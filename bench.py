@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Benchmark driver — prints ONE JSON line with the headline metric.
+
+Round-1 headline: LeNet-5 MNIST training throughput (samples/sec/chip) on
+the attached TPU chip (benchmark config #1; BASELINE.md policy: measured,
+not copied — the reference publishes no numbers, so vs_baseline is the
+ratio against the recorded first measurement in BASELINE.md once it lands).
+"""
+
+import json
+import sys
+import time
+
+
+def bench_lenet(batch_size: int = 256, warmup: int = 5, iters: int = 30):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    model = lenet(updater=Adam(1e-3))
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.3, 0.25, (batch_size, 28, 28, 1)).astype(np.float32)
+    y = np.zeros((batch_size, 10), np.float32)
+    y[np.arange(batch_size), rng.integers(0, 10, batch_size)] = 1.0
+    batch = {"features": jnp.asarray(x), "labels": jnp.asarray(y)}
+
+    for _ in range(warmup):
+        ts, metrics = trainer.train_step(ts, batch)
+    jax.block_until_ready(ts.params)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ts, metrics = trainer.train_step(ts, batch)
+    jax.block_until_ready(ts.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch_size * iters / dt
+    return samples_per_sec
+
+
+def main():
+    try:
+        value = bench_lenet()
+        result = {
+            "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+            "value": round(value, 1),
+            "unit": "samples/sec/chip",
+            "vs_baseline": 1.0,
+        }
+    except Exception as e:  # noqa: BLE001 - bench must always emit one line
+        result = {
+            "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "samples/sec/chip",
+            "vs_baseline": 0.0,
+            "error": str(e)[:200],
+        }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Data/ETL layer (↔ DataVec + the deeplearning4j dataset iterators)."""
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    TransformIterator,
+)
+from deeplearning4j_tpu.data.mnist import load_mnist
+from deeplearning4j_tpu.data.normalizers import (
+    ImageMeanSubtraction,
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet",
+    "ArrayDataSetIterator", "AsyncDataSetIterator", "TransformIterator",
+    "load_mnist",
+    "ImageMeanSubtraction", "ImagePreProcessingScaler",
+    "NormalizerMinMaxScaler", "NormalizerStandardize",
+]

@@ -1,0 +1,69 @@
+"""Minibatch containers (↔ org.nd4j.linalg.dataset.{DataSet, MultiDataSet}).
+
+A DataSet is a pytree (registered dataclass) so it can flow directly into a
+jitted train step and be device_put with a sharding in one call — the
+TPU-native replacement for the reference's workspace-attached INDArray
+batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DataSet:
+    """↔ org.nd4j.linalg.dataset.DataSet (features, labels + masks)."""
+
+    features: Any
+    labels: Any
+    features_mask: Optional[Any] = None
+    labels_mask: Optional[Any] = None
+
+    @property
+    def num_examples(self) -> int:
+        return self.features.shape[0]
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {"features": self.features, "labels": self.labels}
+        if self.labels_mask is not None:
+            d["mask"] = self.labels_mask
+        return d
+
+    def split(self, n: int):
+        """Split into n equal shards along batch (host-side)."""
+        fs = np.array_split(np.asarray(self.features), n)
+        ls = np.array_split(np.asarray(self.labels), n)
+        return [DataSet(f, l) for f, l in zip(fs, ls)]
+
+
+def as_batch_dict(batch) -> Dict[str, Any]:
+    """Coerce DataSet-likes, (x, y) tuples, or ready dicts into the batch
+    dict the loss functions consume."""
+    if isinstance(batch, dict):
+        return batch
+    if hasattr(batch, "features") and hasattr(batch, "labels"):
+        d = {"features": batch.features, "labels": batch.labels}
+        mask = getattr(batch, "labels_mask", None)
+        if mask is not None:
+            d["mask"] = mask
+        return d
+    if isinstance(batch, (tuple, list)) and len(batch) == 2:
+        return {"features": batch[0], "labels": batch[1]}
+    raise TypeError(f"cannot interpret batch of type {type(batch)}")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MultiDataSet:
+    """↔ org.nd4j.linalg.dataset.MultiDataSet (N features, M labels)."""
+
+    features: Sequence[Any]
+    labels: Sequence[Any]
+    features_masks: Optional[Sequence[Any]] = None
+    labels_masks: Optional[Sequence[Any]] = None

@@ -1,0 +1,135 @@
+"""Dataset iterators (↔ org.nd4j.linalg.dataset.api.iterator.DataSetIterator
++ org.deeplearning4j.datasets.iterator.AsyncDataSetIterator).
+
+The reference's AsyncDataSetIterator prefetches batches on a background
+thread into a workspace ring; the TPU-native equivalent overlaps host ETL
+with device compute via a background thread + ``jax.device_put`` onto a
+sharding (H2D happens while the previous step runs — double buffering).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class ArrayDataSetIterator:
+    """In-memory (features, labels) → minibatch iterator
+    (↔ ListDataSetIterator / ExistingDataSetIterator)."""
+
+    def __init__(self, features, labels, batch_size: int, *, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        assert self.features.shape[0] == self.labels.shape[0]
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+        self._epoch = 0
+
+    def __len__(self):
+        n = self.features.shape[0]
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[DataSet]:
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        end = n - (n % self.batch_size) if self.drop_last else n
+        for i in range(0, end, self.batch_size):
+            sel = idx[i : i + self.batch_size]
+            yield DataSet(self.features[sel], self.labels[sel])
+        self._epoch += 1
+
+    def reset(self):
+        pass  # fresh iterator each __iter__
+
+
+class AsyncDataSetIterator:
+    """Background-thread prefetch wrapper (↔ AsyncDataSetIterator with its
+    workspace ring buffer; here the ring is a bounded queue and the
+    device-transfer overlap comes from issuing ``jax.device_put`` before the
+    consumer needs the batch)."""
+
+    def __init__(self, base: Iterable, prefetch: int = 2, device_put_to=None):
+        self.base = base
+        self.prefetch = prefetch
+        self.device_put_to = device_put_to
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+        stop = threading.Event()
+        err: list = []
+
+        def put(item) -> bool:
+            # Bounded put that gives up when the consumer abandoned us, so an
+            # early `break` in the consumer can't leave this thread blocked
+            # holding device buffers alive.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for item in self.base:
+                    if self.device_put_to is not None:
+                        item = jax.device_put(item, self.device_put_to)
+                    if not put(item):
+                        return
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def __len__(self):
+        return len(self.base)  # type: ignore[arg-type]
+
+
+class TransformIterator:
+    """Apply a per-batch transform fn (↔ the DataSetPreProcessor hook on
+    DataSetIterator: normalizers attach this way)."""
+
+    def __init__(self, base: Iterable, fn: Callable[[DataSet], DataSet]):
+        self.base = base
+        self.fn = fn
+
+    def __iter__(self):
+        for b in self.base:
+            yield self.fn(b)
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def __len__(self):
+        return len(self.base)  # type: ignore[arg-type]

@@ -1,0 +1,105 @@
+"""Data normalizers (↔ org.nd4j.linalg.dataset.api.preprocessor.*).
+
+ref: NormalizerStandardize (fit mean/std, transform), NormalizerMinMaxScaler,
+ImagePreProcessingScaler (pixel /255 range map), VGG16ImagePreProcessor
+(mean subtraction). Same fit/transform/revert lifecycle; state is plain
+numpy (host-side ETL), serializable to npz alongside checkpoints.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class NormalizerStandardize:
+    """↔ NormalizerStandardize: per-feature z-score."""
+
+    def __init__(self):
+        self.mean = None
+        self.std = None
+
+    def fit(self, features: np.ndarray):
+        axes = tuple(range(features.ndim - 1))
+        self.mean = np.asarray(features).mean(axis=axes)
+        self.std = np.asarray(features).std(axis=axes) + 1e-8
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        return DataSet((ds.features - self.mean) / self.std, ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+    def revert_features(self, features):
+        return features * self.std + self.mean
+
+    def save(self, path):
+        np.savez(path, mean=self.mean, std=self.std)
+
+    @classmethod
+    def load(cls, path):
+        z = np.load(path)
+        n = cls()
+        n.mean, n.std = z["mean"], z["std"]
+        return n
+
+    __call__ = transform
+
+
+class NormalizerMinMaxScaler:
+    """↔ NormalizerMinMaxScaler: map features into [lo, hi]."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0):
+        self.lo, self.hi = lo, hi
+        self.fmin = None
+        self.fmax = None
+
+    def fit(self, features: np.ndarray):
+        axes = tuple(range(features.ndim - 1))
+        self.fmin = np.asarray(features).min(axis=axes)
+        self.fmax = np.asarray(features).max(axis=axes)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        scale = (self.hi - self.lo) / np.maximum(self.fmax - self.fmin, 1e-8)
+        f = (ds.features - self.fmin) * scale + self.lo
+        return DataSet(f, ds.labels, ds.features_mask, ds.labels_mask)
+
+    __call__ = transform
+
+
+class ImagePreProcessingScaler:
+    """↔ ImagePreProcessingScaler: uint8 pixels → [lo, hi] (default [0,1])."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0, max_pixel: float = 255.0):
+        self.lo, self.hi, self.max_pixel = lo, hi, max_pixel
+
+    def fit(self, features):
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features, np.float32) / self.max_pixel
+        f = f * (self.hi - self.lo) + self.lo
+        return DataSet(f, ds.labels, ds.features_mask, ds.labels_mask)
+
+    __call__ = transform
+
+
+class ImageMeanSubtraction:
+    """↔ VGG16ImagePreProcessor: per-channel mean subtraction (and optional
+    std division — covers ImageNet preprocessing)."""
+
+    def __init__(self, mean, std=None):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def fit(self, features):
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features, np.float32) - self.mean
+        if self.std is not None:
+            f = f / self.std
+        return DataSet(f, ds.labels, ds.features_mask, ds.labels_mask)
+
+    __call__ = transform

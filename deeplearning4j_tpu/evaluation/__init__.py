@@ -1,0 +1,6 @@
+"""Evaluation stack (↔ org.nd4j.evaluation.**)."""
+
+from deeplearning4j_tpu.evaluation.classification import Evaluation, evaluate_model
+from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+
+__all__ = ["Evaluation", "evaluate_model", "RegressionEvaluation"]

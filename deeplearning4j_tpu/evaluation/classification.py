@@ -1,0 +1,123 @@
+"""Classification evaluation (↔ org.nd4j.evaluation.classification.Evaluation).
+
+ref: Evaluation (confusion matrix, accuracy/precision/recall/F1 micro+macro,
+top-N accuracy), incremental ``eval(labels, predictions)`` batching.
+
+TPU-native: the per-batch statistic is a confusion-matrix accumulation done
+ON DEVICE (one segment-sum — and under pjit it psums across data shards),
+with metrics derived host-side at report time. This replaces the
+reference's host-side per-batch INDArray bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops import math as opsmath
+
+
+@jax.jit
+def _confusion_update(cm, logits_or_probs, labels):
+    pred = jnp.argmax(logits_or_probs, axis=-1)
+    lab = jnp.argmax(labels, axis=-1) if labels.ndim == pred.ndim + 1 else labels
+    return cm + opsmath.confusion_matrix(lab, pred, cm.shape[0])
+
+
+class Evaluation:
+    """↔ org.nd4j.evaluation.classification.Evaluation."""
+
+    def __init__(self, num_classes: int, labels_list: Optional[list] = None):
+        self.num_classes = num_classes
+        self.labels_list = labels_list or [str(i) for i in range(num_classes)]
+        self.cm = jnp.zeros((num_classes, num_classes), jnp.float32)
+
+    # -- accumulation ------------------------------------------------------
+
+    def eval(self, labels, predictions):
+        """Accumulate one batch (device-side)."""
+        self.cm = _confusion_update(self.cm, predictions, labels)
+        return self
+
+    def merge(self, other: "Evaluation"):
+        """↔ Evaluation.merge (for sharded/parallel eval)."""
+        self.cm = self.cm + other.cm
+        return self
+
+    # -- derived metrics (host-side) ---------------------------------------
+
+    def _np(self):
+        return np.asarray(jax.device_get(self.cm))
+
+    def accuracy(self) -> float:
+        cm = self._np()
+        return float(np.trace(cm) / max(cm.sum(), 1))
+
+    def precision(self, cls: Optional[int] = None, average: str = "macro") -> float:
+        cm = self._np()
+        tp = np.diag(cm)
+        denom = cm.sum(axis=0)
+        per = np.divide(tp, denom, out=np.zeros_like(tp), where=denom > 0)
+        if cls is not None:
+            return float(per[cls])
+        if average == "macro":
+            present = denom > 0
+            return float(per[present].mean()) if present.any() else 0.0
+        return float(tp.sum() / max(cm.sum(), 1))
+
+    def recall(self, cls: Optional[int] = None, average: str = "macro") -> float:
+        cm = self._np()
+        tp = np.diag(cm)
+        denom = cm.sum(axis=1)
+        per = np.divide(tp, denom, out=np.zeros_like(tp), where=denom > 0)
+        if cls is not None:
+            return float(per[cls])
+        if average == "macro":
+            present = denom > 0
+            return float(per[present].mean()) if present.any() else 0.0
+        return float(tp.sum() / max(cm.sum(), 1))
+
+    def f1(self, cls: Optional[int] = None, average: str = "macro") -> float:
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return 2 * p * r / max(p + r, 1e-12)
+        cm = self._np()
+        tp = np.diag(cm)
+        pden = cm.sum(axis=0)
+        rden = cm.sum(axis=1)
+        p = np.divide(tp, pden, out=np.zeros_like(tp), where=pden > 0)
+        r = np.divide(tp, rden, out=np.zeros_like(tp), where=rden > 0)
+        f = np.divide(2 * p * r, p + r, out=np.zeros_like(tp), where=(p + r) > 0)
+        present = rden > 0
+        return float(f[present].mean()) if present.any() else 0.0
+
+    def confusion(self) -> np.ndarray:
+        return self._np()
+
+    def stats(self) -> str:
+        """↔ Evaluation.stats() summary string."""
+        cm = self._np()
+        lines = [
+            f"# examples: {int(cm.sum())}",
+            f"Accuracy:  {self.accuracy():.4f}",
+            f"Precision: {self.precision():.4f} (macro)",
+            f"Recall:    {self.recall():.4f} (macro)",
+            f"F1 Score:  {self.f1():.4f} (macro)",
+        ]
+        return "\n".join(lines)
+
+
+def evaluate_model(model, variables, data_iter, num_classes: int) -> Evaluation:
+    """↔ MultiLayerNetwork.evaluate(DataSetIterator)."""
+    ev = Evaluation(num_classes)
+    for batch in data_iter:
+        feats = batch.features if hasattr(batch, "features") else batch[0]
+        labels = batch.labels if hasattr(batch, "labels") else batch[1]
+        out = model.output(variables, feats)
+        if isinstance(out, dict):
+            out = next(iter(out.values()))
+        ev.eval(labels, out)
+    return ev

@@ -1,0 +1,75 @@
+"""Regression evaluation (↔ org.nd4j.evaluation.regression.RegressionEvaluation).
+
+Metrics per output column: MSE, MAE, RMSE, RSE (relative squared error),
+PC (Pearson correlation), R². Accumulated with streaming sums on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _acc_update(acc, pred, target):
+    return {
+        "n": acc["n"] + pred.shape[0],
+        "se": acc["se"] + jnp.sum(jnp.square(pred - target), axis=0),
+        "ae": acc["ae"] + jnp.sum(jnp.abs(pred - target), axis=0),
+        "sum_t": acc["sum_t"] + jnp.sum(target, axis=0),
+        "sum_t2": acc["sum_t2"] + jnp.sum(jnp.square(target), axis=0),
+        "sum_p": acc["sum_p"] + jnp.sum(pred, axis=0),
+        "sum_p2": acc["sum_p2"] + jnp.sum(jnp.square(pred), axis=0),
+        "sum_pt": acc["sum_pt"] + jnp.sum(pred * target, axis=0),
+    }
+
+
+class RegressionEvaluation:
+    def __init__(self, n_columns: int):
+        z = jnp.zeros((n_columns,), jnp.float32)
+        self.acc = {
+            "n": jnp.zeros((), jnp.float32),
+            "se": z, "ae": z, "sum_t": z, "sum_t2": z,
+            "sum_p": z, "sum_p2": z, "sum_pt": z,
+        }
+
+    def eval(self, labels, predictions):
+        self.acc = _acc_update(self.acc, predictions, labels)
+        return self
+
+    def _h(self):
+        return {k: np.asarray(jax.device_get(v)) for k, v in self.acc.items()}
+
+    def mse(self):
+        a = self._h()
+        return a["se"] / max(a["n"], 1)
+
+    def mae(self):
+        a = self._h()
+        return a["ae"] / max(a["n"], 1)
+
+    def rmse(self):
+        return np.sqrt(self.mse())
+
+    def r2(self):
+        a = self._h()
+        n = max(a["n"], 1)
+        ss_tot = a["sum_t2"] - np.square(a["sum_t"]) / n
+        return 1.0 - a["se"] / np.maximum(ss_tot, 1e-12)
+
+    def pearson(self):
+        a = self._h()
+        n = max(a["n"], 1)
+        cov = a["sum_pt"] - a["sum_p"] * a["sum_t"] / n
+        vp = a["sum_p2"] - np.square(a["sum_p"]) / n
+        vt = a["sum_t2"] - np.square(a["sum_t"]) / n
+        return cov / np.maximum(np.sqrt(vp * vt), 1e-12)
+
+    def stats(self) -> str:
+        return (
+            f"MSE:  {np.mean(self.mse()):.6f}\n"
+            f"MAE:  {np.mean(self.mae()):.6f}\n"
+            f"RMSE: {np.mean(self.rmse()):.6f}\n"
+            f"R^2:  {np.mean(self.r2()):.6f}"
+        )

@@ -1,0 +1,243 @@
+"""Pallas TPU LSTM scan kernel.
+
+ref: the cuDNN RNN platform helper (libnd4j
+ops/declarable/platform/cudnn/lstmLayer.cu + DL4J CudnnLSTMHelper) —
+benchmark config #3 'GravesLSTM cuDNN RNN helper → Pallas scan'.
+
+Design: one `pallas_call` with grid=(T,). The recurrent weights [H,4H] and
+the per-step carried state (h, c — VMEM scratch) stay resident on-chip for
+the whole sequence; each grid step does ONE MXU matmul (h·RW) + VPU gate
+math + a [N,4H] slice stream-in / [N,H] stream-out. The input projection
+x·W for all timesteps is done OUTSIDE the kernel as one large MXU GEMM
+(same schedule cuDNN uses).
+
+Backward: a custom_vjp whose bwd recomputes via the XLA lax.scan
+implementation (ops/rnn.py) and differentiates that — correct by
+construction; a hand-written backward kernel is a later optimization.
+
+Falls back to interpret mode off-TPU (CI) and to ops/rnn.py for shapes that
+don't tile (N % 8, H % 128).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend may be absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from deeplearning4j_tpu.ops import rnn as opsrnn
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _gates_kernel(xp_ref, rw_ref, b_ref, h0_ref, c0_ref, out_ref,
+                  hN_ref, cN_ref, h_scr, c_scr, *, forget_bias, peep):
+    """One timestep per grid index; state carried in VMEM scratch."""
+    t = pl.program_id(0)
+    n_t = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h = h_scr[:]
+    c_prev = c_scr[:]
+    H = h.shape[-1]
+
+    z = (
+        xp_ref[0]
+        + jnp.dot(h, rw_ref[:], preferred_element_type=jnp.float32)
+        + b_ref[0]
+    )
+    zi = z[:, 0 * H : 1 * H]
+    zf = z[:, 1 * H : 2 * H]
+    zg = z[:, 2 * H : 3 * H]
+    zo = z[:, 3 * H : 4 * H]
+    if peep:
+        pI_ref, pF_ref, pO_ref = peep
+        zi = zi + pI_ref[0] * c_prev
+        zf = zf + pF_ref[0] * c_prev
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf + forget_bias)
+    g = jnp.tanh(zg)
+    c = f * c_prev + i * g
+    if peep:
+        zo = zo + pO_ref[0] * c
+    o = jax.nn.sigmoid(zo)
+    h_new = o * jnp.tanh(c)
+
+    h_scr[:] = h_new
+    c_scr[:] = c
+    out_ref[0] = h_new.astype(out_ref.dtype)
+
+    @pl.when(t == n_t - 1)
+    def _final():
+        hN_ref[:] = h_new.astype(hN_ref.dtype)
+        cN_ref[:] = c.astype(cN_ref.dtype)
+
+
+def _lstm_pallas_fwd(x_proj_tm, rw, b, h0, c0, peepholes, forget_bias):
+    """x_proj_tm: [T,N,4H] time-major; returns (hs [T,N,H], (hT, cT))."""
+    t_len, n, fourh = x_proj_tm.shape
+    h_dim = fourh // 4
+    dtype = x_proj_tm.dtype
+
+    b2 = b.reshape(1, fourh).astype(jnp.float32)
+    peep = peepholes is not None
+    peep_args = ()
+    peep_specs = ()
+    if peep:
+        peep_args = tuple(p.reshape(1, h_dim).astype(jnp.float32) for p in peepholes)
+        peep_specs = tuple(
+            pl.BlockSpec((1, h_dim), lambda t: (0, 0)) for _ in range(3)
+        )
+
+    # Kernel signature depends on whether peephole refs are present.
+    if peep:
+        def kernel(xp_ref, rw_ref, b_ref, pI_ref, pF_ref, pO_ref, h0_ref, c0_ref,
+                   out_ref, hN_ref, cN_ref, h_scr, c_scr):
+            return _gates_kernel(
+                xp_ref, rw_ref, b_ref, h0_ref, c0_ref, out_ref, hN_ref, cN_ref,
+                h_scr, c_scr, forget_bias=float(forget_bias),
+                peep=(pI_ref, pF_ref, pO_ref),
+            )
+    else:
+        def kernel(xp_ref, rw_ref, b_ref, h0_ref, c0_ref,
+                   out_ref, hN_ref, cN_ref, h_scr, c_scr):
+            return _gates_kernel(
+                xp_ref, rw_ref, b_ref, h0_ref, c0_ref, out_ref, hN_ref, cN_ref,
+                h_scr, c_scr, forget_bias=float(forget_bias), peep=None,
+            )
+
+    in_specs = [
+        pl.BlockSpec((1, n, fourh), lambda t: (t, 0, 0)),  # x_proj step t
+        pl.BlockSpec((h_dim, fourh), lambda t: (0, 0)),    # RW resident
+        pl.BlockSpec((1, fourh), lambda t: (0, 0)),        # bias
+        *peep_specs,
+        pl.BlockSpec((n, h_dim), lambda t: (0, 0)),        # h0
+        pl.BlockSpec((n, h_dim), lambda t: (0, 0)),        # c0
+    ]
+    out_specs = [
+        pl.BlockSpec((1, n, h_dim), lambda t: (t, 0, 0)),  # hs
+        pl.BlockSpec((n, h_dim), lambda t: (0, 0)),        # hT
+        pl.BlockSpec((n, h_dim), lambda t: (0, 0)),        # cT
+    ]
+    scratch = [
+        pltpu.VMEM((n, h_dim), jnp.float32),
+        pltpu.VMEM((n, h_dim), jnp.float32),
+    ]
+
+    hs, hT, cT = pl.pallas_call(
+        kernel,
+        grid=(t_len,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=[
+            jax.ShapeDtypeStruct((t_len, n, h_dim), dtype),
+            jax.ShapeDtypeStruct((n, h_dim), dtype),
+            jax.ShapeDtypeStruct((n, h_dim), dtype),
+        ],
+        scratch_shapes=scratch,
+        interpret=not _on_tpu(),
+    )(
+        x_proj_tm,
+        rw.astype(jnp.float32),
+        b2,
+        *peep_args,
+        h0.astype(jnp.float32),
+        c0.astype(jnp.float32),
+    )
+    return hs, hT, cT
+
+
+def _shapes_tile(n: int, h: int) -> bool:
+    return n % 8 == 0 and (4 * h) % 128 == 0 and h % 128 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _lstm_core(x, w_x, w_h, b, peep_stack, forget_bias, has_peep):
+    """peep_stack: [3,H] array when has_peep else zeros. Returns the triple
+    (outputs [N,T,H], h_T [N,H], c_T [N,H])."""
+    return _lstm_core_fwd_impl(x, w_x, w_h, b, peep_stack, forget_bias, has_peep)
+
+
+def _lstm_core_fwd_impl(x, w_x, w_h, b, peep_stack, forget_bias, has_peep):
+    n, t, _ = x.shape
+    h_dim = w_h.shape[0]
+    x_proj = jnp.einsum("nti,ih->nth", x, w_x)  # big MXU GEMM outside kernel
+    xp_tm = jnp.swapaxes(x_proj, 0, 1).astype(jnp.float32)
+    h0 = jnp.zeros((n, h_dim), jnp.float32)
+    c0 = jnp.zeros((n, h_dim), jnp.float32)
+    peep = tuple(peep_stack) if has_peep else None
+    hs, hT, cT = _lstm_pallas_fwd(xp_tm, w_h, b, h0, c0, peep, forget_bias)
+    return jnp.swapaxes(hs, 0, 1).astype(x.dtype), hT, cT
+
+
+def _lstm_core_vjp_fwd(x, w_x, w_h, b, peep_stack, forget_bias, has_peep):
+    out = _lstm_core(x, w_x, w_h, b, peep_stack, forget_bias, has_peep)
+    return out, (x, w_x, w_h, b, peep_stack)
+
+
+def _lstm_core_vjp_bwd(forget_bias, has_peep, res, g):
+    x, w_x, w_h, b, peep_stack = res
+
+    def ref_impl(x, w_x, w_h, b, peep_stack):
+        peep = tuple(peep_stack) if has_peep else None
+        out, final = opsrnn.lstm(x, w_x, w_h, b, peepholes=peep, forget_bias=forget_bias)
+        return out, final.h, final.c
+
+    _, vjp = jax.vjp(ref_impl, x, w_x, w_h, b, peep_stack)
+    return vjp(g)
+
+
+_lstm_core.defvjp(_lstm_core_vjp_fwd, _lstm_core_vjp_bwd)
+
+
+def lstm(
+    x,
+    w_x,
+    w_h,
+    b,
+    *,
+    peepholes=None,
+    forget_bias: float = 0.0,
+    init_state=None,
+):
+    """Drop-in replacement for ops/rnn.lstm using the Pallas kernel.
+
+    Falls back to the XLA scan when shapes don't tile onto the TPU VPU/MXU
+    (N % 8 != 0 or H % 128 != 0) or when an initial state is supplied
+    (kernel currently assumes zero init for the custom-vjp recompute path).
+    """
+    n, t, _ = x.shape
+    h_dim = w_h.shape[0]
+    if init_state is not None or not _shapes_tile(n, h_dim):
+        return opsrnn.lstm(
+            x, w_x, w_h, b, peepholes=peepholes, forget_bias=forget_bias,
+            init_state=init_state,
+        )
+    if peepholes is not None:
+        peep_stack = jnp.stack(peepholes)
+        has_peep = True
+    else:
+        peep_stack = jnp.zeros((3, h_dim), x.dtype)
+        has_peep = False
+    outputs, h_t, c_t = _lstm_core(x, w_x, w_h, b, peep_stack, float(forget_bias), has_peep)
+    return outputs, opsrnn.LSTMState(h_t, c_t)

@@ -1,0 +1,46 @@
+"""LeNet-5 (↔ org.deeplearning4j.zoo.model.LeNet — benchmark config #1).
+
+ref architecture (zoo LeNet): conv5x5x20 → maxpool2 → conv5x5x50 →
+maxpool2 → dense500(relu) → softmax output. NHWC here (TPU layout).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, SequentialConfig
+from deeplearning4j_tpu.nn.layers import (
+    Conv2D,
+    Dense,
+    Flatten,
+    OutputLayer,
+    Pooling2D,
+)
+from deeplearning4j_tpu.nn.model import SequentialModel
+from deeplearning4j_tpu.train.updaters import Adam
+
+
+def lenet_config(
+    *,
+    num_classes: int = 10,
+    input_shape=(28, 28, 1),
+    updater=None,
+    seed: int = 12345,
+) -> SequentialConfig:
+    net = NeuralNetConfiguration(
+        seed=seed,
+        updater=updater if updater is not None else Adam(1e-3),
+        weight_init="xavier",
+    )
+    layers = [
+        Conv2D(filters=20, kernel=5, stride=1, padding="SAME", activation="relu"),
+        Pooling2D(pool_type="max", window=2),
+        Conv2D(filters=50, kernel=5, stride=1, padding="SAME", activation="relu"),
+        Pooling2D(pool_type="max", window=2),
+        Flatten(),
+        Dense(units=500, activation="relu"),
+        OutputLayer(units=num_classes, activation="softmax", loss="mcxent"),
+    ]
+    return SequentialConfig(net=net, layers=layers, input_shape=input_shape)
+
+
+def lenet(**kw) -> SequentialModel:
+    return SequentialModel(lenet_config(**kw))

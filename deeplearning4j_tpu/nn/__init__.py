@@ -1,0 +1,32 @@
+"""NN library (↔ deeplearning4j-nn: config, layers, containers)."""
+
+from deeplearning4j_tpu.nn import layers  # noqa: F401
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    LayerConfig,
+    NeuralNetConfiguration,
+    SequentialConfig,
+    config_from_dict,
+    config_from_json,
+    config_to_dict,
+    config_to_json,
+    register_config,
+)
+from deeplearning4j_tpu.nn.model import GraphModel, SequentialModel
+
+__all__ = [
+    "layers",
+    "GraphConfig",
+    "GraphVertex",
+    "LayerConfig",
+    "NeuralNetConfiguration",
+    "SequentialConfig",
+    "config_from_dict",
+    "config_from_json",
+    "config_to_dict",
+    "config_to_json",
+    "register_config",
+    "GraphModel",
+    "SequentialModel",
+]

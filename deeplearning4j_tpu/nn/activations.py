@@ -1,0 +1,54 @@
+"""Activation registry (↔ org.nd4j.linalg.activations.Activation enum).
+
+ref: nd4j Activation enum (CUBE, ELU, GELU, HARDSIGMOID, HARDTANH, IDENTITY,
+LEAKYRELU, MISH, RATIONALTANH, RECTIFIEDTANH, RELU, RELU6, SELU, SIGMOID,
+SOFTMAX, SOFTPLUS, SOFTSIGN, SWISH, TANH, THRESHOLDEDRELU, PRELU) with
+IActivation impls. Here: name → pure function, resolved at model-build time
+so the jitted program contains the function directly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import nn as opsnn
+
+ACTIVATIONS: dict[str, Callable] = {
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "relu": opsnn.relu,
+    "relu6": opsnn.relu6,
+    "sigmoid": opsnn.sigmoid,
+    "tanh": opsnn.tanh,
+    "softmax": opsnn.softmax,
+    "log_softmax": opsnn.log_softmax,
+    "softplus": opsnn.softplus,
+    "softsign": opsnn.soft_sign,
+    "elu": opsnn.elu,
+    "selu": opsnn.selu,
+    "gelu": opsnn.gelu,
+    "silu": opsnn.silu,
+    "swish": opsnn.swish,
+    "mish": opsnn.mish,
+    "hardsigmoid": opsnn.hard_sigmoid,
+    "hardtanh": opsnn.hard_tanh,
+    "leakyrelu": opsnn.leaky_relu,
+    "hardswish": opsnn.hard_swish,
+    "thresholdedrelu": opsnn.thresholded_relu,
+    "rationaltanh": opsnn.rational_tanh,
+    "rectifiedtanh": opsnn.rectified_tanh,
+    "cube": opsnn.cube,
+}
+
+
+def get_activation(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return ACTIVATIONS[name_or_fn.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation '{name_or_fn}'; available: {sorted(ACTIVATIONS)}"
+        ) from None

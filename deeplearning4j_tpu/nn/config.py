@@ -1,0 +1,199 @@
+"""Configuration-as-data with JSON round-trip.
+
+ref: org.deeplearning4j.nn.conf.{NeuralNetConfiguration, MultiLayerConfiguration,
+ComputationGraphConfiguration} — builder-pattern config classes with polymorphic
+Jackson JSON serialization; the serialized config is the checkpoint's
+architecture record (a model is reconstructable from JSON alone).
+
+TPU-native version: plain dataclasses with a type registry. ``to_dict`` embeds
+``"@class"`` discriminators exactly like the reference's Jackson
+``@JsonTypeInfo``; ``from_dict`` resolves them. All configs are immutable
+value objects; building a model from a config produces pure init/apply
+functions that jit/pjit compile whole-graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# --- polymorphic config registry (↔ Jackson @JsonTypeInfo/@JsonSubTypes) ---
+
+CONFIG_REGISTRY: Dict[str, type] = {}
+
+
+def register_config(cls):
+    """Class decorator: make a dataclass JSON round-trippable by name."""
+    CONFIG_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def config_to_dict(obj: Any) -> Any:
+    """Recursively convert a config object to JSON-able primitives."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        d = {"@class": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            d[f.name] = config_to_dict(getattr(obj, f.name))
+        return d
+    if isinstance(obj, dict):
+        return {k: config_to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [config_to_dict(v) for v in obj]
+    return obj
+
+
+def config_from_dict(d: Any) -> Any:
+    """Inverse of config_to_dict (lists stay lists; configs by @class)."""
+    if isinstance(d, dict):
+        if "@class" in d:
+            cls = CONFIG_REGISTRY.get(d["@class"])
+            if cls is None:
+                raise ValueError(f"unknown config class '{d['@class']}'")
+            kwargs = {k: config_from_dict(v) for k, v in d.items() if k != "@class"}
+            # Tolerate forward/backward compat: drop unknown fields.
+            names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {k: v for k, v in kwargs.items() if k in names}
+            return cls(**kwargs)
+        return {k: config_from_dict(v) for k, v in d.items()}
+    if isinstance(d, list):
+        return [config_from_dict(v) for v in d]
+    return d
+
+
+def config_to_json(obj: Any, **kw) -> str:
+    return json.dumps(config_to_dict(obj), indent=kw.pop("indent", 2), **kw)
+
+
+def config_from_json(s: str) -> Any:
+    return config_from_dict(json.loads(s))
+
+
+# --- base layer config -----------------------------------------------------
+
+
+@dataclass
+class LayerConfig:
+    """Base for all layer configs (↔ org.deeplearning4j.nn.conf.layers.Layer).
+
+    A layer config is a pure value; the runtime behavior is its
+    ``init(rng, input_shape, dtype) -> (params, state)`` and
+    ``apply(params, state, x, train, rng) -> (y, new_state)`` methods.
+    Shapes exclude the batch dimension (↔ InputType shape inference).
+    """
+
+    name: Optional[str] = field(default=None, kw_only=True)
+    # Per-layer regularization (↔ Layer.l1/l2 config; collected by the model
+    # into the loss term). None = inherit the net-level default; an explicit
+    # 0.0 opts the layer out even when the net default is nonzero.
+    l1: Optional[float] = field(default=None, kw_only=True)
+    l2: Optional[float] = field(default=None, kw_only=True)
+    # Per-layer dtype override; None → model default.
+    dtype: Optional[str] = field(default=None, kw_only=True)
+
+    # -- interface ---------------------------------------------------------
+    def output_shape(self, input_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        return tuple(input_shape)
+
+    def init(self, rng, input_shape, dtype):
+        return {}, {}
+
+    def apply(self, params, state, x, *, train: bool = False, rng=None):
+        raise NotImplementedError
+
+    # -- convenience -------------------------------------------------------
+    def to_json(self) -> str:
+        return config_to_json(self)
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+
+# --- network-level configs -------------------------------------------------
+
+
+@register_config
+@dataclass
+class NeuralNetConfiguration:
+    """Global hyperparameters (↔ NeuralNetConfiguration / the part of
+    MultiLayerConfiguration that is not the layer list).
+
+    ``updater`` is an updater config from train/updaters.py (registered for
+    serde). ``seed`` drives all param init and dropout RNG.
+    """
+
+    seed: int = 12345
+    updater: Any = None  # UpdaterConfig dataclass; None → SGD(0.01)
+    weight_init: str = "xavier"
+    dtype: str = "float32"
+    # Gradient clipping (↔ GradientNormalization enum + threshold).
+    gradient_normalization: Optional[str] = None  # None|'clip_l2_per_param'|
+    # 'clip_l2_global'|'clip_value'|'renormalize_l2_per_layer'
+    gradient_normalization_threshold: float = 1.0
+    # Global regularization applied to all weight params (not biases),
+    # overridden by per-layer values (↔ .l2(x) on the builder).
+    l1: float = 0.0
+    l2: float = 0.0
+    mixed_precision: bool = False  # bf16 compute / fp32 params+accum
+
+
+@register_config
+@dataclass
+class SequentialConfig:
+    """↔ MultiLayerConfiguration: global conf + ordered layer stack + input
+    shape (↔ setInputType)."""
+
+    net: NeuralNetConfiguration
+    layers: List[Any]
+    input_shape: Sequence[int]  # without batch dim
+
+    def to_json(self) -> str:
+        return config_to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "SequentialConfig":
+        cfg = config_from_json(s)
+        if not isinstance(cfg, SequentialConfig):
+            raise TypeError(f"expected SequentialConfig, got {type(cfg)}")
+        return cfg
+
+
+@register_config
+@dataclass
+class GraphVertex:
+    """One vertex of a DAG network (↔ org.deeplearning4j.nn.conf.graph.*).
+
+    kind: 'layer' (wraps a LayerConfig), 'merge' (concat on feature axis),
+    'add' / 'mul' / 'average' / 'max' / 'subtract' (ElementWiseVertex ops),
+    'scale', 'preprocessor' (reshape function by name).
+    """
+
+    kind: str
+    inputs: List[str]
+    layer: Any = None  # LayerConfig when kind == 'layer'
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config
+@dataclass
+class GraphConfig:
+    """↔ ComputationGraphConfiguration: named-vertex DAG with explicit
+    network inputs and outputs."""
+
+    net: NeuralNetConfiguration
+    inputs: List[str]  # network input names
+    input_shapes: Dict[str, Sequence[int]]
+    vertices: Dict[str, GraphVertex]  # name → vertex (insertion order kept)
+    outputs: List[str]  # vertex names producing network outputs
+
+    def to_json(self) -> str:
+        return config_to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "GraphConfig":
+        cfg = config_from_json(s)
+        if not isinstance(cfg, GraphConfig):
+            raise TypeError(f"expected GraphConfig, got {type(cfg)}")
+        return cfg

@@ -1,0 +1,169 @@
+"""Weight initialization schemes (↔ org.deeplearning4j.nn.weights.WeightInit).
+
+ref: WeightInit enum {XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN, RELU,
+RELU_UNIFORM, LECUN_NORMAL, LECUN_UNIFORM, SIGMOID_UNIFORM, UNIFORM, NORMAL,
+ZERO, ONES, CONSTANT, IDENTITY, VAR_SCALING_*, DISTRIBUTION} and the
+IWeightInit implementations. fan_in/fan_out computed from the weight shape
+the same way (product of receptive field × channels for convs).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape):
+    """fan_in/fan_out for dense [in,out] and conv [k..., in, out] weights."""
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = math.prod(shape[:-2]) if len(shape) > 2 else 1
+    fan_in = shape[-2] * receptive
+    fan_out = shape[-1] * receptive
+    return fan_in, fan_out
+
+
+def zeros(rng, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(rng, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def constant(value):
+    def init(rng, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+def normal(stddev=1.0, mean=0.0):
+    def init(rng, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.normal(rng, shape, dtype)
+
+    return init
+
+
+def uniform(lo=None, hi=None):
+    def init(rng, shape, dtype=jnp.float32):
+        if lo is None:
+            fan_in, _ = _fans(shape)
+            a = 1.0 / math.sqrt(fan_in)
+            return jax.random.uniform(rng, shape, dtype, -a, a)
+        return jax.random.uniform(rng, shape, dtype, lo, hi)
+
+    return init
+
+
+def xavier(rng, shape, dtype=jnp.float32):
+    """Glorot normal: N(0, 2/(fan_in+fan_out)) (ref: WeightInitXavier)."""
+    fan_in, fan_out = _fans(shape)
+    std = math.sqrt(2.0 / (fan_in + fan_out))
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def xavier_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    a = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -a, a)
+
+
+def xavier_fan_in(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    std = math.sqrt(1.0 / fan_in)
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def relu_init(rng, shape, dtype=jnp.float32):
+    """He normal: N(0, 2/fan_in) (ref: WeightInit.RELU)."""
+    fan_in, _ = _fans(shape)
+    std = math.sqrt(2.0 / fan_in)
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def relu_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    a = math.sqrt(6.0 / fan_in)
+    return jax.random.uniform(rng, shape, dtype, -a, a)
+
+
+def lecun_normal(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    std = math.sqrt(1.0 / fan_in)
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def lecun_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    a = math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(rng, shape, dtype, -a, a)
+
+
+def sigmoid_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -a, a)
+
+
+def identity(rng, shape, dtype=jnp.float32):
+    assert len(shape) == 2 and shape[0] == shape[1], "identity init needs square matrix"
+    return jnp.eye(shape[0], dtype=dtype)
+
+
+def orthogonal(scale=1.0):
+    def init(rng, shape, dtype=jnp.float32):
+        return scale * jax.nn.initializers.orthogonal()(rng, shape, dtype)
+
+    return init
+
+
+def var_scaling(scale=1.0, mode="fan_in", distribution="normal"):
+    def init(rng, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        n = {"fan_in": fan_in, "fan_out": fan_out, "fan_avg": (fan_in + fan_out) / 2}[mode]
+        if distribution == "normal":
+            return math.sqrt(scale / n) * jax.random.normal(rng, shape, dtype)
+        a = math.sqrt(3.0 * scale / n)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+
+    return init
+
+
+INITIALIZERS: dict[str, Callable] = {
+    "zero": zeros,
+    "zeros": zeros,
+    "ones": ones,
+    "xavier": xavier,
+    "glorot_normal": xavier,
+    "xavier_uniform": xavier_uniform,
+    "glorot_uniform": xavier_uniform,
+    "xavier_fan_in": xavier_fan_in,
+    "relu": relu_init,
+    "he_normal": relu_init,
+    "relu_uniform": relu_uniform,
+    "he_uniform": relu_uniform,
+    "lecun_normal": lecun_normal,
+    "lecun_uniform": lecun_uniform,
+    "sigmoid_uniform": sigmoid_uniform,
+    "uniform": uniform(),
+    "normal": normal(0.01),
+    "identity": identity,
+    "orthogonal": orthogonal(),
+}
+
+
+def get_initializer(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return INITIALIZERS[name_or_fn.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown weight init '{name_or_fn}'; available: {sorted(INITIALIZERS)}"
+        ) from None

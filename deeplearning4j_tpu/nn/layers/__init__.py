@@ -1,0 +1,51 @@
+"""Layer catalog (↔ org.deeplearning4j.nn.conf.layers.*)."""
+
+from deeplearning4j_tpu.nn.layers.conv import (
+    Conv1D,
+    Conv2D,
+    Conv3D,
+    Cropping2D,
+    Deconv2D,
+    DepthwiseConv2D,
+    GlobalPooling,
+    Pooling2D,
+    SeparableConv2D,
+    SpaceToDepth,
+    Upsampling2D,
+    ZeroPadding2D,
+)
+from deeplearning4j_tpu.nn.layers.core import (
+    ActivationLayer,
+    Dense,
+    Dropout,
+    ElementWiseMultiplication,
+    Embedding,
+    Flatten,
+    PReLU,
+    Reshape,
+)
+from deeplearning4j_tpu.nn.layers.norm import (
+    BatchNorm,
+    LayerNorm,
+    LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.output import LossLayer, OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    GRU,
+    LSTM,
+    Bidirectional,
+    GravesLSTM,
+    LastTimeStep,
+    SimpleRnn,
+)
+
+__all__ = [
+    "ActivationLayer", "Dense", "Dropout", "ElementWiseMultiplication",
+    "Embedding", "Flatten", "PReLU", "Reshape",
+    "Conv1D", "Conv2D", "Conv3D", "Cropping2D", "Deconv2D", "DepthwiseConv2D",
+    "GlobalPooling", "Pooling2D", "SeparableConv2D", "SpaceToDepth",
+    "Upsampling2D", "ZeroPadding2D",
+    "BatchNorm", "LayerNorm", "LocalResponseNormalization",
+    "LossLayer", "OutputLayer", "RnnOutputLayer",
+    "GRU", "LSTM", "Bidirectional", "GravesLSTM", "LastTimeStep", "SimpleRnn",
+]

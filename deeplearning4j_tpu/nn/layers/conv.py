@@ -1,0 +1,426 @@
+"""Convolution and pooling layers (NHWC, TPU-first).
+
+ref: org.deeplearning4j.nn.conf.layers.{ConvolutionLayer, Convolution1DLayer,
+Convolution3D, Deconvolution2D, DepthwiseConvolution2D,
+SeparableConvolution2D, SubsamplingLayer, Subsampling1DLayer,
+Upsampling2D, ZeroPaddingLayer, Cropping2D, GlobalPoolingLayer,
+SpaceToDepthLayer} + runtime impls in org.deeplearning4j.nn.layers.convolution.
+
+The reference's layout is NCHW with a cuDNN helper override
+(CudnnConvolutionHelper); here the layout is NHWC (TPU-preferred) and the
+conv lowers to a single XLA conv_general_dilated on the MXU — no helper
+indirection layer exists. Weight layout is HWIO.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.ops import cnn as opscnn
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_out(size, k, s, pad_mode, p=0, d=1):
+    if pad_mode == "SAME":
+        return -(-size // s)
+    eff = (k - 1) * d + 1
+    return (size + 2 * p - eff) // s + 1
+
+
+def _resolve_pad(padding):
+    """'same'/'valid'/int/(ph,pw) → (mode, (ph,pw))."""
+    if isinstance(padding, str):
+        return padding.upper(), (0, 0)
+    return "EXPLICIT", _pair(padding)
+
+
+@register_config
+@dataclass
+class Conv2D(LayerConfig):
+    """↔ ConvolutionLayer (2D). Input [N,H,W,C], weights [kh,kw,Cin,Cout]."""
+
+    filters: int = 0
+    kernel: Union[int, Sequence[int]] = 3
+    stride: Union[int, Sequence[int]] = 1
+    padding: Union[str, int, Sequence[int]] = "SAME"  # ↔ ConvolutionMode.Same
+    dilation: Union[int, Sequence[int]] = 1
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+    groups: int = 1
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        mode, (ph, pw) = _resolve_pad(self.padding)
+        if mode == "VALID":
+            ph = pw = 0
+        oh = _conv_out(h, kh, sh, mode, ph, dh)
+        ow = _conv_out(w, kw, sw, mode, pw, dw)
+        return (oh, ow, self.filters)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        w_init = get_initializer(self.weight_init or "relu")
+        params = {"W": w_init(rng, (kh, kw, c // self.groups, self.filters), dtype)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.filters,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        mode, p = _resolve_pad(self.padding)
+        pad = mode if mode != "EXPLICIT" else p
+        y = opscnn.conv2d(
+            x, params["W"], params.get("b"),
+            stride=self.stride, padding=pad, dilation=self.dilation,
+            feature_group_count=self.groups,
+        )
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class Conv1D(LayerConfig):
+    """↔ Convolution1DLayer. Input [N,T,C], weights [k,Cin,Cout]."""
+
+    filters: int = 0
+    kernel: int = 3
+    stride: int = 1
+    padding: Union[str, int] = "SAME"
+    dilation: int = 1
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        if isinstance(self.padding, str):
+            mode, p = self.padding.upper(), 0
+        else:
+            mode, p = "EXPLICIT", self.padding
+        ot = _conv_out(t, self.kernel, self.stride, mode, p, self.dilation)
+        return (ot, self.filters)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        w_init = get_initializer(self.weight_init or "relu")
+        params = {"W": w_init(rng, (self.kernel, c, self.filters), dtype)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.filters,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = opscnn.conv1d(
+            x, params["W"], params.get("b"),
+            stride=self.stride, padding=self.padding, dilation=self.dilation,
+        )
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class Conv3D(LayerConfig):
+    """↔ Convolution3D. Input [N,D,H,W,C], weights [kd,kh,kw,Cin,Cout]."""
+
+    filters: int = 0
+    kernel: Union[int, Sequence[int]] = 3
+    stride: Union[int, Sequence[int]] = 1
+    padding: str = "SAME"
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        k = self.kernel if not isinstance(self.kernel, int) else (self.kernel,) * 3
+        s = self.stride if not isinstance(self.stride, int) else (self.stride,) * 3
+        dims = tuple(
+            _conv_out(sz, kk, ss, self.padding.upper()) for sz, kk, ss in zip((d, h, w), k, s)
+        )
+        return (*dims, self.filters)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        k = self.kernel if not isinstance(self.kernel, int) else (self.kernel,) * 3
+        w_init = get_initializer(self.weight_init or "relu")
+        params = {"W": w_init(rng, (*k, c, self.filters), dtype)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.filters,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = opscnn.conv3d(x, params["W"], params.get("b"), stride=self.stride, padding=self.padding)
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class Deconv2D(LayerConfig):
+    """↔ Deconvolution2D (transposed conv)."""
+
+    filters: int = 0
+    kernel: Union[int, Sequence[int]] = 2
+    stride: Union[int, Sequence[int]] = 2
+    padding: str = "SAME"
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        sh, sw = _pair(self.stride)
+        kh, kw = _pair(self.kernel)
+        if self.padding.upper() == "SAME":
+            return (h * sh, w * sw, self.filters)
+        return ((h - 1) * sh + kh, (w - 1) * sw + kw, self.filters)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        w_init = get_initializer(self.weight_init or "relu")
+        params = {"W": w_init(rng, (kh, kw, c, self.filters), dtype)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.filters,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = opscnn.deconv2d(x, params["W"], params.get("b"), stride=self.stride, padding=self.padding)
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class DepthwiseConv2D(LayerConfig):
+    """↔ DepthwiseConvolution2D. Weights [kh,kw,C,mult]."""
+
+    depth_multiplier: int = 1
+    kernel: Union[int, Sequence[int]] = 3
+    stride: Union[int, Sequence[int]] = 1
+    padding: str = "SAME"
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        mode = self.padding.upper()
+        return (_conv_out(h, kh, sh, mode), _conv_out(w, kw, sw, mode), c * self.depth_multiplier)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        w_init = get_initializer(self.weight_init or "relu")
+        params = {"W": w_init(rng, (kh, kw, c, self.depth_multiplier), dtype)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((c * self.depth_multiplier,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = opscnn.depthwise_conv2d(x, params["W"], params.get("b"), stride=self.stride, padding=self.padding)
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class SeparableConv2D(LayerConfig):
+    """↔ SeparableConvolution2D (depthwise + pointwise)."""
+
+    filters: int = 0
+    kernel: Union[int, Sequence[int]] = 3
+    stride: Union[int, Sequence[int]] = 1
+    padding: str = "SAME"
+    depth_multiplier: int = 1
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        mode = self.padding.upper()
+        return (_conv_out(h, kh, sh, mode), _conv_out(w, kw, sw, mode), self.filters)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        w_init = get_initializer(self.weight_init or "relu")
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "dW": w_init(k1, (kh, kw, c, self.depth_multiplier), dtype),
+            "pW": w_init(k2, (1, 1, c * self.depth_multiplier, self.filters), dtype),
+        }
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.filters,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = opscnn.separable_conv2d(
+            x, params["dW"], params["pW"], params.get("b"),
+            stride=self.stride, padding=self.padding,
+        )
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class Pooling2D(LayerConfig):
+    """↔ SubsamplingLayer (PoolingType MAX/AVG/PNORM/SUM)."""
+
+    pool_type: str = "max"  # 'max' | 'avg' | 'pnorm' | 'sum'
+    window: Union[int, Sequence[int]] = 2
+    stride: Optional[Union[int, Sequence[int]]] = None
+    padding: Union[str, int] = "VALID"
+    pnorm: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        kh, kw = _pair(self.window)
+        s = self.stride if self.stride is not None else self.window
+        sh, sw = _pair(s)
+        if isinstance(self.padding, str):
+            mode, p = self.padding.upper(), (0, 0)
+        else:
+            mode, p = "EXPLICIT", _pair(self.padding)
+        oh = _conv_out(h, kh, sh, mode if mode != "EXPLICIT" else "VALID", p[0] if mode == "EXPLICIT" else 0)
+        ow = _conv_out(w, kw, sw, mode if mode != "EXPLICIT" else "VALID", p[1] if mode == "EXPLICIT" else 0)
+        return (oh, ow, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        stride = self.stride if self.stride is not None else self.window
+        if self.pool_type == "max":
+            return opscnn.max_pool2d(x, self.window, stride, self.padding), state
+        if self.pool_type == "avg":
+            return opscnn.avg_pool2d(x, self.window, stride, self.padding), state
+        if self.pool_type == "pnorm":
+            return opscnn.pnorm_pool2d(x, self.pnorm, self.window, stride, self.padding), state
+        if self.pool_type == "sum":
+            return opscnn._pool(x, 0.0, jax.lax.add, self.window, stride, self.padding), state
+        raise ValueError(f"unknown pool type {self.pool_type}")
+
+
+@register_config
+@dataclass
+class GlobalPooling(LayerConfig):
+    """↔ GlobalPoolingLayer (avg/max over spatial or time dims)."""
+
+    pool_type: str = "avg"
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        axes = tuple(range(1, x.ndim - 1))
+        if self.pool_type == "avg":
+            return jnp.mean(x, axis=axes), state
+        if self.pool_type == "max":
+            return jnp.max(x, axis=axes), state
+        if self.pool_type == "sum":
+            return jnp.sum(x, axis=axes), state
+        raise ValueError(f"unknown pool type {self.pool_type}")
+
+
+@register_config
+@dataclass
+class Upsampling2D(LayerConfig):
+    """↔ Upsampling2D (nearest-neighbour)."""
+
+    scale: Union[int, Sequence[int]] = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        sh, sw = _pair(self.scale)
+        return (h * sh, w * sw, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return opscnn.upsampling2d(x, self.scale), state
+
+
+@register_config
+@dataclass
+class ZeroPadding2D(LayerConfig):
+    """↔ ZeroPaddingLayer."""
+
+    padding: Sequence[int] = (1, 1, 1, 1)  # top, bottom, left, right
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        t, b, l, r = self.padding
+        return (h + t + b, w + l + r, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        t, b, l, r = self.padding
+        return jnp.pad(x, [(0, 0), (t, b), (l, r), (0, 0)]), state
+
+
+@register_config
+@dataclass
+class Cropping2D(LayerConfig):
+    """↔ Cropping2D."""
+
+    cropping: Sequence[int] = (0, 0, 0, 0)  # top, bottom, left, right
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        t, b, l, r = self.cropping
+        return (h - t - b, w - l - r, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        t, b, l, r = self.cropping
+        return x[:, t : x.shape[1] - b, l : x.shape[2] - r, :], state
+
+
+@register_config
+@dataclass
+class SpaceToDepth(LayerConfig):
+    """↔ SpaceToDepthLayer."""
+
+    block_size: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        b = self.block_size
+        return (h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return opscnn.space_to_depth(x, self.block_size), state

@@ -1,0 +1,183 @@
+"""Core feed-forward layers.
+
+ref: org.deeplearning4j.nn.conf.layers.{DenseLayer, ActivationLayer,
+DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer} and their runtime
+impls under org.deeplearning4j.nn.layers.feedforward.*.
+
+Param names follow the reference convention: "W" (weights), "b" (bias),
+so flat-param parity utilities and checkpoint converters line up.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.ops import nn as opsnn
+
+
+@register_config
+@dataclass
+class Dense(LayerConfig):
+    """Fully connected layer (↔ DenseLayer; runtime BaseLayer.preOutput =
+    x·W + b followed by activation)."""
+
+    units: int = 0
+    activation: str = "identity"
+    weight_init: Optional[str] = None  # None → net default
+    use_bias: bool = True
+
+    def output_shape(self, input_shape):
+        return (*input_shape[:-1], self.units)
+
+    def init(self, rng, input_shape, dtype):
+        fan_in = input_shape[-1]
+        w_init = get_initializer(self.weight_init or "xavier")
+        k_w, _ = jax.random.split(rng)
+        params = {"W": w_init(k_w, (fan_in, self.units), dtype)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.units,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = opsnn.linear(x, params["W"], params.get("b"))
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class ActivationLayer(LayerConfig):
+    """↔ ActivationLayer — apply an activation with no params."""
+
+    activation: str = "relu"
+
+    @property
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return get_activation(self.activation)(x), state
+
+
+@register_config
+@dataclass
+class Dropout(LayerConfig):
+    """↔ DropoutLayer / IDropout Dropout impl.
+
+    NOTE: the reference's Dropout(x) config value is the RETAIN probability;
+    here ``rate`` is the DROP probability (modern convention) — the Keras/TF
+    import adapters convert.
+    """
+
+    rate: float = 0.5
+    kind: str = "standard"  # 'standard' | 'alpha' | 'gaussian_dropout' | 'gaussian_noise'
+    stddev: float = 1.0  # for gaussian_noise
+
+    @property
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if not train or rng is None:
+            return x, state
+        if self.kind == "standard":
+            return opsnn.dropout(x, self.rate, rng), state
+        if self.kind == "alpha":
+            return opsnn.alpha_dropout(x, self.rate, rng), state
+        if self.kind == "gaussian_dropout":
+            return opsnn.gaussian_dropout(x, self.rate, rng), state
+        if self.kind == "gaussian_noise":
+            return opsnn.gaussian_noise(x, self.stddev, rng), state
+        raise ValueError(f"unknown dropout kind {self.kind}")
+
+
+@register_config
+@dataclass
+class Embedding(LayerConfig):
+    """↔ EmbeddingLayer (single index per example → embedding row) and
+    EmbeddingSequenceLayer (index sequence → embedding sequence); both are
+    the same gather on TPU."""
+
+    vocab_size: int = 0
+    units: int = 0
+    weight_init: Optional[str] = None
+
+    def output_shape(self, input_shape):
+        return (*input_shape, self.units)
+
+    def init(self, rng, input_shape, dtype):
+        w_init = get_initializer(self.weight_init or "normal")
+        return {"W": w_init(rng, (self.vocab_size, self.units), dtype)}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return opsnn.embedding_lookup(params["W"], x.astype(jnp.int32)), state
+
+
+@register_config
+@dataclass
+class Flatten(LayerConfig):
+    """↔ CnnToFeedForwardPreProcessor — flatten trailing dims to features."""
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        return (math.prod(input_shape),)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@register_config
+@dataclass
+class Reshape(LayerConfig):
+    """↔ ReshapePreprocessor (per-example reshape, batch preserved)."""
+
+    target_shape: Sequence[int] = ()
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        return tuple(self.target_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape(x.shape[0], *self.target_shape), state
+
+
+@register_config
+@dataclass
+class ElementWiseMultiplication(LayerConfig):
+    """↔ ElementWiseMultiplicationLayer: y = activation(x ⊙ w + b)."""
+
+    activation: str = "identity"
+
+    def init(self, rng, input_shape, dtype):
+        return {
+            "W": jnp.ones(tuple(input_shape), dtype),
+            "b": jnp.zeros(tuple(input_shape), dtype),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return get_activation(self.activation)(x * params["W"] + params["b"]), state
+
+
+@register_config
+@dataclass
+class PReLU(LayerConfig):
+    """↔ PReLULayer — learned negative-slope activation."""
+
+    def init(self, rng, input_shape, dtype):
+        return {"alpha": jnp.zeros(tuple(input_shape), dtype)}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return opsnn.prelu(x, params["alpha"]), state

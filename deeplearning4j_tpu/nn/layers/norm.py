@@ -1,0 +1,127 @@
+"""Normalization layers.
+
+ref: org.deeplearning4j.nn.conf.layers.{BatchNormalization,
+LocalResponseNormalization} + runtime impls under nn.layers.normalization
+and their cuDNN helpers (CudnnBatchNormalizationHelper). On TPU batch-norm is
+a handful of VPU ops XLA fuses into neighbours; the helper seam disappears.
+
+BatchNorm keeps running statistics as layer *state* (the framework's state
+pytree — ↔ the reference's global mean/var params updated in-place during
+forward). Cross-replica statistics under data parallelism: set ``axis_name``
+to the mesh axis and stats are psum-averaged exactly (the reference's
+ParallelWrapper never synchronized BN stats — replicas drifted and averaging
+smoothed it; synchronized BN is strictly better and free on ICI).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.ops import nn as opsnn
+
+
+@register_config
+@dataclass
+class BatchNorm(LayerConfig):
+    """↔ BatchNormalization layer (config: decay, eps, gamma/beta, lockGammaBeta).
+
+    ``momentum`` ↔ reference ``decay`` (running = decay·running + (1−decay)·batch).
+    Normalizes over all axes except the last (feature/channel) axis — correct
+    for both [N,F] dense and [N,H,W,C] conv activations.
+    """
+
+    momentum: float = 0.9
+    eps: float = 1e-5
+    use_gamma_beta: bool = True
+    activation: str = "identity"
+    axis_name: Optional[str] = None  # mesh axis for cross-replica stats
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        params = {}
+        if self.use_gamma_beta:
+            params = {"gamma": jnp.ones((c,), dtype), "beta": jnp.zeros((c,), dtype)}
+        state = {
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32),
+        }
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        gamma = params.get("gamma")
+        beta = params.get("beta")
+        if train:
+            axes = tuple(range(x.ndim - 1))
+            # fp32 statistics even under bf16 compute.
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                # E[x²] − E[x]² composed from pmeans for exact global var.
+                ex2 = lax.pmean(jnp.mean(jnp.square(xf), axis=axes), self.axis_name)
+                var = ex2 - jnp.square(mean)
+            new_state = {
+                "mean": self.momentum * state["mean"] + (1 - self.momentum) * mean,
+                "var": self.momentum * state["var"] + (1 - self.momentum) * var,
+            }
+            y = (x - mean.astype(x.dtype)) * lax.rsqrt(var + self.eps).astype(x.dtype)
+            if gamma is not None:
+                y = y * gamma + beta
+            return get_activation(self.activation)(y), new_state
+        y = opsnn.batch_norm_inference(
+            x, state["mean"].astype(x.dtype), state["var"].astype(x.dtype),
+            gamma, beta, eps=self.eps,
+        )
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class LayerNorm(LayerConfig):
+    """Layer normalization over the feature axis.
+
+    ref: nd4j layer_norm op (used by SameDiff attention layers; DL4J proper
+    had no standalone LayerNorm layer — capability superset needed for the
+    BERT path).
+    """
+
+    eps: float = 1e-5
+    use_gamma_beta: bool = True
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        if not self.use_gamma_beta:
+            return {}, {}
+        return {"gamma": jnp.ones((c,), dtype), "beta": jnp.zeros((c,), dtype)}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return (
+            opsnn.layer_norm(x, params.get("gamma"), params.get("beta"), eps=self.eps),
+            state,
+        )
+
+
+@register_config
+@dataclass
+class LocalResponseNormalization(LayerConfig):
+    """↔ LocalResponseNormalization (AlexNet-era LRN; kept for zoo parity)."""
+
+    depth_radius: int = 5
+    bias: float = 1.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    @property
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return opsnn.lrn(x, self.depth_radius, self.bias, self.alpha, self.beta), state

@@ -1,0 +1,103 @@
+"""Output/loss layers.
+
+ref: org.deeplearning4j.nn.conf.layers.{OutputLayer, LossLayer,
+RnnOutputLayer, RnnLossLayer, CnnLossLayer, CenterLossOutputLayer} — an
+output layer is a dense layer fused with a loss function (IOutputLayer
+provides computeScore for the Solver); a loss layer applies loss without
+extra params.
+
+Design: ``apply`` produces activations (prediction path, used by
+``output()``); ``compute_loss(params, state, x, labels, mask)`` produces the
+scalar training loss on *pre-activation logits* where the loss supports it
+(fused softmax-CE — stable and XLA-friendly), matching reference score
+semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.layers.core import Dense
+from deeplearning4j_tpu.ops import loss as losses
+from deeplearning4j_tpu.ops import nn as opsnn
+
+# (loss, activation) pairs whose registry impl takes logits and fuses the
+# activation for numerical stability.
+_LOGIT_LOSSES = {
+    ("mcxent", "softmax"),
+    ("softmax_cross_entropy", "softmax"),
+    ("negativeloglikelihood", "softmax"),
+    ("nll", "softmax"),
+    ("xent", "sigmoid"),
+    ("binary_cross_entropy", "sigmoid"),
+}
+
+
+@register_config
+@dataclass
+class OutputLayer(Dense):
+    """↔ OutputLayer: Dense + activation + loss (reference defaults:
+    softmax activation, MCXENT loss)."""
+
+    loss: str = "mcxent"
+    activation: str = "softmax"
+
+    def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
+        pre = opsnn.linear(x, params["W"], params.get("b"))
+        fn = losses.get_loss(self.loss)
+        w = mask if mask is not None else weights
+        if (self.loss.lower(), self.activation.lower()) in _LOGIT_LOSSES:
+            return fn(pre, labels, weights=w)
+        return fn(get_activation(self.activation)(pre), labels, weights=w)
+
+
+@register_config
+@dataclass
+class LossLayer(LayerConfig):
+    """↔ LossLayer: activation + loss, no params."""
+
+    activation: str = "identity"
+    loss: str = "mse"
+
+    @property
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return get_activation(self.activation)(x), state
+
+    def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
+        fn = losses.get_loss(self.loss)
+        w = mask if mask is not None else weights
+        if (self.loss.lower(), self.activation.lower()) in _LOGIT_LOSSES:
+            return fn(x, labels, weights=w)
+        return fn(get_activation(self.activation)(x), labels, weights=w)
+
+
+@register_config
+@dataclass
+class RnnOutputLayer(Dense):
+    """↔ RnnOutputLayer: per-timestep dense+loss over [N,T,F] input.
+
+    ``mask`` [N,T] excludes padded steps from the loss (↔ the reference's
+    label-mask handling in BaseOutputLayer for sequences).
+    """
+
+    loss: str = "mcxent"
+    activation: str = "softmax"
+
+    def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
+        pre = opsnn.linear(x, params["W"], params.get("b"))
+        fn = losses.get_loss(self.loss)
+        use_logits = (self.loss.lower(), self.activation.lower()) in _LOGIT_LOSSES
+        target = pre if use_logits else get_activation(self.activation)(pre)
+        per_step = fn(target, labels, reduction="none")  # [N,T]
+        if mask is not None:
+            per_step = per_step * mask
+            return jnp.sum(per_step) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.mean(per_step)

@@ -1,0 +1,242 @@
+"""Recurrent layers.
+
+ref: org.deeplearning4j.nn.conf.layers.{LSTM, GravesLSTM, GravesBidirectionalLSTM,
+SimpleRnn} + recurrent.Bidirectional wrapper and LastTimeStep; runtime impls
+org.deeplearning4j.nn.layers.recurrent.{LSTM, GravesLSTM, LSTMHelpers} and
+the cuDNN helper (CudnnLSTMHelper).
+
+Sequence layout: [N, T, C] (batch, time, features). The reference uses
+[N, C, T]; time-last is a CUDA-era layout — [N, T, C] keeps the feature axis
+minor, which is what the MXU wants for the hoisted input projection.
+
+Param naming parity: "W" = input weights [in, 4H], "RW" = recurrent weights
+[H, 4H] (↔ reference RECURRENT_WEIGHT_KEY "RW"), "b" = bias [4H]. Graves
+peepholes are stored as the trailing 3H columns of the reference's RW; here
+they are explicit "pI","pF","pO" [H] params (the converter in the import
+module maps between the two).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.ops import rnn as opsrnn
+
+
+@register_config
+@dataclass
+class LSTM(LayerConfig):
+    """↔ LSTM layer (no peepholes; cuDNN-compatible math).
+
+    The scan body is one fused gate matmul; the input projection for all T
+    steps is hoisted into a single MXU GEMM (see ops/rnn.py). A Pallas
+    fused-scan kernel can be selected with ``backend='pallas'``
+    (kernels/lstm_scan.py).
+    """
+
+    units: int = 0
+    activation: str = "tanh"  # kept for config parity; cell uses tanh/sigmoid
+    weight_init: Optional[str] = None
+    forget_bias: float = 1.0
+    return_sequences: bool = True
+    backend: str = "xla"  # 'xla' | 'pallas'
+    unroll: int = 1
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        return (t, self.units) if self.return_sequences else (self.units,)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        h = self.units
+        w_init = get_initializer(self.weight_init or "xavier")
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "W": w_init(k1, (c, 4 * h), dtype),
+            "RW": w_init(k2, (h, 4 * h), dtype),
+            "b": jnp.zeros((4 * h,), dtype),
+        }
+        return params, {}
+
+    def _peepholes(self, params):
+        return None
+
+    def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
+        if self.backend == "pallas":
+            from deeplearning4j_tpu.kernels import lstm_scan
+
+            outputs, final = lstm_scan.lstm(
+                x, params["W"], params["RW"], params["b"],
+                peepholes=self._peepholes(params),
+                forget_bias=self.forget_bias, init_state=initial_state,
+            )
+        else:
+            outputs, final = opsrnn.lstm(
+                x, params["W"], params["RW"], params["b"],
+                init_state=initial_state,
+                peepholes=self._peepholes(params),
+                forget_bias=self.forget_bias,
+                unroll=self.unroll,
+            )
+        if not self.return_sequences:
+            return outputs[:, -1, :], state
+        return outputs, state
+
+
+@register_config
+@dataclass
+class GravesLSTM(LSTM):
+    """↔ GravesLSTM — LSTM with Graves-2013 peephole connections
+    (i,f peep from c_{t-1}; o peeps from c_t). North-star config #3."""
+
+    def init(self, rng, input_shape, dtype):
+        params, state = LSTM.init(self, rng, input_shape, dtype)
+        h = self.units
+        params["pI"] = jnp.zeros((h,), dtype)
+        params["pF"] = jnp.zeros((h,), dtype)
+        params["pO"] = jnp.zeros((h,), dtype)
+        return params, state
+
+    def _peepholes(self, params):
+        return (params["pI"], params["pF"], params["pO"])
+
+
+@register_config
+@dataclass
+class GRU(LayerConfig):
+    """GRU layer (ref: libnd4j gruCell op; DL4J-era had no GRU layer —
+    capability superset)."""
+
+    units: int = 0
+    weight_init: Optional[str] = None
+    return_sequences: bool = True
+    unroll: int = 1
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        return (t, self.units) if self.return_sequences else (self.units,)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        h = self.units
+        w_init = get_initializer(self.weight_init or "xavier")
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": w_init(k1, (c, 3 * h), dtype),
+            "RW": w_init(k2, (h, 3 * h), dtype),
+            "b": jnp.zeros((3 * h,), dtype),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
+        outputs, final = opsrnn.gru(
+            x, params["W"], params["RW"], params["b"], init_h=initial_state,
+            unroll=self.unroll,
+        )
+        if not self.return_sequences:
+            return outputs[:, -1, :], state
+        return outputs, state
+
+
+@register_config
+@dataclass
+class SimpleRnn(LayerConfig):
+    """↔ SimpleRnn (Elman RNN: h_t = act(x_t·W + h_{t-1}·RW + b))."""
+
+    units: int = 0
+    activation: str = "tanh"
+    weight_init: Optional[str] = None
+    return_sequences: bool = True
+    unroll: int = 1
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        return (t, self.units) if self.return_sequences else (self.units,)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        h = self.units
+        w_init = get_initializer(self.weight_init or "xavier")
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": w_init(k1, (c, h), dtype),
+            "RW": w_init(k2, (h, h), dtype),
+            "b": jnp.zeros((h,), dtype),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
+        act = get_activation(self.activation)
+        outputs, final = opsrnn.simple_rnn(
+            x, params["W"], params["RW"], params["b"], init_h=initial_state,
+            activation=act, unroll=self.unroll,
+        )
+        if not self.return_sequences:
+            return outputs[:, -1, :], state
+        return outputs, state
+
+
+@register_config
+@dataclass
+class Bidirectional(LayerConfig):
+    """↔ recurrent.Bidirectional wrapper (modes CONCAT/ADD/MUL/AVERAGE).
+
+    Wraps any recurrent layer config; maintains separate fwd/bwd params.
+    """
+
+    layer: Any = None  # inner recurrent LayerConfig
+    merge: str = "concat"
+
+    def output_shape(self, input_shape):
+        inner = self.layer.output_shape(input_shape)
+        if self.merge == "concat":
+            return (*inner[:-1], inner[-1] * 2)
+        return inner
+
+    def init(self, rng, input_shape, dtype):
+        kf, kb = jax.random.split(rng)
+        pf, sf = self.layer.init(kf, input_shape, dtype)
+        pb, sb = self.layer.init(kb, input_shape, dtype)
+        return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        yf, sf = self.layer.apply(params["fwd"], state.get("fwd", {}), x, train=train, rng=rng)
+        yb, sb = self.layer.apply(
+            params["bwd"], state.get("bwd", {}), jnp.flip(x, axis=1), train=train, rng=rng
+        )
+        yb = jnp.flip(yb, axis=1)
+        if self.merge == "concat":
+            y = jnp.concatenate([yf, yb], axis=-1)
+        elif self.merge == "add":
+            y = yf + yb
+        elif self.merge == "mul":
+            y = yf * yb
+        elif self.merge == "average":
+            y = 0.5 * (yf + yb)
+        else:
+            raise ValueError(f"unknown merge mode {self.merge}")
+        return y, {"fwd": sf, "bwd": sb}
+
+
+@register_config
+@dataclass
+class LastTimeStep(LayerConfig):
+    """↔ LastTimeStep wrapper — [N,T,C] → [N,C] (mask-aware last step)."""
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if mask is None:
+            return x[:, -1, :], state
+        idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :], state

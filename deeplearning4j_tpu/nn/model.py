@@ -1,0 +1,357 @@
+"""Model containers: sequential stack and DAG graph.
+
+ref: org.deeplearning4j.nn.multilayer.MultiLayerNetwork (sequential stack,
+param flattening, fit/output/score orchestration) and
+org.deeplearning4j.nn.graph.ComputationGraph (GraphVertex DAG,
+merge/elementwise vertices, multi-input/multi-output).
+
+TPU-first inversion of the reference design: a model is a *pure function
+factory*. ``init`` builds the variables pytree; ``apply``/``loss_fn`` are
+pure functions of (variables, batch, rng) that the trainer jit/pjit-compiles
+whole-graph — the per-layer activate() loop below runs at TRACE time only,
+so the compiled step contains the entire network in one XLA program (vs one
+JNI dispatch per op per layer in the reference, SURVEY §3.1).
+
+Variables layout::
+
+    {"params": {"<layer_name>": {...}}, "state": {"<layer_name>": {...}}}
+
+Param naming inside each layer follows the reference ("W", "b", "RW", …) so
+flat-vector parity utils (utils/pytree.py) and checkpoint converters align.
+"""
+
+from __future__ import annotations
+
+import graphlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    LayerConfig,
+    NeuralNetConfiguration,
+    SequentialConfig,
+)
+
+# Param keys exempt from l1/l2 regularization (biases & norm scales — the
+# reference likewise regularizes weights only by default).
+_NO_REG_KEYS = {"b", "beta", "gamma", "pI", "pF", "pO", "alpha", "mean", "var"}
+
+
+def _layer_name(i: int, cfg: LayerConfig) -> str:
+    base = cfg.name or type(cfg).__name__.lower()
+    return f"{i}_{base}"
+
+
+class SequentialModel:
+    """↔ MultiLayerNetwork."""
+
+    def __init__(self, config: SequentialConfig):
+        self.config = config
+        self.net: NeuralNetConfiguration = config.net
+        self.layers: List[LayerConfig] = list(config.layers)
+        self.layer_names = [_layer_name(i, l) for i, l in enumerate(self.layers)]
+        # Shape inference pass (↔ InputType propagation / setInputType).
+        self.shapes = [tuple(config.input_shape)]
+        for l in self.layers:
+            self.shapes.append(tuple(l.output_shape(self.shapes[-1])))
+
+    # -- construction ------------------------------------------------------
+
+    def init(self, seed: Optional[int] = None) -> Dict[str, Any]:
+        """Build the variables pytree (↔ MultiLayerNetwork.init())."""
+        seed = self.net.seed if seed is None else seed
+        rng = jax.random.key(seed)
+        dtype = jnp.dtype(self.net.dtype)
+        params, state = {}, {}
+        for i, (name, layer) in enumerate(zip(self.layer_names, self.layers)):
+            lrng = jax.random.fold_in(rng, i)
+            ldtype = jnp.dtype(layer.dtype) if layer.dtype else dtype
+            p, s = layer.init(lrng, self.shapes[i], ldtype)
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        return {"params": params, "state": state}
+
+    # -- pure functions (traced under jit) ---------------------------------
+
+    def apply(self, variables, x, *, train: bool = False, rng=None,
+              up_to: Optional[int] = None):
+        """Forward pass; ``up_to`` stops before layer index (exclusive).
+
+        Returns (activations, new_state). ↔ feedForward/feedForwardToLayer.
+        """
+        params = variables["params"]
+        state = variables["state"]
+        new_state = dict(state)
+        n = len(self.layers) if up_to is None else up_to
+        for i in range(n):
+            name = self.layer_names[i]
+            layer = self.layers[i]
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, s = layer.apply(
+                params.get(name, {}), state.get(name, {}), x, train=train, rng=lrng
+            )
+            if s:
+                new_state[name] = s
+        return x, new_state
+
+    def loss_fn(self, params, state, batch, rng=None):
+        """Scalar training loss (↔ computeGradientAndScore's score).
+
+        batch: dict with 'features', 'labels', optional 'mask'/'weights'.
+        Returns (loss, (new_state, metrics)).
+        """
+        variables = {"params": params, "state": state}
+        x, new_state = self.apply(
+            variables, batch["features"], train=True, rng=rng,
+            up_to=len(self.layers) - 1,
+        )
+        out_layer = self.layers[-1]
+        out_name = self.layer_names[-1]
+        if not hasattr(out_layer, "compute_loss"):
+            raise TypeError(
+                f"last layer {type(out_layer).__name__} is not an output layer"
+            )
+        loss = out_layer.compute_loss(
+            params.get(out_name, {}), state.get(out_name, {}), x, batch["labels"],
+            mask=batch.get("mask"), weights=batch.get("weights"),
+        )
+        reg = self._regularization(params)
+        return loss + reg, (new_state, {"loss": loss, "reg": reg})
+
+    def _regularization(self, params):
+        """Collect l1/l2 penalties (per-layer override, else net default)."""
+        total = 0.0
+        any_reg = False
+        for name, layer in zip(self.layer_names, self.layers):
+            l1 = layer.l1 if layer.l1 is not None else self.net.l1
+            l2 = layer.l2 if layer.l2 is not None else self.net.l2
+            if (not l1 and not l2) or name not in params:
+                continue
+            any_reg = True
+            for k, p in params[name].items():
+                if k in _NO_REG_KEYS:
+                    continue
+                if l2:
+                    total = total + l2 * jnp.sum(jnp.square(p))
+                if l1:
+                    total = total + l1 * jnp.sum(jnp.abs(p))
+        return total if any_reg else jnp.zeros(())
+
+    # -- eager conveniences (jit-cached) -----------------------------------
+
+    def output(self, variables, x):
+        """Inference forward (↔ MultiLayerNetwork.output)."""
+        if not hasattr(self, "_output_jit"):
+            self._output_jit = jax.jit(
+                lambda v, xx: self.apply(v, xx, train=False)[0]
+            )
+        return self._output_jit(variables, x)
+
+    def score(self, variables, batch):
+        """↔ MultiLayerNetwork.score(DataSet). Accepts a DataSet, (x, y)
+        tuple, or batch dict."""
+        from deeplearning4j_tpu.data.dataset import as_batch_dict
+
+        if not hasattr(self, "_score_jit"):
+            self._score_jit = jax.jit(
+                lambda v, b: self.loss_fn(v["params"], v["state"], b)[0]
+            )
+        return float(self._score_jit(variables, as_batch_dict(batch)))
+
+    # -- introspection -----------------------------------------------------
+
+    def num_params(self, variables) -> int:
+        return sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
+
+    def summary(self, variables=None) -> str:
+        """↔ MultiLayerNetwork.summary()."""
+        lines = [f"{'idx':<4}{'layer':<28}{'out shape':<20}{'params':<12}"]
+        lines.append("=" * 64)
+        total = 0
+        for i, (name, layer) in enumerate(zip(self.layer_names, self.layers)):
+            n = 0
+            if variables is not None and name in variables["params"]:
+                n = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"][name]))
+            total += n
+            lines.append(f"{i:<4}{type(layer).__name__:<28}{str(self.shapes[i + 1]):<20}{n:<12}")
+        lines.append("=" * 64)
+        lines.append(f"total params: {total}")
+        return "\n".join(lines)
+
+
+# --- DAG model --------------------------------------------------------------
+
+_MERGE_OPS = {
+    "add": lambda xs: sum(xs),
+    "subtract": lambda xs: xs[0] - xs[1],
+    "mul": lambda xs: _prod(xs),
+    "average": lambda xs: sum(xs) / len(xs),
+    "max": lambda xs: _reduce_max(xs),
+    "merge": lambda xs: jnp.concatenate(xs, axis=-1),
+}
+
+
+def _prod(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out * x
+    return out
+
+
+def _reduce_max(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out
+
+
+class GraphModel:
+    """↔ ComputationGraph: named-vertex DAG with merge/elementwise vertices.
+
+    Topology is resolved once at build; the traced apply() visits vertices
+    in topological order — under jit the whole DAG is one XLA program.
+    """
+
+    def __init__(self, config: GraphConfig):
+        self.config = config
+        self.net = config.net
+        ts = graphlib.TopologicalSorter(
+            {name: set(v.inputs) - set(config.inputs) for name, v in config.vertices.items()}
+        )
+        self.order = [n for n in ts.static_order() if n in config.vertices]
+        # Shape inference.
+        self.shapes: Dict[str, Tuple[int, ...]] = {
+            k: tuple(v) for k, v in config.input_shapes.items()
+        }
+        for name in self.order:
+            v = config.vertices[name]
+            in_shapes = [self.shapes[i] for i in v.inputs]
+            self.shapes[name] = self._vertex_out_shape(v, in_shapes)
+
+    def _vertex_out_shape(self, v: GraphVertex, in_shapes):
+        if v.kind == "layer":
+            return tuple(v.layer.output_shape(in_shapes[0]))
+        if v.kind == "merge":
+            feat = sum(s[-1] for s in in_shapes)
+            return (*in_shapes[0][:-1], feat)
+        return tuple(in_shapes[0])
+
+    def init(self, seed: Optional[int] = None):
+        seed = self.net.seed if seed is None else seed
+        rng = jax.random.key(seed)
+        dtype = jnp.dtype(self.net.dtype)
+        params, state = {}, {}
+        for i, name in enumerate(self.order):
+            v = self.config.vertices[name]
+            if v.kind != "layer":
+                continue
+            in_shape = self.shapes[v.inputs[0]]
+            p, s = v.layer.init(jax.random.fold_in(rng, i), in_shape, dtype)
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        return {"params": params, "state": state}
+
+    def apply(self, variables, inputs, *, train=False, rng=None):
+        """inputs: dict name→array (or a single array if one input).
+
+        Returns (dict of output-name→activation, new_state).
+        """
+        values, new_state = self._forward_values(
+            variables, inputs, train=train, rng=rng, exclude=set()
+        )
+        return {o: values[o] for o in self.config.outputs if o in values}, new_state
+
+    def loss_fn(self, params, state, batch, rng=None):
+        """Sum of output-layer losses (↔ ComputationGraph score with multiple
+        outputs). batch['labels'] is a dict name→labels for multi-output, or
+        a single array for one output."""
+        variables = {"params": params, "state": state}
+        # Run every vertex except the output layers, then apply their losses.
+        out_names = list(self.config.outputs)
+        values, new_state = self._forward_values(variables, batch["features"],
+                                                 train=True, rng=rng,
+                                                 exclude=set(out_names))
+        labels = batch["labels"]
+        if not isinstance(labels, dict):
+            labels = {out_names[0]: labels}
+        total = 0.0
+        metrics = {}
+        for name in out_names:
+            v = self.config.vertices[name]
+            x_in = values[v.inputs[0]]
+            loss = v.layer.compute_loss(
+                params.get(name, {}), state.get(name, {}), x_in, labels[name],
+                mask=batch.get("mask"), weights=batch.get("weights"),
+            )
+            total = total + loss
+            metrics[f"loss/{name}"] = loss
+        reg = self._regularization(params)
+        metrics["loss"] = total
+        return total + reg, (new_state, metrics)
+
+    def _forward_values(self, variables, inputs, *, train, rng, exclude):
+        if not isinstance(inputs, dict):
+            inputs = {self.config.inputs[0]: inputs}
+        params, state = variables["params"], variables["state"]
+        values = dict(inputs)
+        new_state = dict(state)
+        for i, name in enumerate(self.order):
+            if name in exclude:
+                continue
+            v = self.config.vertices[name]
+            xs = [values[inp] for inp in v.inputs]
+            if v.kind == "layer":
+                lrng = jax.random.fold_in(rng, i) if rng is not None else None
+                y, s = v.layer.apply(
+                    params.get(name, {}), state.get(name, {}), xs[0],
+                    train=train, rng=lrng,
+                )
+                if s:
+                    new_state[name] = s
+            elif v.kind in _MERGE_OPS:
+                y = _MERGE_OPS[v.kind](xs)
+            elif v.kind == "scale":
+                y = xs[0] * v.args.get("factor", 1.0)
+            else:
+                raise ValueError(f"unknown vertex kind {v.kind}")
+            values[name] = y
+        return values, new_state
+
+    def _regularization(self, params):
+        total = 0.0
+        any_reg = False
+        for name in self.order:
+            v = self.config.vertices[name]
+            if v.kind != "layer" or name not in params:
+                continue
+            l1 = v.layer.l1 if v.layer.l1 is not None else self.net.l1
+            l2 = v.layer.l2 if v.layer.l2 is not None else self.net.l2
+            if not l1 and not l2:
+                continue
+            any_reg = True
+            for k, p in params[name].items():
+                if k in _NO_REG_KEYS:
+                    continue
+                if l2:
+                    total = total + l2 * jnp.sum(jnp.square(p))
+                if l1:
+                    total = total + l1 * jnp.sum(jnp.abs(p))
+        return total if any_reg else jnp.zeros(())
+
+    def output(self, variables, inputs):
+        if not hasattr(self, "_output_jit"):
+            self._output_jit = jax.jit(
+                lambda v, xx: self.apply(v, xx, train=False)[0]
+            )
+        return self._output_jit(variables, inputs)
+
+    def num_params(self, variables) -> int:
+        return sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))

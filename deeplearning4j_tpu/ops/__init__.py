@@ -1,0 +1,11 @@
+"""Typed op catalog over jax/lax (↔ ND4J op namespaces + libnd4j op catalog).
+
+ref: org.nd4j.linalg.factory.ops.{NDMath,NDNN,NDCNN,NDRNN,NDLoss,NDRandom}
+(generated namespaces) dispatching per-op over JNI to libnd4j's declarable op
+catalog. Here each namespace is a module of pure functions lowering to XLA
+HLO; whole programs are compiled once by jit/pjit instead of per-op dispatch.
+"""
+
+from deeplearning4j_tpu.ops import cnn, loss, math, nn, random, rnn  # noqa: F401
+
+__all__ = ["math", "nn", "cnn", "rnn", "loss", "random"]

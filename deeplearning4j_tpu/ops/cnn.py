@@ -1,0 +1,298 @@
+"""CNN op namespace (↔ org.nd4j.linalg.factory.ops.NDCNN).
+
+ref: libnd4j conv ops (ops/declarable/generic/nn/convo/: conv1d/2d/3d,
+deconv2d, depthwise_conv2d, sconv2d, pooling2d/3d, upsampling, im2col,
+col2im, space_to_depth …) and the cuDNN platform helpers that override them
+(ops/declarable/platform/cudnn/conv2d.cu etc.).
+
+TPU-first design: convs map directly to XLA's conv_general_dilated which the
+compiler tiles onto the MXU — there is no im2col materialization and no
+vendor-helper indirection. Default layout is NHWC (TPU-preferred), not the
+reference's NCHW; layout is a parameter everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+IntOr2 = Union[int, Tuple[int, int], Sequence[int]]
+
+
+def _pair(v: IntOr2, n: int = 2):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    assert len(t) == n, f"expected {n}-tuple, got {t}"
+    return t
+
+
+def _padding(padding, kernel, dilation, n):
+    """Resolve padding spec: 'SAME' | 'VALID' | int | per-dim pairs.
+
+    ref: DL4J ConvolutionMode (Same/Truncate/Strict) — 'SAME' ≈ Same mode,
+    explicit ints ≈ Truncate with manual padding.
+    """
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _pair(padding, n)
+    return [(pi, pi) for pi in p]
+
+
+def conv2d(
+    x,
+    w,
+    b=None,
+    *,
+    stride: IntOr2 = 1,
+    padding="SAME",
+    dilation: IntOr2 = 1,
+    feature_group_count: int = 1,
+    data_format: str = "NHWC",
+    preferred_element_type=None,
+):
+    """2-D convolution on the MXU.
+
+    x: [N,H,W,C] (NHWC) or [N,C,H,W]; w: [kh,kw,Cin/groups,Cout] (HWIO).
+    ref: libnd4j conv2d op + CudnnConvolutionHelper — replaced by one XLA
+    conv_general_dilated (fused bias-add happens in XLA).
+    """
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, (data_format, "HWIO", data_format)
+    )
+    pad = _padding(padding, (w.shape[0], w.shape[1]), dilation, 2)
+    y = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=feature_group_count,
+        preferred_element_type=preferred_element_type,
+    )
+    if b is not None:
+        bshape = [1] * y.ndim
+        bshape[dn.out_spec.index(1) if hasattr(dn, "out_spec") else -1] = b.shape[0]
+        if data_format == "NHWC":
+            y = y + b.reshape(1, 1, 1, -1)
+        else:
+            y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+def conv1d(x, w, b=None, *, stride=1, padding="SAME", dilation=1, data_format="NWC"):
+    """1-D conv as rank-3 conv_general_dilated (x: [N,W,C], w: [k,Cin,Cout])."""
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (data_format, "WIO", data_format))
+    pad = padding.upper() if isinstance(padding, str) else [(padding, padding)]
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=pad, rhs_dilation=(dilation,),
+        dimension_numbers=dn,
+    )
+    if b is not None:
+        y = y + (b.reshape(1, 1, -1) if data_format == "NWC" else b.reshape(1, -1, 1))
+    return y
+
+
+def conv3d(x, w, b=None, *, stride=1, padding="SAME", dilation=1, data_format="NDHWC"):
+    """3-D conv (x: [N,D,H,W,C], w: [kd,kh,kw,Cin,Cout])."""
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (data_format, "DHWIO", data_format))
+    pad = _padding(padding, w.shape[:3], dilation, 3)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn,
+    )
+    if b is not None:
+        y = y + b.reshape((1,) * 4 + (-1,))
+    return y
+
+
+def deconv2d(x, w, b=None, *, stride=1, padding="SAME", data_format="NHWC"):
+    """Transposed conv (ref: libnd4j deconv2d / DL4J Deconvolution2D)."""
+    stride = _pair(stride)
+    pad = padding.upper() if isinstance(padding, str) else [(p, p) for p in _pair(padding)]
+    y = lax.conv_transpose(
+        x, w, strides=stride, padding=pad,
+        dimension_numbers=(data_format, "HWIO", data_format),
+    )
+    if b is not None:
+        y = y + b.reshape(1, 1, 1, -1)
+    return y
+
+
+def depthwise_conv2d(x, w, b=None, *, stride=1, padding="SAME", dilation=1, data_format="NHWC"):
+    """Depthwise conv (ref: libnd4j depthwise_conv2d).
+
+    w: [kh, kw, C, channel_multiplier] → HWIO with feature_group_count=C.
+    """
+    c = x.shape[-1] if data_format == "NHWC" else x.shape[1]
+    kh, kw, cin, mult = w.shape
+    assert cin == c, f"depthwise weight channel dim {cin} != input channels {c}"
+    w_r = w.reshape(kh, kw, 1, cin * mult)
+    return conv2d(
+        x, w_r, b, stride=stride, padding=padding, dilation=dilation,
+        feature_group_count=c, data_format=data_format,
+    )
+
+
+def separable_conv2d(x, dw, pw, b=None, *, stride=1, padding="SAME", data_format="NHWC"):
+    """Depthwise-separable conv (ref: libnd4j sconv2d / SeparableConvolution2D)."""
+    y = depthwise_conv2d(x, dw, None, stride=stride, padding=padding, data_format=data_format)
+    return conv2d(y, pw, b, stride=1, padding="SAME", data_format=data_format)
+
+
+# --- pooling (ref: libnd4j pooling2d ops + CudnnSubsamplingHelper) ---
+
+
+def _pool(x, init, op, window, stride, padding, data_format="NHWC", norm=None):
+    window = _pair(window)
+    stride = _pair(stride)
+    if data_format == "NHWC":
+        dims = (1, *window, 1)
+        strides = (1, *stride, 1)
+    else:
+        dims = (1, 1, *window)
+        strides = (1, 1, *stride)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding)
+        if data_format == "NHWC":
+            pad = [(0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0)]
+        else:
+            pad = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+    return lax.reduce_window(x, init, op, dims, strides, pad)
+
+
+def max_pool2d(x, window=2, stride=None, padding="VALID", data_format="NHWC"):
+    stride = stride if stride is not None else window
+    return _pool(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+                 lax.max, window, stride, padding, data_format)
+
+
+def avg_pool2d(x, window=2, stride=None, padding="VALID", data_format="NHWC"):
+    stride = stride if stride is not None else window
+    summed = _pool(x, 0.0, lax.add, window, stride, padding, data_format)
+    if isinstance(padding, str) and padding.upper() == "VALID":
+        w = _pair(window)
+        return summed / (w[0] * w[1])
+    ones = jnp.ones_like(x)
+    counts = _pool(ones, 0.0, lax.add, window, stride, padding, data_format)
+    return summed / counts
+
+
+def pnorm_pool2d(x, p=2, window=2, stride=None, padding="VALID", data_format="NHWC"):
+    """ref: DL4J SubsamplingLayer PoolingType.PNORM."""
+    stride = stride if stride is not None else window
+    summed = _pool(jnp.power(jnp.abs(x), p), 0.0, lax.add, window, stride, padding, data_format)
+    return jnp.power(summed, 1.0 / p)
+
+
+def global_avg_pool(x, data_format="NHWC", keepdims=False):
+    axes = (1, 2) if data_format == "NHWC" else (2, 3)
+    return jnp.mean(x, axis=axes, keepdims=keepdims)
+
+
+def global_max_pool(x, data_format="NHWC", keepdims=False):
+    axes = (1, 2) if data_format == "NHWC" else (2, 3)
+    return jnp.max(x, axis=axes, keepdims=keepdims)
+
+
+def max_pool3d(x, window=2, stride=None, padding="VALID"):
+    window = _pair(window, 3)
+    stride = _pair(stride if stride is not None else window, 3)
+    pad = padding.upper() if isinstance(padding, str) else [(0, 0)] + [(p, p) for p in _pair(padding, 3)] + [(0, 0)]
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, *window, 1), (1, *stride, 1), pad)
+
+
+def avg_pool3d(x, window=2, stride=None, padding="VALID"):
+    window3 = _pair(window, 3)
+    stride3 = _pair(stride if stride is not None else window, 3)
+    pad = padding.upper() if isinstance(padding, str) else [(0, 0)] + [(p, p) for p in _pair(padding, 3)] + [(0, 0)]
+    s = lax.reduce_window(x, 0.0, lax.add, (1, *window3, 1), (1, *stride3, 1), pad)
+    return s / (window3[0] * window3[1] * window3[2])
+
+
+# --- resolution reshuffles (ref: libnd4j space_to_depth etc.) ---
+
+
+def upsampling2d(x, scale=2, data_format="NHWC"):
+    """Nearest-neighbour upsample (ref: DL4J Upsampling2D)."""
+    s = _pair(scale)
+    if data_format == "NHWC":
+        return jnp.repeat(jnp.repeat(x, s[0], axis=1), s[1], axis=2)
+    return jnp.repeat(jnp.repeat(x, s[0], axis=2), s[1], axis=3)
+
+
+def space_to_depth(x, block_size, data_format="NHWC"):
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // b, w // b, c * b * b)
+
+
+def depth_to_space(x, block_size, data_format="NHWC"):
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h, w, b, b, c // (b * b))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * b, w * b, c // (b * b))
+
+
+def space_to_batch(x, block_size, paddings=((0, 0), (0, 0))):
+    b = block_size
+    x = jnp.pad(x, [(0, 0), paddings[0], paddings[1], (0, 0)])
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    x = x.transpose(2, 4, 0, 1, 3, 5)
+    return x.reshape(n * b * b, h // b, w // b, c)
+
+
+def batch_to_space(x, block_size, crops=((0, 0), (0, 0))):
+    b = block_size
+    nb, h, w, c = x.shape
+    n = nb // (b * b)
+    x = x.reshape(b, b, n, h, w, c)
+    x = x.transpose(2, 3, 0, 4, 1, 5)
+    x = x.reshape(n, h * b, w * b, c)
+    return x[:, crops[0][0] : x.shape[1] - crops[0][1], crops[1][0] : x.shape[2] - crops[1][1], :]
+
+
+# --- im2col kept for capability parity (ref: libnd4j helpers/im2col) ---
+
+
+def im2col(x, kernel, stride=1, padding=0, dilation=1):
+    """Extract patches: [N,H,W,C] → [N,OH,OW,kh*kw*C].
+
+    On TPU this is NOT used by conv (XLA convs don't materialize patches);
+    provided for reference capability parity and for custom ops that want
+    patch views.
+    """
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    x = jnp.pad(x, [(0, 0), (ph, ph), (pw, pw), (0, 0)])
+    n, h, w, c = x.shape
+    oh = (h - (kh - 1) * dh - 1) // sh + 1
+    ow = (w - (kw - 1) * dw - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(
+                lax.slice(
+                    x,
+                    (0, i * dh, j * dw, 0),
+                    (n, i * dh + (oh - 1) * sh + 1, j * dw + (ow - 1) * sw + 1, c),
+                    (1, sh, sw, 1),
+                )
+            )
+    return jnp.concatenate(patches, axis=-1).reshape(n, oh, ow, kh * kw * c)

@@ -1,0 +1,78 @@
+"""Random op namespace (↔ org.nd4j.linalg.factory.ops.NDRandom + rng API).
+
+ref: nd4j NativeRandom (philox counter-based RNG in libnd4j,
+include/helpers/RandomLauncher) and the distribution ops
+(ops/declarable/generic/random/: uniform, normal, bernoulli, binomial,
+exponential, truncated/log normal, gamma, poisson, dropout, shuffle).
+
+TPU-native: JAX's threefry/rbg counter-based PRNG — functional keys instead
+of the reference's stateful per-backend RNG. ``RandomFactory``-style stateful
+convenience wrapper provided for API parity, but the functional key-passing
+API is the primary surface (it is what makes RNG reproducible under pjit
+sharding: per-device independent streams derive from the same key).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+key = jax.random.key
+split = jax.random.split
+fold_in = jax.random.fold_in
+
+uniform = jax.random.uniform
+normal = jax.random.normal
+bernoulli = jax.random.bernoulli
+truncated_normal = jax.random.truncated_normal
+gamma = jax.random.gamma
+poisson = jax.random.poisson
+exponential = jax.random.exponential
+randint = jax.random.randint
+permutation = jax.random.permutation
+shuffle = jax.random.permutation
+categorical = jax.random.categorical
+choice = jax.random.choice
+
+
+def log_normal(rng, shape=(), mean=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(mean + sigma * jax.random.normal(rng, shape, dtype))
+
+
+def binomial(rng, n, p, shape=(), dtype=jnp.int32):
+    """ref: libnd4j random_binomial (sum of n bernoulli draws)."""
+    draws = jax.random.bernoulli(rng, p, (n,) + tuple(shape))
+    return jnp.sum(draws, axis=0).astype(dtype)
+
+
+class RandomGenerator:
+    """Stateful convenience RNG (ref: org.nd4j.linalg.api.rng.Random).
+
+    NOT for use inside jit-compiled code — functional keys only there. This
+    exists for host-side data pipeline / init ergonomics.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+
+    def set_seed(self, seed: int):
+        self._key = jax.random.key(seed)
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def uniform(self, shape=(), lo=0.0, hi=1.0, dtype=jnp.float32):
+        return jax.random.uniform(self.next_key(), shape, dtype, lo, hi)
+
+    def normal(self, shape=(), mean=0.0, stddev=1.0, dtype=jnp.float32):
+        return mean + stddev * jax.random.normal(self.next_key(), shape, dtype)
+
+    def bernoulli(self, p=0.5, shape=()):
+        return jax.random.bernoulli(self.next_key(), p, shape)
+
+    def randint(self, lo, hi, shape=(), dtype=jnp.int32):
+        return jax.random.randint(self.next_key(), shape, lo, hi, dtype)
+
+    def permutation(self, n_or_array):
+        return jax.random.permutation(self.next_key(), n_or_array)

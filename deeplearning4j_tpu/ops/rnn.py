@@ -1,0 +1,200 @@
+"""RNN op namespace (↔ org.nd4j.linalg.factory.ops.NDRNN).
+
+ref: libnd4j recurrent ops (ops/declarable/generic/recurrent/: lstmLayer,
+gruCell, sruCell …) and their cuDNN platform helper
+(ops/declarable/platform/cudnn/lstmLayer.cu), plus DL4J LSTMHelpers
+(org.deeplearning4j.nn.layers.recurrent.LSTMHelpers — the Java math shared by
+LSTM/GravesLSTM).
+
+TPU-first design: the recurrence is a ``lax.scan`` whose body is one fused
+step (all four gates in a single MXU matmul). The input projection for ALL
+timesteps is hoisted out of the scan as one big [T·N, in] × [in, 4H] matmul —
+the MXU-friendly schedule cuDNN uses internally. A Pallas variant lives in
+kernels/lstm_scan.py; this module is the reference XLA implementation.
+
+Gate math matches the reference for parity testing:
+- lstm_cell: standard LSTM (ref LSTMHelpers with peephole=false)
+- graves_lstm_cell: peephole connections per Graves 2013 "Generating
+  sequences with RNNs" (ref GravesLSTM layer: peepholes on i,f from c_{t-1}
+  and on o from c_t).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LSTMState(NamedTuple):
+    h: jax.Array  # hidden state [N, H]
+    c: jax.Array  # cell state   [N, H]
+
+
+def _gates(x_proj, h, w_h, b):
+    """Sum input projection + recurrent projection + bias → [N, 4H]."""
+    g = x_proj + jnp.matmul(h, w_h)
+    if b is not None:
+        g = g + b
+    return g
+
+
+def lstm_cell(x_proj, state: LSTMState, w_h, b=None, *, forget_bias=0.0):
+    """One LSTM step. x_proj: [N,4H] (precomputed x@w_x), gate order i,f,g,o."""
+    H = state.h.shape[-1]
+    z = _gates(x_proj, state.h, w_h, b)
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    g = jnp.tanh(g)
+    c = f * state.c + i * g
+    o = jax.nn.sigmoid(o)
+    h = o * jnp.tanh(c)
+    return LSTMState(h, c)
+
+
+def graves_lstm_cell(x_proj, state: LSTMState, w_h, b, peep_i, peep_f, peep_o,
+                     *, forget_bias=0.0):
+    """Graves-2013 peephole LSTM step (ref: GravesLSTM / LSTMHelpers with
+    peephole connections). peep_*: [H] diagonal peephole weights."""
+    z = _gates(x_proj, state.h, w_h, b)
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(i + peep_i * state.c)
+    f = jax.nn.sigmoid(f + peep_f * state.c + forget_bias)
+    g = jnp.tanh(g)
+    c = f * state.c + i * g
+    o = jax.nn.sigmoid(o + peep_o * c)
+    h = o * jnp.tanh(c)
+    return LSTMState(h, c)
+
+
+def lstm(
+    x,
+    w_x,
+    w_h,
+    b=None,
+    init_state: Optional[LSTMState] = None,
+    *,
+    peepholes=None,
+    forget_bias: float = 0.0,
+    reverse: bool = False,
+    unroll: int = 1,
+):
+    """Full-sequence LSTM: x [N,T,In] → (outputs [N,T,H], final LSTMState).
+
+    One hoisted input GEMM + lax.scan over time. ``peepholes`` is an optional
+    (peep_i, peep_f, peep_o) triple enabling GravesLSTM math.
+    ref: libnd4j lstmLayer op (direction/gate-order args collapsed to the
+    TPU-relevant subset) + CudnnLSTMHelper.
+    """
+    n, t, _ = x.shape
+    h_dim = w_h.shape[0]
+    if init_state is None:
+        init_state = LSTMState(
+            jnp.zeros((n, h_dim), x.dtype), jnp.zeros((n, h_dim), x.dtype)
+        )
+    # Hoist the input projection for all timesteps: one big MXU matmul.
+    x_proj = jnp.einsum("nti,ih->nth", x, w_x)  # [N,T,4H]
+    xs = jnp.swapaxes(x_proj, 0, 1)  # [T,N,4H] scan-major
+
+    if peepholes is not None:
+        p_i, p_f, p_o = peepholes
+
+        def step(state, xp):
+            new = graves_lstm_cell(xp, state, w_h, b, p_i, p_f, p_o,
+                                   forget_bias=forget_bias)
+            return new, new.h
+    else:
+
+        def step(state, xp):
+            new = lstm_cell(xp, state, w_h, b, forget_bias=forget_bias)
+            return new, new.h
+
+    final, hs = lax.scan(step, init_state, xs, reverse=reverse, unroll=unroll)
+    return jnp.swapaxes(hs, 0, 1), final
+
+
+def bidirectional_lstm(x, params_fwd, params_bwd, *, merge="concat", **kw):
+    """ref: DL4J Bidirectional wrapper (modes: CONCAT/ADD/MUL/AVERAGE)."""
+    out_f, st_f = lstm(x, *params_fwd, **kw)
+    out_b, st_b = lstm(x, *params_bwd, reverse=True, **kw)
+    if merge == "concat":
+        out = jnp.concatenate([out_f, out_b], axis=-1)
+    elif merge == "add":
+        out = out_f + out_b
+    elif merge == "mul":
+        out = out_f * out_b
+    elif merge == "average":
+        out = 0.5 * (out_f + out_b)
+    else:
+        raise ValueError(f"unknown merge mode {merge}")
+    return out, (st_f, st_b)
+
+
+def gru_cell(x_proj, h, w_h, b=None):
+    """One GRU step (ref: libnd4j gruCell). x_proj: [N,3H], gate order r,z,n.
+
+    Recurrent projection split so the candidate uses r ⊙ (h @ w_hn) (the
+    cuDNN/TF "linear_before_reset=false" variant matching nd4j gruCell).
+    """
+    H = h.shape[-1]
+    w_rz, w_n = w_h[:, : 2 * H], w_h[:, 2 * H :]
+    rz = x_proj[:, : 2 * H] + jnp.matmul(h, w_rz)
+    if b is not None:
+        rz = rz + b[: 2 * H]
+    r, z = jnp.split(jax.nn.sigmoid(rz), 2, axis=-1)
+    nb = b[2 * H :] if b is not None else 0.0
+    nx = x_proj[:, 2 * H :] + r * jnp.matmul(h, w_n) + nb
+    n = jnp.tanh(nx)
+    return (1.0 - z) * n + z * h
+
+
+def gru(x, w_x, w_h, b=None, init_h=None, *, reverse=False, unroll=1):
+    """Full-sequence GRU: x [N,T,In] → (outputs [N,T,H], final h [N,H])."""
+    n, t, _ = x.shape
+    h_dim = w_h.shape[0]
+    if init_h is None:
+        init_h = jnp.zeros((n, h_dim), x.dtype)
+    x_proj = jnp.einsum("nti,ih->nth", x, w_x)
+    xs = jnp.swapaxes(x_proj, 0, 1)
+
+    def step(h, xp):
+        h2 = gru_cell(xp, h, w_h, b)
+        return h2, h2
+
+    final, hs = lax.scan(step, init_h, xs, reverse=reverse, unroll=unroll)
+    return jnp.swapaxes(hs, 0, 1), final
+
+
+def simple_rnn(x, w_x, w_h, b=None, init_h=None, *, activation=jnp.tanh,
+               reverse=False, unroll=1):
+    """Elman RNN (ref: DL4J SimpleRnn layer)."""
+    n, t, _ = x.shape
+    h_dim = w_h.shape[0]
+    if init_h is None:
+        init_h = jnp.zeros((n, h_dim), x.dtype)
+    x_proj = jnp.einsum("nti,ih->nth", x, w_x)
+    xs = jnp.swapaxes(x_proj, 0, 1)
+
+    def step(h, xp):
+        pre = xp + jnp.matmul(h, w_h)
+        if b is not None:
+            pre = pre + b
+        h2 = activation(pre)
+        return h2, h2
+
+    final, hs = lax.scan(step, init_h, xs, reverse=reverse, unroll=unroll)
+    return jnp.swapaxes(hs, 0, 1), final
+
+
+def reverse_sequence(x, lengths, time_axis=1, batch_axis=0):
+    """ref: nd4j ReverseSequence op — reverse each sequence up to its length."""
+    t = x.shape[time_axis]
+    idx = jnp.arange(t)
+    rev_idx = lengths[:, None] - 1 - idx[None, :]
+    rev_idx = jnp.where(rev_idx >= 0, rev_idx, idx[None, :])
+    return jnp.take_along_axis(
+        x, rev_idx.reshape(rev_idx.shape + (1,) * (x.ndim - 2)), axis=time_axis
+    )

@@ -1,0 +1,140 @@
+"""Partition-spec policy tables (↔ the ENTIRE L5 scaleout layer of the
+reference, SURVEY §2.6).
+
+ref: ParallelWrapper (P1 param averaging), gradient sharing
+(EncodedGradientsAccumulator/EncodingHandler, P2), SharedTrainingMaster +
+VoidParameterServer over Aeron (P4/P5). On TPU none of that user-space
+machinery exists: parallelism is a *placement policy* — a pytree of
+NamedShardings handed to pjit — and XLA emits the ICI/DCN collectives.
+The replacement table (SURVEY §2.6):
+
+- P1/P2/P3/P4 (data parallel, any flavour)  → batch P('data'), params
+  replicated; gradient all-reduce inserted by XLA (exact, synchronous —
+  supersedes threshold-compressed async sharing).
+- P11 (FSDP/ZeRO)                           → params/opt-state sharded on
+  'fsdp' axis; all-gather on use, reduce-scatter on grads, from the same
+  spec table.
+- P7 (tensor parallel)                      → per-layer specs on 'model'
+  axis (dense kernels alternating column/row split).
+- P9 (sequence parallel / ring attention)   → 'seq' axis (kernels/ring_attention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.runtime.device import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_spec(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over all data-like axes present."""
+    axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names)
+    return NamedSharding(mesh, P(axes if axes else None))
+
+
+def data_parallel_plan(mesh: Mesh):
+    """P1–P4 equivalent: replicated state, batch-sharded data.
+
+    Returns (state_sharding, batch_sharding) usable as pjit prefix pytrees
+    for (TrainState, batch dict).
+    """
+    return replicated(mesh), batch_spec(mesh)
+
+
+def _fsdp_spec_for(shape, fsdp_size: int, min_shard_elems: int) -> P:
+    """Shard the largest divisible dim on the fsdp axis; tiny params stay
+    replicated (same policy XLA's weight-update sharding paper uses —
+    sharding a 10-element bias costs more in collectives than it saves)."""
+    if not shape or int(np.prod(shape)) < min_shard_elems:
+        return P()
+    # Prefer the largest dimension divisible by the axis size.
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size:
+            spec = [None] * len(shape)
+            spec[i] = FSDP_AXIS
+            return P(*spec)
+    return P()
+
+
+def fsdp_plan(mesh: Mesh, params_template: Any, *, min_shard_elems: int = 1024):
+    """P11 equivalent (ZeRO-3-style): per-leaf param sharding pytree.
+
+    Apply the same sharding to optimizer state by tree-prefix (opt state
+    mirrors params structure under every updater in train/updaters.py).
+    Returns (params_sharding_tree, batch_sharding).
+    """
+    fsdp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(FSDP_AXIS, 1)
+    if fsdp_size == 1:
+        return jax.tree_util.tree_map(lambda _: replicated(mesh), params_template), batch_spec(mesh)
+    shardings = jax.tree_util.tree_map(
+        lambda p: NamedSharding(mesh, _fsdp_spec_for(p.shape, fsdp_size, min_shard_elems)),
+        params_template,
+    )
+    return shardings, batch_spec(mesh)
+
+
+def train_state_sharding(mesh: Mesh, ts_template, params_sharding=None):
+    """Build a sharding pytree matching a TrainState.
+
+    params follow ``params_sharding`` (default replicated); optimizer state
+    mirrors the params sharding (every updater in train/updaters.py keeps
+    state as {name: params-shaped tree} — exactly the ZeRO trick: sharded
+    params ⇒ sharded Adam m/v for free); model_state, step, rng replicated.
+    """
+    rep = replicated(mesh)
+    if params_sharding is None:
+        return rep  # prefix pytree: everything replicated
+
+    from deeplearning4j_tpu.train.trainer import TrainState
+
+    def mirror(tree):
+        """Apply params' per-leaf shardings to a params-shaped tree."""
+        ps_leaves = jax.tree_util.tree_flatten(params_sharding)[0]
+        t_leaves, t_def = jax.tree_util.tree_flatten(tree)
+        if len(ps_leaves) == len(t_leaves):
+            return jax.tree_util.tree_unflatten(t_def, ps_leaves)
+        return jax.tree_util.tree_map(lambda _: rep, tree)
+
+    if isinstance(ts_template.opt_state, dict):
+        opt_sh = {k: mirror(v) for k, v in ts_template.opt_state.items()}
+    else:
+        opt_sh = jax.tree_util.tree_map(lambda _: rep, ts_template.opt_state)
+
+    return TrainState(
+        params=params_sharding,
+        model_state=jax.tree_util.tree_map(lambda _: rep, ts_template.model_state),
+        opt_state=opt_sh,
+        step=rep,
+        rng=rep,
+    )
+
+
+# --- tensor-parallel layer spec table (P7) ---------------------------------
+
+# Megatron-style alternating split for transformer blocks: qkv/up-proj
+# column-split (output dim on 'model'), attn-out/down-proj row-split
+# (input dim on 'model'); embeddings vocab-split. Used by models/bert.py.
+TP_RULES = [
+    # (param path substring, PartitionSpec factory by rank)
+    ("attention/qkv", lambda r: P(*([None] * (r - 1) + [MODEL_AXIS]))),
+    ("attention/out", lambda r: P(*([MODEL_AXIS] + [None] * (r - 1)))),
+    ("mlp/up", lambda r: P(*([None] * (r - 1) + [MODEL_AXIS]))),
+    ("mlp/down", lambda r: P(*([MODEL_AXIS] + [None] * (r - 1)))),
+    ("embedding", lambda r: P(MODEL_AXIS, *([None] * (r - 1)))),
+]
+
+
+def tp_spec_for_path(path: str, rank: int) -> P:
+    for sub, factory in TP_RULES:
+        if sub in path:
+            return factory(rank)
+    return P()

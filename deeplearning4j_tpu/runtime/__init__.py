@@ -1,0 +1,42 @@
+"""Runtime substrate: device/mesh discovery, environment flags, PJRT glue.
+
+ref layer: libnd4j runtime (LaunchContext, Environment, NativeOps C ABI) +
+nd4j backend SPI. On TPU the device runtime is PJRT (loaded by JAX); this
+package holds the thin framework-side utilities around it.
+"""
+
+from deeplearning4j_tpu.runtime.device import (
+    ALL_AXES,
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    MeshSpec,
+    batch_sharding,
+    build_mesh,
+    device_count,
+    devices,
+    is_tpu,
+    replicated,
+    single_device_mesh,
+)
+from deeplearning4j_tpu.runtime.environment import Environment, get_environment, set_environment
+
+__all__ = [
+    "ALL_AXES",
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "MeshSpec",
+    "batch_sharding",
+    "build_mesh",
+    "device_count",
+    "devices",
+    "is_tpu",
+    "replicated",
+    "single_device_mesh",
+    "Environment",
+    "get_environment",
+    "set_environment",
+]

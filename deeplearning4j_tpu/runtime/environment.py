@@ -1,0 +1,74 @@
+"""Runtime environment flags and dtype policy.
+
+ref: libnd4j/include/system/Environment.h — sd::Environment (singleton holding
+verbose/debug/maxThreads flags) and org.nd4j.config.ND4JSystemProperties /
+ND4JEnvironmentVars (JVM property + env-var runtime config layer).
+
+The TPU-native analogue is a small process-wide settings object sourced from
+environment variables at import, overridable programmatically. XLA-level knobs
+are passed through via XLA_FLAGS (documented here, not re-implemented).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    return os.environ.get(name, "").strip().lower() in _TRUTHY if name in os.environ else default
+
+
+@dataclasses.dataclass
+class Environment:
+    """Process-wide runtime flags (ref: sd::Environment singleton).
+
+    Attributes mirror the reference's debug/verbose/profiling switches plus
+    TPU-specific dtype policy. ``compute_dtype`` is what matmuls/convs run in
+    on the MXU (bf16 by default on TPU); ``param_dtype`` is the persistent
+    parameter storage dtype (fp32 master copy, as in mixed-precision
+    training); ``accum_dtype`` is the reduction/accumulation dtype.
+    """
+
+    debug: bool = dataclasses.field(default_factory=lambda: _env_bool("DL4J_TPU_DEBUG"))
+    verbose: bool = dataclasses.field(default_factory=lambda: _env_bool("DL4J_TPU_VERBOSE"))
+    check_numerics: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("DL4J_TPU_CHECK_NUMERICS")
+    )
+    profiling: bool = dataclasses.field(default_factory=lambda: _env_bool("DL4J_TPU_PROFILING"))
+    # Dtype policy (ref: Nd4j.setDefaultDataTypes(compute, init)).
+    param_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("DL4J_TPU_PARAM_DTYPE", "float32")
+    )
+    compute_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("DL4J_TPU_COMPUTE_DTYPE", "float32")
+    )
+    accum_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("DL4J_TPU_ACCUM_DTYPE", "float32")
+    )
+
+    def jnp_param_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    def jnp_compute_dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+_ENV: Optional[Environment] = None
+
+
+def get_environment() -> Environment:
+    global _ENV
+    if _ENV is None:
+        _ENV = Environment()
+    return _ENV
+
+
+def set_environment(env: Environment) -> None:
+    global _ENV
+    _ENV = env

@@ -1,0 +1,142 @@
+"""Checkpoint save/restore (↔ org.deeplearning4j.util.ModelSerializer +
+CheckpointListener rotation + SameDiff.save).
+
+ref format: zip{configuration.json, coefficients.bin (flat params),
+updaterState.bin, normalizer}. TPU-native format: a directory per
+checkpoint containing
+
+- ``config.json``   — model architecture (config_to_json; the model is
+  reconstructable from this alone, like the reference)
+- ``state.npz``     — every TrainState leaf under its pytree path key
+- ``meta.json``     — step, tag, framework version, leaf manifest
+
+Arrays are pulled to host and stored dense (single-host). The layout is
+topology-independent: restore does NOT care how the arrays were sharded at
+save time — pass a sharding to ``restore_checkpoint`` and leaves are
+device_put to it (↔ SURVEY §5.4 'resharding on restore'). Multi-host async
+checkpointing can later swap this backend for orbax without changing
+callers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.nn.config import config_from_json, config_to_json
+from deeplearning4j_tpu.utils.pytree import flatten_with_names
+from deeplearning4j_tpu.version import __version__
+
+_INDEX = "checkpoint_index.json"
+
+
+def _is_key_array(x) -> bool:
+    return isinstance(x, jax.Array) and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict] = None):
+    """Save any pytree (TrainState, variables dict, …) to directory."""
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    key_paths = []
+    for name, leaf in flatten_with_names(tree):
+        if _is_key_array(leaf):
+            arrays[name] = np.asarray(jax.random.key_data(leaf))
+            key_paths.append(name)
+        else:
+            arrays[name] = np.asarray(jax.device_get(leaf))
+    np.savez(d / "state.npz", **arrays)
+    meta = {
+        "version": __version__,
+        "time": time.time(),
+        "leaves": sorted(arrays.keys()),
+        "key_paths": key_paths,
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    (d / "meta.json").write_text(json.dumps(meta, indent=2))
+
+
+def load_state_tree(directory: str | Path, template: Any, sharding=None) -> Any:
+    """Restore a pytree saved by save_state_tree into template's structure.
+
+    ``sharding``: optional pytree of shardings (or one sharding) — leaves
+    are device_put accordingly (topology-independent resharding).
+    """
+    d = Path(directory)
+    meta = json.loads((d / "meta.json").read_text())
+    key_paths = set(meta.get("key_paths", []))
+    with np.load(d / "state.npz") as z:
+        data = {k: z[k] for k in z.files}
+    paths = jax.tree_util.tree_flatten_with_path(template)[0]
+    treedef = jax.tree_util.tree_structure(template)
+    from deeplearning4j_tpu.utils.pytree import path_str
+
+    leaves = []
+    for p, tmpl_leaf in paths:
+        name = path_str(p)
+        if name not in data:
+            raise KeyError(f"checkpoint missing leaf '{name}'")
+        arr = data[name]
+        if name in key_paths:
+            leaves.append(jax.random.wrap_key_data(jax.numpy.asarray(arr)))
+        else:
+            leaves.append(jax.numpy.asarray(arr).astype(tmpl_leaf.dtype))
+    tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    if sharding is not None:
+        tree = jax.device_put(tree, sharding)
+    return tree
+
+
+def save_checkpoint(directory: str | Path, train_state, *, model=None,
+                    tag: str = "", keep_last: int = 0):
+    """Full training checkpoint: state + model config + rotation index
+    (↔ CheckpointListener.keepLast + checkpoint.json)."""
+    root = Path(directory)
+    root.mkdir(parents=True, exist_ok=True)
+    step = int(jax.device_get(train_state.step))
+    name = f"checkpoint_{step}" + (f"_{tag}" if tag else "")
+    ckpt_dir = root / name
+    save_state_tree(ckpt_dir, train_state, {"step": step, "tag": tag})
+    if model is not None:
+        (ckpt_dir / "config.json").write_text(model.config.to_json())
+    # rotation index
+    idx_path = root / _INDEX
+    index = json.loads(idx_path.read_text()) if idx_path.exists() else {"checkpoints": []}
+    index["checkpoints"].append({"name": name, "step": step, "tag": tag, "time": time.time()})
+    if keep_last and len(index["checkpoints"]) > keep_last:
+        for old in index["checkpoints"][:-keep_last]:
+            shutil.rmtree(root / old["name"], ignore_errors=True)
+        index["checkpoints"] = index["checkpoints"][-keep_last:]
+    idx_path.write_text(json.dumps(index, indent=2))
+    return str(ckpt_dir)
+
+
+def latest_checkpoint(directory: str | Path) -> Optional[str]:
+    idx_path = Path(directory) / _INDEX
+    if not idx_path.exists():
+        return None
+    index = json.loads(idx_path.read_text())
+    if not index["checkpoints"]:
+        return None
+    return str(Path(directory) / index["checkpoints"][-1]["name"])
+
+
+def restore_checkpoint(ckpt_dir: str | Path, train_state_template,
+                       sharding=None):
+    """↔ ModelSerializer.restoreMultiLayerNetwork(+updater): returns the
+    restored TrainState."""
+    return load_state_tree(ckpt_dir, train_state_template, sharding)
+
+
+def load_model_config(ckpt_dir: str | Path):
+    """Rebuild the model config from a checkpoint's config.json."""
+    return config_from_json((Path(ckpt_dir) / "config.json").read_text())

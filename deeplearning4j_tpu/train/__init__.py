@@ -1,0 +1,23 @@
+"""Training stack (↔ deeplearning4j Solver/updaters/listeners)."""
+
+from deeplearning4j_tpu.train import listeners, schedules, updaters  # noqa: F401
+from deeplearning4j_tpu.train.trainer import TrainState, Trainer
+from deeplearning4j_tpu.train.updaters import (
+    AMSGrad,
+    AdaDelta,
+    AdaGrad,
+    AdaMax,
+    Adam,
+    AdamW,
+    Nadam,
+    Nesterovs,
+    NoOp,
+    RmsProp,
+    Sgd,
+)
+
+__all__ = [
+    "listeners", "schedules", "updaters", "TrainState", "Trainer",
+    "Sgd", "Adam", "AdamW", "AMSGrad", "Nadam", "AdaMax", "AdaGrad",
+    "AdaDelta", "RmsProp", "Nesterovs", "NoOp",
+]

@@ -1,0 +1,194 @@
+"""Training listeners (↔ org.deeplearning4j.optimize.api.TrainingListener).
+
+ref listener impls: ScoreIterationListener (log loss every N iters),
+PerformanceListener (samples/sec + memory — the throughput number the
+north-star metric comes from), EvaluativeListener (periodic eval),
+CheckpointListener (rotating checkpoints), TimeIterationListener.
+
+Protocol (host-side; metrics arrive as device arrays and are only pulled
+when a listener actually reads them, keeping the device pipeline async):
+
+    on_fit_start(trainer, ts)
+    on_epoch_start(epoch)
+    on_iteration(epoch, step, ts, metrics) -> bool (True = stop training)
+    on_epoch_end(epoch, ts) -> bool (True = stop)
+    on_fit_end(trainer, ts)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+
+class TrainingListener:
+    def on_fit_start(self, trainer, ts):
+        pass
+
+    def on_epoch_start(self, epoch: int):
+        pass
+
+    def on_iteration(self, epoch: int, step: int, ts, metrics) -> bool:
+        return False
+
+    def on_epoch_end(self, epoch: int, ts) -> bool:
+        return False
+
+    def on_fit_end(self, trainer, ts):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """↔ ScoreIterationListener — print loss every N iterations."""
+
+    def __init__(self, every: int = 10, stream=None):
+        self.every = every
+        self.stream = stream or sys.stdout
+        self.history: List[float] = []
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        if step % self.every == 0:
+            loss = float(jax.device_get(metrics["total_loss"]))
+            self.history.append(loss)
+            print(f"epoch {epoch} iter {step}: loss={loss:.6f}", file=self.stream)
+        return False
+
+
+class PerformanceListener(TrainingListener):
+    """↔ PerformanceListener — throughput (samples/sec) every N iters.
+
+    This is the listener the project's headline metric comes from; batch
+    size is read from the features' leading dim.
+    """
+
+    def __init__(self, every: int = 50, stream=None):
+        self.every = every
+        self.stream = stream or sys.stdout
+        self._t0 = None
+        self._count0 = 0
+        self._samples = 0
+        self.last_samples_per_sec: Optional[float] = None
+
+    def on_epoch_start(self, epoch):
+        self._t0 = None
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        bs = metrics.get("batch_size")
+        self._samples += int(jax.device_get(bs)) if bs is not None else 0
+        if self._t0 is None:
+            # Skip the compile step in throughput accounting.
+            jax.block_until_ready(ts.params)
+            self._t0 = time.perf_counter()
+            self._count0 = step
+            self._samples = 0
+            return False
+        if (step - self._count0) % self.every == 0:
+            jax.block_until_ready(ts.params)
+            dt = time.perf_counter() - self._t0
+            iters = step - self._count0
+            ips = iters / dt
+            msg = f"perf: {ips:.2f} iter/sec"
+            if self._samples:
+                self.last_samples_per_sec = self._samples / dt
+                msg += f", {self.last_samples_per_sec:.1f} samples/sec"
+            print(msg, file=self.stream)
+        return False
+
+
+class JsonlMetricsListener(TrainingListener):
+    """Structured metrics to a JSONL file (↔ StatsListener → StatsStorage;
+    the file is the storage, consumable by any dashboard)."""
+
+    def __init__(self, path: str, every: int = 1):
+        self.path = path
+        self.every = every
+        self._fh = None
+
+    def on_fit_start(self, trainer, ts):
+        self._fh = open(self.path, "a")
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        if step % self.every == 0 and self._fh:
+            rec = {"epoch": epoch, "step": step, "time": time.time()}
+            for k, v in metrics.items():
+                try:
+                    rec[k] = float(jax.device_get(v))
+                except (TypeError, ValueError):
+                    pass
+            self._fh.write(json.dumps(rec) + "\n")
+        return False
+
+    def on_fit_end(self, trainer, ts):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class EvaluativeListener(TrainingListener):
+    """↔ EvaluativeListener — periodic evaluation on a held-out iterator."""
+
+    def __init__(self, eval_fn: Callable[[Any], Dict[str, float]],
+                 every_epochs: int = 1, stream=None):
+        self.eval_fn = eval_fn
+        self.every_epochs = every_epochs
+        self.stream = stream or sys.stdout
+        self.history: List[Dict[str, float]] = []
+
+    def on_epoch_end(self, epoch, ts):
+        if (epoch + 1) % self.every_epochs == 0:
+            scores = self.eval_fn(ts)
+            self.history.append(scores)
+            pretty = ", ".join(f"{k}={v:.4f}" for k, v in scores.items())
+            print(f"eval after epoch {epoch}: {pretty}", file=self.stream)
+        return False
+
+
+class CheckpointListener(TrainingListener):
+    """↔ CheckpointListener — rotating checkpoint saves every N epochs/iters.
+
+    Uses serde/checkpoint.py; keeps the last ``keep_last`` checkpoints plus
+    a JSON index (↔ checkpoint.json in the reference).
+    """
+
+    def __init__(self, directory: str, *, every_epochs: Optional[int] = 1,
+                 every_iters: Optional[int] = None, keep_last: int = 3,
+                 model=None):
+        self.directory = directory
+        self.every_epochs = every_epochs
+        self.every_iters = every_iters
+        self.keep_last = keep_last
+        self.model = model
+
+    def _save(self, ts, tag: str):
+        from deeplearning4j_tpu.serde.checkpoint import save_checkpoint
+
+        save_checkpoint(self.directory, ts, model=self.model, tag=tag,
+                        keep_last=self.keep_last)
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        if self.every_iters and step % self.every_iters == 0:
+            self._save(ts, f"iter{step}")
+        return False
+
+    def on_epoch_end(self, epoch, ts):
+        if self.every_epochs and (epoch + 1) % self.every_epochs == 0:
+            self._save(ts, f"epoch{epoch}")
+        return False
+
+
+class TimeIterationListener(TrainingListener):
+    """↔ TimeIterationListener — stop after a wall-clock budget."""
+
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = None
+
+    def on_fit_start(self, trainer, ts):
+        self._start = time.time()
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        return (time.time() - self._start) > self.max_seconds

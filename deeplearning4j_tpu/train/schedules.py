@@ -1,0 +1,135 @@
+"""Learning-rate schedules (↔ org.nd4j.linalg.schedule.ISchedule impls).
+
+ref: ExponentialSchedule, InverseSchedule, PolySchedule, SigmoidSchedule,
+StepSchedule, MapSchedule, CycleSchedule, RampSchedule — all functions of
+(iteration | epoch). Here a schedule is a pure fn(step) -> lr, traced into
+the compiled train step (so LR changes don't retrigger compilation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import register_config
+
+
+@register_config
+@dataclass
+class FixedSchedule:
+    value: float = 0.01
+
+    def __call__(self, step):
+        return jnp.asarray(self.value, jnp.float32)
+
+
+@register_config
+@dataclass
+class ExponentialSchedule:
+    """lr = initial * gamma^step (ref: ExponentialSchedule)."""
+
+    initial: float = 0.01
+    gamma: float = 0.99
+
+    def __call__(self, step):
+        return self.initial * jnp.power(self.gamma, step.astype(jnp.float32))
+
+
+@register_config
+@dataclass
+class InverseSchedule:
+    """lr = initial / (1 + gamma*step)^power (ref: InverseSchedule)."""
+
+    initial: float = 0.01
+    gamma: float = 0.001
+    power: float = 1.0
+
+    def __call__(self, step):
+        return self.initial / jnp.power(1.0 + self.gamma * step, self.power)
+
+
+@register_config
+@dataclass
+class PolySchedule:
+    """lr = initial * (1 - step/max_steps)^power (ref: PolySchedule)."""
+
+    initial: float = 0.01
+    power: float = 1.0
+    max_steps: int = 10000
+
+    def __call__(self, step):
+        frac = jnp.clip(step.astype(jnp.float32) / self.max_steps, 0.0, 1.0)
+        return self.initial * jnp.power(1.0 - frac, self.power)
+
+
+@register_config
+@dataclass
+class SigmoidSchedule:
+    """lr = initial / (1 + exp(-gamma*(step - step_center))) complement
+    (ref: SigmoidSchedule)."""
+
+    initial: float = 0.01
+    gamma: float = 0.01
+    step_center: int = 1000
+
+    def __call__(self, step):
+        return self.initial / (1.0 + jnp.exp(self.gamma * (step - self.step_center)))
+
+
+@register_config
+@dataclass
+class StepSchedule:
+    """lr = initial * decay^floor(step/step_size) (ref: StepSchedule)."""
+
+    initial: float = 0.01
+    decay: float = 0.1
+    step_size: int = 1000
+
+    def __call__(self, step):
+        return self.initial * jnp.power(self.decay, jnp.floor(step / self.step_size))
+
+
+@register_config
+@dataclass
+class MapSchedule:
+    """Piecewise-constant from {step: lr} breakpoints (ref: MapSchedule)."""
+
+    values: Dict[int, float] = field(default_factory=dict)
+    initial: float = 0.01
+
+    def __call__(self, step):
+        lr = jnp.asarray(self.initial, jnp.float32)
+        for s in sorted(self.values):
+            lr = jnp.where(step >= s, self.values[s], lr)
+        return lr
+
+
+@register_config
+@dataclass
+class WarmupCosineSchedule:
+    """Linear warmup → cosine decay (TPU-era addition; not in reference —
+    needed for BERT/ResNet recipes)."""
+
+    peak: float = 1e-3
+    warmup_steps: int = 1000
+    total_steps: int = 100000
+    end_value: float = 0.0
+
+    def __call__(self, step):
+        stepf = step.astype(jnp.float32)
+        warm = self.peak * stepf / jnp.maximum(self.warmup_steps, 1)
+        frac = jnp.clip(
+            (stepf - self.warmup_steps) / jnp.maximum(self.total_steps - self.warmup_steps, 1),
+            0.0, 1.0,
+        )
+        cos = self.end_value + 0.5 * (self.peak - self.end_value) * (1 + jnp.cos(jnp.pi * frac))
+        return jnp.where(stepf < self.warmup_steps, warm, cos)
+
+
+def resolve_schedule(lr) -> "callable":
+    """float → FixedSchedule; schedule objects pass through."""
+    if callable(lr):
+        return lr
+    return FixedSchedule(float(lr))

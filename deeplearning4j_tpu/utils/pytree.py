@@ -1,0 +1,68 @@
+"""Pytree ↔ flat-vector utilities.
+
+ref: the reference keeps ALL params in one contiguous flat vector
+(MultiLayerNetwork.params()) with layer params as views — an allocation
+trick that the TPU design abandons (pytrees shard better and donate
+cleanly). These utils provide the flat view for checkpoint compat and
+parity tests (↔ MultiLayerNetwork.params() / setParams()).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def flatten_with_names(tree) -> List[Tuple[str, Any]]:
+    """[(path string, leaf array)] in deterministic order."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(path_str(p), v) for p, v in leaves]
+
+
+def to_flat_vector(params) -> jnp.ndarray:
+    """↔ MultiLayerNetwork.params(): single 1-D concat of all params."""
+    named = flatten_with_names(params)
+    return jnp.concatenate([jnp.ravel(v) for _, v in named]) if named else jnp.zeros((0,))
+
+
+def from_flat_vector(params_template, flat) -> Any:
+    """↔ setParams(): scatter a flat vector back into the pytree structure."""
+    leaves, treedef = jax.tree_util.tree_flatten(params_template)
+    out = []
+    off = 0
+    for leaf in leaves:
+        n = leaf.size
+        out.append(jnp.reshape(flat[off : off + n], leaf.shape).astype(leaf.dtype))
+        off += n
+    if off != flat.shape[0]:
+        raise ValueError(f"flat vector length {flat.shape[0]} != param count {off}")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def num_params(tree) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_allclose(a, b, rtol=1e-5, atol=1e-6) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        return False
+    return all(np.allclose(x, y, rtol=rtol, atol=atol) for x, y in zip(la, lb))

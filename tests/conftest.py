@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+This is the TPU analogue of the reference's multi-node-without-cluster test
+strategy (SURVEY §4: Spark local[N] + embedded Aeron media driver): all mesh
+and pjit tests run against 8 fake CPU devices, so the identical SPMD
+programs that run on a v5e slice are validated in CI with no TPU attached.
+
+NOTE: this environment's sitecustomize imports jax at interpreter startup
+with JAX_PLATFORMS=axon already in the env, so plain env-var edits here are
+too late — use jax.config.update instead (backends initialize lazily, so
+this still lands before any backend is created).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,188 @@
+"""Layer unit tests: shape inference vs actual apply shapes, basic math.
+
+ref test strategy: deeplearning4j-core MultiLayerTest / ConvolutionLayerTest
+forward-shape checks (SURVEY §4 tier 'Layer/network unit tests').
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+
+
+def _check(layer, input_shape, batch=2, dtype=jnp.float32, **apply_kw):
+    """Init + apply a layer; assert apply shape matches output_shape."""
+    rng = jax.random.key(0)
+    params, state = layer.init(rng, input_shape, dtype)
+    x = jax.random.normal(jax.random.key(1), (batch, *input_shape), dtype)
+    y, _ = layer.apply(params, state, x, **apply_kw)
+    expected = layer.output_shape(input_shape)
+    assert y.shape == (batch, *expected), f"{type(layer).__name__}: {y.shape} != {(batch, *expected)}"
+    assert jnp.all(jnp.isfinite(y)), f"{type(layer).__name__} produced non-finite"
+    return params, y
+
+
+def test_dense():
+    params, y = _check(L.Dense(units=16, activation="relu"), (8,))
+    assert params["W"].shape == (8, 16)
+
+
+def test_dense_no_bias():
+    params, _ = _check(L.Dense(units=4, use_bias=False), (8,))
+    assert "b" not in params
+
+
+def test_activation_layer():
+    _check(L.ActivationLayer(activation="tanh"), (5,))
+
+
+def test_dropout_train_vs_eval():
+    layer = L.Dropout(rate=0.5)
+    x = jnp.ones((4, 100))
+    y_eval, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_allclose(y_eval, x)
+    y_train, _ = layer.apply({}, {}, x, train=True, rng=jax.random.key(0))
+    assert float(jnp.mean(y_train == 0)) > 0.2  # some dropped
+    # inverted scaling keeps expectation ≈ 1
+    assert 0.7 < float(jnp.mean(y_train)) < 1.3
+
+
+def test_embedding():
+    layer = L.Embedding(vocab_size=50, units=8)
+    params, state = layer.init(jax.random.key(0), (), jnp.float32)
+    ids = jnp.array([[1, 2], [3, 4]])
+    y, _ = layer.apply(params, state, ids)
+    assert y.shape == (2, 2, 8)
+
+
+def test_conv2d_same_padding():
+    _check(L.Conv2D(filters=4, kernel=3, padding="SAME"), (8, 8, 3))
+
+
+def test_conv2d_valid_stride():
+    _check(L.Conv2D(filters=4, kernel=3, stride=2, padding="VALID"), (9, 9, 3))
+
+
+def test_conv2d_explicit_padding():
+    _check(L.Conv2D(filters=2, kernel=5, stride=1, padding=2), (8, 8, 1))
+
+
+def test_conv1d():
+    _check(L.Conv1D(filters=6, kernel=3, padding="SAME"), (10, 4))
+
+
+def test_conv3d():
+    _check(L.Conv3D(filters=2, kernel=3, padding="SAME"), (4, 6, 6, 2))
+
+
+def test_deconv2d():
+    _check(L.Deconv2D(filters=3, kernel=2, stride=2), (4, 4, 5))
+
+
+def test_depthwise_conv():
+    _check(L.DepthwiseConv2D(depth_multiplier=2, kernel=3), (6, 6, 4))
+
+
+def test_separable_conv():
+    _check(L.SeparableConv2D(filters=8, kernel=3), (6, 6, 4))
+
+
+def test_pooling_variants():
+    for pt in ["max", "avg", "pnorm"]:
+        _check(L.Pooling2D(pool_type=pt, window=2), (8, 8, 3))
+
+
+def test_pooling_matches_manual_max():
+    layer = L.Pooling2D(pool_type="max", window=2)
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = layer.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], [[5, 7], [13, 15]])
+
+
+def test_global_pooling():
+    _check(L.GlobalPooling(pool_type="avg"), (5, 5, 7))
+
+
+def test_upsampling():
+    _check(L.Upsampling2D(scale=2), (3, 3, 2))
+
+
+def test_zero_padding_cropping():
+    _check(L.ZeroPadding2D(padding=(1, 1, 2, 2)), (4, 4, 1))
+    _check(L.Cropping2D(cropping=(1, 1, 1, 1)), (5, 5, 2))
+
+
+def test_space_to_depth():
+    _check(L.SpaceToDepth(block_size=2), (4, 4, 3))
+
+
+def test_batchnorm_train_normalizes():
+    layer = L.BatchNorm(momentum=0.9)
+    params, state = layer.init(jax.random.key(0), (6,), jnp.float32)
+    x = 5.0 + 3.0 * jax.random.normal(jax.random.key(1), (64, 6))
+    y, new_state = layer.apply(params, state, x, train=True)
+    assert abs(float(jnp.mean(y))) < 0.1
+    assert abs(float(jnp.std(y)) - 1.0) < 0.1
+    # running stats moved toward batch stats
+    assert float(jnp.max(jnp.abs(new_state["mean"]))) > 0.1
+
+
+def test_batchnorm_inference_uses_running_stats():
+    layer = L.BatchNorm()
+    params, state = layer.init(jax.random.key(0), (4,), jnp.float32)
+    state = {"mean": jnp.full((4,), 2.0), "var": jnp.full((4,), 4.0)}
+    x = jnp.full((3, 4), 2.0)
+    y, _ = layer.apply(params, state, x, train=False)
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-2)
+
+
+def test_layernorm():
+    layer = L.LayerNorm()
+    params, state = layer.init(jax.random.key(0), (10,), jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (4, 10)) * 7 + 3
+    y, _ = layer.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, -1)), 0.0, atol=1e-4)
+
+
+def test_lrn():
+    _check(L.LocalResponseNormalization(), (4, 4, 8))
+
+
+def test_lstm_shapes():
+    _check(L.LSTM(units=12), (5, 7))
+    _check(L.LSTM(units=12, return_sequences=False), (5, 7))
+
+
+def test_graves_lstm_has_peepholes():
+    layer = L.GravesLSTM(units=6)
+    params, _ = layer.init(jax.random.key(0), (4, 3), jnp.float32)
+    assert set(params) >= {"W", "RW", "b", "pI", "pF", "pO"}
+    _check(layer, (4, 3))
+
+
+def test_gru_and_simple_rnn():
+    _check(L.GRU(units=9), (6, 4))
+    _check(L.SimpleRnn(units=9), (6, 4))
+
+
+def test_bidirectional_concat():
+    layer = L.Bidirectional(layer=L.LSTM(units=5))
+    _check(layer, (7, 3))
+
+
+def test_last_time_step():
+    layer = L.LastTimeStep()
+    x = jnp.arange(24.0).reshape(2, 3, 4)
+    y, _ = layer.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x[:, -1, :]))
+
+
+def test_prelu():
+    layer = L.PReLU()
+    params, state = layer.init(jax.random.key(0), (5,), jnp.float32)
+    params = {"alpha": jnp.full((5,), 0.1)}
+    x = jnp.array([[-1.0, -2.0, 0.0, 1.0, 2.0]])
+    y, _ = layer.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(y)[0], [-0.1, -0.2, 0.0, 1.0, 2.0], rtol=1e-6)

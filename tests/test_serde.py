@@ -1,0 +1,80 @@
+"""Config JSON round-trip + checkpoint save/restore tests.
+
+ref: config serde round-trip tests (MultiLayerTest JSON/YAML) and
+ModelSerializer round-trip tests (SURVEY §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.lenet import lenet, lenet_config
+from deeplearning4j_tpu.nn.config import SequentialConfig, config_from_json
+from deeplearning4j_tpu.nn.model import SequentialModel
+from deeplearning4j_tpu.serde.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.utils.pytree import (
+    from_flat_vector,
+    to_flat_vector,
+    tree_allclose,
+)
+
+
+def test_config_json_roundtrip():
+    cfg = lenet_config()
+    js = cfg.to_json()
+    cfg2 = SequentialConfig.from_json(js)
+    assert cfg2.to_json() == js
+    assert len(cfg2.layers) == len(cfg.layers)
+    assert cfg2.net.updater.lr == cfg.net.updater.lr
+
+
+def test_rebuilt_model_same_output():
+    cfg = lenet_config()
+    m1 = SequentialModel(cfg)
+    m2 = SequentialModel(SequentialConfig.from_json(cfg.to_json()))
+    v1 = m1.init(seed=3)
+    v2 = m2.init(seed=3)
+    x = np.random.default_rng(0).normal(size=(2, 28, 28, 1)).astype(np.float32)
+    y1 = m1.output(v1, x)
+    y2 = m2.output(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    model = lenet()
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    d = save_checkpoint(tmp_path, ts, model=model, tag="t")
+    ts2 = restore_checkpoint(d, ts)
+    assert tree_allclose(ts.params, ts2.params)
+    assert int(ts2.step) == int(ts.step)
+
+
+def test_checkpoint_rotation(tmp_path):
+    model = lenet()
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    import dataclasses
+
+    for i in range(5):
+        ts = dataclasses.replace(ts, step=jnp.asarray(i, jnp.int32))
+        save_checkpoint(tmp_path, ts, keep_last=2)
+    import json
+
+    idx = json.loads((tmp_path / "checkpoint_index.json").read_text())
+    assert len(idx["checkpoints"]) == 2
+    assert latest_checkpoint(tmp_path).endswith("checkpoint_4")
+
+
+def test_flat_vector_roundtrip():
+    model = lenet()
+    v = model.init(seed=0)
+    flat = to_flat_vector(v["params"])
+    assert flat.ndim == 1
+    back = from_flat_vector(v["params"], flat)
+    assert tree_allclose(v["params"], back)

@@ -1,0 +1,98 @@
+"""Updater math tests vs closed-form/first-step expectations.
+
+ref: Nd4j UpdaterValidation-style tests — assert each updater's first-step
+update matches the published formula.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.train import updaters as U
+
+
+def _one_step(cfg, g=0.5, p=1.0):
+    init, update = cfg.make()
+    params = {"w": jnp.array([p])}
+    grads = {"w": jnp.array([g])}
+    state = init(params)
+    upd, state = update(grads, state, params, jnp.zeros((), jnp.int32))
+    return float(upd["w"][0]), state
+
+
+def test_sgd():
+    u, _ = _one_step(U.Sgd(0.1), g=0.5)
+    assert np.isclose(u, -0.05)
+
+
+def test_adam_first_step_is_lr_sized():
+    # bias-corrected first step ≈ -lr * sign(g)
+    u, _ = _one_step(U.Adam(lr=1e-3), g=0.5)
+    assert np.isclose(u, -1e-3, rtol=1e-3)
+
+
+def test_nesterov():
+    m, lr, g = 0.9, 0.1, 0.5
+    u, state = _one_step(U.Nesterovs(lr=lr, momentum=m), g=g)
+    v1 = m * 0.0 - lr * g
+    expected = -m * 0.0 + (1 + m) * v1
+    assert np.isclose(u, expected)
+    assert np.isclose(float(state["v"]["w"][0]), v1)
+
+
+def test_rmsprop():
+    u, _ = _one_step(U.RmsProp(lr=1e-2, decay=0.95), g=0.5)
+    expected = -1e-2 * 0.5 / (np.sqrt(0.05 * 0.25) + 1e-8)
+    assert np.isclose(u, expected, rtol=1e-5)
+
+
+def test_adagrad():
+    u, _ = _one_step(U.AdaGrad(lr=0.01), g=0.5)
+    expected = -0.01 * 0.5 / (np.sqrt(0.25) + 1e-6)
+    assert np.isclose(u, expected, rtol=1e-5)
+
+
+def test_adadelta_no_lr():
+    u, _ = _one_step(U.AdaDelta(rho=0.95), g=0.5)
+    assert u < 0  # moves against gradient
+
+
+def test_amsgrad_close_to_adam_first_step():
+    ua, _ = _one_step(U.AMSGrad(lr=1e-3), g=0.5)
+    assert ua < 0
+
+
+def test_nadam_negative_update():
+    u, _ = _one_step(U.Nadam(lr=1e-3), g=0.5)
+    assert u < 0
+
+
+def test_adamax():
+    u, _ = _one_step(U.AdaMax(lr=2e-3), g=0.5)
+    # first step: -lr * (m/bc1) / (u + eps) = -lr * g / |g| = -lr
+    assert np.isclose(u, -2e-3, rtol=1e-3)
+
+
+def test_noop():
+    u, _ = _one_step(U.NoOp(), g=0.5)
+    assert u == 0.0
+
+
+def test_adamw_decays_weights():
+    ua, _ = _one_step(U.Adam(lr=1e-3), g=0.5, p=2.0)
+    uw, _ = _one_step(U.AdamW(lr=1e-3, weight_decay=0.1), g=0.5, p=2.0)
+    assert uw < ua  # extra decay term pushes further down
+
+
+def test_schedule_in_updater():
+    from deeplearning4j_tpu.train.schedules import StepSchedule
+
+    cfg = U.Sgd(StepSchedule(initial=0.1, decay=0.1, step_size=10))
+    init, update = cfg.make()
+    params = {"w": jnp.array([1.0])}
+    grads = {"w": jnp.array([1.0])}
+    st = init(params)
+    u0, _ = update(grads, st, params, jnp.asarray(0))
+    u15, _ = update(grads, st, params, jnp.asarray(15))
+    assert np.isclose(float(u0["w"][0]), -0.1)
+    assert np.isclose(float(u15["w"][0]), -0.01)
