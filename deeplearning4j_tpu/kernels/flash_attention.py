@@ -1,0 +1,194 @@
+"""Pallas TPU blockwise (flash) attention kernel.
+
+ref: the reference's only attention is the O(T²)-memory libnd4j
+``multi_head_dot_product_attention`` op behind SameDiff attention layers
+(SURVEY §5.7) — it materializes the [T,S] score matrix in HBM. This kernel
+is the TPU-native replacement: online-softmax tiling keeps only
+[block_q, block_k] score tiles in VMEM, so memory is O(T·D) and the two
+matmuls per tile run back-to-back on the MXU.
+
+Grid: (batch*heads, q_blocks, kv_blocks), kv innermost so the running
+max/denominator/accumulator for one q block live in VMEM scratch across the
+kv sweep. Causal masking skips fully-masked kv blocks via ``pl.when``.
+
+Backward: custom_vjp recomputing through the XLA reference implementation
+(correct by construction; flash backward kernel is a later optimization —
+same policy as kernels/lstm_scan.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+def reference_attention(q, k, v, *, causal=False, bias=None, scale=None):
+    """XLA O(T²) attention; q [B,H,T,D], k/v [B,H,S,D]. fp32 softmax."""
+    d = q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        t_len, s_len = s.shape[-2], s.shape[-1]
+        idx_t = jnp.arange(t_len)[:, None]
+        idx_s = jnp.arange(s_len)[None, :]
+        s = jnp.where(idx_t + (s_len - t_len) >= idx_s, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale, causal, block_q, block_k, seq_q, seq_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: a kv block whose smallest key index exceeds the largest query
+    # index is fully masked — skip its compute entirely.
+    q_hi = (qi + 1) * block_q - 1 + (seq_k - seq_q)
+    k_lo = ki * block_k
+    run = (not causal) or (q_hi >= k_lo)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        # Mask key padding (seq_k tail) and the causal triangle.
+        key_idx = k_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = key_idx < seq_k
+        if causal:
+            query_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (query_idx + (seq_k - seq_q) >= key_idx)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        o_ref[0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
+    b, h, t, d = q.shape
+    s_len = k.shape[2]
+    block_q = min(block_q, max(t, 8))
+    block_k = min(block_k, max(s_len, 128))
+
+    qp = _pad_to(_pad_to(q.reshape(b * h, t, d), 1, block_q), 2, 128)
+    kp = _pad_to(_pad_to(k.reshape(b * h, s_len, d), 1, block_k), 2, 128)
+    vp = _pad_to(_pad_to(v.reshape(b * h, s_len, d), 1, block_k), 2, 128)
+    dp = qp.shape[-1]
+    tq, tk = qp.shape[1], kp.shape[1]
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_q=t, seq_k=s_len,
+    )
+    grid = (b * h, tq // block_q, tk // block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, dp), jnp.float32),
+        ],
+        interpret=not _on_tpu(),
+    )(qp, kp, vp)
+    return out[:, :t, :d].reshape(b, h, t, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal=causal, scale=scale,
+                      block_q=block_q, block_k=block_k)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    out = _flash(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: reference_attention(q, k, v, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale=None, bias=None,
+                    block_q: int = 256, block_k: int = 256):
+    """Blockwise attention; q [B,H,T,D], k/v [B,H,S,D] → [B,H,T,D].
+
+    ``bias`` (additive logits mask, e.g. padding) forces the XLA fallback —
+    the kernel covers the unbiased and causal fast paths.
+    """
+    d = q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    if bias is not None or q.shape[2] < 8 or not _HAS_PLTPU:
+        return reference_attention(q, k, v, causal=causal, bias=bias, scale=scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k)

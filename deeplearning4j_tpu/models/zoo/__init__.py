@@ -1,0 +1,70 @@
+"""Model zoo registry (↔ org.deeplearning4j.zoo.ZooModel + model classes).
+
+The reference zoo couples each architecture with pretrained-weight download;
+with zero egress here the registry provides architecture builders only —
+weights come from checkpoints via serde/ (↔ ZooModel.initPretrained's role
+is played by ModelSerializer.restore).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from deeplearning4j_tpu.models.lenet import lenet, lenet_config
+from deeplearning4j_tpu.models.zoo.classic import (
+    alexnet,
+    alexnet_config,
+    darknet19,
+    darknet19_config,
+    simplecnn,
+    simplecnn_config,
+    text_generation_lstm,
+    text_generation_lstm_config,
+    vgg16,
+    vgg16_config,
+    vgg19,
+    vgg19_config,
+)
+from deeplearning4j_tpu.models.zoo.graphs import (
+    squeezenet,
+    squeezenet_config,
+    unet,
+    unet_config,
+    xception,
+    xception_config,
+)
+from deeplearning4j_tpu.models.zoo.resnet import (
+    resnet50,
+    resnet101,
+    resnet152,
+    resnet_config,
+)
+
+ZOO: Dict[str, Callable] = {
+    "lenet": lenet,
+    "alexnet": alexnet,
+    "vgg16": vgg16,
+    "vgg19": vgg19,
+    "simplecnn": simplecnn,
+    "darknet19": darknet19,
+    "squeezenet": squeezenet,
+    "unet": unet,
+    "xception": xception,
+    "resnet50": resnet50,
+    "resnet101": resnet101,
+    "resnet152": resnet152,
+    "text_generation_lstm": text_generation_lstm,
+}
+
+
+def get_model(name: str, **kw):
+    """↔ ZooModel lookup by name."""
+    try:
+        return ZOO[name.lower()](**kw)
+    except KeyError:
+        raise KeyError(f"unknown zoo model '{name}'; have {sorted(ZOO)}") from None
+
+
+__all__ = ["ZOO", "get_model"] + sorted(
+    n for n in dir() if n.endswith("_config") or n in ZOO
+)

@@ -1,0 +1,190 @@
+"""Classic sequential CNN zoo entries.
+
+ref: org.deeplearning4j.zoo.model.{AlexNet, VGG16, VGG19, SimpleCNN,
+Darknet19, TextGenerationLSTM} — each a MultiLayerNetwork/ComputationGraph
+builder in the reference zoo; here each is a SequentialConfig factory whose
+training step compiles to one XLA program (NHWC layout for the MXU).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, SequentialConfig
+from deeplearning4j_tpu.nn.layers import (
+    LSTM,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    GlobalPooling,
+    LocalResponseNormalization,
+    OutputLayer,
+    Pooling2D,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.model import SequentialModel
+
+
+def alexnet_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
+                   updater=None, seed: int = 12345) -> SequentialConfig:
+    """↔ zoo AlexNet (one-tower variant with LRN, as in the reference zoo)."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    layers = [
+        Conv2D(filters=96, kernel=11, stride=4, padding="SAME", activation="relu"),
+        LocalResponseNormalization(),
+        Pooling2D(pool_type="max", window=3, stride=2),
+        Conv2D(filters=256, kernel=5, stride=1, padding="SAME", activation="relu"),
+        LocalResponseNormalization(),
+        Pooling2D(pool_type="max", window=3, stride=2),
+        Conv2D(filters=384, kernel=3, activation="relu"),
+        Conv2D(filters=384, kernel=3, activation="relu"),
+        Conv2D(filters=256, kernel=3, activation="relu"),
+        Pooling2D(pool_type="max", window=3, stride=2),
+        Flatten(),
+        Dense(units=4096, activation="relu"),
+        Dropout(rate=0.5),
+        Dense(units=4096, activation="relu"),
+        Dropout(rate=0.5),
+        OutputLayer(units=num_classes, activation="softmax", loss="mcxent"),
+    ]
+    return SequentialConfig(net=net, layers=layers, input_shape=input_shape)
+
+
+def _vgg_blocks(spec):
+    layers = []
+    for n_convs, filters in spec:
+        for _ in range(n_convs):
+            layers.append(Conv2D(filters=filters, kernel=3, padding="SAME",
+                                 activation="relu"))
+        layers.append(Pooling2D(pool_type="max", window=2, stride=2))
+    return layers
+
+
+def vgg16_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
+                 updater=None, seed: int = 12345) -> SequentialConfig:
+    """↔ zoo VGG16."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    layers = _vgg_blocks([(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)])
+    layers += [
+        Flatten(),
+        Dense(units=4096, activation="relu"),
+        Dropout(rate=0.5),
+        Dense(units=4096, activation="relu"),
+        Dropout(rate=0.5),
+        OutputLayer(units=num_classes, activation="softmax", loss="mcxent"),
+    ]
+    return SequentialConfig(net=net, layers=layers, input_shape=input_shape)
+
+
+def vgg19_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
+                 updater=None, seed: int = 12345) -> SequentialConfig:
+    """↔ zoo VGG19."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    layers = _vgg_blocks([(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)])
+    layers += [
+        Flatten(),
+        Dense(units=4096, activation="relu"),
+        Dropout(rate=0.5),
+        Dense(units=4096, activation="relu"),
+        Dropout(rate=0.5),
+        OutputLayer(units=num_classes, activation="softmax", loss="mcxent"),
+    ]
+    return SequentialConfig(net=net, layers=layers, input_shape=input_shape)
+
+
+def simplecnn_config(*, num_classes: int = 10, input_shape=(48, 48, 3),
+                     updater=None, seed: int = 12345) -> SequentialConfig:
+    """↔ zoo SimpleCNN (small conv stack used for sanity workloads)."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    layers = [
+        Conv2D(filters=16, kernel=3, activation="relu"),
+        BatchNorm(),
+        Conv2D(filters=16, kernel=3, activation="relu"),
+        BatchNorm(),
+        Pooling2D(pool_type="max", window=2),
+        Conv2D(filters=32, kernel=3, activation="relu"),
+        BatchNorm(),
+        Conv2D(filters=32, kernel=3, activation="relu"),
+        BatchNorm(),
+        Pooling2D(pool_type="max", window=2),
+        Flatten(),
+        Dense(units=128, activation="relu"),
+        Dropout(rate=0.5),
+        OutputLayer(units=num_classes, activation="softmax", loss="mcxent"),
+    ]
+    return SequentialConfig(net=net, layers=layers, input_shape=input_shape)
+
+
+def darknet19_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
+                     updater=None, seed: int = 12345) -> SequentialConfig:
+    """↔ zoo Darknet19 (conv-bn-leakyrelu stacks, global avg pool head)."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+
+    def cb(filters, kernel):
+        return [
+            Conv2D(filters=filters, kernel=kernel, use_bias=False),
+            BatchNorm(activation="leakyrelu"),
+        ]
+
+    layers = []
+    layers += cb(32, 3) + [Pooling2D(pool_type="max", window=2)]
+    layers += cb(64, 3) + [Pooling2D(pool_type="max", window=2)]
+    layers += cb(128, 3) + cb(64, 1) + cb(128, 3)
+    layers += [Pooling2D(pool_type="max", window=2)]
+    layers += cb(256, 3) + cb(128, 1) + cb(256, 3)
+    layers += [Pooling2D(pool_type="max", window=2)]
+    layers += cb(512, 3) + cb(256, 1) + cb(512, 3) + cb(256, 1) + cb(512, 3)
+    layers += [Pooling2D(pool_type="max", window=2)]
+    layers += cb(1024, 3) + cb(512, 1) + cb(1024, 3) + cb(512, 1) + cb(1024, 3)
+    layers += [
+        Conv2D(filters=num_classes, kernel=1),
+        GlobalPooling(pool_type="avg"),
+        OutputLayer(units=num_classes, activation="softmax", loss="mcxent"),
+    ]
+    return SequentialConfig(net=net, layers=layers, input_shape=input_shape)
+
+
+def text_generation_lstm_config(*, vocab_size: int = 77, hidden: int = 256,
+                                seq_len: int = 64, updater=None,
+                                seed: int = 12345,
+                                graves: bool = True) -> SequentialConfig:
+    """↔ zoo TextGenerationLSTM (char-RNN; benchmark config #3 uses the
+    GravesLSTM/peephole variant on the Pallas scan path).
+
+    Input: one-hot chars [N, T, vocab]; output: next-char softmax per step.
+    """
+    from deeplearning4j_tpu.nn.layers import GravesLSTM as GravesLSTMLayer
+
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="xavier")
+    lstm_cls = GravesLSTMLayer if graves else LSTM
+    layers = [
+        lstm_cls(units=hidden, activation="tanh"),
+        lstm_cls(units=hidden, activation="tanh"),
+        RnnOutputLayer(units=vocab_size, activation="softmax", loss="mcxent"),
+    ]
+    return SequentialConfig(net=net, layers=layers,
+                            input_shape=(seq_len, vocab_size))
+
+
+def alexnet(**kw) -> SequentialModel:
+    return SequentialModel(alexnet_config(**kw))
+
+
+def vgg16(**kw) -> SequentialModel:
+    return SequentialModel(vgg16_config(**kw))
+
+
+def vgg19(**kw) -> SequentialModel:
+    return SequentialModel(vgg19_config(**kw))
+
+
+def simplecnn(**kw) -> SequentialModel:
+    return SequentialModel(simplecnn_config(**kw))
+
+
+def darknet19(**kw) -> SequentialModel:
+    return SequentialModel(darknet19_config(**kw))
+
+
+def text_generation_lstm(**kw) -> SequentialModel:
+    return SequentialModel(text_generation_lstm_config(**kw))

@@ -1,0 +1,200 @@
+"""DAG zoo entries: SqueezeNet, U-Net, Xception.
+
+ref: org.deeplearning4j.zoo.model.{SqueezeNet, UNet, Xception} — each a
+ComputationGraph in the reference zoo (fire modules / skip concats /
+separable-conv residual towers). Built here as GraphConfig DAGs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNorm,
+    Conv2D,
+    Dropout,
+    GlobalPooling,
+    OutputLayer,
+    Pooling2D,
+    SeparableConv2D,
+    Upsampling2D,
+)
+from deeplearning4j_tpu.nn.model import GraphModel
+
+
+def _layer(v: Dict[str, GraphVertex], name: str, inp: str, layer) -> str:
+    v[name] = GraphVertex(kind="layer", inputs=[inp], layer=layer)
+    return name
+
+
+# --- SqueezeNet -------------------------------------------------------------
+
+
+def _fire(v: Dict[str, GraphVertex], name: str, inp: str, *, squeeze: int,
+          expand: int) -> str:
+    s = _layer(v, f"{name}_sq", inp,
+               Conv2D(filters=squeeze, kernel=1, activation="relu"))
+    e1 = _layer(v, f"{name}_e1", s,
+                Conv2D(filters=expand, kernel=1, activation="relu"))
+    e3 = _layer(v, f"{name}_e3", s,
+                Conv2D(filters=expand, kernel=3, activation="relu"))
+    v[f"{name}_cat"] = GraphVertex(kind="merge", inputs=[e1, e3])
+    return f"{name}_cat"
+
+
+def squeezenet_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
+                      updater=None, seed: int = 12345) -> GraphConfig:
+    """↔ zoo SqueezeNet v1.1 (fire modules, conv10 head, global avg pool)."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    v: Dict[str, GraphVertex] = {}
+    x = _layer(v, "stem", "input",
+               Conv2D(filters=64, kernel=3, stride=2, activation="relu"))
+    x = _layer(v, "pool1", x, Pooling2D(pool_type="max", window=3, stride=2))
+    x = _fire(v, "fire2", x, squeeze=16, expand=64)
+    x = _fire(v, "fire3", x, squeeze=16, expand=64)
+    x = _layer(v, "pool3", x, Pooling2D(pool_type="max", window=3, stride=2))
+    x = _fire(v, "fire4", x, squeeze=32, expand=128)
+    x = _fire(v, "fire5", x, squeeze=32, expand=128)
+    x = _layer(v, "pool5", x, Pooling2D(pool_type="max", window=3, stride=2))
+    x = _fire(v, "fire6", x, squeeze=48, expand=192)
+    x = _fire(v, "fire7", x, squeeze=48, expand=192)
+    x = _fire(v, "fire8", x, squeeze=64, expand=256)
+    x = _fire(v, "fire9", x, squeeze=64, expand=256)
+    x = _layer(v, "drop9", x, Dropout(rate=0.5))
+    x = _layer(v, "conv10", x,
+               Conv2D(filters=num_classes, kernel=1, activation="relu"))
+    x = _layer(v, "gap", x, GlobalPooling(pool_type="avg"))
+    _layer(v, "output", x,
+           OutputLayer(units=num_classes, activation="softmax", loss="mcxent"))
+    return GraphConfig(net=net, inputs=["input"],
+                       input_shapes={"input": tuple(input_shape)},
+                       vertices=v, outputs=["output"])
+
+
+# --- U-Net ------------------------------------------------------------------
+
+
+def unet_config(*, num_classes: int = 1, input_shape=(128, 128, 3),
+                base_filters: int = 32, depth: int = 4, updater=None,
+                seed: int = 12345) -> GraphConfig:
+    """↔ zoo UNet (encoder-decoder with skip concats; sigmoid mask head).
+
+    ``num_classes=1`` gives the reference's binary-mask head (sigmoid+xent);
+    >1 uses per-pixel softmax.
+    """
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    v: Dict[str, GraphVertex] = {}
+
+    def double_conv(name, inp, filters):
+        a = _layer(v, f"{name}_c1", inp,
+                   Conv2D(filters=filters, kernel=3, activation="relu"))
+        return _layer(v, f"{name}_c2", a,
+                      Conv2D(filters=filters, kernel=3, activation="relu"))
+
+    skips = []
+    x = "input"
+    for d in range(depth):
+        x = double_conv(f"enc{d}", x, base_filters * (2 ** d))
+        skips.append(x)
+        x = _layer(v, f"down{d}", x, Pooling2D(pool_type="max", window=2))
+    x = double_conv("mid", x, base_filters * (2 ** depth))
+    for d in reversed(range(depth)):
+        x = _layer(v, f"up{d}", x, Upsampling2D(scale=2))
+        v[f"cat{d}"] = GraphVertex(kind="merge", inputs=[x, skips[d]])
+        x = double_conv(f"dec{d}", f"cat{d}", base_filters * (2 ** d))
+    from deeplearning4j_tpu.models.zoo.pixel import PixelOutput
+
+    _layer(v, "output", x, PixelOutput(num_classes=num_classes))
+    return GraphConfig(net=net, inputs=["input"],
+                       input_shapes={"input": tuple(input_shape)},
+                       vertices=v, outputs=["output"])
+
+
+# --- Xception ---------------------------------------------------------------
+
+
+def xception_config(*, num_classes: int = 1000, input_shape=(299, 299, 3),
+                    updater=None, seed: int = 12345) -> GraphConfig:
+    """↔ zoo Xception (entry/middle/exit flows of separable convs with
+    residual 1x1-conv shortcuts)."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    v: Dict[str, GraphVertex] = {}
+
+    def sep_bn(name, inp, filters, activation_first=True):
+        src = inp
+        if activation_first:
+            src = _layer(v, f"{name}_act", src, ActivationLayer(activation="relu"))
+        c = _layer(v, f"{name}_sep", src,
+                   SeparableConv2D(filters=filters, kernel=3, use_bias=False))
+        return _layer(v, f"{name}_bn", c, BatchNorm())
+
+    def conv_bn(name, inp, filters, kernel, stride):
+        c = _layer(v, f"{name}_conv", inp,
+                   Conv2D(filters=filters, kernel=kernel, stride=stride,
+                          use_bias=False))
+        return _layer(v, f"{name}_bn", c, BatchNorm(activation="relu"))
+
+    x = conv_bn("stem1", "input", 32, 3, 2)
+    x = conv_bn("stem2", x, 64, 3, 1)
+
+    def entry_block(name, inp, filters, first_act=True):
+        a = sep_bn(f"{name}_s1", inp, filters, activation_first=first_act)
+        b = sep_bn(f"{name}_s2", a, filters)
+        p = _layer(v, f"{name}_pool", b,
+                   Pooling2D(pool_type="max", window=3, stride=2, padding="SAME"))
+        sc = _layer(v, f"{name}_short", inp,
+                    Conv2D(filters=filters, kernel=1, stride=2, use_bias=False))
+        sb = _layer(v, f"{name}_shortbn", sc, BatchNorm())
+        v[f"{name}_add"] = GraphVertex(kind="add", inputs=[p, sb])
+        return f"{name}_add"
+
+    x = entry_block("e1", x, 128, first_act=False)
+    x = entry_block("e2", x, 256)
+    x = entry_block("e3", x, 728)
+
+    for i in range(8):
+        inp = x
+        a = sep_bn(f"m{i}_s1", inp, 728)
+        b = sep_bn(f"m{i}_s2", a, 728)
+        c = sep_bn(f"m{i}_s3", b, 728)
+        v[f"m{i}_add"] = GraphVertex(kind="add", inputs=[c, inp])
+        x = f"m{i}_add"
+
+    a = sep_bn("x1_s1", x, 728)
+    b = sep_bn("x1_s2", a, 1024)
+    p = _layer(v, "x1_pool", b,
+               Pooling2D(pool_type="max", window=3, stride=2, padding="SAME"))
+    sc = _layer(v, "x1_short", x,
+                Conv2D(filters=1024, kernel=1, stride=2, use_bias=False))
+    sb = _layer(v, "x1_shortbn", sc, BatchNorm())
+    v["x1_add"] = GraphVertex(kind="add", inputs=[p, sb])
+    c = _layer(v, "x2_sep", "x1_add",
+               SeparableConv2D(filters=1536, kernel=3, use_bias=False))
+    c = _layer(v, "x2_bn", c, BatchNorm(activation="relu"))
+    c = _layer(v, "x3_sep", c,
+               SeparableConv2D(filters=2048, kernel=3, use_bias=False))
+    c = _layer(v, "x3_bn", c, BatchNorm(activation="relu"))
+    g = _layer(v, "gap", c, GlobalPooling(pool_type="avg"))
+    _layer(v, "output", g,
+           OutputLayer(units=num_classes, activation="softmax", loss="mcxent"))
+    return GraphConfig(net=net, inputs=["input"],
+                       input_shapes={"input": tuple(input_shape)},
+                       vertices=v, outputs=["output"])
+
+
+def squeezenet(**kw) -> GraphModel:
+    return GraphModel(squeezenet_config(**kw))
+
+
+def unet(**kw) -> GraphModel:
+    return GraphModel(unet_config(**kw))
+
+
+def xception(**kw) -> GraphModel:
+    return GraphModel(xception_config(**kw))

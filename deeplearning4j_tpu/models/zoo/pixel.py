@@ -1,0 +1,64 @@
+"""Per-pixel output head for segmentation models (↔ the reference UNet's
+final 1x1-conv + sigmoid/xent CnnLossLayer combination)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.ops import cnn as opscnn
+
+
+@register_config
+@dataclass
+class PixelOutput(LayerConfig):
+    """1x1 conv to ``num_classes`` channels + per-pixel loss.
+
+    num_classes == 1 → sigmoid / binary cross-entropy (mask prediction);
+    num_classes  > 1 → softmax cross-entropy over the channel axis.
+    Labels: [N,H,W,1] binary mask or [N,H,W,C] one-hot.
+    """
+
+    num_classes: int = 1
+
+    def output_shape(self, input_shape):
+        h, w, _ = input_shape
+        return (h, w, self.num_classes)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        w_init = get_initializer("xavier")
+        return {
+            "W": w_init(rng, (1, 1, c, self.num_classes), dtype),
+            "b": jnp.zeros((self.num_classes,), dtype),
+        }, {}
+
+    def _logits(self, params, x):
+        return opscnn.conv2d(x, params["W"], params.get("b"), stride=1,
+                             padding="SAME")
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        logits = self._logits(params, x)
+        if self.num_classes == 1:
+            return jnp.reciprocal(1 + jnp.exp(-logits)), state
+        return jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True)) / jnp.sum(
+            jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True)),
+            axis=-1, keepdims=True), state
+
+    def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
+        logits = self._logits(params, x)
+        if self.num_classes == 1:
+            z = logits[..., 0]
+            y = labels[..., 0] if labels.ndim == 4 else labels
+            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        else:
+            logp = logits - jnp.max(logits, axis=-1, keepdims=True)
+            logp = logp - jnp.log(jnp.sum(jnp.exp(logp), axis=-1, keepdims=True))
+            per = -jnp.sum(labels * logp, axis=-1)
+        if mask is not None:
+            per = per * mask
+            return jnp.sum(per) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.mean(per)

@@ -1,0 +1,125 @@
+"""ResNet family (↔ org.deeplearning4j.zoo.model.ResNet50 — benchmark
+config #2 / #5, the north-star conv model).
+
+The reference builds ResNet-50 as a ComputationGraph with explicit
+merge/shortcut vertices (zoo ResNet50.graphBuilder: conv/bn/act blocks +
+ElementWiseVertex(Add) shortcuts). Here the same DAG is expressed as a
+GraphConfig whose whole forward+backward step compiles to ONE XLA program;
+residual adds are plain vertices fused by XLA, convs hit the MXU as
+conv_general_dilated in NHWC/HWIO layout.
+
+ResNet-v1 bottleneck layout (matches the canonical 50/101/152 definitions):
+7x7/2 stem → 3x3/2 maxpool → stages of bottleneck blocks
+(1x1 f → 3x3 f → 1x1 4f, projection shortcut on stage entry) →
+global avg pool → softmax.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNorm,
+    Conv2D,
+    GlobalPooling,
+    OutputLayer,
+    Pooling2D,
+)
+from deeplearning4j_tpu.nn.model import GraphModel
+
+
+def _conv_bn(vertices: Dict[str, GraphVertex], name: str, inp: str, *,
+             filters: int, kernel, stride=1, activation: str = "relu",
+             padding="SAME") -> str:
+    """conv → bn(+act) pair; returns the output vertex name."""
+    vertices[f"{name}_conv"] = GraphVertex(
+        kind="layer", inputs=[inp],
+        layer=Conv2D(filters=filters, kernel=kernel, stride=stride,
+                     padding=padding, use_bias=False),
+    )
+    vertices[f"{name}_bn"] = GraphVertex(
+        kind="layer", inputs=[f"{name}_conv"],
+        layer=BatchNorm(activation=activation),
+    )
+    return f"{name}_bn"
+
+
+def _bottleneck(vertices: Dict[str, GraphVertex], name: str, inp: str, *,
+                filters: int, stride: int, project: bool) -> str:
+    """1x1 → 3x3 → 1x1(4f) bottleneck with identity/projection shortcut."""
+    a = _conv_bn(vertices, f"{name}_a", inp, filters=filters, kernel=1,
+                 stride=1)
+    b = _conv_bn(vertices, f"{name}_b", a, filters=filters, kernel=3,
+                 stride=stride)
+    c = _conv_bn(vertices, f"{name}_c", b, filters=4 * filters, kernel=1,
+                 stride=1, activation="identity")
+    if project:
+        short = _conv_bn(vertices, f"{name}_proj", inp, filters=4 * filters,
+                         kernel=1, stride=stride, activation="identity")
+    else:
+        short = inp
+    vertices[f"{name}_add"] = GraphVertex(kind="add", inputs=[c, short])
+    vertices[f"{name}_relu"] = GraphVertex(
+        kind="layer", inputs=[f"{name}_add"], layer=ActivationLayer(activation="relu")
+    )
+    return f"{name}_relu"
+
+
+def resnet_config(
+    *,
+    blocks: Sequence[int] = (3, 4, 6, 3),
+    num_classes: int = 1000,
+    input_shape=(224, 224, 3),
+    updater=None,
+    seed: int = 12345,
+    dtype: str = "float32",
+) -> GraphConfig:
+    net = NeuralNetConfiguration(seed=seed, updater=updater, dtype=dtype,
+                                 weight_init="relu")
+    v: Dict[str, GraphVertex] = {}
+    x = _conv_bn(v, "stem", "input", filters=64, kernel=7, stride=2)
+    v["stem_pool"] = GraphVertex(
+        kind="layer", inputs=[x],
+        layer=Pooling2D(pool_type="max", window=3, stride=2, padding="SAME"),
+    )
+    x = "stem_pool"
+    for stage, n_blocks in enumerate(blocks):
+        filters = 64 * (2 ** stage)
+        for block in range(n_blocks):
+            stride = 2 if (stage > 0 and block == 0) else 1
+            x = _bottleneck(
+                v, f"s{stage}b{block}", x,
+                filters=filters, stride=stride, project=(block == 0),
+            )
+    v["avgpool"] = GraphVertex(
+        kind="layer", inputs=[x], layer=GlobalPooling(pool_type="avg")
+    )
+    v["output"] = GraphVertex(
+        kind="layer", inputs=["avgpool"],
+        layer=OutputLayer(units=num_classes, activation="softmax", loss="mcxent"),
+    )
+    return GraphConfig(
+        net=net,
+        inputs=["input"],
+        input_shapes={"input": tuple(input_shape)},
+        vertices=v,
+        outputs=["output"],
+    )
+
+
+def resnet50(**kw) -> GraphModel:
+    return GraphModel(resnet_config(blocks=(3, 4, 6, 3), **kw))
+
+
+def resnet101(**kw) -> GraphModel:
+    return GraphModel(resnet_config(blocks=(3, 4, 23, 3), **kw))
+
+
+def resnet152(**kw) -> GraphModel:
+    return GraphModel(resnet_config(blocks=(3, 8, 36, 3), **kw))

@@ -97,6 +97,10 @@ class RnnOutputLayer(Dense):
         use_logits = (self.loss.lower(), self.activation.lower()) in _LOGIT_LOSSES
         target = pre if use_logits else get_activation(self.activation)(pre)
         per_step = fn(target, labels, reduction="none")  # [N,T]
+        if weights is not None:
+            # Per-example [N] or per-step [N,T] weights.
+            w = weights if weights.ndim == per_step.ndim else weights[:, None]
+            per_step = per_step * w
         if mask is not None:
             per_step = per_step * mask
             return jnp.sum(per_step) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -209,7 +209,10 @@ class Bidirectional(LayerConfig):
         yb, sb = self.layer.apply(
             params["bwd"], state.get("bwd", {}), jnp.flip(x, axis=1), train=train, rng=rng
         )
-        yb = jnp.flip(yb, axis=1)
+        # Re-align the backward pass to forward time order; with
+        # return_sequences=False there is no time axis to flip.
+        if yb.ndim == yf.ndim == 3:
+            yb = jnp.flip(yb, axis=1)
         if self.merge == "concat":
             y = jnp.concatenate([yf, yb], axis=-1)
         elif self.merge == "add":

@@ -22,6 +22,7 @@ flat-vector parity utils (utils/pytree.py) and checkpoint converters align.
 
 from __future__ import annotations
 
+import dataclasses
 import graphlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -44,6 +45,18 @@ _NO_REG_KEYS = {"b", "beta", "gamma", "pI", "pF", "pO", "alpha", "mean", "var"}
 def _layer_name(i: int, cfg: LayerConfig) -> str:
     base = cfg.name or type(cfg).__name__.lower()
     return f"{i}_{base}"
+
+
+def _with_net_weight_init(layer: LayerConfig, net: NeuralNetConfiguration):
+    """Net-level weight_init is the default for layers that don't set their
+    own (↔ NeuralNetConfiguration.Builder.weightInit cascading to layers)."""
+    if (
+        net.weight_init
+        and hasattr(layer, "weight_init")
+        and getattr(layer, "weight_init") is None
+    ):
+        return dataclasses.replace(layer, weight_init=net.weight_init)
+    return layer
 
 
 class SequentialModel:
@@ -70,7 +83,9 @@ class SequentialModel:
         for i, (name, layer) in enumerate(zip(self.layer_names, self.layers)):
             lrng = jax.random.fold_in(rng, i)
             ldtype = jnp.dtype(layer.dtype) if layer.dtype else dtype
-            p, s = layer.init(lrng, self.shapes[i], ldtype)
+            p, s = _with_net_weight_init(layer, self.net).init(
+                lrng, self.shapes[i], ldtype
+            )
             if p:
                 params[name] = p
             if s:
@@ -252,7 +267,9 @@ class GraphModel:
             if v.kind != "layer":
                 continue
             in_shape = self.shapes[v.inputs[0]]
-            p, s = v.layer.init(jax.random.fold_in(rng, i), in_shape, dtype)
+            p, s = _with_net_weight_init(v.layer, self.net).init(
+                jax.random.fold_in(rng, i), in_shape, dtype
+            )
             if p:
                 params[name] = p
             if s:

@@ -77,8 +77,6 @@ def conv2d(
         preferred_element_type=preferred_element_type,
     )
     if b is not None:
-        bshape = [1] * y.ndim
-        bshape[dn.out_spec.index(1) if hasattr(dn, "out_spec") else -1] = b.shape[0]
         if data_format == "NHWC":
             y = y + b.reshape(1, 1, 1, -1)
         else:
@@ -110,7 +108,8 @@ def conv3d(x, w, b=None, *, stride=1, padding="SAME", dilation=1, data_format="N
         dimension_numbers=dn,
     )
     if b is not None:
-        y = y + b.reshape((1,) * 4 + (-1,))
+        y = y + (b.reshape((1,) * 4 + (-1,)) if data_format == "NDHWC"
+                 else b.reshape(1, -1, 1, 1, 1))
     return y
 
 
@@ -123,7 +122,8 @@ def deconv2d(x, w, b=None, *, stride=1, padding="SAME", data_format="NHWC"):
         dimension_numbers=(data_format, "HWIO", data_format),
     )
     if b is not None:
-        y = y + b.reshape(1, 1, 1, -1)
+        y = y + (b.reshape(1, 1, 1, -1) if data_format == "NHWC"
+                 else b.reshape(1, -1, 1, 1))
     return y
 
 

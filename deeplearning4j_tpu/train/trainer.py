@@ -91,10 +91,27 @@ class Trainer:
         self._extra_metrics = extra_metrics
         self._batch_sharding = batch_sharding
 
+        mixed = bool(getattr(self.net, "mixed_precision", False))
+
+        def _to_bf16(tree):
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype == jnp.float32
+                else a,
+                tree,
+            )
+
         def train_step(ts: TrainState, batch) -> tuple[TrainState, Dict[str, jax.Array]]:
             step_rng = jax.random.fold_in(ts.rng, ts.step)
+            if mixed:
+                # bf16 compute / fp32 master params + optimizer state: the
+                # cast sits inside grad, so grads come back fp32 (MXU runs
+                # bf16, accumulation and updates stay fp32).
+                batch = dict(batch, features=_to_bf16(batch["features"]))
 
             def loss_of(params):
+                if mixed:
+                    params = _to_bf16(params)
                 return self.model.loss_fn(params, ts.model_state, batch, rng=step_rng)
 
             (loss, (new_model_state, metrics)), grads = jax.value_and_grad(
@@ -155,6 +172,9 @@ class Trainer:
         for lst in listeners:
             lst.on_fit_start(self, ts)
         stop = False
+        # One host sync up front; after that the step counter is tracked
+        # host-side so the dispatch pipeline never blocks on the device.
+        host_step = int(jax.device_get(ts.step))
         for epoch in range(epochs):
             for lst in listeners:
                 lst.on_epoch_start(epoch)
@@ -166,9 +186,9 @@ class Trainer:
                     batch = jax.device_put(batch, self._batch_sharding)
                 ts, metrics = self.train_step(ts, batch)
                 n += 1
-                step = n  # host-side count; device step is ts.step
+                host_step += 1
                 for lst in listeners:
-                    if lst.on_iteration(epoch, int(jax.device_get(ts.step)), ts, metrics):
+                    if lst.on_iteration(epoch, host_step, ts, metrics):
                         stop = True
                 if steps_per_epoch is not None and n >= steps_per_epoch:
                     break
