@@ -1,35 +1,29 @@
 #!/usr/bin/env python
 """Benchmark driver — prints ONE JSON line with the headline metric.
 
-Round-1 headline: LeNet-5 MNIST training throughput (samples/sec/chip) on
-the attached TPU chip (benchmark config #1; BASELINE.md policy: measured,
-not copied — the reference publishes no numbers, so vs_baseline is the
-ratio against the recorded first measurement in BASELINE.md once it lands).
+Headline: BERT-base MLM pretraining throughput (tokens/sec/chip) on the
+attached TPU chip — north-star workload #4. The reference publishes no
+numbers (BASELINE.md: measured, not copied), so vs_baseline is the ratio
+against the first recorded measurement once BENCH_r1.json lands.
 """
 
 import json
-import sys
 import time
 
 
-def bench_lenet(batch_size: int = 256, warmup: int = 5, iters: int = 30):
+def bench_bert(batch_size: int = 32, seq_len: int = 128, warmup: int = 3,
+               iters: int = 10):
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
-    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.models.bert import bert_base, make_mlm_batch
     from deeplearning4j_tpu.train.trainer import Trainer
-    from deeplearning4j_tpu.train.updaters import Adam
 
-    model = lenet(updater=Adam(1e-3))
+    model = bert_base()
     trainer = Trainer(model)
     ts = trainer.init_state()
-
-    rng = np.random.default_rng(0)
-    x = rng.normal(0.3, 0.25, (batch_size, 28, 28, 1)).astype(np.float32)
-    y = np.zeros((batch_size, 10), np.float32)
-    y[np.arange(batch_size), rng.integers(0, 10, batch_size)] = 1.0
-    batch = {"features": jnp.asarray(x), "labels": jnp.asarray(y)}
+    batch = make_mlm_batch(0, batch_size=batch_size, seq_len=seq_len,
+                           vocab_size=model.config.vocab_size)
+    batch = jax.device_put(batch)
 
     for _ in range(warmup):
         ts, metrics = trainer.train_step(ts, batch)
@@ -41,24 +35,23 @@ def bench_lenet(batch_size: int = 256, warmup: int = 5, iters: int = 30):
     jax.block_until_ready(ts.params)
     dt = time.perf_counter() - t0
 
-    samples_per_sec = batch_size * iters / dt
-    return samples_per_sec
+    return batch_size * seq_len * iters / dt
 
 
 def main():
     try:
-        value = bench_lenet()
+        value = bench_bert()
         result = {
-            "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+            "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
             "value": round(value, 1),
-            "unit": "samples/sec/chip",
+            "unit": "tokens/sec/chip",
             "vs_baseline": 1.0,
         }
     except Exception as e:  # noqa: BLE001 - bench must always emit one line
         result = {
-            "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+            "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
             "value": 0.0,
-            "unit": "samples/sec/chip",
+            "unit": "tokens/sec/chip",
             "vs_baseline": 0.0,
             "error": str(e)[:200],
         }

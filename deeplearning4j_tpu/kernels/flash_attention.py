@@ -10,6 +10,9 @@ matmuls per tile run back-to-back on the MXU.
 Grid: (batch*heads, q_blocks, kv_blocks), kv innermost so the running
 max/denominator/accumulator for one q block live in VMEM scratch across the
 kv sweep. Causal masking skips fully-masked kv blocks via ``pl.when``.
+Per-example key padding masks ([B,S] 1/0 — the BERT attention-mask case)
+are handled *inside* the kernel, so masked batches keep the flash path;
+only arbitrary additive ``bias`` falls back to the XLA reference.
 
 Backward: custom_vjp recomputing through the XLA reference implementation
 (correct by construction; flash backward kernel is a later optimization —
@@ -42,13 +45,21 @@ def _on_tpu() -> bool:
         return False
 
 
-def reference_attention(q, k, v, *, causal=False, bias=None, scale=None):
-    """XLA O(T²) attention; q [B,H,T,D], k/v [B,H,S,D]. fp32 softmax."""
+def reference_attention(q, k, v, *, causal=False, bias=None, key_mask=None,
+                        scale=None):
+    """XLA O(T²) attention; q [B,H,T,D], k/v [B,H,S,D]. fp32 softmax.
+
+    ``key_mask`` [B,S] 1/0 is folded into an additive bias. Fully-masked
+    rows produce uniform attention (softmax of constant) — callers never
+    read those outputs.
+    """
     d = q.shape[-1]
     scale = (d ** -0.5) if scale is None else scale
     s = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) * scale
     if bias is not None:
         s = s + bias
+    if key_mask is not None:
+        s = s + jnp.where(key_mask[:, None, None, :] > 0, 0.0, _NEG_INF)
     if causal:
         t_len, s_len = s.shape[-2], s.shape[-1]
         idx_t = jnp.arange(t_len)[:, None]
@@ -58,8 +69,8 @@ def reference_attention(q, k, v, *, causal=False, bias=None, scale=None):
     return jnp.einsum("bhts,bhsd->bhtd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale, causal, block_q, block_k, seq_q, seq_k):
+def _flash_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale, causal, has_mask, block_q, block_k, seq_q, seq_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -83,9 +94,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        # Mask key padding (seq_k tail) and the causal triangle.
+        # Mask key padding (seq_k tail + per-example mask) and the causal
+        # triangle.
         key_idx = k_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = key_idx < seq_k
+        if has_mask:
+            mask = mask & (km_ref[0] > 0)  # [1, bk] broadcasts over rows
         if causal:
             query_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             mask = mask & (query_idx + (seq_k - seq_q) >= key_idx)
@@ -93,7 +107,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # Explicitly zero masked probabilities: in a fully-masked block
+        # m_new stays _NEG_INF and exp(s - m_new) would be 1, not 0.
+        p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -105,9 +121,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(ki == n_k - 1)
     def _finish():
+        # Fully-masked rows: l == 0 → output 0 (callers never read them).
         o_ref[0] = (
             acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
         ).astype(o_ref.dtype)
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
 
 
 def _pad_to(x, axis, multiple):
@@ -120,11 +141,12 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
-def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, key_mask, *, causal, scale, block_q, block_k):
     b, h, t, d = q.shape
     s_len = k.shape[2]
-    block_q = min(block_q, max(t, 8))
-    block_k = min(block_k, max(s_len, 128))
+    # Blocks stay (8,128)-tile-aligned even for short sequences.
+    block_q = min(block_q, _round_up(t, 8))
+    block_k = min(block_k, _round_up(s_len, 128))
 
     qp = _pad_to(_pad_to(q.reshape(b * h, t, d), 1, block_q), 2, 128)
     kp = _pad_to(_pad_to(k.reshape(b * h, s_len, d), 1, block_k), 2, 128)
@@ -132,9 +154,22 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
     dp = qp.shape[-1]
     tq, tk = qp.shape[1], kp.shape[1]
 
+    has_mask = key_mask is not None
+    if has_mask:
+        km = _pad_to(key_mask.astype(jnp.float32), 1, block_k)  # [B, tk]
+        # [B*H, 1, tk] — tiny; the unit middle dim keeps the Mosaic block
+        # shape (1, 1, block_k) legal (second-minor equals the array dim).
+        km = jnp.repeat(km, h, axis=0)[:, None, :]
+    else:
+        km = jnp.ones((b * h, 1, 1), jnp.float32)  # placeholder operand
+
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_q=t, seq_k=s_len,
+        _flash_kernel, scale=scale, causal=causal, has_mask=has_mask,
+        block_q=block_q, block_k=block_k, seq_q=t, seq_k=s_len,
+    )
+    km_block = block_k if has_mask else 1
+    km_index = (lambda bh, qi, ki: (bh, 0, ki)) if has_mask else (
+        lambda bh, qi, ki: (bh, 0, 0)
     )
     grid = (b * h, tq // block_q, tk // block_k)
     out = pl.pallas_call(
@@ -144,6 +179,7 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
             pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, 1, km_block), km_index),
         ],
         out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
@@ -153,42 +189,48 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
             pltpu.VMEM((block_q, dp), jnp.float32),
         ],
         interpret=not _on_tpu(),
-    )(qp, kp, vp)
+    )(qp, kp, vp, km)
     return out[:, :t, :d].reshape(b, h, t, d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal=causal, scale=scale,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, key_mask, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, key_mask, causal=causal, scale=scale,
                       block_q=block_q, block_k=block_k)
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
-    out = _flash(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+def _flash_vjp_fwd(q, k, v, key_mask, causal, scale, block_q, block_k):
+    out = _flash(q, k, v, key_mask, causal, scale, block_q, block_k)
+    return out, (q, k, v, key_mask)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, key_mask = res
     _, vjp = jax.vjp(
-        lambda q, k, v: reference_attention(q, k, v, causal=causal, scale=scale),
+        lambda q, k, v: reference_attention(
+            q, k, v, causal=causal, scale=scale, key_mask=key_mask
+        ),
         q, k, v,
     )
-    return vjp(g)
+    dq, dk, dv = vjp(g)
+    dkm = jnp.zeros_like(key_mask) if key_mask is not None else None
+    return dq, dk, dv, dkm
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale=None, bias=None,
-                    block_q: int = 256, block_k: int = 256):
+                    key_mask=None, block_q: int = 256, block_k: int = 256):
     """Blockwise attention; q [B,H,T,D], k/v [B,H,S,D] → [B,H,T,D].
 
-    ``bias`` (additive logits mask, e.g. padding) forces the XLA fallback —
-    the kernel covers the unbiased and causal fast paths.
+    ``key_mask`` [B,S] 1/0 (padding mask) runs inside the kernel — the
+    BERT path keeps the flash fast path. Arbitrary additive ``bias``
+    forces the XLA fallback.
     """
     d = q.shape[-1]
     scale = (d ** -0.5) if scale is None else scale
     if bias is not None or q.shape[2] < 8 or not _HAS_PLTPU:
-        return reference_attention(q, k, v, causal=causal, bias=bias, scale=scale)
-    return _flash(q, k, v, causal, scale, block_q, block_k)
+        return reference_attention(q, k, v, causal=causal, bias=bias,
+                                   key_mask=key_mask, scale=scale)
+    return _flash(q, k, v, key_mask, causal, scale, block_q, block_k)

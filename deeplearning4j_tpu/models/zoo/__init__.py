@@ -60,9 +60,10 @@ ZOO: Dict[str, Callable] = {
 def get_model(name: str, **kw):
     """↔ ZooModel lookup by name."""
     try:
-        return ZOO[name.lower()](**kw)
+        fn = ZOO[name.lower()]
     except KeyError:
         raise KeyError(f"unknown zoo model '{name}'; have {sorted(ZOO)}") from None
+    return fn(**kw)
 
 
 __all__ = ["ZOO", "get_model"] + sorted(

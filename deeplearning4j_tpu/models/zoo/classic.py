@@ -18,6 +18,7 @@ from deeplearning4j_tpu.nn.layers import (
     Flatten,
     GlobalPooling,
     LocalResponseNormalization,
+    LossLayer,
     OutputLayer,
     Pooling2D,
     RnnOutputLayer,
@@ -139,7 +140,8 @@ def darknet19_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
     layers += [
         Conv2D(filters=num_classes, kernel=1),
         GlobalPooling(pool_type="avg"),
-        OutputLayer(units=num_classes, activation="softmax", loss="mcxent"),
+        # conv10 already maps to num_classes — parameter-free softmax head
+        LossLayer(activation="softmax", loss="mcxent"),
     ]
     return SequentialConfig(net=net, layers=layers, input_shape=input_shape)
 

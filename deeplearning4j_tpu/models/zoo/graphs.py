@@ -20,6 +20,7 @@ from deeplearning4j_tpu.nn.layers import (
     Conv2D,
     Dropout,
     GlobalPooling,
+    LossLayer,
     OutputLayer,
     Pooling2D,
     SeparableConv2D,
@@ -71,7 +72,7 @@ def squeezenet_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
                Conv2D(filters=num_classes, kernel=1, activation="relu"))
     x = _layer(v, "gap", x, GlobalPooling(pool_type="avg"))
     _layer(v, "output", x,
-           OutputLayer(units=num_classes, activation="softmax", loss="mcxent"))
+           LossLayer(activation="softmax", loss="mcxent"))
     return GraphConfig(net=net, inputs=["input"],
                        input_shapes={"input": tuple(input_shape)},
                        vertices=v, outputs=["output"])

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.config import LayerConfig, register_config
 from deeplearning4j_tpu.nn.initializers import get_initializer
 from deeplearning4j_tpu.ops import cnn as opscnn
+from deeplearning4j_tpu.ops import loss as losses
+from deeplearning4j_tpu.ops import nn as opsnn
 
 
 @register_config
@@ -43,21 +45,16 @@ class PixelOutput(LayerConfig):
     def apply(self, params, state, x, *, train=False, rng=None):
         logits = self._logits(params, x)
         if self.num_classes == 1:
-            return jnp.reciprocal(1 + jnp.exp(-logits)), state
-        return jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True)) / jnp.sum(
-            jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True)),
-            axis=-1, keepdims=True), state
+            return opsnn.sigmoid(logits), state
+        return opsnn.softmax(logits), state
 
     def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
         logits = self._logits(params, x)
         if self.num_classes == 1:
-            z = logits[..., 0]
-            y = labels[..., 0] if labels.ndim == 4 else labels
-            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+            y = labels if labels.ndim == 4 else labels[..., None]
+            per = losses.binary_cross_entropy(logits, y, reduction="none")
         else:
-            logp = logits - jnp.max(logits, axis=-1, keepdims=True)
-            logp = logp - jnp.log(jnp.sum(jnp.exp(logp), axis=-1, keepdims=True))
-            per = -jnp.sum(labels * logp, axis=-1)
+            per = losses.softmax_cross_entropy(logits, labels, reduction="none")
         if mask is not None:
             per = per * mask
             return jnp.sum(per) / jnp.maximum(jnp.sum(mask), 1.0)

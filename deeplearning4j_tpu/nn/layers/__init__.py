@@ -14,6 +14,12 @@ from deeplearning4j_tpu.nn.layers.conv import (
     Upsampling2D,
     ZeroPadding2D,
 )
+from deeplearning4j_tpu.nn.layers.attention import (
+    LearnedSelfAttention,
+    PositionalEmbedding,
+    SelfAttention,
+    TransformerEncoderBlock,
+)
 from deeplearning4j_tpu.nn.layers.core import (
     ActivationLayer,
     Dense,
@@ -48,4 +54,6 @@ __all__ = [
     "BatchNorm", "LayerNorm", "LocalResponseNormalization",
     "LossLayer", "OutputLayer", "RnnOutputLayer",
     "GRU", "LSTM", "Bidirectional", "GravesLSTM", "LastTimeStep", "SimpleRnn",
+    "SelfAttention", "LearnedSelfAttention", "TransformerEncoderBlock",
+    "PositionalEmbedding",
 ]

@@ -1,0 +1,255 @@
+"""Attention layers.
+
+ref: org.deeplearning4j.nn.conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer} and
+org.deeplearning4j.nn.conf.graph.AttentionVertex, all backed by the libnd4j
+``multi_head_dot_product_attention`` op (O(T²) HBM score matrix, SURVEY
+§5.7). Here attention lowers to the Pallas blockwise flash kernel
+(kernels/flash_attention.py) — O(T·D) memory, MXU-tiled — with an XLA
+fallback for biased/masked paths.
+
+Layout convention: sequences are [N, T, E] (batch, time, embed) — the
+TPU-friendly layout where the embed axis maps to lanes. The reference uses
+[N, E, T] for RNN activations; converters in the Keras-import module handle
+the transpose.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.kernels.flash_attention import flash_attention
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.ops import nn as opsnn
+
+
+def _split_heads(x, num_heads):
+    n, t, e = x.shape
+    return x.reshape(n, t, num_heads, e // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    n, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(n, t, h * d)
+
+
+def mask_to_bias(mask, dtype=jnp.float32):
+    """[N,S] 1/0 key mask → additive [N,1,1,S] logit bias."""
+    return jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30).astype(dtype)
+
+
+@register_config
+@dataclass
+class SelfAttention(LayerConfig):
+    """↔ SelfAttentionLayer (multi-head dot-product self-attention with
+    learned Q/K/V/O projections).
+
+    nIn inferred from input shape; ``head_size`` defaults to nOut/num_heads.
+    ``causal`` adds the autoregressive triangle (capability superset — the
+    reference layer is bidirectional only).
+    """
+
+    num_heads: int = 1
+    out_size: int = 0  # nOut; 0 → same as input embed size
+    head_size: Optional[int] = None
+    causal: bool = False
+    dropout: float = 0.0
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def _dims(self, e):
+        out = self.out_size or e
+        hd = self.head_size or out // self.num_heads
+        return out, hd
+
+    def output_shape(self, input_shape):
+        t, e = input_shape
+        out, _ = self._dims(e)
+        return (t, out)
+
+    def init(self, rng, input_shape, dtype):
+        e = input_shape[-1]
+        out, hd = self._dims(e)
+        proj = self.num_heads * hd
+        w_init = get_initializer(self.weight_init or "xavier")
+        ks = jax.random.split(rng, 4)
+        params = {
+            "Wq": w_init(ks[0], (e, proj), dtype),
+            "Wk": w_init(ks[1], (e, proj), dtype),
+            "Wv": w_init(ks[2], (e, proj), dtype),
+            "Wo": w_init(ks[3], (proj, out), dtype),
+        }
+        if self.use_bias:
+            params.update(
+                bq=jnp.zeros((proj,), dtype), bk=jnp.zeros((proj,), dtype),
+                bv=jnp.zeros((proj,), dtype), bo=jnp.zeros((out,), dtype),
+            )
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        q = opsnn.linear(x, params["Wq"], params.get("bq"))
+        k = opsnn.linear(x, params["Wk"], params.get("bk"))
+        v = opsnn.linear(x, params["Wv"], params.get("bv"))
+        h = self.num_heads
+        y = flash_attention(
+            _split_heads(q, h), _split_heads(k, h), _split_heads(v, h),
+            causal=self.causal, key_mask=mask,
+        )
+        y = _merge_heads(y)
+        if train and self.dropout > 0.0 and rng is not None:
+            y = opsnn.dropout(y, self.dropout, rng)
+        return opsnn.linear(y, params["Wo"], params.get("bo")), state
+
+
+@register_config
+@dataclass
+class LearnedSelfAttention(SelfAttention):
+    """↔ LearnedSelfAttentionLayer: attention against ``n_queries`` learned
+    query vectors — output is [N, n_queries, out] regardless of T."""
+
+    n_queries: int = 1
+
+    def output_shape(self, input_shape):
+        t, e = input_shape
+        out, _ = self._dims(e)
+        return (self.n_queries, out)
+
+    def init(self, rng, input_shape, dtype):
+        params, state = SelfAttention.init(self, rng, input_shape, dtype)
+        e = input_shape[-1]
+        _, hd = self._dims(e)
+        proj = self.num_heads * hd
+        qrng = jax.random.fold_in(rng, 17)
+        params["Q"] = get_initializer(self.weight_init or "xavier")(
+            qrng, (self.n_queries, proj), dtype
+        )
+        del params["Wq"]
+        params.pop("bq", None)
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        n = x.shape[0]
+        q = jnp.broadcast_to(params["Q"], (n, *params["Q"].shape))
+        k = opsnn.linear(x, params["Wk"], params.get("bk"))
+        v = opsnn.linear(x, params["Wv"], params.get("bv"))
+        h = self.num_heads
+        y = flash_attention(
+            _split_heads(q, h), _split_heads(k, h), _split_heads(v, h),
+            key_mask=mask,
+        )
+        y = _merge_heads(y)
+        if train and self.dropout > 0.0 and rng is not None:
+            y = opsnn.dropout(y, self.dropout, rng)
+        return opsnn.linear(y, params["Wo"], params.get("bo")), state
+
+
+@register_config
+@dataclass
+class TransformerEncoderBlock(LayerConfig):
+    """Pre/post-LN transformer encoder block: MHA + residual + LN, then
+    FFN(intermediate, activation) + residual + LN.
+
+    Capability superset of the reference (which composes SelfAttentionLayer
+    manually); the BERT model family builds on this block. post_ln=True
+    matches original BERT.
+    """
+
+    num_heads: int = 8
+    intermediate: int = 0  # FFN hidden; 0 → 4×embed
+    activation: str = "gelu"
+    dropout: float = 0.0
+    attention_dropout: float = 0.0
+    causal: bool = False
+    post_ln: bool = True
+    eps: float = 1e-12
+    weight_init: Optional[str] = None
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def init(self, rng, input_shape, dtype):
+        e = input_shape[-1]
+        inter = self.intermediate or 4 * e
+        w_init = get_initializer(self.weight_init or "xavier")
+        ks = jax.random.split(rng, 8)
+        att = SelfAttention(
+            num_heads=self.num_heads, causal=self.causal,
+            dropout=self.attention_dropout, weight_init=self.weight_init,
+        )
+        att_p, _ = att.init(ks[0], input_shape, dtype)
+        params = {
+            "attention": att_p,
+            "W1": w_init(ks[1], (e, inter), dtype),
+            "b1": jnp.zeros((inter,), dtype),
+            "W2": w_init(ks[2], (inter, e), dtype),
+            "b2": jnp.zeros((e,), dtype),
+            "ln1_gamma": jnp.ones((e,), dtype), "ln1_beta": jnp.zeros((e,), dtype),
+            "ln2_gamma": jnp.ones((e,), dtype), "ln2_beta": jnp.zeros((e,), dtype),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        att = SelfAttention(
+            num_heads=self.num_heads, causal=self.causal,
+            dropout=self.attention_dropout,
+        )
+        r1, r2, r3 = (
+            jax.random.split(rng, 3) if rng is not None else (None, None, None)
+        )
+
+        def ln(h, which):
+            return opsnn.layer_norm(
+                h, params[f"{which}_gamma"], params[f"{which}_beta"], eps=self.eps
+            )
+
+        if self.post_ln:  # original-BERT residual order
+            a, _ = att.apply(params["attention"], {}, x, train=train, rng=r1, mask=mask)
+            if train and self.dropout > 0.0 and r2 is not None:
+                a = opsnn.dropout(a, self.dropout, r2)
+            x = ln(x + a, "ln1")
+            f = opsnn.linear(x, params["W1"], params["b1"])
+            f = get_activation(self.activation)(f)
+            f = opsnn.linear(f, params["W2"], params["b2"])
+            if train and self.dropout > 0.0 and r3 is not None:
+                f = opsnn.dropout(f, self.dropout, r3)
+            return ln(x + f, "ln2"), state
+        # pre-LN (more stable for deep stacks)
+        a_in = ln(x, "ln1")
+        a, _ = att.apply(params["attention"], {}, a_in, train=train, rng=r1, mask=mask)
+        if train and self.dropout > 0.0 and r2 is not None:
+            a = opsnn.dropout(a, self.dropout, r2)
+        x = x + a
+        f_in = ln(x, "ln2")
+        f = opsnn.linear(f_in, params["W1"], params["b1"])
+        f = get_activation(self.activation)(f)
+        f = opsnn.linear(f, params["W2"], params["b2"])
+        if train and self.dropout > 0.0 and r3 is not None:
+            f = opsnn.dropout(f, self.dropout, r3)
+        return x + f, state
+
+
+@register_config
+@dataclass
+class PositionalEmbedding(LayerConfig):
+    """Learned absolute position embeddings added to [N,T,E] input
+    (BERT-style; capability superset — the reference has no positional
+    embedding layer)."""
+
+    max_len: int = 512
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def init(self, rng, input_shape, dtype):
+        e = input_shape[-1]
+        return {"P": 0.02 * jax.random.normal(rng, (self.max_len, e), dtype)}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        t = x.shape[1]
+        return x + params["P"][:t][None, :, :], state

@@ -138,3 +138,44 @@ def tp_spec_for_path(path: str, rank: int) -> P:
         if sub in path:
             return factory(rank)
     return P()
+
+
+# Megatron split table keyed on the framework's own param names (models/bert.py
+# layout): qkv & FFN-up column-split, attn-out & FFN-down row-split, word
+# embedding vocab-split. Biases of column-split weights follow the split.
+_BERT_TP_TABLE = {
+    "Wq": -1, "Wk": -1, "Wv": -1, "W1": -1,   # column (last dim on 'model')
+    "bq": 0, "bk": 0, "bv": 0, "b1": 0,        # 1-d biases of column splits
+    "Wo": 0, "W2": 0,                           # row (first dim on 'model')
+    "word": 0,                                  # vocab split
+}
+
+
+def tensor_parallel_plan(mesh: Mesh, params_template: Any, *,
+                         table: Optional[dict] = None):
+    """P7 equivalent: per-leaf Megatron-style sharding tree for transformer
+    params (matches models/bert.py param naming). Leaves whose split dim is
+    not divisible by the 'model' axis size stay replicated — GSPMD then
+    still produces a correct program, just without that split.
+
+    Returns (params_sharding_tree, batch_sharding).
+    """
+    table = table if table is not None else _BERT_TP_TABLE
+    tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(MODEL_AXIS, 1)
+
+    def spec_for(path, leaf):
+        if tp_size == 1:
+            return NamedSharding(mesh, P())
+        key = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        dim = table.get(key)
+        if dim is None:
+            return NamedSharding(mesh, P())
+        dim = dim % leaf.ndim
+        if leaf.shape[dim] % tp_size != 0:
+            return NamedSharding(mesh, P())
+        spec = [None] * leaf.ndim
+        spec[dim] = MODEL_AXIS
+        return NamedSharding(mesh, P(*spec))
+
+    shardings = jax.tree_util.tree_map_with_path(spec_for, params_template)
+    return shardings, batch_spec(mesh)

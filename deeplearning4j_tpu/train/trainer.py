@@ -122,7 +122,8 @@ class Trainer:
             new_params = apply_updates(ts.params, updates)
             metrics = dict(metrics)
             metrics["total_loss"] = loss
-            metrics["batch_size"] = jnp.asarray(batch["features"].shape[0])
+            feats = jax.tree_util.tree_leaves(batch["features"])
+            metrics["batch_size"] = jnp.asarray(feats[0].shape[0])
             if self._extra_metrics is not None:
                 metrics.update(self._extra_metrics(new_params, batch))
             new_ts = TrainState(

@@ -28,7 +28,7 @@ def test_lenet_learns_and_evaluates():
     score0 = model.score(trainer.variables(ts), {"features": jnp.asarray(xtr[:64]),
                                                  "labels": jnp.asarray(ytr[:64])})
     listener = ScoreIterationListener(every=4)
-    ts = trainer.fit(ts, AsyncDataSetIterator(it), epochs=4, listeners=[listener])
+    ts = trainer.fit(ts, AsyncDataSetIterator(it), epochs=6, listeners=[listener])
 
     score1 = model.score(trainer.variables(ts), {"features": jnp.asarray(xtr[:64]),
                                                  "labels": jnp.asarray(ytr[:64])})
