@@ -1,1 +1,37 @@
-"""Subpackage."""
+"""Graph autodiff layer (↔ SameDiff, SURVEY §2.3).
+
+- samediff: define-by-run graph building, whole-graph XLA compile, grad,
+  training, save/load, StableHLO export.
+- validation: finite-difference gradient checking + op coverage ledger
+  (↔ OpValidation/GradCheckUtil).
+"""
+
+from deeplearning4j_tpu.autodiff.samediff import (
+    OP_REGISTRY,
+    OpNode,
+    SameDiff,
+    SDVariable,
+    TrainingConfig,
+    VariableType,
+    register_op,
+)
+from deeplearning4j_tpu.autodiff.validation import (
+    check_gradients,
+    check_samediff_gradients,
+    coverage_report,
+    register_validated,
+)
+
+__all__ = [
+    "SameDiff",
+    "SDVariable",
+    "VariableType",
+    "TrainingConfig",
+    "OpNode",
+    "OP_REGISTRY",
+    "register_op",
+    "check_gradients",
+    "check_samediff_gradients",
+    "coverage_report",
+    "register_validated",
+]

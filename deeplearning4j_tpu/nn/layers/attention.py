@@ -39,11 +39,6 @@ def _merge_heads(x):
     return x.transpose(0, 2, 1, 3).reshape(n, t, h * d)
 
 
-def mask_to_bias(mask, dtype=jnp.float32):
-    """[N,S] 1/0 key mask → additive [N,1,1,S] logit bias."""
-    return jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30).astype(dtype)
-
-
 @register_config
 @dataclass
 class SelfAttention(LayerConfig):

@@ -322,8 +322,22 @@ class NoOp:
         return init, update
 
 
-def resolve_updater(cfg):
-    """None → Sgd(0.01); updater configs pass through."""
+_BY_NAME = {
+    "sgd": Sgd, "nesterovs": Nesterovs, "adam": Adam, "adamw": AdamW,
+    "amsgrad": AMSGrad, "nadam": Nadam, "adamax": AdaMax, "adagrad": AdaGrad,
+    "adadelta": AdaDelta, "rmsprop": RmsProp, "noop": NoOp,
+}
+
+
+def resolve_updater(cfg, **kwargs):
+    """None → Sgd(0.01); updater configs pass through; a string name builds
+    from the registry (``learning_rate``/``lr`` kwargs accepted) — the
+    serializable path used by autodiff TrainingConfig."""
     if cfg is None:
         return Sgd(0.01)
+    if isinstance(cfg, str):
+        cls = _BY_NAME[cfg.lower()]
+        if "learning_rate" in kwargs:
+            kwargs["lr"] = kwargs.pop("learning_rate")
+        return cls(**kwargs)
     return cfg
