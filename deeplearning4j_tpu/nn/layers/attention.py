@@ -57,6 +57,19 @@ class SelfAttention(LayerConfig):
     dropout: float = 0.0
     weight_init: Optional[str] = None
     use_bias: bool = True
+    # "ring" | "ulysses" | None — sequence/context parallelism (P9). Takes
+    # effect when a sequence mesh is active (parallel.sequence.sequence_mesh);
+    # the mesh is captured at trace time (see sharded_attention docstring).
+    sequence_parallel: Optional[str] = None
+
+    def __post_init__(self):
+        if self.sequence_parallel is not None:
+            from deeplearning4j_tpu.parallel.sequence import VALID_SP_IMPLS
+
+            if self.sequence_parallel not in VALID_SP_IMPLS:
+                raise ValueError(
+                    f"sequence_parallel={self.sequence_parallel!r}; "
+                    f"valid: {VALID_SP_IMPLS}")
 
     def _dims(self, e):
         out = self.out_size or e
@@ -92,10 +105,14 @@ class SelfAttention(LayerConfig):
         k = opsnn.linear(x, params["Wk"], params.get("bk"))
         v = opsnn.linear(x, params["Wv"], params.get("bv"))
         h = self.num_heads
-        y = flash_attention(
-            _split_heads(q, h), _split_heads(k, h), _split_heads(v, h),
-            causal=self.causal, key_mask=mask,
-        )
+        qh, kh, vh = _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
+        if self.sequence_parallel:
+            from deeplearning4j_tpu.parallel.sequence import sharded_attention
+
+            y = sharded_attention(qh, kh, vh, impl=self.sequence_parallel,
+                                  causal=self.causal, key_mask=mask)
+        else:
+            y = flash_attention(qh, kh, vh, causal=self.causal, key_mask=mask)
         y = _merge_heads(y)
         if train and self.dropout > 0.0 and rng is not None:
             y = opsnn.dropout(y, self.dropout, rng)
@@ -109,6 +126,14 @@ class LearnedSelfAttention(SelfAttention):
     query vectors — output is [N, n_queries, out] regardless of T."""
 
     n_queries: int = 1
+
+    def __post_init__(self):
+        if self.sequence_parallel is not None:
+            # Learned queries are n_queries long, not sequence-sharded;
+            # refuse rather than silently running full-sequence attention.
+            raise ValueError(
+                "LearnedSelfAttention does not support sequence_parallel "
+                "(queries are learned, not sequence-sharded)")
 
     def output_shape(self, input_shape):
         t, e = input_shape
@@ -164,6 +189,7 @@ class TransformerEncoderBlock(LayerConfig):
     post_ln: bool = True
     eps: float = 1e-12
     weight_init: Optional[str] = None
+    sequence_parallel: Optional[str] = None  # threaded to inner SelfAttention
 
     def output_shape(self, input_shape):
         return tuple(input_shape)
@@ -176,6 +202,7 @@ class TransformerEncoderBlock(LayerConfig):
         att = SelfAttention(
             num_heads=self.num_heads, causal=self.causal,
             dropout=self.attention_dropout, weight_init=self.weight_init,
+            sequence_parallel=self.sequence_parallel,
         )
         att_p, _ = att.init(ks[0], input_shape, dtype)
         params = {
@@ -193,6 +220,7 @@ class TransformerEncoderBlock(LayerConfig):
         att = SelfAttention(
             num_heads=self.num_heads, causal=self.causal,
             dropout=self.attention_dropout,
+            sequence_parallel=self.sequence_parallel,
         )
         r1, r2, r3 = (
             jax.random.split(rng, 3) if rng is not None else (None, None, None)

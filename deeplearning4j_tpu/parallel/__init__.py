@@ -1,1 +1,41 @@
-"""Subpackage."""
+"""Parallelism layer (↔ L5 scaleout + parameter server, SURVEY §2.6).
+
+Every reference strategy maps to a placement policy + XLA collectives:
+
+- specs: partition-spec tables (DP P1–P4, FSDP P11, TP P7)
+- sequence: ring attention + Ulysses all-to-all (P9 — new capability)
+"""
+
+from deeplearning4j_tpu.parallel.specs import (
+    batch_spec,
+    data_parallel_plan,
+    fsdp_plan,
+    replicated,
+    tensor_parallel_plan,
+    train_state_sharding,
+)
+from deeplearning4j_tpu.parallel.sequence import (
+    get_sequence_mesh,
+    ring_attention,
+    sequence_mesh,
+    sequence_sharded_spec,
+    set_sequence_mesh,
+    sharded_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "batch_spec",
+    "data_parallel_plan",
+    "fsdp_plan",
+    "replicated",
+    "tensor_parallel_plan",
+    "train_state_sharding",
+    "ring_attention",
+    "ulysses_attention",
+    "sharded_attention",
+    "sequence_mesh",
+    "set_sequence_mesh",
+    "get_sequence_mesh",
+    "sequence_sharded_spec",
+]
