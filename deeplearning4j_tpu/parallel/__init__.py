@@ -4,6 +4,8 @@ Every reference strategy maps to a placement policy + XLA collectives:
 
 - specs: partition-spec tables (DP P1–P4, FSDP P11, TP P7)
 - sequence: ring attention + Ulysses all-to-all (P9 — new capability)
+- pipeline: GPipe-style microbatched stage pipeline (P8 — new capability)
+- inference: replicated-model serving with dynamic batching (P6)
 """
 
 from deeplearning4j_tpu.parallel.specs import (
@@ -23,6 +25,12 @@ from deeplearning4j_tpu.parallel.sequence import (
     sharded_attention,
     ulysses_attention,
 )
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+    stage_params_sharding,
+)
+from deeplearning4j_tpu.parallel.inference import ParallelInference
 
 __all__ = [
     "batch_spec",
@@ -38,4 +46,8 @@ __all__ = [
     "set_sequence_mesh",
     "get_sequence_mesh",
     "sequence_sharded_spec",
+    "pipeline_apply",
+    "stack_stage_params",
+    "stage_params_sharding",
+    "ParallelInference",
 ]

@@ -1,0 +1,201 @@
+"""Parallel inference serving (P6).
+
+ref: org.deeplearning4j.parallelism.ParallelInference — N model replicas on
+N devices, a request queue, worker threads, and optional dynamic batching
+(InferenceMode.BATCHED via BatchedInferenceObservable) (SURVEY §2.6 P6,
+§3.5). TPU translation: the "replica" is one compiled executable placed per
+device (compile once — PJRT executables are device-agnostic within a
+platform); worker threads drain a shared queue; BATCHED mode coalesces
+queued requests up to max_batch_size before dispatch, splitting results
+back per caller.
+
+The GIL is not a bottleneck: device execution releases it, so N host
+threads keep N chips busy, same as the reference's Java worker threads.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Request:
+    __slots__ = ("inputs", "event", "result", "error", "cancelled")
+
+    def __init__(self, inputs):
+        self.inputs = inputs
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.cancelled = False
+
+
+class ParallelInference:
+    """Replicated-model inference server (↔ ParallelInference builder).
+
+    forward: (variables, features) -> outputs, pure (jit-compiled; one
+    compilation per distinct input shape per device). ``mode``: "instant"
+    dispatches each request alone; "batched" coalesces queued requests up
+    to ``max_batch_size`` rows and pads the coalesced batch to a
+    power-of-two bucket so compilation count stays bounded under traffic
+    with varying request sizes. Features must be a single array whose
+    non-leading dims agree across requests.
+
+    Usage::
+
+        pi = ParallelInference(model.forward, variables,
+                               devices=jax.devices(), mode="batched")
+        y = pi.output(x)          # thread-safe, blocking
+        pi.shutdown()
+    """
+
+    def __init__(
+        self,
+        forward: Callable[[Any, Any], Any],
+        variables: Any,
+        *,
+        devices: Optional[Sequence] = None,
+        mode: str = "instant",
+        max_batch_size: int = 32,
+        queue_limit: int = 256,
+    ):
+        if mode not in ("instant", "batched"):
+            raise ValueError(f"mode {mode!r}; valid: instant|batched")
+        self._devices = list(devices) if devices is not None else jax.devices()
+        self._mode = mode
+        self._max_batch = max_batch_size
+        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue(queue_limit)
+        self._fn = jax.jit(forward)
+        # One replica of the variables per device (↔ model.clone() per GPU —
+        # but here it's the same immutable buffers, transferred not cloned).
+        self._replicas = [
+            jax.device_put(variables, d) for d in self._devices
+        ]
+        self._workers: List[threading.Thread] = []
+        self._running = True
+        for i, dev in enumerate(self._devices):
+            th = threading.Thread(
+                target=self._worker, args=(i, dev), daemon=True,
+                name=f"parallel-inference-{i}")
+            th.start()
+            self._workers.append(th)
+
+    # -- client API --------------------------------------------------------
+
+    def output(self, features, timeout: Optional[float] = None):
+        """Blocking single-request inference (thread-safe).
+
+        On timeout the request is marked cancelled — a worker that picks it
+        up later skips it instead of computing a result nobody reads."""
+        if not self._running:
+            raise RuntimeError("ParallelInference is shut down")
+        req = _Request(features)
+        self._queue.put(req)
+        if not req.event.wait(timeout):
+            req.cancelled = True
+            raise TimeoutError("inference request timed out")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def shutdown(self):
+        """Stop accepting requests; pending queued requests are still served
+        (FIFO: sentinels are enqueued behind them), then workers exit."""
+        if not self._running:
+            return
+        self._running = False
+        for _ in self._workers:
+            self._queue.put(None)
+        for th in self._workers:
+            th.join(timeout=30)
+        # Anything still queued after the workers died (crash path): fail it.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.error = RuntimeError("server shut down before serving request")
+                req.event.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # -- workers -----------------------------------------------------------
+
+    def _take_batch(self, carry: Optional[_Request]):
+        """Collect the next batch. ``carry`` is a request taken off the
+        queue last round that would have overflowed max_batch_size.
+        Returns (batch, next_carry) — batch None means shutdown."""
+        req = carry if carry is not None else self._queue.get()
+        if req is None:
+            return None, None
+        batch = [req]
+        if self._mode == "batched":
+            rows = req.inputs.shape[0]
+            while rows < self._max_batch:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._queue.put(None)  # keep shutdown signal for peers
+                    break
+                if nxt.cancelled:
+                    continue
+                if rows + nxt.inputs.shape[0] > self._max_batch:
+                    return batch, nxt  # would overflow: starts next batch
+                batch.append(nxt)
+                rows += nxt.inputs.shape[0]
+        return batch, None
+
+    @staticmethod
+    def _bucket(rows: int, cap: int) -> int:
+        """Next power-of-two ≥ rows (≤ cap): bounds jit compilation count."""
+        b = 1
+        while b < rows:
+            b *= 2
+        return min(b, max(cap, rows))
+
+    def _worker(self, idx: int, device):
+        variables = self._replicas[idx]
+        carry: Optional[_Request] = None
+        while True:
+            batch, carry = self._take_batch(carry)
+            if batch is None:
+                return
+            batch = [r for r in batch if not r.cancelled]
+            if not batch:
+                continue
+            try:
+                sizes = [r.inputs.shape[0] for r in batch]
+                rows = sum(sizes)
+                feats = jnp.concatenate(
+                    [jnp.asarray(r.inputs) for r in batch]) \
+                    if len(batch) > 1 else jnp.asarray(batch[0].inputs)
+                if self._mode == "batched":
+                    bucket = self._bucket(rows, self._max_batch)
+                    if bucket > rows:
+                        pad = jnp.zeros((bucket - rows, *feats.shape[1:]),
+                                        feats.dtype)
+                        feats = jnp.concatenate([feats, pad])
+                out = jax.device_get(
+                    self._fn(variables, jax.device_put(feats, device)))
+                offs = np.cumsum([0] + sizes)
+                for r, lo, hi in zip(batch, offs[:-1], offs[1:]):
+                    r.result = jax.tree_util.tree_map(
+                        lambda a: a[int(lo):int(hi)], out)
+                for r in batch:
+                    r.event.set()
+            except Exception as e:  # noqa: BLE001 — deliver to caller
+                for r in batch:
+                    r.error = e
+                    r.event.set()

@@ -1,0 +1,152 @@
+"""Pipeline parallelism (P8): GPipe-style microbatched stage pipeline.
+
+ref: ABSENT in the reference (SURVEY §2.6 P8) — DL4J has no pipeline
+parallelism at all. This is a TPU-native capability line-item: stages are
+laid out on a `stage` mesh axis, activations flow stage→stage over ICI via
+`lax.ppermute`, and microbatches fill the pipeline GPipe-style. The whole
+schedule — forward and the reverse (backward) pipeline jax.grad derives from
+it — is ONE compiled XLA program; there is no host-side scheduler thread
+(contrast: the reference's ParallelWrapper runs a Java thread per device
+even for plain data parallelism).
+
+Design (the scan/ppermute pipeline from the public scaling-book recipe):
+
+- Stage parameters are *stacked* on a leading axis of size S sharded over
+  `stage` — each device holds its own stage's slice (this is also exactly
+  how repeated transformer blocks are naturally stored: a scanned-over
+  params pytree).
+- The per-device program runs T = n_micro + S - 1 ticks. On tick t, the
+  device holding stage s computes microbatch m = t - s (bubble ticks
+  compute garbage that is masked out), then the activation ring-shifts one
+  hop toward stage s+1.
+- Outputs are collected on the last stage and broadcast with a masked psum.
+
+Bubble fraction is (S-1)/T — choose n_micro >> S. 1F1B-style scheduling
+(smaller activation footprint) is a later optimization; memory here is
+bounded by jax.checkpoint on the stage fn if needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.sequence import shard_map
+from deeplearning4j_tpu.runtime.device import DATA_AXIS, FSDP_AXIS, STAGE_AXIS
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack a list of identically-structured stage param pytrees along a
+    new leading axis (the axis sharded over `stage`)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def stage_params_sharding(mesh: Mesh, stacked_params: Any):
+    """NamedSharding pytree putting each stage's slice on its device."""
+    def spec(leaf):
+        return NamedSharding(mesh, P(STAGE_AXIS, *([None] * (leaf.ndim - 1))))
+
+    return jax.tree_util.tree_map(spec, stacked_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    stage_axis: str = STAGE_AXIS,
+    checkpoint_stage: bool = True,
+) -> jax.Array:
+    """Run ``x`` [B, ...] through S pipelined stages; returns [B, ...out].
+
+    ``stage_fn(params_s, x_mb) -> y_mb`` applies ONE stage to ONE
+    microbatch; every stage must map activations of the same shape
+    (classic GPipe restriction for the stacked layout). B must divide into
+    ``n_microbatches`` equal microbatches.
+
+    Differentiable: jax.grad through this runs the reverse pipeline
+    (ppermute transposes to the opposite ring direction).
+    """
+    if stage_axis not in mesh.axis_names:
+        # No stage axis: plain sequential scan over stages (single device).
+        def seq_step(h, p):
+            return stage_fn(p, h), None
+
+        out, _ = lax.scan(seq_step, x, stacked_params)
+        return out
+
+    n_stages = mesh.shape[stage_axis]
+    b = x.shape[0]
+    # Batch composes with data-like axes: each data-replica pipelines only
+    # its own batch shard (no duplicated FLOPs when mesh has data/fsdp axes).
+    batch_axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names)
+    dp = int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
+    if b % (dp * n_microbatches) != 0:
+        raise ValueError(
+            f"batch {b} not divisible into {n_microbatches} microbatches "
+            f"per data shard (data-axis product {dp})")
+    leading = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if leading != n_stages:
+        raise ValueError(
+            f"stacked params leading dim {leading} != stage axis size {n_stages}")
+    fn = jax.checkpoint(stage_fn) if checkpoint_stage else stage_fn
+
+    params_spec = jax.tree_util.tree_map(
+        lambda leaf: P(stage_axis, *([None] * (leaf.ndim - 1))), stacked_params)
+    x_spec = P(batch_axes if batch_axes else None)
+
+    def per_device(params_local, x_all):
+        # params_local: [1, ...] (this device's stage); x_all: this data
+        # shard's batch (replicated across the stage axis).
+        params_me = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        stage = lax.axis_index(stage_axis)
+        b_local = x_all.shape[0]
+        mb = b_local // n_microbatches
+        xs = x_all.reshape(n_microbatches, mb, *x_all.shape[1:])
+        n_ticks = n_microbatches + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 feeds microbatch t (repeats the last real microbatch
+            # during drain ticks); other stages consume what arrived from
+            # the previous stage.
+            feed = xs[jnp.minimum(t, n_microbatches - 1)]
+            x_in = jnp.where(stage == 0, feed, state)
+            y = fn(params_me, x_in)
+            m_out = t - (n_stages - 1)
+            valid = (stage == n_stages - 1) & (m_out >= 0)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(valid, y, lax.dynamic_index_in_dim(
+                    outputs, jnp.maximum(m_out, 0), 0, keepdims=False)),
+                jnp.maximum(m_out, 0), 0)
+            state = lax.ppermute(y, stage_axis, perm)
+            return (state, outputs), None
+
+        out0 = jnp.zeros((n_microbatches, mb, *x_all.shape[1:]), x_all.dtype)
+        # Bubble carry starts from real (finite) data, not zeros: the
+        # masked-out garbage still flows through fn's VJP under jax.grad,
+        # and 0-cotangent × inf/nan primal would poison param grads.
+        (_, outputs), _ = lax.scan(
+            tick, (xs[0], out0), jnp.arange(n_ticks))
+        # Only the last stage holds real outputs; masked psum broadcasts.
+        outputs = lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            stage_axis)
+        return outputs.reshape(b_local, *x_all.shape[1:])
+
+    fn_sm = shard_map(
+        per_device, mesh,
+        in_specs=(params_spec, x_spec),
+        out_specs=x_spec,
+    )
+    return fn_sm(stacked_params, x)

@@ -14,6 +14,7 @@ Canonical mesh axis names (used framework-wide, see parallel/specs.py):
 - ``fsdp``  — ZeRO-style parameter sharding (all-gather on use)
 - ``model`` — tensor (Megatron-style) parallelism
 - ``seq``   — sequence/context parallelism (ring attention)
+- ``stage`` — pipeline parallelism (GPipe microbatch pipeline)
 """
 
 from __future__ import annotations
@@ -30,8 +31,9 @@ DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+STAGE_AXIS = "stage"
 
-ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS)
+ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS, STAGE_AXIS)
 
 
 def devices(platform: Optional[str] = None):
@@ -60,6 +62,7 @@ class MeshSpec:
     fsdp: int = 1
     model: int = 1
     seq: int = 1
+    stage: int = 1
 
     def resolve(self, n_devices: Optional[int] = None) -> dict:
         n = n_devices if n_devices is not None else jax.device_count()
@@ -68,6 +71,7 @@ class MeshSpec:
             FSDP_AXIS: self.fsdp,
             MODEL_AXIS: self.model,
             SEQ_AXIS: self.seq,
+            STAGE_AXIS: self.stage,
         }
         wildcard = [k for k, v in sizes.items() if v == -1]
         if len(wildcard) > 1:

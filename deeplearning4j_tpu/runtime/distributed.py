@@ -1,0 +1,123 @@
+"""Multi-host bootstrap + cross-host utilities.
+
+ref: the ENTIRE host control plane of the reference's distributed story —
+Aeron media-driver launch, VoidParameterServer mesh handshake/heartbeats,
+Spark driver/executor plumbing (SURVEY §2.6, §3.4). On TPU all of that
+collapses into `jax.distributed.initialize` (gRPC coordination service:
+process 0 is the coordinator) + the PJRT plugin; data-plane collectives ride
+ICI/DCN inside compiled programs, so there is no user-space transport, no
+heartbeat protocol, and no parameter-server process to operate.
+
+What remains host-side, provided here:
+
+- `initialize()` — process bootstrap (env-var or explicit args), idempotent.
+- `global_mesh()` — mesh over ALL processes' devices (DCN-outer ordering:
+  the first axis varies slowest across hosts/slices, so cross-slice traffic
+  lands on the data axis as the scaling-book recipe prescribes).
+- `barrier()` / `broadcast_host_data()` — the rare host-level syncs
+  (checkpoint rendezvous), via multihost_utils.
+- failure story per SURVEY §5.3: a lost process fails the coordination
+  barrier; recovery is checkpoint-restart (serde/checkpoint is
+  topology-independent), not elastic re-scale — documented, like the
+  reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.runtime.device import MeshSpec, build_mesh
+
+_INITIALIZED = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Bootstrap multi-host JAX (↔ Aeron handshake + Spark executor launch).
+
+    No-op when single-process (no coordinator configured) or already
+    initialized. Env fallbacks: JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES,
+    JAX_PROCESS_ID (also set by TPU pod runtimes automatically).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None and num_processes is None:
+        return  # single-process: nothing to do
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    elif "JAX_NUM_PROCESSES" in os.environ:
+        kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    elif "JAX_PROCESS_ID" in os.environ:
+        kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = list(local_device_ids)
+    jax.distributed.initialize(**kwargs)
+    _INITIALIZED = True
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def global_mesh(spec: Optional[MeshSpec] = None):
+    """Mesh over every device of every process. With the default spec the
+    `data` axis absorbs all devices; multi-slice topologies put the
+    slice-crossing (DCN) traffic on the leading axis automatically because
+    jax.devices() orders by process."""
+    return build_mesh(spec or MeshSpec(), devices_=jax.devices())
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-process sync point (↔ parameter-server handshake round)."""
+    if not is_multiprocess():
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_host_data(value, is_source: Optional[bool] = None):
+    """Broadcast a host-side pytree from process 0 to all processes
+    (↔ Spark driver broadcast of model config/params in §3.4)."""
+    if not is_multiprocess():
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(
+        value, is_source=is_source)
+
+
+def host_local_to_global(arrays, mesh, pspecs):
+    """Per-host shards → one global jax.Array (↔ executor-local
+    VirtualDataSetIterator feeding the shared training wrapper)."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(arrays, mesh, pspecs)

@@ -1,0 +1,242 @@
+"""Pipeline parallelism (P8) + ParallelInference (P6) + multi-host utils.
+
+Mesh tests run on the 8-virtual-CPU-device platform per SURVEY §4.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import (
+    ParallelInference,
+    pipeline_apply,
+    stack_stage_params,
+    stage_params_sharding,
+)
+from deeplearning4j_tpu.runtime import distributed
+from deeplearning4j_tpu.runtime.device import MeshSpec, build_mesh
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stages(n, dim, seed=0):
+    rs = np.random.RandomState(seed)
+    return [
+        {"w": jnp.asarray(rs.randn(dim, dim).astype(np.float32) * 0.4),
+         "b": jnp.asarray(rs.randn(dim).astype(np.float32) * 0.1)}
+        for _ in range(n)
+    ]
+
+
+def _sequential(per_stage, x):
+    for p in per_stage:
+        x = _stage_fn(p, x)
+    return x
+
+
+class TestPipeline:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return build_mesh(MeshSpec(data=-1, stage=4))
+
+    def test_matches_sequential(self, mesh):
+        per_stage = _stages(4, 8)
+        stacked = stack_stage_params(per_stage)
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+        want = _sequential(per_stage, x)
+        got = pipeline_apply(_stage_fn, stacked, x, mesh=mesh, n_microbatches=8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match_sequential(self, mesh):
+        per_stage = _stages(4, 8, seed=2)
+        stacked = stack_stage_params(per_stage)
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(8, 8).astype(np.float32))
+
+        def loss_pipe(p):
+            return jnp.sum(pipeline_apply(_stage_fn, p, x, mesh=mesh,
+                                          n_microbatches=4) ** 2)
+
+        def loss_seq(p):
+            h = x
+            for i in range(4):
+                h = _stage_fn(jax.tree_util.tree_map(lambda a: a[i], p), h)
+            return jnp.sum(h ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = jax.grad(loss_seq)(stacked)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g_pipe, g_seq)
+
+    def test_jit_with_sharded_params(self, mesh):
+        per_stage = _stages(4, 8, seed=4)
+        stacked = stack_stage_params(per_stage)
+        sharding = stage_params_sharding(mesh, stacked)
+        stacked_sh = jax.device_put(stacked, sharding)
+        rs = np.random.RandomState(5)
+        x = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+        f = jax.jit(lambda p, x: pipeline_apply(
+            _stage_fn, p, x, mesh=mesh, n_microbatches=8))
+        got = f(stacked_sh, x)
+        want = _sequential(per_stage, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_no_stage_axis_sequential_fallback(self):
+        mesh = build_mesh(MeshSpec(data=-1))
+        per_stage = _stages(3, 4, seed=6)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.ones((4, 4), jnp.float32)
+        got = pipeline_apply(_stage_fn, stacked, x, mesh=mesh, n_microbatches=2)
+        want = _sequential(per_stage, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+    def test_bad_microbatch_count_raises(self, mesh):
+        stacked = stack_stage_params(_stages(4, 8))
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline_apply(_stage_fn, stacked, jnp.ones((10, 8)), mesh=mesh,
+                           n_microbatches=4)
+
+    def test_wrong_stage_count_raises(self, mesh):
+        stacked = stack_stage_params(_stages(3, 8))
+        with pytest.raises(ValueError, match="leading dim"):
+            pipeline_apply(_stage_fn, stacked, jnp.ones((8, 8)), mesh=mesh,
+                           n_microbatches=4)
+
+    def test_grad_finite_with_norm_stage(self, mesh):
+        # sqrt at 0 has an infinite derivative: guards the bubble-carry
+        # initialization (must be real data, not zeros).
+        def norm_stage(params, x):
+            h = jnp.tanh(x @ params["w"] + params["b"])
+            return h / (1e-3 + jnp.sqrt(jnp.sum(h * h, -1, keepdims=True)))
+
+        per_stage = _stages(4, 8, seed=7)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(np.random.RandomState(8).randn(8, 8).astype(np.float32))
+
+        def loss(p):
+            return jnp.sum(pipeline_apply(norm_stage, p, x, mesh=mesh,
+                                          n_microbatches=4) ** 2)
+
+        g = jax.grad(loss)(stacked)
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_composes_with_data_axis(self, mesh):
+        # mesh is (data=2, stage=4): each data replica pipelines its shard.
+        per_stage = _stages(4, 8, seed=9)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(np.random.RandomState(10).randn(16, 8).astype(np.float32))
+        got = pipeline_apply(_stage_fn, stacked, x, mesh=mesh, n_microbatches=4)
+        want = _sequential(per_stage, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestParallelInference:
+    def _model(self):
+        w = jnp.asarray(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+
+        def forward(variables, x):
+            return x @ variables["w"]
+
+        return forward, {"w": w}
+
+    def test_instant_mode(self):
+        forward, variables = self._model()
+        with ParallelInference(forward, variables,
+                               devices=jax.devices()[:2]) as pi:
+            x = np.ones((5, 4), np.float32)
+            out = pi.output(x)
+            np.testing.assert_allclose(out, np.asarray(x @ variables["w"]),
+                                       rtol=1e-5)
+
+    def test_batched_mode_concurrent_clients(self):
+        forward, variables = self._model()
+        rs = np.random.RandomState(1)
+        inputs = [rs.randn(3, 4).astype(np.float32) for _ in range(16)]
+        results = [None] * 16
+        with ParallelInference(forward, variables, devices=jax.devices()[:4],
+                               mode="batched", max_batch_size=8) as pi:
+            def client(i):
+                results[i] = pi.output(inputs[i])
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for i in range(16):
+            np.testing.assert_allclose(
+                results[i], np.asarray(inputs[i] @ np.asarray(variables["w"])),
+                rtol=1e-4, atol=1e-5)
+
+    def test_error_propagates(self):
+        def forward(variables, x):
+            return x @ variables["w"]  # wrong shape triggers error
+
+        with ParallelInference(forward, {"w": jnp.ones((4, 3))},
+                               devices=jax.devices()[:1]) as pi:
+            with pytest.raises(Exception):
+                pi.output(np.ones((2, 7), np.float32))
+
+    def test_bad_mode_raises(self):
+        forward, variables = self._model()
+        with pytest.raises(ValueError, match="valid"):
+            ParallelInference(forward, variables, mode="nope")
+
+    def test_shutdown_serves_pending_then_rejects(self):
+        forward, variables = self._model()
+        pi = ParallelInference(forward, variables, devices=jax.devices()[:1])
+        x = np.ones((2, 4), np.float32)
+        assert pi.output(x).shape == (2, 3)
+        pi.shutdown()
+        with pytest.raises(RuntimeError, match="shut down"):
+            pi.output(x)
+        pi.shutdown()  # idempotent
+
+    def test_batched_respects_max_batch_rows(self):
+        rows_seen = []
+
+        def forward(variables, x):
+            rows_seen.append(x.shape[0])
+            return x @ variables["w"]
+
+        w = jnp.eye(4)
+        with ParallelInference(forward, {"w": w}, devices=jax.devices()[:1],
+                               mode="batched", max_batch_size=8) as pi:
+            import concurrent.futures as cf
+
+            xs = [np.full((5, 4), i, np.float32) for i in range(6)]
+            with cf.ThreadPoolExecutor(6) as ex:
+                outs = list(ex.map(pi.output, xs))
+        # 5-row requests with cap 8: batches must never merge two (10 > 8),
+        # and padding buckets to 8 — traced shapes only ever 5 (instant
+        # single) padded to 8.
+        assert all(r <= 8 for r in rows_seen)
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o, xs[i])
+
+
+class TestDistributedSingleProcess:
+    def test_noop_initialize_and_barrier(self):
+        distributed.initialize()  # no coordinator: no-op
+        assert distributed.process_count() == 1
+        assert distributed.process_index() == 0
+        assert not distributed.is_multiprocess()
+        distributed.barrier()  # no-op
+        assert distributed.broadcast_host_data({"a": 1}) == {"a": 1}
+
+    def test_global_mesh(self):
+        mesh = distributed.global_mesh()
+        assert int(np.prod(list(mesh.shape.values()))) == jax.device_count()
