@@ -70,6 +70,7 @@ class ParallelInference:
         self._mode = mode
         self._max_batch = max_batch_size
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue(queue_limit)
+        self._state_lock = threading.Lock()  # orders enqueue vs shutdown
         self._fn = jax.jit(forward)
         # One replica of the variables per device (↔ model.clone() per GPU —
         # but here it's the same immutable buffers, transferred not cloned).
@@ -92,10 +93,15 @@ class ParallelInference:
 
         On timeout the request is marked cancelled — a worker that picks it
         up later skips it instead of computing a result nobody reads."""
-        if not self._running:
-            raise RuntimeError("ParallelInference is shut down")
         req = _Request(features)
-        self._queue.put(req)
+        # Lock orders the running-check + enqueue against shutdown()'s
+        # running-flip + sentinel enqueue: a request admitted here is
+        # guaranteed to precede the sentinels in the FIFO, so workers
+        # serve it before exiting.
+        with self._state_lock:
+            if not self._running:
+                raise RuntimeError("ParallelInference is shut down")
+            self._queue.put(req)
         if not req.event.wait(timeout):
             req.cancelled = True
             raise TimeoutError("inference request timed out")
@@ -106,11 +112,12 @@ class ParallelInference:
     def shutdown(self):
         """Stop accepting requests; pending queued requests are still served
         (FIFO: sentinels are enqueued behind them), then workers exit."""
-        if not self._running:
-            return
-        self._running = False
-        for _ in self._workers:
-            self._queue.put(None)
+        with self._state_lock:
+            if not self._running:
+                return
+            self._running = False
+            for _ in self._workers:
+                self._queue.put(None)
         for th in self._workers:
             th.join(timeout=30)
         # Anything still queued after the workers died (crash path): fail it.

@@ -37,7 +37,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.sequence import shard_map
-from deeplearning4j_tpu.runtime.device import DATA_AXIS, FSDP_AXIS, STAGE_AXIS
+from deeplearning4j_tpu.runtime.device import STAGE_AXIS, data_like_axes
 
 
 def stack_stage_params(per_stage_params: list) -> Any:
@@ -87,7 +87,7 @@ def pipeline_apply(
     b = x.shape[0]
     # Batch composes with data-like axes: each data-replica pipelines only
     # its own batch shard (no duplicated FLOPs when mesh has data/fsdp axes).
-    batch_axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names)
+    batch_axes = data_like_axes(mesh)
     dp = int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
     if b % (dp * n_microbatches) != 0:
         raise ValueError(

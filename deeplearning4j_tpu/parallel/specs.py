@@ -27,7 +27,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.runtime.device import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+from deeplearning4j_tpu.runtime.device import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    data_like_axes,
+)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -36,7 +42,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def batch_spec(mesh: Mesh) -> NamedSharding:
     """Shard the leading (batch) dim over all data-like axes present."""
-    axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names)
+    axes = data_like_axes(mesh)
     return NamedSharding(mesh, P(axes if axes else None))
 
 

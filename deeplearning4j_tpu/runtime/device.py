@@ -119,9 +119,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def data_like_axes(mesh: Mesh) -> tuple:
+    """Mesh axes the batch dimension shards over (single source of truth
+    for specs.batch_spec / pipeline_apply / batch_sharding)."""
+    return tuple(a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Shard leading (batch) dim over every data-like axis present."""
-    axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names)
+    axes = data_like_axes(mesh)
     if not axes:
         return NamedSharding(mesh, PartitionSpec())
     return NamedSharding(mesh, PartitionSpec(axes))

@@ -43,9 +43,11 @@ def initialize(
 ) -> None:
     """Bootstrap multi-host JAX (↔ Aeron handshake + Spark executor launch).
 
-    No-op when single-process (no coordinator configured) or already
-    initialized. Env fallbacks: JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES,
-    JAX_PROCESS_ID (also set by TPU pod runtimes automatically).
+    With explicit args (or JAX_COORDINATOR_ADDRESS/…): initializes against
+    that coordinator. With no args on a TPU pod (multiple worker hostnames
+    in the runtime metadata): defers to jax's own cluster auto-detection —
+    ``jax.distributed.initialize()`` resolves the coordinator from TPU
+    metadata. Single-host: no-op. Idempotent.
     """
     global _INITIALIZED
     if _INITIALIZED:
@@ -53,7 +55,15 @@ def initialize(
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None and num_processes is None:
-        return  # single-process: nothing to do
+        # No explicit cluster config. On a real pod slice the TPU runtime
+        # publishes the worker list; let jax auto-detect the coordinator.
+        workers = [
+            w for w in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if w
+        ]
+        if len(workers) > 1:
+            jax.distributed.initialize()
+            _INITIALIZED = True
+        return  # single host: nothing to do
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
