@@ -1,4 +1,11 @@
-"""Data/ETL layer (↔ DataVec + the deeplearning4j dataset iterators)."""
+"""Data/ETL layer (↔ DataVec + the deeplearning4j dataset iterators).
+
+- records: RecordReader API (CSV/line/collection/sequence) + DataSet bridge
+- transform: Schema + TransformProcess column-op pipeline
+- image: ImageRecordReader, augmentation transforms, label generators
+- iterators: minibatch + async-prefetch (device double-buffering)
+- normalizers: fit/transform feature scalers
+"""
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
@@ -13,6 +20,23 @@ from deeplearning4j_tpu.data.normalizers import (
     NormalizerMinMaxScaler,
     NormalizerStandardize,
 )
+from deeplearning4j_tpu.data.records import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    LineRecordReader,
+    RecordReader,
+    RecordReaderDataSetIterator,
+    SequenceRecordReader,
+)
+from deeplearning4j_tpu.data.transform import Schema, TransformProcess
+from deeplearning4j_tpu.data.image import (
+    ImageDataSetIterator,
+    ImageRecordReader,
+    ParentPathLabelGenerator,
+    PatternPathLabelGenerator,
+    PipelineImageTransform,
+)
 
 __all__ = [
     "DataSet", "MultiDataSet",
@@ -20,4 +44,11 @@ __all__ = [
     "load_mnist",
     "ImageMeanSubtraction", "ImagePreProcessingScaler",
     "NormalizerMinMaxScaler", "NormalizerStandardize",
+    "RecordReader", "CollectionRecordReader", "CSVRecordReader",
+    "LineRecordReader", "SequenceRecordReader", "CSVSequenceRecordReader",
+    "RecordReaderDataSetIterator",
+    "Schema", "TransformProcess",
+    "ImageRecordReader", "ImageDataSetIterator",
+    "ParentPathLabelGenerator", "PatternPathLabelGenerator",
+    "PipelineImageTransform",
 ]

@@ -1,0 +1,273 @@
+"""Image input pipeline (↔ DataVec image, SURVEY §2.4 / §2.8 item 12).
+
+ref: org.datavec.image.recordreader.ImageRecordReader +
+org.datavec.image.loader.NativeImageLoader (JavaCPP OpenCV) +
+org.datavec.image.transform.* (crop/flip/rotate/scale, PipelineImageTransform)
+and org.datavec.api.io.labels.ParentPathLabelGenerator.
+
+Decode runs host-side on native OpenCV when available (cv2 — the same
+library the reference binds via JavaCPP) with a PIL fallback; augmentation
+is pure numpy. The output is NHWC float32, the TPU-friendly layout (↔ the
+reference's NCHW default; conv layers here are NHWC natively). Device
+transfer/overlap is the AsyncDataSetIterator's job (data/iterators.py), so
+ImageRecordReader stays a pure host producer — the role split the reference
+uses (RecordReader produces, AsyncDataSetIterator prefetches).
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+try:
+    import cv2
+
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover
+    cv2 = None
+    _HAS_CV2 = False
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+IMAGE_EXTENSIONS = {".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm", ".webp"}
+
+
+# --- label generators (↔ org.datavec.api.io.labels.*) ----------------------
+
+
+class ParentPathLabelGenerator:
+    """Label = name of the file's parent directory."""
+
+    def __call__(self, path: pathlib.Path) -> str:
+        return path.parent.name
+
+
+class PatternPathLabelGenerator:
+    """Label = path-stem split by `pattern`, taking `index`
+    (↔ PatternPathLabelGenerator)."""
+
+    def __init__(self, pattern: str = "_", index: int = 0):
+        self.pattern = pattern
+        self.index = index
+
+    def __call__(self, path: pathlib.Path) -> str:
+        return path.stem.split(self.pattern)[self.index]
+
+
+# --- decode ----------------------------------------------------------------
+
+
+def load_image(path, *, height: int, width: int, channels: int = 3) -> np.ndarray:
+    """Decode + resize one image to [H, W, C] float32 in [0, 255]
+    (↔ NativeImageLoader.asMatrix; normalization is the normalizer's job)."""
+    path = str(path)
+    if _HAS_CV2:
+        flag = cv2.IMREAD_COLOR if channels == 3 else cv2.IMREAD_GRAYSCALE
+        img = cv2.imread(path, flag)
+        if img is None:
+            raise IOError(f"cannot decode image {path}")
+        img = cv2.resize(img, (width, height), interpolation=cv2.INTER_AREA)
+        if channels == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        else:
+            img = img[..., None]
+    else:  # pragma: no cover - PIL fallback
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert("RGB" if channels == 3 else "L")
+            im = im.resize((width, height))
+            img = np.asarray(im)
+            if channels == 1:
+                img = img[..., None]
+    return img.astype(np.float32)
+
+
+# --- transforms (↔ org.datavec.image.transform.*) --------------------------
+
+
+class ImageTransform:
+    def __call__(self, img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        raise NotImplementedError
+
+
+class FlipImageTransform(ImageTransform):
+    """↔ FlipImageTransform: horizontal (axis=1) or vertical (axis=0)."""
+
+    def __init__(self, axis: int = 1, probability: float = 0.5):
+        self.axis = axis
+        self.probability = probability
+
+    def __call__(self, img, rng):
+        if rng.random() < self.probability:
+            return np.flip(img, axis=self.axis)
+        return img
+
+
+class RotateImageTransform(ImageTransform):
+    """↔ RotateImageTransform: rotation by a random angle in ±max_deg."""
+
+    def __init__(self, max_deg: float = 15.0):
+        self.max_deg = max_deg
+
+    def __call__(self, img, rng):
+        angle = float(rng.uniform(-self.max_deg, self.max_deg))
+        if not _HAS_CV2:  # pragma: no cover - 90°-step fallback
+            k = int(round(angle / 90.0)) % 4
+            return np.rot90(img, k).copy() if k else img
+        h, w = img.shape[:2]
+        m = cv2.getRotationMatrix2D((w / 2, h / 2), angle, 1.0)
+        out = cv2.warpAffine(img, m, (w, h), flags=cv2.INTER_LINEAR,
+                             borderMode=cv2.BORDER_REFLECT)
+        return out[..., None] if img.ndim == 3 and img.shape[2] == 1 else out
+
+
+class CropImageTransform(ImageTransform):
+    """↔ CropImageTransform: random crop by up to `margin` px per side,
+    resized back to the original size."""
+
+    def __init__(self, margin: int = 4):
+        self.margin = margin
+
+    def __call__(self, img, rng):
+        h, w = img.shape[:2]
+        t, b = rng.integers(0, self.margin + 1, 2)
+        l, r = rng.integers(0, self.margin + 1, 2)
+        cropped = img[t:h - b or h, l:w - r or w]
+        if _HAS_CV2:
+            out = cv2.resize(cropped, (w, h), interpolation=cv2.INTER_LINEAR)
+            return out[..., None] if img.ndim == 3 and img.shape[2] == 1 else out
+        pad_h, pad_w = h - cropped.shape[0], w - cropped.shape[1]
+        return np.pad(cropped, ((0, pad_h), (0, pad_w), (0, 0)), mode="edge")
+
+
+class ScaleImageTransform(ImageTransform):
+    """Multiply pixel values by a random factor in [1-delta, 1+delta]
+    (brightness jitter; ↔ ScaleImageTransform's spirit)."""
+
+    def __init__(self, delta: float = 0.2):
+        self.delta = delta
+
+    def __call__(self, img, rng):
+        return img * float(rng.uniform(1 - self.delta, 1 + self.delta))
+
+
+class PipelineImageTransform(ImageTransform):
+    """↔ PipelineImageTransform: sequence of (transform, probability)."""
+
+    def __init__(self, steps: Sequence, shuffle: bool = False):
+        self.steps = [s if isinstance(s, tuple) else (s, 1.0) for s in steps]
+        self.shuffle = shuffle
+
+    def __call__(self, img, rng):
+        steps = list(self.steps)
+        if self.shuffle:
+            rng.shuffle(steps)
+        for t, p in steps:
+            if rng.random() < p:
+                img = t(img, rng)
+        return img
+
+
+# --- reader + iterator -----------------------------------------------------
+
+
+class ImageRecordReader:
+    """↔ org.datavec.image.recordreader.ImageRecordReader.
+
+    Walks `root` (or an explicit file list), decodes to [H,W,C] float32 and
+    yields (image, label_string) pairs. Labels come from `label_generator`
+    (default: parent directory name).
+    """
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 label_generator: Optional[Callable] = None):
+        self.height = height
+        self.width = width
+        self.channels = channels
+        self.label_generator = label_generator or ParentPathLabelGenerator()
+        self.paths: List[pathlib.Path] = []
+        self.labels: List[str] = []
+
+    def initialize(self, source: Union[str, pathlib.Path, Sequence]) -> "ImageRecordReader":
+        if isinstance(source, (str, pathlib.Path)):
+            root = pathlib.Path(source)
+            self.paths = sorted(
+                p for p in root.rglob("*")
+                if p.is_file() and p.suffix.lower() in IMAGE_EXTENSIONS)
+        else:
+            self.paths = [pathlib.Path(p) for p in source]
+        self.labels = sorted({self.label_generator(p) for p in self.paths})
+        return self
+
+    def num_labels(self) -> int:
+        return len(self.labels)
+
+    def read_index(self, i: int):
+        """Decode entry i → (image [H,W,C] float32, label string). The one
+        decode path, shared by __iter__ and the batch iterator."""
+        p = self.paths[i]
+        img = load_image(p, height=self.height, width=self.width,
+                         channels=self.channels)
+        return img, self.label_generator(p)
+
+    def __iter__(self):
+        for i in range(len(self.paths)):
+            yield self.read_index(i)
+
+    def reset(self):
+        pass
+
+
+class ImageDataSetIterator:
+    """Minibatch iterator over an ImageRecordReader: NHWC float32 features +
+    one-hot labels (↔ RecordReaderDataSetIterator specialized for images).
+
+    `transform` (ImageTransform) is applied per image with the iterator's
+    rng; `shuffle` reshuffles file order each epoch.
+    """
+
+    def __init__(self, reader: ImageRecordReader, batch_size: int, *,
+                 transform: Optional[ImageTransform] = None,
+                 shuffle: bool = True, seed: int = 0,
+                 normalizer: Optional[Callable] = None):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.transform = transform
+        self.shuffle = shuffle
+        self.normalizer = normalizer
+        self._rng = np.random.default_rng(seed)
+        self._label_to_idx = {l: i for i, l in enumerate(reader.labels)}
+
+    def __len__(self):
+        return -(-len(self.reader.paths) // self.batch_size)
+
+    def __iter__(self):
+        order = np.arange(len(self.reader.paths))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        batch_x, batch_y = [], []
+        for i in order:
+            img, label = self.reader.read_index(int(i))
+            if self.transform is not None:
+                img = self.transform(img, self._rng)
+            batch_x.append(img)
+            batch_y.append(self._label_to_idx[label])
+            if len(batch_x) == self.batch_size:
+                yield self._emit(batch_x, batch_y)
+                batch_x, batch_y = [], []
+        if batch_x:
+            yield self._emit(batch_x, batch_y)
+
+    def _emit(self, xs, ys):
+        x = np.stack(xs).astype(np.float32)
+        if self.normalizer is not None:
+            x = self.normalizer(x)
+        y = np.zeros((len(ys), self.reader.num_labels()), np.float32)
+        y[np.arange(len(ys)), ys] = 1.0
+        return DataSet(x, y)
+
+    def reset(self):
+        pass

@@ -1,0 +1,200 @@
+"""Record readers (↔ DataVec's record API, SURVEY §2.4).
+
+ref: org.datavec.api.records.reader.{RecordReader, SequenceRecordReader}
+and impls (CSVRecordReader, LineRecordReader, CollectionRecordReader,
+CSVSequenceRecordReader), org.datavec.api.split.FileSplit, and the DL4J
+bridge org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator.
+
+A record is a list of python values (↔ List<Writable>); a sequence record
+is a list of records. Readers are plain iterators with reset() — the
+TPU-relevant part is the bridge at the bottom, which turns records into
+dense numpy minibatches ready for jax.device_put (all dtype conversion
+happens host-side, once, not per-op like the reference's Writable boxing).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import pathlib
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class RecordReader:
+    """Iterable of records with reset (↔ org.datavec RecordReader)."""
+
+    def __iter__(self) -> Iterator[List]:
+        raise NotImplementedError
+
+    def reset(self) -> None:  # most readers are re-iterable; stateful ones override
+        pass
+
+    def map_records(self, fn: Callable[[List], List]) -> "MappedRecordReader":
+        return MappedRecordReader(self, fn)
+
+
+class MappedRecordReader(RecordReader):
+    def __init__(self, base: RecordReader, fn: Callable[[List], List]):
+        self.base = base
+        self.fn = fn
+
+    def __iter__(self):
+        return (self.fn(rec) for rec in self.base)
+
+    def reset(self):
+        self.base.reset()
+
+
+class CollectionRecordReader(RecordReader):
+    """↔ CollectionRecordReader: records from an in-memory collection."""
+
+    def __init__(self, records: Sequence[List]):
+        self.records = list(records)
+
+    def __iter__(self):
+        return iter(self.records)
+
+
+class LineRecordReader(RecordReader):
+    """↔ LineRecordReader: one record per line, single string value."""
+
+    def __init__(self, paths: Union[str, pathlib.Path, Sequence]):
+        self.paths = _as_paths(paths)
+
+    def __iter__(self):
+        for p in self.paths:
+            with open(p, "r") as f:
+                for line in f:
+                    yield [line.rstrip("\n")]
+
+
+class CSVRecordReader(RecordReader):
+    """↔ CSVRecordReader: delimited text → typed-as-string records.
+
+    skip_lines skips headers; values stay strings (the TransformProcess or
+    the dataset bridge handles conversion, like the reference's Writables).
+    """
+
+    def __init__(self, paths: Union[str, pathlib.Path, Sequence],
+                 *, delimiter: str = ",", skip_lines: int = 0,
+                 quotechar: str = '"'):
+        self.paths = _as_paths(paths)
+        self.delimiter = delimiter
+        self.skip_lines = skip_lines
+        self.quotechar = quotechar
+
+    def __iter__(self):
+        for p in self.paths:
+            with open(p, "r", newline="") as f:
+                reader = csv.reader(f, delimiter=self.delimiter,
+                                    quotechar=self.quotechar)
+                for i, row in enumerate(reader):
+                    if i < self.skip_lines or not row:
+                        continue
+                    yield list(row)
+
+    @staticmethod
+    def from_string(text: str, *, delimiter: str = ",", skip_lines: int = 0,
+                    quotechar: str = '"') -> "CollectionRecordReader":
+        reader = csv.reader(io.StringIO(text), delimiter=delimiter,
+                            quotechar=quotechar)
+        return CollectionRecordReader(
+            [list(r) for i, r in enumerate(reader) if i >= skip_lines and r])
+
+
+class SequenceRecordReader:
+    """↔ SequenceRecordReader: iterator of sequences (list of records)."""
+
+    def __iter__(self) -> Iterator[List[List]]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """↔ CSVSequenceRecordReader: one CSV file per sequence."""
+
+    def __init__(self, paths: Union[str, pathlib.Path, Sequence],
+                 *, delimiter: str = ",", skip_lines: int = 0):
+        self.paths = _as_paths(paths)
+        self.delimiter = delimiter
+        self.skip_lines = skip_lines
+
+    def __iter__(self):
+        for p in self.paths:
+            reader = CSVRecordReader(p, delimiter=self.delimiter,
+                                     skip_lines=self.skip_lines)
+            yield list(reader)
+
+
+def _as_paths(paths) -> List[pathlib.Path]:
+    """↔ FileSplit: accept a file, a directory (sorted recursive), or a list."""
+    if isinstance(paths, (str, pathlib.Path)):
+        p = pathlib.Path(paths)
+        if p.is_dir():
+            return sorted(q for q in p.rglob("*") if q.is_file())
+        return [p]
+    return [pathlib.Path(p) for p in paths]
+
+
+# --- DL4J bridge -----------------------------------------------------------
+
+
+class RecordReaderDataSetIterator:
+    """↔ org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator.
+
+    Converts records to DataSet minibatches: columns [0, label_index) and
+    (label_index, end) are features (float32); column label_index is the
+    label — one-hot encoded when num_classes is given, float regression
+    target(s) otherwise. label_index=-1 means "last column";
+    label_index=None means unlabeled (features only).
+    """
+
+    def __init__(self, reader: RecordReader, batch_size: int, *,
+                 label_index: Optional[int] = -1,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+
+    def _split(self, rec: List):
+        if self.label_index is None:
+            return [float(v) for v in rec], None
+        li = self.label_index if self.label_index >= 0 else len(rec) + self.label_index
+        feats = [float(v) for i, v in enumerate(rec) if i != li]
+        return feats, rec[li]
+
+    def __iter__(self):
+        feats, labels = [], []
+        for rec in self.reader:
+            f, lb = self._split(rec)
+            feats.append(f)
+            labels.append(lb)
+            if len(feats) == self.batch_size:
+                yield self._emit(feats, labels)
+                feats, labels = [], []
+        if feats:
+            yield self._emit(feats, labels)
+
+    def _emit(self, feats, labels) -> DataSet:
+        x = np.asarray(feats, np.float32)
+        if self.label_index is None:
+            return DataSet(x, None)
+        if self.regression or self.num_classes is None:
+            y = np.asarray([[float(v)] for v in labels], np.float32)
+        else:
+            idx = np.asarray([int(float(v)) for v in labels])
+            y = np.zeros((len(idx), self.num_classes), np.float32)
+            y[np.arange(len(idx)), idx] = 1.0
+        return DataSet(x, y)
+
+    def reset(self):
+        self.reader.reset()

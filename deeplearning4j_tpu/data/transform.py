@@ -1,0 +1,438 @@
+"""Schema-typed transform engine (↔ DataVec TransformProcess, SURVEY §2.4).
+
+ref: org.datavec.api.transform.{schema.Schema, TransformProcess} and its
+local executor (datavec-local LocalTransformExecutor). The reference builds
+a serializable op pipeline over typed columns (remove/convert/filter/
+normalize/math) executed locally or on Spark. Here the pipeline is the same
+idea — a list of serializable column ops, each also transforming the
+schema — executed locally (a Spark analogue is unnecessary: at TPU scale
+the transform output feeds the host input pipeline per process, and
+parallelism across hosts is per-host data sharding, not a Spark cluster).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+COLUMN_TYPES = ("string", "integer", "double", "categorical", "long", "time")
+
+
+@dataclasses.dataclass
+class Column:
+    name: str
+    type: str = "string"
+    categories: Optional[List[str]] = None  # for categorical
+
+
+class Schema:
+    """↔ org.datavec.api.transform.schema.Schema (builder pattern kept)."""
+
+    def __init__(self, columns: Optional[List[Column]] = None):
+        self.columns = columns or []
+
+    # builder-style adders
+    def add_string_column(self, name):
+        self.columns.append(Column(name, "string"))
+        return self
+
+    def add_integer_column(self, name):
+        self.columns.append(Column(name, "integer"))
+        return self
+
+    def add_double_column(self, name):
+        self.columns.append(Column(name, "double"))
+        return self
+
+    def add_categorical_column(self, name, categories: Sequence[str]):
+        self.columns.append(Column(name, "categorical", list(categories)))
+        return self
+
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        try:
+            return self.names().index(name)
+        except ValueError:
+            raise KeyError(f"no column {name!r}; have {self.names()}")
+
+    def column(self, name: str) -> Column:
+        return self.columns[self.index_of(name)]
+
+    def copy(self) -> "Schema":
+        return Schema([dataclasses.replace(c) for c in self.columns])
+
+    def to_dict(self):
+        return {"columns": [dataclasses.asdict(c) for c in self.columns]}
+
+    @staticmethod
+    def from_dict(d):
+        return Schema([Column(**c) for c in d["columns"]])
+
+    def __repr__(self):
+        cols = ", ".join(f"{c.name}:{c.type}" for c in self.columns)
+        return f"Schema({cols})"
+
+
+# --- transform ops ---------------------------------------------------------
+# Each op: apply(records, schema) -> records AND out_schema(schema) -> schema.
+# Ops are dataclasses → JSON round-trip like the reference's Jackson serde.
+
+_OP_REGISTRY: Dict[str, type] = {}
+
+
+def _register(cls):
+    _OP_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@_register
+@dataclasses.dataclass
+class RemoveColumns:
+    names: List[str]
+
+    def out_schema(self, s: Schema) -> Schema:
+        return Schema([c for c in s.copy().columns if c.name not in self.names])
+
+    def apply(self, records, s: Schema):
+        idxs = {s.index_of(n) for n in self.names}
+        return [[v for i, v in enumerate(r) if i not in idxs] for r in records]
+
+
+@_register
+@dataclasses.dataclass
+class KeepColumns:
+    names: List[str]
+
+    def out_schema(self, s: Schema) -> Schema:
+        return Schema([c for c in s.copy().columns if c.name in self.names])
+
+    def apply(self, records, s: Schema):
+        idxs = [s.index_of(n) for n in s.names() if n in self.names]
+        return [[r[i] for i in idxs] for r in records]
+
+
+@_register
+@dataclasses.dataclass
+class RenameColumn:
+    old: str
+    new: str
+
+    def out_schema(self, s: Schema) -> Schema:
+        out = s.copy()
+        out.columns[s.index_of(self.old)].name = self.new
+        return out
+
+    def apply(self, records, s: Schema):
+        return records
+
+
+@_register
+@dataclasses.dataclass
+class ConvertToDouble:
+    names: List[str]
+
+    def out_schema(self, s: Schema) -> Schema:
+        out = s.copy()
+        for n in self.names:
+            out.columns[s.index_of(n)].type = "double"
+        return out
+
+    def apply(self, records, s: Schema):
+        idxs = [s.index_of(n) for n in self.names]
+        out = []
+        for r in records:
+            r = list(r)
+            for i in idxs:
+                r[i] = float(r[i])
+            out.append(r)
+        return out
+
+
+@_register
+@dataclasses.dataclass
+class CategoricalToInteger:
+    """↔ CategoricalToIntegerTransform: category → its index."""
+
+    names: List[str]
+
+    def out_schema(self, s: Schema) -> Schema:
+        out = s.copy()
+        for n in self.names:
+            col = out.columns[s.index_of(n)]
+            if col.type != "categorical" or not col.categories:
+                raise ValueError(f"column {n!r} is not categorical")
+            col.type = "integer"
+        return out
+
+    def apply(self, records, s: Schema):
+        maps = {s.index_of(n): {c: i for i, c in enumerate(s.column(n).categories)}
+                for n in self.names}
+        out = []
+        for r in records:
+            r = list(r)
+            for i, m in maps.items():
+                r[i] = m[r[i]]
+            out.append(r)
+        return out
+
+
+@_register
+@dataclasses.dataclass
+class CategoricalToOneHot:
+    """↔ CategoricalToOneHotTransform: expands the column to K 0/1 columns."""
+
+    name: str
+
+    def out_schema(self, s: Schema) -> Schema:
+        i = s.index_of(self.name)
+        col = s.column(self.name)
+        if col.type != "categorical" or not col.categories:
+            raise ValueError(f"column {self.name!r} is not categorical")
+        cols = s.copy().columns
+        onehot = [Column(f"{self.name}[{c}]", "integer") for c in col.categories]
+        return Schema(cols[:i] + onehot + cols[i + 1:])
+
+    def apply(self, records, s: Schema):
+        i = s.index_of(self.name)
+        cats = s.column(self.name).categories
+        m = {c: j for j, c in enumerate(cats)}
+        out = []
+        for r in records:
+            hot = [0] * len(cats)
+            hot[m[r[i]]] = 1
+            out.append(list(r[:i]) + hot + list(r[i + 1:]))
+        return out
+
+
+@_register
+@dataclasses.dataclass
+class FilterInvalid:
+    """Drop records with missing/NaN values in the given columns."""
+
+    names: List[str]
+
+    def out_schema(self, s: Schema) -> Schema:
+        return s.copy()
+
+    def apply(self, records, s: Schema):
+        idxs = [s.index_of(n) for n in self.names]
+
+        def ok(r):
+            for i in idxs:
+                v = r[i]
+                if v is None or v == "":
+                    return False
+                if isinstance(v, float) and math.isnan(v):
+                    return False
+            return True
+
+        return [r for r in records if ok(r)]
+
+
+@_register
+@dataclasses.dataclass
+class FilterByCondition:
+    """↔ ConditionFilter. condition: (column op value) kept serializable."""
+
+    column: str
+    op: str  # "lt" | "lte" | "gt" | "gte" | "eq" | "neq" | "in"
+    value: Any
+    keep_matching: bool = False  # reference semantics: filter REMOVES matches
+
+    _OPS = {
+        "lt": lambda a, b: a < b, "lte": lambda a, b: a <= b,
+        "gt": lambda a, b: a > b, "gte": lambda a, b: a >= b,
+        "eq": lambda a, b: a == b, "neq": lambda a, b: a != b,
+        "in": lambda a, b: a in b,
+    }
+
+    def out_schema(self, s: Schema) -> Schema:
+        return s.copy()
+
+    def apply(self, records, s: Schema):
+        i = s.index_of(self.column)
+        f = self._OPS[self.op]
+        keep = self.keep_matching
+        return [r for r in records if f(r[i], self.value) == keep]
+
+
+@_register
+@dataclasses.dataclass
+class DoubleMathOp:
+    """↔ DoubleMathOpTransform: column = column <op> scalar."""
+
+    column: str
+    op: str  # add sub mul div pow
+    value: float
+
+    _OPS = {
+        "add": lambda a, b: a + b, "sub": lambda a, b: a - b,
+        "mul": lambda a, b: a * b, "div": lambda a, b: a / b,
+        "pow": lambda a, b: a ** b,
+    }
+
+    def out_schema(self, s: Schema) -> Schema:
+        out = s.copy()
+        out.columns[s.index_of(self.column)].type = "double"
+        return out
+
+    def apply(self, records, s: Schema):
+        i = s.index_of(self.column)
+        f = self._OPS[self.op]
+        out = []
+        for r in records:
+            r = list(r)
+            r[i] = f(float(r[i]), self.value)
+            out.append(r)
+        return out
+
+
+@_register
+@dataclasses.dataclass
+class Normalize:
+    """↔ the transform-side normalizers: minmax or standardize, with stats
+    either given or fit via TransformProcess.fit()."""
+
+    column: str
+    mode: str = "standardize"  # or "minmax"
+    mean: Optional[float] = None
+    std: Optional[float] = None
+    min: Optional[float] = None
+    max: Optional[float] = None
+
+    def out_schema(self, s: Schema) -> Schema:
+        out = s.copy()
+        out.columns[s.index_of(self.column)].type = "double"
+        return out
+
+    def fit(self, records, s: Schema):
+        vals = np.asarray([float(r[s.index_of(self.column)]) for r in records])
+        if self.mode == "standardize":
+            self.mean, self.std = float(vals.mean()), float(vals.std() + 1e-12)
+        else:
+            self.min, self.max = float(vals.min()), float(vals.max())
+
+    def apply(self, records, s: Schema):
+        i = s.index_of(self.column)
+        if self.mode == "standardize":
+            if self.mean is None:
+                raise ValueError(f"Normalize({self.column}): call fit() first")
+            f = lambda v: (float(v) - self.mean) / self.std
+        else:
+            if self.min is None:
+                raise ValueError(f"Normalize({self.column}): call fit() first")
+            rng = (self.max - self.min) or 1.0
+            f = lambda v: (float(v) - self.min) / rng
+        out = []
+        for r in records:
+            r = list(r)
+            r[i] = f(r[i])
+            out.append(r)
+        return out
+
+
+class TransformProcess:
+    """↔ org.datavec.api.transform.TransformProcess (builder + executor).
+
+    Build with chained calls, then ``fit`` (for stateful normalizers) and
+    ``execute``; ``final_schema`` gives the output schema. JSON round-trip
+    via to_json/from_json like the reference.
+    """
+
+    def __init__(self, initial_schema: Schema, steps: Optional[List] = None):
+        self.initial_schema = initial_schema
+        self.steps = steps or []
+
+    def _add(self, op) -> "TransformProcess":
+        self.steps.append(op)
+        return self
+
+    # builder sugar mirroring reference method names
+    def remove_columns(self, *names):
+        return self._add(RemoveColumns(list(names)))
+
+    def keep_columns(self, *names):
+        return self._add(KeepColumns(list(names)))
+
+    def rename_column(self, old, new):
+        return self._add(RenameColumn(old, new))
+
+    def convert_to_double(self, *names):
+        return self._add(ConvertToDouble(list(names)))
+
+    def categorical_to_integer(self, *names):
+        return self._add(CategoricalToInteger(list(names)))
+
+    def categorical_to_one_hot(self, name):
+        return self._add(CategoricalToOneHot(name))
+
+    def filter_invalid(self, *names):
+        return self._add(FilterInvalid(list(names)))
+
+    def filter_by_condition(self, column, op, value, keep_matching=False):
+        return self._add(FilterByCondition(column, op, value, keep_matching))
+
+    def double_math_op(self, column, op, value):
+        return self._add(DoubleMathOp(column, op, value))
+
+    def normalize(self, column, mode="standardize", **stats):
+        return self._add(Normalize(column, mode, **stats))
+
+    # -- execution ---------------------------------------------------------
+
+    def schemas(self) -> List[Schema]:
+        out = [self.initial_schema]
+        for op in self.steps:
+            out.append(op.out_schema(out[-1]))
+        return out
+
+    @property
+    def final_schema(self) -> Schema:
+        return self.schemas()[-1]
+
+    def fit(self, records) -> "TransformProcess":
+        """Compute stats for stateful steps against `records` (applied
+        through the preceding steps first, like normalizer fit order)."""
+        records = [list(r) for r in records]
+        schemas = self.schemas()
+        for op, schema in zip(self.steps, schemas):
+            if hasattr(op, "fit"):
+                op.fit(records, schema)
+            records = op.apply(records, schema)
+        return self
+
+    def execute(self, records) -> List[List]:
+        """↔ LocalTransformExecutor.execute."""
+        records = [list(r) for r in records]
+        schemas = self.schemas()
+        for op, schema in zip(self.steps, schemas):
+            records = op.apply(records, schema)
+        return records
+
+    def to_matrix(self, records) -> np.ndarray:
+        """Execute and densify to float32 (feeds the dataset iterators)."""
+        return np.asarray(self.execute(records), np.float32)
+
+    # -- serde -------------------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "schema": self.initial_schema.to_dict(),
+            "steps": [{"op": type(s).__name__, **dataclasses.asdict(s)}
+                      for s in self.steps],
+        }, indent=1)
+
+    @staticmethod
+    def from_json(text: str) -> "TransformProcess":
+        d = json.loads(text)
+        steps = []
+        for sd in d["steps"]:
+            cls = _OP_REGISTRY[sd.pop("op")]
+            steps.append(cls(**sd))
+        return TransformProcess(Schema.from_dict(d["schema"]), steps)

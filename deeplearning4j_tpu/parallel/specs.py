@@ -28,7 +28,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.runtime.device import (
-    DATA_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
     SEQ_AXIS,

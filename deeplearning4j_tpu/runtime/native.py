@@ -59,8 +59,11 @@ def ensure_built(force: bool = False) -> pathlib.Path:
     buildnativeoperations.sh before the JVM can load nd4j-native)."""
     if _LIB_PATH.exists() and not force:
         return _LIB_PATH
-    subprocess.run(["make"], cwd=_NATIVE_DIR, check=True,
-                   capture_output=True, text=True)
+    proc = subprocess.run(["make"], cwd=_NATIVE_DIR,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeRuntimeError(
+            f"native build failed (exit {proc.returncode}):\n{proc.stderr}")
     return _LIB_PATH
 
 
@@ -173,6 +176,13 @@ class NativeExecutable:
         self.num_outputs = n
 
     def execute(self, args: Sequence[np.ndarray], device: int = 0) -> List[np.ndarray]:
+        if device != 0:
+            # The executable is compiled with default (device-0) placement;
+            # PJRT requires args on the execution device and this binding
+            # does not yet set execute_device / per-device compile options.
+            raise NativeRuntimeError(
+                "execute on device != 0 is not supported yet; compile with "
+                "device-specific options or use device 0")
         rt, lib = self._rt, self._rt._lib
         err = _err_buf()
         arg_handles = []

@@ -98,7 +98,8 @@ DL4J_EXPORT void* dl4j_pjrt_load(const char* plugin_path, const char** keys,
   using GetApiFn = const PJRT_Api* (*)();
   auto get_api = reinterpret_cast<GetApiFn>(dlsym(dso, "GetPjrtApi"));
   if (!get_api) {
-    copy_msg("plugin has no GetPjrtApi symbol", 30, err, errlen);
+    const char* msg = "plugin has no GetPjrtApi symbol";
+    copy_msg(msg, std::strlen(msg), err, errlen);
     dlclose(dso);
     return nullptr;
   }
@@ -288,7 +289,8 @@ DL4J_EXPORT void* dl4j_pjrt_buffer_from_host(void* handle, const void* data,
                                              char* err, size_t errlen) {
   Ctx* ctx = static_cast<Ctx*>(handle);
   if (device_index < 0 || device_index >= static_cast<int>(ctx->devices.size())) {
-    copy_msg("bad device index", 16, err, errlen);
+    const char* msg = "bad device index";
+    copy_msg(msg, std::strlen(msg), err, errlen);
     return nullptr;
   }
   PJRT_Client_BufferFromHostBuffer_Args args;
@@ -306,6 +308,12 @@ DL4J_EXPORT void* dl4j_pjrt_buffer_from_host(void* handle, const void* data,
                     err, errlen))
     return nullptr;
   if (!await_event(ctx->api, args.done_with_host_buffer, err, errlen)) {
+    // don't leak the device buffer when the H2D transfer failed
+    PJRT_Buffer_Destroy_Args dargs;
+    std::memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    dargs.buffer = args.buffer;
+    consume_error(ctx->api, ctx->api->PJRT_Buffer_Destroy(&dargs), nullptr, 0);
     return nullptr;
   }
   return args.buffer;
@@ -426,7 +434,19 @@ DL4J_EXPORT int dl4j_pjrt_execute(void* handle, void* exe, void** arg_buffers,
   if (consume_error(ctx->api, ctx->api->PJRT_LoadedExecutable_Execute(&eargs),
                     err, errlen))
     return -1;
-  if (!await_event(ctx->api, device_complete, err, errlen)) return -1;
+  if (!await_event(ctx->api, device_complete, err, errlen)) {
+    // execution failed after output buffers were allocated: free them here
+    // (the caller never sees them)
+    for (PJRT_Buffer* b : outs_vec) {
+      if (b == nullptr) continue;
+      PJRT_Buffer_Destroy_Args dargs;
+      std::memset(&dargs, 0, sizeof(dargs));
+      dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      dargs.buffer = b;
+      consume_error(ctx->api, ctx->api->PJRT_Buffer_Destroy(&dargs), nullptr, 0);
+    }
+    return -1;
+  }
   for (int i = 0; i < num_outputs; ++i) out_buffers[i] = outs_vec[i];
   return 0;
 }

@@ -36,6 +36,13 @@ def test_missing_plugin_errors_cleanly(tmp_path):
 
 @pytest.fixture(scope="module")
 def runtime():
+    # Opt-in: creating PJRT sessions against the shared TPU tunnel from test
+    # runs can wedge its claim queue (observed on the axon relay: several
+    # create/destroy cycles in quick succession left the terminal granting
+    # nothing, hanging every later client). Routine pytest must not touch
+    # the chip; set DL4J_TPU_NATIVE_TESTS=1 to run the live-plugin tests.
+    if os.environ.get("DL4J_TPU_NATIVE_TESTS") != "1":
+        pytest.skip("live-plugin tests are opt-in (DL4J_TPU_NATIVE_TESTS=1)")
     if not _plugin_available():
         pytest.skip("no PJRT plugin on this machine")
     try:
