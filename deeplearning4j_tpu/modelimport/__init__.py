@@ -1,0 +1,22 @@
+"""Model import layer (↔ deeplearning4j-modelimport + samediff-import,
+SURVEY §2.3/§2.7).
+
+- keras: Keras h5 (sequential + functional) → SequentialModel/GraphModel
+- tf: frozen TF GraphDef → autodiff SameDiff program (the BERT path)
+"""
+
+from deeplearning4j_tpu.modelimport.keras import (
+    KerasImportError,
+    import_keras_model,
+)
+from deeplearning4j_tpu.modelimport.tf import (
+    TFImportError,
+    import_tf_graph,
+)
+
+__all__ = [
+    "import_keras_model",
+    "KerasImportError",
+    "import_tf_graph",
+    "TFImportError",
+]

@@ -1,0 +1,547 @@
+"""Keras h5 import (↔ deeplearning4j-modelimport, SURVEY §2.7).
+
+ref: org.deeplearning4j.nn.modelimport.keras.{KerasModelImport, KerasModel,
+KerasSequentialModel, layers.**, Hdf5Archive} — ~60 per-layer mappers
+translating Keras 1/2 h5 configs+weights to MLN/CG. Here the target is the
+framework's config dataclasses (SequentialConfig/GraphConfig); the happy
+difference from the reference is layout: Keras and this framework are both
+channels-last with (in, out) dense kernels and HWIO conv kernels, so most
+weights copy through unchanged (the reference had to transpose everything
+into its NCHW/(out,in) conventions).
+
+Supports the Keras-3 legacy-h5 format written by the environment's
+tensorflow (`model.save("m.h5")`): `model_config` JSON attr + per-layer
+weight groups. Sequential and Functional topologies; functional merge
+layers map to GraphVertex kinds.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    NeuralNetConfiguration,
+    SequentialConfig,
+)
+from deeplearning4j_tpu.nn.layers.conv import (
+    Conv1D,
+    Conv2D,
+    Cropping2D,
+    DepthwiseConv2D,
+    GlobalPooling,
+    Pooling2D,
+    SeparableConv2D,
+    Upsampling2D,
+    ZeroPadding2D,
+)
+from deeplearning4j_tpu.nn.layers.core import (
+    ActivationLayer,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Reshape,
+)
+from deeplearning4j_tpu.nn.layers.norm import BatchNorm, LayerNorm
+from deeplearning4j_tpu.nn.layers.recurrent import GRU, LSTM, SimpleRnn
+
+
+class KerasImportError(Exception):
+    pass
+
+
+_ACTIVATIONS = {
+    "relu": "relu", "relu6": "relu6", "sigmoid": "sigmoid", "tanh": "tanh",
+    "softmax": "softmax", "linear": "identity", "elu": "elu", "selu": "selu",
+    "softplus": "softplus", "softsign": "softsign", "gelu": "gelu",
+    "swish": "swish", "silu": "swish", "exponential": "exp",
+    "hard_sigmoid": "hard_sigmoid", "leaky_relu": "leaky_relu",
+    "mish": "mish",
+}
+
+
+def _act(name) -> str:
+    if name is None:
+        return "identity"
+    if isinstance(name, dict):  # serialized Activation object
+        name = name.get("class_name", "linear").lower()
+    out = _ACTIVATIONS.get(str(name))
+    if out is None:
+        raise KerasImportError(f"unsupported Keras activation {name!r}")
+    return out
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _padding(cfg) -> str:
+    p = cfg.get("padding", "valid")
+    if isinstance(p, str):
+        return p.upper()
+    raise KerasImportError(f"unsupported padding {p!r}")
+
+
+# --- per-layer mappers -----------------------------------------------------
+# mapper(cfg) -> (LayerConfig | None, weight_map) where weight_map maps our
+# param name -> (keras weight suffix, transform fn | None). None layer means
+# structural no-op (InputLayer).
+
+def _dense(cfg):
+    return Dense(units=cfg["units"], activation=_act(cfg.get("activation")),
+                 use_bias=cfg.get("use_bias", True)), \
+        {"W": ("kernel", None), "b": ("bias", None)}
+
+
+def _conv2d(cfg):
+    if cfg.get("data_format") not in (None, "channels_last"):
+        raise KerasImportError("channels_first Conv2D not supported")
+    return Conv2D(
+        filters=cfg["filters"], kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), padding=_padding(cfg),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        groups=cfg.get("groups", 1),
+        activation=_act(cfg.get("activation")),
+        use_bias=cfg.get("use_bias", True),
+    ), {"W": ("kernel", None), "b": ("bias", None)}
+
+
+def _conv1d(cfg):
+    return Conv1D(
+        filters=cfg["filters"], kernel=cfg["kernel_size"][0]
+        if isinstance(cfg["kernel_size"], (list, tuple)) else cfg["kernel_size"],
+        stride=cfg.get("strides", [1])[0] if isinstance(cfg.get("strides", 1), (list, tuple))
+        else cfg.get("strides", 1),
+        padding=_padding(cfg), activation=_act(cfg.get("activation")),
+        use_bias=cfg.get("use_bias", True),
+    ), {"W": ("kernel", None), "b": ("bias", None)}
+
+
+def _depthwise(cfg):
+    return DepthwiseConv2D(
+        depth_multiplier=cfg.get("depth_multiplier", 1),
+        kernel=_pair(cfg["kernel_size"]), stride=_pair(cfg.get("strides", 1)),
+        padding=_padding(cfg), activation=_act(cfg.get("activation")),
+        use_bias=cfg.get("use_bias", True),
+        # keras 2 names it depthwise_kernel, keras 3 plain kernel
+    ), {"W": (("depthwise_kernel", "kernel"), None), "b": ("bias", None)}
+
+
+def _separable(cfg):
+    return SeparableConv2D(
+        filters=cfg["filters"], kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), padding=_padding(cfg),
+        activation=_act(cfg.get("activation")),
+        use_bias=cfg.get("use_bias", True),
+    ), {"dW": ("depthwise_kernel", None), "pW": ("pointwise_kernel", None),
+        "b": ("bias", None)}
+
+
+def _pool(kind):
+    def mapper(cfg):
+        return Pooling2D(
+            pool_type=kind, window=_pair(cfg.get("pool_size", 2)),
+            stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+            padding=_padding(cfg),
+        ), {}
+
+    return mapper
+
+
+def _global_pool(kind):
+    def mapper(cfg):
+        return GlobalPooling(pool_type=kind), {}
+
+    return mapper
+
+
+def _batchnorm(cfg):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, list):
+        axis = axis[0]
+    if axis not in (-1, 3, 2, 1):
+        raise KerasImportError(f"BatchNormalization axis {axis} unsupported")
+    return BatchNorm(momentum=cfg.get("momentum", 0.99), eps=cfg.get("epsilon", 1e-3)), {
+        "gamma": ("gamma", None), "beta": ("beta", None),
+        "state:mean": ("moving_mean", None),
+        "state:var": ("moving_variance", None),
+    }
+
+
+def _layernorm(cfg):
+    return LayerNorm(eps=cfg.get("epsilon", 1e-3)), {
+        "gamma": ("gamma", None), "beta": ("beta", None)}
+
+
+def _lstm(cfg):
+    # forget_bias=0: keras' unit_forget_bias is already baked into the
+    # saved bias vector; adding our layer's runtime forget_bias on top
+    # would double it.
+    layer = LSTM(units=cfg["units"],
+                 return_sequences=cfg.get("return_sequences", False),
+                 forget_bias=0.0)
+    if _act(cfg.get("activation", "tanh")) != "tanh" or \
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        raise KerasImportError(
+            "LSTM with non-default activations (incl. hard_sigmoid "
+            "recurrent) does not match this framework's tanh/sigmoid cell")
+    # keras gate order i,f,c,o == ours; unit_forget_bias already baked into b
+    return layer, {"W": ("kernel", None), "RW": ("recurrent_kernel", None),
+                   "b": ("bias", None)}
+
+
+def _gru_reorder(w):
+    """keras gate order z,r,h → ours r,z,n (blocks along last dim)."""
+    h = w.shape[-1] // 3
+    z, r, n = w[..., :h], w[..., h:2 * h], w[..., 2 * h:]
+    return np.concatenate([r, z, n], axis=-1)
+
+
+def _gru_bias(b):
+    """keras reset_after bias [2, 3h] (input+recurrent). Our cell folds a
+    single bias; only the input-side bias maps exactly — require the
+    recurrent side to be ~0 (true for freshly-initialized and many trained
+    nets; otherwise refuse rather than import wrong math)."""
+    if b.ndim == 2:
+        if np.abs(b[1]).max() > 1e-6:
+            raise KerasImportError(
+                "GRU with nonzero recurrent bias cannot be mapped exactly "
+                "onto this framework's reset-after GRU cell; fold the "
+                "recurrent bias into the input bias before export, or "
+                "rebuild the layer natively")
+        b = b[0]
+    return _gru_reorder(b)
+
+
+def _gru(cfg):
+    if not cfg.get("reset_after", True):
+        # keras reset_after=False applies the reset gate BEFORE the
+        # recurrent projection; our cell (cuDNN variant) applies it after —
+        # different math whenever r != 1, so refuse.
+        raise KerasImportError(
+            "GRU(reset_after=False) does not match this framework's "
+            "reset-after GRU cell; re-export with reset_after=True")
+    return GRU(units=cfg["units"],
+               return_sequences=cfg.get("return_sequences", False)), {
+        "W": ("kernel", _gru_reorder),
+        "RW": ("recurrent_kernel", _gru_reorder),
+        "b": ("bias", _gru_bias),
+    }
+
+
+def _simple_rnn(cfg):
+    return SimpleRnn(units=cfg["units"],
+                     return_sequences=cfg.get("return_sequences", False),
+                     activation=_act(cfg.get("activation", "tanh"))), {
+        "W": ("kernel", None), "RW": ("recurrent_kernel", None),
+        "b": ("bias", None)}
+
+
+def _embedding(cfg):
+    return Embedding(vocab_size=cfg["input_dim"], units=cfg["output_dim"]), {
+        "W": ("embeddings", None)}
+
+
+def _activation(cfg):
+    return ActivationLayer(activation=_act(cfg.get("activation"))), {}
+
+
+def _dropout(cfg):
+    return Dropout(rate=cfg.get("rate", 0.5)), {}
+
+
+def _flatten(cfg):
+    return Flatten(), {}
+
+
+def _reshape(cfg):
+    return Reshape(target_shape=list(cfg["target_shape"])), {}
+
+
+def _flat4(v) -> Tuple[int, int, int, int]:
+    """Keras padding/cropping (int | (h,w) | ((t,b),(l,r))) → flat
+    (top, bottom, left, right)."""
+    if isinstance(v, int):
+        return (v, v, v, v)
+    a, b = v
+    if isinstance(a, int):
+        return (a, a, b, b)
+    return (a[0], a[1], b[0], b[1])
+
+
+def _zeropad(cfg):
+    return ZeroPadding2D(padding=_flat4(cfg.get("padding", 1))), {}
+
+
+def _upsample(cfg):
+    if cfg.get("interpolation", "nearest") != "nearest":
+        raise KerasImportError(
+            "UpSampling2D interpolation != 'nearest' unsupported")
+    s = cfg.get("size", 2)
+    return Upsampling2D(scale=tuple(s) if isinstance(s, (list, tuple)) else s), {}
+
+
+def _cropping(cfg):
+    return Cropping2D(cropping=_flat4(cfg.get("cropping", 0))), {}
+
+
+LAYER_MAPPERS: Dict[str, Callable] = {
+    "Dense": _dense,
+    "Conv2D": _conv2d,
+    "Convolution2D": _conv2d,
+    "Conv1D": _conv1d,
+    "DepthwiseConv2D": _depthwise,
+    "SeparableConv2D": _separable,
+    "MaxPooling2D": _pool("max"),
+    "AveragePooling2D": _pool("avg"),
+    "GlobalAveragePooling2D": _global_pool("avg"),
+    "GlobalMaxPooling2D": _global_pool("max"),
+    "GlobalAveragePooling1D": _global_pool("avg"),
+    "BatchNormalization": _batchnorm,
+    "LayerNormalization": _layernorm,
+    "LSTM": _lstm,
+    "GRU": _gru,
+    "SimpleRNN": _simple_rnn,
+    "Embedding": _embedding,
+    "Activation": _activation,
+    "Dropout": _dropout,
+    "SpatialDropout2D": _dropout,
+    "Flatten": _flatten,
+    "Reshape": _reshape,
+    "ZeroPadding2D": _zeropad,
+    "UpSampling2D": _upsample,
+    "Cropping2D": _cropping,
+}
+
+# functional merge layers → GraphVertex kinds
+MERGE_KINDS = {
+    "Add": "add", "Concatenate": "merge", "Multiply": "mul",
+    "Average": "average", "Maximum": "max", "Subtract": "subtract",
+}
+
+
+def _map_layer(class_name: str, cfg: dict):
+    if class_name == "InputLayer":
+        return None, {}
+    mapper = LAYER_MAPPERS.get(class_name)
+    if mapper is None:
+        raise KerasImportError(
+            f"no mapper for Keras layer {class_name!r} "
+            f"(supported: {sorted(LAYER_MAPPERS)})")
+    return mapper(cfg)
+
+
+# --- weights ---------------------------------------------------------------
+
+
+def _layer_weights(h5file, layer_name: str) -> Dict[str, np.ndarray]:
+    """Weight arrays for one layer, keyed by their last path component."""
+    mw = h5file["model_weights"]
+    if layer_name not in mw:
+        return {}
+    grp = mw[layer_name]
+    names = [n if isinstance(n, str) else n.decode()
+             for n in grp.attrs.get("weight_names", [])]
+    out = {}
+    for n in names:
+        out[n.split("/")[-1].split(":")[0]] = np.asarray(grp[n])
+    return out
+
+
+# Suffixes allowed to be absent (use_bias=False, BN scale/center=False).
+_OPTIONAL_SUFFIXES = {"bias", "gamma", "beta"}
+
+
+def _fill_params(weight_map, kweights, layer_cls: str):
+    params, state = {}, {}
+    for ours, (suffixes, transform) in weight_map.items():
+        if isinstance(suffixes, str):
+            suffixes = (suffixes,)
+        found = next((s for s in suffixes if s in kweights), None)
+        if found is None:
+            if all(s in _OPTIONAL_SUFFIXES for s in suffixes):
+                continue
+            # A required weight that didn't match would silently leave the
+            # layer at its random initialization — refuse instead.
+            raise KerasImportError(
+                f"{layer_cls}: required weight {suffixes} not found in h5 "
+                f"(available: {sorted(kweights)})")
+        arr = kweights[found]
+        if transform is not None:
+            arr = transform(arr)
+        if ours.startswith("state:"):
+            state[ours.split(":", 1)[1]] = arr
+        else:
+            params[ours] = arr
+    return params, state
+
+
+def _input_shape_of(layer_cfg: dict) -> Optional[Tuple[int, ...]]:
+    shape = layer_cfg.get("batch_shape") or layer_cfg.get("batch_input_shape")
+    if shape is None:
+        return None
+    return tuple(d for d in shape[1:])
+
+
+# --- entry points ----------------------------------------------------------
+
+
+def import_keras_model(path, *, updater=None):
+    """↔ KerasModelImport.importKerasSequentialModel/importKerasModel.
+
+    Returns (model, variables): a SequentialModel or GraphModel plus the
+    imported {params, state} pytree ready for model.apply.
+    """
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        if "model_config" not in f.attrs:
+            raise KerasImportError("h5 file has no model_config attr "
+                                   "(not a Keras model save?)")
+        raw = f.attrs["model_config"]
+        cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
+        if cfg["class_name"] == "Sequential":
+            return _import_sequential(f, cfg["config"], updater)
+        if cfg["class_name"] in ("Functional", "Model"):
+            return _import_functional(f, cfg["config"], updater)
+        raise KerasImportError(f"unknown model class {cfg['class_name']!r}")
+
+
+def _import_sequential(f, config: dict, updater):
+    from deeplearning4j_tpu.nn.model import SequentialModel
+
+    layers, per_layer = [], []
+    input_shape = None
+    for ld in config["layers"]:
+        lcfg = ld["config"]
+        if input_shape is None:
+            shp = _input_shape_of(lcfg)
+            if shp is not None:
+                input_shape = shp
+        layer, wmap = _map_layer(ld["class_name"], lcfg)
+        if layer is None:
+            continue
+        layer.name = lcfg.get("name")
+        layers.append(layer)
+        per_layer.append((lcfg.get("name"), ld["class_name"], wmap))
+    if input_shape is None:
+        raise KerasImportError("could not infer input shape from config")
+    if any(d is None for d in input_shape):
+        raise KerasImportError(
+            f"input shape {input_shape} has unknown (None) dims beyond batch")
+
+    net = NeuralNetConfiguration(updater=updater)
+    model = SequentialModel(SequentialConfig(
+        net=net, layers=layers, input_shape=input_shape))
+
+    params, state = {}, {}
+    for model_name, (kname, kcls, wmap) in zip(model.layer_names, per_layer):
+        kweights = _layer_weights(f, kname)
+        p, s = _fill_params(wmap, kweights, kcls)
+        if p:
+            params[model_name] = p
+        if s:
+            state[model_name] = s
+    # layers without imported weights (pool/flatten/...) own no params.
+    variables = _merge_with_init(model, params, state)
+    return model, variables
+
+
+def _inbound_names(inbound_nodes) -> List[str]:
+    """Input layer names from Keras inbound_nodes (keras 2 and 3 formats)."""
+    names: List[str] = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            if obj.get("class_name") == "__keras_tensor__":
+                names.append(obj["config"]["keras_history"][0])
+                return
+            for v in obj.values():
+                walk(v)
+        elif isinstance(obj, (list, tuple)):
+            # keras2 triplets: ["layer", node_idx, tensor_idx, {...}]
+            if (len(obj) >= 3 and isinstance(obj[0], str)
+                    and isinstance(obj[1], int) and isinstance(obj[2], int)):
+                names.append(obj[0])
+                return
+            for v in obj:
+                walk(v)
+
+    walk(inbound_nodes)
+    return names
+
+
+def _import_functional(f, config: dict, updater):
+    from deeplearning4j_tpu.nn.model import GraphModel
+
+    vertices: Dict[str, GraphVertex] = {}
+    weight_info: Dict[str, Tuple[str, dict]] = {}
+    inputs: List[str] = []
+    input_shapes: Dict[str, Tuple[int, ...]] = {}
+
+    for ld in config["layers"]:
+        lcfg = ld["config"]
+        name = lcfg.get("name")
+        inbound = _inbound_names(ld.get("inbound_nodes", []))
+        if ld["class_name"] == "InputLayer":
+            shp = _input_shape_of(lcfg)
+            if shp is None or any(d is None for d in shp):
+                raise KerasImportError(f"input {name}: unknown shape {shp}")
+            inputs.append(name)
+            input_shapes[name] = shp
+            continue
+        if ld["class_name"] in MERGE_KINDS:
+            vertices[name] = GraphVertex(kind=MERGE_KINDS[ld["class_name"]],
+                                         inputs=inbound)
+            continue
+        layer, wmap = _map_layer(ld["class_name"], lcfg)
+        layer.name = name
+        vertices[name] = GraphVertex(kind="layer", inputs=inbound, layer=layer)
+        weight_info[name] = (ld["class_name"], wmap)
+
+    out_names = _inbound_names(config.get("output_layers", []))
+    if not out_names:
+        raise KerasImportError("functional model without output_layers")
+
+    net = NeuralNetConfiguration(updater=updater)
+    model = GraphModel(GraphConfig(
+        net=net, inputs=inputs, input_shapes=input_shapes,
+        vertices=vertices, outputs=out_names))
+
+    params, state = {}, {}
+    for name, (kcls, wmap) in weight_info.items():
+        p, s = _fill_params(wmap, _layer_weights(f, name), kcls)
+        if p:
+            params[name] = p
+        if s:
+            state[name] = s
+    variables = _merge_with_init(model, params, state)
+    return model, variables
+
+
+def _merge_with_init(model, params, state):
+    """Initialize then overwrite with imported tensors — guarantees the
+    variables pytree has exactly the structure model.apply expects, and
+    shape-checks every imported array against it."""
+    variables = model.init(seed=0)
+    for scope, src in (("params", params), ("state", state)):
+        dst = variables[scope]
+        for lname, ptree in src.items():
+            if lname not in dst:
+                raise KerasImportError(
+                    f"imported weights for unknown layer {lname!r}")
+            for k, v in ptree.items():
+                if k not in dst[lname]:
+                    raise KerasImportError(f"{lname}: unexpected param {k!r}")
+                want = np.asarray(dst[lname][k]).shape
+                if tuple(v.shape) != tuple(want):
+                    raise KerasImportError(
+                        f"{lname}.{k}: shape {v.shape} != expected {want}")
+                dst[lname][k] = np.asarray(v, np.asarray(dst[lname][k]).dtype)
+    return variables

@@ -1,0 +1,616 @@
+"""TF frozen-GraphDef import → SameDiff program (↔ samediff-import, SURVEY §2.3).
+
+ref: nd4j/samediff-import-tensorflow (OpMappingRegistry, TensorflowImporter)
+and the legacy org.nd4j.imports.graphmapper.tf.TFGraphMapper: per-op mapping
+rules translate GraphDef nodes into SameDiff ops. Same architecture here —
+a registry of per-op mappers targeting the autodiff.SameDiff graph — with
+the TPU-era difference downstream: the imported graph compiles as ONE XLA
+program (SameDiff.output / export_stablehlo) instead of running through the
+per-op interpreter (SURVEY §3.2's BERT call stack collapses to one dispatch).
+
+Oracle testing (SURVEY §4 pattern): tests freeze small tf.functions with
+convert_variables_to_constants_v2 and compare this importer's outputs
+against tensorflow's own execution of the same graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import (
+    OP_REGISTRY,
+    SameDiff,
+    SDVariable,
+    register_op,
+)
+
+
+class TFImportError(Exception):
+    pass
+
+
+# --- extra ops needed by TF graphs (registered under tfimport.*) -----------
+
+def _register_tfimport_ops():
+    import jax
+    import jax.numpy as jnp
+
+    def strided_slice(x, begin, end, strides, begin_mask=0, end_mask=0,
+                      shrink_axis_mask=0, new_axis_mask=0, ellipsis_mask=0):
+        if ellipsis_mask or new_axis_mask:
+            raise NotImplementedError("ellipsis/new_axis in StridedSlice")
+        idx = []
+        for i in range(len(begin)):
+            b = None if (begin_mask >> i) & 1 else begin[i]
+            e = None if (end_mask >> i) & 1 else end[i]
+            s = strides[i]
+            if (shrink_axis_mask >> i) & 1:
+                idx.append(begin[i])
+            else:
+                idx.append(slice(b, e, s))
+        return x[tuple(idx)]
+
+    def fused_batch_norm(x, scale, offset, mean, var, epsilon=1e-3):
+        inv = scale * jax.lax.rsqrt(var + epsilon)
+        return x * inv + (offset - mean * inv)
+
+    def conv2d_tf(x, w, strides, padding, dilations=(1, 1, 1, 1)):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=tuple(strides[1:3]), padding=padding,
+            rhs_dilation=tuple(dilations[1:3]),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def depthwise_conv2d_tf(x, w, strides, padding, dilations=(1, 1, 1, 1)):
+        kh, kw, c, m = w.shape
+        w2 = w.reshape(kh, kw, 1, c * m)
+        return jax.lax.conv_general_dilated(
+            x, w2, window_strides=tuple(strides[1:3]), padding=padding,
+            rhs_dilation=tuple(dilations[1:3]),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c)
+
+    def pool_tf(x, ksize, strides, padding, kind):
+        import jax.numpy as jnp
+
+        window = (1, ksize[1], ksize[2], 1)
+        stride = (1, strides[1], strides[2], 1)
+        if kind == "max":
+            return jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, window, stride, padding)
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride, padding)
+        if padding == "VALID":
+            return s / (ksize[1] * ksize[2])
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, stride, padding)
+        return s / cnt
+
+    def batch_matmul(a, b, adj_x=False, adj_y=False):
+        if adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    def matmul_t(a, b, transpose_a=False, transpose_b=False):
+        if transpose_a:
+            a = a.T
+        if transpose_b:
+            b = b.T
+        return jnp.matmul(a, b)
+
+    def pad_tf(x, paddings, constant_value=0.0):
+        return jnp.pad(x, [tuple(p) for p in paddings], constant_values=constant_value)
+
+    def split_v(x, num_or_sizes, axis):
+        return tuple(jnp.split(x, num_or_sizes, axis=axis))
+
+    table = {
+        "tfimport.strided_slice": strided_slice,
+        "tfimport.fused_batch_norm": fused_batch_norm,
+        "tfimport.conv2d": conv2d_tf,
+        "tfimport.depthwise_conv2d": depthwise_conv2d_tf,
+        "tfimport.max_pool": lambda x, ksize, strides, padding: pool_tf(
+            x, ksize, strides, padding, "max"),
+        "tfimport.avg_pool": lambda x, ksize, strides, padding: pool_tf(
+            x, ksize, strides, padding, "avg"),
+        "tfimport.batch_matmul": batch_matmul,
+        "tfimport.matmul": matmul_t,
+        "tfimport.pad": pad_tf,
+        "tfimport.split": split_v,
+        "tfimport.leaky_relu": lambda x, alpha=0.2: jax.nn.leaky_relu(x, alpha),
+        "tfimport.squared_difference": lambda a, b: jnp.square(a - b),
+        "tfimport.rsqrt": jax.lax.rsqrt,
+        "tfimport.erf": jax.scipy.special.erf,
+        "tfimport.select": lambda c, a, b: jnp.where(c, a, b),
+        "tfimport.range": lambda start, limit, delta: jnp.arange(start, limit, delta),
+        "tfimport.fill": lambda dims, value: jnp.full(tuple(dims), value),
+        "tfimport.floor_div": jnp.floor_divide,
+        "tfimport.floor_mod": jnp.mod,
+    }
+    for name, fn in table.items():
+        register_op(name, fn)
+
+
+_register_tfimport_ops()
+
+
+# --- node attr helpers -----------------------------------------------------
+
+
+def _attr(node, name, default=None):
+    if name not in node.attr:
+        return default
+    a = node.attr[name]
+    kind = a.WhichOneof("value")
+    if kind == "i":
+        return int(a.i)
+    if kind == "f":
+        return float(a.f)
+    if kind == "b":
+        return bool(a.b)
+    if kind == "s":
+        return a.s.decode()
+    if kind == "list":
+        if a.list.i:
+            return [int(v) for v in a.list.i]
+        if a.list.f:
+            return [float(v) for v in a.list.f]
+        if a.list.s:
+            return [v.decode() for v in a.list.s]
+        return []
+    if kind == "type":
+        return int(a.type)
+    if kind == "shape":
+        return [d.size for d in a.shape.dim]
+    if kind == "tensor":
+        return a.tensor
+    return default
+
+
+_TF_DTYPES = {
+    1: "float32", 2: "float64", 3: "int32", 4: "uint8", 5: "int16",
+    6: "int8", 9: "int64", 10: "bool", 14: "bfloat16", 19: "float16",
+    22: "uint16", 23: "uint32",
+}
+
+
+def _np_dtype(tf_type: int) -> str:
+    # "bfloat16" passes through: ml_dtypes registers it with numpy/jax, so
+    # Cast/Placeholder keep real bfloat16 semantics.
+    if tf_type not in _TF_DTYPES:
+        raise TFImportError(f"unsupported TF dtype enum {tf_type}")
+    return _TF_DTYPES[tf_type]
+
+
+# --- the import ------------------------------------------------------------
+
+
+class _GraphImporter:
+    """Walks GraphDef nodes, emitting SameDiff ops via the mapper registry
+    (↔ TFGraphMapper.importGraph)."""
+
+    def __init__(self, graph_def, input_shapes: Dict[str, Tuple], sd: SameDiff):
+        self.gd = graph_def
+        self.sd = sd
+        self.input_shapes = input_shapes
+        self.vars: Dict[str, Any] = {}  # tf tensor name -> SDVariable
+        self.consts: Dict[str, np.ndarray] = {}  # host-known constant values
+
+    def tensor(self, ref: str) -> SDVariable:
+        name = ref.split(":")[0].lstrip("^")
+        idx = int(ref.split(":")[1]) if ":" in ref else 0
+        v = self.vars.get(name)
+        if v is None:
+            raise TFImportError(f"tensor {ref!r} produced by unknown node")
+        if isinstance(v, tuple):
+            return v[idx]
+        if idx != 0:
+            raise TFImportError(f"node {name} has one output; wanted :{idx}")
+        return v
+
+    def const_value(self, ref: str) -> np.ndarray:
+        """Host-side value of a constant input (shapes, perms, axes...)."""
+        name = ref.split(":")[0]
+        if name not in self.consts:
+            raise TFImportError(
+                f"op needs host-known constant for {ref!r}, but {name!r} "
+                "is not a Const node")
+        return self.consts[name]
+
+    def run(self, outputs: Sequence[str]) -> Dict[str, str]:
+        from tensorflow.python.framework import tensor_util
+
+        name_map: Dict[str, str] = {}
+        for node in self.gd.node:
+            op = node.op
+            if op == "Placeholder":
+                shape = self.input_shapes.get(node.name)
+                if shape is None:
+                    shape = _attr(node, "shape")
+                    if shape is None:
+                        raise TFImportError(
+                            f"placeholder {node.name} needs an input_shapes entry")
+                    shape = tuple(None if d in (-1, None) else d for d in shape)
+                dtype = _np_dtype(_attr(node, "dtype", 1))
+                self.vars[node.name] = self.sd.placeholder(
+                    node.name, shape, dtype)
+            elif op == "Const":
+                arr = tensor_util.MakeNdarray(node.attr["value"].tensor)
+                self.consts[node.name] = arr
+                self.vars[node.name] = self.sd.constant(
+                    _uniq(self.sd, node.name), arr)
+            elif op in ("Identity", "StopGradient", "PreventGradient", "CheckNumerics"):
+                self.vars[node.name] = self.tensor(node.input[0])
+                # Const→Identity chains (grappler leaves these) must keep the
+                # host-known value visible to shape/axis consumers.
+                src = node.input[0].split(":")[0].lstrip("^")
+                if src in self.consts:
+                    self.consts[node.name] = self.consts[src]
+            elif op == "NoOp":
+                continue
+            else:
+                mapper = TF_OP_MAPPERS.get(op)
+                if mapper is None:
+                    raise TFImportError(
+                        f"no mapper for TF op {op!r} (node {node.name}); "
+                        f"supported: {sorted(TF_OP_MAPPERS)}")
+                self.vars[node.name] = mapper(self, node)
+        for out in outputs:
+            name_map[out] = self.tensor(out).name
+        return name_map
+
+
+def _uniq(sd: SameDiff, base: str) -> str:
+    name = base
+    i = 0
+    while name in sd._vars:
+        i += 1
+        name = f"{base}__{i}"
+    return name
+
+
+# mapper(importer, node) -> SDVariable | tuple
+
+TF_OP_MAPPERS: Dict[str, Callable] = {}
+
+
+def tf_op(*names):
+    def deco(fn):
+        for n in names:
+            TF_OP_MAPPERS[n] = fn
+        return fn
+
+    return deco
+
+
+def _simple(op_name):
+    """Mapper for ops that take their TF inputs positionally."""
+
+    def mapper(imp: _GraphImporter, node):
+        ins = [imp.tensor(r) for r in node.input if not r.startswith("^")]
+        return imp.sd._record(op_name, ins, {
+            "__argspec__": ["var"] * len(ins), "__posattrs__": []})
+
+    return mapper
+
+
+for tf_name, our_op in {
+    "Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
+    "RealDiv": "div", "Div": "div", "Pow": "pow", "Neg": "neg",
+    "Maximum": "maximum", "Minimum": "minimum",
+    "Relu": "relu", "Relu6": "relu6", "Elu": "elu", "Selu": "selu",
+    "Sigmoid": "sigmoid", "Tanh": "tanh", "Softplus": "softplus",
+    "Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Square": "square",
+    "Abs": "abs", "Sign": "math.sign", "Floor": "math.floor",
+    "Ceil": "math.ceil", "Round": "math.round", "Sin": "math.sin",
+    "Cos": "math.cos", "Erf": "tfimport.erf", "Rsqrt": "tfimport.rsqrt",
+    "LogicalAnd": "math.logical_and" if "math.logical_and" in OP_REGISTRY else "mul",
+    "Equal": "eq", "NotEqual": "neq", "Greater": "gt",
+    "GreaterEqual": "gte", "Less": "lt", "LessEqual": "lte",
+    "SquaredDifference": "tfimport.squared_difference",
+    "Select": "tfimport.select", "SelectV2": "tfimport.select",
+    "FloorDiv": "tfimport.floor_div", "FloorMod": "tfimport.floor_mod",
+    "ZerosLike": "zeros_like", "OnesLike": "ones_like",
+}.items():
+    TF_OP_MAPPERS[tf_name] = _simple(our_op)
+
+
+@tf_op("MatMul")
+def _matmul(imp, node):
+    a, b = (imp.tensor(r) for r in node.input[:2])
+    return imp.sd._record("tfimport.matmul", [a, b], {
+        "__argspec__": ["var", "var"], "__posattrs__": [],
+        "transpose_a": _attr(node, "transpose_a", False),
+        "transpose_b": _attr(node, "transpose_b", False)})
+
+
+@tf_op("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
+def _batch_matmul(imp, node):
+    a, b = (imp.tensor(r) for r in node.input[:2])
+    return imp.sd._record("tfimport.batch_matmul", [a, b], {
+        "__argspec__": ["var", "var"], "__posattrs__": [],
+        "adj_x": _attr(node, "adj_x", False),
+        "adj_y": _attr(node, "adj_y", False)})
+
+
+@tf_op("BiasAdd")
+def _bias_add(imp, node):
+    a, b = (imp.tensor(r) for r in node.input[:2])
+    if _attr(node, "data_format", "NHWC") == "NCHW":
+        raise TFImportError("BiasAdd NCHW unsupported")
+    return imp.sd._record("add", [a, b], {})
+
+
+@tf_op("Softmax")
+def _softmax(imp, node):
+    return imp.sd._record("softmax", [imp.tensor(node.input[0])], {"axis": -1})
+
+
+@tf_op("LogSoftmax")
+def _log_softmax(imp, node):
+    return imp.sd._record("log_softmax", [imp.tensor(node.input[0])], {"axis": -1})
+
+
+@tf_op("LeakyRelu")
+def _leaky(imp, node):
+    return imp.sd._record("tfimport.leaky_relu", [imp.tensor(node.input[0])], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "alpha": _attr(node, "alpha", 0.2)})
+
+
+@tf_op("Cast")
+def _cast(imp, node):
+    return imp.sd._record("cast", [imp.tensor(node.input[0])], {
+        "dtype": _np_dtype(_attr(node, "DstT", 1))})
+
+
+def _reduction(our_op):
+    def mapper(imp, node):
+        x = imp.tensor(node.input[0])
+        axes = imp.const_value(node.input[1])
+        axes = [int(a) for a in np.atleast_1d(axes)]
+        return imp.sd._record(our_op, [x], {
+            "axis": axes if len(axes) > 1 else axes[0],
+            "keepdims": bool(_attr(node, "keep_dims", False))})
+
+    return mapper
+
+
+TF_OP_MAPPERS["Mean"] = _reduction("mean")
+TF_OP_MAPPERS["Sum"] = _reduction("sum")
+TF_OP_MAPPERS["Max"] = _reduction("max")
+TF_OP_MAPPERS["Min"] = _reduction("min")
+TF_OP_MAPPERS["Prod"] = _reduction("prod")
+
+
+@tf_op("Reshape")
+def _reshape(imp, node):
+    x = imp.tensor(node.input[0])
+    shape = [int(v) for v in imp.const_value(node.input[1])]
+    return imp.sd._record("reshape", [x], {"shape": shape})
+
+
+@tf_op("Transpose")
+def _transpose(imp, node):
+    x = imp.tensor(node.input[0])
+    perm = [int(v) for v in imp.const_value(node.input[1])]
+    return imp.sd._record("permute", [x], {"axes": perm})
+
+
+@tf_op("ExpandDims")
+def _expand_dims(imp, node):
+    x = imp.tensor(node.input[0])
+    axis = int(np.atleast_1d(imp.const_value(node.input[1]))[0])
+    return imp.sd._record("expand_dims", [x], {"axis": axis})
+
+
+@tf_op("Squeeze")
+def _squeeze(imp, node):
+    dims = _attr(node, "squeeze_dims", []) or None
+    return imp.sd._record("squeeze", [imp.tensor(node.input[0])], {
+        "axis": dims if dims else None})
+
+
+@tf_op("ConcatV2")
+def _concat(imp, node):
+    xs = [imp.tensor(r) for r in node.input[:-1]]
+    axis = int(np.atleast_1d(imp.const_value(node.input[-1]))[0])
+    return imp.sd._record("concat", xs, {
+        "__argspec__": ["var"] * len(xs), "__posattrs__": [], "axis": axis})
+
+
+@tf_op("Pack")
+def _pack(imp, node):
+    xs = [imp.tensor(r) for r in node.input]
+    return imp.sd._record("stack", xs, {
+        "__argspec__": ["var"] * len(xs), "__posattrs__": [],
+        "axis": _attr(node, "axis", 0)})
+
+
+@tf_op("StridedSlice")
+def _strided_slice(imp, node):
+    x = imp.tensor(node.input[0])
+    begin = [int(v) for v in imp.const_value(node.input[1])]
+    end = [int(v) for v in imp.const_value(node.input[2])]
+    strides = [int(v) for v in imp.const_value(node.input[3])]
+    return imp.sd._record("tfimport.strided_slice", [x], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "begin": begin, "end": end, "strides": strides,
+        "begin_mask": _attr(node, "begin_mask", 0),
+        "end_mask": _attr(node, "end_mask", 0),
+        "shrink_axis_mask": _attr(node, "shrink_axis_mask", 0),
+        "new_axis_mask": _attr(node, "new_axis_mask", 0),
+        "ellipsis_mask": _attr(node, "ellipsis_mask", 0)})
+
+
+@tf_op("GatherV2", "Gather")
+def _gather(imp, node):
+    params, indices = imp.tensor(node.input[0]), imp.tensor(node.input[1])
+    axis = 0
+    if len(node.input) > 2:
+        axis = int(np.atleast_1d(imp.const_value(node.input[2]))[0])
+    return imp.sd._record("gather", [params, indices], {
+        "__argspec__": ["var", "var"], "__posattrs__": [], "axis": axis})
+
+
+@tf_op("OneHot")
+def _one_hot(imp, node):
+    indices = imp.tensor(node.input[0])
+    depth = int(np.atleast_1d(imp.const_value(node.input[1]))[0])
+    on = float(np.atleast_1d(imp.const_value(node.input[2]))[0])
+    off = float(np.atleast_1d(imp.const_value(node.input[3]))[0])
+    return imp.sd._record("math.one_hot", [indices], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "depth": depth, "on_value": on, "off_value": off,
+        "axis": _attr(node, "axis", -1)})
+
+
+@tf_op("Pad", "PadV2")
+def _pad(imp, node):
+    x = imp.tensor(node.input[0])
+    paddings = [[int(a), int(b)] for a, b in imp.const_value(node.input[1])]
+    cval = 0.0
+    if len(node.input) > 2:
+        cval = float(np.atleast_1d(imp.const_value(node.input[2]))[0])
+    return imp.sd._record("tfimport.pad", [x], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "paddings": paddings, "constant_value": cval})
+
+
+@tf_op("Tile")
+def _tile(imp, node):
+    x = imp.tensor(node.input[0])
+    reps = [int(v) for v in imp.const_value(node.input[1])]
+    return imp.sd._record("tile", [x], {"reps": reps})
+
+
+@tf_op("Fill")
+def _fill(imp, node):
+    dims = [int(v) for v in imp.const_value(node.input[0])]
+    value = imp.tensor(node.input[1])
+    return imp.sd._record("tfimport.fill", [value], {
+        "__argspec__": ["attr", "var"], "__posattrs__": [dims]})
+
+
+@tf_op("Range")
+def _range(imp, node):
+    start, limit, delta = (np.atleast_1d(imp.const_value(r))[0]
+                           for r in node.input[:3])
+    dtype = _np_dtype(_attr(node, "Tidx", _attr(node, "Tout", 1)))
+    arr = np.arange(start, limit, delta).astype(dtype)
+    return imp.sd.constant(_uniq(imp.sd, node.name), arr)
+
+
+@tf_op("Conv2D")
+def _conv2d(imp, node):
+    x, w = imp.tensor(node.input[0]), imp.tensor(node.input[1])
+    if _attr(node, "data_format", "NHWC") != "NHWC":
+        raise TFImportError("Conv2D NCHW unsupported")
+    return imp.sd._record("tfimport.conv2d", [x, w], {
+        "__argspec__": ["var", "var"], "__posattrs__": [],
+        "strides": _attr(node, "strides", [1, 1, 1, 1]),
+        "padding": _attr(node, "padding", "SAME"),
+        "dilations": _attr(node, "dilations", [1, 1, 1, 1])})
+
+
+@tf_op("DepthwiseConv2dNative")
+def _depthwise(imp, node):
+    x, w = imp.tensor(node.input[0]), imp.tensor(node.input[1])
+    return imp.sd._record("tfimport.depthwise_conv2d", [x, w], {
+        "__argspec__": ["var", "var"], "__posattrs__": [],
+        "strides": _attr(node, "strides", [1, 1, 1, 1]),
+        "padding": _attr(node, "padding", "SAME"),
+        "dilations": _attr(node, "dilations", [1, 1, 1, 1])})
+
+
+@tf_op("MaxPool")
+def _max_pool(imp, node):
+    return imp.sd._record("tfimport.max_pool", [imp.tensor(node.input[0])], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "ksize": _attr(node, "ksize"), "strides": _attr(node, "strides"),
+        "padding": _attr(node, "padding", "VALID")})
+
+
+@tf_op("AvgPool")
+def _avg_pool(imp, node):
+    return imp.sd._record("tfimport.avg_pool", [imp.tensor(node.input[0])], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "ksize": _attr(node, "ksize"), "strides": _attr(node, "strides"),
+        "padding": _attr(node, "padding", "VALID")})
+
+
+@tf_op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_bn(imp, node):
+    if _attr(node, "is_training", True):
+        raise TFImportError("FusedBatchNorm training mode unsupported "
+                            "(freeze the graph for inference import)")
+    x, scale, offset, mean, var = (imp.tensor(r) for r in node.input[:5])
+    out = imp.sd._record("tfimport.fused_batch_norm",
+                         [x, scale, offset, mean, var], {
+                             "__argspec__": ["var"] * 5, "__posattrs__": [],
+                             "epsilon": _attr(node, "epsilon", 1e-3)})
+    # TF yields 6 outputs (y, batch stats, reserves); only y is consumed in
+    # frozen inference graphs.
+    return (out,)
+
+
+@tf_op("Shape")
+def _shape(imp, node):
+    x = imp.tensor(node.input[0])
+    if x.shape is None or any(d is None for d in x.shape):
+        raise TFImportError(f"Shape of dynamic tensor {node.input[0]!r}")
+    return imp.sd.constant(_uniq(imp.sd, node.name),
+                           np.asarray(x.shape, np.int32))
+
+
+@tf_op("Split")
+def _split(imp, node):
+    axis = int(np.atleast_1d(imp.const_value(node.input[0]))[0])
+    x = imp.tensor(node.input[1])
+    num = _attr(node, "num_split")
+    return imp.sd._record("tfimport.split", [x], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "num_or_sizes": num, "axis": axis})
+
+
+def import_tf_graph(
+    graph_def,
+    inputs: Optional[Dict[str, Tuple]] = None,
+    outputs: Optional[Sequence[str]] = None,
+) -> Tuple[SameDiff, Dict[str, str], Dict[str, str]]:
+    """Import a frozen GraphDef.
+
+    inputs: optional {placeholder_name: (shape, ...)...} overriding/providing
+    placeholder shapes (None dims allowed for batch).
+    outputs: tensor names to expose; default = nodes nobody consumes.
+
+    Returns (sd, input_map, output_map): maps from TF names to SameDiff
+    variable names.
+    """
+    if outputs is None:
+        consumed = {r.split(":")[0].lstrip("^")
+                    for n in graph_def.node for r in n.input}
+        outputs = [n.name for n in graph_def.node
+                   if n.name not in consumed and n.op not in ("Const", "NoOp")]
+    sd = SameDiff.create()
+    imp = _GraphImporter(graph_def, dict(inputs or {}), sd)
+    out_map = imp.run(list(outputs))
+    in_map = {n.name: n.name for n in graph_def.node if n.op == "Placeholder"}
+    return sd, in_map, out_map
+
+
+def freeze_tf_function(fn, *example_args):
+    """Helper (used by tests/tools): tf.function → frozen GraphDef +
+    input/output tensor names."""
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    conc = tf.function(fn).get_concrete_function(*example_args)
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name for t in frozen.outputs]
+    return gd, in_names, out_names

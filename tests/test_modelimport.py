@@ -1,0 +1,316 @@
+"""Keras h5 + TF GraphDef import oracle tests (SURVEY §4: golden-oracle
+pattern — import, execute, compare against the source framework's own
+execution within per-op tolerance; ↔ KerasModelEndToEndTest /
+TFGraphTestAllSameDiff)."""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport import (  # noqa: E402
+    KerasImportError,
+    import_keras_model,
+    import_tf_graph,
+)
+from deeplearning4j_tpu.modelimport.tf import freeze_tf_function  # noqa: E402
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def _save(model, tmp_path, name="m.h5"):
+    p = str(tmp_path / name)
+    model.save(p)
+    return p
+
+
+def _compare_keras(keras_model, path, x, *, rtol=RTOL, atol=ATOL, train=False):
+    want = keras_model.predict(x, verbose=0)
+    model, variables = import_keras_model(path)
+    got, _ = model.apply(variables, x, train=train)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=rtol, atol=atol)
+    return model, variables
+
+
+class TestKerasSequential:
+    def test_dense_stack(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((8,)),
+            tf.keras.layers.Dense(16, activation="relu"),
+            tf.keras.layers.Dense(4, activation="softmax"),
+        ])
+        x = np.random.RandomState(0).randn(5, 8).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x)
+
+    def test_batchnorm_inference(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(10),
+            tf.keras.layers.BatchNormalization(),
+            tf.keras.layers.Activation("tanh"),
+        ])
+        # make running stats non-trivial
+        km.compile("sgd", "mse")
+        rs = np.random.RandomState(1)
+        km.fit(rs.randn(64, 6).astype(np.float32),
+               rs.randn(64, 10).astype(np.float32), epochs=1, verbose=0)
+        x = rs.randn(4, 6).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x)
+
+    def test_convnet(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((12, 12, 3)),
+            tf.keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+            tf.keras.layers.MaxPooling2D(2),
+            tf.keras.layers.Conv2D(4, 3, padding="valid"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(2),
+        ])
+        x = np.random.RandomState(2).rand(3, 12, 12, 3).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x)
+
+    def test_lstm(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((7, 5)),
+            tf.keras.layers.LSTM(6, return_sequences=True),
+            tf.keras.layers.LSTM(3, return_sequences=False),
+            tf.keras.layers.Dense(2),
+        ])
+        x = np.random.RandomState(3).randn(4, 7, 5).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x, rtol=1e-3, atol=1e-4)
+
+    def test_embedding(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((6,), dtype="int32"),
+            tf.keras.layers.Embedding(20, 8),
+            tf.keras.layers.GlobalAveragePooling1D(),
+            tf.keras.layers.Dense(3),
+        ])
+        x = np.random.RandomState(4).randint(0, 20, (5, 6)).astype(np.int32)
+        _compare_keras(km, _save(km, tmp_path), x)
+
+    def test_depthwise_separable(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((10, 10, 4)),
+            tf.keras.layers.DepthwiseConv2D(3, padding="same"),
+            tf.keras.layers.SeparableConv2D(6, 3, padding="same"),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(2),
+        ])
+        x = np.random.RandomState(5).rand(2, 10, 10, 4).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x)
+
+    def test_gru_fresh_model(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((6, 4)),
+            tf.keras.layers.GRU(5, return_sequences=False),
+        ])
+        x = np.random.RandomState(8).randn(3, 6, 4).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x, rtol=1e-3, atol=1e-4)
+
+    def test_grouped_conv(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((8, 8, 8)),
+            tf.keras.layers.Conv2D(8, 3, groups=4, padding="same"),
+        ])
+        x = np.random.RandomState(9).rand(2, 8, 8, 8).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x)
+
+    def test_unsupported_layer_clear_error(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((4,)),
+            tf.keras.layers.GaussianNoise(0.1),
+            tf.keras.layers.Dense(2),
+        ])
+        with pytest.raises(KerasImportError, match="no mapper"):
+            import_keras_model(_save(km, tmp_path))
+
+
+class TestKerasFunctional:
+    def test_residual_block(self, tmp_path):
+        inp = tf.keras.layers.Input((8, 8, 4))
+        h = tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu")(inp)
+        h = tf.keras.layers.Conv2D(4, 3, padding="same")(h)
+        merged = tf.keras.layers.Add()([inp, h])
+        out = tf.keras.layers.GlobalAveragePooling2D()(merged)
+        out = tf.keras.layers.Dense(3, activation="softmax")(out)
+        km = tf.keras.Model(inp, out)
+        x = np.random.RandomState(6).rand(2, 8, 8, 4).astype(np.float32)
+        want = km.predict(x, verbose=0)
+        model, variables = import_keras_model(_save(km, tmp_path))
+        got = model.apply(variables, {model.config.inputs[0]: x}, train=False)[0]
+        out_arr = got[model.config.outputs[0]] if isinstance(got, dict) else got
+        np.testing.assert_allclose(np.asarray(out_arr), want, rtol=RTOL, atol=ATOL)
+
+    def test_concat_branches(self, tmp_path):
+        inp = tf.keras.layers.Input((10,))
+        a = tf.keras.layers.Dense(4, activation="relu")(inp)
+        b = tf.keras.layers.Dense(4, activation="tanh")(inp)
+        merged = tf.keras.layers.Concatenate()([a, b])
+        out = tf.keras.layers.Dense(2)(merged)
+        km = tf.keras.Model(inp, out)
+        x = np.random.RandomState(7).randn(3, 10).astype(np.float32)
+        want = km.predict(x, verbose=0)
+        model, variables = import_keras_model(_save(km, tmp_path))
+        got = model.apply(variables, {model.config.inputs[0]: x}, train=False)[0]
+        out_arr = got[model.config.outputs[0]] if isinstance(got, dict) else got
+        np.testing.assert_allclose(np.asarray(out_arr), want, rtol=RTOL, atol=ATOL)
+
+
+def _compare_tf(fn, args, *, input_shapes=None, rtol=RTOL, atol=ATOL):
+    gd, in_names, out_names = freeze_tf_function(fn, *args)
+    shapes = input_shapes or {
+        n: tuple(a.shape) for n, a in zip(in_names, args)}
+    sd, in_map, out_map = import_tf_graph(gd, inputs=shapes, outputs=out_names)
+    feeds = {in_map[n]: np.asarray(a) for n, a in zip(in_names, args)}
+    got = sd.output(feeds, [out_map[o] for o in out_names])
+    want = fn(*args)
+    want = want if isinstance(want, (list, tuple)) else [want]
+    for o, w in zip(out_names, want):
+        np.testing.assert_allclose(got[out_map[o]], np.asarray(w),
+                                   rtol=rtol, atol=atol)
+    return sd
+
+
+class TestTFGraphImport:
+    def test_mlp_matmul_bias_relu(self):
+        w1 = tf.constant(np.random.RandomState(0).randn(6, 8).astype(np.float32))
+        b1 = tf.constant(np.zeros(8, np.float32))
+
+        def f(x):
+            return tf.nn.relu(tf.matmul(x, w1) + b1)
+
+        x = tf.constant(np.random.RandomState(1).randn(4, 6).astype(np.float32))
+        _compare_tf(f, [x])
+
+    def test_layernorm_decomposition(self):
+        gamma = tf.constant(np.random.RandomState(2).rand(8).astype(np.float32))
+        beta = tf.constant(np.random.RandomState(3).rand(8).astype(np.float32))
+
+        def f(x):
+            mean = tf.reduce_mean(x, axis=-1, keepdims=True)
+            var = tf.reduce_mean(tf.math.squared_difference(x, mean), -1, keepdims=True)
+            return (x - mean) * tf.math.rsqrt(var + 1e-6) * gamma + beta
+
+        x = tf.constant(np.random.RandomState(4).randn(3, 8).astype(np.float32))
+        _compare_tf(f, [x])
+
+    def test_gelu_erf_form(self):
+        def f(x):
+            return 0.5 * x * (1.0 + tf.math.erf(x / np.sqrt(2.0).astype(np.float32)))
+
+        x = tf.constant(np.random.RandomState(5).randn(4, 7).astype(np.float32))
+        _compare_tf(f, [x])
+
+    def test_attention_core(self):
+        # BERT-style single-head attention on frozen weights
+        rs = np.random.RandomState(6)
+        wq = tf.constant(rs.randn(16, 16).astype(np.float32) * 0.2)
+        wk = tf.constant(rs.randn(16, 16).astype(np.float32) * 0.2)
+        wv = tf.constant(rs.randn(16, 16).astype(np.float32) * 0.2)
+
+        def f(x):
+            q = tf.matmul(x, wq)
+            k = tf.matmul(x, wk)
+            v = tf.matmul(x, wv)
+            s = tf.matmul(q, k, transpose_b=True) / 4.0
+            p = tf.nn.softmax(s, axis=-1)
+            return tf.matmul(p, v)
+
+        x = tf.constant(rs.randn(5, 16).astype(np.float32))
+        _compare_tf(f, [x])
+
+    def test_multihead_reshape_transpose(self):
+        rs = np.random.RandomState(7)
+        w = tf.constant(rs.randn(12, 12).astype(np.float32) * 0.3)
+
+        def f(x):
+            h = tf.matmul(x, w)                     # [B*T, 12]
+            h = tf.reshape(h, [2, 4, 3, 4])         # [B, T, H, D]
+            h = tf.transpose(h, [0, 2, 1, 3])       # [B, H, T, D]
+            s = tf.matmul(h, h, transpose_b=True)   # [B, H, T, T]
+            p = tf.nn.softmax(s)
+            o = tf.matmul(p, h)
+            o = tf.transpose(o, [0, 2, 1, 3])
+            return tf.reshape(o, [8, 12])
+
+        x = tf.constant(rs.randn(8, 12).astype(np.float32))
+        _compare_tf(f, [x])
+
+    def test_conv_pool(self):
+        rs = np.random.RandomState(8)
+        w = tf.constant(rs.randn(3, 3, 2, 4).astype(np.float32) * 0.2)
+
+        def f(x):
+            h = tf.nn.conv2d(x, w, strides=1, padding="SAME")
+            h = tf.nn.relu(h)
+            return tf.nn.max_pool2d(h, 2, 2, "VALID")
+
+        x = tf.constant(rs.rand(2, 8, 8, 2).astype(np.float32))
+        _compare_tf(f, [x])
+
+    def test_embedding_gather(self):
+        rs = np.random.RandomState(9)
+        table = tf.constant(rs.randn(30, 6).astype(np.float32))
+
+        def f(ids):
+            e = tf.gather(table, ids)
+            return tf.reduce_mean(e, axis=1)
+
+        ids = tf.constant(rs.randint(0, 30, (4, 5)).astype(np.int32))
+        _compare_tf(f, [ids])
+
+    def test_slice_concat_pad(self):
+        def f(x):
+            a = x[:, :3]
+            b = x[:, 3:]
+            c = tf.concat([b, a], axis=1)
+            return tf.pad(c, [[0, 0], [1, 1]])
+
+        x = tf.constant(np.random.RandomState(10).randn(3, 6).astype(np.float32))
+        _compare_tf(f, [x])
+
+    def test_range_positions_int_gather(self):
+        # BERT positional-embedding pattern: tf.range → gather (int32)
+        rs = np.random.RandomState(14)
+        table = tf.constant(rs.randn(16, 4).astype(np.float32))
+
+        def f(x):
+            pos = tf.range(8)
+            return x + tf.gather(table, pos)
+
+        x = tf.constant(rs.randn(8, 4).astype(np.float32))
+        _compare_tf(f, [x])
+
+    def test_unsupported_op_clear_error(self):
+        def f(x):
+            return tf.signal.fft(tf.cast(x, tf.complex64))
+
+        x = tf.constant(np.random.RandomState(11).randn(8).astype(np.float32))
+        gd, in_names, out_names = freeze_tf_function(f, x)
+        from deeplearning4j_tpu.modelimport import TFImportError
+
+        with pytest.raises(TFImportError, match="no mapper|unsupported TF dtype"):
+            import_tf_graph(gd, inputs={in_names[0]: (8,)}, outputs=out_names)
+
+    def test_stablehlo_export_of_imported_graph(self):
+        w = tf.constant(np.random.RandomState(12).randn(4, 4).astype(np.float32))
+
+        def f(x):
+            return tf.nn.softmax(tf.matmul(x, w))
+
+        x = tf.constant(np.random.RandomState(13).randn(2, 4).astype(np.float32))
+        gd, in_names, out_names = freeze_tf_function(f, x)
+        sd, in_map, out_map = import_tf_graph(
+            gd, inputs={in_names[0]: (2, 4)}, outputs=out_names)
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        blob = sd.export_stablehlo([out_map[out_names[0]]],
+                                   {in_map[in_names[0]]: ((2, 4), "float32")})
+        out = SameDiff.run_stablehlo(blob, {in_map[in_names[0]]: np.asarray(x)})
+        np.testing.assert_allclose(out[out_map[out_names[0]]],
+                                   f(x).numpy(), rtol=RTOL, atol=ATOL)
