@@ -132,7 +132,18 @@ def _register_tfimport_ops():
         register_op(name, fn)
 
 
-_register_tfimport_ops()
+_TFIMPORT_OPS_REGISTERED = False
+
+
+def ensure_tfimport_ops():
+    """Idempotent registration of the tfimport.* ops. Deferred from module
+    import (avoids forcing jax init for Keras-only users); call this before
+    replaying a previously-saved SameDiff graph that contains tfimport ops
+    in a process that hasn't run import_tf_graph."""
+    global _TFIMPORT_OPS_REGISTERED
+    if not _TFIMPORT_OPS_REGISTERED:
+        _register_tfimport_ops()
+        _TFIMPORT_OPS_REGISTERED = True
 
 
 # --- node attr helpers -----------------------------------------------------
@@ -588,6 +599,7 @@ def import_tf_graph(
     Returns (sd, input_map, output_map): maps from TF names to SameDiff
     variable names.
     """
+    ensure_tfimport_ops()
     if outputs is None:
         consumed = {r.split(":")[0].lstrip("^")
                     for n in graph_def.node for r in n.input}

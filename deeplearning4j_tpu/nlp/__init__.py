@@ -1,0 +1,33 @@
+"""NLP layer (↔ deeplearning4j-nlp-parent, SURVEY §2.7).
+
+- tokenization: tokenizer factories + preprocessors
+- vocab: vocabulary construction (min frequency, subsampling)
+- word2vec: skip-gram / CBOW with negative sampling (jit'd SGNS steps)
+- glove: co-occurrence factorization
+- paragraph_vectors: PV-DBOW doc embeddings with inference
+- serde: word-vector text format round-trip
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.serde import load_word_vectors, save_word_vectors
+
+__all__ = [
+    "DefaultTokenizerFactory",
+    "NGramTokenizerFactory",
+    "CommonPreprocessor",
+    "VocabCache",
+    "build_vocab",
+    "Word2Vec",
+    "Glove",
+    "ParagraphVectors",
+    "save_word_vectors",
+    "load_word_vectors",
+]

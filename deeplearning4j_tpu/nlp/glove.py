@@ -1,0 +1,120 @@
+"""GloVe (↔ org.deeplearning4j.models.glove.Glove).
+
+Host-side co-occurrence accumulation (symmetric window, 1/d weighting),
+then jit'd weighted-least-squares factorization steps over shuffled
+(i, j, X_ij) triples with the standard f(x) = (x/x_max)^α weighting. The
+reference runs per-pair AdaGrad updates on the JVM; here each batch of
+triples is one compiled XLA step with AdaGrad state carried in the pytree.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabCache,
+    build_vocab,
+    fixed_shape_batches,
+)
+
+
+class Glove:
+    def __init__(self, *, vector_size: int = 100, window: int = 5,
+                 min_word_frequency: int = 5, x_max: float = 100.0,
+                 alpha: float = 0.75, learning_rate: float = 0.05,
+                 epochs: int = 5, batch_size: int = 4096, seed: int = 0,
+                 tokenizer: Optional[Callable] = None):
+        self.vector_size = vector_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.x_max = x_max
+        self.alpha = alpha
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.tokenizer = tokenizer or DefaultTokenizerFactory(CommonPreprocessor())
+        self.vocab: Optional[VocabCache] = None
+        self.vectors: Optional[np.ndarray] = None
+
+    def _cooccurrences(self, encoded: List[List[int]]):
+        counts: defaultdict = defaultdict(float)
+        for ids in encoded:
+            for pos, center in enumerate(ids):
+                lo = max(0, pos - self.window)
+                for j in range(lo, pos):
+                    d = pos - j
+                    counts[(center, ids[j])] += 1.0 / d
+                    counts[(ids[j], center)] += 1.0 / d
+        keys = np.asarray(list(counts.keys()), np.int32).reshape(-1, 2)
+        vals = np.asarray(list(counts.values()), np.float32)
+        return keys, vals
+
+    def fit(self, corpus: Iterable) -> List[float]:
+        import jax
+        import jax.numpy as jnp
+
+        sentences = [self.tokenizer(s) if isinstance(s, str) else list(s)
+                     for s in corpus]
+        self.vocab = build_vocab(sentences,
+                                 min_word_frequency=self.min_word_frequency)
+        encoded = [self.vocab.encode(s) for s in sentences]
+        keys, vals = self._cooccurrences(encoded)
+        n, d = len(self.vocab), self.vector_size
+        rs = np.random.RandomState(self.seed)
+        params = {
+            "w": ((rs.rand(n, d) - 0.5) / d).astype(np.float32),
+            "wc": ((rs.rand(n, d) - 0.5) / d).astype(np.float32),
+            "b": np.zeros((n,), np.float32),
+            "bc": np.zeros((n,), np.float32),
+        }
+        adagrad = jax.tree_util.tree_map(
+            lambda p: np.full_like(p, 1e-8), params)
+        x_max, alpha, lr = self.x_max, self.alpha, self.learning_rate
+
+        def loss_fn(p, ii, jj, x):
+            dot = jnp.sum(p["w"][ii] * p["wc"][jj], -1) + p["b"][ii] + p["bc"][jj]
+            f = jnp.minimum((x / x_max) ** alpha, 1.0)
+            return jnp.sum(f * jnp.square(dot - jnp.log(x)))
+
+        def step(p, g2, ii, jj, x):
+            loss, grads = jax.value_and_grad(loss_fn)(p, ii, jj, x)
+            g2 = jax.tree_util.tree_map(lambda a, g: a + g * g, g2, grads)
+            p = jax.tree_util.tree_map(
+                lambda a, g, acc: a - lr * g / jnp.sqrt(acc), p, grads, g2)
+            return p, g2, loss
+
+        jit_step = jax.jit(step, donate_argnums=(0, 1))
+        p = jax.tree_util.tree_map(jnp.asarray, params)
+        g2 = jax.tree_util.tree_map(jnp.asarray, adagrad)
+        rng = np.random.default_rng(self.seed)
+        history = []
+        for _ in range(self.epochs):
+            losses = []
+            for sel in fixed_shape_batches(len(vals), self.batch_size, rng,
+                                           what="co-occurrence pairs"):
+                p, g2, loss = jit_step(
+                    p, g2, jnp.asarray(keys[sel, 0]), jnp.asarray(keys[sel, 1]),
+                    jnp.asarray(vals[sel]))
+                losses.append(loss)
+            history.append(float(np.mean(jax.device_get(losses))))
+        final = jax.device_get(p)
+        # standard GloVe: final word vector = w + wc
+        self.vectors = np.asarray(final["w"]) + np.asarray(final["wc"])
+        return history
+
+    def get_word_vector(self, w: str) -> np.ndarray:
+        if self.vectors is None:
+            raise RuntimeError("call fit() first")
+        return self.vectors[self.vocab.id_of(w)]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))

@@ -1,0 +1,107 @@
+"""ParagraphVectors / doc2vec (↔ org.deeplearning4j.models.paragraphvectors
+.ParagraphVectors).
+
+PV-DBOW: a document vector predicts the words it contains — the exact SGNS
+machinery of word2vec with doc ids as the "center" table (the reference
+shares SequenceVectors plumbing the same way). ``infer_vector`` trains a
+fresh doc row against frozen word vectors (the standard inference trick).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import build_vocab, fixed_shape_batches
+from deeplearning4j_tpu.nlp.word2vec import _SGNSModel
+
+
+class ParagraphVectors:
+    def __init__(self, *, vector_size: int = 100, min_word_frequency: int = 1,
+                 negative: int = 5, learning_rate: float = 0.025,
+                 epochs: int = 10, batch_size: int = 2048, seed: int = 0,
+                 tokenizer: Optional[Callable] = None):
+        self.vector_size = vector_size
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.tokenizer = tokenizer or DefaultTokenizerFactory(CommonPreprocessor())
+        self.vocab = None
+        self.labels: List[str] = []
+        self._model: Optional[_SGNSModel] = None
+
+    def fit(self, documents: Iterable, labels: Optional[Sequence[str]] = None
+            ) -> List[float]:
+        docs = [self.tokenizer(d) if isinstance(d, str) else list(d)
+                for d in documents]
+        self.labels = list(labels) if labels is not None else [
+            f"DOC_{i}" for i in range(len(docs))]
+        if len(self.labels) != len(docs):
+            raise ValueError("labels/documents length mismatch")
+        self.vocab = build_vocab(docs, min_word_frequency=self.min_word_frequency)
+        encoded = [self.vocab.encode(d) for d in docs]
+        self._model = _SGNSModel(len(docs), len(self.vocab),
+                                 self.vector_size, self.seed)
+        rng = np.random.default_rng(self.seed)
+
+        def batches():
+            pairs = [(di, w) for di, ids in enumerate(encoded) for w in ids]
+            arr = np.asarray(pairs, np.int32).reshape(-1, 2)
+            for sel in fixed_shape_batches(len(arr), self.batch_size, rng,
+                                           what="doc-word pairs"):
+                chunk = arr[sel]
+                negs = self.vocab.sample_negatives(rng, (len(sel), self.negative))
+                yield chunk[:, 0], chunk[:, 1], negs.astype(np.int32)
+
+        return self._model.train_epochs(
+            batches, epochs=self.epochs, lr=self.learning_rate,
+            lr_min=self.learning_rate * 0.01)
+
+    def get_doc_vector(self, label: str) -> np.ndarray:
+        if self._model is None:
+            raise RuntimeError("call fit() first")
+        return self._model.in_vecs[self.labels.index(label)]
+
+    def infer_vector(self, text, *, steps: int = 50,
+                     learning_rate: float = 0.05) -> np.ndarray:
+        """Train a fresh doc vector against the frozen word table."""
+        if self._model is None:
+            raise RuntimeError("call fit() first")
+        tokens = self.tokenizer(text) if isinstance(text, str) else list(text)
+        ids = np.asarray(self.vocab.encode(tokens), np.int32)
+        if len(ids) == 0:
+            raise ValueError("no in-vocabulary tokens in text")
+        rng = np.random.default_rng(self.seed)
+        rs = np.random.RandomState(self.seed)
+        vec = ((rs.rand(self.vector_size) - 0.5) / self.vector_size).astype(np.float32)
+        out = self._model.out_vecs
+        for _ in range(steps):
+            negs = self.vocab.sample_negatives(rng, (len(ids), self.negative))
+            v_o = out[ids]                       # [T, D]
+            v_n = out[negs]                      # [T, K, D]
+            pos = v_o @ vec                      # [T]
+            neg = np.einsum("d,tkd->tk", vec, v_n)
+            g_pos = 1.0 / (1.0 + np.exp(-pos)) - 1.0   # σ(pos) − 1
+            g_neg = 1.0 / (1.0 + np.exp(-neg))         # σ(neg)
+            grad = g_pos @ v_o + np.einsum("tk,tkd->d", g_neg, v_n)
+            vec -= learning_rate * grad / len(ids)
+        return vec
+
+    def similarity_to_label(self, text, label: str) -> float:
+        v = self.infer_vector(text)
+        d = self.get_doc_vector(label)
+        return float(v @ d / (np.linalg.norm(v) * np.linalg.norm(d) + 1e-12))
+
+    def nearest_labels(self, text, top_n: int = 5) -> List[str]:
+        v = self.infer_vector(text)
+        m = self._model.in_vecs
+        sims = (m @ v) / (np.linalg.norm(m, axis=1) * np.linalg.norm(v) + 1e-12)
+        return [self.labels[i] for i in np.argsort(-sims)[:top_n]]

@@ -1,0 +1,33 @@
+"""Word-vector serialization (↔ org.deeplearning4j.models.embeddings.loader
+.WordVectorSerializer): the standard word2vec text format — header line
+"<n> <dim>", then one "<word> v1 v2 ..." line per word."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def save_word_vectors(path, words: List[str], vectors: np.ndarray) -> None:
+    vectors = np.asarray(vectors)
+    if len(words) != vectors.shape[0]:
+        raise ValueError("words/vectors length mismatch")
+    with open(path, "w") as f:
+        f.write(f"{len(words)} {vectors.shape[1]}\n")
+        for w, v in zip(words, vectors):
+            f.write(w + " " + " ".join(f"{x:.6g}" for x in v) + "\n")
+
+
+def load_word_vectors(path) -> Tuple[List[str], np.ndarray]:
+    with open(path) as f:
+        first = f.readline().split()
+        n, d = int(first[0]), int(first[1])
+        words, rows = [], []
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            words.append(parts[0])
+            rows.append([float(x) for x in parts[1:d + 1]])
+    if len(words) != n:
+        raise ValueError(f"header said {n} words, file has {len(words)}")
+    return words, np.asarray(rows, np.float32)

@@ -1,0 +1,63 @@
+"""Tokenizers (↔ org.deeplearning4j.text.tokenization.tokenizerfactory.*).
+
+ref: DefaultTokenizerFactory (whitespace/punct split), NGramTokenizerFactory,
+TokenPreProcess impls (CommonPreprocessor: lowercase + strip punctuation,
+EndingPreProcessor). Pure host-side string processing — no device work.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class CommonPreprocessor:
+    """↔ CommonPreprocessor: lowercase, strip punctuation/digits-noise."""
+
+    _PUNCT = re.compile(r"[^\w\s]|_", re.UNICODE)
+
+    def __call__(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class LowCasePreprocessor:
+    def __call__(self, token: str) -> str:
+        return token.lower()
+
+
+class DefaultTokenizerFactory:
+    """↔ DefaultTokenizerFactory: split on whitespace, optional per-token
+    preprocessor."""
+
+    _SPLIT = re.compile(r"\s+")
+
+    def __init__(self, preprocessor: Optional[Callable[[str], str]] = None):
+        self.preprocessor = preprocessor
+
+    def tokenize(self, text: str) -> List[str]:
+        toks = [t for t in self._SPLIT.split(text.strip()) if t]
+        if self.preprocessor is not None:
+            toks = [self.preprocessor(t) for t in toks]
+        return [t for t in toks if t]
+
+    def __call__(self, text: str) -> List[str]:
+        return self.tokenize(text)
+
+
+class NGramTokenizerFactory(DefaultTokenizerFactory):
+    """↔ NGramTokenizerFactory: emits n-grams (joined with '_') from n_min
+    to n_max over the base tokens."""
+
+    def __init__(self, n_min: int = 1, n_max: int = 2,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        super().__init__(preprocessor)
+        self.n_min = n_min
+        self.n_max = n_max
+
+    def tokenize(self, text: str) -> List[str]:
+        base = super().tokenize(text)
+        out: List[str] = []
+        for n in range(self.n_min, self.n_max + 1):
+            for i in range(len(base) - n + 1):
+                out.append("_".join(base[i:i + n]))
+        return out

@@ -1,0 +1,91 @@
+"""Vocabulary cache (↔ org.deeplearning4j.models.word2vec.wordstore.VocabCache
+/ AbstractCache + VocabConstructor).
+
+Counts, min-frequency pruning, index assignment by descending frequency,
+subsampling probabilities (Mikolov 2013 eq.), and the unigram^0.75 negative-
+sampling table — all host-side numpy; the device only ever sees index
+arrays.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class VocabCache:
+    def __init__(self, words: List[str], counts: np.ndarray, total: int,
+                 subsample: float = 0.0):
+        self.words = words
+        self.counts = counts
+        self.total = int(total)
+        self.index: Dict[str, int] = {w: i for i, w in enumerate(words)}
+        # negative-sampling distribution ∝ count^0.75
+        p = counts.astype(np.float64) ** 0.75
+        self.neg_probs = p / p.sum()
+        # subsampling keep-probability per word (1.0 when disabled)
+        if subsample > 0:
+            f = counts / max(total, 1)
+            keep = (np.sqrt(f / subsample) + 1) * (subsample / np.maximum(f, 1e-12))
+            self.keep_probs = np.minimum(keep, 1.0)
+        else:
+            self.keep_probs = np.ones(len(words))
+
+    def __len__(self):
+        return len(self.words)
+
+    def __contains__(self, w):
+        return w in self.index
+
+    def id_of(self, w: str) -> int:
+        return self.index[w]
+
+    def word_of(self, i: int) -> str:
+        return self.words[i]
+
+    def encode(self, tokens: Sequence[str]) -> List[int]:
+        return [self.index[t] for t in tokens if t in self.index]
+
+    def sample_negatives(self, rng: np.random.Generator, shape) -> np.ndarray:
+        return rng.choice(len(self.words), size=shape, p=self.neg_probs)
+
+
+def fixed_shape_batches(n_items: int, batch_size: int,
+                        rng: Optional[np.random.Generator] = None,
+                        what: str = "training items"):
+    """Yield index arrays of ONE fixed length (pad-by-wrapping the tail) so
+    every device step reuses a single XLA compilation. Shared by the
+    word2vec/glove/doc2vec trainers. Raises a clear error on empty input
+    (the corpus/pruning produced nothing to train on)."""
+    if n_items <= 0:
+        raise ValueError(
+            f"no {what} to train on — corpus too small or pruned away "
+            "(check min_word_frequency / subsample)")
+    order = np.arange(n_items) if rng is None else rng.permutation(n_items)
+    bs = min(batch_size, n_items)
+    for i in range(max(n_items // bs, 1)):
+        sel = order[i * bs:(i + 1) * bs]
+        if len(sel) < bs:
+            sel = np.concatenate([sel, order[:bs - len(sel)]])
+        yield sel
+
+
+def build_vocab(sentences: Iterable[Sequence[str]], *,
+                min_word_frequency: int = 1,
+                max_vocab_size: Optional[int] = None,
+                subsample: float = 0.0) -> VocabCache:
+    """↔ VocabConstructor.buildJointVocabulary."""
+    counter: Counter = Counter()
+    total = 0
+    for sent in sentences:
+        counter.update(sent)
+        total += len(sent)
+    items = [(w, c) for w, c in counter.items() if c >= min_word_frequency]
+    items.sort(key=lambda wc: (-wc[1], wc[0]))
+    if max_vocab_size is not None:
+        items = items[:max_vocab_size]
+    words = [w for w, _ in items]
+    counts = np.asarray([c for _, c in items], np.int64)
+    return VocabCache(words, counts, total, subsample)

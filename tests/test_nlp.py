@@ -1,0 +1,138 @@
+"""NLP tests (↔ deeplearning4j-nlp test coverage at the capability level):
+tokenizers, vocab, word2vec similarity structure, glove, doc vectors,
+serde round-trip. Corpus is synthetic with planted co-occurrence topics so
+the similarity assertions are deterministic-ish and fast."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    Word2Vec,
+    build_vocab,
+    load_word_vectors,
+    save_word_vectors,
+)
+
+
+def _topic_corpus(n=300, seed=0):
+    """Two topics with disjoint vocab; words inside a topic co-occur."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache"]
+    sents = []
+    for _ in range(n):
+        topic = animals if rng.random() < 0.5 else tech
+        sents.append(" ".join(rng.choice(topic, size=6)))
+    return sents
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        t = DefaultTokenizerFactory(CommonPreprocessor())
+        assert t("Hello, World!  foo") == ["hello", "world", "foo"]
+
+    def test_ngram(self):
+        t = NGramTokenizerFactory(1, 2)
+        assert t.tokenize("a b c") == ["a", "b", "c", "a_b", "b_c"]
+
+
+class TestVocab:
+    def test_build_and_prune(self):
+        sents = [["a", "a", "b"], ["a", "c"]]
+        v = build_vocab(sents, min_word_frequency=2)
+        assert "a" in v and "b" not in v
+        assert v.counts[v.id_of("a")] == 3
+
+    def test_ordering_by_frequency(self):
+        v = build_vocab([["x"], ["y", "y"], ["z", "z", "z"]])
+        assert v.words[0] == "z" and v.words[-1] == "x"
+
+    def test_negative_sampling_distribution(self):
+        v = build_vocab([["a"] * 80 + ["b"] * 20])
+        rng = np.random.default_rng(0)
+        draws = v.sample_negatives(rng, 2000)
+        frac_a = (draws == v.id_of("a")).mean()
+        assert 0.55 < frac_a < 0.9  # ∝ count^0.75, softer than raw freq
+
+
+class TestWord2Vec:
+    @pytest.fixture(scope="class")
+    def w2v(self):
+        m = Word2Vec(vector_size=24, window=3, min_word_frequency=1,
+                     negative=4, epochs=12, batch_size=1024, seed=1,
+                     subsample=0.0)
+        m.fit(_topic_corpus())
+        return m
+
+    def test_topic_similarity_structure(self, w2v):
+        within = w2v.similarity("cat", "dog")
+        across = w2v.similarity("cat", "gpu")
+        assert within > across + 0.2, (within, across)
+
+    def test_words_nearest(self, w2v):
+        near = w2v.words_nearest("cpu", 4)
+        assert set(near) <= {"gpu", "ram", "disk", "cache"}
+
+    def test_get_vector_shape(self, w2v):
+        assert w2v.get_word_vector("cat").shape == (24,)
+        assert w2v.has_word("cat") and not w2v.has_word("zebra")
+
+    def test_serde_roundtrip(self, w2v, tmp_path):
+        p = tmp_path / "vecs.txt"
+        save_word_vectors(p, w2v.vocab.words, w2v.vectors)
+        words, vecs = load_word_vectors(p)
+        assert words == w2v.vocab.words
+        np.testing.assert_allclose(vecs, w2v.vectors, rtol=1e-4, atol=1e-4)
+
+    def test_cbow_mode_trains(self):
+        m = Word2Vec(vector_size=8, window=2, min_word_frequency=1,
+                     epochs=2, cbow=True, seed=2)
+        hist = m.fit(_topic_corpus(50))
+        assert len(hist) == 2 and np.isfinite(hist).all()
+
+    def test_unfit_raises(self):
+        with pytest.raises(RuntimeError, match="fit"):
+            Word2Vec().get_word_vector("x")
+
+
+class TestGlove:
+    def test_topic_structure(self):
+        g = Glove(vector_size=16, window=3, min_word_frequency=1,
+                  epochs=30, learning_rate=0.05, seed=3)
+        hist = g.fit(_topic_corpus(200, seed=3))
+        assert hist[-1] < hist[0]  # loss decreases
+        within = g.similarity("cat", "dog")
+        across = g.similarity("cat", "gpu")
+        assert within > across, (within, across)
+
+
+class TestParagraphVectors:
+    def test_doc_topic_clustering(self):
+        animals = ["cat dog horse cow", "dog sheep cat cow", "horse cat dog"]
+        tech = ["cpu gpu ram disk", "gpu cache cpu ram", "disk cpu gpu"]
+        pv = ParagraphVectors(vector_size=16, epochs=60, negative=4, seed=4,
+                              batch_size=64)
+        pv.fit(animals + tech,
+               labels=[f"a{i}" for i in range(3)] + [f"t{i}" for i in range(3)])
+        v_a = [pv.get_doc_vector(f"a{i}") for i in range(3)]
+        v_t = [pv.get_doc_vector(f"t{i}") for i in range(3)]
+
+        def cos(x, y):
+            return float(x @ y / (np.linalg.norm(x) * np.linalg.norm(y) + 1e-12))
+
+        within = np.mean([cos(v_a[0], v_a[1]), cos(v_t[0], v_t[1])])
+        across = np.mean([cos(v_a[i], v_t[j]) for i in range(3) for j in range(3)])
+        assert within > across, (within, across)
+
+    def test_infer_vector_nearest_label(self):
+        docs = ["cat dog cow horse sheep cat dog", "cpu gpu ram cache disk cpu gpu"]
+        pv = ParagraphVectors(vector_size=16, epochs=150, negative=4, seed=5,
+                              batch_size=32)
+        pv.fit(docs, labels=["animals", "tech"])
+        near = pv.nearest_labels("dog cat sheep", top_n=1)
+        assert near == ["animals"]
