@@ -1,0 +1,22 @@
+"""Reinforcement learning (↔ rl4j, SURVEY §2.7).
+
+- mdp: MDP interface + built-in toy environments (CartPole, Corridor)
+- replay: experience replay buffer
+- policy: epsilon-greedy / greedy / Boltzmann action selection
+- qlearning: QLearningDiscrete (DQN, double-DQN, target network)
+- a2c: advantage actor-critic (n-step rollouts)
+"""
+
+from deeplearning4j_tpu.rl.mdp import MDP, CartPole, Corridor
+from deeplearning4j_tpu.rl.replay import ReplayBuffer
+from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedyPolicy, GreedyPolicy
+from deeplearning4j_tpu.rl.qlearning import QLearningDiscrete, QLearningConfig
+from deeplearning4j_tpu.rl.a2c import A2C, A2CConfig
+
+__all__ = [
+    "MDP", "CartPole", "Corridor",
+    "ReplayBuffer",
+    "EpsGreedyPolicy", "GreedyPolicy", "BoltzmannPolicy",
+    "QLearningDiscrete", "QLearningConfig",
+    "A2C", "A2CConfig",
+]

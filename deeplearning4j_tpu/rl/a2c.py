@@ -1,0 +1,154 @@
+"""Advantage actor-critic (↔ org.deeplearning4j.rl4j.learning.async.a3c.A3CDiscrete).
+
+The reference runs asynchronous JVM actor threads sharing a global net
+(A3C); on TPU the synchronous batched variant (A2C) is the idiomatic
+equivalent — n-step rollouts collected on the host, ONE jit'd update fusing
+policy gradient + value loss + entropy bonus. (Async gradient races buy
+nothing when the update itself is a single fused device step.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.qlearning import mlp_apply, mlp_init
+
+
+@dataclasses.dataclass
+class A2CConfig:
+    gamma: float = 0.99
+    learning_rate: float = 7e-4
+    n_steps: int = 16
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+    hidden: Tuple[int, ...] = (64,)
+    seed: int = 0
+
+
+class A2C:
+    """Shared-trunk actor-critic over one MDP instance."""
+
+    def __init__(self, mdp, config: Optional[A2CConfig] = None):
+        self.mdp = mdp
+        self.config = config or A2CConfig()
+        obs_dim = int(np.prod(mdp.observation_shape))
+        cfg = self.config
+        self.params = {
+            "trunk": mlp_init([obs_dim, *cfg.hidden], cfg.seed),
+            "pi": mlp_init([cfg.hidden[-1], mdp.action_count], cfg.seed + 1),
+            "v": mlp_init([cfg.hidden[-1], 1], cfg.seed + 2),
+        }
+        self._rng = np.random.default_rng(cfg.seed)
+        self._build()
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+
+        def forward(params, obs):
+            h = mlp_apply(params["trunk"], obs)
+            h = jnp.maximum(h, 0.0)
+            logits = mlp_apply(params["pi"], h)
+            value = mlp_apply(params["v"], h)[..., 0]
+            return logits, value
+
+        def loss_fn(params, obs, actions, returns):
+            logits, value = forward(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            logp_a = jnp.take_along_axis(logp, actions[:, None], 1)[:, 0]
+            adv = returns - value
+            policy_loss = -jnp.mean(logp_a * jax.lax.stop_gradient(adv))
+            value_loss = jnp.mean(jnp.square(adv))
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, -1))
+            return (policy_loss + cfg.value_coef * value_loss
+                    - cfg.entropy_coef * entropy)
+
+        def step(params, opt, t, obs, actions, returns):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions, returns)
+            m, v = opt
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree_util.tree_map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+            v = jax.tree_util.tree_map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+            t = t + 1
+            params = jax.tree_util.tree_map(
+                lambda p, a, bb: p - cfg.learning_rate * (a / (1 - b1**t))
+                / (jnp.sqrt(bb / (1 - b2**t)) + eps),
+                params, m, v)
+            return params, (m, v), t, loss
+
+        z = jax.tree_util.tree_map(jnp.zeros_like,
+                                   jax.tree_util.tree_map(jnp.asarray, self.params))
+        self._opt = (z, jax.tree_util.tree_map(jnp.zeros_like, z))
+        self._t = jnp.zeros((), jnp.int32)
+        self._jit_step = jax.jit(step, donate_argnums=(0, 1))
+        self._jit_forward = jax.jit(forward)
+
+    def _policy(self, obs) -> Tuple[int, float]:
+        import jax
+
+        logits, value = self._jit_forward(self.params,
+                                          np.asarray(obs, np.float32)[None])
+        logits = np.asarray(jax.device_get(logits))[0]
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p)), float(value[0])
+
+    def act_greedy(self, obs) -> int:
+        import jax
+
+        logits, _ = self._jit_forward(self.params,
+                                      np.asarray(obs, np.float32)[None])
+        return int(np.argmax(np.asarray(jax.device_get(logits))[0]))
+
+    def play(self) -> float:
+        obs = self.mdp.reset()
+        total, done = 0.0, False
+        while not done:
+            obs, r, done, _ = self.mdp.step(self.act_greedy(obs))
+            total += r
+        return total
+
+    def train(self, *, max_steps: int = 10_000,
+              listeners: Optional[List[Callable]] = None) -> List[float]:
+        cfg = self.config
+        episode_rewards: List[float] = []
+        obs = self.mdp.reset()
+        ep_reward = 0.0
+        step_i = 0
+        while step_i < max_steps:
+            # n-step rollout
+            traj_obs, traj_act, traj_rew, traj_done = [], [], [], []
+            for _ in range(cfg.n_steps):
+                a, _ = self._policy(obs)
+                nxt, r, done, _ = self.mdp.step(a)
+                traj_obs.append(obs)
+                traj_act.append(a)
+                traj_rew.append(r)
+                traj_done.append(done)
+                ep_reward += r
+                step_i += 1
+                obs = nxt
+                if done:
+                    episode_rewards.append(ep_reward)
+                    for lst in listeners or []:
+                        lst(len(episode_rewards), ep_reward)
+                    ep_reward = 0.0
+                    obs = self.mdp.reset()
+            # bootstrap from the value of the final state
+            _, boot = self._policy(obs)
+            returns = np.zeros(len(traj_rew), np.float32)
+            run = 0.0 if traj_done[-1] else boot
+            for i in reversed(range(len(traj_rew))):
+                run = traj_rew[i] + cfg.gamma * run * (0.0 if traj_done[i] else 1.0)
+                # a done inside the window resets the return beyond it
+                returns[i] = run
+            self.params, self._opt, self._t, _ = self._jit_step(
+                self.params, self._opt, self._t,
+                np.asarray(traj_obs, np.float32),
+                np.asarray(traj_act, np.int32), returns)
+        return episode_rewards

@@ -1,0 +1,101 @@
+"""MDP interface + toy environments (↔ org.deeplearning4j.rl4j.mdp.MDP and
+the gym/malmo/ale connectors, SURVEY §2.7).
+
+The reference binds external simulators (gym-java-client etc.); in this
+zero-egress build the interface is the deliverable and two classic pure-
+numpy environments back the tests. Any object with reset/step/action_count/
+observation_shape plugs into the learners (gymnasium adapters drop in the
+same way the reference's connectors did).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, Tuple
+
+import numpy as np
+
+
+class MDP(Protocol):
+    """↔ org.deeplearning4j.rl4j.mdp.MDP<O, A, AS>."""
+
+    action_count: int
+    observation_shape: Tuple[int, ...]
+
+    def reset(self) -> np.ndarray: ...
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, dict]: ...
+
+
+class CartPole:
+    """Classic cart-pole balancing (Barto-Sutton-Anderson dynamics; the same
+    task rl4j's gym examples lead with), pure numpy."""
+
+    action_count = 2
+    observation_shape = (4,)
+
+    def __init__(self, seed: int = 0, max_steps: int = 200):
+        self._rng = np.random.default_rng(seed)
+        self.max_steps = max_steps
+        self._state = None
+        self._t = 0
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, 4)
+        self._t = 0
+        return self._state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self._state
+        force = 10.0 if action == 1 else -10.0
+        g, mc, mp, l, tau = 9.8, 1.0, 0.1, 0.5, 0.02
+        total = mc + mp
+        costh, sinth = np.cos(th), np.sin(th)
+        temp = (force + mp * l * th_dot**2 * sinth) / total
+        th_acc = (g * sinth - costh * temp) / (l * (4.0 / 3.0 - mp * costh**2 / total))
+        x_acc = temp - mp * l * th_acc * costh / total
+        x += tau * x_dot
+        x_dot += tau * x_acc
+        th += tau * th_dot
+        th_dot += tau * th_acc
+        self._state = np.array([x, x_dot, th, th_dot])
+        self._t += 1
+        failed = bool(abs(x) > 2.4 or abs(th) > 12 * np.pi / 180)
+        truncated = bool(self._t >= self.max_steps and not failed)
+        # `truncated` marks a time-limit cut, NOT a terminal state — learners
+        # must keep bootstrapping through it (TD target ≠ reward alone).
+        return (self._state.astype(np.float32), 1.0, failed or truncated,
+                {"truncated": truncated})
+
+
+class Corridor:
+    """Deterministic 1-D corridor: start left, goal right; +1 at the goal,
+    small step penalty. Solvable quickly — the convergence-sanity
+    environment for learner tests (SURVEY §4 tiny-dataset pattern)."""
+
+    def __init__(self, length: int = 8, max_steps: int = 50):
+        self.length = length
+        self.max_steps = max_steps
+        self.action_count = 2  # 0 = left, 1 = right
+        self.observation_shape = (length,)
+        self._pos = 0
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        v = np.zeros(self.length, np.float32)
+        v[self._pos] = 1.0
+        return v
+
+    def reset(self) -> np.ndarray:
+        self._pos = 0
+        self._t = 0
+        return self._obs()
+
+    def step(self, action: int):
+        self._pos = max(0, self._pos - 1) if action == 0 else \
+            min(self.length - 1, self._pos + 1)
+        self._t += 1
+        at_goal = self._pos == self.length - 1
+        reward = 1.0 if at_goal else -0.01
+        truncated = bool(self._t >= self.max_steps and not at_goal)
+        return self._obs(), reward, bool(at_goal or truncated), \
+            {"truncated": truncated}

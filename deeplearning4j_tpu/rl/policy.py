@@ -1,0 +1,47 @@
+"""Action-selection policies (↔ org.deeplearning4j.rl4j.policy.{EpsGreedy,
+Policy, BoltzmannPolicy-ish ACPolicy sampling})."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class GreedyPolicy:
+    def select(self, q_values: np.ndarray, step: int) -> int:
+        return int(np.argmax(q_values))
+
+
+class EpsGreedyPolicy:
+    """↔ EpsGreedy: linear anneal from eps_start to eps_min over
+    anneal_steps environment steps."""
+
+    def __init__(self, eps_start: float = 1.0, eps_min: float = 0.05,
+                 anneal_steps: int = 10_000, seed: int = 0):
+        self.eps_start = eps_start
+        self.eps_min = eps_min
+        self.anneal_steps = anneal_steps
+        self._rng = np.random.default_rng(seed)
+
+    def epsilon(self, step: int) -> float:
+        frac = min(step / max(self.anneal_steps, 1), 1.0)
+        return self.eps_start + (self.eps_min - self.eps_start) * frac
+
+    def select(self, q_values: np.ndarray, step: int) -> int:
+        if self._rng.random() < self.epsilon(step):
+            return int(self._rng.integers(len(q_values)))
+        return int(np.argmax(q_values))
+
+
+class BoltzmannPolicy:
+    """Softmax exploration with temperature."""
+
+    def __init__(self, temperature: float = 1.0, seed: int = 0):
+        self.temperature = temperature
+        self._rng = np.random.default_rng(seed)
+
+    def select(self, q_values: np.ndarray, step: int) -> int:
+        z = q_values / max(self.temperature, 1e-6)
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(q_values), p=p))

@@ -1,0 +1,184 @@
+"""Deep Q-learning (↔ org.deeplearning4j.rl4j.learning.sync.qlearning
+.discrete.QLearningDiscrete + QLConfiguration).
+
+TPU-first shape: the reference's learner calls network.fit per minibatch
+through the full per-op stack; here the TD step — forward on obs AND next
+obs, (double-)DQN target, Huber loss, Adam update — is ONE jit'd XLA
+program with donated params; the host loop only steps the environment and
+fills the replay buffer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.policy import EpsGreedyPolicy
+from deeplearning4j_tpu.rl.replay import ReplayBuffer
+
+
+def mlp_init(sizes: Sequence[int], seed: int = 0):
+    """Small MLP (relu hidden) param pytree."""
+    rs = np.random.RandomState(seed)
+    params = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        w = (rs.randn(a, b) * np.sqrt(2.0 / a)).astype(np.float32)
+        params.append({"w": w, "b": np.zeros(b, np.float32)})
+    return params
+
+
+def mlp_apply(params, x):
+    import jax.numpy as jnp
+
+    h = x.reshape(x.shape[0], -1)  # flatten multi-dim observations
+    for i, layer in enumerate(params):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            h = jnp.maximum(h, 0.0)
+    return h
+
+
+@dataclasses.dataclass
+class QLearningConfig:
+    """↔ QLearning.QLConfiguration."""
+
+    gamma: float = 0.99
+    learning_rate: float = 1e-3
+    batch_size: int = 64
+    replay_capacity: int = 10_000
+    warmup_steps: int = 200
+    target_update_every: int = 250
+    train_every: int = 1
+    double_dqn: bool = True
+    eps_start: float = 1.0
+    eps_min: float = 0.05
+    eps_anneal_steps: int = 3000
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+
+class QLearningDiscrete:
+    """DQN learner over any MDP with discrete actions.
+
+    network: optional (init_fn() -> params, apply_fn(params, obs) -> q)
+    pair; default is an MLP sized from the MDP.
+    """
+
+    def __init__(self, mdp, config: Optional[QLearningConfig] = None,
+                 network: Optional[Tuple[Callable, Callable]] = None):
+        self.mdp = mdp
+        self.config = config or QLearningConfig()
+        obs_dim = int(np.prod(mdp.observation_shape))
+        if network is None:
+            sizes = [obs_dim, *self.config.hidden, mdp.action_count]
+            self._init_fn = lambda: mlp_init(sizes, self.config.seed)
+            self._apply_fn = mlp_apply
+        else:
+            self._init_fn, self._apply_fn = network
+        self.params = self._init_fn()
+        self.target_params = self.params
+        self.replay = ReplayBuffer(self.config.replay_capacity,
+                                   mdp.observation_shape, self.config.seed)
+        self.policy = EpsGreedyPolicy(self.config.eps_start, self.config.eps_min,
+                                      self.config.eps_anneal_steps,
+                                      self.config.seed)
+        self._build()
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        apply_fn = self._apply_fn
+
+        def td_loss(params, target_params, obs, actions, rewards, next_obs, dones):
+            q = apply_fn(params, obs)
+            q_sel = jnp.take_along_axis(q, actions[:, None], 1)[:, 0]
+            q_next_t = apply_fn(target_params, next_obs)
+            if cfg.double_dqn:
+                a_star = jnp.argmax(apply_fn(params, next_obs), -1)
+                q_next = jnp.take_along_axis(q_next_t, a_star[:, None], 1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_t, -1)
+            target = rewards + cfg.gamma * (1.0 - dones) * q_next
+            err = q_sel - jax.lax.stop_gradient(target)
+            # Huber
+            return jnp.mean(jnp.where(jnp.abs(err) < 1.0, 0.5 * err * err,
+                                      jnp.abs(err) - 0.5))
+
+        def adam_init(params):
+            z = jax.tree_util.tree_map(jnp.zeros_like, params)
+            return (z, jax.tree_util.tree_map(jnp.zeros_like, params))
+
+        def step(params, opt, t, target_params, batch):
+            loss, grads = jax.value_and_grad(td_loss)(params, target_params, *batch)
+            m, v = opt
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree_util.tree_map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+            v = jax.tree_util.tree_map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+            t = t + 1
+            mh = jax.tree_util.tree_map(lambda a: a / (1 - b1**t), m)
+            vh = jax.tree_util.tree_map(lambda a: a / (1 - b2**t), v)
+            params = jax.tree_util.tree_map(
+                lambda p, a, bb: p - cfg.learning_rate * a / (jnp.sqrt(bb) + eps),
+                params, mh, vh)
+            return params, (m, v), t, loss
+
+        # no donation: target_params aliases params buffers between target
+        # syncs, and donating them would invalidate the target network.
+        self._jit_step = jax.jit(step)
+        self._jit_q = jax.jit(apply_fn)
+        self._opt = adam_init(jax.tree_util.tree_map(jnp.asarray, self.params))
+        self._t = jnp.zeros((), jnp.int32)
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        import jax
+
+        return np.asarray(jax.device_get(
+            self._jit_q(self.params, np.asarray(obs, np.float32)[None]))[0])
+
+    def play(self, greedy: bool = True) -> float:
+        """One evaluation episode; returns total reward."""
+        obs = self.mdp.reset()
+        total, done = 0.0, False
+        while not done:
+            q = self.q_values(obs)
+            a = int(np.argmax(q))
+            obs, r, done, _ = self.mdp.step(a)
+            total += r
+        return total
+
+    def train(self, *, max_steps: int = 10_000,
+              listeners: Optional[List[Callable]] = None) -> List[float]:
+        """Environment-step loop; returns per-episode rewards."""
+        import jax
+
+        cfg = self.config
+        episode_rewards: List[float] = []
+        obs = self.mdp.reset()
+        ep_reward = 0.0
+        for step_i in range(max_steps):
+            q = self.q_values(obs)
+            action = self.policy.select(q, step_i)
+            next_obs, reward, done, _ = self.mdp.step(action)
+            self.replay.add(obs, action, reward, next_obs, done)
+            ep_reward += reward
+            obs = next_obs
+            if done:
+                episode_rewards.append(ep_reward)
+                for lst in listeners or []:
+                    lst(len(episode_rewards), ep_reward)
+                ep_reward = 0.0
+                obs = self.mdp.reset()
+            if (len(self.replay) >= cfg.warmup_steps
+                    and step_i % cfg.train_every == 0):
+                batch = self.replay.sample(cfg.batch_size)
+                self.params, self._opt, self._t, _ = self._jit_step(
+                    self.params, self._opt, self._t, self.target_params,
+                    tuple(np.asarray(b) for b in batch))
+            if step_i % cfg.target_update_every == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    lambda x: x, self.params)
+        return episode_rewards

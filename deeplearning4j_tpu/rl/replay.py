@@ -1,0 +1,45 @@
+"""Experience replay (↔ org.deeplearning4j.rl4j.learning.sync.ExpReplay).
+
+Preallocated numpy ring buffer; sampling returns dense batches ready for
+one jit'd learner step (the reference boxes each Transition; here storage
+is columnar from the start so the device batch is a set of views)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, observation_shape: Tuple[int, ...],
+                 seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, *observation_shape), np.float32)
+        self.next_obs = np.zeros((capacity, *observation_shape), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self._n = 0
+        self._i = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return self._n
+
+    def add(self, obs, action, reward, next_obs, done) -> None:
+        i = self._i
+        self.obs[i] = obs
+        self.actions[i] = action
+        self.rewards[i] = reward
+        self.next_obs[i] = next_obs
+        self.dones[i] = float(done)
+        self._i = (i + 1) % self.capacity
+        self._n = min(self._n + 1, self.capacity)
+
+    def sample(self, batch_size: int):
+        if self._n == 0:
+            raise ValueError("replay buffer is empty")
+        idx = self._rng.integers(0, self._n, batch_size)
+        return (self.obs[idx], self.actions[idx], self.rewards[idx],
+                self.next_obs[idx], self.dones[idx])

@@ -1,0 +1,100 @@
+"""RL tests (↔ rl4j's learner tests at the capability level): replay/policy
+units + convergence sanity on the deterministic Corridor MDP (SURVEY §4
+tiny-dataset convergence pattern)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (
+    A2C,
+    A2CConfig,
+    BoltzmannPolicy,
+    CartPole,
+    Corridor,
+    EpsGreedyPolicy,
+    QLearningConfig,
+    QLearningDiscrete,
+    ReplayBuffer,
+)
+
+
+class TestReplayBuffer:
+    def test_ring_semantics(self):
+        rb = ReplayBuffer(4, (2,))
+        for i in range(6):
+            rb.add(np.full(2, i), i, float(i), np.full(2, i + 1), False)
+        assert len(rb) == 4
+        obs, actions, rewards, next_obs, dones = rb.sample(8)
+        assert obs.shape == (8, 2) and actions.min() >= 2  # 0,1 overwritten
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="empty"):
+            ReplayBuffer(4, (2,)).sample(1)
+
+
+class TestPolicies:
+    def test_eps_anneal(self):
+        p = EpsGreedyPolicy(1.0, 0.1, anneal_steps=100)
+        assert p.epsilon(0) == 1.0
+        assert abs(p.epsilon(50) - 0.55) < 1e-9
+        assert p.epsilon(1000) == pytest.approx(0.1)
+
+    def test_greedy_at_zero_eps(self):
+        p = EpsGreedyPolicy(0.0, 0.0, anneal_steps=1)
+        q = np.array([0.1, 0.9, 0.3])
+        assert all(p.select(q, i) == 1 for i in range(20))
+
+    def test_boltzmann_prefers_high_q(self):
+        p = BoltzmannPolicy(temperature=0.1, seed=0)
+        q = np.array([0.0, 1.0])
+        picks = [p.select(q, 0) for _ in range(50)]
+        assert np.mean(picks) > 0.9
+
+
+class TestEnvironments:
+    def test_corridor_optimal_return(self):
+        env = Corridor(length=6)
+        obs = env.reset()
+        total, done = 0.0, False
+        while not done:
+            obs, r, done, _ = env.step(1)  # always right
+            total += r
+        assert total == pytest.approx(1.0 - 0.01 * 4)
+
+    def test_cartpole_terminates(self):
+        env = CartPole(seed=0)
+        env.reset()
+        steps = 0
+        done = False
+        while not done:
+            _, _, done, _ = env.step(steps % 2)
+            steps += 1
+        assert 1 <= steps <= 200
+
+
+class TestQLearning:
+    def test_learns_corridor(self):
+        env = Corridor(length=6)
+        cfg = QLearningConfig(
+            gamma=0.95, learning_rate=2e-3, batch_size=32,
+            warmup_steps=100, target_update_every=100,
+            eps_anneal_steps=800, hidden=(32,), seed=0)
+        ql = QLearningDiscrete(env, cfg)
+        ql.train(max_steps=2500)
+        # greedy policy should walk straight to the goal
+        assert ql.play() == pytest.approx(1.0 - 0.01 * 4, abs=1e-6)
+
+    def test_q_values_shape(self):
+        ql = QLearningDiscrete(Corridor(length=5),
+                               QLearningConfig(hidden=(8,)))
+        q = ql.q_values(Corridor(length=5).reset())
+        assert q.shape == (2,)
+
+
+class TestA2C:
+    def test_learns_corridor(self):
+        env = Corridor(length=5)
+        a2c = A2C(env, A2CConfig(gamma=0.95, learning_rate=3e-3, n_steps=16,
+                                 hidden=(32,), seed=0))
+        a2c.train(max_steps=6000)
+        assert a2c.play() == pytest.approx(1.0 - 0.01 * 3, abs=1e-6)
