@@ -4,15 +4,92 @@
 Headline: BERT-base MLM pretraining throughput (tokens/sec/chip) on the
 attached TPU chip — north-star workload #4. The reference publishes no
 numbers (BASELINE.md: measured, not copied), so vs_baseline is the ratio
-against the first recorded measurement once BENCH_r1.json lands.
+against the recorded round-2 measurement in BASELINE.md once it lands.
+
+The axon TPU backend rides a shared tunnel that wedges transiently when
+another PJRT client holds the claim; round 1 recorded 0.0 because a single
+init failure aborted the run. Backend init therefore retries with backoff
+for several minutes, and the emitted line carries diagnostics (platform,
+device count, compile seconds) so a failure is attributable.
 """
 
 import json
+import subprocess
+import sys
 import time
+
+# Recorded first real measurement (round 2). vs_baseline = value / this.
+BASELINE_TOKENS_PER_SEC = None  # set after BENCH_r02 lands
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def _probe_backend(timeout_s: float):
+    """Probe backend init in a THROWAWAY subprocess.
+
+    The axon tunnel's failure mode is a multi-minute hang inside the PJRT
+    client claim (not an exception), and jax caches a partially-initialized
+    backend set forever — so the probe must run out-of-process, where a
+    hang becomes a kill-able timeout and a wedged claim dies with the
+    process instead of poisoning this one.
+    Returns (platform, n_devices) or raises.
+    """
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); print(d[0].platform, len(d))"],
+        capture_output=True, text=True, timeout=timeout_s,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr.strip().splitlines()[-1][:200]
+                           if out.stderr.strip() else f"rc={out.returncode}")
+    platform, n = out.stdout.split()[-2:]
+    return platform, int(n)
+
+
+def _init_backend(max_wait_s: float = 420.0):
+    """Return (devices, diag), retrying transient tunnel wedges.
+
+    Probes sparingly (the tunnel serializes grants; hammering it with
+    rapid client creates makes the wedge worse) and only touches jax
+    in-process once a probe subprocess has initialized cleanly.
+    """
+    deadline = time.monotonic() + max_wait_s
+    delay = 30.0
+    last_err = None
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            platform, _ = _probe_backend(timeout_s=120.0)
+            if platform not in _TPU_PLATFORMS:
+                raise RuntimeError(
+                    f"backend came up as '{platform}', not a TPU — refusing "
+                    "to record a CPU number as the per-chip metric"
+                )
+            break
+        except (subprocess.TimeoutExpired, RuntimeError) as e:
+            last_err = e
+            if time.monotonic() + delay > deadline:
+                raise RuntimeError(
+                    f"backend init failed after {attempt} attempts: {last_err}"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 120.0)
+
+    import jax
+
+    devs = jax.devices()
+    if devs[0].platform not in _TPU_PLATFORMS:
+        raise RuntimeError(f"in-process backend is '{devs[0].platform}'")
+    return devs, {
+        "platform": devs[0].platform,
+        "n_devices": len(devs),
+        "init_attempts": attempt,
+    }
 
 
 def bench_bert(batch_size: int = 32, seq_len: int = 128, warmup: int = 3,
-               iters: int = 10):
+               iters: int = 10, diag: dict | None = None):
     import jax
 
     from deeplearning4j_tpu.models.bert import bert_base, make_mlm_batch
@@ -25,7 +102,13 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128, warmup: int = 3,
                            vocab_size=model.config.vocab_size)
     batch = jax.device_put(batch)
 
-    for _ in range(warmup):
+    t0 = time.perf_counter()
+    ts, _ = trainer.train_step(ts, batch)  # first call compiles
+    jax.block_until_ready(ts.params)
+    if diag is not None:
+        diag["compile_s"] = round(time.perf_counter() - t0, 1)
+
+    for _ in range(warmup - 1):
         ts, metrics = trainer.train_step(ts, batch)
     jax.block_until_ready(ts.params)
 
@@ -35,17 +118,27 @@ def bench_bert(batch_size: int = 32, seq_len: int = 128, warmup: int = 3,
     jax.block_until_ready(ts.params)
     dt = time.perf_counter() - t0
 
+    if diag is not None:
+        diag["step_ms"] = round(dt / iters * 1000, 1)
+        diag["batch"] = batch_size
+        diag["seq_len"] = seq_len
     return batch_size * seq_len * iters / dt
 
 
 def main():
+    diag = {}
     try:
-        value = bench_bert()
+        _, init_diag = _init_backend()
+        diag.update(init_diag)
+        value = bench_bert(diag=diag)
+        vs = (round(value / BASELINE_TOKENS_PER_SEC, 3)
+              if BASELINE_TOKENS_PER_SEC else 1.0)
         result = {
             "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
             "value": round(value, 1),
             "unit": "tokens/sec/chip",
-            "vs_baseline": 1.0,
+            "vs_baseline": vs,
+            **diag,
         }
     except Exception as e:  # noqa: BLE001 - bench must always emit one line
         result = {
@@ -53,10 +146,13 @@ def main():
             "value": 0.0,
             "unit": "tokens/sec/chip",
             "vs_baseline": 0.0,
-            "error": str(e)[:200],
+            "error": str(e)[:300],
+            **diag,
         }
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
     main()
+
+

@@ -1,0 +1,38 @@
+"""Pallas kernel dispatch policy.
+
+The compiled Pallas path is used only on real TPU devices. Off-TPU (CPU
+CI, the driver's virtual-device dry-run) the kernels' callers take the XLA
+reference implementations instead: interpret-mode Pallas is an emulator
+meant for unit-testing kernel logic, and is far too slow to sit inside a
+jitted train step (a cold BERT step exceeds several minutes).
+
+Kernel unit tests opt back in by setting ``DL4J_TPU_FORCE_PALLAS=1``, which
+routes through the kernel in interpret mode so the kernel body itself is
+exercised against the XLA oracle on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def on_tpu() -> bool:
+    """True when the default jax backend is a real TPU."""
+    try:
+        return jax.devices()[0].platform in _TPU_PLATFORMS
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def force_pallas() -> bool:
+    """True when tests force the (interpret-mode) Pallas path off-TPU."""
+    return os.environ.get("DL4J_TPU_FORCE_PALLAS", "") == "1"
+
+
+def use_pallas() -> bool:
+    """Should callers dispatch to the Pallas kernel at all?"""
+    return on_tpu() or force_pallas()

@@ -35,14 +35,10 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
+from deeplearning4j_tpu.kernels._dispatch import on_tpu as _on_tpu
+from deeplearning4j_tpu.kernels._dispatch import use_pallas as _use_pallas
+
 _NEG_INF = -1e30
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:  # pragma: no cover
-        return False
 
 
 def reference_attention(q, k, v, *, causal=False, bias=None, key_mask=None,
@@ -230,7 +226,8 @@ def flash_attention(q, k, v, *, causal: bool = False, scale=None, bias=None,
     """
     d = q.shape[-1]
     scale = (d ** -0.5) if scale is None else scale
-    if bias is not None or q.shape[2] < 8 or not _HAS_PLTPU:
+    if (bias is not None or q.shape[2] < 8 or not _HAS_PLTPU
+            or not _use_pallas()):
         return reference_attention(q, k, v, causal=causal, bias=bias,
                                    key_mask=key_mask, scale=scale)
     return _flash(q, k, v, key_mask, causal, scale, block_q, block_k)

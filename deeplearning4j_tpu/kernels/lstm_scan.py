@@ -15,8 +15,8 @@ Backward: a custom_vjp whose bwd recomputes via the XLA lax.scan
 implementation (ops/rnn.py) and differentiates that — correct by
 construction; a hand-written backward kernel is a later optimization.
 
-Falls back to interpret mode off-TPU (CI) and to ops/rnn.py for shapes that
-don't tile (N % 8, H % 128).
+Off-TPU the public ``lstm`` routes to ops/rnn.py (see kernels/_dispatch.py);
+shapes that don't tile (N % 8, H % 128) also fall back.
 """
 
 from __future__ import annotations
@@ -36,14 +36,9 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
+from deeplearning4j_tpu.kernels._dispatch import on_tpu as _on_tpu
+from deeplearning4j_tpu.kernels._dispatch import use_pallas as _use_pallas
 from deeplearning4j_tpu.ops import rnn as opsrnn
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:  # pragma: no cover
-        return False
 
 
 def _gates_kernel(xp_ref, rw_ref, b_ref, h0_ref, c0_ref, out_ref,
@@ -228,7 +223,7 @@ def lstm(
     """
     n, t, _ = x.shape
     h_dim = w_h.shape[0]
-    if init_state is not None or not _shapes_tile(n, h_dim):
+    if init_state is not None or not _shapes_tile(n, h_dim) or not _use_pallas():
         return opsrnn.lstm(
             x, w_x, w_h, b, peepholes=peepholes, forget_bias=forget_bias,
             init_state=init_state,

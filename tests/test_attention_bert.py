@@ -28,18 +28,43 @@ def _qkv(rng, b=2, h=2, t=32, d=16):
     return tuple(jax.random.normal(k, shape) for k in ks)
 
 
-def test_flash_matches_reference():
+def test_flash_matches_reference(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FORCE_PALLAS", "1")
     q, k, v = _qkv(0)
     got = flash_attention(q, k, v)
     want = reference_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
-def test_flash_causal_matches_reference():
+def test_flash_causal_matches_reference(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FORCE_PALLAS", "1")
     q, k, v = _qkv(1)
     got = flash_attention(q, k, v, causal=True)
     want = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_key_mask_matches_reference(monkeypatch):
+    # In-kernel key-padding-mask path — what the BERT TPU train step uses.
+    monkeypatch.setenv("DL4J_TPU_FORCE_PALLAS", "1")
+    q, k, v = _qkv(2)
+    mask = jnp.ones((q.shape[0], q.shape[2])).at[:, 20:].set(0.0)
+    got = flash_attention(q, k, v, key_mask=mask)
+    want = reference_attention(q, k, v, key_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(got)[:, :, :20], np.asarray(want)[:, :, :20], atol=2e-5
+    )
+
+
+def test_flash_causal_key_mask_matches_reference(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FORCE_PALLAS", "1")
+    q, k, v = _qkv(3)
+    mask = jnp.ones((q.shape[0], q.shape[2])).at[:, 24:].set(0.0)
+    got = flash_attention(q, k, v, causal=True, key_mask=mask)
+    want = reference_attention(q, k, v, causal=True, key_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(got)[:, :, :24], np.asarray(want)[:, :, :24], atol=2e-5
+    )
 
 
 def test_self_attention_shapes_and_mask():
