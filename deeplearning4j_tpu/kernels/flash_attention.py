@@ -14,9 +14,15 @@ Per-example key padding masks ([B,S] 1/0 — the BERT attention-mask case)
 are handled *inside* the kernel, so masked batches keep the flash path;
 only arbitrary additive ``bias`` falls back to the XLA reference.
 
-Backward: custom_vjp recomputing through the XLA reference implementation
-(correct by construction; flash backward kernel is a later optimization —
-same policy as kernels/lstm_scan.py).
+Backward: blockwise Pallas kernels (FlashAttention-2 style). The forward
+saves the per-row logsumexp (lane-broadcast [BH,T,128] layout, the Mosaic
+tiling-friendly shape jax's own TPU flash kernel uses); backward runs two
+kernels — dk/dv with a q-block sweep per kv block, dq with a kv-block
+sweep per q block — plus one XLA pass for delta = rowsum(dO*O). Scores are
+recomputed on-chip, so backward memory stays O(T·D) like forward. The
+same kernels run everywhere: compiled on TPU, interpret-mode in CPU tests
+(via DL4J_TPU_FORCE_PALLAS=1; plain CPU callers never reach them because
+flash_attention dispatches to reference_attention off-TPU).
 """
 
 from __future__ import annotations
@@ -65,7 +71,8 @@ def reference_attention(q, k, v, *, causal=False, bias=None, key_mask=None,
     return jnp.einsum("bhts,bhsd->bhtd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _flash_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *,
                   scale, causal, has_mask, block_q, block_k, seq_q, seq_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -121,6 +128,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, m_scr, l_scr, acc_scr, *,
         o_ref[0] = (
             acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
         ).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # Row logsumexp, lane-broadcast — the backward residual. Fully
+            # masked / padded rows get ~-1e30; backward clamps before exp.
+            lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
 def _round_up(x, m):
@@ -137,7 +148,9 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
-def _flash_fwd(q, k, v, key_mask, *, causal, scale, block_q, block_k):
+def _prep_blocks(q, k, v, key_mask, block_q, block_k):
+    """Tile-align block sizes and pad operands — shared by fwd and bwd so
+    their block geometry can never desynchronize."""
     b, h, t, d = q.shape
     s_len = k.shape[2]
     # Blocks stay (8,128)-tile-aligned even for short sequences.
@@ -147,28 +160,55 @@ def _flash_fwd(q, k, v, key_mask, *, causal, scale, block_q, block_k):
     qp = _pad_to(_pad_to(q.reshape(b * h, t, d), 1, block_q), 2, 128)
     kp = _pad_to(_pad_to(k.reshape(b * h, s_len, d), 1, block_k), 2, 128)
     vp = _pad_to(_pad_to(v.reshape(b * h, s_len, d), 1, block_k), 2, 128)
-    dp = qp.shape[-1]
-    tq, tk = qp.shape[1], kp.shape[1]
 
-    has_mask = key_mask is not None
-    if has_mask:
+    if key_mask is not None:
         km = _pad_to(key_mask.astype(jnp.float32), 1, block_k)  # [B, tk]
         # [B*H, 1, tk] — tiny; the unit middle dim keeps the Mosaic block
         # shape (1, 1, block_k) legal (second-minor equals the array dim).
         km = jnp.repeat(km, h, axis=0)[:, None, :]
+        km_block = block_k
     else:
         km = jnp.ones((b * h, 1, 1), jnp.float32)  # placeholder operand
+        km_block = 1
+    return qp, kp, vp, km, km_block, block_q, block_k
 
-    kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, has_mask=has_mask,
-        block_q=block_q, block_k=block_k, seq_q=t, seq_k=s_len,
-    )
-    km_block = block_k if has_mask else 1
+
+def _flash_fwd(q, k, v, key_mask, *, causal, scale, block_q, block_k,
+               save_lse=False):
+    b, h, t, d = q.shape
+    s_len = k.shape[2]
+    qp, kp, vp, km, km_block, block_q, block_k = _prep_blocks(
+        q, k, v, key_mask, block_q, block_k)
+    dp = qp.shape[-1]
+    tq, tk = qp.shape[1], kp.shape[1]
+    has_mask = key_mask is not None
+
+    params = dict(scale=scale, causal=causal, has_mask=has_mask,
+                  block_q=block_q, block_k=block_k, seq_q=t, seq_k=s_len)
+    if save_lse:
+        kernel = functools.partial(_flash_kernel, **params)
+        out_specs = [
+            pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 128), jnp.float32),
+        ]
+    else:
+        def kernel(q_ref, k_ref, v_ref, km_ref, o_ref, m_scr, l_scr, acc_scr):
+            return _flash_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, None,
+                                 m_scr, l_scr, acc_scr, **params)
+
+        out_specs = pl.BlockSpec((1, block_q, dp),
+                                 lambda bh, qi, ki: (bh, qi, 0))
+        out_shape = jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype)
+
     km_index = (lambda bh, qi, ki: (bh, 0, ki)) if has_mask else (
         lambda bh, qi, ki: (bh, 0, 0)
     )
     grid = (b * h, tq // block_q, tk // block_k)
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -177,8 +217,8 @@ def _flash_fwd(q, k, v, key_mask, *, causal, scale, block_q, block_k):
             pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, 1, km_block), km_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -186,29 +226,203 @@ def _flash_fwd(q, k, v, key_mask, *, causal, scale, block_q, block_k):
         ],
         interpret=not _on_tpu(),
     )(qp, kp, vp, km)
-    return out[:, :t, :d].reshape(b, h, t, d)
+    out, lse = res if save_lse else (res, None)
+    return out[:, :t, :d].reshape(b, h, t, d), lse
+
+
+def _bwd_recompute(q_ref, k_ref, v_ref, km_ref, g_ref, lse_ref, delta_ref,
+                   qi, ki, *, scale, causal, has_mask, block_q, block_k,
+                   seq_q, seq_k):
+    """Recompute p and ds for one (q-block, kv-block) pair — the math both
+    backward kernels share. Returns (q, k, g, p, ds) as fp32 tiles."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    # Clamp: padded / fully-masked rows carry lse ≈ -1e30; after the
+    # query-validity mask below their scores are -1e30 too, so the
+    # clamped difference underflows exp to exactly 0 (no inf·0 NaNs).
+    lse = jnp.maximum(lse_ref[0][:, :1], -1e20)
+    delta = delta_ref[0][:, :1]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    k_lo = ki * block_k
+    key_idx = k_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    query_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    mask = (key_idx < seq_k) & (query_idx < seq_q)
+    if has_mask:
+        mask = mask & (km_ref[0] > 0)
+    if causal:
+        mask = mask & (query_idx + (seq_k - seq_q) >= key_idx)
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse)  # [bq, bk]; exactly 0 where masked
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta) * scale
+    return q, k, g, p, ds
+
+
+def _causal_block_live(qi, ki, *, causal, block_q, block_k, seq_q, seq_k):
+    """False only for kv blocks entirely above the causal diagonal."""
+    q_hi = (qi + 1) * block_q - 1 + (seq_k - seq_q)
+    return (not causal) or (q_hi >= ki * block_k)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, g_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                          **params):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_causal_block_live(qi, ki, **{k: params[k] for k in (
+        "causal", "block_q", "block_k", "seq_q", "seq_k")}))
+    def _compute():
+        q, k, g, p, ds = _bwd_recompute(
+            q_ref, k_ref, v_ref, km_ref, g_ref, lse_ref, delta_ref,
+            qi, ki, **params)
+        dv_scr[:] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, g_ref, lse_ref,
+                         delta_ref, dq_ref, dq_scr, **params):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_causal_block_live(qi, ki, **{k: params[k] for k in (
+        "causal", "block_q", "block_k", "seq_q", "seq_k")}))
+    def _compute():
+        q, k, g, p, ds = _bwd_recompute(
+            q_ref, k_ref, v_ref, km_ref, g_ref, lse_ref, delta_ref,
+            qi, ki, **params)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, key_mask, out, lse, g, *, causal, scale,
+                    block_q, block_k):
+    """Blockwise backward; block geometry shared with fwd via _prep_blocks."""
+    b, h, t, d = q.shape
+    s_len = k.shape[2]
+    qp, kp, vp, km, km_block, block_q, block_k = _prep_blocks(
+        q, k, v, key_mask, block_q, block_k)
+    gp = _pad_to(_pad_to(g.reshape(b * h, t, d), 1, block_q), 2, 128)
+    dp = qp.shape[-1]
+    tq, tk = qp.shape[1], kp.shape[1]
+    has_mask = key_mask is not None
+
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+    delta = _pad_to(delta.reshape(b * h, t), 1, block_q)
+    delta = jnp.broadcast_to(delta[:, :, None], (b * h, tq, 128))
+
+    common = dict(scale=scale, causal=causal, has_mask=has_mask,
+                  block_q=block_q, block_k=block_k, seq_q=t, seq_k=s_len)
+    n_q, n_k = tq // block_q, tk // block_k
+
+    km_index_kq = (lambda bh, ki, qi: (bh, 0, ki)) if has_mask else (
+        lambda bh, ki, qi: (bh, 0, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(b * h, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, 1, km_block), km_index_kq),
+            pl.BlockSpec((1, block_q, dp), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, ki, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, dp), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, dp), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, dp), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dp), jnp.float32),
+            pltpu.VMEM((block_k, dp), jnp.float32),
+        ],
+        interpret=not _on_tpu(),
+    )(qp, kp, vp, km, gp, lse, delta)
+
+    km_index_qk = (lambda bh, qi, ki: (bh, 0, ki)) if has_mask else (
+        lambda bh, qi, ki: (bh, 0, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, 1, km_block), km_index_qk),
+            pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        interpret=not _on_tpu(),
+    )(qp, kp, vp, km, gp, lse, delta)
+
+    dq = dq[:, :t, :d].reshape(b, h, t, d)
+    dk = dk[:, :s_len, :d].reshape(b, h, s_len, d)
+    dv = dv[:, :s_len, :d].reshape(b, h, s_len, d)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, key_mask, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, key_mask, causal=causal, scale=scale,
-                      block_q=block_q, block_k=block_k)
+    out, _ = _flash_fwd(q, k, v, key_mask, causal=causal, scale=scale,
+                        block_q=block_q, block_k=block_k)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, key_mask, causal, scale, block_q, block_k):
-    out = _flash(q, k, v, key_mask, causal, scale, block_q, block_k)
-    return out, (q, k, v, key_mask)
+    out, lse = _flash_fwd(q, k, v, key_mask, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, save_lse=True)
+    return out, (q, k, v, key_mask, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v, key_mask = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: reference_attention(
-            q, k, v, causal=causal, scale=scale, key_mask=key_mask
-        ),
-        q, k, v,
+    q, k, v, key_mask, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, key_mask, out, lse, g,
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
     )
-    dq, dk, dv = vjp(g)
     dkm = jnp.zeros_like(key_mask) if key_mask is not None else None
     return dq, dk, dv, dkm
 

@@ -1,4 +1,4 @@
-"""Pallas TPU LSTM scan kernel.
+"""Pallas TPU LSTM scan kernels (forward + backward).
 
 ref: the cuDNN RNN platform helper (libnd4j
 ops/declarable/platform/cudnn/lstmLayer.cu + DL4J CudnnLSTMHelper) —
@@ -11,9 +11,13 @@ math + a [N,4H] slice stream-in / [N,H] stream-out. The input projection
 x·W for all timesteps is done OUTSIDE the kernel as one large MXU GEMM
 (same schedule cuDNN uses).
 
-Backward: a custom_vjp whose bwd recomputes via the XLA lax.scan
-implementation (ops/rnn.py) and differentiates that — correct by
-construction; a hand-written backward kernel is a later optimization.
+Backward: a second Pallas kernel sweeping time REVERSED (index maps flip
+t → T-1-t), carrying (dh, dc) in VMEM scratch and accumulating dRW/db/
+dpeephole directly in constant-index output blocks that stay VMEM-resident
+across the sweep — the cuDNN-style training path. The forward-under-AD
+variant saves the post-activation gates and cell states ([T,N,4H]+[T,N,H],
+the cuDNN training-workspace analogue) so backward needs no recompute; the
+primal (inference) call skips those outputs.
 
 Off-TPU the public ``lstm`` routes to ops/rnn.py (see kernels/_dispatch.py);
 shapes that don't tile (N % 8, H % 128) also fall back.
@@ -22,7 +26,6 @@ shapes that don't tile (N % 8, H % 128) also fall back.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,55 +44,79 @@ from deeplearning4j_tpu.kernels._dispatch import use_pallas as _use_pallas
 from deeplearning4j_tpu.ops import rnn as opsrnn
 
 
-def _gates_kernel(xp_ref, rw_ref, b_ref, h0_ref, c0_ref, out_ref,
-                  hN_ref, cN_ref, h_scr, c_scr, *, forget_bias, peep):
+def _make_fwd_kernel(peep: bool, save_ws: bool, forget_bias: float):
     """One timestep per grid index; state carried in VMEM scratch."""
-    t = pl.program_id(0)
-    n_t = pl.num_programs(0)
 
-    @pl.when(t == 0)
-    def _init():
-        h_scr[:] = h0_ref[:]
-        c_scr[:] = c0_ref[:]
+    def kernel(*refs):
+        xp_ref, rw_ref, b_ref = refs[0:3]
+        i0 = 3
+        if peep:
+            pI_ref, pF_ref, pO_ref = refs[3:6]
+            i0 = 6
+        h0_ref, c0_ref = refs[i0], refs[i0 + 1]
+        outs = refs[i0 + 2:]
+        out_ref, hN_ref, cN_ref = outs[0:3]
+        if save_ws:
+            gates_ref, cs_ref = outs[3:5]
+            h_scr, c_scr = outs[5:]
+        else:
+            h_scr, c_scr = outs[3:]
 
-    h = h_scr[:]
-    c_prev = c_scr[:]
-    H = h.shape[-1]
+        t = pl.program_id(0)
+        n_t = pl.num_programs(0)
 
-    z = (
-        xp_ref[0]
-        + jnp.dot(h, rw_ref[:], preferred_element_type=jnp.float32)
-        + b_ref[0]
-    )
-    zi = z[:, 0 * H : 1 * H]
-    zf = z[:, 1 * H : 2 * H]
-    zg = z[:, 2 * H : 3 * H]
-    zo = z[:, 3 * H : 4 * H]
-    if peep:
-        pI_ref, pF_ref, pO_ref = peep
-        zi = zi + pI_ref[0] * c_prev
-        zf = zf + pF_ref[0] * c_prev
-    i = jax.nn.sigmoid(zi)
-    f = jax.nn.sigmoid(zf + forget_bias)
-    g = jnp.tanh(zg)
-    c = f * c_prev + i * g
-    if peep:
-        zo = zo + pO_ref[0] * c
-    o = jax.nn.sigmoid(zo)
-    h_new = o * jnp.tanh(c)
+        @pl.when(t == 0)
+        def _init():
+            h_scr[:] = h0_ref[:]
+            c_scr[:] = c0_ref[:]
 
-    h_scr[:] = h_new
-    c_scr[:] = c
-    out_ref[0] = h_new.astype(out_ref.dtype)
+        h = h_scr[:]
+        c_prev = c_scr[:]
+        H = h.shape[-1]
 
-    @pl.when(t == n_t - 1)
-    def _final():
-        hN_ref[:] = h_new.astype(hN_ref.dtype)
-        cN_ref[:] = c.astype(cN_ref.dtype)
+        z = (
+            xp_ref[0]
+            + jnp.dot(h, rw_ref[:], preferred_element_type=jnp.float32)
+            + b_ref[0]
+        )
+        zi = z[:, 0 * H : 1 * H]
+        zf = z[:, 1 * H : 2 * H]
+        zg = z[:, 2 * H : 3 * H]
+        zo = z[:, 3 * H : 4 * H]
+        if peep:
+            zi = zi + pI_ref[0] * c_prev
+            zf = zf + pF_ref[0] * c_prev
+        i = jax.nn.sigmoid(zi)
+        f = jax.nn.sigmoid(zf + forget_bias)
+        g = jnp.tanh(zg)
+        c = f * c_prev + i * g
+        if peep:
+            zo = zo + pO_ref[0] * c
+        o = jax.nn.sigmoid(zo)
+        h_new = o * jnp.tanh(c)
+
+        h_scr[:] = h_new
+        c_scr[:] = c
+        out_ref[0] = h_new.astype(out_ref.dtype)
+        if save_ws:
+            gates_ref[0] = jnp.concatenate([i, f, g, o], axis=1)
+            cs_ref[0] = c
+
+        @pl.when(t == n_t - 1)
+        def _final():
+            hN_ref[:] = h_new.astype(hN_ref.dtype)
+            cN_ref[:] = c.astype(cN_ref.dtype)
+
+    return kernel
 
 
-def _lstm_pallas_fwd(x_proj_tm, rw, b, h0, c0, peepholes, forget_bias):
-    """x_proj_tm: [T,N,4H] time-major; returns (hs [T,N,H], (hT, cT))."""
+def _lstm_pallas_fwd(x_proj_tm, rw, b, h0, c0, peepholes, forget_bias,
+                     save_workspace=False):
+    """x_proj_tm: [T,N,4H] time-major.
+
+    Returns (hs [T,N,H], hT, cT) and, with ``save_workspace``, also the
+    post-activation gates [T,N,4H] and cell states [T,N,H].
+    """
     t_len, n, fourh = x_proj_tm.shape
     h_dim = fourh // 4
     dtype = x_proj_tm.dtype
@@ -104,22 +131,7 @@ def _lstm_pallas_fwd(x_proj_tm, rw, b, h0, c0, peepholes, forget_bias):
             pl.BlockSpec((1, h_dim), lambda t: (0, 0)) for _ in range(3)
         )
 
-    # Kernel signature depends on whether peephole refs are present.
-    if peep:
-        def kernel(xp_ref, rw_ref, b_ref, pI_ref, pF_ref, pO_ref, h0_ref, c0_ref,
-                   out_ref, hN_ref, cN_ref, h_scr, c_scr):
-            return _gates_kernel(
-                xp_ref, rw_ref, b_ref, h0_ref, c0_ref, out_ref, hN_ref, cN_ref,
-                h_scr, c_scr, forget_bias=float(forget_bias),
-                peep=(pI_ref, pF_ref, pO_ref),
-            )
-    else:
-        def kernel(xp_ref, rw_ref, b_ref, h0_ref, c0_ref,
-                   out_ref, hN_ref, cN_ref, h_scr, c_scr):
-            return _gates_kernel(
-                xp_ref, rw_ref, b_ref, h0_ref, c0_ref, out_ref, hN_ref, cN_ref,
-                h_scr, c_scr, forget_bias=float(forget_bias), peep=None,
-            )
+    kernel = _make_fwd_kernel(peep, save_workspace, float(forget_bias))
 
     in_specs = [
         pl.BlockSpec((1, n, fourh), lambda t: (t, 0, 0)),  # x_proj step t
@@ -134,21 +146,31 @@ def _lstm_pallas_fwd(x_proj_tm, rw, b, h0, c0, peepholes, forget_bias):
         pl.BlockSpec((n, h_dim), lambda t: (0, 0)),        # hT
         pl.BlockSpec((n, h_dim), lambda t: (0, 0)),        # cT
     ]
+    out_shape = [
+        jax.ShapeDtypeStruct((t_len, n, h_dim), dtype),
+        jax.ShapeDtypeStruct((n, h_dim), dtype),
+        jax.ShapeDtypeStruct((n, h_dim), dtype),
+    ]
+    if save_workspace:
+        out_specs += [
+            pl.BlockSpec((1, n, fourh), lambda t: (t, 0, 0)),  # gates
+            pl.BlockSpec((1, n, h_dim), lambda t: (t, 0, 0)),  # cs
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((t_len, n, fourh), jnp.float32),
+            jax.ShapeDtypeStruct((t_len, n, h_dim), jnp.float32),
+        ]
     scratch = [
         pltpu.VMEM((n, h_dim), jnp.float32),
         pltpu.VMEM((n, h_dim), jnp.float32),
     ]
 
-    hs, hT, cT = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid=(t_len,),
         in_specs=in_specs,
         out_specs=out_specs,
-        out_shape=[
-            jax.ShapeDtypeStruct((t_len, n, h_dim), dtype),
-            jax.ShapeDtypeStruct((n, h_dim), dtype),
-            jax.ShapeDtypeStruct((n, h_dim), dtype),
-        ],
+        out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=not _on_tpu(),
     )(
@@ -159,7 +181,160 @@ def _lstm_pallas_fwd(x_proj_tm, rw, b, h0, c0, peepholes, forget_bias):
         h0.astype(jnp.float32),
         c0.astype(jnp.float32),
     )
-    return hs, hT, cT
+
+
+def _make_bwd_kernel(peep: bool):
+    """Reversed-time step: grid index i processes t = T-1-i (the index
+    maps in _lstm_pallas_bwd do the flip, so refs already hold step t)."""
+
+    def kernel(*refs):
+        (gates_ref, cs_ref, csp_ref, hp_ref, gh_ref, gcT_ref, rw_ref) = refs[0:7]
+        i0 = 7
+        if peep:
+            pI_ref, pF_ref, pO_ref = refs[7:10]
+            i0 = 10
+        dxp_ref, drw_ref, db_ref = refs[i0 : i0 + 3]
+        i1 = i0 + 3
+        if peep:
+            dpI_ref, dpF_ref, dpO_ref = refs[i1 : i1 + 3]
+            i1 += 3
+        dh_scr, dc_scr = refs[i1:]
+
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            dh_scr[:] = jnp.zeros_like(dh_scr)
+            dc_scr[:] = gcT_ref[:]
+            drw_ref[:] = jnp.zeros_like(drw_ref)
+            db_ref[:] = jnp.zeros_like(db_ref)
+            if peep:
+                dpI_ref[:] = jnp.zeros_like(dpI_ref)
+                dpF_ref[:] = jnp.zeros_like(dpF_ref)
+                dpO_ref[:] = jnp.zeros_like(dpO_ref)
+
+        gates = gates_ref[0]
+        H = gates.shape[-1] // 4
+        ig = gates[:, 0 * H : 1 * H]
+        fg = gates[:, 1 * H : 2 * H]
+        gg = gates[:, 2 * H : 3 * H]
+        og = gates[:, 3 * H : 4 * H]
+        c_t = cs_ref[0]
+        c_prev = csp_ref[0]
+        h_prev = hp_ref[0]
+
+        dh_total = gh_ref[0] + dh_scr[:]
+        tanh_c = jnp.tanh(c_t)
+        do = dh_total * tanh_c
+        dzo = do * og * (1.0 - og)
+        dc = dc_scr[:] + dh_total * og * (1.0 - tanh_c * tanh_c)
+        if peep:
+            dc = dc + dzo * pO_ref[0]
+        di = dc * gg
+        df = dc * c_prev
+        dg = dc * ig
+        dzi = di * ig * (1.0 - ig)
+        dzf = df * fg * (1.0 - fg)
+        dzg = dg * (1.0 - gg * gg)
+        dc_next = dc * fg
+        if peep:
+            dc_next = dc_next + dzi * pI_ref[0] + dzf * pF_ref[0]
+
+        dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=1)  # [N,4H]
+        dxp_ref[0] = dz
+        # dh_{t-1} through the recurrent matmul: dz · RWᵀ.
+        dh_scr[:] = jax.lax.dot_general(
+            dz, rw_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dc_scr[:] = dc_next
+        # Weight grads accumulate in VMEM-resident output blocks.
+        drw_ref[:] += jax.lax.dot_general(
+            h_prev, dz, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        db_ref[:] += jnp.sum(dz, axis=0, keepdims=True)
+        if peep:
+            dpI_ref[:] += jnp.sum(dzi * c_prev, axis=0, keepdims=True)
+            dpF_ref[:] += jnp.sum(dzf * c_prev, axis=0, keepdims=True)
+            dpO_ref[:] += jnp.sum(dzo * c_t, axis=0, keepdims=True)
+
+    return kernel
+
+
+def _lstm_pallas_bwd(gates_tm, cs_tm, h_prev_tm, c_prev_tm, gh_tm, gcT, rw,
+                     peepholes):
+    """Reversed-time backward sweep.
+
+    gates_tm [T,N,4H], cs_tm/c_prev_tm/h_prev_tm [T,N,H], gh_tm [T,N,H]
+    (upstream grad per step incl. the final-state grad folded into the
+    last step), gcT [N,H]. Returns (dxp_tm [T,N,4H], drw [H,4H], db [4H],
+    dpeep ([H],[H],[H]) or None).
+    """
+    t_len, n, fourh = gates_tm.shape
+    h_dim = fourh // 4
+    peep = peepholes is not None
+
+    rev = lambda i: (t_len - 1 - i, 0, 0)  # noqa: E731 - index map
+    const2 = lambda i: (0, 0)  # noqa: E731
+
+    peep_args = ()
+    peep_in_specs = ()
+    if peep:
+        peep_args = tuple(p.reshape(1, h_dim).astype(jnp.float32) for p in peepholes)
+        peep_in_specs = tuple(pl.BlockSpec((1, h_dim), const2) for _ in range(3))
+
+    in_specs = [
+        pl.BlockSpec((1, n, fourh), rev),   # gates
+        pl.BlockSpec((1, n, h_dim), rev),   # c_t
+        pl.BlockSpec((1, n, h_dim), rev),   # c_{t-1}
+        pl.BlockSpec((1, n, h_dim), rev),   # h_{t-1}
+        pl.BlockSpec((1, n, h_dim), rev),   # dL/dh_t (upstream)
+        pl.BlockSpec((n, h_dim), const2),   # dL/dc_T
+        pl.BlockSpec((h_dim, fourh), const2),  # RW resident
+        *peep_in_specs,
+    ]
+    out_specs = [
+        pl.BlockSpec((1, n, fourh), rev),   # dxp
+        pl.BlockSpec((h_dim, fourh), const2),  # dRW (accumulated)
+        pl.BlockSpec((1, fourh), const2),   # db
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((t_len, n, fourh), jnp.float32),
+        jax.ShapeDtypeStruct((h_dim, fourh), jnp.float32),
+        jax.ShapeDtypeStruct((1, fourh), jnp.float32),
+    ]
+    if peep:
+        out_specs += [pl.BlockSpec((1, h_dim), const2) for _ in range(3)]
+        out_shape += [jax.ShapeDtypeStruct((1, h_dim), jnp.float32)] * 3
+    scratch = [
+        pltpu.VMEM((n, h_dim), jnp.float32),  # dh carry
+        pltpu.VMEM((n, h_dim), jnp.float32),  # dc carry
+    ]
+
+    results = pl.pallas_call(
+        _make_bwd_kernel(peep),
+        grid=(t_len,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=not _on_tpu(),
+    )(
+        gates_tm,
+        cs_tm,
+        c_prev_tm,
+        h_prev_tm,
+        gh_tm,
+        gcT,
+        rw.astype(jnp.float32),
+        *peep_args,
+    )
+    dxp_tm, drw, db = results[0:3]
+    dpeep = None
+    if peep:
+        dpeep = tuple(r.reshape(h_dim) for r in results[3:6])
+    return dxp_tm, drw, db.reshape(fourh), dpeep
 
 
 def _shapes_tile(n: int, h: int) -> bool:
@@ -170,10 +345,12 @@ def _shapes_tile(n: int, h: int) -> bool:
 def _lstm_core(x, w_x, w_h, b, peep_stack, forget_bias, has_peep):
     """peep_stack: [3,H] array when has_peep else zeros. Returns the triple
     (outputs [N,T,H], h_T [N,H], c_T [N,H])."""
-    return _lstm_core_fwd_impl(x, w_x, w_h, b, peep_stack, forget_bias, has_peep)
+    return _lstm_core_fwd_impl(x, w_x, w_h, b, peep_stack, forget_bias,
+                               has_peep)[0]
 
 
-def _lstm_core_fwd_impl(x, w_x, w_h, b, peep_stack, forget_bias, has_peep):
+def _lstm_core_fwd_impl(x, w_x, w_h, b, peep_stack, forget_bias, has_peep,
+                        save_workspace=False):
     n, t, _ = x.shape
     h_dim = w_h.shape[0]
     x_proj = jnp.einsum("nti,ih->nth", x, w_x)  # big MXU GEMM outside kernel
@@ -181,25 +358,49 @@ def _lstm_core_fwd_impl(x, w_x, w_h, b, peep_stack, forget_bias, has_peep):
     h0 = jnp.zeros((n, h_dim), jnp.float32)
     c0 = jnp.zeros((n, h_dim), jnp.float32)
     peep = tuple(peep_stack) if has_peep else None
-    hs, hT, cT = _lstm_pallas_fwd(xp_tm, w_h, b, h0, c0, peep, forget_bias)
-    return jnp.swapaxes(hs, 0, 1).astype(x.dtype), hT, cT
+    res = _lstm_pallas_fwd(xp_tm, w_h, b, h0, c0, peep, forget_bias,
+                           save_workspace=save_workspace)
+    hs, hT, cT = res[0:3]
+    primal = (jnp.swapaxes(hs, 0, 1).astype(x.dtype), hT, cT)
+    ws = (hs, res[3], res[4]) if save_workspace else None
+    return primal, ws
 
 
 def _lstm_core_vjp_fwd(x, w_x, w_h, b, peep_stack, forget_bias, has_peep):
-    out = _lstm_core(x, w_x, w_h, b, peep_stack, forget_bias, has_peep)
-    return out, (x, w_x, w_h, b, peep_stack)
+    primal, ws = _lstm_core_fwd_impl(
+        x, w_x, w_h, b, peep_stack, forget_bias, has_peep,
+        save_workspace=True,
+    )
+    hs_tm, gates_tm, cs_tm = ws
+    return primal, (x, w_x, w_h, b, peep_stack, hs_tm, gates_tm, cs_tm)
 
 
 def _lstm_core_vjp_bwd(forget_bias, has_peep, res, g):
-    x, w_x, w_h, b, peep_stack = res
+    x, w_x, w_h, b, peep_stack, hs_tm, gates_tm, cs_tm = res
+    g_out, ghT, gcT = g
+    t_len, n, h_dim = hs_tm.shape
 
-    def ref_impl(x, w_x, w_h, b, peep_stack):
-        peep = tuple(peep_stack) if has_peep else None
-        out, final = opsrnn.lstm(x, w_x, w_h, b, peepholes=peep, forget_bias=forget_bias)
-        return out, final.h, final.c
+    zeros_nh = jnp.zeros((1, n, h_dim), jnp.float32)
+    h_prev_tm = jnp.concatenate([zeros_nh, hs_tm[:-1].astype(jnp.float32)], 0)
+    c_prev_tm = jnp.concatenate([zeros_nh, cs_tm[:-1]], 0)
 
-    _, vjp = jax.vjp(ref_impl, x, w_x, w_h, b, peep_stack)
-    return vjp(g)
+    gh_tm = jnp.swapaxes(g_out, 0, 1).astype(jnp.float32)
+    gh_tm = gh_tm.at[-1].add(ghT.astype(jnp.float32))
+
+    peep = tuple(peep_stack) if has_peep else None
+    dxp_tm, drw, db, dpeep = _lstm_pallas_bwd(
+        gates_tm, cs_tm, h_prev_tm, c_prev_tm, gh_tm,
+        gcT.astype(jnp.float32), w_h, peep,
+    )
+
+    dx = jnp.einsum("tnh,ih->nti", dxp_tm, w_x.astype(jnp.float32))
+    dw_x = jnp.einsum("nti,tnh->ih", x.astype(jnp.float32), dxp_tm)
+    if has_peep:
+        dpeep_stack = jnp.stack(dpeep)
+    else:
+        dpeep_stack = jnp.zeros_like(peep_stack)
+    return (dx.astype(x.dtype), dw_x.astype(w_x.dtype), drw.astype(w_h.dtype),
+            db.astype(b.dtype), dpeep_stack.astype(peep_stack.dtype))
 
 
 _lstm_core.defvjp(_lstm_core_vjp_fwd, _lstm_core_vjp_bwd)
@@ -215,11 +416,11 @@ def lstm(
     forget_bias: float = 0.0,
     init_state=None,
 ):
-    """Drop-in replacement for ops/rnn.lstm using the Pallas kernel.
+    """Drop-in replacement for ops/rnn.lstm using the Pallas kernels.
 
     Falls back to the XLA scan when shapes don't tile onto the TPU VPU/MXU
     (N % 8 != 0 or H % 128 != 0) or when an initial state is supplied
-    (kernel currently assumes zero init for the custom-vjp recompute path).
+    (kernel currently assumes zero init for the backward sweep).
     """
     n, t, _ = x.shape
     h_dim = w_h.shape[0]

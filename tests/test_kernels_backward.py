@@ -1,0 +1,178 @@
+"""Pallas backward-kernel gradient parity vs the XLA reference.
+
+ref pattern: oracle testing + central-difference gradcheck (SURVEY §4).
+The kernels run in interpret mode on CPU (DL4J_TPU_FORCE_PALLAS=1); the
+oracle is jax.grad through the O(T²) XLA reference implementation.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.kernels.flash_attention import (
+    flash_attention,
+    reference_attention,
+)
+
+
+@pytest.fixture(autouse=True)
+def _force_pallas(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FORCE_PALLAS", "1")
+
+
+def _qkv(seed, b=2, h=2, t=32, s=None, d=16):
+    s = t if s is None else s
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (b, h, t, d))
+    k = jax.random.normal(ks[1], (b, h, s, d))
+    v = jax.random.normal(ks[2], (b, h, s, d))
+    return q, k, v
+
+
+def _grads(fn, q, k, v):
+    # Scalar loss with a fixed weighting so every output element matters.
+    w = jnp.cos(jnp.arange(q.shape[0] * q.shape[1] * q.shape[2] * v.shape[-1],
+                           dtype=jnp.float32)).reshape(
+        q.shape[0], q.shape[1], q.shape[2], v.shape[-1])
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v) * w)
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+def _assert_grads_close(got, want, atol=5e-4):
+    for g, w, name in zip(got, want, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=atol, rtol=1e-3, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_matches_reference(causal):
+    q, k, v = _qkv(0)
+    got = _grads(functools.partial(flash_attention, causal=causal), q, k, v)
+    want = _grads(functools.partial(reference_attention, causal=causal),
+                  q, k, v)
+    _assert_grads_close(got, want)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_key_mask(causal):
+    q, k, v = _qkv(1)
+    mask = jnp.ones((q.shape[0], k.shape[2])).at[:, 20:].set(0.0)
+    got = _grads(
+        functools.partial(flash_attention, causal=causal, key_mask=mask),
+        q, k, v)
+    want = _grads(
+        functools.partial(reference_attention, causal=causal, key_mask=mask),
+        q, k, v)
+    # Fully-masked reference rows softmax uniformly (flash outputs 0), so
+    # compare only grads flowing from valid positions: both paths zero
+    # key-masked columns' dk/dv identically and dq rows match everywhere
+    # queries can see ≥1 key, which is all rows here (keys 0:20 visible).
+    _assert_grads_close(got, want)
+
+
+def test_flash_bwd_unpadded_multi_block():
+    # Sequence spanning several kv blocks with tail padding inside a block.
+    q, k, v = _qkv(2, b=1, h=2, t=200, d=32)
+    got = _grads(
+        functools.partial(flash_attention, block_q=64, block_k=128), q, k, v)
+    want = _grads(reference_attention, q, k, v)
+    _assert_grads_close(got, want)
+
+
+def test_flash_bwd_cross_attention_shapes():
+    # seq_q != seq_k exercises the offset in the causal/bounds index math.
+    q, k, v = _qkv(3, t=24, s=40)
+    got = _grads(flash_attention, q, k, v)
+    want = _grads(reference_attention, q, k, v)
+    _assert_grads_close(got, want)
+
+
+class TestLstmBackward:
+    """Pallas LSTM fwd+bwd vs the XLA lax.scan reference (ops/rnn.py).
+
+    Shapes must tile (N % 8 == 0, H % 128 == 0) to take the kernel path.
+    """
+
+    N, T, I, H = 8, 5, 16, 128
+
+    def _weights(self, seed):
+        ks = jax.random.split(jax.random.key(seed), 5)
+        sc = 0.1
+        x = jax.random.normal(ks[0], (self.N, self.T, self.I))
+        w_x = jax.random.normal(ks[1], (self.I, 4 * self.H)) * sc
+        w_h = jax.random.normal(ks[2], (self.H, 4 * self.H)) * sc
+        b = jax.random.normal(ks[3], (4 * self.H,)) * sc
+        peep = jax.random.normal(ks[4], (3, self.H)) * sc
+        return x, w_x, w_h, b, peep
+
+    def _compare(self, seed, use_peep, forget_bias=0.0):
+        from deeplearning4j_tpu.kernels import lstm_scan
+        from deeplearning4j_tpu.ops import rnn as opsrnn
+
+        x, w_x, w_h, b, peep = self._weights(seed)
+        peep_t = tuple(peep) if use_peep else None
+
+        def loss(fn, x, w_x, w_h, b, peep):
+            peeps = tuple(peep) if use_peep else None
+            out, final = fn(x, w_x, w_h, b, peepholes=peeps,
+                            forget_bias=forget_bias)
+            return (jnp.sum(out * jnp.cos(jnp.arange(out.size, dtype=jnp.float32)).reshape(out.shape))
+                    + 2.0 * jnp.sum(final.h) + 3.0 * jnp.sum(final.c))
+
+        args = (x, w_x, w_h, b, peep)
+        got_out, _ = lstm_scan.lstm(x, w_x, w_h, b, peepholes=peep_t,
+                                    forget_bias=forget_bias)
+        want_out, _ = opsrnn.lstm(x, w_x, w_h, b, peepholes=peep_t,
+                                  forget_bias=forget_bias)
+        np.testing.assert_allclose(np.asarray(got_out), np.asarray(want_out),
+                                   atol=1e-5, rtol=1e-4)
+
+        got = jax.grad(functools.partial(loss, lstm_scan.lstm),
+                       argnums=(0, 1, 2, 3, 4))(*args)
+        want = jax.grad(functools.partial(loss, opsrnn.lstm),
+                        argnums=(0, 1, 2, 3, 4))(*args)
+        names = ("dx", "dw_x", "dw_h", "db", "dpeep")
+        for g, w, name in zip(got, want, names):
+            if name == "dpeep" and not use_peep:
+                continue
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=2e-4, rtol=1e-3, err_msg=name)
+
+    def test_kernel_path_taken(self, monkeypatch):
+        # Guard against the comparison silently degenerating into
+        # reference-vs-reference via the shape/dispatch fallback.
+        from deeplearning4j_tpu.kernels import lstm_scan
+
+        called = []
+        orig = lstm_scan.opsrnn.lstm
+        monkeypatch.setattr(
+            lstm_scan.opsrnn, "lstm",
+            lambda *a, **k: (called.append(1), orig(*a, **k))[1],
+        )
+        x, w_x, w_h, b, _ = self._weights(0)
+        out, _ = lstm_scan.lstm(x, w_x, w_h, b)
+        jax.block_until_ready(out)
+        assert not called, "tiled shapes should take the Pallas path"
+
+    def test_bwd_no_peepholes(self):
+        self._compare(0, use_peep=False)
+
+    def test_bwd_peepholes_graves(self):
+        self._compare(1, use_peep=True)
+
+    def test_bwd_forget_bias(self):
+        self._compare(2, use_peep=False, forget_bias=1.0)
+
+
+def test_flash_bwd_bf16_finite():
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(4))
+    dq, dk, dv = _grads(flash_attention, q, k, v)
+    for g in (dq, dk, dv):
+        assert g.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
