@@ -461,6 +461,39 @@ class SameDiff:
     def placeholder(self, name: str, shape=None, dtype="float32") -> SDVariable:
         return self._add_var(name, VariableType.PLACEHOLDER, shape, dtype)
 
+    def convert_to_variable(self, name: str) -> SDVariable:
+        """CONSTANT → trainable VARIABLE in place (↔ sd.convertToVariable).
+
+        The model-import path creates weights as constants; fine-tuning an
+        imported graph promotes them so gradients/updaters apply.
+        """
+        v = self._vars[name]
+        if v.var_type == VariableType.VARIABLE:
+            return v
+        if v.var_type != VariableType.CONSTANT:
+            raise ValueError(f"{name!r} is {v.var_type.value}, not constant")
+        v.var_type = VariableType.VARIABLE
+        self._fn_cache.clear()
+        # Updater state is keyed to the trainable set; a stale pytree would
+        # mismatch on the next fit().
+        self._updater_state = None
+        self._updater_leaves = None
+        return v
+
+    def convert_to_constant(self, name: str) -> SDVariable:
+        """VARIABLE → CONSTANT in place (↔ sd.convertToConstant) — e.g.
+        freezing layers before fine-tuning."""
+        v = self._vars[name]
+        if v.var_type == VariableType.CONSTANT:
+            return v
+        if v.var_type != VariableType.VARIABLE:
+            raise ValueError(f"{name!r} is {v.var_type.value}, not variable")
+        v.var_type = VariableType.CONSTANT
+        self._fn_cache.clear()
+        self._updater_state = None
+        self._updater_leaves = None
+        return v
+
     def _lift(self, value) -> SDVariable:
         """Wrap a literal array/scalar as an (anonymous) constant variable."""
         if isinstance(value, SDVariable):

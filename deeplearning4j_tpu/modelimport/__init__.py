@@ -3,11 +3,17 @@ SURVEY §2.3/§2.7).
 
 - keras: Keras h5 (sequential + functional) → SequentialModel/GraphModel
 - tf: frozen TF GraphDef → autodiff SameDiff program (the BERT path)
+- onnx: ONNX ModelProto → autodiff SameDiff program (dependency-free
+  protobuf wire codec in onnx_proto)
 """
 
 from deeplearning4j_tpu.modelimport.keras import (
     KerasImportError,
     import_keras_model,
+)
+from deeplearning4j_tpu.modelimport.onnx import (
+    ONNXImportError,
+    import_onnx_model,
 )
 from deeplearning4j_tpu.modelimport.tf import (
     TFImportError,
@@ -19,4 +25,6 @@ __all__ = [
     "KerasImportError",
     "import_tf_graph",
     "TFImportError",
+    "import_onnx_model",
+    "ONNXImportError",
 ]

@@ -1,0 +1,446 @@
+"""ONNX import tests.
+
+Three oracle layers (SURVEY §4 oracle-testing pattern):
+1. Wire format: our hand-rolled codec round-trips through the `protoc`
+   binary (independent protobuf implementation) — guards against a codec
+   that is merely self-consistent.
+2. Numerics: imported graphs are compared against torch executing the
+   same weights (independent framework implementation).
+3. Strict-refusal: unmapped ops raise ONNXImportError.
+"""
+
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+import torch
+
+from deeplearning4j_tpu.modelimport.onnx import (
+    ONNXImportError,
+    import_onnx_model,
+)
+from deeplearning4j_tpu.modelimport.onnx_proto import (
+    ATTR_FLOAT,
+    ATTR_INT,
+    ATTR_INTS,
+    ATTR_STRING,
+    ATTR_TENSOR,
+    AttributeProto,
+    GraphProto,
+    ModelProto,
+    NodeProto,
+    OperatorSetIdProto,
+    TensorProto,
+    TensorShapeProto,
+    TypeProto,
+    ValueInfoProto,
+)
+
+# --- fixture builders ------------------------------------------------------
+
+
+def _vi(name, shape, elem_type=1):
+    return ValueInfoProto(
+        name=name,
+        type=TypeProto(elem_type=elem_type, shape=TensorShapeProto(list(shape))),
+    )
+
+
+def _node(op_type, inputs, outputs, name="", **attrs):
+    protos = []
+    for k, v in attrs.items():
+        if v is None:
+            continue
+        if isinstance(v, float):
+            protos.append(AttributeProto(name=k, type=ATTR_FLOAT, f=v))
+        elif isinstance(v, bool) or isinstance(v, int):
+            protos.append(AttributeProto(name=k, type=ATTR_INT, i=int(v)))
+        elif isinstance(v, str):
+            protos.append(AttributeProto(name=k, type=ATTR_STRING, s=v.encode()))
+        elif isinstance(v, (list, tuple)):
+            protos.append(AttributeProto(name=k, type=ATTR_INTS,
+                                         ints=[int(x) for x in v]))
+        elif isinstance(v, np.ndarray):
+            protos.append(AttributeProto(name=k, type=ATTR_TENSOR,
+                                         t=TensorProto.from_numpy(v)))
+        else:
+            raise TypeError(f"attr {k}: {type(v)}")
+    return NodeProto(input=list(inputs), output=list(outputs), name=name,
+                     op_type=op_type, attribute=protos)
+
+
+def _model(nodes, inputs, outputs, initializers=(), opset=17):
+    g = GraphProto(
+        node=list(nodes), name="g",
+        initializer=[TensorProto.from_numpy(a, name=n) for n, a in initializers],
+        input=list(inputs), output=list(outputs),
+    )
+    return ModelProto(ir_version=8, producer_name="dl4j-tpu-tests", graph=g,
+                      opset_import=[OperatorSetIdProto(domain="", version=opset)])
+
+
+def _run(sd, out_map, feeds, out_name):
+    res = sd.output(feeds, [out_map[out_name]])
+    return np.asarray(res[out_map[out_name]])
+
+
+# --- wire-format oracle vs protoc ------------------------------------------
+
+_ONNX_PROTO = """
+syntax = "proto3";
+package onnx;
+message AttributeProto {
+  string name = 1; float f = 2; int64 i = 3; bytes s = 4;
+  TensorProto t = 5; repeated float floats = 7; repeated int64 ints = 8;
+  repeated bytes strings = 9; int32 type = 20;
+}
+message ValueInfoProto { string name = 1; TypeProto type = 2; }
+message NodeProto {
+  repeated string input = 1; repeated string output = 2; string name = 3;
+  string op_type = 4; repeated AttributeProto attribute = 5; string domain = 7;
+}
+message ModelProto {
+  int64 ir_version = 1; string producer_name = 2; GraphProto graph = 7;
+  repeated OperatorSetIdProto opset_import = 8;
+}
+message GraphProto {
+  repeated NodeProto node = 1; string name = 2;
+  repeated TensorProto initializer = 5;
+  repeated ValueInfoProto input = 11; repeated ValueInfoProto output = 12;
+  repeated ValueInfoProto value_info = 13;
+}
+message TensorProto {
+  repeated int64 dims = 1; int32 data_type = 2;
+  repeated float float_data = 4; repeated int32 int32_data = 5;
+  repeated int64 int64_data = 7; string name = 8; bytes raw_data = 9;
+  repeated double double_data = 10;
+}
+message TensorShapeProto {
+  message Dimension { int64 dim_value = 1; string dim_param = 2; }
+  repeated Dimension dim = 1;
+}
+message TypeProto {
+  message Tensor { int32 elem_type = 1; TensorShapeProto shape = 2; }
+  Tensor tensor_type = 1;
+}
+message OperatorSetIdProto { string domain = 1; int64 version = 2; }
+"""
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None, reason="protoc missing")
+def test_wire_format_vs_protoc():
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    model = _model(
+        [_node("Gemm", ["x", "w"], ["y"], name="gemm0", alpha=1.5, transB=1)],
+        [_vi("x", (None, 3))], [_vi("y", (None, 2))],
+        initializers=[("w", w)],
+    )
+    data = model.encode()
+    with tempfile.TemporaryDirectory() as td:
+        proto_path = f"{td}/onnx.proto"
+        with open(proto_path, "w") as f:
+            f.write(_ONNX_PROTO)
+        # decode our bytes with protoc (independent parser)
+        out = subprocess.run(
+            ["protoc", f"--proto_path={td}", "--decode=onnx.ModelProto",
+             proto_path],
+            input=data, capture_output=True, check=True)
+        text = out.stdout.decode()
+        assert 'op_type: "Gemm"' in text
+        assert "ir_version: 8" in text
+        assert 'producer_name: "dl4j-tpu-tests"' in text
+        assert "data_type: 1" in text
+        assert "f: 1.5" in text
+        # re-encode with protoc and decode with our codec
+        out2 = subprocess.run(
+            ["protoc", f"--proto_path={td}", "--encode=onnx.ModelProto",
+             proto_path],
+            input=out.stdout, capture_output=True, check=True)
+        m2 = ModelProto.decode(out2.stdout)
+    assert m2.graph.node[0].op_type == "Gemm"
+    assert m2.graph.node[0].attrs()["alpha"] == 1.5
+    assert m2.graph.node[0].attrs()["transB"] == 1
+    np.testing.assert_array_equal(m2.graph.initializer[0].to_numpy(), w)
+    dims = m2.graph.input[0].type.shape.dims
+    assert dims[1] == 3
+
+
+# --- numeric oracles vs torch ----------------------------------------------
+
+
+def test_mlp_matches_torch():
+    torch.manual_seed(0)
+    net = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(),
+        torch.nn.Linear(16, 5), torch.nn.Softmax(dim=-1),
+    )
+    w1 = net[0].weight.detach().numpy()  # [16, 8]
+    b1 = net[0].bias.detach().numpy()
+    w2 = net[2].weight.detach().numpy()
+    b2 = net[2].bias.detach().numpy()
+
+    model = _model(
+        [
+            _node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+            _node("Relu", ["h"], ["hr"]),
+            _node("Gemm", ["hr", "w2", "b2"], ["logits"], transB=1),
+            _node("Softmax", ["logits"], ["probs"], axis=-1),
+        ],
+        [_vi("x", (None, 8))], [_vi("probs", (None, 5))],
+        initializers=[("w1", w1), ("b1", b1), ("w2", w2), ("b2", b2)],
+    )
+    sd, in_map, out_map = import_onnx_model(model.encode())
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    got = _run(sd, out_map, {"x": x}, "probs")
+    want = net(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_cnn_matches_torch():
+    torch.manual_seed(1)
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = torch.nn.Conv2d(3, 8, 3, stride=1, padding=1)
+            self.bn = torch.nn.BatchNorm2d(8)
+            self.pool = torch.nn.MaxPool2d(2)
+            self.fc = torch.nn.Linear(8 * 8 * 8, 10)
+
+        def forward(self, x):
+            h = torch.relu(self.bn(self.conv(x)))
+            h = self.pool(h)
+            h = torch.flatten(h, 1)
+            return self.fc(h)
+
+    net = Net().eval()
+    conv_w = net.conv.weight.detach().numpy()
+    conv_b = net.conv.bias.detach().numpy()
+    bn = net.bn
+    model = _model(
+        [
+            _node("Conv", ["x", "cw", "cb"], ["c"], kernel_shape=[3, 3],
+                  strides=[1, 1], pads=[1, 1, 1, 1]),
+            _node("BatchNormalization",
+                  ["c", "bn_s", "bn_b", "bn_m", "bn_v"], ["n"],
+                  epsilon=float(bn.eps)),
+            _node("Relu", ["n"], ["r"]),
+            _node("MaxPool", ["r"], ["p"], kernel_shape=[2, 2],
+                  strides=[2, 2]),
+            _node("Flatten", ["p"], ["f"], axis=1),
+            _node("Gemm", ["f", "fw", "fb"], ["y"], transB=1),
+        ],
+        [_vi("x", (None, 3, 16, 16))], [_vi("y", (None, 10))],
+        initializers=[
+            ("cw", conv_w), ("cb", conv_b),
+            ("bn_s", bn.weight.detach().numpy()),
+            ("bn_b", bn.bias.detach().numpy()),
+            ("bn_m", bn.running_mean.detach().numpy()),
+            ("bn_v", bn.running_var.detach().numpy()),
+            ("fw", net.fc.weight.detach().numpy()),
+            ("fb", net.fc.bias.detach().numpy()),
+        ],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    x = np.random.default_rng(1).normal(size=(2, 3, 16, 16)).astype(np.float32)
+    got = _run(sd, out_map, {"x": x}, "y")
+    with torch.no_grad():
+        want = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_structural_ops_match_torch():
+    # Transpose/Concat/Reshape/Slice/ReduceMean/Unsqueeze path.
+    x = np.random.default_rng(2).normal(size=(2, 3, 4)).astype(np.float32)
+    model = _model(
+        [
+            _node("Transpose", ["x"], ["t"], perm=[0, 2, 1]),       # [2,4,3]
+            _node("Concat", ["t", "t"], ["c"], axis=1),             # [2,8,3]
+            _node("Reshape", ["c", "shape"], ["r"]),                # [2,24]
+            _node("Slice", ["r", "starts", "ends", "sl_axes"], ["s"]),  # [2,10]
+            _node("ReduceMean", ["s"], ["m"], axes=[1], keepdims=0),  # [2]
+            _node("Unsqueeze", ["m"], ["u"], axes=[1]),             # [2,1]
+        ],
+        [_vi("x", (2, 3, 4))], [_vi("u", (2, 1))],
+        initializers=[
+            ("shape", np.asarray([0, -1], np.int64)),
+            ("starts", np.asarray([4], np.int64)),
+            ("ends", np.asarray([14], np.int64)),
+            ("sl_axes", np.asarray([1], np.int64)),
+        ],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    got = _run(sd, out_map, {"x": x}, "u")
+    t = torch.from_numpy(x).permute(0, 2, 1)
+    c = torch.cat([t, t], dim=1).reshape(2, -1)
+    want = c[:, 4:14].mean(dim=1, keepdim=True).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_avgpool_gap_lrn():
+    x = np.random.default_rng(3).normal(size=(1, 4, 8, 8)).astype(np.float32)
+    model = _model(
+        [
+            _node("AveragePool", ["x"], ["a"], kernel_shape=[2, 2],
+                  strides=[2, 2]),
+            _node("LRN", ["a"], ["l"], size=3, alpha=2e-4, beta=0.75,
+                  bias=1.0),
+            _node("GlobalAveragePool", ["l"], ["g"]),
+        ],
+        [_vi("x", (1, 4, 8, 8))], [_vi("g", (1, 4, 1, 1))],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    got = _run(sd, out_map, {"x": x}, "g")
+    xt = torch.from_numpy(x)
+    at = torch.nn.functional.avg_pool2d(xt, 2, 2)
+    lt = torch.nn.functional.local_response_norm(at, 3, alpha=2e-4,
+                                                beta=0.75, k=1.0)
+    want = lt.mean(dim=(2, 3), keepdim=True).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_grouped_conv_matches_torch():
+    torch.manual_seed(4)
+    conv = torch.nn.Conv2d(4, 8, 3, padding=1, groups=2).eval()
+    model = _model(
+        [_node("Conv", ["x", "w", "b"], ["y"], kernel_shape=[3, 3],
+               pads=[1, 1, 1, 1], group=2)],
+        [_vi("x", (1, 4, 6, 6))], [_vi("y", (1, 8, 6, 6))],
+        initializers=[("w", conv.weight.detach().numpy()),
+                      ("b", conv.bias.detach().numpy())],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    x = np.random.default_rng(4).normal(size=(1, 4, 6, 6)).astype(np.float32)
+    got = _run(sd, out_map, {"x": x}, "y")
+    with torch.no_grad():
+        want = conv(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_gemm_alpha_beta_transA():
+    a = np.random.default_rng(5).normal(size=(3, 2)).astype(np.float32)
+    b = np.random.default_rng(6).normal(size=(3, 4)).astype(np.float32)
+    c = np.random.default_rng(7).normal(size=(4,)).astype(np.float32)
+    model = _model(
+        [_node("Gemm", ["x", "b", "c"], ["y"], alpha=0.5, beta=2.0,
+               transA=1)],
+        [_vi("x", (3, 2))], [_vi("y", (2, 4))],
+        initializers=[("b", b), ("c", c)],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    got = _run(sd, out_map, {"x": a}, "y")
+    want = 0.5 * (a.T @ b) + 2.0 * c
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_elementwise_and_constant_nodes():
+    x = np.random.default_rng(8).normal(size=(2, 3)).astype(np.float32)
+    two = np.asarray(2.0, np.float32)
+    model = _model(
+        [
+            _node("Constant", [], ["k"], value=two),
+            _node("Mul", ["x", "k"], ["m"]),
+            _node("Clip", ["m"], ["cl"], min=-1.0, max=1.0),
+            _node("Erf", ["cl"], ["e"]),
+            _node("LeakyRelu", ["e"], ["y"], alpha=0.1),
+        ],
+        [_vi("x", (2, 3))], [_vi("y", (2, 3))],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    got = _run(sd, out_map, {"x": x}, "y")
+    want = torch.nn.functional.leaky_relu(
+        torch.erf(torch.clamp(torch.from_numpy(x) * 2.0, -1, 1)), 0.1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_dropout_identity_and_cast():
+    x = np.random.default_rng(9).normal(size=(2, 3)).astype(np.float32)
+    model = _model(
+        [
+            _node("Dropout", ["x"], ["d"], ratio=0.5),
+            _node("Cast", ["d"], ["y"], to=6),  # INT32
+        ],
+        [_vi("x", (2, 3))], [_vi("y", (2, 3), elem_type=6)],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    got = _run(sd, out_map, {"x": x}, "y")
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, x.astype(np.int32))
+
+
+def test_fp16_int32_data_bit_pattern():
+    # Spec: fp16 values without raw_data live as uint16 BIT PATTERNS in
+    # int32_data (0x3C00 == 1.0), not as numeric values.
+    t = TensorProto(dims=[2], data_type=10, int32_data=[0x3C00, 0x4000])
+    np.testing.assert_array_equal(t.to_numpy().astype(np.float32),
+                                  np.asarray([1.0, 2.0], np.float32))
+
+
+def test_flatten_negative_axis_and_empty_reduce():
+    x = np.random.default_rng(11).normal(size=(2, 3, 4)).astype(np.float32)
+    model = _model(
+        [
+            _node("Flatten", ["x"], ["f"], axis=-1),     # → (6, 4)
+            _node("ReduceSum", ["f"], ["s"], keepdims=0),  # empty axes → scalar
+        ],
+        [_vi("x", (2, 3, 4))], [_vi("s", ())],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    flat = _run(sd, out_map, {"x": x}, "s")
+    assert flat.shape == ()
+    np.testing.assert_allclose(flat, x.sum(), rtol=1e-5)
+
+
+def test_conv_without_kernel_shape_attr():
+    torch.manual_seed(12)
+    conv = torch.nn.Conv2d(2, 3, 3, padding=1).eval()
+    model = _model(
+        [_node("Conv", ["x", "w", "b"], ["y"], pads=[1, 1, 1, 1])],
+        [_vi("x", (1, 2, 5, 5))], [_vi("y", (1, 3, 5, 5))],
+        initializers=[("w", conv.weight.detach().numpy()),
+                      ("b", conv.bias.detach().numpy())],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    x = np.random.default_rng(12).normal(size=(1, 2, 5, 5)).astype(np.float32)
+    got = _run(sd, out_map, {"x": x}, "y")
+    with torch.no_grad():
+        want = conv(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_unmapped_op_refused():
+    model = _model(
+        [_node("NonMaxSuppression", ["x"], ["y"])],
+        [_vi("x", (2, 3))], [_vi("y", (2, 3))],
+    )
+    with pytest.raises(ONNXImportError, match="NonMaxSuppression"):
+        import_onnx_model(model.encode())
+
+
+def test_imported_graph_is_trainable():
+    # Imported programs are SameDiff graphs: gradient flow must work
+    # (↔ reference fine-tunes imported models).
+    torch.manual_seed(10)
+    lin = torch.nn.Linear(4, 3)
+    model = _model(
+        [
+            _node("Gemm", ["x", "w", "b"], ["y"], transB=1),
+            _node("ReduceSum", ["y"], ["loss"], keepdims=0),
+        ],
+        [_vi("x", (None, 4))], [_vi("loss", ())],
+        initializers=[("w", lin.weight.detach().numpy()),
+                      ("b", lin.bias.detach().numpy())],
+    )
+    sd, _, out_map = import_onnx_model(model.encode())
+    x = np.ones((2, 4), np.float32)
+    w_name = [n for n in sd._vars
+              if n == "w" or n.startswith("w__")][0]
+    sd.convert_to_variable(w_name)  # promote imported weight (fine-tune path)
+    grads = sd.calculate_gradients({"x": x}, out_map["loss"], [w_name])
+    assert grads[w_name].shape == (3, 4)
+    assert np.isfinite(np.asarray(grads[w_name])).all()
+    # torch oracle: d(sum(x@W^T+b))/dW = ones(3,1) @ sum_x  → each row = x-colsums
+    want = np.tile(x.sum(0), (3, 1))
+    np.testing.assert_allclose(grads[w_name], want, atol=1e-5)
