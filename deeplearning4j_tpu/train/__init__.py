@@ -1,7 +1,23 @@
-"""Training stack (↔ deeplearning4j Solver/updaters/listeners)."""
+"""Training stack (↔ deeplearning4j Solver/updaters/listeners +
+earlystopping + transferlearning)."""
 
 from deeplearning4j_tpu.train import listeners, schedules, updaters  # noqa: F401
+from deeplearning4j_tpu.train.earlystopping import (
+    EarlyStoppingConfig,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    InvalidScoreIterationTermination,
+    MaxEpochsTermination,
+    MaxScoreIterationTermination,
+    MaxTimeTermination,
+    ScoreImprovementEpochTermination,
+)
 from deeplearning4j_tpu.train.trainer import TrainState, Trainer
+from deeplearning4j_tpu.train.transfer import (
+    FineTuneConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
 from deeplearning4j_tpu.train.updaters import (
     AMSGrad,
     AdaDelta,
@@ -20,4 +36,9 @@ __all__ = [
     "listeners", "schedules", "updaters", "TrainState", "Trainer",
     "Sgd", "Adam", "AdamW", "AMSGrad", "Nadam", "AdaMax", "AdaGrad",
     "AdaDelta", "RmsProp", "Nesterovs", "NoOp",
+    "TransferLearning", "TransferLearningHelper", "FineTuneConfiguration",
+    "EarlyStoppingTrainer", "EarlyStoppingConfig", "EarlyStoppingResult",
+    "MaxEpochsTermination", "ScoreImprovementEpochTermination",
+    "MaxTimeTermination", "MaxScoreIterationTermination",
+    "InvalidScoreIterationTermination",
 ]

@@ -1,0 +1,215 @@
+"""Early stopping trainer + termination conditions.
+
+ref: deeplearning4j-core org.deeplearning4j.earlystopping.** (SURVEY §2.5):
+EarlyStoppingConfiguration{scoreCalculator, epoch/iteration termination
+conditions, model saver}, EarlyStoppingTrainer, EarlyStoppingResult. Same
+capability surface here over the functional Trainer: epoch conditions
+(max epochs, score-improvement patience, max time) and iteration
+conditions (max score / invalid score), best-state retention, and a
+result record with the termination reason.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+from deeplearning4j_tpu.train.trainer import Trainer, TrainState
+
+# --- termination conditions (↔ org.deeplearning4j.earlystopping.termination) ---
+
+
+class EpochTerminationCondition:
+    def initialize(self):  # noqa: B027 - optional hook
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self):  # noqa: B027
+        pass
+
+    def terminate(self, iteration: int, loss: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTermination(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score):
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTermination(EpochTerminationCondition):
+    """Stop when the eval score hasn't improved by ``min_improvement`` for
+    ``patience`` consecutive epochs (↔ ScoreImprovementEpochTerminationCondition)."""
+
+    def __init__(self, patience: int, min_improvement: float = 0.0):
+        self.patience = patience
+        self.min_improvement = min_improvement
+        self.initialize()
+
+    def initialize(self):
+        self._best = math.inf
+        self._bad_epochs = 0
+
+    def terminate(self, epoch, score):
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._bad_epochs = 0
+            return False
+        self._bad_epochs += 1
+        return self._bad_epochs > self.patience
+
+
+class MaxTimeTermination(EpochTerminationCondition, IterationTerminationCondition):
+    """Wall-clock budget (↔ MaxTimeIterationTerminationCondition)."""
+
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self.initialize()
+
+    def initialize(self):
+        self._t0 = time.monotonic()
+
+    def terminate(self, *_):
+        return time.monotonic() - self._t0 >= self.max_seconds
+
+
+class MaxScoreIterationTermination(IterationTerminationCondition):
+    """Abort when training loss explodes past a bound
+    (↔ MaxScoreIterationTerminationCondition)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, iteration, loss):
+        return loss > self.max_score
+
+
+class InvalidScoreIterationTermination(IterationTerminationCondition):
+    """Abort on NaN/inf loss (↔ InvalidScoreIterationTerminationCondition)."""
+
+    def terminate(self, iteration, loss):
+        return not math.isfinite(loss)
+
+
+# --- configuration / result ------------------------------------------------
+
+
+@dataclasses.dataclass
+class EarlyStoppingConfig:
+    """↔ EarlyStoppingConfiguration.
+
+    score_calculator(trainer, ts) -> float, LOWER is better (↔
+    DataSetLossCalculator; wrap accuracy as ``1 - acc``). Evaluated every
+    ``evaluate_every_epochs`` epochs.
+    """
+
+    score_calculator: Callable[[Trainer, TrainState], float]
+    epoch_terminations: List[EpochTerminationCondition] = dataclasses.field(
+        default_factory=list)
+    iteration_terminations: List[IterationTerminationCondition] = dataclasses.field(
+        default_factory=list)
+    evaluate_every_epochs: int = 1
+    save_best: Optional[Callable[[TrainState, float, int], None]] = None
+
+
+@dataclasses.dataclass
+class EarlyStoppingResult:
+    """↔ EarlyStoppingResult: why training stopped + the best state."""
+
+    best_state: TrainState
+    best_score: float
+    best_epoch: int
+    termination_reason: str
+    termination_details: str
+    total_epochs: int
+    score_history: Dict[int, float]
+
+
+class _IterationGuard(TrainingListener):
+    """Listener surfacing iteration-termination conditions into fit()."""
+
+    def __init__(self, conditions: List[IterationTerminationCondition]):
+        self.conditions = conditions
+        self.tripped: Optional[IterationTerminationCondition] = None
+
+    def on_iteration(self, epoch, step, ts, metrics) -> bool:
+        loss = float(jax.device_get(metrics["total_loss"]))
+        for c in self.conditions:
+            if c.terminate(step, loss):
+                self.tripped = c
+                return True
+        return False
+
+
+class EarlyStoppingTrainer:
+    """Epoch loop with eval-score tracking and best-state retention
+    (↔ BaseEarlyStoppingTrainer.fit)."""
+
+    def __init__(self, trainer: Trainer, config: EarlyStoppingConfig):
+        self.trainer = trainer
+        self.config = config
+
+    def fit(self, ts: TrainState, data, *, max_epochs: int = 10_000,
+            listeners: Optional[List[TrainingListener]] = None,
+            steps_per_epoch: Optional[int] = None) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_terminations:
+            c.initialize()
+        for c in cfg.iteration_terminations:
+            c.initialize()
+
+        best_score = math.inf
+        best_state = ts
+        best_epoch = -1
+        history: Dict[int, float] = {}
+        reason, details = "MaxEpochs", f"max_epochs={max_epochs}"
+
+        epoch = -1
+        for epoch in range(max_epochs):
+            guard = _IterationGuard(cfg.iteration_terminations)
+            ts = self.trainer.fit(
+                ts, data, epochs=1, steps_per_epoch=steps_per_epoch,
+                listeners=list(listeners or []) + [guard],
+            )
+            if guard.tripped is not None:
+                reason = "IterationTermination"
+                details = type(guard.tripped).__name__
+                break
+
+            if (epoch + 1) % cfg.evaluate_every_epochs == 0:
+                score = float(cfg.score_calculator(self.trainer, ts))
+                history[epoch] = score
+                if score < best_score:
+                    best_score, best_state, best_epoch = score, ts, epoch
+                    if cfg.save_best is not None:
+                        cfg.save_best(ts, score, epoch)
+            else:
+                score = history.get(epoch - 1, math.inf)
+
+            hit = next(
+                (c for c in cfg.epoch_terminations if c.terminate(epoch, score)),
+                None)
+            if hit is not None:
+                reason = "EpochTermination"
+                details = type(hit).__name__
+                break
+
+        if best_epoch < 0:  # never evaluated: fall back to the final state
+            best_state, best_score, best_epoch = ts, math.inf, epoch
+        return EarlyStoppingResult(
+            best_state=best_state, best_score=best_score,
+            best_epoch=best_epoch, termination_reason=reason,
+            termination_details=details, total_epochs=epoch + 1,
+            score_history=history,
+        )

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Callable, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -71,6 +71,12 @@ class Trainer:
     ``mesh``/``state_sharding``/``batch_sharding``: optional pjit placement
     (see parallel/ for policy builders). Without a mesh, runs single-device
     jit — the same program, so single-chip and pod use identical code.
+
+    ``frozen_layers``: top-level param-tree keys (layer names) excluded from
+    training (↔ FrozenLayer wrapping in the reference's transfer-learning
+    path). Gradients for frozen layers are zeroed BEFORE the updater (so
+    Adam-style moments stay zero) and their updates are zeroed AFTER it
+    (so decoupled weight decay à la AdamW cannot move them either).
     """
 
     def __init__(
@@ -81,10 +87,17 @@ class Trainer:
         state_sharding=None,
         batch_sharding=None,
         extra_metrics: Optional[Callable] = None,
+        frozen_layers: Optional[Sequence[str]] = None,
     ):
         self.model = model
         self.net: NeuralNetConfiguration = model.net
         self.mesh = mesh
+        self.frozen_layers = frozenset(frozen_layers or ())
+        if self.frozen_layers:
+            known = set(getattr(model, "layer_names", [])) or None
+            unknown = (self.frozen_layers - known) if known else set()
+            if unknown:
+                raise ValueError(f"frozen_layers not in model: {sorted(unknown)}")
         upd_init, upd_update = resolve_updater(self.net.updater).make()
         self._upd_init = upd_init
         self._upd_update = upd_update
@@ -117,8 +130,10 @@ class Trainer:
             (loss, (new_model_state, metrics)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(ts.params)
+            grads = self._mask_frozen(grads)
             grads = _normalize_gradients(grads, self.net)
             updates, new_opt = self._upd_update(grads, ts.opt_state, ts.params, ts.step)
+            updates = self._mask_frozen(updates)
             new_params = apply_updates(ts.params, updates)
             metrics = dict(metrics)
             metrics["total_loss"] = loss
@@ -140,6 +155,15 @@ class Trainer:
             jit_kwargs["in_shardings"] = (state_sharding, batch_sharding)
             jit_kwargs["out_shardings"] = (state_sharding, None)
         self.train_step = jax.jit(train_step, **jit_kwargs)
+
+    def _mask_frozen(self, tree):
+        if not self.frozen_layers:
+            return tree
+        return {
+            k: (jax.tree_util.tree_map(jnp.zeros_like, v)
+                if k in self.frozen_layers else v)
+            for k, v in tree.items()
+        }
 
     # -- state construction ------------------------------------------------
 

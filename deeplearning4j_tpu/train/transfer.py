@@ -1,0 +1,195 @@
+"""Transfer learning: graph surgery + fine-tune configuration.
+
+ref: org.deeplearning4j.nn.transferlearning.{TransferLearning,
+FineTuneConfiguration, TransferLearningHelper} (SURVEY §2.5) — freeze a
+feature-extractor prefix, remove/replace output layers, override training
+hyperparameters, and carry pretrained weights into the surgered network.
+
+TPU-era differences: params are a pytree keyed by layer name (no flat
+vector views to re-slice), and freezing is a compiled-step gradient mask
+(Trainer.frozen_layers) rather than FrozenLayer wrapper objects — the
+frozen forward still runs inside the single fused XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from deeplearning4j_tpu.nn.config import (
+    NeuralNetConfiguration,
+    SequentialConfig,
+)
+from deeplearning4j_tpu.nn.model import SequentialModel
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Hyperparameter overrides applied to the surgered net
+    (↔ org.deeplearning4j.nn.transferlearning.FineTuneConfiguration).
+
+    Only non-None fields override the pretrained model's configuration.
+    """
+
+    updater: Any = None
+    seed: Optional[int] = None
+    weight_init: Optional[str] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+    mixed_precision: Optional[bool] = None
+
+    def apply(self, net: NeuralNetConfiguration) -> NeuralNetConfiguration:
+        overrides = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if getattr(self, f.name) is not None
+        }
+        return dataclasses.replace(net, **overrides)
+
+
+class TransferLearning:
+    """Builder performing surgery on a trained SequentialModel
+    (↔ TransferLearning.Builder).
+
+    Usage::
+
+        tl = (TransferLearning(model, variables)
+              .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-4)))
+              .set_feature_extractor("3_dense")     # freeze ≤ this layer
+              .remove_last_layers(1)                # pop the old head
+              .add_layer(OutputLayer(n_out=5)))
+        new_model, new_vars, frozen = tl.build()
+        trainer = Trainer(new_model, frozen_layers=frozen)
+
+    Weights for retained layers are carried over; new layers initialize
+    fresh. Frozen-layer names feed Trainer(frozen_layers=...).
+    """
+
+    def __init__(self, model: SequentialModel, variables: Dict[str, Any]):
+        self._model = model
+        self._variables = variables
+        self._layers: List[Any] = list(model.layers)
+        self._keep_names: List[Optional[str]] = list(model.layer_names)
+        self._freeze_until: Optional[int] = None
+        self._ftc: Optional[FineTuneConfiguration] = None
+
+    def _index_of(self, layer: Union[int, str]) -> int:
+        if isinstance(layer, int):
+            return layer
+        try:
+            return self._keep_names.index(layer)
+        except ValueError:
+            raise ValueError(
+                f"layer {layer!r} not found; have {self._keep_names}"
+            ) from None
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration) -> "TransferLearning":
+        self._ftc = ftc
+        return self
+
+    def set_feature_extractor(self, layer: Union[int, str]) -> "TransferLearning":
+        """Freeze every layer up to and INCLUSIVE of ``layer``
+        (↔ setFeatureExtractor)."""
+        self._freeze_until = self._index_of(layer)
+        return self
+
+    def remove_last_layers(self, n: int = 1) -> "TransferLearning":
+        """Pop ``n`` layers off the top (↔ removeOutputLayer /
+        removeLayersFromOutput)."""
+        if n > len(self._layers):
+            raise ValueError(f"cannot remove {n} of {len(self._layers)} layers")
+        del self._layers[len(self._layers) - n:]
+        del self._keep_names[len(self._keep_names) - n:]
+        return self
+
+    def add_layer(self, layer_cfg) -> "TransferLearning":
+        """Append a fresh layer (↔ addLayer); it initializes from scratch."""
+        self._layers.append(layer_cfg)
+        self._keep_names.append(None)  # no pretrained weights to carry
+        return self
+
+    def n_out_replace(self, layer: Union[int, str], n_out: int,
+                      weight_init: Optional[str] = None) -> "TransferLearning":
+        """Replace a layer's output width, re-initializing it
+        (↔ nOutReplace)."""
+        i = self._index_of(layer)
+        cfg = self._layers[i]
+        if not hasattr(cfg, "n_out"):
+            raise ValueError(f"layer {self._keep_names[i]!r} has no n_out")
+        kw = {"n_out": n_out}
+        if weight_init is not None and hasattr(cfg, "weight_init"):
+            kw["weight_init"] = weight_init
+        self._layers[i] = dataclasses.replace(cfg, **kw)
+        self._keep_names[i] = None  # shape changed: fresh init
+        return self
+
+    def build(self, seed: Optional[int] = None):
+        """Returns (model, variables, frozen_layer_names)."""
+        net = self._model.net
+        if self._ftc is not None:
+            net = self._ftc.apply(net)
+        config = SequentialConfig(
+            net=net, layers=list(self._layers),
+            input_shape=self._model.config.input_shape,
+        )
+        new_model = SequentialModel(config)
+        fresh = new_model.init(seed)
+
+        old_params = self._variables.get("params", {})
+        old_state = self._variables.get("state", {})
+        params = dict(fresh["params"])
+        state = dict(fresh["state"])
+        for new_name, old_name in zip(new_model.layer_names, self._keep_names):
+            if old_name is None:
+                continue
+            if old_name in old_params:
+                params[new_name] = old_params[old_name]
+            if old_name in old_state:
+                state[new_name] = old_state[old_name]
+
+        frozen: List[str] = []
+        if self._freeze_until is not None:
+            frozen = [
+                name for i, name in enumerate(new_model.layer_names)
+                if i <= self._freeze_until
+                and name in fresh["params"]
+            ]
+        return new_model, {"params": params, "state": state}, frozen
+
+
+class TransferLearningHelper:
+    """Featurize-once helper (↔ TransferLearningHelper): run the frozen
+    prefix once per dataset and train only the head on cached features."""
+
+    def __init__(self, model: SequentialModel, variables: Dict[str, Any],
+                 frozen_until: Union[int, str]):
+        if isinstance(frozen_until, str):
+            frozen_until = model.layer_names.index(frozen_until)
+        self._split = frozen_until + 1
+        self._model = model
+        self._variables = variables
+
+    def featurize(self, x, **kw):
+        """Activations at the freeze boundary (host-callable)."""
+        out, _ = self._model.apply(self._variables, x, up_to=self._split, **kw)
+        return out
+
+    def unfrozen_graph(self):
+        """(model, variables) for the trainable tail, consuming featurized
+        inputs."""
+        tail_layers = self._model.layers[self._split:]
+        tail_names = self._model.layer_names[self._split:]
+        config = SequentialConfig(
+            net=self._model.net, layers=list(tail_layers),
+            input_shape=self._model.shapes[self._split],
+        )
+        tail = SequentialModel(config)
+        params, state = {}, {}
+        for new_name, old_name in zip(tail.layer_names, tail_names):
+            if old_name in self._variables.get("params", {}):
+                params[new_name] = self._variables["params"][old_name]
+            if old_name in self._variables.get("state", {}):
+                state[new_name] = self._variables["state"][old_name]
+        return tail, {"params": params, "state": state}
