@@ -191,9 +191,14 @@ class EarlyStoppingTrainer:
                 score = float(cfg.score_calculator(self.trainer, ts))
                 history[epoch] = score
                 if score < best_score:
-                    best_score, best_state, best_epoch = score, ts, epoch
+                    # Deep-copy: train_step donates its input state, so the
+                    # live ts buffers are invalidated next epoch — retaining
+                    # the reference would hand back deleted arrays.
+                    best_state = jax.tree_util.tree_map(
+                        lambda a: a.copy() if hasattr(a, "copy") else a, ts)
+                    best_score, best_epoch = score, epoch
                     if cfg.save_best is not None:
-                        cfg.save_best(ts, score, epoch)
+                        cfg.save_best(best_state, score, epoch)
             else:
                 score = history.get(epoch - 1, math.inf)
 

@@ -113,12 +113,18 @@ class TransferLearning:
     def n_out_replace(self, layer: Union[int, str], n_out: int,
                       weight_init: Optional[str] = None) -> "TransferLearning":
         """Replace a layer's output width, re-initializing it
-        (↔ nOutReplace)."""
+        (↔ nOutReplace; nOut maps to ``units`` on dense/output layers and
+        ``filters`` on conv layers)."""
         i = self._index_of(layer)
         cfg = self._layers[i]
-        if not hasattr(cfg, "n_out"):
-            raise ValueError(f"layer {self._keep_names[i]!r} has no n_out")
-        kw = {"n_out": n_out}
+        if hasattr(cfg, "units"):
+            kw = {"units": n_out}
+        elif hasattr(cfg, "filters"):
+            kw = {"filters": n_out}
+        else:
+            raise ValueError(
+                f"layer {self._keep_names[i]!r} ({type(cfg).__name__}) has "
+                "no output-width attribute (units/filters)")
         if weight_init is not None and hasattr(cfg, "weight_init"):
             kw["weight_init"] = weight_init
         self._layers[i] = dataclasses.replace(cfg, **kw)
