@@ -1,0 +1,224 @@
+"""ROC / AUC / calibration evaluation (↔ org.nd4j.evaluation.classification.
+{ROC, ROCBinary, ROCMultiClass, EvaluationCalibration}).
+
+ref: the reference's ROC supports an "exact" mode (store every score) and a
+"thresholded" mode (fixed threshold steps, O(1) memory). TPU-native design
+keeps only the thresholded mode's statistic — per-batch accumulation is a
+pair of fixed-size score HISTOGRAMS (positives / negatives per output),
+computed on device with one segment-sum per batch (static shapes, jit-able,
+and psum-able across data shards exactly like the confusion matrix in
+classification.py). Curves, AUC, AUPRC, reliability and ECE are derived
+host-side at report time from the histograms; with B bins the derived curve
+is identical to the reference's thresholded curve with B steps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_trapz = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 compat
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _hist_update(pos_hist, neg_hist, scores_and_labels, bins):
+    """Accumulate per-class score histograms on device.
+
+    scores_and_labels = (probs [N, C] in [0,1], labels [N, C] in {0,1}).
+    Returns updated ([C, bins], [C, bins]) histograms.
+    """
+    probs, labels = scores_and_labels
+    idx = jnp.clip((probs * bins).astype(jnp.int32), 0, bins - 1)  # [N, C]
+    c = probs.shape[1]
+    # one segment-sum per class-column, flattened to a single call:
+    # flat bin id = class * bins + score bin
+    flat = idx + jnp.arange(c)[None, :] * bins
+    pos = jax.ops.segment_sum(labels.reshape(-1), flat.reshape(-1), c * bins)
+    neg = jax.ops.segment_sum((1.0 - labels).reshape(-1), flat.reshape(-1),
+                              c * bins)
+    return (pos_hist + pos.reshape(c, bins), neg_hist + neg.reshape(c, bins))
+
+
+def _as_2d(a):
+    a = jnp.asarray(a)
+    return a[:, None] if a.ndim == 1 else a
+
+
+class ROCBinary:
+    """Per-output-column binary ROC (↔ ROCBinary); the building block for
+    ROC (1 column) and ROCMultiClass (one-vs-all columns)."""
+
+    def __init__(self, num_outputs: int = 1, threshold_steps: int = 200):
+        self.num_outputs = num_outputs
+        self.bins = threshold_steps
+        self.pos = jnp.zeros((num_outputs, self.bins), jnp.float32)
+        self.neg = jnp.zeros((num_outputs, self.bins), jnp.float32)
+
+    # -- accumulation (device-side) ---------------------------------------
+
+    def eval(self, labels, probs):
+        labels = _as_2d(labels).astype(jnp.float32)
+        probs = _as_2d(probs)
+        if labels.shape != probs.shape:
+            raise ValueError(f"shape mismatch {labels.shape} vs {probs.shape}")
+        self.pos, self.neg = _hist_update(self.pos, self.neg, (probs, labels),
+                                          self.bins)
+        return self
+
+    def merge(self, other: "ROCBinary"):
+        self.pos = self.pos + other.pos
+        self.neg = self.neg + other.neg
+        return self
+
+    # -- derived curves (host-side) ---------------------------------------
+
+    def _counts(self, output: int):
+        pos = np.asarray(jax.device_get(self.pos[output]), np.float64)
+        neg = np.asarray(jax.device_get(self.neg[output]), np.float64)
+        return pos, neg
+
+    def roc_curve(self, output: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(thresholds, fpr, tpr), thresholds ascending 0..1 (B+1 points).
+
+        Point k is the operating point "predict positive iff score >= k/B":
+        TPR = P(score bin >= k | positive), FPR likewise for negatives.
+        """
+        pos, neg = self._counts(output)
+        p_total = max(pos.sum(), 1.0)
+        n_total = max(neg.sum(), 1.0)
+        # suffix sums: counts with bin index >= k, k = 0..B
+        tp = np.concatenate([np.cumsum(pos[::-1])[::-1], [0.0]])
+        fp = np.concatenate([np.cumsum(neg[::-1])[::-1], [0.0]])
+        thr = np.arange(self.bins + 1) / self.bins
+        return thr, fp / n_total, tp / p_total
+
+    def precision_recall_curve(self, output: int = 0):
+        """(thresholds, precision, recall); precision=1 at zero predictions
+        (↔ reference convention for the empty-positive end of the curve)."""
+        pos, neg = self._counts(output)
+        p_total = max(pos.sum(), 1.0)
+        tp = np.concatenate([np.cumsum(pos[::-1])[::-1], [0.0]])
+        fp = np.concatenate([np.cumsum(neg[::-1])[::-1], [0.0]])
+        pred = tp + fp
+        prec = np.divide(tp, pred, out=np.ones_like(tp), where=pred > 0)
+        rec = tp / p_total
+        thr = np.arange(self.bins + 1) / self.bins
+        return thr, prec, rec
+
+    def auc(self, output: int = 0) -> float:
+        """Area under ROC via trapezoid over the thresholded curve
+        (↔ ROC.calculateAUC)."""
+        _, fpr, tpr = self.roc_curve(output)
+        return float(-_trapz(tpr, fpr))  # fpr descends with threshold
+
+    def auc_pr(self, output: int = 0) -> float:
+        """Area under precision-recall (↔ ROC.calculateAUCPR)."""
+        _, prec, rec = self.precision_recall_curve(output)
+        return float(-_trapz(prec, rec))
+
+
+class ROC(ROCBinary):
+    """Binary ROC (↔ org.nd4j.evaluation.classification.ROC, thresholded
+    mode). Accepts labels/probs as [N], [N,1], or one-hot/softmax [N,2]
+    (positive class = column 1, reference convention)."""
+
+    def __init__(self, threshold_steps: int = 200):
+        super().__init__(num_outputs=1, threshold_steps=threshold_steps)
+
+    def eval(self, labels, probs):
+        labels = jnp.asarray(labels)
+        probs = jnp.asarray(probs)
+        if labels.ndim == 2 and labels.shape[1] == 2:
+            labels = labels[:, 1]
+        if probs.ndim == 2 and probs.shape[1] == 2:
+            probs = probs[:, 1]
+        return super().eval(labels, probs)
+
+
+class ROCMultiClass(ROCBinary):
+    """One-vs-all ROC per class (↔ ROCMultiClass). labels one-hot [N, C]
+    or int ids [N]; probs [N, C] (softmax)."""
+
+    def __init__(self, num_classes: int, threshold_steps: int = 200):
+        super().__init__(num_outputs=num_classes, threshold_steps=threshold_steps)
+
+    def eval(self, labels, probs):
+        labels = jnp.asarray(labels)
+        probs = jnp.asarray(probs)
+        if labels.ndim == 1:
+            labels = jax.nn.one_hot(labels, self.num_outputs)
+        return super().eval(labels, probs)
+
+    def average_auc(self) -> float:
+        """Macro-average AUC over classes (↔ calculateAverageAUC)."""
+        return float(np.mean([self.auc(i) for i in range(self.num_outputs)]))
+
+
+class EvaluationCalibration:
+    """Calibration statistics (↔ EvaluationCalibration): reliability diagram,
+    expected calibration error, residual plot, probability histograms —
+    all derived from the same device-side histogram pair."""
+
+    def __init__(self, num_classes: int, reliability_bins: int = 10,
+                 histogram_bins: int = 50):
+        self.num_classes = num_classes
+        self.rbins = reliability_bins
+        self.hbins = histogram_bins
+        # device histogram resolution: a multiple of both report binnings
+        # (~200 bins) so host-side rebinning is exact, never interpolated
+        lcm = int(np.lcm(reliability_bins, histogram_bins))
+        bins = lcm * max(1, round(200 / lcm))
+        self._roc = ROCBinary(num_outputs=num_classes, threshold_steps=bins)
+
+    def eval(self, labels, probs):
+        labels = jnp.asarray(labels)
+        if labels.ndim == 1:
+            labels = jax.nn.one_hot(labels, self.num_classes)
+        self._roc.eval(labels, probs)
+        return self
+
+    def merge(self, other: "EvaluationCalibration"):
+        self._roc.merge(other._roc)
+        return self
+
+    def _rebin(self, hist: np.ndarray, nbins: int) -> np.ndarray:
+        b = hist.shape[-1]
+        assert b % nbins == 0
+        return hist.reshape(*hist.shape[:-1], nbins, b // nbins).sum(-1)
+
+    def reliability_curve(self, cls: int = 0):
+        """(bin_centers, observed_frequency, count) per reliability bin."""
+        pos, neg = self._roc._counts(cls)
+        pos = self._rebin(pos, self.rbins)
+        neg = self._rebin(neg, self.rbins)
+        count = pos + neg
+        freq = np.divide(pos, count, out=np.zeros_like(pos), where=count > 0)
+        centers = (np.arange(self.rbins) + 0.5) / self.rbins
+        return centers, freq, count
+
+    def ece(self, cls: int = 0) -> float:
+        """Expected calibration error: sum_b (n_b/N) |freq_b - center_b|."""
+        centers, freq, count = self.reliability_curve(cls)
+        n = max(count.sum(), 1.0)
+        return float(np.sum(count / n * np.abs(freq - centers)))
+
+    def probability_histogram(self, cls: int = 0):
+        """(bin_edges, counts) of predicted probabilities for ``cls``
+        (↔ getProbabilityHistogramAllClasses)."""
+        pos, neg = self._roc._counts(cls)
+        counts = self._rebin(pos + neg, self.hbins)
+        edges = np.arange(self.hbins + 1) / self.hbins
+        return edges, counts
+
+    def residual_plot(self, cls: int = 0):
+        """(bin_centers, |label - prob| mass per bin) (↔ getResidualPlot)."""
+        pos, neg = self._roc._counts(cls)
+        pos = self._rebin(pos, self.hbins)
+        neg = self._rebin(neg, self.hbins)
+        centers = (np.arange(self.hbins) + 0.5) / self.hbins
+        # positives at prob p contribute |1-p|, negatives |p|
+        return centers, pos * (1.0 - centers) + neg * centers

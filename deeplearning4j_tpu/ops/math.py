@@ -76,8 +76,12 @@ def clip_by_norm(x, max_norm, axes=None):
 
 def clip_by_global_norm(tree, max_norm):
     """ref: nd4j ClipByGlobalNorm — used by GradientNormalization config."""
+    import builtins
+
+    # NB: this module rebinds ``sum`` to jnp.sum below; the builtin is needed
+    # here to fold the per-leaf scalars (jnp.sum rejects a generator).
     leaves = jax.tree_util.tree_leaves(tree)
-    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+    gnorm = jnp.sqrt(builtins.sum(jnp.sum(jnp.square(g)) for g in leaves))
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
     return jax.tree_util.tree_map(lambda g: g * scale, tree), gnorm
 

@@ -1,0 +1,205 @@
+"""ROC/AUC/calibration oracle tests (VERDICT r2 Missing #4).
+
+ref strategy: nd4j ROCTest / EvaluationCalibrationTest — curves checked
+against independently computed values. The oracle here recomputes every
+operating point by brute force on the raw scores (predict positive iff
+score >= k/B), which is exactly the thresholded-ROC definition the
+device-side histograms implement, plus closed-form sanity cases
+(perfect separation = 1.0, symmetric overlap ≈ 0.5).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation import (
+    ROC,
+    EvaluationCalibration,
+    ROCBinary,
+    ROCMultiClass,
+)
+
+B = 200  # threshold steps used throughout
+
+
+def _brute_roc(labels, scores, bins=B):
+    """Oracle: TPR/FPR at thresholds k/bins, k=0..bins, by direct counting."""
+    labels = np.asarray(labels, bool)
+    scores = np.asarray(scores, np.float64)
+    thr = np.arange(bins + 1) / bins
+    tpr = np.array([(scores[labels] >= t).sum() for t in thr]) / max(labels.sum(), 1)
+    fpr = np.array([(scores[~labels] >= t).sum() for t in thr]) / max((~labels).sum(), 1)
+    return thr, fpr, tpr
+
+
+def _scores(n, seed, sep=1.5):
+    """Two overlapping score distributions in (0, 1)."""
+    r = np.random.default_rng(seed)
+    labels = r.integers(0, 2, n)
+    raw = r.normal(loc=labels * sep, scale=1.0)
+    scores = 1.0 / (1.0 + np.exp(-raw))
+    # keep scores strictly inside bins (no threshold-boundary ties)
+    scores = np.clip(np.round(scores * B - 0.5) / B + 0.5 / B, 0.0, 1.0 - 0.5 / B)
+    return labels.astype(np.float32), scores.astype(np.float32)
+
+
+class TestROC:
+    def test_curve_matches_bruteforce(self):
+        labels, scores = _scores(500, seed=0)
+        roc = ROC(threshold_steps=B).eval(labels, scores)
+        thr, fpr, tpr = roc.roc_curve()
+        othr, ofpr, otpr = _brute_roc(labels, scores)
+        np.testing.assert_allclose(thr, othr)
+        np.testing.assert_allclose(fpr, ofpr, atol=1e-9)
+        np.testing.assert_allclose(tpr, otpr, atol=1e-9)
+
+    def test_auc_matches_bruteforce_trapezoid(self):
+        labels, scores = _scores(500, seed=1)
+        roc = ROC(threshold_steps=B).eval(labels, scores)
+        _, ofpr, otpr = _brute_roc(labels, scores)
+        oracle = -np.trapezoid(otpr, ofpr)
+        assert roc.auc() == pytest.approx(oracle, abs=1e-9)
+        # a separated mixture must score clearly above chance
+        assert 0.75 < roc.auc() < 1.0
+
+    def test_perfect_separation_auc_one(self):
+        labels = np.array([0, 0, 0, 1, 1, 1], np.float32)
+        scores = np.array([0.05, 0.1, 0.2, 0.8, 0.9, 0.95], np.float32)
+        roc = ROC(threshold_steps=B).eval(labels, scores)
+        assert roc.auc() == pytest.approx(1.0, abs=1e-6)
+        assert roc.auc_pr() == pytest.approx(1.0, abs=1e-6)
+
+    def test_random_scores_auc_half(self):
+        r = np.random.default_rng(2)
+        labels = r.integers(0, 2, 4000).astype(np.float32)
+        scores = r.uniform(0, 1, 4000).astype(np.float32)
+        roc = ROC(threshold_steps=B).eval(labels, scores)
+        assert roc.auc() == pytest.approx(0.5, abs=0.05)
+
+    def test_one_hot_two_column_input(self):
+        labels, scores = _scores(200, seed=3)
+        oh = np.stack([1 - labels, labels], axis=1)
+        probs2 = np.stack([1 - scores, scores], axis=1)
+        a = ROC(threshold_steps=B).eval(labels, scores).auc()
+        b = ROC(threshold_steps=B).eval(oh, probs2).auc()
+        assert a == pytest.approx(b, abs=1e-9)
+
+    def test_incremental_equals_single_batch(self):
+        labels, scores = _scores(300, seed=4)
+        whole = ROC(threshold_steps=B).eval(labels, scores)
+        parts = ROC(threshold_steps=B)
+        for i in range(0, 300, 100):
+            parts.eval(labels[i:i + 100], scores[i:i + 100])
+        np.testing.assert_allclose(np.asarray(whole.pos), np.asarray(parts.pos))
+        assert whole.auc() == pytest.approx(parts.auc(), abs=1e-12)
+
+    def test_merge(self):
+        labels, scores = _scores(300, seed=5)
+        whole = ROC(threshold_steps=B).eval(labels, scores)
+        a = ROC(threshold_steps=B).eval(labels[:150], scores[:150])
+        b = ROC(threshold_steps=B).eval(labels[150:], scores[150:])
+        assert a.merge(b).auc() == pytest.approx(whole.auc(), abs=1e-12)
+
+    def test_auc_pr_matches_bruteforce(self):
+        labels, scores = _scores(400, seed=6)
+        roc = ROC(threshold_steps=B).eval(labels, scores)
+        thr = np.arange(B + 1) / B
+        lab = labels.astype(bool)
+        tp = np.array([(scores[lab] >= t).sum() for t in thr], float)
+        fp = np.array([(scores[~lab] >= t).sum() for t in thr], float)
+        pred = tp + fp
+        prec = np.divide(tp, pred, out=np.ones_like(tp), where=pred > 0)
+        rec = tp / lab.sum()
+        oracle = -np.trapezoid(prec, rec)
+        assert roc.auc_pr() == pytest.approx(oracle, abs=1e-9)
+
+
+class TestROCMultiClass:
+    def test_per_class_matches_binary(self):
+        r = np.random.default_rng(7)
+        n, c = 400, 3
+        labels = r.integers(0, c, n)
+        logits = r.normal(size=(n, c)) + 2.0 * np.eye(c)[labels]
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        probs = np.clip(np.round(probs * B - 0.5) / B + 0.5 / B,
+                        0.0, 1.0 - 0.5 / B)
+
+        mc = ROCMultiClass(num_classes=c, threshold_steps=B).eval(labels, probs)
+        for k in range(c):
+            solo = ROC(threshold_steps=B).eval(
+                (labels == k).astype(np.float32), probs[:, k].astype(np.float32))
+            assert mc.auc(k) == pytest.approx(solo.auc(), abs=1e-9)
+            assert mc.auc(k) > 0.7  # informative scores
+        assert mc.average_auc() == pytest.approx(
+            np.mean([mc.auc(k) for k in range(c)]), abs=1e-12)
+
+    def test_int_and_onehot_labels_agree(self):
+        r = np.random.default_rng(8)
+        labels = r.integers(0, 3, 100)
+        probs = r.dirichlet(np.ones(3), 100)
+        a = ROCMultiClass(3, threshold_steps=B).eval(labels, probs)
+        b = ROCMultiClass(3, threshold_steps=B).eval(np.eye(3)[labels], probs)
+        for k in range(3):
+            assert a.auc(k) == pytest.approx(b.auc(k), abs=1e-12)
+
+
+class TestROCBinaryMultiLabel:
+    def test_independent_columns(self):
+        l0, s0 = _scores(300, seed=9)
+        l1, s1 = _scores(300, seed=10, sep=0.3)
+        rb = ROCBinary(num_outputs=2, threshold_steps=B).eval(
+            np.stack([l0, l1], 1), np.stack([s0, s1], 1))
+        solo0 = ROC(threshold_steps=B).eval(l0, s0)
+        solo1 = ROC(threshold_steps=B).eval(l1, s1)
+        assert rb.auc(0) == pytest.approx(solo0.auc(), abs=1e-9)
+        assert rb.auc(1) == pytest.approx(solo1.auc(), abs=1e-9)
+        assert rb.auc(0) > rb.auc(1)  # column 0 is better separated
+
+
+class TestEvaluationCalibration:
+    def test_reliability_perfectly_calibrated(self):
+        """Scores drawn so P(label=1 | score=s) = s: observed frequency per
+        bin must track the bin center."""
+        r = np.random.default_rng(11)
+        n = 200_000
+        scores = r.uniform(0, 1, n)
+        labels = (r.uniform(0, 1, n) < scores).astype(np.float32)
+        ec = EvaluationCalibration(num_classes=1, reliability_bins=10)
+        ec.eval(labels[:, None], scores[:, None].astype(np.float32))
+        centers, freq, count = ec.reliability_curve(0)
+        assert count.sum() == n
+        np.testing.assert_allclose(freq, centers, atol=0.02)
+        assert ec.ece(0) < 0.02
+
+    def test_overconfident_model_high_ece(self):
+        """A model that always says 0.99 but is right half the time."""
+        n = 2000
+        labels = (np.arange(n) % 2).astype(np.float32)
+        scores = np.full(n, 0.99, np.float32)
+        ec = EvaluationCalibration(num_classes=1, reliability_bins=10)
+        ec.eval(labels[:, None], scores[:, None])
+        assert ec.ece(0) == pytest.approx(abs(0.5 - 0.95), abs=0.05)
+
+    def test_probability_histogram_mass(self):
+        r = np.random.default_rng(12)
+        scores = r.uniform(0, 1, 5000).astype(np.float32)
+        labels = r.integers(0, 2, 5000).astype(np.float32)
+        ec = EvaluationCalibration(num_classes=1, histogram_bins=50)
+        ec.eval(labels[:, None], scores[:, None])
+        edges, counts = ec.probability_histogram(0)
+        assert counts.sum() == 5000
+        oracle, _ = np.histogram(scores, bins=edges)
+        # uniform scores: every bin within sampling noise of n/bins
+        np.testing.assert_allclose(counts, oracle, atol=1.0)
+
+    def test_residual_plot_oracle(self):
+        labels = np.array([1, 0, 1, 0], np.float32)
+        scores = np.array([0.81, 0.81, 0.21, 0.21], np.float32)
+        ec = EvaluationCalibration(num_classes=1, histogram_bins=50)
+        ec.eval(labels[:, None], scores[:, None])
+        centers, resid = ec.residual_plot(0)
+        # bin of 0.81 (center 0.81): one pos |1-c| + one neg |c|
+        b81 = int(0.81 * 50)
+        b21 = int(0.21 * 50)
+        assert resid[b81] == pytest.approx((1 - centers[b81]) + centers[b81])
+        assert resid[b21] == pytest.approx((1 - centers[b21]) + centers[b21])
+        assert resid.sum() == pytest.approx(2.0)
