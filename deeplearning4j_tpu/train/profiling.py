@@ -1,0 +1,160 @@
+"""Profiling & tracing (↔ org.nd4j.linalg.profiler.{OpProfiler,
+ProfilerConfig} + deeplearning4j ProfilingListener; SURVEY §5.1).
+
+TPU-era design: the reference intercepts per-op JNI dispatches and
+aggregates host-side timings. Under XLA there are no per-op dispatches to
+intercept — the step is one fused program — so profiling is (a) the XLA
+profiler (``jax.profiler``) capturing a device trace viewable in
+TensorBoard/Perfetto, wrapped per-step with ``StepTraceAnnotation`` so
+steps show as rows, and (b) host-side step wall-time statistics with
+forced-materialization sync (the axon tunnel's ``block_until_ready``
+returns at dispatch — see bench.py) for the per-step breakdown.
+
+``analyze_trace``/``compare_traces`` are the ProfileAnalyzer analogue:
+they parse the captured ``.trace.json.gz`` (Chrome trace format) and
+aggregate device-op durations, so a regression between two runs is
+attributable to named XLA ops.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+class ProfilingListener(TrainingListener):
+    """Capture an XLA device trace for steps [start_step, end_step).
+
+    Usage::
+
+        lst = ProfilingListener("/tmp/tb_profile", start_step=5, end_step=8)
+        trainer.fit(ts, data, listeners=[lst])
+        report = lst.report()          # host-side step-time stats
+        ops = analyze_trace(lst.log_dir)  # device-op breakdown
+
+    The trace lands under ``<log_dir>/plugins/profile/...`` (TensorBoard's
+    profile plugin layout) plus a Perfetto-compatible trace.json.gz.
+    """
+
+    def __init__(self, log_dir: str, *, start_step: int = 2,
+                 end_step: Optional[int] = None, sync_every_step: bool = True):
+        self.log_dir = log_dir
+        self.start_step = start_step
+        self.end_step = end_step if end_step is not None else start_step + 3
+        self.sync_every_step = sync_every_step
+        self.step_ms: List[float] = []
+        self._active = False
+        self._t_prev: Optional[float] = None
+        self._annotation = None
+
+    # -- trace control -----------------------------------------------------
+
+    def _start(self):
+        import jax
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+
+    def _stop(self):
+        import jax
+
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    # -- listener protocol -------------------------------------------------
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        import jax
+
+        if self.sync_every_step:
+            # Forced host materialization: the only sync the axon tunnel
+            # honors. Serializes the dispatch pipeline while profiling —
+            # that is the point (per-step attribution).
+            float(jax.device_get(metrics["total_loss"]))
+        now = time.perf_counter()
+        if self._t_prev is not None and self._active:
+            self.step_ms.append((now - self._t_prev) * 1000)
+        if step == self.start_step and not self._active:
+            self._start()
+        elif self._active and step >= self.end_step:
+            self._stop()
+        self._t_prev = now
+        return False
+
+    def on_fit_end(self, trainer, ts):
+        self._stop()
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self) -> Dict[str, float]:
+        if not self.step_ms:
+            return {"steps": 0}
+        s = sorted(self.step_ms)
+        n = len(s)
+        return {
+            "steps": n,
+            "mean_ms": sum(s) / n,
+            "p50_ms": s[n // 2],
+            "min_ms": s[0],
+            "max_ms": s[-1],
+        }
+
+
+def _find_trace_file(log_dir: str) -> str:
+    pats = [os.path.join(log_dir, "**", "*.trace.json.gz"),
+            os.path.join(log_dir, "**", "*.trace.json")]
+    for pat in pats:
+        hits = sorted(glob.glob(pat, recursive=True), key=os.path.getmtime)
+        if hits:
+            return hits[-1]
+    raise FileNotFoundError(f"no trace file under {log_dir}")
+
+
+def analyze_trace(log_dir: str, top: int = 20) -> List[Dict]:
+    """Aggregate device-op durations from the newest captured trace
+    (↔ ProfileAnalyzer summarize): [{name, total_us, count, pct}] sorted
+    by total duration descending."""
+    path = _find_trace_file(log_dir)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        trace = json.load(fh)
+    events = trace.get("traceEvents", [])
+    # device lanes: XLA op events are complete events ("ph": "X") on TPU/GPU
+    # (or CPU thread) tracks; aggregate by event name.
+    agg = defaultdict(lambda: [0.0, 0])
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        name = ev.get("name", "?")
+        agg[name][0] += float(ev["dur"])
+        agg[name][1] += 1
+    total = sum(v[0] for v in agg.values()) or 1.0
+    rows = [{"name": k, "total_us": round(v[0], 1), "count": v[1],
+             "pct": round(100 * v[0] / total, 2)}
+            for k, v in agg.items()]
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows[:top]
+
+
+def compare_traces(log_dir_a: str, log_dir_b: str, top: int = 15) -> List[Dict]:
+    """↔ ProfileAnalyzer.compareProfiles: per-op total-duration delta between
+    two captured runs, sorted by |delta|."""
+    a = {r["name"]: r for r in analyze_trace(log_dir_a, top=10_000)}
+    b = {r["name"]: r for r in analyze_trace(log_dir_b, top=10_000)}
+    rows = []
+    for name in set(a) | set(b):
+        ta = a.get(name, {}).get("total_us", 0.0)
+        tb = b.get(name, {}).get("total_us", 0.0)
+        rows.append({"name": name, "a_us": ta, "b_us": tb,
+                     "delta_us": round(tb - ta, 1)})
+    rows.sort(key=lambda r: -abs(r["delta_us"]))
+    return rows[:top]

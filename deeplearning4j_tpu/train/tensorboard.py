@@ -1,0 +1,225 @@
+"""TensorBoard event-file writer + training listener (↔ deeplearning4j-ui
+StatsListener → StatsStorage; SURVEY §2.7 Training UI).
+
+TPU-era design: the reference ships a bespoke web UI fed by a StatsListener
+writing to StatsStorage. Here the storage format IS the dashboard protocol:
+standard TensorBoard event files (TFRecord-framed TF ``Event`` protobufs),
+viewable by any TensorBoard instance and greppable by the TF ecosystem.
+The writer is dependency-free — protobuf wire encoding reuses the varint
+primitives from modelimport/onnx_proto.py and the TFRecord CRC32C framing
+is implemented here; tests read the files back with real TensorFlow as an
+independent oracle (the format cannot be self-consistently wrong).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.onnx_proto import (
+    _write_len_delim,
+    _write_tag,
+    _write_varint,
+)
+
+# --- CRC32C (Castagnoli), required by TFRecord framing ---------------------
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+            table.append(crc)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# --- Event / Summary / HistogramProto encoding -----------------------------
+
+
+def _encode_histogram(values: np.ndarray, bins: int = 30) -> bytes:
+    """tensorflow.HistogramProto: min(1) max(2) num(3) sum(4) sum_squares(5)
+    bucket_limit(6, packed double) bucket(7, packed double)."""
+    v = np.asarray(values, np.float64).ravel()
+    counts, edges = np.histogram(v, bins=bins)
+    buf = bytearray()
+    for num, val in ((1, v.min()), (2, v.max()), (3, float(v.size)),
+                     (4, v.sum()), (5, np.square(v).sum())):
+        _write_tag(buf, num, 1)
+        buf += struct.pack("<d", float(val))
+    limits = bytearray()
+    for e in edges[1:]:
+        limits += struct.pack("<d", float(e))
+    _write_len_delim(buf, 6, bytes(limits))
+    buckets = bytearray()
+    for c in counts:
+        buckets += struct.pack("<d", float(c))
+    _write_len_delim(buf, 7, bytes(buckets))
+    return bytes(buf)
+
+
+def _encode_summary_value(tag: str, *, simple_value: Optional[float] = None,
+                          histo: Optional[bytes] = None) -> bytes:
+    val = bytearray()
+    _write_len_delim(val, 1, tag.encode())
+    if simple_value is not None:
+        _write_tag(val, 2, 5)  # float, wire type 5
+        val += struct.pack("<f", float(simple_value))
+    if histo is not None:
+        _write_len_delim(val, 5, histo)
+    return bytes(val)
+
+
+def _encode_event(wall_time: float, step: Optional[int] = None, *,
+                  file_version: Optional[str] = None,
+                  summary_values: Optional[List[bytes]] = None) -> bytes:
+    ev = bytearray()
+    _write_tag(ev, 1, 1)  # wall_time double
+    ev += struct.pack("<d", wall_time)
+    if step is not None:
+        _write_tag(ev, 2, 0)
+        _write_varint(ev, step)
+    if file_version is not None:
+        _write_len_delim(ev, 3, file_version.encode())
+    if summary_values:
+        summary = bytearray()
+        for v in summary_values:
+            _write_len_delim(summary, 1, v)
+        _write_len_delim(ev, 5, bytes(summary))
+    return bytes(ev)
+
+
+class TensorBoardWriter:
+    """Minimal SummaryWriter: scalars + histograms to a TB event file."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}.{os.getpid()}")
+        self.path = os.path.join(log_dir, fname)
+        self._fh = open(self.path, "wb")
+        self._record(_encode_event(time.time(), file_version="brain.Event:2"))
+
+    def _record(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._fh.write(header)
+        self._fh.write(struct.pack("<I", _masked_crc(header)))
+        self._fh.write(payload)
+        self._fh.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag: str, value: float, step: int,
+                   wall_time: Optional[float] = None) -> None:
+        self._record(_encode_event(
+            wall_time or time.time(), step,
+            summary_values=[_encode_summary_value(tag, simple_value=value)]))
+
+    def add_scalars(self, scalars: dict, step: int,
+                    wall_time: Optional[float] = None) -> None:
+        """All tags in ONE event (one record per step, not per metric)."""
+        vals = [_encode_summary_value(t, simple_value=v)
+                for t, v in scalars.items()]
+        self._record(_encode_event(wall_time or time.time(), step,
+                                   summary_values=vals))
+
+    def add_histogram(self, tag: str, values, step: int,
+                      wall_time: Optional[float] = None) -> None:
+        self._record(_encode_event(
+            wall_time or time.time(), step,
+            summary_values=[_encode_summary_value(
+                tag, histo=_encode_histogram(values))]))
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class TensorBoardListener:
+    """↔ StatsListener: scalars (losses, throughput) every N iterations and
+    parameter/gradient-free histograms every H epochs, into TB event files.
+
+    Device arrays are pulled once per logging interval only — the dispatch
+    pipeline stays async between intervals.
+    """
+
+    def __init__(self, log_dir: str, *, every: int = 10,
+                 histogram_every_epochs: Optional[int] = None):
+        self.log_dir = log_dir
+        self.every = every
+        self.histogram_every_epochs = histogram_every_epochs
+        self.writer: Optional[TensorBoardWriter] = None
+        self._t_last = None
+        self._step_last = None
+
+    def on_fit_start(self, trainer, ts):
+        self.writer = TensorBoardWriter(self.log_dir)
+        self._t_last = time.perf_counter()
+
+    def on_epoch_start(self, epoch):
+        pass
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        if step % self.every == 0 and self.writer:
+            import jax
+
+            scalars = {}
+            for k, v in metrics.items():
+                try:
+                    scalars[f"train/{k}"] = float(jax.device_get(v))
+                except (TypeError, ValueError):
+                    continue
+            now = time.perf_counter()
+            if self._step_last is not None and now > self._t_last:
+                scalars["train/iterations_per_sec"] = (
+                    (step - self._step_last) / (now - self._t_last))
+            self._t_last, self._step_last = now, step
+            self.writer.add_scalars(scalars, step)
+        return False
+
+    def on_epoch_end(self, epoch, ts):
+        h = self.histogram_every_epochs
+        if h and (epoch + 1) % h == 0 and self.writer:
+            import jax
+
+            flat = jax.tree_util.tree_leaves_with_path(ts.params)
+            step = int(jax.device_get(ts.step))
+            for path, leaf in flat:
+                name = "params/" + "/".join(
+                    getattr(p, "key", getattr(p, "name", str(p))) for p in path)
+                self.writer.add_histogram(name, np.asarray(jax.device_get(leaf)),
+                                          step)
+            self.writer.flush()
+        return False
+
+    def on_fit_end(self, trainer, ts):
+        if self.writer:
+            self.writer.close()
+            self.writer = None
