@@ -77,6 +77,14 @@ class Trainer:
     path). Gradients for frozen layers are zeroed BEFORE the updater (so
     Adam-style moments stay zero) and their updates are zeroed AFTER it
     (so decoupled weight decay à la AdamW cannot move them either).
+
+    ``check_nan``: NaN/inf guard mode (↔ OpExecutionerUtil.checkForNAN /
+    ND4JEnvironmentVars checkForNAN; SURVEY §5.2). When on, the compiled
+    step is instrumented with ``checkify`` float checks: the FIRST op that
+    produces a non-finite value raises host-side with the op name and
+    traceback, instead of the NaN silently poisoning training. Defaults to
+    the process-wide ``DL4J_TPU_CHECK_NUMERICS`` flag. Debug tool — the
+    instrumentation costs compile time and some step time.
     """
 
     def __init__(
@@ -88,6 +96,7 @@ class Trainer:
         batch_sharding=None,
         extra_metrics: Optional[Callable] = None,
         frozen_layers: Optional[Sequence[str]] = None,
+        check_nan: Optional[bool] = None,
     ):
         self.model = model
         self.net: NeuralNetConfiguration = model.net
@@ -154,7 +163,36 @@ class Trainer:
         if mesh is not None and state_sharding is not None:
             jit_kwargs["in_shardings"] = (state_sharding, batch_sharding)
             jit_kwargs["out_shardings"] = (state_sharding, None)
-        self.train_step = jax.jit(train_step, **jit_kwargs)
+
+        if check_nan is None:
+            from deeplearning4j_tpu.runtime.environment import get_environment
+
+            check_nan = get_environment().check_numerics
+        self.check_nan = bool(check_nan)
+        if self.check_nan:
+            from jax.experimental import checkify
+
+            # checkify preserves the wrapped fn's signature (returns
+            # (err, out)), so donation and the mesh in/out shardings apply
+            # unchanged to arg 0 / the state output; the error pytree rides
+            # along as an extra replicated output.
+            checked_kwargs = dict(jit_kwargs)
+            if "out_shardings" in checked_kwargs:
+                checked_kwargs["out_shardings"] = (
+                    None, checked_kwargs["out_shardings"])
+            checked = jax.jit(
+                checkify.checkify(train_step, errors=checkify.float_checks),
+                **checked_kwargs,
+            )
+
+            def train_step_checked(ts, batch):
+                err, out = checked(ts, batch)
+                checkify.check_error(err)  # raises with the offending op name
+                return out
+
+            self.train_step = train_step_checked
+        else:
+            self.train_step = jax.jit(train_step, **jit_kwargs)
 
     def _mask_frozen(self, tree):
         if not self.frozen_layers:

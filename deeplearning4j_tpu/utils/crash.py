@@ -1,0 +1,158 @@
+"""Crash reporting (↔ org.deeplearning4j.util.CrashReportingUtil; SURVEY
+§2.5). On an OOM or training-loop crash the reference writes a diagnostic
+dump (memory state, JVM info, network config, iteration count) next to the
+model. The TPU-native analogue dumps: device + HBM stats from PJRT
+(``device.memory_stats()``), the jax/backend identity, the model/net config
+JSON when serializable, the training step, recent losses, and the full
+traceback — everything needed to attribute an OOM to a config without a
+live session."""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import traceback
+from typing import Any, Dict, List, Optional
+
+_LAST_REPORT: Optional[str] = None
+
+
+def last_crash_report() -> Optional[str]:
+    """Path of the most recent crash dump written by this process."""
+    return _LAST_REPORT
+
+
+def _device_info() -> List[Dict[str, Any]]:
+    import jax
+
+    infos = []
+    try:
+        for d in jax.devices():
+            info: Dict[str, Any] = {
+                "id": d.id,
+                "platform": d.platform,
+                "device_kind": d.device_kind,
+            }
+            try:
+                stats = d.memory_stats()
+            except Exception:  # pragma: no cover - backend-dependent
+                stats = None
+            if stats:
+                info["memory_stats"] = {
+                    k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float))
+                }
+            infos.append(info)
+    except Exception as e:  # pragma: no cover - backend init failure
+        infos.append({"error": f"device enumeration failed: {e}"})
+    return infos
+
+
+def write_crash_report(
+    directory: str = ".",
+    *,
+    exception: Optional[BaseException] = None,
+    model=None,
+    step: Optional[int] = None,
+    recent_losses: Optional[List[float]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write ``dl4j-tpu-crash-<ts>.json`` and return its path
+    (↔ CrashReportingUtil.writeMemoryCrashDump)."""
+    global _LAST_REPORT
+    import jax
+
+    report: Dict[str, Any] = {
+        "timestamp": datetime.datetime.now().isoformat(),
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend() if _safe_backend() else "unknown",
+        "devices": _device_info(),
+        "pid": os.getpid(),
+    }
+    if step is not None:
+        report["step"] = int(step)
+    if recent_losses:
+        report["recent_losses"] = [float(x) for x in recent_losses[-50:]]
+    if exception is not None:
+        report["exception"] = {
+            "type": type(exception).__name__,
+            "message": str(exception)[:2000],
+            "traceback": traceback.format_exception(
+                type(exception), exception, exception.__traceback__),
+        }
+    if model is not None:
+        try:
+            from deeplearning4j_tpu.nn.config import config_to_json
+
+            report["model_config"] = json.loads(config_to_json(model.config))
+        except Exception:
+            report["model_config"] = repr(getattr(model, "config", model))[:4000]
+    if extra:
+        report["extra"] = extra
+
+    os.makedirs(directory, exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+    path = os.path.join(directory, f"dl4j-tpu-crash-{stamp}-{os.getpid()}.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=2, default=str)
+    _LAST_REPORT = path
+    return path
+
+
+def _safe_backend() -> bool:
+    try:
+        import jax
+
+        jax.default_backend()
+        return True
+    except Exception:  # pragma: no cover
+        return False
+
+
+class CrashReportingListener:
+    """Listener variant: track step/losses and dump on fit-loop crash.
+
+    Trainer.fit does not catch exceptions (fail fast); wrap the fit call::
+
+        lst = CrashReportingListener("/tmp/crash")
+        try:
+            trainer.fit(ts, data, listeners=[lst])
+        except Exception as e:
+            lst.dump(e, model=model)
+            raise
+    """
+
+    def __init__(self, directory: str = "."):
+        self.directory = directory
+        self._step = 0
+        self._losses: List[float] = []
+
+    # TrainingListener protocol (duck-typed)
+    def on_fit_start(self, trainer, ts):
+        self._model = getattr(trainer, "model", None)
+
+    def on_epoch_start(self, epoch):
+        pass
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        import jax
+
+        self._step = step
+        try:
+            self._losses.append(float(jax.device_get(metrics["total_loss"])))
+        except Exception:
+            pass
+        return False
+
+    def on_epoch_end(self, epoch, ts):
+        return False
+
+    def on_fit_end(self, trainer, ts):
+        pass
+
+    def dump(self, exception: BaseException, model=None) -> str:
+        return write_crash_report(
+            self.directory, exception=exception,
+            model=model or getattr(self, "_model", None),
+            step=self._step, recent_losses=self._losses)
