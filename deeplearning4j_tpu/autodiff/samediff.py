@@ -599,6 +599,14 @@ class SameDiff:
         for k, v in feeds.items():
             if k not in self._vars:
                 raise KeyError(f"unknown placeholder {k!r}")
+            vt = self._vars[k].var_type
+            if vt != VariableType.PLACEHOLDER:
+                # Feeding a VARIABLE/CONSTANT would silently shadow its
+                # stored value (r1 advisor); state changes go through
+                # set_value / convert_to_variable instead.
+                raise ValueError(
+                    f"cannot feed {vt.name} {k!r}: only placeholders accept "
+                    "feeds (use set_value to change stored values)")
             placeholders[k] = jnp.asarray(v)
         variables = {n: self._values[n] for n, v in self._vars.items()
                      if v.var_type == VariableType.VARIABLE}

@@ -165,13 +165,36 @@ def _batchnorm(cfg):
     axis = cfg.get("axis", -1)
     if isinstance(axis, list):
         axis = axis[0]
-    if axis not in (-1, 3, 2, 1):
-        raise KerasImportError(f"BatchNormalization axis {axis} unsupported")
-    return BatchNorm(momentum=cfg.get("momentum", 0.99), eps=cfg.get("epsilon", 1e-3)), {
+    # Our BatchNorm normalizes the LAST axis. Keras' axis counts the batch
+    # dim, so a positive axis is channels-last iff it equals rank-1 — which
+    # only the built model's shape inference knows. Stash the raw axis on
+    # the layer; the import paths validate it post-build (r1 advisor: no
+    # silent wrong-axis normalization; review r3: don't reject axis=2 on
+    # rank-3 inputs where it IS the last axis).
+    layer = BatchNorm(momentum=cfg.get("momentum", 0.99),
+                      eps=cfg.get("epsilon", 1e-3))
+    layer._keras_axis = axis
+    return layer, {
         "gamma": ("gamma", None), "beta": ("beta", None),
         "state:mean": ("moving_mean", None),
         "state:var": ("moving_variance", None),
     }
+
+
+def _check_bn_axis(layer, shape_nobatch, where: str) -> None:
+    """Refuse channels-first BatchNormalization once the input rank is known.
+
+    ``shape_nobatch`` excludes the batch dim, so the channels-last Keras
+    axis index for this input is exactly ``len(shape_nobatch)``."""
+    axis = getattr(layer, "_keras_axis", None)
+    if axis is None or axis == -1:
+        return
+    last = len(shape_nobatch)
+    if axis != last:
+        raise KerasImportError(
+            f"BatchNormalization {where!r}: axis {axis} on rank-{last + 1} "
+            f"input is channels-first; only channels-last (axis=-1 or "
+            f"{last}) imports are supported")
 
 
 def _layernorm(cfg):
@@ -439,6 +462,8 @@ def _import_sequential(f, config: dict, updater):
     net = NeuralNetConfiguration(updater=updater)
     model = SequentialModel(SequentialConfig(
         net=net, layers=layers, input_shape=input_shape))
+    for i, layer in enumerate(model.layers):
+        _check_bn_axis(layer, model.shapes[i], model.layer_names[i])
 
     params, state = {}, {}
     for model_name, (kname, kcls, wmap) in zip(model.layer_names, per_layer):
@@ -513,6 +538,11 @@ def _import_functional(f, config: dict, updater):
     model = GraphModel(GraphConfig(
         net=net, inputs=inputs, input_shapes=input_shapes,
         vertices=vertices, outputs=out_names))
+    for name, v in vertices.items():
+        if v.kind == "layer" and v.layer is not None:
+            # BatchNorm preserves shape: the vertex's output shape IS its
+            # input shape, which is what the axis check needs.
+            _check_bn_axis(v.layer, model.shapes[name], name)
 
     params, state = {}, {}
     for name, (kcls, wmap) in weight_info.items():

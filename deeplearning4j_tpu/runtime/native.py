@@ -55,13 +55,22 @@ _BF16 = 13  # surfaced as uint16 host-side (numpy has no bf16)
 
 
 def ensure_built(force: bool = False) -> pathlib.Path:
-    """Build native/lib/libdl4j_tpu_runtime.so if missing (↔ running
-    buildnativeoperations.sh before the JVM can load nd4j-native)."""
-    if _LIB_PATH.exists() and not force:
-        return _LIB_PATH
+    """Build native/lib/libdl4j_tpu_runtime.so (↔ running
+    buildnativeoperations.sh before the JVM can load nd4j-native).
+
+    Always consults ``make`` — make's own mtime comparison decides whether a
+    rebuild is needed, so an edited pjrt_runtime.cpp can never be shadowed
+    by a stale binary (r1 advisor finding)."""
+    if force:
+        subprocess.run(["make", "clean"], cwd=_NATIVE_DIR,
+                       capture_output=True, text=True)
     proc = subprocess.run(["make"], cwd=_NATIVE_DIR,
                           capture_output=True, text=True)
     if proc.returncode != 0:
+        if _LIB_PATH.exists():
+            raise NativeRuntimeError(
+                "native rebuild failed and a stale binary exists — refusing "
+                f"to load it (exit {proc.returncode}):\n{proc.stderr}")
         raise NativeRuntimeError(
             f"native build failed (exit {proc.returncode}):\n{proc.stderr}")
     return _LIB_PATH
@@ -69,9 +78,28 @@ def ensure_built(force: bool = False) -> pathlib.Path:
 
 def default_compile_options() -> bytes:
     """Serialized CompileOptionsProto with 1 replica / 1 partition."""
+    return make_compile_options()
+
+
+def make_compile_options(num_replicas: int = 1, num_partitions: int = 1,
+                         portable: bool = False) -> bytes:
+    """Serialized CompileOptionsProto (↔ the reference's per-backend build
+    flags). ``num_replicas``/``num_partitions`` request an SPMD executable
+    spanning that many devices; ``portable`` compiles device-unassigned so
+    ``execute(device=k)`` can target any addressable device at run time
+    (PJRT portable-executable path)."""
     from jaxlib import xla_client
 
-    return xla_client.CompileOptions().SerializeAsString()
+    opts = xla_client.CompileOptions()
+    opts.num_replicas = num_replicas
+    opts.num_partitions = num_partitions
+    if num_partitions > 1:
+        opts.executable_build_options.use_spmd_partitioning = True
+    if portable:
+        opts.compile_portable_executable = True
+    opts.executable_build_options.num_replicas = num_replicas
+    opts.executable_build_options.num_partitions = num_partitions
+    return opts.SerializeAsString()
 
 
 def default_create_options(plugin_path: str) -> dict:
@@ -150,7 +178,7 @@ class _Lib:
                 ctypes.c_size_t]
             lib.dl4j_pjrt_execute.argtypes = [
                 c, c, ctypes.POINTER(c), ctypes.c_int, ctypes.POINTER(c),
-                ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t]
+                ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t]
             cls._instance = lib
         return cls._instance
 
@@ -165,9 +193,10 @@ def _err_buf():
 class NativeExecutable:
     """A loaded PJRT executable (↔ libnd4j registered graph handle)."""
 
-    def __init__(self, runtime: "NativeRuntime", handle):
+    def __init__(self, runtime: "NativeRuntime", handle, portable: bool = False):
         self._rt = runtime
         self._handle = handle
+        self.portable = portable
         err = _err_buf()
         n = self._rt._lib.dl4j_pjrt_exe_num_outputs(
             runtime._ctx, handle, err, _ERRLEN)
@@ -176,13 +205,16 @@ class NativeExecutable:
         self.num_outputs = n
 
     def execute(self, args: Sequence[np.ndarray], device: int = 0) -> List[np.ndarray]:
-        if device != 0:
-            # The executable is compiled with default (device-0) placement;
-            # PJRT requires args on the execution device and this binding
-            # does not yet set execute_device / per-device compile options.
+        """Run on ``device`` (addressable-device index). Non-default devices
+        need a portable executable (``compile(..., portable=True)``) — a
+        device-assigned executable is pinned by its compile options."""
+        if device != 0 and not self.portable:
             raise NativeRuntimeError(
-                "execute on device != 0 is not supported yet; compile with "
-                "device-specific options or use device 0")
+                f"executable is device-assigned; compile(portable=True) to "
+                f"execute on device {device}")
+        if device < 0 or device >= self._rt.device_count():
+            raise NativeRuntimeError(
+                f"device {device} out of range 0..{self._rt.device_count()-1}")
         rt, lib = self._rt, self._rt._lib
         err = _err_buf()
         arg_handles = []
@@ -203,9 +235,10 @@ class NativeExecutable:
 
             in_arr = (ctypes.c_void_p * len(arg_handles))(*arg_handles)
             out_arr = (ctypes.c_void_p * self.num_outputs)()
+            exec_device = device if self.portable else -1
             rc = lib.dl4j_pjrt_execute(
                 rt._ctx, self._handle, in_arr, len(arg_handles), out_arr,
-                self.num_outputs, err, _ERRLEN)
+                self.num_outputs, exec_device, err, _ERRLEN)
             if rc != 0:
                 raise NativeRuntimeError(f"execute: {err.value.decode()}")
 
@@ -298,19 +331,25 @@ class NativeRuntime:
     # -- compile/execute ---------------------------------------------------
 
     def compile(self, code, fmt: str = "mlir",
-                compile_options: Optional[bytes] = None) -> NativeExecutable:
-        """Compile StableHLO MLIR (text or bytecode) or serialized HLO."""
+                compile_options: Optional[bytes] = None, *,
+                num_replicas: int = 1, num_partitions: int = 1,
+                portable: bool = False) -> NativeExecutable:
+        """Compile StableHLO MLIR (text or bytecode) or serialized HLO.
+
+        ``num_replicas``/``num_partitions`` build an SPMD executable over
+        that many devices; ``portable=True`` leaves the device unassigned so
+        ``execute(device=k)`` can target any addressable device."""
         if isinstance(code, str):
             code = code.encode()
         opts = compile_options if compile_options is not None \
-            else default_compile_options()
+            else make_compile_options(num_replicas, num_partitions, portable)
         err = _err_buf()
         h = self._lib.dl4j_pjrt_compile(
             self._ctx, code, len(code), fmt.encode(), opts, len(opts),
             err, _ERRLEN)
         if not h:
             raise NativeRuntimeError(f"compile: {err.value.decode()}")
-        return NativeExecutable(self, h)
+        return NativeExecutable(self, h, portable=portable)
 
     def _buffer_to_numpy(self, buf) -> np.ndarray:
         lib = self._lib

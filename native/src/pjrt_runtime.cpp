@@ -153,6 +153,13 @@ DL4J_EXPORT void* dl4j_pjrt_load(const char* plugin_path, const char** keys,
   dargs.client = ctx->client;
   if (consume_error(api, api->PJRT_Client_AddressableDevices(&dargs), err,
                     errlen)) {
+    // destroy the client before dropping the ctx — the claim a live client
+    // holds (e.g. the axon tunnel grant) must not outlive this failure
+    PJRT_Client_Destroy_Args cdargs;
+    std::memset(&cdargs, 0, sizeof(cdargs));
+    cdargs.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    cdargs.client = ctx->client;
+    consume_error(api, api->PJRT_Client_Destroy(&cdargs), nullptr, 0);
     delete ctx;
     return nullptr;
   }
@@ -402,10 +409,19 @@ DL4J_EXPORT int dl4j_pjrt_buffer_to_host(void* handle, void* buf, void* dst,
 
 // Single-device synchronous execute: device buffers in, device buffers out.
 // out_buffers must have capacity for num_outputs entries.
+// device_index >= 0 selects the execution device for PORTABLE executables
+// (compiled with compile_portable_executable; PJRT requires execute_device
+// for those); pass -1 for executables with a built-in device assignment.
 DL4J_EXPORT int dl4j_pjrt_execute(void* handle, void* exe, void** arg_buffers,
                                   int num_args, void** out_buffers,
-                                  int num_outputs, char* err, size_t errlen) {
+                                  int num_outputs, int device_index, char* err,
+                                  size_t errlen) {
   Ctx* ctx = static_cast<Ctx*>(handle);
+  if (device_index >= static_cast<int>(ctx->devices.size())) {
+    const char* msg = "bad execute device index";
+    copy_msg(msg, std::strlen(msg), err, errlen);
+    return -1;
+  }
 
   PJRT_ExecuteOptions options;
   std::memset(&options, 0, sizeof(options));
@@ -431,6 +447,7 @@ DL4J_EXPORT int dl4j_pjrt_execute(void* handle, void* exe, void** arg_buffers,
   eargs.num_args = static_cast<size_t>(num_args);
   eargs.output_lists = &out_list;
   eargs.device_complete_events = &device_complete;
+  if (device_index >= 0) eargs.execute_device = ctx->devices[device_index];
   if (consume_error(ctx->api, ctx->api->PJRT_LoadedExecutable_Execute(&eargs),
                     err, errlen))
     return -1;

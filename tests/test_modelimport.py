@@ -314,3 +314,32 @@ class TestTFGraphImport:
         out = SameDiff.run_stablehlo(blob, {in_map[in_names[0]]: np.asarray(x)})
         np.testing.assert_allclose(out[out_map[out_names[0]]],
                                    f(x).numpy(), rtol=RTOL, atol=ATOL)
+
+
+class TestKerasBatchNormAxis:
+    """Channels-first refusal must be rank-aware (r3 review): a positive
+    axis is fine iff it is the LAST axis of that layer's input."""
+
+    def test_axis_validation_rank_aware(self):
+        from deeplearning4j_tpu.modelimport.keras import (
+            KerasImportError,
+            _batchnorm,
+            _check_bn_axis,
+        )
+
+        layer3, _ = _batchnorm({"axis": 2})
+        _check_bn_axis(layer3, (16, 8), "bn3")  # rank-3 (N,T,C): axis 2 OK
+
+        layer4, _ = _batchnorm({"axis": 3})
+        _check_bn_axis(layer4, (8, 8, 4), "bn4")  # rank-4 NHWC: axis 3 OK
+
+        layerm1, _ = _batchnorm({"axis": -1})
+        _check_bn_axis(layerm1, (8, 8, 4), "bnm1")  # -1 always OK
+
+        bad, _ = _batchnorm({"axis": 1})
+        with pytest.raises(KerasImportError, match="channels-first"):
+            _check_bn_axis(bad, (4, 8, 8), "bad")  # rank-4 NCHW: refuse
+
+        bad2, _ = _batchnorm({"axis": 2})
+        with pytest.raises(KerasImportError, match="channels-first"):
+            _check_bn_axis(bad2, (8, 8, 4), "bad2")  # axis 2 on rank 4: refuse
