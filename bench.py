@@ -2,26 +2,77 @@
 """Benchmark driver — prints ONE JSON line with the headline metric.
 
 Headline: BERT-base MLM pretraining throughput (tokens/sec/chip) on the
-attached TPU chip — north-star workload #4. The reference publishes no
-numbers (BASELINE.md: measured, not copied), so vs_baseline is the ratio
-against the recorded round-2 measurement in BASELINE.md once it lands.
+attached TPU chip — north-star workload #4 — plus co-primary ResNet-50,
+GravesLSTM char-RNN (Pallas scan path) and LeNet configs in the same line
+(``configs`` field). BASELINE.md policy: the reference publishes no numbers,
+so the baseline is measured-not-copied and later runs must not regress it.
 
-The axon TPU backend rides a shared tunnel that wedges transiently when
-another PJRT client holds the claim; round 1 recorded 0.0 because a single
-init failure aborted the run. Backend init therefore retries with backoff
-for several minutes, and the emitted line carries diagnostics (platform,
-device count, compile seconds) so a failure is attributable.
+Measurement integrity (round-3 hardening):
+
+* **The axon tunnel's ``block_until_ready`` does NOT synchronize.** Measured
+  this round: a chained 4096^3 bf16 matmul loop "timed" with
+  ``block_until_ready`` reports 6264 TFLOP/s — 30x over the v5e's 197 TFLOP/s
+  bf16 peak, i.e. the call returns at dispatch, not completion. That is what
+  inflated round 2's 1.38M tokens/sec (0.9 PFLOP/s "sustained" on a chip that
+  peaks at 0.197). Every timing window here therefore ends with a forced host
+  materialization (``jax.device_get``) of values data-dependent on the last
+  step, which cannot complete before the device work has.
+* **MFU attribution.** Each config computes model FLOPs/step analytically
+  (formulas inline below) and emits MFU against the chip's published bf16
+  peak, looked up from ``device_kind``. An MFU > 1.0 is physically impossible
+  and fails the run rather than recording a fantasy number.
+* **Correctness gating.** Every timed window retains the per-step losses and
+  asserts all are finite and that loss decreased over the window (each config
+  re-fits one fixed batch, so decrease is guaranteed for a working step);
+  a step that NaNs can no longer record a time.
+
+The tunnel also serializes dispatches at ~69 ms round-trip latency but
+pipelines async dispatches at ~1.4 ms/call, so steps are dispatched
+asynchronously and synced once, inside the timing window.
 """
 
+import argparse
 import json
 import subprocess
 import sys
 import time
 
-# Recorded first real measurement (round 2). vs_baseline = value / this.
-BASELINE_TOKENS_PER_SEC = None  # set after BENCH_r02 lands
+# Per-chip baselines (tokens|samples)/sec/chip. Round 2's recorded 1,382,357
+# tok/s BERT figure was a sync artifact (block_until_ready returns at
+# dispatch — see module docstring; the implied 0.9 PFLOP/s exceeds the v5e's
+# 197 TFLOP/s peak by 4.5x, as the r2 judge computed) and is VOID, not a
+# baseline. None = no honest measurement recorded yet: the first green
+# driver run with this methodology becomes the baseline (update these from
+# BENCH_r03.json's per-config values, per BASELINE.md policy).
+BASELINES = {
+    "bert": None,       # tokens/sec/chip, b32 x s128, bf16 mixed
+    "resnet50": None,   # samples/sec/chip, b32 224x224, bf16 mixed
+    "lstm": None,       # tokens/sec/chip, b32 x s256, GravesLSTM pallas
+    "lenet": None,      # samples/sec/chip, b256 28x28
+}
+
+# Published dense bf16 peak FLOP/s per chip, keyed by device_kind substring
+# (ordered: first match wins; more specific names first).
+_PEAK_BF16 = [
+    ("TPU7x", 2307e12),
+    ("TPU v6 lite", 918e12),
+    ("TPU v6", 918e12),
+    ("TPU v5p", 459e12),
+    ("TPU v5 lite", 197e12),   # v5e
+    ("TPU v5", 459e12),
+    ("TPU v4", 275e12),
+    ("TPU v3", 123e12),
+    ("TPU v2", 45e12),
+]
 
 _TPU_PLATFORMS = ("tpu", "axon")
+
+
+def peak_bf16_flops(device_kind: str):
+    for key, peak in _PEAK_BF16:
+        if key.lower() in device_kind.lower():
+            return peak
+    return None
 
 
 def _probe_backend(timeout_s: float):
@@ -46,12 +97,14 @@ def _probe_backend(timeout_s: float):
     return platform, int(n)
 
 
-def _init_backend(max_wait_s: float = 420.0):
+def _init_backend(max_wait_s: float = 900.0):
     """Return (devices, diag), retrying transient tunnel wedges.
 
-    Probes sparingly (the tunnel serializes grants; hammering it with
-    rapid client creates makes the wedge worse) and only touches jax
-    in-process once a probe subprocess has initialized cleanly.
+    Patience over retry count: killing a probe mid-claim can strand a
+    server-side claim that re-wedges the NEXT probe, so few long-timeout
+    attempts beat many short ones. (A probe that NEVER succeeds can also
+    mean the relay process carrying the tunnel died — observed r3 —
+    which no amount of client-side retrying recovers.)
     """
     deadline = time.monotonic() + max_wait_s
     delay = 30.0
@@ -60,7 +113,7 @@ def _init_backend(max_wait_s: float = 420.0):
     while True:
         attempt += 1
         try:
-            platform, _ = _probe_backend(timeout_s=120.0)
+            platform, _ = _probe_backend(timeout_s=300.0)
             if platform not in _TPU_PLATFORMS:
                 raise RuntimeError(
                     f"backend came up as '{platform}', not a TPU — refusing "
@@ -81,78 +134,319 @@ def _init_backend(max_wait_s: float = 420.0):
     devs = jax.devices()
     if devs[0].platform not in _TPU_PLATFORMS:
         raise RuntimeError(f"in-process backend is '{devs[0].platform}'")
+    kind = devs[0].device_kind
     return devs, {
         "platform": devs[0].platform,
+        "device_kind": kind,
+        "peak_bf16_tflops": (peak_bf16_flops(kind) or 0) / 1e12 or None,
         "n_devices": len(devs),
         "init_attempts": attempt,
     }
 
 
-def bench_bert(batch_size: int = 32, seq_len: int = 128, warmup: int = 3,
-               iters: int = 10, diag: dict | None = None):
+# --------------------------------------------------------------------------
+# Timing core
+# --------------------------------------------------------------------------
+
+def _timed_train(trainer, ts, batch, *, warmup: int, iters: int,
+                 flops_per_step: float, units_per_step: float,
+                 peak_flops, info: dict):
+    """Time `iters` train steps with forced-materialization sync.
+
+    Steps are dispatched asynchronously (the ts -> ts data dependence keeps
+    them sequential on device); the window closes with a device_get of every
+    step's loss AND an element of the final params, so the clock cannot stop
+    before the device finishes. Returns units/sec.
+    """
+    import jax
+    import numpy as np
+
+    t0 = time.perf_counter()
+    ts, m = trainer.train_step(ts, batch)
+    first = float(jax.device_get(m["total_loss"]))
+    info["compile_s"] = round(time.perf_counter() - t0, 1)
+    if not np.isfinite(first):
+        raise RuntimeError(f"non-finite loss at step 1: {first}")
+
+    for _ in range(warmup):
+        ts, m = trainer.train_step(ts, batch)
+    float(jax.device_get(m["total_loss"]))  # sync before opening the window
+
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ts, m = trainer.train_step(ts, batch)
+        losses.append(m["total_loss"])
+    host_losses = [float(x) for x in jax.device_get(losses)]
+    # Force the last param update too (loss i depends only on params i-1).
+    last_leaf = jax.tree_util.tree_leaves(ts.params)[0]
+    float(jax.device_get(last_leaf.ravel()[0]))
+    dt = time.perf_counter() - t0
+
+    if not all(np.isfinite(l) for l in host_losses):
+        raise RuntimeError(f"non-finite loss in timed window: {host_losses}")
+    k = max(1, iters // 4)
+    decreasing = float(np.mean(host_losses[-k:])) < float(np.mean(host_losses[:k]))
+    step_s = dt / iters
+    info.update({
+        "step_ms": round(step_s * 1000, 2),
+        "iters": iters,
+        "loss_first": round(host_losses[0], 4),
+        "loss_last": round(host_losses[-1], 4),
+        "decreasing": bool(decreasing),
+        "flops_per_step": flops_per_step,
+    })
+    if peak_flops:
+        mfu = flops_per_step / step_s / peak_flops
+        info["mfu"] = round(mfu, 4)
+        if mfu > 1.0:
+            raise RuntimeError(
+                f"MFU {mfu:.2f} > 1.0 — measurement artifact (sync failure?)"
+            )
+    if not decreasing:
+        # Hard failure, not a warning: every config re-fits one fixed batch,
+        # so a working step MUST reduce the loss across the window — a flat
+        # loss means the step isn't training and its time is meaningless.
+        raise RuntimeError(
+            f"loss did not decrease over timed window "
+            f"({host_losses[0]:.4f} -> {host_losses[-1]:.4f})")
+    return units_per_step / step_s
+
+
+# --------------------------------------------------------------------------
+# Analytic FLOPs (train step ~= 3x forward for matmul-dominated models)
+# --------------------------------------------------------------------------
+
+def bert_train_flops(batch, seq, cfg) -> float:
+    """Matmul FLOPs for one BERT MLM+NSP train step.
+
+    fwd = L*(8*B*T*H^2 [QKV+O] + 4*B*T^2*H [QK^T + AV] + 4*B*T*H*I [FFN])
+          + 2*B*T*H^2 [MLM transform] + 2*B*T*H*V [tied decoder]; bwd = 2x.
+    """
+    b, t = batch, seq
+    h, i, l, v = cfg.hidden, cfg.intermediate, cfg.num_layers, cfg.vocab_size
+    fwd = l * (8 * b * t * h * h + 4 * b * t * t * h + 4 * b * t * h * i)
+    fwd += 2 * b * t * h * h + 2 * b * t * h * v
+    return 3.0 * fwd
+
+
+def lstm_train_flops(batch, seq, hidden, vocab, layers=2) -> float:
+    """GravesLSTM char-RNN: per step per layer the cell does the fused gate
+    GEMM 2*(4H*(H+in)) MACs; head is 2*B*T*H*V. FLOPs = 2*MACs; train = 3x fwd.
+    """
+    b, t, h, v = batch, seq, hidden, vocab
+    fwd = 0.0
+    inp = v
+    for _ in range(layers):
+        fwd += b * t * 2 * (4 * h * (h + inp))
+        inp = h
+    fwd += 2 * b * t * h * v
+    return 3.0 * fwd
+
+
+# ResNet-50 224x224 forward = 4.09e9 MACs (standard torchvision count of the
+# conv/fc MACs for the v1.5 graph); FLOPs = 2*MACs, train = 3x forward.
+RESNET50_TRAIN_FLOPS_PER_SAMPLE = 3.0 * 2.0 * 4.09e9
+
+# LeNet (our models/lenet.py geometry: SAME-padded convs, 28x28): conv1
+# 5x5x1x20 @ 28^2 (0.39e6) + conv2 5x5x20x50 @ 14^2 (4.90e6) + fc 2450x500
+# (1.23e6) + fc 500x10 ~= 6.52e6 MACs fwd.
+LENET_TRAIN_FLOPS_PER_SAMPLE = 3.0 * 2.0 * 6.52e6
+
+
+# --------------------------------------------------------------------------
+# Configs
+# --------------------------------------------------------------------------
+
+def bench_bert(peak, *, batch_size=32, seq_len=128, warmup=4, iters=30):
     import jax
 
     from deeplearning4j_tpu.models.bert import bert_base, make_mlm_batch
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
     from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
 
-    model = bert_base()
+    model = bert_base(net=NeuralNetConfiguration(
+        updater=Adam(1e-4), mixed_precision=True))
     trainer = Trainer(model)
     ts = trainer.init_state()
-    batch = make_mlm_batch(0, batch_size=batch_size, seq_len=seq_len,
-                           vocab_size=model.config.vocab_size)
-    batch = jax.device_put(batch)
+    batch = jax.device_put(make_mlm_batch(
+        0, batch_size=batch_size, seq_len=seq_len,
+        vocab_size=model.config.vocab_size))
 
-    t0 = time.perf_counter()
-    ts, _ = trainer.train_step(ts, batch)  # first call compiles
-    jax.block_until_ready(ts.params)
-    if diag is not None:
-        diag["compile_s"] = round(time.perf_counter() - t0, 1)
+    info = {"batch": batch_size, "seq_len": seq_len, "dtype": "bf16-mixed",
+            "unit": "tokens/sec/chip"}
+    value = _timed_train(
+        trainer, ts, batch, warmup=warmup, iters=iters,
+        flops_per_step=bert_train_flops(batch_size, seq_len, model.config),
+        units_per_step=batch_size * seq_len, peak_flops=peak, info=info)
+    info["value"] = round(value, 1)
+    return info
 
-    for _ in range(warmup - 1):
-        ts, metrics = trainer.train_step(ts, batch)
-    jax.block_until_ready(ts.params)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        ts, metrics = trainer.train_step(ts, batch)
-    jax.block_until_ready(ts.params)
-    dt = time.perf_counter() - t0
+def bench_resnet50(peak, *, batch_size=32, warmup=3, iters=20):
+    import jax
+    import numpy as np
 
-    if diag is not None:
-        diag["step_ms"] = round(dt / iters * 1000, 1)
-        diag["batch"] = batch_size
-        diag["seq_len"] = seq_len
-    return batch_size * seq_len * iters / dt
+    from deeplearning4j_tpu.models.zoo import resnet50
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    model = resnet50(num_classes=1000, updater=Adam(1e-3))
+    model.net.mixed_precision = True
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    r = np.random.default_rng(0)
+    labels = np.eye(1000, dtype=np.float32)[r.integers(0, 1000, batch_size)]
+    batch = jax.device_put({
+        "features": r.normal(size=(batch_size, 224, 224, 3)).astype(np.float32),
+        "labels": labels,
+    })
+
+    info = {"batch": batch_size, "image": 224, "dtype": "bf16-mixed",
+            "unit": "samples/sec/chip"}
+    value = _timed_train(
+        trainer, ts, batch, warmup=warmup, iters=iters,
+        flops_per_step=RESNET50_TRAIN_FLOPS_PER_SAMPLE * batch_size,
+        units_per_step=batch_size, peak_flops=peak, info=info)
+    info["value"] = round(value, 1)
+    return info
+
+
+def bench_lstm(peak, *, batch_size=32, seq_len=256, hidden=256, vocab=77,
+               warmup=4, iters=30):
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.classic import text_generation_lstm
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    model = text_generation_lstm(
+        vocab_size=vocab, hidden=hidden, seq_len=seq_len,
+        updater=Adam(1e-3), backend="pallas")
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    r = np.random.default_rng(0)
+    ids = r.integers(0, vocab, (batch_size, seq_len + 1))
+    eye = np.eye(vocab, dtype=np.float32)
+    batch = jax.device_put({
+        "features": eye[ids[:, :-1]], "labels": eye[ids[:, 1:]]})
+
+    info = {"batch": batch_size, "seq_len": seq_len, "hidden": hidden,
+            "kernel": "pallas", "unit": "tokens/sec/chip"}
+    value = _timed_train(
+        trainer, ts, batch, warmup=warmup, iters=iters,
+        flops_per_step=lstm_train_flops(batch_size, seq_len, hidden, vocab),
+        units_per_step=batch_size * seq_len, peak_flops=peak, info=info)
+    info["value"] = round(value, 1)
+    return info
+
+
+def bench_lenet(peak, *, batch_size=256, warmup=4, iters=30):
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    model = lenet()
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    r = np.random.default_rng(0)
+    batch = jax.device_put({
+        "features": r.normal(size=(batch_size, 28, 28, 1)).astype(np.float32),
+        "labels": np.eye(10, dtype=np.float32)[r.integers(0, 10, batch_size)],
+    })
+
+    info = {"batch": batch_size, "unit": "samples/sec/chip"}
+    value = _timed_train(
+        trainer, ts, batch, warmup=warmup, iters=iters,
+        flops_per_step=LENET_TRAIN_FLOPS_PER_SAMPLE * batch_size,
+        units_per_step=batch_size, peak_flops=peak, info=info)
+    info["value"] = round(value, 1)
+    return info
+
+
+_CONFIGS = {
+    "bert": bench_bert,
+    "resnet50": bench_resnet50,
+    "lstm": bench_lstm,
+    "lenet": bench_lenet,
+}
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="bert,resnet50,lstm,lenet",
+                    help="comma-separated subset of %s" % list(_CONFIGS))
+    ap.add_argument("--kernels", action="store_true",
+                    help="run the on-chip Pallas-vs-XLA kernel A/B instead")
+    args = ap.parse_args()
+
     diag = {}
+    configs = {}
     try:
         _, init_diag = _init_backend()
         diag.update(init_diag)
-        value = bench_bert(diag=diag)
-        vs = (round(value / BASELINE_TOKENS_PER_SEC, 3)
-              if BASELINE_TOKENS_PER_SEC else 1.0)
-        result = {
-            "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
-            "value": round(value, 1),
-            "unit": "tokens/sec/chip",
-            "vs_baseline": vs,
-            **diag,
-        }
     except Exception as e:  # noqa: BLE001 - bench must always emit one line
-        result = {
+        print(json.dumps({
             "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "tokens/sec/chip",
-            "vs_baseline": 0.0,
-            "error": str(e)[:300],
-            **diag,
-        }
+            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "error": str(e)[:300], **diag,
+        }))
+        return
+
+    if args.kernels:
+        from kernels_ab import run_kernels_ab  # local module, repo root
+
+        print(json.dumps(run_kernels_ab(diag)))
+        return
+
+    peak = peak_bf16_flops(diag.get("device_kind", "")) or None
+    for name in args.configs.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            info = _CONFIGS[name](peak)
+            base = BASELINES.get(name)
+            if base:
+                info["vs_baseline"] = round(info["value"] / base, 3)
+            configs[name] = info
+        except Exception as e:  # noqa: BLE001 - keep other configs alive
+            configs[name] = {"value": 0.0, "error": str(e)[:300]}
+
+    # Pallas-vs-XLA kernel A/B (compiled on this chip): parity + speedup,
+    # embedded so the driver's single bench invocation records it.
+    kernels = None
+    try:
+        from kernels_ab import run_kernels_ab
+
+        kernels = run_kernels_ab({})
+        kernels.pop("metric", None)
+    except Exception as e:  # noqa: BLE001
+        kernels = {"error": str(e)[:300]}
+
+    head = configs.get("bert", {})
+    result = {
+        "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
+        "value": head.get("value", 0.0),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": head.get(
+            "vs_baseline",
+            0.0 if "error" in head or not head else 1.0),
+        "baseline_pending": BASELINES.get("bert") is None,
+        "mfu": head.get("mfu"),
+        "sync": "forced-host-materialization (axon block_until_ready is async)",
+        **diag,
+        "configs": configs,
+        "kernels_ab": kernels,
+    }
+    if "error" in head:
+        result["error"] = head["error"]
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
     main()
-
-

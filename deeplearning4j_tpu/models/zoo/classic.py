@@ -148,8 +148,8 @@ def darknet19_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
 
 def text_generation_lstm_config(*, vocab_size: int = 77, hidden: int = 256,
                                 seq_len: int = 64, updater=None,
-                                seed: int = 12345,
-                                graves: bool = True) -> SequentialConfig:
+                                seed: int = 12345, graves: bool = True,
+                                backend: str = "xla") -> SequentialConfig:
     """↔ zoo TextGenerationLSTM (char-RNN; benchmark config #3 uses the
     GravesLSTM/peephole variant on the Pallas scan path).
 
@@ -160,8 +160,8 @@ def text_generation_lstm_config(*, vocab_size: int = 77, hidden: int = 256,
     net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="xavier")
     lstm_cls = GravesLSTMLayer if graves else LSTM
     layers = [
-        lstm_cls(units=hidden, activation="tanh"),
-        lstm_cls(units=hidden, activation="tanh"),
+        lstm_cls(units=hidden, activation="tanh", backend=backend),
+        lstm_cls(units=hidden, activation="tanh", backend=backend),
         RnnOutputLayer(units=vocab_size, activation="softmax", loss="mcxent"),
     ]
     return SequentialConfig(net=net, layers=layers,

@@ -1,0 +1,157 @@
+"""On-chip Pallas-vs-XLA kernel A/B: compiled parity + speedup.
+
+Run via ``python bench.py --kernels`` on a machine with a TPU attached.
+Answers VERDICT r2 Weak #4: the Pallas kernels had only ever been
+correctness-checked in interpret mode on CPU, and their claimed speed was a
+hypothesis. This module compiles BOTH the Pallas kernels and their XLA
+reference implementations on the real chip, checks numerical parity of
+forward AND backward, and A/B-times them with the same
+forced-host-materialization sync that bench.py uses (the axon tunnel's
+``block_until_ready`` returns at dispatch — see bench.py docstring).
+
+Emits one JSON dict (bench.py --kernels prints it); the round artifact is
+committed as KERNELS_TPU_r{N}.json.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _sync_scalar(x):
+    """Force completion: materialize a scalar data-dependent on x."""
+    import jax
+
+    return float(jax.device_get(x.ravel()[0] if x.ndim else x))
+
+
+def _time_fn(fn, args, iters=30):
+    """Median-free simple timing: async dispatch, one sync in-window."""
+    import jax
+
+    out = fn(*args)
+    _sync_scalar(out if not isinstance(out, tuple) else out[0])
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(iters):
+        o = fn(*args)
+        outs.append(o if not isinstance(o, tuple) else o[0])
+    # One scalar per call: every dispatch must have completed.
+    s = sum(o.ravel()[0] for o in outs)
+    _sync_scalar(s)
+    return (time.perf_counter() - t0) / iters * 1000  # ms
+
+
+def _max_rel_err(a, b):
+    import jax
+    import numpy as np
+
+    a = np.asarray(jax.device_get(a), np.float32)
+    b = np.asarray(jax.device_get(b), np.float32)
+    denom = np.maximum(np.abs(b).max(), 1e-6)
+    return float(np.abs(a - b).max() / denom)
+
+
+def _flash_ab(iters=30):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.kernels.flash_attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    B, H, T, D = 8, 12, 512, 64
+    r = np.random.default_rng(0)
+    q = jnp.asarray(r.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(B, H, T, D)), jnp.float32)
+    lens = r.integers(T // 2, T + 1, B)
+    key_mask = jnp.asarray(
+        (np.arange(T)[None, :] < lens[:, None]).astype(np.float32))
+
+    out = {"shape": f"B{B} H{H} T{T} D{D}", "iters": iters}
+
+    flash_f = jax.jit(lambda q, k, v: flash_attention(q, k, v, key_mask=key_mask))
+    ref_f = jax.jit(lambda q, k, v: reference_attention(q, k, v, key_mask=key_mask))
+
+    of, orf = flash_f(q, k, v), ref_f(q, k, v)
+    # Padded key rows of the reference produce uniform-attention outputs that
+    # callers never read; compare only live queries (all queries are live —
+    # key_mask masks keys, so outputs differ only via masked softmax: both
+    # implement it, all rows comparable).
+    out["fwd_max_rel_err"] = _max_rel_err(of, orf)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, key_mask=key_mask) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, key_mask=key_mask) ** 2)
+
+    gflash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    gref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))
+    gf, gr = gflash(q, k, v), gref(q, k, v)
+    out["bwd_max_rel_err"] = max(_max_rel_err(a, b) for a, b in zip(gf, gr))
+
+    out["fwd_ms"] = {"pallas": _time_fn(flash_f, (q, k, v), iters),
+                     "xla": _time_fn(ref_f, (q, k, v), iters)}
+    out["bwd_ms"] = {"pallas": _time_fn(lambda *a: gflash(*a)[0], (q, k, v), iters),
+                     "xla": _time_fn(lambda *a: gref(*a)[0], (q, k, v), iters)}
+    out["fwd_speedup"] = round(out["fwd_ms"]["xla"] / out["fwd_ms"]["pallas"], 3)
+    out["bwd_speedup"] = round(out["bwd_ms"]["xla"] / out["bwd_ms"]["pallas"], 3)
+    out["parity"] = bool(out["fwd_max_rel_err"] < 2e-2
+                         and out["bwd_max_rel_err"] < 2e-2)
+    return out
+
+
+def _lstm_ab(iters=30):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.kernels import lstm_scan
+    from deeplearning4j_tpu.ops import rnn as opsrnn
+
+    N, T, H, C = 32, 256, 256, 256
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(N, T, C)) * 0.1, jnp.float32)
+    w_x = jnp.asarray(r.normal(size=(C, 4 * H)) * 0.05, jnp.float32)
+    w_h = jnp.asarray(r.normal(size=(H, 4 * H)) * 0.05, jnp.float32)
+    b = jnp.zeros((4 * H,), jnp.float32)
+    peep = tuple(jnp.asarray(r.normal(size=(H,)) * 0.05, jnp.float32)
+                 for _ in range(3))
+
+    out = {"shape": f"N{N} T{T} H{H}", "iters": iters}
+
+    pallas_f = jax.jit(lambda x: lstm_scan.lstm(x, w_x, w_h, b, peepholes=peep,
+                                                forget_bias=1.0)[0])
+    xla_f = jax.jit(lambda x: opsrnn.lstm(x, w_x, w_h, b, peepholes=peep,
+                                          forget_bias=1.0)[0])
+    op, ox = pallas_f(x), xla_f(x)
+    out["fwd_max_rel_err"] = _max_rel_err(op, ox)
+
+    gpallas = jax.jit(jax.grad(lambda x: jnp.sum(pallas_f(x) ** 2)))
+    gxla = jax.jit(jax.grad(lambda x: jnp.sum(xla_f(x) ** 2)))
+    gp, gx = gpallas(x), gxla(x)
+    out["bwd_max_rel_err"] = _max_rel_err(gp, gx)
+
+    out["fwd_ms"] = {"pallas": _time_fn(pallas_f, (x,), iters),
+                     "xla": _time_fn(xla_f, (x,), iters)}
+    out["bwd_ms"] = {"pallas": _time_fn(gpallas, (x,), iters),
+                     "xla": _time_fn(gxla, (x,), iters)}
+    out["fwd_speedup"] = round(out["fwd_ms"]["xla"] / out["fwd_ms"]["pallas"], 3)
+    out["bwd_speedup"] = round(out["bwd_ms"]["xla"] / out["bwd_ms"]["pallas"], 3)
+    out["parity"] = bool(out["fwd_max_rel_err"] < 2e-2
+                         and out["bwd_max_rel_err"] < 2e-2)
+    return out
+
+
+def run_kernels_ab(diag: dict) -> dict:
+    result = {"metric": "pallas_kernel_ab", **diag}
+    for name, fn in (("flash_attention", _flash_ab), ("lstm_scan", _lstm_ab)):
+        try:
+            result[name] = fn()
+        except Exception as e:  # noqa: BLE001 - record, keep going
+            result[name] = {"error": str(e)[:300]}
+    return result
