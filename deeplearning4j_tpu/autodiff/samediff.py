@@ -895,6 +895,7 @@ class SameDiff:
         from jax import export as jexport
 
         outputs = tuple(outputs)
+        self._require_placeholders(feed_specs)
         ph_names = tuple(sorted(feed_specs))
         fn = self._build_fn(outputs, ph_names)
         variables, constants, _ = self._split_feeds({})
@@ -906,6 +907,18 @@ class SameDiff:
                  for n, (s, d) in feed_specs.items()}
         return bytes(jexport.export(jax.jit(program))(specs).serialize())
 
+    def _require_placeholders(self, names) -> None:
+        """Exported-program inputs must be PLACEHOLDERs: a VARIABLE or
+        CONSTANT name here would silently become a runtime input shadowing
+        its stored value (same hazard _split_feeds rejects for feeds)."""
+        for n in names:
+            if n not in self._vars:
+                raise KeyError(f"unknown placeholder {n!r}")
+            vt = self._vars[n].var_type
+            if vt != VariableType.PLACEHOLDER:
+                raise ValueError(
+                    f"export input {n!r} is {vt.name}, not a placeholder")
+
     @staticmethod
     def run_stablehlo(blob: bytes, feeds: Dict[str, Any]) -> Dict[str, np.ndarray]:
         from jax import export as jexport
@@ -913,6 +926,34 @@ class SameDiff:
         fn = jexport.deserialize(blob)
         out = fn.call({k: jnp.asarray(v) for k, v in feeds.items()})
         return {k: np.asarray(v) for k, v in out.items()}
+
+    def export_stablehlo_text(self, outputs: Sequence[str],
+                              feed_specs: Dict[str, Tuple[Tuple[int, ...], str]]
+                              ) -> Tuple[str, List[str]]:
+        """Raw StableHLO MLIR of the compiled program + the positional arg
+        order (sorted placeholder names). This is the form
+        runtime/native.NativeRuntime.compile consumes directly — the
+        north-star #4 seam: import → train → export → PJRT execute
+        without jax in the serving process."""
+        outputs = tuple(outputs)
+        self._require_placeholders(feed_specs)
+        ph_names = tuple(sorted(feed_specs))
+        fn = self._build_fn(outputs, ph_names)
+        variables, constants, _ = self._split_feeds({})
+
+        def program(*placeholder_vals):
+            feeds = dict(zip(ph_names, placeholder_vals))
+            out = fn(variables, constants, feeds)
+            return tuple(out[o] for o in outputs)
+
+        specs = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                 for _, (s, d) in sorted(feed_specs.items())]
+        # keep_unused: the MLIR signature must carry EVERY declared
+        # placeholder, or arg_order would misalign with main()'s params
+        # when an output doesn't consume some feed.
+        mlir = jax.jit(program, keep_unused=True).lower(*specs).compiler_ir(
+            "stablehlo")
+        return str(mlir), list(ph_names)
 
 
 def _replay_call_node(sd: SameDiff, node: OpNode, fn, vals: List[Any]):

@@ -13,6 +13,13 @@ from deeplearning4j_tpu.data.iterators import (
     AsyncDataSetIterator,
     TransformIterator,
 )
+from deeplearning4j_tpu.data.datasets import (
+    load_cifar10,
+    load_cifar100,
+    load_emnist,
+    load_iris,
+    load_tiny_imagenet,
+)
 from deeplearning4j_tpu.data.mnist import load_mnist
 from deeplearning4j_tpu.data.normalizers import (
     ImageMeanSubtraction,
@@ -41,7 +48,8 @@ from deeplearning4j_tpu.data.image import (
 __all__ = [
     "DataSet", "MultiDataSet",
     "ArrayDataSetIterator", "AsyncDataSetIterator", "TransformIterator",
-    "load_mnist",
+    "load_mnist", "load_cifar10", "load_cifar100", "load_emnist",
+    "load_iris", "load_tiny_imagenet",
     "ImageMeanSubtraction", "ImagePreProcessingScaler",
     "NormalizerMinMaxScaler", "NormalizerStandardize",
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",

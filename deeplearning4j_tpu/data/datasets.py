@@ -1,0 +1,291 @@
+"""Built-in dataset fetchers beyond MNIST (↔ deeplearning4j-datasets
+fetchers/iterators: Cifar10Fetcher + Cifar10DataSetIterator,
+EmnistDataSetIterator, IrisDataSetIterator, TinyImageNetFetcher;
+SURVEY §2.5 Datasets row).
+
+Same contract as data/mnist.py: the reference auto-downloads archives; this
+environment has no network, so each loader searches standard on-disk
+locations for the real files and otherwise falls back to a deterministic
+SYNTHETIC stand-in with the dataset's exact shapes/classes and a learnable
+structure (class template + noise), so convergence tests and benchmarks
+exercise the real compute path either way. The third return value
+``is_real`` says which you got.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.mnist import _read_idx
+
+Split = Tuple[np.ndarray, np.ndarray]
+
+
+def _search(names) -> Optional[Path]:
+    roots = [
+        "/root/data", "/root/datasets",
+        os.path.expanduser("~/.cache"),
+        os.path.expanduser("~/.deeplearning4j"),
+    ]
+    for root in roots:
+        for name in names:
+            p = Path(root) / name
+            if p.exists():
+                return p
+    return None
+
+
+def _synthetic_images(n_train, n_test, *, shape, num_classes, seed):
+    """Class-template-plus-noise images in [0,255] uint8 (learnable: a
+    small convnet separates the templates through the noise)."""
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(0.0, 1.0, (num_classes,) + shape).astype(np.float32)
+
+    def make(n, seed2):
+        r = np.random.default_rng(seed2)
+        y = r.integers(0, num_classes, n)
+        x = templates[y] + 0.5 * r.normal(0.0, 1.0, (n,) + shape).astype(np.float32)
+        x = (x - x.min()) / (x.max() - x.min())
+        return (x * 255).astype(np.uint8), y.astype(np.int64)
+
+    return make(n_train, seed + 1), make(n_test, seed + 2)
+
+
+def _prep(x, y, *, num_classes, normalize, one_hot, image_shape):
+    x = x.astype(np.float32)
+    if normalize:
+        x = x / 255.0
+    x = x.reshape((x.shape[0],) + image_shape)
+    if one_hot:
+        oh = np.zeros((y.shape[0], num_classes), np.float32)
+        oh[np.arange(y.shape[0]), y.astype(int)] = 1.0
+        y = oh
+    return x, y
+
+
+# --- CIFAR -----------------------------------------------------------------
+
+
+def _read_cifar10_batches(d: Path):
+    xs, ys = [], []
+    for name in [f"data_batch_{i}" for i in range(1, 6)]:
+        with open(d / name, "rb") as f:
+            b = pickle.load(f, encoding="bytes")
+        xs.append(b[b"data"])
+        ys.extend(b[b"labels"])
+    xtr = np.concatenate(xs)
+    with open(d / "test_batch", "rb") as f:
+        b = pickle.load(f, encoding="bytes")
+    return (xtr, np.array(ys)), (b[b"data"], np.array(b[b"labels"]))
+
+
+def _cifar_to_nhwc(x):
+    return x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+
+
+def load_cifar10(*, n_train: Optional[int] = None, n_test: Optional[int] = None,
+                 normalize: bool = True, one_hot: bool = True
+                 ) -> Tuple[Split, Split, bool]:
+    """↔ Cifar10DataSetIterator. Images [N,32,32,3] float32, 10 classes."""
+    d = _search(["cifar-10-batches-py", "cifar10/cifar-10-batches-py"])
+    npz = _search(["cifar10.npz", "cifar10/cifar10.npz"])
+    if d is not None and (d / "test_batch").exists():
+        (xtr, ytr), (xte, yte) = _read_cifar10_batches(d)
+        xtr, xte = _cifar_to_nhwc(xtr), _cifar_to_nhwc(xte)
+        is_real = True
+    elif npz is not None:
+        with np.load(npz) as z:
+            xtr, ytr, xte, yte = (z["x_train"], z["y_train"],
+                                  z["x_test"], z["y_test"])
+        is_real = True
+    else:
+        ((xtr, ytr), (xte, yte)) = _synthetic_images(
+            n_train or 50000, n_test or 10000, shape=(32, 32, 3),
+            num_classes=10, seed=11)
+        is_real = False
+    if n_train:
+        xtr, ytr = xtr[:n_train], ytr[:n_train]
+    if n_test:
+        xte, yte = xte[:n_test], yte[:n_test]
+    kw = dict(num_classes=10, normalize=normalize, one_hot=one_hot,
+              image_shape=(32, 32, 3))
+    return _prep(xtr, ytr, **kw), _prep(xte, yte, **kw), is_real
+
+
+def load_cifar100(*, n_train: Optional[int] = None, n_test: Optional[int] = None,
+                  normalize: bool = True, one_hot: bool = True
+                  ) -> Tuple[Split, Split, bool]:
+    """CIFAR-100 fine labels; [N,32,32,3], 100 classes."""
+    d = _search(["cifar-100-python", "cifar100/cifar-100-python"])
+    if d is not None and (d / "test").exists():
+        def rd(name):
+            with open(d / name, "rb") as f:
+                b = pickle.load(f, encoding="bytes")
+            return _cifar_to_nhwc(b[b"data"]), np.array(b[b"fine_labels"])
+
+        xtr, ytr = rd("train")
+        xte, yte = rd("test")
+        is_real = True
+    else:
+        ((xtr, ytr), (xte, yte)) = _synthetic_images(
+            n_train or 50000, n_test or 10000, shape=(32, 32, 3),
+            num_classes=100, seed=13)
+        is_real = False
+    if n_train:
+        xtr, ytr = xtr[:n_train], ytr[:n_train]
+    if n_test:
+        xte, yte = xte[:n_test], yte[:n_test]
+    kw = dict(num_classes=100, normalize=normalize, one_hot=one_hot,
+              image_shape=(32, 32, 3))
+    return _prep(xtr, ytr, **kw), _prep(xte, yte, **kw), is_real
+
+
+# --- EMNIST ----------------------------------------------------------------
+
+EMNIST_CLASSES = {"byclass": 62, "bymerge": 47, "balanced": 47, "letters": 26,
+                  "digits": 10, "mnist": 10}
+
+
+def load_emnist(split: str = "balanced", *, n_train: Optional[int] = None,
+                n_test: Optional[int] = None, normalize: bool = True,
+                one_hot: bool = True) -> Tuple[Split, Split, bool]:
+    """↔ EmnistDataSetIterator(Set.<SPLIT>). Images [N,28,28,1].
+
+    Splits and class counts follow the reference enum
+    (BYCLASS 62 / BYMERGE 47 / BALANCED 47 / LETTERS 26 / DIGITS 10 /
+    MNIST 10). Letters labels are rebased to 0..25 like the reference.
+    """
+    if split not in EMNIST_CLASSES:
+        raise ValueError(f"unknown EMNIST split {split!r}; "
+                         f"have {sorted(EMNIST_CLASSES)}")
+    classes = EMNIST_CLASSES[split]
+    found = {}
+    for kind, io in (("train", "images"), ("train", "labels"),
+                     ("test", "images"), ("test", "labels")):
+        dim = 3 if io == "images" else 1
+        stem = f"emnist-{split}-{kind}-{io}-idx{dim}-ubyte"
+        p = _search([f"emnist/{stem}", f"emnist/{stem}.gz", stem, f"{stem}.gz"])
+        if p is not None:
+            found[(kind, io)] = p
+    if len(found) == 4:
+        xtr = _read_idx(found[("train", "images")])
+        ytr = _read_idx(found[("train", "labels")]).astype(np.int64)
+        xte = _read_idx(found[("test", "images")])
+        yte = _read_idx(found[("test", "labels")]).astype(np.int64)
+        # EMNIST idx images are transposed relative to MNIST orientation
+        xtr = xtr.transpose(0, 2, 1)
+        xte = xte.transpose(0, 2, 1)
+        if split == "letters":  # stored 1-indexed
+            ytr, yte = ytr - 1, yte - 1
+        is_real = True
+    else:
+        ((xtr, ytr), (xte, yte)) = _synthetic_images(
+            n_train or 10000, n_test or 2000, shape=(28, 28),
+            num_classes=classes, seed=17)
+        is_real = False
+    if n_train:
+        xtr, ytr = xtr[:n_train], ytr[:n_train]
+    if n_test:
+        xte, yte = xte[:n_test], yte[:n_test]
+    kw = dict(num_classes=classes, normalize=normalize, one_hot=one_hot,
+              image_shape=(28, 28, 1))
+    return _prep(xtr, ytr, **kw), _prep(xte, yte, **kw), is_real
+
+
+# --- Iris ------------------------------------------------------------------
+
+
+def load_iris(*, test_frac: float = 0.2, one_hot: bool = True, seed: int = 0
+              ) -> Tuple[Split, Split, bool]:
+    """↔ IrisDataSetIterator. Features [N,4] float32, 3 classes,
+    stratified train/test split.
+
+    Real data: an ``iris.csv``/``iris.data`` (sepal_l,sepal_w,petal_l,
+    petal_w,label) in the search dirs. Fallback: a deterministic 150-sample
+    stand-in drawn from per-class Gaussians with the published per-class
+    feature means/stds of the real dataset — same separability character
+    (setosa linearly separable, versicolor/virginica overlapping).
+    """
+    p = _search(["iris/iris.csv", "iris/iris.data", "iris.csv", "iris.data"])
+    if p is not None:
+        rows = []
+        labels = []
+        name_to_id = {}
+        for line in p.read_text().strip().splitlines():
+            parts = [s.strip() for s in line.replace(";", ",").split(",")]
+            if len(parts) < 5 or not parts[0][:1].isdigit():
+                continue  # header / blank / delimiter-only rows
+            rows.append([float(v) for v in parts[:4]])
+            lab = parts[4]
+            if lab not in name_to_id:
+                name_to_id[lab] = len(name_to_id)
+            labels.append(name_to_id[lab])
+        x = np.asarray(rows, np.float32)
+        y = np.asarray(labels, np.int64)
+        is_real = True
+    else:
+        # per-class N(mean, std) on the 4 features (published summary stats)
+        means = np.array([[5.01, 3.43, 1.46, 0.25],
+                          [5.94, 2.77, 4.26, 1.33],
+                          [6.59, 2.97, 5.55, 2.03]], np.float32)
+        stds = np.array([[0.35, 0.38, 0.17, 0.11],
+                         [0.52, 0.31, 0.47, 0.20],
+                         [0.64, 0.32, 0.55, 0.27]], np.float32)
+        r = np.random.default_rng(seed + 42)
+        x = np.concatenate([means[c] + stds[c] * r.normal(size=(50, 4))
+                            for c in range(3)]).astype(np.float32)
+        y = np.repeat(np.arange(3), 50).astype(np.int64)
+        is_real = False
+
+    # stratified shuffle/split
+    r = np.random.default_rng(seed)
+    tr_idx, te_idx = [], []
+    for c in np.unique(y):
+        idx = r.permutation(np.where(y == c)[0])
+        k = max(1, int(len(idx) * test_frac))
+        te_idx.extend(idx[:k])
+        tr_idx.extend(idx[k:])
+    tr_idx, te_idx = np.array(tr_idx), np.array(te_idx)
+
+    def enc(yy):
+        if not one_hot:
+            return yy
+        oh = np.zeros((yy.shape[0], 3), np.float32)
+        oh[np.arange(yy.shape[0]), yy] = 1.0
+        return oh
+
+    return ((x[tr_idx], enc(y[tr_idx])), (x[te_idx], enc(y[te_idx])), is_real)
+
+
+# --- TinyImageNet ----------------------------------------------------------
+
+
+def load_tiny_imagenet(*, n_train: Optional[int] = None,
+                       n_test: Optional[int] = None, normalize: bool = True,
+                       one_hot: bool = True) -> Tuple[Split, Split, bool]:
+    """↔ TinyImageNetDataSetIterator. Images [N,64,64,3], 200 classes.
+    Real-data path expects a pre-packed ``tiny-imagenet.npz``; the raw
+    per-file archive layout is served by data/image.py's directory reader."""
+    npz = _search(["tiny-imagenet.npz", "tiny-imagenet-200/tiny-imagenet.npz"])
+    if npz is not None:
+        with np.load(npz) as z:
+            xtr, ytr, xte, yte = (z["x_train"], z["y_train"],
+                                  z["x_test"], z["y_test"])
+        is_real = True
+    else:
+        ((xtr, ytr), (xte, yte)) = _synthetic_images(
+            n_train or 5000, n_test or 1000, shape=(64, 64, 3),
+            num_classes=200, seed=23)
+        is_real = False
+    if n_train:
+        xtr, ytr = xtr[:n_train], ytr[:n_train]
+    if n_test:
+        xte, yte = xte[:n_test], yte[:n_test]
+    kw = dict(num_classes=200, normalize=normalize, one_hot=one_hot,
+              image_shape=(64, 64, 3))
+    return _prep(xtr, ytr, **kw), _prep(xte, yte, **kw), is_real

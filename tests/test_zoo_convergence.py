@@ -1,0 +1,135 @@
+"""Zoo convergence sanity: every zoo entry must overfit 10 samples
+(VERDICT r2 Weak #9; SURVEY §4 pattern 5 — a model that cannot memorize a
+tiny batch is broken regardless of its shapes).
+
+Models run at reduced input resolution (the configs are parametric) so the
+whole suite stays CPU-feasible; architecture — blocks, skips, BN, pooling,
+loss heads — is exercised unchanged.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.train.updaters import Adam
+
+N = 10  # samples to memorize
+
+
+def _image_batch(shape, classes, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(N,) + shape).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[np.arange(N) % classes]
+    return {"features": x, "labels": y}
+
+
+def _overfit(model, batch, *, steps=60, min_drop=0.5, lr=None):
+    if lr is not None:
+        model.net.updater = Adam(lr)
+    trainer = Trainer(model)
+    ts = trainer.init_state(seed=0)
+    first = None
+    loss = None
+    for _ in range(steps):
+        ts, m = trainer.train_step(ts, batch)
+        if first is None:
+            first = float(jax.device_get(m["total_loss"]))
+    loss = float(jax.device_get(m["total_loss"]))
+    assert np.isfinite(loss), f"loss diverged: {loss}"
+    assert loss < first * min_drop, (
+        f"failed to overfit {N} samples: {first:.4f} -> {loss:.4f}")
+    return first, loss
+
+
+class TestSequentialZoo:
+    def test_lenet(self):
+        from deeplearning4j_tpu.models.lenet import lenet
+
+        _overfit(lenet(updater=Adam(1e-3)),
+                 _image_batch((28, 28, 1), 10))
+
+    def test_alexnet(self):
+        from deeplearning4j_tpu.models.zoo import alexnet
+
+        _overfit(alexnet(num_classes=10, input_shape=(96, 96, 3),
+                         updater=Adam(1e-4)),
+                 _image_batch((96, 96, 3), 10), steps=40)
+
+    def test_vgg16(self):
+        from deeplearning4j_tpu.models.zoo import vgg16
+
+        _overfit(vgg16(num_classes=10, input_shape=(64, 64, 3),
+                       updater=Adam(1e-4)),
+                 _image_batch((64, 64, 3), 10), steps=40)
+
+    def test_simplecnn(self):
+        from deeplearning4j_tpu.models.zoo import simplecnn
+
+        _overfit(simplecnn(num_classes=10, updater=Adam(1e-3)),
+                 _image_batch((48, 48, 3), 10), steps=40)
+
+    def test_darknet19(self):
+        from deeplearning4j_tpu.models.zoo import darknet19
+
+        _overfit(darknet19(num_classes=10, input_shape=(64, 64, 3),
+                           updater=Adam(1e-3)),
+                 _image_batch((64, 64, 3), 10), steps=40)
+
+    def test_text_generation_lstm(self):
+        from deeplearning4j_tpu.models.zoo.classic import text_generation_lstm
+
+        vocab, t = 20, 16
+        model = text_generation_lstm(vocab_size=vocab, hidden=32, seq_len=t,
+                                     updater=Adam(1e-2))
+        r = np.random.default_rng(0)
+        ids = r.integers(0, vocab, (N, t + 1))
+        eye = np.eye(vocab, dtype=np.float32)
+        batch = {"features": eye[ids[:, :-1]], "labels": eye[ids[:, 1:]]}
+        _overfit(model, batch, steps=80)
+
+
+class TestGraphZoo:
+    def test_resnet50(self):
+        from deeplearning4j_tpu.models.zoo import resnet50
+
+        _overfit(resnet50(num_classes=10, input_shape=(64, 64, 3),
+                          updater=Adam(1e-3)),
+                 _image_batch((64, 64, 3), 10), steps=50)
+
+    def test_squeezenet(self):
+        from deeplearning4j_tpu.models.zoo import squeezenet
+
+        _overfit(squeezenet(num_classes=10, input_shape=(96, 96, 3),
+                            updater=Adam(1e-3)),
+                 _image_batch((96, 96, 3), 10), steps=60)
+
+    def test_xception(self):
+        from deeplearning4j_tpu.models.zoo import xception
+
+        _overfit(xception(num_classes=10, input_shape=(96, 96, 3),
+                          updater=Adam(1e-3)),
+                 _image_batch((96, 96, 3), 10), steps=40)
+
+    def test_unet(self):
+        from deeplearning4j_tpu.models.zoo import unet
+
+        model = unet(num_classes=1, input_shape=(32, 32, 3),
+                     updater=Adam(1e-3))
+        r = np.random.default_rng(0)
+        x = r.normal(size=(N, 32, 32, 3)).astype(np.float32)
+        # learnable target: mask = thresholded mean channel
+        y = (x.mean(-1, keepdims=True) > 0).astype(np.float32)
+        _overfit(model, {"features": x, "labels": y}, steps=60, min_drop=0.7)
+
+
+class TestBert:
+    def test_bert_tiny_mlm(self):
+        from deeplearning4j_tpu.models.bert import bert_tiny, make_mlm_batch
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+
+        model = bert_tiny(net=NeuralNetConfiguration(updater=Adam(1e-3)))
+        batch = make_mlm_batch(0, batch_size=N, seq_len=32,
+                               vocab_size=model.config.vocab_size)
+        batch = jax.device_put(batch)
+        _overfit(model, batch, steps=60, min_drop=0.6)
