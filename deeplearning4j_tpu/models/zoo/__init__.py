@@ -39,6 +39,22 @@ from deeplearning4j_tpu.models.zoo.resnet import (
     resnet152,
     resnet_config,
 )
+from deeplearning4j_tpu.models.zoo.advanced import (
+    inception_resnet_v1,
+    inception_resnet_v1_config,
+    nasnet,
+    nasnet_config,
+)
+from deeplearning4j_tpu.models.zoo.yolo import (
+    Yolo2OutputLayer,
+    decode_predictions,
+    make_yolo_labels,
+    non_max_suppression,
+    tiny_yolo,
+    tiny_yolo_config,
+    yolo2,
+    yolo2_config,
+)
 
 ZOO: Dict[str, Callable] = {
     "lenet": lenet,
@@ -54,6 +70,10 @@ ZOO: Dict[str, Callable] = {
     "resnet101": resnet101,
     "resnet152": resnet152,
     "text_generation_lstm": text_generation_lstm,
+    "tiny_yolo": tiny_yolo,
+    "yolo2": yolo2,
+    "inception_resnet_v1": inception_resnet_v1,
+    "nasnet": nasnet,
 }
 
 

@@ -1,0 +1,264 @@
+"""Advanced zoo DAGs: Inception-ResNet-V1 and NASNet-A
+(↔ org.deeplearning4j.zoo.model.{InceptionResNetV1 (FaceNet backbone),
+NASNet}).
+
+Both are GraphConfig DAGs like graphs.py. Block structure follows the
+papers the reference zoo implements (Szegedy et al. 2016 Inception-ResNet;
+Zoph et al. 2018 NASNet-A): scaled residual inception branches, and
+NASNet's two-input cells (h, h_prev) of separable-conv/pool/identity pairs.
+Filter counts are parametric so convergence tests run at reduced width.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    GlobalPooling,
+    OutputLayer,
+    Pooling2D,
+    SeparableConv2D,
+)
+from deeplearning4j_tpu.nn.model import GraphModel
+
+
+from deeplearning4j_tpu.models.zoo.graphs import _layer  # shared helper
+
+
+def _merge(v, name, inputs, kind="merge"):
+    v[name] = GraphVertex(kind=kind, inputs=list(inputs))
+    return name
+
+
+def _scaled_residual(v, name, inp, branch, factor):
+    """x + factor * branch — the Inception-ResNet residual scaling."""
+    v[f"{name}_scl"] = GraphVertex(kind="scale", inputs=[branch],
+                                   args={"factor": factor})
+    return _merge(v, name, [inp, f"{name}_scl"], kind="add")
+
+
+def _cb(v, name, inp, filters, kernel, stride=1, *, act="relu",
+        padding="SAME"):
+    c = _layer(v, f"{name}_c", inp,
+               Conv2D(filters=filters, kernel=kernel, stride=stride,
+                      padding=padding, use_bias=False))
+    return _layer(v, f"{name}_bn", c, BatchNorm(activation=act))
+
+
+# --- Inception-ResNet-V1 ----------------------------------------------------
+
+
+def _ir_block_a(v, name, inp, w):
+    """35x35 Inception-ResNet-A: 1x1 / 1x1-3x3 / 1x1-3x3-3x3 branches,
+    1x1 projection, scaled residual add."""
+    b0 = _cb(v, f"{name}_b0", inp, w, 1)
+    b1 = _cb(v, f"{name}_b1b", _cb(v, f"{name}_b1a", inp, w, 1), w, 3)
+    b2a = _cb(v, f"{name}_b2a", inp, w, 1)
+    b2 = _cb(v, f"{name}_b2c", _cb(v, f"{name}_b2b", b2a, w, 3), w, 3)
+    cat = _merge(v, f"{name}_cat", [b0, b1, b2])
+    up = _layer(v, f"{name}_up", cat,
+                Conv2D(filters=4 * w, kernel=1))  # linear projection
+    add = _scaled_residual(v, f"{name}_add", inp, up, 0.17)
+    return _layer(v, f"{name}_relu", add, ActivationLayer(activation="relu"))
+
+
+def _ir_block_b(v, name, inp, w, channels):
+    """17x17 Inception-ResNet-B: 1x1 / 1x1-1x7-7x1 branches."""
+    b0 = _cb(v, f"{name}_b0", inp, w, 1)
+    b1a = _cb(v, f"{name}_b1a", inp, w, 1)
+    b1b = _cb(v, f"{name}_b1b", b1a, w, (1, 7))
+    b1 = _cb(v, f"{name}_b1c", b1b, w, (7, 1))
+    cat = _merge(v, f"{name}_cat", [b0, b1])
+    up = _layer(v, f"{name}_up", cat, Conv2D(filters=channels, kernel=1))
+    add = _scaled_residual(v, f"{name}_add", inp, up, 0.10)
+    return _layer(v, f"{name}_relu", add, ActivationLayer(activation="relu"))
+
+
+def _ir_reduction_a(v, name, inp, w):
+    p = _layer(v, f"{name}_pool", inp,
+               Pooling2D(pool_type="max", window=3, stride=2, padding="SAME"))
+    c = _cb(v, f"{name}_c", inp, 2 * w, 3, stride=2)
+    d = _cb(v, f"{name}_d2", _cb(v, f"{name}_d1", inp, w, 1), 2 * w, 3,
+            stride=2)
+    return _merge(v, f"{name}_cat", [p, c, d])
+
+
+def inception_resnet_v1_config(
+    *, num_classes: int = 0, embedding: int = 128, width: int = 32,
+    blocks_a: int = 3, blocks_b: int = 5, input_shape=(160, 160, 3),
+    updater=None, dropout: float = 0.2, seed: int = 12345,
+) -> GraphConfig:
+    """↔ zoo InceptionResNetV1 (FaceNet): stem → A-blocks → Reduction-A →
+    B-blocks → pooled bottleneck embedding (num_classes=0) or softmax head.
+    Width/blocks are parametric (reference: width 32, 5×A, 10×B + C tower).
+    """
+    net = NeuralNetConfiguration(seed=seed, updater=updater,
+                                 weight_init="relu")
+    v: Dict[str, GraphVertex] = {}
+    w = width
+
+    x = _cb(v, "stem1", "input", w, 3, stride=2)
+    x = _cb(v, "stem2", x, w, 3)
+    x = _cb(v, "stem3", x, 2 * w, 3)
+    x = _layer(v, "stem_pool", x,
+               Pooling2D(pool_type="max", window=3, stride=2, padding="SAME"))
+    x = _cb(v, "stem4", x, 2 * w + w // 2, 1)
+    x = _cb(v, "stem5", x, 4 * w, 3)
+    # channels entering the A tower must equal the A-block projection (4w)
+    for i in range(blocks_a):
+        x = _ir_block_a(v, f"a{i}", x, w)
+    x = _ir_reduction_a(v, "red_a", x, 4 * w)
+    channels_b = 4 * w + 2 * (2 * 4 * w)  # pool + conv + double-conv branches
+    for i in range(blocks_b):
+        x = _ir_block_b(v, f"b{i}", x, 2 * w, channels_b)
+
+    x = _layer(v, "avgpool", x, GlobalPooling(pool_type="avg"))
+    if dropout:
+        x = _layer(v, "drop", x, Dropout(rate=dropout))
+    if num_classes:
+        v["output"] = GraphVertex(
+            kind="layer", inputs=[x],
+            layer=OutputLayer(units=num_classes, activation="softmax",
+                              loss="mcxent"))
+        outputs = ["output"]
+    else:
+        # FaceNet bottleneck: linear embedding (L2-normalized by callers).
+        # Inference/transfer surface only — to TRAIN, build with a softmax
+        # head (num_classes=N) and strip it afterward, the same recipe the
+        # reference's FaceNet path uses (GraphModel.loss_fn rejects this
+        # head with a clear error if fit directly).
+        x = _layer(v, "bottleneck", x, Dense(units=embedding,
+                                             activation="identity"))
+        outputs = [x]
+    return GraphConfig(net=net, inputs=["input"],
+                       input_shapes={"input": tuple(input_shape)},
+                       vertices=v, outputs=outputs)
+
+
+def inception_resnet_v1(**kw) -> GraphModel:
+    return GraphModel(inception_resnet_v1_config(**kw))
+
+
+# --- NASNet-A ---------------------------------------------------------------
+
+
+def _sep_block(v, name, inp, filters, kernel, stride=1):
+    """NASNet separable block: relu → sepconv → bn, twice (stride on 1st)."""
+    a = _layer(v, f"{name}_r1", inp, ActivationLayer(activation="relu"))
+    a = _layer(v, f"{name}_s1", a,
+               SeparableConv2D(filters=filters, kernel=kernel, stride=stride,
+                               padding="SAME", use_bias=False))
+    a = _layer(v, f"{name}_bn1", a, BatchNorm())
+    b = _layer(v, f"{name}_r2", a, ActivationLayer(activation="relu"))
+    b = _layer(v, f"{name}_s2", b,
+               SeparableConv2D(filters=filters, kernel=kernel, stride=1,
+                               padding="SAME", use_bias=False))
+    return _layer(v, f"{name}_bn2", b, BatchNorm())
+
+
+def _fit(v, name, inp, filters, stride=1):
+    """1x1 (optionally strided) projection so cell inputs agree in
+    shape/width (the role of NASNet's squeeze/adjust blocks)."""
+    a = _layer(v, f"{name}_r", inp, ActivationLayer(activation="relu"))
+    a = _layer(v, f"{name}_c", a,
+               Conv2D(filters=filters, kernel=1, stride=stride,
+                      use_bias=False))
+    return _layer(v, f"{name}_bn", a, BatchNorm())
+
+
+def _normal_cell(v, name, h, h_prev, filters):
+    """NASNet-A normal cell: 5 pairwise-add blocks over (h, h_prev)."""
+    h = _fit(v, f"{name}_fit_h", h, filters)
+    p = _fit(v, f"{name}_fit_p", h_prev, filters)
+    b1 = _merge(v, f"{name}_b1", [
+        _sep_block(v, f"{name}_b1l", h, filters, 3), h], kind="add")
+    b2 = _merge(v, f"{name}_b2", [
+        _sep_block(v, f"{name}_b2l", p, filters, 3),
+        _sep_block(v, f"{name}_b2r", h, filters, 5)], kind="add")
+    b3 = _merge(v, f"{name}_b3", [
+        _layer(v, f"{name}_b3l", p,
+               Pooling2D(pool_type="avg", window=3, stride=1,
+                         padding="SAME")), p], kind="add")
+    b4 = _merge(v, f"{name}_b4", [
+        _sep_block(v, f"{name}_b4l", p, filters, 5),
+        _sep_block(v, f"{name}_b4r", p, filters, 3)], kind="add")
+    b5 = _merge(v, f"{name}_b5", [
+        _layer(v, f"{name}_b5l", h,
+               Pooling2D(pool_type="avg", window=3, stride=1,
+                         padding="SAME")), h], kind="add")
+    out = _merge(v, f"{name}_out", [b1, b2, b3, b4, b5])
+    return out, h  # (cell output, new h_prev)
+
+
+def _reduction_cell(v, name, h, h_prev, filters):
+    h = _fit(v, f"{name}_fit_h", h, filters)
+    p = _fit(v, f"{name}_fit_p", h_prev, filters, stride=2)
+    b1 = _merge(v, f"{name}_b1", [
+        _sep_block(v, f"{name}_b1l", h, filters, 5, stride=2),
+        _sep_block(v, f"{name}_b1r", h, filters, 7, stride=2)], kind="add")
+    b2 = _merge(v, f"{name}_b2", [
+        _layer(v, f"{name}_b2l", h,
+               Pooling2D(pool_type="max", window=3, stride=2,
+                         padding="SAME")),
+        _sep_block(v, f"{name}_b2r", h, filters, 7, stride=2)], kind="add")
+    b3 = _merge(v, f"{name}_b3", [
+        _layer(v, f"{name}_b3l", h,
+               Pooling2D(pool_type="avg", window=3, stride=2,
+                         padding="SAME")),
+        _sep_block(v, f"{name}_b3r", h, filters, 5, stride=2)], kind="add")
+    b4 = _merge(v, f"{name}_b4", [
+        _layer(v, f"{name}_b4l", b1,
+               Pooling2D(pool_type="max", window=3, stride=1,
+                         padding="SAME")), b2], kind="add")
+    out = _merge(v, f"{name}_out", [b1, b3, b4])
+    return out, p
+
+
+def nasnet_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),
+                  penultimate_filters: int = 176, cells_per_stack: int = 2,
+                  stem_filters: int = 32, updater=None, dropout: float = 0.5,
+                  seed: int = 12345) -> GraphConfig:
+    """↔ zoo NASNet (NASNet-A). The mobile reference config is
+    penultimate_filters=1056, cells_per_stack=4, stem 32; defaults here are
+    narrower for single-host training, same cell topology."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater,
+                                 weight_init="relu")
+    v: Dict[str, GraphVertex] = {}
+    f = penultimate_filters // 24  # NASNet filter-scaling convention
+
+    x = _cb(v, "stem", "input", stem_filters, 3, stride=2, act="identity")
+    h, p = x, x
+    for i in range(cells_per_stack):
+        h, p = _normal_cell(v, f"n1_{i}", h, p, f)
+    h, p = _reduction_cell(v, "r1", h, p, 2 * f)
+    for i in range(cells_per_stack):
+        h, p = _normal_cell(v, f"n2_{i}", h, p, 2 * f)
+    h, p = _reduction_cell(v, "r2", h, p, 4 * f)
+    for i in range(cells_per_stack):
+        h, p = _normal_cell(v, f"n3_{i}", h, p, 4 * f)
+
+    x = _layer(v, "final_relu", h, ActivationLayer(activation="relu"))
+    x = _layer(v, "gap", x, GlobalPooling(pool_type="avg"))
+    if dropout:
+        x = _layer(v, "drop", x, Dropout(rate=dropout))
+    v["output"] = GraphVertex(
+        kind="layer", inputs=[x],
+        layer=OutputLayer(units=num_classes, activation="softmax",
+                          loss="mcxent"))
+    return GraphConfig(net=net, inputs=["input"],
+                       input_shapes={"input": tuple(input_shape)},
+                       vertices=v, outputs=["output"])
+
+
+def nasnet(**kw) -> GraphModel:
+    return GraphModel(nasnet_config(**kw))

@@ -1,0 +1,331 @@
+"""YOLOv2 object detection family (↔ org.deeplearning4j.zoo.model.{TinyYOLO,
+YOLO2} + org.deeplearning4j.nn.layers.objdetect.{Yolo2OutputLayer, YoloUtils}).
+
+TPU-first redesign of the reference's output layer:
+
+* The reference's label format is a [N, 4+C, H, W] channel-first tensor with
+  corner-coordinate boxes, decoded object-by-object on the host. Here labels
+  are a dense NHWC grid ``[N, gridH, gridW, 5+C]`` per cell:
+  ``(objectness, x, y, w, h, class one-hot)`` with x/y cell-relative in
+  [0,1] and w/h in grid units — one responsible object per cell (the YOLOv2
+  assumption). Everything in the loss is static-shape tensor algebra: the
+  responsible anchor per object cell is an argmax over shape-IOU with the
+  anchor priors, exactly darknet's rule, with no dynamic gather.
+* Box decode + NMS (``YoloUtils.getPredictedObjects`` role) are
+  jit-compatible: top-K via ``lax.top_k`` and a fixed-iteration NMS sweep —
+  no data-dependent shapes, so detection post-processing can run on-device.
+
+Loss terms follow YOLOv2: coord MSE (λ=5) on cell-relative xy and √wh of
+the responsible anchor, objectness MSE toward the live IOU, no-object
+confidence suppression (λ=0.5) outside a responsible anchor, and per-cell
+class cross-entropy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    LayerConfig,
+    NeuralNetConfiguration,
+    SequentialConfig,
+    register_config,
+)
+from deeplearning4j_tpu.nn.layers import (
+    BatchNorm,
+    Conv2D,
+    Pooling2D,
+    SpaceToDepth,
+)
+from deeplearning4j_tpu.nn.model import GraphModel, SequentialModel
+
+# anchor priors in grid units (↔ the reference zoo models' priorBoxes)
+TINY_YOLO_ANCHORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                     (9.42, 5.11), (16.62, 10.52))
+YOLO2_ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
+                 (7.88282, 3.52778), (9.77052, 9.16828))
+
+
+def _shape_iou(wh_a, wh_b):
+    """IOU of boxes sharing a center — darknet's anchor-assignment rule."""
+    inter = jnp.minimum(wh_a[..., 0], wh_b[..., 0]) * \
+        jnp.minimum(wh_a[..., 1], wh_b[..., 1])
+    union = wh_a[..., 0] * wh_a[..., 1] + wh_b[..., 0] * wh_b[..., 1] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def _box_iou(xy_a, wh_a, xy_b, wh_b):
+    """IOU of center-format boxes (same units both sides)."""
+    lo = jnp.maximum(xy_a - wh_a / 2, xy_b - wh_b / 2)
+    hi = jnp.minimum(xy_a + wh_a / 2, xy_b + wh_b / 2)
+    inter = jnp.prod(jnp.clip(hi - lo, 0.0), axis=-1)
+    union = (wh_a[..., 0] * wh_a[..., 1] + wh_b[..., 0] * wh_b[..., 1]
+             - inter)
+    return inter / jnp.maximum(union, 1e-9)
+
+
+@register_config
+@dataclass
+class Yolo2OutputLayer(LayerConfig):
+    """↔ org.deeplearning4j.nn.layers.objdetect.Yolo2OutputLayer.
+
+    Consumes a feature map ``[N, H, W, B*(5+C)]`` (B = len(anchors)).
+    ``apply`` returns decoded ``(xy, wh, conf, class_probs)`` concatenated
+    as ``[N, H, W, B, 5+C]``; ``compute_loss`` takes the dense grid labels
+    described in the module docstring.
+    """
+
+    anchors: Sequence[Tuple[float, float]] = field(
+        default_factory=lambda: TINY_YOLO_ANCHORS)
+    num_classes: int = 20
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        b = len(self.anchors)
+        assert c == b * (5 + self.num_classes), (
+            f"feature channels {c} != {b}*(5+{self.num_classes})")
+        return (h, w, b, 5 + self.num_classes)
+
+    def init(self, rng, input_shape, dtype):
+        return {}, {}
+
+    def _split(self, x):
+        n, h, w, c = x.shape
+        b = len(self.anchors)
+        x = x.reshape(n, h, w, b, 5 + self.num_classes)
+        txy, twh, to, tc = (x[..., 0:2], x[..., 2:4], x[..., 4],
+                            x[..., 5:])
+        anchors = jnp.asarray(self.anchors, x.dtype)
+        xy = jax.nn.sigmoid(txy)                       # cell-relative
+        wh = anchors * jnp.exp(jnp.clip(twh, -8, 8))   # grid units
+        return xy, wh, to, tc
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        xy, wh, to, tc = self._split(x)
+        out = jnp.concatenate(
+            [xy, wh, jax.nn.sigmoid(to)[..., None], jax.nn.softmax(tc, -1)],
+            axis=-1)
+        return out, state
+
+    def compute_loss(self, params, state, x, labels, *, mask=None,
+                     weights=None):
+        xy, wh, to, tc = self._split(x)        # [N,H,W,B,*]
+        obj = labels[..., 0]                   # [N,H,W]
+        txy = labels[..., 1:3]                 # cell-relative target
+        twh = labels[..., 3:5]                 # grid-unit target
+        tcls = labels[..., 5:]
+
+        anchors = jnp.asarray(self.anchors, x.dtype)   # [B,2]
+        # responsible anchor per object cell: best shape-IOU vs priors
+        prior_iou = _shape_iou(anchors[None, None, None, :, :],
+                               twh[..., None, :])      # [N,H,W,B]
+        resp = jax.nn.one_hot(jnp.argmax(prior_iou, -1), len(self.anchors),
+                              dtype=x.dtype)           # [N,H,W,B]
+        resp = resp * obj[..., None]
+
+        # live IOU of each predicted box vs the cell's target (same units)
+        live_iou = _box_iou(xy, wh, txy[..., None, :], twh[..., None, :])
+
+        sum_img = lambda a: jnp.sum(a, axis=tuple(range(1, a.ndim)))  # noqa: E731
+        coord = sum_img(resp[..., None] * (
+            jnp.square(xy - txy[..., None, :])
+            + jnp.square(jnp.sqrt(jnp.maximum(wh, 1e-9))
+                         - jnp.sqrt(jnp.maximum(twh[..., None, :], 1e-9)))))
+        conf_obj = sum_img(resp * jnp.square(jax.nn.sigmoid(to)
+                                             - jax.lax.stop_gradient(live_iou)))
+        conf_noobj = sum_img((1.0 - resp) * jnp.square(jax.nn.sigmoid(to)))
+        logp = jax.nn.log_softmax(tc, -1)
+        cls = -sum_img(resp[..., None] * tcls[..., None, :] * logp)
+
+        per_image = (self.lambda_coord * coord + conf_obj
+                     + self.lambda_noobj * conf_noobj + cls)   # [N]
+        w = mask if mask is not None else weights
+        if w is not None:
+            w = jnp.asarray(w, per_image.dtype).reshape(per_image.shape)
+            return jnp.sum(per_image * w) / jnp.maximum(jnp.sum(w), 1e-12)
+        return jnp.mean(per_image)
+
+
+def decode_predictions(decoded, *, top_k: int = 20):
+    """↔ YoloUtils.getPredictedObjects, jit-compatible.
+
+    decoded: Yolo2OutputLayer.apply output [N,H,W,B,5+C]. Returns
+    (boxes [N,K,4] as (x1,y1,x2,y2) in [0,1] image coords, scores [N,K],
+    classes [N,K] int32), top-K by confidence*class score.
+    """
+    n, h, w, b, _ = decoded.shape
+    top_k = min(top_k, h * w * b)
+    xy, wh = decoded[..., 0:2], decoded[..., 2:4]
+    conf, probs = decoded[..., 4], decoded[..., 5:]
+    cols = jnp.arange(w, dtype=decoded.dtype)
+    rows = jnp.arange(h, dtype=decoded.dtype)
+    cx = (xy[..., 0] + cols[None, None, :, None]) / w
+    cy = (xy[..., 1] + rows[None, :, None, None]) / h
+    bw = wh[..., 0] / w
+    bh = wh[..., 1] / h
+    cls_score = jnp.max(probs, -1) * conf
+    cls_id = jnp.argmax(probs, -1)
+
+    flat = lambda a: a.reshape(n, h * w * b)  # noqa: E731
+    scores, idx = jax.lax.top_k(flat(cls_score), top_k)
+    take = lambda a: jnp.take_along_axis(flat(a), idx, axis=1)  # noqa: E731
+    x1 = take(cx) - take(bw) / 2
+    y1 = take(cy) - take(bh) / 2
+    x2 = take(cx) + take(bw) / 2
+    y2 = take(cy) + take(bh) / 2
+    boxes = jnp.stack([x1, y1, x2, y2], -1)
+    return boxes, scores, jnp.take_along_axis(flat(cls_id), idx, axis=1)
+
+
+def non_max_suppression(boxes, scores, *, iou_threshold: float = 0.45):
+    """Fixed-iteration NMS over top-K candidates (static shapes, vmappable).
+
+    Returns ``keep`` [N,K] {0,1}: greedy suppression in score order — for
+    each candidate in descending-score order, drop it if it overlaps an
+    already-kept higher-scoring box above the threshold.
+    """
+
+    def one_image(bx, sc):
+        k = bx.shape[0]
+        order = jnp.argsort(-sc)
+        bx = bx[order]
+
+        x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+        area = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+        ix1 = jnp.maximum(x1[:, None], x1[None, :])
+        iy1 = jnp.maximum(y1[:, None], y1[None, :])
+        ix2 = jnp.minimum(x2[:, None], x2[None, :])
+        iy2 = jnp.minimum(y2[:, None], y2[None, :])
+        inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+
+        def body(i, keep):
+            # suppressed iff any kept earlier box overlaps too much
+            over = (iou[i] > iou_threshold) & (jnp.arange(k) < i) & (keep > 0)
+            return keep.at[i].set(jnp.where(jnp.any(over), 0.0, 1.0))
+
+        keep_sorted = jax.lax.fori_loop(0, k, body, jnp.ones((k,), bx.dtype))
+        inv = jnp.zeros_like(order).at[order].set(jnp.arange(k))
+        return keep_sorted[inv]
+
+    return jax.vmap(one_image)(boxes, scores)
+
+
+# --- zoo entries ------------------------------------------------------------
+
+
+def _cbl(filters, kernel):
+    return [Conv2D(filters=filters, kernel=kernel, use_bias=False),
+            BatchNorm(activation="leakyrelu")]
+
+
+def tiny_yolo_config(*, num_classes: int = 20, input_shape=(416, 416, 3),
+                     anchors=TINY_YOLO_ANCHORS, updater=None,
+                     seed: int = 12345) -> SequentialConfig:
+    """↔ zoo TinyYOLO: 9-conv darknet-tiny backbone, stride 32, B=5."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    b = len(anchors)
+    layers = []
+    for filters in (16, 32, 64, 128, 256):
+        layers += _cbl(filters, 3) + [Pooling2D(pool_type="max", window=2)]
+    layers += _cbl(512, 3)
+    layers += _cbl(1024, 3) + _cbl(1024, 3)
+    layers += [Conv2D(filters=b * (5 + num_classes), kernel=1),
+               Yolo2OutputLayer(anchors=tuple(anchors),
+                                num_classes=num_classes)]
+    return SequentialConfig(net=net, layers=layers, input_shape=input_shape)
+
+
+def tiny_yolo(**kw) -> SequentialModel:
+    return SequentialModel(tiny_yolo_config(**kw))
+
+
+def yolo2_config(*, num_classes: int = 80, input_shape=(608, 608, 3),
+                 anchors=YOLO2_ANCHORS, updater=None,
+                 seed: int = 12345) -> GraphConfig:
+    """↔ zoo YOLO2: darknet19 backbone + reorg passthrough (the 26x26
+    stage is space-to-depth'd and concatenated with the 13x13 head)."""
+    net = NeuralNetConfiguration(seed=seed, updater=updater, weight_init="relu")
+    b = len(anchors)
+    v = {}
+    x = "input"
+
+    def add(name, layer, inp):
+        v[name] = GraphVertex(kind="layer", inputs=[inp], layer=layer)
+        return name
+
+    def cbl(name, inp, filters, kernel):
+        c = add(f"{name}_c", Conv2D(filters=filters, kernel=kernel,
+                                    use_bias=False), inp)
+        return add(f"{name}_bn", BatchNorm(activation="leakyrelu"), c)
+
+    def pool(name, inp):
+        return add(name, Pooling2D(pool_type="max", window=2), inp)
+
+    x = cbl("s1", x, 32, 3)
+    x = pool("p1", x)
+    x = cbl("s2", x, 64, 3)
+    x = pool("p2", x)
+    for i, f in enumerate((128, 64, 128)):
+        x = cbl(f"s3_{i}", x, f, 3 if f == 128 else 1)
+    x = pool("p3", x)
+    for i, f in enumerate((256, 128, 256)):
+        x = cbl(f"s4_{i}", x, f, 3 if f == 256 else 1)
+    x = pool("p4", x)
+    for i, f in enumerate((512, 256, 512, 256, 512)):
+        x = cbl(f"s5_{i}", x, f, 3 if f == 512 else 1)
+    passthrough = x                      # 26x26x512 stage
+    x = pool("p5", x)
+    for i, f in enumerate((1024, 512, 1024, 512, 1024)):
+        x = cbl(f"s6_{i}", x, f, 3 if f == 1024 else 1)
+    x = cbl("head1", x, 1024, 3)
+    x = cbl("head2", x, 1024, 3)
+
+    reorg = add("reorg", SpaceToDepth(block_size=2), passthrough)
+    v["route"] = GraphVertex(kind="merge", inputs=[reorg, x])
+    x = cbl("head3", "route", 1024, 3)
+    x = add("head_out", Conv2D(filters=b * (5 + num_classes), kernel=1), x)
+    v["yolo"] = GraphVertex(
+        kind="layer", inputs=[x],
+        layer=Yolo2OutputLayer(anchors=tuple(anchors),
+                               num_classes=num_classes))
+    return GraphConfig(net=net, inputs=["input"],
+                       input_shapes={"input": tuple(input_shape)},
+                       vertices=v, outputs=["yolo"])
+
+
+def yolo2(**kw) -> GraphModel:
+    return GraphModel(yolo2_config(**kw))
+
+
+def make_yolo_labels(objects: List[List[Tuple[float, float, float, float, int]]],
+                     *, grid: Tuple[int, int], num_classes: int) -> np.ndarray:
+    """Host-side label builder: per image a list of (cx, cy, w, h, cls) in
+    [0,1] image coords → dense [N, gridH, gridW, 5+C] grid labels."""
+    gh, gw = grid
+    n = len(objects)
+    out = np.zeros((n, gh, gw, 5 + num_classes), np.float32)
+    for i, objs in enumerate(objects):
+        for (cx, cy, w, h, cls) in objs:
+            col = min(int(cx * gw), gw - 1)
+            row = min(int(cy * gh), gh - 1)
+            out[i, row, col, 0] = 1.0
+            out[i, row, col, 1] = cx * gw - col
+            out[i, row, col, 2] = cy * gh - row
+            out[i, row, col, 3] = w * gw
+            out[i, row, col, 4] = h * gh
+            out[i, row, col, 5 + cls] = 1.0
+    return out

@@ -304,6 +304,12 @@ class GraphModel:
         for name in out_names:
             v = self.config.vertices[name]
             x_in = values[v.inputs[0]]
+            if not hasattr(v.layer, "compute_loss"):
+                raise TypeError(
+                    f"output vertex {name!r} ({type(v.layer).__name__}) is "
+                    "not an output layer — inference-only heads (e.g. an "
+                    "embedding bottleneck) cannot be trained directly; add "
+                    "a loss head for training")
             loss = v.layer.compute_loss(
                 params.get(name, {}), state.get(name, {}), x_in, labels[name],
                 mask=batch.get("mask"), weights=batch.get("weights"),

@@ -111,6 +111,22 @@ class TestGraphZoo:
                           updater=Adam(1e-3)),
                  _image_batch((96, 96, 3), 10), steps=40)
 
+    def test_inception_resnet_v1(self):
+        from deeplearning4j_tpu.models.zoo import inception_resnet_v1
+
+        _overfit(inception_resnet_v1(num_classes=10, width=8, blocks_a=1,
+                                     blocks_b=1, input_shape=(64, 64, 3),
+                                     dropout=0.0, updater=Adam(1e-3)),
+                 _image_batch((64, 64, 3), 10), steps=60)
+
+    def test_nasnet(self):
+        from deeplearning4j_tpu.models.zoo import nasnet
+
+        _overfit(nasnet(num_classes=10, input_shape=(64, 64, 3),
+                        penultimate_filters=48, cells_per_stack=1,
+                        dropout=0.0, updater=Adam(1e-3)),
+                 _image_batch((64, 64, 3), 10), steps=60)
+
     def test_unet(self):
         from deeplearning4j_tpu.models.zoo import unet
 
