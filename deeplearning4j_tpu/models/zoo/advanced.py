@@ -201,27 +201,31 @@ def _normal_cell(v, name, h, h_prev, filters):
 
 
 def _reduction_cell(v, name, h, h_prev, filters):
+    """NASNet-A reduction cell: h_prev feeds the sep7x7/sep5x5 right-hand
+    branches of blocks 1-3 (paper topology), everything strided to /2."""
     h = _fit(v, f"{name}_fit_h", h, filters)
-    p = _fit(v, f"{name}_fit_p", h_prev, filters, stride=2)
+    p = _fit(v, f"{name}_fit_p", h_prev, filters)
     b1 = _merge(v, f"{name}_b1", [
         _sep_block(v, f"{name}_b1l", h, filters, 5, stride=2),
-        _sep_block(v, f"{name}_b1r", h, filters, 7, stride=2)], kind="add")
+        _sep_block(v, f"{name}_b1r", p, filters, 7, stride=2)], kind="add")
     b2 = _merge(v, f"{name}_b2", [
         _layer(v, f"{name}_b2l", h,
                Pooling2D(pool_type="max", window=3, stride=2,
                          padding="SAME")),
-        _sep_block(v, f"{name}_b2r", h, filters, 7, stride=2)], kind="add")
+        _sep_block(v, f"{name}_b2r", p, filters, 7, stride=2)], kind="add")
     b3 = _merge(v, f"{name}_b3", [
         _layer(v, f"{name}_b3l", h,
                Pooling2D(pool_type="avg", window=3, stride=2,
                          padding="SAME")),
-        _sep_block(v, f"{name}_b3r", h, filters, 5, stride=2)], kind="add")
+        _sep_block(v, f"{name}_b3r", p, filters, 5, stride=2)], kind="add")
     b4 = _merge(v, f"{name}_b4", [
         _layer(v, f"{name}_b4l", b1,
                Pooling2D(pool_type="max", window=3, stride=1,
                          padding="SAME")), b2], kind="add")
     out = _merge(v, f"{name}_out", [b1, b3, b4])
-    return out, p
+    # next cell's h_prev is this cell's strided h (shape-compatible)
+    hp = _fit(v, f"{name}_fit_hp", h, filters, stride=2)
+    return out, hp
 
 
 def nasnet_config(*, num_classes: int = 1000, input_shape=(224, 224, 3),

@@ -30,7 +30,7 @@ class Glove:
                  min_word_frequency: int = 5, x_max: float = 100.0,
                  alpha: float = 0.75, learning_rate: float = 0.05,
                  epochs: int = 5, batch_size: int = 4096, seed: int = 0,
-                 tokenizer: Optional[Callable] = None):
+                 tokenizer: Optional[Callable] = None, mesh=None):
         self.vector_size = vector_size
         self.window = window
         self.min_word_frequency = min_word_frequency
@@ -40,6 +40,7 @@ class Glove:
         self.epochs = epochs
         self.batch_size = batch_size
         self.seed = seed
+        self.mesh = mesh  # P5: shard tables over the mesh 'model' axis
         self.tokenizer = tokenizer or DefaultTokenizerFactory(CommonPreprocessor())
         self.vocab: Optional[VocabCache] = None
         self.vectors: Optional[np.ndarray] = None
@@ -91,7 +92,25 @@ class Glove:
                 lambda a, g, acc: a - lr * g / jnp.sqrt(acc), p, grads, g2)
             return p, g2, loss
 
-        jit_step = jax.jit(step, donate_argnums=(0, 1))
+        if self.mesh is not None:
+            # P5 role: all four tables are vocab-major → row-shard them on
+            # the mesh 'model' axis (replicate if vocab doesn't divide it).
+            from deeplearning4j_tpu.nlp.sharding import replicated, row_sharding
+
+            mesh = self.mesh
+            rep = replicated(mesh)
+            p_sh = jax.tree_util.tree_map(
+                lambda a: row_sharding(mesh, a.shape), params)
+            jit_step = jax.jit(
+                step, donate_argnums=(0, 1),
+                in_shardings=(p_sh, p_sh, rep, rep, rep),
+                out_shardings=(p_sh, p_sh, rep))
+            params = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(np.asarray(a), s), params, p_sh)
+            adagrad = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(np.asarray(a), s), adagrad, p_sh)
+        else:
+            jit_step = jax.jit(step, donate_argnums=(0, 1))
         p = jax.tree_util.tree_map(jnp.asarray, params)
         g2 = jax.tree_util.tree_map(jnp.asarray, adagrad)
         rng = np.random.default_rng(self.seed)

@@ -1,0 +1,28 @@
+"""Shared P5 embedding-sharding policy (SURVEY §2.6): vocab-major tables
+row-shard over the mesh 'model' axis; tables whose leading dim doesn't
+divide the axis stay replicated (GSPMD would otherwise require padding).
+Used by word2vec and glove — one definition so the fallback rule and any
+future padded-sharding support stay in lockstep."""
+
+from __future__ import annotations
+
+
+def model_axis(mesh) -> str:
+    return "model" if "model" in mesh.axis_names else mesh.axis_names[0]
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def row_sharding(mesh, shape):
+    """NamedSharding for one vocab-major array of ``shape``."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axis = model_axis(mesh)
+    if shape[0] % mesh.shape[axis] != 0:
+        return replicated(mesh)
+    spec = (axis,) + (None,) * (len(shape) - 1)
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -47,7 +47,7 @@ class _SGNSModel:
                      np.full((n_out, dim), 1e-6, np.float32))
         self._step = None
 
-    def _build_step(self, mode: str = "sg"):
+    def _build_step(self, mode: str = "sg", table_shardings=None):
         import jax
         import jax.numpy as jnp
 
@@ -89,20 +89,51 @@ class _SGNSModel:
             b = batch[0].shape[0]
             return new, acc, loss / b  # report per-example mean
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        if table_shardings is not None:
+            # P5 parameter-server role: embedding rows sharded on the mesh
+            # model axis; GSPMD turns the gathers/scatter-adds of the same
+            # step function into the cross-shard collectives the reference
+            # routed through VoidParameterServer messages.
+            rep = table_shardings[-1]
+            self._step = jax.jit(
+                step, donate_argnums=(0, 1),
+                in_shardings=(table_shardings[:2], table_shardings[:2],
+                              rep, rep),
+                out_shardings=(table_shardings[:2], table_shardings[:2], rep))
+        else:
+            self._step = jax.jit(step, donate_argnums=(0, 1))
 
     def train_epochs(self, batches_fn: Callable[[], Iterable], *, epochs: int,
-                     lr: float, lr_min: float, mode: str = "sg") -> List[float]:
+                     lr: float, lr_min: float, mode: str = "sg",
+                     mesh=None) -> List[float]:
         """batches_fn() yields tuples of arrays matching `mode`'s loss:
         sg: (center, context, negatives); cbow: (contexts, mask, center,
-        negatives)."""
+        negatives). ``mesh``: shard the embedding tables across the mesh's
+        'model' axis (SURVEY §2.6 P5 — the parameter-server-for-embeddings
+        role); tables whose vocab doesn't divide the axis stay replicated.
+        """
         import jax
         import jax.numpy as jnp
 
-        if self._step is None:
-            self._build_step(mode)
+        shardings = None
+        if mesh is not None:
+            from deeplearning4j_tpu.nlp.sharding import replicated, row_sharding
+
+            shardings = (row_sharding(mesh, self.in_vecs.shape),
+                         row_sharding(mesh, self.out_vecs.shape),
+                         replicated(mesh))
+        step_key = (mode, None if shardings is None else tuple(
+            str(s) for s in shardings))
+        if getattr(self, "_step_key", None) != step_key:
+            self._build_step(mode, table_shardings=shardings)
+            self._step_key = step_key
         tables = (jnp.asarray(self.in_vecs), jnp.asarray(self.out_vecs))
         acc = tuple(jnp.asarray(a) for a in self._acc)
+        if shardings is not None:
+            tables = tuple(jax.device_put(t, s)
+                           for t, s in zip(tables, shardings[:2]))
+            acc = tuple(jax.device_put(a, s)
+                        for a, s in zip(acc, shardings[:2]))
         history = []
         for e in range(epochs):
             cur_lr = lr - (lr - lr_min) * e / max(epochs - 1, 1)
@@ -151,7 +182,7 @@ class Word2Vec:
                  subsample: float = 1e-3, learning_rate: float = 0.025,
                  min_learning_rate: float = 1e-4, epochs: int = 1,
                  batch_size: int = 2048, cbow: bool = False, seed: int = 0,
-                 tokenizer: Optional[Callable] = None):
+                 tokenizer: Optional[Callable] = None, mesh=None):
         self.vector_size = vector_size
         self.window = window
         self.min_word_frequency = min_word_frequency
@@ -163,6 +194,7 @@ class Word2Vec:
         self.batch_size = batch_size
         self.cbow = cbow
         self.seed = seed
+        self.mesh = mesh  # P5: shard embedding tables over mesh 'model' axis
         self.tokenizer = tokenizer or DefaultTokenizerFactory(CommonPreprocessor())
         self.vocab: Optional[VocabCache] = None
         self._model: Optional[_SGNSModel] = None
@@ -205,7 +237,7 @@ class Word2Vec:
 
         return self._model.train_epochs(
             batches, epochs=self.epochs, lr=self.learning_rate,
-            lr_min=self.min_learning_rate, mode="sg")
+            lr_min=self.min_learning_rate, mode="sg", mesh=self.mesh)
 
     def _fit_cbow(self, encoded, rng) -> List[float]:
         """CBOW samples: (padded context window, mask, center word)."""
@@ -243,7 +275,7 @@ class Word2Vec:
 
         return self._model.train_epochs(
             batches, epochs=self.epochs, lr=self.learning_rate,
-            lr_min=self.min_learning_rate, mode="cbow")
+            lr_min=self.min_learning_rate, mode="cbow", mesh=self.mesh)
 
     # -- lookups (↔ WordVectors interface) ---------------------------------
 

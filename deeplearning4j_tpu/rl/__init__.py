@@ -5,18 +5,24 @@
 - policy: epsilon-greedy / greedy / Boltzmann action selection
 - qlearning: QLearningDiscrete (DQN, double-DQN, target network)
 - a2c: advantage actor-critic (n-step rollouts)
+- a3c: batched-worker A3C (the reference's async threads, vectorized)
+- td3: twin-delayed DDPG for continuous control
 """
 
-from deeplearning4j_tpu.rl.mdp import MDP, CartPole, Corridor
-from deeplearning4j_tpu.rl.replay import ReplayBuffer
-from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedyPolicy, GreedyPolicy
-from deeplearning4j_tpu.rl.qlearning import QLearningDiscrete, QLearningConfig
 from deeplearning4j_tpu.rl.a2c import A2C, A2CConfig
+from deeplearning4j_tpu.rl.a3c import A3CConfig, A3CDiscrete
+from deeplearning4j_tpu.rl.mdp import MDP, CartPole, Corridor, Pendulum
+from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedyPolicy, GreedyPolicy
+from deeplearning4j_tpu.rl.qlearning import QLearningConfig, QLearningDiscrete
+from deeplearning4j_tpu.rl.replay import ReplayBuffer
+from deeplearning4j_tpu.rl.td3 import TD3, TD3Config
 
 __all__ = [
-    "MDP", "CartPole", "Corridor",
+    "MDP", "CartPole", "Corridor", "Pendulum",
     "ReplayBuffer",
     "EpsGreedyPolicy", "GreedyPolicy", "BoltzmannPolicy",
     "QLearningDiscrete", "QLearningConfig",
     "A2C", "A2CConfig",
+    "A3CDiscrete", "A3CConfig",
+    "TD3", "TD3Config",
 ]

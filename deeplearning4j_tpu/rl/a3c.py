@@ -1,0 +1,162 @@
+"""A3C (↔ org.deeplearning4j.rl4j.learning.async.a3c.A3CDiscrete +
+AsyncGlobal/AsyncThread workers).
+
+TPU-first redesign of the reference's worker model: rl4j runs JVM actor
+THREADS that race gradient updates into a shared global network (Hogwild
+style). Races buy nothing on an accelerator whose update is one fused XLA
+program — so the workers here are a VECTOR of environments stepped in
+lockstep on the host, with one BATCHED jit'd forward serving every
+worker's policy and one fused update consuming all workers' n-step
+rollouts per iteration. Same estimator (n-step advantage actor-critic
+with entropy bonus), same worker-diversity effect (decorrelated
+experience from K parallel actors), deterministic instead of racy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.qlearning import (
+    adam_init,
+    adam_update,
+    mlp_apply,
+    mlp_init,
+)
+
+
+@dataclasses.dataclass
+class A3CConfig:
+    gamma: float = 0.99
+    learning_rate: float = 7e-4
+    n_steps: int = 8           # rollout length per worker per update
+    num_workers: int = 8       # ↔ rl4j numThreads
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+    hidden: Tuple[int, ...] = (64,)
+    seed: int = 0
+
+
+class A3CDiscrete:
+    """Batched-worker advantage actor-critic for discrete actions.
+
+    ``mdp_factory(worker_index) -> MDP`` builds one env per worker (the
+    reference's per-thread MDP instances; pass different seeds for
+    decorrelation).
+    """
+
+    def __init__(self, mdp_factory: Callable[[int], object],
+                 config: Optional[A3CConfig] = None):
+        self.config = cfg = config or A3CConfig()
+        self.envs = [mdp_factory(i) for i in range(cfg.num_workers)]
+        obs_dim = int(np.prod(self.envs[0].observation_shape))
+        self.action_count = self.envs[0].action_count
+        self.params = {
+            "trunk": mlp_init([obs_dim, *cfg.hidden], cfg.seed),
+            "pi": mlp_init([cfg.hidden[-1], self.action_count], cfg.seed + 1),
+            "v": mlp_init([cfg.hidden[-1], 1], cfg.seed + 2),
+        }
+        self._rng = np.random.default_rng(cfg.seed)
+        self._obs = np.stack([e.reset() for e in self.envs])
+        self.episode_returns: List[float] = []
+        self._acc = np.zeros(cfg.num_workers)
+        self._build()
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+
+        def forward(params, obs):          # obs [K, D] — every worker at once
+            h = jnp.maximum(mlp_apply(params["trunk"], obs), 0.0)
+            return mlp_apply(params["pi"], h), mlp_apply(params["v"], h)[..., 0]
+
+        def loss_fn(params, obs, actions, returns):
+            logits, value = forward(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            logp_a = jnp.take_along_axis(logp, actions[:, None], 1)[:, 0]
+            adv = returns - value
+            policy_loss = -jnp.mean(logp_a * jax.lax.stop_gradient(adv))
+            value_loss = jnp.mean(jnp.square(adv))
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, -1))
+            return (policy_loss + cfg.value_coef * value_loss
+                    - cfg.entropy_coef * entropy)
+
+        def step(params, opt, obs, actions, returns):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions,
+                                                      returns)
+            params, opt = adam_update(params, grads, opt, cfg.learning_rate)
+            return params, opt, loss
+
+        self._opt = adam_init(self.params)
+        self._jit_step = jax.jit(step, donate_argnums=(0, 1))
+        self._jit_forward = jax.jit(forward)
+
+    # -- acting --------------------------------------------------------------
+
+    def _act(self, obs_batch):
+        import jax
+
+        logits, values = self._jit_forward(self.params,
+                                           obs_batch.astype(np.float32))
+        logits = np.asarray(jax.device_get(logits))
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        acts = np.array([self._rng.choice(self.action_count, p=pi)
+                         for pi in p])
+        return acts, np.asarray(jax.device_get(values))
+
+    def train_iteration(self) -> float:
+        """One update: every worker contributes an n-step rollout."""
+        cfg = self.config
+        K, T = cfg.num_workers, cfg.n_steps
+        obs_buf = np.zeros((T, K) + (self._obs.shape[1],), np.float32)
+        act_buf = np.zeros((T, K), np.int64)
+        rew_buf = np.zeros((T, K), np.float32)
+        done_buf = np.zeros((T, K), np.float32)
+
+        for t in range(T):
+            acts, _ = self._act(self._obs)
+            obs_buf[t] = self._obs
+            act_buf[t] = acts
+            for k, env in enumerate(self.envs):
+                nobs, r, done, _ = env.step(int(acts[k]))
+                rew_buf[t, k] = r
+                done_buf[t, k] = float(done)
+                self._acc[k] += r
+                if done:
+                    self.episode_returns.append(self._acc[k])
+                    self._acc[k] = 0.0
+                    nobs = env.reset()
+                self._obs[k] = nobs
+
+        import jax
+
+        # V(s_T) bootstrap per worker: value head only (no policy sampling —
+        # a value query must not perturb the exploration RNG stream)
+        _, boot = self._jit_forward(self.params, self._obs.astype(np.float32))
+        boot = np.asarray(jax.device_get(boot))
+        rets = np.zeros((T, K), np.float32)
+        running = boot.copy()
+        for t in reversed(range(T)):
+            running = rew_buf[t] + cfg.gamma * running * (1.0 - done_buf[t])
+            rets[t] = running
+
+        self.params, self._opt, loss = self._jit_step(
+            self.params, self._opt,
+            obs_buf.reshape(T * K, -1), act_buf.reshape(T * K),
+            rets.reshape(T * K))
+        return float(jax.device_get(loss))
+
+    def train(self, iterations: int) -> List[float]:
+        return [self.train_iteration() for _ in range(iterations)]
+
+    def policy_action(self, obs) -> int:
+        import jax
+
+        logits, _ = self._jit_forward(self.params,
+                                      np.asarray(obs, np.float32)[None])
+        return int(np.argmax(np.asarray(jax.device_get(logits))[0]))

@@ -99,3 +99,42 @@ class Corridor:
         truncated = bool(self._t >= self.max_steps and not at_goal)
         return self._obs(), reward, bool(at_goal or truncated), \
             {"truncated": truncated}
+
+
+class Pendulum:
+    """Classic torque-limited pendulum swing-up (the continuous-control
+    staple rl4j's gym connector exposed). Box action in [-1,1]^1, scaled to
+    ±2 N·m torque. Episode is a 200-step time-limit truncation."""
+
+    observation_shape = (3,)
+    action_dim = 1
+
+    def __init__(self, seed: int = 0, max_steps: int = 200):
+        self._rng = np.random.default_rng(seed)
+        self.max_steps = max_steps
+        self._th = 0.0
+        self._thdot = 0.0
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._th), np.sin(self._th),
+                         self._thdot / 8.0], np.float32)
+
+    def reset(self) -> np.ndarray:
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._thdot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs()
+
+    def step(self, action):
+        g, m, l, dt = 10.0, 1.0, 1.0, 0.05
+        u = 2.0 * float(np.clip(np.asarray(action).ravel()[0], -1.0, 1.0))
+        th = ((self._th + np.pi) % (2 * np.pi)) - np.pi  # normalized angle
+        cost = th ** 2 + 0.1 * self._thdot ** 2 + 0.001 * u ** 2
+        self._thdot += (3 * g / (2 * l) * np.sin(self._th)
+                        + 3.0 / (m * l ** 2) * u) * dt
+        self._thdot = float(np.clip(self._thdot, -8.0, 8.0))
+        self._th += self._thdot * dt
+        self._t += 1
+        truncated = self._t >= self.max_steps
+        return self._obs(), -float(cost), truncated, {"truncated": truncated}

@@ -182,3 +182,28 @@ class QLearningDiscrete:
                 self.target_params = jax.tree_util.tree_map(
                     lambda x: x, self.params)
         return episode_rewards
+
+
+def adam_init(params):
+    """Shared Adam state for the rl learners (a2c/a3c/td3): (m, v, t)."""
+    import jax
+    import jax.numpy as jnp
+
+    z = jax.tree_util.tree_map(jnp.zeros_like,
+                               jax.tree_util.tree_map(jnp.asarray, params))
+    return z, jax.tree_util.tree_map(jnp.zeros_like, z), jnp.zeros((), jnp.int32)
+
+
+def adam_update(params, grads, opt, lr, *, b1=0.9, b2=0.999, eps=1e-8):
+    """One bias-corrected Adam step; returns (params, opt). jit-safe."""
+    import jax
+    import jax.numpy as jnp
+
+    m, v, t = opt
+    t = t + 1
+    m = jax.tree_util.tree_map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+    v = jax.tree_util.tree_map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+    params = jax.tree_util.tree_map(
+        lambda p, a, bb: p - lr * (a / (1 - b1 ** t))
+        / (jnp.sqrt(bb / (1 - b2 ** t)) + eps), params, m, v)
+    return params, (m, v, t)

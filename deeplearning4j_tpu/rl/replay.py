@@ -13,11 +13,14 @@ import numpy as np
 
 class ReplayBuffer:
     def __init__(self, capacity: int, observation_shape: Tuple[int, ...],
-                 seed: int = 0):
+                 seed: int = 0, *, action_shape: Tuple[int, ...] = (),
+                 action_dtype=np.int32):
+        """``action_shape=()``/int32 for discrete learners (DQN);
+        continuous learners (TD3/DDPG) pass a vector shape + float32."""
         self.capacity = capacity
         self.obs = np.zeros((capacity, *observation_shape), np.float32)
         self.next_obs = np.zeros((capacity, *observation_shape), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        self.actions = np.zeros((capacity, *action_shape), action_dtype)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.float32)
         self._n = 0

@@ -1,0 +1,196 @@
+"""TD3 — twin-delayed deep deterministic policy gradient
+(↔ org.deeplearning4j.rl4j's continuous-control (DDPG-family) role; TD3 is
+the fixed-up successor with the three stabilizers below).
+
+All three TD3 mechanisms, fused into two jit'd programs (critic step every
+iteration; actor + polyak target update every ``policy_delay``):
+
+1. clipped double-Q: TD target uses min(Q1', Q2')
+2. delayed policy updates
+3. target policy smoothing: clipped gaussian noise on the target action
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.qlearning import (
+    adam_init,
+    adam_update,
+    mlp_apply,
+    mlp_init,
+)
+from deeplearning4j_tpu.rl.replay import ReplayBuffer
+
+
+@dataclasses.dataclass
+class TD3Config:
+    gamma: float = 0.99
+    tau: float = 0.005              # polyak for target nets
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    policy_delay: int = 2
+    policy_noise: float = 0.2       # target smoothing sigma
+    noise_clip: float = 0.5
+    explore_noise: float = 0.1
+    batch_size: int = 128
+    buffer_size: int = 100_000
+    warmup_steps: int = 500
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+
+class TD3:
+    """Continuous-control learner over one MDP with box actions in [-1,1]^A.
+
+    mdp protocol: reset() -> obs; step(action: np.ndarray) ->
+    (obs, reward, done, info); attributes observation_shape, action_dim.
+    """
+
+    def __init__(self, mdp, config: Optional[TD3Config] = None):
+        self.mdp = mdp
+        self.config = cfg = config or TD3Config()
+        obs_dim = int(np.prod(mdp.observation_shape))
+        self.act_dim = act_dim = mdp.action_dim
+
+        self.params = {
+            "actor": mlp_init([obs_dim, *cfg.hidden, act_dim], cfg.seed),
+            "q1": mlp_init([obs_dim + act_dim, *cfg.hidden, 1], cfg.seed + 1),
+            "q2": mlp_init([obs_dim + act_dim, *cfg.hidden, 1], cfg.seed + 2),
+        }
+        self.buffer = ReplayBuffer(cfg.buffer_size, mdp.observation_shape,
+                                   seed=cfg.seed, action_shape=(act_dim,),
+                                   action_dtype=np.float32)
+        self._rng = np.random.default_rng(cfg.seed)
+        self.total_steps = 0
+        self.episode_returns: List[float] = []
+        self._build()
+
+    # -- jit programs --------------------------------------------------------
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+
+        def actor(params, obs):
+            return jnp.tanh(mlp_apply(params["actor"], obs))
+
+        def q(params, key, obs, act):
+            return mlp_apply(params[key],
+                             jnp.concatenate([obs, act], -1))[..., 0]
+
+        def critic_step(params, targets, copt, rng, batch):
+            obs, act, rew, nobs, done = batch
+
+            noise = jnp.clip(
+                cfg.policy_noise * jax.random.normal(rng, act.shape),
+                -cfg.noise_clip, cfg.noise_clip)
+            next_act = jnp.clip(actor(targets, nobs) + noise, -1.0, 1.0)
+            tq = jnp.minimum(q(targets, "q1", nobs, next_act),
+                             q(targets, "q2", nobs, next_act))
+            target = rew + cfg.gamma * (1.0 - done) * tq
+
+            def loss_fn(critics):
+                p = {**params, **critics}
+                l1 = jnp.mean(jnp.square(q(p, "q1", obs, act) - target))
+                l2 = jnp.mean(jnp.square(q(p, "q2", obs, act) - target))
+                return l1 + l2
+
+            critics = {"q1": params["q1"], "q2": params["q2"]}
+            loss, grads = jax.value_and_grad(loss_fn)(critics)
+            critics, copt = adam_update(critics, grads, copt, cfg.critic_lr)
+            return {**params, **critics}, copt, loss
+
+        def actor_step(params, targets, aopt, obs):
+            def loss_fn(actor_p):
+                a = actor({"actor": actor_p["actor"]}, obs)
+                return -jnp.mean(q(params, "q1", obs, a))
+
+            actor_p = {"actor": params["actor"]}
+            loss, grads = jax.value_and_grad(loss_fn)(actor_p)
+            actor_p, aopt = adam_update(actor_p, grads, aopt, cfg.actor_lr)
+            params = {**params, **actor_p}
+            targets = jax.tree_util.tree_map(
+                lambda t, p: (1 - cfg.tau) * t + cfg.tau * p, targets, params)
+            return params, targets, aopt, loss
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, self.params)
+        self.targets = jax.tree_util.tree_map(lambda a: a.copy(), self.params)
+        self._copt = adam_init({"q1": self.params["q1"],
+                                "q2": self.params["q2"]})
+        self._aopt = adam_init({"actor": self.params["actor"]})
+        self._jit_critic = jax.jit(critic_step)
+        self._jit_actor = jax.jit(actor_step)
+        self._jit_act = jax.jit(actor)
+        self._key = jax.random.key(cfg.seed)
+
+    # -- interaction ---------------------------------------------------------
+
+    def action(self, obs, *, explore: bool = True) -> np.ndarray:
+        import jax
+
+        a = np.asarray(jax.device_get(self._jit_act(
+            {"actor": self.params["actor"]},
+            np.asarray(obs, np.float32)[None])))[0]
+        if explore:
+            a = a + self._rng.normal(0, self.config.explore_noise, a.shape)
+        return np.clip(a, -1.0, 1.0).astype(np.float32)
+
+    def train(self, env_steps: int) -> None:
+        """Resumable: an episode in flight from a previous train() call
+        continues — chunked train(n)+train(m) equals train(n+m)."""
+        import jax
+
+        cfg = self.config
+        if getattr(self, "_obs", None) is None:
+            self._obs = self.mdp.reset()
+            self._acc = 0.0
+        obs, acc = self._obs, self._acc
+        for _ in range(env_steps):
+            if self.total_steps < cfg.warmup_steps:
+                act = self._rng.uniform(-1, 1, self.act_dim).astype(np.float32)
+            else:
+                act = self.action(obs)
+            nobs, rew, done, info = self.mdp.step(act)
+            acc += rew
+            # time-limit truncations bootstrap; real terminals do not
+            store_done = 0.0 if info.get("truncated") else float(done)
+            self.buffer.add(obs, act, rew, nobs, store_done)
+            obs = nobs
+            self.total_steps += 1
+            if done:
+                self.episode_returns.append(acc)
+                acc = 0.0
+                obs = self.mdp.reset()
+
+            if (self.total_steps >= cfg.warmup_steps
+                    and len(self.buffer) >= cfg.batch_size):
+                batch = self.buffer.sample(cfg.batch_size)
+                self._key, sub = jax.random.split(self._key)
+                self.params, self._copt, _ = self._jit_critic(
+                    self.params, self.targets, self._copt, sub, batch)
+                if self.total_steps % cfg.policy_delay == 0:
+                    self.params, self.targets, self._aopt, _ = \
+                        self._jit_actor(self.params, self.targets, self._aopt,
+                                        batch[0])
+        self._obs, self._acc = obs, acc
+
+    def evaluate(self, episodes: int = 5) -> float:
+        # evaluation drives the same (stateful) env — the training episode
+        # in flight is void after this, so drop it rather than resume a
+        # mismatched (obs, env-state) pair
+        self._obs = None
+        total = 0.0
+        for _ in range(episodes):
+            obs = self.mdp.reset()
+            done = False
+            while not done:
+                obs, rew, done, _ = self.mdp.step(
+                    self.action(obs, explore=False))
+                total += rew
+        return total / episodes
