@@ -32,12 +32,18 @@ from deeplearning4j_tpu.nn.config import (
 from deeplearning4j_tpu.nn.layers.conv import (
     Conv1D,
     Conv2D,
+    Conv3D,
+    Cropping1D,
     Cropping2D,
+    Deconv2D,
     DepthwiseConv2D,
     GlobalPooling,
+    Pooling1D,
     Pooling2D,
     SeparableConv2D,
+    Upsampling1D,
     Upsampling2D,
+    ZeroPadding1D,
     ZeroPadding2D,
 )
 from deeplearning4j_tpu.nn.layers.core import (
@@ -46,6 +52,9 @@ from deeplearning4j_tpu.nn.layers.core import (
     Dropout,
     Embedding,
     Flatten,
+    Permute,
+    PReLU,
+    RepeatVector,
     Reshape,
 )
 from deeplearning4j_tpu.nn.layers.norm import BatchNorm, LayerNorm
@@ -61,7 +70,7 @@ _ACTIVATIONS = {
     "softmax": "softmax", "linear": "identity", "elu": "elu", "selu": "selu",
     "softplus": "softplus", "softsign": "softsign", "gelu": "gelu",
     "swish": "swish", "silu": "swish", "exponential": "exp",
-    "hard_sigmoid": "hard_sigmoid", "leaky_relu": "leaky_relu",
+    "hard_sigmoid": "hardsigmoid", "leaky_relu": "leakyrelu02",
     "mish": "mish",
 }
 
@@ -156,6 +165,10 @@ def _pool(kind):
 
 def _global_pool(kind):
     def mapper(cfg):
+        if cfg.get("keepdims"):
+            raise KerasImportError(
+                "global pooling with keepdims=True not supported (keras "
+                "keeps the pooled axes; our GlobalPooling drops them)")
         return GlobalPooling(pool_type=kind), {}
 
     return mapper
@@ -314,6 +327,149 @@ def _cropping(cfg):
     return Cropping2D(cropping=_flat4(cfg.get("cropping", 0))), {}
 
 
+def _conv2d_transpose(cfg):
+    if cfg.get("data_format") not in (None, "channels_last"):
+        raise KerasImportError("channels_first Conv2DTranspose not supported")
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise KerasImportError("dilated Conv2DTranspose not supported")
+    if cfg.get("output_padding") not in (None, [None, None]):
+        raise KerasImportError(
+            "Conv2DTranspose output_padding not supported")
+    return Deconv2D(
+        filters=cfg["filters"], kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), padding=_padding(cfg),
+        activation=_act(cfg.get("activation")),
+        use_bias=cfg.get("use_bias", True),
+        # keras stores the transpose kernel (kh, kw, OUT, IN) with
+        # gradient-of-conv semantics; our lax.conv_transpose takes
+        # (kh, kw, IN, OUT) unflipped — so spatially flip + swap IO
+        # (verified against tf.nn.conv2d_transpose for SAME/VALID, s=1/2)
+    ), {"W": ("kernel",
+              lambda w: np.ascontiguousarray(
+                  w[::-1, ::-1].transpose(0, 1, 3, 2))),
+        "b": ("bias", None)}
+
+
+def _conv3d(cfg):
+    if cfg.get("data_format") not in (None, "channels_last"):
+        raise KerasImportError("channels_first Conv3D not supported")
+    ks = cfg["kernel_size"]
+    return Conv3D(
+        filters=cfg["filters"],
+        kernel=tuple(ks) if isinstance(ks, (list, tuple)) else ks,
+        stride=tuple(cfg["strides"]) if isinstance(cfg.get("strides"),
+                                                   (list, tuple))
+        else cfg.get("strides", 1),
+        padding=_padding(cfg), activation=_act(cfg.get("activation")),
+        use_bias=cfg.get("use_bias", True),
+    ), {"W": ("kernel", None), "b": ("bias", None)}
+
+
+def _pool1d(kind):
+    def mapper(cfg):
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError(
+                f"channels_first {kind} 1D pooling not supported")
+
+        def one(v, default):
+            v = cfg.get(v) or default
+            return v[0] if isinstance(v, (list, tuple)) else v
+
+        return Pooling1D(
+            pool_type=kind, window=one("pool_size", 2),
+            stride=one("strides", cfg.get("pool_size", 2)),
+            padding=_padding(cfg)), {}
+
+    return mapper
+
+
+def _adv_activation(name, alpha_keys=(), default=None):
+    """alpha_keys: tried in order (keras 3 vs keras 2 config names)."""
+
+    def mapper(cfg):
+        alpha = default
+        for k in alpha_keys:
+            if cfg.get(k) is not None:
+                alpha = float(cfg[k])
+                break
+        return ActivationLayer(activation=name, alpha=alpha), {}
+
+    return mapper
+
+
+def _relu_layer(cfg):
+    if cfg.get("threshold"):
+        raise KerasImportError("ReLU threshold != 0 not supported")
+    if cfg.get("max_value") is not None:
+        if float(cfg["max_value"]) == 6.0 and not cfg.get("negative_slope"):
+            return ActivationLayer(activation="relu6"), {}
+        raise KerasImportError("ReLU max_value != 6 not supported")
+    if cfg.get("negative_slope"):
+        return ActivationLayer(activation="leakyrelu",
+                               alpha=float(cfg["negative_slope"])), {}
+    return ActivationLayer(activation="relu"), {}
+
+
+def _softmax_layer(cfg):
+    if cfg.get("axis", -1) != -1:
+        raise KerasImportError("Softmax over a non-last axis not supported")
+    return ActivationLayer(activation="softmax"), {}
+
+
+def _prelu(cfg):
+    if cfg.get("shared_axes"):
+        raise KerasImportError("PReLU shared_axes not supported")
+    return PReLU(), {"alpha": ("alpha", None)}
+
+
+def _noise(kind, key, default, as_stddev=False):
+    def mapper(cfg):
+        val = cfg.get(key, default)
+        if as_stddev:
+            return Dropout(rate=0.0, kind=kind, stddev=val), {}
+        return Dropout(rate=val, kind=kind), {}
+
+    return mapper
+
+
+def _repeat_vector(cfg):
+    return RepeatVector(n=cfg["n"]), {}
+
+
+def _permute(cfg):
+    return Permute(dims=tuple(cfg["dims"])), {}
+
+
+def _zeropad1d(cfg):
+    p = cfg.get("padding", 1)
+    return ZeroPadding1D(padding=tuple(p) if isinstance(p, (list, tuple))
+                         else p), {}
+
+
+def _cropping1d(cfg):
+    c = cfg.get("cropping", 1)
+    return Cropping1D(cropping=tuple(c) if isinstance(c, (list, tuple))
+                      else c), {}
+
+
+def _upsampling1d(cfg):
+    return Upsampling1D(size=cfg.get("size", 2)), {}
+
+
+def _time_distributed(cfg):
+    """TimeDistributed(inner): our Dense/Activation/Dropout already map over
+    every leading axis, so the wrapper unwraps to the inner layer. Inner
+    layers with spatial semantics (convs) would need real reshaping —
+    refuse those."""
+    inner = cfg.get("layer", {})
+    cls = inner.get("class_name")
+    if cls not in ("Dense", "Activation", "Dropout"):
+        raise KerasImportError(
+            f"TimeDistributed({cls}) not supported (Dense/Activation/"
+            "Dropout unwrap; spatial inner layers need reshaping)")
+    return LAYER_MAPPERS[cls](inner.get("config", {}))
+
+
 LAYER_MAPPERS: Dict[str, Callable] = {
     "Dense": _dense,
     "Conv2D": _conv2d,
@@ -340,12 +496,39 @@ LAYER_MAPPERS: Dict[str, Callable] = {
     "ZeroPadding2D": _zeropad,
     "UpSampling2D": _upsample,
     "Cropping2D": _cropping,
+    # --- breadth beyond the r2 set (≈ the reference's ~60-mapper surface)
+    "Conv2DTranspose": _conv2d_transpose,
+    "Convolution2DTranspose": _conv2d_transpose,
+    "Conv3D": _conv3d,
+    "Convolution3D": _conv3d,
+    "MaxPooling1D": _pool1d("max"),
+    "AveragePooling1D": _pool1d("avg"),
+    "GlobalMaxPooling1D": _global_pool("max"),
+    "LeakyReLU": _adv_activation("leakyrelu", ("negative_slope", "alpha"), 0.3),
+    "ELU": _adv_activation("elu", ("alpha",), 1.0),
+    "ThresholdedReLU": _adv_activation("thresholdedrelu", ("theta",), 1.0),
+    "ReLU": _relu_layer,
+    "Softmax": _softmax_layer,
+    "PReLU": _prelu,
+    "GaussianNoise": _noise("gaussian_noise", "stddev", 0.1, as_stddev=True),
+    "GaussianDropout": _noise("gaussian_dropout", "rate", 0.5),
+    "AlphaDropout": _noise("alpha", "rate", 0.5),
+    "SpatialDropout1D": _dropout,
+    "RepeatVector": _repeat_vector,
+    "Permute": _permute,
+    "ZeroPadding1D": _zeropad1d,
+    "Cropping1D": _cropping1d,
+    "UpSampling1D": _upsampling1d,
+    "TimeDistributed": _time_distributed,
+    "ActivityRegularization": lambda cfg: (
+        ActivationLayer(activation="identity"), {}),
 }
 
 # functional merge layers → GraphVertex kinds
 MERGE_KINDS = {
     "Add": "add", "Concatenate": "merge", "Multiply": "mul",
-    "Average": "average", "Maximum": "max", "Subtract": "subtract",
+    "Average": "average", "Maximum": "max", "Minimum": "min",
+    "Subtract": "subtract",
 }
 
 

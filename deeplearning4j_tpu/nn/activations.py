@@ -36,6 +36,11 @@ ACTIVATIONS: dict[str, Callable] = {
     "hardtanh": opsnn.hard_tanh,
     "leakyrelu": opsnn.leaky_relu,
     "hardswish": opsnn.hard_swish,
+    "exp": jnp.exp,  # keras 'exponential'
+    # keras' leaky_relu ACTIVATION STRING fixes negative_slope=0.2 (unlike
+    # its LeakyReLU layer default 0.3 and jax's 0.01) — exact-match alias
+    # for the import path
+    "leakyrelu02": lambda x: opsnn.leaky_relu(x, 0.2),
     "thresholdedrelu": opsnn.thresholded_relu,
     "rationaltanh": opsnn.rational_tanh,
     "rectifiedtanh": opsnn.rectified_tanh,

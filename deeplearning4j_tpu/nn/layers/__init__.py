@@ -4,14 +4,18 @@ from deeplearning4j_tpu.nn.layers.conv import (
     Conv1D,
     Conv2D,
     Conv3D,
+    Cropping1D,
     Cropping2D,
     Deconv2D,
     DepthwiseConv2D,
     GlobalPooling,
+    Pooling1D,
     Pooling2D,
     SeparableConv2D,
     SpaceToDepth,
+    Upsampling1D,
     Upsampling2D,
+    ZeroPadding1D,
     ZeroPadding2D,
 )
 from deeplearning4j_tpu.nn.layers.attention import (
@@ -27,8 +31,14 @@ from deeplearning4j_tpu.nn.layers.core import (
     ElementWiseMultiplication,
     Embedding,
     Flatten,
+    Permute,
     PReLU,
+    RepeatVector,
     Reshape,
+)
+from deeplearning4j_tpu.nn.layers.samediff_layer import (
+    SameDiffLambdaLayer,
+    SameDiffLayer,
 )
 from deeplearning4j_tpu.nn.layers.norm import (
     BatchNorm,
@@ -47,10 +57,12 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 
 __all__ = [
     "ActivationLayer", "Dense", "Dropout", "ElementWiseMultiplication",
-    "Embedding", "Flatten", "PReLU", "Reshape",
-    "Conv1D", "Conv2D", "Conv3D", "Cropping2D", "Deconv2D", "DepthwiseConv2D",
-    "GlobalPooling", "Pooling2D", "SeparableConv2D", "SpaceToDepth",
-    "Upsampling2D", "ZeroPadding2D",
+    "Embedding", "Flatten", "Permute", "PReLU", "RepeatVector", "Reshape",
+    "SameDiffLayer", "SameDiffLambdaLayer",
+    "Conv1D", "Conv2D", "Conv3D", "Cropping1D", "Cropping2D", "Deconv2D",
+    "DepthwiseConv2D", "GlobalPooling", "Pooling1D", "Pooling2D",
+    "SeparableConv2D", "SpaceToDepth",
+    "Upsampling1D", "Upsampling2D", "ZeroPadding1D", "ZeroPadding2D",
     "BatchNorm", "LayerNorm", "LocalResponseNormalization",
     "LossLayer", "OutputLayer", "RnnOutputLayer",
     "GRU", "LSTM", "Bidirectional", "GravesLSTM", "LastTimeStep", "SimpleRnn",

@@ -424,3 +424,103 @@ class SpaceToDepth(LayerConfig):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         return opscnn.space_to_depth(x, self.block_size), state
+
+
+@register_config
+@dataclass
+class Pooling1D(LayerConfig):
+    """↔ Subsampling1DLayer: pooling over the time axis of [N, T, C]."""
+
+    pool_type: str = "max"
+    window: int = 2
+    stride: Optional[int] = None
+    padding: str = "VALID"
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        s = self.stride if self.stride is not None else self.window
+        return (_conv_out(t, self.window, s, self.padding.upper()), c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        stride = self.stride if self.stride is not None else self.window
+        y = x[:, :, None, :]  # [N, T, 1, C] — reuse the 2D pooling kernels
+        if self.pool_type == "max":
+            y = opscnn.max_pool2d(y, (self.window, 1), (stride, 1), self.padding)
+        elif self.pool_type == "avg":
+            y = opscnn.avg_pool2d(y, (self.window, 1), (stride, 1), self.padding)
+        else:
+            raise ValueError(f"unknown pool type {self.pool_type}")
+        return y[:, :, 0, :], state
+
+
+@register_config
+@dataclass
+class ZeroPadding1D(LayerConfig):
+    """↔ ZeroPadding1DLayer: pad the time axis of [N, T, C]."""
+
+    padding: Union[int, Sequence[int]] = 1
+
+    @property
+    def has_params(self):
+        return False
+
+    def _pads(self):
+        p = self.padding
+        return (p, p) if isinstance(p, int) else tuple(p)
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        lo, hi = self._pads()
+        return (t + lo + hi, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        lo, hi = self._pads()
+        return jnp.pad(x, ((0, 0), (lo, hi), (0, 0))), state
+
+
+@register_config
+@dataclass
+class Cropping1D(LayerConfig):
+    """↔ Cropping1D: crop the time axis of [N, T, C]."""
+
+    cropping: Union[int, Sequence[int]] = 1
+
+    @property
+    def has_params(self):
+        return False
+
+    def _crops(self):
+        c = self.cropping
+        return (c, c) if isinstance(c, int) else tuple(c)
+
+    def output_shape(self, input_shape):
+        t, ch = input_shape
+        lo, hi = self._crops()
+        return (t - lo - hi, ch)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        lo, hi = self._crops()
+        return x[:, lo:x.shape[1] - hi, :], state
+
+
+@register_config
+@dataclass
+class Upsampling1D(LayerConfig):
+    """↔ Upsampling1D: repeat each timestep ``size`` times."""
+
+    size: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        return (t * self.size, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.repeat(x, self.size, axis=1), state

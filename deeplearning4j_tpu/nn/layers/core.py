@@ -54,15 +54,29 @@ class Dense(LayerConfig):
 @register_config
 @dataclass
 class ActivationLayer(LayerConfig):
-    """↔ ActivationLayer — apply an activation with no params."""
+    """↔ ActivationLayer — apply an activation with no params.
+
+    ``alpha`` parameterizes the activations that take one (leakyrelu's
+    negative slope, elu's alpha, thresholdedrelu's theta); None keeps each
+    function's default."""
 
     activation: str = "relu"
+    alpha: Optional[float] = None
 
     @property
     def has_params(self):
         return False
 
     def apply(self, params, state, x, *, train=False, rng=None):
+        if self.alpha is not None:
+            name = self.activation.lower()
+            if name == "leakyrelu":
+                return opsnn.leaky_relu(x, self.alpha), state
+            if name == "elu":
+                return opsnn.elu(x, self.alpha), state
+            if name == "thresholdedrelu":
+                return opsnn.thresholded_relu(x, self.alpha), state
+            raise ValueError(f"activation {name!r} takes no alpha")
         return get_activation(self.activation)(x), state
 
 
@@ -181,3 +195,40 @@ class PReLU(LayerConfig):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         return opsnn.prelu(x, params["alpha"]), state
+
+
+@register_config
+@dataclass
+class RepeatVector(LayerConfig):
+    """↔ RepeatVector: [N, D] → [N, n, D]."""
+
+    n: int = 1
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        return (self.n, *input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+
+@register_config
+@dataclass
+class Permute(LayerConfig):
+    """↔ PermutePreprocessor / keras Permute. ``dims`` are 1-indexed over
+    the non-batch axes (keras convention): (2, 1) swaps the first two."""
+
+    dims: tuple = (1,)
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[d - 1] for d in self.dims)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.transpose(x, (0, *[d for d in self.dims])), state

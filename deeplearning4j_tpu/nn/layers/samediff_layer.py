@@ -1,0 +1,119 @@
+"""SameDiffLayer — custom-layer escape hatch
+(↔ org.deeplearning4j.nn.conf.layers.samediff.{SameDiffLayer,
+SameDiffLambdaLayer}).
+
+The reference lets users drop a hand-defined SameDiff graph into a network
+as a layer: declare parameters, define the forward graph, and the framework
+derives gradients. Same contract here: subclass and implement
+
+    define_parameters(input_shape) -> {name: shape}
+    define_layer(sd, x, params)    -> SDVariable   (build the graph)
+
+or, for the parameter-free lambda variant, pass ``forward_fn`` to
+``SameDiffLambdaLayer``. The graph is built ONCE per input shape; execution
+replays it as pure jax inside the model's traced apply, so jax.grad/jit/
+pjit see straight through it — the custom layer trains and shards like any
+built-in layer (no per-op host boundary, unlike the reference's
+op-by-op SameDiff session).
+
+Note: the graph is built with a batch dim of 1 and replayed shape-
+polymorphically; avoid baking literal batch sizes into reshapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+
+
+@register_config
+@dataclass
+class SameDiffLayer(LayerConfig):
+    """Base class: subclass, implement define_parameters + define_layer."""
+
+    weight_init: Optional[str] = None
+
+    # -- user hooks --------------------------------------------------------
+
+    def define_parameters(self, input_shape) -> Dict[str, Tuple[int, ...]]:
+        raise NotImplementedError
+
+    def define_layer(self, sd, x, params):
+        """Build the forward graph. x: SDVariable placeholder [1, *in];
+        params: {name: SDVariable placeholder}. Return the output var."""
+        raise NotImplementedError
+
+    # -- framework plumbing ------------------------------------------------
+
+    def _graph(self, input_shape):
+        cache = getattr(self, "_graph_cache", None)
+        if cache is not None and cache[0] == tuple(input_shape):
+            return cache[1:]
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (1, *input_shape), "float32")
+        pvars = {
+            name: sd.placeholder(f"p_{name}", tuple(shape), "float32")
+            for name, shape in self.define_parameters(input_shape).items()
+        }
+        out = self.define_layer(sd, x, pvars)
+        ph_names = tuple(sorted(["x"] + [f"p_{n}" for n in pvars]))
+        fn = sd._build_fn((out.name,), ph_names)
+        # literals created by the graph builder (e.g. `x * 2.0`) live as
+        # CONSTANT vars — they ride along with the compiled fn
+        variables, constants, _ = sd._split_feeds({})
+        self._graph_cache = (tuple(input_shape), sd,
+                             lambda feeds: fn(variables, constants, feeds),
+                             out)
+        return sd, self._graph_cache[2], out
+
+    def output_shape(self, input_shape):
+        _, _, out = self._graph(tuple(input_shape))
+        return tuple(out.shape[1:])
+
+    def init(self, rng, input_shape, dtype):
+        w_init = get_initializer(self.weight_init or "xavier")
+        shapes = self.define_parameters(tuple(input_shape))
+        params = {}
+        for i, (name, shape) in enumerate(sorted(shapes.items())):
+            k = jax.random.fold_in(rng, i)
+            if len(shape) <= 1:
+                params[name] = jnp.zeros(shape, dtype)  # biases start at 0
+            else:
+                params[name] = w_init(k, tuple(shape), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        _, fn, out = self._graph(tuple(x.shape[1:]))
+        feeds = {"x": x}
+        feeds.update({f"p_{k}": v for k, v in params.items()})
+        res = fn(feeds)
+        return res[out.name], state
+
+
+@register_config
+@dataclass
+class SameDiffLambdaLayer(SameDiffLayer):
+    """Parameter-free variant (↔ SameDiffLambdaLayer): wraps a
+    ``forward_fn(sd, x) -> SDVariable`` graph builder."""
+
+    forward_fn: Optional[Callable] = field(default=None, compare=False)
+
+    @property
+    def has_params(self):
+        return False
+
+    def define_parameters(self, input_shape):
+        return {}
+
+    def define_layer(self, sd, x, params):
+        if self.forward_fn is None:
+            raise ValueError("SameDiffLambdaLayer needs forward_fn")
+        return self.forward_fn(sd, x)

@@ -208,6 +208,7 @@ _MERGE_OPS = {
     "mul": lambda xs: _prod(xs),
     "average": lambda xs: sum(xs) / len(xs),
     "max": lambda xs: _reduce_max(xs),
+    "min": lambda xs: _reduce_min(xs),
     "merge": lambda xs: jnp.concatenate(xs, axis=-1),
 }
 
@@ -223,6 +224,13 @@ def _reduce_max(xs):
     out = xs[0]
     for x in xs[1:]:
         out = jnp.maximum(out, x)
+    return out
+
+
+def _reduce_min(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.minimum(out, x)
     return out
 
 

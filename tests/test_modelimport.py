@@ -123,7 +123,7 @@ class TestKerasSequential:
     def test_unsupported_layer_clear_error(self, tmp_path):
         km = tf.keras.Sequential([
             tf.keras.layers.Input((4,)),
-            tf.keras.layers.GaussianNoise(0.1),
+            tf.keras.layers.UnitNormalization(),
             tf.keras.layers.Dense(2),
         ])
         with pytest.raises(KerasImportError, match="no mapper"):
@@ -343,3 +343,128 @@ class TestKerasBatchNormAxis:
         bad2, _ = _batchnorm({"axis": 2})
         with pytest.raises(KerasImportError, match="channels-first"):
             _check_bn_axis(bad2, (8, 8, 4), "bad2")  # axis 2 on rank 4: refuse
+
+
+class TestKerasBreadth:
+    """New-mapper oracle tests (r3): each saved real-Keras model must
+    import and reproduce keras' own predictions."""
+
+    def test_conv2d_transpose(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((8, 8, 3)),
+            tf.keras.layers.Conv2DTranspose(4, 3, strides=2, padding="same",
+                                            activation="relu"),
+        ])
+        x = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)
+
+    def test_pool1d_and_padding1d(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((12, 5)),
+            tf.keras.layers.ZeroPadding1D(2),
+            tf.keras.layers.Conv1D(8, 3, activation="relu"),
+            tf.keras.layers.MaxPooling1D(2),
+            tf.keras.layers.AveragePooling1D(2),
+            tf.keras.layers.GlobalMaxPooling1D(),
+        ])
+        x = np.random.RandomState(1).rand(2, 12, 5).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)
+
+    def test_advanced_activations(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(8),
+            tf.keras.layers.LeakyReLU(negative_slope=0.2),
+            tf.keras.layers.Dense(8),
+            tf.keras.layers.ELU(alpha=0.7),
+            tf.keras.layers.Dense(8),
+            tf.keras.layers.ReLU(),
+            tf.keras.layers.Dense(4),
+            tf.keras.layers.Softmax(),
+        ])
+        x = np.random.RandomState(2).randn(4, 6).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)
+
+    def test_prelu_weights_carry(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(8),
+            tf.keras.layers.PReLU(),
+        ])
+        # make alphas nontrivial so the oracle actually checks the carry
+        m.layers[-1].set_weights(
+            [np.random.RandomState(3).rand(8).astype(np.float32)])
+        x = np.random.RandomState(4).randn(4, 6).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)
+
+    def test_repeat_vector_permute(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((5,)),
+            tf.keras.layers.Dense(6, activation="tanh"),
+            tf.keras.layers.RepeatVector(3),
+            tf.keras.layers.Permute((2, 1)),
+            tf.keras.layers.Flatten(),
+        ])
+        x = np.random.RandomState(5).randn(2, 5).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)
+
+    def test_cropping_upsampling_1d(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((10, 4)),
+            tf.keras.layers.Cropping1D((1, 2)),
+            tf.keras.layers.UpSampling1D(2),
+        ])
+        x = np.random.RandomState(6).rand(2, 10, 4).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)
+
+    def test_time_distributed_dense(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((7, 5)),
+            tf.keras.layers.TimeDistributed(
+                tf.keras.layers.Dense(6, activation="relu")),
+        ])
+        x = np.random.RandomState(7).rand(2, 7, 5).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)
+
+    def test_noise_layers_inference_identity(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(8, activation="tanh"),
+            tf.keras.layers.GaussianNoise(0.5),
+            tf.keras.layers.GaussianDropout(0.3),
+        ])
+        x = np.random.RandomState(8).randn(4, 6).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)  # inference: identity
+
+    def test_minimum_merge(self, tmp_path):
+        inp = tf.keras.layers.Input((6,))
+        a = tf.keras.layers.Dense(4, activation="tanh")(inp)
+        b = tf.keras.layers.Dense(4, activation="tanh")(inp)
+        out = tf.keras.layers.Minimum()([a, b])
+        km = tf.keras.Model(inp, out)
+        x = np.random.RandomState(9).randn(3, 6).astype(np.float32)
+        want = km.predict(x, verbose=0)
+        model, variables = import_keras_model(_save(km, tmp_path))
+        got, _ = model.apply(variables, {model.config.inputs[0]: x})
+        np.testing.assert_allclose(
+            np.asarray(got[model.config.outputs[0]]), want,
+            rtol=RTOL, atol=ATOL)
+
+    def test_unsupported_relu_params_refused(self, tmp_path):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((4,)),
+            tf.keras.layers.ReLU(max_value=3.0),
+        ])
+        with pytest.raises(KerasImportError, match="max_value"):
+            import_keras_model(_save(m, tmp_path))
+
+    def test_leaky_relu_activation_string(self, tmp_path):
+        """r3 review: 'leaky_relu'/'exponential' activation strings mapped
+        to names absent from the activation registry."""
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((5,)),
+            tf.keras.layers.Dense(6, activation="leaky_relu"),
+            tf.keras.layers.Dense(3, activation="exponential"),
+        ])
+        x = np.random.RandomState(10).randn(3, 5).astype(np.float32)
+        _compare_keras(m, _save(m, tmp_path), x)
