@@ -13,6 +13,17 @@ from deeplearning4j_tpu.data.iterators import (
     AsyncDataSetIterator,
     TransformIterator,
 )
+from deeplearning4j_tpu.data.audio import (
+    WavFileRecordReader,
+    mel_filterbank,
+    mfcc,
+    read_wav,
+    spectrogram,
+)
+from deeplearning4j_tpu.data.columnar import (
+    ColumnarRecordReader,
+    SQLRecordReader,
+)
 from deeplearning4j_tpu.data.datasets import (
     load_cifar10,
     load_cifar100,
@@ -50,6 +61,9 @@ __all__ = [
     "ArrayDataSetIterator", "AsyncDataSetIterator", "TransformIterator",
     "load_mnist", "load_cifar10", "load_cifar100", "load_emnist",
     "load_iris", "load_tiny_imagenet",
+    "WavFileRecordReader", "read_wav", "spectrogram", "mfcc",
+    "mel_filterbank",
+    "ColumnarRecordReader", "SQLRecordReader",
     "ImageMeanSubtraction", "ImagePreProcessingScaler",
     "NormalizerMinMaxScaler", "NormalizerStandardize",
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",

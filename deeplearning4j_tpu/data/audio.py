@@ -1,0 +1,134 @@
+"""Audio data domain (↔ datavec-audio: WavFileRecordReader +
+AudioRecordReader with MFCC/spectrogram feature extraction via
+musicg/jlibrosa in the reference; SURVEY §2.4 "other data domains").
+
+TPU-first: WAV parsing is stdlib (``wave``) + numpy; feature extraction
+(STFT power spectrogram, mel filterbank, MFCC) is pure numpy/jnp-free
+host-side code producing dense [frames, coeffs] arrays ready for the
+dataset bridge — the heavy math (the model) runs on device, the feature
+extractor is IO-bound and stays on host like every other reader.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import wave
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.data.records import RecordReader
+
+
+def read_wav(path) -> tuple:
+    """(samples float32 in [-1,1] shaped [n] (mono-mixed), sample_rate)."""
+    with wave.open(str(path), "rb") as w:
+        rate = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 2:
+        x = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 1:
+        x = (np.frombuffer(raw, "u1").astype(np.float32) - 128.0) / 128.0
+    elif width == 4:
+        x = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        x = x.reshape(-1, channels).mean(axis=1)
+    return x, rate
+
+
+def _frame(x: np.ndarray, frame_length: int, hop: int) -> np.ndarray:
+    if len(x) < frame_length:  # short clip: zero-pad to one full frame
+        x = np.pad(x, (0, frame_length - len(x)))
+    n = 1 + (len(x) - frame_length) // hop
+    idx = np.arange(frame_length)[None, :] + hop * np.arange(n)[:, None]
+    return x[idx]
+
+
+def spectrogram(x: np.ndarray, *, frame_length: int = 400, hop: int = 160,
+                window: str = "hann") -> np.ndarray:
+    """Power spectrogram [frames, frame_length//2 + 1]."""
+    frames = _frame(np.asarray(x, np.float32), frame_length, hop)
+    if window == "hann":
+        frames = frames * np.hanning(frame_length).astype(np.float32)
+    spec = np.abs(np.fft.rfft(frames, axis=-1)) ** 2
+    return spec.astype(np.float32)
+
+
+def mel_filterbank(num_filters: int, frame_length: int, sample_rate: int,
+                   fmin: float = 0.0, fmax: Optional[float] = None) -> np.ndarray:
+    """[num_filters, frame_length//2+1] triangular mel filters (HTK mel)."""
+    fmax = fmax or sample_rate / 2
+
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+
+    n_bins = frame_length // 2 + 1
+    mel_pts = np.linspace(hz_to_mel(fmin), hz_to_mel(fmax), num_filters + 2)
+    hz_pts = mel_to_hz(mel_pts)
+    bins = np.floor((frame_length + 1) * hz_pts / sample_rate).astype(int)
+    fb = np.zeros((num_filters, n_bins), np.float32)
+    for i in range(num_filters):
+        lo, mid, hi = bins[i], bins[i + 1], bins[i + 2]
+        for b in range(lo, mid):
+            if mid > lo:
+                fb[i, b] = (b - lo) / (mid - lo)
+        for b in range(mid, hi):
+            if hi > mid:
+                fb[i, b] = (hi - b) / (hi - mid)
+    return fb
+
+
+def mfcc(x: np.ndarray, sample_rate: int, *, num_coeffs: int = 13,
+         num_filters: int = 26, frame_length: int = 400,
+         hop: int = 160) -> np.ndarray:
+    """[frames, num_coeffs] mel-frequency cepstral coefficients (log-mel →
+    type-II DCT), the reference's AudioRecordReader feature set."""
+    spec = spectrogram(x, frame_length=frame_length, hop=hop)
+    fb = mel_filterbank(num_filters, frame_length, sample_rate)
+    logmel = np.log(np.maximum(spec @ fb.T, 1e-10))
+    n = num_filters
+    dct = np.cos(np.pi * np.arange(num_coeffs)[:, None]
+                 * (np.arange(n) + 0.5)[None, :] / n)
+    return (logmel @ dct.T).astype(np.float32)
+
+
+class WavFileRecordReader(RecordReader):
+    """↔ WavFileRecordReader: one record per file = [feature_array, label?].
+
+    features: 'waveform' | 'spectrogram' | 'mfcc'. ``label_fn(path)`` maps a
+    file to its label (↔ ParentPathLabelGenerator-style usage).
+    """
+
+    def __init__(self, paths: Union[str, Sequence], *,
+                 features: str = "mfcc", label_fn=None, **feature_kw):
+        if features not in ("waveform", "spectrogram", "mfcc"):
+            raise ValueError(f"unknown feature kind {features!r}")
+        if isinstance(paths, (str, pathlib.Path)):
+            p = pathlib.Path(paths)
+            paths = sorted(p.glob("**/*.wav")) if p.is_dir() else [p]
+        self.paths = [pathlib.Path(p) for p in paths]
+        self.features = features
+        self.label_fn = label_fn
+        self.feature_kw = feature_kw
+
+    def __iter__(self):
+        for p in self.paths:
+            x, rate = read_wav(p)
+            if self.features == "waveform":
+                feats = x
+            elif self.features == "spectrogram":
+                feats = spectrogram(x, **self.feature_kw)
+            else:
+                feats = mfcc(x, rate, **self.feature_kw)
+            rec: List = [feats]
+            if self.label_fn is not None:
+                rec.append(self.label_fn(p))
+            yield rec

@@ -1,0 +1,98 @@
+"""Columnar + SQL record readers (↔ datavec-arrow ArrowRecordReader and
+datavec-jdbc JDBCRecordReader; SURVEY §2.4 "other data domains").
+
+TPU-first: the reference routes Arrow record batches and JDBC ResultSets
+through per-value Writable boxing. Here columnar data stays columnar —
+numpy column arrays end-to-end — and only the record-API view is row-wise,
+so the dataset bridge can slice dense minibatches without materializing
+Python rows. The SQL reader uses the stdlib ``sqlite3`` driver (the
+environment's no-new-deps rule); the reader API mirrors JDBCRecordReader
+(query + column metadata) so other DB-API drivers drop in.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.data.records import RecordReader
+
+
+class ColumnarRecordReader(RecordReader):
+    """↔ ArrowRecordReader: named column arrays viewed as records.
+
+    Accepts {name: array} (the in-memory "record batch"), or an ``.npz``
+    path holding the columns. Column order follows ``schema`` when given.
+    """
+
+    def __init__(self, columns: Union[Dict[str, Sequence], str, pathlib.Path],
+                 schema: Optional[Sequence[str]] = None):
+        if isinstance(columns, (str, pathlib.Path)):
+            with np.load(columns) as z:
+                columns = {k: z[k] for k in z.files}
+        self.columns = {k: np.asarray(v) for k, v in columns.items()}
+        lens = {len(v) for v in self.columns.values()}
+        if len(lens) > 1:
+            raise ValueError(f"ragged columns: lengths {sorted(lens)}")
+        self.names = list(schema) if schema is not None else list(self.columns)
+        missing = [n for n in self.names if n not in self.columns]
+        if missing:
+            raise ValueError(f"schema names missing from columns: {missing}")
+        self._n = lens.pop() if lens else 0
+
+    def __len__(self):
+        return self._n
+
+    def __iter__(self):
+        cols = [self.columns[n] for n in self.names]
+        for i in range(self._n):
+            yield [c[i].item() if c[i].shape == () else c[i] for c in cols]
+
+    # columnar fast path (what the reference's Arrow batches can't give the
+    # JVM without copying): dense matrices straight from the columns
+    def features_matrix(self, names: Optional[Sequence[str]] = None) -> np.ndarray:
+        names = list(names) if names is not None else self.names
+        return np.stack([np.asarray(self.columns[n], np.float32)
+                         for n in names], axis=1)
+
+
+class SQLRecordReader(RecordReader):
+    """↔ JDBCRecordReader: records from a SQL query.
+
+    ``conn`` is any DB-API connection (default path: stdlib sqlite3 opened
+    on ``database``). The query runs at iteration (and again on reset),
+    mirroring the reference's fetch-on-next semantics.
+    """
+
+    def __init__(self, query: str, *, database: Optional[str] = None,
+                 conn=None, params: Sequence = ()):
+        if conn is None:
+            if database is None:
+                raise ValueError("need a database path or an open conn")
+            import sqlite3
+
+            conn = sqlite3.connect(database)
+            self._owns = True
+        else:
+            self._owns = False
+        self.conn = conn
+        self.query = query
+        self.params = tuple(params)
+        self.column_names: Optional[List[str]] = None
+
+    def __iter__(self):
+        cur = self.conn.cursor()
+        try:
+            cur.execute(self.query, self.params)
+            if cur.description:
+                self.column_names = [d[0] for d in cur.description]
+            for row in cur:
+                yield list(row)
+        finally:
+            cur.close()
+
+    def close(self):
+        if self._owns:
+            self.conn.close()

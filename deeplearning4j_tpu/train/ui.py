@@ -1,0 +1,232 @@
+"""Training UI server (↔ deeplearning4j-ui: StatsListener → StatsStorage →
+Play-framework dashboard; SURVEY §2.7 Training UI).
+
+TPU-era redesign: the reference ships a ~60 kLoC web app with a bespoke
+stats wire format. Here the STORAGE is the open format the listeners
+already write — JSONL metric files (JsonlMetricsListener) and TensorBoard
+event files (TensorBoardListener) — and the UI is a dependency-free stdlib
+``http.server`` that renders live-polling SVG charts over those files.
+Point it at a directory of runs; TensorBoard itself also works on the same
+files, so this server is the zero-install path, not a lock-in.
+
+Usage::
+
+    server = UIServer("/tmp/runs", port=9000)     # port 0 → ephemeral
+    server.start()                                 # background thread
+    ...
+    server.stop()
+
+Endpoints: ``/`` dashboard, ``/api/runs`` run listing,
+``/api/metrics?run=<name>`` the run's scalar series.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j-tpu training UI</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 1.5rem; }
+ h1 { font-size: 1.2rem; }
+ .chart { display: inline-block; margin: .8rem; }
+ .chart h3 { font-size: .9rem; margin: 0 0 .3rem 0; }
+ svg { background: #fafafa; border: 1px solid #ddd; }
+ path { fill: none; stroke: #2563eb; stroke-width: 1.5; }
+ text { font-size: 10px; fill: #666; }
+</style></head>
+<body>
+<h1>deeplearning4j-tpu training UI</h1>
+<div id="runs"></div><div id="charts"></div>
+<script>
+const W = 360, H = 180, PAD = 30;
+function line(points) {
+  if (!points.length) return "";
+  const xs = points.map(p => p[0]), ys = points.map(p => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys);
+  const sx = v => PAD + (W - 2 * PAD) * (x1 > x0 ? (v - x0) / (x1 - x0) : 0);
+  const sy = v => H - PAD - (H - 2 * PAD) * (y1 > y0 ? (v - y0) / (y1 - y0) : 0);
+  return { d: points.map((p, i) => (i ? "L" : "M") + sx(p[0]) + " " + sy(p[1])).join(" "),
+           y0: y0, y1: y1 };
+}
+async function refresh() {
+  const runs = await (await fetch("/api/runs")).json();
+  document.getElementById("runs").textContent = "runs: " + runs.join(", ");
+  const charts = document.getElementById("charts");
+  charts.innerHTML = "";
+  for (const run of runs) {
+    const series = await (await fetch("/api/metrics?run=" + run)).json();
+    for (const [name, pts] of Object.entries(series)) {
+      const l = line(pts);
+      const div = document.createElement("div");
+      div.className = "chart";
+      div.innerHTML = `<h3>${run} · ${name}</h3>
+        <svg width="${W}" height="${H}"><path d="${l.d}"/>
+        <text x="4" y="${PAD}">${(+l.y1).toPrecision(4)}</text>
+        <text x="4" y="${H - PAD}">${(+l.y0).toPrecision(4)}</text></svg>`;
+      charts.appendChild(div);
+    }
+  }
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
+def _read_jsonl_series(path: Path) -> Dict[str, List]:
+    series: Dict[str, List] = {}
+    try:
+        with open(path) as fh:
+            for ln in fh:
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                step = rec.get("step")
+                if step is None:
+                    continue
+                for k, v in rec.items():
+                    if k in ("step", "epoch", "time") or not isinstance(
+                            v, (int, float)):
+                        continue
+                    series.setdefault(k, []).append([step, v])
+    except OSError:
+        pass
+    return series
+
+
+def _read_tb_series(path: Path) -> Dict[str, List]:
+    """Scalars from a TB event file via our own framing/wire reader."""
+    import gzip  # noqa: F401  (parity with profiling helpers)
+    import struct
+
+    from deeplearning4j_tpu.modelimport.onnx_proto import (
+        _iter_fields,
+        _read_varint,
+    )
+
+    series: Dict[str, List] = {}
+    try:
+        data = path.read_bytes()
+    except OSError:
+        return series
+    pos = 0
+    while pos + 12 <= len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        pos += 12  # length + length-crc
+        payload = data[pos:pos + length]
+        pos += length + 4  # + data-crc
+        step = 0
+        summary = None
+        for num, wt, val in _iter_fields(payload):
+            if num == 2 and wt == 0:
+                step = val
+            elif num == 5 and wt == 2:
+                summary = val
+        if summary is None:
+            continue
+        for num, wt, val in _iter_fields(summary):
+            if num != 1 or wt != 2:
+                continue
+            tag, simple = None, None
+            for n2, w2, v2 in _iter_fields(val):
+                if n2 == 1 and w2 == 2:
+                    tag = v2.decode()
+                elif n2 == 2 and w2 == 5:
+                    (simple,) = struct.unpack("<f", v2)
+            if tag is not None and simple is not None:
+                series.setdefault(tag, []).append([step, simple])
+    return series
+
+
+class UIServer:
+    """Serve live charts over a directory of training runs.
+
+    A "run" is either a ``*.jsonl`` metrics file or a subdirectory holding
+    TB event files; both listeners in train/ produce them.
+    """
+
+    def __init__(self, log_dir: str, port: int = 9000, host: str = "127.0.0.1"):
+        self.log_dir = Path(log_dir)
+        self.host = host
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- data --------------------------------------------------------------
+
+    def runs(self) -> List[str]:
+        out = []
+        if self.log_dir.is_dir():
+            for p in sorted(self.log_dir.iterdir()):
+                if p.suffix == ".jsonl" or (
+                        p.is_dir() and any(p.glob("events.out.tfevents.*"))):
+                    out.append(p.name)
+        return out
+
+    def metrics(self, run: str) -> Dict[str, List]:
+        p = self.log_dir / run
+        if p.suffix == ".jsonl" and p.is_file():
+            return _read_jsonl_series(p)
+        if p.is_dir():
+            series: Dict[str, List] = {}
+            for ev in sorted(p.glob("events.out.tfevents.*")):
+                for k, v in _read_tb_series(ev).items():
+                    series.setdefault(k, []).extend(v)
+            return series
+        return {}
+
+    # -- server ------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._requested_port
+
+    def start(self) -> "UIServer":
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 - stdlib API
+                pass
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                url = urlparse(self.path)
+                if url.path == "/":
+                    body = _PAGE.encode()
+                    ctype = "text/html"
+                elif url.path == "/api/runs":
+                    body = json.dumps(ui.runs()).encode()
+                    ctype = "application/json"
+                elif url.path == "/api/metrics":
+                    run = parse_qs(url.query).get("run", [""])[0]
+                    if "/" in run or ".." in run:
+                        self.send_error(400, "bad run name")
+                        return
+                    body = json.dumps(ui.metrics(run)).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                          Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,138 @@
+"""Audio / columnar / SQL data-domain tests (VERDICT r2 Missing #10).
+
+Oracles: WAV files are written with the stdlib ``wave`` module and parsed
+back; the spectrogram of a pure sine must peak at the right FFT bin; MFCC
+frames have the declared shape; SQL results come from a real sqlite3 DB.
+"""
+
+import sqlite3
+import wave
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    ColumnarRecordReader,
+    SQLRecordReader,
+    WavFileRecordReader,
+    mel_filterbank,
+    mfcc,
+    read_wav,
+    spectrogram,
+)
+
+
+def _write_wav(path, x, rate=16000, width=2, channels=1):
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(channels)
+        w.setsampwidth(width)
+        w.setframerate(rate)
+        if width == 2:
+            data = (np.clip(x, -1, 1) * 32767).astype("<i2")
+        else:
+            data = ((np.clip(x, -1, 1) * 127) + 128).astype("u1")
+        if channels > 1:
+            data = np.repeat(data[:, None], channels, axis=1)
+        w.writeframes(data.tobytes())
+
+
+class TestWav:
+    def test_roundtrip_16bit(self, tmp_path):
+        t = np.arange(16000) / 16000
+        x = 0.5 * np.sin(2 * np.pi * 440 * t)
+        p = tmp_path / "a.wav"
+        _write_wav(p, x)
+        y, rate = read_wav(p)
+        assert rate == 16000 and y.shape == (16000,)
+        np.testing.assert_allclose(y, x, atol=1e-3)
+
+    def test_stereo_mixdown_and_8bit(self, tmp_path):
+        x = np.linspace(-0.5, 0.5, 1000)
+        p = tmp_path / "s.wav"
+        _write_wav(p, x, width=1, channels=2)
+        y, _ = read_wav(p)
+        assert y.shape == (1000,)
+        np.testing.assert_allclose(y, x, atol=2e-2)
+
+    def test_sine_spectrogram_peak_bin(self):
+        rate, freq, n_fft = 16000, 1000, 400
+        t = np.arange(rate) / rate
+        x = np.sin(2 * np.pi * freq * t).astype(np.float32)
+        spec = spectrogram(x, frame_length=n_fft, hop=160)
+        peak = int(np.argmax(spec.mean(axis=0)))
+        assert peak == round(freq * n_fft / rate)  # bin 25
+
+    def test_mfcc_shape_and_finite(self):
+        x = np.random.default_rng(0).normal(size=8000).astype(np.float32)
+        m = mfcc(x, 16000, num_coeffs=13)
+        assert m.shape[1] == 13 and m.shape[0] > 10
+        assert np.isfinite(m).all()
+
+    def test_mel_filterbank_partition(self):
+        fb = mel_filterbank(26, 400, 16000)
+        assert fb.shape == (26, 201)
+        assert (fb >= 0).all() and fb.max() <= 1.0
+        # every filter has support
+        assert (fb.sum(axis=1) > 0).all()
+
+    def test_reader_with_labels(self, tmp_path):
+        for name in ("cat_1.wav", "dog_1.wav"):
+            _write_wav(tmp_path / name,
+                       np.random.default_rng(0).normal(size=2000) * 0.1)
+        rr = WavFileRecordReader(tmp_path, features="mfcc",
+                                 label_fn=lambda p: p.stem.split("_")[0])
+        recs = list(rr)
+        assert len(recs) == 2
+        feats, label = recs[0]
+        assert feats.ndim == 2 and label == "cat"
+
+
+class TestColumnar:
+    def test_rows_view_and_matrix(self):
+        rr = ColumnarRecordReader({
+            "a": np.array([1.0, 2.0, 3.0]),
+            "b": np.array([10, 20, 30]),
+            "label": np.array(["x", "y", "x"]),
+        }, schema=["a", "b", "label"])
+        assert len(rr) == 3
+        assert list(rr)[1] == [2.0, 20, "y"]
+        m = rr.features_matrix(["a", "b"])
+        np.testing.assert_allclose(m, [[1, 10], [2, 20], [3, 30]])
+
+    def test_npz_source(self, tmp_path):
+        p = tmp_path / "cols.npz"
+        np.savez(p, x=np.arange(4.0), y=np.arange(4.0) ** 2)
+        rr = ColumnarRecordReader(p, schema=["x", "y"])
+        assert list(rr)[3] == [3.0, 9.0]
+
+    def test_ragged_refused(self):
+        with pytest.raises(ValueError, match="ragged"):
+            ColumnarRecordReader({"a": [1, 2], "b": [1]})
+
+    def test_bad_schema_refused(self):
+        with pytest.raises(ValueError, match="missing"):
+            ColumnarRecordReader({"a": [1]}, schema=["a", "zz"])
+
+
+class TestSQL:
+    def test_query_records_and_reset(self, tmp_path):
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE iris (sl REAL, sw REAL, species TEXT)")
+        conn.executemany("INSERT INTO iris VALUES (?,?,?)",
+                         [(5.1, 3.5, "setosa"), (7.0, 3.2, "versicolor"),
+                          (6.3, 3.3, "virginica")])
+        conn.commit()
+        conn.close()
+
+        rr = SQLRecordReader("SELECT sl, sw, species FROM iris WHERE sl > ?",
+                             database=db, params=(5.5,))
+        rows = list(rr)
+        assert rows == [[7.0, 3.2, "versicolor"], [6.3, 3.3, "virginica"]]
+        assert rr.column_names == ["sl", "sw", "species"]
+        assert list(rr) == rows  # re-iterable (reset semantics)
+        rr.close()
+
+    def test_needs_database_or_conn(self):
+        with pytest.raises(ValueError, match="database"):
+            SQLRecordReader("SELECT 1")

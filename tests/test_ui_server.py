@@ -1,0 +1,89 @@
+"""Training UI server tests (SURVEY §2.7 Training UI; VERDICT r2 Missing #3).
+
+The server must list runs, serve scalar series parsed from BOTH storage
+formats the listeners write (JSONL and TB event files), and render the
+dashboard page — all verified over real HTTP against a live instance.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.train.tensorboard import TensorBoardWriter
+from deeplearning4j_tpu.train.ui import UIServer
+
+
+@pytest.fixture()
+def ui(tmp_path):
+    # run 1: JSONL metrics
+    with open(tmp_path / "run1.jsonl", "w") as fh:
+        for step in range(5):
+            fh.write(json.dumps({"step": step, "epoch": 0,
+                                 "total_loss": 2.0 - 0.3 * step,
+                                 "note": "non-numeric ignored"}) + "\n")
+    # run 2: TB event files
+    w = TensorBoardWriter(str(tmp_path / "run2"))
+    for step in range(4):
+        w.add_scalar("loss", 1.0 - 0.1 * step, step)
+        w.add_scalar("acc", 0.5 + 0.1 * step, step)
+    w.close()
+
+    server = UIServer(str(tmp_path), port=0).start()
+    yield server
+    server.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}", timeout=10) as r:
+        return r.status, r.read()
+
+
+class TestUIServer:
+    def test_dashboard_page(self, ui):
+        status, body = _get(ui, "/")
+        assert status == 200
+        assert b"training UI" in body and b"/api/metrics" in body
+
+    def test_runs_listing(self, ui):
+        status, body = _get(ui, "/api/runs")
+        assert status == 200
+        assert json.loads(body) == ["run1.jsonl", "run2"]
+
+    def test_jsonl_metrics(self, ui):
+        _, body = _get(ui, "/api/metrics?run=run1.jsonl")
+        series = json.loads(body)
+        assert "total_loss" in series and "note" not in series
+        pts = series["total_loss"]
+        assert pts[0] == [0, 2.0]
+        assert pts[-1][0] == 4
+        assert pts[-1][1] == pytest.approx(0.8)
+
+    def test_tb_metrics_parsed_by_own_reader(self, ui):
+        _, body = _get(ui, "/api/metrics?run=run2")
+        series = json.loads(body)
+        assert set(series) == {"loss", "acc"}
+        np.testing.assert_allclose(
+            [v for _, v in series["loss"]],
+            [1.0, 0.9, 0.8, 0.7], rtol=1e-6)
+        assert [s for s, _ in series["acc"]] == [0, 1, 2, 3]
+
+    def test_unknown_run_empty(self, ui):
+        _, body = _get(ui, "/api/metrics?run=nope")
+        assert json.loads(body) == {}
+
+    def test_path_traversal_refused(self, ui):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(ui, "/api/metrics?run=../etc")
+        assert ei.value.code == 400
+
+    def test_404(self, ui):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(ui, "/nope")
+        assert ei.value.code == 404
