@@ -132,3 +132,47 @@ class WavFileRecordReader(RecordReader):
             if self.label_fn is not None:
                 rec.append(self.label_fn(p))
             yield rec
+
+
+class FrameSequenceRecordReader(RecordReader):
+    """↔ datavec-data-codec's VideoRecordReader role: a video is a directory
+    of frame images (the codec-decode step happens offline — this
+    environment ships no codec libs, and the reference's JCodec path existed
+    to produce exactly these frame sequences). One record per video:
+    [frames array [T, H, W, C], label?].
+    """
+
+    def __init__(self, root, *, height: int, width: int, channels: int = 3,
+                 max_frames: Optional[int] = None, label_fn=None):
+        from deeplearning4j_tpu.data.image import load_image
+
+        self._load = load_image
+        self.root = pathlib.Path(root)
+        self.height, self.width, self.channels = height, width, channels
+        self.max_frames = max_frames
+        self.label_fn = label_fn
+        exts = (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        self.videos = sorted(
+            d for d in self.root.iterdir()
+            if d.is_dir() and any(p.suffix.lower() in exts
+                                  for p in d.iterdir()))
+
+    def __iter__(self):
+        for vid in self.videos:
+            frames = sorted(p for p in vid.iterdir()
+                            if p.suffix.lower() in
+                            (".png", ".jpg", ".jpeg", ".bmp", ".npy"))
+            if self.max_frames:
+                frames = frames[:self.max_frames]
+            arrs = []
+            for f in frames:
+                if f.suffix.lower() == ".npy":
+                    a = np.load(f).astype(np.float32)
+                else:
+                    a = self._load(f, height=self.height, width=self.width,
+                                   channels=self.channels)
+                arrs.append(a)
+            rec: List = [np.stack(arrs)]
+            if self.label_fn is not None:
+                rec.append(self.label_fn(vid))
+            yield rec

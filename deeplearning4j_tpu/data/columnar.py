@@ -73,7 +73,10 @@ class SQLRecordReader(RecordReader):
                 raise ValueError("need a database path or an open conn")
             import sqlite3
 
-            conn = sqlite3.connect(database)
+            # check_same_thread=False: iteration may happen on a prefetch
+            # worker (AsyncDataSetIterator); access is still serialized per
+            # cursor by the reader's own iteration
+            conn = sqlite3.connect(database, check_same_thread=False)
             self._owns = True
         else:
             self._owns = False

@@ -110,14 +110,51 @@ class Evaluation:
         return "\n".join(lines)
 
 
-def evaluate_model(model, variables, data_iter, num_classes: int) -> Evaluation:
-    """↔ MultiLayerNetwork.evaluate(DataSetIterator)."""
+def evaluate_model(model, variables, data_iter, num_classes: int,
+                   mesh=None) -> Evaluation:
+    """↔ MultiLayerNetwork.evaluate(DataSetIterator).
+
+    The per-batch statistic (forward + confusion accumulation) is ONE jit'd
+    program carrying the confusion matrix on device — no host sync inside
+    the loop (SURVEY §5.5). With ``mesh``, the same program pjits over the
+    data axis: parameters replicated, batch sharded, and the confusion
+    accumulation psums across shards via GSPMD (the reference's
+    distributed-eval aggregation without explicit collectives)."""
+    import jax
+
     ev = Evaluation(num_classes)
-    for batch in data_iter:
-        feats = batch.features if hasattr(batch, "features") else batch[0]
-        labels = batch.labels if hasattr(batch, "labels") else batch[1]
+
+    def eval_step(cm, variables, feats, labels):
         out = model.output(variables, feats)
         if isinstance(out, dict):
             out = next(iter(out.values()))
-        ev.eval(labels, out)
+        return _confusion_update(cm, out, labels)
+
+    jit_kwargs = {}
+    n_shards = 1
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        axis = "data" if "data" in mesh.axis_names else mesh.axis_names[0]
+        n_shards = mesh.shape[axis]
+        rep = NamedSharding(mesh, PartitionSpec())
+        batch_sh = NamedSharding(mesh, PartitionSpec(axis))
+        jit_kwargs = {"in_shardings": (rep, rep, batch_sh, batch_sh),
+                      "out_shardings": rep}
+    step = jax.jit(eval_step, **jit_kwargs)
+    plain_step = step if mesh is None else None
+
+    cm = ev.cm
+    for batch in data_iter:
+        feats = batch.features if hasattr(batch, "features") else batch[0]
+        labels = batch.labels if hasattr(batch, "labels") else batch[1]
+        use = step
+        if mesh is not None and len(feats) % n_shards != 0:
+            # partial tail batch (drop_last=False): not shardable over the
+            # data axis — run it unsharded, same math
+            if plain_step is None:
+                plain_step = jax.jit(eval_step)
+            use = plain_step
+        cm = use(cm, variables, jnp.asarray(feats), jnp.asarray(labels))
+    ev.cm = cm
     return ev

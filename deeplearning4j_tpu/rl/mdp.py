@@ -10,7 +10,7 @@ same way the reference's connectors did).
 
 from __future__ import annotations
 
-from typing import Any, Protocol, Tuple
+from typing import Any, Optional, Protocol, Tuple
 
 import numpy as np
 
@@ -138,3 +138,66 @@ class Pendulum:
         self._t += 1
         truncated = self._t >= self.max_steps
         return self._obs(), -float(cost), truncated, {"truncated": truncated}
+
+
+class GymEnv:
+    """↔ rl4j-gym's GymEnv connector: adapt a Gymnasium/Gym environment to
+    this package's MDP protocol (reset() -> obs; step(a) -> (obs, reward,
+    done, info) with info['truncated'] marking time-limit cuts).
+
+    The env object can be passed directly (duck-typed) or built by name
+    when the ``gymnasium`` package is installed; this environment ships
+    without it, so name-construction raises a clear error instead of
+    importing at module load."""
+
+    def __init__(self, env=None, *, name: Optional[str] = None, seed: int = 0):
+        if env is None:
+            if name is None:
+                raise ValueError("need an env object or a name")
+            try:
+                import gymnasium
+            except ImportError as e:  # pragma: no cover - gated dependency
+                raise ImportError(
+                    "gymnasium is not installed; pass a constructed env "
+                    "object instead of a name") from e
+            env = gymnasium.make(name)
+        self.env = env
+        self._seed = seed
+        space = getattr(env, "action_space", None)
+        if space is not None and hasattr(space, "n"):
+            self.action_count = int(space.n)
+        elif space is not None and hasattr(space, "shape"):
+            self.action_dim = int(np.prod(space.shape))
+        obs_space = getattr(env, "observation_space", None)
+        if obs_space is not None and hasattr(obs_space, "shape"):
+            self.observation_shape = tuple(obs_space.shape)
+
+    def reset(self) -> np.ndarray:
+        out = self.env.reset(seed=self._seed) if _accepts_seed(self.env) \
+            else self.env.reset()
+        self._seed = None if self._seed is None else self._seed + 1
+        obs = out[0] if isinstance(out, tuple) else out
+        return np.asarray(obs, np.float32)
+
+    def step(self, action):
+        out = self.env.step(action)
+        if len(out) == 5:  # gymnasium: obs, reward, terminated, truncated, info
+            obs, rew, term, trunc, info = out
+            info = dict(info or {})
+            info["truncated"] = bool(trunc)
+            return (np.asarray(obs, np.float32), float(rew),
+                    bool(term or trunc), info)
+        obs, rew, done, info = out  # classic gym
+        info = dict(info or {})
+        info.setdefault("truncated",
+                        bool(info.get("TimeLimit.truncated", False)))
+        return np.asarray(obs, np.float32), float(rew), bool(done), info
+
+
+def _accepts_seed(env) -> bool:
+    import inspect
+
+    try:
+        return "seed" in inspect.signature(env.reset).parameters
+    except (TypeError, ValueError):
+        return False

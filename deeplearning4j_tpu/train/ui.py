@@ -61,15 +61,19 @@ async function refresh() {
   const charts = document.getElementById("charts");
   charts.innerHTML = "";
   for (const run of runs) {
-    const series = await (await fetch("/api/metrics?run=" + run)).json();
+    const series = await (await fetch(
+      "/api/metrics?run=" + encodeURIComponent(run))).json();
     for (const [name, pts] of Object.entries(series)) {
       const l = line(pts);
       const div = document.createElement("div");
       div.className = "chart";
-      div.innerHTML = `<h3>${run} · ${name}</h3>
-        <svg width="${W}" height="${H}"><path d="${l.d}"/>
+      const h3 = document.createElement("h3");
+      h3.textContent = run + " · " + name;   // textContent: names are data
+      div.appendChild(h3);
+      div.insertAdjacentHTML("beforeend",
+        `<svg width="${W}" height="${H}"><path d="${l.d}"/>
         <text x="4" y="${PAD}">${(+l.y1).toPrecision(4)}</text>
-        <text x="4" y="${H - PAD}">${(+l.y0).toPrecision(4)}</text></svg>`;
+        <text x="4" y="${H - PAD}">${(+l.y0).toPrecision(4)}</text></svg>`);
       charts.appendChild(div);
     }
   }
@@ -102,7 +106,6 @@ def _read_jsonl_series(path: Path) -> Dict[str, List]:
 
 def _read_tb_series(path: Path) -> Dict[str, List]:
     """Scalars from a TB event file via our own framing/wire reader."""
-    import gzip  # noqa: F401  (parity with profiling helpers)
     import struct
 
     from deeplearning4j_tpu.modelimport.onnx_proto import (

@@ -136,3 +136,103 @@ class TestSQL:
     def test_needs_database_or_conn(self):
         with pytest.raises(ValueError, match="database"):
             SQLRecordReader("SELECT 1")
+
+
+class TestFrameSequence:
+    def test_video_as_frame_dirs(self, tmp_path):
+        from deeplearning4j_tpu.data.audio import FrameSequenceRecordReader
+
+        r = np.random.default_rng(0)
+        for vid, n in (("clipA", 4), ("clipB", 3)):
+            d = tmp_path / vid
+            d.mkdir()
+            for i in range(n):
+                np.save(d / f"frame_{i:03d}.npy",
+                        r.random((8, 8, 3)).astype(np.float32))
+        rr = FrameSequenceRecordReader(tmp_path, height=8, width=8,
+                                       label_fn=lambda p: p.name)
+        recs = list(rr)
+        assert len(recs) == 2
+        frames, label = recs[0]
+        assert frames.shape == (4, 8, 8, 3) and label == "clipA"
+        assert recs[1][0].shape == (3, 8, 8, 3)
+
+    def test_max_frames(self, tmp_path):
+        from deeplearning4j_tpu.data.audio import FrameSequenceRecordReader
+
+        d = tmp_path / "v"
+        d.mkdir()
+        for i in range(6):
+            np.save(d / f"f{i}.npy", np.zeros((4, 4, 3), np.float32))
+        rr = FrameSequenceRecordReader(tmp_path, height=4, width=4,
+                                       max_frames=2)
+        assert list(rr)[0][0].shape == (2, 4, 4, 3)
+
+
+class TestGymConnector:
+    def test_duck_typed_gymnasium_style_env(self):
+        from deeplearning4j_tpu.rl.mdp import GymEnv
+
+        class Fake:
+            class action_space:
+                n = 3
+
+            class observation_space:
+                shape = (5,)
+
+            def reset(self, seed=None):
+                return np.zeros(5), {}
+
+            def step(self, a):
+                return np.ones(5), 1.0, False, True, {}
+
+        env = GymEnv(Fake())
+        assert env.action_count == 3
+        assert env.observation_shape == (5,)
+        obs = env.reset()
+        assert obs.shape == (5,) and obs.dtype == np.float32
+        obs, rew, done, info = env.step(1)
+        assert done and info["truncated"] and rew == 1.0
+
+    def test_classic_gym_four_tuple(self):
+        from deeplearning4j_tpu.rl.mdp import GymEnv
+
+        class Fake:
+            def reset(self):
+                return np.zeros(2)
+
+            def step(self, a):
+                return np.ones(2), 0.5, True, {"TimeLimit.truncated": True}
+
+        env = GymEnv(Fake())
+        env.reset()
+        obs, rew, done, info = env.step(0)
+        assert done and info["truncated"]
+
+    def test_real_gymnasium_cartpole(self):
+        pytest.importorskip("gymnasium")
+        from deeplearning4j_tpu.rl.mdp import GymEnv
+
+        env = GymEnv(name="CartPole-v1", seed=0)
+        assert env.action_count == 2
+        assert env.observation_shape == (4,)
+        obs = env.reset()
+        assert obs.shape == (4,)
+        total = 0
+        done = False
+        while not done and total < 600:
+            obs, rew, done, info = env.step(total % 2)
+            total += 1
+        assert done and "truncated" in info
+
+    def test_real_gymnasium_trains_with_a3c(self):
+        pytest.importorskip("gymnasium")
+        from deeplearning4j_tpu.rl import A3CConfig, A3CDiscrete
+        from deeplearning4j_tpu.rl.mdp import GymEnv
+
+        agent = A3CDiscrete(
+            lambda i: GymEnv(name="CartPole-v1", seed=i),
+            A3CConfig(num_workers=4, n_steps=8, seed=0))
+        losses = agent.train(30)
+        assert np.isfinite(losses).all()
+        assert agent.episode_returns  # episodes completed across workers
