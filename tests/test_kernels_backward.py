@@ -176,3 +176,34 @@ def test_flash_bwd_bf16_finite():
     for g in (dq, dk, dv):
         assert g.dtype == jnp.bfloat16
         assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+
+
+class TestBf16KernelPath:
+    """The bench's headline BERT config runs bf16 mixed precision: the
+    Pallas kernels must accept bf16 q/k/v (fp32 internally, bf16 out)."""
+
+    def test_flash_bf16_fwd_bwd(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.kernels.flash_attention import (
+            flash_attention,
+            reference_attention,
+        )
+
+        r = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(r.normal(size=(2, 2, 16, 8)), jnp.bfloat16)
+                   for _ in range(3))
+        km = jnp.ones((2, 16), jnp.bfloat16)
+
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, key_mask=km, block_q=8, block_k=8)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        ref = jnp.sum(reference_attention(q, k, v, key_mask=km)
+                      .astype(jnp.float32) ** 2)
+        assert float(val) == pytest.approx(float(ref), rel=0.05)
+        for g in grads:
+            assert g.dtype == jnp.bfloat16
+            assert np.isfinite(np.asarray(g, np.float32)).all()
