@@ -36,6 +36,7 @@ from deeplearning4j_tpu.nn.layers.core import (
     RepeatVector,
     Reshape,
 )
+from deeplearning4j_tpu.nn.layers.moe import MoEBlock, load_balance_loss
 from deeplearning4j_tpu.nn.layers.samediff_layer import (
     SameDiffLambdaLayer,
     SameDiffLayer,
@@ -59,6 +60,7 @@ __all__ = [
     "ActivationLayer", "Dense", "Dropout", "ElementWiseMultiplication",
     "Embedding", "Flatten", "Permute", "PReLU", "RepeatVector", "Reshape",
     "SameDiffLayer", "SameDiffLambdaLayer",
+    "MoEBlock", "load_balance_loss",
     "Conv1D", "Conv2D", "Conv3D", "Cropping1D", "Cropping2D", "Deconv2D",
     "DepthwiseConv2D", "GlobalPooling", "Pooling1D", "Pooling2D",
     "SeparableConv2D", "SpaceToDepth",

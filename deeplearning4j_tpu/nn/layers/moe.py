@@ -1,0 +1,168 @@
+"""Mixture-of-experts FFN block with top-k routing (SURVEY §2.6 P10
+"expert parallelism"; capability superset — the reference has no MoE layer,
+its P10 row maps to this block sharded over an ``expert`` mesh axis).
+
+TPU-first formulation (GShard/Switch style): routing is DENSE tensor
+algebra — a [tokens, experts, capacity] one-hot dispatch tensor built from
+top-k gates and a per-expert running position (cumsum), everything static
+shape so XLA can lay it out — and the experts are one STACKED weight tensor
+``[E, H, I]`` applied with a single einsum. Under a mesh, sharding that
+leading E dim over the 'expert' (or 'model') axis makes GSPMD insert the
+all-to-all dispatch/combine collectives the reference would have needed a
+parameter server for; see parallel/specs.expert_parallel_plan.
+
+Tokens routed beyond an expert's capacity are dropped (standard MoE
+semantics — the residual path carries them); ``load_balance_loss`` exposes
+the GShard auxiliary loss for callers that want to regularize routing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+
+
+@register_config
+@dataclass
+class MoEBlock(LayerConfig):
+    """Top-k routed expert FFN: y = x + combine(experts(dispatch(x))).
+
+    Input [..., H] (leading dims are flattened into a token axis). The
+    residual add keeps capacity-dropped tokens on the identity path.
+    """
+
+    num_experts: int = 8
+    units: int = 0                # expert FFN hidden width (I)
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    activation: str = "gelu"
+    weight_init: Optional[str] = None
+    residual: bool = True
+    # GShard-style fixed-size routing groups: capacity is computed per
+    # group of this many tokens, keeping the dispatch tensor O(tokens)
+    # instead of O(tokens^2). None = one global group (small inputs).
+    group_size: Optional[int] = None
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def init(self, rng, input_shape, dtype):
+        h = input_shape[-1]
+        i = self.units or 4 * h
+        w_init = get_initializer(self.weight_init or "xavier")
+        k1, k2, k3 = jax.random.split(rng, 3)
+        params = {
+            "Wg": w_init(k1, (h, self.num_experts), dtype),
+            "W1": w_init(k2, (self.num_experts, h, i), dtype),
+            "b1": jnp.zeros((self.num_experts, i), dtype),
+            "W2": w_init(k3, (self.num_experts, i, h), dtype),
+            "b2": jnp.zeros((self.num_experts, h), dtype),
+        }
+        # state structure must be stable across init/apply (sharding trees
+        # are built from the init-time template)
+        state = {"router_probs_mean": jnp.zeros((self.num_experts,), dtype),
+                 "expert_fraction": jnp.zeros((self.num_experts,), dtype)}
+        return params, state
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self, probs):
+        """probs [B, E] → (dispatch [B, E, C] {0,1}, combine [B, E, C]).
+
+        Slot bookkeeping (one-hots, cumsum positions, fill counters) runs
+        in int32 regardless of probs.dtype: a bf16 cumsum loses integer
+        exactness past 256 tokens and would silently collide tokens into
+        the same capacity slot."""
+        b, e = probs.shape
+        c = max(1, int(self.capacity_factor * self.top_k * b / e))
+        dispatch = jnp.zeros((b, e, c), probs.dtype)
+        combine = jnp.zeros((b, e, c), probs.dtype)
+        remaining = probs
+        fill = jnp.zeros((e,), jnp.int32)  # tokens already in each expert
+        for _ in range(self.top_k):
+            choice = jnp.argmax(remaining, axis=-1)            # [B]
+            gate = jnp.take_along_axis(remaining, choice[:, None], 1)[:, 0]
+            onehot_i = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [B, E]
+            # position of each token within its chosen expert, in token
+            # order (exclusive cumsum), offset by previous rounds' fill
+            pos = jnp.cumsum(onehot_i, axis=0) - onehot_i + fill[None, :]
+            pos_tok = jnp.sum(pos * onehot_i, axis=-1)         # [B] int32
+            keep = pos_tok < c
+            slot = jax.nn.one_hot(jnp.where(keep, pos_tok, c), c,
+                                  dtype=probs.dtype)           # [B, C]
+            d = (onehot_i.astype(probs.dtype)[:, :, None]
+                 * slot[:, None, :]
+                 * keep[:, None, None].astype(probs.dtype))
+            dispatch = dispatch + d
+            combine = combine + d * gate[:, None, None]
+            fill = fill + jnp.sum(onehot_i * keep[:, None].astype(jnp.int32),
+                                  axis=0)
+            remaining = remaining * (1.0 - onehot_i.astype(probs.dtype))
+        return dispatch, combine
+
+    def _ffn_one_group(self, params, tokens):
+        """Route + dispatch + experts + combine for one token group."""
+        probs = jax.nn.softmax(tokens @ params["Wg"], axis=-1)  # [B, E]
+        dispatch, combine = self._route(probs)
+
+        expert_in = jnp.einsum("bec,bh->ech", dispatch, tokens)
+        act = get_activation(self.activation)
+        hmid = act(jnp.einsum("ech,ehi->eci", expert_in, params["W1"])
+                   + params["b1"][:, None, :])
+        expert_out = (jnp.einsum("eci,eih->ech", hmid, params["W2"])
+                      + params["b2"][:, None, :])
+        y = jnp.einsum("bec,ech->bh", combine, expert_out)
+        # routing stats: mean router prob + fraction routed, per expert —
+        # exactly what load_balance_loss needs (see load_balance_loss_from_state)
+        stats = (jnp.mean(probs, axis=0),
+                 jnp.mean(jnp.sum(dispatch, axis=-1), axis=0))
+        return y, stats
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        shape = x.shape
+        h = shape[-1]
+        tokens = x.reshape(-1, h)                               # [B, H]
+        b = tokens.shape[0]
+        g = self.group_size
+        if g is not None and b > g and b % g == 0:
+            groups = tokens.reshape(b // g, g, h)
+            y, stats = jax.vmap(self._ffn_one_group, in_axes=(None, 0))(
+                params, groups)
+            y = y.reshape(b, h)
+            stats = tuple(jnp.mean(s, axis=0) for s in stats)
+        else:
+            y, stats = self._ffn_one_group(params, tokens)
+        if self.residual:
+            y = y + tokens
+        new_state = dict(state)
+        new_state["router_probs_mean"] = stats[0]
+        new_state["expert_fraction"] = stats[1]
+        return y.reshape(shape), new_state
+
+
+def load_balance_loss(probs, dispatch) -> jnp.ndarray:
+    """GShard auxiliary loss: E * Σ_e fraction_routed_e · mean_prob_e.
+
+    probs [B, E] softmax router outputs; dispatch [B, E, C] the one-hot
+    dispatch tensor. Minimized (→ top_k) by uniform routing."""
+    e = probs.shape[-1]
+    frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=0)   # [E] routed frac
+    mean_prob = jnp.mean(probs, axis=0)                   # [E]
+    return e * jnp.sum(frac * mean_prob)
+
+
+def load_balance_loss_from_state(layer_state) -> jnp.ndarray:
+    """Aux loss from the stats MoEBlock.apply stores in its state — the
+    wiring point for training: pass this (per MoE layer, via the model's
+    new_state) into Trainer(extra_metrics=...) or add it to a custom loss.
+    """
+    mean_prob = layer_state["router_probs_mean"]
+    frac = layer_state["expert_fraction"]
+    return mean_prob.shape[-1] * jnp.sum(frac * mean_prob)

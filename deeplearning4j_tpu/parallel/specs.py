@@ -184,3 +184,56 @@ def tensor_parallel_plan(mesh: Mesh, params_template: Any, *,
 
     shardings = jax.tree_util.tree_map_with_path(spec_for, params_template)
     return shardings, batch_spec(mesh)
+
+
+def expert_parallel_plan(mesh: Mesh, params_template: Any):
+    """P10 equivalent: MoE expert-stacked weights sharded on the 'expert'
+    axis (falling back to 'model' when no expert axis is in the mesh).
+
+    Every param whose tree path contains an MoEBlock layer and whose
+    leading dim is the expert count shards that dim; everything else
+    replicates. GSPMD then turns the dispatch/combine einsums of
+    nn/layers/moe.py into the all-to-all collectives — the expert
+    "parameter server" without a server. Returns
+    (params_sharding_tree, batch_sharding).
+    """
+    from deeplearning4j_tpu.runtime.device import EXPERT_AXIS
+
+    axis = EXPERT_AXIS if EXPERT_AXIS in mesh.axis_names else (
+        MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None)
+    rep = replicated(mesh)
+    if axis is None:
+        return jax.tree_util.tree_map(lambda _: rep, params_template), \
+            batch_spec(mesh)
+    size = mesh.shape[axis]
+
+    def is_moe_group(node) -> bool:
+        """Structural detection (names are user-chosen): an MoE param dict
+        carries a router plus expert-stacked FFN weights whose leading dim
+        is the expert count."""
+        if not isinstance(node, dict):
+            return False
+        if not {"Wg", "W1", "W2", "b1", "b2"} <= set(node):
+            return False
+        w1 = node["W1"]
+        return (getattr(w1, "ndim", 0) == 3
+                and getattr(node["Wg"], "ndim", 0) == 2
+                and w1.shape[0] == node["Wg"].shape[-1])
+
+    def walk(node):
+        if is_moe_group(node):
+            out = {}
+            for k, leaf in node.items():
+                if k in ("W1", "W2", "b1", "b2") and leaf.shape[0] % size == 0:
+                    out[k] = NamedSharding(
+                        mesh, P(axis, *([None] * (leaf.ndim - 1))))
+                else:
+                    out[k] = rep
+            return out
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return rep
+
+    return walk(params_template), batch_spec(mesh)

@@ -32,8 +32,10 @@ FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 STAGE_AXIS = "stage"
+EXPERT_AXIS = "expert"
 
-ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS, STAGE_AXIS)
+ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS, STAGE_AXIS,
+            EXPERT_AXIS)
 
 
 def devices(platform: Optional[str] = None):
@@ -63,6 +65,7 @@ class MeshSpec:
     model: int = 1
     seq: int = 1
     stage: int = 1
+    expert: int = 1
 
     def resolve(self, n_devices: Optional[int] = None) -> dict:
         n = n_devices if n_devices is not None else jax.device_count()
@@ -72,6 +75,7 @@ class MeshSpec:
             MODEL_AXIS: self.model,
             SEQ_AXIS: self.seq,
             STAGE_AXIS: self.stage,
+            EXPERT_AXIS: self.expert,
         }
         wildcard = [k for k, v in sizes.items() if v == -1]
         if len(wildcard) > 1:

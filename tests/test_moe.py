@@ -1,0 +1,233 @@
+"""MoE block + expert parallelism (P10) tests.
+
+Oracles: top-1 routing reproduced by a numpy reference; capacity dropping
+counted exactly; expert-parallel execution on an 8-device mesh matches the
+single-device output bit-for-tolerance; the block trains inside a model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, SequentialConfig
+from deeplearning4j_tpu.nn.layers import MoEBlock, OutputLayer, load_balance_loss
+from deeplearning4j_tpu.nn.model import SequentialModel
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.train.updaters import Adam
+
+H, E = 8, 4
+
+
+def _block(**kw):
+    kw.setdefault("num_experts", E)
+    kw.setdefault("units", 16)
+    return MoEBlock(**kw)
+
+
+def _params(layer, seed=0):
+    p, s = layer.init(jax.random.key(seed), (H,), jnp.float32)
+    return p, s
+
+
+class TestRouting:
+    def test_top1_matches_numpy_reference(self):
+        layer = _block(top_k=1, capacity_factor=4.0, residual=False)
+        params, _ = _params(layer)
+        r = np.random.default_rng(0)
+        x = r.normal(size=(12, H)).astype(np.float32)
+
+        out, _ = layer.apply(params, {}, jnp.asarray(x))
+
+        # reference: every token goes to its argmax expert (capacity ample);
+        # output = gate_prob * expert_ffn(token)
+        logits = x @ np.asarray(params["Wg"])
+        probs = np.exp(logits - logits.max(1, keepdims=True))
+        probs /= probs.sum(1, keepdims=True)
+        want = np.zeros_like(x)
+        for t in range(len(x)):
+            e = int(np.argmax(probs[t]))
+            mid = jax.nn.gelu(x[t] @ params["W1"][e] + params["b1"][e])
+            want[t] = probs[t, e] * np.asarray(mid @ params["W2"][e]
+                                               + params["b2"][e])
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+    def test_capacity_drops_tokens(self):
+        # force every token to one expert with a rigged router, capacity 2
+        layer = _block(top_k=1, capacity_factor=0.5, residual=False)
+        params, _ = _params(layer)
+        params = dict(params)
+        wg = np.zeros((H, E), np.float32)
+        wg[:, 2] = 10.0  # with positive inputs, all tokens pick expert 2
+        params["Wg"] = jnp.asarray(wg)
+        x = jnp.asarray(
+            np.abs(np.random.default_rng(1).normal(size=(16, H))) + 0.1,
+            jnp.float32)
+        dispatch, combine = layer._route(jax.nn.softmax(x @ params["Wg"], -1))
+        c = dispatch.shape[-1]
+        assert c == max(1, int(0.5 * 1 * 16 / E))  # capacity 2
+        assert float(jnp.sum(dispatch)) == c       # only c tokens kept
+        out, _ = layer.apply(params, {}, x)
+        # dropped tokens produce zero output (no residual)
+        kept = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+        dropped_rows = np.asarray(out)[kept == 0]
+        np.testing.assert_allclose(dropped_rows, 0.0, atol=1e-7)
+
+    def test_top2_gates_sum_and_residual(self):
+        layer = _block(top_k=2, capacity_factor=4.0)
+        params, _ = _params(layer)
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(6, H)),
+                        jnp.float32)
+        probs = jax.nn.softmax(x @ params["Wg"], -1)
+        dispatch, combine = layer._route(probs)
+        # each token dispatched exactly twice (ample capacity)
+        np.testing.assert_allclose(np.asarray(jnp.sum(dispatch, axis=(1, 2))),
+                                   2.0)
+        # combine weights are the two largest router probs per token
+        top2 = np.sort(np.asarray(probs), axis=1)[:, -2:].sum(1)
+        np.testing.assert_allclose(np.asarray(jnp.sum(combine, axis=(1, 2))),
+                                   top2, rtol=1e-6)
+
+    def test_load_balance_loss_uniform_is_one(self):
+        b = 64
+        probs = jnp.full((b, E), 1.0 / E)
+        # uniform dispatch: token t -> expert t % E
+        disp = jax.nn.one_hot(jnp.arange(b) % E, E)[:, :, None]
+        assert float(load_balance_loss(probs, disp)) == pytest.approx(1.0)
+        # collapsed routing scores E x worse
+        collapsed = jax.nn.one_hot(jnp.zeros(b, jnp.int32), E)[:, :, None]
+        probs_c = jnp.asarray(np.eye(E, dtype=np.float32)[np.zeros(b, int)])
+        assert float(load_balance_loss(probs_c, collapsed)) == pytest.approx(E)
+
+
+class TestExpertParallel:
+    def test_sharded_matches_single_device(self):
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.parallel.specs import expert_parallel_plan
+
+        cfg = SequentialConfig(
+            net=NeuralNetConfiguration(seed=0),
+            layers=[_block(top_k=2, capacity_factor=2.0),
+                    OutputLayer(units=3, activation="softmax", loss="mcxent")],
+            input_shape=(H,),
+        )
+        model = SequentialModel(cfg)
+        variables = model.init(seed=0)
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(16, H)),
+                        jnp.float32)
+
+        single = np.asarray(model.output(variables, x))
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "expert"))
+        p_sh, b_sh = expert_parallel_plan(mesh, variables["params"])
+        # expert-stacked tensors actually sharded on the expert axis
+        moe_name = model.layer_names[0]
+        assert "expert" in str(p_sh[moe_name]["W1"].spec)
+        assert p_sh[moe_name]["Wg"].is_fully_replicated
+
+        v_sh = {"params": jax.device_put(variables["params"], p_sh),
+                "state": variables["state"]}
+        x_sh = jax.device_put(x, b_sh)
+
+        @jax.jit
+        def fwd(v, xx):
+            return model.apply(v, xx, train=False)[0]
+
+        sharded = np.asarray(jax.device_get(fwd(v_sh, x_sh)))
+        np.testing.assert_allclose(sharded, single, rtol=2e-5, atol=2e-6)
+
+    def test_moe_model_trains(self):
+        cfg = SequentialConfig(
+            net=NeuralNetConfiguration(updater=Adam(3e-3), seed=0),
+            layers=[_block(top_k=2, capacity_factor=2.0),
+                    OutputLayer(units=2, activation="softmax", loss="mcxent")],
+            input_shape=(H,),
+        )
+        model = SequentialModel(cfg)
+        trainer = Trainer(model)
+        ts = trainer.init_state(seed=0)
+        r = np.random.default_rng(4)
+        batch = {"features": r.normal(size=(32, H)).astype(np.float32),
+                 "labels": np.eye(2, dtype=np.float32)[r.integers(0, 2, 32)]}
+        losses = []
+        for _ in range(60):
+            ts, m = trainer.train_step(ts, batch)
+            losses.append(float(jax.device_get(m["total_loss"])))
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+class TestRobustness:
+    def test_bf16_routing_no_slot_collisions(self):
+        """r3 review: bf16 cumsum loses integer exactness past 256 tokens;
+        slot bookkeeping must run in int32 so no two tokens share a slot."""
+        layer = _block(top_k=1, capacity_factor=4.0, residual=False)
+        params, _ = _params(layer)
+        r = np.random.default_rng(5)
+        probs = jax.nn.softmax(
+            jnp.asarray(r.normal(size=(2048, E)), jnp.bfloat16), -1)
+        dispatch, _ = layer._route(probs)
+        per_slot = np.asarray(jnp.sum(dispatch, axis=0), np.float32)  # [E, C]
+        assert per_slot.max() <= 1.0, f"slot collision: {per_slot.max()}"
+        # all 2048 tokens placed (ample capacity)
+        assert float(jnp.sum(dispatch)) == 2048
+
+    def test_expert_plan_detects_custom_layer_name(self):
+        """r3 review: detection is structural, not name-based."""
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.parallel.specs import expert_parallel_plan
+
+        cfg = SequentialConfig(
+            net=NeuralNetConfiguration(seed=0),
+            layers=[_block(name="my_experts"),
+                    OutputLayer(units=2, activation="softmax", loss="mcxent")],
+            input_shape=(H,),
+        )
+        model = SequentialModel(cfg)
+        variables = model.init(seed=0)
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "expert"))
+        p_sh, _ = expert_parallel_plan(mesh, variables["params"])
+        moe_name = model.layer_names[0]
+        assert "expert" in str(p_sh[moe_name]["W1"].spec)
+        assert p_sh[moe_name]["Wg"].is_fully_replicated
+        # non-MoE layers stay replicated
+        out_name = model.layer_names[1]
+        assert all(s.is_fully_replicated for s in
+                   jax.tree_util.tree_leaves(p_sh[out_name]))
+
+    def test_grouped_routing_bounds_capacity(self):
+        layer_global = _block(top_k=1, capacity_factor=2.0, residual=False)
+        layer_grouped = _block(top_k=1, capacity_factor=2.0, residual=False,
+                               group_size=32)
+        params, _ = _params(layer_global)
+        x = jnp.asarray(np.random.default_rng(6).normal(size=(128, H)),
+                        jnp.float32)
+        yg, sg = layer_grouped.apply(params, {}, x)
+        y0, s0 = layer_global.apply(params, {}, x)
+        assert yg.shape == y0.shape
+        assert np.isfinite(np.asarray(yg)).all()
+        # stats present and normalized either way
+        for s in (sg, s0):
+            assert float(jnp.sum(s["expert_fraction"])) == pytest.approx(
+                1.0, abs=0.05)  # top-1, ample capacity: ~all tokens routed
+
+    def test_aux_loss_from_state_wiring(self):
+        from deeplearning4j_tpu.nn.layers import load_balance_loss as lbl
+        from deeplearning4j_tpu.nn.layers.moe import (
+            load_balance_loss_from_state,
+        )
+
+        layer = _block(top_k=1, capacity_factor=4.0)
+        params, state0 = _params(layer)
+        x = jnp.asarray(np.random.default_rng(7).normal(size=(64, H)),
+                        jnp.float32)
+        _, state = layer.apply(params, state0, x)
+        aux = float(load_balance_loss_from_state(state))
+        # cross-check against the direct form
+        probs = jax.nn.softmax(x @ params["Wg"], -1)
+        dispatch, _ = layer._route(probs)
+        assert aux == pytest.approx(float(lbl(probs, dispatch)), rel=1e-5)
+        assert aux >= 0.9  # bounded below by ~1 for top-1
