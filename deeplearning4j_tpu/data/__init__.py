@@ -42,10 +42,13 @@ from deeplearning4j_tpu.data.records import (
     CollectionRecordReader,
     CSVRecordReader,
     CSVSequenceRecordReader,
+    JsonLineRecordReader,
     LineRecordReader,
     RecordReader,
     RecordReaderDataSetIterator,
+    RegexLineRecordReader,
     SequenceRecordReader,
+    SVMLightRecordReader,
 )
 from deeplearning4j_tpu.data.transform import Schema, TransformProcess
 from deeplearning4j_tpu.data.image import (
@@ -68,7 +71,8 @@ __all__ = [
     "NormalizerMinMaxScaler", "NormalizerStandardize",
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",
     "LineRecordReader", "SequenceRecordReader", "CSVSequenceRecordReader",
-    "RecordReaderDataSetIterator",
+    "RecordReaderDataSetIterator", "RegexLineRecordReader",
+    "JsonLineRecordReader", "SVMLightRecordReader",
     "Schema", "TransformProcess",
     "ImageRecordReader", "ImageDataSetIterator",
     "ParentPathLabelGenerator", "PatternPathLabelGenerator",

@@ -106,6 +106,98 @@ class CSVRecordReader(RecordReader):
             [list(r) for i, r in enumerate(reader) if i >= skip_lines and r])
 
 
+class RegexLineRecordReader(RecordReader):
+    """↔ org.datavec RegexLineRecordReader: each line matched against a
+    regex; capture groups become the record's values. Non-matching lines
+    raise (the reference's strict behavior) unless ``skip_unmatched``."""
+
+    def __init__(self, paths: Union[str, pathlib.Path, Sequence],
+                 pattern: str, *, skip_lines: int = 0,
+                 skip_unmatched: bool = False):
+        import re
+
+        self.paths = _as_paths(paths)
+        self.pattern = re.compile(pattern)
+        self.skip_lines = skip_lines
+        self.skip_unmatched = skip_unmatched
+
+    def __iter__(self):
+        for p in self.paths:
+            with open(p, "r") as f:
+                for i, line in enumerate(f):
+                    if i < self.skip_lines:
+                        continue
+                    m = self.pattern.match(line.rstrip("\n"))
+                    if m is None:
+                        if self.skip_unmatched:
+                            continue
+                        raise ValueError(
+                            f"line {i} of {p} does not match pattern: "
+                            f"{line!r}")
+                    yield list(m.groups())
+
+
+class JsonLineRecordReader(RecordReader):
+    """↔ JacksonLineRecordReader: one JSON object per line; ``fields``
+    selects and orders the record's values (dotted paths supported)."""
+
+    def __init__(self, paths: Union[str, pathlib.Path, Sequence],
+                 fields: Sequence[str]):
+        self.paths = _as_paths(paths)
+        self.fields = list(fields)
+
+    @staticmethod
+    def _get(obj, dotted):
+        for part in dotted.split("."):
+            obj = obj[part]
+        return obj
+
+    def __iter__(self):
+        import json
+
+        for p in self.paths:
+            with open(p, "r") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    yield [self._get(obj, fld) for fld in self.fields]
+
+
+class SVMLightRecordReader(RecordReader):
+    """↔ org.datavec SVMLightRecordReader: ``label idx:val idx:val ...``
+    sparse lines → dense records [f0..fN-1, label] (label last, matching
+    the default label_index=-1 of the dataset bridge)."""
+
+    def __init__(self, paths: Union[str, pathlib.Path, Sequence],
+                 num_features: int, *, zero_based: bool = False):
+        self.paths = _as_paths(paths)
+        self.num_features = num_features
+        self.zero_based = zero_based
+
+    def __iter__(self):
+        off = 0 if self.zero_based else 1
+        for p in self.paths:
+            with open(p, "r") as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if not line:
+                        continue
+                    parts = line.split()
+                    dense = [0.0] * self.num_features
+                    for tok in parts[1:]:
+                        i, v = tok.split(":")
+                        j = int(i) - off
+                        if not 0 <= j < self.num_features:
+                            raise ValueError(
+                                f"feature index {i} out of range for "
+                                f"num_features={self.num_features} "
+                                f"(zero_based={self.zero_based}): {line!r}")
+                        dense[j] = float(v)
+                    yield dense + [parts[0]]
+
+
 class SequenceRecordReader:
     """↔ SequenceRecordReader: iterator of sequences (list of records)."""
 

@@ -6,17 +6,29 @@ from deeplearning4j_tpu.nn.layers.conv import (
     Conv3D,
     Cropping1D,
     Cropping2D,
+    Cropping3D,
     Deconv2D,
+    Deconv3D,
+    DepthToSpace,
     DepthwiseConv2D,
     GlobalPooling,
+    LocallyConnected1D,
+    LocallyConnected2D,
     Pooling1D,
     Pooling2D,
+    Pooling3D,
     SeparableConv2D,
     SpaceToDepth,
     Upsampling1D,
     Upsampling2D,
+    Upsampling3D,
     ZeroPadding1D,
     ZeroPadding2D,
+    ZeroPadding3D,
+)
+from deeplearning4j_tpu.nn.layers.autoencoder import (
+    AutoEncoder,
+    VariationalAutoencoder,
 )
 from deeplearning4j_tpu.nn.layers.attention import (
     LearnedSelfAttention,
@@ -31,6 +43,7 @@ from deeplearning4j_tpu.nn.layers.core import (
     ElementWiseMultiplication,
     Embedding,
     Flatten,
+    MaskZeroLayer,
     Permute,
     PReLU,
     RepeatVector,
@@ -46,7 +59,14 @@ from deeplearning4j_tpu.nn.layers.norm import (
     LayerNorm,
     LocalResponseNormalization,
 )
-from deeplearning4j_tpu.nn.layers.output import LossLayer, OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.output import (
+    CenterLossOutputLayer,
+    CnnLossLayer,
+    LossLayer,
+    OutputLayer,
+    RnnLossLayer,
+    RnnOutputLayer,
+)
 from deeplearning4j_tpu.nn.layers.recurrent import (
     GRU,
     LSTM,
@@ -58,15 +78,21 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 
 __all__ = [
     "ActivationLayer", "Dense", "Dropout", "ElementWiseMultiplication",
-    "Embedding", "Flatten", "Permute", "PReLU", "RepeatVector", "Reshape",
+    "Embedding", "Flatten", "MaskZeroLayer", "Permute", "PReLU",
+    "RepeatVector", "Reshape",
     "SameDiffLayer", "SameDiffLambdaLayer",
     "MoEBlock", "load_balance_loss",
-    "Conv1D", "Conv2D", "Conv3D", "Cropping1D", "Cropping2D", "Deconv2D",
-    "DepthwiseConv2D", "GlobalPooling", "Pooling1D", "Pooling2D",
+    "Conv1D", "Conv2D", "Conv3D", "Cropping1D", "Cropping2D", "Cropping3D",
+    "Deconv2D", "Deconv3D", "DepthToSpace", "DepthwiseConv2D",
+    "GlobalPooling", "LocallyConnected1D", "LocallyConnected2D",
+    "Pooling1D", "Pooling2D", "Pooling3D",
     "SeparableConv2D", "SpaceToDepth",
-    "Upsampling1D", "Upsampling2D", "ZeroPadding1D", "ZeroPadding2D",
+    "Upsampling1D", "Upsampling2D", "Upsampling3D",
+    "ZeroPadding1D", "ZeroPadding2D", "ZeroPadding3D",
+    "AutoEncoder", "VariationalAutoencoder",
     "BatchNorm", "LayerNorm", "LocalResponseNormalization",
     "LossLayer", "OutputLayer", "RnnOutputLayer",
+    "RnnLossLayer", "CnnLossLayer", "CenterLossOutputLayer",
     "GRU", "LSTM", "Bidirectional", "GravesLSTM", "LastTimeStep", "SimpleRnn",
     "SelfAttention", "LearnedSelfAttention", "TransformerEncoderBlock",
     "PositionalEmbedding",

@@ -1,0 +1,205 @@
+"""Unsupervised pretrain layers: denoising autoencoder + VAE.
+
+ref: org.deeplearning4j.nn.conf.layers.AutoEncoder (+ runtime
+org.deeplearning4j.nn.layers.feedforward.autoencoder.AutoEncoder) and
+org.deeplearning4j.nn.conf.layers.variational.VariationalAutoencoder (+
+runtime org.deeplearning4j.nn.layers.variational.VariationalAutoencoder).
+
+In the reference these are "pretrain layers": MultiLayerNetwork.pretrain()
+runs greedy layer-wise unsupervised training on them (reconstruction /
+ELBO), after which the supervised path uses only the encoder half. Here a
+pretrain layer is an ordinary LayerConfig whose ``apply`` is the encoder,
+plus a ``pretrain_loss(params, state, x, rng)`` method consumed by
+``train.pretrain.pretrain`` (the MultiLayerNetwork.pretrain analogue) — one
+jitted step per layer, whole pretrain objective compiled.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.ops import loss as losses
+from deeplearning4j_tpu.ops import nn as opsnn
+
+
+@register_config
+@dataclass
+class AutoEncoder(LayerConfig):
+    """↔ AutoEncoder: denoising autoencoder with tied decode weights.
+
+    Params follow the reference convention: encoder ``W``/``b`` plus a
+    visible (decoder) bias ``vb``; decode uses Wᵀ (the reference's
+    AutoEncoder.decode: sigmoid(h·Wᵀ + vb)). ``corruption_level`` is the
+    masking-noise probability applied to the input during pretraining only
+    (↔ corruptionLevel).
+    """
+
+    units: int = 0
+    activation: str = "sigmoid"
+    corruption_level: float = 0.3
+    loss: str = "mse"            # reconstruction loss (↔ lossFunction)
+    sparsity: float = 0.0        # KL-sparsity weight on mean hidden activity
+    sparsity_target: float = 0.05
+    weight_init: Optional[str] = None
+
+    def output_shape(self, input_shape):
+        return (self.units,)
+
+    def init(self, rng, input_shape, dtype):
+        # Non-flat inputs are flattened (both here and in apply/pretrain),
+        # matching the reference's FeedForwardToCnnPreProcessor-free usage.
+        n_in = int(np.prod(input_shape))
+        w_init = get_initializer(self.weight_init or "xavier")
+        return {
+            "W": w_init(rng, (n_in, self.units), dtype),
+            "b": jnp.zeros((self.units,), dtype),
+            "vb": jnp.zeros((n_in,), dtype),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        h = get_activation(self.activation)(
+            opsnn.linear(x.reshape(x.shape[0], -1), params["W"], params["b"]))
+        return h, state
+
+    def _encode_decode(self, params, x):
+        act = get_activation(self.activation)
+        h = act(opsnn.linear(x, params["W"], params["b"]))
+        recon = act(jnp.matmul(h, params["W"].T) + params["vb"])
+        return h, recon
+
+    def pretrain_loss(self, params, state, x, rng):
+        """Denoising reconstruction loss (+ optional KL sparsity penalty)."""
+        x_in = x.reshape(x.shape[0], -1)
+        if self.corruption_level > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.corruption_level, x_in.shape)
+            corrupted = jnp.where(keep, x_in, 0.0)
+        else:
+            corrupted = x_in
+        h, recon = self._encode_decode(params, corrupted)
+        fn = losses.get_loss(self.loss)
+        loss = fn(recon, x_in)
+        if self.sparsity > 0.0:
+            rho, rho_hat = self.sparsity_target, jnp.clip(
+                jnp.mean(h, axis=0), 1e-6, 1.0 - 1e-6)
+            kl = rho * jnp.log(rho / rho_hat) + (1 - rho) * jnp.log(
+                (1 - rho) / (1 - rho_hat))
+            loss = loss + self.sparsity * jnp.sum(kl)
+        return loss
+
+
+@register_config
+@dataclass
+class VariationalAutoencoder(LayerConfig):
+    """↔ VariationalAutoencoder (Kingma & Welling): MLP encoder → diagonal
+    Gaussian q(z|x) → MLP decoder → reconstruction distribution p(x|z).
+
+    ``units`` is the latent size (↔ nOut); ``encoder_sizes``/``decoder_sizes``
+    mirror encoderLayerSizes/decoderLayerSizes. The supervised forward pass
+    outputs the posterior mean (the reference's activate() uses the mean of
+    q(z|x)); ``pretrain_loss`` is the negative ELBO with ``num_samples``
+    reparameterized samples (↔ numSamples). Reconstruction distributions:
+    'gaussian' (↔ GaussianReconstructionDistribution, decoder emits mean and
+    log-variance) or 'bernoulli' (↔ BernoulliReconstructionDistribution,
+    decoder emits logits).
+    """
+
+    units: int = 0
+    encoder_sizes: Sequence[int] = (256,)
+    decoder_sizes: Sequence[int] = (256,)
+    activation: str = "relu"
+    reconstruction: str = "gaussian"   # 'gaussian' | 'bernoulli'
+    num_samples: int = 1
+    weight_init: Optional[str] = None
+
+    def output_shape(self, input_shape):
+        return (self.units,)
+
+    def _dims(self, n_in):
+        out_mult = 2 if self.reconstruction == "gaussian" else 1
+        enc = [n_in, *self.encoder_sizes]
+        dec = [self.units, *self.decoder_sizes]
+        return enc, dec, out_mult * n_in
+
+    def init(self, rng, input_shape, dtype):
+        n_in = int(np.prod(input_shape))
+        enc, dec, n_out = self._dims(n_in)
+        w_init = get_initializer(self.weight_init or "xavier")
+        params = {}
+        keys = jax.random.split(rng, len(enc) + len(dec) + 2)
+        k = iter(keys)
+        for i in range(len(enc) - 1):
+            params[f"eW{i}"] = w_init(next(k), (enc[i], enc[i + 1]), dtype)
+            params[f"eb{i}"] = jnp.zeros((enc[i + 1],), dtype)
+        params["muW"] = w_init(next(k), (enc[-1], self.units), dtype)
+        params["mub"] = jnp.zeros((self.units,), dtype)
+        params["lvW"] = w_init(next(k), (enc[-1], self.units), dtype)
+        params["lvb"] = jnp.zeros((self.units,), dtype)
+        for i in range(len(dec) - 1):
+            params[f"dW{i}"] = w_init(next(k), (dec[i], dec[i + 1]), dtype)
+            params[f"db{i}"] = jnp.zeros((dec[i + 1],), dtype)
+        params["oW"] = w_init(next(k), (dec[-1], n_out), dtype)
+        params["ob"] = jnp.zeros((n_out,), dtype)
+        return params, {}
+
+    def _encode(self, params, x):
+        act = get_activation(self.activation)
+        h = x
+        for i in range(len(self.encoder_sizes)):
+            h = act(opsnn.linear(h, params[f"eW{i}"], params[f"eb{i}"]))
+        mu = opsnn.linear(h, params["muW"], params["mub"])
+        logvar = opsnn.linear(h, params["lvW"], params["lvb"])
+        return mu, logvar
+
+    def _decode(self, params, z):
+        act = get_activation(self.activation)
+        h = z
+        for i in range(len(self.decoder_sizes)):
+            h = act(opsnn.linear(h, params[f"dW{i}"], params[f"db{i}"]))
+        return opsnn.linear(h, params["oW"], params["ob"])
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        mu, _ = self._encode(params, x.reshape(x.shape[0], -1))
+        return mu, state
+
+    def reconstruct(self, params, x):
+        """Mean reconstruction through the posterior mean (eval utility)."""
+        mu, _ = self._encode(params, x.reshape(x.shape[0], -1))
+        out = self._decode(params, mu)
+        if self.reconstruction == "gaussian":
+            return out[..., : out.shape[-1] // 2]
+        return jax.nn.sigmoid(out)
+
+    def pretrain_loss(self, params, state, x, rng):
+        """Negative ELBO = KL(q(z|x) ‖ N(0,I)) − E_q[log p(x|z)]."""
+        x_in = x.reshape(x.shape[0], -1)
+        mu, logvar = self._encode(params, x_in)
+        kl = 0.5 * jnp.sum(jnp.exp(logvar) + mu**2 - 1.0 - logvar, axis=-1)
+
+        def sample_loglik(key):
+            eps = jax.random.normal(key, mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            out = self._decode(params, z)
+            if self.reconstruction == "gaussian":
+                m, lv = jnp.split(out, 2, axis=-1)
+                lv = jnp.clip(lv, -10.0, 10.0)
+                ll = -0.5 * jnp.sum(
+                    lv + (x_in - m) ** 2 / jnp.exp(lv)
+                    + jnp.log(2.0 * jnp.pi), axis=-1)
+            else:
+                ll = -jnp.sum(
+                    jnp.maximum(out, 0) - out * x_in
+                    + jnp.log1p(jnp.exp(-jnp.abs(out))), axis=-1)
+            return ll
+
+        keys = jax.random.split(rng, self.num_samples)
+        ll = jnp.mean(jax.vmap(sample_loglik)(keys), axis=0)
+        return jnp.mean(kl - ll)

@@ -524,3 +524,259 @@ class Upsampling1D(LayerConfig):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         return jnp.repeat(x, self.size, axis=1), state
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+@register_config
+@dataclass
+class Deconv3D(LayerConfig):
+    """↔ Deconvolution3D (transposed 3-D conv). Input [N,D,H,W,C]."""
+
+    filters: int = 0
+    kernel: Union[int, Sequence[int]] = 2
+    stride: Union[int, Sequence[int]] = 2
+    padding: str = "SAME"
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        k = _triple(self.kernel)
+        s = _triple(self.stride)
+        if self.padding.upper() == "SAME":
+            dims = tuple(sz * ss for sz, ss in zip((d, h, w), s))
+        else:
+            dims = tuple((sz - 1) * ss + kk for sz, ss, kk in zip((d, h, w), s, k))
+        return (*dims, self.filters)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        k = _triple(self.kernel)
+        w_init = get_initializer(self.weight_init or "relu")
+        params = {"W": w_init(rng, (*k, c, self.filters), dtype)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.filters,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = opscnn.deconv3d(x, params["W"], params.get("b"),
+                            stride=self.stride, padding=self.padding)
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class Pooling3D(LayerConfig):
+    """↔ Subsampling3DLayer (MAX/AVG over [N,D,H,W,C])."""
+
+    pool_type: str = "max"
+    window: Union[int, Sequence[int]] = 2
+    stride: Optional[Union[int, Sequence[int]]] = None
+    padding: str = "VALID"
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        k = _triple(self.window)
+        s = _triple(self.stride if self.stride is not None else self.window)
+        mode = self.padding.upper()
+        dims = tuple(_conv_out(sz, kk, ss, mode)
+                     for sz, kk, ss in zip((d, h, w), k, s))
+        return (*dims, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        stride = self.stride if self.stride is not None else self.window
+        if self.pool_type == "max":
+            return opscnn.max_pool3d(x, self.window, stride, self.padding), state
+        if self.pool_type == "avg":
+            return opscnn.avg_pool3d(x, self.window, stride, self.padding), state
+        raise ValueError(f"unknown pool type {self.pool_type}")
+
+
+@register_config
+@dataclass
+class Upsampling3D(LayerConfig):
+    """↔ Upsampling3D (nearest-neighbour on [N,D,H,W,C])."""
+
+    scale: Union[int, Sequence[int]] = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        sd, sh, sw = _triple(self.scale)
+        return (d * sd, h * sh, w * sw, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        sd, sh, sw = _triple(self.scale)
+        y = jnp.repeat(x, sd, axis=1)
+        y = jnp.repeat(y, sh, axis=2)
+        return jnp.repeat(y, sw, axis=3), state
+
+
+@register_config
+@dataclass
+class ZeroPadding3D(LayerConfig):
+    """↔ ZeroPadding3DLayer."""
+
+    padding: Sequence[int] = (1, 1, 1, 1, 1, 1)  # d_lo,d_hi,h_lo,h_hi,w_lo,w_hi
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        dl, dh_, hl, hh, wl, wh = self.padding
+        return (d + dl + dh_, h + hl + hh, w + wl + wh, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        dl, dh_, hl, hh, wl, wh = self.padding
+        return jnp.pad(x, [(0, 0), (dl, dh_), (hl, hh), (wl, wh), (0, 0)]), state
+
+
+@register_config
+@dataclass
+class Cropping3D(LayerConfig):
+    """↔ Cropping3D."""
+
+    cropping: Sequence[int] = (0, 0, 0, 0, 0, 0)  # d_lo,d_hi,h_lo,h_hi,w_lo,w_hi
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        dl, dh_, hl, hh, wl, wh = self.cropping
+        return (d - dl - dh_, h - hl - hh, w - wl - wh, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        dl, dh_, hl, hh, wl, wh = self.cropping
+        return x[:, dl:x.shape[1] - dh_, hl:x.shape[2] - hh,
+                 wl:x.shape[3] - wh, :], state
+
+
+@register_config
+@dataclass
+class DepthToSpace(LayerConfig):
+    """↔ DepthToSpace (inverse of SpaceToDepthLayer)."""
+
+    block_size: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        b = self.block_size
+        return (h * b, w * b, c // (b * b))
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return opscnn.depth_to_space(x, self.block_size), state
+
+
+@register_config
+@dataclass
+class LocallyConnected2D(LayerConfig):
+    """↔ LocallyConnected2D: conv geometry with UNSHARED per-position weights.
+
+    The reference defines this as a SameDiff layer that im2col's the input and
+    runs one small GEMM per output position. TPU-native shape: one
+    ``conv_general_dilated_patches`` (itself a conv on the MXU) followed by a
+    single batched einsum over all positions at once — no per-position loop.
+    Weights: [OH, OW, kh*kw*Cin, F] (patch dim is C-major, see
+    ops.cnn.extract_patches2d).
+    """
+
+    filters: int = 0
+    kernel: Union[int, Sequence[int]] = 3
+    stride: Union[int, Sequence[int]] = 1
+    padding: str = "VALID"
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+    # Input spatial dims must be known at init (unshared weights are sized by
+    # output position). Set by Sequential/Graph shape inference via init().
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        mode = self.padding.upper()
+        return (_conv_out(h, kh, sh, mode), _conv_out(w, kw, sw, mode), self.filters)
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        oh, ow, _ = self.output_shape(input_shape)
+        w_init = get_initializer(self.weight_init or "relu")
+        # fan_in for the init is the patch size, same as a conv — draw with a
+        # 2-D shape (patch, oh*ow*F) so the initializer sees fan_in=patch
+        # (drawing (oh,ow,patch,F) directly would inflate fan_in by oh*ow and
+        # attenuate the init std by sqrt(oh*ow)), then scatter to positions.
+        patch = c * kh * kw
+        w = w_init(rng, (patch, oh * ow * self.filters), dtype)
+        params = {"W": jnp.transpose(
+            w.reshape(patch, oh, ow, self.filters), (1, 2, 0, 3))}
+        if self.use_bias:
+            params["b"] = jnp.zeros((oh, ow, self.filters), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        patches = opscnn.extract_patches2d(
+            x, self.kernel, stride=self.stride, padding=self.padding)
+        y = jnp.einsum("nhwk,hwkf->nhwf", patches, params["W"])
+        if self.use_bias:
+            y = y + params["b"][None]
+        return get_activation(self.activation)(y), state
+
+
+@register_config
+@dataclass
+class LocallyConnected1D(LayerConfig):
+    """↔ LocallyConnected1D: unshared weights over the time axis of [N,T,C]."""
+
+    filters: int = 0
+    kernel: int = 3
+    stride: int = 1
+    padding: str = "VALID"
+    activation: str = "identity"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        return (_conv_out(t, self.kernel, self.stride, self.padding.upper()),
+                self.filters)
+
+    def init(self, rng, input_shape, dtype):
+        t, c = input_shape
+        ot, _ = self.output_shape(input_shape)
+        w_init = get_initializer(self.weight_init or "relu")
+        patch = c * self.kernel
+        w = w_init(rng, (patch, ot * self.filters), dtype)  # fan_in = patch
+        params = {"W": jnp.transpose(
+            w.reshape(patch, ot, self.filters), (1, 0, 2))}
+        if self.use_bias:
+            params["b"] = jnp.zeros((ot, self.filters), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        patches = opscnn.extract_patches2d(
+            x[:, :, None, :], (self.kernel, 1),
+            stride=(self.stride, 1), padding=self.padding)[:, :, 0, :]
+        y = jnp.einsum("ntk,tkf->ntf", patches, params["W"])
+        if self.use_bias:
+            y = y + params["b"][None]
+        return get_activation(self.activation)(y), state

@@ -232,3 +232,24 @@ class Permute(LayerConfig):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         return jnp.transpose(x, (0, *[d for d in self.dims])), state
+
+
+@register_config
+@dataclass
+class MaskZeroLayer(LayerConfig):
+    """↔ MaskZeroLayer (recurrent util wrapper, unwrapped here): zeroes
+    timesteps of [N,T,F] whose features all equal ``mask_value`` — the
+    reference wraps an underlying layer and builds a mask from
+    input == maskValue; in the functional stack the zeroing itself is the
+    composable piece (downstream recurrent layers see zero input at padded
+    steps)."""
+
+    mask_value: float = 0.0
+
+    @property
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return x * keep.astype(x.dtype), state

@@ -38,6 +38,30 @@ _LOGIT_LOSSES = {
 }
 
 
+def _masked_mean_loss(loss_name, activation, x, labels, *, mask=None,
+                      weights=None):
+    """Shared per-element loss → weighted/masked mean (Rnn/Cnn loss layers).
+
+    ``x`` holds pre-activations; per-element losses keep the leading dims
+    ([N,T] for sequences, [N,H,W] for images). ``weights`` right-broadcasts
+    (per-example [N] or per-element); ``mask`` excludes elements and
+    normalizes by the surviving count (reference BaseOutputLayer mask
+    semantics)."""
+    fn = losses.get_loss(loss_name)
+    use_logits = (loss_name.lower(), activation.lower()) in _LOGIT_LOSSES
+    target = x if use_logits else get_activation(activation)(x)
+    per = fn(target, labels, reduction="none")
+    if weights is not None:
+        w = weights
+        while w.ndim < per.ndim:
+            w = w[..., None]
+        per = per * w
+    if mask is not None:
+        per = per * mask
+        return jnp.sum(per) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(per)
+
+
 @register_config
 @dataclass
 class OutputLayer(Dense):
@@ -81,6 +105,99 @@ class LossLayer(LayerConfig):
 
 @register_config
 @dataclass
+class RnnLossLayer(LayerConfig):
+    """↔ RnnLossLayer: per-timestep activation + loss over [N,T,F], no params.
+
+    Same mask semantics as RnnOutputLayer ([N,T] mask excludes padded steps).
+    """
+
+    activation: str = "identity"
+    loss: str = "mcxent"
+
+    @property
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return get_activation(self.activation)(x), state
+
+    def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
+        return _masked_mean_loss(self.loss, self.activation, x, labels,
+                                 mask=mask, weights=weights)
+
+
+@register_config
+@dataclass
+class CnnLossLayer(LayerConfig):
+    """↔ CnnLossLayer: per-pixel activation + loss over [N,H,W,C], no params.
+
+    Used for dense prediction (segmentation) heads — e.g. U-Net. ``mask``
+    [N,H,W] (or broadcastable) excludes pixels from the loss.
+    """
+
+    activation: str = "identity"
+    loss: str = "mcxent"
+
+    @property
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return get_activation(self.activation)(x), state
+
+    def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
+        return _masked_mean_loss(self.loss, self.activation, x, labels,
+                                 mask=mask, weights=weights)
+
+
+@register_config
+@dataclass
+class CenterLossOutputLayer(Dense):
+    """↔ CenterLossOutputLayer: softmax CE + λ·½‖f − c_y‖² center loss.
+
+    The reference (Wen et al. 2016 style) keeps per-class centers as extra
+    params updated by a moving average with rate α inside the layer's
+    backprop. Functionally (TPU-first) the centers are ordinary trainable
+    params: the gradient of the center term w.r.t. c_y is λ·(c_y − f), so
+    SGD on it IS the reference's center update with α = lr·λ — one pjit'd
+    step, no special-cased mutable state. The feature term pulls activations
+    toward their class center exactly as in the reference.
+    """
+
+    loss: str = "mcxent"
+    activation: str = "softmax"
+    alpha: float = 0.05      # kept for config parity / JSON round-trip
+    lambda_: float = 2e-4    # ↔ lambda (center-loss weight)
+
+    def init(self, rng, input_shape, dtype):
+        params, state = super().init(rng, input_shape, dtype)
+        # centers: [num_classes, feature_dim] = [units_out, units_in]
+        params["centers"] = jnp.zeros((self.units, int(input_shape[-1])), dtype)
+        return params, state
+
+    def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
+        pre = opsnn.linear(x, params["W"], params.get("b"))
+        fn = losses.get_loss(self.loss)
+        w = mask if mask is not None else weights
+        if (self.loss.lower(), self.activation.lower()) in _LOGIT_LOSSES:
+            ce = fn(pre, labels, weights=w)
+        else:
+            ce = fn(get_activation(self.activation)(pre), labels, weights=w)
+        # labels are one-hot [N, classes]: c_y = labels @ centers.
+        cy = labels @ params["centers"]
+        d = 0.5 * jnp.sum((x - cy) ** 2, axis=-1)  # [N]
+        if w is not None:
+            # Exclude masked/zero-weight rows from the center pull too —
+            # otherwise padded examples drag class centers.
+            d = d * w
+            center = jnp.sum(d) / jnp.maximum(jnp.sum(w), 1.0)
+        else:
+            center = jnp.mean(d)
+        return ce + self.lambda_ * center
+
+
+@register_config
+@dataclass
 class RnnOutputLayer(Dense):
     """↔ RnnOutputLayer: per-timestep dense+loss over [N,T,F] input.
 
@@ -93,15 +210,5 @@ class RnnOutputLayer(Dense):
 
     def compute_loss(self, params, state, x, labels, *, mask=None, weights=None):
         pre = opsnn.linear(x, params["W"], params.get("b"))
-        fn = losses.get_loss(self.loss)
-        use_logits = (self.loss.lower(), self.activation.lower()) in _LOGIT_LOSSES
-        target = pre if use_logits else get_activation(self.activation)(pre)
-        per_step = fn(target, labels, reduction="none")  # [N,T]
-        if weights is not None:
-            # Per-example [N] or per-step [N,T] weights.
-            w = weights if weights.ndim == per_step.ndim else weights[:, None]
-            per_step = per_step * w
-        if mask is not None:
-            per_step = per_step * mask
-            return jnp.sum(per_step) / jnp.maximum(jnp.sum(mask), 1.0)
-        return jnp.mean(per_step)
+        return _masked_mean_loss(self.loss, self.activation, pre, labels,
+                                 mask=mask, weights=weights)

@@ -127,6 +127,41 @@ def deconv2d(x, w, b=None, *, stride=1, padding="SAME", data_format="NHWC"):
     return y
 
 
+def deconv3d(x, w, b=None, *, stride=1, padding="SAME", data_format="NDHWC"):
+    """3-D transposed conv (ref: libnd4j deconv3d / DL4J Deconvolution3D)."""
+    stride = _pair(stride, 3)
+    pad = padding.upper() if isinstance(padding, str) else [(p, p) for p in _pair(padding, 3)]
+    y = lax.conv_transpose(
+        x, w, strides=stride, padding=pad,
+        dimension_numbers=(data_format, "DHWIO", data_format),
+    )
+    if b is not None:
+        y = y + b.reshape((1,) * 4 + (-1,))
+    return y
+
+
+def extract_patches2d(x, kernel, *, stride=1, padding="VALID", dilation=1):
+    """[N,H,W,C] → [N,OH,OW,C*kh*kw] sliding-window patches.
+
+    The substrate for locally-connected layers: patch extraction lowers to a
+    dilated conv of an identity kernel, and the per-position weight contraction
+    that follows is a single batched matmul on the MXU — the TPU-native shape
+    of the reference's unshared-weights loop (libnd4j im2col + per-position
+    GEMM in LocallyConnected2D's SameDiff definition).
+    Channel order in the last dim is C-major (lax convention: C*kh*kw).
+    """
+    kernel = _pair(kernel)
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _padding(padding, kernel, dilation, 2)
+    return lax.conv_general_dilated_patches(
+        x, filter_shape=kernel, window_strides=stride, padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, 1, *kernel), ("NHWC", "OIHW", "NHWC")),
+    )
+
+
 def depthwise_conv2d(x, w, b=None, *, stride=1, padding="SAME", dilation=1, data_format="NHWC"):
     """Depthwise conv (ref: libnd4j depthwise_conv2d).
 

@@ -1,0 +1,80 @@
+"""Greedy layer-wise unsupervised pretraining.
+
+ref: org.deeplearning4j.nn.multilayer.MultiLayerNetwork.pretrain(iter) /
+pretrainLayer(layerIdx, iter) — for each pretrain-capable layer in order,
+feed the dataset forward through the already-trained prefix and run
+unsupervised updates on that layer alone.
+
+TPU-native: one jitted step per pretrain layer; the prefix forward and the
+layer's pretrain objective trace into a single XLA program, and only the
+target layer's params are differentiated (the prefix is closed over as
+constants, so XLA folds it into the data path — the reference's "frozen
+prefix" for free).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.train.updaters import Sgd, apply_updates
+
+
+def pretrain_layer(model, variables, layer_index: int, batches,
+                   *, updater=None, epochs: int = 1, seed: int = 0,
+                   listener=None) -> Dict[str, Any]:
+    """↔ MultiLayerNetwork.pretrainLayer. Returns updated variables.
+
+    ``batches`` is a reusable iterable of batch dicts (or arrays) whose
+    'features' feed the network input.
+    """
+    layer = model.layers[layer_index]
+    name = model.layer_names[layer_index]
+    if not hasattr(layer, "pretrain_loss"):
+        return variables
+    updater = updater or Sgd(1e-2)
+    init_fn, update_fn = updater.make()
+
+    def loss_fn(layer_params, feats, rng):
+        p_all = dict(variables["params"])
+        p_all[name] = layer_params
+        x, _ = model.apply({"params": p_all, "state": variables["state"]},
+                           feats, train=False, up_to=layer_index)
+        return layer.pretrain_loss(
+            layer_params, variables["state"].get(name, {}), x, rng)
+
+    @jax.jit
+    def step(layer_params, opt_state, n, feats, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(layer_params, feats, rng)
+        updates, opt_state = update_fn(grads, opt_state, layer_params, n)
+        return apply_updates(layer_params, updates), opt_state, loss
+
+    lp = variables["params"][name]
+    opt_state = init_fn(lp)
+    rng = jax.random.key(seed)
+    n = 0
+    for _ in range(epochs):
+        for batch in batches:
+            feats = batch["features"] if isinstance(batch, dict) else batch
+            rng, sub = jax.random.split(rng)
+            lp, opt_state, loss = step(lp, opt_state, jnp.asarray(n), feats, sub)
+            n += 1
+            if listener is not None:
+                listener(layer_index, n, float(loss))
+    new_params = dict(variables["params"])
+    new_params[name] = lp
+    return {"params": new_params, "state": variables["state"]}
+
+
+def pretrain(model, variables, batches, *, updater=None, epochs: int = 1,
+             seed: int = 0, listener=None) -> Dict[str, Any]:
+    """↔ MultiLayerNetwork.pretrain: greedy layer-wise over all
+    pretrain-capable layers in network order."""
+    for i, layer in enumerate(model.layers):
+        if hasattr(layer, "pretrain_loss"):
+            variables = pretrain_layer(
+                model, variables, i, batches, updater=updater,
+                epochs=epochs, seed=seed + i, listener=listener)
+    return variables

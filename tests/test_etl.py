@@ -217,3 +217,59 @@ class TestImagePipeline:
         async_it = AsyncDataSetIterator(base, prefetch=2)
         batches = list(async_it)
         assert len(batches) == 2
+
+
+# --- round-3 reader additions ----------------------------------------------
+
+
+def test_regex_line_record_reader(tmp_path):
+    from deeplearning4j_tpu.data import RegexLineRecordReader
+
+    p = tmp_path / "log.txt"
+    p.write_text("2026-01-01 INFO start\n2026-01-02 WARN slow\n")
+    rr = RegexLineRecordReader(p, r"(\S+) (\S+) (.*)")
+    recs = list(rr)
+    assert recs == [["2026-01-01", "INFO", "start"],
+                    ["2026-01-02", "WARN", "slow"]]
+
+
+def test_regex_reader_strict_and_skip(tmp_path):
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.data import RegexLineRecordReader
+
+    p = tmp_path / "log.txt"
+    p.write_text("a 1\nmalformed\nb 2\n")
+    with _pytest.raises(ValueError):
+        list(RegexLineRecordReader(p, r"(\w) (\d)"))
+    recs = list(RegexLineRecordReader(p, r"(\w) (\d)", skip_unmatched=True))
+    assert recs == [["a", "1"], ["b", "2"]]
+
+
+def test_json_line_record_reader(tmp_path):
+    from deeplearning4j_tpu.data import JsonLineRecordReader
+
+    p = tmp_path / "data.jsonl"
+    p.write_text('{"x": 1, "meta": {"y": 2}}\n\n{"x": 3, "meta": {"y": 4}}\n')
+    rr = JsonLineRecordReader(p, ["x", "meta.y"])
+    assert list(rr) == [[1, 2], [3, 4]]
+
+
+def test_svmlight_record_reader_to_dataset(tmp_path):
+    import numpy as np
+
+    from deeplearning4j_tpu.data import (
+        RecordReaderDataSetIterator,
+        SVMLightRecordReader,
+    )
+
+    p = tmp_path / "data.svm"
+    p.write_text("1 1:0.5 3:2.0 # comment\n0 2:1.5\n")
+    rr = SVMLightRecordReader(p, num_features=3)
+    recs = list(rr)
+    assert recs[0] == [0.5, 0.0, 2.0, "1"]
+    assert recs[1] == [0.0, 1.5, 0.0, "0"]
+    it = RecordReaderDataSetIterator(rr, batch_size=2, num_classes=2)
+    ds = next(iter(it))
+    np.testing.assert_allclose(ds.features, [[0.5, 0.0, 2.0], [0.0, 1.5, 0.0]])
+    np.testing.assert_allclose(ds.labels, [[0, 1], [1, 0]])

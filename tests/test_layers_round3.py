@@ -1,0 +1,331 @@
+"""Round-3 layer additions: 3D conv tail, locally-connected, loss layers,
+autoencoder/VAE pretrain layers, MaskZeroLayer.
+
+ref test strategy: deeplearning4j-core layer unit tests + the
+MultiLayerTest pretrain tests (SURVEY §4 'Layer/network unit tests' and
+'overfit-tiny-dataset convergence sanity').
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.config import config_from_json
+
+
+def _check(layer, input_shape, batch=2, dtype=jnp.float32, **apply_kw):
+    rng = jax.random.key(0)
+    params, state = layer.init(rng, input_shape, dtype)
+    x = jax.random.normal(jax.random.key(1), (batch, *input_shape), dtype)
+    y, _ = layer.apply(params, state, x, **apply_kw)
+    expected = layer.output_shape(input_shape)
+    assert y.shape == (batch, *expected), (
+        f"{type(layer).__name__}: {y.shape} != {(batch, *expected)}")
+    assert jnp.all(jnp.isfinite(y))
+    return params, y
+
+
+# --- 3D tail ---------------------------------------------------------------
+
+def test_deconv3d_shape():
+    params, _ = _check(L.Deconv3D(filters=4, kernel=2, stride=2), (3, 4, 5, 2))
+    assert params["W"].shape == (2, 2, 2, 2, 4)
+
+
+def test_pooling3d_max_and_avg():
+    _check(L.Pooling3D(pool_type="max", window=2), (4, 4, 4, 3))
+    _check(L.Pooling3D(pool_type="avg", window=2), (4, 4, 4, 3))
+
+
+def test_upsampling3d():
+    _, y = _check(L.Upsampling3D(scale=2), (2, 3, 4, 5))
+    assert y.shape == (2, 4, 6, 8, 5)
+
+
+def test_zeropad_crop3d_roundtrip():
+    pad = L.ZeroPadding3D(padding=(1, 2, 0, 1, 2, 0))
+    crop = L.Cropping3D(cropping=(1, 2, 0, 1, 2, 0))
+    x = jax.random.normal(jax.random.key(0), (2, 3, 4, 5, 2))
+    y, _ = pad.apply({}, {}, x)
+    z, _ = crop.apply({}, {}, y)
+    np.testing.assert_allclose(z, x)
+
+
+def test_depth_to_space_inverts_space_to_depth():
+    s2d = L.SpaceToDepth(block_size=2)
+    d2s = L.DepthToSpace(block_size=2)
+    x = jax.random.normal(jax.random.key(0), (2, 4, 6, 3))
+    y, _ = s2d.apply({}, {}, x)
+    z, _ = d2s.apply({}, {}, y)
+    np.testing.assert_allclose(z, x)
+
+
+# --- locally connected -----------------------------------------------------
+
+def test_locally_connected2d_matches_explicit_loop():
+    """Oracle: per-position einsum == naive python loop over positions."""
+    layer = L.LocallyConnected2D(filters=3, kernel=2, stride=1,
+                                 padding="VALID", use_bias=True)
+    input_shape = (4, 5, 2)
+    params, _ = layer.init(jax.random.key(0), input_shape, jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (2, *input_shape))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (2, 3, 4, 3)
+    W, b = np.array(params["W"]), np.array(params["b"])
+    xn = np.array(x)
+    # patch layout is C-major (lax.conv_general_dilated_patches): C, kh, kw
+    for oh in range(3):
+        for ow in range(4):
+            patch = xn[:, oh:oh + 2, ow:ow + 2, :]          # [N,kh,kw,C]
+            patch = patch.transpose(0, 3, 1, 2).reshape(2, -1)  # C-major
+            ref = patch @ W[oh, ow] + b[oh, ow]
+            np.testing.assert_allclose(np.array(y[:, oh, ow]), ref,
+                                       rtol=1e-5, atol=1e-5)
+
+
+def test_locally_connected1d_shape_and_grad():
+    layer = L.LocallyConnected1D(filters=4, kernel=3, stride=1)
+    params, _ = layer.init(jax.random.key(0), (8, 2), jnp.float32)
+    assert params["W"].shape == (6, 6, 4)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 2))
+
+    def f(p):
+        y, _ = layer.apply(p, {}, x)
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(f)(params)
+    assert jnp.any(g["W"] != 0)
+
+
+# --- loss layers -----------------------------------------------------------
+
+def test_rnn_loss_layer_mask():
+    layer = L.RnnLossLayer(activation="softmax", loss="mcxent")
+    x = jax.random.normal(jax.random.key(0), (2, 5, 7))
+    labels = jax.nn.one_hot(jnp.zeros((2, 5), jnp.int32), 7)
+    full = layer.compute_loss({}, {}, x, labels)
+    mask = jnp.ones((2, 5)).at[:, 3:].set(0.0)
+    masked = layer.compute_loss({}, {}, x, labels, mask=mask)
+    trunc = layer.compute_loss({}, {}, x[:, :3], labels[:, :3])
+    np.testing.assert_allclose(float(masked), float(trunc), rtol=1e-5)
+    assert np.isfinite(float(full))
+
+
+def test_cnn_loss_layer_segmentation():
+    layer = L.CnnLossLayer(activation="softmax", loss="mcxent")
+    x = jax.random.normal(jax.random.key(0), (2, 4, 4, 3))
+    labels = jax.nn.one_hot(jnp.zeros((2, 4, 4), jnp.int32), 3)
+    loss = layer.compute_loss({}, {}, x, labels)
+    assert np.isfinite(float(loss))
+    # uniform-logit sanity: CE of uniform prediction = log(3)
+    loss_u = layer.compute_loss({}, {}, jnp.zeros((2, 4, 4, 3)), labels)
+    np.testing.assert_allclose(float(loss_u), np.log(3), rtol=1e-5)
+
+
+def test_center_loss_output_layer_trains_centers():
+    layer = L.CenterLossOutputLayer(units=3, lambda_=0.1)
+    params, _ = layer.init(jax.random.key(0), (6,), jnp.float32)
+    assert params["centers"].shape == (3, 6)
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    labels = jax.nn.one_hot(jnp.arange(8) % 3, 3)
+
+    def f(p):
+        return layer.compute_loss(p, {}, x, labels)
+
+    g = jax.grad(f)(params)
+    # both the classifier AND the centers receive gradient
+    assert jnp.any(g["W"] != 0)
+    assert jnp.any(g["centers"] != 0)
+    # center gradient for class k is λ·mean(c_k − f_i) over its members:
+    # pulls centers toward features (reference α-update direction)
+    ck = np.array(params["centers"][0])
+    feats = np.array(x[labels[:, 0] == 1])
+    gdir = np.array(g["centers"][0])
+    expected_dir = (ck - feats.mean(0)) * 0.1 * (feats.shape[0] / 8)
+    np.testing.assert_allclose(gdir, expected_dir, rtol=1e-4, atol=1e-5)
+
+
+# --- mask zero -------------------------------------------------------------
+
+def test_mask_zero_layer():
+    layer = L.MaskZeroLayer(mask_value=0.0)
+    x = jnp.array([[[1.0, 2.0], [0.0, 0.0], [3.0, 0.0]]])
+    y, _ = layer.apply({}, {}, x)
+    np.testing.assert_allclose(y, x)  # zero rows stay zero, others untouched
+    x2 = x.at[0, 0].set(0.0)
+    y2, _ = layer.apply({}, {}, x2)
+    assert float(jnp.sum(y2[0, 0])) == 0.0
+
+
+# --- autoencoder / VAE -----------------------------------------------------
+
+def _blob_data(n=64, d=12, seed=0):
+    r = np.random.default_rng(seed)
+    centers = r.normal(size=(3, d)) * 2
+    x = centers[r.integers(0, 3, n)] + 0.1 * r.normal(size=(n, d))
+    x = (x - x.min(0)) / (x.max(0) - x.min(0) + 1e-9)  # [0,1] (sigmoid AE)
+    return jnp.asarray(x.astype(np.float32))
+
+
+def test_autoencoder_pretrain_reduces_reconstruction():
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.nn.config import SequentialConfig, NeuralNetConfiguration
+    from deeplearning4j_tpu.train.pretrain import pretrain
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    ae = L.AutoEncoder(units=6, corruption_level=0.1, loss="mse")
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(seed=0),
+        input_shape=(12,),
+        layers=[ae, L.OutputLayer(units=3)],
+    ))
+    variables = model.init()
+    x = _blob_data()
+    name = model.layer_names[0]
+
+    def recon_err(v):
+        _, recon = ae._encode_decode(v["params"][name], x)
+        return float(jnp.mean((recon - x) ** 2))
+
+    before = recon_err(variables)
+    out = pretrain(model, variables, [{"features": x}], updater=Adam(1e-2),
+                   epochs=30)
+    after = recon_err(out)
+    assert after < before * 0.7, (before, after)
+    # non-pretrain layers untouched
+    np.testing.assert_allclose(out["params"][model.layer_names[1]]["W"],
+                               variables["params"][model.layer_names[1]]["W"])
+
+
+def test_vae_pretrain_improves_elbo_and_shapes():
+    vae = L.VariationalAutoencoder(
+        units=4, encoder_sizes=(16,), decoder_sizes=(16,),
+        reconstruction="gaussian", num_samples=2)
+    params, _ = vae.init(jax.random.key(0), (12,), jnp.float32)
+    x = _blob_data()
+    # supervised forward = posterior mean
+    y, _ = vae.apply(params, {}, x)
+    assert y.shape == (64, 4)
+
+    from deeplearning4j_tpu.train.updaters import apply_updates, Adam
+    init_fn, update_fn = Adam(1e-2).make()
+    opt = init_fn(params)
+    rng = jax.random.key(1)
+
+    @jax.jit
+    def step(p, o, n, k):
+        loss, g = jax.value_and_grad(
+            lambda pp: vae.pretrain_loss(pp, {}, x, k))(p)
+        upd, o = update_fn(g, o, p, n)
+        return apply_updates(p, upd), o, loss
+
+    first = None
+    for i in range(60):
+        rng, sub = jax.random.split(rng)
+        params, opt, loss = step(params, opt, jnp.asarray(i), sub)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first - 1.0, (first, float(loss))
+    # reconstruction through the mean improves over init
+    recon = vae.reconstruct(params, x)
+    assert recon.shape == x.shape
+    assert float(jnp.mean((recon - x) ** 2)) < float(jnp.var(x))
+
+
+def test_vae_bernoulli_mode():
+    vae = L.VariationalAutoencoder(
+        units=3, encoder_sizes=(8,), decoder_sizes=(8,),
+        reconstruction="bernoulli")
+    params, _ = vae.init(jax.random.key(0), (10,), jnp.float32)
+    x = (jax.random.uniform(jax.random.key(1), (16, 10)) > 0.5).astype(
+        jnp.float32)
+    loss = vae.pretrain_loss(params, {}, x, jax.random.key(2))
+    assert np.isfinite(float(loss))
+    g = jax.grad(lambda p: vae.pretrain_loss(p, {}, x, jax.random.key(2)))(
+        params)
+    assert jnp.any(g["oW"] != 0)
+
+
+# --- config round-trip for every new layer ---------------------------------
+
+@pytest.mark.parametrize("layer", [
+    L.Deconv3D(filters=2, kernel=2),
+    L.Pooling3D(window=2),
+    L.Upsampling3D(scale=2),
+    L.ZeroPadding3D(),
+    L.Cropping3D(),
+    L.DepthToSpace(block_size=2),
+    L.LocallyConnected1D(filters=2, kernel=3),
+    L.LocallyConnected2D(filters=2, kernel=3),
+    L.RnnLossLayer(loss="mse"),
+    L.CnnLossLayer(loss="mse"),
+    L.CenterLossOutputLayer(units=4, lambda_=0.1),
+    L.MaskZeroLayer(),
+    L.AutoEncoder(units=4),
+    L.VariationalAutoencoder(units=4),
+])
+def test_new_layer_json_roundtrip(layer):
+    js = layer.to_json()
+    restored = config_from_json(js)
+    assert type(restored) is type(layer)
+    assert restored.to_json() == js
+
+
+# --- review-fix regressions ------------------------------------------------
+
+def test_locally_connected_init_std_independent_of_spatial_size():
+    """fan_in must be the patch size, not patch*positions (r3 review)."""
+    small = L.LocallyConnected2D(filters=8, kernel=3, weight_init="relu")
+    big = L.LocallyConnected2D(filters=8, kernel=3, weight_init="relu")
+    ps, _ = small.init(jax.random.key(0), (6, 6, 4), jnp.float32)
+    pb, _ = big.init(jax.random.key(0), (30, 30, 4), jnp.float32)
+    std_s = float(jnp.std(ps["W"]))
+    std_b = float(jnp.std(pb["W"]))
+    expected = np.sqrt(2.0 / (3 * 3 * 4))  # He with fan_in = patch
+    assert abs(std_s - expected) / expected < 0.15, (std_s, expected)
+    assert abs(std_b - expected) / expected < 0.15, (std_b, expected)
+
+
+def test_autoencoder_accepts_nonflat_input():
+    ae = L.AutoEncoder(units=5, corruption_level=0.0)
+    params, _ = ae.init(jax.random.key(0), (4, 4, 2), jnp.float32)
+    assert params["W"].shape == (32, 5)
+    x = jax.random.uniform(jax.random.key(1), (3, 4, 4, 2))
+    y, _ = ae.apply(params, {}, x)
+    assert y.shape == (3, 5)
+    loss = ae.pretrain_loss(params, {}, x, jax.random.key(2))
+    assert np.isfinite(float(loss))
+
+
+def test_vae_accepts_nonflat_input():
+    vae = L.VariationalAutoencoder(units=3, encoder_sizes=(8,),
+                                   decoder_sizes=(8,))
+    params, _ = vae.init(jax.random.key(0), (4, 4, 2), jnp.float32)
+    x = jax.random.uniform(jax.random.key(1), (3, 4, 4, 2))
+    y, _ = vae.apply(params, {}, x)
+    assert y.shape == (3, 3)
+    assert np.isfinite(float(vae.pretrain_loss(params, {}, x,
+                                               jax.random.key(2))))
+
+
+def test_center_loss_mask_excludes_rows():
+    layer = L.CenterLossOutputLayer(units=3, lambda_=1.0)
+    params, _ = layer.init(jax.random.key(0), (6,), jnp.float32)
+    params = dict(params, centers=jax.random.normal(jax.random.key(3), (3, 6)))
+    x = jax.random.normal(jax.random.key(1), (4, 6))
+    labels = jax.nn.one_hot(jnp.array([0, 1, 2, 0]), 3)
+    mask = jnp.array([1.0, 1.0, 0.0, 0.0])
+    masked = layer.compute_loss(params, {}, x, labels, mask=mask)
+    trunc = layer.compute_loss(params, {}, x[:2], labels[:2])
+    np.testing.assert_allclose(float(masked), float(trunc), rtol=1e-5)
+
+
+def test_svmlight_out_of_range_raises(tmp_path):
+    from deeplearning4j_tpu.data import SVMLightRecordReader
+
+    p = tmp_path / "bad.svm"
+    p.write_text("1 0:9.0\n")  # zero-based index with 1-based default
+    with pytest.raises(ValueError, match="out of range"):
+        list(SVMLightRecordReader(p, num_features=3))
