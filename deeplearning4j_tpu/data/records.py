@@ -127,7 +127,7 @@ class RegexLineRecordReader(RecordReader):
                 for i, line in enumerate(f):
                     if i < self.skip_lines:
                         continue
-                    m = self.pattern.match(line.rstrip("\n"))
+                    m = self.pattern.fullmatch(line.rstrip("\n"))
                     if m is None:
                         if self.skip_unmatched:
                             continue

@@ -1,6 +1,10 @@
 """Evaluation stack (↔ org.nd4j.evaluation.**)."""
 
-from deeplearning4j_tpu.evaluation.classification import Evaluation, evaluate_model
+from deeplearning4j_tpu.evaluation.classification import (
+    Evaluation,
+    EvaluationBinary,
+    evaluate_model,
+)
 from deeplearning4j_tpu.evaluation.curves import (
     ROC,
     EvaluationCalibration,
@@ -9,5 +13,6 @@ from deeplearning4j_tpu.evaluation.curves import (
 )
 from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
 
-__all__ = ["Evaluation", "evaluate_model", "RegressionEvaluation",
+__all__ = ["Evaluation", "EvaluationBinary", "evaluate_model",
+           "RegressionEvaluation",
            "ROC", "ROCBinary", "ROCMultiClass", "EvaluationCalibration"]

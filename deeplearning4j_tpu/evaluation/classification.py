@@ -110,6 +110,97 @@ class Evaluation:
         return "\n".join(lines)
 
 
+@jax.jit
+def _binary_counts_update(counts, probs, labels, thresholds):
+    """counts: [4, L] stacked TP/FP/TN/FN per output column."""
+    pred = (probs >= thresholds).astype(jnp.float32)
+    lab = labels.astype(jnp.float32)
+    tp = jnp.sum(pred * lab, axis=0)
+    fp = jnp.sum(pred * (1 - lab), axis=0)
+    tn = jnp.sum((1 - pred) * (1 - lab), axis=0)
+    fn = jnp.sum((1 - pred) * lab, axis=0)
+    return counts + jnp.stack([tp, fp, tn, fn])
+
+
+class EvaluationBinary:
+    """↔ org.nd4j.evaluation.classification.EvaluationBinary: independent
+    binary metrics PER OUTPUT column (multi-label networks with sigmoid
+    outputs), not mutually-exclusive classes like ``Evaluation``.
+
+    Per-batch accumulation is one on-device update of a [4, L] TP/FP/TN/FN
+    count array; metrics derive host-side at report time. ``thresholds``
+    mirrors the reference's per-output decision thresholds (default 0.5).
+    """
+
+    def __init__(self, num_outputs: int, labels_list: Optional[list] = None,
+                 thresholds=None):
+        self.num_outputs = num_outputs
+        self.labels_list = labels_list or [str(i) for i in range(num_outputs)]
+        t = np.full((num_outputs,), 0.5, np.float32) if thresholds is None \
+            else np.asarray(thresholds, np.float32)
+        self.thresholds = jnp.asarray(t)
+        self.counts = jnp.zeros((4, num_outputs), jnp.float32)
+        self._host = None  # memoized device_get of counts
+
+    def eval(self, labels, predictions):
+        self.counts = _binary_counts_update(
+            self.counts, predictions, labels, self.thresholds)
+        self._host = None
+        return self
+
+    def merge(self, other: "EvaluationBinary"):
+        self.counts = self.counts + other.counts
+        self._host = None
+        return self
+
+    def _np(self):
+        if self._host is None:
+            self._host = np.asarray(jax.device_get(self.counts))
+        return self._host
+
+    def true_positives(self):
+        return self._np()[0]
+
+    def false_positives(self):
+        return self._np()[1]
+
+    def true_negatives(self):
+        return self._np()[2]
+
+    def false_negatives(self):
+        return self._np()[3]
+
+    def accuracy(self, output: Optional[int] = None):
+        tp, fp, tn, fn = self._np()
+        tot = np.maximum(tp + fp + tn + fn, 1)
+        per = (tp + tn) / tot
+        return float(per[output]) if output is not None else float(per.mean())
+
+    def precision(self, output: Optional[int] = None):
+        tp, fp, _, _ = self._np()
+        per = np.divide(tp, tp + fp, out=np.zeros_like(tp), where=(tp + fp) > 0)
+        return float(per[output]) if output is not None else float(per.mean())
+
+    def recall(self, output: Optional[int] = None):
+        tp, _, _, fn = self._np()
+        per = np.divide(tp, tp + fn, out=np.zeros_like(tp), where=(tp + fn) > 0)
+        return float(per[output]) if output is not None else float(per.mean())
+
+    def f1(self, output: Optional[int] = None):
+        tp, fp, _, fn = self._np()
+        denom = 2 * tp + fp + fn
+        per = np.divide(2 * tp, denom, out=np.zeros_like(tp), where=denom > 0)
+        return float(per[output]) if output is not None else float(per.mean())
+
+    def stats(self) -> str:
+        rows = [f"{'label':>12} {'acc':>7} {'prec':>7} {'recall':>7} {'f1':>7}"]
+        for i, name in enumerate(self.labels_list):
+            rows.append(
+                f"{name:>12} {self.accuracy(i):7.4f} {self.precision(i):7.4f} "
+                f"{self.recall(i):7.4f} {self.f1(i):7.4f}")
+        return "\n".join(rows)
+
+
 def evaluate_model(model, variables, data_iter, num_classes: int,
                    mesh=None) -> Evaluation:
     """↔ MultiLayerNetwork.evaluate(DataSetIterator).

@@ -15,11 +15,13 @@ from deeplearning4j_tpu.nlp.tokenization import (
 )
 from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.fasttext import FastText, char_ngrams
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.serde import load_word_vectors, save_word_vectors
 
 __all__ = [
+    "FastText", "char_ngrams",
     "DefaultTokenizerFactory",
     "NGramTokenizerFactory",
     "CommonPreprocessor",

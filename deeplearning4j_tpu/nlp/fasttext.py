@@ -1,0 +1,262 @@
+"""FastText-style subword skip-gram embeddings.
+
+ref: deeplearning4j-nlp org.deeplearning4j.models.fasttext.FastText (JNI
+wrapper over facebook fastText in the reference; SURVEY §2.7 NLP row
+"fastText-ish SequenceVectors") — word vectors composed from hashed
+character-n-gram vectors, giving OOV lookup and morphology sharing.
+
+TPU-first: same batched-SGNS shape as word2vec.py, but the center-word
+vector is the masked MEAN of (word row + its n-gram bucket rows), all
+gathered from one [1+vocab+buckets, D] table in a single jitted step —
+jax.grad turns the gathers into scatter-adds and XLA fuses the whole
+update. The reference's per-pair C++ loop becomes one device program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabCache,
+    build_vocab,
+    fixed_shape_batches,
+)
+from deeplearning4j_tpu.nlp.word2vec import _window_pairs
+
+
+def _fnv1a(s: str) -> int:
+    """32-bit FNV-1a over utf-8 bytes (the fastText n-gram hash)."""
+    h = 2166136261
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def char_ngrams(word: str, minn: int, maxn: int) -> List[str]:
+    """Boundary-marked character n-grams, excluding the full '<word>'."""
+    w = f"<{word}>"
+    out = []
+    for n in range(minn, maxn + 1):
+        for i in range(0, len(w) - n + 1):
+            g = w[i:i + n]
+            if g != w:
+                out.append(g)
+    return out
+
+
+class FastText:
+    """↔ org.deeplearning4j.models.fasttext.FastText (skip-gram mode).
+
+    Usage::
+
+        ft = FastText(vector_size=64, minn=3, maxn=5)
+        ft.fit(sentences)
+        ft.get_word_vector("unseenword")   # OOV via subwords
+    """
+
+    def __init__(self, *, vector_size: int = 100, window: int = 5,
+                 min_word_frequency: int = 5, negative: int = 5,
+                 subsample: float = 1e-3, learning_rate: float = 0.05,
+                 min_learning_rate: float = 1e-4, epochs: int = 1,
+                 batch_size: int = 2048, minn: int = 3, maxn: int = 6,
+                 bucket: int = 200_000, max_ngrams: int = 24, seed: int = 0,
+                 tokenizer: Optional[Callable] = None):
+        self.vector_size = vector_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.subsample = subsample
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.minn = minn
+        self.maxn = maxn
+        self.bucket = bucket
+        self.max_ngrams = max_ngrams  # n-gram slots per word (padded/truncated)
+        self.seed = seed
+        self.tokenizer = tokenizer or DefaultTokenizerFactory(CommonPreprocessor())
+        self.vocab: Optional[VocabCache] = None
+        self.in_vecs: Optional[np.ndarray] = None   # [1+vocab+bucket, D]
+        self.out_vecs: Optional[np.ndarray] = None  # [vocab, D]
+        self._ngram_ids: Optional[np.ndarray] = None  # [vocab, 1+max_ngrams]
+        self._ngram_mask: Optional[np.ndarray] = None
+        self._step = None
+
+    # -- subword indexing --------------------------------------------------
+
+    def _subword_row(self, word: str, word_id: Optional[int]):
+        """Padded row of table indices for a word: [word_row?, ngram rows...].
+
+        Table layout: row 0 = pad, rows 1..V = words, rows V+1.. = buckets.
+        """
+        width = 1 + self.max_ngrams
+        ids = np.zeros((width,), np.int32)
+        mask = np.zeros((width,), np.float32)
+        k = 0
+        if word_id is not None:
+            ids[k], mask[k] = 1 + word_id, 1.0
+            k += 1
+        for g in char_ngrams(word, self.minn, self.maxn)[: width - k]:
+            ids[k] = 1 + len(self.vocab) + _fnv1a(g) % self.bucket
+            mask[k] = 1.0
+            k += 1
+        return ids, mask
+
+    def _build_subword_table(self):
+        v = len(self.vocab)
+        self._ngram_ids = np.zeros((v, 1 + self.max_ngrams), np.int32)
+        self._ngram_mask = np.zeros((v, 1 + self.max_ngrams), np.float32)
+        for i, w in enumerate(self.vocab.words):
+            self._ngram_ids[i], self._ngram_mask[i] = self._subword_row(w, i)
+
+    # -- training ----------------------------------------------------------
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        def loss_fn(tables, batch):
+            inv, outv = tables
+            ngram_ids, ngram_mask, context, negatives = batch
+            v_sub = inv[ngram_ids] * ngram_mask[..., None]       # [B, G, D]
+            v_c = jnp.sum(v_sub, 1) / jnp.maximum(
+                jnp.sum(ngram_mask, 1, keepdims=True), 1.0)       # [B, D]
+            pos = jnp.sum(v_c * outv[context], -1)
+            neg = jnp.einsum("bd,bkd->bk", v_c, outv[negatives])
+            # SUM over batch: classic per-pair SGD batched (see word2vec.py)
+            return -jnp.sum(
+                jax.nn.log_sigmoid(pos) + jnp.sum(jax.nn.log_sigmoid(-neg), -1))
+
+        def step(tables, acc, batch, lr):
+            loss, grads = jax.value_and_grad(loss_fn)(tables, batch)
+            acc = jax.tree_util.tree_map(lambda a, g: a + g * g, acc, grads)
+            new = jax.tree_util.tree_map(
+                lambda t, g, a: t - lr * g / jnp.sqrt(a), tables, grads, acc)
+            return new, acc, loss / batch[0].shape[0]
+
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+
+    def _tokenize_corpus(self, corpus) -> List[List[str]]:
+        return [self.tokenizer(it) if isinstance(it, str) else list(it)
+                for it in corpus]
+
+    def fit(self, corpus: Iterable) -> List[float]:
+        import jax
+        import jax.numpy as jnp
+
+        sentences = self._tokenize_corpus(corpus)
+        self.vocab = build_vocab(
+            sentences, min_word_frequency=self.min_word_frequency,
+            subsample=self.subsample)
+        if len(self.vocab) < 2:
+            raise ValueError("vocabulary too small (check min_word_frequency)")
+        self._build_subword_table()
+        encoded = [self.vocab.encode(s) for s in sentences]
+        encoded = [s for s in encoded if len(s) > 1]
+        v, d = len(self.vocab), self.vector_size
+        rs = np.random.RandomState(self.seed)
+        n_rows = 1 + v + self.bucket
+        self.in_vecs = ((rs.rand(n_rows, d) - 0.5) / d).astype(np.float32)
+        self.in_vecs[0] = 0.0  # pad row
+        self.out_vecs = np.zeros((v, d), np.float32)
+        acc = (np.full((n_rows, d), 1e-6, np.float32),
+               np.full((v, d), 1e-6, np.float32))
+        self._build_step()
+        rng = np.random.default_rng(self.seed)
+
+        def batches():
+            pairs: List[Tuple[int, int]] = []
+            for ids in encoded:
+                pairs.extend(_window_pairs(ids, self.window, rng,
+                                           self.vocab.keep_probs))
+            arr = np.asarray(pairs, np.int32).reshape(-1, 2)
+            for sel in fixed_shape_batches(len(arr), self.batch_size, rng,
+                                           what="fastText pairs"):
+                chunk = arr[sel]
+                negs = self.vocab.sample_negatives(
+                    rng, (len(sel), self.negative)).astype(np.int32)
+                yield (self._ngram_ids[chunk[:, 0]],
+                       self._ngram_mask[chunk[:, 0]], chunk[:, 1], negs)
+
+        tables = (jnp.asarray(self.in_vecs), jnp.asarray(self.out_vecs))
+        acc = tuple(jnp.asarray(a) for a in acc)
+        history = []
+        for e in range(self.epochs):
+            cur_lr = self.learning_rate - (
+                self.learning_rate - self.min_learning_rate
+            ) * e / max(self.epochs - 1, 1)
+            losses = []
+            for batch in batches():
+                tables, acc, loss = self._step(
+                    tables, acc, tuple(jnp.asarray(a) for a in batch),
+                    jnp.float32(cur_lr))
+                losses.append(loss)
+            if losses:
+                history.append(float(np.mean(jax.device_get(losses))))
+        self.in_vecs, self.out_vecs = (np.asarray(t) for t in tables)
+        self._vocab_mat = None  # invalidate words_nearest cache
+        return history
+
+    # -- lookups (↔ WordVectors interface; OOV supported) ------------------
+
+    def _check_fit(self):
+        if self.in_vecs is None or self.vocab is None:
+            raise RuntimeError("call fit() first")
+
+    def has_word(self, w: str) -> bool:
+        return self.vocab is not None and w in self.vocab
+
+    def get_word_vector(self, w: str) -> np.ndarray:
+        """In-vocab: mean of word row + its n-gram rows. OOV: mean of the
+        n-gram rows alone (the fastText OOV story)."""
+        self._check_fit()
+        if w in self.vocab:
+            i = self.vocab.id_of(w)
+            ids, mask = self._ngram_ids[i], self._ngram_mask[i]
+        else:
+            ids, mask = self._subword_row(w, None)
+        n = max(float(mask.sum()), 1.0)
+        return (self.in_vecs[ids] * mask[:, None]).sum(0) / n
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        return float(va @ vb /
+                     (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    def _vocab_matrix(self) -> np.ndarray:
+        """[V, D] subword-composed vector per vocab word — one vectorized
+        gather over the precomputed ngram tables, cached after fit."""
+        if getattr(self, "_vocab_mat", None) is None:
+            num = (self.in_vecs[self._ngram_ids]
+                   * self._ngram_mask[..., None]).sum(1)
+            den = np.maximum(self._ngram_mask.sum(1, keepdims=True), 1.0)
+            self._vocab_mat = num / den
+        return self._vocab_mat
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        self._check_fit()
+        if isinstance(word_or_vec, str):
+            query = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            query = np.asarray(word_or_vec, np.float32)
+            exclude = set()
+        mat = self._vocab_matrix()
+        norms = np.linalg.norm(mat, axis=1) * (np.linalg.norm(query) + 1e-12)
+        sims = mat @ query / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_of(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) == top_n:
+                break
+        return out

@@ -58,7 +58,11 @@ def _masked_mean_loss(loss_name, activation, x, labels, *, mask=None,
         per = per * w
     if mask is not None:
         per = per * mask
-        return jnp.sum(per) / jnp.maximum(jnp.sum(mask), 1.0)
+        # Normalize by the surviving ELEMENT count: a broadcast mask (e.g.
+        # per-example [N,1,1] over per-pixel [N,H,W]) covers H*W elements
+        # per unmasked row, not 1.
+        n = jnp.sum(jnp.broadcast_to(mask, per.shape))
+        return jnp.sum(per) / jnp.maximum(n, 1.0)
     return jnp.mean(per)
 
 

@@ -12,6 +12,7 @@ from deeplearning4j_tpu.train.earlystopping import (
     MaxTimeTermination,
     ScoreImprovementEpochTermination,
 )
+from deeplearning4j_tpu.train.pretrain import pretrain, pretrain_layer
 from deeplearning4j_tpu.train.trainer import TrainState, Trainer
 from deeplearning4j_tpu.train.transfer import (
     FineTuneConfiguration,
@@ -33,6 +34,7 @@ from deeplearning4j_tpu.train.updaters import (
 )
 
 __all__ = [
+    "pretrain", "pretrain_layer",
     "listeners", "schedules", "updaters", "TrainState", "Trainer",
     "Sgd", "Adam", "AdamW", "AMSGrad", "Nadam", "AdaMax", "AdaGrad",
     "AdaDelta", "RmsProp", "Nesterovs", "NoOp",

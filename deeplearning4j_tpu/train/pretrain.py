@@ -51,6 +51,13 @@ def pretrain_layer(model, variables, layer_index: int, batches,
         updates, opt_state = update_fn(grads, opt_state, layer_params, n)
         return apply_updates(layer_params, updates), opt_state, loss
 
+    if iter(batches) is iter(batches):
+        # A one-shot generator would silently leave every epoch (and every
+        # later pretrain layer) with zero batches — reject it up front.
+        raise TypeError(
+            "`batches` must be a re-iterable collection (list, dataset "
+            "iterator with reset), not a one-shot generator: greedy "
+            "layer-wise pretraining iterates it once per epoch per layer")
     lp = variables["params"][name]
     opt_state = init_fn(lp)
     rng = jax.random.key(seed)
@@ -63,6 +70,8 @@ def pretrain_layer(model, variables, layer_index: int, batches,
             n += 1
             if listener is not None:
                 listener(layer_index, n, float(loss))
+    if n == 0:
+        raise ValueError("pretrain received an empty batch iterable")
     new_params = dict(variables["params"])
     new_params[name] = lp
     return {"params": new_params, "state": variables["state"]}

@@ -273,3 +273,15 @@ def test_svmlight_record_reader_to_dataset(tmp_path):
     ds = next(iter(it))
     np.testing.assert_allclose(ds.features, [[0.5, 0.0, 2.0], [0.0, 1.5, 0.0]])
     np.testing.assert_allclose(ds.labels, [[0, 1], [1, 0]])
+
+
+def test_regex_reader_rejects_trailing_garbage(tmp_path):
+    """fullmatch semantics (DataVec Matcher.matches), not prefix match."""
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.data import RegexLineRecordReader
+
+    p = tmp_path / "log.txt"
+    p.write_text("a 1 GARBAGE\n")
+    with _pytest.raises(ValueError):
+        list(RegexLineRecordReader(p, r"(\w+) (\d+)"))

@@ -288,3 +288,53 @@ class TestShardedEvaluation:
         sharded = evaluate_model(model, variables, it(), 3, mesh=mesh)
         np.testing.assert_array_equal(single.confusion(), sharded.confusion())
         assert sharded.confusion().sum() == 22
+
+
+# --- EvaluationBinary (round 3) --------------------------------------------
+
+
+def test_evaluation_binary_against_sklearn_style_oracle():
+    """Per-output binary counts vs a hand-computed numpy oracle."""
+    import numpy as np
+
+    from deeplearning4j_tpu.evaluation import EvaluationBinary
+
+    r = np.random.default_rng(0)
+    probs = r.random((200, 3)).astype(np.float32)
+    labels = (r.random((200, 3)) > 0.6).astype(np.float32)
+    ev = EvaluationBinary(3)
+    # two batches to exercise accumulation
+    ev.eval(labels[:120], probs[:120])
+    ev.eval(labels[120:], probs[120:])
+    pred = (probs >= 0.5).astype(np.float32)
+    for i in range(3):
+        tp = float(((pred[:, i] == 1) & (labels[:, i] == 1)).sum())
+        fp = float(((pred[:, i] == 1) & (labels[:, i] == 0)).sum())
+        fn = float(((pred[:, i] == 0) & (labels[:, i] == 1)).sum())
+        tn = float(((pred[:, i] == 0) & (labels[:, i] == 0)).sum())
+        assert ev.true_positives()[i] == tp
+        assert ev.false_positives()[i] == fp
+        np.testing.assert_allclose(ev.accuracy(i), (tp + tn) / 200, rtol=1e-6)
+        if tp + fp:
+            np.testing.assert_allclose(ev.precision(i), tp / (tp + fp),
+                                       rtol=1e-6)
+        if tp + fn:
+            np.testing.assert_allclose(ev.recall(i), tp / (tp + fn), rtol=1e-6)
+    assert "label" in ev.stats()
+
+
+def test_evaluation_binary_custom_thresholds_and_merge():
+    import numpy as np
+
+    from deeplearning4j_tpu.evaluation import EvaluationBinary
+
+    probs = np.array([[0.3, 0.9], [0.6, 0.2]], np.float32)
+    labels = np.array([[1, 1], [0, 0]], np.float32)
+    ev = EvaluationBinary(2, thresholds=[0.25, 0.95])
+    ev.eval(labels, probs)
+    # col0 thr .25: preds 1,1 -> tp=1 fp=1; col1 thr .95: preds 0,0 -> fn=1 tn=1
+    assert ev.true_positives()[0] == 1 and ev.false_positives()[0] == 1
+    assert ev.false_negatives()[1] == 1 and ev.true_negatives()[1] == 1
+    ev2 = EvaluationBinary(2, thresholds=[0.25, 0.95]).eval(labels, probs)
+    ev.merge(ev2)
+    assert ev.true_positives()[0] == 2

@@ -329,3 +329,29 @@ def test_svmlight_out_of_range_raises(tmp_path):
     p.write_text("1 0:9.0\n")  # zero-based index with 1-based default
     with pytest.raises(ValueError, match="out of range"):
         list(SVMLightRecordReader(p, num_features=3))
+
+
+def test_pretrain_rejects_one_shot_generator():
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.nn.config import SequentialConfig, NeuralNetConfiguration
+    from deeplearning4j_tpu.train.pretrain import pretrain
+
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(seed=0), input_shape=(4,),
+        layers=[L.AutoEncoder(units=2), L.OutputLayer(units=2)]))
+    variables = model.init()
+    gen = ({"features": jnp.ones((2, 4))} for _ in range(3))
+    with pytest.raises(TypeError, match="re-iterable"):
+        pretrain(model, variables, gen)
+
+
+def test_cnn_loss_broadcast_mask_normalization():
+    """Per-example [N,1,1] mask over [N,H,W] pixels must average over the
+    surviving pixels, not the surviving examples (r3 review)."""
+    layer = L.CnnLossLayer(activation="softmax", loss="mcxent")
+    x = jax.random.normal(jax.random.key(0), (4, 3, 3, 5))
+    labels = jax.nn.one_hot(jnp.zeros((4, 3, 3), jnp.int32), 5)
+    mask = jnp.array([1.0, 1.0, 0.0, 0.0]).reshape(4, 1, 1)
+    masked = layer.compute_loss({}, {}, x, labels, mask=mask)
+    trunc = layer.compute_loss({}, {}, x[:2], labels[:2])
+    np.testing.assert_allclose(float(masked), float(trunc), rtol=1e-5)

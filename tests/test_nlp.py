@@ -196,3 +196,38 @@ class TestDistributedEmbeddings:
         sharded.fit(corpus)
         np.testing.assert_allclose(sharded.vectors, single.vectors,
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestFastText:
+    @pytest.fixture(scope="class")
+    def ft(self):
+        from deeplearning4j_tpu.nlp import FastText
+
+        m = FastText(vector_size=24, window=3, min_word_frequency=1,
+                     negative=4, epochs=12, batch_size=1024, seed=1,
+                     subsample=0.0, minn=2, maxn=4, bucket=5000)
+        m.fit(_topic_corpus())
+        return m
+
+    def test_topic_similarity_structure(self, ft):
+        within = ft.similarity("cat", "dog")
+        across = ft.similarity("cat", "gpu")
+        assert within > across + 0.2, (within, across)
+
+    def test_oov_lookup_via_subwords(self, ft):
+        v = ft.get_word_vector("cats")  # OOV — shares <ca, cat, ats> etc.
+        assert v.shape == (24,)
+        assert np.linalg.norm(v) > 0
+        # OOV morphological variant lands nearer its stem's topic than the
+        # other topic's words
+        assert ft.similarity("cats", "dog") > ft.similarity("cats", "gpu")
+
+    def test_ngram_extraction(self):
+        from deeplearning4j_tpu.nlp import char_ngrams
+
+        grams = char_ngrams("cat", 3, 3)
+        assert grams == ["<ca", "cat", "at>"]
+
+    def test_words_nearest(self, ft):
+        near = ft.words_nearest("cpu", 4)
+        assert set(near) <= {"gpu", "ram", "disk", "cache"}
