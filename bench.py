@@ -44,11 +44,14 @@ import time
 # baseline. None = no honest measurement recorded yet: the first green
 # driver run with this methodology becomes the baseline (update these from
 # BENCH_r03.json's per-config values, per BASELINE.md policy).
+# Measured 2026-07-30 on the live TPU v5 lite chip with this methodology
+# (losses finite AND decreasing; MFU sanity-gated) at commit 6847fbb — see
+# BASELINE.md's measured table. Later runs must not regress these.
 BASELINES = {
-    "bert": None,       # tokens/sec/chip, b32 x s128, bf16 mixed
-    "resnet50": None,   # samples/sec/chip, b32 224x224, bf16 mixed
-    "lstm": None,       # tokens/sec/chip, b32 x s256, GravesLSTM pallas
-    "lenet": None,      # samples/sec/chip, b256 28x28
+    "bert": 44489.2,    # tokens/sec/chip, b32 x s128, bf16 mixed (mfu .151)
+    "resnet50": 199.5,  # samples/sec/chip, b32 224x224, bf16 mixed
+    "lstm": 194017.1,   # tokens/sec/chip, b32 x s256, GravesLSTM pallas
+    "lenet": 6605.7,    # samples/sec/chip, b256 28x28
 }
 
 # Published dense bf16 peak FLOP/s per chip, keyed by device_kind substring
@@ -172,12 +175,17 @@ def _timed_train(trainer, ts, batch, *, warmup: int, iters: int,
         ts, m = trainer.train_step(ts, batch)
     float(jax.device_get(m["total_loss"]))  # sync before opening the window
 
+    import jax.numpy as jnp
+
     losses = []
     t0 = time.perf_counter()
     for _ in range(iters):
         ts, m = trainer.train_step(ts, batch)
         losses.append(m["total_loss"])
-    host_losses = [float(x) for x in jax.device_get(losses)]
+    # Stack on device first: ONE tunnel round-trip for the whole loss
+    # vector (a python-list get fetches each tiny buffer separately),
+    # still data-dependent on every step.
+    host_losses = [float(x) for x in jax.device_get(jnp.stack(losses))]
     # Force the last param update too (loss i depends only on params i-1).
     last_leaf = jax.tree_util.tree_leaves(ts.params)[0]
     float(jax.device_get(last_leaf.ravel()[0]))

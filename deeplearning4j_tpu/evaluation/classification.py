@@ -143,6 +143,16 @@ class EvaluationBinary:
         self._host = None  # memoized device_get of counts
 
     def eval(self, labels, predictions):
+        labels = jnp.asarray(labels)
+        predictions = jnp.asarray(predictions)
+        if labels.ndim == 1:      # [N] with num_outputs=1 → [N,1]
+            labels = labels[:, None]
+        if predictions.ndim == 1:
+            predictions = predictions[:, None]
+        if predictions.shape[-1] != self.num_outputs:
+            raise ValueError(
+                f"predictions last dim {predictions.shape[-1]} != "
+                f"num_outputs {self.num_outputs}")
         self.counts = _binary_counts_update(
             self.counts, predictions, labels, self.thresholds)
         self._host = None
@@ -176,21 +186,29 @@ class EvaluationBinary:
         per = (tp + tn) / tot
         return float(per[output]) if output is not None else float(per.mean())
 
+    @staticmethod
+    def _agg(per, defined, output):
+        """Per-output value, or macro mean over DEFINED outputs only
+        (matching Evaluation's macro averaging of present classes)."""
+        if output is not None:
+            return float(per[output])
+        return float(per[defined].mean()) if defined.any() else 0.0
+
     def precision(self, output: Optional[int] = None):
         tp, fp, _, _ = self._np()
         per = np.divide(tp, tp + fp, out=np.zeros_like(tp), where=(tp + fp) > 0)
-        return float(per[output]) if output is not None else float(per.mean())
+        return self._agg(per, (tp + fp) > 0, output)
 
     def recall(self, output: Optional[int] = None):
         tp, _, _, fn = self._np()
         per = np.divide(tp, tp + fn, out=np.zeros_like(tp), where=(tp + fn) > 0)
-        return float(per[output]) if output is not None else float(per.mean())
+        return self._agg(per, (tp + fn) > 0, output)
 
     def f1(self, output: Optional[int] = None):
         tp, fp, _, fn = self._np()
         denom = 2 * tp + fp + fn
         per = np.divide(2 * tp, denom, out=np.zeros_like(tp), where=denom > 0)
-        return float(per[output]) if output is not None else float(per.mean())
+        return self._agg(per, denom > 0, output)
 
     def stats(self) -> str:
         rows = [f"{'label':>12} {'acc':>7} {'prec':>7} {'recall':>7} {'f1':>7}"]

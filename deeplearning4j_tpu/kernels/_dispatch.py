@@ -36,3 +36,15 @@ def force_pallas() -> bool:
 def use_pallas() -> bool:
     """Should callers dispatch to the Pallas kernel at all?"""
     return on_tpu() or force_pallas()
+
+
+def flash_min_seq() -> int:
+    """Sequence length at/above which attention auto-dispatch prefers the
+    Pallas flash kernel over XLA's fused attention.
+
+    Measured on TPU v5e (BENCH kernels_ab, 2026-07-30, B8 H12 T512 D64):
+    XLA wins the forward 8x and the backward 1.2x at short sequences —
+    the flash kernel's O(T) memory advantage only pays once the T^2 score
+    materialization pressures HBM. Override with DL4J_TPU_FLASH_MIN_SEQ.
+    """
+    return int(os.environ.get("DL4J_TPU_FLASH_MIN_SEQ", "1024"))

@@ -42,7 +42,11 @@ except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
 from deeplearning4j_tpu.kernels._dispatch import on_tpu as _on_tpu
-from deeplearning4j_tpu.kernels._dispatch import use_pallas as _use_pallas
+from deeplearning4j_tpu.kernels._dispatch import (
+    flash_min_seq as _flash_min_seq,
+    force_pallas as _force_pallas,
+    use_pallas as _use_pallas,
+)
 
 _NEG_INF = -1e30
 
@@ -431,17 +435,37 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale=None, bias=None,
-                    key_mask=None, block_q: int = 256, block_k: int = 256):
+                    key_mask=None, block_q: int = 256, block_k: int = 256,
+                    backend: str = None):
     """Blockwise attention; q [B,H,T,D], k/v [B,H,S,D] → [B,H,T,D].
 
     ``key_mask`` [B,S] 1/0 (padding mask) runs inside the kernel — the
     BERT path keeps the flash fast path. Arbitrary additive ``bias``
     forces the XLA fallback.
+
+    ``backend``: None (auto), 'pallas', or 'xla'. Auto dispatch picks XLA's
+    fused attention below ``_dispatch.flash_min_seq()`` keys — measured on
+    v5e it wins there (kernels_ab 2026-07-30: fwd 8x at T=512) — and the
+    Pallas kernel at long sequences where the O(T^2) score materialization
+    pressures HBM. DL4J_TPU_FORCE_PALLAS=1 (kernel unit tests) still
+    forces the kernel path.
     """
     d = q.shape[-1]
     scale = (d ** -0.5) if scale is None else scale
+    if backend not in (None, "pallas", "xla"):
+        raise ValueError(f"backend must be None|'pallas'|'xla', got {backend!r}")
+    # Hard constraints on the kernel path regardless of request (off-TPU
+    # without the force env, an explicit 'pallas' also falls back — the
+    # compiled kernel only exists on TPU):
     if (bias is not None or q.shape[2] < 8 or not _HAS_PLTPU
             or not _use_pallas()):
+        backend = "xla"
+    elif backend is None:
+        if _force_pallas() or k.shape[2] >= _flash_min_seq():
+            backend = "pallas"
+        else:
+            backend = "xla"
+    if backend == "xla":
         return reference_attention(q, k, v, causal=causal, bias=bias,
                                    key_mask=key_mask, scale=scale)
     return _flash(q, k, v, key_mask, causal, scale, block_q, block_k)

@@ -27,7 +27,7 @@ from deeplearning4j_tpu.nlp.vocab import (
     build_vocab,
     fixed_shape_batches,
 )
-from deeplearning4j_tpu.nlp.word2vec import _window_pairs
+from deeplearning4j_tpu.nlp.word2vec import _SGNSModel, _window_pairs
 
 
 def _fnv1a(s: str) -> int:
@@ -83,11 +83,9 @@ class FastText:
         self.seed = seed
         self.tokenizer = tokenizer or DefaultTokenizerFactory(CommonPreprocessor())
         self.vocab: Optional[VocabCache] = None
-        self.in_vecs: Optional[np.ndarray] = None   # [1+vocab+bucket, D]
-        self.out_vecs: Optional[np.ndarray] = None  # [vocab, D]
+        self._model: Optional[_SGNSModel] = None  # tables [1+V+bucket, D], [V, D]
         self._ngram_ids: Optional[np.ndarray] = None  # [vocab, 1+max_ngrams]
         self._ngram_mask: Optional[np.ndarray] = None
-        self._step = None
 
     # -- subword indexing --------------------------------------------------
 
@@ -118,39 +116,17 @@ class FastText:
 
     # -- training ----------------------------------------------------------
 
-    def _build_step(self):
-        import jax
-        import jax.numpy as jnp
-
-        def loss_fn(tables, batch):
-            inv, outv = tables
-            ngram_ids, ngram_mask, context, negatives = batch
-            v_sub = inv[ngram_ids] * ngram_mask[..., None]       # [B, G, D]
-            v_c = jnp.sum(v_sub, 1) / jnp.maximum(
-                jnp.sum(ngram_mask, 1, keepdims=True), 1.0)       # [B, D]
-            pos = jnp.sum(v_c * outv[context], -1)
-            neg = jnp.einsum("bd,bkd->bk", v_c, outv[negatives])
-            # SUM over batch: classic per-pair SGD batched (see word2vec.py)
-            return -jnp.sum(
-                jax.nn.log_sigmoid(pos) + jnp.sum(jax.nn.log_sigmoid(-neg), -1))
-
-        def step(tables, acc, batch, lr):
-            loss, grads = jax.value_and_grad(loss_fn)(tables, batch)
-            acc = jax.tree_util.tree_map(lambda a, g: a + g * g, acc, grads)
-            new = jax.tree_util.tree_map(
-                lambda t, g, a: t - lr * g / jnp.sqrt(a), tables, grads, acc)
-            return new, acc, loss / batch[0].shape[0]
-
-        self._step = jax.jit(step, donate_argnums=(0, 1))
-
     def _tokenize_corpus(self, corpus) -> List[List[str]]:
         return [self.tokenizer(it) if isinstance(it, str) else list(it)
                 for it in corpus]
 
     def fit(self, corpus: Iterable) -> List[float]:
-        import jax
-        import jax.numpy as jnp
-
+        """Train. The SGNS objective with a subword-composed center vector
+        IS word2vec's CBOW loss shape (masked-mean gather → pos/neg dots),
+        so training reuses _SGNSModel verbatim: batches are (ngram_ids,
+        ngram_mask, context, negatives) in place of CBOW's (contexts, mask,
+        center, negatives). That also inherits the mesh-shardable tables
+        (P5 embedding sharding) and AdaGrad state persistence."""
         sentences = self._tokenize_corpus(corpus)
         self.vocab = build_vocab(
             sentences, min_word_frequency=self.min_word_frequency,
@@ -160,15 +136,10 @@ class FastText:
         self._build_subword_table()
         encoded = [self.vocab.encode(s) for s in sentences]
         encoded = [s for s in encoded if len(s) > 1]
-        v, d = len(self.vocab), self.vector_size
-        rs = np.random.RandomState(self.seed)
-        n_rows = 1 + v + self.bucket
-        self.in_vecs = ((rs.rand(n_rows, d) - 0.5) / d).astype(np.float32)
-        self.in_vecs[0] = 0.0  # pad row
-        self.out_vecs = np.zeros((v, d), np.float32)
-        acc = (np.full((n_rows, d), 1e-6, np.float32),
-               np.full((v, d), 1e-6, np.float32))
-        self._build_step()
+        v = len(self.vocab)
+        self._model = _SGNSModel(1 + v + self.bucket, v, self.vector_size,
+                                 self.seed)
+        self._model.in_vecs[0] = 0.0  # pad row (masked out everywhere)
         rng = np.random.default_rng(self.seed)
 
         def batches():
@@ -185,24 +156,19 @@ class FastText:
                 yield (self._ngram_ids[chunk[:, 0]],
                        self._ngram_mask[chunk[:, 0]], chunk[:, 1], negs)
 
-        tables = (jnp.asarray(self.in_vecs), jnp.asarray(self.out_vecs))
-        acc = tuple(jnp.asarray(a) for a in acc)
-        history = []
-        for e in range(self.epochs):
-            cur_lr = self.learning_rate - (
-                self.learning_rate - self.min_learning_rate
-            ) * e / max(self.epochs - 1, 1)
-            losses = []
-            for batch in batches():
-                tables, acc, loss = self._step(
-                    tables, acc, tuple(jnp.asarray(a) for a in batch),
-                    jnp.float32(cur_lr))
-                losses.append(loss)
-            if losses:
-                history.append(float(np.mean(jax.device_get(losses))))
-        self.in_vecs, self.out_vecs = (np.asarray(t) for t in tables)
+        history = self._model.train_epochs(
+            batches, epochs=self.epochs, lr=self.learning_rate,
+            lr_min=self.min_learning_rate, mode="cbow")
         self._vocab_mat = None  # invalidate words_nearest cache
         return history
+
+    @property
+    def in_vecs(self) -> Optional[np.ndarray]:
+        return self._model.in_vecs if self._model is not None else None
+
+    @property
+    def out_vecs(self) -> Optional[np.ndarray]:
+        return self._model.out_vecs if self._model is not None else None
 
     # -- lookups (↔ WordVectors interface; OOV supported) ------------------
 

@@ -144,7 +144,9 @@ class _SGNSModel:
                     jnp.float32(cur_lr))
                 losses.append(loss)
             if losses:
-                history.append(float(np.mean(jax.device_get(losses))))
+                # Stack on device: one host fetch per epoch instead of one
+                # per batch (per-buffer fetches dominate on the TPU tunnel).
+                history.append(float(np.mean(jax.device_get(jnp.stack(losses)))))
         self.in_vecs, self.out_vecs = (np.asarray(t) for t in tables)
         self._acc = tuple(np.asarray(a) for a in acc)
         return history

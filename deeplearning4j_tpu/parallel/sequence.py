@@ -195,9 +195,12 @@ def ulysses_attention(
         km_full = lax.all_gather(km_l, seq_axis, axis=1, tiled=True) \
             if has_mask else None
         if use_flash:
+            # Explicit backend: use_flash=True means the Pallas kernel, not
+            # the auto-dispatch (which would route short sequences to XLA
+            # and make this flag a no-op).
             out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
                                   key_mask=km_full, block_q=block_q,
-                                  block_k=block_k)
+                                  block_k=block_k, backend="pallas")
         else:
             out = reference_attention(qh, kh, vh, causal=causal, scale=scale,
                                       key_mask=km_full)

@@ -73,7 +73,8 @@ def _flash_ab(iters=30):
 
     out = {"shape": f"B{B} H{H} T{T} D{D}", "iters": iters}
 
-    flash_f = jax.jit(lambda q, k, v: flash_attention(q, k, v, key_mask=key_mask))
+    flash_f = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, key_mask=key_mask, backend="pallas"))
     ref_f = jax.jit(lambda q, k, v: reference_attention(q, k, v, key_mask=key_mask))
 
     of, orf = flash_f(q, k, v), ref_f(q, k, v)
@@ -84,7 +85,8 @@ def _flash_ab(iters=30):
     out["fwd_max_rel_err"] = _max_rel_err(of, orf)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, key_mask=key_mask) ** 2)
+        return jnp.sum(flash_attention(
+            q, k, v, key_mask=key_mask, backend="pallas") ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(reference_attention(q, k, v, key_mask=key_mask) ** 2)

@@ -143,3 +143,39 @@ def test_bert_forward_masked_padding_invariant():
     np.testing.assert_allclose(
         np.asarray(h1)[keep], np.asarray(h2)[keep], atol=1e-4
     )
+
+
+def test_flash_attention_backend_dispatch():
+    """backend param: explicit 'xla' == reference; bad value raises; auto on
+    CPU (no TPU) takes the XLA path at any length (r3 dispatch policy)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from deeplearning4j_tpu.kernels.flash_attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    r = np.random.default_rng(0)
+    q = jnp.asarray(r.normal(size=(2, 2, 16, 8)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(2, 2, 16, 8)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(2, 2, 16, 8)), jnp.float32)
+    np.testing.assert_allclose(
+        flash_attention(q, k, v, backend="xla"),
+        reference_attention(q, k, v), rtol=1e-6)
+    np.testing.assert_allclose(
+        flash_attention(q, k, v),  # auto, off-TPU -> xla
+        reference_attention(q, k, v), rtol=1e-6)
+    with pytest.raises(ValueError, match="backend"):
+        flash_attention(q, k, v, backend="cuda")
+
+
+def test_flash_min_seq_env_override(monkeypatch):
+    from deeplearning4j_tpu.kernels import _dispatch
+
+    monkeypatch.setenv("DL4J_TPU_FLASH_MIN_SEQ", "123")
+    assert _dispatch.flash_min_seq() == 123
+    monkeypatch.delenv("DL4J_TPU_FLASH_MIN_SEQ")
+    assert _dispatch.flash_min_seq() == 1024

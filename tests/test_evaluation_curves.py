@@ -338,3 +338,34 @@ def test_evaluation_binary_custom_thresholds_and_merge():
     ev2 = EvaluationBinary(2, thresholds=[0.25, 0.95]).eval(labels, probs)
     ev.merge(ev2)
     assert ev.true_positives()[0] == 2
+
+
+def test_evaluation_binary_1d_single_output():
+    """[N]-shaped labels/probs with num_outputs=1 must work, not silently
+    broadcast counts into [4,4] garbage (r3 review)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.evaluation import EvaluationBinary
+
+    ev = EvaluationBinary(1)
+    ev.eval(np.array([1.0, 0.0, 1.0]), np.array([0.9, 0.1, 0.8]))
+    assert ev.counts.shape == (4, 1)
+    assert ev.true_positives()[0] == 2
+    assert ev.true_negatives()[0] == 1
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="num_outputs"):
+        ev.eval(np.zeros((4, 3)), np.zeros((4, 3)))
+
+
+def test_evaluation_binary_macro_excludes_undefined():
+    """Aggregate precision averages only defined outputs (like
+    Evaluation's macro averaging of present classes)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.evaluation import EvaluationBinary
+
+    ev = EvaluationBinary(2)
+    # output 0: one TP; output 1: never predicted positive & no positives
+    # in labels -> precision undefined there
+    ev.eval(np.array([[1.0, 0.0]]), np.array([[0.9, 0.1]]))
+    assert ev.precision() == 1.0  # not dragged to 0.5 by undefined col
