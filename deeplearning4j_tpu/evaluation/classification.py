@@ -153,6 +153,10 @@ class EvaluationBinary:
             raise ValueError(
                 f"predictions last dim {predictions.shape[-1]} != "
                 f"num_outputs {self.num_outputs}")
+        if labels.shape != predictions.shape:
+            raise ValueError(
+                f"labels shape {labels.shape} != predictions shape "
+                f"{predictions.shape}")
         self.counts = _binary_counts_update(
             self.counts, predictions, labels, self.thresholds)
         self._host = None

@@ -150,7 +150,17 @@ def _lstm_ab(iters=30):
 
 
 def run_kernels_ab(diag: dict) -> dict:
-    result = {"metric": "pallas_kernel_ab", **diag}
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "axon"):
+        # Off-TPU an explicit backend='pallas' request silently falls back
+        # to XLA (flash_attention hard constraint), so the "A/B" would
+        # compare XLA against itself and record a fake parity artifact.
+        return {"metric": "pallas_kernel_ab",
+                "error": f"refusing to A/B on platform '{platform}': the "
+                         "Pallas side would silently run XLA", **diag}
+    result = {"metric": "pallas_kernel_ab", "platform": platform, **diag}
     for name, fn in (("flash_attention", _flash_ab), ("lstm_scan", _lstm_ab)):
         try:
             result[name] = fn()

@@ -1,0 +1,95 @@
+"""All-layers × dtypes sweep (↔ deeplearning4j-core DTypeTests: every layer
+constructed and run under each global dtype; SURVEY §4 'Layer/network unit
+tests' row).
+
+For each registered layer config that can be constructed generically, init
+and apply under float32 and bfloat16 and assert (a) params/outputs carry
+the requested dtype family, (b) outputs stay finite. bf16 is the TPU
+compute dtype, so every layer must tolerate it — this sweep is what makes
+the mixed-precision trainer path safe to enable per-model.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+
+# Layer instances + an input shape (batchless) for the generic sweep.
+# Sampled to cover every family: core, conv 1/2/3D, norm, recurrent,
+# attention, pooling/reshape, pretrain, output.
+SWEEP = [
+    (L.Dense(units=8, activation="relu"), (6,)),
+    (L.ActivationLayer(activation="tanh"), (5,)),
+    (L.Dropout(rate=0.3), (7,)),
+    (L.PReLU(), (6,)),
+    (L.ElementWiseMultiplication(), (6,)),
+    (L.Conv1D(filters=4, kernel=3), (10, 3)),
+    (L.Conv2D(filters=4, kernel=3), (8, 8, 3)),
+    (L.Conv3D(filters=2, kernel=2), (4, 4, 4, 2)),
+    (L.Deconv2D(filters=3, kernel=2, stride=2), (5, 5, 2)),
+    (L.Deconv3D(filters=2, kernel=2, stride=2), (3, 3, 3, 2)),
+    (L.DepthwiseConv2D(depth_multiplier=2, kernel=3), (8, 8, 3)),
+    (L.SeparableConv2D(filters=4, kernel=3), (8, 8, 3)),
+    (L.LocallyConnected1D(filters=2, kernel=3), (8, 2)),
+    (L.LocallyConnected2D(filters=2, kernel=3), (6, 6, 2)),
+    (L.Pooling2D(window=2), (8, 8, 3)),
+    (L.Pooling3D(window=2), (4, 4, 4, 2)),
+    (L.GlobalPooling(), (6, 6, 3)),
+    (L.Upsampling2D(scale=2), (4, 4, 2)),
+    (L.SpaceToDepth(block_size=2), (4, 4, 2)),
+    (L.DepthToSpace(block_size=2), (4, 4, 8)),
+    (L.BatchNorm(), (6,)),
+    (L.LayerNorm(), (6,)),
+    (L.LocalResponseNormalization(), (6, 6, 4)),
+    (L.SimpleRnn(units=5), (7, 3)),
+    (L.LSTM(units=5), (7, 3)),
+    (L.GravesLSTM(units=5), (7, 3)),
+    (L.GRU(units=5), (7, 3)),
+    (L.SelfAttention(num_heads=2, head_size=4), (8, 8)),
+    (L.AutoEncoder(units=4), (9,)),
+    (L.VariationalAutoencoder(units=3, encoder_sizes=(8,),
+                              decoder_sizes=(8,)), (9,)),
+    (L.OutputLayer(units=4), (6,)),
+    (L.MaskZeroLayer(), (5, 3)),
+]
+
+_IDS = [f"{type(l).__name__}" for l, _ in SWEEP]
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+@pytest.mark.parametrize("layer,shape", SWEEP, ids=_IDS)
+def test_layer_dtype_sweep(layer, shape, dtype):
+    rng = jax.random.key(0)
+    params, state = layer.init(rng, shape, dtype)
+    x = jax.random.normal(jax.random.key(1), (2, *shape), dtype)
+    y, _ = layer.apply(params, state, x, train=False)
+    # params carry the requested dtype
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert leaf.dtype == dtype, f"param dtype {leaf.dtype} != {dtype}"
+    # outputs stay in the same dtype family (some ops upcast internally and
+    # cast back; integer outputs don't occur in this sweep)
+    assert y.dtype == dtype, f"output dtype {y.dtype} != {dtype}"
+    assert bool(jnp.all(jnp.isfinite(y.astype(jnp.float32))))
+
+
+@pytest.mark.parametrize("layer,shape", [
+    (L.Dense(units=8), (6,)),
+    (L.Conv2D(filters=4, kernel=3), (8, 8, 3)),
+    (L.LSTM(units=5), (7, 3)),
+], ids=["dense", "conv2d", "lstm"])
+def test_bf16_forward_close_to_f32(layer, shape):
+    """bf16 forward tracks the f32 forward within bf16 tolerance (the
+    reference's DTypeTests asserts the same network produces comparable
+    activations across dtypes)."""
+    p32, s32 = layer.init(jax.random.key(0), shape, jnp.float32)
+    p16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), p32)
+    x32 = jax.random.normal(jax.random.key(1), (2, *shape), jnp.float32)
+    y32, _ = layer.apply(p32, s32, x32)
+    y16, _ = layer.apply(p16, s32, x32.astype(jnp.bfloat16))
+    np.testing.assert_allclose(
+        np.asarray(y16, np.float32), np.asarray(y32), rtol=0.06, atol=0.06)

@@ -369,3 +369,14 @@ def test_evaluation_binary_macro_excludes_undefined():
     # in labels -> precision undefined there
     ev.eval(np.array([[1.0, 0.0]]), np.array([[0.9, 0.1]]))
     assert ev.precision() == 1.0  # not dragged to 0.5 by undefined col
+
+
+def test_evaluation_binary_label_shape_mismatch_raises():
+    import numpy as np
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.evaluation import EvaluationBinary
+
+    ev = EvaluationBinary(1)
+    with _pytest.raises(ValueError, match="labels shape"):
+        ev.eval(np.zeros((4, 3)), np.array([0.9, 0.1, 0.8, 0.2]))
