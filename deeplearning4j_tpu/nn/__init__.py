@@ -13,9 +13,11 @@ from deeplearning4j_tpu.nn.config import (
     config_to_json,
     register_config,
 )
+from deeplearning4j_tpu.nn.generation import RnnTimeStepper, generate
 from deeplearning4j_tpu.nn.model import GraphModel, SequentialModel
 
 __all__ = [
+    "RnnTimeStepper", "generate",
     "layers",
     "GraphConfig",
     "GraphVertex",

@@ -68,6 +68,28 @@ class LSTM(LayerConfig):
     def _peepholes(self, params):
         return None
 
+    # -- stateful single-step inference (↔ MultiLayerNetwork.rnnTimeStep) --
+
+    def init_carry(self, params, batch_size: int, dtype=jnp.float32):
+        h = self.units
+        return opsrnn.LSTMState(jnp.zeros((batch_size, h), dtype),
+                                jnp.zeros((batch_size, h), dtype))
+
+    def step(self, params, carry, x_t):
+        """One timestep: x_t [N,In] → (y_t [N,H], new_carry). Used by the
+        compiled autoregressive generation scan (nn/generation.py)."""
+        x_proj = jnp.matmul(x_t, params["W"])
+        peep = self._peepholes(params)
+        if peep is not None:
+            new = opsrnn.graves_lstm_cell(
+                x_proj, carry, params["RW"], params["b"], *peep,
+                forget_bias=self.forget_bias)
+        else:
+            new = opsrnn.lstm_cell(
+                x_proj, carry, params["RW"], params["b"],
+                forget_bias=self.forget_bias)
+        return new.h, new
+
     def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
         if self.backend == "pallas":
             from deeplearning4j_tpu.kernels import lstm_scan
@@ -134,6 +156,14 @@ class GRU(LayerConfig):
             "b": jnp.zeros((3 * h,), dtype),
         }, {}
 
+    def init_carry(self, params, batch_size: int, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.units), dtype)
+
+    def step(self, params, carry, x_t):
+        h = opsrnn.gru_cell(jnp.matmul(x_t, params["W"]), carry,
+                            params["RW"], params["b"])
+        return h, h
+
     def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
         outputs, final = opsrnn.gru(
             x, params["W"], params["RW"], params["b"], init_h=initial_state,
@@ -169,6 +199,15 @@ class SimpleRnn(LayerConfig):
             "RW": w_init(k2, (h, h), dtype),
             "b": jnp.zeros((h,), dtype),
         }, {}
+
+    def init_carry(self, params, batch_size: int, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.units), dtype)
+
+    def step(self, params, carry, x_t):
+        act = get_activation(self.activation)
+        pre = jnp.matmul(x_t, params["W"]) + jnp.matmul(carry, params["RW"])
+        h = act(pre + params["b"])
+        return h, h
 
     def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
         act = get_activation(self.activation)

@@ -295,8 +295,6 @@ class TestShardedEvaluation:
 
 def test_evaluation_binary_against_sklearn_style_oracle():
     """Per-output binary counts vs a hand-computed numpy oracle."""
-    import numpy as np
-
     from deeplearning4j_tpu.evaluation import EvaluationBinary
 
     r = np.random.default_rng(0)
@@ -324,8 +322,6 @@ def test_evaluation_binary_against_sklearn_style_oracle():
 
 
 def test_evaluation_binary_custom_thresholds_and_merge():
-    import numpy as np
-
     from deeplearning4j_tpu.evaluation import EvaluationBinary
 
     probs = np.array([[0.3, 0.9], [0.6, 0.2]], np.float32)
@@ -343,8 +339,6 @@ def test_evaluation_binary_custom_thresholds_and_merge():
 def test_evaluation_binary_1d_single_output():
     """[N]-shaped labels/probs with num_outputs=1 must work, not silently
     broadcast counts into [4,4] garbage (r3 review)."""
-    import numpy as np
-
     from deeplearning4j_tpu.evaluation import EvaluationBinary
 
     ev = EvaluationBinary(1)
@@ -352,16 +346,13 @@ def test_evaluation_binary_1d_single_output():
     assert ev.counts.shape == (4, 1)
     assert ev.true_positives()[0] == 2
     assert ev.true_negatives()[0] == 1
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="num_outputs"):
+    with pytest.raises(ValueError, match="num_outputs"):
         ev.eval(np.zeros((4, 3)), np.zeros((4, 3)))
 
 
 def test_evaluation_binary_macro_excludes_undefined():
     """Aggregate precision averages only defined outputs (like
     Evaluation's macro averaging of present classes)."""
-    import numpy as np
-
     from deeplearning4j_tpu.evaluation import EvaluationBinary
 
     ev = EvaluationBinary(2)
@@ -372,11 +363,8 @@ def test_evaluation_binary_macro_excludes_undefined():
 
 
 def test_evaluation_binary_label_shape_mismatch_raises():
-    import numpy as np
-    import pytest as _pytest
-
     from deeplearning4j_tpu.evaluation import EvaluationBinary
 
     ev = EvaluationBinary(1)
-    with _pytest.raises(ValueError, match="labels shape"):
+    with pytest.raises(ValueError, match="labels shape"):
         ev.eval(np.zeros((4, 3)), np.array([0.9, 0.1, 0.8, 0.2]))
