@@ -45,6 +45,25 @@ def _split_stack(model):
     return rec, head
 
 
+def _make_one_step(rec, head):
+    """(params, state, carries, x_t) → (head output, new carries): one
+    timestep through the recurrent stack then the per-step head. Shared by
+    RnnTimeStepper and the generation scan."""
+
+    def one_step(params, state, carries, x_t):
+        new_carries = []
+        h = x_t
+        for (name, layer), c in zip(rec, carries):
+            h, c2 = layer.step(params.get(name, {}), c, h)
+            new_carries.append(c2)
+        for name, layer in head:
+            h, _ = layer.apply(params.get(name, {}), state.get(name, {}),
+                               h, train=False)
+        return h, new_carries
+
+    return one_step
+
+
 class RnnTimeStepper:
     """↔ rnnTimeStep: stateful single/multi-step inference.
 
@@ -59,21 +78,10 @@ class RnnTimeStepper:
         self.variables = variables
         self._rec, self._head = _split_stack(model)
         self._carries: Optional[List[Any]] = None
-        params = variables["params"]
-        state = variables["state"]
-
-        def one_step(params, carries, x_t):
-            new_carries = []
-            h = x_t
-            for (name, layer), c in zip(self._rec, carries):
-                h, c2 = layer.step(params.get(name, {}), c, h)
-                new_carries.append(c2)
-            for name, layer in self._head:
-                h, _ = layer.apply(params.get(name, {}), state.get(name, {}),
-                                   h, train=False)
-            return h, new_carries
-
-        self._step_jit = jax.jit(one_step)
+        # params AND state are jit arguments (not baked constants) so a
+        # caller refreshing self.variables after more training sees both
+        # halves update consistently.
+        self._step_jit = jax.jit(_make_one_step(self._rec, self._head))
 
     def clear_state(self):
         """↔ rnnClearPreviousState."""
@@ -88,14 +96,17 @@ class RnnTimeStepper:
     def time_step(self, x):
         """x: [N,C] or [N,T,C] → head output for the final step [N,Out]."""
         params = self.variables["params"]
+        state = self.variables["state"]
         x = jnp.asarray(x)
-        squeeze_t = x.ndim == 2
-        if squeeze_t:
+        if x.ndim == 2:
             x = x[:, None, :]
+        if x.shape[1] == 0:
+            raise ValueError("time_step got an empty time axis")
         self._ensure_carries(params, x.shape[0], x.dtype)
         out = None
         for t in range(x.shape[1]):
-            out, self._carries = self._step_jit(params, self._carries, x[:, t])
+            out, self._carries = self._step_jit(params, state, self._carries,
+                                                x[:, t])
         return out
 
 
@@ -106,22 +117,21 @@ def _build_generate_fn(model, n_steps: int, temperature: float):
     than baked-in constants."""
     rec, head = _split_stack(model)
     vocab = model.shapes[0][-1]  # input one-hot width
+    out_width = model.shapes[-1][-1]
+    if out_width != vocab:
+        raise ValueError(
+            f"generation feeds sampled head-output ids back as one-hot "
+            f"input, so head width ({out_width}) must equal input one-hot "
+            f"width ({vocab})")
     dtype = jnp.float32
+    step_fn = _make_one_step(rec, head)
 
     @jax.jit
     def run(params, state, rng, prime_ids):
         batch = prime_ids.shape[0]
 
         def one_step(carries, x_t):
-            new_carries = []
-            h = x_t
-            for (name, layer), c in zip(rec, carries):
-                h, c2 = layer.step(params.get(name, {}), c, h)
-                new_carries.append(c2)
-            for name, layer in head:
-                h, _ = layer.apply(params.get(name, {}), state.get(name, {}),
-                                   h, train=False)
-            return h, new_carries
+            return step_fn(params, state, carries, x_t)
 
         carries = [layer.init_carry(params.get(name, {}), batch, dtype)
                    for name, layer in rec]

@@ -1,0 +1,40 @@
+"""Examples smoke tests (↔ dl4j-examples being the de-facto integration
+suite of the reference). Each example runs --quick in a subprocess with
+the CPU platform; the two cheapest run always, the full set behind
+DL4J_TPU_EXAMPLE_TESTS=1 (they re-train small models, ~1-2 min each)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+FAST = ["samediff_graph.py", "word2vec_similarity.py"]
+SLOW = ["mnist_lenet.py", "transfer_learning.py", "bert_mlm_pretrain.py",
+        "char_rnn_generation.py", "data_parallel_mesh.py"]
+
+
+def _run(name, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "examples" / name), "--quick"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert out.returncode == 0, f"{name} failed:\n{out.stdout[-2000:]}\n{out.stderr[-2000:]}"
+    return out.stdout
+
+
+@pytest.mark.parametrize("name", FAST)
+def test_fast_examples(name):
+    _run(name)
+
+
+@pytest.mark.skipif(os.environ.get("DL4J_TPU_EXAMPLE_TESTS") != "1",
+                    reason="set DL4J_TPU_EXAMPLE_TESTS=1 to run all examples")
+@pytest.mark.parametrize("name", SLOW)
+def test_slow_examples(name):
+    extra = {}
+    if name == "data_parallel_mesh.py":
+        extra["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    _run(name, extra)

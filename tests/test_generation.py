@@ -132,3 +132,24 @@ def test_generate_reuses_compiled_runner(char_model):
     before = cache[(5, 1.0)]
     generate(model, variables, n_steps=5, rng=jax.random.key(1))
     assert cache[(5, 1.0)] is before  # no rebuild
+
+
+def test_generate_rejects_vocab_mismatch():
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(seed=0), input_shape=(4, 5),
+        layers=[L.SimpleRnn(units=6),
+                L.RnnOutputLayer(units=9)]))  # head 9 != input one-hot 5
+    with pytest.raises(ValueError, match="head width"):
+        generate(model, model.init(), n_steps=2, rng=jax.random.key(0))
+
+
+def test_time_step_empty_time_axis_raises(char_model):
+    model, variables = char_model
+    stepper = RnnTimeStepper(model, variables)
+    with pytest.raises(ValueError, match="empty time axis"):
+        stepper.time_step(jnp.zeros((2, 0, 11)))
