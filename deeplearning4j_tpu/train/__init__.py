@@ -16,6 +16,7 @@ from deeplearning4j_tpu.train.pretrain import pretrain, pretrain_layer
 from deeplearning4j_tpu.train.trainer import TrainState, Trainer
 from deeplearning4j_tpu.train.transfer import (
     FineTuneConfiguration,
+    GraphTransferLearning,
     TransferLearning,
     TransferLearningHelper,
 )
@@ -34,6 +35,7 @@ from deeplearning4j_tpu.train.updaters import (
 )
 
 __all__ = [
+    "GraphTransferLearning",
     "pretrain", "pretrain_layer",
     "listeners", "schedules", "updaters", "TrainState", "Trainer",
     "Sgd", "Adam", "AdamW", "AMSGrad", "Nadam", "AdaMax", "AdaGrad",

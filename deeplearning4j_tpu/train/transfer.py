@@ -165,6 +165,186 @@ class TransferLearning:
         return new_model, {"params": params, "state": state}, frozen
 
 
+class GraphTransferLearning:
+    """Surgery on a trained GraphModel (↔ TransferLearning.GraphBuilder —
+    the reference's ComputationGraph transfer path, the one its zoo
+    ResNet/VGG fine-tuning examples use).
+
+    Usage::
+
+        gtl = (GraphTransferLearning(model, variables)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-4)))
+               .set_feature_extractor("pool5")          # freeze ancestors ≤ here
+               .n_out_replace("fc1000", 5)              # new 5-way head
+               )
+        new_model, new_vars, frozen = gtl.build()
+        trainer = Trainer(new_model, frozen_layers=frozen)
+    """
+
+    def __init__(self, model, variables: Dict[str, Any]):
+        self._model = model
+        self._variables = variables
+        self._vertices = dict(model.config.vertices)  # name → GraphVertex
+        self._outputs = list(model.config.outputs)
+        self._fresh: set = set()       # vertices re-initialized (no carry)
+        self._frontier: List[str] = []  # feature-extractor frontier
+        self._ftc: Optional[FineTuneConfiguration] = None
+
+    def _require(self, name: str):
+        if name not in self._vertices:
+            raise ValueError(
+                f"vertex {name!r} not found; have {list(self._vertices)}")
+
+    def fine_tune_configuration(
+            self, ftc: FineTuneConfiguration) -> "GraphTransferLearning":
+        self._ftc = ftc
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str) -> "GraphTransferLearning":
+        """Freeze the named vertices and ALL their ancestors
+        (↔ GraphBuilder.setFeatureExtractor frontier semantics)."""
+        for n in vertex_names:
+            self._require(n)
+        self._frontier = list(vertex_names)
+        return self
+
+    def n_out_replace(self, vertex: str, n_out: int,
+                      weight_init: Optional[str] = None) -> "GraphTransferLearning":
+        """Replace a layer vertex's output width with a fresh init
+        (↔ GraphBuilder.nOutReplace)."""
+        self._require(vertex)
+        v = self._vertices[vertex]
+        if v.kind != "layer":
+            raise ValueError(f"vertex {vertex!r} is {v.kind!r}, not a layer")
+        cfg = v.layer
+        if hasattr(cfg, "units"):
+            kw = {"units": n_out}
+        elif hasattr(cfg, "filters"):
+            kw = {"filters": n_out}
+        else:
+            raise ValueError(
+                f"vertex {vertex!r} ({type(cfg).__name__}) has no "
+                "output-width attribute (units/filters)")
+        if weight_init is not None and hasattr(cfg, "weight_init"):
+            kw["weight_init"] = weight_init
+        self._vertices[vertex] = dataclasses.replace(
+            v, layer=dataclasses.replace(cfg, **kw))
+        self._fresh.add(vertex)
+        return self
+
+    def remove_vertex(self, name: str, *, and_descendants: bool = True
+                      ) -> "GraphTransferLearning":
+        """↔ GraphBuilder.removeVertexAndConnections: drop a vertex (and by
+        default everything downstream of it)."""
+        self._require(name)
+        doomed = {name}
+        if and_descendants:
+            changed = True
+            while changed:
+                changed = False
+                for n, v in self._vertices.items():
+                    if n not in doomed and any(i in doomed for i in v.inputs):
+                        doomed.add(n)
+                        changed = True
+        # Validate BEFORE mutating so a raise leaves the builder untouched.
+        dangling = [n for n, v in self._vertices.items()
+                    if n not in doomed and any(i in doomed for i in v.inputs)]
+        if dangling:
+            raise ValueError(
+                f"removing {name!r} leaves {dangling} with missing inputs")
+        for n in doomed:
+            self._vertices.pop(n, None)
+        self._outputs = [o for o in self._outputs if o not in doomed]
+        return self
+
+    def add_vertex(self, name: str, vertex) -> "GraphTransferLearning":
+        """↔ GraphBuilder.addLayer/addVertex: append a fresh vertex."""
+        if name in self._vertices:
+            raise ValueError(f"vertex {name!r} already exists")
+        for i in vertex.inputs:
+            if i not in self._vertices and i not in self._model.config.inputs:
+                raise ValueError(f"vertex {name!r} input {i!r} not found")
+        self._vertices[name] = vertex
+        self._fresh.add(name)
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphTransferLearning":
+        for n in names:
+            self._require(n)
+        self._outputs = list(names)
+        return self
+
+    def _ancestors(self, frontier: Sequence[str]) -> set:
+        net_inputs = set(self._model.config.inputs)
+        seen = set()
+        stack = list(frontier)
+        while stack:
+            n = stack.pop()
+            if n in seen or n in net_inputs:
+                continue
+            seen.add(n)
+            v = self._vertices.get(n)
+            if v is not None:
+                stack.extend(i for i in v.inputs if i not in net_inputs)
+        return seen
+
+    def build(self, seed: Optional[int] = None):
+        """Returns (model, variables, frozen_vertex_names)."""
+        from deeplearning4j_tpu.nn.config import GraphConfig
+        from deeplearning4j_tpu.nn.model import GraphModel
+
+        net = self._model.net
+        if self._ftc is not None:
+            net = self._ftc.apply(net)
+        config = GraphConfig(
+            net=net,
+            inputs=list(self._model.config.inputs),
+            input_shapes=dict(self._model.config.input_shapes),
+            vertices=dict(self._vertices),
+            outputs=list(self._outputs),
+        )
+        new_model = GraphModel(config)
+        fresh = new_model.init(seed)
+
+        old_params = self._variables.get("params", {})
+        old_state = self._variables.get("state", {})
+        params = dict(fresh["params"])
+        state = dict(fresh["state"])
+        refreshed = set(self._fresh)
+
+        def _shapes_match(old, new):
+            import jax
+
+            tu = jax.tree_util
+            if tu.tree_structure(old) != tu.tree_structure(new):
+                return False
+            return all(tuple(a.shape) == tuple(b.shape)
+                       for a, b in zip(tu.tree_leaves(old),
+                                       tu.tree_leaves(new)))
+
+        for name in new_model.order:
+            if name in self._fresh:
+                continue
+            # Carry old weights only when shapes match the surgered graph:
+            # a vertex downstream of an nOutReplace/remove has a new input
+            # width and must re-initialize (DL4J's nOutReplace nIn rule).
+            if name in old_params:
+                if _shapes_match(old_params[name], params[name]):
+                    params[name] = old_params[name]
+                else:
+                    refreshed.add(name)
+                    continue
+            if name in old_state and _shapes_match(
+                    old_state[name], state.get(name, old_state[name])):
+                state[name] = old_state[name]
+
+        frozen: List[str] = []
+        if self._frontier:
+            frozen = [n for n in self._ancestors(self._frontier)
+                      if n in fresh["params"] and n not in refreshed]
+        return new_model, {"params": params, "state": state}, sorted(frozen)
+
+
 class TransferLearningHelper:
     """Featurize-once helper (↔ TransferLearningHelper): run the frozen
     prefix once per dataset and train only the head on cached features."""

@@ -1,0 +1,15 @@
+"""Shared example bootstrap: repo-root import path + platform override.
+
+The axon sitecustomize force-registers the TPU platform at interpreter
+start; an explicit JAX_PLATFORMS (e.g. cpu) must be re-applied via
+jax.config to win (see tests/conftest.py for the same workaround).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

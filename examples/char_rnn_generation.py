@@ -8,15 +8,8 @@ step-per-dispatch host loop.
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-if os.environ.get("JAX_PLATFORMS"):
-    # The axon sitecustomize force-registers the TPU platform at interpreter
-    # start; an explicit JAX_PLATFORMS (e.g. cpu) must be re-applied via
-    # config to win (see tests/conftest.py).
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401,E402 - repo path + platform override
 
 import argparse
 

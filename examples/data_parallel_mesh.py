@@ -11,15 +11,8 @@ exact all-reduces on ICI. Run on CPU with 8 virtual devices:
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-if os.environ.get("JAX_PLATFORMS"):
-    # The axon sitecustomize force-registers the TPU platform at interpreter
-    # start; an explicit JAX_PLATFORMS (e.g. cpu) must be re-applied via
-    # config to win (see tests/conftest.py).
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401,E402 - repo path + platform override
 
 import argparse
 
@@ -46,8 +39,6 @@ def main(quick: bool = False):
     ts = trainer.init_state()
     it = ArrayDataSetIterator(xtr, ytr, batch_size=256, drop_last=True)
     ts = trainer.fit(ts, it, epochs=1 if quick else 3)
-    loss = float(trainer.last_metrics["total_loss"]) \
-        if hasattr(trainer, "last_metrics") else None
 
     # parity: same seed, single-device
     single = Trainer(lenet(updater=Adam(3e-3)))

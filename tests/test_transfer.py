@@ -162,3 +162,158 @@ class TestTransferLearningHelper:
         full_out, _ = model.apply(variables, x, up_to=len(model.layers) - 1)
         np.testing.assert_allclose(np.asarray(tail_out), np.asarray(full_out),
                                    rtol=1e-5, atol=1e-5)
+
+
+# --- GraphTransferLearning (round 3: ComputationGraph transfer path) --------
+
+
+class TestGraphTransferLearning:
+    def _tiny_graph(self):
+        """input -> conv -> pool -> dense -> output (as a DAG)."""
+        import jax
+
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn.config import (
+            GraphConfig,
+            GraphVertex,
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.model import GraphModel
+
+        v = {
+            "conv": GraphVertex(kind="layer", inputs=["input"],
+                                layer=L.Conv2D(filters=4, kernel=3,
+                                               activation="relu")),
+            "pool": GraphVertex(kind="layer", inputs=["conv"],
+                                layer=L.GlobalPooling()),
+            "dense": GraphVertex(kind="layer", inputs=["pool"],
+                                 layer=L.Dense(units=8, activation="relu")),
+            "output": GraphVertex(kind="layer", inputs=["dense"],
+                                  layer=L.OutputLayer(units=10)),
+        }
+        cfg = GraphConfig(net=NeuralNetConfiguration(seed=0),
+                          inputs=["input"],
+                          input_shapes={"input": (8, 8, 3)},
+                          vertices=v, outputs=["output"])
+        m = GraphModel(cfg)
+        return m, m.init()
+
+    def test_nout_replace_and_freeze(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.train.transfer import (
+            FineTuneConfiguration,
+            GraphTransferLearning,
+        )
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        model, variables = self._tiny_graph()
+        gtl = (GraphTransferLearning(model, variables)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-3)))
+               .set_feature_extractor("dense")
+               .n_out_replace("output", 5))
+        new_model, new_vars, frozen = gtl.build()
+        assert frozen == ["conv", "dense"]
+        # carried weights are identical; replaced head is fresh 5-wide
+        np.testing.assert_array_equal(
+            np.asarray(new_vars["params"]["conv"]["W"]),
+            np.asarray(variables["params"]["conv"]["W"]))
+        assert new_vars["params"]["output"]["W"].shape == (8, 5)
+        out = new_model.output(new_vars, np.zeros((2, 8, 8, 3), np.float32))
+        assert out["output"].shape == (2, 5)
+
+    def test_frozen_training_keeps_backbone(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.train.trainer import Trainer
+        from deeplearning4j_tpu.train.transfer import GraphTransferLearning
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        model, variables = self._tiny_graph()
+        gtl = (GraphTransferLearning(model, variables)
+               .set_feature_extractor("dense")
+               .n_out_replace("output", 3))
+        new_model, new_vars, frozen = gtl.build()
+        new_model.net.updater = Adam(1e-2)
+        # snapshot BEFORE training: train_step donates the state buffers
+        conv_before = np.asarray(new_vars["params"]["conv"]["W"]).copy()
+        head_before = np.asarray(new_vars["params"]["output"]["W"]).copy()
+        tr = Trainer(new_model, frozen_layers=frozen)
+        ts = tr.init_state(variables=new_vars)
+        r = np.random.default_rng(0)
+        batch = {"features": r.normal(size=(8, 8, 8, 3)).astype(np.float32),
+                 "labels": np.eye(3, dtype=np.float32)[r.integers(0, 3, 8)]}
+        for _ in range(5):
+            ts, m = tr.train_step(ts, batch)
+        after = tr.variables(ts)["params"]
+        np.testing.assert_array_equal(np.asarray(after["conv"]["W"]),
+                                      conv_before)
+        assert not np.allclose(np.asarray(after["output"]["W"]), head_before)
+
+    def test_remove_vertex_and_add_new_head(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn.config import GraphVertex
+        from deeplearning4j_tpu.train.transfer import GraphTransferLearning
+
+        model, variables = self._tiny_graph()
+        gtl = (GraphTransferLearning(model, variables)
+               .remove_vertex("dense")  # drops dense AND output
+               .add_vertex("newhead", GraphVertex(
+                   kind="layer", inputs=["pool"],
+                   layer=L.OutputLayer(units=2)))
+               .set_outputs("newhead"))
+        new_model, new_vars, _ = gtl.build()
+        out = new_model.output(new_vars, np.zeros((2, 8, 8, 3), np.float32))
+        assert out["newhead"].shape == (2, 2)
+
+    def test_zoo_resnet_surgery(self):
+        """The reference's canonical use: re-head a zoo ResNet."""
+        import numpy as np
+
+        from deeplearning4j_tpu.models.zoo import resnet50
+        from deeplearning4j_tpu.train.transfer import GraphTransferLearning
+
+        model = resnet50(num_classes=10, input_shape=(32, 32, 3))
+        variables = model.init(seed=0)
+        gtl = (GraphTransferLearning(model, variables)
+               .set_feature_extractor("avgpool")
+               .n_out_replace("output", 4))
+        new_model, new_vars, frozen = gtl.build()
+        assert "avgpool" not in frozen  # pooling has no params
+        assert "output" not in frozen  # the fresh head is trainable
+        assert len(frozen) > 30  # every conv/bn vertex upstream
+        out = new_model.output(new_vars, np.zeros((1, 32, 32, 3), np.float32))
+        assert out["output"].shape == (1, 4)
+
+
+    def test_nout_replace_midgraph_reinitializes_downstream(self):
+        """nOutReplace on a non-terminal vertex: downstream vertices whose
+        input width changed must re-init, not carry stale-shaped weights
+        (DL4J's nOutReplace nIn rule; r3 review)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.train.transfer import GraphTransferLearning
+
+        model, variables = self._tiny_graph()
+        gtl = GraphTransferLearning(model, variables).n_out_replace("dense", 16)
+        new_model, new_vars, _ = gtl.build()
+        assert new_vars["params"]["dense"]["W"].shape == (4, 16)
+        assert new_vars["params"]["output"]["W"].shape == (16, 10)
+        out = new_model.output(new_vars, np.zeros((2, 8, 8, 3), np.float32))
+        assert out["output"].shape == (2, 10)
+
+    def test_remove_vertex_validation_leaves_builder_intact(self):
+        import pytest as _p
+
+        from deeplearning4j_tpu.train.transfer import GraphTransferLearning
+
+        model, variables = self._tiny_graph()
+        gtl = GraphTransferLearning(model, variables)
+        with _p.raises(ValueError, match="missing inputs"):
+            gtl.remove_vertex("dense", and_descendants=False)
+        # builder unchanged: a valid edit still works
+        assert "dense" in gtl._vertices
+        new_model, new_vars, _ = gtl.n_out_replace("output", 2).build()
+        assert new_vars["params"]["output"]["W"].shape[-1] == 2
