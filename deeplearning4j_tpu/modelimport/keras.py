@@ -532,6 +532,18 @@ MERGE_KINDS = {
 }
 
 
+def register_keras_layer(class_name: str, mapper: Callable) -> None:
+    """Custom-layer SPI (↔ KerasLayer.registerCustomLayer /
+    KerasLayerUtils custom-layer registry).
+
+    ``mapper(config_dict) -> (LayerConfig, weight_map)`` where weight_map
+    maps our param names to (keras weight name, transform-or-None) — the
+    same contract every built-in mapper follows. Registering an existing
+    name overrides the built-in (the reference allows shadowing too).
+    """
+    LAYER_MAPPERS[class_name] = mapper
+
+
 def _map_layer(class_name: str, cfg: dict):
     if class_name == "InputLayer":
         return None, {}
@@ -539,7 +551,8 @@ def _map_layer(class_name: str, cfg: dict):
     if mapper is None:
         raise KerasImportError(
             f"no mapper for Keras layer {class_name!r} "
-            f"(supported: {sorted(LAYER_MAPPERS)})")
+            f"(supported: {sorted(LAYER_MAPPERS)}). Custom layers can be "
+            "registered via register_keras_layer(class_name, mapper)")
     return mapper(cfg)
 
 

@@ -32,10 +32,11 @@ from deeplearning4j_tpu.train.updaters import (
     NoOp,
     RmsProp,
     Sgd,
+    OptaxUpdater,
 )
 
 __all__ = [
-    "GraphTransferLearning",
+    "GraphTransferLearning", "OptaxUpdater",
     "pretrain", "pretrain_layer",
     "listeners", "schedules", "updaters", "TrainState", "Trainer",
     "Sgd", "Adam", "AdamW", "AMSGrad", "Nadam", "AdaMax", "AdaGrad",

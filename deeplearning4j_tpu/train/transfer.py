@@ -23,6 +23,22 @@ from deeplearning4j_tpu.nn.config import (
 from deeplearning4j_tpu.nn.model import SequentialModel
 
 
+def _replace_n_out(cfg, n_out: int, weight_init: Optional[str], what: str):
+    """Shared nOutReplace attribute resolution (units on dense/output
+    layers, filters on conv layers)."""
+    if hasattr(cfg, "units"):
+        kw = {"units": n_out}
+    elif hasattr(cfg, "filters"):
+        kw = {"filters": n_out}
+    else:
+        raise ValueError(
+            f"{what} ({type(cfg).__name__}) has no output-width attribute "
+            "(units/filters)")
+    if weight_init is not None and hasattr(cfg, "weight_init"):
+        kw["weight_init"] = weight_init
+    return dataclasses.replace(cfg, **kw)
+
+
 @dataclasses.dataclass
 class FineTuneConfiguration:
     """Hyperparameter overrides applied to the surgered net
@@ -116,18 +132,9 @@ class TransferLearning:
         (↔ nOutReplace; nOut maps to ``units`` on dense/output layers and
         ``filters`` on conv layers)."""
         i = self._index_of(layer)
-        cfg = self._layers[i]
-        if hasattr(cfg, "units"):
-            kw = {"units": n_out}
-        elif hasattr(cfg, "filters"):
-            kw = {"filters": n_out}
-        else:
-            raise ValueError(
-                f"layer {self._keep_names[i]!r} ({type(cfg).__name__}) has "
-                "no output-width attribute (units/filters)")
-        if weight_init is not None and hasattr(cfg, "weight_init"):
-            kw["weight_init"] = weight_init
-        self._layers[i] = dataclasses.replace(cfg, **kw)
+        self._layers[i] = _replace_n_out(
+            self._layers[i], n_out, weight_init,
+            f"layer {self._keep_names[i]!r}")
         self._keep_names[i] = None  # shape changed: fresh init
         return self
 
@@ -216,19 +223,9 @@ class GraphTransferLearning:
         v = self._vertices[vertex]
         if v.kind != "layer":
             raise ValueError(f"vertex {vertex!r} is {v.kind!r}, not a layer")
-        cfg = v.layer
-        if hasattr(cfg, "units"):
-            kw = {"units": n_out}
-        elif hasattr(cfg, "filters"):
-            kw = {"filters": n_out}
-        else:
-            raise ValueError(
-                f"vertex {vertex!r} ({type(cfg).__name__}) has no "
-                "output-width attribute (units/filters)")
-        if weight_init is not None and hasattr(cfg, "weight_init"):
-            kw["weight_init"] = weight_init
         self._vertices[vertex] = dataclasses.replace(
-            v, layer=dataclasses.replace(cfg, **kw))
+            v, layer=_replace_n_out(v.layer, n_out, weight_init,
+                                    f"vertex {vertex!r}"))
         self._fresh.add(vertex)
         return self
 
@@ -293,6 +290,10 @@ class GraphTransferLearning:
         from deeplearning4j_tpu.nn.config import GraphConfig
         from deeplearning4j_tpu.nn.model import GraphModel
 
+        if not self._outputs:
+            raise ValueError(
+                "surgered graph has no outputs — call set_outputs() (or "
+                "add_vertex a new head) after removing the old output")
         net = self._model.net
         if self._ftc is not None:
             net = self._ftc.apply(net)

@@ -322,6 +322,30 @@ class NoOp:
         return init, update
 
 
+class OptaxUpdater:
+    """Adapter: any optax ``GradientTransformation`` as an updater.
+
+    Escape hatch beyond the reference's IUpdater set (e.g. lion, lamb,
+    schedule-chained transforms) — both APIs share the additive-update
+    convention, so the bridge is direct. Not JSON round-trippable (an
+    arbitrary optax transform has no config form); use the named updaters
+    for configs that must serialize.
+    """
+
+    def __init__(self, tx):
+        self.tx = tx
+
+    def make(self):
+        def init(params):
+            return self.tx.init(params)
+
+        def update(grads, state, params, step):
+            updates, state = self.tx.update(grads, state, params)
+            return updates, state
+
+        return init, update
+
+
 _BY_NAME = {
     "sgd": Sgd, "nesterovs": Nesterovs, "adam": Adam, "adamw": AdamW,
     "amsgrad": AMSGrad, "nadam": Nadam, "adamax": AdaMax, "adagrad": AdaGrad,

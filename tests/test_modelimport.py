@@ -468,3 +468,47 @@ class TestKerasBreadth:
         ])
         x = np.random.RandomState(10).randn(3, 5).astype(np.float32)
         _compare_keras(m, _save(m, tmp_path), x)
+
+
+class TestKerasCustomLayerSPI:
+    def test_register_custom_layer_mapper(self, tmp_path):
+        """↔ KerasLayer.registerCustomLayer: user-registered mapper makes an
+        otherwise-unsupported layer importable, oracle-checked vs keras."""
+        from dataclasses import dataclass
+
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.modelimport.keras import (
+            LAYER_MAPPERS,
+            register_keras_layer,
+        )
+        from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+
+        @register_config
+        @dataclass
+        class UnitNorm(LayerConfig):
+            @property
+            def has_params(self):
+                return False
+
+            def apply(self, params, state, x, *, train=False, rng=None):
+                n = jnp.linalg.norm(x, axis=-1, keepdims=True)
+                return x / jnp.maximum(n, 1e-12), state
+
+        def unit_norm_mapper(cfg):
+            return UnitNorm(), {}
+
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((4,)),
+            tf.keras.layers.Dense(6, activation="relu"),
+            tf.keras.layers.UnitNormalization(),
+        ])
+        x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        register_keras_layer("UnitNormalization", unit_norm_mapper)
+        try:
+            _compare_keras(km, _save(km, tmp_path), x)
+        finally:
+            LAYER_MAPPERS.pop("UnitNormalization", None)
+        # registry restored: the strict-refusal behavior is back
+        with pytest.raises(KerasImportError, match="no mapper"):
+            import_keras_model(_save(km, tmp_path, "m2.h5"))

@@ -317,3 +317,14 @@ class TestGraphTransferLearning:
         assert "dense" in gtl._vertices
         new_model, new_vars, _ = gtl.n_out_replace("output", 2).build()
         assert new_vars["params"]["output"]["W"].shape[-1] == 2
+
+
+    def test_build_requires_outputs(self):
+        import pytest as _p
+
+        from deeplearning4j_tpu.train.transfer import GraphTransferLearning
+
+        model, variables = self._tiny_graph()
+        gtl = GraphTransferLearning(model, variables).remove_vertex("dense")
+        with _p.raises(ValueError, match="no outputs"):
+            gtl.build()

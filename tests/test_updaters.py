@@ -96,3 +96,54 @@ def test_schedule_in_updater():
     u15, _ = update(grads, st, params, jnp.asarray(15))
     assert np.isclose(float(u0["w"][0]), -0.1)
     assert np.isclose(float(u15["w"][0]), -0.01)
+
+
+def test_optax_updater_bridge():
+    """OptaxUpdater: optax.adam through the Trainer step matches our Adam
+    closely (same math, optax counts steps internally)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    optax = pytest.importorskip("optax")
+    from deeplearning4j_tpu.train.updaters import Adam, OptaxUpdater, apply_updates
+
+    params = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(8, 4))
+                               .astype(np.float32))}
+    grads = {"w": jnp.asarray(np.random.default_rng(1).normal(size=(8, 4))
+                              .astype(np.float32))}
+
+    ours_init, ours_update = Adam(1e-2).make()
+    ox_init, ox_update = OptaxUpdater(optax.adam(1e-2)).make()
+    s1, s2 = ours_init(params), ox_init(params)
+    p1, p2 = params, params
+    for step in range(5):
+        u1, s1 = ours_update(grads, s1, p1, jnp.asarray(step))
+        u2, s2 = ox_update(grads, s2, p2, jnp.asarray(step))
+        p1 = apply_updates(p1, u1)
+        p2 = apply_updates(p2, u2)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_optax_updater_in_trainer():
+    import numpy as np
+
+    optax = pytest.importorskip("optax")
+    from deeplearning4j_tpu.models.lenet import lenet_config
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import OptaxUpdater
+
+    cfg = lenet_config()
+    cfg.net.updater = OptaxUpdater(optax.lion(1e-3))
+    model = SequentialModel(cfg)
+    tr = Trainer(model)
+    ts = tr.init_state()
+    r = np.random.default_rng(0)
+    batch = {"features": r.normal(size=(8, 28, 28, 1)).astype(np.float32),
+             "labels": np.eye(10, dtype=np.float32)[r.integers(0, 10, 8)]}
+    losses = []
+    for _ in range(10):
+        ts, m = tr.train_step(ts, batch)
+        losses.append(float(m["total_loss"]))
+    assert losses[-1] < losses[0], losses
