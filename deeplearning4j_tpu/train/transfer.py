@@ -140,6 +140,10 @@ class TransferLearning:
 
     def build(self, seed: Optional[int] = None):
         """Returns (model, variables, frozen_layer_names)."""
+        if not self._layers:
+            raise ValueError(
+                "surgered network has no layers — remove_last_layers "
+                "removed everything; add_layer a new head before build()")
         net = self._model.net
         if self._ftc is not None:
             net = self._ftc.apply(net)
@@ -292,8 +296,8 @@ class GraphTransferLearning:
 
         if not self._outputs:
             raise ValueError(
-                "surgered graph has no outputs — call set_outputs() (or "
-                "add_vertex a new head) after removing the old output")
+                "surgered graph has no outputs — after removing the old "
+                "output vertex, add a new head and name it in set_outputs()")
         net = self._model.net
         if self._ftc is not None:
             net = self._ftc.apply(net)

@@ -305,13 +305,11 @@ class TestGraphTransferLearning:
         assert out["output"].shape == (2, 10)
 
     def test_remove_vertex_validation_leaves_builder_intact(self):
-        import pytest as _p
-
         from deeplearning4j_tpu.train.transfer import GraphTransferLearning
 
         model, variables = self._tiny_graph()
         gtl = GraphTransferLearning(model, variables)
-        with _p.raises(ValueError, match="missing inputs"):
+        with pytest.raises(ValueError, match="missing inputs"):
             gtl.remove_vertex("dense", and_descendants=False)
         # builder unchanged: a valid edit still works
         assert "dense" in gtl._vertices
@@ -320,11 +318,17 @@ class TestGraphTransferLearning:
 
 
     def test_build_requires_outputs(self):
-        import pytest as _p
-
         from deeplearning4j_tpu.train.transfer import GraphTransferLearning
 
         model, variables = self._tiny_graph()
         gtl = GraphTransferLearning(model, variables).remove_vertex("dense")
-        with _p.raises(ValueError, match="no outputs"):
+        with pytest.raises(ValueError, match="no outputs"):
             gtl.build()
+
+
+def test_sequential_remove_all_layers_raises(pretrained):
+    model, variables = pretrained
+    tl = TransferLearning(model, variables).remove_last_layers(
+        len(model.layers))
+    with pytest.raises(ValueError, match="no layers"):
+        tl.build()
