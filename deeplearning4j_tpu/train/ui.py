@@ -197,6 +197,34 @@ class UIServer:
             def log_message(self, *a):  # noqa: N802 - stdlib API
                 pass
 
+            def do_POST(self):  # noqa: N802 - stdlib API
+                # Remote stats routing (↔ RemoteUIStatsStorageRouter →
+                # VertxUIServer POST endpoint): a RemoteStatsListener on a
+                # training host appends JSONL records into this server's
+                # log_dir, so the dashboard charts remote runs live.
+                url = urlparse(self.path)
+                if url.path != "/api/post":
+                    self.send_error(404)
+                    return
+                run = parse_qs(url.query).get("run", [""])[0]
+                if not run or "/" in run or ".." in run:
+                    self.send_error(400, "bad run name")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                try:
+                    lines = [json.dumps(json.loads(l)) for l in
+                             body.decode().splitlines() if l.strip()]
+                except ValueError:
+                    self.send_error(400, "body must be JSONL")
+                    return
+                ui.log_dir.mkdir(parents=True, exist_ok=True)
+                with open(ui.log_dir / f"{run}.jsonl", "a") as fh:
+                    for line in lines:
+                        fh.write(line + "\n")
+                self.send_response(204)
+                self.end_headers()
+
             def do_GET(self):  # noqa: N802 - stdlib API
                 url = urlparse(self.path)
                 if url.path == "/":
@@ -233,3 +261,69 @@ class UIServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+class RemoteStatsListener:
+    """Training listener POSTing metric records to a remote UIServer
+    (↔ RemoteUIStatsStorageRouter: train on one machine, chart on another).
+
+    Buffers records and flushes every ``flush_every`` iterations (one HTTP
+    round-trip per flush, never per step). Network failures are recorded
+    on ``last_error`` and never interrupt training (reference behavior:
+    the router retries/queues rather than failing the fit).
+    """
+
+    def __init__(self, url: str, run: str, *, every: int = 1,
+                 flush_every: int = 32, timeout: float = 2.0):
+        self.url = url.rstrip("/")
+        self.run = run
+        self.every = every
+        self.flush_every = flush_every
+        self.timeout = timeout
+        self.last_error: Optional[str] = None
+        self._buf: List[str] = []
+
+    def _flush(self):
+        if not self._buf:
+            return
+        import urllib.request
+
+        body = ("\n".join(self._buf) + "\n").encode()
+        self._buf = []
+        req = urllib.request.Request(
+            f"{self.url}/api/post?run={self.run}", data=body,
+            headers={"Content-Type": "application/jsonl"})
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except Exception as e:  # noqa: BLE001 - stats must not kill training
+            self.last_error = str(e)
+
+    def on_fit_start(self, trainer, ts):
+        return False
+
+    def on_epoch_start(self, epoch, ts):
+        return False
+
+    def on_epoch_end(self, epoch, ts):
+        self._flush()
+        return False
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        if step % self.every == 0:
+            import time as _time
+
+            import jax as _jax
+
+            rec = {"epoch": epoch, "step": step, "time": _time.time()}
+            for k, v in metrics.items():
+                try:
+                    rec[k] = float(_jax.device_get(v))
+                except (TypeError, ValueError):
+                    pass
+            self._buf.append(json.dumps(rec))
+            if len(self._buf) >= self.flush_every:
+                self._flush()
+        return False
+
+    def on_fit_end(self, trainer, ts):
+        self._flush()

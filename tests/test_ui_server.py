@@ -87,3 +87,53 @@ class TestUIServer:
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get(ui, "/nope")
         assert ei.value.code == 404
+
+
+def test_remote_stats_routing(tmp_path):
+    """↔ RemoteUIStatsStorageRouter: listener on the 'training host' POSTs
+    metric records; the UI server's run/metrics API charts them."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.train.ui import RemoteStatsListener, UIServer
+
+    server = UIServer(str(tmp_path), port=0).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        lis = RemoteStatsListener(url, "remote-run", flush_every=2)
+        for step in range(5):
+            lis.on_iteration(0, step, None,
+                             {"total_loss": jnp.asarray(1.0 / (step + 1))})
+        lis.on_fit_end(None, None)
+        assert lis.last_error is None, lis.last_error
+        assert "remote-run.jsonl" in server.runs()
+        series = server.metrics("remote-run.jsonl")
+        assert len(series["total_loss"]) == 5
+        assert series["total_loss"][0][1] == 1.0
+    finally:
+        server.stop()
+
+
+def test_remote_stats_post_rejects_bad_run(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.train.ui import UIServer
+
+    server = UIServer(str(tmp_path), port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api/post?run=../evil",
+            data=b'{"step": 1}\n')
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req, timeout=2)
+    finally:
+        server.stop()
+
+
+def test_remote_stats_listener_survives_dead_server(tmp_path):
+    from deeplearning4j_tpu.train.ui import RemoteStatsListener
+
+    lis = RemoteStatsListener("http://127.0.0.1:9", "r", flush_every=1,
+                              timeout=0.5)
+    lis.on_iteration(0, 0, None, {"total_loss": 1.0})  # must not raise
+    assert lis.last_error is not None
