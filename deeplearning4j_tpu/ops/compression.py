@@ -1,0 +1,126 @@
+"""Gradient compression: threshold + bitmap encode/decode.
+
+ref: libnd4j encode_threshold/decode_threshold and encode_bitmap/
+decode_bitmap ops (SURVEY §2.1 "Gradient-compression ops") — the Strom-2015
+style sparse update codec under the reference's gradient-sharing path
+(EncodingHandler → ThresholdCompression), with residual accumulation.
+
+On TPU this codec is NOT used intra-slice: ICI all-reduce is exact and
+faster than any lossy exchange (SURVEY §2.8.7). It exists for the
+DCN-constrained leg — cross-slice or cross-datacenter gradient exchange
+where bandwidth, not latency, dominates — and as capability parity with
+the reference's compression surface.
+
+TPU-first shape: both codecs are fixed-shape, jit-compatible pure
+functions (XLA-friendly: no data-dependent output sizes — the threshold
+codec returns a fixed ``max_elements`` buffer plus a count, the bitmap
+codec a dense 2-bit plane), and the residual logic is a pure
+(grads, residual) → (encoded, new_residual) transform mirroring
+EncodingHandler's accumulate-what-didn't-send rule.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ThresholdEncoded(NamedTuple):
+    """Sparse codec output: up to ``max_elements`` (index, ±threshold)."""
+
+    indices: jax.Array   # [max_elements] int32, -1 = empty slot
+    signs: jax.Array     # [max_elements] int8 (+1/-1; 0 = empty)
+    threshold: jax.Array  # scalar f32
+    count: jax.Array     # scalar int32 — how many slots are live
+
+
+def threshold_encode(grad: jax.Array, threshold: float,
+                     max_elements: int) -> Tuple[ThresholdEncoded, jax.Array]:
+    """↔ encode_threshold: entries with |g| >= threshold are quantized to
+    ±threshold; the rest (and any overflow beyond ``max_elements``) stays
+    in the returned residual. Deterministic: largest magnitudes win slots.
+
+    Returns (encoded, residual) with residual.shape == grad.shape.
+    """
+    flat = grad.reshape(-1)
+    n = flat.shape[0]
+    mag = jnp.abs(flat)
+    eligible = mag >= threshold
+    # Top-k by magnitude among eligible (stable fixed-shape selection).
+    score = jnp.where(eligible, mag, -1.0)
+    k = min(max_elements, n)
+    top_val, top_idx = jax.lax.top_k(score, k)
+    live = top_val >= threshold
+    count = jnp.sum(live.astype(jnp.int32))
+    idx = jnp.where(live, top_idx, -1).astype(jnp.int32)
+    sgn = jnp.where(
+        live, jnp.sign(flat[top_idx]), 0.0).astype(jnp.int8)
+    if k < max_elements:
+        idx = jnp.pad(idx, (0, max_elements - k), constant_values=-1)
+        sgn = jnp.pad(sgn, (0, max_elements - k))
+    # Residual: everything not transmitted, plus the quantization error
+    # of what was (g - ±threshold), matching the reference's residual rule.
+    sent = jnp.zeros_like(flat).at[jnp.where(idx >= 0, idx, 0)].add(
+        jnp.where(idx >= 0, sgn.astype(flat.dtype) * threshold, 0.0))
+    residual = (flat - sent).reshape(grad.shape)
+    enc = ThresholdEncoded(idx, sgn, jnp.float32(threshold), count)
+    return enc, residual
+
+
+def threshold_decode(encoded: ThresholdEncoded, shape) -> jax.Array:
+    """↔ decode_threshold: scatter ±threshold back into a dense array."""
+    n = 1
+    for s in shape:
+        n *= int(s)
+    flat = jnp.zeros((n,), jnp.float32)
+    safe_idx = jnp.where(encoded.indices >= 0, encoded.indices, 0)
+    vals = jnp.where(encoded.indices >= 0,
+                     encoded.signs.astype(jnp.float32) * encoded.threshold,
+                     0.0)
+    return flat.at[safe_idx].add(vals).reshape(shape)
+
+
+def bitmap_encode(grad: jax.Array, threshold: float
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """↔ encode_bitmap: dense 2-bit plane — 0 = below threshold,
+    1 = +threshold, 2 = -threshold (packed 16 codes per int32 word).
+
+    Returns (packed int32 words [ceil(n/16)], residual like grad). Unlike
+    the threshold codec there is no element cap: size is n/16 words always
+    (the reference picks bitmap over sparse when density is high).
+    """
+    flat = grad.reshape(-1)
+    n = flat.shape[0]
+    code = jnp.where(flat >= threshold, 1,
+                     jnp.where(flat <= -threshold, 2, 0)).astype(jnp.uint32)
+    sent = jnp.where(code == 1, threshold,
+                     jnp.where(code == 2, -threshold, 0.0)).astype(flat.dtype)
+    residual = (flat - sent).reshape(grad.shape)
+    pad = (-n) % 16
+    code = jnp.pad(code, (0, pad))
+    words = code.reshape(-1, 16)
+    shifts = jnp.arange(16, dtype=jnp.uint32) * 2
+    packed = jnp.sum(words << shifts, axis=1, dtype=jnp.uint32)
+    return packed.astype(jnp.int32), residual
+
+
+def bitmap_decode(packed: jax.Array, threshold: float, shape) -> jax.Array:
+    """↔ decode_bitmap."""
+    n = 1
+    for s in shape:
+        n *= int(s)
+    words = packed.astype(jnp.uint32)[:, None]
+    shifts = jnp.arange(16, dtype=jnp.uint32) * 2
+    codes = (words >> shifts) & 0x3
+    codes = codes.reshape(-1)[:n]
+    return jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0)
+                     ).astype(jnp.float32).reshape(shape)
+
+
+def compress_ratio(n_elements: int, encoded: ThresholdEncoded) -> float:
+    """Wire-size ratio vs dense f32 (diagnostic, host-side)."""
+    wire = int(encoded.indices.shape[0]) * (4 + 1) + 8
+    return wire / (n_elements * 4)

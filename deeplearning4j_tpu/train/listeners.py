@@ -99,6 +99,18 @@ class PerformanceListener(TrainingListener):
         return False
 
 
+def metrics_record(epoch: int, step: int, metrics) -> dict:
+    """Host-side JSONL record for one iteration's metrics (shared by the
+    file and remote stats listeners)."""
+    rec = {"epoch": epoch, "step": step, "time": time.time()}
+    for k, v in metrics.items():
+        try:
+            rec[k] = float(jax.device_get(v))
+        except (TypeError, ValueError):
+            pass
+    return rec
+
+
 class JsonlMetricsListener(TrainingListener):
     """Structured metrics to a JSONL file (↔ StatsListener → StatsStorage;
     the file is the storage, consumable by any dashboard)."""
@@ -113,13 +125,8 @@ class JsonlMetricsListener(TrainingListener):
 
     def on_iteration(self, epoch, step, ts, metrics):
         if step % self.every == 0 and self._fh:
-            rec = {"epoch": epoch, "step": step, "time": time.time()}
-            for k, v in metrics.items():
-                try:
-                    rec[k] = float(jax.device_get(v))
-                except (TypeError, ValueError):
-                    pass
-            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.write(json.dumps(metrics_record(epoch, step, metrics))
+                           + "\n")
         return False
 
     def on_fit_end(self, trainer, ts):

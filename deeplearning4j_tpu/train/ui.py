@@ -29,6 +29,8 @@ from pathlib import Path
 from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j-tpu training UI</title>
 <style>
@@ -210,7 +212,14 @@ class UIServer:
                 if not run or "/" in run or ".." in run:
                     self.send_error(400, "bad run name")
                     return
-                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if not 0 <= n <= 8 << 20:  # 8 MiB cap per post
+                    self.send_error(413, "body too large")
+                    return
                 body = self.rfile.read(n)
                 try:
                     lines = [json.dumps(json.loads(l)) for l in
@@ -263,46 +272,50 @@ class UIServer:
             self._httpd = None
 
 
-class RemoteStatsListener:
+class RemoteStatsListener(TrainingListener):
     """Training listener POSTing metric records to a remote UIServer
     (↔ RemoteUIStatsStorageRouter: train on one machine, chart on another).
 
     Buffers records and flushes every ``flush_every`` iterations (one HTTP
-    round-trip per flush, never per step). Network failures are recorded
-    on ``last_error`` and never interrupt training (reference behavior:
-    the router retries/queues rather than failing the fit).
+    round-trip per flush, never per step). A failed flush re-queues its
+    records and retries on the next flush; ``last_error`` records the most
+    recent failure and training is never interrupted (reference behavior:
+    the router queues rather than failing the fit).
     """
 
     def __init__(self, url: str, run: str, *, every: int = 1,
-                 flush_every: int = 32, timeout: float = 2.0):
+                 flush_every: int = 32, timeout: float = 2.0,
+                 max_queue: int = 10_000):
+        from urllib.parse import quote
+
         self.url = url.rstrip("/")
         self.run = run
         self.every = every
         self.flush_every = flush_every
         self.timeout = timeout
+        self.max_queue = max_queue
         self.last_error: Optional[str] = None
         self._buf: List[str] = []
+        self._endpoint = f"{self.url}/api/post?run={quote(run, safe='')}"
 
     def _flush(self):
         if not self._buf:
             return
         import urllib.request
 
-        body = ("\n".join(self._buf) + "\n").encode()
-        self._buf = []
+        pending = self._buf
+        body = ("\n".join(pending) + "\n").encode()
         req = urllib.request.Request(
-            f"{self.url}/api/post?run={self.run}", data=body,
+            self._endpoint, data=body,
             headers={"Content-Type": "application/jsonl"})
         try:
             urllib.request.urlopen(req, timeout=self.timeout).close()
         except Exception as e:  # noqa: BLE001 - stats must not kill training
             self.last_error = str(e)
-
-    def on_fit_start(self, trainer, ts):
-        return False
-
-    def on_epoch_start(self, epoch, ts):
-        return False
+            # Re-queue for the next flush (bounded: drop oldest on overflow).
+            self._buf = pending[-self.max_queue:]
+            return
+        self._buf = []
 
     def on_epoch_end(self, epoch, ts):
         self._flush()
@@ -310,17 +323,9 @@ class RemoteStatsListener:
 
     def on_iteration(self, epoch, step, ts, metrics):
         if step % self.every == 0:
-            import time as _time
+            from deeplearning4j_tpu.train.listeners import metrics_record
 
-            import jax as _jax
-
-            rec = {"epoch": epoch, "step": step, "time": _time.time()}
-            for k, v in metrics.items():
-                try:
-                    rec[k] = float(_jax.device_get(v))
-                except (TypeError, ValueError):
-                    pass
-            self._buf.append(json.dumps(rec))
+            self._buf.append(json.dumps(metrics_record(epoch, step, metrics)))
             if len(self._buf) >= self.flush_every:
                 self._flush()
         return False

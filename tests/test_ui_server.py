@@ -137,3 +137,52 @@ def test_remote_stats_listener_survives_dead_server(tmp_path):
                               timeout=0.5)
     lis.on_iteration(0, 0, None, {"total_loss": 1.0})  # must not raise
     assert lis.last_error is not None
+
+
+def test_remote_stats_listener_through_trainer_fit(tmp_path):
+    """The listener rides a real Trainer.fit loop (protocol compliance)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.ui import RemoteStatsListener, UIServer
+
+    server = UIServer(str(tmp_path), port=0).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        lis = RemoteStatsListener(url, "fit-run", flush_every=4)
+        model = lenet()
+        tr = Trainer(model)
+        ts = tr.init_state()
+        r = np.random.default_rng(0)
+        x = r.normal(size=(16, 28, 28, 1)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[r.integers(0, 10, 16)]
+        tr.fit(ts, ArrayDataSetIterator(x, y, batch_size=8), epochs=2,
+               listeners=[lis])
+        assert lis.last_error is None, lis.last_error
+        series = server.metrics("fit-run.jsonl")
+        assert len(series["total_loss"]) >= 4
+    finally:
+        server.stop()
+
+
+def test_remote_stats_requeues_on_failure(tmp_path):
+    """A failed flush keeps the records and delivers them once the server
+    is reachable (the router's queue-don't-drop contract)."""
+    from deeplearning4j_tpu.train.ui import RemoteStatsListener, UIServer
+
+    server = UIServer(str(tmp_path), port=0).start()
+    port = server.port
+    server.stop()  # now unreachable
+    lis = RemoteStatsListener(f"http://127.0.0.1:{port}", "q", flush_every=1,
+                              timeout=0.5)
+    lis.on_iteration(0, 0, None, {"total_loss": 3.0})
+    assert lis.last_error is not None and lis._buf  # queued, not dropped
+    server2 = UIServer(str(tmp_path), port=port).start()
+    try:
+        lis.on_iteration(0, 1, None, {"total_loss": 2.0})
+        series = server2.metrics("q.jsonl")
+        assert len(series["total_loss"]) == 2  # both records arrived
+    finally:
+        server2.stop()
