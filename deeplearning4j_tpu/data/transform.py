@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -436,3 +436,139 @@ class TransformProcess:
             cls = _OP_REGISTRY[sd.pop("op")]
             steps.append(cls(**sd))
         return TransformProcess(Schema.from_dict(d["schema"]), steps)
+
+
+# --- join + group-by reduction (↔ org.datavec.api.transform.join.Join and
+# org.datavec.api.transform.reduce.Reducer, executed by
+# LocalTransformExecutor in the reference) ----------------------------------
+
+
+def join(left_records, left_schema: Schema, right_records,
+         right_schema: Schema, *, key: Union[str, Sequence[str]],
+         join_type: str = "inner") -> Tuple[List[List], Schema]:
+    """↔ Join: combine two record sets on key column(s).
+
+    join_type: 'inner' | 'left' | 'right' | 'full'. Output columns: key(s),
+    then left non-keys, then right non-keys; missing side fills None.
+    Right-side duplicates multiply rows (relational semantics, like the
+    reference's Spark/local join executors).
+    """
+    keys = [key] if isinstance(key, str) else list(key)
+    if join_type not in ("inner", "left", "right", "full"):
+        raise ValueError(f"unknown join_type {join_type!r}")
+    li = [left_schema.index_of(k) for k in keys]
+    ri = [right_schema.index_of(k) for k in keys]
+    l_rest = [i for i in range(len(left_schema.columns)) if i not in li]
+    r_rest = [i for i in range(len(right_schema.columns)) if i not in ri]
+
+    out_schema = Schema()
+    for k, i in zip(keys, li):
+        out_schema.columns.append(dataclasses.replace(left_schema.columns[i]))
+    for i in l_rest:
+        out_schema.columns.append(dataclasses.replace(left_schema.columns[i]))
+    taken = set(out_schema.names())
+    for i in r_rest:
+        col = dataclasses.replace(right_schema.columns[i])
+        if col.name in taken:
+            # Both sides carry a non-key column of this name: disambiguate
+            # (silently shadowing would make index_of always hit the left).
+            col = dataclasses.replace(col, name=f"right_{col.name}")
+        taken.add(col.name)
+        out_schema.columns.append(col)
+
+    rindex: Dict[tuple, List] = {}
+    for r in right_records:
+        rindex.setdefault(tuple(r[i] for i in ri), []).append(r)
+
+    out: List[List] = []
+    matched_right = set()
+    for l in left_records:
+        k = tuple(l[i] for i in li)
+        matches = rindex.get(k, [])
+        if matches:
+            matched_right.add(k)
+            for r in matches:
+                out.append(list(k) + [l[i] for i in l_rest]
+                           + [r[i] for i in r_rest])
+        elif join_type in ("left", "full"):
+            out.append(list(k) + [l[i] for i in l_rest]
+                       + [None] * len(r_rest))
+    if join_type in ("right", "full"):
+        for k, rows in rindex.items():
+            if k in matched_right:
+                continue
+            for r in rows:
+                out.append(list(k) + [None] * len(l_rest)
+                           + [r[i] for i in r_rest])
+    return out, out_schema
+
+
+_REDUCE_OPS = {
+    "sum": lambda vs: float(np.sum(vs)),
+    "mean": lambda vs: float(np.mean(vs)),
+    "min": lambda vs: float(np.min(vs)),
+    "max": lambda vs: float(np.max(vs)),
+    "stdev": lambda vs: float(np.std(vs, ddof=1)) if len(vs) > 1 else 0.0,
+    "count": len,
+    "first": lambda vs: vs[0],
+    "last": lambda vs: vs[-1],
+}
+
+
+def reduce_by_key(records, schema: Schema, *, key: Union[str, Sequence[str]],
+                  ops: Dict[str, str]) -> Tuple[List[List], Schema]:
+    """↔ Reducer: group rows by key column(s), aggregate the named columns.
+
+    ``ops`` maps column name → one of sum/mean/min/max/stdev/count/first/
+    last. Output columns: key(s) then aggregates in ``ops`` order, named
+    '<op>(<column>)' like the reference's reduced-column naming.
+    """
+    keys = [key] if isinstance(key, str) else list(key)
+    ki = [schema.index_of(k) for k in keys]
+    numeric_ops = ("sum", "mean", "min", "max", "stdev")
+    col_idx = {}
+    for col, op in ops.items():
+        col_idx[col] = schema.index_of(col)  # validates existence
+        if op not in _REDUCE_OPS:
+            raise ValueError(
+                f"unknown reduce op {op!r}; have {sorted(_REDUCE_OPS)}")
+        if op in numeric_ops and schema.column(col).type not in (
+                "integer", "double", "long"):
+            raise ValueError(
+                f"reduce op {op!r} needs a numeric column; "
+                f"{col!r} is {schema.column(col).type!r}")
+
+    groups: Dict[tuple, List[List]] = {}
+    order: List[tuple] = []
+    for r in records:
+        k = tuple(r[i] for i in ki)
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(r)
+
+    out_schema = Schema()
+    for k, i in zip(keys, ki):
+        out_schema.columns.append(dataclasses.replace(schema.columns[i]))
+    for col, op in ops.items():
+        name = f"{op}({col})"
+        if op == "count":
+            out_schema.add_integer_column(name)
+        elif op in ("first", "last"):
+            out_schema.columns.append(
+                dataclasses.replace(schema.column(col), name=name))
+        else:
+            out_schema.add_double_column(name)
+
+    out = []
+    for k in order:
+        rows = groups[k]
+        rec = list(k)
+        for col, op in ops.items():
+            ci = col_idx[col]
+            vals = [r[ci] for r in rows]
+            if op not in ("count", "first", "last"):
+                vals = [float(v) for v in vals]
+            rec.append(_REDUCE_OPS[op](vals))
+        out.append(rec)
+    return out, out_schema

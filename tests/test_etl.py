@@ -285,3 +285,89 @@ def test_regex_reader_rejects_trailing_garbage(tmp_path):
     p.write_text("a 1 GARBAGE\n")
     with _pytest.raises(ValueError):
         list(RegexLineRecordReader(p, r"(\w+) (\d+)"))
+
+
+# --- join + reducer (round 3) ----------------------------------------------
+
+
+def _people_schema():
+    from deeplearning4j_tpu.data.transform import Schema
+
+    s = Schema()
+    s.add_string_column("id")
+    s.add_double_column("amount")
+    return s
+
+
+def test_join_inner_and_left():
+    from deeplearning4j_tpu.data.transform import Schema, join
+
+    left_s = _people_schema()
+    right_s = Schema()
+    right_s.add_string_column("id")
+    right_s.add_string_column("city")
+    left = [["a", 1.0], ["b", 2.0], ["c", 3.0]]
+    right = [["a", "rome"], ["b", "oslo"], ["b", "kyiv"]]
+
+    rows, out_s = join(left, left_s, right, right_s, key="id")
+    assert out_s.names() == ["id", "amount", "city"]
+    assert rows == [["a", 1.0, "rome"], ["b", 2.0, "oslo"],
+                    ["b", 2.0, "kyiv"]]
+
+    rows_l, _ = join(left, left_s, right, right_s, key="id",
+                     join_type="left")
+    assert ["c", 3.0, None] in rows_l
+
+    rows_f, _ = join(left, left_s, [["z", "lima"]], right_s, key="id",
+                     join_type="full")
+    assert ["z", None, "lima"] in rows_f
+
+
+def test_reduce_by_key():
+    from deeplearning4j_tpu.data.transform import reduce_by_key
+
+    s = _people_schema()
+    records = [["a", 1.0], ["a", 3.0], ["b", 10.0]]
+    rows, out_s = reduce_by_key(records, s, key="id",
+                                ops={"amount": "mean"})
+    assert out_s.names() == ["id", "mean(amount)"]
+    assert rows == [["a", 2.0], ["b", 10.0]]
+
+    rows2, out2 = reduce_by_key(records, s, key="id",
+                                ops={"amount": "count"})
+    assert rows2 == [["a", 2], ["b", 1]]
+    assert out2.column("count(amount)").type == "integer"
+
+
+def test_reduce_unknown_op_raises():
+    import pytest as _p
+
+    from deeplearning4j_tpu.data.transform import reduce_by_key
+
+    with _p.raises(ValueError, match="unknown reduce op"):
+        reduce_by_key([["a", 1.0]], _people_schema(), key="id",
+                      ops={"amount": "median"})
+
+
+def test_join_renames_colliding_columns():
+    from deeplearning4j_tpu.data.transform import Schema, join
+
+    left_s = _people_schema()                       # id, amount
+    right_s = _people_schema()                      # id, amount (collision)
+    rows, out_s = join([["a", 1.0]], left_s, [["a", 9.0]], right_s, key="id")
+    assert out_s.names() == ["id", "amount", "right_amount"]
+    assert rows == [["a", 1.0, 9.0]]
+    # inputs unchanged (no schema aliasing)
+    assert left_s.names() == ["id", "amount"]
+
+
+def test_reduce_numeric_op_on_string_column_rejected():
+    import pytest as _p
+
+    from deeplearning4j_tpu.data.transform import Schema, reduce_by_key
+
+    s = Schema()
+    s.add_string_column("id")
+    s.add_string_column("city")
+    with _p.raises(ValueError, match="numeric column"):
+        reduce_by_key([["a", "rome"]], s, key="id", ops={"city": "min"})

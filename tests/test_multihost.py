@@ -9,6 +9,7 @@ bind/handshake.
 """
 
 import os
+import re
 import socket
 import subprocess
 import sys
@@ -63,9 +64,10 @@ def _free_port():
 def test_two_process_bootstrap_and_psum():
     port = _free_port()
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "").replace(
-        "--xla_force_host_platform_device_count=8", "")
-        + " --xla_force_host_platform_device_count=2").strip()
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
     procs = [
         subprocess.Popen([sys.executable, "-c", _WORKER, str(port), str(i)],
                          env=env, stdout=subprocess.PIPE,
