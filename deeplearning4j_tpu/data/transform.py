@@ -471,8 +471,14 @@ def join(left_records, left_schema: Schema, right_records,
         col = dataclasses.replace(right_schema.columns[i])
         if col.name in taken:
             # Both sides carry a non-key column of this name: disambiguate
-            # (silently shadowing would make index_of always hit the left).
-            col = dataclasses.replace(col, name=f"right_{col.name}")
+            # (silently shadowing would make index_of always hit the left),
+            # re-suffixing until unique.
+            base, n = f"right_{col.name}", 2
+            name = base
+            while name in taken:
+                name = f"{base}_{n}"
+                n += 1
+            col = dataclasses.replace(col, name=name)
         taken.add(col.name)
         out_schema.columns.append(col)
 
@@ -538,14 +544,9 @@ def reduce_by_key(records, schema: Schema, *, key: Union[str, Sequence[str]],
                 f"reduce op {op!r} needs a numeric column; "
                 f"{col!r} is {schema.column(col).type!r}")
 
-    groups: Dict[tuple, List[List]] = {}
-    order: List[tuple] = []
+    groups: Dict[tuple, List[List]] = {}  # insertion-ordered
     for r in records:
-        k = tuple(r[i] for i in ki)
-        if k not in groups:
-            groups[k] = []
-            order.append(k)
-        groups[k].append(r)
+        groups.setdefault(tuple(r[i] for i in ki), []).append(r)
 
     out_schema = Schema()
     for k, i in zip(keys, ki):
@@ -561,14 +562,19 @@ def reduce_by_key(records, schema: Schema, *, key: Union[str, Sequence[str]],
             out_schema.add_double_column(name)
 
     out = []
-    for k in order:
-        rows = groups[k]
+    for k, rows in groups.items():
         rec = list(k)
         for col, op in ops.items():
             ci = col_idx[col]
             vals = [r[ci] for r in rows]
             if op not in ("count", "first", "last"):
-                vals = [float(v) for v in vals]
+                # None = missing (e.g. an outer join's unmatched side):
+                # excluded from the aggregate, like the reference Reducer's
+                # null handling. All-missing -> 0 count rule applies.
+                vals = [float(v) for v in vals if v is not None]
+                if not vals:
+                    rec.append(None)
+                    continue
             rec.append(_REDUCE_OPS[op](vals))
         out.append(rec)
     return out, out_schema

@@ -332,3 +332,29 @@ class RemoteStatsListener(TrainingListener):
 
     def on_fit_end(self, trainer, ts):
         self._flush()
+
+
+def main(argv=None):
+    """CLI: ``python -m deeplearning4j_tpu.train.ui <log_dir> [port]``
+    (↔ the reference's standalone UIServer main)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Training UI server")
+    ap.add_argument("log_dir")
+    ap.add_argument("port", nargs="?", type=int, default=9000)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+    server = UIServer(args.log_dir, port=args.port, host=args.host).start()
+    print(f"training UI on http://{args.host}:{server.port} "
+          f"(runs from {args.log_dir})")
+    try:
+        import time as _t
+
+        while True:
+            _t.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

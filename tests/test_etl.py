@@ -371,3 +371,34 @@ def test_reduce_numeric_op_on_string_column_rejected():
     s.add_string_column("city")
     with _p.raises(ValueError, match="numeric column"):
         reduce_by_key([["a", "rome"]], s, key="id", ops={"city": "min"})
+
+
+def test_join_rename_collision_cascades():
+    from deeplearning4j_tpu.data.transform import Schema, join
+
+    left_s = Schema()
+    for n in ("id", "amount", "right_amount"):
+        left_s.add_double_column(n) if n != "id" else left_s.add_string_column(n)
+    right_s = _people_schema()  # id, amount
+    rows, out_s = join([["a", 1.0, 7.0]], left_s, [["a", 9.0]], right_s,
+                       key="id")
+    assert out_s.names() == ["id", "amount", "right_amount",
+                             "right_amount_2"]
+    assert rows == [["a", 1.0, 7.0, 9.0]]
+
+
+def test_reduce_skips_none_from_outer_join():
+    from deeplearning4j_tpu.data.transform import (
+        Schema,
+        join,
+        reduce_by_key,
+    )
+
+    left_s = _people_schema()
+    right_s = Schema()
+    right_s.add_string_column("id")
+    right_s.add_double_column("paid")
+    rows, out_s = join([["a", 1.0], ["b", 2.0]], left_s,
+                       [["a", 5.0]], right_s, key="id", join_type="left")
+    agg, agg_s = reduce_by_key(rows, out_s, key="id", ops={"paid": "sum"})
+    assert agg == [["a", 5.0], ["b", None]]  # all-missing group -> None
