@@ -38,6 +38,7 @@ from deeplearning4j_tpu.data.normalizers import (
     NormalizerMinMaxScaler,
     NormalizerStandardize,
 )
+from deeplearning4j_tpu.data.excel import ExcelRecordReader, write_xlsx
 from deeplearning4j_tpu.data.records import (
     CollectionRecordReader,
     CSVRecordReader,
@@ -67,6 +68,7 @@ __all__ = [
     "WavFileRecordReader", "read_wav", "spectrogram", "mfcc",
     "mel_filterbank",
     "ColumnarRecordReader", "SQLRecordReader",
+    "ExcelRecordReader", "write_xlsx",
     "ImageMeanSubtraction", "ImagePreProcessingScaler",
     "NormalizerMinMaxScaler", "NormalizerStandardize",
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",

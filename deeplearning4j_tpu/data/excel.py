@@ -1,0 +1,212 @@
+"""Excel (.xlsx) record reader.
+
+ref: datavec-excel ExcelRecordReader (SURVEY §2.4 "other data domains" —
+Excel is named reference surface). The reference wraps Apache POI; this
+environment has no spreadsheet dependency, and none is needed: an .xlsx
+file IS a zip of XML parts (ECMA-376). This reader handles the subset real
+data files use — sharedStrings, inline strings, numeric cells, per-sheet
+rows — with the stdlib ``zipfile`` + ``xml.etree`` only, mirroring the
+repo's dependency-free ONNX/TB codecs.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import zipfile
+from typing import List, Optional, Sequence, Union
+from xml.etree import ElementTree
+
+from deeplearning4j_tpu.data.records import RecordReader, _as_paths
+
+_NS = "{http://schemas.openxmlformats.org/spreadsheetml/2006/main}"
+_REL_NS = ("{http://schemas.openxmlformats.org/officeDocument/2006/"
+           "relationships}")
+
+
+def _col_index(cell_ref: str) -> int:
+    """'A1' → 0, 'BC12' → 54 (0-based column)."""
+    col = 0
+    for ch in cell_ref:
+        if ch.isdigit():
+            break
+        col = col * 26 + (ord(ch.upper()) - ord("A") + 1)
+    return col - 1
+
+
+def _shared_strings(zf: zipfile.ZipFile) -> List[str]:
+    try:
+        data = zf.read("xl/sharedStrings.xml")
+    except KeyError:
+        return []
+    root = ElementTree.fromstring(data)
+    out = []
+    for si in root.findall(f"{_NS}si"):
+        # plain <t> or rich-text runs <r><t>; phonetic guides <rPh> are
+        # furigana annotations, NOT cell text — excluded.
+        parts = [t.text or "" for t in si.findall(f"{_NS}t")]
+        for run in si.findall(f"{_NS}r"):
+            parts.extend(t.text or "" for t in run.findall(f"{_NS}t"))
+        out.append("".join(parts))
+    return out
+
+
+def _sheet_paths(zf: zipfile.ZipFile, sheet: Optional[Union[int, str]]
+                 ) -> List[str]:
+    wb = ElementTree.fromstring(zf.read("xl/workbook.xml"))
+    rels = ElementTree.fromstring(zf.read("xl/_rels/workbook.xml.rels"))
+    rel_map = {
+        r.get("Id"): r.get("Target")
+        for r in rels.findall(
+            "{http://schemas.openxmlformats.org/package/2006/relationships}"
+            "Relationship")
+    }
+    sheets = []
+    for sh in wb.find(f"{_NS}sheets").findall(f"{_NS}sheet"):
+        target = rel_map.get(sh.get(f"{_REL_NS}id"), "")
+        if target and not target.startswith("xl/"):
+            target = f"xl/{target.lstrip('/')}"
+        sheets.append((sh.get("name"), target))
+    if sheet is None:
+        return [t for _, t in sheets]
+    if isinstance(sheet, int):
+        return [sheets[sheet][1]]
+    for name, t in sheets:
+        if name == sheet:
+            return [t]
+    raise ValueError(
+        f"sheet {sheet!r} not found; have {[n for n, _ in sheets]}")
+
+
+class ExcelRecordReader(RecordReader):
+    """↔ org.datavec.poi.excel.ExcelRecordReader: one record per row.
+
+    Values: numeric cells → float, string cells → str, empty cells →
+    ``None`` (ragged rows padded to the row's max seen column).
+    ``sheet``: None = every sheet in order (the reference iterates all),
+    an int index, or a sheet name. ``skip_rows`` skips headers per sheet.
+    """
+
+    def __init__(self, paths: Union[str, pathlib.Path, Sequence],
+                 *, sheet: Optional[Union[int, str]] = None,
+                 skip_rows: int = 0):
+        self.paths = _as_paths(paths)
+        self.sheet = sheet
+        self.skip_rows = skip_rows
+
+    def _rows(self, zf: zipfile.ZipFile, sheet_path: str, strings: List[str]):
+        root = ElementTree.fromstring(zf.read(sheet_path))
+        data = root.find(f"{_NS}sheetData")
+        if data is None:
+            return
+        for i, row in enumerate(data.findall(f"{_NS}row")):
+            if i < self.skip_rows:
+                continue
+            rec: List = []
+            for c in row.findall(f"{_NS}c"):
+                ref = c.get("r", "")
+                # r= is optional per ECMA-376: default to the next column
+                idx = _col_index(ref) if ref else len(rec)
+                while len(rec) <= idx:
+                    rec.append(None)
+                ctype = c.get("t", "n")
+                v = c.find(f"{_NS}v")
+                if ctype == "inlineStr":
+                    is_el = c.find(f"{_NS}is")
+                    rec[idx] = "".join(
+                        t.text or "" for t in is_el.iter(f"{_NS}t")
+                    ) if is_el is not None else None
+                elif v is None or v.text is None:
+                    rec[idx] = None
+                elif ctype == "s":
+                    rec[idx] = strings[int(v.text)]
+                elif ctype in ("str", "d"):  # formula string / ISO date
+                    rec[idx] = v.text
+                elif ctype == "b":
+                    rec[idx] = bool(int(v.text))
+                elif ctype == "e":  # formula error cell (#DIV/0! etc.)
+                    rec[idx] = None
+                else:  # 'n' numeric (or untyped)
+                    rec[idx] = float(v.text)
+            yield rec
+
+    def __iter__(self):
+        for p in self.paths:
+            with zipfile.ZipFile(p) as zf:
+                strings = _shared_strings(zf)
+                for sheet_path in _sheet_paths(zf, self.sheet):
+                    yield from self._rows(zf, sheet_path, strings)
+
+
+def write_xlsx(path: Union[str, pathlib.Path],
+               rows: Sequence[Sequence], *, sheet_name: str = "Sheet1"):
+    """Minimal single-sheet .xlsx writer (inline strings + numbers) — the
+    round-trip partner for tests/fixtures; not a formatting library."""
+
+    def cell_ref(r, c):
+        col = ""
+        c += 1
+        while c:
+            c, rem = divmod(c - 1, 26)
+            col = chr(ord("A") + rem) + col
+        return f"{col}{r + 1}"
+
+    body = []
+    for ri, row in enumerate(rows):
+        cells = []
+        for ci, v in enumerate(row):
+            if v is None:
+                continue
+            ref = cell_ref(ri, ci)
+            if isinstance(v, bool):
+                cells.append(f'<c r="{ref}" t="b"><v>{int(v)}</v></c>')
+            elif isinstance(v, (int, float)):
+                cells.append(f'<c r="{ref}"><v>{v}</v></c>')
+            else:
+                sv = (str(v).replace("&", "&amp;").replace("<", "&lt;")
+                      .replace(">", "&gt;"))
+                cells.append(
+                    f'<c r="{ref}" t="inlineStr"><is><t>{sv}</t></is></c>')
+        body.append(f'<row r="{ri + 1}">{"".join(cells)}</row>')
+    sheet_xml = (
+        '<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
+        f'<worksheet xmlns="{_NS[1:-1]}"><sheetData>{"".join(body)}'
+        "</sheetData></worksheet>")
+    sn = (sheet_name.replace("&", "&amp;").replace("<", "&lt;")
+          .replace(">", "&gt;").replace('"', "&quot;"))
+    wb = (
+        '<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
+        f'<workbook xmlns="{_NS[1:-1]}" xmlns:r="{_REL_NS[1:-1]}"><sheets>'
+        f'<sheet name="{sn}" sheetId="1" r:id="rId1"/>'
+        "</sheets></workbook>")
+    rels = (
+        '<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
+        '<Relationships xmlns="http://schemas.openxmlformats.org/package/'
+        '2006/relationships">'
+        '<Relationship Id="rId1" Type="http://schemas.openxmlformats.org/'
+        'officeDocument/2006/relationships/worksheet" '
+        'Target="worksheets/sheet1.xml"/></Relationships>')
+    types = (
+        '<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
+        '<Types xmlns="http://schemas.openxmlformats.org/package/2006/'
+        'content-types">'
+        '<Default Extension="rels" ContentType="application/vnd.'
+        'openxmlformats-package.relationships+xml"/>'
+        '<Default Extension="xml" ContentType="application/xml"/>'
+        '<Override PartName="/xl/workbook.xml" ContentType="application/'
+        'vnd.openxmlformats-officedocument.spreadsheetml.sheet.main+xml"/>'
+        '<Override PartName="/xl/worksheets/sheet1.xml" ContentType='
+        '"application/vnd.openxmlformats-officedocument.spreadsheetml.'
+        'worksheet+xml"/></Types>')
+    root_rels = (
+        '<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
+        '<Relationships xmlns="http://schemas.openxmlformats.org/package/'
+        '2006/relationships">'
+        '<Relationship Id="rId1" Type="http://schemas.openxmlformats.org/'
+        'officeDocument/2006/relationships/officeDocument" '
+        'Target="xl/workbook.xml"/></Relationships>')
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("[Content_Types].xml", types)
+        zf.writestr("_rels/.rels", root_rels)
+        zf.writestr("xl/workbook.xml", wb)
+        zf.writestr("xl/_rels/workbook.xml.rels", rels)
+        zf.writestr("xl/worksheets/sheet1.xml", sheet_xml)

@@ -567,10 +567,12 @@ def reduce_by_key(records, schema: Schema, *, key: Union[str, Sequence[str]],
         for col, op in ops.items():
             ci = col_idx[col]
             vals = [r[ci] for r in rows]
-            if op not in ("count", "first", "last"):
-                # None = missing (e.g. an outer join's unmatched side):
-                # excluded from the aggregate, like the reference Reducer's
-                # null handling. All-missing -> 0 count rule applies.
+            if op == "count":
+                # None = missing (outer-join unmatched side): not counted.
+                vals = [v for v in vals if v is not None]
+            elif op not in ("first", "last"):
+                # Numeric aggregates exclude missing values (the reference
+                # Reducer's null handling); an all-missing group -> None.
                 vals = [float(v) for v in vals if v is not None]
                 if not vals:
                     rec.append(None)

@@ -402,3 +402,14 @@ def test_reduce_skips_none_from_outer_join():
                        [["a", 5.0]], right_s, key="id", join_type="left")
     agg, agg_s = reduce_by_key(rows, out_s, key="id", ops={"paid": "sum"})
     assert agg == [["a", 5.0], ["b", None]]  # all-missing group -> None
+
+
+def test_reduce_count_excludes_missing():
+    from deeplearning4j_tpu.data.transform import Schema, reduce_by_key
+
+    s = Schema()
+    s.add_string_column("id")
+    s.add_double_column("paid")
+    rows, _ = reduce_by_key([["a", 1.0], ["a", None], ["b", None]], s,
+                            key="id", ops={"paid": "count"})
+    assert rows == [["a", 1], ["b", 0]]
