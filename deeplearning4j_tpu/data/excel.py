@@ -39,15 +39,17 @@ def _shared_strings(zf: zipfile.ZipFile) -> List[str]:
     except KeyError:
         return []
     root = ElementTree.fromstring(data)
-    out = []
-    for si in root.findall(f"{_NS}si"):
-        # plain <t> or rich-text runs <r><t>; phonetic guides <rPh> are
-        # furigana annotations, NOT cell text — excluded.
-        parts = [t.text or "" for t in si.findall(f"{_NS}t")]
-        for run in si.findall(f"{_NS}r"):
-            parts.extend(t.text or "" for t in run.findall(f"{_NS}t"))
-        out.append("".join(parts))
-    return out
+    return [_rich_text(si) for si in root.findall(f"{_NS}si")]
+
+
+def _rich_text(el) -> str:
+    """Cell text from an <si>/<is> element: plain <t> plus rich-text runs
+    <r><t>; phonetic guides <rPh> are furigana annotations, NOT cell text —
+    excluded (direct-children walk, not .iter())."""
+    parts = [t.text or "" for t in el.findall(f"{_NS}t")]
+    for run in el.findall(f"{_NS}r"):
+        parts.extend(t.text or "" for t in run.findall(f"{_NS}t"))
+    return "".join(parts)
 
 
 def _sheet_paths(zf: zipfile.ZipFile, sheet: Optional[Union[int, str]]
@@ -62,9 +64,9 @@ def _sheet_paths(zf: zipfile.ZipFile, sheet: Optional[Union[int, str]]
     }
     sheets = []
     for sh in wb.find(f"{_NS}sheets").findall(f"{_NS}sheet"):
-        target = rel_map.get(sh.get(f"{_REL_NS}id"), "")
+        target = rel_map.get(sh.get(f"{_REL_NS}id"), "").lstrip("/")
         if target and not target.startswith("xl/"):
-            target = f"xl/{target.lstrip('/')}"
+            target = f"xl/{target}"
         sheets.append((sh.get("name"), target))
     if sheet is None:
         return [t for _, t in sheets]
@@ -112,9 +114,8 @@ class ExcelRecordReader(RecordReader):
                 v = c.find(f"{_NS}v")
                 if ctype == "inlineStr":
                     is_el = c.find(f"{_NS}is")
-                    rec[idx] = "".join(
-                        t.text or "" for t in is_el.iter(f"{_NS}t")
-                    ) if is_el is not None else None
+                    rec[idx] = (_rich_text(is_el)
+                                if is_el is not None else None)
                 elif v is None or v.text is None:
                     rec[idx] = None
                 elif ctype == "s":
@@ -134,7 +135,13 @@ class ExcelRecordReader(RecordReader):
             with zipfile.ZipFile(p) as zf:
                 strings = _shared_strings(zf)
                 for sheet_path in _sheet_paths(zf, self.sheet):
-                    yield from self._rows(zf, sheet_path, strings)
+                    # Rectangularize per sheet: rows whose trailing cells
+                    # are blank must pad to the sheet's width or the
+                    # dataset bridge gets ragged records.
+                    rows = list(self._rows(zf, sheet_path, strings))
+                    width = max((len(r) for r in rows), default=0)
+                    for r in rows:
+                        yield r + [None] * (width - len(r))
 
 
 def write_xlsx(path: Union[str, pathlib.Path],

@@ -166,8 +166,12 @@ class GraphVertex:
     """One vertex of a DAG network (↔ org.deeplearning4j.nn.conf.graph.*).
 
     kind: 'layer' (wraps a LayerConfig), 'merge' (concat on feature axis),
-    'add' / 'mul' / 'average' / 'max' / 'subtract' (ElementWiseVertex ops),
-    'scale', 'preprocessor' (reshape function by name).
+    'add' / 'mul' / 'average' / 'max' / 'min' / 'subtract'
+    (ElementWiseVertex ops), 'scale', 'shift', 'subset' (feature-range
+    slice), 'stack' / 'unstack' (batch-axis shared-weights trick),
+    'l2norm', 'reshape', 'last_timestep', 'duplicate_to_timeseries',
+    'reverse_timeseries' — the reference's org.deeplearning4j.nn.conf.graph
+    vertex set; args carries each kind's parameters.
     """
 
     kind: str

@@ -234,6 +234,67 @@ def _reduce_min(xs):
     return out
 
 
+# Arg-taking vertices (↔ org.deeplearning4j.nn.conf.graph.*Vertex beyond the
+# elementwise set). Each entry: (apply(xs, args), out_shape(in_shapes, args))
+# where shapes are batchless; the batch axis is axis 0 at runtime.
+_VERTEX_OPS = {
+    # ↔ SubsetVertex: feature-range slice [from, to] INCLUSIVE (reference
+    # semantics) on the last axis.
+    "subset": (
+        lambda xs, a: xs[0][..., a["from"]:a["to"] + 1],
+        lambda ss, a: (*ss[0][:-1], a["to"] + 1 - a["from"]),
+    ),
+    # ↔ StackVertex: concatenate along the BATCH axis (shared-weights trick;
+    # pair with 'unstack').
+    "stack": (
+        lambda xs, a: jnp.concatenate(xs, axis=0),
+        lambda ss, a: tuple(ss[0]),
+    ),
+    # ↔ UnstackVertex(from, stackSize): batch slice i of n.
+    "unstack": (
+        lambda xs, a: jnp.split(xs[0], a["of"], axis=0)[a["from"]],
+        lambda ss, a: tuple(ss[0]),
+    ),
+    # ↔ L2NormalizeVertex (unit-norm last axis). rsqrt of the CLAMPED
+    # sum-of-squares keeps the backward pass finite at x=0 (norm(x) itself
+    # has a NaN gradient there — the standard JAX safe-norm pitfall).
+    "l2norm": (
+        lambda xs, a: xs[0] * jax.lax.rsqrt(jnp.maximum(
+            jnp.sum(jnp.square(xs[0]), axis=-1, keepdims=True),
+            a.get("eps", 1e-8) ** 2)),
+        lambda ss, a: tuple(ss[0]),
+    ),
+    # ↔ ShiftVertex (x + const).
+    "shift": (
+        lambda xs, a: xs[0] + a["shift"],
+        lambda ss, a: tuple(ss[0]),
+    ),
+    # ↔ ReshapeVertex: batchless target shape.
+    "reshape": (
+        lambda xs, a: xs[0].reshape(xs[0].shape[0], *a["shape"]),
+        lambda ss, a: tuple(a["shape"]),
+    ),
+    # ↔ LastTimeStepVertex: [T, C] → [C].
+    "last_timestep": (
+        lambda xs, a: xs[0][:, -1],
+        lambda ss, a: tuple(ss[0][1:]),
+    ),
+    # ↔ DuplicateToTimeSeriesVertex: [C] duplicated across the second
+    # input's time axis → [T, C].
+    "duplicate_to_timeseries": (
+        lambda xs, a: jnp.broadcast_to(
+            xs[0][:, None, :],
+            (xs[0].shape[0], xs[1].shape[1], xs[0].shape[-1])),
+        lambda ss, a: (ss[1][0], ss[0][-1]),
+    ),
+    # ↔ ReverseTimeSeriesVertex: flip the time axis.
+    "reverse_timeseries": (
+        lambda xs, a: jnp.flip(xs[0], axis=1),
+        lambda ss, a: tuple(ss[0]),
+    ),
+}
+
+
 class GraphModel:
     """↔ ComputationGraph: named-vertex DAG with merge/elementwise vertices.
 
@@ -263,6 +324,8 @@ class GraphModel:
         if v.kind == "merge":
             feat = sum(s[-1] for s in in_shapes)
             return (*in_shapes[0][:-1], feat)
+        if v.kind in _VERTEX_OPS:
+            return tuple(_VERTEX_OPS[v.kind][1](in_shapes, v.args))
         return tuple(in_shapes[0])
 
     def init(self, seed: Optional[int] = None):
@@ -349,6 +412,8 @@ class GraphModel:
                     new_state[name] = s
             elif v.kind in _MERGE_OPS:
                 y = _MERGE_OPS[v.kind](xs)
+            elif v.kind in _VERTEX_OPS:
+                y = _VERTEX_OPS[v.kind][0](xs, v.args)
             elif v.kind == "scale":
                 y = xs[0] * v.args.get("factor", 1.0)
             else:

@@ -366,3 +366,12 @@ class TestExcelReader:
             for n, data in names.items():
                 zf.writestr(n, data)
         assert list(ExcelRecordReader(p)) == [[7.0, None, 9.0]]
+
+    def test_ragged_trailing_blanks_rectangularized(self, tmp_path):
+        from deeplearning4j_tpu.data.excel import ExcelRecordReader, write_xlsx
+
+        p = tmp_path / "r.xlsx"
+        write_xlsx(p, [[1.0, 2.0, 3.0], [4.0, None, None], [5.0, 6.0, None]])
+        recs = list(ExcelRecordReader(p))
+        assert all(len(r) == 3 for r in recs)
+        assert recs[1] == [4.0, None, None]

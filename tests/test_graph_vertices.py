@@ -1,0 +1,158 @@
+"""Graph-vertex breadth (↔ org.deeplearning4j.nn.conf.graph.*Vertex:
+Subset, Stack/Unstack, L2Normalize, Shift, Reshape, LastTimeStep,
+DuplicateToTimeSeries, ReverseTimeSeries)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.model import GraphModel
+
+
+def _model(vertices, inputs, input_shapes, outputs):
+    cfg = GraphConfig(net=NeuralNetConfiguration(seed=0), inputs=inputs,
+                      input_shapes=input_shapes, vertices=vertices,
+                      outputs=outputs)
+    m = GraphModel(cfg)
+    return m, m.init()
+
+
+def _x(shape, seed=0):
+    return jnp.asarray(np.random.default_rng(seed)
+                       .normal(size=shape).astype(np.float32))
+
+
+def test_subset_vertex_inclusive_range():
+    m, v = _model({"sub": GraphVertex(kind="subset", inputs=["in"],
+                                      args={"from": 1, "to": 3})},
+                  ["in"], {"in": (6,)}, ["sub"])
+    assert m.shapes["sub"] == (3,)
+    x = _x((2, 6))
+    out = m.output(v, x)["sub"]
+    np.testing.assert_allclose(out, np.asarray(x)[:, 1:4])
+
+
+def test_stack_unstack_roundtrip():
+    verts = {
+        "stacked": GraphVertex(kind="stack", inputs=["a", "b"]),
+        "dense": GraphVertex(kind="layer", inputs=["stacked"],
+                             layer=L.Dense(units=4)),
+        "back_a": GraphVertex(kind="unstack", inputs=["dense"],
+                              args={"from": 0, "of": 2}),
+        "back_b": GraphVertex(kind="unstack", inputs=["dense"],
+                              args={"from": 1, "of": 2}),
+    }
+    m, v = _model(verts, ["a", "b"], {"a": (5,), "b": (5,)},
+                  ["back_a", "back_b"])
+    xa, xb = _x((3, 5), 1), _x((3, 5), 2)
+    out = m.apply(v, {"a": xa, "b": xb})[0]
+    # shared weights: each slice equals applying the dense layer directly
+    dense_p = v["params"]["dense"]
+    ya, _ = m.config.vertices["dense"].layer.apply(dense_p, {}, xa)
+    yb, _ = m.config.vertices["dense"].layer.apply(dense_p, {}, xb)
+    np.testing.assert_allclose(np.asarray(out["back_a"]), np.asarray(ya),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["back_b"]), np.asarray(yb),
+                               rtol=1e-6)
+
+
+def test_l2norm_and_shift():
+    verts = {
+        "n": GraphVertex(kind="l2norm", inputs=["in"]),
+        "s": GraphVertex(kind="shift", inputs=["n"], args={"shift": 2.0}),
+    }
+    m, v = _model(verts, ["in"], {"in": (4,)}, ["s"])
+    x = _x((3, 4))
+    out = np.asarray(m.output(v, x)["s"]) - 2.0
+    np.testing.assert_allclose(np.linalg.norm(out, axis=-1), 1.0, rtol=1e-5)
+
+
+def test_reshape_vertex():
+    m, v = _model({"r": GraphVertex(kind="reshape", inputs=["in"],
+                                    args={"shape": [2, 3]})},
+                  ["in"], {"in": (6,)}, ["r"])
+    assert m.shapes["r"] == (2, 3)
+    assert m.output(v, _x((4, 6)))["r"].shape == (4, 2, 3)
+
+
+def test_timeseries_vertices():
+    verts = {
+        "rev": GraphVertex(kind="reverse_timeseries", inputs=["ts"]),
+        "last": GraphVertex(kind="last_timestep", inputs=["rev"]),
+        "dup": GraphVertex(kind="duplicate_to_timeseries",
+                           inputs=["last", "ts"]),
+    }
+    m, v = _model(verts, ["ts"], {"ts": (5, 3)}, ["last", "dup"])
+    assert m.shapes["last"] == (3,)
+    assert m.shapes["dup"] == (5, 3)
+    x = _x((2, 5, 3))
+    out = m.apply(v, {"ts": x})[0]
+    # last of reversed == first of original
+    np.testing.assert_allclose(np.asarray(out["last"]),
+                               np.asarray(x)[:, 0], rtol=1e-6)
+    expected = np.broadcast_to(np.asarray(out["last"])[:, None, :],
+                               (2, 5, 3))
+    np.testing.assert_allclose(np.asarray(out["dup"]), expected, rtol=1e-6)
+
+
+def test_vertices_trainable_end_to_end():
+    """Gradients flow through the new vertices in a compiled train step."""
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    verts = {
+        "dense": GraphVertex(kind="layer", inputs=["in"],
+                             layer=L.Dense(units=6, activation="relu")),
+        "sub": GraphVertex(kind="subset", inputs=["dense"],
+                           args={"from": 0, "to": 3}),
+        "norm": GraphVertex(kind="l2norm", inputs=["sub"]),
+        "out": GraphVertex(kind="layer", inputs=["norm"],
+                           layer=L.OutputLayer(units=3)),
+    }
+    cfg = GraphConfig(net=NeuralNetConfiguration(seed=0, updater=Adam(5e-2)),
+                      inputs=["in"], input_shapes={"in": (5,)},
+                      vertices=verts, outputs=["out"])
+    model = GraphModel(cfg)
+    tr = Trainer(model)
+    ts = tr.init_state()
+    r = np.random.default_rng(0)
+    batch = {"features": r.normal(size=(16, 5)).astype(np.float32),
+             "labels": np.eye(3, dtype=np.float32)[r.integers(0, 3, 16)]}
+    losses = []
+    for _ in range(40):
+        ts, m_ = tr.train_step(ts, batch)
+        losses.append(float(m_["total_loss"]))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_json_roundtrip_of_vertex_graph():
+    verts = {"sub": GraphVertex(kind="subset", inputs=["in"],
+                                args={"from": 0, "to": 1})}
+    cfg = GraphConfig(net=NeuralNetConfiguration(seed=0), inputs=["in"],
+                      input_shapes={"in": (4,)}, vertices=verts,
+                      outputs=["sub"])
+    js = cfg.to_json()
+    cfg2 = GraphConfig.from_json(js)
+    assert cfg2.to_json() == js
+    m2 = GraphModel(cfg2)
+    assert m2.shapes["sub"] == (2,)
+
+
+def test_l2norm_zero_row_finite_gradient():
+    """All-zero input row must not NaN the backward pass (safe-norm)."""
+    m, v = _model({"n": GraphVertex(kind="l2norm", inputs=["in"])},
+                  ["in"], {"in": (4,)}, ["n"])
+    x = jnp.zeros((2, 4)).at[1].set(1.0)
+
+    def f(x):
+        return jnp.sum(m.apply(v, {"in": x})[0]["n"] ** 2)
+
+    g = jax.grad(f)(x)
+    assert bool(jnp.all(jnp.isfinite(g))), g
