@@ -83,7 +83,8 @@ class ExcelRecordReader(RecordReader):
     """↔ org.datavec.poi.excel.ExcelRecordReader: one record per row.
 
     Values: numeric cells → float, string cells → str, empty cells →
-    ``None`` (ragged rows padded to the row's max seen column).
+    ``None``; rows pad to the widest row across ALL selected sheets/files
+    so the dataset bridge always sees rectangular records.
     ``sheet``: None = every sheet in order (the reference iterates all),
     an int index, or a sheet name. ``skip_rows`` skips headers per sheet.
     """
@@ -131,17 +132,18 @@ class ExcelRecordReader(RecordReader):
             yield rec
 
     def __iter__(self):
+        # Two passes conceptually; materialized once. Width must be global
+        # (across sheets AND files) or the dataset bridge gets ragged
+        # records when sources differ in column count.
+        all_rows: List[List] = []
         for p in self.paths:
             with zipfile.ZipFile(p) as zf:
                 strings = _shared_strings(zf)
                 for sheet_path in _sheet_paths(zf, self.sheet):
-                    # Rectangularize per sheet: rows whose trailing cells
-                    # are blank must pad to the sheet's width or the
-                    # dataset bridge gets ragged records.
-                    rows = list(self._rows(zf, sheet_path, strings))
-                    width = max((len(r) for r in rows), default=0)
-                    for r in rows:
-                        yield r + [None] * (width - len(r))
+                    all_rows.extend(self._rows(zf, sheet_path, strings))
+        width = max((len(r) for r in all_rows), default=0)
+        for r in all_rows:
+            yield r + [None] * (width - len(r))
 
 
 def write_xlsx(path: Union[str, pathlib.Path],

@@ -26,6 +26,12 @@ from deeplearning4j_tpu.nn.layers.conv import (
     ZeroPadding2D,
     ZeroPadding3D,
 )
+from deeplearning4j_tpu.nn.layers.capsule import (
+    CapsuleLayer,
+    CapsuleStrength,
+    PrimaryCapsules,
+    squash,
+)
 from deeplearning4j_tpu.nn.layers.autoencoder import (
     AutoEncoder,
     VariationalAutoencoder,
@@ -90,6 +96,7 @@ __all__ = [
     "Upsampling1D", "Upsampling2D", "Upsampling3D",
     "ZeroPadding1D", "ZeroPadding2D", "ZeroPadding3D",
     "AutoEncoder", "VariationalAutoencoder",
+    "PrimaryCapsules", "CapsuleLayer", "CapsuleStrength", "squash",
     "BatchNorm", "LayerNorm", "LocalResponseNormalization",
     "LossLayer", "OutputLayer", "RnnOutputLayer",
     "RnnLossLayer", "CnnLossLayer", "CenterLossOutputLayer",

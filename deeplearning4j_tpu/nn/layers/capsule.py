@@ -1,0 +1,139 @@
+"""CapsNet layers: primary capsules, dynamic-routing capsule layer, strength.
+
+ref: org.deeplearning4j.nn.conf.layers.{PrimaryCapsules, CapsuleLayer,
+CapsuleStrengthLayer} (1.0.0-beta4+; defined over SameDiff in the
+reference, per Sabour et al. 2017 "Dynamic Routing Between Capsules").
+
+TPU-first shape: the prediction tensor is ONE einsum over all capsule
+pairs ([N, in_caps, out_caps, out_dims] — MXU-batched), and the routing
+loop is a STATICALLY UNROLLED fixed count of softmax/weighted-sum/squash
+steps (``routings`` is 3 in the paper and the reference default), so the
+whole layer traces into straight-line XLA with no dynamic control flow.
+Squash uses the clamped-rsqrt safe-norm pattern (finite gradients at the
+zero vector).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import LayerConfig, register_config
+from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.ops.nn import safe_sq_norm
+
+
+def squash(s, axis=-1, eps=1e-8):
+    """v = (‖s‖²/(1+‖s‖²)) · s/‖s‖ — capsule nonlinearity, safe at 0."""
+    sq = safe_sq_norm(s, axis=axis, eps=eps)
+    scale = sq / (1.0 + sq) * jax.lax.rsqrt(sq)
+    return s * scale
+
+
+@register_config
+@dataclass
+class PrimaryCapsules(LayerConfig):
+    """↔ PrimaryCapsules: conv → capsule grouping → squash.
+
+    Input [H, W, C] → conv(channels·capsule_dims filters) →
+    [num_caps, capsule_dims] where num_caps = OH·OW·channels.
+    """
+
+    channels: int = 8          # capsule channels (↔ channels)
+    capsule_dims: int = 8      # ↔ capsuleDimensions
+    kernel: Union[int, Sequence[int]] = 9
+    stride: Union[int, Sequence[int]] = 2
+    padding: str = "VALID"
+    weight_init: Optional[str] = None
+
+    def _conv(self):
+        from deeplearning4j_tpu.nn.layers.conv import Conv2D
+
+        return Conv2D(filters=self.channels * self.capsule_dims,
+                      kernel=self.kernel, stride=self.stride,
+                      padding=self.padding, weight_init=self.weight_init)
+
+    def output_shape(self, input_shape):
+        oh, ow, _ = self._conv().output_shape(input_shape)
+        return (oh * ow * self.channels, self.capsule_dims)
+
+    def init(self, rng, input_shape, dtype):
+        return self._conv().init(rng, input_shape, dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y, _ = self._conv().apply(params, state, x, train=train, rng=rng)
+        n = y.shape[0]
+        caps = y.reshape(n, -1, self.capsule_dims)
+        return squash(caps), state
+
+
+@register_config
+@dataclass
+class CapsuleLayer(LayerConfig):
+    """↔ CapsuleLayer: fully connected capsules with dynamic routing.
+
+    Input [in_caps, in_dims] → [capsules, capsule_dims]; ``routings``
+    agreement iterations (coupling softmax over OUTPUT capsules, as in the
+    paper and the reference).
+    """
+
+    capsules: int = 10          # ↔ capsules (nOut)
+    capsule_dims: int = 16      # ↔ capsuleDimensions
+    routings: int = 3
+
+    weight_init: Optional[str] = None
+
+    def output_shape(self, input_shape):
+        return (self.capsules, self.capsule_dims)
+
+    def init(self, rng, input_shape, dtype):
+        in_caps, in_dims = input_shape
+        w_init = get_initializer(self.weight_init or "xavier")
+        # Per-pair transform [in_caps, capsules, in_dims, capsule_dims]:
+        # each (in_dims, capsule_dims) block is an independent draw with
+        # the dims-pair fan (vmapped over pairs), so the init std does not
+        # collapse as capsule counts grow.
+        keys = jax.random.split(rng, in_caps * self.capsules)
+        blocks = jax.vmap(
+            lambda k: w_init(k, (in_dims, self.capsule_dims), dtype))(keys)
+        W = blocks.reshape(in_caps, self.capsules, in_dims,
+                           self.capsule_dims)
+        return {"W": W}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        # u_hat[n,i,c,o]: every input capsule's prediction for every output
+        # capsule — one batched einsum on the MXU.
+        u_hat = jnp.einsum("nid,icdo->nico", x, params["W"])
+        n, i, c, _ = u_hat.shape
+        b = jnp.zeros((n, i, c), u_hat.dtype)
+        v = None
+        for it in range(max(1, self.routings)):
+            coupling = jax.nn.softmax(b, axis=2)            # over out caps
+            s = jnp.einsum("nic,nico->nco", coupling, u_hat)
+            v = squash(s)                                    # [n, c, o]
+            if it + 1 < self.routings:
+                # Agreement: do NOT backprop through the routing logits
+                # (the reference/paper treat b as routing state, not params).
+                b = b + jax.lax.stop_gradient(
+                    jnp.einsum("nico,nco->nic", u_hat, v))
+        return v, state
+
+
+@register_config
+@dataclass
+class CapsuleStrength(LayerConfig):
+    """↔ CapsuleStrengthLayer: ‖v‖ per capsule → [capsules] (the class
+    probabilities of a CapsNet head; safe-norm gradients)."""
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        return (input_shape[0],)
+
+    def apply(self, params, state, x, *, train=False, rng=None, eps=1e-8):
+        return jnp.sqrt(safe_sq_norm(x, keepdims=False, eps=eps)), state

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.ops.nn import safe_sq_norm as _safe_sq_norm
 from deeplearning4j_tpu.nn.config import (
     GraphConfig,
     GraphVertex,
@@ -255,13 +256,15 @@ _VERTEX_OPS = {
         lambda xs, a: jnp.split(xs[0], a["of"], axis=0)[a["from"]],
         lambda ss, a: tuple(ss[0]),
     ),
-    # ↔ L2NormalizeVertex (unit-norm last axis). rsqrt of the CLAMPED
-    # sum-of-squares keeps the backward pass finite at x=0 (norm(x) itself
-    # has a NaN gradient there — the standard JAX safe-norm pitfall).
+    # ↔ L2NormalizeVertex (unit-norm last axis; safe-norm gradients).
     "l2norm": (
-        lambda xs, a: xs[0] * jax.lax.rsqrt(jnp.maximum(
-            jnp.sum(jnp.square(xs[0]), axis=-1, keepdims=True),
-            a.get("eps", 1e-8) ** 2)),
+        lambda xs, a: xs[0] * jax.lax.rsqrt(
+            _safe_sq_norm(xs[0], eps=a.get("eps", 1e-8))),
+        lambda ss, a: tuple(ss[0]),
+    ),
+    # ↔ ScaleVertex (x * const).
+    "scale": (
+        lambda xs, a: xs[0] * a.get("factor", 1.0),
         lambda ss, a: tuple(ss[0]),
     ),
     # ↔ ShiftVertex (x + const).
@@ -414,8 +417,6 @@ class GraphModel:
                 y = _MERGE_OPS[v.kind](xs)
             elif v.kind in _VERTEX_OPS:
                 y = _VERTEX_OPS[v.kind][0](xs, v.args)
-            elif v.kind == "scale":
-                y = xs[0] * v.args.get("factor", 1.0)
             else:
                 raise ValueError(f"unknown vertex kind {v.kind}")
             values[name] = y

@@ -150,6 +150,20 @@ def hinge(pred, target, weights=None, reduction="mean"):
     return _reduce(v, reduction, weights)
 
 
+@register_loss("capsnet_margin")
+@register_loss("margin")
+def margin(pred, target, weights=None, reduction="mean",
+           m_plus=0.9, m_minus=0.1, lam=0.5):
+    """CapsNet margin loss (Sabour 2017, the CapsuleStrength objective):
+    L_c = T_c·max(0, m+ − ‖v_c‖)² + λ(1−T_c)·max(0, ‖v_c‖ − m−)².
+    ``pred`` holds capsule strengths (‖v_c‖ ∈ [0,1]); target one-hot."""
+    present = target * jnp.square(jnp.maximum(0.0, m_plus - pred))
+    absent = lam * (1.0 - target) * jnp.square(
+        jnp.maximum(0.0, pred - m_minus))
+    v = jnp.sum(present + absent, axis=-1)
+    return _reduce(v, reduction, weights)
+
+
 @register_loss("squared_hinge")
 def squared_hinge(pred, target, weights=None, reduction="mean"):
     t = jnp.where(target > 0, 1.0, -1.0)

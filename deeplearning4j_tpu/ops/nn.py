@@ -192,3 +192,17 @@ def dot_product_attention(q, k, v, mask=None, scale=None, dropout_rate=0.0, rng=
 
 def pad(x, paddings, mode="constant", constant_value=0.0):
     return jnp.pad(x, paddings, mode=mode, constant_values=constant_value)
+
+
+def safe_sq_norm(x, axis=-1, keepdims=True, eps=1e-8):
+    """Sum-of-squares clamped to eps² — the safe-norm substrate.
+
+    ``sqrt(safe_sq_norm(x))`` and ``x * rsqrt(safe_sq_norm(x))`` have
+    finite gradients at x=0 (plain ``norm`` backprops NaN there: the
+    standard JAX safe-norm pitfall). Shared by the l2norm graph vertex and
+    the capsule squash/strength layers.
+    """
+    import jax.numpy as jnp
+
+    return jnp.maximum(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims),
+                       eps * eps)

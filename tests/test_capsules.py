@@ -1,0 +1,127 @@
+"""CapsNet layer tests (↔ PrimaryCapsules/CapsuleLayer/CapsuleStrengthLayer;
+Sabour 2017 semantics: squash norm bound, routing agreement, overfit)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.layers.capsule import squash
+
+
+def test_squash_norm_bounded_and_safe_at_zero():
+    x = jax.random.normal(jax.random.key(0), (4, 6, 8)) * 5
+    v = squash(x)
+    norms = jnp.linalg.norm(v, axis=-1)
+    assert float(norms.max()) < 1.0
+    # large inputs keep direction
+    np.testing.assert_allclose(
+        np.asarray(v[0, 0] / norms[0, 0]),
+        np.asarray(x[0, 0] / jnp.linalg.norm(x[0, 0])), rtol=1e-5)
+    g = jax.grad(lambda x: jnp.sum(squash(x)))(jnp.zeros((2, 3)))
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_primary_capsules_shapes():
+    layer = L.PrimaryCapsules(channels=4, capsule_dims=8, kernel=3, stride=2)
+    params, _ = layer.init(jax.random.key(0), (12, 12, 3), jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (2, 12, 12, 3))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (2, *layer.output_shape((12, 12, 3)))
+    assert y.shape[-1] == 8
+    assert float(jnp.linalg.norm(y, axis=-1).max()) < 1.0
+
+
+def test_capsule_layer_routing_shapes_and_grad():
+    layer = L.CapsuleLayer(capsules=5, capsule_dims=4, routings=3)
+    params, _ = layer.init(jax.random.key(0), (12, 6), jnp.float32)
+    assert params["W"].shape == (12, 5, 6, 4)
+    x = jax.random.normal(jax.random.key(1), (3, 12, 6))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (3, 5, 4)
+
+    def f(p):
+        y, _ = layer.apply(p, {}, x)
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(f)(params)
+    assert bool(jnp.all(jnp.isfinite(g["W"])))
+    assert float(jnp.abs(g["W"]).max()) > 0
+
+
+def test_routing_iterations_change_output():
+    p1 = L.CapsuleLayer(capsules=3, capsule_dims=4, routings=1)
+    p3 = L.CapsuleLayer(capsules=3, capsule_dims=4, routings=3)
+    params, _ = p1.init(jax.random.key(0), (8, 5), jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 5))
+    y1, _ = p1.apply(params, {}, x)
+    y3, _ = p3.apply(params, {}, x)
+    assert not np.allclose(np.asarray(y1), np.asarray(y3))
+
+
+def test_capsnet_overfits_tiny_dataset():
+    """SURVEY §4 pattern 5: a small CapsNet learns a toy image problem."""
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    r = np.random.default_rng(0)
+    n, classes = 24, 3
+    labels = np.arange(n) % classes
+    x = np.zeros((n, 8, 8, 1), np.float32)
+    for i, c in enumerate(labels):  # class = which corner is lit
+        x[i, (c // 2) * 4:(c // 2) * 4 + 4, (c % 2) * 4:(c % 2) * 4 + 4] = 1.0
+    x += 0.05 * r.normal(size=x.shape).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[labels]
+
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(seed=0, updater=Adam(1e-2)),
+        input_shape=(8, 8, 1),
+        layers=[
+            L.PrimaryCapsules(channels=4, capsule_dims=4, kernel=3, stride=2),
+            L.CapsuleLayer(capsules=classes, capsule_dims=6, routings=2),
+            L.CapsuleStrength(),
+            L.LossLayer(activation="identity", loss="margin"),
+        ],
+    ))
+    tr = Trainer(model)
+    ts = tr.init_state()
+    batch = {"features": x, "labels": y}
+    losses = []
+    for _ in range(200):
+        ts, m = tr.train_step(ts, batch)
+        losses.append(float(m["total_loss"]))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+    out = model.output(tr.variables(ts), x)
+    acc = float((np.argmax(np.asarray(out), -1) == labels).mean())
+    assert acc > 0.9, acc
+
+
+def test_capsule_json_roundtrip():
+    from deeplearning4j_tpu.nn.config import config_from_json
+
+    for layer in [L.PrimaryCapsules(channels=2, capsule_dims=4),
+                  L.CapsuleLayer(capsules=3, capsule_dims=4),
+                  L.CapsuleStrength()]:
+        js = layer.to_json()
+        assert config_from_json(js).to_json() == js
+
+
+def test_margin_loss_oracle():
+    """Hand-computed margin loss values (Sabour 2017 eq. 4)."""
+    from deeplearning4j_tpu.ops.loss import get_loss
+
+    fn = get_loss("margin")
+    pred = jnp.asarray([[0.95, 0.05, 0.5]])
+    target = jnp.asarray([[1.0, 0.0, 0.0]])
+    # present: max(0, .9-.95)^2 = 0; absent: .5*(max(0,.05-.1)^2 +
+    # max(0,.5-.1)^2) = .5*(0 + .16) = .08
+    np.testing.assert_allclose(float(fn(pred, target)), 0.08, rtol=1e-5)
+    # perfect prediction -> 0
+    perfect = jnp.asarray([[1.0, 0.0, 0.0]])
+    np.testing.assert_allclose(float(fn(perfect, target)), 0.0, atol=1e-7)
