@@ -131,18 +131,20 @@ class ExcelRecordReader(RecordReader):
                     rec[idx] = float(v.text)
             yield rec
 
-    def __iter__(self):
-        # Two passes conceptually; materialized once. Width must be global
-        # (across sheets AND files) or the dataset bridge gets ragged
-        # records when sources differ in column count.
-        all_rows: List[List] = []
+    def _iter_raw(self):
         for p in self.paths:
             with zipfile.ZipFile(p) as zf:
                 strings = _shared_strings(zf)
                 for sheet_path in _sheet_paths(zf, self.sheet):
-                    all_rows.extend(self._rows(zf, sheet_path, strings))
-        width = max((len(r) for r in all_rows), default=0)
-        for r in all_rows:
+                    yield from self._rows(zf, sheet_path, strings)
+
+    def __iter__(self):
+        # True two-pass: pass 1 scans only row widths, pass 2 re-parses and
+        # yields padded rows — global width (across sheets AND files, so
+        # the dataset bridge never sees ragged records) at O(one row)
+        # memory instead of materializing the corpus.
+        width = max((len(r) for r in self._iter_raw()), default=0)
+        for r in self._iter_raw():
             yield r + [None] * (width - len(r))
 
 

@@ -202,7 +202,5 @@ def safe_sq_norm(x, axis=-1, keepdims=True, eps=1e-8):
     standard JAX safe-norm pitfall). Shared by the l2norm graph vertex and
     the capsule squash/strength layers.
     """
-    import jax.numpy as jnp
-
     return jnp.maximum(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims),
                        eps * eps)

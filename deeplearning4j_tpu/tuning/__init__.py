@@ -1,0 +1,28 @@
+"""Hyperparameter search (↔ the reference-era Arbiter module:
+MultiLayerSpace/ParameterSpace + RandomSearchGenerator/GridSearchCandidateGenerator
++ IOptimizationRunner with a ScoreFunction).
+
+TPU-first simplification: a candidate is just a dict of sampled leaf
+values; the user supplies ``build_fn(params) -> (model, trainer_kwargs)``
+and the tuner drives ordinary Trainer fits — every trial is the same
+compiled-step machinery as production training, no bespoke runner layer.
+"""
+
+from deeplearning4j_tpu.tuning.search import (
+    Choice,
+    GridSearch,
+    IntRange,
+    LogUniform,
+    RandomSearch,
+    TrialResult,
+    Tuner,
+    Uniform,
+    grid_points,
+    sample_space,
+)
+
+__all__ = [
+    "Choice", "Uniform", "LogUniform", "IntRange",
+    "sample_space", "grid_points",
+    "RandomSearch", "GridSearch", "Tuner", "TrialResult",
+]

@@ -1,0 +1,237 @@
+"""Parameter spaces + candidate generators + the trial runner.
+
+ref: arbiter ParameterSpace impls (ContinuousParameterSpace,
+IntegerParameterSpace, DiscreteParameterSpace), CandidateGenerator
+(RandomSearchGenerator, GridSearchCandidateGenerator), OptimizationRunner
++ ScoreFunction (SURVEY-era reference surface; arbiter lived in the
+monorepo in the fork's era).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+# --- parameter spaces ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Choice:
+    """↔ DiscreteParameterSpace: one of a fixed set."""
+
+    values: Sequence[Any]
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid(self, points):
+        return list(self.values)
+
+
+@dataclasses.dataclass(frozen=True)
+class Uniform:
+    """↔ ContinuousParameterSpace (linear)."""
+
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+    def grid(self, points):
+        return [float(v) for v in np.linspace(self.low, self.high, points)]
+
+
+@dataclasses.dataclass(frozen=True)
+class LogUniform:
+    """↔ ContinuousParameterSpace with exp-scale sampling (the learning-rate
+    space shape)."""
+
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return float(math.exp(rng.uniform(math.log(self.low),
+                                          math.log(self.high))))
+
+    def grid(self, points):
+        return [float(v) for v in np.exp(
+            np.linspace(math.log(self.low), math.log(self.high), points))]
+
+
+@dataclasses.dataclass(frozen=True)
+class IntRange:
+    """↔ IntegerParameterSpace: integer in [low, high] inclusive."""
+
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high + 1))
+
+    def grid(self, points):
+        pts = np.unique(np.round(
+            np.linspace(self.low, self.high, points)).astype(int))
+        return [int(v) for v in pts]
+
+
+_SPACE_TYPES = (Choice, Uniform, LogUniform, IntRange)
+
+
+def sample_space(space: Dict[str, Any], rng) -> Dict[str, Any]:
+    """Sample every parameter-space leaf; fixed values pass through."""
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, _SPACE_TYPES):
+            out[k] = v.sample(rng)
+        elif isinstance(v, dict):
+            out[k] = sample_space(v, rng)
+        else:
+            out[k] = v
+    return out
+
+
+def grid_points(space: Dict[str, Any], points_per_axis: int = 3
+                ) -> List[Dict[str, Any]]:
+    """Cartesian product over every space leaf (↔ GridSearchCandidateGenerator).
+
+    Nested dicts are handled structurally (key PATHS as tuples, so literal
+    dots in parameter names survive).
+    """
+    flat: Dict[tuple, Any] = {}
+
+    def _flatten(prefix: tuple, d):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                _flatten(prefix + (k,), v)
+            else:
+                flat[prefix + (k,)] = v
+
+    _flatten((), space)
+    axes = []
+    for path, v in flat.items():
+        vals = v.grid(points_per_axis) if isinstance(v, _SPACE_TYPES) else [v]
+        axes.append([(path, val) for val in vals])
+    out = []
+    for combo in itertools.product(*axes):
+        nested: Dict[str, Any] = {}
+        for path, val in combo:
+            cur = nested
+            for p in path[:-1]:
+                cur = cur.setdefault(p, {})
+            cur[path[-1]] = val
+        out.append(nested)
+    return out
+
+
+# --- candidate generators --------------------------------------------------
+
+
+class RandomSearch:
+    """↔ RandomSearchGenerator."""
+
+    def __init__(self, space: Dict[str, Any], n_trials: int, seed: int = 0):
+        self.space = space
+        self.n_trials = n_trials
+        self.seed = seed
+
+    def candidates(self) -> Iterable[Dict[str, Any]]:
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.n_trials):
+            yield sample_space(self.space, rng)
+
+
+class GridSearch:
+    """↔ GridSearchCandidateGenerator."""
+
+    def __init__(self, space: Dict[str, Any], points_per_axis: int = 3):
+        self.space = space
+        self.points_per_axis = points_per_axis
+
+    def candidates(self) -> Iterable[Dict[str, Any]]:
+        return iter(grid_points(self.space, self.points_per_axis))
+
+
+# --- runner ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TrialResult:
+    params: Dict[str, Any]
+    score: float
+    seconds: float
+    error: Optional[str] = None
+
+
+class Tuner:
+    """↔ OptimizationRunner: run candidates, score, keep the best.
+
+    ``build_fn(params) -> (model, fit_kwargs)`` builds a fresh model per
+    candidate; ``scorer(model, variables) -> float`` evaluates it
+    (``mode``: 'max' e.g. accuracy, 'min' e.g. loss). A crashing candidate
+    records its error and the search continues (arbiter behavior).
+    """
+
+    def __init__(self, build_fn: Callable, scorer: Callable,
+                 *, mode: str = "max", max_seconds: Optional[float] = None):
+        if mode not in ("max", "min"):
+            raise ValueError(f"mode must be 'max'|'min', got {mode!r}")
+        self.build_fn = build_fn
+        self.scorer = scorer
+        self.mode = mode
+        self.max_seconds = max_seconds
+        self.results: List[TrialResult] = []
+
+    def fit(self, generator, train_iter, *, epochs: int = 1,
+            listeners=None) -> TrialResult:
+        from deeplearning4j_tpu.train.trainer import Trainer
+
+        self.results = []  # per-search: a second fit() starts fresh
+        deadline = (time.monotonic() + self.max_seconds
+                    if self.max_seconds else None)
+        for params in generator.candidates():
+            if deadline and time.monotonic() > deadline:
+                break
+            t0 = time.monotonic()
+            try:
+                model, fit_kwargs = self.build_fn(params)
+                trainer = Trainer(model, **(fit_kwargs or {}))
+                ts = trainer.init_state()
+                ts = trainer.fit(ts, train_iter, epochs=epochs,
+                                 listeners=listeners)
+                score = float(self.scorer(model, trainer.variables(ts)))
+                self.results.append(TrialResult(
+                    params, score, time.monotonic() - t0))
+            except Exception as e:  # noqa: BLE001 - arbiter keeps searching
+                self.results.append(TrialResult(
+                    params, float("nan"), time.monotonic() - t0,
+                    error=f"{type(e).__name__}: {e}"))
+            if hasattr(train_iter, "reset"):
+                train_iter.reset()
+        ok = [r for r in self.results if r.error is None
+              and not math.isnan(r.score)]
+        if not ok:
+            raise RuntimeError(
+                "every candidate failed: "
+                + "; ".join(r.error or "nan" for r in self.results[:3]))
+        key = (max if self.mode == "max" else min)
+        return key(ok, key=lambda r: r.score)
+
+    def summary(self) -> str:
+        lines = [f"{'score':>10}  {'secs':>6}  params"]
+        order = sorted(
+            [r for r in self.results if r.error is None],
+            key=lambda r: r.score, reverse=self.mode == "max")
+        for r in order:
+            lines.append(f"{r.score:10.4f}  {r.seconds:6.1f}  {r.params}")
+        for r in self.results:
+            if r.error is not None:
+                lines.append(f"{'FAILED':>10}  {r.seconds:6.1f}  "
+                             f"{r.params} ({r.error})")
+        return "\n".join(lines)

@@ -1,0 +1,165 @@
+"""Hyperparameter search tests (↔ arbiter: spaces, grid/random generators,
+runner keeps best and survives failing candidates)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.tuning import (
+    Choice,
+    GridSearch,
+    IntRange,
+    LogUniform,
+    RandomSearch,
+    Tuner,
+    Uniform,
+    grid_points,
+    sample_space,
+)
+
+
+def test_space_sampling_bounds():
+    rng = np.random.default_rng(0)
+    space = {"lr": LogUniform(1e-4, 1e-1), "units": IntRange(4, 16),
+             "act": Choice(["relu", "tanh"]), "drop": Uniform(0.0, 0.5),
+             "fixed": 7, "nested": {"depth": IntRange(1, 3)}}
+    for _ in range(50):
+        s = sample_space(space, rng)
+        assert 1e-4 <= s["lr"] <= 1e-1
+        assert 4 <= s["units"] <= 16
+        assert s["act"] in ("relu", "tanh")
+        assert 0.0 <= s["drop"] <= 0.5
+        assert s["fixed"] == 7
+        assert 1 <= s["nested"]["depth"] <= 3
+
+
+def test_grid_cartesian_product():
+    pts = grid_points({"lr": LogUniform(1e-3, 1e-1),
+                       "act": Choice(["relu", "tanh"]),
+                       "nested": {"units": IntRange(2, 4)}},
+                      points_per_axis=3)
+    assert len(pts) == 3 * 2 * 3
+    assert all("nested" in p and "units" in p["nested"] for p in pts)
+    # endpoints present on log axis
+    lrs = sorted({p["lr"] for p in pts})
+    assert lrs[0] == pytest.approx(1e-3) and lrs[-1] == pytest.approx(1e-1)
+
+
+def _blob_problem():
+    r = np.random.default_rng(0)
+    n, d, classes = 96, 8, 3
+    centers = r.normal(size=(classes, d)) * 3
+    labels = r.integers(0, classes, n)
+    x = (centers[labels] + r.normal(size=(n, d))).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[labels]
+    return x, y, labels
+
+
+def test_tuner_finds_learning_signal():
+    """Grid over {good lr, hopeless lr}: the best trial must be a good-lr
+    config and classify the blobs well."""
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.evaluation import evaluate_model
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    x, y, labels = _blob_problem()
+    it = ArrayDataSetIterator(x, y, batch_size=32)
+    val = ArrayDataSetIterator(x, y, batch_size=32, shuffle=False)
+
+    def build(params):
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(seed=0, updater=Adam(params["lr"])),
+            input_shape=(8,),
+            layers=[L.Dense(units=params["units"], activation="relu"),
+                    L.OutputLayer(units=3)]))
+        return model, {}
+
+    def scorer(model, variables):
+        val.reset()
+        return evaluate_model(model, variables, val, num_classes=3).accuracy()
+
+    tuner = Tuner(build, scorer, mode="max")
+    best = tuner.fit(
+        GridSearch({"lr": Choice([3e-2, 1e-9]),
+                    "units": Choice([16])}, points_per_axis=2),
+        it, epochs=12)
+    assert best.params["lr"] == pytest.approx(3e-2)
+    assert best.score > 0.8, tuner.summary()
+    assert len(tuner.results) == 2
+    assert "score" in tuner.summary()
+
+
+def test_tuner_survives_failing_candidate():
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    x, y, _ = _blob_problem()
+    it = ArrayDataSetIterator(x, y, batch_size=32)
+
+    def build(params):
+        if params["units"] == 0:
+            raise ValueError("boom")
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(seed=0, updater=Adam(1e-2)),
+            input_shape=(8,),
+            layers=[L.Dense(units=params["units"], activation="relu"),
+                    L.OutputLayer(units=3)]))
+        return model, {}
+
+    tuner = Tuner(build, lambda m, v: 1.0, mode="max")
+    best = tuner.fit(GridSearch({"units": Choice([0, 8])}), it, epochs=1)
+    assert best.params["units"] == 8
+    failed = [r for r in tuner.results if r.error]
+    assert len(failed) == 1 and "boom" in failed[0].error
+    assert "FAILED" in tuner.summary()
+
+
+def test_random_search_deterministic_by_seed():
+    space = {"lr": LogUniform(1e-4, 1e-1)}
+    a = [c["lr"] for c in RandomSearch(space, 5, seed=3).candidates()]
+    b = [c["lr"] for c in RandomSearch(space, 5, seed=3).candidates()]
+    assert a == b
+
+
+def test_grid_preserves_literal_dotted_keys():
+    pts = grid_points({"adam.b1": Uniform(0.8, 0.9)}, points_per_axis=2)
+    assert all("adam.b1" in p for p in pts)
+
+
+def test_second_fit_starts_fresh():
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    x, y, _ = _blob_problem()
+    it = ArrayDataSetIterator(x, y, batch_size=32)
+
+    def build(params):
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(seed=0, updater=Adam(1e-2)),
+            input_shape=(8,),
+            layers=[L.Dense(units=8), L.OutputLayer(units=3)]))
+        return model, {}
+
+    scores = iter([0.9, 0.2])
+    tuner = Tuner(build, lambda m, v: next(scores), mode="max")
+    tuner.fit(GridSearch({"a": Choice([1])}), it, epochs=1)
+    best2 = tuner.fit(GridSearch({"a": Choice([2])}), it, epochs=1)
+    assert best2.params["a"] == 2 and best2.score == 0.2  # not the stale 0.9
+    assert len(tuner.results) == 1
