@@ -52,7 +52,7 @@ def _max_rel_err(a, b):
     return float(np.abs(a - b).max() / denom)
 
 
-def _flash_ab(iters=30):
+def _flash_ab(iters=30, B=8, H=12, T=512, D=64, causal=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -62,7 +62,6 @@ def _flash_ab(iters=30):
         reference_attention,
     )
 
-    B, H, T, D = 8, 12, 512, 64
     r = np.random.default_rng(0)
     q = jnp.asarray(r.normal(size=(B, H, T, D)), jnp.float32)
     k = jnp.asarray(r.normal(size=(B, H, T, D)), jnp.float32)
@@ -74,8 +73,9 @@ def _flash_ab(iters=30):
     out = {"shape": f"B{B} H{H} T{T} D{D}", "iters": iters}
 
     flash_f = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, key_mask=key_mask, backend="pallas"))
-    ref_f = jax.jit(lambda q, k, v: reference_attention(q, k, v, key_mask=key_mask))
+        q, k, v, key_mask=key_mask, causal=causal, backend="pallas"))
+    ref_f = jax.jit(lambda q, k, v: reference_attention(
+        q, k, v, key_mask=key_mask, causal=causal))
 
     of, orf = flash_f(q, k, v), ref_f(q, k, v)
     # Padded key rows of the reference produce uniform-attention outputs that
@@ -86,10 +86,12 @@ def _flash_ab(iters=30):
 
     def loss_flash(q, k, v):
         return jnp.sum(flash_attention(
-            q, k, v, key_mask=key_mask, backend="pallas") ** 2)
+            q, k, v, key_mask=key_mask, causal=causal,
+            backend="pallas") ** 2)
 
     def loss_ref(q, k, v):
-        return jnp.sum(reference_attention(q, k, v, key_mask=key_mask) ** 2)
+        return jnp.sum(reference_attention(
+            q, k, v, key_mask=key_mask, causal=causal) ** 2)
 
     gflash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
     gref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))
@@ -161,7 +163,15 @@ def run_kernels_ab(diag: dict) -> dict:
                 "error": f"refusing to A/B on platform '{platform}': the "
                          "Pallas side would silently run XLA", **diag}
     result = {"metric": "pallas_kernel_ab", "platform": platform, **diag}
-    for name, fn in (("flash_attention", _flash_ab), ("lstm_scan", _lstm_ab)):
+    # The long-context shape is where the flash kernel's O(T) memory is the
+    # point (the T^2 score materialization of the XLA reference is ~1 GiB
+    # here): record whether the dispatch policy's DL4J_TPU_FLASH_MIN_SEQ
+    # crossover is justified.
+    flash_long = lambda: _flash_ab(iters=10, B=2, H=8, T=4096, D=64,
+                                   causal=True)
+    for name, fn in (("flash_attention", _flash_ab),
+                     ("flash_attention_long", flash_long),
+                     ("lstm_scan", _lstm_ab)):
         try:
             result[name] = fn()
         except Exception as e:  # noqa: BLE001 - record, keep going
