@@ -13,7 +13,8 @@ import pytest
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 FAST = ["samediff_graph.py", "word2vec_similarity.py"]
 SLOW = ["mnist_lenet.py", "transfer_learning.py", "bert_mlm_pretrain.py",
-        "char_rnn_generation.py", "data_parallel_mesh.py"]
+        "char_rnn_generation.py", "data_parallel_mesh.py",
+        "hyperparameter_search.py"]
 
 
 def _run(name, extra_env=None):
