@@ -21,10 +21,15 @@ from deeplearning4j_tpu.ops import math as opsmath
 
 
 @jax.jit
-def _confusion_update(cm, logits_or_probs, labels):
-    pred = jnp.argmax(logits_or_probs, axis=-1)
-    lab = jnp.argmax(labels, axis=-1) if labels.ndim == pred.ndim + 1 else labels
-    return cm + opsmath.confusion_matrix(lab, pred, cm.shape[0])
+def _confusion_update(cm, logits_or_probs, labels, mask=None):
+    """Confusion accumulation for [N,C] or (flattened) [N,T,C] inputs;
+    optional mask weights exclude entries (padded timesteps) while keeping
+    shapes static under jit."""
+    pred = jnp.argmax(logits_or_probs, axis=-1).reshape(-1)
+    lab = (jnp.argmax(labels, axis=-1)
+           if labels.ndim == logits_or_probs.ndim else labels).reshape(-1)
+    w = None if mask is None else mask.astype(jnp.float32).reshape(-1)
+    return cm + opsmath.confusion_matrix(lab, pred, cm.shape[0], weights=w)
 
 
 class Evaluation:
@@ -38,8 +43,24 @@ class Evaluation:
     # -- accumulation ------------------------------------------------------
 
     def eval(self, labels, predictions):
-        """Accumulate one batch (device-side)."""
+        """Accumulate one batch (device-side). For sequence outputs
+        ([N,T,C]) use eval_time_series (mask-aware)."""
+        predictions = jnp.asarray(predictions)
+        if predictions.ndim == 3:
+            return self.eval_time_series(labels, predictions)
         self.cm = _confusion_update(self.cm, predictions, labels)
+        return self
+
+    def eval_time_series(self, labels, predictions, mask=None):
+        """↔ Evaluation.evalTimeSeries: per-timestep accumulation over
+        [N,T,C] predictions with an optional [N,T] mask excluding padded
+        steps (zero-weighted, so the update stays static-shaped)."""
+        predictions = jnp.asarray(predictions)
+        labels = jnp.asarray(labels)
+        if mask is None:
+            mask = jnp.ones(predictions.shape[:2], jnp.float32)
+        self.cm = _confusion_update(self.cm, predictions, labels,
+                                    jnp.asarray(mask))
         return self
 
     def merge(self, other: "Evaluation"):

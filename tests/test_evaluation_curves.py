@@ -368,3 +368,29 @@ def test_evaluation_binary_label_shape_mismatch_raises():
     ev = EvaluationBinary(1)
     with pytest.raises(ValueError, match="labels shape"):
         ev.eval(np.zeros((4, 3)), np.array([0.9, 0.1, 0.8, 0.2]))
+
+
+def test_eval_time_series_masked():
+    """↔ Evaluation.evalTimeSeries: masked steps excluded; unmasked result
+    equals flattening time into the batch."""
+    from deeplearning4j_tpu.evaluation import Evaluation
+
+    r = np.random.default_rng(0)
+    preds = r.random((3, 5, 4)).astype(np.float32)
+    lab_idx = r.integers(0, 4, (3, 5))
+    labels = np.eye(4, dtype=np.float32)[lab_idx]
+
+    ev = Evaluation(4)
+    ev.eval(labels, preds)  # 3-D dispatches to eval_time_series
+    flat = Evaluation(4)
+    flat.eval(labels.reshape(-1, 4), preds.reshape(-1, 4))
+    np.testing.assert_array_equal(ev.confusion(), flat.confusion())
+    assert ev.confusion().sum() == 15
+
+    mask = np.ones((3, 5), np.float32)
+    mask[:, 3:] = 0.0
+    evm = Evaluation(4)
+    evm.eval_time_series(labels, preds, mask=mask)
+    trunc = Evaluation(4)
+    trunc.eval(labels[:, :3].reshape(-1, 4), preds[:, :3].reshape(-1, 4))
+    np.testing.assert_array_equal(evm.confusion(), trunc.confusion())
