@@ -57,10 +57,8 @@ class Evaluation:
         steps (zero-weighted, so the update stays static-shaped)."""
         predictions = jnp.asarray(predictions)
         labels = jnp.asarray(labels)
-        if mask is None:
-            mask = jnp.ones(predictions.shape[:2], jnp.float32)
-        self.cm = _confusion_update(self.cm, predictions, labels,
-                                    jnp.asarray(mask))
+        m = None if mask is None else jnp.asarray(mask)
+        self.cm = _confusion_update(self.cm, predictions, labels, m)
         return self
 
     def merge(self, other: "Evaluation"):

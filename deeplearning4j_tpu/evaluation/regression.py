@@ -12,16 +12,24 @@ import numpy as np
 
 
 @jax.jit
-def _acc_update(acc, pred, target):
+def _acc_update(acc, pred, target, mask=None):
+    """Streaming sums; [rows, C] inputs (callers flatten time first).
+    ``mask`` [rows] zero-weights excluded rows (padded timesteps)."""
+    # Weights in f32 regardless of pred dtype: bf16 row counts round
+    # (1001 -> 1000) and would drift n across batches.
+    if mask is None:
+        w = jnp.ones((pred.shape[0], 1), jnp.float32)
+    else:
+        w = mask.astype(jnp.float32).reshape(-1, 1)
     return {
-        "n": acc["n"] + pred.shape[0],
-        "se": acc["se"] + jnp.sum(jnp.square(pred - target), axis=0),
-        "ae": acc["ae"] + jnp.sum(jnp.abs(pred - target), axis=0),
-        "sum_t": acc["sum_t"] + jnp.sum(target, axis=0),
-        "sum_t2": acc["sum_t2"] + jnp.sum(jnp.square(target), axis=0),
-        "sum_p": acc["sum_p"] + jnp.sum(pred, axis=0),
-        "sum_p2": acc["sum_p2"] + jnp.sum(jnp.square(pred), axis=0),
-        "sum_pt": acc["sum_pt"] + jnp.sum(pred * target, axis=0),
+        "n": acc["n"] + jnp.sum(w),
+        "se": acc["se"] + jnp.sum(w * jnp.square(pred - target), axis=0),
+        "ae": acc["ae"] + jnp.sum(w * jnp.abs(pred - target), axis=0),
+        "sum_t": acc["sum_t"] + jnp.sum(w * target, axis=0),
+        "sum_t2": acc["sum_t2"] + jnp.sum(w * jnp.square(target), axis=0),
+        "sum_p": acc["sum_p"] + jnp.sum(w * pred, axis=0),
+        "sum_p2": acc["sum_p2"] + jnp.sum(w * jnp.square(pred), axis=0),
+        "sum_pt": acc["sum_pt"] + jnp.sum(w * pred * target, axis=0),
     }
 
 
@@ -35,7 +43,21 @@ class RegressionEvaluation:
         }
 
     def eval(self, labels, predictions):
-        self.acc = _acc_update(self.acc, predictions, labels)
+        predictions = jnp.asarray(predictions)
+        if predictions.ndim == 3:
+            return self.eval_time_series(labels, predictions)
+        self.acc = _acc_update(self.acc, predictions, jnp.asarray(labels))
+        return self
+
+    def eval_time_series(self, labels, predictions, mask=None):
+        """↔ RegressionEvaluation.evalTimeSeries: [N,T,C] with optional
+        [N,T] mask; padded steps carry zero weight."""
+        predictions = jnp.asarray(predictions)
+        labels = jnp.asarray(labels)
+        c = predictions.shape[-1]
+        m = None if mask is None else jnp.asarray(mask).reshape(-1)
+        self.acc = _acc_update(self.acc, predictions.reshape(-1, c),
+                               labels.reshape(-1, c), m)
         return self
 
     def _h(self):

@@ -394,3 +394,26 @@ def test_eval_time_series_masked():
     trunc = Evaluation(4)
     trunc.eval(labels[:, :3].reshape(-1, 4), preds[:, :3].reshape(-1, 4))
     np.testing.assert_array_equal(evm.confusion(), trunc.confusion())
+
+
+def test_regression_eval_time_series_masked():
+    from deeplearning4j_tpu.evaluation import RegressionEvaluation
+
+    r = np.random.default_rng(0)
+    preds = r.normal(size=(3, 5, 2)).astype(np.float32)
+    targets = r.normal(size=(3, 5, 2)).astype(np.float32)
+
+    ev = RegressionEvaluation(2)
+    ev.eval(targets, preds)  # 3-D auto-dispatch
+    flat = RegressionEvaluation(2)
+    flat.eval(targets.reshape(-1, 2), preds.reshape(-1, 2))
+    np.testing.assert_allclose(ev.mse(), flat.mse(), rtol=1e-6)
+
+    mask = np.ones((3, 5), np.float32)
+    mask[:, 2:] = 0.0
+    evm = RegressionEvaluation(2)
+    evm.eval_time_series(targets, preds, mask=mask)
+    trunc = RegressionEvaluation(2)
+    trunc.eval(targets[:, :2].reshape(-1, 2), preds[:, :2].reshape(-1, 2))
+    np.testing.assert_allclose(evm.mse(), trunc.mse(), rtol=1e-6)
+    np.testing.assert_allclose(evm.r2(), trunc.r2(), rtol=1e-5)
