@@ -11,6 +11,7 @@ reference's host-side per-batch INDArray bookkeeping.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, Optional
 
 import jax
@@ -32,13 +33,34 @@ def _confusion_update(cm, logits_or_probs, labels, mask=None):
     return cm + opsmath.confusion_matrix(lab, pred, cm.shape[0], weights=w)
 
 
-class Evaluation:
-    """↔ org.nd4j.evaluation.classification.Evaluation."""
+@partial(jax.jit, static_argnums=(3,))
+def _topn_update(correct, probs, labels, n):
+    """Count rows whose true class is among the n highest scores."""
+    lab = (jnp.argmax(labels, axis=-1)
+           if labels.ndim == probs.ndim else labels).reshape(-1)
+    flat = probs.reshape(-1, probs.shape[-1])
+    _, top_idx = jax.lax.top_k(flat, n)
+    hit = jnp.any(top_idx == lab[:, None], axis=-1)
+    return correct + jnp.sum(hit.astype(jnp.float32))
 
-    def __init__(self, num_classes: int, labels_list: Optional[list] = None):
+
+class Evaluation:
+    """↔ org.nd4j.evaluation.classification.Evaluation.
+
+    ``top_n``: like the reference's ``Evaluation(int topN)`` constructor,
+    additionally tracks top-N accuracy (true class among the N highest
+    scores) — only meaningful when ``eval`` receives scores, not argmaxed
+    labels.
+    """
+
+    def __init__(self, num_classes: int, labels_list: Optional[list] = None,
+                 top_n: Optional[int] = None):
         self.num_classes = num_classes
         self.labels_list = labels_list or [str(i) for i in range(num_classes)]
         self.cm = jnp.zeros((num_classes, num_classes), jnp.float32)
+        self.top_n = top_n
+        self._topn_correct = jnp.zeros((), jnp.float32)
+        self._topn_total = 0
 
     # -- accumulation ------------------------------------------------------
 
@@ -49,21 +71,43 @@ class Evaluation:
         if predictions.ndim == 3:
             return self.eval_time_series(labels, predictions)
         self.cm = _confusion_update(self.cm, predictions, labels)
+        if self.top_n:
+            self._topn_correct = _topn_update(
+                self._topn_correct, predictions, jnp.asarray(labels),
+                self.top_n)
+            self._topn_total += predictions.shape[0]
         return self
+
+    def top_n_accuracy(self) -> float:
+        """↔ Evaluation.topNAccuracy()."""
+        if not self.top_n:
+            raise ValueError("construct Evaluation(..., top_n=N) to track it")
+        total = int(self._topn_total)
+        return float(jax.device_get(self._topn_correct)) / max(total, 1)
 
     def eval_time_series(self, labels, predictions, mask=None):
         """↔ Evaluation.evalTimeSeries: per-timestep accumulation over
         [N,T,C] predictions with an optional [N,T] mask excluding padded
-        steps (zero-weighted, so the update stays static-shaped)."""
+        steps (zero-weighted, so the update stays static-shaped).
+
+        Top-N tracking counts every step of every sequence (padded steps
+        excluded only from the confusion matrix; use mask=None data for
+        exact top-N over sequences)."""
         predictions = jnp.asarray(predictions)
         labels = jnp.asarray(labels)
         m = None if mask is None else jnp.asarray(mask)
         self.cm = _confusion_update(self.cm, predictions, labels, m)
+        if self.top_n:
+            self._topn_correct = _topn_update(
+                self._topn_correct, predictions, labels, self.top_n)
+            self._topn_total += int(np.prod(predictions.shape[:-1]))
         return self
 
     def merge(self, other: "Evaluation"):
         """↔ Evaluation.merge (for sharded/parallel eval)."""
         self.cm = self.cm + other.cm
+        self._topn_correct = self._topn_correct + other._topn_correct
+        self._topn_total += other._topn_total
         return self
 
     # -- derived metrics (host-side) ---------------------------------------

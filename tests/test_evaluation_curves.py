@@ -417,3 +417,39 @@ def test_regression_eval_time_series_masked():
     trunc.eval(targets[:, :2].reshape(-1, 2), preds[:, :2].reshape(-1, 2))
     np.testing.assert_allclose(evm.mse(), trunc.mse(), rtol=1e-6)
     np.testing.assert_allclose(evm.r2(), trunc.r2(), rtol=1e-5)
+
+
+def test_top_n_accuracy():
+    from deeplearning4j_tpu.evaluation import Evaluation
+
+    probs = np.array([[0.5, 0.3, 0.2],   # true 1: top-1 miss, top-2 hit
+                      [0.1, 0.2, 0.7],   # true 2: top-1 hit
+                      [0.4, 0.35, 0.25],  # true 2: top-2 miss
+                      [0.3, 0.4, 0.3]],  # true 0: top-2 hit
+                     np.float32)
+    labels = np.eye(3, dtype=np.float32)[[1, 2, 2, 0]]
+    ev = Evaluation(3, top_n=2)
+    ev.eval(labels[:2], probs[:2])
+    ev.eval(labels[2:], probs[2:])
+    np.testing.assert_allclose(ev.top_n_accuracy(), 3 / 4)
+    assert ev.accuracy() == 1 / 4  # plain accuracy still from confusion
+    with pytest.raises(ValueError, match="top_n"):
+        Evaluation(3).top_n_accuracy()
+
+
+def test_top_n_merge_and_time_series():
+    from deeplearning4j_tpu.evaluation import Evaluation
+
+    probs = np.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]], np.float32)
+    labels = np.eye(3, dtype=np.float32)[[1, 2]]
+    a = Evaluation(3, top_n=2).eval(labels, probs)
+    b = Evaluation(3, top_n=2).eval(labels, probs)
+    a.merge(b)
+    np.testing.assert_allclose(a.top_n_accuracy(), 1.0)  # 4/4, both halves
+    assert a._topn_total == 4
+
+    # sequence inputs also accumulate top-N (every step counted)
+    seq = Evaluation(3, top_n=2)
+    seq.eval(labels.reshape(1, 2, 3), probs.reshape(1, 2, 3))
+    np.testing.assert_allclose(seq.top_n_accuracy(), 1.0)
+    assert seq._topn_total == 2
