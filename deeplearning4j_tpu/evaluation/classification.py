@@ -34,14 +34,16 @@ def _confusion_update(cm, logits_or_probs, labels, mask=None):
 
 
 @partial(jax.jit, static_argnums=(3,))
-def _topn_update(correct, probs, labels, n):
-    """Count rows whose true class is among the n highest scores."""
+def _topn_update(correct, probs, labels, n, mask=None):
+    """Count rows whose true class is among the n highest scores;
+    optional flat mask zero-weights excluded rows (padded steps)."""
     lab = (jnp.argmax(labels, axis=-1)
            if labels.ndim == probs.ndim else labels).reshape(-1)
     flat = probs.reshape(-1, probs.shape[-1])
-    _, top_idx = jax.lax.top_k(flat, n)
-    hit = jnp.any(top_idx == lab[:, None], axis=-1)
-    return correct + jnp.sum(hit.astype(jnp.float32))
+    hit = opsmath.in_top_k(flat, lab, n).astype(jnp.float32)
+    if mask is not None:
+        hit = hit * mask.astype(jnp.float32).reshape(-1)
+    return correct + jnp.sum(hit)
 
 
 class Evaluation:
@@ -58,6 +60,9 @@ class Evaluation:
         self.num_classes = num_classes
         self.labels_list = labels_list or [str(i) for i in range(num_classes)]
         self.cm = jnp.zeros((num_classes, num_classes), jnp.float32)
+        if top_n is not None and not 1 <= top_n <= num_classes:
+            raise ValueError(
+                f"top_n={top_n} must be in [1, num_classes={num_classes}]")
         self.top_n = top_n
         self._topn_correct = jnp.zeros((), jnp.float32)
         self._topn_total = 0
@@ -90,22 +95,27 @@ class Evaluation:
         [N,T,C] predictions with an optional [N,T] mask excluding padded
         steps (zero-weighted, so the update stays static-shaped).
 
-        Top-N tracking counts every step of every sequence (padded steps
-        excluded only from the confusion matrix; use mask=None data for
-        exact top-N over sequences)."""
+        Top-N tracking honors the mask too (padded steps excluded from
+        both numerator and denominator)."""
         predictions = jnp.asarray(predictions)
         labels = jnp.asarray(labels)
         m = None if mask is None else jnp.asarray(mask)
         self.cm = _confusion_update(self.cm, predictions, labels, m)
         if self.top_n:
             self._topn_correct = _topn_update(
-                self._topn_correct, predictions, labels, self.top_n)
-            self._topn_total += int(np.prod(predictions.shape[:-1]))
+                self._topn_correct, predictions, labels, self.top_n, m)
+            self._topn_total += (int(np.prod(predictions.shape[:-1]))
+                                 if m is None
+                                 else int(np.asarray(jax.device_get(
+                                     jnp.sum(m)))))
         return self
 
     def merge(self, other: "Evaluation"):
         """↔ Evaluation.merge (for sharded/parallel eval)."""
         self.cm = self.cm + other.cm
+        if self.top_n != other.top_n:
+            raise ValueError(
+                f"cannot merge top_n={self.top_n} with top_n={other.top_n}")
         self._topn_correct = self._topn_correct + other._topn_correct
         self._topn_total += other._topn_total
         return self
@@ -170,6 +180,9 @@ class Evaluation:
             f"Recall:    {self.recall():.4f} (macro)",
             f"F1 Score:  {self.f1():.4f} (macro)",
         ]
+        if self.top_n:
+            lines.append(
+                f"Top-{self.top_n} Accuracy: {self.top_n_accuracy():.4f}")
         return "\n".join(lines)
 
 

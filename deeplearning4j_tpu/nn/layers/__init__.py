@@ -80,6 +80,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     GravesLSTM,
     LastTimeStep,
     SimpleRnn,
+    graves_bidirectional_lstm,
 )
 
 __all__ = [
@@ -100,7 +101,8 @@ __all__ = [
     "BatchNorm", "LayerNorm", "LocalResponseNormalization",
     "LossLayer", "OutputLayer", "RnnOutputLayer",
     "RnnLossLayer", "CnnLossLayer", "CenterLossOutputLayer",
-    "GRU", "LSTM", "Bidirectional", "GravesLSTM", "LastTimeStep", "SimpleRnn",
+    "GRU", "LSTM", "Bidirectional", "GravesLSTM", "LastTimeStep",
+    "SimpleRnn", "graves_bidirectional_lstm",
     "SelfAttention", "LearnedSelfAttention", "TransformerEncoderBlock",
     "PositionalEmbedding",
 ]

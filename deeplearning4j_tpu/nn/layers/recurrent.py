@@ -282,3 +282,11 @@ class LastTimeStep(LayerConfig):
             return x[:, -1, :], state
         idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
         return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :], state
+
+
+def graves_bidirectional_lstm(units: int, *, merge: str = "concat",
+                              **lstm_kwargs) -> Bidirectional:
+    """↔ GravesBidirectionalLSTM: the reference's dedicated class is exactly
+    a bidirectional wrapper over the peephole LSTM; here it composes."""
+    return Bidirectional(layer=GravesLSTM(units=units, **lstm_kwargs),
+                         merge=merge)

@@ -453,3 +453,21 @@ def test_top_n_merge_and_time_series():
     seq.eval(labels.reshape(1, 2, 3), probs.reshape(1, 2, 3))
     np.testing.assert_allclose(seq.top_n_accuracy(), 1.0)
     assert seq._topn_total == 2
+
+
+def test_top_n_masked_and_validation():
+    from deeplearning4j_tpu.evaluation import Evaluation
+
+    probs = np.array([[[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]]], np.float32)
+    labels = np.eye(3, dtype=np.float32)[[[1, 2]]]
+    mask = np.array([[1.0, 0.0]], np.float32)  # second step padded
+    ev = Evaluation(3, top_n=2)
+    ev.eval_time_series(labels, probs, mask=mask)
+    np.testing.assert_allclose(ev.top_n_accuracy(), 1.0)  # 1/1, not 2/2
+    assert ev._topn_total == 1
+
+    with pytest.raises(ValueError, match="top_n"):
+        Evaluation(3, top_n=5)
+    a, b = Evaluation(3, top_n=2), Evaluation(3, top_n=3)
+    with pytest.raises(ValueError, match="merge"):
+        a.merge(b)

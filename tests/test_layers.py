@@ -186,3 +186,19 @@ def test_prelu():
     x = jnp.array([[-1.0, -2.0, 0.0, 1.0, 2.0]])
     y, _ = layer.apply(params, state, x)
     np.testing.assert_allclose(np.asarray(y)[0], [-0.1, -0.2, 0.0, 1.0, 2.0], rtol=1e-6)
+
+
+def test_graves_bidirectional_lstm_helper():
+    """↔ GravesBidirectionalLSTM: composes Bidirectional(GravesLSTM)."""
+    from deeplearning4j_tpu.nn.layers import graves_bidirectional_lstm
+
+    layer = graves_bidirectional_lstm(6)
+    params, state = layer.init(jax.random.key(0), (5, 3), jnp.float32)
+    assert "pI" in params["fwd"]  # peepholes present both directions
+    x = jax.random.normal(jax.random.key(1), (2, 5, 3))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (2, 5, 12)  # concat merge
+    # JSON round-trip of the composed config
+    from deeplearning4j_tpu.nn.config import config_from_json
+    js = layer.to_json()
+    assert config_from_json(js).to_json() == js
