@@ -33,9 +33,26 @@ asynchronously and synced once, inside the timing window.
 
 import argparse
 import json
+import os
+import socket
 import subprocess
 import sys
 import time
+
+# Ports the axon relay (the container's only path to the TPU) listens on
+# locally. If none accepts a TCP connect, the relay process is dead and no
+# amount of PJRT probing can reach the chip — fail fast instead of burning
+# 3 x 300 s of probe subprocesses (VERDICT r3 Weak #5).
+_RELAY_PORTS = (8082, 8083, 8087, 8092)
+
+# Set per-config by main() under --profile: _timed_train wraps its timed
+# window in jax.profiler.trace(_PROFILE_DIR).
+_PROFILE_DIR = None
+
+# Set by _cpu_evidence: the CPU integrity fallback wants the host-driven
+# window (a chained-scan train step compiles for minutes on CPU, and the
+# integrity record needs no dispatch-overhead-free timing anyway).
+_FORCE_HOST_WINDOW = False
 
 # Per-chip baselines (tokens|samples)/sec/chip. Round 2's recorded 1,382,357
 # tok/s BERT figure was a sync artifact (block_until_ready returns at
@@ -100,6 +117,17 @@ def _probe_backend(timeout_s: float):
     return platform, int(n)
 
 
+def _relay_alive(timeout_s: float = 1.0) -> bool:
+    """True if the axon relay accepts a TCP connect on any of its ports."""
+    for port in _RELAY_PORTS:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout_s).close()
+            return True
+        except OSError:
+            continue
+    return False
+
+
 def _init_backend(max_wait_s: float = 900.0):
     """Return (devices, diag), retrying transient tunnel wedges.
 
@@ -107,30 +135,49 @@ def _init_backend(max_wait_s: float = 900.0):
     server-side claim that re-wedges the NEXT probe, so few long-timeout
     attempts beat many short ones. (A probe that NEVER succeeds can also
     mean the relay process carrying the tunnel died — observed r3 —
-    which no amount of client-side retrying recovers.)
+    which no amount of client-side retrying recovers.) A dead relay is
+    detected up front by a TCP liveness probe and bounded at ONE short
+    attempt, so the failure path costs ~2 min, not 15.
     """
-    deadline = time.monotonic() + max_wait_s
-    delay = 30.0
-    last_err = None
-    attempt = 0
-    while True:
-        attempt += 1
+    relay_up = _relay_alive()
+    if not relay_up:
+        # Nothing is listening locally; either this environment doesn't use
+        # the relay (then one probe settles it fast) or the relay is dead
+        # (then the probe fails with connection-refused rather than a hang).
         try:
-            platform, _ = _probe_backend(timeout_s=300.0)
+            platform, _ = _probe_backend(timeout_s=120.0)
             if platform not in _TPU_PLATFORMS:
-                raise RuntimeError(
-                    f"backend came up as '{platform}', not a TPU — refusing "
-                    "to record a CPU number as the per-chip metric"
-                )
-            break
+                raise RuntimeError(f"backend came up as '{platform}'")
         except (subprocess.TimeoutExpired, RuntimeError) as e:
-            last_err = e
-            if time.monotonic() + delay > deadline:
-                raise RuntimeError(
-                    f"backend init failed after {attempt} attempts: {last_err}"
-                )
-            time.sleep(delay)
-            delay = min(delay * 2, 120.0)
+            raise RuntimeError(
+                "TPU unreachable: relay not listening on any of "
+                f"{_RELAY_PORTS} and a single 120s probe failed ({e})"
+            ) from e
+    else:
+        deadline = time.monotonic() + max_wait_s
+        delay = 30.0
+        last_err = None
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                platform, _ = _probe_backend(timeout_s=300.0)
+                if platform not in _TPU_PLATFORMS:
+                    raise RuntimeError(
+                        f"backend came up as '{platform}', not a TPU — "
+                        "refusing to record a CPU number as the per-chip "
+                        "metric"
+                    )
+                break
+            except (subprocess.TimeoutExpired, RuntimeError) as e:
+                last_err = e
+                if time.monotonic() + delay > deadline:
+                    raise RuntimeError(
+                        f"backend init failed after {attempt} attempts: "
+                        f"{last_err}"
+                    )
+                time.sleep(delay)
+                delay = min(delay * 2, 120.0)
 
     import jax
 
@@ -151,23 +198,126 @@ def _init_backend(max_wait_s: float = 900.0):
 # Timing core
 # --------------------------------------------------------------------------
 
+def _gate_and_record(host_losses, dt, iters, *, flops_per_step,
+                     units_per_step, peak_flops, info):
+    """Shared integrity gates: finite + decreasing losses, MFU sanity."""
+    import numpy as np
+
+    host_losses = [float(x) for x in host_losses]
+    if not all(np.isfinite(l) for l in host_losses):
+        raise RuntimeError(f"non-finite loss in timed window: {host_losses}")
+    k = max(1, iters // 4)
+    decreasing = float(np.mean(host_losses[-k:])) < float(np.mean(host_losses[:k]))
+    # Fixed-batch refits converge: a loss that has already collapsed to ~0
+    # by the timed window is trained, not broken — only a FLAT NON-SMALL
+    # loss means the step isn't training.
+    converged = float(np.mean(host_losses[-k:])) < 1e-2
+    step_s = dt / iters
+    info.update({
+        "step_ms": round(step_s * 1000, 3),
+        "iters": iters,
+        "loss_first": round(host_losses[0], 4),
+        "loss_last": round(host_losses[-1], 4),
+        "decreasing": bool(decreasing),
+        "flops_per_step": flops_per_step,
+    })
+    if converged and not decreasing:
+        info["converged"] = True
+    if peak_flops:
+        mfu = flops_per_step / step_s / peak_flops
+        info["mfu"] = round(mfu, 4)
+        if mfu > 1.0:
+            raise RuntimeError(
+                f"MFU {mfu:.2f} > 1.0 — measurement artifact (sync failure?)"
+            )
+    if not decreasing and not converged:
+        # Hard failure, not a warning: every config re-fits one fixed batch,
+        # so a working step MUST reduce the loss across the window — a flat
+        # loss means the step isn't training and its time is meaningless.
+        raise RuntimeError(
+            f"loss did not decrease over timed window "
+            f"({host_losses[0]:.4f} -> {host_losses[-1]:.4f})")
+    return units_per_step / step_s
+
+
 def _timed_train(trainer, ts, batch, *, warmup: int, iters: int,
                  flops_per_step: float, units_per_step: float,
                  peak_flops, info: dict):
-    """Time `iters` train steps with forced-materialization sync.
+    """Time `iters` train steps ON-DEVICE with forced-materialization sync.
 
-    Steps are dispatched asynchronously (the ts -> ts data dependence keeps
-    them sequential on device); the window closes with a device_get of every
-    step's loss AND an element of the final params, so the clock cannot stop
-    before the device finishes. Returns units/sec.
+    The timed window is ONE jitted ``lax.scan`` chain of `iters` steps
+    (Trainer.make_chained_step): the device iterates without host round
+    trips, so the number measures the chip, not the ~35-45 ms/dispatch
+    axon-tunnel cost that dominated small-model rows in r3 (BASELINE.md
+    overhead note; VERDICT r3 next-round #4b). The window still closes with
+    a device_get of the per-step loss vector AND a final-params element —
+    both data-dependent on every step, so the clock cannot stop early. One
+    tunnel round-trip (~69 ms) remains in the window; amortized over the
+    window it is <5% for every config's iters.
+
+    Falls back to the r3 host-driven loop if the chained program fails to
+    build (info["window"] records which path ran).
     """
+    import jax
+    import numpy as np
+
+    if _FORCE_HOST_WINDOW:
+        info["window"] = "host-driven (integrity mode)"
+        return _timed_train_host(
+            trainer, ts, batch, warmup=warmup, iters=iters,
+            flops_per_step=flops_per_step, units_per_step=units_per_step,
+            peak_flops=peak_flops, info=info)
+
+    try:
+        chained = trainer.make_chained_step(iters)
+        t0 = time.perf_counter()
+        ts, losses = chained(ts, batch)  # compile + warmup window
+        warm = np.asarray(jax.device_get(losses))
+        info["compile_s"] = round(time.perf_counter() - t0, 1)
+        if not np.isfinite(warm).all():
+            raise RuntimeError(f"non-finite loss in warmup window: {warm[:8]}")
+
+        import contextlib
+
+        prof = (jax.profiler.trace(_PROFILE_DIR) if _PROFILE_DIR
+                else contextlib.nullcontext())
+        with prof:
+            t0 = time.perf_counter()
+            ts, losses = chained(ts, batch)
+            host_losses = list(np.asarray(jax.device_get(losses)))
+            last_leaf = jax.tree_util.tree_leaves(ts.params)[0]
+            float(jax.device_get(last_leaf.ravel()[0]))
+            dt = time.perf_counter() - t0
+        info["window"] = "on-device-chained"
+    except Exception as e:  # noqa: BLE001 - fall back to host-driven timing
+        if isinstance(e, RuntimeError) and "non-finite" in str(e):
+            raise
+        info["window"] = f"host-driven (chained failed: {str(e)[:120]})"
+        # A runtime failure mid-window happens AFTER ts's buffers were
+        # donated to the chained program — rebuild the state before the
+        # host-driven rescue path touches it.
+        ts = trainer.init_state()
+        return _timed_train_host(
+            trainer, ts, batch, warmup=warmup, iters=iters,
+            flops_per_step=flops_per_step, units_per_step=units_per_step,
+            peak_flops=peak_flops, info=info)
+
+    return _gate_and_record(
+        host_losses, dt, iters, flops_per_step=flops_per_step,
+        units_per_step=units_per_step, peak_flops=peak_flops, info=info)
+
+
+def _timed_train_host(trainer, ts, batch, *, warmup: int, iters: int,
+                      flops_per_step: float, units_per_step: float,
+                      peak_flops, info: dict):
+    """r3 host-driven timing loop (one dispatch per step, async, one sync)."""
     import jax
     import numpy as np
 
     t0 = time.perf_counter()
     ts, m = trainer.train_step(ts, batch)
     first = float(jax.device_get(m["total_loss"]))
-    info["compile_s"] = round(time.perf_counter() - t0, 1)
+    info.setdefault("compile_s", round(time.perf_counter() - t0, 1))
     if not np.isfinite(first):
         raise RuntimeError(f"non-finite loss at step 1: {first}")
 
@@ -191,34 +341,9 @@ def _timed_train(trainer, ts, batch, *, warmup: int, iters: int,
     float(jax.device_get(last_leaf.ravel()[0]))
     dt = time.perf_counter() - t0
 
-    if not all(np.isfinite(l) for l in host_losses):
-        raise RuntimeError(f"non-finite loss in timed window: {host_losses}")
-    k = max(1, iters // 4)
-    decreasing = float(np.mean(host_losses[-k:])) < float(np.mean(host_losses[:k]))
-    step_s = dt / iters
-    info.update({
-        "step_ms": round(step_s * 1000, 2),
-        "iters": iters,
-        "loss_first": round(host_losses[0], 4),
-        "loss_last": round(host_losses[-1], 4),
-        "decreasing": bool(decreasing),
-        "flops_per_step": flops_per_step,
-    })
-    if peak_flops:
-        mfu = flops_per_step / step_s / peak_flops
-        info["mfu"] = round(mfu, 4)
-        if mfu > 1.0:
-            raise RuntimeError(
-                f"MFU {mfu:.2f} > 1.0 — measurement artifact (sync failure?)"
-            )
-    if not decreasing:
-        # Hard failure, not a warning: every config re-fits one fixed batch,
-        # so a working step MUST reduce the loss across the window — a flat
-        # loss means the step isn't training and its time is meaningless.
-        raise RuntimeError(
-            f"loss did not decrease over timed window "
-            f"({host_losses[0]:.4f} -> {host_losses[-1]:.4f})")
-    return units_per_step / step_s
+    return _gate_and_record(
+        host_losses, dt, iters, flops_per_step=flops_per_step,
+        units_per_step=units_per_step, peak_flops=peak_flops, info=info)
 
 
 # --------------------------------------------------------------------------
@@ -322,7 +447,7 @@ def bench_resnet50(peak, *, batch_size=32, warmup=3, iters=20):
 
 
 def bench_lstm(peak, *, batch_size=32, seq_len=256, hidden=256, vocab=77,
-               warmup=4, iters=30):
+               warmup=4, iters=60):
     import jax
     import numpy as np
 
@@ -351,7 +476,7 @@ def bench_lstm(peak, *, batch_size=32, seq_len=256, hidden=256, vocab=77,
     return info
 
 
-def bench_lenet(peak, *, batch_size=256, warmup=4, iters=30):
+def bench_lenet(peak, *, batch_size=256, warmup=4, iters=200):
     import jax
     import numpy as np
 
@@ -383,6 +508,67 @@ _CONFIGS = {
     "lenet": bench_lenet,
 }
 
+# Shrunken shapes for the CPU config-integrity fallback: prove every bench
+# config's train step runs and reduces its loss even when the TPU is
+# unreachable, so a dead relay never zeroes the round's entire perf record
+# (VERDICT r3 Weak #5 / next-round #4a). No perf value is recorded from CPU.
+_CPU_INTEGRITY = {
+    "lenet": dict(batch_size=64, warmup=0, iters=8),
+    "lstm": dict(batch_size=4, seq_len=32, hidden=64, warmup=0, iters=8),
+    "bert": dict(batch_size=2, seq_len=32, warmup=0, iters=3),
+    "resnet50": dict(batch_size=2, warmup=0, iters=3),
+}
+
+
+def _cpu_evidence():
+    """Run every config at tiny shapes on CPU; return integrity records."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # env var alone cannot win
+    global _FORCE_HOST_WINDOW
+    _FORCE_HOST_WINDOW = True
+    ev = {"platform": "cpu", "note": "config-integrity only; no perf values"}
+    for name, kw in _CPU_INTEGRITY.items():
+        info = {}
+        try:
+            info = _CONFIGS[name](None, **kw)
+            ev[name] = {k: info[k] for k in
+                        ("loss_first", "loss_last", "decreasing", "iters")
+                        if k in info}
+            ev[name]["ok"] = bool(info.get("decreasing")
+                                  or info.get("converged"))
+        except Exception as e:  # noqa: BLE001 - record, keep going
+            ev[name] = {"ok": False, "error": str(e)[:200]}
+    return ev
+
+
+def _cpu_kernel_parity():
+    """Tiny interpret-mode Pallas-vs-XLA parity (kernel logic evidence)."""
+    os.environ["DL4J_TPU_FORCE_PALLAS"] = "1"
+    out = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning4j_tpu.kernels.flash_attention import (
+            flash_attention, reference_attention)
+
+        r = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(r.normal(size=(1, 2, 128, 64)), jnp.float32)
+                   for _ in range(3))
+        of = flash_attention(q, k, v, causal=True, backend="pallas")
+        orf = reference_attention(q, k, v, causal=True)
+        err = float(jnp.max(jnp.abs(of - orf)) /
+                    jnp.maximum(jnp.max(jnp.abs(orf)), 1e-6))
+        out["flash_attention"] = {"max_rel_err": round(err, 6),
+                                  "parity": bool(err < 2e-2)}
+    except Exception as e:  # noqa: BLE001
+        out["flash_attention"] = {"error": str(e)[:200]}
+    finally:
+        os.environ.pop("DL4J_TPU_FORCE_PALLAS", None)
+    return out
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -390,6 +576,9 @@ def main():
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of one timed window "
+                         "per config into DIR and append a top-op table")
     args = ap.parse_args()
 
     diag = {}
@@ -398,10 +587,25 @@ def main():
         _, init_diag = _init_backend()
         diag.update(init_diag)
     except Exception as e:  # noqa: BLE001 - bench must always emit one line
+        # TPU unreachable: the artifact still carries CPU-verified evidence
+        # that every config trains and the kernel logic is sound, instead
+        # of a bare error (VERDICT r3 next-round #4a). The evidence pass
+        # itself is guarded — "bench must always emit one line" holds even
+        # if jax is too broken to run on CPU.
+        try:
+            evidence = _cpu_evidence()
+        except Exception as ev_e:  # noqa: BLE001
+            evidence = {"error": str(ev_e)[:200]}
+        try:
+            kparity = _cpu_kernel_parity()
+        except Exception as kp_e:  # noqa: BLE001
+            kparity = {"error": str(kp_e)[:200]}
         print(json.dumps({
             "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
             "error": str(e)[:300], **diag,
+            "cpu_evidence": evidence,
+            "cpu_kernel_parity": kparity,
         }))
         return
 
@@ -412,18 +616,29 @@ def main():
         return
 
     peak = peak_bf16_flops(diag.get("device_kind", "")) or None
+    global _PROFILE_DIR
     for name in args.configs.split(","):
         name = name.strip()
         if not name:
             continue
+        if args.profile:
+            _PROFILE_DIR = os.path.join(args.profile, name)
         try:
             info = _CONFIGS[name](peak)
             base = BASELINES.get(name)
             if base:
                 info["vs_baseline"] = round(info["value"] / base, 3)
+            if args.profile:
+                try:
+                    from deeplearning4j_tpu.train.profiling import analyze_trace
+
+                    info["profile_top_ops"] = analyze_trace(_PROFILE_DIR, top=12)
+                except Exception as e:  # noqa: BLE001
+                    info["profile_error"] = str(e)[:200]
             configs[name] = info
         except Exception as e:  # noqa: BLE001 - keep other configs alive
             configs[name] = {"value": 0.0, "error": str(e)[:300]}
+    _PROFILE_DIR = None
 
     # Pallas-vs-XLA kernel A/B (compiled on this chip): parity + speedup,
     # embedded so the driver's single bench invocation records it.

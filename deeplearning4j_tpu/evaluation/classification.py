@@ -112,10 +112,11 @@ class Evaluation:
 
     def merge(self, other: "Evaluation"):
         """↔ Evaluation.merge (for sharded/parallel eval)."""
-        self.cm = self.cm + other.cm
+        # validate BEFORE mutating: a raise must not leave self half-merged
         if self.top_n != other.top_n:
             raise ValueError(
                 f"cannot merge top_n={self.top_n} with top_n={other.top_n}")
+        self.cm = self.cm + other.cm
         self._topn_correct = self._topn_correct + other._topn_correct
         self._topn_total += other._topn_total
         return self

@@ -38,6 +38,19 @@ def use_pallas() -> bool:
     return on_tpu() or force_pallas()
 
 
+def flash_block_sizes() -> tuple[int, int]:
+    """Default (block_q, block_k) for the flash kernel.
+
+    Tunable via DL4J_TPU_FLASH_BLOCK_Q/K so the on-chip kernels_ab sweep
+    can promote a winning geometry without a code change. 256x512 default:
+    larger kv blocks amortize the per-grid-step overhead along the
+    innermost (sequential) dimension while [block_q, block_k] score tiles
+    stay comfortably inside VMEM.
+    """
+    return (int(os.environ.get("DL4J_TPU_FLASH_BLOCK_Q", "256")),
+            int(os.environ.get("DL4J_TPU_FLASH_BLOCK_K", "512")))
+
+
 def flash_min_seq() -> int:
     """Sequence length at/above which attention auto-dispatch prefers the
     Pallas flash kernel over XLA's fused attention.

@@ -43,12 +43,49 @@ except Exception:  # pragma: no cover
 
 from deeplearning4j_tpu.kernels._dispatch import on_tpu as _on_tpu
 from deeplearning4j_tpu.kernels._dispatch import (
+    flash_block_sizes as _flash_block_sizes,
     flash_min_seq as _flash_min_seq,
     force_pallas as _force_pallas,
     use_pallas as _use_pallas,
 )
 
 _NEG_INF = -1e30
+
+
+def _matmul_dtype(dtype):
+    """MXU input dtype for score/value matmuls.
+
+    fp32 operands are cast to bf16 (fp32 accumulation via
+    ``preferred_element_type`` is kept): a true-fp32 MXU matmul costs ~6
+    passes, while XLA's einsum at its DEFAULT precision runs ONE bf16 pass —
+    that asymmetry was most of the r3 kernels_ab 8x forward loss at T=512
+    (the XLA reference was single-pass bf16, the kernel six-pass fp32).
+    Matching XLA's default keeps the A/B apples-to-apples and the parity
+    bound unchanged (both sides now carry bf16 matmul error).
+    DL4J_TPU_FLASH_FP32=1 restores true-fp32 matmuls.
+
+    Off-TPU (interpret-mode unit tests) the input dtype is kept: those
+    tests pin kernel LOGIC against the fp32 XLA oracle at tight tolerance,
+    and numpy emulation has no MXU whose precision policy needs matching.
+    DL4J_TPU_FLASH_BF16=1 opts interpret mode into the cast path so the
+    policy itself is testable on CPU.
+    """
+    import os
+
+    if os.environ.get("DL4J_TPU_FLASH_FP32", "") == "1":
+        return jnp.float32
+    if not _on_tpu() and os.environ.get("DL4J_TPU_FLASH_BF16", "") != "1":
+        return dtype
+    return jnp.bfloat16 if dtype == jnp.float32 else dtype
+
+
+def _compiler_params(*semantics):
+    """Mosaic grid-dimension semantics (parallel dims enable multi-core
+    partitioning on megacore chips and better pipelining); only meaningful
+    when compiled for TPU — interpret mode ignores them."""
+    if not (_HAS_PLTPU and _on_tpu()):
+        return None
+    return pltpu.CompilerParams(dimension_semantics=tuple(semantics))
 
 
 def reference_attention(q, k, v, *, causal=False, bias=None, key_mask=None,
@@ -96,8 +133,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, m_scr, l_scr,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        mm = _matmul_dtype(q_ref.dtype)
+        q = q_ref[0].astype(mm)
+        k = k_ref[0].astype(mm)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
@@ -120,7 +158,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, m_scr, l_scr,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(mm), v_ref[0].astype(mm), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -228,6 +266,7 @@ def _flash_fwd(q, k, v, key_mask, *, causal, scale, block_q, block_k,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, dp), jnp.float32),
         ],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=not _on_tpu(),
     )(qp, kp, vp, km)
     out, lse = res if save_lse else (res, None)
@@ -238,11 +277,13 @@ def _bwd_recompute(q_ref, k_ref, v_ref, km_ref, g_ref, lse_ref, delta_ref,
                    qi, ki, *, scale, causal, has_mask, block_q, block_k,
                    seq_q, seq_k):
     """Recompute p and ds for one (q-block, kv-block) pair — the math both
-    backward kernels share. Returns (q, k, g, p, ds) as fp32 tiles."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    backward kernels share. Returns (q, k, g, p, ds); matmul inputs in the
+    MXU compute dtype (see _matmul_dtype), p/ds stats in fp32."""
+    mm = _matmul_dtype(q_ref.dtype)
+    q = q_ref[0].astype(mm)
+    k = k_ref[0].astype(mm)
+    v = v_ref[0].astype(mm)
+    g = g_ref[0].astype(mm)
     # Clamp: padded / fully-masked rows carry lse ≈ -1e30; after the
     # query-validity mask below their scores are -1e30 too, so the
     # clamped difference underflows exp to exactly 0 (no inf·0 NaNs).
@@ -266,7 +307,9 @@ def _bwd_recompute(q_ref, k_ref, v_ref, km_ref, g_ref, lse_ref, delta_ref,
         g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     ds = p * (dp - delta) * scale
-    return q, k, g, p, ds
+    # p/ds feed straight into MXU matmuls at the call sites — hand them
+    # over in the compute dtype (fp32 accumulation happens there).
+    return q, k, g, p.astype(mm), ds.astype(mm)
 
 
 def _causal_block_live(qi, ki, *, causal, block_q, block_k, seq_q, seq_k):
@@ -378,6 +421,7 @@ def _flash_bwd_impl(q, k, v, key_mask, out, lse, g, *, causal, scale,
             pltpu.VMEM((block_k, dp), jnp.float32),
             pltpu.VMEM((block_k, dp), jnp.float32),
         ],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=not _on_tpu(),
     )(qp, kp, vp, km, gp, lse, delta)
 
@@ -399,6 +443,7 @@ def _flash_bwd_impl(q, k, v, key_mask, out, lse, g, *, causal, scale,
         out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=not _on_tpu(),
     )(qp, kp, vp, km, gp, lse, delta)
 
@@ -435,7 +480,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale=None, bias=None,
-                    key_mask=None, block_q: int = 256, block_k: int = 256,
+                    key_mask=None, block_q: int = None, block_k: int = None,
                     backend: str = None):
     """Blockwise attention; q [B,H,T,D], k/v [B,H,S,D] → [B,H,T,D].
 
@@ -454,6 +499,9 @@ def flash_attention(q, k, v, *, causal: bool = False, scale=None, bias=None,
     scale = (d ** -0.5) if scale is None else scale
     if backend not in (None, "pallas", "xla"):
         raise ValueError(f"backend must be None|'pallas'|'xla', got {backend!r}")
+    default_bq, default_bk = _flash_block_sizes()
+    block_q = default_bq if block_q is None else block_q
+    block_k = default_bk if block_k is None else block_k
     # Hard constraints on the kernel path regardless of request (off-TPU
     # without the force env, an explicit 'pallas' also falls back — the
     # compiled kernel only exists on TPU):

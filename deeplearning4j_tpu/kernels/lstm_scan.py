@@ -185,20 +185,25 @@ def _lstm_pallas_fwd(x_proj_tm, rw, b, h0, c0, peepholes, forget_bias,
 
 def _make_bwd_kernel(peep: bool):
     """Reversed-time step: grid index i processes t = T-1-i (the index
-    maps in _lstm_pallas_bwd do the flip, so refs already hold step t)."""
+    maps in _lstm_pallas_bwd do the flip, so refs already hold step t).
+
+    The sweep is dgrad-only (dz per step + the dh/dc carries): weight,
+    bias and peephole grads are ONE large batched GEMM / reduction over
+    the saved dz tensor OUTSIDE the kernel (the cuDNN dgrad-then-wgrad
+    schedule). r3's kernel accumulated dRW/db per step inside the sweep —
+    a tiny [H,N]x[N,4H] matmul plus a [H,4H] VMEM read-modify-write every
+    timestep on the sequential critical path — and measured 0.65x XLA
+    (BASELINE.md kernel A/B); hoisting the wgrad out removes that work
+    from the recurrence entirely."""
 
     def kernel(*refs):
-        (gates_ref, cs_ref, csp_ref, hp_ref, gh_ref, gcT_ref, rw_ref) = refs[0:7]
-        i0 = 7
+        (gates_ref, cs_ref, csp_ref, gh_ref, gcT_ref, rw_ref) = refs[0:6]
+        i0 = 6
         if peep:
-            pI_ref, pF_ref, pO_ref = refs[7:10]
-            i0 = 10
-        dxp_ref, drw_ref, db_ref = refs[i0 : i0 + 3]
-        i1 = i0 + 3
-        if peep:
-            dpI_ref, dpF_ref, dpO_ref = refs[i1 : i1 + 3]
-            i1 += 3
-        dh_scr, dc_scr = refs[i1:]
+            pI_ref, pF_ref, pO_ref = refs[6:9]
+            i0 = 9
+        dxp_ref = refs[i0]
+        dh_scr, dc_scr = refs[i0 + 1:]
 
         i = pl.program_id(0)
 
@@ -206,12 +211,6 @@ def _make_bwd_kernel(peep: bool):
         def _init():
             dh_scr[:] = jnp.zeros_like(dh_scr)
             dc_scr[:] = gcT_ref[:]
-            drw_ref[:] = jnp.zeros_like(drw_ref)
-            db_ref[:] = jnp.zeros_like(db_ref)
-            if peep:
-                dpI_ref[:] = jnp.zeros_like(dpI_ref)
-                dpF_ref[:] = jnp.zeros_like(dpF_ref)
-                dpO_ref[:] = jnp.zeros_like(dpO_ref)
 
         gates = gates_ref[0]
         H = gates.shape[-1] // 4
@@ -221,7 +220,6 @@ def _make_bwd_kernel(peep: bool):
         og = gates[:, 3 * H : 4 * H]
         c_t = cs_ref[0]
         c_prev = csp_ref[0]
-        h_prev = hp_ref[0]
 
         dh_total = gh_ref[0] + dh_scr[:]
         tanh_c = jnp.tanh(c_t)
@@ -248,28 +246,17 @@ def _make_bwd_kernel(peep: bool):
             preferred_element_type=jnp.float32,
         )
         dc_scr[:] = dc_next
-        # Weight grads accumulate in VMEM-resident output blocks.
-        drw_ref[:] += jax.lax.dot_general(
-            h_prev, dz, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        db_ref[:] += jnp.sum(dz, axis=0, keepdims=True)
-        if peep:
-            dpI_ref[:] += jnp.sum(dzi * c_prev, axis=0, keepdims=True)
-            dpF_ref[:] += jnp.sum(dzf * c_prev, axis=0, keepdims=True)
-            dpO_ref[:] += jnp.sum(dzo * c_t, axis=0, keepdims=True)
 
     return kernel
 
 
-def _lstm_pallas_bwd(gates_tm, cs_tm, h_prev_tm, c_prev_tm, gh_tm, gcT, rw,
-                     peepholes):
-    """Reversed-time backward sweep.
+def _lstm_pallas_bwd(gates_tm, cs_tm, c_prev_tm, gh_tm, gcT, rw, peepholes):
+    """Reversed-time dgrad sweep.
 
-    gates_tm [T,N,4H], cs_tm/c_prev_tm/h_prev_tm [T,N,H], gh_tm [T,N,H]
-    (upstream grad per step incl. the final-state grad folded into the
-    last step), gcT [N,H]. Returns (dxp_tm [T,N,4H], drw [H,4H], db [4H],
-    dpeep ([H],[H],[H]) or None).
+    gates_tm [T,N,4H], cs_tm/c_prev_tm [T,N,H], gh_tm [T,N,H] (upstream
+    grad per step incl. the final-state grad folded into the last step),
+    gcT [N,H]. Returns dxp_tm [T,N,4H]; weight/bias/peephole grads are
+    computed from it outside (one big GEMM — see _make_bwd_kernel).
     """
     t_len, n, fourh = gates_tm.shape
     h_dim = fourh // 4
@@ -288,31 +275,19 @@ def _lstm_pallas_bwd(gates_tm, cs_tm, h_prev_tm, c_prev_tm, gh_tm, gcT, rw,
         pl.BlockSpec((1, n, fourh), rev),   # gates
         pl.BlockSpec((1, n, h_dim), rev),   # c_t
         pl.BlockSpec((1, n, h_dim), rev),   # c_{t-1}
-        pl.BlockSpec((1, n, h_dim), rev),   # h_{t-1}
         pl.BlockSpec((1, n, h_dim), rev),   # dL/dh_t (upstream)
         pl.BlockSpec((n, h_dim), const2),   # dL/dc_T
         pl.BlockSpec((h_dim, fourh), const2),  # RW resident
         *peep_in_specs,
     ]
-    out_specs = [
-        pl.BlockSpec((1, n, fourh), rev),   # dxp
-        pl.BlockSpec((h_dim, fourh), const2),  # dRW (accumulated)
-        pl.BlockSpec((1, fourh), const2),   # db
-    ]
-    out_shape = [
-        jax.ShapeDtypeStruct((t_len, n, fourh), jnp.float32),
-        jax.ShapeDtypeStruct((h_dim, fourh), jnp.float32),
-        jax.ShapeDtypeStruct((1, fourh), jnp.float32),
-    ]
-    if peep:
-        out_specs += [pl.BlockSpec((1, h_dim), const2) for _ in range(3)]
-        out_shape += [jax.ShapeDtypeStruct((1, h_dim), jnp.float32)] * 3
+    out_specs = pl.BlockSpec((1, n, fourh), rev)   # dxp
+    out_shape = jax.ShapeDtypeStruct((t_len, n, fourh), jnp.float32)
     scratch = [
         pltpu.VMEM((n, h_dim), jnp.float32),  # dh carry
         pltpu.VMEM((n, h_dim), jnp.float32),  # dc carry
     ]
 
-    results = pl.pallas_call(
+    return pl.pallas_call(
         _make_bwd_kernel(peep),
         grid=(t_len,),
         in_specs=in_specs,
@@ -324,17 +299,11 @@ def _lstm_pallas_bwd(gates_tm, cs_tm, h_prev_tm, c_prev_tm, gh_tm, gcT, rw,
         gates_tm,
         cs_tm,
         c_prev_tm,
-        h_prev_tm,
         gh_tm,
         gcT,
         rw.astype(jnp.float32),
         *peep_args,
     )
-    dxp_tm, drw, db = results[0:3]
-    dpeep = None
-    if peep:
-        dpeep = tuple(r.reshape(h_dim) for r in results[3:6])
-    return dxp_tm, drw, db.reshape(fourh), dpeep
 
 
 def _shapes_tile(n: int, h: int) -> bool:
@@ -388,15 +357,26 @@ def _lstm_core_vjp_bwd(forget_bias, has_peep, res, g):
     gh_tm = gh_tm.at[-1].add(ghT.astype(jnp.float32))
 
     peep = tuple(peep_stack) if has_peep else None
-    dxp_tm, drw, db, dpeep = _lstm_pallas_bwd(
-        gates_tm, cs_tm, h_prev_tm, c_prev_tm, gh_tm,
-        gcT.astype(jnp.float32), w_h, peep,
+    dxp_tm = _lstm_pallas_bwd(
+        gates_tm, cs_tm, c_prev_tm, gh_tm, gcT.astype(jnp.float32), w_h, peep,
     )
 
+    # Wgrad phase: one large MXU GEMM / reduction each over the full dz
+    # tensor (dgrad-then-wgrad — see _make_bwd_kernel docstring).
+    drw = jnp.einsum("tnh,tnf->hf", h_prev_tm, dxp_tm)
+    db = jnp.sum(dxp_tm, axis=(0, 1))
     dx = jnp.einsum("tnh,ih->nti", dxp_tm, w_x.astype(jnp.float32))
     dw_x = jnp.einsum("nti,tnh->ih", x.astype(jnp.float32), dxp_tm)
     if has_peep:
-        dpeep_stack = jnp.stack(dpeep)
+        h_dim_ = c_prev_tm.shape[-1]
+        dzi = dxp_tm[:, :, 0 * h_dim_:1 * h_dim_]
+        dzf = dxp_tm[:, :, 1 * h_dim_:2 * h_dim_]
+        dzo = dxp_tm[:, :, 3 * h_dim_:4 * h_dim_]
+        dpeep_stack = jnp.stack([
+            jnp.sum(dzi * c_prev_tm, axis=(0, 1)),
+            jnp.sum(dzf * c_prev_tm, axis=(0, 1)),
+            jnp.sum(dzo * cs_tm, axis=(0, 1)),
+        ])
     else:
         dpeep_stack = jnp.zeros_like(peep_stack)
     return (dx.astype(x.dtype), dw_x.astype(w_x.dtype), drw.astype(w_h.dtype),

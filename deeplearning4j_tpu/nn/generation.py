@@ -29,6 +29,12 @@ def _split_stack(model):
     each recurrent layer must expose step/init_carry; head layers (Dense,
     RnnOutputLayer, ActivationLayer, ...) must be per-step appliable.
     """
+    # Time-axis layers that are NOT step-capable cannot sit in the per-step
+    # head: they would silently treat the [N,C] per-step input's feature
+    # axis as time (e.g. Bidirectional's jnp.flip(x, axis=1) flips features
+    # and produces garbage). Reject them by name rather than guess.
+    _SEQUENCE_HEADS = {"Bidirectional", "LastTimeStep", "MaskZero",
+                       "TimeDistributed", "GlobalPooling1D", "RnnLossLayer"}
     rec, head = [], []
     for i, layer in enumerate(model.layers):
         if hasattr(layer, "step"):
@@ -39,6 +45,11 @@ def _split_stack(model):
                     "supports [recurrent..., head...] stacks")
             rec.append((model.layer_names[i], layer))
         else:
+            if type(layer).__name__ in _SEQUENCE_HEADS:
+                raise ValueError(
+                    f"layer {type(layer).__name__} at index {i} operates on "
+                    "the time axis and is not step-capable — it cannot be "
+                    "part of the per-step generation head")
             head.append((model.layer_names[i], layer))
     if not rec:
         raise ValueError("model has no recurrent (step-capable) layers")

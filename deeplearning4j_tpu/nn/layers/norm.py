@@ -59,15 +59,18 @@ class BatchNorm(LayerConfig):
         beta = params.get("beta")
         if train:
             axes = tuple(range(x.ndim - 1))
-            # fp32 statistics even under bf16 compute.
+            # fp32 statistics even under bf16 compute. Var as E[x²]−E[x]²:
+            # both reductions read x once and are independent, so XLA fuses
+            # them into a single pass over the activation (jnp.var's
+            # (x−mean)² form forces a second pass serialized behind the
+            # mean — measurable across ResNet-50's 53 BNs).
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            ex2 = jnp.mean(jnp.square(xf), axis=axes)
             if self.axis_name is not None:
                 mean = lax.pmean(mean, self.axis_name)
-                # E[x²] − E[x]² composed from pmeans for exact global var.
-                ex2 = lax.pmean(jnp.mean(jnp.square(xf), axis=axes), self.axis_name)
-                var = ex2 - jnp.square(mean)
+                ex2 = lax.pmean(ex2, self.axis_name)
+            var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
             new_state = {
                 "mean": self.momentum * state["mean"] + (1 - self.momentum) * mean,
                 "var": self.momentum * state["var"] + (1 - self.momentum) * var,

@@ -214,6 +214,12 @@ _MERGE_OPS = {
 }
 
 
+def _masked_last_step(x, mask):
+    """Select each example's last unpadded timestep: x [N,T,C], mask [N,T]."""
+    idx = jnp.maximum(jnp.sum((mask > 0).astype(jnp.int32), axis=1) - 1, 0)
+    return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+
+
 def _prod(xs):
     out = xs[0]
     for x in xs[1:]:
@@ -277,9 +283,13 @@ _VERTEX_OPS = {
         lambda xs, a: xs[0].reshape(xs[0].shape[0], *a["shape"]),
         lambda ss, a: tuple(a["shape"]),
     ),
-    # ↔ LastTimeStepVertex: [T, C] → [C].
+    # ↔ LastTimeStepVertex: [T, C] → [C]. The reference vertex is
+    # mask-aware (selects the last UNPADDED step); declare the vertex with
+    # a second input holding the [N, T] mask to get that behavior — with
+    # one input it takes x[:, -1] (valid only for unpadded batches).
     "last_timestep": (
-        lambda xs, a: xs[0][:, -1],
+        lambda xs, a: (xs[0][:, -1] if len(xs) == 1
+                       else _masked_last_step(xs[0], xs[1])),
         lambda ss, a: tuple(ss[0][1:]),
     ),
     # ↔ DuplicateToTimeSeriesVertex: [C] duplicated across the second

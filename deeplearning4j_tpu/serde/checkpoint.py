@@ -92,8 +92,37 @@ def load_state_tree(directory: str | Path, template: Any, sharding=None) -> Any:
             leaves.append(jax.numpy.asarray(arr).astype(tmpl_leaf.dtype))
     tree = jax.tree_util.tree_unflatten(treedef, leaves)
     if sharding is not None:
-        tree = jax.device_put(tree, sharding)
+        tree = _place(tree, sharding)
     return tree
+
+
+def _place(tree, sharding):
+    """device_put a restored tree onto shardings, including MULTI-PROCESS
+    (non-addressable) meshes.
+
+    Plain ``jax.device_put`` refuses shardings whose devices span processes
+    (SURVEY §5.3-§5.4: restore-on-a-different-topology is the recovery
+    story, and that topology is usually multi-host). Non-addressable
+    placement: ordinary leaves go through ``jax.make_array_from_callback``
+    (each process materializes only its addressable shards from the
+    host-loaded global value); PRNG-key leaves — tiny — are rebuilt inside
+    a jit whose out_shardings does the placement.
+    """
+    def put(leaf, s):
+        if s.is_fully_addressable:
+            return jax.device_put(leaf, s)
+        if _is_key_array(leaf):
+            data = np.asarray(jax.random.key_data(leaf))
+            return jax.jit(
+                lambda: jax.random.wrap_key_data(jax.numpy.asarray(data)),
+                out_shardings=s)()
+        host = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            host.shape, s, lambda idx: host[idx])
+
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(lambda l: put(l, sharding), tree)
+    return jax.tree_util.tree_map(put, tree, sharding)
 
 
 def save_checkpoint(directory: str | Path, train_state, *, model=None,

@@ -159,10 +159,13 @@ class Trainer:
             )
             return new_ts, metrics
 
+        self._raw_step = train_step  # unjitted; reused by make_chained_step
+
         jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
         if mesh is not None and state_sharding is not None:
             jit_kwargs["in_shardings"] = (state_sharding, batch_sharding)
             jit_kwargs["out_shardings"] = (state_sharding, None)
+        self._jit_kwargs = jit_kwargs
 
         if check_nan is None:
             from deeplearning4j_tpu.runtime.environment import get_environment
@@ -193,6 +196,36 @@ class Trainer:
             self.train_step = train_step_checked
         else:
             self.train_step = jax.jit(train_step, **jit_kwargs)
+
+    def make_chained_step(self, n_steps: int):
+        """One jitted program that runs ``n_steps`` train steps on-device.
+
+        ``lax.scan`` over the raw step: the step body compiles once, the
+        device iterates without returning to the host, and the only outputs
+        are the final TrainState plus the per-step loss vector. This is how
+        benchmarks measure the chip instead of the host dispatch path — the
+        reference's equivalent overhead (one JNI round-trip per op) has no
+        analogue to hide here, but the axon tunnel's ~35-45 ms per-dispatch
+        cost does (BASELINE.md overhead note), and a chained window removes
+        it. Also the building block for profiled runs (train/profiling.py).
+
+        Returns ``chained(ts, batch) -> (ts, losses[n_steps])``, jitted with
+        the same donation/sharding as ``train_step``.
+        """
+        raw = self._raw_step
+
+        def chained(ts: TrainState, batch):
+            def body(carry, _):
+                new_ts, metrics = raw(carry, batch)
+                return new_ts, metrics["total_loss"]
+
+            final_ts, losses = jax.lax.scan(body, ts, None, length=n_steps)
+            return final_ts, losses
+
+        kwargs = dict(self._jit_kwargs)
+        if "out_shardings" in kwargs:
+            kwargs["out_shardings"] = (kwargs["out_shardings"][0], None)
+        return jax.jit(chained, **kwargs)
 
     def _mask_frozen(self, tree):
         if not self.frozen_layers:

@@ -339,8 +339,11 @@ class GraphTransferLearning:
                 else:
                     refreshed.add(name)
                     continue
-            if name in old_state and _shapes_match(
-                    old_state[name], state.get(name, old_state[name])):
+            # Only carry state for vertices the fresh model actually has
+            # state for: state.get(name, old_state[name]) made the shape
+            # check vacuously true and injected stale entries.
+            if name in old_state and name in state and _shapes_match(
+                    old_state[name], state[name]):
                 state[name] = old_state[name]
 
         frozen: List[str] = []

@@ -22,6 +22,7 @@ Endpoints: ``/`` dashboard, ``/api/runs`` run listing,
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -156,9 +157,25 @@ class UIServer:
     TB event files; both listeners in train/ produce them.
     """
 
-    def __init__(self, log_dir: str, port: int = 9000, host: str = "127.0.0.1"):
+    def __init__(self, log_dir: str, port: int = 9000, host: str = "127.0.0.1",
+                 post_token: Optional[str] = None,
+                 max_run_bytes: int = 256 << 20,
+                 max_total_bytes: int = 2 << 30):
+        """``post_token``: when set, /api/post requires the X-DL4J-Token
+        header to match (REQUIRED for non-loopback ``host`` — the ingest
+        endpoint appends to disk). ``max_run_bytes`` caps each run file;
+        ``max_total_bytes`` caps the SUM of all ingested run files so
+        rotating run names cannot defeat the per-run cap and fill the
+        disk."""
+        if host not in ("127.0.0.1", "localhost", "::1") and not post_token:
+            raise ValueError(
+                "binding the UI server to a non-loopback host requires "
+                "post_token= (the /api/post ingest endpoint writes to disk)")
         self.log_dir = Path(log_dir)
         self.host = host
+        self.post_token = post_token
+        self.max_run_bytes = max_run_bytes
+        self.max_total_bytes = max_total_bytes
         self._requested_port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -208,6 +225,11 @@ class UIServer:
                 if url.path != "/api/post":
                     self.send_error(404)
                     return
+                if ui.post_token is not None and not hmac.compare_digest(
+                        self.headers.get("X-DL4J-Token") or "",
+                        ui.post_token):
+                    self.send_error(403, "bad or missing X-DL4J-Token")
+                    return
                 run = parse_qs(url.query).get("run", [""])[0]
                 if not run or "/" in run or ".." in run:
                     self.send_error(400, "bad run name")
@@ -219,6 +241,17 @@ class UIServer:
                     return
                 if not 0 <= n <= 8 << 20:  # 8 MiB cap per post
                     self.send_error(413, "body too large")
+                    return
+                target = ui.log_dir / f"{run}.jsonl"
+                if target.exists() and \
+                        target.stat().st_size + n > ui.max_run_bytes:
+                    self.send_error(413, "run file size cap exceeded")
+                    return
+                total = sum(p.stat().st_size
+                            for p in ui.log_dir.glob("*.jsonl")
+                            ) if ui.log_dir.is_dir() else 0
+                if total + n > ui.max_total_bytes:
+                    self.send_error(413, "log dir size cap exceeded")
                     return
                 body = self.rfile.read(n)
                 try:
@@ -285,7 +318,7 @@ class RemoteStatsListener(TrainingListener):
 
     def __init__(self, url: str, run: str, *, every: int = 1,
                  flush_every: int = 32, timeout: float = 2.0,
-                 max_queue: int = 10_000):
+                 max_queue: int = 10_000, token: Optional[str] = None):
         from urllib.parse import quote
 
         self.url = url.rstrip("/")
@@ -294,6 +327,7 @@ class RemoteStatsListener(TrainingListener):
         self.flush_every = flush_every
         self.timeout = timeout
         self.max_queue = max_queue
+        self.token = token  # matches UIServer(post_token=...)
         self.last_error: Optional[str] = None
         self._buf: List[str] = []
         self._endpoint = f"{self.url}/api/post?run={quote(run, safe='')}"
@@ -305,9 +339,11 @@ class RemoteStatsListener(TrainingListener):
 
         pending = self._buf
         body = ("\n".join(pending) + "\n").encode()
-        req = urllib.request.Request(
-            self._endpoint, data=body,
-            headers={"Content-Type": "application/jsonl"})
+        headers = {"Content-Type": "application/jsonl"}
+        if self.token is not None:
+            headers["X-DL4J-Token"] = self.token
+        req = urllib.request.Request(self._endpoint, data=body,
+                                     headers=headers)
         try:
             urllib.request.urlopen(req, timeout=self.timeout).close()
         except Exception as e:  # noqa: BLE001 - stats must not kill training

@@ -151,6 +151,53 @@ def _lstm_ab(iters=30):
     return out
 
 
+def _flash_tune(iters=8, B=8, H=12, T=512, D=64, causal=False):
+    """On-chip block-size sweep for the flash kernel (VERDICT r3 #2).
+
+    Times fwd+bwd at each (block_q, block_k) geometry and reports the best;
+    the dispatch defaults (kernels/_dispatch.flash_block_sizes) can then be
+    promoted via DL4J_TPU_FLASH_BLOCK_Q/K without a code change.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.kernels.flash_attention import flash_attention
+
+    r = np.random.default_rng(0)
+    q = jnp.asarray(r.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(B, H, T, D)), jnp.float32)
+
+    geometries = [(128, 128), (128, 256), (256, 256), (256, 512),
+                  (512, 512), (128, 512)]
+    out = {"shape": f"B{B} H{H} T{T} D{D} causal={causal}", "iters": iters,
+           "sweep": {}}
+    best = None
+    for bq, bk in geometries:
+        if bq > T or bk > T:
+            continue
+        key = f"q{bq}_k{bk}"
+        try:
+            f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=causal, backend="pallas",
+                block_q=bq, block_k=bk))
+            g = jax.jit(jax.grad(
+                lambda q, k, v, bq=bq, bk=bk: jnp.sum(flash_attention(
+                    q, k, v, causal=causal, backend="pallas",
+                    block_q=bq, block_k=bk) ** 2), argnums=(0, 1, 2)))
+            fwd = _time_fn(f, (q, k, v), iters)
+            bwd = _time_fn(lambda *a: g(*a)[0], (q, k, v), iters)
+            out["sweep"][key] = {"fwd_ms": round(fwd, 3), "bwd_ms": round(bwd, 3)}
+            if best is None or fwd + bwd < best[1]:
+                best = (key, fwd + bwd)
+        except Exception as e:  # noqa: BLE001 - record, keep sweeping
+            out["sweep"][key] = {"error": str(e)[:160]}
+    if best:
+        out["best"] = best[0]
+    return out
+
+
 def run_kernels_ab(diag: dict) -> dict:
     import jax
 
@@ -169,8 +216,12 @@ def run_kernels_ab(diag: dict) -> dict:
     # crossover is justified.
     flash_long = lambda: _flash_ab(iters=10, B=2, H=8, T=4096, D=64,
                                    causal=True)
+    tune_long = lambda: _flash_tune(iters=6, B=2, H=8, T=2048, D=64,
+                                    causal=True)
     for name, fn in (("flash_attention", _flash_ab),
                      ("flash_attention_long", flash_long),
+                     ("flash_tune_512", _flash_tune),
+                     ("flash_tune_2048", tune_long),
                      ("lstm_scan", _lstm_ab)):
         try:
             result[name] = fn()
