@@ -139,19 +139,21 @@ def _init_backend(max_wait_s: float = 900.0):
     detected up front by a TCP liveness probe and bounded at ONE short
     attempt, so the failure path costs ~2 min, not 15.
     """
-    relay_up = _relay_alive()
-    if not relay_up:
-        # Nothing is listening locally; either this environment doesn't use
-        # the relay (then one probe settles it fast) or the relay is dead
-        # (then the probe fails with connection-refused rather than a hang).
+    # Fail fast ONLY when this is recognizably the relay-tunneled container
+    # (the relay script exists) and the relay isn't listening — then no
+    # probe can ever succeed. On any other host (direct TPU VM, changed
+    # ports) keep the full patient retry loop: a transient cold-init there
+    # must not zero the perf record.
+    relay_env = os.path.exists("/root/.relay.py")
+    if relay_env and not _relay_alive():
         try:
             platform, _ = _probe_backend(timeout_s=120.0)
             if platform not in _TPU_PLATFORMS:
                 raise RuntimeError(f"backend came up as '{platform}'")
         except (subprocess.TimeoutExpired, RuntimeError) as e:
             raise RuntimeError(
-                "TPU unreachable: relay not listening on any of "
-                f"{_RELAY_PORTS} and a single 120s probe failed ({e})"
+                "TPU unreachable: relay process dead (not listening on any "
+                f"of {_RELAY_PORTS}) and a single 120s probe failed ({e})"
             ) from e
     else:
         deadline = time.monotonic() + max_wait_s

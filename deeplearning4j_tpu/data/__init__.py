@@ -52,6 +52,12 @@ from deeplearning4j_tpu.data.records import (
     SVMLightRecordReader,
 )
 from deeplearning4j_tpu.data.transform import Schema, TransformProcess
+from deeplearning4j_tpu.data.arrow import ArrowRecordReader, read_arrow_file
+from deeplearning4j_tpu.data.geo import (
+    CoordinatesDistanceTransform,
+    GeoJsonPointReader,
+    haversine_m,
+)
 from deeplearning4j_tpu.data.image import (
     ImageDataSetIterator,
     ImageRecordReader,
@@ -76,6 +82,8 @@ __all__ = [
     "RecordReaderDataSetIterator", "RegexLineRecordReader",
     "JsonLineRecordReader", "SVMLightRecordReader",
     "Schema", "TransformProcess",
+    "ArrowRecordReader", "read_arrow_file",
+    "CoordinatesDistanceTransform", "GeoJsonPointReader", "haversine_m",
     "ImageRecordReader", "ImageDataSetIterator",
     "ParentPathLabelGenerator", "PatternPathLabelGenerator",
     "PipelineImageTransform",

@@ -1,0 +1,179 @@
+"""Geo readers + coordinate transforms (↔ datavec-geo).
+
+ref: org.datavec.api.transform.transform.geo.{CoordinatesDistanceTransform,
+IPAddressToCoordinatesTransform, LocationToCoordinatesTransform} and the
+datavec-geo module. The MaxMind GeoIP lookup needs an external licensed
+database — absent here, ``IPAddressToCoordinatesTransform`` raises with
+instructions — while the coordinate math and point readers are full
+implementations:
+
+- ``GeoJsonPointReader``: dependency-free GeoJSON ``FeatureCollection``
+  reader yielding [lon, lat, *properties] records for the transform engine.
+- ``CoordinatesDistanceTransform``: derived-column transform computing the
+  distance between two coordinate columns (reference semantics: coordinates
+  serialized as delimited strings, euclidean by default; haversine meters
+  supported for lat/lon).
+- ``haversine_m`` / ``parse_point``: the underlying math, exposed.
+
+Transforms plug into data/transform.py's TransformProcess (same
+apply/out_schema protocol, registered for JSON round-trip).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from pathlib import Path
+from typing import Any, List, Optional
+
+from deeplearning4j_tpu.data.transform import Column, Schema, _register
+
+_EARTH_RADIUS_M = 6_371_008.8  # IUGG mean radius
+
+
+def parse_point(value: Any, delimiter: str = ":") -> List[float]:
+    """Parse a delimited coordinate string ('lat:lon' or 'x:y:z' …) into
+    floats; passes through list/tuple input."""
+    if isinstance(value, (list, tuple)):
+        return [float(v) for v in value]
+    return [float(p) for p in str(value).split(delimiter)]
+
+
+def haversine_m(lat1: float, lon1: float, lat2: float, lon2: float) -> float:
+    """Great-circle distance in meters between two (lat, lon) points."""
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = math.radians(lat2 - lat1)
+    dl = math.radians(lon2 - lon1)
+    a = (math.sin(dp / 2) ** 2
+         + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2)
+    return 2 * _EARTH_RADIUS_M * math.asin(math.sqrt(a))
+
+
+@_register
+@dataclasses.dataclass
+class CoordinatesDistanceTransform:
+    """↔ CoordinatesDistanceTransform: new column = distance between two
+    delimited-coordinate columns.
+
+    ``metric``: 'euclidean' (reference default, any dimensionality) or
+    'haversine' (2-D lat:lon, meters).
+    """
+
+    new_name: str
+    first_column: str
+    second_column: str
+    delimiter: str = ":"
+    metric: str = "euclidean"
+
+    def out_schema(self, s: Schema) -> Schema:
+        out = s.copy()
+        out.columns.append(Column(self.new_name, "double"))
+        return out
+
+    def apply(self, records, s: Schema):
+        i = s.index_of(self.first_column)
+        j = s.index_of(self.second_column)
+        out = []
+        for r in records:
+            a = parse_point(r[i], self.delimiter)
+            b = parse_point(r[j], self.delimiter)
+            if self.metric == "haversine":
+                d = haversine_m(a[0], a[1], b[0], b[1])
+            elif self.metric == "euclidean":
+                d = math.sqrt(sum((x - y) ** 2 for x, y in zip(a, b)))
+            else:
+                raise ValueError(f"unknown metric {self.metric!r}")
+            out.append(list(r) + [d])
+        return out
+
+
+@_register
+@dataclasses.dataclass
+class IPAddressToCoordinatesTransform:
+    """↔ IPAddressToCoordinatesTransform (MaxMind GeoIP2). The GeoLite2
+    database is licensed/external and not present in this environment; the
+    transform exists for API parity and raises with setup instructions."""
+
+    column: str
+    delimiter: str = ":"
+
+    def out_schema(self, s: Schema) -> Schema:
+        return s.copy()
+
+    def apply(self, records, s: Schema):
+        raise RuntimeError(
+            "IPAddressToCoordinatesTransform needs a MaxMind GeoLite2 "
+            "database (geoip2 reader + .mmdb file); neither ships in this "
+            "environment. Provide a custom transform wrapping your geo "
+            "database, or resolve IPs offline before ingest.")
+
+
+class GeoJsonPointReader:
+    """Read Point features from a GeoJSON FeatureCollection.
+
+    Records are [lon, lat, *property values] (GeoJSON's native coordinate
+    order); ``schema()`` describes the columns so TransformProcess can take
+    over. Non-point geometries are skipped unless ``strict``.
+    """
+
+    def __init__(self, property_names: Optional[List[str]] = None,
+                 strict: bool = False):
+        self.property_names = property_names
+        self.strict = strict
+        self._rows: List[List[Any]] = []
+        self._props: List[str] = []
+        self._i = 0
+
+    def initialize(self, path):
+        doc = json.loads(Path(path).read_text())
+        if doc.get("type") != "FeatureCollection":
+            raise ValueError(f"{path}: not a GeoJSON FeatureCollection")
+        feats = doc.get("features", [])
+        if self.property_names is not None:
+            self._props = list(self.property_names)
+        else:
+            keys: List[str] = []
+            for f in feats:
+                for k in (f.get("properties") or {}):
+                    if k not in keys:
+                        keys.append(k)
+            self._props = keys
+        self._rows = []
+        for f in feats:
+            geom = f.get("geometry") or {}
+            if geom.get("type") != "Point":
+                if self.strict:
+                    raise ValueError(
+                        f"non-Point geometry {geom.get('type')!r} in {path}")
+                continue
+            lon, lat = geom["coordinates"][:2]
+            props = f.get("properties") or {}
+            self._rows.append([float(lon), float(lat)]
+                              + [props.get(k) for k in self._props])
+        self._i = 0
+        return self
+
+    def schema(self) -> Schema:
+        s = Schema().add_double_column("lon").add_double_column("lat")
+        for k in self._props:
+            s.add_string_column(k)
+        return s
+
+    def has_next(self) -> bool:
+        return self._i < len(self._rows)
+
+    def next(self) -> List[Any]:
+        if not self.has_next():
+            raise StopIteration
+        r = self._rows[self._i]
+        self._i += 1
+        return r
+
+    def reset(self):
+        self._i = 0
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()

@@ -353,6 +353,12 @@ class TransformProcess:
         self.steps.append(op)
         return self
 
+    def add(self, op) -> "TransformProcess":
+        """Append any transform implementing apply/out_schema — the
+        extension point for custom transforms (↔ TransformProcess.Builder
+        .transform(Transform)); used by e.g. data/geo.py."""
+        return self._add(op)
+
     # builder sugar mirroring reference method names
     def remove_columns(self, *names):
         return self._add(RemoveColumns(list(names)))

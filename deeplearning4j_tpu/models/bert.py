@@ -52,6 +52,10 @@ class BertConfig:
     eps: float = 1e-12
     use_nsp: bool = True
     initializer_range: float = 0.02
+    # jax.checkpoint each encoder block (recompute-in-backward): the
+    # memory lever for long-context / deep configs — see
+    # TransformerEncoderBlock.remat.
+    remat: bool = False
     net: NeuralNetConfiguration = field(
         default_factory=lambda: NeuralNetConfiguration(updater=Adam(1e-4))
     )
@@ -75,6 +79,7 @@ class Bert:
             attention_dropout=config.attention_dropout,
             post_ln=True,
             eps=config.eps,
+            remat=config.remat,
         )
 
     # -- construction ------------------------------------------------------

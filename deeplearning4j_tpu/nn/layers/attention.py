@@ -190,6 +190,12 @@ class TransformerEncoderBlock(LayerConfig):
     eps: float = 1e-12
     weight_init: Optional[str] = None
     sequence_parallel: Optional[str] = None  # threaded to inner SelfAttention
+    # Rematerialize the block under grad (jax.checkpoint): activations are
+    # recomputed in backward instead of stored — the long-context /
+    # deep-stack memory lever (HBM is the usual TPU bottleneck; trading
+    # ~1/3 more FLOPs for O(layers) less activation memory raises the
+    # trainable T and batch). Off by default: at short T it only costs.
+    remat: bool = False
 
     def output_shape(self, input_shape):
         return tuple(input_shape)
@@ -217,6 +223,14 @@ class TransformerEncoderBlock(LayerConfig):
         return params, {}
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if self.remat:
+            fwd = jax.checkpoint(
+                lambda p, h, r, m: self._forward(p, h, train=train, rng=r,
+                                                 mask=m))
+            return fwd(params, x, rng, mask), state
+        return self._forward(params, x, train=train, rng=rng, mask=mask), state
+
+    def _forward(self, params, x, *, train, rng, mask):
         att = SelfAttention(
             num_heads=self.num_heads, causal=self.causal,
             dropout=self.attention_dropout,
@@ -241,7 +255,7 @@ class TransformerEncoderBlock(LayerConfig):
             f = opsnn.linear(f, params["W2"], params["b2"])
             if train and self.dropout > 0.0 and r3 is not None:
                 f = opsnn.dropout(f, self.dropout, r3)
-            return ln(x + f, "ln2"), state
+            return ln(x + f, "ln2")
         # pre-LN (more stable for deep stacks)
         a_in = ln(x, "ln1")
         a, _ = att.apply(params["attention"], {}, a_in, train=train, rng=r1, mask=mask)
@@ -254,7 +268,7 @@ class TransformerEncoderBlock(LayerConfig):
         f = opsnn.linear(f, params["W2"], params["b2"])
         if train and self.dropout > 0.0 and r3 is not None:
             f = opsnn.dropout(f, self.dropout, r3)
-        return x + f, state
+        return x + f
 
 
 @register_config

@@ -63,7 +63,11 @@ class BatchNorm(LayerConfig):
             # both reductions read x once and are independent, so XLA fuses
             # them into a single pass over the activation (jnp.var's
             # (x−mean)² form forces a second pass serialized behind the
-            # mean — measurable across ResNet-50's 53 BNs).
+            # mean — measurable across ResNet-50's 53 BNs). Same one-pass
+            # form as flax BatchNorm and the cross-replica branch below.
+            # Tradeoff: fp32 cancellation degrades var when |mean|/std
+            # exceeds ~1e3 (unnormalized raw inputs) — normalize inputs,
+            # as every reference pipeline does, and it is immaterial.
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
             ex2 = jnp.mean(jnp.square(xf), axis=axes)

@@ -10,6 +10,11 @@
 """
 
 from deeplearning4j_tpu.rl.a2c import A2C, A2CConfig
+from deeplearning4j_tpu.rl.history import (
+    FrameStackEnv,
+    HistoryProcessor,
+    SyntheticFrameEnv,
+)
 from deeplearning4j_tpu.rl.a3c import A3CConfig, A3CDiscrete
 from deeplearning4j_tpu.rl.mdp import MDP, CartPole, Corridor, Pendulum
 from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedyPolicy, GreedyPolicy

@@ -1,0 +1,160 @@
+"""Frame preprocessing + stacking for pixel RL (↔ RL4J HistoryProcessor +
+the ALE/malmo MDP wrappers).
+
+ref: org.deeplearning4j.rl4j.util.HistoryProcessor (grayscale, rescale to
+84x84, stack the last N frames, frame-skip with action repeat) and
+org.deeplearning4j.rl4j.mdp.ale.ALEMDP. The Atari emulator itself is an
+external native dependency (ale-py / Stella) not present here; the
+connector half — everything between a raw-frame-producing env and the DQN
+agent — is implemented in full and wraps ANY MDP whose observations are
+[H, W] or [H, W, C] uint8/float frames (an ale-py or gymnasium Atari env
+plugs straight in; tests use a synthetic frame env).
+
+DeepMind-standard pipeline, matching the reference's defaults:
+grayscale → bilinear resize to ``size`` → max-pool over the last two raw
+frames (flicker removal) → repeat each action ``skip`` times → stack the
+last ``stack`` processed frames into the [stack, H, W] observation.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def to_grayscale(frame: np.ndarray) -> np.ndarray:
+    """[H,W] passthrough; [H,W,3] ITU-R 601 luma; [H,W,1] squeeze."""
+    if frame.ndim == 2:
+        return frame.astype(np.float32)
+    if frame.shape[-1] == 1:
+        return frame[..., 0].astype(np.float32)
+    f = frame.astype(np.float32)
+    return 0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2]
+
+
+def resize_bilinear(img: np.ndarray, size: Tuple[int, int]) -> np.ndarray:
+    """Dependency-free bilinear resize of a [H,W] image (align_corners=False
+    convention, the cv2/PIL default)."""
+    h, w = img.shape
+    oh, ow = size
+    if (h, w) == (oh, ow):
+        return img.astype(np.float32)
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+class HistoryProcessor:
+    """↔ RL4J HistoryProcessor: per-frame preprocessing + rolling stack.
+
+    ``add(frame)`` ingests a raw frame; ``history()`` returns the current
+    [stack, H, W] float32 observation (oldest first), zero-padded until
+    ``stack`` frames have been seen since the last ``reset()``.
+    """
+
+    def __init__(self, stack: int = 4, size: Tuple[int, int] = (84, 84),
+                 scale: float = 1.0 / 255.0):
+        self.stack = stack
+        self.size = tuple(size)
+        self.scale = scale
+        self._frames: deque = deque(maxlen=stack)
+
+    def process(self, frame: np.ndarray) -> np.ndarray:
+        return (resize_bilinear(to_grayscale(np.asarray(frame)), self.size)
+                * self.scale).astype(np.float32)
+
+    def add(self, frame: np.ndarray) -> None:
+        self._frames.append(self.process(frame))
+
+    def reset(self) -> None:
+        self._frames.clear()
+
+    def history(self) -> np.ndarray:
+        n = len(self._frames)
+        if n == 0:
+            raise RuntimeError("history() before any add()")
+        pad = [np.zeros(self.size, np.float32)] * (self.stack - n)
+        return np.stack(pad + list(self._frames))
+
+
+class FrameStackEnv:
+    """ALE-style MDP wrapper: action-repeat + flicker max-pool + history.
+
+    Wraps any env with ``reset() -> frame`` and
+    ``step(a) -> (frame, reward, done, info)`` where ``frame`` is an image;
+    emits [stack, H, W] float32 observations. ``skip``: each agent action is
+    repeated ``skip`` emulator steps, rewards summed, and the observation is
+    the elementwise max of the last two raw frames (the DeepMind/ALE
+    flicker workaround the reference inherits).
+    """
+
+    def __init__(self, env, *, stack: int = 4, skip: int = 4,
+                 size: Tuple[int, int] = (84, 84),
+                 scale: float = 1.0 / 255.0):
+        self.env = env
+        self.skip = max(1, skip)
+        self.proc = HistoryProcessor(stack=stack, size=size, scale=scale)
+        self.action_space_n: Optional[int] = getattr(env, "action_space_n",
+                                                     None)
+
+    def reset(self) -> np.ndarray:
+        frame = self.env.reset()
+        self.proc.reset()
+        self.proc.add(frame)
+        return self.proc.history()
+
+    def step(self, action):
+        total = 0.0
+        done = False
+        info: dict = {}
+        last_two = deque(maxlen=2)
+        frame = None
+        for _ in range(self.skip):
+            frame, r, done, info = self.env.step(action)
+            total += float(r)
+            last_two.append(np.asarray(frame, np.float32))
+            if done:
+                break
+        pooled = (np.maximum(last_two[0], last_two[1])
+                  if len(last_two) == 2 else last_two[0])
+        self.proc.add(pooled)
+        return self.proc.history(), total, done, info
+
+
+class SyntheticFrameEnv:
+    """Tiny deterministic frame-producing MDP for connector tests: a bright
+    square whose position advances each step; reward 1 when the agent's
+    action matches the square's parity; episode of fixed length."""
+
+    action_space_n = 2
+
+    def __init__(self, shape=(30, 40, 3), episode_len: int = 12):
+        self.shape = shape
+        self.episode_len = episode_len
+        self._t = 0
+
+    def _frame(self) -> np.ndarray:
+        f = np.zeros(self.shape, np.uint8)
+        x = (3 * self._t) % (self.shape[1] - 6)
+        f[5:11, x:x + 6] = 255
+        return f
+
+    def reset(self) -> np.ndarray:
+        self._t = 0
+        return self._frame()
+
+    def step(self, action):
+        self._t += 1
+        reward = 1.0 if int(action) == self._t % 2 else 0.0
+        return self._frame(), reward, self._t >= self.episode_len, {}

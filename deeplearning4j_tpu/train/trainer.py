@@ -210,7 +210,8 @@ class Trainer:
         it. Also the building block for profiled runs (train/profiling.py).
 
         Returns ``chained(ts, batch) -> (ts, losses[n_steps])``, jitted with
-        the same donation/sharding as ``train_step``.
+        the same donation/sharding — and the same ``check_nan`` guard —
+        as ``train_step``.
         """
         raw = self._raw_step
 
@@ -225,6 +226,24 @@ class Trainer:
         kwargs = dict(self._jit_kwargs)
         if "out_shardings" in kwargs:
             kwargs["out_shardings"] = (kwargs["out_shardings"][0], None)
+
+        if self.check_nan:
+            from jax.experimental import checkify
+
+            checked_kwargs = dict(kwargs)
+            if "out_shardings" in checked_kwargs:
+                checked_kwargs["out_shardings"] = (
+                    None, checked_kwargs["out_shardings"])
+            checked = jax.jit(
+                checkify.checkify(chained, errors=checkify.float_checks),
+                **checked_kwargs)
+
+            def chained_checked(ts, batch):
+                err, out = checked(ts, batch)
+                checkify.check_error(err)
+                return out
+
+            return chained_checked
         return jax.jit(chained, **kwargs)
 
     def _mask_frozen(self, tree):

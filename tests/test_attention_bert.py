@@ -179,3 +179,26 @@ def test_flash_min_seq_env_override(monkeypatch):
     assert _dispatch.flash_min_seq() == 123
     monkeypatch.delenv("DL4J_TPU_FLASH_MIN_SEQ")
     assert _dispatch.flash_min_seq() == 1024
+
+
+def test_transformer_block_remat_grads_match():
+    # remat must change memory, not math: grads bitwise-close to non-remat
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.layers.attention import TransformerEncoderBlock
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 12, 32)),
+                    jnp.float32)
+    blk = TransformerEncoderBlock(num_heads=4)
+    blk_r = TransformerEncoderBlock(num_heads=4, remat=True)
+    params, _ = blk.init(jax.random.key(0), (12, 32), jnp.float32)
+
+    def loss(b):
+        return lambda p: jnp.sum(b.apply(p, {}, x, train=False)[0] ** 2)
+
+    g = jax.grad(loss(blk))(params)
+    gr = jax.grad(loss(blk_r))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)

@@ -169,3 +169,27 @@ class TestNanGuardSharded:
         with pytest.raises(Exception, match="(?i)nan"):
             ts3, m = trainer.train_step(ts2, jax.device_put(_batch(nan=True), bs))
             jax.device_get(m["total_loss"])
+
+
+class TestNanGuardChained:
+    def test_chained_step_keeps_guard(self):
+        # make_chained_step must carry the checkify guard, not silently
+        # drop it (a NaN inside the scan would otherwise only show up in
+        # the returned losses)
+        trainer = Trainer(_model(), check_nan=True)
+        ts = trainer.init_state(seed=0)
+        chained = trainer.make_chained_step(3)
+        with pytest.raises(Exception):
+            out_ts, losses = chained(ts, _batch(nan=True))
+            import jax
+
+            jax.device_get(losses)
+
+    def test_chained_step_clean_passes(self):
+        trainer = Trainer(_model(), check_nan=True)
+        ts = trainer.init_state(seed=0)
+        chained = trainer.make_chained_step(3)
+        ts, losses = chained(ts, _batch())
+        import jax
+
+        assert np.isfinite(np.asarray(jax.device_get(losses))).all()
