@@ -506,6 +506,11 @@ def bench_lenet(peak, *, batch_size=256, warmup=4, iters=200):
 _CONFIGS = {
     "bert": bench_bert,
     "resnet50": bench_resnet50,
+    # Batch-size knee probe: same model, 4x the per-step work. No r3
+    # baseline (baseline_pending); recorded to show how much of the b32
+    # MFU gap is launch-bound vs intrinsic (BASELINE.md ResNet diagnosis).
+    "resnet50_b128": lambda peak: bench_resnet50(peak, batch_size=128,
+                                                 iters=10),
     "lstm": bench_lstm,
     "lenet": bench_lenet,
 }
@@ -574,7 +579,8 @@ def _cpu_kernel_parity():
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="bert,resnet50,lstm,lenet",
+    ap.add_argument("--configs",
+                    default="bert,resnet50,resnet50_b128,lstm,lenet",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

@@ -470,6 +470,121 @@ def _time_distributed(cfg):
     return LAYER_MAPPERS[cls](inner.get("config", {}))
 
 
+def _dir_matcher(direction: str, suffix: str):
+    """Full-path weight matcher for Bidirectional sub-layers: the key must
+    contain '<direction>_' and end with '/<suffix>'."""
+
+    def match(key: str) -> bool:
+        return f"{direction}_" in key and key.endswith("/" + suffix)
+
+    match.optional = suffix in _OPTIONAL_SUFFIXES
+    return match
+
+
+def _bidirectional(cfg):
+    """↔ KerasBidirectional: wraps LSTM/GRU/SimpleRNN; merge modes map to
+    the Bidirectional layer's CONCAT/ADD/MUL/AVERAGE set."""
+    inner = cfg.get("layer", {})
+    cls = inner.get("class_name")
+    if cls not in ("LSTM", "GRU", "SimpleRNN"):
+        raise KerasImportError(f"Bidirectional({cls}) not supported")
+    merge = cfg.get("merge_mode", "concat")
+    merge_map = {"concat": "concat", "sum": "add", "mul": "mul",
+                 "ave": "average"}
+    if merge not in merge_map:
+        raise KerasImportError(
+            f"Bidirectional merge_mode={merge!r} not supported "
+            "(concat/sum/mul/ave)")
+    inner_layer, inner_map = LAYER_MAPPERS[cls](inner.get("config", {}))
+    from deeplearning4j_tpu.nn.layers.recurrent import Bidirectional
+
+    wmap = {}
+    for ours, (sfx, transform) in inner_map.items():
+        sfxs = (sfx,) if isinstance(sfx, str) else tuple(sfx)
+        wmap[f"fwd/{ours}"] = (
+            tuple(_dir_matcher("forward", s) for s in sfxs), transform)
+        wmap[f"bwd/{ours}"] = (
+            tuple(_dir_matcher("backward", s) for s in sfxs), transform)
+    return Bidirectional(layer=inner_layer, merge=merge_map[merge]), wmap
+
+
+def _masking(cfg):
+    """↔ KerasMasking → MaskZeroLayer (the reference's mapping). Only
+    mask_value=0.0 matches MaskZero semantics."""
+    if float(cfg.get("mask_value", 0.0)) != 0.0:
+        raise KerasImportError("Masking with mask_value != 0 not supported")
+    from deeplearning4j_tpu.nn.layers.core import MaskZeroLayer
+
+    return MaskZeroLayer(), {}
+
+
+def _tuple3(v, default):
+    if v is None:
+        return (default,) * 3
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * 3
+
+
+def _pool3d(kind):
+    def mapper(cfg):
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("channels_first 3D pooling not supported")
+        from deeplearning4j_tpu.nn.layers.conv import Pooling3D
+
+        window = _tuple3(cfg.get("pool_size"), 2)
+        return Pooling3D(
+            pool_type=kind, window=window,
+            stride=_tuple3(cfg.get("strides"), window[0])
+            if cfg.get("strides") is not None else window,
+            padding=_padding(cfg)), {}
+
+    return mapper
+
+
+def _global_pool3d(kind):
+    def mapper(cfg):
+        if cfg.get("keepdims"):
+            raise KerasImportError("Global 3D pooling keepdims not supported")
+        from deeplearning4j_tpu.nn.layers.conv import GlobalPooling
+
+        return GlobalPooling(pool_type=kind), {}
+
+    return mapper
+
+
+def _upsampling3d(cfg):
+    from deeplearning4j_tpu.nn.layers.conv import Upsampling3D
+
+    return Upsampling3D(scale=_tuple3(cfg.get("size"), 2)), {}
+
+
+def _sym3(v, default=1):
+    """Keras 3D padding/cropping config: int | [a,b,c] | [[lo,hi]x3] →
+    our flat (d_lo, d_hi, h_lo, h_hi, w_lo, w_hi)."""
+    if v is None:
+        v = default
+    if isinstance(v, int):
+        return (v,) * 6
+    out = []
+    for item in v:
+        if isinstance(item, (list, tuple)):
+            out.extend([int(item[0]), int(item[1])])
+        else:
+            out.extend([int(item), int(item)])
+    return tuple(out)
+
+
+def _zeropad3d(cfg):
+    from deeplearning4j_tpu.nn.layers.conv import ZeroPadding3D
+
+    return ZeroPadding3D(padding=_sym3(cfg.get("padding"))), {}
+
+
+def _cropping3d(cfg):
+    from deeplearning4j_tpu.nn.layers.conv import Cropping3D
+
+    return Cropping3D(cropping=_sym3(cfg.get("cropping"))), {}
+
+
 LAYER_MAPPERS: Dict[str, Callable] = {
     "Dense": _dense,
     "Conv2D": _conv2d,
@@ -522,6 +637,17 @@ LAYER_MAPPERS: Dict[str, Callable] = {
     "TimeDistributed": _time_distributed,
     "ActivityRegularization": lambda cfg: (
         ActivationLayer(activation="identity"), {}),
+    # --- round-4 tail: wrappers, masking, the 3D family ---
+    "Bidirectional": _bidirectional,
+    "Masking": _masking,
+    "MaxPooling3D": _pool3d("max"),
+    "AveragePooling3D": _pool3d("avg"),
+    "GlobalAveragePooling3D": _global_pool3d("avg"),
+    "GlobalMaxPooling3D": _global_pool3d("max"),
+    "UpSampling3D": _upsampling3d,
+    "ZeroPadding3D": _zeropad3d,
+    "Cropping3D": _cropping3d,
+    "SpatialDropout3D": _dropout,
 }
 
 # functional merge layers → GraphVertex kinds
@@ -560,7 +686,10 @@ def _map_layer(class_name: str, cfg: dict):
 
 
 def _layer_weights(h5file, layer_name: str) -> Dict[str, np.ndarray]:
-    """Weight arrays for one layer, keyed by their last path component."""
+    """Weight arrays for one layer, keyed by their last path component AND
+    by their full path (":<idx>" stripped) — wrapper layers like
+    Bidirectional have forward/backward weights whose last components
+    collide, so their mappers match on the full path instead."""
     mw = h5file["model_weights"]
     if layer_name not in mw:
         return {}
@@ -569,7 +698,9 @@ def _layer_weights(h5file, layer_name: str) -> Dict[str, np.ndarray]:
              for n in grp.attrs.get("weight_names", [])]
     out = {}
     for n in names:
-        out[n.split("/")[-1].split(":")[0]] = np.asarray(grp[n])
+        arr = np.asarray(grp[n])
+        out[n.split("/")[-1].split(":")[0]] = arr
+        out[n.split(":")[0]] = arr
     return out
 
 
@@ -578,13 +709,32 @@ _OPTIONAL_SUFFIXES = {"bias", "gamma", "beta"}
 
 
 def _fill_params(weight_map, kweights, layer_cls: str):
+    """weight_map entries: ours -> (suffixes, transform). A suffix may be a
+    plain key, or a CALLABLE predicate matched against every available
+    weight key (wrapper layers match on full paths this way). ``ours``
+    containing '/' nests into sub-dicts (e.g. Bidirectional's fwd/W)."""
     params, state = {}, {}
+
+    def put(tree, key, arr):
+        parts = key.split("/")
+        for p in parts[:-1]:
+            tree = tree.setdefault(p, {})
+        tree[parts[-1]] = arr
+
     for ours, (suffixes, transform) in weight_map.items():
-        if isinstance(suffixes, str):
+        if isinstance(suffixes, str) or callable(suffixes):
             suffixes = (suffixes,)
-        found = next((s for s in suffixes if s in kweights), None)
+        found = None
+        for s in suffixes:
+            if callable(s):
+                found = next((k for k in kweights if s(k)), None)
+            elif s in kweights:
+                found = s
+            if found is not None:
+                break
         if found is None:
-            if all(s in _OPTIONAL_SUFFIXES for s in suffixes):
+            if all((getattr(s, "optional", False) if callable(s)
+                    else s in _OPTIONAL_SUFFIXES) for s in suffixes):
                 continue
             # A required weight that didn't match would silently leave the
             # layer at its random initialization — refuse instead.
@@ -595,9 +745,9 @@ def _fill_params(weight_map, kweights, layer_cls: str):
         if transform is not None:
             arr = transform(arr)
         if ours.startswith("state:"):
-            state[ours.split(":", 1)[1]] = arr
+            put(state, ours.split(":", 1)[1], arr)
         else:
-            params[ours] = arr
+            put(params, ours, arr)
     return params, state
 
 
@@ -754,20 +904,32 @@ def _import_functional(f, config: dict, updater):
 def _merge_with_init(model, params, state):
     """Initialize then overwrite with imported tensors — guarantees the
     variables pytree has exactly the structure model.apply expects, and
-    shape-checks every imported array against it."""
+    shape-checks every imported array against it. Recurses into nested
+    param groups (wrapper layers like Bidirectional's fwd/bwd)."""
     variables = model.init(seed=0)
+
+    def merge(dst, src, path):
+        for k, v in src.items():
+            if k not in dst:
+                raise KerasImportError(f"{path}: unexpected param {k!r}")
+            if isinstance(v, dict):
+                if not isinstance(dst[k], dict):
+                    raise KerasImportError(
+                        f"{path}.{k}: imported a group where the model "
+                        "expects an array")
+                merge(dst[k], v, f"{path}.{k}")
+                continue
+            want = np.asarray(dst[k]).shape
+            if tuple(v.shape) != tuple(want):
+                raise KerasImportError(
+                    f"{path}.{k}: shape {v.shape} != expected {want}")
+            dst[k] = np.asarray(v, np.asarray(dst[k]).dtype)
+
     for scope, src in (("params", params), ("state", state)):
         dst = variables[scope]
         for lname, ptree in src.items():
             if lname not in dst:
                 raise KerasImportError(
                     f"imported weights for unknown layer {lname!r}")
-            for k, v in ptree.items():
-                if k not in dst[lname]:
-                    raise KerasImportError(f"{lname}: unexpected param {k!r}")
-                want = np.asarray(dst[lname][k]).shape
-                if tuple(v.shape) != tuple(want):
-                    raise KerasImportError(
-                        f"{lname}.{k}: shape {v.shape} != expected {want}")
-                dst[lname][k] = np.asarray(v, np.asarray(dst[lname][k]).dtype)
+            merge(dst[lname], ptree, lname)
     return variables

@@ -270,6 +270,303 @@ def _register_onnximport_ops():
         register_op(f"onnximport.{name}", fn)
 
 
+def _register_onnximport_ops_ext():
+    """Round-4 breadth extension: the op surface real exported models use
+    beyond the classic-CNN/transformer core (recurrent ops, resize,
+    normalizations, multi-output split/topk, extended reductions)."""
+    import jax
+    import jax.numpy as jnp
+
+    def mod(a, b, fmod=0):
+        return jnp.fmod(a, b) if fmod else jnp.mod(a, b)
+
+    def is_inf(x, detect_negative=1, detect_positive=1):
+        pos = jnp.isposinf(x) if detect_positive else jnp.zeros_like(x, bool)
+        neg = jnp.isneginf(x) if detect_negative else jnp.zeros_like(x, bool)
+        return pos | neg
+
+    def thresholded_relu(x, alpha=1.0):
+        return jnp.where(x > alpha, x, 0.0)
+
+    def celu(x, alpha=1.0):
+        return jnp.maximum(x, 0.0) + jnp.minimum(
+            0.0, alpha * (jnp.exp(x / alpha) - 1.0))
+
+    def shrink(x, bias=0.0, lambd=0.5):
+        return jnp.where(x < -lambd, x + bias,
+                         jnp.where(x > lambd, x - bias, 0.0))
+
+    def hard_swish(x):
+        return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+    def mish(x):
+        return x * jnp.tanh(jax.nn.softplus(x))
+
+    def arg_extreme(kind):
+        fn = jnp.argmax if kind == "max" else jnp.argmin
+
+        def f(x, axis=0, keepdims=1):
+            out = fn(x, axis=axis).astype(jnp.int64)
+            if keepdims:
+                out = jnp.expand_dims(out, axis)
+            return out
+
+        return f
+
+    def top_k(x, k, axis=-1, largest=1, sorted=1):  # noqa: A002
+        if axis % x.ndim != x.ndim - 1:
+            x = jnp.moveaxis(x, axis, -1)
+        vals, idx = jax.lax.top_k(-x if not largest else x, int(k))
+        if not largest:
+            vals = -vals
+        if axis % x.ndim != x.ndim - 1:
+            vals = jnp.moveaxis(vals, -1, axis)
+            idx = jnp.moveaxis(idx, -1, axis)
+        return vals, idx.astype(jnp.int64)
+
+    def one_hot(indices, values, *, depth, axis=-1):
+        off, on = values[0], values[1]
+        idx = indices.astype(jnp.int32)
+        idx = jnp.where(idx < 0, idx + int(depth), idx)  # ONNX wraps negatives
+        oh = jax.nn.one_hot(idx, int(depth), axis=axis)
+        return oh * (on - off) + off
+
+    def cumsum(x, axis, exclusive=0, reverse=0):
+        ax = int(axis)
+        if reverse:
+            x = jnp.flip(x, ax)
+        out = jnp.cumsum(x, axis=ax)
+        if exclusive:
+            out = out - x
+        if reverse:
+            out = jnp.flip(out, ax)
+        return out
+
+    def einsum(*xs, equation):
+        return jnp.einsum(equation, *xs)
+
+    def reduce_ext(kind):
+        def f(x, axes=None, keepdims=1, noop_with_empty_axes=0):
+            if axes is None or len(axes) == 0:
+                if noop_with_empty_axes:
+                    return x
+                ax = None
+            else:
+                ax = tuple(int(a) for a in axes)
+            kd = bool(keepdims)
+            if kind == "l1":
+                return jnp.sum(jnp.abs(x), axis=ax, keepdims=kd)
+            if kind == "l2":
+                return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=kd))
+            if kind == "log_sum":
+                return jnp.log(jnp.sum(x, axis=ax, keepdims=kd))
+            if kind == "log_sum_exp":
+                return jax.scipy.special.logsumexp(x, axis=ax, keepdims=kd)
+            if kind == "sum_square":
+                return jnp.sum(jnp.square(x), axis=ax, keepdims=kd)
+            raise ValueError(kind)
+
+        return f
+
+    def depth_to_space(x, blocksize, mode="DCR"):
+        n, c, h, w = x.shape
+        b = blocksize
+        if mode == "DCR":
+            y = x.reshape(n, b, b, c // (b * b), h, w)
+            y = y.transpose(0, 3, 4, 1, 5, 2)
+        else:  # CRD
+            y = x.reshape(n, c // (b * b), b, b, h, w)
+            y = y.transpose(0, 1, 4, 2, 5, 3)
+        return y.reshape(n, c // (b * b), h * b, w * b)
+
+    def space_to_depth(x, blocksize):
+        n, c, h, w = x.shape
+        b = blocksize
+        y = x.reshape(n, c, h // b, b, w // b, b)
+        y = y.transpose(0, 3, 5, 1, 2, 4)
+        return y.reshape(n, c * b * b, h // b, w // b)
+
+    def global_max_pool(x):
+        return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+    def conv_transpose(x, w, b=None, strides=(1, 1), pads=None, group=1):
+        # ONNX/torch weight layout [Cin, Cout/g, *k]; gradient semantics →
+        # lax.conv_transpose(transpose_kernel=True) with IOHW numbers.
+        nd = x.ndim - 2
+        if group != 1:
+            raise NotImplementedError("ConvTranspose group != 1")
+        pads = [(0, 0)] * nd if pads is None else [
+            (int(pads[i]), int(pads[i + nd])) for i in range(nd)]
+        # ONNX weight [Cin, Cout/g, *k] is exactly the FORWARD conv's OIHW
+        # kernel whose input-gradient this op computes; transpose_kernel=
+        # True then swaps I/O and flips spatial axes (torch/Keras
+        # gradient-deconv semantics).
+        dn = (("NCHW", "OIHW", "NCHW") if nd == 2
+              else ("NCDHW", "OIDHW", "NCDHW") if nd == 3
+              else None)
+        if dn is None:
+            raise NotImplementedError(f"ConvTranspose rank {x.ndim}")
+        y = jax.lax.conv_transpose(
+            x, w, strides=tuple(strides), padding=pads,
+            dimension_numbers=dn, transpose_kernel=True)
+        if b is not None:
+            y = y + b.reshape((1, -1) + (1,) * nd)
+        return y
+
+    def instance_norm(x, scale, bias, epsilon=1e-5):
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mean) * jax.lax.rsqrt(var + epsilon)
+                * scale.reshape(shape) + bias.reshape(shape))
+
+    def group_norm(x, scale, bias, num_groups, epsilon=1e-5):
+        n, c = x.shape[:2]
+        spatial = x.shape[2:]
+        g = int(num_groups)
+        y = x.reshape(n, g, c // g, *spatial)
+        axes = tuple(range(2, y.ndim))
+        mean = jnp.mean(y, axis=axes, keepdims=True)
+        var = jnp.var(y, axis=axes, keepdims=True)
+        y = ((y - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return y * scale.reshape(shape) + bias.reshape(shape)
+
+    def split(x, axis=0, split_sizes=None, num_outputs=None):
+        if split_sizes is not None:
+            idxs = np.cumsum(split_sizes)[:-1].tolist()
+            return tuple(jnp.split(x, idxs, axis=axis))
+        return tuple(jnp.split(x, int(num_outputs), axis=axis))
+
+    def gather_elements(x, idx, axis=0):
+        return jnp.take_along_axis(x, idx.astype(jnp.int32), axis=axis)
+
+    def trilu(x, k=0, upper=1):
+        return jnp.triu(x, int(k)) if upper else jnp.tril(x, int(k))
+
+    def resize_nearest_int(x, scales):
+        # integer-factor nearest with asymmetric coords == exact repeat
+        y = x
+        for ax, s in enumerate(scales):
+            if s != 1:
+                y = jnp.repeat(y, int(s), axis=ax)
+        return y
+
+    def resize_linear_half_pixel(x, out_shape):
+        import jax.image
+
+        return jax.image.resize(x, tuple(int(d) for d in out_shape),
+                                method="linear", antialias=False)
+
+    def _lstm_direction(x_tm, w, r, wb, h0, c0, hidden, reverse):
+        """One ONNX LSTM direction: x_tm [T,N,In]; w [4H,In] r [4H,H]
+        b [4H] in ONNX iofc gate blocks. Returns (ys [T,N,H], hT, cT)."""
+        from deeplearning4j_tpu.ops import rnn as opsrnn
+
+        H = hidden
+        order = jnp.concatenate([  # iofc -> ifgo row blocks
+            jnp.arange(0, H), jnp.arange(2 * H, 3 * H),
+            jnp.arange(3 * H, 4 * H), jnp.arange(H, 2 * H)])
+        w_x = jnp.take(w, order, axis=0).T      # [In, 4H]
+        w_h = jnp.take(r, order, axis=0).T      # [H, 4H]
+        b = jnp.take(wb, order, axis=0) if wb is not None else None
+        x_nm = jnp.swapaxes(x_tm, 0, 1)         # [N, T, In]
+        init = None
+        if h0 is not None or c0 is not None:
+            ref = h0 if h0 is not None else c0
+            init = opsrnn.LSTMState(
+                jnp.zeros_like(ref) if h0 is None else h0,
+                jnp.zeros_like(ref) if c0 is None else c0)
+        ys, st = opsrnn.lstm(x_nm, w_x, w_h, b, init_state=init,
+                             reverse=bool(reverse))
+        return jnp.swapaxes(ys, 0, 1), st.h, st.c
+
+    def lstm(*ins, hidden_size, direction="forward", present=()):
+        """ONNX LSTM, layout=0: x [T,N,In], w [D,4H,In], r [D,4H,H],
+        b [D,8H]. Default activations only. Y [T,D,N,H], Y_h/Y_c [D,N,H].
+        ``present`` names which optional inputs follow x/w/r (ONNX leaves
+        gaps via empty-string input refs)."""
+        it = iter(ins)
+        x, w, r = next(it), next(it), next(it)
+        b = next(it) if "b" in present else None
+        h0 = next(it) if "h0" in present else None
+        c0 = next(it) if "c0" in present else None
+        H = int(hidden_size)
+        dirs = 2 if direction == "bidirectional" else 1
+        outs = []
+        for d in range(dirs):
+            wb = None
+            if b is not None:
+                wb = b[d, :4 * H] + b[d, 4 * H:]
+            rev = (direction == "reverse") or d == 1
+            ys, hT, cT = _lstm_direction(
+                x, w[d], r[d], wb,
+                None if h0 is None else h0[d],
+                None if c0 is None else c0[d], H, rev)
+            outs.append((ys, hT, cT))
+        y = jnp.stack([o[0] for o in outs], axis=1)          # [T,D,N,H]
+        y_h = jnp.stack([o[1] for o in outs], axis=0)        # [D,N,H]
+        y_c = jnp.stack([o[2] for o in outs], axis=0)
+        return y, y_h, y_c
+
+    def gru(*ins, hidden_size, direction="forward", present=()):
+        """ONNX GRU, layout=0, linear_before_reset=0, Rb_h must be zero
+        (validated at import): x [T,N,In], w [D,3H,In], r [D,3H,H],
+        b [D,6H]. Y [T,D,N,H], Y_h [D,N,H]."""
+        from deeplearning4j_tpu.ops import rnn as opsrnn
+
+        it = iter(ins)
+        x, w, r = next(it), next(it), next(it)
+        b = next(it) if "b" in present else None
+        h0 = next(it) if "h0" in present else None
+        H = int(hidden_size)
+        dirs = 2 if direction == "bidirectional" else 1
+        order = jnp.concatenate([  # zrh -> rzn row blocks
+            jnp.arange(H, 2 * H), jnp.arange(0, H),
+            jnp.arange(2 * H, 3 * H)])
+        ys_all, h_all = [], []
+        for d in range(dirs):
+            w_x = jnp.take(w[d], order, axis=0).T
+            w_h = jnp.take(r[d], order, axis=0).T
+            bb = None
+            if b is not None:
+                wb, rb = b[d, :3 * H], b[d, 3 * H:]
+                bb = jnp.take(wb, order, axis=0) + jnp.concatenate(
+                    [jnp.take(rb, order, axis=0)[:2 * H], jnp.zeros((H,))])
+            rev = (direction == "reverse") or d == 1
+            x_nm = jnp.swapaxes(x, 0, 1)
+            ys, hT = opsrnn.gru(x_nm, w_x, w_h, bb,
+                                init_h=None if h0 is None else h0[d],
+                                reverse=rev)
+            ys_all.append(jnp.swapaxes(ys, 0, 1))
+            h_all.append(hT)
+        return (jnp.stack(ys_all, axis=1), jnp.stack(h_all, axis=0))
+
+    for name, fn in {
+        "mod": mod, "is_inf": is_inf, "thresholded_relu": thresholded_relu,
+        "celu": celu, "shrink": shrink, "hard_swish": hard_swish,
+        "mish": mish,
+        "argmax": arg_extreme("max"), "argmin": arg_extreme("min"),
+        "top_k": top_k, "one_hot": one_hot, "cumsum": cumsum,
+        "einsum": einsum,
+        "reduce_l1": reduce_ext("l1"), "reduce_l2": reduce_ext("l2"),
+        "reduce_log_sum": reduce_ext("log_sum"),
+        "reduce_log_sum_exp": reduce_ext("log_sum_exp"),
+        "reduce_sum_square": reduce_ext("sum_square"),
+        "depth_to_space": depth_to_space, "space_to_depth": space_to_depth,
+        "global_max_pool": global_max_pool,
+        "conv_transpose": conv_transpose,
+        "instance_norm": instance_norm, "group_norm": group_norm,
+        "split": split, "gather_elements": gather_elements, "trilu": trilu,
+        "resize_nearest_int": resize_nearest_int,
+        "resize_linear_half_pixel": resize_linear_half_pixel,
+        "lstm": lstm, "gru": gru,
+        "tile": lambda x, repeats: jnp.tile(x, tuple(int(r) for r in repeats)),
+    }.items():
+        register_op(f"onnximport.{name}", fn)
+
+
 _ONNX_OPS_READY = False
 
 
@@ -277,6 +574,7 @@ def ensure_onnximport_ops():
     global _ONNX_OPS_READY
     if not _ONNX_OPS_READY:
         _register_onnximport_ops()
+        _register_onnximport_ops_ext()
         _ONNX_OPS_READY = True
 
 
@@ -605,6 +903,356 @@ def _constant(imp, node):
         raise ONNXImportError(f"Constant node {node.name!r}: no value attr")
     imp.consts[node.output[0]] = arr
     return imp.sd.constant(imp.fresh_const_name(node.name or "const"), arr)
+
+
+# --- round-4 breadth mappers ----------------------------------------------
+
+for onnx_name, our_op in {
+    "Tan": "math.tan", "Asin": "math.asin", "Acos": "math.acos",
+    "Atan": "math.atan", "Sinh": "math.sinh", "Cosh": "math.cosh",
+    "Asinh": "math.asinh", "Acosh": "math.acosh", "Atanh": "math.atanh",
+    "Reciprocal": "math.reciprocal", "Not": "math.logical_not",
+    "And": "math.logical_and", "Or": "math.logical_or",
+    "Xor": "math.logical_xor", "IsNaN": "math.is_nan",
+    "Selu": "selu", "Softsign": "softsign",
+    "Mish": "onnximport.mish", "HardSwish": "onnximport.hard_swish",
+    "GlobalMaxPool": "onnximport.global_max_pool",
+}.items():
+    ONNX_OP_MAPPERS[onnx_name] = _simple(our_op)
+
+
+@onnx_op("Mod")
+def _mod(imp, node):
+    ins = [imp.tensor(r) for r in node.input]
+    return _rec(imp, "onnximport.mod", ins,
+                fmod=node.attrs().get("fmod", 0))
+
+
+@onnx_op("IsInf")
+def _is_inf(imp, node):
+    a = node.attrs()
+    return _rec(imp, "onnximport.is_inf", [imp.tensor(node.input[0])],
+                detect_negative=a.get("detect_negative", 1),
+                detect_positive=a.get("detect_positive", 1))
+
+
+@onnx_op("ThresholdedRelu")
+def _thresholded_relu(imp, node):
+    return _rec(imp, "onnximport.thresholded_relu",
+                [imp.tensor(node.input[0])],
+                alpha=node.attrs().get("alpha", 1.0))
+
+
+@onnx_op("Celu")
+def _celu(imp, node):
+    return _rec(imp, "onnximport.celu", [imp.tensor(node.input[0])],
+                alpha=node.attrs().get("alpha", 1.0))
+
+
+@onnx_op("Shrink")
+def _shrink(imp, node):
+    a = node.attrs()
+    return _rec(imp, "onnximport.shrink", [imp.tensor(node.input[0])],
+                bias=a.get("bias", 0.0), lambd=a.get("lambd", 0.5))
+
+
+@onnx_op("ArgMax", "ArgMin")
+def _argextreme(imp, node):
+    a = node.attrs()
+    if a.get("select_last_index", 0):
+        raise ONNXImportError(f"{node.op_type} select_last_index unsupported")
+    op = "onnximport.argmax" if node.op_type == "ArgMax" else "onnximport.argmin"
+    return _rec(imp, op, [imp.tensor(node.input[0])],
+                axis=a.get("axis", 0), keepdims=a.get("keepdims", 1))
+
+
+@onnx_op("TopK")
+def _topk(imp, node):
+    a = node.attrs()
+    k = int(imp.const_value(node.input[1]).reshape(-1)[0])
+    return _rec(imp, "onnximport.top_k", [imp.tensor(node.input[0])],
+                k=k, axis=a.get("axis", -1), largest=a.get("largest", 1),
+                sorted=a.get("sorted", 1))
+
+
+@onnx_op("OneHot")
+def _one_hot(imp, node):
+    depth = int(imp.const_value(node.input[1]).reshape(-1)[0])
+    ins = [imp.tensor(node.input[0]), imp.tensor(node.input[2])]
+    return imp.sd._record("onnximport.one_hot", ins, {
+        "__argspec__": ["var", "var"], "__posattrs__": [],
+        "depth": depth, "axis": node.attrs().get("axis", -1)})
+
+
+@onnx_op("Range")
+def _range(imp, node):
+    start, limit, delta = (imp.const_value(r).reshape(()) for r in node.input)
+    arr = np.arange(start, limit, delta)
+    imp.consts[node.output[0]] = arr
+    return imp.sd.constant(imp.fresh_const_name(node.name or "range"), arr)
+
+
+@onnx_op("ConstantOfShape")
+def _const_of_shape(imp, node):
+    shape = [int(v) for v in imp.const_value(node.input[0]).reshape(-1)]
+    a = {at.name: at for at in node.attribute}
+    if "value" in a and a["value"].type == ATTR_TENSOR:
+        fill = a["value"].t.to_numpy().reshape(-1)[0]
+    else:
+        fill = np.float32(0.0)
+    arr = np.full(shape, fill)
+    imp.consts[node.output[0]] = arr
+    return imp.sd.constant(imp.fresh_const_name(node.name or "cofs"), arr)
+
+
+@onnx_op("CumSum")
+def _cumsum(imp, node):
+    a = node.attrs()
+    axis = int(imp.const_value(node.input[1]).reshape(-1)[0])
+    return _rec(imp, "onnximport.cumsum", [imp.tensor(node.input[0])],
+                axis=axis, exclusive=a.get("exclusive", 0),
+                reverse=a.get("reverse", 0))
+
+
+@onnx_op("Einsum")
+def _einsum(imp, node):
+    ins = [imp.tensor(r) for r in node.input]
+    return _rec(imp, "onnximport.einsum", ins,
+                equation=node.attrs()["equation"])
+
+
+@onnx_op("ReduceL1", "ReduceL2", "ReduceLogSum", "ReduceLogSumExp",
+         "ReduceSumSquare")
+def _reduce_ext(imp, node):
+    kind = {"ReduceL1": "l1", "ReduceL2": "l2", "ReduceLogSum": "log_sum",
+            "ReduceLogSumExp": "log_sum_exp",
+            "ReduceSumSquare": "sum_square"}[node.op_type]
+    a = node.attrs()
+    axes = _axes_attr_or_input(imp, node)
+    return _rec(imp, f"onnximport.reduce_{kind}", [imp.tensor(node.input[0])],
+                axes=axes, keepdims=a.get("keepdims", 1),
+                noop_with_empty_axes=a.get("noop_with_empty_axes", 0))
+
+
+@onnx_op("DepthToSpace")
+def _depth_to_space(imp, node):
+    a = node.attrs()
+    return _rec(imp, "onnximport.depth_to_space", [imp.tensor(node.input[0])],
+                blocksize=a["blocksize"], mode=a.get("mode", "DCR"))
+
+
+@onnx_op("SpaceToDepth")
+def _space_to_depth(imp, node):
+    return _rec(imp, "onnximport.space_to_depth", [imp.tensor(node.input[0])],
+                blocksize=node.attrs()["blocksize"])
+
+
+@onnx_op("ConvTranspose")
+def _conv_transpose(imp, node):
+    a = node.attrs()
+    if a.get("auto_pad", "NOTSET") != "NOTSET":
+        raise ONNXImportError("ConvTranspose auto_pad unsupported")
+    if any(a.get("output_padding", [])):
+        raise ONNXImportError("ConvTranspose output_padding unsupported")
+    if "output_shape" in a:
+        raise ONNXImportError("ConvTranspose output_shape unsupported")
+    if a.get("group", 1) != 1:
+        raise ONNXImportError("ConvTranspose group != 1 unsupported")
+    ins = [imp.tensor(r) for r in node.input if r]
+    w_shape = ins[1].shape
+    nd = (len(a["kernel_shape"]) if "kernel_shape" in a
+          else len(w_shape) - 2 if w_shape else 2)
+    if nd not in (2, 3):
+        raise ONNXImportError(f"ConvTranspose spatial rank {nd} unsupported")
+    return _rec(imp, "onnximport.conv_transpose", ins,
+                strides=a.get("strides", [1] * nd), pads=a.get("pads"),
+                group=1)
+
+
+@onnx_op("InstanceNormalization")
+def _instance_norm(imp, node):
+    ins = [imp.tensor(r) for r in node.input[:3]]
+    return _rec(imp, "onnximport.instance_norm", ins,
+                epsilon=node.attrs().get("epsilon", 1e-5))
+
+
+@onnx_op("GroupNormalization")
+def _group_norm(imp, node):
+    a = node.attrs()
+    ins = [imp.tensor(r) for r in node.input[:3]]
+    return _rec(imp, "onnximport.group_norm", ins,
+                num_groups=a["num_groups"], epsilon=a.get("epsilon", 1e-5))
+
+
+@onnx_op("Split")
+def _split(imp, node):
+    a = node.attrs()
+    split_sizes = a.get("split")
+    if split_sizes is None and len(node.input) > 1 and node.input[1]:
+        split_sizes = [int(v)
+                       for v in imp.const_value(node.input[1]).reshape(-1)]
+    return _rec(imp, "onnximport.split", [imp.tensor(node.input[0])],
+                axis=a.get("axis", 0), split_sizes=split_sizes,
+                num_outputs=a.get("num_outputs", len(node.output)))
+
+
+@onnx_op("Tile")
+def _tile(imp, node):
+    repeats = [int(v) for v in imp.const_value(node.input[1]).reshape(-1)]
+    return _rec(imp, "onnximport.tile", [imp.tensor(node.input[0])],
+                repeats=repeats)
+
+
+@onnx_op("GatherElements")
+def _gather_elements(imp, node):
+    ins = [imp.tensor(node.input[0]), imp.tensor(node.input[1])]
+    return _rec(imp, "onnximport.gather_elements", ins,
+                axis=node.attrs().get("axis", 0))
+
+
+@onnx_op("Trilu")
+def _trilu(imp, node):
+    k = 0
+    if len(node.input) > 1 and node.input[1]:
+        k = int(imp.const_value(node.input[1]).reshape(-1)[0])
+    return _rec(imp, "onnximport.trilu", [imp.tensor(node.input[0])],
+                k=k, upper=node.attrs().get("upper", 1))
+
+
+def _resize_scales_sizes(imp, node, x):
+    """Resolve (scales, out_shape) from a Resize/Upsample node's inputs."""
+    scales = sizes = None
+    # Resize inputs: X, roi, scales, sizes (any of roi/scales empty).
+    if node.op_type == "Upsample":
+        if len(node.input) > 1 and node.input[1]:
+            scales = [float(v)
+                      for v in imp.const_value(node.input[1]).reshape(-1)]
+        else:
+            scales = list(node.attrs().get("scales", []))
+    else:
+        if len(node.input) > 2 and node.input[2]:
+            scales = [float(v)
+                      for v in imp.const_value(node.input[2]).reshape(-1)]
+        if len(node.input) > 3 and node.input[3]:
+            sizes = [int(v)
+                     for v in imp.const_value(node.input[3]).reshape(-1)]
+    if scales is not None and len(scales) == 0:
+        scales = None
+    if scales is None and sizes is None:
+        raise ONNXImportError(f"{node.op_type}: needs scales or sizes")
+    if x.shape is None or any(d is None for d in x.shape):
+        # both conversions below need concrete dims
+        raise ONNXImportError(
+            f"{node.op_type}: input shape must be fully static at import "
+            f"(got {x.shape})")
+    if sizes is None:
+        sizes = [int(round(d * s)) for d, s in zip(x.shape, scales)]
+    if scales is None:
+        scales = [o / d for o, d in zip(sizes, x.shape)]
+    return scales, sizes
+
+
+@onnx_op("Resize", "Upsample")
+def _resize(imp, node):
+    a = node.attrs()
+    mode = a.get("mode", "nearest")
+    coord = a.get("coordinate_transformation_mode",
+                  "asymmetric" if node.op_type == "Upsample" else "half_pixel")
+    x = imp.tensor(node.input[0])
+    scales, sizes = _resize_scales_sizes(imp, node, x)
+    if mode == "nearest":
+        # exact only for integer upscale factors with asymmetric coords +
+        # floor rounding (the classic Upsample) — the repeat identity
+        if coord not in ("asymmetric",):
+            raise ONNXImportError(
+                f"Resize nearest with coordinate mode {coord!r} unsupported "
+                "(asymmetric only)")
+        if a.get("nearest_mode", "round_prefer_floor") not in (
+                "floor", "round_prefer_floor"):
+            raise ONNXImportError("Resize nearest_mode unsupported")
+        if any(abs(s - round(s)) > 1e-6 or s < 1 for s in scales):
+            raise ONNXImportError(
+                f"Resize nearest with non-integer scales {scales} unsupported")
+        return _rec(imp, "onnximport.resize_nearest_int", [x],
+                    scales=[int(round(s)) for s in scales])
+    if mode == "linear":
+        if coord != "half_pixel":
+            raise ONNXImportError(
+                f"Resize linear with coordinate mode {coord!r} unsupported "
+                "(half_pixel only)")
+        return _rec(imp, "onnximport.resize_linear_half_pixel", [x],
+                    out_shape=sizes)
+    raise ONNXImportError(f"Resize mode {mode!r} unsupported")
+
+
+def _rnn_common(imp, node, n_gates):
+    a = node.attrs()
+    if a.get("layout", 0) != 0:
+        raise ONNXImportError(f"{node.op_type} layout=1 unsupported")
+    if "activations" in a:
+        defaults = {2: [b"Sigmoid", b"Tanh"],
+                    3: [b"Sigmoid", b"Tanh", b"Tanh"]}[n_gates]
+        acts = [v if isinstance(v, bytes) else v.encode()
+                for v in a["activations"]]
+        dirs = 2 if a.get("direction", "forward") == "bidirectional" else 1
+        if acts != defaults * dirs:
+            raise ONNXImportError(
+                f"{node.op_type} non-default activations {acts} unsupported")
+    if "clip" in a:
+        raise ONNXImportError(f"{node.op_type} clip unsupported")
+    if len(node.input) > 4 and node.input[4]:
+        raise ONNXImportError(
+            f"{node.op_type} sequence_lens input unsupported")
+    direction = a.get("direction", "forward")
+    if direction not in ("forward", "reverse", "bidirectional"):
+        raise ONNXImportError(f"{node.op_type} direction {direction!r}")
+    return a, direction
+
+
+@onnx_op("LSTM")
+def _lstm(imp, node):
+    a, direction = _rnn_common(imp, node, n_gates=3)
+    if a.get("input_forget", 0):
+        raise ONNXImportError("LSTM input_forget unsupported")
+    if len(node.input) > 7 and node.input[7]:
+        raise ONNXImportError("LSTM peephole input P unsupported")
+    ins = [imp.tensor(node.input[i]) for i in range(3)]
+    present = []
+    for idx, tag in ((3, "b"), (5, "h0"), (6, "c0")):
+        if len(node.input) > idx and node.input[idx]:
+            ins.append(imp.tensor(node.input[idx]))
+            present.append(tag)
+    return _rec(imp, "onnximport.lstm", ins,
+                hidden_size=a["hidden_size"], direction=direction,
+                present=present)
+
+
+@onnx_op("GRU")
+def _gru(imp, node):
+    a, direction = _rnn_common(imp, node, n_gates=2)
+    if a.get("linear_before_reset", 0):
+        raise ONNXImportError("GRU linear_before_reset=1 unsupported")
+    H = a["hidden_size"]
+    ins = [imp.tensor(node.input[i]) for i in range(3)]
+    present = []
+    if len(node.input) > 3 and node.input[3]:
+        # our gru_cell adds the candidate bias OUTSIDE the reset gate; that
+        # matches ONNX linear_before_reset=0 only when Rb_h == 0 — verify
+        # on the host-known initializer rather than import wrong math
+        bval = imp.consts.get(node.input[3])
+        if bval is None:
+            raise ONNXImportError("GRU bias must be an initializer")
+        if np.any(bval[:, 5 * H:6 * H] != 0):
+            raise ONNXImportError(
+                "GRU with nonzero recurrent candidate bias Rb_h is "
+                "unsupported (linear_before_reset=0 semantics differ)")
+        ins.append(imp.tensor(node.input[3]))
+        present.append("b")
+    if len(node.input) > 5 and node.input[5]:
+        ins.append(imp.tensor(node.input[5]))
+        present.append("h0")
+    return _rec(imp, "onnximport.gru", ins,
+                hidden_size=H, direction=direction, present=present)
 
 
 @onnx_op("Dropout")

@@ -1,0 +1,86 @@
+"""Mixed-precision lowering regression gates.
+
+The bench configs' MFU depends on every conv/matmul hitting the MXU in
+bf16; r3's ResNet MFU hunt showed how easily a silent fp32 upcast could
+hide in a 160 ms step. These tests lower REAL train steps (trace only —
+no compile/execute) and assert the StableHLO contains no fp32/f64
+convolutions or dot_generals under mixed_precision, pinning the dtype
+policy in CI instead of on-chip archaeology. (The full bench-size models
+are too slow to trace in CI; these are shrunken same-shape stand-ins —
+same layers, same Trainer cast path.)
+"""
+
+import re
+from collections import Counter
+
+import jax
+import numpy as np
+import pytest
+
+
+def _op_out_dtypes(txt, op):
+    return Counter(re.findall(
+        rf"stablehlo\.{op}.*?->\s*tensor<[^>]*x(\w+)>", txt))
+
+
+def _lower_step(trainer, ts, batch):
+    return jax.jit(trainer._raw_step).lower(ts, batch).as_text()
+
+
+def test_conv_net_mixed_precision_all_bf16():
+    from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                              SequentialConfig)
+    from deeplearning4j_tpu.nn.layers import (BatchNorm, Conv2D, Dense,
+                                              GlobalPooling, OutputLayer)
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    cfg = SequentialConfig(
+        net=NeuralNetConfiguration(updater=Adam(1e-3), mixed_precision=True),
+        input_shape=(16, 16, 3),
+        layers=[Conv2D(filters=8, kernel=3, stride=2),
+                BatchNorm(activation="relu"),
+                Conv2D(filters=16, kernel=3),
+                BatchNorm(activation="relu"),
+                GlobalPooling(),
+                Dense(units=16, activation="relu"),
+                OutputLayer(units=4, loss="mcxent", activation="softmax")],
+    )
+    model = SequentialModel(cfg)
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    r = np.random.default_rng(0)
+    batch = {"features": np.asarray(r.normal(size=(4, 16, 16, 3)),
+                                    np.float32),
+             "labels": np.eye(4, dtype=np.float32)[r.integers(0, 4, 4)]}
+    txt = _lower_step(trainer, ts, batch)
+
+    convs = _op_out_dtypes(txt, "convolution")
+    assert convs, "no convolutions found in lowered step"
+    assert set(convs) == {"bf16"}, f"non-bf16 convs: {convs}"
+    assert "xf64" not in txt
+
+
+def test_transformer_mixed_precision_dots_bf16():
+    from deeplearning4j_tpu.models.bert import Bert, BertConfig, make_mlm_batch
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    cfg = BertConfig(vocab_size=64, hidden=32, num_layers=2, num_heads=2,
+                     intermediate=64, max_position=32,
+                     net=NeuralNetConfiguration(updater=Adam(1e-4),
+                                                mixed_precision=True))
+    model = Bert(cfg)
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    batch = make_mlm_batch(0, batch_size=2, seq_len=16, vocab_size=64)
+    txt = _lower_step(trainer, ts, batch)
+
+    dots = _op_out_dtypes(txt, "dot_general")
+    assert dots, "no dot_generals found in lowered step"
+    # fp32 dots under mixed precision = silent MXU slowdown; bf16 only
+    assert set(dots) == {"bf16"}, f"non-bf16 dots: {dots}"
+    assert "tpu_custom_call" not in txt  # T=16 < flash_min_seq → XLA path
+    assert "xf64" not in txt

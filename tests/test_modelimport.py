@@ -512,3 +512,61 @@ class TestKerasCustomLayerSPI:
         # registry restored: the strict-refusal behavior is back
         with pytest.raises(KerasImportError, match="no mapper"):
             import_keras_model(_save(km, tmp_path, "m2.h5"))
+
+
+class TestKerasRound4Tail:
+    def test_bidirectional_lstm(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((7, 5)),
+            tf.keras.layers.Bidirectional(
+                tf.keras.layers.LSTM(6, return_sequences=True)),
+            tf.keras.layers.Bidirectional(tf.keras.layers.LSTM(4)),
+            tf.keras.layers.Dense(3, activation="softmax"),
+        ])
+        x = np.random.default_rng(0).normal(size=(2, 7, 5)).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x, rtol=1e-3, atol=1e-4)
+
+    def test_bidirectional_merge_modes(self, tmp_path):
+        for mode in ("sum", "mul", "ave"):
+            km = tf.keras.Sequential([
+                tf.keras.layers.Input((5, 4)),
+                tf.keras.layers.Bidirectional(
+                    tf.keras.layers.SimpleRNN(6, return_sequences=True),
+                    merge_mode=mode),
+            ])
+            x = np.random.default_rng(1).normal(size=(2, 5, 4)).astype(
+                np.float32)
+            _compare_keras(km, _save(km, tmp_path, f"m_{mode}.h5"), x,
+                           rtol=1e-3, atol=1e-4)
+
+    def test_pool3d_upsample3d_pad3d(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 6, 6, 2)),
+            tf.keras.layers.ZeroPadding3D(1),
+            tf.keras.layers.MaxPooling3D(2),
+            tf.keras.layers.UpSampling3D(2),
+            tf.keras.layers.Cropping3D(1),
+            tf.keras.layers.AveragePooling3D(2),
+            tf.keras.layers.GlobalAveragePooling3D(),
+        ])
+        x = np.random.default_rng(2).normal(size=(2, 4, 6, 6, 2)).astype(
+            np.float32)
+        _compare_keras(km, _save(km, tmp_path), x)
+
+    def test_global_max_pool3d(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((3, 4, 4, 2)),
+            tf.keras.layers.GlobalMaxPooling3D(),
+        ])
+        x = np.random.default_rng(3).normal(size=(2, 3, 4, 4, 2)).astype(
+            np.float32)
+        _compare_keras(km, _save(km, tmp_path), x)
+
+    def test_masking_refuses_nonzero(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 3)),
+            tf.keras.layers.Masking(mask_value=2.0),
+            tf.keras.layers.SimpleRNN(4),
+        ])
+        with pytest.raises(KerasImportError, match="mask_value"):
+            import_keras_model(_save(km, tmp_path))

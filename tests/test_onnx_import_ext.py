@@ -1,0 +1,406 @@
+"""ONNX import breadth-extension tests (round 4).
+
+Same oracle discipline as test_onnx_import.py: fixture models built with
+the dependency-free codec, numerics pinned against torch (independent
+framework) where torch has the op, numpy closed forms elsewhere, and
+strict-refusal checks for the documented unsupported corners."""
+
+import numpy as np
+import pytest
+import torch
+
+from deeplearning4j_tpu.modelimport.onnx import (ONNXImportError,
+                                                 import_onnx_model)
+
+from tests.test_onnx_import import _model, _node, _run, _vi
+
+
+def _import_single(nodes, inputs, outputs, initializers=(), **kw):
+    m = _model(nodes, inputs, outputs, initializers=initializers, **kw)
+    return import_onnx_model(m.encode())
+
+
+def _eval1(op_type, x, out_shape=None, extra_inits=(), extra_inputs=(),
+           **attrs):
+    """Single-node graph: float input 'x' (+ optional const inputs) → 'y'."""
+    ins = ["x"] + [n for n, _ in extra_inits] + list(extra_inputs)
+    nodes = [_node(op_type, ins, ["y"], **attrs)]
+    sd, in_map, out_map = _import_single(
+        nodes, [_vi("x", x.shape)], [_vi("y", out_shape or x.shape)],
+        initializers=list(extra_inits))
+    return _run(sd, out_map, {"x": x}, "y")
+
+
+_R = np.random.default_rng(0)
+
+
+def test_trig_family_and_reciprocal():
+    x = _R.uniform(0.2, 0.8, (3, 4)).astype(np.float32)
+    for op, fn in [("Tan", np.tan), ("Asin", np.arcsin), ("Acos", np.arccos),
+                   ("Atan", np.arctan), ("Sinh", np.sinh), ("Cosh", np.cosh),
+                   ("Asinh", np.arcsinh), ("Atanh", np.arctanh),
+                   ("Reciprocal", lambda v: 1.0 / v)]:
+        got = _eval1(op, x)
+        np.testing.assert_allclose(got, fn(x), rtol=1e-5, atol=1e-6, err_msg=op)
+    xg = (1.0 + np.abs(x)).astype(np.float32)
+    np.testing.assert_allclose(_eval1("Acosh", xg), np.arccosh(xg), rtol=1e-5)
+
+
+def test_activation_tail_vs_torch():
+    x = _R.normal(size=(4, 5)).astype(np.float32)
+    cases = [
+        ("Selu", torch.nn.functional.selu, {}),
+        ("Softsign", torch.nn.functional.softsign, {}),
+        ("Mish", torch.nn.functional.mish, {}),
+        ("HardSwish", torch.nn.functional.hardswish, {}),
+        ("Celu", lambda t: torch.nn.functional.celu(t, alpha=1.4),
+         {"alpha": 1.4}),
+    ]
+    for op, tfn, attrs in cases:
+        got = _eval1(op, x, **attrs)
+        want = tfn(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6, err_msg=op)
+    got = _eval1("ThresholdedRelu", x, alpha=0.5)
+    np.testing.assert_allclose(got, np.where(x > 0.5, x, 0.0))
+    got = _eval1("Shrink", x, bias=0.1, lambd=0.4)
+    np.testing.assert_allclose(
+        got, np.where(x < -0.4, x + 0.1, np.where(x > 0.4, x - 0.1, 0.0)),
+        rtol=1e-6, atol=1e-7)
+
+
+def test_logical_and_special_values():
+    a = (_R.integers(0, 2, (3, 4)) > 0)
+    b = (_R.integers(0, 2, (3, 4)) > 0)
+    for op, fn in [("And", np.logical_and), ("Or", np.logical_or),
+                   ("Xor", np.logical_xor)]:
+        nodes = [_node(op, ["a", "b"], ["y"])]
+        sd, _, out_map = _import_single(
+            nodes, [_vi("a", a.shape, 9), _vi("b", b.shape, 9)],
+            [_vi("y", a.shape, 9)])
+        got = _run(sd, out_map, {"a": a, "b": b}, "y")
+        np.testing.assert_array_equal(got.astype(bool), fn(a, b), err_msg=op)
+
+    x = np.array([1.0, np.nan, np.inf, -np.inf], np.float32)
+    np.testing.assert_array_equal(
+        _eval1("IsNaN", x).astype(bool), np.isnan(x))
+    np.testing.assert_array_equal(
+        _eval1("IsInf", x).astype(bool), np.isinf(x))
+    np.testing.assert_array_equal(
+        _eval1("IsInf", x, detect_negative=0).astype(bool), np.isposinf(x))
+
+
+def test_mod_fmod():
+    a = _R.integers(-10, 10, (3, 4)).astype(np.float32)
+    b = np.full((3, 4), 3.0, np.float32)
+    nodes = [_node("Mod", ["a", "b"], ["y"], fmod=1)]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("a", a.shape), _vi("b", b.shape)], [_vi("y", a.shape)])
+    got = _run(sd, out_map, {"a": a, "b": b}, "y")
+    np.testing.assert_allclose(got, np.fmod(a, b))
+
+
+def test_argmax_topk():
+    x = _R.permutation(24).reshape(4, 6).astype(np.float32)
+    got = _eval1("ArgMax", x, out_shape=(4, 1), axis=1)
+    np.testing.assert_array_equal(got[:, 0], np.argmax(x, 1))
+    got = _eval1("ArgMin", x, out_shape=(4, 6), axis=0, keepdims=0)
+    np.testing.assert_array_equal(got, np.argmin(x, 0))
+
+    nodes = [_node("TopK", ["x", "k"], ["vals", "idx"], axis=-1, largest=1)]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("x", x.shape)],
+        [_vi("vals", (4, 3)), _vi("idx", (4, 3), 7)],
+        initializers=[("k", np.asarray([3], np.int64))])
+    vals = _run(sd, out_map, {"x": x}, "vals")
+    tv, _ = torch.topk(torch.tensor(x), 3, dim=-1)
+    np.testing.assert_allclose(vals, tv.numpy())
+
+
+def test_reduce_extensions():
+    x = _R.uniform(0.1, 2.0, (3, 4, 5)).astype(np.float32)
+    for op, fn in [
+        ("ReduceL1", lambda v: np.abs(v).sum(1, keepdims=True)),
+        ("ReduceL2", lambda v: np.sqrt((v ** 2).sum(1, keepdims=True))),
+        ("ReduceLogSum", lambda v: np.log(v.sum(1, keepdims=True))),
+        ("ReduceLogSumExp",
+         lambda v: np.log(np.exp(v).sum(1, keepdims=True))),
+        ("ReduceSumSquare", lambda v: (v ** 2).sum(1, keepdims=True)),
+    ]:
+        got = _eval1(op, x, out_shape=(3, 1, 5), axes=[1])
+        np.testing.assert_allclose(got, fn(x), rtol=1e-5, err_msg=op)
+
+
+def test_cumsum_einsum_tile_trilu_gather_elements():
+    x = _R.normal(size=(3, 5)).astype(np.float32)
+    got = _eval1("CumSum", x, extra_inits=[("ax", np.asarray([1], np.int64))])
+    np.testing.assert_allclose(got, np.cumsum(x, 1), rtol=1e-6)
+    got = _eval1("CumSum", x, extra_inits=[("ax", np.asarray([1], np.int64))],
+                 exclusive=1, reverse=1)
+    want = np.flip(np.cumsum(np.flip(x, 1), 1) - np.flip(x, 1), 1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    a = _R.normal(size=(3, 4)).astype(np.float32)
+    b = _R.normal(size=(4, 5)).astype(np.float32)
+    nodes = [_node("Einsum", ["a", "b"], ["y"], equation="ij,jk->ik")]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("a", a.shape), _vi("b", b.shape)], [_vi("y", (3, 5))])
+    np.testing.assert_allclose(_run(sd, out_map, {"a": a, "b": b}, "y"),
+                               a @ b, rtol=1e-5, atol=1e-5)
+
+    got = _eval1("Tile", x, out_shape=(6, 5),
+                 extra_inits=[("reps", np.asarray([2, 1], np.int64))])
+    np.testing.assert_array_equal(got, np.tile(x, (2, 1)))
+
+    sq = _R.normal(size=(4, 4)).astype(np.float32)
+    np.testing.assert_array_equal(_eval1("Trilu", sq, upper=1), np.triu(sq))
+    got = _eval1("Trilu", sq, upper=0,
+                 extra_inits=[("k", np.asarray([-1], np.int64))])
+    np.testing.assert_array_equal(got, np.tril(sq, -1))
+
+    idx = _R.integers(0, 3, (3, 5)).astype(np.int64)
+    nodes = [_node("GatherElements", ["x", "i"], ["y"], axis=0)]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("x", x.shape), _vi("i", idx.shape, 7)],
+        [_vi("y", idx.shape)])
+    got = _run(sd, out_map, {"x": x, "i": idx}, "y")
+    np.testing.assert_array_equal(got, np.take_along_axis(x, idx, 0))
+
+
+def test_onehot_range_constantofshape():
+    idx = np.asarray([0, 2, 1], np.int64)
+    nodes = [_node("OneHot", ["i", "depth", "vals"], ["y"], axis=-1)]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("i", idx.shape, 7)], [_vi("y", (3, 4))],
+        initializers=[("depth", np.asarray([4], np.int64)),
+                      ("vals", np.asarray([0.5, 2.0], np.float32))])
+    got = _run(sd, out_map, {"i": idx}, "y")
+    want = np.full((3, 4), 0.5, np.float32)
+    want[np.arange(3), idx] = 2.0
+    np.testing.assert_allclose(got, want)
+
+    nodes = [_node("Range", ["s", "l", "d"], ["y"]),
+             _node("Add", ["x", "y"], ["z"])]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("x", (5,))], [_vi("z", (5,))],
+        initializers=[("s", np.asarray(0.0, np.float32)),
+                      ("l", np.asarray(5.0, np.float32)),
+                      ("d", np.asarray(1.0, np.float32))])
+    got = _run(sd, out_map, {"x": np.zeros(5, np.float32)}, "z")
+    np.testing.assert_allclose(got, np.arange(5, dtype=np.float32))
+
+    nodes = [_node("ConstantOfShape", ["shp"], ["y"],
+                   value=np.asarray([7.0], np.float32)),
+             _node("Add", ["x", "y"], ["z"])]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("x", (2, 3))], [_vi("z", (2, 3))],
+        initializers=[("shp", np.asarray([2, 3], np.int64))])
+    got = _run(sd, out_map, {"x": np.zeros((2, 3), np.float32)}, "z")
+    np.testing.assert_allclose(got, np.full((2, 3), 7.0))
+
+
+def test_space_depth_roundtrip_and_vs_torch():
+    x = _R.normal(size=(2, 8, 4, 6)).astype(np.float32)
+    got = _eval1("DepthToSpace", x, out_shape=(2, 2, 8, 12), blocksize=2,
+                 mode="DCR")
+    want = torch.nn.functional.pixel_shuffle(torch.tensor(x), 2).numpy()
+    # ONNX CRD == torch pixel_shuffle; DCR is the ONNX default order
+    got_crd = _eval1("DepthToSpace", x, out_shape=(2, 2, 8, 12), blocksize=2,
+                     mode="CRD")
+    np.testing.assert_allclose(got_crd, want, rtol=1e-6)
+    # DCR pinned by round-trip through SpaceToDepth
+    nodes = [_node("DepthToSpace", ["x"], ["m"], blocksize=2, mode="DCR"),
+             _node("SpaceToDepth", ["m"], ["y"], blocksize=2)]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("x", x.shape)], [_vi("y", x.shape)])
+    back = _run(sd, out_map, {"x": x}, "y")
+    np.testing.assert_allclose(back, x)
+    assert got.shape == (2, 2, 8, 12)
+
+
+def test_global_max_pool_vs_torch():
+    x = _R.normal(size=(2, 3, 5, 7)).astype(np.float32)
+    got = _eval1("GlobalMaxPool", x, out_shape=(2, 3, 1, 1))
+    want = torch.nn.functional.adaptive_max_pool2d(torch.tensor(x), 1).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_conv_transpose_vs_torch():
+    x = _R.normal(size=(2, 3, 5, 5)).astype(np.float32)
+    w = (0.3 * _R.normal(size=(3, 4, 3, 3))).astype(np.float32)  # [Cin,Cout,k,k]
+    b = _R.normal(size=(4,)).astype(np.float32)
+    got = _eval1("ConvTranspose", x, out_shape=(2, 4, 9, 9),
+                 extra_inits=[("w", w), ("b", b)],
+                 strides=[2, 2], pads=[1, 1, 1, 1])
+    want = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2,
+        padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_instance_and_group_norm_vs_torch():
+    x = _R.normal(size=(2, 6, 5, 5)).astype(np.float32)
+    s = _R.uniform(0.5, 1.5, (6,)).astype(np.float32)
+    b = _R.normal(size=(6,)).astype(np.float32)
+    got = _eval1("InstanceNormalization", x,
+                 extra_inits=[("s", s), ("b", b)], epsilon=1e-5)
+    want = torch.nn.functional.instance_norm(
+        torch.tensor(x), weight=torch.tensor(s), bias=torch.tensor(b),
+        eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    got = _eval1("GroupNormalization", x,
+                 extra_inits=[("s", s), ("b", b)], num_groups=3, epsilon=1e-5)
+    want = torch.nn.functional.group_norm(
+        torch.tensor(x), 3, torch.tensor(s), torch.tensor(b), eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_split_outputs():
+    x = _R.normal(size=(2, 9)).astype(np.float32)
+    nodes = [_node("Split", ["x"], ["a", "b", "c"], axis=1, split=[2, 3, 4])]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("x", x.shape)],
+        [_vi("a", (2, 2)), _vi("b", (2, 3)), _vi("c", (2, 4))])
+    for name, want in zip("abc", np.split(x, [2, 5], axis=1)):
+        np.testing.assert_allclose(_run(sd, out_map, {"x": x}, name), want)
+
+
+def test_resize_nearest_and_linear_vs_torch():
+    x = _R.normal(size=(1, 2, 4, 4)).astype(np.float32)
+    got = _eval1("Resize", x, out_shape=(1, 2, 8, 8),
+                 extra_inits=[("roi", np.asarray([], np.float32)),
+                              ("scales", np.asarray([1, 1, 2, 2], np.float32))],
+                 mode="nearest", coordinate_transformation_mode="asymmetric",
+                 nearest_mode="floor")
+    want = torch.nn.functional.interpolate(torch.tensor(x),
+                                           scale_factor=2).numpy()
+    np.testing.assert_allclose(got, want)
+
+    got = _eval1("Resize", x, out_shape=(1, 2, 7, 9),
+                 extra_inits=[("roi", np.asarray([], np.float32)),
+                              ("scl", np.asarray([], np.float32)),
+                              ("sizes", np.asarray([1, 2, 7, 9], np.int64))],
+                 mode="linear",
+                 coordinate_transformation_mode="half_pixel")
+    want = torch.nn.functional.interpolate(
+        torch.tensor(x), size=(7, 9), mode="bilinear",
+        align_corners=False).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    got = _eval1("Upsample", x, out_shape=(1, 2, 8, 8),
+                 extra_inits=[("scales", np.asarray([1, 1, 2, 2], np.float32))],
+                 mode="nearest")
+    np.testing.assert_allclose(
+        got, torch.nn.functional.interpolate(torch.tensor(x),
+                                             scale_factor=2).numpy())
+
+
+def _torch_lstm_oracle(x, w, r, b, direction):
+    T, N, I = x.shape
+    D, fourH, _ = w.shape
+    H = fourH // 4
+    m = torch.nn.LSTM(I, H, bidirectional=(direction == "bidirectional"))
+    with torch.no_grad():
+        for d in range(D):
+            # ONNX gate order iofc -> torch ifgo
+            perm = np.concatenate([np.arange(0, H), np.arange(2 * H, 3 * H),
+                                   np.arange(3 * H, 4 * H),
+                                   np.arange(H, 2 * H)])
+            sfx = "_reverse" if d == 1 else ""
+            getattr(m, f"weight_ih_l0{sfx}").copy_(torch.tensor(w[d][perm]))
+            getattr(m, f"weight_hh_l0{sfx}").copy_(torch.tensor(r[d][perm]))
+            getattr(m, f"bias_ih_l0{sfx}").copy_(
+                torch.tensor(b[d][:fourH][perm]))
+            getattr(m, f"bias_hh_l0{sfx}").copy_(
+                torch.tensor(b[d][fourH:][perm]))
+        y, (h, c) = m(torch.tensor(x))
+    # torch y: [T, N, D*H] -> ONNX [T, D, N, H]
+    y = y.numpy().reshape(T, N, D, H).transpose(0, 2, 1, 3)
+    return y, h.numpy(), c.numpy()
+
+
+def test_lstm_vs_torch_forward_and_bidirectional():
+    T, N, I, H = 5, 3, 4, 6
+    for direction, D in (("forward", 1), ("bidirectional", 2)):
+        x = _R.normal(size=(T, N, I)).astype(np.float32)
+        w = (0.4 * _R.normal(size=(D, 4 * H, I))).astype(np.float32)
+        r = (0.4 * _R.normal(size=(D, 4 * H, H))).astype(np.float32)
+        b = (0.2 * _R.normal(size=(D, 8 * H))).astype(np.float32)
+        nodes = [_node("LSTM", ["x", "w", "r", "b"], ["y", "yh", "yc"],
+                       hidden_size=H, direction=direction)]
+        sd, _, out_map = _import_single(
+            nodes, [_vi("x", x.shape)],
+            [_vi("y", (T, D, N, H)), _vi("yh", (D, N, H)),
+             _vi("yc", (D, N, H))],
+            initializers=[("w", w), ("r", r), ("b", b)])
+        got_y = _run(sd, out_map, {"x": x}, "y")
+        got_h = _run(sd, out_map, {"x": x}, "yh")
+        got_c = _run(sd, out_map, {"x": x}, "yc")
+        want_y, want_h, want_c = _torch_lstm_oracle(x, w, r, b, direction)
+        np.testing.assert_allclose(got_y, want_y, rtol=1e-4, atol=1e-5,
+                                   err_msg=direction)
+        np.testing.assert_allclose(got_h, want_h, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got_c, want_c, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_vs_torch_forward():
+    T, N, I, H = 5, 3, 4, 6
+    x = _R.normal(size=(T, N, I)).astype(np.float32)
+    w = (0.4 * _R.normal(size=(1, 3 * H, I))).astype(np.float32)
+    r = (0.4 * _R.normal(size=(1, 3 * H, H))).astype(np.float32)
+    b = (0.2 * _R.normal(size=(1, 6 * H))).astype(np.float32)
+    b[0, 5 * H:] = 0.0  # Rb_h must be zero (documented restriction)
+    nodes = [_node("GRU", ["x", "w", "r", "b"], ["y", "yh"],
+                   hidden_size=H, direction="forward")]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("x", x.shape)],
+        [_vi("y", (T, 1, N, H)), _vi("yh", (1, N, H))],
+        initializers=[("w", w), ("r", r), ("b", b)])
+    got_y = _run(sd, out_map, {"x": x}, "y")
+
+    m = torch.nn.GRU(I, H)
+    with torch.no_grad():
+        # ONNX zrh -> torch rzn
+        perm = np.concatenate([np.arange(H, 2 * H), np.arange(0, H),
+                               np.arange(2 * H, 3 * H)])
+        m.weight_ih_l0.copy_(torch.tensor(w[0][perm]))
+        m.weight_hh_l0.copy_(torch.tensor(r[0][perm]))
+        m.bias_ih_l0.copy_(torch.tensor(b[0][:3 * H][perm]))
+        m.bias_hh_l0.copy_(torch.tensor(b[0][3 * H:][perm]))
+        want_y, _ = m(torch.tensor(x))
+    np.testing.assert_allclose(got_y[:, 0], want_y.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_strict_refusals_ext():
+    x = np.zeros((2, 3, 4, 4), np.float32)
+    with pytest.raises(ONNXImportError, match="coordinate mode"):
+        _eval1("Resize", x, out_shape=(2, 3, 8, 8),
+               extra_inits=[("roi", np.asarray([], np.float32)),
+                            ("scales", np.asarray([1, 1, 2, 2], np.float32))],
+               mode="nearest",
+               coordinate_transformation_mode="align_corners")
+    with pytest.raises(ONNXImportError, match="non-integer"):
+        _eval1("Resize", x, out_shape=(2, 3, 6, 6),
+               extra_inits=[("roi", np.asarray([], np.float32)),
+                            ("scales",
+                             np.asarray([1, 1, 1.5, 1.5], np.float32))],
+               mode="nearest", coordinate_transformation_mode="asymmetric",
+               nearest_mode="floor")
+    seq = np.zeros((4, 2, 3), np.float32)
+    w = np.zeros((1, 24, 3), np.float32)
+    r = np.zeros((1, 24, 6), np.float32)
+    with pytest.raises(ONNXImportError, match="layout"):
+        nodes = [_node("LSTM", ["x", "w", "r"], ["y"], hidden_size=6,
+                       layout=1)]
+        _import_single(nodes, [_vi("x", seq.shape)],
+                       [_vi("y", (4, 1, 2, 6))],
+                       initializers=[("w", w), ("r", r)])
+    bg = np.ones((1, 36), np.float32)  # nonzero Rb_h
+    wg = np.zeros((1, 18, 3), np.float32)
+    rg = np.zeros((1, 18, 6), np.float32)
+    with pytest.raises(ONNXImportError, match="Rb_h"):
+        nodes = [_node("GRU", ["x", "w", "r", "b"], ["y"], hidden_size=6)]
+        _import_single(nodes, [_vi("x", seq.shape)],
+                       [_vi("y", (4, 1, 2, 6))],
+                       initializers=[("w", wg), ("r", rg), ("b", bg)])
