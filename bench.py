@@ -61,14 +61,22 @@ _FORCE_HOST_WINDOW = False
 # baseline. None = no honest measurement recorded yet: the first green
 # driver run with this methodology becomes the baseline (update these from
 # BENCH_r03.json's per-config values, per BASELINE.md policy).
-# Measured 2026-07-30 on the live TPU v5 lite chip with this methodology
-# (losses finite AND decreasing; MFU sanity-gated) at commit 6847fbb — see
-# BASELINE.md's measured table. Later runs must not regress these.
+# Measured 2026-07-30 on the live TPU v5 lite chip with the r4 methodology:
+# on-device chained window; one compile+warmup execution, then THREE timed
+# windows with the MIN recorded (the axon relay pollutes a program's early
+# re-executions with deferred server-side work, see BASELINE.md r4 note);
+# losses finite on every window AND decreasing on the first; MFU
+# sanity-gated. See BASELINE.md's measured table and
+# BENCH_insession_r04.json. Later runs must not regress these. The r3
+# values (bert 44489 / resnet50 199.5 / lstm 194017 / lenet 6605) carried
+# per-step tunnel-dispatch overhead and exec2 pollution inside the window;
+# the jump to these numbers is a measurement correction documented in
+# BASELINE.md, not a hardware speedup.
 BASELINES = {
-    "bert": 44489.2,    # tokens/sec/chip, b32 x s128, bf16 mixed (mfu .151)
-    "resnet50": 199.5,  # samples/sec/chip, b32 224x224, bf16 mixed
-    "lstm": 194017.1,   # tokens/sec/chip, b32 x s256, GravesLSTM pallas
-    "lenet": 6605.7,    # samples/sec/chip, b256 28x28
+    "bert": 107962.4,    # tokens/sec/chip, b32 x s128, bf16 mixed (mfu .366)
+    "resnet50": 1684.0,  # samples/sec/chip, b32 224x224, bf16 mixed (mfu .21)
+    "lstm": 2724053.1,   # tokens/sec/chip, b32 x s256, GravesLSTM pallas
+    "lenet": 263659.4,   # samples/sec/chip, b256 28x28
 }
 
 # Published dense bf16 peak FLOP/s per chip, keyed by device_kind substring
@@ -281,15 +289,38 @@ def _timed_train(trainer, ts, batch, *, warmup: int, iters: int,
 
         import contextlib
 
-        prof = (jax.profiler.trace(_PROFILE_DIR) if _PROFILE_DIR
-                else contextlib.nullcontext())
-        with prof:
-            t0 = time.perf_counter()
-            ts, losses = chained(ts, batch)
-            host_losses = list(np.asarray(jax.device_get(losses)))
-            last_leaf = jax.tree_util.tree_leaves(ts.params)[0]
-            float(jax.device_get(last_leaf.ravel()[0]))
-            dt = time.perf_counter() - t0
+        # Min-of-3 windows: the axon relay pollutes a program's EARLY
+        # re-executions with deferred server-side work — measured 2026-07-30,
+        # the first timed window after the compile run read 4-28x slow for
+        # every config (e.g. ResNet-50 b32 534.7 ms/step vs 19.0 steady;
+        # window_ms_all in the emitted JSON records all three), and a
+        # dedicated discard execution did NOT reliably absorb it. Each
+        # window is honestly synced (device_get of the loss vector + a
+        # final-params element, both data-dependent on every step), so min
+        # discards transient relay noise, not device work. Finiteness is
+        # gated on EVERY window; the decrease gate runs on window 1's
+        # losses (the earliest, least-converged window). The profiler, when
+        # requested, wraps ONLY the last window — the one least likely to
+        # carry relay pollution — so the top-op attribution describes model
+        # ops, not relay artifacts.
+        dts, host_losses = [], None
+        for w in range(3):
+            prof = (jax.profiler.trace(_PROFILE_DIR)
+                    if _PROFILE_DIR and w == 2 else contextlib.nullcontext())
+            with prof:
+                t0 = time.perf_counter()
+                ts, losses = chained(ts, batch)
+                got = np.asarray(jax.device_get(losses))
+                last_leaf = jax.tree_util.tree_leaves(ts.params)[0]
+                float(jax.device_get(last_leaf.ravel()[0]))
+                dts.append(time.perf_counter() - t0)
+            if not np.isfinite(got).all():
+                raise RuntimeError(
+                    f"non-finite loss in timed window: {got[:8]}")
+            if host_losses is None:
+                host_losses = list(got)
+        dt = min(dts)
+        info["window_ms_all"] = [round(d / iters * 1000, 3) for d in dts]
         info["window"] = "on-device-chained"
     except Exception as e:  # noqa: BLE001 - fall back to host-driven timing
         if isinstance(e, RuntimeError) and "non-finite" in str(e):
@@ -654,7 +685,10 @@ def main():
     try:
         from kernels_ab import run_kernels_ab
 
-        kernels = run_kernels_ab({})
+        # A/B proof rows only: the block-size tune sweeps compile ~24 extra
+        # kernel variants (minutes of wall) and are diagnostics, not proof —
+        # they stay behind an explicit `--kernels` invocation.
+        kernels = run_kernels_ab({}, include_tune=False)
         kernels.pop("metric", None)
     except Exception as e:  # noqa: BLE001
         kernels = {"error": str(e)[:300]}

@@ -26,20 +26,36 @@ def _sync_scalar(x):
 
 
 def _time_fn(fn, args, iters=30):
-    """Median-free simple timing: async dispatch, one sync in-window."""
+    """Async dispatch, one sync in-window; best of 3 windows.
+
+    The axon relay pollutes a program's EARLY re-executions with deferred
+    server-side work (measured 2026-07-30: ResNet chained step 353-535 ms
+    on early executions vs 19-25 ms steady — BASELINE.md r4 note). That
+    artifact is what produced r3/r4's flash-fwd "0.10x" readings: the
+    Pallas side was timed on its polluted early executions while the XLA
+    side ran later in the process. The defense is min-of-3 honestly-synced
+    windows (a discard execution alone was measured NOT to absorb the
+    pollution reliably); the two warmup calls just keep window 1 from
+    paying first-touch costs.
+    """
     import jax
 
-    out = fn(*args)
-    _sync_scalar(out if not isinstance(out, tuple) else out[0])
-    t0 = time.perf_counter()
-    outs = []
-    for _ in range(iters):
-        o = fn(*args)
-        outs.append(o if not isinstance(o, tuple) else o[0])
-    # One scalar per call: every dispatch must have completed.
-    s = sum(o.ravel()[0] for o in outs)
-    _sync_scalar(s)
-    return (time.perf_counter() - t0) / iters * 1000  # ms
+    for _ in range(2):
+        out = fn(*args)
+        _sync_scalar(out if not isinstance(out, tuple) else out[0])
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = []
+        for _ in range(iters):
+            o = fn(*args)
+            outs.append(o if not isinstance(o, tuple) else o[0])
+        # One scalar per call: every dispatch must have completed.
+        s = sum(o.ravel()[0] for o in outs)
+        _sync_scalar(s)
+        dt = (time.perf_counter() - t0) / iters * 1000  # ms
+        best = dt if best is None else min(best, dt)
+    return best
 
 
 def _max_rel_err(a, b):
@@ -198,7 +214,7 @@ def _flash_tune(iters=8, B=8, H=12, T=512, D=64, causal=False):
     return out
 
 
-def run_kernels_ab(diag: dict) -> dict:
+def run_kernels_ab(diag: dict, include_tune: bool = True) -> dict:
     import jax
 
     platform = jax.devices()[0].platform
@@ -216,13 +232,21 @@ def run_kernels_ab(diag: dict) -> dict:
     # crossover is justified.
     flash_long = lambda: _flash_ab(iters=10, B=2, H=8, T=4096, D=64,
                                    causal=True)
+    # The auto-dispatch crossover (DL4J_TPU_FLASH_MIN_SEQ=1024): measure
+    # the A/B exactly at the boundary shape so the policy is justified by
+    # a recorded number rather than interpolation.
+    flash_1024 = lambda: _flash_ab(iters=15, B=4, H=12, T=1024, D=64,
+                                   causal=True)
     tune_long = lambda: _flash_tune(iters=6, B=2, H=8, T=2048, D=64,
                                     causal=True)
-    for name, fn in (("flash_attention", _flash_ab),
-                     ("flash_attention_long", flash_long),
-                     ("flash_tune_512", _flash_tune),
-                     ("flash_tune_2048", tune_long),
-                     ("lstm_scan", _lstm_ab)):
+    tune_legs = [("flash_tune_512", _flash_tune),
+                 ("flash_tune_2048", tune_long)] if include_tune else []
+    legs = ([("flash_attention", _flash_ab),
+             ("flash_attention_1024", flash_1024),
+             ("flash_attention_long", flash_long)]
+            + tune_legs
+            + [("lstm_scan", _lstm_ab)])
+    for name, fn in legs:
         try:
             result[name] = fn()
         except Exception as e:  # noqa: BLE001 - record, keep going
