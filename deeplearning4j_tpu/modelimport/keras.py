@@ -471,11 +471,19 @@ def _time_distributed(cfg):
 
 
 def _dir_matcher(direction: str, suffix: str):
-    """Full-path weight matcher for Bidirectional sub-layers: the key must
-    contain '<direction>_' and end with '/<suffix>'."""
+    """Full-path weight matcher for Bidirectional sub-layers: some path
+    segment must start with '<direction>_' and the key must end with
+    '/<suffix>'. Segment-anchored, not a bare substring: Keras names the
+    sub-layers 'forward_<inner>'/'backward_<inner>', so for an inner layer
+    itself named e.g. 'forward_enc' the backward path is
+    'backward_forward_enc/...' — a substring 'forward_' test would match it
+    and silently bind the forward params to the backward weights."""
 
     def match(key: str) -> bool:
-        return f"{direction}_" in key and key.endswith("/" + suffix)
+        if not key.endswith("/" + suffix):
+            return False
+        return any(seg.startswith(f"{direction}_")
+                   for seg in key.split("/"))
 
     match.optional = suffix in _OPTIONAL_SUFFIXES
     return match

@@ -20,6 +20,7 @@ compares imported-graph outputs against torch executing the same weights.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -425,19 +426,43 @@ def _register_onnximport_ops_ext():
         n, c = x.shape[:2]
         spatial = x.shape[2:]
         g = int(num_groups)
+
+        def per_channel(p):
+            # Opset 21: scale/bias are per-channel [C]. Opset 18 defined
+            # them per-GROUP [G]; broadcast each group value across its
+            # C/G channels (when G == C the two readings coincide).
+            if p.shape[0] == c:
+                return p
+            if p.shape[0] == g:
+                return jnp.repeat(p, c // g)
+            raise ValueError(
+                f"GroupNormalization: scale/bias length {p.shape[0]} "
+                f"matches neither channels ({c}) nor num_groups ({g})")
+
         y = x.reshape(n, g, c // g, *spatial)
         axes = tuple(range(2, y.ndim))
         mean = jnp.mean(y, axis=axes, keepdims=True)
         var = jnp.var(y, axis=axes, keepdims=True)
         y = ((y - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
         shape = (1, -1) + (1,) * (x.ndim - 2)
-        return y * scale.reshape(shape) + bias.reshape(shape)
+        return (y * per_channel(scale).reshape(shape)
+                + per_channel(bias).reshape(shape))
 
     def split(x, axis=0, split_sizes=None, num_outputs=None):
-        if split_sizes is not None:
-            idxs = np.cumsum(split_sizes)[:-1].tolist()
-            return tuple(jnp.split(x, idxs, axis=axis))
-        return tuple(jnp.split(x, int(num_outputs), axis=axis))
+        if split_sizes is None:
+            # Split-18 spec for num_outputs on a non-divisible axis:
+            # chunk = ceil(dim / k), last chunk smaller. jnp.split would
+            # raise on uneven dims (and the error surfaces at the wrong
+            # node once _infer swallows it).
+            k = int(num_outputs)
+            dim = x.shape[axis]
+            chunk = -(-dim // k)
+            split_sizes = [chunk] * (k - 1) + [dim - chunk * (k - 1)]
+            if split_sizes[-1] <= 0:
+                raise ValueError(
+                    f"Split: num_outputs={k} too large for axis dim {dim}")
+        idxs = np.cumsum(split_sizes)[:-1].tolist()
+        return tuple(jnp.split(x, idxs, axis=axis))
 
     def gather_elements(x, idx, axis=0):
         return jnp.take_along_axis(x, idx.astype(jnp.int32), axis=axis)
@@ -454,6 +479,11 @@ def _register_onnximport_ops_ext():
         return y
 
     def resize_linear_half_pixel(x, out_shape):
+        # half_pixel via jax.image.resize, whose coordinate transform uses
+        # the EFFECTIVE ratio out/in. When the node carried fractional
+        # `scales`, sizes = floor(d*s) and ORT would keep the raw scale in
+        # the transform — a documented sub-pixel divergence, identical
+        # whenever d*s is integral (the overwhelmingly common case).
         import jax.image
 
         return jax.image.resize(x, tuple(int(d) for d in out_shape),
@@ -1058,6 +1088,10 @@ def _conv_transpose(imp, node):
         raise ONNXImportError("ConvTranspose output_shape unsupported")
     if a.get("group", 1) != 1:
         raise ONNXImportError("ConvTranspose group != 1 unsupported")
+    if any(d != 1 for d in a.get("dilations", [])):
+        # jax.lax.conv_transpose below runs undilated; importing would
+        # silently produce wrong activations AND a wrong output shape.
+        raise ONNXImportError("ConvTranspose dilations != 1 unsupported")
     ins = [imp.tensor(r) for r in node.input if r]
     w_shape = ins[1].shape
     nd = (len(a["kernel_shape"]) if "kernel_shape" in a
@@ -1146,7 +1180,10 @@ def _resize_scales_sizes(imp, node, x):
             f"{node.op_type}: input shape must be fully static at import "
             f"(got {x.shape})")
     if sizes is None:
-        sizes = [int(round(d * s)) for d, s in zip(x.shape, scales)]
+        # Spec: output_size = floor(input_size * scale) — round() would
+        # disagree with onnxruntime on fractional scales (5 * 1.5 -> 7,
+        # not 8). Epsilon guards float noise like 0.999999 * d.
+        sizes = [int(math.floor(d * s + 1e-9)) for d, s in zip(x.shape, scales)]
     if scales is None:
         scales = [o / d for o, d in zip(sizes, x.shape)]
     return scales, sizes

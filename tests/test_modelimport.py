@@ -570,3 +570,31 @@ class TestKerasRound4Tail:
         ])
         with pytest.raises(KerasImportError, match="mask_value"):
             import_keras_model(_save(km, tmp_path))
+
+
+class TestBidirectionalDirMatcher:
+    """Segment-anchored direction matching: an inner layer whose own name
+    contains 'forward'/'backward' must not cross-bind direction weights."""
+
+    def test_direction_anchored_to_path_segment(self):
+        from deeplearning4j_tpu.modelimport.keras import _dir_matcher
+
+        fwd = _dir_matcher("forward", "kernel")
+        bwd = _dir_matcher("backward", "kernel")
+        # inner layer named 'forward_enc' -> sub-layer paths:
+        f_path = "bidir/forward_forward_enc/lstm_cell/kernel"
+        b_path = "bidir/backward_forward_enc/lstm_cell/kernel"
+        assert fwd(f_path) and not fwd(b_path)
+        assert bwd(b_path) and not bwd(f_path)
+        # suffix must match the final path segment
+        assert not fwd("bidir/forward_x/lstm_cell/recurrent_kernel")
+
+    def test_bidirectional_inner_name_contains_direction(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((5, 4)),
+            tf.keras.layers.Bidirectional(
+                tf.keras.layers.LSTM(3, return_sequences=True,
+                                     name="forward_enc")),
+        ])
+        x = np.random.default_rng(2).normal(size=(2, 5, 4)).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x, rtol=1e-3, atol=1e-4)

@@ -404,3 +404,70 @@ def test_strict_refusals_ext():
         _import_single(nodes, [_vi("x", seq.shape)],
                        [_vi("y", (4, 1, 2, 6))],
                        initializers=[("w", wg), ("r", rg), ("b", bg)])
+
+
+def test_conv_transpose_dilations_rejected():
+    """Dilated ConvTranspose would run undilated (silently wrong outputs
+    AND shape) — the importer must refuse, like its other unsupported
+    attribute corners."""
+    x = np.zeros((1, 3, 5, 5), np.float32)
+    w = np.zeros((3, 4, 3, 3), np.float32)
+    with pytest.raises(ONNXImportError, match="dilations"):
+        nodes = [_node("ConvTranspose", ["x", "w"], ["y"],
+                       strides=[1, 1], dilations=[2, 2])]
+        _import_single(nodes, [_vi("x", x.shape)], [_vi("y", (1, 4, 9, 9))],
+                       initializers=[("w", w)])
+    # all-1 dilations are the default and stay accepted
+    nodes = [_node("ConvTranspose", ["x", "w"], ["y"],
+                   strides=[1, 1], dilations=[1, 1])]
+    _import_single(nodes, [_vi("x", x.shape)], [_vi("y", (1, 4, 7, 7))],
+                   initializers=[("w", w)])
+
+
+def test_resize_fractional_scale_uses_floor():
+    """Spec: output_size = floor(input_size * scale). 5 * 1.5 -> 7 (round
+    would give 8 and diverge from onnxruntime/torch)."""
+    x = _R.normal(size=(1, 1, 5, 5)).astype(np.float32)
+    got = _eval1("Resize", x, out_shape=(1, 1, 7, 7),
+                 extra_inits=[("roi", np.asarray([], np.float32)),
+                              ("scales",
+                               np.asarray([1, 1, 1.5, 1.5], np.float32))],
+                 mode="linear", coordinate_transformation_mode="half_pixel")
+    assert got.shape == (1, 1, 7, 7)
+    # Values pinned against the size-based oracle: with fractional scales
+    # the import resolves sizes = floor(d*s) and resamples with the
+    # effective out/in ratio (documented divergence from ORT's use of the
+    # raw scale inside the half-pixel transform; identical whenever d*s is
+    # integral).
+    want = torch.nn.functional.interpolate(
+        torch.tensor(x), size=(7, 7), mode="bilinear",
+        align_corners=False).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_split_num_outputs_uneven():
+    """Split-18: non-divisible axis -> chunk = ceil(dim/k), last chunk
+    smaller (dim 7, k 3 -> [3, 3, 1])."""
+    x = _R.normal(size=(2, 7)).astype(np.float32)
+    nodes = [_node("Split", ["x"], ["a", "b", "c"], axis=1, num_outputs=3)]
+    sd, _, out_map = _import_single(
+        nodes, [_vi("x", x.shape)],
+        [_vi("a", (2, 3)), _vi("b", (2, 3)), _vi("c", (2, 1))])
+    for name, want in zip("abc", np.split(x, [3, 6], axis=1)):
+        np.testing.assert_allclose(_run(sd, out_map, {"x": x}, name), want)
+
+
+def test_group_norm_opset18_per_group_params():
+    """Opset 18 GroupNormalization carries scale/bias of shape
+    [num_groups]; each group value applies to all its channels (pinned
+    against torch with explicitly repeated per-channel params)."""
+    x = _R.normal(size=(2, 6, 5, 5)).astype(np.float32)
+    s = _R.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    b = _R.normal(size=(3,)).astype(np.float32)
+    got = _eval1("GroupNormalization", x,
+                 extra_inits=[("s", s), ("b", b)], num_groups=3,
+                 epsilon=1e-5)
+    want = torch.nn.functional.group_norm(
+        torch.tensor(x), 3, torch.tensor(np.repeat(s, 2)),
+        torch.tensor(np.repeat(b, 2)), eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
