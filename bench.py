@@ -383,16 +383,20 @@ def _timed_train_host(trainer, ts, batch, *, warmup: int, iters: int,
 # Analytic FLOPs (train step ~= 3x forward for matmul-dominated models)
 # --------------------------------------------------------------------------
 
-def bert_train_flops(batch, seq, cfg) -> float:
+def bert_train_flops(batch, seq, cfg, max_predictions=None) -> float:
     """Matmul FLOPs for one BERT MLM+NSP train step.
 
     fwd = L*(8*B*T*H^2 [QKV+O] + 4*B*T^2*H [QK^T + AV] + 4*B*T*H*I [FFN])
-          + 2*B*T*H^2 [MLM transform] + 2*B*T*H*V [tied decoder]; bwd = 2x.
+          + 2*B*P*H^2 [MLM transform] + 2*B*P*H*V [tied decoder]; bwd = 2x.
+    P = max_predictions when the gathered MLM head is used (the decoder GEMM
+    runs over the P masked slots only), else the full T — the MFU
+    denominator counts the FLOPs the model actually issues.
     """
     b, t = batch, seq
+    p = t if max_predictions is None else max_predictions
     h, i, l, v = cfg.hidden, cfg.intermediate, cfg.num_layers, cfg.vocab_size
     fwd = l * (8 * b * t * h * h + 4 * b * t * t * h + 4 * b * t * h * i)
-    fwd += 2 * b * t * h * h + 2 * b * t * h * v
+    fwd += 2 * b * p * h * h + 2 * b * p * h * v
     return 3.0 * fwd
 
 
@@ -424,7 +428,11 @@ LENET_TRAIN_FLOPS_PER_SAMPLE = 3.0 * 2.0 * 6.52e6
 # Configs
 # --------------------------------------------------------------------------
 
-def bench_bert(peak, *, batch_size=32, seq_len=128, warmup=4, iters=30):
+def bench_bert(peak, *, batch_size=32, seq_len=128, warmup=4, iters=30,
+               max_predictions=20):
+    """max_predictions=20 selects the gathered MLM head (decoder GEMM over
+    the 20 masked slots, ~15% of T=128, the standard BERT pretraining data
+    layout); None falls back to the dense [N,T,V] head."""
     import jax
 
     from deeplearning4j_tpu.models.bert import bert_base, make_mlm_batch
@@ -432,19 +440,26 @@ def bench_bert(peak, *, batch_size=32, seq_len=128, warmup=4, iters=30):
     from deeplearning4j_tpu.train.trainer import Trainer
     from deeplearning4j_tpu.train.updaters import Adam
 
+    # rng_impl="rbg": hardware RngBitGenerator for the dropout masks —
+    # threefry cost BERT-base ~12 ms of a 34 ms step (~150M random
+    # bits/step); see NeuralNetConfiguration.rng_impl.
     model = bert_base(net=NeuralNetConfiguration(
-        updater=Adam(1e-4), mixed_precision=True))
+        updater=Adam(1e-4), mixed_precision=True, rng_impl="rbg"))
     trainer = Trainer(model)
     ts = trainer.init_state()
     batch = jax.device_put(make_mlm_batch(
         0, batch_size=batch_size, seq_len=seq_len,
-        vocab_size=model.config.vocab_size))
+        vocab_size=model.config.vocab_size,
+        max_predictions=max_predictions))
 
     info = {"batch": batch_size, "seq_len": seq_len, "dtype": "bf16-mixed",
+            "mlm_head": ("dense" if max_predictions is None
+                         else f"gathered(P={max_predictions})"),
             "unit": "tokens/sec/chip"}
     value = _timed_train(
         trainer, ts, batch, warmup=warmup, iters=iters,
-        flops_per_step=bert_train_flops(batch_size, seq_len, model.config),
+        flops_per_step=bert_train_flops(batch_size, seq_len, model.config,
+                                        max_predictions),
         units_per_step=batch_size * seq_len, peak_flops=peak, info=info)
     info["value"] = round(value, 1)
     return info

@@ -47,10 +47,15 @@ def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict]
     d.mkdir(parents=True, exist_ok=True)
     arrays: Dict[str, np.ndarray] = {}
     key_paths = []
+    key_impls: Dict[str, str] = {}
     for name, leaf in flatten_with_names(tree):
         if _is_key_array(leaf):
             arrays[name] = np.asarray(jax.random.key_data(leaf))
             key_paths.append(name)
+            # impl must round-trip explicitly: rbg key data is uint32[4]
+            # and threefry's uint32[2]; wrap_key_data with the default
+            # impl would misread a non-default key's data.
+            key_impls[name] = str(jax.random.key_impl(leaf))
         else:
             arrays[name] = np.asarray(jax.device_get(leaf))
     np.savez(d / "state.npz", **arrays)
@@ -59,6 +64,7 @@ def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict]
         "time": time.time(),
         "leaves": sorted(arrays.keys()),
         "key_paths": key_paths,
+        "key_impls": key_impls,
     }
     if extra_meta:
         meta.update(extra_meta)
@@ -74,6 +80,7 @@ def load_state_tree(directory: str | Path, template: Any, sharding=None) -> Any:
     d = Path(directory)
     meta = json.loads((d / "meta.json").read_text())
     key_paths = set(meta.get("key_paths", []))
+    key_impls = meta.get("key_impls", {})
     with np.load(d / "state.npz") as z:
         data = {k: z[k] for k in z.files}
     paths = jax.tree_util.tree_flatten_with_path(template)[0]
@@ -87,7 +94,8 @@ def load_state_tree(directory: str | Path, template: Any, sharding=None) -> Any:
             raise KeyError(f"checkpoint missing leaf '{name}'")
         arr = data[name]
         if name in key_paths:
-            leaves.append(jax.random.wrap_key_data(jax.numpy.asarray(arr)))
+            leaves.append(jax.random.wrap_key_data(
+                jax.numpy.asarray(arr), impl=key_impls.get(name)))
         else:
             leaves.append(jax.numpy.asarray(arr).astype(tmpl_leaf.dtype))
     tree = jax.tree_util.tree_unflatten(treedef, leaves)
@@ -113,8 +121,10 @@ def _place(tree, sharding):
             return jax.device_put(leaf, s)
         if _is_key_array(leaf):
             data = np.asarray(jax.random.key_data(leaf))
+            impl = str(jax.random.key_impl(leaf))
             return jax.jit(
-                lambda: jax.random.wrap_key_data(jax.numpy.asarray(data)),
+                lambda: jax.random.wrap_key_data(
+                    jax.numpy.asarray(data), impl=impl),
                 out_shardings=s)()
         host = np.asarray(leaf)
         return jax.make_array_from_callback(

@@ -265,7 +265,8 @@ class Trainer:
             model_state=variables["state"],
             opt_state=self._upd_init(variables["params"]),
             step=jnp.zeros((), jnp.int32),
-            rng=jax.random.key(seed),
+            rng=jax.random.key(
+                seed, impl=getattr(self.net, "rng_impl", None)),
         )
         return ts
 

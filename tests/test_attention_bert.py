@@ -202,3 +202,50 @@ def test_transformer_block_remat_grads_match():
                     jax.tree_util.tree_leaves(gr)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_bert_gathered_mlm_head_matches_dense():
+    """Gathered (mlm_positions) and dense (mlm_mask) layouts of the SAME
+    batch must produce the same loss — the gathered head only skips
+    positions whose weight is zero."""
+    model = bert_tiny(max_position=32, dropout=0.0, attention_dropout=0.0,
+                      use_nsp=False)
+    v = model.init(seed=0)
+    batch = make_mlm_batch(3, batch_size=4, seq_len=32,
+                           vocab_size=model.config.vocab_size,
+                           max_predictions=8)
+    lab = batch["labels"]
+    # derive the dense view: scatter the gathered labels/weights back to [N,T]
+    n, t = batch["features"]["token_ids"].shape
+    dense_labels = np.zeros((n, t), np.int32)
+    dense_mask = np.zeros((n, t), np.float32)
+    for i in range(n):
+        for j in range(lab["mlm_positions"].shape[1]):
+            if lab["mlm_weights"][i, j] > 0:
+                p = lab["mlm_positions"][i, j]
+                dense_labels[i, p] = lab["mlm_labels"][i, j]
+                dense_mask[i, p] = 1.0
+    dense_batch = {"features": batch["features"],
+                   "labels": {"mlm_labels": dense_labels,
+                              "mlm_mask": dense_mask}}
+    lg, _ = model.loss_fn(v["params"], v["state"], batch)
+    ld, _ = model.loss_fn(v["params"], v["state"], dense_batch)
+    np.testing.assert_allclose(float(lg), float(ld), rtol=1e-5)
+
+
+def test_bert_gathered_mlm_trains():
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    model = bert_tiny(max_position=32, use_nsp=True,
+                      net=NeuralNetConfiguration(updater=Adam(1e-3)))
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    batch = make_mlm_batch(0, batch_size=8, seq_len=32,
+                           vocab_size=model.config.vocab_size,
+                           max_predictions=8)
+    losses = []
+    for _ in range(12):
+        ts, metrics = trainer.train_step(ts, batch)
+        losses.append(float(jax.device_get(metrics["mlm_loss"])))
+    assert losses[-1] < losses[0] * 0.9, losses

@@ -78,3 +78,32 @@ def test_flat_vector_roundtrip():
     assert flat.ndim == 1
     back = from_flat_vector(v["params"], flat)
     assert tree_allclose(v["params"], back)
+
+
+def test_checkpoint_roundtrip_rbg_rng(tmp_path):
+    """A TrainState whose rng uses a non-default PRNG impl (rbg) must
+    restore with the same impl — rbg key data is uint32[4], and wrapping
+    it with the default threefry impl would misread it."""
+    import jax
+
+    from deeplearning4j_tpu.serde.checkpoint import (
+        load_state_tree, save_state_tree)
+
+    tree = {"rng": jax.random.key(7, impl="rbg"),
+            "w": jnp.ones((3,), jnp.float32)}
+    save_state_tree(tmp_path / "s", tree)
+    back = load_state_tree(tmp_path / "s", tree)
+    assert str(jax.random.key_impl(back["rng"])) == "rbg"
+    a = jax.random.bernoulli(tree["rng"], 0.5, (16,))
+    b = jax.random.bernoulli(back["rng"], 0.5, (16,))
+    assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_trainer_rng_impl_config():
+    import jax
+
+    model = lenet()
+    model.net.rng_impl = "rbg"
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    assert str(jax.random.key_impl(ts.rng)) == "rbg"
