@@ -1125,9 +1125,23 @@ def _split(imp, node):
     if split_sizes is None and len(node.input) > 1 and node.input[1]:
         split_sizes = [int(v)
                        for v in imp.const_value(node.input[1]).reshape(-1)]
-    return _rec(imp, "onnximport.split", [imp.tensor(node.input[0])],
-                axis=a.get("axis", 0), split_sizes=split_sizes,
-                num_outputs=a.get("num_outputs", len(node.output)))
+    x = imp.tensor(node.input[0])
+    axis = a.get("axis", 0)
+    k = a.get("num_outputs", len(node.output))
+    # Validate HERE, where the static dim is known and the error names the
+    # node — a raise inside the op fn is swallowed by _infer's eval_shape
+    # guard, which records ONE output for the node and crashes downstream
+    # with a confusing output-binding error.
+    if split_sizes is None and x.shape is not None:
+        dim = x.shape[axis if axis >= 0 else axis + len(x.shape)]
+        if dim is not None:
+            chunk = -(-int(dim) // int(k))
+            if int(dim) - chunk * (int(k) - 1) <= 0:
+                raise ONNXImportError(
+                    f"Split node '{node.name}': num_outputs={k} too large "
+                    f"for axis dim {dim}")
+    return _rec(imp, "onnximport.split", [x],
+                axis=axis, split_sizes=split_sizes, num_outputs=k)
 
 
 @onnx_op("Tile")
@@ -1182,8 +1196,11 @@ def _resize_scales_sizes(imp, node, x):
     if sizes is None:
         # Spec: output_size = floor(input_size * scale) — round() would
         # disagree with onnxruntime on fractional scales (5 * 1.5 -> 7,
-        # not 8). Epsilon guards float noise like 0.999999 * d.
-        sizes = [int(math.floor(d * s + 1e-9)) for d, s in zip(x.shape, scales)]
+        # not 8). The epsilon must be RELATIVE: scales arrive float32
+        # (~1e-7 ulp), so an intended-integer product reads d*(1 - 1e-7)
+        # and a d-independent 1e-9 cannot lift it back over the floor.
+        sizes = [int(math.floor(d * s * (1 + 1e-6) + 1e-9))
+                 for d, s in zip(x.shape, scales)]
     if scales is None:
         scales = [o / d for o, d in zip(sizes, x.shape)]
     return scales, sizes

@@ -471,3 +471,36 @@ def test_group_norm_opset18_per_group_params():
         torch.tensor(x), 3, torch.tensor(np.repeat(s, 2)),
         torch.tensor(np.repeat(b, 2)), eps=1e-5).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_split_num_outputs_too_large_clear_error():
+    """num_outputs > what the axis dim supports must raise ONNXImportError
+    at the mapper (a raise inside the traced op fn is swallowed by
+    _infer's eval_shape guard and surfaces as a confusing output-binding
+    failure downstream)."""
+    from deeplearning4j_tpu.modelimport.onnx import ONNXImportError
+
+    nodes = [_node("Split", ["x"], ["a", "b", "c", "d"], axis=1,
+                   num_outputs=4)]
+    with pytest.raises(ONNXImportError, match="num_outputs=4 too large"):
+        _import_single(
+            nodes, [_vi("x", (2, 3))],
+            [_vi(n, (2, 1)) for n in "abcd"])
+
+
+def test_resize_float32_scale_ulp_low_keeps_size():
+    """A scale serialized one float32 ulp below 2.0 must still produce the
+    exporter-intended 2x size — the floor epsilon is relative to d*s, not
+    absolute (0.99999988 * 64 + 1e-9 would floor to 127 otherwise)."""
+    x = _R.normal(size=(1, 1, 4, 4)).astype(np.float32)
+    s_low = np.nextafter(np.float32(2.0), np.float32(0.0), dtype=np.float32)
+    got = _eval1("Resize", x, out_shape=(1, 1, 8, 8),
+                 extra_inits=[("roi", np.asarray([], np.float32)),
+                              ("scales",
+                               np.asarray([1, 1, s_low, s_low], np.float32))],
+                 mode="nearest", coordinate_transformation_mode="asymmetric",
+                 nearest_mode="floor")
+    assert got.shape == (1, 1, 8, 8)
+    want = torch.nn.functional.interpolate(torch.tensor(x),
+                                           scale_factor=2).numpy()
+    np.testing.assert_allclose(got, want)
