@@ -443,8 +443,10 @@ def bench_bert(peak, *, batch_size=32, seq_len=128, warmup=4, iters=30,
     # rng_impl="rbg": hardware RngBitGenerator for the dropout masks —
     # threefry cost BERT-base ~12 ms of a 34 ms step (~150M random
     # bits/step); see NeuralNetConfiguration.rng_impl.
-    model = bert_base(net=NeuralNetConfiguration(
-        updater=Adam(1e-4), mixed_precision=True, rng_impl="rbg"))
+    model = bert_base(
+        max_position=max(512, seq_len),
+        net=NeuralNetConfiguration(
+            updater=Adam(1e-4), mixed_precision=True, rng_impl="rbg"))
     trainer = Trainer(model)
     ts = trainer.init_state()
     batch = jax.device_put(make_mlm_batch(
@@ -551,6 +553,16 @@ def bench_lenet(peak, *, batch_size=256, warmup=4, iters=200):
 
 _CONFIGS = {
     "bert": bench_bert,
+    # Batch-size knee probe (no baseline row): how much of the remaining
+    # b32 MFU gap is parallelism-bound.
+    "bert_b64": lambda peak: bench_bert(peak, batch_size=64, iters=15,
+                                        max_predictions=20),
+    # Long-context leg: T=2048 crosses DL4J_TPU_FLASH_MIN_SEQ=1024, so the
+    # encoder runs the Pallas flash-attention kernel inside the full model
+    # (the shape class where XLA's O(T^2) score materialization loses —
+    # BASELINE.md kernel A/B). P scales with T at the same 15% mask rate.
+    "bert_long": lambda peak: bench_bert(peak, batch_size=4, seq_len=2048,
+                                         iters=10, max_predictions=308),
     "resnet50": bench_resnet50,
     # Batch-size knee probe: same model, 4x the per-step work. No r3
     # baseline (baseline_pending); recorded to show how much of the b32

@@ -58,7 +58,8 @@ from deeplearning4j_tpu.nn.layers.core import (
     Reshape,
 )
 from deeplearning4j_tpu.nn.layers.norm import BatchNorm, LayerNorm
-from deeplearning4j_tpu.nn.layers.recurrent import GRU, LSTM, SimpleRnn
+from deeplearning4j_tpu.nn.layers.recurrent import (GRU, LSTM,
+    ConvLSTM2D, SimpleRnn)
 
 
 class KerasImportError(Exception):
@@ -276,6 +277,29 @@ def _simple_rnn(cfg):
                      return_sequences=cfg.get("return_sequences", False),
                      activation=_act(cfg.get("activation", "tanh"))), {
         "W": ("kernel", None), "RW": ("recurrent_kernel", None),
+        "b": ("bias", None)}
+
+
+def _conv_lstm2d(cfg):
+    """↔ KerasConvLSTM2D. Gate order i,f,c,o and HWIO kernels match the
+    native ConvLSTM2D layer verbatim; keras' unit_forget_bias is baked
+    into the saved bias (unit_forget_bias=False stops init re-adding it).
+    Train-time dropout/recurrent_dropout fields are inference no-ops and
+    are ignored, as the reference importer does."""
+    if cfg.get("data_format") not in (None, "channels_last"):
+        raise KerasImportError("channels_first ConvLSTM2D not supported")
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise KerasImportError("dilated ConvLSTM2D not supported")
+    if cfg.get("go_backwards"):
+        raise KerasImportError("ConvLSTM2D(go_backwards=True) not supported")
+    return ConvLSTM2D(
+        filters=cfg["filters"], kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)), padding=_padding(cfg),
+        activation=_act(cfg.get("activation", "tanh")),
+        recurrent_activation=_act(cfg.get("recurrent_activation", "sigmoid")),
+        use_bias=cfg.get("use_bias", True), unit_forget_bias=False,
+        return_sequences=cfg.get("return_sequences", False),
+    ), {"W": ("kernel", None), "RW": ("recurrent_kernel", None),
         "b": ("bias", None)}
 
 
@@ -610,6 +634,7 @@ LAYER_MAPPERS: Dict[str, Callable] = {
     "LSTM": _lstm,
     "GRU": _gru,
     "SimpleRNN": _simple_rnn,
+    "ConvLSTM2D": _conv_lstm2d,
     "Embedding": _embedding,
     "Activation": _activation,
     "Dropout": _dropout,

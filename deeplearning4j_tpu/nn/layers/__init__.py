@@ -77,6 +77,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     GRU,
     LSTM,
     Bidirectional,
+    ConvLSTM2D,
     GravesLSTM,
     LastTimeStep,
     SimpleRnn,
@@ -101,7 +102,7 @@ __all__ = [
     "BatchNorm", "LayerNorm", "LocalResponseNormalization",
     "LossLayer", "OutputLayer", "RnnOutputLayer",
     "RnnLossLayer", "CnnLossLayer", "CenterLossOutputLayer",
-    "GRU", "LSTM", "Bidirectional", "GravesLSTM", "LastTimeStep",
+    "GRU", "LSTM", "Bidirectional", "ConvLSTM2D", "GravesLSTM", "LastTimeStep",
     "SimpleRnn", "graves_bidirectional_lstm",
     "SelfAttention", "LearnedSelfAttention", "TransformerEncoderBlock",
     "PositionalEmbedding",

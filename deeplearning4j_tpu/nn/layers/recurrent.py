@@ -290,3 +290,105 @@ def graves_bidirectional_lstm(units: int, *, merge: str = "concat",
     a bidirectional wrapper over the peephole LSTM; here it composes."""
     return Bidirectional(layer=GravesLSTM(units=units, **lstm_kwargs),
                          merge=merge)
+
+
+@register_config
+@dataclass
+class ConvLSTM2D(LayerConfig):
+    """Convolutional LSTM over [N,T,H,W,C] (↔ the reference's Keras-import
+    target KerasConvLSTM2D; Shi et al. 2015 cell, Keras semantics).
+
+    Gates are convolutions instead of matmuls:
+        i,f,g,o = split(conv(x_t, W, stride, padding)
+                        + conv(h_{t-1}, RW, 1, SAME) + b)
+    with Keras gate order i,f,c,o — imported kernels map verbatim.
+
+    TPU-native shape: the input-to-gate conv for ALL T steps is hoisted out
+    of the recurrence into ONE conv over the folded [N*T,H,W,C] batch (a
+    single large MXU GEMM), so the ``lax.scan`` body carries only the
+    stride-1 SAME recurrent conv on h — the same hoisting the LSTM layer
+    does for its input projection (ops/rnn.py).
+    """
+
+    filters: int = 0
+    kernel: Any = 3  # int or (kh, kw)
+    stride: Any = 1
+    padding: str = "VALID"
+    activation: str = "tanh"
+    recurrent_activation: str = "sigmoid"
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+    unit_forget_bias: bool = True
+    return_sequences: bool = True
+
+    def _pairs(self):
+        k = self.kernel if isinstance(self.kernel, (tuple, list)) \
+            else (self.kernel, self.kernel)
+        s = self.stride if isinstance(self.stride, (tuple, list)) \
+            else (self.stride, self.stride)
+        return tuple(k), tuple(s)
+
+    def output_shape(self, input_shape):
+        from deeplearning4j_tpu.nn.layers.conv import _conv_out
+
+        t, h, w, c = input_shape
+        (kh, kw), (sh, sw) = self._pairs()
+        mode = self.padding.upper()
+        oh, ow = _conv_out(h, kh, sh, mode), _conv_out(w, kw, sw, mode)
+        out = (oh, ow, self.filters)
+        return (t, *out) if self.return_sequences else out
+
+    def init(self, rng, input_shape, dtype):
+        c = input_shape[-1]
+        f = self.filters
+        (kh, kw), _ = self._pairs()
+        w_init = get_initializer(self.weight_init or "xavier")
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "W": w_init(k1, (kh, kw, c, 4 * f), dtype),
+            "RW": w_init(k2, (kh, kw, f, 4 * f), dtype),
+        }
+        if self.use_bias:
+            b = jnp.zeros((4 * f,), dtype)
+            if self.unit_forget_bias:
+                b = b.at[f:2 * f].set(1.0)
+            params["b"] = b
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None,
+              initial_state=None):
+        from deeplearning4j_tpu.ops import cnn as opscnn
+
+        act = get_activation(self.activation)
+        rec_act = get_activation(self.recurrent_activation)
+        n, t, h, w, c = x.shape
+        f = self.filters
+        _, (sh, sw) = self._pairs()
+
+        # hoisted input conv: one MXU pass over all T steps
+        xg = opscnn.conv2d(
+            x.reshape(n * t, h, w, c), params["W"], params.get("b"),
+            stride=(sh, sw), padding=self.padding)
+        oh, ow = xg.shape[1], xg.shape[2]
+        xg_tm = jnp.swapaxes(xg.reshape(n, t, oh, ow, 4 * f), 0, 1)
+
+        if initial_state is not None:
+            h0, c0 = initial_state
+        else:
+            h0 = jnp.zeros((n, oh, ow, f), x.dtype)
+            c0 = jnp.zeros((n, oh, ow, f), x.dtype)
+
+        def body(carry, xg_t):
+            h_prev, c_prev = carry
+            gates = xg_t + opscnn.conv2d(
+                h_prev, params["RW"], stride=1, padding="SAME")
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i, fg, o = rec_act(i), rec_act(fg), rec_act(o)
+            c_new = fg * c_prev + i * act(g)
+            h_new = o * act(c_new)
+            return (h_new, c_new), h_new
+
+        (hT, cT), ys = jax.lax.scan(body, (h0, c0), xg_tm)
+        if not self.return_sequences:
+            return hT, state
+        return jnp.swapaxes(ys, 0, 1), state

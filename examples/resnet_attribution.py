@@ -11,7 +11,12 @@ Prints one JSON line; intended for BASELINE.md diagnosis notes.
 
 import argparse
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401,E402 - repo path + platform override
 
 
 def _timed_window(fn, state, batch, iters):
@@ -70,6 +75,7 @@ def main():
     chained = trainer.make_chained_step(iters)
     ms, cs = _timed_window(lambda s, x: chained(s, x), ts, batch, iters)
     out["train_full_ms"] = round(ms, 2)
+    print(f"train_full_ms={ms:.2f} (compile {cs:.1f}s)", file=sys.stderr)
 
     # 2. forward-only (train=False BN inference path, jit + scan chain)
     model2 = build()
@@ -89,7 +95,9 @@ def main():
         acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=iters)
         return v_, acc
 
-    ms_f, _ = _timed_window(fwd_chain, v, xb, iters)
+    ms_f, cs_f = _timed_window(fwd_chain, v, xb, iters)
+    print(f"forward_only_ms={ms_f:.2f} (compile {cs_f:.1f}s)",
+          file=sys.stderr)
     out["forward_only_ms"] = round(ms_f, 2)
     out["backward_update_ms"] = round(out["train_full_ms"] - ms_f, 2)
 

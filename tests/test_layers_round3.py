@@ -355,3 +355,68 @@ def test_cnn_loss_broadcast_mask_normalization():
     masked = layer.compute_loss({}, {}, x, labels, mask=mask)
     trunc = layer.compute_loss({}, {}, x[:2], labels[:2])
     np.testing.assert_allclose(float(masked), float(trunc), rtol=1e-5)
+
+
+class TestConvLSTM2D:
+    """Native ConvLSTM2D (↔ KerasConvLSTM2D import target; parity vs real
+    keras is pinned in tests/test_modelimport.py::TestKerasConvLSTM)."""
+
+    def test_shapes_and_config_roundtrip(self):
+        layer = L.ConvLSTM2D(filters=4, kernel=3, stride=1, padding="SAME",
+                             return_sequences=True)
+        assert layer.output_shape((5, 8, 8, 3)) == (5, 8, 8, 4)
+        layer2 = L.ConvLSTM2D(filters=4, kernel=(3, 2), stride=(2, 2),
+                              padding="VALID", return_sequences=False)
+        assert layer2.output_shape((5, 8, 8, 3)) == (3, 4, 4)
+        from deeplearning4j_tpu.nn.config import config_to_json
+
+        back = config_from_json(config_to_json(layer2))
+        assert back.filters == 4 and tuple(back.kernel) == (3, 2)
+
+    def test_unit_forget_bias_and_grad_flow(self):
+        layer = L.ConvLSTM2D(filters=2, kernel=2, padding="VALID")
+        params, _ = layer.init(jax.random.key(0), (3, 5, 5, 1), jnp.float32)
+        b = np.asarray(params["b"])
+        np.testing.assert_array_equal(b[2:4], 1.0)  # f-gate slice
+        np.testing.assert_array_equal(np.delete(b, [2, 3]), 0.0)
+
+        x = jax.random.normal(jax.random.key(1), (2, 3, 5, 5, 1))
+
+        def loss(p):
+            y, _ = layer.apply(p, {}, x)
+            return jnp.sum(y ** 2)
+
+        grads = jax.grad(loss)(params)
+        for name in ("W", "RW", "b"):
+            assert float(jnp.max(jnp.abs(grads[name]))) > 0.0, name
+
+    def test_trains_in_sequential(self):
+        from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                                  SequentialConfig)
+        from deeplearning4j_tpu.nn.model import SequentialModel
+        from deeplearning4j_tpu.train.trainer import Trainer
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(seed=0, updater=Adam(3e-3)),
+            input_shape=(3, 6, 6, 1),
+            layers=[
+                L.ConvLSTM2D(filters=3, kernel=3, padding="SAME",
+                             return_sequences=False),
+                L.Flatten(),
+                L.OutputLayer(units=2, activation="softmax", loss="mcxent"),
+            ]))
+        trainer = Trainer(model)
+        ts = trainer.init_state()
+        r = np.random.default_rng(0)
+        batch = {
+            "features": jnp.asarray(
+                r.normal(size=(8, 3, 6, 6, 1)).astype(np.float32)),
+            "labels": jnp.asarray(
+                np.eye(2, dtype=np.float32)[r.integers(0, 2, 8)]),
+        }
+        losses = []
+        for _ in range(15):
+            ts, m = trainer.train_step(ts, batch)
+            losses.append(float(m["total_loss"]))
+        assert losses[-1] < losses[0] * 0.8, losses

@@ -598,3 +598,37 @@ class TestBidirectionalDirMatcher:
         ])
         x = np.random.default_rng(2).normal(size=(2, 5, 4)).astype(np.float32)
         _compare_keras(km, _save(km, tmp_path), x, rtol=1e-3, atol=1e-4)
+
+
+class TestKerasConvLSTM:
+    def test_conv_lstm2d(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 8, 8, 3)),
+            tf.keras.layers.ConvLSTM2D(5, 3, padding="same",
+                                       return_sequences=True),
+            tf.keras.layers.ConvLSTM2D(4, 3, padding="valid",
+                                       return_sequences=False),
+        ])
+        x = np.random.RandomState(7).randn(2, 4, 8, 8, 3).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x, rtol=1e-3, atol=1e-4)
+
+    def test_conv_lstm2d_head(self, tmp_path):
+        """ConvLSTM2D -> Flatten -> Dense (classification head shape)."""
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((3, 6, 6, 2)),
+            tf.keras.layers.ConvLSTM2D(3, (2, 2), strides=(2, 2),
+                                       padding="valid",
+                                       return_sequences=False),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(4, activation="softmax"),
+        ])
+        x = np.random.RandomState(8).randn(3, 3, 6, 6, 2).astype(np.float32)
+        _compare_keras(km, _save(km, tmp_path), x, rtol=1e-3, atol=1e-4)
+
+    def test_conv_lstm2d_go_backwards_refused(self, tmp_path):
+        km = tf.keras.Sequential([
+            tf.keras.layers.Input((3, 6, 6, 2)),
+            tf.keras.layers.ConvLSTM2D(3, 2, go_backwards=True),
+        ])
+        with pytest.raises(KerasImportError, match="go_backwards"):
+            import_keras_model(_save(km, tmp_path))
