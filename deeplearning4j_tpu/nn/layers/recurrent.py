@@ -139,6 +139,7 @@ class GRU(LayerConfig):
     units: int = 0
     weight_init: Optional[str] = None
     return_sequences: bool = True
+    backend: str = "xla"  # 'xla' | 'pallas' (kernels/gru_scan.py)
     unroll: int = 1
 
     def output_shape(self, input_shape):
@@ -165,10 +166,17 @@ class GRU(LayerConfig):
         return h, h
 
     def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
-        outputs, final = opsrnn.gru(
-            x, params["W"], params["RW"], params["b"], init_h=initial_state,
-            unroll=self.unroll,
-        )
+        if self.backend == "pallas":
+            from deeplearning4j_tpu.kernels import gru_scan
+
+            outputs, _final = gru_scan.gru(
+                x, params["W"], params["RW"], params["b"],
+                init_h=initial_state)
+        else:
+            outputs, _final = opsrnn.gru(
+                x, params["W"], params["RW"], params["b"],
+                init_h=initial_state, unroll=self.unroll,
+            )
         if not self.return_sequences:
             return outputs[:, -1, :], state
         return outputs, state

@@ -167,6 +167,44 @@ def _lstm_ab(iters=30):
     return out
 
 
+def _gru_ab(iters=30):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.kernels import gru_scan
+    from deeplearning4j_tpu.ops import rnn as opsrnn
+
+    N, T, H, C = 32, 256, 256, 256
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(N, T, C)) * 0.1, jnp.float32)
+    w_x = jnp.asarray(r.normal(size=(C, 3 * H)) * 0.05, jnp.float32)
+    w_h = jnp.asarray(r.normal(size=(H, 3 * H)) * 0.05, jnp.float32)
+    b = jnp.asarray(r.normal(size=(3 * H,)) * 0.05, jnp.float32)
+
+    out = {"shape": f"N{N} T{T} H{H}", "iters": iters}
+
+    pallas_f = jax.jit(lambda x: gru_scan.gru(x, w_x, w_h, b)[0])
+    xla_f = jax.jit(lambda x: opsrnn.gru(x, w_x, w_h, b)[0])
+    op, ox = pallas_f(x), xla_f(x)
+    out["fwd_max_rel_err"] = _max_rel_err(op, ox)
+
+    gpallas = jax.jit(jax.grad(lambda x: jnp.sum(pallas_f(x) ** 2)))
+    gxla = jax.jit(jax.grad(lambda x: jnp.sum(xla_f(x) ** 2)))
+    gp, gx = gpallas(x), gxla(x)
+    out["bwd_max_rel_err"] = _max_rel_err(gp, gx)
+
+    out["fwd_ms"] = {"pallas": _time_fn(pallas_f, (x,), iters),
+                     "xla": _time_fn(xla_f, (x,), iters)}
+    out["bwd_ms"] = {"pallas": _time_fn(gpallas, (x,), iters),
+                     "xla": _time_fn(gxla, (x,), iters)}
+    out["fwd_speedup"] = round(out["fwd_ms"]["xla"] / out["fwd_ms"]["pallas"], 3)
+    out["bwd_speedup"] = round(out["bwd_ms"]["xla"] / out["bwd_ms"]["pallas"], 3)
+    out["parity"] = bool(out["fwd_max_rel_err"] < 2e-2
+                         and out["bwd_max_rel_err"] < 2e-2)
+    return out
+
+
 def _flash_tune(iters=8, B=8, H=12, T=512, D=64, causal=False):
     """On-chip block-size sweep for the flash kernel (VERDICT r3 #2).
 
@@ -245,7 +283,7 @@ def run_kernels_ab(diag: dict, include_tune: bool = True) -> dict:
              ("flash_attention_1024", flash_1024),
              ("flash_attention_long", flash_long)]
             + tune_legs
-            + [("lstm_scan", _lstm_ab)])
+            + [("lstm_scan", _lstm_ab), ("gru_scan", _gru_ab)])
     for name, fn in legs:
         try:
             result[name] = fn()

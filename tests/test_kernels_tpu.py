@@ -84,3 +84,10 @@ def test_flash_attention_long_context_parity(ab_result):
     assert fl is not None, sorted(ab_result)
     assert "error" not in fl, fl
     assert fl["parity"], fl
+
+
+def test_gru_compiled_parity(ab_result):
+    gs = ab_result["gru_scan"]
+    assert "error" not in gs, gs
+    assert gs["parity"], gs
+    assert "fwd_speedup" in gs and "bwd_speedup" in gs
