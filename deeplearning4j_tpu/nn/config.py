@@ -91,6 +91,11 @@ class LayerConfig:
     l2: Optional[float] = field(default=None, kw_only=True)
     # Per-layer dtype override; None → model default.
     dtype: Optional[str] = field(default=None, kw_only=True)
+    # Train-time weight transform (↔ Layer.weightNoise: DropConnect /
+    # WeightNoise from nn/weightnoise.py). Applied by the model containers
+    # to this layer's params each training forward pass; inference uses
+    # the raw weights.
+    weight_noise: Optional[Any] = field(default=None, kw_only=True)
 
     # -- interface ---------------------------------------------------------
     def output_shape(self, input_shape: Tuple[int, ...]) -> Tuple[int, ...]:

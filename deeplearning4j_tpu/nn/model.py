@@ -37,6 +37,7 @@ from deeplearning4j_tpu.nn.config import (
     NeuralNetConfiguration,
     SequentialConfig,
 )
+from deeplearning4j_tpu.nn.weightnoise import apply_weight_noise
 
 # Param keys exempt from l1/l2 regularization (biases & norm scales — the
 # reference likewise regularizes weights only by default).
@@ -109,8 +110,10 @@ class SequentialModel:
             name = self.layer_names[i]
             layer = self.layers[i]
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            p = apply_weight_noise(
+                layer, params.get(name, {}), lrng, train)
             x, s = layer.apply(
-                params.get(name, {}), state.get(name, {}), x, train=train, rng=lrng
+                p, state.get(name, {}), x, train=train, rng=lrng
             )
             if s:
                 new_state[name] = s
@@ -133,8 +136,12 @@ class SequentialModel:
             raise TypeError(
                 f"last layer {type(out_layer).__name__} is not an output layer"
             )
+        out_i = len(self.layers) - 1
+        orng = jax.random.fold_in(rng, out_i) if rng is not None else None
+        out_params = apply_weight_noise(
+            out_layer, params.get(out_name, {}), orng, True)
         loss = out_layer.compute_loss(
-            params.get(out_name, {}), state.get(out_name, {}), x, batch["labels"],
+            out_params, state.get(out_name, {}), x, batch["labels"],
             mask=batch.get("mask"), weights=batch.get("weights"),
         )
         reg = self._regularization(params)
@@ -394,8 +401,12 @@ class GraphModel:
                     "not an output layer — inference-only heads (e.g. an "
                     "embedding bottleneck) cannot be trained directly; add "
                     "a loss head for training")
+            orng = (jax.random.fold_in(rng, self.order.index(name))
+                    if rng is not None else None)
+            out_params = apply_weight_noise(
+                v.layer, params.get(name, {}), orng, True)
             loss = v.layer.compute_loss(
-                params.get(name, {}), state.get(name, {}), x_in, labels[name],
+                out_params, state.get(name, {}), x_in, labels[name],
                 mask=batch.get("mask"), weights=batch.get("weights"),
             )
             total = total + loss
@@ -417,8 +428,10 @@ class GraphModel:
             xs = [values[inp] for inp in v.inputs]
             if v.kind == "layer":
                 lrng = jax.random.fold_in(rng, i) if rng is not None else None
+                p = apply_weight_noise(
+                    v.layer, params.get(name, {}), lrng, train)
                 y, s = v.layer.apply(
-                    params.get(name, {}), state.get(name, {}), xs[0],
+                    p, state.get(name, {}), xs[0],
                     train=train, rng=lrng,
                 )
                 if s:

@@ -1,0 +1,107 @@
+"""Weight noise / DropConnect (↔ org.deeplearning4j.nn.conf.weightnoise.*).
+
+ref: the reference attaches an ``IWeightNoise`` to a layer config
+(``.weightNoise(new DropConnect(0.9))``); at each training forward pass the
+layer's weight view is transformed before use — DropConnect masks weights
+with a Bernoulli keep pattern, WeightNoise adds/multiplies noise drawn from
+a distribution. Inference uses the raw weights.
+
+TPU-native shape: a pure ``transform(params, rng, train)`` the model
+containers apply to a layer's param dict right before ``layer.apply`` (and
+before the output layer's ``compute_loss``) when training. The transform
+sits inside the jitted step, so the mask/noise is generated on-device and
+fused; params themselves are never mutated.
+
+Weight keys: every param whose name is not in the no-regularization set
+(biases, norm scales, peepholes...) — the same classification the l1/l2
+collector uses — unless ``apply_to_bias`` opts biases in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import register_config
+
+# Mirrors model._NO_REG_KEYS (import would be circular: model imports
+# layer configs which may carry these objects).
+_NON_WEIGHT_KEYS = {"b", "beta", "gamma", "pI", "pF", "pO", "alpha",
+                    "mean", "var"}
+
+
+def _is_weight(key: str, apply_to_bias: bool) -> bool:
+    return apply_to_bias or key not in _NON_WEIGHT_KEYS
+
+
+@register_config
+@dataclass
+class DropConnect:
+    """↔ weightnoise.DropConnect(weightRetainProb).
+
+    Each weight element is kept with probability ``p`` and scaled by
+    ``1/p`` (inverted-dropout scaling, matching the reference's use of the
+    nd4j dropout op on the weight view), so activation magnitudes match
+    inference without a separate rescale there.
+    """
+
+    p: float = 0.5  # retain probability
+    apply_to_bias: bool = False
+
+    def transform(self, params, rng, train: bool):
+        if not train or self.p >= 1.0:
+            return params
+        out = {}
+        for i, (k, w) in enumerate(sorted(params.items())):
+            if _is_weight(k, self.apply_to_bias):
+                mask = jax.random.bernoulli(
+                    jax.random.fold_in(rng, i), self.p, w.shape)
+                out[k] = jnp.where(mask, w / self.p, 0.0).astype(w.dtype)
+            else:
+                out[k] = w
+        return out
+
+
+@register_config
+@dataclass
+class WeightNoise:
+    """↔ weightnoise.WeightNoise(distribution, applyToBias, additive).
+
+    Gaussian N(mean, std) noise, added (``additive=True``) or multiplied
+    (x * (1+n), matching the reference's multiplicative branch) onto the
+    weight view at each training step.
+    """
+
+    mean: float = 0.0
+    std: float = 0.1
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def transform(self, params, rng, train: bool):
+        if not train or (self.std == 0.0 and self.mean == 0.0):
+            return params
+        out = {}
+        for i, (k, w) in enumerate(sorted(params.items())):
+            if _is_weight(k, self.apply_to_bias):
+                n = (self.mean + self.std * jax.random.normal(
+                    jax.random.fold_in(rng, i), w.shape)).astype(w.dtype)
+                out[k] = w + n if self.additive else w * (1.0 + n)
+            else:
+                out[k] = w
+        return out
+
+
+def apply_weight_noise(layer, params, rng, train: bool):
+    """Container hook: transform a layer's params if it carries noise.
+
+    ``rng`` may be None (inference/no-rng fit paths) — noise then stays
+    off, matching a train=False pass.
+    """
+    wn = getattr(layer, "weight_noise", None)
+    if wn is None or not train or rng is None or not params:
+        return params
+    # A distinct fold tag keeps the noise stream independent of the
+    # layer's own dropout rng (both derive from the same per-layer key).
+    return wn.transform(params, jax.random.fold_in(rng, 0x5EED), train)

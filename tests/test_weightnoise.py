@@ -1,0 +1,119 @@
+"""DropConnect / WeightNoise tests (↔ weightnoise.* in the reference;
+TestWeightNoise pattern: train-time transform, inference untouched)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                          SequentialConfig, config_from_json,
+                                          config_to_json)
+from deeplearning4j_tpu.nn.model import SequentialModel
+from deeplearning4j_tpu.nn.weightnoise import DropConnect, WeightNoise
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.train.updaters import Adam
+
+
+def _model(noise):
+    return SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(seed=0, updater=Adam(1e-2)),
+        input_shape=(8,),
+        layers=[
+            L.Dense(units=32, activation="relu", weight_noise=noise),
+            L.OutputLayer(units=4, activation="softmax", loss="mcxent"),
+        ]))
+
+
+def test_dropconnect_masks_at_train_only():
+    model = _model(DropConnect(p=0.5))
+    v = model.init(seed=0)
+    x = jnp.ones((16, 8))
+    y_inf, _ = model.apply(v, x)
+    y_inf2, _ = model.apply(v, x)
+    np.testing.assert_array_equal(np.asarray(y_inf), np.asarray(y_inf2))
+
+    y_tr1, _ = model.apply(v, x, train=True, rng=jax.random.key(1))
+    y_tr2, _ = model.apply(v, x, train=True, rng=jax.random.key(2))
+    # different masks -> different activations; both differ from inference
+    assert np.abs(np.asarray(y_tr1) - np.asarray(y_tr2)).max() > 1e-6
+    assert np.abs(np.asarray(y_tr1) - np.asarray(y_inf)).max() > 1e-6
+
+
+def test_dropconnect_keep_fraction_and_scaling():
+    dc = DropConnect(p=0.8)
+    w = jnp.ones((64, 64))
+    out = dc.transform({"W": w, "b": jnp.ones((64,))},
+                       jax.random.key(0), train=True)
+    vals = np.asarray(out["W"]).ravel()
+    kept = vals != 0.0
+    assert abs(kept.mean() - 0.8) < 0.05
+    np.testing.assert_allclose(vals[kept], 1.0 / 0.8, rtol=1e-6)
+    # bias untouched by default
+    np.testing.assert_array_equal(np.asarray(out["b"]), 1.0)
+
+
+def test_weight_noise_additive_and_multiplicative():
+    w = jnp.full((32, 32), 2.0)
+    add = WeightNoise(std=0.1, additive=True).transform(
+        {"W": w}, jax.random.key(0), train=True)["W"]
+    mul = WeightNoise(std=0.1, additive=False).transform(
+        {"W": w}, jax.random.key(0), train=True)["W"]
+    d_add = np.asarray(add) - 2.0
+    d_mul = np.asarray(mul) - 2.0
+    assert 0.05 < d_add.std() < 0.2
+    # multiplicative: w*(1+n) -> deviation std = 2*std(n)
+    assert 0.1 < d_mul.std() < 0.4
+    # train=False is identity
+    same = WeightNoise(std=0.1).transform({"W": w}, jax.random.key(0),
+                                          train=False)["W"]
+    np.testing.assert_array_equal(np.asarray(same), np.asarray(w))
+
+
+def test_config_json_roundtrip_with_noise():
+    cfg = _model(DropConnect(p=0.7)).config
+    back = config_from_json(config_to_json(cfg))
+    assert isinstance(back.layers[0].weight_noise, DropConnect)
+    assert back.layers[0].weight_noise.p == 0.7
+
+    cfg2 = _model(WeightNoise(std=0.05, additive=False)).config
+    back2 = config_from_json(config_to_json(cfg2))
+    wn = back2.layers[0].weight_noise
+    assert isinstance(wn, WeightNoise) and not wn.additive
+
+
+def test_trains_with_dropconnect():
+    model = _model(DropConnect(p=0.9))
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    r = np.random.default_rng(0)
+    batch = {"features": jnp.asarray(r.normal(size=(32, 8)),
+                                     dtype=jnp.float32),
+             "labels": jnp.asarray(
+                 np.eye(4, dtype=np.float32)[r.integers(0, 4, 32)])}
+    losses = []
+    for _ in range(30):
+        ts, m = trainer.train_step(ts, batch)
+        losses.append(float(m["total_loss"]))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_weight_noise_on_output_layer_loss_path():
+    """Noise on the OUTPUT layer must reach compute_loss (the output layer
+    is excluded from the forward loop)."""
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(seed=0),
+        input_shape=(8,),
+        layers=[L.Dense(units=16),
+                L.OutputLayer(units=4, loss="mcxent", activation="softmax",
+                              weight_noise=WeightNoise(std=0.5))]))
+    v = model.init(seed=0)
+    r = np.random.default_rng(1)
+    batch = {"features": jnp.asarray(r.normal(size=(8, 8)), jnp.float32),
+             "labels": jnp.asarray(
+                 np.eye(4, dtype=np.float32)[r.integers(0, 4, 8)])}
+    l1, _ = model.loss_fn(v["params"], v["state"], batch,
+                          rng=jax.random.key(1))
+    l2, _ = model.loss_fn(v["params"], v["state"], batch,
+                          rng=jax.random.key(2))
+    assert abs(float(l1) - float(l2)) > 1e-6
