@@ -96,6 +96,11 @@ class LayerConfig:
     # to this layer's params each training forward pass; inference uses
     # the raw weights.
     weight_noise: Optional[Any] = field(default=None, kw_only=True)
+    # Post-update weight projections (↔ Layer.constrainWeights /
+    # constraint.* : MaxNorm/MinMaxNorm/UnitNorm/NonNegative from
+    # nn/constraints.py). One constraint or a list; the Trainer projects
+    # this layer's weights right after every updater step.
+    constraints: Optional[Any] = field(default=None, kw_only=True)
 
     # -- interface ---------------------------------------------------------
     def output_shape(self, input_shape: Tuple[int, ...]) -> Tuple[int, ...]:

@@ -1,0 +1,125 @@
+"""Weight constraints (↔ org.deeplearning4j.nn.conf.constraint.*).
+
+ref: the reference attaches ``LayerConstraint``s to layers
+(``.constrainWeights(new MaxNormConstraint(m, 1))``); after every updater
+step the constraint PROJECTS the weights back into its feasible set
+(max-norm clip, unit-norm rescale, non-negativity...). Applied to weight
+params only (the same weight/bias classification as l1/l2) unless
+``apply_to_bias``.
+
+TPU-native shape: a pure ``project(param)`` per constraint; the Trainer
+maps it over a layer's weight params right after ``apply_updates`` inside
+the jitted step, so the projection fuses with the update.
+
+Axis convention: norms are taken over ``axis`` (default 0, the fan-in
+axis of [in, out] dense kernels and the flattened-receptive-field axes of
+HWIO conv kernels are 0..ndim-2; passing axis=None uses all-but-last,
+which matches the reference's per-output-neuron norm for both layouts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import register_config
+
+_NON_WEIGHT_KEYS = {"b", "beta", "gamma", "pI", "pF", "pO", "alpha",
+                    "mean", "var"}
+_EPS = 1e-12
+
+
+def _axes(w, axis):
+    if axis is None:
+        return tuple(range(w.ndim - 1)) or (0,)
+    return (axis,) if isinstance(axis, int) else tuple(axis)
+
+
+def _norms(w, axis):
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=_axes(w, axis),
+                            keepdims=True))
+
+
+@register_config
+@dataclass
+class MaxNorm:
+    """↔ MaxNormConstraint: rescale any per-neuron norm above ``max_norm``
+    down onto the sphere."""
+
+    max_norm: float = 2.0
+    axis: Optional[int] = None
+    apply_to_bias: bool = False
+
+    def project(self, w):
+        n = _norms(w, self.axis)
+        scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(n, _EPS))
+        return (w * scale).astype(w.dtype)
+
+
+@register_config
+@dataclass
+class MinMaxNorm:
+    """↔ MinMaxNormConstraint: pull norms into [min_norm, max_norm] at
+    ``rate`` (rate=1 → hard projection)."""
+
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+    axis: Optional[int] = None
+    apply_to_bias: bool = False
+
+    def project(self, w):
+        n = _norms(w, self.axis)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * n
+        return (w * (target / jnp.maximum(n, _EPS))).astype(w.dtype)
+
+
+@register_config
+@dataclass
+class UnitNorm:
+    """↔ UnitNormConstraint: renormalize each neuron to norm 1."""
+
+    axis: Optional[int] = None
+    apply_to_bias: bool = False
+
+    def project(self, w):
+        return (w / jnp.maximum(_norms(w, self.axis), _EPS)).astype(w.dtype)
+
+
+@register_config
+@dataclass
+class NonNegative:
+    """↔ NonNegativeConstraint: clamp below at 0."""
+
+    apply_to_bias: bool = False
+
+    def project(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+def constrain_params(layers_named, params):
+    """Project every constrained layer's params; pure, jit-safe.
+
+    ``layers_named``: iterable of (name, layer_config). Layers declare
+    constraints via ``LayerConfig.constraints`` (one constraint or a
+    list). Returns a new params dict (shared subtrees reused).
+    """
+    out = dict(params)
+    for name, layer in layers_named:
+        cons = getattr(layer, "constraints", None)
+        if not cons or name not in out:
+            continue
+        if not isinstance(cons, (list, tuple)):
+            cons = [cons]
+        lp = dict(out[name])
+        for k, w in lp.items():
+            for c in cons:
+                if k in _NON_WEIGHT_KEYS and not c.apply_to_bias:
+                    continue
+                w = c.project(w)
+            lp[k] = w
+        out[name] = lp
+    return out

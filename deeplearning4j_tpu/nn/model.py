@@ -94,6 +94,10 @@ class SequentialModel:
                 state[name] = s
         return {"params": params, "state": state}
 
+    def named_layers(self):
+        """(name, layer_config) pairs — the Trainer's constraint hook."""
+        return list(zip(self.layer_names, self.layers))
+
     # -- pure functions (traced under jit) ---------------------------------
 
     def apply(self, variables, x, *, train: bool = False, rng=None,
@@ -347,6 +351,11 @@ class GraphModel:
         if v.kind in _VERTEX_OPS:
             return tuple(_VERTEX_OPS[v.kind][1](in_shapes, v.args))
         return tuple(in_shapes[0])
+
+    def named_layers(self):
+        """(name, layer_config) pairs — the Trainer's constraint hook."""
+        return [(n, self.config.vertices[n].layer) for n in self.order
+                if self.config.vertices[n].kind == "layer"]
 
     def init(self, seed: Optional[int] = None):
         seed = self.net.seed if seed is None else seed

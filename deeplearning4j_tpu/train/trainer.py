@@ -115,6 +115,15 @@ class Trainer:
 
         mixed = bool(getattr(self.net, "mixed_precision", False))
 
+        # Post-update weight projections (↔ BaseLayer.constrainWeights +
+        # constraint.*): collect once; the step applies them only when any
+        # layer declares one, so unconstrained models pay nothing.
+        named = (model.named_layers()
+                 if hasattr(model, "named_layers") else [])
+        self._constrained_layers = [
+            (n, l) for n, l in named
+            if getattr(l, "constraints", None)]
+
         def _to_bf16(tree):
             return jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.bfloat16)
@@ -144,6 +153,11 @@ class Trainer:
             updates, new_opt = self._upd_update(grads, ts.opt_state, ts.params, ts.step)
             updates = self._mask_frozen(updates)
             new_params = apply_updates(ts.params, updates)
+            if self._constrained_layers:
+                from deeplearning4j_tpu.nn.constraints import constrain_params
+
+                new_params = constrain_params(
+                    self._constrained_layers, new_params)
             metrics = dict(metrics)
             metrics["total_loss"] = loss
             feats = jax.tree_util.tree_leaves(batch["features"])

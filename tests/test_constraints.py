@@ -1,0 +1,104 @@
+"""Weight-constraint tests (↔ constraint.* / TestConstraints pattern:
+after every updater step the constrained weights satisfy the bound)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                          SequentialConfig, config_from_json,
+                                          config_to_json)
+from deeplearning4j_tpu.nn.constraints import (MaxNorm, MinMaxNorm,
+                                               NonNegative, UnitNorm)
+from deeplearning4j_tpu.nn.model import SequentialModel
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.train.updaters import Sgd
+
+
+def _col_norms(w):
+    return np.sqrt((np.asarray(w) ** 2).sum(axis=0))
+
+
+def test_projections():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(16, 8)) * 3.0,
+                    jnp.float32)
+    mn = MaxNorm(max_norm=1.5).project(w)
+    assert _col_norms(mn).max() <= 1.5 + 1e-5
+    un = UnitNorm().project(w)
+    np.testing.assert_allclose(_col_norms(un), 1.0, rtol=1e-5)
+    mm = MinMaxNorm(min_norm=0.5, max_norm=1.0).project(w)
+    n = _col_norms(mm)
+    assert n.min() >= 0.5 - 1e-5 and n.max() <= 1.0 + 1e-5
+    nn_ = NonNegative().project(w)
+    assert np.asarray(nn_).min() >= 0.0
+
+
+def test_minmaxnorm_partial_rate():
+    w = jnp.full((4, 4), 10.0)  # col norm 20
+    half = MinMaxNorm(min_norm=0.0, max_norm=2.0, rate=0.5).project(w)
+    np.testing.assert_allclose(_col_norms(half), 11.0, rtol=1e-5)  # 0.5*2+0.5*20
+
+
+def test_constraint_enforced_every_step():
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(seed=0, updater=Sgd(0.5)),
+        input_shape=(8,),
+        layers=[
+            L.Dense(units=16, activation="tanh",
+                    constraints=MaxNorm(max_norm=1.0, axis=0)),
+            L.OutputLayer(units=2, activation="softmax", loss="mcxent"),
+        ]))
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    r = np.random.default_rng(0)
+    batch = {"features": jnp.asarray(r.normal(size=(16, 8)), jnp.float32),
+             "labels": jnp.asarray(
+                 np.eye(2, dtype=np.float32)[r.integers(0, 2, 16)])}
+    name = model.layer_names[0]
+    for _ in range(5):
+        ts, _m = trainer.train_step(ts, batch)
+        norms = _col_norms(ts.params[name]["W"])
+        assert norms.max() <= 1.0 + 1e-4, norms.max()
+    # bias NOT projected (apply_to_bias default False): biases may move
+    # freely — just check they were trained
+    assert np.abs(np.asarray(ts.params[name]["b"])).max() > 0.0
+
+
+def test_constraint_json_roundtrip():
+    cfg = SequentialConfig(
+        net=NeuralNetConfiguration(seed=0), input_shape=(4,),
+        layers=[L.Dense(units=3, constraints=[MaxNorm(max_norm=3.0),
+                                              NonNegative()]),
+                L.OutputLayer(units=2)])
+    back = config_from_json(config_to_json(cfg))
+    cons = back.layers[0].constraints
+    assert isinstance(cons[0], MaxNorm) and cons[0].max_norm == 3.0
+    assert isinstance(cons[1], NonNegative)
+
+
+def test_graph_model_constraints():
+    from deeplearning4j_tpu.nn.config import GraphConfig, GraphVertex
+    from deeplearning4j_tpu.nn.model import GraphModel
+
+    cfg = GraphConfig(
+        net=NeuralNetConfiguration(seed=0, updater=Sgd(0.5)),
+        inputs=["input"], input_shapes={"input": (6,)},
+        vertices={
+            "d": GraphVertex(kind="layer", inputs=["input"],
+                             layer=L.Dense(units=8, constraints=UnitNorm())),
+            "out": GraphVertex(kind="layer", inputs=["d"],
+                               layer=L.OutputLayer(units=2, loss="mcxent",
+                                                   activation="softmax")),
+        },
+        outputs=["out"])
+    model = GraphModel(cfg)
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    r = np.random.default_rng(1)
+    batch = {"features": jnp.asarray(r.normal(size=(8, 6)), jnp.float32),
+             "labels": jnp.asarray(
+                 np.eye(2, dtype=np.float32)[r.integers(0, 2, 8)])}
+    ts, _ = trainer.train_step(ts, batch)
+    np.testing.assert_allclose(_col_norms(ts.params["d"]["W"]), 1.0,
+                               rtol=1e-4)
