@@ -703,6 +703,48 @@ def register_keras_layer(class_name: str, mapper: Callable) -> None:
     LAYER_MAPPERS[class_name] = mapper
 
 
+def _constraint(spec, *, keys):
+    """One serialized keras constraint → nn.constraints config.
+
+    ↔ KerasConstraintUtils — the reference maps keras kernel/bias
+    constraints onto its LayerConstraint set on import so retraining the
+    imported model keeps enforcing them. ``keys`` pins the constraint to
+    the exact param it governed in keras (kernel_constraint → "W",
+    bias_constraint → "b").
+    """
+    from deeplearning4j_tpu.nn import constraints as C
+
+    name = spec.get("class_name")
+    c = spec.get("config", {})
+    axis = c.get("axis", 0)
+    axis = axis[0] if isinstance(axis, list) and len(axis) == 1 else axis
+    bias = "b" in keys
+    if name == "MaxNorm":
+        return C.MaxNorm(max_norm=c.get("max_value", 2.0), axis=axis,
+                         apply_to_bias=bias, keys=keys)
+    if name == "MinMaxNorm":
+        return C.MinMaxNorm(min_norm=c.get("min_value", 0.0),
+                            max_norm=c.get("max_value", 1.0),
+                            rate=c.get("rate", 1.0), axis=axis,
+                            apply_to_bias=bias, keys=keys)
+    if name == "UnitNorm":
+        return C.UnitNorm(axis=axis, apply_to_bias=bias, keys=keys)
+    if name == "NonNeg":
+        return C.NonNegative(apply_to_bias=bias, keys=keys)
+    raise KerasImportError(f"unsupported keras constraint {name!r}")
+
+
+def _attach_constraints(layer, cfg: dict):
+    cons = []
+    if cfg.get("kernel_constraint"):
+        cons.append(_constraint(cfg["kernel_constraint"], keys=("W",)))
+    if cfg.get("bias_constraint"):
+        cons.append(_constraint(cfg["bias_constraint"], keys=("b",)))
+    if cons and layer is not None:
+        layer.constraints = cons
+    return layer
+
+
 def _map_layer(class_name: str, cfg: dict):
     if class_name == "InputLayer":
         return None, {}
@@ -712,7 +754,8 @@ def _map_layer(class_name: str, cfg: dict):
             f"no mapper for Keras layer {class_name!r} "
             f"(supported: {sorted(LAYER_MAPPERS)}). Custom layers can be "
             "registered via register_keras_layer(class_name, mapper)")
-    return mapper(cfg)
+    layer, wmap = mapper(cfg)
+    return _attach_constraints(layer, cfg), wmap
 
 
 # --- weights ---------------------------------------------------------------

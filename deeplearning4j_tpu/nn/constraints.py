@@ -51,6 +51,7 @@ class MaxNorm:
     max_norm: float = 2.0
     axis: Optional[int] = None
     apply_to_bias: bool = False
+    keys: Optional[tuple] = None  # restrict to these param names
 
     def project(self, w):
         n = _norms(w, self.axis)
@@ -69,6 +70,7 @@ class MinMaxNorm:
     rate: float = 1.0
     axis: Optional[int] = None
     apply_to_bias: bool = False
+    keys: Optional[tuple] = None
 
     def project(self, w):
         n = _norms(w, self.axis)
@@ -84,6 +86,7 @@ class UnitNorm:
 
     axis: Optional[int] = None
     apply_to_bias: bool = False
+    keys: Optional[tuple] = None
 
     def project(self, w):
         return (w / jnp.maximum(_norms(w, self.axis), _EPS)).astype(w.dtype)
@@ -95,6 +98,7 @@ class NonNegative:
     """↔ NonNegativeConstraint: clamp below at 0."""
 
     apply_to_bias: bool = False
+    keys: Optional[tuple] = None
 
     def project(self, w):
         return jnp.maximum(w, 0.0)
@@ -117,7 +121,11 @@ def constrain_params(layers_named, params):
         lp = dict(out[name])
         for k, w in lp.items():
             for c in cons:
-                if k in _NON_WEIGHT_KEYS and not c.apply_to_bias:
+                keys = getattr(c, "keys", None)
+                if keys is not None:
+                    if k not in keys:
+                        continue
+                elif k in _NON_WEIGHT_KEYS and not c.apply_to_bias:
                     continue
                 w = c.project(w)
             lp[k] = w

@@ -102,3 +102,43 @@ def test_graph_model_constraints():
     ts, _ = trainer.train_step(ts, batch)
     np.testing.assert_allclose(_col_norms(ts.params["d"]["W"]), 1.0,
                                rtol=1e-4)
+
+
+def test_keras_import_maps_constraints(tmp_path):
+    """kernel_constraint/bias_constraint survive keras h5 import and are
+    enforced when the imported model is retrained (↔ KerasConstraintUtils)."""
+    import tensorflow as tf
+
+    from deeplearning4j_tpu.modelimport.keras import import_keras_model
+
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(
+            8, kernel_constraint=tf.keras.constraints.MaxNorm(1.25),
+            bias_constraint=tf.keras.constraints.NonNeg()),
+        tf.keras.layers.Dense(2, activation="softmax"),
+    ])
+    p = str(tmp_path / "m.h5")
+    km.save(p)
+    model, variables = import_keras_model(p)
+    layer = model.layers[0]
+    cons = layer.constraints
+    assert len(cons) == 2
+    assert isinstance(cons[0], MaxNorm) and cons[0].max_norm == 1.25
+    assert cons[0].keys == ("W",) and cons[1].keys == ("b",)
+    assert isinstance(cons[1], NonNegative)
+
+    # Enforcement path: constrain_params (what the Trainer applies after
+    # every update) projects W to the max-norm ball and b to >= 0, and
+    # each constraint touches ONLY its keras-designated param.
+    from deeplearning4j_tpu.nn.constraints import constrain_params
+
+    name = model.layer_names[0]
+    big = dict(variables["params"])
+    big[name] = {"W": jnp.full((6, 8), 3.0), "b": jnp.full((8,), -1.0)}
+    projected = constrain_params(model.named_layers(), big)
+    assert _col_norms(projected[name]["W"]).max() <= 1.25 + 1e-4
+    assert np.asarray(projected[name]["b"]).min() >= 0.0
+    # NonNeg (bias_constraint) must NOT have clamped W's negatives:
+    w_in = np.asarray(big[name]["W"])
+    assert (np.sign(np.asarray(projected[name]["W"])) == np.sign(w_in)).all()
