@@ -105,7 +105,46 @@ def _register_tfimport_ops():
     def split_v(x, num_or_sizes, axis):
         return tuple(jnp.split(x, num_or_sizes, axis=axis))
 
+    def einsum_tf(*xs, equation):
+        return jnp.einsum(equation, *xs)
+
+    def cumsum_tf(x, axis=0, exclusive=False, reverse=False):
+        if reverse:
+            x = jnp.flip(x, axis)
+        y = jnp.cumsum(x, axis=axis)
+        if exclusive:
+            y = y - x  # shift: sum of strictly-earlier elements
+        if reverse:
+            y = jnp.flip(y, axis)
+        return y
+
+    def top_k_tf(x, k):
+        return tuple(jax.lax.top_k(x, k))
+
+    def resize_tf(x, size, method):
+        n, _, _, c = x.shape
+        return jax.image.resize(x, (n, int(size[0]), int(size[1]), c),
+                                method=method)
+
+    def conv2d_backprop_input(w, dy, input_sizes, strides, padding):
+        # transpose_kernel flips spatial + swaps I/O, making conv_transpose
+        # exactly the gradient of conv2d — the op Conv2DBackpropInput is.
+        return jax.lax.conv_transpose(
+            dy, w, strides=tuple(strides[1:3]), padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True)
+
+    def mirror_pad(x, paddings, mode="REFLECT"):
+        return jnp.pad(x, [tuple(p) for p in paddings],
+                       mode="reflect" if mode == "REFLECT" else "symmetric")
+
     table = {
+        "tfimport.einsum": einsum_tf,
+        "tfimport.cumsum": cumsum_tf,
+        "tfimport.top_k": top_k_tf,
+        "tfimport.resize": resize_tf,
+        "tfimport.conv2d_backprop_input": conv2d_backprop_input,
+        "tfimport.mirror_pad": mirror_pad,
         "tfimport.strided_slice": strided_slice,
         "tfimport.fused_batch_norm": fused_batch_norm,
         "tfimport.conv2d": conv2d_tf,
@@ -626,3 +665,124 @@ def freeze_tf_function(fn, *example_args):
     in_names = [t.name.split(":")[0] for t in frozen.inputs]
     out_names = [t.name for t in frozen.outputs]
     return gd, in_names, out_names
+
+
+@tf_op("Einsum")
+def _einsum(imp, node):
+    xs = [imp.tensor(r) for r in node.input]
+    return imp.sd._record("tfimport.einsum", xs, {
+        "__argspec__": ["var"] * len(xs), "__posattrs__": [],
+        "equation": _attr(node, "equation")})
+
+
+@tf_op("Slice")
+def _slice(imp, node):
+    x = imp.tensor(node.input[0])
+    begin = [int(v) for v in np.atleast_1d(imp.const_value(node.input[1]))]
+    size = [int(v) for v in np.atleast_1d(imp.const_value(node.input[2]))]
+    if x.shape is None or any(d is None for d in x.shape):
+        raise TFImportError("Slice needs a static input shape")
+    # TF size=-1 means "to the end of the dim"; lax.dynamic_slice wants
+    # concrete sizes — resolve here where the dim is known.
+    size = [d - b if s == -1 else s
+            for s, b, d in zip(size, begin, x.shape)]
+    return imp.sd._record("slice", [x], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "begin": begin, "size": size})
+
+
+@tf_op("SplitV")
+def _split_v(imp, node):
+    x = imp.tensor(node.input[0])
+    sizes = [int(v) for v in np.atleast_1d(imp.const_value(node.input[1]))]
+    axis = int(np.atleast_1d(imp.const_value(node.input[2]))[0])
+    if sizes.count(-1) > 1:
+        raise TFImportError("SplitV: at most one -1 size")
+    if -1 in sizes:
+        dim = (x.shape or [None])[axis]
+        if dim is None:
+            raise TFImportError("SplitV with -1 needs a static dim")
+        sizes[sizes.index(-1)] = dim - (sum(sizes) + 1)
+    # jnp.split takes cut INDICES when given a list — convert sizes.
+    idxs = list(np.cumsum(sizes)[:-1])
+    return imp.sd._record("tfimport.split", [x], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "num_or_sizes": [int(i) for i in idxs], "axis": axis})
+
+
+@tf_op("Unpack")
+def _unpack(imp, node):
+    x = imp.tensor(node.input[0])
+    return imp.sd._record("unstack", [x], {"axis": _attr(node, "axis", 0)})
+
+
+@tf_op("ArgMax", "ArgMin")
+def _argminmax(imp, node):
+    x = imp.tensor(node.input[0])
+    axis = int(np.atleast_1d(imp.const_value(node.input[1]))[0])
+    op = "argmax" if node.op == "ArgMax" else "argmin"
+    return imp.sd._record(op, [x], {"axis": axis})
+
+
+@tf_op("Cumsum")
+def _cumsum(imp, node):
+    x = imp.tensor(node.input[0])
+    axis = int(np.atleast_1d(imp.const_value(node.input[1]))[0])
+    return imp.sd._record("tfimport.cumsum", [x], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "axis": axis, "exclusive": bool(_attr(node, "exclusive", False)),
+        "reverse": bool(_attr(node, "reverse", False))})
+
+
+@tf_op("TopKV2")
+def _top_k(imp, node):
+    x = imp.tensor(node.input[0])
+    k = int(np.atleast_1d(imp.const_value(node.input[1]))[0])
+    return imp.sd._record("tfimport.top_k", [x], {
+        "__argspec__": ["var"], "__posattrs__": [], "k": k})
+
+
+@tf_op("ResizeBilinear", "ResizeNearestNeighbor")
+def _resize(imp, node):
+    x = imp.tensor(node.input[0])
+    size = [int(v) for v in np.atleast_1d(imp.const_value(node.input[1]))]
+    if _attr(node, "align_corners", False):
+        raise TFImportError(
+            f"{node.op}: align_corners=True (TF1 legacy grid) is not "
+            "supported; re-export with tf.image.resize")
+    if not _attr(node, "half_pixel_centers", False):
+        raise TFImportError(
+            f"{node.op}: half_pixel_centers=False (legacy asymmetric grid) "
+            "is not supported; re-export with tf.image.resize")
+    method = "linear" if node.op == "ResizeBilinear" else "nearest"
+    return imp.sd._record("tfimport.resize", [x], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "size": size, "method": method})
+
+
+@tf_op("Conv2DBackpropInput")
+def _conv2d_backprop_input(imp, node):
+    input_sizes = [int(v)
+                   for v in np.atleast_1d(imp.const_value(node.input[0]))]
+    w = imp.tensor(node.input[1])
+    dy = imp.tensor(node.input[2])
+    if _attr(node, "data_format", b"NHWC") not in (b"NHWC", "NHWC"):
+        raise TFImportError("Conv2DBackpropInput: only NHWC")
+    return imp.sd._record("tfimport.conv2d_backprop_input", [w, dy], {
+        "__argspec__": ["var", "var"], "__posattrs__": [],
+        "input_sizes": input_sizes, "strides": _attr(node, "strides"),
+        "padding": _attr(node, "padding").decode()
+        if isinstance(_attr(node, "padding"), bytes)
+        else _attr(node, "padding")})
+
+
+@tf_op("MirrorPad")
+def _mirror_pad(imp, node):
+    x = imp.tensor(node.input[0])
+    paddings = [[int(a), int(b)] for a, b in imp.const_value(node.input[1])]
+    mode = _attr(node, "mode", "REFLECT")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    return imp.sd._record("tfimport.mirror_pad", [x], {
+        "__argspec__": ["var"], "__posattrs__": [],
+        "paddings": paddings, "mode": mode})

@@ -632,3 +632,80 @@ class TestKerasConvLSTM:
         ])
         with pytest.raises(KerasImportError, match="go_backwards"):
             import_keras_model(_save(km, tmp_path))
+
+
+class TestTFGraphImportExt:
+    """Round-4 op-mapper tail: Einsum/Slice/SplitV/Unpack/ArgMax/Cumsum/
+    TopK/Resize/Conv2DBackpropInput/MirrorPad, each pinned against TF's own
+    execution of the frozen graph."""
+
+    def test_einsum(self):
+        rs = np.random.RandomState(0)
+        w = tf.constant(rs.randn(4, 6, 8).astype(np.float32))
+
+        def f(x):
+            return tf.einsum("bth,thd->btd", x, w)
+
+        _compare_tf(f, [tf.constant(rs.randn(2, 4, 6).astype(np.float32))])
+
+    def test_slice_and_splitv(self):
+        rs = np.random.RandomState(1)
+
+        def f(x):
+            a = tf.slice(x, [0, 1], [2, -1])
+            p1, p2, p3 = tf.split(x, [2, 3, -1], axis=1)
+            return a, p1, p2, p3
+
+        _compare_tf(f, [tf.constant(rs.randn(3, 8).astype(np.float32))])
+
+    def test_unpack_argmax_cumsum(self):
+        rs = np.random.RandomState(2)
+
+        def f(x):
+            rows = tf.unstack(x, axis=0)
+            am = tf.cast(tf.argmax(x, axis=1), tf.float32)
+            cs = tf.cumsum(x, axis=1, exclusive=True, reverse=True)
+            return rows[0], rows[2], am, cs
+
+        _compare_tf(f, [tf.constant(rs.randn(3, 5).astype(np.float32))])
+
+    def test_top_k(self):
+        rs = np.random.RandomState(3)
+
+        def f(x):
+            v, i = tf.math.top_k(x, k=3)
+            return v, tf.cast(i, tf.float32)
+
+        _compare_tf(f, [tf.constant(rs.randn(4, 9).astype(np.float32))])
+
+    def test_resize_bilinear_and_nearest(self):
+        rs = np.random.RandomState(4)
+
+        def f(x):
+            a = tf.image.resize(x, [8, 8], method="bilinear")
+            b = tf.image.resize(x, [8, 8], method="nearest")
+            return a, b
+
+        _compare_tf(f, [tf.constant(rs.rand(2, 4, 4, 3).astype(np.float32))],
+                    rtol=1e-3, atol=1e-4)
+
+    def test_conv2d_transpose(self):
+        rs = np.random.RandomState(5)
+        w = tf.constant(rs.randn(3, 3, 5, 4).astype(np.float32) * 0.3)
+
+        def f(x):
+            return tf.nn.conv2d_transpose(
+                x, w, output_shape=[2, 8, 8, 5], strides=[1, 2, 2, 1],
+                padding="SAME")
+
+        _compare_tf(f, [tf.constant(rs.randn(2, 4, 4, 4).astype(np.float32))],
+                    rtol=1e-4, atol=1e-4)
+
+    def test_mirror_pad(self):
+        rs = np.random.RandomState(6)
+
+        def f(x):
+            return (tf.pad(x, [[0, 0], [2, 1]], mode="REFLECT"),
+                    tf.pad(x, [[1, 0], [0, 2]], mode="SYMMETRIC"))
+
+        _compare_tf(f, [tf.constant(rs.randn(3, 6).astype(np.float32))])
