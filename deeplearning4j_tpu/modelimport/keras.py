@@ -303,6 +303,66 @@ def _conv_lstm2d(cfg):
         "b": ("bias", None)}
 
 
+def _locally_connected2d(cfg):
+    """↔ KerasLocallyConnected2D (keras-2 layer; removed in keras 3).
+
+    keras impl-1 kernel is [oh*ow, kh*kw*c, f] with the patch axis
+    (kh, kw, c) row-major; our LocallyConnected2D stores [oh, ow,
+    c*kh*kw, f] with the patch C-major (lax conv_general_dilated_patches
+    convention) — the transform splits + permutes, using the input shape
+    to recover (oh, ow) from the flat output-position axis.
+    """
+    if cfg.get("data_format") not in (None, "channels_last"):
+        raise KerasImportError("channels_first LocallyConnected2D "
+                               "not supported")
+    if cfg.get("implementation", 1) != 1:
+        raise KerasImportError(
+            "LocallyConnected2D implementation != 1 stores a different "
+            "kernel layout; re-save with implementation=1")
+    kh, kw = _pair(cfg["kernel_size"])
+    layer = L.LocallyConnected2D(
+        filters=cfg["filters"], kernel=(kh, kw),
+        stride=_pair(cfg.get("strides", 1)), padding=_padding(cfg),
+        activation=_act(cfg.get("activation")),
+        use_bias=cfg.get("use_bias", True))
+
+    def kernel_t(arr, input_shape):
+        oh, ow, _f = layer.output_shape(input_shape)
+        c = arr.shape[1] // (kh * kw)
+        w = arr.reshape(oh, ow, kh, kw, c, cfg["filters"])
+        w = np.transpose(w, (0, 1, 4, 2, 3, 5))  # patch → C-major
+        return w.reshape(oh, ow, c * kh * kw, cfg["filters"])
+
+    return layer, {"W": ("kernel", _ShapeAware(kernel_t)),
+                   "b": ("bias", None)}
+
+
+def _locally_connected1d(cfg):
+    """↔ KerasLocallyConnected1D. keras kernel [ot, k*c, f] with the patch
+    (k, c) row-major; ours is [ot, c*k, f] C-major."""
+    if cfg.get("implementation", 1) != 1:
+        raise KerasImportError(
+            "LocallyConnected1D implementation != 1 stores a different "
+            "kernel layout; re-save with implementation=1")
+    k = cfg["kernel_size"]
+    k = k[0] if isinstance(k, (list, tuple)) else k
+    stride = cfg.get("strides", 1)
+    stride = stride[0] if isinstance(stride, (list, tuple)) else stride
+    layer = L.LocallyConnected1D(
+        filters=cfg["filters"], kernel=k, stride=stride,
+        padding=_padding(cfg), activation=_act(cfg.get("activation")),
+        use_bias=cfg.get("use_bias", True))
+
+    def kernel_t(arr):
+        ot = arr.shape[0]  # output positions are the leading axis already
+        c = arr.shape[1] // k
+        w = arr.reshape(ot, k, c, cfg["filters"])
+        return np.transpose(w, (0, 2, 1, 3)).reshape(
+            ot, c * k, cfg["filters"])
+
+    return layer, {"W": ("kernel", kernel_t), "b": ("bias", None)}
+
+
 def _embedding(cfg):
     return Embedding(vocab_size=cfg["input_dim"], units=cfg["output_dim"]), {
         "W": ("embeddings", None)}
@@ -635,6 +695,8 @@ LAYER_MAPPERS: Dict[str, Callable] = {
     "GRU": _gru,
     "SimpleRNN": _simple_rnn,
     "ConvLSTM2D": _conv_lstm2d,
+    "LocallyConnected2D": _locally_connected2d,
+    "LocallyConnected1D": _locally_connected1d,
     "Embedding": _embedding,
     "Activation": _activation,
     "Dropout": _dropout,
@@ -784,7 +846,21 @@ def _layer_weights(h5file, layer_name: str) -> Dict[str, np.ndarray]:
 _OPTIONAL_SUFFIXES = {"bias", "gamma", "beta"}
 
 
-def _fill_params(weight_map, kweights, layer_cls: str):
+class _ShapeAware:
+    """Weight transform that additionally needs the layer's INPUT shape
+    (LocallyConnected kernels: splitting the flat output-position axis into
+    (oh, ow) takes the spatial dims only shape inference knows)."""
+
+    needs_input_shape = True
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, arr, input_shape):
+        return self.fn(arr, input_shape)
+
+
+def _fill_params(weight_map, kweights, layer_cls: str, input_shape=None):
     """weight_map entries: ours -> (suffixes, transform). A suffix may be a
     plain key, or a CALLABLE predicate matched against every available
     weight key (wrapper layers match on full paths this way). ``ours``
@@ -819,7 +895,14 @@ def _fill_params(weight_map, kweights, layer_cls: str):
                 f"(available: {sorted(kweights)})")
         arr = kweights[found]
         if transform is not None:
-            arr = transform(arr)
+            if getattr(transform, "needs_input_shape", False):
+                if input_shape is None:
+                    raise KerasImportError(
+                        f"{layer_cls}: weight transform needs the layer "
+                        "input shape but none was provided")
+                arr = transform(arr, input_shape)
+            else:
+                arr = transform(arr)
         if ours.startswith("state:"):
             put(state, ours.split(":", 1)[1], arr)
         else:
@@ -888,9 +971,11 @@ def _import_sequential(f, config: dict, updater):
         _check_bn_axis(layer, model.shapes[i], model.layer_names[i])
 
     params, state = {}, {}
-    for model_name, (kname, kcls, wmap) in zip(model.layer_names, per_layer):
+    for i, (model_name, (kname, kcls, wmap)) in enumerate(
+            zip(model.layer_names, per_layer)):
         kweights = _layer_weights(f, kname)
-        p, s = _fill_params(wmap, kweights, kcls)
+        p, s = _fill_params(wmap, kweights, kcls,
+                            input_shape=model.shapes[i])
         if p:
             params[model_name] = p
         if s:
@@ -968,7 +1053,10 @@ def _import_functional(f, config: dict, updater):
 
     params, state = {}, {}
     for name, (kcls, wmap) in weight_info.items():
-        p, s = _fill_params(wmap, _layer_weights(f, name), kcls)
+        v = vertices[name]
+        in_shape = (model.shapes.get(v.inputs[0]) if v.inputs else None)
+        p, s = _fill_params(wmap, _layer_weights(f, name), kcls,
+                            input_shape=in_shape)
         if p:
             params[name] = p
         if s:

@@ -709,3 +709,61 @@ class TestTFGraphImportExt:
                     tf.pad(x, [[1, 0], [0, 2]], mode="SYMMETRIC"))
 
         _compare_tf(f, [tf.constant(rs.randn(3, 6).astype(np.float32))])
+
+
+try:
+    import tf_keras
+except ImportError:  # pragma: no cover - env-dependent
+    tf_keras = None
+
+
+@pytest.mark.skipif(tf_keras is None, reason="tf_keras (keras-2) not installed")
+class TestKerasLocallyConnected:
+    """Keras-2 LocallyConnected layers (removed in keras 3) via the tf_keras
+    compat package — real keras-2 h5 files, outputs pinned against keras.
+    The kernel transform reorders the patch axis (keras row-major (kh,kw,c)
+    -> our C-major) and splits the flat output-position axis via shape
+    inference (_ShapeAware)."""
+
+    def test_lc2d(self, tmp_path):
+        km = tf_keras.Sequential([
+            tf_keras.layers.Input((8, 8, 3)),
+            tf_keras.layers.LocallyConnected2D(4, 3, strides=2,
+                                               activation="relu"),
+            tf_keras.layers.Flatten(),
+            tf_keras.layers.Dense(2),
+        ])
+        p = str(tmp_path / "lc2.h5")
+        km.save(p)
+        x = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+        want = km.predict(x, verbose=0)
+        model, variables = import_keras_model(p)
+        got, _ = model.apply(variables, x)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lc1d(self, tmp_path):
+        km = tf_keras.Sequential([
+            tf_keras.layers.Input((10, 5)),
+            tf_keras.layers.LocallyConnected1D(6, 3, activation="tanh"),
+            tf_keras.layers.Flatten(),
+            tf_keras.layers.Dense(3),
+        ])
+        p = str(tmp_path / "lc1.h5")
+        km.save(p)
+        x = np.random.RandomState(1).rand(2, 10, 5).astype(np.float32)
+        want = km.predict(x, verbose=0)
+        model, variables = import_keras_model(p)
+        got, _ = model.apply(variables, x)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lc2d_nondefault_implementation_refused(self, tmp_path):
+        km = tf_keras.Sequential([
+            tf_keras.layers.Input((6, 6, 2)),
+            tf_keras.layers.LocallyConnected2D(3, 2, implementation=2),
+        ])
+        p = str(tmp_path / "lc2i2.h5")
+        km.save(p)
+        with pytest.raises(KerasImportError, match="implementation"):
+            import_keras_model(p)
