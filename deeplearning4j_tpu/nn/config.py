@@ -156,6 +156,15 @@ class NeuralNetConfiguration:
     # guaranteed stable across compiler versions/backends — fine for
     # dropout, keep threefry when bitwise-reproducible runs matter.
     rng_impl: Optional[str] = None
+    # ↔ MultiLayerConfiguration.Builder.backpropType(TruncatedBPTT) +
+    # tBPTTLength: 'tbptt' splits each sequence batch into windows of
+    # tbptt_length steps; gradients truncate at window boundaries, recurrent
+    # state carries across them, and parameters update once per window (the
+    # reference's semantics — each window is an iteration). The TPU-native
+    # execution is ONE compiled lax.scan over the windows with the update
+    # inside the body (Trainer.make_tbptt_step), not a host loop.
+    backprop_type: str = "standard"  # 'standard' | 'tbptt'
+    tbptt_length: int = 0  # window length (fwd == back, the reference default)
 
 
 @register_config

@@ -91,25 +91,32 @@ class LSTM(LayerConfig):
         return new.h, new
 
     def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
+        y, state, _final = self.apply_window(
+            params, state, x, initial_state, train=train, rng=rng)
+        return y, state
+
+    def apply_window(self, params, state, x, carry, *, train=False, rng=None):
+        """One TBPTT window: forward from ``carry`` (None = zeros), return
+        (y, new_state, final_carry). The final carry is what the next window
+        starts from; gradient truncation at the boundary is automatic
+        because the caller passes carries as non-differentiated inputs
+        (↔ BaseRecurrentLayer.rnnSetPreviousState + tbpttBackpropGradient)."""
         if self.backend == "pallas":
             from deeplearning4j_tpu.kernels import lstm_scan
 
             outputs, final = lstm_scan.lstm(
                 x, params["W"], params["RW"], params["b"],
                 peepholes=self._peepholes(params),
-                forget_bias=self.forget_bias, init_state=initial_state,
+                forget_bias=self.forget_bias, init_state=carry,
             )
         else:
             outputs, final = opsrnn.lstm(
-                x, params["W"], params["RW"], params["b"],
-                init_state=initial_state,
+                x, params["W"], params["RW"], params["b"], init_state=carry,
                 peepholes=self._peepholes(params),
-                forget_bias=self.forget_bias,
-                unroll=self.unroll,
+                forget_bias=self.forget_bias, unroll=self.unroll,
             )
-        if not self.return_sequences:
-            return outputs[:, -1, :], state
-        return outputs, state
+        y = outputs if self.return_sequences else outputs[:, -1, :]
+        return y, state, final
 
 
 @register_config
@@ -166,20 +173,23 @@ class GRU(LayerConfig):
         return h, h
 
     def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
+        y, state, _final = self.apply_window(
+            params, state, x, initial_state, train=train, rng=rng)
+        return y, state
+
+    def apply_window(self, params, state, x, carry, *, train=False, rng=None):
+        """One TBPTT window from hidden state ``carry`` (None = zeros)."""
         if self.backend == "pallas":
             from deeplearning4j_tpu.kernels import gru_scan
 
-            outputs, _final = gru_scan.gru(
-                x, params["W"], params["RW"], params["b"],
-                init_h=initial_state)
+            outputs, final = gru_scan.gru(
+                x, params["W"], params["RW"], params["b"], init_h=carry)
         else:
-            outputs, _final = opsrnn.gru(
-                x, params["W"], params["RW"], params["b"],
-                init_h=initial_state, unroll=self.unroll,
-            )
-        if not self.return_sequences:
-            return outputs[:, -1, :], state
-        return outputs, state
+            outputs, final = opsrnn.gru(
+                x, params["W"], params["RW"], params["b"], init_h=carry,
+                unroll=self.unroll)
+        y = outputs if self.return_sequences else outputs[:, -1, :]
+        return y, state, final
 
 
 @register_config
@@ -218,14 +228,17 @@ class SimpleRnn(LayerConfig):
         return h, h
 
     def apply(self, params, state, x, *, train=False, rng=None, initial_state=None):
+        y, state, _final = self.apply_window(
+            params, state, x, initial_state, train=train, rng=rng)
+        return y, state
+
+    def apply_window(self, params, state, x, carry, *, train=False, rng=None):
         act = get_activation(self.activation)
         outputs, final = opsrnn.simple_rnn(
-            x, params["W"], params["RW"], params["b"], init_h=initial_state,
-            activation=act, unroll=self.unroll,
-        )
-        if not self.return_sequences:
-            return outputs[:, -1, :], state
-        return outputs, state
+            x, params["W"], params["RW"], params["b"], init_h=carry,
+            activation=act, unroll=self.unroll)
+        y = outputs if self.return_sequences else outputs[:, -1, :]
+        return y, state, final
 
 
 @register_config
@@ -363,8 +376,7 @@ class ConvLSTM2D(LayerConfig):
             params["b"] = b
         return params, {}
 
-    def apply(self, params, state, x, *, train=False, rng=None,
-              initial_state=None):
+    def _forward(self, params, x, initial_state):
         from deeplearning4j_tpu.ops import cnn as opscnn
 
         act = get_activation(self.activation)
@@ -397,6 +409,16 @@ class ConvLSTM2D(LayerConfig):
             return (h_new, c_new), h_new
 
         (hT, cT), ys = jax.lax.scan(body, (h0, c0), xg_tm)
+        return jnp.swapaxes(ys, 0, 1), (hT, cT)
+
+    def apply(self, params, state, x, *, train=False, rng=None,
+              initial_state=None):
+        ys, (hT, _cT) = self._forward(params, x, initial_state)
         if not self.return_sequences:
             return hT, state
-        return jnp.swapaxes(ys, 0, 1), state
+        return ys, state
+
+    def apply_window(self, params, state, x, carry, *, train=False, rng=None):
+        ys, final = self._forward(params, x, carry)
+        y = ys if self.return_sequences else final[0]
+        return y, state, final

@@ -100,28 +100,121 @@ class SequentialModel:
 
     # -- pure functions (traced under jit) ---------------------------------
 
+    def _forward_layers(self, variables, x, *, train, rng, up_to,
+                        carries=None, tbptt=False):
+        """Shared layer loop for apply/apply_tbptt. Under ``tbptt``,
+        recurrent layers run apply_window from carries and report finals,
+        and layers whose semantics need the FULL sequence are rejected."""
+        params = variables["params"]
+        state = variables["state"]
+        new_state = dict(state)
+        new_carries = {}
+        carries = carries or {}
+        n = len(self.layers) if up_to is None else up_to
+        for i in range(n):
+            name = self.layer_names[i]
+            layer = self.layers[i]
+            if tbptt:
+                self._check_tbptt_compatible(layer)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            p = apply_weight_noise(
+                layer, params.get(name, {}), lrng, train)
+            if tbptt and hasattr(layer, "apply_window"):
+                x, s, carry = layer.apply_window(
+                    p, state.get(name, {}), x, carries.get(name),
+                    train=train, rng=lrng)
+                new_carries[name] = carry
+            else:
+                x, s = layer.apply(
+                    p, state.get(name, {}), x, train=train, rng=lrng)
+            if s:
+                new_state[name] = s
+        return x, new_state, new_carries
+
+    @staticmethod
+    def _check_tbptt_compatible(layer):
+        """↔ the reference's TBPTT restrictions: layers that read the whole
+        sequence (bidirectional) or collapse the time axis (last-step /
+        global pooling / return_sequences=False) would silently change
+        semantics per-window — raise instead."""
+        from deeplearning4j_tpu.nn.layers.recurrent import (Bidirectional,
+                                                            LastTimeStep)
+
+        kind = type(layer).__name__
+        if isinstance(layer, Bidirectional):
+            raise ValueError(
+                "truncated BPTT cannot be used with Bidirectional layers "
+                "(the backward direction needs the full sequence)")
+        if isinstance(layer, LastTimeStep) or kind in ("GlobalPooling",
+                                                       "GlobalPooling1D"):
+            raise ValueError(
+                f"truncated BPTT cannot be used with {kind}: it collapses "
+                "the time axis, so each window would train an intermediate "
+                "state against the full-sequence target")
+        if getattr(layer, "return_sequences", True) is False:
+            raise ValueError(
+                f"truncated BPTT requires return_sequences=True on {kind} "
+                "(per-window last-step outputs are not the sequence output)")
+
     def apply(self, variables, x, *, train: bool = False, rng=None,
               up_to: Optional[int] = None):
         """Forward pass; ``up_to`` stops before layer index (exclusive).
 
         Returns (activations, new_state). ↔ feedForward/feedForwardToLayer.
         """
-        params = variables["params"]
-        state = variables["state"]
-        new_state = dict(state)
-        n = len(self.layers) if up_to is None else up_to
-        for i in range(n):
-            name = self.layer_names[i]
-            layer = self.layers[i]
-            lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            p = apply_weight_noise(
-                layer, params.get(name, {}), lrng, train)
-            x, s = layer.apply(
-                p, state.get(name, {}), x, train=train, rng=lrng
-            )
-            if s:
-                new_state[name] = s
+        x, new_state, _ = self._forward_layers(
+            variables, x, train=train, rng=rng, up_to=up_to)
         return x, new_state
+
+    def apply_tbptt(self, variables, x, carries, *, train: bool = False,
+                    rng=None, up_to: Optional[int] = None):
+        """Forward one TBPTT window with recurrent state carried in/out.
+
+        ↔ MultiLayerNetwork.rnnActivateUsingStoredState under
+        BackpropType.TruncatedBPTT: recurrent layers start from
+        ``carries[name]`` (None = zeros) and report their final state so the
+        caller can hand it to the next window. Gradient truncation at the
+        window boundary is automatic — carries enter as plain inputs, not
+        through the differentiated path.
+
+        Returns (activations, new_state, new_carries); ``new_carries`` holds
+        an entry per recurrent (``apply_window``-capable) layer.
+        """
+        return self._forward_layers(
+            variables, x, train=train, rng=rng, up_to=up_to,
+            carries=carries, tbptt=True)
+
+    def _output_loss(self, params, state, x, batch, rng):
+        """Shared tail of the loss fns: weight-noised output layer +
+        compute_loss over labels/mask/weights."""
+        out_layer = self.layers[-1]
+        out_name = self.layer_names[-1]
+        if not hasattr(out_layer, "compute_loss"):
+            raise TypeError(
+                f"last layer {type(out_layer).__name__} is not an output layer"
+            )
+        out_i = len(self.layers) - 1
+        orng = jax.random.fold_in(rng, out_i) if rng is not None else None
+        out_params = apply_weight_noise(
+            out_layer, params.get(out_name, {}), orng, True)
+        return out_layer.compute_loss(
+            out_params, state.get(out_name, {}), x, batch["labels"],
+            mask=batch.get("mask"), weights=batch.get("weights"),
+        )
+
+    def loss_fn_tbptt(self, params, state, batch, carries, rng=None):
+        """TBPTT-window variant of loss_fn: threads recurrent carries.
+
+        Returns (loss, (new_state, metrics, new_carries)).
+        """
+        variables = {"params": params, "state": state}
+        x, new_state, new_carries = self.apply_tbptt(
+            variables, batch["features"], carries, train=True, rng=rng,
+            up_to=len(self.layers) - 1)
+        loss = self._output_loss(params, state, x, batch, rng)
+        reg = self._regularization(params)
+        return loss + reg, (new_state, {"loss": loss, "reg": reg},
+                            new_carries)
 
     def loss_fn(self, params, state, batch, rng=None):
         """Scalar training loss (↔ computeGradientAndScore's score).
@@ -134,20 +227,7 @@ class SequentialModel:
             variables, batch["features"], train=True, rng=rng,
             up_to=len(self.layers) - 1,
         )
-        out_layer = self.layers[-1]
-        out_name = self.layer_names[-1]
-        if not hasattr(out_layer, "compute_loss"):
-            raise TypeError(
-                f"last layer {type(out_layer).__name__} is not an output layer"
-            )
-        out_i = len(self.layers) - 1
-        orng = jax.random.fold_in(rng, out_i) if rng is not None else None
-        out_params = apply_weight_noise(
-            out_layer, params.get(out_name, {}), orng, True)
-        loss = out_layer.compute_loss(
-            out_params, state.get(out_name, {}), x, batch["labels"],
-            mask=batch.get("mask"), weights=batch.get("weights"),
-        )
+        loss = self._output_loss(params, state, x, batch, rng)
         reg = self._regularization(params)
         return loss + reg, (new_state, {"loss": loss, "reg": reg})
 

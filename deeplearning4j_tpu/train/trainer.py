@@ -62,6 +62,22 @@ def _normalize_gradients(grads, net: NeuralNetConfiguration):
     raise ValueError(f"unknown gradient normalization {mode}")
 
 
+def _is_time_distributed(key: str, v, t: int) -> bool:
+    """Which batch entries get split along the time axis under TBPTT.
+
+    Only the four keys the loss path reads are ever split, and only with an
+    unambiguous time layout: rank>=3 [N,T,...] for features/labels, rank-2
+    [N,T] for mask/weights. A rank-2 'labels' of [N,C] with C == T is NOT
+    split (full-sequence targets are invalid under TBPTT and are rejected
+    by _fit_tbptt_batch's validation instead of silently windowed).
+    """
+    if key in ("features", "labels"):
+        return hasattr(v, "ndim") and v.ndim >= 3 and v.shape[1] == t
+    if key in ("mask", "weights"):
+        return hasattr(v, "ndim") and v.ndim == 2 and v.shape[1] == t
+    return False
+
+
 class Trainer:
     """Builds and runs the compiled train step for a model.
 
@@ -101,6 +117,11 @@ class Trainer:
         self.model = model
         self.net: NeuralNetConfiguration = model.net
         self.mesh = mesh
+        bt = getattr(self.net, "backprop_type", "standard")
+        if bt not in ("standard", "tbptt"):
+            raise ValueError(
+                f"unknown backprop_type {bt!r}: expected 'standard' or "
+                "'tbptt' (↔ BackpropType.{Standard,TruncatedBPTT})")
         self.frozen_layers = frozenset(frozen_layers or ())
         if self.frozen_layers:
             known = set(getattr(model, "layer_names", [])) or None
@@ -148,32 +169,35 @@ class Trainer:
             (loss, (new_model_state, metrics)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(ts.params)
-            grads = self._mask_frozen(grads)
-            grads = _normalize_gradients(grads, self.net)
-            updates, new_opt = self._upd_update(grads, ts.opt_state, ts.params, ts.step)
-            updates = self._mask_frozen(updates)
-            new_params = apply_updates(ts.params, updates)
-            if self._constrained_layers:
-                from deeplearning4j_tpu.nn.constraints import constrain_params
-
-                new_params = constrain_params(
-                    self._constrained_layers, new_params)
-            metrics = dict(metrics)
-            metrics["total_loss"] = loss
-            feats = jax.tree_util.tree_leaves(batch["features"])
-            metrics["batch_size"] = jnp.asarray(feats[0].shape[0])
-            if self._extra_metrics is not None:
-                metrics.update(self._extra_metrics(new_params, batch))
-            new_ts = TrainState(
-                params=new_params,
-                model_state=new_model_state,
-                opt_state=new_opt,
-                step=ts.step + 1,
-                rng=ts.rng,
-            )
-            return new_ts, metrics
+            return self._finish_step(
+                ts, grads, new_model_state, metrics, loss, batch)
 
         self._raw_step = train_step  # unjitted; reused by make_chained_step
+
+        def tbptt_window_step(ts: TrainState, batch, carries):
+            """One TBPTT window: loss over the window from ``carries``,
+            gradients truncated at the window start, one parameter update
+            (↔ one reference iteration), carries handed to the next window."""
+            step_rng = jax.random.fold_in(ts.rng, ts.step)
+            if mixed:
+                batch = dict(batch, features=_to_bf16(batch["features"]))
+
+            def loss_of(params):
+                if mixed:
+                    params = _to_bf16(params)
+                return self.model.loss_fn_tbptt(
+                    params, ts.model_state, batch, carries, rng=step_rng)
+
+            (loss, (new_model_state, metrics, new_carries)), grads = (
+                jax.value_and_grad(loss_of, has_aux=True)(ts.params))
+            new_ts, metrics = self._finish_step(
+                ts, grads, new_model_state, metrics, loss, batch)
+            return new_ts, new_carries, metrics
+
+        self._raw_tbptt_step = tbptt_window_step
+        self._mixed = mixed
+        self._to_bf16 = _to_bf16
+        self._tbptt_progs: Dict[Any, Any] = {}
 
         jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
         if mesh is not None and state_sharding is not None:
@@ -186,30 +210,63 @@ class Trainer:
 
             check_nan = get_environment().check_numerics
         self.check_nan = bool(check_nan)
-        if self.check_nan:
-            from jax.experimental import checkify
+        self.train_step = self._jit_with_nan_guard(train_step, jit_kwargs)
 
-            # checkify preserves the wrapped fn's signature (returns
-            # (err, out)), so donation and the mesh in/out shardings apply
-            # unchanged to arg 0 / the state output; the error pytree rides
-            # along as an extra replicated output.
-            checked_kwargs = dict(jit_kwargs)
-            if "out_shardings" in checked_kwargs:
-                checked_kwargs["out_shardings"] = (
-                    None, checked_kwargs["out_shardings"])
-            checked = jax.jit(
-                checkify.checkify(train_step, errors=checkify.float_checks),
-                **checked_kwargs,
-            )
+    def _finish_step(self, ts: TrainState, grads, new_model_state, metrics,
+                     loss, batch):
+        """Shared back half of every step kind: freeze-mask, normalize,
+        updater, constraints, metric assembly, TrainState rebuild. Keeping
+        it in ONE place is what guarantees the standard, chained, and TBPTT
+        paths can never diverge on gradient handling."""
+        grads = self._mask_frozen(grads)
+        grads = _normalize_gradients(grads, self.net)
+        updates, new_opt = self._upd_update(
+            grads, ts.opt_state, ts.params, ts.step)
+        updates = self._mask_frozen(updates)
+        new_params = apply_updates(ts.params, updates)
+        if self._constrained_layers:
+            from deeplearning4j_tpu.nn.constraints import constrain_params
 
-            def train_step_checked(ts, batch):
-                err, out = checked(ts, batch)
-                checkify.check_error(err)  # raises with the offending op name
-                return out
+            new_params = constrain_params(self._constrained_layers, new_params)
+        metrics = dict(metrics)
+        metrics["total_loss"] = loss
+        feats = jax.tree_util.tree_leaves(batch["features"])
+        metrics["batch_size"] = jnp.asarray(feats[0].shape[0])
+        if self._extra_metrics is not None:
+            metrics.update(self._extra_metrics(new_params, batch))
+        new_ts = TrainState(
+            params=new_params,
+            model_state=new_model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+            rng=ts.rng,
+        )
+        return new_ts, metrics
 
-            self.train_step = train_step_checked
-        else:
-            self.train_step = jax.jit(train_step, **jit_kwargs)
+    def _jit_with_nan_guard(self, fn, kwargs):
+        """jit ``fn``; under ``check_nan``, checkify-instrument it first
+        (↔ OpExecutionerUtil.checkForNAN, SURVEY §5.2). checkify preserves
+        the wrapped fn's signature (returns (err, out)), so donation and
+        mesh in/out shardings apply unchanged to arg 0 / the state output;
+        the error pytree rides along as an extra replicated output."""
+        if not self.check_nan:
+            return jax.jit(fn, **kwargs)
+        from jax.experimental import checkify
+
+        checked_kwargs = dict(kwargs)
+        if "out_shardings" in checked_kwargs:
+            checked_kwargs["out_shardings"] = (
+                None, checked_kwargs["out_shardings"])
+        checked = jax.jit(
+            checkify.checkify(fn, errors=checkify.float_checks),
+            **checked_kwargs)
+
+        def guarded(*args):
+            err, out = checked(*args)
+            checkify.check_error(err)  # raises with the offending op name
+            return out
+
+        return guarded
 
     def make_chained_step(self, n_steps: int):
         """One jitted program that runs ``n_steps`` train steps on-device.
@@ -240,25 +297,145 @@ class Trainer:
         kwargs = dict(self._jit_kwargs)
         if "out_shardings" in kwargs:
             kwargs["out_shardings"] = (kwargs["out_shardings"][0], None)
+        return self._jit_with_nan_guard(chained, kwargs)
 
-        if self.check_nan:
-            from jax.experimental import checkify
+    # -- truncated BPTT (↔ BackpropType.TruncatedBPTT, SURVEY §5.7) --------
 
-            checked_kwargs = dict(kwargs)
-            if "out_shardings" in checked_kwargs:
-                checked_kwargs["out_shardings"] = (
-                    None, checked_kwargs["out_shardings"])
-            checked = jax.jit(
-                checkify.checkify(chained, errors=checkify.float_checks),
-                **checked_kwargs)
+    def _zero_carries(self, ts: TrainState, x_window):
+        """Zero recurrent carries matching one window's forward, derived by
+        shape-only evaluation (no FLOPs; works eagerly or at trace time —
+        eval_shape only reads avals, and jnp.zeros is cheap either way)."""
+        params = self._to_bf16(ts.params) if self._mixed else ts.params
+        xw = self._to_bf16(x_window) if self._mixed else x_window
+        shapes = jax.eval_shape(
+            lambda p, s, x: self.model.apply_tbptt(
+                {"params": p, "state": s}, x, None, train=False)[2],
+            params, ts.model_state, xw)
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
-            def chained_checked(ts, batch):
-                err, out = checked(ts, batch)
-                checkify.check_error(err)
-                return out
+    def _tbptt_jit_kwargs(self, *, with_carries_arg: bool):
+        """_jit_kwargs adapted to the TBPTT signatures: outputs grow to
+        (state, metrics, carries); the single-window step additionally takes
+        carries as a third, unconstrained input (the scan program does not —
+        it builds carries internally)."""
+        kwargs = dict(self._jit_kwargs)
+        if with_carries_arg and "in_shardings" in kwargs:
+            kwargs["in_shardings"] = (*kwargs["in_shardings"], None)
+        if "out_shardings" in kwargs:
+            kwargs["out_shardings"] = (
+                kwargs["out_shardings"][0], None, None)
+        return kwargs
 
-            return chained_checked
-        return jax.jit(chained, **kwargs)
+    def make_tbptt_step(self, n_windows: int, window_len: int):
+        """One jitted program: ``lax.scan`` over ``n_windows`` TBPTT windows
+        of ``window_len`` steps, the parameter update INSIDE the scan body.
+
+        The reference walks windows on the host, re-dispatching every op per
+        window (SURVEY §3.1); here the whole truncated-BPTT pass over a batch
+        of long sequences — every window forward, truncated backward, and
+        updater application — is a single XLA program.
+
+        Returns ``prog(ts, batch) -> (ts, metrics, carries)`` where
+        ``metrics`` is the per-window stack of the full train_step metric
+        dict; batch time axes must be exactly ``n_windows * window_len``
+        long. The returned carries let a caller run a shorter remainder
+        window (ragged tail) through ``train_step_tbptt``.
+        """
+        raw = self._raw_tbptt_step
+        span = n_windows * window_len
+
+        def split_time(a):
+            # [N, span, ...] -> [n_windows, N, window_len, ...]
+            n = a.shape[0]
+            a = a.reshape(n, n_windows, window_len, *a.shape[2:])
+            return jnp.moveaxis(a, 1, 0)
+
+        def program(ts: TrainState, batch):
+            timed = {k: split_time(v) for k, v in batch.items()
+                     if _is_time_distributed(k, v, span)}
+            static = {k: v for k, v in batch.items() if k not in timed}
+            carries0 = self._zero_carries(ts, timed["features"][0])
+
+            def body(carry, wb):
+                ts_c, carries = carry
+                new_ts, new_carries, metrics = raw(
+                    ts_c, dict(static, **wb), carries)
+                return (new_ts, new_carries), metrics
+
+            (ts_f, carries_f), metrics = jax.lax.scan(
+                body, (ts, carries0), timed)
+            return ts_f, metrics, carries_f
+
+        return self._jit_with_nan_guard(
+            program, self._tbptt_jit_kwargs(with_carries_arg=False))
+
+    def train_step_tbptt(self, ts: TrainState, batch, carries):
+        """Single TBPTT window step (jitted lazily); used for ragged tail
+        windows and as the building block callers can drive directly."""
+        if not hasattr(self, "_tbptt_single_jit"):
+            self._tbptt_single_jit = self._jit_with_nan_guard(
+                self._raw_tbptt_step,
+                self._tbptt_jit_kwargs(with_carries_arg=True))
+        return self._tbptt_single_jit(ts, batch, carries)
+
+    def _fit_tbptt_batch(self, ts: TrainState, batch):
+        """Fit one batch of long sequences by truncated BPTT: full windows
+        through the compiled scan program, any remainder through a single
+        shorter window continuing from the scanned-out carries (the
+        reference also trains the shorter tail window).
+
+        Returns (ts, [per-window metrics dict]) — one dict per window, the
+        same keys the standard step reports.
+        """
+        if not hasattr(self.model, "loss_fn_tbptt"):
+            raise ValueError(
+                "backprop_type='tbptt' requires a model with TBPTT support "
+                f"(SequentialModel); {type(self.model).__name__} has none")
+        length = int(self.net.tbptt_length)
+        if length <= 0:
+            raise ValueError("backprop_type='tbptt' requires tbptt_length>0")
+        feats = batch["features"]
+        if not (hasattr(feats, "ndim") and feats.ndim >= 3):
+            raise ValueError(
+                "TBPTT needs sequence features [N, T, ...]; got shape "
+                f"{getattr(feats, 'shape', None)}")
+        t_total = feats.shape[1]
+        labels = batch.get("labels")
+        if labels is not None and not _is_time_distributed(
+                "labels", labels, t_total):
+            raise ValueError(
+                "TBPTT requires per-timestep labels [N, T, ...] matching the "
+                f"feature time axis (T={t_total}); got labels shape "
+                f"{getattr(labels, 'shape', None)} — full-sequence targets "
+                "cannot be trained per truncated window")
+        n_w, rem = divmod(t_total, length)
+        span = n_w * length
+
+        def time_slice(k, v, lo, hi):
+            if _is_time_distributed(k, v, t_total):
+                return v[:, lo:hi]
+            return v
+
+        wmetrics = []
+        carries = None
+        if n_w:
+            prog = self._tbptt_progs.get((n_w, length))
+            if prog is None:
+                prog = self.make_tbptt_step(n_w, length)
+                self._tbptt_progs[(n_w, length)] = prog
+            head = {k: time_slice(k, v, 0, span) for k, v in batch.items()}
+            ts, stacked, carries = prog(ts, head)
+            wmetrics = [{k: v[i] for k, v in stacked.items()}
+                        for i in range(n_w)]
+        if rem:
+            tail = {k: time_slice(k, v, span, t_total)
+                    for k, v in batch.items()}
+            if carries is None:
+                carries = self._zero_carries(ts, tail["features"])
+            ts, _, metrics = self.train_step_tbptt(ts, tail, carries)
+            wmetrics.append(metrics)
+        return ts, wmetrics
 
     def _mask_frozen(self, tree):
         if not self.frozen_layers:
@@ -314,12 +491,19 @@ class Trainer:
                 batch = _as_batch_dict(batch)
                 if self._batch_sharding is not None:
                     batch = jax.device_put(batch, self._batch_sharding)
-                ts, metrics = self.train_step(ts, batch)
+                if getattr(self.net, "backprop_type", "standard") == "tbptt":
+                    # ↔ TruncatedBPTT: every window is an iteration (the
+                    # reference fires iterationDone once per window).
+                    ts, wmetrics = self._fit_tbptt_batch(ts, batch)
+                else:
+                    ts, metrics = self.train_step(ts, batch)
+                    wmetrics = [metrics]
                 n += 1
-                host_step += 1
-                for lst in listeners:
-                    if lst.on_iteration(epoch, host_step, ts, metrics):
-                        stop = True
+                for wm in wmetrics:
+                    host_step += 1
+                    for lst in listeners:
+                        if lst.on_iteration(epoch, host_step, ts, wm):
+                            stop = True
                 if steps_per_epoch is not None and n >= steps_per_epoch:
                     break
                 if stop:

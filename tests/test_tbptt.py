@@ -1,0 +1,276 @@
+"""Truncated BPTT (↔ BackpropType.TruncatedBPTT + tBPTTLength;
+SURVEY §5.7: the reference's long-sequence training story).
+
+Semantics pinned here:
+- forward chaining: a full-sequence forward equals per-window forwards
+  chained through the reported carries (per recurrent layer kind);
+- a single window spanning the whole sequence is bitwise the standard step;
+- the compiled scan program equals a host loop over single-window steps;
+- ragged tails (T % L != 0) train the shorter remainder window;
+- Bidirectional layers are rejected (backward direction needs the full
+  sequence — the reference raises too);
+- end-to-end: loss decreases training a char-model with windows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                          SequentialConfig)
+from deeplearning4j_tpu.nn.layers.core import Embedding
+from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (GRU, LSTM, Bidirectional,
+                                                    ConvLSTM2D, GravesLSTM,
+                                                    SimpleRnn)
+from deeplearning4j_tpu.nn.model import SequentialModel
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.train.updaters import Adam, Sgd
+
+
+def _seq_batch(rng, n=4, t=16, c=8, k=5):
+    feats = rng.normal(size=(n, t, c)).astype(np.float32)
+    labels = np.eye(k, dtype=np.float32)[rng.integers(0, k, (n, t))]
+    return {"features": jnp.asarray(feats), "labels": jnp.asarray(labels)}
+
+
+@pytest.mark.parametrize("layer_cls", [LSTM, GravesLSTM, GRU, SimpleRnn])
+def test_window_chaining_matches_full_forward(layer_cls):
+    rng = np.random.default_rng(0)
+    layer = layer_cls(units=6)
+    params, state = layer.init(jax.random.key(0), (16, 8), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(3, 16, 8)).astype(np.float32))
+
+    y_full, _ = layer.apply(params, state, x)
+    y1, _, carry = layer.apply_window(params, state, x[:, :9], None)
+    y2, _, _ = layer.apply_window(params, state, x[:, 9:], carry)
+    np.testing.assert_allclose(np.asarray(y_full),
+                               np.asarray(jnp.concatenate([y1, y2], axis=1)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_convlstm2d_window_chaining_matches_full_forward():
+    rng = np.random.default_rng(1)
+    layer = ConvLSTM2D(filters=4, kernel=3, padding="SAME")
+    params, state = layer.init(jax.random.key(1), (10, 6, 6, 3), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(2, 10, 6, 6, 3)).astype(np.float32))
+
+    y_full, _ = layer.apply(params, state, x)
+    y1, _, carry = layer.apply_window(params, state, x[:, :4], None)
+    y2, _, _ = layer.apply_window(params, state, x[:, 4:], carry)
+    np.testing.assert_allclose(np.asarray(y_full),
+                               np.asarray(jnp.concatenate([y1, y2], axis=1)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _char_model(t, *, tbptt_length=0, layer=None, updater=None):
+    net = NeuralNetConfiguration(
+        updater=updater or Sgd(0.5), seed=3,
+        backprop_type="tbptt" if tbptt_length else "standard",
+        tbptt_length=tbptt_length)
+    return SequentialModel(SequentialConfig(
+        net=net,
+        layers=[layer or GravesLSTM(units=12),
+                RnnOutputLayer(units=5, activation="softmax", loss="mcxent")],
+        input_shape=(t, 8)))
+
+
+def test_single_window_equals_standard_step():
+    rng = np.random.default_rng(2)
+    batch = _seq_batch(rng, t=16)
+
+    std = _char_model(16)
+    ts0 = Trainer(std).init_state()
+    trainer_std = Trainer(std)
+    ts_std, _ = trainer_std.train_step(ts0, batch)
+
+    tb = _char_model(16, tbptt_length=16)
+    trainer_tb = Trainer(tb)
+    ts1 = trainer_tb.init_state()
+    ts_tb, wmetrics = trainer_tb._fit_tbptt_batch(ts1, batch)
+    assert len(wmetrics) == 1
+    assert int(wmetrics[0]["batch_size"]) == 4
+
+    for a, b in zip(jax.tree_util.tree_leaves(ts_std.params),
+                    jax.tree_util.tree_leaves(ts_tb.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scan_program_equals_window_loop():
+    rng = np.random.default_rng(4)
+    batch = _seq_batch(rng, t=16)
+
+    model = _char_model(16, tbptt_length=4, updater=Adam(1e-2))
+    trainer = Trainer(model)
+
+    prog = trainer.make_tbptt_step(4, 4)
+    ts_a, stacked, _ = prog(trainer.init_state(), batch)
+    losses_a = stacked["total_loss"]
+
+    ts = trainer.init_state()
+    carries = trainer._zero_carries(ts, batch["features"][:, :4])
+    losses_b = []
+    for w in range(4):
+        wb = {"features": batch["features"][:, 4 * w:4 * (w + 1)],
+              "labels": batch["labels"][:, 4 * w:4 * (w + 1)]}
+        ts, carries, metrics = trainer.train_step_tbptt(ts, wb, carries)
+        losses_b.append(float(metrics["total_loss"]))
+
+    np.testing.assert_allclose(np.asarray(losses_a), np.asarray(losses_b),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(ts_a.params),
+                    jax.tree_util.tree_leaves(ts.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ragged_tail_window_trains():
+    rng = np.random.default_rng(5)
+    batch = _seq_batch(rng, t=20)  # 2 full windows of 8 + tail of 4
+    model = _char_model(20, tbptt_length=8)
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    ts, wmetrics = trainer._fit_tbptt_batch(ts, batch)
+    assert len(wmetrics) == 3
+    assert all(np.isfinite(float(m["total_loss"])) for m in wmetrics)
+    assert int(jax.device_get(ts.step)) == 3  # every window is an iteration
+
+
+def test_tbptt_fit_loss_decreases():
+    rng = np.random.default_rng(6)
+    # learnable toy: next-token structure via a fixed linear map
+    n, t, c, k = 8, 24, 8, 5
+    feats = rng.normal(size=(n, t, c)).astype(np.float32)
+    proj = rng.normal(size=(c, k)).astype(np.float32)
+    labels = np.eye(k, dtype=np.float32)[np.argmax(feats @ proj, axis=-1)]
+    batch = {"features": jnp.asarray(feats), "labels": jnp.asarray(labels)}
+
+    model = _char_model(t, tbptt_length=6, updater=Adam(5e-2))
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+
+    first = None
+    for _ in range(12):
+        ts, wmetrics = trainer._fit_tbptt_batch(ts, batch)
+        if first is None:
+            first = float(wmetrics[0]["total_loss"])
+    last = float(wmetrics[-1]["total_loss"])
+    assert last < 0.6 * first, (first, last)
+
+
+def test_tbptt_fit_entrypoint_and_mask():
+    rng = np.random.default_rng(7)
+    batch = _seq_batch(rng, t=12)
+    batch["mask"] = jnp.asarray(
+        (np.arange(12)[None, :] < rng.integers(6, 13, size=(4, 1)))
+        .astype(np.float32))
+    model = _char_model(12, tbptt_length=4)
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+
+    seen = []
+
+    class Rec:
+        def on_fit_start(self, *a): pass
+
+        def on_fit_end(self, *a): pass
+
+        def on_epoch_start(self, *a): pass
+
+        def on_epoch_end(self, *a): return False
+
+        def on_iteration(self, epoch, step, ts, metrics):
+            seen.append(float(metrics["total_loss"]))
+            return False
+
+    ts = trainer.fit(ts, [batch], epochs=1, listeners=[Rec()])
+    assert len(seen) == 3  # 12 / 4 windows, one iteration each
+    assert all(np.isfinite(v) for v in seen)
+
+
+def test_tbptt_rejects_bidirectional():
+    model = _char_model(12, tbptt_length=4,
+                        layer=Bidirectional(layer=LSTM(units=6)))
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    batch = _seq_batch(np.random.default_rng(8), t=12)
+    with pytest.raises(ValueError, match="[Bb]idirectional"):
+        trainer._fit_tbptt_batch(ts, batch)
+
+
+def test_tbptt_sharded_mesh():
+    """Regression: the 3-arg TBPTT jits must extend in_shardings, not
+    reuse the 2-tuple train_step kwargs (crashes under a mesh otherwise)."""
+    import jax.numpy  # noqa: F401
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("data",))
+    model = _char_model(20, tbptt_length=8)
+    rep = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P("data"))
+    trainer = Trainer(model, mesh=mesh, state_sharding=rep,
+                      batch_sharding=bsh)
+    ts = jax.device_put(trainer.init_state(), rep)
+    batch = jax.device_put(_seq_batch(np.random.default_rng(9), t=20), bsh)
+    # 2 full windows + ragged tail of 4 — exercises prog AND single-window
+    ts, wmetrics = trainer._fit_tbptt_batch(ts, batch)
+    assert len(wmetrics) == 3
+    assert all(np.isfinite(float(m["total_loss"])) for m in wmetrics)
+
+
+def test_tbptt_check_nan_guard_fires():
+    """Regression: Trainer(check_nan=True) must instrument the TBPTT
+    programs too, not only the standard step."""
+    model = _char_model(8, tbptt_length=4)
+    trainer = Trainer(model, check_nan=True)
+    ts = trainer.init_state()
+    batch = _seq_batch(np.random.default_rng(10), t=8)
+    # an inf feature turns into inf + (-inf) = NaN inside the first matmul
+    batch["features"] = batch["features"].at[0, 0, 0].set(np.inf)
+    with pytest.raises(Exception, match="nan|inf|float"):
+        ts, _ = trainer._fit_tbptt_batch(ts, batch)
+        # force materialization in case the raise is deferred
+        jax.block_until_ready(ts.params)
+
+
+def test_invalid_backprop_type_rejected():
+    model = _char_model(8)
+    model.net.backprop_type = "TBPTT"  # wrong case — must not silently train
+    with pytest.raises(ValueError, match="backprop_type"):
+        Trainer(model)
+
+
+def test_full_sequence_labels_rejected():
+    rng = np.random.default_rng(11)
+    model = _char_model(16, tbptt_length=4)
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    batch = {"features": jnp.asarray(
+        rng.normal(size=(4, 16, 8)).astype(np.float32)),
+        "labels": jnp.asarray(np.eye(16, dtype=np.float32)[:4])}  # [N,C] C==T
+    with pytest.raises(ValueError, match="per-timestep labels"):
+        trainer._fit_tbptt_batch(ts, batch)
+
+
+def test_time_collapsing_layers_rejected():
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import LastTimeStep
+
+    net = NeuralNetConfiguration(updater=Sgd(0.1), seed=0,
+                                 backprop_type="tbptt", tbptt_length=4)
+    model = SequentialModel(SequentialConfig(
+        net=net,
+        layers=[LSTM(units=6), LastTimeStep(),
+                OutputLayer(units=3, activation="softmax", loss="mcxent")],
+        input_shape=(16, 8)))
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    rng = np.random.default_rng(12)
+    batch = {"features": jnp.asarray(
+        rng.normal(size=(4, 16, 8)).astype(np.float32)),
+        "labels": jnp.asarray(
+            np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 16))])}
+    with pytest.raises(ValueError, match="LastTimeStep|time axis"):
+        trainer._fit_tbptt_batch(ts, batch)
