@@ -39,6 +39,34 @@ def _merge_heads(x):
     return x.transpose(0, 2, 1, 3).reshape(n, t, h * d)
 
 
+def _init_qkv(rng, embeds, proj, out, dtype, w_init, use_bias):
+    """Shared Q/K/V/O projection init. embeds = (eq, ek, ev)."""
+    eq, ek, ev = embeds
+    ks = jax.random.split(rng, 4)
+    params = {
+        "Wq": w_init(ks[0], (eq, proj), dtype),
+        "Wk": w_init(ks[1], (ek, proj), dtype),
+        "Wv": w_init(ks[2], (ev, proj), dtype),
+        "Wo": w_init(ks[3], (proj, out), dtype),
+    }
+    if use_bias:
+        params.update(
+            bq=jnp.zeros((proj,), dtype), bk=jnp.zeros((proj,), dtype),
+            bv=jnp.zeros((proj,), dtype), bo=jnp.zeros((out,), dtype),
+        )
+    return params
+
+
+def _attend_tail(y_heads, params, *, dropout, train, rng, project=True):
+    """Shared post-attention pipeline: merge heads, dropout, O-projection."""
+    y = _merge_heads(y_heads)
+    if train and dropout > 0.0 and rng is not None:
+        y = opsnn.dropout(y, dropout, rng)
+    if project:
+        y = opsnn.linear(y, params["Wo"], params.get("bo"))
+    return y
+
+
 @register_config
 @dataclass
 class SelfAttention(LayerConfig):
@@ -86,19 +114,8 @@ class SelfAttention(LayerConfig):
         out, hd = self._dims(e)
         proj = self.num_heads * hd
         w_init = get_initializer(self.weight_init or "xavier")
-        ks = jax.random.split(rng, 4)
-        params = {
-            "Wq": w_init(ks[0], (e, proj), dtype),
-            "Wk": w_init(ks[1], (e, proj), dtype),
-            "Wv": w_init(ks[2], (e, proj), dtype),
-            "Wo": w_init(ks[3], (proj, out), dtype),
-        }
-        if self.use_bias:
-            params.update(
-                bq=jnp.zeros((proj,), dtype), bk=jnp.zeros((proj,), dtype),
-                bv=jnp.zeros((proj,), dtype), bo=jnp.zeros((out,), dtype),
-            )
-        return params, {}
+        return _init_qkv(rng, (e, e, e), proj, out, dtype, w_init,
+                         self.use_bias), {}
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         q = opsnn.linear(x, params["Wq"], params.get("bq"))
@@ -113,10 +130,8 @@ class SelfAttention(LayerConfig):
                                   causal=self.causal, key_mask=mask)
         else:
             y = flash_attention(qh, kh, vh, causal=self.causal, key_mask=mask)
-        y = _merge_heads(y)
-        if train and self.dropout > 0.0 and rng is not None:
-            y = opsnn.dropout(y, self.dropout, rng)
-        return opsnn.linear(y, params["Wo"], params.get("bo")), state
+        return _attend_tail(y, params, dropout=self.dropout, train=train,
+                            rng=rng), state
 
 
 @register_config
@@ -163,10 +178,188 @@ class LearnedSelfAttention(SelfAttention):
             _split_heads(q, h), _split_heads(k, h), _split_heads(v, h),
             key_mask=mask,
         )
-        y = _merge_heads(y)
-        if train and self.dropout > 0.0 and rng is not None:
-            y = opsnn.dropout(y, self.dropout, rng)
-        return opsnn.linear(y, params["Wo"], params.get("bo")), state
+        return _attend_tail(y, params, dropout=self.dropout, train=train,
+                            rng=rng), state
+
+
+@register_config
+@dataclass
+class CrossAttention(LayerConfig):
+    """↔ org.deeplearning4j.nn.conf.graph.AttentionVertex: multi-head
+    dot-product attention whose queries/keys/values come from DIFFERENT
+    graph inputs (machine-translation-style cross attention).
+
+    A multi-input layer (GraphModel feeds it via the ``apply_multi``
+    protocol). Input arities, matching the reference vertex:
+
+    - 1 input  → self-attention (q = k = v);
+    - 2 inputs → (queries, kv) — keys and values share the second input;
+    - 3 inputs → (queries, keys, values).
+
+    ``project_input=False`` skips the Q/K/V/O projections (reference
+    ``projectInput`` flag) — then all inputs must share the embed size and
+    ``num_heads`` must divide it. Lowered to the Pallas flash kernel / XLA
+    fallback exactly like SelfAttention (no O(T²) HBM score matrix)."""
+
+    num_heads: int = 1
+    out_size: int = 0  # nOut; 0 → query embed size
+    head_size: Optional[int] = None
+    project_input: bool = True
+    causal: bool = False
+    dropout: float = 0.0
+    weight_init: Optional[str] = None
+    use_bias: bool = True
+
+    def _dims(self, eq):
+        out = self.out_size or eq
+        hd = self.head_size or out // self.num_heads
+        return out, hd
+
+    def output_shape_multi(self, in_shapes):
+        tq, eq = in_shapes[0]
+        if not self.project_input:
+            return (tq, eq)
+        out, _ = self._dims(eq)
+        return (tq, out)
+
+    # Single-input fallbacks so the layer also works in SequentialModel.
+    def output_shape(self, input_shape):
+        return self.output_shape_multi([input_shape])
+
+    def init(self, rng, input_shape, dtype):
+        return self.init_multi(rng, [input_shape], dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y, s = self.apply_multi(params, state, [x], train=train, rng=rng,
+                                mask=mask)
+        return y, s
+
+    def _resolve(self, xs):
+        if len(xs) == 1:
+            return xs[0], xs[0], xs[0]
+        if len(xs) == 2:
+            return xs[0], xs[1], xs[1]
+        if len(xs) == 3:
+            return xs[0], xs[1], xs[2]
+        raise ValueError(
+            f"CrossAttention takes 1-3 inputs (q[,k[,v]]), got {len(xs)}")
+
+    def init_multi(self, rng, in_shapes, dtype):
+        q_shape, k_shape, v_shape = self._resolve(list(in_shapes))
+        eq, ek, ev = q_shape[-1], k_shape[-1], v_shape[-1]
+        if not self.project_input:
+            if not (eq == ek == ev):
+                raise ValueError(
+                    "project_input=False requires equal embed sizes, got "
+                    f"{(eq, ek, ev)}")
+            if eq % self.num_heads:
+                raise ValueError(
+                    f"num_heads={self.num_heads} must divide embed {eq} "
+                    "when project_input=False")
+            return {}, {}
+        out, hd = self._dims(eq)
+        proj = self.num_heads * hd
+        w_init = get_initializer(self.weight_init or "xavier")
+        return _init_qkv(rng, (eq, ek, ev), proj, out, dtype, w_init,
+                         self.use_bias), {}
+
+    def apply_multi(self, params, state, xs, *, train=False, rng=None,
+                    mask=None):
+        q_in, k_in, v_in = self._resolve(list(xs))
+        if self.project_input:
+            q = opsnn.linear(q_in, params["Wq"], params.get("bq"))
+            k = opsnn.linear(k_in, params["Wk"], params.get("bk"))
+            v = opsnn.linear(v_in, params["Wv"], params.get("bv"))
+        else:
+            q, k, v = q_in, k_in, v_in
+        h = self.num_heads
+        y = flash_attention(
+            _split_heads(q, h), _split_heads(k, h), _split_heads(v, h),
+            causal=self.causal, key_mask=mask,
+        )
+        return _attend_tail(y, params, dropout=self.dropout, train=train,
+                            rng=rng, project=self.project_input), state
+
+
+@register_config
+@dataclass
+class RecurrentAttention(LayerConfig):
+    """↔ RecurrentAttentionLayer: an RNN whose step output attends over the
+    FULL input sequence, with the attention query derived from the previous
+    hidden state:
+
+        a_t = MHA(q = h_{t-1} Wq, K = X Wk, V = X Wv) Wo
+        h_t = act(x_t W + a_t R + b)
+
+    Inherently sequential (the query depends on h_{t-1}), so it lowers to
+    ``lax.scan`` over time — O(T²) FLOPs like the reference's SameDiff
+    implementation, but O(T) activation memory (K/V are projected once
+    outside the scan; each step is a single-query attention matvec, which
+    XLA fuses — no [T,T] score matrix is ever materialized)."""
+
+    units: int = 0  # nOut (required)
+    num_heads: int = 1
+    head_size: Optional[int] = None
+    activation: str = "tanh"
+    weight_init: Optional[str] = None
+
+    def _proj(self):
+        hd = self.head_size or self.units // self.num_heads
+        return self.num_heads * hd
+
+    def output_shape(self, input_shape):
+        t, _ = input_shape
+        return (t, self.units)
+
+    def init(self, rng, input_shape, dtype):
+        if self.units <= 0:
+            raise ValueError("RecurrentAttention requires units > 0")
+        e = input_shape[-1]
+        proj = self._proj()
+        w_init = get_initializer(self.weight_init or "xavier")
+        ks = jax.random.split(rng, 6)
+        params = {
+            "Wq": w_init(ks[0], (self.units, proj), dtype),
+            "Wk": w_init(ks[1], (e, proj), dtype),
+            "Wv": w_init(ks[2], (e, proj), dtype),
+            "Wo": w_init(ks[3], (proj, self.units), dtype),
+            "W": w_init(ks[4], (e, self.units), dtype),
+            "R": w_init(ks[5], (self.units, self.units), dtype),
+            "b": jnp.zeros((self.units,), dtype),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        n, t, e = x.shape
+        h_heads = self.num_heads
+        hd = self._proj() // h_heads
+        # K/V projected ONCE for the whole sequence (outside the scan).
+        k = _split_heads(opsnn.linear(x, params["Wk"]), h_heads)  # [N,H,T,D]
+        v = _split_heads(opsnn.linear(x, params["Wv"]), h_heads)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, x.dtype))
+        # Input projection hoisted out of the scan: x_t·W is h-independent,
+        # so it runs as ONE [N·T,E]×[E,units] MXU GEMM instead of T small
+        # per-step matmuls (same hoist ops/rnn.py does for the LSTM gates).
+        xw_t = jnp.swapaxes(opsnn.linear(x, params["W"]) + params["b"], 0, 1)
+        act = get_activation(self.activation)
+        neg = jnp.asarray(-1e9, x.dtype)
+
+        def step(h_prev, xw):
+            q = opsnn.linear(h_prev, params["Wq"])            # [N, H*D]
+            q = q.reshape(n, h_heads, hd)                     # [N,H,D]
+            scores = jnp.einsum("nhd,nhtd->nht", q, k) * scale
+            if mask is not None:
+                scores = jnp.where(mask[:, None, :] > 0, scores, neg)
+            w = jax.nn.softmax(scores, axis=-1)
+            a = jnp.einsum("nht,nhtd->nhd", w, v).reshape(n, h_heads * hd)
+            a = opsnn.linear(a, params["Wo"])                 # [N,units]
+            h = act(xw + a @ params["R"])
+            return h, h
+
+        h0 = jnp.zeros((n, self.units),
+                       jnp.result_type(x.dtype, params["W"].dtype))
+        _, ys = jax.lax.scan(step, h0, xw_t)
+        return jnp.swapaxes(ys, 0, 1), state
 
 
 @register_config

@@ -137,6 +137,9 @@ class SequentialModel:
         sequence (bidirectional) or collapse the time axis (last-step /
         global pooling / return_sequences=False) would silently change
         semantics per-window — raise instead."""
+        from deeplearning4j_tpu.nn.layers.attention import (
+            CrossAttention, PositionalEmbedding, RecurrentAttention,
+            SelfAttention, TransformerEncoderBlock)
         from deeplearning4j_tpu.nn.layers.recurrent import (Bidirectional,
                                                             LastTimeStep)
 
@@ -145,6 +148,16 @@ class SequentialModel:
             raise ValueError(
                 "truncated BPTT cannot be used with Bidirectional layers "
                 "(the backward direction needs the full sequence)")
+        if isinstance(layer, (SelfAttention, CrossAttention,
+                              RecurrentAttention, TransformerEncoderBlock)):
+            raise ValueError(
+                f"truncated BPTT cannot be used with {kind}: attention "
+                "reads the full sequence, so per-window application would "
+                "silently attend within each window only")
+        if isinstance(layer, PositionalEmbedding):
+            raise ValueError(
+                "truncated BPTT cannot be used with PositionalEmbedding: "
+                "absolute positions would restart at 0 in every window")
         if isinstance(layer, LastTimeStep) or kind in ("GlobalPooling",
                                                        "GlobalPooling1D"):
             raise ValueError(
@@ -422,8 +435,37 @@ class GraphModel:
             in_shapes = [self.shapes[i] for i in v.inputs]
             self.shapes[name] = self._vertex_out_shape(v, in_shapes)
 
+    @staticmethod
+    def _is_multi(v: GraphVertex) -> bool:
+        """True when a layer vertex routes ALL inputs to the layer via the
+        multi-input protocol (↔ AttentionVertex-style vertices).
+
+        ``apply_multi`` is the canonical flag; a layer declaring it must
+        also declare ``init_multi`` + ``output_shape_multi`` (validated
+        here so a half-implemented protocol fails loudly at build, not as
+        a mis-sized-weight error deep in tracing), and a multi-input
+        vertex whose layer has no protocol is rejected rather than
+        silently dropping inputs 1..n."""
+        if v.kind != "layer" or len(v.inputs) <= 1:
+            return False
+        if not hasattr(v.layer, "apply_multi"):
+            raise ValueError(
+                f"layer vertex with {len(v.inputs)} inputs requires a "
+                f"multi-input layer (apply_multi), but "
+                f"{type(v.layer).__name__} is single-input — merge the "
+                "inputs with a 'merge'/elementwise vertex first")
+        missing = [m for m in ("init_multi", "output_shape_multi")
+                   if not hasattr(v.layer, m)]
+        if missing:
+            raise TypeError(
+                f"{type(v.layer).__name__} declares apply_multi but lacks "
+                f"{missing}: the multi-input protocol is all-or-nothing")
+        return True
+
     def _vertex_out_shape(self, v: GraphVertex, in_shapes):
         if v.kind == "layer":
+            if self._is_multi(v):
+                return tuple(v.layer.output_shape_multi(in_shapes))
             return tuple(v.layer.output_shape(in_shapes[0]))
         if v.kind == "merge":
             feat = sum(s[-1] for s in in_shapes)
@@ -446,10 +488,15 @@ class GraphModel:
             v = self.config.vertices[name]
             if v.kind != "layer":
                 continue
-            in_shape = self.shapes[v.inputs[0]]
-            p, s = _with_net_weight_init(v.layer, self.net).init(
-                jax.random.fold_in(rng, i), in_shape, dtype
-            )
+            layer = _with_net_weight_init(v.layer, self.net)
+            if self._is_multi(v):
+                p, s = layer.init_multi(
+                    jax.random.fold_in(rng, i),
+                    [self.shapes[inp] for inp in v.inputs], dtype)
+            else:
+                p, s = layer.init(
+                    jax.random.fold_in(rng, i), self.shapes[v.inputs[0]],
+                    dtype)
             if p:
                 params[name] = p
             if s:
@@ -519,10 +566,14 @@ class GraphModel:
                 lrng = jax.random.fold_in(rng, i) if rng is not None else None
                 p = apply_weight_noise(
                     v.layer, params.get(name, {}), lrng, train)
-                y, s = v.layer.apply(
-                    p, state.get(name, {}), xs[0],
-                    train=train, rng=lrng,
-                )
+                if self._is_multi(v):
+                    y, s = v.layer.apply_multi(
+                        p, state.get(name, {}), xs, train=train, rng=lrng)
+                else:
+                    y, s = v.layer.apply(
+                        p, state.get(name, {}), xs[0],
+                        train=train, rng=lrng,
+                    )
                 if s:
                     new_state[name] = s
             elif v.kind in _MERGE_OPS:

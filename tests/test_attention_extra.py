@@ -1,0 +1,256 @@
+"""Cross-attention vertex + recurrent attention layer
+(↔ org.deeplearning4j.nn.conf.graph.AttentionVertex and
+org.deeplearning4j.nn.conf.layers.RecurrentAttentionLayer — the last two
+members of the reference's attention surface, SURVEY §5.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.config import (
+    GraphConfig,
+    GraphVertex,
+    NeuralNetConfiguration,
+    config_from_json,
+)
+from deeplearning4j_tpu.nn.layers.attention import (
+    CrossAttention,
+    RecurrentAttention,
+)
+from deeplearning4j_tpu.nn.model import GraphModel
+
+
+def _x(shape, seed=0):
+    return jnp.asarray(np.random.default_rng(seed)
+                       .normal(size=shape).astype(np.float32))
+
+
+def _ref_mha(q, k, v, params, num_heads):
+    """O(T²) oracle: plain softmax attention with the layer's projections."""
+    def lin(x, w, b):
+        y = x @ np.asarray(w)
+        return y + np.asarray(b) if b is not None else y
+
+    qp = lin(np.asarray(q), params["Wq"], params.get("bq"))
+    kp = lin(np.asarray(k), params["Wk"], params.get("bk"))
+    vp = lin(np.asarray(v), params["Wv"], params.get("bv"))
+    n, tq, proj = qp.shape
+    tk = kp.shape[1]
+    d = proj // num_heads
+    qh = qp.reshape(n, tq, num_heads, d).transpose(0, 2, 1, 3)
+    kh = kp.reshape(n, tk, num_heads, d).transpose(0, 2, 1, 3)
+    vh = vp.reshape(n, tk, num_heads, d).transpose(0, 2, 1, 3)
+    scores = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d)
+    w = np.exp(scores - scores.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    y = (w @ vh).transpose(0, 2, 1, 3).reshape(n, tq, proj)
+    return lin(y, params["Wo"], params.get("bo"))
+
+
+class TestCrossAttention:
+    def test_three_input_matches_oracle(self):
+        layer = CrossAttention(num_heads=2, out_size=8)
+        shapes = [(5, 8), (7, 6), (7, 10)]
+        p, _ = layer.init_multi(jax.random.key(0), shapes, jnp.float32)
+        q, k, v = _x((2, 5, 8), 1), _x((2, 7, 6), 2), _x((2, 7, 10), 3)
+        y, _ = layer.apply_multi(p, {}, [q, k, v])
+        assert y.shape == (2, 5, 8)
+        ref = _ref_mha(q, k, v, p, 2)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-5)
+
+    def test_two_input_shares_kv(self):
+        layer = CrossAttention(num_heads=1, out_size=4)
+        p, _ = layer.init_multi(jax.random.key(1), [(3, 4), (6, 4)],
+                                jnp.float32)
+        q, kv = _x((2, 3, 4), 4), _x((2, 6, 4), 5)
+        y2, _ = layer.apply_multi(p, {}, [q, kv])
+        y3, _ = layer.apply_multi(p, {}, [q, kv, kv])
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y3))
+
+    def test_unprojected_requires_equal_embed(self):
+        layer = CrossAttention(num_heads=2, project_input=False)
+        with pytest.raises(ValueError, match="equal embed"):
+            layer.init_multi(jax.random.key(0), [(3, 4), (5, 6)], jnp.float32)
+        # equal embeds: parameterless, output == plain attention on inputs
+        p, _ = layer.init_multi(jax.random.key(0), [(3, 4), (5, 4)],
+                                jnp.float32)
+        assert p == {}
+
+    def test_arity_validation(self):
+        layer = CrossAttention()
+        with pytest.raises(ValueError, match="1-3 inputs"):
+            layer.apply_multi({}, {}, [1, 2, 3, 4])
+
+    def test_vertex_in_graph_trains(self):
+        """Translation-style graph: query seq + context seq → cross-attn →
+        per-step classification; loss decreases and JSON round-trips."""
+        verts = {
+            "xatt": GraphVertex(
+                kind="layer", inputs=["qseq", "ctx"],
+                layer=CrossAttention(num_heads=2, out_size=8)),
+            "out": GraphVertex(
+                kind="layer", inputs=["xatt"],
+                layer=L.RnnOutputLayer(units=3, activation="softmax",
+                                       loss="mcxent")),
+        }
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        cfg = GraphConfig(net=NeuralNetConfiguration(seed=0, updater=Adam(1e-2)),
+                          inputs=["qseq", "ctx"],
+                          input_shapes={"qseq": (5, 8), "ctx": (9, 6)},
+                          vertices=verts, outputs=["out"])
+        m = GraphModel(cfg)
+        assert m.shapes["xatt"] == (5, 8)
+        v = m.init()
+        rng = np.random.default_rng(0)
+        feats = {"qseq": _x((4, 5, 8), 6), "ctx": _x((4, 9, 6), 7)}
+        labels = jax.nn.one_hot(
+            jnp.asarray(rng.integers(0, 3, size=(4, 5))), 3)
+
+        from deeplearning4j_tpu.train.trainer import Trainer
+
+        tr = Trainer(m)
+        ts = tr.init_state(v)
+        batch = {"features": feats, "labels": {"out": labels}}
+        losses = []
+        for _ in range(30):
+            ts, metrics = tr.train_step(ts, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.7
+
+        # config JSON round-trip preserves the multi-input vertex
+        cfg2 = config_from_json(cfg.to_json())
+        m2 = GraphModel(cfg2)
+        assert m2.shapes["xatt"] == (5, 8)
+
+
+class TestRecurrentAttention:
+    def _ref_loop(self, layer, p, x):
+        """Per-step numpy oracle of the scan."""
+        n, t, e = x.shape
+        h_heads, units = layer.num_heads, layer.units
+        proj = layer._proj()
+        d = proj // h_heads
+        k = (np.asarray(x) @ np.asarray(p["Wk"])).reshape(n, t, h_heads, d)
+        v = (np.asarray(x) @ np.asarray(p["Wv"])).reshape(n, t, h_heads, d)
+        h = np.zeros((n, units), np.float32)
+        ys = []
+        for step in range(t):
+            q = (h @ np.asarray(p["Wq"])).reshape(n, h_heads, d)
+            scores = np.einsum("nhd,nthd->nht", q, k) / np.sqrt(d)
+            w = np.exp(scores - scores.max(-1, keepdims=True))
+            w = w / w.sum(-1, keepdims=True)
+            a = np.einsum("nht,nthd->nhd", w, v).reshape(n, proj)
+            a = a @ np.asarray(p["Wo"])
+            h = np.tanh(np.asarray(x)[:, step] @ np.asarray(p["W"])
+                        + a @ np.asarray(p["R"]) + np.asarray(p["b"]))
+            ys.append(h)
+        return np.stack(ys, axis=1)
+
+    def test_matches_per_step_oracle(self):
+        layer = RecurrentAttention(units=6, num_heads=2)
+        p, _ = layer.init(jax.random.key(0), (7, 5), jnp.float32)
+        x = _x((3, 7, 5), 8)
+        y, _ = layer.apply(p, {}, x)
+        assert y.shape == (3, 7, 6)
+        np.testing.assert_allclose(np.asarray(y), self._ref_loop(layer, p, x),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_mask_excludes_padding(self):
+        """A masked key position must not influence any step's output."""
+        layer = RecurrentAttention(units=4, num_heads=1)
+        p, _ = layer.init(jax.random.key(1), (6, 3), jnp.float32)
+        x = _x((2, 6, 3), 9)
+        mask = jnp.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]],
+                           jnp.float32)
+        y1, _ = layer.apply(p, {}, x, mask=mask)
+        # perturb the masked tail of example 0; its output must not move
+        x2 = x.at[0, 4:].set(99.0)
+        y2, _ = layer.apply(p, {}, x2, mask=mask)
+        # note: x_t itself feeds h_t, so only steps 0-3 of example 0 are
+        # invariant (steps 4-5 see their own perturbed x_t input)
+        np.testing.assert_allclose(np.asarray(y1[0, :4]),
+                                   np.asarray(y2[0, :4]), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(y1[1]), np.asarray(y2[1]),
+                                   atol=1e-5)
+
+    def test_gradcheck(self):
+        from deeplearning4j_tpu.autodiff.validation import check_gradients
+
+        layer = RecurrentAttention(units=3, num_heads=1)
+        p, _ = layer.init(jax.random.key(2), (4, 3), jnp.float32)
+        x = jnp.asarray(np.random.default_rng(3)
+                        .normal(size=(2, 4, 3)).astype(np.float32))
+
+        def f(params):
+            y, _ = layer.apply(params, {}, x)
+            return jnp.sum(y * y)
+
+        report = check_gradients(f, {k: np.asarray(v) for k, v in p.items()},
+                                 samples_per_param=16)
+        assert report["passed"]
+
+    def test_trains_in_sequential(self):
+        from deeplearning4j_tpu.nn.config import SequentialConfig
+        from deeplearning4j_tpu.nn.model import SequentialModel
+        from deeplearning4j_tpu.train.trainer import Trainer
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        cfg = SequentialConfig(
+            net=NeuralNetConfiguration(seed=0, updater=Adam(1e-2)),
+            input_shape=(6, 4),
+            layers=[RecurrentAttention(units=8, num_heads=2),
+                    L.RnnOutputLayer(units=2, activation="softmax",
+                                     loss="mcxent")])
+        m = SequentialModel(cfg)
+        tr = Trainer(m)
+        ts = tr.init_state()
+        rng = np.random.default_rng(1)
+        batch = {
+            "features": _x((8, 6, 4), 10),
+            "labels": jax.nn.one_hot(
+                jnp.asarray(rng.integers(0, 2, size=(8, 6))), 2),
+        }
+        losses = []
+        for _ in range(25):
+            ts, metrics = tr.train_step(ts, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.8
+
+
+class TestProtocolGuards:
+    def test_multi_input_vertex_without_protocol_rejected(self):
+        verts = {"d": GraphVertex(kind="layer", inputs=["a", "b"],
+                                  layer=L.Dense(units=4))}
+        cfg = GraphConfig(net=NeuralNetConfiguration(seed=0),
+                          inputs=["a", "b"],
+                          input_shapes={"a": (3,), "b": (3,)},
+                          vertices=verts, outputs=["d"])
+        with pytest.raises(ValueError, match="multi-input layer"):
+            GraphModel(cfg)
+
+    def test_tbptt_rejects_attention_layers(self):
+        from deeplearning4j_tpu.nn.config import SequentialConfig
+        from deeplearning4j_tpu.nn.layers.attention import SelfAttention
+        from deeplearning4j_tpu.nn.model import SequentialModel
+
+        cfg = SequentialConfig(
+            net=NeuralNetConfiguration(seed=0), input_shape=(8, 4),
+            layers=[RecurrentAttention(units=4),
+                    L.RnnOutputLayer(units=2, activation="softmax",
+                                     loss="mcxent")])
+        m = SequentialModel(cfg)
+        v = m.init()
+        with pytest.raises(ValueError, match="full sequence"):
+            m.apply_tbptt(v, _x((2, 4, 4)), {})
+        cfg2 = SequentialConfig(
+            net=NeuralNetConfiguration(seed=0), input_shape=(8, 4),
+            layers=[SelfAttention(num_heads=2, out_size=4),
+                    L.RnnOutputLayer(units=2, activation="softmax",
+                                     loss="mcxent")])
+        m2 = SequentialModel(cfg2)
+        v2 = m2.init()
+        with pytest.raises(ValueError, match="full sequence"):
+            m2.apply_tbptt(v2, _x((2, 4, 4)), {})
