@@ -199,3 +199,123 @@ class TimeIterationListener(TrainingListener):
 
     def on_iteration(self, epoch, step, ts, metrics):
         return (time.time() - self._start) > self.max_seconds
+
+
+class ModelStatsListener(TrainingListener):
+    """↔ StatsListener: per-layer parameter/update statistics — the data
+    behind the reference UI's model tab (mean-magnitude charts, the
+    log10(update:param ratio) tuning chart — healthy training sits near
+    1e-3 — and parameter histograms).
+
+    TPU-first inversion: the reference computes stats inside the training
+    loop on every reported iteration (host INDArray math per layer). Here
+    the train step is one donated XLA program, so the listener snapshots
+    params to HOST numpy on the iteration BEFORE each report (donated
+    device buffers from step N are invalid at N+1) and diffs on the report
+    iteration. Cost: one D2H transfer of the params every ``every`` steps
+    and one the step before; zero cost in the compiled step itself.
+
+    Emits a flat record {"param_mm/<layer>", "update_mm/<layer>",
+    "update_ratio/<layer>"} to a JSONL file (consumable by UIServer) and/or
+    a TensorBoardWriter (scalars + optional parameter histograms).
+
+    TBPTT granularity: under ``backprop_type='tbptt'`` the trainer fires
+    ``on_iteration`` once per WINDOW but updates params once per batch, so
+    consecutive callbacks can see bit-identical params. A report whose
+    params are identical to the snapshot is skipped (the snapshot is
+    retained), so emitted ratios always measure a real update — at
+    per-batch granularity in that mode.
+    """
+
+    def __init__(self, every: int = 10, *, jsonl_path: Optional[str] = None,
+                 tensorboard=None, histograms: bool = False):
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        self.every = every
+        self.jsonl_path = jsonl_path
+        self.tb = tensorboard
+        self.histograms = histograms
+        self._prev = None  # host params snapshot from step-1
+        self._fh = None
+
+    def on_fit_start(self, trainer, ts):
+        # a retained snapshot from a previous fit() would diff params of
+        # two unrelated initializations
+        self._prev = None
+        if self.jsonl_path:
+            self._fh = open(self.jsonl_path, "a")
+
+    @staticmethod
+    def _host_params(ts):
+        import numpy as np  # noqa: PLC0415 - host-side only
+
+        # tree_map handles arbitrarily nested per-layer param groups
+        # (Bidirectional's {"fwd": ..., "bwd": ...}, ConvLSTM2D, ...).
+        return {layer: jax.tree_util.tree_map(
+                    lambda v: np.asarray(jax.device_get(v)), group)
+                for layer, group in ts.params.items()}
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        import numpy as np  # noqa: PLC0415
+
+        cur = None
+        report = step % self.every == 0
+        if report and self._prev is not None:
+            cur = self._host_params(ts)
+            stats = {}  # layer -> (p_mm, u_mm, leaves)
+            total_update = 0.0
+            for layer, group in cur.items():
+                leaves, treedef = jax.tree_util.tree_flatten(group)
+                prev = self._prev.get(layer)
+                if prev is None:
+                    continue
+                prev_leaves, prev_def = jax.tree_util.tree_flatten(prev)
+                if prev_def != treedef:
+                    continue
+                p_abs, u_abs, n = 0.0, 0.0, 0
+                for w, pw in zip(leaves, prev_leaves):
+                    if w.shape != pw.shape:
+                        continue
+                    p_abs += float(np.abs(w).sum())
+                    u_abs += float(np.abs(w - pw).sum())
+                    n += w.size
+                if not n:
+                    continue
+                stats[layer] = (p_abs / n, u_abs / n, leaves)
+                total_update += u_abs
+            if stats and total_update == 0.0:
+                # bit-identical params (e.g. TBPTT windows between batch
+                # updates): not a real report — retain the snapshot so the
+                # next distinct state diffs against it
+                return False
+            rec = {"epoch": epoch, "step": step, "time": time.time()}
+            for layer, (p_mm, u_mm, leaves) in stats.items():
+                rec[f"param_mm/{layer}"] = p_mm
+                rec[f"update_mm/{layer}"] = u_mm
+                rec[f"update_ratio/{layer}"] = u_mm / p_mm if p_mm else 0.0
+                if self.tb is not None:
+                    for tag in ("param_mm", "update_mm", "update_ratio"):
+                        self.tb.add_scalar(f"{tag}/{layer}", rec[f"{tag}/{layer}"],
+                                           step)
+                    if self.histograms:
+                        flat = np.concatenate([w.ravel() for w in leaves])
+                        self.tb.add_histogram(f"params/{layer}", flat, step)
+            if self._fh:
+                self._fh.write(json.dumps(rec) + "\n")
+                self._fh.flush()
+            self._prev = None
+        # snapshot the step BEFORE the next report (donation invalidates
+        # old device buffers, so the diff needs a host copy); with every=1
+        # the just-fetched report copy IS that snapshot. A RETAINED
+        # snapshot (identical-params skip above) is never overwritten —
+        # it stays the diff base until a report consumes it, which is what
+        # makes TBPTT's repeated-state callbacks resolve to per-batch
+        # updates instead of zeros.
+        if self._prev is None and (step + 1) % self.every == 0:
+            self._prev = cur if cur is not None else self._host_params(ts)
+        return False
+
+    def on_fit_end(self, trainer, ts):
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -1,6 +1,6 @@
 """Examples smoke tests (↔ dl4j-examples being the de-facto integration
 suite of the reference). Each example runs --quick in a subprocess with
-the CPU platform; the two cheapest run always, the full set behind
+the CPU platform; the cheap ones run always, the full set behind
 DL4J_TPU_EXAMPLE_TESTS=1 (they re-train small models, ~1-2 min each)."""
 
 import os
@@ -11,7 +11,8 @@ import sys
 import pytest
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-FAST = ["samediff_graph.py", "word2vec_similarity.py"]
+FAST = ["samediff_graph.py", "word2vec_similarity.py",
+        "seq2seq_attention.py"]
 SLOW = ["mnist_lenet.py", "transfer_learning.py", "bert_mlm_pretrain.py",
         "char_rnn_generation.py", "data_parallel_mesh.py",
         "hyperparameter_search.py"]

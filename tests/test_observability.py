@@ -165,3 +165,155 @@ class TestProfilingListener:
             trainer.fit(ts, _data(), epochs=4, listeners=[lst])
         rows = compare_traces(str(tmp_path / "a"), str(tmp_path / "b"))
         assert rows and all("delta_us" in r for r in rows)
+
+
+class TestModelStatsListener:
+    """↔ StatsListener: per-layer mean magnitudes + update:param ratio."""
+
+    def _fit(self, tmp_path, **kw):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        from deeplearning4j_tpu.train.listeners import ModelStatsListener
+
+        m = _model()
+        tr = Trainer(m)
+        ts = tr.init_state()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(64, 8)).astype(np.float32))
+        y = jnp.asarray(np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)])
+        listener = ModelStatsListener(every=4, **kw)
+        tr.fit(ts, ArrayDataSetIterator(x, y, batch_size=16), epochs=4,
+               listeners=[listener])
+        return m
+
+    def test_jsonl_records_ratios_per_layer(self, tmp_path):
+        import json as _json
+
+        path = str(tmp_path / "stats.jsonl")
+        m = self._fit(tmp_path, jsonl_path=path)
+        rows = [_json.loads(l) for l in open(path)]
+        assert rows, "no stats records written"
+        layer_names = [n for n, _ in m.named_layers()]
+        for row in rows:
+            for name in layer_names:
+                assert f"param_mm/{name}" in row
+                assert f"update_mm/{name}" in row
+                ratio = row[f"update_ratio/{name}"]
+                # Adam with lr 1e-2 on a converging net: ratios are small
+                # positive numbers; 0 would mean the diff saw no update
+                assert 0 < ratio < 1.0
+
+    def test_tensorboard_scalars_and_histograms(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        tb_dir = str(tmp_path / "tb")
+        w = TensorBoardWriter(tb_dir)
+        self._fit(tmp_path, tensorboard=w, histograms=True)
+        w.close()
+        events = glob.glob(os.path.join(tb_dir, "events.out.tfevents.*"))
+        assert events
+        tags = set()
+        for e in tf.compat.v1.train.summary_iterator(events[0]):
+            for v in e.summary.value:
+                tags.add(v.tag)
+        assert any(t.startswith("update_ratio/") for t in tags)
+        assert any(t.startswith("params/") for t in tags)
+
+    def test_nested_param_groups_bidirectional(self, tmp_path):
+        """Bidirectional layers have {'fwd': {...}, 'bwd': {...}} params —
+        the stats walk must traverse nested groups, not assume two dict
+        levels."""
+        import json as _json
+
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        from deeplearning4j_tpu.nn.config import SequentialConfig
+        from deeplearning4j_tpu.nn.layers import (LSTM, Bidirectional,
+                                                  RnnOutputLayer)
+        from deeplearning4j_tpu.nn.model import SequentialModel
+        from deeplearning4j_tpu.train.listeners import ModelStatsListener
+
+        cfg = SequentialConfig(
+            net=NeuralNetConfiguration(updater=Adam(1e-2), seed=0),
+            input_shape=(6, 4),
+            layers=[Bidirectional(LSTM(units=8)),
+                    RnnOutputLayer(units=2, activation="softmax",
+                                   loss="mcxent")])
+        m = SequentialModel(cfg)
+        tr = Trainer(m)
+        rng = np.random.default_rng(0)
+        x = np.asarray(rng.normal(size=(32, 6, 4)), np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (32, 6))]
+        path = str(tmp_path / "bi.jsonl")
+        tr.fit(tr.init_state(), ArrayDataSetIterator(jnp.asarray(x),
+                                                     jnp.asarray(y),
+                                                     batch_size=16),
+               epochs=4, listeners=[ModelStatsListener(every=3,
+                                                       jsonl_path=path)])
+        rows = [_json.loads(l) for l in open(path)]
+        assert rows
+        bi_name = m.layer_names[0]
+        assert any(f"update_ratio/{bi_name}" in r for r in rows)
+
+    def test_reuse_across_fits_resets_snapshot(self, tmp_path):
+        """A listener reused for a second fit must not diff across the two
+        models' unrelated initializations."""
+        import json as _json
+
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        from deeplearning4j_tpu.train.listeners import ModelStatsListener
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+        y = jnp.asarray(np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)])
+        path = str(tmp_path / "r.jsonl")
+        # every=4, 2 steps/epoch, 2 epochs -> 4 steps: snapshot at step 3,
+        # fit ends with _prev set
+        lis = ModelStatsListener(every=4, jsonl_path=path)
+        for _ in range(2):
+            m = _model()
+            tr = Trainer(m)
+            tr.fit(tr.init_state(), ArrayDataSetIterator(x, y, batch_size=16),
+                   epochs=2, listeners=[lis])
+        rows = [_json.loads(l) for l in open(path)]
+        for row in rows:
+            for k, v in row.items():
+                if k.startswith("update_ratio/"):
+                    assert v < 0.5, (
+                        "cross-fit diff leaked into ratios: %r" % row)
+
+    def test_tbptt_identical_params_not_reported_as_dead(self, tmp_path):
+        """Under TBPTT, windows between batch updates see identical params;
+        those must be skipped, not written as update_ratio=0."""
+        import json as _json
+
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        from deeplearning4j_tpu.nn.config import SequentialConfig
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        from deeplearning4j_tpu.nn.model import SequentialModel
+        from deeplearning4j_tpu.train.listeners import ModelStatsListener
+
+        cfg = SequentialConfig(
+            net=NeuralNetConfiguration(updater=Adam(1e-2), seed=0,
+                                       backprop_type="tbptt",
+                                       tbptt_length=4),
+            input_shape=(16, 3),
+            layers=[LSTM(units=8),
+                    RnnOutputLayer(units=2, activation="softmax",
+                                   loss="mcxent")])
+        m = SequentialModel(cfg)
+        tr = Trainer(m)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(16, 16, 3)).astype(np.float32))
+        y = jnp.asarray(np.eye(2, dtype=np.float32)[
+            rng.integers(0, 2, (16, 16))])
+        path = str(tmp_path / "tb.jsonl")
+        tr.fit(tr.init_state(), ArrayDataSetIterator(x, y, batch_size=8),
+               epochs=6,
+               listeners=[ModelStatsListener(every=2, jsonl_path=path)])
+        rows = [_json.loads(l) for l in open(path)]
+        ratios = [v for r in rows for k, v in r.items()
+                  if k.startswith("update_ratio/")]
+        assert ratios, "no reports emitted at all under tbptt"
+        assert all(v > 0 for v in ratios), "zero-update report leaked"
