@@ -16,6 +16,7 @@ from deeplearning4j_tpu.rl.history import (
     SyntheticFrameEnv,
 )
 from deeplearning4j_tpu.rl.a3c import A3CConfig, A3CDiscrete
+from deeplearning4j_tpu.rl.malmo import MalmoStyleEnv, MissionSpec
 from deeplearning4j_tpu.rl.mdp import MDP, CartPole, Corridor, Pendulum
 from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedyPolicy, GreedyPolicy
 from deeplearning4j_tpu.rl.qlearning import QLearningConfig, QLearningDiscrete
@@ -30,4 +31,5 @@ __all__ = [
     "A2C", "A2CConfig",
     "A3CDiscrete", "A3CConfig",
     "TD3", "TD3Config",
+    "MissionSpec", "MalmoStyleEnv",
 ]

@@ -105,8 +105,14 @@ class FrameStackEnv:
         self.env = env
         self.skip = max(1, skip)
         self.proc = HistoryProcessor(stack=stack, size=size, scale=scale)
-        self.action_space_n: Optional[int] = getattr(env, "action_space_n",
-                                                     None)
+        # expose the MDP-protocol surface so learners can wrap this env
+        # directly (they read action_count/observation_shape, mdp.py:21-22)
+        n = getattr(env, "action_count", None) or getattr(
+            env, "action_space_n", None)
+        self.action_space_n: Optional[int] = n
+        if n is not None:
+            self.action_count = int(n)
+        self.observation_shape = (stack, *self.proc.size)
 
     def reset(self) -> np.ndarray:
         frame = self.env.reset()

@@ -1,0 +1,156 @@
+"""Malmo-style mission connector (↔ rl4j-malmo, SURVEY §2.7 RL4J row).
+
+ref: org.deeplearning4j.rl4j.mdp.MalmoEnv + MalmoBox/MalmoActionSpace —
+RL4J's Minecraft connector, which adapts a *mission*-driven simulator
+(declarative mission spec → episode; pixel-frame observations; discrete
+movement commands; per-event rewards) onto its MDP interface. Malmo itself
+is an external Minecraft mod that cannot run here (zero egress, no JVM
+game process); as with the ALE connector (`rl/history.py`), the deliverable
+is the connector half:
+
+- ``MissionSpec``: the declarative episode description the reference
+  expresses as mission XML — grid layout, start/goal, hazard blocks,
+  reward table, time limit — with JSON round-trip so missions are data,
+  not code (the framework-wide config-as-data rule, SURVEY §5.6).
+- ``MalmoStyleEnv``: executes a MissionSpec as an MDP with **rendered RGB
+  frame observations** ([H, W, 3] uint8, like Malmo's video producer) and
+  the discrete movement action set (movenorth/south/east/west). Plugs
+  straight into ``HistoryProcessor``/``FrameStackEnv`` and the DQN/A2C
+  learners, exactly where the reference's MalmoEnv sat.
+
+A real Malmo endpoint would implement the same two methods against the
+game socket; every downstream component is exercised by the synthetic
+executor below.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# Block palette: mission grids are lists of strings over these characters.
+_BLOCK_COLORS: Dict[str, Tuple[int, int, int]] = {
+    ".": (60, 60, 60),     # floor (stone)
+    "#": (120, 85, 40),    # wall (impassable)
+    "L": (220, 80, 0),     # lava (hazard, terminal)
+    "G": (40, 200, 60),    # goal (emerald, terminal)
+    "S": (60, 60, 60),     # start (rendered as floor)
+}
+_AGENT_COLOR = (230, 230, 40)
+
+ACTIONS: List[str] = ["movenorth", "movesouth", "movewest", "moveeast"]
+_DELTAS = {0: (-1, 0), 1: (1, 0), 2: (0, -1), 3: (0, 1)}
+
+
+@dataclass
+class MissionSpec:
+    """Declarative mission (↔ Malmo mission XML, as data).
+
+    ``grid`` rows use the block palette: ``.`` floor, ``#`` wall, ``L``
+    lava (terminal, ``hazard_reward``), ``G`` goal (terminal,
+    ``goal_reward``), ``S`` start cell (exactly one).
+    """
+
+    grid: List[str] = field(default_factory=lambda: [
+        "#######",
+        "#S..L.#",
+        "#.##..#",
+        "#...#G#",
+        "#######",
+    ])
+    goal_reward: float = 100.0
+    hazard_reward: float = -100.0
+    step_reward: float = -1.0
+    max_steps: int = 100
+    cell_px: int = 4  # rendered pixels per grid cell
+
+    def __post_init__(self):
+        widths = {len(r) for r in self.grid}
+        if len(widths) != 1:
+            raise ValueError("mission grid rows must have equal width")
+        unknown = {c for r in self.grid for c in r} - set(_BLOCK_COLORS)
+        if unknown:
+            raise ValueError(f"unknown mission blocks: {sorted(unknown)}")
+        starts = sum(r.count("S") for r in self.grid)
+        if starts != 1:
+            raise ValueError(f"mission needs exactly one 'S' start, got {starts}")
+
+    @property
+    def start(self) -> Tuple[int, int]:
+        for i, row in enumerate(self.grid):
+            j = row.find("S")
+            if j >= 0:
+                return (i, j)
+        raise AssertionError("validated grid lost its start")
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "grid": self.grid, "goal_reward": self.goal_reward,
+            "hazard_reward": self.hazard_reward,
+            "step_reward": self.step_reward, "max_steps": self.max_steps,
+            "cell_px": self.cell_px,
+        })
+
+    @classmethod
+    def from_json(cls, s: str) -> "MissionSpec":
+        return cls(**json.loads(s))
+
+
+class MalmoStyleEnv:
+    """Mission-executing MDP with RGB frame observations (↔ MalmoEnv).
+
+    Observations are [H, W, 3] uint8 frames (H = rows * cell_px), the raw
+    form the DeepMind pipeline in ``rl/history.py`` consumes; actions are
+    indices into ``ACTIONS``. Moving into a wall is a no-op step (Malmo
+    semantics: the command executes, the agent stays put, time advances).
+    """
+
+    def __init__(self, mission: MissionSpec = None):
+        self.mission = mission or MissionSpec()
+        g = self.mission.grid
+        self.action_count = len(ACTIONS)
+        self.action_space_n = len(ACTIONS)
+        h = len(g) * self.mission.cell_px
+        w = len(g[0]) * self.mission.cell_px
+        self.observation_shape = (h, w, 3)
+        self._pos = self.mission.start
+        self._t = 0
+
+    def _render(self) -> np.ndarray:
+        px = self.mission.cell_px
+        g = self.mission.grid
+        frame = np.zeros((len(g) * px, len(g[0]) * px, 3), np.uint8)
+        for i, row in enumerate(g):
+            for j, c in enumerate(row):
+                frame[i * px:(i + 1) * px, j * px:(j + 1) * px] = \
+                    _BLOCK_COLORS[c]
+        i, j = self._pos
+        frame[i * px:(i + 1) * px, j * px:(j + 1) * px] = _AGENT_COLOR
+        return frame
+
+    def reset(self) -> np.ndarray:
+        self._pos = self.mission.start
+        self._t = 0
+        return self._render()
+
+    def step(self, action: int):
+        di, dj = _DELTAS[int(action)]
+        i, j = self._pos
+        ni, nj = i + di, j + dj
+        g = self.mission.grid
+        if 0 <= ni < len(g) and 0 <= nj < len(g[0]) and g[ni][nj] != "#":
+            self._pos = (ni, nj)
+        self._t += 1
+        block = g[self._pos[0]][self._pos[1]]
+        if block == "G":
+            return self._render(), self.mission.goal_reward, True, \
+                {"truncated": False, "block": "goal"}
+        if block == "L":
+            return self._render(), self.mission.hazard_reward, True, \
+                {"truncated": False, "block": "lava"}
+        truncated = self._t >= self.mission.max_steps
+        return self._render(), self.mission.step_reward, truncated, \
+            {"truncated": truncated, "block": block}
