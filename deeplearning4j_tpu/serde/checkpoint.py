@@ -41,10 +41,12 @@ def _is_key_array(x) -> bool:
     return isinstance(x, jax.Array) and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
 
 
-def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict] = None):
-    """Save any pytree (TrainState, variables dict, …) to directory."""
-    d = Path(directory)
-    d.mkdir(parents=True, exist_ok=True)
+def _snapshot_tree(tree: Any):
+    """Device→host snapshot of a pytree: (arrays dict, key metadata).
+
+    This is the part of a save that MUST run before training continues —
+    donated buffers from the snapshotted state become invalid at the next
+    step — and it is cheap next to the file IO that follows."""
     arrays: Dict[str, np.ndarray] = {}
     key_paths = []
     key_impls: Dict[str, str] = {}
@@ -58,6 +60,14 @@ def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict]
             key_impls[name] = str(jax.random.key_impl(leaf))
         else:
             arrays[name] = np.asarray(jax.device_get(leaf))
+    return arrays, key_paths, key_impls
+
+
+def _write_snapshot(directory: str | Path, arrays: Dict[str, np.ndarray],
+                    key_paths, key_impls, extra_meta: Optional[dict] = None):
+    """File-IO half of a save; safe to run off-thread (touches no jax)."""
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
     np.savez(d / "state.npz", **arrays)
     meta = {
         "version": __version__,
@@ -69,6 +79,11 @@ def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict]
     if extra_meta:
         meta.update(extra_meta)
     (d / "meta.json").write_text(json.dumps(meta, indent=2))
+
+
+def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict] = None):
+    """Save any pytree (TrainState, variables dict, …) to directory."""
+    _write_snapshot(directory, *_snapshot_tree(tree), extra_meta=extra_meta)
 
 
 def load_state_tree(directory: str | Path, template: Any, sharding=None) -> Any:
@@ -135,19 +150,14 @@ def _place(tree, sharding):
     return jax.tree_util.tree_map(put, tree, sharding)
 
 
-def save_checkpoint(directory: str | Path, train_state, *, model=None,
-                    tag: str = "", keep_last: int = 0):
-    """Full training checkpoint: state + model config + rotation index
-    (↔ CheckpointListener.keepLast + checkpoint.json)."""
-    root = Path(directory)
-    root.mkdir(parents=True, exist_ok=True)
-    step = int(jax.device_get(train_state.step))
-    name = f"checkpoint_{step}" + (f"_{tag}" if tag else "")
+def _finalize_checkpoint(root: Path, name: str, step: int, tag: str,
+                         keep_last: int, config_json: Optional[str]):
+    """config.json + rotation-index update for a written checkpoint dir.
+    Runs wherever the write ran (caller thread or async worker) so index
+    order matches write-completion order."""
     ckpt_dir = root / name
-    save_state_tree(ckpt_dir, train_state, {"step": step, "tag": tag})
-    if model is not None:
-        (ckpt_dir / "config.json").write_text(model.config.to_json())
-    # rotation index
+    if config_json is not None:
+        (ckpt_dir / "config.json").write_text(config_json)
     idx_path = root / _INDEX
     index = json.loads(idx_path.read_text()) if idx_path.exists() else {"checkpoints": []}
     index["checkpoints"].append({"name": name, "step": step, "tag": tag, "time": time.time()})
@@ -157,6 +167,84 @@ def save_checkpoint(directory: str | Path, train_state, *, model=None,
         index["checkpoints"] = index["checkpoints"][-keep_last:]
     idx_path.write_text(json.dumps(index, indent=2))
     return str(ckpt_dir)
+
+
+def save_checkpoint(directory: str | Path, train_state, *, model=None,
+                    tag: str = "", keep_last: int = 0):
+    """Full training checkpoint: state + model config + rotation index
+    (↔ CheckpointListener.keepLast + checkpoint.json)."""
+    root = Path(directory)
+    root.mkdir(parents=True, exist_ok=True)
+    step = int(jax.device_get(train_state.step))
+    name = f"checkpoint_{step}" + (f"_{tag}" if tag else "")
+    save_state_tree(root / name, train_state, {"step": step, "tag": tag})
+    return _finalize_checkpoint(
+        root, name, step, tag, keep_last,
+        model.config.to_json() if model is not None else None)
+
+
+class AsyncCheckpointer:
+    """Orbax-style asynchronous checkpointing (SURVEY §5.4's stated TPU
+    equivalent: "orbax-style sharded async checkpoint").
+
+    The device→host snapshot runs synchronously on the caller's thread —
+    it must, because the trainer donates state buffers and step N's state
+    is gone by step N+1 — but serialization, file IO, and rotation run on
+    a single background worker, so a multi-GB checkpoint costs the train
+    loop a D2H copy instead of a disk write. Semantics follow orbax's
+    AsyncCheckpointer: one save in flight at a time (a new ``save`` first
+    waits out the previous one), ``wait_until_finished`` joins, and a
+    failed write re-raises on the next ``save``/``wait_until_finished``
+    rather than being dropped silently.
+
+    Usable directly or through ``CheckpointListener(async_save=True)``.
+    """
+
+    def __init__(self):
+        import concurrent.futures
+
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer")
+        self._inflight = None
+
+    def save(self, directory: str | Path, train_state, *, model=None,
+             tag: str = "", keep_last: int = 0) -> str:
+        """Snapshot now, write in the background; returns the checkpoint
+        path that WILL exist once the write completes."""
+        self.wait_until_finished()
+        root = Path(directory)
+        root.mkdir(parents=True, exist_ok=True)
+        step = int(jax.device_get(train_state.step))
+        name = f"checkpoint_{step}" + (f"_{tag}" if tag else "")
+        snapshot = _snapshot_tree(train_state)
+        config_json = model.config.to_json() if model is not None else None
+
+        def _write():
+            _write_snapshot(root / name, *snapshot,
+                            extra_meta={"step": step, "tag": tag})
+            _finalize_checkpoint(root, name, step, tag, keep_last,
+                                 config_json)
+
+        self._inflight = self._pool.submit(_write)
+        return str(root / name)
+
+    def wait_until_finished(self):
+        """Join the in-flight write, re-raising any worker exception."""
+        if self._inflight is not None:
+            fut, self._inflight = self._inflight, None
+            fut.result()
+
+    def close(self):
+        try:
+            self.wait_until_finished()
+        finally:
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def latest_checkpoint(directory: str | Path) -> Optional[str]:

@@ -163,14 +163,30 @@ class CheckpointListener(TrainingListener):
 
     def __init__(self, directory: str, *, every_epochs: Optional[int] = 1,
                  every_iters: Optional[int] = None, keep_last: int = 3,
-                 model=None):
+                 model=None, async_save: bool = False):
         self.directory = directory
         self.every_epochs = every_epochs
         self.every_iters = every_iters
         self.keep_last = keep_last
         self.model = model
+        # async_save: snapshot-to-host synchronously, write on a background
+        # worker (serde.checkpoint.AsyncCheckpointer) so the fit loop pays
+        # D2H, not disk latency. The worker is created lazily per fit and
+        # shut down at on_fit_end (no thread outlives the fit it served).
+        self._async_save = async_save
+        self._async = None
 
     def _save(self, ts, tag: str):
+        if self._async_save:
+            if self._async is None:
+                from deeplearning4j_tpu.serde.checkpoint import (
+                    AsyncCheckpointer,
+                )
+
+                self._async = AsyncCheckpointer()
+            self._async.save(self.directory, ts, model=self.model, tag=tag,
+                             keep_last=self.keep_last)
+            return
         from deeplearning4j_tpu.serde.checkpoint import save_checkpoint
 
         save_checkpoint(self.directory, ts, model=self.model, tag=tag,
@@ -185,6 +201,11 @@ class CheckpointListener(TrainingListener):
         if self.every_epochs and (epoch + 1) % self.every_epochs == 0:
             self._save(ts, f"epoch{epoch}")
         return False
+
+    def on_fit_end(self, trainer, ts):
+        if self._async is not None:
+            ck, self._async = self._async, None
+            ck.close()
 
 
 class TimeIterationListener(TrainingListener):

@@ -482,41 +482,46 @@ class Trainer:
         # One host sync up front; after that the step counter is tracked
         # host-side so the dispatch pipeline never blocks on the device.
         host_step = int(jax.device_get(ts.step))
-        for epoch in range(epochs):
-            for lst in listeners:
-                lst.on_epoch_start(epoch)
-            it = iter(data)
-            n = 0
-            for batch in it:
-                batch = _as_batch_dict(batch)
-                if self._batch_sharding is not None:
-                    batch = jax.device_put(batch, self._batch_sharding)
-                if getattr(self.net, "backprop_type", "standard") == "tbptt":
-                    # ↔ TruncatedBPTT: every window is an iteration (the
-                    # reference fires iterationDone once per window).
-                    ts, wmetrics = self._fit_tbptt_batch(ts, batch)
-                else:
-                    ts, metrics = self.train_step(ts, batch)
-                    wmetrics = [metrics]
-                n += 1
-                for wm in wmetrics:
-                    host_step += 1
-                    for lst in listeners:
-                        if lst.on_iteration(epoch, host_step, ts, wm):
-                            stop = True
-                if steps_per_epoch is not None and n >= steps_per_epoch:
-                    break
+        # on_fit_end must run even when a step raises (non-finite loss,
+        # OOM, interrupt): listeners hold resources whose teardown
+        # re-raises swallowed failures (async checkpoint writers).
+        try:
+            for epoch in range(epochs):
+                for lst in listeners:
+                    lst.on_epoch_start(epoch)
+                it = iter(data)
+                n = 0
+                for batch in it:
+                    batch = _as_batch_dict(batch)
+                    if self._batch_sharding is not None:
+                        batch = jax.device_put(batch, self._batch_sharding)
+                    if getattr(self.net, "backprop_type", "standard") == "tbptt":
+                        # ↔ TruncatedBPTT: every window is an iteration (the
+                        # reference fires iterationDone once per window).
+                        ts, wmetrics = self._fit_tbptt_batch(ts, batch)
+                    else:
+                        ts, metrics = self.train_step(ts, batch)
+                        wmetrics = [metrics]
+                    n += 1
+                    for wm in wmetrics:
+                        host_step += 1
+                        for lst in listeners:
+                            if lst.on_iteration(epoch, host_step, ts, wm):
+                                stop = True
+                    if steps_per_epoch is not None and n >= steps_per_epoch:
+                        break
+                    if stop:
+                        break
+                for lst in listeners:
+                    if lst.on_epoch_end(epoch, ts):
+                        stop = True
+                if hasattr(data, "reset"):
+                    data.reset()
                 if stop:
                     break
+        finally:
             for lst in listeners:
-                if lst.on_epoch_end(epoch, ts):
-                    stop = True
-            if hasattr(data, "reset"):
-                data.reset()
-            if stop:
-                break
-        for lst in listeners:
-            lst.on_fit_end(self, ts)
+                lst.on_fit_end(self, ts)
         return ts
 
 
