@@ -158,3 +158,54 @@ def compare_traces(log_dir_a: str, log_dir_b: str, top: int = 15) -> List[Dict]:
                      "delta_us": round(tb - ta, 1)})
     rows.sort(key=lambda r: -abs(r["delta_us"]))
     return rows[:top]
+
+
+def op_costs(fn, *example_args, top: int = 0, **jit_kwargs) -> Dict[str, float]:
+    """Static whole-program cost analysis of a jitted function (↔ the
+    OpProfiler's FLOP/bandwidth estimates, recast for XLA).
+
+    The reference's OpProfiler accumulated per-op-class counters at each
+    JNI dispatch; under jit there are no per-op dispatches, but the
+    compiled executable carries the compiler's own cost model. This
+    returns XLA's ``cost_analysis()`` for the whole program — keys such as
+    ``flops``, ``bytes accessed``, ``transcendentals``, plus per-memory-
+    space traffic — so callers can compute analytic MFU / arithmetic
+    intensity without running anything on a device.
+
+    ``op_costs(step_fn, state, batch)`` → {"flops": ..., "bytes accessed":
+    ..., ...}. Works on CPU and TPU backends alike (compilation only, no
+    execution). With ``top > 0``, also returns the dominant HLO ops by
+    estimated FLOPs under key ``"_top_flops_ops"`` when the backend's cost
+    analysis exposes per-op detail (TPU PJRT returns program totals only;
+    the key is then absent).
+    """
+    import jax
+
+    compiled = jax.jit(fn, **jit_kwargs).lower(*example_args).compile()
+    ca = compiled.cost_analysis()
+    # jax returns a dict, a 1-element list of dicts (version-dependent), or
+    # None when the backend implements no cost analysis
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if ca is None:
+        return {}
+    out: Dict[str, float] = {k: float(v) for k, v in dict(ca).items()
+                             if isinstance(v, (int, float))}
+    if top > 0:
+        per_op = [(k[len("flops:"):], v) for k, v in out.items()
+                  if k.startswith("flops:")]
+        if per_op:
+            per_op.sort(key=lambda kv: -kv[1])
+            out["_top_flops_ops"] = dict(per_op[:top])  # type: ignore
+    return out
+
+
+def arithmetic_intensity(costs: Dict[str, float]) -> Optional[float]:
+    """FLOPs per HBM byte from an ``op_costs`` result — the roofline
+    abscissa. None when the backend reports no byte traffic (some PJRT
+    plugins omit it)."""
+    flops = costs.get("flops")
+    byts = costs.get("bytes accessed")
+    if not flops or not byts:
+        return None
+    return flops / byts

@@ -317,3 +317,42 @@ class TestModelStatsListener:
                   if k.startswith("update_ratio/")]
         assert ratios, "no reports emitted at all under tbptt"
         assert all(v > 0 for v in ratios), "zero-update report leaked"
+
+
+class TestOpCosts:
+    """Static HLO cost analysis (↔ OpProfiler counters; profiling.op_costs)."""
+
+    def test_matmul_flops_and_intensity(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.train.profiling import (
+            arithmetic_intensity,
+            op_costs,
+        )
+
+        def f(a, b):
+            return jnp.tanh(a @ b).sum()
+
+        c = op_costs(f, jnp.ones((64, 64), jnp.float32),
+                     jnp.ones((64, 64), jnp.float32))
+        # dominated by the 2*64^3 matmul; cost model may add elementwise
+        assert c["flops"] >= 2 * 64**3
+        ai = arithmetic_intensity(c)
+        if ai is not None:  # CPU backend reports byte traffic
+            assert 0 < ai < 1000
+
+    def test_train_step_costs(self):
+        from deeplearning4j_tpu.models.lenet import lenet
+        from deeplearning4j_tpu.train.profiling import op_costs
+        from deeplearning4j_tpu.train.trainer import Trainer
+
+        model = lenet()
+        tr = Trainer(model)
+        ts = tr.init_state()
+        import numpy as np
+
+        batch = {"features": np.zeros((8, 28, 28, 1), np.float32),
+                 "labels": np.zeros((8, 10), np.float32)}
+        c = op_costs(tr.train_step, ts, batch)
+        # fwd+bwd+Adam of LeNet at b8 is far beyond 1 MFLOP
+        assert c["flops"] > 1e6
