@@ -99,7 +99,9 @@ _FORCE_HOST_WINDOW = False
 # the jump to these numbers is a measurement correction documented in
 # BASELINE.md, not a hardware speedup.
 BASELINES = {
-    "bert": 107962.4,    # tokens/sec/chip, b32 x s128, bf16 mixed (mfu .366)
+    # r4b config: gathered MLM head (P=20) + rbg dropout, mfu .475
+    # (BASELINE.md r4b row; a 2026-07-31 full re-run read 168,610 = 0.985x)
+    "bert": 171181.3,    # tokens/sec/chip, b32 x s128, bf16 mixed
     "resnet50": 1684.0,  # samples/sec/chip, b32 224x224, bf16 mixed (mfu .21)
     "lstm": 2724053.1,   # tokens/sec/chip, b32 x s256, GravesLSTM pallas
     "lenet": 263659.4,   # samples/sec/chip, b256 28x28
@@ -329,10 +331,17 @@ def _timed_train(trainer, ts, batch, *, warmup: int, iters: int,
         # requested, wraps ONLY the last window — the one least likely to
         # carry relay pollution — so the top-op attribution describes model
         # ops, not relay artifacts.
+        # Cheap windows buy noise immunity: configs whose whole window is
+        # sub-second (lenet/lstm) get 6 windows instead of 3 — observed
+        # 2026-07-31, chip-side throughput varies run-to-run well beyond
+        # the ±5% the min-of-3 absorbs on the shortest windows.
         dts, host_losses = [], None
-        for w in range(3):
+        n_windows = 3
+        w = 0
+        while w < n_windows:
             prof = (jax.profiler.trace(_PROFILE_DIR)
-                    if _PROFILE_DIR and w == 2 else contextlib.nullcontext())
+                    if _PROFILE_DIR and w == n_windows - 1
+                    else contextlib.nullcontext())
             with prof:
                 t0 = time.perf_counter()
                 ts, losses = chained(ts, batch)
@@ -345,6 +354,9 @@ def _timed_train(trainer, ts, batch, *, warmup: int, iters: int,
                     f"non-finite loss in timed window: {got[:8]}")
             if host_losses is None:
                 host_losses = list(got)
+                if dts[0] < 1.0:
+                    n_windows = 6
+            w += 1
         dt = min(dts)
         info["window_ms_all"] = [round(d / iters * 1000, 3) for d in dts]
         info["window"] = "on-device-chained"

@@ -25,8 +25,28 @@ def _sync_scalar(x):
     return float(jax.device_get(x.ravel()[0] if x.ndim else x))
 
 
+def _one_window(fn, args, iters):
+    """One honestly-synced timing window: async dispatch, one in-window
+    materialization that is data-dependent on every call."""
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(iters):
+        o = fn(*args)
+        outs.append(o if not isinstance(o, tuple) else o[0])
+    # One scalar per call: every dispatch must have completed.
+    s = sum(o.ravel()[0] for o in outs)
+    _sync_scalar(s)
+    return (time.perf_counter() - t0) / iters * 1000  # ms
+
+
+def _warm(fn, args, n=2):
+    for _ in range(n):
+        out = fn(*args)
+        _sync_scalar(out if not isinstance(out, tuple) else out[0])
+
+
 def _time_fn(fn, args, iters=30):
-    """Async dispatch, one sync in-window; best of 3 windows.
+    """Best of 3 honestly-synced windows (single-sided).
 
     The axon relay pollutes a program's EARLY re-executions with deferred
     server-side work (measured 2026-07-30: ResNet chained step 353-535 ms
@@ -38,24 +58,35 @@ def _time_fn(fn, args, iters=30):
     pollution reliably); the two warmup calls just keep window 1 from
     paying first-touch costs.
     """
-    import jax
-
-    for _ in range(2):
-        out = fn(*args)
-        _sync_scalar(out if not isinstance(out, tuple) else out[0])
+    _warm(fn, args)
     best = None
     for _ in range(3):
-        t0 = time.perf_counter()
-        outs = []
-        for _ in range(iters):
-            o = fn(*args)
-            outs.append(o if not isinstance(o, tuple) else o[0])
-        # One scalar per call: every dispatch must have completed.
-        s = sum(o.ravel()[0] for o in outs)
-        _sync_scalar(s)
-        dt = (time.perf_counter() - t0) / iters * 1000  # ms
+        dt = _one_window(fn, args, iters)
         best = dt if best is None else min(best, dt)
     return best
+
+
+def _time_pair(fn_a, fn_b, args, iters=30, rounds=3):
+    """Time two implementations of the same computation INTERLEAVED:
+    A,B,A,B,... window by window, min per side.
+
+    Sequential per-side timing (all A windows, then all B windows) lets
+    slow relay drift — server-side load that varies over seconds — land
+    entirely on one side and flip a speedup ratio (observed 2026-07-31: an
+    A/B run concurrent with a CPU-saturating test suite read the LSTM fwd
+    at 0.74x where quiet runs read ~1.1x). Alternating windows gives both
+    sides the same exposure to drift; min-of-rounds still rejects the
+    early-execution pollution.
+    """
+    _warm(fn_a, args)
+    _warm(fn_b, args)
+    best_a = best_b = None
+    for _ in range(rounds):
+        da = _one_window(fn_a, args, iters)
+        db = _one_window(fn_b, args, iters)
+        best_a = da if best_a is None else min(best_a, da)
+        best_b = db if best_b is None else min(best_b, db)
+    return best_a, best_b
 
 
 def _max_rel_err(a, b):
@@ -114,10 +145,11 @@ def _flash_ab(iters=30, B=8, H=12, T=512, D=64, causal=False):
     gf, gr = gflash(q, k, v), gref(q, k, v)
     out["bwd_max_rel_err"] = max(_max_rel_err(a, b) for a, b in zip(gf, gr))
 
-    out["fwd_ms"] = {"pallas": _time_fn(flash_f, (q, k, v), iters),
-                     "xla": _time_fn(ref_f, (q, k, v), iters)}
-    out["bwd_ms"] = {"pallas": _time_fn(lambda *a: gflash(*a)[0], (q, k, v), iters),
-                     "xla": _time_fn(lambda *a: gref(*a)[0], (q, k, v), iters)}
+    fp, fx = _time_pair(flash_f, ref_f, (q, k, v), iters)
+    out["fwd_ms"] = {"pallas": fp, "xla": fx}
+    bp, bx = _time_pair(lambda *a: gflash(*a)[0], lambda *a: gref(*a)[0],
+                        (q, k, v), iters)
+    out["bwd_ms"] = {"pallas": bp, "xla": bx}
     out["fwd_speedup"] = round(out["fwd_ms"]["xla"] / out["fwd_ms"]["pallas"], 3)
     out["bwd_speedup"] = round(out["bwd_ms"]["xla"] / out["bwd_ms"]["pallas"], 3)
     out["parity"] = bool(out["fwd_max_rel_err"] < 2e-2
@@ -156,10 +188,10 @@ def _lstm_ab(iters=30):
     gp, gx = gpallas(x), gxla(x)
     out["bwd_max_rel_err"] = _max_rel_err(gp, gx)
 
-    out["fwd_ms"] = {"pallas": _time_fn(pallas_f, (x,), iters),
-                     "xla": _time_fn(xla_f, (x,), iters)}
-    out["bwd_ms"] = {"pallas": _time_fn(gpallas, (x,), iters),
-                     "xla": _time_fn(gxla, (x,), iters)}
+    fp, fx = _time_pair(pallas_f, xla_f, (x,), iters)
+    out["fwd_ms"] = {"pallas": fp, "xla": fx}
+    bp, bx = _time_pair(gpallas, gxla, (x,), iters)
+    out["bwd_ms"] = {"pallas": bp, "xla": bx}
     out["fwd_speedup"] = round(out["fwd_ms"]["xla"] / out["fwd_ms"]["pallas"], 3)
     out["bwd_speedup"] = round(out["bwd_ms"]["xla"] / out["bwd_ms"]["pallas"], 3)
     out["parity"] = bool(out["fwd_max_rel_err"] < 2e-2
@@ -194,10 +226,10 @@ def _gru_ab(iters=30):
     gp, gx = gpallas(x), gxla(x)
     out["bwd_max_rel_err"] = _max_rel_err(gp, gx)
 
-    out["fwd_ms"] = {"pallas": _time_fn(pallas_f, (x,), iters),
-                     "xla": _time_fn(xla_f, (x,), iters)}
-    out["bwd_ms"] = {"pallas": _time_fn(gpallas, (x,), iters),
-                     "xla": _time_fn(gxla, (x,), iters)}
+    fp, fx = _time_pair(pallas_f, xla_f, (x,), iters)
+    out["fwd_ms"] = {"pallas": fp, "xla": fx}
+    bp, bx = _time_pair(gpallas, gxla, (x,), iters)
+    out["bwd_ms"] = {"pallas": bp, "xla": bx}
     out["fwd_speedup"] = round(out["fwd_ms"]["xla"] / out["fwd_ms"]["pallas"], 3)
     out["bwd_speedup"] = round(out["bwd_ms"]["xla"] / out["bwd_ms"]["pallas"], 3)
     out["parity"] = bool(out["fwd_max_rel_err"] < 2e-2
