@@ -49,6 +49,29 @@ def _layer_name(i: int, cfg: LayerConfig) -> str:
     return f"{i}_{base}"
 
 
+def _validate_registry_names(named_layers):
+    """Fail fast on typo'd activation/loss names (↔ the reference's
+    config-time builder validation): resolve registry names at model build
+    instead of deep inside the first traced apply, and prefix the layer
+    name so the offender is findable in a long stack."""
+    from deeplearning4j_tpu.nn.activations import get_activation
+    from deeplearning4j_tpu.ops.loss import get_loss
+
+    for name, l in named_layers:
+        act = getattr(l, "activation", None)
+        if isinstance(act, str):
+            try:
+                get_activation(act)
+            except ValueError as e:
+                raise ValueError(f"layer '{name}': {e}") from None
+        loss = getattr(l, "loss", None)
+        if isinstance(loss, str):
+            try:
+                get_loss(loss)
+            except ValueError as e:
+                raise ValueError(f"layer '{name}': {e}") from None
+
+
 def _with_net_weight_init(layer: LayerConfig, net: NeuralNetConfiguration):
     """Net-level weight_init is the default for layers that don't set their
     own (↔ NeuralNetConfiguration.Builder.weightInit cascading to layers)."""
@@ -73,6 +96,7 @@ class SequentialModel:
         self.shapes = [tuple(config.input_shape)]
         for l in self.layers:
             self.shapes.append(tuple(l.output_shape(self.shapes[-1])))
+        _validate_registry_names(self.named_layers())
 
     # -- construction ------------------------------------------------------
 
@@ -434,6 +458,7 @@ class GraphModel:
             v = config.vertices[name]
             in_shapes = [self.shapes[i] for i in v.inputs]
             self.shapes[name] = self._vertex_out_shape(v, in_shapes)
+        _validate_registry_names(self.named_layers())
 
     @staticmethod
     def _is_multi(v: GraphVertex) -> bool:

@@ -202,3 +202,26 @@ def test_graves_bidirectional_lstm_helper():
     from deeplearning4j_tpu.nn.config import config_from_json
     js = layer.to_json()
     assert config_from_json(js).to_json() == js
+
+
+def test_typod_registry_names_fail_at_build():
+    """Typo'd activation/loss names raise at MODEL BUILD with the layer
+    name prefixed (↔ reference builder validation), not deep inside the
+    first traced apply."""
+    import pytest
+
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+
+    with pytest.raises(ValueError, match=r"0_dense.*unknown activation"):
+        SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(), input_shape=(4,),
+            layers=[Dense(units=4, activation="relUU")]))
+    with pytest.raises(ValueError, match=r"unknown loss 'msee'"):
+        SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(), input_shape=(4,),
+            layers=[OutputLayer(units=2, loss="msee")]))
