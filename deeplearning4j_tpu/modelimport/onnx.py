@@ -1325,6 +1325,101 @@ def _identity(imp, node):
     return v
 
 
+# --- host constant folding --------------------------------------------------
+# Real exporters (torch.onnx above all) compute shape arguments with small
+# on-graph arithmetic chains: Shape → Gather → Unsqueeze → Concat/Mul feeds
+# a Reshape or an LSTM initial-state ConstantOfShape. Shape/Constant already
+# land in imp.consts; these folders propagate host values through the
+# arithmetic so downstream const_value() lookups succeed. Folding is
+# best-effort and does not replace the emitted graph ops — it only records
+# the host value alongside.
+
+
+def _fold_axes(node, arrs, key="axes"):
+    """axes from attr (opset<13) or trailing const input (opset>=13)."""
+    a = node.attrs()
+    if key in a:
+        ax = a[key]
+        return [int(v) for v in (ax if isinstance(ax, (list, tuple)) else [ax])]
+    if len(arrs) > 1:
+        return [int(v) for v in np.asarray(arrs[1]).reshape(-1)]
+    return None
+
+
+def _fold_cast(node, arrs):
+    to = TENSOR_DTYPES.get(int(node.attrs().get("to", 1)))
+    return arrs[0].astype(np.dtype(to))
+
+
+def _fold_div(node, arrs):
+    a, b = arrs[0], arrs[1]
+    if np.issubdtype(np.asarray(a).dtype, np.integer):
+        # ONNX integer Div truncates toward zero (shape math is positive,
+        # where trunc == floor)
+        return (np.sign(a) * np.sign(b) * (np.abs(a) // np.abs(b))).astype(
+            np.asarray(a).dtype)
+    return a / b
+
+
+def _fold_slice(node, arrs):
+    x = arrs[0]
+    starts = np.asarray(arrs[1]).reshape(-1)
+    ends = np.asarray(arrs[2]).reshape(-1)
+    axes = (np.asarray(arrs[3]).reshape(-1) if len(arrs) > 3
+            else np.arange(len(starts)))
+    steps = (np.asarray(arrs[4]).reshape(-1) if len(arrs) > 4
+             else np.ones(len(starts), np.int64))
+    sl = [slice(None)] * x.ndim
+    for s, e, ax, st in zip(starts, ends, axes, steps):
+        sl[int(ax)] = slice(int(s), int(e), int(st))
+    return x[tuple(sl)]
+
+
+def _fold_unsqueeze(node, arrs):
+    out = arrs[0]
+    axes = _fold_axes(node, arrs) or []
+    # ONNX negative axes are relative to the OUTPUT rank (input rank +
+    # len(axes));
+    # normalize before sorting or multiple negative axes land wrong
+    out_rank = out.ndim + len(axes)
+    for ax in sorted(a + out_rank if a < 0 else a for a in axes):
+        out = np.expand_dims(out, int(ax))
+    return out
+
+
+def _fold_squeeze(node, arrs):
+    axes = _fold_axes(node, arrs)
+    if axes is None:
+        return np.squeeze(arrs[0])
+    return np.squeeze(arrs[0], axis=tuple(int(a) for a in axes))
+
+
+_HOST_FOLDABLE = {
+    "Gather": lambda n, a: np.take(a[0], a[1].astype(np.int64),
+                                   axis=int(n.attrs().get("axis", 0))),
+    "Concat": lambda n, a: np.concatenate(
+        [np.atleast_1d(x) for x in a], axis=int(n.attrs().get("axis", 0))),
+    "Unsqueeze": _fold_unsqueeze,
+    "Squeeze": _fold_squeeze,
+    "Add": lambda n, a: a[0] + a[1],
+    "Sub": lambda n, a: a[0] - a[1],
+    "Mul": lambda n, a: a[0] * a[1],
+    "Div": _fold_div,
+    "Neg": lambda n, a: -a[0],
+    "Cast": _fold_cast,
+    "Slice": _fold_slice,
+    "ReduceProd": lambda n, a: (
+        a[0] if (_fold_axes(n, a) is None
+                 and n.attrs().get("noop_with_empty_axes", 0))
+        else np.prod(
+            a[0],
+            axis=(tuple(_fold_axes(n, a)) if _fold_axes(n, a) else None),
+            keepdims=bool(n.attrs().get("keepdims", 1)))),
+    "Reshape": lambda n, a: a[0].reshape(
+        [int(v) for v in np.asarray(a[1]).reshape(-1)]),
+}
+
+
 # --- the importer ----------------------------------------------------------
 
 
@@ -1352,6 +1447,25 @@ class _GraphImporter:
                 f"op needs host-known constant for {ref!r} (shapes/axes/pads "
                 "must be initializers or Constant nodes)")
         return self.consts[ref]
+
+    def _try_fold(self, node) -> None:
+        """Best-effort host evaluation when every input is host-known (see
+        _HOST_FOLDABLE above); failures leave the graph untouched."""
+        fold = _HOST_FOLDABLE.get(node.op_type)
+        if fold is None or node.output[0] in self.consts:
+            return
+        if not all(r in self.consts for r in node.input if r):
+            return
+        # Shape-math tensors are tiny; a cap keeps weight-sized initializer
+        # chains (Cast/Mul over multi-MB arrays) from being host-evaluated
+        # and duplicated into self.consts for no consumer.
+        if any(self.consts[r].size > 4096 for r in node.input if r):
+            return
+        try:
+            self.consts[node.output[0]] = np.asarray(
+                fold(node, [self.consts[r] for r in node.input if r]))
+        except Exception:  # noqa: BLE001 - folding is advisory only
+            pass
 
     def fresh_const_name(self, base: str) -> str:
         name = base or "const"
@@ -1398,6 +1512,7 @@ class _GraphImporter:
             for ref, var in zip(node.output, outs):
                 if ref:
                     self.vars[ref] = var
+            self._try_fold(node)
 
         return {out: self.tensor(out).name for out in outputs}
 

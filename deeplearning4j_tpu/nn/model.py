@@ -54,22 +54,34 @@ def _validate_registry_names(named_layers):
     config-time builder validation): resolve registry names at model build
     instead of deep inside the first traced apply, and prefix the layer
     name so the offender is findable in a long stack."""
+    import dataclasses
+
     from deeplearning4j_tpu.nn.activations import get_activation
     from deeplearning4j_tpu.ops.loss import get_loss
 
+    def check(name, l):
+        fields = ([f.name for f in dataclasses.fields(l)]
+                  if dataclasses.is_dataclass(l) else
+                  ["activation", "loss"])
+        for fname in fields:
+            val = getattr(l, fname, None)
+            if fname.endswith("activation") and isinstance(val, str):
+                try:
+                    get_activation(val)
+                except ValueError as e:
+                    raise ValueError(f"layer '{name}': {e}") from None
+            elif fname == "loss" and isinstance(val, str):
+                try:
+                    get_loss(val)
+                except ValueError as e:
+                    raise ValueError(f"layer '{name}': {e}") from None
+            elif fname == "layer" and dataclasses.is_dataclass(val):
+                # wrappers (Bidirectional, TimeDistributed) hold the real
+                # layer one level down
+                check(f"{name}.{type(val).__name__.lower()}", val)
+
     for name, l in named_layers:
-        act = getattr(l, "activation", None)
-        if isinstance(act, str):
-            try:
-                get_activation(act)
-            except ValueError as e:
-                raise ValueError(f"layer '{name}': {e}") from None
-        loss = getattr(l, "loss", None)
-        if isinstance(loss, str):
-            try:
-                get_loss(loss)
-            except ValueError as e:
-                raise ValueError(f"layer '{name}': {e}") from None
+        check(name, l)
 
 
 def _with_net_weight_init(layer: LayerConfig, net: NeuralNetConfiguration):

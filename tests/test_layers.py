@@ -225,3 +225,26 @@ def test_typod_registry_names_fail_at_build():
         SequentialModel(SequentialConfig(
             net=NeuralNetConfiguration(), input_shape=(4,),
             layers=[OutputLayer(units=2, loss="msee")]))
+
+
+def test_typod_names_fail_at_build_nested_and_recurrent():
+    """Validation reaches recurrent_activation fields and layers wrapped in
+    Bidirectional (review finding: top-level-only checks miss both)."""
+    import pytest
+
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import LSTM, Bidirectional, ConvLSTM2D
+    from deeplearning4j_tpu.nn.model import SequentialModel
+
+    with pytest.raises(ValueError, match=r"unknown activation 'relUU'"):
+        SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(), input_shape=(5, 4),
+            layers=[Bidirectional(layer=LSTM(units=4, activation="relUU"))]))
+    with pytest.raises(ValueError, match=r"unknown activation 'sigmoidd'"):
+        SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(), input_shape=(4, 6, 6, 2),
+            layers=[ConvLSTM2D(filters=3, kernel=(3, 3),
+                               recurrent_activation="sigmoidd")]))
