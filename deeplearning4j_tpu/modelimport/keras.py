@@ -166,11 +166,8 @@ def _pool(kind):
 
 def _global_pool(kind):
     def mapper(cfg):
-        if cfg.get("keepdims"):
-            raise KerasImportError(
-                "global pooling with keepdims=True not supported (keras "
-                "keeps the pooled axes; our GlobalPooling drops them)")
-        return GlobalPooling(pool_type=kind), {}
+        return GlobalPooling(pool_type=kind,
+                             keepdims=bool(cfg.get("keepdims"))), {}
 
     return mapper
 
@@ -206,14 +203,55 @@ def _check_bn_axis(layer, shape_nobatch, where: str) -> None:
     last = len(shape_nobatch)
     if axis != last:
         raise KerasImportError(
-            f"BatchNormalization {where!r}: axis {axis} on rank-{last + 1} "
-            f"input is channels-first; only channels-last (axis=-1 or "
-            f"{last}) imports are supported")
+            f"{type(layer).__name__} {where!r}: axis {axis} on "
+            f"rank-{last + 1} input is channels-first; only channels-last "
+            f"(axis=-1 or {last}) imports are supported")
 
 
 def _layernorm(cfg):
     return LayerNorm(eps=cfg.get("epsilon", 1e-3)), {
         "gamma": ("gamma", None), "beta": ("beta", None)}
+
+
+def _rescaling(cfg):
+    from deeplearning4j_tpu.nn.layers import Rescaling
+
+    scale = cfg.get("scale", 1.0)
+    offset = cfg.get("offset", 0.0)
+    if isinstance(scale, (list, tuple)) or isinstance(offset, (list, tuple)):
+        raise KerasImportError(
+            "Rescaling with per-channel scale/offset lists not supported")
+    return Rescaling(scale=float(scale), offset=float(offset)), {}
+
+
+def _normalization(cfg):
+    # Adapted stats live as h5 weights (mean/variance/count); keras
+    # epsilon 1e-7 matches Normalization.call's max(sqrt(var), eps).
+    from deeplearning4j_tpu.nn.layers import Rescaling
+
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        if len(axis) != 1:
+            raise KerasImportError(
+                f"Normalization over multiple axes {axis} not supported")
+        axis = axis[0]
+    if cfg.get("mean") is not None:
+        # explicit-stats construction: keras stores mean/variance in the
+        # CONFIG and creates no h5 weights
+        mean = np.asarray(cfg["mean"], np.float32).reshape(-1)
+        var = np.asarray(cfg["variance"], np.float32).reshape(-1)
+        layer = Rescaling(invert=bool(cfg.get("invert", False)), eps=1e-7,
+                          mean=[float(v) for v in mean],
+                          var=[float(v) for v in var])
+        layer._keras_axis = axis
+        return layer, {}
+    layer = Rescaling(invert=bool(cfg.get("invert", False)), eps=1e-7,
+                      stats=True)
+    # channels-last post-build check shared with BatchNorm (broadcast is
+    # against the LAST axis here too)
+    layer._keras_axis = axis
+    return layer, {"state:mean": ("mean", None),
+                   "state:var": ("variance", None)}
 
 
 def _lstm(cfg):
@@ -632,15 +670,6 @@ def _pool3d(kind):
     return mapper
 
 
-def _global_pool3d(kind):
-    def mapper(cfg):
-        if cfg.get("keepdims"):
-            raise KerasImportError("Global 3D pooling keepdims not supported")
-        from deeplearning4j_tpu.nn.layers.conv import GlobalPooling
-
-        return GlobalPooling(pool_type=kind), {}
-
-    return mapper
 
 
 def _upsampling3d(cfg):
@@ -691,6 +720,8 @@ LAYER_MAPPERS: Dict[str, Callable] = {
     "GlobalAveragePooling1D": _global_pool("avg"),
     "BatchNormalization": _batchnorm,
     "LayerNormalization": _layernorm,
+    "Rescaling": _rescaling,
+    "Normalization": _normalization,
     "LSTM": _lstm,
     "GRU": _gru,
     "SimpleRNN": _simple_rnn,
@@ -737,8 +768,8 @@ LAYER_MAPPERS: Dict[str, Callable] = {
     "Masking": _masking,
     "MaxPooling3D": _pool3d("max"),
     "AveragePooling3D": _pool3d("avg"),
-    "GlobalAveragePooling3D": _global_pool3d("avg"),
-    "GlobalMaxPooling3D": _global_pool3d("max"),
+    "GlobalAveragePooling3D": _global_pool("avg"),
+    "GlobalMaxPooling3D": _global_pool("max"),
     "UpSampling3D": _upsampling3d,
     "ZeroPadding3D": _zeropad3d,
     "Cropping3D": _cropping3d,

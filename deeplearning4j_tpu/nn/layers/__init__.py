@@ -53,6 +53,7 @@ from deeplearning4j_tpu.nn.layers.core import (
     Permute,
     PReLU,
     RepeatVector,
+    Rescaling,
     Reshape,
 )
 from deeplearning4j_tpu.nn.layers.moe import MoEBlock, load_balance_loss
@@ -86,7 +87,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 
 __all__ = [
     "ActivationLayer", "Dense", "Dropout", "ElementWiseMultiplication",
-    "Embedding", "Flatten", "MaskZeroLayer", "Permute", "PReLU",
+    "Embedding", "Flatten", "MaskZeroLayer", "Permute", "PReLU", "Rescaling",
     "RepeatVector", "Reshape",
     "SameDiffLayer", "SameDiffLambdaLayer",
     "MoEBlock", "load_balance_loss",

@@ -322,25 +322,32 @@ class Pooling2D(LayerConfig):
 @register_config
 @dataclass
 class GlobalPooling(LayerConfig):
-    """↔ GlobalPoolingLayer (avg/max over spatial or time dims)."""
+    """↔ GlobalPoolingLayer (avg/max over spatial or time dims).
+
+    keepdims keeps the pooled axes as size-1 dims (Keras
+    GlobalAveragePooling2D(keepdims=True) — MobileNet's head uses it so
+    downstream Conv2D/Reshape layers still see a 4-D tensor)."""
 
     pool_type: str = "avg"
+    keepdims: bool = False
 
     @property
     def has_params(self):
         return False
 
     def output_shape(self, input_shape):
+        if self.keepdims:
+            return (*(1,) * (len(input_shape) - 1), input_shape[-1])
         return (input_shape[-1],)
 
     def apply(self, params, state, x, *, train=False, rng=None):
         axes = tuple(range(1, x.ndim - 1))
         if self.pool_type == "avg":
-            return jnp.mean(x, axis=axes), state
+            return jnp.mean(x, axis=axes, keepdims=self.keepdims), state
         if self.pool_type == "max":
-            return jnp.max(x, axis=axes), state
+            return jnp.max(x, axis=axes, keepdims=self.keepdims), state
         if self.pool_type == "sum":
-            return jnp.sum(x, axis=axes), state
+            return jnp.sum(x, axis=axes, keepdims=self.keepdims), state
         raise ValueError(f"unknown pool type {self.pool_type}")
 
 

@@ -136,6 +136,55 @@ class Embedding(LayerConfig):
 
 @register_config
 @dataclass
+class Rescaling(LayerConfig):
+    """Fixed affine preprocessing (↔ keras Rescaling, and the import
+    target for adapted keras Normalization).
+
+    Two modes:
+    - config-only: ``y = x * scale + offset`` (Rescaling semantics);
+    - with ``mean``/``var`` entries in state (filled by the Keras
+      importer from an adapted Normalization layer's stored moments):
+      ``y = (x - mean) / max(sqrt(var), eps)`` — exactly tf_keras
+      Normalization.call — or its ``invert=True`` inverse. Stats live in
+      STATE, not params, so updaters never touch them.
+    """
+
+    scale: float = 1.0
+    offset: float = 0.0
+    invert: bool = False
+    eps: float = 1e-7
+    stats: bool = False  # True: carry mean/var state (Normalization mode)
+    # Explicit stats (keras Normalization(mean=..., variance=...) stores
+    # them in CONFIG, not as h5 weights); lists so config JSON-round-trips.
+    mean: Optional[Sequence[float]] = None
+    var: Optional[Sequence[float]] = None
+
+    @property
+    def has_params(self):
+        return False
+
+    def init(self, rng, input_shape, dtype):
+        if self.mean is not None:
+            return {}, {"mean": jnp.asarray(self.mean, jnp.float32),
+                        "var": jnp.asarray(self.var, jnp.float32)}
+        if not self.stats:
+            return {}, {}
+        c = input_shape[-1]
+        return {}, {"mean": jnp.zeros((c,), jnp.float32),
+                    "var": jnp.ones((c,), jnp.float32)}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if "mean" in state:
+            mean, var = state["mean"], state["var"]
+            denom = jnp.maximum(jnp.sqrt(var), self.eps)
+            if self.invert:
+                return mean + x * denom, state
+            return (x - mean) / denom, state
+        return x * self.scale + self.offset, state
+
+
+@register_config
+@dataclass
 class Flatten(LayerConfig):
     """↔ CnnToFeedForwardPreProcessor — flatten trailing dims to features."""
 
