@@ -55,6 +55,7 @@ from deeplearning4j_tpu.nn.layers.core import (
     Permute,
     PReLU,
     RepeatVector,
+    Rescaling,
     Reshape,
 )
 from deeplearning4j_tpu.nn.layers.norm import BatchNorm, LayerNorm
@@ -166,6 +167,9 @@ def _pool(kind):
 
 def _global_pool(kind):
     def mapper(cfg):
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError(
+                "channels_first global pooling not supported")
         return GlobalPooling(pool_type=kind,
                              keepdims=bool(cfg.get("keepdims"))), {}
 
@@ -193,7 +197,9 @@ def _batchnorm(cfg):
 
 
 def _check_bn_axis(layer, shape_nobatch, where: str) -> None:
-    """Refuse channels-first BatchNormalization once the input rank is known.
+    """Refuse channels-first normalization once the input rank is known —
+    shared by every imported layer stashing ``_keras_axis`` (BatchNorm and
+    the Normalization→Rescaling path); the error names the layer type.
 
     ``shape_nobatch`` excludes the batch dim, so the channels-last Keras
     axis index for this input is exactly ``len(shape_nobatch)``."""
@@ -214,8 +220,6 @@ def _layernorm(cfg):
 
 
 def _rescaling(cfg):
-    from deeplearning4j_tpu.nn.layers import Rescaling
-
     scale = cfg.get("scale", 1.0)
     offset = cfg.get("offset", 0.0)
     if isinstance(scale, (list, tuple)) or isinstance(offset, (list, tuple)):
@@ -227,8 +231,6 @@ def _rescaling(cfg):
 def _normalization(cfg):
     # Adapted stats live as h5 weights (mean/variance/count); keras
     # epsilon 1e-7 matches Normalization.call's max(sqrt(var), eps).
-    from deeplearning4j_tpu.nn.layers import Rescaling
-
     axis = cfg.get("axis", -1)
     if isinstance(axis, (list, tuple)):
         if len(axis) != 1:
@@ -668,8 +670,6 @@ def _pool3d(kind):
             padding=_padding(cfg)), {}
 
     return mapper
-
-
 
 
 def _upsampling3d(cfg):

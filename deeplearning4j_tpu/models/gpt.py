@@ -1,0 +1,336 @@
+"""Decoder-only causal language model (GPT family).
+
+The reference's only text-generation model is TextGenerationLSTM
+(rnnTimeStep char-RNN, SURVEY §2.7 zoo row); this is its transformer-era
+counterpart, required by the build's first-class long-context story
+(task §5 / SURVEY §5.7): causal flash attention (Pallas under the
+auto-dispatch policy at long T), optional ring/Ulysses sequence
+parallelism on a `seq` mesh axis, remat for deep stacks, and a KV-cache
+autoregressive decoder that compiles the WHOLE generation loop into one
+`lax.scan` program — the transformer analogue of the compiled char-RNN
+generation in nn/generation.py (one dispatch per sequence, not per
+token; through a ~69 ms-round-trip interconnect that is the difference
+between usable and unusable sampling).
+
+Training reuses TransformerEncoderBlock (pre-LN, causal=True) so every
+Trainer feature (donation, bf16 policy, NaN guard, chained bench
+windows) applies unchanged; the cached decode step re-implements the
+block's forward over the same param tree, and a parity test pins its
+logits to the full forward's at every position
+(tests/test_gpt.py::test_cached_decode_matches_full_forward).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, register_config
+from deeplearning4j_tpu.nn.layers.attention import TransformerEncoderBlock
+from deeplearning4j_tpu.ops import loss as losses
+from deeplearning4j_tpu.ops import nn as opsnn
+from deeplearning4j_tpu.train.updaters import Adam
+
+
+@register_config
+@dataclass
+class GptConfig:
+    """Architecture config (JSON round-trip via the config registry)."""
+
+    vocab_size: int = 50257
+    hidden: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate: int = 3072
+    max_position: int = 1024
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation: str = "gelu"
+    eps: float = 1e-5
+    initializer_range: float = 0.02
+    remat: bool = False
+    # "ring" | "ulysses" | None — P9 sequence parallelism for long-context
+    # training (takes effect inside a parallel.sequence.sequence_mesh).
+    sequence_parallel: Optional[str] = None
+    net: NeuralNetConfiguration = field(
+        default_factory=lambda: NeuralNetConfiguration(updater=Adam(3e-4))
+    )
+
+
+class Gpt:
+    """Causal transformer LM: Trainer-compatible (init/apply/loss_fn) plus
+    a compiled KV-cache generator."""
+
+    def __init__(self, config: GptConfig):
+        self.config = config
+        self.net = config.net
+        self._block = TransformerEncoderBlock(
+            num_heads=config.num_heads,
+            intermediate=config.intermediate,
+            activation=config.activation,
+            dropout=config.dropout,
+            attention_dropout=config.attention_dropout,
+            causal=True,
+            post_ln=False,  # pre-LN: stable for deep decoder stacks
+            eps=config.eps,
+            remat=config.remat,
+            sequence_parallel=config.sequence_parallel,
+        )
+
+    # -- construction ------------------------------------------------------
+
+    def init(self, seed: Optional[int] = None) -> Dict[str, Any]:
+        c = self.config
+        seed = self.net.seed if seed is None else seed
+        rng = jax.random.key(seed)
+        dtype = jnp.dtype(self.net.dtype)
+        std = c.initializer_range
+
+        def trunc(key, shape):
+            return std * jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                                     dtype)
+
+        ks = jax.random.split(rng, 4 + c.num_layers)
+        params: Dict[str, Any] = {
+            "embeddings": {
+                "word": trunc(ks[0], (c.vocab_size, c.hidden)),
+                "position": trunc(ks[1], (c.max_position, c.hidden)),
+            },
+            # final pre-head LayerNorm (GPT-2 style); decoder weight is
+            # tied to the word embedding, only a bias is learned
+            "final": {
+                "ln_gamma": jnp.ones((c.hidden,), dtype),
+                "ln_beta": jnp.zeros((c.hidden,), dtype),
+                "out_b": jnp.zeros((c.vocab_size,), dtype),
+            },
+        }
+        for i in range(c.num_layers):
+            p, _ = self._block.init(ks[4 + i], (c.max_position, c.hidden),
+                                    dtype)
+            params[f"layer_{i}"] = p
+        return {"params": params, "state": {}}
+
+    # -- pure functions ----------------------------------------------------
+
+    def encode(self, params, ids, *, train=False, rng=None, mask=None):
+        """[N,T] int32 → hidden [N,T,H] (pre-head LN applied)."""
+        c = self.config
+        t = ids.shape[1]
+        emb = params["embeddings"]
+        x = opsnn.embedding_lookup(emb["word"], ids)
+        x = x + emb["position"][:t][None, :, :]
+        if train and c.dropout > 0.0 and rng is not None:
+            x = opsnn.dropout(x, c.dropout, jax.random.fold_in(rng, 999))
+        for i in range(c.num_layers):
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, _ = self._block.apply(params[f"layer_{i}"], {}, x,
+                                     train=train, rng=lrng, mask=mask)
+        f = params["final"]
+        return opsnn.layer_norm(x, f["ln_gamma"], f["ln_beta"], eps=c.eps)
+
+    def logits(self, params, hidden):
+        return (jnp.einsum("nth,vh->ntv", hidden,
+                           params["embeddings"]["word"])
+                + params["final"]["out_b"])
+
+    def apply(self, variables, features, *, train=False, rng=None):
+        """Returns (logits [N,T,V], state)."""
+        if isinstance(features, dict):
+            ids = features["token_ids"]
+            mask = features.get("mask")
+        else:
+            ids, mask = features, None
+        h = self.encode(variables["params"], ids, train=train, rng=rng,
+                        mask=mask)
+        return self.logits(variables["params"], h), variables.get("state", {})
+
+    def loss_fn(self, params, state, batch, rng=None):
+        """Next-token cross entropy. batch["features"]["token_ids"] [N,T];
+        optional features["mask"] [N,T] excludes padding from loss and
+        attention; optional batch["labels"] overrides the shifted ids."""
+        features = batch["features"]
+        if not isinstance(features, dict):
+            features = {"token_ids": features}
+        ids = features["token_ids"]
+        mask = features.get("mask")
+        h = self.encode(params, ids, train=True, rng=rng, mask=mask)
+        lg = self.logits(params, h)[:, :-1]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = ids[:, 1:]
+        w = (jnp.ones(labels.shape, jnp.float32) if mask is None
+             else mask[:, 1:].astype(jnp.float32))
+        per_tok = losses.sparse_softmax_cross_entropy(lg, labels,
+                                                      reduction="none")
+        loss = jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)
+        return loss, (state, {"loss": loss})
+
+    def num_params(self, variables) -> int:
+        return sum(p.size for p in
+                   jax.tree_util.tree_leaves(variables["params"]))
+
+    # -- KV-cache decoding -------------------------------------------------
+
+    def init_cache(self, batch_size: int, max_len: int, dtype=jnp.float32):
+        """Per-layer K/V ring buffers [N, heads, max_len, head_dim]."""
+        c = self.config
+        hd = c.hidden // c.num_heads
+        shape = (batch_size, c.num_heads, max_len, hd)
+        return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                for _ in range(c.num_layers)]
+
+    def _block_step(self, p, cache, x_t, pos):
+        """One token through one block with cached K/V.
+
+        x_t: [N,H]; pos: scalar int32 (0-based position of this token).
+        Re-implements TransformerEncoderBlock._forward (pre-LN branch) —
+        parity pinned by test_cached_decode_matches_full_forward.
+        """
+        c = self.config
+        h = c.num_heads
+        eps = c.eps
+
+        def ln(v, which):
+            return opsnn.layer_norm(v, p[f"{which}_gamma"],
+                                    p[f"{which}_beta"], eps=eps)
+
+        ap = p["attention"]
+        a_in = ln(x_t, "ln1")  # [N,H]
+        n, e = a_in.shape
+        hd = e // h
+
+        def heads(z):
+            return z.reshape(n, h, 1, hd)  # [N,h,1,hd] from [N, h*hd]
+
+        q = heads(opsnn.linear(a_in, ap["Wq"], ap.get("bq")))
+        k = heads(opsnn.linear(a_in, ap["Wk"], ap.get("bk")))
+        v = heads(opsnn.linear(a_in, ap["Wv"], ap.get("bv")))
+        kc = jax.lax.dynamic_update_slice(cache["k"], k, (0, 0, pos, 0))
+        vc = jax.lax.dynamic_update_slice(cache["v"], v, (0, 0, pos, 0))
+        scores = jnp.einsum("nhqd,nhld->nhql", q, kc) / jnp.sqrt(
+            jnp.asarray(hd, q.dtype))
+        # causal-by-construction: only slots <= pos are live
+        live = (jnp.arange(kc.shape[2]) <= pos)[None, None, None, :]
+        scores = jnp.where(live, scores, jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores, axis=-1)
+        y = jnp.einsum("nhql,nhld->nhqd", att, vc).reshape(n, e)
+        a = opsnn.linear(y, ap["Wo"], ap.get("bo"))
+        x = x_t + a
+        f_in = ln(x, "ln2")
+        f = opsnn.linear(f_in, p["W1"], p["b1"])
+        f = get_activation(c.activation)(f)
+        f = opsnn.linear(f, p["W2"], p["b2"])
+        return x + f, {"k": kc, "v": vc}
+
+    def decode_step(self, params, caches, ids_t, pos):
+        """One decode step: ids_t [N] int32 at position pos → (logits [N,V],
+        updated caches)."""
+        c = self.config
+        emb = params["embeddings"]
+        x = opsnn.embedding_lookup(emb["word"], ids_t)  # [N,H]
+        x = x + jax.lax.dynamic_slice_in_dim(emb["position"], pos, 1, 0)[0]
+        new_caches = []
+        for i in range(c.num_layers):
+            x, cc = self._block_step(params[f"layer_{i}"], caches[i], x, pos)
+            new_caches.append(cc)
+        f = params["final"]
+        hfin = opsnn.layer_norm(x, f["ln_gamma"], f["ln_beta"], eps=c.eps)
+        lg = hfin @ params["embeddings"]["word"].T + f["out_b"]
+        return lg, new_caches
+
+    def generate(self, variables, prime_ids, *, n_steps: int, rng,
+                 temperature: float = 1.0, max_len: Optional[int] = None):
+        """Sample n_steps continuation tokens after prime_ids [N,T0].
+
+        Prefill runs the cached decoder over the prime with a lax.scan
+        (teacher forcing), then a second scan samples; BOTH loops live in
+        one jitted program per (shape, n_steps) — no per-token dispatch.
+        temperature=0 is greedy argmax. Returns [N, n_steps] int32.
+        """
+        params = variables["params"]
+        n, t0 = prime_ids.shape
+        total = max_len or (t0 + n_steps)
+        if total > self.config.max_position:
+            raise ValueError(
+                f"generation length {total} exceeds max_position "
+                f"{self.config.max_position}")
+        fn = _generate_fn_cache(self, t0, n_steps, total, float(temperature))
+        return fn(params, jnp.asarray(prime_ids, jnp.int32), rng)
+
+
+def _build_generate_fn(model: Gpt, t0: int, n_steps: int, total: int,
+                       temperature: float):
+    def run(params, prime, rng):
+        caches = model.init_cache(prime.shape[0], total)
+
+        def prefill(carry, t):
+            caches = carry
+            lg, caches = model.decode_step(params, caches, prime[:, t], t)
+            return caches, lg
+
+        caches, lgs = jax.lax.scan(prefill, caches, jnp.arange(t0))
+        last_logits = lgs[-1]
+
+        def sample(lg, key):
+            if temperature == 0.0:
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, lg / jnp.asarray(temperature, lg.dtype), axis=-1
+            ).astype(jnp.int32)
+
+        def step(carry, i):
+            caches, lg, key = carry
+            key, sub = jax.random.split(key)
+            tok = sample(lg, sub)
+            lg2, caches = model.decode_step(params, caches, tok, t0 + i)
+            return (caches, lg2, key), tok
+
+        (_, _, _), toks = jax.lax.scan(
+            step, (caches, last_logits, rng), jnp.arange(n_steps))
+        return toks.T  # [N, n_steps]
+
+    return jax.jit(run)
+
+
+def _generate_fn_cache(model: Gpt, t0: int, n_steps: int, total: int,
+                       temperature: float):
+    """Per-model jit cache so repeated sampling never retraces."""
+    cache = getattr(model, "_gen_cache", None)
+    if cache is None:
+        cache = model._gen_cache = {}
+    key = (t0, n_steps, total, temperature)
+    if key not in cache:
+        cache[key] = _build_generate_fn(model, t0, n_steps, total,
+                                        temperature)
+    return cache[key]
+
+
+def gpt2_small(**kw) -> Gpt:
+    """GPT-2 small dims (12L/768H/12A, 1024 ctx)."""
+    return Gpt(GptConfig(**kw))
+
+
+def gpt_tiny(**kw) -> Gpt:
+    """2L/64H/2A toy config for tests and CPU runs."""
+    kw.setdefault("hidden", 64)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("intermediate", 128)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position", 64)
+    kw.setdefault("dropout", 0.0)
+    kw.setdefault("attention_dropout", 0.0)
+    return Gpt(GptConfig(**kw))
+
+
+def gpt_long(**kw) -> Gpt:
+    """Long-context config: ring-attention sequence parallelism + remat
+    (train at T ≫ single-chip HBM limits on a `seq` mesh axis)."""
+    kw.setdefault("sequence_parallel", "ring")
+    kw.setdefault("remat", True)
+    kw.setdefault("max_position", 32768)
+    return Gpt(GptConfig(**kw))

@@ -20,11 +20,8 @@ keras = pytest.importorskip("tf_keras")
 from deeplearning4j_tpu.modelimport.keras import import_keras_model  # noqa: E402
 
 
-def _roundtrip(m, atol=5e-6):
-    import os
-    import tempfile
-
-    p = os.path.join(tempfile.mkdtemp(), "m.h5")
+def _roundtrip(m, tmp_path, atol=5e-6):
+    p = str(tmp_path / "m.h5")
     m.save(p)
     model, variables = import_keras_model(p)
     shape = m.input_shape[1:]
@@ -38,29 +35,29 @@ def _roundtrip(m, atol=5e-6):
     np.testing.assert_allclose(got, want, atol=atol)
 
 
-def test_mobilenet_v1():
+def test_mobilenet_v1(tmp_path):
     # depthwise convs + GlobalAveragePooling2D(keepdims=True) head
     _roundtrip(keras.applications.MobileNet(
-        weights=None, input_shape=(64, 64, 3), classes=7))
+        weights=None, input_shape=(64, 64, 3), classes=7), tmp_path)
 
 
-def test_mobilenet_v2():
+def test_mobilenet_v2(tmp_path):
     # inverted residuals, relu6, linear bottlenecks, Add merges
     _roundtrip(keras.applications.MobileNetV2(
-        weights=None, input_shape=(64, 64, 3), classes=7))
+        weights=None, input_shape=(64, 64, 3), classes=7), tmp_path)
 
 
-def test_resnet50():
+def test_resnet50(tmp_path):
     # the reference zoo's flagship CG model, via real Keras graph
     _roundtrip(keras.applications.ResNet50(
-        weights=None, input_shape=(64, 64, 3), classes=7))
+        weights=None, input_shape=(64, 64, 3), classes=7), tmp_path)
 
 
-def test_efficientnet_b0():
+def test_efficientnet_b0(tmp_path):
     # Rescaling + adapted-Normalization preprocessing, SE blocks
     # (GlobalPool->Reshape->Conv->Multiply), swish, depthwise
     _roundtrip(keras.applications.EfficientNetB0(
-        weights=None, input_shape=(64, 64, 3), classes=7))
+        weights=None, input_shape=(64, 64, 3), classes=7), tmp_path)
 
 
 def test_normalization_semantics_pinned_to_keras():
@@ -91,19 +88,16 @@ def test_rescaling_config_roundtrip():
     assert config_from_json(r.to_json()).to_json() == r.to_json()
 
 
-def test_normalization_explicit_stats_import():
+def test_normalization_explicit_stats_import(tmp_path):
     """keras Normalization(mean=..., variance=...) keeps stats in CONFIG
     with no h5 weights (review finding) — import must read them there."""
-    import os
-    import tempfile
-
     m = keras.Sequential([
         keras.layers.Input((3,)),
         keras.layers.Normalization(axis=-1, mean=[1.0, 2.0, 3.0],
                                    variance=[4.0, 1.0, 0.25]),
         keras.layers.Dense(2),
     ])
-    p = os.path.join(tempfile.mkdtemp(), "m.h5")
+    p = str(tmp_path / "m.h5")
     m.save(p)
     model, variables = import_keras_model(p)
     x = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
