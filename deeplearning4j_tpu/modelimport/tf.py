@@ -236,6 +236,102 @@ def _np_dtype(tf_type: int) -> str:
 # --- the import ------------------------------------------------------------
 
 
+# --- host constant folding --------------------------------------------------
+# Frozen graphs from real exporters (tf.function + convert_to_constants of
+# keras models) compute Reshape/BroadcastTo arguments with on-graph shape
+# arithmetic: Shape → StridedSlice → Pack / Mul / ConcatV2. The Shape mapper
+# records its host value; these folders propagate it so const_value()
+# consumers succeed. Best-effort; never replaces the emitted graph ops.
+
+
+def _tf_fold_strided_slice(node, arrs):
+    x, begin, end, strides = (np.asarray(a) for a in arrs[:4])
+    if _attr(node, "new_axis_mask", 0) or _attr(node, "ellipsis_mask", 0):
+        raise ValueError("unhandled mask")
+    bm = _attr(node, "begin_mask", 0)
+    em = _attr(node, "end_mask", 0)
+    sm = _attr(node, "shrink_axis_mask", 0)
+    sl = []
+    shrink = []
+    for i in range(len(begin)):
+        if (sm >> i) & 1:
+            sl.append(slice(int(begin[i]), int(begin[i]) + 1
+                            if int(begin[i]) != -1 else None, 1))
+            shrink.append(i)
+            continue
+        b = None if (bm >> i) & 1 else int(begin[i])
+        e = None if (em >> i) & 1 else int(end[i])
+        sl.append(slice(b, e, int(strides[i])))
+    out = x[tuple(sl)]
+    for i in reversed(shrink):
+        out = np.squeeze(out, axis=i)
+    return out
+
+
+def _tf_fold_cast(node, arrs):
+    return arrs[0].astype(_np_dtype(_attr(node, "DstT", 1)))
+
+
+_FOLD_SIZE_CAP = 4096
+
+
+def _capped(arr):
+    if arr.size > _FOLD_SIZE_CAP:
+        raise ValueError("fold output exceeds size cap")
+    return arr
+
+
+def _capped_fill(dims, value):
+    n = 1
+    for d in dims:
+        n *= max(int(d), 0)
+    if n > _FOLD_SIZE_CAP:
+        raise ValueError("fold output exceeds size cap")
+    return np.full(dims, value)
+
+
+_TF_HOST_FOLDABLE = {
+    "Pack": lambda n, a: np.stack(a, axis=_attr(n, "axis", 0)),
+    "ConcatV2": lambda n, a: np.concatenate(
+        [np.atleast_1d(x) for x in a[:-1]], axis=int(np.asarray(a[-1]))),
+    "StridedSlice": _tf_fold_strided_slice,
+    "Slice": lambda n, a: a[0][tuple(
+        slice(int(b), int(b) + int(s)) if int(s) != -1 else slice(int(b), None)
+        for b, s in zip(np.asarray(a[1]).reshape(-1),
+                        np.asarray(a[2]).reshape(-1)))],
+    "GatherV2": lambda n, a: np.take(
+        a[0], a[1].astype(np.int64),
+        axis=int(np.asarray(a[2]).reshape(())) if len(a) > 2 else 0),
+    "Add": lambda n, a: a[0] + a[1],
+    "AddV2": lambda n, a: a[0] + a[1],
+    "Sub": lambda n, a: a[0] - a[1],
+    "Mul": lambda n, a: a[0] * a[1],
+    "Maximum": lambda n, a: np.maximum(a[0], a[1]),
+    "Minimum": lambda n, a: np.minimum(a[0], a[1]),
+    "FloorDiv": lambda n, a: a[0] // a[1],
+    "FloorMod": lambda n, a: a[0] % a[1],
+    "Neg": lambda n, a: -a[0],
+    "Cast": _tf_fold_cast,
+    "Squeeze": lambda n, a: np.squeeze(
+        a[0], axis=tuple(_attr(n, "squeeze_dims", []) or []) or None),
+    "ExpandDims": lambda n, a: np.expand_dims(
+        a[0], int(np.asarray(a[1]).reshape(()))),
+    "Prod": lambda n, a: np.prod(
+        a[0], axis=tuple(np.atleast_1d(a[1]).astype(int)),
+        keepdims=bool(_attr(n, "keep_dims", 0))),
+    # Range/Fill GROW output from tiny inputs — cap the result size too (a
+    # frozen graph may Fill a [N,T,T] attention mask; advisory folding must
+    # not allocate it on host)
+    "Range": lambda n, a: _capped(np.arange(
+        *(np.asarray(x).reshape(()) for x in a))),
+    "Fill": lambda n, a: _capped_fill(
+        [int(v) for v in np.asarray(a[0]).reshape(-1)],
+        np.asarray(a[1]).reshape(())),
+    "Reshape": lambda n, a: a[0].reshape(
+        [int(v) for v in np.asarray(a[1]).reshape(-1)]),
+}
+
+
 class _GraphImporter:
     """Walks GraphDef nodes, emitting SameDiff ops via the mapper registry
     (↔ TFGraphMapper.importGraph)."""
@@ -267,6 +363,26 @@ class _GraphImporter:
                 f"op needs host-known constant for {ref!r}, but {name!r} "
                 "is not a Const node")
         return self.consts[name]
+
+    def _try_fold(self, node) -> None:
+        """Best-effort host evaluation when every input is host-known (see
+        _TF_HOST_FOLDABLE); failures leave the graph untouched. The size
+        cap keeps weight-sized const chains off the fold path — shape math
+        is tiny."""
+        fold = _TF_HOST_FOLDABLE.get(node.op)
+        if fold is None or node.name in self.consts:
+            return
+        refs = [r.split(":")[0].lstrip("^") for r in node.input
+                if not r.startswith("^")]
+        if not all(r in self.consts for r in refs):
+            return
+        if any(self.consts[r].size > 4096 for r in refs):
+            return
+        try:
+            self.consts[node.name] = np.asarray(
+                fold(node, [self.consts[r] for r in refs]))
+        except Exception:  # noqa: BLE001 - folding is advisory only
+            pass
 
     def run(self, outputs: Sequence[str]) -> Dict[str, str]:
         from tensorflow.python.framework import tensor_util
@@ -306,6 +422,7 @@ class _GraphImporter:
                         f"no mapper for TF op {op!r} (node {node.name}); "
                         f"supported: {sorted(TF_OP_MAPPERS)}")
                 self.vars[node.name] = mapper(self, node)
+                self._try_fold(node)
         for out in outputs:
             name_map[out] = self.tensor(out).name
         return name_map
@@ -610,8 +727,11 @@ def _shape(imp, node):
     x = imp.tensor(node.input[0])
     if x.shape is None or any(d is None for d in x.shape):
         raise TFImportError(f"Shape of dynamic tensor {node.input[0]!r}")
-    return imp.sd.constant(_uniq(imp.sd, node.name),
-                           np.asarray(x.shape, np.int32))
+    arr = np.asarray(x.shape, np.int32)
+    # host-known: downstream shape arithmetic (Pack/StridedSlice chases
+    # real exporters emit) folds from this
+    imp.consts[node.name] = arr
+    return imp.sd.constant(_uniq(imp.sd, node.name), arr)
 
 
 @tf_op("Split")

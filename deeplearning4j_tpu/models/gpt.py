@@ -254,6 +254,11 @@ class Gpt:
         params = variables["params"]
         n, t0 = prime_ids.shape
         total = max_len or (t0 + n_steps)
+        if total < t0 + n_steps:
+            raise ValueError(
+                f"max_len {total} < prime {t0} + n_steps {n_steps}: the KV "
+                "cache would clamp out-of-range writes to its last slot and "
+                "sample from stale keys")
         if total > self.config.max_position:
             raise ValueError(
                 f"generation length {total} exceeds max_position "
@@ -265,7 +270,10 @@ class Gpt:
 def _build_generate_fn(model: Gpt, t0: int, n_steps: int, total: int,
                        temperature: float):
     def run(params, prime, rng):
-        caches = model.init_cache(prime.shape[0], total)
+        # cache dtype follows the params (bf16 nets project bf16 K/V)
+        caches = model.init_cache(
+            prime.shape[0], total,
+            dtype=params["embeddings"]["word"].dtype)
 
         def prefill(carry, t):
             caches = carry

@@ -163,3 +163,25 @@ class TestLongContext:
         g = jax.grad(lambda p: rem.loss_fn(p, {}, batch)[0])(v["params"])
         assert all(np.isfinite(np.asarray(x)).all()
                    for x in jax.tree_util.tree_leaves(g))
+
+
+class TestGenerateValidation:
+    def test_max_len_too_small_refused(self):
+        import pytest
+
+        model = gpt_tiny()
+        v = model.init(seed=0)
+        with pytest.raises(ValueError, match="stale keys"):
+            model.generate(v, jnp.zeros((1, 5), jnp.int32), n_steps=4,
+                           rng=jax.random.key(0), max_len=5)
+
+    def test_bf16_net_generates(self):
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        model = gpt_tiny(net=NeuralNetConfiguration(updater=Adam(1e-3),
+                                                    dtype="bfloat16"))
+        v = model.init(seed=0)
+        toks = model.generate(v, jnp.zeros((1, 3), jnp.int32), n_steps=4,
+                              rng=jax.random.key(0), temperature=0.0)
+        assert toks.shape == (1, 4)

@@ -1,0 +1,104 @@
+"""TF import oracle-tested against REAL frozen GraphDefs of production
+architectures (tf.function + convert_variables_to_constants_v2 — the
+modern form of the frozen .pb files the reference's TF import consumed;
+SURVEY §3.2). Complements the hand-built subgraph tests in
+test_modelimport.py with exporter-emitted graph patterns: grappler
+Const→Identity chains, Shape→StridedSlice→Pack reshape chases,
+FusedBatchNormV3, DepthwiseConv2dNative."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("tf_keras")
+
+from deeplearning4j_tpu.modelimport.tf import import_tf_graph  # noqa: E402
+
+
+def _freeze(model, shape, batch=2):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    f = tf.function(lambda x: model(x, training=False))
+    cf = f.get_concrete_function(tf.TensorSpec((batch, *shape), tf.float32))
+    frozen = convert_variables_to_constants_v2(cf)
+    return (frozen.graph.as_graph_def(),
+            frozen.inputs[0].name.split(":")[0],
+            frozen.outputs[0].name.split(":")[0])
+
+
+def _roundtrip(model, shape, atol=5e-6):
+    gd, in_name, out_name = _freeze(model, shape)
+    x = np.random.default_rng(0).normal(size=(2, *shape)).astype(np.float32)
+    want = np.asarray(model(x))
+    sd, in_map, out_map = import_tf_graph(gd, outputs=[out_name])
+    got = sd.output({in_map[in_name]: x}, [out_map[out_name]])[
+        out_map[out_name]]
+    np.testing.assert_allclose(np.asarray(got), want, atol=atol)
+    return len(gd.node)
+
+
+def test_frozen_small_cnn():
+    m = keras.Sequential([
+        keras.layers.Input((16, 16, 3)),
+        keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+        keras.layers.BatchNormalization(),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Conv2D(16, 3, strides=2, activation="relu"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax")])
+    _roundtrip(m, (16, 16, 3))
+
+
+def test_frozen_mobilenet():
+    # depthwise convs, relu6, and the Shape->StridedSlice->Pack reshape
+    # chase the keras exporter emits for the keepdims-pooling head
+    n = _roundtrip(keras.applications.MobileNet(
+        weights=None, input_shape=(64, 64, 3), classes=7), (64, 64, 3))
+    assert n > 300  # a real graph, not a toy
+
+
+def test_frozen_resnet50():
+    _roundtrip(keras.applications.ResNet50(
+        weights=None, input_shape=(64, 64, 3), classes=7), (64, 64, 3))
+
+
+def test_strided_slice_fold_masks():
+    """Unit-check the host StridedSlice folder: begin/end masks and
+    shrink_axis_mask (the exporter's `shape[0]` chase)."""
+    from deeplearning4j_tpu.modelimport.tf import _tf_fold_strided_slice
+
+    class FakeNode:
+        op = "StridedSlice"
+
+        def __init__(self, **attrs):
+            self._attrs = attrs
+
+    # _attr reads node.attr protobuf; emulate via monkeypatched _attr?
+    # Simpler: drive through real TF graphs below instead — here check the
+    # pure-numpy core with a stub matching _attr's access pattern.
+    import deeplearning4j_tpu.modelimport.tf as tfmod
+
+    orig = tfmod._attr
+    try:
+        tfmod._attr = lambda node, name, default=None: \
+            node._attrs.get(name, default)
+        x = np.asarray([2, 7, 64, 64])
+        # shape[0] with shrink_axis_mask=1
+        out = _tf_fold_strided_slice(
+            FakeNode(shrink_axis_mask=1),
+            [x, np.asarray([0]), np.asarray([1]), np.asarray([1])])
+        assert out.shape == () and int(out) == 2
+        # shape[1:3]
+        out = _tf_fold_strided_slice(
+            FakeNode(),
+            [x, np.asarray([1]), np.asarray([3]), np.asarray([1])])
+        np.testing.assert_array_equal(out, [7, 64])
+        # end_mask: shape[2:]
+        out = _tf_fold_strided_slice(
+            FakeNode(end_mask=1),
+            [x, np.asarray([2]), np.asarray([0]), np.asarray([1])])
+        np.testing.assert_array_equal(out, [64, 64])
+    finally:
+        tfmod._attr = orig
