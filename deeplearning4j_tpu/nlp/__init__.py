@@ -14,6 +14,12 @@ from deeplearning4j_tpu.nlp.tokenization import (
     NGramTokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+from deeplearning4j_tpu.nlp.wordpiece import (
+    BasicTokenizer,
+    BertWordPieceTokenizerFactory,
+    WordPieceTokenizer,
+    load_vocab,
+)
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.fasttext import FastText, char_ngrams
 from deeplearning4j_tpu.nlp.glove import Glove
@@ -25,6 +31,8 @@ __all__ = [
     "DefaultTokenizerFactory",
     "NGramTokenizerFactory",
     "CommonPreprocessor",
+    "BasicTokenizer", "WordPieceTokenizer", "BertWordPieceTokenizerFactory",
+    "load_vocab",
     "VocabCache",
     "build_vocab",
     "Word2Vec",

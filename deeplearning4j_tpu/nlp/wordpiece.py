@@ -1,0 +1,207 @@
+"""BERT WordPiece tokenization (↔ deeplearning4j-nlp's
+BertWordPieceTokenizerFactory / BertWordPiecePreProcessor, SURVEY §2.7
+NLP row — the tokenizer the reference pairs with its BERT import path).
+
+Pipeline matches the original BERT reference implementation (and
+HuggingFace's BertTokenizer, which tests use as the oracle):
+
+1. ``BasicTokenizer`` — unicode clean-up, whitespace split, optional
+   lower-casing + accent stripping (NFD), punctuation split, CJK
+   character isolation;
+2. ``WordPieceTokenizer`` — greedy longest-match-first against the
+   vocab, ``##`` continuation prefix, ``[UNK]`` for words that cannot
+   be composed or exceed ``max_input_chars_per_word``.
+
+``BertWordPieceTokenizerFactory.encode`` assembles the model-ready
+[CLS]/[SEP] pair encoding (token_ids/segment_ids/mask, fixed max_len,
+static shapes) that ``models.bert`` consumes directly.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def load_vocab(path) -> Dict[str, int]:
+    """One token per line (the standard vocab.txt format)."""
+    out: Dict[str, int] = {}
+    for i, line in enumerate(Path(path).read_text(
+            encoding="utf-8").splitlines()):
+        tok = line.rstrip("\n")
+        if tok:
+            out[tok] = i
+    return out
+
+
+def _is_whitespace(ch: str) -> bool:
+    return ch in " \t\n\r" or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch: str) -> bool:
+    if ch in "\t\n\r":
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII ranges BERT treats as punctuation even where unicode doesn't
+    # (e.g. $, +, ~, `)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation/CJK pre-tokenizer (BERT reference rules).
+
+    ``never_split``: whitespace-delimited tokens passed through verbatim —
+    no lower-casing or punctuation split (how [MASK]/[SEP] markers embedded
+    in raw text survive, matching HF's never_split/all_special_tokens)."""
+
+    def __init__(self, lower_case: bool = True,
+                 never_split: Optional[Sequence[str]] = None):
+        self.lower_case = lower_case
+        self.never_split = frozenset(never_split or ())
+
+    def tokenize(self, text: str) -> List[str]:
+        cleaned = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            if _is_cjk(cp):
+                cleaned.extend((" ", ch, " "))
+            elif _is_whitespace(ch):
+                cleaned.append(" ")
+            else:
+                cleaned.append(ch)
+        tokens = "".join(cleaned).split()
+        out: List[str] = []
+        for tok in tokens:
+            if tok in self.never_split:
+                out.append(tok)
+                continue
+            if self.lower_case:
+                tok = tok.lower()
+                tok = "".join(c for c in unicodedata.normalize("NFD", tok)
+                              if unicodedata.category(c) != "Mn")
+            out.extend(self._split_punct(tok))
+        return out
+
+    @staticmethod
+    def _split_punct(tok: str) -> List[str]:
+        pieces: List[List[str]] = [[]]
+        for ch in tok:
+            if _is_punctuation(ch):
+                pieces.append([ch])
+                pieces.append([])
+            else:
+                pieces[-1].append(ch)
+        return ["".join(p) for p in pieces if p]
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first subword split against a vocab."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 200):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    def tokenize(self, word: str) -> List[str]:
+        if len(word) > self.max_input_chars_per_word:
+            return [self.unk_token]
+        out: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = piece
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            out.append(cur)
+            start = end
+        return out
+
+
+class BertWordPieceTokenizerFactory:
+    """↔ BertWordPieceTokenizerFactory: text → WordPiece tokens/ids, plus
+    the [CLS]/[SEP] pair encoding models.bert consumes."""
+
+    def __init__(self, vocab, *, lower_case: bool = True,
+                 unk_token: str = "[UNK]", cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]"):
+        self.vocab: Dict[str, int] = (load_vocab(vocab)
+                                      if not isinstance(vocab, dict)
+                                      else dict(vocab))
+        specials = (unk_token, cls_token, sep_token, pad_token, "[MASK]")
+        self.basic = BasicTokenizer(lower_case=lower_case,
+                                    never_split=specials)
+        self.wordpiece = WordPieceTokenizer(self.vocab, unk_token)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self.cls_token, self.sep_token = cls_token, sep_token
+        self.pad_token, self.unk_token = pad_token, unk_token
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for word in self.basic.tokenize(text):
+            if word in self.basic.never_split:
+                out.append(word)
+                continue
+            out.extend(self.wordpiece.tokenize(word))
+        return out
+
+    def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
+        unk = self.vocab[self.unk_token]
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids: Sequence[int]) -> List[str]:
+        return [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
+
+    def encode(self, text_a: str, text_b: Optional[str] = None, *,
+               max_len: int = 128) -> Dict[str, "np.ndarray"]:
+        """[CLS] a [SEP] (b [SEP]) → fixed-length feature dict
+        {token_ids, segment_ids, mask} (models.bert's batch convention;
+        stack encodes along axis 0 for a batch)."""
+        import numpy as np
+
+        a = self.tokenize(text_a)
+        b = self.tokenize(text_b) if text_b is not None else []
+        # truncate longest-first to fit specials (BERT reference rule;
+        # ties pop from the SECOND sequence, as HF truncate_sequences does)
+        budget = max_len - (3 if b else 2)
+        while len(a) + len(b) > budget:
+            (a if len(a) > len(b) else b).pop()
+        toks = [self.cls_token] + a + [self.sep_token]
+        segs = [0] * len(toks)
+        if b:
+            toks += b + [self.sep_token]
+            segs += [1] * (len(b) + 1)
+        ids = self.convert_tokens_to_ids(toks)
+        pad = max_len - len(ids)
+        out = {
+            "token_ids": np.asarray(
+                ids + [self.vocab[self.pad_token]] * pad, np.int32),
+            "segment_ids": np.asarray(segs + [0] * pad, np.int32),
+            "mask": np.asarray([1.0] * len(ids) + [0.0] * pad, np.float32),
+        }
+        return out

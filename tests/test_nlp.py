@@ -231,3 +231,94 @@ class TestFastText:
     def test_words_nearest(self, ft):
         near = ft.words_nearest("cpu", 4)
         assert set(near) <= {"gpu", "ram", "disk", "cache"}
+
+
+class TestWordPiece:
+    """BertWordPieceTokenizerFactory pinned to the HuggingFace
+    BertTokenizer oracle (↔ the reference's BertWordPieceTokenizerFactory,
+    validated the way its tests validate against known encodings)."""
+
+    VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "quick",
+             "brown", "fox", "jump", "##s", "##ed", "##ing", "over", "lazy",
+             "dog", "un", "##aff", "##able", "runn", "hello", "world", "!",
+             ",", ".", "$", "2", "##0", "##2", "##4", "vex", "零", "一"]
+
+    @pytest.fixture()
+    def vocab_file(self, tmp_path):
+        p = tmp_path / "vocab.txt"
+        p.write_text("\n".join(self.VOCAB))
+        return str(p)
+
+    def test_tokenize_matches_huggingface(self, vocab_file):
+        transformers = pytest.importorskip("transformers")
+        hf = transformers.BertTokenizer(vocab_file, do_lower_case=True)
+        from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+
+        ours = BertWordPieceTokenizerFactory(vocab_file)
+        for text in [
+            "The quick brown fox JUMPS over the lazy dog!",
+            "unaffable, hello world.",
+            "vexing jumps $2024 runn jumped",
+            "héllo wörld 零一 the",          # accents + CJK isolation
+            "supercalifragilistic the",      # uncomposable -> [UNK]
+        ]:
+            assert ours.tokenize(text) == hf.tokenize(text), text
+
+    def test_pair_encoding_matches_huggingface(self, vocab_file):
+        transformers = pytest.importorskip("transformers")
+        hf = transformers.BertTokenizer(vocab_file, do_lower_case=True)
+        from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+
+        ours = BertWordPieceTokenizerFactory(vocab_file)
+        enc = ours.encode("the quick fox", "jumps over", max_len=16)
+        want = hf(text="the quick fox", text_pair="jumps over",
+                  max_length=16, padding="max_length",
+                  truncation="longest_first")
+        assert list(enc["token_ids"]) == want["input_ids"]
+        assert list(enc["segment_ids"]) == want["token_type_ids"]
+        assert [int(v) for v in enc["mask"]] == want["attention_mask"]
+
+    def test_truncation_and_roundtrip(self, vocab_file):
+        from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+
+        ours = BertWordPieceTokenizerFactory(vocab_file)
+        enc = ours.encode("the quick brown fox jumps over the lazy dog",
+                          "hello world hello world", max_len=12)
+        assert enc["token_ids"].shape == (12,)
+        assert float(enc["mask"].sum()) == 12.0  # fully used
+        toks = ours.convert_ids_to_tokens(enc["token_ids"])
+        assert toks[0] == "[CLS]" and toks.count("[SEP]") == 2
+
+    def test_feeds_bert_model(self, vocab_file):
+        """encode() output slots straight into models.bert apply."""
+        import numpy as np
+
+        from deeplearning4j_tpu.models.bert import bert_tiny
+        from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+
+        ours = BertWordPieceTokenizerFactory(vocab_file)
+        rows = [ours.encode("the quick fox", max_len=16),
+                ours.encode("hello world !", max_len=16)]
+        feats = {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+        model = bert_tiny(vocab_size=64, max_position=16)
+        v = model.init(seed=0)
+        h, _ = model.apply(v, feats)
+        assert h.shape == (2, 16, 128)
+
+    def test_special_tokens_survive_and_tie_truncation(self, vocab_file):
+        transformers = pytest.importorskip("transformers")
+        hf = transformers.BertTokenizer(vocab_file, do_lower_case=True)
+        from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+
+        ours = BertWordPieceTokenizerFactory(vocab_file)
+        # [MASK] embedded in raw text stays one token (never_split)
+        text = "the [MASK] fox"
+        assert ours.tokenize(text) == hf.tokenize(text) == \
+            ["the", "[MASK]", "fox"]
+        # equal-length pair over budget: ties truncate the SECOND sequence
+        enc = ours.encode("the quick fox jumps over",
+                          "hello world the lazy dog", max_len=13)
+        want = hf(text="the quick fox jumps over",
+                  text_pair="hello world the lazy dog", max_length=13,
+                  padding="max_length", truncation="longest_first")
+        assert list(enc["token_ids"]) == want["input_ids"]
