@@ -1417,6 +1417,18 @@ _HOST_FOLDABLE = {
             keepdims=bool(n.attrs().get("keepdims", 1)))),
     "Reshape": lambda n, a: a[0].reshape(
         [int(v) for v in np.asarray(a[1]).reshape(-1)]),
+    # boolean shape-select chains (torch exports Where/Equal around
+    # dynamic-vs-static dims in e.g. HF attention-mask expansion)
+    "Equal": lambda n, a: a[0] == a[1],
+    "Greater": lambda n, a: a[0] > a[1],
+    "Less": lambda n, a: a[0] < a[1],
+    "Not": lambda n, a: ~a[0].astype(bool),
+    "Where": lambda n, a: np.where(a[0].astype(bool), a[1], a[2]),
+    "Expand": lambda n, a: np.broadcast_to(
+        a[0], np.broadcast_shapes(
+            a[0].shape, tuple(int(v) for v in np.asarray(a[1]).reshape(-1)))),
+    "Min": lambda n, a: np.minimum.reduce(a),
+    "Max": lambda n, a: np.maximum.reduce(a),
 }
 
 

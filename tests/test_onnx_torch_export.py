@@ -174,3 +174,34 @@ def test_fold_unsqueeze_negative_axes_and_reduceprod_noop():
     np.testing.assert_array_equal(out, shape_vec)
     out = _HOST_FOLDABLE["ReduceProd"](FakeNode({"keepdims": 0}), [shape_vec])
     assert int(out) == 24
+
+
+def test_exported_hf_bert_model():
+    """Real HuggingFace BertModel through the real torch exporter — the
+    attention/LayerNorm/mask-expansion graph a user's transformer .onnx
+    actually contains (Where/Equal shape-select chains included).
+    (GPT2Model is not testable: its export crashes inside torch's own
+    tracer in this environment — exporter bug, not an import gap.)"""
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.BertConfig(
+        vocab_size=100, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32)
+    m = transformers.BertModel(cfg).eval()
+    torch.manual_seed(0)
+    ids = torch.randint(0, 100, (2, 10))
+    attn = torch.ones(2, 10, dtype=torch.long)
+    import io
+
+    buf = io.BytesIO()
+    with torch.no_grad():
+        want = m(ids, attention_mask=attn).last_hidden_state
+        torch.onnx.export(m, (ids, attn), buf, opset_version=14,
+                          dynamo=False, input_names=["ids", "attn"],
+                          output_names=["h", "pooled"])
+    sd, in_map, out_map = import_onnx_model(buf.getvalue(), outputs=["h"])
+    got = sd.output({"ids": ids.numpy(),
+                     "attn": attn.numpy().astype(np.float32)},
+                    [out_map["h"]])[out_map["h"]]
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=5e-6)
