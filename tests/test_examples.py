@@ -14,7 +14,8 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 FAST = ["samediff_graph.py", "word2vec_similarity.py",
         "seq2seq_attention.py"]
 SLOW = ["mnist_lenet.py", "transfer_learning.py", "bert_mlm_pretrain.py",
-        "char_rnn_generation.py", "gpt_char_lm.py", "data_parallel_mesh.py",
+        "char_rnn_generation.py", "gpt_char_lm.py", "bert_finetune_classifier.py",
+        "rl_dqn_cartpole.py", "data_parallel_mesh.py",
         "hyperparameter_search.py"]
 
 
