@@ -906,3 +906,42 @@ def _mirror_pad(imp, node):
     return imp.sd._record("tfimport.mirror_pad", [x], {
         "__argspec__": ["var"], "__posattrs__": [],
         "paddings": paddings, "mode": mode})
+
+
+def import_tf_saved_model(path, *, signature: str = "serving_default",
+                          outputs: Optional[Sequence[str]] = None):
+    """Import a TF2 SavedModel directory (the container modern TF users
+    actually have on disk; the reference predates it and consumed frozen
+    .pb only — this wrapper freezes the chosen signature with
+    convert_variables_to_constants_v2 and feeds the frozen GraphDef
+    through import_tf_graph).
+
+    Returns (sd, input_map, output_map) exactly like import_tf_graph;
+    input_map keys are the signature's tensor input names (":0" stripped).
+    Requires tensorflow at call time (import-gated, like the oracle tests).
+    """
+    try:
+        import tensorflow as tf
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+    except ImportError as e:  # pragma: no cover - gated dependency
+        raise TFImportError(
+            "import_tf_saved_model needs tensorflow installed to load and "
+            "freeze the SavedModel; export a frozen GraphDef and use "
+            "import_tf_graph instead") from e
+
+    loaded = tf.saved_model.load(path)
+    sigs = getattr(loaded, "signatures", {})
+    if signature not in sigs:
+        raise TFImportError(
+            f"SavedModel has no signature {signature!r}; available: "
+            f"{sorted(sigs)}")
+    frozen = convert_variables_to_constants_v2(sigs[signature])
+    gd = frozen.graph.as_graph_def()
+    # keep full name:idx — _GraphImporter.tensor() uses the index to pick
+    # among multi-output ops ("split:1" must not collapse to output 0);
+    # ":0" is dropped for cosmetics only.
+    out_names = [t.name[:-2] if t.name.endswith(":0") else t.name
+                 for t in frozen.outputs]
+    return import_tf_graph(gd, outputs=list(outputs or out_names))

@@ -110,7 +110,7 @@ def main(quick: bool = False):
 
     logits, _ = model.apply(trainer.variables(ts), xte)
     ev = Evaluation(num_classes=2)
-    ev.eval(jax.nn.softmax(logits), jax.nn.one_hot(yte, 2))
+    ev.eval(jax.nn.one_hot(yte, 2), jax.nn.softmax(logits))
     print(ev.stats())
     acc = ev.accuracy()
     print(f"test accuracy: {acc:.3f}")

@@ -102,3 +102,37 @@ def test_strided_slice_fold_masks():
         np.testing.assert_array_equal(out, [64, 64])
     finally:
         tfmod._attr = orig
+
+
+def test_saved_model_import(tmp_path):
+    """TF2 SavedModel directory → frozen signature → SameDiff, outputs
+    pinned to TF execution."""
+    from deeplearning4j_tpu.modelimport.tf import import_tf_saved_model
+
+    m = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(3, activation="softmax")])
+    d = str(tmp_path / "sm")
+    tf.saved_model.save(m, d)
+    sd, in_map, out_map = import_tf_saved_model(d)
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    want = np.asarray(m(x))
+    out_name = next(iter(out_map.values()))
+    got = sd.output({next(iter(in_map.values())): x}, [out_name])[out_name]
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+
+def test_saved_model_bad_signature(tmp_path):
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.modelimport.tf import (
+        TFImportError,
+        import_tf_saved_model,
+    )
+
+    m = keras.Sequential([keras.layers.Input((4,)), keras.layers.Dense(2)])
+    d = str(tmp_path / "sm")
+    tf.saved_model.save(m, d)
+    with _pytest.raises(TFImportError, match="no signature"):
+        import_tf_saved_model(d, signature="nope")
