@@ -11,6 +11,7 @@ from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
     ArrayDataSetIterator,
     AsyncDataSetIterator,
+    ShardedDataSetIterator,
     TransformIterator,
 )
 from deeplearning4j_tpu.data.audio import (
@@ -69,6 +70,7 @@ from deeplearning4j_tpu.data.image import (
 __all__ = [
     "DataSet", "MultiDataSet",
     "ArrayDataSetIterator", "AsyncDataSetIterator", "TransformIterator",
+    "ShardedDataSetIterator",
     "load_mnist", "load_cifar10", "load_cifar100", "load_emnist",
     "load_iris", "load_tiny_imagenet",
     "WavFileRecordReader", "read_wav", "spectrogram", "mfcc",

@@ -86,3 +86,73 @@ def test_two_process_bootstrap_and_psum():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc{i} failed:\n{out[-3000:]}"
         assert f"proc{i} ok" in out
+
+
+_SHARDED_ITER_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deeplearning4j_tpu.runtime import distributed
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                           process_id=pid)
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.data import (ArrayDataSetIterator,
+                                         ShardedDataSetIterator)
+
+    mesh = distributed.global_mesh()
+    # every process holds the same GLOBAL dataset; the iterator keeps only
+    # this process's row block and assembles global arrays
+    x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    y = np.arange(8, dtype=np.float32)[:, None]
+    it = ShardedDataSetIterator(
+        ArrayDataSetIterator(x, y, batch_size=4, shuffle=False),
+        mesh, P("data"))
+    batches = list(it)
+    assert len(batches) == 2
+    f0 = batches[0]["features"]
+    assert f0.shape == (4, 3), f0.shape          # GLOBAL shape
+    # local shard carries this process's half of the global batch
+    local = np.asarray(f0.addressable_data(0))
+    want_row0 = 0.0 if pid == 0 else 6.0
+    assert local[0, 0] == want_row0, (pid, local)
+    # global content round-trips: gather on 1 device and compare row sums
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    s = jax.jit(lambda a: jnp.sum(a),
+                out_shardings=NamedSharding(mesh, P()))(f0)
+    assert float(np.asarray(s.addressable_data(0))) == float(x[:4].sum())
+    distributed.barrier("done")
+    print(f"proc{pid} ok", flush=True)
+""")
+
+
+def test_two_process_sharded_iterator():
+    """ShardedDataSetIterator slices per process and assembles global
+    batches across a REAL 2-process gRPC job."""
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _SHARDED_ITER_WORKER, str(port), str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("2-process job timed out (constrained environment)")
+    if any(p.returncode != 0 for p in procs):
+        if any("UNAVAILABLE" in o or "DEADLINE" in o for o in outs):
+            pytest.skip(f"coordination service unavailable: {outs}")
+        raise AssertionError(f"worker failed:\n{outs[0]}\n{outs[1]}")
+    assert all("ok" in o for o in outs)
