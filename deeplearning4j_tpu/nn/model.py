@@ -137,10 +137,13 @@ class SequentialModel:
     # -- pure functions (traced under jit) ---------------------------------
 
     def _forward_layers(self, variables, x, *, train, rng, up_to,
-                        carries=None, tbptt=False):
-        """Shared layer loop for apply/apply_tbptt. Under ``tbptt``,
-        recurrent layers run apply_window from carries and report finals,
-        and layers whose semantics need the FULL sequence are rejected."""
+                        carries=None, tbptt=False, collect=None):
+        """Shared layer loop for apply/apply_tbptt/feed_forward. Under
+        ``tbptt``, recurrent layers run apply_window from carries and
+        report finals, and layers whose semantics need the FULL sequence
+        are rejected. ``collect``: optional list each layer's activation is
+        appended to (feed_forward's collector — one loop, no divergence
+        between reported activations and what training computes)."""
         params = variables["params"]
         state = variables["state"]
         new_state = dict(state)
@@ -165,6 +168,8 @@ class SequentialModel:
                     p, state.get(name, {}), x, train=train, rng=lrng)
             if s:
                 new_state[name] = s
+            if collect is not None:
+                collect.append(x)
         return x, new_state, new_carries
 
     @staticmethod
@@ -214,6 +219,22 @@ class SequentialModel:
         x, new_state, _ = self._forward_layers(
             variables, x, train=train, rng=rng, up_to=up_to)
         return x, new_state
+
+    def feed_forward(self, variables, x, *, train: bool = False, rng=None):
+        """Every layer's activation, input first (↔ MultiLayerNetwork.
+        feedForward's List<INDArray> contract — the data behind the
+        reference UI's activation-histogram charts and activation-based
+        debugging).
+
+        Returns ([input, act_0, ..., act_{L-1}], new_state) — a LIST, not
+        a dict, because jit canonicalizes dict key order; positions map to
+        ``layer_names`` (acts[i+1] ↔ layer i). One traced forward (the
+        same loop apply() runs); jit-safe.
+        """
+        collect: list = []
+        _, new_state, _ = self._forward_layers(
+            variables, x, train=train, rng=rng, up_to=None, collect=collect)
+        return [x] + collect, new_state
 
     def apply_tbptt(self, variables, x, carries, *, train: bool = False,
                     rng=None, up_to: Optional[int] = None):
@@ -549,6 +570,14 @@ class GraphModel:
             variables, inputs, train=train, rng=rng, exclude=set()
         )
         return {o: values[o] for o in self.config.outputs if o in values}, new_state
+
+    def feed_forward(self, variables, inputs, *, train=False, rng=None):
+        """Every vertex's activation (↔ ComputationGraph.feedForward's
+        Map<String, INDArray>): {input_name: x, vertex_name: activation}.
+        One traced forward; jit-safe (a mapping, no order contract — under
+        jit the keys come back canonically sorted)."""
+        return self._forward_values(variables, inputs, train=train, rng=rng,
+                                    exclude=set())
 
     def loss_fn(self, params, state, batch, rng=None):
         """Sum of output-layer losses (↔ ComputationGraph score with multiple

@@ -340,3 +340,84 @@ class ModelStatsListener(TrainingListener):
         if self._fh:
             self._fh.close()
             self._fh = None
+
+
+class ActivationStatsListener(TrainingListener):
+    """↔ StatsListener's activation charts: per-layer activation
+    mean-magnitudes (and optional histograms) over a fixed probe batch.
+
+    The reference collects activations from hooks inside the training
+    forward; here the train step is one donated XLA program with no
+    per-layer hook points, so the listener runs a SEPARATE jitted
+    ``model.feed_forward`` over ``probe_features`` every ``every`` steps —
+    deterministic (inference mode, fixed batch), comparable across steps,
+    and zero cost inside the compiled train step. Emits
+    {"activation_mm/<layer>": mean |activation|} to JSONL (UIServer) and/or
+    a TensorBoardWriter.
+    """
+
+    def __init__(self, probe_features, *, every: int = 10,
+                 jsonl_path: Optional[str] = None, tensorboard=None,
+                 histograms: bool = False):
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        self.probe = probe_features
+        self.every = every
+        self.jsonl_path = jsonl_path
+        self.tb = tensorboard
+        self.histograms = histograms
+        self._fwd = None
+        self._trainer = None
+        self._model = None
+        self._fh = None
+
+    def on_fit_start(self, trainer, ts):
+        model = trainer.model
+        if not hasattr(model, "feed_forward"):
+            raise TypeError(
+                f"{type(model).__name__} has no feed_forward; "
+                "ActivationStatsListener needs the container protocol")
+        self._trainer = trainer
+        self._model = model
+        self._fwd = jax.jit(
+            lambda v, x: model.feed_forward(v, x, train=False)[0])
+        if self.jsonl_path:
+            self._fh = open(self.jsonl_path, "a")
+
+    def _named_activations(self, acts):
+        """Normalize feed_forward's two shapes to (name, act) pairs with
+        inputs excluded: Sequential returns [input, act_0, ...] positional;
+        GraphModel returns {input_name/vertex_name: value}."""
+        if isinstance(acts, dict):
+            skip = set(getattr(getattr(self._model, "config", None),
+                               "inputs", ())) | {"input"}
+            return [(k, v) for k, v in acts.items() if k not in skip]
+        return list(zip(self._model.layer_names, acts[1:]))
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        if step % self.every != 0 or self._fwd is None:
+            return False
+        import numpy as np  # noqa: PLC0415 - host-side only
+
+        acts = self._fwd(self._trainer.variables(ts), self.probe)
+        rec = {"step": int(step)}
+        hists = {}
+        for name, a in self._named_activations(acts):
+            host = np.asarray(jax.device_get(a))
+            rec[f"activation_mm/{name}"] = float(np.abs(host).mean())
+            if self.histograms:
+                hists[f"activations/{name}"] = host
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self.tb is not None:
+            self.tb.add_scalars(
+                {k: v for k, v in rec.items() if k != "step"}, step=step)
+            for k, v in hists.items():
+                self.tb.add_histogram(k, v, step=step)
+        return False
+
+    def on_fit_end(self, trainer, ts):
+        if self._fh:
+            self._fh.close()
+            self._fh = None

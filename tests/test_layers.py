@@ -248,3 +248,52 @@ def test_typod_names_fail_at_build_nested_and_recurrent():
             net=NeuralNetConfiguration(), input_shape=(4, 6, 6, 2),
             layers=[ConvLSTM2D(filters=3, kernel=(3, 3),
                                recurrent_activation="sigmoidd")]))
+
+
+def test_feed_forward_returns_all_activations():
+    """↔ MultiLayerNetwork.feedForward / ComputationGraph.feedForward: the
+    per-layer activation map (UI activation histograms, debugging)."""
+    from deeplearning4j_tpu.models.lenet import lenet
+
+    model = lenet()
+    v = model.init(seed=0)
+    x = np.random.default_rng(0).normal(size=(2, 28, 28, 1)).astype(np.float32)
+    acts, _ = model.feed_forward(v, x)
+    # List contract (jit preserves list order; dicts it would re-sort):
+    # acts[0] is the input, acts[i+1] pairs with layer_names[i]
+    assert len(acts) == len(model.layers) + 1
+    np.testing.assert_allclose(np.asarray(acts[0]), x)
+    out = model.output(v, x)
+    np.testing.assert_allclose(np.asarray(acts[-1]), np.asarray(out),
+                               atol=1e-6)
+    jitted, _ = jax.jit(lambda vv, xx: model.feed_forward(vv, xx))(v, x)
+    assert len(jitted) == len(acts)
+    np.testing.assert_allclose(np.asarray(jitted[-1]), np.asarray(out),
+                               atol=1e-6)
+
+
+def test_graph_feed_forward_all_vertices():
+    from deeplearning4j_tpu.nn.config import (
+        GraphConfig,
+        GraphVertex,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import GraphModel
+
+    cfg = GraphConfig(
+        net=NeuralNetConfiguration(),
+        inputs=["in"], input_shapes={"in": (4,)},
+        vertices={
+            "h": GraphVertex(kind="layer", inputs=["in"],
+                             layer=Dense(units=8, activation="relu")),
+            "out": GraphVertex(kind="layer", inputs=["h"],
+                               layer=OutputLayer(units=2)),
+        },
+        outputs=["out"])
+    m = GraphModel(cfg)
+    v = m.init(seed=0)
+    x = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+    vals, _ = m.feed_forward(v, x)
+    assert set(vals) == {"in", "h", "out"}
+    assert vals["h"].shape == (3, 8)

@@ -356,3 +356,91 @@ class TestOpCosts:
         c = op_costs(tr.train_step, ts, batch)
         # fwd+bwd+Adam of LeNet at b8 is far beyond 1 MFLOP
         assert c["flops"] > 1e6
+
+
+class TestActivationStatsListener:
+    def test_jsonl_and_tensorboard(self, tmp_path):
+        import json as _json
+
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        from deeplearning4j_tpu.models.lenet import lenet
+        from deeplearning4j_tpu.train.listeners import (
+            ActivationStatsListener,
+        )
+        from deeplearning4j_tpu.train.tensorboard import TensorBoardWriter
+        from deeplearning4j_tpu.train.trainer import Trainer
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+        model = lenet()
+        trainer = Trainer(model)
+        ts = trainer.init_state()
+        path = tmp_path / "acts.jsonl"
+        tb = TensorBoardWriter(str(tmp_path / "tb"))
+        lst = ActivationStatsListener(x[:4], every=2, jsonl_path=str(path),
+                                      tensorboard=tb, histograms=True)
+        ts = trainer.fit(ts, ArrayDataSetIterator(x, y, batch_size=8),
+                         epochs=2, listeners=[lst])
+        tb.close()
+        rows = [_json.loads(l) for l in open(path)]
+        assert rows, "no activation reports"
+        keys = [k for k in rows[0] if k.startswith("activation_mm/")]
+        assert len(keys) == len(model.layers)
+        assert all(np.isfinite(r[k]) for r in rows for k in keys)
+
+    def test_rejects_model_without_feed_forward(self):
+        from deeplearning4j_tpu.train.listeners import (
+            ActivationStatsListener,
+        )
+
+        class FakeTrainer:
+            model = object()
+
+        lst = ActivationStatsListener(np.zeros((1, 4), np.float32))
+        import pytest
+
+        with pytest.raises(TypeError, match="feed_forward"):
+            lst.on_fit_start(FakeTrainer(), None)
+
+    def test_graph_model_inputs_excluded(self, tmp_path):
+        import json as _json
+
+        from deeplearning4j_tpu.nn.config import (
+            GraphConfig,
+            GraphVertex,
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import GraphModel
+        from deeplearning4j_tpu.train.listeners import (
+            ActivationStatsListener,
+        )
+        from deeplearning4j_tpu.train.trainer import Trainer
+
+        cfg = GraphConfig(
+            net=NeuralNetConfiguration(),
+            inputs=["in"], input_shapes={"in": (4,)},
+            vertices={
+                "h": GraphVertex(kind="layer", inputs=["in"],
+                                 layer=Dense(units=8, activation="relu")),
+                "out": GraphVertex(kind="layer", inputs=["h"],
+                                   layer=OutputLayer(units=2)),
+            },
+            outputs=["out"])
+        m = GraphModel(cfg)
+        trainer = Trainer(m)
+        ts = trainer.init_state()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        path = tmp_path / "g.jsonl"
+        lst = ActivationStatsListener(x[:2], every=1,
+                                      jsonl_path=str(path))
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+        trainer.fit(ts, ArrayDataSetIterator(x, y, batch_size=4),
+                    epochs=1, listeners=[lst])
+        rows = [_json.loads(l) for l in open(path)]
+        keys = {k for r in rows for k in r if k.startswith("activation_mm/")}
+        assert keys == {"activation_mm/h", "activation_mm/out"}  # no input
