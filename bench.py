@@ -438,6 +438,20 @@ def bert_train_flops(batch, seq, cfg, max_predictions=None) -> float:
     return 3.0 * fwd
 
 
+def gpt_train_flops(batch, seq, cfg) -> float:
+    """Matmul FLOPs for one GPT causal-LM train step.
+
+    Same encoder arithmetic as BERT (the attention score/AV GEMMs are
+    issued dense, causality is a mask) plus the tied LM head over ALL T
+    positions: 2*B*T*H*V. train = 3x fwd.
+    """
+    b, t = batch, seq
+    h, i, l, v = cfg.hidden, cfg.intermediate, cfg.num_layers, cfg.vocab_size
+    fwd = l * (8 * b * t * h * h + 4 * b * t * t * h + 4 * b * t * h * i)
+    fwd += 2 * b * t * h * v
+    return 3.0 * fwd
+
+
 def lstm_train_flops(batch, seq, hidden, vocab, layers=2) -> float:
     """GravesLSTM char-RNN: per step per layer the cell does the fused gate
     GEMM 2*(4H*(H+in)) MACs; head is 2*B*T*H*V. FLOPs = 2*MACs; train = 3x fwd.
@@ -500,6 +514,39 @@ def bench_bert(peak, *, batch_size=32, seq_len=128, warmup=4, iters=30,
         trainer, ts, batch, warmup=warmup, iters=iters,
         flops_per_step=bert_train_flops(batch_size, seq_len, model.config,
                                         max_predictions),
+        units_per_step=batch_size * seq_len, peak_flops=peak, info=info)
+    info["value"] = round(value, 1)
+    return info
+
+
+def bench_gpt(peak, *, batch_size=8, seq_len=512, warmup=3, iters=15):
+    """GPT-2-small causal-LM pretraining step (models/gpt.py): the
+    decoder-only counterpart of the BERT row. Next-token CE over all
+    positions; bf16 mixed; hardware-RNG dropout (same rationale as BERT)."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.gpt import Gpt, GptConfig
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    model = Gpt(GptConfig(
+        max_position=max(512, seq_len),
+        net=NeuralNetConfiguration(
+            updater=Adam(1e-4), mixed_precision=True, rng_impl="rbg")))
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    r = np.random.default_rng(0)
+    ids = r.integers(0, model.config.vocab_size,
+                     (batch_size, seq_len)).astype(np.int32)
+    batch = jax.device_put({"features": {"token_ids": ids}})
+
+    info = {"batch": batch_size, "seq_len": seq_len, "dtype": "bf16-mixed",
+            "unit": "tokens/sec/chip"}
+    value = _timed_train(
+        trainer, ts, batch, warmup=warmup, iters=iters,
+        flops_per_step=gpt_train_flops(batch_size, seq_len, model.config),
         units_per_step=batch_size * seq_len, peak_flops=peak, info=info)
     info["value"] = round(value, 1)
     return info
@@ -609,6 +656,9 @@ _CONFIGS = {
                                                  iters=10),
     "lstm": bench_lstm,
     "lenet": bench_lenet,
+    # GPT causal-LM (decoder-only; first recorded r4 — no baseline row yet,
+    # the first green driver value becomes the baseline per BASELINE.md).
+    "gpt": bench_gpt,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -620,6 +670,7 @@ _CPU_INTEGRITY = {
     "lstm": dict(batch_size=4, seq_len=32, hidden=64, warmup=0, iters=8),
     "bert": dict(batch_size=2, seq_len=32, warmup=0, iters=3),
     "resnet50": dict(batch_size=2, warmup=0, iters=3),
+    "gpt": dict(batch_size=2, seq_len=32, warmup=0, iters=3),
 }
 
 
@@ -676,7 +727,7 @@ def _cpu_kernel_parity():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs",
-                    default="bert,resnet50,resnet50_b128,lstm,lenet",
+                    default="bert,resnet50,resnet50_b128,lstm,lenet,gpt",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

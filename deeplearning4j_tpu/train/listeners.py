@@ -361,6 +361,10 @@ class ActivationStatsListener(TrainingListener):
                  histograms: bool = False):
         if every < 1:
             raise ValueError("every must be >= 1")
+        if histograms and tensorboard is None:
+            raise ValueError(
+                "histograms=True needs a tensorboard writer (JSONL carries "
+                "scalar magnitudes only)")
         self.probe = probe_features
         self.every = every
         self.jsonl_path = jsonl_path
@@ -381,16 +385,20 @@ class ActivationStatsListener(TrainingListener):
         self._model = model
         self._fwd = jax.jit(
             lambda v, x: model.feed_forward(v, x, train=False)[0])
+        # upload the probe once; a numpy arg would re-transfer every report
+        self._probe_dev = jax.device_put(self.probe)
         if self.jsonl_path:
             self._fh = open(self.jsonl_path, "a")
 
     def _named_activations(self, acts):
         """Normalize feed_forward's two shapes to (name, act) pairs with
         inputs excluded: Sequential returns [input, act_0, ...] positional;
-        GraphModel returns {input_name/vertex_name: value}."""
+        GraphModel returns {input_name/vertex_name: value} where
+        config.inputs names exactly the probe-seeded keys (a vertex
+        legitimately named "input" is NOT an input and must be kept)."""
         if isinstance(acts, dict):
             skip = set(getattr(getattr(self._model, "config", None),
-                               "inputs", ())) | {"input"}
+                               "inputs", ()))
             return [(k, v) for k, v in acts.items() if k not in skip]
         return list(zip(self._model.layer_names, acts[1:]))
 
@@ -399,13 +407,17 @@ class ActivationStatsListener(TrainingListener):
             return False
         import numpy as np  # noqa: PLC0415 - host-side only
 
-        acts = self._fwd(self._trainer.variables(ts), self.probe)
+        acts = self._fwd(self._trainer.variables(ts), self._probe_dev)
+        # one batched D2H for the whole activation pytree, not one blocking
+        # device_get per layer
+        acts = jax.device_get(acts)
         rec = {"step": int(step)}
         hists = {}
+        want_hists = self.histograms and self.tb is not None
         for name, a in self._named_activations(acts):
-            host = np.asarray(jax.device_get(a))
+            host = np.asarray(a)
             rec[f"activation_mm/{name}"] = float(np.abs(host).mean())
-            if self.histograms:
+            if want_hists:
                 hists[f"activations/{name}"] = host
         if self._fh:
             self._fh.write(json.dumps(rec) + "\n")
