@@ -113,6 +113,7 @@ class Trainer:
         extra_metrics: Optional[Callable] = None,
         frozen_layers: Optional[Sequence[str]] = None,
         check_nan: Optional[bool] = None,
+        grad_accum: int = 1,
     ):
         self.model = model
         self.net: NeuralNetConfiguration = model.net
@@ -153,25 +154,95 @@ class Trainer:
                 tree,
             )
 
+        def _cast_batch(batch):
+            # bf16 compute / fp32 master params + optimizer state: the
+            # cast sits inside grad, so grads come back fp32 (MXU runs
+            # bf16, accumulation and updates stay fp32).
+            if mixed:
+                return dict(batch, features=_to_bf16(batch["features"]))
+            return batch
+
+        def _grad_of(params, model_state, batch, rng):
+            """Shared loss+grad core for the plain and accumulating steps
+            (one copy of the mixed-precision param cast)."""
+            def loss_of(p):
+                if mixed:
+                    p = _to_bf16(p)
+                return self.model.loss_fn(p, model_state, batch, rng=rng)
+
+            (loss, (new_state, metrics)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            return loss, new_state, metrics, grads
+
         def train_step(ts: TrainState, batch) -> tuple[TrainState, Dict[str, jax.Array]]:
             step_rng = jax.random.fold_in(ts.rng, ts.step)
-            if mixed:
-                # bf16 compute / fp32 master params + optimizer state: the
-                # cast sits inside grad, so grads come back fp32 (MXU runs
-                # bf16, accumulation and updates stay fp32).
-                batch = dict(batch, features=_to_bf16(batch["features"]))
-
-            def loss_of(params):
-                if mixed:
-                    params = _to_bf16(params)
-                return self.model.loss_fn(params, ts.model_state, batch, rng=step_rng)
-
-            (loss, (new_model_state, metrics)), grads = jax.value_and_grad(
-                loss_of, has_aux=True
-            )(ts.params)
+            batch = _cast_batch(batch)
+            loss, new_model_state, metrics, grads = _grad_of(
+                ts.params, ts.model_state, batch, step_rng)
             return self._finish_step(
                 ts, grads, new_model_state, metrics, loss, batch)
 
+        if not isinstance(grad_accum, int) or grad_accum < 1:
+            raise ValueError(
+                f"grad_accum must be an int >= 1, got {grad_accum!r}")
+        if grad_accum > 1 and bt == "tbptt":
+            raise ValueError(
+                "grad_accum is not supported with backprop_type='tbptt' "
+                "(windows already bound the per-update memory; accumulate "
+                "by widening tbptt_length instead)")
+        self.grad_accum = grad_accum
+
+        def train_step_accum(ts: TrainState, batch):
+            """Gradient accumulation: the batch's leading dim splits into
+            ``grad_accum`` microbatches scanned INSIDE the compiled step —
+            activation memory is one microbatch's, the update sees the
+            mean gradient of the full batch (the HBM lever for effective
+            batch sizes beyond a chip's activation budget; TPU-idiomatic
+            lax.scan, not a host loop). Stateful layers (BatchNorm) see
+            microbatches sequentially, exactly like running the reference
+            on k smaller batches with one deferred update."""
+            k = self.grad_accum
+            step_rng = jax.random.fold_in(ts.rng, ts.step)
+            batch = _cast_batch(batch)
+
+            def split(leaf):
+                n = leaf.shape[0]
+                if n % k:
+                    raise ValueError(
+                        f"batch dim {n} not divisible by grad_accum {k}")
+                return leaf.reshape(k, n // k, *leaf.shape[1:])
+
+            micro = jax.tree_util.tree_map(split, batch)
+
+            def micro_grad(model_state, mb, i):
+                return _grad_of(ts.params, model_state, mb,
+                                jax.random.fold_in(step_rng, i))
+
+            # microbatch 0 outside the scan fixes the carry structures
+            mb0 = jax.tree_util.tree_map(lambda l: l[0], micro)
+            loss0, state0, metrics0, grads0 = micro_grad(
+                ts.model_state, mb0, 0)
+
+            def body(carry, xs):
+                model_state, gsum, loss_sum, msum = carry
+                i = xs
+                mb = jax.tree_util.tree_map(lambda l: l[i], micro)
+                loss, new_state, metrics, grads = micro_grad(
+                    model_state, mb, i)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                msum = jax.tree_util.tree_map(jnp.add, msum, metrics)
+                return (new_state, gsum, loss_sum + loss, msum), None
+
+            (final_state, gsum, loss_sum, msum), _ = jax.lax.scan(
+                body, (state0, grads0, loss0, metrics0),
+                jnp.arange(1, k))
+            grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+            metrics = jax.tree_util.tree_map(lambda m: m / k, msum)
+            return self._finish_step(
+                ts, grads, final_state, metrics, loss_sum / k, batch)
+
+        if self.grad_accum > 1:
+            train_step = train_step_accum
         self._raw_step = train_step  # unjitted; reused by make_chained_step
 
         def tbptt_window_step(ts: TrainState, batch, carries):
