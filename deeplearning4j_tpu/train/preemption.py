@@ -1,0 +1,106 @@
+"""Preemption-safe training (SURVEY §5.3 failure detection / recovery).
+
+The reference's recovery story is CheckpointListener + restart-from-
+checkpoint; on TPU the dominant failure is *preemption* — the scheduler
+sends SIGTERM with a grace window before reclaiming the slice. This
+listener closes the gap: on SIGTERM (and optionally SIGINT) it marks a
+flag, the fit loop checkpoints AT THE NEXT ITERATION BOUNDARY (signal
+handlers must not touch jax state — the step in flight finishes first),
+stops training cleanly, and ``resume()`` restores the latest checkpoint
+so the relaunched job continues where it left off.
+
+Usage::
+
+    handler = PreemptionCheckpointer("ckpts", model=model)
+    ts = handler.resume(trainer, ts)          # no-op on first launch
+    trainer.fit(ts, data, epochs=N, listeners=[handler, ...])
+    if handler.preempted:                     # exited early: requeue
+        sys.exit(143)
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+class PreemptionCheckpointer(TrainingListener):
+    """↔ CheckpointListener's role under preemption: save-on-SIGTERM at
+    the next safe point + resume-from-latest.
+
+    The handler only sets an Event — async-signal-safe, no jax calls —
+    and restores any previous handler on ``on_fit_end`` so nested/outer
+    SIGTERM semantics survive. ``install_sigint=True`` also catches
+    Ctrl-C the same way (finish the step, checkpoint, stop).
+    """
+
+    def __init__(self, directory: str, *, model=None, keep_last: int = 2,
+                 install_sigint: bool = False):
+        self.directory = directory
+        self.model = model
+        self.keep_last = keep_last
+        self.install_sigint = install_sigint
+        self.preempted = False
+        self._flag = threading.Event()
+        self._prev_handlers = {}
+
+    # -- resume ------------------------------------------------------------
+
+    def resume(self, trainer, ts):
+        """Restore the latest checkpoint in ``directory`` into ``ts``
+        (template) if one exists; otherwise return ``ts`` unchanged."""
+        from deeplearning4j_tpu.serde.checkpoint import (
+            latest_checkpoint,
+            restore_checkpoint,
+        )
+
+        latest = latest_checkpoint(self.directory)
+        if latest is None:
+            return ts
+        return restore_checkpoint(latest, ts)
+
+    # -- listener protocol -------------------------------------------------
+
+    def _arm(self, sig):
+        try:
+            self._prev_handlers[sig] = signal.signal(
+                sig, lambda *_: self._flag.set())
+        except ValueError:  # pragma: no cover - non-main thread
+            pass
+
+    def on_fit_start(self, trainer, ts):
+        self._flag.clear()
+        self.preempted = False
+        self._arm(signal.SIGTERM)
+        if self.install_sigint:
+            self._arm(signal.SIGINT)
+
+    def on_iteration(self, epoch, step, ts, metrics):
+        if not self._flag.is_set():
+            return False
+        from deeplearning4j_tpu.serde.checkpoint import save_checkpoint
+
+        save_checkpoint(self.directory, ts, model=self.model,
+                        tag="preempt", keep_last=self.keep_last)
+        self.preempted = True
+        return True  # stop training cleanly
+
+    def on_fit_end(self, trainer, ts):
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except ValueError:  # pragma: no cover
+                pass
+        self._prev_handlers.clear()
+
+
+def install_preemption_checkpointer(directory: str, **kw) -> Optional[
+        PreemptionCheckpointer]:
+    """Convenience: construct the listener only in the main thread (signal
+    handlers cannot be installed elsewhere); returns None off-main."""
+    if threading.current_thread() is not threading.main_thread():
+        return None
+    return PreemptionCheckpointer(directory, **kw)

@@ -205,28 +205,36 @@ class Trainer:
             step_rng = jax.random.fold_in(ts.rng, ts.step)
             batch = _cast_batch(batch)
 
-            def split(leaf):
-                n = leaf.shape[0]
-                if n % k:
-                    raise ValueError(
-                        f"batch dim {n} not divisible by grad_accum {k}")
-                return leaf.reshape(k, n // k, *leaf.shape[1:])
+            # Shapes are trace-time constants: a ragged final batch (normal
+            # at epoch end) falls back to the plain un-accumulated step for
+            # that shape instead of crashing mid-epoch — same gradients,
+            # just without the memory split for the one small batch.
+            n0 = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            if n0 % k:
+                loss, new_model_state, metrics, grads = _grad_of(
+                    ts.params, ts.model_state, batch, step_rng)
+                return self._finish_step(
+                    ts, grads, new_model_state, metrics, loss, batch)
 
-            micro = jax.tree_util.tree_map(split, batch)
+            micro = jax.tree_util.tree_map(
+                lambda l: l.reshape(k, l.shape[0] // k, *l.shape[1:]),
+                batch)
 
             def micro_grad(model_state, mb, i):
                 return _grad_of(ts.params, model_state, mb,
                                 jax.random.fold_in(step_rng, i))
 
-            # microbatch 0 outside the scan fixes the carry structures
+            # carry structures from eval_shape (costs a trace, not a second
+            # copy of the differentiated graph in the executable)
             mb0 = jax.tree_util.tree_map(lambda l: l[0], micro)
-            loss0, state0, metrics0, grads0 = micro_grad(
-                ts.model_state, mb0, 0)
+            loss_sd, _, metrics_sd, grads_sd = jax.eval_shape(
+                micro_grad, ts.model_state, mb0, 0)
+            zeros = lambda sd: jax.tree_util.tree_map(  # noqa: E731
+                lambda s: jnp.zeros(s.shape, s.dtype), sd)
 
             def body(carry, xs):
                 model_state, gsum, loss_sum, msum = carry
-                i = xs
-                mb = jax.tree_util.tree_map(lambda l: l[i], micro)
+                i, mb = xs
                 loss, new_state, metrics, grads = micro_grad(
                     model_state, mb, i)
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
@@ -234,8 +242,10 @@ class Trainer:
                 return (new_state, gsum, loss_sum + loss, msum), None
 
             (final_state, gsum, loss_sum, msum), _ = jax.lax.scan(
-                body, (state0, grads0, loss0, metrics0),
-                jnp.arange(1, k))
+                body,
+                (ts.model_state, zeros(grads_sd), zeros(loss_sd),
+                 zeros(metrics_sd)),
+                (jnp.arange(k), micro))
             grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
             metrics = jax.tree_util.tree_map(lambda m: m / k, msum)
             return self._finish_step(
@@ -250,8 +260,7 @@ class Trainer:
             gradients truncated at the window start, one parameter update
             (↔ one reference iteration), carries handed to the next window."""
             step_rng = jax.random.fold_in(ts.rng, ts.step)
-            if mixed:
-                batch = dict(batch, features=_to_bf16(batch["features"]))
+            batch = _cast_batch(batch)
 
             def loss_of(params):
                 if mixed:

@@ -1,0 +1,92 @@
+"""Preemption checkpoint/resume tests (SURVEY §5.3): SIGTERM mid-fit →
+checkpoint at the iteration boundary → clean stop → resume continues."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_TRAIN = textwrap.dedent("""
+    import os, signal, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.train.preemption import PreemptionCheckpointer
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    ckpt_dir = sys.argv[1]
+    model = lenet()
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    handler = PreemptionCheckpointer(ckpt_dir, model=model)
+    ts = handler.resume(trainer, ts)
+    start_step = int(jax.device_get(ts.step))
+    print("start_step", start_step, flush=True)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]
+
+    class SelfTerm:
+        # deliver SIGTERM to OURSELVES after step 3 (simulated preemption)
+        def on_fit_start(self, t, s): pass
+        def on_epoch_start(self, e): pass
+        def on_iteration(self, e, step, s, m):
+            if step == start_step + 3 and os.environ.get("PREEMPT") == "1":
+                os.kill(os.getpid(), signal.SIGTERM)
+            return False
+        def on_epoch_end(self, e, s): return False
+        def on_fit_end(self, t, s): pass
+
+    ts = trainer.fit(ts, ArrayDataSetIterator(x, y, batch_size=8),
+                     epochs=50, listeners=[SelfTerm(), handler])
+    print("preempted", handler.preempted, flush=True)
+    print("end_step", int(jax.device_get(ts.step)), flush=True)
+""")
+
+
+def _run(ckpt_dir, preempt):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PREEMPT="1" if preempt else "0")
+    out = subprocess.run(
+        [sys.executable, "-c", _TRAIN, str(ckpt_dir)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return dict(line.split() for line in out.stdout.splitlines()
+                if line.split()[0] in ("start_step", "preempted", "end_step"))
+
+
+def test_sigterm_checkpoints_and_resume_continues(tmp_path):
+    first = _run(tmp_path, preempt=True)
+    assert first["start_step"] == "0"
+    assert first["preempted"] == "True"
+    # stopped right after the signal step, not after 50 epochs
+    assert int(first["end_step"]) <= 6
+
+    second = _run(tmp_path, preempt=False)
+    # resumed from the preemption checkpoint, not from scratch
+    assert int(second["start_step"]) == int(first["end_step"])
+    assert second["preempted"] == "False"
+    assert int(second["end_step"]) > 300  # ran the full 50 epochs
+
+
+def test_handler_restores_previous_signal_handler():
+    from deeplearning4j_tpu.train.preemption import PreemptionCheckpointer
+
+    calls = []
+    prev = signal.signal(signal.SIGTERM, lambda *_: calls.append(1))
+    try:
+        h = PreemptionCheckpointer("unused")
+        h.on_fit_start(None, None)
+        assert signal.getsignal(signal.SIGTERM) is not prev
+        h.on_fit_end(None, None)
+        got = signal.getsignal(signal.SIGTERM)
+        assert got({}, None) is None and calls == [1]
+    finally:
+        signal.signal(signal.SIGTERM, prev)

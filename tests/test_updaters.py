@@ -190,18 +190,28 @@ class TestGradAccumulation:
         np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]),
                                    rtol=1e-5)
 
-    def test_indivisible_batch_refused(self):
-        import pytest
+    def test_indivisible_batch_falls_back_unaccumulated(self):
+        """A ragged final batch (normal at epoch end) must not crash
+        mid-epoch: the step runs un-accumulated for that shape."""
+        import jax
 
         from deeplearning4j_tpu.models.lenet import lenet
         from deeplearning4j_tpu.train.trainer import Trainer
 
-        t = Trainer(lenet(), grad_accum=3)
-        ts = t.init_state()
-        batch = {"features": np.zeros((8, 28, 28, 1), np.float32),
-                 "labels": np.zeros((8, 10), np.float32)}
-        with pytest.raises(ValueError, match="not divisible"):
-            t.train_step(ts, batch)
+        model = lenet()
+        t3 = Trainer(model, grad_accum=3)
+        t1 = Trainer(model)
+        ts3, ts1 = t3.init_state(), t1.init_state()
+        rng = np.random.default_rng(0)
+        batch = {"features": rng.normal(
+            size=(8, 28, 28, 1)).astype(np.float32),
+            "labels": np.eye(10, dtype=np.float32)[
+                rng.integers(0, 10, 8)]}
+        ts3, m3 = t3.train_step(ts3, batch)  # 8 % 3 != 0 → plain path
+        ts1, m1 = t1.train_step(ts1, batch)
+        np.testing.assert_allclose(float(jax.device_get(m3["loss"])),
+                                   float(jax.device_get(m1["loss"])),
+                                   rtol=1e-6)
 
     def test_stateful_model_trains_and_converges(self):
         """BatchNorm model under accumulation: running stats thread
