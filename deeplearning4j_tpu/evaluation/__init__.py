@@ -11,8 +11,10 @@ from deeplearning4j_tpu.evaluation.curves import (
     ROCBinary,
     ROCMultiClass,
 )
+from deeplearning4j_tpu.evaluation.lm import LMEvaluation, evaluate_lm
 from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
 
-__all__ = ["Evaluation", "EvaluationBinary", "evaluate_model",
+__all__ = [
+    "LMEvaluation", "evaluate_lm","Evaluation", "EvaluationBinary", "evaluate_model",
            "RegressionEvaluation",
            "ROC", "ROCBinary", "ROCMultiClass", "EvaluationCalibration"]

@@ -1,0 +1,87 @@
+"""Language-model evaluation (perplexity / bits-per-token).
+
+The reference's evaluation stack covers classification/regression; its
+LM examples report raw loss. With a causal-LM family in the zoo
+(models/gpt.py) the standard LM metrics belong in the evaluation module:
+on-device accumulation (sum of token NLL + token count — mergeable
+across shards/batches like Evaluation's confusion matrix), metrics
+derived at report time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops import loss as losses
+
+
+class LMEvaluation:
+    """Accumulates token-level NLL over batches; derives perplexity,
+    cross-entropy (nats and bits) per token. ``eval`` takes next-token
+    logits [N,T,V] and label ids [N,T] (+ optional 0/1 mask)."""
+
+    def __init__(self):
+        self._nll = jnp.zeros((), jnp.float64 if jax.config.jax_enable_x64
+                              else jnp.float32)
+        self._count = jnp.zeros((), jnp.float32)
+
+    def eval(self, logits, labels, mask=None):
+        per_tok = losses.sparse_softmax_cross_entropy(
+            logits, labels, reduction="none")
+        w = (jnp.ones(per_tok.shape, jnp.float32) if mask is None
+             else jnp.asarray(mask, jnp.float32))
+        self._nll = self._nll + jnp.sum(per_tok * w)
+        self._count = self._count + jnp.sum(w)
+        return self
+
+    def merge(self, other: "LMEvaluation"):
+        self._nll = self._nll + other._nll
+        self._count = self._count + other._count
+        return self
+
+    # -- derived metrics (host-side) ---------------------------------------
+
+    def token_count(self) -> float:
+        return float(jax.device_get(self._count))
+
+    def cross_entropy(self) -> float:
+        """Mean NLL per token, nats."""
+        n = self.token_count()
+        return float(jax.device_get(self._nll)) / max(n, 1.0)
+
+    def bits_per_token(self) -> float:
+        return self.cross_entropy() / float(np.log(2.0))
+
+    def perplexity(self) -> float:
+        return float(np.exp(self.cross_entropy()))
+
+    def stats(self) -> str:
+        return (f"# tokens: {int(self.token_count())}\n"
+                f"Cross entropy: {self.cross_entropy():.4f} nats "
+                f"({self.bits_per_token():.4f} bits)\n"
+                f"Perplexity:    {self.perplexity():.4f}")
+
+
+def evaluate_lm(model, variables, batches) -> LMEvaluation:
+    """Run a causal LM over an iterable of batches ({"features":
+    {"token_ids": [N,T]}, optional "mask", optional "labels"}) and
+    accumulate next-token perplexity. Labels default to ids shifted by
+    one; an explicit batch["labels"] overrides — the same convention
+    Gpt.loss_fn trains with, so eval ppl matches the training objective."""
+    ev = LMEvaluation()
+    fwd = jax.jit(lambda v, f: model.apply(v, f, train=False)[0])
+    for batch in batches:
+        labels = batch.get("labels") if isinstance(batch, dict) else None
+        feats = batch["features"] if (isinstance(batch, dict)
+                                      and "features" in batch) else batch
+        if not isinstance(feats, dict):
+            feats = {"token_ids": feats}
+        ids = jnp.asarray(feats["token_ids"])
+        logits = fwd(variables, feats)[:, :-1]
+        mask = feats.get("mask")
+        ev.eval(logits,
+                ids[:, 1:] if labels is None else jnp.asarray(labels),
+                None if mask is None else jnp.asarray(mask)[:, 1:])
+    return ev

@@ -243,13 +243,18 @@ class Gpt:
         return lg, new_caches
 
     def generate(self, variables, prime_ids, *, n_steps: int, rng,
-                 temperature: float = 1.0, max_len: Optional[int] = None):
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 max_len: Optional[int] = None):
         """Sample n_steps continuation tokens after prime_ids [N,T0].
 
         Prefill runs the cached decoder over the prime with a lax.scan
         (teacher forcing), then a second scan samples; BOTH loops live in
         one jitted program per (shape, n_steps) — no per-token dispatch.
-        temperature=0 is greedy argmax. Returns [N, n_steps] int32.
+        temperature=0 is greedy argmax; ``top_k`` keeps the k most likely
+        tokens, ``top_p`` nucleus-truncates to the smallest set with
+        cumulative probability ≥ p (both before the categorical draw;
+        combinable — top_k filters first). Returns [N, n_steps] int32.
         """
         params = variables["params"]
         n, t0 = prime_ids.shape
@@ -263,12 +268,48 @@ class Gpt:
             raise ValueError(
                 f"generation length {total} exceeds max_position "
                 f"{self.config.max_position}")
-        fn = _generate_fn_cache(self, t0, n_steps, total, float(temperature))
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # normalize no-op filters so they share the plain program's jit
+        # cache entry instead of recompiling identical behavior
+        if top_k is not None and top_k >= self.config.vocab_size:
+            top_k = None
+        if top_p is not None and top_p >= 1.0:
+            top_p = None
+        fn = _generate_fn_cache(
+            self, t0, n_steps, total, float(temperature),
+            None if top_k is None else int(top_k),
+            None if top_p is None else float(top_p))
         return fn(params, jnp.asarray(prime_ids, jnp.int32), rng)
 
 
+def _truncate_logits(lg, top_k: Optional[int], top_p: Optional[float]):
+    """Mask logits outside the top-k set and/or the nucleus (top-p) set to
+    -inf. Pure function of static (k, p); vocab axis last."""
+    neg = jnp.finfo(lg.dtype).min
+    if top_k is not None and top_k < lg.shape[-1]:
+        kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+        lg = jnp.where(lg < kth, neg, lg)
+    if top_p is not None and top_p < 1.0:
+        sorted_lg = jnp.sort(lg, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the cumulative mass BEFORE them is < p (the
+        # first token is always kept)
+        keep = jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1) < top_p
+        # threshold = smallest kept sorted logit
+        thresh = jnp.min(jnp.where(keep, sorted_lg, jnp.inf), axis=-1,
+                         keepdims=True)
+        lg = jnp.where(lg < thresh, neg, lg)
+    return lg
+
+
 def _build_generate_fn(model: Gpt, t0: int, n_steps: int, total: int,
-                       temperature: float):
+                       temperature: float, top_k: Optional[int] = None,
+                       top_p: Optional[float] = None):
     def run(params, prime, rng):
         # cache dtype follows the params (bf16 nets project bf16 K/V)
         caches = model.init_cache(
@@ -286,9 +327,12 @@ def _build_generate_fn(model: Gpt, t0: int, n_steps: int, total: int,
         def sample(lg, key):
             if temperature == 0.0:
                 return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(
-                key, lg / jnp.asarray(temperature, lg.dtype), axis=-1
-            ).astype(jnp.int32)
+            # temperature FIRST, then nucleus/top-k on the tempered
+            # distribution (standard semantics: the kept set holds mass p
+            # of the distribution actually sampled)
+            lg = lg / jnp.asarray(temperature, lg.dtype)
+            lg = _truncate_logits(lg, top_k, top_p)
+            return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
         def step(carry, i):
             caches, lg, key = carry
@@ -305,15 +349,16 @@ def _build_generate_fn(model: Gpt, t0: int, n_steps: int, total: int,
 
 
 def _generate_fn_cache(model: Gpt, t0: int, n_steps: int, total: int,
-                       temperature: float):
+                       temperature: float, top_k: Optional[int] = None,
+                       top_p: Optional[float] = None):
     """Per-model jit cache so repeated sampling never retraces."""
     cache = getattr(model, "_gen_cache", None)
     if cache is None:
         cache = model._gen_cache = {}
-    key = (t0, n_steps, total, temperature)
+    key = (t0, n_steps, total, temperature, top_k, top_p)
     if key not in cache:
         cache[key] = _build_generate_fn(model, t0, n_steps, total,
-                                        temperature)
+                                        temperature, top_k, top_p)
     return cache[key]
 
 

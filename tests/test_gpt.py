@@ -185,3 +185,99 @@ class TestGenerateValidation:
         toks = model.generate(v, jnp.zeros((1, 3), jnp.int32), n_steps=4,
                               rng=jax.random.key(0), temperature=0.0)
         assert toks.shape == (1, 4)
+
+
+class TestSamplingAndEval:
+    def test_top_k_restricts_support(self):
+        model = gpt_tiny()
+        v = model.init(seed=0)
+        prime = jnp.zeros((1, 4), jnp.int32)
+        # k=1 must equal greedy argmax regardless of temperature
+        greedy = model.generate(v, prime, n_steps=6, rng=jax.random.key(0),
+                                temperature=0.0)
+        topk1 = model.generate(v, prime, n_steps=6, rng=jax.random.key(5),
+                               temperature=1.0, top_k=1)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+    def test_top_p_one_equals_plain_sampling(self):
+        model = gpt_tiny()
+        v = model.init(seed=1)
+        prime = jnp.zeros((1, 4), jnp.int32)
+        a = model.generate(v, prime, n_steps=6, rng=jax.random.key(3),
+                           temperature=0.9)
+        b = model.generate(v, prime, n_steps=6, rng=jax.random.key(3),
+                           temperature=0.9, top_p=1.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_truncate_logits_semantics(self):
+        from deeplearning4j_tpu.models.gpt import _truncate_logits
+
+        lg = jnp.asarray([[2.0, 1.0, 0.5, -1.0]])
+        neg = jnp.finfo(lg.dtype).min
+        out = np.asarray(_truncate_logits(lg, 2, None))
+        assert (out[0, 2:] == neg).all() and (out[0, :2] == [2.0, 1.0]).all()
+        # top_p: probs ~ [.57, .21, .13, .03...]; p=0.6 keeps only token 0;
+        # p=0.85 keeps tokens 0+1+2? cum-before: [0,.57,.78,.91] < .85 ->
+        # keep first three
+        out = np.asarray(_truncate_logits(lg, None, 0.6))
+        assert (out[0, 1:] == neg).all() and out[0, 0] == 2.0
+        out = np.asarray(_truncate_logits(lg, None, 0.85))
+        assert (out[0, :3] == [2.0, 1.0, 0.5]).all() and out[0, 3] == neg
+
+    def test_bad_sampling_params_refused(self):
+        import pytest
+
+        model = gpt_tiny()
+        v = model.init(seed=0)
+        prime = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError, match="top_k"):
+            model.generate(v, prime, n_steps=2, rng=jax.random.key(0),
+                           top_k=0)
+        with pytest.raises(ValueError, match="top_p"):
+            model.generate(v, prime, n_steps=2, rng=jax.random.key(0),
+                           top_p=1.5)
+
+    def test_lm_evaluation_perplexity(self):
+        from deeplearning4j_tpu.evaluation import LMEvaluation, evaluate_lm
+
+        model = gpt_tiny()
+        v = model.init(seed=2)
+        batch = _pattern_batch(n=4, t=24)
+        ev = evaluate_lm(model, v, [batch, batch])
+        assert ev.token_count() == 2 * 4 * 23
+        # untrained model ~ uniform: ppl near vocab size, and consistent
+        # with the loss_fn's mean NLL
+        loss, _ = model.loss_fn(v["params"], {}, batch)
+        np.testing.assert_allclose(ev.cross_entropy(), float(loss),
+                                   rtol=1e-5)
+        assert 1.0 < ev.perplexity() < 2 * model.config.vocab_size
+        # merge across shards
+        ev2 = LMEvaluation().merge(ev)
+        np.testing.assert_allclose(ev2.perplexity(), ev.perplexity())
+
+    def test_noop_filters_share_cache_entry(self):
+        model = gpt_tiny()
+        v = model.init(seed=0)
+        prime = jnp.zeros((1, 3), jnp.int32)
+        a = model.generate(v, prime, n_steps=3, rng=jax.random.key(1),
+                           temperature=0.9)
+        n = len(model._gen_cache)
+        b = model.generate(v, prime, n_steps=3, rng=jax.random.key(1),
+                           temperature=0.9, top_p=1.0,
+                           top_k=model.config.vocab_size)
+        assert len(model._gen_cache) == n  # no recompile
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_labels_override_in_evaluate_lm(self):
+        from deeplearning4j_tpu.evaluation import evaluate_lm
+
+        model = gpt_tiny()
+        v = model.init(seed=3)
+        b = _pattern_batch(n=2, t=16)
+        ids = b["features"]["token_ids"]
+        labels = np.roll(ids[:, 1:], 1, axis=1).copy()
+        ev_default = evaluate_lm(model, v, [b])
+        ev_custom = evaluate_lm(
+            model, v, [{"features": b["features"], "labels": labels}])
+        assert abs(ev_default.cross_entropy()
+                   - ev_custom.cross_entropy()) > 1e-4
