@@ -121,8 +121,11 @@ class TestCachedDecode:
 
 
 class TestLongContext:
-    def test_ring_sp_training_matches_unsharded(self):
-        """gpt(sequence_parallel='ring') on a data×seq mesh: loss and grads
+    import pytest as _pytest
+
+    @_pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_sp_training_matches_unsharded(self, impl):
+        """gpt(sequence_parallel=impl) on a data×seq mesh: loss and grads
         match the unsharded model — the long-context training leg (SURVEY
         §5.7) through the full model, not just the attention op."""
         from deeplearning4j_tpu.parallel.sequence import sequence_mesh
@@ -133,8 +136,10 @@ class TestLongContext:
 
             pytest.skip("needs 8 virtual devices")
         mesh = build_mesh(MeshSpec(data=2, seq=4))
-        base = gpt_tiny()
-        sp = gpt_tiny(sequence_parallel="ring")
+        # 4 heads: ulysses scatters heads across the seq axis (needs
+        # heads % seq == 0); ring has no such constraint
+        base = gpt_tiny(num_heads=4)
+        sp = gpt_tiny(num_heads=4, sequence_parallel=impl)
         v = base.init(seed=0)
         batch = _pattern_batch(n=4, t=32)
 
