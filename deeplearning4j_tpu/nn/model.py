@@ -679,5 +679,14 @@ class GraphModel:
             )
         return self._output_jit(variables, inputs)
 
+    def output_single(self, variables, inputs):
+        """↔ ComputationGraph.outputSingle: the one output array of a
+        single-output graph (output() returns the {name: array} map)."""
+        if len(self.config.outputs) != 1:
+            raise ValueError(
+                f"output_single on a graph with outputs "
+                f"{self.config.outputs}; use output() for multi-output")
+        return self.output(variables, inputs)[self.config.outputs[0]]
+
     def num_params(self, variables) -> int:
         return sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))

@@ -156,3 +156,48 @@ def test_l2norm_zero_row_finite_gradient():
 
     g = jax.grad(f)(x)
     assert bool(jnp.all(jnp.isfinite(g))), g
+
+
+def test_output_single():
+    """↔ ComputationGraph.outputSingle: one array for single-output
+    graphs; multi-output graphs refuse."""
+    import numpy as np
+    import pytest
+
+    from deeplearning4j_tpu.nn.config import (
+        GraphConfig,
+        GraphVertex,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import GraphModel
+
+    cfg = GraphConfig(
+        net=NeuralNetConfiguration(),
+        inputs=["in"], input_shapes={"in": (4,)},
+        vertices={
+            "h": GraphVertex(kind="layer", inputs=["in"],
+                             layer=Dense(units=8)),
+            "out": GraphVertex(kind="layer", inputs=["h"],
+                               layer=OutputLayer(units=2)),
+        },
+        outputs=["out"])
+    m = GraphModel(cfg)
+    v = m.init(seed=0)
+    x = np.zeros((3, 4), np.float32)
+    single = m.output_single(v, x)
+    assert single.shape == (3, 2)
+    np.testing.assert_allclose(np.asarray(single),
+                               np.asarray(m.output(v, x)["out"]))
+    cfg2 = GraphConfig(
+        net=NeuralNetConfiguration(),
+        inputs=["in"], input_shapes={"in": (4,)},
+        vertices={
+            "a": GraphVertex(kind="layer", inputs=["in"],
+                             layer=OutputLayer(units=2)),
+            "b": GraphVertex(kind="layer", inputs=["in"],
+                             layer=OutputLayer(units=3)),
+        },
+        outputs=["a", "b"])
+    with pytest.raises(ValueError, match="multi-output"):
+        GraphModel(cfg2).output_single(GraphModel(cfg2).init(seed=0), x)
