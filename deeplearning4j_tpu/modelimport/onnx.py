@@ -1394,6 +1394,14 @@ def _fold_squeeze(node, arrs):
     return np.squeeze(arrs[0], axis=tuple(int(a) for a in axes))
 
 
+def _fold_reduce_prod(node, arrs):
+    axes = _fold_axes(node, arrs)
+    if axes is None and node.attrs().get("noop_with_empty_axes", 0):
+        return arrs[0]
+    return np.prod(arrs[0], axis=(tuple(axes) if axes else None),
+                   keepdims=bool(node.attrs().get("keepdims", 1)))
+
+
 _HOST_FOLDABLE = {
     "Gather": lambda n, a: np.take(a[0], a[1].astype(np.int64),
                                    axis=int(n.attrs().get("axis", 0))),
@@ -1408,13 +1416,7 @@ _HOST_FOLDABLE = {
     "Neg": lambda n, a: -a[0],
     "Cast": _fold_cast,
     "Slice": _fold_slice,
-    "ReduceProd": lambda n, a: (
-        a[0] if (_fold_axes(n, a) is None
-                 and n.attrs().get("noop_with_empty_axes", 0))
-        else np.prod(
-            a[0],
-            axis=(tuple(_fold_axes(n, a)) if _fold_axes(n, a) else None),
-            keepdims=bool(n.attrs().get("keepdims", 1)))),
+    "ReduceProd": _fold_reduce_prod,
     "Reshape": lambda n, a: a[0].reshape(
         [int(v) for v in np.asarray(a[1]).reshape(-1)]),
     # boolean shape-select chains (torch exports Where/Equal around

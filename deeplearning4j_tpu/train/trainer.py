@@ -229,8 +229,9 @@ class Trainer:
             mb0 = jax.tree_util.tree_map(lambda l: l[0], micro)
             loss_sd, _, metrics_sd, grads_sd = jax.eval_shape(
                 micro_grad, ts.model_state, mb0, 0)
-            zeros = lambda sd: jax.tree_util.tree_map(  # noqa: E731
-                lambda s: jnp.zeros(s.shape, s.dtype), sd)
+            def zeros(sd):
+                return jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), sd)
 
             def body(carry, xs):
                 model_state, gsum, loss_sum, msum = carry
