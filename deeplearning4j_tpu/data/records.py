@@ -105,6 +105,47 @@ class CSVRecordReader(RecordReader):
         return CollectionRecordReader(
             [list(r) for i, r in enumerate(reader) if i >= skip_lines and r])
 
+    def read_numeric(self):
+        """All-numeric fast path: the files as ONE float32 [rows, cols]
+        array (rows concatenated across paths). Uses the native mmap
+        parser (native/src/fast_io.cpp) when built — the role DataVec's
+        JavaCPP-native readers played on the ETL hot path. Files the
+        native parser can't take (library absent, skip_lines>1, or a
+        native parse error — e.g. quoted numeric fields) fall back to the
+        csv-module path, which shares __iter__'s exact dialect handling;
+        genuinely non-numeric content raises either way. Empty fields
+        parse as NaN."""
+        from deeplearning4j_tpu.data import native_csv
+
+        def python_parse(p):
+            rows = []
+            with open(p, "r", newline="") as f:
+                reader = csv.reader(f, delimiter=self.delimiter,
+                                    quotechar=self.quotechar)
+                for i, row in enumerate(reader):
+                    if i < self.skip_lines or not row:
+                        continue
+                    rows.append([float(v) if v.strip() else float("nan")
+                                 for v in row])
+            return np.asarray(rows, np.float32).reshape(len(rows), -1)
+
+        mats = []
+        for p in self.paths:
+            mat = None
+            if self.skip_lines <= 1:
+                try:
+                    mat = native_csv.read_csv_f32(
+                        p, skip_header=self.skip_lines == 1,
+                        delimiter=self.delimiter)
+                except ValueError as e:
+                    if "parse error" not in str(e):
+                        raise  # ragged/missing-file: same failure per path
+                    mat = None  # maybe quoted fields — csv path decides
+            if mat is None:
+                mat = python_parse(p)
+            mats.append(mat)
+        return mats[0] if len(mats) == 1 else np.concatenate(mats, axis=0)
+
 
 class RegexLineRecordReader(RecordReader):
     """↔ org.datavec RegexLineRecordReader: each line matched against a

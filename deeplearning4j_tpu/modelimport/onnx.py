@@ -1396,7 +1396,9 @@ def _fold_squeeze(node, arrs):
 
 def _fold_reduce_prod(node, arrs):
     axes = _fold_axes(node, arrs)
-    if axes is None and node.attrs().get("noop_with_empty_axes", 0):
+    # "empty axes" = absent attr/input OR an empty axes tensor (opset-18
+    # allows both spellings; the runtime reduce_op honors len()==0 too)
+    if not axes and node.attrs().get("noop_with_empty_axes", 0):
         return arrs[0]
     return np.prod(arrs[0], axis=(tuple(axes) if axes else None),
                    keepdims=bool(node.attrs().get("keepdims", 1)))

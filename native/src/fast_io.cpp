@@ -1,0 +1,205 @@
+// Native IO fast paths (↔ DataVec's native-backed readers: the reference
+// reads records through JavaCPP-wrapped native code — NativeImageLoader,
+// and libnd4j-backed buffer fills; SURVEY §2.4 / §2.8.12). The TPU-era
+// hot path is host-side ETL feeding the device pipeline: numeric CSV →
+// float32 batches. Python's csv+float() path is allocation-bound; this
+// parser is a single pass over an mmapped file into one preallocated
+// float32 buffer.
+//
+// C ABI (consumed by deeplearning4j_tpu/data/native_csv.py via ctypes):
+//   dl4j_csv_dims(path, skip_header, delim, *rows, *cols) -> 0 | errno-ish
+//   dl4j_csv_read_f32(path, skip_header, delim, out, rows, cols) -> 0 | err
+//
+// Error codes: 0 ok, 1 open/stat failed, 2 ragged rows, 3 parse error,
+// 4 dims mismatch. Parsing accepts leading/trailing spaces, empty fields
+// (-> NaN), scientific notation (delegates to strtof for correctness).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Mapped {
+  const char* data = nullptr;
+  size_t size = 0;
+  int fd = -1;
+  // a failed open/stat/mmap leaves fd == -1; an EMPTY file is valid and
+  // keeps its fd with data pointing at a static ""
+  bool ok() const { return fd >= 0 && data != nullptr; }
+};
+
+Mapped map_file(const char* path) {
+  Mapped m;
+  m.fd = ::open(path, O_RDONLY);
+  if (m.fd < 0) return m;
+  struct stat st;
+  if (::fstat(m.fd, &st) != 0) {
+    ::close(m.fd);
+    m.fd = -1;
+    return m;
+  }
+  m.size = static_cast<size_t>(st.st_size);
+  if (m.size == 0) {
+    m.data = "";  // empty file is a valid 0-row mapping
+    return m;
+  }
+  void* p = ::mmap(nullptr, m.size, PROT_READ, MAP_PRIVATE, m.fd, 0);
+  if (p == MAP_FAILED) {
+    ::close(m.fd);
+    m.fd = -1;
+    return m;
+  }
+  m.data = static_cast<const char*>(p);
+  return m;
+}
+
+void unmap(Mapped& m) {
+  if (m.data && m.size) ::munmap(const_cast<char*>(m.data), m.size);
+  if (m.fd >= 0) ::close(m.fd);
+}
+
+// Count fields in [line, end) separated by delim (quotes unsupported —
+// numeric CSV only; the Python reader stays the general path).
+int64_t count_fields(const char* p, const char* end, char delim) {
+  if (p == end) return 0;
+  int64_t n = 1;
+  for (; p < end; ++p)
+    if (*p == delim) ++n;
+  return n;
+}
+
+const char* next_line(const char* p, const char* end) {
+  while (p < end && *p != '\n') ++p;
+  return p;  // points at '\n' or end
+}
+
+// A line is blank when it holds only whitespace that is NOT the
+// delimiter: with a tab delimiter, "\t\t" is a row of empty fields, not
+// a blank line.
+bool line_blank(const char* p, const char* end, char delim) {
+  for (; p < end; ++p) {
+    if (*p == delim) return false;
+    if (*p != ' ' && *p != '\t' && *p != '\r') return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+__attribute__((visibility("default"))) int dl4j_csv_dims(
+    const char* path, int skip_header, char delim, int64_t* rows,
+    int64_t* cols) {
+  Mapped m = map_file(path);
+  if (!m.ok()) return 1;
+  const char* p = m.data;
+  const char* end = m.data + m.size;
+  int64_t r = 0, c = -1;
+  bool first = true;
+  while (p < end) {
+    const char* eol = next_line(p, end);
+    // skip_header drops the first PHYSICAL line (matching the Python
+    // paths' skip_lines semantics), blank or not
+    bool skip = skip_header && first;
+    first = false;
+    if (!skip && !line_blank(p, eol, delim)) {
+      int64_t n = count_fields(p, eol, delim);
+      if (c < 0) c = n;
+      else if (n != c) {
+        unmap(m);
+        return 2;
+      }
+      ++r;
+    }
+    p = eol + 1;
+  }
+  *rows = r;
+  *cols = c < 0 ? 0 : c;
+  unmap(m);
+  return 0;
+}
+
+__attribute__((visibility("default"))) int dl4j_csv_read_f32(
+    const char* path, int skip_header, char delim, float* out, int64_t rows,
+    int64_t cols) {
+  Mapped m = map_file(path);
+  if (!m.ok()) return 1;
+  const char* p = m.data;
+  const char* end = m.data + m.size;
+  int64_t r = 0;
+  bool first = true;
+  while (p < end) {
+    const char* eol = next_line(p, end);
+    bool skip = skip_header && first;
+    first = false;
+    if (!skip && !line_blank(p, eol, delim)) {
+      {
+        if (r >= rows) {
+          unmap(m);
+          return 4;
+        }
+        const char* f = p;
+        for (int64_t c = 0; c < cols; ++c) {
+          const char* fend = f;
+          while (fend < eol && *fend != delim) ++fend;
+          if (c < cols - 1 && fend >= eol) {
+            unmap(m);
+            return 2;  // ragged: fewer fields than declared
+          }
+          // trim
+          const char* a = f;
+          const char* b = fend;
+          while (a < b && (*a == ' ' || *a == '\t' || *a == '\r')) ++a;
+          while (b > a && (b[-1] == ' ' || b[-1] == '\t' || b[-1] == '\r'))
+            --b;
+          if (a == b) {
+            out[r * cols + c] = __builtin_nanf("");
+          } else {
+            // strtof needs NUL termination; fields are short — copy to a
+            // small stack buffer (correct for every float format strtof
+            // accepts, incl. exponents, inf, nan)
+            char buf[64];
+            size_t len = static_cast<size_t>(b - a);
+            if (len >= sizeof(buf)) {
+              unmap(m);
+              return 3;
+            }
+            std::memcpy(buf, a, len);
+            buf[len] = '\0';
+            char* endptr = nullptr;
+            errno = 0;
+            float v = std::strtof(buf, &endptr);
+            if (endptr == buf || *endptr != '\0') {
+              unmap(m);
+              return 3;
+            }
+            out[r * cols + c] = v;
+          }
+          f = fend + 1;
+        }
+        // after exactly `cols` fields the cursor sits past eol (the last
+        // field ends at eol, not a delimiter); f <= eol means the row had
+        // MORE fields than declared
+        if (f <= eol) {
+          unmap(m);
+          return 2;
+        }
+        ++r;
+      }
+    }
+    p = eol + 1;
+  }
+  unmap(m);
+  return r == rows ? 0 : 4;
+}
+
+}  // extern "C"
