@@ -10,11 +10,18 @@ from deeplearning4j_tpu.evaluation.curves import (
     EvaluationCalibration,
     ROCBinary,
     ROCMultiClass,
+    evaluate_roc,
 )
 from deeplearning4j_tpu.evaluation.lm import LMEvaluation, evaluate_lm
-from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+from deeplearning4j_tpu.evaluation.regression import (
+    RegressionEvaluation,
+    evaluate_regression,
+)
 
 __all__ = [
-    "LMEvaluation", "evaluate_lm","Evaluation", "EvaluationBinary", "evaluate_model",
-           "RegressionEvaluation",
-           "ROC", "ROCBinary", "ROCMultiClass", "EvaluationCalibration"]
+    "Evaluation", "EvaluationBinary", "evaluate_model",
+    "RegressionEvaluation", "evaluate_regression",
+    "ROC", "ROCBinary", "ROCMultiClass", "EvaluationCalibration",
+    "evaluate_roc",
+    "LMEvaluation", "evaluate_lm",
+]

@@ -222,3 +222,20 @@ class EvaluationCalibration:
         centers = (np.arange(self.hbins) + 0.5) / self.hbins
         # positives at prob p contribute |1-p|, negatives |p|
         return centers, pos * (1.0 - centers) + neg * centers
+
+
+def evaluate_roc(model, variables, data_iter, *, num_classes: int = 2,
+                 threshold_steps: int = 200):
+    """↔ MultiLayerNetwork.evaluateROC / evaluateROCMultiClass: run the
+    model over an iterator and accumulate ROC curves — binary ``ROC`` for
+    num_classes=2, one-vs-all ``ROCMultiClass`` otherwise."""
+    ev = (ROC(threshold_steps) if num_classes == 2
+          else ROCMultiClass(num_classes, threshold_steps))
+    for ds in data_iter:
+        out = model.output(variables, getattr(ds, "features", None)
+                           if hasattr(ds, "features") else ds["features"])
+        if isinstance(out, dict):
+            out = next(iter(out.values()))
+        labels = ds.labels if hasattr(ds, "labels") else ds["labels"]
+        ev.eval(labels, out)
+    return ev

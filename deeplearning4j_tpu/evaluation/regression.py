@@ -95,3 +95,17 @@ class RegressionEvaluation:
             f"RMSE: {np.mean(self.rmse()):.6f}\n"
             f"R^2:  {np.mean(self.r2()):.6f}"
         )
+
+
+def evaluate_regression(model, variables, data_iter,
+                        n_columns: int) -> RegressionEvaluation:
+    """↔ MultiLayerNetwork.evaluateRegression(DataSetIterator)."""
+    ev = RegressionEvaluation(n_columns)
+    for ds in data_iter:
+        feats = ds.features if hasattr(ds, "features") else ds["features"]
+        labels = ds.labels if hasattr(ds, "labels") else ds["labels"]
+        out = model.output(variables, feats)
+        if isinstance(out, dict):
+            out = next(iter(out.values()))
+        ev.eval(labels, out)
+    return ev

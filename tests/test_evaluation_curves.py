@@ -471,3 +471,70 @@ def test_top_n_masked_and_validation():
     a, b = Evaluation(3, top_n=2), Evaluation(3, top_n=3)
     with pytest.raises(ValueError, match="merge"):
         a.merge(b)
+
+
+class TestEvaluateHelpers:
+    """evaluate_roc / evaluate_regression (↔ MultiLayerNetwork.evaluateROC
+    / evaluateRegression iterator conveniences)."""
+
+    def test_evaluate_roc_binary_and_multiclass(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        from deeplearning4j_tpu.evaluation import evaluate_roc
+        from deeplearning4j_tpu.nn.config import (
+            NeuralNetConfiguration,
+            SequentialConfig,
+        )
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import SequentialModel
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 5)).astype(np.float32)
+        y2 = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(seed=0), input_shape=(5,),
+            layers=[Dense(units=8, activation="tanh"),
+                    OutputLayer(units=2)]))
+        v = model.init(seed=0)
+        roc = evaluate_roc(
+            model, v, ArrayDataSetIterator(x, y2, batch_size=32,
+                                           shuffle=False))
+        assert 0.0 <= roc.auc() <= 1.0
+
+        y3 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        model3 = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(seed=0), input_shape=(5,),
+            layers=[OutputLayer(units=3, activation="softmax")]))
+        v3 = model3.init(seed=0)
+        roc3 = evaluate_roc(
+            model3, v3, ArrayDataSetIterator(x, y3, batch_size=32,
+                                             shuffle=False),
+            num_classes=3)
+        assert 0.0 <= roc3.average_auc() <= 1.0
+
+    def test_evaluate_regression(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        from deeplearning4j_tpu.evaluation import evaluate_regression
+        from deeplearning4j_tpu.nn.config import (
+            NeuralNetConfiguration,
+            SequentialConfig,
+        )
+        from deeplearning4j_tpu.nn.layers import OutputLayer
+        from deeplearning4j_tpu.nn.model import SequentialModel
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(48, 4)).astype(np.float32)
+        y = rng.normal(size=(48, 2)).astype(np.float32)
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(seed=0), input_shape=(4,),
+            layers=[OutputLayer(units=2, activation="identity",
+                                loss="mse")]))
+        v = model.init(seed=0)
+        ev = evaluate_regression(
+            model, v, ArrayDataSetIterator(x, y, batch_size=16,
+                                           shuffle=False), n_columns=2)
+        assert np.all(np.asarray(ev.mse()) >= 0)
+        assert ev._h()["n"] == 48
