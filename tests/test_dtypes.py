@@ -55,6 +55,8 @@ SWEEP = [
                               decoder_sizes=(8,)), (9,)),
     (L.OutputLayer(units=4), (6,)),
     (L.MaskZeroLayer(), (5, 3)),
+    (L.Rescaling(scale=1 / 255.0, offset=-0.5), (6, 6, 3)),
+    (L.GlobalPooling(keepdims=True), (6, 6, 3)),
 ]
 
 _IDS = [f"{type(l).__name__}" for l, _ in SWEEP]
