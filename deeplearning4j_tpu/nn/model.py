@@ -688,5 +688,32 @@ class GraphModel:
                 f"{self.config.outputs}; use output() for multi-output")
         return self.output(variables, inputs)[self.config.outputs[0]]
 
+    def summary(self, variables=None) -> str:
+        """↔ ComputationGraph.summary(): vertex table in topological
+        order — kind, inputs, inferred output shape, param count."""
+        lines = [f"{'vertex':<20}{'kind':<18}{'inputs':<24}"
+                 f"{'out shape':<16}{'params':<10}"]
+        lines.append("=" * 88)
+        total = 0
+        for name in self.config.inputs:
+            lines.append(f"{name:<20}{'input':<18}{'-':<24}"
+                         f"{str(self.shapes[name]):<16}{0:<10}")
+        for name in self.order:
+            v = self.config.vertices[name]
+            kind = (type(v.layer).__name__ if v.kind == "layer"
+                    else v.kind)
+            n = 0
+            if variables is not None and name in variables["params"]:
+                n = sum(p.size for p in jax.tree_util.tree_leaves(
+                    variables["params"][name]))
+            total += n
+            lines.append(f"{name:<20}{kind:<18}"
+                         f"{','.join(v.inputs):<24}"
+                         f"{str(self.shapes[name]):<16}{n:<10}")
+        lines.append("=" * 88)
+        lines.append(f"total params: {total}   outputs: "
+                     f"{', '.join(self.config.outputs)}")
+        return "\n".join(lines)
+
     def num_params(self, variables) -> int:
         return sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))

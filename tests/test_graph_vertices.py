@@ -201,3 +201,34 @@ def test_output_single():
         outputs=["a", "b"])
     with pytest.raises(ValueError, match="multi-output"):
         GraphModel(cfg2).output_single(GraphModel(cfg2).init(seed=0), x)
+
+
+def test_graph_summary():
+    """↔ ComputationGraph.summary(): vertex table with param counts."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.config import (
+        GraphConfig,
+        GraphVertex,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import GraphModel
+
+    cfg = GraphConfig(
+        net=NeuralNetConfiguration(),
+        inputs=["in"], input_shapes={"in": (4,)},
+        vertices={
+            "h": GraphVertex(kind="layer", inputs=["in"],
+                             layer=Dense(units=8)),
+            "m": GraphVertex(kind="merge", inputs=["h", "in"]),
+            "out": GraphVertex(kind="layer", inputs=["m"],
+                               layer=OutputLayer(units=2)),
+        },
+        outputs=["out"])
+    m = GraphModel(cfg)
+    v = m.init(seed=0)
+    s = m.summary(v)
+    assert "Dense" in s and "merge" in s and "outputs: out" in s
+    want = m.num_params(v)
+    assert f"total params: {want}" in s
