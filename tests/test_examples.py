@@ -16,6 +16,7 @@ FAST = ["samediff_graph.py", "word2vec_similarity.py",
 SLOW = ["mnist_lenet.py", "transfer_learning.py", "bert_mlm_pretrain.py",
         "char_rnn_generation.py", "gpt_char_lm.py", "bert_finetune_classifier.py",
         "rl_dqn_cartpole.py", "data_parallel_mesh.py",
+        "long_context_ring.py",
         "hyperparameter_search.py"]
 
 
@@ -38,6 +39,6 @@ def test_fast_examples(name):
 @pytest.mark.parametrize("name", SLOW)
 def test_slow_examples(name):
     extra = {}
-    if name == "data_parallel_mesh.py":
+    if name in ("data_parallel_mesh.py", "long_context_ring.py"):
         extra["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     _run(name, extra)
