@@ -52,11 +52,12 @@ def main(quick: bool = False):
     batch = {"features": {"token_ids": ids}}
 
     steps = 12 if quick else 40
-    with sequence_mesh(mesh):  # captured at trace time by the SP layers
-        step = jax.jit(trainer._raw_step, donate_argnums=0)
+    # the SP layers capture the active mesh when the step is TRACED —
+    # first call inside the context compiles the ring program
+    with sequence_mesh(mesh):
         losses = []
         for i in range(steps):
-            ts, m = step(ts, batch)
+            ts, m = trainer.train_step(ts, batch)
             if i % 4 == 0:
                 loss = float(jax.device_get(m["loss"]))
                 losses.append(loss)
