@@ -1,0 +1,112 @@
+"""Model serving over HTTP with ParallelInference.
+
+↔ the reference's serving story (ParallelInference behind a REST
+endpoint): a stdlib HTTP server fronts ParallelInference in BATCHED mode
+— concurrent requests coalesce into padded power-of-two device batches,
+so N clients cost ~one dispatch, not N. POST /predict with
+{"features": [[...row...], ...]} returns {"predictions": [...]}.
+
+Run, then:  curl -s localhost:PORT/predict -d '{"features": [[...784 floats...]]}'
+--quick serves a few in-process requests and exits (the examples-suite
+smoke path).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401,E402 - repo path + platform override
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from deeplearning4j_tpu.models.lenet import lenet
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+
+def build_server(port: int = 0):
+    model = lenet()
+    variables = model.init(seed=0)
+    pi = ParallelInference(
+        lambda v, x: model.output(v, x), variables, mode="batched",
+        max_batch_size=64)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: N802 - stdlib API
+            pass
+
+        def do_POST(self):  # noqa: N802 - stdlib API
+            if self.path != "/predict":
+                self.send_error(404)
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                x = np.asarray(req["features"], np.float32)
+                x = x.reshape(x.shape[0], 28, 28, 1)
+                y = np.asarray(pi.output(x))
+                body = json.dumps(
+                    {"predictions": y.argmax(-1).tolist(),
+                     "probabilities": y.tolist()}).encode()
+            except Exception as e:  # noqa: BLE001 - client error surface
+                self.send_error(400, str(e)[:200])
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    return httpd, pi
+
+
+def main(quick: bool = False):
+    httpd, pi = build_server()
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    print(f"serving on http://127.0.0.1:{port}/predict")
+
+    if quick:
+        import urllib.request
+
+        rng = np.random.default_rng(0)
+        threads = []
+        results = [None] * 6
+
+        def call(i):
+            x = rng.normal(size=(2, 784)).tolist()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps({"features": x}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                results[i] = json.loads(r.read())
+
+        # concurrent clients exercise the batched coalescing path
+        for i in range(6):
+            threads.append(threading.Thread(target=call, args=(i,)))
+            threads[-1].start()
+        for th in threads:
+            th.join()
+        assert all(r and len(r["predictions"]) == 2 for r in results)
+        print("6 concurrent requests served:",
+              [r["predictions"] for r in results])
+        httpd.shutdown()
+        pi.shutdown()
+        return
+    try:
+        t.join()
+    except KeyboardInterrupt:
+        httpd.shutdown()
+        pi.shutdown()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(**vars(ap.parse_args()))

@@ -16,7 +16,7 @@ FAST = ["samediff_graph.py", "word2vec_similarity.py",
 SLOW = ["mnist_lenet.py", "transfer_learning.py", "bert_mlm_pretrain.py",
         "char_rnn_generation.py", "gpt_char_lm.py", "bert_finetune_classifier.py",
         "rl_dqn_cartpole.py", "data_parallel_mesh.py",
-        "long_context_ring.py",
+        "long_context_ring.py", "serving_http.py",
         "hyperparameter_search.py"]
 
 
