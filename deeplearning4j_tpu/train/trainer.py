@@ -114,6 +114,7 @@ class Trainer:
         frozen_layers: Optional[Sequence[str]] = None,
         check_nan: Optional[bool] = None,
         grad_accum: int = 1,
+        grad_metrics: bool = False,
     ):
         self.model = model
         self.net: NeuralNetConfiguration = model.net
@@ -191,6 +192,7 @@ class Trainer:
                 "(windows already bound the per-update memory; accumulate "
                 "by widening tbptt_length instead)")
         self.grad_accum = grad_accum
+        self.grad_metrics = bool(grad_metrics)
 
         def train_step_accum(ts: TrainState, batch):
             """Gradient accumulation: the batch's leading dim splits into
@@ -313,6 +315,15 @@ class Trainer:
         metrics["total_loss"] = loss
         feats = jax.tree_util.tree_leaves(batch["features"])
         metrics["batch_size"] = jnp.asarray(feats[0].shape[0])
+        if self.grad_metrics:
+            # per-layer gradient L2 norms, computed INSIDE the compiled
+            # step (↔ the StatsListener gradient charts; the reference
+            # pulled gradients host-side per report — here they'd be gone
+            # by then, donated)
+            for lname, g in grads.items():
+                sq = sum(jnp.sum(jnp.square(leaf))
+                         for leaf in jax.tree_util.tree_leaves(g))
+                metrics[f"grad_norm/{lname}"] = jnp.sqrt(sq)
         if self._extra_metrics is not None:
             metrics.update(self._extra_metrics(new_params, batch))
         new_ts = TrainState(

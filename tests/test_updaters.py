@@ -272,3 +272,25 @@ class TestGradAccumulation:
         cfg.net.tbptt_length = 8
         with pytest.raises(ValueError, match="tbptt"):
             Trainer(SequentialModel(cfg), grad_accum=2)
+
+
+def test_grad_metrics_per_layer_norms():
+    """Trainer(grad_metrics=True): per-layer gradient L2 norms computed
+    inside the compiled step (↔ StatsListener gradient charts)."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    model = lenet()
+    t = Trainer(model, grad_metrics=True)
+    ts = t.init_state()
+    rng = np.random.default_rng(0)
+    batch = {"features": rng.normal(size=(8, 28, 28, 1)).astype(np.float32),
+             "labels": np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]}
+    ts, m = t.train_step(ts, batch)
+    keys = [k for k in m if k.startswith("grad_norm/")]
+    assert len(keys) == len([n for n, l in model.named_layers()
+                             if getattr(l, "has_params", True)])
+    assert all(float(jax.device_get(m[k])) > 0 for k in keys)
