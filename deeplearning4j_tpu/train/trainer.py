@@ -301,6 +301,15 @@ class Trainer:
         updater, constraints, metric assembly, TrainState rebuild. Keeping
         it in ONE place is what guarantees the standard, chained, and TBPTT
         paths can never diverge on gradient handling."""
+        raw_grad_norms = {}
+        if self.grad_metrics:
+            # RAW per-layer norms, before freeze-masking and clipping —
+            # the explode/vanish diagnostic must see the gradient the
+            # model produced, not the one the clip already capped
+            for lname, g in grads.items():
+                sq = sum(jnp.sum(jnp.square(leaf))
+                         for leaf in jax.tree_util.tree_leaves(g))
+                raw_grad_norms[f"grad_norm/{lname}"] = jnp.sqrt(sq)
         grads = self._mask_frozen(grads)
         grads = _normalize_gradients(grads, self.net)
         updates, new_opt = self._upd_update(
@@ -315,15 +324,7 @@ class Trainer:
         metrics["total_loss"] = loss
         feats = jax.tree_util.tree_leaves(batch["features"])
         metrics["batch_size"] = jnp.asarray(feats[0].shape[0])
-        if self.grad_metrics:
-            # per-layer gradient L2 norms, computed INSIDE the compiled
-            # step (↔ the StatsListener gradient charts; the reference
-            # pulled gradients host-side per report — here they'd be gone
-            # by then, donated)
-            for lname, g in grads.items():
-                sq = sum(jnp.sum(jnp.square(leaf))
-                         for leaf in jax.tree_util.tree_leaves(g))
-                metrics[f"grad_norm/{lname}"] = jnp.sqrt(sq)
+        metrics.update(raw_grad_norms)
         if self._extra_metrics is not None:
             metrics.update(self._extra_metrics(new_params, batch))
         new_ts = TrainState(

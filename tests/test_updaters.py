@@ -294,3 +294,39 @@ def test_grad_metrics_per_layer_norms():
     assert len(keys) == len([n for n, l in model.named_layers()
                              if getattr(l, "has_params", True)])
     assert all(float(jax.device_get(m[k])) > 0 for k in keys)
+
+
+def test_grad_metrics_report_raw_norms_under_clipping():
+    """grad_norm/* must report the RAW gradient (pre-clip, pre-freeze) or
+    the explode-detector reads a flat capped curve (review finding)."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    cfg = SequentialConfig(
+        net=NeuralNetConfiguration(
+            updater=Sgd(0.1), seed=0,
+            gradient_normalization="renormalize_l2_per_layer"),
+        input_shape=(6,),
+        layers=[Dense(units=8, activation="tanh"), OutputLayer(units=3)])
+    model = SequentialModel(cfg)
+    t = Trainer(model, grad_metrics=True)
+    ts = t.init_state()
+    rng = np.random.default_rng(0)
+    batch = {"features": 50 * rng.normal(size=(16, 6)).astype(np.float32),
+             "labels": np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]}
+    _, m = t.train_step(ts, batch)
+    norms = sorted(float(jax.device_get(v)) for k, v in m.items()
+                   if k.startswith("grad_norm/"))
+    # renormalized grads would make every layer's reported norm exactly
+    # sqrt(#leaves); raw norms differ per layer and scale with the data
+    assert norms[0] != norms[1]
+    assert all(abs(n - np.sqrt(2)) > 1e-3 for n in norms), norms
