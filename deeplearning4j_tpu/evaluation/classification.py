@@ -171,8 +171,12 @@ class Evaluation:
     def confusion(self) -> np.ndarray:
         return self._np()
 
-    def stats(self) -> str:
-        """↔ Evaluation.stats() summary string."""
+    def stats(self, *, confusion: bool = True,
+              per_class: bool = True) -> str:
+        """↔ Evaluation.stats() summary string: headline metrics, the
+        confusion matrix (rows = actual, cols = predicted — reference
+        orientation), and per-class precision/recall/F1. Both blocks are
+        suppressible for compact logs."""
         cm = self._np()
         lines = [
             f"# examples: {int(cm.sum())}",
@@ -184,6 +188,24 @@ class Evaluation:
         if self.top_n:
             lines.append(
                 f"Top-{self.top_n} Accuracy: {self.top_n_accuracy():.4f}")
+        k = cm.shape[0]
+        if confusion:
+            w = max(5, len(str(int(cm.max()))) + 1)
+            lines.append("")
+            lines.append("Confusion matrix (rows=actual, cols=predicted):")
+            lines.append(" " * 6 + "".join(f"{c:>{w}}" for c in range(k)))
+            for r in range(k):
+                lines.append(f"{r:>5} " + "".join(
+                    f"{int(cm[r, c]):>{w}}" for c in range(k)))
+        if per_class:
+            lines.append("")
+            lines.append(f"{'class':>5}  {'precision':>9}  {'recall':>9}  "
+                         f"{'f1':>9}  {'support':>8}")
+            for c in range(k):
+                lines.append(
+                    f"{c:>5}  {self.precision(c):>9.4f}  "
+                    f"{self.recall(c):>9.4f}  {self.f1(c):>9.4f}  "
+                    f"{int(cm[c].sum()):>8}")
         return "\n".join(lines)
 
 
