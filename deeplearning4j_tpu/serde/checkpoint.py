@@ -150,6 +150,17 @@ def _place(tree, sharding):
     return jax.tree_util.tree_map(put, tree, sharding)
 
 
+def _model_config_json(model) -> str:
+    """Architecture record for config.json. SequentialConfig/GraphConfig
+    carry their own to_json; plain registered config dataclasses
+    (BertConfig, GptConfig, ...) serialize through the registry — every
+    model kind checkpoints, not just the containers."""
+    cfg = model.config
+    if hasattr(cfg, "to_json"):
+        return cfg.to_json()
+    return config_to_json(cfg)
+
+
 def _finalize_checkpoint(root: Path, name: str, step: int, tag: str,
                          keep_last: int, config_json: Optional[str]):
     """config.json + rotation-index update for a written checkpoint dir.
@@ -180,7 +191,7 @@ def save_checkpoint(directory: str | Path, train_state, *, model=None,
     save_state_tree(root / name, train_state, {"step": step, "tag": tag})
     return _finalize_checkpoint(
         root, name, step, tag, keep_last,
-        model.config.to_json() if model is not None else None)
+        _model_config_json(model) if model is not None else None)
 
 
 class AsyncCheckpointer:
@@ -217,7 +228,8 @@ class AsyncCheckpointer:
         step = int(jax.device_get(train_state.step))
         name = f"checkpoint_{step}" + (f"_{tag}" if tag else "")
         snapshot = _snapshot_tree(train_state)
-        config_json = model.config.to_json() if model is not None else None
+        config_json = (_model_config_json(model) if model is not None
+                       else None)
 
         def _write():
             _write_snapshot(root / name, *snapshot,

@@ -286,3 +286,36 @@ class TestSamplingAndEval:
             model, v, [{"features": b["features"], "labels": labels}])
         assert abs(ev_default.cross_entropy()
                    - ev_custom.cross_entropy()) > 1e-4
+
+
+class TestChain:
+    def test_train_checkpoint_restore_generate_chain(self, tmp_path):
+        """End-to-end: train → save → rebuild model FROM config.json →
+        restore state → identical greedy generations (the northstar-chain
+        pattern applied to the GPT family)."""
+        from deeplearning4j_tpu.nn.config import config_from_json
+        from deeplearning4j_tpu.serde.checkpoint import (
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        model = gpt_tiny()
+        tr = Trainer(model)
+        ts = tr.init_state()
+        batch = _pattern_batch(n=4, t=24)
+        for _ in range(10):
+            ts, _ = tr.train_step(ts, batch)
+
+        d = save_checkpoint(tmp_path, ts, model=model)
+        from deeplearning4j_tpu.serde.checkpoint import load_model_config
+
+        model2 = Gpt(load_model_config(d))
+        tr2 = Trainer(model2)
+        ts2 = restore_checkpoint(d, tr2.init_state())
+
+        prime = jnp.asarray([[7, 8, 9]], jnp.int32)
+        a = model.generate(tr.variables(ts), prime, n_steps=8,
+                           rng=jax.random.key(0), temperature=0.0)
+        b = model2.generate(tr2.variables(ts2), prime, n_steps=8,
+                            rng=jax.random.key(0), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
