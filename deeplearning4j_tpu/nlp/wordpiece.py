@@ -177,6 +177,22 @@ class BertWordPieceTokenizerFactory:
     def convert_ids_to_tokens(self, ids: Sequence[int]) -> List[str]:
         return [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
 
+    def decode(self, ids: Sequence[int], *,
+               skip_special_tokens: bool = True) -> str:
+        """ids → text: ``##`` continuations join their predecessor, other
+        tokens space-separate (the standard WordPiece detokenizer; exact
+        inverse only up to the lossy lower/accent/punct normalization)."""
+        specials = {self.cls_token, self.sep_token, self.pad_token}
+        out: List[str] = []
+        for tok in self.convert_ids_to_tokens(ids):
+            if skip_special_tokens and tok in specials:
+                continue
+            if tok.startswith("##") and out:
+                out[-1] += tok[2:]
+            else:
+                out.append(tok)
+        return " ".join(out)
+
     def encode(self, text_a: str, text_b: Optional[str] = None, *,
                max_len: int = 128) -> Dict[str, "np.ndarray"]:
         """[CLS] a [SEP] (b [SEP]) → fixed-length feature dict

@@ -322,3 +322,16 @@ class TestWordPiece:
                   text_pair="hello world the lazy dog", max_length=13,
                   padding="max_length", truncation="longest_first")
         assert list(enc["token_ids"]) == want["input_ids"]
+
+    def test_decode_joins_wordpieces(self, vocab_file):
+        from deeplearning4j_tpu.nlp import BertWordPieceTokenizerFactory
+
+        ours = BertWordPieceTokenizerFactory(vocab_file)
+        ids = ours.convert_tokens_to_ids(
+            ["[CLS]", "un", "##aff", "##able", "jump", "##s", "[SEP]"])
+        assert ours.decode(ids) == "unaffable jumps"
+        assert ours.decode(ids, skip_special_tokens=False) == \
+            "[CLS] unaffable jumps [SEP]"
+        # padded encode round-trips cleanly
+        enc = ours.encode("the quick fox", max_len=12)
+        assert ours.decode(enc["token_ids"]) == "the quick fox"
