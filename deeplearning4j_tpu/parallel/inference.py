@@ -48,8 +48,9 @@ class ParallelInference:
 
     Usage::
 
-        pi = ParallelInference(model.forward, variables,
-                               devices=jax.devices(), mode="batched")
+        pi = ParallelInference(lambda v, x: model.output(v, x),
+                               variables, devices=jax.devices(),
+                               mode="batched")
         y = pi.output(x)          # thread-safe, blocking
         pi.shutdown()
     """
