@@ -519,10 +519,14 @@ def bench_bert(peak, *, batch_size=32, seq_len=128, warmup=4, iters=30,
     return info
 
 
-def bench_gpt(peak, *, batch_size=8, seq_len=512, warmup=3, iters=15):
+def bench_gpt(peak, *, batch_size=8, seq_len=512, warmup=3, iters=15,
+              tiny=False):
     """GPT-2-small causal-LM pretraining step (models/gpt.py): the
     decoder-only counterpart of the BERT row. Next-token CE over all
-    positions; bf16 mixed; hardware-RNG dropout (same rationale as BERT)."""
+    positions; bf16 mixed; hardware-RNG dropout (same rationale as BERT).
+    ``tiny`` swaps in 2L/128H dims — the CPU config-integrity leg only
+    (a 12-layer CPU compile costs minutes the dead-relay path can't
+    afford; loss-decrease evidence doesn't need GPT-2-small dims)."""
     import jax
     import numpy as np
 
@@ -531,10 +535,13 @@ def bench_gpt(peak, *, batch_size=8, seq_len=512, warmup=3, iters=15):
     from deeplearning4j_tpu.train.trainer import Trainer
     from deeplearning4j_tpu.train.updaters import Adam
 
+    dims = (dict(hidden=128, num_layers=2, num_heads=2, intermediate=256,
+                 vocab_size=1000) if tiny else {})
     model = Gpt(GptConfig(
         max_position=max(512, seq_len),
         net=NeuralNetConfiguration(
-            updater=Adam(1e-4), mixed_precision=True, rng_impl="rbg")))
+            updater=Adam(1e-4), mixed_precision=True, rng_impl="rbg"),
+        **dims))
     trainer = Trainer(model)
     ts = trainer.init_state()
     r = np.random.default_rng(0)
@@ -670,7 +677,7 @@ _CPU_INTEGRITY = {
     "lstm": dict(batch_size=4, seq_len=32, hidden=64, warmup=0, iters=8),
     "bert": dict(batch_size=2, seq_len=32, warmup=0, iters=3),
     "resnet50": dict(batch_size=2, warmup=0, iters=3),
-    "gpt": dict(batch_size=2, seq_len=32, warmup=0, iters=3),
+    "gpt": dict(batch_size=2, seq_len=32, warmup=0, iters=3, tiny=True),
 }
 
 
