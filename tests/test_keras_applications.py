@@ -104,3 +104,21 @@ def test_normalization_explicit_stats_import(tmp_path):
     got = np.asarray(model.output(variables, x))
     want = np.asarray(m(x))
     np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+import os as _os
+
+
+@pytest.mark.skipif(_os.environ.get("DL4J_TPU_SLOW_IMPORT_TESTS") != "1",
+                    reason="set DL4J_TPU_SLOW_IMPORT_TESTS=1 (minutes of "
+                           "model building; probed green 2026-07-31)")
+@pytest.mark.parametrize("name,shape", [
+    ("DenseNet121", (64, 64, 3)),
+    ("InceptionV3", (96, 96, 3)),
+    ("Xception", (96, 96, 3)),
+    ("NASNetMobile", (96, 96, 3)),
+])
+def test_slow_applications(name, shape, tmp_path):
+    ctor = getattr(keras.applications, name)
+    _roundtrip(ctor(weights=None, input_shape=shape, classes=7), tmp_path,
+               atol=2e-5)
