@@ -48,6 +48,7 @@ from deeplearning4j_tpu.data.records import (
     LineRecordReader,
     RecordReader,
     RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
     RegexLineRecordReader,
     SequenceRecordReader,
     SVMLightRecordReader,
@@ -81,7 +82,7 @@ __all__ = [
     "NormalizerMinMaxScaler", "NormalizerStandardize",
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",
     "LineRecordReader", "SequenceRecordReader", "CSVSequenceRecordReader",
-    "RecordReaderDataSetIterator", "RegexLineRecordReader",
+    "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator", "RegexLineRecordReader",
     "JsonLineRecordReader", "SVMLightRecordReader",
     "Schema", "TransformProcess",
     "ArrowRecordReader", "read_arrow_file",
