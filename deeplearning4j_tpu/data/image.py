@@ -232,25 +232,57 @@ class ImageDataSetIterator:
     def __init__(self, reader: ImageRecordReader, batch_size: int, *,
                  transform: Optional[ImageTransform] = None,
                  shuffle: bool = True, seed: int = 0,
-                 normalizer: Optional[Callable] = None):
+                 normalizer: Optional[Callable] = None,
+                 num_workers: int = 0):
         self.reader = reader
         self.batch_size = batch_size
         self.transform = transform
         self.shuffle = shuffle
         self.normalizer = normalizer
+        # num_workers > 0: decode a batch's images on a thread pool —
+        # cv2/PIL release the GIL during JPEG decode, so workers scale on
+        # cores. This is the host-decode-throughput lever SURVEY §7.4
+        # names as the usual pod-scale input bottleneck (the reference's
+        # NativeImageLoader got the same effect from native decode +
+        # async prefetch); wrap with AsyncDataSetIterator to also overlap
+        # whole batches with device compute.
+        self.num_workers = int(num_workers)
         self._rng = np.random.default_rng(seed)
         self._label_to_idx = {l: i for i, l in enumerate(reader.labels)}
 
     def __len__(self):
         return -(-len(self.reader.paths) // self.batch_size)
 
+    def _decoded(self, order):
+        """Yield (img, label) in `order` — sequentially, or decoded ahead
+        by a worker pool with bounded lookahead (order preserved)."""
+        if self.num_workers <= 0:
+            for i in order:
+                yield self.reader.read_index(int(i))
+            return
+        import concurrent.futures as cf
+        from collections import deque
+
+        with cf.ThreadPoolExecutor(self.num_workers) as pool:
+            pending = deque()
+            lookahead = max(2 * self.num_workers, self.batch_size)
+            it = iter(order)
+            for i in it:
+                pending.append(pool.submit(self.reader.read_index, int(i)))
+                if len(pending) >= lookahead:
+                    break
+            for i in it:
+                yield pending.popleft().result()
+                pending.append(pool.submit(self.reader.read_index, int(i)))
+            while pending:
+                yield pending.popleft().result()
+
     def __iter__(self):
         order = np.arange(len(self.reader.paths))
         if self.shuffle:
             self._rng.shuffle(order)
         batch_x, batch_y = [], []
-        for i in order:
-            img, label = self.reader.read_index(int(i))
+        for img, label in self._decoded(order):
             if self.transform is not None:
                 img = self.transform(img, self._rng)
             batch_x.append(img)

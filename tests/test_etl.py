@@ -539,3 +539,35 @@ class TestMultiDataSetIterator:
             it.add_reader("csv", CSVRecordReader(p))
         with pytest.raises(ValueError, match="at least one reader"):
             list(RecordReaderMultiDataSetIterator(batch_size=2))
+
+
+class TestParallelImageDecode:
+    def test_worker_pool_matches_sequential(self, tmp_path):
+        """num_workers decode (ordered, bounded lookahead) must yield
+        byte-identical batches to the sequential path."""
+        import numpy as np
+
+        _write_images(tmp_path, per_class=7)
+        rr = ImageRecordReader(8, 8, 3).initialize(tmp_path)
+        seq = list(ImageDataSetIterator(rr, batch_size=4, shuffle=False))
+        par = list(ImageDataSetIterator(rr, batch_size=4, shuffle=False,
+                                        num_workers=4))
+        assert len(seq) == len(par)
+        for a, b in zip(seq, par):
+            np.testing.assert_array_equal(np.asarray(a.features),
+                                          np.asarray(b.features))
+            np.testing.assert_array_equal(np.asarray(a.labels),
+                                          np.asarray(b.labels))
+
+    def test_shuffled_deterministic_with_workers(self, tmp_path):
+        import numpy as np
+
+        _write_images(tmp_path, per_class=5)
+        rr = ImageRecordReader(8, 8, 3).initialize(tmp_path)
+        a = list(ImageDataSetIterator(rr, batch_size=3, shuffle=True,
+                                      seed=7, num_workers=3))
+        b = list(ImageDataSetIterator(rr, batch_size=3, shuffle=True,
+                                      seed=7, num_workers=3))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x.features),
+                                          np.asarray(y.features))
