@@ -49,6 +49,7 @@ from deeplearning4j_tpu.data.records import (
     RecordReader,
     RecordReaderDataSetIterator,
     RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
     RegexLineRecordReader,
     SequenceRecordReader,
     SVMLightRecordReader,
@@ -82,7 +83,8 @@ __all__ = [
     "NormalizerMinMaxScaler", "NormalizerStandardize",
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",
     "LineRecordReader", "SequenceRecordReader", "CSVSequenceRecordReader",
-    "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator", "RegexLineRecordReader",
+    "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator",
+    "SequenceRecordReaderDataSetIterator", "RegexLineRecordReader",
     "JsonLineRecordReader", "SVMLightRecordReader",
     "Schema", "TransformProcess",
     "ArrowRecordReader", "read_arrow_file",

@@ -263,7 +263,8 @@ class ImageDataSetIterator:
         import concurrent.futures as cf
         from collections import deque
 
-        with cf.ThreadPoolExecutor(self.num_workers) as pool:
+        pool = cf.ThreadPoolExecutor(self.num_workers)
+        try:
             pending = deque()
             lookahead = max(2 * self.num_workers, self.batch_size)
             it = iter(order)
@@ -276,6 +277,10 @@ class ImageDataSetIterator:
                 pending.append(pool.submit(self.reader.read_index, int(i)))
             while pending:
                 yield pending.popleft().result()
+        finally:
+            # early abandonment (break/exception upstream) must not stall
+            # on queued decodes
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def __iter__(self):
         order = np.arange(len(self.reader.paths))

@@ -462,3 +462,143 @@ class RecordReaderMultiDataSetIterator:
 
     def reset(self):
         pass  # fresh iterators each __iter__
+
+
+class SequenceRecordReaderDataSetIterator:
+    """↔ org.deeplearning4j.datasets.datavec.SequenceRecordReaderDataSetIterator:
+    sequence records → padded RNN minibatches with masks.
+
+    Modes (the reference's common three):
+
+    - ONE reader + ``label_index``: each timestep's column ``label_index``
+      is the per-step label (sequence labeling); remaining columns are
+      features.
+    - TWO readers (features + labels), ``align="equal_length"``: per-step
+      labels from the second reader (must match step counts).
+    - TWO readers, ``align="align_end"``: one label record per sequence
+      (sequence classification) — the label sits at the LAST live step and
+      ``labels_mask`` marks exactly that step (the reference's
+      AlignmentMode.ALIGN_END layout; pair with RnnOutputLayer + masked
+      eval, or a LastTimeStep head).
+
+    Sequences pad to the batch max length; ``features_mask`` [N,T] marks
+    live steps. ``num_classes`` one-hots integer labels; ``regression``
+    keeps them as floats.
+    """
+
+    def __init__(self, reader: SequenceRecordReader, batch_size: int, *,
+                 labels_reader: Optional[SequenceRecordReader] = None,
+                 label_index: Optional[int] = None,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False,
+                 align: str = "equal_length"):
+        if (labels_reader is None) == (label_index is None):
+            raise ValueError(
+                "exactly one of labels_reader / label_index is required")
+        if align not in ("equal_length", "align_end"):
+            raise ValueError(f"align {align!r}; "
+                             "valid: equal_length|align_end")
+        if align == "align_end" and labels_reader is None:
+            raise ValueError("align_end needs a separate labels_reader")
+        if not regression and num_classes is None:
+            raise ValueError("classification needs num_classes "
+                             "(or set regression=True)")
+        self.reader = reader
+        self.labels_reader = labels_reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.align = align
+
+    def _label_array(self, vals):
+        a = np.asarray(vals, np.float32)
+        if self.regression:
+            return a.reshape(len(vals), -1)
+        ids = a.reshape(-1).astype(np.int64)
+        if (ids < 0).any() or (ids >= self.num_classes).any():
+            raise ValueError(
+                f"label id outside [0, {self.num_classes})")
+        # O(t*C) one-hot (an np.eye would be C x C — quadratic in the
+        # label space)
+        y = np.zeros((len(ids), self.num_classes), np.float32)
+        y[np.arange(len(ids)), ids] = 1.0
+        return y
+
+    def __iter__(self):
+        self.reader.reset()
+        feats_it = iter(self.reader)
+        labs_it = None
+        if self.labels_reader is not None:
+            self.labels_reader.reset()
+            labs_it = iter(self.labels_reader)
+        while True:
+            seqs, labs = [], []
+            for _ in range(self.batch_size):
+                seq = next(feats_it, None)
+                if seq is None:
+                    break
+                lab = next(labs_it, None) if labs_it is not None else None
+                if labs_it is not None and lab is None:
+                    raise ValueError("labels reader exhausted early")
+                seqs.append(seq)
+                labs.append(lab)
+            if not seqs:
+                return
+            yield self._emit(seqs, labs)
+
+    def _emit(self, seqs, labs):
+        n = len(seqs)
+        t_max = max(len(s) for s in seqs)
+        fmask = np.zeros((n, t_max), np.float32)
+        feats = None
+        labels = None
+        lmask = np.zeros((n, t_max), np.float32)
+        for i, seq in enumerate(seqs):
+            t = len(seq)
+            fmask[i, :t] = 1.0
+            if self.label_index is not None:
+                # normalize negatives (label_index=-1 = last column, the
+                # RecordReaderDataSetIterator convention) or the filter
+                # below would silently leak the label into the features
+                li = (self.label_index if self.label_index >= 0
+                      else len(seq[0]) + self.label_index)
+                rows = [[float(v) for j, v in enumerate(r)
+                         if j != li] for r in seq]
+                lab_vals = [r[li] for r in seq]
+            else:
+                rows = [[float(v) for v in r] for r in seq]
+            if feats is None:
+                feats = np.zeros((n, t_max, len(rows[0])), np.float32)
+            feats[i, :t] = rows
+
+            if self.label_index is not None:
+                la = self._label_array(lab_vals)          # [t, C]
+                lmask[i, :t] = 1.0
+            elif self.align == "equal_length":
+                if len(labs[i]) != t:
+                    raise ValueError(
+                        f"labels sequence length {len(labs[i])} != "
+                        f"features length {t} (use align='align_end' for "
+                        "per-sequence labels)")
+                la = self._label_array([r[0] if len(r) == 1 else r
+                                        for r in labs[i]])
+                lmask[i, :t] = 1.0
+            else:  # align_end: one label record at the LAST live step
+                if len(labs[i]) != 1:
+                    raise ValueError(
+                        "align_end expects one label record per sequence")
+                la_last = self._label_array(
+                    [labs[i][0][0] if len(labs[i][0]) == 1
+                     else labs[i][0]])                    # [1, C]
+                la = np.zeros((t, la_last.shape[-1]), np.float32)
+                la[t - 1] = la_last[0]
+                lmask[i, t - 1] = 1.0
+            if labels is None:
+                labels = np.zeros((n, t_max, la.shape[-1]), np.float32)
+            labels[i, :t] = la
+        return DataSet(feats, labels, features_mask=fmask,
+                       labels_mask=lmask)
+
+    def reset(self):
+        pass  # fresh iterators each __iter__

@@ -571,3 +571,104 @@ class TestParallelImageDecode:
         for x, y in zip(a, b):
             np.testing.assert_array_equal(np.asarray(x.features),
                                           np.asarray(y.features))
+
+
+class TestSequenceDataSetIterator:
+    """SequenceRecordReaderDataSetIterator: padded [N,T,*] batches with
+    masks, in the reference's three feeding modes."""
+
+    def _seq_reader(self, seqs):
+        from deeplearning4j_tpu.data.records import SequenceRecordReader
+
+        class R(SequenceRecordReader):
+            def __iter__(self):
+                return iter([[list(map(str, r)) for r in s] for s in seqs])
+
+        return R()
+
+    def test_single_reader_per_step_labels(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data import (
+            SequenceRecordReaderDataSetIterator,
+        )
+
+        seqs = [[[0.1, 0.2, 0], [0.3, 0.4, 1]],
+                [[0.5, 0.6, 2]]]
+        it = SequenceRecordReaderDataSetIterator(
+            self._seq_reader(seqs), batch_size=2, label_index=2,
+            num_classes=3)
+        (ds,) = list(it)
+        assert ds.features.shape == (2, 2, 2)
+        assert ds.labels.shape == (2, 2, 3)
+        np.testing.assert_allclose(ds.features_mask, [[1, 1], [1, 0]])
+        np.testing.assert_allclose(ds.labels[0, 1], [0, 1, 0])
+        np.testing.assert_allclose(ds.features[1, 1], [0, 0])  # padded
+
+    def test_two_readers_align_end_classification(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data import (
+            SequenceRecordReaderDataSetIterator,
+        )
+
+        feats = [[[1, 1], [2, 2], [3, 3]], [[4, 4]]]
+        labels = [[[1]], [[0]]]
+        it = SequenceRecordReaderDataSetIterator(
+            self._seq_reader(feats), batch_size=2,
+            labels_reader=self._seq_reader(labels), num_classes=2,
+            align="align_end")
+        (ds,) = list(it)
+        # label sits at the LAST LIVE step; labels_mask marks exactly it
+        np.testing.assert_allclose(ds.labels_mask, [[0, 0, 1], [1, 0, 0]])
+        np.testing.assert_allclose(ds.labels[0, 2], [0, 1])
+        np.testing.assert_allclose(ds.labels[1, 0], [1, 0])
+        np.testing.assert_allclose(ds.labels[0, 0], [0, 0])  # masked slot
+
+    def test_two_readers_equal_length_regression(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data import (
+            SequenceRecordReaderDataSetIterator,
+        )
+
+        feats = [[[1], [2]], [[3], [4]]]
+        labels = [[[0.5], [0.6]], [[0.7], [0.8]]]
+        it = SequenceRecordReaderDataSetIterator(
+            self._seq_reader(feats), batch_size=2,
+            labels_reader=self._seq_reader(labels), regression=True)
+        (ds,) = list(it)
+        np.testing.assert_allclose(np.asarray(ds.labels).squeeze(-1),
+                                   [[0.5, 0.6], [0.7, 0.8]])
+
+    def test_misconfigurations_refused(self):
+        import pytest
+
+        from deeplearning4j_tpu.data import (
+            SequenceRecordReaderDataSetIterator,
+        )
+
+        r = self._seq_reader([[[1, 0]]])
+        with pytest.raises(ValueError, match="exactly one"):
+            SequenceRecordReaderDataSetIterator(r, 1)
+        with pytest.raises(ValueError, match="num_classes"):
+            SequenceRecordReaderDataSetIterator(r, 1, label_index=1)
+        with pytest.raises(ValueError, match="align_end needs"):
+            SequenceRecordReaderDataSetIterator(
+                r, 1, label_index=1, num_classes=2, align="align_end")
+
+    def test_negative_label_index_excluded_from_features(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data import (
+            SequenceRecordReaderDataSetIterator,
+        )
+
+        seqs = [[[0.1, 0.2, 1], [0.3, 0.4, 0]]]
+        it = SequenceRecordReaderDataSetIterator(
+            self._seq_reader(seqs), batch_size=1, label_index=-1,
+            num_classes=2)
+        (ds,) = list(it)
+        assert ds.features.shape == (1, 2, 2)  # label column excluded
+        np.testing.assert_allclose(ds.features[0, 0], [0.1, 0.2])
+        np.testing.assert_allclose(ds.labels[0, 0], [0, 1])
