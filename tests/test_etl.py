@@ -672,3 +672,53 @@ class TestSequenceDataSetIterator:
         assert ds.features.shape == (1, 2, 2)  # label column excluded
         np.testing.assert_allclose(ds.features[0, 0], [0.1, 0.2])
         np.testing.assert_allclose(ds.labels[0, 0], [0, 1])
+
+    def test_align_end_trains_rnn_classifier(self):
+        """ALIGN_END batches (labels_mask marking the final live step)
+        drive masked RnnOutputLayer training end to end and the model
+        learns a first-step-determines-class rule."""
+        import jax
+        import numpy as np
+
+        from deeplearning4j_tpu.data import (
+            SequenceRecordReaderDataSetIterator,
+        )
+        from deeplearning4j_tpu.nn.config import (
+            NeuralNetConfiguration,
+            SequentialConfig,
+        )
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        from deeplearning4j_tpu.nn.model import SequentialModel
+        from deeplearning4j_tpu.train.trainer import Trainer
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        rng = np.random.default_rng(0)
+        feats, labels = [], []
+        for _ in range(32):
+            y = int(rng.integers(0, 2))
+            t = int(rng.integers(3, 7))
+            seq = rng.normal(scale=0.1, size=(t, 4))
+            seq[0, 0] = 3.0 if y else -3.0  # class signal at step 0
+            feats.append(seq.tolist())
+            labels.append([[y]])
+        it = SequenceRecordReaderDataSetIterator(
+            self._seq_reader(feats), batch_size=8,
+            labels_reader=self._seq_reader(labels), num_classes=2,
+            align="align_end")
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(updater=Adam(5e-3), seed=0),
+            input_shape=(6, 4),
+            layers=[LSTM(units=16, return_sequences=True),
+                    RnnOutputLayer(units=2)]))
+        tr = Trainer(model)
+        ts = tr.init_state()
+        first = last = None
+        for epoch in range(30):
+            for ds in it:
+                ts, m = tr.train_step(
+                    ts, {"features": ds.features, "labels": ds.labels,
+                         "mask": ds.labels_mask})
+                loss = float(jax.device_get(m["loss"]))
+                first = loss if first is None else first
+                last = loss
+        assert last < first * 0.3, (first, last)
