@@ -49,12 +49,18 @@ from deeplearning4j_tpu.data.records import (
     RecordReader,
     RecordReaderDataSetIterator,
     RecordReaderMultiDataSetIterator,
+    CollectionSequenceRecordReader,
     SequenceRecordReaderDataSetIterator,
     RegexLineRecordReader,
     SequenceRecordReader,
     SVMLightRecordReader,
 )
-from deeplearning4j_tpu.data.transform import Schema, TransformProcess
+from deeplearning4j_tpu.data.transform import (
+    Schema,
+    TransformProcess,
+    convert_to_sequence,
+    sliding_windows,
+)
 from deeplearning4j_tpu.data.arrow import ArrowRecordReader, read_arrow_file
 from deeplearning4j_tpu.data.geo import (
     CoordinatesDistanceTransform,
@@ -84,9 +90,9 @@ __all__ = [
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",
     "LineRecordReader", "SequenceRecordReader", "CSVSequenceRecordReader",
     "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator",
-    "SequenceRecordReaderDataSetIterator", "RegexLineRecordReader",
+    "SequenceRecordReaderDataSetIterator", "CollectionSequenceRecordReader", "RegexLineRecordReader",
     "JsonLineRecordReader", "SVMLightRecordReader",
-    "Schema", "TransformProcess",
+    "Schema", "TransformProcess", "convert_to_sequence", "sliding_windows",
     "ArrowRecordReader", "read_arrow_file",
     "CoordinatesDistanceTransform", "GeoJsonPointReader", "haversine_m",
     "ImageRecordReader", "ImageDataSetIterator",

@@ -249,6 +249,18 @@ class SequenceRecordReader:
         pass
 
 
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    """↔ CollectionSequenceRecordReader: sequences from memory — the
+    bridge from transform.convert_to_sequence/sliding_windows output to
+    the padded-batch iterator."""
+
+    def __init__(self, sequences):
+        self.sequences = list(sequences)
+
+    def __iter__(self):
+        return iter(self.sequences)
+
+
 class CSVSequenceRecordReader(SequenceRecordReader):
     """↔ CSVSequenceRecordReader: one CSV file per sequence."""
 

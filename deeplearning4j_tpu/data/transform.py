@@ -586,3 +586,77 @@ def reduce_by_key(records, schema: Schema, *, key: Union[str, Sequence[str]],
             rec.append(_REDUCE_OPS[op](vals))
         out.append(rec)
     return out, out_schema
+
+
+def convert_to_sequence(records, schema: Schema, *,
+                        key: Union[str, Sequence[str]],
+                        order_by: Optional[str] = None,
+                        numeric_order: bool = True,
+                        ascending: bool = True):
+    """↔ TransformProcess.convertToSequence(keyCols, comparator): group a
+    flat record stream by key column(s) into SEQUENCE records, ordered
+    within each group by ``order_by`` (numeric or lexicographic).
+
+    Returns (sequences, keys, out_schema): ``sequences`` is a list of
+    sequence records (each a list of records, key columns REMOVED — they
+    are the sequence's identity; ``keys`` carries them in the same
+    order); ``out_schema`` describes the per-step columns after key
+    removal (reduce_by_key's convention — downstream label_index math
+    needs it). Feed the result to a CollectionSequenceRecordReader →
+    SequenceRecordReaderDataSetIterator for padded RNN batches.
+    """
+    keys = [key] if isinstance(key, str) else list(key)
+    kidx = [schema.index_of(k) for k in keys]
+    oidx = schema.index_of(order_by) if order_by is not None else None
+    if oidx is not None and numeric_order and             schema.column(order_by).type == "string":
+        raise ValueError(
+            f"order_by column {order_by!r} is a string column; pass "
+            "numeric_order=False for lexicographic ordering")
+    groups: Dict[tuple, list] = {}
+    for rec in records:
+        groups.setdefault(tuple(rec[i] for i in kidx), []).append(rec)
+    drop = set(kidx)
+    out_schema = Schema([dataclasses.replace(c)
+                         for i, c in enumerate(schema.columns)
+                         if i not in drop])
+    out_seqs, out_keys = [], []
+    for gk, rows in groups.items():  # dicts preserve insertion order
+        if oidx is not None:
+            try:
+                sort_key = ((lambda r: float(r[oidx])) if numeric_order
+                            else (lambda r: str(r[oidx])))
+                rows = sorted(rows, key=sort_key, reverse=not ascending)
+            except ValueError as e:
+                raise ValueError(
+                    f"order_by column {order_by!r} has non-numeric "
+                    f"values; pass numeric_order=False ({e})") from None
+        out_seqs.append([[v for i, v in enumerate(r) if i not in drop]
+                         for r in rows])
+        out_keys.append(gk if len(gk) > 1 else gk[0])
+    return out_seqs, out_keys, out_schema
+
+
+def sliding_windows(sequences, *, size: int, step: Optional[int] = None,
+                    drop_last: bool = True):
+    """↔ the reference's time-window functions (OverlappingTimeWindow in
+    spirit, index-based): split each sequence record into windows of
+    ``size`` steps advancing by ``step`` (default: non-overlapping).
+    ``drop_last=False`` keeps a shorter tail window."""
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+    step = size if step is None else step
+    if step < 1:
+        raise ValueError(f"step must be >= 1, got {step}")
+    out = []
+    for seq in sequences:
+        i = 0
+        while i < len(seq):
+            win = seq[i:i + size]
+            if len(win) == size:
+                out.append(win)
+                i += step
+            else:  # tail shorter than size: keep at most one, if asked
+                if not drop_last:
+                    out.append(win)
+                break
+    return out

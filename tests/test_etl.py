@@ -722,3 +722,67 @@ class TestSequenceDataSetIterator:
                 first = loss if first is None else first
                 last = loss
         assert last < first * 0.3, (first, last)
+
+
+class TestSequenceTransforms:
+    """convert_to_sequence + sliding_windows (↔ TransformProcess
+    convertToSequence + time-window functions) feeding the padded-batch
+    iterator end to end."""
+
+    def test_group_order_and_key_removal(self):
+        from deeplearning4j_tpu.data import Schema, convert_to_sequence
+
+        s = (Schema().add_string_column("id").add_double_column("t")
+                     .add_double_column("v"))
+        recs = [["a", "2", "20"], ["b", "1", "100"], ["a", "1", "10"],
+                ["b", "2", "200"], ["a", "3", "30"]]
+        seqs, keys, out_s = convert_to_sequence(recs, s, key="id",
+                                                order_by="t")
+        assert keys == ["a", "b"]
+        assert out_s.names() == ["t", "v"]  # key column removed
+        assert seqs[0] == [["1", "10"], ["2", "20"], ["3", "30"]]
+        assert seqs[1] == [["1", "100"], ["2", "200"]]
+        # descending + lexicographic
+        seqs2, _, _ = convert_to_sequence(recs, s, key="id", order_by="t",
+                                          ascending=False)
+        assert seqs2[0][0] == ["3", "30"]
+
+    def test_sliding_windows(self):
+        from deeplearning4j_tpu.data import sliding_windows
+
+        seq = [[i] for i in range(7)]
+        assert sliding_windows([seq], size=3) == \
+            [[[0], [1], [2]], [[3], [4], [5]]]
+        assert sliding_windows([seq], size=3, step=2) == \
+            [[[0], [1], [2]], [[2], [3], [4]], [[4], [5], [6]]]
+        tail = sliding_windows([seq], size=4, drop_last=False)
+        assert tail[-1] == [[4], [5], [6]]
+        import pytest
+
+        with pytest.raises(ValueError, match="size"):
+            sliding_windows([seq], size=0)
+
+    def test_chain_to_padded_batches(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data import (
+            CollectionSequenceRecordReader,
+            Schema,
+            SequenceRecordReaderDataSetIterator,
+            convert_to_sequence,
+        )
+
+        s = (Schema().add_string_column("sensor")
+                     .add_double_column("t").add_double_column("x")
+                     .add_double_column("y"))
+        recs = [["s1", 1, 0.1, 0], ["s1", 2, 0.2, 1], ["s2", 1, 0.3, 1],
+                ["s1", 3, 0.3, 0], ["s2", 2, 0.4, 0]]
+        seqs, _, _ = convert_to_sequence(
+            [list(map(str, r)) for r in recs], s, key="sensor",
+            order_by="t")
+        it = SequenceRecordReaderDataSetIterator(
+            CollectionSequenceRecordReader(seqs), batch_size=2,
+            label_index=-1, num_classes=2)
+        (ds,) = list(it)
+        assert ds.features.shape == (2, 3, 2)   # (t, x) cols
+        np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [1, 1, 0]])
