@@ -122,3 +122,57 @@ def test_slow_applications(name, shape, tmp_path):
     ctor = getattr(keras.applications, name)
     _roundtrip(ctor(weights=None, input_shape=shape, classes=7), tmp_path,
                atol=2e-5)
+
+
+def test_imported_mobilenet_transfer_finetune(tmp_path):
+    """The classic reference workflow end to end: import a real Keras
+    architecture, re-head it with GraphTransferLearning, freeze the
+    backbone, fine-tune — frozen params stay bit-identical, the new head
+    learns."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.transfer import GraphTransferLearning
+
+    m = keras.applications.MobileNet(weights=None, input_shape=(32, 32, 3),
+                                     classes=9, alpha=0.25)
+    p = str(tmp_path / "m.h5")
+    m.save(p)
+    model, variables = import_keras_model(p)
+
+    from deeplearning4j_tpu.nn.config import GraphVertex
+    from deeplearning4j_tpu.nn.layers import Flatten, OutputLayer
+
+    # drop the old 9-way head (conv_preds + its hardcoded reshape +
+    # softmax) and put on a fresh 4-way head; freeze the whole backbone
+    new_model, new_vars, frozen = (
+        GraphTransferLearning(model, variables)
+        .set_feature_extractor("dropout")        # freeze everything before
+        .remove_vertex("conv_preds")             # + reshape_2, predictions
+        .add_vertex("flat", GraphVertex(kind="layer", inputs=["dropout"],
+                                        layer=Flatten()))
+        .add_vertex("head", GraphVertex(kind="layer", inputs=["flat"],
+                                        layer=OutputLayer(units=4)))
+        .set_outputs("head")
+        .build())
+    assert "head" not in frozen and len(frozen) > 20
+
+    tr = Trainer(new_model, frozen_layers=frozen)
+    ts = tr.init_state(variables=new_vars)
+    frozen_before = {n: np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(ts.params[n])[0])).copy()
+        for n in list(frozen)[:3]}
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    losses = []
+    for _ in range(8):
+        ts, mtr = tr.train_step(
+            ts, {"features": x, "labels": {new_model.config.outputs[0]: y}})
+        losses.append(float(jax.device_get(mtr["loss"])))
+    assert losses[-1] < losses[0], losses
+    for n, before in frozen_before.items():
+        after = np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(ts.params[n])[0]))
+        np.testing.assert_array_equal(before, after)
