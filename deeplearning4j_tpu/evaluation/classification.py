@@ -358,8 +358,10 @@ def evaluate_model(model, variables, data_iter, num_classes: int,
 
     cm = ev.cm
     for batch in data_iter:
-        feats = batch.features if hasattr(batch, "features") else batch[0]
-        labels = batch.labels if hasattr(batch, "labels") else batch[1]
+        from deeplearning4j_tpu.data.dataset import as_batch_dict
+
+        b = as_batch_dict(batch)  # DataSet-likes, (x,y), or dict batches
+        feats, labels = b["features"], b["labels"]
         use = step
         if mesh is not None and len(feats) % n_shards != 0:
             # partial tail batch (drop_last=False): not shardable over the

@@ -212,3 +212,39 @@ class TestShardedDataSetIterator:
             mesh, P("data")), prefetch=2)
         got = [b["features"].shape for b in it]
         assert got == [(16, 4), (16, 4)]
+
+
+def test_sharded_pipeline_composes_with_sharded_eval():
+    """ShardedDataSetIterator batches feed the mesh-sharded evaluate_model
+    path (global arrays in, psum'd confusion matrix out)."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.data import (
+        ArrayDataSetIterator,
+        ShardedDataSetIterator,
+    )
+    from deeplearning4j_tpu.evaluation import evaluate_model
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.runtime.device import MeshSpec, build_mesh
+
+    if len(jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs 8 virtual devices")
+    mesh = build_mesh(MeshSpec(data=8))
+    model = lenet()
+    v = model.init(seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]
+    plain_it = ArrayDataSetIterator(x, y, batch_size=32, shuffle=False)
+    sharded_it = ShardedDataSetIterator(
+        ArrayDataSetIterator(x, y, batch_size=32, shuffle=False),
+        mesh, P("data"))
+    ev_plain = evaluate_model(model, v, plain_it, num_classes=10)
+    ev_sharded = evaluate_model(model, v, sharded_it, num_classes=10,
+                                mesh=mesh)
+    np.testing.assert_array_equal(ev_plain.confusion(),
+                                  ev_sharded.confusion())
