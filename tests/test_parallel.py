@@ -248,3 +248,51 @@ def test_sharded_pipeline_composes_with_sharded_eval():
                                 mesh=mesh)
     np.testing.assert_array_equal(ev_plain.confusion(),
                                   ev_sharded.confusion())
+
+
+def test_fsdp_composes_with_grad_accum():
+    """FSDP-sharded state + in-step gradient accumulation: training
+    matches the unsharded, unaccumulated reference run."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.parallel.specs import (
+        batch_spec,
+        fsdp_plan,
+        train_state_sharding,
+    )
+    from deeplearning4j_tpu.runtime.device import MeshSpec, build_mesh
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    if len(jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs 8 virtual devices")
+    mesh = build_mesh(MeshSpec(data=8))
+    model = lenet()
+    template = Trainer(model).init_state()
+    params_sh, batch_sh = fsdp_plan(mesh, template.params)
+    state_sh = train_state_sharding(mesh, template, params_sh)
+    tr_f = Trainer(model, mesh=mesh, state_sharding=state_sh,
+                   batch_sharding=batch_sh, grad_accum=2)
+    ts_f = jax.device_put(template, state_sh)
+
+    tr_1 = Trainer(model)
+    ts_1 = tr_1.init_state()
+
+    rng = np.random.default_rng(0)
+    batch = {"features": rng.normal(
+        size=(16, 28, 28, 1)).astype(np.float32),
+        "labels": np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]}
+    for _ in range(3):
+        ts_f, mf = tr_f.train_step(ts_f, batch)
+        ts_1, m1 = tr_1.train_step(ts_1, batch)
+    np.testing.assert_allclose(float(jax.device_get(mf["loss"])),
+                               float(jax.device_get(m1["loss"])),
+                               rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ts_1.params),
+                    jax.tree_util.tree_leaves(ts_f.params)):
+        np.testing.assert_allclose(np.asarray(jax.device_get(a)),
+                                   np.asarray(jax.device_get(b)),
+                                   atol=3e-5)
