@@ -258,7 +258,6 @@ def test_fsdp_composes_with_grad_accum():
 
     from deeplearning4j_tpu.models.lenet import lenet
     from deeplearning4j_tpu.parallel.specs import (
-        batch_spec,
         fsdp_plan,
         train_state_sharding,
     )
