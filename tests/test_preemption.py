@@ -90,3 +90,36 @@ def test_handler_restores_previous_signal_handler():
         assert got({}, None) is None and calls == [1]
     finally:
         signal.signal(signal.SIGTERM, prev)
+
+
+def test_preemption_handler_coexists_with_async_checkpoints(tmp_path):
+    """A normal fit with BOTH an async CheckpointListener and the
+    preemption handler installed: no signal fires, training completes,
+    rotation works, and handlers restore cleanly."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.serde.checkpoint import latest_checkpoint
+    from deeplearning4j_tpu.train.listeners import CheckpointListener
+    from deeplearning4j_tpu.train.preemption import PreemptionCheckpointer
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    model = lenet()
+    trainer = Trainer(model)
+    handler = PreemptionCheckpointer(str(tmp_path / "pre"), model=model)
+    ts = handler.resume(trainer, trainer.init_state())
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)]
+    ckpt = CheckpointListener(str(tmp_path / "rot"), every_epochs=1,
+                              keep_last=2, model=model, async_save=True)
+    ts = trainer.fit(ts, ArrayDataSetIterator(x, y, batch_size=16),
+                     epochs=3, listeners=[handler, ckpt])
+    assert not handler.preempted
+    assert latest_checkpoint(tmp_path / "rot").endswith("epoch2")
+    assert latest_checkpoint(tmp_path / "pre") is None  # never preempted
+    assert int(jax.device_get(ts.step)) == 6
