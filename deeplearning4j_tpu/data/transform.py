@@ -608,7 +608,8 @@ def convert_to_sequence(records, schema: Schema, *,
     keys = [key] if isinstance(key, str) else list(key)
     kidx = [schema.index_of(k) for k in keys]
     oidx = schema.index_of(order_by) if order_by is not None else None
-    if oidx is not None and numeric_order and             schema.column(order_by).type == "string":
+    if (oidx is not None and numeric_order
+            and schema.column(order_by).type == "string"):
         raise ValueError(
             f"order_by column {order_by!r} is a string column; pass "
             "numeric_order=False for lexicographic ordering")

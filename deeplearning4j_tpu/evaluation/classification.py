@@ -323,7 +323,8 @@ class EvaluationBinary:
 
 
 def evaluate_model(model, variables, data_iter, num_classes: int,
-                   mesh=None) -> Evaluation:
+                   mesh=None,
+                   output_name: Optional[str] = None) -> Evaluation:
     """↔ MultiLayerNetwork.evaluate(DataSetIterator).
 
     The per-batch statistic (forward + confusion accumulation) is ONE jit'd
@@ -331,15 +332,17 @@ def evaluate_model(model, variables, data_iter, num_classes: int,
     the loop (SURVEY §5.5). With ``mesh``, the same program pjits over the
     data axis: parameters replicated, batch sharded, and the confusion
     accumulation psums across shards via GSPMD (the reference's
-    distributed-eval aggregation without explicit collectives)."""
+    distributed-eval aggregation without explicit collectives). For
+    multi-output graph models pass ``output_name`` to pick the head."""
     import jax
+
+    from deeplearning4j_tpu.evaluation.util import select_output
 
     ev = Evaluation(num_classes)
 
     def eval_step(cm, variables, feats, labels):
         out = model.output(variables, feats)
-        if isinstance(out, dict):
-            out = next(iter(out.values()))
+        out = select_output(out, output_name, "evaluate_model")
         return _confusion_update(cm, out, labels)
 
     jit_kwargs = {}

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.evaluation.util import select_output
+
 _trapz = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 compat
 
 
@@ -225,17 +227,18 @@ class EvaluationCalibration:
 
 
 def evaluate_roc(model, variables, data_iter, *, num_classes: int = 2,
-                 threshold_steps: int = 200):
+                 threshold_steps: int = 200,
+                 output_name: Optional[str] = None):
     """↔ MultiLayerNetwork.evaluateROC / evaluateROCMultiClass: run the
     model over an iterator and accumulate ROC curves — binary ``ROC`` for
-    num_classes=2, one-vs-all ``ROCMultiClass`` otherwise."""
+    num_classes=2, one-vs-all ``ROCMultiClass`` otherwise. For multi-output
+    graph models pass ``output_name`` to pick the head to evaluate."""
     ev = (ROC(threshold_steps) if num_classes == 2
           else ROCMultiClass(num_classes, threshold_steps))
     for ds in data_iter:
         out = model.output(variables, getattr(ds, "features", None)
                            if hasattr(ds, "features") else ds["features"])
-        if isinstance(out, dict):
-            out = next(iter(out.values()))
+        out = select_output(out, output_name, "evaluate_roc")
         labels = ds.labels if hasattr(ds, "labels") else ds["labels"]
         ev.eval(labels, out)
     return ev

@@ -6,9 +6,13 @@ PC (Pearson correlation), R². Accumulated with streaming sums on device.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deeplearning4j_tpu.evaluation.util import select_output
 
 
 @jax.jit
@@ -98,14 +102,16 @@ class RegressionEvaluation:
 
 
 def evaluate_regression(model, variables, data_iter,
-                        n_columns: int) -> RegressionEvaluation:
-    """↔ MultiLayerNetwork.evaluateRegression(DataSetIterator)."""
+                        n_columns: int, *,
+                        output_name: Optional[str] = None,
+                        ) -> RegressionEvaluation:
+    """↔ MultiLayerNetwork.evaluateRegression(DataSetIterator). For
+    multi-output graph models pass ``output_name`` to pick the head."""
     ev = RegressionEvaluation(n_columns)
     for ds in data_iter:
         feats = ds.features if hasattr(ds, "features") else ds["features"]
         labels = ds.labels if hasattr(ds, "labels") else ds["labels"]
         out = model.output(variables, feats)
-        if isinstance(out, dict):
-            out = next(iter(out.values()))
+        out = select_output(out, output_name, "evaluate_regression")
         ev.eval(labels, out)
     return ev

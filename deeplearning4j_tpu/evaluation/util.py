@@ -1,0 +1,25 @@
+"""Shared evaluation helpers."""
+
+from __future__ import annotations
+
+
+def select_output(out, output_name, caller: str):
+    """Resolve a (possibly multi-output graph) model's output dict.
+
+    Single-output dicts resolve unambiguously; multi-output dicts require
+    ``output_name`` — silently evaluating an arbitrary head would produce
+    a plausible-looking but wrong metric. Non-dict outputs pass through.
+    """
+    if not isinstance(out, dict):
+        return out
+    if output_name is not None:
+        if output_name not in out:
+            raise KeyError(
+                f"{caller}: output '{output_name}' not found; model "
+                f"outputs are {sorted(out)}")
+        return out[output_name]
+    if len(out) == 1:
+        return next(iter(out.values()))
+    raise ValueError(
+        f"{caller}: model has multiple outputs {sorted(out)}; pass "
+        f"output_name= to choose which one to evaluate")

@@ -169,6 +169,26 @@ class Gpt:
         loss = jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)
         return loss, (state, {"loss": loss})
 
+    def loss_weight(self, batch):
+        """Total loss-weight of ``batch`` — non-padding next-token
+        positions. The trainer's grad-accumulation scan uses this to
+        combine microbatches exactly as the full-batch weighted mean
+        would, even when mask density varies across microbatches.
+
+        Deliberately UNclamped (unlike loss_fn's max(Σw,1) divide-guard):
+        a fully-padded microbatch has loss 0 and must contribute weight 0
+        to the combination, not a phantom 1 — w·loss = Σ per-token loss
+        holds exactly either way."""
+        features = batch["features"]
+        if not isinstance(features, dict):
+            features = {"token_ids": features}
+        ids = features["token_ids"]
+        mask = features.get("mask")
+        if mask is None:
+            n, t = ids.shape
+            return jnp.float32(n * (t - 1))
+        return jnp.sum(mask[:, 1:].astype(jnp.float32))
+
     def num_params(self, variables) -> int:
         return sum(p.size for p in
                    jax.tree_util.tree_leaves(variables["params"]))

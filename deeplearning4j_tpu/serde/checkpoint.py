@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import time
 from pathlib import Path
 from typing import Any, Dict, Optional
@@ -161,22 +162,38 @@ def _model_config_json(model) -> str:
     return config_to_json(cfg)
 
 
+_index_lock = threading.Lock()
+
+
 def _finalize_checkpoint(root: Path, name: str, step: int, tag: str,
                          keep_last: int, config_json: Optional[str]):
     """config.json + rotation-index update for a written checkpoint dir.
     Runs wherever the write ran (caller thread or async worker) so index
-    order matches write-completion order."""
+    order matches write-completion order.
+
+    The index read-modify-write (and rotation deletes) are serialized by
+    a process-wide lock: a synchronous ``save_checkpoint`` — e.g. a
+    SIGTERM PreemptionCheckpointer — can legitimately race an in-flight
+    ``AsyncCheckpointer`` worker writing to the same directory, and an
+    unguarded update could drop an index entry or rotate-delete a
+    checkpoint mid-write. Cross-PROCESS writers to one directory remain
+    unsupported (single-writer-per-directory, matching orbax)."""
     ckpt_dir = root / name
     if config_json is not None:
         (ckpt_dir / "config.json").write_text(config_json)
-    idx_path = root / _INDEX
-    index = json.loads(idx_path.read_text()) if idx_path.exists() else {"checkpoints": []}
-    index["checkpoints"].append({"name": name, "step": step, "tag": tag, "time": time.time()})
-    if keep_last and len(index["checkpoints"]) > keep_last:
-        for old in index["checkpoints"][:-keep_last]:
-            shutil.rmtree(root / old["name"], ignore_errors=True)
-        index["checkpoints"] = index["checkpoints"][-keep_last:]
-    idx_path.write_text(json.dumps(index, indent=2))
+    with _index_lock:
+        idx_path = root / _INDEX
+        index = json.loads(idx_path.read_text()) if idx_path.exists() else {"checkpoints": []}
+        index["checkpoints"].append({"name": name, "step": step, "tag": tag, "time": time.time()})
+        if keep_last and len(index["checkpoints"]) > keep_last:
+            for old in index["checkpoints"][:-keep_last]:
+                shutil.rmtree(root / old["name"], ignore_errors=True)
+            index["checkpoints"] = index["checkpoints"][-keep_last:]
+        # atomic replace: a SIGKILL mid-write must leave the previous
+        # index readable, or restart recovery loses ALL checkpoints
+        tmp = idx_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(index, indent=2))
+        os.replace(tmp, idx_path)
     return str(ckpt_dir)
 
 

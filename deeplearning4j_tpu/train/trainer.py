@@ -202,15 +202,26 @@ class Trainer:
             batch sizes beyond a chip's activation budget; TPU-idiomatic
             lax.scan, not a host loop). Stateful layers (BatchNorm) see
             microbatches sequentially, exactly like running the reference
-            on k smaller batches with one deferred update."""
+            on k smaller batches with one deferred update.
+
+            Weighting: if the model exposes ``loss_weight(batch) -> scalar``
+            (the total loss-weight in a batch, e.g. the non-padding token
+            count — Gpt does), each microbatch's loss/grads are combined
+            weighted by that sum, which makes the accumulated step EXACTLY
+            equal to the full-batch weighted-mean loss even when mask
+            density varies across microbatches. Without the hook,
+            microbatches are weighted equally — exact for unweighted mean
+            losses, an approximation for masked/weighted ones."""
             k = self.grad_accum
             step_rng = jax.random.fold_in(ts.rng, ts.step)
             batch = _cast_batch(batch)
+            weight_of = getattr(self.model, "loss_weight", None)
 
             # Shapes are trace-time constants: a ragged final batch (normal
             # at epoch end) falls back to the plain un-accumulated step for
-            # that shape instead of crashing mid-epoch — same gradients,
-            # just without the memory split for the one small batch.
+            # that shape instead of crashing mid-epoch — the full-batch
+            # weighted mean, i.e. the same semantics the weighted
+            # accumulation reproduces, just without the memory split.
             n0 = jax.tree_util.tree_leaves(batch)[0].shape[0]
             if n0 % k:
                 loss, new_model_state, metrics, grads = _grad_of(
@@ -236,23 +247,29 @@ class Trainer:
                     lambda s: jnp.zeros(s.shape, s.dtype), sd)
 
             def body(carry, xs):
-                model_state, gsum, loss_sum, msum = carry
+                model_state, gsum, loss_sum, msum, wsum = carry
                 i, mb = xs
                 loss, new_state, metrics, grads = micro_grad(
                     model_state, mb, i)
-                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
-                msum = jax.tree_util.tree_map(jnp.add, msum, metrics)
-                return (new_state, gsum, loss_sum + loss, msum), None
+                w = (jnp.asarray(weight_of(mb), jnp.float32)
+                     if weight_of is not None else jnp.float32(1.0))
+                gsum = jax.tree_util.tree_map(
+                    lambda s, g: (s + w * g).astype(s.dtype), gsum, grads)
+                msum = jax.tree_util.tree_map(
+                    lambda s, m: (s + w * m).astype(s.dtype), msum, metrics)
+                loss_sum = (loss_sum + w * loss).astype(loss_sum.dtype)
+                return (new_state, gsum, loss_sum, msum, wsum + w), None
 
-            (final_state, gsum, loss_sum, msum), _ = jax.lax.scan(
+            (final_state, gsum, loss_sum, msum, wsum), _ = jax.lax.scan(
                 body,
                 (ts.model_state, zeros(grads_sd), zeros(loss_sd),
-                 zeros(metrics_sd)),
+                 zeros(metrics_sd), jnp.float32(0.0)),
                 (jnp.arange(k), micro))
-            grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
-            metrics = jax.tree_util.tree_map(lambda m: m / k, msum)
+            denom = jnp.maximum(wsum, jnp.float32(1e-12))
+            grads = jax.tree_util.tree_map(lambda g: g / denom, gsum)
+            metrics = jax.tree_util.tree_map(lambda m: m / denom, msum)
             return self._finish_step(
-                ts, grads, final_state, metrics, loss_sum / k, batch)
+                ts, grads, final_state, metrics, loss_sum / denom, batch)
 
         if self.grad_accum > 1:
             train_step = train_step_accum

@@ -538,3 +538,60 @@ class TestEvaluateHelpers:
                                            shuffle=False), n_columns=2)
         assert np.all(np.asarray(ev.mse()) >= 0)
         assert ev._h()["n"] == 48
+
+
+class TestMultiOutputSelection:
+    """select_output guard: multi-output graph models must not be scored
+    against an arbitrary head (advisor r4 finding)."""
+
+    class _TwoHead:
+        def output(self, variables, feats):
+            import jax.numpy as jnp
+
+            n = feats.shape[0]
+            return {"a": jnp.tile(jnp.asarray([[0.9, 0.1]]), (n, 1)),
+                    "b": jnp.tile(jnp.asarray([[0.1, 0.9]]), (n, 1))}
+
+    def _iter(self):
+        import numpy as np
+
+        x = np.zeros((8, 3), np.float32)
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, np.int64)]
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        return ArrayDataSetIterator(x, y, batch_size=8, shuffle=False)
+
+    def test_roc_raises_without_output_name(self):
+        import pytest
+
+        from deeplearning4j_tpu.evaluation import evaluate_roc
+
+        with pytest.raises(ValueError, match="multiple outputs"):
+            evaluate_roc(self._TwoHead(), {}, self._iter())
+
+    def test_roc_selects_named_output(self):
+        from deeplearning4j_tpu.evaluation import evaluate_roc
+
+        import pytest
+
+        # resolves without error for a valid name, refuses an unknown one
+        evaluate_roc(self._TwoHead(), {}, self._iter(), output_name="a")
+        with pytest.raises(KeyError, match="not found"):
+            evaluate_roc(self._TwoHead(), {}, self._iter(), output_name="c")
+
+    def test_evaluate_model_raises_without_output_name(self):
+        import pytest
+
+        from deeplearning4j_tpu.evaluation import evaluate_model
+
+        with pytest.raises(ValueError, match="multiple outputs"):
+            evaluate_model(self._TwoHead(), {}, self._iter(), 2)
+
+    def test_evaluate_model_selects_named_output(self):
+        from deeplearning4j_tpu.evaluation import evaluate_model
+
+        ev_a = evaluate_model(self._TwoHead(), {}, self._iter(), 2,
+                              output_name="a")
+        ev_b = evaluate_model(self._TwoHead(), {}, self._iter(), 2,
+                              output_name="b")
+        assert ev_a.accuracy() == 1.0   # head a predicts class 0 = labels
+        assert ev_b.accuracy() == 0.0

@@ -321,6 +321,66 @@ class TestChain:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_grad_accum_weighted_matches_full_batch_masked_loss():
+    """Masked-loss exactness: with uneven mask density across microbatches
+    (one microbatch nearly all padding), the accumulated step must still
+    equal the full-batch weighted mean — Gpt.loss_weight carries each
+    microbatch's token count through the scan. A naive mean-of-means
+    differs measurably here; this guards the weighted combination.
+
+    SGD updater on purpose: Gpt's attention key-bias gradient is
+    mathematically zero (softmax shift invariance), so it is pure float
+    noise — Adam's 1/sqrt(v) normalization would amplify that noise into
+    lr-sized divergent steps on those leaves and mask the real check."""
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    model = gpt_tiny(net=NeuralNetConfiguration(updater=Sgd(0.1)))
+    t1 = Trainer(model)
+    t2 = Trainer(model, grad_accum=2)
+    ts1, ts2 = t1.init_state(), t2.init_state()
+    batch = _pattern_batch(n=8, t=16)
+    mask = np.ones((8, 16), np.float32)
+    mask[:4, 3:] = 0.0  # first microbatch: 3 real tokens/row; second: 16
+    batch["features"]["mask"] = mask
+    for _ in range(3):
+        ts1, m1 = t1.train_step(ts1, batch)
+        ts2, m2 = t2.train_step(ts2, batch)
+    np.testing.assert_allclose(float(jax.device_get(m1["loss"])),
+                               float(jax.device_get(m2["loss"])),
+                               rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ts1.params),
+                    jax.tree_util.tree_leaves(ts2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-6)
+
+
+def test_grad_accum_fully_padded_microbatch_contributes_zero_weight():
+    """A microbatch that is ALL padding must contribute weight 0 (not a
+    clamped phantom 1) to the accumulated combination — otherwise every
+    gradient leaf is silently scaled by W/(W+1) vs the k=1 step."""
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    model = gpt_tiny(net=NeuralNetConfiguration(updater=Sgd(0.1)))
+    t1 = Trainer(model)
+    t2 = Trainer(model, grad_accum=2)
+    ts1, ts2 = t1.init_state(), t2.init_state()
+    batch = _pattern_batch(n=8, t=16)
+    mask = np.ones((8, 16), np.float32)
+    mask[:4] = 0.0  # first microbatch entirely padding
+    batch["features"]["mask"] = mask
+    ts1, m1 = t1.train_step(ts1, batch)
+    ts2, m2 = t2.train_step(ts2, batch)
+    np.testing.assert_allclose(float(jax.device_get(m1["loss"])),
+                               float(jax.device_get(m2["loss"])),
+                               rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ts1.params),
+                    jax.tree_util.tree_leaves(ts2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-6)
+
+
 def test_grad_accum_and_remat_compose_on_gpt():
     """Feature composition smoke: remat blocks + in-step gradient
     accumulation train together and match k=1 on the same (dropout-free)
