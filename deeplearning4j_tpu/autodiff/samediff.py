@@ -412,6 +412,12 @@ class SameDiff:
         self._updater_leaves = None  # loaded-from-checkpoint leaves, pending restore
         self._iteration = 0
         self.listeners: List[Any] = []
+        # When this graph is a control-flow branch (cond/while subgraph),
+        # an explicit ordered output list. None = the terminal-vars
+        # heuristic in _as_branch_fn. The TF importer sets this: a
+        # FunctionDef's rets are named and ordered, and loop-carry order
+        # must match lax.while_loop's carry exactly.
+        self.branch_outputs: Optional[List[str]] = None
 
     # -- construction ------------------------------------------------------
 
@@ -691,17 +697,28 @@ class SameDiff:
 
     def while_loop(self, cond_graph: "SameDiff", body_graph: "SameDiff",
                    inits: Sequence[SDVariable]):
-        """Record a While: ↔ sd.whileLoop; compiles to lax.while_loop."""
+        """Record a While: ↔ sd.whileLoop; compiles to lax.while_loop.
+
+        Reverse-mode differentiation through a while_loop is undefined
+        (XLA semantics: dynamic trip count, nothing to checkpoint
+        against); calculate_gradients over a graph containing one raises.
+        Express differentiable loops as scan-style programs (fixed trip
+        count) instead."""
         return self._record("__while__", list(inits), {},
                             {"cond": cond_graph, "body": body_graph})
 
     def _as_branch_fn(self):
-        """This graph as fn(*placeholder_values) -> outputs tuple, where
-        outputs are all terminal ARRAY vars (no consumer)."""
+        """This graph as fn(*placeholder_values) -> outputs tuple.
+        Outputs are ``branch_outputs`` when set (explicit, ordered — may
+        include placeholders for pass-through loop vars), else all
+        terminal ARRAY vars (no consumer)."""
         ph = [n for n, v in self._vars.items() if v.var_type == VariableType.PLACEHOLDER]
-        consumed = {n for node in self._nodes for n in node.inputs}
-        outs = [n for n, v in self._vars.items()
-                if v.var_type == VariableType.ARRAY and n not in consumed]
+        if self.branch_outputs is not None:
+            outs = list(self.branch_outputs)
+        else:
+            consumed = {n for node in self._nodes for n in node.inputs}
+            outs = [n for n, v in self._vars.items()
+                    if v.var_type == VariableType.ARRAY and n not in consumed]
         fn = self._build_fn(tuple(outs), tuple(ph))
         variables = {n: self._values[n] for n, v in self._vars.items()
                      if v.var_type == VariableType.VARIABLE}
@@ -819,7 +836,7 @@ class SameDiff:
     # -- serialization (↔ SameDiff.save/load FlatBuffers .fb) --------------
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "format": "deeplearning4j_tpu.samediff.v1",
             "variables": [
                 {"name": n, "type": v.var_type.value, "shape": list(v.shape) if v.shape else None,
@@ -839,6 +856,9 @@ class SameDiff:
             if self.training_config else None,
             "iteration": self._iteration,
         }
+        if self.branch_outputs is not None:
+            d["branch_outputs"] = list(self.branch_outputs)
+        return d
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "SameDiff":
@@ -858,14 +878,45 @@ class SameDiff:
             sd.training_config = TrainingConfig(**d["training_config"])
         sd._iteration = int(d.get("iteration", 0))
         sd._counter = len(sd._vars)
+        if d.get("branch_outputs") is not None:
+            sd.branch_outputs = list(d["branch_outputs"])
         return sd
+
+    def _collect_subgraph_values(self, prefix: str, out: Dict[str, Any]) -> None:
+        """Flatten control-flow subgraph constants into npz-able keys:
+        ``__sub__|<node_idx>|<subgraph_key>|...|<var_name>``. Subgraphs
+        hold their own _values (loop bounds — or captured weights, for
+        functional TF imports), which the top-level npz otherwise never
+        sees; npz keeps weight-scale constants binary instead of blowing
+        up graph.json as JSON text."""
+        for i, node in enumerate(self._nodes):
+            if not node.subgraphs:
+                continue
+            for k, g in node.subgraphs.items():
+                p = f"{prefix}{i}|{k}|"
+                for n, v in g._values.items():
+                    if "|" in n:
+                        raise ValueError(
+                            f"subgraph variable name {n!r} contains '|'")
+                    out[f"__sub__|{p}{n}"] = np.asarray(v)
+                g._collect_subgraph_values(p, out)
+
+    def _inject_subgraph_value(self, key: str, value) -> None:
+        tokens = key.split("|")
+        g = self
+        while len(tokens) > 1:
+            g = g._nodes[int(tokens[0])].subgraphs[tokens[1]]
+            tokens = tokens[2:]
+        g._values[tokens[0]] = value
 
     def save(self, path, save_updater_state: bool = True) -> None:
         """One-file zip: graph.json + arrays.npz (+ updater npz)."""
+        sub_vals: Dict[str, Any] = {}
+        self._collect_subgraph_values("", sub_vals)
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("graph.json", json.dumps(self.to_dict(), indent=1))
             buf = io.BytesIO()
-            np.savez(buf, **self._values)
+            np.savez(buf, **self._values, **sub_vals)
             zf.writestr("arrays.npz", buf.getvalue())
             if save_updater_state and self._updater_state is not None:
                 leaves, treedef = jax.tree_util.tree_flatten(self._updater_state)
@@ -878,7 +929,12 @@ class SameDiff:
         with zipfile.ZipFile(path, "r") as zf:
             sd = SameDiff.from_dict(json.loads(zf.read("graph.json")))
             with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
-                sd._values = {k: npz[k] for k in npz.files}
+                sd._values = {k: npz[k] for k in npz.files
+                              if not k.startswith("__sub__|")}
+                for k in npz.files:
+                    if k.startswith("__sub__|"):
+                        sd._inject_subgraph_value(
+                            k[len("__sub__|"):], npz[k])
             if "updater.npz" in zf.namelist():
                 with np.load(io.BytesIO(zf.read("updater.npz"))) as unpz:
                     sd._updater_leaves = [

@@ -138,6 +138,11 @@ def _register_tfimport_ops():
         return jnp.pad(x, [tuple(p) for p in paddings],
                        mode="reflect" if mode == "REFLECT" else "symmetric")
 
+    def index_dyn(x, begin):
+        # pure-index StridedSlice with traced (loop-var) indices: x[i, j]
+        # lowers to dynamic_slice — static shapes, XLA-friendly
+        return x[tuple(begin[i] for i in range(begin.shape[0]))]
+
     table = {
         "tfimport.einsum": einsum_tf,
         "tfimport.cumsum": cumsum_tf,
@@ -166,6 +171,7 @@ def _register_tfimport_ops():
         "tfimport.fill": lambda dims, value: jnp.full(tuple(dims), value),
         "tfimport.floor_div": jnp.floor_divide,
         "tfimport.floor_mod": jnp.mod,
+        "tfimport.index_dyn": index_dyn,
     }
     for name, fn in table.items():
         register_op(name, fn)
@@ -342,6 +348,11 @@ class _GraphImporter:
         self.input_shapes = input_shapes
         self.vars: Dict[str, Any] = {}  # tf tensor name -> SDVariable
         self.consts: Dict[str, np.ndarray] = {}  # host-known constant values
+        # name -> FunctionDef, for functional control flow (While/If attrs
+        # reference these; ↔ the reference's TF import resolves function
+        # bodies the same way, SURVEY §2.3)
+        self.library = ({f.signature.name: f for f in graph_def.library.function}
+                        if graph_def is not None else {})
 
     def tensor(self, ref: str) -> SDVariable:
         name = ref.split(":")[0].lstrip("^")
@@ -384,48 +395,427 @@ class _GraphImporter:
         except Exception:  # noqa: BLE001 - folding is advisory only
             pass
 
-    def run(self, outputs: Sequence[str]) -> Dict[str, str]:
+    def _process_node(self, node) -> None:
+        """Dispatch one NodeDef into the SameDiff graph. Shared by the
+        top-level walk, FunctionDef bodies, and raised TF1 frame
+        subgraphs."""
         from tensorflow.python.framework import tensor_util
 
+        op = node.op
+        if op == "Placeholder":
+            shape = self.input_shapes.get(node.name)
+            if shape is None:
+                shape = _attr(node, "shape")
+                if shape is None:
+                    raise TFImportError(
+                        f"placeholder {node.name} needs an input_shapes entry")
+                shape = tuple(None if d in (-1, None) else d for d in shape)
+            dtype = _np_dtype(_attr(node, "dtype", 1))
+            self.vars[node.name] = self.sd.placeholder(
+                node.name, shape, dtype)
+        elif op == "Const":
+            arr = tensor_util.MakeNdarray(node.attr["value"].tensor)
+            self.consts[node.name] = arr
+            self.vars[node.name] = self.sd.constant(
+                _uniq(self.sd, node.name), arr)
+        elif op in ("Identity", "StopGradient", "PreventGradient",
+                    "CheckNumerics", "LoopCond"):
+            self.vars[node.name] = self.tensor(node.input[0])
+            # Const→Identity chains (grappler leaves these) must keep the
+            # host-known value visible to shape/axis consumers.
+            src = node.input[0].split(":")[0].lstrip("^")
+            if src in self.consts:
+                self.consts[node.name] = self.consts[src]
+        elif op == "NoOp":
+            return
+        else:
+            mapper = TF_OP_MAPPERS.get(op)
+            if mapper is None:
+                raise TFImportError(
+                    f"no mapper for TF op {op!r} (node {node.name}); "
+                    f"supported: {sorted(TF_OP_MAPPERS)}")
+            self.vars[node.name] = mapper(self, node)
+            self._try_fold(node)
+
+    def run(self, outputs: Sequence[str]) -> Dict[str, str]:
+        frames = _collect_frames(self.gd)
+        frame_of: Dict[str, "_Frame"] = {}
+        for fr in frames:
+            for n in fr.members:
+                frame_of[n] = fr
+        # data-consumer map: placeholders nobody reads (the lowered form
+        # emits unused_control_flow_input placeholders) are skipped, and
+        # control-only stragglers of a processed frame are droppable
+        data_consumed = {r.split(":")[0] for n in self.gd.node
+                         for r in n.input if not r.startswith("^")}
         name_map: Dict[str, str] = {}
         for node in self.gd.node:
-            op = node.op
-            if op == "Placeholder":
-                shape = self.input_shapes.get(node.name)
-                if shape is None:
-                    shape = _attr(node, "shape")
-                    if shape is None:
-                        raise TFImportError(
-                            f"placeholder {node.name} needs an input_shapes entry")
-                    shape = tuple(None if d in (-1, None) else d for d in shape)
-                dtype = _np_dtype(_attr(node, "dtype", 1))
-                self.vars[node.name] = self.sd.placeholder(
-                    node.name, shape, dtype)
-            elif op == "Const":
-                arr = tensor_util.MakeNdarray(node.attr["value"].tensor)
-                self.consts[node.name] = arr
-                self.vars[node.name] = self.sd.constant(
-                    _uniq(self.sd, node.name), arr)
-            elif op in ("Identity", "StopGradient", "PreventGradient", "CheckNumerics"):
-                self.vars[node.name] = self.tensor(node.input[0])
-                # Const→Identity chains (grappler leaves these) must keep the
-                # host-known value visible to shape/axis consumers.
-                src = node.input[0].split(":")[0].lstrip("^")
-                if src in self.consts:
-                    self.consts[node.name] = self.consts[src]
-            elif op == "NoOp":
+            fr = frame_of.get(node.name)
+            if fr is not None:
+                if not fr.done and fr.ready(self):
+                    fr.process(self)
                 continue
-            else:
-                mapper = TF_OP_MAPPERS.get(op)
-                if mapper is None:
-                    raise TFImportError(
-                        f"no mapper for TF op {op!r} (node {node.name}); "
-                        f"supported: {sorted(TF_OP_MAPPERS)}")
-                self.vars[node.name] = mapper(self, node)
-                self._try_fold(node)
+            if (node.op == "Placeholder" and node.name not in data_consumed
+                    and node.name not in (outputs or [])
+                    and node.name not in self.input_shapes):
+                continue
+            try:
+                self._process_node(node)
+            except TFImportError:
+                # a control-only consumer of frame internals (e.g. the
+                # loop_body_control Identity) — droppable iff nothing
+                # reads its data output
+                if node.name not in data_consumed and any(
+                        r.split(":")[0].lstrip("^") in frame_of
+                        for r in node.input):
+                    continue
+                raise
+        undone = [fr for fr in frames if not fr.done]
+        if undone:
+            raise TFImportError(
+                f"could not resolve TF1 control-flow frame(s) "
+                f"{[fr.name for fr in undone]}: loop-entry inputs never "
+                "became available (malformed or unsupported graph)")
         for out in outputs:
             name_map[out] = self.tensor(out).name
         return name_map
+
+
+# --- control flow: TF1 frame raising + FunctionDef import ------------------
+#
+# The reference's TF import executes Switch/Merge/Enter/Exit/NextIteration
+# frames with control-flow-aware sessions (SURVEY §2.3 sessions row, §3.2).
+# On TPU the only compilable form is lax.while_loop/lax.cond, so this
+# importer RAISES TF1 frames back to functional cond/body subgraphs and maps
+# TF2 functional While/If (FunctionDef-carried) directly onto
+# samediff.while_loop / samediff.cond — XLA-native structured control flow
+# instead of a dataflow interpreter.
+
+_FRAME_OPS = ("Enter", "Merge", "Switch", "NextIteration", "Exit", "LoopCond")
+
+
+class _SubgraphImporter(_GraphImporter):
+    """Demand-driven import of a subset of GraphDef nodes into a fresh
+    SameDiff, with boundary tensors (loop-var Merges/Switches, invariant
+    Enters) pre-bound to placeholders. Used for raised TF1 frame bodies,
+    where node order in the GraphDef is not topological (cycles through
+    NextIteration)."""
+
+    def __init__(self, by_name, library, sd: SameDiff, boundary):
+        self.gd = None
+        self.sd = sd
+        self.input_shapes = {}
+        self.vars = dict(boundary)  # boundary name -> placeholder (any :idx)
+        self._boundary = set(boundary)
+        self.consts = {}
+        self.library = library
+        self.by_name = by_name
+
+    def tensor(self, ref: str) -> SDVariable:
+        name = ref.split(":")[0].lstrip("^")
+        if name in self._boundary:
+            return self.vars[name]  # Switch:1 / Merge:0 both mean "the var"
+        if name not in self.vars:
+            self._ensure(name)
+        return super().tensor(ref)
+
+    def const_value(self, ref: str) -> np.ndarray:
+        name = ref.split(":")[0]
+        if name not in self.consts and name not in self.vars \
+                and name not in self._boundary:
+            self._ensure(name)
+        return super().const_value(ref)
+
+    def _ensure(self, name: str) -> None:
+        node = self.by_name.get(name)
+        if node is None:
+            raise TFImportError(f"tensor {name!r}: no such node in graph")
+        if node.op in _FRAME_OPS:
+            raise TFImportError(
+                f"node {name!r} ({node.op}) crosses into another control-"
+                "flow frame: nested TF1 frames are not supported (freeze "
+                "with lower_control_flow=False for functional While/If)")
+        for r in node.input:
+            if r.startswith("^"):
+                continue
+            src = r.split(":")[0]
+            if src not in self.vars and src not in self._boundary:
+                self._ensure(src)
+        self._process_node(node)
+
+
+class _Frame:
+    """One TF1 while-loop frame and its functional reconstruction:
+
+        init_m  = Enter_m.input                     (outer graph)
+        carry_m = Merge_m(Enter_m, NextIteration_m) (loop header phi)
+        pred    = cond(carries) -> LoopCond
+        Switch_m(carry_m, pred): :1 -> body, :0 -> Exit_m
+        body outputs = NextIteration_m.input
+
+    Loop-invariant Enters (is_constant=true, no Merge) become
+    pass-through loop vars so in-body reads see a stable carry."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.enters: list = []       # loop-var Enter, merge order
+        self.inv_enters: list = []   # loop-invariant Enter
+        self.merges: list = []
+        self.switches: list = []     # per loop var; None if unused in body
+        self.next_iters: list = []
+        self.exits: Dict[int, Any] = {}
+        self.loop_cond = None
+        self.members: set = set()
+        self.cond_pred_ref = None
+        self.done = False
+
+    def ready(self, imp: _GraphImporter) -> bool:
+        return all(e.input[0].split(":")[0].lstrip("^") in imp.vars
+                   for e in self.enters + self.inv_enters)
+
+    def process(self, imp: _GraphImporter) -> None:
+        by_name = {n.name: n for n in imp.gd.node}
+        inits = [imp.tensor(e.input[0])
+                 for e in self.enters + self.inv_enters]
+        cond_sd, body_sd = SameDiff.create(), SameDiff.create()
+        cond_bound, body_bound = {}, {}
+        # placeholders declared in loop-var order: _as_branch_fn maps them
+        # positionally onto the while carry
+        for i, m in enumerate(self.merges):
+            v = inits[i]
+            cond_bound[m.name] = cond_sd.placeholder(
+                m.name, v.shape, v.dtype or "float32")
+            sw = self.switches[i]
+            bname = sw.name if sw is not None else f"__var{i}_unused"
+            body_bound[bname] = body_sd.placeholder(
+                bname, v.shape, v.dtype or "float32")
+        for j, e in enumerate(self.inv_enters):
+            v = inits[len(self.merges) + j]
+            cond_bound[e.name] = cond_sd.placeholder(
+                e.name, v.shape, v.dtype or "float32")
+            body_bound[e.name] = body_sd.placeholder(
+                e.name, v.shape, v.dtype or "float32")
+        cimp = _SubgraphImporter(by_name, imp.library, cond_sd, cond_bound)
+        cond_sd.branch_outputs = [cimp.tensor(self.cond_pred_ref).name]
+        bimp = _SubgraphImporter(by_name, imp.library, body_sd, body_bound)
+        outs = [bimp.tensor(ni.input[0]).name for ni in self.next_iters]
+        outs += [body_bound[e.name].name for e in self.inv_enters]
+        body_sd.branch_outputs = outs
+        res = imp.sd.while_loop(cond_sd, body_sd, inits)
+        res = res if isinstance(res, tuple) else (res,)
+        for i, ex in self.exits.items():
+            imp.vars[ex.name] = res[i]
+        self.done = True
+
+
+def _walk_frame_interior(by_name, start_refs, boundary, frame_name):
+    """Backward closure (data + control edges) from `start_refs`, stopping
+    at `boundary` names. Anything reached is frame-internal; reaching
+    another frame's machinery means nesting -> refuse."""
+    seen = set()
+    stack = [r.split(":")[0].lstrip("^") for r in start_refs]
+    while stack:
+        name = stack.pop()
+        if name in boundary or name in seen:
+            continue
+        node = by_name.get(name)
+        if node is None:
+            raise TFImportError(
+                f"frame {frame_name!r}: interior ref {name!r} missing")
+        if node.op in _FRAME_OPS:
+            raise TFImportError(
+                f"frame {frame_name!r} touches {node.op} node {name!r}: "
+                "nested TF1 control-flow frames are not supported (freeze "
+                "with lower_control_flow=False for functional While/If)")
+        seen.add(name)
+        for r in node.input:
+            stack.append(r.split(":")[0].lstrip("^"))
+    return seen
+
+
+def _collect_frames(gd) -> list:
+    """Identify TF1 while frames (grouped by Enter frame_name) and
+    precompute their membership + structure for raising."""
+    if gd is None:
+        return []
+    by_name = {n.name: n for n in gd.node}
+    consumers: Dict[str, list] = {}
+    data_consumed = set()
+    for n in gd.node:
+        for r in n.input:
+            if not r.startswith("^"):
+                consumers.setdefault(r.split(":")[0], []).append((n, r))
+                data_consumed.add(r.split(":")[0])
+    enters_by_frame: Dict[str, list] = {}
+    for n in gd.node:
+        if n.op == "Enter":
+            fname = n.attr["frame_name"].s.decode()
+            enters_by_frame.setdefault(fname, []).append(n)
+    frames = []
+    for fname, enters in enters_by_frame.items():
+        fr = _Frame(fname)
+        enter_names = {e.name for e in enters}
+        merge_for_enter: Dict[str, Any] = {}
+        for n in gd.node:
+            if n.op == "Merge":
+                for r in n.input:
+                    src = r.split(":")[0]
+                    if src in enter_names:
+                        merge_for_enter[src] = n
+        for e in enters:
+            m = merge_for_enter.get(e.name)
+            if m is None:
+                fr.inv_enters.append(e)
+                continue
+            fr.enters.append(e)
+            fr.merges.append(m)
+            ni_name = next((r.split(":")[0] for r in m.input
+                            if r.split(":")[0] != e.name), None)
+            ni = by_name.get(ni_name)
+            if ni is None or ni.op != "NextIteration":
+                raise TFImportError(
+                    f"frame {fname!r}: Merge {m.name} lacks a "
+                    "NextIteration input (unsupported frame shape)")
+            fr.next_iters.append(ni)
+            sw = next((c for c, _ in consumers.get(m.name, [])
+                       if c.op == "Switch"), None)
+            fr.switches.append(sw)
+            if sw is not None:
+                lc = by_name.get(sw.input[1].split(":")[0])
+                if lc is None or lc.op != "LoopCond":
+                    raise TFImportError(
+                        f"frame {fname!r}: Switch {sw.name} predicate is "
+                        f"not a LoopCond")
+                fr.loop_cond = lc
+                ex = next((c for c, ref in consumers.get(sw.name, [])
+                           if c.op == "Exit"), None)
+                if ex is not None:
+                    fr.exits[len(fr.merges) - 1] = ex
+        if fr.loop_cond is None:
+            raise TFImportError(
+                f"frame {fname!r}: no LoopCond found (cond-only Switch/"
+                "Merge graphs are not raiseable as loops)")
+        fr.cond_pred_ref = fr.loop_cond.input[0]
+        boundary = ({m.name for m in fr.merges}
+                    | {s.name for s in fr.switches if s is not None}
+                    | {e.name for e in fr.inv_enters})
+        interior = _walk_frame_interior(
+            by_name, [fr.cond_pred_ref], boundary, fname)
+        interior |= _walk_frame_interior(
+            by_name, [ni.input[0] for ni in fr.next_iters], boundary, fname)
+        # control-only stragglers hanging off loop machinery (pivot
+        # identities, control NoOps): anything consuming a Switch/Merge
+        # that only feeds control edges
+        for s in list(boundary):
+            for c, _ref in consumers.get(s, []):
+                if (c.op in ("Identity", "NoOp")
+                        and c.name not in data_consumed):
+                    interior.add(c.name)
+        fr.members = (interior | boundary | enter_names
+                      | {ni.name for ni in fr.next_iters}
+                      | {e.name for e in fr.exits.values()}
+                      | {fr.loop_cond.name})
+        frames.append(fr)
+    return frames
+
+
+_TF_OUT_ARG_OFFSETS = {
+    # multi-output-arg ops: FunctionDef refs are 'node:out_arg:idx'; flat
+    # tuple position = offset(out_arg) + idx
+    "TopKV2": {"values": 0, "indices": 1},
+    "FusedBatchNorm": {"y": 0}, "FusedBatchNormV2": {"y": 0},
+    "FusedBatchNormV3": {"y": 0},
+    "Split": {"output": 0}, "SplitV": {"output": 0}, "Unpack": {"output": 0},
+}
+
+
+class _FunctionImporter(_GraphImporter):
+    """Imports a FunctionDef (TF2 functional While/If branch) into a fresh
+    SameDiff subgraph. FunctionDef tensor refs are 'node:out_arg:idx'
+    (GraphDef uses 'node:idx') and function inputs are bare arg names;
+    placeholders are declared in signature order so the branch maps
+    positionally onto call-site operands."""
+
+    def __init__(self, fdef, library, sd: SameDiff, arg_vars):
+        self.gd = None
+        self.sd = sd
+        self.input_shapes = {}
+        self.vars = {}
+        self.consts = {}
+        self.library = library
+        self.fdef = fdef
+        self._node_ops: Dict[str, str] = {}
+        sig = fdef.signature
+        if len(arg_vars) != len(sig.input_arg):
+            raise TFImportError(
+                f"function {sig.name!r} takes {len(sig.input_arg)} args, "
+                f"got {len(arg_vars)}")
+        for arg, v in zip(sig.input_arg, arg_vars):
+            self.vars[arg.name] = self.sd.placeholder(
+                arg.name, v.shape, v.dtype or "float32")
+
+    def tensor(self, ref: str) -> SDVariable:
+        parts = ref.lstrip("^").split(":")
+        name = parts[0]
+        if len(parts) >= 3:
+            off = _TF_OUT_ARG_OFFSETS.get(
+                self._node_ops.get(name, ""), {}).get(parts[1], 0)
+            flat = off + int(parts[2])
+        elif len(parts) == 2 and parts[1].isdigit():
+            flat = int(parts[1])
+        else:
+            flat = 0
+        v = self.vars.get(name)
+        if v is None:
+            raise TFImportError(f"tensor {ref!r} produced by unknown node")
+        if isinstance(v, tuple):
+            return v[flat]
+        if flat != 0:
+            raise TFImportError(f"node {name} has one output; wanted {ref!r}")
+        return v
+
+    def run_function(self) -> None:
+        pending = list(self.fdef.node_def)
+        while pending:
+            rest = []
+            for nd in pending:
+                refs = [r.split(":")[0].lstrip("^") for r in nd.input]
+                if all(r in self.vars for r in refs):
+                    self._node_ops[nd.name] = nd.op
+                    self._process_node(nd)
+                else:
+                    rest.append(nd)
+            if len(rest) == len(pending):
+                missing = sorted({r.split(":")[0].lstrip("^")
+                                  for nd in rest for r in nd.input
+                                  if r.split(":")[0].lstrip("^")
+                                  not in self.vars})
+                raise TFImportError(
+                    f"function {self.fdef.signature.name!r}: unresolvable "
+                    f"refs {missing[:5]} (cycle or unsupported structure)")
+            pending = rest
+        rets = []
+        for oa in self.fdef.signature.output_arg:
+            rets.append(self.tensor(self.fdef.ret[oa.name]).name)
+        self.sd.branch_outputs = rets
+
+
+def _import_function(imp: _GraphImporter, fname: str, arg_vars) -> SameDiff:
+    fdef = imp.library.get(fname)
+    if fdef is None:
+        raise TFImportError(
+            f"function {fname!r} not found in the graph's function library")
+    sub = SameDiff.create()
+    fimp = _FunctionImporter(fdef, imp.library, sub, arg_vars)
+    fimp.run_function()
+    return sub
+
+
+def _func_name_attr(node, key: str) -> str:
+    if key not in node.attr or not node.attr[key].func.name:
+        raise TFImportError(
+            f"node {node.name} ({node.op}) lacks function attr {key!r}")
+    return node.attr[key].func.name
 
 
 def _uniq(sd: SameDiff, base: str) -> str:
@@ -595,12 +985,51 @@ def _pack(imp, node):
         "axis": _attr(node, "axis", 0)})
 
 
+@tf_op("While", "StatelessWhile")
+def _while_functional(imp, node):
+    """TF2 functional while: cond/body FunctionDefs -> samediff.while_loop
+    -> lax.while_loop. Loop vars map positionally (While is N-in/N-out)."""
+    inits = [imp.tensor(r) for r in node.input if not r.startswith("^")]
+    cond_sd = _import_function(imp, _func_name_attr(node, "cond"), inits)
+    body_sd = _import_function(imp, _func_name_attr(node, "body"), inits)
+    return imp.sd.while_loop(cond_sd, body_sd, inits)
+
+
+@tf_op("If", "StatelessIf")
+def _if_functional(imp, node):
+    """TF2 functional cond: then/else FunctionDefs -> samediff.cond ->
+    lax.cond (both branches compiled, one executed — XLA-native)."""
+    ins = [r for r in node.input if not r.startswith("^")]
+    pred = imp.tensor(ins[0])
+    args = [imp.tensor(r) for r in ins[1:]]
+    t_sd = _import_function(imp, _func_name_attr(node, "then_branch"), args)
+    f_sd = _import_function(imp, _func_name_attr(node, "else_branch"), args)
+    return imp.sd.cond(pred, t_sd, f_sd, args)
+
+
 @tf_op("StridedSlice")
 def _strided_slice(imp, node):
     x = imp.tensor(node.input[0])
-    begin = [int(v) for v in imp.const_value(node.input[1])]
-    end = [int(v) for v in imp.const_value(node.input[2])]
-    strides = [int(v) for v in imp.const_value(node.input[3])]
+    try:
+        begin = [int(v) for v in imp.const_value(node.input[1])]
+        end = [int(v) for v in imp.const_value(node.input[2])]
+        strides = [int(v) for v in imp.const_value(node.input[3])]
+    except TFImportError:
+        # Loop-var-dependent slicing (x[i] inside a while body): bounds
+        # are traced, not host constants. Supported for the pure-index
+        # (all-shrink) form — jnp turns x[i, j] with traced scalars into
+        # dynamic_slice+squeeze; ranges with traced bounds have no static
+        # shape and stay refused.
+        bvar = imp.tensor(node.input[1])
+        k = (bvar.shape or [1])[0] or 1
+        if (_attr(node, "new_axis_mask", 0) or _attr(node, "ellipsis_mask", 0)
+                or _attr(node, "begin_mask", 0) or _attr(node, "end_mask", 0)
+                or _attr(node, "shrink_axis_mask", 0) != (1 << k) - 1):
+            raise TFImportError(
+                f"StridedSlice {node.name}: non-constant begin/end is only "
+                "supported for pure-index (all-shrink) slices like x[i]")
+        return imp.sd._record("tfimport.index_dyn", [x, bvar], {
+            "__argspec__": ["var", "var"], "__posattrs__": []})
     return imp.sd._record("tfimport.strided_slice", [x], {
         "__argspec__": ["var"], "__posattrs__": [],
         "begin": begin, "end": end, "strides": strides,
@@ -767,7 +1196,11 @@ def import_tf_graph(
     sd = SameDiff.create()
     imp = _GraphImporter(graph_def, dict(inputs or {}), sd)
     out_map = imp.run(list(outputs))
-    in_map = {n.name: n.name for n in graph_def.node if n.op == "Placeholder"}
+    # imp.vars membership: unconsumed placeholders (the lowered control-
+    # flow form emits unused_control_flow_input stubs) are skipped by the
+    # walk and must not be advertised as feedable inputs
+    in_map = {n.name: n.name for n in graph_def.node
+              if n.op == "Placeholder" and n.name in imp.vars}
     return sd, in_map, out_map
 
 

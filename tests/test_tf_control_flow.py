@@ -1,0 +1,280 @@
+"""TF control-flow import oracles (SURVEY §2.3 sessions row, §3.2).
+
+The reference's TF import executes Switch/Merge/Enter/Exit/NextIteration
+frames with control-flow-aware sessions. Here both lowered TF1 frames
+(what convert_variables_to_constants_v2 emits by default) and TF2
+functional While/If (lower_control_flow=False) must import onto
+samediff.while_loop / samediff.cond — i.e. lax.while_loop / lax.cond —
+and match real TF execution bit-for-bit-ish (fp32 tolerance).
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tf import (  # noqa: E402
+    TFImportError,
+    import_tf_graph,
+)
+
+
+def _freeze_fn(fn, *specs, lower=True):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    conc = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(
+        conc, lower_control_flow=lower)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name.split(":")[0] for t in frozen.outputs]
+    return gd, in_names, out_names
+
+
+def _import_and_run(gd, in_names, out_names, feeds):
+    sd, in_map, out_map = import_tf_graph(gd, outputs=list(out_names))
+    res = sd.output({in_map[n]: v for n, v in zip(in_names, feeds)},
+                    [out_map[n] for n in out_names])
+    return [res[out_map[n]] for n in out_names]
+
+
+def _loop_fn(x):
+    i = tf.constant(0)
+
+    def cond(i, acc):
+        return i < 5
+
+    def body(i, acc):
+        return i + 1, acc * 1.1 + 0.5
+
+    _, acc = tf.while_loop(cond, body, [i, x])
+    return acc
+
+
+class TestWhileImport:
+    @pytest.mark.parametrize("lower", [True, False],
+                             ids=["tf1_frames", "functional"])
+    def test_while_accumulator_matches_tf(self, lower):
+        """Same loop through BOTH encodings: lowered TF1 frames (raised
+        back to lax.while_loop) and functional StatelessWhile."""
+        gd, ins, outs = _freeze_fn(
+            _loop_fn, tf.TensorSpec((2, 3), tf.float32), lower=lower)
+        ops = {n.op for n in gd.node}
+        if lower:
+            assert "Enter" in ops and "Merge" in ops  # really frames
+        else:
+            assert "StatelessWhile" in ops or "While" in ops
+        x = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+        want = np.asarray(_loop_fn(tf.constant(x)))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    @pytest.mark.parametrize("lower", [True, False],
+                             ids=["tf1_frames", "functional"])
+    def test_dynamic_rnn_style_loop(self, lower):
+        """dynamic_rnn-shaped program: a while loop over time steps
+        carrying hidden state, reading x[t] per step (loop-var-dependent
+        StridedSlice -> the dynamic pure-index path)."""
+        T, N, D, H = 6, 2, 3, 4
+        rng = np.random.default_rng(1)
+        wx = tf.constant(rng.normal(size=(D, H)).astype(np.float32) * 0.4)
+        wh = tf.constant(rng.normal(size=(H, H)).astype(np.float32) * 0.4)
+        b = tf.constant(rng.normal(size=(H,)).astype(np.float32) * 0.1)
+
+        def rnn(x):
+            h0 = tf.zeros((N, H), tf.float32)
+            t0 = tf.constant(0)
+
+            def cond(t, h):
+                return t < T
+
+            def body(t, h):
+                xt = x[t]  # [N, D] — StridedSlice with traced begin
+                return t + 1, tf.tanh(
+                    tf.matmul(xt, wx) + tf.matmul(h, wh) + b)
+
+            _, hT = tf.while_loop(cond, body, [t0, h0])
+            return hT
+
+        gd, ins, outs = _freeze_fn(
+            rnn, tf.TensorSpec((T, N, D), tf.float32), lower=lower)
+        x = rng.normal(size=(T, N, D)).astype(np.float32)
+        want = np.asarray(rnn(tf.constant(x)))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                                   atol=1e-6)
+
+    def test_imported_while_saves_and_loads(self, tmp_path):
+        """Control-flow graphs round-trip through sd.save/load: subgraph
+        constants and branch_outputs must survive (a fresh process would
+        otherwise replay the loop with missing loop bounds)."""
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        from deeplearning4j_tpu.modelimport.tf import ensure_tfimport_ops
+
+        gd, ins, outs = _freeze_fn(
+            _loop_fn, tf.TensorSpec((2, 3), tf.float32), lower=True)
+        x = np.random.default_rng(2).normal(size=(2, 3)).astype(np.float32)
+        sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
+        want = sd.output({in_map[ins[0]]: x}, [out_map[outs[0]]])
+        p = tmp_path / "loop.sdz"
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        ensure_tfimport_ops()
+        got = sd2.output({in_map[ins[0]]: x}, [out_map[outs[0]]])
+        np.testing.assert_allclose(got[out_map[outs[0]]],
+                                   want[out_map[outs[0]]], rtol=1e-6)
+
+    def test_functional_while_with_captured_weights_saves_binary(self, tmp_path):
+        """Functional-form import puts captured weights (Consts inside the
+        body FunctionDef) in SUBGRAPH _values; save() must carry them in
+        arrays.npz (binary, __sub__| keys) — not as JSON text — and load()
+        must reinject them for bit-equal replay."""
+        import zipfile
+
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        from deeplearning4j_tpu.modelimport.tf import ensure_tfimport_ops
+
+        T, N, D, H = 4, 2, 3, 4
+        rng = np.random.default_rng(7)
+        wx = tf.constant(rng.normal(size=(D, H)).astype(np.float32) * 0.4)
+
+        def rnn(x):
+            def body(t, h):
+                return t + 1, tf.tanh(tf.matmul(x[t], wx) + h)
+
+            _, hT = tf.while_loop(lambda t, h: t < T, body,
+                                  [tf.constant(0), tf.zeros((N, H))])
+            return hT
+
+        gd, ins, outs = _freeze_fn(
+            rnn, tf.TensorSpec((T, N, D), tf.float32), lower=False)
+        x = rng.normal(size=(T, N, D)).astype(np.float32)
+        sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
+        want = sd.output({in_map[ins[0]]: x}, [out_map[outs[0]]])
+        p = tmp_path / "rnn.sdz"
+        sd.save(p)
+        with zipfile.ZipFile(p) as zf:
+            graph_json = zf.read("graph.json").decode()
+            import io as _io
+
+            npz = np.load(_io.BytesIO(zf.read("arrays.npz")))
+            sub_keys = [k for k in npz.files if k.startswith("__sub__|")]
+        assert sub_keys, "captured body weights should land in arrays.npz"
+        assert "0.4" not in graph_json or len(graph_json) < 50_000
+        sd2 = SameDiff.load(p)
+        ensure_tfimport_ops()
+        got = sd2.output({in_map[ins[0]]: x}, [out_map[outs[0]]])
+        np.testing.assert_array_equal(got[out_map[outs[0]]],
+                                      want[out_map[outs[0]]])
+
+    def test_nested_frames_refused(self):
+        """Nested TF1 while frames stay strict-refused with a pointed
+        message (freeze with lower_control_flow=False instead)."""
+
+        def nested(x):
+            def outer_body(i, acc):
+                def inner_body(j, a):
+                    return j + 1, a + 0.5
+
+                _, acc2 = tf.while_loop(
+                    lambda j, a: j < 2, inner_body, [tf.constant(0), acc])
+                return i + 1, acc2
+
+            _, out = tf.while_loop(
+                lambda i, a: i < 3, outer_body, [tf.constant(0), x])
+            return out
+
+        gd, ins, outs = _freeze_fn(
+            nested, tf.TensorSpec((2,), tf.float32), lower=True)
+        with pytest.raises(TFImportError, match="[Nn]ested"):
+            import_tf_graph(gd, outputs=list(outs))
+
+    def test_nested_functional_while_imports(self):
+        """The SAME nested loop imports fine in functional form — mapper
+        recursion through the function library handles nesting."""
+
+        def nested(x):
+            def outer_body(i, acc):
+                def inner_body(j, a):
+                    return j + 1, a + 0.5
+
+                _, acc2 = tf.while_loop(
+                    lambda j, a: j < 2, inner_body, [tf.constant(0), acc])
+                return i + 1, acc2
+
+            _, out = tf.while_loop(
+                lambda i, a: i < 3, outer_body, [tf.constant(0), x])
+            return out
+
+        gd, ins, outs = _freeze_fn(
+            nested, tf.TensorSpec((2,), tf.float32), lower=False)
+        x = np.asarray([1.0, -2.0], np.float32)
+        want = np.asarray(nested(tf.constant(x)))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+class TestIfImport:
+    def test_functional_cond_both_branches(self):
+        def cond_fn(x):
+            return tf.cond(tf.reduce_sum(x) > 0.0,
+                           lambda: x * 2.0, lambda: x - 1.0)
+
+        gd, ins, outs = _freeze_fn(
+            cond_fn, tf.TensorSpec((2, 3), tf.float32), lower=False)
+        assert any(n.op in ("StatelessIf", "If") for n in gd.node)
+        for sign in (+1.0, -1.0):
+            x = sign * np.abs(
+                np.random.default_rng(3).normal(size=(2, 3))
+            ).astype(np.float32)
+            want = np.asarray(cond_fn(tf.constant(x)))
+            (got,) = _import_and_run(gd, ins, outs, [x])
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_grad_flows_through_imported_cond(self):
+        """lax.cond IS differentiable — gradients flow through an
+        imported functional If and match TF's tape."""
+
+        def cond_fn(x):
+            return tf.cond(tf.reduce_sum(x) > 0.0,
+                           lambda: x * 2.0, lambda: x - 1.0)
+
+        gd, ins, outs = _freeze_fn(
+            cond_fn, tf.TensorSpec((2, 3), tf.float32), lower=False)
+        x = np.abs(np.random.default_rng(4).normal(size=(2, 3))
+                   ).astype(np.float32)
+        with tf.GradientTape() as tape:
+            xt = tf.constant(x)
+            tape.watch(xt)
+            loss = tf.reduce_sum(cond_fn(xt))
+        want = np.asarray(tape.gradient(loss, xt))
+
+        sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
+        from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+        ph = in_map[ins[0]]
+        sd._vars[ph].var_type = VariableType.VARIABLE
+        sd._values[ph] = x
+        loss_var = sd.get_variable(out_map[outs[0]]).sum()
+        grads = sd.calculate_gradients({}, loss_var.name, [ph])
+        np.testing.assert_allclose(grads[ph], want, rtol=2e-5, atol=1e-6)
+
+    def test_grad_through_imported_while_raises_cleanly(self):
+        """Reverse-mode over lax.while_loop (dynamic trip count) is
+        undefined in XLA — the limitation must surface as an error, not
+        silent garbage. (The reference's TF import shares the restriction
+        in spirit: its imported loops train only when unrolled.)"""
+        gd, ins, outs = _freeze_fn(
+            _loop_fn, tf.TensorSpec((2, 3), tf.float32), lower=False)
+        x = np.random.default_rng(4).normal(size=(2, 3)).astype(np.float32)
+        sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
+        from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+        ph = in_map[ins[0]]
+        sd._vars[ph].var_type = VariableType.VARIABLE
+        sd._values[ph] = x
+        loss_var = sd.get_variable(out_map[outs[0]]).sum()
+        with pytest.raises(ValueError, match="while_loop|fori_loop"):
+            sd.calculate_gradients({}, loss_var.name, [ph])
