@@ -738,6 +738,9 @@ def main():
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")
+    ap.add_argument("--canonical", action="store_true",
+                    help="with --kernels: mark the table canonical "
+                         "(requires a quiet host; recorded via loadavg)")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of one timed window "
                          "per config into DIR and append a top-op table")
@@ -775,7 +778,7 @@ def main():
     if args.kernels:
         from kernels_ab import run_kernels_ab  # local module, repo root
 
-        print(json.dumps(run_kernels_ab(diag)))
+        print(json.dumps(run_kernels_ab(diag, canonical=args.canonical)))
         return
 
     peak = peak_bf16_flops(diag.get("device_kind", "")) or None

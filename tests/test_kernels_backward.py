@@ -93,6 +93,21 @@ def test_flash_bwd_cross_attention_shapes():
     _assert_grads_close(got, want)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_single_kv_iteration_block(causal):
+    """block_k == seq collapses the sequential kv sweep to ONE grid step,
+    so _init (ki==0) and _finish (ki==n_k-1) fire on the same iteration —
+    the edge path the r5 wide-block sweep geometries (bk=T) rely on."""
+    q, k, v = _qkv(4, t=128, d=16)
+    fn = functools.partial(flash_attention, causal=causal,
+                           block_q=64, block_k=128)
+    ref = functools.partial(reference_attention, causal=causal)
+    out = fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(q, k, v)),
+                               atol=5e-5, rtol=1e-4)
+    _assert_grads_close(_grads(fn, q, k, v), _grads(ref, q, k, v))
+
+
 class TestLstmBackward:
     """Pallas LSTM fwd+bwd vs the XLA lax.scan reference (ops/rnn.py).
 
