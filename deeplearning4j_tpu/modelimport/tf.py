@@ -143,6 +143,16 @@ def _register_tfimport_ops():
         # lowers to dynamic_slice — static shapes, XLA-friendly
         return x[tuple(begin[i] for i in range(begin.shape[0]))]
 
+    # TensorList ops (keras RNN / TensorArray loops): a TF TensorList of
+    # static length and uniform element shape IS a dense [L, ...] array on
+    # TPU — SetItem is a dynamic_update_slice, GetItem a dynamic_slice,
+    # Stack/FromTensor the identity. No variant handles, no host objects.
+    def list_get(handle, index):
+        return handle[index]
+
+    def list_set(handle, index, item):
+        return handle.at[index].set(item)
+
     table = {
         "tfimport.einsum": einsum_tf,
         "tfimport.cumsum": cumsum_tf,
@@ -172,6 +182,9 @@ def _register_tfimport_ops():
         "tfimport.floor_div": jnp.floor_divide,
         "tfimport.floor_mod": jnp.mod,
         "tfimport.index_dyn": index_dyn,
+        "tfimport.list_get": list_get,
+        "tfimport.list_set": list_set,
+        "tfimport.list_length": lambda x: jnp.int32(x.shape[0]),
     }
     for name, fn in table.items():
         register_op(name, fn)
@@ -779,17 +792,20 @@ class _FunctionImporter(_GraphImporter):
         while pending:
             rest = []
             for nd in pending:
-                refs = [r.split(":")[0].lstrip("^") for r in nd.input]
+                # control inputs (^node) don't gate dataflow readiness —
+                # their targets (NoOps) register nothing in vars
+                refs = [r.split(":")[0] for r in nd.input
+                        if not r.startswith("^")]
                 if all(r in self.vars for r in refs):
                     self._node_ops[nd.name] = nd.op
                     self._process_node(nd)
                 else:
                     rest.append(nd)
             if len(rest) == len(pending):
-                missing = sorted({r.split(":")[0].lstrip("^")
-                                  for nd in rest for r in nd.input
-                                  if r.split(":")[0].lstrip("^")
-                                  not in self.vars})
+                missing = sorted({r.split(":")[0] for nd in rest
+                                  for r in nd.input
+                                  if not r.startswith("^")
+                                  and r.split(":")[0] not in self.vars})
                 raise TFImportError(
                     f"function {self.fdef.signature.name!r}: unresolvable "
                     f"refs {missing[:5]} (cycle or unsupported structure)")
@@ -927,8 +943,10 @@ def _reduction(our_op):
         x = imp.tensor(node.input[0])
         axes = imp.const_value(node.input[1])
         axes = [int(a) for a in np.atleast_1d(axes)]
+        # axes=[] (reduce over no axes — keras RNN's Max(maximum_iterations,
+        # range(0, rank=0)) emits this) is the identity reduction
         return imp.sd._record(our_op, [x], {
-            "axis": axes if len(axes) > 1 else axes[0],
+            "axis": axes if len(axes) != 1 else axes[0],
             "keepdims": bool(_attr(node, "keep_dims", False))})
 
     return mapper
@@ -983,6 +1001,64 @@ def _pack(imp, node):
     return imp.sd._record("stack", xs, {
         "__argspec__": ["var"] * len(xs), "__posattrs__": [],
         "axis": _attr(node, "axis", 0)})
+
+
+@tf_op("TensorListReserve")
+def _tensor_list_reserve(imp, node):
+    """A reserved TensorList of static length/element-shape is a dense
+    zeros [num_elements, *element_shape] array (see tfimport.list_* ops).
+    Dynamic element shapes (freeze with a symbolic batch) are refused —
+    the dense representation needs static shapes, like everything else
+    under jit."""
+    shp = np.atleast_1d(imp.const_value(node.input[0])).astype(np.int64)
+    num = int(np.atleast_1d(imp.const_value(node.input[1]))[0])
+    if shp.ndim != 1 or any(int(d) < 0 for d in shp) or num < 0:
+        raise TFImportError(
+            f"TensorListReserve {node.name}: dynamic element_shape "
+            f"{shp.tolist()} / num_elements {num}; freeze the graph with "
+            "concrete shapes (fixed batch) to import TensorList loops")
+    dtype = _np_dtype(_attr(node, "element_dtype", 1))
+    # lazy zeros via tfimport.fill — a dense numpy constant here would
+    # embed an O(T·batch·hidden) zeros array in the graph (and every
+    # serialization of it) for nothing; XLA materializes fill at trace
+    # time for free
+    zero = imp.sd.constant(_uniq(imp.sd, f"{node.name}_zero"),
+                           np.zeros((), dtype))
+    return imp.sd._record("tfimport.fill", [zero], {
+        "__argspec__": ["attr", "var"],
+        "__posattrs__": [[num, *[int(d) for d in shp]]]})
+
+
+@tf_op("TensorListFromTensor")
+def _tensor_list_from_tensor(imp, node):
+    return imp.tensor(node.input[0])
+
+
+@tf_op("TensorListStack")
+def _tensor_list_stack(imp, node):
+    return imp.tensor(node.input[0])
+
+
+@tf_op("TensorListGetItem")
+def _tensor_list_get_item(imp, node):
+    handle, idx = imp.tensor(node.input[0]), imp.tensor(node.input[1])
+    return imp.sd._record("tfimport.list_get", [handle, idx], {
+        "__argspec__": ["var", "var"], "__posattrs__": []})
+
+
+@tf_op("TensorListSetItem")
+def _tensor_list_set_item(imp, node):
+    handle = imp.tensor(node.input[0])
+    idx = imp.tensor(node.input[1])
+    item = imp.tensor(node.input[2])
+    return imp.sd._record("tfimport.list_set", [handle, idx, item], {
+        "__argspec__": ["var", "var", "var"], "__posattrs__": []})
+
+
+@tf_op("TensorListLength")
+def _tensor_list_length(imp, node):
+    return imp.sd._record("tfimport.list_length", [imp.tensor(node.input[0])],
+                          {"__argspec__": ["var"], "__posattrs__": []})
 
 
 @tf_op("While", "StatelessWhile")

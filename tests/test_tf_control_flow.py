@@ -278,3 +278,90 @@ class TestIfImport:
         loss_var = sd.get_variable(out_map[outs[0]]).sum()
         with pytest.raises(ValueError, match="while_loop|fori_loop"):
             sd.calculate_gradients({}, loss_var.name, [ph])
+
+
+class TestTensorListLoops:
+    """TensorList ops (keras RNN / TensorArray loops) as dense arrays:
+    SetItem = dynamic_update_slice, GetItem = dynamic_slice, Stack =
+    identity — the TPU-native representation of a static-length list."""
+
+    def test_tensor_array_accumulating_loop(self):
+        T = 6
+
+        def loop_seq(x):
+            ta = tf.TensorArray(tf.float32, size=T, element_shape=(2, 3))
+
+            def body(t, h, ta):
+                h2 = tf.tanh(x[t] + h)
+                return t + 1, h2, ta.write(t, h2)
+
+            _, _, ta = tf.while_loop(
+                lambda t, h, ta: t < T, body,
+                [0, tf.zeros((2, 3)), ta])
+            return ta.stack()
+
+        gd, ins, outs = _freeze_fn(
+            loop_seq, tf.TensorSpec((T, 2, 3), tf.float32), lower=False)
+        x = np.random.default_rng(5).normal(size=(T, 2, 3)).astype(np.float32)
+        want = np.asarray(loop_seq(tf.constant(x)))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_tensor_array_without_element_shape_refused(self):
+        """A TensorArray with undeclared element_shape freezes as
+        TensorListReserve(element_shape=-1) — strict refusal with a
+        message pointing at the fix (declare element_shape)."""
+        T = 4
+
+        def loop_seq(x):
+            ta = tf.TensorArray(tf.float32, size=T)
+
+            def body(t, ta):
+                return t + 1, ta.write(t, x[t] * 2.0)
+
+            _, ta = tf.while_loop(lambda t, ta: t < T, body, [0, ta])
+            return ta.stack()
+
+        gd, ins, outs = _freeze_fn(
+            loop_seq, tf.TensorSpec((T, 3), tf.float32), lower=False)
+        with pytest.raises(TFImportError, match="element_shape"):
+            import_tf_graph(gd, outputs=list(outs))
+
+    def test_keras_lstm_return_sequences_oracle(self):
+        """The real thing: a keras LSTM(return_sequences=True) frozen with
+        functional control flow — While + TensorListReserve/FromTensor/
+        GetItem/SetItem/Stack — imports and matches keras' output. This is
+        the dynamic_rnn-class graph the reference's TF import handles via
+        control-flow sessions (SURVEY §2.3)."""
+        from tensorflow import keras
+
+        m = keras.Sequential([
+            keras.layers.Input((12, 5)),
+            keras.layers.LSTM(8, return_sequences=True)])
+        gd, ins, outs = _freeze_fn(
+            lambda x: m(x, training=False),
+            tf.TensorSpec((2, 12, 5), tf.float32), lower=False)
+        ops = {n.op for n in gd.node}
+        assert "TensorListReserve" in ops and "While" in ops
+        x = np.random.default_rng(6).normal(size=(2, 12, 5)).astype(np.float32)
+        want = np.asarray(m(x, training=False))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                                   atol=2e-6)
+
+    def test_keras_gru_return_sequences_oracle(self):
+        from tensorflow import keras
+
+        m = keras.Sequential([
+            keras.layers.Input((10, 4)),
+            keras.layers.GRU(6, return_sequences=True,
+                             reset_after=True)])
+        gd, ins, outs = _freeze_fn(
+            lambda x: m(x, training=False),
+            tf.TensorSpec((3, 10, 4), tf.float32), lower=False)
+        x = np.random.default_rng(8).normal(size=(3, 10, 4)).astype(np.float32)
+        want = np.asarray(m(x, training=False))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                                   atol=2e-6)
