@@ -456,6 +456,10 @@ class _GraphImporter:
         for fr in frames:
             for n in fr.members:
                 frame_of[n] = fr
+        clusters = _collect_cond_clusters(self.gd, set(frame_of))
+        for cl in clusters:
+            for n in cl.members:
+                frame_of.setdefault(n, cl)  # same skip/trigger protocol
         # data-consumer map: placeholders nobody reads (the lowered form
         # emits unused_control_flow_input placeholders) are skipped, and
         # control-only stragglers of a processed frame are droppable
@@ -483,12 +487,14 @@ class _GraphImporter:
                         for r in node.input):
                     continue
                 raise
-        undone = [fr for fr in frames if not fr.done]
+        undone = [fr.name for fr in frames if not fr.done]
+        undone += [m.name for cl in clusters if not cl.done
+                   for m in cl.merges]
         if undone:
             raise TFImportError(
-                f"could not resolve TF1 control-flow frame(s) "
-                f"{[fr.name for fr in undone]}: loop-entry inputs never "
-                "became available (malformed or unsupported graph)")
+                f"could not resolve TF1 control-flow structure(s) "
+                f"{undone}: entry inputs never became available "
+                "(malformed or unsupported graph)")
         for out in outputs:
             name_map[out] = self.tensor(out).name
         return name_map
@@ -730,6 +736,150 @@ def _collect_frames(gd) -> list:
                       | {fr.loop_cond.name})
         frames.append(fr)
     return frames
+
+
+class _CondCluster:
+    """One lowered tf.cond: Switch(data, pred) pairs gate two branch
+    bodies joined by Merges (one per cond output; a multi-output cond
+    emits several Merges over ONE Switch set). Raised to a SINGLE
+    samediff.cond (lax.cond) so shared branch computation runs once:
+
+        pred = Switch.input[1]            (shared across the cluster)
+        Switch_i(data_i, pred): :1 -> true branch, :0 -> false branch
+        Merge_j(true_out_j, false_out_j) -> cond output j
+
+    Branch membership of each Merge input is decided by WHICH switch
+    output index its backward closure consumes (a constant-only branch
+    still reaches its pivot Switch through control edges)."""
+
+    def __init__(self, pred_ref: str):
+        self.pred_ref = pred_ref
+        self.merges: list = []
+        self.true_refs: list = []
+        self.false_refs: list = []
+        self.switches: list = []
+        self.members: set = set()
+        self.done = False
+
+    def ready(self, imp: _GraphImporter) -> bool:
+        return all(
+            sw.input[0].split(":")[0].lstrip("^") in imp.vars
+            and sw.input[1].split(":")[0].lstrip("^") in imp.vars
+            for sw in self.switches)
+
+    def process(self, imp: _GraphImporter) -> None:
+        by_name = {n.name: n for n in imp.gd.node}
+        pred = imp.tensor(self.pred_ref)
+        datas = [imp.tensor(sw.input[0]) for sw in self.switches]
+
+        def build(branch_refs) -> SameDiff:
+            sub = SameDiff.create()
+            bound = {}
+            for sw, d in zip(self.switches, datas):
+                bound[sw.name] = sub.placeholder(
+                    sw.name, d.shape, d.dtype or "float32")
+            bimp = _SubgraphImporter(by_name, imp.library, sub, bound)
+            sub.branch_outputs = [bimp.tensor(r).name for r in branch_refs]
+            return sub
+
+        res = imp.sd.cond(pred, build(self.true_refs),
+                          build(self.false_refs), datas)
+        res = res if isinstance(res, tuple) else (res,)
+        for m, out in zip(self.merges, res):
+            imp.vars[m.name] = out
+        self.done = True
+
+
+def _walk_cond_branch(by_name, start_ref: str, merge_name: str):
+    """Backward closure (data + control) from one Merge input, stopping at
+    Switch nodes. Returns (interior names, switch nodes in discovery
+    order, consumed switch-output indices)."""
+    interior, switches, idxs = set(), [], set()
+    seen_sw = set()
+    stack = [start_ref]
+    while stack:
+        ref = stack.pop()
+        name = ref.lstrip("^").split(":")[0]
+        if name in interior:
+            continue
+        node = by_name.get(name)
+        if node is None:
+            raise TFImportError(
+                f"cond at Merge {merge_name!r}: ref {name!r} missing")
+        if node.op == "Switch":
+            if name not in seen_sw:
+                seen_sw.add(name)
+                switches.append(node)
+            parts = ref.lstrip("^").split(":")
+            idxs.add(int(parts[1]) if len(parts) > 1 else 0)
+            continue
+        if node.op in ("Merge", "Enter", "Exit", "NextIteration",
+                       "LoopCond"):
+            raise TFImportError(
+                f"cond at Merge {merge_name!r} touches {node.op} node "
+                f"{name!r}: nested lowered control flow is not supported "
+                "(freeze with lower_control_flow=False)")
+        interior.add(name)
+        stack.extend(node.input)
+    return interior, switches, idxs
+
+
+def _collect_cond_clusters(gd, exclude: set) -> list:
+    """Identify lowered tf.cond clusters: Merges OUTSIDE while frames,
+    grouped by predicate so a multi-output cond (several Merges over one
+    Switch set) raises to ONE lax.cond with shared branch bodies."""
+    if gd is None:
+        return []
+    by_name = {n.name: n for n in gd.node}
+    by_pred: Dict[str, _CondCluster] = {}
+    for n in gd.node:
+        if n.op != "Merge" or n.name in exclude:
+            continue
+        data_in = [r for r in n.input if not r.startswith("^")]
+        if len(data_in) != 2:
+            raise TFImportError(
+                f"Merge {n.name}: {len(data_in)} data inputs; only 2-way "
+                "(tf.cond) merges are raiseable")
+        sides = {}
+        interior = set()
+        switches = []
+        for ref in data_in:
+            br_interior, br_switches, idxs = _walk_cond_branch(
+                by_name, ref, n.name)
+            interior |= br_interior
+            for sw in br_switches:
+                if sw.name not in {s.name for s in switches}:
+                    switches.append(sw)
+            if idxs == {1}:
+                sides["true"] = ref
+            elif idxs == {0}:
+                sides["false"] = ref
+            else:
+                raise TFImportError(
+                    f"Merge {n.name}: branch {ref!r} consumes switch "
+                    f"outputs {sorted(idxs)}; cannot assign it to one side")
+        if set(sides) != {"true", "false"}:
+            raise TFImportError(
+                f"Merge {n.name}: could not identify both branches")
+        if not switches:
+            raise TFImportError(f"Merge {n.name}: no gating Switch found")
+        preds = {sw.input[1] for sw in switches}
+        if len(preds) > 1:
+            raise TFImportError(
+                f"Merge {n.name}: switches disagree on the predicate "
+                f"({sorted(preds)}); unsupported cond shape")
+        pred_ref = switches[0].input[1]
+        cl = by_pred.get(pred_ref)
+        if cl is None:
+            cl = by_pred[pred_ref] = _CondCluster(pred_ref)
+        cl.merges.append(n)
+        cl.true_refs.append(sides["true"])
+        cl.false_refs.append(sides["false"])
+        for sw in switches:
+            if sw.name not in {s.name for s in cl.switches}:
+                cl.switches.append(sw)
+        cl.members |= interior | {n.name} | {sw.name for sw in switches}
+    return list(by_pred.values())
 
 
 _TF_OUT_ARG_OFFSETS = {

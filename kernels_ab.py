@@ -334,28 +334,36 @@ def run_kernels_ab(diag: dict, include_tune: bool = True,
         load_before = os.getloadavg()
     except OSError:  # pragma: no cover
         load_before = None
-    t0, cpu0 = time.time(), sum(os.times()[:4])
+    # Per-LEG load certification: each sample's own-CPU correction uses
+    # only that leg's interval, so it tracks the 1-min loadavg EWMA far
+    # better than a whole-run average (which would let early compile
+    # bursts mask late foreign load, or a long quiet tail fail a clean
+    # run). foreign ~ loadavg - own_cpu_share over the same interval.
+    leg_loads = []
+    certified = load_before is not None and load_before[0] < 2.0
+    t_leg, cpu_leg = time.time(), sum(os.times()[:4])
     for name, fn in legs:
         try:
             result[name] = fn()
         except Exception as e:  # noqa: BLE001 - record, keep going
             result[name] = {"error": str(e)[:300]}
-    try:
-        load_after = os.getloadavg()
-    except OSError:  # pragma: no cover
-        load_after = None
-    if load_before is not None and load_after is not None:
-        # The after-sample includes OUR OWN multi-threaded XLA compiles and
-        # dispatch loop — subtract this process's average CPU utilization
-        # over the run, or a quiet host could never certify a long
-        # tune-included run on the run's own account.
-        own_util = (sum(os.times()[:4]) - cpu0) / max(time.time() - t0, 1e-6)
-        foreign_after = max(0.0, load_after[0] - own_util)
+        try:
+            la = os.getloadavg()[0]
+        except OSError:  # pragma: no cover
+            certified = False
+            continue
+        now, cpu_now = time.time(), sum(os.times()[:4])
+        own = (cpu_now - cpu_leg) / max(now - t_leg, 1e-6)
+        foreign = max(0.0, la - own)
+        leg_loads.append({"leg": name, "load1": round(la, 2),
+                          "own_cpu_util": round(own, 2),
+                          "foreign_est": round(foreign, 2)})
+        if foreign >= 2.0:
+            certified = False
+        t_leg, cpu_leg = now, cpu_now
+    if load_before is not None:
         result["host_loadavg"] = {
             "before": [round(x, 2) for x in load_before],
-            "after": [round(x, 2) for x in load_after],
-            "own_cpu_util": round(own_util, 2),
-            "foreign_after_est": round(foreign_after, 2)}
-        result["canonical"] = bool(
-            canonical and load_before[0] < 2.0 and foreign_after < 2.0)
+            "per_leg": leg_loads}
+        result["canonical"] = bool(canonical and certified)
     return result

@@ -365,3 +365,73 @@ class TestTensorListLoops:
         (got,) = _import_and_run(gd, ins, outs, [x])
         np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
                                    atol=2e-6)
+
+
+class TestLoweredCondImport:
+    """Lowered (TF1-style) tf.cond — Switch/Merge without frames — raised
+    to lax.cond, matching the reference's Switch/Merge session semantics
+    (SURVEY §2.3)."""
+
+    def test_lowered_cond_both_branches(self):
+        def cond_fn(x):
+            return tf.cond(tf.reduce_sum(x) > 0.0,
+                           lambda: x * 2.0 + 1.0, lambda: x - 1.0)
+
+        gd, ins, outs = _freeze_fn(
+            cond_fn, tf.TensorSpec((2, 3), tf.float32), lower=True)
+        ops = {n.op for n in gd.node}
+        assert "Switch" in ops and "Merge" in ops and "Enter" not in ops
+        for sign in (+1.0, -1.0):
+            x = sign * np.abs(
+                np.random.default_rng(9).normal(size=(2, 3))
+            ).astype(np.float32)
+            want = np.asarray(cond_fn(tf.constant(x)))
+            (got,) = _import_and_run(gd, ins, outs, [x])
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_lowered_cond_identity_branch(self):
+        """One branch passes the operand straight through (Merge input IS
+        a Switch output) — the boundary-placeholder path."""
+
+        def cond_fn(x):
+            return tf.cond(tf.reduce_max(x) > 0.0,
+                           lambda: x, lambda: x * 3.0)
+
+        gd, ins, outs = _freeze_fn(
+            cond_fn, tf.TensorSpec((4,), tf.float32), lower=True)
+        for arr in ([1.0, -2.0, 3.0, 0.5], [-1.0, -2.0, -3.0, -0.5]):
+            x = np.asarray(arr, np.float32)
+            want = np.asarray(cond_fn(tf.constant(x)))
+            (got,) = _import_and_run(gd, ins, outs, [x])
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_lowered_multi_output_cond_single_lax_cond(self):
+        """A multi-output tf.cond lowers to several Merges over ONE
+        Switch set; the import must group them into a single __cond__ op
+        (shared branch compute runs once) and still match TF."""
+
+        def cond_fn(x):
+            def then():
+                y = x * 2.0
+                return y + 1.0, y - 1.0
+
+            def els():
+                return x - 3.0, x + 3.0
+
+            a, b = tf.cond(tf.reduce_sum(x) > 0.0, then, els)
+            return a * b
+
+        gd, ins, outs = _freeze_fn(
+            cond_fn, tf.TensorSpec((2, 2), tf.float32), lower=True)
+        assert sum(1 for n in gd.node if n.op == "Merge") >= 2
+        sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
+        n_conds = sum(1 for node in sd.ops() if node.op == "__cond__")
+        assert n_conds == 1, f"expected one grouped __cond__, got {n_conds}"
+        for sign in (+1.0, -1.0):
+            x = sign * np.abs(
+                np.random.default_rng(11).normal(size=(2, 2))
+            ).astype(np.float32)
+            want = np.asarray(cond_fn(tf.constant(x)))
+            res = sd.output({in_map[ins[0]]: x}, [out_map[outs[0]]])
+            np.testing.assert_allclose(res[out_map[outs[0]]], want,
+                                       rtol=1e-6)
