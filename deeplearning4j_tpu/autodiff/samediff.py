@@ -548,7 +548,16 @@ class SameDiff:
         try:
             out = jax.eval_shape(
                 lambda *vals: _replay_call_node(self, node, fn, list(vals)), *structs)
-        except Exception:
+        except Exception as e:
+            if op in ("__while__", "__cond__"):
+                # Control flow MUST infer: its output arity equals the
+                # carry/branch arity, and a silent single-unknown fallback
+                # would mis-wire every downstream consumer (carry dtype
+                # mismatches surface here, e.g. lax.while_loop rejecting
+                # an inconsistent body).
+                raise ValueError(
+                    f"control-flow op {op} failed shape inference: "
+                    f"{e}") from e
             return [_UnknownStruct()]
         leaves = out if isinstance(out, (tuple, list)) else [out]
         sym = any(v.shape is not None and any(d in (None, -1) for d in v.shape)

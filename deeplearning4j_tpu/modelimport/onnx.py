@@ -29,6 +29,7 @@ from deeplearning4j_tpu.autodiff.samediff import (
     OP_REGISTRY,
     SameDiff,
     SDVariable,
+    VariableType,
     register_op,
 )
 from deeplearning4j_tpu.modelimport.onnx_proto import (
@@ -593,6 +594,10 @@ def _register_onnximport_ops_ext():
         "resize_linear_half_pixel": resize_linear_half_pixel,
         "lstm": lstm, "gru": gru,
         "tile": lambda x, repeats: jnp.tile(x, tuple(int(r) for r in repeats)),
+        # Loop scan accumulation: dense [M, ...] array + dynamic_update_slice
+        "list_set": lambda acc, i, item: acc.at[i].set(item),
+        "scalar_bool": lambda x: jnp.reshape(x, ()).astype(jnp.bool_),
+        "fill": lambda dims, value: jnp.full(tuple(dims), value),
     }.items():
         register_op(f"onnximport.{name}", fn)
 
@@ -1325,6 +1330,197 @@ def _identity(imp, node):
     return v
 
 
+@onnx_op("If")
+def _if_onnx(imp, node):
+    """ONNX If → samediff.cond (lax.cond). Branch subgraphs take no
+    declared inputs; everything they read is implicit capture, which
+    becomes the cond's operand list (union of both branches, fixed
+    order, host-known captures inlined as constants)."""
+    a = node.attrs()
+    then_g, else_g = a.get("then_branch"), a.get("else_branch")
+    if not isinstance(then_g, GraphProto) or not isinstance(else_g, GraphProto):
+        raise ONNXImportError(
+            f"If {node.name!r}: then_branch/else_branch graph attrs missing")
+    if len(then_g.output) != len(else_g.output):
+        raise ONNXImportError(
+            f"If {node.name!r}: branches disagree on output count "
+            f"({len(then_g.output)} vs {len(else_g.output)})")
+    pred = _rec(imp, "onnximport.scalar_bool", [imp.tensor(node.input[0])])
+    all_caps, var_caps = _union_captures(imp, [then_g, else_g])
+    t_sub = _import_onnx_subgraph(imp, then_g, [], all_caps, var_caps).sd
+    f_sub = _import_onnx_subgraph(imp, else_g, [], all_caps, var_caps).sd
+    return imp.sd.cond(pred, t_sub, f_sub,
+                       [imp.tensor(c) for c in var_caps])
+
+
+@onnx_op("Loop")
+def _loop_onnx(imp, node):
+    """ONNX Loop → samediff.while_loop (lax.while_loop).
+
+    Loop(M?, cond?, v_1..N) with body (iter, cond_in, v_1..N) ->
+    (cond_out, v_1..N_out, scan_1..K). The carry is
+    (i, cond, v..., captures..., scan accumulators...); captures ride as
+    pass-through loop vars (loop-invariant), scan outputs accumulate via
+    dynamic_update_slice into a preallocated [M, ...] array.
+
+    Scan outputs need the dense preallocation, so K > 0 additionally
+    requires a host-known trip count M and an effectively-constant-true
+    loop condition (the standard for-loop export shape); ONNX's
+    dynamic-length scan semantics have no static-shape equivalent under
+    jit and are refused otherwise.
+    """
+    a = node.attrs()
+    body = a.get("body")
+    if not isinstance(body, GraphProto):
+        raise ONNXImportError(f"Loop {node.name!r}: body graph attr missing")
+    m_ref = node.input[0] if len(node.input) > 0 else ""
+    c_ref = node.input[1] if len(node.input) > 1 else ""
+    v_inits = [imp.tensor(r) for r in node.input[2:]]
+    n_v = len(v_inits)
+    if len(body.input) != 2 + n_v:
+        raise ONNXImportError(
+            f"Loop {node.name!r}: body takes {len(body.input)} inputs, "
+            f"expected {2 + n_v}")
+    n_scan = len(body.output) - 1 - n_v
+    if n_scan < 0:
+        raise ONNXImportError(
+            f"Loop {node.name!r}: body yields {len(body.output)} outputs "
+            f"for {n_v} loop vars")
+
+    sd = imp.sd
+    zero = sd.constant(imp.fresh_const_name(f"{node.name}_i0"),
+                       np.zeros((), np.int32))
+    has_m = bool(m_ref)
+    m_var = imp.tensor(m_ref) if has_m else None
+    m_const = None
+    if has_m and m_ref in imp.consts:
+        m_const = int(np.asarray(imp.consts[m_ref]).reshape(()))
+    if c_ref:
+        cond0 = _rec(imp, "onnximport.scalar_bool", [imp.tensor(c_ref)])
+    else:
+        cond0 = sd.constant(imp.fresh_const_name(f"{node.name}_true"),
+                            np.asarray(True))
+    if has_m:
+        # first-iteration gate: run iff cond0 AND 0 < M
+        cond0 = _rec(imp, "math.logical_and", [
+            cond0, _rec(imp, "lt", [zero, m_var])])
+    # lax.while_loop cond must be a SCALAR bool; scalar initializers can
+    # decode as shape-(1,) tensors, which would poison the whole carry
+    cond0 = _rec(imp, "onnximport.scalar_bool", [cond0])
+
+    all_caps, var_caps = _union_captures(imp, [body])
+    # iter/cond/v placeholders take the INIT vars' shapes; the body's
+    # declared input value-infos are usually shapeless in real exports
+    class _Spec:
+        def __init__(self, shape, dtype):
+            self.shape, self.dtype = shape, dtype
+
+    declared = [_Spec((), "int32"), _Spec((), "bool")] + [
+        _Spec(v.shape, v.dtype or "float32") for v in v_inits]
+    simp = _import_onnx_subgraph(imp, body, declared, all_caps, var_caps)
+    bsd = simp.sd
+    iter_ph = bsd._vars[body.input[0].name]
+    cond_out = bsd._vars[bsd.branch_outputs[0]]
+    v_outs = list(bsd.branch_outputs[1:1 + n_v])
+    scan_outs = [bsd._vars[n] for n in bsd.branch_outputs[1 + n_v:]]
+
+    # placeholder DECLARATION order defines the positional carry mapping
+    # (_as_branch_fn): [i, cond, v..., caps...] are declared by the
+    # subgraph import; M (if any) must come before the accumulators
+    m_ph = bsd.placeholder(f"__{node.name}_M", (), "int32") if has_m else None
+
+    # scan accumulators: preallocated dense arrays, written at carry's i
+    accs = []
+    acc_body_outs = []
+    if n_scan:
+        if m_const is None:
+            raise ONNXImportError(
+                f"Loop {node.name!r}: scan outputs need a host-constant "
+                "trip count M (dynamic-length scans have no static shape)")
+        if c_ref and not (c_ref in imp.consts
+                          and bool(np.asarray(imp.consts[c_ref]).reshape(()))):
+            raise ONNXImportError(
+                f"Loop {node.name!r}: scan outputs require a constant-true "
+                "initial condition (for-loop form)")
+        # ...and the BODY must provably keep it true (constant or cond
+        # passthrough): an early data-dependent exit would shorten the
+        # scan dimension, which has no static-shape equivalent
+        cond_is_pass = cond_out.name == body.input[1].name
+        cond_is_const_true = (
+            cond_out.var_type == VariableType.CONSTANT
+            and bool(np.asarray(bsd._values[cond_out.name]).reshape(())))
+        if not (cond_is_pass or cond_is_const_true):
+            raise ONNXImportError(
+                f"Loop {node.name!r}: scan outputs require a for-loop body "
+                "(cond_out must be constant true or the cond passthrough); "
+                f"got computed condition {cond_out.name!r}")
+        for sv in scan_outs:
+            if sv.shape is None or any(d in (None, -1)
+                                       for d in (sv.shape or ())):
+                raise ONNXImportError(
+                    f"Loop {node.name!r}: scan output {sv.name!r} has "
+                    f"unknown shape {sv.shape}; cannot preallocate")
+            acc_shape = (m_const, *[int(d) for d in sv.shape])
+            acc_dtype = str(np.dtype(sv.dtype or "float32"))
+            # lazy fill, not a dense zeros constant — same rationale as
+            # the TF TensorListReserve mapper (no O(M·elem) zero bytes in
+            # the graph or its serializations)
+            acc_zero = sd.constant(
+                imp.fresh_const_name(f"{node.name}_acc_zero"),
+                np.zeros((), acc_dtype))
+            accs.append(sd._record("onnximport.fill", [acc_zero], {
+                "__argspec__": ["attr", "var"],
+                "__posattrs__": [list(acc_shape)]}))
+            acc_ph = bsd.placeholder(
+                f"__{node.name}_acc{len(acc_body_outs)}", acc_shape,
+                acc_dtype)
+            new_acc = bsd._record("onnximport.list_set",
+                                  [acc_ph, iter_ph, sv], {
+                                      "__argspec__": ["var", "var", "var"],
+                                      "__posattrs__": []})
+            acc_body_outs.append(new_acc.name)
+
+    # body-side: i+1 and the next-iteration condition
+    bsd_one = bsd.constant("__loop_one", np.ones((), np.int32))
+    new_i = bsd._record("add", [iter_ph, bsd_one], {})
+    cond_next = bsd._record("onnximport.scalar_bool", [cond_out], {})
+    if has_m:
+        cond_next = bsd._record("math.logical_and", [
+            cond_next, bsd._record("lt", [new_i, m_ph], {})], {})
+        cond_next = bsd._record("onnximport.scalar_bool", [cond_next], {})
+    bsd.branch_outputs = (
+        [new_i.name, cond_next.name] + v_outs
+        + list(var_caps) + ([m_ph.name] if has_m else []) + acc_body_outs)
+
+    # cond graph: pass-through read of the carried bool
+    csd = SameDiff.create()
+    csd.placeholder("__i", (), "int32")
+    c_ph = csd.placeholder("__cond", (), "bool")
+    for i, v in enumerate(v_inits):
+        csd.placeholder(f"__v{i}", v.shape, v.dtype or "float32")
+    for i, c in enumerate(var_caps):
+        cv = imp.tensor(c)
+        csd.placeholder(f"__c{i}", cv.shape, cv.dtype or "float32")
+    if has_m:
+        csd.placeholder("__M", (), "int32")
+    for i, acc in enumerate(accs):
+        csd.placeholder(f"__a{i}", acc.shape, acc.dtype)
+    csd.branch_outputs = [c_ph.name]
+
+    m_scalar = None
+    if has_m:
+        m_scalar = sd._record("reshape", [sd._record(
+            "cast", [m_var], {"dtype": "int32"})], {"shape": []})
+    inits = ([zero, cond0] + v_inits
+             + [imp.tensor(c) for c in var_caps]
+             + ([m_scalar] if has_m else []) + accs)
+    res = sd.while_loop(csd, bsd, inits)
+    res = res if isinstance(res, tuple) else (res,)
+    v_finals = tuple(res[2:2 + n_v])
+    scan_finals = tuple(res[2 + n_v + len(var_caps) + (1 if has_m else 0):])
+    return v_finals + scan_finals
+
+
 # --- host constant folding --------------------------------------------------
 # Real exporters (torch.onnx above all) compute shape arguments with small
 # on-graph arithmetic chains: Shape → Gather → Unsqueeze → Concat/Mul feeds
@@ -1514,6 +1710,10 @@ class _GraphImporter:
                 vi.type.elem_type if vi.type else 1, "float32")
             self.vars[vi.name] = self.sd.placeholder(vi.name, shape, dtype)
 
+        self._process_nodes()
+        return {out: self.tensor(out).name for out in outputs}
+
+    def _process_nodes(self) -> None:
         for node in self.g.node:
             if node.domain not in ("", "ai.onnx"):
                 raise ONNXImportError(
@@ -1530,7 +1730,100 @@ class _GraphImporter:
                     self.vars[ref] = var
             self._try_fold(node)
 
-        return {out: self.tensor(out).name for out in outputs}
+
+# --- control flow (If / Loop) ----------------------------------------------
+#
+# ONNX subgraphs (If branches, Loop bodies) reference outer-scope values BY
+# NAME (implicit capture) — unlike TF FunctionDefs, which take explicit
+# args. Raising onto samediff.cond / samediff.while_loop therefore turns
+# every captured name into a branch placeholder (or an inlined constant,
+# when the outer value is host-known) bound positionally at the call site.
+# Loop compiles to lax.while_loop with carry (i, cond, loop-vars, captures,
+# scan accumulators); scan outputs use the dense-accumulator pattern
+# (dynamic_update_slice into a preallocated [M, ...] array — the same
+# TPU-native representation the TF TensorList import uses).
+
+
+def _graph_captures(graph: GraphProto) -> list:
+    """Names a subgraph reads from the enclosing scope, in discovery
+    order — including reads made by nested subgraphs (a nested If inside
+    a Loop body captures through BOTH levels unless produced locally)."""
+    produced = {t.name for t in graph.initializer}
+    produced |= {vi.name for vi in graph.input}
+    caps, seen = [], set()
+    for node in graph.node:
+        for ref in node.input:
+            if ref and ref not in produced and ref not in seen:
+                seen.add(ref)
+                caps.append(ref)
+        for a in node.attribute:
+            if a.g is not None:
+                for c in _graph_captures(a.g):
+                    if c not in produced and c not in seen:
+                        seen.add(c)
+                        caps.append(c)
+        produced |= {o for o in node.output if o}
+    # A declared output can name an outer value directly (a passthrough
+    # branch with no Identity node) — that read is a capture too
+    for o in graph.output:
+        if o.name and o.name not in produced and o.name not in seen:
+            seen.add(o.name)
+            caps.append(o.name)
+    return caps
+
+
+def _union_captures(imp: "_GraphImporter", graphs) -> Tuple[list, list]:
+    """(all_caps, var_caps): ordered union of the graphs' captures; the
+    var_caps subset is NOT host-known in the outer scope and must ride as
+    placeholders/loop carry (host-known captures inline as constants so
+    shape/axis consumers keep working)."""
+    caps, seen = [], set()
+    for g in graphs:
+        for c in _graph_captures(g):
+            if c not in seen:
+                seen.add(c)
+                caps.append(c)
+    var_caps = [c for c in caps if c not in imp.consts]
+    for c in var_caps:
+        imp.tensor(c)  # fail early with the standard unknown-value error
+    return caps, var_caps
+
+
+def _import_onnx_subgraph(imp: "_GraphImporter", graph: GraphProto,
+                          declared_vars, all_caps, var_caps):
+    """Import a branch/body GraphProto into a fresh SameDiff.
+
+    Placeholder declaration order (positional contract with
+    _as_branch_fn): graph.input (bound to declared_vars' shapes/dtypes)
+    first, then var_caps. Host-known captures become subgraph constants.
+    branch_outputs = the graph's declared outputs. Returns the importer
+    (callers may need to record extra ops, e.g. Loop's accumulators).
+    """
+    if len(declared_vars) != len(graph.input):
+        raise ONNXImportError(
+            f"subgraph {graph.name!r} takes {len(graph.input)} inputs, "
+            f"got {len(declared_vars)}")
+    sub = SameDiff.create()
+    simp = _GraphImporter(graph, {}, sub)
+    for vi, v in zip(graph.input, declared_vars):
+        simp.vars[vi.name] = sub.placeholder(
+            vi.name, getattr(v, "shape", None),
+            getattr(v, "dtype", None) or "float32")
+    for c in var_caps:
+        v = imp.tensor(c)
+        simp.vars[c] = sub.placeholder(c, v.shape, v.dtype or "float32")
+    for c in all_caps:
+        if c in imp.consts:
+            arr = imp.consts[c]
+            simp.consts[c] = arr
+            simp.vars[c] = sub.constant(simp.fresh_const_name(c), arr)
+    for t in graph.initializer:
+        arr = t.to_numpy()
+        simp.consts[t.name] = arr
+        simp.vars[t.name] = sub.constant(simp.fresh_const_name(t.name), arr)
+    simp._process_nodes()
+    sub.branch_outputs = [simp.tensor(o.name).name for o in graph.output]
+    return simp
 
 
 def import_onnx_model(

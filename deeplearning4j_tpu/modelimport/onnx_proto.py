@@ -354,6 +354,7 @@ class AttributeProto:
     i: int = 0
     s: bytes = b""
     t: Optional[TensorProto] = None
+    g: Optional["GraphProto"] = None  # control-flow branch/body graphs
     floats: List[float] = field(default_factory=list)
     ints: List[int] = field(default_factory=list)
     strings: List[bytes] = field(default_factory=list)
@@ -367,6 +368,8 @@ class AttributeProto:
             return self.s.decode()
         if self.type == ATTR_TENSOR:
             return self.t
+        if self.type == ATTR_GRAPH:
+            return self.g
         if self.type == ATTR_FLOATS:
             return list(self.floats)
         if self.type == ATTR_INTS:
@@ -389,6 +392,8 @@ class AttributeProto:
             _write_len_delim(buf, 4, self.s)
         elif self.type == ATTR_TENSOR:
             _write_len_delim(buf, 5, self.t.encode())
+        elif self.type == ATTR_GRAPH:
+            _write_len_delim(buf, 6, self.g.encode())
         elif self.type == ATTR_FLOATS:
             _write_len_delim(buf, 7, np.asarray(self.floats, "<f4").tobytes())
         elif self.type == ATTR_INTS:
@@ -414,6 +419,10 @@ class AttributeProto:
                 out.s = val
             elif num == 5 and wt == _WT_LEN:
                 out.t = TensorProto.decode(val)
+            elif num == 6 and wt == _WT_LEN:
+                # GraphProto is defined later in this module; by decode
+                # time (runtime) the name resolves
+                out.g = GraphProto.decode(val)
             elif num == 7:
                 out.floats.extend(_packed_or_single_f32(wt, val))
             elif num == 8:
@@ -427,6 +436,8 @@ class AttributeProto:
             # Pre-IR3 writers omit `type`; infer from the populated field.
             if out.t is not None:
                 out.type = ATTR_TENSOR
+            elif out.g is not None:
+                out.type = ATTR_GRAPH
             elif out.floats:
                 out.type = ATTR_FLOATS
             elif out.ints:

@@ -1,0 +1,299 @@
+"""ONNX control-flow import (If / Loop → lax.cond / lax.while_loop).
+
+Oracle layers match test_onnx_import.py: hand-built fixture models with
+hand-computed expected values (precise corner cases: implicit capture,
+loop-carried state, scan outputs, strict refusals), plus a REAL
+torch.onnx scripted export containing a Loop.
+"""
+
+import io
+
+import numpy as np
+import pytest
+import torch
+
+from deeplearning4j_tpu.modelimport.onnx import (
+    ONNXImportError,
+    import_onnx_model,
+)
+from deeplearning4j_tpu.modelimport.onnx_proto import (
+    ATTR_GRAPH,
+    AttributeProto,
+    GraphProto,
+    ModelProto,
+    NodeProto,
+    OperatorSetIdProto,
+    TensorProto,
+    TensorShapeProto,
+    TypeProto,
+    ValueInfoProto,
+)
+
+
+def _vi(name, shape, elem_type=1):
+    return ValueInfoProto(
+        name=name,
+        type=TypeProto(elem_type=elem_type,
+                       shape=TensorShapeProto(list(shape))),
+    )
+
+
+def _node(op_type, inputs, outputs, name="", **attrs):
+    protos = []
+    for k, v in attrs.items():
+        if isinstance(v, GraphProto):
+            protos.append(AttributeProto(name=k, type=ATTR_GRAPH, g=v))
+        else:
+            raise TypeError(f"attr {k}: {type(v)}")
+    return NodeProto(input=list(inputs), output=list(outputs), name=name,
+                     op_type=op_type, attribute=protos)
+
+
+def _model(nodes, inputs, outputs, initializers=(), opset=17):
+    g = GraphProto(
+        node=list(nodes), name="g",
+        initializer=[TensorProto.from_numpy(a, name=n)
+                     for n, a in initializers],
+        input=list(inputs), output=list(outputs),
+    )
+    return ModelProto(ir_version=8, producer_name="dl4j-tpu-tests", graph=g,
+                      opset_import=[OperatorSetIdProto(domain="",
+                                                       version=opset)])
+
+
+class TestIf:
+    def _if_model(self):
+        # then: y = x * 2 ; else: y = x - 3  — x is an implicit capture
+        then_g = GraphProto(
+            node=[NodeProto(input=["x", "two"], output=["y"],
+                            op_type="Mul")],
+            name="then",
+            initializer=[TensorProto.from_numpy(
+                np.asarray(2.0, np.float32), name="two")],
+            input=[], output=[_vi("y", (2, 3))])
+        else_g = GraphProto(
+            node=[NodeProto(input=["x", "three"], output=["y"],
+                            op_type="Sub")],
+            name="else",
+            initializer=[TensorProto.from_numpy(
+                np.asarray(3.0, np.float32), name="three")],
+            input=[], output=[_vi("y", (2, 3))])
+        m = _model(
+            [_node("If", ["p"], ["out"], then_branch=then_g,
+                   else_branch=else_g)],
+            inputs=[_vi("p", (), elem_type=9), _vi("x", (2, 3))],
+            outputs=[_vi("out", (2, 3))])
+        return m
+
+    def test_if_both_branches(self):
+        sd, in_map, out_map = import_onnx_model(self._if_model().encode())
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        for p, want in ((True, x * 2), (False, x - 3)):
+            res = sd.output({in_map["p"]: np.asarray(p),
+                             in_map["x"]: x}, [out_map["out"]])
+            np.testing.assert_allclose(res[out_map["out"]], want, rtol=1e-6)
+
+    def test_if_branch_output_count_mismatch_refused(self):
+        then_g = GraphProto(
+            node=[NodeProto(input=["x", "x"], output=["y"], op_type="Add")],
+            name="then", input=[], output=[_vi("y", (2,))])
+        else_g = GraphProto(
+            node=[NodeProto(input=["x", "x"], output=["y"], op_type="Add"),
+                  NodeProto(input=["x", "x"], output=["z"], op_type="Mul")],
+            name="else", input=[], output=[_vi("y", (2,)), _vi("z", (2,))])
+        m = _model(
+            [_node("If", ["p"], ["out"], then_branch=then_g,
+                   else_branch=else_g)],
+            inputs=[_vi("p", (), elem_type=9), _vi("x", (2,))],
+            outputs=[_vi("out", (2,))])
+        with pytest.raises(ONNXImportError, match="output count"):
+            import_onnx_model(m.encode())
+
+
+class TestLoop:
+    def _loop_model(self, with_scan=True, m_init=5):
+        # body: v_out = v + w (w: implicit capture from outer scope);
+        # scan = v_out * v_out; cond passthrough
+        body_nodes = [
+            NodeProto(input=["cond_in"], output=["cond_out"],
+                      op_type="Identity"),
+            NodeProto(input=["v_in", "w"], output=["v_out"], op_type="Add"),
+        ]
+        body_outputs = [_vi("cond_out", (), elem_type=9),
+                        _vi("v_out", (2,))]
+        if with_scan:
+            body_nodes.append(NodeProto(input=["v_out", "v_out"],
+                                        output=["scan"], op_type="Mul"))
+            body_outputs.append(_vi("scan", (2,)))
+        body = GraphProto(
+            node=body_nodes, name="body",
+            input=[_vi("iter", (), elem_type=7),
+                   _vi("cond_in", (), elem_type=9),
+                   _vi("v_in", (2,))],
+            output=body_outputs)
+        outputs = [_vi("v_final", (2,))]
+        node_outputs = ["v_final"]
+        if with_scan:
+            outputs.append(_vi("scans", (m_init, 2)))
+            node_outputs.append("scans")
+        m = _model(
+            [_node("Loop", ["M", "", "v0"], node_outputs, body=body)],
+            inputs=[_vi("v0", (2,))],
+            outputs=outputs,
+            initializers=[("M", np.asarray(m_init, np.int64)),
+                          ("w", np.asarray([1.0, 10.0], np.float32))])
+        return m
+
+    def test_for_loop_with_scan_outputs(self):
+        sd, in_map, out_map = import_onnx_model(
+            self._loop_model(with_scan=True).encode())
+        v0 = np.asarray([0.5, -1.0], np.float32)
+        w = np.asarray([1.0, 10.0], np.float32)
+        v = v0.copy()
+        scans = []
+        for _ in range(5):
+            v = v + w
+            scans.append(v * v)
+        res = sd.output({in_map["v0"]: v0},
+                        [out_map["v_final"], out_map["scans"]])
+        np.testing.assert_allclose(res[out_map["v_final"]], v, rtol=1e-6)
+        np.testing.assert_allclose(res[out_map["scans"]],
+                                   np.stack(scans), rtol=1e-6)
+
+    def test_loop_without_scan(self):
+        sd, in_map, out_map = import_onnx_model(
+            self._loop_model(with_scan=False).encode())
+        v0 = np.asarray([2.0, 3.0], np.float32)
+        want = v0 + 5 * np.asarray([1.0, 10.0], np.float32)
+        res = sd.output({in_map["v0"]: v0}, [out_map["v_final"]])
+        np.testing.assert_allclose(res[out_map["v_final"]], want, rtol=1e-6)
+
+    def test_early_exit_loop_cond_carried(self):
+        """Data-dependent early exit (the while form, no scan outputs):
+        cond computed in the body from the loop state."""
+        # body: v_out = v * 2 ; cond_out = ReduceSum(v_out) < 100
+        body = GraphProto(
+            node=[
+                NodeProto(input=["v_in", "two"], output=["v_out"],
+                          op_type="Mul"),
+                NodeProto(input=["v_out"], output=["s"],
+                          op_type="ReduceSum",
+                          attribute=[AttributeProto(name="keepdims", type=2,
+                                                    i=0)]),
+                NodeProto(input=["s", "hundred"], output=["cond_out"],
+                          op_type="Less"),
+            ],
+            name="body",
+            input=[_vi("iter", (), elem_type=7),
+                   _vi("cond_in", (), elem_type=9),
+                   _vi("v_in", (2,))],
+            output=[_vi("cond_out", (), elem_type=9), _vi("v_out", (2,))])
+        m = _model(
+            [_node("Loop", ["M", "c0", "v0"], ["v_final"], body=body)],
+            inputs=[_vi("v0", (2,))],
+            outputs=[_vi("v_final", (2,))],
+            initializers=[("M", np.asarray(100, np.int64)),
+                          ("c0", np.asarray(True)),
+                          ("two", np.asarray(2.0, np.float32)),
+                          ("hundred", np.asarray(100.0, np.float32))])
+        sd, in_map, out_map = import_onnx_model(m.encode())
+        v0 = np.asarray([1.0, 2.0], np.float32)
+        # 3->6->12->24->48->96->192: sum first reaches >=100 at 64+128=192?
+        v = v0.copy()
+        for _ in range(100):
+            v = v * 2
+            if not (v.sum() < 100.0):
+                break
+        res = sd.output({in_map["v0"]: v0}, [out_map["v_final"]])
+        np.testing.assert_allclose(res[out_map["v_final"]], v, rtol=1e-6)
+
+    def test_scan_with_computed_condition_refused(self):
+        """Scan outputs + data-dependent exit = dynamic scan length: no
+        static-shape equivalent, must refuse loudly."""
+        body = GraphProto(
+            node=[
+                NodeProto(input=["v_in", "v_in"], output=["v_out"],
+                          op_type="Add"),
+                NodeProto(input=["v_out"], output=["s"],
+                          op_type="ReduceSum",
+                          attribute=[AttributeProto(name="keepdims", type=2,
+                                                    i=0)]),
+                NodeProto(input=["s", "hundred"], output=["cond_out"],
+                          op_type="Less"),
+                NodeProto(input=["v_out", "v_out"], output=["scan"],
+                          op_type="Mul"),
+            ],
+            name="body",
+            input=[_vi("iter", (), elem_type=7),
+                   _vi("cond_in", (), elem_type=9),
+                   _vi("v_in", (2,))],
+            output=[_vi("cond_out", (), elem_type=9), _vi("v_out", (2,)),
+                    _vi("scan", (2,))])
+        m = _model(
+            [_node("Loop", ["M", "", "v0"], ["v_final", "scans"],
+                   body=body)],
+            inputs=[_vi("v0", (2,))],
+            outputs=[_vi("v_final", (2,)), _vi("scans", (4, 2))],
+            initializers=[("M", np.asarray(4, np.int64)),
+                          ("hundred", np.asarray(100.0, np.float32))])
+        with pytest.raises(ONNXImportError, match="for-loop body"):
+            import_onnx_model(m.encode())
+
+
+class TestTorchScriptedExport:
+    @pytest.fixture(autouse=True)
+    def _patch_onnxscript_merge(self):
+        # the legacy exporter's final merge step needs the onnx module
+        # (absent in this image) only to inline onnxscript functions we
+        # don't use — same patch as test_onnx_torch_export.py
+        from torch.onnx._internal.torchscript_exporter import (
+            onnx_proto_utils,
+        )
+
+        orig = onnx_proto_utils._add_onnxscript_fn
+        onnx_proto_utils._add_onnxscript_fn = \
+            lambda model_bytes, custom_opsets: model_bytes
+        yield
+        onnx_proto_utils._add_onnxscript_fn = orig
+
+    def test_scripted_loop_module(self):
+        """A REAL torch.onnx export of a scripted module with a for loop
+        (emits ONNX Loop) — imported output matches torch."""
+
+        class LoopNet(torch.nn.Module):
+            def forward(self, x):
+                acc = torch.zeros_like(x[0])
+                for i in range(x.size(0)):
+                    acc = torch.tanh(acc + x[i])
+                return acc
+
+        m = torch.jit.script(LoopNet())
+        x = torch.randn(5, 3, dtype=torch.float32)
+        buf = io.BytesIO()
+        torch.onnx.export(m, (x,), buf, opset_version=13, dynamo=False,
+                          input_names=["x"], output_names=["out"])
+        want = m(x).detach().numpy()
+        sd, in_map, out_map = import_onnx_model(buf.getvalue())
+        res = sd.output({in_map["x"]: x.numpy()}, [out_map["out"]])
+        np.testing.assert_allclose(res[out_map["out"]], want, rtol=2e-5,
+                                   atol=1e-6)
+
+    def test_if_passthrough_branch_output(self):
+        """A branch whose declared output directly names an outer value
+        (no Identity node) — the output itself is an implicit capture."""
+        then_g = GraphProto(
+            node=[NodeProto(input=["x", "x"], output=["y"], op_type="Add")],
+            name="then", input=[], output=[_vi("y", (3,))])
+        else_g = GraphProto(node=[], name="else", input=[],
+                            output=[_vi("x", (3,))])
+        m = _model(
+            [_node("If", ["p"], ["out"], then_branch=then_g,
+                   else_branch=else_g)],
+            inputs=[_vi("p", (), elem_type=9), _vi("x", (3,))],
+            outputs=[_vi("out", (3,))])
+        sd, in_map, out_map = import_onnx_model(m.encode())
+        x = np.asarray([1.0, 2.0, 3.0], np.float32)
+        for p, want in ((True, x + x), (False, x)):
+            res = sd.output({in_map["p"]: np.asarray(p), in_map["x"]: x},
+                            [out_map["out"]])
+            np.testing.assert_allclose(res[out_map["out"]], want, rtol=1e-6)
