@@ -594,8 +594,10 @@ def _register_onnximport_ops_ext():
         "resize_linear_half_pixel": resize_linear_half_pixel,
         "lstm": lstm, "gru": gru,
         "tile": lambda x, repeats: jnp.tile(x, tuple(int(r) for r in repeats)),
-        # Loop scan accumulation: dense [M, ...] array + dynamic_update_slice
+        # Loop/Scan accumulation: dense [T, ...] array + dynamic slices
         "list_set": lambda acc, i, item: acc.at[i].set(item),
+        "list_get": lambda x, i: x[i],
+        "flip0": lambda x: jnp.flip(x, 0),
         "scalar_bool": lambda x: jnp.reshape(x, ()).astype(jnp.bool_),
         "fill": lambda dims, value: jnp.full(tuple(dims), value),
     }.items():
@@ -1330,6 +1332,36 @@ def _identity(imp, node):
     return v
 
 
+def _make_scan_accumulators(imp, bsd, iter_ph, trip, scan_out_vars,
+                            node_name):
+    """Preallocated dense accumulators for per-iteration scan outputs
+    (shared by Loop and Scan): an outer lazy fill [trip, *elem] per
+    output, a body-side placeholder, and a list_set write at the carry's
+    iteration index. Returns (outer accs, body output names)."""
+    accs, acc_body_outs = [], []
+    for sv in scan_out_vars:
+        if sv.shape is None or any(d in (None, -1)
+                                   for d in (sv.shape or ())):
+            raise ONNXImportError(
+                f"{node_name!r}: scan output {sv.name!r} has unknown "
+                f"shape {sv.shape}; cannot preallocate")
+        acc_shape = (trip, *[int(d) for d in sv.shape])
+        acc_dtype = str(np.dtype(sv.dtype or "float32"))
+        # lazy fill, not a dense zeros constant — no O(T·elem) zero bytes
+        # in the graph or its serializations
+        acc_zero = imp.sd.constant(
+            imp.fresh_const_name(f"{node_name}_acc_zero"),
+            np.zeros((), acc_dtype))
+        accs.append(imp.sd._record("onnximport.fill", [acc_zero], {
+            "__argspec__": ["attr", "var"],
+            "__posattrs__": [list(acc_shape)]}))
+        acc_ph = bsd.placeholder(
+            f"__{node_name}_acc{len(acc_body_outs)}", acc_shape, acc_dtype)
+        acc_body_outs.append(bsd._record(
+            "onnximport.list_set", [acc_ph, iter_ph, sv], {}).name)
+    return accs, acc_body_outs
+
+
 @onnx_op("If")
 def _if_onnx(imp, node):
     """ONNX If → samediff.cond (lax.cond). Branch subgraphs take no
@@ -1454,31 +1486,8 @@ def _loop_onnx(imp, node):
                 f"Loop {node.name!r}: scan outputs require a for-loop body "
                 "(cond_out must be constant true or the cond passthrough); "
                 f"got computed condition {cond_out.name!r}")
-        for sv in scan_outs:
-            if sv.shape is None or any(d in (None, -1)
-                                       for d in (sv.shape or ())):
-                raise ONNXImportError(
-                    f"Loop {node.name!r}: scan output {sv.name!r} has "
-                    f"unknown shape {sv.shape}; cannot preallocate")
-            acc_shape = (m_const, *[int(d) for d in sv.shape])
-            acc_dtype = str(np.dtype(sv.dtype or "float32"))
-            # lazy fill, not a dense zeros constant — same rationale as
-            # the TF TensorListReserve mapper (no O(M·elem) zero bytes in
-            # the graph or its serializations)
-            acc_zero = sd.constant(
-                imp.fresh_const_name(f"{node.name}_acc_zero"),
-                np.zeros((), acc_dtype))
-            accs.append(sd._record("onnximport.fill", [acc_zero], {
-                "__argspec__": ["attr", "var"],
-                "__posattrs__": [list(acc_shape)]}))
-            acc_ph = bsd.placeholder(
-                f"__{node.name}_acc{len(acc_body_outs)}", acc_shape,
-                acc_dtype)
-            new_acc = bsd._record("onnximport.list_set",
-                                  [acc_ph, iter_ph, sv], {
-                                      "__argspec__": ["var", "var", "var"],
-                                      "__posattrs__": []})
-            acc_body_outs.append(new_acc.name)
+        accs, acc_body_outs = _make_scan_accumulators(
+            imp, bsd, iter_ph, m_const, scan_outs, node.name)
 
     # body-side: i+1 and the next-iteration condition
     bsd_one = bsd.constant("__loop_one", np.ones((), np.int32))
@@ -1519,6 +1528,134 @@ def _loop_onnx(imp, node):
     v_finals = tuple(res[2:2 + n_v])
     scan_finals = tuple(res[2 + n_v + len(var_caps) + (1 if has_m else 0):])
     return v_finals + scan_finals
+
+
+@onnx_op("Scan")
+def _scan_onnx(imp, node):
+    """ONNX Scan → while_loop over a STATIC trip count (the scan-input
+    length — known at import, unlike Loop's M), i.e. lax.scan shape:
+    carry (i, states..., captures..., scan-inputs..., accumulators...),
+    per-step elements read with dynamic_slice, outputs accumulated with
+    dynamic_update_slice. Reverse directions flip at the boundary.
+    scan axes other than 0 are refused (transpose before/after instead).
+    """
+    a = node.attrs()
+    body = a.get("body")
+    if not isinstance(body, GraphProto):
+        raise ONNXImportError(f"Scan {node.name!r}: body graph attr missing")
+    k = int(a.get("num_scan_inputs", 0))
+    n_states = len(node.input) - k
+    if k < 1 or n_states < 0:
+        raise ONNXImportError(
+            f"Scan {node.name!r}: num_scan_inputs={k} with "
+            f"{len(node.input)} inputs")
+    if len(body.input) != n_states + k:
+        raise ONNXImportError(
+            f"Scan {node.name!r}: body takes {len(body.input)} inputs, "
+            f"expected {n_states + k}")
+    n_scan_out = len(body.output) - n_states
+    if n_scan_out < 0:
+        raise ONNXImportError(
+            f"Scan {node.name!r}: body yields {len(body.output)} outputs "
+            f"for {n_states} states")
+    for key in ("scan_input_axes", "scan_output_axes"):
+        axes = a.get(key)
+        if axes and any(int(x) != 0 for x in axes):
+            raise ONNXImportError(
+                f"Scan {node.name!r}: {key}={axes} unsupported (axis 0 "
+                "only; transpose around the Scan instead)")
+    in_dirs = [int(d) for d in (a.get("scan_input_directions")
+                                or [0] * k)]
+    out_dirs = [int(d) for d in (a.get("scan_output_directions")
+                                 or [0] * n_scan_out)]
+    if len(in_dirs) != k or len(out_dirs) != n_scan_out:
+        raise ONNXImportError(
+            f"Scan {node.name!r}: directions length mismatch "
+            f"(inputs {len(in_dirs)}/{k}, outputs "
+            f"{len(out_dirs)}/{n_scan_out})")
+
+    sd = imp.sd
+    state_inits = [imp.tensor(r) for r in node.input[:n_states]]
+    scan_ins = [imp.tensor(r) for r in node.input[n_states:]]
+    trip = None
+    for v in scan_ins:
+        if not v.shape or v.shape[0] in (None, -1):
+            raise ONNXImportError(
+                f"Scan {node.name!r}: scan input {v.name!r} needs a "
+                f"static leading dim, got shape {v.shape}")
+        if trip is None:
+            trip = int(v.shape[0])
+        elif int(v.shape[0]) != trip:
+            raise ONNXImportError(
+                f"Scan {node.name!r}: scan inputs disagree on length "
+                f"({trip} vs {v.shape[0]})")
+    scan_ins = [
+        _rec(imp, "onnximport.flip0", [v]) if d == 1 else v
+        for v, d in zip(scan_ins, in_dirs)]
+
+    all_caps, var_caps = _union_captures(imp, [body])
+    # body subgraph, assembled manually: the declared scan-element inputs
+    # are COMPUTED (list_get at i), not placeholders, so the carry is
+    # [i, states..., captures..., full scan inputs..., accumulators...]
+    sub = SameDiff.create()
+    simp = _GraphImporter(body, {}, sub)
+    i_ph = sub.placeholder(f"__{node.name}_i", (), "int32")
+    for vi, v in zip(body.input[:n_states], state_inits):
+        simp.vars[vi.name] = sub.placeholder(
+            vi.name, v.shape, v.dtype or "float32")
+    for c in var_caps:
+        v = imp.tensor(c)
+        simp.vars[c] = sub.placeholder(c, v.shape, v.dtype or "float32")
+    scanin_phs = []
+    for j, v in enumerate(scan_ins):
+        ph = sub.placeholder(f"__{node.name}_xs{j}", v.shape,
+                             v.dtype or "float32")
+        scanin_phs.append(ph)
+    for vi, ph in zip(body.input[n_states:], scanin_phs):
+        simp.vars[vi.name] = sub._record(
+            "onnximport.list_get", [ph, i_ph], {})
+    _seed_subgraph_constants(imp, simp, body, all_caps)
+    simp._process_nodes()
+    state_out_names = [simp.tensor(o.name).name
+                       for o in body.output[:n_states]]
+    scan_out_vars = [simp.tensor(o.name)
+                     for o in body.output[n_states:]]
+
+    accs, acc_body_outs = _make_scan_accumulators(
+        imp, sub, i_ph, trip, scan_out_vars, node.name)
+
+    one = sub.constant(f"__{node.name}_one", np.ones((), np.int32))
+    new_i = sub._record("add", [i_ph, one], {})
+    sub.branch_outputs = (
+        [new_i.name] + state_out_names + list(var_caps)
+        + [ph.name for ph in scanin_phs] + acc_body_outs)
+
+    csd = SameDiff.create()
+    ci = csd.placeholder("__i", (), "int32")
+    for j, v in enumerate(state_inits):
+        csd.placeholder(f"__s{j}", v.shape, v.dtype or "float32")
+    for j, c in enumerate(var_caps):
+        cv = imp.tensor(c)
+        csd.placeholder(f"__c{j}", cv.shape, cv.dtype or "float32")
+    for j, v in enumerate(scan_ins):
+        csd.placeholder(f"__x{j}", v.shape, v.dtype or "float32")
+    for j, acc in enumerate(accs):
+        csd.placeholder(f"__a{j}", acc.shape, acc.dtype)
+    trip_c = csd.constant("__trip", np.asarray(trip, np.int32))
+    csd.branch_outputs = [csd._record("lt", [ci, trip_c], {}).name]
+
+    zero = sd.constant(imp.fresh_const_name(f"{node.name}_i0"),
+                       np.zeros((), np.int32))
+    inits = ([zero] + state_inits + [imp.tensor(c) for c in var_caps]
+             + scan_ins + accs)
+    res = sd.while_loop(csd, sub, inits)
+    res = res if isinstance(res, tuple) else (res,)
+    states_final = list(res[1:1 + n_states])
+    accs_final = list(res[1 + n_states + len(var_caps) + k:])
+    accs_final = [
+        _rec(imp, "onnximport.flip0", [v]) if d == 1 else v
+        for v, d in zip(accs_final, out_dirs)]
+    return tuple(states_final + accs_final)
 
 
 # --- host constant folding --------------------------------------------------
@@ -1812,18 +1949,32 @@ def _import_onnx_subgraph(imp: "_GraphImporter", graph: GraphProto,
     for c in var_caps:
         v = imp.tensor(c)
         simp.vars[c] = sub.placeholder(c, v.shape, v.dtype or "float32")
+    _seed_subgraph_constants(imp, simp, graph, all_caps)
+    simp._process_nodes()
+    sub.branch_outputs = [simp.tensor(o.name).name for o in graph.output]
+    return simp
+
+
+def _seed_subgraph_constants(imp, simp, graph, all_caps) -> None:
+    """Inline host-known outer captures + the subgraph's own initializers
+    as constants of the sub-SameDiff (keeps const_value() working for
+    shape/axis consumers inside branch bodies)."""
     for c in all_caps:
         if c in imp.consts:
             arr = imp.consts[c]
             simp.consts[c] = arr
-            simp.vars[c] = sub.constant(simp.fresh_const_name(c), arr)
+            simp.vars[c] = simp.sd.constant(simp.fresh_const_name(c), arr)
     for t in graph.initializer:
+        if t.name in simp.vars:
+            # an initializer sharing a declared input's name is that
+            # input's DEFAULT value (ONNX default-value form) — the bound
+            # placeholder must win or the carried value is silently
+            # ignored (mirrors init_names handling in run())
+            continue
         arr = t.to_numpy()
         simp.consts[t.name] = arr
-        simp.vars[t.name] = sub.constant(simp.fresh_const_name(t.name), arr)
-    simp._process_nodes()
-    sub.branch_outputs = [simp.tensor(o.name).name for o in graph.output]
-    return simp
+        simp.vars[t.name] = simp.sd.constant(
+            simp.fresh_const_name(t.name), arr)
 
 
 def import_onnx_model(

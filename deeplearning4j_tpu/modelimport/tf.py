@@ -1596,7 +1596,12 @@ def import_tf_saved_model(path, *, signature: str = "serving_default",
         raise TFImportError(
             f"SavedModel has no signature {signature!r}; available: "
             f"{sorted(sigs)}")
-    frozen = convert_variables_to_constants_v2(sigs[signature])
+    # lower_control_flow=False keeps While/If functional (FunctionDef
+    # branches) instead of lowering to TF1 frames — the functional path
+    # supports nesting and is the preferred route for keras RNN layers'
+    # TensorList loops
+    frozen = convert_variables_to_constants_v2(
+        sigs[signature], lower_control_flow=False)
     gd = frozen.graph.as_graph_def()
     # keep full name:idx — _GraphImporter.tensor() uses the index to pick
     # among multi-output ops ("split:1" must not collapse to output 0);

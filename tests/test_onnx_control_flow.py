@@ -297,3 +297,92 @@ class TestTorchScriptedExport:
             res = sd.output({in_map["p"]: np.asarray(p), in_map["x"]: x},
                             [out_map["out"]])
             np.testing.assert_allclose(res[out_map["out"]], want, rtol=1e-6)
+
+
+class TestScan:
+    def _scan_model(self, reverse_in=False, reverse_out=False):
+        # state' = state + x_elem ; scan_out = state' * 2
+        body = GraphProto(
+            node=[
+                NodeProto(input=["s_in", "x_elem"], output=["s_out"],
+                          op_type="Add"),
+                NodeProto(input=["s_out", "two"], output=["y_elem"],
+                          op_type="Mul"),
+            ],
+            name="body",
+            input=[_vi("s_in", (3,)), _vi("x_elem", (3,))],
+            output=[_vi("s_out", (3,)), _vi("y_elem", (3,))])
+        attrs = [AttributeProto(name="body", type=ATTR_GRAPH, g=body),
+                 AttributeProto(name="num_scan_inputs", type=2, i=1)]
+        if reverse_in:
+            attrs.append(AttributeProto(name="scan_input_directions",
+                                        type=7, ints=[1]))
+        if reverse_out:
+            attrs.append(AttributeProto(name="scan_output_directions",
+                                        type=7, ints=[1]))
+        node = NodeProto(input=["s0", "xs"], output=["s_final", "ys"],
+                         op_type="Scan", attribute=attrs)
+        return _model(
+            [node],
+            inputs=[_vi("s0", (3,)), _vi("xs", (4, 3))],
+            outputs=[_vi("s_final", (3,)), _vi("ys", (4, 3))],
+            initializers=[("two", np.asarray(2.0, np.float32))])
+
+    @pytest.mark.parametrize("rev_in,rev_out", [(False, False),
+                                                (True, False),
+                                                (False, True)])
+    def test_scan_accumulating(self, rev_in, rev_out):
+        sd, in_map, out_map = import_onnx_model(
+            self._scan_model(rev_in, rev_out).encode())
+        rng = np.random.default_rng(13)
+        s0 = rng.normal(size=(3,)).astype(np.float32)
+        xs = rng.normal(size=(4, 3)).astype(np.float32)
+        seq = xs[::-1] if rev_in else xs
+        s = s0.copy()
+        ys = []
+        for t in range(4):
+            s = s + seq[t]
+            ys.append(s * 2)
+        ys = np.stack(ys)
+        if rev_out:
+            ys = ys[::-1]
+        res = sd.output({in_map["s0"]: s0, in_map["xs"]: xs},
+                        [out_map["s_final"], out_map["ys"]])
+        np.testing.assert_allclose(res[out_map["s_final"]], s, rtol=1e-6)
+        np.testing.assert_allclose(res[out_map["ys"]], ys, rtol=1e-6)
+
+    def test_scan_nonzero_axis_refused(self):
+        m = self._scan_model()
+        m.graph.node[0].attribute.append(
+            AttributeProto(name="scan_input_axes", type=7, ints=[1]))
+        with pytest.raises(ONNXImportError, match="axis 0 only"):
+            import_onnx_model(m.encode())
+
+    def test_loop_var_with_default_initializer_not_shadowed(self):
+        """Spec-legal ONNX: a body input may have a same-named initializer
+        (its default value). The loop-carried binding must win — seeding
+        the default over the placeholder silently freezes the state."""
+        body = GraphProto(
+            node=[
+                NodeProto(input=["cond_in"], output=["cond_out"],
+                          op_type="Identity"),
+                NodeProto(input=["v_in", "v_in"], output=["v_out"],
+                          op_type="Add"),
+            ],
+            name="body",
+            initializer=[TensorProto.from_numpy(
+                np.zeros(2, np.float32), name="v_in")],
+            input=[_vi("iter", (), elem_type=7),
+                   _vi("cond_in", (), elem_type=9),
+                   _vi("v_in", (2,))],
+            output=[_vi("cond_out", (), elem_type=9), _vi("v_out", (2,))])
+        m = _model(
+            [_node("Loop", ["M", "", "v0"], ["v_final"], body=body)],
+            inputs=[_vi("v0", (2,))],
+            outputs=[_vi("v_final", (2,))],
+            initializers=[("M", np.asarray(3, np.int64))])
+        sd, in_map, out_map = import_onnx_model(m.encode())
+        v0 = np.asarray([1.0, 3.0], np.float32)
+        res = sd.output({in_map["v0"]: v0}, [out_map["v_final"]])
+        np.testing.assert_allclose(res[out_map["v_final"]], v0 * 8,
+                                   rtol=1e-6)

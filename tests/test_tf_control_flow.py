@@ -435,3 +435,30 @@ class TestLoweredCondImport:
             res = sd.output({in_map[ins[0]]: x}, [out_map[outs[0]]])
             np.testing.assert_allclose(res[out_map[outs[0]]], want,
                                        rtol=1e-6)
+
+
+def test_saved_model_with_lstm_imports(tmp_path):
+    """TF2 SavedModel containing a keras LSTM (While + TensorList inside
+    the serving signature) — the functional-freeze path end-to-end."""
+    from tensorflow import keras
+
+    from deeplearning4j_tpu.modelimport.tf import import_tf_saved_model
+
+    m = keras.Sequential([
+        keras.layers.Input((8, 3), batch_size=2),
+        keras.layers.LSTM(5, return_sequences=True)])
+    d = str(tmp_path / "sm")
+
+    @tf.function(input_signature=[tf.TensorSpec((2, 8, 3), tf.float32)])
+    def serve(x):
+        return {"y": m(x, training=False)}
+
+    tf.saved_model.save(m, d, signatures={"serving_default": serve})
+    sd, in_map, out_map = import_tf_saved_model(d)
+    x = np.random.default_rng(12).normal(size=(2, 8, 3)).astype(np.float32)
+    want = np.asarray(m(x, training=False))
+    (in_name,) = in_map
+    (out_name,) = out_map
+    res = sd.output({in_map[in_name]: x}, [out_map[out_name]])
+    np.testing.assert_allclose(res[out_map[out_name]], want, rtol=2e-5,
+                               atol=2e-6)
