@@ -34,6 +34,7 @@ import dataclasses
 import enum
 import io
 import json
+import math
 import zipfile
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -1021,6 +1022,106 @@ class SameDiff:
         return str(mlir), list(ph_names)
 
 
+def _while_static_trip(sd: SameDiff, node: OpNode) -> Optional[int]:
+    """Static trip count of a counter-bounded while, or None.
+
+    Recognizes conds that are (conjunctions of) ``lt(counter, bound)``
+    where each counter carry slot is updated by ``add(counter, step)``
+    with a positive body-constant step, the bound is a cond-graph
+    constant or a pass-through carry slot, and every needed init is a
+    CONSTANT of the outer graph. This is exactly the loop shape TF/keras
+    RNN imports produce (loop_counter < max_iterations AND time < T),
+    and it lowers to ``lax.scan`` — reverse-differentiable (imported
+    RNNs train) where lax.while_loop is not, and scan is the TPU-native
+    loop form.
+    """
+    cond_sd = (node.subgraphs or {}).get("cond")
+    body_sd = (node.subgraphs or {}).get("body")
+    if cond_sd is None or body_sd is None:
+        return None
+    if cond_sd.branch_outputs is None or body_sd.branch_outputs is None:
+        return None
+    phc = [n for n, v in cond_sd._vars.items()
+           if v.var_type == VariableType.PLACEHOLDER]
+    phb = [n for n, v in body_sd._vars.items()
+           if v.var_type == VariableType.PLACEHOLDER]
+    if len(phc) != len(node.inputs) or len(phb) != len(node.inputs):
+        return None
+    slot_c = {n: i for i, n in enumerate(phc)}
+    b_outs = body_sd.branch_outputs
+    if len(b_outs) != len(node.inputs):
+        return None
+
+    def static_outer(j):
+        name = node.inputs[j]
+        v = sd._vars.get(name)
+        if v is None or v.var_type != VariableType.CONSTANT:
+            return None
+        arr = np.asarray(sd._values[name])
+        return arr.reshape(()).item() if arr.size == 1 else None
+
+    def static_cond_const(name):
+        v = cond_sd._vars.get(name)
+        if v is None or v.var_type != VariableType.CONSTANT:
+            return None
+        arr = np.asarray(cond_sd._values[name])
+        return arr.reshape(()).item() if arr.size == 1 else None
+
+    def body_step(j):
+        idx = body_sd._producer.get(b_outs[j])
+        if idx is None:
+            return None
+        nd = body_sd._nodes[idx]
+        if nd.op != "add" or len(nd.inputs) != 2:
+            return None
+        a, b = nd.inputs
+        other = b if a == phb[j] else (a if b == phb[j] else None)
+        if other is None:
+            return None
+        v = body_sd._vars.get(other)
+        if v is None or v.var_type != VariableType.CONSTANT:
+            return None
+        arr = np.asarray(body_sd._values[other])
+        step = arr.reshape(()).item() if arr.size == 1 else None
+        return step if step is not None and step > 0 else None
+
+    def analyze(name):
+        idx = cond_sd._producer.get(name)
+        if idx is None:
+            return None
+        nd = cond_sd._nodes[idx]
+        if nd.op == "math.logical_and" and len(nd.inputs) == 2:
+            left = analyze(nd.inputs[0])
+            right = analyze(nd.inputs[1])
+            return None if left is None or right is None else left + right
+        if nd.op == "lt" and len(nd.inputs) == 2:
+            j = slot_c.get(nd.inputs[0])
+            if j is None:
+                return None
+            bound = static_cond_const(nd.inputs[1])
+            if bound is None:
+                m = slot_c.get(nd.inputs[1])
+                if m is None or b_outs[m] != phb[m]:
+                    return None  # bound must be invariant
+                bound = static_outer(m)
+            i0 = static_outer(j)
+            step = body_step(j)
+            if bound is None or i0 is None or step is None:
+                return None
+            # INTEGER counters only: a float counter's accumulated value
+            # can disagree with ceil((bound-i0)/step) (0.1-steps hit
+            # 10.000000000000002), and a silently-wrong trip count is
+            # worse than staying on lax.while_loop
+            if not (float(step).is_integer() and float(bound).is_integer()
+                    and float(i0).is_integer()):
+                return None
+            return [max(0, -(-(int(bound) - int(i0)) // int(step)))]
+        return None
+
+    trips = analyze(cond_sd.branch_outputs[0])
+    return None if trips is None else int(min(trips))
+
+
 def _replay_call_node(sd: SameDiff, node: OpNode, fn, vals: List[Any]):
     if node.op == "__cond__":
         pred, *operands = vals
@@ -1028,8 +1129,18 @@ def _replay_call_node(sd: SameDiff, node: OpNode, fn, vals: List[Any]):
         fb = node.subgraphs["false"]._as_branch_fn()
         return jax.lax.cond(pred, tb, fb, *operands)
     if node.op == "__while__":
-        cg = node.subgraphs["cond"]._as_branch_fn()
         bg = node.subgraphs["body"]._as_branch_fn()
+        trip = _while_static_trip(sd, node)
+        if trip is not None:
+            # counter-bounded loop -> lax.scan: differentiable, and the
+            # TPU-native loop form (unrolled trip metadata for XLA)
+            def step(carry, _):
+                out = bg(*carry)
+                return (out if isinstance(out, tuple) else (out,)), None
+
+            final, _ = jax.lax.scan(step, tuple(vals), None, length=trip)
+            return final
+        cg = node.subgraphs["cond"]._as_branch_fn()
         carry = tuple(vals)
 
         def c(state):

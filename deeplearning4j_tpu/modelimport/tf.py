@@ -309,6 +309,14 @@ def _capped_fill(dims, value):
     return np.full(dims, value)
 
 
+def _fold_reduce(fn, node, arrs):
+    axes = tuple(np.atleast_1d(arrs[1]).astype(int))
+    if not axes:
+        return arrs[0]
+    return fn(arrs[0], axis=axes,
+              keepdims=bool(_attr(node, "keep_dims", 0)))
+
+
 _TF_HOST_FOLDABLE = {
     "Pack": lambda n, a: np.stack(a, axis=_attr(n, "axis", 0)),
     "ConcatV2": lambda n, a: np.concatenate(
@@ -338,6 +346,12 @@ _TF_HOST_FOLDABLE = {
     "Prod": lambda n, a: np.prod(
         a[0], axis=tuple(np.atleast_1d(a[1]).astype(int)),
         keepdims=bool(_attr(n, "keep_dims", 0))),
+    # keras RNNs compute maximum_iterations as Max(T, range(0, rank=0)) —
+    # host-folding it makes the While init a static constant, which the
+    # samediff scan-lowering (counter-bounded loops -> lax.scan) needs.
+    # Empty axes = identity reduction.
+    "Max": lambda n, a: _fold_reduce(np.max, n, a),
+    "Min": lambda n, a: _fold_reduce(np.min, n, a),
     # Range/Fill GROW output from tiny inputs — cap the result size too (a
     # frozen graph may Fill a [N,T,T] attention mask; advisory folding must
     # not allocate it on host)
@@ -594,7 +608,7 @@ class _Frame:
 
     def process(self, imp: _GraphImporter) -> None:
         by_name = {n.name: n for n in imp.gd.node}
-        inits = [imp.tensor(e.input[0])
+        inits = [_init_var(imp, e.input[0])
                  for e in self.enters + self.inv_enters]
         cond_sd, body_sd = SameDiff.create(), SameDiff.create()
         cond_bound, body_bound = {}, {}
@@ -1211,11 +1225,28 @@ def _tensor_list_length(imp, node):
                           {"__argspec__": ["var"], "__posattrs__": []})
 
 
+def _init_var(imp, ref):
+    """Resolve a loop-entry input, promoting host-known values (folded
+    shape math like keras' maximum_iterations) to true sd constants —
+    the samediff scan-lowering detects static trip counts by init
+    var_type, and a host-folded ARRAY var would hide the static value."""
+    name = ref.split(":")[0].lstrip("^")
+    if name in imp.consts:
+        v = imp.tensor(ref)
+        from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+        if v.var_type != VariableType.CONSTANT:
+            return imp.sd.constant(_uniq(imp.sd, name), imp.consts[name])
+        return v
+    return imp.tensor(ref)
+
+
 @tf_op("While", "StatelessWhile")
 def _while_functional(imp, node):
     """TF2 functional while: cond/body FunctionDefs -> samediff.while_loop
-    -> lax.while_loop. Loop vars map positionally (While is N-in/N-out)."""
-    inits = [imp.tensor(r) for r in node.input if not r.startswith("^")]
+    -> lax.while_loop (or lax.scan when samediff detects a static trip
+    count). Loop vars map positionally (While is N-in/N-out)."""
+    inits = [_init_var(imp, r) for r in node.input if not r.startswith("^")]
     cond_sd = _import_function(imp, _func_name_attr(node, "cond"), inits)
     body_sd = _import_function(imp, _func_name_attr(node, "body"), inits)
     return imp.sd.while_loop(cond_sd, body_sd, inits)

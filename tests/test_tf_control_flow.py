@@ -261,14 +261,50 @@ class TestIfImport:
         grads = sd.calculate_gradients({}, loss_var.name, [ph])
         np.testing.assert_allclose(grads[ph], want, rtol=2e-5, atol=1e-6)
 
-    def test_grad_through_imported_while_raises_cleanly(self):
-        """Reverse-mode over lax.while_loop (dynamic trip count) is
-        undefined in XLA — the limitation must surface as an error, not
-        silent garbage. (The reference's TF import shares the restriction
-        in spirit: its imported loops train only when unrolled.)"""
+    @pytest.mark.parametrize("lower", [True, False],
+                             ids=["tf1_frames", "functional"])
+    def test_grad_flows_through_counter_bounded_loop(self, lower):
+        """Counter-bounded imported loops (i < 5) are detected and
+        scan-lowered — reverse-mode works and matches TF's tape. This is
+        what makes imported RNNs TRAINABLE (lax.while_loop itself has no
+        reverse-mode)."""
         gd, ins, outs = _freeze_fn(
-            _loop_fn, tf.TensorSpec((2, 3), tf.float32), lower=False)
+            _loop_fn, tf.TensorSpec((2, 3), tf.float32), lower=lower)
         x = np.random.default_rng(4).normal(size=(2, 3)).astype(np.float32)
+        with tf.GradientTape() as tape:
+            xt = tf.constant(x)
+            tape.watch(xt)
+            loss = tf.reduce_sum(_loop_fn(xt))
+        want = np.asarray(tape.gradient(loss, xt))
+        sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
+        from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+        ph = in_map[ins[0]]
+        sd._vars[ph].var_type = VariableType.VARIABLE
+        sd._values[ph] = x
+        loss_var = sd.get_variable(out_map[outs[0]]).sum()
+        grads = sd.calculate_gradients({}, loss_var.name, [ph])
+        np.testing.assert_allclose(grads[ph], want, rtol=2e-5, atol=1e-6)
+
+    def test_grad_through_data_dependent_while_raises_cleanly(self):
+        """A DATA-dependent loop condition cannot scan-lower (no static
+        trip count) — reverse-mode must surface XLA's limitation as an
+        error, not silent garbage."""
+
+        def loop(x):
+            def cond(acc):
+                return tf.reduce_sum(acc) < 100.0
+
+            def body(acc):
+                return (acc * 2.0,)
+
+            (acc,) = tf.while_loop(cond, body, [x])
+            return acc
+
+        gd, ins, outs = _freeze_fn(
+            loop, tf.TensorSpec((2, 3), tf.float32), lower=False)
+        x = np.abs(np.random.default_rng(4).normal(size=(2, 3))
+                   ).astype(np.float32)
         sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
         from deeplearning4j_tpu.autodiff.samediff import VariableType
 
@@ -462,3 +498,34 @@ def test_saved_model_with_lstm_imports(tmp_path):
     res = sd.output({in_map[in_name]: x}, [out_map[out_name]])
     np.testing.assert_allclose(res[out_map[out_name]], want, rtol=2e-5,
                                atol=2e-6)
+
+
+def test_imported_keras_lstm_is_differentiable():
+    """The headline of scan-lowering: a frozen keras LSTM imports AND
+    differentiates — d(sum(output))/dx matches TF's GradientTape. The
+    While loop keras emits is counter-bounded, so it lowers to lax.scan
+    (reverse-differentiable); without the lowering this raises."""
+    from tensorflow import keras
+
+    m = keras.Sequential([
+        keras.layers.Input((6, 3)),
+        keras.layers.LSTM(4, return_sequences=True)])
+    gd, ins, outs = _freeze_fn(
+        lambda x: m(x, training=False),
+        tf.TensorSpec((2, 6, 3), tf.float32), lower=False)
+    x = np.random.default_rng(15).normal(size=(2, 6, 3)).astype(np.float32)
+    with tf.GradientTape() as tape:
+        xt = tf.constant(x)
+        tape.watch(xt)
+        loss = tf.reduce_sum(m(xt, training=False))
+    want = np.asarray(tape.gradient(loss, xt))
+
+    sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
+    from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+    ph = in_map[ins[0]]
+    sd._vars[ph].var_type = VariableType.VARIABLE
+    sd._values[ph] = x
+    loss_var = sd.get_variable(out_map[outs[0]]).sum()
+    grads = sd.calculate_gradients({}, loss_var.name, [ph])
+    np.testing.assert_allclose(grads[ph], want, rtol=5e-5, atol=1e-5)
