@@ -17,7 +17,7 @@ SLOW = ["mnist_lenet.py", "transfer_learning.py", "bert_mlm_pretrain.py",
         "char_rnn_generation.py", "gpt_char_lm.py", "bert_finetune_classifier.py",
         "rl_dqn_cartpole.py", "data_parallel_mesh.py",
         "long_context_ring.py", "serving_http.py",
-        "hyperparameter_search.py"]
+        "hyperparameter_search.py", "import_keras_lstm_finetune.py"]
 
 
 def _run(name, extra_env=None):
