@@ -1461,6 +1461,22 @@ def _loop_onnx(imp, node):
     # subgraph import; M (if any) must come before the accumulators
     m_ph = bsd.placeholder(f"__{node.name}_M", (), "int32") if has_m else None
 
+    # for-loop certification: constant-true initial cond AND a body that
+    # provably keeps it true (constant or cond passthrough). Required for
+    # scan outputs (an early data-dependent exit would shorten the scan
+    # dimension — no static-shape equivalent); when it holds with a
+    # host-constant M, the cond graph is emitted in counter form
+    # (i < M) so samediff's scan-lowering makes the loop differentiable.
+    cond0_true = not c_ref or (
+        c_ref in imp.consts
+        and bool(np.asarray(imp.consts[c_ref]).reshape(())))
+    cond_is_pass = cond_out.name == body.input[1].name
+    cond_is_const_true = (
+        cond_out.var_type == VariableType.CONSTANT
+        and bool(np.asarray(bsd._values[cond_out.name]).reshape(())))
+    for_loop = (m_const is not None and cond0_true
+                and (cond_is_pass or cond_is_const_true))
+
     # scan accumulators: preallocated dense arrays, written at carry's i
     accs = []
     acc_body_outs = []
@@ -1469,18 +1485,10 @@ def _loop_onnx(imp, node):
             raise ONNXImportError(
                 f"Loop {node.name!r}: scan outputs need a host-constant "
                 "trip count M (dynamic-length scans have no static shape)")
-        if c_ref and not (c_ref in imp.consts
-                          and bool(np.asarray(imp.consts[c_ref]).reshape(()))):
+        if not cond0_true:
             raise ONNXImportError(
                 f"Loop {node.name!r}: scan outputs require a constant-true "
                 "initial condition (for-loop form)")
-        # ...and the BODY must provably keep it true (constant or cond
-        # passthrough): an early data-dependent exit would shorten the
-        # scan dimension, which has no static-shape equivalent
-        cond_is_pass = cond_out.name == body.input[1].name
-        cond_is_const_true = (
-            cond_out.var_type == VariableType.CONSTANT
-            and bool(np.asarray(bsd._values[cond_out.name]).reshape(())))
         if not (cond_is_pass or cond_is_const_true):
             raise ONNXImportError(
                 f"Loop {node.name!r}: scan outputs require a for-loop body "
@@ -1501,9 +1509,11 @@ def _loop_onnx(imp, node):
         [new_i.name, cond_next.name] + v_outs
         + list(var_caps) + ([m_ph.name] if has_m else []) + acc_body_outs)
 
-    # cond graph: pass-through read of the carried bool
+    # cond graph: counter form (i < M) for certified for-loops — the
+    # samediff replay detects it and compiles lax.scan (differentiable);
+    # otherwise a pass-through read of the carried bool (lax.while_loop)
     csd = SameDiff.create()
-    csd.placeholder("__i", (), "int32")
+    ci = csd.placeholder("__i", (), "int32")
     c_ph = csd.placeholder("__cond", (), "bool")
     for i, v in enumerate(v_inits):
         csd.placeholder(f"__v{i}", v.shape, v.dtype or "float32")
@@ -1514,7 +1524,11 @@ def _loop_onnx(imp, node):
         csd.placeholder("__M", (), "int32")
     for i, acc in enumerate(accs):
         csd.placeholder(f"__a{i}", acc.shape, acc.dtype)
-    csd.branch_outputs = [c_ph.name]
+    if for_loop:
+        bound = csd.constant("__M_const", np.asarray(m_const, np.int32))
+        csd.branch_outputs = [csd._record("lt", [ci, bound], {}).name]
+    else:
+        csd.branch_outputs = [c_ph.name]
 
     m_scalar = None
     if has_m:

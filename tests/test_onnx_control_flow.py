@@ -240,22 +240,21 @@ class TestLoop:
             import_onnx_model(m.encode())
 
 
+@pytest.fixture(autouse=True)
+def _patch_onnxscript_merge():
+    # the legacy exporter's final merge step needs the onnx module
+    # (absent in this image) only to inline onnxscript functions we
+    # don't use — same patch as test_onnx_torch_export.py
+    from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = \
+        lambda model_bytes, custom_opsets: model_bytes
+    yield
+    onnx_proto_utils._add_onnxscript_fn = orig
+
+
 class TestTorchScriptedExport:
-    @pytest.fixture(autouse=True)
-    def _patch_onnxscript_merge(self):
-        # the legacy exporter's final merge step needs the onnx module
-        # (absent in this image) only to inline onnxscript functions we
-        # don't use — same patch as test_onnx_torch_export.py
-        from torch.onnx._internal.torchscript_exporter import (
-            onnx_proto_utils,
-        )
-
-        orig = onnx_proto_utils._add_onnxscript_fn
-        onnx_proto_utils._add_onnxscript_fn = \
-            lambda model_bytes, custom_opsets: model_bytes
-        yield
-        onnx_proto_utils._add_onnxscript_fn = orig
-
     def test_scripted_loop_module(self):
         """A REAL torch.onnx export of a scripted module with a for loop
         (emits ONNX Loop) — imported output matches torch."""
@@ -386,3 +385,33 @@ class TestScan:
         res = sd.output({in_map["v0"]: v0}, [out_map["v_final"]])
         np.testing.assert_allclose(res[out_map["v_final"]], v0 * 8,
                                    rtol=1e-6)
+
+    def test_scripted_loop_is_differentiable(self):
+        """Certified for-loops import with a counter-form cond, so the
+        samediff scan-lowering applies: gradients through the imported
+        ONNX Loop match torch autograd."""
+
+        class LoopNet(torch.nn.Module):
+            def forward(self, x):
+                acc = torch.zeros_like(x[0])
+                for i in range(x.size(0)):
+                    acc = torch.tanh(acc + x[i])
+                return acc
+
+        m = torch.jit.script(LoopNet())
+        x = torch.randn(5, 3, dtype=torch.float32, requires_grad=True)
+        buf = io.BytesIO()
+        torch.onnx.export(m, (x,), buf, opset_version=13, dynamo=False,
+                          input_names=["x"], output_names=["out"])
+        m(x).sum().backward()
+        want = x.grad.detach().numpy()
+
+        from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+        sd, in_map, out_map = import_onnx_model(buf.getvalue())
+        ph = in_map["x"]
+        sd._vars[ph].var_type = VariableType.VARIABLE
+        sd._values[ph] = x.detach().numpy()
+        loss_var = sd.get_variable(out_map["out"]).sum()
+        grads = sd.calculate_gradients({}, loss_var.name, [ph])
+        np.testing.assert_allclose(grads[ph], want, rtol=2e-5, atol=1e-6)
