@@ -304,6 +304,75 @@ class Gpt:
             None if top_p is None else float(top_p))
         return fn(params, jnp.asarray(prime_ids, jnp.int32), rng)
 
+    def beam_search(self, variables, prime_ids, *, n_steps: int,
+                    beam_size: int = 4, length_penalty: float = 0.0,
+                    eos_id: Optional[int] = None,
+                    max_len: Optional[int] = None):
+        """Beam-search n_steps continuation tokens after prime_ids [N,T0].
+
+        Returns (sequences [N, beam_size, n_steps] int32, scores
+        [N, beam_size] float32), best beam first. Scores are summed
+        next-token log-probabilities; with ``length_penalty`` α > 0 they
+        are GNMT-normalized by ((5+len)/6)^α. ``eos_id`` freezes a beam
+        once it emits eos (it then continues on eos at logprob 0). The
+        whole search — prefill, expansion, cache reordering, backtrace —
+        compiles as one XLA program per shape (no per-token dispatch).
+        beam_size=1 degenerates to greedy decoding."""
+        params = variables["params"]
+        n, t0 = prime_ids.shape
+        total = max_len or (t0 + n_steps)
+        if total < t0 + n_steps:
+            raise ValueError(
+                f"max_len {total} < prime {t0} + n_steps {n_steps}")
+        if total > self.config.max_position:
+            raise ValueError(
+                f"generation length {total} exceeds max_position "
+                f"{self.config.max_position}")
+        if beam_size < 1:
+            raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+        if beam_size > self.config.vocab_size:
+            raise ValueError(
+                f"beam_size {beam_size} > vocab {self.config.vocab_size}")
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        if length_penalty < 0:
+            raise ValueError(
+                f"length_penalty must be >= 0, got {length_penalty}")
+        key = (t0, n_steps, total, int(beam_size), float(length_penalty),
+               None if eos_id is None else int(eos_id))
+        fn = _jit_cache(self, "_beam_cache", key, lambda: _build_beam_search_fn(
+            self, t0, n_steps, total, int(beam_size),
+            float(length_penalty), eos_id))
+        return fn(params, jnp.asarray(prime_ids, jnp.int32))
+
+
+def _jit_cache(model, attr: str, key, build):
+    """Per-model jit-program cache (generate/beam_search): repeated calls
+    with the same static config never retrace."""
+    cache = getattr(model, attr, None)
+    if cache is None:
+        cache = {}
+        setattr(model, attr, cache)
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
+
+
+def _prefill(model: "Gpt", params, prime, t0: int, total: int):
+    """Cached-decoder prefill over the prime (teacher forcing, one scan).
+    Returns (caches, last-position logits). Shared by generate and
+    beam_search so KV-parity is pinned once."""
+    caches = model.init_cache(
+        prime.shape[0], total, dtype=params["embeddings"]["word"].dtype)
+
+    def step(carry, t):
+        caches = carry
+        lg, caches = model.decode_step(params, caches, prime[:, t], t)
+        return caches, lg
+
+    caches, lgs = jax.lax.scan(step, caches, jnp.arange(t0))
+    return caches, lgs[-1]
+
 
 def _truncate_logits(lg, top_k: Optional[int], top_p: Optional[float]):
     """Mask logits outside the top-k set and/or the nucleus (top-p) set to
@@ -332,17 +401,7 @@ def _build_generate_fn(model: Gpt, t0: int, n_steps: int, total: int,
                        top_p: Optional[float] = None):
     def run(params, prime, rng):
         # cache dtype follows the params (bf16 nets project bf16 K/V)
-        caches = model.init_cache(
-            prime.shape[0], total,
-            dtype=params["embeddings"]["word"].dtype)
-
-        def prefill(carry, t):
-            caches = carry
-            lg, caches = model.decode_step(params, caches, prime[:, t], t)
-            return caches, lg
-
-        caches, lgs = jax.lax.scan(prefill, caches, jnp.arange(t0))
-        last_logits = lgs[-1]
+        caches, last_logits = _prefill(model, params, prime, t0, total)
 
         def sample(lg, key):
             if temperature == 0.0:
@@ -372,14 +431,92 @@ def _generate_fn_cache(model: Gpt, t0: int, n_steps: int, total: int,
                        temperature: float, top_k: Optional[int] = None,
                        top_p: Optional[float] = None):
     """Per-model jit cache so repeated sampling never retraces."""
-    cache = getattr(model, "_gen_cache", None)
-    if cache is None:
-        cache = model._gen_cache = {}
-    key = (t0, n_steps, total, temperature, top_k, top_p)
-    if key not in cache:
-        cache[key] = _build_generate_fn(model, t0, n_steps, total,
-                                        temperature, top_k, top_p)
-    return cache[key]
+    return _jit_cache(
+        model, "_gen_cache", (t0, n_steps, total, temperature, top_k, top_p),
+        lambda: _build_generate_fn(model, t0, n_steps, total, temperature,
+                                   top_k, top_p))
+
+
+def _build_beam_search_fn(model: Gpt, t0: int, n_steps: int, total: int,
+                          beam_size: int, length_penalty: float,
+                          eos_id: Optional[int]):
+    """Compiled beam search: prefill scan at beam 1, tile the KV caches to
+    ``beam_size`` rows, then ONE lax.scan of expand→top-k(B·V)→reorder
+    steps with parent backtrace — the whole search is a single XLA
+    program (↔ the reference SameDiff's beam decoding, without per-step
+    host dispatch). Finished beams (eos) continue on eos with logprob 0,
+    the standard freeze."""
+    B = beam_size
+    neg = -1e30
+
+    def run(params, prime):
+        n = prime.shape[0]
+        caches, last_logits = _prefill(model, params, prime, t0, total)
+        v = last_logits.shape[-1]
+        logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+
+        caches = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, B, axis=0), caches)
+        # first expansion from the (identical) prefix only once — top-B
+        # tokens of the prime's next-token distribution seed the beams
+        scores, tok0 = jax.lax.top_k(logp0, B)          # [N,B]
+        tok0 = tok0.astype(jnp.int32)
+        finished = (tok0 == eos_id) if eos_id is not None \
+            else jnp.zeros((n, B), bool)
+        lengths = jnp.ones((n, B), jnp.int32)
+
+        def step(carry, i):
+            caches, scores, finished, lengths, tok = carry
+            lg, caches = model.decode_step(
+                params, caches, tok.reshape(n * B), t0 + i)
+            lp = jax.nn.log_softmax(
+                lg.reshape(n, B, v).astype(jnp.float32), axis=-1)
+            if eos_id is not None:
+                eos_only = jnp.where(
+                    jnp.arange(v)[None, None, :] == eos_id, 0.0, neg)
+                lp = jnp.where(finished[..., None], eos_only, lp)
+            flat = (scores[..., None] + lp).reshape(n, B * v)
+            new_scores, idx = jax.lax.top_k(flat, B)    # [N,B]
+            parent = idx // v
+            new_tok = (idx % v).astype(jnp.int32)
+            rows = (jnp.arange(n)[:, None] * B + parent).reshape(-1)
+            caches = jax.tree_util.tree_map(lambda x: x[rows], caches)
+            new_fin = jnp.take_along_axis(finished, parent, axis=1)
+            new_len = jnp.take_along_axis(lengths, parent, axis=1) \
+                + jnp.where(new_fin, 0, 1)
+            if eos_id is not None:
+                new_fin = new_fin | (new_tok == eos_id)
+            return ((caches, new_scores, new_fin, new_len, new_tok),
+                    (new_tok, parent))
+
+        # iteration i decodes the PREVIOUS token (first: tok0 at slot t0)
+        # and expands to the next one — n_steps-1 expansions after tok0
+        (caches, scores, finished, lengths, _), (toks, parents) = \
+            jax.lax.scan(step, (caches, scores, finished, lengths, tok0),
+                         jnp.arange(n_steps - 1))
+
+        # backtrace the parent chain (newest step first)
+        def back(beam_idx, x):
+            tok_t, parent_t = x
+            sel = jnp.take_along_axis(tok_t, beam_idx, axis=1)
+            return jnp.take_along_axis(parent_t, beam_idx, axis=1), sel
+
+        init_idx = jnp.tile(jnp.arange(B)[None, :], (n, 1))
+        beam_idx, rev = jax.lax.scan(back, init_idx,
+                                     (toks[::-1], parents[::-1]))
+        first = jnp.take_along_axis(tok0, beam_idx, axis=1)
+        seqs = jnp.concatenate([first[None], rev[::-1]], axis=0)
+        seqs = jnp.moveaxis(seqs, 0, 2)                 # [N,B,n_steps]
+        final = scores
+        if length_penalty:
+            final = final / (((5.0 + lengths.astype(jnp.float32)) / 6.0)
+                             ** length_penalty)
+        order = jnp.argsort(-final, axis=1)
+        seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+        final = jnp.take_along_axis(final, order, axis=1)
+        return seqs, final
+
+    return jax.jit(run)
 
 
 def gpt2_small(**kw) -> Gpt:
