@@ -284,3 +284,64 @@ class TestMisc:
         xv = np.array([1.0, 2.0, 3.0], np.float32)
         got = sd2.output({"p": True, "x": xv}, [out.name])[out.name]
         np.testing.assert_allclose(got, xv**2)
+
+
+class TestScanLowering:
+    """Counter-bounded while loops lower to lax.scan at replay (explicit
+    branch_outputs) — reverse-differentiable, unlike lax.while_loop."""
+
+    def _counter_while(self, w0: float):
+        # while i < 4: i += 1; s *= w   (w: pass-through loop invariant)
+        cond = SameDiff.create()
+        i_c = cond.placeholder("i", (), "int32")
+        cond.placeholder("s", (), "float32")
+        cond.placeholder("w", (), "float32")
+        bound = cond.constant("K", np.int32(4))
+        pred = i_c.lt(bound)
+        cond.branch_outputs = [pred.name]
+        body = SameDiff.create()
+        i_b = body.placeholder("i", (), "int32")
+        s_b = body.placeholder("s", (), "float32")
+        w_b = body.placeholder("w", (), "float32")
+        one = body.constant("one", np.int32(1))
+        ni = i_b + one
+        ns = s_b * w_b
+        body.branch_outputs = [ni.name, ns.name, "w"]
+
+        sd = SameDiff.create()
+        i0 = sd.constant("i0", np.int32(0))
+        s0 = sd.constant("s0", np.float32(2.0))
+        w = sd.var("w", np.float32(w0))
+        return sd, sd.while_loop(cond, body, [i0, s0, w])
+
+    def test_forward_value(self):
+        sd, (i_out, s_out, _) = self._counter_while(3.0)
+        assert int(i_out.eval()) == 4
+        assert float(s_out.eval()) == 2.0 * 3.0 ** 4
+
+    def test_gradient_through_lowered_loop(self):
+        """d(s0 * w^4)/dw = 4 * s0 * w^3 — reverse-mode works because the
+        loop compiled as lax.scan."""
+        sd, (_, s_out, _) = self._counter_while(1.5)
+        g = sd.calculate_gradients({}, s_out.name, ["w"])["w"]
+        np.testing.assert_allclose(float(g), 4 * 2.0 * 1.5 ** 3, rtol=1e-6)
+
+    def test_data_dependent_loop_still_raises_on_grad(self):
+        # while s < 100: s *= w  — no counter, stays lax.while_loop
+        cond = SameDiff.create()
+        s_c = cond.placeholder("s", (), "float32")
+        cond.placeholder("w", (), "float32")
+        pred = s_c.lt(cond.constant("K", np.float32(100.0)))
+        cond.branch_outputs = [pred.name]
+        body = SameDiff.create()
+        s_b = body.placeholder("s", (), "float32")
+        w_b = body.placeholder("w", (), "float32")
+        ns = s_b * w_b
+        body.branch_outputs = [ns.name, "w"]
+        sd = SameDiff.create()
+        s0 = sd.constant("s0", np.float32(2.0))
+        w = sd.var("w", np.float32(3.0))
+        s_out, _ = sd.while_loop(cond, body, [s0, w])
+        assert float(s_out.eval()) == 162.0  # 2*3^4 -> first >= 100
+        with pytest.raises(ValueError, match="while_loop|fori_loop"):
+            sd.calculate_gradients({}, s_out.name, ["w"])
