@@ -532,9 +532,12 @@ class _SubgraphImporter(_GraphImporter):
     SameDiff, with boundary tensors (loop-var Merges/Switches, invariant
     Enters) pre-bound to placeholders. Used for raised TF1 frame bodies,
     where node order in the GraphDef is not topological (cycles through
-    NextIteration)."""
+    NextIteration). ``child_frames`` maps member names of NESTED frames
+    to their _Frame: reaching one (its Exit, from the parent body's
+    compute) raises the inner loop recursively within THIS subgraph."""
 
-    def __init__(self, by_name, library, sd: SameDiff, boundary):
+    def __init__(self, by_name, library, sd: SameDiff, boundary,
+                 child_frames=None):
         self.gd = None
         self.sd = sd
         self.input_shapes = {}
@@ -543,6 +546,7 @@ class _SubgraphImporter(_GraphImporter):
         self.consts = {}
         self.library = library
         self.by_name = by_name
+        self.child_frames = child_frames or {}
 
     def tensor(self, ref: str) -> SDVariable:
         name = ref.split(":")[0].lstrip("^")
@@ -560,14 +564,26 @@ class _SubgraphImporter(_GraphImporter):
         return super().const_value(ref)
 
     def _ensure(self, name: str) -> None:
+        fr = self.child_frames.get(name)
+        if fr is not None:
+            # processed per-IMPORTER (keyed on the exits being present in
+            # OUR vars, not fr.done): a child frame read from both the
+            # parent's cond and body subgraphs must be raised into each
+            if not any(ex.name in self.vars for ex in fr.exits.values()):
+                fr.process(self, self.by_name)
+            if name not in self.vars:
+                raise TFImportError(
+                    f"frame-internal node {name!r} is consumed outside "
+                    f"its loop (only Exit values may escape a frame)")
+            return
         node = self.by_name.get(name)
         if node is None:
             raise TFImportError(f"tensor {name!r}: no such node in graph")
         if node.op in _FRAME_OPS:
             raise TFImportError(
-                f"node {name!r} ({node.op}) crosses into another control-"
-                "flow frame: nested TF1 frames are not supported (freeze "
-                "with lower_control_flow=False for functional While/If)")
+                f"node {name!r} ({node.op}) belongs to unstructured "
+                "control flow this importer cannot raise (freeze with "
+                "lower_control_flow=False for functional While/If)")
         for r in node.input:
             if r.startswith("^"):
                 continue
@@ -599,6 +615,7 @@ class _Frame:
         self.exits: Dict[int, Any] = {}
         self.loop_cond = None
         self.members: set = set()
+        self.children: list = []     # frames nested inside this one
         self.cond_pred_ref = None
         self.done = False
 
@@ -606,8 +623,16 @@ class _Frame:
         return all(e.input[0].split(":")[0].lstrip("^") in imp.vars
                    for e in self.enters + self.inv_enters)
 
-    def process(self, imp: _GraphImporter) -> None:
-        by_name = {n.name: n for n in imp.gd.node}
+    def _child_frame_map(self) -> Dict[str, "_Frame"]:
+        out: Dict[str, _Frame] = {}
+        for ch in self.children:
+            for n in ch.members:
+                out[n] = ch
+        return out
+
+    def process(self, imp: _GraphImporter, by_name=None) -> None:
+        if by_name is None:
+            by_name = {n.name: n for n in imp.gd.node}
         inits = [_init_var(imp, e.input[0])
                  for e in self.enters + self.inv_enters]
         cond_sd, body_sd = SameDiff.create(), SameDiff.create()
@@ -628,9 +653,12 @@ class _Frame:
                 e.name, v.shape, v.dtype or "float32")
             body_bound[e.name] = body_sd.placeholder(
                 e.name, v.shape, v.dtype or "float32")
-        cimp = _SubgraphImporter(by_name, imp.library, cond_sd, cond_bound)
+        kids = self._child_frame_map()
+        cimp = _SubgraphImporter(by_name, imp.library, cond_sd, cond_bound,
+                                 child_frames=kids)
         cond_sd.branch_outputs = [cimp.tensor(self.cond_pred_ref).name]
-        bimp = _SubgraphImporter(by_name, imp.library, body_sd, body_bound)
+        bimp = _SubgraphImporter(by_name, imp.library, body_sd, body_bound,
+                                 child_frames=kids)
         outs = [bimp.tensor(ni.input[0]).name for ni in self.next_iters]
         outs += [body_bound[e.name].name for e in self.inv_enters]
         body_sd.branch_outputs = outs
@@ -641,34 +669,14 @@ class _Frame:
         self.done = True
 
 
-def _walk_frame_interior(by_name, start_refs, boundary, frame_name):
-    """Backward closure (data + control edges) from `start_refs`, stopping
-    at `boundary` names. Anything reached is frame-internal; reaching
-    another frame's machinery means nesting -> refuse."""
-    seen = set()
-    stack = [r.split(":")[0].lstrip("^") for r in start_refs]
-    while stack:
-        name = stack.pop()
-        if name in boundary or name in seen:
-            continue
-        node = by_name.get(name)
-        if node is None:
-            raise TFImportError(
-                f"frame {frame_name!r}: interior ref {name!r} missing")
-        if node.op in _FRAME_OPS:
-            raise TFImportError(
-                f"frame {frame_name!r} touches {node.op} node {name!r}: "
-                "nested TF1 control-flow frames are not supported (freeze "
-                "with lower_control_flow=False for functional While/If)")
-        seen.add(name)
-        for r in node.input:
-            stack.append(r.split(":")[0].lstrip("^"))
-    return seen
-
-
 def _collect_frames(gd) -> list:
     """Identify TF1 while frames (grouped by Enter frame_name) and
-    precompute their membership + structure for raising."""
+    precompute their membership + structure for raising. Nested frames
+    are resolved recursively: an outer frame's interior walk absorbs any
+    inner frame it reaches (via the inner Exit its body consumes) into
+    its membership and records it as a child — the raising then happens
+    inside the outer body's subgraph import. Returns only ROOT frames;
+    children hang off ``frame.children``."""
     if gd is None:
         return []
     by_name = {n.name: n for n in gd.node}
@@ -684,7 +692,10 @@ def _collect_frames(gd) -> list:
         if n.op == "Enter":
             fname = n.attr["frame_name"].s.decode()
             enters_by_frame.setdefault(fname, []).append(n)
-    frames = []
+
+    # phase 1: structure (enters/merges/switches/NIs/exits/LoopCond)
+    frames: list = []
+    struct_of: Dict[str, _Frame] = {}  # structural member name -> frame
     for fname, enters in enters_by_frame.items():
         fr = _Frame(fname)
         enter_names = {e.name for e in enters}
@@ -729,13 +740,54 @@ def _collect_frames(gd) -> list:
                 f"frame {fname!r}: no LoopCond found (cond-only Switch/"
                 "Merge graphs are not raiseable as loops)")
         fr.cond_pred_ref = fr.loop_cond.input[0]
+        for nd in (fr.enters + fr.inv_enters + fr.merges + fr.next_iters
+                   + [s for s in fr.switches if s is not None]
+                   + list(fr.exits.values()) + [fr.loop_cond]):
+            struct_of[nd.name] = fr
+        frames.append(fr)
+
+    # phase 2: full membership, innermost-first via recursion — an
+    # interior walk reaching ANOTHER frame's structural node absorbs that
+    # frame (children import inside the parent's body subgraph)
+    def full_members(fr: _Frame, visiting: set) -> set:
+        if fr.members:
+            return fr.members
+        if fr.name in visiting:
+            raise TFImportError(
+                f"frames {sorted(visiting)} are mutually entangled; "
+                "cannot raise")
+        visiting = visiting | {fr.name}
         boundary = ({m.name for m in fr.merges}
                     | {s.name for s in fr.switches if s is not None}
                     | {e.name for e in fr.inv_enters})
-        interior = _walk_frame_interior(
-            by_name, [fr.cond_pred_ref], boundary, fname)
-        interior |= _walk_frame_interior(
-            by_name, [ni.input[0] for ni in fr.next_iters], boundary, fname)
+        interior: set = set()
+        stack = [fr.cond_pred_ref] + [ni.input[0] for ni in fr.next_iters]
+        stack = [r.split(":")[0].lstrip("^") for r in stack]
+        while stack:
+            name = stack.pop()
+            if name in boundary or name in interior:
+                continue
+            other = struct_of.get(name)
+            if other is not None and other is not fr:
+                if other not in fr.children:
+                    fr.children.append(other)
+                    interior |= full_members(other, visiting)
+                    # the child's loop-entry values are computed in OUR
+                    # body — keep walking from its Enter inputs
+                    stack.extend(e.input[0].split(":")[0].lstrip("^")
+                                 for e in other.enters + other.inv_enters)
+                continue
+            node = by_name.get(name)
+            if node is None:
+                raise TFImportError(
+                    f"frame {fr.name!r}: interior ref {name!r} missing")
+            if node.op in _FRAME_OPS:
+                raise TFImportError(
+                    f"frame {fr.name!r} touches unstructured {node.op} "
+                    f"node {name!r}; cannot raise")
+            interior.add(name)
+            for r in node.input:
+                stack.append(r.split(":")[0].lstrip("^"))
         # control-only stragglers hanging off loop machinery (pivot
         # identities, control NoOps): anything consuming a Switch/Merge
         # that only feeds control edges
@@ -744,12 +796,17 @@ def _collect_frames(gd) -> list:
                 if (c.op in ("Identity", "NoOp")
                         and c.name not in data_consumed):
                     interior.add(c.name)
-        fr.members = (interior | boundary | enter_names
+        fr.members = (interior | boundary
+                      | {e.name for e in fr.enters + fr.inv_enters}
                       | {ni.name for ni in fr.next_iters}
                       | {e.name for e in fr.exits.values()}
                       | {fr.loop_cond.name})
-        frames.append(fr)
-    return frames
+        return fr.members
+
+    for fr in frames:
+        full_members(fr, set())
+    nested = {ch.name for fr in frames for ch in fr.children}
+    return [fr for fr in frames if fr.name not in nested]
 
 
 class _CondCluster:
@@ -1230,15 +1287,13 @@ def _init_var(imp, ref):
     shape math like keras' maximum_iterations) to true sd constants —
     the samediff scan-lowering detects static trip counts by init
     var_type, and a host-folded ARRAY var would hide the static value."""
-    name = ref.split(":")[0].lstrip("^")
-    if name in imp.consts:
-        v = imp.tensor(ref)
-        from deeplearning4j_tpu.autodiff.samediff import VariableType
+    from deeplearning4j_tpu.autodiff.samediff import VariableType
 
-        if v.var_type != VariableType.CONSTANT:
-            return imp.sd.constant(_uniq(imp.sd, name), imp.consts[name])
-        return v
-    return imp.tensor(ref)
+    name = ref.split(":")[0].lstrip("^")
+    v = imp.tensor(ref)  # ensures the producer (and any folding) ran
+    if v.var_type != VariableType.CONSTANT and name in imp.consts:
+        return imp.sd.constant(_uniq(imp.sd, name), imp.consts[name])
+    return v
 
 
 @tf_op("While", "StatelessWhile")

@@ -169,9 +169,9 @@ class TestWhileImport:
         np.testing.assert_array_equal(got[out_map[outs[0]]],
                                       want[out_map[outs[0]]])
 
-    def test_nested_frames_refused(self):
-        """Nested TF1 while frames stay strict-refused with a pointed
-        message (freeze with lower_control_flow=False instead)."""
+    def test_nested_frames_import(self):
+        """Nested TF1 while frames raise RECURSIVELY: the inner loop is
+        rebuilt inside the outer body's subgraph — same output as TF."""
 
         def nested(x):
             def outer_body(i, acc):
@@ -180,7 +180,7 @@ class TestWhileImport:
 
                 _, acc2 = tf.while_loop(
                     lambda j, a: j < 2, inner_body, [tf.constant(0), acc])
-                return i + 1, acc2
+                return i + 1, acc2 * 1.25
 
             _, out = tf.while_loop(
                 lambda i, a: i < 3, outer_body, [tf.constant(0), x])
@@ -188,8 +188,12 @@ class TestWhileImport:
 
         gd, ins, outs = _freeze_fn(
             nested, tf.TensorSpec((2,), tf.float32), lower=True)
-        with pytest.raises(TFImportError, match="[Nn]ested"):
-            import_tf_graph(gd, outputs=list(outs))
+        ops = {n.op for n in gd.node}
+        assert "Enter" in ops  # really the lowered TF1 form
+        x = np.asarray([1.0, -2.0], np.float32)
+        want = np.asarray(nested(tf.constant(x)))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
 
     def test_nested_functional_while_imports(self):
         """The SAME nested loop imports fine in functional form — mapper
@@ -529,3 +533,76 @@ def test_imported_keras_lstm_is_differentiable():
     loss_var = sd.get_variable(out_map[outs[0]]).sum()
     grads = sd.calculate_gradients({}, loss_var.name, [ph])
     np.testing.assert_allclose(grads[ph], want, rtol=5e-5, atol=1e-5)
+
+
+def test_three_level_nested_frames_import():
+    """Grandchild frames raise through two levels of recursive body
+    subgraph import."""
+
+    def f(x):
+        def b1(i, a):
+            def b2(j, b):
+                def b3(k, c):
+                    return k + 1, c * 1.1
+
+                _, b2v = tf.while_loop(lambda k, c: k < 2, b3,
+                                       [tf.constant(0), b])
+                return j + 1, b2v + 0.25
+
+            _, a2 = tf.while_loop(lambda j, b: j < 2, b2,
+                                  [tf.constant(0), a])
+            return i + 1, a2
+
+        _, out = tf.while_loop(lambda i, a: i < 2, b1, [tf.constant(0), x])
+        return out
+
+    gd, ins, outs = _freeze_fn(f, tf.TensorSpec((3,), tf.float32),
+                               lower=True)
+    x = np.asarray([1.0, -1.0, 0.5], np.float32)
+    want = np.asarray(f(tf.constant(x)))
+    (got,) = _import_and_run(gd, ins, outs, [x])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_cond_inside_lowered_frame_refused():
+    """A lowered tf.cond INSIDE a lowered while body is Switch/Merge
+    machinery the frame walk cannot attribute — must refuse loudly (the
+    GUIDE points at lower_control_flow=False), never import wrong."""
+
+    def f(x):
+        def body(i, a):
+            a2 = tf.cond(tf.reduce_sum(a) > 0.0,
+                         lambda: a * 0.5, lambda: a + 1.0)
+            return i + 1, a2
+
+        _, out = tf.while_loop(lambda i, a: i < 3, body,
+                               [tf.constant(0), x])
+        return out
+
+    gd, ins, outs = _freeze_fn(f, tf.TensorSpec((2,), tf.float32),
+                               lower=True)
+    with pytest.raises(TFImportError, match="unstructured|cannot raise"):
+        import_tf_graph(gd, outputs=list(outs))
+
+
+def test_functional_cond_inside_functional_loop_imports():
+    """The same program in functional form imports fine (If inside the
+    While body FunctionDef) — the recommended re-freeze."""
+
+    def f(x):
+        def body(i, a):
+            a2 = tf.cond(tf.reduce_sum(a) > 0.0,
+                         lambda: a * 0.5, lambda: a + 1.0)
+            return i + 1, a2
+
+        _, out = tf.while_loop(lambda i, a: i < 3, body,
+                               [tf.constant(0), x])
+        return out
+
+    gd, ins, outs = _freeze_fn(f, tf.TensorSpec((2,), tf.float32),
+                               lower=False)
+    for arr in ([2.0, 1.0], [-3.0, -1.0]):
+        x = np.asarray(arr, np.float32)
+        want = np.asarray(f(tf.constant(x)))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
