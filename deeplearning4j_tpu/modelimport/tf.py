@@ -564,17 +564,18 @@ class _SubgraphImporter(_GraphImporter):
         return super().const_value(ref)
 
     def _ensure(self, name: str) -> None:
-        fr = self.child_frames.get(name)
-        if fr is not None:
-            # processed per-IMPORTER (keyed on the exits being present in
-            # OUR vars, not fr.done): a child frame read from both the
-            # parent's cond and body subgraphs must be raised into each
-            if not any(ex.name in self.vars for ex in fr.exits.values()):
-                fr.process(self, self.by_name)
+        unit = self.child_frames.get(name)  # nested _Frame or _CondCluster
+        if unit is not None:
+            # processed per-IMPORTER (keyed on the provided names being
+            # present in OUR vars, not unit.done): a child read from both
+            # the parent's cond and body subgraphs raises into each
+            if not any(p in self.vars for p in unit.provided_names()):
+                unit.process(self, self.by_name)
             if name not in self.vars:
                 raise TFImportError(
-                    f"frame-internal node {name!r} is consumed outside "
-                    f"its loop (only Exit values may escape a frame)")
+                    f"control-flow-internal node {name!r} is consumed "
+                    "outside its structure (only Exit/Merge values may "
+                    "escape)")
             return
         node = self.by_name.get(name)
         if node is None:
@@ -623,11 +624,14 @@ class _Frame:
         return all(e.input[0].split(":")[0].lstrip("^") in imp.vars
                    for e in self.enters + self.inv_enters)
 
-    def _child_frame_map(self) -> Dict[str, "_Frame"]:
-        out: Dict[str, _Frame] = {}
-        for ch in self.children:
+    def provided_names(self) -> list:
+        return [ex.name for ex in self.exits.values()]
+
+    def _child_frame_map(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for ch in self.children:  # nested _Frame or in-frame _CondCluster
             for n in ch.members:
-                out[n] = ch
+                out.setdefault(n, ch)
         return out
 
     def process(self, imp: _GraphImporter, by_name=None) -> None:
@@ -761,6 +765,7 @@ def _collect_frames(gd) -> list:
                     | {s.name for s in fr.switches if s is not None}
                     | {e.name for e in fr.inv_enters})
         interior: set = set()
+        cond_kids: Dict[str, _CondCluster] = {}  # in-frame conds, by pred
         stack = [fr.cond_pred_ref] + [ni.input[0] for ni in fr.next_iters]
         stack = [r.split(":")[0].lstrip("^") for r in stack]
         while stack:
@@ -781,6 +786,29 @@ def _collect_frames(gd) -> list:
             if node is None:
                 raise TFImportError(
                     f"frame {fr.name!r}: interior ref {name!r} missing")
+            if node.op == "Merge":
+                # a lowered tf.cond INSIDE the loop body: absorb it as a
+                # child cluster (raised within the body subgraph import),
+                # grouped by predicate so a multi-output cond still runs
+                # its branches once, and keep walking from its operands
+                single = _build_merge_cluster(node, by_name)
+                cl = cond_kids.get(single.pred_ref)
+                if cl is None:
+                    cond_kids[single.pred_ref] = single
+                    cl = single
+                else:
+                    cl.merges.extend(single.merges)
+                    cl.true_refs.extend(single.true_refs)
+                    cl.false_refs.extend(single.false_refs)
+                    for sw in single.switches:
+                        if sw.name not in {s.name for s in cl.switches}:
+                            cl.switches.append(sw)
+                    cl.members |= single.members
+                interior |= single.members
+                for sw in single.switches:
+                    stack.append(sw.input[0].split(":")[0].lstrip("^"))
+                    stack.append(sw.input[1].split(":")[0].lstrip("^"))
+                continue
             if node.op in _FRAME_OPS:
                 raise TFImportError(
                     f"frame {fr.name!r} touches unstructured {node.op} "
@@ -796,6 +824,7 @@ def _collect_frames(gd) -> list:
                 if (c.op in ("Identity", "NoOp")
                         and c.name not in data_consumed):
                     interior.add(c.name)
+        fr.children.extend(cond_kids.values())
         fr.members = (interior | boundary
                       | {e.name for e in fr.enters + fr.inv_enters}
                       | {ni.name for ni in fr.next_iters}
@@ -805,7 +834,8 @@ def _collect_frames(gd) -> list:
 
     for fr in frames:
         full_members(fr, set())
-    nested = {ch.name for fr in frames for ch in fr.children}
+    nested = {ch.name for fr in frames for ch in fr.children
+              if isinstance(ch, _Frame)}
     return [fr for fr in frames if fr.name not in nested]
 
 
@@ -832,14 +862,18 @@ class _CondCluster:
         self.members: set = set()
         self.done = False
 
+    def provided_names(self) -> list:
+        return [m.name for m in self.merges]
+
     def ready(self, imp: _GraphImporter) -> bool:
         return all(
             sw.input[0].split(":")[0].lstrip("^") in imp.vars
             and sw.input[1].split(":")[0].lstrip("^") in imp.vars
             for sw in self.switches)
 
-    def process(self, imp: _GraphImporter) -> None:
-        by_name = {n.name: n for n in imp.gd.node}
+    def process(self, imp: _GraphImporter, by_name=None) -> None:
+        if by_name is None:
+            by_name = {n.name: n for n in imp.gd.node}
         pred = imp.tensor(self.pred_ref)
         datas = [imp.tensor(sw.input[0]) for sw in self.switches]
 
@@ -895,6 +929,52 @@ def _walk_cond_branch(by_name, start_ref: str, merge_name: str):
     return interior, switches, idxs
 
 
+def _build_merge_cluster(n, by_name) -> _CondCluster:
+    """Single-Merge cond cluster: walk both inputs to the gating Switch
+    set, decide true/false by consumed output index, validate one shared
+    predicate. Raises TFImportError for unraiseable shapes."""
+    data_in = [r for r in n.input if not r.startswith("^")]
+    if len(data_in) != 2:
+        raise TFImportError(
+            f"Merge {n.name}: {len(data_in)} data inputs; only 2-way "
+            "(tf.cond) merges are raiseable")
+    sides = {}
+    interior = set()
+    switches = []
+    for ref in data_in:
+        br_interior, br_switches, idxs = _walk_cond_branch(
+            by_name, ref, n.name)
+        interior |= br_interior
+        for sw in br_switches:
+            if sw.name not in {s.name for s in switches}:
+                switches.append(sw)
+        if idxs == {1}:
+            sides["true"] = ref
+        elif idxs == {0}:
+            sides["false"] = ref
+        else:
+            raise TFImportError(
+                f"Merge {n.name}: branch {ref!r} consumes switch "
+                f"outputs {sorted(idxs)}; cannot assign it to one side")
+    if set(sides) != {"true", "false"}:
+        raise TFImportError(
+            f"Merge {n.name}: could not identify both branches")
+    if not switches:
+        raise TFImportError(f"Merge {n.name}: no gating Switch found")
+    preds = {sw.input[1] for sw in switches}
+    if len(preds) > 1:
+        raise TFImportError(
+            f"Merge {n.name}: switches disagree on the predicate "
+            f"({sorted(preds)}); unsupported cond shape")
+    cl = _CondCluster(switches[0].input[1])
+    cl.merges.append(n)
+    cl.true_refs.append(sides["true"])
+    cl.false_refs.append(sides["false"])
+    cl.switches.extend(switches)
+    cl.members = interior | {n.name} | {sw.name for sw in switches}
+    return cl
+
+
 def _collect_cond_clusters(gd, exclude: set) -> list:
     """Identify lowered tf.cond clusters: Merges OUTSIDE while frames,
     grouped by predicate so a multi-output cond (several Merges over one
@@ -906,50 +986,26 @@ def _collect_cond_clusters(gd, exclude: set) -> list:
     for n in gd.node:
         if n.op != "Merge" or n.name in exclude:
             continue
-        data_in = [r for r in n.input if not r.startswith("^")]
-        if len(data_in) != 2:
-            raise TFImportError(
-                f"Merge {n.name}: {len(data_in)} data inputs; only 2-way "
-                "(tf.cond) merges are raiseable")
-        sides = {}
-        interior = set()
-        switches = []
-        for ref in data_in:
-            br_interior, br_switches, idxs = _walk_cond_branch(
-                by_name, ref, n.name)
-            interior |= br_interior
-            for sw in br_switches:
-                if sw.name not in {s.name for s in switches}:
-                    switches.append(sw)
-            if idxs == {1}:
-                sides["true"] = ref
-            elif idxs == {0}:
-                sides["false"] = ref
-            else:
-                raise TFImportError(
-                    f"Merge {n.name}: branch {ref!r} consumes switch "
-                    f"outputs {sorted(idxs)}; cannot assign it to one side")
-        if set(sides) != {"true", "false"}:
-            raise TFImportError(
-                f"Merge {n.name}: could not identify both branches")
-        if not switches:
-            raise TFImportError(f"Merge {n.name}: no gating Switch found")
-        preds = {sw.input[1] for sw in switches}
-        if len(preds) > 1:
-            raise TFImportError(
-                f"Merge {n.name}: switches disagree on the predicate "
-                f"({sorted(preds)}); unsupported cond shape")
-        pred_ref = switches[0].input[1]
-        cl = by_pred.get(pred_ref)
+        single = _build_merge_cluster(n, by_name)
+        if any(sw.input[0].split(":")[0].lstrip("^") in exclude
+               or sw.input[1].split(":")[0].lstrip("^") in exclude
+               for sw in single.switches):
+            # frame-internal debris: a dead in-frame cond Merge (no live
+            # consumer, unpruned freeze) gated by frame machinery — its
+            # switch inputs can never resolve at top level; skip rather
+            # than dooming run() to an unresolvable-structure error
+            continue
+        cl = by_pred.get(single.pred_ref)
         if cl is None:
-            cl = by_pred[pred_ref] = _CondCluster(pred_ref)
-        cl.merges.append(n)
-        cl.true_refs.append(sides["true"])
-        cl.false_refs.append(sides["false"])
-        for sw in switches:
+            by_pred[single.pred_ref] = single
+            continue
+        cl.merges.extend(single.merges)
+        cl.true_refs.extend(single.true_refs)
+        cl.false_refs.extend(single.false_refs)
+        for sw in single.switches:
             if sw.name not in {s.name for s in cl.switches}:
                 cl.switches.append(sw)
-        cl.members |= interior | {n.name} | {sw.name for sw in switches}
+        cl.members |= single.members
     return list(by_pred.values())
 
 

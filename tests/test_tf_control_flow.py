@@ -564,10 +564,11 @@ def test_three_level_nested_frames_import():
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
 
 
-def test_cond_inside_lowered_frame_refused():
-    """A lowered tf.cond INSIDE a lowered while body is Switch/Merge
-    machinery the frame walk cannot attribute — must refuse loudly (the
-    GUIDE points at lower_control_flow=False), never import wrong."""
+def test_cond_inside_lowered_frame_imports():
+    """A lowered tf.cond INSIDE a lowered while body: the Merge is
+    absorbed as a child cluster of the frame and raised to lax.cond
+    within the body subgraph — output matches TF (the cond is
+    data-dependent, flipping branches across iterations)."""
 
     def f(x):
         def body(i, a):
@@ -581,8 +582,13 @@ def test_cond_inside_lowered_frame_refused():
 
     gd, ins, outs = _freeze_fn(f, tf.TensorSpec((2,), tf.float32),
                                lower=True)
-    with pytest.raises(TFImportError, match="unstructured|cannot raise"):
-        import_tf_graph(gd, outputs=list(outs))
+    ops = {n.op for n in gd.node}
+    assert "Enter" in ops and "Merge" in ops
+    for arr in ([2.0, 1.0], [-3.0, -1.0]):
+        x = np.asarray(arr, np.float32)
+        want = np.asarray(f(tf.constant(x)))
+        (got,) = _import_and_run(gd, ins, outs, [x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
 
 
 def test_functional_cond_inside_functional_loop_imports():
@@ -606,3 +612,33 @@ def test_functional_cond_inside_functional_loop_imports():
         want = np.asarray(f(tf.constant(x)))
         (got,) = _import_and_run(gd, ins, outs, [x])
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_multi_output_cond_inside_frame_single_lax_cond():
+    """A multi-output tf.cond inside a loop body groups by predicate into
+    ONE child cluster — shared branch compute runs once per iteration."""
+
+    def f(x):
+        def body(i, a):
+            p, q = tf.cond(tf.reduce_sum(a) > 0.0,
+                           lambda: (a * 0.5, a - 1.0),
+                           lambda: (a + 1.0, a * 2.0))
+            return i + 1, p + q * 0.25
+
+        _, out = tf.while_loop(lambda i, a: i < 3, body,
+                               [tf.constant(0), x])
+        return out
+
+    gd, ins, outs = _freeze_fn(f, tf.TensorSpec((2,), tf.float32),
+                               lower=True)
+    x = np.asarray([2.0, -1.0], np.float32)
+    want = np.asarray(f(tf.constant(x)))
+    sd, in_map, out_map = import_tf_graph(gd, outputs=list(outs))
+    res = sd.output({in_map[ins[0]]: x}, [out_map[outs[0]]])
+    np.testing.assert_allclose(res[out_map[outs[0]]], want, rtol=1e-6)
+    # exactly one __cond__ inside the while body subgraph
+    while_nodes = [nd for nd in sd.ops() if nd.op == "__while__"]
+    assert len(while_nodes) == 1
+    body_sd = while_nodes[0].subgraphs["body"]
+    n_conds = sum(1 for nd in body_sd.ops() if nd.op == "__cond__")
+    assert n_conds == 1, f"expected one grouped __cond__, got {n_conds}"
