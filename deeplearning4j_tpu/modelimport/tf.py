@@ -1345,9 +1345,13 @@ def _init_var(imp, ref):
     var_type, and a host-folded ARRAY var would hide the static value."""
     from deeplearning4j_tpu.autodiff.samediff import VariableType
 
-    name = ref.split(":")[0].lstrip("^")
+    parts = ref.lstrip("^").split(":")
+    name = parts[0]
+    idx0 = len(parts) == 1 or parts[-1] in ("0", "")
     v = imp.tensor(ref)  # ensures the producer (and any folding) ran
-    if v.var_type != VariableType.CONSTANT and name in imp.consts:
+    # consts is keyed by NODE name and holds output 0 — never promote a
+    # :k>0 ref from it
+    if v.var_type != VariableType.CONSTANT and idx0 and name in imp.consts:
         return imp.sd.constant(_uniq(imp.sd, name), imp.consts[name])
     return v
 
