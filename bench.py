@@ -643,6 +643,99 @@ def bench_lenet(peak, *, batch_size=256, warmup=4, iters=200):
     return info
 
 
+def bench_serving(peak, *, n_threads=8, requests_per_thread=40,
+                  max_batch=16):
+    """Serving-path benchmark: requests/sec and p50/p99 end-to-end latency
+    at a fixed offered load (N closed-loop client threads, mixed batch
+    sizes) through the full stack — real loopback HTTP, ModelServer,
+    admission control, ParallelInference dynamic batching — plus mean
+    batch occupancy from the worker-side metrics hook. ``peak`` (chip
+    FLOPs) is unused: the metric is end-to-end serving capacity, not MFU.
+    """
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.serving import (
+        DeadlineExceededError,
+        ModelRegistry,
+        ModelServer,
+        QueueFullError,
+        ServingClient,
+        spec,
+    )
+
+    model = lenet()
+    registry = ModelRegistry()
+    registry.register(
+        "lenet", lambda v, x: model.output(v, x), model.init(seed=0),
+        input_spec=spec((28, 28, 1)), version="v1", mode="batched",
+        max_batch_size=max_batch)
+    server = ModelServer(registry, port=0)
+    server.start(warm=True)  # buckets pre-compiled: no compile in the window
+    try:
+        client = ServingClient(server.url)
+        lock = threading.Lock()
+        latencies, rows_served, shed, broken = [], [], [], []
+        barrier = threading.Barrier(n_threads + 1)
+
+        def run(tid):
+            rng = np.random.default_rng(tid)
+            barrier.wait()
+            for i in range(requests_per_thread):
+                rows = 1 + (tid + i) % 4
+                x = rng.normal(size=(rows, 784)).astype(np.float32)
+                t0 = time.monotonic()
+                try:
+                    client.predict("lenet", x, deadline_ms=30000)
+                    dt = time.monotonic() - t0
+                    with lock:
+                        latencies.append(dt)
+                        rows_served.append(rows)
+                except (QueueFullError, DeadlineExceededError) as e:
+                    with lock:
+                        shed.append(e)
+                except Exception as e:  # noqa: BLE001 - anything else = bug
+                    with lock:
+                        broken.append(e)
+
+        threads = [threading.Thread(target=run, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        barrier.wait()  # all clients poised: the window starts here
+        t_start = time.monotonic()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t_start
+
+        occupancy = server.metrics.batch_occupancy.summary(model="lenet")
+        device = server.metrics.device_latency.summary(model="lenet")
+        lat_ms = (np.sort(np.asarray(latencies)) if latencies
+                  else np.zeros(1)) * 1e3
+        total = n_threads * requests_per_thread
+        info = {
+            "n_threads": n_threads, "offered": total,
+            "served": len(latencies), "shed": len(shed),
+            "broken": len(broken), "max_batch": max_batch,
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            "rows_per_sec": round(sum(rows_served) / wall, 1),
+            "batch_occupancy_mean": round(occupancy["mean"], 3),
+            "device_batches": device["count"],
+            "device_ms_mean": round(device["mean"] * 1e3, 2),
+            # rides the CPU config-integrity machinery: ok = every request
+            # either served or shed with a typed error, and some served
+            "converged": bool(latencies) and not broken,
+            "unit": "requests/sec",
+        }
+        info["value"] = round(len(latencies) / wall, 1)
+        return info
+    finally:
+        server.stop()
+
+
 _CONFIGS = {
     "bert": bench_bert,
     # Batch-size knee probe (no baseline row): how much of the remaining
@@ -666,6 +759,9 @@ _CONFIGS = {
     # GPT causal-LM (decoder-only; first recorded r4 — no baseline row yet,
     # the first green driver value becomes the baseline per BASELINE.md).
     "gpt": bench_gpt,
+    # End-to-end serving capacity through serving/ (HTTP + admission +
+    # dynamic batching); first recorded round — no baseline row yet.
+    "serving": bench_serving,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -678,6 +774,8 @@ _CPU_INTEGRITY = {
     "bert": dict(batch_size=2, seq_len=32, warmup=0, iters=3),
     "resnet50": dict(batch_size=2, warmup=0, iters=3),
     "gpt": dict(batch_size=2, seq_len=32, warmup=0, iters=3, tiny=True),
+    # serving reports "converged" = all requests served-or-typed-shed
+    "serving": dict(n_threads=4, requests_per_thread=6, max_batch=8),
 }
 
 
@@ -734,7 +832,8 @@ def _cpu_kernel_parity():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs",
-                    default="bert,resnet50,resnet50_b128,lstm,lenet,gpt",
+                    default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
+                            "serving",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

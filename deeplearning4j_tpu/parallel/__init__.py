@@ -30,7 +30,10 @@ from deeplearning4j_tpu.parallel.pipeline import (
     stack_stage_params,
     stage_params_sharding,
 )
-from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceQueueFull,
+    ParallelInference,
+)
 
 __all__ = [
     "batch_spec",
@@ -50,4 +53,5 @@ __all__ = [
     "stack_stage_params",
     "stage_params_sharding",
     "ParallelInference",
+    "InferenceQueueFull",
 ]

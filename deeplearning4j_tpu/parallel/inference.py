@@ -17,11 +17,28 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+class InferenceQueueFull(RuntimeError):
+    """Raised by ``output()`` when the request queue is at ``queue_limit``.
+
+    This is structured backpressure, not a bug: the server is saturated
+    and the caller should shed/retry. The old behavior (block until a
+    slot frees) held ``_state_lock`` through the blocking put, which
+    ``shutdown()`` also needs — sustained overload deadlocked shutdown
+    until the worker ``join(timeout=30)`` expired."""
+
+
+def _rows(inputs) -> int:
+    """Leading-dim row count of a features pytree (single array or a
+    dict of aligned arrays, e.g. BERT's {token_ids, segment_ids, mask})."""
+    return jax.tree_util.tree_leaves(inputs)[0].shape[0]
 
 
 class _Request:
@@ -43,8 +60,17 @@ class ParallelInference:
     dispatches each request alone; "batched" coalesces queued requests up
     to ``max_batch_size`` rows and pads the coalesced batch to a
     power-of-two bucket so compilation count stays bounded under traffic
-    with varying request sizes. Features must be a single array whose
-    non-leading dims agree across requests.
+    with varying request sizes. Features are a single array — or a pytree
+    of arrays sharing the leading batch dim (dict-feature models like
+    BERT) — whose non-leading dims agree across requests.
+
+    ``on_batch``: optional callback ``(n_requests, rows, bucket_rows,
+    seconds)`` invoked after every device dispatch — the hook the serving
+    layer uses for batch-occupancy and on-device-latency metrics.
+
+    When the queue is at ``queue_limit``, ``output()`` raises
+    :class:`InferenceQueueFull` instead of blocking (overload must shed,
+    not wedge shutdown).
 
     Usage::
 
@@ -64,6 +90,7 @@ class ParallelInference:
         mode: str = "instant",
         max_batch_size: int = 32,
         queue_limit: int = 256,
+        on_batch: Optional[Callable[[int, int, int, float], None]] = None,
     ):
         if mode not in ("instant", "batched"):
             raise ValueError(f"mode {mode!r}; valid: instant|batched")
@@ -72,6 +99,7 @@ class ParallelInference:
         self._max_batch = max_batch_size
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue(queue_limit)
         self._state_lock = threading.Lock()  # orders enqueue vs shutdown
+        self._on_batch = on_batch
         self._fn = jax.jit(forward)
         # One replica of the variables per device (↔ model.clone() per GPU —
         # but here it's the same immutable buffers, transferred not cloned).
@@ -93,16 +121,35 @@ class ParallelInference:
         """Blocking single-request inference (thread-safe).
 
         On timeout the request is marked cancelled — a worker that picks it
-        up later skips it instead of computing a result nobody reads."""
+        up later skips it instead of computing a result nobody reads.
+        Raises :class:`InferenceQueueFull` when the queue is at
+        ``queue_limit`` (never blocks while holding the state lock)."""
+        # Validate here, in the caller's thread: malformed features that
+        # raised in the worker's batch-collection path would kill the
+        # worker and strand every request it held.
+        try:
+            _rows(features)
+        except (IndexError, AttributeError, TypeError) as e:
+            raise ValueError(
+                "features must be a non-empty pytree of arrays with a "
+                f"leading batch dim, got {type(features).__name__}") from e
         req = _Request(features)
         # Lock orders the running-check + enqueue against shutdown()'s
-        # running-flip + sentinel enqueue: a request admitted here is
-        # guaranteed to precede the sentinels in the FIFO, so workers
-        # serve it before exiting.
+        # running-flip: a request admitted here is guaranteed to precede
+        # the sentinels in the FIFO, so workers serve it before exiting.
+        # The put must be non-blocking — a blocking put at queue_limit
+        # would hold the lock shutdown() needs, deadlocking it under
+        # sustained overload.
         with self._state_lock:
             if not self._running:
                 raise RuntimeError("ParallelInference is shut down")
-            self._queue.put(req)
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                req = None
+        if req is None:
+            raise InferenceQueueFull(
+                f"request queue full (queue_limit={self._queue.maxsize})")
         if not req.event.wait(timeout):
             req.cancelled = True
             raise TimeoutError("inference request timed out")
@@ -117,8 +164,11 @@ class ParallelInference:
             if not self._running:
                 return
             self._running = False
-            for _ in self._workers:
-                self._queue.put(None)
+        # Sentinels go in OUTSIDE the lock: at queue_limit this put blocks
+        # until workers drain (guaranteed progress — they only consume),
+        # and no output() can slip in ahead since _running is already off.
+        for _ in self._workers:
+            self._queue.put(None)
         for th in self._workers:
             th.join(timeout=30)
         # Anything still queued after the workers died (crash path): fail it.
@@ -148,7 +198,7 @@ class ParallelInference:
             return None, None
         batch = [req]
         if self._mode == "batched":
-            rows = req.inputs.shape[0]
+            rows = _rows(req.inputs)
             while rows < self._max_batch:
                 try:
                     nxt = self._queue.get_nowait()
@@ -159,19 +209,24 @@ class ParallelInference:
                     break
                 if nxt.cancelled:
                     continue
-                if rows + nxt.inputs.shape[0] > self._max_batch:
+                if rows + _rows(nxt.inputs) > self._max_batch:
                     return batch, nxt  # would overflow: starts next batch
                 batch.append(nxt)
-                rows += nxt.inputs.shape[0]
+                rows += _rows(nxt.inputs)
         return batch, None
 
     @staticmethod
     def _bucket(rows: int, cap: int) -> int:
-        """Next power-of-two ≥ rows (≤ cap): bounds jit compilation count."""
+        """Next power-of-two ≥ rows, clamped to the cap bucket when rows
+        fit under it. In-cap traffic sees ≤ log2(cap)+1 programs; an
+        oversized request (rows > cap, possible for direct callers —
+        the serving layer rejects them) still pads to a power of two,
+        so compilation count stays log-bounded, never one program per
+        distinct row count."""
         b = 1
         while b < rows:
             b *= 2
-        return min(b, max(cap, rows))
+        return min(b, cap) if rows <= cap else b
 
     def _worker(self, idx: int, device):
         variables = self._replicas[idx]
@@ -184,19 +239,34 @@ class ParallelInference:
             if not batch:
                 continue
             try:
-                sizes = [r.inputs.shape[0] for r in batch]
+                sizes = [_rows(r.inputs) for r in batch]
                 rows = sum(sizes)
-                feats = jnp.concatenate(
-                    [jnp.asarray(r.inputs) for r in batch]) \
-                    if len(batch) > 1 else jnp.asarray(batch[0].inputs)
+                if len(batch) > 1:
+                    feats = jax.tree_util.tree_map(
+                        lambda *xs: jnp.concatenate(
+                            [jnp.asarray(x) for x in xs]),
+                        *[r.inputs for r in batch])
+                else:
+                    feats = jax.tree_util.tree_map(
+                        jnp.asarray, batch[0].inputs)
+                bucket = rows
                 if self._mode == "batched":
                     bucket = self._bucket(rows, self._max_batch)
                     if bucket > rows:
-                        pad = jnp.zeros((bucket - rows, *feats.shape[1:]),
-                                        feats.dtype)
-                        feats = jnp.concatenate([feats, pad])
+                        feats = jax.tree_util.tree_map(
+                            lambda a: jnp.concatenate(
+                                [a, jnp.zeros((bucket - rows, *a.shape[1:]),
+                                              a.dtype)]),
+                            feats)
+                t0 = time.monotonic()
                 out = jax.device_get(
                     self._fn(variables, jax.device_put(feats, device)))
+                if self._on_batch is not None:
+                    try:
+                        self._on_batch(len(batch), rows, bucket,
+                                       time.monotonic() - t0)
+                    except Exception:  # noqa: BLE001 — metrics never fail serving
+                        pass
                 offs = np.cumsum([0] + sizes)
                 for r, lo, hi in zip(batch, offs[:-1], offs[1:]):
                     r.result = jax.tree_util.tree_map(

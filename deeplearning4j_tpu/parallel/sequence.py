@@ -34,12 +34,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map as _shard_map
+try:  # jax >= 0.6: top-level export, replication check renamed check_vma
+    from jax import shard_map as _shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+except ImportError:  # jax 0.4/0.5: experimental module, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SHARD_MAP_KW = {"check_rep": False}
 
 
 def shard_map(f, mesh, in_specs, out_specs):
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_vma=False)
+                      **_SHARD_MAP_KW)
 
 from deeplearning4j_tpu.kernels.flash_attention import (
     flash_attention,

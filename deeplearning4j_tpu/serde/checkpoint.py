@@ -87,11 +87,16 @@ def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict]
     _write_snapshot(directory, *_snapshot_tree(tree), extra_meta=extra_meta)
 
 
-def load_state_tree(directory: str | Path, template: Any, sharding=None) -> Any:
+def load_state_tree(directory: str | Path, template: Any, sharding=None,
+                    alias=None) -> Any:
     """Restore a pytree saved by save_state_tree into template's structure.
 
     ``sharding``: optional pytree of shardings (or one sharding) — leaves
     are device_put accordingly (topology-independent resharding).
+    ``alias``: optional ``name -> [candidate names]`` callable; the first
+    candidate present in the checkpoint is loaded (lets a template read
+    leaves saved under a different prefix, e.g. serving's ``state/`` vs a
+    TrainState's ``model_state/``).
     """
     d = Path(directory)
     meta = json.loads((d / "meta.json").read_text())
@@ -106,12 +111,15 @@ def load_state_tree(directory: str | Path, template: Any, sharding=None) -> Any:
     leaves = []
     for p, tmpl_leaf in paths:
         name = path_str(p)
-        if name not in data:
-            raise KeyError(f"checkpoint missing leaf '{name}'")
-        arr = data[name]
-        if name in key_paths:
+        candidates = [name] if alias is None else list(alias(name))
+        hit = next((c for c in candidates if c in data), None)
+        if hit is None:
+            tried = f" (tried {candidates})" if len(candidates) > 1 else ""
+            raise KeyError(f"checkpoint missing leaf '{name}'{tried}")
+        arr = data[hit]
+        if hit in key_paths:
             leaves.append(jax.random.wrap_key_data(
-                jax.numpy.asarray(arr), impl=key_impls.get(name)))
+                jax.numpy.asarray(arr), impl=key_impls.get(hit)))
         else:
             leaves.append(jax.numpy.asarray(arr).astype(tmpl_leaf.dtype))
     tree = jax.tree_util.tree_unflatten(treedef, leaves)
@@ -296,3 +304,20 @@ def restore_checkpoint(ckpt_dir: str | Path, train_state_template,
 def load_model_config(ckpt_dir: str | Path):
     """Rebuild the model config from a checkpoint's config.json."""
     return config_from_json((Path(ckpt_dir) / "config.json").read_text())
+
+
+def load_inference_variables(ckpt_dir: str | Path, model) -> Any:
+    """Inference variables ``{"params", "state"}`` from a checkpoint.
+
+    Serving-side loader (serving/registry.py): accepts both checkpoint
+    flavors — a full TrainState (leaves ``params/...``,
+    ``model_state/...``) and a bare variables dict (``params/...``,
+    ``state/...``) — and drops optimizer state, step, and RNG, which
+    inference never needs. ``model.init()`` provides the target structure
+    and leaf dtypes."""
+    def alias(name):
+        if name.startswith("state/"):
+            return [name, "model_state/" + name[len("state/"):]]
+        return [name]
+
+    return load_state_tree(ckpt_dir, model.init(), alias=alias)

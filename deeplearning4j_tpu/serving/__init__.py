@@ -1,0 +1,70 @@
+"""Production model serving (↔ the reference's ParallelInference-behind-
+REST serving story, grown into a first-class subsystem).
+
+- registry: multi-model ModelRegistry — versions, warmed hot-swap
+  (load → pre-compile → atomic switch → drain old replicas), rollback,
+  checkpoint loading via serde.
+- admission: bounded in-flight admission + per-request deadlines;
+  overload sheds with structured backpressure errors, never blocks.
+- warmup: pre-compiles the power-of-two batch buckets ParallelInference
+  pads to, so no live request eats a first-compile spike.
+- metrics: Prometheus-text-format counters/histograms with a JSON twin.
+- server: ModelServer — POST /v1/models/<name>:predict, GET /models,
+  /healthz, /readyz, /metrics; graceful drain on shutdown.
+- client: stdlib ServingClient raising the same typed errors.
+"""
+
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionController,
+    AdmissionTicket,
+)
+from deeplearning4j_tpu.serving.client import ServingClient
+from deeplearning4j_tpu.serving.errors import (
+    BadRequestError,
+    DeadlineExceededError,
+    ModelNotFoundError,
+    NotReadyError,
+    QueueFullError,
+    ServingError,
+    error_from_code,
+)
+from deeplearning4j_tpu.serving.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    ServingMetrics,
+)
+from deeplearning4j_tpu.serving.registry import ModelEntry, ModelRegistry
+from deeplearning4j_tpu.serving.server import ModelServer
+from deeplearning4j_tpu.serving.warmup import (
+    bucket_sizes,
+    spec,
+    warmup_inference,
+    zeros_batch,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionTicket",
+    "BadRequestError",
+    "Counter",
+    "DeadlineExceededError",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ModelEntry",
+    "ModelNotFoundError",
+    "ModelRegistry",
+    "ModelServer",
+    "NotReadyError",
+    "QueueFullError",
+    "ServingClient",
+    "ServingError",
+    "ServingMetrics",
+    "bucket_sizes",
+    "error_from_code",
+    "spec",
+    "warmup_inference",
+    "zeros_batch",
+]

@@ -1,0 +1,116 @@
+"""Admission control: bounded in-flight requests, deadlines, drain.
+
+Sits in front of the per-model ParallelInference queues and gives the
+server explicit overload semantics: a request is either admitted (and
+then served or deadline-failed) or rejected *immediately* with a
+structured :class:`~deeplearning4j_tpu.serving.errors.QueueFullError` —
+it never blocks in the HTTP handler, so overload degrades into fast
+429s instead of piled-up threads (the same discipline the reference's
+ParallelInference queue_limit intends, made non-blocking end to end).
+
+Drain support: ``drain()`` waits for in-flight count to reach zero —
+graceful shutdown serves what was admitted and sheds the rest.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.serving.errors import BadRequestError, QueueFullError
+
+
+class AdmissionTicket:
+    """Held while a request is in flight; ``release()`` is idempotent."""
+
+    def __init__(self, controller: "AdmissionController"):
+        self._controller = controller
+        self._released = False
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self._controller._release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        *,
+        max_in_flight: int = 64,
+        default_deadline_ms: float = 30000.0,
+        max_deadline_ms: float = 300000.0,
+        on_depth: Optional[Callable[[int], None]] = None,
+    ):
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        self.max_in_flight = max_in_flight
+        self.default_deadline_ms = default_deadline_ms
+        self.max_deadline_ms = max_deadline_ms
+        self._on_depth = on_depth
+        self._cv = threading.Condition()
+        self._in_flight = 0
+
+    @property
+    def in_flight(self) -> int:
+        with self._cv:
+            return self._in_flight
+
+    def admit(self) -> AdmissionTicket:
+        """Admit or raise QueueFullError — never blocks."""
+        with self._cv:
+            if self._in_flight >= self.max_in_flight:
+                raise QueueFullError(
+                    f"admission cap reached ({self.max_in_flight} in flight)")
+            self._in_flight += 1
+            # report under the lock: out-of-order depth publications would
+            # leave the gauge stale (e.g. nonzero forever while idle)
+            self._report(self._in_flight)
+        return AdmissionTicket(self)
+
+    def _release(self):
+        with self._cv:
+            self._in_flight -= 1
+            self._report(self._in_flight)
+            self._cv.notify_all()
+
+    def _report(self, depth: int):
+        if self._on_depth is not None:
+            try:
+                self._on_depth(depth)
+            except Exception:  # noqa: BLE001 — metrics never fail admission
+                pass
+
+    def timeout_s(self, deadline_ms=None) -> float:
+        """Validate+clamp a per-request deadline into a seconds timeout."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        try:
+            deadline_ms = float(deadline_ms)
+        except (TypeError, ValueError):
+            raise BadRequestError(f"deadline_ms must be a number, "
+                                  f"got {deadline_ms!r}") from None
+        if not math.isfinite(deadline_ms) or deadline_ms <= 0:
+            # NaN survives json.loads and both comparisons below
+            raise BadRequestError(
+                "deadline_ms must be a positive finite number")
+        return min(deadline_ms, self.max_deadline_ms) / 1000.0
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until nothing is in flight; True if fully drained."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._in_flight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True

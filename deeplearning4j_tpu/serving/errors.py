@@ -1,0 +1,78 @@
+"""Structured serving errors (shared by server and client).
+
+Every failure a client can observe maps to one class here, carrying a
+stable machine-readable ``code``, an HTTP status, and a ``retryable``
+hint. The server renders them as ``{"error": {code, message, retryable}}``
+bodies; the client parses that body back into the same exception class —
+so a Python caller sees ``QueueFullError`` whether the shed happened
+in-process or across the wire (↔ TF-Serving / KServe error envelopes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+_BY_CODE: Dict[str, Type["ServingError"]] = {}
+
+
+class ServingError(RuntimeError):
+    """Base class; subclasses fix ``code``/``http_status``/``retryable``."""
+
+    code = "INTERNAL"
+    http_status = 500
+    retryable = False
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        _BY_CODE[cls.code] = cls
+
+    @property
+    def message(self) -> str:
+        return str(self)
+
+    def to_json(self) -> dict:
+        return {"error": {"code": self.code, "message": self.message,
+                          "retryable": self.retryable}}
+
+
+class BadRequestError(ServingError):
+    """Malformed body / inputs that don't match the model's input spec."""
+
+    code = "INVALID_ARGUMENT"
+    http_status = 400
+
+
+class ModelNotFoundError(ServingError):
+    """No registry entry under the requested name."""
+
+    code = "NOT_FOUND"
+    http_status = 404
+
+
+class NotReadyError(ServingError):
+    """Server not started yet, warming up, or draining for shutdown."""
+
+    code = "UNAVAILABLE"
+    http_status = 503
+    retryable = True
+
+
+class QueueFullError(ServingError):
+    """Load shed: admission cap or the model's request queue is full."""
+
+    code = "RESOURCE_EXHAUSTED"
+    http_status = 429
+    retryable = True
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline elapsed before a result was produced."""
+
+    code = "DEADLINE_EXCEEDED"
+    http_status = 504
+
+
+def error_from_code(code: str, message: str = "") -> ServingError:
+    """Rebuild the typed exception from a wire ``code`` (client side)."""
+    cls = _BY_CODE.get(code, ServingError)
+    return cls(message)

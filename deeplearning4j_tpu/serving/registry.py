@@ -1,0 +1,388 @@
+"""Multi-model registry with warmed hot-swap and rollback.
+
+One ``ModelRegistry`` holds N named entries; each entry owns the live
+``ParallelInference`` replica set for its active version plus a version
+history. Deployment discipline (↔ TF-Serving's version policy):
+
+1. ``deploy(name, variables)`` builds a NEW replica set from the new
+   variables,
+2. pre-compiles every batch bucket against it (warmup) while the old
+   version keeps serving,
+3. atomically switches the active pointer under the entry lock,
+4. drains the old replicas (``shutdown()`` serves everything already
+   queued, FIFO, then the workers exit).
+
+No request ever observes a torn model: a request is served entirely by
+whichever replica set it was enqueued on, and a request that loses the
+race against the old set's drain (enqueue raises "shut down") retries
+once on the new active set.
+
+``register_from_checkpoint`` loads entries straight from serde
+checkpoints (config.json rebuilds the model, state.npz supplies the
+variables) — the registry is the serving-side consumer of the training
+side's checkpoint rotation.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.serving.errors import (
+    BadRequestError,
+    ModelNotFoundError,
+    NotReadyError,
+    ServingError,
+)
+from deeplearning4j_tpu.serving.warmup import bucket_sizes, warmup_inference
+
+
+class _Active:
+    __slots__ = ("pi", "version")
+
+    def __init__(self, pi, version):
+        self.pi = pi
+        self.version = version
+
+
+class ModelEntry:
+    """One named model: active replica set + version history."""
+
+    def __init__(self, registry: "ModelRegistry", name: str,
+                 forward: Callable[[Any, Any], Any], input_spec: Any, *,
+                 mode: str = "batched", max_batch_size: int = 32,
+                 queue_limit: int = 256, devices: Optional[Sequence] = None):
+        self._registry = registry
+        self.name = name
+        self.forward = forward
+        self.input_spec = input_spec
+        self.mode = mode
+        self.max_batch_size = max_batch_size
+        self.queue_limit = queue_limit
+        self.devices = devices
+        self._lock = threading.Lock()
+        # Serializes deploy/rollback (history mutation + swap) so
+        # concurrent deploys can't leave the active version out of sync
+        # with history[-1]. Never held while _lock is already held.
+        self._deploy_lock = threading.Lock()
+        self._active: Optional[_Active] = None
+        self.history: List[Tuple[str, Any]] = []  # (version, variables)
+        self.warmed = False
+
+    # -- replica-set lifecycle ---------------------------------------------
+
+    def _build_pi(self, variables) -> ParallelInference:
+        return ParallelInference(
+            self.forward, variables, devices=self.devices, mode=self.mode,
+            max_batch_size=self.max_batch_size, queue_limit=self.queue_limit,
+            on_batch=functools.partial(
+                self._registry._record_batch, self.name))
+
+    def warm(self) -> Dict[int, float]:
+        """Pre-compile every batch bucket on the active replica set.
+
+        Expects no concurrent traffic on this entry (the standard paths —
+        ``ModelServer.start(warm=True)`` before serving begins, and
+        ``deploy``'s warm of a not-yet-active set — are quiescent): a live
+        request coalescing with a warmup batch would shift it into a
+        different bucket, leaving the intended one uncompiled."""
+        with self._lock:
+            active = self._active
+        if active is None:
+            raise NotReadyError(f"model '{self.name}' is shut down")
+        stats = warmup_inference(
+            active.pi, self.input_spec,
+            bucket_sizes(self.max_batch_size, self.mode))
+        self.warmed = True
+        self._registry._record_ready(self.name, True)
+        return stats
+
+    @property
+    def version(self) -> str:
+        with self._lock:
+            return self._active.version if self._active else ""
+
+    # -- serving -----------------------------------------------------------
+
+    def predict(self, features, timeout: Optional[float] = None):
+        """Serve one request on the active replica set."""
+        return self.predict_versioned(features, timeout=timeout)[0]
+
+    def predict_versioned(self, features,
+                          timeout: Optional[float] = None) -> Tuple[Any, str]:
+        """Serve one request; returns ``(outputs, version)`` where
+        ``version`` is the version of the replica set that actually
+        served — read under the same lock as the pointer grab, so a
+        concurrent hot-swap can never mislabel a response.
+
+        Retries once if the grabbed replica set was drained by a
+        concurrent hot-swap between the pointer read and the enqueue —
+        the swap guarantees a live active set exists."""
+        for attempt in range(2):
+            with self._lock:
+                if self._active is None:
+                    if self.history:
+                        # had versions, now none: the entry was shut down
+                        # (server stopping) — retryable 503, not a 500
+                        raise NotReadyError(
+                            f"model '{self.name}' is shut down")
+                    raise ServingError(f"model '{self.name}' has no "
+                                       "deployed version")
+                pi, version = self._active.pi, self._active.version
+            try:
+                return pi.output(features, timeout=timeout), version
+            except RuntimeError as e:
+                if "shut down" in str(e) and attempt == 0:
+                    continue
+                raise
+
+    def parse_inputs(self, inputs):
+        """JSON-decoded inputs → feature arrays matching the input spec.
+
+        Array-spec models accept a nested list (any layout whose row size
+        matches — a flat 784-float row reshapes to (28,28,1)); dict-spec
+        models accept an object with exactly the spec's keys.
+
+        Batched-mode requests larger than ``max_batch_size`` are rejected:
+        oversized batches fall outside the pre-compiled (warmed) buckets,
+        so admitting them would hand arbitrary clients fresh XLA compiles."""
+        if isinstance(self.input_spec, dict):
+            if not isinstance(inputs, dict):
+                raise BadRequestError(
+                    f"model '{self.name}' takes a dict of inputs "
+                    f"{sorted(self.input_spec)}")
+            extra = set(inputs) - set(self.input_spec)
+            if extra:
+                raise BadRequestError(f"unknown inputs {sorted(extra)}; "
+                                      f"expected {sorted(self.input_spec)}")
+            out, rows = {}, None
+            for key, s in self.input_spec.items():
+                if key not in inputs:
+                    raise BadRequestError(f"missing input '{key}'")
+                out[key] = self._coerce(inputs[key], s, key)
+                n = out[key].shape[0]
+                if rows is not None and n != rows:
+                    raise BadRequestError(
+                        f"inputs disagree on batch size ({rows} vs {n})")
+                rows = n
+            self._check_rows(rows)
+            return out
+        arr = self._coerce(inputs, self.input_spec, "inputs")
+        self._check_rows(arr.shape[0])
+        return arr
+
+    def _check_rows(self, rows: int):
+        if self.mode == "batched" and rows > self.max_batch_size:
+            raise BadRequestError(
+                f"batch of {rows} rows exceeds this model's "
+                f"max_batch_size={self.max_batch_size}; split the request")
+
+    def _coerce(self, value, s, label: str):
+        try:
+            arr = np.asarray(value, dtype=np.dtype(s.dtype))
+            return arr.reshape((-1,) + tuple(s.shape))
+        except Exception as e:  # noqa: BLE001 — anything here is the client's
+            raise BadRequestError(
+                f"{label}: cannot coerce to shape (N, "
+                f"{', '.join(map(str, s.shape))}) {np.dtype(s.dtype).name}: "
+                f"{e}") from None
+
+    def describe(self) -> dict:
+        with self._lock:
+            version = self._active.version if self._active else ""
+        return {"name": self.name, "version": version,
+                "versions": [v for v, _ in self.history],
+                "warmed": self.warmed, "mode": self.mode,
+                "max_batch_size": self.max_batch_size}
+
+    def shutdown(self):
+        with self._lock:
+            active, self._active = self._active, None
+        if active is not None:
+            active.pi.shutdown()
+
+
+class ModelRegistry:
+    def __init__(self, *, metrics=None):
+        self._entries: Dict[str, ModelEntry] = {}
+        self._lock = threading.Lock()
+        self._metrics = metrics
+
+    def attach_metrics(self, metrics):
+        """Wire a ServingMetrics bundle (occupancy/device-latency hooks
+        take effect immediately — entries call back through the registry)."""
+        self._metrics = metrics
+
+    # -- metrics hooks (called from ParallelInference workers) -------------
+
+    def _record_batch(self, name: str, n_requests: int, rows: int,
+                      bucket: int, seconds: float):
+        m = self._metrics
+        if m is not None:
+            m.batch_occupancy.observe(rows / max(bucket, 1), model=name)
+            m.device_latency.observe(seconds, model=name)
+
+    def _record_ready(self, name: str, ready: bool):
+        m = self._metrics
+        if m is not None:
+            m.model_ready.set(1.0 if ready else 0.0, model=name)
+
+    # -- registration / deployment -----------------------------------------
+
+    def register(self, name: str, forward: Callable[[Any, Any], Any],
+                 variables: Any, *, input_spec: Any, version: str = "v1",
+                 mode: str = "batched", max_batch_size: int = 32,
+                 queue_limit: int = 256, devices: Optional[Sequence] = None,
+                 warm: bool = False) -> ModelEntry:
+        """Create an entry and deploy ``variables`` as its first version."""
+        entry = ModelEntry(self, name, forward, input_spec, mode=mode,
+                           max_batch_size=max_batch_size,
+                           queue_limit=queue_limit, devices=devices)
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"model '{name}' already registered")
+        # Activate BEFORE publishing: a concurrent predict must never see
+        # a registered entry with no deployed version.
+        entry._active = _Active(entry._build_pi(variables), version)
+        entry.history.append((version, variables))
+        with self._lock:
+            if name in self._entries:  # lost a register-register race
+                entry.shutdown()
+                raise ValueError(f"model '{name}' already registered")
+            self._entries[name] = entry
+        self._record_ready(name, False)
+        if warm:
+            entry.warm()
+        return entry
+
+    def register_from_checkpoint(self, name: str, ckpt_dir, *,
+                                 forward: Optional[Callable] = None,
+                                 input_spec: Any = None,
+                                 version: Optional[str] = None,
+                                 **kw) -> ModelEntry:
+        """Load a registry entry from a serde checkpoint directory.
+
+        ``config.json`` rebuilds the model; ``state.npz`` supplies the
+        inference variables (works for both TrainState and bare-variables
+        checkpoints). ``forward`` defaults to ``model.output``;
+        ``input_spec`` defaults to the config's ``input_shape`` (float32)
+        when it has one."""
+        from deeplearning4j_tpu.serde.checkpoint import (
+            load_inference_variables,
+            load_model_config,
+        )
+        from deeplearning4j_tpu.serving.warmup import spec
+
+        cfg = load_model_config(ckpt_dir)
+        model = _model_for_config(cfg)
+        variables = load_inference_variables(ckpt_dir, model)
+        if forward is None:
+            forward = lambda v, x: model.output(v, x)  # noqa: E731
+        if input_spec is None:
+            shape = getattr(cfg, "input_shape", None)
+            if shape is None:
+                raise ValueError(
+                    "config has no input_shape; pass input_spec explicitly")
+            input_spec = spec(tuple(shape))
+        if version is None:
+            import pathlib
+
+            version = pathlib.Path(str(ckpt_dir)).name
+        return self.register(name, forward, variables,
+                             input_spec=input_spec, version=version, **kw)
+
+    def deploy(self, name: str, variables: Any, *,
+               version: Optional[str] = None, warm: bool = True) -> str:
+        """Warmed hot-swap: build + pre-compile a new replica set, switch
+        atomically, drain the old one. Returns the deployed version."""
+        entry = self.get(name)
+        with entry._deploy_lock:
+            if version is None:
+                version = f"v{len(entry.history) + 1}"
+            # Swap first, record second: a failed warmup must not leave a
+            # phantom never-activated version in the history.
+            self._swap(entry, variables, version, warm)
+            entry.history.append((version, variables))
+            # Rollback reaches exactly one version back, so older entries
+            # keep only their name — holding every past version's full
+            # variables would grow host memory per hot-swap forever.
+            if len(entry.history) > 2:
+                old_version, _ = entry.history[-3]
+                entry.history[-3] = (old_version, None)
+        return version
+
+    def rollback(self, name: str) -> str:
+        """Drop the active version and redeploy the previous one (itself
+        rebuilt + rewarmed — the drained replica set is gone)."""
+        entry = self.get(name)
+        with entry._deploy_lock:
+            if len(entry.history) < 2:
+                raise ServingError(f"model '{name}' has no previous version "
+                                   "to roll back to")
+            version, variables = entry.history[-2]
+            if variables is None:
+                raise ServingError(
+                    f"model '{name}' version {version} is too old to roll "
+                    "back to (only the previous version's variables are "
+                    "retained)")
+            self._swap(entry, variables, version, warm=True)
+            entry.history.pop()  # only after the swap succeeded
+        return version
+
+    def _swap(self, entry: ModelEntry, variables, version: str, warm: bool):
+        new_pi = entry._build_pi(variables)
+        if warm:
+            try:
+                warmup_inference(new_pi, entry.input_spec,
+                                 bucket_sizes(entry.max_batch_size,
+                                              entry.mode))
+            except BaseException:
+                # failed deploy: the old version keeps serving; don't leak
+                # the half-built replica set's worker threads
+                new_pi.shutdown()
+                raise
+        with entry._lock:
+            old, entry._active = entry._active, _Active(new_pi, version)
+            entry.warmed = warm
+        self._record_ready(entry.name, warm)
+        if old is not None:
+            old.pi.shutdown()  # FIFO drain: queued requests still served
+
+    # -- lookup -------------------------------------------------------------
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise ModelNotFoundError(f"no model named '{name}'")
+        return entry
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def entries(self) -> List[ModelEntry]:
+        with self._lock:
+            return [self._entries[n] for n in sorted(self._entries)]
+
+    def describe(self) -> List[dict]:
+        return [e.describe() for e in self.entries()]
+
+    def shutdown_all(self):
+        for entry in self.entries():
+            entry.shutdown()
+
+
+def _model_for_config(cfg):
+    from deeplearning4j_tpu.nn.config import GraphConfig, SequentialConfig
+    from deeplearning4j_tpu.nn.model import GraphModel, SequentialModel
+
+    if isinstance(cfg, SequentialConfig):
+        return SequentialModel(cfg)
+    if isinstance(cfg, GraphConfig):
+        return GraphModel(cfg)
+    raise TypeError(f"cannot build a servable model from {type(cfg).__name__}")

@@ -1,0 +1,254 @@
+"""ModelServer: the HTTP front of the serving subsystem.
+
+stdlib ``ThreadingHTTPServer`` over ``ModelRegistry`` +
+``AdmissionController`` + ``ServingMetrics`` — no dependencies beyond
+what the repo already ships. Endpoints:
+
+- ``POST /v1/models/<name>:predict`` — body
+  ``{"inputs": ..., "deadline_ms": <optional>}``; 200 returns
+  ``{"model", "version", "outputs"}``; failures return the structured
+  error envelope (errors.py) with 400/404/429/503/504 status.
+- ``GET /models``   — registry contents (name, version, history, warmed).
+- ``GET /healthz``  — process liveness, always 200 while serving.
+- ``GET /readyz``   — 200 only after every registered model's warmup
+  completed AND the server is not draining; 503 otherwise.
+- ``GET /metrics``  — Prometheus text format; ``?format=json`` for the
+  JSON twin.
+
+Graceful drain (``stop(drain=True)``): flip draining (readyz → 503, new
+predicts shed with UNAVAILABLE), wait for in-flight requests to finish,
+then stop the HTTP loop and shut the replica sets down (their FIFO
+drain serves anything still queued).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import InferenceQueueFull
+from deeplearning4j_tpu.serving.admission import AdmissionController
+from deeplearning4j_tpu.serving.errors import (
+    BadRequestError,
+    DeadlineExceededError,
+    ModelNotFoundError,
+    NotReadyError,
+    QueueFullError,
+    ServingError,
+)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+_PREDICT_RE = re.compile(r"^/v1/models/([\w.\-]+):predict$")
+
+_SHED_REASONS = {
+    QueueFullError: "queue_full",
+    DeadlineExceededError: "deadline",
+    NotReadyError: "draining",
+}
+
+
+class ModelServer:
+    def __init__(
+        self,
+        registry: Optional[ModelRegistry] = None,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics: Optional[ServingMetrics] = None,
+        admission: Optional[AdmissionController] = None,
+        default_deadline_ms: float = 30000.0,
+    ):
+        self.registry = registry if registry is not None else ModelRegistry()
+        if metrics is not None:
+            self.metrics = metrics
+        elif getattr(self.registry, "_metrics", None) is not None:
+            # adopt the bundle the registry was built with rather than
+            # silently re-routing its worker-side metrics to a fresh one
+            self.metrics = self.registry._metrics
+        else:
+            self.metrics = ServingMetrics()
+        self.registry.attach_metrics(self.metrics)
+        self.admission = admission if admission is not None else \
+            AdmissionController(on_depth=self.metrics.queue_depth.set,
+                                default_deadline_ms=default_deadline_ms)
+        self._draining = False
+        self._started = False
+        self._serve_thread: Optional[threading.Thread] = None
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # quiet: per-request stderr lines are useless under load tests
+            def log_message(self, *a):  # noqa: N802 - stdlib API
+                pass
+
+            def _send(self, status: int, body, content_type="application/json"):
+                raw = (body if isinstance(body, bytes)
+                       else json.dumps(body).encode())
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif path == "/readyz":
+                    body = server.readiness()
+                    self._send(200 if body["ready"] else 503, body)
+                elif path == "/models":
+                    self._send(200, {"models": server.registry.describe()})
+                elif path == "/metrics":
+                    if "format=json" in query:
+                        self._send(200, server.metrics.render_json())
+                    else:
+                        self._send(
+                            200, server.metrics.render_text().encode(),
+                            content_type="text/plain; version=0.0.4")
+                else:
+                    self._send(404, ServingError(
+                        f"no route {path}").to_json())
+
+            def do_POST(self):  # noqa: N802 - stdlib API
+                m = _PREDICT_RE.match(self.path.partition("?")[0])
+                if not m:
+                    self._send(404, ServingError(
+                        f"no route {self.path}").to_json())
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n)) if n else {}
+                except Exception as e:  # noqa: BLE001 - client's bad JSON
+                    self._send(400, BadRequestError(
+                        f"invalid JSON body: {e}").to_json())
+                    return
+                status, body = server.handle_predict(m.group(1), payload)
+                self._send(status, body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+
+    # -- surface -------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def readiness(self) -> dict:
+        models = {e["name"]: e["warmed"] for e in self.registry.describe()}
+        ready = (self._started and not self._draining
+                 and all(models.values()))
+        return {"ready": ready, "draining": self._draining, "models": models}
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- predict path (handler-independent for direct testing) ---------------
+
+    def handle_predict(self, name: str, payload) -> Tuple[int, dict]:
+        t0 = time.monotonic()
+        # Unknown model names are client-controlled: labeling metrics with
+        # them would grow a permanent label set per scanned/typo'd URL.
+        metric_model = name
+        try:
+            entry = self.registry.get(name)
+            if self._draining or not self._started:
+                raise NotReadyError("server is draining" if self._draining
+                                    else "server not started")
+            if not isinstance(payload, dict) or "inputs" not in payload:
+                raise BadRequestError('body must be {"inputs": ...}')
+            timeout = self.admission.timeout_s(payload.get("deadline_ms"))
+            # Admit before the body parse: over-cap traffic must shed
+            # before paying the array-coercion cost, not after.
+            ticket = self.admission.admit()
+            try:
+                features = entry.parse_inputs(payload["inputs"])
+                try:
+                    out, version = entry.predict_versioned(
+                        features, timeout=timeout)
+                except TimeoutError as e:
+                    raise DeadlineExceededError(
+                        str(e) or "deadline exceeded") from e
+                except InferenceQueueFull as e:
+                    raise QueueFullError(str(e)) from e
+                except RuntimeError as e:
+                    if "shut down" in str(e):
+                        # lost the race against stop(): a structured
+                        # retryable 503, not an INTERNAL 500
+                        raise NotReadyError("server is draining") from e
+                    raise
+            finally:
+                ticket.release()
+            outputs = jax.tree_util.tree_map(
+                lambda a: np.asarray(a).tolist(), out)
+            status, body = 200, {"model": name, "version": version,
+                                 "outputs": outputs}
+        except ServingError as e:
+            status, body = e.http_status, e.to_json()
+            if isinstance(e, ModelNotFoundError):
+                metric_model = "<unknown>"
+            reason = _SHED_REASONS.get(type(e))
+            if reason is not None:
+                self.metrics.shed_total.inc(model=metric_model, reason=reason)
+        except Exception as e:  # noqa: BLE001 — surface, never crash handler
+            status = 500
+            body = {"error": {"code": "INTERNAL", "message": str(e)[:300],
+                              "retryable": False}}
+        self.metrics.requests_total.inc(model=metric_model, code=str(status))
+        self.metrics.request_latency.observe(time.monotonic() - t0,
+                                             model=metric_model)
+        return status, body
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def warm_all(self) -> dict:
+        """Warm every not-yet-warmed entry; {name: {rows: seconds}}."""
+        return {e.name: e.warm()
+                for e in self.registry.entries() if not e.warmed}
+
+    def start(self, *, warm: bool = True) -> "ModelServer":
+        if self._started:
+            return self
+        if warm:
+            self.warm_all()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="model-server")
+        self._serve_thread.start()
+        self._started = True
+        return self
+
+    def stop(self, *, drain: bool = True, timeout: float = 30.0) -> bool:
+        """Graceful shutdown; returns True if fully drained in time."""
+        drained = True
+        if self._started:
+            self._draining = True
+            if drain:
+                drained = self.admission.drain(timeout)
+            self._httpd.shutdown()
+            if self._serve_thread is not None:
+                self._serve_thread.join(timeout=10)
+            self._started = False
+        self._httpd.server_close()
+        self.registry.shutdown_all()
+        return drained
+
+    def __enter__(self) -> "ModelServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

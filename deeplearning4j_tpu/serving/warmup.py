@@ -1,0 +1,68 @@
+"""Warmup: pre-compile the batch buckets a model will serve.
+
+``ParallelInference`` in batched mode pads coalesced batches to
+power-of-two row buckets (capped at ``max_batch_size``) — that bounds
+the number of distinct compiled programs, but each bucket still pays a
+first-compile latency spike the first time live traffic hits it. This
+module drives zero-batches of every reachable bucket size through the
+replica set *before* the model is marked ready, so no user request eats
+a compile (the same discipline PAPERS.md's weight-update-sharding paper
+applies to bounding training-step program counts).
+
+Input specs are pytrees of ``jax.ShapeDtypeStruct`` with *per-example*
+shapes (no batch dim): a single struct for array-feature models, a dict
+of structs for dict-feature models (BERT's {token_ids, segment_ids,
+mask}).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def spec(shape: Sequence[int], dtype=np.float32) -> jax.ShapeDtypeStruct:
+    """Per-example input spec leaf (shape WITHOUT the batch dim)."""
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+def bucket_sizes(max_batch: int, mode: str = "batched") -> List[int]:
+    """Row counts whose buckets cover everything batched traffic can hit:
+    powers of two below ``max_batch``, plus ``max_batch`` itself (the cap
+    bucket, which may not be a power of two). Instant mode does no
+    padding, so only batch=1 is predictably warmable."""
+    if mode == "instant":
+        return [1]
+    sizes = []
+    b = 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch)
+    return sizes
+
+
+def zeros_batch(input_spec: Any, rows: int):
+    """A ``rows``-example all-zeros batch matching the input spec."""
+    return jax.tree_util.tree_map(
+        lambda s: np.zeros((rows,) + tuple(s.shape), np.dtype(s.dtype)),
+        input_spec)
+
+
+def warmup_inference(pi, input_spec: Any,
+                     sizes: Optional[Sequence[int]] = None
+                     ) -> Dict[int, float]:
+    """Push one zero-batch per bucket through ``pi``; returns
+    {rows: seconds}. Sequential on purpose: concurrent warmup requests
+    would coalesce into one batch and skip buckets."""
+    if sizes is None:
+        sizes = bucket_sizes(pi._max_batch, pi._mode)
+    stats: Dict[int, float] = {}
+    for rows in sizes:
+        t0 = time.monotonic()
+        pi.output(zeros_batch(input_spec, rows))
+        stats[rows] = time.monotonic() - t0
+    return stats

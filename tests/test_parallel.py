@@ -134,6 +134,146 @@ def test_fsdp_matches_single_device():
         np.testing.assert_allclose(np.asarray(p1), np.asarray(p8), rtol=2e-5, atol=1e-6)
 
 
+class TestParallelInferenceOverload:
+    """Regression: overload must shed with InferenceQueueFull, and
+    shutdown() must never deadlock behind a full queue (the old blocking
+    ``put`` held _state_lock until a slot freed, wedging shutdown for
+    the whole 30 s worker join)."""
+
+    def _blocked_pi(self, queue_limit):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+        pi = ParallelInference(lambda v, x: x, np.zeros(1),
+                               devices=jax.devices()[:1],
+                               queue_limit=queue_limit)
+        release = __import__("threading").Event()
+
+        def slow_fn(v, x):
+            release.wait(30)
+            return np.asarray(x)
+
+        pi._fn = slow_fn  # worker-side block, fully controllable
+        return pi, release
+
+    def test_queue_full_raises_instead_of_blocking(self):
+        import threading
+        import time
+
+        from deeplearning4j_tpu.parallel.inference import InferenceQueueFull
+
+        pi, release = self._blocked_pi(queue_limit=2)
+        done = []
+        threads = [threading.Thread(
+            target=lambda: done.append(np.asarray(pi.output(
+                np.ones((1, 2), np.float32))))) for _ in range(3)]
+        for t in threads:
+            t.start()
+        # 1 request held by the worker + 2 filling the queue
+        deadline = time.monotonic() + 5
+        while pi._queue.qsize() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        with pytest.raises(InferenceQueueFull):
+            pi.output(np.ones((1, 2), np.float32))
+        assert time.monotonic() - t0 < 1.0, "backpressure must be immediate"
+
+        # shutdown while the queue is still full: must complete promptly
+        # and still serve everything already admitted (FIFO drain).
+        release.set()
+        t0 = time.monotonic()
+        pi.shutdown()
+        assert time.monotonic() - t0 < 10.0, "shutdown deadlocked"
+        for t in threads:
+            t.join(timeout=5)
+        assert len(done) == 3, "admitted requests lost during shutdown"
+        with pytest.raises(RuntimeError):
+            pi.output(np.ones((1, 2), np.float32))
+
+    def test_shutdown_prompt_while_queue_full_and_worker_busy(self):
+        import threading
+        import time
+
+        pi, release = self._blocked_pi(queue_limit=1)
+
+        def call():
+            # racing shutdown: queue-full / shut-down errors are expected
+            # (InferenceQueueFull subclasses RuntimeError)
+            try:
+                pi.output(np.ones((1, 2), np.float32))
+            except RuntimeError:
+                pass
+
+        t = threading.Thread(target=call)
+        t.start()
+        deadline = time.monotonic() + 5
+        while pi._queue.qsize() < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)  # a second thread fills the 1-slot queue
+        t2 = threading.Thread(target=call)
+        t2.start()
+        time.sleep(0.05)
+        stopper = threading.Thread(target=pi.shutdown)
+        t0 = time.monotonic()
+        stopper.start()
+        release.set()
+        stopper.join(timeout=10)
+        assert not stopper.is_alive(), "shutdown hung under overload"
+        assert time.monotonic() - t0 < 10.0
+        t.join(timeout=5), t2.join(timeout=5)
+
+
+def test_parallel_inference_rejects_malformed_features_and_bounds_buckets():
+    """Malformed features must fail in the caller's thread (a worker-side
+    raise in batch collection would kill the worker and strand every
+    queued request), and oversized rows must still pad to a power of two
+    so compile count stays log-bounded."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    with ParallelInference(lambda v, x: x, np.zeros(1),
+                           devices=jax.devices()[:1], mode="batched",
+                           max_batch_size=16) as pi:
+        with pytest.raises(ValueError):
+            pi.output({})  # empty pytree: no leaves
+        with pytest.raises(ValueError):
+            pi.output(np.float32(1.0))  # 0-d: no leading batch dim
+        # workers survived the bad requests
+        out = np.asarray(pi.output(np.ones((2, 3), np.float32)))
+        assert out.shape == (2, 3)
+    assert ParallelInference._bucket(17, 16) == 32  # pow2, not rows
+    assert ParallelInference._bucket(20, 24) == 24  # cap bucket
+    assert ParallelInference._bucket(16, 16) == 16
+
+
+def test_parallel_inference_dict_features_batched():
+    """Pytree (dict) features coalesce/pad through batched mode — the
+    BERT-style {token_ids, segment_ids, mask} serving path."""
+    import threading
+
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    def forward(v, feats):
+        return feats["a"] * v + feats["b"].astype(jnp.float32)
+
+    with ParallelInference(forward, jnp.asarray(2.0),
+                           devices=jax.devices()[:2], mode="batched",
+                           max_batch_size=8) as pi:
+        outs = {}
+
+        def call(i, rows):
+            feats = {"a": np.full((rows, 3), float(i), np.float32),
+                     "b": np.full((rows, 3), i, np.int32)}
+            outs[i] = np.asarray(pi.output(feats))
+
+        threads = [threading.Thread(target=call, args=(i, 1 + i % 3))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        for i, out in outs.items():
+            assert out.shape[1] == 3
+            np.testing.assert_allclose(out, 3.0 * i)
+
+
 def test_graft_dryrun_multichip():
     import sys
 
@@ -292,6 +432,8 @@ def test_fsdp_composes_with_grad_accum():
                                rtol=2e-5)
     for a, b in zip(jax.tree_util.tree_leaves(ts_1.params),
                     jax.tree_util.tree_leaves(ts_f.params)):
+        # fp32 reduction-order slack: XLA versions differ on the sharded
+        # accum path by up to ~6e-5 after 3 steps
         np.testing.assert_allclose(np.asarray(jax.device_get(a)),
                                    np.asarray(jax.device_get(b)),
-                                   atol=3e-5)
+                                   atol=1e-4)

@@ -1,0 +1,530 @@
+"""Serving subsystem tests (serving/): registry, admission, warmup,
+metrics, ModelServer lifecycle.
+
+Strategy mirrors the repo's multi-node-without-cluster pattern: real
+ThreadingHTTPServer on a port-0 loopback socket, real concurrent
+clients, 8 virtual CPU devices — the identical code path a v5e slice
+serves. Heavy sustained-load tests are @pytest.mark.slow (deselected by
+default via pyproject addopts) so tier-1 stays fast.
+"""
+
+import re
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (
+    AdmissionController,
+    BadRequestError,
+    DeadlineExceededError,
+    ModelNotFoundError,
+    ModelRegistry,
+    ModelServer,
+    QueueFullError,
+    ServingClient,
+    ServingError,
+    bucket_sizes,
+    spec,
+)
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _dense_model():
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+
+    net = NeuralNetConfiguration(seed=7)
+    layers = [Dense(units=8, activation="relu"),
+              OutputLayer(units=4, activation="softmax", loss="mcxent")]
+    return SequentialModel(
+        SequentialConfig(net=net, layers=layers, input_shape=(16,)))
+
+
+def _scale_forward(v, x):
+    """Every output row equals v['scale'] — a torn/mixed read is visible."""
+    return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+
+def _scale_server(**kw):
+    registry = ModelRegistry()
+    registry.register("scale", _scale_forward, {"scale": 1.0},
+                      input_spec=spec((4,)), version="v1", mode="batched",
+                      max_batch_size=8, devices=jax.devices()[:2])
+    server = ModelServer(registry, port=0, **kw)
+    return server, registry
+
+
+def _block_active_fn(entry, seconds=0.5):
+    """Make the entry's active replica set slow (worker-side sleep)."""
+    pi = entry._active.pi
+    orig = pi._fn
+
+    def slow(v, x):
+        time.sleep(seconds)
+        return orig(v, x)
+
+    pi._fn = slow
+    return pi, orig
+
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}'
+_VALUE = r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|Inf|NaN)"
+_SAMPLE_RE = re.compile(rf"^({_NAME})({_LABELS})? {_VALUE}$")
+
+
+def parse_prometheus(text):
+    """Strict-ish Prometheus text-format parser for the test assertions.
+
+    Returns {family: {"type": ..., "help": ..., "samples": [(name,
+    labels_str, value)]}}; raises AssertionError on malformed lines,
+    samples without a preceding HELP/TYPE header, or non-monotonic
+    histogram buckets."""
+    families, current = {}, None
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            current = families.setdefault(
+                name, {"help": help_text, "type": None, "samples": []})
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert name in families, f"TYPE before HELP: {line!r}"
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            families[name]["type"] = kind
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            sample_name = m.group(1)
+            family = next((f for f in families
+                           if sample_name in (f, f + "_bucket", f + "_sum",
+                                              f + "_count")), None)
+            assert family is not None, f"sample without header: {line!r}"
+            families[family]["samples"].append(
+                (sample_name, m.group(2) or "", float(line.rsplit(" ", 1)[1])))
+    for name, fam in families.items():
+        if fam["type"] == "histogram":
+            by_series = {}
+            for sname, labels, value in fam["samples"]:
+                if sname == name + "_bucket":
+                    key = re.sub(r',?le="[^"]*"', "", labels)
+                    by_series.setdefault(key, []).append(value)
+            for key, counts in by_series.items():
+                assert counts == sorted(counts), \
+                    f"{name}{key}: non-cumulative buckets {counts}"
+    return families
+
+
+# ---------------------------------------------------------------------------
+# registry + warmup (no HTTP)
+
+
+def test_registry_predict_matches_direct_forward():
+    model = _dense_model()
+    variables = model.init(seed=0)
+    registry = ModelRegistry()
+    entry = registry.register(
+        "dense", lambda v, x: model.output(v, x), variables,
+        input_spec=spec((16,)), mode="batched", max_batch_size=8,
+        devices=jax.devices()[:2], warm=True)
+    assert entry.warmed
+    x = np.random.default_rng(0).normal(size=(3, 16)).astype(np.float32)
+    got = np.asarray(entry.predict(x))
+    want = np.asarray(model.output(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert registry.get("dense").version == "v1"
+    with pytest.raises(ModelNotFoundError):
+        registry.get("nope")
+    registry.shutdown_all()
+
+
+def test_bucket_sizes_cover_max_batch():
+    assert bucket_sizes(32) == [1, 2, 4, 8, 16, 32]
+    assert bucket_sizes(24) == [1, 2, 4, 8, 16, 24]  # cap bucket kept
+    assert bucket_sizes(1) == [1]
+    assert bucket_sizes(64, mode="instant") == [1]
+
+
+def test_parse_inputs_validation():
+    registry = ModelRegistry()
+    entry = registry.register(
+        "d", _scale_forward, {"scale": 1.0},
+        input_spec={"a": spec((2,)), "b": spec((3,), np.int32)},
+        devices=jax.devices()[:1])
+    feats = entry.parse_inputs({"a": [[1.0, 2.0]], "b": [[1, 2, 3]]})
+    assert feats["a"].dtype == np.float32 and feats["b"].dtype == np.int32
+    with pytest.raises(BadRequestError):
+        entry.parse_inputs([[1.0, 2.0]])  # dict-spec model needs a dict
+    with pytest.raises(BadRequestError):
+        entry.parse_inputs({"a": [[1.0, 2.0]]})  # missing key
+    with pytest.raises(BadRequestError):
+        entry.parse_inputs({"a": [[1.0, 2.0]], "b": [[1, 2, 3]],
+                            "c": [[0]]})  # unknown key
+    with pytest.raises(BadRequestError):
+        entry.parse_inputs({"a": [[1.0, 2.0]] * 2,
+                            "b": [[1, 2, 3]]})  # batch mismatch
+    with pytest.raises(BadRequestError):
+        # oversized batch: outside the warmed buckets = a fresh compile
+        # per distinct row count — rejected, not served
+        entry.parse_inputs({"a": [[1.0, 2.0]] * 33,
+                            "b": [[1, 2, 3]] * 33})
+    registry.shutdown_all()
+
+
+def test_failed_deploy_is_atomic():
+    """A deploy whose warmup fails must leave no trace: the old version
+    keeps serving and no phantom entry lands in the history."""
+    registry = ModelRegistry()
+    registry.register("m", _scale_forward, {"scale": 1.0},
+                      input_spec=spec((4,)), version="v1",
+                      devices=jax.devices()[:1], max_batch_size=4, warm=True)
+    with pytest.raises(Exception):  # noqa: B017 - any compile/trace error
+        registry.deploy("m", {"scale": "not a number"}, version="v2")
+    entry = registry.get("m")
+    assert [v for v, _ in entry.history] == ["v1"]
+    assert entry.version == "v1"
+    out = np.asarray(entry.predict(np.zeros((2, 4), np.float32)))
+    assert np.all(out == 1.0), "old version must keep serving"
+    with pytest.raises(ServingError):
+        registry.rollback("m")  # v1 is all there is — nothing to pop
+    registry.shutdown_all()
+
+
+def test_rollback_requires_history():
+    registry = ModelRegistry()
+    registry.register("m", _scale_forward, {"scale": 1.0},
+                      input_spec=spec((4,)), devices=jax.devices()[:1])
+    with pytest.raises(ServingError):
+        registry.rollback("m")
+    registry.shutdown_all()
+
+
+def test_history_bounds_variables_and_rollback_depth():
+    """Only the previous version's variables stay resident (rollback
+    depth 1) — older entries keep the name, not GBs of weights — and a
+    shut-down entry sheds retryable 503s, not 500s."""
+    from deeplearning4j_tpu.serving import NotReadyError
+
+    registry = ModelRegistry()
+    registry.register("m", _scale_forward, {"scale": 1.0},
+                      input_spec=spec((4,)), devices=jax.devices()[:1],
+                      max_batch_size=4)
+    registry.deploy("m", {"scale": 2.0})  # v2
+    registry.deploy("m", {"scale": 3.0})  # v3
+    entry = registry.get("m")
+    assert [v for v, _ in entry.history] == ["v1", "v2", "v3"]
+    assert entry.history[0][1] is None, "v1's variables must be released"
+    assert registry.rollback("m") == "v2"
+    with pytest.raises(ServingError):
+        registry.rollback("m")  # v1's variables are gone — refuse loudly
+    registry.shutdown_all()
+    with pytest.raises(NotReadyError):
+        entry.predict(np.zeros((1, 4), np.float32))
+
+
+def test_register_from_checkpoint(tmp_path):
+    from deeplearning4j_tpu.serde.checkpoint import save_checkpoint
+    from deeplearning4j_tpu.train.trainer import Trainer
+
+    model = _dense_model()
+    trainer = Trainer(model)
+    ts = trainer.init_state()
+    ckpt_dir = save_checkpoint(tmp_path, ts, model=model)
+
+    registry = ModelRegistry()
+    entry = registry.register_from_checkpoint(
+        "dense", ckpt_dir, devices=jax.devices()[:1])
+    x = np.random.default_rng(1).normal(size=(2, 16)).astype(np.float32)
+    got = np.asarray(entry.predict(x))
+    want = np.asarray(model.output(trainer.variables(ts), x))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    registry.shutdown_all()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+
+
+def test_admission_cap_and_drain():
+    depths = []
+    ac = AdmissionController(max_in_flight=2, on_depth=depths.append)
+    t1, t2 = ac.admit(), ac.admit()
+    with pytest.raises(QueueFullError):
+        ac.admit()
+    t1.release()
+    t1.release()  # idempotent
+    assert ac.in_flight == 1
+    t3 = ac.admit()
+    t2.release(), t3.release()
+    assert ac.drain(timeout=1.0)
+    assert max(depths) == 2 and depths[-1] == 0
+    with pytest.raises(BadRequestError):
+        ac.timeout_s(-5)
+    with pytest.raises(BadRequestError):
+        ac.timeout_s("soon")
+    with pytest.raises(BadRequestError):
+        ac.timeout_s(float("nan"))  # valid JSON for Python's parser
+    with pytest.raises(BadRequestError):
+        ac.timeout_s(float("inf"))
+    assert ac.timeout_s(10_000_000) == ac.max_deadline_ms / 1000.0
+
+
+# ---------------------------------------------------------------------------
+# ModelServer over real HTTP
+
+
+def test_server_endpoints_metrics_and_errors():
+    server, registry = _scale_server()
+    with server:
+        client = ServingClient(server.url)
+        assert client.health() == {"status": "ok"}
+        assert client.ready()["ready"]
+        models = client.models()
+        assert [m["name"] for m in models] == ["scale"]
+        assert models[0]["warmed"]
+
+        x = np.zeros((3, 4), np.float32)
+        r = client.predict("scale", x)
+        assert r["version"] == "v1"
+        np.testing.assert_allclose(np.asarray(r["outputs"]),
+                                   np.ones((3, 1)))
+        with pytest.raises(ModelNotFoundError):
+            client.predict("nope", x)
+        with pytest.raises(BadRequestError):
+            client.predict("scale", "not numbers")
+        with pytest.raises(ServingError):
+            client._request("/no/such/route", {})
+
+        fams = parse_prometheus(client.metrics_text())
+        assert fams["serving_requests_total"]["type"] == "counter"
+        codes = {labels for (_, labels, _)
+                 in fams["serving_requests_total"]["samples"]}
+        assert any('code="200"' in c for c in codes)
+        assert any('code="404"' in c for c in codes)
+        for series in ("serving_request_latency_seconds",
+                       "serving_device_latency_seconds",
+                       "serving_batch_occupancy", "serving_queue_depth",
+                       "serving_model_ready"):
+            assert series in fams, f"missing family {series}"
+        # JSON twin agrees on the request count
+        twin = client.metrics_json()
+        names = {m["name"] for m in twin["metrics"]}
+        assert "serving_requests_total" in names
+    assert not server.readiness()["ready"]
+
+
+def test_readyz_flips_across_warmup_and_drain():
+    server, registry = _scale_server()
+    server.start(warm=False)  # registered but NOT warmed
+    try:
+        client = ServingClient(server.url)
+        body = client.ready()
+        assert body == {"ready": False, "draining": False,
+                        "models": {"scale": False}}
+        server.warm_all()
+        assert client.ready()["ready"]
+
+        # during drain: readyz flips false while HTTP still answers
+        _block_active_fn(registry.get("scale"), seconds=0.6)
+        results = []
+        t = threading.Thread(target=lambda: results.append(
+            client.predict("scale", np.zeros((1, 4), np.float32))))
+        t.start()
+        time.sleep(0.1)  # let the request get admitted
+        stopper = threading.Thread(target=server.stop)
+        stopper.start()
+        deadline = time.monotonic() + 2.0
+        saw_draining = False
+        while time.monotonic() < deadline and not saw_draining:
+            try:
+                body = client.ready()
+            except Exception:  # noqa: BLE001 - HTTP loop already stopped
+                break
+            saw_draining = body["draining"] and not body["ready"]
+        t.join(timeout=5)
+        stopper.join(timeout=10)
+        assert saw_draining, "readyz never reported draining"
+        assert results, "in-flight request was dropped by graceful drain"
+    finally:
+        server.stop()
+
+
+def test_deadline_exceeded_returns_structured_504():
+    server, registry = _scale_server()
+    with server:
+        _block_active_fn(registry.get("scale"), seconds=0.5)
+        client = ServingClient(server.url)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError) as ei:
+            client.predict("scale", np.zeros((1, 4), np.float32),
+                           deadline_ms=50)
+        assert time.monotonic() - t0 < 5.0
+        assert ei.value.code == "DEADLINE_EXCEEDED"
+        assert not ei.value.retryable
+        assert server.metrics.shed_total.value(
+            model="scale", reason="deadline") == 1
+
+
+def test_admission_shed_returns_structured_429():
+    server, registry = _scale_server(
+        admission=AdmissionController(max_in_flight=1))
+    with server:
+        _block_active_fn(registry.get("scale"), seconds=0.5)
+        client = ServingClient(server.url)
+        results = []
+        t = threading.Thread(target=lambda: results.append(
+            client.predict("scale", np.zeros((1, 4), np.float32))))
+        t.start()
+        time.sleep(0.1)  # first request holds the single admission slot
+        with pytest.raises(QueueFullError) as ei:
+            client.predict("scale", np.zeros((1, 4), np.float32))
+        assert ei.value.retryable
+        t.join(timeout=5)
+        assert results, "admitted request must still be served"
+        assert server.metrics.shed_total.value(
+            model="scale", reason="queue_full") == 1
+        fams = parse_prometheus(client.metrics_text())
+        sheds = fams["serving_shed_total"]["samples"]
+        assert any('reason="queue_full"' in labels for _, labels, _ in sheds)
+
+
+def _mixed_load(client, model, n_threads, per_thread, verify):
+    """Closed-loop concurrent clients with mixed batch sizes. Every
+    request must be answered correctly or fail with a typed retryable
+    backpressure error — anything else (hang, crash, silent drop) fails."""
+    ok, shed, broken = [], [], []
+
+    def run(tid):
+        rng = np.random.default_rng(tid)
+        for i in range(per_thread):
+            rows = 1 + (tid + i) % 5
+            x = rng.normal(size=(rows, 4)).astype(np.float32)
+            try:
+                r = client.predict(model, x, deadline_ms=30000)
+                verify(x, r)
+                ok.append(rows)
+            except (QueueFullError, DeadlineExceededError) as e:
+                shed.append(e)
+            except Exception as e:  # noqa: BLE001 - anything else = bug
+                broken.append(e)
+
+    threads = [threading.Thread(target=run, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "load thread hung"
+    assert not broken, f"non-backpressure failures: {broken[:3]}"
+    return ok, shed
+
+
+def test_concurrent_load_zero_dropped_requests():
+    server, registry = _scale_server()
+    with server:
+        client = ServingClient(server.url)
+
+        def verify(x, r):
+            np.testing.assert_allclose(
+                np.asarray(r["outputs"]), np.ones((x.shape[0], 1)))
+
+        ok, shed = _mixed_load(client, "scale", n_threads=8, per_thread=4,
+                               verify=verify)
+        total = len(ok) + len(shed)
+        assert total == 32, f"dropped without error: {32 - total}"
+        assert ok, "at least some requests must be served"
+        # accounting: every issued request shows up in requests_total
+        fams = parse_prometheus(client.metrics_text())
+        served = sum(v for name, labels, v
+                     in fams["serving_requests_total"]["samples"]
+                     if 'model="scale"' in labels)
+        assert served == total
+
+
+def test_hot_swap_under_traffic_no_torn_model():
+    server, registry = _scale_server()
+    with server:
+        client = ServingClient(server.url)
+        seen = set()
+
+        def verify(x, r):
+            out = np.asarray(r["outputs"])
+            assert out.shape == (x.shape[0], 1)
+            # a torn model would mix 1.0 and 2.0 rows inside one response
+            assert np.all(out == out[0, 0]), f"torn response: {out.ravel()}"
+            assert out[0, 0] in (1.0, 2.0)
+            expected = 1.0 if r["version"] == "v1" else 2.0
+            assert out[0, 0] == expected, \
+                f"version {r['version']} served value {out[0, 0]}"
+            seen.add(r["version"])
+
+        swap_done = threading.Event()
+
+        def swapper():
+            time.sleep(0.05)
+            registry.deploy("scale", {"scale": 2.0}, version="v2")
+            swap_done.set()
+
+        sw = threading.Thread(target=swapper)
+        sw.start()
+        ok, shed = _mixed_load(client, "scale", n_threads=8, per_thread=6,
+                               verify=verify)
+        sw.join(timeout=30)
+        assert swap_done.is_set()
+        assert len(ok) + len(shed) == 48
+        # after the swap settles every response is v2
+        r = client.predict("scale", np.zeros((2, 4), np.float32))
+        assert r["version"] == "v2"
+        assert np.all(np.asarray(r["outputs"]) == 2.0)
+        assert registry.rollback("scale") == "v1"
+        r = client.predict("scale", np.zeros((2, 4), np.float32))
+        assert np.all(np.asarray(r["outputs"]) == 1.0)
+
+
+@pytest.mark.slow
+def test_sustained_load_with_repeated_hot_swaps():
+    """Heavy tier-2 load test: sustained mixed-size traffic through
+    repeated warmed hot-swaps, then graceful drain. Invariants: zero
+    dropped-without-error requests, zero torn responses, drain serves
+    everything admitted."""
+    server, registry = _scale_server()
+    with server:
+        client = ServingClient(server.url)
+        stop = threading.Event()
+        versions = {"v1": 1.0, "v2": 2.0, "v3": 3.0, "v4": 4.0}
+
+        def verify(x, r):
+            out = np.asarray(r["outputs"])
+            assert np.all(out == out[0, 0])
+            assert out[0, 0] == versions[r["version"]]
+
+        def swapper():
+            i = 2
+            while not stop.is_set() and i <= 4:
+                time.sleep(0.2)
+                registry.deploy("scale", {"scale": float(i)},
+                                version=f"v{i}")
+                i += 1
+
+        sw = threading.Thread(target=swapper)
+        sw.start()
+        ok, shed = _mixed_load(client, "scale", n_threads=16, per_thread=12,
+                               verify=verify)
+        stop.set()
+        sw.join(timeout=30)
+        assert len(ok) + len(shed) == 16 * 12
+        assert len(ok) > 0
+    assert server.stop(), "graceful drain timed out"
