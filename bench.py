@@ -736,6 +736,133 @@ def bench_serving(peak, *, n_threads=8, requests_per_thread=40,
         server.stop()
 
 
+def bench_resilience(peak, *, sizes_mb=(1, 8, 64), repeats=3, epochs=2):
+    """Fault-tolerance benchmark (resilience/ + serde integrity):
+    verified-checkpoint save/verify/restore latency vs. snapshot size
+    (what the SHA-256 manifest + atomic tmp/replace write costs over a
+    bare ``np.savez``), and the wall-clock recovery overhead of a
+    training run that hits one injected poison batch — rollback to the
+    last verified checkpoint plus replay — against the same run fault
+    free. ``peak`` (chip FLOPs) is unused: the metrics are host-side IO
+    and recovery latency, not MFU.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.resilience import (
+        FaultInjector,
+        FaultTolerantTrainer,
+        RecoveryPolicy,
+        set_fault_injector,
+    )
+    from deeplearning4j_tpu.serde.checkpoint import (
+        load_state_tree,
+        save_state_tree,
+        verify_checkpoint,
+    )
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    tmp_root = tempfile.mkdtemp(prefix="bench_resilience_")
+    rows = []
+    try:
+        rng = np.random.default_rng(0)
+        for mb in sizes_mb:
+            per = max(1, int(mb * (1 << 20)) // (4 * 4))  # 4 float32 leaves
+            tree = {f"w{i}": rng.normal(size=(per,)).astype(np.float32)
+                    for i in range(4)}
+            d = os.path.join(tmp_root, f"snap_{mb}mb")
+            t_save, t_verify, t_restore = [], [], []
+            for _ in range(repeats):
+                shutil.rmtree(d, ignore_errors=True)
+                t0 = time.perf_counter()
+                save_state_tree(d, tree)
+                t_save.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                ok, why = verify_checkpoint(d, deep=True)
+                t_verify.append(time.perf_counter() - t0)
+                if not ok:
+                    raise RuntimeError(f"verify_checkpoint failed: {why}")
+                t0 = time.perf_counter()
+                load_state_tree(d, tree)
+                t_restore.append(time.perf_counter() - t0)
+            rows.append({
+                "size_mb": mb,
+                "save_ms": round(min(t_save) * 1e3, 2),
+                "verify_deep_ms": round(min(t_verify) * 1e3, 2),
+                "restore_ms": round(min(t_restore) * 1e3, 2),
+                "save_mb_per_s": round(mb / min(t_save), 1),
+            })
+
+        # recovery wall-clock: identical tiny-MLP fits, one with a poison
+        # batch injected mid-training (NaN loss → rollback to the last
+        # verified checkpoint → replay); a warmup fit populates the jit
+        # cache first so the delta is rollback+replay cost, not jit skew
+        def _mlp():
+            return SequentialModel(SequentialConfig(
+                net=NeuralNetConfiguration(updater=Sgd(0.05), seed=0),
+                layers=[Dense(units=32, activation="tanh"),
+                        OutputLayer(units=2, activation="softmax",
+                                    loss="mcxent")],
+                input_shape=(16,),
+            ))
+
+        def _data():
+            r = np.random.default_rng(0)
+            x = r.normal(size=(64, 16)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 0).astype(int)]
+            return ArrayDataSetIterator(x, y, batch_size=8, shuffle=False)
+
+        def _fit(tag, injector):
+            set_fault_injector(injector)
+            trainer = Trainer(_mlp())
+            ft = FaultTolerantTrainer(
+                trainer, os.path.join(tmp_root, tag),
+                policy=RecoveryPolicy(checkpoint_every=4, keep_last=3))
+            t0 = time.perf_counter()
+            ts = ft.fit(trainer.init_state(), _data(), epochs=epochs)
+            return (time.perf_counter() - t0,
+                    int(jax.device_get(ts.step)), ft.recoveries)
+
+        _fit("warmup", FaultInjector())
+        clean_wall, clean_steps, _ = _fit("clean", FaultInjector())
+        faulty_wall, faulty_steps, recoveries = _fit(
+            "faulty", FaultInjector().plan("train.step_nan", at=6))
+        rollbacks = sum(1 for r in recoveries if r["kind"] == "rollback")
+
+        info = {
+            "snapshots": rows,
+            "clean_fit_s": round(clean_wall, 3),
+            "faulty_fit_s": round(faulty_wall, 3),
+            "recovery_overhead_s": round(faulty_wall - clean_wall, 3),
+            "rollbacks": rollbacks,
+            "steps_clean": clean_steps,
+            "steps_faulty": faulty_steps,
+            # integrity gate: the faulted run recovered AND finished with
+            # the fault-free step count
+            "converged": bool(rollbacks >= 1
+                              and faulty_steps == clean_steps),
+            "unit": "MB/s verified save",
+        }
+        info["value"] = rows[-1]["save_mb_per_s"]
+        return info
+    finally:
+        # None = drop back to the env-built injector, so a DL4J_TPU_FAULTS
+        # plan armed for other configs in this process stays armed
+        set_fault_injector(None)
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
+
 _CONFIGS = {
     "bert": bench_bert,
     # Batch-size knee probe (no baseline row): how much of the remaining
@@ -762,6 +889,10 @@ _CONFIGS = {
     # End-to-end serving capacity through serving/ (HTTP + admission +
     # dynamic batching); first recorded round — no baseline row yet.
     "serving": bench_serving,
+    # Fault-tolerance path (resilience/ + serde integrity): verified
+    # checkpoint save/verify/restore latency vs. snapshot size + recovery
+    # wall-clock after an injected fault; first recorded round.
+    "resilience": bench_resilience,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -776,6 +907,9 @@ _CPU_INTEGRITY = {
     "gpt": dict(batch_size=2, seq_len=32, warmup=0, iters=3, tiny=True),
     # serving reports "converged" = all requests served-or-typed-shed
     "serving": dict(n_threads=4, requests_per_thread=6, max_batch=8),
+    # resilience reports "converged" = faulted run recovered to the
+    # fault-free step count
+    "resilience": dict(sizes_mb=(1,), repeats=1, epochs=1),
 }
 
 
@@ -833,7 +967,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs",
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
-                            "serving",
+                            "serving,resilience",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

@@ -14,6 +14,9 @@ from deeplearning4j_tpu.data.iterators import (
     ShardedDataSetIterator,
     TransformIterator,
 )
+# transient-IO retry wrapper (lives in resilience/, re-exported here so
+# data pipelines compose it like any other iterator wrapper)
+from deeplearning4j_tpu.resilience.retry import RetryingIterator, retrying
 from deeplearning4j_tpu.data.audio import (
     WavFileRecordReader,
     mel_filterbank,

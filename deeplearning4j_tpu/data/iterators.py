@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, as_batch_dict
+from deeplearning4j_tpu.resilience.faults import get_fault_injector
 
 
 class ArrayDataSetIterator:
@@ -31,26 +32,57 @@ class ArrayDataSetIterator:
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
-        self._rng = np.random.default_rng(seed)
+        self.seed = seed
         self._epoch = 0
+        self._in_pass = False
 
     def __len__(self):
         n = self.features.shape[0]
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator[DataSet]:
+        self._in_pass = True
         n = self.features.shape[0]
         idx = np.arange(n)
         if self.shuffle:
-            self._rng.shuffle(idx)
+            # permutation derived from (seed, epoch), not a stateful rng:
+            # an aborted pass (transient read failure) re-iterates with the
+            # SAME order, so resilience.retrying's fast-forward re-delivers
+            # the stream exactly; the epoch advances on a completed pass
+            # (below) or via reset()/set_epoch()
+            np.random.default_rng([self.seed, self._epoch]).shuffle(idx)
         end = n - (n % self.batch_size) if self.drop_last else n
+        inj = get_fault_injector()
         for i in range(0, end, self.batch_size):
+            if inj.enabled:
+                # "data.read" injection point: a transient storage failure
+                # surfaces exactly like a real reader's (wrap with
+                # resilience.retrying() to survive it)
+                inj.maybe_fail("data.read", exc=IOError,
+                               msg="injected transient read failure")
             sel = idx[i : i + self.batch_size]
             yield DataSet(self.features[sel], self.labels[sel])
         self._epoch += 1
+        self._in_pass = False
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def set_epoch(self, epoch: int):
+        """Pin the shuffle permutation to a logical epoch — recovery
+        resumes/rollbacks re-align the data order with a checkpointed
+        position (the permutation is a pure function of (seed, epoch))."""
+        self._epoch = int(epoch)
+        self._in_pass = False
 
     def reset(self):
-        pass  # fresh iterator each __iter__
+        # an abandoned pass (steps_per_epoch break, early stop) still
+        # counts as a finished epoch: the next pass must reshuffle, not
+        # replay the same permutation prefix forever
+        if self._in_pass:
+            self._epoch += 1
+            self._in_pass = False
 
 
 class AsyncDataSetIterator:

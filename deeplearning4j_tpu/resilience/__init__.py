@@ -1,0 +1,64 @@
+"""Fault-tolerance layer: fault injection, retry, auto-recovering training.
+
+Three pillars (ISSUE 2; SURVEY §5.3/§5.4):
+
+- ``faults``   — seeded deterministic :class:`FaultInjector` with named
+  injection points wired through data/train/serde/serving, configured via
+  ``DL4J_TPU_FAULTS`` so failure paths run in CI;
+- ``retry``    — :func:`retrying` data-iterator wrapper + shared
+  :func:`backoff_delays` (capped exponential, full jitter);
+- ``recovery`` — :class:`RecoveryPolicy` + :class:`FaultTolerantTrainer`
+  (rollback to the latest *verified* checkpoint on NaN/inf, bounded
+  retries, optional LR cut and poison-batch skip).
+
+Checkpoint integrity itself (SHA-256 manifests, atomic writes,
+``verify_checkpoint`` / ``latest_verified_checkpoint`` / quarantine)
+lives in ``serde/checkpoint.py`` — this package is the policy layer on
+top of it. Stdlib + numpy + jax only.
+"""
+
+from deeplearning4j_tpu.resilience.faults import (
+    POINT_CKPT_CORRUPT,
+    POINT_CKPT_WRITE_CRASH,
+    POINT_DATA_READ,
+    POINT_SERVING_ERROR,
+    POINT_SERVING_LATENCY,
+    POINT_STEP_NAN,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    get_fault_injector,
+    parse_fault_spec,
+    set_fault_injector,
+)
+from deeplearning4j_tpu.resilience.recovery import (
+    FaultTolerantTrainer,
+    NonFiniteLossError,
+    RecoveryPolicy,
+)
+from deeplearning4j_tpu.resilience.retry import (
+    RetryingIterator,
+    backoff_delays,
+    retrying,
+)
+
+__all__ = [
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "get_fault_injector",
+    "set_fault_injector",
+    "parse_fault_spec",
+    "POINT_DATA_READ",
+    "POINT_STEP_NAN",
+    "POINT_CKPT_WRITE_CRASH",
+    "POINT_CKPT_CORRUPT",
+    "POINT_SERVING_LATENCY",
+    "POINT_SERVING_ERROR",
+    "FaultTolerantTrainer",
+    "NonFiniteLossError",
+    "RecoveryPolicy",
+    "RetryingIterator",
+    "backoff_delays",
+    "retrying",
+]

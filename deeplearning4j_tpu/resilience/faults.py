@@ -1,0 +1,295 @@
+"""Deterministic fault injection (SURVEY §5.3: failure detection is only
+testable if failures are reproducible).
+
+The reference stack's resilience was proven by production incidents; here
+failure paths are first-class, CI-testable code: a seeded
+:class:`FaultInjector` fires at *named injection points* compiled into the
+hot paths (data read, train step, checkpoint write, serving request).
+Every hook is a no-op attribute check when no plan targets its point, so
+production runs pay one dict lookup per batch, not a conditional forest.
+
+Named injection points wired through the codebase:
+
+==========================  =====================================================
+``data.read``            ``ArrayDataSetIterator`` raises ``IOError`` before a
+                            batch (transient storage failure)
+``train.step_nan``          the batch's float features are replaced with NaN
+                            before the step (poison batch → non-finite loss)
+``checkpoint.write_crash``  raises (or SIGKILLs with ``mode="kill"``) between
+                            writing ``state.npz``'s tmp file and the atomic
+                            rename — the classic crash-mid-checkpoint window
+``checkpoint.corrupt``      truncates the *final* ``state.npz`` after a
+                            successful, indexed write (bit-rot / torn disk; the
+                            manifest must catch it on restore)
+``serving.latency``         sleeps ``arg`` seconds inside ``handle_predict``
+``serving.error``           ``handle_predict`` sheds with a retryable 429
+==========================  =====================================================
+
+Plans are deterministic: ``at=N`` fires on the N-th trigger of the point
+(1-based), ``prob=p`` draws from the injector's own seeded RNG. Wired
+through the environment config (``DL4J_TPU_FAULTS`` /
+``DL4J_TPU_FAULT_SEED``) so subprocess tests and CI enable faults without
+touching code::
+
+    DL4J_TPU_FAULTS="train.step_nan@8;checkpoint.corrupt@2"
+    DL4J_TPU_FAULTS="checkpoint.write_crash@3!kill"      # real SIGKILL
+    DL4J_TPU_FAULTS="serving.latency@1x5:0.25"           # 5 firings, 0.25 s
+
+Grammar per ``;``/``,``-separated entry:
+``point[@AT|%PROB][xTIMES][:ARG][!MODE]`` (default ``@1``, ``x1``,
+``!raise``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import re
+import signal
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Canonical injection point names (importable, greppable).
+POINT_DATA_READ = "data.read"
+POINT_STEP_NAN = "train.step_nan"
+POINT_CKPT_WRITE_CRASH = "checkpoint.write_crash"
+POINT_CKPT_CORRUPT = "checkpoint.corrupt"
+POINT_SERVING_LATENCY = "serving.latency"
+POINT_SERVING_ERROR = "serving.error"
+
+KNOWN_POINTS = (
+    POINT_DATA_READ,
+    POINT_STEP_NAN,
+    POINT_CKPT_WRITE_CRASH,
+    POINT_CKPT_CORRUPT,
+    POINT_SERVING_LATENCY,
+    POINT_SERVING_ERROR,
+)
+
+
+class InjectedFault(Exception):
+    """Raised by a fired injection point (never by production code paths)."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One planned firing schedule for one injection point.
+
+    ``at``: fire on the first trigger whose 1-based count reaches ``at``
+    (and the next ``times - 1`` matching triggers). ``prob``: fire each
+    trigger with this probability from the injector's seeded RNG instead.
+    ``arg`` carries a point-specific scalar (latency seconds, retry-after
+    seconds). ``mode``: ``"raise"`` or ``"kill"`` (process SIGKILL — real
+    crash-consistency testing, not an exception the caller could catch).
+    """
+
+    point: str
+    at: Optional[int] = 1
+    prob: float = 0.0
+    times: int = 1
+    arg: float = 0.0
+    mode: str = "raise"
+    fired: int = 0
+
+
+class FaultInjector:
+    """Seeded, deterministic fault injector.
+
+    Thread-safe: trigger counting and plan state are guarded by one lock
+    (checkpoint writes fire from the AsyncCheckpointer worker, serving
+    points from HTTP handler threads). ``log`` records every firing
+    ``{point, trigger, time}`` for assertions and post-mortems.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._plans: Dict[str, List[FaultPlan]] = {}
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.log: List[dict] = []
+
+    @property
+    def enabled(self) -> bool:
+        """True if any plan is installed — the hooks' fast-path gate."""
+        return bool(self._plans)
+
+    def plan(self, point: str, *, at: Optional[int] = None, prob: float = 0.0,
+             times: int = 1, arg: float = 0.0,
+             mode: str = "raise") -> "FaultInjector":
+        """Install a firing schedule; returns self for chaining."""
+        if at is None and not prob:
+            at = 1
+        if at is not None and at < 1:
+            raise ValueError(f"at must be >= 1 (1-based trigger), got {at}")
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {prob}")
+        if times < 1:
+            raise ValueError(f"times must be >= 1, got {times}")
+        if mode not in ("raise", "kill"):
+            raise ValueError(f"mode must be 'raise' or 'kill', got {mode!r}")
+        with self._lock:
+            self._plans.setdefault(point, []).append(
+                FaultPlan(point=point, at=at, prob=prob, times=times,
+                          arg=arg, mode=mode))
+        return self
+
+    def reset(self):
+        """Clear trigger counts, fired counters, the RNG, and the log —
+        plans stay installed (rerun the same deterministic schedule)."""
+        with self._lock:
+            self._counts.clear()
+            self.log.clear()
+            self._rng = random.Random(self.seed)
+            for plans in self._plans.values():
+                for p in plans:
+                    p.fired = 0
+
+    # -- core ----------------------------------------------------------------
+
+    def fire(self, point: str) -> Optional[FaultPlan]:
+        """Count one trigger of ``point``; return the plan that fires, or
+        None. Unplanned points return immediately without counting."""
+        if point not in self._plans:
+            return None
+        with self._lock:
+            count = self._counts.get(point, 0) + 1
+            self._counts[point] = count
+            for p in self._plans[point]:
+                if p.fired >= p.times:
+                    continue
+                if p.at is not None:
+                    hit = count >= p.at
+                else:
+                    hit = self._rng.random() < p.prob
+                if hit:
+                    p.fired += 1
+                    self.log.append({"point": point, "trigger": count,
+                                     "time": time.time()})
+                    return p
+        return None
+
+    def triggers(self, point: str) -> int:
+        with self._lock:
+            return self._counts.get(point, 0)
+
+    # -- hook helpers (what the wired code paths call) -----------------------
+
+    def maybe_fail(self, point: str, exc=InjectedFault,
+                   msg: Optional[str] = None) -> bool:
+        """Raise ``exc`` (or SIGKILL under ``mode='kill'``) if the point
+        fires; returns False otherwise."""
+        p = self.fire(point)
+        if p is None:
+            return False
+        if p.mode == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, by design
+        raise exc(msg or f"injected fault at '{point}' "
+                         f"(firing {p.fired}/{p.times})")
+
+    def maybe_sleep(self, point: str) -> bool:
+        """Sleep the fired plan's ``arg`` seconds (latency spike)."""
+        p = self.fire(point)
+        if p is not None and p.arg > 0:
+            time.sleep(p.arg)
+            return True
+        return p is not None
+
+    def maybe_poison_batch(self, batch):
+        """NaN-poison a batch dict's float ``features`` when
+        ``train.step_nan`` fires; otherwise return the batch untouched."""
+        if self.fire(POINT_STEP_NAN) is None:
+            return batch
+        import numpy as np
+
+        def nanify(v):
+            if isinstance(v, dict):
+                return {k: nanify(x) for k, x in v.items()}
+            arr = np.asarray(v)
+            if np.issubdtype(arr.dtype, np.floating):
+                return np.full_like(arr, np.nan)
+            return v
+
+        out = dict(batch)
+        if "features" in out:
+            out["features"] = nanify(out["features"])
+        return out
+
+
+# -- spec parsing + process-wide injector ------------------------------------
+
+_SPEC_RE = re.compile(
+    r"^(?P<point>[\w.]+)"
+    r"(?:@(?P<at>\d+)|%(?P<prob>[0-9.eE+-]+))?"
+    r"(?:x(?P<times>\d+))?"
+    r"(?::(?P<arg>[0-9.eE+-]+))?"
+    r"(?:!(?P<mode>\w+))?$")
+
+
+def parse_fault_spec(spec: str) -> List[dict]:
+    """``DL4J_TPU_FAULTS`` grammar → list of ``FaultInjector.plan`` kwargs.
+
+    ``point[@AT|%PROB][xTIMES][:ARG][!MODE]``, entries separated by ``;``
+    or ``,``. Raises ValueError with the offending entry on bad syntax.
+    """
+    plans = []
+    for entry in re.split(r"[;,]", spec):
+        entry = entry.strip()
+        if not entry:
+            continue
+        m = _SPEC_RE.match(entry)
+        if m is None:
+            raise ValueError(
+                f"bad fault spec entry {entry!r}; expected "
+                "point[@AT|%PROB][xTIMES][:ARG][!MODE]")
+        g = m.groupdict()
+        if g["point"] not in KNOWN_POINTS:
+            # a typo'd env spec would otherwise arm a point nothing ever
+            # fires, and the fault test it backs would pass vacuously
+            # (programmatic plan() stays open for custom points)
+            raise ValueError(
+                f"unknown injection point {g['point']!r}; known points: "
+                + ", ".join(KNOWN_POINTS))
+        plans.append({
+            "point": g["point"],
+            "at": int(g["at"]) if g["at"] else (None if g["prob"] else 1),
+            "prob": float(g["prob"]) if g["prob"] else 0.0,
+            "times": int(g["times"]) if g["times"] else 1,
+            "arg": float(g["arg"]) if g["arg"] else 0.0,
+            "mode": g["mode"] or "raise",
+        })
+    return plans
+
+
+_injector: Optional[FaultInjector] = None
+_injector_lock = threading.Lock()
+
+
+def get_fault_injector() -> FaultInjector:
+    """Process-wide injector, built on first use from the environment
+    config (``DL4J_TPU_FAULTS`` / ``DL4J_TPU_FAULT_SEED``). With no spec
+    it is empty (``enabled == False``) and every hook is a fast no-op."""
+    global _injector
+    if _injector is None:
+        with _injector_lock:
+            if _injector is None:
+                from deeplearning4j_tpu.runtime.environment import (
+                    get_environment,
+                )
+
+                env = get_environment()
+                inj = FaultInjector(seed=getattr(env, "fault_seed", 0))
+                spec = getattr(env, "fault_spec", "")
+                for kw in (parse_fault_spec(spec) if spec else []):
+                    inj.plan(**kw)
+                _injector = inj
+    return _injector
+
+
+def set_fault_injector(inj: Optional[FaultInjector]):
+    """Install (or with None, drop back to env-built) the process-wide
+    injector — tests swap in a programmatic schedule this way."""
+    global _injector
+    _injector = inj

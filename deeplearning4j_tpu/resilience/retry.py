@@ -1,0 +1,158 @@
+"""Transient-failure retry: backoff schedules + a retrying data-iterator
+wrapper.
+
+The reference's AsyncDataSetIterator dies on the first reader IOError and
+takes the fit loop with it; on preemptible fleets the dominant data-path
+failure is *transient* (NFS blip, object-store 5xx, a reader racing a
+rotating file). :func:`retrying` turns those into bounded, jittered
+retries, and :func:`backoff_delays` is the shared capped-exponential
+schedule (also used by ``ServingClient``'s 429/503 retry).
+
+Stdlib only; no jax imports — safe from any thread.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterable, Iterator, Optional, Tuple, Type
+
+
+def backoff_delays(*, base: float = 0.05, cap: float = 2.0,
+                   factor: float = 2.0, jitter: float = 0.5,
+                   rng: Optional[random.Random] = None) -> Iterator[float]:
+    """Infinite generator of capped exponential backoff delays.
+
+    ``jitter=j`` multiplies each delay by a uniform draw from
+    ``[1-j, 1+j]`` (full jitter decorrelates retry storms across workers);
+    the post-jitter delay is re-capped at ``cap``. Deterministic when
+    given a seeded ``rng``.
+    """
+    if not 0.0 <= jitter <= 1.0:
+        raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+    rng = rng if rng is not None else random.Random()
+    attempt = 0
+    while True:
+        # exponent clamp: factor ** 1024 is a float OverflowError, and the
+        # cap has long since bitten anyway
+        d = min(cap, base * factor ** min(attempt, 64))
+        if jitter:
+            d *= 1.0 + rng.uniform(-jitter, jitter)
+        yield max(0.0, min(cap, d))
+        attempt += 1
+
+
+class RetryingIterator:
+    """Iterator wrapper that survives transient read failures.
+
+    A failed Python generator cannot be resumed, so recovery re-creates
+    the base iterator and fast-forwards past the ``produced`` items the
+    consumer already received (items are re-read, not re-delivered —
+    the storage pays, the training loop sees an uninterrupted stream).
+    ``max_retries`` bounds *consecutive* failures; any successful item
+    resets the budget, so an iterator that fails once an hour never
+    exhausts it, while a hard-down source still errors out promptly.
+
+    The base must be a re-iterable that re-yields the same items on
+    re-iteration until a pass completes (``ArrayDataSetIterator`` does:
+    its shuffle order is derived from (seed, epoch), and epoch advances
+    only on a completed pass). Two failure shapes surface loudly instead
+    of corrupting the stream: a one-shot iterator/generator cannot be
+    re-created, so its first failure re-raises immediately; a base that
+    comes back *shorter* than what was already delivered (an exhausted
+    generator, a file rotated away) raises RuntimeError rather than
+    silently ending the epoch early.
+
+    Composes with the other wrappers: put ``retrying`` closest to the
+    storage (inside AsyncDataSetIterator, outside the raw reader) so a
+    retry re-reads one batch, not the prefetch queue.
+    """
+
+    def __init__(self, base: Iterable, *, max_retries: int = 5,
+                 retry_on: Tuple[Type[BaseException], ...] = (IOError, OSError),
+                 base_delay: float = 0.05, max_delay: float = 2.0,
+                 jitter: float = 0.5, seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        self.base = base
+        self.max_retries = max_retries
+        self.retry_on = retry_on
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.seed = seed
+        self.sleep = sleep
+        self.retry_log: list = []  # (produced, attempt, repr(error))
+
+    def __iter__(self):
+        produced = 0
+        attempts = 0
+        delays = None
+        one_shot = False
+        # pin the base's shuffle epoch (when it has one) so a retry
+        # re-iteration replays the SAME permutation it fast-forwards
+        epoch_pin = getattr(self.base, "epoch", None)
+        while True:
+            try:
+                if epoch_pin is not None and hasattr(self.base, "set_epoch"):
+                    self.base.set_epoch(epoch_pin)
+                it = iter(self.base)
+                one_shot = it is self.base
+                # fast-forward past items the consumer already has
+                for k in range(produced):
+                    try:
+                        next(it)
+                    except StopIteration:
+                        raise RuntimeError(
+                            f"base iterator yielded only {k} items on "
+                            f"re-iteration but {produced} were already "
+                            "delivered — one-shot generator or shrunken "
+                            "source; refusing to truncate the stream "
+                            "silently") from None
+                while True:
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        return
+                    produced += 1
+                    attempts = 0
+                    yield item
+            except self.retry_on as e:
+                attempts += 1
+                self.retry_log.append((produced, attempts, repr(e)))
+                if one_shot:
+                    # iter(base) returned base itself: the failed iterator
+                    # cannot be re-created, a retry would truncate
+                    raise
+                if attempts > self.max_retries:
+                    raise
+                if attempts == 1:
+                    # fresh failure streak: the schedule restarts at the
+                    # base delay — like the retry budget, it must not
+                    # remember transients recovered hours ago
+                    delays = backoff_delays(
+                        base=self.base_delay, cap=self.max_delay,
+                        jitter=self.jitter, rng=random.Random(self.seed))
+                self.sleep(next(delays))
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    @property
+    def epoch(self):
+        return getattr(self.base, "epoch", None)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.base, "set_epoch"):
+            self.base.set_epoch(epoch)
+
+    def __len__(self):
+        return len(self.base)  # type: ignore[arg-type]
+
+
+def retrying(base: Iterable, **kwargs) -> RetryingIterator:
+    """Wrap a dataset iterator with bounded exponential-backoff retry on
+    transient read failures (see :class:`RetryingIterator`)."""
+    return RetryingIterator(base, **kwargs)

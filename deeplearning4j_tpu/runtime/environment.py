@@ -51,6 +51,15 @@ class Environment:
     accum_dtype: str = dataclasses.field(
         default_factory=lambda: os.environ.get("DL4J_TPU_ACCUM_DTYPE", "float32")
     )
+    # Fault injection (resilience/faults.py): a plan spec like
+    # "train.step_nan@8;checkpoint.corrupt@2" arms named injection points
+    # deterministically — empty means every hook is a no-op.
+    fault_spec: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("DL4J_TPU_FAULTS", "")
+    )
+    fault_seed: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get("DL4J_TPU_FAULT_SEED", "0"))
+    )
 
     def jnp_param_dtype(self):
         return jnp.dtype(self.param_dtype)

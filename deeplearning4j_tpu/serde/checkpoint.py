@@ -16,17 +16,27 @@ save time — pass a sharding to ``restore_checkpoint`` and leaves are
 device_put to it (↔ SURVEY §5.4 'resharding on restore'). Multi-host async
 checkpointing can later swap this backend for orbax without changing
 callers.
+
+Integrity (resilience layer): every snapshot carries a ``manifest.json``
+with a per-array SHA-256 digest plus the whole-file digest/size of
+``state.npz``; all files land via tmp-sibling + ``os.replace`` so a crash
+at any point leaves either the previous complete state or tmp litter —
+never a truncated file at a final path. ``verify_checkpoint`` checks a
+directory against its manifest; ``latest_verified_checkpoint`` walks the
+rotation index newest→oldest past corrupt/truncated/missing entries
+(quarantining the bad ones) — the restore path recovery code uses.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 import threading
 import time
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -36,6 +46,8 @@ from deeplearning4j_tpu.utils.pytree import flatten_with_names
 from deeplearning4j_tpu.version import __version__
 
 _INDEX = "checkpoint_index.json"
+_MANIFEST = "manifest.json"
+_QUARANTINE_DIR = "quarantine"
 
 
 def _is_key_array(x) -> bool:
@@ -64,12 +76,80 @@ def _snapshot_tree(tree: Any):
     return arrays, key_paths, key_impls
 
 
+def _atomic_write_text(path: Path, text: str):
+    """tmp-sibling + os.replace: readers never observe a partial file."""
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
+def _array_sha256(a: np.ndarray) -> str:
+    """Content digest of one array (dtype + shape + raw bytes)."""
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(repr(tuple(a.shape)).encode())
+    h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _file_sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fault_injector():
+    """Active process-wide fault injector, or None (the common case)."""
+    from deeplearning4j_tpu.resilience.faults import get_fault_injector
+
+    inj = get_fault_injector()
+    return inj if inj.enabled else None
+
+
 def _write_snapshot(directory: str | Path, arrays: Dict[str, np.ndarray],
                     key_paths, key_impls, extra_meta: Optional[dict] = None):
-    """File-IO half of a save; safe to run off-thread (touches no jax)."""
+    """File-IO half of a save; safe to run off-thread (touches no jax).
+
+    Crash-consistent write order: (1) ``state.npz`` to a tmp sibling, then
+    ``os.replace`` — a SIGKILL mid-write leaves the previous complete file
+    (or tmp litter), never a truncated ``state.npz`` at the final path;
+    (2) ``manifest.json`` (per-array SHA-256 + whole-file digest of the
+    bytes just written); (3) ``meta.json`` last. The caller indexes only
+    after this returns, so an indexed checkpoint always has its manifest.
+    """
     d = Path(directory)
     d.mkdir(parents=True, exist_ok=True)
-    np.savez(d / "state.npz", **arrays)
+    inj = _fault_injector()
+    npz = d / "state.npz"
+    tmp = d / "state.npz.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        file_digest = _file_sha256(tmp)
+        file_size = tmp.stat().st_size
+        if inj is not None:
+            # the classic torn-write window: after the bytes, before the
+            # rename (mode="kill" SIGKILLs here for crash-consistency tests)
+            inj.maybe_fail("checkpoint.write_crash")
+        os.replace(tmp, npz)
+    finally:
+        tmp.unlink(missing_ok=True)
+    if inj is not None and inj.fire("checkpoint.corrupt") is not None:
+        # simulate bit-rot / out-of-band truncation of a checkpoint the
+        # index will point at — verify_checkpoint must catch it on restore
+        with open(npz, "r+b") as f:
+            f.truncate(max(file_size // 2, 1))
+    manifest = {
+        "state_npz": {"sha256": file_digest, "size": file_size},
+        "arrays": {
+            name: {"sha256": _array_sha256(a), "dtype": str(a.dtype),
+                   "shape": list(a.shape)}
+            for name, a in arrays.items()
+        },
+    }
+    _atomic_write_text(d / _MANIFEST, json.dumps(manifest, indent=2))
     meta = {
         "version": __version__,
         "time": time.time(),
@@ -79,7 +159,7 @@ def _write_snapshot(directory: str | Path, arrays: Dict[str, np.ndarray],
     }
     if extra_meta:
         meta.update(extra_meta)
-    (d / "meta.json").write_text(json.dumps(meta, indent=2))
+    _atomic_write_text(d / "meta.json", json.dumps(meta, indent=2))
 
 
 def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict] = None):
@@ -188,10 +268,16 @@ def _finalize_checkpoint(root: Path, name: str, step: int, tag: str,
     unsupported (single-writer-per-directory, matching orbax)."""
     ckpt_dir = root / name
     if config_json is not None:
-        (ckpt_dir / "config.json").write_text(config_json)
+        _atomic_write_text(ckpt_dir / "config.json", config_json)
     with _index_lock:
         idx_path = root / _INDEX
         index = json.loads(idx_path.read_text()) if idx_path.exists() else {"checkpoints": []}
+        # re-save of the same name (a rolled-back run repeating a step):
+        # the write replaced the directory contents, so the old entry is
+        # stale — drop it or rotation could rmtree a live checkpoint that
+        # a duplicate entry still references
+        index["checkpoints"] = [c for c in index["checkpoints"]
+                                if c.get("name") != name]
         index["checkpoints"].append({"name": name, "step": step, "tag": tag, "time": time.time()})
         if keep_last and len(index["checkpoints"]) > keep_last:
             for old in index["checkpoints"][:-keep_last]:
@@ -206,14 +292,19 @@ def _finalize_checkpoint(root: Path, name: str, step: int, tag: str,
 
 
 def save_checkpoint(directory: str | Path, train_state, *, model=None,
-                    tag: str = "", keep_last: int = 0):
+                    tag: str = "", keep_last: int = 0,
+                    extra_meta: Optional[dict] = None):
     """Full training checkpoint: state + model config + rotation index
-    (↔ CheckpointListener.keepLast + checkpoint.json)."""
+    (↔ CheckpointListener.keepLast + checkpoint.json). ``extra_meta``
+    merges into meta.json (recovery stores its epoch/batch position)."""
     root = Path(directory)
     root.mkdir(parents=True, exist_ok=True)
     step = int(jax.device_get(train_state.step))
     name = f"checkpoint_{step}" + (f"_{tag}" if tag else "")
-    save_state_tree(root / name, train_state, {"step": step, "tag": tag})
+    meta = {"step": step, "tag": tag}
+    if extra_meta:
+        meta.update(extra_meta)
+    save_state_tree(root / name, train_state, meta)
     return _finalize_checkpoint(
         root, name, step, tag, keep_last,
         _model_config_json(model) if model is not None else None)
@@ -244,7 +335,8 @@ class AsyncCheckpointer:
         self._inflight = None
 
     def save(self, directory: str | Path, train_state, *, model=None,
-             tag: str = "", keep_last: int = 0) -> str:
+             tag: str = "", keep_last: int = 0,
+             extra_meta: Optional[dict] = None) -> str:
         """Snapshot now, write in the background; returns the checkpoint
         path that WILL exist once the write completes."""
         self.wait_until_finished()
@@ -255,10 +347,12 @@ class AsyncCheckpointer:
         snapshot = _snapshot_tree(train_state)
         config_json = (_model_config_json(model) if model is not None
                        else None)
+        meta = {"step": step, "tag": tag}
+        if extra_meta:
+            meta.update(extra_meta)
 
         def _write():
-            _write_snapshot(root / name, *snapshot,
-                            extra_meta={"step": step, "tag": tag})
+            _write_snapshot(root / name, *snapshot, extra_meta=meta)
             _finalize_checkpoint(root, name, step, tag, keep_last,
                                  config_json)
 
@@ -284,14 +378,131 @@ class AsyncCheckpointer:
         self.close()
 
 
-def latest_checkpoint(directory: str | Path) -> Optional[str]:
+def _read_index_entries(directory: str | Path, *, strict: bool = True) -> list:
     idx_path = Path(directory) / _INDEX
     if not idx_path.exists():
+        return []
+    try:
+        return json.loads(idx_path.read_text()).get("checkpoints", [])
+    except Exception:  # noqa: BLE001 - out-of-band index corruption
+        if strict:
+            raise
+        return []
+
+
+def latest_checkpoint(directory: str | Path) -> Optional[str]:
+    """Newest indexed checkpoint whose directory still exists. Entries
+    whose directory was deleted out-of-band (operator cleanup, quarantine)
+    are skipped instead of handed to a restore that would crash."""
+    root = Path(directory)
+    for entry in reversed(_read_index_entries(root)):
+        d = root / str(entry.get("name", ""))
+        if d.is_dir():
+            return str(d)
+    return None
+
+
+def verify_checkpoint(ckpt_dir: str | Path, *,
+                      deep: bool = False) -> Tuple[bool, str]:
+    """Integrity-check one checkpoint directory against its manifest.
+
+    Returns ``(ok, reason)``. The default check compares ``state.npz``'s
+    size and whole-file SHA-256 to the manifest — any flipped or missing
+    byte fails it. ``deep=True`` additionally re-hashes every array
+    against the per-array digests (catches a manifest that matches the
+    file but disagrees with itself, and names the bad leaf). Checkpoints
+    written before manifests existed verify as ok with a "legacy" reason —
+    fallback must not quarantine every pre-upgrade checkpoint.
+    """
+    d = Path(ckpt_dir)
+    if not d.is_dir():
+        return False, "missing checkpoint directory"
+    npz = d / "state.npz"
+    if not npz.is_file():
+        return False, "missing state.npz"
+    try:
+        json.loads((d / "meta.json").read_text())
+    except FileNotFoundError:
+        return False, "missing meta.json"
+    except Exception as e:  # noqa: BLE001 - torn/garbled json
+        return False, f"unreadable meta.json: {e}"
+    man_path = d / _MANIFEST
+    if not man_path.is_file():
+        return True, "legacy checkpoint (no manifest); integrity unverified"
+    try:
+        manifest = json.loads(man_path.read_text())
+    except Exception as e:  # noqa: BLE001
+        return False, f"unreadable manifest.json: {e}"
+    want = manifest.get("state_npz", {})
+    size = npz.stat().st_size
+    if want.get("size") is not None and size != want["size"]:
+        return False, (f"state.npz size {size} != manifest {want['size']} "
+                       "(truncated write?)")
+    if want.get("sha256") and _file_sha256(npz) != want["sha256"]:
+        return False, "state.npz sha256 mismatch (corrupt bytes)"
+    if deep:
+        arrays_man = manifest.get("arrays", {})
+        try:
+            with np.load(npz) as z:
+                if set(z.files) != set(arrays_man):
+                    return False, "leaf set differs from manifest"
+                for name, rec in arrays_man.items():
+                    if _array_sha256(z[name]) != rec.get("sha256"):
+                        return False, f"array '{name}' sha256 mismatch"
+        except Exception as e:  # noqa: BLE001 - undecodable zip
+            return False, f"unreadable state.npz: {e}"
+    return True, "ok"
+
+
+def quarantine_checkpoint(ckpt_dir: str | Path,
+                          reason: str = "") -> Optional[str]:
+    """Move a corrupt checkpoint into ``<root>/quarantine/`` (same-fs
+    atomic rename) instead of deleting evidence; returns the new path or
+    None if the move failed. A QUARANTINE.txt records why."""
+    d = Path(ckpt_dir)
+    qdir = d.parent / _QUARANTINE_DIR
+    qdir.mkdir(parents=True, exist_ok=True)
+    target = qdir / d.name
+    n = 0
+    while target.exists():
+        n += 1
+        target = qdir / f"{d.name}.{n}"
+    try:
+        os.replace(d, target)
+    except OSError:
         return None
-    index = json.loads(idx_path.read_text())
-    if not index["checkpoints"]:
+    try:
+        (target / "QUARANTINE.txt").write_text(
+            f"quarantined {time.time()}: {reason}\n")
+    except OSError:
+        pass
+    return str(target)
+
+
+def latest_verified_checkpoint(directory: str | Path, *,
+                               quarantine: bool = True,
+                               deep: bool = False) -> Optional[str]:
+    """The restore path recovery trusts: walk the rotation index newest →
+    oldest and return the first checkpoint that passes
+    :func:`verify_checkpoint`. Missing directories are skipped; corrupt
+    ones are quarantined (moved aside so the next walk doesn't re-hash
+    them and operators can post-mortem). Never raises on bad on-disk
+    state — an unreadable index just means no verified checkpoint."""
+    root = Path(directory)
+    try:
+        entries = _read_index_entries(root, strict=False)
+    except Exception:  # noqa: BLE001 - unreachable, strict=False absorbs
         return None
-    return str(Path(directory) / index["checkpoints"][-1]["name"])
+    for entry in reversed(entries):
+        d = root / str(entry.get("name", ""))
+        if not d.is_dir():
+            continue
+        ok, why = verify_checkpoint(d, deep=deep)
+        if ok:
+            return str(d)
+        if quarantine:
+            quarantine_checkpoint(d, reason=why)
+    return None
 
 
 def restore_checkpoint(ckpt_dir: str | Path, train_state_template,

@@ -49,12 +49,17 @@ class AdmissionController:
         default_deadline_ms: float = 30000.0,
         max_deadline_ms: float = 300000.0,
         on_depth: Optional[Callable[[int], None]] = None,
+        retry_after_ms: float = 50.0,
     ):
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1")
         self.max_in_flight = max_in_flight
         self.default_deadline_ms = default_deadline_ms
         self.max_deadline_ms = max_deadline_ms
+        # backoff hint attached to QueueFullError sheds (→ the error body's
+        # retry_after_ms + the HTTP Retry-After header); roughly one batch
+        # service time — long enough to drain, short enough not to idle
+        self.retry_after_ms = retry_after_ms
         self._on_depth = on_depth
         self._cv = threading.Condition()
         self._in_flight = 0
@@ -69,7 +74,8 @@ class AdmissionController:
         with self._cv:
             if self._in_flight >= self.max_in_flight:
                 raise QueueFullError(
-                    f"admission cap reached ({self.max_in_flight} in flight)")
+                    f"admission cap reached ({self.max_in_flight} in flight)",
+                    retry_after_ms=self.retry_after_ms)
             self._in_flight += 1
             # report under the lock: out-of-order depth publications would
             # leave the gauge stale (e.g. nonzero forever while idle)

@@ -3,18 +3,35 @@
 Raises the same typed exceptions the server sheds with: a 429 comes
 back as :class:`QueueFullError`, a 504 as :class:`DeadlineExceededError`
 — callers write one retry policy for in-process and over-the-wire use.
+
+Retry (off by default): ``max_retries > 0`` re-sends requests that shed
+with a *retryable* error (429 admission-cap / 503 draining) after a
+capped, jittered exponential backoff, honoring the server's
+``Retry-After`` hint (the precise ``retry_after_ms`` from the error
+body, or the integer-seconds header) when it asks for a longer wait
+than the local schedule. Non-retryable failures (400/404/504/500)
+always surface immediately — a deadline that expired server-side
+would only expire again.
 """
 
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.serving.errors import ServingError, error_from_code
+from deeplearning4j_tpu.resilience.retry import backoff_delays
+from deeplearning4j_tpu.serving.errors import (
+    NotReadyError,
+    QueueFullError,
+    ServingError,
+    error_from_code,
+)
 
 
 def _jsonable(value):
@@ -28,11 +45,23 @@ def _jsonable(value):
 
 
 class ServingClient:
-    def __init__(self, base_url: str, *, timeout: float = 60.0):
+    def __init__(self, base_url: str, *, timeout: float = 60.0,
+                 max_retries: int = 0, backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0, backoff_jitter: float = 0.5,
+                 retry_seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.backoff_jitter = backoff_jitter
+        self._rng = random.Random(retry_seed)
+        self._sleep = sleep
 
-    def _request(self, path: str, payload: Optional[dict] = None) -> dict:
+    def _request_once(self, path: str, payload: Optional[dict] = None) -> dict:
         data = json.dumps(payload).encode() if payload is not None else None
         req = urllib.request.Request(
             self.base_url + path, data=data,
@@ -41,13 +70,54 @@ class ServingClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return json.loads(r.read())
         except urllib.error.HTTPError as e:
+            retry_after_ms = None
+            header = e.headers.get("Retry-After") if e.headers else None
+            if header:
+                try:
+                    retry_after_ms = float(header) * 1000.0
+                except ValueError:
+                    pass  # HTTP-date form: ignore, body may still carry ms
             try:
                 body = json.loads(e.read())
             except Exception:  # noqa: BLE001 - non-JSON error body
-                raise ServingError(f"HTTP {e.code}") from e
+                # a proxy/LB shedding with a plain-text 429/503 must still
+                # map to the retryable typed error, or the retry loop
+                # silently does nothing in exactly the proxied deployment
+                cls = {429: QueueFullError, 503: NotReadyError}.get(
+                    e.code, ServingError)
+                raise cls(
+                    f"HTTP {e.code}", retry_after_ms=retry_after_ms) from e
             err = body.get("error", {})
+            if err.get("retry_after_ms") is not None:
+                retry_after_ms = err["retry_after_ms"]  # body ms is precise
             raise error_from_code(err.get("code", "INTERNAL"),
-                                  err.get("message", f"HTTP {e.code}")) from e
+                                  err.get("message", f"HTTP {e.code}"),
+                                  retry_after_ms=retry_after_ms) from e
+
+    def _request(self, path: str, payload: Optional[dict] = None) -> dict:
+        """One request with the retry policy applied (a no-op loop at the
+        default ``max_retries=0``)."""
+        attempt = 0
+        delays = None
+        while True:
+            try:
+                return self._request_once(path, payload)
+            except ServingError as err:
+                if not getattr(err, "retryable", False) \
+                        or attempt >= self.max_retries:
+                    raise
+                if delays is None:
+                    delays = backoff_delays(
+                        base=self.backoff_base_s, cap=self.backoff_max_s,
+                        jitter=self.backoff_jitter, rng=self._rng)
+                delay = next(delays)
+                ra = getattr(err, "retry_after_ms", None)
+                if ra:
+                    # the server's hint is authoritative: wait at least
+                    # that long even when it exceeds the local cap
+                    delay = max(delay, float(ra) / 1000.0)
+                self._sleep(delay)
+                attempt += 1
 
     # -- API ------------------------------------------------------------------
 

@@ -16,11 +16,21 @@ _BY_CODE: Dict[str, Type["ServingError"]] = {}
 
 
 class ServingError(RuntimeError):
-    """Base class; subclasses fix ``code``/``http_status``/``retryable``."""
+    """Base class; subclasses fix ``code``/``http_status``/``retryable``.
+
+    ``retry_after_ms``: optional server backoff hint for retryable sheds
+    (the AdmissionController attaches one) — rendered into the error body
+    and surfaced as an HTTP ``Retry-After`` header; the client's retry
+    loop honors it over its own exponential schedule.
+    """
 
     code = "INTERNAL"
     http_status = 500
     retryable = False
+
+    def __init__(self, *args, retry_after_ms=None):
+        super().__init__(*args)
+        self.retry_after_ms = retry_after_ms
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
@@ -31,8 +41,11 @@ class ServingError(RuntimeError):
         return str(self)
 
     def to_json(self) -> dict:
-        return {"error": {"code": self.code, "message": self.message,
-                          "retryable": self.retryable}}
+        err = {"code": self.code, "message": self.message,
+               "retryable": self.retryable}
+        if self.retry_after_ms is not None:
+            err["retry_after_ms"] = self.retry_after_ms
+        return {"error": err}
 
 
 class BadRequestError(ServingError):
@@ -72,7 +85,8 @@ class DeadlineExceededError(ServingError):
     http_status = 504
 
 
-def error_from_code(code: str, message: str = "") -> ServingError:
+def error_from_code(code: str, message: str = "",
+                    retry_after_ms=None) -> ServingError:
     """Rebuild the typed exception from a wire ``code`` (client side)."""
     cls = _BY_CODE.get(code, ServingError)
-    return cls(message)
+    return cls(message, retry_after_ms=retry_after_ms)

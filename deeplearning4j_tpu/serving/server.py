@@ -34,6 +34,7 @@ import jax
 import numpy as np
 
 from deeplearning4j_tpu.parallel.inference import InferenceQueueFull
+from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector
 from deeplearning4j_tpu.serving.admission import AdmissionController
 from deeplearning4j_tpu.serving.errors import (
     BadRequestError,
@@ -89,12 +90,19 @@ class ModelServer:
             def log_message(self, *a):  # noqa: N802 - stdlib API
                 pass
 
-            def _send(self, status: int, body, content_type="application/json"):
+            def _send(self, status: int, body, content_type="application/json",
+                      retry_after_ms=None):
                 raw = (body if isinstance(body, bytes)
                        else json.dumps(body).encode())
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(raw)))
+                if retry_after_ms is not None:
+                    # HTTP Retry-After is integer seconds; the precise ms
+                    # hint rides in the error body's retry_after_ms
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, -(-int(retry_after_ms) // 1000))))
                 self.end_headers()
                 self.wfile.write(raw)
 
@@ -132,7 +140,9 @@ class ModelServer:
                         f"invalid JSON body: {e}").to_json())
                     return
                 status, body = server.handle_predict(m.group(1), payload)
-                self._send(status, body)
+                retry_after = (body.get("error", {}).get("retry_after_ms")
+                               if isinstance(body, dict) else None)
+                self._send(status, body, retry_after_ms=retry_after)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
 
@@ -165,6 +175,18 @@ class ModelServer:
         # them would grow a permanent label set per scanned/typo'd URL.
         metric_model = name
         try:
+            inj = _fault_injector()
+            if inj.enabled:
+                # resilience injection points: "serving.latency" (sleep
+                # arg seconds) and "serving.error" (retryable 429 shed) —
+                # deterministic overload/latency spikes for client-retry
+                # and SLO tests, armed via DL4J_TPU_FAULTS
+                inj.maybe_sleep("serving.latency")
+                p = inj.fire("serving.error")
+                if p is not None:
+                    raise QueueFullError(
+                        "injected overload (fault injection)",
+                        retry_after_ms=(p.arg * 1000.0) if p.arg else None)
             entry = self.registry.get(name)
             if self._draining or not self._started:
                 raise NotReadyError("server is draining" if self._draining

@@ -50,14 +50,19 @@ class PreemptionCheckpointer(TrainingListener):
     # -- resume ------------------------------------------------------------
 
     def resume(self, trainer, ts):
-        """Restore the latest checkpoint in ``directory`` into ``ts``
-        (template) if one exists; otherwise return ``ts`` unchanged."""
+        """Restore the latest *verified* checkpoint in ``directory`` into
+        ``ts`` (template) if one exists; otherwise return ``ts`` unchanged.
+
+        A relaunch after preemption is exactly when a truncated final
+        write is most likely, so the restore walks the rotation index
+        past corrupt/missing entries (quarantining bad ones) instead of
+        crashing on the newest (serde.latest_verified_checkpoint)."""
         from deeplearning4j_tpu.serde.checkpoint import (
-            latest_checkpoint,
+            latest_verified_checkpoint,
             restore_checkpoint,
         )
 
-        latest = latest_checkpoint(self.directory)
+        latest = latest_verified_checkpoint(self.directory)
         if latest is None:
             return ts
         return restore_checkpoint(latest, ts)

@@ -603,6 +603,11 @@ class Trainer:
                 n = 0
                 for batch in it:
                     batch = _as_batch_dict(batch)
+                    if _fault_injector().enabled:
+                        # "train.step_nan" poison-batch injection point
+                        # (resilience/faults.py); no-op attribute check
+                        # unless DL4J_TPU_FAULTS armed a plan
+                        batch = _fault_injector().maybe_poison_batch(batch)
                     if self._batch_sharding is not None:
                         batch = jax.device_put(batch, self._batch_sharding)
                     if getattr(self.net, "backprop_type", "standard") == "tbptt":
@@ -636,3 +641,4 @@ class Trainer:
 
 
 from deeplearning4j_tpu.data.dataset import as_batch_dict as _as_batch_dict  # noqa: E402
+from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector  # noqa: E402
