@@ -863,6 +863,122 @@ def bench_resilience(peak, *, sizes_mb=(1, 8, 64), repeats=3, epochs=2):
         shutil.rmtree(tmp_root, ignore_errors=True)
 
 
+def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
+                        span_n=5000, series=1000):
+    """Telemetry-layer self-cost benchmark (observability/): the cost of
+    the instrumentation itself, so the layer that watches regressions
+    cannot silently become one. Three numbers:
+
+    - instrumented vs BARE ``Trainer.fit`` step time (the global
+      ``set_enabled``/``set_tracing_enabled`` switches toggle the same
+      code path the production loop runs) — min-of-3 windows each,
+      interleaved, to shed host jitter. The probe MLP is sized so the
+      step sits in the low-ms class of the real configs (lenet b256 ≈
+      1 ms, bert ≈ 24 ms): the per-step instrument cost is ~10 µs of
+      host work, so the honest denominators are ms-scale steps; the
+      absolute cost is reported too (``overhead_us_per_step``) so
+      sub-ms-step models can budget it;
+    - span enter/exit cost (``with span(...)``) in µs;
+    - registry render latency with ``series`` live counter series plus a
+      populated histogram (the /metrics scrape cost at 1k-series scale).
+
+    ``peak`` (chip FLOPs) is unused: the metric is host-side overhead.
+    """
+    import numpy as np
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.observability import metrics as om
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.observability.trace import (
+        set_tracing_enabled,
+        span,
+    )
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    import jax
+
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(updater=Sgd(0.01), seed=0),
+        layers=[Dense(units=hidden, activation="tanh"),
+                OutputLayer(units=2, activation="softmax", loss="mcxent")],
+        input_shape=(32,),
+    ))
+    trainer = Trainer(model)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch_size * steps, 32)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, batch_size * steps)]
+    data = ArrayDataSetIterator(x, y, batch_size=batch_size, shuffle=False)
+
+    def timed_fit(instrumented: bool) -> float:
+        om.set_enabled(instrumented)
+        set_tracing_enabled(instrumented)
+        ts = trainer.init_state()
+        t0 = time.perf_counter()
+        ts = trainer.fit(ts, data, epochs=1)
+        # forced host materialization: the window must include the work
+        leaf = jax.tree_util.tree_leaves(ts.params)[0]
+        float(jax.device_get(leaf.ravel()[0]))
+        return time.perf_counter() - t0
+
+    try:
+        timed_fit(True)  # compile + warm the jit cache outside any window
+        bare, instr = [], []
+        for _ in range(3):  # interleaved min-of-3: host jitter sheds
+            bare.append(timed_fit(False))
+            instr.append(timed_fit(True))
+        bare_s, instr_s = min(bare), min(instr)
+        overhead_pct = (instr_s - bare_s) / bare_s * 100.0
+
+        set_tracing_enabled(True)
+        t0 = time.perf_counter()
+        for _ in range(span_n):
+            with span("bench.span"):
+                pass
+        span_us = (time.perf_counter() - t0) / span_n * 1e6
+
+        reg = MetricsRegistry()
+        c = reg.counter("bench_series_total", "render-latency probe",
+                        ("idx",))
+        for i in range(series):
+            c.inc(idx=str(i))
+        h = reg.histogram("bench_latency_seconds", "render-latency probe")
+        for i in range(256):
+            h.observe(i * 1e-4)
+        t_render = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            text = reg.render_text()
+            t_render.append(time.perf_counter() - t0)
+
+        info = {
+            "steps": steps, "batch": batch_size,
+            "bare_step_ms": round(bare_s / steps * 1e3, 4),
+            "instrumented_step_ms": round(instr_s / steps * 1e3, 4),
+            "overhead_pct": round(overhead_pct, 2),
+            "overhead_us_per_step": round(
+                (instr_s - bare_s) / steps * 1e6, 2),
+            "span_enter_exit_us": round(span_us, 2),
+            "render_series": series,
+            "render_ms": round(min(t_render) * 1e3, 3),
+            "render_bytes": len(text),
+            # integrity gate: the telemetry layer's own cost stays < 5%
+            "converged": bool(overhead_pct < 5.0),
+            "unit": "% instrumented step-time overhead",
+        }
+        info["value"] = round(max(overhead_pct, 0.0), 3)
+        return info
+    finally:
+        om.set_enabled(True)
+        set_tracing_enabled(True)
+
+
 _CONFIGS = {
     "bert": bench_bert,
     # Batch-size knee probe (no baseline row): how much of the remaining
@@ -893,6 +1009,9 @@ _CONFIGS = {
     # checkpoint save/verify/restore latency vs. snapshot size + recovery
     # wall-clock after an injected fault; first recorded round.
     "resilience": bench_resilience,
+    # Telemetry self-cost (observability/): instrumented-vs-bare step
+    # time, span enter/exit cost, registry render latency at 1k series.
+    "observability": bench_observability,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -910,6 +1029,9 @@ _CPU_INTEGRITY = {
     # resilience reports "converged" = faulted run recovered to the
     # fault-free step count
     "resilience": dict(sizes_mb=(1,), repeats=1, epochs=1),
+    # observability reports "converged" = instrumentation overhead < 5%
+    "observability": dict(steps=24, batch_size=128, hidden=512,
+                          span_n=500, series=128),
 }
 
 
@@ -967,7 +1089,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs",
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
-                            "serving,resilience",
+                            "serving,resilience,observability",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

@@ -1,0 +1,100 @@
+"""Unified telemetry core (↔ the reference's StatsListener / UIServer /
+ProfilingListener family as ONE spine instead of per-layer silos).
+
+- ``metrics``: Counter/Gauge/Histogram on a process-global default
+  registry with Prometheus text + JSON exposition; per-layer bundles
+  (training, resilience, checkpoint) register lazily so one scrape of a
+  running ``ModelServer`` tells the whole story — serving AND training
+  AND recovery AND runtime series.
+- ``trace``: nested spans with correlation IDs propagated from
+  ``ServingClient`` request headers through admission, batch assembly,
+  and ``ParallelInference`` dispatch; exported as JSONL and Chrome-trace
+  JSON, loadable in Perfetto alongside the XLA traces.
+- ``runtime``: device-memory / live-array gauges, XLA recompile events
+  (count + wall time via jax.monitoring), host↔device transfer counters.
+
+``metrics.set_enabled(False)`` / ``trace.set_tracing_enabled(False)``
+turn the hot-path instrumentation off; ``bench.py observability``
+measures its cost (instrumented vs bare step time, span enter/exit,
+registry render latency).
+"""
+
+from deeplearning4j_tpu.observability.metrics import (
+    COMPILE_BUCKETS,
+    DEFAULT_LATENCY_BUCKETS,
+    OCCUPANCY_BUCKETS,
+    CheckpointMetrics,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    ResilienceMetrics,
+    TrainingMetrics,
+    default_registry,
+    enabled,
+    get_checkpoint_metrics,
+    get_resilience_metrics,
+    get_training_metrics,
+    render_json_multi,
+    render_text_multi,
+    reset_default_registry,
+    set_enabled,
+)
+from deeplearning4j_tpu.observability.runtime import (
+    RuntimeCollector,
+    get_runtime_collector,
+    record_transfer,
+)
+from deeplearning4j_tpu.observability.trace import (
+    Span,
+    Tracer,
+    current_span,
+    from_chrome_trace,
+    get_tracer,
+    load_jsonl,
+    new_id,
+    record_span,
+    set_tracing_enabled,
+    span,
+    to_chrome_trace,
+    tracing_enabled,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "COMPILE_BUCKETS",
+    "DEFAULT_LATENCY_BUCKETS",
+    "OCCUPANCY_BUCKETS",
+    "CheckpointMetrics",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ResilienceMetrics",
+    "RuntimeCollector",
+    "Span",
+    "Tracer",
+    "TrainingMetrics",
+    "current_span",
+    "default_registry",
+    "enabled",
+    "from_chrome_trace",
+    "get_checkpoint_metrics",
+    "get_resilience_metrics",
+    "get_runtime_collector",
+    "get_tracer",
+    "get_training_metrics",
+    "load_jsonl",
+    "new_id",
+    "record_span",
+    "record_transfer",
+    "render_json_multi",
+    "render_text_multi",
+    "reset_default_registry",
+    "set_enabled",
+    "set_tracing_enabled",
+    "span",
+    "to_chrome_trace",
+    "tracing_enabled",
+    "write_chrome_trace",
+]

@@ -1,0 +1,446 @@
+"""Shared metrics core: Counter / Gauge / Histogram on one registry.
+
+Promoted out of ``serving/metrics.py`` (which remains a thin re-export)
+so every layer — serving, train, resilience, serde, data, runtime
+collectors — feeds ONE process-global default registry and a single
+scrape tells the whole story (↔ the reference's StatsListener/UIServer
+family, where one StatsStorage held every module's series).
+
+Exposition semantics follow the Prometheus text format scrapers expect:
+``# HELP``/``# TYPE`` headers (HELP text escaped per the format:
+backslash and newline), cumulative ``_bucket{le=...}`` series,
+``_sum``/``_count``. A JSON twin serves scripts and tests.
+
+Registration is strict: a second instrument under an already-reserved
+name — including a histogram's derived ``_bucket``/``_sum``/``_count``
+sample names — raises with a clear error naming the prior owner, so two
+subsystems can never silently interleave samples in one family.
+
+Thread-safety: every mutation takes the instrument's lock — serving
+handlers, ParallelInference workers, checkpoint writer threads, and the
+training loop all write concurrently.
+
+``set_enabled(False)`` is the kill switch the instrumented hot paths
+consult (Trainer.fit, recovery, checkpoint, inference): recording
+becomes a no-op so ``bench.py observability`` can measure the
+instrumentation's own cost against a bare run.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_INF = float("inf")
+
+# Latency buckets spanning sub-ms host overhead to multi-second cold paths.
+DEFAULT_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                           0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# rows/bucket of a dispatched device batch — 1.0 means no padding waste.
+OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+# XLA compiles: tens of ms (cache hit) to minutes (cold BERT via a relay).
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                   60.0, 120.0)
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    # NaN/±Inf are legal Prometheus sample values; crashing on them here
+    # would poison EVERY future scrape of the registry over one bad
+    # observation (f == int(f) raises on non-finite floats).
+    if f != f:
+        return "NaN"
+    if f == _INF:
+        return "+Inf"
+    if f == -_INF:
+        return "-Inf"
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _esc_label(v) -> str:
+    """Label-value escaping: backslash, double-quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _esc_help(v) -> str:
+    """HELP-text escaping per the exposition format: backslash and
+    newline only (quotes are legal in help text)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        if not _METRIC_NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_NAME_RE.match(ln) or ln.startswith("__"):
+                raise ValueError(f"invalid label name {ln!r} on {name!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple[str, ...], object] = {}
+
+    def sample_names(self) -> Tuple[str, ...]:
+        """Every exposition sample-line name this instrument owns — the
+        registry reserves all of them to reject cross-family collisions."""
+        return (self.name,)
+
+    def _key(self, labels: dict) -> Tuple[str, ...]:
+        if not labels and not self.labelnames:
+            return ()  # fast path: label-less hot-loop instruments
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
+        parts = [f'{k}="{_esc_label(v)}"'
+                 for k, v in zip(self.labelnames, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._data[key] = self._data.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._data.get(self._key(labels), 0.0))
+
+    def render(self) -> List[str]:
+        with self._lock:
+            return [f"{self.name}{self._label_str(k)} {_fmt(v)}"
+                    for k, v in sorted(self._data.items())]
+
+    def to_json(self) -> dict:
+        with self._lock:
+            samples = [{"labels": dict(zip(self.labelnames, k)), "value": v}
+                       for k, v in sorted(self._data.items())]
+        return {"name": self.name, "type": self.kind, "help": self.help,
+                "samples": samples}
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._data[key] = float(value)
+
+    def dec(self, amount: float = 1.0, **labels):
+        self.inc(-amount, **labels)
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets)) + (_INF,)
+
+    def sample_names(self) -> Tuple[str, ...]:
+        return (self.name, f"{self.name}_bucket", f"{self.name}_sum",
+                f"{self.name}_count")
+
+    def observe(self, value: float, **labels):
+        key = self._key(labels)
+        with self._lock:
+            st = self._data.get(key)
+            if st is None:
+                st = self._data[key] = {
+                    "counts": [0] * len(self.buckets), "sum": 0.0, "n": 0}
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    st["counts"][i] += 1
+                    break
+            st["sum"] += float(value)
+            st["n"] += 1
+
+    def summary(self, **labels) -> Dict[str, float]:
+        """{'count', 'sum', 'mean'} for one label set (0s when unseen)."""
+        with self._lock:
+            st = self._data.get(self._key(labels))
+            if st is None:
+                return {"count": 0, "sum": 0.0, "mean": 0.0}
+            return {"count": st["n"], "sum": st["sum"],
+                    "mean": st["sum"] / st["n"] if st["n"] else 0.0}
+
+    def render(self) -> List[str]:
+        lines = []
+        with self._lock:
+            for key, st in sorted(self._data.items()):
+                cum = 0
+                for b, c in zip(self.buckets, st["counts"]):
+                    cum += c
+                    le = 'le="%s"' % _fmt(b)
+                    lines.append(
+                        f"{self.name}_bucket{self._label_str(key, le)} {cum}")
+                lines.append(f"{self.name}_sum{self._label_str(key)} "
+                             f"{_fmt(st['sum'])}")
+                lines.append(f"{self.name}_count{self._label_str(key)} "
+                             f"{st['n']}")
+        return lines
+
+    def to_json(self) -> dict:
+        with self._lock:
+            samples = []
+            for key, st in sorted(self._data.items()):
+                cum, bucket_map = 0, {}
+                for b, c in zip(self.buckets, st["counts"]):
+                    cum += c
+                    bucket_map[_fmt(b)] = cum
+                samples.append({"labels": dict(zip(self.labelnames, key)),
+                                "sum": st["sum"], "count": st["n"],
+                                "buckets": bucket_map})
+        return {"name": self.name, "type": self.kind, "help": self.help,
+                "samples": samples}
+
+
+class MetricsRegistry:
+    """A set of named instruments rendered together.
+
+    ``namespace=`` on the constructors prefixes the metric name
+    (``counter("steps_total", ..., namespace="train")`` registers
+    ``train_steps_total``) — the one-registry-many-subsystems
+    convention that keeps family names collision-free by layer.
+    """
+
+    def __init__(self):
+        self._instruments: List[_Instrument] = []
+        # every sample-line name any instrument exposes -> owning family
+        self._reserved: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def _add(self, inst: _Instrument) -> _Instrument:
+        with self._lock:
+            for n in inst.sample_names():
+                owner = self._reserved.get(n)
+                if owner is not None:
+                    raise ValueError(
+                        f"duplicate metric registration: {inst.kind} "
+                        f"{inst.name!r} would expose sample name {n!r}, "
+                        f"already owned by instrument {owner!r} — metric "
+                        "names must be unique per registry")
+            for n in inst.sample_names():
+                self._reserved[n] = inst.name
+            self._instruments.append(inst)
+        return inst
+
+    @staticmethod
+    def _full_name(name: str, namespace: Optional[str]) -> str:
+        return f"{namespace}_{name}" if namespace else name
+
+    def counter(self, name, help, labelnames=(), *,
+                namespace: Optional[str] = None) -> Counter:
+        return self._add(Counter(self._full_name(name, namespace), help,
+                                 labelnames))
+
+    def gauge(self, name, help, labelnames=(), *,
+              namespace: Optional[str] = None) -> Gauge:
+        return self._add(Gauge(self._full_name(name, namespace), help,
+                               labelnames))
+
+    def histogram(self, name, help, labelnames=(),
+                  buckets=DEFAULT_LATENCY_BUCKETS, *,
+                  namespace: Optional[str] = None) -> Histogram:
+        return self._add(Histogram(self._full_name(name, namespace), help,
+                                   labelnames, buckets))
+
+    def instruments(self) -> List[_Instrument]:
+        with self._lock:
+            return list(self._instruments)
+
+    def names(self) -> List[str]:
+        return [i.name for i in self.instruments()]
+
+    def render_text(self) -> str:
+        return render_text_multi([self])
+
+    def render_json(self) -> dict:
+        return render_json_multi([self])
+
+
+def render_text_multi(registries: Sequence[MetricsRegistry]) -> str:
+    """One exposition document over several registries (first wins on a
+    family-name collision — how the serving bundle's private registry and
+    the process default merge into one scrape)."""
+    out: List[str] = []
+    seen = set()
+    for reg in registries:
+        for inst in reg.instruments():
+            if inst.name in seen:
+                continue
+            seen.add(inst.name)
+            out.append(f"# HELP {inst.name} {_esc_help(inst.help)}")
+            out.append(f"# TYPE {inst.name} {inst.kind}")
+            out.extend(inst.render())
+    return "\n".join(out) + "\n"
+
+
+def render_json_multi(registries: Sequence[MetricsRegistry]) -> dict:
+    out, seen = [], set()
+    for reg in registries:
+        for inst in reg.instruments():
+            if inst.name in seen:
+                continue
+            seen.add(inst.name)
+            out.append(inst.to_json())
+    return {"metrics": out}
+
+
+# -- process-global default registry ----------------------------------------
+
+_DEFAULT = MetricsRegistry()
+_BUNDLES: Dict[str, object] = {}
+_RESET_HOOKS: List[Callable[[], None]] = []
+_ENABLED = True
+_state_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-global registry every built-in collector feeds; the
+    ``/metrics`` endpoint renders it alongside the server's own bundle."""
+    return _DEFAULT
+
+
+def reset_default_registry() -> MetricsRegistry:
+    """Replace the global registry with a fresh one (tests/bench): bundle
+    singletons are dropped and re-create lazily on the new registry."""
+    global _DEFAULT
+    with _state_lock:
+        _DEFAULT = MetricsRegistry()
+        _BUNDLES.clear()
+    for hook in list(_RESET_HOOKS):
+        hook()
+    return _DEFAULT
+
+
+def register_reset_hook(fn: Callable[[], None]):
+    """Run ``fn`` on every ``reset_default_registry`` (lets runtime.py
+    drop its collector singleton without an import cycle)."""
+    _RESET_HOOKS.append(fn)
+
+
+def set_enabled(flag: bool):
+    """Master switch for the built-in hot-path instrumentation."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _bundle(key: str, factory):
+    b = _BUNDLES.get(key)
+    if b is None:
+        with _state_lock:
+            b = _BUNDLES.get(key)
+            if b is None:
+                b = _BUNDLES[key] = factory(_DEFAULT)
+    return b
+
+
+# -- built-in bundles (lazy singletons on the default registry) -------------
+
+
+class TrainingMetrics:
+    """Trainer.fit hot-loop instruments (↔ PerformanceListener's numbers,
+    continuously exported instead of printed)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        ns = "train"
+        self.steps_total = r.counter(
+            "steps_total", "Optimizer steps dispatched by Trainer.fit "
+            "(TBPTT windows each count as one step).", namespace=ns)
+        self.samples_total = r.counter(
+            "samples_total",
+            "Training samples consumed (leading batch dim).", namespace=ns)
+        self.epochs_total = r.counter(
+            "epochs_total", "Completed training epochs.", namespace=ns)
+        self.step_seconds = r.histogram(
+            "step_seconds",
+            "Host wall time per dispatched train step. Dispatch is async: "
+            "this measures the host loop's pace, not device latency — "
+            "a backed-up pipeline shows up here, a fast one shows "
+            "dispatch cost.", namespace=ns)
+        self.data_read_seconds = r.histogram(
+            "data_read_seconds",
+            "Data-iterator next() latency as seen by the fit loop.",
+            namespace=ns)
+
+
+class ResilienceMetrics:
+    """Recovery/crash events (resilience/recovery.py, retry.py,
+    utils/crash.py) — previously only visible in local logs/files."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        ns = "resilience"
+        self.rollbacks_total = r.counter(
+            "rollbacks_total", "Rollbacks to the latest verified "
+            "checkpoint (NaN/inf recovery).", namespace=ns)
+        self.skipped_batches_total = r.counter(
+            "skipped_batches_total",
+            "Poison batches skipped on replay.", namespace=ns)
+        self.lr_cuts_total = r.counter(
+            "lr_cuts_total",
+            "Learning-rate cuts applied after rollbacks.", namespace=ns)
+        self.checkpoint_skips_total = r.counter(
+            "checkpoint_skips_total", "Checkpoint saves refused because "
+            "params were non-finite.", namespace=ns)
+        self.data_retries_total = r.counter(
+            "data_retries_total", "Transient data-read failures retried "
+            "by RetryingIterator.", namespace=ns)
+        self.crash_reports_total = r.counter(
+            "crash_reports_total",
+            "Crash dumps written by utils.crash.", namespace=ns)
+
+
+class CheckpointMetrics:
+    """serde/checkpoint.py latency + quarantine instruments."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        ns = "checkpoint"
+        self.op_seconds = r.histogram(
+            "op_seconds", "Checkpoint operation latency by op "
+            "(save = snapshot serialization + atomic file IO, "
+            "verify = manifest check, restore = load into a template).",
+            ("op",), namespace=ns)
+        self.quarantined_total = r.counter(
+            "quarantined_total",
+            "Corrupt checkpoints moved to quarantine/.", namespace=ns)
+
+
+def get_training_metrics() -> TrainingMetrics:
+    return _bundle("training", TrainingMetrics)
+
+
+def get_resilience_metrics() -> ResilienceMetrics:
+    return _bundle("resilience", ResilienceMetrics)
+
+
+def get_checkpoint_metrics() -> CheckpointMetrics:
+    return _bundle("checkpoint", CheckpointMetrics)

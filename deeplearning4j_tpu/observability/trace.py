@@ -1,0 +1,282 @@
+"""Tracing spans: nested, correlation-ID-linked, Perfetto-loadable.
+
+The metrics registry answers "how much / how often"; spans answer
+"where did THIS request's time go". A :class:`Span` is one named,
+timed interval with a ``trace_id`` (the correlation ID every span of
+one logical request shares), a ``span_id``, and a ``parent_id`` link
+forming the tree. Producers:
+
+- ``span("name")`` — context manager with thread-local nesting (a span
+  opened inside another becomes its child automatically);
+- ``record_span(...)`` — post-hoc recording with explicit timestamps,
+  for work measured on another thread (ParallelInference workers record
+  the batch/dispatch legs of a request after the fact).
+
+Correlation propagation over HTTP uses two headers the serving layer
+reads and writes: ``X-Correlation-ID`` (the trace id) and ``X-Span-ID``
+(the caller's span, adopted as the server-side root's parent) — so one
+served request yields a linked tree: client → request → admission /
+batch → dispatch.
+
+Finished spans land in a process-global bounded ring (:class:`Tracer`)
+and export two ways: JSONL (one span per line — the same convention as
+train/listeners.py records) and Chrome-trace JSON (``ph: "X"`` complete
+events) loadable in Perfetto next to the XLA traces from
+train/profiling.py. The two forms convert losslessly in both
+directions: ids, parent links, and attributes ride in the Chrome
+events' ``args``.
+
+Stdlib only; safe to import from any layer.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional
+
+# Wall-clock anchor + monotonic progression: timestamps are comparable
+# across threads and meaningful as dates, but never go backwards the way
+# raw time.time() can under NTP slew.
+_T0 = time.time() - time.perf_counter()
+
+
+def now() -> float:
+    """Trace timestamp (seconds, wall-anchored monotonic)."""
+    return _T0 + time.perf_counter()
+
+
+# Span ids are minted on the serving hot path; uuid4 costs ~8 µs a call,
+# so ids are a random-per-process 8-hex prefix + an atomic counter
+# (itertools.count is GIL-atomic): unique across processes by the prefix,
+# unique within one by the counter, ~0.3 µs a call.
+_ID_PREFIX = uuid.uuid4().hex[:8]
+_ID_COUNTER = itertools.count()
+
+
+def new_id() -> str:
+    """A fresh 16-hex-char correlation/span id."""
+    return f"{_ID_PREFIX}{next(_ID_COUNTER) & 0xFFFFFFFF:08x}"
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
+                 "thread", "attrs")
+
+    def __init__(self, name: str, *, trace_id: str, span_id: str,
+                 parent_id: Optional[str] = None, start: float = 0.0,
+                 end: float = 0.0, thread: Optional[str] = None,
+                 attrs: Optional[dict] = None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start
+        self.end = end
+        self.thread = thread
+        self.attrs = attrs if attrs is not None else {}
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.end - self.start)
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "start": self.start, "end": self.end, "thread": self.thread,
+                "attrs": dict(self.attrs)}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Span":
+        return cls(d["name"], trace_id=d["trace_id"], span_id=d["span_id"],
+                   parent_id=d.get("parent_id"), start=d.get("start", 0.0),
+                   end=d.get("end", 0.0), thread=d.get("thread"),
+                   attrs=dict(d.get("attrs", {})))
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, trace={self.trace_id}, "
+                f"id={self.span_id}, parent={self.parent_id}, "
+                f"dur={self.duration * 1e3:.3f}ms)")
+
+
+class Tracer:
+    """Bounded ring of finished spans (oldest evicted first)."""
+
+    def __init__(self, capacity: int = 4096):
+        self._spans: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, span: Span):
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self, trace_id: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            snap = list(self._spans)
+        if trace_id is None:
+            return snap
+        return [s for s in snap if s.trace_id == trace_id]
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+
+    def export_jsonl(self, path: str, trace_id: Optional[str] = None) -> int:
+        """Append spans as JSONL; returns the number written."""
+        spans = self.spans(trace_id)
+        with open(path, "a") as fh:
+            for s in spans:
+                fh.write(json.dumps(s.to_json()) + "\n")
+        return len(spans)
+
+
+_TRACER = Tracer()
+_ENABLED = True
+_tls = threading.local()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def set_tracing_enabled(flag: bool):
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def tracing_enabled() -> bool:
+    return _ENABLED
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span() -> Optional[Span]:
+    st = _stack()
+    return st[-1] if st else None
+
+
+@contextmanager
+def span(name: str, *, trace_id: Optional[str] = None,
+         parent_id: Optional[str] = None, tracer: Optional[Tracer] = None,
+         **attrs):
+    """Open a span around a block. Nesting is thread-local: without an
+    explicit ``trace_id``/``parent_id`` the current span (if any) is the
+    parent and shares its trace. Yields the live Span (attrs mutable)
+    or None when tracing is disabled. An exception in the block is
+    recorded as an ``error`` attr and re-raised; the span always closes.
+    """
+    if not _ENABLED:
+        yield None
+        return
+    parent = current_span()
+    if trace_id is None:
+        trace_id = parent.trace_id if parent is not None else new_id()
+    if parent_id is None and parent is not None:
+        parent_id = parent.span_id
+    s = Span(name, trace_id=trace_id, span_id=new_id(), parent_id=parent_id,
+             start=now(), thread=threading.current_thread().name,
+             attrs=dict(attrs))
+    _stack().append(s)
+    try:
+        yield s
+    except BaseException as e:
+        s.attrs.setdefault("error", type(e).__name__)
+        raise
+    finally:
+        _stack().pop()
+        s.end = now()
+        (tracer if tracer is not None else _TRACER).record(s)
+
+
+def record_span(name: str, *, start: float, end: float, trace_id: str,
+                parent_id: Optional[str] = None,
+                span_id: Optional[str] = None, thread: Optional[str] = None,
+                tracer: Optional[Tracer] = None, **attrs) -> Span:
+    """Record a span with explicit timestamps (post-hoc, cross-thread).
+    Returns the Span so callers can parent further spans to it."""
+    s = Span(name, trace_id=trace_id,
+             span_id=span_id if span_id is not None else new_id(),
+             parent_id=parent_id, start=start, end=end,
+             thread=(thread if thread is not None
+                     else threading.current_thread().name),
+             attrs=dict(attrs))
+    (tracer if tracer is not None else _TRACER).record(s)
+    return s
+
+
+# -- JSONL / Chrome-trace conversion ----------------------------------------
+
+
+def load_jsonl(path: str) -> List[Span]:
+    spans = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                spans.append(Span.from_json(json.loads(line)))
+    return spans
+
+
+def to_chrome_trace(spans: Iterable[Span]) -> dict:
+    """Chrome-trace JSON (Perfetto-loadable). One ``"X"`` complete event
+    per span; ids/attrs ride in ``args`` so :func:`from_chrome_trace`
+    reconstructs the exact span set (nesting included). Threads map to
+    tids with ``thread_name`` metadata events."""
+    spans = list(spans)
+    tids: Dict[str, int] = {}
+    for s in spans:
+        tids.setdefault(s.thread or "main", len(tids) + 1)
+    events = [{"ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
+               "args": {"name": tname}} for tname, tid in tids.items()]
+    for s in spans:
+        # attrs ride in their own sub-dict: a user attr named "span_id"
+        # must not clobber the identity keys the round trip depends on
+        args = {"trace_id": s.trace_id, "span_id": s.span_id,
+                "parent_id": s.parent_id, "attrs": dict(s.attrs)}
+        events.append({
+            "ph": "X", "cat": "span", "name": s.name, "pid": 1,
+            "tid": tids[s.thread or "main"],
+            "ts": s.start * 1e6, "dur": s.duration * 1e6, "args": args})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def from_chrome_trace(trace: dict) -> List[Span]:
+    """Inverse of :func:`to_chrome_trace` for events it wrote (spans with
+    ``span_id`` in args); foreign events without one — e.g. XLA ops in a
+    merged profile — are skipped."""
+    events = trace.get("traceEvents", [])
+    tid_names = {ev.get("tid"): ev.get("args", {}).get("name")
+                 for ev in events
+                 if ev.get("ph") == "M" and ev.get("name") == "thread_name"}
+    spans = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args", {}))
+        if "span_id" not in args:
+            continue
+        start = float(ev.get("ts", 0.0)) / 1e6
+        spans.append(Span(
+            ev.get("name", "?"), trace_id=args.get("trace_id"),
+            span_id=args.get("span_id"), parent_id=args.get("parent_id"),
+            start=start, end=start + float(ev.get("dur", 0.0)) / 1e6,
+            thread=tid_names.get(ev.get("tid")),
+            attrs=dict(args.get("attrs", {}))))
+    return spans
+
+
+def write_chrome_trace(path: str, spans: Iterable[Span]) -> int:
+    spans = list(spans)
+    with open(path, "w") as fh:
+        json.dump(to_chrome_trace(spans), fh)
+    return len(spans)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.observability import trace as _trace
+
 
 class InferenceQueueFull(RuntimeError):
     """Raised by ``output()`` when the request queue is at ``queue_limit``.
@@ -42,7 +44,8 @@ def _rows(inputs) -> int:
 
 
 class _Request:
-    __slots__ = ("inputs", "event", "result", "error", "cancelled")
+    __slots__ = ("inputs", "event", "result", "error", "cancelled",
+                 "trace", "t_enqueue")
 
     def __init__(self, inputs):
         self.inputs = inputs
@@ -50,6 +53,10 @@ class _Request:
         self.result = None
         self.error = None
         self.cancelled = False
+        # (trace_id, parent_span_id) from the serving layer, or None;
+        # the worker records batch/dispatch spans against it post-hoc.
+        self.trace = None
+        self.t_enqueue = 0.0
 
 
 class ParallelInference:
@@ -117,13 +124,19 @@ class ParallelInference:
 
     # -- client API --------------------------------------------------------
 
-    def output(self, features, timeout: Optional[float] = None):
+    def output(self, features, timeout: Optional[float] = None,
+               trace=None):
         """Blocking single-request inference (thread-safe).
 
         On timeout the request is marked cancelled — a worker that picks it
         up later skips it instead of computing a result nobody reads.
         Raises :class:`InferenceQueueFull` when the queue is at
-        ``queue_limit`` (never blocks while holding the state lock)."""
+        ``queue_limit`` (never blocks while holding the state lock).
+
+        ``trace``: optional ``(trace_id, parent_span_id)`` correlation
+        context — the worker records "serving.batch" (queue wait + batch
+        assembly) and "serving.dispatch" (device execution) spans under
+        it, so a request's time is attributable end to end."""
         # Validate here, in the caller's thread: malformed features that
         # raised in the worker's batch-collection path would kill the
         # worker and strand every request it held.
@@ -134,6 +147,9 @@ class ParallelInference:
                 "features must be a non-empty pytree of arrays with a "
                 f"leading batch dim, got {type(features).__name__}") from e
         req = _Request(features)
+        if trace is not None and _trace.tracing_enabled():
+            req.trace = trace
+            req.t_enqueue = _trace.now()
         # Lock orders the running-check + enqueue against shutdown()'s
         # running-flip: a request admitted here is guaranteed to precede
         # the sentinels in the FIFO, so workers serve it before exiting.
@@ -258,9 +274,14 @@ class ParallelInference:
                                 [a, jnp.zeros((bucket - rows, *a.shape[1:]),
                                               a.dtype)]),
                             feats)
+                traced = [r for r in batch if r.trace is not None]
                 t0 = time.monotonic()
+                td0 = _trace.now() if traced else 0.0
                 out = jax.device_get(
                     self._fn(variables, jax.device_put(feats, device)))
+                td1 = _trace.now() if traced else 0.0
+                self._record_telemetry(traced, feats, out, device,
+                                       len(batch), rows, bucket, td0, td1)
                 if self._on_batch is not None:
                     try:
                         self._on_batch(len(batch), rows, bucket,
@@ -277,3 +298,30 @@ class ParallelInference:
                 for r in batch:
                     r.error = e
                     r.event.set()
+
+    def _record_telemetry(self, traced, feats, out, device, n_requests,
+                          rows, bucket, td0, td1):
+        """Post-dispatch spans + transfer counters; never fails serving."""
+        try:
+            from deeplearning4j_tpu.observability import metrics as _obsm
+            from deeplearning4j_tpu.observability import runtime as _obsr
+
+            if _obsm.enabled():
+                nbytes = sum(getattr(a, "nbytes", 0)
+                             for a in jax.tree_util.tree_leaves(feats))
+                _obsr.record_transfer("h2d", nbytes)
+                _obsr.record_transfer("d2h", sum(
+                    getattr(a, "nbytes", 0)
+                    for a in jax.tree_util.tree_leaves(out)))
+            for r in traced:
+                trace_id, parent = r.trace
+                b = _trace.record_span(
+                    "serving.batch", trace_id=trace_id, parent_id=parent,
+                    start=r.t_enqueue, end=td0, rows=rows, bucket=bucket,
+                    n_requests=n_requests)
+                _trace.record_span(
+                    "serving.dispatch", trace_id=trace_id,
+                    parent_id=b.span_id, start=td0, end=td1,
+                    device=str(device))
+        except Exception:  # noqa: BLE001 — telemetry never fails serving
+            pass

@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
+import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -47,6 +48,24 @@ class NonFiniteLossError(RuntimeError):
     def __init__(self, msg: str, step: Optional[int] = None):
         super().__init__(msg)
         self.step = step
+
+
+def _obs():
+    """Shared-registry resilience bundle, or None when instrumentation is
+    off — recovery events (rollbacks, skips, LR cuts) are rare, so the
+    lazy lookup per event is free next to the checkpoint IO around it."""
+    from deeplearning4j_tpu.observability import metrics as _obsm
+
+    return _obsm.get_resilience_metrics() if _obsm.enabled() else None
+
+
+def _train_obs():
+    """The same training bundle Trainer.fit feeds — FaultTolerantTrainer
+    drives the compiled step from its own loop, so it reports step/sample
+    counts itself or a recovering run would vanish from the scrape."""
+    from deeplearning4j_tpu.observability import metrics as _obsm
+
+    return _obsm.get_training_metrics() if _obsm.enabled() else None
 
 
 def _nan_exception_types():
@@ -180,6 +199,9 @@ class FaultTolerantTrainer:
                 "kind": "skip_checkpoint",
                 "step": int(jax.device_get(ts.step)),
                 "reason": "non-finite params"})
+            rm = _obs()
+            if rm is not None:
+                rm.checkpoint_skips_total.inc()
             return
         save_checkpoint(
             self.directory, ts, model=self.model, tag=tag,
@@ -222,6 +244,9 @@ class FaultTolerantTrainer:
         self.recoveries.append({
             "kind": "rollback", "checkpoint": d,
             "to_step": int(meta.get("step", 0)), "cause": repr(err)})
+        rm = _obs()
+        if rm is not None:
+            rm.rollbacks_total.inc()
         return ts, (int(meta.get("epoch", 0)),
                     int(meta.get("batch_in_epoch", 0)))
 
@@ -264,6 +289,7 @@ class FaultTolerantTrainer:
         fail_counts: Dict[Tuple[int, int], int] = {}
         skip_set: Set[Tuple[int, int]] = set()
         stop = False
+        tm = _train_obs()
         for lst in listeners:
             lst.on_fit_start(tr, ts)
         try:
@@ -287,6 +313,9 @@ class FaultTolerantTrainer:
                     if (epoch, b) in skip_set:
                         self.recoveries.append(
                             {"kind": "skip_batch", "epoch": epoch, "batch": b})
+                        rm = _obs()
+                        if rm is not None:
+                            rm.skipped_batches_total.inc()
                         b += 1
                         continue
                     batch = as_batch_dict(batch)
@@ -295,6 +324,7 @@ class FaultTolerantTrainer:
                     if tr._batch_sharding is not None:
                         batch = jax.device_put(batch, tr._batch_sharding)
                     new_ts = None
+                    t_step = time.perf_counter() if tm is not None else 0.0
                     try:
                         new_ts, metrics = self._step_fn(ts, batch)
                         if pol.check_every and \
@@ -325,12 +355,20 @@ class FaultTolerantTrainer:
                                 tr._raw_step, tr._jit_kwargs)
                             self.recoveries.append(
                                 {"kind": "lr_cut", "scale": self._lr_scale})
+                            rm = _obs()
+                            if rm is not None:
+                                rm.lr_cuts_total.inc()
                         epoch = r_epoch
                         skip_batches = r_skip
                         restart_epoch = True
                         break
                     ts = new_ts
                     host_step += 1
+                    if tm is not None:
+                        tm.step_seconds.observe(time.perf_counter() - t_step)
+                        tm.steps_total.inc()
+                        feats = jax.tree_util.tree_leaves(batch["features"])
+                        tm.samples_total.inc(feats[0].shape[0])
                     b += 1
                     if pol.checkpoint_every and \
                             host_step % pol.checkpoint_every == 0:
@@ -353,6 +391,8 @@ class FaultTolerantTrainer:
                         stop = True
                 if hasattr(data, "reset"):
                     data.reset()
+                if tm is not None:
+                    tm.epochs_total.inc()
                 epoch += 1
                 if pol.checkpoint_every_epoch and epoch < epochs:
                     # position = start of the next epoch: a rollback in

@@ -121,6 +121,16 @@ class RetryingIterator:
             except self.retry_on as e:
                 attempts += 1
                 self.retry_log.append((produced, attempts, repr(e)))
+                try:
+                    from deeplearning4j_tpu.observability import (
+                        metrics as _obsm,
+                    )
+
+                    if _obsm.enabled():
+                        _obsm.get_resilience_metrics() \
+                            .data_retries_total.inc()
+                except Exception:  # noqa: BLE001 - telemetry never blocks retry
+                    pass
                 if one_shot:
                     # iter(base) returned base itself: the failed iterator
                     # cannot be re-created, a retry would truncate

@@ -73,6 +73,12 @@ def _snapshot_tree(tree: Any):
             key_impls[name] = str(jax.random.key_impl(leaf))
         else:
             arrays[name] = np.asarray(jax.device_get(leaf))
+    try:
+        from deeplearning4j_tpu.observability.runtime import record_transfer
+
+        record_transfer("d2h", sum(a.nbytes for a in arrays.values()))
+    except Exception:  # noqa: BLE001 - telemetry never fails a snapshot
+        pass
     return arrays, key_paths, key_impls
 
 
@@ -108,6 +114,20 @@ def _fault_injector():
     return inj if inj.enabled else None
 
 
+def _ckpt_metrics():
+    """Shared-registry checkpoint bundle, or None when instrumentation is
+    off (observability/metrics.py)."""
+    from deeplearning4j_tpu.observability import metrics as _obsm
+
+    return _obsm.get_checkpoint_metrics() if _obsm.enabled() else None
+
+
+def _observe_op(op: str, seconds: float):
+    m = _ckpt_metrics()
+    if m is not None:
+        m.op_seconds.observe(seconds, op=op)
+
+
 def _write_snapshot(directory: str | Path, arrays: Dict[str, np.ndarray],
                     key_paths, key_impls, extra_meta: Optional[dict] = None):
     """File-IO half of a save; safe to run off-thread (touches no jax).
@@ -119,6 +139,7 @@ def _write_snapshot(directory: str | Path, arrays: Dict[str, np.ndarray],
     bytes just written); (3) ``meta.json`` last. The caller indexes only
     after this returns, so an indexed checkpoint always has its manifest.
     """
+    t_op = time.perf_counter()
     d = Path(directory)
     d.mkdir(parents=True, exist_ok=True)
     inj = _fault_injector()
@@ -160,6 +181,7 @@ def _write_snapshot(directory: str | Path, arrays: Dict[str, np.ndarray],
     if extra_meta:
         meta.update(extra_meta)
     _atomic_write_text(d / "meta.json", json.dumps(meta, indent=2))
+    _observe_op("save", time.perf_counter() - t_op)
 
 
 def save_state_tree(directory: str | Path, tree: Any, extra_meta: Optional[dict] = None):
@@ -178,6 +200,7 @@ def load_state_tree(directory: str | Path, template: Any, sharding=None,
     leaves saved under a different prefix, e.g. serving's ``state/`` vs a
     TrainState's ``model_state/``).
     """
+    t_op = time.perf_counter()
     d = Path(directory)
     meta = json.loads((d / "meta.json").read_text())
     key_paths = set(meta.get("key_paths", []))
@@ -205,6 +228,13 @@ def load_state_tree(directory: str | Path, template: Any, sharding=None,
     tree = jax.tree_util.tree_unflatten(treedef, leaves)
     if sharding is not None:
         tree = _place(tree, sharding)
+    _observe_op("restore", time.perf_counter() - t_op)
+    try:
+        from deeplearning4j_tpu.observability.runtime import record_transfer
+
+        record_transfer("h2d", sum(a.nbytes for a in data.values()))
+    except Exception:  # noqa: BLE001 - telemetry never fails a restore
+        pass
     return tree
 
 
@@ -414,6 +444,15 @@ def verify_checkpoint(ckpt_dir: str | Path, *,
     written before manifests existed verify as ok with a "legacy" reason —
     fallback must not quarantine every pre-upgrade checkpoint.
     """
+    t_op = time.perf_counter()
+    try:
+        return _verify_checkpoint_impl(ckpt_dir, deep=deep)
+    finally:
+        _observe_op("verify", time.perf_counter() - t_op)
+
+
+def _verify_checkpoint_impl(ckpt_dir: str | Path, *,
+                            deep: bool = False) -> Tuple[bool, str]:
     d = Path(ckpt_dir)
     if not d.is_dir():
         return False, "missing checkpoint directory"
@@ -471,6 +510,9 @@ def quarantine_checkpoint(ckpt_dir: str | Path,
         os.replace(d, target)
     except OSError:
         return None
+    m = _ckpt_metrics()
+    if m is not None:
+        m.quarantined_total.inc()
     try:
         (target / "QUARANTINE.txt").write_text(
             f"quarantined {time.time()}: {reason}\n")

@@ -8,7 +8,10 @@ REST serving story, grown into a first-class subsystem).
   overload sheds with structured backpressure errors, never blocks.
 - warmup: pre-compiles the power-of-two batch buckets ParallelInference
   pads to, so no live request eats a first-compile spike.
-- metrics: Prometheus-text-format counters/histograms with a JSON twin.
+- metrics: the serving instrument bundle on the shared telemetry core
+  (observability/metrics.py; this module re-exports the instruments) —
+  Prometheus text format with a JSON twin, and /metrics exposes the
+  process-global registry's train/resilience/runtime series too.
 - server: ModelServer — POST /v1/models/<name>:predict, GET /models,
   /healthz, /readyz, /metrics; graceful drain on shutdown.
 - client: stdlib ServingClient raising the same typed errors.

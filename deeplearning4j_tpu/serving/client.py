@@ -25,6 +25,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import trace as _trace
 from deeplearning4j_tpu.resilience.retry import backoff_delays
 from deeplearning4j_tpu.serving.errors import (
     NotReadyError,
@@ -61,11 +62,14 @@ class ServingClient:
         self._rng = random.Random(retry_seed)
         self._sleep = sleep
 
-    def _request_once(self, path: str, payload: Optional[dict] = None) -> dict:
+    def _request_once(self, path: str, payload: Optional[dict] = None,
+                      headers: Optional[dict] = None) -> dict:
         data = json.dumps(payload).encode() if payload is not None else None
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         req = urllib.request.Request(
-            self.base_url + path, data=data,
-            headers={"Content-Type": "application/json"})
+            self.base_url + path, data=data, headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return json.loads(r.read())
@@ -94,14 +98,15 @@ class ServingClient:
                                   err.get("message", f"HTTP {e.code}"),
                                   retry_after_ms=retry_after_ms) from e
 
-    def _request(self, path: str, payload: Optional[dict] = None) -> dict:
+    def _request(self, path: str, payload: Optional[dict] = None,
+                 headers: Optional[dict] = None) -> dict:
         """One request with the retry policy applied (a no-op loop at the
         default ``max_retries=0``)."""
         attempt = 0
         delays = None
         while True:
             try:
-                return self._request_once(path, payload)
+                return self._request_once(path, payload, headers)
             except ServingError as err:
                 if not getattr(err, "retryable", False) \
                         or attempt >= self.max_retries:
@@ -122,13 +127,27 @@ class ServingClient:
     # -- API ------------------------------------------------------------------
 
     def predict(self, model: str, inputs: Any, *,
-                deadline_ms: Optional[float] = None) -> dict:
+                deadline_ms: Optional[float] = None,
+                correlation_id: Optional[str] = None) -> dict:
         """POST a predict; returns the full response dict
-        ({"model", "version", "outputs"}). Typed ServingError on failure."""
+        ({"model", "version", "outputs"}). Typed ServingError on failure.
+
+        A correlation ID (minted per call unless given) rides the
+        ``X-Correlation-ID``/``X-Span-ID`` headers, so the client span
+        recorded here and the server-side request/admission/batch/
+        dispatch spans form one tree (``observability/trace.py``).
+        Retries reuse the same ID — one logical request, one trace."""
         payload = {"inputs": _jsonable(inputs)}
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
-        return self._request(f"/v1/models/{model}:predict", payload)
+        cid = correlation_id if correlation_id else _trace.new_id()
+        with _trace.span("client.request", trace_id=cid,
+                         model=model) as s:
+            headers = {"X-Correlation-ID": cid}
+            if s is not None:
+                headers["X-Span-ID"] = s.span_id
+            return self._request(f"/v1/models/{model}:predict", payload,
+                                 headers)
 
     def models(self) -> list:
         return self._request("/models")["models"]

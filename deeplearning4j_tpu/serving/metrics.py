@@ -1,203 +1,44 @@
-"""Serving metrics: Prometheus text format + a JSON twin, stdlib-only.
+"""Serving metrics — now a thin facade over the shared telemetry core.
 
-Counter / Gauge / Histogram with the exposition semantics scrapers
-expect (``# HELP``/``# TYPE`` headers, cumulative ``_bucket{le=...}``
-series, ``_sum``/``_count``). Follows the repo's observability
-convention (train/listeners.py emits JSONL records; here the same
-numbers are exposed twice: ``/metrics`` for Prometheus,
-``/metrics?format=json`` for scripts and tests).
+The Counter/Gauge/Histogram implementation was promoted to
+``observability/metrics.py`` (PR 3); this module re-exports it so every
+existing ``serving.metrics`` import keeps working, and keeps the
+serving-specific :class:`ServingMetrics` instrument bundle.
 
-Thread-safety: every mutation takes the instrument's lock — serving
-handlers and ParallelInference workers write concurrently.
+``ServingMetrics`` still defaults to its OWN registry — a process can
+run several ``ModelServer``s (tests do) and each must count its own
+traffic — but the server's ``/metrics`` endpoint renders this bundle
+UNION the process-global default registry, so one scrape exposes the
+serving series plus everything the train / resilience / checkpoint /
+runtime collectors registered globally.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, List, Sequence, Tuple
+from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    OCCUPANCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    render_json_multi,
+    render_text_multi,
+)
 
-_INF = float("inf")
-
-# Latency buckets spanning sub-ms host overhead to multi-second cold paths.
-DEFAULT_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-                           0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
-# rows/bucket of a dispatched device batch — 1.0 means no padding waste.
-OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
-
-
-def _fmt(v: float) -> str:
-    if v == _INF:
-        return "+Inf"
-    f = float(v)
-    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
-
-
-def _esc(v) -> str:
-    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
-            .replace("\n", "\\n"))
-
-
-class _Instrument:
-    kind = "untyped"
-
-    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
-        self.name = name
-        self.help = help
-        self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
-        self._data: Dict[Tuple[str, ...], object] = {}
-
-    def _key(self, labels: dict) -> Tuple[str, ...]:
-        if set(labels) != set(self.labelnames):
-            raise ValueError(
-                f"{self.name}: expected labels {self.labelnames}, "
-                f"got {tuple(sorted(labels))}")
-        return tuple(str(labels[k]) for k in self.labelnames)
-
-    def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
-        parts = [f'{k}="{_esc(v)}"' for k, v in zip(self.labelnames, key)]
-        if extra:
-            parts.append(extra)
-        return "{" + ",".join(parts) + "}" if parts else ""
-
-
-class Counter(_Instrument):
-    kind = "counter"
-
-    def inc(self, amount: float = 1.0, **labels):
-        key = self._key(labels)
-        with self._lock:
-            self._data[key] = self._data.get(key, 0.0) + amount
-
-    def value(self, **labels) -> float:
-        with self._lock:
-            return float(self._data.get(self._key(labels), 0.0))
-
-    def render(self) -> List[str]:
-        with self._lock:
-            return [f"{self.name}{self._label_str(k)} {_fmt(v)}"
-                    for k, v in sorted(self._data.items())]
-
-    def to_json(self) -> dict:
-        with self._lock:
-            samples = [{"labels": dict(zip(self.labelnames, k)), "value": v}
-                       for k, v in sorted(self._data.items())]
-        return {"name": self.name, "type": self.kind, "help": self.help,
-                "samples": samples}
-
-
-class Gauge(Counter):
-    kind = "gauge"
-
-    def set(self, value: float, **labels):
-        key = self._key(labels)
-        with self._lock:
-            self._data[key] = float(value)
-
-    def dec(self, amount: float = 1.0, **labels):
-        self.inc(-amount, **labels)
-
-
-class Histogram(_Instrument):
-    kind = "histogram"
-
-    def __init__(self, name, help, labelnames=(),
-                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
-        super().__init__(name, help, labelnames)
-        self.buckets = tuple(sorted(buckets)) + (_INF,)
-
-    def observe(self, value: float, **labels):
-        key = self._key(labels)
-        with self._lock:
-            st = self._data.get(key)
-            if st is None:
-                st = self._data[key] = {
-                    "counts": [0] * len(self.buckets), "sum": 0.0, "n": 0}
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    st["counts"][i] += 1
-                    break
-            st["sum"] += float(value)
-            st["n"] += 1
-
-    def summary(self, **labels) -> Dict[str, float]:
-        """{'count', 'sum', 'mean'} for one label set (0s when unseen)."""
-        with self._lock:
-            st = self._data.get(self._key(labels))
-            if st is None:
-                return {"count": 0, "sum": 0.0, "mean": 0.0}
-            return {"count": st["n"], "sum": st["sum"],
-                    "mean": st["sum"] / st["n"] if st["n"] else 0.0}
-
-    def render(self) -> List[str]:
-        lines = []
-        with self._lock:
-            for key, st in sorted(self._data.items()):
-                cum = 0
-                for b, c in zip(self.buckets, st["counts"]):
-                    cum += c
-                    le = 'le="%s"' % _fmt(b)
-                    lines.append(
-                        f"{self.name}_bucket{self._label_str(key, le)} {cum}")
-                lines.append(f"{self.name}_sum{self._label_str(key)} "
-                             f"{_fmt(st['sum'])}")
-                lines.append(f"{self.name}_count{self._label_str(key)} "
-                             f"{st['n']}")
-        return lines
-
-    def to_json(self) -> dict:
-        with self._lock:
-            samples = []
-            for key, st in sorted(self._data.items()):
-                cum, bucket_map = 0, {}
-                for b, c in zip(self.buckets, st["counts"]):
-                    cum += c
-                    bucket_map[_fmt(b)] = cum
-                samples.append({"labels": dict(zip(self.labelnames, key)),
-                                "sum": st["sum"], "count": st["n"],
-                                "buckets": bucket_map})
-        return {"name": self.name, "type": self.kind, "help": self.help,
-                "samples": samples}
-
-
-class MetricsRegistry:
-    """A set of named instruments rendered together."""
-
-    def __init__(self):
-        self._instruments: List[_Instrument] = []
-        self._lock = threading.Lock()
-
-    def _add(self, inst: _Instrument) -> _Instrument:
-        with self._lock:
-            if any(i.name == inst.name for i in self._instruments):
-                raise ValueError(f"duplicate metric name {inst.name!r}")
-            self._instruments.append(inst)
-        return inst
-
-    def counter(self, name, help, labelnames=()) -> Counter:
-        return self._add(Counter(name, help, labelnames))
-
-    def gauge(self, name, help, labelnames=()) -> Gauge:
-        return self._add(Gauge(name, help, labelnames))
-
-    def histogram(self, name, help, labelnames=(),
-                  buckets=DEFAULT_LATENCY_BUCKETS) -> Histogram:
-        return self._add(Histogram(name, help, labelnames, buckets))
-
-    def render_text(self) -> str:
-        out = []
-        with self._lock:
-            instruments = list(self._instruments)
-        for inst in instruments:
-            out.append(f"# HELP {inst.name} {inst.help}")
-            out.append(f"# TYPE {inst.name} {inst.kind}")
-            out.extend(inst.render())
-        return "\n".join(out) + "\n"
-
-    def render_json(self) -> dict:
-        with self._lock:
-            instruments = list(self._instruments)
-        return {"metrics": [inst.to_json() for inst in instruments]}
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "OCCUPANCY_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ServingMetrics",
+    "default_registry",
+    "render_json_multi",
+    "render_text_multi",
+]
 
 
 class ServingMetrics:

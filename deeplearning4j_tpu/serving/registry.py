@@ -108,16 +108,22 @@ class ModelEntry:
 
     # -- serving -----------------------------------------------------------
 
-    def predict(self, features, timeout: Optional[float] = None):
+    def predict(self, features, timeout: Optional[float] = None,
+                trace=None):
         """Serve one request on the active replica set."""
-        return self.predict_versioned(features, timeout=timeout)[0]
+        return self.predict_versioned(features, timeout=timeout,
+                                      trace=trace)[0]
 
-    def predict_versioned(self, features,
-                          timeout: Optional[float] = None) -> Tuple[Any, str]:
+    def predict_versioned(self, features, timeout: Optional[float] = None,
+                          trace=None) -> Tuple[Any, str]:
         """Serve one request; returns ``(outputs, version)`` where
         ``version`` is the version of the replica set that actually
         served — read under the same lock as the pointer grab, so a
         concurrent hot-swap can never mislabel a response.
+
+        ``trace``: optional ``(trace_id, parent_span_id)`` correlation
+        context forwarded to ``ParallelInference.output`` for the
+        batch/dispatch spans.
 
         Retries once if the grabbed replica set was drained by a
         concurrent hot-swap between the pointer read and the enqueue —
@@ -134,7 +140,8 @@ class ModelEntry:
                                        "deployed version")
                 pi, version = self._active.pi, self._active.version
             try:
-                return pi.output(features, timeout=timeout), version
+                return pi.output(features, timeout=timeout,
+                                 trace=trace), version
             except RuntimeError as e:
                 if "shut down" in str(e) and attempt == 0:
                     continue

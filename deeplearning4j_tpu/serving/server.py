@@ -13,7 +13,15 @@ what the repo already ships. Endpoints:
 - ``GET /readyz``   — 200 only after every registered model's warmup
   completed AND the server is not draining; 503 otherwise.
 - ``GET /metrics``  — Prometheus text format; ``?format=json`` for the
-  JSON twin.
+  JSON twin. Renders this server's serving bundle UNION the process-
+  global default registry (observability/metrics.py), so the train /
+  resilience / checkpoint / runtime-collector series of the same
+  process ride the same scrape.
+
+Predict requests propagate correlation IDs: ``X-Correlation-ID`` /
+``X-Span-ID`` headers (minted when absent, echoed back) root the
+server-side span tree request → admission → batch → dispatch
+(observability/trace.py).
 
 Graceful drain (``stop(drain=True)``): flip draining (readyz → 503, new
 predicts shed with UNAVAILABLE), wait for in-flight requests to finish,
@@ -33,6 +41,12 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
+from deeplearning4j_tpu.observability import trace as _trace
+from deeplearning4j_tpu.observability.metrics import (
+    default_registry,
+    render_json_multi,
+    render_text_multi,
+)
 from deeplearning4j_tpu.parallel.inference import InferenceQueueFull
 from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector
 from deeplearning4j_tpu.serving.admission import AdmissionController
@@ -91,12 +105,14 @@ class ModelServer:
                 pass
 
             def _send(self, status: int, body, content_type="application/json",
-                      retry_after_ms=None):
+                      retry_after_ms=None, correlation_id=None):
                 raw = (body if isinstance(body, bytes)
                        else json.dumps(body).encode())
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(raw)))
+                if correlation_id is not None:
+                    self.send_header("X-Correlation-ID", correlation_id)
                 if retry_after_ms is not None:
                     # HTTP Retry-After is integer seconds; the precise ms
                     # hint rides in the error body's retry_after_ms
@@ -117,10 +133,10 @@ class ModelServer:
                     self._send(200, {"models": server.registry.describe()})
                 elif path == "/metrics":
                     if "format=json" in query:
-                        self._send(200, server.metrics.render_json())
+                        self._send(200, server.render_metrics_json())
                     else:
                         self._send(
-                            200, server.metrics.render_text().encode(),
+                            200, server.render_metrics_text().encode(),
                             content_type="text/plain; version=0.0.4")
                 else:
                     self._send(404, ServingError(
@@ -139,10 +155,18 @@ class ModelServer:
                     self._send(400, BadRequestError(
                         f"invalid JSON body: {e}").to_json())
                     return
-                status, body = server.handle_predict(m.group(1), payload)
+                # correlation propagation: adopt the client's trace id and
+                # parent span, mint a trace id for headerless callers, and
+                # echo the id back so either side can find the span tree
+                cid = (self.headers.get("X-Correlation-ID")
+                       or _trace.new_id())
+                status, body = server.handle_predict(
+                    m.group(1), payload, correlation_id=cid,
+                    parent_span_id=self.headers.get("X-Span-ID"))
                 retry_after = (body.get("error", {}).get("retry_after_ms")
                                if isinstance(body, dict) else None)
-                self._send(status, body, retry_after_ms=retry_after)
+                self._send(status, body, retry_after_ms=retry_after,
+                           correlation_id=cid)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
 
@@ -169,71 +193,100 @@ class ModelServer:
 
     # -- predict path (handler-independent for direct testing) ---------------
 
-    def handle_predict(self, name: str, payload) -> Tuple[int, dict]:
+    def handle_predict(self, name: str, payload, *,
+                       correlation_id: Optional[str] = None,
+                       parent_span_id: Optional[str] = None
+                       ) -> Tuple[int, dict]:
         t0 = time.monotonic()
         # Unknown model names are client-controlled: labeling metrics with
         # them would grow a permanent label set per scanned/typo'd URL.
         metric_model = name
-        try:
-            inj = _fault_injector()
-            if inj.enabled:
-                # resilience injection points: "serving.latency" (sleep
-                # arg seconds) and "serving.error" (retryable 429 shed) —
-                # deterministic overload/latency spikes for client-retry
-                # and SLO tests, armed via DL4J_TPU_FAULTS
-                inj.maybe_sleep("serving.latency")
-                p = inj.fire("serving.error")
-                if p is not None:
-                    raise QueueFullError(
-                        "injected overload (fault injection)",
-                        retry_after_ms=(p.arg * 1000.0) if p.arg else None)
-            entry = self.registry.get(name)
-            if self._draining or not self._started:
-                raise NotReadyError("server is draining" if self._draining
-                                    else "server not started")
-            if not isinstance(payload, dict) or "inputs" not in payload:
-                raise BadRequestError('body must be {"inputs": ...}')
-            timeout = self.admission.timeout_s(payload.get("deadline_ms"))
-            # Admit before the body parse: over-cap traffic must shed
-            # before paying the array-coercion cost, not after.
-            ticket = self.admission.admit()
+        cid = correlation_id if correlation_id else _trace.new_id()
+        # Root of the server-side span tree: the client's span (X-Span-ID)
+        # is the parent, admission nests inside via the thread-local stack,
+        # and the batch/dispatch legs are recorded against req_span by the
+        # ParallelInference worker (observability/trace.py).
+        with _trace.span("serving.request", trace_id=cid,
+                         parent_id=parent_span_id, model=name) as req_span:
             try:
-                features = entry.parse_inputs(payload["inputs"])
+                inj = _fault_injector()
+                if inj.enabled:
+                    # resilience injection points: "serving.latency" (sleep
+                    # arg seconds) and "serving.error" (retryable 429 shed) —
+                    # deterministic overload/latency spikes for client-retry
+                    # and SLO tests, armed via DL4J_TPU_FAULTS
+                    inj.maybe_sleep("serving.latency")
+                    p = inj.fire("serving.error")
+                    if p is not None:
+                        raise QueueFullError(
+                            "injected overload (fault injection)",
+                            retry_after_ms=(p.arg * 1000.0) if p.arg else None)
+                entry = self.registry.get(name)
+                if self._draining or not self._started:
+                    raise NotReadyError("server is draining" if self._draining
+                                        else "server not started")
+                if not isinstance(payload, dict) or "inputs" not in payload:
+                    raise BadRequestError('body must be {"inputs": ...}')
+                # Admit before the body parse: over-cap traffic must shed
+                # before paying the array-coercion cost, not after.
+                with _trace.span("serving.admission"):
+                    timeout = self.admission.timeout_s(
+                        payload.get("deadline_ms"))
+                    ticket = self.admission.admit()
                 try:
-                    out, version = entry.predict_versioned(
-                        features, timeout=timeout)
-                except TimeoutError as e:
-                    raise DeadlineExceededError(
-                        str(e) or "deadline exceeded") from e
-                except InferenceQueueFull as e:
-                    raise QueueFullError(str(e)) from e
-                except RuntimeError as e:
-                    if "shut down" in str(e):
-                        # lost the race against stop(): a structured
-                        # retryable 503, not an INTERNAL 500
-                        raise NotReadyError("server is draining") from e
-                    raise
-            finally:
-                ticket.release()
-            outputs = jax.tree_util.tree_map(
-                lambda a: np.asarray(a).tolist(), out)
-            status, body = 200, {"model": name, "version": version,
-                                 "outputs": outputs}
-        except ServingError as e:
-            status, body = e.http_status, e.to_json()
-            if isinstance(e, ModelNotFoundError):
-                metric_model = "<unknown>"
-            reason = _SHED_REASONS.get(type(e))
-            if reason is not None:
-                self.metrics.shed_total.inc(model=metric_model, reason=reason)
-        except Exception as e:  # noqa: BLE001 — surface, never crash handler
-            status = 500
-            body = {"error": {"code": "INTERNAL", "message": str(e)[:300],
-                              "retryable": False}}
+                    features = entry.parse_inputs(payload["inputs"])
+                    tctx = ((cid, req_span.span_id)
+                            if req_span is not None else None)
+                    try:
+                        out, version = entry.predict_versioned(
+                            features, timeout=timeout, trace=tctx)
+                    except TimeoutError as e:
+                        raise DeadlineExceededError(
+                            str(e) or "deadline exceeded") from e
+                    except InferenceQueueFull as e:
+                        raise QueueFullError(str(e)) from e
+                    except RuntimeError as e:
+                        if "shut down" in str(e):
+                            # lost the race against stop(): a structured
+                            # retryable 503, not an INTERNAL 500
+                            raise NotReadyError("server is draining") from e
+                        raise
+                finally:
+                    ticket.release()
+                outputs = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a).tolist(), out)
+                status, body = 200, {"model": name, "version": version,
+                                     "outputs": outputs}
+            except ServingError as e:
+                status, body = e.http_status, e.to_json()
+                if isinstance(e, ModelNotFoundError):
+                    metric_model = "<unknown>"
+                reason = _SHED_REASONS.get(type(e))
+                if reason is not None:
+                    self.metrics.shed_total.inc(model=metric_model,
+                                                reason=reason)
+            except Exception as e:  # noqa: BLE001 — surface, never crash
+                status = 500
+                body = {"error": {"code": "INTERNAL",
+                                  "message": str(e)[:300],
+                                  "retryable": False}}
+            if req_span is not None:
+                req_span.attrs["status"] = status
         self.metrics.requests_total.inc(model=metric_model, code=str(status))
         self.metrics.request_latency.observe(time.monotonic() - t0,
                                              model=metric_model)
         return status, body
+
+    # -- metrics exposition ---------------------------------------------------
+
+    def render_metrics_text(self) -> str:
+        """The /metrics document: this server's bundle UNION the
+        process-global default registry (train / resilience / checkpoint /
+        runtime collector series) — one scrape tells the whole story."""
+        return render_text_multi([self.metrics.registry, default_registry()])
+
+    def render_metrics_json(self) -> dict:
+        return render_json_multi([self.metrics.registry, default_registry()])
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -592,6 +592,11 @@ class Trainer:
         # One host sync up front; after that the step counter is tracked
         # host-side so the dispatch pipeline never blocks on the device.
         host_step = int(jax.device_get(ts.step))
+        # Shared-registry telemetry (observability/metrics.py): step/read
+        # timing + throughput counters, sampled once per fit so a disabled
+        # switch costs nothing in the loop. None of it syncs the device —
+        # step_seconds measures the host loop's dispatch pace.
+        om = _training_metrics()
         # on_fit_end must run even when a step raises (non-finite loss,
         # OOM, interrupt): listeners hold resources whose teardown
         # re-raises swallowed failures (async checkpoint writers).
@@ -601,7 +606,15 @@ class Trainer:
                     lst.on_epoch_start(epoch)
                 it = iter(data)
                 n = 0
-                for batch in it:
+                while True:
+                    t_read = time.perf_counter() if om is not None else 0.0
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    if om is not None:
+                        om.data_read_seconds.observe(
+                            time.perf_counter() - t_read)
                     batch = _as_batch_dict(batch)
                     if _fault_injector().enabled:
                         # "train.step_nan" poison-batch injection point
@@ -609,7 +622,10 @@ class Trainer:
                         # unless DL4J_TPU_FAULTS armed a plan
                         batch = _fault_injector().maybe_poison_batch(batch)
                     if self._batch_sharding is not None:
+                        if om is not None:
+                            _record_batch_transfer(batch)
                         batch = jax.device_put(batch, self._batch_sharding)
+                    t_step = time.perf_counter() if om is not None else 0.0
                     if getattr(self.net, "backprop_type", "standard") == "tbptt":
                         # ↔ TruncatedBPTT: every window is an iteration (the
                         # reference fires iterationDone once per window).
@@ -617,6 +633,11 @@ class Trainer:
                     else:
                         ts, metrics = self.train_step(ts, batch)
                         wmetrics = [metrics]
+                    if om is not None:
+                        om.step_seconds.observe(time.perf_counter() - t_step)
+                        om.steps_total.inc(len(wmetrics))
+                        feats = jax.tree_util.tree_leaves(batch["features"])
+                        om.samples_total.inc(feats[0].shape[0])
                     n += 1
                     for wm in wmetrics:
                         host_step += 1
@@ -630,6 +651,8 @@ class Trainer:
                 for lst in listeners:
                     if lst.on_epoch_end(epoch, ts):
                         stop = True
+                if om is not None:
+                    om.epochs_total.inc()
                 if hasattr(data, "reset"):
                     data.reset()
                 if stop:
@@ -638,6 +661,21 @@ class Trainer:
             for lst in listeners:
                 lst.on_fit_end(self, ts)
         return ts
+
+
+def _training_metrics():
+    """The shared-registry training bundle, or None when instrumentation
+    is globally disabled (bench.py's bare-vs-instrumented comparison)."""
+    from deeplearning4j_tpu.observability import metrics as _obsm
+
+    return _obsm.get_training_metrics() if _obsm.enabled() else None
+
+
+def _record_batch_transfer(batch):
+    from deeplearning4j_tpu.observability.runtime import record_transfer
+
+    record_transfer("h2d", sum(getattr(l, "nbytes", 0)
+                               for l in jax.tree_util.tree_leaves(batch)))
 
 
 from deeplearning4j_tpu.data.dataset import as_batch_dict as _as_batch_dict  # noqa: E402

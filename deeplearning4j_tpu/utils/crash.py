@@ -97,6 +97,15 @@ def write_crash_report(
     with open(path, "w") as fh:
         json.dump(report, fh, indent=2, default=str)
     _LAST_REPORT = path
+    try:
+        # shared-registry crash counter: dumps reach /metrics scrapers,
+        # not just the local filesystem (observability/metrics.py)
+        from deeplearning4j_tpu.observability import metrics as _obsm
+
+        if _obsm.enabled():
+            _obsm.get_resilience_metrics().crash_reports_total.inc()
+    except Exception:  # noqa: BLE001 - telemetry must never mask the crash
+        pass
     return path
 
 

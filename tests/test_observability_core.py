@@ -1,0 +1,598 @@
+"""Unified telemetry core tests (observability/): shared registry,
+tracing spans, runtime collectors, and the cross-layer wiring.
+
+Oracles:
+
+- a STRICT Prometheus exposition line-grammar parser (HELP/TYPE
+  ordering, escape-aware label parsing, cumulative ``le`` buckets,
+  ``_sum``/``_count`` consistency) round-trips the full ``/metrics``
+  document of a server whose process also trained, rolled back, and
+  checkpointed — the "one scrape tells the whole story" acceptance;
+- span JSONL ↔ Chrome-trace conversion is checked lossless on ids,
+  parent links (nesting), threads, and attrs;
+- a real loopback ``ServingClient.predict`` yields a correlation-ID-
+  linked span tree: client → request → admission / batch → dispatch.
+"""
+
+import json
+import math
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import metrics as om
+from deeplearning4j_tpu.observability import runtime as rt
+from deeplearning4j_tpu.observability import trace as tr
+
+
+@pytest.fixture()
+def fresh():
+    """A fresh default registry + empty tracer, restored after the test
+    (bundles re-create lazily, so other test files are unaffected)."""
+    reg = om.reset_default_registry()
+    tr.get_tracer().clear()
+    om.set_enabled(True)
+    tr.set_tracing_enabled(True)
+    yield reg
+    om.reset_default_registry()
+    tr.get_tracer().clear()
+    om.set_enabled(True)
+    tr.set_tracing_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# strict exposition parser (the test oracle)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" ([-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
+def _unescape_help(v: str) -> str:
+    return v.replace("\\n", "\n").replace("\\\\", "\\")
+
+
+def parse_exposition(text: str):
+    """Strict parser: every line must be a well-formed HELP, TYPE, or
+    sample; TYPE must directly follow its HELP; samples must belong to
+    the most recent family (no interleaving); histogram families must
+    have ascending ``le`` buckets, non-decreasing cumulative counts, and
+    ``_count`` equal to the ``+Inf`` bucket. Returns
+    {family: {"help", "type", "samples": [(name, labels_dict, value)]}}.
+    """
+    families, current, last_was_help = {}, None, False
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            assert _NAME_RE.match(name), f"bad family name {name!r}"
+            assert name not in families, f"family {name!r} repeated"
+            current = families[name] = {
+                "help": _unescape_help(help_text), "type": None,
+                "samples": []}
+            current["name"] = name
+            last_was_help = True
+        elif line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, kind = rest.partition(" ")
+            assert last_was_help and current and current["name"] == name, \
+                f"TYPE not directly after its HELP: {line!r}"
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            current["type"] = kind
+            last_was_help = False
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            last_was_help = False
+            sname, labels_raw, value = m.group(1), m.group(2), m.group(3)
+            assert current is not None, f"sample before any family: {line!r}"
+            fam = current["name"]
+            allowed = ({fam, fam + "_bucket", fam + "_sum", fam + "_count"}
+                       if current["type"] == "histogram" else {fam})
+            assert sname in allowed, \
+                f"sample {sname!r} interleaved into family {fam!r}"
+            labels = {k: _unescape(v)
+                      for k, v in _LABEL_RE.findall(labels_raw or "")}
+            current["samples"].append((sname, labels, float(value)))
+    for name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        series = {}
+        for sname, labels, value in fam["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            st = series.setdefault(key, {"buckets": [], "sum": None,
+                                         "count": None})
+            if sname == name + "_bucket":
+                le = labels["le"]
+                st["buckets"].append(
+                    (math.inf if le == "+Inf" else float(le), value))
+            elif sname == name + "_sum":
+                st["sum"] = value
+            elif sname == name + "_count":
+                st["count"] = value
+        for key, st in series.items():
+            les = [b[0] for b in st["buckets"]]
+            counts = [b[1] for b in st["buckets"]]
+            assert les == sorted(les) and les[-1] == math.inf, \
+                f"{name}{key}: le not ascending to +Inf: {les}"
+            assert counts == sorted(counts), \
+                f"{name}{key}: non-cumulative buckets {counts}"
+            assert st["count"] is not None and st["sum"] is not None, \
+                f"{name}{key}: missing _sum/_count"
+            assert counts[-1] == st["count"], \
+                f"{name}{key}: +Inf bucket {counts[-1]} != _count " \
+                f"{st['count']}"
+    return families
+
+
+# ---------------------------------------------------------------------------
+# registry core
+
+
+class TestRegistryCore:
+    def test_help_escaping_backslash_and_newline(self):
+        reg = om.MetricsRegistry()
+        help_text = 'line1\nline2 back\\slash "quoted"'
+        reg.counter("esc_total", help_text).inc()
+        text = reg.render_text()
+        assert ('# HELP esc_total line1\\nline2 back\\\\slash "quoted"'
+                in text.splitlines())
+        fams = parse_exposition(text)
+        assert fams["esc_total"]["help"] == help_text
+
+    def test_label_value_escaping(self):
+        reg = om.MetricsRegistry()
+        c = reg.counter("lbl_total", "labels", ("path",))
+        nasty = 'a\\b\n"c"'
+        c.inc(path=nasty)
+        fams = parse_exposition(reg.render_text())
+        (_, labels, value), = fams["lbl_total"]["samples"]
+        assert labels == {"path": nasty} and value == 1.0
+
+    def test_duplicate_name_rejected_with_clear_error(self):
+        reg = om.MetricsRegistry()
+        reg.counter("dup_total", "first")
+        with pytest.raises(ValueError, match="duplicate metric.*dup_total"):
+            reg.counter("dup_total", "second")
+        with pytest.raises(ValueError, match="duplicate"):
+            reg.gauge("dup_total", "as gauge")
+
+    def test_histogram_derived_names_reserved(self):
+        reg = om.MetricsRegistry()
+        reg.histogram("lat_seconds", "h")
+        # a counter that would collide with the histogram's sample lines
+        with pytest.raises(ValueError, match="lat_seconds_bucket"):
+            reg.counter("lat_seconds_bucket", "collides")
+        # ...and the reverse direction
+        reg2 = om.MetricsRegistry()
+        reg2.counter("lat_seconds_count", "first")
+        with pytest.raises(ValueError, match="lat_seconds_count"):
+            reg2.histogram("lat_seconds", "would expose _count")
+
+    def test_invalid_names_rejected(self):
+        reg = om.MetricsRegistry()
+        with pytest.raises(ValueError, match="invalid metric name"):
+            reg.counter("0bad", "x")
+        with pytest.raises(ValueError, match="invalid label name"):
+            reg.counter("ok_total", "x", ("bad-label",))
+
+    def test_namespace_prefix(self):
+        reg = om.MetricsRegistry()
+        c = reg.counter("steps_total", "x", namespace="train")
+        assert c.name == "train_steps_total"
+        assert "train_steps_total" in reg.names()
+
+    def test_histogram_grammar_and_sum_count(self):
+        reg = om.MetricsRegistry()
+        h = reg.histogram("h_seconds", "x", ("op",), buckets=(0.1, 1.0))
+        vals = [0.05, 0.5, 5.0, 0.07]
+        for v in vals:
+            h.observe(v, op="save")
+        h.observe(2.0, op="restore")
+        fams = parse_exposition(reg.render_text())
+        series = [(n, l, v) for n, l, v in fams["h_seconds"]["samples"]
+                  if l.get("op") == "save"]
+        count = [v for n, l, v in series if n == "h_seconds_count"][0]
+        total = [v for n, l, v in series if n == "h_seconds_sum"][0]
+        assert count == len(vals)
+        assert total == pytest.approx(sum(vals))
+
+    def test_non_finite_sample_values_render(self):
+        """NaN/±Inf are legal sample values: one bad observation must not
+        poison every future scrape of the shared registry."""
+        reg = om.MetricsRegistry()
+        g = reg.gauge("g_val", "x", ("k",))
+        g.set(float("nan"), k="a")
+        g.set(float("-inf"), k="b")
+        g.set(float("inf"), k="c")
+        h = reg.histogram("h_seconds", "x")
+        h.observe(float("inf"))
+        text = reg.render_text()  # must not raise
+        fams = parse_exposition(text)
+        vals = {l["k"]: v for _, l, v in fams["g_val"]["samples"]}
+        assert math.isnan(vals["a"])
+        assert vals["b"] == -math.inf and vals["c"] == math.inf
+        assert fams["h_seconds"]["samples"][-1][2] == 1  # _count intact
+
+    def test_render_multi_dedups_first_wins(self):
+        a, b = om.MetricsRegistry(), om.MetricsRegistry()
+        a.counter("shared_total", "from a").inc(2)
+        b.counter("shared_total", "from b").inc(5)
+        b.counter("only_b_total", "b only").inc()
+        fams = parse_exposition(om.render_text_multi([a, b]))
+        assert fams["shared_total"]["help"] == "from a"
+        assert fams["shared_total"]["samples"][0][2] == 2.0
+        assert "only_b_total" in fams
+
+    def test_serving_bundles_do_not_collide(self):
+        from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+        m1, m2 = ServingMetrics(), ServingMetrics()
+        m1.requests_total.inc(model="a", code="200")
+        assert m2.requests_total.value(model="a", code="200") == 0
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+class TestSpans:
+    def test_nesting_is_thread_local(self, fresh):
+        with tr.span("outer") as s1:
+            assert tr.current_span() is s1
+            with tr.span("inner") as s2:
+                assert s2.parent_id == s1.span_id
+                assert s2.trace_id == s1.trace_id
+        assert tr.current_span() is None
+        spans = tr.get_tracer().spans(trace_id=s1.trace_id)
+        assert {s.name for s in spans} == {"outer", "inner"}
+
+    def test_exception_recorded_and_span_closed(self, fresh):
+        with pytest.raises(RuntimeError):
+            with tr.span("boom") as s:
+                raise RuntimeError("x")
+        assert tr.current_span() is None
+        assert s.attrs["error"] == "RuntimeError"
+        assert s.end >= s.start
+
+    def test_disabled_tracing_yields_none_and_records_nothing(self, fresh):
+        tr.set_tracing_enabled(False)
+        with tr.span("off") as s:
+            assert s is None
+        assert tr.get_tracer().spans() == []
+
+    def _tree(self):
+        """A two-thread span tree with attrs — the lossless fixture."""
+        cid = tr.new_id()
+        root = tr.record_span("client", start=1.0, end=2.0, trace_id=cid,
+                              thread="main", model="m")
+        req = tr.record_span("request", start=1.1, end=1.9, trace_id=cid,
+                             parent_id=root.span_id, thread="main",
+                             status=200)
+        tr.record_span("dispatch", start=1.2, end=1.8, trace_id=cid,
+                       parent_id=req.span_id, thread="worker-0",
+                       rows=3, device="cpu:0")
+        return cid
+
+    @staticmethod
+    def _key(s):
+        return (s.name, s.trace_id, s.span_id, s.parent_id, s.thread,
+                tuple(sorted(s.attrs.items())))
+
+    def test_jsonl_chrome_round_trip_lossless(self, fresh, tmp_path):
+        cid = self._tree()
+        path = str(tmp_path / "spans.jsonl")
+        assert tr.get_tracer().export_jsonl(path, trace_id=cid) == 3
+        loaded = tr.load_jsonl(path)
+        orig = {self._key(s) for s in tr.get_tracer().spans(cid)}
+        assert {self._key(s) for s in loaded} == orig
+
+        chrome = tr.to_chrome_trace(loaded)
+        # a foreign XLA-style event (no span_id) must be skipped on parse
+        chrome["traceEvents"].append(
+            {"ph": "X", "name": "fusion.1", "ts": 0, "dur": 5, "pid": 2,
+             "tid": 9, "args": {}})
+        back = tr.from_chrome_trace(chrome)
+        assert {self._key(s) for s in back} == orig
+        # nesting (parent links) reconstructs the same tree
+        by_parent = {}
+        for s in back:
+            by_parent.setdefault(s.parent_id, []).append(s.name)
+        assert by_parent[None] == ["client"]
+        # chrome file is valid trace JSON with thread_name metadata
+        names = {ev["args"]["name"] for ev in chrome["traceEvents"]
+                 if ev.get("ph") == "M"}
+        assert {"main", "worker-0"} <= names
+
+    def test_reserved_name_attrs_survive_round_trip(self, fresh):
+        """A user attr named span_id/trace_id/parent_id must not clobber
+        the span's identity in the Chrome-trace round trip."""
+        s = tr.Span("load", trace_id=tr.new_id(), span_id=tr.new_id(),
+                    start=1.0, end=2.0, thread="main",
+                    attrs={"span_id": "shard-3", "trace_id": "t",
+                           "parent_id": "p"})
+        back, = tr.from_chrome_trace(tr.to_chrome_trace([s]))
+        assert back.span_id == s.span_id
+        assert back.trace_id == s.trace_id
+        assert back.parent_id is None
+        assert back.attrs == s.attrs
+
+    def test_write_chrome_trace_file(self, fresh, tmp_path):
+        cid = self._tree()
+        path = str(tmp_path / "trace.json")
+        tr.write_chrome_trace(path, tr.get_tracer().spans(cid))
+        trace = json.loads(open(path).read())
+        assert any(ev.get("ph") == "X" for ev in trace["traceEvents"])
+
+    def test_correlation_id_links_client_to_dispatch(self, fresh):
+        from deeplearning4j_tpu.serving import (
+            ModelRegistry,
+            ModelServer,
+            ServingClient,
+            spec,
+        )
+
+        registry = ModelRegistry()
+        registry.register(
+            "scale", lambda v, x: x * v["s"], {"s": np.float32(2.0)},
+            input_spec=spec((4,)), mode="batched", max_batch_size=8)
+        server = ModelServer(registry, port=0).start(warm=True)
+        try:
+            client = ServingClient(server.url)
+            cid = tr.new_id()
+            client.predict("scale", np.ones((2, 4), np.float32),
+                           correlation_id=cid)
+            spans = {s.name: s for s in tr.get_tracer().spans(trace_id=cid)}
+            need = {"client.request", "serving.request",
+                    "serving.admission", "serving.batch",
+                    "serving.dispatch"}
+            assert need <= set(spans), sorted(spans)
+            cli, req = spans["client.request"], spans["serving.request"]
+            assert req.parent_id == cli.span_id
+            assert spans["serving.admission"].parent_id == req.span_id
+            assert spans["serving.batch"].parent_id == req.span_id
+            assert (spans["serving.dispatch"].parent_id
+                    == spans["serving.batch"].span_id)
+            assert req.attrs["status"] == 200
+            assert all(s.trace_id == cid for s in spans.values())
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# runtime collectors
+
+
+class TestRuntimeCollector:
+    def test_collect_populates_live_array_gauges(self, fresh):
+        c = rt.RuntimeCollector(om.MetricsRegistry())
+        keep = jax.numpy.ones((128,))  # noqa: F841 - held live on purpose
+        c.collect()
+        assert c.live_arrays.value() >= 1
+        assert c.live_array_bytes.value() >= keep.nbytes
+        assert c.collections_total.value() == 1
+
+    def test_compile_events_counted(self, fresh):
+        c = rt.get_runtime_collector()
+        before = c.jit_compiles_total.value()
+        marker = float(np.random.default_rng(0).normal())  # unique closure
+        jax.jit(lambda x: x * marker + 1.0)(jax.numpy.ones((3,)))
+        assert c.jit_compiles_total.value() >= before + 1
+        assert (c.jit_compile_seconds.summary()["count"]
+                >= before + 1)
+
+    def test_record_transfer(self, fresh):
+        c = rt.RuntimeCollector(om.MetricsRegistry())
+        c.record_transfer("h2d", 1024)
+        c.record_transfer("h2d", 1024)
+        c.record_transfer("d2h", 10)
+        assert c.transfers_total.value(direction="h2d") == 2
+        assert c.transfer_bytes_total.value(direction="h2d") == 2048
+        with pytest.raises(ValueError, match="h2d|d2h"):
+            c.record_transfer("sideways", 1)
+
+    def test_background_sampling_thread(self, fresh):
+        import time as _time
+
+        c = rt.RuntimeCollector(om.MetricsRegistry())
+        c.start(interval_s=0.01)
+        deadline = _time.monotonic() + 5.0
+        while (c.collections_total.value() < 2
+               and _time.monotonic() < deadline):
+            _time.sleep(0.01)
+        c.stop()
+        assert c.collections_total.value() >= 2
+
+    def test_collect_honors_kill_switch(self, fresh):
+        c = rt.RuntimeCollector(om.MetricsRegistry())
+        om.set_enabled(False)
+        c.collect()
+        om.set_enabled(True)
+        assert c.collections_total.value() == 0
+
+
+# ---------------------------------------------------------------------------
+# instrumented hot paths feed the one registry
+
+
+def _mlp(seed=0):
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    return SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(updater=Sgd(0.05), seed=seed),
+        layers=[Dense(units=16, activation="tanh"),
+                OutputLayer(units=2, activation="softmax", loss="mcxent")],
+        input_shape=(8,),
+    ))
+
+
+def _iterator(n=64, batch=16):
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(n, 8)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 0).astype(int)]
+    return ArrayDataSetIterator(x, y, batch_size=batch, shuffle=False)
+
+
+class TestHotPathInstrumentation:
+    def test_trainer_fit_feeds_registry(self, fresh):
+        from deeplearning4j_tpu.train.trainer import Trainer
+
+        tr_ = Trainer(_mlp())
+        tr_.fit(tr_.init_state(), _iterator(64, 16), epochs=2)
+        tm = om.get_training_metrics()
+        assert tm.steps_total.value() == 8
+        assert tm.samples_total.value() == 128
+        assert tm.epochs_total.value() == 2
+        assert tm.step_seconds.summary()["count"] == 8
+        assert tm.data_read_seconds.summary()["count"] >= 8
+
+    def test_disabled_instrumentation_records_nothing(self, fresh):
+        from deeplearning4j_tpu.train.trainer import Trainer
+
+        om.set_enabled(False)
+        tr_ = Trainer(_mlp())
+        tr_.fit(tr_.init_state(), _iterator(32, 16), epochs=1)
+        om.set_enabled(True)
+        assert om.get_training_metrics().steps_total.value() == 0
+
+    def test_checkpoint_ops_observed(self, fresh, tmp_path):
+        from deeplearning4j_tpu.serde.checkpoint import (
+            load_state_tree,
+            quarantine_checkpoint,
+            save_state_tree,
+            verify_checkpoint,
+        )
+
+        tree = {"w": np.ones((32,), np.float32)}
+        d = tmp_path / "snap"
+        save_state_tree(d, tree)
+        ok, _ = verify_checkpoint(d, deep=True)
+        assert ok
+        load_state_tree(d, tree)
+        cm = om.get_checkpoint_metrics()
+        for op in ("save", "verify", "restore"):
+            assert cm.op_seconds.summary(op=op)["count"] >= 1, op
+        assert quarantine_checkpoint(d, reason="test") is not None
+        assert cm.quarantined_total.value() == 1
+
+    def test_crash_report_counted(self, fresh, tmp_path):
+        from deeplearning4j_tpu.utils.crash import write_crash_report
+
+        write_crash_report(str(tmp_path), exception=ValueError("boom"))
+        assert om.get_resilience_metrics().crash_reports_total.value() == 1
+
+    def test_data_retry_counted(self, fresh):
+        from deeplearning4j_tpu.resilience.retry import retrying
+
+        class Flaky:
+            def __init__(self):
+                self.fails = 1
+
+            def __iter__(self):
+                for i in range(4):
+                    if i == 2 and self.fails:
+                        self.fails -= 1
+                        raise IOError("transient")
+                    yield i
+
+        assert list(retrying(Flaky(), max_retries=3, base_delay=0.0,
+                             max_delay=0.0)) == [0, 1, 2, 3]
+        assert om.get_resilience_metrics().data_retries_total.value() == 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scrape: serving + training + resilience + runtime in ONE
+# document from one server
+
+
+class TestWholeStoryScrape:
+    def test_single_scrape_tells_whole_story(self, fresh, tmp_path):
+        from deeplearning4j_tpu.resilience import (
+            FaultInjector,
+            FaultTolerantTrainer,
+            RecoveryPolicy,
+            set_fault_injector,
+        )
+        from deeplearning4j_tpu.serving import (
+            ModelRegistry,
+            ModelServer,
+            ServingClient,
+            spec,
+        )
+        from deeplearning4j_tpu.train.trainer import Trainer
+        from deeplearning4j_tpu.utils.crash import write_crash_report
+
+        # a FaultTolerantTrainer run that hits one poison batch: rollback
+        # + verified checkpoints + (via the injector) a resilience story
+        set_fault_injector(FaultInjector().plan("train.step_nan", at=3))
+        try:
+            trainer = Trainer(_mlp())
+            ft = FaultTolerantTrainer(
+                trainer, tmp_path / "ckpt",
+                policy=RecoveryPolicy(checkpoint_every=2, max_rollbacks=5))
+            ft.fit(trainer.init_state(), _iterator(64, 16), epochs=1)
+            assert any(r["kind"] == "rollback" for r in ft.recoveries)
+        finally:
+            set_fault_injector(None)
+        write_crash_report(str(tmp_path), exception=RuntimeError("post"))
+        rt.get_runtime_collector().collect()
+
+        registry = ModelRegistry()
+        registry.register(
+            "scale", lambda v, x: x * v["s"], {"s": np.float32(3.0)},
+            input_spec=spec((4,)), mode="batched", max_batch_size=8)
+        server = ModelServer(registry, port=0).start(warm=True)
+        try:
+            client = ServingClient(server.url)
+            client.predict("scale", np.ones((2, 4), np.float32))
+            text = client.metrics_text()
+        finally:
+            server.stop()
+
+        fams = parse_exposition(text)  # strict grammar over EVERYTHING
+        # serving series
+        assert "serving_requests_total" in fams
+        assert "serving_queue_depth" in fams
+        # training series (fed by the FaultTolerantTrainer loop)
+        steps = fams["train_steps_total"]["samples"][0][2]
+        assert steps >= 4
+        assert "train_step_seconds" in fams
+        # resilience series
+        rb = fams["resilience_rollbacks_total"]["samples"][0][2]
+        assert rb >= 1
+        crash = fams["resilience_crash_reports_total"]["samples"][0][2]
+        assert crash == 1
+        # checkpoint series: the recovery run saved, verified, restored
+        ops = {l.get("op") for n, l, v
+               in fams["checkpoint_op_seconds"]["samples"]}
+        assert {"save", "verify", "restore"} <= ops
+        # runtime collector series
+        assert "runtime_live_arrays" in fams
+        assert "runtime_transfer_bytes_total" in fams
+        # JSON twin carries the same superset
+        names = {m["name"] for m in om.render_json_multi(
+            [server.metrics.registry, om.default_registry()])["metrics"]}
+        assert {"serving_requests_total", "train_steps_total",
+                "resilience_rollbacks_total",
+                "runtime_live_arrays"} <= names
