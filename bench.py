@@ -867,7 +867,7 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
                         span_n=5000, series=1000):
     """Telemetry-layer self-cost benchmark (observability/): the cost of
     the instrumentation itself, so the layer that watches regressions
-    cannot silently become one. Three numbers:
+    cannot silently become one. Four numbers:
 
     - instrumented vs BARE ``Trainer.fit`` step time (the global
       ``set_enabled``/``set_tracing_enabled`` switches toggle the same
@@ -878,6 +878,12 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
       host work, so the honest denominators are ms-scale steps; the
       absolute cost is reported too (``overhead_us_per_step``) so
       sub-ms-step models can budget it;
+    - the DIAGNOSTICS-plane increment, gated < 2%
+      (``diag_overhead_pct``): the flight recorder's in-loop cost (same
+      instrumented fit with recording on, vs off) PLUS the SLO
+      evaluator's tick cost amortized at its production 10 s cadence —
+      the layer that answers "is this healthy?" must not itself make it
+      unhealthy;
     - span enter/exit cost (``with span(...)``) in µs;
     - registry render latency with ``series`` live counter series plus a
       populated histogram (the /metrics scrape cost at 1k-series scale).
@@ -893,8 +899,10 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
     )
     from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
     from deeplearning4j_tpu.nn.model import SequentialModel
-    from deeplearning4j_tpu.observability import metrics as om
+    from deeplearning4j_tpu.observability import flightrecorder as fr
+    from deeplearning4j_tpu.observability import slo
     from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.observability import metrics as om
     from deeplearning4j_tpu.observability.trace import (
         set_tracing_enabled,
         span,
@@ -916,9 +924,10 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
     y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, batch_size * steps)]
     data = ArrayDataSetIterator(x, y, batch_size=batch_size, shuffle=False)
 
-    def timed_fit(instrumented: bool) -> float:
+    def timed_fit(instrumented: bool, recorder: bool = False) -> float:
         om.set_enabled(instrumented)
         set_tracing_enabled(instrumented)
+        fr.set_recording(recorder)
         ts = trainer.init_state()
         t0 = time.perf_counter()
         ts = trainer.fit(ts, data, epochs=1)
@@ -927,14 +936,76 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
         float(jax.device_get(leaf.ravel()[0]))
         return time.perf_counter() - t0
 
+    # the diagnostics plane under test: an evaluator over the train
+    # families the instrumented fit feeds (ticked manually below so its
+    # cost is measured, not sampled)
+    engine = slo.HealthEngine(
+        [slo.SLORule(
+            name="bench-step-latency", kind="latency", objective=0.9,
+            threshold_s=1.0,
+            histogram=slo.Selector("train_step_seconds"),
+            windows=(slo.BurnWindow(60.0, 300.0, 2.0),),
+            for_s=30.0, resolve_hold_s=30.0)],
+        interval_s=10.0, snapshot_every_s=1.0)
+
     try:
         timed_fit(True)  # compile + warm the jit cache outside any window
-        bare, instr = [], []
-        for _ in range(3):  # interleaved min-of-3: host jitter sheds
+        # Drain EVERY in-flight background step-cost analysis BEFORE any
+        # timed window — ours from the warmup fit, and any left running
+        # by configs that ran earlier in this process (bench_resilience's
+        # FaultTolerantTrainers each spawn one): a compile thread stealing
+        # CPU mid-window reads as instrumentation overhead that isn't.
+        from deeplearning4j_tpu.train import trainer as _trainer_mod
+
+        for th in list(_trainer_mod._COST_THREADS):
+            th.join(timeout=30)
+        t_wait = time.perf_counter()
+        while any(v == "pending"
+                  for v in trainer._step_cost_cache.values()) and \
+                time.perf_counter() - t_wait < 30:
+            time.sleep(0.02)
+        # Interleaved rounds, all three variants per round: host-load
+        # drift (CPU scaling, noisy neighbors) hits every variant alike
+        # instead of biasing whichever phase ran last. MEDIAN of rounds,
+        # not min: with ~50 ms windows a single unusually-clean round on
+        # one variant swings a min-based ratio by several percent. The
+        # diag windows price the flight recorder IN the loop; the
+        # evaluator is priced separately below (a tick every interval_s
+        # regardless of step count — landing 0-or-1 ticks in a short
+        # window would read as quantization noise, not cost).
+        import statistics
+
+        bare, instr, diag = [], [], []
+        for _ in range(9):
             bare.append(timed_fit(False))
             instr.append(timed_fit(True))
-        bare_s, instr_s = min(bare), min(instr)
-        overhead_pct = (instr_s - bare_s) / bare_s * 100.0
+            diag.append(timed_fit(True, recorder=True))
+        # PAIRED differences per round, then the median across rounds:
+        # this host's load drifts ±10% between rounds, which swamps an
+        # unpaired median-vs-median ratio; within one ~0.5 s round the
+        # three variants see the same machine, so their differences
+        # isolate the instrumentation.
+        bare_s = statistics.median(bare)
+        instr_s = statistics.median(instr)
+        diag_s = statistics.median(diag)
+        d_instr = statistics.median(
+            i - b for b, i in zip(bare, instr))
+        d_diag = statistics.median(
+            d - i for i, d in zip(instr, diag))
+        overhead_pct = d_instr / bare_s * 100.0
+        recorder_pct = d_diag / instr_s * 100.0
+
+        # evaluator tick cost on the LIVE (possibly large) registry state,
+        # amortized at the production default cadence (10 s): the thread
+        # wakes once per interval whatever the step rate, so its honest
+        # per-step price is tick_seconds / interval_seconds.
+        engine.tick()  # warm lazy bundles outside the timed loop
+        t0 = time.perf_counter()
+        for _ in range(50):
+            engine.tick()
+        tick_s = (time.perf_counter() - t0) / 50
+        evaluator_pct = tick_s / 10.0 * 100.0
+        diag_overhead_pct = recorder_pct + evaluator_pct
 
         set_tracing_enabled(True)
         t0 = time.perf_counter()
@@ -961,15 +1032,23 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
             "steps": steps, "batch": batch_size,
             "bare_step_ms": round(bare_s / steps * 1e3, 4),
             "instrumented_step_ms": round(instr_s / steps * 1e3, 4),
+            "diagnostics_step_ms": round(diag_s / steps * 1e3, 4),
             "overhead_pct": round(overhead_pct, 2),
-            "overhead_us_per_step": round(
-                (instr_s - bare_s) / steps * 1e6, 2),
+            "overhead_us_per_step": round(d_instr / steps * 1e6, 2),
+            "diag_overhead_pct": round(diag_overhead_pct, 2),
+            "recorder_pct": round(recorder_pct, 2),
+            "recorder_us_per_step": round(d_diag / steps * 1e6, 2),
+            "evaluator_tick_ms": round(tick_s * 1e3, 3),
+            "evaluator_pct_at_10s": round(evaluator_pct, 4),
             "span_enter_exit_us": round(span_us, 2),
             "render_series": series,
             "render_ms": round(min(t_render) * 1e3, 3),
             "render_bytes": len(text),
-            # integrity gate: the telemetry layer's own cost stays < 5%
-            "converged": bool(overhead_pct < 5.0),
+            # integrity gates: the telemetry layer's own cost stays < 5%,
+            # and the diagnostics plane (evaluator + flight recorder)
+            # adds < 2% on the already-instrumented step
+            "converged": bool(overhead_pct < 5.0
+                              and diag_overhead_pct < 2.0),
             "unit": "% instrumented step-time overhead",
         }
         info["value"] = round(max(overhead_pct, 0.0), 3)
@@ -977,6 +1056,7 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
     finally:
         om.set_enabled(True)
         set_tracing_enabled(True)
+        fr.set_recording(True)
 
 
 _CONFIGS = {
@@ -1030,7 +1110,11 @@ _CPU_INTEGRITY = {
     # fault-free step count
     "resilience": dict(sizes_mb=(1,), repeats=1, epochs=1),
     # observability reports "converged" = instrumentation overhead < 5%
-    "observability": dict(steps=24, batch_size=128, hidden=512,
+    # AND diagnostics (evaluator + recorder) increment < 2%; 96 steps of
+    # a ~2 ms step: this host's run-to-run jitter is ±30 µs/step, so
+    # shorter/lighter windows read noise as overhead against the
+    # ~35 µs/step instrumentation cost the gates actually police
+    "observability": dict(steps=96, batch_size=128, hidden=1024,
                           span_n=500, series=128),
 }
 

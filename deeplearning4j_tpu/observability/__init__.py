@@ -17,8 +17,27 @@ ProfilingListener family as ONE spine instead of per-layer silos).
 turn the hot-path instrumentation off; ``bench.py observability``
 measures its cost (instrumented vs bare step time, span enter/exit,
 registry render latency).
+
+The diagnostics plane consumes the spine (PR 4):
+
+- ``slo``: declarative SLO rules + multi-window burn-rate alerting over
+  the registries' counters/histograms, an ok→pending→firing→resolved
+  alert state machine per rule, a background :class:`HealthEngine`
+  evaluator, and a ``--check`` CLI for offline rule validation;
+- ``flightrecorder``: the black-box ring of structured events every
+  layer feeds (train steps, sheds, rollbacks, quarantines, fault
+  injections, alert transitions) — dumped into every crash report and
+  served at ``GET /debug/flightrecorder``.
 """
 
+from deeplearning4j_tpu.observability.flightrecorder import (
+    FlightRecorder,
+    get_flight_recorder,
+    record_event,
+    recording_enabled,
+    set_flight_recorder,
+    set_recording,
+)
 from deeplearning4j_tpu.observability.metrics import (
     COMPILE_BUCKETS,
     DEFAULT_LATENCY_BUCKETS,
@@ -45,6 +64,20 @@ from deeplearning4j_tpu.observability.runtime import (
     get_runtime_collector,
     record_transfer,
 )
+from deeplearning4j_tpu.observability.slo import (
+    DEFAULT_WINDOWS,
+    BurnWindow,
+    HealthEngine,
+    Selector,
+    SLOMetrics,
+    SLORule,
+    default_serving_rules,
+    get_default_engine,
+    get_slo_metrics,
+    load_rules,
+    set_default_engine,
+    validate_rules_doc,
+)
 from deeplearning4j_tpu.observability.trace import (
     Span,
     Tracer,
@@ -64,37 +97,55 @@ from deeplearning4j_tpu.observability.trace import (
 __all__ = [
     "COMPILE_BUCKETS",
     "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_WINDOWS",
     "OCCUPANCY_BUCKETS",
+    "BurnWindow",
     "CheckpointMetrics",
     "Counter",
+    "FlightRecorder",
     "Gauge",
+    "HealthEngine",
     "Histogram",
     "MetricsRegistry",
     "ResilienceMetrics",
     "RuntimeCollector",
+    "SLOMetrics",
+    "SLORule",
+    "Selector",
     "Span",
     "Tracer",
     "TrainingMetrics",
     "current_span",
     "default_registry",
+    "default_serving_rules",
     "enabled",
     "from_chrome_trace",
     "get_checkpoint_metrics",
+    "get_default_engine",
+    "get_flight_recorder",
     "get_resilience_metrics",
     "get_runtime_collector",
+    "get_slo_metrics",
     "get_tracer",
     "get_training_metrics",
     "load_jsonl",
+    "load_rules",
     "new_id",
+    "record_event",
     "record_span",
     "record_transfer",
+    "recording_enabled",
     "render_json_multi",
     "render_text_multi",
     "reset_default_registry",
+    "set_default_engine",
     "set_enabled",
+    "set_flight_recorder",
+    "set_recording",
     "set_tracing_enabled",
     "span",
     "to_chrome_trace",
     "tracing_enabled",
+    "validate_rules_doc",
     "write_chrome_trace",
 ]

@@ -1,0 +1,171 @@
+"""Black-box flight recorder: the last N seconds of *events*, always on.
+
+The metrics registry answers "how much", spans answer "where did this
+request's time go" — neither answers the post-mortem question "what
+HAPPENED in the 30 seconds before the crash?". This module is the
+aviation-style answer: a bounded, thread-safe ring of structured events
+that every layer feeds continuously (train steps, admissions/sheds,
+rollbacks, checkpoint verify/quarantine, fault injections, SLO alert
+transitions) plus periodic compact registry snapshots, so the timeline
+around any incident is reconstructable from the ring alone.
+
+Consumers:
+
+- ``utils/crash.py`` attaches ``dump()`` to every crash report — a crash
+  dump ships its own timeline;
+- ``ModelServer`` serves ``GET /debug/flightrecorder`` — the live ring
+  over HTTP;
+- tests assert on event sequences instead of scraping logs.
+
+Cost discipline: ``record_event`` is one dict build + deque append under
+a lock (~1 µs); producers on hot paths additionally gate on
+``metrics.enabled()`` like every other instrument. ``set_recording(False)``
+is the recorder's own kill switch so ``bench.py observability`` can
+price the recorder separately from the rest of the telemetry.
+
+Stdlib only; safe to import from any layer (imports nothing but
+``observability.metrics`` lazily, for snapshots).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional
+
+DEFAULT_CAPACITY = 4096
+# cap on distinct series a registry snapshot event may carry — a snapshot
+# must stay one compact ring entry, not a full scrape
+SNAPSHOT_SERIES_CAP = 256
+
+
+class FlightRecorder:
+    """Bounded ring of ``{"t", "kind", "data"}`` events, oldest evicted.
+
+    ``data`` is nested (never merged into the envelope) so producer keys
+    can never clobber ``t``/``kind``. Eviction is counted
+    (``dropped_total``) — a dump that lost history says so.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self._dropped = 0
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, /, **data) -> dict:
+        """Append one event; returns it (already enveloped). ``kind`` is
+        positional-only so a producer may carry ``kind``/``t`` keys in
+        its data payload."""
+        ev = {"t": time.time(), "kind": kind, "data": data}
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self._dropped += 1
+            self._events.append(ev)
+        return ev
+
+    @property
+    def dropped_total(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def events(self, *, last_seconds: Optional[float] = None,
+               kinds: Optional[Iterable[str]] = None) -> List[dict]:
+        """Snapshot of the ring, oldest first, optionally windowed to the
+        trailing ``last_seconds`` and filtered to ``kinds``."""
+        with self._lock:
+            snap = list(self._events)
+        if last_seconds is not None:
+            cutoff = time.time() - last_seconds
+            snap = [e for e in snap if e["t"] >= cutoff]
+        if kinds is not None:
+            want = set(kinds)
+            snap = [e for e in snap if e["kind"] in want]
+        return snap
+
+    def dump(self, last_seconds: Optional[float] = None,
+             kinds: Optional[Iterable[str]] = None) -> dict:
+        """The black-box dump: JSON-serializable, self-describing."""
+        evs = self.events(last_seconds=last_seconds, kinds=kinds)
+        return {
+            "capacity": self.capacity,
+            "dropped_total": self.dropped_total,
+            "window_seconds": last_seconds,
+            "count": len(evs),
+            "events": evs,
+        }
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    # -- periodic registry snapshots ----------------------------------------
+
+    def snapshot_registries(self, registries=None) -> dict:
+        """Record one compact ``metrics.snapshot`` event: every counter /
+        gauge family summed over its label sets (histograms contribute
+        their ``_count``). The SLO evaluator calls this each tick, so the
+        ring carries a coarse metric timeline between discrete events."""
+        from deeplearning4j_tpu.observability import metrics as _m
+
+        if registries is None:
+            registries = [_m.default_registry()]
+        series: Dict[str, float] = {}
+        for reg in registries:
+            for inst in reg.instruments():
+                if len(series) >= SNAPSHOT_SERIES_CAP:
+                    break
+                doc = inst.to_json()
+                if doc["type"] in ("counter", "gauge"):
+                    series[doc["name"]] = float(
+                        sum(s["value"] for s in doc["samples"]))
+                elif doc["type"] == "histogram":
+                    series[doc["name"] + "_count"] = float(
+                        sum(s["count"] for s in doc["samples"]))
+        return self.record("metrics.snapshot", series=series,
+                           truncated=len(series) >= SNAPSHOT_SERIES_CAP)
+
+
+# -- process-global recorder --------------------------------------------------
+
+_RECORDER = FlightRecorder()
+_RECORDING = True
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-global ring every built-in producer feeds."""
+    return _RECORDER
+
+
+def set_flight_recorder(rec: Optional[FlightRecorder]) -> FlightRecorder:
+    """Swap the global recorder (tests); None installs a fresh ring."""
+    global _RECORDER
+    _RECORDER = rec if rec is not None else FlightRecorder()
+    return _RECORDER
+
+
+def set_recording(flag: bool):
+    """Recorder kill switch (independent of ``metrics.set_enabled`` so the
+    bench can price the recorder alone)."""
+    global _RECORDING
+    _RECORDING = bool(flag)
+
+
+def recording_enabled() -> bool:
+    return _RECORDING
+
+
+def record_event(kind: str, /, **data) -> Optional[dict]:
+    """The one-liner producers call; no-op (returns None) when recording
+    is switched off."""
+    if not _RECORDING:
+        return None
+    return _RECORDER.record(kind, **data)

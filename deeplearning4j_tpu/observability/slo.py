@@ -1,0 +1,794 @@
+"""SLO / burn-rate health engine: the layer that turns counters into
+"is this server healthy?".
+
+PR 3 gave every layer one telemetry spine; nothing consumed it. This
+module evaluates *declarative SLO rules* against the registries' live
+counters and histogram buckets and answers with an alert state per rule:
+
+- **availability** rules: a good-events ratio objective (e.g. 99.9% of
+  ``serving_requests_total`` not 429/5xx);
+- **latency** rules: a quantile objective expressed through histogram
+  buckets (e.g. 99% of ``serving_request_latency_seconds`` ≤ 0.25 s —
+  the threshold snaps to the nearest bucket bound at or above it).
+
+Alerting is classic multi-window burn rate (the SRE-workbook recipe):
+with error budget ``1 - objective``, the burn rate over a window is
+``error_rate / budget``; a rule *breaches* when BOTH the short and long
+window of any configured pair burn faster than the pair's threshold
+(fast 5m/1h at 14.4x and slow 30m/6h at 6x by default). Short windows
+make alerts resolve quickly; long windows stop one blip from paging.
+
+Each rule runs an :class:`AlertState` machine —
+``ok → pending → firing → resolved → ok`` — driven by a background
+evaluator thread (:class:`HealthEngine`), with every transition counted
+in the ``slo_*`` metric family and recorded to the flight recorder
+(``slo.transition`` events), so the post-mortem timeline contains the
+alert history alongside the faults that caused it.
+
+``time_scale`` multiplies every rule duration (windows, for/hold), so
+the same production rule file runs in CI at milliseconds-scale windows.
+
+CLI: ``python -m deeplearning4j_tpu.observability.slo --check rules.json``
+validates a rule file offline (unknown metric names, malformed
+objectives, overlapping windows) and exits non-zero on problems.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import re
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+    record_event,
+)
+
+# -- alert states -------------------------------------------------------------
+
+STATE_OK = "ok"
+STATE_PENDING = "pending"
+STATE_FIRING = "firing"
+STATE_RESOLVED = "resolved"
+_STATE_NUM = {STATE_OK: 0, STATE_PENDING: 1, STATE_FIRING: 2,
+              STATE_RESOLVED: 3}
+
+
+# -- rule model ---------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnWindow:
+    """One (short, long) burn-rate window pair; the rule breaches when
+    both windows burn at >= ``burn`` times the error-budget rate."""
+
+    short_s: float
+    long_s: float
+    burn: float
+
+    def label(self) -> str:
+        return f"{_dur(self.short_s)}/{_dur(self.long_s)}"
+
+
+# The SRE-workbook page-worthy defaults: 14.4x over 5m/1h (2% of a
+# 30-day budget in one hour) and 6x over 30m/6h.
+DEFAULT_WINDOWS = (BurnWindow(300.0, 3600.0, 14.4),
+                   BurnWindow(1800.0, 21600.0, 6.0))
+
+
+@dataclasses.dataclass(frozen=True)
+class Selector:
+    """Which samples of a metric family a rule reads: the family name
+    plus optional per-label regex filters (fullmatch semantics)."""
+
+    metric: str
+    match: Tuple[Tuple[str, str], ...] = ()
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for key, pattern in self.match:
+            if not re.fullmatch(pattern, str(labels.get(key, ""))):
+                return False
+        return True
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Selector":
+        match = tuple(sorted((k, v) for k, v in (d.get("match") or {}).items()))
+        return cls(metric=d["metric"], match=match)
+
+    def to_json(self) -> dict:
+        out: dict = {"metric": self.metric}
+        if self.match:
+            out["match"] = dict(self.match)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SLORule:
+    """One declarative objective.
+
+    ``kind="availability"``: ``objective`` is the target good ratio;
+    ``total``/``bad`` select counter samples. ``kind="latency"``:
+    ``objective`` is the quantile, ``threshold_s`` the bound it must
+    stay under, ``histogram`` the latency family.
+
+    Durations (``windows``, ``for_s``, ``resolve_hold_s``) are canonical
+    production values; the engine's ``time_scale`` shrinks them for
+    tests, so the same rule file ships everywhere.
+    """
+
+    name: str
+    kind: str                    # "availability" | "latency"
+    objective: float
+    total: Optional[Selector] = None
+    bad: Optional[Selector] = None
+    histogram: Optional[Selector] = None
+    threshold_s: Optional[float] = None
+    windows: Tuple[BurnWindow, ...] = DEFAULT_WINDOWS
+    for_s: float = 120.0         # breach must hold this long before firing
+    resolve_hold_s: float = 300.0  # resolved lingers this long before ok
+
+    @property
+    def error_budget(self) -> float:
+        return 1.0 - self.objective
+
+    def metric_names(self) -> List[str]:
+        out = []
+        for sel in (self.total, self.bad, self.histogram):
+            if sel is not None:
+                out.append(sel.metric)
+        return out
+
+    def to_json(self) -> dict:
+        out: dict = {"name": self.name, "kind": self.kind,
+                     "objective": self.objective,
+                     "windows": [dataclasses.asdict(w) for w in self.windows],
+                     "for_s": self.for_s,
+                     "resolve_hold_s": self.resolve_hold_s}
+        if self.kind == "availability":
+            out["total"] = self.total.to_json()
+            out["bad"] = self.bad.to_json()
+        else:
+            out["histogram"] = self.histogram.to_json()
+            out["threshold_s"] = self.threshold_s
+        return out
+
+
+def _dur(seconds: float) -> str:
+    if seconds >= 3600 and seconds % 3600 == 0:
+        return f"{int(seconds // 3600)}h"
+    if seconds >= 60 and seconds % 60 == 0:
+        return f"{int(seconds // 60)}m"
+    return f"{seconds:g}s"
+
+
+# -- rule parsing + validation ------------------------------------------------
+
+_ALLOWED_RULE_KEYS = {"name", "kind", "objective", "total", "bad",
+                      "histogram", "threshold_s", "windows", "for_s",
+                      "resolve_hold_s"}
+
+# Built-in metric families a rule file may reference without a live
+# process (serving bundle + the lazy default-registry bundles). The
+# runtime collector's families are listed statically: instantiating it
+# offline would hook jax.monitoring as a side effect.
+_RUNTIME_FAMILIES = (
+    "runtime_device_memory_bytes", "runtime_live_arrays",
+    "runtime_live_array_bytes", "runtime_jit_compiles_total",
+    "runtime_jit_compile_seconds", "runtime_transfers_total",
+    "runtime_transfer_bytes_total", "runtime_collections_total",
+)
+
+
+def known_metric_names(extra: Sequence[str] = ()) -> set:
+    """Every metric family the built-in bundles can expose — the
+    validation vocabulary for offline ``--check``."""
+    names = set(_RUNTIME_FAMILIES) | set(extra)
+    reg = _metrics.MetricsRegistry()
+    _metrics.TrainingMetrics(reg)
+    _metrics.ResilienceMetrics(reg)
+    _metrics.CheckpointMetrics(reg)
+    SLOMetrics(reg)
+    from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+    ServingMetrics(reg)
+    names.update(i.name for i in reg.instruments())
+    return names
+
+
+def _validate_selector(d, where: str, errors: List[str],
+                       known: Optional[set]) -> Optional[Selector]:
+    if not isinstance(d, dict) or not isinstance(d.get("metric"), str) \
+            or not d.get("metric"):
+        errors.append(f"{where}: expected {{'metric': <name>, "
+                      f"'match': {{label: regex}}?}}, got {d!r}")
+        return None
+    if known is not None and d["metric"] not in known:
+        errors.append(f"{where}: unknown metric name {d['metric']!r}")
+    match = d.get("match") or {}
+    if not isinstance(match, dict):
+        errors.append(f"{where}: 'match' must be a dict of label->regex")
+        return None
+    for k, v in match.items():
+        try:
+            re.compile(str(v))
+        except re.error as e:
+            errors.append(f"{where}: bad regex for label {k!r}: {e}")
+    try:
+        return Selector.from_json(d)
+    except Exception as e:  # noqa: BLE001 - report, keep validating
+        errors.append(f"{where}: {e}")
+        return None
+
+
+def _validate_windows(ws, where: str, errors: List[str]
+                      ) -> Tuple[BurnWindow, ...]:
+    if ws is None:
+        return DEFAULT_WINDOWS
+    if not isinstance(ws, list) or not ws:
+        errors.append(f"{where}: 'windows' must be a non-empty list")
+        return DEFAULT_WINDOWS
+    out, seen = [], set()
+    for i, w in enumerate(ws):
+        tag = f"{where}.windows[{i}]"
+        if not isinstance(w, dict):
+            errors.append(f"{tag}: expected an object, got {w!r}")
+            continue
+        try:
+            short_s = float(w["short_s"])
+            long_s = float(w["long_s"])
+            burn = float(w["burn"])
+        except (KeyError, TypeError, ValueError):
+            errors.append(f"{tag}: needs numeric short_s, long_s, burn")
+            continue
+        if short_s <= 0 or long_s <= 0 or burn <= 0:
+            errors.append(f"{tag}: short_s/long_s/burn must be > 0")
+            continue
+        if short_s >= long_s:
+            errors.append(f"{tag}: overlapping window: short_s "
+                          f"({short_s:g}) must be < long_s ({long_s:g})")
+            continue
+        if (short_s, long_s) in seen:
+            errors.append(f"{tag}: overlapping window: duplicate pair "
+                          f"({short_s:g}s, {long_s:g}s)")
+            continue
+        seen.add((short_s, long_s))
+        out.append(BurnWindow(short_s, long_s, burn))
+    return tuple(out) if out else DEFAULT_WINDOWS
+
+
+def validate_rules_doc(doc, known: Optional[set] = None
+                       ) -> Tuple[List[SLORule], List[str]]:
+    """Validate a rules document (``{"rules": [...]}`` or a bare list);
+    returns (parsed rules, error strings). A rule with errors is
+    dropped from the parsed list."""
+    errors: List[str] = []
+    raw = doc.get("rules") if isinstance(doc, dict) else doc
+    if not isinstance(raw, list):
+        return [], ["rules document must be {'rules': [...]} or a list"]
+    rules: List[SLORule] = []
+    names = set()
+    for i, rd in enumerate(raw):
+        where = (f"rules[{i}]" if not isinstance(rd, dict) or not rd.get("name")
+                 else f"rule {rd['name']!r}")
+        n_before = len(errors)
+        if not isinstance(rd, dict):
+            errors.append(f"{where}: expected an object, got {rd!r}")
+            continue
+        unknown = set(rd) - _ALLOWED_RULE_KEYS
+        if unknown:
+            errors.append(f"{where}: unknown keys {sorted(unknown)}")
+        name = rd.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{where}: 'name' must be a non-empty string")
+            name = f"<rules[{i}]>"
+        if name in names:
+            errors.append(f"{where}: duplicate rule name")
+        names.add(name)
+        kind = rd.get("kind")
+        if kind not in ("availability", "latency"):
+            errors.append(f"{where}: 'kind' must be 'availability' or "
+                          f"'latency', got {kind!r}")
+            continue
+        # a malformed objective must not mask selector/window problems:
+        # record it and keep validating the rest of the rule
+        objective = None
+        try:
+            objective = float(rd["objective"])
+        except (KeyError, TypeError, ValueError):
+            errors.append(f"{where}: malformed objective: 'objective' must "
+                          "be a number")
+        if objective is not None and not 0.0 < objective < 1.0:
+            errors.append(f"{where}: malformed objective: must be in (0, 1) "
+                          f"exclusive, got {objective!r} (an objective of 1.0 "
+                          "has zero error budget — burn rate is undefined)")
+            objective = None
+        total = bad = hist = None
+        threshold_s = None
+        if kind == "availability":
+            if "histogram" in rd or "threshold_s" in rd:
+                errors.append(f"{where}: availability rules take "
+                              "'total'/'bad', not 'histogram'/'threshold_s'")
+            total = _validate_selector(rd.get("total"), f"{where}.total",
+                                       errors, known)
+            bad = _validate_selector(rd.get("bad"), f"{where}.bad",
+                                     errors, known)
+        else:
+            if "total" in rd or "bad" in rd:
+                errors.append(f"{where}: latency rules take 'histogram'/"
+                              "'threshold_s', not 'total'/'bad'")
+            hist = _validate_selector(rd.get("histogram"),
+                                      f"{where}.histogram", errors, known)
+            try:
+                threshold_s = float(rd["threshold_s"])
+            except (KeyError, TypeError, ValueError):
+                errors.append(f"{where}: malformed objective: latency rules "
+                              "need a numeric 'threshold_s'")
+                threshold_s = None
+            if threshold_s is not None and not threshold_s > 0:
+                errors.append(f"{where}: malformed objective: threshold_s "
+                              f"must be > 0, got {threshold_s!r}")
+                threshold_s = None
+        windows = _validate_windows(rd.get("windows"), where, errors)
+        for_s = rd.get("for_s", 120.0)
+        hold_s = rd.get("resolve_hold_s", 300.0)
+        for key, val in (("for_s", for_s), ("resolve_hold_s", hold_s)):
+            if not isinstance(val, (int, float)) or val < 0:
+                errors.append(f"{where}: {key} must be a number >= 0")
+        if len(errors) > n_before:
+            continue
+        rules.append(SLORule(
+            name=name, kind=kind, objective=objective, total=total, bad=bad,
+            histogram=hist, threshold_s=threshold_s, windows=windows,
+            for_s=float(for_s), resolve_hold_s=float(hold_s)))
+    return rules, errors
+
+
+def load_rules(path: str, known: Optional[set] = None) -> List[SLORule]:
+    """Load + validate a rules JSON file; raises ValueError listing every
+    problem. ``known=None`` skips metric-name vocabulary checking (the
+    engine accepts rules over user-registered families)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    rules, errors = validate_rules_doc(doc, known=known)
+    if errors:
+        raise ValueError(f"{path}: " + "; ".join(errors))
+    return rules
+
+
+def default_serving_rules() -> List[SLORule]:
+    """The rules a ``ModelServer`` evaluates when none are supplied —
+    availability (non-429/5xx ratio) and p99 latency against the serving
+    bundle. Mirrored by ``observability/example_rules.json``."""
+    return [
+        SLORule(
+            name="serving-availability", kind="availability",
+            objective=0.999,
+            total=Selector("serving_requests_total"),
+            bad=Selector("serving_requests_total",
+                         match=(("code", "429|5.."),)),
+            windows=DEFAULT_WINDOWS, for_s=120.0, resolve_hold_s=300.0),
+        SLORule(
+            name="serving-latency-p99", kind="latency",
+            objective=0.99, threshold_s=0.25,
+            histogram=Selector("serving_request_latency_seconds"),
+            windows=DEFAULT_WINDOWS, for_s=120.0, resolve_hold_s=300.0),
+    ]
+
+
+# -- slo metric family --------------------------------------------------------
+
+
+class SLOMetrics:
+    """The engine's own exposition: rule state, live burn rates, and a
+    transition counter — health is scrapeable, not just pollable."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        r = registry if registry is not None else _metrics.default_registry()
+        self.registry = r
+        ns = "slo"
+        self.state = r.gauge(
+            "state", "Alert state per rule: 0=ok 1=pending 2=firing "
+            "3=resolved.", ("rule",), namespace=ns)
+        self.burn_rate = r.gauge(
+            "burn_rate", "Error-budget burn rate per rule and window "
+            "(1.0 = burning exactly the budget).", ("rule", "window"),
+            namespace=ns)
+        self.transitions_total = r.counter(
+            "transitions_total", "Alert state transitions by rule and "
+            "destination state.", ("rule", "to"), namespace=ns)
+
+
+_slo_metrics: Optional[SLOMetrics] = None
+_slo_lock = threading.Lock()
+
+
+def get_slo_metrics() -> SLOMetrics:
+    global _slo_metrics
+    if _slo_metrics is None:
+        with _slo_lock:
+            if _slo_metrics is None:
+                _slo_metrics = SLOMetrics()
+    return _slo_metrics
+
+
+def _drop_slo_metrics():
+    global _slo_metrics
+    _slo_metrics = None
+
+
+_metrics.register_reset_hook(_drop_slo_metrics)
+
+
+# -- sampling helpers ---------------------------------------------------------
+
+
+def _doc_map(registries) -> Dict[str, dict]:
+    doc = _metrics.render_json_multi(registries)
+    return {m["name"]: m for m in doc["metrics"]}
+
+
+def _counter_sum(families: Dict[str, dict], sel: Selector) -> float:
+    fam = families.get(sel.metric)
+    if fam is None or fam["type"] not in ("counter", "gauge"):
+        return 0.0
+    return float(sum(s["value"] for s in fam["samples"]
+                     if sel.matches(s["labels"])))
+
+
+def _parse_bound(key: str) -> float:
+    return float("inf") if key == "+Inf" else float(key)
+
+
+def _hist_good_total(families: Dict[str, dict], sel: Selector,
+                     threshold_s: float) -> Tuple[float, float]:
+    """(observations <= threshold bucket, total observations) summed over
+    the matching label sets. The threshold snaps to the smallest bucket
+    bound at or above it (an off-bucket threshold degrades gracefully to
+    the next coarser bound rather than failing)."""
+    fam = families.get(sel.metric)
+    if fam is None or fam["type"] != "histogram":
+        return 0.0, 0.0
+    good = total = 0.0
+    for s in fam["samples"]:
+        if not sel.matches(s["labels"]):
+            continue
+        total += s["count"]
+        bounds = sorted((_parse_bound(k) for k in s["buckets"]),)
+        chosen = next((b for b in bounds
+                       if b >= threshold_s * (1.0 - 1e-9)), float("inf"))
+        good += s["buckets"][
+            "+Inf" if chosen == float("inf") else _metrics._fmt(chosen)]
+    return good, total
+
+
+# -- engine -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _RuleRuntime:
+    """Mutable evaluator state for one rule."""
+
+    rule: SLORule
+    samples: deque                       # (t, bad, total) cumulative
+    state: str = STATE_OK
+    since: float = 0.0                   # when the current state began
+    pending_since: float = 0.0
+    resolved_at: float = 0.0
+    burns: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+    transitions: List[dict] = dataclasses.field(default_factory=list)
+    last_bad: float = 0.0
+    last_total: float = 0.0
+
+
+class HealthEngine:
+    """Evaluate SLO rules on a cadence; drive alert state machines.
+
+    ``registries``: the metric registries to read (None = the live
+    process-global default registry, resolved per tick so registry
+    resets in tests are honored). ``time_scale`` multiplies every rule
+    duration; ``interval_s`` is the evaluator cadence (real seconds,
+    never scaled — callers pick a cadence matching their scale).
+    ``clock`` is injectable for deterministic tests.
+
+    Thread-safe: ``tick()`` may be called from the background thread and
+    on demand (the ``/debug/health`` handler does) under one lock.
+    """
+
+    def __init__(self, rules: Sequence[SLORule], *,
+                 registries: Optional[Sequence] = None,
+                 interval_s: float = 10.0, time_scale: float = 1.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 snapshot_every_s: float = 30.0,
+                 max_samples: int = 4096):
+        if time_scale <= 0:
+            raise ValueError(f"time_scale must be > 0, got {time_scale}")
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.rules = list(rules)
+        self._registries = list(registries) if registries is not None else None
+        self.interval_s = interval_s
+        self.time_scale = time_scale
+        self.snapshot_every_s = snapshot_every_s
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_snapshot: Optional[float] = None
+        self._runtimes = {
+            r.name: _RuleRuntime(
+                rule=r,
+                samples=deque(maxlen=self._retention(r, max_samples)))
+            for r in self.rules
+        }
+
+    def _retention(self, rule: SLORule, cap: int) -> int:
+        longest = max((w.long_s for w in rule.windows), default=0.0)
+        need = int(longest * self.time_scale / self.interval_s) + 8
+        return max(16, min(cap, need))
+
+    def _resolve_registries(self):
+        if self._registries is not None:
+            return self._registries
+        return [_metrics.default_registry()]
+
+    # -- evaluation ----------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """One evaluation pass; returns :meth:`health`. Safe to call
+        concurrently with the background thread."""
+        with self._lock:
+            t = self._clock() if now is None else now
+            families = _doc_map(self._resolve_registries())
+            sm = get_slo_metrics() if _metrics.enabled() else None
+            for rt in self._runtimes.values():
+                self._eval_rule(rt, families, t, sm)
+            if self.snapshot_every_s and (
+                    self._last_snapshot is None
+                    or t - self._last_snapshot >= self.snapshot_every_s):
+                self._last_snapshot = t
+                try:
+                    get_flight_recorder().snapshot_registries(
+                        self._resolve_registries())
+                except Exception:  # noqa: BLE001 — snapshots are best-effort
+                    pass
+            return self._health_locked(t)
+
+    def _sample(self, rule: SLORule, families) -> Tuple[float, float]:
+        if rule.kind == "availability":
+            return (_counter_sum(families, rule.bad),
+                    _counter_sum(families, rule.total))
+        good, total = _hist_good_total(families, rule.histogram,
+                                       rule.threshold_s)
+        return total - good, total
+
+    @staticmethod
+    def _window_delta(samples, t: float, window: float
+                      ) -> Tuple[float, float]:
+        """(bad delta, total delta) between now and the newest sample at
+        least ``window`` old (falling back to the oldest sample while
+        history is still shorter than the window)."""
+        latest = samples[-1]
+        anchor = samples[0]
+        for s in samples:
+            if s[0] <= t - window:
+                anchor = s
+            else:
+                break
+        return latest[1] - anchor[1], latest[2] - anchor[2]
+
+    def _burn(self, rt: _RuleRuntime, t: float, window: float) -> float:
+        bad_d, total_d = self._window_delta(rt.samples, t, window)
+        if total_d <= 0:
+            return 0.0
+        err_rate = max(0.0, bad_d) / total_d
+        return err_rate / rt.rule.error_budget
+
+    def _eval_rule(self, rt: _RuleRuntime, families, t: float, sm):
+        rule = rt.rule
+        bad, total = self._sample(rule, families)
+        rt.last_bad, rt.last_total = bad, total
+        # Retention is sized for interval_s cadence, but tick() also runs
+        # on demand (every /debug/health request): faster-than-cadence
+        # ticks REPLACE the newest sample instead of appending, or a 1 Hz
+        # health poller would evict the history the 6 h window needs and
+        # silently shrink every long window to minutes.
+        if rt.samples and t - rt.samples[-1][0] < 0.5 * self.interval_s:
+            rt.samples[-1] = (t, bad, total)
+        else:
+            rt.samples.append((t, bad, total))
+        breach = False
+        burns: Dict[str, Dict[str, float]] = {}
+        for w in rule.windows:
+            bs = self._burn(rt, t, w.short_s * self.time_scale)
+            bl = self._burn(rt, t, w.long_s * self.time_scale)
+            burns[w.label()] = {"short": bs, "long": bl,
+                                "threshold": w.burn}
+            if bs >= w.burn and bl >= w.burn:
+                breach = True
+            if sm is not None:
+                sm.burn_rate.set(bs, rule=rule.name,
+                                 window=_dur(w.short_s))
+                sm.burn_rate.set(bl, rule=rule.name, window=_dur(w.long_s))
+        rt.burns = burns
+        self._advance(rt, breach, t, sm)
+
+    def _advance(self, rt: _RuleRuntime, breach: bool, t: float, sm):
+        rule = rt.rule
+        state = rt.state
+        new = state
+        if breach:
+            if state in (STATE_OK, STATE_RESOLVED):
+                new = STATE_PENDING
+                rt.pending_since = t
+            elif state == STATE_PENDING and \
+                    t - rt.pending_since >= rule.for_s * self.time_scale:
+                new = STATE_FIRING
+        else:
+            if state == STATE_PENDING:
+                new = STATE_OK
+            elif state == STATE_FIRING:
+                new = STATE_RESOLVED
+                rt.resolved_at = t
+            elif state == STATE_RESOLVED and \
+                    t - rt.resolved_at >= rule.resolve_hold_s * self.time_scale:
+                new = STATE_OK
+        if new != state:
+            rt.state = new
+            rt.since = t
+            tr = {"t": t, "from": state, "to": new,
+                  "burns": {k: round(v["short"], 3)
+                            for k, v in rt.burns.items()}}
+            rt.transitions.append(tr)
+            del rt.transitions[:-64]  # bounded history per rule
+            record_event("slo.transition", rule=rule.name, **{
+                "from": state, "to": new, "burns": tr["burns"]})
+            if sm is not None:
+                sm.transitions_total.inc(rule=rule.name, to=new)
+        if sm is not None:
+            sm.state.set(_STATE_NUM[rt.state], rule=rule.name)
+
+    # -- rendering -----------------------------------------------------------
+
+    def health(self) -> dict:
+        with self._lock:
+            return self._health_locked(self._clock())
+
+    def _health_locked(self, t: float) -> dict:
+        worst = STATE_OK
+        rules = []
+        for rt in self._runtimes.values():
+            rule = rt.rule
+            if _STATE_NUM[rt.state] > _STATE_NUM[worst]:
+                worst = rt.state
+            rules.append({
+                "name": rule.name, "kind": rule.kind, "state": rt.state,
+                "objective": rule.objective,
+                "error_budget": rule.error_budget,
+                "threshold_s": rule.threshold_s,
+                "since": rt.since,
+                "bad": rt.last_bad, "total": rt.last_total,
+                "windows": [
+                    dict(dataclasses.asdict(w),
+                         **rt.burns.get(w.label(),
+                                        {"short": 0.0, "long": 0.0}))
+                    for w in rule.windows
+                ],
+                "for_s": rule.for_s,
+                "transitions": list(rt.transitions[-16:]),
+            })
+        return {"status": worst, "time_scale": self.time_scale,
+                "interval_s": self.interval_s, "evaluated_at": t,
+                "rules": rules}
+
+    def render_text(self) -> str:
+        h = self.health()
+        lines = [f"status: {h['status']}"]
+        for r in h["rules"]:
+            burn = " ".join(
+                f"burn({_dur(w['short_s'])}/{_dur(w['long_s'])})="
+                f"{w['short']:.2f}/{w['long']:.2f}(x{w['burn']:g})"
+                for w in r["windows"])
+            lines.append(
+                f"{r['name']:<28} {r['state'].upper():<9} "
+                f"objective={r['objective']:g} bad={r['bad']:g}/"
+                f"{r['total']:g} {burn}")
+        return "\n".join(lines) + "\n"
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {name: rt.state for name, rt in self._runtimes.items()}
+
+    # -- background thread ---------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "HealthEngine":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="slo-evaluator")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the evaluator must survive
+                pass           # a transient bad sample; next tick retries
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# -- process-default engine (UIServer's /health reads it) ---------------------
+
+_default_engine: Optional[HealthEngine] = None
+
+
+def set_default_engine(engine: Optional[HealthEngine]):
+    """Publish an engine as the process default (ModelServer does on
+    start) so zero-config consumers — UIServer's /health page — can
+    render current SLO states."""
+    global _default_engine
+    _default_engine = engine
+
+
+def get_default_engine() -> Optional[HealthEngine]:
+    return _default_engine
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def check_rules_file(path: str, extra_known: Sequence[str] = ()
+                     ) -> Tuple[int, List[str]]:
+    """Validate one rules file; returns (n valid rules, errors)."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        return 0, [f"cannot read {path}: {e}"]
+    rules, errors = validate_rules_doc(
+        doc, known=known_metric_names(extra_known))
+    return len(rules), errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.observability.slo",
+        description="SLO rule-file tools")
+    ap.add_argument("--check", metavar="RULES_JSON", required=True,
+                    help="validate a rules file offline; non-zero exit on "
+                         "any problem")
+    ap.add_argument("--known", default="",
+                    help="comma-separated extra metric names to accept "
+                         "(user-registered families)")
+    args = ap.parse_args(argv)
+    extra = [n for n in args.known.split(",") if n]
+    n, errors = check_rules_file(args.check, extra_known=extra)
+    for e in errors:
+        print(f"error: {e}", file=sys.stderr)
+    if errors:
+        print(f"{args.check}: {len(errors)} problem(s)", file=sys.stderr)
+        return 1
+    print(f"ok: {n} rule(s) valid")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -168,6 +168,17 @@ class FaultInjector:
                     p.fired += 1
                     self.log.append({"point": point, "trigger": count,
                                      "time": time.time()})
+                    try:
+                        # the black-box timeline must show the injected
+                        # fault next to the recovery it caused
+                        from deeplearning4j_tpu.observability.flightrecorder import (  # noqa: E501
+                            record_event,
+                        )
+
+                        record_event("fault.injected", point=point,
+                                     trigger=count, mode=p.mode, arg=p.arg)
+                    except Exception:  # noqa: BLE001 - never mask the fault
+                        pass
                     return p
         return None
 

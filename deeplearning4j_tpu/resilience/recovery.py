@@ -59,6 +59,14 @@ def _obs():
     return _obsm.get_resilience_metrics() if _obsm.enabled() else None
 
 
+def _flight(kind: str, **data):
+    """Recovery events into the black-box ring: a crash report's timeline
+    must show the rollbacks/skips that preceded it."""
+    from deeplearning4j_tpu.observability.flightrecorder import record_event
+
+    record_event(kind, **data)
+
+
 def _train_obs():
     """The same training bundle Trainer.fit feeds — FaultTolerantTrainer
     drives the compiled step from its own loop, so it reports step/sample
@@ -195,13 +203,16 @@ class FaultTolerantTrainer:
             if jnp.issubdtype(arr.dtype, jnp.floating):
                 ok = jnp.logical_and(ok, jnp.isfinite(arr).all())
         if not bool(jax.device_get(ok)):
+            step = int(jax.device_get(ts.step))
             self.recoveries.append({
                 "kind": "skip_checkpoint",
-                "step": int(jax.device_get(ts.step)),
+                "step": step,
                 "reason": "non-finite params"})
             rm = _obs()
             if rm is not None:
                 rm.checkpoint_skips_total.inc()
+            _flight("resilience.checkpoint_skip", step=step,
+                    reason="non-finite params")
             return
         save_checkpoint(
             self.directory, ts, model=self.model, tag=tag,
@@ -247,6 +258,8 @@ class FaultTolerantTrainer:
         rm = _obs()
         if rm is not None:
             rm.rollbacks_total.inc()
+        _flight("resilience.rollback", checkpoint=str(d),
+                to_step=int(meta.get("step", 0)), cause=repr(err)[:200])
         return ts, (int(meta.get("epoch", 0)),
                     int(meta.get("batch_in_epoch", 0)))
 
@@ -290,6 +303,10 @@ class FaultTolerantTrainer:
         skip_set: Set[Tuple[int, int]] = set()
         stop = False
         tm = _train_obs()
+        if tm is not None:
+            from deeplearning4j_tpu.train.trainer import _StepTelemetry
+
+            tele = _StepTelemetry(tr, tm)
         for lst in listeners:
             lst.on_fit_start(tr, ts)
         try:
@@ -306,7 +323,18 @@ class FaultTolerantTrainer:
                     lst.on_epoch_start(epoch)
                 restart_epoch = False
                 b = 0
-                for batch in iter(data):
+                it = iter(data)
+                while True:
+                    # manual next(): the read is timed so the starvation
+                    # detector sees FT runs too (Trainer.fit measures the
+                    # same leg)
+                    t_read = time.perf_counter() if tm is not None else 0.0
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    read_s = (time.perf_counter() - t_read
+                              if tm is not None else 0.0)
                     if b < skip_batches:
                         b += 1
                         continue
@@ -316,6 +344,7 @@ class FaultTolerantTrainer:
                         rm = _obs()
                         if rm is not None:
                             rm.skipped_batches_total.inc()
+                        _flight("resilience.skip_batch", epoch=epoch, batch=b)
                         b += 1
                         continue
                     batch = as_batch_dict(batch)
@@ -358,6 +387,8 @@ class FaultTolerantTrainer:
                             rm = _obs()
                             if rm is not None:
                                 rm.lr_cuts_total.inc()
+                            _flight("resilience.lr_cut",
+                                    scale=self._lr_scale)
                         epoch = r_epoch
                         skip_batches = r_skip
                         restart_epoch = True
@@ -365,10 +396,13 @@ class FaultTolerantTrainer:
                     ts = new_ts
                     host_step += 1
                     if tm is not None:
-                        tm.step_seconds.observe(time.perf_counter() - t_step)
+                        step_s = time.perf_counter() - t_step
+                        tm.step_seconds.observe(step_s)
+                        tm.data_read_seconds.observe(read_s)
                         tm.steps_total.inc()
                         feats = jax.tree_util.tree_leaves(batch["features"])
                         tm.samples_total.inc(feats[0].shape[0])
+                        tele.on_step(ts, batch, read_s, step_s, host_step)
                     b += 1
                     if pol.checkpoint_every and \
                             host_step % pol.checkpoint_every == 0:

@@ -52,6 +52,7 @@ def initialize(
     global _INITIALIZED
     if _INITIALIZED:
         return
+    _enable_cpu_collectives()
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None and num_processes is None:
@@ -79,6 +80,31 @@ def initialize(
         kwargs["local_device_ids"] = list(local_device_ids)
     jax.distributed.initialize(**kwargs)
     _INITIALIZED = True
+
+
+def _enable_cpu_collectives() -> None:
+    """Arm gloo collectives when the job will run on the CPU backend.
+
+    The default XLA CPU client implements no cross-process collectives —
+    a 2-process CPU job fails its first psum with "Multiprocess
+    computations aren't implemented on the CPU backend". jaxlib ships a
+    gloo-based implementation behind ``jax_cpu_collectives_implementation``;
+    it must be selected BEFORE the backend initializes, which is exactly
+    when ``initialize()`` runs. Armed when the platform is explicitly
+    ``cpu`` AND when it is unset (a CPU-only install auto-selects cpu;
+    the option only configures the CPU client, so it is harmless on a
+    TPU/GPU machine where that client is secondary). No-op when an
+    explicit non-cpu platform is forced or the jaxlib build lacks the
+    option."""
+    platforms = (getattr(jax.config, "jax_platforms", None)
+                 or os.environ.get("JAX_PLATFORMS") or "")
+    first = platforms.split(",")[0].strip().lower()
+    if first not in ("", "cpu"):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - option/impl absent in this jaxlib
+        pass
 
 
 def is_multiprocess() -> bool:

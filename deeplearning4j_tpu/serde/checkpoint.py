@@ -446,7 +446,18 @@ def verify_checkpoint(ckpt_dir: str | Path, *,
     """
     t_op = time.perf_counter()
     try:
-        return _verify_checkpoint_impl(ckpt_dir, deep=deep)
+        ok, reason = _verify_checkpoint_impl(ckpt_dir, deep=deep)
+        if not ok:
+            try:
+                from deeplearning4j_tpu.observability.flightrecorder import (
+                    record_event,
+                )
+
+                record_event("checkpoint.verify_failed",
+                             checkpoint=str(ckpt_dir), reason=reason)
+            except Exception:  # noqa: BLE001 - never mask the verdict
+                pass
+        return ok, reason
     finally:
         _observe_op("verify", time.perf_counter() - t_op)
 
@@ -513,6 +524,15 @@ def quarantine_checkpoint(ckpt_dir: str | Path,
     m = _ckpt_metrics()
     if m is not None:
         m.quarantined_total.inc()
+    try:
+        from deeplearning4j_tpu.observability.flightrecorder import (
+            record_event,
+        )
+
+        record_event("checkpoint.quarantined", checkpoint=str(ckpt_dir),
+                     quarantine=str(target), reason=reason[:300])
+    except Exception:  # noqa: BLE001 - telemetry never blocks quarantine
+        pass
     try:
         (target / "QUARANTINE.txt").write_text(
             f"quarantined {time.time()}: {reason}\n")

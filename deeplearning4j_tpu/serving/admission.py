@@ -73,6 +73,20 @@ class AdmissionController:
         """Admit or raise QueueFullError — never blocks."""
         with self._cv:
             if self._in_flight >= self.max_in_flight:
+                try:
+                    # black-box breadcrumb with the depth context only
+                    # this layer knows; a distinct kind from the server's
+                    # per-request "serving.shed" so timelines don't
+                    # double-count one rejection
+                    from deeplearning4j_tpu.observability.flightrecorder import (  # noqa: E501
+                        record_event,
+                    )
+
+                    record_event("serving.admission_cap",
+                                 in_flight=self._in_flight,
+                                 max_in_flight=self.max_in_flight)
+                except Exception:  # noqa: BLE001 — never block the shed
+                    pass
                 raise QueueFullError(
                     f"admission cap reached ({self.max_in_flight} in flight)",
                     retry_after_ms=self.retry_after_ms)

@@ -72,6 +72,9 @@ class ModelEntry:
         self._active: Optional[_Active] = None
         self.history: List[Tuple[str, Any]] = []  # (version, variables)
         self.warmed = False
+        # static cost analyses are a compile each — cache per (version,
+        # rows) so /debug/costs polling never recompiles
+        self._cost_cache: Dict[Tuple[str, int], dict] = {}
 
     # -- replica-set lifecycle ---------------------------------------------
 
@@ -206,6 +209,55 @@ class ModelEntry:
                 "warmed": self.warmed, "mode": self.mode,
                 "max_batch_size": self.max_batch_size}
 
+    def cost_analysis(self, rows: Optional[int] = None) -> dict:
+        """Static XLA cost analysis of this entry's forward program at
+        ``rows`` examples (default: the largest warmed bucket) — flops,
+        bytes accessed, arithmetic intensity, per-row flops. Compilation
+        only, no execution; cached per (version, rows). The roofline
+        inputs for ``GET /debug/costs``."""
+        from deeplearning4j_tpu.serving.warmup import zeros_batch
+        from deeplearning4j_tpu.train.profiling import (
+            arithmetic_intensity,
+            op_costs,
+        )
+
+        with self._lock:
+            if self._active is None:
+                raise NotReadyError(f"model '{self.name}' is shut down")
+            version = self._active.version
+        if rows is None:
+            rows = self.max_batch_size if self.mode == "batched" else 1
+        cached = self._cost_cache.get((version, rows))
+        if cached is not None:
+            return dict(cached)
+        variables = next((v for ver, v in reversed(self.history)
+                          if ver == version and v is not None), None)
+        out: dict = {"model": self.name, "version": version, "rows": rows}
+        if variables is None:
+            out.update(available=False,
+                       reason="active version's variables were released")
+            return out
+        example = zeros_batch(self.input_spec, rows)
+        try:
+            costs = op_costs(self.forward, variables, example)
+        except Exception as e:  # noqa: BLE001 — diagnostics never 500 on
+            costs = {}          # a backend without cost analysis
+            out["reason"] = str(e)[:200]
+        if not costs:
+            # NOT cached: a transient compile failure must not pin this
+            # version's roofline data to "unavailable" forever
+            out.setdefault("reason", "backend reports no cost analysis")
+            out["available"] = False
+            return out
+        out["available"] = True
+        out["flops"] = costs.get("flops")
+        out["bytes_accessed"] = costs.get("bytes accessed")
+        out["arithmetic_intensity"] = arithmetic_intensity(costs)
+        if costs.get("flops"):
+            out["flops_per_row"] = costs["flops"] / rows
+        self._cost_cache[(version, rows)] = dict(out)
+        return out
+
     def shutdown(self):
         with self._lock:
             active, self._active = self._active, None
@@ -320,6 +372,8 @@ class ModelRegistry:
             if len(entry.history) > 2:
                 old_version, _ = entry.history[-3]
                 entry.history[-3] = (old_version, None)
+        _record_flight("serving.deploy", model=name, version=version,
+                       warm=warm)
         return version
 
     def rollback(self, name: str) -> str:
@@ -338,6 +392,7 @@ class ModelRegistry:
                     "retained)")
             self._swap(entry, variables, version, warm=True)
             entry.history.pop()  # only after the swap succeeded
+        _record_flight("serving.rollback", model=name, version=version)
         return version
 
     def _swap(self, entry: ModelEntry, variables, version: str, warm: bool):
@@ -382,6 +437,19 @@ class ModelRegistry:
     def shutdown_all(self):
         for entry in self.entries():
             entry.shutdown()
+
+
+def _record_flight(kind: str, **data):
+    """Deployment lifecycle into the black-box ring — a post-mortem must
+    show hot-swaps/rollbacks next to the traffic they affected."""
+    try:
+        from deeplearning4j_tpu.observability.flightrecorder import (
+            record_event,
+        )
+
+        record_event(kind, **data)
+    except Exception:  # noqa: BLE001 — telemetry never fails a deploy
+        pass
 
 
 def _model_for_config(cfg):

@@ -18,6 +18,23 @@ what the repo already ships. Endpoints:
   resilience / checkpoint / runtime-collector series of the same
   process ride the same scrape.
 
+Diagnostics plane (``/debug/*`` — the operator-facing consumers of the
+telemetry spine):
+
+- ``GET /debug/health`` — SLO alert states + live burn rates from the
+  server's :class:`~deeplearning4j_tpu.observability.slo.HealthEngine`
+  (default rules: serving availability 99.9% + p99 latency; pass
+  ``slo_rules=``/``slo_engine=`` to override). ``?format=text`` for the
+  one-line-per-rule rendering.
+- ``GET /debug/flightrecorder`` — the black-box event ring
+  (``?seconds=N`` trims to the trailing window).
+- ``POST /debug/profile?ms=N`` — capture ``jax.profiler`` of LIVE
+  traffic for N ms; returns the Perfetto trace (gzipped, base64) plus
+  the ``analyze_trace`` device-op breakdown. One capture at a time.
+- ``GET /debug/costs`` — per-registered-model static XLA cost analysis
+  (flops, bytes accessed, arithmetic intensity; ``?rows=N`` overrides
+  the batch size analyzed).
+
 Predict requests propagate correlation IDs: ``X-Correlation-ID`` /
 ``X-Span-ID`` headers (minted when absent, echoed back) root the
 server-side span tree request → admission → batch → dispatch
@@ -31,17 +48,24 @@ drain serves anything still queued).
 
 from __future__ import annotations
 
+import base64
 import json
 import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
+from urllib.parse import parse_qs
 
 import jax
 import numpy as np
 
+from deeplearning4j_tpu.observability import slo as _slo
 from deeplearning4j_tpu.observability import trace as _trace
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+    record_event,
+)
 from deeplearning4j_tpu.observability.metrics import (
     default_registry,
     render_json_multi,
@@ -80,6 +104,11 @@ class ModelServer:
         metrics: Optional[ServingMetrics] = None,
         admission: Optional[AdmissionController] = None,
         default_deadline_ms: float = 30000.0,
+        slo_rules: Optional[Sequence["_slo.SLORule"]] = None,
+        slo_engine: Optional["_slo.HealthEngine"] = None,
+        slo_interval_s: float = 10.0,
+        slo_time_scale: float = 1.0,
+        max_profile_ms: float = 60000.0,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
         if metrics is not None:
@@ -97,6 +126,19 @@ class ModelServer:
         self._draining = False
         self._started = False
         self._serve_thread: Optional[threading.Thread] = None
+        # Diagnostics plane: the health engine evaluates this server's
+        # serving bundle UNION the process default registry, so train /
+        # resilience series in the same process count toward rules too.
+        if slo_engine is not None:
+            self.slo_engine = slo_engine
+        else:
+            self.slo_engine = _slo.HealthEngine(
+                slo_rules if slo_rules is not None
+                else _slo.default_serving_rules(),
+                registries=[self.metrics.registry, default_registry()],
+                interval_s=slo_interval_s, time_scale=slo_time_scale)
+        self.max_profile_ms = max_profile_ms
+        self._profile_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -138,12 +180,52 @@ class ModelServer:
                         self._send(
                             200, server.render_metrics_text().encode(),
                             content_type="text/plain; version=0.0.4")
+                elif path == "/debug/health":
+                    if "format=text" in query:
+                        self._send(200, server.render_health_text().encode(),
+                                   content_type="text/plain")
+                    else:
+                        self._send(200, server.render_health())
+                elif path == "/debug/flightrecorder":
+                    q = parse_qs(query)
+                    try:
+                        seconds = (float(q["seconds"][0])
+                                   if "seconds" in q else None)
+                    except ValueError:
+                        self._send(400, BadRequestError(
+                            "seconds must be a number").to_json())
+                        return
+                    self._send(200, get_flight_recorder().dump(
+                        last_seconds=seconds))
+                elif path == "/debug/costs":
+                    q = parse_qs(query)
+                    try:
+                        rows = int(q["rows"][0]) if "rows" in q else None
+                    except ValueError:
+                        rows = 0
+                    if rows is not None and rows < 1:
+                        self._send(400, BadRequestError(
+                            "rows must be a positive integer").to_json())
+                        return
+                    self._send(200, server.render_costs(rows=rows))
                 else:
                     self._send(404, ServingError(
                         f"no route {path}").to_json())
 
             def do_POST(self):  # noqa: N802 - stdlib API
-                m = _PREDICT_RE.match(self.path.partition("?")[0])
+                path, _, query = self.path.partition("?")
+                if path == "/debug/profile":
+                    q = parse_qs(query)
+                    try:
+                        ms = float(q.get("ms", ["500"])[0])
+                    except ValueError:
+                        self._send(400, BadRequestError(
+                            "ms must be a number").to_json())
+                        return
+                    status, body = server.handle_profile(ms)
+                    self._send(status, body)
+                    return
+                m = _PREDICT_RE.match(path)
                 if not m:
                     self._send(404, ServingError(
                         f"no route {self.path}").to_json())
@@ -265,11 +347,15 @@ class ModelServer:
                 if reason is not None:
                     self.metrics.shed_total.inc(model=metric_model,
                                                 reason=reason)
+                    record_event("serving.shed", model=metric_model,
+                                 reason=reason, status=status)
             except Exception as e:  # noqa: BLE001 — surface, never crash
                 status = 500
                 body = {"error": {"code": "INTERNAL",
                                   "message": str(e)[:300],
                                   "retryable": False}}
+                record_event("serving.error", model=metric_model,
+                             error=str(e)[:200])
             if req_span is not None:
                 req_span.attrs["status"] = status
         self.metrics.requests_total.inc(model=metric_model, code=str(status))
@@ -288,6 +374,88 @@ class ModelServer:
     def render_metrics_json(self) -> dict:
         return render_json_multi([self.metrics.registry, default_registry()])
 
+    # -- diagnostics plane ----------------------------------------------------
+
+    def render_health(self) -> dict:
+        """Current SLO states + burn rates (a fresh tick, so /debug/health
+        is never staler than one request)."""
+        return self.slo_engine.tick()
+
+    def render_health_text(self) -> str:
+        self.slo_engine.tick()
+        return self.slo_engine.render_text()
+
+    def render_costs(self, rows: Optional[int] = None) -> dict:
+        """Per-registered-model static XLA cost analysis — the roofline
+        inputs (flops, bytes, arithmetic intensity) of what this server
+        is actually serving. One entry failing (e.g. shut down mid-walk
+        during a deploy) reports itself; the others still render."""
+        out = []
+        for e in self.registry.entries():
+            try:
+                out.append(e.cost_analysis(rows=rows))
+            except Exception as exc:  # noqa: BLE001 — diagnostics never 500
+                out.append({"model": e.name, "available": False,
+                            "reason": str(exc)[:200]})
+        return {"models": out}
+
+    def handle_profile(self, ms: float) -> Tuple[int, dict]:
+        """On-demand ``jax.profiler`` capture of live traffic for ``ms``
+        milliseconds. Returns the Perfetto trace (gzipped trace file,
+        base64) plus the ``analyze_trace`` op breakdown. Serialized: one
+        capture at a time (jax has one global profiler session)."""
+        import glob
+        import os
+        import tempfile
+
+        from deeplearning4j_tpu.train.profiling import analyze_trace
+
+        if not (0 < ms <= self.max_profile_ms):
+            return 400, BadRequestError(
+                f"ms must be in (0, {self.max_profile_ms:g}], "
+                f"got {ms!r}").to_json()
+        if not self._profile_lock.acquire(blocking=False):
+            return 409, {"error": {
+                "code": "PROFILE_IN_PROGRESS",
+                "message": "another /debug/profile capture is running",
+                "retryable": True}}
+        try:
+            log_dir = tempfile.mkdtemp(prefix="dl4j-tpu-profile-")
+            t0 = time.monotonic()
+            jax.profiler.start_trace(log_dir)
+            try:
+                time.sleep(ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+            wall_ms = (time.monotonic() - t0) * 1000.0
+            hits = sorted(
+                glob.glob(os.path.join(log_dir, "**", "*.trace.json.gz"),
+                          recursive=True), key=os.path.getmtime)
+            if not hits:
+                return 503, {"error": {
+                    "code": "NO_TRACE",
+                    "message": "profiler produced no trace file "
+                               "(backend without profiling support?)",
+                    "retryable": True}}
+            trace_file = hits[-1]
+            raw = open(trace_file, "rb").read()
+            ops = analyze_trace(log_dir, top=25)
+            record_event("debug.profile", ms=ms, trace_bytes=len(raw),
+                         ops=len(ops))
+            body = {"duration_ms": round(wall_ms, 1),
+                    "trace_dir": log_dir, "trace_file": trace_file,
+                    "trace_bytes": len(raw), "ops": ops}
+            # the gzipped trace rides inline when it fits a JSON response
+            if len(raw) <= 16 << 20:
+                body["trace_gz_b64"] = base64.b64encode(raw).decode()
+            return 200, body
+        except Exception as e:  # noqa: BLE001 — diagnostics never crash
+            return 500, {"error": {"code": "INTERNAL",  # the server
+                                   "message": str(e)[:300],
+                                   "retryable": False}}
+        finally:
+            self._profile_lock.release()
+
     # -- lifecycle ------------------------------------------------------------
 
     def warm_all(self) -> dict:
@@ -305,6 +473,13 @@ class ModelServer:
             name="model-server")
         self._serve_thread.start()
         self._started = True
+        self.slo_engine.start()
+        if _slo.get_default_engine() is None:
+            # zero-config visibility: UIServer's /health page renders the
+            # process-default engine
+            _slo.set_default_engine(self.slo_engine)
+        record_event("serving.start", port=self.port,
+                     models=self.registry.names())
         return self
 
     def stop(self, *, drain: bool = True, timeout: float = 30.0) -> bool:
@@ -312,12 +487,17 @@ class ModelServer:
         drained = True
         if self._started:
             self._draining = True
+            record_event("serving.drain", port=self.port)
             if drain:
                 drained = self.admission.drain(timeout)
             self._httpd.shutdown()
             if self._serve_thread is not None:
                 self._serve_thread.join(timeout=10)
             self._started = False
+            record_event("serving.stop", port=self.port, drained=drained)
+        self.slo_engine.stop()
+        if _slo.get_default_engine() is self.slo_engine:
+            _slo.set_default_engine(None)
         self._httpd.server_close()
         self.registry.shutdown_all()
         return drained

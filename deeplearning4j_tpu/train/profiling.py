@@ -22,6 +22,7 @@ import glob
 import gzip
 import json
 import os
+import re
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
@@ -119,20 +120,46 @@ def _find_trace_file(log_dir: str) -> str:
     raise FileNotFoundError(f"no trace file under {log_dir}")
 
 
+# Process lanes that carry XLA device ops in profiler traces: the
+# TensorBoard/Perfetto layout names them "/device:TPU:0", "/device:GPU:0
+# (...)", etc. via "process_name" metadata events. Host-side lanes
+# ("/host:CPU", "python", TSL runtime threads) must NOT match.
+_DEVICE_LANE_RE = re.compile(r"/device:(TPU|GPU|XLA|CUSTOM)", re.IGNORECASE)
+
+
+def _device_pids(events: List[Dict]) -> set:
+    """pids whose process_name metadata marks a device/XLA-op lane."""
+    pids = set()
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pname = str((ev.get("args") or {}).get("name", ""))
+            if _DEVICE_LANE_RE.search(pname):
+                pids.add(ev.get("pid"))
+    return pids
+
+
 def analyze_trace(log_dir: str, top: int = 20) -> List[Dict]:
     """Aggregate device-op durations from the newest captured trace
     (↔ ProfileAnalyzer summarize): [{name, total_us, count, pct}] sorted
-    by total duration descending."""
+    by total duration descending.
+
+    Only the device/XLA-op lanes are aggregated (identified by the
+    trace's ``process_name`` metadata events): summing host-side
+    Python/runtime lanes into the totals would dilute every device op's
+    ``pct``. When the capture has no device lane (CPU backend), all
+    complete events are aggregated instead — a host-side breakdown beats
+    an empty one."""
     path = _find_trace_file(log_dir)
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rt") as fh:
         trace = json.load(fh)
     events = trace.get("traceEvents", [])
-    # device lanes: XLA op events are complete events ("ph": "X") on TPU/GPU
-    # (or CPU thread) tracks; aggregate by event name.
+    device_pids = _device_pids(events)
     agg = defaultdict(lambda: [0.0, 0])
     for ev in events:
         if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        if device_pids and ev.get("pid") not in device_pids:
             continue
         name = ev.get("name", "?")
         agg[name][0] += float(ev["dur"])
@@ -160,6 +187,20 @@ def compare_traces(log_dir_a: str, log_dir_b: str, top: int = 15) -> List[Dict]:
     return rows[:top]
 
 
+def normalize_cost_analysis(ca) -> Dict[str, float]:
+    """Flatten XLA's ``cost_analysis()`` result into a plain float dict.
+
+    jax returns a dict, a 1-element list of dicts (version-dependent), or
+    None when the backend implements no cost analysis — callers get ``{}``
+    for the latter so every consumer shares one fallback."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if ca is None:
+        return {}
+    return {k: float(v) for k, v in dict(ca).items()
+            if isinstance(v, (int, float))}
+
+
 def op_costs(fn, *example_args, top: int = 0, **jit_kwargs) -> Dict[str, float]:
     """Static whole-program cost analysis of a jitted function (↔ the
     OpProfiler's FLOP/bandwidth estimates, recast for XLA).
@@ -182,15 +223,7 @@ def op_costs(fn, *example_args, top: int = 0, **jit_kwargs) -> Dict[str, float]:
     import jax
 
     compiled = jax.jit(fn, **jit_kwargs).lower(*example_args).compile()
-    ca = compiled.cost_analysis()
-    # jax returns a dict, a 1-element list of dicts (version-dependent), or
-    # None when the backend implements no cost analysis
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else {}
-    if ca is None:
-        return {}
-    out: Dict[str, float] = {k: float(v) for k, v in dict(ca).items()
-                             if isinstance(v, (int, float))}
+    out = normalize_cost_analysis(compiled.cost_analysis())
     if top > 0:
         per_op = [(k[len("flops:"):], v) for k, v in out.items()
                   if k.startswith("flops:")]

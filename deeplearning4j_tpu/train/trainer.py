@@ -12,8 +12,12 @@ the compiler (↔ ParallelWrapper/SharedTrainingMaster replacement).
 
 from __future__ import annotations
 
+import atexit
 import dataclasses
+import os
+import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import jax
@@ -23,6 +27,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
 from deeplearning4j_tpu.ops import math as opsmath
 from deeplearning4j_tpu.train.updaters import apply_updates, resolve_updater
+
+# Background step-cost analyses (Trainer.step_flops) run XLA compiles on
+# daemon threads; the interpreter killing one mid-compile at process exit
+# segfaults inside XLA. The atexit hook stops new compiles from starting
+# and waits (bounded) for in-flight ones, so SIGTERM-preempted runs still
+# exit cleanly.
+_COST_THREADS: set = set()
+_COST_SHUTDOWN = threading.Event()
+
+
+def _join_cost_threads():
+    _COST_SHUTDOWN.set()
+    for t in list(_COST_THREADS):
+        t.join(timeout=120)
+
+
+atexit.register(_join_cost_threads)
 
 
 @jax.tree_util.register_dataclass
@@ -311,6 +332,70 @@ class Trainer:
             check_nan = get_environment().check_numerics
         self.check_nan = bool(check_nan)
         self.train_step = self._jit_with_nan_guard(train_step, jit_kwargs)
+        # analytic step-cost cache (diagnostics plane): batch-shape key ->
+        # float FLOPs | "pending" | "failed"; filled by a background
+        # compile so the fit loop never blocks on cost analysis
+        self._step_cost_cache: Dict[Any, Any] = {}
+        self._step_cost_lock = threading.Lock()
+
+    # -- analytic step cost (observability) ---------------------------------
+
+    def step_flops(self, ts: "TrainState", batch) -> Optional[float]:
+        """Analytic FLOPs of the compiled step for this batch shape, or
+        None while unknown. First call per shape kicks off a background
+        thread that lowers + compiles the step ABSTRACTLY (ShapeDtype
+        structs — no live buffers held, donation-safe) and reads XLA's
+        ``cost_analysis``; later calls return the cached number. Disable
+        with ``DL4J_TPU_STEP_COST_ANALYSIS=0`` (a second compile of a
+        huge model, even off-thread, may not be worth the gauge)."""
+        if os.environ.get("DL4J_TPU_STEP_COST_ANALYSIS", "1") == "0":
+            return None
+        key = tuple(
+            (tuple(leaf.shape), leaf.dtype)
+            for leaf in jax.tree_util.tree_leaves(batch)
+            if hasattr(leaf, "shape"))
+        with self._step_cost_lock:
+            val = self._step_cost_cache.get(key)
+            if val is None:
+                self._step_cost_cache[key] = "pending"
+        if isinstance(val, float):
+            return val
+        if val is not None:  # pending or failed
+            return None
+
+        def abstract(tree):
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+                tree)
+
+        a_ts, a_batch = abstract(ts), abstract(batch)
+
+        def _compute():
+            from deeplearning4j_tpu.train.profiling import (
+                normalize_cost_analysis,
+            )
+
+            try:
+                if _COST_SHUTDOWN.is_set():
+                    result = "failed"  # process exiting: never start a
+                else:                  # compile the exit would tear down
+                    compiled = jax.jit(
+                        self._raw_step,
+                        **self._jit_kwargs).lower(a_ts, a_batch).compile()
+                    costs = normalize_cost_analysis(compiled.cost_analysis())
+                    flops = float(costs.get("flops") or 0.0)
+                    result = flops if flops > 0 else "failed"
+            except Exception:  # noqa: BLE001 — diagnostics never kill a fit
+                result = "failed"
+            with self._step_cost_lock:
+                self._step_cost_cache[key] = result
+            _COST_THREADS.discard(threading.current_thread())
+
+        t = threading.Thread(target=_compute, daemon=True,
+                             name="step-cost-analysis")
+        _COST_THREADS.add(t)
+        t.start()
+        return None
 
     def _finish_step(self, ts: TrainState, grads, new_model_state, metrics,
                      loss, batch):
@@ -597,6 +682,7 @@ class Trainer:
         # switch costs nothing in the loop. None of it syncs the device —
         # step_seconds measures the host loop's dispatch pace.
         om = _training_metrics()
+        tele = _StepTelemetry(self, om) if om is not None else None
         # on_fit_end must run even when a step raises (non-finite loss,
         # OOM, interrupt): listeners hold resources whose teardown
         # re-raises swallowed failures (async checkpoint writers).
@@ -612,9 +698,10 @@ class Trainer:
                         batch = next(it)
                     except StopIteration:
                         break
+                    read_s = (time.perf_counter() - t_read
+                              if om is not None else 0.0)
                     if om is not None:
-                        om.data_read_seconds.observe(
-                            time.perf_counter() - t_read)
+                        om.data_read_seconds.observe(read_s)
                     batch = _as_batch_dict(batch)
                     if _fault_injector().enabled:
                         # "train.step_nan" poison-batch injection point
@@ -634,10 +721,13 @@ class Trainer:
                         ts, metrics = self.train_step(ts, batch)
                         wmetrics = [metrics]
                     if om is not None:
-                        om.step_seconds.observe(time.perf_counter() - t_step)
+                        step_s = time.perf_counter() - t_step
+                        om.step_seconds.observe(step_s)
                         om.steps_total.inc(len(wmetrics))
                         feats = jax.tree_util.tree_leaves(batch["features"])
                         om.samples_total.inc(feats[0].shape[0])
+                        tele.on_step(ts, batch, read_s, step_s,
+                                     host_step + len(wmetrics))
                     n += 1
                     for wm in wmetrics:
                         host_step += 1
@@ -653,6 +743,11 @@ class Trainer:
                         stop = True
                 if om is not None:
                     om.epochs_total.inc()
+                    from deeplearning4j_tpu.observability.flightrecorder import (  # noqa: E501
+                        record_event,
+                    )
+
+                    record_event("train.epoch", epoch=epoch, steps=n)
                 if hasattr(data, "reset"):
                     data.reset()
                 if stop:
@@ -669,6 +764,99 @@ def _training_metrics():
     from deeplearning4j_tpu.observability import metrics as _obsm
 
     return _obsm.get_training_metrics() if _obsm.enabled() else None
+
+
+class _StepTelemetry:
+    """Per-fit diagnostics feeding the shared registry + flight recorder:
+
+    - analytic-MFU gauges: the step's XLA cost-model FLOPs (computed once
+      per batch shape off-thread by ``Trainer.step_flops``) over the
+      measured host step wall-time → ``train_step_flops`` /
+      ``train_flops_per_second`` / ``train_analytic_mfu`` (the last only
+      when ``DL4J_TPU_PEAK_FLOPS`` declares the chip peak);
+    - data-starvation detector: when data-read latency exceeds
+      ``STARVE_FRACTION`` of recent loop wall-time, the input pipeline —
+      not the chip — is the bottleneck: ``train_data_starved`` flips to 1
+      and the transition lands in the flight recorder;
+    - sampled ``train.step`` flight events (every ``STEP_EVENT_EVERY``-th
+      step + the first) so crash timelines carry training progress
+      without flooding the ring at ms-scale step rates.
+
+    Used by both ``Trainer.fit`` and ``FaultTolerantTrainer.fit``; all
+    methods are host-side arithmetic — nothing here syncs the device.
+    """
+
+    WINDOW = 32
+    MIN_STEPS = 8
+    STARVE_FRACTION = 0.5
+    STEP_EVENT_EVERY = 16
+
+    def __init__(self, trainer: "Trainer", om):
+        self.trainer = trainer
+        self.om = om
+        self._samples: deque = deque(maxlen=self.WINDOW)
+        self._read_sum = 0.0
+        self._step_sum = 0.0
+        self._starved = False
+        # resolved-FLOPs fast path keyed by the features shape: the full
+        # step_flops cache key (every leaf's shape+dtype) costs ~10 µs a
+        # step — too much for a per-step hot loop once the answer is known
+        self._flops_by_shape: Dict[Any, float] = {}
+        try:
+            self._peak = float(os.environ.get("DL4J_TPU_PEAK_FLOPS", "0"))
+        except ValueError:
+            self._peak = 0.0
+
+    def on_step(self, ts, batch, read_s: float, step_s: float,
+                step_no: int):
+        from deeplearning4j_tpu.observability.flightrecorder import (
+            record_event,
+        )
+
+        om = self.om
+        # throughput gauges refresh on the sampled cadence: a gauge is a
+        # last-value instrument, and three .set() locks per step is real
+        # money on a ~1 ms step
+        if step_no == 1 or step_no % self.STEP_EVENT_EVERY == 0:
+            shape_key = getattr(batch.get("features"), "shape", None) \
+                if isinstance(batch, dict) else None
+            flops = (self._flops_by_shape.get(shape_key)
+                     if shape_key else None)
+            if flops is None:
+                flops = self.trainer.step_flops(ts, batch)
+                if flops and shape_key is not None:
+                    self._flops_by_shape[shape_key] = flops
+            if flops:
+                om.step_flops.set(flops)
+                if step_s > 0:
+                    fps = flops / step_s
+                    om.flops_per_second.set(fps)
+                    if self._peak > 0:
+                        om.analytic_mfu.set(fps / self._peak)
+        # rolling read-vs-step attribution over the trailing window
+        if len(self._samples) == self._samples.maxlen:
+            old_r, old_s = self._samples[0]
+            self._read_sum -= old_r
+            self._step_sum -= old_s
+        self._samples.append((read_s, step_s))
+        self._read_sum += read_s
+        self._step_sum += step_s
+        if len(self._samples) >= self.MIN_STEPS:
+            wall = self._read_sum + self._step_sum
+            starved = (wall > 0 and
+                       self._read_sum / wall > self.STARVE_FRACTION)
+            if starved != self._starved:
+                self._starved = starved
+                om.data_starved.set(1.0 if starved else 0.0)
+                record_event(
+                    "train.data_starvation" if starved
+                    else "train.data_recovered",
+                    step=step_no,
+                    read_fraction=round(self._read_sum / wall, 3))
+        if step_no == 1 or step_no % self.STEP_EVENT_EVERY == 0:
+            record_event("train.step", step=step_no,
+                         seconds=round(step_s, 6),
+                         read_seconds=round(read_s, 6))
 
 
 def _record_batch_transfer(batch):

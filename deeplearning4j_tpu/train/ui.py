@@ -17,7 +17,10 @@ Usage::
     server.stop()
 
 Endpoints: ``/`` dashboard, ``/api/runs`` run listing,
-``/api/metrics?run=<name>`` the run's scalar series.
+``/api/metrics?run=<name>`` the run's scalar series, ``/health`` the
+live in-process health page (current SLO alert states from the process
+default :class:`~deeplearning4j_tpu.observability.slo.HealthEngine`
+plus the default-registry scrape), ``/api/health`` its JSON twin.
 """
 
 from __future__ import annotations
@@ -203,6 +206,78 @@ class UIServer:
             return series
         return {}
 
+    # -- live health (in-process SLO states + default-registry scrape) ------
+
+    def health(self) -> dict:
+        """JSON health: current SLO states from the process-default
+        engine (None when no engine is published — e.g. a UI server
+        pointed at another process's run files) + the live
+        default-registry metrics document."""
+        from deeplearning4j_tpu.observability import metrics as _om
+        from deeplearning4j_tpu.observability import slo as _slo
+
+        engine = _slo.get_default_engine()
+        return {
+            "slo": engine.tick() if engine is not None else None,
+            "metrics": _om.render_json_multi([_om.default_registry()]),
+        }
+
+    def health_page(self) -> str:
+        """Server-rendered /health HTML: SLO alert table + the live
+        default-registry scrape, so the zero-install dashboard answers
+        "is training healthy?" — not just "what are the series?"."""
+        import html as _html
+
+        from deeplearning4j_tpu.observability import metrics as _om
+        from deeplearning4j_tpu.observability import slo as _slo
+
+        engine = _slo.get_default_engine()
+        rows = []
+        if engine is None:
+            slo_block = ("<p>no SLO engine running in this process "
+                         "(a ModelServer or HealthEngine.start() "
+                         "publishes one)</p>")
+        else:
+            h = engine.tick()
+            for r in h["rules"]:
+                burn = "; ".join(
+                    f"{w['short']:.2f}/{w['long']:.2f} (x{w['burn']:g})"
+                    for w in r["windows"])
+                rows.append(
+                    f"<tr class='{_html.escape(r['state'])}'>"
+                    f"<td>{_html.escape(r['name'])}</td>"
+                    f"<td>{_html.escape(r['state'].upper())}</td>"
+                    f"<td>{r['objective']:g}</td>"
+                    f"<td>{r['bad']:g}/{r['total']:g}</td>"
+                    f"<td>{_html.escape(burn)}</td></tr>")
+            slo_block = (
+                f"<p>overall: <b>{_html.escape(h['status'].upper())}</b></p>"
+                "<table><tr><th>rule</th><th>state</th><th>objective</th>"
+                "<th>bad/total</th><th>burn short/long (threshold)</th></tr>"
+                + "".join(rows) + "</table>")
+        scrape = _html.escape(
+            _om.render_text_multi([_om.default_registry()]))
+        return f"""<!DOCTYPE html>
+<html><head><title>deeplearning4j-tpu health</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 1.5rem; }}
+ h1 {{ font-size: 1.2rem; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #ddd; padding: .3rem .6rem;
+           font-size: .85rem; }}
+ tr.firing td {{ background: #fee2e2; }}
+ tr.pending td {{ background: #fef9c3; }}
+ tr.resolved td {{ background: #dbeafe; }}
+ pre {{ background: #fafafa; border: 1px solid #ddd; padding: .8rem;
+        font-size: .75rem; overflow-x: auto; }}
+</style></head>
+<body><h1>training health</h1>
+{slo_block}
+<h1>live metrics (process default registry)</h1>
+<pre>{scrape}</pre>
+</body></html>"""
+
     # -- server ------------------------------------------------------------
 
     @property
@@ -272,6 +347,12 @@ class UIServer:
                 if url.path == "/":
                     body = _PAGE.encode()
                     ctype = "text/html"
+                elif url.path == "/health":
+                    body = ui.health_page().encode()
+                    ctype = "text/html"
+                elif url.path == "/api/health":
+                    body = json.dumps(ui.health()).encode()
+                    ctype = "application/json"
                 elif url.path == "/api/runs":
                     body = json.dumps(ui.runs()).encode()
                     ctype = "application/json"

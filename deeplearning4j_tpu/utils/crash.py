@@ -57,9 +57,12 @@ def write_crash_report(
     step: Optional[int] = None,
     recent_losses: Optional[List[float]] = None,
     extra: Optional[Dict[str, Any]] = None,
+    flight_window_s: Optional[float] = 120.0,
 ) -> str:
     """Write ``dl4j-tpu-crash-<ts>.json`` and return its path
-    (↔ CrashReportingUtil.writeMemoryCrashDump)."""
+    (↔ CrashReportingUtil.writeMemoryCrashDump). The report includes the
+    flight recorder's trailing ``flight_window_s`` seconds of events
+    (None = the whole ring)."""
     global _LAST_REPORT
     import jax
 
@@ -90,6 +93,18 @@ def write_crash_report(
             report["model_config"] = repr(getattr(model, "config", model))[:4000]
     if extra:
         report["extra"] = extra
+    try:
+        # black-box timeline: the flight recorder's trailing window rides
+        # in every crash dump, so "what happened just before?" is
+        # answerable from the report alone (observability/flightrecorder)
+        from deeplearning4j_tpu.observability.flightrecorder import (
+            get_flight_recorder,
+        )
+
+        report["flight_recorder"] = get_flight_recorder().dump(
+            last_seconds=flight_window_s)
+    except Exception:  # noqa: BLE001 - telemetry must never mask the crash
+        pass
 
     os.makedirs(directory, exist_ok=True)
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")

@@ -1,0 +1,447 @@
+"""Diagnostics plane tests: flight recorder, crash-report timeline,
+ModelServer /debug/* endpoints, and the end-to-end SLO acceptance story —
+a server under injected serving.error/serving.latency faults drives the
+availability and latency SLOs through ok → pending → firing and back to
+resolved after the faults clear, with the alert transitions present in
+the flight-recorder dump attached to a forced crash report."""
+
+import base64
+import gzip
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import flightrecorder as fr
+from deeplearning4j_tpu.observability import metrics as om
+from deeplearning4j_tpu.observability import slo
+from deeplearning4j_tpu.resilience.faults import (
+    FaultInjector,
+    set_fault_injector,
+)
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer, spec
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    om.reset_default_registry()
+    fr.set_flight_recorder(None)
+    om.set_enabled(True)
+    fr.set_recording(True)
+    slo.set_default_engine(None)
+    set_fault_injector(FaultInjector())  # empty: no faults armed
+    yield
+    set_fault_injector(None)
+    slo.set_default_engine(None)
+    om.reset_default_registry()
+    fr.set_flight_recorder(None)
+
+
+def _forward(v, x):
+    return jnp.tanh(x @ v["w"])
+
+
+def _server(**kw):
+    registry = ModelRegistry()
+    registry.register(
+        "tiny", _forward,
+        {"w": jnp.asarray(np.random.default_rng(0).normal(size=(4, 3)),
+                          jnp.float32)},
+        input_spec=spec((4,)), version="v1", mode="batched",
+        max_batch_size=8, devices=jax.devices()[:1])
+    return ModelServer(registry, port=0, **kw)
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _wait_for(predicate, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder unit
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_dropped_counter(self):
+        rec = fr.FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record("k", i=i)
+        assert len(rec) == 8
+        assert rec.dropped_total == 12
+        evs = rec.events()
+        assert [e["data"]["i"] for e in evs] == list(range(12, 20))
+        d = rec.dump()
+        assert d["capacity"] == 8 and d["dropped_total"] == 12
+        assert d["count"] == 8
+
+    def test_last_seconds_window_and_kind_filter(self):
+        rec = fr.FlightRecorder()
+        old = rec.record("old")
+        old["t"] -= 3600.0  # age it an hour
+        rec.record("new", x=1)
+        assert [e["kind"] for e in rec.events(last_seconds=60)] == ["new"]
+        assert [e["kind"] for e in rec.events(kinds=["old"])] == ["old"]
+        assert rec.dump(last_seconds=60)["count"] == 1
+
+    def test_data_never_clobbers_envelope(self):
+        rec = fr.FlightRecorder()
+        ev = rec.record("k", t="not-a-time", kind="not-a-kind")
+        assert isinstance(ev["t"], float)
+        assert ev["kind"] == "k"
+        assert ev["data"] == {"t": "not-a-time", "kind": "not-a-kind"}
+
+    def test_recording_kill_switch(self):
+        fr.set_recording(False)
+        try:
+            assert fr.record_event("k") is None
+            assert len(fr.get_flight_recorder()) == 0
+        finally:
+            fr.set_recording(True)
+        assert fr.record_event("k") is not None
+
+    def test_snapshot_registries_compact(self):
+        reg = om.MetricsRegistry()
+        c = reg.counter("reqs_total", "t", ("code",))
+        c.inc(3, code="200")
+        c.inc(2, code="500")
+        h = reg.histogram("lat_seconds", "t")
+        h.observe(0.01), h.observe(0.02)
+        ev = fr.FlightRecorder().snapshot_registries([reg])
+        assert ev["data"]["series"] == {"reqs_total": 5.0,
+                                        "lat_seconds_count": 2.0}
+
+    def test_events_json_serializable(self):
+        rec = fr.FlightRecorder()
+        rec.record("k", nested={"a": [1, 2]}, s="x")
+        json.dumps(rec.dump())  # must not raise
+
+    def test_crash_report_ships_timeline(self, tmp_path):
+        from deeplearning4j_tpu.utils.crash import write_crash_report
+
+        fr.record_event("marker.event", detail="pre-crash breadcrumb")
+        path = write_crash_report(str(tmp_path),
+                                  exception=RuntimeError("boom"))
+        report = json.loads(open(path).read())
+        evs = report["flight_recorder"]["events"]
+        assert any(e["kind"] == "marker.event" and
+                   e["data"]["detail"] == "pre-crash breadcrumb"
+                   for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# producers across layers
+
+
+class TestProducers:
+    def test_admission_shed_recorded(self):
+        from deeplearning4j_tpu.serving.admission import AdmissionController
+        from deeplearning4j_tpu.serving.errors import QueueFullError
+
+        ac = AdmissionController(max_in_flight=1)
+        t1 = ac.admit()
+        with pytest.raises(QueueFullError):
+            ac.admit()
+        t1.release()
+        evs = fr.get_flight_recorder().events(
+            kinds=["serving.admission_cap"])
+        assert evs and evs[-1]["data"]["in_flight"] == 1
+
+    def test_fault_injection_recorded(self):
+        inj = FaultInjector().plan("serving.error", at=1)
+        assert inj.fire("serving.error") is not None
+        evs = fr.get_flight_recorder().events(kinds=["fault.injected"])
+        assert evs[-1]["data"]["point"] == "serving.error"
+
+    def test_rollback_and_quarantine_recorded(self, tmp_path):
+        from deeplearning4j_tpu.serde.checkpoint import (
+            quarantine_checkpoint,
+            verify_checkpoint,
+        )
+
+        ckpt = tmp_path / "ckpt-000001"
+        ckpt.mkdir()
+        ok, reason = verify_checkpoint(ckpt)  # missing state.npz
+        assert not ok
+        evs = fr.get_flight_recorder().events(
+            kinds=["checkpoint.verify_failed"])
+        assert evs and reason in evs[-1]["data"]["reason"]
+        assert quarantine_checkpoint(ckpt, reason="test") is not None
+        assert fr.get_flight_recorder().events(
+            kinds=["checkpoint.quarantined"])
+
+    def test_data_starvation_detector_transitions(self):
+        from deeplearning4j_tpu.train.trainer import _StepTelemetry
+
+        tm = om.get_training_metrics()
+
+        class _NoFlops:
+            def step_flops(self, ts, batch):
+                return None
+
+        tele = _StepTelemetry(_NoFlops(), tm)
+        # reads dominate the loop: starved flips on after MIN_STEPS
+        for i in range(1, tele.MIN_STEPS + 1):
+            tele.on_step(None, None, read_s=0.09, step_s=0.01, step_no=i)
+        assert tm.data_starved.value() == 1.0
+        evs = fr.get_flight_recorder().events(
+            kinds=["train.data_starvation"])
+        assert evs and evs[-1]["data"]["read_fraction"] > 0.5
+        # fast reads for a full window: recovers
+        for i in range(tele.MIN_STEPS + 1, tele.MIN_STEPS + tele.WINDOW + 2):
+            tele.on_step(None, None, read_s=0.0001, step_s=0.01, step_no=i)
+        assert tm.data_starved.value() == 0.0
+        assert fr.get_flight_recorder().events(
+            kinds=["train.data_recovered"])
+
+    def test_trainer_fit_records_sampled_steps_and_epochs(self):
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        from deeplearning4j_tpu.nn.config import (
+            NeuralNetConfiguration,
+            SequentialConfig,
+        )
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import SequentialModel
+        from deeplearning4j_tpu.train.trainer import Trainer
+
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(seed=0),
+            layers=[Dense(units=4, activation="tanh"),
+                    OutputLayer(units=2, activation="softmax",
+                                loss="mcxent")],
+            input_shape=(6,)))
+        trainer = Trainer(model)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        trainer.fit(trainer.init_state(),
+                    ArrayDataSetIterator(x, y, batch_size=8), epochs=2)
+        rec = fr.get_flight_recorder()
+        steps = rec.events(kinds=["train.step"])
+        assert steps and steps[0]["data"]["step"] == 1
+        epochs = rec.events(kinds=["train.epoch"])
+        assert [e["data"]["epoch"] for e in epochs] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# /debug/* endpoints
+
+
+class TestDebugEndpoints:
+    @pytest.fixture()
+    def server(self):
+        s = _server(slo_interval_s=0.05,
+                    slo_time_scale=1.0 / 600.0).start()
+        yield s
+        s.stop()
+
+    def test_debug_health(self, server):
+        status, body = _get(f"{server.url}/debug/health")
+        assert status == 200
+        h = json.loads(body)
+        assert h["status"] == "ok"
+        names = {r["name"] for r in h["rules"]}
+        assert names == {"serving-availability", "serving-latency-p99"}
+        for r in h["rules"]:
+            assert r["state"] == "ok"
+            assert r["windows"][0]["burn"] > 0
+        status, body = _get(f"{server.url}/debug/health?format=text")
+        assert status == 200
+        assert b"serving-availability" in body
+
+    def test_debug_flightrecorder(self, server):
+        status, body = _get(f"{server.url}/debug/flightrecorder")
+        assert status == 200
+        d = json.loads(body)
+        assert any(e["kind"] == "serving.start" for e in d["events"])
+        status, body = _get(
+            f"{server.url}/debug/flightrecorder?seconds=0.000001")
+        assert json.loads(body)["count"] <= 2
+        status, _ = _get(f"{server.url}/debug/flightrecorder?seconds=zzz")
+        assert status == 400
+
+    def test_debug_costs(self, server):
+        status, body = _get(f"{server.url}/debug/costs")
+        assert status == 200
+        models = json.loads(body)["models"]
+        assert len(models) == 1
+        m = models[0]
+        assert m["model"] == "tiny" and m["version"] == "v1"
+        assert m["available"] is True
+        assert m["rows"] == 8
+        assert m["flops"] > 0
+        assert m["flops_per_row"] == pytest.approx(m["flops"] / 8)
+        # arithmetic intensity present when the backend reports bytes
+        if m.get("bytes_accessed"):
+            assert m["arithmetic_intensity"] == pytest.approx(
+                m["flops"] / m["bytes_accessed"])
+        # rows override analyzes a different bucket
+        status, body = _get(f"{server.url}/debug/costs?rows=1")
+        assert json.loads(body)["models"][0]["rows"] == 1
+
+    def test_debug_profile_live_traffic(self, server):
+        stop = threading.Event()
+
+        def traffic():
+            while not stop.is_set():
+                _post(f"{server.url}/v1/models/tiny:predict",
+                      {"inputs": [[0.1, 0.2, 0.3, 0.4]]})
+                # breathe: a zero-gap hammer loop contends with the
+                # profiler's stop/flush on a loaded CI host
+                time.sleep(0.005)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        try:
+            # generous read timeout: trace serialization + analysis after
+            # stop_trace can take a while in a long-lived test process
+            status, body = _post(f"{server.url}/debug/profile?ms=400", {},
+                                 timeout=180)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert status == 200, body
+        assert body["duration_ms"] >= 400
+        # non-empty op breakdown from the live capture
+        assert body["ops"], body
+        assert all(r["total_us"] >= 0 for r in body["ops"])
+        # the returned trace is loadable Perfetto/Chrome JSON
+        raw = gzip.decompress(base64.b64decode(body["trace_gz_b64"]))
+        trace = json.loads(raw)
+        assert trace["traceEvents"]
+
+    def test_debug_profile_validates_ms(self, server):
+        status, _ = _post(f"{server.url}/debug/profile?ms=0", {})
+        assert status == 400
+        status, _ = _post(f"{server.url}/debug/profile?ms=99999999", {})
+        assert status == 400
+        status, _ = _post(f"{server.url}/debug/profile?ms=abc", {})
+        assert status == 400
+
+    def test_server_publishes_default_engine(self, server):
+        assert slo.get_default_engine() is server.slo_engine
+        assert server.slo_engine.running
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance: faults drive the SLOs through the full cycle
+
+
+class TestEndToEndSLO:
+    def test_faults_drive_slo_cycle_and_crash_report(self, tmp_path):
+        # scaled-down rules: 0.5 s / 2 s windows, 0.1 s for/hold
+        scale = 1.0 / 600.0
+        rules = [
+            slo.SLORule(
+                name="availability", kind="availability", objective=0.99,
+                total=slo.Selector("serving_requests_total"),
+                bad=slo.Selector("serving_requests_total",
+                                 match=(("code", "429|5.."),)),
+                windows=(slo.BurnWindow(300.0, 1200.0, 2.0),),
+                for_s=60.0, resolve_hold_s=60.0),
+            slo.SLORule(
+                name="latency", kind="latency", objective=0.9,
+                threshold_s=0.05,
+                histogram=slo.Selector("serving_request_latency_seconds"),
+                windows=(slo.BurnWindow(300.0, 1200.0, 2.0),),
+                for_s=60.0, resolve_hold_s=60.0),
+        ]
+        server = _server(slo_rules=rules, slo_interval_s=0.05,
+                         slo_time_scale=scale).start()
+        stop = threading.Event()
+
+        def traffic():
+            while not stop.is_set():
+                _post(f"{server.url}/v1/models/tiny:predict",
+                      {"inputs": [[0.1, 0.2, 0.3, 0.4]]})
+                time.sleep(0.01)
+
+        driver = threading.Thread(target=traffic, daemon=True)
+        engine = server.slo_engine
+        seen = {"availability": set(), "latency": set()}
+
+        def note_states():
+            for name, st in engine.states().items():
+                seen[name].add(st)
+
+        try:
+            driver.start()
+            # phase 1: healthy traffic
+            assert _wait_for(lambda: (note_states(),
+                                      engine.states() == {
+                                          "availability": "ok",
+                                          "latency": "ok"})[1])
+            # phase 2: inject latency (0.12 s >> 0.05 s threshold) +
+            # overload sheds (429) on every request
+            set_fault_injector(
+                FaultInjector()
+                .plan("serving.latency", at=1, times=10**9, arg=0.12)
+                .plan("serving.error", at=1, times=10**9))
+            assert _wait_for(
+                lambda: (note_states(),
+                         engine.states() == {"availability": "firing",
+                                             "latency": "firing"})[1],
+                timeout=30), engine.states()
+            # phase 3: crash WHILE firing — the report must carry the
+            # alert timeline
+            from deeplearning4j_tpu.utils.crash import write_crash_report
+
+            path = write_crash_report(
+                str(tmp_path), exception=RuntimeError("forced post-mortem"))
+            # phase 4: faults clear; windows slide; alerts resolve
+            set_fault_injector(FaultInjector())
+            assert _wait_for(
+                lambda: (note_states(),
+                         all(st == "ok"
+                             for st in engine.states().values()))[1],
+                timeout=30), engine.states()
+        finally:
+            stop.set()
+            driver.join(timeout=10)
+            server.stop()
+        # the full state machine was traversed for BOTH rules
+        for rule in ("availability", "latency"):
+            assert {"ok", "pending", "firing"} <= seen[rule], seen
+        report = json.loads(open(path).read())
+        evs = report["flight_recorder"]["events"]
+        fired = [(e["data"]["rule"], e["data"]["to"]) for e in evs
+                 if e["kind"] == "slo.transition"]
+        assert ("availability", "firing") in fired
+        assert ("latency", "firing") in fired
+        # the injected faults are on the same timeline
+        assert any(e["kind"] == "fault.injected" for e in evs)
+        # resolution transitions landed in the live ring after the dump
+        ring = fr.get_flight_recorder().events(kinds=["slo.transition"])
+        resolved = [(e["data"]["rule"], e["data"]["to"]) for e in ring]
+        assert ("availability", "resolved") in resolved
+        assert ("latency", "resolved") in resolved

@@ -1,0 +1,367 @@
+"""SLO / burn-rate engine tests (observability/slo.py): rule parsing +
+offline validation (the --check CLI contract), burn-rate math over
+synthetic counter timelines with an injected clock, the full
+ok → pending → firing → resolved → ok alert state machine, and the
+slo_* metric family + flight-recorder transition events."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.observability import flightrecorder as fr
+from deeplearning4j_tpu.observability import metrics as om
+from deeplearning4j_tpu.observability import slo
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+EXAMPLE_RULES = "deeplearning4j_tpu/observability/example_rules.json"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    om.reset_default_registry()
+    fr.set_flight_recorder(None)
+    om.set_enabled(True)
+    fr.set_recording(True)
+    yield
+    om.reset_default_registry()
+    fr.set_flight_recorder(None)
+
+
+# ---------------------------------------------------------------------------
+# rule parsing + validation
+
+
+class TestValidation:
+    def test_example_rules_are_valid(self):
+        with open(EXAMPLE_RULES) as fh:
+            doc = json.load(fh)
+        rules, errors = slo.validate_rules_doc(
+            doc, known=slo.known_metric_names())
+        assert errors == []
+        assert {r.name for r in rules} == {
+            "serving-availability", "serving-latency-p99",
+            "train-data-pipeline"}
+
+    def test_default_serving_rules_match_example_vocabulary(self):
+        known = slo.known_metric_names()
+        for rule in slo.default_serving_rules():
+            for name in rule.metric_names():
+                assert name in known
+
+    def test_unknown_metric_name_rejected(self):
+        doc = {"rules": [{
+            "name": "r", "kind": "availability", "objective": 0.99,
+            "total": {"metric": "no_such_metric"},
+            "bad": {"metric": "serving_requests_total"}}]}
+        _, errors = slo.validate_rules_doc(
+            doc, known=slo.known_metric_names())
+        assert any("unknown metric name 'no_such_metric'" in e
+                   for e in errors)
+
+    @pytest.mark.parametrize("objective", [0.0, 1.0, 1.5, -0.1, "high"])
+    def test_malformed_objective_rejected(self, objective):
+        doc = [{"name": "r", "kind": "availability", "objective": objective,
+                "total": {"metric": "serving_requests_total"},
+                "bad": {"metric": "serving_requests_total"}}]
+        _, errors = slo.validate_rules_doc(doc)
+        assert any("objective" in e for e in errors)
+
+    def test_overlapping_windows_rejected(self):
+        base = {"name": "r", "kind": "availability", "objective": 0.9,
+                "total": {"metric": "serving_requests_total"},
+                "bad": {"metric": "serving_requests_total"}}
+        # short >= long
+        doc = [dict(base, windows=[
+            {"short_s": 600, "long_s": 600, "burn": 2}])]
+        _, errors = slo.validate_rules_doc(doc)
+        assert any("overlapping window" in e for e in errors)
+        # duplicate pair
+        doc = [dict(base, windows=[
+            {"short_s": 60, "long_s": 600, "burn": 2},
+            {"short_s": 60, "long_s": 600, "burn": 4}])]
+        _, errors = slo.validate_rules_doc(doc)
+        assert any("duplicate pair" in e for e in errors)
+
+    def test_kind_selector_mismatch_rejected(self):
+        doc = [{"name": "r", "kind": "latency", "objective": 0.99,
+                "threshold_s": 0.1,
+                "histogram": {"metric": "serving_request_latency_seconds"},
+                "total": {"metric": "serving_requests_total"}}]
+        _, errors = slo.validate_rules_doc(doc)
+        assert any("latency rules take" in e for e in errors)
+
+    def test_bad_regex_and_duplicate_names_rejected(self):
+        doc = [
+            {"name": "r", "kind": "availability", "objective": 0.9,
+             "total": {"metric": "serving_requests_total"},
+             "bad": {"metric": "serving_requests_total",
+                     "match": {"code": "[unclosed"}}},
+            {"name": "r", "kind": "availability", "objective": 0.9,
+             "total": {"metric": "serving_requests_total"},
+             "bad": {"metric": "serving_requests_total"}},
+        ]
+        _, errors = slo.validate_rules_doc(doc)
+        assert any("bad regex" in e for e in errors)
+        assert any("duplicate rule name" in e for e in errors)
+
+    def test_valid_rules_survive_alongside_broken_ones(self):
+        doc = [
+            {"name": "good", "kind": "availability", "objective": 0.9,
+             "total": {"metric": "serving_requests_total"},
+             "bad": {"metric": "serving_requests_total"}},
+            {"name": "bad", "kind": "nope", "objective": 0.9},
+        ]
+        rules, errors = slo.validate_rules_doc(doc)
+        assert [r.name for r in rules] == ["good"]
+        assert errors
+
+
+# ---------------------------------------------------------------------------
+# --check CLI
+
+
+class TestCheckCLI:
+    def test_shipped_example_rules_pass(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.observability.slo",
+             "--check", EXAMPLE_RULES],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "ok: 3 rule(s) valid" in out.stdout
+
+    def test_bad_rules_exit_nonzero(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"rules": [
+            {"name": "r", "kind": "availability", "objective": 2.0,
+             "total": {"metric": "nope"},
+             "bad": {"metric": "serving_requests_total"}}]}))
+        out = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.observability.slo",
+             "--check", str(bad)],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode != 0
+        assert "unknown metric name" in out.stderr
+        assert "objective" in out.stderr
+
+    def test_unreadable_file_exit_nonzero(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.observability.slo",
+             "--check", str(tmp_path / "missing.json")],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode != 0
+
+    def test_known_flag_accepts_custom_families(self, tmp_path):
+        rules = tmp_path / "custom.json"
+        rules.write_text(json.dumps({"rules": [
+            {"name": "custom", "kind": "availability", "objective": 0.99,
+             "total": {"metric": "myapp_requests_total"},
+             "bad": {"metric": "myapp_requests_total",
+                     "match": {"code": "5.."}}}]}))
+        out = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.observability.slo",
+             "--check", str(rules), "--known", "myapp_requests_total"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math + state machine (injected clock, deterministic)
+
+
+def _avail_rule(**kw):
+    defaults = dict(
+        name="avail", kind="availability", objective=0.9,
+        total=slo.Selector("serving_requests_total"),
+        bad=slo.Selector("serving_requests_total",
+                         match=(("code", "429|5.."),)),
+        windows=(slo.BurnWindow(10.0, 40.0, 2.0),),
+        for_s=2.0, resolve_hold_s=2.0)
+    defaults.update(kw)
+    return slo.SLORule(**defaults)
+
+
+class TestBurnRate:
+    def test_no_traffic_means_zero_burn(self):
+        sm = ServingMetrics()
+        eng = slo.HealthEngine([_avail_rule()], registries=[sm.registry],
+                               interval_s=1.0, clock=lambda: 0.0,
+                               snapshot_every_s=0)
+        h = eng.tick()
+        w = h["rules"][0]["windows"][0]
+        assert w["short"] == 0.0 and w["long"] == 0.0
+        assert h["rules"][0]["state"] == "ok"
+
+    def test_burn_rate_is_error_rate_over_budget(self):
+        sm = ServingMetrics()
+        clock = [0.0]
+        eng = slo.HealthEngine([_avail_rule()], registries=[sm.registry],
+                               interval_s=1.0, clock=lambda: clock[0],
+                               snapshot_every_s=0)
+        eng.tick()
+        # 5% errors against a 10% budget => burn 0.5
+        clock[0] = 1.0
+        sm.requests_total.inc(95, model="m", code="200")
+        sm.requests_total.inc(5, model="m", code="503")
+        h = eng.tick()
+        w = h["rules"][0]["windows"][0]
+        assert w["short"] == pytest.approx(0.5)
+        assert w["long"] == pytest.approx(0.5)
+
+    def test_short_window_recovers_before_long(self):
+        sm = ServingMetrics()
+        clock = [0.0]
+        eng = slo.HealthEngine(
+            [_avail_rule(windows=(slo.BurnWindow(2.0, 100.0, 1.0),))],
+            registries=[sm.registry], interval_s=1.0,
+            clock=lambda: clock[0], snapshot_every_s=0)
+        eng.tick()  # baseline sample at t=0 (deltas start here)
+        # error burst lands between t=0 and t=1, then clean traffic
+        clock[0] = 1.0
+        sm.requests_total.inc(10, model="m", code="500")
+        eng.tick()
+        for t in range(2, 8):
+            clock[0] = float(t)
+            sm.requests_total.inc(10, model="m", code="200")
+            h = eng.tick()
+        w = h["rules"][0]["windows"][0]
+        # the 2 s window slid past the error burst; the 100 s window has not
+        assert w["short"] == 0.0
+        assert w["long"] > 0.0
+
+    def test_latency_rule_counts_over_threshold_as_bad(self):
+        sm = ServingMetrics()
+        rule = slo.SLORule(
+            name="lat", kind="latency", objective=0.9, threshold_s=0.1,
+            histogram=slo.Selector("serving_request_latency_seconds"),
+            windows=(slo.BurnWindow(10.0, 40.0, 1.0),),
+            for_s=0.0, resolve_hold_s=2.0)
+        clock = [0.0]
+        eng = slo.HealthEngine([rule], registries=[sm.registry],
+                               interval_s=1.0, clock=lambda: clock[0],
+                               snapshot_every_s=0)
+        eng.tick()
+        clock[0] = 1.0
+        for _ in range(8):
+            sm.request_latency.observe(0.01, model="m")   # good
+        for _ in range(2):
+            sm.request_latency.observe(0.2, model="m")    # > 0.1 s: bad
+        h = eng.tick()
+        r = h["rules"][0]
+        assert r["total"] == 10
+        assert r["bad"] == 2
+        # 20% slow against a 10% budget => burn 2.0
+        assert r["windows"][0]["short"] == pytest.approx(2.0)
+
+
+class TestStateMachine:
+    def _engine(self, sm, **rule_kw):
+        clock = [0.0]
+        eng = slo.HealthEngine([_avail_rule(**rule_kw)],
+                               registries=[sm.registry], interval_s=1.0,
+                               clock=lambda: clock[0], snapshot_every_s=0)
+        return eng, clock
+
+    def test_full_cycle_ok_pending_firing_resolved_ok(self):
+        sm = ServingMetrics()
+        eng, clock = self._engine(sm)
+        eng.tick()
+        assert eng.states() == {"avail": "ok"}
+        # sustained 100% errors: pending, then firing after for_s
+        for t in (1, 2, 3, 4):
+            clock[0] = float(t)
+            sm.requests_total.inc(50, model="m", code="429")
+            eng.tick()
+        assert eng.states() == {"avail": "firing"}
+        # clean traffic slides the windows past the burst: resolved
+        for t in range(5, 60):
+            clock[0] = float(t)
+            sm.requests_total.inc(50, model="m", code="200")
+            eng.tick()
+        assert eng.states() == {"avail": "ok"}
+        transitions = [(e["data"]["from"], e["data"]["to"])
+                       for e in fr.get_flight_recorder().events(
+                           kinds=["slo.transition"])]
+        assert transitions == [("ok", "pending"), ("pending", "firing"),
+                               ("firing", "resolved"), ("resolved", "ok")]
+
+    def test_blip_shorter_than_for_never_fires(self):
+        sm = ServingMetrics()
+        eng, clock = self._engine(sm, for_s=5.0)
+        eng.tick()
+        clock[0] = 1.0
+        sm.requests_total.inc(50, model="m", code="500")
+        eng.tick()
+        assert eng.states() == {"avail": "pending"}
+        # burst clears before for_s elapses -> back to ok, never fired
+        for t in range(2, 60):
+            clock[0] = float(t)
+            sm.requests_total.inc(50, model="m", code="200")
+            eng.tick()
+        states = [e["data"]["to"] for e in fr.get_flight_recorder().events(
+            kinds=["slo.transition"])]
+        assert "firing" not in states
+        assert eng.states() == {"avail": "ok"}
+
+    def test_slo_metric_family_exported(self):
+        sm = ServingMetrics()
+        eng, clock = self._engine(sm)
+        for t in range(4):
+            clock[0] = float(t)
+            sm.requests_total.inc(50, model="m", code="500")
+            eng.tick()
+        text = om.default_registry().render_text()
+        assert 'slo_state{rule="avail"} 2' in text          # firing
+        assert "slo_transitions_total" in text
+        assert 'slo_burn_rate{rule="avail",window="10s"}' in text
+
+    def test_health_and_text_render(self):
+        sm = ServingMetrics()
+        eng, clock = self._engine(sm)
+        for t in range(4):
+            clock[0] = float(t)
+            sm.requests_total.inc(50, model="m", code="500")
+            eng.tick()
+        h = eng.health()
+        assert h["status"] == "firing"
+        assert h["rules"][0]["transitions"][-1]["to"] == "firing"
+        text = eng.render_text()
+        assert "status: firing" in text
+        assert "FIRING" in text
+
+    def test_evaluator_thread_drives_transitions(self):
+        import time as _time
+
+        sm = ServingMetrics()
+        eng = slo.HealthEngine(
+            [_avail_rule(windows=(slo.BurnWindow(10.0, 40.0, 1.0),),
+                         for_s=0.0)],
+            registries=[sm.registry], interval_s=0.02, snapshot_every_s=0)
+        eng.start()
+        try:
+            # keep erroring while the evaluator runs: the burst must land
+            # AFTER the baseline sample for window deltas to see it
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline and \
+                    eng.states()["avail"] != "firing":
+                sm.requests_total.inc(5, model="m", code="500")
+                _time.sleep(0.02)
+            assert eng.states()["avail"] == "firing"
+        finally:
+            eng.stop()
+        assert not eng.running
+
+    def test_registry_snapshots_recorded(self):
+        sm = ServingMetrics()
+        clock = [0.0]
+        eng = slo.HealthEngine([_avail_rule()], registries=[sm.registry],
+                               interval_s=1.0, clock=lambda: clock[0],
+                               snapshot_every_s=5.0)
+        sm.requests_total.inc(3, model="m", code="200")
+        eng.tick()
+        clock[0] = 6.0
+        eng.tick()
+        snaps = fr.get_flight_recorder().events(kinds=["metrics.snapshot"])
+        assert len(snaps) == 2  # t=0 and t=6
+        assert snaps[-1]["data"]["series"]["serving_requests_total"] == 3.0

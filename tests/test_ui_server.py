@@ -186,3 +186,71 @@ def test_remote_stats_requeues_on_failure(tmp_path):
         assert len(series["total_loss"]) == 2  # both records arrived
     finally:
         server2.stop()
+
+
+def test_health_page_without_engine(tmp_path):
+    """/health renders even with no SLO engine published: the live
+    default-registry scrape plus a no-engine notice."""
+    from deeplearning4j_tpu.observability import metrics as om
+    from deeplearning4j_tpu.observability import slo
+
+    om.reset_default_registry()
+    slo.set_default_engine(None)
+    server = UIServer(str(tmp_path), port=0).start()
+    try:
+        om.get_training_metrics().steps_total.inc(5)
+        status, body = _get(server, "/health")
+        assert status == 200
+        assert b"no SLO engine running" in body
+        assert b"train_steps_total 5" in body  # live scrape on the page
+        status, body = _get(server, "/api/health")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["slo"] is None
+        names = {m["name"] for m in doc["metrics"]["metrics"]}
+        assert "train_steps_total" in names
+    finally:
+        server.stop()
+        om.reset_default_registry()
+
+
+def test_health_page_renders_slo_states(tmp_path):
+    """With a published engine, /health shows per-rule alert states —
+    the zero-install dashboard answers "is training healthy?"."""
+    from deeplearning4j_tpu.observability import metrics as om
+    from deeplearning4j_tpu.observability import slo
+    from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+    om.reset_default_registry()
+    sm = ServingMetrics()
+    rule = slo.SLORule(
+        name="ui-avail", kind="availability", objective=0.9,
+        total=slo.Selector("serving_requests_total"),
+        bad=slo.Selector("serving_requests_total",
+                         match=(("code", "5.."),)),
+        windows=(slo.BurnWindow(10.0, 40.0, 1.0),),
+        for_s=0.0, resolve_hold_s=10.0)
+    clock = [0.0]
+    engine = slo.HealthEngine([rule], registries=[sm.registry],
+                              interval_s=1.0, clock=lambda: clock[0],
+                              snapshot_every_s=0)
+    engine.tick()
+    slo.set_default_engine(engine)
+    server = UIServer(str(tmp_path), port=0).start()
+    try:
+        status, body = _get(server, "/health")
+        assert status == 200
+        assert b"ui-avail" in body and b">OK<" in body
+        # drive the rule to firing; the page reflects it live
+        for t in (1.0, 2.0):
+            clock[0] = t
+            sm.requests_total.inc(20, model="m", code="500")
+            engine.tick()
+        status, body = _get(server, "/health")
+        assert b"FIRING" in body
+        doc = json.loads(_get(server, "/api/health")[1])
+        assert doc["slo"]["rules"][0]["state"] == "firing"
+    finally:
+        server.stop()
+        slo.set_default_engine(None)
+        om.reset_default_registry()
