@@ -1059,6 +1059,271 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
         fr.set_recording(True)
 
 
+def bench_robustness(peak, *, steps=96, batch_size=128, hidden=1024,
+                     rounds=10, mttr_rounds=3, load_threads=3):
+    """Cluster-robustness benchmark (resilience/cluster+supervisor +
+    serving worker supervision): what the self-healing layer costs when
+    nothing is failing, and how fast serving heals when something is.
+
+    - **Serving failover MTTR**: a ModelServer under background load has
+      a ParallelInference worker killed (injected
+      ``serving.worker_crash``); MTTR is the wall time from the first
+      failed response to the first subsequent success (worker respawn +
+      retry path), median over ``mttr_rounds``.
+    - **Watchdog steady-state overhead**, gated < 1% on ``Trainer.fit``:
+      the per-step cost of the armed supervision plane — the heartbeat
+      progress beat (``touch_heartbeat``) in the fit loop plus the
+      background beacon-writer thread — measured as paired
+      armed-vs-bare fit windows, median of ``rounds``. The deadline
+      guard itself costs nothing per step (collectives are per-epoch,
+      not per-step), so this IS the whole steady-state bill.
+
+    ``peak`` (chip FLOPs) is unused: host-side latency metrics.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.resilience import FaultInjector, set_fault_injector
+    from deeplearning4j_tpu.resilience.cluster import (
+        HeartbeatWriter,
+        set_process_heartbeat,
+    )
+    from deeplearning4j_tpu.serving import (
+        ModelRegistry,
+        ModelServer,
+        ServingClient,
+        ServingError,
+    )
+    from deeplearning4j_tpu.serving.warmup import spec
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    tmp_root = tempfile.mkdtemp(prefix="bench_robustness_")
+    try:
+        # -- serving failover MTTR ------------------------------------------
+        reg = ModelRegistry()
+        reg.register("probe", lambda v, x: x @ v,
+                     np.eye(8, dtype=np.float32), input_spec=spec((8,)),
+                     mode="batched", max_batch_size=16,
+                     devices=jax.devices()[:1])
+        srv = ModelServer(reg, slo_interval_s=3600.0,
+                          circuit_policy=None)  # measure bare respawn MTTR
+        srv.start()
+        stop = threading.Event()
+        outcomes = []  # (t_monotonic, ok) from EVERY client thread
+
+        def client_loop():
+            c = ServingClient(srv.url)
+            x = [[0.1] * 8]
+            while not stop.is_set():
+                try:
+                    c.predict("probe", x, deadline_ms=2000)
+                    outcomes.append((time.monotonic(), True))
+                except ServingError:
+                    outcomes.append((time.monotonic(), False))
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=client_loop, daemon=True)
+                   for _ in range(load_threads)]
+        for t in threads:
+            t.start()
+        mttrs, respawns = [], 0
+        try:
+            for _ in range(mttr_rounds):
+                # healthy traffic flowing, then kill a worker: MTTR is
+                # first-failure -> first-subsequent-success across ALL
+                # clients (whichever request the crashed batch held)
+                time.sleep(0.05)
+                mark = len(outcomes)
+                set_fault_injector(
+                    FaultInjector().plan("serving.worker_crash", at=1))
+                deadline = time.monotonic() + 30.0
+                t_fail = None
+                while time.monotonic() < deadline:
+                    snap = outcomes[mark:]
+                    if t_fail is None:
+                        t_fail = next((t for t, ok in snap if not ok), None)
+                    if t_fail is not None:
+                        t_ok = next((t for t, ok in snap
+                                     if ok and t > t_fail), None)
+                        if t_ok is not None:
+                            mttrs.append(t_ok - t_fail)
+                            break
+                    time.sleep(0.001)
+                set_fault_injector(None)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            set_fault_injector(None)
+            entry = reg.get("probe")
+            respawns = entry._active.pi.worker_respawns \
+                if entry._active is not None else 0
+            srv.stop()
+
+        # -- watchdog steady-state overhead on Trainer.fit ------------------
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(updater=Sgd(0.05), seed=0),
+            layers=[Dense(units=hidden, activation="tanh"),
+                    OutputLayer(units=8, activation="softmax",
+                                loss="mcxent")],
+            input_shape=(32,),
+        ))
+        trainer = Trainer(model)
+        r = np.random.default_rng(0)
+        x = r.normal(size=(steps * batch_size, 32)).astype(np.float32)
+        y = np.eye(8, dtype=np.float32)[r.integers(0, 8, steps * batch_size)]
+
+        class StepTimes:
+            # per-step timestamps: ~rounds x steps samples per arm, so
+            # the median is immune to a multi-second busy burst that a
+            # window-level comparison would book entirely to one arm
+            def __init__(self):
+                self.deltas = []
+                self._last = None
+
+            def on_fit_start(self, t, s):
+                self._last = None
+
+            def on_epoch_start(self, e):
+                pass
+
+            def on_iteration(self, e, step, s, m):
+                now = time.perf_counter()
+                if self._last is not None:
+                    self.deltas.append(now - self._last)
+                self._last = now
+                return False
+
+            def on_epoch_end(self, e, s):
+                return False
+
+            def on_fit_end(self, t, s):
+                pass
+
+        def fit_window(sink):
+            data = ArrayDataSetIterator(x, y, batch_size=batch_size,
+                                        shuffle=False)
+            ts = trainer.init_state()
+            t0 = time.perf_counter()
+            ts = trainer.fit(ts, data, epochs=1, listeners=[sink])
+            jax.block_until_ready(ts.params)
+            return time.perf_counter() - t0
+
+        # Isolate the watchdog plane: the instrumentation/diagnostics
+        # cost is gated by the observability config; here both arms run
+        # the BARE loop so the armed-vs-bare delta is heartbeat-only
+        # (background span/recorder/step-cost threads otherwise add
+        # asymmetric scheduler noise well above the ~0.1 µs/step cost
+        # this gate polices).
+        from deeplearning4j_tpu.observability import flightrecorder as fr
+        from deeplearning4j_tpu.observability import metrics as om
+        from deeplearning4j_tpu.observability.trace import (
+            set_tracing_enabled,
+        )
+
+        om.set_enabled(False)
+        set_tracing_enabled(False)
+        fr.set_recording(False)
+        prev_cost = os.environ.get("DL4J_TPU_STEP_COST_ANALYSIS")
+        os.environ["DL4J_TPU_STEP_COST_ANALYSIS"] = "0"
+        try:
+            from statistics import median as _median
+
+            fit_window(StepTimes())  # jit warmup
+            hb_dir = os.path.join(tmp_root, "hb")
+
+            def bare_window():
+                sink = StepTimes()
+                wall = fit_window(sink)
+                return wall, _median(sink.deltas)
+
+            def armed_window():
+                hb = HeartbeatWriter(hb_dir, 0, interval_s=0.5).start()
+                set_process_heartbeat(hb)
+                sink = StepTimes()
+                try:
+                    wall = fit_window(sink)
+                finally:
+                    set_process_heartbeat(None)
+                    hb.stop()
+                return wall, _median(sink.deltas)
+
+            # The host's step time drifts by a few % over the run
+            # (frequency/heap aging) — far above the ~0.01% true cost.
+            # Cancel it in two layers: (1) each round compares ADJACENT
+            # windows (per-round paired diff of per-step medians, drift
+            # over one pair is tiny), alternating which arm leads;
+            # (2) average each (bare-led, armed-led) round pair so the
+            # residual position bias cancels, and take the median of
+            # those bias-free samples.
+            import gc
+
+            bare_s = armed_s = 0.0
+            round_diffs = []
+            rounds += rounds % 2
+            gc.collect()
+            gc.disable()  # gen-2 pauses in a long-lived process dwarf
+            try:          # the ~0.01% cost this gate polices
+                for i in range(rounds):
+                    if i % 2 == 0:
+                        (bw, bm), (aw, am) = bare_window(), armed_window()
+                    else:
+                        (aw, am), (bw, bm) = armed_window(), bare_window()
+                    bare_s, armed_s = bare_s + bw, armed_s + aw
+                    round_diffs.append((am - bm) / bm * 100.0)
+            finally:
+                gc.enable()
+            pair_diffs = [(round_diffs[k] + round_diffs[k + 1]) / 2.0
+                          for k in range(0, len(round_diffs), 2)]
+            overhead_pct = _median(pair_diffs)
+        finally:
+            om.set_enabled(True)
+            set_tracing_enabled(True)
+            fr.set_recording(True)
+            if prev_cost is None:
+                os.environ.pop("DL4J_TPU_STEP_COST_ANALYSIS", None)
+            else:
+                os.environ["DL4J_TPU_STEP_COST_ANALYSIS"] = prev_cost
+
+        from statistics import median as _stat_median
+
+        mttr_ms = _stat_median(mttrs) * 1e3 if mttrs else None
+        info = {
+            "mttr_rounds": mttr_rounds,
+            "mttr_measured": len(mttrs),
+            "failover_mttr_ms": round(mttr_ms, 2) if mttr_ms else None,
+            "worker_respawns": int(respawns),
+            "watchdog_rounds": rounds,
+            "watchdog_steps": steps,
+            "bare_step_ms": round(bare_s / (rounds * steps) * 1e3, 4),
+            "armed_step_ms": round(armed_s / (rounds * steps) * 1e3, 4),
+            "watchdog_overhead_pct": round(overhead_pct, 3),
+            # integrity gates: every kill healed, and the supervision
+            # plane's steady-state cost stays < 1% of the fit step
+            "gate_overhead_ok": bool(overhead_pct < 1.0),
+            "converged": bool(len(mttrs) == mttr_rounds
+                              and overhead_pct < 1.0),
+            "unit": "ms serving failover MTTR",
+        }
+        info["value"] = round(mttr_ms, 2) if mttr_ms else 0.0
+        return info
+    finally:
+        set_fault_injector(None)
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
+
 _CONFIGS = {
     "bert": bench_bert,
     # Batch-size knee probe (no baseline row): how much of the remaining
@@ -1092,6 +1357,10 @@ _CONFIGS = {
     # Telemetry self-cost (observability/): instrumented-vs-bare step
     # time, span enter/exit cost, registry render latency at 1k series.
     "observability": bench_observability,
+    # Cluster robustness (resilience/cluster+supervisor, serving worker
+    # supervision): serving failover MTTR after a killed worker, and the
+    # armed watchdog/heartbeat plane's steady-state fit overhead (< 1%).
+    "robustness": bench_robustness,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -1116,6 +1385,10 @@ _CPU_INTEGRITY = {
     # ~35 µs/step instrumentation cost the gates actually police
     "observability": dict(steps=96, batch_size=128, hidden=1024,
                           span_n=500, series=128),
+    # robustness reports "converged" = every injected worker kill healed
+    # (MTTR measured) AND the armed supervision plane costs < 1%/step
+    "robustness": dict(steps=96, batch_size=128, hidden=1024, rounds=10,
+                       mttr_rounds=2, load_threads=2),
 }
 
 
@@ -1173,7 +1446,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs",
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
-                            "serving,resilience,observability",
+                            "serving,resilience,observability,robustness",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

@@ -431,6 +431,15 @@ class ResilienceMetrics:
         self.crash_reports_total = r.counter(
             "crash_reports_total",
             "Crash dumps written by utils.crash.", namespace=ns)
+        self.collective_timeouts_total = r.counter(
+            "collective_timeouts_total",
+            "Host collectives (barrier/broadcast/checkpoint sync) that "
+            "exceeded the watchdog deadline (resilience/cluster.py).",
+            namespace=ns)
+        self.supervisor_restarts_total = r.counter(
+            "supervisor_restarts_total",
+            "Training-worker cohort relaunches by the elastic supervisor "
+            "(resilience/supervisor.py).", namespace=ns)
 
 
 class CheckpointMetrics:

@@ -32,7 +32,9 @@ from deeplearning4j_tpu.parallel.pipeline import (
 )
 from deeplearning4j_tpu.parallel.inference import (
     InferenceQueueFull,
+    InferenceShutdown,
     ParallelInference,
+    WorkerCrashError,
 )
 
 __all__ = [
@@ -54,4 +56,6 @@ __all__ = [
     "stage_params_sharding",
     "ParallelInference",
     "InferenceQueueFull",
+    "InferenceShutdown",
+    "WorkerCrashError",
 ]

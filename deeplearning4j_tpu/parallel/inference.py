@@ -37,6 +37,36 @@ class InferenceQueueFull(RuntimeError):
     until the worker ``join(timeout=30)`` expired."""
 
 
+class InferenceShutdown(RuntimeError):
+    """Raised by ``output()`` when the replica set is shut down (or every
+    worker thread is dead with no respawn budget left).
+
+    Typed so callers fail FAST with a retryable signal instead of
+    enqueueing into a queue nobody will ever drain and burning the full
+    client timeout. The serving layer maps it to a retryable 503.
+    ``workers_dead`` distinguishes "every worker died, respawn budget
+    exhausted" (a real outage the circuit breaker must count) from an
+    orderly ``shutdown()`` race (a drain, which it must not)."""
+
+    def __init__(self, *args, workers_dead: bool = False):
+        super().__init__(*args)
+        self.workers_dead = workers_dead
+
+
+class WorkerCrashError(RuntimeError):
+    """Delivered to the in-flight requests of a worker thread that died
+    unexpectedly (bug, injected ``serving.worker_crash``): their batch
+    was lost, but the failure is *retryable* — a replacement worker was
+    respawned (or a peer still serves the queue)."""
+
+
+class _InjectedWorkerCrash(BaseException):
+    """``serving.worker_crash`` injection vehicle. BaseException so the
+    per-batch ``except Exception`` delivery path cannot swallow it — it
+    must escape the worker loop and kill the thread, exactly like an
+    un-caught bug would."""
+
+
 def _rows(inputs) -> int:
     """Leading-dim row count of a features pytree (single array or a
     dict of aligned arrays, e.g. BERT's {token_ids, segment_ids, mask})."""
@@ -79,6 +109,16 @@ class ParallelInference:
     :class:`InferenceQueueFull` instead of blocking (overload must shed,
     not wedge shutdown).
 
+    **Worker supervision**: a worker thread that dies unexpectedly (a
+    bug escaping the dispatch path, or the injected
+    ``serving.worker_crash`` fault) fails every request it held with a
+    retryable :class:`WorkerCrashError` — nothing is silently stranded —
+    and is respawned on the same device (bounded by
+    ``max_worker_respawns``; ``on_respawn(worker_idx)`` is the serving
+    layer's metrics hook). With the budget exhausted and every worker
+    dead, ``output()`` raises :class:`InferenceShutdown` immediately
+    instead of enqueueing into a queue nobody drains.
+
     Usage::
 
         pi = ParallelInference(lambda v, x: model.output(v, x),
@@ -98,6 +138,8 @@ class ParallelInference:
         max_batch_size: int = 32,
         queue_limit: int = 256,
         on_batch: Optional[Callable[[int, int, int, float], None]] = None,
+        max_worker_respawns: int = 8,
+        on_respawn: Optional[Callable[[int], None]] = None,
     ):
         if mode not in ("instant", "batched"):
             raise ValueError(f"mode {mode!r}; valid: instant|batched")
@@ -107,6 +149,9 @@ class ParallelInference:
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue(queue_limit)
         self._state_lock = threading.Lock()  # orders enqueue vs shutdown
         self._on_batch = on_batch
+        self._on_respawn = on_respawn
+        self._max_respawns = max_worker_respawns
+        self._respawns = 0
         self._fn = jax.jit(forward)
         # One replica of the variables per device (↔ model.clone() per GPU —
         # but here it's the same immutable buffers, transferred not cloned).
@@ -114,13 +159,22 @@ class ParallelInference:
             jax.device_put(variables, d) for d in self._devices
         ]
         self._workers: List[threading.Thread] = []
+        # Per-worker list of taken-but-undelivered requests: the crash
+        # handler fails exactly these, so a dying worker never strands a
+        # caller into its full timeout.
+        self._inflight: List[List[_Request]] = [
+            [] for _ in self._devices]
         self._running = True
+        # flipped (under _state_lock) by the LAST worker's crash handler
+        # when no respawn budget remains: output() must fail fast from
+        # that instant — an is_alive() scan alone races the handler,
+        # which is still a live thread while it drains the queue (and
+        # two concurrently-crashing handlers would each see the other
+        # alive, so the count below is decremented explicitly instead)
+        self._dead = False
+        self._live = len(self._devices)
         for i, dev in enumerate(self._devices):
-            th = threading.Thread(
-                target=self._worker, args=(i, dev), daemon=True,
-                name=f"parallel-inference-{i}")
-            th.start()
-            self._workers.append(th)
+            self._workers.append(self._spawn_worker(i, dev))
 
     # -- client API --------------------------------------------------------
 
@@ -131,7 +185,9 @@ class ParallelInference:
         On timeout the request is marked cancelled — a worker that picks it
         up later skips it instead of computing a result nobody reads.
         Raises :class:`InferenceQueueFull` when the queue is at
-        ``queue_limit`` (never blocks while holding the state lock).
+        ``queue_limit`` (never blocks while holding the state lock), and
+        :class:`InferenceShutdown` — immediately, not after the timeout —
+        when the replica set is shut down or every worker is dead.
 
         ``trace``: optional ``(trace_id, parent_span_id)`` correlation
         context — the worker records "serving.batch" (queue wait + batch
@@ -158,7 +214,17 @@ class ParallelInference:
         # sustained overload.
         with self._state_lock:
             if not self._running:
-                raise RuntimeError("ParallelInference is shut down")
+                raise InferenceShutdown("ParallelInference is shut down")
+            if self._dead:
+                # every worker died and the respawn budget is gone:
+                # enqueueing would strand the caller for its full
+                # timeout — fail fast and retryably instead. (The flag
+                # is set under this lock before the dying worker drains
+                # the queue, so no request can slip in between.)
+                raise InferenceShutdown(
+                    "ParallelInference has no live workers "
+                    f"(respawn budget {self._max_respawns} exhausted)",
+                    workers_dead=True)
             try:
                 self._queue.put_nowait(req)
             except queue.Full:
@@ -194,7 +260,8 @@ class ParallelInference:
             except queue.Empty:
                 break
             if req is not None:
-                req.error = RuntimeError("server shut down before serving request")
+                req.error = InferenceShutdown(
+                    "shut down before serving request")
                 req.event.set()
 
     def __enter__(self):
@@ -205,13 +272,35 @@ class ParallelInference:
 
     # -- workers -----------------------------------------------------------
 
-    def _take_batch(self, carry: Optional[_Request]):
+    def _spawn_worker(self, idx: int, device) -> threading.Thread:
+        th = threading.Thread(
+            target=self._worker, args=(idx, device), daemon=True,
+            name=f"parallel-inference-{idx}")
+        th.start()
+        return th
+
+    @property
+    def worker_respawns(self) -> int:
+        """Worker threads respawned after an unexpected death."""
+        with self._state_lock:
+            return self._respawns
+
+    def alive_workers(self) -> int:
+        return sum(th.is_alive() for th in self._workers)
+
+    def _take_batch(self, carry: Optional[_Request],
+                    held: List[_Request]):
         """Collect the next batch. ``carry`` is a request taken off the
         queue last round that would have overflowed max_batch_size.
+        Every request taken off the queue is appended to ``held`` (the
+        worker's in-flight ledger) the moment it leaves the queue, so a
+        crash at ANY point fails it instead of stranding its caller.
         Returns (batch, next_carry) — batch None means shutdown."""
         req = carry if carry is not None else self._queue.get()
         if req is None:
             return None, None
+        if req not in held:
+            held.append(req)
         batch = [req]
         if self._mode == "batched":
             rows = _rows(req.inputs)
@@ -223,6 +312,7 @@ class ParallelInference:
                 if nxt is None:
                     self._queue.put(None)  # keep shutdown signal for peers
                     break
+                held.append(nxt)
                 if nxt.cancelled:
                     continue
                 if rows + _rows(nxt.inputs) > self._max_batch:
@@ -245,15 +335,41 @@ class ParallelInference:
         return min(b, cap) if rows <= cap else b
 
     def _worker(self, idx: int, device):
+        """Thread entry: run the serve loop; an escape (bug or injected
+        ``serving.worker_crash``) is a *crash* — fail what this worker
+        held, then respawn."""
+        try:
+            self._worker_loop(idx, device)
+        except BaseException as e:  # noqa: BLE001 — the supervision point
+            self._handle_worker_crash(idx, device, e)
+
+    def _worker_loop(self, idx: int, device):
+        from deeplearning4j_tpu.resilience.faults import (
+            POINT_SERVING_WORKER_CRASH,
+            get_fault_injector,
+        )
+
         variables = self._replicas[idx]
         carry: Optional[_Request] = None
         while True:
-            batch, carry = self._take_batch(carry)
+            held = self._inflight[idx]
+            held.clear()
+            if carry is not None:
+                held.append(carry)
+            batch, carry = self._take_batch(carry, held)
             if batch is None:
                 return
             batch = [r for r in batch if not r.cancelled]
             if not batch:
                 continue
+            inj = get_fault_injector()
+            if inj.enabled and \
+                    inj.fire(POINT_SERVING_WORKER_CRASH) is not None:
+                # mid-flight thread death, deterministically: the batch
+                # is taken, the caller is waiting — exactly the moment a
+                # real crash hurts most
+                raise _InjectedWorkerCrash(
+                    f"injected serving.worker_crash in worker {idx}")
             try:
                 sizes = [_rows(r.inputs) for r in batch]
                 rows = sum(sizes)
@@ -298,6 +414,82 @@ class ParallelInference:
                 for r in batch:
                     r.error = e
                     r.event.set()
+
+    def _handle_worker_crash(self, idx: int, device, exc: BaseException):
+        """A worker thread died outside the delivery path. Respawn it
+        (budget permitting) FIRST — so a retrying caller finds a live
+        worker — then fail every undelivered request it held with a
+        retryable :class:`WorkerCrashError`."""
+        respawned = False
+        with self._state_lock:
+            # swap the ledger BEFORE spawning: the replacement worker
+            # starts from a fresh (empty) list, so it cannot clear the
+            # crashed worker's held requests out from under this handler
+            held, self._inflight[idx] = self._inflight[idx], []
+            self._live -= 1
+            if self._running and self._respawns < self._max_respawns:
+                self._respawns += 1
+                self._workers[idx] = self._spawn_worker(idx, device)
+                self._live += 1
+                respawned = True
+            # explicit count, not an is_alive() scan: two handlers
+            # crashing concurrently each still see the OTHER's thread
+            # alive (it is — running its handler), but exactly one of
+            # them decrements the count to zero
+            last_worker = self._live == 0
+            if last_worker:
+                # flag first (same lock output() enqueues under), THEN
+                # drain below: a request either raced in before the flag
+                # — caught by the drain — or fail-fasts at output()
+                self._dead = True
+        err = WorkerCrashError(
+            f"inference worker {idx} died ({exc!r}); its in-flight batch "
+            "was lost" + ("; a replacement worker was respawned — retry"
+                          if respawned else
+                          "; no respawn budget left"))
+        failed = 0
+        for r in held:
+            if not r.event.is_set():
+                r.error = err
+                r.event.set()
+                failed += 1
+        if last_worker:
+            # this was the LAST worker and nothing replaced it: requests
+            # already queued have no one to ever serve them — fail them
+            # now (retryably) instead of letting them burn their full
+            # client timeouts. output() fail-fasts new arrivals (the
+            # _dead flag is already up); this drain covers the ones
+            # that beat the death.
+            dead_err = InferenceShutdown(
+                f"inference worker {idx} died with no respawn budget; "
+                "queued request will never be served", workers_dead=True)
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is None:
+                    self._queue.put(None)  # keep shutdown's sentinel
+                    break
+                if not req.event.is_set():
+                    req.error = dead_err
+                    req.event.set()
+                    failed += 1
+        try:
+            from deeplearning4j_tpu.observability.flightrecorder import (
+                record_event,
+            )
+
+            record_event("serving.worker_crash", worker=idx,
+                         device=str(device), error=repr(exc)[:200],
+                         failed_requests=failed, respawned=respawned)
+        except Exception:  # noqa: BLE001 — telemetry never blocks recovery
+            pass
+        if respawned and self._on_respawn is not None:
+            try:
+                self._on_respawn(idx)
+            except Exception:  # noqa: BLE001 — metrics never fail serving
+                pass
 
     def _record_telemetry(self, traced, feats, out, device, n_requests,
                           rows, bucket, td0, td1):

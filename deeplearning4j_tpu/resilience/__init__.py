@@ -17,13 +17,25 @@ lives in ``serde/checkpoint.py`` — this package is the policy layer on
 top of it. Stdlib + numpy + jax only.
 """
 
+from deeplearning4j_tpu.resilience.cluster import (
+    CollectiveTimeout,
+    CollectiveWatchdog,
+    HeartbeatWriter,
+    dead_peers,
+    dump_thread_stacks,
+    heartbeat_from_env,
+    read_heartbeats,
+)
 from deeplearning4j_tpu.resilience.faults import (
     POINT_CKPT_CORRUPT,
     POINT_CKPT_WRITE_CRASH,
+    POINT_COLLECTIVE_STALL,
     POINT_DATA_READ,
     POINT_SERVING_ERROR,
     POINT_SERVING_LATENCY,
+    POINT_SERVING_WORKER_CRASH,
     POINT_STEP_NAN,
+    POINT_TRAIN_WORKER_KILL,
     FaultInjector,
     FaultPlan,
     InjectedFault,
@@ -41,6 +53,12 @@ from deeplearning4j_tpu.resilience.retry import (
     backoff_delays,
     retrying,
 )
+from deeplearning4j_tpu.resilience.supervisor import (
+    ElasticSupervisor,
+    SupervisorGaveUp,
+    WorkerExit,
+    install_sigterm_teardown,
+)
 
 __all__ = [
     "FaultInjector",
@@ -55,6 +73,20 @@ __all__ = [
     "POINT_CKPT_CORRUPT",
     "POINT_SERVING_LATENCY",
     "POINT_SERVING_ERROR",
+    "POINT_COLLECTIVE_STALL",
+    "POINT_SERVING_WORKER_CRASH",
+    "POINT_TRAIN_WORKER_KILL",
+    "CollectiveTimeout",
+    "CollectiveWatchdog",
+    "HeartbeatWriter",
+    "dead_peers",
+    "dump_thread_stacks",
+    "heartbeat_from_env",
+    "read_heartbeats",
+    "ElasticSupervisor",
+    "SupervisorGaveUp",
+    "WorkerExit",
+    "install_sigterm_teardown",
     "FaultTolerantTrainer",
     "NonFiniteLossError",
     "RecoveryPolicy",

@@ -1,0 +1,411 @@
+"""Collective watchdog: detect, report, and TYPE a stalled cluster.
+
+The nastiest multi-host failure mode is not a crash — it is a *silent
+stall*: one peer dies (OOM-killed, preempted, kernel panic) and every
+surviving process blocks forever inside its next collective
+(``barrier``, ``broadcast_host_data``, the psum inside a compiled step).
+The reference stack detected this with VoidParameterServer heartbeats
+over Aeron (SURVEY §5.3); jax's coordination service has no user-facing
+liveness surface, so this module rebuilds the detection layer host-side:
+
+- :class:`HeartbeatWriter` — each worker publishes a beacon file
+  (``proc_<i>.json``: pid, seq, wall time, and a *progress* stamp the
+  training loop advances via :meth:`~HeartbeatWriter.touch`) to a shared
+  directory; :func:`dead_peers` reads all beacons and names the peers
+  whose beat (or progress) went stale. ``touch()`` is an in-memory
+  monotonic store (~ns) — the background thread does the file IO, so
+  per-step beats cost nothing on the hot path.
+- :class:`CollectiveWatchdog` — runs a blocking host collective under a
+  deadline (worker thread + join). On stall it dumps **every thread's
+  stack** plus the flight-recorder timeline into a crash report
+  (``utils/crash.py``), names the dead peers when a heartbeat directory
+  is armed, and raises a typed :class:`CollectiveTimeout` instead of
+  hanging — so the process exits and the elastic supervisor
+  (``resilience/supervisor.py``) can relaunch the cohort.
+
+``runtime/distributed.py`` routes ``barrier`` / ``broadcast_host_data``
+through the watchdog whenever a deadline is armed
+(``DL4J_TPU_COLLECTIVE_TIMEOUT_S``, default 300 s in multi-process
+jobs), and fires the ``collective.stall`` injection point inside the
+guarded region so the whole detection path is chaos-testable in one
+process. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_COLLECTIVE_TIMEOUT = "DL4J_TPU_COLLECTIVE_TIMEOUT_S"
+ENV_HEARTBEAT_DIR = "DL4J_TPU_HEARTBEAT_DIR"
+ENV_HEARTBEAT_INTERVAL = "DL4J_TPU_HEARTBEAT_INTERVAL_S"
+ENV_CRASH_DIR = "DL4J_TPU_CRASH_DIR"
+DEFAULT_COLLECTIVE_TIMEOUT_S = 300.0
+DEFAULT_HEARTBEAT_INTERVAL_S = 1.0
+
+
+class CollectiveTimeout(RuntimeError):
+    """A host collective exceeded its deadline — the cluster is stalled.
+
+    Typed so supervisors/relaunch logic can distinguish "a peer is gone,
+    restart the cohort" from ordinary training failures. Carries the
+    operation name, the deadline, the crash-report path (thread stacks +
+    flight recorder), and the peers whose heartbeat was stale at
+    detection time (empty when no heartbeat directory is armed)."""
+
+    def __init__(self, msg: str, *, op: str = "", timeout_s: float = 0.0,
+                 crash_report: Optional[str] = None,
+                 dead: Optional[List[int]] = None):
+        super().__init__(msg)
+        self.op = op
+        self.timeout_s = timeout_s
+        self.crash_report = crash_report
+        self.dead = list(dead or [])
+
+
+def dump_thread_stacks() -> Dict[str, List[str]]:
+    """Every live thread's current stack, by thread name — the "where is
+    everyone blocked?" half of a stall post-mortem."""
+    names = {th.ident: th.name for th in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, f"thread-{ident}")
+        out[name] = traceback.format_stack(frame)
+    return out
+
+
+# -- heartbeat files ----------------------------------------------------------
+
+
+class HeartbeatWriter:
+    """Publish this process's liveness beacon to a shared directory.
+
+    A daemon thread rewrites ``<dir>/proc_<id>.json`` every ``interval_s``
+    with ``{pid, process_id, seq, time, progress_age_s}``. ``touch()``
+    stores a monotonic stamp in memory (call it once per training step);
+    the beacon's ``progress_age_s`` is how long ago the last touch was,
+    so a reader can tell a *hung* main thread (fresh beacon, stale
+    progress) from a *dead* process (stale beacon). Until the FIRST
+    ``touch()`` the beacon reports ``progress_age_s: null`` and hang
+    detection stays off — a long first-step compile must not read as a
+    hang (touch once right after bootstrap if you want wedged-init
+    coverage). Writes are atomic (tmp + ``os.replace``) — a reader
+    never sees a torn beacon."""
+
+    def __init__(self, directory: str | Path, process_id: int, *,
+                 interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.directory = Path(directory)
+        self.process_id = int(process_id)
+        self.interval_s = float(interval_s)
+        self._seq = 0
+        self._progress: Optional[float] = None  # set by the first touch()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def path(self) -> Path:
+        return self.directory / f"proc_{self.process_id}.json"
+
+    def touch(self) -> None:
+        """Mark forward progress (in-memory, ~ns; no file IO)."""
+        self._progress = time.monotonic()
+
+    def beat(self) -> None:
+        """Write one beacon now (the background thread calls this)."""
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._seq += 1
+        progress = self._progress
+        doc = {
+            "pid": os.getpid(),
+            "process_id": self.process_id,
+            "seq": self._seq,
+            "time": time.time(),
+            "progress_age_s": (round(time.monotonic() - progress, 3)
+                               if progress is not None else None),
+        }
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(doc))
+        os.replace(tmp, self.path)
+
+    def start(self) -> "HeartbeatWriter":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self.beat()  # a beacon exists before start() returns
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"heartbeat-{self.process_id}")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat()
+            except OSError:  # transient FS trouble: keep beating
+                pass
+
+    def stop(self, *, remove: bool = False) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if remove:
+            try:
+                self.path.unlink()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "HeartbeatWriter":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def read_heartbeats(directory: str | Path) -> Dict[int, dict]:
+    """All peers' latest beacons, by process id. Torn/unparseable files
+    are skipped (the atomic writer makes them rare; a reader must never
+    crash on one)."""
+    out: Dict[int, dict] = {}
+    d = Path(directory)
+    if not d.is_dir():
+        return out
+    for f in d.glob("proc_*.json"):
+        try:
+            doc = json.loads(f.read_text())
+            out[int(doc["process_id"])] = doc
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def dead_peers(directory: str | Path, *, timeout_s: float,
+               expect: Optional[int] = None,
+               progress_timeout_s: Optional[float] = None,
+               now: Optional[float] = None) -> List[int]:
+    """Process ids whose beacon is stale/missing (dead process) or —
+    with ``progress_timeout_s`` — whose progress stamp went stale while
+    the beacon stayed fresh (hung main thread). A beacon that never
+    reported progress (``progress_age_s: null`` — the worker has not
+    touched yet, e.g. still in its first compile) is NOT hung: hang
+    detection starts at the first touch. ``expect``: also report ids in
+    ``range(expect)`` that never wrote a beacon."""
+    beats = read_heartbeats(directory)
+    t = time.time() if now is None else now
+    dead = set()
+    if expect is not None:
+        dead.update(i for i in range(expect) if i not in beats)
+    for pid_, doc in beats.items():
+        age = doc.get("progress_age_s")
+        if t - float(doc.get("time", 0.0)) > timeout_s:
+            dead.add(pid_)
+        elif progress_timeout_s is not None and age is not None \
+                and float(age) > progress_timeout_s:
+            dead.add(pid_)
+    return sorted(dead)
+
+
+_PROC_HEARTBEAT: Optional[HeartbeatWriter] = None
+
+
+def heartbeat_from_env(process_id: Optional[int] = None
+                       ) -> Optional[HeartbeatWriter]:
+    """Start a :class:`HeartbeatWriter` from the supervisor-provided
+    environment (``DL4J_TPU_HEARTBEAT_DIR`` + worker id), or None when
+    no supervisor armed one — the one-liner a worker script calls. The
+    writer is published process-wide so the training loops' per-step
+    :func:`touch_heartbeat` advances its progress stamp."""
+    global _PROC_HEARTBEAT
+    directory = os.environ.get(ENV_HEARTBEAT_DIR)
+    if not directory:
+        return None
+    if process_id is None:
+        process_id = int(os.environ.get("DL4J_TPU_WORKER_ID", "0"))
+    prev = _PROC_HEARTBEAT
+    if prev is not None:
+        if str(prev.directory) == directory \
+                and prev.process_id == process_id:
+            return prev  # idempotent: bootstrap helper + script both call
+        # two writers alternating beacons would flap the supervisor's
+        # hang detector (only the new one's progress stamp advances)
+        prev.stop()
+    interval = float(os.environ.get(ENV_HEARTBEAT_INTERVAL,
+                                    str(DEFAULT_HEARTBEAT_INTERVAL_S)))
+    hb = HeartbeatWriter(directory, process_id,
+                         interval_s=interval).start()
+    _PROC_HEARTBEAT = hb
+    return hb
+
+
+def get_process_heartbeat() -> Optional[HeartbeatWriter]:
+    return _PROC_HEARTBEAT
+
+
+def set_process_heartbeat(hb: Optional[HeartbeatWriter]) -> None:
+    global _PROC_HEARTBEAT
+    _PROC_HEARTBEAT = hb
+
+
+def touch_heartbeat() -> None:
+    """Advance the process heartbeat's progress stamp (the supervisor's
+    hang detector watches it). A global load + None check when no
+    supervisor armed a heartbeat — cheap enough for every train step."""
+    hb = _PROC_HEARTBEAT
+    if hb is not None:
+        hb.touch()
+
+
+# -- the watchdog -------------------------------------------------------------
+
+
+def default_collective_timeout_s() -> Optional[float]:
+    """The armed deadline: ``DL4J_TPU_COLLECTIVE_TIMEOUT_S`` seconds
+    (<= 0 disables), defaulting to 300 s. ``None`` means "no watchdog"."""
+    raw = os.environ.get(ENV_COLLECTIVE_TIMEOUT)
+    if raw is None:
+        return DEFAULT_COLLECTIVE_TIMEOUT_S
+    try:
+        val = float(raw)
+    except ValueError:
+        return DEFAULT_COLLECTIVE_TIMEOUT_S
+    return val if val > 0 else None
+
+
+class CollectiveWatchdog:
+    """Run blocking host collectives under a deadline; on stall, report
+    then raise instead of hanging forever.
+
+    ``run(fn, op=..., timeout_s=...)`` executes ``fn`` on a daemon worker
+    thread and joins with the deadline. On timeout it:
+
+    1. collects every thread's stack (the stalled collective's included),
+    2. reads the heartbeat directory (when armed) to name dead peers,
+    3. writes a crash report carrying both plus the flight-recorder
+       timeline (``utils/crash.write_crash_report``),
+    4. bumps ``resilience_collective_timeouts_total`` and records a
+       ``collective.timeout`` flight event,
+    5. raises :class:`CollectiveTimeout`.
+
+    The abandoned worker thread keeps blocking (a stuck gRPC barrier is
+    not interruptible from Python) — it is a daemon, so the expected
+    next move, *exit and let the supervisor relaunch*, is never blocked
+    by it. A late result from a timed-out collective is discarded."""
+
+    def __init__(self, *, timeout_s: Optional[float] = None,
+                 crash_dir: Optional[str] = None,
+                 heartbeat_dir: Optional[str | Path] = None,
+                 heartbeat_timeout_s: float = 5.0,
+                 expect_peers: Optional[int] = None):
+        self.timeout_s = timeout_s
+        self.crash_dir = crash_dir if crash_dir is not None else \
+            os.environ.get(ENV_CRASH_DIR, ".")
+        self.heartbeat_dir = heartbeat_dir if heartbeat_dir is not None \
+            else os.environ.get(ENV_HEARTBEAT_DIR)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.expect_peers = expect_peers
+
+    def resolve_timeout(self, timeout_s: Optional[float] = None
+                        ) -> Optional[float]:
+        if timeout_s is not None:
+            return timeout_s if timeout_s > 0 else None
+        if self.timeout_s is not None:
+            return self.timeout_s if self.timeout_s > 0 else None
+        return default_collective_timeout_s()
+
+    def run(self, fn: Callable[[], Any], *, op: str = "collective",
+            timeout_s: Optional[float] = None) -> Any:
+        deadline = self.resolve_timeout(timeout_s)
+        if deadline is None:
+            return fn()
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def _call():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — deliver to caller
+                box["error"] = e
+            finally:
+                done.set()
+
+        th = threading.Thread(target=_call, daemon=True,
+                              name=f"collective-{op}")
+        th.start()
+        if not done.wait(deadline):
+            raise self._on_stall(op, deadline)
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _on_stall(self, op: str, deadline: float) -> CollectiveTimeout:
+        dead: List[int] = []
+        if self.heartbeat_dir:
+            try:
+                dead = dead_peers(self.heartbeat_dir,
+                                  timeout_s=self.heartbeat_timeout_s,
+                                  expect=self.expect_peers)
+            except OSError:
+                pass
+        msg = (f"collective '{op}' exceeded its {deadline:g}s deadline"
+               + (f"; stale peers: {dead}" if dead else ""))
+        report = None
+        try:
+            from deeplearning4j_tpu.utils.crash import write_crash_report
+
+            report = write_crash_report(
+                self.crash_dir,
+                exception=CollectiveTimeout(msg, op=op, timeout_s=deadline),
+                extra={"collective_op": op, "timeout_s": deadline,
+                       "dead_peers": dead,
+                       "thread_stacks": dump_thread_stacks()})
+        except Exception:  # noqa: BLE001 — reporting never masks the stall
+            pass
+        try:
+            from deeplearning4j_tpu.observability.flightrecorder import (
+                record_event,
+            )
+
+            record_event("collective.timeout", op=op, timeout_s=deadline,
+                         dead_peers=dead, crash_report=report)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from deeplearning4j_tpu.observability import metrics as _obsm
+
+            if _obsm.enabled():
+                _obsm.get_resilience_metrics().collective_timeouts_total.inc()
+        except Exception:  # noqa: BLE001
+            pass
+        return CollectiveTimeout(msg, op=op, timeout_s=deadline,
+                                 crash_report=report, dead=dead)
+
+
+_WATCHDOG: Optional[CollectiveWatchdog] = None
+_WATCHDOG_LOCK = threading.Lock()
+
+
+def get_watchdog() -> CollectiveWatchdog:
+    """Process-wide watchdog (env-configured deadline/dirs on first use);
+    ``runtime/distributed.py`` routes guarded collectives through it."""
+    global _WATCHDOG
+    if _WATCHDOG is None:
+        with _WATCHDOG_LOCK:
+            if _WATCHDOG is None:
+                _WATCHDOG = CollectiveWatchdog()
+    return _WATCHDOG
+
+
+def set_watchdog(wd: Optional[CollectiveWatchdog]) -> None:
+    """Install (or with None, rebuild from env on next use) the
+    process-wide watchdog — tests arm short deadlines this way."""
+    global _WATCHDOG
+    _WATCHDOG = wd

@@ -23,6 +23,16 @@ Named injection points wired through the codebase:
                             manifest must catch it on restore)
 ``serving.latency``         sleeps ``arg`` seconds inside ``handle_predict``
 ``serving.error``           ``handle_predict`` sheds with a retryable 429
+``collective.stall``        sleeps ``arg`` seconds inside a watchdog-guarded
+                            collective (``runtime/distributed.barrier`` /
+                            ``broadcast_host_data``) — a dead-peer stall the
+                            watchdog deadline must catch (resilience/cluster)
+``serving.worker_crash``    kills the ``ParallelInference`` worker thread that
+                            picked up the next batch (the in-flight batch must
+                            fail retryably and the worker must be respawned)
+``train.worker_kill``       raises (or with ``!kill`` SIGKILLs the process)
+                            at the top of the N-th training step — the
+                            elastic supervisor's relaunch/resume trigger
 ==========================  =====================================================
 
 Plans are deterministic: ``at=N`` fires on the N-th trigger of the point
@@ -58,6 +68,9 @@ POINT_CKPT_WRITE_CRASH = "checkpoint.write_crash"
 POINT_CKPT_CORRUPT = "checkpoint.corrupt"
 POINT_SERVING_LATENCY = "serving.latency"
 POINT_SERVING_ERROR = "serving.error"
+POINT_COLLECTIVE_STALL = "collective.stall"
+POINT_SERVING_WORKER_CRASH = "serving.worker_crash"
+POINT_TRAIN_WORKER_KILL = "train.worker_kill"
 
 KNOWN_POINTS = (
     POINT_DATA_READ,
@@ -66,6 +79,9 @@ KNOWN_POINTS = (
     POINT_CKPT_CORRUPT,
     POINT_SERVING_LATENCY,
     POINT_SERVING_ERROR,
+    POINT_COLLECTIVE_STALL,
+    POINT_SERVING_WORKER_CRASH,
+    POINT_TRAIN_WORKER_KILL,
 )
 
 
@@ -115,6 +131,13 @@ class FaultInjector:
     def enabled(self) -> bool:
         """True if any plan is installed — the hooks' fast-path gate."""
         return bool(self._plans)
+
+    def planned(self, point: str) -> bool:
+        """True if any plan targets ``point`` (cheap membership check;
+        callers that must restructure control flow around a possible
+        firing — e.g. the collective watchdog's worker-thread hop — gate
+        on this instead of paying the hop for unrelated plans)."""
+        return point in self._plans
 
     def plan(self, point: str, *, at: Optional[int] = None, prob: float = 0.0,
              times: int = 1, arg: float = 0.0,

@@ -268,6 +268,7 @@ class FaultTolerantTrainer:
     def fit(self, ts, data, *, epochs: int = 1, listeners: Optional[List] = None,
             steps_per_epoch: Optional[int] = None, resume: bool = True):
         from deeplearning4j_tpu.data.dataset import as_batch_dict
+        from deeplearning4j_tpu.resilience.cluster import touch_heartbeat
         from deeplearning4j_tpu.resilience.faults import get_fault_injector
         from deeplearning4j_tpu.resilience.retry import (
             RetryingIterator,
@@ -349,6 +350,10 @@ class FaultTolerantTrainer:
                         continue
                     batch = as_batch_dict(batch)
                     if inj.enabled:
+                        # "train.worker_kill": die here (SIGKILL under
+                        # !kill) so supervisor relaunch/resume paths are
+                        # chaos-testable at an exact step
+                        inj.maybe_fail("train.worker_kill")
                         batch = inj.maybe_poison_batch(batch)
                     if tr._batch_sharding is not None:
                         batch = jax.device_put(batch, tr._batch_sharding)
@@ -395,6 +400,7 @@ class FaultTolerantTrainer:
                         break
                     ts = new_ts
                     host_step += 1
+                    touch_heartbeat()  # supervisor hang-detector beacon
                     if tm is not None:
                         step_s = time.perf_counter() - t_step
                         tm.step_seconds.observe(step_s)

@@ -15,11 +15,18 @@ What remains host-side, provided here:
   the first axis varies slowest across hosts/slices, so cross-slice traffic
   lands on the data axis as the scaling-book recipe prescribes).
 - `barrier()` / `broadcast_host_data()` — the rare host-level syncs
-  (checkpoint rendezvous), via multihost_utils.
+  (checkpoint rendezvous), via multihost_utils. Both are **deadline-
+  guarded** by the collective watchdog (resilience/cluster.py): a dead
+  peer turns an infinite hang into a typed `CollectiveTimeout` after
+  `DL4J_TPU_COLLECTIVE_TIMEOUT_S` seconds (default 300; <= 0 disables),
+  with a crash report carrying every thread's stack + the flight-recorder
+  timeline. The `collective.stall` fault-injection point fires inside the
+  guarded region, so the detection path is chaos-testable deterministically.
 - failure story per SURVEY §5.3: a lost process fails the coordination
-  barrier; recovery is checkpoint-restart (serde/checkpoint is
-  topology-independent), not elastic re-scale — documented, like the
-  reference.
+  barrier (now within a bounded deadline, not forever); recovery is
+  checkpoint-restart — single-process via serde/checkpoint, whole-cohort
+  via the elastic supervisor (resilience/supervisor.py) relaunching the
+  job to resume from the latest verified checkpoint.
 """
 
 from __future__ import annotations
@@ -131,24 +138,76 @@ def global_mesh(spec: Optional[MeshSpec] = None):
     return build_mesh(spec or MeshSpec(), devices_=jax.devices())
 
 
-def barrier(name: str = "barrier") -> None:
-    """Cross-process sync point (↔ parameter-server handshake round)."""
-    if not is_multiprocess():
-        return
-    from jax.experimental import multihost_utils
+def _guard_collective(fn, *, op: str, timeout_s: Optional[float]):
+    """Run a host collective under the watchdog deadline; the
+    ``collective.stall`` injection point fires inside the guarded region
+    (so an injected stall is observed exactly like a dead peer's).
+    Resolves to a direct call when no deadline is armed."""
+    from deeplearning4j_tpu.resilience.cluster import get_watchdog
+    from deeplearning4j_tpu.resilience.faults import get_fault_injector
 
-    multihost_utils.sync_global_devices(name)
+    inj = get_fault_injector()
+
+    def _guarded():
+        if inj.enabled:
+            inj.maybe_sleep("collective.stall")
+        return fn()
+
+    wd = get_watchdog()
+    if wd.resolve_timeout(timeout_s) is None or (
+            not is_multiprocess() and not inj.planned("collective.stall")):
+        # single process with no stall injectable: nothing can stall;
+        # skip the worker-thread hop entirely
+        return _guarded()
+    return wd.run(_guarded, op=op, timeout_s=timeout_s)
 
 
-def broadcast_host_data(value, is_source: Optional[bool] = None):
+def barrier(name: str = "barrier",
+            timeout_s: Optional[float] = None) -> None:
+    """Cross-process sync point (↔ parameter-server handshake round).
+
+    Deadline-guarded: raises
+    :class:`~deeplearning4j_tpu.resilience.cluster.CollectiveTimeout`
+    (after dumping thread stacks + the flight recorder into a crash
+    report) instead of hanging forever on a dead peer. ``timeout_s``
+    overrides the env-armed default for this call."""
+
+    def _sync():
+        if not is_multiprocess():
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+    _guard_collective(_sync, op=f"barrier:{name}", timeout_s=timeout_s)
+
+
+def broadcast_host_data(value, is_source: Optional[bool] = None,
+                        timeout_s: Optional[float] = None):
     """Broadcast a host-side pytree from process 0 to all processes
-    (↔ Spark driver broadcast of model config/params in §3.4)."""
-    if not is_multiprocess():
-        return value
-    from jax.experimental import multihost_utils
+    (↔ Spark driver broadcast of model config/params in §3.4).
+    Deadline-guarded like :func:`barrier`."""
 
-    return multihost_utils.broadcast_one_to_all(
-        value, is_source=is_source)
+    def _bcast():
+        if not is_multiprocess():
+            return value
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(
+            value, is_source=is_source)
+
+    return _guard_collective(_bcast, op="broadcast_host_data",
+                             timeout_s=timeout_s)
+
+
+def checkpoint_sync(name: str = "checkpoint",
+                    timeout_s: Optional[float] = None) -> None:
+    """The multihost checkpoint rendezvous: every process must reach the
+    save/restore point before any proceeds (a writer racing a dead
+    reader corrupts the rotation index). Same deadline guard as
+    :func:`barrier`, named so crash reports attribute the stall to the
+    checkpoint path."""
+    barrier(f"checkpoint:{name}", timeout_s=timeout_s)
 
 
 def host_local_to_global(arrays, mesh, pspecs):

@@ -14,6 +14,9 @@ REST serving story, grown into a first-class subsystem).
   process-global registry's train/resilience/runtime series too.
 - server: ModelServer — POST /v1/models/<name>:predict, GET /models,
   /healthz, /readyz, /metrics; graceful drain on shutdown.
+- circuit: per-model-version circuit breaker (closed → open on windowed
+  error rate → half-open probes → closed); open sheds with 503 +
+  Retry-After so the client's retry path composes.
 - client: stdlib ServingClient raising the same typed errors.
 """
 
@@ -21,14 +24,17 @@ from deeplearning4j_tpu.serving.admission import (
     AdmissionController,
     AdmissionTicket,
 )
+from deeplearning4j_tpu.serving.circuit import CircuitBreaker, CircuitPolicy
 from deeplearning4j_tpu.serving.client import ServingClient
 from deeplearning4j_tpu.serving.errors import (
     BadRequestError,
+    CircuitOpenError,
     DeadlineExceededError,
     ModelNotFoundError,
     NotReadyError,
     QueueFullError,
     ServingError,
+    WorkerCrashedError,
     error_from_code,
 )
 from deeplearning4j_tpu.serving.metrics import (
@@ -51,6 +57,9 @@ __all__ = [
     "AdmissionController",
     "AdmissionTicket",
     "BadRequestError",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "CircuitPolicy",
     "Counter",
     "DeadlineExceededError",
     "Gauge",
@@ -65,6 +74,7 @@ __all__ = [
     "ServingClient",
     "ServingError",
     "ServingMetrics",
+    "WorkerCrashedError",
     "bucket_sizes",
     "error_from_code",
     "spec",

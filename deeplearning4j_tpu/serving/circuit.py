@@ -1,0 +1,220 @@
+"""Per-model-version circuit breaker for the serving plane.
+
+A model version that starts failing hard (poisoned weights after a bad
+deploy, a device wedged under it, a worker crash-looping) should not
+have every request pay the full failure path — timeout, retry storm,
+thread churn — before the client learns the truth. The classic answer
+(Nygard's *Release It!*, Hystrix/Envoy outlier detection) is a circuit
+breaker in front of the model:
+
+- **closed** — requests flow; outcomes feed a sliding time window.
+  When the window holds at least ``min_requests`` decided outcomes and
+  the failure rate reaches ``failure_rate_threshold``, the circuit
+  **opens**.
+- **open** — requests are rejected instantly with a retryable 503 +
+  ``Retry-After`` (the remaining open time), so ``ServingClient``'s
+  existing retry/backoff path composes. After ``open_duration_s`` the
+  circuit moves to **half_open**.
+- **half_open** — up to ``half_open_probes`` concurrent probe requests
+  are let through. ``half_open_probes`` probe *successes* re-close the
+  circuit; any probe *failure* re-opens it for another full
+  ``open_duration_s``.
+
+What counts as a failure is the *caller's* decision (``record()``):
+``ModelServer`` feeds it 500s and worker-crash 503s — not client
+errors (4xx), not admission sheds (429), and not 504s (the deadline is
+client-chosen, so counting it would let one impatient client open the
+circuit for everyone). Undecided outcomes (``record_neutral``) return
+a half-open probe slot instead of leaking the budget.
+
+Deterministic: clock-injectable, no threads of its own; thread-safe via
+one lock. State changes invoke ``on_transition(from, to)`` — the
+serving layer's hook for ``serving_circuit_state`` gauges and
+``serving.circuit`` flight-recorder events.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Tuple
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+# gauge encoding (serving_circuit_state)
+STATE_NUM = {STATE_CLOSED: 0, STATE_OPEN: 1, STATE_HALF_OPEN: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class CircuitPolicy:
+    """Tuning knobs, all host-side.
+
+    ``window_s``: sliding window the failure rate is computed over.
+    ``min_requests``: decided outcomes required in the window before the
+    rate is trusted (a single failed request is not an outage).
+    ``failure_rate_threshold``: open at/above this failure fraction.
+    ``open_duration_s``: how long the circuit rejects before probing.
+    ``half_open_probes``: probe concurrency AND the consecutive probe
+    successes required to re-close."""
+
+    window_s: float = 30.0
+    min_requests: int = 20
+    failure_rate_threshold: float = 0.5
+    open_duration_s: float = 10.0
+    half_open_probes: int = 3
+
+    def validate(self) -> "CircuitPolicy":
+        if self.window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {self.window_s}")
+        if self.min_requests < 1:
+            raise ValueError(
+                f"min_requests must be >= 1, got {self.min_requests}")
+        if not 0.0 < self.failure_rate_threshold <= 1.0:
+            raise ValueError("failure_rate_threshold must be in (0, 1], "
+                             f"got {self.failure_rate_threshold}")
+        if self.open_duration_s <= 0:
+            raise ValueError(
+                f"open_duration_s must be > 0, got {self.open_duration_s}")
+        if self.half_open_probes < 1:
+            raise ValueError(
+                f"half_open_probes must be >= 1, got {self.half_open_probes}")
+        return self
+
+
+class CircuitBreaker:
+    """One breaker (one model version). See module docstring for the
+    state machine; every method is thread-safe and O(window)."""
+
+    def __init__(self, policy: Optional[CircuitPolicy] = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[
+                     Callable[[str, str], None]] = None):
+        self.policy = (policy or CircuitPolicy()).validate()
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._outcomes: deque = deque()  # (t, ok) decided outcomes
+        self._failures = 0               # running count of not-ok entries
+        self._open_until = 0.0
+        self._probes_out = 0
+        self._probe_successes = 0
+        # epoch bumps on every transition: an outcome reported with a
+        # stale token (request admitted in a previous state period) is
+        # ignored, so a pre-open straggler can neither re-close a
+        # half-open circuit without a real probe nor poison the fresh
+        # window after a close
+        self._epoch = 0
+
+    # -- inspection ----------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def failure_rate(self) -> Tuple[int, float]:
+        """(decided outcomes in window, failure fraction)."""
+        with self._lock:
+            self._prune()
+            n = len(self._outcomes)
+            return (n, self._failures / n) if n else (0, 0.0)
+
+    # -- decision points -----------------------------------------------------
+
+    def allow(self) -> Tuple[bool, float, Optional[int]]:
+        """May this request proceed? Returns ``(allowed, retry_after_s,
+        token)`` — ``retry_after_s`` only meaningful on denial, ``token``
+        only on allowance. The caller passes the token back to exactly
+        one of ``record(...)`` / ``record_neutral()``: an outcome whose
+        token predates the current state period (a straggler admitted
+        before a transition) is discarded, so it can never masquerade as
+        a half-open probe or seed the post-close window. In half_open
+        the allowance is one of the bounded probe slots."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == STATE_CLOSED:
+                return True, 0.0, self._epoch
+            if self._state == STATE_OPEN:
+                return False, max(0.0, self._open_until - self._clock()), None
+            # half_open: bounded probe concurrency
+            if self._probes_out < self.policy.half_open_probes:
+                self._probes_out += 1
+                return True, 0.0, self._epoch
+            # probes saturated: ask for a short retry (a probe decides soon)
+            return False, self.policy.open_duration_s / 10.0, None
+
+    def record(self, success: bool, token: Optional[int] = None) -> None:
+        """Report the decided outcome of an allowed request. ``token``
+        is what ``allow()`` returned; None means "trust me, current
+        period" (tests/simple callers)."""
+        with self._lock:
+            self._maybe_half_open()
+            if token is not None and token != self._epoch:
+                return  # straggler from a previous state period
+            now = self._clock()
+            if self._state == STATE_HALF_OPEN:
+                self._probes_out = max(0, self._probes_out - 1)
+                if success:
+                    self._probe_successes += 1
+                    if self._probe_successes >= self.policy.half_open_probes:
+                        self._transition(STATE_CLOSED)
+                else:
+                    self._transition(STATE_OPEN)
+                    self._open_until = now + self.policy.open_duration_s
+                return
+            if self._state == STATE_OPEN:
+                # tokenless straggler that was admitted while closed and
+                # finished after the open flip: no longer matters
+                return
+            self._outcomes.append((now, success))
+            if not success:
+                self._failures += 1
+            self._prune()
+            n = len(self._outcomes)
+            if n >= self.policy.min_requests and \
+                    self._failures / n >= self.policy.failure_rate_threshold:
+                self._transition(STATE_OPEN)
+                self._open_until = now + self.policy.open_duration_s
+
+    def record_neutral(self, token: Optional[int] = None) -> None:
+        """Report an allowed request whose outcome says nothing about
+        model health (bad input, shed downstream): returns the probe
+        slot in half_open, records nothing in closed."""
+        with self._lock:
+            if token is not None and token != self._epoch:
+                return
+            if self._state == STATE_HALF_OPEN:
+                self._probes_out = max(0, self._probes_out - 1)
+
+    # -- internals (lock held) ----------------------------------------------
+
+    def _prune(self):
+        cutoff = self._clock() - self.policy.window_s
+        while self._outcomes and self._outcomes[0][0] < cutoff:
+            _, ok = self._outcomes.popleft()
+            if not ok:
+                self._failures -= 1
+
+    def _maybe_half_open(self):
+        if self._state == STATE_OPEN and self._clock() >= self._open_until:
+            self._transition(STATE_HALF_OPEN)
+
+    def _transition(self, to: str):
+        frm, self._state = self._state, to
+        self._epoch += 1
+        if to == STATE_HALF_OPEN:
+            self._probes_out = 0
+            self._probe_successes = 0
+        elif to == STATE_CLOSED:
+            self._outcomes.clear()
+            self._failures = 0
+        if self._on_transition is not None and frm != to:
+            try:
+                self._on_transition(frm, to)
+            except Exception:  # noqa: BLE001 — hooks never wedge the breaker
+                pass

@@ -85,6 +85,27 @@ class DeadlineExceededError(ServingError):
     http_status = 504
 
 
+class CircuitOpenError(ServingError):
+    """The model version's circuit breaker is open: recent requests
+    failed at/above the configured rate, so this one is rejected
+    instantly instead of paying the failure path. ``retry_after_ms``
+    carries the remaining open time (also the Retry-After header)."""
+
+    code = "CIRCUIT_OPEN"
+    http_status = 503
+    retryable = True
+
+
+class WorkerCrashedError(ServingError):
+    """An inference worker thread died while holding this request's
+    batch. The batch is lost but the failure is transient — a
+    replacement worker was respawned, so a retry should succeed."""
+
+    code = "WORKER_CRASHED"
+    http_status = 503
+    retryable = True
+
+
 def error_from_code(code: str, message: str = "",
                     retry_after_ms=None) -> ServingError:
     """Rebuild the typed exception from a wire ``code`` (client side)."""

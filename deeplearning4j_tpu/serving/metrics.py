@@ -72,6 +72,19 @@ class ServingMetrics:
         self.model_ready = r.gauge(
             "serving_model_ready",
             "1 once the model's batch buckets are pre-compiled.", ("model",))
+        self.worker_respawns_total = r.counter(
+            "serving_worker_respawns_total",
+            "ParallelInference worker threads respawned after an "
+            "unexpected death (their in-flight batch failed retryably).",
+            ("model",))
+        self.circuit_state = r.gauge(
+            "serving_circuit_state",
+            "Per-model-version circuit-breaker state "
+            "(0=closed, 1=open, 2=half_open).", ("model", "version"))
+        self.circuit_transitions_total = r.counter(
+            "serving_circuit_transitions_total",
+            "Circuit-breaker state transitions.",
+            ("model", "version", "to"))
 
     def render_text(self) -> str:
         return self.registry.render_text()

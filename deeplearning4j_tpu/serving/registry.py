@@ -31,7 +31,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceShutdown,
+    ParallelInference,
+)
 from deeplearning4j_tpu.serving.errors import (
     BadRequestError,
     ModelNotFoundError,
@@ -83,7 +86,9 @@ class ModelEntry:
             self.forward, variables, devices=self.devices, mode=self.mode,
             max_batch_size=self.max_batch_size, queue_limit=self.queue_limit,
             on_batch=functools.partial(
-                self._registry._record_batch, self.name))
+                self._registry._record_batch, self.name),
+            on_respawn=functools.partial(
+                self._registry._record_respawn, self.name))
 
     def warm(self) -> Dict[int, float]:
         """Pre-compile every batch bucket on the active replica set.
@@ -145,7 +150,13 @@ class ModelEntry:
             try:
                 return pi.output(features, timeout=timeout,
                                  trace=trace), version
+            except InferenceShutdown:
+                if attempt == 0:
+                    continue
+                raise
             except RuntimeError as e:
+                # legacy string match kept for custom replica sets that
+                # raise their own "shut down" RuntimeError
                 if "shut down" in str(e) and attempt == 0:
                     continue
                 raise
@@ -289,6 +300,11 @@ class ModelRegistry:
         m = self._metrics
         if m is not None:
             m.model_ready.set(1.0 if ready else 0.0, model=name)
+
+    def _record_respawn(self, name: str, worker_idx: int):
+        m = self._metrics
+        if m is not None and hasattr(m, "worker_respawns_total"):
+            m.worker_respawns_total.inc(model=name)
 
     # -- registration / deployment -----------------------------------------
 

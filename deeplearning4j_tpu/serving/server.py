@@ -44,6 +44,15 @@ Graceful drain (``stop(drain=True)``): flip draining (readyz → 503, new
 predicts shed with UNAVAILABLE), wait for in-flight requests to finish,
 then stop the HTTP loop and shut the replica sets down (their FIFO
 drain serves anything still queued).
+
+Per-model-version **circuit breaker** (serving/circuit.py,
+``circuit_policy=``, None disables): a version failing at/above the
+windowed rate sheds instantly with ``503 CIRCUIT_OPEN`` + Retry-After
+(remaining open time) until half-open probes prove it healthy again —
+failures are 500s and worker crashes, never 4xx, admission sheds, or
+504s (deadlines are client-chosen and must not be weaponizable).
+``serving_circuit_state`` / ``serving_circuit_transitions_total``
+metrics + ``serving.circuit`` flight events trace every transition.
 """
 
 from __future__ import annotations
@@ -71,16 +80,27 @@ from deeplearning4j_tpu.observability.metrics import (
     render_json_multi,
     render_text_multi,
 )
-from deeplearning4j_tpu.parallel.inference import InferenceQueueFull
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceQueueFull,
+    InferenceShutdown,
+    WorkerCrashError,
+)
 from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector
 from deeplearning4j_tpu.serving.admission import AdmissionController
+from deeplearning4j_tpu.serving.circuit import (
+    STATE_NUM,
+    CircuitBreaker,
+    CircuitPolicy,
+)
 from deeplearning4j_tpu.serving.errors import (
     BadRequestError,
+    CircuitOpenError,
     DeadlineExceededError,
     ModelNotFoundError,
     NotReadyError,
     QueueFullError,
     ServingError,
+    WorkerCrashedError,
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.registry import ModelRegistry
@@ -91,6 +111,8 @@ _SHED_REASONS = {
     QueueFullError: "queue_full",
     DeadlineExceededError: "deadline",
     NotReadyError: "draining",
+    CircuitOpenError: "circuit_open",
+    WorkerCrashedError: "worker_crash",
 }
 
 
@@ -109,6 +131,7 @@ class ModelServer:
         slo_interval_s: float = 10.0,
         slo_time_scale: float = 1.0,
         max_profile_ms: float = 60000.0,
+        circuit_policy: Optional[CircuitPolicy] = CircuitPolicy(),
     ):
         self.registry = registry if registry is not None else ModelRegistry()
         if metrics is not None:
@@ -139,6 +162,13 @@ class ModelServer:
                 interval_s=slo_interval_s, time_scale=slo_time_scale)
         self.max_profile_ms = max_profile_ms
         self._profile_lock = threading.Lock()
+        # Per-(model, version) circuit breakers: a bad deploy's failures
+        # open ITS version's circuit; the rollback target starts fresh.
+        # None disables breaking entirely.
+        self.circuit_policy = circuit_policy.validate() \
+            if circuit_policy is not None else None
+        self._circuits: dict = {}
+        self._circuits_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -215,6 +245,13 @@ class ModelServer:
             def do_POST(self):  # noqa: N802 - stdlib API
                 path, _, query = self.path.partition("?")
                 if path == "/debug/profile":
+                    # drain the (unused) request body: closing the socket
+                    # with unread request bytes makes Linux RST instead
+                    # of FIN, which can discard the tail of the multi-MB
+                    # profile response still in the send buffer
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n:
+                        self.rfile.read(n)
                     q = parse_qs(query)
                     try:
                         ms = float(q.get("ms", ["500"])[0])
@@ -273,6 +310,49 @@ class ModelServer:
     def draining(self) -> bool:
         return self._draining
 
+    # -- circuit breakers ----------------------------------------------------
+
+    def circuit_for(self, model: str, version: str) -> Optional[CircuitBreaker]:
+        """The (model, version) breaker, created on first use (None when
+        breaking is disabled). Transitions feed ``serving_circuit_state``
+        / ``serving_circuit_transitions_total`` and ``serving.circuit``
+        flight events."""
+        if self.circuit_policy is None:
+            return None
+        key = (model, version)
+        with self._circuits_lock:
+            cb = self._circuits.get(key)
+            if cb is None:
+                # bound per-model breaker retention to the last 3
+                # versions (incl. the one created below): versions
+                # further back can never serve again (rollback reaches
+                # one back), so a long-lived server under continuous
+                # deploys must not grow a breaker per version forever.
+                # The registry has no series-removal API, so the retired
+                # version's gauge is pinned to closed — a breaker frozen
+                # at "open" for a version that no longer exists must not
+                # page anyone forever (its series objects do persist:
+                # per-deploy label cardinality, operator-bounded).
+                stale = [k for k in self._circuits if k[0] == model][:-2]
+                for k in stale:
+                    del self._circuits[k]
+                    self.metrics.circuit_state.set(
+                        STATE_NUM["closed"], model=k[0], version=k[1])
+                def _on_transition(frm, to, _key=key):
+                    self.metrics.circuit_state.set(
+                        STATE_NUM[to], model=_key[0], version=_key[1])
+                    self.metrics.circuit_transitions_total.inc(
+                        model=_key[0], version=_key[1], to=to)
+                    record_event("serving.circuit", model=_key[0],
+                                 version=_key[1], frm=frm, to=to)
+
+                cb = CircuitBreaker(self.circuit_policy,
+                                    on_transition=_on_transition)
+                self.metrics.circuit_state.set(
+                    STATE_NUM[cb.state], model=model, version=version)
+                self._circuits[key] = cb
+        return cb
+
     # -- predict path (handler-independent for direct testing) ---------------
 
     def handle_predict(self, name: str, payload, *,
@@ -284,6 +364,8 @@ class ModelServer:
         # them would grow a permanent label set per scanned/typo'd URL.
         metric_model = name
         cid = correlation_id if correlation_id else _trace.new_id()
+        cb = None  # the breaker this request must report back to
+        cb_token = None
         # Root of the server-side span tree: the client's span (X-Span-ID)
         # is the parent, admission nests inside via the thread-local stack,
         # and the batch/dispatch legs are recorded against req_span by the
@@ -309,6 +391,18 @@ class ModelServer:
                                         else "server not started")
                 if not isinstance(payload, dict) or "inputs" not in payload:
                     raise BadRequestError('body must be {"inputs": ...}')
+                # circuit breaker: a version failing at/above the policy
+                # rate sheds instantly with 503 + Retry-After instead of
+                # paying the failure path per request
+                cb = self.circuit_for(name, entry.version)
+                if cb is not None:
+                    allowed, retry_after_s, cb_token = cb.allow()
+                    if not allowed:
+                        cb = None  # denied: nothing to record back
+                        raise CircuitOpenError(
+                            f"circuit open for model '{name}' "
+                            f"(recent failure rate over threshold)",
+                            retry_after_ms=retry_after_s * 1000.0)
                 # Admit before the body parse: over-cap traffic must shed
                 # before paying the array-coercion cost, not after.
                 with _trace.span("serving.admission"):
@@ -327,10 +421,22 @@ class ModelServer:
                             str(e) or "deadline exceeded") from e
                     except InferenceQueueFull as e:
                         raise QueueFullError(str(e)) from e
+                    except WorkerCrashError as e:
+                        # the worker holding this batch died; it was
+                        # respawned — retryable 503, counted as a circuit
+                        # failure (a crash-looping version must open)
+                        raise WorkerCrashedError(str(e)) from e
+                    except InferenceShutdown as e:
+                        if getattr(e, "workers_dead", False):
+                            # NOT a drain: every worker died and the
+                            # respawn budget is gone — a truthful,
+                            # circuit-countable outage signal
+                            raise WorkerCrashedError(str(e)) from e
+                        # lost the race against stop()/deploy drain: a
+                        # structured retryable 503, not an INTERNAL 500
+                        raise NotReadyError("server is draining") from e
                     except RuntimeError as e:
                         if "shut down" in str(e):
-                            # lost the race against stop(): a structured
-                            # retryable 503, not an INTERNAL 500
                             raise NotReadyError("server is draining") from e
                         raise
                 finally:
@@ -358,6 +464,21 @@ class ModelServer:
                              error=str(e)[:200])
             if req_span is not None:
                 req_span.attrs["status"] = status
+        if cb is not None:
+            # model-health outcomes only: 200 succeeds; 500s and worker
+            # crashes fail. 504s are NEUTRAL — deadline_ms is client-
+            # chosen, so one client sending impossible deadlines must
+            # not be able to open the circuit for everyone. Client
+            # errors and admission/drain sheds likewise say nothing
+            # about the version and return the probe slot.
+            if status == 200:
+                cb.record(True, token=cb_token)
+            elif status == 500 or (isinstance(body, dict)
+                    and body.get("error", {}).get("code")
+                    == WorkerCrashedError.code):
+                cb.record(False, token=cb_token)
+            else:
+                cb.record_neutral(token=cb_token)
         self.metrics.requests_total.inc(model=metric_model, code=str(status))
         self.metrics.request_latency.observe(time.monotonic() - t0,
                                              model=metric_model)

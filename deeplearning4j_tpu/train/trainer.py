@@ -704,9 +704,12 @@ class Trainer:
                         om.data_read_seconds.observe(read_s)
                     batch = _as_batch_dict(batch)
                     if _fault_injector().enabled:
-                        # "train.step_nan" poison-batch injection point
-                        # (resilience/faults.py); no-op attribute check
+                        # "train.worker_kill" (SIGKILL/raise at the N-th
+                        # step — the elastic supervisor's relaunch
+                        # trigger) and "train.step_nan" poison-batch
+                        # injection points (resilience/faults.py); no-op
                         # unless DL4J_TPU_FAULTS armed a plan
+                        _fault_injector().maybe_fail("train.worker_kill")
                         batch = _fault_injector().maybe_poison_batch(batch)
                     if self._batch_sharding is not None:
                         if om is not None:
@@ -729,6 +732,10 @@ class Trainer:
                         tele.on_step(ts, batch, read_s, step_s,
                                      host_step + len(wmetrics))
                     n += 1
+                    # progress beacon for the elastic supervisor's hang
+                    # detector (resilience/cluster.py); a no-op global
+                    # check unless a supervisor armed a heartbeat
+                    _touch_heartbeat()
                     for wm in wmetrics:
                         host_step += 1
                         for lst in listeners:
@@ -867,4 +874,5 @@ def _record_batch_transfer(batch):
 
 
 from deeplearning4j_tpu.data.dataset import as_batch_dict as _as_batch_dict  # noqa: E402
+from deeplearning4j_tpu.resilience.cluster import touch_heartbeat as _touch_heartbeat  # noqa: E402
 from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector  # noqa: E402

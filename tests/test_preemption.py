@@ -92,6 +92,107 @@ def test_handler_restores_previous_signal_handler():
         signal.signal(signal.SIGTERM, prev)
 
 
+_SUPERVISED = textwrap.dedent("""
+    import os, signal, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                              SequentialConfig)
+    from deeplearning4j_tpu.nn.layers.core import Dense
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.train.preemption import PreemptionCheckpointer
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    gen = int(os.environ["DL4J_TPU_GENERATION"])
+    ckpt_dir = os.environ["CKPT_DIR"]
+
+    # a handler installed BEFORE the checkpointer: it must be back in
+    # place after fit (nested/outer SIGTERM semantics survive)
+    def outer_handler(*_):
+        pass
+    signal.signal(signal.SIGTERM, outer_handler)
+
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(updater=Sgd(0.05), seed=3),
+        input_shape=(8,),
+        layers=[Dense(units=16, activation="tanh"),
+                OutputLayer(units=4, loss="mcxent", activation="softmax")],
+    ))
+    trainer = Trainer(model)
+    handler = PreemptionCheckpointer(ckpt_dir, model=model)
+    ts = handler.resume(trainer, trainer.init_state())
+    start_step = int(jax.device_get(ts.step))
+    print("start_step", start_step, flush=True)
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(32, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 32)]
+
+    class SelfTerm:
+        # generation 1 is "preempted" (SIGTERM to ourselves) at step 3
+        def on_fit_start(self, t, s): pass
+        def on_epoch_start(self, e): pass
+        def on_iteration(self, e, step, s, m):
+            if gen == 1 and step == start_step + 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return False
+        def on_epoch_end(self, e, s): return False
+        def on_fit_end(self, t, s): pass
+
+    ts = trainer.fit(ts, ArrayDataSetIterator(x, y, batch_size=8,
+                                              shuffle=False),
+                     epochs=4, listeners=[SelfTerm(), handler])
+    # the previously-installed handler is restored after fit either way
+    assert signal.getsignal(signal.SIGTERM) is outer_handler, \\
+        signal.getsignal(signal.SIGTERM)
+    print("handler_restored ok", flush=True)
+    print("end_step", int(jax.device_get(ts.step)), flush=True)
+    if handler.preempted:
+        print("preempted", flush=True)
+        sys.exit(143)  # requeue-me exit: the supervisor relaunches
+    print("completed", flush=True)
+""")
+
+
+def test_preemption_checkpointer_under_elastic_supervisor(tmp_path):
+    """SIGTERM mid-fit under the supervisor: generation 1 saves the
+    ``preempt`` checkpoint and exits 143; the supervisor relaunches;
+    generation 2 resumes from that exact checkpoint and completes; the
+    previously-installed SIGTERM handler is restored in both."""
+    from deeplearning4j_tpu.resilience.supervisor import ElasticSupervisor
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CKPT_DIR=str(tmp_path / "ckpts"))
+    sup = ElasticSupervisor(
+        [sys.executable, "-c", _SUPERVISED], num_workers=1,
+        max_restarts=2, workdir=tmp_path / "run", env=env,
+        backoff_base_s=0.05, backoff_max_s=0.2)
+    res = sup.run()
+    assert res.generations == 2 and res.restarts == 1
+
+    gen1 = sup.worker_log(0, 1).read_text()
+    assert "start_step 0" in gen1
+    assert "preempted" in gen1
+    assert "handler_restored ok" in gen1
+    gen1_end = int(gen1.split("end_step ")[1].split()[0])
+    assert gen1_end <= 6  # stopped at the signal boundary, not epoch 4
+    # the preempt-tagged checkpoint is what got saved
+    from deeplearning4j_tpu.serde.checkpoint import latest_checkpoint
+
+    assert latest_checkpoint(tmp_path / "ckpts").endswith("preempt")
+
+    gen2 = sup.worker_log(0, 2).read_text()
+    assert f"start_step {gen1_end}" in gen2  # resumed exactly there
+    assert "handler_restored ok" in gen2
+    assert "completed" in gen2
+    # ran its full 4 epochs x 4 batches on top of the restored step
+    assert int(gen2.split("end_step ")[1].split()[0]) == gen1_end + 16
+
+
 def test_preemption_handler_coexists_with_async_checkpoints(tmp_path):
     """A normal fit with BOTH an async CheckpointListener and the
     preemption handler installed: no signal fires, training completes,
