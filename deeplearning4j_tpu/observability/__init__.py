@@ -30,6 +30,22 @@ The diagnostics plane consumes the spine (PR 4):
   served at ``GET /debug/flightrecorder``.
 """
 
+from deeplearning4j_tpu.observability.federation import (
+    ClusterAggregator,
+    ClusterMetrics,
+    ClusterTelemetryServer,
+    FederatedRegistry,
+    TelemetryExporter,
+    build_snapshot,
+    default_cluster_rules,
+    federate_instruments,
+    get_process_exporter,
+    set_process_exporter,
+    stitch_chrome_trace,
+    synthesize_step_roots,
+    telemetry_exporter_from_env,
+    telemetry_port,
+)
 from deeplearning4j_tpu.observability.flightrecorder import (
     FlightRecorder,
     get_flight_recorder,
@@ -101,7 +117,11 @@ __all__ = [
     "OCCUPANCY_BUCKETS",
     "BurnWindow",
     "CheckpointMetrics",
+    "ClusterAggregator",
+    "ClusterMetrics",
+    "ClusterTelemetryServer",
     "Counter",
+    "FederatedRegistry",
     "FlightRecorder",
     "Gauge",
     "HealthEngine",
@@ -113,12 +133,22 @@ __all__ = [
     "SLORule",
     "Selector",
     "Span",
+    "TelemetryExporter",
     "Tracer",
     "TrainingMetrics",
+    "build_snapshot",
     "current_span",
+    "default_cluster_rules",
     "default_registry",
     "default_serving_rules",
     "enabled",
+    "federate_instruments",
+    "get_process_exporter",
+    "set_process_exporter",
+    "stitch_chrome_trace",
+    "synthesize_step_roots",
+    "telemetry_exporter_from_env",
+    "telemetry_port",
     "from_chrome_trace",
     "get_checkpoint_metrics",
     "get_default_engine",

@@ -1,0 +1,1184 @@
+"""Cluster telemetry federation: N worker dossiers -> one cluster view.
+
+PRs 3-4 gave every *process* a telemetry spine (one metrics registry,
+one flight-recorder ring, one span tracer); PR 5 gave training a
+multi-process world (ElasticSupervisor cohorts, gloo collectives,
+heartbeats). The two never met: each worker's series die inside its
+process, so the supervisor relaunches cohorts blind and a 2-process
+chaos run yields N disconnected dossiers instead of one timeline. This
+module is the meeting point:
+
+- :class:`TelemetryExporter` — the per-worker publication side. A tiny
+  stdlib HTTP endpoint (port derived from ``DL4J_TPU_WORKER_ID`` +
+  ``DL4J_TPU_TELEMETRY_PORT_BASE``) serving the worker's default-
+  registry scrape (``/metrics``), flight-ring dump
+  (``/flightrecorder``), span dump (``/trace``), and the one-GET
+  aggregation document (``/snapshot``). Where a port cannot be bound
+  (or none is armed) it degrades to a **file sink**: the same snapshot
+  document atomically rewritten to
+  ``DL4J_TPU_TELEMETRY_DIR/worker_<id>.json`` on a cadence, so the
+  aggregator can read workers on filesystems-only environments and the
+  *final pre-crash snapshot of a dead worker survives its process*.
+
+- :class:`ClusterAggregator` — the supervisor/coordinator side. Each
+  ``poll()`` fetches every worker's snapshot (HTTP first, file-sink
+  fallback), keeps the **last-known snapshot per worker** (a dead
+  worker's final state stays addressable for the crash dossier), and
+  republishes three cluster artifacts:
+
+  * a **federated registry**: every worker's series unioned under
+    ``worker``/``generation`` labels (strict collision rules — a family
+    whose type/labels/buckets disagree across workers is dropped and
+    counted in ``cluster_federation_conflicts_total`` instead of
+    silently interleaved), rendered through the same
+    ``render_text_multi`` union path as every other scrape;
+  * one **ordered cluster timeline**: every worker's flight events
+    merged by timestamp (events carry worker identity — see
+    ``flightrecorder.record``);
+  * one **stitched Perfetto trace**: every worker's spans in a single
+    Chrome-trace document with one pid lane per worker, plus
+    synthesized ``cluster.step`` roots so the per-step collective legs
+    recorded by ``runtime/distributed.py`` (trace ids minted at the
+    coordinator and propagated through ``broadcast_host_data``) join
+    one trace tree.
+
+- :class:`ClusterTelemetryServer` — the cluster health surface the
+  supervisor exposes: ``GET /cluster/metrics`` (federated scrape),
+  ``/cluster/debug/workers`` (worker table: generation, restarts, last
+  step, heartbeat age), ``/cluster/debug/flightrecorder`` (merged
+  timeline), ``/cluster/debug/trace`` (stitched Perfetto JSON), and
+  ``/cluster/debug/health`` (an SLO :class:`HealthEngine` pointed at
+  the *federated* registry, so burn-rate rules fire on cohort-wide
+  availability rather than one survivor's view).
+
+Stdlib only; safe to import from any layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability import trace as _trace
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    render_json_multi,
+    render_text_multi,
+)
+
+ENV_TELEMETRY_PORT = "DL4J_TPU_TELEMETRY_PORT"
+ENV_TELEMETRY_PORT_BASE = "DL4J_TPU_TELEMETRY_PORT_BASE"
+ENV_TELEMETRY_DIR = "DL4J_TPU_TELEMETRY_DIR"
+
+# labels the federation layer appends to every worker series
+FEDERATION_LABELS = ("worker", "generation")
+
+_INF = float("inf")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name) or default)
+    except ValueError:  # junk/empty env must not crash telemetry paths
+        return default
+
+
+def worker_identity() -> Dict[str, int]:
+    """This process's supervisor-provided identity (zeros/ones when not
+    under a supervisor; junk env degrades to the defaults rather than
+    crashing a telemetry path). This is the ONE parser of the identity
+    env vars — ``resilience.supervisor.worker_identity`` delegates
+    here; only ``flightrecorder._identity_fields`` keeps its own
+    presence-gated variant (importing this module there would cycle)."""
+    return {
+        "worker_id": _env_int("DL4J_TPU_WORKER_ID", 0),
+        "num_workers": _env_int("DL4J_TPU_NUM_WORKERS", 1),
+        "generation": _env_int("DL4J_TPU_GENERATION", 1),
+    }
+
+
+def telemetry_port(worker_id: Optional[int] = None) -> Optional[int]:
+    """The exporter port this worker should bind:
+    ``DL4J_TPU_TELEMETRY_PORT`` wins outright; otherwise
+    ``DL4J_TPU_TELEMETRY_PORT_BASE + worker_id`` (the supervisor arms
+    the base, each worker derives its own). None = no port armed."""
+    explicit = os.environ.get(ENV_TELEMETRY_PORT)
+    if explicit:
+        try:
+            return int(explicit)
+        except ValueError:
+            return None
+    base = os.environ.get(ENV_TELEMETRY_PORT_BASE)
+    if not base:
+        return None
+    try:
+        wid = (worker_identity()["worker_id"]
+               if worker_id is None else int(worker_id))
+        return int(base) + wid
+    except ValueError:
+        return None
+
+
+class _JsonHandler(BaseHTTPRequestHandler):
+    """Shared base for the exporter/cluster HTTP handlers: quiet
+    logging, one JSON/bytes ``_send``, one ``?seconds=`` parser."""
+
+    def log_message(self, *a):  # noqa: N802 - stdlib API
+        pass
+
+    def _send(self, status: int, body, content_type="application/json"):
+        raw = (body if isinstance(body, bytes)
+               else json.dumps(body, default=str).encode())
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _seconds_param(self, query: str) -> Tuple[Optional[float], bool]:
+        """Parsed ``?seconds=`` as (value, ok) — (None, True) when
+        absent; sends the 400 itself and returns ok=False on junk."""
+        q = parse_qs(query)
+        if "seconds" not in q:
+            return None, True
+        try:
+            return float(q["seconds"][0]), True
+        except ValueError:
+            self._send(400, {"error": "seconds must be a number"})
+            return None, False
+
+
+def build_snapshot(*, extra_registries: Sequence = (),
+                   flight_window_s: Optional[float] = None) -> dict:
+    """The one-document export the aggregator consumes: identity +
+    metrics JSON + flight dump + span dump, self-describing."""
+    ident = worker_identity()
+    regs = [default_registry()] + list(extra_registries)
+    return {
+        "worker": ident["worker_id"],
+        "num_workers": ident["num_workers"],
+        "generation": ident["generation"],
+        "pid": os.getpid(),
+        "time": time.time(),
+        "metrics": render_json_multi(regs),
+        "flight": get_flight_recorder().dump(last_seconds=flight_window_s),
+        "spans": [s.to_json() for s in _trace.get_tracer().spans()],
+    }
+
+
+class TelemetryExporter:
+    """Publish this worker's telemetry for the cluster aggregator.
+
+    HTTP mode (a port resolved from env or passed explicitly): a
+    daemon ``ThreadingHTTPServer`` serving ``/snapshot`` (the
+    aggregation document), ``/metrics`` (Prometheus text;
+    ``?format=json``), ``/flightrecorder`` (``?seconds=``), ``/trace``
+    (span JSON; ``?format=chrome`` for Perfetto), ``/identity``, and
+    ``/healthz``.
+
+    File sink (``DL4J_TPU_TELEMETRY_DIR`` armed): a daemon thread
+    atomically rewrites ``worker_<id>.json`` every ``sink_interval_s``
+    — and once more on :meth:`stop`, so a cleanly-exiting worker's
+    final state is on disk. The sink runs *alongside* HTTP too (not
+    just as the no-port fallback): a SIGKILLed worker's HTTP endpoint
+    dies with it, but its last sink write survives for the crash
+    dossier. :meth:`publish` forces one write now (training loops may
+    call it at epoch boundaries so the sink is never staler than an
+    epoch).
+    """
+
+    def __init__(self, *, port: Optional[int] = None,
+                 host: str = "127.0.0.1",
+                 sink_dir: Optional[str | Path] = None,
+                 sink_interval_s: float = 1.0,
+                 extra_registries: Sequence = ()):
+        if sink_interval_s <= 0:
+            raise ValueError(
+                f"sink_interval_s must be > 0, got {sink_interval_s}")
+        self.host = host
+        self._requested_port = port
+        self.sink_dir = Path(sink_dir) if sink_dir is not None else None
+        self.sink_interval_s = float(sink_interval_s)
+        self.extra_registries = list(extra_registries)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._sink_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # an epoch-boundary publish() and the sink thread both target
+        # the same tmp file; unserialized, the losing os.replace raises
+        # out of the CALLER (the training loop)
+        self._publish_lock = threading.Lock()
+        self.mode = "disabled"
+
+    # -- surface -------------------------------------------------------------
+
+    @property
+    def port(self) -> Optional[int]:
+        return (self._httpd.server_address[1]
+                if self._httpd is not None else None)
+
+    @property
+    def url(self) -> Optional[str]:
+        return (f"http://{self.host}:{self.port}"
+                if self._httpd is not None else None)
+
+    @property
+    def sink_path(self) -> Optional[Path]:
+        if self.sink_dir is None:
+            return None
+        return self.sink_dir / f"worker_{worker_identity()['worker_id']}.json"
+
+    def snapshot(self) -> dict:
+        return build_snapshot(extra_registries=self.extra_registries)
+
+    def publish(self) -> Optional[Path]:
+        """Write one file-sink snapshot now (no-op without a sink dir);
+        returns the path written, or None when there is nothing to
+        write or the write failed. Telemetry never fails the worker: a
+        full/read-only sink disk must not crash the training loop that
+        calls this at epoch boundaries, nor kill a cohort at launch."""
+        path = self.sink_path
+        if path is None:
+            return None
+        doc = json.dumps(self.snapshot(), default=str)
+        try:
+            with self._publish_lock:
+                path.parent.mkdir(parents=True, exist_ok=True)
+                tmp = path.with_suffix(".tmp")
+                tmp.write_text(doc)
+                os.replace(tmp, path)
+        except OSError:
+            return None
+        return path
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "TelemetryExporter":
+        if self.mode != "disabled":
+            return self
+        self._stop.clear()
+        port = (self._requested_port if self._requested_port is not None
+                else telemetry_port())
+        if port is not None:
+            try:
+                self._httpd = ThreadingHTTPServer(
+                    (self.host, port), self._handler_class())
+                self._serve_thread = threading.Thread(
+                    target=self._httpd.serve_forever, daemon=True,
+                    name=f"telemetry-exporter-{port}")
+                self._serve_thread.start()
+                self.mode = "http"
+            except OSError:
+                # port taken / unbindable: fall through to the file sink
+                self._httpd = None
+        if self.sink_dir is not None:
+            # the sink runs even in HTTP mode: an HTTP endpoint dies
+            # with its (SIGKILLed) worker; the sink file outlives it
+            self.publish()
+            self._sink_thread = threading.Thread(
+                target=self._sink_loop, daemon=True,
+                name="telemetry-sink")
+            self._sink_thread.start()
+            if self.mode == "disabled":
+                self.mode = "file"
+        return self
+
+    def _sink_loop(self):
+        while not self._stop.wait(self.sink_interval_s):
+            try:
+                self.publish()
+            except Exception:  # noqa: BLE001 — telemetry never fails the
+                pass           # worker; a dead sink loses the final
+                               # pre-crash snapshot, so keep publishing
+
+    def stop(self):
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            if self._serve_thread is not None:
+                self._serve_thread.join(timeout=5)
+            self._httpd.server_close()
+            self._httpd = None
+            self._serve_thread = None
+        if self._sink_thread is not None:
+            self._sink_thread.join(timeout=5)
+            self._sink_thread = None
+            try:
+                self.publish()  # the final (possibly pre-exit) state
+            except OSError:
+                pass
+        self.mode = "disabled"
+
+    def __enter__(self) -> "TelemetryExporter":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- HTTP handler --------------------------------------------------------
+
+    def _handler_class(self):
+        exporter = self
+
+        class Handler(_JsonHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                path, _, query = self.path.partition("?")
+                regs = [default_registry()] + exporter.extra_registries
+                if path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif path == "/identity":
+                    self._send(200, dict(worker_identity(),
+                                         pid=os.getpid(),
+                                         mode=exporter.mode))
+                elif path == "/snapshot":
+                    self._send(200, exporter.snapshot())
+                elif path == "/metrics":
+                    if "format=json" in query:
+                        self._send(200, render_json_multi(regs))
+                    else:
+                        self._send(
+                            200, render_text_multi(regs).encode(),
+                            content_type="text/plain; version=0.0.4")
+                elif path == "/flightrecorder":
+                    seconds, ok = self._seconds_param(query)
+                    if not ok:
+                        return
+                    self._send(200, get_flight_recorder().dump(
+                        last_seconds=seconds))
+                elif path == "/trace":
+                    spans = _trace.get_tracer().spans()
+                    if "format=chrome" in query:
+                        self._send(200, _trace.to_chrome_trace(spans))
+                    else:
+                        self._send(200, {"spans": [s.to_json()
+                                                   for s in spans]})
+                else:
+                    self._send(404, {"error": f"no route {path}"})
+
+        return Handler
+
+
+_PROC_EXPORTER: Optional[TelemetryExporter] = None
+
+
+def telemetry_exporter_from_env() -> Optional[TelemetryExporter]:
+    """Start a :class:`TelemetryExporter` from the supervisor-provided
+    environment (telemetry port base and/or sink dir), or None when
+    neither is armed — the one-liner a worker script calls next to
+    ``heartbeat_from_env()``. Idempotent per process."""
+    global _PROC_EXPORTER
+    port = telemetry_port()
+    sink = os.environ.get(ENV_TELEMETRY_DIR) or None
+    if port is None and sink is None:
+        return None
+    if _PROC_EXPORTER is not None and _PROC_EXPORTER.mode != "disabled":
+        return _PROC_EXPORTER
+    exp = TelemetryExporter(port=port, sink_dir=sink).start()
+    if exp.mode == "disabled":
+        return None
+    _PROC_EXPORTER = exp
+    return exp
+
+
+def get_process_exporter() -> Optional[TelemetryExporter]:
+    return _PROC_EXPORTER
+
+
+def set_process_exporter(exp: Optional[TelemetryExporter]) -> None:
+    global _PROC_EXPORTER
+    _PROC_EXPORTER = exp
+
+
+# -- federation: N metrics documents -> one labeled registry ------------------
+
+
+def _parse_bound(key: str) -> float:
+    return _INF if key == "+Inf" else float(key)
+
+
+def federate_instruments(
+        snapshots: Dict[int, dict], *,
+        on_conflict: Optional[Callable[[str, str], None]] = None
+) -> List[_metrics._Instrument]:
+    """Union every worker snapshot's metric families into fresh
+    instruments whose label sets are extended with
+    ``worker``/``generation``.
+
+    Collision rules are strict: the first worker (lowest id) to expose
+    a family fixes its type, label names, and histogram buckets; a
+    later worker whose same-named family disagrees on any of those is
+    NOT interleaved — its samples are dropped and ``on_conflict(name,
+    reason)`` is called, so a federated scrape never mixes
+    incompatible series under one family the way a naive concat would.
+    """
+    insts: Dict[str, _metrics._Instrument] = {}
+    shapes: Dict[str, Tuple] = {}  # name -> (kind, labelnames, buckets)
+    out: List[_metrics._Instrument] = []
+    for wid in sorted(snapshots):
+        snap = snapshots[wid]
+        if not isinstance(snap, dict):
+            continue
+        gen = str(snap.get("generation", 1))
+        metrics_doc = snap.get("metrics")
+        families = (metrics_doc.get("metrics", [])
+                    if isinstance(metrics_doc, dict) else [])
+        for fam in families:
+            # one malformed-but-identity-passing family (version-skewed
+            # worker, stray sink file) must drop as a conflict, not
+            # poison every future poll of the whole federated view
+            try:
+                _federate_family(fam, wid, gen, insts, shapes, out,
+                                 on_conflict)
+            except Exception:  # noqa: BLE001 — contained per family
+                if on_conflict is not None:
+                    fam_name = (fam.get("name", "?")
+                                if isinstance(fam, dict) else "?")
+                    on_conflict(str(fam_name), "malformed family")
+    return out
+
+
+def _federate_family(fam: dict, wid: int, gen: str,
+                     insts: Dict[str, _metrics._Instrument],
+                     shapes: Dict[str, Tuple],
+                     out: List[_metrics._Instrument],
+                     on_conflict: Optional[Callable[[str, str], None]]
+                     ) -> None:
+    """Fold one worker's metric family into the federated instruments
+    (see :func:`federate_instruments` for the collision rules)."""
+    name, kind = fam["name"], fam["type"]
+    samples = fam.get("samples", [])
+    if not samples:
+        return
+    labelnames = tuple(samples[0]["labels"].keys())
+    if set(labelnames) & set(FEDERATION_LABELS):
+        # a family already labeled worker/generation would render
+        # duplicate label names (invalid exposition) — a shape
+        # conflict like any other
+        if on_conflict is not None:
+            on_conflict(name, "reserved federation label")
+        return
+    buckets: Optional[Tuple[float, ...]] = None
+    if kind == "histogram":
+        buckets = tuple(sorted(
+            _parse_bound(k) for k in samples[0]["buckets"]))
+    inst = insts.get(name)
+    if inst is None:
+        try:
+            if kind == "histogram":
+                inst = Histogram(
+                    name, fam.get("help", ""),
+                    labelnames + FEDERATION_LABELS,
+                    buckets=[b for b in buckets if b != _INF])
+            else:
+                cls = Gauge if kind == "gauge" else Counter
+                inst = cls(name, fam.get("help", ""),
+                           labelnames + FEDERATION_LABELS)
+        except ValueError:
+            if on_conflict is not None:
+                on_conflict(name, "invalid name/labels")
+            return
+        insts[name] = inst
+        shapes[name] = (kind, labelnames, buckets)
+        out.append(inst)
+    elif shapes[name] != (kind, labelnames, buckets):
+        if on_conflict is not None:
+            on_conflict(name, "type/label/bucket mismatch")
+        return
+    # stage the writes: a malformed sample mid-family must drop this
+    # worker's WHOLE contribution (matching the conflict counter's
+    # claim), never leave a partially-folded series behind
+    staged: Dict[Tuple[str, ...], object] = {}
+    for s in samples:
+        key = tuple(str(s["labels"][k]) for k in labelnames) \
+            + (str(wid), gen)
+        if kind == "histogram":
+            bounds = sorted(_parse_bound(k) for k in s["buckets"])
+            if tuple(bounds) != buckets:
+                if on_conflict is not None:
+                    on_conflict(name, "bucket mismatch")
+                continue
+            cums = [s["buckets"][
+                "+Inf" if b == _INF else _metrics._fmt(b)]
+                for b in bounds]
+            counts = [c - p for c, p in zip(cums, [0] + cums[:-1])]
+            staged[key] = {"counts": counts,
+                           "sum": float(s["sum"]),
+                           "n": int(s["count"])}
+        else:
+            staged[key] = float(s["value"])
+    inst._data.update(staged)
+
+
+class FederatedRegistry:
+    """A read-only registry *view* over the aggregator's latest poll —
+    duck-typed to ``MetricsRegistry`` (``instruments()``) so
+    ``render_text_multi`` / ``render_json_multi`` and the SLO
+    :class:`HealthEngine` consume the federated series exactly like any
+    local registry."""
+
+    def __init__(self, aggregator: "ClusterAggregator"):
+        self._aggregator = aggregator
+
+    def instruments(self) -> List[_metrics._Instrument]:
+        return self._aggregator.federated_instruments()
+
+    def names(self) -> List[str]:
+        return [i.name for i in self.instruments()]
+
+    def render_text(self) -> str:
+        return render_text_multi([self])
+
+    def render_json(self) -> dict:
+        return render_json_multi([self])
+
+
+class ClusterMetrics:
+    """The aggregator's own exposition — cohort liveness/progress gauges
+    plus the poll/ conflict counters the worker-liveness SLO rule reads."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else MetricsRegistry()
+        self.registry = r
+        ns = "cluster"
+        self.worker_up = r.gauge(
+            "worker_up", "1 while the worker's telemetry snapshot is "
+            "fresh (HTTP reachable or file sink younger than the "
+            "liveness window), else 0.", ("worker",), namespace=ns)
+        self.worker_generation = r.gauge(
+            "worker_generation", "Cohort generation the worker's latest "
+            "snapshot reported.", ("worker",), namespace=ns)
+        self.worker_last_step = r.gauge(
+            "worker_last_step", "train_steps_total from the worker's "
+            "latest snapshot.", ("worker",), namespace=ns)
+        self.worker_step_lag = r.gauge(
+            "worker_step_lag", "Steps behind the farthest-ahead worker "
+            "(straggler surface: persistent lag on one worker is a "
+            "slow host, not a slow model).", ("worker",), namespace=ns)
+        self.worker_heartbeat_age_seconds = r.gauge(
+            "worker_heartbeat_age_seconds", "Seconds since the worker's "
+            "heartbeat beacon was written (resilience/cluster.py "
+            "read_heartbeats); -1 when no beacon exists.", ("worker",),
+            namespace=ns)
+        self.worker_snapshot_age_seconds = r.gauge(
+            "worker_snapshot_age_seconds", "Age of the last-known "
+            "telemetry snapshot per worker.", ("worker",), namespace=ns)
+        self.workers_expected = r.gauge(
+            "workers_expected", "Cohort size the aggregator polls.",
+            namespace=ns)
+        self.workers_up = r.gauge(
+            "workers_up", "Workers whose snapshot is currently fresh.",
+            namespace=ns)
+        self.restarts_total = r.gauge(
+            "restarts_total", "Cohort relaunches observed by the "
+            "supervisor driving this aggregator.", namespace=ns)
+        self.worker_polls_total = r.counter(
+            "worker_polls_total", "Snapshot poll attempts per worker "
+            "(the worker-liveness SLO rule's total).", ("worker",),
+            namespace=ns)
+        self.worker_poll_failures_total = r.counter(
+            "worker_poll_failures_total", "Poll attempts that found no "
+            "fresh snapshot (HTTP unreachable and file sink stale/"
+            "absent) — the worker-liveness SLO rule's bad events.",
+            ("worker",), namespace=ns)
+        self.federation_conflicts_total = r.counter(
+            "federation_conflicts_total", "Per-poll observations of a "
+            "worker metric family dropped from the federated view "
+            "because its type/labels/buckets disagreed with the "
+            "family's first-seen shape (the view is rebuilt every "
+            "poll, so a persistent conflict counts once per poll — a "
+            "flat line means it cleared).", ("name",), namespace=ns)
+        self.poll_seconds = r.histogram(
+            "poll_seconds", "Wall time of one full aggregator poll "
+            "across the cohort.", namespace=ns)
+
+
+def _sanitize_snapshot(snap: dict) -> dict:
+    """Coerce an identity-passing snapshot's nested documents to the
+    shapes every downstream consumer assumes (worker table, timeline,
+    span stitching, dossier): a version-skewed worker's malformed
+    'flight'/'spans' must degrade to empty, not permanently poison the
+    aggregator's last-known state."""
+    flight = snap.get("flight")
+    if not isinstance(flight, dict):
+        flight = snap["flight"] = {}
+    evs = flight.get("events")
+    flight["events"] = ([e for e in evs if isinstance(e, dict)]
+                        if isinstance(evs, list) else [])
+    spans = snap.get("spans")
+    snap["spans"] = (
+        [d for d in spans if isinstance(d, dict)
+         and all(k in d for k in ("name", "trace_id", "span_id"))]
+        if isinstance(spans, list) else [])
+    return snap
+
+
+def _snapshot_last_step(snap: dict) -> float:
+    try:
+        for fam in snap.get("metrics", {}).get("metrics", []):
+            if fam.get("name") == "train_steps_total":
+                return float(sum(s["value"]
+                                 for s in fam.get("samples", [])))
+    except Exception:  # noqa: BLE001 — a malformed family reads as 0
+        pass
+    return 0.0
+
+
+class ClusterAggregator:
+    """Poll every worker's exporter; hold the cluster's last-known view.
+
+    ``port_base``/``host`` name the HTTP exporters (worker *i* at
+    ``port_base + i``); ``sink_dir`` is the file-sink fallback read
+    when HTTP fails. ``heartbeat_dir`` (the supervisor's) feeds the
+    per-worker heartbeat-age gauge. ``restarts`` is a callable the
+    supervisor provides so ``cluster_restarts_total`` tracks cohort
+    relaunches. Snapshots survive worker death — :meth:`dossier` is
+    what the supervisor buries in the crash report on cohort teardown.
+    """
+
+    def __init__(self, *, num_workers: int,
+                 port_base: Optional[int] = None,
+                 host: str = "127.0.0.1",
+                 sink_dir: Optional[str | Path] = None,
+                 heartbeat_dir: Optional[str | Path] = None,
+                 fetch_timeout_s: float = 2.0,
+                 liveness_window_s: float = 10.0,
+                 startup_grace_s: float = 10.0,
+                 restarts: Optional[Callable[[], int]] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        self.num_workers = num_workers
+        self.port_base = port_base
+        self.host = host
+        self.sink_dir = Path(sink_dir) if sink_dir is not None else None
+        self.heartbeat_dir = heartbeat_dir
+        self.fetch_timeout_s = fetch_timeout_s
+        self.liveness_window_s = liveness_window_s
+        self.startup_grace_s = startup_grace_s
+        self._restarts = restarts
+        self._started = time.monotonic()
+        self.metrics = ClusterMetrics(registry)
+        self.federated = FederatedRegistry(self)
+        # _poll_lock serializes whole polls (incl. the blocking network
+        # fetches); _lock guards only the state swap, so /cluster/*
+        # reads never stall behind a wedged worker's fetch timeout
+        self._poll_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._fetch_pool = None  # built lazily on the first multi-worker poll
+        self._snapshots: Dict[int, dict] = {}
+        self._live: Dict[int, bool] = {}
+        self._federated_insts: List[_metrics._Instrument] = []
+        self._last_poll: Optional[float] = None
+        self.metrics.workers_expected.set(num_workers)
+
+    # -- reconfiguration (a new generation moves the port base) --------------
+
+    def set_port_base(self, port_base: Optional[int]) -> None:
+        self.port_base = port_base
+
+    # -- polling -------------------------------------------------------------
+
+    # exporter URLs are loopback/cluster-local: a corporate http_proxy
+    # env var must not route (and time out) every worker poll
+    _OPENER = urllib.request.build_opener(
+        urllib.request.ProxyHandler({}))
+
+    def _fetch_http(self, wid: int) -> Optional[dict]:
+        if self.port_base is None:
+            return None
+        url = f"http://{self.host}:{self.port_base + wid}/snapshot"
+        try:
+            with self._OPENER.open(
+                    url, timeout=self.fetch_timeout_s) as resp:
+                snap = json.loads(resp.read())
+        except Exception:  # noqa: BLE001 — any transport failure = miss
+            return None
+        # identity check: the port range is picked-then-released before
+        # workers bind (racy by design) — a foreign process answering
+        # this port (with ANY body shape) must not be attributed to
+        # worker `wid`, nor abort the rest of the poll
+        if not isinstance(snap, dict) or snap.get("worker") != wid:
+            return None
+        return _sanitize_snapshot(snap)
+
+    def _fetch_file(self, wid: int) -> Tuple[Optional[dict], bool]:
+        """(snapshot, fresh). A stale file still updates the last-known
+        view (it IS the dead worker's final state) but reads as down."""
+        if self.sink_dir is None:
+            return None, False
+        path = self.sink_dir / f"worker_{wid}.json"
+        try:
+            snap = json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None, False
+        if not isinstance(snap, dict) or snap.get("worker") != wid:
+            return None, False
+        try:
+            age = time.time() - float(snap.get("time", 0.0))
+        except (TypeError, ValueError):
+            return None, False
+        return _sanitize_snapshot(snap), age <= self.liveness_window_s
+
+    def poll(self) -> dict:
+        """One aggregation pass across the cohort; returns
+        :meth:`workers` (the worker table). The (possibly slow —
+        ``fetch_timeout_s`` per wedged worker) network fetches,
+        heartbeat file reads, and the federation rebuild all run
+        OUTSIDE the reader-facing state lock, which guards only the
+        final swap: readers of the federated view never stall behind a
+        sick worker, which is exactly when the debug surface matters
+        most."""
+        with self._poll_lock:
+            return self._poll_under_lock()
+
+    def _fetch_worker(self, wid: int) -> Tuple[Optional[dict], bool]:
+        snap = self._fetch_http(wid)
+        if snap is not None:
+            return snap, True
+        return self._fetch_file(wid)
+
+    def _pool(self):
+        """One persistent fetch pool for the aggregator's lifetime —
+        a fresh executor per poll would spawn/join N threads per second
+        at the production cadence. ``_poll_lock`` serializes users."""
+        if self._fetch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._fetch_pool = ThreadPoolExecutor(
+                max_workers=min(self.num_workers, 16),
+                thread_name_prefix="agg-fetch")
+        return self._fetch_pool
+
+    def close(self) -> None:
+        """Release the fetch pool's threads (the supervisor calls this
+        on teardown; last-known snapshots stay readable after close)."""
+        pool, self._fetch_pool = self._fetch_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def _poll_under_lock(self) -> dict:
+        """The body of one poll; caller holds ``_poll_lock``."""
+        t0 = time.perf_counter()
+        m = self.metrics
+        # fetch workers CONCURRENTLY (pure blocking IO): one poll is
+        # bounded by ~one fetch_timeout_s, not num_workers of them —
+        # several wedged-but-accepting workers must not stretch a poll
+        # past the cadence exactly when the cohort is sick
+        if self.num_workers == 1:
+            fetched = {0: self._fetch_worker(0)}
+        else:
+            futures = {wid: self._pool().submit(self._fetch_worker, wid)
+                       for wid in range(self.num_workers)}
+            fetched = {wid: f.result() for wid, f in futures.items()}
+        with self._lock:
+            snapshots = dict(self._snapshots)
+        live: Dict[int, bool] = {}
+        max_step = 0.0
+        steps: Dict[int, float] = {}
+        def _snap_time(s: dict) -> float:
+            try:
+                return float(s.get("time", 0.0))
+            except (TypeError, ValueError):
+                return 0.0
+
+        for wid in range(self.num_workers):
+            w = str(wid)
+            m.worker_polls_total.inc(worker=w)
+            snap, up = fetched[wid]
+            if snap is not None:
+                held = snapshots.get(wid)
+                # last-known means NEWEST-known: a stale sink file left
+                # behind (worker's disk full, old generation) must not
+                # overwrite a fresher HTTP snapshot after the worker
+                # dies — the dossier's 'final state' depends on it
+                if held is None or _snap_time(snap) >= _snap_time(held):
+                    snapshots[wid] = snap
+            if not up and (wid in snapshots
+                           or time.monotonic() - self._started
+                           > self.startup_grace_s):
+                # a worker we have NEVER seen, inside the startup
+                # grace, is still booting (jax import takes seconds) —
+                # not a liveness failure; counting it would hold the
+                # cohort-liveness rule in pending on every clean
+                # launch. A worker that stays invisible past the grace
+                # IS down.
+                m.worker_poll_failures_total.inc(worker=w)
+            live[wid] = up
+            known = snapshots.get(wid)
+            m.worker_up.set(1.0 if up else 0.0, worker=w)
+            if known is not None:
+                steps[wid] = _snapshot_last_step(known)
+                max_step = max(max_step, steps[wid])
+                m.worker_generation.set(
+                    float(known.get("generation", 1)), worker=w)
+                m.worker_last_step.set(steps[wid], worker=w)
+                m.worker_snapshot_age_seconds.set(
+                    max(0.0, time.time() - float(known.get("time", 0.0))),
+                    worker=w)
+        for wid, st in steps.items():
+            m.worker_step_lag.set(max_step - st, worker=str(wid))
+        m.workers_up.set(float(sum(live.values())))
+        if self._restarts is not None:
+            try:
+                m.restarts_total.set(float(self._restarts()))
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
+        if self.heartbeat_dir is not None:
+            from deeplearning4j_tpu.resilience.cluster import (
+                read_heartbeats,
+            )
+
+            beats = read_heartbeats(self.heartbeat_dir)
+            now = time.time()
+            for wid in range(self.num_workers):
+                doc = beats.get(wid)
+                age = (now - float(doc.get("time", now))
+                       if doc is not None else -1.0)
+                m.worker_heartbeat_age_seconds.set(
+                    round(age, 3), worker=str(wid))
+        insts = federate_instruments(
+            snapshots,
+            on_conflict=lambda name, _reason:
+                m.federation_conflicts_total.inc(name=name))
+        with self._lock:
+            self._snapshots = snapshots
+            self._live = live
+            self._federated_insts = insts
+            self._last_poll = time.monotonic()
+        m.poll_seconds.observe(time.perf_counter() - t0)
+        return self.workers()
+
+    def _stale(self, max_age_s: float) -> bool:
+        last = self._last_poll
+        return last is None or time.monotonic() - last > max_age_s
+
+    def ensure_fresh(self, max_age_s: float) -> None:
+        """Poll now if the last poll is older than ``max_age_s`` (the
+        on-demand scrape path — a /cluster/metrics GET must not serve a
+        view staler than one poll interval). Non-blocking: when a poll
+        is already in flight (possibly slow against a wedged cohort),
+        serve the last-known view instead of queueing — and re-check
+        staleness after acquiring, so N handler threads never each
+        re-run a full poll."""
+        if not self._stale(max_age_s):
+            return
+        if not self._poll_lock.acquire(blocking=False):
+            return  # a poll is running right now; stale view is fine
+        try:
+            if self._stale(max_age_s):
+                self._poll_under_lock()
+        finally:
+            self._poll_lock.release()
+
+    # -- cluster artifacts ---------------------------------------------------
+
+    def federated_instruments(self) -> List[_metrics._Instrument]:
+        with self._lock:
+            return list(self._federated_insts)
+
+    def registries(self) -> List:
+        """Cluster gauges first, then the federated worker series —
+        the order render_text_multi resolves collisions in (the
+        aggregator's own families win)."""
+        return [self.metrics.registry, self.federated]
+
+    def render_metrics_text(self) -> str:
+        return render_text_multi(self.registries())
+
+    def render_metrics_json(self) -> dict:
+        return render_json_multi(self.registries())
+
+    def workers(self) -> dict:
+        with self._lock:
+            return self._workers_locked()
+
+    def _workers_locked(self) -> dict:
+        rows = []
+        for wid in range(self.num_workers):
+            snap = self._snapshots.get(wid)
+            row = {"worker": wid, "up": bool(self._live.get(wid, False)),
+                   "snapshot": snap is not None}
+            if snap is not None:
+                row.update({
+                    "generation": snap.get("generation"),
+                    "pid": snap.get("pid"),
+                    "last_step": _snapshot_last_step(snap),
+                    "snapshot_age_s": round(
+                        max(0.0, time.time() - float(snap.get("time", 0.0))),
+                        3),
+                    "flight_events": snap.get("flight", {}).get("count", 0),
+                    "spans": len(snap.get("spans", [])),
+                })
+            rows.append(row)
+        return {"num_workers": self.num_workers,
+                "up": sum(1 for r in rows if r["up"]),
+                "workers": rows}
+
+    def cluster_timeline(self, last_seconds: Optional[float] = None) -> dict:
+        """Every worker's flight events merged into one ordered
+        timeline. Events already carry worker identity (stamped at the
+        source by ``flightrecorder.record``); events from pre-identity
+        rings are stamped here from the snapshot they rode in on."""
+        with self._lock:
+            snaps = dict(self._snapshots)
+        events: List[dict] = []
+        dropped = 0
+        for wid, snap in sorted(snaps.items()):
+            dump = snap.get("flight", {})
+            dropped += int(dump.get("dropped_total", 0))
+            for ev in dump.get("events", []):
+                if "worker" not in ev:
+                    ev = dict(ev, worker=wid,
+                              generation=snap.get("generation", 1))
+                events.append(ev)
+        if last_seconds is not None:
+            cutoff = time.time() - last_seconds
+            events = [e for e in events if e.get("t", 0.0) >= cutoff]
+        events.sort(key=lambda e: e.get("t", 0.0))
+        return {"workers": sorted(snaps), "dropped_total": dropped,
+                "window_seconds": last_seconds, "count": len(events),
+                "events": events}
+
+    def worker_spans(self) -> Dict[int, List[_trace.Span]]:
+        with self._lock:
+            snaps = dict(self._snapshots)
+        return {wid: [_trace.Span.from_json(d)
+                      for d in snap.get("spans", [])]
+                for wid, snap in sorted(snaps.items())}
+
+    def cluster_chrome_trace(self, *, synthesize_roots: bool = True) -> dict:
+        """One Perfetto document over the whole cohort: worker *i*'s
+        spans on pid lane ``i + 1`` (named ``worker-i``), with
+        synthesized ``cluster.step`` root spans joining each step's
+        per-worker collective legs (which share a coordinator-minted
+        trace id but whose root exists in no single worker's ring)."""
+        return stitch_chrome_trace(self.worker_spans(),
+                                   synthesize_roots=synthesize_roots)
+
+    def dossier(self) -> dict:
+        """The cohort post-mortem bundle: worker table + merged
+        timeline + every worker's LAST-KNOWN full snapshot (the dead
+        worker's final pre-crash state included). The supervisor writes
+        this into the crash report on cohort teardown."""
+        with self._lock:
+            snaps = dict(self._snapshots)
+            table = self._workers_locked()
+        return {"workers": table, "timeline": self.cluster_timeline(),
+                "snapshots": {str(w): s for w, s in sorted(snaps.items())}}
+
+
+# the deterministic per-step root ids runtime/distributed.py derives:
+# 8-hex cluster prefix + 'r' marker + 8-hex step — a shape new_id()
+# (pure 16-hex) can never produce
+_STEP_ROOT_RE = re.compile(r"^[0-9a-f]{8}r[0-9a-f]{8}$")
+
+
+def synthesize_step_roots(spans: Sequence[_trace.Span]
+                          ) -> List[_trace.Span]:
+    """For every *step-root* parent id referenced but owned by no span
+    (the deterministic per-step root ids ``runtime/distributed.py``
+    derives on every worker — recognizable by their ``r`` marker),
+    synthesize one ``cluster.step`` root spanning its children — so a
+    stitched trace renders each step's collective legs as ONE tree
+    instead of N orphans. Ordinary orphans (a parent still open at
+    snapshot time, or evicted from the bounded tracer ring) are left
+    alone: fabricating a root there would collide with the real parent
+    when a later snapshot carries it."""
+    spans = list(spans)
+    owned = {s.span_id for s in spans}
+    orphans: Dict[Tuple[str, str], List[_trace.Span]] = {}
+    for s in spans:
+        if s.parent_id and s.parent_id not in owned \
+                and _STEP_ROOT_RE.match(s.parent_id):
+            orphans.setdefault((s.trace_id, s.parent_id), []).append(s)
+    roots = []
+    for (trace_id, parent_id), children in sorted(orphans.items()):
+        attrs = {"synthesized": True}
+        step = children[0].attrs.get("step")
+        if step is not None:
+            attrs["step"] = step
+        roots.append(_trace.Span(
+            "cluster.step", trace_id=trace_id, span_id=parent_id,
+            start=min(c.start for c in children),
+            end=max(c.end for c in children),
+            thread="cluster", attrs=attrs))
+    return roots
+
+
+def stitch_chrome_trace(worker_spans: Dict[int, List[_trace.Span]], *,
+                        synthesize_roots: bool = True) -> dict:
+    """Merge per-worker span sets into one Chrome-trace document with
+    one pid lane per worker (``pid = worker + 1``, named
+    ``worker-<id>``); synthesized roots ride on pid 0 (``cluster``).
+    Lossless against :func:`trace.from_chrome_trace` — every span's
+    ids/attrs/threads survive, and ``attrs["worker"]`` is stamped so
+    the per-worker grouping itself round-trips."""
+    events: List[dict] = []
+    all_spans: List[_trace.Span] = []
+    for wid, spans in sorted(worker_spans.items()):
+        stamped = []
+        for s in spans:
+            if "worker" not in s.attrs:
+                s = _trace.Span(
+                    s.name, trace_id=s.trace_id, span_id=s.span_id,
+                    parent_id=s.parent_id, start=s.start, end=s.end,
+                    thread=s.thread, attrs=dict(s.attrs, worker=wid))
+            stamped.append(s)
+        all_spans.extend(stamped)
+        doc = _trace.to_chrome_trace(stamped, pid=wid + 1,
+                                     process_name=f"worker-{wid}")
+        events.extend(doc["traceEvents"])
+    if synthesize_roots:
+        roots = synthesize_step_roots(all_spans)
+        if roots:
+            doc = _trace.to_chrome_trace(roots, pid=0,
+                                         process_name="cluster")
+            events.extend(doc["traceEvents"])
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- cluster SLO rules --------------------------------------------------------
+
+
+def default_cluster_rules() -> List["_slo.SLORule"]:
+    """The rules a supervisor-side HealthEngine evaluates against the
+    federated registry when none are supplied: worker liveness (every
+    poll should find every worker up) — mirrored by the
+    ``cluster-worker-liveness`` rule in ``example_rules.json``."""
+    from deeplearning4j_tpu.observability import slo as _slo
+
+    return [
+        _slo.SLORule(
+            name="cluster-worker-liveness", kind="availability",
+            objective=0.99,
+            total=_slo.Selector("cluster_worker_polls_total"),
+            bad=_slo.Selector("cluster_worker_poll_failures_total"),
+            windows=_slo.DEFAULT_WINDOWS, for_s=60.0,
+            resolve_hold_s=300.0),
+    ]
+
+
+# -- the supervisor-side HTTP surface -----------------------------------------
+
+
+class ClusterTelemetryServer:
+    """``GET /cluster/*`` — the cohort's health surface, served from the
+    supervisor process over its :class:`ClusterAggregator`:
+
+    - ``/cluster/metrics`` — federated scrape (cluster gauges UNION
+      every worker's series, worker/generation-labeled);
+      ``?format=json`` for the JSON twin;
+    - ``/cluster/debug/workers`` — the worker table (up, generation,
+      last step, snapshot age);
+    - ``/cluster/debug/flightrecorder`` — merged ordered timeline
+      (``?seconds=N`` trims);
+    - ``/cluster/debug/trace`` — the stitched Perfetto document;
+    - ``/cluster/debug/health`` — the federated SLO engine's states
+      (404 when no engine is attached);
+    - ``/healthz``.
+
+    Every GET freshens the aggregator if its last poll is older than
+    ``max_staleness_s`` — an on-demand scrape never reads a stale view.
+    """
+
+    def __init__(self, aggregator: ClusterAggregator, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 engine: Optional["_slo.HealthEngine"] = None,
+                 max_staleness_s: float = 1.0):
+        self.aggregator = aggregator
+        self.engine = engine
+        self.max_staleness_s = max_staleness_s
+        server = self
+
+        class Handler(_JsonHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                path, _, query = self.path.partition("?")
+                agg = server.aggregator
+                if path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                    return
+                try:
+                    agg.ensure_fresh(server.max_staleness_s)
+                except Exception:  # noqa: BLE001 — serve the stale view
+                    pass
+                if path == "/cluster/metrics":
+                    if "format=json" in query:
+                        self._send(200, agg.render_metrics_json())
+                    else:
+                        self._send(
+                            200, agg.render_metrics_text().encode(),
+                            content_type="text/plain; version=0.0.4")
+                elif path == "/cluster/debug/workers":
+                    self._send(200, agg.workers())
+                elif path == "/cluster/debug/flightrecorder":
+                    seconds, ok = self._seconds_param(query)
+                    if not ok:
+                        return
+                    self._send(200, agg.cluster_timeline(seconds))
+                elif path == "/cluster/debug/trace":
+                    self._send(200, agg.cluster_chrome_trace())
+                elif path == "/cluster/debug/health":
+                    if server.engine is None:
+                        self._send(404, {"error": "no cluster health "
+                                                  "engine attached"})
+                    elif "format=text" in query:
+                        server.engine.tick()
+                        self._send(200,
+                                   server.engine.render_text().encode(),
+                                   content_type="text/plain")
+                    else:
+                        self._send(200, server.engine.tick())
+                else:
+                    self._send(404, {"error": f"no route {path}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "ClusterTelemetryServer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="cluster-telemetry")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread is not None:
+            # shutdown() blocks on an event only serve_forever() sets —
+            # calling it on a never-started server deadlocks
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self) -> "ClusterTelemetryServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

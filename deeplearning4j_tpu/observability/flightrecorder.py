@@ -29,6 +29,7 @@ Stdlib only; safe to import from any layer (imports nothing but
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -38,6 +39,22 @@ DEFAULT_CAPACITY = 4096
 # cap on distinct series a registry snapshot event may carry — a snapshot
 # must stay one compact ring entry, not a full scrape
 SNAPSHOT_SERIES_CAP = 256
+
+
+def _identity_fields() -> dict:
+    """Worker identity stamped onto every event envelope when this
+    process runs under a cluster supervisor (``DL4J_TPU_WORKER_ID``
+    armed) — merged cluster dossiers attribute events without guessing
+    which ring they came from. Empty (no extra keys) standalone."""
+    wid = os.environ.get("DL4J_TPU_WORKER_ID")
+    if wid is None:
+        return {}
+    try:
+        return {"worker": int(wid),
+                "generation": int(
+                    os.environ.get("DL4J_TPU_GENERATION", "1") or 1)}
+    except ValueError:
+        return {}
 
 
 class FlightRecorder:
@@ -59,8 +76,11 @@ class FlightRecorder:
     def record(self, kind: str, /, **data) -> dict:
         """Append one event; returns it (already enveloped). ``kind`` is
         positional-only so a producer may carry ``kind``/``t`` keys in
-        its data payload."""
-        ev = {"t": time.time(), "kind": kind, "data": data}
+        its data payload. Under a cluster supervisor the envelope also
+        carries ``worker``/``generation`` (identity lives in the
+        envelope, not ``data``, so producer keys can't clobber it)."""
+        ev = {"t": time.time(), "kind": kind,
+              **_identity_fields(), "data": data}
         with self._lock:
             if len(self._events) == self.capacity:
                 self._dropped += 1
@@ -94,13 +114,21 @@ class FlightRecorder:
              kinds: Optional[Iterable[str]] = None) -> dict:
         """The black-box dump: JSON-serializable, self-describing."""
         evs = self.events(last_seconds=last_seconds, kinds=kinds)
-        return {
+        out = {
             "capacity": self.capacity,
             "dropped_total": self.dropped_total,
             "window_seconds": last_seconds,
             "count": len(evs),
             "events": evs,
         }
+        ident = _identity_fields()
+        if ident:
+            try:
+                nw = int(os.environ.get("DL4J_TPU_NUM_WORKERS", "1") or 1)
+            except ValueError:
+                nw = 1
+            out["worker_identity"] = dict(ident, num_workers=nw)
+        return out
 
     def clear(self):
         with self._lock:

@@ -227,24 +227,33 @@ def load_jsonl(path: str) -> List[Span]:
     return spans
 
 
-def to_chrome_trace(spans: Iterable[Span]) -> dict:
+def to_chrome_trace(spans: Iterable[Span], *, pid: int = 1,
+                    process_name: Optional[str] = None) -> dict:
     """Chrome-trace JSON (Perfetto-loadable). One ``"X"`` complete event
     per span; ids/attrs ride in ``args`` so :func:`from_chrome_trace`
     reconstructs the exact span set (nesting included). Threads map to
-    tids with ``thread_name`` metadata events."""
+    tids with ``thread_name`` metadata events. ``pid``/``process_name``
+    place the whole span set on one process lane — the cluster
+    federation layer stitches per-worker traces into a single document
+    by giving each worker its own pid (observability/federation.py)."""
     spans = list(spans)
     tids: Dict[str, int] = {}
     for s in spans:
         tids.setdefault(s.thread or "main", len(tids) + 1)
-    events = [{"ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
-               "args": {"name": tname}} for tname, tid in tids.items()]
+    events: List[dict] = []
+    if process_name is not None:
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": process_name}})
+    events.extend({"ph": "M", "name": "thread_name", "pid": pid,
+                   "tid": tid, "args": {"name": tname}}
+                  for tname, tid in tids.items())
     for s in spans:
         # attrs ride in their own sub-dict: a user attr named "span_id"
         # must not clobber the identity keys the round trip depends on
         args = {"trace_id": s.trace_id, "span_id": s.span_id,
                 "parent_id": s.parent_id, "attrs": dict(s.attrs)}
         events.append({
-            "ph": "X", "cat": "span", "name": s.name, "pid": 1,
+            "ph": "X", "cat": "span", "name": s.name, "pid": pid,
             "tid": tids[s.thread or "main"],
             "ts": s.start * 1e6, "dur": s.duration * 1e6, "args": args})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
@@ -255,7 +264,10 @@ def from_chrome_trace(trace: dict) -> List[Span]:
     ``span_id`` in args); foreign events without one — e.g. XLA ops in a
     merged profile — are skipped."""
     events = trace.get("traceEvents", [])
-    tid_names = {ev.get("tid"): ev.get("args", {}).get("name")
+    # thread names are keyed per (pid, tid): a stitched multi-worker
+    # document reuses tid 1 on every worker's pid lane
+    tid_names = {(ev.get("pid"), ev.get("tid")):
+                 ev.get("args", {}).get("name")
                  for ev in events
                  if ev.get("ph") == "M" and ev.get("name") == "thread_name"}
     spans = []
@@ -270,7 +282,7 @@ def from_chrome_trace(trace: dict) -> List[Span]:
             ev.get("name", "?"), trace_id=args.get("trace_id"),
             span_id=args.get("span_id"), parent_id=args.get("parent_id"),
             start=start, end=start + float(ev.get("dur", 0.0)) / 1e6,
-            thread=tid_names.get(ev.get("tid")),
+            thread=tid_names.get((ev.get("pid"), ev.get("tid"))),
             attrs=dict(args.get("attrs", {}))))
     return spans
 

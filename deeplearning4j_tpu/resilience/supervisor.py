@@ -46,11 +46,13 @@ import random
 import signal
 import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from deeplearning4j_tpu.resilience.cluster import (
+    ENV_CRASH_DIR,
     ENV_HEARTBEAT_DIR,
     ENV_HEARTBEAT_INTERVAL,
     dead_peers,
@@ -145,6 +147,10 @@ class ElasticSupervisor:
         backoff_max_s: float = 30.0,
         backoff_jitter: float = 0.5,
         seed: int = 0,
+        telemetry: bool = False,
+        telemetry_poll_interval_s: float = 1.0,
+        cluster_server_port: Optional[int] = None,
+        cluster_slo_rules: Optional[Sequence] = None,
     ):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -168,6 +174,25 @@ class ElasticSupervisor:
         self.generation = 0
         self._procs: List[subprocess.Popen] = []
         self._logs: List[Path] = []
+        # -- cluster telemetry federation (observability/federation.py):
+        # with telemetry=True each generation's workers get an exporter
+        # port base + file-sink dir in env; the supervisor polls every
+        # worker's snapshot each telemetry_poll_interval_s, serves the
+        # federated view at /cluster/* (cluster_server_port: 0 =
+        # ephemeral, None = no HTTP surface), runs a HealthEngine over
+        # the federated registry (cluster_slo_rules: None = the default
+        # worker-liveness rule), and buries the cohort's last-known
+        # snapshots in a crash dossier on every teardown.
+        self.telemetry = bool(telemetry)
+        self.telemetry_poll_interval_s = float(telemetry_poll_interval_s)
+        self.cluster_server_port = cluster_server_port
+        self.cluster_slo_rules = cluster_slo_rules
+        self._restart_count = 0
+        self._aggregator = None
+        self._cluster_server = None
+        self._cluster_engine = None
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
 
     # -- introspection -------------------------------------------------------
 
@@ -179,6 +204,234 @@ class ElasticSupervisor:
                    generation: Optional[int] = None) -> Path:
         gen = self.generation if generation is None else generation
         return self.workdir / f"gen{gen}_worker{worker_id}.log"
+
+    @property
+    def telemetry_dir(self) -> Path:
+        return self.workdir / "telemetry"
+
+    @property
+    def aggregator(self):
+        """The :class:`~deeplearning4j_tpu.observability.federation.
+        ClusterAggregator` (None until the first telemetry-enabled
+        launch)."""
+        return self._aggregator
+
+    @property
+    def cluster_server(self):
+        return self._cluster_server
+
+    @property
+    def cluster_url(self) -> Optional[str]:
+        return (self._cluster_server.url
+                if self._cluster_server is not None else None)
+
+    # -- telemetry federation ------------------------------------------------
+
+    def _pick_telemetry_port_base(self) -> Optional[int]:
+        """A base port such that base..base+N-1 all bind right now
+        (workers derive base + worker_id). Racy by nature — a worker
+        losing the race falls back to its file sink, which the
+        aggregator reads anyway."""
+        import socket
+
+        for _ in range(32):
+            socks = []
+            try:
+                s0 = socket.socket()
+                s0.bind(("127.0.0.1", 0))
+                base = s0.getsockname()[1]
+                socks.append(s0)
+                ok = base + self.num_workers <= 65535
+                for i in range(1, self.num_workers if ok else 0):
+                    s = socket.socket()
+                    try:
+                        s.bind(("127.0.0.1", base + i))
+                        socks.append(s)
+                    except OSError:
+                        ok = False
+                        break
+                if ok:
+                    return base
+            finally:
+                for s in socks:
+                    s.close()
+        return None
+
+    def _arm_telemetry(self, env: Dict[str, str]) -> None:
+        """Per-generation telemetry env + aggregator (re)configuration;
+        called from ``_launch_cohort`` before workers spawn."""
+        from deeplearning4j_tpu.observability.federation import (
+            ENV_TELEMETRY_DIR,
+            ENV_TELEMETRY_PORT_BASE,
+            ClusterAggregator,
+        )
+
+        base = self._pick_telemetry_port_base()
+        self.telemetry_dir.mkdir(parents=True, exist_ok=True)
+        if base is not None:
+            env[ENV_TELEMETRY_PORT_BASE] = str(base)
+        env[ENV_TELEMETRY_DIR] = str(self.telemetry_dir)
+        if self._aggregator is None:
+            # fresh run: a PREVIOUS run's sink files must not read as
+            # this cohort's last-known state (they would defeat the
+            # aggregator's startup grace and leak foreign snapshots
+            # into the federated view/dossier). Cleared only here —
+            # across THIS run's generations the files are the dead
+            # workers' final states the dossier needs.
+            for f in self.telemetry_dir.glob("worker_*.json"):
+                try:
+                    f.unlink()
+                except OSError:
+                    pass
+            self._aggregator = ClusterAggregator(
+                num_workers=self.num_workers, port_base=base,
+                sink_dir=self.telemetry_dir,
+                heartbeat_dir=self.heartbeat_dir,
+                restarts=lambda: self._restart_count)
+        else:
+            self._aggregator.set_port_base(base)
+
+    def _start_telemetry_surface(self) -> None:
+        """Cluster HTTP surface + federated SLO engine (idempotent)."""
+        if self._aggregator is None:
+            return
+        if self._cluster_engine is None:
+            try:
+                from deeplearning4j_tpu.observability.federation import (
+                    default_cluster_rules,
+                )
+                from deeplearning4j_tpu.observability.slo import (
+                    HealthEngine,
+                )
+
+                rules = (list(self.cluster_slo_rules)
+                         if self.cluster_slo_rules is not None
+                         else default_cluster_rules())
+                self._cluster_engine = HealthEngine(
+                    rules, registries=self._aggregator.registries(),
+                    interval_s=max(1.0, self.telemetry_poll_interval_s))
+                self._cluster_engine.start()
+            except Exception:  # noqa: BLE001 — telemetry never fails
+                self._cluster_engine = None  # supervision
+        if self._cluster_server is None \
+                and self.cluster_server_port is not None:
+            try:
+                from deeplearning4j_tpu.observability.federation import (
+                    ClusterTelemetryServer,
+                )
+
+                self._cluster_server = ClusterTelemetryServer(
+                    self._aggregator, port=self.cluster_server_port,
+                    engine=self._cluster_engine,
+                    max_staleness_s=self.telemetry_poll_interval_s)
+                self._cluster_server.start()
+            except Exception:  # noqa: BLE001
+                self._cluster_server = None
+        if self._poll_thread is None:
+            # polling runs on its own thread: a wedged worker blocks a
+            # fetch for fetch_timeout_s, and that must never delay the
+            # watch loop's exit/hang detection
+            self._poll_stop.clear()
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name="supervisor-telemetry")
+            self._poll_thread.start()
+
+    def _poll_loop(self):
+        while not self._poll_stop.wait(self.telemetry_poll_interval_s):
+            try:
+                self._aggregator.poll()
+            except Exception:  # noqa: BLE001 — telemetry never fails
+                pass           # supervision
+
+    def _stop_telemetry_surface(self) -> None:
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=10)
+            self._poll_thread = None
+        if self._aggregator is not None:
+            try:
+                self._aggregator.close()  # releases fetch-pool threads
+            except Exception:  # noqa: BLE001
+                pass
+        if self._cluster_server is not None:
+            try:
+                self._cluster_server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._cluster_server = None
+        if self._cluster_engine is not None:
+            try:
+                self._cluster_engine.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._cluster_engine = None
+
+    def _write_cluster_dossier(self, failure: str) -> Optional[str]:
+        """On cohort teardown: one final poll (the dead worker's file
+        sink still holds its last pre-crash snapshot), then the whole
+        last-known cluster view — worker table, merged timeline, every
+        worker's final snapshot — into a crash report.
+
+        Written WITHOUT ``utils.crash.write_crash_report``: that path
+        imports jax and enumerates devices, and a supervisor that
+        initializes an accelerator backend between generations would
+        hold the very devices its relaunched workers need."""
+        if self._aggregator is None:
+            return None
+        try:
+            self._aggregator.poll()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import datetime
+            import json
+
+            crash_dir = Path(os.environ.get(ENV_CRASH_DIR,
+                                            str(self.workdir)))
+            crash_dir.mkdir(parents=True, exist_ok=True)
+            report = {
+                "timestamp": datetime.datetime.now().isoformat(),
+                "pid": os.getpid(),
+                "kind": "supervisor_cluster_dossier",
+                "extra": {
+                    "supervisor_failure": failure,
+                    "generation": self.generation,
+                    "cluster_dossier": self._aggregator.dossier(),
+                },
+            }
+            try:
+                from deeplearning4j_tpu.observability.flightrecorder import (  # noqa: E501
+                    get_flight_recorder,
+                )
+
+                report["flight_recorder"] = \
+                    get_flight_recorder().dump(last_seconds=120.0)
+            except Exception:  # noqa: BLE001
+                pass
+            # generation + microseconds uniquify: rapid launch-crash
+            # loops (sub-second backoff) must not overwrite the
+            # previous generation's dossier — the forensic artifact
+            # this path exists to preserve
+            stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S-%f")
+            path = crash_dir / (f"dl4j-tpu-crash-{stamp}-cluster-"
+                                f"g{self.generation}-{os.getpid()}.json")
+            path.write_text(json.dumps(report, indent=2, default=str))
+            try:
+                from deeplearning4j_tpu.observability import (
+                    metrics as _obsm,
+                )
+
+                if _obsm.enabled():
+                    _obsm.get_resilience_metrics() \
+                         .crash_reports_total.inc()
+            except Exception:  # noqa: BLE001
+                pass
+            _flight("supervisor.cluster_dossier",
+                    generation=self.generation, path=str(path))
+            return str(path)
+        except Exception:  # noqa: BLE001 — reporting never blocks the
+            return None    # relaunch
 
     # -- cohort lifecycle ----------------------------------------------------
 
@@ -198,6 +451,8 @@ class ElasticSupervisor:
                 except OSError:
                     pass
         hb.mkdir(parents=True, exist_ok=True)
+        if self.telemetry:
+            self._arm_telemetry(gen_env)
         self._procs, self._logs = [], []
         for wid in range(self.num_workers):
             env = dict(self.env)
@@ -304,38 +559,51 @@ class ElasticSupervisor:
         to ``max_restarts`` times, then raise :class:`SupervisorGaveUp`."""
         self.workdir.mkdir(parents=True, exist_ok=True)
         restarts = 0
-        while True:
-            self.generation += 1
-            gen_env = dict(self.on_generation(self.generation)
-                           if self.on_generation is not None else {})
-            self._launch_cohort(gen_env)
-            failure = self._watch_cohort()
-            if failure is None:
-                _flight("supervisor.complete", generation=self.generation,
-                        restarts=restarts)
-                return SupervisorResult(generations=self.generation,
-                                        restarts=restarts, exits=self.exits)
-            if restarts >= self.max_restarts:
-                _flight("supervisor.gave_up", generation=self.generation,
-                        restarts=restarts, failure=failure)
-                raise SupervisorGaveUp(
-                    f"cohort failed {restarts + 1}x (restart budget "
-                    f"{self.max_restarts}); last failure: {failure}",
-                    self.exits)
-            restarts += 1
-            delay = next(self._delays)
-            _flight("supervisor.restart", generation=self.generation,
-                    restarts=restarts, failure=failure,
-                    backoff_s=round(delay, 3))
-            try:
-                from deeplearning4j_tpu.observability import metrics as _obsm
+        try:
+            while True:
+                self.generation += 1
+                gen_env = dict(self.on_generation(self.generation)
+                               if self.on_generation is not None else {})
+                self._launch_cohort(gen_env)
+                self._start_telemetry_surface()
+                failure = self._watch_cohort()
+                if failure is None:
+                    _flight("supervisor.complete",
+                            generation=self.generation, restarts=restarts)
+                    return SupervisorResult(generations=self.generation,
+                                            restarts=restarts,
+                                            exits=self.exits)
+                # cohort teardown: the aggregator's last-known view of
+                # every worker (the dead one's final snapshot included)
+                # becomes the crash dossier before anything relaunches
+                self._write_cluster_dossier(failure)
+                if restarts >= self.max_restarts:
+                    _flight("supervisor.gave_up",
+                            generation=self.generation,
+                            restarts=restarts, failure=failure)
+                    raise SupervisorGaveUp(
+                        f"cohort failed {restarts + 1}x (restart budget "
+                        f"{self.max_restarts}); last failure: {failure}",
+                        self.exits)
+                restarts += 1
+                self._restart_count = restarts
+                delay = next(self._delays)
+                _flight("supervisor.restart", generation=self.generation,
+                        restarts=restarts, failure=failure,
+                        backoff_s=round(delay, 3))
+                try:
+                    from deeplearning4j_tpu.observability import (
+                        metrics as _obsm,
+                    )
 
-                if _obsm.enabled():
-                    _obsm.get_resilience_metrics() \
-                         .supervisor_restarts_total.inc()
-            except Exception:  # noqa: BLE001
-                pass
-            time.sleep(delay)
+                    if _obsm.enabled():
+                        _obsm.get_resilience_metrics() \
+                             .supervisor_restarts_total.inc()
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(delay)
+        finally:
+            self._stop_telemetry_surface()
 
     def stop(self):
         """Terminate any live workers (cleanup path for callers that
@@ -353,12 +621,15 @@ def worker_identity() -> Dict[str, int]:
     """The supervisor-provided identity of this worker process
     (``{"worker_id", "num_workers", "generation"}``; zeros/ones when not
     running under a supervisor) — what a worker script reads to wire
-    ``distributed.initialize(process_id=..., num_processes=...)``."""
-    return {
-        "worker_id": int(os.environ.get(ENV_WORKER_ID, "0")),
-        "num_workers": int(os.environ.get(ENV_NUM_WORKERS, "1")),
-        "generation": int(os.environ.get(ENV_GENERATION, "1")),
-    }
+    ``distributed.initialize(process_id=..., num_processes=...)``.
+    Delegates to the observability layer's parser so every consumer
+    (snapshots, crash reports, worker scripts) agrees on junk-env
+    semantics (degrade to defaults, never raise)."""
+    from deeplearning4j_tpu.observability.federation import (
+        worker_identity as _identity,
+    )
+
+    return _identity()
 
 
 def install_sigterm_teardown(sup: ElasticSupervisor) -> bool:

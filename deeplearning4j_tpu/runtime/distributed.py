@@ -138,20 +138,147 @@ def global_mesh(spec: Optional[MeshSpec] = None):
     return build_mesh(spec or MeshSpec(), devices_=jax.devices())
 
 
+# -- cluster trace context (observability/federation.py consumes it) ---------
+#
+# A correlation id minted at the coordinator (process 0) and propagated
+# to every worker through `broadcast_host_data`, from which per-step
+# trace ids + root span ids derive DETERMINISTICALLY — so every
+# worker's collective legs for one training step share one trace id
+# and one (synthesizable) root without any per-step rendezvous, and
+# the cluster aggregator stitches them into a single trace tree.
+
+_CLUSTER_TRACE_ID: Optional[str] = None
+_CURRENT_STEP = 0
+
+
+def establish_cluster_trace(timeout_s: Optional[float] = None
+                            ) -> Optional[str]:
+    """Agree on one cluster-wide trace id: process 0 mints it, everyone
+    receives it over the (deadline-guarded) host broadcast. Idempotent;
+    single-process jobs just mint locally. Call once after
+    :func:`initialize`."""
+    global _CLUSTER_TRACE_ID
+    if _CLUSTER_TRACE_ID is not None:
+        return _CLUSTER_TRACE_ID
+    from deeplearning4j_tpu.observability import trace as _trace
+
+    tid = _trace.new_id()
+    if is_multiprocess():
+        # fixed-shape byte buffer: broadcast_one_to_all needs identical
+        # pytree structure/shape on every process (ids are 16 ASCII hex)
+        buf = np.frombuffer(tid.encode("ascii"), dtype=np.uint8)
+        got = broadcast_host_data(buf, timeout_s=timeout_s)
+        tid = bytes(np.asarray(got, dtype=np.uint8)).decode("ascii")
+    _CLUSTER_TRACE_ID = tid
+    return tid
+
+
+def cluster_trace_id() -> Optional[str]:
+    return _CLUSTER_TRACE_ID
+
+
+def reset_cluster_trace() -> None:
+    """Drop the agreed trace id (tests / re-initialization)."""
+    global _CLUSTER_TRACE_ID
+    _CLUSTER_TRACE_ID = None
+
+
+def note_step(step: int) -> None:
+    """Record the training loop's current optimizer step (a bare global
+    store — called per step next to ``touch_heartbeat``) so collective
+    legs are attributed to the step that issued them."""
+    global _CURRENT_STEP
+    _CURRENT_STEP = int(step)
+
+
+def current_step() -> int:
+    return _CURRENT_STEP
+
+
+def step_trace_id(step: Optional[int] = None) -> Optional[str]:
+    """The cluster-wide trace id of one training step: the agreed
+    cluster prefix + an ``s`` marker + the step number — identical on
+    every worker with no communication. The non-hex marker reserves a
+    namespace disjoint from ``trace.new_id()`` (pure 16-hex), so a
+    step's trace id can never collide with an ordinary span tree
+    minted on the coordinator. None until a cluster trace is
+    established."""
+    if _CLUSTER_TRACE_ID is None:
+        return None
+    s = _CURRENT_STEP if step is None else int(step)
+    return f"{_CLUSTER_TRACE_ID[:8]}s{s & 0xFFFFFFFF:08x}"
+
+
+def step_root_span_id(step: Optional[int] = None) -> Optional[str]:
+    """The deterministic root span id every worker parents its step's
+    collective legs to (the ``r`` marker keeps it distinct from both
+    :func:`step_trace_id` and every ``new_id()`` output). No worker
+    records the root itself — the federation stitcher synthesizes it
+    (``cluster.step``)."""
+    if _CLUSTER_TRACE_ID is None:
+        return None
+    s = _CURRENT_STEP if step is None else int(step)
+    return f"{_CLUSTER_TRACE_ID[:8]}r{s & 0xFFFFFFFF:08x}"
+
+
+def _record_collective_span(op: str, start: float, end: float,
+                            error: Optional[str], *, step: int,
+                            trace_id: Optional[str],
+                            parent_id: Optional[str]) -> None:
+    from deeplearning4j_tpu.observability import trace as _trace
+
+    if not _trace.tracing_enabled():
+        return
+    attrs = {"op": op, "worker": process_index(), "step": step}
+    if error is not None:
+        attrs["error"] = error
+    _trace.record_span(
+        f"collective.{op.split(':', 1)[0]}", start=start, end=end,
+        trace_id=trace_id, parent_id=parent_id, **attrs)
+
+
 def _guard_collective(fn, *, op: str, timeout_s: Optional[float]):
     """Run a host collective under the watchdog deadline; the
     ``collective.stall`` injection point fires inside the guarded region
     (so an injected stall is observed exactly like a dead peer's).
-    Resolves to a direct call when no deadline is armed."""
+    Resolves to a direct call when no deadline is armed. With a cluster
+    trace established, each leg is recorded as a span on the cluster-
+    wide trace id of the step that ISSUED it (captured at entry — a
+    watchdog-abandoned leg whose thread unblocks later still attributes
+    correctly); a leg still blocked at process exit never records, and
+    the watchdog's ``collective.timeout`` flight event carries the
+    stall itself."""
     from deeplearning4j_tpu.resilience.cluster import get_watchdog
     from deeplearning4j_tpu.resilience.faults import get_fault_injector
 
     inj = get_fault_injector()
 
-    def _guarded():
+    def _bare():
         if inj.enabled:
             inj.maybe_sleep("collective.stall")
         return fn()
+
+    if _CLUSTER_TRACE_ID is None:
+        _guarded = _bare
+    else:
+        def _guarded():
+            from deeplearning4j_tpu.observability.trace import now as _now
+
+            # attribution is captured at ENTRY: a watchdog-abandoned
+            # leg whose thread completes seconds later must record
+            # against the step that issued it, not whatever step the
+            # training loop has advanced to by then
+            step = _CURRENT_STEP
+            tid, root = step_trace_id(step), step_root_span_id(step)
+            t0, err = _now(), None
+            try:
+                return _bare()
+            except BaseException as e:  # noqa: BLE001 — re-raised
+                err = type(e).__name__
+                raise
+            finally:
+                _record_collective_span(op, t0, _now(), err, step=step,
+                                        trace_id=tid, parent_id=root)
 
     wd = get_watchdog()
     if wd.resolve_timeout(timeout_s) is None or (

@@ -736,6 +736,11 @@ class Trainer:
                     # detector (resilience/cluster.py); a no-op global
                     # check unless a supervisor armed a heartbeat
                     _touch_heartbeat()
+                    # step attribution for cluster trace stitching: the
+                    # next collective's span joins THIS step's cluster-
+                    # wide trace id (runtime/distributed.py; a bare
+                    # global int store)
+                    _note_step(host_step + len(wmetrics))
                     for wm in wmetrics:
                         host_step += 1
                         for lst in listeners:
@@ -876,3 +881,4 @@ def _record_batch_transfer(batch):
 from deeplearning4j_tpu.data.dataset import as_batch_dict as _as_batch_dict  # noqa: E402
 from deeplearning4j_tpu.resilience.cluster import touch_heartbeat as _touch_heartbeat  # noqa: E402
 from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector  # noqa: E402
+from deeplearning4j_tpu.runtime.distributed import note_step as _note_step  # noqa: E402

@@ -93,6 +93,23 @@ def write_crash_report(
             report["model_config"] = repr(getattr(model, "config", model))[:4000]
     if extra:
         report["extra"] = extra
+    # worker identity: merged cluster dossiers must attribute each
+    # report to its worker/generation without parsing logs — the
+    # identity rides in the body AND the filename (two reports from two
+    # workers of one cohort can no longer collide or need guessing)
+    ident_tag = ""
+    if os.environ.get("DL4J_TPU_WORKER_ID") is not None:
+        try:
+            from deeplearning4j_tpu.observability.federation import (
+                worker_identity,
+            )
+
+            ident = worker_identity()
+            report["worker_identity"] = ident
+            ident_tag = (f"-w{ident['worker_id']}"
+                         f"g{ident['generation']}")
+        except Exception:  # noqa: BLE001 - identity never masks the crash
+            pass
     try:
         # black-box timeline: the flight recorder's trailing window rides
         # in every crash dump, so "what happened just before?" is
@@ -108,7 +125,8 @@ def write_crash_report(
 
     os.makedirs(directory, exist_ok=True)
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
-    path = os.path.join(directory, f"dl4j-tpu-crash-{stamp}-{os.getpid()}.json")
+    path = os.path.join(
+        directory, f"dl4j-tpu-crash-{stamp}{ident_tag}-{os.getpid()}.json")
     with open(path, "w") as fh:
         json.dump(report, fh, indent=2, default=str)
     _LAST_REPORT = path

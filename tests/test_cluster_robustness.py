@@ -441,13 +441,18 @@ def _run_chaos(tmp_path, *, chaos: bool, max_restarts: int = 2):
     return sup, sup.run()
 
 
+@pytest.mark.slow
 def test_chaos_sigkill_midfit_supervisor_resumes_step_exact(tmp_path):
     """THE acceptance run: with ``collective.stall`` +
     ``train.worker_kill`` armed, worker 1 of a 2-process gloo fit is
     SIGKILLed mid-epoch; the supervisor relaunches the cohort; both
     workers resume from the latest verified checkpoint at the exact
     rolled-back step; the completed run's optimizer-step count (and
-    final params) match the fault-free run's."""
+    final params) match the fault-free run's.
+
+    Tier-1 budget relief (ROADMAP item 5): slow-marked (~20 s — two
+    full 2-process gloo cohorts); the single-process proxy below keeps
+    the supervisor + SIGKILL + verified-resume semantics in tier-1."""
     try:
         sup_clean, clean = _run_chaos(tmp_path, chaos=False)
     except SupervisorGaveUp as e:
@@ -486,6 +491,78 @@ def test_chaos_sigkill_midfit_supervisor_resumes_step_exact(tmp_path):
     # bitwise-identical final params: the relaunch replayed exactly the
     # batches the fault-free run saw
     assert re.search(r"end_digest (\d+)", g2w0).group(1) == clean_digest
+
+
+_PROXY_CHAOS_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    gen = int(os.environ["DL4J_TPU_GENERATION"])
+    if gen == 1:
+        # SIGKILL before the 6th optimizer step: mid-epoch 2 (epoch
+        # boundaries at 4/8/12), so the resume target is step 4
+        os.environ["DL4J_TPU_FAULTS"] = "train.worker_kill@6!kill"
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                              SequentialConfig)
+    from deeplearning4j_tpu.nn.layers.core import Dense
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.resilience import (FaultTolerantTrainer,
+                                               RecoveryPolicy)
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(updater=Sgd(0.05), seed=7),
+        input_shape=(8,),
+        layers=[Dense(units=16, activation="tanh"),
+                OutputLayer(units=4, loss="mcxent", activation="softmax")],
+    ))
+    r = np.random.default_rng(11)
+    x = r.normal(size=(32, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 32)]
+    data = ArrayDataSetIterator(x, y, batch_size=8, shuffle=False)
+
+    trainer = Trainer(model)
+    ft = FaultTolerantTrainer(
+        trainer, os.environ["CKPT_DIR"], model=model,
+        policy=RecoveryPolicy(checkpoint_every=0,
+                              checkpoint_every_epoch=True, keep_last=3))
+    ts0 = ft.resume(trainer.init_state())
+    print("resumed_step", int(jax.device_get(ts0.step)), flush=True)
+    ts = ft.fit(ts0, data, epochs=3, resume=True)
+    print("end_step", int(jax.device_get(ts.step)), flush=True)
+""")
+
+
+def test_supervisor_worker_kill_resumes_step_exact_single_process(tmp_path):
+    """Fast tier-1 proxy for the slow 2-process chaos acceptance run
+    above: the SAME supervisor + injected ``train.worker_kill`` SIGKILL
+    + verified-checkpoint resume semantics, minus the gloo cohort — the
+    relaunched generation must resume at the exact epoch-boundary
+    rollback step and finish with the fault-free step count."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CKPT_DIR=str(tmp_path / "ckpt"))
+    sup = ElasticSupervisor(
+        [sys.executable, "-c", _PROXY_CHAOS_WORKER], num_workers=1,
+        max_restarts=1, workdir=tmp_path / "run", env=env,
+        backoff_base_s=0.05, backoff_max_s=0.2)
+    res = sup.run()
+    assert res.generations == 2 and res.restarts == 1
+    gen1 = next(e for e in res.exits if e.generation == 1)
+    assert gen1.returncode == -signal.SIGKILL
+    g1 = sup.worker_log(0, 1).read_text()
+    assert "resumed_step 0" in g1
+    assert "end_step" not in g1  # died mid-epoch 2, after the step-4 save
+    g2 = sup.worker_log(0, 2).read_text()
+    # resumed at the exact rolled-back step (epoch-0 boundary save) and
+    # completed the fault-free step count: 3 epochs x 4 batches
+    assert "resumed_step 4" in g2, g2[-2000:]
+    assert re.search(r"end_step (\d+)", g2).group(1) == "12"
 
 
 # -- serving: worker supervision + circuit breaker ----------------------------

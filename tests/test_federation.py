@@ -1,0 +1,964 @@
+"""Cluster telemetry federation (ISSUE 6): worker-labeled metrics
+union, cross-worker trace stitching, supervisor cohort view.
+
+Three layers under test:
+
+1. **TelemetryExporter** — each worker publishes its default-registry
+   scrape, flight ring, and spans over a tiny HTTP endpoint (port
+   derived from ``DL4J_TPU_WORKER_ID``) or, where no port binds, an
+   atomically-rewritten file sink that survives the worker's death.
+2. **ClusterAggregator / federation** — the supervisor side polls every
+   worker, unions their series into one ``worker``/``generation``-
+   labeled registry (strict collision rules), merges flight events into
+   one ordered timeline, and stitches spans into a single Perfetto
+   trace with one pid lane per worker.
+3. **The cohort view** — ``/cluster/*`` endpoints, the federated SLO
+   health engine, and the supervisor writing the whole last-known
+   cluster view (dead worker's final snapshot included) into the crash
+   dossier on cohort teardown.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from deeplearning4j_tpu.observability import federation as fed
+from deeplearning4j_tpu.observability import flightrecorder as fr
+from deeplearning4j_tpu.observability import metrics as om
+from deeplearning4j_tpu.observability import trace as tr
+from deeplearning4j_tpu.observability import slo
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    om.reset_default_registry()
+    fr.set_flight_recorder(None)
+    tr.get_tracer().clear()
+    yield
+    om.reset_default_registry()
+    fr.set_flight_recorder(None)
+    tr.get_tracer().clear()
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _get_json(url, timeout=5):
+    status, raw = _get(url, timeout=timeout)
+    return status, json.loads(raw)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fake_snapshot(wid, *, gen=1, steps=5.0, t=None, events=(), spans=()):
+    """A minimal worker snapshot document (what /snapshot serves)."""
+    return {
+        "worker": wid, "num_workers": 2, "generation": gen,
+        "pid": 1000 + wid, "time": time.time() if t is None else t,
+        "metrics": {"metrics": [
+            {"name": "train_steps_total", "type": "counter",
+             "help": "steps", "samples": [{"labels": {}, "value": steps}]},
+        ]},
+        "flight": {"capacity": 16, "dropped_total": 0, "count": len(events),
+                   "events": list(events)},
+        "spans": [s.to_json() for s in spans],
+    }
+
+
+# ---------------------------------------------------------------------------
+# exporter
+
+
+class TestTelemetryExporter:
+    def test_port_derivation_from_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_TELEMETRY_PORT_BASE", "9400")
+        monkeypatch.setenv("DL4J_TPU_WORKER_ID", "3")
+        assert fed.telemetry_port() == 9403
+        monkeypatch.setenv("DL4J_TPU_TELEMETRY_PORT", "7777")
+        assert fed.telemetry_port() == 7777  # explicit port wins
+        monkeypatch.delenv("DL4J_TPU_TELEMETRY_PORT")
+        monkeypatch.delenv("DL4J_TPU_TELEMETRY_PORT_BASE")
+        assert fed.telemetry_port() is None
+
+    def test_http_endpoints(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_WORKER_ID", "0")
+        monkeypatch.setenv("DL4J_TPU_NUM_WORKERS", "2")
+        monkeypatch.setenv("DL4J_TPU_GENERATION", "4")
+        om.get_training_metrics().steps_total.inc(7)
+        fr.record_event("test.note", detail="x")
+        with tr.span("unit.work"):
+            pass
+        with fed.TelemetryExporter(port=0) as exp:
+            assert exp.mode == "http"
+            url = exp.url
+            _, ident = _get_json(url + "/identity")
+            assert ident["worker_id"] == 0 and ident["generation"] == 4
+            _, snap = _get_json(url + "/snapshot")
+            assert snap["worker"] == 0 and snap["num_workers"] == 2
+            fams = {m["name"] for m in snap["metrics"]["metrics"]}
+            assert "train_steps_total" in fams
+            assert snap["flight"]["events"][-1]["kind"] == "test.note"
+            # identity stamped on the event envelope at the source
+            assert snap["flight"]["events"][-1]["worker"] == 0
+            assert any(s["name"] == "unit.work" for s in snap["spans"])
+            _, raw = _get(url + "/metrics")
+            assert b"train_steps_total 7" in raw
+            _, doc = _get_json(url + "/metrics?format=json")
+            assert any(m["name"] == "train_steps_total"
+                       for m in doc["metrics"])
+            _, dump = _get_json(url + "/flightrecorder?seconds=60")
+            assert dump["count"] >= 1
+            _, spans = _get_json(url + "/trace")
+            assert any(s["name"] == "unit.work" for s in spans["spans"])
+            _, chrome = _get_json(url + "/trace?format=chrome")
+            assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+            status, _ = _get_json(url + "/healthz")
+            assert status == 200
+
+    def test_file_sink_mode_and_final_write(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_WORKER_ID", "1")
+        exp = fed.TelemetryExporter(sink_dir=tmp_path,
+                                    sink_interval_s=30.0).start()
+        try:
+            assert exp.mode == "file"
+            path = tmp_path / "worker_1.json"
+            assert path.exists()  # written on start
+            om.get_training_metrics().steps_total.inc(2)
+        finally:
+            exp.stop()  # final write carries the post-start increments
+        snap = json.loads(path.read_text())
+        fam = next(m for m in snap["metrics"]["metrics"]
+                   if m["name"] == "train_steps_total")
+        assert fam["samples"][0]["value"] == 2
+
+    def test_unbindable_port_falls_back_to_file_sink(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_WORKER_ID", "0")
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        taken = blocker.getsockname()[1]
+        try:
+            exp = fed.TelemetryExporter(port=taken,
+                                        sink_dir=tmp_path).start()
+            try:
+                assert exp.mode == "file"
+                assert (tmp_path / "worker_0.json").exists()
+            finally:
+                exp.stop()
+        finally:
+            blocker.close()
+
+    def test_from_env_disabled_without_config(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_TELEMETRY_PORT", raising=False)
+        monkeypatch.delenv("DL4J_TPU_TELEMETRY_PORT_BASE", raising=False)
+        monkeypatch.delenv("DL4J_TPU_TELEMETRY_DIR", raising=False)
+        assert fed.telemetry_exporter_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# federation of metrics documents
+
+
+class TestFederateInstruments:
+    def test_counter_gauge_union_with_worker_labels(self):
+        snaps = {0: _fake_snapshot(0, steps=5), 1: _fake_snapshot(1, steps=9)}
+        insts = fed.federate_instruments(snaps)
+        (inst,) = insts
+        assert inst.labelnames == ("worker", "generation")
+        text = om.render_text_multi([_Reg(insts)])
+        assert 'train_steps_total{worker="0",generation="1"} 5' in text
+        assert 'train_steps_total{worker="1",generation="1"} 9' in text
+
+    def test_labeled_family_keeps_original_labels_first(self):
+        snap = _fake_snapshot(0)
+        snap["metrics"]["metrics"] = [{
+            "name": "serving_requests_total", "type": "counter", "help": "",
+            "samples": [{"labels": {"model": "m", "code": "200"},
+                         "value": 3.0}]}]
+        (inst,) = fed.federate_instruments({0: snap})
+        assert inst.labelnames == ("model", "code", "worker", "generation")
+        assert ('serving_requests_total{model="m",code="200",worker="0",'
+                'generation="1"} 3') in "\n".join(inst.render())
+
+    def test_histogram_reconstruction_preserves_buckets(self):
+        h = om.MetricsRegistry().histogram("lat_seconds", "h",
+                                           buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        fam = h.to_json()
+        snap = _fake_snapshot(0)
+        snap["metrics"]["metrics"] = [fam]
+        (inst,) = fed.federate_instruments({0: snap})
+        lines = "\n".join(inst.render())
+        assert 'lat_seconds_bucket{worker="0",generation="1",le="0.1"} 1' \
+            in lines
+        assert 'lat_seconds_bucket{worker="0",generation="1",le="1"} 2' \
+            in lines
+        assert 'lat_seconds_bucket{worker="0",generation="1",le="+Inf"} 3' \
+            in lines
+        assert 'lat_seconds_count{worker="0",generation="1"} 3' in lines
+
+    def test_type_conflict_dropped_not_interleaved(self):
+        a = _fake_snapshot(0)
+        b = _fake_snapshot(1)
+        b["metrics"]["metrics"][0]["type"] = "gauge"  # disagrees with w0
+        conflicts = []
+        insts = fed.federate_instruments(
+            {0: a, 1: b}, on_conflict=lambda n, r: conflicts.append(n))
+        (inst,) = insts
+        assert conflicts == ["train_steps_total"]
+        # only worker 0's sample made it in
+        keys = list(inst._data)
+        assert keys == [("0", "1")]
+
+    def test_label_mismatch_conflict(self):
+        a = _fake_snapshot(0)
+        b = _fake_snapshot(1)
+        b["metrics"]["metrics"][0]["samples"] = [
+            {"labels": {"shard": "x"}, "value": 1.0}]
+        conflicts = []
+        fed.federate_instruments(
+            {0: a, 1: b}, on_conflict=lambda n, r: conflicts.append(n))
+        assert conflicts == ["train_steps_total"]
+
+    def test_malformed_family_contained_as_conflict(self):
+        """A version-skewed worker's family missing required fields must
+        drop as a conflict — not poison the whole federated rebuild."""
+        good = _fake_snapshot(0)
+        bad = _fake_snapshot(1, steps=3)
+        bad["metrics"]["metrics"].append(
+            {"name": "weird_family", "samples": [{"labels": {}}]})  # no type
+        conflicts = []
+        insts = fed.federate_instruments(
+            {0: good, 1: bad},
+            on_conflict=lambda n, r: conflicts.append((n, r)))
+        # the good families from BOTH workers still federate
+        (inst,) = insts
+        assert set(inst._data) == {("0", "1"), ("1", "1")}
+        assert ("weird_family", "malformed family") in conflicts
+
+    def test_reserved_federation_label_is_a_conflict(self):
+        """A worker family already labeled `worker` would render
+        duplicate label names (invalid exposition) — dropped, not
+        interleaved."""
+        snap = _fake_snapshot(0)
+        snap["metrics"]["metrics"][0]["samples"] = [
+            {"labels": {"worker": "9"}, "value": 1.0}]
+        conflicts = []
+        insts = fed.federate_instruments(
+            {0: snap}, on_conflict=lambda n, r: conflicts.append((n, r)))
+        assert insts == []
+        assert conflicts == [("train_steps_total",
+                              "reserved federation label")]
+
+
+class _Reg:
+    """Minimal registry stand-in for render_text_multi."""
+
+    def __init__(self, insts):
+        self._insts = insts
+
+    def instruments(self):
+        return list(self._insts)
+
+
+# ---------------------------------------------------------------------------
+# aggregator over file sinks
+
+
+class TestClusterAggregator:
+    def _write(self, d, wid, **kw):
+        (Path(d) / f"worker_{wid}.json").write_text(
+            json.dumps(_fake_snapshot(wid, **kw)))
+
+    def test_poll_liveness_lag_and_last_known(self, tmp_path):
+        self._write(tmp_path, 0, steps=10)
+        self._write(tmp_path, 1, steps=6)
+        agg = fed.ClusterAggregator(num_workers=2, sink_dir=tmp_path,
+                                    liveness_window_s=60.0,
+                                    restarts=lambda: 2)
+        table = agg.poll()
+        assert table["up"] == 2
+        m = agg.metrics
+        assert m.worker_up.value(worker="0") == 1
+        assert m.worker_last_step.value(worker="0") == 10
+        assert m.worker_step_lag.value(worker="1") == 4
+        assert m.restarts_total.value() == 2
+        assert m.worker_polls_total.value(worker="0") == 1
+        # worker 1 goes stale: down, but the NEWEST-known snapshot is
+        # retained — a backdated leftover file must not overwrite the
+        # fresher state already held (the dossier's 'final state')
+        self._write(tmp_path, 1, steps=8, t=time.time() - 3600)
+        agg.liveness_window_s = 0.5
+        table = agg.poll()
+        assert table["up"] == 1
+        assert m.worker_up.value(worker="1") == 0
+        assert m.worker_poll_failures_total.value(worker="1") == 1
+        assert agg.dossier()["snapshots"]["1"] is not None
+        row = next(r for r in table["workers"] if r["worker"] == 1)
+        assert row["snapshot"] and row["last_step"] == 6  # newest kept
+        # a genuinely newer (if stale-by-window) file DOES update it
+        time.sleep(0.1)  # ensure the new stamp postdates the held one
+        self._write(tmp_path, 1, steps=9, t=time.time() - 0.05)
+        agg.liveness_window_s = 0.01
+        table = agg.poll()
+        row = next(r for r in table["workers"] if r["worker"] == 1)
+        assert row["last_step"] == 9 and not row["up"]
+
+    def test_foreign_snapshot_identity_rejected(self, tmp_path):
+        """A snapshot whose own identity stamp disagrees with the slot
+        it was fetched from (port-race loser, copied file) must not be
+        attributed to that worker."""
+        (tmp_path / "worker_0.json").write_text(
+            json.dumps(_fake_snapshot(5)))
+        agg = fed.ClusterAggregator(num_workers=1, sink_dir=tmp_path,
+                                    startup_grace_s=0.0)
+        table = agg.poll()
+        assert table["up"] == 0
+        assert agg.dossier()["snapshots"] == {}
+        assert agg.metrics.worker_poll_failures_total.value(worker="0") \
+            == 1
+
+    def test_startup_grace_suppresses_boot_failures(self, tmp_path):
+        """A worker that has never published, inside the startup grace,
+        is booting — not down: its polls must not burn the liveness
+        rule's error budget on every clean cohort launch. Past the
+        grace, an invisible worker IS a failure."""
+        agg = fed.ClusterAggregator(num_workers=1, sink_dir=tmp_path,
+                                    startup_grace_s=3600.0)
+        agg.poll()
+        m = agg.metrics
+        assert m.worker_poll_failures_total.value(worker="0") == 0
+        assert m.worker_up.value(worker="0") == 0  # still reads down
+        agg._started -= 7200  # grace long expired
+        agg.poll()
+        assert m.worker_poll_failures_total.value(worker="0") == 1
+
+    def test_federated_scrape_and_collision_with_cluster_families(
+            self, tmp_path):
+        snap = _fake_snapshot(0)
+        # a worker maliciously/buggily exporting a cluster_* family must
+        # not clobber the aggregator's own (first-wins in the union)
+        snap["metrics"]["metrics"].append({
+            "name": "cluster_workers_up", "type": "gauge", "help": "",
+            "samples": [{"labels": {}, "value": 99.0}]})
+        (tmp_path / "worker_0.json").write_text(json.dumps(snap))
+        agg = fed.ClusterAggregator(num_workers=1, sink_dir=tmp_path,
+                                    liveness_window_s=60.0)
+        agg.poll()
+        text = agg.render_metrics_text()
+        assert 'train_steps_total{worker="0",generation="1"} 5' in text
+        assert re.search(r"^cluster_workers_up 1$", text, re.M), text
+        assert "cluster_workers_up 99" not in text
+
+    def test_malformed_nested_docs_sanitized_at_intake(self, tmp_path):
+        """An identity-passing snapshot with junk 'flight'/'spans' (a
+        version-skewed worker) must degrade to empty — every debug
+        surface and the dossier keep working off it."""
+        (Path(tmp_path) / "worker_0.json").write_text(json.dumps({
+            "worker": 0, "generation": 1, "time": time.time(),
+            "metrics": {"metrics": []},
+            "flight": "junk",
+            "spans": [{"nope": 1}, "junk"],
+        }))
+        agg = fed.ClusterAggregator(num_workers=1, sink_dir=tmp_path,
+                                    liveness_window_s=60.0)
+        table = agg.poll()
+        assert table["up"] == 1
+        assert agg.cluster_timeline()["count"] == 0
+        assert agg.worker_spans() == {0: []}
+        assert [e for e in agg.cluster_chrome_trace()["traceEvents"]
+                if e.get("ph") == "X"] == []  # metadata lane only
+        assert "0" in agg.dossier()["snapshots"]
+
+    def test_timeline_merges_ordered_and_stamps_workers(self, tmp_path):
+        e0 = [{"t": 100.0, "kind": "a", "data": {}},
+              {"t": 300.0, "kind": "c", "data": {}}]
+        e1 = [{"t": 200.0, "kind": "b", "worker": 1, "generation": 1,
+               "data": {}}]
+        self._write(tmp_path, 0, events=e0)
+        self._write(tmp_path, 1, events=e1)
+        agg = fed.ClusterAggregator(num_workers=2, sink_dir=tmp_path,
+                                    liveness_window_s=60.0)
+        agg.poll()
+        tl = agg.cluster_timeline()
+        assert [e["kind"] for e in tl["events"]] == ["a", "b", "c"]
+        # pre-identity events get stamped from the snapshot they rode in
+        assert [e["worker"] for e in tl["events"]] == [0, 1, 0]
+
+
+# ---------------------------------------------------------------------------
+# trace stitching
+
+
+def _span(name, *, trace, sid, parent=None, start=1.0, end=2.0,
+          thread="MainThread", **attrs):
+    return tr.Span(name, trace_id=trace, span_id=sid, parent_id=parent,
+                   start=start, end=end, thread=thread, attrs=attrs)
+
+
+class TestTraceStitching:
+    # a parent id shaped like runtime/distributed.step_root_span_id's
+    # output: 8-hex cluster prefix + 'r' marker + 8-hex step
+    ROOT = "0a1b2c3dr00000004"
+
+    def test_pid_lane_per_worker_and_lossless_roundtrip(self):
+        w0 = [_span("collective.barrier", trace="t100", sid="a0",
+                    parent=self.ROOT, start=1.0, end=1.5, step=4,
+                    worker=0)]
+        w1 = [_span("collective.barrier", trace="t100", sid="a1",
+                    parent=self.ROOT, start=1.1, end=1.4, step=4,
+                    worker=1),
+              _span("train.io", trace="t200", sid="b1", start=0.5, end=0.7)]
+        doc = fed.stitch_chrome_trace({0: w0, 1: w1})
+        x_events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        by_pid = {}
+        for e in x_events:
+            by_pid.setdefault(e["pid"], []).append(e["name"])
+        assert sorted(by_pid[1]) == ["collective.barrier"]
+        assert sorted(by_pid[2]) == ["collective.barrier", "train.io"]
+        assert by_pid[0] == ["cluster.step"]  # synthesized root lane
+        pnames = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+                  if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert pnames == {0: "cluster", 1: "worker-0", 2: "worker-1"}
+        back = tr.from_chrome_trace(doc)
+        ids = {(s.span_id, s.trace_id, s.parent_id, s.name, s.thread)
+               for s in back}
+        assert ("a0", "t100", self.ROOT, "collective.barrier",
+                "MainThread") in ids
+        assert ("a1", "t100", self.ROOT, "collective.barrier",
+                "MainThread") in ids
+        assert (self.ROOT, "t100", None, "cluster.step", "cluster") in ids
+        # per-worker grouping itself round-trips via the stamped attr
+        workers = {s.span_id: s.attrs.get("worker") for s in back}
+        assert workers["a0"] == 0 and workers["a1"] == 1
+        assert workers["b1"] == 1  # stamped during stitching
+
+    def test_synthesized_root_spans_children_and_carries_step(self):
+        rid = "0a1b2c3dr00000007"
+        spans = [_span("x", trace="t1", sid="s0", parent=rid, start=1.0,
+                       end=2.0, step=7),
+                 _span("x", trace="t1", sid="s1", parent=rid, start=0.5,
+                       end=1.5, step=7)]
+        (root,) = fed.synthesize_step_roots(spans)
+        assert root.span_id == rid and root.trace_id == "t1"
+        assert root.start == 0.5 and root.end == 2.0
+        assert root.attrs["step"] == 7 and root.attrs["synthesized"]
+
+    def test_owned_parents_not_synthesized(self):
+        spans = [_span("p", trace="t1", sid="p1"),
+                 _span("c", trace="t1", sid="c1", parent="p1")]
+        assert fed.synthesize_step_roots(spans) == []
+
+    def test_ordinary_orphans_not_fabricated_into_roots(self):
+        """A child whose parent was simply still open (or evicted from
+        the bounded tracer ring) at snapshot time is NOT a step root —
+        synthesizing one would collide with the real parent when a
+        later snapshot carries it."""
+        spans = [_span("serving.batch", trace="t1", sid="c1",
+                       parent=tr.new_id())]  # pure-hex ordinary id
+        assert fed.synthesize_step_roots(spans) == []
+
+
+# ---------------------------------------------------------------------------
+# cluster server + federated health
+
+
+class TestClusterTelemetryServer:
+    def test_endpoints_and_on_demand_freshness(self, tmp_path):
+        (tmp_path / "worker_0.json").write_text(
+            json.dumps(_fake_snapshot(0, steps=3)))
+        agg = fed.ClusterAggregator(num_workers=1, sink_dir=tmp_path,
+                                    liveness_window_s=60.0)
+        engine = slo.HealthEngine(fed.default_cluster_rules(),
+                                  registries=agg.registries(),
+                                  interval_s=3600.0)
+        with fed.ClusterTelemetryServer(agg, engine=engine,
+                                        max_staleness_s=0.0) as srv:
+            _, raw = _get(srv.url + "/cluster/metrics")
+            text = raw.decode()
+            assert 'train_steps_total{worker="0",generation="1"} 3' in text
+            assert "cluster_worker_up" in text
+            # freshness: a newer sink snapshot is visible on the next GET
+            # without anyone calling poll() (max_staleness 0 = always)
+            (tmp_path / "worker_0.json").write_text(
+                json.dumps(_fake_snapshot(0, steps=11)))
+            _, raw = _get(srv.url + "/cluster/metrics")
+            assert 'train_steps_total{worker="0",generation="1"} 11' \
+                in raw.decode()
+            _, doc = _get_json(srv.url + "/cluster/metrics?format=json")
+            assert any(m["name"] == "cluster_worker_up"
+                       for m in doc["metrics"])
+            _, table = _get_json(srv.url + "/cluster/debug/workers")
+            assert table["num_workers"] == 1 and table["up"] == 1
+            _, tl = _get_json(srv.url + "/cluster/debug/flightrecorder")
+            assert "events" in tl
+            _, ct = _get_json(srv.url + "/cluster/debug/trace")
+            assert "traceEvents" in ct
+            _, health = _get_json(srv.url + "/cluster/debug/health")
+            assert {r["name"] for r in health["rules"]} == {
+                "cluster-worker-liveness"}
+            status, _ = _get_json(srv.url + "/healthz")
+            assert status == 200
+
+    def test_health_404_without_engine(self, tmp_path):
+        agg = fed.ClusterAggregator(num_workers=1, sink_dir=tmp_path)
+        with fed.ClusterTelemetryServer(agg) as srv:
+            try:
+                urllib.request.urlopen(
+                    srv.url + "/cluster/debug/health", timeout=5)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
+
+class TestFederatedHealth:
+    def test_worker_liveness_rule_fires_on_dead_worker(self, tmp_path):
+        """Cohort-wide burn rate: one of two workers vanishing drives a
+        50% poll-failure rate — far over a 1% error budget — and the
+        liveness rule must go pending -> firing on the FEDERATED
+        registry (not any single worker's)."""
+        (tmp_path / "worker_0.json").write_text(
+            json.dumps(_fake_snapshot(0)))
+        agg = fed.ClusterAggregator(num_workers=2, sink_dir=tmp_path,
+                                    liveness_window_s=3600.0,
+                                    startup_grace_s=0.0)
+        rule = slo.SLORule(
+            name="liveness", kind="availability", objective=0.99,
+            total=slo.Selector("cluster_worker_polls_total"),
+            bad=slo.Selector("cluster_worker_poll_failures_total"),
+            windows=(slo.BurnWindow(2.0, 4.0, 1.0),), for_s=0.0,
+            resolve_hold_s=0.0)
+        engine = slo.HealthEngine([rule], registries=agg.registries(),
+                                  interval_s=1.0, clock=lambda: 0.0)
+        states = []
+        for t in range(8):
+            agg.poll()  # worker 1 never appears: 1 failure per 2 polls
+            engine.tick(now=float(t))
+            states.append(engine.states()["liveness"])
+        assert "firing" in states, states
+
+    def test_default_cluster_rules_validate_against_vocabulary(self):
+        known = slo.known_metric_names()
+        for rule in fed.default_cluster_rules():
+            for name in rule.metric_names():
+                assert name in known, name
+
+
+# ---------------------------------------------------------------------------
+# worker identity stamping
+
+
+class TestWorkerIdentityStamping:
+    def test_flight_events_carry_identity_under_supervisor(
+            self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_WORKER_ID", "2")
+        monkeypatch.setenv("DL4J_TPU_NUM_WORKERS", "4")
+        monkeypatch.setenv("DL4J_TPU_GENERATION", "3")
+        ev = fr.record_event("unit.ev", payload=1)
+        assert ev["worker"] == 2 and ev["generation"] == 3
+        assert ev["data"] == {"payload": 1}
+        dump = fr.get_flight_recorder().dump()
+        assert dump["worker_identity"] == {
+            "worker": 2, "generation": 3, "num_workers": 4}
+
+    def test_standalone_events_carry_no_identity(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_WORKER_ID", raising=False)
+        ev = fr.record_event("unit.ev")
+        assert "worker" not in ev
+        assert "worker_identity" not in fr.get_flight_recorder().dump()
+
+    def test_crash_report_filename_and_body_identity(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_WORKER_ID", "1")
+        monkeypatch.setenv("DL4J_TPU_NUM_WORKERS", "2")
+        monkeypatch.setenv("DL4J_TPU_GENERATION", "2")
+        from deeplearning4j_tpu.utils.crash import write_crash_report
+
+        path = write_crash_report(str(tmp_path),
+                                  exception=RuntimeError("boom"))
+        assert "-w1g2-" in os.path.basename(path)
+        doc = json.loads(Path(path).read_text())
+        assert doc["worker_identity"] == {
+            "worker_id": 1, "num_workers": 2, "generation": 2}
+
+
+# ---------------------------------------------------------------------------
+# coordinator-minted step trace ids (single process)
+
+
+class TestClusterStepTrace:
+    def test_establish_derive_and_collective_spans(self):
+        from deeplearning4j_tpu.runtime import distributed as dist
+
+        dist.reset_cluster_trace()
+        try:
+            tid = dist.establish_cluster_trace()
+            assert dist.establish_cluster_trace() == tid  # idempotent
+            dist.note_step(4)
+            st, rt = dist.step_trace_id(), dist.step_root_span_id()
+            assert st == f"{tid[:8]}s00000004"
+            assert rt != st and rt.endswith("00000004")
+            assert dist.step_trace_id(9) == f"{tid[:8]}s00000009"
+            # the 's'/'r' markers reserve a namespace disjoint from
+            # new_id()'s pure-hex ids: a local span tree minted on the
+            # coordinator can never collide with a step's cluster trace
+            from deeplearning4j_tpu.observability.trace import new_id
+
+            assert all(c in "0123456789abcdef" for c in new_id())
+            # every worker derives identically: pure functions of
+            # (cluster id, step) — no per-step rendezvous
+            dist.barrier("sync")
+            legs = [s for s in tr.get_tracer().spans()
+                    if s.name == "collective.barrier"]
+            assert legs and legs[-1].trace_id == st
+            assert legs[-1].parent_id == rt
+            assert legs[-1].attrs["step"] == 4
+            assert legs[-1].attrs["worker"] == 0
+        finally:
+            dist.reset_cluster_trace()
+
+    def test_no_spans_without_established_trace(self):
+        from deeplearning4j_tpu.runtime import distributed as dist
+
+        dist.reset_cluster_trace()
+        assert dist.step_trace_id() is None
+        dist.barrier("plain")
+        assert [s for s in tr.get_tracer().spans()
+                if s.name.startswith("collective.")] == []
+
+
+# ---------------------------------------------------------------------------
+# supervisor integration: live /cluster scrape + worker-kill dossier
+
+
+_SUPERVISED_WORKER = textwrap.dedent("""
+    import os, pathlib, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    wid = int(os.environ["DL4J_TPU_WORKER_ID"])
+    gen = int(os.environ["DL4J_TPU_GENERATION"])
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                              SequentialConfig)
+    from deeplearning4j_tpu.nn.layers.core import Dense
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.observability.federation import (
+        telemetry_exporter_from_env)
+    from deeplearning4j_tpu.resilience.faults import (FaultInjector,
+                                                      set_fault_injector)
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    exp = telemetry_exporter_from_env()
+    assert exp is not None, "supervisor did not arm telemetry env"
+
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(updater=Sgd(0.05), seed=1),
+        input_shape=(8,),
+        layers=[Dense(units=8, activation="tanh"),
+                OutputLayer(units=4, loss="mcxent", activation="softmax")],
+    ))
+    r = np.random.default_rng(wid)
+    x = r.normal(size=(16, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 16)]
+    data = ArrayDataSetIterator(x, y, batch_size=4, shuffle=False)
+    trainer = Trainer(model)
+    ts = trainer.fit(trainer.init_state(), data, epochs=1)
+    exp.publish()
+    print("fit done", wid, flush=True)
+
+    if gen == 1:
+        # hold the cohort live until the parent has scraped /cluster/*
+        ack = pathlib.Path(os.environ["ACK_FILE"])
+        deadline = time.monotonic() + 60
+        while not ack.exists() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if wid == 1:
+            # injected worker kill (raise mode): the fault.injected
+            # flight event lands in the final published snapshot — the
+            # dead worker's black box survives it
+            set_fault_injector(
+                FaultInjector().plan("train.worker_kill", at=1))
+            try:
+                trainer.fit(ts, data, epochs=1)
+            finally:
+                exp.publish()
+            print("FAIL: injected kill did not fire", flush=True)
+            sys.exit(3)
+        time.sleep(60)  # torn down with the cohort
+    exp.stop()
+    print("worker ok", wid, flush=True)
+""")
+
+
+def test_supervisor_live_cluster_scrape_and_worker_kill_dossier(tmp_path):
+    """THE cohort-view acceptance: a live 2-process cohort under a
+    telemetry-enabled supervisor serves per-worker-labeled series at
+    /cluster/metrics; after an injected ``train.worker_kill`` the
+    merged cluster timeline AND the dead worker's final snapshot land
+    in the crash dossier; the cohort relaunches and completes."""
+    from deeplearning4j_tpu.resilience.supervisor import ElasticSupervisor
+
+    ack = tmp_path / "scraped.ack"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ACK_FILE=str(ack))
+    env.pop("DL4J_TPU_WORKER_ID", None)
+    sup = ElasticSupervisor(
+        [sys.executable, "-c", _SUPERVISED_WORKER], num_workers=2,
+        max_restarts=1, workdir=tmp_path / "run", env=env,
+        backoff_base_s=0.05, backoff_max_s=0.2, grace_s=5.0,
+        telemetry=True, telemetry_poll_interval_s=0.25,
+        cluster_server_port=0)
+    box = {}
+
+    def _run():
+        try:
+            box["result"] = sup.run()
+        except Exception as e:  # noqa: BLE001 — surfaced by the asserts
+            box["error"] = e
+
+    th = threading.Thread(target=_run, daemon=True)
+    th.start()
+    try:
+        deadline = time.monotonic() + 60
+        while sup.cluster_url is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup.cluster_url is not None, "cluster server never started"
+        # live scrape: both workers' series, worker-labeled, one document
+        text = ""
+        while time.monotonic() < deadline:
+            try:
+                _, raw = _get(sup.cluster_url + "/cluster/metrics")
+                text = raw.decode()
+                # wait for the POST-fit value (a live scrape legally
+                # sees 1..3 mid-fit — that's the feature, not a bug)
+                if ('train_steps_total{worker="0",generation="1"} 4'
+                        in text
+                        and 'train_steps_total{worker="1",generation="1"} 4'
+                        in text):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert 'train_steps_total{worker="0",generation="1"} 4' in text
+        assert 'train_steps_total{worker="1",generation="1"} 4' in text
+        assert "cluster_worker_up" in text
+        _, table = _get_json(sup.cluster_url + "/cluster/debug/workers")
+        assert table["num_workers"] == 2
+        _, health = _get_json(sup.cluster_url + "/cluster/debug/health")
+        assert any(r["name"] == "cluster-worker-liveness"
+                   for r in health["rules"])
+        ack.write_text("go")  # release the cohort into the chaos leg
+        th.join(timeout=120)
+        assert not th.is_alive(), "supervisor run did not finish"
+    finally:
+        ack.write_text("go")
+        sup.stop()
+        th.join(timeout=30)
+    assert "error" not in box, box.get("error")
+    res = box["result"]
+    assert res.generations == 2 and res.restarts == 1
+    # worker 1 failed generation 1 (injected kill -> nonzero exit)
+    assert any(e.generation == 1 and e.worker_id == 1
+               and e.returncode not in (0, None) for e in res.exits)
+
+    crashes = sorted((tmp_path / "run").glob("dl4j-tpu-crash-*.json"))
+    assert crashes, list((tmp_path / "run").iterdir())
+    dossier = None
+    for p in crashes:
+        doc = json.loads(p.read_text())
+        if "cluster_dossier" in doc.get("extra", {}):
+            dossier = doc["extra"]["cluster_dossier"]
+            failure = doc["extra"]["supervisor_failure"]
+    assert dossier is not None
+    assert "worker 1" in failure
+    # the dead worker's FINAL snapshot is in the dossier, carrying the
+    # injected-fault event in its flight ring
+    assert set(dossier["snapshots"]) == {"0", "1"}
+    w1_events = dossier["snapshots"]["1"]["flight"]["events"]
+    assert any(e["kind"] == "fault.injected"
+               and e["data"]["point"] == "train.worker_kill"
+               for e in w1_events)
+    # the merged timeline attributes events to workers without guessing
+    tl_events = dossier["timeline"]["events"]
+    assert {e.get("worker") for e in tl_events
+            if e["kind"] == "train.epoch"} == {0, 1}
+    kill = [e for e in tl_events if e["kind"] == "fault.injected"]
+    assert kill and kill[-1]["worker"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 2-process gloo cohort: federated scrape + stitched trace
+
+
+_GLOO_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    wid = int(os.environ["DL4J_TPU_WORKER_ID"])
+    port = os.environ["COORD_PORT"]
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                              SequentialConfig)
+    from deeplearning4j_tpu.nn.layers.core import Dense
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.observability.federation import (
+        telemetry_exporter_from_env)
+    from deeplearning4j_tpu.runtime import distributed
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    exp = telemetry_exporter_from_env()
+    assert exp is not None
+    distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                           process_id=wid)
+    # correlation id minted at the coordinator, received over the
+    # guarded host broadcast: every worker's per-step collective legs
+    # now derive the SAME trace ids
+    tid = distributed.establish_cluster_trace()
+    print("cluster_trace", tid, flush=True)
+
+    model = SequentialModel(SequentialConfig(
+        net=NeuralNetConfiguration(updater=Sgd(0.05), seed=7),
+        input_shape=(8,),
+        layers=[Dense(units=8, activation="tanh"),
+                OutputLayer(units=4, loss="mcxent", activation="softmax")],
+    ))
+    r = np.random.default_rng(11)
+    x = r.normal(size=(16, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 16)]
+    data = ArrayDataSetIterator(x, y, batch_size=4, shuffle=False)
+
+    class EpochBarrier:
+        def on_fit_start(self, t, s): pass
+        def on_epoch_start(self, e): pass
+        def on_iteration(self, e, step, s, m): return False
+        def on_epoch_end(self, e, s):
+            distributed.checkpoint_sync(f"epoch{e}")
+            return False
+        def on_fit_end(self, t, s): pass
+
+    trainer = Trainer(model)
+    trainer.fit(trainer.init_state(), data, epochs=2,
+                listeners=[EpochBarrier()])
+    distributed.barrier("done")
+    exp.publish()
+    exp.stop()
+    print("worker ok", wid, flush=True)
+""")
+
+
+def test_two_process_gloo_federated_scrape_and_stitched_trace(tmp_path):
+    """THE federation acceptance over a REAL 2-process gloo cohort:
+    (1) one federated scrape carries both workers'
+    ``train_steps_total{worker=...}`` series; (2) the stitched Chrome
+    trace round-trips losslessly with one pid lane per worker and a
+    shared coordinator-minted trace id across the step's collective
+    legs from BOTH workers."""
+    sink = tmp_path / "telemetry"
+    sink.mkdir()
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", COORD_PORT=str(port))
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+    env["DL4J_TPU_TELEMETRY_DIR"] = str(sink)
+    env["DL4J_TPU_NUM_WORKERS"] = "2"
+    env["DL4J_TPU_GENERATION"] = "1"
+    env["DL4J_TPU_COLLECTIVE_TIMEOUT_S"] = "60"
+    procs = []
+    for wid in range(2):
+        wenv = dict(env, DL4J_TPU_WORKER_ID=str(wid))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _GLOO_WORKER], env=wenv,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed handshake timed out in this environment")
+    if any("UNAVAILABLE" in o or "DEADLINE" in o for o in outs):
+        pytest.skip(f"coordination service unavailable: {outs[0][-500:]}")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"worker ok {i}" in out
+    # both workers received the SAME coordinator-minted cluster trace id
+    tids = {re.search(r"cluster_trace (\w+)", o).group(1) for o in outs}
+    assert len(tids) == 1
+    (cluster_tid,) = tids
+
+    agg = fed.ClusterAggregator(num_workers=2, sink_dir=sink,
+                                liveness_window_s=3600.0)
+    agg.poll()
+
+    # (1) the federated scrape: per-worker-labeled series from BOTH
+    text = agg.render_metrics_text()
+    assert 'train_steps_total{worker="0",generation="1"} 8' in text
+    assert 'train_steps_total{worker="1",generation="1"} 8' in text
+    assert re.search(r'^cluster_workers_up 2$', text, re.M), text
+
+    # (2) stitched trace: one pid lane per worker; the epoch-0
+    # checkpoint sync (step 4) legs share one derived trace id and one
+    # synthesized root across both workers; lossless round trip
+    doc = agg.cluster_chrome_trace()
+    back = tr.from_chrome_trace(doc)
+    legs = [s for s in back if s.name == "collective.barrier"
+            and s.attrs.get("step") == 4]
+    leg_workers = {s.attrs["worker"] for s in legs}
+    assert leg_workers == {0, 1}, legs
+    assert {s.trace_id for s in legs} == {f"{cluster_tid[:8]}s00000004"}
+    assert len({s.parent_id for s in legs}) == 1
+    roots = [s for s in back if s.name == "cluster.step"
+             and s.span_id == legs[0].parent_id]
+    assert len(roots) == 1 and roots[0].attrs.get("step") == 4
+    # pid lanes: worker spans on pid 1/2, synthesized roots on pid 0
+    x_pids = {e["pid"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert x_pids == {0, 1, 2}, x_pids
+    # losslessness: every span the workers exported survives the round
+    # trip with identity, linkage, and attrs intact
+    exported = {s.span_id: s for spans in agg.worker_spans().values()
+                for s in spans}
+    returned = {s.span_id: s for s in back if not
+                s.attrs.get("synthesized")}
+    assert set(returned) == set(exported)
+    for sid, orig in exported.items():
+        got = returned[sid]
+        assert (got.name, got.trace_id, got.parent_id, got.thread) == \
+            (orig.name, orig.trace_id, orig.parent_id, orig.thread)
+        for k, v in orig.attrs.items():
+            assert got.attrs[k] == v, (sid, k)
+        assert abs(got.start - orig.start) < 1e-4
+        assert abs(got.end - orig.end) < 1e-4

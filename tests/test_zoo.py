@@ -25,6 +25,11 @@ def _one_hot(rng, n, k):
         ("resnet50", dict(num_classes=10, input_shape=(32, 32, 3)), (2, 32, 32, 3), 10),
         ("squeezenet", dict(num_classes=7, input_shape=(64, 64, 3)), (2, 64, 64, 3), 7),
         ("xception", dict(num_classes=5, input_shape=(71, 71, 3)), (2, 71, 71, 3), 5),
+        # tier-1 proxy for the slow-marked nasnet convergence run
+        # (test_zoo_convergence): the full cell-stack graph stays wired
+        ("nasnet", dict(num_classes=5, input_shape=(32, 32, 3),
+                        penultimate_filters=48, cells_per_stack=1,
+                        dropout=0.0), (2, 32, 32, 3), 5),
     ],
 )
 def test_graph_zoo_forward_shapes(name, kw, in_shape, n_out):
