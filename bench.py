@@ -1324,6 +1324,179 @@ def bench_robustness(peak, *, steps=96, batch_size=128, hidden=1024,
         shutil.rmtree(tmp_root, ignore_errors=True)
 
 
+_ELASTIC_BENCH_WORKER = """
+import json, os, pathlib, sys, time
+slot = os.environ["DL4J_TPU_SLOT_ID"]
+wid = os.environ["DL4J_TPU_WORKER_ID"]
+gen = os.environ["DL4J_TPU_GENERATION"]
+run = pathlib.Path(os.environ["RUN_DIR"])
+if slot == "1" and not (run / "heal").exists():
+    sys.exit(7)  # the dead slot crash-loops until healed
+ckpt = pathlib.Path(os.environ["CKPT_DIR"])
+ckpt.mkdir(parents=True, exist_ok=True)
+steps = run / ("steps_g%s_w%s.jsonl" % (gen, wid))
+with steps.open("a") as fh:
+    for i in range(4000):
+        if (run / "stop").exists():
+            break
+        fh.write(json.dumps({"t": time.time(), "step": i}) + "\\n")
+        fh.flush()
+        if wid == "0" and i % 5 == 4:
+            # epoch-boundary save: the rotation-index write is what the
+            # supervisor's expansion boundary watch keys on
+            (ckpt / "checkpoint_index.json").write_text(
+                json.dumps({"step": i}))
+        time.sleep(0.02)
+"""
+
+
+def bench_elastic(peak, *, rounds=3, step_s=0.02,
+                  mttr_gate_s=5.0, disruption_gate_s=5.0):
+    """Elastic degraded-mode benchmark (resilience/supervisor shrink /
+    probe / expand): what a permanently dead slot costs the cohort.
+
+    - **Shrink MTTR** (kill -> first post-shrink step): wall time from
+      the supervisor *detecting* the dead slot's final fatal exit to
+      the shrunken cohort's first step — classification + teardown +
+      env re-derivation + relaunch. Workers here are process-light
+      (no jax import, a ``step_s`` sleep per step), so this prices the
+      SUPERVISOR plane itself; a real cohort adds its own bootstrap +
+      checkpoint-restore time on top.
+    - **Expand disruption** (pause at the checkpoint boundary): wall
+      time between the degraded cohort's last step and the re-expanded
+      full cohort's first step — the planned-teardown window the
+      boundary wait is designed to bound.
+
+    Both are medians over ``rounds``; ``peak`` (chip FLOPs) is unused —
+    host-side process-control latency.
+    """
+    import shutil
+    import tempfile
+    import threading
+    from statistics import median as _median
+
+    from deeplearning4j_tpu.observability.flightrecorder import (
+        get_flight_recorder,
+    )
+    from deeplearning4j_tpu.resilience.supervisor import ElasticSupervisor
+
+    def _steps(run_dir, gen):
+        out = []
+        for p in run_dir.glob(f"steps_g{gen}_w*.jsonl"):
+            for line in p.read_text().splitlines():
+                try:
+                    out.append(json.loads(line)["t"])
+                except (ValueError, KeyError):
+                    pass
+        return sorted(out)
+
+    import pathlib
+
+    tmp_root = pathlib.Path(tempfile.mkdtemp(prefix="bench_elastic_"))
+    mttrs, disruptions = [], []
+    try:
+        for rnd in range(rounds):
+            run_dir = tmp_root / f"round{rnd}"
+            run_dir.mkdir(parents=True)
+            ckpt = run_dir / "ckpt"
+            env = dict(os.environ, RUN_DIR=str(run_dir),
+                       CKPT_DIR=str(ckpt))
+            for k in ("DL4J_TPU_WORKER_ID", "DL4J_TPU_NUM_WORKERS",
+                      "DL4J_TPU_GENERATION", "DL4J_TPU_SLOT_ID",
+                      "DL4J_TPU_FAULTS"):
+                env.pop(k, None)
+            t0 = time.time()
+            sup = ElasticSupervisor(
+                [sys.executable, "-c", _ELASTIC_BENCH_WORKER],
+                num_workers=2, max_restarts=4, workdir=run_dir, env=env,
+                backoff_base_s=0.02, backoff_max_s=0.05, grace_s=5.0,
+                min_workers=1, dead_slot_threshold=2,
+                immediate_exit_s=5.0, checkpoint_dir=ckpt,
+                probe_interval_s=0.05, probe_max_interval_s=0.2,
+                slot_healthy=lambda s: (run_dir / "heal").exists())
+            box = {}
+
+            def _run():
+                try:
+                    box["result"] = sup.run()
+                except Exception as e:  # noqa: BLE001 — recorded below
+                    box["error"] = e
+
+            th = threading.Thread(target=_run, daemon=True)
+            th.start()
+
+            def _wait(cond, timeout):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if cond():
+                        return True
+                    time.sleep(0.005)
+                return cond()
+
+            try:
+                if not _wait(lambda: sup.shrinks >= 1, 30):
+                    raise RuntimeError(
+                        f"never shrank: {box.get('error')}")
+                (run_dir / "heal").write_text("ok")
+                if not _wait(lambda: sup.expands >= 1, 30):
+                    raise RuntimeError(
+                        f"never expanded: {box.get('error')}")
+                # a few full-strength steps, then wind the run down
+                time.sleep(0.5)
+                (run_dir / "stop").write_text("ok")
+                th.join(timeout=30)
+            finally:
+                (run_dir / "heal").write_text("ok")
+                (run_dir / "stop").write_text("ok")
+                sup.stop()
+                th.join(timeout=10)
+            if "error" in box:
+                raise box["error"]
+
+            evs = [e for e in get_flight_recorder().events()
+                   if e["t"] >= t0]
+            shrunk_gen = next(e["data"]["generation"] for e in evs
+                              if e["kind"] == "supervisor.shrink")
+            expand_gen = next(e["data"]["generation"] for e in evs
+                              if e["kind"] == "supervisor.expand") + 1
+            # kill -> first post-shrink step: detection of the dead
+            # slot's FINAL fatal exit vs the shrunken gen's first step
+            t_kill = max(e["t"] for e in evs
+                         if e["kind"] == "supervisor.worker_exit"
+                         and e["data"].get("slot") == 1)
+            shrunk_steps = _steps(run_dir, shrunk_gen + 1)
+            expand_steps = _steps(run_dir, expand_gen)
+            if not shrunk_steps or not expand_steps:
+                raise RuntimeError("worker step telemetry missing")
+            mttrs.append(shrunk_steps[0] - t_kill)
+            disruptions.append(expand_steps[0] - shrunk_steps[-1])
+        mttr_s = _median(mttrs)
+        disruption_s = _median(disruptions)
+        info = {
+            "rounds": rounds,
+            "worker_step_ms": round(step_s * 1e3, 1),
+            "shrink_mttr_ms": round(mttr_s * 1e3, 2),
+            "expand_disruption_ms": round(disruption_s * 1e3, 2),
+            "shrink_mttr_ms_all": [round(v * 1e3, 2) for v in mttrs],
+            "expand_disruption_ms_all": [round(v * 1e3, 2)
+                                         for v in disruptions],
+            # integrity gates: every round shrank AND re-expanded, and
+            # both transitions stay inside their latency budgets
+            "gate_mttr_ok": bool(mttr_s < mttr_gate_s),
+            "gate_disruption_ok": bool(disruption_s < disruption_gate_s),
+            "converged": bool(len(mttrs) == rounds
+                              and mttr_s < mttr_gate_s
+                              and disruption_s < disruption_gate_s),
+            "note": ("process-light workers: prices the supervisor "
+                     "plane; real cohorts add bootstrap+restore"),
+            "unit": "ms shrink MTTR (kill -> first post-shrink step)",
+        }
+        info["value"] = info["shrink_mttr_ms"]
+        return info
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
+
 def bench_federation(peak, *, steps=96, batch_size=128, hidden=1024,
                      rounds=10, poll_interval_s=0.02,
                      production_poll_interval_s=1.0):
@@ -1568,6 +1741,10 @@ _CONFIGS = {
     # Cluster telemetry federation (observability/federation): exporter +
     # aggregator polling cost on a live training worker, gated < 2%/step.
     "federation": bench_federation,
+    # Elastic degraded mode (resilience/supervisor shrink/probe/expand):
+    # shrink MTTR (kill -> first post-shrink step) and expand disruption
+    # (pause at the checkpoint boundary), both gated < 5 s.
+    "elastic": bench_elastic,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -1599,6 +1776,9 @@ _CPU_INTEGRITY = {
     # federation reports "converged" = exporter + aggregator polling a
     # 2-worker cohort costs the instrumented fit step < 2%
     "federation": dict(steps=96, batch_size=128, hidden=1024, rounds=10),
+    # elastic reports "converged" = every round shrank AND re-expanded
+    # with shrink MTTR and expand disruption inside their gates
+    "elastic": dict(rounds=2),
 }
 
 
@@ -1657,7 +1837,7 @@ def main():
     ap.add_argument("--configs",
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
                             "serving,resilience,observability,robustness,"
-                            "federation",
+                            "federation,elastic",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

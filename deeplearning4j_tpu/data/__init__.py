@@ -12,7 +12,10 @@ from deeplearning4j_tpu.data.iterators import (
     ArrayDataSetIterator,
     AsyncDataSetIterator,
     ShardedDataSetIterator,
+    ShrinkPolicy,
     TransformIterator,
+    derive_shard,
+    maybe_auto_prefetch,
 )
 # transient-IO retry wrapper (lives in resilience/, re-exported here so
 # data pipelines compose it like any other iterator wrapper)

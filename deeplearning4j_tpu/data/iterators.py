@@ -9,6 +9,7 @@ sharding (H2D happens while the previous step runs — double buffering).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Callable, Iterable, Iterator, Optional
@@ -18,6 +19,95 @@ import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, as_batch_dict
 from deeplearning4j_tpu.resilience.faults import get_fault_injector
+
+# Degraded-mode env plumbing: the elastic supervisor
+# (resilience/supervisor.py — same literals there; that module must stay
+# importable without jax, this one without it) arms these per generation
+# so a relaunched worker re-derives its shard from the NEW
+# (worker_id, num_workers) under an explicit policy.
+ENV_SHRINK_POLICY = "DL4J_TPU_SHRINK_POLICY"
+ENV_BASELINE_NUM_WORKERS = "DL4J_TPU_BASELINE_NUM_WORKERS"
+# Starvation remediation (train/trainer.py `_StepTelemetry` detects,
+# this wraps): opt-in background prefetch of the training iterator.
+ENV_AUTO_PREFETCH = "DL4J_TPU_AUTO_PREFETCH"
+ENV_PREFETCH_DEPTH = "DL4J_TPU_PREFETCH_DEPTH"
+
+
+class ShrinkPolicy:
+    """How a shrunken cohort (N baseline workers, n < N survivors)
+    re-divides the global batch — the explicit choice degraded-mode
+    training forces:
+
+    - ``PRESERVE_GLOBAL_BATCH``: the global batch stays whole; each
+      survivor's share grows to ``rows / n``. Optimization dynamics are
+      unchanged (same batches, same gradient), per-worker memory and
+      step time grow — the default, matching the topology-independent
+      checkpoint restore's bitwise-continuity story.
+    - ``PRESERVE_PER_WORKER_BATCH``: each survivor keeps its baseline
+      share ``rows / N``; the dead slots' rows are dropped, so the
+      effective global batch shrinks to ``n * rows / N``. Per-worker
+      cost is unchanged, throughput (and the gradient's batch size)
+      degrades — for cohorts already at the per-chip memory ceiling.
+    """
+
+    PRESERVE_GLOBAL_BATCH = "preserve_global_batch"
+    PRESERVE_PER_WORKER_BATCH = "preserve_per_worker_batch"
+    ALL = (PRESERVE_GLOBAL_BATCH, PRESERVE_PER_WORKER_BATCH)
+
+    @staticmethod
+    def from_env(default: str = PRESERVE_GLOBAL_BATCH) -> str:
+        """The supervisor-armed policy (``DL4J_TPU_SHRINK_POLICY``),
+        degrading to ``default`` on junk/absent env — a typo'd policy
+        must not crash a relaunching cohort."""
+        val = os.environ.get(ENV_SHRINK_POLICY, "").strip().lower()
+        return val if val in ShrinkPolicy.ALL else default
+
+
+def baseline_num_workers_from_env() -> Optional[int]:
+    """The cohort's FULL size (``DL4J_TPU_BASELINE_NUM_WORKERS``, armed
+    by the supervisor) — what ``PRESERVE_PER_WORKER_BATCH`` divides by;
+    None when not running under a supervisor."""
+    raw = os.environ.get(ENV_BASELINE_NUM_WORKERS)
+    try:
+        n = int(raw) if raw else 0
+    except ValueError:
+        return None
+    return n if n >= 1 else None
+
+
+def derive_shard(n_rows: int, worker_id: int, num_workers: int, *,
+                 baseline_num_workers: Optional[int] = None,
+                 policy: Optional[str] = None) -> slice:
+    """This worker's row block of a global batch, re-derived from the
+    CURRENT ``(worker_id, num_workers)`` — the pure function both
+    :class:`ShardedDataSetIterator` and custom readers use, so a cohort
+    relaunched at a different size agrees on the division without any
+    cross-worker negotiation.
+
+    ``PRESERVE_GLOBAL_BATCH`` divides ``n_rows`` by ``num_workers``
+    (shares grow on a shrunken cohort); ``PRESERVE_PER_WORKER_BATCH``
+    divides by ``baseline_num_workers`` (shares stay put; the trailing
+    dead slots' rows fall out of the batch)."""
+    policy = ShrinkPolicy.from_env() if policy is None else policy
+    if policy not in ShrinkPolicy.ALL:
+        raise ValueError(f"unknown shrink policy {policy!r}; expected one "
+                         f"of {ShrinkPolicy.ALL}")
+    if not 0 <= worker_id < num_workers:
+        raise ValueError(f"worker_id {worker_id} out of range for "
+                         f"num_workers={num_workers}")
+    divisor = num_workers
+    if policy == ShrinkPolicy.PRESERVE_PER_WORKER_BATCH:
+        divisor = baseline_num_workers or num_workers
+        if divisor < num_workers:
+            raise ValueError(
+                f"baseline_num_workers={divisor} smaller than the live "
+                f"cohort ({num_workers}) — the baseline is the FULL size")
+    per, rem = divmod(n_rows, divisor)
+    if rem:
+        raise ValueError(
+            f"global batch {n_rows} not divisible by {divisor} "
+            f"({'baseline ' if divisor != num_workers else ''}workers)")
+    return slice(worker_id * per, (worker_id + 1) * per)
 
 
 class ArrayDataSetIterator:
@@ -143,8 +233,50 @@ class AsyncDataSetIterator:
         if hasattr(self.base, "reset"):
             self.base.reset()
 
+    def set_epoch(self, epoch: int):
+        """Epoch-pinning pass-through (the recovery layer's shuffle
+        realignment protocol — see ``ArrayDataSetIterator.set_epoch``)."""
+        if hasattr(self.base, "set_epoch"):
+            self.base.set_epoch(epoch)
+
+    @property
+    def epoch(self):
+        return getattr(self.base, "epoch", 0)
+
     def __len__(self):
         return len(self.base)  # type: ignore[arg-type]
+
+
+def maybe_auto_prefetch(data, *, device_put_to=None):
+    """Wrap ``data`` in :class:`AsyncDataSetIterator` when the operator
+    armed ``DL4J_TPU_AUTO_PREFETCH=1`` — the minimal remediation for a
+    firing ``train_data_starved`` detector (the reads that dominated the
+    step now overlap it from a background thread). Opt-in because a
+    prefetch thread changes teardown/ordering semantics for exotic
+    iterators; already-wrapped iterators pass through untouched.
+    ``DL4J_TPU_PREFETCH_DEPTH`` sizes the ring (default 2 — double
+    buffering)."""
+    if os.environ.get(ENV_AUTO_PREFETCH, "").strip().lower() \
+            not in ("1", "true", "yes"):
+        return data
+    if isinstance(data, AsyncDataSetIterator):
+        return data
+    try:
+        depth = int(os.environ.get(ENV_PREFETCH_DEPTH) or 2)
+    except ValueError:
+        depth = 2
+    depth = max(1, depth)
+    try:
+        from deeplearning4j_tpu.observability.flightrecorder import (
+            record_event,
+        )
+
+        record_event("data.auto_prefetch", depth=depth,
+                     base=type(data).__name__)
+    except Exception:  # noqa: BLE001 — telemetry never fails the wrap
+        pass
+    return AsyncDataSetIterator(data, prefetch=depth,
+                                device_put_to=device_put_to)
 
 
 class TransformIterator:
@@ -187,13 +319,35 @@ class ShardedDataSetIterator:
     degenerates to a plain sharded device_put in single-process jobs — the
     same iterator runs unchanged on 1 chip, an 8-device CPU mesh, or a
     multi-host slice. Wrap with AsyncDataSetIterator for prefetch overlap.
+
+    **Elastic degraded mode**: the shard is re-derived from the LIVE
+    ``(process_index, process_count)`` on every construction, so a
+    cohort relaunched at N-k after a shrink (resilience/supervisor.py)
+    re-divides the same global stream with no code change. The division
+    rule is an explicit :class:`ShrinkPolicy` — ``shrink_policy`` /
+    ``baseline_num_workers`` default to the supervisor-armed env
+    (``DL4J_TPU_SHRINK_POLICY`` / ``DL4J_TPU_BASELINE_NUM_WORKERS``),
+    preserving the global batch unless told otherwise. ``local=True``
+    mode is unaffected (each host already reads only its own rows — a
+    shrunken cohort there simply reads fewer hosts' files).
     """
 
-    def __init__(self, base: Iterable, mesh, spec, *, local: bool = False):
+    def __init__(self, base: Iterable, mesh, spec, *, local: bool = False,
+                 shrink_policy: Optional[str] = None,
+                 baseline_num_workers: Optional[int] = None):
         self.base = base
         self.mesh = mesh
         self.spec = spec
         self.local = local
+        self.shrink_policy = (ShrinkPolicy.from_env()
+                              if shrink_policy is None else shrink_policy)
+        if self.shrink_policy not in ShrinkPolicy.ALL:
+            raise ValueError(
+                f"unknown shrink policy {self.shrink_policy!r}; expected "
+                f"one of {ShrinkPolicy.ALL}")
+        self.baseline_num_workers = (
+            baseline_num_workers_from_env()
+            if baseline_num_workers is None else baseline_num_workers)
         if jax.process_count() > 1:
             # Row blocks are assigned in process order; the assembly places
             # each process's rows at its devices' mesh positions. A mesh
@@ -209,16 +363,19 @@ class ShardedDataSetIterator:
                     "process-grouped order) for per-host input sharding")
 
     def _proc_slice(self, arr):
-        n = jax.process_count()
-        if n == 1 or self.local:
+        if self.local:
             return arr
-        per = arr.shape[0] // n
-        if per * n != arr.shape[0]:
-            raise ValueError(
-                f"global batch {arr.shape[0]} not divisible by "
-                f"{n} processes")
-        pid = jax.process_index()
-        return arr[pid * per:(pid + 1) * per]
+        n = jax.process_count()
+        baseline = self.baseline_num_workers or n
+        if n == 1 and baseline == 1:
+            return arr
+        # the policy-aware division: under PRESERVE_PER_WORKER_BATCH a
+        # shrunken cohort (baseline > n) keeps baseline-sized shares and
+        # drops the dead slots' rows; PRESERVE_GLOBAL_BATCH grows each
+        # survivor's share so the batch (and the gradient) is unchanged
+        return arr[derive_shard(arr.shape[0], jax.process_index(), n,
+                                baseline_num_workers=baseline,
+                                policy=self.shrink_policy)]
 
     def __iter__(self):
         from deeplearning4j_tpu.runtime.distributed import (

@@ -587,6 +587,32 @@ class ClusterMetrics:
         self.restarts_total = r.gauge(
             "restarts_total", "Cohort relaunches observed by the "
             "supervisor driving this aggregator.", namespace=ns)
+        # -- degraded-mode topology (elastic shrink-to-survivors) ------------
+        self.workers_active = r.gauge(
+            "workers_active", "Workers the CURRENT topology runs — equal "
+            "to cluster_workers_expected at full strength, smaller while "
+            "the cohort is shrunken onto its survivors.", namespace=ns)
+        self.degraded = r.gauge(
+            "degraded", "1 while the cohort runs degraded (one or more "
+            "slots classified permanently dead and excluded), else 0.",
+            namespace=ns)
+        self.polls_total = r.counter(
+            "polls_total", "Aggregation passes over the cohort (the "
+            "time-in-degraded-mode burn-rate rule's total).",
+            namespace=ns)
+        self.degraded_ticks_total = r.counter(
+            "degraded_ticks_total", "Aggregation passes that found the "
+            "cohort degraded — degraded_ticks/polls is the fraction of "
+            "time spent below full strength (the degraded-mode "
+            "burn-rate rule's bad events).", namespace=ns)
+        self.shrinks_total = r.counter(
+            "shrinks_total", "Topology shrinks committed by the "
+            "supervisor (dead slot excluded, cohort relaunched on the "
+            "survivors).", namespace="supervisor")
+        self.expands_total = r.counter(
+            "expands_total", "Re-expansions committed by the supervisor "
+            "(dead slots probed healthy, cohort relaunched at full "
+            "strength at a checkpoint boundary).", namespace="supervisor")
         self.worker_polls_total = r.counter(
             "worker_polls_total", "Snapshot poll attempts per worker "
             "(the worker-liveness SLO rule's total).", ("worker",),
@@ -660,6 +686,8 @@ class ClusterAggregator:
                  liveness_window_s: float = 10.0,
                  startup_grace_s: float = 10.0,
                  restarts: Optional[Callable[[], int]] = None,
+                 topology: Optional[Callable[[], dict]] = None,
+                 local_events: Optional[Callable[[], List[dict]]] = None,
                  registry: Optional[MetricsRegistry] = None):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -672,6 +700,16 @@ class ClusterAggregator:
         self.liveness_window_s = liveness_window_s
         self.startup_grace_s = startup_grace_s
         self._restarts = restarts
+        # optional cohort-shape provider (the elastic supervisor wires
+        # its degraded-mode view: workers_active / degraded / dead
+        # slots) — feeds the cluster_workers_active/cluster_degraded
+        # gauges and the time-in-degraded-mode counter every poll
+        self._topology = topology
+        # optional provider of the AGGREGATOR-side process's own flight
+        # events (the supervisor passes its supervisor.* ring) so the
+        # merged cluster timeline shows launches/shrinks/expands next to
+        # the worker events they caused — stamped worker="supervisor"
+        self._local_events = local_events
         self._started = time.monotonic()
         self.metrics = ClusterMetrics(registry)
         self.federated = FederatedRegistry(self)
@@ -690,7 +728,38 @@ class ClusterAggregator:
     # -- reconfiguration (a new generation moves the port base) --------------
 
     def set_port_base(self, port_base: Optional[int]) -> None:
+        """Same-size regeneration; delegates to :meth:`set_cohort` so
+        a caller reaching for the narrower API can never desync the
+        polled worker-id range from the base."""
+        self.set_cohort(self.num_workers, port_base=port_base)
+
+    def set_cohort(self, num_workers: int,
+                   port_base: Optional[int] = None) -> None:
+        """Re-derive the polled cohort for a new generation: worker-id
+        range AND port base together (a shrink/expand compacts ids and
+        moves the base — polling a dead slot's stale reservation would
+        count phantom liveness failures forever). Per-worker gauges of
+        slots beyond the new range are pruned — their *snapshots* are
+        kept (the dossier's last-known state); counters are never
+        pruned (a monotonic family must not step backwards mid-window
+        under the SLO engine's deltas)."""
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        old = self.num_workers
+        self.num_workers = num_workers
         self.port_base = port_base
+        m = self.metrics
+        m.workers_expected.set(float(num_workers))
+        for wid in range(num_workers, old):
+            w = str(wid)
+            for gauge in (m.worker_up, m.worker_generation,
+                          m.worker_last_step, m.worker_step_lag,
+                          m.worker_heartbeat_age_seconds,
+                          m.worker_snapshot_age_seconds):
+                try:
+                    gauge.remove(worker=w)
+                except ValueError:
+                    pass
 
     # -- polling -------------------------------------------------------------
 
@@ -839,6 +908,26 @@ class ClusterAggregator:
                 m.restarts_total.set(float(self._restarts()))
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+        m.polls_total.inc()
+        if self._topology is not None:
+            try:
+                topo = self._topology()
+                m.workers_active.set(
+                    float(topo.get("workers_active", self.num_workers)))
+                degraded = bool(topo.get("degraded"))
+                m.degraded.set(1.0 if degraded else 0.0)
+                if degraded:
+                    # time-in-degraded-mode accumulator: one tick per
+                    # poll, so degraded_ticks/polls IS the degraded
+                    # fraction the burn-rate rule evaluates
+                    m.degraded_ticks_total.inc()
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
+        else:
+            # no supervisor-provided shape: the polled range IS the
+            # topology (plain aggregators are never degraded)
+            m.workers_active.set(float(self.num_workers))
+            m.degraded.set(0.0)
         if self.heartbeat_dir is not None:
             from deeplearning4j_tpu.resilience.cluster import (
                 read_heartbeats,
@@ -947,6 +1036,15 @@ class ClusterAggregator:
                     ev = dict(ev, worker=wid,
                               generation=snap.get("generation", 1))
                 events.append(ev)
+        if self._local_events is not None:
+            try:
+                for ev in self._local_events():
+                    if isinstance(ev, dict):
+                        if "worker" not in ev:
+                            ev = dict(ev, worker="supervisor")
+                        events.append(ev)
+            except Exception:  # noqa: BLE001 — the merged view degrades
+                pass           # to workers-only, never fails
         if last_seconds is not None:
             cutoff = time.time() - last_seconds
             events = [e for e in events if e.get("t", 0.0) >= cutoff]

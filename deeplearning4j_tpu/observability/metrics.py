@@ -109,6 +109,14 @@ class _Instrument:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
 
+    def remove(self, **labels) -> bool:
+        """Drop one label series from the family (e.g. the federation
+        layer pruning a departed worker's gauges when the cohort
+        shrinks); returns True when the series existed."""
+        key = self._key(labels)
+        with self._lock:
+            return self._data.pop(key, None) is not None
+
 
 class Counter(_Instrument):
     kind = "counter"

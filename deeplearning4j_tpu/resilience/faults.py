@@ -33,6 +33,11 @@ Named injection points wired through the codebase:
 ``train.worker_kill``       raises (or with ``!kill`` SIGKILLs the process)
                             at the top of the N-th training step — the
                             elastic supervisor's relaunch/resume trigger
+``supervisor.slot_dead``    fires in the SUPERVISOR process while it
+                            classifies a cohort failure: the failing slot is
+                            ruled permanently dead, driving the
+                            shrink-to-survivors path without a real crash
+                            loop (``at=N`` = the N-th cohort failure)
 ==========================  =====================================================
 
 Plans are deterministic: ``at=N`` fires on the N-th trigger of the point
@@ -71,6 +76,7 @@ POINT_SERVING_ERROR = "serving.error"
 POINT_COLLECTIVE_STALL = "collective.stall"
 POINT_SERVING_WORKER_CRASH = "serving.worker_crash"
 POINT_TRAIN_WORKER_KILL = "train.worker_kill"
+POINT_SUPERVISOR_SLOT_DEAD = "supervisor.slot_dead"
 
 KNOWN_POINTS = (
     POINT_DATA_READ,
@@ -82,6 +88,7 @@ KNOWN_POINTS = (
     POINT_COLLECTIVE_STALL,
     POINT_SERVING_WORKER_CRASH,
     POINT_TRAIN_WORKER_KILL,
+    POINT_SUPERVISOR_SLOT_DEAD,
 )
 
 

@@ -292,6 +292,12 @@ class FaultTolerantTrainer:
             data = retrying(data, max_retries=pol.data_retries,
                             base_delay=pol.data_base_delay,
                             max_delay=pol.data_max_delay, seed=0)
+        # outermost wrap (prefetch over the retrying reader) so retried
+        # reads are what the background thread overlaps; no-op unless
+        # DL4J_TPU_AUTO_PREFETCH=1 (both wrappers pass set_epoch through)
+        from deeplearning4j_tpu.data.iterators import maybe_auto_prefetch
+
+        data = maybe_auto_prefetch(data)
         host_step = int(jax.device_get(ts.step))
         # Anchor: a rollback target must exist before the first step can
         # fail (the donated input state is unrecoverable host-side).

@@ -1,5 +1,6 @@
 """Elastic training supervisor: launch N workers, relaunch the cohort
-on death or hang, resume from the latest verified checkpoint.
+on death or hang, resume from the latest verified checkpoint — and,
+when a slot is *permanently* gone, keep training on the survivors.
 
 The reference ran multi-worker training under ParallelWrapper /
 SharedTrainingMaster, whose production value was surviving worker loss
@@ -25,6 +26,37 @@ job*. This module is that missing process-level layer:
   as generation N+1 — bounded by ``max_restarts``, after which
   :class:`SupervisorGaveUp` surfaces the full exit history.
 
+**Degraded mode** (``min_workers`` armed): relaunch-at-same-N assumes
+every failure is transient, so a permanently lost slot (host gone, port
+unbindable, crash loop) burns the whole restart budget and still ends
+in :class:`SupervisorGaveUp`. With ``min_workers`` set the supervisor
+instead *classifies* failures per slot — ``dead_slot_threshold``
+consecutive immediate exits (younger than ``immediate_exit_s``) from
+one slot, an explicit :meth:`ElasticSupervisor.mark_slot_dead`, or the
+env-injectable ``supervisor.slot_dead`` fault — and on a dead slot
+**shrinks to the survivors**: the cohort is torn down, worker ids are
+compacted (slot identity rides along as ``DL4J_TPU_SLOT_ID``), the
+per-generation env is re-derived for the smaller world
+(``DL4J_TPU_NUM_WORKERS``, a fresh telemetry port base sized to the
+survivor count, a fresh coordinator port via ``on_generation``), and
+the cohort relaunches at N-k. Workers resume from the latest verified
+checkpoint through the existing topology-independent restore, and the
+data layer re-derives each worker's shard from the new ``(worker_id,
+num_workers)`` under an explicit shrink policy
+(``data.iterators.ShrinkPolicy``: preserve the global batch — each
+survivor's share grows — or preserve the per-worker batch and accept
+degraded throughput). A background **capacity probe** then retests the
+dead slots on a jittered backoff (bind the slot's ports + an optional
+user ``slot_healthy`` callback) and, once every dead slot probes
+healthy, **re-expands to full N at the next checkpoint boundary**
+(a new entry in ``checkpoint_dir``'s rotation index; immediately when
+no ``checkpoint_dir`` is armed) so the planned teardown never loses a
+step. Every topology transition writes a cluster crash dossier and is
+observable: ``supervisor.shrink`` / ``supervisor.expand`` flight
+events, ``cluster_workers_active`` / ``cluster_degraded`` gauges and
+``supervisor_shrinks_total`` / ``supervisor_expands_total`` counters
+federated through the cluster aggregator.
+
 Recovery correctness is the *worker's* job: a worker that trains via
 ``FaultTolerantTrainer.fit(resume=True)`` (or
 ``PreemptionCheckpointer.resume``) restores the latest **verified**
@@ -41,15 +73,25 @@ per-worker log files under ``log_dir``. Stdlib only.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import os
 import random
 import signal
+import socket
 import subprocess
 import sys
 import threading
 import time
 from pathlib import Path
-from typing import Callable, Dict, List, Optional, Sequence, Union
+from typing import (
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Union,
+)
 
 from deeplearning4j_tpu.resilience.cluster import (
     ENV_CRASH_DIR,
@@ -62,6 +104,19 @@ from deeplearning4j_tpu.resilience.retry import backoff_delays
 ENV_WORKER_ID = "DL4J_TPU_WORKER_ID"
 ENV_NUM_WORKERS = "DL4J_TPU_NUM_WORKERS"
 ENV_GENERATION = "DL4J_TPU_GENERATION"
+# degraded-mode identity: the worker's PHYSICAL slot (stable across
+# shrink/expand; worker ids are compacted per generation) and the
+# cohort's full size, so the data layer can apply its shrink policy.
+# data/iterators.py reads the same names (duplicated literals — this
+# module must stay importable without jax, that one without this one).
+ENV_SLOT_ID = "DL4J_TPU_SLOT_ID"
+ENV_BASELINE_NUM_WORKERS = "DL4J_TPU_BASELINE_NUM_WORKERS"
+ENV_SHRINK_POLICY = "DL4J_TPU_SHRINK_POLICY"
+
+# the rotation-index file serde/checkpoint.py maintains — watched (never
+# parsed) for the expansion checkpoint boundary, so the supervisor needs
+# no jax/numpy import to know a new checkpoint landed
+_CKPT_INDEX = "checkpoint_index.json"
 
 
 @dataclasses.dataclass
@@ -71,8 +126,11 @@ class WorkerExit:
     generation: int
     worker_id: int
     returncode: Optional[int]  # None = killed by the supervisor (hang)
-    reason: str                # "exit" | "hang" | "cohort"
+    reason: str                # "exit" | "hang" | "cohort" | "shrink"
+    #                            | "expand"
     log_path: Optional[str] = None
+    slot: Optional[int] = None  # physical slot (== worker_id until a
+    #                             shrink compacts the ids)
 
 
 class SupervisorGaveUp(RuntimeError):
@@ -91,6 +149,22 @@ class SupervisorResult:
     generations: int
     restarts: int
     exits: List[WorkerExit]
+    shrinks: int = 0
+    expands: int = 0
+    dead_slots: List[int] = dataclasses.field(default_factory=list)
+    final_workers: int = 0
+
+
+@dataclasses.dataclass
+class _GenOutcome:
+    """How one generation resolved (``_watch_cohort``'s verdict)."""
+
+    kind: str                  # "ok" | "fail" | "expand"
+    failure: Optional[str] = None
+    worker: Optional[int] = None      # first failing worker index
+    slot: Optional[int] = None        # ... and its physical slot
+    reason: Optional[str] = None      # "exit" | "hang" | "shrink"
+    lifetime_s: float = 0.0
 
 
 def _flight(kind: str, **data):
@@ -111,22 +185,34 @@ class ElasticSupervisor:
     worker reads its identity from env), or a callable
     ``(worker_id, generation) -> argv``. Each worker's env carries
     ``DL4J_TPU_WORKER_ID`` / ``DL4J_TPU_NUM_WORKERS`` /
-    ``DL4J_TPU_GENERATION`` plus the heartbeat directory; workers that
-    want hang detection call
+    ``DL4J_TPU_GENERATION`` (plus ``DL4J_TPU_SLOT_ID`` /
+    ``DL4J_TPU_BASELINE_NUM_WORKERS`` / ``DL4J_TPU_SHRINK_POLICY`` for
+    the degraded-mode data plane) and the heartbeat directory; workers
+    that want hang detection call
     ``resilience.cluster.heartbeat_from_env()`` and ``touch()`` once per
     step (cheap — in-memory stamp). Workers without heartbeats are still
     supervised for exits, just not for hangs.
 
-    ``on_generation``: optional ``(generation) -> dict`` returning extra
-    env vars for that generation — the hook that mints a fresh
-    coordinator port per relaunch (gRPC coordination state does not
-    survive its processes).
+    ``on_generation``: optional hook returning extra env vars for a
+    generation — the hook that mints a fresh coordinator port per
+    relaunch (gRPC coordination state does not survive its processes).
+    Signature ``(generation) -> dict`` or
+    ``(generation, num_workers) -> dict`` — the two-argument form sees
+    the *effective* (possibly shrunken) cohort size.
+
+    Degraded mode: pass ``min_workers`` (the smallest cohort worth
+    running) to allow shrink-to-survivors; see the module docstring for
+    the classification/shrink/probe/expand lifecycle. ``checkpoint_dir``
+    points at the workers' (shared) verified-checkpoint directory so
+    re-expansion waits for the next checkpoint boundary instead of
+    tearing down mid-step window.
 
     Usage::
 
         sup = ElasticSupervisor([sys.executable, "worker.py"],
                                 num_workers=2, max_restarts=3,
-                                workdir=run_dir)
+                                workdir=run_dir, min_workers=1,
+                                checkpoint_dir=ckpt_dir)
         result = sup.run()        # returns when all workers exit 0
     """
 
@@ -138,7 +224,7 @@ class ElasticSupervisor:
         max_restarts: int = 3,
         workdir: Optional[str | Path] = None,
         env: Optional[Dict[str, str]] = None,
-        on_generation: Optional[Callable[[int], Dict[str, str]]] = None,
+        on_generation: Optional[Callable[..., Dict[str, str]]] = None,
         heartbeat_timeout_s: Optional[float] = None,
         heartbeat_interval_s: float = 0.25,
         poll_interval_s: float = 0.1,
@@ -151,11 +237,29 @@ class ElasticSupervisor:
         telemetry_poll_interval_s: float = 1.0,
         cluster_server_port: Optional[int] = None,
         cluster_slo_rules: Optional[Sequence] = None,
+        min_workers: Optional[int] = None,
+        dead_slot_threshold: int = 3,
+        immediate_exit_s: float = 5.0,
+        shrink_policy: Optional[str] = None,
+        checkpoint_dir: Optional[str | Path] = None,
+        probe_interval_s: float = 5.0,
+        probe_max_interval_s: float = 60.0,
+        probe_jitter: float = 0.5,
+        slot_healthy: Optional[Callable[[int], bool]] = None,
+        slot_ports: Optional[Callable[[int], Sequence[int]]] = None,
+        max_topology_changes: int = 16,
     ):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
         if max_restarts < 0:
             raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        if min_workers is not None and not 1 <= min_workers <= num_workers:
+            raise ValueError(
+                f"min_workers must be in [1, num_workers={num_workers}], "
+                f"got {min_workers}")
+        if dead_slot_threshold < 1:
+            raise ValueError("dead_slot_threshold must be >= 1, got "
+                             f"{dead_slot_threshold}")
         self.command = command
         self.num_workers = num_workers
         self.max_restarts = max_restarts
@@ -174,6 +278,46 @@ class ElasticSupervisor:
         self.generation = 0
         self._procs: List[subprocess.Popen] = []
         self._logs: List[Path] = []
+        # -- degraded mode (shrink-to-survivors) -----------------------------
+        self.min_workers = min_workers
+        self.dead_slot_threshold = dead_slot_threshold
+        self.immediate_exit_s = immediate_exit_s
+        self.shrink_policy = shrink_policy
+        self.checkpoint_dir = (Path(checkpoint_dir)
+                               if checkpoint_dir is not None else None)
+        self.probe_interval_s = probe_interval_s
+        self.probe_max_interval_s = probe_max_interval_s
+        self.probe_jitter = probe_jitter
+        self.slot_healthy = slot_healthy
+        self.slot_ports = slot_ports
+        self.max_topology_changes = max_topology_changes
+        self.dead_slots: Set[int] = set()
+        self.shrinks = 0
+        self.expands = 0
+        self._fail_streak: Dict[int, int] = {}
+        self._marked_dead: Set[int] = set()
+        self._marked_lock = threading.Lock()
+        self._gen_slots: List[int] = list(range(num_workers))
+        self._launch_time = 0.0
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_stop = threading.Event()
+        self._expand_ready = threading.Event()
+        self._ckpt_sig_at_ready = None
+        self._probe_seed = seed + 1
+        # probe-thread generation: a shrink supersedes any in-flight
+        # probe pass, so a stale thread can never arm expansion for a
+        # dead set it did not test. _probe_lock serializes the probe
+        # state machine (dead-set mutation, epoch bump + ready-clear,
+        # recheck + ready-set, and the shared backoff generator) across
+        # the run thread and any number of probe threads.
+        self._probe_epoch = 0
+        self._probe_lock = threading.Lock()
+        # ONE backoff schedule for the supervisor's lifetime: a slot
+        # that flaps (probes healthy, crash-loops on expansion,
+        # re-shrinks) keeps escalating toward probe_max_interval_s
+        # instead of hammering on a fresh fast schedule every cycle
+        self._probe_delays = None
+        self._last_port_base: Optional[int] = None
         # -- cluster telemetry federation (observability/federation.py):
         # with telemetry=True each generation's workers get an exporter
         # port base + file-sink dir in env; the supervisor polls every
@@ -225,15 +369,56 @@ class ElasticSupervisor:
         return (self._cluster_server.url
                 if self._cluster_server is not None else None)
 
+    @property
+    def degraded(self) -> bool:
+        """True while the cohort runs without its dead slots."""
+        return bool(self.dead_slots)
+
+    def active_slots(self) -> List[int]:
+        """The physical slots the next (or current) generation runs —
+        worker ids are their positions in this list."""
+        return [s for s in range(self.num_workers)
+                if s not in self.dead_slots]
+
+    def mark_slot_dead(self, slot: int) -> None:
+        """Classify ``slot`` permanently dead *now* (operator/scheduler
+        knowledge the exit-history heuristic can't see: host
+        decommissioned, maintenance drain). The watch loop tears the
+        cohort down at its next poll and relaunches on the survivors.
+        Requires degraded mode (``min_workers``), and refuses a mark
+        that would take the cohort below the floor — silently consuming
+        the operator's intent after a useless teardown would be worse
+        than failing the call."""
+        if not 0 <= slot < self.num_workers:
+            raise ValueError(f"slot must be in [0, {self.num_workers}), "
+                             f"got {slot}")
+        if self.min_workers is None:
+            raise RuntimeError(
+                "mark_slot_dead requires degraded mode: construct the "
+                "supervisor with min_workers=<floor> to allow shrinking")
+        with self._marked_lock:
+            survivors = [s for s in range(self.num_workers)
+                         if s not in self.dead_slots
+                         and s not in self._marked_dead and s != slot]
+            if slot not in self.dead_slots \
+                    and len(survivors) < self.min_workers:
+                raise ValueError(
+                    f"marking slot {slot} dead would leave "
+                    f"{len(survivors)} worker(s), below "
+                    f"min_workers={self.min_workers}")
+            self._marked_dead.add(slot)
+
     # -- telemetry federation ------------------------------------------------
 
-    def _pick_telemetry_port_base(self) -> Optional[int]:
-        """A base port such that base..base+N-1 all bind right now
-        (workers derive base + worker_id). Racy by nature — a worker
-        losing the race falls back to its file sink, which the
-        aggregator reads anyway."""
-        import socket
-
+    def _pick_telemetry_port_base(self, n: Optional[int] = None
+                                  ) -> Optional[int]:
+        """A base port such that base..base+n-1 all bind right now
+        (workers derive base + worker_id). ``n`` is the generation's
+        *effective* cohort size — re-derived per generation so a
+        shrunken cohort never inherits (or leaks) a dead slot's
+        reservation. Racy by nature — a worker losing the race falls
+        back to its file sink, which the aggregator reads anyway."""
+        n = self.num_workers if n is None else n
         for _ in range(32):
             socks = []
             try:
@@ -241,8 +426,8 @@ class ElasticSupervisor:
                 s0.bind(("127.0.0.1", 0))
                 base = s0.getsockname()[1]
                 socks.append(s0)
-                ok = base + self.num_workers <= 65535
-                for i in range(1, self.num_workers if ok else 0):
+                ok = base + n <= 65535
+                for i in range(1, n if ok else 0):
                     s = socket.socket()
                     try:
                         s.bind(("127.0.0.1", base + i))
@@ -257,16 +442,31 @@ class ElasticSupervisor:
                     s.close()
         return None
 
-    def _arm_telemetry(self, env: Dict[str, str]) -> None:
+    def _topology_info(self) -> dict:
+        """What the aggregator publishes about the cohort's shape (the
+        ``cluster_workers_active`` / ``cluster_degraded`` gauges and the
+        time-in-degraded-mode counter feed from this)."""
+        return {
+            "workers_active": len(self.active_slots()),
+            "workers_baseline": self.num_workers,
+            "degraded": bool(self.dead_slots),
+            "dead_slots": sorted(self.dead_slots),
+            "shrinks": self.shrinks,
+            "expands": self.expands,
+        }
+
+    def _arm_telemetry(self, env: Dict[str, str], n: int) -> None:
         """Per-generation telemetry env + aggregator (re)configuration;
-        called from ``_launch_cohort`` before workers spawn."""
+        called from ``_launch_cohort`` before workers spawn. ``n`` is
+        this generation's effective cohort size."""
         from deeplearning4j_tpu.observability.federation import (
             ENV_TELEMETRY_DIR,
             ENV_TELEMETRY_PORT_BASE,
             ClusterAggregator,
         )
 
-        base = self._pick_telemetry_port_base()
+        base = self._pick_telemetry_port_base(n)
+        self._last_port_base = base
         self.telemetry_dir.mkdir(parents=True, exist_ok=True)
         if base is not None:
             env[ENV_TELEMETRY_PORT_BASE] = str(base)
@@ -284,12 +484,40 @@ class ElasticSupervisor:
                 except OSError:
                     pass
             self._aggregator = ClusterAggregator(
-                num_workers=self.num_workers, port_base=base,
+                num_workers=n, port_base=base,
                 sink_dir=self.telemetry_dir,
                 heartbeat_dir=self.heartbeat_dir,
-                restarts=lambda: self._restart_count)
+                restarts=lambda: self._restart_count,
+                topology=self._topology_info,
+                local_events=self._supervisor_events)
         else:
-            self._aggregator.set_port_base(base)
+            # a shrink/expand changes the cohort size: re-derive the
+            # polled worker-id range WITH the port base, or the
+            # aggregator keeps polling (and failing on) dead slots'
+            # stale reservations
+            self._aggregator.set_cohort(n, port_base=base)
+
+    def _cluster_m(self):
+        """The aggregator's ClusterMetrics, or None without telemetry."""
+        return (self._aggregator.metrics
+                if self._aggregator is not None else None)
+
+    def _supervisor_events(self) -> List[dict]:
+        """This (supervisor) process's own ``supervisor.*`` flight
+        events — merged into the cluster timeline so launches, shrinks
+        and expansions appear next to the worker events they caused.
+        Filtered to the supervisor namespace: the supervisor process's
+        ring also carries unrelated local telemetry (tests, co-located
+        training) that must not masquerade as cohort history."""
+        try:
+            from deeplearning4j_tpu.observability.flightrecorder import (
+                get_flight_recorder,
+            )
+
+            return [e for e in get_flight_recorder().events()
+                    if str(e.get("kind", "")).startswith("supervisor.")]
+        except Exception:  # noqa: BLE001
+            return []
 
     def _start_telemetry_surface(self) -> None:
         """Cluster HTTP surface + federated SLO engine (idempotent)."""
@@ -368,10 +596,11 @@ class ElasticSupervisor:
             self._cluster_engine = None
 
     def _write_cluster_dossier(self, failure: str) -> Optional[str]:
-        """On cohort teardown: one final poll (the dead worker's file
-        sink still holds its last pre-crash snapshot), then the whole
-        last-known cluster view — worker table, merged timeline, every
-        worker's final snapshot — into a crash report.
+        """On cohort teardown (failure OR planned topology transition):
+        one final poll (the dead worker's file sink still holds its last
+        pre-crash snapshot), then the whole last-known cluster view —
+        worker table, merged timeline, every worker's final snapshot —
+        into a crash report.
 
         Written WITHOUT ``utils.crash.write_crash_report``: that path
         imports jax and enumerates devices, and a supervisor that
@@ -397,6 +626,7 @@ class ElasticSupervisor:
                 "extra": {
                     "supervisor_failure": failure,
                     "generation": self.generation,
+                    "topology": self._topology_info(),
                     "cluster_dossier": self._aggregator.dossier(),
                 },
             }
@@ -440,6 +670,21 @@ class ElasticSupervisor:
             return list(self.command(worker_id, self.generation))
         return list(self.command)
 
+    def _generation_env(self) -> Dict[str, str]:
+        """The hook-minted extra env for this generation; the
+        two-argument hook form also sees the effective cohort size."""
+        if self.on_generation is None:
+            return {}
+        try:
+            nparams = len(inspect.signature(
+                self.on_generation).parameters)
+        except (TypeError, ValueError):
+            nparams = 1
+        if nparams >= 2:
+            return dict(self.on_generation(self.generation,
+                                           len(self.active_slots())))
+        return dict(self.on_generation(self.generation))
+
     def _launch_cohort(self, gen_env: Dict[str, str]):
         # heartbeats are per-generation: a stale beacon from the killed
         # previous cohort must not read as a dead peer of the new one
@@ -451,15 +696,23 @@ class ElasticSupervisor:
                 except OSError:
                     pass
         hb.mkdir(parents=True, exist_ok=True)
+        active = self.active_slots()
+        n = len(active)
         if self.telemetry:
-            self._arm_telemetry(gen_env)
+            self._arm_telemetry(gen_env, n)
+        self._gen_slots = active
         self._procs, self._logs = [], []
-        for wid in range(self.num_workers):
+        self._launch_time = time.monotonic()
+        for wid, slot in enumerate(active):
             env = dict(self.env)
             env.update(gen_env)
             env[ENV_WORKER_ID] = str(wid)
-            env[ENV_NUM_WORKERS] = str(self.num_workers)
+            env[ENV_NUM_WORKERS] = str(n)
             env[ENV_GENERATION] = str(self.generation)
+            env[ENV_SLOT_ID] = str(slot)
+            env[ENV_BASELINE_NUM_WORKERS] = str(self.num_workers)
+            if self.shrink_policy is not None:
+                env[ENV_SHRINK_POLICY] = str(self.shrink_policy)
             env[ENV_HEARTBEAT_DIR] = str(hb)
             env[ENV_HEARTBEAT_INTERVAL] = str(self.heartbeat_interval_s)
             log_path = self.worker_log(wid)
@@ -474,8 +727,15 @@ class ElasticSupervisor:
             self._procs.append(proc)
             self._logs.append(log_path)
         _flight("supervisor.launch", generation=self.generation,
-                num_workers=self.num_workers,
+                num_workers=n, slots=active, degraded=self.degraded,
                 pids=[p.pid for p in self._procs])
+        m = self._cluster_m()
+        if m is not None:
+            try:
+                m.workers_active.set(float(n))
+                m.degraded.set(1.0 if self.degraded else 0.0)
+            except Exception:  # noqa: BLE001 — telemetry never fails
+                pass
 
     def _hung_workers(self) -> List[int]:
         if self.heartbeat_timeout_s is None:
@@ -517,79 +777,378 @@ class ElasticSupervisor:
                 self._signal_worker(p, signal.SIGKILL)
                 p.wait()
         for wid, p in enumerate(self._procs):
-            why = reason if wid == first else "cohort"
+            why = reason if wid == first or first is None else "cohort"
             self.exits.append(WorkerExit(
                 generation=self.generation, worker_id=wid,
                 returncode=p.returncode, reason=why,
-                log_path=str(self._logs[wid])))
+                log_path=str(self._logs[wid]),
+                slot=self._gen_slots[wid]))
 
-    def _watch_cohort(self) -> Optional[str]:
-        """Block until the generation resolves; returns None on success
-        (all workers exited 0) or the failure reason."""
+    # -- degraded mode: classification / probe / expand ----------------------
+
+    def _consume_marked(self) -> Set[int]:
+        with self._marked_lock:
+            marked, self._marked_dead = self._marked_dead, set()
+        return marked
+
+    def _classify_failure(self, out: _GenOutcome) -> Set[int]:
+        """Which slots this failure proves permanently dead: K
+        consecutive immediate exits from one slot, an external
+        :meth:`mark_slot_dead`, or the ``supervisor.slot_dead``
+        injectable fault (chaos testing the shrink path without a real
+        crash loop)."""
+        newly: Set[int] = set(self._consume_marked()) - self.dead_slots
+        slot = out.slot
+        if out.lifetime_s > self.immediate_exit_s:
+            # the generation ran long before failing: EVERY slot was
+            # healthy for a while, so nobody is crash-looping — isolated
+            # immediate exits days apart must not accumulate into a
+            # death sentence for a slot that ran fine in between
+            self._fail_streak.clear()
+        elif slot is not None and out.reason == "exit":
+            self._fail_streak[slot] = self._fail_streak.get(slot, 0) + 1
+            if self._fail_streak[slot] >= self.dead_slot_threshold:
+                newly.add(slot)
+        try:
+            from deeplearning4j_tpu.resilience.faults import (
+                get_fault_injector,
+            )
+
+            if get_fault_injector().fire("supervisor.slot_dead") is not None \
+                    and slot is not None:
+                newly.add(slot)
+        except Exception:  # noqa: BLE001 — injection must never break
+            pass           # real supervision
+        return newly
+
+    def _shrink(self, newly_dead: Set[int], failure: str) -> None:
+        """Commit a topology shrink: record the dead slots, surface the
+        transition (flight event + counters + dossier), and start the
+        capacity probe that will earn the expansion back."""
+        before = len(self.active_slots())
+        with self._probe_lock:
+            self.dead_slots |= newly_dead
+        for s in newly_dead:
+            self._fail_streak.pop(s, None)
+        self.shrinks += 1
+        after = len(self.active_slots())
+        _flight("supervisor.shrink", generation=self.generation,
+                dead_slots=sorted(newly_dead),
+                all_dead_slots=sorted(self.dead_slots),
+                from_workers=before, to_workers=after, cause=failure,
+                policy=self.shrink_policy)
+        m = self._cluster_m()
+        if m is not None:
+            try:
+                m.shrinks_total.inc()
+                m.degraded.set(1.0)
+                m.workers_active.set(float(after))
+            except Exception:  # noqa: BLE001
+                pass
+        self._start_probe()
+
+    def _expand(self) -> None:
+        """Commit the re-expansion: the probed-healthy slots rejoin and
+        the cohort relaunches at full N from the checkpoint the boundary
+        wait just observed."""
+        before = len(self.active_slots())
+        with self._probe_lock:
+            healed = sorted(self.dead_slots)
+            self.dead_slots.clear()
+            self._expand_ready.clear()
+        self.expands += 1
+        _flight("supervisor.expand", generation=self.generation,
+                healed_slots=healed, from_workers=before,
+                to_workers=self.num_workers)
+        m = self._cluster_m()
+        if m is not None:
+            try:
+                m.expands_total.inc()
+                m.degraded.set(0.0)
+                m.workers_active.set(float(self.num_workers))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _ckpt_signature(self):
+        """Cheap identity of the newest checkpoint-index write (the
+        expansion boundary detector — content is never parsed, so no
+        jax/numpy enters the supervisor process)."""
+        if self.checkpoint_dir is None:
+            return None
+        try:
+            st = (self.checkpoint_dir / _CKPT_INDEX).stat()
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _probe_slot(self, slot: int) -> bool:
+        """One capacity retest of a dead slot: every port the slot needs
+        must bind right now (``slot_ports`` when provided; else the
+        slot's would-be telemetry port at the last armed base, skipped
+        when that port sits inside the live survivors' range), and the
+        user's ``slot_healthy`` callback (scheduler/host checks the
+        supervisor can't see) must agree. With neither hook nor a
+        telemetry base armed the probe degrades to a plain cooldown
+        retry — expansion then leans on the escalating backoff and the
+        ``max_topology_changes`` bound to contain a flapping slot."""
+        ports: List[int] = []
+        if self.slot_ports is not None:
+            try:
+                ports = [int(p) for p in self.slot_ports(slot)]
+            except Exception:  # noqa: BLE001 — a broken hook reads as
+                return False   # unhealthy, never as healthy
+        elif self._last_port_base is not None:
+            cand = self._last_port_base + slot
+            if cand >= self._last_port_base + len(self._gen_slots):
+                # outside the live survivors' port range: a squatter
+                # (the slot's old tenant) still holding it means the
+                # slot's resources are not back
+                ports = [cand]
+        ok = True
+        for port in ports:
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", port))
+            except OSError:
+                ok = False
+            finally:
+                s.close()
+            if not ok:
+                break
+        if ok and self.slot_healthy is not None:
+            try:
+                ok = bool(self.slot_healthy(slot))
+            except Exception:  # noqa: BLE001
+                ok = False
+        _flight("supervisor.probe", slot=slot, ok=ok, ports=ports)
+        return ok
+
+    def _start_probe(self) -> None:
+        """(Re)arm the capacity probe for the CURRENT dead set. Always
+        bumps the probe epoch and starts a fresh thread: an in-flight
+        pass that was testing a smaller dead set is superseded — a
+        stale thread must never arm expansion for slots it did not
+        probe (the epoch check and the ready-set happen under one lock,
+        so a superseded thread's arm is either rejected or already
+        cleared here)."""
+        with self._probe_lock:
+            self._probe_epoch += 1
+            self._expand_ready.clear()
+        self._probe_stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, args=(self._probe_epoch,),
+            daemon=True, name="supervisor-capacity-probe")
+        self._probe_thread.start()
+
+    def _next_probe_delay(self) -> float:
+        """One delay off the supervisor-lifetime backoff schedule.
+        Locked: a superseded probe thread may overlap the new one for
+        one wakeup, and two threads calling next() on one generator
+        concurrently is a ValueError."""
+        with self._probe_lock:
+            if self._probe_delays is None:
+                self._probe_delays = backoff_delays(
+                    base=self.probe_interval_s,
+                    cap=self.probe_max_interval_s,
+                    jitter=self.probe_jitter,
+                    rng=random.Random(self._probe_seed))
+            return next(self._probe_delays)
+
+    def _probe_loop(self, epoch: int):
+        """Retest dead slots on a capped full-jitter backoff; once EVERY
+        dead slot probes healthy, arm the expansion (the watch loop
+        executes it at the next checkpoint boundary). Partial healing
+        keeps probing — re-expansion restores full N, not N-k+1. The
+        backoff generator persists across probe restarts so a flapping
+        slot keeps escalating instead of resetting to the fast end."""
+        while not self._probe_stop.wait(self._next_probe_delay()):
+            if epoch != self._probe_epoch:
+                return  # superseded by a newer probe thread
+            dead = sorted(self.dead_slots)
+            if not dead:
+                return
+            if all(self._probe_slot(s) for s in dead):
+                with self._probe_lock:
+                    if epoch != self._probe_epoch \
+                            or sorted(self.dead_slots) != dead:
+                        continue  # a shrink landed mid-pass: retest all
+                    # boundary baseline is captured NOW: only a
+                    # checkpoint written after the heal releases the
+                    # expansion, so the relaunched full cohort resumes
+                    # from a post-heal save
+                    self._ckpt_sig_at_ready = self._ckpt_signature()
+                    self._expand_ready.set()
+                _flight("supervisor.expand_ready", healed_slots=dead)
+                return
+
+    def _stop_probe(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None
+
+    def _expansion_due(self) -> bool:
+        """The probe armed expansion AND the checkpoint boundary passed
+        (a new rotation-index write since the probe passed; immediate
+        when no ``checkpoint_dir`` is armed)."""
+        if not self._expand_ready.is_set():
+            return False
+        if self.checkpoint_dir is None:
+            return True
+        return self._ckpt_signature() != self._ckpt_sig_at_ready
+
+    # -- watch ---------------------------------------------------------------
+
+    def _watch_cohort(self) -> _GenOutcome:
+        """Block until the generation resolves: success (all workers
+        exited 0), failure (exit/hang/marked-dead slot), or a due
+        expansion (planned teardown at the checkpoint boundary)."""
         while True:
             codes = [p.poll() for p in self._procs]
             bad = next((i for i, c in enumerate(codes)
                         if c is not None and c != 0), None)
             if bad is not None:
+                lifetime = time.monotonic() - self._launch_time
                 _flight("supervisor.worker_exit",
                         generation=self.generation, worker=bad,
-                        returncode=codes[bad])
+                        slot=self._gen_slots[bad], returncode=codes[bad],
+                        lifetime_s=round(lifetime, 3))
                 self._terminate_cohort("exit", first=bad)
-                return f"worker {bad} exited {codes[bad]}"
+                return _GenOutcome(
+                    "fail",
+                    failure=(f"worker {bad} (slot {self._gen_slots[bad]}) "
+                             f"exited {codes[bad]}"),
+                    worker=bad, slot=self._gen_slots[bad], reason="exit",
+                    lifetime_s=lifetime)
             if all(c == 0 for c in codes):
                 for wid, p in enumerate(self._procs):
                     self.exits.append(WorkerExit(
                         generation=self.generation, worker_id=wid,
                         returncode=0, reason="exit",
-                        log_path=str(self._logs[wid])))
-                return None
+                        log_path=str(self._logs[wid]),
+                        slot=self._gen_slots[wid]))
+                return _GenOutcome("ok")
+            marked = {s for s in self._consume_marked()
+                      if s in self._gen_slots}
+            if marked:
+                first = self._gen_slots.index(sorted(marked)[0])
+                # re-queue so classification (which consumes the marked
+                # set again) still sees every marked slot
+                with self._marked_lock:
+                    self._marked_dead |= marked
+                _flight("supervisor.slot_marked_dead",
+                        generation=self.generation, slots=sorted(marked))
+                self._terminate_cohort("shrink", first=first)
+                return _GenOutcome(
+                    "fail",
+                    failure=f"slot(s) {sorted(marked)} marked dead",
+                    worker=first, slot=self._gen_slots[first],
+                    reason="shrink",
+                    lifetime_s=time.monotonic() - self._launch_time)
+            if self._expansion_due():
+                self._terminate_cohort("expand")
+                return _GenOutcome(
+                    "expand",
+                    failure=(f"planned expansion to {self.num_workers} "
+                             "workers at checkpoint boundary"))
             hung = [w for w in self._hung_workers()
                     if w < len(codes) and codes[w] is None]
             if hung:
                 _flight("supervisor.worker_hang",
                         generation=self.generation, workers=hung)
                 self._terminate_cohort("hang", first=hung[0])
-                return f"worker(s) {hung} hung (stale heartbeat progress)"
+                return _GenOutcome(
+                    "fail",
+                    failure=(f"worker(s) {hung} hung (stale heartbeat "
+                             "progress)"),
+                    worker=hung[0], slot=self._gen_slots[hung[0]],
+                    reason="hang",
+                    lifetime_s=time.monotonic() - self._launch_time)
             time.sleep(self.poll_interval_s)
 
     # -- run -----------------------------------------------------------------
 
     def run(self) -> SupervisorResult:
         """Supervise until the cohort completes; relaunch on failure up
-        to ``max_restarts`` times, then raise :class:`SupervisorGaveUp`."""
+        to ``max_restarts`` times (consecutive failures at one topology
+        — a shrink or expansion resets the streak: it changes the
+        failure regime), then raise :class:`SupervisorGaveUp`."""
         self.workdir.mkdir(parents=True, exist_ok=True)
         restarts = 0
+        streak = 0   # consecutive failures since the last topology change
         try:
             while True:
                 self.generation += 1
-                gen_env = dict(self.on_generation(self.generation)
-                               if self.on_generation is not None else {})
+                gen_env = self._generation_env()
                 self._launch_cohort(gen_env)
                 self._start_telemetry_surface()
-                failure = self._watch_cohort()
-                if failure is None:
+                out = self._watch_cohort()
+                if out.kind == "ok":
                     _flight("supervisor.complete",
-                            generation=self.generation, restarts=restarts)
-                    return SupervisorResult(generations=self.generation,
-                                            restarts=restarts,
-                                            exits=self.exits)
+                            generation=self.generation, restarts=restarts,
+                            shrinks=self.shrinks, expands=self.expands)
+                    return SupervisorResult(
+                        generations=self.generation, restarts=restarts,
+                        exits=self.exits, shrinks=self.shrinks,
+                        expands=self.expands,
+                        dead_slots=sorted(self.dead_slots),
+                        final_workers=len(self.active_slots()))
                 # cohort teardown: the aggregator's last-known view of
                 # every worker (the dead one's final snapshot included)
-                # becomes the crash dossier before anything relaunches
-                self._write_cluster_dossier(failure)
-                if restarts >= self.max_restarts:
+                # becomes the crash dossier before anything relaunches.
+                # Topology transitions commit FIRST so their dossier
+                # carries the supervisor.shrink/expand event and the
+                # post-transition topology — the forensic record of the
+                # transition itself, not just the failure before it.
+                if out.kind == "expand":
+                    self._expand()
+                    self._write_cluster_dossier(out.failure)
+                    streak = 0
+                    continue  # planned transition: no backoff, no budget
+                newly_dead = (self._classify_failure(out)
+                              if self.min_workers is not None else set())
+                survivors = ([s for s in self.active_slots()
+                              if s not in newly_dead]
+                             if newly_dead else [])
+                if newly_dead and len(survivors) >= self.min_workers \
+                        and self.shrinks + self.expands \
+                        < self.max_topology_changes:
+                    self._shrink(newly_dead, out.failure)
+                    self._write_cluster_dossier(
+                        f"shrink to {len(survivors)} worker(s) after: "
+                        f"{out.failure}")
+                    restarts += 1
+                    self._restart_count = restarts
+                    streak = 0  # new topology, new failure regime
+                    continue    # the failing slot is out: relaunch now
+                if newly_dead:
+                    # classification said dead but the floor / topology
+                    # budget denies the shrink: surface it loudly — the
+                    # intent is dropped here (relaunch at the same N),
+                    # never silently
+                    _flight("supervisor.shrink_denied",
+                            generation=self.generation,
+                            dead_slots=sorted(newly_dead),
+                            survivors=len(survivors),
+                            reason=("below min_workers"
+                                    if len(survivors) < self.min_workers
+                                    else "max_topology_changes reached"))
+                self._write_cluster_dossier(out.failure)
+                if streak >= self.max_restarts:
                     _flight("supervisor.gave_up",
                             generation=self.generation,
-                            restarts=restarts, failure=failure)
+                            restarts=restarts, failure=out.failure)
                     raise SupervisorGaveUp(
-                        f"cohort failed {restarts + 1}x (restart budget "
-                        f"{self.max_restarts}); last failure: {failure}",
+                        f"cohort failed {streak + 1}x (restart budget "
+                        f"{self.max_restarts}); last failure: "
+                        f"{out.failure}",
                         self.exits)
                 restarts += 1
+                streak += 1
                 self._restart_count = restarts
                 delay = next(self._delays)
                 _flight("supervisor.restart", generation=self.generation,
-                        restarts=restarts, failure=failure,
+                        restarts=restarts, failure=out.failure,
                         backoff_s=round(delay, 3))
                 try:
                     from deeplearning4j_tpu.observability import (
@@ -603,11 +1162,13 @@ class ElasticSupervisor:
                     pass
                 time.sleep(delay)
         finally:
+            self._stop_probe()
             self._stop_telemetry_surface()
 
     def stop(self):
         """Terminate any live workers (cleanup path for callers that
         abandon a run mid-flight)."""
+        self._probe_stop.set()
         for p in self._procs:
             if p.poll() is None:
                 self._signal_worker(p, signal.SIGTERM)

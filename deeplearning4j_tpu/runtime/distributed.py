@@ -39,6 +39,12 @@ import numpy as np
 
 from deeplearning4j_tpu.runtime.device import MeshSpec, build_mesh
 
+# Supervisor-armed coordination endpoint (resilience/supervisor.py's
+# on_generation hook typically mints the port per generation): either a
+# full host:port address, or a bare port implying 127.0.0.1.
+ENV_COORDINATOR_ADDRESS = "DL4J_TPU_COORDINATOR_ADDRESS"
+ENV_COORDINATOR_PORT = "DL4J_TPU_COORDINATOR_PORT"
+
 _INITIALIZED = False
 
 
@@ -112,6 +118,46 @@ def _enable_cpu_collectives() -> None:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:  # noqa: BLE001 - option/impl absent in this jaxlib
         pass
+
+
+def initialize_from_env() -> dict:
+    """Bootstrap a supervised worker entirely from the elastic
+    supervisor's per-generation env: identity from ``DL4J_TPU_WORKER_ID``
+    / ``DL4J_TPU_NUM_WORKERS`` (compacted per generation — a cohort
+    relaunched at N-k after a shrink just works), coordinator from
+    ``DL4J_TPU_COORDINATOR_ADDRESS`` or ``DL4J_TPU_COORDINATOR_PORT``.
+    A 1-worker (fully shrunken) generation skips distributed init
+    entirely — the survivor trains standalone. Returns the identity
+    dict (``worker_id`` / ``num_workers`` / ``generation``), so a
+    worker script's whole bootstrap is::
+
+        ident = distributed.initialize_from_env()
+        mesh = distributed.global_mesh()
+    """
+    from deeplearning4j_tpu.observability.federation import (
+        worker_identity,
+    )
+
+    ident = worker_identity()
+    if ident["num_workers"] > 1:
+        addr = os.environ.get(ENV_COORDINATOR_ADDRESS)
+        if not addr:
+            port = os.environ.get(ENV_COORDINATOR_PORT)
+            addr = f"127.0.0.1:{port}" if port else None
+        if addr is None:
+            # fail HERE naming the missing env: letting jax's own init
+            # fail deep inside coordinator auto-detection points nowhere
+            # near the real cause (a supervisor without an on_generation
+            # hook minting the port), on every relaunch
+            raise RuntimeError(
+                f"initialize_from_env: {ident['num_workers']}-worker "
+                f"generation but neither {ENV_COORDINATOR_ADDRESS} nor "
+                f"{ENV_COORDINATOR_PORT} is set — the supervisor's "
+                "on_generation hook must mint the coordinator endpoint "
+                "per generation")
+        initialize(addr, num_processes=ident["num_workers"],
+                   process_id=ident["worker_id"])
+    return ident
 
 
 def is_multiprocess() -> bool:

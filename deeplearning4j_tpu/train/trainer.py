@@ -671,6 +671,11 @@ class Trainer:
         steps_per_epoch: Optional[int] = None,
     ) -> TrainState:
         listeners = listeners or []
+        # opt-in starvation remediation (DL4J_TPU_AUTO_PREFETCH=1): the
+        # data_starved detector below names the read-dominated step; this
+        # is its minimal fix — reads move to a background prefetch thread
+        # so they overlap the compiled step (no-op unless armed)
+        data = _maybe_auto_prefetch(data)
         for lst in listeners:
             lst.on_fit_start(self, ts)
         stop = False
@@ -865,6 +870,18 @@ class _StepTelemetry:
                     else "train.data_recovered",
                     step=step_no,
                     read_fraction=round(self._read_sum / wall, 3))
+                if starved:
+                    # remediation breadcrumb next to the detection: the
+                    # post-mortem timeline names the fix, not just the
+                    # symptom
+                    record_event(
+                        "data.starved", step=step_no,
+                        read_fraction=round(self._read_sum / wall, 3),
+                        hint=("input pipeline dominates the step: wrap "
+                              "the training iterator in "
+                              "data.AsyncDataSetIterator, or arm "
+                              "DL4J_TPU_AUTO_PREFETCH=1 to do it "
+                              "automatically"))
         if step_no == 1 or step_no % self.STEP_EVENT_EVERY == 0:
             record_event("train.step", step=step_no,
                          seconds=round(step_s, 6),
@@ -879,6 +896,7 @@ def _record_batch_transfer(batch):
 
 
 from deeplearning4j_tpu.data.dataset import as_batch_dict as _as_batch_dict  # noqa: E402
+from deeplearning4j_tpu.data.iterators import maybe_auto_prefetch as _maybe_auto_prefetch  # noqa: E402
 from deeplearning4j_tpu.resilience.cluster import touch_heartbeat as _touch_heartbeat  # noqa: E402
 from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector  # noqa: E402
 from deeplearning4j_tpu.runtime.distributed import note_step as _note_step  # noqa: E402
