@@ -1117,8 +1117,12 @@ def bench_robustness(peak, *, steps=96, batch_size=128, hidden=1024,
                      np.eye(8, dtype=np.float32), input_spec=spec((8,)),
                      mode="batched", max_batch_size=16,
                      devices=jax.devices()[:1])
+        # measure bare respawn MTTR: no circuit breaker, and no sentinel
+        # either — its always-on host sampler outlives the server (by
+        # design) and would wake 20x/s inside the <1% watchdog windows
+        # this config times NEXT (the sentinel plane has its own gate)
         srv = ModelServer(reg, slo_interval_s=3600.0,
-                          circuit_policy=None)  # measure bare respawn MTTR
+                          circuit_policy=None, sentinel=False)
         srv.start()
         stop = threading.Event()
         outcomes = []  # (t_monotonic, ok) from EVERY client thread
@@ -1701,6 +1705,160 @@ def bench_federation(peak, *, steps=96, batch_size=128, hidden=1024,
         shutil.rmtree(tmp_root, ignore_errors=True)
 
 
+def bench_sentinel(peak, *, steps=96, batch_size=128, hidden=1024,
+                   rounds=10, sampler_hz=20.0,
+                   production_tick_interval_s=10.0):
+    """Anomaly-sentinel benchmark (observability/sentinel + hostsampler):
+    what the ALWAYS-ON detection plane costs a running training step —
+    the layer that catches regressions must not be one.
+
+    Two priced components, gated together **< 2%** of step time:
+
+    - the **20 Hz host stack sampler**: armed-vs-bare instrumented
+      ``Trainer.fit`` step time with the sampler thread walking
+      ``sys._current_frames()`` at its always-on rate (adjacent-pair
+      drift cancellation, balanced lead order, GC off — the same
+      protocol every other sub-1% host gate here uses, since gen-2 GC
+      pauses alone dwarf the true cost);
+    - the **detector tick**: one full sentinel pass (registry JSON walk
+      + probes + baselines for all built-in detectors) over the LIVE
+      post-fit registry state, amortized at the production 10 s
+      cadence — the same amortization the diagnostics gate uses for
+      the SLO evaluator.
+
+    The per-sample cost of one stack walk is reported absolutely
+    (``sample_us``) so deployments with many threads can budget it.
+
+    ``peak`` (chip FLOPs) is unused: host-side overhead metrics.
+    """
+    import gc
+    from statistics import median as _median
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.config import (
+        NeuralNetConfiguration,
+        SequentialConfig,
+    )
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import SequentialModel
+    from deeplearning4j_tpu.observability.hostsampler import HostStackSampler
+    from deeplearning4j_tpu.observability.sentinel import (
+        Sentinel,
+        default_detectors,
+    )
+    from deeplearning4j_tpu.train.trainer import Trainer
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    prev_cost = os.environ.get("DL4J_TPU_STEP_COST_ANALYSIS")
+    # background step-cost compiles are scheduler noise orders above
+    # the cost this gate polices (same isolation as the other host gates)
+    os.environ["DL4J_TPU_STEP_COST_ANALYSIS"] = "0"
+    try:
+        model = SequentialModel(SequentialConfig(
+            net=NeuralNetConfiguration(updater=Sgd(0.05), seed=0),
+            layers=[Dense(units=hidden, activation="tanh"),
+                    OutputLayer(units=8, activation="softmax",
+                                loss="mcxent")],
+            input_shape=(32,),
+        ))
+        trainer = Trainer(model)
+        r = np.random.default_rng(0)
+        x = r.normal(size=(steps * batch_size, 32)).astype(np.float32)
+        y = np.eye(8, dtype=np.float32)[r.integers(0, 8, steps * batch_size)]
+
+        def fit_window():
+            data = ArrayDataSetIterator(x, y, batch_size=batch_size,
+                                        shuffle=False)
+            ts = trainer.init_state()
+            t0 = time.perf_counter()
+            ts = trainer.fit(ts, data, epochs=1)
+            # forced host materialization: the window must include the work
+            leaf = jax.tree_util.tree_leaves(ts.params)[0]
+            float(jax.device_get(leaf.ravel()[0]))
+            return time.perf_counter() - t0
+
+        fit_window()  # jit warmup
+
+        def armed_window():
+            sampler = HostStackSampler(hz=sampler_hz).start()
+            try:
+                return sampler, fit_window()
+            finally:
+                sampler.stop()
+
+        rounds += rounds % 2
+        round_diffs, bare_s, samples_seen = [], [], 0
+        gc.collect()
+        gc.disable()
+        try:
+            for i in range(rounds):
+                if i % 2 == 0:
+                    bm = fit_window()
+                    sampler, am = armed_window()
+                else:
+                    sampler, am = armed_window()
+                    bm = fit_window()
+                bare_s.append(bm)
+                samples_seen += sampler.samples_total
+                round_diffs.append((am - bm) / bm * 100.0)
+        finally:
+            gc.enable()
+        pair_diffs = [(round_diffs[k] + round_diffs[k + 1]) / 2.0
+                      for k in range(0, len(round_diffs), 2)]
+        sampler_pct = max(0.0, _median(pair_diffs))
+        bare_step_ms = _median(bare_s) / steps * 1e3
+
+        # absolute per-sample cost of one stack walk (off-thread caller
+        # exclusion does not change the walk cost)
+        probe = HostStackSampler()
+        probe.sample()  # warm the fold path
+        t0 = time.perf_counter()
+        for _ in range(200):
+            probe.sample()
+        sample_us = (time.perf_counter() - t0) / 200 * 1e6
+
+        # detector tick over the LIVE registry the fits populated, every
+        # built-in detector armed; amortized at the production cadence
+        sent = Sentinel(default_detectors())
+        sent.tick()  # warm lazy bundles / probe anchors
+        t0 = time.perf_counter()
+        for _ in range(50):
+            sent.tick()
+        tick_ms = (time.perf_counter() - t0) / 50 * 1e3
+        tick_pct = tick_ms / (production_tick_interval_s * 1e3) * 100.0
+
+        total_pct = sampler_pct + tick_pct
+        info = {
+            "rounds": rounds,
+            "steps": steps,
+            "sampler_hz": sampler_hz,
+            "bare_step_ms": round(bare_step_ms, 4),
+            "sampler_overhead_pct": round(sampler_pct, 3),
+            "sampler_samples_per_window": samples_seen // rounds,
+            "sample_us": round(sample_us, 2),
+            "detectors": len(sent.detectors),
+            "tick_ms": round(tick_ms, 3),
+            "tick_pct_at_10s": round(tick_pct, 4),
+            "always_on_overhead_pct": round(total_pct, 3),
+            # integrity gate: the whole always-on plane (20 Hz sampler +
+            # detector tick at the 10 s cadence) costs the training step
+            # < 2%
+            "gate_overhead_ok": bool(total_pct < 2.0),
+            "converged": bool(total_pct < 2.0 and samples_seen > 0),
+            "unit": "% step-time overhead, always-on sentinel plane",
+        }
+        info["value"] = round(total_pct, 3)
+        return info
+    finally:
+        if prev_cost is None:
+            os.environ.pop("DL4J_TPU_STEP_COST_ANALYSIS", None)
+        else:
+            os.environ["DL4J_TPU_STEP_COST_ANALYSIS"] = prev_cost
+
+
 _CONFIGS = {
     "bert": bench_bert,
     # Batch-size knee probe (no baseline row): how much of the remaining
@@ -1745,6 +1903,10 @@ _CONFIGS = {
     # shrink MTTR (kill -> first post-shrink step) and expand disruption
     # (pause at the checkpoint boundary), both gated < 5 s.
     "elastic": bench_elastic,
+    # Anomaly sentinel (observability/sentinel + hostsampler): the
+    # always-on detection plane's cost — 20 Hz host stack sampler +
+    # detector tick amortized at the 10 s cadence, gated < 2%/step.
+    "sentinel": bench_sentinel,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -1779,7 +1941,26 @@ _CPU_INTEGRITY = {
     # elastic reports "converged" = every round shrank AND re-expanded
     # with shrink MTTR and expand disruption inside their gates
     "elastic": dict(rounds=2),
+    # sentinel reports "converged" = the always-on plane (20 Hz host
+    # sampler + detector tick at the production cadence) costs the
+    # instrumented fit step < 2%
+    "sentinel": dict(steps=96, batch_size=128, hidden=1024, rounds=10),
 }
+
+
+def _quiesce_sentinel():
+    """Stop the process-global host sampler between configs: a serving
+    config's ModelServer starts it (by design it outlives the server),
+    and its 20 Hz wakeups are scheduler noise the later sub-1% paired
+    timing gates must not inherit. bench_sentinel builds its own."""
+    try:
+        from deeplearning4j_tpu.observability.hostsampler import (
+            set_host_sampler,
+        )
+
+        set_host_sampler(None)
+    except Exception:  # noqa: BLE001 - isolation is best-effort
+        pass
 
 
 def _cpu_evidence():
@@ -1793,6 +1974,7 @@ def _cpu_evidence():
     for name, kw in _CPU_INTEGRITY.items():
         info = {}
         try:
+            _quiesce_sentinel()
             info = _CONFIGS[name](None, **kw)
             ev[name] = {k: info[k] for k in
                         ("loss_first", "loss_last", "decreasing", "iters")
@@ -1837,7 +2019,7 @@ def main():
     ap.add_argument("--configs",
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
                             "serving,resilience,observability,robustness,"
-                            "federation,elastic",
+                            "federation,elastic,sentinel",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")
@@ -1893,6 +2075,7 @@ def main():
         if args.profile:
             _PROFILE_DIR = os.path.join(args.profile, name)
         try:
+            _quiesce_sentinel()
             info = _CONFIGS[name](peak)
             base = BASELINES.get(name)
             if base:

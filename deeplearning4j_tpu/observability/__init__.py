@@ -54,6 +54,20 @@ from deeplearning4j_tpu.observability.flightrecorder import (
     set_flight_recorder,
     set_recording,
 )
+from deeplearning4j_tpu.observability.hostsampler import (
+    HostStackSampler,
+    get_host_sampler,
+    set_host_sampler,
+)
+from deeplearning4j_tpu.observability.incidents import (
+    IncidentManager,
+    get_incident_manager,
+    incident_index,
+    register_profile_hook,
+    request_step_capture,
+    set_incident_manager,
+    unregister_profile_hook,
+)
 from deeplearning4j_tpu.observability.metrics import (
     COMPILE_BUCKETS,
     DEFAULT_LATENCY_BUCKETS,
@@ -79,6 +93,13 @@ from deeplearning4j_tpu.observability.runtime import (
     RuntimeCollector,
     get_runtime_collector,
     record_transfer,
+)
+from deeplearning4j_tpu.observability.sentinel import (
+    Detector,
+    Sentinel,
+    SentinelMetrics,
+    default_detectors,
+    get_sentinel_metrics,
 )
 from deeplearning4j_tpu.observability.slo import (
     DEFAULT_WINDOWS,
@@ -121,17 +142,22 @@ __all__ = [
     "ClusterMetrics",
     "ClusterTelemetryServer",
     "Counter",
+    "Detector",
     "FederatedRegistry",
     "FlightRecorder",
     "Gauge",
     "HealthEngine",
     "Histogram",
+    "HostStackSampler",
+    "IncidentManager",
     "MetricsRegistry",
     "ResilienceMetrics",
     "RuntimeCollector",
     "SLOMetrics",
     "SLORule",
     "Selector",
+    "Sentinel",
+    "SentinelMetrics",
     "Span",
     "TelemetryExporter",
     "Tracer",
@@ -139,6 +165,7 @@ __all__ = [
     "build_snapshot",
     "current_span",
     "default_cluster_rules",
+    "default_detectors",
     "default_registry",
     "default_serving_rules",
     "enabled",
@@ -153,11 +180,15 @@ __all__ = [
     "get_checkpoint_metrics",
     "get_default_engine",
     "get_flight_recorder",
+    "get_host_sampler",
+    "get_incident_manager",
     "get_resilience_metrics",
     "get_runtime_collector",
+    "get_sentinel_metrics",
     "get_slo_metrics",
     "get_tracer",
     "get_training_metrics",
+    "incident_index",
     "load_jsonl",
     "load_rules",
     "new_id",
@@ -165,14 +196,19 @@ __all__ = [
     "record_span",
     "record_transfer",
     "recording_enabled",
+    "register_profile_hook",
     "render_json_multi",
     "render_text_multi",
+    "request_step_capture",
     "reset_default_registry",
     "set_default_engine",
     "set_enabled",
     "set_flight_recorder",
+    "set_host_sampler",
+    "set_incident_manager",
     "set_recording",
     "set_tracing_enabled",
+    "unregister_profile_hook",
     "span",
     "to_chrome_trace",
     "tracing_enabled",

@@ -167,7 +167,8 @@ class _JsonHandler(BaseHTTPRequestHandler):
 def build_snapshot(*, extra_registries: Sequence = (),
                    flight_window_s: Optional[float] = None) -> dict:
     """The one-document export the aggregator consumes: identity +
-    metrics JSON + flight dump + span dump, self-describing."""
+    metrics JSON + flight dump + span dump + incident index,
+    self-describing."""
     ident = worker_identity()
     regs = [default_registry()] + list(extra_registries)
     return {
@@ -179,7 +180,21 @@ def build_snapshot(*, extra_registries: Sequence = (),
         "metrics": render_json_multi(regs),
         "flight": get_flight_recorder().dump(last_seconds=flight_window_s),
         "spans": [s.to_json() for s in _trace.get_tracer().spans()],
+        "incidents": _incident_index(),
     }
+
+
+def _incident_index() -> List[dict]:
+    """This worker's incident-bundle index (observability/incidents.py),
+    or [] — never creates a manager as a side effect, never raises."""
+    try:
+        from deeplearning4j_tpu.observability.incidents import (
+            incident_index,
+        )
+
+        return incident_index()
+    except Exception:  # noqa: BLE001 — telemetry never fails the worker
+        return []
 
 
 class TelemetryExporter:
@@ -368,6 +383,8 @@ class TelemetryExporter:
                     else:
                         self._send(200, {"spans": [s.to_json()
                                                    for s in spans]})
+                elif path == "/incidents":
+                    self._send(200, {"incidents": _incident_index()})
                 else:
                     self._send(404, {"error": f"no route {path}"})
 
@@ -651,6 +668,10 @@ def _sanitize_snapshot(snap: dict) -> dict:
         [d for d in spans if isinstance(d, dict)
          and all(k in d for k in ("name", "trace_id", "span_id"))]
         if isinstance(spans, list) else [])
+    incidents = snap.get("incidents")
+    snap["incidents"] = (
+        [d for d in incidents if isinstance(d, dict) and d.get("id")]
+        if isinstance(incidents, list) else [])
     return snap
 
 
@@ -1069,15 +1090,45 @@ class ClusterAggregator:
         return stitch_chrome_trace(self.worker_spans(),
                                    synthesize_roots=synthesize_roots)
 
+    def cluster_incidents(self) -> dict:
+        """Every worker's incident-bundle index, worker/generation-
+        stamped and merged (newest first) — the cohort's incident view
+        (``GET /cluster/debug/incidents``). Built from last-known
+        snapshots, so a dead worker's open incidents stay visible."""
+        with self._lock:
+            snaps = dict(self._snapshots)
+        rows: List[dict] = []
+        for wid, snap in sorted(snaps.items()):
+            for inc in snap.get("incidents", []):
+                rows.append(dict(inc, worker=wid,
+                                 generation=snap.get("generation", 1)))
+        def _opened(r):
+            # opened_at arrives over HTTP from version-skewed peers: a
+            # non-numeric value must sort low, never crash the cohort
+            # view (dossier() runs this during crash-report writing)
+            try:
+                return float(r.get("opened_at") or 0.0)
+            except (TypeError, ValueError):
+                return 0.0
+
+        rows.sort(key=lambda r: -_opened(r))
+        return {"workers": sorted(snaps), "count": len(rows),
+                "open": sum(1 for r in rows if r.get("state") == "open"),
+                "incidents": rows}
+
     def dossier(self) -> dict:
         """The cohort post-mortem bundle: worker table + merged
         timeline + every worker's LAST-KNOWN full snapshot (the dead
-        worker's final pre-crash state included). The supervisor writes
-        this into the crash report on cohort teardown."""
+        worker's final pre-crash state included) + the open incidents
+        the cohort was carrying at teardown. The supervisor writes this
+        into the crash report on cohort teardown."""
         with self._lock:
             snaps = dict(self._snapshots)
             table = self._workers_locked()
+        incidents = self.cluster_incidents()
         return {"workers": table, "timeline": self.cluster_timeline(),
+                "open_incidents": [r for r in incidents["incidents"]
+                                   if r.get("state") == "open"],
                 "snapshots": {str(w): s for w, s in sorted(snaps.items())}}
 
 
@@ -1187,6 +1238,8 @@ class ClusterTelemetryServer:
     - ``/cluster/debug/flightrecorder`` — merged ordered timeline
       (``?seconds=N`` trims);
     - ``/cluster/debug/trace`` — the stitched Perfetto document;
+    - ``/cluster/debug/incidents`` — every worker's incident-bundle
+      index merged (worker/generation-stamped, newest first);
     - ``/cluster/debug/health`` — the federated SLO engine's states
       (404 when no engine is attached);
     - ``/healthz``.
@@ -1231,6 +1284,8 @@ class ClusterTelemetryServer:
                     self._send(200, agg.cluster_timeline(seconds))
                 elif path == "/cluster/debug/trace":
                     self._send(200, agg.cluster_chrome_trace())
+                elif path == "/cluster/debug/incidents":
+                    self._send(200, agg.cluster_incidents())
                 elif path == "/cluster/debug/health":
                     if server.engine is None:
                         self._send(404, {"error": "no cluster health "

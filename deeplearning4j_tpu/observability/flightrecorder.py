@@ -97,9 +97,13 @@ class FlightRecorder:
             return len(self._events)
 
     def events(self, *, last_seconds: Optional[float] = None,
-               kinds: Optional[Iterable[str]] = None) -> List[dict]:
+               kinds: Optional[Iterable[str]] = None,
+               max_events: Optional[int] = None) -> List[dict]:
         """Snapshot of the ring, oldest first, optionally windowed to the
-        trailing ``last_seconds`` and filtered to ``kinds``."""
+        trailing ``last_seconds``, filtered to ``kinds``, and capped to
+        the NEWEST ``max_events`` (the incident pipeline bounds its
+        bundle artifact with this — when history is cut, it is the old
+        end that goes)."""
         with self._lock:
             snap = list(self._events)
         if last_seconds is not None:
@@ -108,12 +112,16 @@ class FlightRecorder:
         if kinds is not None:
             want = set(kinds)
             snap = [e for e in snap if e["kind"] in want]
+        if max_events is not None and len(snap) > max_events:
+            snap = snap[-max_events:]
         return snap
 
     def dump(self, last_seconds: Optional[float] = None,
-             kinds: Optional[Iterable[str]] = None) -> dict:
+             kinds: Optional[Iterable[str]] = None,
+             max_events: Optional[int] = None) -> dict:
         """The black-box dump: JSON-serializable, self-describing."""
-        evs = self.events(last_seconds=last_seconds, kinds=kinds)
+        evs = self.events(last_seconds=last_seconds, kinds=kinds,
+                          max_events=max_events)
         out = {
             "capacity": self.capacity,
             "dropped_total": self.dropped_total,

@@ -1,0 +1,278 @@
+"""Always-on host stack sampler: cheap continuous flame data.
+
+``/debug/profile`` captures the *device* side on demand; nothing
+captures the *host* side continuously — yet most production anomalies
+(a wedged data pipeline, a lock convoy in the serving path, a runaway
+background compile) live in host Python, and by the time a human
+attaches a profiler the anomalous seconds are gone. This module is the
+always-on answer: a daemon thread walking ``sys._current_frames()`` at
+a low default rate (~20 Hz), folding every thread's stack into bounded
+aggregated flame data the incident pipeline can snapshot the instant a
+detector fires.
+
+Design constraints, in order:
+
+- **idle-cheap**: one sample is a ``sys._current_frames()`` call plus a
+  frame walk per live thread — tens of microseconds for a typical
+  process. At 20 Hz that is well under 0.1% of a core (the ``sentinel``
+  bench config gates the whole always-on plane < 2% of step time).
+- **bounded**: stacks fold to ``module:function`` frames (no line
+  numbers — line-level detail explodes cardinality without aiding the
+  "where is the time going" question), depth-capped, and the aggregate
+  table caps distinct stacks; overflow folds into a counted
+  ``<overflow>`` bucket instead of growing without bound.
+- **armable**: :meth:`arm` raises the rate (default 200 Hz) for a
+  bounded window — the sentinel arms it when a detector turns
+  *suspect*, so by the time the detector *fires* the flame data over
+  the anomalous window is dense, then the rate decays back by itself.
+
+Export is the classic collapsed-stack format (``frame;frame;frame N``
+per line — flamegraph.pl / speedscope / pyspy-compatible), with the
+thread name as the root frame so one document shows every thread's
+flame side by side.
+
+Stdlib only; no jax, no registry requirement (the sampler feeds the
+sentinel metric bundle opportunistically when one exists).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_HZ = 20.0
+DEFAULT_ARMED_HZ = 200.0
+DEFAULT_MAX_DEPTH = 48
+DEFAULT_MAX_STACKS = 2048
+
+_OVERFLOW_KEY = "<overflow>"
+
+
+def fold_frame(frame, max_depth: int = DEFAULT_MAX_DEPTH) -> str:
+    """Fold one thread's live frame chain to ``mod:fn;mod:fn;...``
+    (root first). Modules render as their basename without extension —
+    ``module:function`` granularity keeps the table small and stable
+    across line-level code motion."""
+    parts: List[str] = []
+    depth = 0
+    while frame is not None and depth < max_depth:
+        code = frame.f_code
+        mod = os.path.splitext(os.path.basename(code.co_filename))[0]
+        parts.append(f"{mod}:{code.co_name}")
+        frame = frame.f_back
+        depth += 1
+    parts.reverse()  # root (outermost call) first: flamegraph convention
+    return ";".join(parts) if parts else "<no-frames>"
+
+
+class HostStackSampler:
+    """Bounded aggregating sampler over ``sys._current_frames()``.
+
+    ``hz``/``armed_hz``: the base and armed sampling rates.
+    ``max_depth``: frames kept per stack. ``max_stacks``: distinct
+    folded stacks held before overflow folding.
+    """
+
+    def __init__(self, *, hz: float = DEFAULT_HZ,
+                 armed_hz: float = DEFAULT_ARMED_HZ,
+                 max_depth: int = DEFAULT_MAX_DEPTH,
+                 max_stacks: int = DEFAULT_MAX_STACKS):
+        if hz <= 0 or armed_hz <= 0:
+            raise ValueError(f"hz/armed_hz must be > 0, got {hz}/{armed_hz}")
+        self.hz = float(hz)
+        self.armed_hz = float(armed_hz)
+        self.max_depth = int(max_depth)
+        self.max_stacks = int(max_stacks)
+        self._lock = threading.Lock()
+        # (thread_name, folded_stack) -> sample count
+        self._stacks: Dict[Tuple[str, str], int] = {}
+        self._samples_total = 0
+        self._overflow_total = 0
+        self._armed_until = 0.0
+        self._armed_hz_now = armed_hz
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(self) -> int:
+        """Take one sample of every live thread (the sampler's own
+        thread excluded); returns the number of stacks folded in."""
+        me = threading.get_ident()
+        frames = sys._current_frames()
+        # thread names resolve through the live thread table; a thread
+        # the table doesn't know (C-created) keeps its ident as name
+        names = {t.ident: t.name for t in threading.enumerate()}
+        folded = 0
+        with self._lock:
+            for ident, frame in frames.items():
+                if ident == me:
+                    continue
+                key = (str(names.get(ident, ident)),
+                       fold_frame(frame, self.max_depth))
+                if key not in self._stacks and \
+                        len(self._stacks) >= self.max_stacks:
+                    self._overflow_total += 1
+                    key = (key[0], _OVERFLOW_KEY)
+                    if key not in self._stacks and \
+                            len(self._stacks) >= self.max_stacks + 64:
+                        continue  # even overflow rows are bounded
+                self._stacks[key] = self._stacks.get(key, 0) + 1
+                folded += 1
+            self._samples_total += 1
+        self._feed_metrics()
+        return folded
+
+    def _feed_metrics(self):
+        """Opportunistically mirror the sampler's counters into the
+        sentinel metric bundle — guarded so the sampler works with no
+        registry at all (and survives registry resets mid-sample)."""
+        try:
+            from deeplearning4j_tpu.observability import metrics as _m
+
+            if not _m.enabled():
+                return
+            from deeplearning4j_tpu.observability.sentinel import (
+                get_sentinel_metrics,
+            )
+
+            sm = get_sentinel_metrics()
+            sm.hostsampler_samples_total.inc()
+            with self._lock:
+                n = len(self._stacks)
+            sm.hostsampler_stacks.set(float(n))
+        except Exception:  # noqa: BLE001 — telemetry never fails the sampler
+            pass
+
+    # -- arming --------------------------------------------------------------
+
+    def arm(self, seconds: float, hz: Optional[float] = None) -> None:
+        """Raise the sampling rate to ``hz`` (default ``armed_hz``) for
+        ``seconds``; extends (never shortens) an existing window. The
+        sentinel calls this when a detector turns suspect, so the flame
+        data over the anomalous window is dense by firing time."""
+        until = time.monotonic() + max(0.0, float(seconds))
+        with self._lock:
+            self._armed_until = max(self._armed_until, until)
+            self._armed_hz_now = float(hz) if hz else self.armed_hz
+        self._wake.set()  # re-evaluate the sleep interval now
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._armed_until
+
+    def current_hz(self) -> float:
+        with self._lock:
+            if time.monotonic() < self._armed_until:
+                return self._armed_hz_now
+        return self.hz
+
+    # -- export --------------------------------------------------------------
+
+    @property
+    def samples_total(self) -> int:
+        with self._lock:
+            return self._samples_total
+
+    def collapsed(self) -> str:
+        """The aggregate as collapsed-stack text: one
+        ``thread;frame;frame count`` line per distinct (thread, stack),
+        highest counts first — flamegraph.pl / speedscope ready."""
+        with self._lock:
+            rows = sorted(self._stacks.items(), key=lambda kv: -kv[1])
+        return "\n".join(
+            f"{name};{stack} {count}" for (name, stack), count in rows)
+
+    def dump(self) -> dict:
+        """JSON-serializable summary + the collapsed document (what the
+        incident bundle embeds)."""
+        with self._lock:
+            n_stacks = len(self._stacks)
+            threads = sorted({name for name, _ in self._stacks})
+            samples = self._samples_total
+            overflow = self._overflow_total
+            armed = time.monotonic() < self._armed_until
+        return {
+            "hz": self.hz, "armed_hz": self.armed_hz, "armed": armed,
+            "samples_total": samples, "unique_stacks": n_stacks,
+            "max_stacks": self.max_stacks,
+            "overflow_samples_total": overflow,
+            "threads": threads,
+            "collapsed": self.collapsed(),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stacks.clear()
+            self._samples_total = 0
+            self._overflow_total = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "HostStackSampler":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="host-stack-sampler")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 — the sampler must survive
+                pass           # interpreter-state races; next tick retries
+            self._wake.wait(1.0 / self.current_hz())
+            self._wake.clear()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "HostStackSampler":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+# -- process-global sampler ---------------------------------------------------
+
+_SAMPLER: Optional[HostStackSampler] = None
+_sampler_lock = threading.Lock()
+
+
+def get_host_sampler(*, start: bool = False) -> HostStackSampler:
+    """The process sampler (created lazily, NOT started unless asked —
+    ``ModelServer.start`` and the sentinel pass ``start=True``)."""
+    global _SAMPLER
+    with _sampler_lock:
+        if _SAMPLER is None:
+            _SAMPLER = HostStackSampler()
+        s = _SAMPLER
+    if start:
+        s.start()
+    return s
+
+
+def set_host_sampler(s: Optional[HostStackSampler]) -> None:
+    """Swap the process sampler (tests); the old one is stopped."""
+    global _SAMPLER
+    with _sampler_lock:
+        old, _SAMPLER = _SAMPLER, s
+    if old is not None and old is not s:
+        old.stop()

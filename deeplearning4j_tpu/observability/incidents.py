@@ -1,0 +1,617 @@
+"""Incident bundles: auto-captured, self-contained post-mortems.
+
+When a sentinel detector fires, the evidence that explains it — the
+anomalous steps, the queue state, the stacks burning the time — exists
+for seconds. A human curling ``/debug/profile`` afterwards captures the
+*recovery*, not the anomaly. This module captures evidence AT firing
+time, automatically, into one bounded on-disk bundle:
+
+``<incidents_dir>/<incident-id>/``
+
+- ``incident.json``       — manifest: detector, open/close times, state,
+  artifact table (the fetch surface's index row);
+- ``verdict.json``        — the detector's judgement: observed sample vs
+  rolling baseline (median/MAD), score, thresholds, transition history;
+- ``metrics.prom`` / ``metrics.json`` — full registry scrape at firing;
+- ``flightrecorder.json`` — the black-box event ring (bounded window);
+- ``spans.json``          — the most recent finished spans;
+- ``flames.txt`` (+ meta in the manifest) — the host stack sampler's
+  collapsed flame data (dense over the anomaly: the sentinel armed the
+  high-rate window at *suspect*);
+- ``profile.json``        — asynchronous: when a profile hook is
+  registered (ModelServer registers a live-traffic ``jax.profiler``
+  capture; ``Trainer.fit`` registers a capture of the *next N steps*),
+  a short device capture lands here moments after the bundle opens.
+
+The bundle directory is staged under a dot-prefixed temp name and
+renamed into place, so a reader listing the incidents dir never sees a
+half-written bundle. Retention is bounded (``max_bundles``; oldest
+closed bundles pruned first). Every open/close is a flight event
+(``incident.open`` / ``incident.close``) and counts in
+``incident_bundles_total{detector=}`` / ``incidents_open``.
+
+Consumers: ``GET /debug/incidents`` (index) and
+``GET /debug/incidents/<id>`` (full bundle) on ``ModelServer``; the
+federation snapshot carries each worker's index so
+``GET /cluster/debug/incidents`` shows the cohort view and cohort
+teardown dossiers reference open incidents.
+
+Stdlib only (jax is touched only inside the step-capture path, lazily).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability import trace as _trace
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+    record_event,
+)
+
+_ID_SAFE_RE = re.compile(r"[^A-Za-z0-9_.\-]+")
+# incident ids are path components served back over HTTP: the fetch
+# route must only ever resolve names this shape (no separators, no dots
+# leading) — belt and suspenders against traversal
+INCIDENT_ID_RE = re.compile(r"^inc-[0-9]{13}-[0-9]{3}-[A-Za-z0-9_.\-]+$")
+
+ENV_INCIDENT_DIR = "DL4J_TPU_INCIDENT_DIR"
+
+
+def _sentinel_metrics():
+    try:
+        if not _metrics.enabled():
+            return None
+        from deeplearning4j_tpu.observability.sentinel import (
+            get_sentinel_metrics,
+        )
+
+        return get_sentinel_metrics()
+    except Exception:  # noqa: BLE001 — metrics never fail the pipeline
+        return None
+
+
+class IncidentManager:
+    """Owns one incidents directory: bundle writes, retention, index.
+
+    ``max_bundles`` bounds disk (oldest closed incidents pruned first —
+    an open incident is live evidence and survives pruning unless
+    everything else is open too). ``flight_window_s`` /
+    ``max_flight_events`` / ``span_limit`` bound the bundle's artifact
+    sizes; ``profile_timeout_s`` bounds how long the async profile
+    thread waits on a hook.
+    """
+
+    def __init__(self, dir, *, max_bundles: int = 16,
+                 flight_window_s: float = 180.0,
+                 max_flight_events: int = 2048,
+                 span_limit: int = 512,
+                 profile_timeout_s: float = 60.0):
+        if max_bundles < 1:
+            raise ValueError(f"max_bundles must be >= 1, got {max_bundles}")
+        self.dir = Path(dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.max_bundles = int(max_bundles)
+        self.flight_window_s = float(flight_window_s)
+        self.max_flight_events = int(max_flight_events)
+        self.span_limit = int(span_limit)
+        self.profile_timeout_s = float(profile_timeout_s)
+        self._lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._manifests: Dict[str, dict] = {}
+        self._load_existing()
+
+    # -- disk ----------------------------------------------------------------
+
+    def _load_existing(self):
+        """Adopt bundles already on disk (a restarted process keeps
+        serving its previous incidents; stale 'open' ones from a dead
+        process read as open until pruned)."""
+        for p in sorted(self.dir.glob("inc-*/incident.json")):
+            try:
+                man = json.loads(p.read_text())
+                if isinstance(man, dict) and man.get("id"):
+                    self._manifests[man["id"]] = man
+            except (OSError, ValueError):
+                continue
+
+    def _write_manifest(self, bundle_dir: Path, manifest: dict):
+        tmp = bundle_dir / ".incident.json.tmp"
+        tmp.write_text(json.dumps(manifest, indent=2, default=str))
+        os.replace(tmp, bundle_dir / "incident.json")
+
+    def _update_open_gauge(self):
+        sm = _sentinel_metrics()
+        if sm is not None:
+            sm.incidents_open.set(float(sum(
+                1 for m in self._manifests.values()
+                if m.get("state") == "open")))
+
+    # -- open ----------------------------------------------------------------
+
+    def open_incident(self, verdict: dict, *,
+                      registries: Optional[Sequence] = None,
+                      sampler=None, profile: bool = True) -> str:
+        """Capture + write one bundle; returns the incident id. The
+        synchronous artifacts land atomically (staged dir, renamed into
+        place); the device profile (if any hook is registered) is
+        captured on a background thread and added to the final dir —
+        it is a capture of the *next* steps/requests by definition."""
+        detector = _ID_SAFE_RE.sub("-", str(
+            verdict.get("detector", "unknown"))) or "unknown"
+        opened_at = time.time()
+        with self._lock:
+            iid = f"inc-{int(opened_at * 1000):013d}-" \
+                  f"{next(self._seq) % 1000:03d}-{detector}"
+        regs = (list(registries) if registries is not None
+                else [_metrics.default_registry()])
+        flight = get_flight_recorder().dump(
+            last_seconds=self.flight_window_s,
+            max_events=self.max_flight_events)
+        spans = [s.to_json()
+                 for s in _trace.get_tracer().spans()[-self.span_limit:]]
+        flames = sampler.dump() if sampler is not None else None
+        hooks = profile_hooks() if profile else {}
+
+        staging = self.dir / f".staging-{iid}"
+        staging.mkdir(parents=True, exist_ok=True)
+        try:
+            (staging / "verdict.json").write_text(
+                json.dumps(verdict, indent=2, default=str))
+            try:
+                (staging / "metrics.prom").write_text(
+                    _metrics.render_text_multi(regs))
+                (staging / "metrics.json").write_text(
+                    json.dumps(_metrics.render_json_multi(regs),
+                               default=str))
+            except Exception as e:  # noqa: BLE001 — a bad registry must
+                (staging / "metrics.prom").write_text(  # not lose the rest
+                    f"# scrape failed: {e}\n")
+                (staging / "metrics.json").write_text(
+                    json.dumps({"error": str(e)[:200]}))
+            (staging / "flightrecorder.json").write_text(
+                json.dumps(flight, default=str))
+            (staging / "spans.json").write_text(
+                json.dumps({"count": len(spans), "spans": spans},
+                           default=str))
+            (staging / "flames.txt").write_text(
+                (flames or {}).get("collapsed", ""))
+            manifest = {
+                "id": iid,
+                "detector": verdict.get("detector"),
+                "state": "open",
+                "opened_at": opened_at,
+                "closed_at": None,
+                "score": verdict.get("score"),
+                "observed": verdict.get("observed"),
+                "baseline": verdict.get("baseline"),
+                "profile": ("pending" if hooks else "none"),
+                "profile_hooks": sorted(hooks),
+                "sampler": ({k: v for k, v in flames.items()
+                             if k != "collapsed"}
+                            if flames is not None else None),
+                "artifacts": ["verdict.json", "metrics.prom",
+                              "metrics.json", "flightrecorder.json",
+                              "spans.json", "flames.txt"],
+            }
+            self._write_manifest(staging, manifest)
+            final = self.dir / iid
+            os.rename(staging, final)
+        except Exception:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        with self._lock:
+            self._manifests[iid] = manifest
+            self._prune_locked()
+            self._update_open_gauge()
+        sm = _sentinel_metrics()
+        if sm is not None:
+            sm.incident_bundles_total.inc(
+                detector=str(verdict.get("detector", "unknown")))
+        record_event("incident.open", id=iid,
+                     detector=verdict.get("detector"),
+                     score=verdict.get("score"),
+                     observed=verdict.get("observed"))
+        if hooks:
+            threading.Thread(
+                target=self._capture_profile, args=(iid, dict(hooks)),
+                daemon=True, name=f"incident-profile-{iid[-8:]}").start()
+        return iid
+
+    def _capture_profile(self, iid: str, hooks: Dict[str, Callable]):
+        """Run every registered profile hook (sequentially: jax has one
+        global profiler session) and attach the results to the bundle.
+        Each hook gets at most ``profile_timeout_s``: a hung hook must
+        not leave the bundle's profile pending forever, and the built-in
+        hooks tolerate an abandoned waiter (they clean up their own
+        profiler session on the owning thread)."""
+        results = {}
+        for name, fn in sorted(hooks.items()):
+            box: dict = {}
+
+            def _run(fn=fn, box=box):
+                try:
+                    box["result"] = fn()
+                except Exception as e:  # noqa: BLE001 — one failed hook
+                    box["result"] = {"available": False,  # is a recorded
+                                     "reason": str(e)[:300]}  # outcome
+
+            t = threading.Thread(target=_run, daemon=True,
+                                 name=f"incident-hook-{name}")
+            t.start()
+            t.join(self.profile_timeout_s)
+            if t.is_alive():
+                results[name] = {
+                    "available": False,
+                    "reason": ("hook did not return within "
+                               f"{self.profile_timeout_s:g}s")}
+            else:
+                results[name] = box["result"]
+        bundle_dir = self.dir / iid
+        with self._lock:
+            man = self._manifests.get(iid)
+            if man is None or not bundle_dir.is_dir():
+                return  # pruned while capturing
+            try:
+                tmp = bundle_dir / ".profile.json.tmp"
+                tmp.write_text(json.dumps(
+                    {"captured_at": time.time(), "captures": results},
+                    default=str))
+                os.replace(tmp, bundle_dir / "profile.json")
+                man["profile"] = "done"
+                man.setdefault("artifacts", []).append("profile.json")
+                self._write_manifest(bundle_dir, man)
+            except OSError:
+                man["profile"] = "failed"
+
+    # -- close / retention ---------------------------------------------------
+
+    def close_incident(self, incident_id: str,
+                       resolution: Optional[dict] = None) -> bool:
+        """Mark an incident closed (idempotent); returns True when it
+        transitioned open→closed."""
+        with self._lock:
+            man = self._manifests.get(incident_id)
+            if man is None or man.get("state") == "closed":
+                return False
+            man["state"] = "closed"
+            man["closed_at"] = time.time()
+            man["duration_s"] = round(
+                man["closed_at"] - float(man.get("opened_at", 0.0)), 3)
+            bundle_dir = self.dir / incident_id
+            try:
+                if resolution is not None:
+                    (bundle_dir / "resolution.json").write_text(
+                        json.dumps(resolution, indent=2, default=str))
+                    if "resolution.json" not in man.get("artifacts", []):
+                        man.setdefault("artifacts",
+                                       []).append("resolution.json")
+                self._write_manifest(bundle_dir, man)
+            except OSError:
+                pass
+            self._update_open_gauge()
+        record_event("incident.close", id=incident_id,
+                     detector=man.get("detector"),
+                     duration_s=man.get("duration_s"))
+        return True
+
+    def _prune_locked(self):
+        """Drop the oldest bundles beyond ``max_bundles`` (closed first;
+        open ones only when everything remaining is open)."""
+        if len(self._manifests) <= self.max_bundles:
+            return
+        by_age = sorted(self._manifests.values(),
+                        key=lambda m: (m.get("state") == "open",
+                                       m.get("opened_at", 0.0)))
+        excess = len(self._manifests) - self.max_bundles
+        for man in by_age[:excess]:
+            iid = man["id"]
+            self._manifests.pop(iid, None)
+            shutil.rmtree(self.dir / iid, ignore_errors=True)
+
+    # -- read surface --------------------------------------------------------
+
+    def index(self) -> List[dict]:
+        """Compact manifest rows, newest first — the ``/debug/incidents``
+        list and the federation snapshot's per-worker incident index."""
+        with self._lock:
+            rows = sorted(self._manifests.values(),
+                          key=lambda m: -float(m.get("opened_at", 0.0)))
+            return [{k: m.get(k) for k in
+                     ("id", "detector", "state", "opened_at", "closed_at",
+                      "duration_s", "score", "observed", "profile")}
+                    for m in rows]
+
+    def open_count(self) -> int:
+        with self._lock:
+            return sum(1 for m in self._manifests.values()
+                       if m.get("state") == "open")
+
+    def get(self, incident_id: str) -> Optional[dict]:
+        """The full bundle — manifest plus every artifact inline (JSON
+        artifacts parsed, text artifacts as strings)."""
+        if not INCIDENT_ID_RE.match(incident_id or ""):
+            return None
+        with self._lock:
+            man = self._manifests.get(incident_id)
+            if man is None:
+                return None
+            man = dict(man)
+        bundle_dir = self.dir / incident_id
+        out = {"manifest": man, "artifacts": {}}
+        for name in man.get("artifacts", []):
+            path = bundle_dir / name
+            try:
+                text = path.read_text()
+            except OSError:
+                out["artifacts"][name] = None
+                continue
+            if name.endswith(".json"):
+                try:
+                    out["artifacts"][name] = json.loads(text)
+                except ValueError:
+                    out["artifacts"][name] = text
+            else:
+                out["artifacts"][name] = text
+        return out
+
+
+# -- process-global manager ---------------------------------------------------
+
+_MANAGER: Optional[IncidentManager] = None
+_manager_lock = threading.Lock()
+
+
+def get_incident_manager(create: bool = False) -> Optional[IncidentManager]:
+    """The process incident manager. ``create=True`` makes one when none
+    exists: directory from ``DL4J_TPU_INCIDENT_DIR`` or a per-process
+    temp dir (bounded retention keeps it small either way)."""
+    global _MANAGER
+    with _manager_lock:
+        if _MANAGER is None and create:
+            import tempfile
+
+            d = os.environ.get(ENV_INCIDENT_DIR) or os.path.join(
+                tempfile.gettempdir(), f"dl4j-tpu-incidents-{os.getpid()}")
+            _MANAGER = IncidentManager(d)
+        return _MANAGER
+
+
+def set_incident_manager(mgr: Optional[IncidentManager]) -> None:
+    global _MANAGER
+    with _manager_lock:
+        _MANAGER = mgr
+
+
+def incident_index() -> List[dict]:
+    """The process's incident index, or [] — what the federation
+    snapshot embeds (never creates a manager as a side effect)."""
+    mgr = get_incident_manager()
+    if mgr is None:
+        return []
+    try:
+        return mgr.index()
+    except Exception:  # noqa: BLE001 — telemetry never fails the caller
+        return []
+
+
+# -- profile hooks ------------------------------------------------------------
+
+_PROFILE_HOOKS: Dict[str, Callable[[], dict]] = {}
+_hooks_lock = threading.Lock()
+
+
+def register_profile_hook(name: str, fn: Callable[[], dict]) -> None:
+    """Register a device-capture hook the incident pipeline runs right
+    after a bundle opens. The hook returns a JSON-serializable dict
+    (``{"available": bool, ...}``). Last registration per name wins."""
+    with _hooks_lock:
+        _PROFILE_HOOKS[name] = fn
+
+
+def unregister_profile_hook(name: str, fn: Optional[Callable] = None) -> None:
+    """Remove a hook; with ``fn`` given, only when it is still the
+    registered one (a stopped server must not unhook its successor).
+    Equality, not identity: bound methods are re-created per attribute
+    access, so ``server._hook is server._hook`` is False while ``==``
+    compares the underlying (instance, function) pair."""
+    with _hooks_lock:
+        if fn is None or _PROFILE_HOOKS.get(name) == fn:
+            _PROFILE_HOOKS.pop(name, None)
+
+
+def profile_hooks() -> Dict[str, Callable[[], dict]]:
+    with _hooks_lock:
+        return dict(_PROFILE_HOOKS)
+
+
+# -- train-side step capture --------------------------------------------------
+#
+# The serving hook captures by wall time (live traffic keeps the device
+# busy); training wants "the next N steps" — the capture must start and
+# stop on step boundaries inside the fit loop. The fit loop calls
+# note_train_step() once per iteration (a no-op global check when no
+# capture is pending); request_step_capture() is called from the
+# incident profile thread and blocks until the capture completes or
+# times out.
+
+
+class _StepCapture:
+    def __init__(self, n_steps: int):
+        self.n_steps = int(n_steps)
+        self.done = threading.Event()
+        self.result: dict = {"available": False, "reason": "not started"}
+        self.abandoned = False
+        self._started = False
+        self._dir: Optional[str] = None
+        self._t0 = 0.0
+        self._steps = 0
+
+    def abort(self, reason: str) -> None:
+        """Tear down a capture that will never complete — the waiter
+        timed out or the fit loop ended. MUST run on the fit thread (the
+        thread driving ``on_step``), so a live ``jax.profiler`` session
+        is stopped by the same thread that started it and can never be
+        left open to wedge every future capture in the process."""
+        if self._started and not self.done.is_set():
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        if not self.done.is_set():
+            self.result = {"available": False, "reason": reason}
+            self.done.set()
+
+    def on_step(self):
+        import glob
+        import tempfile
+
+        import jax
+
+        if not self._started:
+            self._dir = tempfile.mkdtemp(prefix="dl4j-tpu-incident-steps-")
+            try:
+                jax.profiler.start_trace(self._dir)
+            except Exception as e:  # noqa: BLE001 — e.g. another capture
+                self.result = {"available": False,       # holds the session
+                               "reason": f"profiler busy: {e}"[:300]}
+                self.done.set()
+                raise _CaptureFinished()
+            self._t0 = time.monotonic()
+            self._started = True
+            return
+        self._steps += 1
+        if self._steps < self.n_steps:
+            return
+        wall_ms = (time.monotonic() - self._t0) * 1000.0
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            self.result = {"available": False, "reason": str(e)[:300]}
+            self.done.set()
+            raise _CaptureFinished()
+        hits = sorted(glob.glob(os.path.join(
+            self._dir, "**", "*.trace.json.gz"), recursive=True),
+            key=os.path.getmtime)
+        self.result = {
+            "available": bool(hits), "kind": "train_steps",
+            "steps": self._steps, "duration_ms": round(wall_ms, 1),
+            "trace_dir": self._dir,
+            "trace_file": hits[-1] if hits else None,
+            "trace_bytes": (os.path.getsize(hits[-1]) if hits else 0),
+        }
+        if not hits:
+            self.result["reason"] = "profiler produced no trace file"
+        self.done.set()
+        raise _CaptureFinished()
+
+
+class _CaptureFinished(Exception):
+    pass
+
+
+_TRAIN_CAPTURE: Optional[_StepCapture] = None
+_train_lock = threading.Lock()
+_TRAIN_FIT_DEPTH = 0
+
+
+def enter_training() -> None:
+    """Called by ``Trainer.fit`` on entry: marks live training and
+    auto-registers the ``train`` profile hook (capture of the next N
+    steps) the first time."""
+    global _TRAIN_FIT_DEPTH
+    with _train_lock:
+        _TRAIN_FIT_DEPTH += 1
+    register_profile_hook("train", _train_profile_hook)
+
+
+def exit_training() -> None:
+    global _TRAIN_FIT_DEPTH, _TRAIN_CAPTURE
+    cap = None
+    with _train_lock:
+        _TRAIN_FIT_DEPTH = max(0, _TRAIN_FIT_DEPTH - 1)
+        if _TRAIN_FIT_DEPTH == 0 and _TRAIN_CAPTURE is not None:
+            cap, _TRAIN_CAPTURE = _TRAIN_CAPTURE, None
+    if cap is not None:
+        # fit ended mid-capture: stop a live trace (this runs on the fit
+        # thread) and fail the waiter fast instead of letting it burn
+        # its full timeout
+        cap.abort("training ended before the capture completed")
+
+
+def training_active() -> bool:
+    return _TRAIN_FIT_DEPTH > 0
+
+
+def note_train_step() -> None:
+    """Per-step hook in ``Trainer.fit``. Fast path: one global load and
+    None check. When a capture is pending, starts/advances/stops the
+    ``jax.profiler`` trace on step boundaries."""
+    global _TRAIN_CAPTURE
+    cap = _TRAIN_CAPTURE
+    if cap is None:
+        return
+    if cap.abandoned:
+        # the waiter gave up: stop any live trace from the fit thread
+        # (never leave the global profiler session open) and clear
+        cap.abort("capture abandoned by its waiter")
+        with _train_lock:
+            if _TRAIN_CAPTURE is cap:
+                _TRAIN_CAPTURE = None
+        return
+    try:
+        cap.on_step()
+    except _CaptureFinished:
+        with _train_lock:
+            if _TRAIN_CAPTURE is cap:
+                _TRAIN_CAPTURE = None
+    except Exception as e:  # noqa: BLE001 — capture must never kill a fit
+        cap.result = {"available": False, "reason": str(e)[:300]}
+        cap.done.set()
+        with _train_lock:
+            if _TRAIN_CAPTURE is cap:
+                _TRAIN_CAPTURE = None
+
+
+def request_step_capture(n_steps: int = 8,
+                         timeout_s: float = 30.0) -> dict:
+    """Arm a device capture of the next ``n_steps`` training steps and
+    wait (bounded) for it; returns the capture document. Unavailable
+    fast when no fit loop is live or a capture is already pending."""
+    global _TRAIN_CAPTURE
+    cap = _StepCapture(n_steps)
+    with _train_lock:
+        # depth check must share the install's critical section: a fit
+        # exiting between them would strand a capture no thread will
+        # ever service (exit_training aborts under this same lock)
+        if _TRAIN_FIT_DEPTH <= 0:
+            return {"available": False,
+                    "reason": "no training loop is live"}
+        if _TRAIN_CAPTURE is not None:
+            return {"available": False,
+                    "reason": "a step capture is already pending"}
+        _TRAIN_CAPTURE = cap
+    if not cap.done.wait(timeout_s):
+        # do NOT clear _TRAIN_CAPTURE here: a trace the fit thread
+        # started must be stopped by the fit thread (next step or fit
+        # exit), or the leaked global profiler session would wedge
+        # every future capture in the process
+        cap.abandoned = True
+        return {"available": False,
+                "reason": f"capture did not complete within {timeout_s:g}s"}
+    return cap.result
+
+
+def _train_profile_hook() -> dict:
+    return request_step_capture()

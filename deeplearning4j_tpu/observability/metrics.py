@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _INF = float("inf")
@@ -167,7 +168,13 @@ class Histogram(_Instrument):
         return (self.name, f"{self.name}_bucket", f"{self.name}_sum",
                 f"{self.name}_count")
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, *, exemplar_trace_id: Optional[str] = None,
+                **labels):
+        """Record one observation. ``exemplar_trace_id`` (OpenMetrics-
+        style exemplars) keeps the LAST exemplar per bucket — a slow
+        bucket in the scrape links straight to a trace id that actually
+        landed in it (the serving path passes the request's correlation
+        id)."""
         key = self._key(labels)
         with self._lock:
             st = self._data.get(key)
@@ -177,6 +184,10 @@ class Histogram(_Instrument):
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     st["counts"][i] += 1
+                    if exemplar_trace_id is not None:
+                        st.setdefault("exemplars", {})[i] = (
+                            str(exemplar_trace_id), float(value),
+                            time.time())
                     break
             st["sum"] += float(value)
             st["n"] += 1
@@ -195,11 +206,20 @@ class Histogram(_Instrument):
         with self._lock:
             for key, st in sorted(self._data.items()):
                 cum = 0
-                for b, c in zip(self.buckets, st["counts"]):
+                exemplars = st.get("exemplars", {})
+                for i, (b, c) in enumerate(zip(self.buckets, st["counts"])):
                     cum += c
                     le = 'le="%s"' % _fmt(b)
-                    lines.append(
-                        f"{self.name}_bucket{self._label_str(key, le)} {cum}")
+                    line = f"{self.name}_bucket{self._label_str(key, le)} {cum}"
+                    ex = exemplars.get(i)
+                    if ex is not None:
+                        # OpenMetrics exemplar suffix on the bucket the
+                        # observation landed in:
+                        #   ... # {trace_id="<id>"} <value> <timestamp>
+                        tid, val, ts = ex
+                        line += (f' # {{trace_id="{_esc_label(tid)}"}} '
+                                 f"{_fmt(val)} {repr(round(ts, 3))}")
+                    lines.append(line)
                 lines.append(f"{self.name}_sum{self._label_str(key)} "
                              f"{_fmt(st['sum'])}")
                 lines.append(f"{self.name}_count{self._label_str(key)} "
@@ -214,9 +234,16 @@ class Histogram(_Instrument):
                 for b, c in zip(self.buckets, st["counts"]):
                     cum += c
                     bucket_map[_fmt(b)] = cum
-                samples.append({"labels": dict(zip(self.labelnames, key)),
-                                "sum": st["sum"], "count": st["n"],
-                                "buckets": bucket_map})
+                sample = {"labels": dict(zip(self.labelnames, key)),
+                          "sum": st["sum"], "count": st["n"],
+                          "buckets": bucket_map}
+                if st.get("exemplars"):
+                    sample["exemplars"] = {
+                        _fmt(self.buckets[i]): {"trace_id": tid,
+                                                "value": val, "t": ts}
+                        for i, (tid, val, ts)
+                        in sorted(st["exemplars"].items())}
+                samples.append(sample)
         return {"name": self.name, "type": self.kind, "help": self.help,
                 "samples": samples}
 

@@ -1,0 +1,812 @@
+"""Performance anomaly sentinel: rolling baselines → detectors → incidents.
+
+The SLO engine (slo.py) answers "are we breaking our promises?" against
+*declared* objectives; nothing answers "did behavior change?" when no
+objective exists — a step that quietly got 40% slower, a p99 that
+doubled but still clears the rule, a recompile storm, HBM creeping
+toward OOM. This module is the change detector:
+
+- **probes** extract one scalar sample per evaluator tick from the live
+  metric registries (counter rates, histogram mean/quantile deltas,
+  gauge values) — deltas, not cumulative values, so a long-lived
+  process's history can't dilute a fresh regression;
+- a **rolling baseline** per detector (windowed median + MAD over the
+  accepted samples — robust statistics, so the baseline itself ignores
+  outliers) turns each sample into a robust z-score;
+- an **ok → suspect → firing** state machine with hysteresis: one
+  anomalous sample makes a detector *suspect* (and arms the host stack
+  sampler's high-rate window), only ``fire_after`` consecutive
+  anomalous samples make it *fire*, and only ``clear_after`` clean
+  samples close it — a single jittery tick can neither page nor flap.
+  While suspect/firing the baseline is FROZEN, so the anomaly can't
+  teach itself into the baseline and self-resolve;
+- on firing the sentinel opens an **incident bundle** (incidents.py):
+  detector verdict + registry scrape + flight dump + span slice + host
+  flames + (hook-provided) device profile, atomically on disk — the
+  first capture happens DURING the anomaly, not after a human notices.
+
+Built-in detectors (:func:`default_detectors`): train step-time
+regression, serving p99 regression, recompile storm, admission queue
+buildup, data starvation, live-array-bytes / HBM monotonic growth
+(leak heuristic).
+
+Everything is scrapeable: ``anomaly_state{detector=}`` /
+``anomaly_score{detector=}`` gauges, ``anomaly_transitions_total``,
+``sentinel_ticks_total`` + ``anomaly_firing_ticks_total`` (the
+``anomaly-firing`` burn-rate rule's total/bad pair), and
+``incident_bundles_total{detector=}`` from the incident pipeline.
+
+The evaluator follows slo.py's :class:`HealthEngine` pattern: a
+background daemon thread, ``tick()`` callable on demand under one lock,
+registries resolved per tick, injectable clock for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.observability.slo import (
+    _doc_map,
+    _parse_bound,
+)
+
+STATE_OK = "ok"
+STATE_SUSPECT = "suspect"
+STATE_FIRING = "firing"
+_STATE_NUM = {STATE_OK: 0, STATE_SUSPECT: 1, STATE_FIRING: 2}
+
+# robust-z scale: MAD * 1.4826 estimates sigma for normal data
+_MAD_SIGMA = 1.4826
+
+
+# -- probes: families doc -> one scalar sample per tick -----------------------
+
+
+class Probe:
+    """One stateful sample extractor. ``sample(families)`` returns the
+    tick's scalar or None when this tick carries no information for the
+    detector (no new observations, counter reset, family absent)."""
+
+    def sample(self, families: Dict[str, dict],
+               t: Optional[float] = None) -> Optional[float]:
+        """``t`` is the tick's clock reading (the Sentinel's injectable
+        clock) so rate probes stay deterministic under a test clock;
+        None falls back to ``time.monotonic()``."""
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {"probe": type(self).__name__}
+
+
+def _match(labels: dict, match: Tuple[Tuple[str, str], ...]) -> bool:
+    return all(str(labels.get(k, "")) == v for k, v in match)
+
+
+class CounterRateProbe(Probe):
+    """delta(counter) / delta(t) in events/second over the tick; a
+    negative delta (process restart / registry reset) re-anchors and
+    yields None for that tick."""
+
+    def __init__(self, metric: str, match: Dict[str, str] = ()):
+        self.metric = metric
+        self.match = tuple(sorted(dict(match or {}).items()))
+        self._prev: Optional[Tuple[float, float]] = None  # (t, value)
+
+    def _value(self, families) -> float:
+        fam = families.get(self.metric)
+        if fam is None or fam.get("type") not in ("counter", "gauge"):
+            return 0.0
+        return float(sum(s["value"] for s in fam.get("samples", [])
+                         if _match(s.get("labels", {}), self.match)))
+
+    def sample(self, families, t=None) -> Optional[float]:
+        if t is None:
+            t = time.monotonic()
+        v = self._value(families)
+        prev, self._prev = self._prev, (t, v)
+        if prev is None:
+            return None
+        dt = t - prev[0]
+        dv = v - prev[1]
+        if dt <= 0 or dv < 0:
+            return None
+        return dv / dt
+
+    def describe(self) -> dict:
+        return {"probe": "counter_rate", "metric": self.metric,
+                "unit": "events/s"}
+
+
+class _HistDeltaProbe(Probe):
+    """Shared delta machinery over one histogram family: per tick the
+    probe sees (bucket-count deltas, sum delta, count delta) summed over
+    matching label sets."""
+
+    def __init__(self, metric: str, match: Dict[str, str] = (),
+                 min_count: int = 1):
+        self.metric = metric
+        self.match = tuple(sorted(dict(match or {}).items()))
+        self.min_count = int(min_count)
+        self._prev: Optional[Tuple[Dict[float, float], float, float]] = None
+
+    def _cum(self, families):
+        fam = families.get(self.metric)
+        if fam is None or fam.get("type") != "histogram":
+            return None
+        buckets: Dict[float, float] = {}
+        total_sum = total_n = 0.0
+        for s in fam.get("samples", []):
+            if not _match(s.get("labels", {}), self.match):
+                continue
+            total_sum += float(s.get("sum", 0.0))
+            total_n += float(s.get("count", 0))
+            for k, v in s.get("buckets", {}).items():
+                b = _parse_bound(k)
+                buckets[b] = buckets.get(b, 0.0) + float(v)
+        return buckets, total_sum, total_n
+
+    def _delta(self, families):
+        cum = self._cum(families)
+        if cum is None:
+            return None
+        if self._prev is None:
+            self._prev = cum
+            return None
+        buckets, total_sum, total_n = cum
+        pb, ps, pn = self._prev
+        if pn == 0 and not pb and buckets:
+            # the family's first samples appeared since the empty anchor:
+            # the whole current state IS the delta from zero
+            pb = {b: 0.0 for b in buckets}
+        dn = total_n - pn
+        if dn < 0 or set(buckets) != set(pb) or \
+                any(buckets[b] < pb[b] for b in buckets):
+            # counter reset or bucket-layout change (fresh registry):
+            # nothing trustworthy this tick; re-anchor
+            self._prev = cum
+            return None
+        if dn < self.min_count:
+            # too few new observations to judge — HOLD the anchor so a
+            # low-traffic phase accumulates toward min_count instead of
+            # being discarded tick by tick (a sparse but real regression
+            # must still produce samples)
+            return None
+        self._prev = cum
+        db = {b: buckets[b] - pb[b] for b in buckets}
+        return db, total_sum - ps, dn
+
+
+class HistogramMeanProbe(_HistDeltaProbe):
+    """Mean observation over the tick: delta(_sum)/delta(_count) — the
+    step-time regression signal (mean host step seconds this tick)."""
+
+    def sample(self, families, t=None) -> Optional[float]:
+        d = self._delta(families)
+        if d is None:
+            return None
+        _, dsum, dn = d
+        return dsum / dn
+
+    def describe(self) -> dict:
+        return {"probe": "histogram_mean", "metric": self.metric,
+                "unit": "mean observation/tick"}
+
+
+class HistogramQuantileProbe(_HistDeltaProbe):
+    """Quantile estimate from bucket-count deltas over the tick,
+    reported as the upper bound of the bucket containing the quantile
+    (the resolution histograms give; +Inf clamps to the largest finite
+    bound * 2 so the score stays finite)."""
+
+    def __init__(self, metric: str, q: float = 0.99,
+                 match: Dict[str, str] = (), min_count: int = 1):
+        super().__init__(metric, match, min_count)
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"q must be in (0, 1), got {q}")
+        self.q = float(q)
+
+    def sample(self, families, t=None) -> Optional[float]:
+        d = self._delta(families)
+        if d is None:
+            return None
+        db, _, dn = d
+        want = self.q * dn
+        finite = sorted(b for b in db if b != float("inf"))
+        # the exposition's bucket counts are CUMULATIVE, so db[b] (a
+        # delta of cumulatives) is already "observations <= b this
+        # tick" — compare directly, never re-sum across bounds
+        for b in finite:
+            if db[b] >= want:
+                return b
+        return (finite[-1] * 2.0) if finite else None
+
+    def describe(self) -> dict:
+        return {"probe": "histogram_quantile", "metric": self.metric,
+                "q": self.q, "unit": "bucket upper bound"}
+
+
+class GaugeProbe(Probe):
+    """Current value of a gauge (or counter level), summed over matching
+    label sets; None while the family has no samples."""
+
+    def __init__(self, metric: str, match: Dict[str, str] = ()):
+        self.metric = metric
+        self.match = tuple(sorted(dict(match or {}).items()))
+
+    def sample(self, families, t=None) -> Optional[float]:
+        fam = families.get(self.metric)
+        if fam is None:
+            return None
+        samples = [s for s in fam.get("samples", [])
+                   if _match(s.get("labels", {}), self.match)]
+        if not samples:
+            return None
+        return float(sum(s["value"] for s in samples))
+
+    def describe(self) -> dict:
+        return {"probe": "gauge", "metric": self.metric, "unit": "value"}
+
+
+# -- rolling baseline ---------------------------------------------------------
+
+
+class RollingBaseline:
+    """Windowed median + MAD over accepted samples. Robust: up to half
+    the window can be junk before the median moves, so the baseline
+    learns "normal" without learning the anomaly."""
+
+    def __init__(self, window: int = 64):
+        if window < 4:
+            raise ValueError(f"window must be >= 4, got {window}")
+        self._vals: deque = deque(maxlen=window)
+
+    def add(self, x: float) -> None:
+        self._vals.append(float(x))
+
+    def __len__(self) -> int:
+        return len(self._vals)
+
+    def median(self) -> float:
+        return float(statistics.median(self._vals)) if self._vals else 0.0
+
+    def mad(self) -> float:
+        if not self._vals:
+            return 0.0
+        med = self.median()
+        return float(statistics.median(abs(v - med) for v in self._vals))
+
+    def score(self, x: float, *, rel_floor: float = 0.05) -> float:
+        """Robust z of ``x`` against the window. The scale gets a floor
+        of ``rel_floor * |median|`` — an ultra-stable series (MAD 0)
+        must not turn microscopic jitter into infinite scores."""
+        med = self.median()
+        scale = _MAD_SIGMA * self.mad()
+        floor = max(rel_floor * abs(med), 1e-12)
+        return (x - med) / max(scale, floor)
+
+    def to_json(self) -> dict:
+        return {"n": len(self._vals), "median": self.median(),
+                "mad": self.mad(),
+                "window": self._vals.maxlen}
+
+
+# -- detector -----------------------------------------------------------------
+
+
+class Detector:
+    """One named anomaly detector: probe + judgement + state machine.
+
+    ``mode``:
+
+    - ``"baseline"`` — anomalous when the robust z-score of the tick's
+      sample is >= ``threshold`` AND the sample exceeds the baseline
+      median by ``min_increase`` (relative) — regressions only, a
+      *faster* step never pages;
+    - ``"ceiling"`` — anomalous when the sample >= ``threshold``
+      (absolute; for boolean gauges like ``train_data_starved`` and
+      rate ceilings like a recompile storm);
+    - ``"growth"`` — anomalous while the sample grows
+      tick-over-tick; fires only when the sustained streak's total
+      growth reaches ``threshold`` (fractional; the leak heuristic —
+      monotonic AND meaningfully so). Real leaks are steppy
+      (allocator-chunk growth), so up to ``plateau_tolerance``
+      consecutive non-decreasing plateau ticks HOLD the streak and
+      growth anchor instead of resetting them; a longer plateau (or
+      any decrease) counts as clean.
+
+    Hysteresis: ``fire_after`` consecutive anomalous ticks to fire
+    (>= 2 means one jittery sample can never fire), ``clear_after``
+    consecutive clean ticks to close. ``min_history`` baseline samples
+    must accumulate before a baseline detector judges at all — a
+    fresh process can't fire on its own warmup.
+    """
+
+    def __init__(self, name: str, probe: Probe, *,
+                 mode: str = "baseline", threshold: float = 8.0,
+                 min_increase: float = 0.25, min_abs: float = 0.0,
+                 baseline_window: int = 64, min_history: int = 8,
+                 fire_after: int = 3, clear_after: int = 3,
+                 plateau_tolerance: int = 2,
+                 description: str = ""):
+        if mode not in ("baseline", "ceiling", "growth"):
+            raise ValueError(f"unknown detector mode {mode!r}")
+        if fire_after < 2:
+            raise ValueError(
+                f"fire_after must be >= 2 (hysteresis: one jittery sample "
+                f"must not fire), got {fire_after}")
+        if clear_after < 1:
+            raise ValueError(f"clear_after must be >= 1, got {clear_after}")
+        self.name = name
+        self.probe = probe
+        self.mode = mode
+        self.threshold = float(threshold)
+        self.min_increase = float(min_increase)
+        self.min_abs = float(min_abs)
+        self.min_history = int(min_history)
+        self.fire_after = int(fire_after)
+        self.clear_after = int(clear_after)
+        self.description = description
+        self.baseline = RollingBaseline(baseline_window)
+        self.state = STATE_OK
+        self.last_sample: Optional[float] = None
+        self.last_score = 0.0
+        self._anom_streak = 0
+        self._clean_streak = 0
+        self._growth_prev: Optional[float] = None
+        self._growth_start: Optional[float] = None
+        self._plateau_run = 0
+        self.plateau_tolerance = int(plateau_tolerance)
+        self.transitions: List[dict] = []
+
+    # -- judgement -----------------------------------------------------------
+
+    def _judge(self, x: float) -> Tuple[Optional[bool], float]:
+        """(anomalous | None while unjudgeable, score)."""
+        if self.mode == "ceiling":
+            score = x / self.threshold if self.threshold else 0.0
+            return x >= self.threshold, score
+        if self.mode == "growth":
+            prev, self._growth_prev = self._growth_prev, x
+            if prev is None:
+                return None, 0.0
+            grew = x > prev * (1.0 + 1e-6) and x > self.min_abs
+            if grew:
+                self._plateau_run = 0
+                if self._growth_start is None:
+                    # anchor at the first POSITIVE level: fractional
+                    # growth from a zero start is undefined, and a leak
+                    # that begins at 0 bytes must still be able to fire
+                    self._growth_start = prev if prev > 0 else x
+                total = x / self._growth_start - 1.0
+                return True, (total / self.threshold
+                              if self.threshold else 0.0)
+            flat = x >= prev * (1.0 - 1e-6)
+            if flat and self._growth_start is not None and \
+                    self._plateau_run < self.plateau_tolerance:
+                # real-world leaks are steppy (allocator-chunk growth):
+                # a bounded run of non-decreasing plateau ticks carries
+                # no information — HOLD the anchor and the streak
+                # instead of restarting the fire_after count, or a leak
+                # growing every few ticks could never fire
+                self._plateau_run += 1
+                return None, ((x / self._growth_start - 1.0)
+                              / self.threshold if self.threshold else 0.0)
+            # decreased, or plateaued past tolerance: the growth stopped
+            self._plateau_run = 0
+            self._growth_start = None
+            return False, 0.0
+        # baseline mode
+        if len(self.baseline) < self.min_history:
+            self.baseline.add(x)
+            return None, 0.0
+        score = self.baseline.score(x)
+        med = self.baseline.median()
+        anomalous = (score >= self.threshold
+                     and x >= med * (1.0 + self.min_increase)
+                     and x >= self.min_abs)
+        return anomalous, score
+
+    def _growth_fire_ok(self) -> bool:
+        """growth mode's extra fire gate: the sustained streak must add
+        up to at least ``threshold`` fractional growth."""
+        if self.mode != "growth":
+            return True
+        start, x = self._growth_start, self.last_sample
+        return bool(start is not None and x is not None
+                    and x >= start * (1.0 + self.threshold))
+
+    # -- state machine -------------------------------------------------------
+
+    def observe(self, families, t: float) -> Optional[str]:
+        """One tick: sample, judge, advance. Returns the new state on a
+        transition, else None."""
+        x = self.probe.sample(families, t)
+        if x is None:
+            return None  # no information: streaks and state hold
+        self.last_sample = x
+        anomalous, score = self._judge(x)
+        self.last_score = score
+        if anomalous is None:
+            return None
+        new = self.state
+        if anomalous:
+            self._clean_streak = 0
+            self._anom_streak += 1
+            if self.state == STATE_OK:
+                new = STATE_SUSPECT
+            elif self.state == STATE_SUSPECT and \
+                    self._anom_streak >= self.fire_after and \
+                    self._growth_fire_ok():
+                new = STATE_FIRING
+        else:
+            self._anom_streak = 0
+            if self.state == STATE_SUSPECT:
+                new = STATE_OK
+            elif self.state == STATE_FIRING:
+                self._clean_streak += 1
+                if self._clean_streak >= self.clear_after:
+                    new = STATE_OK
+            # only clean samples observed while already ok feed the
+            # baseline — suspect/firing samples never do, and neither
+            # does the clean run that closes an incident (self.state is
+            # the PRE-transition state here): the baseline stays frozen
+            # until the detector has fully returned to ok
+            if self.mode == "baseline" and self.state == STATE_OK:
+                self.baseline.add(x)
+        if new != self.state:
+            old, self.state = self.state, new
+            if new == STATE_OK:
+                self._clean_streak = 0
+            tr = {"t": t, "from": old, "to": new, "sample": x,
+                  "score": round(score, 3)}
+            self.transitions.append(tr)
+            del self.transitions[:-32]
+            return new
+        return None
+
+    def verdict(self) -> dict:
+        """The self-contained judgement document the incident bundle
+        embeds: what fired, against what baseline, by how much."""
+        return {
+            "detector": self.name,
+            "description": self.description,
+            "mode": self.mode,
+            "state": self.state,
+            "observed": self.last_sample,
+            "score": round(self.last_score, 3),
+            "threshold": self.threshold,
+            "baseline": self.baseline.to_json(),
+            "probe": self.probe.describe(),
+            "fire_after": self.fire_after,
+            "clear_after": self.clear_after,
+            "transitions": list(self.transitions[-8:]),
+        }
+
+
+# -- built-in detectors -------------------------------------------------------
+
+
+def default_detectors(*, fire_after: int = 3, clear_after: int = 3,
+                      min_history: int = 8) -> List[Detector]:
+    """The seven built-ins over the standard telemetry families. All are
+    quiet until their probe has real data AND the baseline has
+    ``min_history`` accepted samples — a fresh process can't fire
+    during its own warmup."""
+    k = dict(fire_after=fire_after, clear_after=clear_after,
+             min_history=min_history)
+    return [
+        Detector(
+            "train_step_time_regression",
+            HistogramMeanProbe("train_step_seconds", min_count=4),
+            mode="baseline", threshold=8.0, min_increase=0.25,
+            description="Mean host step wall-time this tick rose far "
+                        "above its rolling baseline.", **k),
+        Detector(
+            "serving_p99_regression",
+            HistogramQuantileProbe("serving_request_latency_seconds",
+                                   q=0.99, min_count=8),
+            mode="baseline", threshold=8.0, min_increase=0.5,
+            description="Serving request p99 (bucket-resolved) rose far "
+                        "above its rolling baseline.", **k),
+        Detector(
+            "recompile_storm",
+            CounterRateProbe("runtime_jit_compiles_total"),
+            mode="ceiling", threshold=0.5,
+            description="Sustained XLA recompiles (>= 0.5/s): bucket "
+                        "misses are compiling on the hot path.", **k),
+        Detector(
+            "serving_queue_buildup",
+            GaugeProbe("serving_queue_depth"),
+            mode="baseline", threshold=8.0, min_increase=1.0, min_abs=8.0,
+            description="Admission queue depth far above its rolling "
+                        "baseline: arrivals outpace dispatch.", **k),
+        Detector(
+            "train_data_starvation",
+            GaugeProbe("train_data_starved"),
+            mode="ceiling", threshold=1.0,
+            description="The input pipeline dominates step wall-time "
+                        "(train_data_starved held at 1).", **k),
+        Detector(
+            "live_array_bytes_leak",
+            GaugeProbe("runtime_live_array_bytes"),
+            mode="growth", threshold=0.10, fire_after=max(fire_after, 6),
+            clear_after=clear_after, min_history=min_history,
+            description="Live jax array bytes growing monotonically "
+                        "(>= 10% sustained): buffers are leaking.", ),
+        Detector(
+            "hbm_bytes_leak",
+            GaugeProbe("runtime_device_memory_bytes",
+                       match={"stat": "bytes_in_use"}),
+            mode="growth", threshold=0.10, fire_after=max(fire_after, 6),
+            clear_after=clear_after, min_history=min_history,
+            description="Device bytes-in-use growing monotonically "
+                        "(>= 10% sustained): HBM is leaking toward "
+                        "OOM.", ),
+    ]
+
+
+# -- sentinel metric family ---------------------------------------------------
+
+
+class SentinelMetrics:
+    """The sentinel's own exposition — detector states/scores, tick
+    counters (the ``anomaly-firing`` burn-rate rule's total/bad pair),
+    the incident pipeline's counters, and the host sampler's meter."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        r = registry if registry is not None else _metrics.default_registry()
+        self.registry = r
+        self.anomaly_state = r.gauge(
+            "anomaly_state", "Detector state: 0=ok 1=suspect 2=firing.",
+            ("detector",))
+        self.anomaly_score = r.gauge(
+            "anomaly_score", "Latest robust anomaly score per detector "
+            "(baseline mode: robust z vs the rolling median+MAD; "
+            "ceiling: value/threshold; growth: growth/threshold).",
+            ("detector",))
+        self.anomaly_transitions_total = r.counter(
+            "anomaly_transitions_total", "Detector state transitions by "
+            "destination.", ("detector", "to"))
+        self.sentinel_ticks_total = r.counter(
+            "ticks_total", "Sentinel evaluation passes (the "
+            "anomaly-firing burn-rate rule's total).",
+            namespace="sentinel")
+        self.anomaly_firing_ticks_total = r.counter(
+            "anomaly_firing_ticks_total", "Sentinel passes that found at "
+            "least one detector firing (the anomaly-firing burn-rate "
+            "rule's bad events).")
+        self.incident_bundles_total = r.counter(
+            "incident_bundles_total", "Incident bundles opened, by the "
+            "detector that fired.", ("detector",))
+        self.incidents_open = r.gauge(
+            "incidents_open", "Incidents currently open.")
+        self.hostsampler_samples_total = r.counter(
+            "hostsampler_samples_total", "Host stack sampler passes "
+            "(each folds every live thread's stack once).")
+        self.hostsampler_stacks = r.gauge(
+            "hostsampler_stacks", "Distinct folded stacks currently "
+            "held by the host stack sampler.")
+
+
+_sentinel_metrics: Optional[SentinelMetrics] = None
+_sm_lock = threading.Lock()
+
+
+def get_sentinel_metrics() -> SentinelMetrics:
+    global _sentinel_metrics
+    if _sentinel_metrics is None:
+        with _sm_lock:
+            if _sentinel_metrics is None:
+                _sentinel_metrics = SentinelMetrics()
+    return _sentinel_metrics
+
+
+def _drop_sentinel_metrics():
+    global _sentinel_metrics
+    _sentinel_metrics = None
+
+
+_metrics.register_reset_hook(_drop_sentinel_metrics)
+
+
+# -- engine -------------------------------------------------------------------
+
+
+class Sentinel:
+    """Evaluate detectors on a cadence; open/close incidents on firing.
+
+    ``registries``: metric registries to read (None = the process
+    default, resolved per tick). ``incidents``: an
+    :class:`~deeplearning4j_tpu.observability.incidents.IncidentManager`
+    (or None — detect-only). ``sampler``: a
+    :class:`~deeplearning4j_tpu.observability.hostsampler.HostStackSampler`
+    whose high-rate window is armed on suspect and whose flames land in
+    the bundle. ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(self, detectors: Optional[Sequence[Detector]] = None, *,
+                 registries: Optional[Sequence] = None,
+                 interval_s: float = 10.0,
+                 incidents=None, sampler=None,
+                 clock: Optional[Callable[[], float]] = None,
+                 arm_window_ticks: int = 6):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.detectors = list(detectors) if detectors is not None \
+            else default_detectors()
+        names = [d.name for d in self.detectors]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate detector names in {names}")
+        self._registries = list(registries) if registries is not None else None
+        self.interval_s = float(interval_s)
+        self.incidents = incidents
+        self.sampler = sampler
+        self.arm_window_ticks = int(arm_window_ticks)
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._open_incidents: Dict[str, str] = {}  # detector -> incident id
+
+    def _resolve_registries(self):
+        if self._registries is not None:
+            return self._registries
+        return [_metrics.default_registry()]
+
+    # -- evaluation ----------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """One evaluation pass; returns :meth:`verdicts`.
+
+        The engine lock covers the state machines only; incident bundle
+        capture (a second registry scrape plus disk writes) runs after
+        it is released, so ``verdicts()``/``states()`` and the next tick
+        never stall behind capture I/O. Verdict documents are snapshotted
+        at transition time, under the lock."""
+        actions: List[Tuple[str, str, dict]] = []
+        with self._lock:
+            t = self._clock() if now is None else now
+            regs = self._resolve_registries()
+            families = _doc_map(regs)
+            sm = get_sentinel_metrics() if _metrics.enabled() else None
+            any_firing = False
+            for det in self.detectors:
+                transition = det.observe(families, t)
+                if transition is not None:
+                    record_event("anomaly.transition", detector=det.name,
+                                 to=transition, sample=det.last_sample,
+                                 score=round(det.last_score, 3))
+                    if sm is not None:
+                        sm.anomaly_transitions_total.inc(
+                            detector=det.name, to=transition)
+                    if transition == STATE_SUSPECT and \
+                            self.sampler is not None:
+                        # dense flames over the (possibly) anomalous
+                        # window, ready by firing time
+                        self.sampler.arm(
+                            self.arm_window_ticks * self.interval_s)
+                    elif transition == STATE_FIRING:
+                        if self.incidents is not None:
+                            # pending marker, placed under the lock: a
+                            # concurrent tick() observing firing->ok
+                            # before the deferred open below registers
+                            # its id must still queue the close
+                            self._open_incidents.setdefault(det.name, "")
+                        actions.append(("open", det.name, det.verdict()))
+                    elif transition == STATE_OK and \
+                            det.name in self._open_incidents:
+                        actions.append(("close", det.name, det.verdict()))
+                if det.state == STATE_FIRING:
+                    any_firing = True
+                if sm is not None:
+                    sm.anomaly_state.set(_STATE_NUM[det.state],
+                                         detector=det.name)
+                    sm.anomaly_score.set(det.last_score, detector=det.name)
+            if sm is not None:
+                sm.sentinel_ticks_total.inc()
+                if any_firing:
+                    sm.anomaly_firing_ticks_total.inc()
+            result = self._verdicts_locked(t)
+        for kind, name, verdict in actions:
+            if kind == "open":
+                self._open_incident(name, verdict)
+            else:
+                self._close_incident(name, verdict)
+        return result
+
+    def _open_incident(self, detector_name: str, verdict: dict):
+        if self.incidents is None:
+            return
+        with self._lock:
+            if self._open_incidents.get(detector_name, ""):
+                return  # a real bundle is already open
+            if detector_name not in self._open_incidents:
+                return  # a racing close consumed the pending marker
+        iid = None
+        try:
+            if self.sampler is not None:
+                # keep the high-rate window open through the capture
+                self.sampler.arm(self.arm_window_ticks * self.interval_s)
+            iid = self.incidents.open_incident(
+                verdict, registries=self._resolve_registries(),
+                sampler=self.sampler)
+            with self._lock:
+                if detector_name in self._open_incidents:
+                    self._open_incidents[detector_name] = iid
+                    iid = None  # registered; nothing to roll back
+            if iid is not None:
+                # the detector cleared while the capture ran (a racing
+                # close popped the marker): close the fresh bundle now
+                # instead of leaking it open forever
+                self.incidents.close_incident(iid, resolution=verdict)
+        except Exception:  # noqa: BLE001 — capture failure must not
+            with self._lock:  # stop detection (or the evaluator thread)
+                if self._open_incidents.get(detector_name) == "":
+                    del self._open_incidents[detector_name]
+
+    def _close_incident(self, detector_name: str, resolution: dict):
+        with self._lock:
+            iid = self._open_incidents.pop(detector_name, None)
+        if not iid or self.incidents is None:
+            return  # "" = open in flight; it will close its own bundle
+        try:
+            self.incidents.close_incident(iid, resolution=resolution)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- rendering -----------------------------------------------------------
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {d.name: d.state for d in self.detectors}
+
+    def verdicts(self) -> dict:
+        with self._lock:
+            return self._verdicts_locked(self._clock())
+
+    def _verdicts_locked(self, t: float) -> dict:
+        worst = STATE_OK
+        rows = []
+        for d in self.detectors:
+            if _STATE_NUM[d.state] > _STATE_NUM[worst]:
+                worst = d.state
+            rows.append(d.verdict())
+        return {"status": worst, "evaluated_at": t,
+                "interval_s": self.interval_s,
+                "open_incidents": {k: v for k, v
+                                   in self._open_incidents.items() if v},
+                "detectors": rows}
+
+    # -- background thread ---------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "Sentinel":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="anomaly-sentinel")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the sentinel must survive
+                pass           # a bad tick; the next one retries
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

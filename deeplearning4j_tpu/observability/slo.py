@@ -196,12 +196,16 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     _metrics.CheckpointMetrics(reg)
     SLOMetrics(reg)
     from deeplearning4j_tpu.observability.federation import ClusterMetrics
+    from deeplearning4j_tpu.observability.sentinel import SentinelMetrics
     from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
     ServingMetrics(reg)
     # the supervisor-side cluster_* families (federation aggregator):
     # rule files over the federated registry validate offline too
     ClusterMetrics(reg)
+    # the anomaly sentinel + incident pipeline families (sentinel.py):
+    # the anomaly-firing burn-rate rule reads these
+    SentinelMetrics(reg)
     names.update(i.name for i in reg.instruments())
     return names
 

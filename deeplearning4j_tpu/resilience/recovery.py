@@ -316,6 +316,16 @@ class FaultTolerantTrainer:
             tele = _StepTelemetry(tr, tm)
         for lst in listeners:
             lst.on_fit_start(tr, ts)
+        # incident pipeline: arm the "train" device-capture hook for the
+        # life of this fit, exactly like Trainer.fit (the per-step
+        # note below is a no-op global check when nothing is pending)
+        from deeplearning4j_tpu.observability.incidents import (
+            enter_training,
+            exit_training,
+            note_train_step,
+        )
+
+        enter_training()
         try:
             epoch = start_epoch
             while epoch < epochs and not stop:
@@ -406,6 +416,7 @@ class FaultTolerantTrainer:
                         break
                     ts = new_ts
                     host_step += 1
+                    note_train_step()  # armed incident capture boundary
                     touch_heartbeat()  # supervisor hang-detector beacon
                     if tm is not None:
                         step_s = time.perf_counter() - t_step
@@ -446,6 +457,7 @@ class FaultTolerantTrainer:
                     self._save(ts, epoch=epoch, batch_in_epoch=0,
                                tag=f"epoch{epoch - 1}")
         finally:
+            exit_training()
             tr._upd_update = self._orig_upd
             for lst in listeners:
                 lst.on_fit_end(tr, ts)

@@ -619,15 +619,21 @@ class ElasticSupervisor:
             crash_dir = Path(os.environ.get(ENV_CRASH_DIR,
                                             str(self.workdir)))
             crash_dir.mkdir(parents=True, exist_ok=True)
+            dossier = self._aggregator.dossier()
+            # incidents the cohort was carrying at teardown, hoisted to
+            # the report's top level: the first question a post-mortem
+            # asks is "was anything already firing when it died?"
+            open_incidents = dossier.get("open_incidents", [])
             report = {
                 "timestamp": datetime.datetime.now().isoformat(),
                 "pid": os.getpid(),
                 "kind": "supervisor_cluster_dossier",
+                "open_incidents": open_incidents,
                 "extra": {
                     "supervisor_failure": failure,
                     "generation": self.generation,
                     "topology": self._topology_info(),
-                    "cluster_dossier": self._aggregator.dossier(),
+                    "cluster_dossier": dossier,
                 },
             }
             try:
@@ -658,7 +664,8 @@ class ElasticSupervisor:
             except Exception:  # noqa: BLE001
                 pass
             _flight("supervisor.cluster_dossier",
-                    generation=self.generation, path=str(path))
+                    generation=self.generation, path=str(path),
+                    open_incidents=len(open_incidents))
             return str(path)
         except Exception:  # noqa: BLE001 — reporting never blocks the
             return None    # relaunch

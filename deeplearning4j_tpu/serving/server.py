@@ -30,10 +30,25 @@ telemetry spine):
   (``?seconds=N`` trims to the trailing window).
 - ``POST /debug/profile?ms=N`` — capture ``jax.profiler`` of LIVE
   traffic for N ms; returns the Perfetto trace (gzipped, base64) plus
-  the ``analyze_trace`` device-op breakdown. One capture at a time.
+  the ``analyze_trace`` device-op breakdown. One capture at a time;
+  a concurrent capture gets ``409`` with ``Retry-After`` + a precise
+  ``retry_after_ms`` body field so client retry composes.
 - ``GET /debug/costs`` — per-registered-model static XLA cost analysis
   (flops, bytes accessed, arithmetic intensity; ``?rows=N`` overrides
   the batch size analyzed).
+- ``GET /debug/incidents`` — the anomaly sentinel's incident-bundle
+  index; ``GET /debug/incidents/<id>`` fetches one full bundle
+  (observability/incidents.py).
+
+Anomaly sentinel (``sentinel=True``, the default): a rolling-baseline
+detector engine (observability/sentinel.py) ticks alongside the SLO
+evaluator — step-time / serving-p99 regressions, recompile storms,
+queue buildup, data starvation, leak heuristics — each with an
+ok→suspect→firing state machine. *Suspect* arms the always-on host
+stack sampler's high-rate window; *firing* writes an incident bundle
+(detector verdict, scrape, flight dump, span slice, host flames, and a
+short live-traffic ``jax.profiler`` capture via the server's registered
+profile hook) under bounded retention.
 
 Predict requests propagate correlation IDs: ``X-Correlation-ID`` /
 ``X-Span-ID`` headers (minted when absent, echoed back) root the
@@ -69,12 +84,15 @@ from urllib.parse import parse_qs
 import jax
 import numpy as np
 
+from deeplearning4j_tpu.observability import incidents as _incidents
+from deeplearning4j_tpu.observability import sentinel as _sentinel
 from deeplearning4j_tpu.observability import slo as _slo
 from deeplearning4j_tpu.observability import trace as _trace
 from deeplearning4j_tpu.observability.flightrecorder import (
     get_flight_recorder,
     record_event,
 )
+from deeplearning4j_tpu.observability.hostsampler import get_host_sampler
 from deeplearning4j_tpu.observability.metrics import (
     default_registry,
     render_json_multi,
@@ -132,6 +150,11 @@ class ModelServer:
         slo_time_scale: float = 1.0,
         max_profile_ms: float = 60000.0,
         circuit_policy: Optional[CircuitPolicy] = CircuitPolicy(),
+        sentinel: bool = True,
+        sentinel_detectors: Optional[Sequence] = None,
+        sentinel_interval_s: float = 10.0,
+        incident_dir: Optional[str] = None,
+        incident_profile_ms: float = 250.0,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
         if metrics is not None:
@@ -162,6 +185,27 @@ class ModelServer:
                 interval_s=slo_interval_s, time_scale=slo_time_scale)
         self.max_profile_ms = max_profile_ms
         self._profile_lock = threading.Lock()
+        # when a capture holds the lock, the deadline it runs until —
+        # the 409's Retry-After derives from it
+        self._profile_busy_until = 0.0
+        # Anomaly sentinel + incident pipeline (observability/sentinel.py,
+        # incidents.py): detectors tick over the same registries the SLO
+        # engine reads; firing writes an incident bundle whose device
+        # profile comes from this server's live-traffic capture hook.
+        self.incident_profile_ms = float(incident_profile_ms)
+        self.incidents: Optional["_incidents.IncidentManager"] = None
+        self.sentinel: Optional["_sentinel.Sentinel"] = None
+        if sentinel:
+            if incident_dir is not None:
+                self.incidents = _incidents.IncidentManager(incident_dir)
+            else:
+                self.incidents = _incidents.get_incident_manager(create=True)
+            self.sentinel = _sentinel.Sentinel(
+                sentinel_detectors,
+                registries=[self.metrics.registry, default_registry()],
+                interval_s=sentinel_interval_s,
+                incidents=self.incidents,
+                sampler=get_host_sampler())
         # Per-(model, version) circuit breakers: a bad deploy's failures
         # open ITS version's circuit; the rollback target starts fresh.
         # None disables breaking entirely.
@@ -178,6 +222,13 @@ class ModelServer:
 
             def _send(self, status: int, body, content_type="application/json",
                       retry_after_ms=None, correlation_id=None):
+                if retry_after_ms is None and isinstance(body, dict):
+                    # every retryable error body carries a precise
+                    # error.retry_after_ms; derive the Retry-After header
+                    # from it here so each route doesn't repeat the lookup
+                    err = body.get("error")
+                    if isinstance(err, dict):
+                        retry_after_ms = err.get("retry_after_ms")
                 raw = (body if isinstance(body, bytes)
                        else json.dumps(body).encode())
                 self.send_response(status)
@@ -238,6 +289,16 @@ class ModelServer:
                             "rows must be a positive integer").to_json())
                         return
                     self._send(200, server.render_costs(rows=rows))
+                elif path == "/debug/incidents":
+                    self._send(200, server.render_incidents())
+                elif path.startswith("/debug/incidents/"):
+                    iid = path[len("/debug/incidents/"):]
+                    body = server.render_incident(iid)
+                    if body is None:
+                        self._send(404, ServingError(
+                            f"no incident {iid!r}").to_json())
+                    else:
+                        self._send(200, body)
                 else:
                     self._send(404, ServingError(
                         f"no route {path}").to_json())
@@ -282,10 +343,7 @@ class ModelServer:
                 status, body = server.handle_predict(
                     m.group(1), payload, correlation_id=cid,
                     parent_span_id=self.headers.get("X-Span-ID"))
-                retry_after = (body.get("error", {}).get("retry_after_ms")
-                               if isinstance(body, dict) else None)
-                self._send(status, body, retry_after_ms=retry_after,
-                           correlation_id=cid)
+                self._send(status, body, correlation_id=cid)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
 
@@ -480,8 +538,12 @@ class ModelServer:
             else:
                 cb.record_neutral(token=cb_token)
         self.metrics.requests_total.inc(model=metric_model, code=str(status))
+        # OpenMetrics-style exemplar: the latency bucket this request
+        # landed in keeps its correlation id, so a slow bucket in the
+        # scrape links straight to the offending trace
         self.metrics.request_latency.observe(time.monotonic() - t0,
-                                             model=metric_model)
+                                             model=metric_model,
+                                             exemplar_trace_id=cid)
         return status, body
 
     # -- metrics exposition ---------------------------------------------------
@@ -520,6 +582,37 @@ class ModelServer:
                             "reason": str(exc)[:200]})
         return {"models": out}
 
+    def render_incidents(self) -> dict:
+        """The incident-bundle index + current detector verdicts (the
+        sentinel's live view rides along so an empty index still answers
+        "is anything suspect right now?")."""
+        out: dict = {"incidents": (self.incidents.index()
+                                   if self.incidents is not None else []),
+                     "sentinel": None}
+        if self.sentinel is not None:
+            out["sentinel"] = self.sentinel.verdicts()
+        return out
+
+    def render_incident(self, incident_id: str) -> Optional[dict]:
+        if self.incidents is None:
+            return None
+        return self.incidents.get(incident_id)
+
+    def _incident_profile_hook(self) -> dict:
+        """The sentinel's device-capture hook: a short live-traffic
+        ``jax.profiler`` capture through the same serialized path as
+        ``POST /debug/profile`` (the inline gzipped trace is dropped —
+        the bundle references the on-disk trace file instead of
+        embedding megabytes)."""
+        status, body = self.handle_profile(self.incident_profile_ms)
+        if status != 200:
+            return {"available": False, "status": status,
+                    "error": body.get("error") if isinstance(body, dict)
+                    else None}
+        body = dict(body)
+        body.pop("trace_gz_b64", None)
+        return {"available": True, "kind": "serving_live_traffic", **body}
+
     def handle_profile(self, ms: float) -> Tuple[int, dict]:
         """On-demand ``jax.profiler`` capture of live traffic for ``ms``
         milliseconds. Returns the Perfetto trace (gzipped trace file,
@@ -536,11 +629,21 @@ class ModelServer:
                 f"ms must be in (0, {self.max_profile_ms:g}], "
                 f"got {ms!r}").to_json()
         if not self._profile_lock.acquire(blocking=False):
+            # how long the in-flight capture still runs, plus headroom
+            # for its serialization/analysis tail — a precise ms hint in
+            # the body and the integer-seconds Retry-After header both,
+            # matching the admission/circuit 503 shape so ServingClient
+            # retry composes
+            remaining_ms = max(
+                0.0, (self._profile_busy_until - time.monotonic()) * 1000.0)
+            retry_after_ms = remaining_ms + 250.0
             return 409, {"error": {
                 "code": "PROFILE_IN_PROGRESS",
                 "message": "another /debug/profile capture is running",
-                "retryable": True}}
+                "retryable": True,
+                "retry_after_ms": round(retry_after_ms, 1)}}
         try:
+            self._profile_busy_until = time.monotonic() + ms / 1000.0
             log_dir = tempfile.mkdtemp(prefix="dl4j-tpu-profile-")
             t0 = time.monotonic()
             jax.profiler.start_trace(log_dir)
@@ -599,6 +702,20 @@ class ModelServer:
             # zero-config visibility: UIServer's /health page renders the
             # process-default engine
             _slo.set_default_engine(self.slo_engine)
+        if self.sentinel is not None:
+            # always-on host flames + the detector engine; the server's
+            # live-traffic capture becomes the incident device profile
+            get_host_sampler(start=True)
+            if _incidents.get_incident_manager() is None:
+                # a server given its OWN incident_dir must still surface
+                # in the federation snapshot (incident_index reads the
+                # process-global manager): promote this manager while
+                # the slot is free. Left registered on stop — bundles
+                # outlive the server and stay readable in cohort views.
+                _incidents.set_incident_manager(self.incidents)
+            _incidents.register_profile_hook(
+                "serving", self._incident_profile_hook)
+            self.sentinel.start()
         record_event("serving.start", port=self.port,
                      models=self.registry.names())
         return self
@@ -617,6 +734,13 @@ class ModelServer:
             self._started = False
             record_event("serving.stop", port=self.port, drained=drained)
         self.slo_engine.stop()
+        if self.sentinel is not None:
+            self.sentinel.stop()
+            # only unhook ourselves (a newer server's hook must survive);
+            # the process host sampler stays running — it is the
+            # always-on plane, not this server's
+            _incidents.unregister_profile_hook(
+                "serving", self._incident_profile_hook)
         if _slo.get_default_engine() is self.slo_engine:
             _slo.set_default_engine(None)
         self._httpd.server_close()

@@ -688,6 +688,11 @@ class Trainer:
         # step_seconds measures the host loop's dispatch pace.
         om = _training_metrics()
         tele = _StepTelemetry(self, om) if om is not None else None
+        # incident pipeline: while a fit loop is live, the sentinel's
+        # "train" profile hook can capture the NEXT N steps on demand
+        # (observability/incidents.py; the per-step check below is one
+        # global load when nothing is pending)
+        _incidents_enter_training()
         # on_fit_end must run even when a step raises (non-finite loss,
         # OOM, interrupt): listeners hold resources whose teardown
         # re-raises swallowed failures (async checkpoint writers).
@@ -737,6 +742,9 @@ class Trainer:
                         tele.on_step(ts, batch, read_s, step_s,
                                      host_step + len(wmetrics))
                     n += 1
+                    # step boundary for an armed incident device capture
+                    # (a no-op global check unless one is pending)
+                    _incidents_note_step()
                     # progress beacon for the elastic supervisor's hang
                     # detector (resilience/cluster.py); a no-op global
                     # check unless a supervisor armed a heartbeat
@@ -770,6 +778,7 @@ class Trainer:
                 if stop:
                     break
         finally:
+            _incidents_exit_training()
             for lst in listeners:
                 lst.on_fit_end(self, ts)
         return ts
@@ -897,6 +906,11 @@ def _record_batch_transfer(batch):
 
 from deeplearning4j_tpu.data.dataset import as_batch_dict as _as_batch_dict  # noqa: E402
 from deeplearning4j_tpu.data.iterators import maybe_auto_prefetch as _maybe_auto_prefetch  # noqa: E402
+from deeplearning4j_tpu.observability.incidents import (  # noqa: E402
+    enter_training as _incidents_enter_training,
+    exit_training as _incidents_exit_training,
+    note_train_step as _incidents_note_step,
+)
 from deeplearning4j_tpu.resilience.cluster import touch_heartbeat as _touch_heartbeat  # noqa: E402
 from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector  # noqa: E402
 from deeplearning4j_tpu.runtime.distributed import note_step as _note_step  # noqa: E402

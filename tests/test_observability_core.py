@@ -46,11 +46,16 @@ def fresh():
 # strict exposition parser (the test oracle)
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NUM = r"[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN)"
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
     r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
-    r" ([-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))$")
+    rf" ({_NUM})"
+    # optional OpenMetrics-style exemplar suffix (bucket lines only,
+    # enforced below): ... # {trace_id="<id>"} <value> [<timestamp>]
+    rf"( # \{{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\"\}} "
+    rf"{_NUM}( {_NUM})?)?$")
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
@@ -103,6 +108,9 @@ def parse_exposition(text: str):
                        if current["type"] == "histogram" else {fam})
             assert sname in allowed, \
                 f"sample {sname!r} interleaved into family {fam!r}"
+            if m.group(4):  # exemplars attach only to histogram buckets
+                assert sname == fam + "_bucket", \
+                    f"exemplar on a non-bucket line: {line!r}"
             labels = {k: _unescape(v)
                       for k, v in _LABEL_RE.findall(labels_raw or "")}
             current["samples"].append((sname, labels, float(value)))

@@ -1,0 +1,1147 @@
+"""Performance anomaly sentinel tests: rolling baselines + probes,
+detector hysteresis (ok → suspect → firing → ok), the always-on host
+stack sampler, incident bundles (six artifact kinds, retention,
+open/close lifecycle), OpenMetrics exemplars, the /debug/profile 409
+retry hint, the federated /cluster/debug/incidents view, and THE
+end-to-end acceptance story: injected serving.latency faults drive the
+p99 detector through the full state machine, an incident bundle lands
+on disk with every artifact kind (device profile included), is served
+at /debug/incidents, and closes once the fault clears."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import flightrecorder as fr
+from deeplearning4j_tpu.observability import hostsampler as hs
+from deeplearning4j_tpu.observability import incidents as inc
+from deeplearning4j_tpu.observability import metrics as om
+from deeplearning4j_tpu.observability import sentinel as sn
+from deeplearning4j_tpu.observability import slo
+from deeplearning4j_tpu.observability import trace as tr
+from deeplearning4j_tpu.resilience.faults import (
+    FaultInjector,
+    set_fault_injector,
+)
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer, spec
+from tests.test_observability_core import parse_exposition
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    om.reset_default_registry()
+    fr.set_flight_recorder(None)
+    om.set_enabled(True)
+    fr.set_recording(True)
+    slo.set_default_engine(None)
+    inc.set_incident_manager(None)
+    set_fault_injector(FaultInjector())
+    yield
+    set_fault_injector(None)
+    slo.set_default_engine(None)
+    inc.set_incident_manager(None)
+    om.reset_default_registry()
+    fr.set_flight_recorder(None)
+
+
+def _forward(v, x):
+    return jnp.tanh(x @ v["w"])
+
+
+def _server(**kw):
+    registry = ModelRegistry()
+    registry.register(
+        "tiny", _forward,
+        {"w": jnp.asarray(np.random.default_rng(0).normal(size=(4, 3)),
+                          jnp.float32)},
+        input_spec=spec((4,)), version="v1", mode="batched",
+        max_batch_size=8, devices=jax.devices()[:1])
+    return ModelServer(registry, port=0, **kw)
+
+
+def _post(url, payload=None, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _get(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _wait_for(predicate, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _sample_from_thread(sampler, n=1):
+    """Drive sampler.sample() off the main thread (it excludes its own
+    caller, so a main-thread call can't see the main thread's stack)."""
+    def run():
+        for _ in range(n):
+            sampler.sample()
+            time.sleep(0.001)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+
+
+# ---------------------------------------------------------------------------
+# rolling baseline + probes
+
+
+class TestRollingBaseline:
+    def test_median_and_mad(self):
+        b = sn.RollingBaseline(window=8)
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            b.add(v)
+        assert b.median() == 3.0
+        assert b.mad() == 1.0  # |1-3|,|2-3|,|3-3|,|4-3|,|97| -> median 1
+
+    def test_score_is_robust_z(self):
+        b = sn.RollingBaseline(window=16)
+        for v in (10.0, 10.5, 9.5, 10.0, 10.2, 9.8):
+            b.add(v)
+        assert abs(b.score(10.0)) < 1.0
+        assert b.score(30.0) > 8.0
+
+    def test_score_floor_on_perfectly_stable_series(self):
+        b = sn.RollingBaseline(window=8)
+        for _ in range(8):
+            b.add(0.004)  # MAD == 0
+        # microscopic jitter must not explode into a huge score...
+        assert b.score(0.004 + 1e-7) < 1.0
+        # ...but a genuine 10x regression still scores enormous
+        assert b.score(0.04) > 100.0
+
+    def test_window_slides(self):
+        b = sn.RollingBaseline(window=4)
+        for v in (1, 1, 1, 1, 9, 9, 9, 9):
+            b.add(v)
+        assert b.median() == 9.0
+
+
+class TestProbes:
+    def test_histogram_mean_probe_deltas(self):
+        reg = om.MetricsRegistry()
+        h = reg.histogram("train_step_seconds", "t")
+        p = sn.HistogramMeanProbe("train_step_seconds", min_count=2)
+        fams = lambda: slo._doc_map([reg])  # noqa: E731
+        assert p.sample(fams()) is None  # first call anchors
+        h.observe(0.01), h.observe(0.03)
+        assert p.sample(fams()) == pytest.approx(0.02)
+        # no new observations: no information, anchor held
+        assert p.sample(fams()) is None
+        h.observe(0.5)  # one obs < min_count accumulates...
+        assert p.sample(fams()) is None
+        h.observe(0.5)  # ...until min_count reached since last delta
+        assert p.sample(fams()) == pytest.approx(0.5)
+
+    def test_histogram_quantile_probe_snaps_to_bucket(self):
+        reg = om.MetricsRegistry()
+        h = reg.histogram("serving_request_latency_seconds", "t", ("m",))
+        p = sn.HistogramQuantileProbe("serving_request_latency_seconds",
+                                      q=0.99, min_count=4)
+        fams = lambda: slo._doc_map([reg])  # noqa: E731
+        assert p.sample(fams()) is None
+        for _ in range(100):
+            h.observe(0.004, m="a")
+        assert p.sample(fams()) == pytest.approx(0.005)  # bucket bound
+        for _ in range(100):
+            h.observe(0.2, m="a")
+        assert p.sample(fams()) == pytest.approx(0.25)
+
+    def test_histogram_quantile_probe_multi_bucket_spread(self):
+        # 90 fast + 10 slow observations in ONE tick: p99 must resolve
+        # to the SLOW tail's bucket bound. Regression test: bucket
+        # deltas are deltas of CUMULATIVE counts — re-summing them
+        # crossed q*dn several buckets early and reported the fast
+        # bucket (0.005) instead of the tail (0.5)
+        reg = om.MetricsRegistry()
+        h = reg.histogram("serving_request_latency_seconds", "t", ("m",))
+        p = sn.HistogramQuantileProbe("serving_request_latency_seconds",
+                                      q=0.99, min_count=4)
+        fams = lambda: slo._doc_map([reg])  # noqa: E731
+        assert p.sample(fams()) is None
+        for _ in range(90):
+            h.observe(0.004, m="a")
+        for _ in range(10):
+            h.observe(0.3, m="a")
+        assert p.sample(fams()) == pytest.approx(0.5)
+
+    def test_counter_rate_probe(self):
+        reg = om.MetricsRegistry()
+        c = reg.counter("runtime_jit_compiles_total", "t")
+        p = sn.CounterRateProbe("runtime_jit_compiles_total")
+        fams = lambda: slo._doc_map([reg])  # noqa: E731
+        assert p.sample(fams()) is None
+        c.inc(5)
+        time.sleep(0.01)
+        rate = p.sample(fams())
+        assert rate is not None and rate > 0
+
+    def test_counter_reset_yields_none(self):
+        reg = om.MetricsRegistry()
+        c = reg.counter("x_total", "t")
+        c.inc(10)
+        p = sn.CounterRateProbe("x_total")
+        p.sample(slo._doc_map([reg]))
+        reg2 = om.MetricsRegistry()  # fresh registry: counter back to 0
+        reg2.counter("x_total", "t").inc(1)
+        assert p.sample(slo._doc_map([reg2])) is None
+
+    def test_gauge_probe_with_match(self):
+        reg = om.MetricsRegistry()
+        g = reg.gauge("runtime_device_memory_bytes", "t",
+                      ("device", "stat"))
+        p = sn.GaugeProbe("runtime_device_memory_bytes",
+                          match={"stat": "bytes_in_use"})
+        assert p.sample(slo._doc_map([reg])) is None  # no samples yet
+        g.set(100.0, device="0", stat="bytes_in_use")
+        g.set(999.0, device="0", stat="peak_bytes_in_use")
+        assert p.sample(slo._doc_map([reg])) == 100.0
+
+
+# ---------------------------------------------------------------------------
+# detector state machine (synthetic registry, manual ticks)
+
+
+def _p99_detector(**kw):
+    args = dict(mode="baseline", threshold=6.0, min_increase=0.5,
+                min_history=6, fire_after=2, clear_after=2)
+    args.update(kw)
+    return sn.Detector(
+        "p99", sn.HistogramQuantileProbe(
+            "serving_request_latency_seconds", q=0.99, min_count=4),
+        **args)
+
+
+class TestDetectorStateMachine:
+    def _setup(self, det=None):
+        reg = om.MetricsRegistry()
+        h = reg.histogram("serving_request_latency_seconds", "t")
+        det = det if det is not None else _p99_detector()
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0)
+        return reg, h, det, s
+
+    def test_no_judgement_before_min_history(self):
+        _, h, det, s = self._setup()
+        for _ in range(3):
+            for _ in range(10):
+                h.observe(0.004)
+            s.tick()
+        assert det.state == "ok"
+        assert len(det.baseline) < det.min_history
+
+    def test_one_jittery_sample_cannot_fire(self):
+        _, h, det, s = self._setup()
+        for _ in range(10):           # healthy baseline
+            for _ in range(10):
+                h.observe(0.004)
+            s.tick()
+        assert det.state == "ok"
+        for _ in range(10):           # ONE anomalous tick
+            h.observe(0.2)
+        s.tick()
+        assert det.state == "suspect"  # suspect, not firing
+        for _ in range(10):           # next tick is clean again
+            h.observe(0.004)
+        s.tick()
+        assert det.state == "ok"
+        tos = [t["to"] for t in det.transitions]
+        assert "firing" not in tos
+
+    def test_sustained_anomaly_fires_then_clears_with_hysteresis(self):
+        _, h, det, s = self._setup()
+        for _ in range(10):
+            for _ in range(10):
+                h.observe(0.004)
+            s.tick()
+        base_median = det.baseline.median()
+        for i in range(3):
+            for _ in range(10):
+                h.observe(0.2)
+            s.tick()
+        assert det.state == "firing"
+        # baseline FROZEN while suspect/firing: the anomaly must not
+        # teach itself into "normal"
+        assert det.baseline.median() == base_median
+        # one clean tick is not enough to clear (clear_after=2)
+        for _ in range(10):
+            h.observe(0.004)
+        s.tick()
+        assert det.state == "firing"
+        for _ in range(10):
+            h.observe(0.004)
+        s.tick()
+        assert det.state == "ok"
+        tos = [t["to"] for t in det.transitions]
+        assert tos == ["suspect", "firing", "ok"]
+
+    def test_ceiling_mode_starvation(self):
+        reg = om.MetricsRegistry()
+        g = reg.gauge("train_data_starved", "t")
+        det = sn.Detector("starved", sn.GaugeProbe("train_data_starved"),
+                          mode="ceiling", threshold=1.0,
+                          fire_after=2, clear_after=2)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0)
+        g.set(0.0)
+        for _ in range(3):
+            s.tick()
+        assert det.state == "ok"
+        g.set(1.0)
+        s.tick()
+        assert det.state == "suspect"
+        s.tick()
+        assert det.state == "firing"
+        g.set(0.0)
+        s.tick(), s.tick()
+        assert det.state == "ok"
+
+    def test_growth_mode_leak_heuristic(self):
+        reg = om.MetricsRegistry()
+        g = reg.gauge("runtime_live_array_bytes", "t")
+        det = sn.Detector("leak", sn.GaugeProbe("runtime_live_array_bytes"),
+                          mode="growth", threshold=0.10,
+                          fire_after=4, clear_after=2)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0)
+        # stable: never anomalous
+        for _ in range(6):
+            g.set(1000.0)
+            s.tick()
+        assert det.state == "ok"
+        # slow monotonic growth, > 10% total over the streak: fires
+        v = 1000.0
+        for _ in range(8):
+            v *= 1.04
+            g.set(v)
+            s.tick()
+        assert det.state == "firing"
+        # sustained plateau: the first plateau_tolerance ticks hold the
+        # streak (no information), the rest count clean and clear
+        for _ in range(det.plateau_tolerance + 3):
+            s.tick()
+        assert det.state == "ok"
+
+    def test_steppy_leak_with_plateaus_still_fires(self):
+        # allocator-chunk leaks plateau between chunks (e.g. grow every
+        # ~30s under a 10s tick): the plateau ticks within tolerance
+        # must HOLD the streak/anchor, not restart the fire_after count
+        reg = om.MetricsRegistry()
+        g = reg.gauge("runtime_live_array_bytes", "t")
+        det = sn.Detector("leak", sn.GaugeProbe("runtime_live_array_bytes"),
+                          mode="growth", threshold=0.10,
+                          fire_after=4, clear_after=2,
+                          plateau_tolerance=2)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0)
+        v = 1000.0
+        g.set(v)
+        s.tick()
+        for _ in range(8):  # grow-plateau-plateau, repeated
+            v *= 1.06
+            g.set(v)
+            s.tick()
+            s.tick(), s.tick()  # two flat ticks: within tolerance
+        assert det.state == "firing"
+        # a plateau LONGER than the tolerance counts clean and clears
+        for _ in range(det.plateau_tolerance + 3):
+            s.tick()
+        assert det.state == "ok"
+
+    def test_counter_rate_probe_uses_injected_clock(self):
+        # the sentinel's deterministic test clock must reach rate
+        # probes: dv/dt computed from tick(now=...), not wall time
+        reg = om.MetricsRegistry()
+        c = reg.counter("runtime_jit_compiles_total", "t")
+        p = sn.CounterRateProbe("runtime_jit_compiles_total")
+        fams = lambda: slo._doc_map([reg])  # noqa: E731
+        assert p.sample(fams(), 100.0) is None  # anchors at t=100
+        c.inc(5)
+        assert p.sample(fams(), 110.0) == pytest.approx(0.5)
+        c.inc(30)
+        assert p.sample(fams(), 112.0) == pytest.approx(15.0)
+
+    def test_growth_from_zero_start_still_fires(self):
+        # a leak that begins at 0 bytes anchors at the first POSITIVE
+        # level (fractional growth from zero is undefined) and must
+        # still fire once the streak's growth clears the threshold
+        reg = om.MetricsRegistry()
+        g = reg.gauge("runtime_live_array_bytes", "t")
+        det = sn.Detector("leak", sn.GaugeProbe("runtime_live_array_bytes"),
+                          mode="growth", threshold=0.10,
+                          fire_after=4, clear_after=2)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0)
+        g.set(0.0)
+        s.tick()
+        v = 0.0
+        for _ in range(8):
+            v = (v or 256.0) * 2.0    # 0 -> 512 -> 1024 -> ...
+            g.set(v)
+            s.tick()
+        assert det.state == "firing"
+
+    def test_tiny_monotonic_growth_below_threshold_never_fires(self):
+        reg = om.MetricsRegistry()
+        g = reg.gauge("runtime_live_array_bytes", "t")
+        det = sn.Detector("leak", sn.GaugeProbe("runtime_live_array_bytes"),
+                          mode="growth", threshold=0.10,
+                          fire_after=4, clear_after=2)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0)
+        v = 1000.0
+        for _ in range(12):
+            v += 0.5  # growing, but ~0.6% total: under the 10% gate
+            g.set(v)
+            s.tick()
+        assert det.state != "firing"
+
+    def test_fire_after_must_allow_hysteresis(self):
+        with pytest.raises(ValueError, match="fire_after"):
+            sn.Detector("d", sn.GaugeProbe("x"), fire_after=1)
+
+    def test_metrics_and_flight_events(self):
+        _, h, det, s = self._setup()
+        for _ in range(10):
+            for _ in range(10):
+                h.observe(0.004)
+            s.tick()
+        for _ in range(3):
+            for _ in range(10):
+                h.observe(0.2)
+            s.tick()
+        sm = sn.get_sentinel_metrics()
+        assert sm.anomaly_state.value(detector="p99") == 2.0
+        assert sm.anomaly_transitions_total.value(
+            detector="p99", to="firing") == 1.0
+        assert sm.sentinel_ticks_total.value() == 13.0
+        assert sm.anomaly_firing_ticks_total.value() >= 1.0
+        evs = fr.get_flight_recorder().events(kinds=["anomaly.transition"])
+        assert [(e["data"]["detector"], e["data"]["to"]) for e in evs] == \
+            [("p99", "suspect"), ("p99", "firing")]
+
+    def test_default_detectors_cover_the_six_signals(self):
+        names = {d.name for d in sn.default_detectors()}
+        assert names == {
+            "train_step_time_regression", "serving_p99_regression",
+            "recompile_storm", "serving_queue_buildup",
+            "train_data_starvation", "live_array_bytes_leak",
+            "hbm_bytes_leak"}
+        # every probed family is in the validation vocabulary
+        known = slo.known_metric_names()
+        for d in sn.default_detectors():
+            assert d.probe.metric in known, d.probe.metric
+
+
+# ---------------------------------------------------------------------------
+# host stack sampler
+
+
+class TestHostSampler:
+    def test_busy_thread_appears_in_collapsed(self):
+        stop = threading.Event()
+
+        def _sentinel_probe_busy_loop():
+            while not stop.is_set():
+                sum(range(200))
+
+        t = threading.Thread(target=_sentinel_probe_busy_loop,
+                             name="busy-probe", daemon=True)
+        t.start()
+        sampler = hs.HostStackSampler(hz=200.0)
+        try:
+            for _ in range(30):
+                sampler.sample()
+                time.sleep(0.002)
+        finally:
+            stop.set()
+            t.join()
+        doc = sampler.collapsed()
+        assert "busy-probe;" in doc
+        assert "_sentinel_probe_busy_loop" in doc
+        # collapsed-format grammar: every line is "stack count"
+        for line in doc.splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and int(count) >= 1
+
+    def test_own_thread_excluded(self):
+        # the sampling thread never sees itself — but another sampler's
+        # thread elsewhere in the process is an ordinary thread to US
+        # (a prior test's global sampler may be live), so assert on the
+        # CALLING thread's name, not on sampler thread names in general
+        sampler = hs.HostStackSampler()
+        t = threading.Thread(target=sampler.sample,
+                             name="sampling-self-probe")
+        t.start()
+        t.join()
+        assert "sampling-self-probe;" not in sampler.collapsed()
+
+    def test_depth_cap(self):
+        def recurse(n):
+            if n == 0:
+                return hs.fold_frame(__import__("sys")._getframe(), 5)
+            return recurse(n - 1)
+
+        folded = recurse(30)
+        assert len(folded.split(";")) == 5
+
+    def test_unique_stack_cap_and_overflow(self):
+        sampler = hs.HostStackSampler(max_stacks=2)
+        with sampler._lock:
+            for i in range(10):
+                key = ("t", f"stack-{i}")
+                if key not in sampler._stacks and \
+                        len(sampler._stacks) >= sampler.max_stacks:
+                    sampler._overflow_total += 1
+                    key = ("t", hs._OVERFLOW_KEY)
+                sampler._stacks[key] = sampler._stacks.get(key, 0) + 1
+        d = sampler.dump()
+        assert d["unique_stacks"] <= 3  # 2 + the overflow bucket
+        assert d["overflow_samples_total"] == 8
+
+    def test_arm_raises_rate_and_decays(self):
+        sampler = hs.HostStackSampler(hz=5.0, armed_hz=500.0)
+        assert sampler.current_hz() == 5.0
+        sampler.arm(0.2)
+        assert sampler.armed
+        assert sampler.current_hz() == 500.0
+        assert _wait_for(lambda: not sampler.armed, timeout=2.0)
+        assert sampler.current_hz() == 5.0
+
+    def test_armed_thread_samples_faster(self):
+        sampler = hs.HostStackSampler(hz=2.0, armed_hz=200.0).start()
+        try:
+            sampler.arm(0.5)
+            assert _wait_for(lambda: sampler.samples_total >= 20,
+                             timeout=2.0), sampler.samples_total
+        finally:
+            sampler.stop()
+
+    def test_dump_shape(self):
+        sampler = hs.HostStackSampler()
+        sampler.sample()
+        d = sampler.dump()
+        for key in ("hz", "armed", "samples_total", "unique_stacks",
+                    "threads", "collapsed"):
+            assert key in d
+        json.dumps(d)  # must be JSON-serializable
+
+
+# ---------------------------------------------------------------------------
+# incident bundles
+
+SYNC_ARTIFACTS = ["verdict.json", "metrics.prom", "metrics.json",
+                  "flightrecorder.json", "spans.json", "flames.txt"]
+
+
+def _verdict(detector="test_det", **kw):
+    v = {"detector": detector, "mode": "baseline", "state": "firing",
+         "observed": 0.25, "score": 42.0, "threshold": 6.0,
+         "baseline": {"n": 16, "median": 0.005, "mad": 0.0}}
+    v.update(kw)
+    return v
+
+
+class TestIncidentManager:
+    def test_bundle_contains_all_six_artifact_kinds(self, tmp_path):
+        reg = om.MetricsRegistry()
+        reg.counter("probe_total", "t").inc(3)
+        fr.record_event("test.breadcrumb", detail="pre-incident")
+        with tr.span("test.span"):
+            pass
+        sampler = hs.HostStackSampler()
+        _sample_from_thread(sampler)
+        mgr = inc.IncidentManager(tmp_path)
+        iid = mgr.open_incident(_verdict(), registries=[reg],
+                                sampler=sampler, profile=False)
+        bundle_dir = tmp_path / iid
+        for name in SYNC_ARTIFACTS:
+            assert (bundle_dir / name).is_file(), name
+        doc = mgr.get(iid)
+        assert doc["manifest"]["state"] == "open"
+        assert doc["manifest"]["detector"] == "test_det"
+        assert doc["manifest"]["profile"] == "none"
+        assert doc["artifacts"]["verdict.json"]["score"] == 42.0
+        assert "probe_total 3" in doc["artifacts"]["metrics.prom"]
+        evs = doc["artifacts"]["flightrecorder.json"]["events"]
+        assert any(e["kind"] == "test.breadcrumb" for e in evs)
+        assert any(s["name"] == "test.span"
+                   for s in doc["artifacts"]["spans.json"]["spans"])
+        assert doc["artifacts"]["flames.txt"]  # non-empty collapsed doc
+
+    def test_open_close_lifecycle_events_and_metrics(self, tmp_path):
+        mgr = inc.IncidentManager(tmp_path)
+        iid = mgr.open_incident(_verdict(), profile=False)
+        sm = sn.get_sentinel_metrics()
+        assert sm.incident_bundles_total.value(detector="test_det") == 1.0
+        assert sm.incidents_open.value() == 1.0
+        assert mgr.open_count() == 1
+        assert mgr.close_incident(iid, resolution={"state": "ok"})
+        assert not mgr.close_incident(iid)  # idempotent
+        assert sm.incidents_open.value() == 0.0
+        man = mgr.index()[0]
+        assert man["state"] == "closed" and man["duration_s"] >= 0
+        res = mgr.get(iid)
+        assert res["artifacts"]["resolution.json"]["state"] == "ok"
+        kinds = [e["kind"] for e in fr.get_flight_recorder().events(
+            kinds=["incident.open", "incident.close"])]
+        assert kinds == ["incident.open", "incident.close"]
+
+    def test_async_profile_hook_lands_in_bundle(self, tmp_path):
+        inc.register_profile_hook(
+            "test", lambda: {"available": True, "kind": "synthetic"})
+        try:
+            mgr = inc.IncidentManager(tmp_path)
+            iid = mgr.open_incident(_verdict())
+            assert _wait_for(
+                lambda: mgr.index()[0]["profile"] == "done", timeout=10)
+            doc = mgr.get(iid)
+            assert doc["artifacts"]["profile.json"]["captures"]["test"][
+                "kind"] == "synthetic"
+        finally:
+            inc.unregister_profile_hook("test")
+
+    def test_failing_profile_hook_is_a_recorded_outcome(self, tmp_path):
+        def boom():
+            raise RuntimeError("no device")
+
+        inc.register_profile_hook("test", boom)
+        try:
+            mgr = inc.IncidentManager(tmp_path)
+            iid = mgr.open_incident(_verdict())
+            assert _wait_for(
+                lambda: mgr.index()[0]["profile"] == "done", timeout=10)
+            cap = mgr.get(iid)["artifacts"]["profile.json"]["captures"]
+            assert cap["test"]["available"] is False
+            assert "no device" in cap["test"]["reason"]
+        finally:
+            inc.unregister_profile_hook("test")
+
+    def test_hung_profile_hook_is_bounded_by_profile_timeout(self, tmp_path):
+        release = threading.Event()
+
+        def hang():
+            release.wait(30)
+            return {"available": True}
+
+        inc.register_profile_hook("hung", hang)
+        inc.register_profile_hook("zfast",
+                                  lambda: {"available": True, "kind": "f"})
+        try:
+            mgr = inc.IncidentManager(tmp_path, profile_timeout_s=0.3)
+            iid = mgr.open_incident(_verdict())
+            # the hung hook must not wedge the capture: the fast hook
+            # still runs and profile.json still lands
+            assert _wait_for(
+                lambda: mgr.index()[0]["profile"] == "done", timeout=10)
+            cap = mgr.get(iid)["artifacts"]["profile.json"]["captures"]
+            assert cap["hung"]["available"] is False
+            assert "did not return" in cap["hung"]["reason"]
+            assert cap["zfast"]["available"] is True
+        finally:
+            release.set()
+            inc.unregister_profile_hook("hung")
+            inc.unregister_profile_hook("zfast")
+
+    def test_retention_prunes_oldest_closed_first(self, tmp_path):
+        mgr = inc.IncidentManager(tmp_path, max_bundles=3)
+        ids = [mgr.open_incident(_verdict(f"d{i}"), profile=False)
+               for i in range(3)]
+        mgr.close_incident(ids[0])
+        mgr.close_incident(ids[1])
+        ids.append(mgr.open_incident(_verdict("d3"), profile=False))
+        idx = {m["id"] for m in mgr.index()}
+        assert len(idx) == 3
+        assert ids[0] not in idx          # oldest CLOSED went first
+        assert ids[2] in idx              # the open one survived
+        assert not (tmp_path / ids[0]).exists()
+
+    def test_index_survives_process_restart(self, tmp_path):
+        mgr = inc.IncidentManager(tmp_path)
+        iid = mgr.open_incident(_verdict(), profile=False)
+        mgr2 = inc.IncidentManager(tmp_path)  # fresh manager, same dir
+        assert [m["id"] for m in mgr2.index()] == [iid]
+        assert mgr2.get(iid)["artifacts"]["verdict.json"]["score"] == 42.0
+
+    def test_get_rejects_traversal_shaped_ids(self, tmp_path):
+        mgr = inc.IncidentManager(tmp_path)
+        mgr.open_incident(_verdict(), profile=False)
+        assert mgr.get("../../etc/passwd") is None
+        assert mgr.get("") is None
+
+    def test_flight_dump_bounded_by_max_events(self, tmp_path):
+        for i in range(50):
+            fr.record_event("flood", i=i)
+        mgr = inc.IncidentManager(tmp_path, max_flight_events=10)
+        iid = mgr.open_incident(_verdict(), profile=False)
+        evs = mgr.get(iid)["artifacts"]["flightrecorder.json"]["events"]
+        assert len(evs) <= 10
+        # the NEWEST events were kept
+        assert evs[-1]["data"]["i"] == 49
+
+
+# ---------------------------------------------------------------------------
+# train-side step capture lifecycle
+
+
+class TestTrainStepCapture:
+    def test_timed_out_capture_releases_profiler_session(self):
+        """A waiter that times out after the fit thread started the
+        jax.profiler trace must NOT wedge the global profiler session:
+        the fit thread stops the live trace at its next step boundary,
+        and a fresh capture then starts and completes."""
+        inc.enter_training()
+        try:
+            res = {}
+            w = threading.Thread(
+                target=lambda: res.update(r=inc.request_step_capture(
+                    n_steps=10**6, timeout_s=0.5)), daemon=True)
+            w.start()
+            assert _wait_for(lambda: inc._TRAIN_CAPTURE is not None,
+                             timeout=5)
+            inc.note_train_step()           # the trace starts HERE
+            assert inc._TRAIN_CAPTURE._started
+            w.join(timeout=30)
+            assert res["r"]["available"] is False
+            assert "did not complete" in res["r"]["reason"]
+            # next step boundary: the fit thread stops the abandoned
+            # trace and clears the pending capture
+            inc.note_train_step()
+            assert inc._TRAIN_CAPTURE is None
+            # the profiler session is free again: a fresh capture runs
+            # to completion
+            res2 = {}
+            w2 = threading.Thread(
+                target=lambda: res2.update(r=inc.request_step_capture(
+                    n_steps=2, timeout_s=60.0)), daemon=True)
+            w2.start()
+            assert _wait_for(lambda: inc._TRAIN_CAPTURE is not None,
+                             timeout=5)
+            for _ in range(4):
+                inc.note_train_step()
+            w2.join(timeout=60)
+            assert res2["r"]["available"] is True, res2["r"]
+            assert res2["r"]["steps"] == 2
+        finally:
+            inc.exit_training()
+
+    def test_fit_exit_mid_capture_stops_trace_and_fails_waiter_fast(self):
+        inc.enter_training()
+        res = {}
+        w = threading.Thread(
+            target=lambda: res.update(r=inc.request_step_capture(
+                n_steps=10**6, timeout_s=60.0)), daemon=True)
+        w.start()
+        assert _wait_for(lambda: inc._TRAIN_CAPTURE is not None, timeout=5)
+        inc.note_train_step()               # trace live
+        inc.exit_training()                 # fit ends mid-capture
+        w.join(timeout=10)
+        assert w.is_alive() is False        # failed FAST, not at 60 s
+        assert res["r"]["available"] is False
+        assert "training ended" in res["r"]["reason"]
+        # the session was torn down on the fit thread: a later serving
+        # capture path can use the profiler again
+        inc.enter_training()
+        try:
+            res2 = {}
+            w2 = threading.Thread(
+                target=lambda: res2.update(r=inc.request_step_capture(
+                    n_steps=1, timeout_s=60.0)), daemon=True)
+            w2.start()
+            assert _wait_for(lambda: inc._TRAIN_CAPTURE is not None,
+                             timeout=5)
+            for _ in range(3):
+                inc.note_train_step()
+            w2.join(timeout=60)
+            assert res2["r"]["available"] is True, res2["r"]
+        finally:
+            inc.exit_training()
+
+
+# ---------------------------------------------------------------------------
+# sentinel engine -> incident pipeline (synthetic, no HTTP)
+
+
+class TestSentinelIncidentLoop:
+    def test_firing_opens_bundle_and_ok_closes_it(self, tmp_path):
+        reg = om.MetricsRegistry()
+        h = reg.histogram("train_step_seconds", "t")
+        det = sn.Detector(
+            "train_step_time_regression",
+            sn.HistogramMeanProbe("train_step_seconds", min_count=2),
+            mode="baseline", threshold=6.0, min_increase=0.25,
+            min_history=6, fire_after=2, clear_after=2)
+        mgr = inc.IncidentManager(tmp_path)
+        sampler = hs.HostStackSampler()
+        _sample_from_thread(sampler)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0,
+                        incidents=mgr, sampler=sampler)
+        for _ in range(10):               # healthy 1 ms steps
+            for _ in range(4):
+                h.observe(0.001)
+            s.tick()
+        assert det.state == "ok" and mgr.index() == []
+        for _ in range(3):                # 20 ms steps: regression
+            for _ in range(4):
+                h.observe(0.02)
+            s.tick()
+        assert det.state == "firing"
+        idx = mgr.index()
+        assert len(idx) == 1 and idx[0]["state"] == "open"
+        assert idx[0]["detector"] == "train_step_time_regression"
+        assert s.verdicts()["open_incidents"] == {
+            "train_step_time_regression": idx[0]["id"]}
+        # suspect armed the sampler's high-rate window
+        assert sampler.armed
+        doc = mgr.get(idx[0]["id"])
+        assert doc["artifacts"]["verdict.json"]["baseline"]["median"] == \
+            pytest.approx(0.001)
+        assert doc["artifacts"]["verdict.json"]["observed"] == \
+            pytest.approx(0.02)
+        for _ in range(2):                # recovery closes the incident
+            for _ in range(4):
+                h.observe(0.001)
+            s.tick()
+        assert det.state == "ok"
+        assert mgr.index()[0]["state"] == "closed"
+        assert mgr.get(idx[0]["id"])["artifacts"][
+            "resolution.json"]["state"] == "ok"
+
+    def test_fast_close_races_slow_open_no_leak(self, tmp_path):
+        """A firing->ok flip while open_incident's capture I/O is still
+        in flight (tick() is public: an on-demand caller can run beside
+        the evaluator thread, the HealthEngine /debug/health idiom) must
+        not leak the bundle open forever: the close consumes the pending
+        marker and the open path closes its own fresh bundle."""
+        reg = om.MetricsRegistry()
+        h = reg.histogram("train_step_seconds", "t")
+        det = sn.Detector(
+            "train_step_time_regression",
+            sn.HistogramMeanProbe("train_step_seconds", min_count=2),
+            mode="baseline", threshold=6.0, min_increase=0.25,
+            min_history=6, fire_after=2, clear_after=2)
+        entered = threading.Event()
+        release = threading.Event()
+
+        class SlowManager(inc.IncidentManager):
+            def open_incident(self, verdict, **kw):
+                entered.set()
+                assert release.wait(timeout=30)
+                return super().open_incident(verdict, **kw)
+
+        mgr = SlowManager(tmp_path)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0,
+                        incidents=mgr)
+        for _ in range(10):               # healthy 1 ms steps
+            for _ in range(4):
+                h.observe(0.001)
+            s.tick()
+        assert det.state == "ok"
+        for _ in range(4):                # regression tick 1: ok->suspect
+            h.observe(0.02)
+        s.tick()
+        for _ in range(4):                # regression tick 2 fires on a
+            h.observe(0.02)               # worker; its open blocks in
+        t = threading.Thread(target=s.tick, daemon=True)  # capture I/O
+        t.start()
+        assert entered.wait(timeout=10)
+        for _ in range(2):                # concurrent clean ticks close
+            for _ in range(4):            # the incident mid-capture
+                h.observe(0.001)
+            s.tick()
+        assert det.state == "ok"
+        release.set()
+        t.join(timeout=30)
+        assert t.is_alive() is False
+        # no leak: nothing stays registered open, and the bundle the
+        # slow open produced was closed by the open path itself
+        assert s.verdicts()["open_incidents"] == {}
+        idx = mgr.index()
+        assert len(idx) == 1 and idx[0]["state"] == "closed"
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exemplars
+
+
+class TestExemplars:
+    def test_observe_keeps_last_exemplar_per_bucket(self):
+        reg = om.MetricsRegistry()
+        h = reg.histogram("lat_seconds", "t", buckets=(0.01, 0.1))
+        h.observe(0.005, exemplar_trace_id="first")
+        h.observe(0.006, exemplar_trace_id="second")
+        h.observe(0.05, exemplar_trace_id="slowpoke")
+        h.observe(0.02)  # no exemplar: must not clobber
+        text = reg.render_text()
+        lines = [l for l in text.splitlines() if "# {trace_id=" in l]
+        assert len(lines) == 2
+        assert 'le="0.01"' in lines[0] and 'trace_id="second"' in lines[0]
+        assert 'le="0.1"' in lines[1] and 'trace_id="slowpoke"' in lines[1]
+        # the strict grammar oracle accepts the exemplar suffix
+        fams = parse_exposition(text)
+        assert fams["lat_seconds"]["type"] == "histogram"
+
+    def test_json_twin_carries_exemplars(self):
+        reg = om.MetricsRegistry()
+        h = reg.histogram("lat_seconds", "t", buckets=(0.01, 0.1))
+        h.observe(0.05, exemplar_trace_id="abc123")
+        sample = reg.render_json()["metrics"][0]["samples"][0]
+        ex = sample["exemplars"]["0.1"]
+        assert ex["trace_id"] == "abc123"
+        assert ex["value"] == pytest.approx(0.05)
+
+    def test_serving_request_exemplar_links_to_trace_id(self):
+        server = _server(sentinel=False)
+        server.start()
+        try:
+            status, headers, _ = _post(
+                f"{server.url}/v1/models/tiny:predict",
+                {"inputs": [[0.1, 0.2, 0.3, 0.4]]})
+            assert status == 200
+            cid = headers["X-Correlation-ID"]
+            text = server.render_metrics_text()
+            ex_lines = [l for l in text.splitlines()
+                        if l.startswith("serving_request_latency_seconds"
+                                        "_bucket") and "# {trace_id=" in l]
+            assert ex_lines, "no exemplar on the latency buckets"
+            assert any(f'trace_id="{cid}"' in l for l in ex_lines)
+            parse_exposition(text)  # whole scrape stays grammar-clean
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/profile 409 retry hint + /debug/incidents over HTTP + e2e
+
+
+class TestIncidentAcceptance:
+    # one server for the class (PR 7 fixture idiom): the 409 probe, the
+    # empty-index read, and THE acceptance loop share it — order matters,
+    # tier-1 runs with -p no:randomly
+    @pytest.fixture(scope="class")
+    def server(self, tmp_path_factory):
+        detectors = [
+            sn.Detector(
+                "serving_p99_regression",
+                sn.HistogramQuantileProbe(
+                    "serving_request_latency_seconds", q=0.99, min_count=2),
+                mode="baseline", threshold=6.0, min_increase=0.5,
+                min_history=6, fire_after=2, clear_after=2),
+        ]
+        s = _server(
+            sentinel_detectors=detectors, sentinel_interval_s=0.05,
+            incident_dir=str(tmp_path_factory.mktemp("incidents")),
+            incident_profile_ms=200.0,
+            slo_interval_s=3600.0)  # SLO engine quiet: sentinel's show
+        s.start()
+        yield s
+        s.stop()
+
+    def test_profile_409_carries_retry_after_header_and_body(self, server):
+        release = threading.Event()
+        results = {}
+
+        def long_profile():
+            results["first"] = _post(f"{server.url}/debug/profile?ms=1200",
+                                     timeout=120)
+            release.set()
+
+        t = threading.Thread(target=long_profile, daemon=True)
+        t.start()
+        time.sleep(0.3)  # the long capture holds the profiler lock now
+        status, headers, body = _post(f"{server.url}/debug/profile?ms=50")
+        release.wait(timeout=120)
+        t.join(timeout=10)
+        assert status == 409
+        err = body["error"]
+        assert err["code"] == "PROFILE_IN_PROGRESS"
+        assert err["retryable"] is True
+        # the precise ms hint and the integer-seconds header BOTH ride,
+        # like the admission/circuit 503s, so client retry composes
+        assert err["retry_after_ms"] > 0
+        assert int(headers["Retry-After"]) >= 1
+
+    def test_debug_incidents_empty_index(self, server):
+        status, body = _get(f"{server.url}/debug/incidents")
+        assert status == 200
+        d = json.loads(body)
+        assert d["incidents"] == []
+        assert d["sentinel"]["status"] == "ok"
+        names = {r["detector"] for r in d["sentinel"]["detectors"]}
+        assert names == {"serving_p99_regression"}
+
+    def test_debug_incidents_unknown_id_404(self, server):
+        status, _ = _get(f"{server.url}/debug/incidents/inc-nope")
+        assert status == 404
+
+    def test_fault_to_incident_to_recovery_acceptance(self, server):
+        """THE acceptance loop: healthy traffic builds the baseline;
+        an injected serving.latency fault drives the p99 detector
+        ok→suspect→firing; an incident bundle lands on disk with every
+        artifact kind (device profile of live traffic included), is
+        listed and fetchable over /debug/incidents, and the scrape
+        carries the anomaly_* families; the fault clears; hysteresis
+        closes the detector and the incident."""
+        sentinel = server.sentinel
+        det = sentinel.detectors[0]
+        stop = threading.Event()
+        seen_states = set()
+
+        def traffic():
+            while not stop.is_set():
+                _post(f"{server.url}/v1/models/tiny:predict",
+                      {"inputs": [[0.1, 0.2, 0.3, 0.4]]}, timeout=60)
+                time.sleep(0.005)
+
+        drivers = [threading.Thread(target=traffic, daemon=True)
+                   for _ in range(3)]
+        for d in drivers:
+            d.start()
+        try:
+            # phase 1: healthy traffic → baseline learned, detector ok
+            assert _wait_for(
+                lambda: len(det.baseline) >= det.min_history, timeout=30)
+            assert det.state == "ok"
+            # phase 2: inject 0.12 s latency on every request → p99
+            # jumps ~50x over the learned baseline
+            set_fault_injector(
+                FaultInjector()
+                .plan("serving.latency", at=1, times=10**9, arg=0.12))
+            assert _wait_for(
+                lambda: (seen_states.add(det.state),
+                         det.state == "firing")[1],
+                timeout=60), det.verdict()
+            # it went THROUGH suspect (the transition log can't miss the
+            # one-tick window the way polling det.state could)
+            assert "suspect" in {t["to"] for t in det.transitions}
+            # phase 3: the incident bundle is on disk and served
+            assert _wait_for(lambda: server.incidents.index(), timeout=10)
+            row = server.incidents.index()[0]
+            assert row["state"] == "open"
+            assert row["detector"] == "serving_p99_regression"
+            status, body = _get(f"{server.url}/debug/incidents")
+            listed = json.loads(body)["incidents"]
+            assert listed and listed[0]["id"] == row["id"]
+            status, body = _get(
+                f"{server.url}/debug/incidents/{row['id']}")
+            assert status == 200
+            doc = json.loads(body)
+            arts = doc["artifacts"]
+            for name in SYNC_ARTIFACTS:
+                assert name in arts, name
+            v = arts["verdict.json"]
+            assert v["observed"] > v["baseline"]["median"] * 10
+            assert v["score"] >= det.threshold
+            assert any(e["kind"] == "fault.injected"
+                       for e in arts["flightrecorder.json"]["events"])
+            assert arts["flames.txt"]  # host flames captured
+            assert arts["spans.json"]["spans"]  # span slice captured
+            # the scrape carries the anomaly families while firing
+            fams = parse_exposition(server.render_metrics_text())
+            assert ("anomaly_state", {"detector": "serving_p99_regression"},
+                    2.0) in fams["anomaly_state"]["samples"]
+            assert fams["incident_bundles_total"]["samples"]
+            # the device profile (server's live-traffic hook) lands async
+            assert _wait_for(
+                lambda: server.incidents.index()[0]["profile"] == "done",
+                timeout=120)
+            status, body = _get(
+                f"{server.url}/debug/incidents/{row['id']}")
+            captures = json.loads(body)["artifacts"]["profile.json"][
+                "captures"]
+            assert captures["serving"]["available"] is True, captures
+            assert captures["serving"]["trace_bytes"] > 0
+            # phase 4: fault clears → hysteresis closes detector+incident
+            set_fault_injector(FaultInjector())
+            assert _wait_for(lambda: det.state == "ok", timeout=60), \
+                det.verdict()
+            assert _wait_for(
+                lambda: server.incidents.index()[0]["state"] == "closed",
+                timeout=10)
+            kinds = [e["kind"] for e in fr.get_flight_recorder().events(
+                kinds=["incident.open", "incident.close"])]
+            assert "incident.close" in kinds
+        finally:
+            stop.set()
+            for d in drivers:
+                d.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# federation: per-worker incident indexes -> the cohort view
+
+
+class TestFederatedIncidents:
+    def test_snapshot_and_cluster_view(self, tmp_path):
+        from deeplearning4j_tpu.observability import federation as fed
+
+        mgr = inc.IncidentManager(tmp_path / "inc")
+        inc.set_incident_manager(mgr)
+        iid = mgr.open_incident(_verdict("serving_p99_regression"),
+                                profile=False)
+        snap = fed.build_snapshot()
+        assert [r["id"] for r in snap["incidents"]] == [iid]
+
+        exp = fed.TelemetryExporter(port=0).start()
+        try:
+            assert exp.mode == "http"
+            status, body = _get(f"{exp.url}/incidents")
+            assert status == 200
+            assert json.loads(body)["incidents"][0]["id"] == iid
+
+            agg = fed.ClusterAggregator(num_workers=1, port_base=exp.port)
+            agg.poll()
+            ci = agg.cluster_incidents()
+            assert ci["count"] == 1 and ci["open"] == 1
+            row = ci["incidents"][0]
+            assert row["id"] == iid and row["worker"] == 0
+            assert row["state"] == "open"
+            # the cohort dossier references the open incident
+            dossier = agg.dossier()
+            assert [r["id"] for r in dossier["open_incidents"]] == [iid]
+
+            with fed.ClusterTelemetryServer(agg) as srv:
+                status, body = _get(
+                    f"{srv.url}/cluster/debug/incidents")
+                assert status == 200
+                d = json.loads(body)
+                assert d["open"] == 1
+                assert d["incidents"][0]["id"] == iid
+            # closing the incident clears the cohort's open view
+            mgr.close_incident(iid)
+            agg.poll()
+            assert agg.cluster_incidents()["open"] == 0
+            assert agg.dossier()["open_incidents"] == []
+        finally:
+            exp.stop()
+
+    def test_malformed_incident_index_degrades_to_empty(self):
+        from deeplearning4j_tpu.observability import federation as fed
+
+        snap = {"worker": 0, "generation": 1, "time": time.time(),
+                "metrics": {"metrics": []}, "flight": {}, "spans": [],
+                "incidents": "not-a-list"}
+        clean = fed._sanitize_snapshot(snap)
+        assert clean["incidents"] == []

@@ -80,7 +80,9 @@ def _block_active_fn(entry, seconds=0.5):
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
 _LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}'
 _VALUE = r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|Inf|NaN)"
-_SAMPLE_RE = re.compile(rf"^({_NAME})({_LABELS})? {_VALUE}$")
+# optional OpenMetrics-style exemplar suffix on histogram bucket lines
+_EXEMPLAR = rf'( # \{{{_NAME}="[^"]*"\}} {_VALUE}( {_VALUE})?)?'
+_SAMPLE_RE = re.compile(rf"^({_NAME})({_LABELS})? ({_VALUE}){_EXEMPLAR}$")
 
 
 def parse_prometheus(text):
@@ -114,7 +116,7 @@ def parse_prometheus(text):
                                               f + "_count")), None)
             assert family is not None, f"sample without header: {line!r}"
             families[family]["samples"].append(
-                (sample_name, m.group(2) or "", float(line.rsplit(" ", 1)[1])))
+                (sample_name, m.group(2) or "", float(m.group(4))))
     for name, fam in families.items():
         if fam["type"] == "histogram":
             by_series = {}
