@@ -70,6 +70,8 @@ from deeplearning4j_tpu.observability.incidents import (
 )
 from deeplearning4j_tpu.observability.metrics import (
     COMPILE_BUCKETS,
+    CONTENT_TYPE_OPENMETRICS,
+    CONTENT_TYPE_TEXT,
     DEFAULT_LATENCY_BUCKETS,
     OCCUPANCY_BUCKETS,
     CheckpointMetrics,
@@ -88,6 +90,7 @@ from deeplearning4j_tpu.observability.metrics import (
     render_text_multi,
     reset_default_registry,
     set_enabled,
+    wants_openmetrics,
 )
 from deeplearning4j_tpu.observability.runtime import (
     RuntimeCollector,
@@ -133,6 +136,8 @@ from deeplearning4j_tpu.observability.trace import (
 
 __all__ = [
     "COMPILE_BUCKETS",
+    "CONTENT_TYPE_OPENMETRICS",
+    "CONTENT_TYPE_TEXT",
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_WINDOWS",
     "OCCUPANCY_BUCKETS",
@@ -213,5 +218,6 @@ __all__ = [
     "to_chrome_trace",
     "tracing_enabled",
     "validate_rules_doc",
+    "wants_openmetrics",
     "write_chrome_trace",
 ]

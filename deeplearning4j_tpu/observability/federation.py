@@ -73,6 +73,8 @@ from deeplearning4j_tpu.observability.flightrecorder import (
     get_flight_recorder,
 )
 from deeplearning4j_tpu.observability.metrics import (
+    CONTENT_TYPE_OPENMETRICS,
+    CONTENT_TYPE_TEXT,
     Counter,
     Gauge,
     Histogram,
@@ -80,6 +82,7 @@ from deeplearning4j_tpu.observability.metrics import (
     default_registry,
     render_json_multi,
     render_text_multi,
+    wants_openmetrics,
 )
 
 ENV_TELEMETRY_PORT = "DL4J_TPU_TELEMETRY_PORT"
@@ -367,9 +370,13 @@ class TelemetryExporter:
                     if "format=json" in query:
                         self._send(200, render_json_multi(regs))
                     else:
+                        om = wants_openmetrics(self.headers.get("Accept"))
                         self._send(
-                            200, render_text_multi(regs).encode(),
-                            content_type="text/plain; version=0.0.4")
+                            200,
+                            render_text_multi(
+                                regs, openmetrics=om).encode(),
+                            content_type=(CONTENT_TYPE_OPENMETRICS if om
+                                          else CONTENT_TYPE_TEXT))
                 elif path == "/flightrecorder":
                     seconds, ok = self._seconds_param(query)
                     if not ok:
@@ -558,8 +565,8 @@ class FederatedRegistry:
     def names(self) -> List[str]:
         return [i.name for i in self.instruments()]
 
-    def render_text(self) -> str:
-        return render_text_multi([self])
+    def render_text(self, *, openmetrics: bool = False) -> str:
+        return render_text_multi([self], openmetrics=openmetrics)
 
     def render_json(self) -> dict:
         return render_json_multi([self])
@@ -1008,8 +1015,8 @@ class ClusterAggregator:
         aggregator's own families win)."""
         return [self.metrics.registry, self.federated]
 
-    def render_metrics_text(self) -> str:
-        return render_text_multi(self.registries())
+    def render_metrics_text(self, *, openmetrics: bool = False) -> str:
+        return render_text_multi(self.registries(), openmetrics=openmetrics)
 
     def render_metrics_json(self) -> dict:
         return render_json_multi(self.registries())
@@ -1272,9 +1279,13 @@ class ClusterTelemetryServer:
                     if "format=json" in query:
                         self._send(200, agg.render_metrics_json())
                     else:
+                        om = wants_openmetrics(self.headers.get("Accept"))
                         self._send(
-                            200, agg.render_metrics_text().encode(),
-                            content_type="text/plain; version=0.0.4")
+                            200,
+                            agg.render_metrics_text(
+                                openmetrics=om).encode(),
+                            content_type=(CONTENT_TYPE_OPENMETRICS if om
+                                          else CONTENT_TYPE_TEXT))
                 elif path == "/cluster/debug/workers":
                     self._send(200, agg.workers())
                 elif path == "/cluster/debug/flightrecorder":

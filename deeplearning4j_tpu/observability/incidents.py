@@ -63,6 +63,11 @@ _ID_SAFE_RE = re.compile(r"[^A-Za-z0-9_.\-]+")
 # route must only ever resolve names this shape (no separators, no dots
 # leading) — belt and suspenders against traversal
 INCIDENT_ID_RE = re.compile(r"^inc-[0-9]{13}-[0-9]{3}-[A-Za-z0-9_.\-]+$")
+# artifact names come from on-disk manifests the manager merely ADOPTED
+# (_load_existing), so the fetch surface treats them as untrusted: a
+# strict allowlist (no separators, no leading dot, so never '..' or a
+# hidden/staging file) keeps ``bundle_dir / name`` inside the bundle
+_ARTIFACT_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.\-]*$")
 
 ENV_INCIDENT_DIR = "DL4J_TPU_INCIDENT_DIR"
 
@@ -119,10 +124,24 @@ class IncidentManager:
         for p in sorted(self.dir.glob("inc-*/incident.json")):
             try:
                 man = json.loads(p.read_text())
-                if isinstance(man, dict) and man.get("id"):
-                    self._manifests[man["id"]] = man
-            except (OSError, ValueError):
-                continue
+            except OSError:
+                continue  # unreadable right now: leave it alone
+            except ValueError:
+                man = None
+            # adopted manifests are untrusted disk content: the id must
+            # match the directory it came from AND the strict id shape,
+            # or a crafted incident.json could point retention's rmtree
+            # / the fetch surface outside the incidents dir
+            if isinstance(man, dict) and man.get("id") == p.parent.name \
+                    and INCIDENT_ID_RE.match(str(man["id"])):
+                self._manifests[man["id"]] = man
+            else:
+                # un-adoptable bundle (forged or corrupt manifest): it
+                # would never enter _manifests, so retention could never
+                # prune it and it would occupy the "bounded" dir forever
+                # — drop it now. Our own writers stage + rename, so a
+                # valid bundle is never visible in this state.
+                shutil.rmtree(p.parent, ignore_errors=True)
 
     def _write_manifest(self, bundle_dir: Path, manifest: dict):
         tmp = bundle_dir / ".incident.json.tmp"
@@ -168,8 +187,12 @@ class IncidentManager:
             (staging / "verdict.json").write_text(
                 json.dumps(verdict, indent=2, default=str))
             try:
+                # the bundle is a self-contained post-mortem read by
+                # humans, never scraped by a classic parser: keep the
+                # exemplar suffixes (slow bucket -> trace id) in the
+                # text artifact too
                 (staging / "metrics.prom").write_text(
-                    _metrics.render_text_multi(regs))
+                    _metrics.render_text_multi(regs, openmetrics=True))
                 (staging / "metrics.json").write_text(
                     json.dumps(_metrics.render_json_multi(regs),
                                default=str))
@@ -349,6 +372,12 @@ class IncidentManager:
         bundle_dir = self.dir / incident_id
         out = {"manifest": man, "artifacts": {}}
         for name in man.get("artifacts", []):
+            name = str(name)
+            if not _ARTIFACT_NAME_RE.match(name):
+                # adopted-manifest artifact names are untrusted: a name
+                # with a separator or leading dot could read outside the
+                # bundle over the debug surface — never serve it
+                continue
             path = bundle_dir / name
             try:
                 text = path.read_text()

@@ -9,7 +9,11 @@ family, where one StatsStorage held every module's series).
 Exposition semantics follow the Prometheus text format scrapers expect:
 ``# HELP``/``# TYPE`` headers (HELP text escaped per the format:
 backslash and newline), cumulative ``_bucket{le=...}`` series,
-``_sum``/``_count``. A JSON twin serves scripts and tests.
+``_sum``/``_count``. A JSON twin serves scripts and tests. Exemplars
+(kept per histogram bucket by ``observe(..., exemplar_trace_id=)``)
+appear only in the JSON twin and in the OpenMetrics rendering a client
+negotiates via ``Accept: application/openmetrics-text`` — never in the
+classic text format, whose grammar forbids them.
 
 Registration is strict: a second instrument under an already-reserved
 name — including a histogram's derived ``_bucket``/``_sum``/``_count``
@@ -46,6 +50,35 @@ COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
 
 _METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Content types the /metrics endpoints negotiate between. Exemplars are
+# an *OpenMetrics* construct: a classic-format parser treats the
+# mid-line '#' as garbage and rejects the whole scrape, so the default
+# (classic) rendering NEVER carries them — a client opts in via
+# ``Accept: application/openmetrics-text`` and gets the exemplar
+# suffixes plus the mandatory ``# EOF`` trailer.
+CONTENT_TYPE_TEXT = "text/plain; version=0.0.4"
+CONTENT_TYPE_OPENMETRICS = ("application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
+
+
+def wants_openmetrics(accept: Optional[str]) -> bool:
+    """Did the request's Accept header negotiate OpenMetrics?
+
+    Deliberately conservative: OpenMetrics only when the client asks
+    for it WITHOUT also accepting the classic text format. A stock
+    Prometheus server (>= 2.49) advertises both media types with
+    q-values and reliably parses classic, so it gets the classic
+    document — serving a type the client listed is valid content
+    negotiation, and this hand-rolled OpenMetrics variant is
+    "OpenMetrics-style" (counter families keep their ``_total`` names)
+    rather than strictly spec-compliant, so it is reserved for clients
+    that explicitly ask for it alone (curl, tests, exemplar-aware
+    tooling). Media types compare case-insensitively (RFC 9110)."""
+    accept = (accept or "").lower()
+    if "application/openmetrics-text" not in accept:
+        return False
+    return "text/plain" not in accept
 
 
 def _fmt(v: float) -> str:
@@ -131,7 +164,7 @@ class Counter(_Instrument):
         with self._lock:
             return float(self._data.get(self._key(labels), 0.0))
 
-    def render(self) -> List[str]:
+    def render(self, *, openmetrics: bool = False) -> List[str]:
         with self._lock:
             return [f"{self.name}{self._label_str(k)} {_fmt(v)}"
                     for k, v in sorted(self._data.items())]
@@ -201,12 +234,12 @@ class Histogram(_Instrument):
             return {"count": st["n"], "sum": st["sum"],
                     "mean": st["sum"] / st["n"] if st["n"] else 0.0}
 
-    def render(self) -> List[str]:
+    def render(self, *, openmetrics: bool = False) -> List[str]:
         lines = []
         with self._lock:
             for key, st in sorted(self._data.items()):
                 cum = 0
-                exemplars = st.get("exemplars", {})
+                exemplars = st.get("exemplars", {}) if openmetrics else {}
                 for i, (b, c) in enumerate(zip(self.buckets, st["counts"])):
                     cum += c
                     le = 'le="%s"' % _fmt(b)
@@ -216,6 +249,8 @@ class Histogram(_Instrument):
                         # OpenMetrics exemplar suffix on the bucket the
                         # observation landed in:
                         #   ... # {trace_id="<id>"} <value> <timestamp>
+                        # (only under the negotiated OpenMetrics format —
+                        # a classic parser errors on the mid-line '#')
                         tid, val, ts = ex
                         line += (f' # {{trace_id="{_esc_label(tid)}"}} '
                                  f"{_fmt(val)} {repr(round(ts, 3))}")
@@ -305,17 +340,25 @@ class MetricsRegistry:
     def names(self) -> List[str]:
         return [i.name for i in self.instruments()]
 
-    def render_text(self) -> str:
-        return render_text_multi([self])
+    def render_text(self, *, openmetrics: bool = False) -> str:
+        return render_text_multi([self], openmetrics=openmetrics)
 
     def render_json(self) -> dict:
         return render_json_multi([self])
 
 
-def render_text_multi(registries: Sequence[MetricsRegistry]) -> str:
+def render_text_multi(registries: Sequence[MetricsRegistry], *,
+                      openmetrics: bool = False) -> str:
     """One exposition document over several registries (first wins on a
     family-name collision — how the serving bundle's private registry and
-    the process default merge into one scrape)."""
+    the process default merge into one scrape).
+
+    ``openmetrics=True`` renders the negotiated OpenMetrics variant:
+    histogram buckets carry their exemplar suffixes and the document
+    ends with the mandatory ``# EOF`` marker. The default (classic
+    ``text/plain; version=0.0.4``) document never carries exemplars —
+    they are invalid in that grammar and would fail the whole scrape.
+    """
     out: List[str] = []
     seen = set()
     for reg in registries:
@@ -325,7 +368,9 @@ def render_text_multi(registries: Sequence[MetricsRegistry]) -> str:
             seen.add(inst.name)
             out.append(f"# HELP {inst.name} {_esc_help(inst.help)}")
             out.append(f"# TYPE {inst.name} {inst.kind}")
-            out.extend(inst.render())
+            out.extend(inst.render(openmetrics=openmetrics))
+    if openmetrics:
+        out.append("# EOF")
     return "\n".join(out) + "\n"
 
 

@@ -91,24 +91,35 @@ def _match(labels: dict, match: Tuple[Tuple[str, str], ...]) -> bool:
 class CounterRateProbe(Probe):
     """delta(counter) / delta(t) in events/second over the tick; a
     negative delta (process restart / registry reset) re-anchors and
-    yields None for that tick."""
+    yields None for that tick. While the family is absent (or has no
+    matching series yet — lazily-registered counters appear at first
+    use), the tick carries no information and the anchor is dropped, so
+    the first appearance re-anchors instead of reading the whole
+    cumulative count as one tick's delta (a spurious rate spike that
+    would flip a ceiling detector to suspect)."""
 
     def __init__(self, metric: str, match: Dict[str, str] = ()):
         self.metric = metric
         self.match = tuple(sorted(dict(match or {}).items()))
         self._prev: Optional[Tuple[float, float]] = None  # (t, value)
 
-    def _value(self, families) -> float:
+    def _value(self, families) -> Optional[float]:
         fam = families.get(self.metric)
         if fam is None or fam.get("type") not in ("counter", "gauge"):
-            return 0.0
-        return float(sum(s["value"] for s in fam.get("samples", [])
-                         if _match(s.get("labels", {}), self.match)))
+            return None
+        vals = [s["value"] for s in fam.get("samples", [])
+                if _match(s.get("labels", {}), self.match)]
+        if not vals:
+            return None
+        return float(sum(vals))
 
     def sample(self, families, t=None) -> Optional[float]:
         if t is None:
             t = time.monotonic()
         v = self._value(families)
+        if v is None:
+            self._prev = None  # family absent: re-anchor on appearance
+            return None
         prev, self._prev = self._prev, (t, v)
         if prev is None:
             return None
@@ -281,14 +292,24 @@ class RollingBaseline:
         med = self.median()
         return float(statistics.median(abs(v - med) for v in self._vals))
 
-    def score(self, x: float, *, rel_floor: float = 0.05) -> float:
+    def score(self, x: float, *, rel_floor: float = 0.05,
+              abs_floor: float = 0.0) -> float:
         """Robust z of ``x`` against the window. The scale gets a floor
         of ``rel_floor * |median|`` — an ultra-stable series (MAD 0)
-        must not turn microscopic jitter into infinite scores."""
+        must not turn microscopic jitter into infinite scores — plus an
+        optional absolute ``abs_floor`` in the probe's own unit, the
+        only meaningful scale when the window learned a flat zero."""
         med = self.median()
         scale = _MAD_SIGMA * self.mad()
-        floor = max(rel_floor * abs(med), 1e-12)
+        floor = max(rel_floor * abs(med), abs_floor, 1e-12)
         return (x - med) / max(scale, floor)
+
+    def degenerate(self, eps: float = 1e-9) -> bool:
+        """True while the window carries no scale information — median
+        AND MAD both ~0 (a series that idled at 0 through warmup). A
+        robust z against such a window is meaningless: the 1e-12 floor
+        would turn any positive sample into an astronomical score."""
+        return abs(self.median()) <= eps and self.mad() <= eps
 
     def to_json(self) -> dict:
         return {"n": len(self._vals), "median": self.median(),
@@ -325,6 +346,16 @@ class Detector:
     consecutive clean ticks to close. ``min_history`` baseline samples
     must accumulate before a baseline detector judges at all — a
     fresh process can't fire on its own warmup.
+
+    ``scale_floor`` (baseline mode) is an absolute lower bound on the
+    robust-z scale, in the probe's own unit. When it is 0 (default) and
+    the learned baseline is *degenerate* (median and MAD both ~0 — a
+    gauge that idled at 0 through warmup), the detector skips judgement
+    and keeps feeding the baseline instead: a z-score against a ~0
+    scale is meaningless, and first real traffic after an idle warmup
+    must re-teach the baseline, not open an incident. Set it > 0 to
+    keep judging off an idle baseline with a unit-appropriate scale
+    (e.g. 1 request of queue depth).
     """
 
     def __init__(self, name: str, probe: Probe, *,
@@ -332,7 +363,7 @@ class Detector:
                  min_increase: float = 0.25, min_abs: float = 0.0,
                  baseline_window: int = 64, min_history: int = 8,
                  fire_after: int = 3, clear_after: int = 3,
-                 plateau_tolerance: int = 2,
+                 plateau_tolerance: int = 2, scale_floor: float = 0.0,
                  description: str = ""):
         if mode not in ("baseline", "ceiling", "growth"):
             raise ValueError(f"unknown detector mode {mode!r}")
@@ -348,6 +379,7 @@ class Detector:
         self.threshold = float(threshold)
         self.min_increase = float(min_increase)
         self.min_abs = float(min_abs)
+        self.scale_floor = float(scale_floor)
         self.min_history = int(min_history)
         self.fire_after = int(fire_after)
         self.clear_after = int(clear_after)
@@ -405,7 +437,14 @@ class Detector:
         if len(self.baseline) < self.min_history:
             self.baseline.add(x)
             return None, 0.0
-        score = self.baseline.score(x)
+        if self.scale_floor <= 0.0 and self.baseline.degenerate():
+            # the window learned a flat zero (series idled through
+            # warmup) and no absolute scale was configured: unjudgeable
+            # — keep feeding the baseline so it re-learns "normal"
+            # under real traffic instead of scoring it ~1e12
+            self.baseline.add(x)
+            return None, 0.0
+        score = self.baseline.score(x, abs_floor=self.scale_floor)
         med = self.baseline.median()
         anomalous = (score >= self.threshold
                      and x >= med * (1.0 + self.min_increase)
@@ -481,6 +520,7 @@ class Detector:
             "observed": self.last_sample,
             "score": round(self.last_score, 3),
             "threshold": self.threshold,
+            "scale_floor": self.scale_floor,
             "baseline": self.baseline.to_json(),
             "probe": self.probe.describe(),
             "fire_after": self.fire_after,
@@ -524,6 +564,16 @@ def default_detectors(*, fire_after: int = 3, clear_after: int = 3,
             "serving_queue_buildup",
             GaugeProbe("serving_queue_depth"),
             mode="baseline", threshold=8.0, min_increase=1.0, min_abs=8.0,
+            # scale_floor deliberately 0: a server that idled through
+            # warmup learns a degenerate all-zero baseline, and the
+            # first traffic ramp then RE-TEACHES it instead of opening
+            # an incident on normal load. The cost is a bounded blind
+            # window (until the window median goes positive) for a
+            # buildup that starts from idle — during which real queue
+            # pathology still surfaces via serving_p99_regression and
+            # the SLO latency burn rules. Operators who prefer absolute
+            # judgement off an idle baseline set scale_floor=1.0 (one
+            # queue slot) on their own detector list.
             description="Admission queue depth far above its rolling "
                         "baseline: arrivals outpace dispatch.", **k),
         Detector(

@@ -86,8 +86,8 @@ class ServingMetrics:
             "Circuit-breaker state transitions.",
             ("model", "version", "to"))
 
-    def render_text(self) -> str:
-        return self.registry.render_text()
+    def render_text(self, *, openmetrics: bool = False) -> str:
+        return self.registry.render_text(openmetrics=openmetrics)
 
     def render_json(self) -> dict:
         return self.registry.render_json()

@@ -94,9 +94,12 @@ from deeplearning4j_tpu.observability.flightrecorder import (
 )
 from deeplearning4j_tpu.observability.hostsampler import get_host_sampler
 from deeplearning4j_tpu.observability.metrics import (
+    CONTENT_TYPE_OPENMETRICS,
+    CONTENT_TYPE_TEXT,
     default_registry,
     render_json_multi,
     render_text_multi,
+    wants_openmetrics,
 )
 from deeplearning4j_tpu.parallel.inference import (
     InferenceQueueFull,
@@ -258,9 +261,13 @@ class ModelServer:
                     if "format=json" in query:
                         self._send(200, server.render_metrics_json())
                     else:
+                        om = wants_openmetrics(self.headers.get("Accept"))
                         self._send(
-                            200, server.render_metrics_text().encode(),
-                            content_type="text/plain; version=0.0.4")
+                            200,
+                            server.render_metrics_text(
+                                openmetrics=om).encode(),
+                            content_type=(CONTENT_TYPE_OPENMETRICS if om
+                                          else CONTENT_TYPE_TEXT))
                 elif path == "/debug/health":
                     if "format=text" in query:
                         self._send(200, server.render_health_text().encode(),
@@ -548,11 +555,15 @@ class ModelServer:
 
     # -- metrics exposition ---------------------------------------------------
 
-    def render_metrics_text(self) -> str:
+    def render_metrics_text(self, *, openmetrics: bool = False) -> str:
         """The /metrics document: this server's bundle UNION the
         process-global default registry (train / resilience / checkpoint /
-        runtime collector series) — one scrape tells the whole story."""
-        return render_text_multi([self.metrics.registry, default_registry()])
+        runtime collector series) — one scrape tells the whole story.
+        ``openmetrics=True`` is the Accept-negotiated variant (exemplar
+        suffixes + ``# EOF`` trailer); the default classic format never
+        carries exemplars."""
+        return render_text_multi([self.metrics.registry, default_registry()],
+                                 openmetrics=openmetrics)
 
     def render_metrics_json(self) -> dict:
         return render_json_multi([self.metrics.registry, default_registry()])

@@ -76,8 +76,10 @@ def parse_exposition(text: str):
     {family: {"help", "type", "samples": [(name, labels_dict, value)]}}.
     """
     families, current, last_was_help = {}, None, False
-    for line in text.splitlines():
-        if not line:
+    lines = [l for l in text.splitlines() if l]
+    for i, line in enumerate(lines):
+        if line == "# EOF":  # OpenMetrics end marker: last line only
+            assert i == len(lines) - 1, f"# EOF mid-document at line {i}"
             continue
         if line.startswith("# HELP "):
             rest = line[len("# HELP "):]

@@ -144,6 +144,18 @@ class TestRollingBaseline:
             b.add(v)
         assert b.median() == 9.0
 
+    def test_degenerate_window_and_abs_floor(self):
+        b = sn.RollingBaseline(window=8)
+        for _ in range(8):
+            b.add(0.0)       # idled through warmup: no scale information
+        assert b.degenerate()
+        # an absolute floor gives the score a meaningful unit again
+        assert b.score(5.0, abs_floor=1.0) == pytest.approx(5.0)
+        b2 = sn.RollingBaseline(window=8)
+        for _ in range(8):
+            b2.add(3.0)      # stable but nonzero: rel_floor applies
+        assert not b2.degenerate()
+
 
 class TestProbes:
     def test_histogram_mean_probe_deltas(self):
@@ -198,11 +210,31 @@ class TestProbes:
         c = reg.counter("runtime_jit_compiles_total", "t")
         p = sn.CounterRateProbe("runtime_jit_compiles_total")
         fams = lambda: slo._doc_map([reg])  # noqa: E731
-        assert p.sample(fams()) is None
+        assert p.sample(fams()) is None  # no series yet: no information
+        c.inc(5)
+        assert p.sample(fams()) is None  # first appearance: anchor only
         c.inc(5)
         time.sleep(0.01)
         rate = p.sample(fams())
         assert rate is not None and rate > 0
+
+    def test_counter_first_appearance_is_not_a_rate_spike(self):
+        # the family materializes AFTER the probe started ticking
+        # (lazily-registered counters appear at first use): its whole
+        # cumulative count must not read as one tick's delta.
+        # Regression: absence used to read as value 0.0, so a counter
+        # appearing at 600 looked like a 600-event tick and flipped the
+        # recompile_storm detector to suspect (arming the sampler) on
+        # perfectly healthy history
+        reg = om.MetricsRegistry()
+        p = sn.CounterRateProbe("runtime_jit_compiles_total")
+        fams = lambda: slo._doc_map([reg])  # noqa: E731
+        assert p.sample(fams(), 0.0) is None   # family absent
+        c = reg.counter("runtime_jit_compiles_total", "t")
+        c.inc(600)                             # pre-existing history
+        assert p.sample(fams(), 1.0) is None   # appearance re-anchors
+        c.inc(1)
+        assert p.sample(fams(), 2.0) == pytest.approx(1.0)
 
     def test_counter_reset_yields_none(self):
         reg = om.MetricsRegistry()
@@ -302,6 +334,53 @@ class TestDetectorStateMachine:
         tos = [t["to"] for t in det.transitions]
         assert tos == ["suspect", "firing", "ok"]
 
+    def test_idle_zero_baseline_skips_judgement_and_relearns(self):
+        # serving_queue_depth idles at 0 through warmup: the learned
+        # baseline has median == MAD == 0, so a robust z against it is
+        # meaningless. First real traffic must re-teach the baseline,
+        # not open an incident (regression: the 1e-12 scale floor
+        # scored any positive depth ~1e12, so three busy ticks after an
+        # idle warmup opened an incident on normal load)
+        reg = om.MetricsRegistry()
+        g = reg.gauge("serving_queue_depth", "t")
+        det = sn.Detector(
+            "serving_queue_buildup", sn.GaugeProbe("serving_queue_depth"),
+            mode="baseline", threshold=8.0, min_increase=1.0, min_abs=8.0,
+            min_history=6, fire_after=2, clear_after=2)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0)
+        g.set(0.0)
+        for _ in range(10):               # idle warmup: all-zero window
+            s.tick()
+        for depth in (9, 12, 10, 11, 12, 10, 9, 11, 10, 12, 11, 10):
+            g.set(float(depth))           # normal load after the idle
+            s.tick()                      # warmup: unjudgeable, absorbed
+        assert det.state == "ok"
+        assert det.transitions == []      # never even suspect
+        assert det.baseline.median() >= 9.0   # re-learned under traffic
+        for _ in range(3):                # a genuine buildup against the
+            g.set(60.0)                   # re-learned baseline still
+            s.tick()                      # fires
+        assert det.state == "firing"
+
+    def test_scale_floor_keeps_judging_an_idle_baseline(self):
+        # with an absolute scale configured (1 queue slot), an idle
+        # baseline stays judgeable: the z-score is in slot units
+        reg = om.MetricsRegistry()
+        g = reg.gauge("serving_queue_depth", "t")
+        det = sn.Detector(
+            "qd", sn.GaugeProbe("serving_queue_depth"),
+            mode="baseline", threshold=8.0, min_increase=1.0, min_abs=8.0,
+            scale_floor=1.0, min_history=4, fire_after=2, clear_after=2)
+        s = sn.Sentinel([det], registries=[reg], interval_s=10.0)
+        g.set(0.0)
+        for _ in range(6):
+            s.tick()
+        for _ in range(2):
+            g.set(16.0)
+            s.tick()
+        assert det.state == "firing"
+        assert det.last_score == pytest.approx(16.0)  # z in slot units
+
     def test_ceiling_mode_starvation(self):
         reg = om.MetricsRegistry()
         g = reg.gauge("train_data_starved", "t")
@@ -377,6 +456,7 @@ class TestDetectorStateMachine:
         # probes: dv/dt computed from tick(now=...), not wall time
         reg = om.MetricsRegistry()
         c = reg.counter("runtime_jit_compiles_total", "t")
+        c.inc(1)  # the series must exist before the probe can anchor
         p = sn.CounterRateProbe("runtime_jit_compiles_total")
         fams = lambda: slo._doc_map([reg])  # noqa: E731
         assert p.sample(fams(), 100.0) is None  # anchors at t=100
@@ -691,6 +771,39 @@ class TestIncidentManager:
         assert mgr.get("../../etc/passwd") is None
         assert mgr.get("") is None
 
+    def test_get_never_serves_traversal_shaped_artifact_names(self, tmp_path):
+        # _load_existing adopts incident.json files it did not write: a
+        # crafted manifest listing '../../secret' as an artifact must
+        # not let the unauthenticated debug surface read outside the
+        # bundle dir
+        secret = tmp_path / "secret.txt"
+        secret.write_text("hands off")
+        incidents_dir = tmp_path / "incidents"
+        mgr = inc.IncidentManager(incidents_dir)
+        iid = mgr.open_incident(_verdict(), profile=False)
+        man = json.loads((incidents_dir / iid / "incident.json").read_text())
+        man["artifacts"] += ["../../secret.txt", "../secret.txt",
+                            "/etc/hostname", ".hidden", "..", "."]
+        (incidents_dir / iid / "incident.json").write_text(json.dumps(man))
+        doc = inc.IncidentManager(incidents_dir).get(iid)  # adopts from disk
+        assert set(doc["artifacts"]) == set(SYNC_ARTIFACTS)
+        assert not any("secret" in str(v) for v in doc["artifacts"].values())
+
+    def test_load_existing_rejects_forged_manifest_ids(self, tmp_path):
+        # the adopted manifest's id must equal the directory it came
+        # from and match the strict id shape — a forged id could point
+        # retention's rmtree (and the fetch path) outside the dir
+        mgr = inc.IncidentManager(tmp_path)
+        iid = mgr.open_incident(_verdict(), profile=False)
+        man = json.loads((tmp_path / iid / "incident.json").read_text())
+        man["id"] = "../../../var"
+        (tmp_path / iid / "incident.json").write_text(json.dumps(man))
+        assert inc.IncidentManager(tmp_path).index() == []
+        # and the un-adoptable dir is removed — retention could never
+        # prune a bundle that is not in the index, so leaving it would
+        # grow the "bounded" dir forever
+        assert not (tmp_path / iid).exists()
+
     def test_flight_dump_bounded_by_max_events(self, tmp_path):
         for i in range(50):
             fr.record_event("flood", i=i)
@@ -894,14 +1007,44 @@ class TestExemplars:
         h.observe(0.006, exemplar_trace_id="second")
         h.observe(0.05, exemplar_trace_id="slowpoke")
         h.observe(0.02)  # no exemplar: must not clobber
-        text = reg.render_text()
+        text = reg.render_text(openmetrics=True)
         lines = [l for l in text.splitlines() if "# {trace_id=" in l]
         assert len(lines) == 2
         assert 'le="0.01"' in lines[0] and 'trace_id="second"' in lines[0]
         assert 'le="0.1"' in lines[1] and 'trace_id="slowpoke"' in lines[1]
+        # the OpenMetrics document carries the mandatory EOF marker and
         # the strict grammar oracle accepts the exemplar suffix
+        assert text.rstrip().splitlines()[-1] == "# EOF"
         fams = parse_exposition(text)
         assert fams["lat_seconds"]["type"] == "histogram"
+
+    def test_negotiation_is_conservative(self):
+        assert not om.wants_openmetrics(None)
+        assert not om.wants_openmetrics("")
+        assert not om.wants_openmetrics("text/plain")
+        assert om.wants_openmetrics("application/openmetrics-text")
+        # a stock Prometheus server (>= 2.49) advertises BOTH media
+        # types: it reliably parses classic, so classic wins — our
+        # OpenMetrics variant keeps _total counter family names and is
+        # not strictly spec-compliant
+        assert not om.wants_openmetrics(
+            "application/openmetrics-text;version=1.0.0;q=0.5,"
+            "text/plain;version=0.0.4;q=0.2,*/*;q=0.1")
+        # media types are case-insensitive per RFC 9110
+        assert om.wants_openmetrics("Application/OpenMetrics-Text")
+        assert not om.wants_openmetrics(
+            "Application/OpenMetrics-Text, TEXT/PLAIN")
+
+    def test_classic_render_never_carries_exemplars(self):
+        # exemplars are invalid in the classic text format — one slow
+        # request must not make a stock Prometheus scrape of /metrics
+        # fail wholesale
+        reg = om.MetricsRegistry()
+        h = reg.histogram("lat_seconds", "t", buckets=(0.01, 0.1))
+        h.observe(0.05, exemplar_trace_id="slowpoke")
+        text = reg.render_text()
+        assert "# {" not in text and "# EOF" not in text
+        parse_exposition(text)
 
     def test_json_twin_carries_exemplars(self):
         reg = om.MetricsRegistry()
@@ -921,13 +1064,41 @@ class TestExemplars:
                 {"inputs": [[0.1, 0.2, 0.3, 0.4]]})
             assert status == 200
             cid = headers["X-Correlation-ID"]
-            text = server.render_metrics_text()
+            # default scrape: classic format, exemplar-free — a stock
+            # Prometheus server pointed at /metrics must keep working
+            # after the first exemplar-carrying request lands
+            with urllib.request.urlopen(f"{server.url}/metrics",
+                                        timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                classic = r.read().decode()
+            assert "# {" not in classic
+            parse_exposition(classic)
+            # Accept-negotiated OpenMetrics: exemplar suffixes, matching
+            # content type, mandatory # EOF trailer
+            req = urllib.request.Request(
+                f"{server.url}/metrics",
+                headers={"Accept": "application/openmetrics-text"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.headers["Content-Type"].startswith(
+                    "application/openmetrics-text")
+                text = r.read().decode()
+            assert text.rstrip().splitlines()[-1] == "# EOF"
             ex_lines = [l for l in text.splitlines()
                         if l.startswith("serving_request_latency_seconds"
                                         "_bucket") and "# {trace_id=" in l]
             assert ex_lines, "no exemplar on the latency buckets"
             assert any(f'trace_id="{cid}"' in l for l in ex_lines)
             parse_exposition(text)  # whole scrape stays grammar-clean
+            # a stock-Prometheus Accept header (lists both media types)
+            # negotiates the classic document it reliably parses
+            req = urllib.request.Request(
+                f"{server.url}/metrics",
+                headers={"Accept": (
+                    "application/openmetrics-text;version=1.0.0;q=0.5,"
+                    "text/plain;version=0.0.4;q=0.2,*/*;q=0.1")})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                assert "# {" not in r.read().decode()
         finally:
             server.stop()
 
