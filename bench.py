@@ -736,6 +736,137 @@ def bench_serving(peak, *, n_threads=8, requests_per_thread=40,
         server.stop()
 
 
+def bench_overload(peak, *, critical_threads=4, normal_threads=8,
+                   batch_threads=28, duration_s=8.0, max_in_flight=4,
+                   max_batch=16, p99_gate_ms=2000.0,
+                   min_critical_availability=0.99):
+    """Overload-discipline benchmark (serving/overload.py): critical-class
+    goodput and p99 while offered concurrency is ~10x the admission
+    ceiling — a closed-loop three-priority, two-tenant client mix
+    through the full stack (HTTP, priority admission, AIMD limit,
+    brownout ladder). Gates: critical availability >= 99% and critical
+    p99 under ``p99_gate_ms`` — the server must protect its most
+    important traffic while shedding the rest with typed backpressure.
+    ``value`` = critical requests/sec served through the storm. ``peak``
+    is unused: the metric is overload goodput, not MFU.
+    """
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.serving import (
+        ModelRegistry,
+        ModelServer,
+        OverloadPolicy,
+        ServingClient,
+        ServingError,
+        spec,
+    )
+
+    model = lenet()
+    registry = ModelRegistry()
+    registry.register(
+        "lenet", lambda v, x: model.output(v, x), model.init(seed=0),
+        input_spec=spec((28, 28, 1)), version="v1", mode="batched",
+        max_batch_size=max_batch)
+    policy = OverloadPolicy(
+        min_in_flight=2, max_in_flight=max_in_flight, interval_s=0.5,
+        min_degraded_p99_s=0.05,
+        # quotas effectively open: this config measures priority
+        # discipline, not tenant policing (tested elsewhere)
+        tenant_rate=10000.0, tenant_burst=10000.0)
+    server = ModelServer(registry, port=0, overload=policy, sentinel=False)
+    server.start(warm=True)
+    try:
+        lock = threading.Lock()
+        lat = {"critical": [], "normal": [], "batch": []}
+        shed = {"critical": 0, "normal": 0, "batch": 0}
+        broken = []
+        stop = threading.Event()
+        n_threads = critical_threads + normal_threads + batch_threads
+        barrier = threading.Barrier(n_threads + 1)
+
+        def run(prio, tenant, tid):
+            rng = np.random.default_rng(tid)
+            client = ServingClient(server.url)
+            barrier.wait()
+            while not stop.is_set():
+                x = rng.normal(size=(1, 784)).astype(np.float32)
+                t0 = time.monotonic()
+                try:
+                    client.predict("lenet", x, deadline_ms=30000,
+                                   priority=prio, tenant=tenant)
+                    dt = time.monotonic() - t0
+                    with lock:
+                        lat[prio].append(dt)
+                except ServingError as e:
+                    # typed backpressure (sheds/deadlines) is the
+                    # designed overload behavior; anything else = bug
+                    if getattr(e, "retryable", False) \
+                            or e.http_status in (429, 503, 504):
+                        with lock:
+                            shed[prio] += 1
+                    else:
+                        with lock:
+                            broken.append(e)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        broken.append(e)
+
+        threads = []
+        tid = 0
+        for n, prio, tenant in ((critical_threads, "critical", "a"),
+                                (normal_threads, "normal", "a"),
+                                (batch_threads, "batch", "b")):
+            for _ in range(n):
+                threads.append(threading.Thread(
+                    target=run, args=(prio, tenant, tid)))
+                tid += 1
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t_start = time.monotonic()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        wall = time.monotonic() - t_start
+
+        crit = np.sort(np.asarray(lat["critical"]))
+        crit_offered = len(crit) + shed["critical"]
+        availability = (len(crit) / crit_offered) if crit_offered else 0.0
+        p99_ms = (float(np.percentile(crit, 99)) * 1e3 if len(crit)
+                  else float("inf"))
+        info = {
+            "offered_concurrency": n_threads,
+            "admission_ceiling": max_in_flight,
+            "overload_factor": round(n_threads / max_in_flight, 1),
+            "critical_served": len(crit),
+            "critical_shed": shed["critical"],
+            "critical_availability": round(availability, 4),
+            "critical_p99_ms": round(p99_ms, 2),
+            "p99_gate_ms": p99_gate_ms,
+            "normal_served": len(lat["normal"]),
+            "normal_shed": shed["normal"],
+            "batch_served": len(lat["batch"]),
+            "batch_shed": shed["batch"],
+            "broken": len(broken),
+            "effective_limit_final": server.overload.effective_limit,
+            "brownout_level_final": server.overload.ladder.level,
+            # config-integrity gate: critical goodput + p99 both inside
+            # their bounds and every failure a typed shed
+            "converged": (len(crit) > 0 and not broken
+                          and availability >= min_critical_availability
+                          and p99_ms <= p99_gate_ms),
+            "unit": "critical requests/sec under ~10x overload",
+        }
+        info["value"] = round(len(crit) / wall, 1)
+        return info
+    finally:
+        server.stop()
+
+
 def bench_resilience(peak, *, sizes_mb=(1, 8, 64), repeats=3, epochs=2):
     """Fault-tolerance benchmark (resilience/ + serde integrity):
     verified-checkpoint save/verify/restore latency vs. snapshot size
@@ -1885,6 +2016,10 @@ _CONFIGS = {
     # End-to-end serving capacity through serving/ (HTTP + admission +
     # dynamic batching); first recorded round — no baseline row yet.
     "serving": bench_serving,
+    # Overload discipline (serving/overload.py): critical-class goodput
+    # and p99 at ~10x offered load through priority admission + AIMD +
+    # brownout; gated on critical availability >= 99%.
+    "overload": bench_overload,
     # Fault-tolerance path (resilience/ + serde integrity): verified
     # checkpoint save/verify/restore latency vs. snapshot size + recovery
     # wall-clock after an injected fault; first recorded round.
@@ -1921,6 +2056,12 @@ _CPU_INTEGRITY = {
     "gpt": dict(batch_size=2, seq_len=32, warmup=0, iters=3, tiny=True),
     # serving reports "converged" = all requests served-or-typed-shed
     "serving": dict(n_threads=4, requests_per_thread=6, max_batch=8),
+    # overload reports "converged" = critical availability >= 99% and
+    # critical p99 inside its gate at ~6x offered load (smaller mix
+    # than the 10x perf leg, same invariants)
+    "overload": dict(critical_threads=2, normal_threads=3,
+                     batch_threads=7, duration_s=3.0, max_in_flight=2,
+                     max_batch=8),
     # resilience reports "converged" = faulted run recovered to the
     # fault-free step count
     "resilience": dict(sizes_mb=(1,), repeats=1, epochs=1),
@@ -2018,8 +2159,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs",
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
-                            "serving,resilience,observability,robustness,"
-                            "federation,elastic,sentinel",
+                            "serving,overload,resilience,observability,"
+                            "robustness,federation,elastic,sentinel",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

@@ -39,6 +39,12 @@ Everything is scrapeable: ``anomaly_state{detector=}`` /
 The evaluator follows slo.py's :class:`HealthEngine` pattern: a
 background daemon thread, ``tick()`` callable on demand under one lock,
 registries resolved per tick, injectable clock for deterministic tests.
+
+The probe/baseline machinery is a reuse surface, not just this engine's
+internals: ``serving/overload.py``'s AIMD concurrency controller feeds
+a :class:`HistogramQuantileProbe` (serving p99) into a
+:class:`RollingBaseline` with the same frozen-while-degraded discipline
+to decide when the effective admission limit must shrink.
 """
 
 from __future__ import annotations

@@ -53,6 +53,13 @@ class InferenceShutdown(RuntimeError):
         self.workers_dead = workers_dead
 
 
+class InferenceDeadlineExpired(RuntimeError):
+    """Delivered to a request whose deadline expired while it was still
+    QUEUED: the worker dropped it before dispatch instead of burning a
+    batch slot computing a result nobody can use. The serving layer
+    maps it to a 504 with the distinct ``DEADLINE_EXPIRED`` code."""
+
+
 class WorkerCrashError(RuntimeError):
     """Delivered to the in-flight requests of a worker thread that died
     unexpectedly (bug, injected ``serving.worker_crash``): their batch
@@ -75,7 +82,7 @@ def _rows(inputs) -> int:
 
 class _Request:
     __slots__ = ("inputs", "event", "result", "error", "cancelled",
-                 "trace", "t_enqueue")
+                 "trace", "t_enqueue", "deadline")
 
     def __init__(self, inputs):
         self.inputs = inputs
@@ -87,6 +94,9 @@ class _Request:
         # the worker records batch/dispatch spans against it post-hoc.
         self.trace = None
         self.t_enqueue = 0.0
+        # absolute monotonic deadline; a worker drops the request
+        # pre-dispatch once it passes (None = never expires in queue)
+        self.deadline = None
 
 
 class ParallelInference:
@@ -137,18 +147,29 @@ class ParallelInference:
         mode: str = "instant",
         max_batch_size: int = 32,
         queue_limit: int = 256,
+        batch_wait_s: float = 0.0,
         on_batch: Optional[Callable[[int, int, int, float], None]] = None,
+        on_expired: Optional[Callable[[int], None]] = None,
         max_worker_respawns: int = 8,
         on_respawn: Optional[Callable[[int], None]] = None,
     ):
         if mode not in ("instant", "batched"):
             raise ValueError(f"mode {mode!r}; valid: instant|batched")
+        if batch_wait_s < 0:
+            raise ValueError(f"batch_wait_s must be >= 0, got {batch_wait_s}")
         self._devices = list(devices) if devices is not None else jax.devices()
         self._mode = mode
         self._max_batch = max_batch_size
+        # batched mode: how long a worker holding a partial batch waits
+        # for more requests to coalesce before dispatching (0 = dispatch
+        # what's there, the historical behavior). The brownout ladder's
+        # first rung shrinks this back to 0 under overload — latency
+        # headroom beats occupancy once the server is drowning.
+        self._batch_wait_s = float(batch_wait_s)
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue(queue_limit)
         self._state_lock = threading.Lock()  # orders enqueue vs shutdown
         self._on_batch = on_batch
+        self._on_expired = on_expired
         self._on_respawn = on_respawn
         self._max_respawns = max_worker_respawns
         self._respawns = 0
@@ -179,7 +200,7 @@ class ParallelInference:
     # -- client API --------------------------------------------------------
 
     def output(self, features, timeout: Optional[float] = None,
-               trace=None):
+               trace=None, deadline: Optional[float] = None):
         """Blocking single-request inference (thread-safe).
 
         On timeout the request is marked cancelled — a worker that picks it
@@ -188,6 +209,12 @@ class ParallelInference:
         ``queue_limit`` (never blocks while holding the state lock), and
         :class:`InferenceShutdown` — immediately, not after the timeout —
         when the replica set is shut down or every worker is dead.
+
+        ``deadline``: absolute ``time.monotonic()`` instant after which
+        the request is DEAD — a worker reaching it later drops it
+        pre-dispatch with :class:`InferenceDeadlineExpired` instead of
+        spending a batch slot on it (defaults to now + ``timeout``, so
+        a timed request can never be dispatched past its own timeout).
 
         ``trace``: optional ``(trace_id, parent_span_id)`` correlation
         context — the worker records "serving.batch" (queue wait + batch
@@ -203,6 +230,10 @@ class ParallelInference:
                 "features must be a non-empty pytree of arrays with a "
                 f"leading batch dim, got {type(features).__name__}") from e
         req = _Request(features)
+        if deadline is not None:
+            req.deadline = deadline
+        elif timeout is not None:
+            req.deadline = time.monotonic() + timeout
         if trace is not None and _trace.tracing_enabled():
             req.trace = trace
             req.t_enqueue = _trace.now()
@@ -238,6 +269,14 @@ class ParallelInference:
         if req.error is not None:
             raise req.error
         return req.result
+
+    def set_batch_wait(self, seconds: float):
+        """Adjust the batched-mode coalesce wait live (plain float
+        assignment — workers read it per batch). The brownout ladder
+        shrinks it to 0 under overload and restores it on recovery."""
+        if seconds < 0:
+            raise ValueError(f"batch_wait_s must be >= 0, got {seconds}")
+        self._batch_wait_s = float(seconds)
 
     def shutdown(self):
         """Stop accepting requests; pending queued requests are still served
@@ -288,6 +327,31 @@ class ParallelInference:
     def alive_workers(self) -> int:
         return sum(th.is_alive() for th in self._workers)
 
+    def _expire(self, r: _Request) -> bool:
+        """True if ``r`` is dead — cancelled by its caller, or its
+        deadline passed while it waited in the queue. Deadline-dropped
+        requests get a typed :class:`InferenceDeadlineExpired` (their
+        caller may still be waiting); both kinds count through the
+        ``on_expired`` hook, which is exactly "batch slots saved by not
+        dispatching dead work"."""
+        if r.cancelled:
+            self._count_expired(1)
+            return True
+        if r.deadline is not None and time.monotonic() >= r.deadline:
+            r.error = InferenceDeadlineExpired(
+                "deadline expired while queued; dropped before dispatch")
+            r.event.set()
+            self._count_expired(1)
+            return True
+        return False
+
+    def _count_expired(self, n: int):
+        if self._on_expired is not None:
+            try:
+                self._on_expired(n)
+            except Exception:  # noqa: BLE001 — metrics never fail serving
+                pass
+
     def _take_batch(self, carry: Optional[_Request],
                     held: List[_Request]):
         """Collect the next batch. ``carry`` is a request taken off the
@@ -304,16 +368,28 @@ class ParallelInference:
         batch = [req]
         if self._mode == "batched":
             rows = _rows(req.inputs)
+            wait_s = self._batch_wait_s
+            wait_until = (time.monotonic() + wait_s) if wait_s > 0 else None
             while rows < self._max_batch:
                 try:
                     nxt = self._queue.get_nowait()
                 except queue.Empty:
-                    break
+                    # partial batch: optionally wait out the coalesce
+                    # budget for stragglers before dispatching
+                    if wait_until is None:
+                        break
+                    remaining = wait_until - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
                 if nxt is None:
                     self._queue.put(None)  # keep shutdown signal for peers
                     break
                 held.append(nxt)
-                if nxt.cancelled:
+                if self._expire(nxt):
                     continue
                 if rows + _rows(nxt.inputs) > self._max_batch:
                     return batch, nxt  # would overflow: starts next batch
@@ -359,7 +435,10 @@ class ParallelInference:
             batch, carry = self._take_batch(carry, held)
             if batch is None:
                 return
-            batch = [r for r in batch if not r.cancelled]
+            # drop dead requests BEFORE dispatch: a request whose caller
+            # gave up (or whose deadline already expired) must not
+            # occupy batch rows — under overload that waste compounds
+            batch = [r for r in batch if not self._expire(r)]
             if not batch:
                 continue
             inj = get_fault_injector()

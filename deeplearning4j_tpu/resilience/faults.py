@@ -23,6 +23,12 @@ Named injection points wired through the codebase:
                             manifest must catch it on restore)
 ``serving.latency``         sleeps ``arg`` seconds inside ``handle_predict``
 ``serving.error``           ``handle_predict`` sheds with a retryable 429
+``serving.overload``        synthetic sustained overload: sleeps ``arg``
+                            seconds inside ``handle_predict`` per firing —
+                            armed with ``xTIMES`` it holds the serving p99
+                            degraded until the budget exhausts, driving the
+                            AIMD shrink → brownout ladder → recovery loop
+                            in chaos tests
 ``collective.stall``        sleeps ``arg`` seconds inside a watchdog-guarded
                             collective (``runtime/distributed.barrier`` /
                             ``broadcast_host_data``) — a dead-peer stall the
@@ -73,6 +79,7 @@ POINT_CKPT_WRITE_CRASH = "checkpoint.write_crash"
 POINT_CKPT_CORRUPT = "checkpoint.corrupt"
 POINT_SERVING_LATENCY = "serving.latency"
 POINT_SERVING_ERROR = "serving.error"
+POINT_SERVING_OVERLOAD = "serving.overload"
 POINT_COLLECTIVE_STALL = "collective.stall"
 POINT_SERVING_WORKER_CRASH = "serving.worker_crash"
 POINT_TRAIN_WORKER_KILL = "train.worker_kill"
@@ -85,6 +92,7 @@ KNOWN_POINTS = (
     POINT_CKPT_CORRUPT,
     POINT_SERVING_LATENCY,
     POINT_SERVING_ERROR,
+    POINT_SERVING_OVERLOAD,
     POINT_COLLECTIVE_STALL,
     POINT_SERVING_WORKER_CRASH,
     POINT_TRAIN_WORKER_KILL,

@@ -18,6 +18,13 @@ REST serving story, grown into a first-class subsystem).
   error rate → half-open probes → closed); open sheds with 503 +
   Retry-After so the client's retry path composes.
 - client: stdlib ServingClient raising the same typed errors.
+- overload: overload management — priority-class admission (critical/
+  normal/batch via X-Priority, lowest class sheds first, critical never
+  shed while lower-class work is in flight), per-tenant token-bucket
+  quotas (X-Tenant, distinct TENANT_QUOTA sheds), AIMD-adaptive
+  in-flight limit (p99-vs-rolling-baseline, sentinel machinery), and a
+  brownout degradation ladder (shrink batch wait → shed batch class →
+  hot-swap fallback versions) with hysteresis.
 """
 
 from deeplearning4j_tpu.serving.admission import (
@@ -30,10 +37,12 @@ from deeplearning4j_tpu.serving.errors import (
     BadRequestError,
     CircuitOpenError,
     DeadlineExceededError,
+    DeadlineExpiredError,
     ModelNotFoundError,
     NotReadyError,
     QueueFullError,
     ServingError,
+    TenantQuotaError,
     WorkerCrashedError,
     error_from_code,
 )
@@ -43,6 +52,14 @@ from deeplearning4j_tpu.serving.metrics import (
     Histogram,
     MetricsRegistry,
     ServingMetrics,
+)
+from deeplearning4j_tpu.serving.overload import (
+    PRIORITIES,
+    BrownoutLadder,
+    BrownoutRung,
+    OverloadManager,
+    OverloadPolicy,
+    TenantQuotas,
 )
 from deeplearning4j_tpu.serving.registry import ModelEntry, ModelRegistry
 from deeplearning4j_tpu.serving.server import ModelServer
@@ -57,11 +74,14 @@ __all__ = [
     "AdmissionController",
     "AdmissionTicket",
     "BadRequestError",
+    "BrownoutLadder",
+    "BrownoutRung",
     "CircuitBreaker",
     "CircuitOpenError",
     "CircuitPolicy",
     "Counter",
     "DeadlineExceededError",
+    "DeadlineExpiredError",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
@@ -70,10 +90,15 @@ __all__ = [
     "ModelRegistry",
     "ModelServer",
     "NotReadyError",
+    "OverloadManager",
+    "OverloadPolicy",
+    "PRIORITIES",
     "QueueFullError",
     "ServingClient",
     "ServingError",
     "ServingMetrics",
+    "TenantQuotas",
+    "TenantQuotaError",
     "WorkerCrashedError",
     "bucket_sizes",
     "error_from_code",

@@ -1,12 +1,34 @@
-"""Admission control: bounded in-flight requests, deadlines, drain.
+"""Admission control: bounded in-flight requests, deadlines, drain —
+now priority-class, tenant, and overload aware.
 
 Sits in front of the per-model ParallelInference queues and gives the
 server explicit overload semantics: a request is either admitted (and
 then served or deadline-failed) or rejected *immediately* with a
-structured :class:`~deeplearning4j_tpu.serving.errors.QueueFullError` —
-it never blocks in the HTTP handler, so overload degrades into fast
-429s instead of piled-up threads (the same discipline the reference's
-ParallelInference queue_limit intends, made non-blocking end to end).
+structured :class:`~deeplearning4j_tpu.serving.errors.QueueFullError` /
+:class:`~deeplearning4j_tpu.serving.errors.TenantQuotaError` — it never
+blocks in the HTTP handler, so overload degrades into fast 429s instead
+of piled-up threads.
+
+With an attached :class:`~deeplearning4j_tpu.serving.overload
+.OverloadManager` the single counter becomes a *policy* admission path:
+
+- per-priority-class thresholds against the manager's AIMD-adapted
+  effective limit (lowest class sheds first; ``critical`` borrows while
+  lower-class work is in flight — never a priority inversion);
+- per-tenant token-bucket quotas (distinct ``TENANT_QUOTA`` shed whose
+  Retry-After is the exact bucket refill wait);
+- the brownout ladder's full ``batch``-class shed.
+
+Without a manager the legacy single-cap behavior is unchanged.
+
+The Retry-After hint is no longer fixed: once the server has observed
+batch service times (``observe_service_time``, fed from the
+ParallelInference ``on_batch`` hook), the shed hint scales with
+measured overshoot — in-flight over the limit × the recent batch
+service EWMA — so a lightly-over server says "retry in one batch" and a
+deeply-buried one says "stay away longer". The contract stays: precise
+``retry_after_ms`` in the error body, integer-seconds ``Retry-After``
+header derived from it.
 
 Drain support: ``drain()`` waits for in-flight count to reach zero —
 graceful shutdown serves what was admitted and sheds the rest.
@@ -17,22 +39,29 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
-from deeplearning4j_tpu.serving.errors import BadRequestError, QueueFullError
+from deeplearning4j_tpu.serving.errors import (
+    BadRequestError,
+    QueueFullError,
+    TenantQuotaError,
+)
+from deeplearning4j_tpu.serving.overload import PRIORITIES, OverloadManager
 
 
 class AdmissionTicket:
     """Held while a request is in flight; ``release()`` is idempotent."""
 
-    def __init__(self, controller: "AdmissionController"):
+    def __init__(self, controller: "AdmissionController",
+                 priority: str = "normal"):
         self._controller = controller
+        self.priority = priority
         self._released = False
 
     def release(self):
         if not self._released:
             self._released = True
-            self._controller._release()
+            self._controller._release(self.priority)
 
     def __enter__(self):
         return self
@@ -49,63 +78,180 @@ class AdmissionController:
         default_deadline_ms: float = 30000.0,
         max_deadline_ms: float = 300000.0,
         on_depth: Optional[Callable[[int], None]] = None,
+        on_class_depth: Optional[Callable[[str, int], None]] = None,
         retry_after_ms: float = 50.0,
+        max_retry_after_ms: float = 5000.0,
     ):
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1")
         self.max_in_flight = max_in_flight
         self.default_deadline_ms = default_deadline_ms
         self.max_deadline_ms = max_deadline_ms
-        # backoff hint attached to QueueFullError sheds (→ the error body's
-        # retry_after_ms + the HTTP Retry-After header); roughly one batch
-        # service time — long enough to drain, short enough not to idle
+        # fallback backoff hint for sheds BEFORE any batch service time
+        # has been observed; once observe_service_time has data the hint
+        # scales with measured overshoot instead (capped below)
         self.retry_after_ms = retry_after_ms
+        self.max_retry_after_ms = max_retry_after_ms
         self._on_depth = on_depth
+        self.on_class_depth = on_class_depth
         self._cv = threading.Condition()
         self._in_flight = 0
+        self._by_class: Dict[str, int] = {c: 0 for c in PRIORITIES}
+        self._service_ewma_s: Optional[float] = None
+        self._overload: Optional[OverloadManager] = None
 
     @property
     def in_flight(self) -> int:
         with self._cv:
             return self._in_flight
 
-    def admit(self) -> AdmissionTicket:
-        """Admit or raise QueueFullError — never blocks."""
+    def class_in_flight(self) -> Dict[str, int]:
         with self._cv:
-            if self._in_flight >= self.max_in_flight:
-                try:
-                    # black-box breadcrumb with the depth context only
-                    # this layer knows; a distinct kind from the server's
-                    # per-request "serving.shed" so timelines don't
-                    # double-count one rejection
-                    from deeplearning4j_tpu.observability.flightrecorder import (  # noqa: E501
-                        record_event,
-                    )
+            return dict(self._by_class)
 
-                    record_event("serving.admission_cap",
-                                 in_flight=self._in_flight,
-                                 max_in_flight=self.max_in_flight)
-                except Exception:  # noqa: BLE001 — never block the shed
-                    pass
-                raise QueueFullError(
-                    f"admission cap reached ({self.max_in_flight} in flight)",
-                    retry_after_ms=self.retry_after_ms)
+    @property
+    def overload(self) -> Optional[OverloadManager]:
+        return self._overload
+
+    def attach_overload(self, manager: Optional[OverloadManager]):
+        """Install (or with None, remove) the overload policy brain —
+        class thresholds, tenant quotas, AIMD limit, brownout sheds."""
+        self._overload = manager
+
+    # -- retry-after scaling --------------------------------------------------
+
+    def observe_service_time(self, seconds: float):
+        """Feed one batch service time (the ParallelInference
+        ``on_batch`` hook, via the registry) into the EWMA the shed
+        hint scales by."""
+        if seconds <= 0 or not math.isfinite(seconds):
+            return
+        with self._cv:
+            if self._service_ewma_s is None:
+                self._service_ewma_s = seconds
+            else:
+                self._service_ewma_s += 0.3 * (seconds - self._service_ewma_s)
+
+    def _retry_hint_ms(self, total: int, limit: int) -> float:
+        """Shed backoff scaled by measured overshoot: (in-flight over
+        the limit) × the recent batch service EWMA. Callers hold _cv."""
+        ewma = self._service_ewma_s
+        if ewma is None:
+            return self.retry_after_ms
+        over = max(1.0, (total + 1.0) / max(limit, 1))
+        return round(min(self.max_retry_after_ms,
+                         max(1.0, ewma * 1000.0 * over)), 1)
+
+    # -- admission ------------------------------------------------------------
+
+    def admit(self, priority: str = "normal",
+              tenant: Optional[str] = None) -> AdmissionTicket:
+        """Admit or raise QueueFullError / TenantQuotaError — never
+        blocks. Check order: brownout batch-shed (cheapest statement of
+        policy), class capacity, then tenant quota LAST — a request the
+        server would shed anyway must not burn one of its tenant's
+        tokens, or global overload would drain well-behaved tenants'
+        quotas through rejected requests."""
+        if priority not in self._by_class:
+            raise BadRequestError(
+                f"priority must be one of {list(PRIORITIES)}, "
+                f"got {priority!r}")
+        ov = self._overload
+        with self._cv:
+            total = self._in_flight
+            if ov is None:
+                limit = self.max_in_flight
+                if total >= limit:
+                    self._record_cap(total, limit, priority)
+                    raise QueueFullError(
+                        f"admission cap reached ({limit} in flight)",
+                        retry_after_ms=self._retry_hint_ms(total, limit))
+            else:
+                limit = ov.effective_limit
+                if priority == "batch" and ov.shed_batch:
+                    # a policy shed, not a capacity signal: it must not
+                    # feed note_shed(), or the ladder's own batch sheds
+                    # would hold the "overloaded" verdict latched and
+                    # block re-escalation
+                    raise QueueFullError(
+                        "brownout: batch-class requests are shed",
+                        retry_after_ms=self._retry_hint_ms(total, limit))
+                threshold = ov.class_limit(priority)
+                if total >= threshold:
+                    # anti-priority-inversion borrow: critical is never
+                    # shed while lower-class work occupies slots —
+                    # admitting one more critical request beats shedding
+                    # it in favor of work the server already judged less
+                    # important. Self-limiting (lower classes stopped
+                    # admitting at their smaller thresholds, so the
+                    # borrow base drains within ~one service time) AND
+                    # hard-capped at the manager's borrow_cap (2x the
+                    # AIMD ceiling): the client-controlled X-Priority
+                    # header must not be an unbounded cap bypass while
+                    # one slow batch request is in flight.
+                    borrow = (priority == "critical"
+                              and (self._by_class["normal"]
+                                   + self._by_class["batch"]) > 0
+                              and total < ov.borrow_cap)
+                    if not borrow:
+                        # capacity sheds — and only these — feed the
+                        # manager's shed-rate overload signal
+                        ov.note_shed()
+                        self._record_cap(total, threshold, priority)
+                        raise QueueFullError(
+                            f"admission cap reached for class "
+                            f"'{priority}' ({total} in flight >= "
+                            f"{threshold})",
+                            retry_after_ms=self._retry_hint_ms(
+                                total, threshold))
+                ok, wait_s = ov.tenant_take(tenant)
+                if not ok:
+                    raise TenantQuotaError(
+                        f"tenant {(tenant or '<anonymous>')!r} is over "
+                        "its request quota",
+                        retry_after_ms=round(wait_s * 1000.0, 1))
             self._in_flight += 1
+            self._by_class[priority] += 1
             # report under the lock: out-of-order depth publications would
             # leave the gauge stale (e.g. nonzero forever while idle)
             self._report(self._in_flight)
-        return AdmissionTicket(self)
+            self._report_class(priority, self._by_class[priority])
+        return AdmissionTicket(self, priority)
 
-    def _release(self):
+    def _record_cap(self, total: int, limit: int, priority: str):
+        try:
+            # black-box breadcrumb with the depth context only this
+            # layer knows; a distinct kind from the server's per-request
+            # "serving.shed" so timelines don't double-count one
+            # rejection
+            from deeplearning4j_tpu.observability.flightrecorder import (
+                record_event,
+            )
+
+            record_event("serving.admission_cap", in_flight=total,
+                         limit=limit, priority=priority)
+        except Exception:  # noqa: BLE001 — never block the shed
+            pass
+
+    def _release(self, priority: str = "normal"):
         with self._cv:
             self._in_flight -= 1
+            self._by_class[priority] -= 1
             self._report(self._in_flight)
+            self._report_class(priority, self._by_class[priority])
             self._cv.notify_all()
 
     def _report(self, depth: int):
         if self._on_depth is not None:
             try:
                 self._on_depth(depth)
+            except Exception:  # noqa: BLE001 — metrics never fail admission
+                pass
+
+    def _report_class(self, priority: str, depth: int):
+        if self.on_class_depth is not None:
+            try:
+                self.on_class_depth(priority, depth)
             except Exception:  # noqa: BLE001 — metrics never fail admission
                 pass
 

@@ -31,6 +31,7 @@ from deeplearning4j_tpu.serving.errors import (
     NotReadyError,
     QueueFullError,
     ServingError,
+    TenantQuotaError,
     error_from_code,
 )
 
@@ -111,16 +112,26 @@ class ServingClient:
                 if not getattr(err, "retryable", False) \
                         or attempt >= self.max_retries:
                     raise
-                if delays is None:
-                    delays = backoff_delays(
-                        base=self.backoff_base_s, cap=self.backoff_max_s,
-                        jitter=self.backoff_jitter, rng=self._rng)
-                delay = next(delays)
                 ra = getattr(err, "retry_after_ms", None)
-                if ra:
-                    # the server's hint is authoritative: wait at least
-                    # that long even when it exceeds the local cap
-                    delay = max(delay, float(ra) / 1000.0)
+                if isinstance(err, TenantQuotaError) and ra:
+                    # quota shed: the server's refill wait is THE
+                    # schedule — retrying on the shared exponential
+                    # backoff would just burn the next token the moment
+                    # it appears (and 50 ms base sits far under any
+                    # real refill interval)
+                    delay = float(ra) / 1000.0
+                else:
+                    if delays is None:
+                        delays = backoff_delays(
+                            base=self.backoff_base_s,
+                            cap=self.backoff_max_s,
+                            jitter=self.backoff_jitter, rng=self._rng)
+                    delay = next(delays)
+                    if ra:
+                        # the server's hint is authoritative: wait at
+                        # least that long even when it exceeds the local
+                        # cap
+                        delay = max(delay, float(ra) / 1000.0)
                 self._sleep(delay)
                 attempt += 1
 
@@ -128,9 +139,17 @@ class ServingClient:
 
     def predict(self, model: str, inputs: Any, *,
                 deadline_ms: Optional[float] = None,
-                correlation_id: Optional[str] = None) -> dict:
+                correlation_id: Optional[str] = None,
+                priority: Optional[str] = None,
+                tenant: Optional[str] = None) -> dict:
         """POST a predict; returns the full response dict
         ({"model", "version", "outputs"}). Typed ServingError on failure.
+
+        ``priority`` (``critical``/``normal``/``batch``) and ``tenant``
+        ride the ``X-Priority``/``X-Tenant`` headers: the server sheds
+        lowest-priority first under overload and enforces per-tenant
+        quotas (a ``TenantQuotaError`` shed retries on the server's
+        refill schedule, never the shared backoff).
 
         A correlation ID (minted per call unless given) rides the
         ``X-Correlation-ID``/``X-Span-ID`` headers, so the client span
@@ -144,6 +163,10 @@ class ServingClient:
         with _trace.span("client.request", trace_id=cid,
                          model=model) as s:
             headers = {"X-Correlation-ID": cid}
+            if priority is not None:
+                headers["X-Priority"] = priority
+            if tenant is not None:
+                headers["X-Tenant"] = tenant
             if s is not None:
                 headers["X-Span-ID"] = s.span_id
             return self._request(f"/v1/models/{model}:predict", payload,

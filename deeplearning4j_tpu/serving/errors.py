@@ -78,10 +78,36 @@ class QueueFullError(ServingError):
     retryable = True
 
 
+class TenantQuotaError(ServingError):
+    """Load shed by the per-tenant token-bucket quota (``X-Tenant``):
+    this tenant exhausted its own share — other tenants are unaffected,
+    which is the point. Retryable, but ``retry_after_ms`` carries the
+    exact wait until the bucket refills one token; the client's retry
+    loop must honor it INSTEAD of its shared backoff schedule (a
+    quota'd client retrying on the 50 ms schedule would just burn its
+    next token the moment it appears)."""
+
+    code = "TENANT_QUOTA"
+    http_status = 429
+    retryable = True
+
+
 class DeadlineExceededError(ServingError):
     """The request's deadline elapsed before a result was produced."""
 
     code = "DEADLINE_EXCEEDED"
+    http_status = 504
+
+
+class DeadlineExpiredError(DeadlineExceededError):
+    """The deadline expired while the request was still QUEUED — it was
+    dropped before dispatch, never occupying a batch slot (a dead
+    request burning device time serves nobody). A subclass of
+    :class:`DeadlineExceededError` so existing handlers keep working,
+    with its own wire code so callers can tell "never ran" from "ran
+    too long"."""
+
+    code = "DEADLINE_EXPIRED"
     http_status = 504
 
 

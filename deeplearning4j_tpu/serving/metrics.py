@@ -77,6 +77,42 @@ class ServingMetrics:
             "ParallelInference worker threads respawned after an "
             "unexpected death (their in-flight batch failed retryably).",
             ("model",))
+        self.class_in_flight = r.gauge(
+            "serving_class_in_flight",
+            "Admitted requests currently in flight, by priority class.",
+            ("priority",))
+        self.deadline_expired_total = r.counter(
+            "serving_deadline_expired_total",
+            "Dead requests dropped before dispatch (deadline expired or "
+            "caller gave up while queued) — batch slots saved by not "
+            "computing results nobody can use.", ("model",))
+        self.tenant_shed_total = r.counter(
+            "serving_tenant_shed_total",
+            "Requests shed by the per-tenant token-bucket quota (all "
+            "tenants; unlabeled on purpose — tenant keys are "
+            "client-controlled, and a label per forged key would grow "
+            "the registry without bound. Per-tenant attribution rides "
+            "the bounded serving.shed flight events instead).")
+        self.effective_limit = r.gauge(
+            "serving_effective_in_flight_limit",
+            "The AIMD controller's current effective in-flight "
+            "admission limit.")
+        self.brownout_level = r.gauge(
+            "serving_brownout_level",
+            "Current brownout ladder level (0 = full service; each "
+            "level engages one more degradation rung).")
+        self.brownout_transitions_total = r.counter(
+            "serving_brownout_transitions_total",
+            "Brownout ladder transitions by direction (down = degrade, "
+            "up = recover).", ("direction",))
+        self.overload_ticks_total = r.counter(
+            "serving_overload_ticks_total",
+            "Overload-manager evaluation passes (the brownout-engaged "
+            "burn-rate rule's total).")
+        self.brownout_ticks_total = r.counter(
+            "serving_brownout_ticks_total",
+            "Overload-manager passes that found the brownout level "
+            "above 0 (the brownout-engaged rule's bad events).")
         self.circuit_state = r.gauge(
             "serving_circuit_state",
             "Per-model-version circuit-breaker state "

@@ -1,0 +1,596 @@
+"""Overload management: priority classes, tenant fairness, adaptive
+concurrency, and the brownout degradation ladder.
+
+Under overload the old admission path treated every request identically:
+one global ``max_in_flight`` counter shedding FIFO-blind 429s with a
+fixed 50 ms Retry-After. A saturated server could only say "no" — it
+could not protect its critical traffic, contain a runaway client, or
+degrade gracefully. This module is the policy brain the reworked
+:class:`~deeplearning4j_tpu.serving.admission.AdmissionController`
+consults per admit, plus the background controller that adapts the
+limit and walks the brownout ladder:
+
+- **priority classes** (``critical`` / ``normal`` / ``batch``, the
+  ``X-Priority`` header): each class admits only while total in-flight
+  is under ``fraction(class) * effective_limit``, so as load climbs the
+  lowest class sheds first. ``critical`` additionally *borrows*: it is
+  never shed while any lower-class request occupies a slot — admitting
+  one more critical request while less-important work holds capacity is
+  strictly better than the priority inversion of shedding it. The
+  transient overshoot is self-limiting: lower classes stop admitting
+  long before ``critical`` does, so the borrow base drains within about
+  one service time of overload onset.
+- **per-tenant fairness** (the ``X-Tenant`` header): a token bucket per
+  tenant in a bounded LRU; a runaway client exhausts its own bucket and
+  sheds with ``TENANT_QUOTA`` (a *distinct* code from ``QUEUE_FULL``)
+  and a server-computed Retry-After of exactly the refill wait — while
+  every other tenant keeps its share. Anonymous requests share the
+  ``""`` bucket, so merely *omitting* the header is not a bypass. The
+  quota polices cooperative-but-runaway clients (a retry storm, a
+  misconfigured batch job); it is NOT an authentication boundary — a
+  client forging a fresh ``X-Tenant`` per request mints fresh buckets
+  and escapes it. Tenant identity must come from an authenticated layer
+  upstream when adversarial clients are in scope.
+- **adaptive concurrency**: an AIMD controller replaces the hand-tuned
+  static cap. Each tick samples the serving p99 (bucket-resolved, via
+  the sentinel's :class:`HistogramQuantileProbe`) and judges it against
+  a rolling median+MAD baseline (the sentinel's
+  :class:`RollingBaseline` — same robust-z + relative-increase gate,
+  baseline frozen while degraded so the overload cannot teach itself
+  into "normal"). Degraded p99 (or a shed-rate burst) multiplicatively
+  shrinks the effective limit; healthy ticks additively regrow it.
+- **brownout ladder**: under *sustained* overload the manager steps
+  down through configured degradation rungs (default wiring in
+  ``ModelServer``: shrink the batch coalesce wait → shed the ``batch``
+  class entirely → hot-swap registered cheaper fallback versions via
+  the existing ``ModelRegistry`` deploy/rollback plumbing) and steps
+  back up with hysteresis once healthy. Every transition emits a
+  ``serving.brownout`` flight event and the
+  ``serving_brownout_level`` / ``serving_brownout_transitions_total``
+  metrics; ``serving_overload_ticks_total`` /
+  ``serving_brownout_ticks_total`` are the ``brownout-engaged``
+  burn-rate rule's total/bad pair.
+
+The manager follows the repo's evaluator pattern (slo.HealthEngine,
+sentinel.Sentinel): a background daemon thread, ``tick()`` callable on
+demand, injectable clock for deterministic tests. Hot-path reads
+(``effective_limit``, ``shed_batch``) are plain attributes — the
+admission path never takes the tick lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.observability import metrics as _obs_metrics
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.observability.sentinel import (
+    HistogramQuantileProbe,
+    RollingBaseline,
+)
+from deeplearning4j_tpu.observability.slo import _doc_map
+
+# Priority classes, best first. The header value must be one of these
+# (validated in handle_predict); admission sheds lowest-class first.
+PRIORITIES = ("critical", "normal", "batch")
+
+DEFAULT_CLASS_FRACTIONS = {"critical": 1.0, "normal": 0.9, "batch": 0.7}
+
+
+@dataclasses.dataclass
+class OverloadPolicy:
+    """Knobs for the overload manager. ``validate()`` returns self or
+    raises — the ModelServer validates at construction, not first tick.
+
+    ``max_in_flight=None`` adopts the AdmissionController's cap as the
+    AIMD ceiling (the common case: one number configures both)."""
+
+    # -- adaptive concurrency (AIMD) --
+    min_in_flight: int = 4
+    max_in_flight: Optional[int] = None
+    decrease_factor: float = 0.7
+    increase_step: float = 1.0
+    interval_s: float = 2.0
+    # p99-vs-baseline judgement (sentinel-style robust statistics)
+    degrade_ratio: float = 1.5     # p99 >= median * ratio → degraded
+    z_threshold: float = 4.0       # AND robust z over the baseline
+    # absolute floor: a p99 below this is NEVER "degraded". Histogram
+    # p99 is bucket-resolved, so a microsecond-scale baseline with zero
+    # MAD would otherwise read one-bucket jitter as overload.
+    min_degraded_p99_s: float = 0.0
+    baseline_window: int = 64
+    min_history: int = 8
+    min_samples_per_tick: int = 8  # histogram-delta probe min_count
+    # secondary overload signal: admission sheds per second (None = off)
+    shed_rate_overload: Optional[float] = 20.0
+    # -- priority classes --
+    class_fractions: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_CLASS_FRACTIONS))
+    # -- per-tenant token buckets (None disables tenant quotas) --
+    tenant_rate: Optional[float] = None   # tokens (requests) per second
+    tenant_burst: float = 20.0
+    max_tenants: int = 1024               # LRU bound on distinct buckets
+    # -- brownout ladder hysteresis --
+    brownout_down_after: int = 2   # consecutive overloaded ticks / step
+    brownout_up_after: int = 4     # consecutive healthy ticks / step
+
+    def validate(self) -> "OverloadPolicy":
+        if self.min_in_flight < 1:
+            raise ValueError(
+                f"min_in_flight must be >= 1, got {self.min_in_flight}")
+        if self.max_in_flight is not None and \
+                self.max_in_flight < self.min_in_flight:
+            raise ValueError(
+                f"max_in_flight ({self.max_in_flight}) must be >= "
+                f"min_in_flight ({self.min_in_flight})")
+        if not 0.0 < self.decrease_factor < 1.0:
+            raise ValueError("decrease_factor must be in (0, 1), got "
+                             f"{self.decrease_factor}")
+        if self.increase_step <= 0:
+            raise ValueError(
+                f"increase_step must be > 0, got {self.increase_step}")
+        if self.interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {self.interval_s}")
+        if self.degrade_ratio < 1.0:
+            raise ValueError(
+                f"degrade_ratio must be >= 1, got {self.degrade_ratio}")
+        if self.min_degraded_p99_s < 0:
+            raise ValueError(f"min_degraded_p99_s must be >= 0, got "
+                             f"{self.min_degraded_p99_s}")
+        missing = set(PRIORITIES) - set(self.class_fractions)
+        if missing:
+            raise ValueError(
+                f"class_fractions missing classes {sorted(missing)}")
+        for cls, frac in self.class_fractions.items():
+            if not 0.0 < frac <= 1.0:
+                raise ValueError(f"class_fractions[{cls!r}] must be in "
+                                 f"(0, 1], got {frac}")
+        if self.class_fractions["critical"] < max(
+                self.class_fractions.values()):
+            raise ValueError("critical must have the largest class "
+                             "fraction (it sheds last)")
+        if self.tenant_rate is not None and self.tenant_rate <= 0:
+            raise ValueError(
+                f"tenant_rate must be > 0, got {self.tenant_rate}")
+        if self.tenant_burst < 1:
+            raise ValueError(
+                f"tenant_burst must be >= 1, got {self.tenant_burst}")
+        if self.max_tenants < 1:
+            raise ValueError(
+                f"max_tenants must be >= 1, got {self.max_tenants}")
+        if self.brownout_down_after < 1 or self.brownout_up_after < 1:
+            raise ValueError("brownout_down_after/up_after must be >= 1")
+        return self
+
+
+# -- per-tenant token buckets -------------------------------------------------
+
+
+class _Bucket:
+    __slots__ = ("tokens", "t")
+
+    def __init__(self, tokens: float, t: float):
+        self.tokens = tokens
+        self.t = t
+
+
+class TenantQuotas:
+    """Token bucket per tenant key, in a bounded LRU.
+
+    ``take`` refills by elapsed time, spends one token, and on refusal
+    returns the exact wait until the next token — the server-supplied
+    Retry-After a well-behaved client honors instead of the shared
+    backoff schedule. The LRU bound caps the *memory* a scanner can
+    pin with forged tenant headers; it does not make the quota
+    adversary-proof (a new key always starts with a full burst, and
+    enough churn evicts exhausted buckets) — see the module docstring:
+    tenant keys are trusted input from an authenticated layer."""
+
+    def __init__(self, rate: float, burst: float, max_tenants: int = 1024):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.max_tenants = int(max_tenants)
+        self._buckets: "OrderedDict[str, _Bucket]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buckets)
+
+    def take(self, tenant: str, now: Optional[float] = None
+             ) -> Tuple[bool, float]:
+        """(admitted, wait_s). ``wait_s`` is 0 when admitted, else the
+        time until this tenant's bucket next holds a whole token."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                b = _Bucket(self.burst, now)
+                self._buckets[tenant] = b
+                while len(self._buckets) > self.max_tenants:
+                    self._buckets.popitem(last=False)
+            else:
+                self._buckets.move_to_end(tenant)
+                b.tokens = min(self.burst,
+                               b.tokens + (now - b.t) * self.rate)
+                b.t = now
+            if b.tokens >= 1.0:
+                b.tokens -= 1.0
+                return True, 0.0
+            return False, (1.0 - b.tokens) / self.rate
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"rate": self.rate, "burst": self.burst,
+                    "tenants": len(self._buckets),
+                    "max_tenants": self.max_tenants}
+
+
+# -- brownout ladder ----------------------------------------------------------
+
+
+class BrownoutRung:
+    """One degradation step: a name plus engage/disengage actions."""
+
+    def __init__(self, name: str, engage: Callable[[], None],
+                 disengage: Callable[[], None]):
+        self.name = name
+        self.engage = engage
+        self.disengage = disengage
+
+
+class BrownoutLadder:
+    """Ordered degradation rungs; ``level`` counts engaged rungs (0 =
+    full service). Stepping always advances the level even when the
+    rung's action raises — the ladder must keep walking under duress,
+    and the error rides the transition event instead of wedging the
+    controller. ``on_transition(frm, to, rung_name, direction, error)``
+    is the telemetry hook."""
+
+    def __init__(self, rungs: Sequence[BrownoutRung],
+                 on_transition: Optional[Callable] = None):
+        self.rungs = list(rungs)
+        names = [r.name for r in self.rungs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rung names in {names}")
+        self._level = 0
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    @property
+    def depth(self) -> int:
+        return len(self.rungs)
+
+    def can_step_down(self) -> bool:
+        return self._level < len(self.rungs)
+
+    def step_down(self) -> Optional[str]:
+        """Engage the next rung; returns its name (None at the bottom)."""
+        with self._lock:
+            if self._level >= len(self.rungs):
+                return None
+            rung = self.rungs[self._level]
+            err = None
+            try:
+                rung.engage()
+            except Exception as e:  # noqa: BLE001 — ladder must keep walking
+                err = e
+            frm, self._level = self._level, self._level + 1
+        self._notify(frm, self._level, rung.name, "down", err)
+        return rung.name
+
+    def step_up(self) -> Optional[str]:
+        """Disengage the deepest engaged rung; returns its name."""
+        with self._lock:
+            if self._level <= 0:
+                return None
+            rung = self.rungs[self._level - 1]
+            err = None
+            try:
+                rung.disengage()
+            except Exception as e:  # noqa: BLE001
+                err = e
+            frm, self._level = self._level, self._level - 1
+        self._notify(frm, self._level, rung.name, "up", err)
+        return rung.name
+
+    def _notify(self, frm: int, to: int, rung: str, direction: str, err):
+        if self._on_transition is not None:
+            try:
+                self._on_transition(frm, to, rung, direction, err)
+            except Exception:  # noqa: BLE001 — telemetry never blocks
+                pass
+
+    def describe(self) -> dict:
+        return {"level": self._level, "depth": len(self.rungs),
+                "rungs": [r.name for r in self.rungs],
+                "engaged": [r.name for r in self.rungs[:self._level]]}
+
+
+# -- the manager --------------------------------------------------------------
+
+
+class OverloadManager:
+    """Per-admit policy decisions + the background AIMD/brownout tick.
+
+    The AdmissionController consults the *hot-path attributes*
+    (``effective_limit``, ``shed_batch``, ``class_fraction``,
+    ``tenant_take``, ``note_shed``) under its own condition lock; none
+    of them takes the tick lock. ``tick()`` — on the background thread
+    or called directly with an injected ``now`` — samples the serving
+    p99, adjusts the limit, and walks the ladder (rung actions run
+    *outside* the lock: engaging a fallback deploys a model).
+    """
+
+    def __init__(self, policy: OverloadPolicy, *,
+                 metrics=None, registries: Optional[Sequence] = None,
+                 ladder: Optional[BrownoutLadder] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.policy = policy.validate()
+        self._metrics = metrics
+        self._registries = list(registries) if registries is not None \
+            else None
+        self.ladder = ladder
+        self._clock = clock if clock is not None else time.monotonic
+        self._probe = HistogramQuantileProbe(
+            "serving_request_latency_seconds", q=0.99,
+            min_count=policy.min_samples_per_tick)
+        self.baseline = RollingBaseline(policy.baseline_window)
+        self.tenants: Optional[TenantQuotas] = None
+        if policy.tenant_rate is not None:
+            self.tenants = TenantQuotas(policy.tenant_rate,
+                                        policy.tenant_burst,
+                                        policy.max_tenants)
+        # hot-path state: plain attributes, read without the tick lock
+        self._max_limit = float(policy.max_in_flight
+                                if policy.max_in_flight is not None else 64)
+        self._limit = self._max_limit
+        self._limit_int = max(policy.min_in_flight, int(self._limit))
+        self.shed_batch = False          # set by the shed-batch rung
+        self._shed_count = 0             # admission sheds (all reasons)
+        # tick state
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._over_streak = 0
+        self._healthy_streak = 0
+        self._last_tick_t: Optional[float] = None
+        self._sheds_at_last = 0
+        self.last_p99: Optional[float] = None
+        self.last_overloaded = False
+        self.ticks = 0
+
+    # -- wiring ---------------------------------------------------------------
+
+    def bind_limit(self, max_in_flight: int) -> "OverloadManager":
+        """Adopt the admission cap as the AIMD ceiling (used when the
+        policy left ``max_in_flight`` as None) and start fully open."""
+        if self.policy.max_in_flight is None:
+            self._max_limit = float(max(max_in_flight,
+                                        self.policy.min_in_flight))
+        self._limit = self._max_limit
+        self._limit_int = max(self.policy.min_in_flight, int(self._limit))
+        return self
+
+    # -- hot-path surface (called under the admission lock) -------------------
+
+    @property
+    def effective_limit(self) -> int:
+        """The AIMD controller's current in-flight cap."""
+        return self._limit_int
+
+    @property
+    def borrow_cap(self) -> int:
+        """Hard ceiling on total in-flight during a critical-class
+        borrow: 2x the AIMD ceiling. The anti-priority-inversion borrow
+        is meant to cover the transient where already-admitted lower-
+        class work holds slots — not to let a flood of client-chosen
+        ``X-Priority: critical`` headers pile up handler threads without
+        bound behind one slow batch request."""
+        return 2 * max(1, int(self._max_limit))
+
+    def class_fraction(self, priority: str) -> float:
+        return self.policy.class_fractions[priority]
+
+    def class_limit(self, priority: str) -> int:
+        """This class's admission threshold against total in-flight."""
+        return max(1, int(math.ceil(
+            self._limit_int * self.policy.class_fractions[priority])))
+
+    def tenant_take(self, tenant: Optional[str]) -> Tuple[bool, float]:
+        """(admitted, wait_s). Quotas disabled → always admitted.
+        Anonymous requests share the ``""`` bucket — omitting the
+        header must not bypass the quota."""
+        if self.tenants is None:
+            return True, 0.0
+        return self.tenants.take(tenant or "", self._clock())
+
+    def note_shed(self):
+        """Count one CAPACITY shed for the shed-rate overload signal.
+        Only class-threshold sheds belong here: tenant-quota sheds mean
+        a runaway is being *contained* (its misbehavior must not
+        collapse the global limit for everyone), and the brownout
+        ladder's own batch sheds would latch the overloaded verdict and
+        block re-escalation. int += is GIL-atomic enough for a rate
+        signal and is always called under the admission condition
+        lock."""
+        self._shed_count += 1
+
+    # -- evaluation -----------------------------------------------------------
+
+    def _resolve_registries(self):
+        if self._registries is not None:
+            return self._registries
+        if self._metrics is not None:
+            return [self._metrics.registry]
+        return [_obs_metrics.default_registry()]
+
+    def _judge(self, t: float) -> bool:
+        """One tick's overload verdict: p99-vs-baseline (robust z AND
+        relative increase, sentinel-style; baseline frozen while
+        degraded) OR a shed-rate burst."""
+        overloaded = False
+        x = self._probe.sample(_doc_map(self._resolve_registries()), t)
+        if x is not None:
+            self.last_p99 = x
+            if len(self.baseline) < self.policy.min_history:
+                self.baseline.add(x)
+            else:
+                score = self.baseline.score(x)
+                med = self.baseline.median()
+                degraded = (score >= self.policy.z_threshold
+                            and x >= med * self.policy.degrade_ratio
+                            and x >= self.policy.min_degraded_p99_s)
+                if degraded:
+                    overloaded = True
+                else:
+                    self.baseline.add(x)
+        if self.policy.shed_rate_overload is not None \
+                and self._last_tick_t is not None:
+            dt = max(t - self._last_tick_t, 1e-9)
+            rate = (self._shed_count - self._sheds_at_last) / dt
+            if rate >= self.policy.shed_rate_overload:
+                overloaded = True
+        self._sheds_at_last = self._shed_count
+        self._last_tick_t = t
+        return overloaded
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """One evaluation pass; returns :meth:`describe`. Ladder rung
+        actions (model deploys) run after the lock is released."""
+        action = None
+        with self._lock:
+            t = self._clock() if now is None else now
+            overloaded = self.last_overloaded = self._judge(t)
+            p = self.policy
+            if overloaded:
+                self._limit = max(float(p.min_in_flight),
+                                  self._limit * p.decrease_factor)
+                self._over_streak += 1
+                self._healthy_streak = 0
+            else:
+                self._limit = min(self._max_limit,
+                                  self._limit + p.increase_step)
+                self._healthy_streak += 1
+                self._over_streak = 0
+            self._limit_int = max(p.min_in_flight, int(self._limit))
+            lad = self.ladder
+            if lad is not None:
+                if overloaded and self._over_streak >= p.brownout_down_after \
+                        and lad.can_step_down():
+                    action = "down"
+                    self._over_streak = 0
+                elif not overloaded \
+                        and self._healthy_streak >= p.brownout_up_after \
+                        and lad.level > 0:
+                    action = "up"
+                    self._healthy_streak = 0
+            self.ticks += 1
+            m = self._metrics
+            if m is not None:
+                m.overload_ticks_total.inc()
+                if lad is not None and lad.level > 0:
+                    m.brownout_ticks_total.inc()
+                m.effective_limit.set(self._limit_int)
+        if action == "down":
+            self.ladder.step_down()
+        elif action == "up":
+            self.ladder.step_up()
+        return self.describe()
+
+    def _on_brownout_transition(self, frm: int, to: int, rung: str,
+                                direction: str, error=None):
+        """The ladder's telemetry hook (ModelServer wires it)."""
+        m = self._metrics
+        if m is not None:
+            m.brownout_level.set(to)
+            m.brownout_transitions_total.inc(direction=direction)
+        data = {"level_from": frm, "level_to": to, "rung": rung,
+                "direction": direction}
+        if error is not None:
+            data["error"] = str(error)[:200]
+        try:
+            record_event("serving.brownout", **data)
+        except Exception:  # noqa: BLE001 — telemetry never blocks the ladder
+            pass
+
+    # -- rendering ------------------------------------------------------------
+
+    def describe(self) -> dict:
+        # under the tick lock: baseline.to_json() iterates the deque the
+        # background tick mutates — an unlocked read can raise "deque
+        # mutated during iteration" mid-/debug/overload render. tick()
+        # only calls this after releasing the lock.
+        with self._lock:
+            return self._describe_locked()
+
+    def _describe_locked(self) -> dict:
+        return {
+            "effective_limit": self._limit_int,
+            "max_limit": int(self._max_limit),
+            "min_limit": self.policy.min_in_flight,
+            "overloaded": self.last_overloaded,
+            "over_streak": self._over_streak,
+            "healthy_streak": self._healthy_streak,
+            "last_p99_s": self.last_p99,
+            "baseline": self.baseline.to_json(),
+            "class_fractions": dict(self.policy.class_fractions),
+            "shed_batch": self.shed_batch,
+            "sheds_total": self._shed_count,
+            "ticks": self.ticks,
+            "tenants": (self.tenants.describe()
+                        if self.tenants is not None else None),
+            "brownout": (self.ladder.describe()
+                         if self.ladder is not None else None),
+        }
+
+    # -- background thread ----------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "OverloadManager":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="overload-manager")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.policy.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the controller must survive
+                pass           # a bad tick; the next one retries
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+__all__ = [
+    "PRIORITIES",
+    "DEFAULT_CLASS_FRACTIONS",
+    "OverloadPolicy",
+    "TenantQuotas",
+    "BrownoutRung",
+    "BrownoutLadder",
+    "OverloadManager",
+]

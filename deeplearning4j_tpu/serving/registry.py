@@ -58,7 +58,8 @@ class ModelEntry:
     def __init__(self, registry: "ModelRegistry", name: str,
                  forward: Callable[[Any, Any], Any], input_spec: Any, *,
                  mode: str = "batched", max_batch_size: int = 32,
-                 queue_limit: int = 256, devices: Optional[Sequence] = None):
+                 queue_limit: int = 256, batch_wait_s: float = 0.0,
+                 devices: Optional[Sequence] = None):
         self._registry = registry
         self.name = name
         self.forward = forward
@@ -66,7 +67,14 @@ class ModelEntry:
         self.mode = mode
         self.max_batch_size = max_batch_size
         self.queue_limit = queue_limit
+        self.batch_wait_s = batch_wait_s
         self.devices = devices
+        # registered-but-dormant cheaper variables the brownout ladder
+        # hot-swaps in at its deepest rung (set_fallback / the
+        # registry's engage_fallback / disengage_fallback)
+        self.fallback_variables: Any = None
+        self.fallback_version: Optional[str] = None
+        self.fallback_engaged = False
         self._lock = threading.Lock()
         # Serializes deploy/rollback (history mutation + swap) so
         # concurrent deploys can't leave the active version out of sync
@@ -85,10 +93,32 @@ class ModelEntry:
         return ParallelInference(
             self.forward, variables, devices=self.devices, mode=self.mode,
             max_batch_size=self.max_batch_size, queue_limit=self.queue_limit,
+            batch_wait_s=self.batch_wait_s,
             on_batch=functools.partial(
                 self._registry._record_batch, self.name),
+            on_expired=functools.partial(
+                self._registry._record_expired, self.name),
             on_respawn=functools.partial(
                 self._registry._record_respawn, self.name))
+
+    def set_batch_wait(self, seconds: float):
+        """Adjust the batched-mode coalesce wait live (active replica
+        set now, future deploys inherit it) — the brownout ladder's
+        first rung."""
+        if seconds < 0:
+            raise ValueError(f"batch_wait_s must be >= 0, got {seconds}")
+        self.batch_wait_s = float(seconds)
+        with self._lock:
+            active = self._active
+        if active is not None:
+            active.pi.set_batch_wait(seconds)
+
+    def set_fallback(self, variables: Any, version: Optional[str] = None):
+        """Register dormant cheaper variables (a distilled/quantized
+        twin) the brownout ladder deploys at its deepest rung via the
+        normal warmed hot-swap path; ``disengage`` rolls back."""
+        self.fallback_variables = variables
+        self.fallback_version = version
 
     def warm(self) -> Dict[int, float]:
         """Pre-compile every batch bucket on the active replica set.
@@ -117,13 +147,14 @@ class ModelEntry:
     # -- serving -----------------------------------------------------------
 
     def predict(self, features, timeout: Optional[float] = None,
-                trace=None):
+                trace=None, deadline: Optional[float] = None):
         """Serve one request on the active replica set."""
         return self.predict_versioned(features, timeout=timeout,
-                                      trace=trace)[0]
+                                      trace=trace, deadline=deadline)[0]
 
     def predict_versioned(self, features, timeout: Optional[float] = None,
-                          trace=None) -> Tuple[Any, str]:
+                          trace=None, deadline: Optional[float] = None
+                          ) -> Tuple[Any, str]:
         """Serve one request; returns ``(outputs, version)`` where
         ``version`` is the version of the replica set that actually
         served — read under the same lock as the pointer grab, so a
@@ -149,7 +180,7 @@ class ModelEntry:
                 pi, version = self._active.pi, self._active.version
             try:
                 return pi.output(features, timeout=timeout,
-                                 trace=trace), version
+                                 trace=trace, deadline=deadline), version
             except InferenceShutdown:
                 if attempt == 0:
                     continue
@@ -281,11 +312,17 @@ class ModelRegistry:
         self._entries: Dict[str, ModelEntry] = {}
         self._lock = threading.Lock()
         self._metrics = metrics
+        self._admission = None
 
     def attach_metrics(self, metrics):
         """Wire a ServingMetrics bundle (occupancy/device-latency hooks
         take effect immediately — entries call back through the registry)."""
         self._metrics = metrics
+
+    def attach_admission(self, admission):
+        """Wire the AdmissionController so worker batch service times
+        feed its Retry-After overshoot EWMA."""
+        self._admission = admission
 
     # -- metrics hooks (called from ParallelInference workers) -------------
 
@@ -295,6 +332,14 @@ class ModelRegistry:
         if m is not None:
             m.batch_occupancy.observe(rows / max(bucket, 1), model=name)
             m.device_latency.observe(seconds, model=name)
+        ac = self._admission
+        if ac is not None and hasattr(ac, "observe_service_time"):
+            ac.observe_service_time(seconds)
+
+    def _record_expired(self, name: str, n: int):
+        m = self._metrics
+        if m is not None and hasattr(m, "deadline_expired_total"):
+            m.deadline_expired_total.inc(n, model=name)
 
     def _record_ready(self, name: str, ready: bool):
         m = self._metrics
@@ -311,12 +356,14 @@ class ModelRegistry:
     def register(self, name: str, forward: Callable[[Any, Any], Any],
                  variables: Any, *, input_spec: Any, version: str = "v1",
                  mode: str = "batched", max_batch_size: int = 32,
-                 queue_limit: int = 256, devices: Optional[Sequence] = None,
+                 queue_limit: int = 256, batch_wait_s: float = 0.0,
+                 devices: Optional[Sequence] = None,
                  warm: bool = False) -> ModelEntry:
         """Create an entry and deploy ``variables`` as its first version."""
         entry = ModelEntry(self, name, forward, input_spec, mode=mode,
                            max_batch_size=max_batch_size,
-                           queue_limit=queue_limit, devices=devices)
+                           queue_limit=queue_limit,
+                           batch_wait_s=batch_wait_s, devices=devices)
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model '{name}' already registered")
@@ -409,6 +456,37 @@ class ModelRegistry:
             self._swap(entry, variables, version, warm=True)
             entry.history.pop()  # only after the swap succeeded
         _record_flight("serving.rollback", model=name, version=version)
+        return version
+
+    # -- brownout fallback versions ----------------------------------------
+
+    def engage_fallback(self, name: str) -> Optional[str]:
+        """Deploy the entry's registered fallback variables through the
+        normal warmed hot-swap (the old version keeps serving while the
+        cheaper one pre-compiles). Returns the deployed version, or
+        None when no fallback is registered / it is already engaged."""
+        entry = self.get(name)
+        if entry.fallback_variables is None or entry.fallback_engaged:
+            return None
+        fb_version = entry.fallback_version or f"{entry.version}-fallback"
+        version = self.deploy(name, entry.fallback_variables,
+                              version=fb_version)
+        entry.fallback_engaged = True
+        _record_flight("serving.fallback", model=name, version=version,
+                       engaged=True)
+        return version
+
+    def disengage_fallback(self, name: str) -> Optional[str]:
+        """Roll back from the engaged fallback to the version that was
+        serving before the brownout. Returns the restored version, or
+        None when no fallback is engaged."""
+        entry = self.get(name)
+        if not entry.fallback_engaged:
+            return None
+        version = self.rollback(name)
+        entry.fallback_engaged = False
+        _record_flight("serving.fallback", model=name, version=version,
+                       engaged=False)
         return version
 
     def _swap(self, entry: ModelEntry, variables, version: str, warm: bool):

@@ -60,6 +60,21 @@ predicts shed with UNAVAILABLE), wait for in-flight requests to finish,
 then stop the HTTP loop and shut the replica sets down (their FIFO
 drain serves anything still queued).
 
+**Overload management** (serving/overload.py, ``overload=
+OverloadPolicy()``, None disables): predicts carry ``X-Priority``
+(``critical``/``normal``/``batch``, validated) and ``X-Tenant``
+headers; admission sheds lowest-class first against per-class
+thresholds of an AIMD-adapted effective limit (``critical`` is never
+shed while lower-class work is in flight), per-tenant token buckets
+shed runaways with a distinct ``TENANT_QUOTA`` 429 whose Retry-After
+is the exact refill wait, and sustained overload walks a brownout
+ladder (shrink batch wait → shed ``batch`` class → hot-swap registered
+fallback versions) with hysteresis, emitting ``serving.brownout``
+flight events and the ``serving_brownout_*`` metric families.
+``GET /debug/overload`` renders the manager's live state. Retry-After
+hints everywhere scale with measured overshoot (in-flight over the
+limit × the recent batch service EWMA) instead of a fixed 50 ms.
+
 Per-model-version **circuit breaker** (serving/circuit.py,
 ``circuit_policy=``, None disables): a version failing at/above the
 windowed rate sheds instantly with ``503 CIRCUIT_OPEN`` + Retry-After
@@ -102,6 +117,7 @@ from deeplearning4j_tpu.observability.metrics import (
     wants_openmetrics,
 )
 from deeplearning4j_tpu.parallel.inference import (
+    InferenceDeadlineExpired,
     InferenceQueueFull,
     InferenceShutdown,
     WorkerCrashError,
@@ -117,24 +133,37 @@ from deeplearning4j_tpu.serving.errors import (
     BadRequestError,
     CircuitOpenError,
     DeadlineExceededError,
+    DeadlineExpiredError,
     ModelNotFoundError,
     NotReadyError,
     QueueFullError,
     ServingError,
+    TenantQuotaError,
     WorkerCrashedError,
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.overload import (
+    PRIORITIES,
+    BrownoutLadder,
+    BrownoutRung,
+    OverloadManager,
+    OverloadPolicy,
+)
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 
 _PREDICT_RE = re.compile(r"^/v1/models/([\w.\-]+):predict$")
 
 _SHED_REASONS = {
     QueueFullError: "queue_full",
+    TenantQuotaError: "tenant_quota",
     DeadlineExceededError: "deadline",
+    DeadlineExpiredError: "deadline_expired",
     NotReadyError: "draining",
     CircuitOpenError: "circuit_open",
     WorkerCrashedError: "worker_crash",
 }
+
+_MAX_TENANT_LEN = 128
 
 
 class ModelServer:
@@ -153,6 +182,7 @@ class ModelServer:
         slo_time_scale: float = 1.0,
         max_profile_ms: float = 60000.0,
         circuit_policy: Optional[CircuitPolicy] = CircuitPolicy(),
+        overload: Optional[OverloadPolicy] = None,
         sentinel: bool = True,
         sentinel_detectors: Optional[Sequence] = None,
         sentinel_interval_s: float = 10.0,
@@ -172,6 +202,32 @@ class ModelServer:
         self.admission = admission if admission is not None else \
             AdmissionController(on_depth=self.metrics.queue_depth.set,
                                 default_deadline_ms=default_deadline_ms)
+        if getattr(self.admission, "on_class_depth", None) is None:
+            self.admission.on_class_depth = (
+                lambda cls, depth: self.metrics.class_in_flight.set(
+                    depth, priority=cls))
+        # worker batch service times feed the admission Retry-After
+        # overshoot EWMA (satellite of the overload work: the shed hint
+        # scales with how buried the server actually is)
+        self.registry.attach_admission(self.admission)
+        # Overload management (overload.py): priority-class admission +
+        # tenant quotas are enforced inside the AdmissionController once
+        # the manager attaches; the manager's tick adapts the in-flight
+        # limit (AIMD over p99-vs-baseline) and walks the brownout
+        # ladder (shrink batch wait → shed batch class → fallback
+        # models). None = static admission, the historical behavior.
+        self.overload: Optional[OverloadManager] = None
+        if overload is not None:
+            self.overload = OverloadManager(
+                overload, metrics=self.metrics,
+                registries=[self.metrics.registry])
+            self.overload.bind_limit(self.admission.max_in_flight)
+            self.overload.ladder = BrownoutLadder(
+                self._default_brownout_rungs(),
+                on_transition=self.overload._on_brownout_transition)
+            self.admission.attach_overload(self.overload)
+            self.metrics.effective_limit.set(self.overload.effective_limit)
+            self.metrics.brownout_level.set(0)
         self._draining = False
         self._started = False
         self._serve_thread: Optional[threading.Thread] = None
@@ -296,6 +352,13 @@ class ModelServer:
                             "rows must be a positive integer").to_json())
                         return
                     self._send(200, server.render_costs(rows=rows))
+                elif path == "/debug/overload":
+                    if server.overload is None:
+                        self._send(404, ServingError(
+                            "overload management is disabled "
+                            "(pass overload=OverloadPolicy())").to_json())
+                    else:
+                        self._send(200, server.overload.describe())
                 elif path == "/debug/incidents":
                     self._send(200, server.render_incidents())
                 elif path.startswith("/debug/incidents/"):
@@ -349,7 +412,9 @@ class ModelServer:
                        or _trace.new_id())
                 status, body = server.handle_predict(
                     m.group(1), payload, correlation_id=cid,
-                    parent_span_id=self.headers.get("X-Span-ID"))
+                    parent_span_id=self.headers.get("X-Span-ID"),
+                    priority=self.headers.get("X-Priority"),
+                    tenant=self.headers.get("X-Tenant"))
                 self._send(status, body, correlation_id=cid)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -420,10 +485,37 @@ class ModelServer:
 
     # -- predict path (handler-independent for direct testing) ---------------
 
+    @staticmethod
+    def _validate_priority(priority) -> str:
+        """``X-Priority`` → a known class (default ``normal``). Client-
+        controlled input: anything outside the fixed vocabulary is a
+        400, never a new metric label or a silent default."""
+        if priority is None or priority == "":
+            return "normal"
+        p = str(priority).strip().lower()
+        if p not in PRIORITIES:
+            raise BadRequestError(
+                f"X-Priority must be one of {list(PRIORITIES)}, "
+                f"got {priority!r}")
+        return p
+
+    @staticmethod
+    def _validate_tenant(tenant) -> Optional[str]:
+        """``X-Tenant`` → a bounded opaque key (None when absent)."""
+        if tenant is None:
+            return None
+        t = str(tenant).strip()
+        if not t:
+            return None
+        if len(t) > _MAX_TENANT_LEN:
+            raise BadRequestError(
+                f"X-Tenant must be <= {_MAX_TENANT_LEN} chars")
+        return t
+
     def handle_predict(self, name: str, payload, *,
                        correlation_id: Optional[str] = None,
-                       parent_span_id: Optional[str] = None
-                       ) -> Tuple[int, dict]:
+                       parent_span_id: Optional[str] = None,
+                       priority=None, tenant=None) -> Tuple[int, dict]:
         t0 = time.monotonic()
         # Unknown model names are client-controlled: labeling metrics with
         # them would grow a permanent label set per scanned/typo'd URL.
@@ -438,13 +530,20 @@ class ModelServer:
         with _trace.span("serving.request", trace_id=cid,
                          parent_id=parent_span_id, model=name) as req_span:
             try:
+                prio = self._validate_priority(priority)
+                tenant = self._validate_tenant(tenant)
                 inj = _fault_injector()
                 if inj.enabled:
                     # resilience injection points: "serving.latency" (sleep
-                    # arg seconds) and "serving.error" (retryable 429 shed) —
-                    # deterministic overload/latency spikes for client-retry
-                    # and SLO tests, armed via DL4J_TPU_FAULTS
+                    # arg seconds), "serving.overload" (the same sleep,
+                    # named for sustained synthetic-overload chaos — armed
+                    # with xTIMES it degrades p99 until the budget runs
+                    # out, driving AIMD shrink → brownout → recovery), and
+                    # "serving.error" (retryable 429 shed) — deterministic
+                    # spikes for client-retry and SLO tests, armed via
+                    # DL4J_TPU_FAULTS
                     inj.maybe_sleep("serving.latency")
+                    inj.maybe_sleep("serving.overload")
                     p = inj.fire("serving.error")
                     if p is not None:
                         raise QueueFullError(
@@ -470,17 +569,28 @@ class ModelServer:
                             retry_after_ms=retry_after_s * 1000.0)
                 # Admit before the body parse: over-cap traffic must shed
                 # before paying the array-coercion cost, not after.
-                with _trace.span("serving.admission"):
+                with _trace.span("serving.admission", priority=prio):
                     timeout = self.admission.timeout_s(
                         payload.get("deadline_ms"))
-                    ticket = self.admission.admit()
+                    ticket = self.admission.admit(priority=prio,
+                                                  tenant=tenant)
+                # the absolute deadline anchors at admission: a request
+                # still queued past it is dropped before dispatch
+                deadline = time.monotonic() + timeout
                 try:
                     features = entry.parse_inputs(payload["inputs"])
                     tctx = ((cid, req_span.span_id)
                             if req_span is not None else None)
                     try:
                         out, version = entry.predict_versioned(
-                            features, timeout=timeout, trace=tctx)
+                            features, timeout=timeout, trace=tctx,
+                            deadline=deadline)
+                    except InferenceDeadlineExpired as e:
+                        # dropped pre-dispatch: distinct code + shed
+                        # reason — the client learns it never ran
+                        raise DeadlineExpiredError(
+                            str(e) or "deadline expired before "
+                            "dispatch") from e
                     except TimeoutError as e:
                         raise DeadlineExceededError(
                             str(e) or "deadline exceeded") from e
@@ -518,8 +628,15 @@ class ModelServer:
                 if reason is not None:
                     self.metrics.shed_total.inc(model=metric_model,
                                                 reason=reason)
+                    extra = {}
+                    if isinstance(e, TenantQuotaError):
+                        # the counter is deliberately unlabeled (client-
+                        # controlled keys = unbounded series); per-tenant
+                        # attribution rides the bounded flight ring
+                        self.metrics.tenant_shed_total.inc()
+                        extra["tenant"] = tenant or ""
                     record_event("serving.shed", model=metric_model,
-                                 reason=reason, status=status)
+                                 reason=reason, status=status, **extra)
             except Exception as e:  # noqa: BLE001 — surface, never crash
                 status = 500
                 body = {"error": {"code": "INTERNAL",
@@ -552,6 +669,67 @@ class ModelServer:
                                              model=metric_model,
                                              exemplar_trace_id=cid)
         return status, body
+
+    # -- brownout ladder (default rungs) --------------------------------------
+
+    def _default_brownout_rungs(self):
+        """The default degradation ladder, shallowest first:
+
+        1. ``shrink_batch_wait`` — zero every entry's batch coalesce
+           wait: latency headroom beats occupancy once overloaded.
+        2. ``shed_batch_class`` — reject all ``batch``-priority
+           requests at admission.
+        3. ``serve_fallback`` — hot-swap every registered fallback
+           version in (and back out on recovery) via the normal warmed
+           deploy/rollback plumbing.
+        """
+        self._saved_batch_waits: dict = {}
+
+        def shed_on():
+            self.overload.shed_batch = True
+
+        def shed_off():
+            self.overload.shed_batch = False
+
+        return [
+            BrownoutRung("shrink_batch_wait",
+                         self._brownout_shrink_batch_wait,
+                         self._brownout_restore_batch_wait),
+            BrownoutRung("shed_batch_class", shed_on, shed_off),
+            BrownoutRung("serve_fallback",
+                         self._brownout_engage_fallbacks,
+                         self._brownout_disengage_fallbacks),
+        ]
+
+    def _brownout_shrink_batch_wait(self):
+        for e in self.registry.entries():
+            if e.batch_wait_s > 0:
+                self._saved_batch_waits[e.name] = e.batch_wait_s
+                e.set_batch_wait(0.0)
+
+    def _brownout_restore_batch_wait(self):
+        saved, self._saved_batch_waits = self._saved_batch_waits, {}
+        for name, wait in saved.items():
+            try:
+                self.registry.get(name).set_batch_wait(wait)
+            except Exception:  # noqa: BLE001 — entry may be gone; recover rest
+                pass
+
+    def _brownout_engage_fallbacks(self):
+        for name in self.registry.names():
+            try:
+                self.registry.engage_fallback(name)
+            except Exception as e:  # noqa: BLE001 — one bad fallback must
+                record_event("serving.fallback_error",  # not stop the rest
+                             model=name, error=str(e)[:200])
+
+    def _brownout_disengage_fallbacks(self):
+        for name in self.registry.names():
+            try:
+                self.registry.disengage_fallback(name)
+            except Exception as e:  # noqa: BLE001
+                record_event("serving.fallback_error",
+                             model=name, error=str(e)[:200])
 
     # -- metrics exposition ---------------------------------------------------
 
@@ -709,6 +887,8 @@ class ModelServer:
         self._serve_thread.start()
         self._started = True
         self.slo_engine.start()
+        if self.overload is not None:
+            self.overload.start()
         if _slo.get_default_engine() is None:
             # zero-config visibility: UIServer's /health page renders the
             # process-default engine
@@ -745,6 +925,8 @@ class ModelServer:
             self._started = False
             record_event("serving.stop", port=self.port, drained=drained)
         self.slo_engine.stop()
+        if self.overload is not None:
+            self.overload.stop()
         if self.sentinel is not None:
             self.sentinel.stop()
             # only unhook ourselves (a newer server's hook must survive);

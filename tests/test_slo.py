@@ -44,7 +44,8 @@ class TestValidation:
             "serving-availability", "serving-latency-p99",
             "serving-circuit-breaker", "collective-watchdog",
             "train-data-pipeline", "cluster-worker-liveness",
-            "cluster-degraded-mode", "anomaly-firing"}
+            "cluster-degraded-mode", "anomaly-firing",
+            "brownout-engaged"}
 
     def test_default_serving_rules_match_example_vocabulary(self):
         known = slo.known_metric_names()
@@ -131,7 +132,7 @@ class TestCheckCLI:
              "--check", EXAMPLE_RULES],
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr
-        assert "ok: 8 rule(s) valid" in out.stdout
+        assert "ok: 9 rule(s) valid" in out.stdout
 
     def test_bad_rules_exit_nonzero(self, tmp_path):
         bad = tmp_path / "bad.json"
