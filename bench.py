@@ -867,6 +867,117 @@ def bench_overload(peak, *, critical_threads=4, normal_threads=8,
         server.stop()
 
 
+def bench_generation(peak, *, n_clients=6, requests_per_client=4,
+                     num_slots=4, max_new_tokens=32, max_len=96,
+                     hidden=128, num_layers=3, num_heads=4, vocab=512,
+                     prompt_lens=(4, 11, 23), temperature=0.8):
+    """Generative-serving benchmark (serving/generation.py): tokens/sec
+    at a fixed offered load of closed-loop STREAMING clients through the
+    full stack — real loopback HTTP, continuous batching, bucketed KV
+    slabs — plus client-measured p50/p99 time-to-first-token, mean
+    decode-slot occupancy, and the recompile discipline gate:
+    jax.monitoring-counted compilations after warmup must be exactly 0
+    across the mixed prefix lengths. ``peak`` is unused: the metric is
+    end-to-end decode throughput, not MFU.
+    """
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models.gpt import Gpt, GptConfig
+    from deeplearning4j_tpu.observability.runtime import (
+        get_runtime_collector,
+    )
+    from deeplearning4j_tpu.serving import (
+        GenerationEngine,
+        ModelServer,
+        ServingClient,
+    )
+
+    model = Gpt(GptConfig(
+        vocab_size=vocab, hidden=hidden, num_layers=num_layers,
+        num_heads=num_heads, intermediate=hidden * 4,
+        max_position=max_len, dropout=0.0, attention_dropout=0.0))
+    variables = model.init(seed=0)
+    engine = GenerationEngine(
+        model, variables, name="gpt", num_slots=num_slots,
+        max_len=max_len, max_new_tokens=max_new_tokens,
+        idle_wait_s=0.002, temperature=temperature,
+        max_waiting=2 * n_clients * requests_per_client)
+    server = ModelServer(port=0, sentinel=False, generators={"gpt": engine})
+    server.start(warm=True)  # every (slot, kv) + prompt bucket compiled
+    try:
+        collector = get_runtime_collector()
+        compiles_before = collector.jit_compiles_total.value()
+        lock = threading.Lock()
+        ttfts, tokens_done, broken = [], [], []
+        barrier = threading.Barrier(n_clients + 1)
+
+        def run(tid):
+            rng = np.random.default_rng(tid)
+            client = ServingClient(server.url, max_retries=4)
+            barrier.wait()
+            for i in range(requests_per_client):
+                plen = prompt_lens[(tid + i) % len(prompt_lens)]
+                prompt = rng.integers(0, vocab - 1, size=plen)
+                t0 = time.monotonic()
+                first, n = None, 0
+                try:
+                    for _tok in client.generate("gpt", prompt,
+                                                temperature=temperature):
+                        if first is None:
+                            first = time.monotonic() - t0
+                        n += 1
+                    with lock:
+                        ttfts.append(first)
+                        tokens_done.append(n)
+                except Exception as e:  # noqa: BLE001 - any failure = bug
+                    with lock:
+                        broken.append(repr(e))
+
+        threads = [threading.Thread(target=run, args=(t,))
+                   for t in range(n_clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()  # all clients poised: the window starts here
+        t_start = time.monotonic()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t_start
+
+        recompiles = int(collector.jit_compiles_total.value()
+                         - compiles_before)
+        occupancy = server.metrics.generation_slot_occupancy.summary(
+            model="gpt")
+        ttft_ms = (np.sort(np.asarray([t for t in ttfts if t is not None]))
+                   if ttfts else np.zeros(1)) * 1e3
+        offered = n_clients * requests_per_client
+        total_tokens = int(sum(tokens_done))
+        info = {
+            "n_clients": n_clients, "offered": offered,
+            "served": len(tokens_done), "broken": len(broken),
+            "num_slots": num_slots, "max_new_tokens": max_new_tokens,
+            "total_tokens": total_tokens,
+            "ttft_p50_ms": round(float(np.percentile(ttft_ms, 50)), 2),
+            "ttft_p99_ms": round(float(np.percentile(ttft_ms, 99)), 2),
+            "slot_occupancy_mean": (round(occupancy["mean"], 3)
+                                    if occupancy["count"] else 0.0),
+            "decode_steps": engine.steps,
+            "recompiles_after_warmup": recompiles,
+            "engine_compiles_after_warm": engine.compiles_after_warm,
+            # config-integrity gate: every stream completed, tokens
+            # flowed, and NO decode/prefill recompiled after warmup
+            "converged": (len(tokens_done) == offered and not broken
+                          and total_tokens > 0 and recompiles == 0
+                          and engine.compiles_after_warm == 0),
+            "unit": "tokens/sec",
+        }
+        info["value"] = round(total_tokens / wall, 1)
+        return info
+    finally:
+        server.stop()
+
+
 def bench_resilience(peak, *, sizes_mb=(1, 8, 64), repeats=3, epochs=2):
     """Fault-tolerance benchmark (resilience/ + serde integrity):
     verified-checkpoint save/verify/restore latency vs. snapshot size
@@ -2020,6 +2131,11 @@ _CONFIGS = {
     # and p99 at ~10x offered load through priority admission + AIMD +
     # brownout; gated on critical availability >= 99%.
     "overload": bench_overload,
+    # Generative serving (serving/generation.py): tokens/sec at fixed
+    # offered streaming load through continuous batching + bucketed KV
+    # slabs, p99 time-to-first-token, slot occupancy; gated on zero
+    # recompiles after warmup across mixed prefix lengths.
+    "generation": bench_generation,
     # Fault-tolerance path (resilience/ + serde integrity): verified
     # checkpoint save/verify/restore latency vs. snapshot size + recovery
     # wall-clock after an injected fault; first recorded round.
@@ -2062,6 +2178,12 @@ _CPU_INTEGRITY = {
     "overload": dict(critical_threads=2, normal_threads=3,
                      batch_threads=7, duration_s=3.0, max_in_flight=2,
                      max_batch=8),
+    # generation reports "converged" = every stream completed, tokens
+    # flowed, and zero recompiles after warmup (mixed prefix lengths)
+    "generation": dict(n_clients=3, requests_per_client=2, num_slots=2,
+                       max_new_tokens=8, max_len=32, hidden=64,
+                       num_layers=2, num_heads=2, vocab=128,
+                       prompt_lens=(3, 7)),
     # resilience reports "converged" = faulted run recovered to the
     # fault-free step count
     "resilience": dict(sizes_mb=(1,), repeats=1, epochs=1),
@@ -2159,8 +2281,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs",
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
-                            "serving,overload,resilience,observability,"
-                            "robustness,federation,elastic,sentinel",
+                            "serving,overload,generation,resilience,"
+                            "observability,robustness,federation,elastic,"
+                            "sentinel",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

@@ -262,6 +262,131 @@ class Gpt:
         lg = hfin @ params["embeddings"]["word"].T + f["out_b"]
         return lg, new_caches
 
+    # -- continuous-batching decode (serving/generation.py) ----------------
+
+    def _block_step_slots(self, p, cache, x_t, pos):
+        """One token through one block with cached K/V and PER-ROW
+        positions — the continuous-batching twin of :meth:`_block_step`,
+        where every row of the batch is an independent sequence at its
+        own depth (``pos`` is [N] int32, not a scalar). Parity with the
+        scalar path is pinned by
+        tests/test_generation_serving.py::test_slot_decode_matches_scalar.
+        """
+        c = self.config
+        h = c.num_heads
+        eps = c.eps
+
+        def ln(v, which):
+            return opsnn.layer_norm(v, p[f"{which}_gamma"],
+                                    p[f"{which}_beta"], eps=eps)
+
+        ap = p["attention"]
+        a_in = ln(x_t, "ln1")  # [N,H]
+        n, e = a_in.shape
+        hd = e // h
+
+        def heads(z):
+            return z.reshape(n, h, hd)  # [N,h,hd] from [N, h*hd]
+
+        q = heads(opsnn.linear(a_in, ap["Wq"], ap.get("bq")))
+        k = heads(opsnn.linear(a_in, ap["Wk"], ap.get("bk")))
+        v = heads(opsnn.linear(a_in, ap["Wv"], ap.get("bv")))
+        rows = jnp.arange(n)
+        # per-row scatter: row i's new K/V lands at its own pos[i]
+        kc = cache["k"].at[rows, :, pos, :].set(k)
+        vc = cache["v"].at[rows, :, pos, :].set(v)
+        scores = jnp.einsum("nhd,nhld->nhl", q, kc) / jnp.sqrt(
+            jnp.asarray(hd, q.dtype))
+        # causal-by-construction, per row: only slots <= pos[i] are live
+        live = jnp.arange(kc.shape[2])[None, None, :] <= pos[:, None, None]
+        scores = jnp.where(live, scores, jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores, axis=-1)
+        y = jnp.einsum("nhl,nhld->nhd", att, vc).reshape(n, e)
+        a = opsnn.linear(y, ap["Wo"], ap.get("bo"))
+        x = x_t + a
+        f_in = ln(x, "ln2")
+        f = opsnn.linear(f_in, p["W1"], p["b1"])
+        f = get_activation(c.activation)(f)
+        f = opsnn.linear(f, p["W2"], p["b2"])
+        return x + f, {"k": kc, "v": vc}
+
+    def decode_step_slots(self, params, caches, ids_t, pos):
+        """One iteration-level decode step over independent sequences:
+        ids_t [N] int32, pos [N] int32 (each row's own 0-based position)
+        → (logits [N,V], updated caches). Rows are decode *slots* —
+        sequences at different depths batched into one device step, the
+        core primitive of the continuous-batching serving engine."""
+        c = self.config
+        emb = params["embeddings"]
+        x = opsnn.embedding_lookup(emb["word"], ids_t)  # [N,H]
+        x = x + emb["position"][pos]                    # per-row gather
+        new_caches = []
+        for i in range(c.num_layers):
+            x, cc = self._block_step_slots(params[f"layer_{i}"], caches[i],
+                                           x, pos)
+            new_caches.append(cc)
+        f = params["final"]
+        hfin = opsnn.layer_norm(x, f["ln_gamma"], f["ln_beta"], eps=c.eps)
+        lg = hfin @ params["embeddings"]["word"].T + f["out_b"]
+        return lg, new_caches
+
+    def prefill_chunk(self, params, ids):
+        """Whole-prompt prefill with full causal self-attention:
+        ids [N,P] int32 → (logits [N,P,V], per-layer K/V
+        ``[{"k": [N,h,P,hd], "v": ...}]``). One matmul-bound program
+        instead of a P-step decode scan — the compute-shaped half of the
+        prefill/decode split (decode is memory-bound; cuDNN-paper
+        batched-primitive framing). Re-implements the pre-LN block over
+        the same param tree; logits parity with the cached decode scan
+        is pinned by tests/test_generation_serving.py."""
+        c = self.config
+        h = c.num_heads
+        emb = params["embeddings"]
+        n, pl = ids.shape
+        x = opsnn.embedding_lookup(emb["word"], ids)
+        x = x + emb["position"][:pl][None, :, :]
+        causal = jnp.tril(jnp.ones((pl, pl), bool))[None, None]
+        kvs = []
+        for i in range(c.num_layers):
+            p = params[f"layer_{i}"]
+
+            def ln(v, which, p=p):
+                return opsnn.layer_norm(v, p[f"{which}_gamma"],
+                                        p[f"{which}_beta"], eps=c.eps)
+
+            ap = p["attention"]
+            a_in = ln(x, "ln1")                      # [N,P,E]
+            e = a_in.shape[-1]
+            hd = e // h
+
+            def heads(z):
+                # [N,P,h*hd] -> [N,h,P,hd]; feature layout head-major,
+                # matching _block_step's reshape(n, h, 1, hd)
+                return z.reshape(n, pl, h, hd).transpose(0, 2, 1, 3)
+
+            q = heads(opsnn.linear(a_in, ap["Wq"], ap.get("bq")))
+            k = heads(opsnn.linear(a_in, ap["Wk"], ap.get("bk")))
+            v = heads(opsnn.linear(a_in, ap["Wv"], ap.get("bv")))
+            scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) / jnp.sqrt(
+                jnp.asarray(hd, q.dtype))
+            scores = jnp.where(causal, scores,
+                               jnp.finfo(scores.dtype).min)
+            att = jax.nn.softmax(scores, axis=-1)
+            y = jnp.einsum("nhqk,nhkd->nhqd", att, v)
+            y = y.transpose(0, 2, 1, 3).reshape(n, pl, e)
+            x = x + opsnn.linear(y, ap["Wo"], ap.get("bo"))
+            f_in = ln(x, "ln2")
+            f = opsnn.linear(f_in, p["W1"], p["b1"])
+            f = get_activation(c.activation)(f)
+            x = x + opsnn.linear(f, p["W2"], p["b2"])
+            kvs.append({"k": k, "v": v})
+        fin = params["final"]
+        hfin = opsnn.layer_norm(x, fin["ln_gamma"], fin["ln_beta"],
+                                eps=c.eps)
+        lg = (jnp.einsum("nth,vh->ntv", hfin, emb["word"])
+              + fin["out_b"])
+        return lg, kvs
+
     def generate(self, variables, prime_ids, *, n_steps: int, rng,
                  temperature: float = 1.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None,

@@ -22,6 +22,22 @@ import jax
 import jax.numpy as jnp
 
 
+def sample_token(logits, key, temperature):
+    """Per-row temperature sampling shared by the char-RNN loop and the
+    serving decode engine: logits [N,V] float, temperature [N] float →
+    [N] int32. Rows with temperature <= 0 take the argmax (greedy);
+    the rest draw from softmax(logits / temperature). One traced
+    program covers greedy and sampled rows in the same batch — the
+    continuous-batching scheduler must not fork a compile per request
+    mix, so the selection is a ``where``, not Python control flow."""
+    logits = jnp.asarray(logits)
+    temperature = jnp.asarray(temperature, logits.dtype)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tempered = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    drawn = jax.random.categorical(key, tempered, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, drawn)
+
+
 def _split_stack(model):
     """Split a SequentialModel into (recurrent stack prefix, head layers).
 
@@ -159,8 +175,10 @@ def _build_generate_fn(model, n_steps: int, temperature: float):
 
         def sample_step(carry, key):
             carries, probs = carry
-            logits = jnp.log(jnp.clip(probs, 1e-9, 1.0)) / temperature
-            ids = jax.random.categorical(key, logits, axis=-1)  # [N]
+            logits = jnp.log(jnp.clip(probs, 1e-9, 1.0))
+            ids = sample_token(logits, key,
+                               jnp.full((batch,), temperature,
+                                        logits.dtype))  # [N]
             probs2, carries = one_step(carries, jax.nn.one_hot(ids, vocab,
                                                                dtype=dtype))
             return (carries, probs2), ids

@@ -26,9 +26,9 @@ toward OOM. This module is the change detector:
   first capture happens DURING the anomaly, not after a human notices.
 
 Built-in detectors (:func:`default_detectors`): train step-time
-regression, serving p99 regression, recompile storm, admission queue
-buildup, data starvation, live-array-bytes / HBM monotonic growth
-(leak heuristic).
+regression, serving p99 regression, generation TTFT regression,
+recompile storm, admission queue buildup, data starvation,
+live-array-bytes / HBM monotonic growth (leak heuristic).
 
 Everything is scrapeable: ``anomaly_state{detector=}`` /
 ``anomaly_score{detector=}`` gauges, ``anomaly_transitions_total``,
@@ -540,7 +540,7 @@ class Detector:
 
 def default_detectors(*, fire_after: int = 3, clear_after: int = 3,
                       min_history: int = 8) -> List[Detector]:
-    """The seven built-ins over the standard telemetry families. All are
+    """The eight built-ins over the standard telemetry families. All are
     quiet until their probe has real data AND the baseline has
     ``min_history`` accepted samples — a fresh process can't fire
     during its own warmup."""
@@ -560,6 +560,15 @@ def default_detectors(*, fire_after: int = 3, clear_after: int = 3,
             mode="baseline", threshold=8.0, min_increase=0.5,
             description="Serving request p99 (bucket-resolved) rose far "
                         "above its rolling baseline.", **k),
+        Detector(
+            "generation_ttft_regression",
+            HistogramQuantileProbe("generation_ttft_seconds",
+                                   q=0.99, min_count=4),
+            mode="baseline", threshold=8.0, min_increase=0.5,
+            description="Streaming-generation time-to-first-token p99 "
+                        "(bucket-resolved) rose far above its rolling "
+                        "baseline: prefill is queueing behind decode or "
+                        "slots are saturated.", **k),
         Detector(
             "recompile_storm",
             CounterRateProbe("runtime_jit_compiles_total"),

@@ -18,6 +18,14 @@ REST serving story, grown into a first-class subsystem).
   error rate → half-open probes → closed); open sheds with 503 +
   Retry-After so the client's retry path composes.
 - client: stdlib ServingClient raising the same typed errors.
+- generation: the generative serving engine — iteration-level
+  continuous batching for GPT decode (requests join/leave the in-flight
+  batch every step), per-sequence KV caches in preallocated
+  power-of-two bucketed slabs (prefill + decode compiled per bucket,
+  warmed at deploy: zero steady-state recompiles), token streaming over
+  the HTTP server (chunked ndjson; ServingClient.generate() yields),
+  priority preemption of decode slots, and a shrink-max_new_tokens
+  brownout rung.
 - overload: overload management — priority-class admission (critical/
   normal/batch via X-Priority, lowest class sheds first, critical never
   shed while lower-class work is in flight), per-tenant token-bucket
@@ -42,9 +50,15 @@ from deeplearning4j_tpu.serving.errors import (
     NotReadyError,
     QueueFullError,
     ServingError,
+    SlotPreemptedError,
     TenantQuotaError,
     WorkerCrashedError,
     error_from_code,
+)
+from deeplearning4j_tpu.serving.generation import (
+    GenerationEngine,
+    GenerationStream,
+    token_brownout_rung,
 )
 from deeplearning4j_tpu.serving.metrics import (
     Counter,
@@ -83,6 +97,8 @@ __all__ = [
     "DeadlineExceededError",
     "DeadlineExpiredError",
     "Gauge",
+    "GenerationEngine",
+    "GenerationStream",
     "Histogram",
     "MetricsRegistry",
     "ModelEntry",
@@ -97,12 +113,14 @@ __all__ = [
     "ServingClient",
     "ServingError",
     "ServingMetrics",
+    "SlotPreemptedError",
     "TenantQuotas",
     "TenantQuotaError",
     "WorkerCrashedError",
     "bucket_sizes",
     "error_from_code",
     "spec",
+    "token_brownout_rung",
     "warmup_inference",
     "zeros_batch",
 ]

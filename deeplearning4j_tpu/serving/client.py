@@ -63,6 +63,36 @@ class ServingClient:
         self._rng = random.Random(retry_seed)
         self._sleep = sleep
 
+    @staticmethod
+    def _raise_typed(e: urllib.error.HTTPError):
+        """Map one HTTPError to the typed ServingError — shared by the
+        predict and streaming-generate paths so both honor the
+        Retry-After header and map a proxy/LB's plain-text 429/503 to
+        the retryable classes."""
+        retry_after_ms = None
+        header = e.headers.get("Retry-After") if e.headers else None
+        if header:
+            try:
+                retry_after_ms = float(header) * 1000.0
+            except ValueError:
+                pass  # HTTP-date form: ignore, body may still carry ms
+        try:
+            body = json.loads(e.read())
+        except Exception:  # noqa: BLE001 - non-JSON error body
+            # a proxy/LB shedding with a plain-text 429/503 must still
+            # map to the retryable typed error, or the retry loop
+            # silently does nothing in exactly the proxied deployment
+            cls = {429: QueueFullError, 503: NotReadyError}.get(
+                e.code, ServingError)
+            raise cls(
+                f"HTTP {e.code}", retry_after_ms=retry_after_ms) from e
+        err = body.get("error", {})
+        if err.get("retry_after_ms") is not None:
+            retry_after_ms = err["retry_after_ms"]  # body ms is precise
+        raise error_from_code(err.get("code", "INTERNAL"),
+                              err.get("message", f"HTTP {e.code}"),
+                              retry_after_ms=retry_after_ms) from e
+
     def _request_once(self, path: str, payload: Optional[dict] = None,
                       headers: Optional[dict] = None) -> dict:
         data = json.dumps(payload).encode() if payload is not None else None
@@ -75,29 +105,7 @@ class ServingClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return json.loads(r.read())
         except urllib.error.HTTPError as e:
-            retry_after_ms = None
-            header = e.headers.get("Retry-After") if e.headers else None
-            if header:
-                try:
-                    retry_after_ms = float(header) * 1000.0
-                except ValueError:
-                    pass  # HTTP-date form: ignore, body may still carry ms
-            try:
-                body = json.loads(e.read())
-            except Exception:  # noqa: BLE001 - non-JSON error body
-                # a proxy/LB shedding with a plain-text 429/503 must still
-                # map to the retryable typed error, or the retry loop
-                # silently does nothing in exactly the proxied deployment
-                cls = {429: QueueFullError, 503: NotReadyError}.get(
-                    e.code, ServingError)
-                raise cls(
-                    f"HTTP {e.code}", retry_after_ms=retry_after_ms) from e
-            err = body.get("error", {})
-            if err.get("retry_after_ms") is not None:
-                retry_after_ms = err["retry_after_ms"]  # body ms is precise
-            raise error_from_code(err.get("code", "INTERNAL"),
-                                  err.get("message", f"HTTP {e.code}"),
-                                  retry_after_ms=retry_after_ms) from e
+            self._raise_typed(e)
 
     def _request(self, path: str, payload: Optional[dict] = None,
                  headers: Optional[dict] = None) -> dict:
@@ -162,15 +170,110 @@ class ServingClient:
         cid = correlation_id if correlation_id else _trace.new_id()
         with _trace.span("client.request", trace_id=cid,
                          model=model) as s:
-            headers = {"X-Correlation-ID": cid}
-            if priority is not None:
-                headers["X-Priority"] = priority
-            if tenant is not None:
-                headers["X-Tenant"] = tenant
+            headers = self._headers(cid, priority, tenant)
             if s is not None:
                 headers["X-Span-ID"] = s.span_id
             return self._request(f"/v1/models/{model}:predict", payload,
                                  headers)
+
+    def _generate_payload(self, prompt, max_new_tokens, temperature,
+                          eos_id, stream, deadline_ms):
+        payload = {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+                   "stream": stream}
+        if max_new_tokens is not None:
+            payload["max_new_tokens"] = int(max_new_tokens)
+        if temperature is not None:
+            payload["temperature"] = float(temperature)
+        if eos_id is not None:
+            payload["eos_id"] = int(eos_id)
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        return payload
+
+    def _headers(self, cid, priority, tenant):
+        headers = {"X-Correlation-ID": cid}
+        if priority is not None:
+            headers["X-Priority"] = priority
+        if tenant is not None:
+            headers["X-Tenant"] = tenant
+        return headers
+
+    def generate(self, model: str, prompt, *,
+                 max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 priority: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 correlation_id: Optional[str] = None):
+        """POST a streaming generation; yields token ids AS THE SERVER
+        PRODUCES THEM (chunked newline-delimited JSON over the wire).
+        ``deadline_ms`` bounds the WHOLE stream server-side (default:
+        the server's default_deadline_ms, same semantics as predict);
+        on expiry the stream ends with a terminal DEADLINE_EXCEEDED.
+        Raises the typed ServingError on a shed/preemption — including
+        MID-STREAM (the server turns a preempted slot into a terminal
+        ``{"error": ...}`` line; tokens already yielded stand). The
+        retry policy does NOT apply to streams — a generator cannot
+        un-yield — so retry-on-preempt is the caller's loop, or use
+        :meth:`generate_tokens` which retries whole requests."""
+        payload = self._generate_payload(prompt, max_new_tokens,
+                                         temperature, eos_id, True,
+                                         deadline_ms)
+        cid = correlation_id if correlation_id else _trace.new_id()
+        req = urllib.request.Request(
+            self.base_url + f"/v1/models/{model}:generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     **self._headers(cid, priority, tenant)})
+        # POST eagerly: submit-time sheds (429/503/400) must raise HERE,
+        # where the caller's try/except lives — not at the first next()
+        # of a generator they may consume elsewhere (or never)
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            self._raise_typed(e)
+
+        def _stream():
+            with resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    if "token" in ev:
+                        yield int(ev["token"])
+                    elif "error" in ev:
+                        err = ev["error"]
+                        raise error_from_code(
+                            err.get("code", "INTERNAL"),
+                            err.get("message", ""),
+                            retry_after_ms=err.get("retry_after_ms"))
+                    elif ev.get("done"):
+                        return
+
+        return _stream()
+
+    def generate_tokens(self, model: str, prompt, *,
+                        max_new_tokens: Optional[int] = None,
+                        temperature: Optional[float] = None,
+                        eos_id: Optional[int] = None,
+                        deadline_ms: Optional[float] = None,
+                        priority: Optional[str] = None,
+                        tenant: Optional[str] = None,
+                        correlation_id: Optional[str] = None) -> dict:
+        """Non-streaming generation: one request, one collected response
+        ``{"model", "version", "tokens", "n_tokens", "finish_reason"}``.
+        Rides :meth:`_request`, so ``max_retries`` re-sends retryable
+        sheds AND mid-flight preemptions (``503 SLOT_PREEMPTED``) after
+        the server's Retry-After — the whole request restarts, which is
+        exactly the preempted-client-retries contract."""
+        payload = self._generate_payload(prompt, max_new_tokens,
+                                         temperature, eos_id, False,
+                                         deadline_ms)
+        cid = correlation_id if correlation_id else _trace.new_id()
+        return self._request(f"/v1/models/{model}:generate", payload,
+                             self._headers(cid, priority, tenant))
 
     def models(self) -> list:
         return self._request("/models")["models"]

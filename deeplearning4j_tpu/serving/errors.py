@@ -122,6 +122,18 @@ class CircuitOpenError(ServingError):
     retryable = True
 
 
+class SlotPreemptedError(ServingError):
+    """A generation request's decode slot was preempted by a
+    higher-priority request: its KV slab was released mid-stream so the
+    more important sequence could run. Transient by construction — the
+    preempting burst drains — so retryable, with ``retry_after_ms``
+    carrying the engine's estimate of when a slot frees up."""
+
+    code = "SLOT_PREEMPTED"
+    http_status = 503
+    retryable = True
+
+
 class WorkerCrashedError(ServingError):
     """An inference worker thread died while holding this request's
     batch. The batch is lost but the failure is transient — a

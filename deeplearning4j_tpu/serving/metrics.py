@@ -113,6 +113,55 @@ class ServingMetrics:
             "serving_brownout_ticks_total",
             "Overload-manager passes that found the brownout level "
             "above 0 (the brownout-engaged rule's bad events).")
+        # -- generative serving (serving/generation.py) --
+        self.generation_requests_total = r.counter(
+            "generation_requests_total",
+            "Generation requests by model and outcome (completed | "
+            "preempted | failed | shed | deadline | cancelled — "
+            "cancelled means the CLIENT disconnected mid-stream and "
+            "deliberately does not count against the generation-"
+            "availability rule; deadline is the server missing the "
+            "request's deadline and does).",
+            ("model", "outcome"))
+        self.generation_tokens_total = r.counter(
+            "generation_tokens_total",
+            "Tokens streamed to clients (prefill first-tokens plus "
+            "decode-step tokens).", ("model",))
+        self.generation_ttft = r.histogram(
+            "generation_ttft_seconds",
+            "Time-to-first-token: submit to the prefill-sampled first "
+            "token entering the stream.", ("model",))
+        self.generation_decode_steps_total = r.counter(
+            "generation_decode_steps_total",
+            "Iteration-level decode steps dispatched (each serves every "
+            "active slot once).", ("model",))
+        self.generation_slot_occupancy = r.histogram(
+            "generation_slot_occupancy",
+            "active-slots/slot-bucket per dispatched decode step "
+            "(1.0 = no padded slots).", ("model",),
+            buckets=OCCUPANCY_BUCKETS)
+        self.generation_active_slots = r.gauge(
+            "generation_active_slots",
+            "Sequences currently holding a decode slot.", ("model",))
+        self.generation_queue_depth = r.gauge(
+            "generation_queue_depth",
+            "Generation requests waiting for a decode slot.", ("model",))
+        self.generation_slot_limit = r.gauge(
+            "generation_slot_limit",
+            "Effective decode-slot cap (num_slots clamped by the AIMD "
+            "overload limit).", ("model",))
+        self.generation_preemptions_total = r.counter(
+            "generation_preemptions_total",
+            "Decode slots preempted, by the priority class of the "
+            "victim.", ("model", "priority"))
+        self.generation_kv_bytes = r.gauge(
+            "generation_kv_bytes",
+            "Bytes preallocated in the bucketed KV slab pool.",
+            ("model",))
+        self.generation_max_new_tokens = r.gauge(
+            "generation_max_new_tokens",
+            "Current effective max_new_tokens cap (shrunk by the "
+            "generation brownout rung under overload).", ("model",))
         self.circuit_state = r.gauge(
             "serving_circuit_state",
             "Per-model-version circuit-breaker state "

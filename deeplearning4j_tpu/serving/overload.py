@@ -263,7 +263,35 @@ class BrownoutLadder:
             raise ValueError(f"duplicate rung names in {names}")
         self._level = 0
         self._on_transition = on_transition
+        self._listeners: List[Callable] = []
         self._lock = threading.Lock()
+
+    def insert_rung(self, rung: BrownoutRung,
+                    before: Optional[str] = None) -> bool:
+        """Insert ``rung`` ahead of the rung named ``before`` (append
+        when absent), even while the ladder is walking: inserting at an
+        index >= the current level leaves the engaged prefix's indices
+        untouched, so it is safe mid-brownout. Returns False — no
+        insert — only when the insertion point sits INSIDE the engaged
+        prefix (the ``before`` rung itself is currently engaged);
+        re-attempt after the next transition (``add_transition_listener``).
+        A rung with this name already present is a no-op True."""
+        with self._lock:
+            names = [r.name for r in self.rungs]
+            if rung.name in names:
+                return True
+            at = names.index(before) if before in names else len(names)
+            if self._level > at:
+                return False
+            self.rungs.insert(at, rung)
+            return True
+
+    def add_transition_listener(self, listener: Callable) -> None:
+        """Register an extra ``(frm, to, rung, direction, error)``
+        observer alongside ``on_transition`` (telemetry stays the
+        server's; listeners are for followers like deferred rung
+        insertion). Exceptions are swallowed like the main hook's."""
+        self._listeners.append(listener)
 
     @property
     def level(self) -> int:
@@ -307,9 +335,10 @@ class BrownoutLadder:
         return rung.name
 
     def _notify(self, frm: int, to: int, rung: str, direction: str, err):
-        if self._on_transition is not None:
+        for cb in ([self._on_transition] if self._on_transition is not None
+                   else []) + list(self._listeners):
             try:
-                self._on_transition(frm, to, rung, direction, err)
+                cb(frm, to, rung, direction, err)
             except Exception:  # noqa: BLE001 — telemetry never blocks
                 pass
 

@@ -8,6 +8,14 @@ what the repo already ships. Endpoints:
   ``{"inputs": ..., "deadline_ms": <optional>}``; 200 returns
   ``{"model", "version", "outputs"}``; failures return the structured
   error envelope (errors.py) with 400/404/429/503/504 status.
+- ``POST /v1/models/<name>:generate`` — the generative serving engine
+  (serving/generation.py; ``generators={name: GenerationEngine}``):
+  body ``{"prompt": [ids...], "max_new_tokens"?, "temperature"?,
+  "eos_id"?, "stream"?: true}``. Streaming responses are chunked
+  newline-delimited JSON (``{"token": id}`` per token, terminal
+  ``{"done": ...}`` or typed ``{"error": ...}`` line);
+  ``"stream": false`` collects server-side into one JSON body.
+  ``GET /debug/generation`` renders live engine state.
 - ``GET /models``   — registry contents (name, version, history, warmed).
 - ``GET /healthz``  — process liveness, always 200 while serving.
 - ``GET /readyz``   — 200 only after every registered model's warmup
@@ -92,6 +100,7 @@ import json
 import re
 import threading
 import time
+from queue import Empty as _queue_Empty
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence, Tuple
 from urllib.parse import parse_qs
@@ -141,6 +150,10 @@ from deeplearning4j_tpu.serving.errors import (
     TenantQuotaError,
     WorkerCrashedError,
 )
+from deeplearning4j_tpu.serving.generation import (
+    GenerationEngine,
+    token_brownout_rung,
+)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.overload import (
     PRIORITIES,
@@ -152,6 +165,7 @@ from deeplearning4j_tpu.serving.overload import (
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 
 _PREDICT_RE = re.compile(r"^/v1/models/([\w.\-]+):predict$")
+_GENERATE_RE = re.compile(r"^/v1/models/([\w.\-]+):generate$")
 
 _SHED_REASONS = {
     QueueFullError: "queue_full",
@@ -183,6 +197,7 @@ class ModelServer:
         max_profile_ms: float = 60000.0,
         circuit_policy: Optional[CircuitPolicy] = CircuitPolicy(),
         overload: Optional[OverloadPolicy] = None,
+        generators: Optional[dict] = None,
         sentinel: bool = True,
         sentinel_detectors: Optional[Sequence] = None,
         sentinel_interval_s: float = 10.0,
@@ -231,6 +246,16 @@ class ModelServer:
         self._draining = False
         self._started = False
         self._serve_thread: Optional[threading.Thread] = None
+        # Generative serving engines (serving/generation.py): continuous-
+        # batching decode schedulers keyed by route name, served at
+        # POST /v1/models/<name>:generate with streamed (chunked ndjson)
+        # or collected responses. Each engine rides this server's metrics
+        # bundle and — when overload management is on — its AIMD limit,
+        # tenant quotas, batch-class brownout shed, and a dedicated
+        # shrink-max_new_tokens brownout rung ahead of fallback hot-swap.
+        self.generators: dict = {}
+        for gname, engine in (generators or {}).items():
+            self.add_generator(gname, engine)
         # Diagnostics plane: the health engine evaluates this server's
         # serving bundle UNION the process default registry, so train /
         # resilience series in the same process count toward rules too.
@@ -275,6 +300,11 @@ class ModelServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 for chunked streaming responses (:generate); every
+            # non-streamed response carries Content-Length (see _send),
+            # which 1.1 keep-alive requires
+            protocol_version = "HTTP/1.1"
+
             # quiet: per-request stderr lines are useless under load tests
             def log_message(self, *a):  # noqa: N802 - stdlib API
                 pass
@@ -359,6 +389,10 @@ class ModelServer:
                             "(pass overload=OverloadPolicy())").to_json())
                     else:
                         self._send(200, server.overload.describe())
+                elif path == "/debug/generation":
+                    self._send(200, {"engines": {
+                        name: eng.describe()
+                        for name, eng in server.generators.items()}})
                 elif path == "/debug/incidents":
                     self._send(200, server.render_incidents())
                 elif path.startswith("/debug/incidents/"):
@@ -394,7 +428,8 @@ class ModelServer:
                     self._send(status, body)
                     return
                 m = _PREDICT_RE.match(path)
-                if not m:
+                g = _GENERATE_RE.match(path)
+                if not m and not g:
                     self._send(404, ServingError(
                         f"no route {self.path}").to_json())
                     return
@@ -410,12 +445,43 @@ class ModelServer:
                 # echo the id back so either side can find the span tree
                 cid = (self.headers.get("X-Correlation-ID")
                        or _trace.new_id())
+                if g is not None:
+                    self._do_generate(g.group(1), payload, cid)
+                    return
                 status, body = server.handle_predict(
                     m.group(1), payload, correlation_id=cid,
                     parent_span_id=self.headers.get("X-Span-ID"),
                     priority=self.headers.get("X-Priority"),
                     tenant=self.headers.get("X-Tenant"))
                 self._send(status, body, correlation_id=cid)
+
+            def _do_generate(self, name: str, payload, cid: str):
+                status, body, stream = server.handle_generate(
+                    name, payload, correlation_id=cid,
+                    priority=self.headers.get("X-Priority"),
+                    tenant=self.headers.get("X-Tenant"))
+                if stream is None:
+                    self._send(status, body, correlation_id=cid)
+                    return
+                # streaming: chunked newline-delimited JSON, one event
+                # per line — {"token": id}* then {"done": ...} or a
+                # terminal {"error": {...}} the client re-raises typed
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Correlation-ID", cid)
+                self.end_headers()
+                try:
+                    for ev in stream.wire_events():
+                        line = json.dumps(ev).encode() + b"\n"
+                        self.wfile.write(b"%X\r\n" % len(line)
+                                         + line + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    # client went away mid-stream: free the decode slot
+                    # instead of generating tokens nobody reads
+                    stream.cancel()
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
 
@@ -432,9 +498,13 @@ class ModelServer:
 
     def readiness(self) -> dict:
         models = {e["name"]: e["warmed"] for e in self.registry.describe()}
+        gens = {name: eng.warmed for name, eng in self.generators.items()}
         ready = (self._started and not self._draining
-                 and all(models.values()))
-        return {"ready": ready, "draining": self._draining, "models": models}
+                 and all(models.values()) and all(gens.values()))
+        body = {"ready": ready, "draining": self._draining, "models": models}
+        if gens:
+            body["generators"] = gens
+        return body
 
     @property
     def draining(self) -> bool:
@@ -670,6 +740,140 @@ class ModelServer:
                                              exemplar_trace_id=cid)
         return status, body
 
+    # -- generative serving ---------------------------------------------------
+
+    def add_generator(self, name: str, engine: "GenerationEngine"
+                      ) -> "GenerationEngine":
+        """Attach a continuous-batching generation engine under ``name``
+        (served at ``POST /v1/models/<name>:generate``). Wires the
+        serving metrics bundle, the overload manager (AIMD slot clamp,
+        tenant quotas, batch-class brownout shed), and — first generator
+        only — slots the shrink-``max_new_tokens`` brownout rung into
+        the default ladder ahead of the fallback hot-swap."""
+        if name in self.generators:
+            raise ValueError(f"generator '{name}' already registered")
+        engine.name = name
+        engine.attach_metrics(self.metrics)
+        self.generators[name] = engine
+        if self.overload is not None:
+            engine.attach_overload(self.overload)
+            self._ensure_generation_rung()
+        if self._started:
+            # live registration follows the deploy discipline: warm
+            # first (readyz gates on every generator's warmed flag, and
+            # traffic must never pay the bucket compiles), then start
+            if not engine.warmed:
+                engine.warm()
+            if not engine.running:
+                engine.start()
+        return engine
+
+    def _ensure_generation_rung(self):
+        """Insert the generation token-brownout rung ahead of
+        ``serve_fallback`` — once. ``BrownoutLadder.insert_rung`` is
+        safe mid-walk; it refuses only while the fallback rung itself
+        is engaged, in which case a transition listener retries as soon
+        as the ladder moves."""
+        ladder = getattr(self.overload, "ladder", None)
+        if ladder is None:
+            return
+        rung = token_brownout_rung(lambda: list(self.generators.values()))
+        if ladder.insert_rung(rung, before="serve_fallback"):
+            return
+        if getattr(self, "_gen_rung_retry_armed", False):
+            return
+        self._gen_rung_retry_armed = True
+        done = []
+
+        def retry(*_a):
+            # one-shot: after the insert lands, every later transition
+            # is a flag check, not a rung rebuild + locked name scan
+            if not done and ladder.insert_rung(rung,
+                                               before="serve_fallback"):
+                done.append(True)
+
+        ladder.add_transition_listener(retry)
+
+    def handle_generate(self, name: str, payload, *,
+                        correlation_id: Optional[str] = None,
+                        priority=None, tenant=None):
+        """Validate + submit one generation request.
+
+        Returns ``(status, body, stream)``: ``stream`` is the live
+        :class:`GenerationStream` for streaming requests (the handler
+        chunks its events), None when the response is complete —
+        an error envelope, or the collected non-streaming body
+        (``{"stream": false}``)."""
+        cid = correlation_id if correlation_id else _trace.new_id()
+        handle = None
+        try:
+            prio = self._validate_priority(priority)
+            tenant = self._validate_tenant(tenant)
+            engine = self.generators.get(name)
+            if engine is None:
+                raise ModelNotFoundError(f"no generator named '{name}'")
+            if self._draining or not self._started:
+                raise NotReadyError("server is draining" if self._draining
+                                    else "server not started")
+            if not isinstance(payload, dict) or "prompt" not in payload:
+                raise BadRequestError('body must be {"prompt": [ids...]}')
+            mnt = payload.get("max_new_tokens")
+            if mnt is not None and (isinstance(mnt, bool)
+                                    or not isinstance(mnt, int)):
+                raise BadRequestError("max_new_tokens must be an integer")
+            temp = payload.get("temperature")
+            if temp is not None and (isinstance(temp, bool)
+                                     or not isinstance(temp, (int, float))):
+                raise BadRequestError("temperature must be a number")
+            eos = payload.get("eos_id")
+            if eos is not None and (isinstance(eos, bool)
+                                    or not isinstance(eos, int)):
+                raise BadRequestError("eos_id must be an integer")
+            stream_mode = payload.get("stream", True)
+            # every validation — deadline included — happens BEFORE
+            # submit: a 400 must never leave an orphaned stream decoding
+            # tokens nobody will read. The deadline semantics match
+            # predict: default_deadline_ms when absent, clamped at
+            # max_deadline_ms — and they bound STREAMING responses too
+            # (the stream ends with a terminal DEADLINE_EXCEEDED line)
+            timeout = self.admission.timeout_s(payload.get("deadline_ms"))
+            record_event("generation.request", model=name, priority=prio,
+                         correlation_id=cid, stream=bool(stream_mode))
+            handle = engine.submit(
+                payload["prompt"], max_new_tokens=mnt, temperature=temp,
+                eos_id=eos, priority=prio, tenant=tenant)
+            if stream_mode:
+                handle._wire_timeout = timeout
+                return 200, None, handle
+            try:
+                # total-budget deadline: result() converts it to an
+                # absolute deadline, so a slow engine can't stretch it
+                # one token at a time
+                res = handle.result(timeout=timeout)
+            except _queue_Empty:
+                # outcome "deadline", not "cancelled": a server-side
+                # 504 must burn the generation-availability rule
+                handle._expire()
+                raise DeadlineExceededError(
+                    "generation did not finish before the deadline"
+                    ) from None
+            return 200, {"model": name, "version": engine.version,
+                         "tokens": res["tokens"],
+                         "n_tokens": len(res["tokens"]),
+                         "finish_reason": res["finish_reason"]}, None
+        except ServingError as e:
+            if handle is not None:
+                handle.cancel()  # idempotent; no-op on a finished stream
+            return e.http_status, e.to_json(), None
+        except Exception as e:  # noqa: BLE001 — surface, never crash
+            if handle is not None:
+                handle.cancel()
+            record_event("generation.error", model=name,
+                         error=str(e)[:200])
+            return 500, {"error": {"code": "INTERNAL",
+                                   "message": str(e)[:300],
+                                   "retryable": False}}, None
+
     # -- brownout ladder (default rungs) --------------------------------------
 
     def _default_brownout_rungs(self):
@@ -872,9 +1076,19 @@ class ModelServer:
     # -- lifecycle ------------------------------------------------------------
 
     def warm_all(self) -> dict:
-        """Warm every not-yet-warmed entry; {name: {rows: seconds}}."""
-        return {e.name: e.warm()
-                for e in self.registry.entries() if not e.warmed}
+        """Warm every not-yet-warmed entry (and generation engine);
+        {name: {rows: seconds}}. A freshly-warmed engine on an
+        already-started server is started here — engines are never
+        warmed while their scheduler runs (warm and the scheduler
+        would race over the donated KV slabs)."""
+        out = {e.name: e.warm()
+               for e in self.registry.entries() if not e.warmed}
+        for name, eng in self.generators.items():
+            if not eng.warmed:
+                out[name] = eng.warm()
+                if self._started and not eng.running:
+                    eng.start()
+        return out
 
     def start(self, *, warm: bool = True) -> "ModelServer":
         if self._started:
@@ -886,6 +1100,12 @@ class ModelServer:
             name="model-server")
         self._serve_thread.start()
         self._started = True
+        # only warmed engines get their scheduler: an unwarmed engine's
+        # later warm_all() must never race a live scheduler over the
+        # donated slabs (requests submitted meanwhile wait in its queue)
+        for eng in self.generators.values():
+            if eng.warmed:
+                eng.start()
         self.slo_engine.start()
         if self.overload is not None:
             self.overload.start()
@@ -918,7 +1138,14 @@ class ModelServer:
             self._draining = True
             record_event("serving.drain", port=self.port)
             if drain:
+                # ONE timeout budget across the admission drain and
+                # every engine drain — stop(timeout=30) must not block
+                # (1 + n_engines) x 30 s
+                deadline = time.monotonic() + timeout
                 drained = self.admission.drain(timeout)
+                for eng in self.generators.values():
+                    drained = eng.drain(
+                        max(0.0, deadline - time.monotonic())) and drained
             self._httpd.shutdown()
             if self._serve_thread is not None:
                 self._serve_thread.join(timeout=10)
@@ -937,6 +1164,8 @@ class ModelServer:
         if _slo.get_default_engine() is self.slo_engine:
             _slo.set_default_engine(None)
         self._httpd.server_close()
+        for eng in self.generators.values():
+            eng.stop()
         self.registry.shutdown_all()
         return drained
 

@@ -29,15 +29,20 @@ def spec(shape: Sequence[int], dtype=np.float32) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
 
 
-def bucket_sizes(max_batch: int, mode: str = "batched") -> List[int]:
+def bucket_sizes(max_batch: int, mode: str = "batched", *,
+                 lo: int = 1) -> List[int]:
     """Row counts whose buckets cover everything batched traffic can hit:
-    powers of two below ``max_batch``, plus ``max_batch`` itself (the cap
-    bucket, which may not be a power of two). Instant mode does no
-    padding, so only batch=1 is predictably warmable."""
+    powers of two from ``lo`` below ``max_batch``, plus ``max_batch``
+    itself (the cap bucket, which may not be a power of two). Instant
+    mode does no padding, so only batch=1 is predictably warmable.
+    ``lo`` is the smallest bucket — the generation engine's KV/prompt
+    buckets floor it so tiny prompts share one program."""
     if mode == "instant":
         return [1]
+    if lo >= max_batch:
+        return [max_batch]
     sizes = []
-    b = 1
+    b = lo
     while b < max_batch:
         sizes.append(b)
         b *= 2

@@ -526,9 +526,9 @@ class TestDetectorStateMachine:
         names = {d.name for d in sn.default_detectors()}
         assert names == {
             "train_step_time_regression", "serving_p99_regression",
-            "recompile_storm", "serving_queue_buildup",
-            "train_data_starvation", "live_array_bytes_leak",
-            "hbm_bytes_leak"}
+            "generation_ttft_regression", "recompile_storm",
+            "serving_queue_buildup", "train_data_starvation",
+            "live_array_bytes_leak", "hbm_bytes_leak"}
         # every probed family is in the validation vocabulary
         known = slo.known_metric_names()
         for d in sn.default_detectors():
