@@ -92,6 +92,17 @@ from deeplearning4j_tpu.observability.metrics import (
     set_enabled,
     wants_openmetrics,
 )
+from deeplearning4j_tpu.observability.reqlog import (
+    ReqLogMetrics,
+    RequestLedger,
+    get_reqlog_metrics,
+    get_request_ledger,
+    ledger_enabled,
+    request_detail,
+    request_index,
+    set_ledger_enabled,
+    set_request_ledger,
+)
 from deeplearning4j_tpu.observability.runtime import (
     RuntimeCollector,
     get_runtime_collector,
@@ -119,14 +130,18 @@ from deeplearning4j_tpu.observability.slo import (
     validate_rules_doc,
 )
 from deeplearning4j_tpu.observability.trace import (
+    RetentionPolicy,
     Span,
+    TailSampler,
     Tracer,
     current_span,
     from_chrome_trace,
+    get_tail_sampler,
     get_tracer,
     load_jsonl,
     new_id,
     record_span,
+    set_tail_sampler,
     set_tracing_enabled,
     span,
     to_chrome_trace,
@@ -156,7 +171,10 @@ __all__ = [
     "HostStackSampler",
     "IncidentManager",
     "MetricsRegistry",
+    "ReqLogMetrics",
+    "RequestLedger",
     "ResilienceMetrics",
+    "RetentionPolicy",
     "RuntimeCollector",
     "SLOMetrics",
     "SLORule",
@@ -164,6 +182,7 @@ __all__ = [
     "Sentinel",
     "SentinelMetrics",
     "Span",
+    "TailSampler",
     "TelemetryExporter",
     "Tracer",
     "TrainingMetrics",
@@ -187,17 +206,23 @@ __all__ = [
     "get_flight_recorder",
     "get_host_sampler",
     "get_incident_manager",
+    "get_reqlog_metrics",
+    "get_request_ledger",
     "get_resilience_metrics",
     "get_runtime_collector",
     "get_sentinel_metrics",
+    "get_tail_sampler",
     "get_slo_metrics",
     "get_tracer",
     "get_training_metrics",
     "incident_index",
+    "ledger_enabled",
     "load_jsonl",
     "load_rules",
     "new_id",
     "record_event",
+    "request_detail",
+    "request_index",
     "record_span",
     "record_transfer",
     "recording_enabled",
@@ -211,7 +236,10 @@ __all__ = [
     "set_flight_recorder",
     "set_host_sampler",
     "set_incident_manager",
+    "set_ledger_enabled",
     "set_recording",
+    "set_request_ledger",
+    "set_tail_sampler",
     "set_tracing_enabled",
     "unregister_profile_hook",
     "span",

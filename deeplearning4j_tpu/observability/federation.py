@@ -170,8 +170,8 @@ class _JsonHandler(BaseHTTPRequestHandler):
 def build_snapshot(*, extra_registries: Sequence = (),
                    flight_window_s: Optional[float] = None) -> dict:
     """The one-document export the aggregator consumes: identity +
-    metrics JSON + flight dump + span dump + incident index,
-    self-describing."""
+    metrics JSON + flight dump + span dump + incident index + the
+    request ledger's recent window, self-describing."""
     ident = worker_identity()
     regs = [default_registry()] + list(extra_registries)
     return {
@@ -184,6 +184,7 @@ def build_snapshot(*, extra_registries: Sequence = (),
         "flight": get_flight_recorder().dump(last_seconds=flight_window_s),
         "spans": [s.to_json() for s in _trace.get_tracer().spans()],
         "incidents": _incident_index(),
+        "requests": _request_index(),
     }
 
 
@@ -196,6 +197,19 @@ def _incident_index() -> List[dict]:
         )
 
         return incident_index()
+    except Exception:  # noqa: BLE001 — telemetry never fails the worker
+        return []
+
+
+def _request_index() -> List[dict]:
+    """This worker's recent request-ledger records (reqlog.py), or []
+    — never creates a ledger as a side effect, never raises. The spans
+    a retained request kept ride the snapshot's ``spans`` list, so the
+    cluster view reconstructs the tree from the same document."""
+    try:
+        from deeplearning4j_tpu.observability.reqlog import request_index
+
+        return request_index()
     except Exception:  # noqa: BLE001 — telemetry never fails the worker
         return []
 
@@ -392,6 +406,19 @@ class TelemetryExporter:
                                                    for s in spans]})
                 elif path == "/incidents":
                     self._send(200, {"incidents": _incident_index()})
+                elif path == "/requests":
+                    self._send(200, {"requests": _request_index()})
+                elif path.startswith("/requests/"):
+                    from deeplearning4j_tpu.observability.reqlog import (
+                        request_detail,
+                    )
+
+                    cid = path[len("/requests/"):]
+                    body = request_detail(cid)
+                    if body is None:
+                        self._send(404, {"error": f"no request {cid!r}"})
+                    else:
+                        self._send(200, body)
                 else:
                     self._send(404, {"error": f"no route {path}"})
 
@@ -679,6 +706,10 @@ def _sanitize_snapshot(snap: dict) -> dict:
     snap["incidents"] = (
         [d for d in incidents if isinstance(d, dict) and d.get("id")]
         if isinstance(incidents, list) else [])
+    requests = snap.get("requests")
+    snap["requests"] = (
+        [d for d in requests if isinstance(d, dict) and d.get("cid")]
+        if isinstance(requests, list) else [])
     return snap
 
 
@@ -1041,6 +1072,7 @@ class ClusterAggregator:
                         3),
                     "flight_events": snap.get("flight", {}).get("count", 0),
                     "spans": len(snap.get("spans", [])),
+                    "requests": len(snap.get("requests", [])),
                 })
             rows.append(row)
         return {"num_workers": self.num_workers,
@@ -1122,6 +1154,83 @@ class ClusterAggregator:
         return {"workers": sorted(snaps), "count": len(rows),
                 "open": sum(1 for r in rows if r.get("state") == "open"),
                 "incidents": rows}
+
+    def cluster_requests(self, *, outcome: Optional[str] = None,
+                         tenant: Optional[str] = None,
+                         model: Optional[str] = None,
+                         min_latency_s: Optional[float] = None,
+                         limit: int = 100) -> dict:
+        """Every worker's recent request-ledger records, worker/
+        generation-stamped and merged newest-first — the cohort request
+        view (``GET /cluster/debug/requests``). Built from last-known
+        snapshots, so a dead worker's requests stay answerable."""
+        with self._lock:
+            snaps = dict(self._snapshots)
+        rows: List[dict] = []
+        for wid, snap in sorted(snaps.items()):
+            for rec in snap.get("requests", []):
+                if outcome is not None and rec.get("outcome") != outcome:
+                    continue
+                if tenant is not None and rec.get("tenant") != tenant:
+                    continue
+                if model is not None and rec.get("model") != model:
+                    continue
+                if min_latency_s is not None and \
+                        (rec.get("latency_s") or 0.0) < min_latency_s:
+                    continue
+                rows.append(dict(rec, worker=wid,
+                                 generation=snap.get("generation", 1)))
+
+        def _started(r):
+            try:
+                return float(r.get("t_start") or 0.0)
+            except (TypeError, ValueError):
+                return 0.0
+
+        rows.sort(key=_started, reverse=True)
+        rows = rows[:max(1, int(limit))]
+        return {"workers": sorted(snaps), "count": len(rows),
+                "requests": rows}
+
+    def cluster_request(self, cid: str) -> Optional[dict]:
+        """Find one request by correlation id on whichever worker
+        served it: the ledger record from that worker's snapshot plus
+        its retained span tree reconstructed from the same snapshot's
+        span dump (``GET /cluster/debug/requests/<id>``). The newest
+        record wins when a retried request touched several workers."""
+        with self._lock:
+            snaps = dict(self._snapshots)
+        best = None  # (t_start, worker, record, snapshot)
+        for wid, snap in sorted(snaps.items()):
+            for rec in snap.get("requests", []):
+                if rec.get("cid") != cid:
+                    continue
+                try:
+                    t = float(rec.get("t_start") or 0.0)
+                except (TypeError, ValueError):
+                    t = 0.0
+                if best is None or t >= best[0]:
+                    best = (t, wid, rec, snap)
+        if best is None:
+            return None
+        _, wid, rec, snap = best
+        spans = [d for d in snap.get("spans", [])
+                 if d.get("trace_id") == cid]
+        return {
+            "worker": wid,
+            "generation": snap.get("generation", 1),
+            "record": dict(rec, worker=wid),
+            "trace": {
+                "retained": bool(spans),
+                "reason": rec.get("trace_retained"),
+                "span_count": len(spans),
+                "spans": spans,
+                "chrome": (_trace.to_chrome_trace(
+                    [_trace.Span.from_json(d) for d in spans],
+                    pid=wid + 1, process_name=f"worker-{wid}")
+                    if spans else None),
+            },
+        }
 
     def dossier(self) -> dict:
         """The cohort post-mortem bundle: worker table + merged
@@ -1247,6 +1356,10 @@ class ClusterTelemetryServer:
     - ``/cluster/debug/trace`` — the stitched Perfetto document;
     - ``/cluster/debug/incidents`` — every worker's incident-bundle
       index merged (worker/generation-stamped, newest first);
+    - ``/cluster/debug/requests`` — every worker's recent request-ledger
+      records merged (``?outcome=&tenant=&model=&min_latency_ms=``);
+      ``/cluster/debug/requests/<correlation-id>`` finds one request on
+      whichever worker served it, retained span tree included;
     - ``/cluster/debug/health`` — the federated SLO engine's states
       (404 when no engine is attached);
     - ``/healthz``.
@@ -1297,6 +1410,30 @@ class ClusterTelemetryServer:
                     self._send(200, agg.cluster_chrome_trace())
                 elif path == "/cluster/debug/incidents":
                     self._send(200, agg.cluster_incidents())
+                elif path == "/cluster/debug/requests":
+                    q = parse_qs(query)
+                    try:
+                        min_latency_s = (
+                            float(q["min_latency_ms"][0]) / 1000.0
+                            if "min_latency_ms" in q else None)
+                        limit = int(q.get("limit", ["100"])[0])
+                    except ValueError:
+                        self._send(400, {"error": "min_latency_ms and "
+                                                  "limit must be numbers"})
+                        return
+                    self._send(200, agg.cluster_requests(
+                        outcome=q.get("outcome", [None])[0],
+                        tenant=q.get("tenant", [None])[0],
+                        model=q.get("model", [None])[0],
+                        min_latency_s=min_latency_s, limit=limit))
+                elif path.startswith("/cluster/debug/requests/"):
+                    cid = path[len("/cluster/debug/requests/"):]
+                    body = agg.cluster_request(cid)
+                    if body is None:
+                        self._send(404, {"error": f"no request {cid!r} "
+                                                  "on any worker"})
+                    else:
+                        self._send(200, body)
                 elif path == "/cluster/debug/health":
                     if server.engine is None:
                         self._send(404, {"error": "no cluster health "

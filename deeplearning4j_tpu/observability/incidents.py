@@ -15,6 +15,10 @@ time, automatically, into one bounded on-disk bundle:
 - ``metrics.prom`` / ``metrics.json`` — full registry scrape at firing;
 - ``flightrecorder.json`` — the black-box event ring (bounded window);
 - ``spans.json``          — the most recent finished spans;
+- ``requests.json``       — the request ledger's worst requests of the
+  anomaly window (bad outcomes first, then by latency), each with its
+  tail-retained span tree — "which requests were suffering, and where
+  did their time go" inside the bundle itself;
 - ``flames.txt`` (+ meta in the manifest) — the host stack sampler's
   collapsed flame data (dense over the anomaly: the sentinel armed the
   high-rate window at *suspect*);
@@ -70,6 +74,18 @@ INCIDENT_ID_RE = re.compile(r"^inc-[0-9]{13}-[0-9]{3}-[A-Za-z0-9_.\-]+$")
 _ARTIFACT_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.\-]*$")
 
 ENV_INCIDENT_DIR = "DL4J_TPU_INCIDENT_DIR"
+
+
+def _worst_requests(window_s: float) -> dict:
+    """The request ledger's worst requests of the trailing window with
+    their retained span trees (reqlog.postmortem) — lazy import, never
+    raises, degrades to an empty document when no ledger exists."""
+    try:
+        from deeplearning4j_tpu.observability.reqlog import postmortem
+
+        return postmortem(window_s)
+    except Exception:  # noqa: BLE001 — one artifact, never the bundle
+        return {"window_seconds": window_s, "count": 0, "requests": []}
 
 
 def _sentinel_metrics():
@@ -179,6 +195,7 @@ class IncidentManager:
         spans = [s.to_json()
                  for s in _trace.get_tracer().spans()[-self.span_limit:]]
         flames = sampler.dump() if sampler is not None else None
+        requests_doc = _worst_requests(self.flight_window_s)
         hooks = profile_hooks() if profile else {}
 
         staging = self.dir / f".staging-{iid}"
@@ -206,6 +223,8 @@ class IncidentManager:
             (staging / "spans.json").write_text(
                 json.dumps({"count": len(spans), "spans": spans},
                            default=str))
+            (staging / "requests.json").write_text(
+                json.dumps(requests_doc, default=str))
             (staging / "flames.txt").write_text(
                 (flames or {}).get("collapsed", ""))
             manifest = {
@@ -224,7 +243,8 @@ class IncidentManager:
                             if flames is not None else None),
                 "artifacts": ["verdict.json", "metrics.prom",
                               "metrics.json", "flightrecorder.json",
-                              "spans.json", "flames.txt"],
+                              "spans.json", "requests.json",
+                              "flames.txt"],
             }
             self._write_manifest(staging, manifest)
             final = self.dir / iid

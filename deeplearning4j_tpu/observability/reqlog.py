@@ -1,0 +1,446 @@
+"""Request ledger: one always-on lifecycle record per served request.
+
+Metrics aggregate, spans sample, the flight ring evicts — none of them
+answers "what happened to request X?" hours later. This module does: a
+bounded in-memory ring of compact per-request lifecycle records, cheap
+enough (~a dict build + deque append under one lock) to record for
+EVERY request the admission plane sees, indexed by correlation id so
+``GET /debug/requests/<correlation-id>`` resolves in O(1).
+
+One record carries the whole story of one request:
+
+- identity: correlation id, plane (``predict`` | ``generation``),
+  model/version, priority class, tenant;
+- admission: ``admitted`` or ``shed:<reason>`` — sheds get records too,
+  so "why did my request 429?" is answerable after the fact;
+- timings: start/end (wall-anchored), end-to-end latency, queue wait,
+  TTFT, prefill seconds, decode-step count + decode-seconds rollup;
+- placement: decode slot / batch rows + bucket (stamped post-hoc by the
+  ParallelInference worker for predict, by the scheduler for
+  generation);
+- outcome: ``ok`` / ``error`` / ``shed`` / ``preempted`` / ``deadline``
+  / ``cancelled`` / ``rejected``, HTTP status, finish reason, deadline
+  slack (negative = the deadline was missed);
+- ``trace_retained``: the tail sampler's retention reason when this
+  request's span tree was kept in the tracer ring (None = ledger record
+  only — the common case for fast, healthy traffic).
+
+The ledger drives **tail-based trace sampling** (trace.py
+:class:`~deeplearning4j_tpu.observability.trace.TailSampler`):
+``begin()`` stages the request's spans, ``finish()`` feeds the
+retention policy the outcome + latency and stamps the decision on the
+record. Everything is scrapeable: ``reqlog_records_total{plane,
+outcome}``, ``reqlog_evictions_total``, ``reqlog_open_requests``, and
+``trace_retained_total{reason}`` / ``trace_retained_spans_total`` /
+``reqlog_trace_dropped_total`` from the sampler's decisions.
+
+Federation: the per-worker telemetry snapshot embeds a bounded recent
+window of records (``recent()``), so the supervisor-side
+``GET /cluster/debug/requests/<id>`` finds a request on whichever
+worker served it; the sentinel's incident bundles embed
+:func:`postmortem` — the worst requests of the anomaly window with
+their retained span trees.
+
+``set_ledger_enabled(False)`` is the kill switch ``bench.py reqtrace``
+prices the plane with (begin/annotate/finish become no-ops).
+
+Stdlib only; safe to import from any layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability import trace as _trace
+
+# outcomes rendered in HELP text / validated nowhere on purpose: the
+# ledger records what the serving layer says happened; the bounded
+# vocabulary below is what the built-in planes emit
+OUTCOMES = ("ok", "error", "failed", "shed", "preempted", "deadline",
+            "cancelled", "rejected")
+
+ENV_REQLOG_CAPACITY = "DL4J_TPU_REQLOG_CAPACITY"
+
+
+class ReqLogMetrics:
+    """The ledger + tail-retention exposition families (on the process
+    default registry, like the sentinel's)."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        r = registry if registry is not None else _metrics.default_registry()
+        self.registry = r
+        self.records_total = r.counter(
+            "reqlog_records_total",
+            "Request-ledger lifecycle records finished, by serving plane "
+            "and outcome (ok | error | failed | shed | preempted | "
+            "deadline | cancelled | rejected).", ("plane", "outcome"))
+        self.evictions_total = r.counter(
+            "reqlog_evictions_total",
+            "Ledger records evicted from the bounded ring (oldest "
+            "first); their staged spans, if any, are dropped with "
+            "them.")
+        self.open_requests = r.gauge(
+            "reqlog_open_requests",
+            "Ledger records currently open (begun, not yet finished).")
+        self.trace_retained_total = r.counter(
+            "trace_retained_total",
+            "Requests whose staged span tree the tail sampler KEPT in "
+            "the tracer ring, by retention reason (outcome name | slow "
+            "| sampled).", ("reason",))
+        self.trace_retained_spans_total = r.counter(
+            "trace_retained_spans_total",
+            "Spans promoted from tail-sampling staging into the tracer "
+            "ring across all retained requests.")
+        self.trace_dropped_total = r.counter(
+            "reqlog_trace_dropped_total",
+            "Requests whose staged spans were dropped at completion "
+            "(fast, healthy, and not the deterministic 1-in-N sample).")
+
+
+_reqlog_metrics: Optional[ReqLogMetrics] = None
+_rm_lock = threading.Lock()
+
+
+def get_reqlog_metrics() -> ReqLogMetrics:
+    global _reqlog_metrics
+    if _reqlog_metrics is None:
+        with _rm_lock:
+            if _reqlog_metrics is None:
+                _reqlog_metrics = ReqLogMetrics()
+    return _reqlog_metrics
+
+
+def _drop_reqlog_metrics():
+    global _reqlog_metrics
+    _reqlog_metrics = None
+
+
+_metrics.register_reset_hook(_drop_reqlog_metrics)
+
+
+class RequestLedger:
+    """Bounded ring of per-request lifecycle records, indexed by
+    correlation id (the newest record wins the index — a retry reusing
+    its id is a new server-side pass; the older pass stays in the ring
+    until evicted)."""
+
+    def __init__(self, capacity: int = 2048, *,
+                 sampler: Optional[_trace.TailSampler] = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.sampler = sampler
+        self._lock = threading.Lock()
+        self._ring: deque = deque()
+        self._index: Dict[str, dict] = {}
+        self._open = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def begin(self, cid: str, *, plane: str, model: str,
+              priority: Optional[str] = None, tenant: Optional[str] = None,
+              **fields) -> Optional[dict]:
+        """Open one record (and stage the request's spans for tail
+        sampling); returns the live record, or None when the ledger is
+        disabled. Extra ``fields`` merge into the record.
+
+        A ``begin`` for a cid whose record is still OPEN merges into it
+        instead of opening a second one — the HTTP layer begins the
+        record before its root span opens and the scheduler's submit
+        enriches the same record moments later. A cid whose previous
+        record already finished gets a fresh record (a client retry is
+        a new server-side pass; the index points at the newest)."""
+        if not _ENABLED:
+            return None
+        with self._lock:
+            prev = self._index.get(cid)
+            if prev is not None and prev.get("state") == "open":
+                for k, v in dict(priority=priority, tenant=tenant,
+                                 **fields).items():
+                    if v is not None:
+                        prev[k] = v
+                rec, evicted, open_now = prev, None, self._open
+            else:
+                rec = {"cid": cid, "plane": plane, "model": model,
+                       "priority": priority, "tenant": tenant,
+                       "state": "open", "t_start": _trace.now(),
+                       "t_end": None, "latency_s": None, "outcome": None,
+                       "status": None, "admission": None,
+                       "trace_retained": None}
+                rec.update(fields)
+                evicted = None
+                if len(self._ring) >= self.capacity:
+                    evicted = self._ring.popleft()
+                    if self._index.get(evicted["cid"]) is evicted:
+                        del self._index[evicted["cid"]]
+                    if evicted.get("state") == "open":
+                        self._open -= 1
+                self._ring.append(rec)
+                self._index[cid] = rec
+                self._open += 1
+                open_now = self._open
+        m = _reqlog_metrics_or_none()
+        if m is not None:
+            if evicted is not None:
+                m.evictions_total.inc()
+            m.open_requests.set(open_now)
+        if self.sampler is not None:
+            if evicted is not None and evicted.get("state") == "open":
+                # its spans can never be decided through finish() now
+                self.sampler.discard(evicted["cid"])
+            self.sampler.begin(cid)
+        return rec
+
+    def annotate(self, cid: str, **fields) -> None:
+        """Merge fields into an open record (no-op for unknown ids and
+        finished records — a late annotation must not mutate a record
+        whose outcome is already sealed; telemetry never fails the
+        serving path)."""
+        if not _ENABLED:
+            return
+        with self._lock:
+            rec = self._index.get(cid)
+            if rec is not None and rec.get("state") == "open":
+                rec.update(fields)
+
+    def finish(self, cid: str, *, outcome: str,
+               status: Optional[int] = None, **fields) -> Optional[dict]:
+        """Close one record: stamp outcome/latency/deadline-slack, run
+        the tail sampler's retention decision, count the metrics.
+        Returns the record (None for unknown ids / disabled ledger)."""
+        if not _ENABLED:
+            return None
+        t_end = _trace.now()
+        with self._lock:
+            rec = self._index.get(cid)
+            if rec is None or rec.get("state") != "open":
+                return None
+            rec.update(fields)
+            rec["state"] = "done"
+            rec["outcome"] = outcome
+            if status is not None:
+                rec["status"] = status
+            rec["t_end"] = t_end
+            latency = max(0.0, t_end - rec["t_start"])
+            rec["latency_s"] = round(latency, 6)
+            deadline_s = rec.get("deadline_s")
+            if deadline_s is not None:
+                rec["deadline_slack_s"] = round(float(deadline_s) - latency,
+                                                6)
+            self._open -= 1
+            open_now = self._open
+        reason, n_spans = (None, 0)
+        if self.sampler is not None:
+            reason, n_spans = self.sampler.finish(
+                cid, outcome=outcome, latency_s=latency)
+            with self._lock:
+                rec["trace_retained"] = reason
+        m = _reqlog_metrics_or_none()
+        if m is not None:
+            m.records_total.inc(plane=rec.get("plane", "?"), outcome=outcome)
+            m.open_requests.set(open_now)
+            if self.sampler is not None:
+                if reason is not None:
+                    m.trace_retained_total.inc(reason=reason)
+                    m.trace_retained_spans_total.inc(n_spans)
+                else:
+                    m.trace_dropped_total.inc()
+        return rec
+
+    def record(self, cid: str, *, plane: str, model: str, outcome: str,
+               status: Optional[int] = None, **fields) -> Optional[dict]:
+        """One-shot begin+finish for requests that never opened a
+        stream/slot (pre-submit sheds and validation rejects) — the
+        admission outcome is still answerable by correlation id."""
+        if self.begin(cid, plane=plane, model=model, **fields) is None:
+            return None
+        return self.finish(cid, outcome=outcome, status=status)
+
+    # -- read surface --------------------------------------------------------
+
+    def get(self, cid: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._index.get(cid)
+            return dict(rec) if rec is not None else None
+
+    def query(self, *, outcome: Optional[str] = None,
+              tenant: Optional[str] = None, model: Optional[str] = None,
+              plane: Optional[str] = None,
+              min_latency_s: Optional[float] = None,
+              limit: int = 100) -> List[dict]:
+        """Newest-first filtered records (the ``/debug/requests``
+        list). Open records match latency filters by their age so an
+        in-flight straggler is findable while it hangs."""
+        with self._lock:
+            snap = list(self._ring)
+        out: List[dict] = []
+        now = _trace.now()
+        for rec in reversed(snap):
+            if outcome is not None and rec.get("outcome") != outcome:
+                continue
+            if tenant is not None and rec.get("tenant") != tenant:
+                continue
+            if model is not None and rec.get("model") != model:
+                continue
+            if plane is not None and rec.get("plane") != plane:
+                continue
+            if min_latency_s is not None:
+                lat = rec.get("latency_s")
+                if lat is None:
+                    lat = max(0.0, now - rec.get("t_start", now))
+                if lat < min_latency_s:
+                    continue
+            out.append(dict(rec))
+            if len(out) >= max(1, int(limit)):
+                break
+        return out
+
+    def recent(self, limit: int = 256) -> List[dict]:
+        """Newest-first window for the federation snapshot."""
+        with self._lock:
+            snap = list(self._ring)[-max(1, int(limit)):]
+        return [dict(r) for r in reversed(snap)]
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"capacity": self.capacity, "records": len(self._ring),
+                    "open": self._open,
+                    "staged": (self.sampler.staged_count()
+                               if self.sampler is not None else 0)}
+
+
+# -- process-global ledger ----------------------------------------------------
+
+_LEDGER: Optional[RequestLedger] = None
+_ledger_lock = threading.Lock()
+_ENABLED = True
+
+
+def set_ledger_enabled(flag: bool) -> None:
+    """Kill switch for the always-on ledger + tail-staging plane (the
+    ``bench.py reqtrace`` gate prices it against this)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def ledger_enabled() -> bool:
+    return _ENABLED
+
+
+def get_request_ledger(create: bool = False) -> Optional[RequestLedger]:
+    """The process request ledger; ``create=True`` makes one when none
+    exists (capacity from ``DL4J_TPU_REQLOG_CAPACITY``, default 2048)
+    and installs the process tail sampler so staged spans route."""
+    global _LEDGER
+    with _ledger_lock:
+        if _LEDGER is None and create:
+            import os
+
+            try:
+                cap = int(os.environ.get(ENV_REQLOG_CAPACITY) or 2048)
+            except ValueError:
+                cap = 2048
+            _LEDGER = RequestLedger(
+                cap, sampler=_trace.get_tail_sampler(create=True))
+        return _LEDGER
+
+
+def set_request_ledger(ledger: Optional[RequestLedger]) -> None:
+    global _LEDGER
+    with _ledger_lock:
+        _LEDGER = ledger
+
+
+def _reqlog_metrics_or_none() -> Optional[ReqLogMetrics]:
+    try:
+        if not _metrics.enabled():
+            return None
+        return get_reqlog_metrics()
+    except Exception:  # noqa: BLE001 — metrics never fail the ledger
+        return None
+
+
+def request_index(limit: int = 256) -> List[dict]:
+    """This process's recent ledger records, or [] — what the federation
+    snapshot embeds (never creates a ledger as a side effect, never
+    raises)."""
+    ledger = get_request_ledger()
+    if ledger is None:
+        return []
+    try:
+        return ledger.recent(limit)
+    except Exception:  # noqa: BLE001 — telemetry never fails the caller
+        return []
+
+
+def request_detail(cid: str) -> Optional[dict]:
+    """One request's ledger record + retained span tree (Chrome-format
+    included) — the ``/debug/requests/<id>`` body. None when the id is
+    unknown to both the ledger and the tracer ring."""
+    ledger = get_request_ledger()
+    rec = ledger.get(cid) if ledger is not None else None
+    spans = _trace.get_tracer().spans(trace_id=cid)
+    if rec is None and not spans:
+        return None
+    return {
+        "record": rec,
+        "trace": {
+            "retained": bool(spans),
+            "reason": rec.get("trace_retained") if rec is not None else None,
+            "span_count": len(spans),
+            "spans": [s.to_json() for s in spans],
+            "chrome": (_trace.to_chrome_trace(spans) if spans else None),
+        },
+    }
+
+
+def postmortem(window_s: float = 180.0, limit: int = 8) -> dict:
+    """The worst requests of the trailing window, retained span trees
+    attached — what the sentinel's incident bundles embed (bad outcomes
+    first, then by latency, newest-first tiebreak). Never raises."""
+    try:
+        ledger = get_request_ledger()
+        if ledger is None:
+            return {"window_seconds": window_s, "count": 0, "requests": []}
+        cutoff = _trace.now() - float(window_s)
+        with ledger._lock:
+            rows = [dict(r) for r in ledger._ring
+                    if (r.get("t_end") or r.get("t_start", 0.0)) >= cutoff]
+        bad = frozenset(("error", "failed", "shed", "preempted", "deadline"))
+        rows.sort(key=lambda r: (
+            r.get("outcome") in bad, r.get("latency_s") or 0.0,
+            r.get("t_start", 0.0)), reverse=True)
+        rows = rows[:max(1, int(limit))]
+        tracer = _trace.get_tracer()
+        out = []
+        for rec in rows:
+            spans = tracer.spans(trace_id=rec["cid"])
+            out.append({"record": rec,
+                        "spans": [s.to_json() for s in spans]})
+        return {"window_seconds": window_s, "count": len(out),
+                "requests": out}
+    except Exception:  # noqa: BLE001 — a bundle artifact, never a crash
+        return {"window_seconds": window_s, "count": 0, "requests": [],
+                "error": "postmortem failed"}
+
+
+__all__ = [
+    "OUTCOMES",
+    "ReqLogMetrics",
+    "RequestLedger",
+    "get_reqlog_metrics",
+    "get_request_ledger",
+    "ledger_enabled",
+    "postmortem",
+    "request_detail",
+    "request_index",
+    "set_ledger_enabled",
+    "set_request_ledger",
+]

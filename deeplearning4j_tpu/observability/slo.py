@@ -196,6 +196,7 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     _metrics.CheckpointMetrics(reg)
     SLOMetrics(reg)
     from deeplearning4j_tpu.observability.federation import ClusterMetrics
+    from deeplearning4j_tpu.observability.reqlog import ReqLogMetrics
     from deeplearning4j_tpu.observability.sentinel import SentinelMetrics
     from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
@@ -206,6 +207,8 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     # the anomaly sentinel + incident pipeline families (sentinel.py):
     # the anomaly-firing burn-rate rule reads these
     SentinelMetrics(reg)
+    # the request-ledger + tail-trace-retention families (reqlog.py)
+    ReqLogMetrics(reg)
     names.update(i.name for i in reg.instruments())
     return names
 

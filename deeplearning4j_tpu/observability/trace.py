@@ -26,7 +26,24 @@ train/profiling.py. The two forms convert losslessly in both
 directions: ids, parent links, and attributes ride in the Chrome
 events' ``args``.
 
-Stdlib only; safe to import from any layer.
+**Tail-based sampling** (:class:`TailSampler` + :class:`RetentionPolicy`):
+at millions-of-requests scale the ring cannot hold every request's
+spans, yet the requests worth explaining — errors, sheds, preemptions,
+deadline blow-ups, p99.9 stragglers — are exactly the ones head
+sampling would have discarded before knowing they mattered. The tail
+sampler inverts the decision: a request registered via ``begin(cid)``
+has its spans diverted into a per-request *staging buffer* as they
+finish, and only at request completion does the retention policy decide
+keep-vs-drop — keep on a bad outcome, keep when the request's latency
+sits far above a rolling baseline (sentinel's ``RollingBaseline``
+machinery), plus a deterministic 1-in-N baseline sample. Kept requests'
+spans land in the bounded ring like any other span; dropped requests
+cost only the staging append. The serving request ledger
+(``observability/reqlog.py``) drives ``begin``/``finish`` for every
+request on both serving planes.
+
+Stdlib only; safe to import from any layer (the retention policy's
+rolling baseline is imported lazily from ``observability.sentinel``).
 """
 
 from __future__ import annotations
@@ -36,9 +53,9 @@ import json
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from contextlib import contextmanager
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 # Wall-clock anchor + monotonic progression: timestamps are comparable
 # across threads and meaningful as dates, but never go backwards the way
@@ -144,6 +161,225 @@ def get_tracer() -> Tracer:
     return _TRACER
 
 
+# -- tail-based sampling ------------------------------------------------------
+
+
+class RetentionPolicy:
+    """The completion-time keep-vs-drop decision for one request's spans.
+
+    ``decide()`` returns the retention *reason* (a short string the
+    ledger records and the ``trace_retained_total`` counter labels) or
+    None to drop:
+
+    - ``keep_outcomes`` — any outcome in the set is kept outright
+      (errors, sheds, preemptions, deadline misses: the requests a
+      post-mortem needs most);
+    - ``"slow"`` — the request's latency scores ``slow_score`` robust-z
+      above a rolling median+MAD baseline of *dropped-ok* latencies AND
+      exceeds the median by ``min_increase`` (the sentinel discipline:
+      kept-slow samples never feed the baseline, so a sustained
+      regression cannot teach itself into "normal");
+    - ``"sampled"`` — a deterministic 1-in-``sample_every`` baseline
+      sample of everything else, so healthy-path traces exist to
+      compare the tail against.
+    """
+
+    def __init__(self, *, sample_every: int = 128, slow_score: float = 8.0,
+                 min_increase: float = 0.5, baseline_window: int = 128,
+                 min_history: int = 16,
+                 keep_outcomes: Optional[Iterable[str]] = None):
+        if sample_every < 1:
+            raise ValueError(
+                f"sample_every must be >= 1, got {sample_every}")
+        from deeplearning4j_tpu.observability.sentinel import RollingBaseline
+
+        self.sample_every = int(sample_every)
+        self.slow_score = float(slow_score)
+        self.min_increase = float(min_increase)
+        self.min_history = int(min_history)
+        self.keep_outcomes = frozenset(
+            keep_outcomes if keep_outcomes is not None
+            else ("error", "failed", "shed", "preempted", "deadline"))
+        self._baseline = RollingBaseline(baseline_window)
+        self._count = itertools.count()
+        self._lock = threading.Lock()
+
+    def decide(self, *, outcome: str = "ok",
+               latency_s: Optional[float] = None) -> Optional[str]:
+        """Retention reason for one completed request, or None (drop)."""
+        if outcome in self.keep_outcomes:
+            return outcome
+        with self._lock:
+            n = next(self._count)
+            slow = False
+            if latency_s is not None \
+                    and len(self._baseline) >= self.min_history \
+                    and not self._baseline.degenerate():
+                med = self._baseline.median()
+                slow = (self._baseline.score(latency_s) >= self.slow_score
+                        and latency_s >= med * (1.0 + self.min_increase))
+            if not slow and latency_s is not None:
+                # only dropped-or-sampled OK latencies teach "normal" —
+                # a kept-slow request is the anomaly, not the baseline
+                self._baseline.add(latency_s)
+        if slow:
+            return "slow"
+        if n % self.sample_every == 0:
+            return "sampled"
+        return None
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"sample_every": self.sample_every,
+                    "slow_score": self.slow_score,
+                    "min_increase": self.min_increase,
+                    "min_history": self.min_history,
+                    "keep_outcomes": sorted(self.keep_outcomes),
+                    "baseline": self._baseline.to_json()}
+
+
+class TailSampler:
+    """Per-request span staging + completion-time retention.
+
+    ``begin(trace_id)`` registers a request; every span finishing with
+    that trace id is diverted into its staging buffer instead of the
+    ring (``offer`` — one dict lookup on the span-finish hot path for
+    unregistered traces). ``finish(trace_id, outcome=, latency_s=)``
+    pops the buffer and either records every staged span into the
+    tracer ring (kept) or drops them all.
+
+    Bounded both ways: at most ``max_staged`` requests stage at once
+    (oldest evicted — a request that never finishes must not pin spans
+    forever) and at most ``max_spans_per_request`` spans per request
+    (newest dropped, eviction counted on the buffer).
+    """
+
+    def __init__(self, *, policy: Optional[RetentionPolicy] = None,
+                 max_staged: int = 512, max_spans_per_request: int = 256,
+                 dropped_memory: int = 512):
+        if max_staged < 1:
+            raise ValueError(f"max_staged must be >= 1, got {max_staged}")
+        self.policy = policy if policy is not None else RetentionPolicy()
+        self.max_staged = int(max_staged)
+        self.max_spans_per_request = int(max_spans_per_request)
+        self._lock = threading.Lock()
+        self._staged: "OrderedDict[str, List[Span]]" = OrderedDict()
+        # trace ids recently decided DROPPED: a straggler span closing
+        # after the decision (the client-side span of an in-process
+        # request, a worker's post-hoc leg) is swallowed instead of
+        # leaking an orphan into the ring the retention just cleaned
+        self._dropped: "OrderedDict[str, bool]" = OrderedDict()
+        self.dropped_memory = int(dropped_memory)
+        self.staging_evictions = 0  # whole requests evicted un-decided
+        self.span_overflows = 0     # spans dropped over the per-request cap
+
+    def begin(self, trace_id: str) -> None:
+        """Register one request for staging (idempotent per trace id)."""
+        with self._lock:
+            if trace_id in self._staged:
+                return
+            # a retry reusing a previously-dropped id starts fresh
+            self._dropped.pop(trace_id, None)
+            while len(self._staged) >= self.max_staged:
+                self._staged.popitem(last=False)
+                self.staging_evictions += 1
+            self._staged[trace_id] = []
+
+    def watching(self, trace_id: str) -> bool:
+        with self._lock:
+            return trace_id in self._staged
+
+    def staged_count(self) -> int:
+        with self._lock:
+            return len(self._staged)
+
+    def offer(self, span: Span) -> bool:
+        """Divert a finishing span into its request's staging buffer;
+        False when the trace is not staged (caller records normally)."""
+        with self._lock:
+            buf = self._staged.get(span.trace_id)
+            if buf is None:
+                # late span of a dropped request: swallow it, or the
+                # decision the sampler just made would leak an orphan
+                return span.trace_id in self._dropped
+            if len(buf) >= self.max_spans_per_request:
+                self.span_overflows += 1
+                return True  # consumed (dropped): the cap is the cap
+            buf.append(span)
+            return True
+
+    def finish(self, trace_id: str, *, outcome: str = "ok",
+               latency_s: Optional[float] = None,
+               tracer: Optional[Tracer] = None
+               ) -> Tuple[Optional[str], int]:
+        """Decide retention for one completed request. Returns
+        ``(reason, n_spans)`` — reason None means the staged spans were
+        dropped; otherwise they were recorded into ``tracer`` (default:
+        the process ring) and are queryable by trace id."""
+        with self._lock:
+            buf = self._staged.pop(trace_id, None)
+            if buf is not None:
+                # tentatively dropped from the same critical section
+                # that un-stages: a span closing while the policy
+                # deliberates below is swallowed, never an orphan in
+                # the ring for a request the decision then drops. (The
+                # flip side — a kept trace losing a span from that
+                # microsecond window — is benign: every load-bearing
+                # leg is recorded before finish() runs by design.)
+                self._dropped[trace_id] = True
+                while len(self._dropped) > self.dropped_memory:
+                    self._dropped.popitem(last=False)
+        if buf is None:
+            return None, 0
+        reason = self.policy.decide(outcome=outcome, latency_s=latency_s)
+        if reason is None:
+            return None, len(buf)
+        with self._lock:
+            self._dropped.pop(trace_id, None)
+        t = tracer if tracer is not None else _TRACER
+        for s in buf:
+            t.record(s)
+        return reason, len(buf)
+
+    def discard(self, trace_id: str) -> int:
+        """Drop a staged request without a retention decision (e.g. the
+        ledger evicted its record); returns the span count dropped."""
+        with self._lock:
+            buf = self._staged.pop(trace_id, None)
+        return len(buf) if buf is not None else 0
+
+
+_TAIL_SAMPLER: Optional[TailSampler] = None
+
+
+def get_tail_sampler(create: bool = False) -> Optional[TailSampler]:
+    """The process tail sampler routing span finishes; ``create=True``
+    installs one when none exists (the request ledger does this)."""
+    global _TAIL_SAMPLER
+    if _TAIL_SAMPLER is None and create:
+        _TAIL_SAMPLER = TailSampler()
+    return _TAIL_SAMPLER
+
+
+def set_tail_sampler(sampler: Optional[TailSampler]) -> None:
+    global _TAIL_SAMPLER
+    _TAIL_SAMPLER = sampler
+
+
+def _route(span: Span, tracer: Optional[Tracer]) -> None:
+    """The one span-finish funnel: an explicit ``tracer`` always wins
+    (tests and collectors that own a private ring bypass staging); a
+    staged trace id diverts to the tail sampler; everything else lands
+    in the process ring exactly as before."""
+    if tracer is not None:
+        tracer.record(span)
+        return
+    ts = _TAIL_SAMPLER
+    if ts is not None and ts.offer(span):
+        return
+    _TRACER.record(span)
+
+
 def set_tracing_enabled(flag: bool):
     global _ENABLED
     _ENABLED = bool(flag)
@@ -195,7 +431,7 @@ def span(name: str, *, trace_id: Optional[str] = None,
     finally:
         _stack().pop()
         s.end = now()
-        (tracer if tracer is not None else _TRACER).record(s)
+        _route(s, tracer)
 
 
 def record_span(name: str, *, start: float, end: float, trace_id: str,
@@ -210,7 +446,7 @@ def record_span(name: str, *, start: float, end: float, trace_id: str,
              thread=(thread if thread is not None
                      else threading.current_thread().name),
              attrs=dict(attrs))
-    (tracer if tracer is not None else _TRACER).record(s)
+    _route(s, tracer)
     return s
 
 

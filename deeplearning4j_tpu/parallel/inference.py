@@ -584,6 +584,13 @@ class ParallelInference:
                 _obsr.record_transfer("d2h", sum(
                     getattr(a, "nbytes", 0)
                     for a in jax.tree_util.tree_leaves(out)))
+            ledger = None
+            if traced:
+                from deeplearning4j_tpu.observability import (
+                    reqlog as _reqlog,
+                )
+
+                ledger = _reqlog.get_request_ledger()
             for r in traced:
                 trace_id, parent = r.trace
                 b = _trace.record_span(
@@ -594,5 +601,14 @@ class ParallelInference:
                     "serving.dispatch", trace_id=trace_id,
                     parent_id=b.span_id, start=td0, end=td1,
                     device=str(device))
+                if ledger is not None:
+                    # the placement facts only this layer knows land on
+                    # the request's ledger record: how long it queued
+                    # and which padded batch served it
+                    ledger.annotate(
+                        trace_id,
+                        queue_wait_s=round(max(0.0, td0 - r.t_enqueue), 6),
+                        batch_rows=rows, batch_bucket=bucket,
+                        dispatch_s=round(max(0.0, td1 - td0), 6))
         except Exception:  # noqa: BLE001 — telemetry never fails serving
             pass

@@ -26,6 +26,12 @@ REST serving story, grown into a first-class subsystem).
   the HTTP server (chunked ndjson; ServingClient.generate() yields),
   priority preemption of decode slots, and a shrink-max_new_tokens
   brownout rung.
+- request tracing: every request on both planes gets an always-on
+  ledger record (observability/reqlog.py — admission outcome, queue
+  wait, TTFT, decode rollup, deadline slack, keyed by correlation id)
+  and tail-sampled span retention: only errors/sheds/preemptions/
+  deadline-misses, latency outliers, and a deterministic 1-in-N sample
+  keep their span trees. GET /debug/requests[/<correlation-id>].
 - overload: overload management — priority-class admission (critical/
   normal/batch via X-Priority, lowest class sheds first, critical never
   shed while lower-class work is in flight), per-tenant token-bucket

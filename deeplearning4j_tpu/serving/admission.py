@@ -145,13 +145,18 @@ class AdmissionController:
     # -- admission ------------------------------------------------------------
 
     def admit(self, priority: str = "normal",
-              tenant: Optional[str] = None) -> AdmissionTicket:
+              tenant: Optional[str] = None,
+              correlation_id: Optional[str] = None) -> AdmissionTicket:
         """Admit or raise QueueFullError / TenantQuotaError — never
         blocks. Check order: brownout batch-shed (cheapest statement of
         policy), class capacity, then tenant quota LAST — a request the
         server would shed anyway must not burn one of its tenant's
         tokens, or global overload would drain well-behaved tenants'
-        quotas through rejected requests."""
+        quotas through rejected requests.
+
+        ``correlation_id`` rides the admission-cap flight breadcrumb so
+        a shed in the timeline joins the request-ledger record
+        (``GET /debug/requests/<id>``) it belongs to."""
         if priority not in self._by_class:
             raise BadRequestError(
                 f"priority must be one of {list(PRIORITIES)}, "
@@ -162,7 +167,8 @@ class AdmissionController:
             if ov is None:
                 limit = self.max_in_flight
                 if total >= limit:
-                    self._record_cap(total, limit, priority)
+                    self._record_cap(total, limit, priority,
+                                     correlation_id)
                     raise QueueFullError(
                         f"admission cap reached ({limit} in flight)",
                         retry_after_ms=self._retry_hint_ms(total, limit))
@@ -197,7 +203,8 @@ class AdmissionController:
                         # capacity sheds — and only these — feed the
                         # manager's shed-rate overload signal
                         ov.note_shed()
-                        self._record_cap(total, threshold, priority)
+                        self._record_cap(total, threshold, priority,
+                                         correlation_id)
                         raise QueueFullError(
                             f"admission cap reached for class "
                             f"'{priority}' ({total} in flight >= "
@@ -218,18 +225,21 @@ class AdmissionController:
             self._report_class(priority, self._by_class[priority])
         return AdmissionTicket(self, priority)
 
-    def _record_cap(self, total: int, limit: int, priority: str):
+    def _record_cap(self, total: int, limit: int, priority: str,
+                    correlation_id: Optional[str] = None):
         try:
             # black-box breadcrumb with the depth context only this
             # layer knows; a distinct kind from the server's per-request
             # "serving.shed" so timelines don't double-count one
-            # rejection
+            # rejection. The correlation id joins it to the request
+            # ledger record.
             from deeplearning4j_tpu.observability.flightrecorder import (
                 record_event,
             )
 
             record_event("serving.admission_cap", in_flight=total,
-                         limit=limit, priority=priority)
+                         limit=limit, priority=priority,
+                         correlation_id=correlation_id)
         except Exception:  # noqa: BLE001 — never block the shed
             pass
 

@@ -216,23 +216,36 @@ class ServingClient:
         ``{"error": ...}`` line; tokens already yielded stand). The
         retry policy does NOT apply to streams — a generator cannot
         un-yield — so retry-on-preempt is the caller's loop, or use
-        :meth:`generate_tokens` which retries whole requests."""
+        :meth:`generate_tokens` which retries whole requests.
+
+        A correlation ID (minted per call unless given) rides the
+        ``X-Correlation-ID``/``X-Span-ID`` headers exactly like
+        :meth:`predict`: the ``client.generate`` span recorded here
+        parents the server's ``serving.generate`` → ``generation.*``
+        tree, and the server echoes the id on the stream response, so
+        client- and server-side records of one request join."""
         payload = self._generate_payload(prompt, max_new_tokens,
                                          temperature, eos_id, True,
                                          deadline_ms)
         cid = correlation_id if correlation_id else _trace.new_id()
-        req = urllib.request.Request(
-            self.base_url + f"/v1/models/{model}:generate",
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json",
-                     **self._headers(cid, priority, tenant)})
         # POST eagerly: submit-time sheds (429/503/400) must raise HERE,
         # where the caller's try/except lives — not at the first next()
-        # of a generator they may consume elsewhere (or never)
-        try:
-            resp = urllib.request.urlopen(req, timeout=self.timeout)
-        except urllib.error.HTTPError as e:
-            self._raise_typed(e)
+        # of a generator they may consume elsewhere (or never). The
+        # client span covers the submit leg (POST to response headers);
+        # the token stream is consumed later, wherever the caller is.
+        with _trace.span("client.generate", trace_id=cid,
+                         model=model) as s:
+            headers = self._headers(cid, priority, tenant)
+            if s is not None:
+                headers["X-Span-ID"] = s.span_id
+            req = urllib.request.Request(
+                self.base_url + f"/v1/models/{model}:generate",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json", **headers})
+            try:
+                resp = urllib.request.urlopen(req, timeout=self.timeout)
+            except urllib.error.HTTPError as e:
+                self._raise_typed(e)
 
         def _stream():
             with resp:
@@ -266,14 +279,20 @@ class ServingClient:
         ``{"model", "version", "tokens", "n_tokens", "finish_reason"}``.
         Rides :meth:`_request`, so ``max_retries`` re-sends retryable
         sheds AND mid-flight preemptions (``503 SLOT_PREEMPTED``) after
-        the server's Retry-After — the whole request restarts, which is
-        exactly the preempted-client-retries contract."""
+        the server's Retry-After — the whole request restarts, and
+        every retry reuses the same correlation id: one logical
+        request, one joinable ledger/trace history."""
         payload = self._generate_payload(prompt, max_new_tokens,
                                          temperature, eos_id, False,
                                          deadline_ms)
         cid = correlation_id if correlation_id else _trace.new_id()
-        return self._request(f"/v1/models/{model}:generate", payload,
-                             self._headers(cid, priority, tenant))
+        with _trace.span("client.generate", trace_id=cid,
+                         model=model) as s:
+            headers = self._headers(cid, priority, tenant)
+            if s is not None:
+                headers["X-Span-ID"] = s.span_id
+            return self._request(f"/v1/models/{model}:generate", payload,
+                                 headers)
 
     def models(self) -> list:
         return self._request("/models")["models"]

@@ -38,11 +38,24 @@ the repo's own warmup-bucket discipline):
   ``max_new_tokens`` under sustained overload.
 
 Telemetry: ``generation_*`` metric families on the serving bundle
-(tokens, TTFT histogram, slot occupancy, preemptions, kv bytes, queue
-depth) and ``generation.join`` / ``generation.leave`` /
-``generation.preempt`` / ``generation.shed`` flight events carrying the
-decode-step index — the post-mortem timeline shows exactly which
-sequences shared which steps.
+(tokens, TTFT + end-to-end latency histograms with correlation-id
+exemplars, slot occupancy, preemptions, kv bytes, queue depth) and
+``generation.join`` / ``generation.leave`` / ``generation.preempt`` /
+``generation.shed`` flight events carrying the decode-step index AND
+the correlation id — the post-mortem timeline shows exactly which
+sequences shared which steps, and joins to the request ledger.
+
+Per-request observability (PR 12): every accepted request opens a
+ledger record (``observability/reqlog.py`` — queue wait, slot, TTFT,
+prefill seconds, decode-step rollup, tokens, outcome, deadline slack)
+and its spans accumulate in the tail sampler's staging buffer — a
+post-hoc ``generation.request`` root, a ``generation.prefill`` leg,
+*sampled* ``generation.decode_step`` legs (every
+``decode_span_every``-th token plus the first two), and a
+``generation.preempt`` marker — retained at completion only when the
+retention policy keeps them (bad outcome, slow, or the 1-in-N sample),
+so ``GET /debug/requests/<correlation-id>`` explains exactly the
+requests worth explaining.
 
 Threading: ONE scheduler thread owns the slabs and all device dispatch
 (the single-writer discipline); submit/cancel only touch the waiting
@@ -64,6 +77,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn.generation import sample_token
+from deeplearning4j_tpu.observability import reqlog as _reqlog
+from deeplearning4j_tpu.observability import trace as _trace
 from deeplearning4j_tpu.observability.flightrecorder import record_event
 from deeplearning4j_tpu.serving.errors import (
     BadRequestError,
@@ -107,6 +122,16 @@ class GenerationStream:
         self.tenant = tenant
         self.t_submit = t_submit
         self.t_first: Optional[float] = None
+        # per-request observability: correlation id (adopted from the
+        # HTTP layer or minted), the pre-minted root span id every
+        # post-hoc leg parents to, and the timing rollups the ledger
+        # record carries
+        self.cid: str = ""
+        self.parent_span: Optional[str] = None
+        self.root_span: str = ""
+        self.traced = False          # ledger record open + spans staged
+        self.prefill_s: Optional[float] = None
+        self.decode_s = 0.0
         # scheduler state (engine lock)
         self.state = _WAITING
         self.slot: Optional[int] = None
@@ -249,6 +274,7 @@ class GenerationEngine:
                  max_waiting: int = 64, min_kv_bucket: int = 8,
                  min_prompt_bucket: int = 8, idle_wait_s: float = 0.05,
                  temperature: float = 1.0, seed: int = 0,
+                 decode_span_every: int = 8,
                  metrics=None, clock: Callable[[], float] = time.monotonic):
         cfg = model.config
         self._model = model
@@ -276,6 +302,10 @@ class GenerationEngine:
         self.max_waiting = int(max_waiting)
         self.default_temperature = float(temperature)
         self.idle_wait_s = float(idle_wait_s)
+        # decode-step span sampling: per request, the first two tokens
+        # and every Nth after that get a staged span — enough legs to
+        # see the step cadence without a span per token
+        self.decode_span_every = max(1, int(decode_span_every))
         self._clock = clock
         # bucket vocabularies — static, closed sets: runtime selection can
         # only ever pick a warmed program (the warmup.bucket_sizes
@@ -471,12 +501,13 @@ class GenerationEngine:
 
     # -- submit path (any thread) --------------------------------------------
 
-    def _shed(self, reason: str, priority: str):
+    def _shed(self, reason: str, priority: str,
+              correlation_id: Optional[str] = None):
         m = self._metrics
         if m is not None:
             m.generation_requests_total.inc(model=self.name, outcome="shed")
         record_event("generation.shed", model=self.name, reason=reason,
-                     priority=priority)
+                     priority=priority, correlation_id=correlation_id)
 
     def _retry_hint_ms(self, waiting: int) -> float:
         ewma = self._stream_ewma_s
@@ -488,14 +519,22 @@ class GenerationEngine:
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None, priority: str = "normal",
-               tenant: Optional[str] = None) -> GenerationStream:
+               tenant: Optional[str] = None,
+               correlation_id: Optional[str] = None,
+               parent_span_id: Optional[str] = None) -> GenerationStream:
         """Queue one generation request; returns its stream handle.
         Sheds exactly like the predict plane: brownout ``batch`` shed
         and waiting-queue capacity sheds raise ``QueueFullError`` (only
         the latter feeds the AIMD shed-rate signal), tenant quota —
         checked LAST so a request the engine would shed anyway never
         burns a token — raises ``TenantQuotaError`` with the refill
-        wait."""
+        wait.
+
+        ``correlation_id`` (minted when absent) keys this request's
+        ledger record and staged span tree; ``parent_span_id`` (the
+        server passes its ``serving.generate`` span) parents the
+        post-hoc ``generation.request`` root so the client → server →
+        scheduler legs form one tree."""
         if priority not in _PRIO_RANK:
             raise BadRequestError(
                 f"priority must be one of {list(PRIORITIES)}, "
@@ -542,26 +581,27 @@ class GenerationEngine:
         if eos_id is not None and not 0 <= int(eos_id) < vocab:
             raise BadRequestError(f"eos_id must be in [0, {vocab})")
         ov = self._overload
+        cid = correlation_id if correlation_id else _trace.new_id()
         with self._cv:
             if self._stopflag or self._draining:
                 raise NotReadyError("generation engine is draining")
             waiting = len(self._waiting)
             if ov is not None and priority == "batch" and ov.shed_batch:
-                self._shed("brownout_batch", priority)
+                self._shed("brownout_batch", priority, cid)
                 raise QueueFullError(
                     "brownout: batch-class generation requests are shed",
                     retry_after_ms=self._retry_hint_ms(waiting))
             if waiting >= self.max_waiting:
                 if ov is not None:
                     ov.note_shed()
-                self._shed("queue_full", priority)
+                self._shed("queue_full", priority, cid)
                 raise QueueFullError(
                     f"generation queue full ({waiting} waiting)",
                     retry_after_ms=self._retry_hint_ms(waiting))
             if ov is not None:
                 ok, wait_s = ov.tenant_take(tenant)
                 if not ok:
-                    self._shed("tenant_quota", priority)
+                    self._shed("tenant_quota", priority, cid)
                     raise TenantQuotaError(
                         f"tenant {(tenant or '<anonymous>')!r} is over "
                         "its request quota",
@@ -571,6 +611,19 @@ class GenerationEngine:
                 int(max_new_tokens), float(temperature),
                 None if eos_id is None else int(eos_id),
                 priority, tenant, self._clock())
+            req.cid = cid
+            req.parent_span = parent_span_id
+            req.root_span = _trace.new_id()
+            # the always-on ledger record: one per accepted request,
+            # whatever its fate — and the tail sampler starts staging
+            # this trace id's spans the same moment
+            led = _reqlog.get_request_ledger(create=True)
+            rec = led.begin(
+                cid, plane="generation", model=self.name,
+                priority=priority, tenant=tenant,
+                prompt_len=req.prompt_len, admission="admitted",
+                req=req.id) if led is not None else None
+            req.traced = rec is not None
             # priority-ordered insert, FIFO within a class
             rank = _PRIO_RANK[priority]
             at = len(self._waiting)
@@ -600,7 +653,44 @@ class GenerationEngine:
             self._report_queue_locked()
         record_event("generation.leave", model=self.name, req=req.id,
                      slot=req.slot, step=self.steps, reason=outcome,
-                     tokens=req.generated)
+                     tokens=req.generated, correlation_id=req.cid)
+        self._close_request(req, outcome)
+
+    def _close_request(self, req: GenerationStream, outcome: str):
+        """Terminal per-request observability, run exactly once per
+        stream (every caller flips ``state`` to done under the lock
+        first): the end-to-end latency histogram (correlation-id
+        exemplar; client cancels excluded — the server never finished
+        that stream), the post-hoc ``generation.request`` root span the
+        staged legs parent to, and the ledger finish that triggers the
+        tail sampler's keep-vs-drop decision."""
+        dur = max(0.0, self._clock() - req.t_submit)
+        m = self._metrics
+        if m is not None and outcome != "cancelled":
+            m.generation_latency.observe(dur, model=self.name,
+                                         exemplar_trace_id=req.cid)
+        if req.traced:
+            # the root is recorded BEFORE the ledger finish pops the
+            # staging buffer, so a retained tree always carries it
+            t_end = _trace.now()
+            _trace.record_span(
+                "generation.request", trace_id=req.cid,
+                span_id=req.root_span, parent_id=req.parent_span,
+                start=t_end - dur, end=t_end, model=self.name,
+                outcome=outcome, priority=req.priority,
+                tokens=req.generated, slot=req.slot)
+            led = _reqlog.get_request_ledger()
+            if led is not None:
+                ledger_outcome = "ok" if outcome == "completed" else outcome
+                led.finish(
+                    req.cid, outcome=ledger_outcome,
+                    finish_reason=req.finish_reason, version=self.version,
+                    tokens=req.generated,
+                    decode_steps=max(0, req.generated - 1),
+                    decode_s=round(req.decode_s, 6),
+                    prefill_s=req.prefill_s,
+                    preemptions=1 if outcome == "preempted" else 0,
+                    slot=req.slot)
 
     # -- scheduler (single thread) -------------------------------------------
 
@@ -657,6 +747,7 @@ class GenerationEngine:
     def _admit(self):
         while True:
             req = None
+            victim = None
             with self._cv:
                 if not self._waiting:
                     return
@@ -670,18 +761,28 @@ class GenerationEngine:
                     self._slots[head.slot] = head
                     self._report_queue_locked()
                     req = head
-                elif head.priority == "critical" \
-                        and self._preempt_locked():
-                    continue  # a slot was freed; retry the admit
+                elif head.priority == "critical":
+                    victim = self._preempt_locked()
+                    if victim is None:
+                        return
                 else:
                     return
+            if victim is not None:
+                # the victim's telemetry close (ledger finish, span
+                # promotion, flight event) runs OUTSIDE the engine
+                # lock, like every other _close_request call site —
+                # submitters and token pushes must not stall behind it
+                self._finish_preempt(victim)
+                continue  # a slot was freed; retry the admit
             self._prefill(req)
 
-    def _preempt_locked(self) -> bool:
+    def _preempt_locked(self) -> Optional[GenerationStream]:
         """Evict the lowest-class active slot for a waiting critical
         request. Victim = worst priority class, newest join within it
         (least sunk decode work). Never evicts critical. Caller holds
-        the lock; returns True when a slot was freed."""
+        the lock; returns the evicted stream (state already flipped to
+        done, error set) for the caller to close outside the lock, or
+        None when nothing was evictable."""
         victim = None
         for s in self._slots:
             if s is None or s.priority == "critical":
@@ -692,28 +793,42 @@ class GenerationEngine:
                         and s.id > victim.id):
                 victim = s
         if victim is None:
-            return False
+            return None
         self._slots[victim.slot] = None
         victim.state = _DONE
         victim.finish_reason = "preempted"
-        err = SlotPreemptedError(
+        victim.error = SlotPreemptedError(
             f"decode slot preempted by a critical request after "
             f"{victim.generated} tokens",
             retry_after_ms=self._retry_hint_ms(len(self._waiting)))
-        victim.error = err
         m = self._metrics
         if m is not None:
             m.generation_preemptions_total.inc(model=self.name,
                                                priority=victim.priority)
             m.generation_requests_total.inc(model=self.name,
                                             outcome="preempted")
+        self._report_queue_locked()
+        return victim
+
+    def _finish_preempt(self, victim: GenerationStream):
+        """Everything an eviction owes the victim that does not need
+        the engine lock (its state is already done, so no other path
+        can close it twice)."""
         record_event("generation.preempt", model=self.name,
                      victim=victim.id, slot=victim.slot, step=self.steps,
                      victim_priority=victim.priority,
-                     tokens=victim.generated)
-        self._report_queue_locked()
-        victim._push_error(err)
-        return True
+                     tokens=victim.generated, correlation_id=victim.cid)
+        if victim.traced:
+            # a point-in-time leg: the preemption marker a retained
+            # tree shows between the last decode step and the end
+            t = _trace.now()
+            _trace.record_span(
+                "generation.preempt", trace_id=victim.cid,
+                parent_id=victim.root_span, start=t, end=t,
+                step=self.steps, slot=victim.slot,
+                victim_priority=victim.priority, tokens=victim.generated)
+        self._close_request(victim, "preempted")
+        victim._push_error(victim.error)
 
     def _prefill(self, req: GenerationStream):
         t0v = req.prompt_len
@@ -722,12 +837,14 @@ class GenerationEngine:
         prompt = np.zeros(p, np.int32)
         prompt[:t0v] = req.prompt
         self._rng_step += 1
+        tp0 = _trace.now()
         ks, vs, tok = fn(self._params, self._kslabs, self._vslabs,
                          self._base_key, np.int32(self._rng_step),
                          np.int32(req.slot), prompt, np.int32(t0v),
                          np.float32(req.temperature))
         self._kslabs, self._vslabs = ks, vs
         tok = int(np.asarray(tok))
+        tp1 = _trace.now()
         with self._cv:
             # same cancel-race guard as the decode path: a client that
             # disconnected while the prefill ran gets no phantom TTFT
@@ -738,14 +855,29 @@ class GenerationEngine:
             req.last_tok = tok
             req.generated = 1
             req.t_first = self._clock()
+            req.prefill_s = round(tp1 - tp0, 6)
+        ttft = req.t_first - req.t_submit
         m = self._metrics
         if m is not None:
-            m.generation_ttft.observe(req.t_first - req.t_submit,
-                                      model=self.name)
+            m.generation_ttft.observe(ttft, model=self.name,
+                                      exemplar_trace_id=req.cid)
             m.generation_tokens_total.inc(model=self.name)
+        if req.traced:
+            _trace.record_span(
+                "generation.prefill", trace_id=req.cid,
+                parent_id=req.root_span, start=tp0, end=tp1,
+                slot=req.slot, prompt_len=t0v, bucket=p)
+            led = _reqlog.get_request_ledger()
+            if led is not None:
+                led.annotate(req.cid, slot=req.slot,
+                             queue_wait_s=round(max(0.0, ttft
+                                                    - (tp1 - tp0)), 6),
+                             ttft_s=round(ttft, 6),
+                             prefill_s=req.prefill_s,
+                             prompt_bucket=p)
         record_event("generation.join", model=self.name, req=req.id,
                      slot=req.slot, step=self.steps, prompt_len=t0v,
-                     priority=req.priority)
+                     priority=req.priority, correlation_id=req.cid)
         req._push_token(tok)
         self._maybe_finish(req, tok)
 
@@ -768,11 +900,14 @@ class GenerationEngine:
             temps[i] = r.temperature
         fn = self._get_decode_fn(b, kv)
         self._rng_step += 1
+        td0 = _trace.now()
         ks, vs, toks = fn(self._params, self._kslabs, self._vslabs,
                           self._base_key, np.int32(self._rng_step),
                           slot_idx, ids, pos, temps)
         self._kslabs, self._vslabs = ks, vs
         toks = np.asarray(toks)
+        td1 = _trace.now()
+        step_s = td1 - td0
         self.steps += 1
         m = self._metrics
         if m is not None:
@@ -788,6 +923,18 @@ class GenerationEngine:
                 r.pos += 1
                 r.generated += 1
                 r.last_tok = tok
+                r.decode_s += step_s
+                gen = r.generated
+            if r.traced and (gen <= 3
+                             or gen % self.decode_span_every == 0):
+                # sampled decode-step legs: the first steps after join
+                # plus every Nth token — the retained tree shows the
+                # step cadence without a span per token
+                _trace.record_span(
+                    "generation.decode_step", trace_id=r.cid,
+                    parent_id=r.root_span, start=td0, end=td1,
+                    step=self.steps, slot=r.slot, token_index=gen,
+                    batch=len(active), kv_bucket=kv)
             r._push_token(tok)
             pushed += 1
             self._maybe_finish(r, tok)
@@ -824,7 +971,8 @@ class GenerationEngine:
             self._report_queue_locked()
         record_event("generation.leave", model=self.name, req=req.id,
                      slot=req.slot, step=self.steps, reason=reason,
-                     tokens=req.generated)
+                     tokens=req.generated, correlation_id=req.cid)
+        self._close_request(req, "completed")
         req._push_done()
 
     def _fail_active(self, exc: Exception):
@@ -847,6 +995,7 @@ class GenerationEngine:
                                                     outcome="failed")
             self._report_queue_locked()
         for r in failed:
+            self._close_request(r, "failed")
             r._push_error(RuntimeError(f"generation step failed: {exc}"))
 
     # -- token brownout (the generation rung) --------------------------------
@@ -909,6 +1058,7 @@ class GenerationEngine:
             self._report_queue_locked()
             self._cv.notify_all()
         for r in victims:
+            self._close_request(r, "failed")
             r._push_error(NotReadyError("generation engine stopped"))
         if self._thread is not None:
             self._thread.join(timeout=10)

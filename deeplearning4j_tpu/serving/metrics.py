@@ -130,7 +130,16 @@ class ServingMetrics:
         self.generation_ttft = r.histogram(
             "generation_ttft_seconds",
             "Time-to-first-token: submit to the prefill-sampled first "
-            "token entering the stream.", ("model",))
+            "token entering the stream. Buckets carry OpenMetrics "
+            "exemplars (the request's correlation id) under the "
+            "negotiated openmetrics-text rendering.", ("model",))
+        self.generation_latency = r.histogram(
+            "generation_latency_seconds",
+            "End-to-end generation stream latency: submit to the "
+            "terminal outcome (completed/preempted/failed/deadline; "
+            "client cancels excluded — the server never finished that "
+            "stream). Buckets carry correlation-id exemplars under the "
+            "OpenMetrics rendering.", ("model",))
         self.generation_decode_steps_total = r.counter(
             "generation_decode_steps_total",
             "Iteration-level decode steps dispatched (each serves every "

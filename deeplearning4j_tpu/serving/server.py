@@ -47,6 +47,14 @@ telemetry spine):
 - ``GET /debug/incidents`` — the anomaly sentinel's incident-bundle
   index; ``GET /debug/incidents/<id>`` fetches one full bundle
   (observability/incidents.py).
+- ``GET /debug/requests`` — the always-on request ledger
+  (observability/reqlog.py): one lifecycle record per request on both
+  planes, filterable by ``outcome``/``tenant``/``model``/``plane``/
+  ``min_latency_ms``; ``GET /debug/requests/<correlation-id>`` returns
+  one request's record plus its tail-retained span tree
+  (Chrome-format twin included). Tail sampling keeps span trees only
+  for bad outcomes, latency outliers, and a deterministic 1-in-N
+  sample — the ledger record itself exists for every request.
 
 Anomaly sentinel (``sentinel=True``, the default): a rolling-baseline
 detector engine (observability/sentinel.py) ticks alongside the SLO
@@ -109,6 +117,7 @@ import jax
 import numpy as np
 
 from deeplearning4j_tpu.observability import incidents as _incidents
+from deeplearning4j_tpu.observability import reqlog as _reqlog
 from deeplearning4j_tpu.observability import sentinel as _sentinel
 from deeplearning4j_tpu.observability import slo as _slo
 from deeplearning4j_tpu.observability import trace as _trace
@@ -290,6 +299,15 @@ class ModelServer:
                 interval_s=sentinel_interval_s,
                 incidents=self.incidents,
                 sampler=get_host_sampler())
+        # Per-request observability (observability/reqlog.py): the
+        # process request ledger records one lifecycle record for EVERY
+        # request either plane sees, and drives tail-based trace
+        # sampling — only errors/sheds/preemptions/deadline-misses,
+        # latency outliers, and a deterministic 1-in-N sample keep
+        # their span trees in the tracer ring. Served at
+        # GET /debug/requests[?outcome=&tenant=&model=&min_latency_ms=]
+        # and GET /debug/requests/<correlation-id>.
+        self.reqlog = _reqlog.get_request_ledger(create=True)
         # Per-(model, version) circuit breakers: a bad deploy's failures
         # open ITS version's circuit; the rollback target starts fresh.
         # None disables breaking entirely.
@@ -393,6 +411,32 @@ class ModelServer:
                     self._send(200, {"engines": {
                         name: eng.describe()
                         for name, eng in server.generators.items()}})
+                elif path == "/debug/requests":
+                    q = parse_qs(query)
+                    try:
+                        min_latency_ms = (float(q["min_latency_ms"][0])
+                                          if "min_latency_ms" in q else None)
+                        limit = int(q.get("limit", ["100"])[0])
+                    except ValueError:
+                        self._send(400, BadRequestError(
+                            "min_latency_ms and limit must be "
+                            "numbers").to_json())
+                        return
+                    self._send(200, server.render_requests(
+                        outcome=q.get("outcome", [None])[0],
+                        tenant=q.get("tenant", [None])[0],
+                        model=q.get("model", [None])[0],
+                        plane=q.get("plane", [None])[0],
+                        min_latency_ms=min_latency_ms, limit=limit))
+                elif path.startswith("/debug/requests/"):
+                    cid = path[len("/debug/requests/"):]
+                    body = server.render_request(cid)
+                    if body is None:
+                        self._send(404, ServingError(
+                            f"no request {cid!r} in the ledger or "
+                            "tracer ring").to_json())
+                    else:
+                        self._send(200, body)
                 elif path == "/debug/incidents":
                     self._send(200, server.render_incidents())
                 elif path.startswith("/debug/incidents/"):
@@ -458,6 +502,7 @@ class ModelServer:
             def _do_generate(self, name: str, payload, cid: str):
                 status, body, stream = server.handle_generate(
                     name, payload, correlation_id=cid,
+                    parent_span_id=self.headers.get("X-Span-ID"),
                     priority=self.headers.get("X-Priority"),
                     tenant=self.headers.get("X-Tenant"))
                 if stream is None:
@@ -471,17 +516,22 @@ class ModelServer:
                 self.send_header("Transfer-Encoding", "chunked")
                 self.send_header("X-Correlation-ID", cid)
                 self.end_headers()
+                ts0 = _trace.now()
+                n_lines = 0
                 try:
                     for ev in stream.wire_events():
                         line = json.dumps(ev).encode() + b"\n"
                         self.wfile.write(b"%X\r\n" % len(line)
                                          + line + b"\r\n")
                         self.wfile.flush()
+                        n_lines += 1
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     # client went away mid-stream: free the decode slot
                     # instead of generating tokens nobody reads
                     stream.cancel()
+                    return
+                server._record_stream_leg(cid, stream, ts0, n_lines)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
 
@@ -593,6 +643,12 @@ class ModelServer:
         cid = correlation_id if correlation_id else _trace.new_id()
         cb = None  # the breaker this request must report back to
         cb_token = None
+        # the always-on ledger record + tail-sampling staging for this
+        # correlation id — opened before the root span so every span of
+        # this request (admission, batch, dispatch) stages
+        led = self.reqlog
+        if led is not None:
+            led.begin(cid, plane="predict", model=name)
         # Root of the server-side span tree: the client's span (X-Span-ID)
         # is the parent, admission nests inside via the thread-local stack,
         # and the batch/dispatch legs are recorded against req_span by the
@@ -602,6 +658,8 @@ class ModelServer:
             try:
                 prio = self._validate_priority(priority)
                 tenant = self._validate_tenant(tenant)
+                if led is not None:
+                    led.annotate(cid, priority=prio, tenant=tenant)
                 inj = _fault_injector()
                 if inj.enabled:
                     # resilience injection points: "serving.latency" (sleep
@@ -643,7 +701,11 @@ class ModelServer:
                     timeout = self.admission.timeout_s(
                         payload.get("deadline_ms"))
                     ticket = self.admission.admit(priority=prio,
-                                                  tenant=tenant)
+                                                  tenant=tenant,
+                                                  correlation_id=cid)
+                if led is not None:
+                    led.annotate(cid, admission="admitted",
+                                 deadline_s=timeout)
                 # the absolute deadline anchors at admission: a request
                 # still queued past it is dropped before dispatch
                 deadline = time.monotonic() + timeout
@@ -696,6 +758,8 @@ class ModelServer:
                     metric_model = "<unknown>"
                 reason = _SHED_REASONS.get(type(e))
                 if reason is not None:
+                    if led is not None:
+                        led.annotate(cid, admission=f"shed:{reason}")
                     self.metrics.shed_total.inc(model=metric_model,
                                                 reason=reason)
                     extra = {}
@@ -738,7 +802,37 @@ class ModelServer:
         self.metrics.request_latency.observe(time.monotonic() - t0,
                                              model=metric_model,
                                              exemplar_trace_id=cid)
+        if led is not None:
+            # finishing the record runs the tail sampler's retention
+            # decision over every span this request staged
+            led.finish(cid, outcome=self._predict_outcome(status, body),
+                       status=status,
+                       version=(body.get("version")
+                                if status == 200 and isinstance(body, dict)
+                                else None))
         return status, body
+
+    @staticmethod
+    def _predict_outcome(status: int, body) -> str:
+        """Map one predict response to a ledger outcome. ``rejected``
+        (client errors) is deliberately NOT in the tail sampler's keep
+        set — a port scanner's 404s must not evict real post-mortems
+        from the tracer ring — while sheds, deadline misses, and server
+        failures are."""
+        if status == 200:
+            return "ok"
+        code = (body.get("error", {}).get("code")
+                if isinstance(body, dict) else None)
+        if status in (400, 404):
+            return "rejected"
+        if code in ("DEADLINE_EXCEEDED", "DEADLINE_EXPIRED") \
+                or status == 504:
+            return "deadline"
+        if code == WorkerCrashedError.code:
+            return "failed"
+        if status in (429, 503):
+            return "shed"
+        return "error"
 
     # -- generative serving ---------------------------------------------------
 
@@ -794,8 +888,32 @@ class ModelServer:
 
         ladder.add_transition_listener(retry)
 
+    def _record_stream_leg(self, cid: str, stream, ts0: float,
+                           n_lines: int) -> None:
+        """The stream-write leg: how long the chunked ndjson write to
+        THIS client took. Recorded post-hoc after the engine already
+        finished the request, so it rides the ring only when the tail
+        sampler retained the trace — a fast dropped request must not
+        leak its stream span past the retention decision."""
+        try:
+            rec = self.reqlog.get(cid) if self.reqlog is not None else None
+            if rec is None or not rec.get("trace_retained"):
+                return
+            root = None
+            for s in _trace.get_tracer().spans(trace_id=cid):
+                if s.name == "generation.request":
+                    root = s.span_id
+                    break
+            _trace.record_span(
+                "serving.stream", trace_id=cid, parent_id=root,
+                start=ts0, end=_trace.now(), lines=n_lines,
+                tracer=_trace.get_tracer())
+        except Exception:  # noqa: BLE001 — telemetry never fails serving
+            pass
+
     def handle_generate(self, name: str, payload, *,
                         correlation_id: Optional[str] = None,
+                        parent_span_id: Optional[str] = None,
                         priority=None, tenant=None):
         """Validate + submit one generation request.
 
@@ -806,42 +924,67 @@ class ModelServer:
         (``{"stream": false}``)."""
         cid = correlation_id if correlation_id else _trace.new_id()
         handle = None
+        # open the ledger record (and span staging) before the root
+        # span, exactly like predict — a shed's spans stage too, so a
+        # kept shed trace explains itself
+        if self.reqlog is not None:
+            self.reqlog.begin(cid, plane="generation", model=name)
         try:
-            prio = self._validate_priority(priority)
-            tenant = self._validate_tenant(tenant)
-            engine = self.generators.get(name)
-            if engine is None:
-                raise ModelNotFoundError(f"no generator named '{name}'")
-            if self._draining or not self._started:
-                raise NotReadyError("server is draining" if self._draining
-                                    else "server not started")
-            if not isinstance(payload, dict) or "prompt" not in payload:
-                raise BadRequestError('body must be {"prompt": [ids...]}')
-            mnt = payload.get("max_new_tokens")
-            if mnt is not None and (isinstance(mnt, bool)
-                                    or not isinstance(mnt, int)):
-                raise BadRequestError("max_new_tokens must be an integer")
-            temp = payload.get("temperature")
-            if temp is not None and (isinstance(temp, bool)
-                                     or not isinstance(temp, (int, float))):
-                raise BadRequestError("temperature must be a number")
-            eos = payload.get("eos_id")
-            if eos is not None and (isinstance(eos, bool)
-                                    or not isinstance(eos, int)):
-                raise BadRequestError("eos_id must be an integer")
-            stream_mode = payload.get("stream", True)
-            # every validation — deadline included — happens BEFORE
-            # submit: a 400 must never leave an orphaned stream decoding
-            # tokens nobody will read. The deadline semantics match
-            # predict: default_deadline_ms when absent, clamped at
-            # max_deadline_ms — and they bound STREAMING responses too
-            # (the stream ends with a terminal DEADLINE_EXCEEDED line)
-            timeout = self.admission.timeout_s(payload.get("deadline_ms"))
-            record_event("generation.request", model=name, priority=prio,
-                         correlation_id=cid, stream=bool(stream_mode))
-            handle = engine.submit(
-                payload["prompt"], max_new_tokens=mnt, temperature=temp,
-                eos_id=eos, priority=prio, tenant=tenant)
+            with _trace.span("serving.generate", trace_id=cid,
+                             parent_id=parent_span_id,
+                             model=name) as gen_span:
+                prio = self._validate_priority(priority)
+                tenant = self._validate_tenant(tenant)
+                engine = self.generators.get(name)
+                if engine is None:
+                    raise ModelNotFoundError(f"no generator named '{name}'")
+                if self._draining or not self._started:
+                    raise NotReadyError("server is draining"
+                                        if self._draining
+                                        else "server not started")
+                if not isinstance(payload, dict) or "prompt" not in payload:
+                    raise BadRequestError(
+                        'body must be {"prompt": [ids...]}')
+                mnt = payload.get("max_new_tokens")
+                if mnt is not None and (isinstance(mnt, bool)
+                                        or not isinstance(mnt, int)):
+                    raise BadRequestError(
+                        "max_new_tokens must be an integer")
+                temp = payload.get("temperature")
+                if temp is not None and (
+                        isinstance(temp, bool)
+                        or not isinstance(temp, (int, float))):
+                    raise BadRequestError("temperature must be a number")
+                eos = payload.get("eos_id")
+                if eos is not None and (isinstance(eos, bool)
+                                        or not isinstance(eos, int)):
+                    raise BadRequestError("eos_id must be an integer")
+                stream_mode = payload.get("stream", True)
+                # every validation — deadline included — happens BEFORE
+                # submit: a 400 must never leave an orphaned stream
+                # decoding tokens nobody will read. The deadline
+                # semantics match predict: default_deadline_ms when
+                # absent, clamped at max_deadline_ms — and they bound
+                # STREAMING responses too (the stream ends with a
+                # terminal DEADLINE_EXCEEDED line)
+                timeout = self.admission.timeout_s(
+                    payload.get("deadline_ms"))
+                record_event("generation.request", model=name,
+                             priority=prio, correlation_id=cid,
+                             stream=bool(stream_mode))
+                if self.reqlog is not None:
+                    # BEFORE submit: the scheduler may finish (preempt,
+                    # fail) the stream the instant it exists, and the
+                    # deadline must already be on the record for the
+                    # finish path's deadline-slack computation
+                    self.reqlog.annotate(cid, deadline_s=timeout)
+                handle = engine.submit(
+                    payload["prompt"], max_new_tokens=mnt,
+                    temperature=temp, eos_id=eos, priority=prio,
+                    tenant=tenant, correlation_id=cid,
+                    parent_span_id=(gen_span.span_id
+                                    if gen_span is not None
+                                    else parent_span_id))
             if stream_mode:
                 handle._wire_timeout = timeout
                 return 200, None, handle
@@ -864,12 +1007,25 @@ class ModelServer:
         except ServingError as e:
             if handle is not None:
                 handle.cancel()  # idempotent; no-op on a finished stream
-            return e.http_status, e.to_json(), None
+            status, body = e.http_status, e.to_json()
+            if handle is None and self.reqlog is not None:
+                # shed/rejected before any stream opened: finish the
+                # record here so the admission outcome is still
+                # answerable by correlation id (the engine never saw it)
+                reason = _SHED_REASONS.get(type(e))
+                self.reqlog.finish(
+                    cid, outcome=self._predict_outcome(status, body),
+                    status=status,
+                    admission=(f"shed:{reason}" if reason is not None
+                               else None))
+            return status, body, None
         except Exception as e:  # noqa: BLE001 — surface, never crash
             if handle is not None:
                 handle.cancel()
             record_event("generation.error", model=name,
                          error=str(e)[:200])
+            if handle is None and self.reqlog is not None:
+                self.reqlog.finish(cid, outcome="error", status=500)
             return 500, {"error": {"code": "INTERNAL",
                                    "message": str(e)[:300],
                                    "retryable": False}}, None
@@ -974,6 +1130,26 @@ class ModelServer:
                 out.append({"model": e.name, "available": False,
                             "reason": str(exc)[:200]})
         return {"models": out}
+
+    def render_requests(self, *, outcome=None, tenant=None, model=None,
+                        plane=None, min_latency_ms=None,
+                        limit: int = 100) -> dict:
+        """The request-ledger list view (newest first, filtered)."""
+        ledger = self.reqlog
+        if ledger is None:
+            return {"ledger": None, "count": 0, "records": []}
+        records = ledger.query(
+            outcome=outcome, tenant=tenant, model=model, plane=plane,
+            min_latency_s=(min_latency_ms / 1000.0
+                           if min_latency_ms is not None else None),
+            limit=limit)
+        return {"ledger": ledger.describe(), "count": len(records),
+                "records": records}
+
+    def render_request(self, cid: str) -> Optional[dict]:
+        """One request by correlation id: ledger record + retained span
+        tree (Chrome-format included); None when unknown."""
+        return _reqlog.request_detail(cid)
 
     def render_incidents(self) -> dict:
         """The incident-bundle index + current detector verdicts (the

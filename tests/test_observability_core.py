@@ -361,6 +361,11 @@ class TestSpans:
             input_spec=spec((4,)), mode="batched", max_batch_size=8)
         server = ModelServer(registry, port=0).start(warm=True)
         try:
+            # the request ledger tail-samples span retention (PR 12);
+            # this test is about tree SHAPE, so force every request kept
+            # instead of depending on the process-global 1-in-N counter
+            server.reqlog.sampler.policy = tr.RetentionPolicy(
+                sample_every=1)
             client = ServingClient(server.url)
             cid = tr.new_id()
             client.predict("scale", np.ones((2, 4), np.float32),

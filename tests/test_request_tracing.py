@@ -1,0 +1,600 @@
+"""Tail-sampled request tracing + the queryable request ledger (PR 12):
+the retention-policy unit matrix (error/preempt/slow kept, fast-ok
+dropped, deterministic 1-in-N), tail-sampler staging bounds, ledger
+lifecycle/query semantics, the strict-grammar ``/debug/requests`` JSON
+surface, correlation-id exemplars on the ``generation_*`` histograms,
+and THE e2e acceptance: under mixed load a preempted generation request
+is retrievable by correlation id at ``/debug/requests/<id>`` with its
+full span tree (prefill + decode-step + preempt legs) AND through the
+federated ``/cluster/debug/requests/<id>`` path, while a fast
+successful request has a ledger record but no retained trace.
+
+Budget discipline (the PR 6/7 pattern): ONE tiny GPT engine compiled
+per module and shared by every HTTP test; retention decisions are made
+deterministic by swapping the policy, never by sleeping.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.gpt import gpt_tiny
+from deeplearning4j_tpu.observability import reqlog as rl
+from deeplearning4j_tpu.observability import slo
+from deeplearning4j_tpu.observability import trace as tr
+from deeplearning4j_tpu.observability.federation import (
+    ClusterAggregator,
+    ClusterTelemetryServer,
+    TelemetryExporter,
+)
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+)
+from deeplearning4j_tpu.serving import (
+    GenerationEngine,
+    ModelServer,
+    OverloadPolicy,
+    ServingClient,
+    SlotPreemptedError,
+)
+
+# ---------------------------------------------------------------------------
+# shared model + engine + server (compiled once per module)
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    model = gpt_tiny()
+    return model, model.init(seed=0)
+
+
+@pytest.fixture(scope="module")
+def server(gpt_model):
+    model, variables = gpt_model
+    eng = GenerationEngine(
+        model, variables, name="gpt", num_slots=2, max_len=32,
+        max_new_tokens=24, min_kv_bucket=8, min_prompt_bucket=8,
+        idle_wait_s=0.002, temperature=0.0, max_waiting=16, seed=0)
+    policy = OverloadPolicy(min_in_flight=2, max_in_flight=8,
+                            interval_s=60.0)
+    srv = ModelServer(port=0, sentinel=False, overload=policy,
+                      generators={"gpt": eng})
+    srv.start(warm=True)
+    yield srv
+    srv.stop()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _no_sampling(server):
+    """Make the shared server's retention deterministic: nothing kept
+    unless its outcome/latency demands it (the 1-in-N counter of the
+    process-global policy is position-dependent across the suite). The
+    n=0 deterministic sample is burned here — a fresh policy keeps its
+    very first completion by design."""
+    policy = tr.RetentionPolicy(sample_every=10 ** 9, min_history=10 ** 6)
+    policy.decide(outcome="ok", latency_s=0.0)
+    server.reqlog.sampler.policy = policy
+    return policy
+
+
+# ---------------------------------------------------------------------------
+# retention policy: the unit matrix
+
+
+class TestRetentionPolicy:
+    def test_bad_outcomes_always_kept_with_their_reason(self):
+        p = tr.RetentionPolicy(sample_every=10 ** 9)
+        for outcome in ("error", "failed", "shed", "preempted", "deadline"):
+            assert p.decide(outcome=outcome, latency_s=0.001) == outcome
+
+    def test_fast_ok_dropped_and_deterministic_1_in_n(self):
+        p = tr.RetentionPolicy(sample_every=4, min_history=10 ** 6)
+        decisions = [p.decide(outcome="ok", latency_s=0.01)
+                     for _ in range(9)]
+        assert decisions == ["sampled", None, None, None,
+                             "sampled", None, None, None, "sampled"]
+
+    def test_cancelled_is_not_a_keep_outcome(self):
+        p = tr.RetentionPolicy(sample_every=10 ** 9, min_history=10 ** 6)
+        p.decide(outcome="ok", latency_s=0.01)  # consume the n=0 sample
+        assert p.decide(outcome="cancelled", latency_s=0.01) is None
+
+    def test_slow_kept_against_rolling_baseline_and_never_taught(self):
+        p = tr.RetentionPolicy(sample_every=10 ** 9, slow_score=8.0,
+                               min_history=16)
+        p.decide(outcome="ok", latency_s=0.01)  # burn the n=0 sample
+        for _ in range(20):  # teach a ~10 ms "normal"
+            assert p.decide(outcome="ok", latency_s=0.01) is None
+        for _ in range(3):  # a sustained 100x straggler is kept...
+            assert p.decide(outcome="ok", latency_s=1.0) == "slow"
+        # ...and never taught into the baseline (frozen-anomaly
+        # discipline): normal traffic still reads as normal after it
+        assert p.decide(outcome="ok", latency_s=0.011) is None
+        assert p.describe()["baseline"]["median"] < 0.1
+
+    def test_no_judgement_before_min_history(self):
+        p = tr.RetentionPolicy(sample_every=10 ** 9, min_history=16)
+        p.decide(outcome="ok", latency_s=0.01)
+        # far too little history for "slow": a big latency drops
+        assert p.decide(outcome="ok", latency_s=5.0) is None
+
+    def test_custom_keep_outcomes(self):
+        p = tr.RetentionPolicy(sample_every=10 ** 9,
+                               keep_outcomes=("weird",))
+        p.decide(outcome="ok", latency_s=0.01)
+        assert p.decide(outcome="weird") == "weird"
+        assert p.decide(outcome="error", latency_s=0.01) is None
+
+
+# ---------------------------------------------------------------------------
+# tail sampler staging
+
+
+class TestTailSampler:
+    def _sampler(self, **kw):
+        policy = tr.RetentionPolicy(sample_every=10 ** 9,
+                                    min_history=10 ** 6)
+        policy.decide(outcome="ok", latency_s=0.0)  # burn the n=0 sample
+        kw.setdefault("policy", policy)
+        return tr.TailSampler(**kw)
+
+    def test_kept_request_promotes_staged_spans_to_ring(self):
+        ring = tr.Tracer()
+        ts = self._sampler()
+        cid = tr.new_id()
+        ts.begin(cid)
+        s = tr.Span("leg", trace_id=cid, span_id=tr.new_id())
+        assert ts.offer(s)  # staged, not recorded
+        assert ring.spans(cid) == []
+        reason, n = ts.finish(cid, outcome="error", latency_s=0.1,
+                              tracer=ring)
+        assert reason == "error" and n == 1
+        assert [x.name for x in ring.spans(cid)] == ["leg"]
+
+    def test_dropped_request_leaves_no_spans(self):
+        ring = tr.Tracer()
+        ts = self._sampler()
+        cid = tr.new_id()
+        ts.begin(cid)
+        ts.offer(tr.Span("leg", trace_id=cid, span_id=tr.new_id()))
+        reason, n = ts.finish(cid, outcome="ok", latency_s=0.001,
+                              tracer=ring)
+        assert reason is None and n == 1
+        assert ring.spans(cid) == []
+
+    def test_unstaged_trace_ids_are_not_consumed(self):
+        ts = self._sampler()
+        assert not ts.offer(tr.Span("x", trace_id=tr.new_id(),
+                                    span_id=tr.new_id()))
+
+    def test_late_spans_of_dropped_requests_are_swallowed(self):
+        # a span closing AFTER the drop decision (the in-process
+        # client's span, a worker's post-hoc leg) must not leak into
+        # the ring the retention just kept clean — but a NEW request
+        # reusing the id (a retry) stages fresh
+        ts = self._sampler()
+        cid = tr.new_id()
+        ts.begin(cid)
+        ts.finish(cid, outcome="ok", latency_s=0.001)  # dropped
+        late = tr.Span("late", trace_id=cid, span_id=tr.new_id())
+        assert ts.offer(late)  # consumed, never recorded
+        ts.begin(cid)
+        assert ts.offer(tr.Span("fresh", trace_id=cid,
+                                span_id=tr.new_id()))
+        ring = tr.Tracer()
+        reason, n = ts.finish(cid, outcome="error", tracer=ring)
+        assert reason == "error" and n == 1
+        assert [s.name for s in ring.spans(cid)] == ["fresh"]
+
+    def test_staging_bounds_requests_and_spans(self):
+        ts = self._sampler(max_staged=2, max_spans_per_request=3)
+        a, b, c = tr.new_id(), tr.new_id(), tr.new_id()
+        ts.begin(a)
+        ts.begin(b)
+        ts.begin(c)  # evicts a (oldest) — never finished, never decided
+        assert not ts.watching(a) and ts.watching(c)
+        assert ts.staging_evictions == 1
+        for _ in range(5):
+            ts.offer(tr.Span("s", trace_id=b, span_id=tr.new_id()))
+        assert ts.span_overflows == 2
+        ring = tr.Tracer()
+        _, n = ts.finish(b, outcome="error", tracer=ring)
+        assert n == 3 and len(ring.spans(b)) == 3
+
+    def test_explicit_tracer_bypasses_staging(self):
+        ts = self._sampler()
+        old = tr.get_tail_sampler()
+        tr.set_tail_sampler(ts)
+        try:
+            cid = tr.new_id()
+            ts.begin(cid)
+            ring = tr.Tracer()
+            tr.record_span("private", start=0.0, end=1.0, trace_id=cid,
+                           tracer=ring)
+            assert len(ring.spans(cid)) == 1  # went to the private ring
+            _, n = ts.finish(cid, outcome="error")
+            assert n == 0  # nothing was staged
+        finally:
+            tr.set_tail_sampler(old)
+
+
+# ---------------------------------------------------------------------------
+# request ledger
+
+
+class TestRequestLedger:
+    def _ledger(self, capacity=8):
+        sampler = tr.TailSampler(policy=tr.RetentionPolicy(
+            sample_every=10 ** 9, min_history=10 ** 6))
+        return rl.RequestLedger(capacity, sampler=sampler)
+
+    def test_lifecycle_fields_and_deadline_slack(self):
+        led = self._ledger()
+        cid = tr.new_id()
+        led.begin(cid, plane="predict", model="m", priority="critical",
+                  tenant="t")
+        led.annotate(cid, admission="admitted", deadline_s=2.0,
+                     batch_rows=2, batch_bucket=4)
+        rec = led.finish(cid, outcome="ok", status=200, version="v1")
+        assert rec["state"] == "done" and rec["outcome"] == "ok"
+        assert rec["priority"] == "critical" and rec["tenant"] == "t"
+        assert rec["admission"] == "admitted"
+        assert rec["batch_rows"] == 2 and rec["batch_bucket"] == 4
+        assert 0 < rec["deadline_slack_s"] <= 2.0
+        assert led.get(cid)["version"] == "v1"
+        # double-finish is a no-op (the record is closed)
+        assert led.finish(cid, outcome="error") is None
+
+    def test_begin_merges_open_record_and_retry_gets_a_fresh_one(self):
+        led = self._ledger()
+        cid = tr.new_id()
+        led.begin(cid, plane="generation", model="m")
+        led.begin(cid, plane="generation", model="m",
+                  priority="batch", admission="admitted")
+        assert len(led) == 1  # merged, not duplicated
+        assert led.get(cid)["priority"] == "batch"
+        led.finish(cid, outcome="preempted", status=503)
+        led.begin(cid, plane="generation", model="m")  # the retry's pass
+        assert len(led) == 2
+        assert led.get(cid)["state"] == "open"
+
+    def test_eviction_is_bounded_and_unindexes(self):
+        led = self._ledger(capacity=3)
+        cids = [tr.new_id() for _ in range(5)]
+        for cid in cids:
+            led.begin(cid, plane="predict", model="m")
+            led.finish(cid, outcome="ok")
+        assert len(led) == 3
+        assert led.get(cids[0]) is None and led.get(cids[-1]) is not None
+
+    def test_query_filters(self):
+        led = self._ledger(capacity=16)
+        for i in range(4):
+            cid = tr.new_id()
+            led.begin(cid, plane="predict",
+                      model="a" if i % 2 == 0 else "b",
+                      tenant="t1" if i < 2 else "t2")
+            led.finish(cid, outcome="ok" if i < 3 else "shed")
+        assert len(led.query(outcome="shed")) == 1
+        assert len(led.query(model="a")) == 2
+        assert len(led.query(tenant="t2")) == 2
+        assert len(led.query(limit=2)) == 2
+        assert led.query(min_latency_s=10.0) == []
+        # an OPEN straggler matches min-latency by its age
+        slow = tr.new_id()
+        led.begin(slow, plane="predict", model="a")
+        led._index[slow]["t_start"] -= 60.0
+        hits = led.query(min_latency_s=30.0)
+        assert [r["cid"] for r in hits] == [slow]
+
+    def test_kill_switch_makes_the_plane_a_noop(self):
+        led = self._ledger()
+        rl.set_ledger_enabled(False)
+        try:
+            cid = tr.new_id()
+            assert led.begin(cid, plane="predict", model="m") is None
+            assert led.finish(cid, outcome="ok") is None
+            assert len(led) == 0
+        finally:
+            rl.set_ledger_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# the /debug/requests JSON surface (strict grammar) + predict-plane records
+
+
+RECORD_REQUIRED = {"cid": str, "plane": str, "model": str, "state": str,
+                   "t_start": float, "outcome": (str, type(None)),
+                   "trace_retained": (str, type(None))}
+
+
+def _check_record_grammar(rec):
+    for key, typ in RECORD_REQUIRED.items():
+        assert key in rec, f"record missing {key}: {sorted(rec)}"
+        assert isinstance(rec[key], typ), (key, rec[key])
+    if rec["state"] == "done":
+        assert isinstance(rec["latency_s"], float)
+        assert isinstance(rec["t_end"], float)
+        assert rec["t_end"] >= rec["t_start"]
+
+
+class TestDebugRequestsSurface:
+    def test_list_grammar_and_filters(self, server):
+        _no_sampling(server)
+        client = ServingClient(server.url)
+        cid = tr.new_id()
+        toks = list(client.generate("gpt", [5, 9, 2], max_new_tokens=3,
+                                    correlation_id=cid))
+        assert len(toks) == 3
+        status, body = _get(f"{server.url}/debug/requests")
+        assert status == 200
+        assert set(body) == {"ledger", "count", "records"}
+        assert set(body["ledger"]) == {"capacity", "records", "open",
+                                       "staged"}
+        assert body["count"] == len(body["records"]) >= 1
+        for rec in body["records"]:
+            _check_record_grammar(rec)
+        status, body = _get(
+            f"{server.url}/debug/requests?outcome=ok&model=gpt&limit=5")
+        assert status == 200 and body["count"] >= 1
+        assert all(r["outcome"] == "ok" and r["model"] == "gpt"
+                   for r in body["records"])
+        status, body = _get(
+            f"{server.url}/debug/requests?min_latency_ms=bogus")
+        assert status == 400
+        status, body = _get(
+            f"{server.url}/debug/requests?min_latency_ms=1e9")
+        assert status == 200 and body["count"] == 0
+
+    def test_detail_grammar_404_and_fast_ok_has_no_trace(self, server):
+        _no_sampling(server)
+        client = ServingClient(server.url)
+        cid = tr.new_id()
+        list(client.generate("gpt", [1, 2], max_new_tokens=2,
+                             correlation_id=cid))
+        status, body = _get(f"{server.url}/debug/requests/{cid}")
+        assert status == 200
+        assert set(body) == {"record", "trace"}
+        _check_record_grammar(body["record"])
+        assert body["record"]["cid"] == cid
+        assert body["record"]["outcome"] == "ok"
+        assert body["record"]["tokens"] == 2
+        assert body["record"]["ttft_s"] > 0
+        assert body["record"]["admission"] == "admitted"
+        # a fast successful request has a LEDGER record but NO retained
+        # trace — the whole point of tail sampling
+        t = body["trace"]
+        assert set(t) == {"retained", "reason", "span_count", "spans",
+                          "chrome"}
+        assert t["retained"] is False and t["reason"] is None
+        assert t["spans"] == [] and t["chrome"] is None
+        status, _ = _get(f"{server.url}/debug/requests/{tr.new_id()}")
+        assert status == 404
+
+    def test_shed_and_reject_get_ledger_records(self, server):
+        _no_sampling(server)
+        # unknown generator: a one-shot "rejected" record
+        cid = tr.new_id()
+        status, body, _ = server.handle_generate(
+            "nope", {"prompt": [1]}, correlation_id=cid)
+        assert status == 404
+        rec = server.reqlog.get(cid)
+        assert rec["outcome"] == "rejected" and rec["status"] == 404
+        # predict against an unregistered model: same contract on the
+        # predict plane (begin at the route top, finish with the reject)
+        cid2 = tr.new_id()
+        status, _ = server.handle_predict("ghost", {"inputs": [1]},
+                                          correlation_id=cid2)
+        assert status == 404
+        rec2 = server.reqlog.get(cid2)
+        assert rec2["plane"] == "predict"
+        assert rec2["outcome"] == "rejected"
+        # a brownout batch shed carries the admission reason
+        server.overload.shed_batch = True
+        try:
+            cid3 = tr.new_id()
+            status, body, _ = server.handle_generate(
+                "gpt", {"prompt": [1]}, correlation_id=cid3,
+                priority="batch")
+            assert status == 429
+            rec3 = server.reqlog.get(cid3)
+            assert rec3["outcome"] == "shed"
+            assert rec3["admission"] == "shed:queue_full"
+            # sheds are keep-outcomes: the serving.generate span tree
+            # of the shed request was retained
+            assert rec3["trace_retained"] == "shed"
+            spans = tr.get_tracer().spans(trace_id=cid3)
+            assert any(s.name == "serving.generate" for s in spans)
+        finally:
+            server.overload.shed_batch = False
+
+
+# ---------------------------------------------------------------------------
+# exemplars + slo vocabulary (satellites)
+
+
+class TestExemplarsAndVocabulary:
+    def test_generation_histograms_carry_exemplars_when_negotiated(
+            self, server):
+        _no_sampling(server)
+        client = ServingClient(server.url)
+        cid = tr.new_id()
+        list(client.generate("gpt", [3, 4], max_new_tokens=2,
+                             correlation_id=cid))
+        om_text = server.render_metrics_text(openmetrics=True)
+        ttft_buckets = [ln for ln in om_text.splitlines()
+                        if ln.startswith("generation_ttft_seconds_bucket")
+                        and "# {" in ln]
+        lat_buckets = [ln for ln in om_text.splitlines()
+                       if ln.startswith("generation_latency_seconds_bucket")
+                       and "# {" in ln]
+        assert ttft_buckets and lat_buckets
+        assert any(f'trace_id="{cid}"' in ln
+                   for ln in ttft_buckets + lat_buckets)
+        # the classic rendering never carries exemplars (a classic
+        # parser errors on the mid-line '#')
+        classic = server.render_metrics_text()
+        assert not any("# {" in ln for ln in classic.splitlines()
+                       if ln.startswith("generation_"))
+
+    def test_reqlog_families_in_slo_vocabulary(self):
+        known = slo.known_metric_names()
+        for name in ("reqlog_records_total", "reqlog_evictions_total",
+                     "reqlog_open_requests", "reqlog_trace_dropped_total",
+                     "trace_retained_total", "trace_retained_spans_total",
+                     "generation_latency_seconds"):
+            assert name in known, name
+
+
+# ---------------------------------------------------------------------------
+# THE e2e acceptance: mixed load, preempted request retrievable by id
+# locally AND through the federated path; fast request leaves no trace
+
+
+class TestEndToEndAcceptance:
+    def test_preempted_request_full_story_by_correlation_id(self, server):
+        _no_sampling(server)
+        engine = server.generators["gpt"]
+        batch_cids = [tr.new_id() for _ in range(engine.num_slots)]
+        errors = {}
+        lock = threading.Lock()
+
+        def batch_run(i):
+            client = ServingClient(server.url)
+            try:
+                # long streams hold every decode slot until preempted
+                list(client.generate("gpt", [1 + i, 2],
+                                     max_new_tokens=24,
+                                     priority="batch",
+                                     correlation_id=batch_cids[i]))
+            except SlotPreemptedError as e:
+                with lock:
+                    errors[i] = e
+
+        threads = [threading.Thread(target=batch_run, args=(i,))
+                   for i in range(engine.num_slots)]
+        for t in threads:
+            t.start()
+        # wait until every slot is held and a few decode steps ran (so
+        # the victim has decode-step span legs before the preemption)
+        deadline = time.monotonic() + 10.0
+        steps0 = engine.steps
+        while time.monotonic() < deadline:
+            if engine.describe()["active"] == engine.num_slots \
+                    and engine.steps >= steps0 + 3:
+                break
+            time.sleep(0.002)
+        crit_cid = tr.new_id()
+        crit_client = ServingClient(server.url)
+        r = crit_client.generate_tokens("gpt", [7], max_new_tokens=2,
+                                        priority="critical",
+                                        correlation_id=crit_cid)
+        assert r["n_tokens"] == 2
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "batch client hung"
+        assert errors, "no batch stream was preempted"
+        victim_cid = batch_cids[sorted(errors)[0]]
+
+        # -- the local story: ledger record + full span tree ------------
+        status, body = _get(f"{server.url}/debug/requests/{victim_cid}")
+        assert status == 200
+        rec = body["record"]
+        assert rec["outcome"] == "preempted"
+        assert rec["trace_retained"] == "preempted"
+        assert rec["preemptions"] == 1
+        assert rec["tokens"] >= 1 and rec["slot"] is not None
+        assert rec["queue_wait_s"] is not None
+        trace_doc = body["trace"]
+        assert trace_doc["retained"] is True
+        names = {s["name"] for s in trace_doc["spans"]}
+        assert {"generation.request", "generation.prefill",
+                "generation.decode_step",
+                "generation.preempt"} <= names, names
+        # the tree is rooted: every leg parents to generation.request
+        root = next(s for s in trace_doc["spans"]
+                    if s["name"] == "generation.request")
+        legs = [s for s in trace_doc["spans"]
+                if s["name"].startswith("generation.")
+                and s["name"] != "generation.request"]
+        assert legs and all(s["parent_id"] == root["span_id"]
+                            for s in legs)
+        # Chrome-format twin round-trips losslessly
+        back = tr.from_chrome_trace(trace_doc["chrome"])
+        assert {s.name for s in back} == names
+        # the flight timeline's preempt event carries the correlation id
+        evs = [e["data"] for e in get_flight_recorder().events(
+            kinds=["generation.preempt"])]
+        assert any(e.get("correlation_id") == victim_cid for e in evs)
+        # /debug/requests?outcome=preempted finds it too
+        status, listing = _get(
+            f"{server.url}/debug/requests?outcome=preempted")
+        assert any(r["cid"] == victim_cid for r in listing["records"])
+
+        # -- the fast successful request: record, no retained trace -----
+        status, body = _get(f"{server.url}/debug/requests/{crit_cid}")
+        assert status == 200
+        assert body["record"]["outcome"] == "ok"
+        assert body["record"]["trace_retained"] is None
+        assert body["trace"]["retained"] is False
+
+        # -- the federated story: found on the worker that served it ----
+        exporter = TelemetryExporter(port=0)
+        exporter.start()
+        try:
+            assert exporter.mode == "http"
+            agg = ClusterAggregator(num_workers=1,
+                                    port_base=exporter.port)
+            agg.poll()
+            cluster_srv = ClusterTelemetryServer(agg)
+            cluster_srv.start()
+            try:
+                status, doc = _get(
+                    f"{cluster_srv.url}/cluster/debug/requests/"
+                    f"{victim_cid}")
+                assert status == 200
+                assert doc["worker"] == 0
+                assert doc["record"]["outcome"] == "preempted"
+                fed_names = {s["name"] for s in doc["trace"]["spans"]}
+                assert {"generation.request", "generation.prefill",
+                        "generation.preempt"} <= fed_names
+                status, listing = _get(
+                    f"{cluster_srv.url}/cluster/debug/requests"
+                    "?outcome=preempted")
+                assert status == 200
+                assert any(r["cid"] == victim_cid
+                           for r in listing["requests"])
+                status, _ = _get(
+                    f"{cluster_srv.url}/cluster/debug/requests/"
+                    f"{tr.new_id()}")
+                assert status == 404
+            finally:
+                cluster_srv.stop()
+        finally:
+            exporter.stop()
+
+    def test_reqlog_metrics_count_the_plane(self, server):
+        _no_sampling(server)
+        m = rl.get_reqlog_metrics()
+        kept0 = m.trace_retained_total.value(reason="preempted")
+        ok0 = m.records_total.value(plane="generation", outcome="ok")
+        dropped0 = m.trace_dropped_total.value()
+        client = ServingClient(server.url)
+        list(client.generate("gpt", [9], max_new_tokens=2))
+        assert m.records_total.value(plane="generation",
+                                     outcome="ok") == ok0 + 1
+        assert m.trace_dropped_total.value() == dropped0 + 1
+        assert m.trace_retained_total.value(reason="preempted") == kept0
+        assert kept0 >= 1  # the acceptance test's victim counted

@@ -1,6 +1,6 @@
 """Performance anomaly sentinel tests: rolling baselines + probes,
 detector hysteresis (ok → suspect → firing → ok), the always-on host
-stack sampler, incident bundles (six artifact kinds, retention,
+stack sampler, incident bundles (seven artifact kinds, retention,
 open/close lifecycle), OpenMetrics exemplars, the /debug/profile 409
 retry hint, the federated /cluster/debug/incidents view, and THE
 end-to-end acceptance story: injected serving.latency faults drive the
@@ -633,7 +633,8 @@ class TestHostSampler:
 # incident bundles
 
 SYNC_ARTIFACTS = ["verdict.json", "metrics.prom", "metrics.json",
-                  "flightrecorder.json", "spans.json", "flames.txt"]
+                  "flightrecorder.json", "spans.json", "requests.json",
+                  "flames.txt"]
 
 
 def _verdict(detector="test_det", **kw):
@@ -645,7 +646,7 @@ def _verdict(detector="test_det", **kw):
 
 
 class TestIncidentManager:
-    def test_bundle_contains_all_six_artifact_kinds(self, tmp_path):
+    def test_bundle_contains_all_sync_artifact_kinds(self, tmp_path):
         reg = om.MetricsRegistry()
         reg.counter("probe_total", "t").inc(3)
         fr.record_event("test.breadcrumb", detail="pre-incident")
