@@ -199,8 +199,13 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     from deeplearning4j_tpu.observability.reqlog import ReqLogMetrics
     from deeplearning4j_tpu.observability.sentinel import SentinelMetrics
     from deeplearning4j_tpu.serving.metrics import ServingMetrics
+    from deeplearning4j_tpu.serving.router import RouterMetrics
 
     ServingMetrics(reg)
+    # the fleet-router router_* families (serving/router.py): the
+    # router-availability / retry-budget burn-rate rules validate
+    # offline like every other plane's
+    RouterMetrics(reg)
     # the supervisor-side cluster_* families (federation aggregator):
     # rule files over the federated registry validate offline too
     ClusterMetrics(reg)

@@ -44,6 +44,20 @@ Named injection points wired through the codebase:
                             ruled permanently dead, driving the
                             shrink-to-survivors path without a real crash
                             loop (``at=N`` = the N-th cohort failure)
+``router.backend_down``     fires in the FLEET ROUTER's send path (requests
+                            AND health probes both trigger it): the chosen
+                            backend is refused with a synthetic connection
+                            failure. ``arg`` selects the victim — the
+                            backend's table index, or ``-1`` for whichever
+                            backend was chosen. Armed with ``xTIMES`` it
+                            holds a backend "down" long enough to drive
+                            ejection / retry-elsewhere / re-admission in
+                            chaos tests and the bench MTTR probe without
+                            killing a real process
+``router.backend_latency``  sleeps ``arg`` seconds in the router's forward
+                            path before the backend send (slow-backend /
+                            congested-link chaos; drives retry-budget and
+                            p99 tests)
 ==========================  =====================================================
 
 Plans are deterministic: ``at=N`` fires on the N-th trigger of the point
@@ -84,6 +98,8 @@ POINT_COLLECTIVE_STALL = "collective.stall"
 POINT_SERVING_WORKER_CRASH = "serving.worker_crash"
 POINT_TRAIN_WORKER_KILL = "train.worker_kill"
 POINT_SUPERVISOR_SLOT_DEAD = "supervisor.slot_dead"
+POINT_ROUTER_BACKEND_DOWN = "router.backend_down"
+POINT_ROUTER_BACKEND_LATENCY = "router.backend_latency"
 
 KNOWN_POINTS = (
     POINT_DATA_READ,
@@ -97,6 +113,8 @@ KNOWN_POINTS = (
     POINT_SERVING_WORKER_CRASH,
     POINT_TRAIN_WORKER_KILL,
     POINT_SUPERVISOR_SLOT_DEAD,
+    POINT_ROUTER_BACKEND_DOWN,
+    POINT_ROUTER_BACKEND_LATENCY,
 )
 
 
@@ -153,6 +171,16 @@ class FaultInjector:
         firing — e.g. the collective watchdog's worker-thread hop — gate
         on this instead of paying the hop for unrelated plans)."""
         return point in self._plans
+
+    def plans_for(self, point: str) -> List[FaultPlan]:
+        """Snapshot of the plans installed for ``point``. For callers
+        with target-selective semantics (the fleet router's
+        ``router.backend_down`` encodes its victim in ``arg``): they
+        must inspect plan args BEFORE consuming a firing, or a finite
+        ``times=N`` plan aimed at one target gets silently drained by
+        triggers the plan was never meant to hit."""
+        with self._lock:
+            return list(self._plans.get(point, ()))
 
     def plan(self, point: str, *, at: Optional[int] = None, prob: float = 0.0,
              times: int = 1, arg: float = 0.0,

@@ -39,6 +39,14 @@ REST serving story, grown into a first-class subsystem).
   in-flight limit (p99-vs-rolling-baseline, sentinel machinery), and a
   brownout degradation ladder (shrink batch wait → shed batch class →
   hot-swap fallback versions) with hysteresis.
+- router: the fleet tier — FleetRouter in front of N ModelServers:
+  health-gated routing (active /readyz probes + passive consecutive-
+  failure ejection through the circuit state machine, half-open
+  re-probe re-admission), least-loaded + consistent-hash-affinity
+  selection, retry-once-elsewhere failover under a fleet-wide retry
+  budget, rolling drain for deploys, router-level priority shed, and
+  fleet-federated /metrics, /debug/requests, /debug/incidents,
+  /debug/fleet.
 """
 
 from deeplearning4j_tpu.serving.admission import (
@@ -50,6 +58,7 @@ from deeplearning4j_tpu.serving.client import ServingClient
 from deeplearning4j_tpu.serving.errors import (
     BadRequestError,
     CircuitOpenError,
+    ConnectionFailedError,
     DeadlineExceededError,
     DeadlineExpiredError,
     ModelNotFoundError,
@@ -82,6 +91,13 @@ from deeplearning4j_tpu.serving.overload import (
     TenantQuotas,
 )
 from deeplearning4j_tpu.serving.registry import ModelEntry, ModelRegistry
+from deeplearning4j_tpu.serving.router import (
+    FleetRouter,
+    HashRing,
+    RetryBudget,
+    RouterMetrics,
+    RouterPolicy,
+)
 from deeplearning4j_tpu.serving.server import ModelServer
 from deeplearning4j_tpu.serving.warmup import (
     bucket_sizes,
@@ -99,12 +115,15 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "CircuitPolicy",
+    "ConnectionFailedError",
     "Counter",
     "DeadlineExceededError",
     "DeadlineExpiredError",
+    "FleetRouter",
     "Gauge",
     "GenerationEngine",
     "GenerationStream",
+    "HashRing",
     "Histogram",
     "MetricsRegistry",
     "ModelEntry",
@@ -116,6 +135,9 @@ __all__ = [
     "OverloadPolicy",
     "PRIORITIES",
     "QueueFullError",
+    "RetryBudget",
+    "RouterMetrics",
+    "RouterPolicy",
     "ServingClient",
     "ServingError",
     "ServingMetrics",

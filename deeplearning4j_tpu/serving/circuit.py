@@ -181,6 +181,24 @@ class CircuitBreaker:
                 self._transition(STATE_OPEN)
                 self._open_until = now + self.policy.open_duration_s
 
+    def trip(self, duration_s: Optional[float] = None) -> None:
+        """Force the circuit OPEN now, regardless of the windowed rate.
+
+        The escape hatch for callers with their own ejection policy on
+        top of the window — the fleet router trips a backend's breaker
+        after N *consecutive* connect/probe failures (a dead process
+        fails fast and often, but a long healthy history would keep the
+        windowed rate below threshold for the whole window). The normal
+        open → half_open → closed re-probe lifecycle takes over from
+        here; an already-open circuit just has its open period extended.
+        ``duration_s`` overrides the policy's ``open_duration_s``."""
+        with self._lock:
+            if self._state != STATE_OPEN:
+                self._transition(STATE_OPEN)
+            self._open_until = self._clock() + (
+                duration_s if duration_s is not None
+                else self.policy.open_duration_s)
+
     def record_neutral(self, token: Optional[int] = None) -> None:
         """Report an allowed request whose outcome says nothing about
         model health (bad input, shed downstream): returns the probe

@@ -12,10 +12,20 @@ body, or the integer-seconds header) when it asks for a longer wait
 than the local schedule. Non-retryable failures (400/404/504/500)
 always surface immediately — a deadline that expired server-side
 would only expire again.
+
+Transport-level failures are typed too: connection refused, connection
+reset / remote hangup, and a truncated response body all raise the
+retryable :class:`ConnectionFailedError` instead of leaking raw
+``URLError``/``IncompleteRead`` — so client-side retry composes with
+the fleet router's retry-elsewhere failover AND with direct-to-backend
+deployments (a restarted server absorbs the retry). Timeouts are NOT
+mapped: a slow server is not a dead one, and retrying a still-running
+request would double its cost.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import random
 import time
@@ -28,12 +38,32 @@ import numpy as np
 from deeplearning4j_tpu.observability import trace as _trace
 from deeplearning4j_tpu.resilience.retry import backoff_delays
 from deeplearning4j_tpu.serving.errors import (
+    ConnectionFailedError,
     NotReadyError,
     QueueFullError,
     ServingError,
     TenantQuotaError,
     error_from_code,
 )
+
+
+def _raise_connection_failed(e: Exception) -> None:
+    """Map a transport-level failure to the typed retryable
+    :class:`ConnectionFailedError`, or re-raise ``e`` untouched.
+
+    Mapped: ``ConnectionError`` (refused / reset / aborted / broken
+    pipe, including ``http.client.RemoteDisconnected``) whether raw or
+    wrapped in ``urllib.error.URLError``, and
+    ``http.client.IncompleteRead`` (the peer died mid-body). NOT
+    mapped: timeouts (``socket.timeout`` reasons) and DNS/OS errors —
+    those are not evidence a *different* attempt would fare better."""
+    if isinstance(e, urllib.error.URLError) \
+            and isinstance(getattr(e, "reason", None), ConnectionError):
+        raise ConnectionFailedError(
+            f"connection failed: {e.reason}") from e
+    if isinstance(e, (ConnectionError, http.client.IncompleteRead)):
+        raise ConnectionFailedError(f"connection failed: {e}") from e
+    raise e
 
 
 def _jsonable(value):
@@ -106,6 +136,9 @@ class ServingClient:
                 return json.loads(r.read())
         except urllib.error.HTTPError as e:
             self._raise_typed(e)
+        except (urllib.error.URLError, ConnectionError,
+                http.client.IncompleteRead) as e:
+            _raise_connection_failed(e)
 
     def _request(self, path: str, payload: Optional[dict] = None,
                  headers: Optional[dict] = None) -> dict:
@@ -246,24 +279,46 @@ class ServingClient:
                 resp = urllib.request.urlopen(req, timeout=self.timeout)
             except urllib.error.HTTPError as e:
                 self._raise_typed(e)
+            except (urllib.error.URLError, ConnectionError,
+                    http.client.IncompleteRead) as e:
+                _raise_connection_failed(e)
 
         def _stream():
             with resp:
-                for line in resp:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    ev = json.loads(line)
-                    if "token" in ev:
-                        yield int(ev["token"])
-                    elif "error" in ev:
-                        err = ev["error"]
-                        raise error_from_code(
-                            err.get("code", "INTERNAL"),
-                            err.get("message", ""),
-                            retry_after_ms=err.get("retry_after_ms"))
-                    elif ev.get("done"):
-                        return
+                # A server dying mid-stream surfaces three ways, ALL of
+                # which must become the typed retryable error (tokens
+                # already yielded stand): a reset/IncompleteRead raise;
+                # a torn half-line (json fails); or — because the
+                # stdlib chunked reader SWALLOWS IncompleteRead on the
+                # readline path — a silent clean-looking EOF. A true
+                # clean end always carries a terminal done/error event,
+                # so anything else is a truncation.
+                try:
+                    for line in resp:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            ev = json.loads(line)
+                        except ValueError as e:
+                            raise ConnectionFailedError(
+                                "stream truncated mid-event: "
+                                f"{line[:80]!r}") from e
+                        if "token" in ev:
+                            yield int(ev["token"])
+                        elif "error" in ev:
+                            err = ev["error"]
+                            raise error_from_code(
+                                err.get("code", "INTERNAL"),
+                                err.get("message", ""),
+                                retry_after_ms=err.get("retry_after_ms"))
+                        elif ev.get("done"):
+                            return
+                except (ConnectionError, http.client.IncompleteRead) as e:
+                    _raise_connection_failed(e)
+                raise ConnectionFailedError(
+                    "stream ended without a terminal done/error event "
+                    "(server died mid-stream)")
 
         return _stream()
 

@@ -92,6 +92,24 @@ class TenantQuotaError(ServingError):
     retryable = True
 
 
+class ConnectionFailedError(ServingError):
+    """The server could not be reached at the transport level:
+    connection refused (process down, port closed), connection reset /
+    remote hangup mid-exchange (process killed), or a truncated
+    response body (``IncompleteRead``). Raised client-side by
+    :class:`ServingClient` — the server never sent it — and by the
+    fleet router when every failover attempt hit the same wall, so the
+    wire code exists for proxied deployments too. Retryable: these are
+    exactly the failures a different backend (or the same one after
+    restart) absorbs. NOTE a reset mid-read means the request may have
+    executed before the failure — predict is idempotent, so at-least-
+    once retry semantics are safe here."""
+
+    code = "CONNECTION_FAILED"
+    http_status = 503
+    retryable = True
+
+
 class DeadlineExceededError(ServingError):
     """The request's deadline elapsed before a result was produced."""
 

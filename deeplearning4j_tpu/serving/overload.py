@@ -76,10 +76,28 @@ from deeplearning4j_tpu.observability.sentinel import (
 from deeplearning4j_tpu.observability.slo import _doc_map
 
 # Priority classes, best first. The header value must be one of these
-# (validated in handle_predict); admission sheds lowest-class first.
+# (validated by validate_priority); admission sheds lowest-class first.
 PRIORITIES = ("critical", "normal", "batch")
 
 DEFAULT_CLASS_FRACTIONS = {"critical": 1.0, "normal": 0.9, "batch": 0.7}
+
+
+def validate_priority(priority) -> str:
+    """``X-Priority`` header value → a known class (default
+    ``normal``). Client-controlled input: anything outside the fixed
+    vocabulary is a 400, never a new metric label or a silent default.
+    The ONE validator — the per-server admission plane and the fleet
+    router must never disagree on the class vocabulary."""
+    if priority is None or priority == "":
+        return "normal"
+    p = str(priority).strip().lower()
+    if p not in PRIORITIES:
+        from deeplearning4j_tpu.serving.errors import BadRequestError
+
+        raise BadRequestError(
+            f"X-Priority must be one of {list(PRIORITIES)}, "
+            f"got {priority!r}")
+    return p
 
 
 @dataclasses.dataclass
@@ -617,6 +635,7 @@ class OverloadManager:
 __all__ = [
     "PRIORITIES",
     "DEFAULT_CLASS_FRACTIONS",
+    "validate_priority",
     "OverloadPolicy",
     "TenantQuotas",
     "BrownoutRung",

@@ -1,0 +1,1733 @@
+"""Fleet router: the data-plane tier in front of N ``ModelServer``s.
+
+Every robustness plane so far (circuits, overload, elastic supervision)
+stops at the process boundary; this module is the layer whose job is
+that **one crashed, saturated, or draining backend is invisible to
+clients** (ROADMAP item 5). Stdlib-HTTP, same style as
+``observability/federation.py``'s aggregator — no new dependencies.
+
+- **Backend table + health gating** — every backend carries a
+  :class:`~deeplearning4j_tpu.serving.circuit.CircuitBreaker` reused as
+  its ejection state machine: *closed* = routable, *open* = ejected,
+  *half_open* = re-probing. An active prober polls ``/readyz`` every
+  ``probe_interval_s``; probe failures and passive request-level
+  connect failures both count, and ``eject_consecutive_failures`` in a
+  row :meth:`~CircuitBreaker.trip` the breaker (a dead process fails
+  fast and often, but a long healthy window would keep the windowed
+  rate below threshold — consecutive is the right shape for "the
+  process is gone"). The windowed rate stays armed as a secondary
+  signal for flaky-but-not-dead backends. Re-admission is the normal
+  half-open lifecycle: ``readmit_probes`` consecutive healthy
+  ``/readyz`` probes re-close the breaker and the backend takes
+  traffic again.
+
+- **Routing** — least-loaded by live in-flight count (ties broken
+  round-robin), or consistent-hash affinity when the request carries
+  ``X-Routing-Key`` (cache locality groundwork for the ROADMAP item 7
+  request/prefix cache tier: same key → same backend while it stays
+  healthy; the ring walk falls through to the next routable backend
+  when the owner is out).
+
+- **Retry-elsewhere** — a retryable failure (connect-level, or a
+  429/503 response) is retried ONCE on a different backend, guarded by
+  a fleet-wide retry budget (Finagle-style: each routed request
+  deposits ``retry_budget_ratio`` tokens, each retry withdraws one —
+  steady-state retries are capped at ~10% of traffic, so failover can
+  never amplify an overload into a retry storm). Budget exhausted or
+  no second backend → the original failure passes through verbatim
+  (typed + retryable, so the CLIENT's retry loop still composes).
+  ``:generate`` streams proxy through chunk-for-chunk with failover
+  only BEFORE the backend response opens (before the first token) —
+  tokens cannot be un-sent, so a mid-stream death surfaces as the
+  terminal typed error line instead of a silent replay.
+
+- **Rolling drain** (deploys) — :meth:`FleetRouter.drain` quiesces one
+  backend (no new sends; in-flight requests finish under a deadline),
+  :meth:`FleetRouter.readmit` puts it back behind the health gate (it
+  takes traffic only once probes prove it ready — "re-admit on healthy
+  probe" falls out of the circuit lifecycle when the deploy restarted
+  the process, and is immediate for an in-place warmed hot-swap).
+  :meth:`FleetRouter.rolling_deploy` walks the fleet one backend at a
+  time: drain → caller's deploy function (e.g.
+  ``registry.deploy(...)`` for an in-process fleet, an exec for a real
+  one) → readmit → wait routable, aborting the walk if a deploy step
+  fails (one bad deploy must not drain the rest of the fleet).
+
+- **Fleet-level priority shed** — the same priority-class policy the
+  per-server overload plane enforces (``serving/overload.py``'s
+  class fractions over ``fleet_max_in_flight``, critical-borrow
+  included), applied at the router BEFORE any backend is contacted: as
+  the fleet fills, ``batch`` sheds first and ``critical`` is never
+  shed while lower-class work holds fleet slots — critical traffic is
+  protected before any single backend saturates.
+
+- **Fleet federation** — ``GET /metrics`` unions every backend's
+  scrape under ``worker``/``generation`` labels via the SAME
+  :func:`~deeplearning4j_tpu.observability.federation.federate_instruments`
+  path (strict collision rules) the cluster aggregator uses, plus the
+  router's own ``router_*`` families; ``GET /debug/requests`` and
+  ``GET /debug/incidents`` merge the backends' ledgers/bundle indexes
+  with a ``backend`` tag; ``GET /debug/fleet`` renders the backend
+  table, circuit states, and retry-budget spend.
+
+Chaos hooks: ``router.backend_down`` (refuse a chosen backend with a
+synthetic connection failure; ``arg`` = backend index, ``-1`` = any)
+fires in the shared send path, so probes AND requests see the outage —
+ejection, failover, and re-admission all run without killing a real
+process. ``router.backend_latency`` sleeps in the forward path.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import http.client
+import json
+import math
+import re
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit
+
+from deeplearning4j_tpu.observability.federation import (
+    federate_instruments,
+)
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.observability.metrics import (
+    CONTENT_TYPE_OPENMETRICS,
+    CONTENT_TYPE_TEXT,
+    MetricsRegistry,
+    render_json_multi,
+    render_text_multi,
+    wants_openmetrics,
+)
+from deeplearning4j_tpu.observability import trace as _trace
+from deeplearning4j_tpu.resilience.faults import (
+    POINT_ROUTER_BACKEND_DOWN,
+    POINT_ROUTER_BACKEND_LATENCY,
+    get_fault_injector as _fault_injector,
+)
+from deeplearning4j_tpu.serving.circuit import (
+    STATE_CLOSED,
+    STATE_NUM,
+    STATE_OPEN,
+    CircuitBreaker,
+    CircuitPolicy,
+)
+from deeplearning4j_tpu.serving.errors import (
+    BadRequestError,
+    ConnectionFailedError,
+    NotReadyError,
+    QueueFullError,
+    ServingError,
+)
+from deeplearning4j_tpu.serving.overload import (
+    DEFAULT_CLASS_FRACTIONS,
+    PRIORITIES,
+    validate_priority,
+)
+
+_MODEL_ROUTE_RE = re.compile(r"^/v1/models/[\w.\-]+:(predict|generate)$")
+
+# admin states (the drain plane; health is the circuit's)
+ADMIN_ACTIVE = "active"
+ADMIN_DRAINING = "draining"
+
+
+def _retry_after_secs(ms) -> str:
+    """HTTP ``Retry-After`` header value: integer seconds, ceilinged,
+    never below 1 (the precise ms hint rides the error body)."""
+    return str(max(1, -(-int(ms) // 1000)))
+
+
+@dataclasses.dataclass
+class RouterPolicy:
+    """Tuning knobs for the fleet router, all host-side.
+
+    Health gating: the prober GETs ``probe_path`` on every backend each
+    ``probe_interval_s``; ``eject_consecutive_failures`` consecutive
+    failures (probe or passive request connect failures, mixed) trip
+    the backend's breaker for ``reprobe_after_s``, after which
+    ``readmit_probes`` consecutive healthy probes re-admit it. The
+    secondary windowed-rate ejection (``circuit_*``) catches
+    flaky-but-alive backends the consecutive counter misses.
+
+    Failover: one retry on a different backend for connect-level
+    failures and 429/503 responses, spending the fleet retry budget —
+    each routed request deposits ``retry_budget_ratio`` tokens
+    (steady-state retries ≤ ~ratio of traffic), ``retry_budget_initial``
+    seeds cold-start failover, ``retry_budget_cap`` bounds the burst.
+
+    ``fleet_max_in_flight`` arms the router-level priority shed over
+    ``class_fractions`` (None disables): lowest class sheds first as
+    fleet in-flight climbs; ``critical`` borrows while lower-class
+    work holds slots, hard-capped at 2x."""
+
+    probe_interval_s: float = 0.5
+    probe_timeout_s: float = 1.0
+    probe_path: str = "/readyz"
+    eject_consecutive_failures: int = 3
+    reprobe_after_s: float = 1.0
+    readmit_probes: int = 2
+    circuit_window_s: float = 10.0
+    circuit_min_requests: int = 8
+    circuit_failure_rate: float = 0.8
+    retry_budget_ratio: float = 0.1
+    retry_budget_initial: float = 10.0
+    retry_budget_cap: float = 100.0
+    request_timeout_s: float = 60.0
+    deadline_headroom_s: float = 5.0
+    affinity_header: str = "X-Routing-Key"
+    hash_replicas: int = 64
+    fleet_max_in_flight: Optional[int] = None
+    class_fractions: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_CLASS_FRACTIONS))
+    drain_timeout_s: float = 30.0
+
+    def validate(self) -> "RouterPolicy":
+        for name in ("probe_interval_s", "probe_timeout_s",
+                     "reprobe_after_s", "circuit_window_s",
+                     "request_timeout_s", "drain_timeout_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"{name} must be > 0, got {getattr(self, name)}")
+        if self.eject_consecutive_failures < 1:
+            raise ValueError("eject_consecutive_failures must be >= 1, "
+                             f"got {self.eject_consecutive_failures}")
+        if self.readmit_probes < 1:
+            raise ValueError(
+                f"readmit_probes must be >= 1, got {self.readmit_probes}")
+        if self.circuit_min_requests < 1:
+            raise ValueError("circuit_min_requests must be >= 1, got "
+                             f"{self.circuit_min_requests}")
+        if not 0.0 < self.circuit_failure_rate <= 1.0:
+            raise ValueError("circuit_failure_rate must be in (0, 1], "
+                             f"got {self.circuit_failure_rate}")
+        if not 0.0 <= self.retry_budget_ratio <= 1.0:
+            raise ValueError("retry_budget_ratio must be in [0, 1], "
+                             f"got {self.retry_budget_ratio}")
+        if self.retry_budget_initial < 0 or self.retry_budget_cap < 1:
+            raise ValueError("retry_budget_initial must be >= 0 and "
+                             "retry_budget_cap >= 1, got "
+                             f"{self.retry_budget_initial}/"
+                             f"{self.retry_budget_cap}")
+        if self.hash_replicas < 1:
+            raise ValueError(
+                f"hash_replicas must be >= 1, got {self.hash_replicas}")
+        if self.fleet_max_in_flight is not None \
+                and self.fleet_max_in_flight < 1:
+            raise ValueError("fleet_max_in_flight must be >= 1, got "
+                             f"{self.fleet_max_in_flight}")
+        missing = set(PRIORITIES) - set(self.class_fractions)
+        if missing:
+            raise ValueError(
+                f"class_fractions missing classes {sorted(missing)}")
+        for cls, frac in self.class_fractions.items():
+            if not 0.0 < frac <= 1.0:
+                raise ValueError(f"class_fractions[{cls!r}] must be in "
+                                 f"(0, 1], got {frac}")
+        return self
+
+    def circuit_policy(self) -> CircuitPolicy:
+        """The per-backend breaker derived from the router knobs."""
+        return CircuitPolicy(
+            window_s=self.circuit_window_s,
+            min_requests=self.circuit_min_requests,
+            failure_rate_threshold=self.circuit_failure_rate,
+            open_duration_s=self.reprobe_after_s,
+            half_open_probes=self.readmit_probes)
+
+
+class RouterMetrics:
+    """The router's instrument bundle, on its own registry (a process
+    can run several routers; each counts its own traffic). ``/metrics``
+    renders this bundle UNION the federated backend series."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        r = self.registry
+        self.requests_total = r.counter(
+            "router_requests_total",
+            "Requests routed, by the last backend ATTEMPTED and final "
+            "HTTP status code (backend=\"\" only when the router "
+            "refused without attempting one: router sheds, bad "
+            "priority, no routable backend).", ("backend", "code"))
+        self.request_latency = r.histogram(
+            "router_request_latency_seconds",
+            "End-to-end router latency (request parse to final "
+            "response byte), failover included.", ("backend",))
+        self.retries_total = r.counter(
+            "router_retries_total",
+            "Retry-elsewhere failovers, by trigger (connect = "
+            "transport-level failure, status = retryable 429/503).",
+            ("reason",))
+        self.retry_budget_balance = r.gauge(
+            "router_retry_budget_balance",
+            "Tokens currently in the fleet retry budget.")
+        self.retry_budget_exhausted_total = r.counter(
+            "router_retry_budget_exhausted_total",
+            "Failover attempts refused because the fleet retry budget "
+            "was empty (the router-retry-budget-exhausted burn-rate "
+            "rule's bad events).")
+        self.backend_health = r.gauge(
+            "router_backend_health",
+            "Backend ejection-circuit state (0=closed/routable, "
+            "1=open/ejected, 2=half_open/re-probing).", ("backend",))
+        self.backend_draining = r.gauge(
+            "router_backend_draining",
+            "1 while the backend is administratively draining (rolling "
+            "deploy quiesce), else 0.", ("backend",))
+        self.backend_in_flight = r.gauge(
+            "router_backend_in_flight",
+            "Live requests the router holds open against the backend "
+            "(the least-loaded routing signal).", ("backend",))
+        self.ejections_total = r.counter(
+            "router_ejections_total",
+            "Backend ejections (circuit transitions to open).",
+            ("backend",))
+        self.readmissions_total = r.counter(
+            "router_readmissions_total",
+            "Backend re-admissions (circuit re-closed after healthy "
+            "probes).", ("backend",))
+        self.probes_total = r.counter(
+            "router_probes_total",
+            "Active health probes, by backend and outcome.",
+            ("backend", "ok"))
+        self.shed_total = r.counter(
+            "router_shed_total",
+            "Requests the ROUTER refused without contacting a backend, "
+            "by priority class and reason (fleet_overload = the "
+            "priority shed; no_backend = nothing routable).",
+            ("priority", "reason"))
+        self.fleet_in_flight = r.gauge(
+            "router_fleet_in_flight",
+            "Live requests across the whole fleet (the priority "
+            "shed's admission signal).")
+        self.backends = r.gauge(
+            "router_backends", "Backends in the routing table.")
+        self.routable_backends = r.gauge(
+            "router_routable_backends",
+            "Backends currently eligible for new sends (circuit "
+            "closed, not draining).")
+        self.drains_total = r.counter(
+            "router_drains_total",
+            "Administrative drains started (rolling deploys).",
+            ("backend",))
+        self.federation_conflicts_total = r.counter(
+            "router_federation_conflicts_total",
+            "Backend metric families dropped from the federated "
+            "/metrics view because their type/labels/buckets disagreed "
+            "with the family's first-seen shape.", ("name",))
+
+
+class RetryBudget:
+    """Fleet-wide failover budget (Finagle's ``RetryBudget`` shape).
+
+    Each *first-attempt* routed request deposits ``ratio`` tokens; each
+    retry-elsewhere withdraws one whole token. Steady state, retries
+    are therefore capped at ~``ratio`` of traffic — a fleet where every
+    request fails cannot double its own load by failing over. The
+    initial balance funds cold-start failover (the first requests after
+    a backend dies arrive before any deposits); the cap bounds how
+    large a burst a long quiet healthy period can bank."""
+
+    def __init__(self, ratio: float = 0.1, initial: float = 10.0,
+                 cap: float = 100.0):
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._balance = min(float(initial), self.cap)
+        self._spent = 0
+        self._exhausted = 0
+        self._lock = threading.Lock()
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._balance = min(self.cap, self._balance + self.ratio)
+
+    def try_spend(self) -> bool:
+        """Withdraw one token for a retry; False (and counted) when the
+        budget cannot fund it."""
+        with self._lock:
+            if self._balance >= 1.0:
+                self._balance -= 1.0
+                self._spent += 1
+                return True
+            self._exhausted += 1
+            return False
+
+    @property
+    def balance(self) -> float:
+        with self._lock:
+            return self._balance
+
+    @property
+    def spent_total(self) -> int:
+        return self._spent
+
+    @property
+    def exhausted_total(self) -> int:
+        return self._exhausted
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"ratio": self.ratio, "cap": self.cap,
+                    "balance": round(self._balance, 3),
+                    "spent_total": self._spent,
+                    "exhausted_total": self._exhausted}
+
+
+class HashRing:
+    """Consistent-hash ring over backend names (``hash_replicas``
+    virtual nodes each, SHA-1 positions — deterministic across
+    processes). ``owner`` walks clockwise from the key's position to
+    the first *eligible* backend, so an ejected/draining owner's keys
+    spill to its ring successor and come straight back when it heals —
+    no global reshuffle either way."""
+
+    def __init__(self, names: Sequence[str], replicas: int = 64):
+        points: List[Tuple[int, str]] = []
+        for name in names:
+            for i in range(replicas):
+                points.append((self._hash(f"{name}#{i}"), name))
+        points.sort()
+        self._points = points
+        self._keys = [p[0] for p in points]
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(s.encode()).digest()[:8], "big")
+
+    def owner(self, key: str, eligible) -> Optional[str]:
+        if not self._points:
+            return None
+        start = bisect.bisect_left(self._keys, self._hash(key))
+        n = len(self._points)
+        for i in range(n):
+            name = self._points[(start + i) % n][1]
+            if name in eligible:
+                return name
+        return None
+
+
+class Backend:
+    """One row of the routing table: identity, the ejection circuit,
+    the drain plane, and live in-flight accounting."""
+
+    def __init__(self, name: str, url: str, index: int,
+                 policy: RouterPolicy, *,
+                 on_transition: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.index = index
+        split = urlsplit(self.url)
+        self.host = split.hostname or "127.0.0.1"
+        self.port = split.port or 80
+        self._policy = policy
+        self.circuit = CircuitBreaker(
+            policy.circuit_policy(), clock=clock,
+            on_transition=on_transition)
+        self.admin_state = ADMIN_ACTIVE
+        self._in_flight = 0
+        self._consecutive_failures = 0
+        self.requests_total = 0
+        self.last_probe_ok: Optional[bool] = None
+        self.last_probe_t: Optional[float] = None
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        # pooled keep-alive connections to this backend (forward path)
+        self._pool: List[http.client.HTTPConnection] = []
+
+    # -- state ----------------------------------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive_failures
+
+    @property
+    def routable(self) -> bool:
+        return (self.admin_state == ADMIN_ACTIVE
+                and self.circuit.state == STATE_CLOSED)
+
+    def begin(self) -> None:
+        with self._lock:
+            self._in_flight += 1
+            self.requests_total += 1
+
+    def end(self) -> None:
+        with self._lock:
+            self._in_flight = max(0, self._in_flight - 1)
+            if self._in_flight == 0:
+                self._idle.notify_all()
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block until in-flight drops to zero (the drain wait)."""
+        deadline = self._clock() + timeout_s
+        with self._lock:
+            while self._in_flight > 0:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(timeout=min(remaining, 0.1))
+            return True
+
+    def note_neutral(self, token: Optional[int]) -> None:
+        """An outcome that says nothing about ejection either way: the
+        backend answered, but with a 503 (draining / circuit-open /
+        worker-crash). It must not RESET the consecutive-failure
+        streak — a draining backend under load would otherwise keep
+        out-voting the probe failures that are trying to eject it —
+        and it must not count toward it either (retry-elsewhere
+        already absorbs per-request 503s; whole-backend ejection is
+        the /readyz probe's verdict)."""
+        self.circuit.record_neutral(token)
+
+    def note_result(self, ok: bool, token: Optional[int]) -> bool:
+        """Fold one reachability outcome (request or probe) into the
+        ejection state. Returns True when THIS outcome tripped the
+        consecutive-failure ejection.
+
+        LOCK ORDER: every circuit interaction happens OUTSIDE the
+        backend lock. The breaker's ``on_transition`` hook runs under
+        the circuit lock and calls ``close_pool`` (backend lock), so
+        touching the circuit while holding the backend lock — even a
+        ``.state`` read — is the ABBA half of a deadlock."""
+        with self._lock:
+            if ok:
+                self._consecutive_failures = 0
+                streak = 0
+            else:
+                self._consecutive_failures += 1
+                streak = self._consecutive_failures
+        # breaker bookkeeping outside our lock (it has its own); the
+        # windowed rate stays armed as the flaky-backend signal
+        self.circuit.record(ok, token=token)
+        if not ok and streak >= self._policy.eject_consecutive_failures \
+                and self.circuit.state != STATE_OPEN:
+            # benign race: two threads may both observe the streak and
+            # trip — the second trip just re-stamps open_until
+            self.circuit.trip()
+            return True
+        return False
+
+    # -- connection pool ------------------------------------------------------
+
+    def checkout(self) -> Tuple[Optional[http.client.HTTPConnection], bool]:
+        """(connection, reused). A fresh connection is NOT opened here —
+        the caller constructs one so connect errors stay in its
+        try/except."""
+        with self._lock:
+            if self._pool:
+                return self._pool.pop(), True
+        return None, False
+
+    def checkin(self, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if len(self._pool) < 16:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def close_pool(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for c in pool:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
+    def describe(self) -> dict:
+        with self._lock:
+            in_flight = self._in_flight
+            fails = self._consecutive_failures
+            requests = self.requests_total
+        n, rate = self.circuit.failure_rate()
+        return {
+            "name": self.name, "url": self.url, "index": self.index,
+            "admin_state": self.admin_state,
+            "circuit": self.circuit.state,
+            "routable": self.routable,
+            "in_flight": in_flight,
+            "consecutive_failures": fails,
+            "requests_total": requests,
+            "window": {"n": n, "failure_rate": round(rate, 4)},
+            "last_probe_ok": self.last_probe_ok,
+            "last_probe_age_s": (
+                round(self._clock() - self.last_probe_t, 3)
+                if self.last_probe_t is not None else None),
+        }
+
+
+class _FederatedView:
+    """Duck-typed registry over one federation pass's instruments."""
+
+    def __init__(self, instruments):
+        self._instruments = instruments
+
+    def instruments(self):
+        return self._instruments
+
+
+# internal marker: the forward path's transport-level failure.
+# ``timeout=True`` means the backend was reachable but slow — it must
+# NOT feed the consecutive-failure ejection streak (three slow requests
+# would eject a healthy backend and cascade its load onto the rest) and
+# must NOT retry elsewhere (the request may still be executing; a
+# failover would double exactly the work the fleet is too slow for).
+class _ConnectFailure(Exception):
+    def __init__(self, msg: str, *, timeout: bool = False):
+        super().__init__(msg)
+        self.timeout = timeout
+
+
+class FleetRouter:
+    """The router process: HTTP front, prober thread, routing logic.
+
+    ``backends`` is a sequence of ``(name, url)`` pairs (or bare urls —
+    names default to ``b<i>``). Lifecycle mirrors ModelServer:
+    ``start()`` binds the HTTP thread and the prober, ``stop()``
+    unwinds both; usable as a context manager."""
+
+    def __init__(self, backends, *, host: str = "127.0.0.1",
+                 port: int = 0,
+                 policy: Optional[RouterPolicy] = None,
+                 metrics: Optional[RouterMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = (policy or RouterPolicy()).validate()
+        self.metrics = metrics if metrics is not None else RouterMetrics()
+        self._clock = clock
+        self._backends: List[Backend] = []
+        for i, spec in enumerate(backends):
+            name, url = (spec if isinstance(spec, (tuple, list))
+                         else (f"b{i}", spec))
+            self._backends.append(self._make_backend(str(name),
+                                                     str(url), i))
+        if not self._backends:
+            raise ValueError("at least one backend is required")
+        names = [b.name for b in self._backends]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate backend names: {names}")
+        self.ring = HashRing(names, self.policy.hash_replicas)
+        self.budget = RetryBudget(self.policy.retry_budget_ratio,
+                                  self.policy.retry_budget_initial,
+                                  self.policy.retry_budget_cap)
+        self.metrics.retry_budget_balance.set(self.budget.balance)
+        self.metrics.backends.set(len(self._backends))
+        # fleet priority-shed state (None fleet_max_in_flight disables)
+        self._fleet_lock = threading.Lock()
+        self._class_in_flight = {p: 0 for p in PRIORITIES}
+        self._rr = 0  # least-loaded tie-break cursor
+        self._started = False
+        self._stop_probing = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        # ONE persistent pool for probe fan-out + federation fetches:
+        # building a fresh executor per probe pass (every 0.5 s,
+        # forever) would churn thread spawn/join on the always-on
+        # health path
+        self._io_pool = ThreadPoolExecutor(
+            max_workers=min(16, max(2, len(self._backends))),
+            thread_name_prefix="fleet-router-io")
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: N802 - stdlib API
+                pass
+
+            def _send(self, status: int, body,
+                      content_type="application/json",
+                      extra_headers: Optional[dict] = None):
+                raw = (body if isinstance(body, bytes)
+                       else json.dumps(body).encode())
+                if extra_headers is None and isinstance(body, dict):
+                    err = body.get("error")
+                    if isinstance(err, dict) \
+                            and err.get("retry_after_ms") is not None:
+                        extra_headers = {"Retry-After": _retry_after_secs(
+                            err["retry_after_ms"])}
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(raw)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif path == "/readyz":
+                    body = router.readiness()
+                    self._send(200 if body["ready"] else 503, body)
+                elif path == "/metrics":
+                    if "format=json" in query:
+                        self._send(200, router.render_metrics_json())
+                    else:
+                        om = wants_openmetrics(self.headers.get("Accept"))
+                        self._send(
+                            200,
+                            router.render_metrics_text(
+                                openmetrics=om).encode(),
+                            content_type=(CONTENT_TYPE_OPENMETRICS if om
+                                          else CONTENT_TYPE_TEXT))
+                elif path == "/debug/fleet":
+                    self._send(200, router.describe())
+                elif path == "/debug/requests":
+                    self._send(200, router.render_fleet_requests(query))
+                elif path == "/debug/incidents":
+                    self._send(200, router.render_fleet_incidents())
+                elif path == "/models":
+                    status, body = router.proxy_models()
+                    self._send(status, body)
+                else:
+                    self._send(404, ServingError(
+                        f"no route {path}").to_json())
+
+            def do_POST(self):  # noqa: N802 - stdlib API
+                path, _, query = self.path.partition("?")
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                if path.startswith("/admin/"):
+                    status, out = router.handle_admin(path, query)
+                    self._send(status, out)
+                    return
+                m = _MODEL_ROUTE_RE.match(path)
+                if m is None:
+                    self._send(404, ServingError(
+                        f"no route {path}").to_json())
+                    return
+                cid = (self.headers.get("X-Correlation-ID")
+                       or _trace.new_id())
+                headers = router._forward_headers(self.headers, cid)
+                try:
+                    payload = json.loads(body) if body else {}
+                    if not isinstance(payload, dict):
+                        payload = {}
+                except ValueError:
+                    payload = {}  # the backend will 400 the junk
+                deadline_ms = router._deadline_from(payload)
+                try:
+                    if m.group(1) == "generate" \
+                            and bool(payload.get("stream", True)):
+                        self._stream_started = False
+                        try:
+                            router.route_stream(self, path, body,
+                                                headers, cid,
+                                                deadline_ms=deadline_ms)
+                        except Exception as e:  # noqa: BLE001
+                            if self._stream_started:
+                                # a 200 chunked response is already in
+                                # flight: a second response's framing
+                                # would corrupt the stream — dropping
+                                # the connection is the only honest
+                                # signal left
+                                self.close_connection = True
+                            else:
+                                self._send(500, {"error": {
+                                    "code": "INTERNAL",
+                                    "message": str(e)[:300],
+                                    "retryable": False}})
+                        return
+                    status, raw, retry_after = router.route_request(
+                        path, body, headers,
+                        priority=self.headers.get("X-Priority"),
+                        affinity=self.headers.get(
+                            router.policy.affinity_header),
+                        deadline_ms=deadline_ms)
+                except Exception as e:  # noqa: BLE001 — surface, never
+                    # crash the connection: a router bug must come back
+                    # as a structured 500, not a reset the client then
+                    # misreads as a (retryable) dead router
+                    status, retry_after = 500, None
+                    raw = json.dumps(
+                        {"error": {"code": "INTERNAL",
+                                   "message": str(e)[:300],
+                                   "retryable": False}}).encode()
+                extra = {"X-Correlation-ID": cid}
+                if retry_after is not None:
+                    extra["Retry-After"] = _retry_after_secs(retry_after)
+                self._send(status, raw, extra_headers=extra)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+
+    # -- construction ---------------------------------------------------------
+
+    def _make_backend(self, name: str, url: str, index: int) -> Backend:
+        # NOTE the hook runs under the breaker's own lock: it must not
+        # read any circuit's .state (self-deadlock) — the routable
+        # gauge refreshes from the probe loop / drain plane instead
+        holder: dict = {}
+
+        def on_transition(frm, to, _name=name):
+            m = self.metrics
+            m.backend_health.set(STATE_NUM[to], backend=_name)
+            if to == STATE_OPEN:
+                m.ejections_total.inc(backend=_name)
+                # an ejected backend's pooled sockets are poison: they
+                # may outlive the process that owned them (a restart on
+                # the same port, a drain that leaves keep-alives open)
+                # and would answer re-admitted traffic with the OLD
+                # process's 503s forever
+                if holder.get("b") is not None:
+                    holder["b"].close_pool()
+            if to == STATE_CLOSED and frm != STATE_CLOSED:
+                m.readmissions_total.inc(backend=_name)
+            record_event("router.backend", backend=_name, frm=frm,
+                         to=to)
+
+        b = Backend(name, url, index, self.policy,
+                    on_transition=on_transition, clock=self._clock)
+        holder["b"] = b
+        self.metrics.backend_health.set(0, backend=name)
+        self.metrics.backend_draining.set(0, backend=name)
+        self.metrics.backend_in_flight.set(0, backend=name)
+        return b
+
+    def _update_routable_gauge(self):
+        self.metrics.routable_backends.set(
+            sum(1 for b in self._backends if b.routable))
+
+    # -- surface --------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    @property
+    def backends(self) -> List[Backend]:
+        return list(self._backends)
+
+    def backend(self, name: str) -> Backend:
+        for b in self._backends:
+            if b.name == name:
+                return b
+        raise KeyError(f"no backend named {name!r}")
+
+    def readiness(self) -> dict:
+        routable = [b.name for b in self._backends if b.routable]
+        return {"ready": bool(routable), "routable": routable,
+                "backends": len(self._backends)}
+
+    def describe(self) -> dict:
+        """The ``/debug/fleet`` document."""
+        with self._fleet_lock:
+            classes = dict(self._class_in_flight)
+        return {
+            "backends": [b.describe() for b in self._backends],
+            "retry_budget": self.budget.describe(),
+            "fleet": {
+                "in_flight": sum(classes.values()),
+                "class_in_flight": classes,
+                "max_in_flight": self.policy.fleet_max_in_flight,
+                "routable": sum(1 for b in self._backends
+                                if b.routable),
+            },
+            "policy": {
+                "probe_interval_s": self.policy.probe_interval_s,
+                "eject_consecutive_failures":
+                    self.policy.eject_consecutive_failures,
+                "reprobe_after_s": self.policy.reprobe_after_s,
+                "readmit_probes": self.policy.readmit_probes,
+                "retry_budget_ratio": self.policy.retry_budget_ratio,
+            },
+        }
+
+    # -- selection ------------------------------------------------------------
+
+    def _routable(self, exclude=()) -> List[Backend]:
+        return [b for b in self._backends
+                if b.routable and b.name not in exclude]
+
+    def _pick(self, *, exclude=(), affinity: Optional[str] = None
+              ) -> Optional[Backend]:
+        """Choose a backend for one attempt: affinity owner when a key
+        rides the request, else least-loaded (round-robin tie-break)."""
+        candidates = self._routable(exclude)
+        if not candidates:
+            return None
+        if affinity:
+            eligible = {b.name for b in candidates}
+            owner = self.ring.owner(affinity, eligible)
+            if owner is not None:
+                return next(b for b in candidates if b.name == owner)
+        low = min(b.in_flight for b in candidates)
+        lows = [b for b in candidates if b.in_flight == low]
+        self._rr += 1  # benign race: any tie-break is a valid one
+        return lows[self._rr % len(lows)]
+
+    # -- fleet priority shed --------------------------------------------------
+
+    @staticmethod
+    def _validate_priority(priority) -> str:
+        """overload.validate_priority — shared with ModelServer so the
+        router and the per-server plane can never disagree on the
+        class vocabulary."""
+        return validate_priority(priority)
+
+    def _class_limit(self, prio: str) -> int:
+        limit = self.policy.fleet_max_in_flight
+        return max(1, int(math.ceil(
+            limit * self.policy.class_fractions[prio])))
+
+    def _fleet_admit(self, prio: str) -> Tuple[bool, float]:
+        """(admitted, retry_after_ms). The same shape as the per-server
+        priority admission: each class admits while total fleet
+        in-flight is under its fraction of the cap; ``critical``
+        borrows while lower-class work holds slots (never shed into a
+        priority inversion), hard-capped at 2x."""
+        limit = self.policy.fleet_max_in_flight
+        with self._fleet_lock:
+            total = sum(self._class_in_flight.values())
+            if limit is None:
+                admit = True
+            else:
+                admit = total < self._class_limit(prio)
+                if not admit and prio == "critical" \
+                        and total < 2 * limit:
+                    lower = sum(v for p, v
+                                in self._class_in_flight.items()
+                                if p != "critical")
+                    admit = lower > 0
+            if admit:
+                self._class_in_flight[prio] += 1
+                self.metrics.fleet_in_flight.set(total + 1)
+                return True, 0.0
+            overshoot = max(1, total - self._class_limit(prio) + 1)
+        return False, 25.0 * overshoot
+
+    def _fleet_release(self, prio: str):
+        with self._fleet_lock:
+            self._class_in_flight[prio] = max(
+                0, self._class_in_flight[prio] - 1)
+            self.metrics.fleet_in_flight.set(
+                sum(self._class_in_flight.values()))
+
+    # -- forwarding -----------------------------------------------------------
+
+    @staticmethod
+    def _forward_headers(headers, cid: str) -> dict:
+        out = {"Content-Type": "application/json",
+               "X-Correlation-ID": cid}
+        for name in ("X-Priority", "X-Tenant", "X-Span-ID"):
+            v = headers.get(name)
+            if v:
+                out[name] = v
+        return out
+
+    @staticmethod
+    def _deadline_from(payload: dict) -> Optional[float]:
+        """``deadline_ms`` out of the already-parsed payload (the body
+        is parsed ONCE in the handler — predict inputs dominate the
+        bytes, and re-parsing them per field would be the router's
+        largest per-request cost)."""
+        v = payload.get("deadline_ms")
+        try:
+            return float(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None  # the backend will 400 the junk
+
+    def _request_timeout(self, deadline_ms: Optional[float]) -> float:
+        if deadline_ms is None:
+            return self.policy.request_timeout_s
+        # floored: a junk negative deadline must not become a negative
+        # socket timeout (ValueError -> 500); with a tiny-but-valid
+        # timeout the backend still gets the chance to 400 it
+        return max(0.05, min(
+            self.policy.request_timeout_s,
+            deadline_ms / 1000.0 + self.policy.deadline_headroom_s))
+
+    def _maybe_inject_down(self, backend: Backend) -> None:
+        """The ``router.backend_down`` chaos point, shared by requests
+        AND probes so an injected-down backend ejects and stays out
+        exactly like a dead process."""
+        inj = _fault_injector()
+        if not inj.enabled:
+            return
+        inj.maybe_sleep(POINT_ROUTER_BACKEND_LATENCY)
+        # victim check BEFORE consuming a firing: a finite times=N plan
+        # aimed at one backend index must not be drained by sends (or
+        # probes) to the others — and an EXHAUSTED plan must not keep
+        # green-lighting fire() for its old victim (that would hand
+        # another active plan's firings to a backend it never targeted)
+        if any(p.fired < p.times
+               and int(p.arg) in (-1, backend.index)
+               for p in inj.plans_for(POINT_ROUTER_BACKEND_DOWN)):
+            p = inj.fire(POINT_ROUTER_BACKEND_DOWN)
+            if p is not None and int(p.arg) in (-1, backend.index):
+                raise ConnectionRefusedError(
+                    "injected router.backend_down")
+
+    def _forward_once(self, backend: Backend, path: str, body: bytes,
+                      headers: dict, timeout: float,
+                      ) -> Tuple[int, bytes, dict]:
+        """One POST to one backend over a pooled keep-alive connection;
+        raises ``_ConnectFailure`` on transport-level failure. A REUSED
+        connection that fails before any response arrives is retried
+        once on a fresh one — an idle keep-alive socket the backend
+        closed is not evidence the backend is down."""
+        try:
+            self._maybe_inject_down(backend)
+        except ConnectionError as e:
+            raise _ConnectFailure(str(e)) from e
+        conn, reused = backend.checkout()
+        for attempt in (0, 1):
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    backend.host, backend.port, timeout=timeout)
+                reused = False
+            try:
+                if conn.sock is not None:  # pooled: refresh the timeout
+                    conn.sock.settimeout(timeout)
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                resp_headers = {k: v for k, v in resp.getheaders()}
+                backend.checkin(conn)
+                return resp.status, raw, resp_headers
+            except (ConnectionError, http.client.IncompleteRead,
+                    http.client.BadStatusLine, BrokenPipeError,
+                    OSError) as e:
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001 — already broken
+                    pass
+                conn = None
+                if isinstance(e, TimeoutError) or "timed out" in str(e):
+                    # a slow backend is not a dead one: surface as a
+                    # retryable 503 for THIS request, but flagged so it
+                    # neither ejects the backend nor fails over
+                    raise _ConnectFailure(f"timeout: {e}",
+                                          timeout=True) from e
+                if reused and attempt == 0:
+                    reused = False
+                    continue  # stale keep-alive socket, not an outage
+                raise _ConnectFailure(str(e)) from e
+        raise _ConnectFailure("unreachable")  # pragma: no cover
+
+    def _attempt(self, backend: Backend, path: str, body: bytes,
+                 headers: dict, timeout: float
+                 ) -> Tuple[int, bytes, dict]:
+        """One routed attempt with in-flight + health accounting."""
+        allowed, _, token = backend.circuit.allow()
+        if not allowed:
+            raise _ConnectFailure("backend ejected mid-selection")
+        backend.begin()
+        self.metrics.backend_in_flight.set(backend.in_flight,
+                                           backend=backend.name)
+        try:
+            status, raw, resp_headers = self._forward_once(
+                backend, path, body, headers, timeout)
+        except _ConnectFailure as e:
+            if e.timeout:
+                backend.note_neutral(token)  # slow ≠ dead: the probe
+            else:                            # owns the slow verdict
+                backend.note_result(False, token)
+            raise
+        finally:
+            backend.end()
+            self.metrics.backend_in_flight.set(backend.in_flight,
+                                               backend=backend.name)
+        # an HTTP response means the process is alive: 200/4xx/500/504
+        # reset the failure streak (model-level health is the backend's
+        # own circuit's business); 503 is NEUTRAL — draining or
+        # circuit-open, the probe decides whether the backend stays
+        if status == 503:
+            backend.note_neutral(token)
+        else:
+            backend.note_result(True, token)
+        return status, raw, resp_headers
+
+    @staticmethod
+    def _retryable_response(status: int) -> bool:
+        return status in (429, 503)
+
+    def route_request(self, path: str, body: bytes, headers: dict, *,
+                      priority=None, affinity: Optional[str] = None,
+                      deadline_ms: Optional[float] = None
+                      ) -> Tuple[int, bytes, Optional[float]]:
+        """Route one non-streaming request; returns ``(status,
+        raw_body, retry_after_ms)`` — the raw backend body passes
+        through verbatim on both success and final failure."""
+        t0 = self._clock()
+        timeout = self._request_timeout(deadline_ms)
+        try:
+            prio = self._validate_priority(priority)
+        except ServingError as e:
+            self.metrics.requests_total.inc(backend="",
+                                            code=str(e.http_status))
+            return (e.http_status, json.dumps(e.to_json()).encode(),
+                    e.retry_after_ms)
+        admitted, retry_after_ms = self._fleet_admit(prio)
+        if not admitted:
+            self.metrics.shed_total.inc(priority=prio,
+                                        reason="fleet_overload")
+            self.metrics.requests_total.inc(backend="", code="429")
+            record_event("router.shed", priority=prio,
+                         reason="fleet_overload")
+            err = QueueFullError("fleet over capacity (router shed)",
+                                 retry_after_ms=retry_after_ms)
+            return 429, json.dumps(err.to_json()).encode(), retry_after_ms
+        try:
+            return self._route_admitted(path, body, headers, prio,
+                                        affinity, timeout, t0)
+        finally:
+            self._fleet_release(prio)
+
+    def _route_admitted(self, path, body, headers, prio, affinity,
+                        timeout, t0):
+        self.budget.deposit()
+        self.metrics.retry_budget_balance.set(self.budget.balance)
+        tried: List[str] = []
+        final: Optional[Tuple[int, bytes, Optional[float]]] = None
+        backend_name = ""
+        for attempt in (0, 1):
+            b = self._pick(exclude=tried, affinity=affinity)
+            if b is None:
+                break
+            tried.append(b.name)
+            backend_name = b.name
+            try:
+                status, raw, resp_headers = self._attempt(
+                    b, path, body, headers, timeout)
+                conn_fail = False
+            except _ConnectFailure as e:
+                conn_fail, status, raw = True, 503, b""
+                err = ConnectionFailedError(
+                    f"backend {b.name} unreachable: {e}",
+                    retry_after_ms=250.0)
+                final = (503, json.dumps(err.to_json()).encode(), 250.0)
+                if e.timeout:
+                    # the request may still be running on that
+                    # backend: failing over would double its cost —
+                    # pass the typed retryable failure to the client
+                    break
+            if not conn_fail:
+                # the Retry-After probe JSON-parses the body — only
+                # error responses can carry one, and re-parsing every
+                # 200's outputs would be the hot path's biggest cost
+                ra = (self._retry_after_from(raw, resp_headers)
+                      if status >= 400 else None)
+                final = (status, raw, ra)
+                if not self._retryable_response(status):
+                    break
+            # retryable: failover once if another backend exists and
+            # the fleet budget funds it
+            if attempt == 1:
+                break
+            if not self._routable(exclude=tried):
+                break
+            if not self.budget.try_spend():
+                self.metrics.retry_budget_exhausted_total.inc()
+                record_event("router.retry_budget_exhausted",
+                             backend=b.name)
+                break
+            reason = "connect" if conn_fail else "status"
+            self.metrics.retries_total.inc(reason=reason)
+            self.metrics.retry_budget_balance.set(self.budget.balance)
+            record_event("router.retry", backend=b.name, reason=reason)
+        if final is None:
+            self.metrics.shed_total.inc(priority=prio,
+                                        reason="no_backend")
+            err = NotReadyError("no routable backend",
+                                retry_after_ms=1000.0 *
+                                self.policy.probe_interval_s * 2)
+            final = (503, json.dumps(err.to_json()).encode(),
+                     err.retry_after_ms)
+            backend_name = ""
+        self.metrics.requests_total.inc(backend=backend_name,
+                                        code=str(final[0]))
+        self.metrics.request_latency.observe(self._clock() - t0,
+                                             backend=backend_name)
+        return final
+
+    @staticmethod
+    def _retry_after_from(raw: bytes, resp_headers: dict
+                          ) -> Optional[float]:
+        try:
+            err = json.loads(raw).get("error", {})
+            if err.get("retry_after_ms") is not None:
+                return float(err["retry_after_ms"])
+        except Exception:  # noqa: BLE001 — non-JSON backend body
+            pass
+        ra = resp_headers.get("Retry-After")
+        if ra:
+            try:
+                return float(ra) * 1000.0
+            except ValueError:
+                pass
+        return None
+
+    # -- streaming (:generate) ------------------------------------------------
+
+    def route_stream(self, handler, path: str, body: bytes,
+                     headers: dict, cid: str, *,
+                     deadline_ms: Optional[float] = None) -> None:
+        """Proxy one streaming generate. Failover happens only while
+        picking a backend and opening its response — BEFORE the first
+        token; once the backend stream is open its chunks relay
+        verbatim, and a mid-stream transport failure becomes the
+        terminal typed error line (tokens already relayed stand)."""
+        t0 = self._clock()
+        try:
+            prio = self._validate_priority(
+                handler.headers.get("X-Priority"))
+        except ServingError as e:
+            self.metrics.requests_total.inc(backend="",
+                                            code=str(e.http_status))
+            handler._send(e.http_status, e.to_json())
+            return
+        admitted, retry_after_ms = self._fleet_admit(prio)
+        if not admitted:
+            self.metrics.shed_total.inc(priority=prio,
+                                        reason="fleet_overload")
+            self.metrics.requests_total.inc(backend="", code="429")
+            handler._send(429, QueueFullError(
+                "fleet over capacity (router shed)",
+                retry_after_ms=retry_after_ms).to_json())
+            return
+        try:
+            self._stream_admitted(handler, path, body, headers, cid,
+                                  prio, t0, deadline_ms)
+        finally:
+            self._fleet_release(prio)
+
+    def _open_stream(self, path, body, headers, affinity, timeout):
+        """The failover loop for streams: returns ``(backend, conn,
+        resp, None)`` with the backend response OPEN (status 200), or
+        ``(None, None, None, (status, raw_body, via))`` where ``via``
+        is the last backend attempted (\"\" when none was). Mirrors
+        :meth:`_route_admitted`'s budget discipline."""
+        self.budget.deposit()
+        self.metrics.retry_budget_balance.set(self.budget.balance)
+        tried: List[str] = []
+        final_err: Optional[Tuple[int, bytes, str]] = None
+        for attempt in (0, 1):
+            b = self._pick(exclude=tried, affinity=affinity)
+            if b is None:
+                break
+            tried.append(b.name)
+            allowed, _, token = b.circuit.allow()
+            if not allowed:
+                continue
+            b.begin()
+            self.metrics.backend_in_flight.set(b.in_flight,
+                                               backend=b.name)
+            conn = None
+            try:
+                self._maybe_inject_down(b)
+                conn = http.client.HTTPConnection(
+                    b.host, b.port, timeout=timeout)
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    b.note_result(True, token)
+                    return b, conn, resp, None
+                raw = resp.read()
+                if resp.status == 503:
+                    b.note_neutral(token)
+                else:
+                    b.note_result(True, token)
+                self._close_stream(b, conn)
+                final_err = (resp.status, raw, b.name)
+                if not self._retryable_response(resp.status):
+                    break
+            except (ConnectionError, http.client.IncompleteRead,
+                    http.client.BadStatusLine, OSError) as e:
+                is_timeout = (isinstance(e, TimeoutError)
+                              or "timed out" in str(e))
+                if is_timeout:
+                    b.note_neutral(token)  # slow ≠ dead (see _attempt)
+                else:
+                    b.note_result(False, token)
+                self._close_stream(b, conn)
+                err = ConnectionFailedError(
+                    f"backend {b.name} unreachable: {e}",
+                    retry_after_ms=250.0)
+                final_err = (503, json.dumps(err.to_json()).encode(),
+                             b.name)
+                if is_timeout:
+                    # the submit may have landed: no failover replay
+                    break
+            if attempt == 1 or not self._routable(exclude=tried):
+                break
+            if not self.budget.try_spend():
+                self.metrics.retry_budget_exhausted_total.inc()
+                break
+            self.metrics.retries_total.inc(reason="stream_open")
+            self.metrics.retry_budget_balance.set(self.budget.balance)
+        if final_err is None:
+            err = NotReadyError("no routable backend")
+            final_err = (503, json.dumps(err.to_json()).encode(), "")
+        return None, None, None, final_err
+
+    @staticmethod
+    def _is_terminal_event(line: bytes) -> bool:
+        """True when the ndjson line is a stream-terminal event (the
+        backend's ``{"done": ...}`` or typed ``{"error": ...}``) — the
+        marker of a CLEAN stream end."""
+        if not line:
+            return False
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            return False
+        return isinstance(ev, dict) and ("done" in ev or "error" in ev)
+
+    def _close_stream(self, backend: Backend, conn) -> None:
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+        backend.end()
+        self.metrics.backend_in_flight.set(backend.in_flight,
+                                           backend=backend.name)
+
+    def _stream_admitted(self, handler, path, body, headers, cid,
+                         prio, t0, deadline_ms=None):
+        timeout = self._request_timeout(deadline_ms)
+        affinity = handler.headers.get(self.policy.affinity_header)
+        backend, conn, resp, err = self._open_stream(
+            path, body, headers, affinity, timeout)
+        if backend is None:
+            status, raw, via = err
+            self.metrics.requests_total.inc(backend=via,
+                                            code=str(status))
+            # the backend's Retry-After hint must survive the raw-bytes
+            # passthrough (the auto-derivation in _send is dict-only)
+            ra = self._retry_after_from(raw, {})
+            extra = ({"Retry-After": _retry_after_secs(ra)}
+                     if ra is not None else None)
+            handler._send(status, raw, extra_headers=extra)
+            return
+        # backend stream open: from here on we are committed — send the
+        # client headers and relay chunk lines verbatim. NOTE the
+        # stdlib chunked reader SWALLOWS IncompleteRead on the
+        # read1/readline path (a killed backend's stream just *ends*),
+        # so a clean end is recognized by its terminal done/error
+        # event, not by the transport — anything else synthesizes the
+        # typed mid-stream error line.
+        status = 200
+        try:
+            handler._stream_started = True  # past this point a second
+            handler.send_response(200)      # response would corrupt
+                                            # the chunked framing
+            handler.send_header("Content-Type", "application/x-ndjson")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.send_header("X-Correlation-ID", cid)
+            handler.end_headers()
+            client_gone = False
+            broken = False
+            last_line = b""
+            try:
+                for line in resp:
+                    if not line.strip():
+                        continue
+                    if not line.endswith(b"\n"):
+                        # EOF mid-line: a torn half-event must never
+                        # reach the client as parseable-looking bytes
+                        broken = True
+                        break
+                    last_line = line
+                    try:
+                        handler.wfile.write(
+                            b"%X\r\n" % len(line) + line + b"\r\n")
+                        handler.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        client_gone = True
+                        break
+            except (ConnectionError, http.client.IncompleteRead,
+                    OSError):
+                broken = True
+            if not client_gone:
+                if not broken and not self._is_terminal_event(last_line):
+                    broken = True
+                if broken:
+                    # the BACKEND died mid-stream: terminal typed
+                    # error line — no failover after the first token
+                    # (tokens cannot be un-sent)
+                    status = 503
+                    err = ConnectionFailedError(
+                        f"backend {backend.name} died mid-stream",
+                        retry_after_ms=250.0)
+                    tail = json.dumps(err.to_json()).encode() + b"\n"
+                    try:
+                        handler.wfile.write(
+                            b"%X\r\n" % len(tail) + tail + b"\r\n")
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        client_gone = True
+                try:
+                    if not client_gone:
+                        handler.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+            if broken or client_gone:
+                try:
+                    conn.close()  # broken / unread tail: not reusable
+                except Exception:  # noqa: BLE001 — already broken
+                    pass
+            else:
+                backend.checkin(conn)
+        finally:
+            backend.end()
+            self.metrics.backend_in_flight.set(backend.in_flight,
+                                               backend=backend.name)
+            self.metrics.requests_total.inc(backend=backend.name,
+                                            code=str(status))
+            self.metrics.request_latency.observe(
+                self._clock() - t0, backend=backend.name)
+
+    # -- drain / rolling deploy ----------------------------------------------
+
+    def drain(self, name: str, *, timeout_s: Optional[float] = None
+              ) -> bool:
+        """Quiesce one backend: stop new sends immediately, then wait
+        for its in-flight requests to finish (True) or the deadline
+        (False — the caller decides whether to proceed anyway)."""
+        b = self.backend(name)
+        b.admin_state = ADMIN_DRAINING
+        self.metrics.backend_draining.set(1, backend=name)
+        self.metrics.drains_total.inc(backend=name)
+        self._update_routable_gauge()
+        record_event("router.drain", backend=name)
+        return b.wait_idle(timeout_s if timeout_s is not None
+                           else self.policy.drain_timeout_s)
+
+    def readmit(self, name: str) -> None:
+        """Lift the administrative drain. The backend takes traffic
+        again only once its circuit is (still/again) closed — a deploy
+        that restarted the process re-admits on healthy probe."""
+        b = self.backend(name)
+        b.admin_state = ADMIN_ACTIVE
+        b.close_pool()  # the old process's sockets are dead weight
+        self.metrics.backend_draining.set(0, backend=name)
+        self._update_routable_gauge()
+        record_event("router.readmit", backend=name)
+
+    def wait_routable(self, name: str, timeout_s: float = 10.0) -> bool:
+        b = self.backend(name)
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
+            if b.routable:
+                return True
+            time.sleep(min(0.02, self.policy.probe_interval_s / 4))
+        return b.routable
+
+    def rolling_deploy(self, deploy_fn: Callable[[str, str], None], *,
+                       drain_timeout_s: Optional[float] = None,
+                       readmit_timeout_s: float = 30.0) -> List[dict]:
+        """Walk the fleet one backend at a time: drain → ``deploy_fn(
+        name, url)`` → readmit → wait routable. Aborts the walk when a
+        drain times out with requests still in flight (deploying over
+        them would fail them — the zero-dropped-requests contract
+        beats finishing the roll), when a deploy step raises, or when
+        a backend never comes back — one bad step must not drain the
+        rest of the fleet. Returns the per-backend report."""
+        report = []
+        for b in list(self._backends):
+            step = {"backend": b.name}
+            step["drained"] = self.drain(b.name,
+                                         timeout_s=drain_timeout_s)
+            if not step["drained"]:
+                # in-flight requests survived the deadline: re-admit
+                # untouched and stop — the operator decides (raise the
+                # deadline, or shed the stragglers first)
+                self.readmit(b.name)
+                step["routable"] = self.wait_routable(
+                    b.name, timeout_s=readmit_timeout_s)
+                step["error"] = "drain deadline expired with requests " \
+                                "in flight; deploy skipped"
+                record_event("router.deploy", backend=b.name,
+                             drained=False, routable=step["routable"],
+                             error=step["error"])
+                report.append(step)
+                break
+            error = None
+            try:
+                deploy_fn(b.name, b.url)
+            except Exception as e:  # noqa: BLE001 — abort, don't crash
+                error = f"{type(e).__name__}: {e}"
+            self.readmit(b.name)
+            step["routable"] = self.wait_routable(
+                b.name, timeout_s=readmit_timeout_s)
+            if error is not None:
+                step["error"] = error
+            record_event("router.deploy", backend=b.name,
+                         drained=step["drained"],
+                         routable=step["routable"], error=error)
+            report.append(step)
+            if error is not None or not step["routable"]:
+                break
+        return report
+
+    # -- admin HTTP -----------------------------------------------------------
+
+    def handle_admin(self, path: str, query: str) -> Tuple[int, dict]:
+        m = re.match(r"^/admin/(drain|readmit)/([\w.\-]+)$", path)
+        if m is None:
+            return 404, ServingError(f"no route {path}").to_json()
+        action, name = m.group(1), m.group(2)
+        try:
+            if action == "drain":
+                timeout = None
+                qm = re.search(r"timeout_s=([0-9.]+)", query or "")
+                if qm:
+                    try:
+                        timeout = float(qm.group(1))
+                    except ValueError:
+                        return 400, BadRequestError(
+                            "timeout_s must be a number, got "
+                            f"{qm.group(1)!r}").to_json()
+                drained = self.drain(name, timeout_s=timeout)
+                return 200, {"backend": name, "drained": drained}
+            self.readmit(name)
+            return 200, {"backend": name, "admin_state": ADMIN_ACTIVE}
+        except KeyError:
+            return 404, ServingError(
+                f"no backend named {name!r}").to_json()
+        except Exception as e:  # noqa: BLE001 — an ops endpoint must
+            # answer with a structured error, never reset the curl
+            return 500, {"error": {"code": "INTERNAL",
+                                   "message": str(e)[:300],
+                                   "retryable": False}}
+
+    # -- health probing -------------------------------------------------------
+
+    def _probe_once(self, backend: Backend) -> bool:
+        """One GET of the probe path on a FRESH connection (probes
+        verify reachability; a pooled socket would hide a dead
+        process behind kernel buffers)."""
+        self._maybe_inject_down(backend)
+        conn = http.client.HTTPConnection(
+            backend.host, backend.port,
+            timeout=self.policy.probe_timeout_s)
+        try:
+            conn.request("GET", self.policy.probe_path)
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        finally:
+            conn.close()
+
+    def _safe_probe(self, backend: Backend) -> bool:
+        try:
+            return self._probe_once(backend)
+        except Exception:  # noqa: BLE001 — any failure is "down"
+            return False
+
+    def probe_all(self) -> None:
+        """One probing pass over the fleet (the prober thread's body;
+        callable directly for deterministic tests). Probes run
+        CONCURRENTLY: one wedged accepting-but-unresponsive backend
+        must cost the pass one probe timeout, not stall every other
+        backend's health cadence by it."""
+        targets = []
+        for b in self._backends:
+            if b.circuit.state == STATE_OPEN:
+                continue  # still inside the re-probe holdoff
+            allowed, _, token = b.circuit.allow()
+            if not allowed:
+                continue  # half-open slots saturated
+            targets.append((b, token))
+        if targets:
+            futures = [(b, token,
+                        self._io_pool.submit(self._safe_probe, b))
+                       for b, token in targets]
+            for b, token, fut in futures:
+                ok = fut.result()
+                b.last_probe_ok = ok
+                b.last_probe_t = self._clock()
+                self.metrics.probes_total.inc(
+                    backend=b.name, ok="true" if ok else "false")
+                b.note_result(ok, token)
+        self._update_routable_gauge()
+
+    def _probe_loop(self):
+        while not self._stop_probing.wait(self.policy.probe_interval_s):
+            try:
+                self.probe_all()
+            except Exception:  # noqa: BLE001 — the prober must survive
+                pass
+
+    # -- fleet federation -----------------------------------------------------
+
+    def _fetch_backend_json(self, backend: Backend, path: str,
+                            timeout: Optional[float] = None
+                            ) -> Optional[dict]:
+        conn = http.client.HTTPConnection(
+            backend.host, backend.port,
+            timeout=timeout if timeout is not None
+            else self.policy.probe_timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return json.loads(resp.read())
+        except Exception:  # noqa: BLE001 — a dead backend just drops out
+            return None
+        finally:
+            conn.close()
+
+    def _fetch_all(self, path: str) -> Dict[str, Optional[dict]]:
+        """GET ``path`` from every backend CONCURRENTLY (name → doc,
+        None for the unreachable). Serial fetches would stall each
+        federation request by up to N x probe_timeout_s when backends
+        hang — one slow backend must cost one timeout, not N."""
+        futures = {b.name: self._io_pool.submit(self._fetch_backend_json,
+                                                b, path)
+                   for b in self._backends}
+        return {name: f.result() for name, f in futures.items()}
+
+    def _federated_instruments(self):
+        docs = self._fetch_all("/metrics?format=json")
+        snaps = {}
+        for b in self._backends:
+            doc = docs.get(b.name)
+            if doc is not None:
+                snaps[b.index] = {"generation": 1, "metrics": doc}
+
+        def on_conflict(name, _reason):
+            self.metrics.federation_conflicts_total.inc(name=name)
+
+        return federate_instruments(snaps, on_conflict=on_conflict)
+
+    def render_metrics_text(self, *, openmetrics: bool = False) -> str:
+        """The router scrape: ``router_*`` families UNION every
+        reachable backend's series under ``worker``/``generation``
+        labels (worker = the backend's table index; the name mapping
+        rides ``/debug/fleet``)."""
+        view = _FederatedView(self._federated_instruments())
+        return render_text_multi([self.metrics.registry, view],
+                                 openmetrics=openmetrics)
+
+    def render_metrics_json(self) -> dict:
+        view = _FederatedView(self._federated_instruments())
+        return render_json_multi([self.metrics.registry, view])
+
+    def render_fleet_requests(self, query: str = "") -> dict:
+        """``/debug/requests`` federated: every backend's ledger list
+        view merged newest-first, each record tagged with its backend."""
+        q = ("?" + query) if query else ""
+        merged: List[dict] = []
+        per_backend = {}
+        docs = self._fetch_all("/debug/requests" + q)
+        for b in self._backends:
+            doc = docs.get(b.name)
+            if doc is None:
+                per_backend[b.name] = None
+                continue
+            records = doc.get("records", [])
+            per_backend[b.name] = len(records)
+            for rec in records:
+                rec = dict(rec)
+                rec["backend"] = b.name
+                merged.append(rec)
+        merged.sort(key=lambda r: r.get("t_start", 0.0), reverse=True)
+        return {"count": len(merged), "backends": per_backend,
+                "records": merged}
+
+    def render_fleet_incidents(self) -> dict:
+        """``/debug/incidents`` federated: bundle indexes merged with a
+        ``backend`` tag (fetch one bundle from its backend directly)."""
+        merged: List[dict] = []
+        docs = self._fetch_all("/debug/incidents")
+        for b in self._backends:
+            doc = docs.get(b.name)
+            if doc is None:
+                continue
+            for inc in doc.get("incidents", []):
+                inc = dict(inc)
+                inc["backend"] = b.name
+                merged.append(inc)
+        return {"incidents": merged}
+
+    def proxy_models(self) -> Tuple[int, dict]:
+        """``GET /models`` answered by the first reachable backend (a
+        healthy fleet serves one registry's worth of models)."""
+        for b in self._backends:
+            if not b.routable:
+                continue
+            doc = self._fetch_backend_json(b, "/models")
+            if doc is not None:
+                return 200, doc
+        return 503, NotReadyError("no routable backend").to_json()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        if self._started:
+            return self
+        self._stop_probing.clear()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="fleet-router")
+        self._serve_thread.start()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name="fleet-router-prober")
+        self._probe_thread.start()
+        self._started = True
+        self._update_routable_gauge()
+        record_event("router.start", port=self.port,
+                     backends=[b.name for b in self._backends])
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            self._stop_probing.set()
+            if self._probe_thread is not None:
+                self._probe_thread.join(timeout=5)
+                self._probe_thread = None
+            self._httpd.shutdown()
+            if self._serve_thread is not None:
+                self._serve_thread.join(timeout=10)
+                self._serve_thread = None
+            self._started = False
+            record_event("router.stop", port=self.port)
+        self._httpd.server_close()
+        self._io_pool.shutdown(wait=True)
+        for b in self._backends:
+            b.close_pool()
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+__all__ = [
+    "ADMIN_ACTIVE",
+    "ADMIN_DRAINING",
+    "Backend",
+    "FleetRouter",
+    "HashRing",
+    "RetryBudget",
+    "RouterMetrics",
+    "RouterPolicy",
+]

@@ -165,11 +165,11 @@ from deeplearning4j_tpu.serving.generation import (
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.overload import (
-    PRIORITIES,
     BrownoutLadder,
     BrownoutRung,
     OverloadManager,
     OverloadPolicy,
+    validate_priority,
 )
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 
@@ -607,17 +607,10 @@ class ModelServer:
 
     @staticmethod
     def _validate_priority(priority) -> str:
-        """``X-Priority`` → a known class (default ``normal``). Client-
-        controlled input: anything outside the fixed vocabulary is a
-        400, never a new metric label or a silent default."""
-        if priority is None or priority == "":
-            return "normal"
-        p = str(priority).strip().lower()
-        if p not in PRIORITIES:
-            raise BadRequestError(
-                f"X-Priority must be one of {list(PRIORITIES)}, "
-                f"got {priority!r}")
-        return p
+        """``X-Priority`` → a known class (overload.validate_priority —
+        shared with the fleet router so the two planes can never
+        disagree on the class vocabulary)."""
+        return validate_priority(priority)
 
     @staticmethod
     def _validate_tenant(tenant) -> Optional[str]:

@@ -1,0 +1,996 @@
+"""Fleet router tests (serving/router.py): health table + ejection /
+re-probe, retry-budget accounting, drain state machine, hash affinity,
+retry-elsewhere failover, fleet-level priority shed, federation
+endpoints, the stream proxy, client transport-error typing — and THE
+chaos acceptance: 3 real subprocess backends under load, one SIGKILLed
+mid-stream → zero client-visible failures for retryable traffic,
+ejection < 2 s, re-admission after restart; plus a rolling drain deploy
+with zero failed or dropped in-flight requests.
+
+Budget discipline: pure-logic units use injected clocks and fake
+transports (no HTTP, no jax); the integration fleet is 3 in-process
+ModelServers behind one class-scoped fixture; only the chaos class pays
+for subprocess backends (class-scoped, one spawn for every test in it);
+the 10x-load variant is @pytest.mark.slow.
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import urllib.request
+
+from deeplearning4j_tpu.resilience.faults import (
+    FaultInjector,
+    set_fault_injector,
+)
+from deeplearning4j_tpu.serving import (
+    ConnectionFailedError,
+    FleetRouter,
+    HashRing,
+    ModelRegistry,
+    ModelServer,
+    QueueFullError,
+    RetryBudget,
+    RouterPolicy,
+    ServingClient,
+    spec,
+)
+from deeplearning4j_tpu.serving.router import ADMIN_DRAINING, Backend
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _scale_forward(v, x):
+    """Every output row equals v['scale'] — which backend served a
+    request is readable straight off the response."""
+    return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+
+def _mk_backend_server(scale, *, port=0, version="v1"):
+    registry = ModelRegistry()
+    registry.register("scale", _scale_forward, {"scale": scale},
+                      input_spec=spec((4,)), version=version,
+                      mode="batched", max_batch_size=8,
+                      devices=jax.devices()[:1])
+    server = ModelServer(registry, port=port, sentinel=False)
+    server.start(warm=True)
+    return server, registry
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _raw_predict(url, *, headers=None, rows=1):
+    body = json.dumps({"inputs": [[0.0] * 4] * rows}).encode()
+    req = urllib.request.Request(
+        url + "/v1/models/scale:predict", data=body,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _fleet_debug(url):
+    with urllib.request.urlopen(url + "/debug/fleet", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _wait(cond, timeout_s, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# units: retry budget
+
+
+class TestRetryBudget:
+    def test_deposit_spend_and_cap(self):
+        b = RetryBudget(ratio=0.5, initial=0.0, cap=2.0)
+        assert not b.try_spend()          # empty
+        for _ in range(2):
+            b.deposit()
+        assert b.balance == 1.0
+        assert b.try_spend()              # 2 deposits fund 1 retry
+        assert not b.try_spend()
+        for _ in range(100):
+            b.deposit()                   # cap bounds the bank
+        assert b.balance == 2.0
+
+    def test_exhaustion_is_counted(self):
+        b = RetryBudget(ratio=0.1, initial=1.0, cap=10.0)
+        assert b.try_spend()
+        assert not b.try_spend()
+        assert not b.try_spend()
+        d = b.describe()
+        assert d["spent_total"] == 1 and d["exhausted_total"] == 2
+
+    def test_steady_state_ratio(self):
+        # 100 requests at ratio 0.1 fund exactly ~10 retries
+        b = RetryBudget(ratio=0.1, initial=0.0, cap=100.0)
+        for _ in range(100):
+            b.deposit()
+        n = 0
+        while b.try_spend():
+            n += 1
+        assert n in (9, 10)               # fp accumulation of 0.1s
+
+
+# ---------------------------------------------------------------------------
+# units: consistent-hash ring
+
+
+class TestHashRing:
+    def test_stable_and_deterministic(self):
+        r1 = HashRing(["a", "b", "c"], replicas=32)
+        r2 = HashRing(["a", "b", "c"], replicas=32)
+        for k in ("k1", "k2", "user-42"):
+            assert r1.owner(k, {"a", "b", "c"}) == \
+                r2.owner(k, {"a", "b", "c"})
+
+    def test_falls_through_to_next_eligible(self):
+        ring = HashRing(["a", "b", "c"], replicas=32)
+        keys = [f"key-{i}" for i in range(200)]
+        owners = {k: ring.owner(k, {"a", "b", "c"}) for k in keys}
+        # every backend owns some keys (64 vnodes spread well)
+        assert set(owners.values()) == {"a", "b", "c"}
+        # removing one backend moves ONLY its keys; others stay pinned
+        for k in keys:
+            o2 = ring.owner(k, {"a", "c"})
+            if owners[k] != "b":
+                assert o2 == owners[k]
+            else:
+                assert o2 in ("a", "c")
+
+    def test_no_eligible_returns_none(self):
+        ring = HashRing(["a"], replicas=4)
+        assert ring.owner("k", set()) is None
+
+
+# ---------------------------------------------------------------------------
+# units: policy validation
+
+
+class TestRouterPolicy:
+    @pytest.mark.parametrize("kw", [
+        {"probe_interval_s": 0.0},
+        {"eject_consecutive_failures": 0},
+        {"readmit_probes": 0},
+        {"circuit_failure_rate": 1.5},
+        {"retry_budget_ratio": -0.1},
+        {"retry_budget_cap": 0.0},
+        {"fleet_max_in_flight": 0},
+        {"class_fractions": {"critical": 1.0}},
+        {"class_fractions": {"critical": 1.0, "normal": 2.0,
+                             "batch": 0.5}},
+    ])
+    def test_bad_knobs_rejected(self, kw):
+        with pytest.raises(ValueError):
+            RouterPolicy(**kw).validate()
+
+    def test_circuit_policy_derivation(self):
+        cp = RouterPolicy(reprobe_after_s=2.5,
+                          readmit_probes=4).circuit_policy()
+        assert cp.open_duration_s == 2.5 and cp.half_open_probes == 4
+
+
+# ---------------------------------------------------------------------------
+# units: backend health / ejection / drain state machines (fake clock)
+
+
+class TestBackendStateMachine:
+    def _backend(self, **kw):
+        t = [0.0]
+        policy = RouterPolicy(**kw).validate()
+        b = Backend("b0", "http://127.0.0.1:9", 0, policy,
+                    clock=lambda: t[0])
+        return b, t
+
+    def test_consecutive_failures_trip_ejection(self):
+        b, t = self._backend(eject_consecutive_failures=3)
+        assert b.routable
+        for _ in range(2):
+            b.note_result(False, None)
+        assert b.routable                 # 2 < 3: still in
+        b.note_result(True, None)         # success resets the streak
+        for _ in range(2):
+            b.note_result(False, None)
+        assert b.routable
+        b.note_result(False, None)        # 3rd consecutive: ejected
+        assert not b.routable
+        assert b.circuit.state == "open"
+
+    def test_neutral_does_not_reset_streak(self):
+        b, _ = self._backend(eject_consecutive_failures=3)
+        b.note_result(False, None)
+        b.note_result(False, None)
+        b.note_neutral(None)              # a 503 answer: says nothing
+        b.note_result(False, None)
+        assert not b.routable
+
+    def test_half_open_reprobe_readmits(self):
+        b, t = self._backend(eject_consecutive_failures=2,
+                             reprobe_after_s=5.0, readmit_probes=2)
+        b.note_result(False, None)
+        b.note_result(False, None)
+        assert b.circuit.state == "open"
+        t[0] = 5.1                        # holdoff elapsed: half-open
+        assert b.circuit.state == "half_open"
+        for _ in range(2):                # two healthy probes re-close
+            allowed, _, token = b.circuit.allow()
+            assert allowed
+            b.note_result(True, token)
+        assert b.routable
+
+    def test_failed_probe_reopens_half_open(self):
+        b, t = self._backend(eject_consecutive_failures=2,
+                             reprobe_after_s=5.0)
+        b.note_result(False, None)
+        b.note_result(False, None)
+        t[0] = 5.1
+        allowed, _, token = b.circuit.allow()
+        assert allowed
+        b.note_result(False, token)       # probe failed: back to open
+        assert b.circuit.state == "open"
+        assert not b.routable
+
+    def test_drain_state_machine(self):
+        # real clock: wait_idle's deadline math must actually advance
+        b = Backend("b0", "http://127.0.0.1:9", 0,
+                    RouterPolicy().validate())
+        b.begin()
+        b.admin_state = ADMIN_DRAINING
+        assert not b.routable             # no new sends while draining
+        assert not b.wait_idle(0.05)      # in-flight holds the drain
+
+        def finish():
+            time.sleep(0.05)
+            b.end()
+
+        th = threading.Thread(target=finish)
+        th.start()
+        assert b.wait_idle(2.0)           # drains once in-flight ends
+        th.join()
+        b.admin_state = "active"
+        assert b.routable
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet integration
+
+
+@pytest.fixture(scope="module")
+def backend_servers():
+    """3 in-process ModelServers (scale = 1/2/3 so responses identify
+    their backend), shared by every router class in this module. NOTE
+    the rolling-deploy test hot-swaps them to scales 11/12/13 — later
+    tests must not assume the original values."""
+    servers = [_mk_backend_server(float(i + 1)) for i in range(3)]
+    yield [s for s, _ in servers], [r for _, r in servers]
+    set_fault_injector(None)
+    for s, _ in servers:
+        s.stop(drain=False)
+
+
+_FLEET_SCALES = (1.0, 2.0, 3.0, 11.0, 12.0, 13.0)  # pre/post deploy
+
+
+@pytest.fixture(scope="class")
+def fleet(backend_servers):
+    """The shared servers behind one FleetRouter with a fast probe
+    cadence. Torn down (prober stopped) before the next class runs —
+    classes that arm one-shot fault plans rely on that, because a live
+    prober shares (and consumes) the process-global injector."""
+    servers, registries = backend_servers
+    policy = RouterPolicy(probe_interval_s=0.1, probe_timeout_s=0.5,
+                          reprobe_after_s=0.3)
+    router = FleetRouter(
+        [(f"b{i}", s.url) for i, s in enumerate(servers)],
+        policy=policy).start()
+    ns = type("Fleet", (), {})()
+    ns.servers = servers
+    ns.registries = registries
+    ns.router = router
+    ns.client = ServingClient(router.url, max_retries=2)
+    ns.x = np.zeros((2, 4), np.float32)
+    yield ns
+    set_fault_injector(None)
+    router.stop()
+
+
+class TestFleetIntegration:
+    def test_predict_routes_and_spreads(self, fleet):
+        seen = set()
+        for _ in range(12):
+            out = fleet.client.predict("scale", fleet.x)
+            seen.add(out["outputs"][0][0])
+        assert seen <= {1.0, 2.0, 3.0} and len(seen) >= 2
+        d = fleet.router.describe()
+        served = [b["requests_total"] for b in d["backends"]]
+        assert sum(served) >= 12 and sum(1 for n in served if n) >= 2
+
+    def test_affinity_key_pins_one_backend(self, fleet):
+        outs = {_raw_predict(fleet.router.url,
+                             headers={"X-Routing-Key": "tenant-7"}
+                             )["outputs"][0][0]
+                for _ in range(8)}
+        assert len(outs) == 1             # same key → same backend
+        # different keys spread across the ring
+        many = {_raw_predict(fleet.router.url,
+                             headers={"X-Routing-Key": f"k{i}"}
+                             )["outputs"][0][0]
+                for i in range(24)}
+        assert len(many) >= 2
+
+    def test_injected_outage_ejects_and_readmits(self, fleet):
+        target = 2
+        inj = FaultInjector()
+        inj.plan("router.backend_down", at=1, times=10**6,
+                 arg=float(target))
+        set_fault_injector(inj)
+        t0 = time.monotonic()
+        try:
+            assert _wait(
+                lambda: not fleet.router.backend(f"b{target}").routable,
+                timeout_s=3.0)
+            eject_s = time.monotonic() - t0
+            assert eject_s < 2.0, f"ejection took {eject_s:.2f}s"
+            # traffic keeps flowing around the hole
+            for _ in range(6):
+                fleet.client.predict("scale", fleet.x)
+        finally:
+            set_fault_injector(None)
+        # outage lifted: half-open probes re-admit the backend
+        assert _wait(
+            lambda: fleet.router.backend(f"b{target}").routable,
+            timeout_s=5.0)
+        m = fleet.router.metrics
+        assert m.ejections_total._data  # at least one ejection counted
+
+    def test_fleet_priority_shed_protects_critical(self, fleet):
+        servers_urls = [(f"b{i}", s.url)
+                        for i, s in enumerate(fleet.servers)]
+        policy = RouterPolicy(probe_interval_s=5.0,
+                              fleet_max_in_flight=2)
+        router = FleetRouter(servers_urls, policy=policy).start()
+        inj = FaultInjector()
+        # every backend predict sleeps, holding fleet slots open
+        inj.plan("serving.latency", at=1, times=50, arg=0.4)
+        set_fault_injector(inj)
+        try:
+            c = ServingClient(router.url)
+            done = []
+
+            def occupy():
+                done.append(c.predict("scale", fleet.x,
+                                      priority="normal"))
+
+            threads = [threading.Thread(target=occupy)
+                       for _ in range(2)]
+            for t in threads:
+                t.start()
+            assert _wait(
+                lambda: sum(
+                    b.in_flight for b in router.backends) >= 2,
+                timeout_s=2.0)
+            # fleet full: batch sheds at the ROUTER (no backend paid),
+            # critical borrows through
+            with pytest.raises(QueueFullError) as ei:
+                c.predict("scale", fleet.x, priority="batch")
+            assert "fleet over capacity" in str(ei.value)
+            out = c.predict("scale", fleet.x, priority="critical")
+            assert out["outputs"][0][0] in (1.0, 2.0, 3.0)
+            for t in threads:
+                t.join()
+            assert len(done) == 2         # occupants were never harmed
+        finally:
+            set_fault_injector(None)
+            router.stop()
+
+    def test_readyz_models_and_fleet_debug(self, fleet):
+        with urllib.request.urlopen(fleet.router.url + "/readyz",
+                                    timeout=10) as r:
+            ready = json.loads(r.read())
+        assert ready["ready"] and len(ready["routable"]) == 3
+        with urllib.request.urlopen(fleet.router.url + "/models",
+                                    timeout=10) as r:
+            models = json.loads(r.read())
+        assert models["models"][0]["name"] == "scale"
+        d = _fleet_debug(fleet.router.url)
+        assert {b["name"] for b in d["backends"]} == {"b0", "b1", "b2"}
+        assert set(d["retry_budget"]) >= {"balance", "ratio",
+                                          "spent_total",
+                                          "exhausted_total"}
+        assert d["fleet"]["routable"] == 3
+        assert d["policy"]["eject_consecutive_failures"] == 3
+
+    def test_metrics_federation(self, fleet):
+        fleet.client.predict("scale", fleet.x)
+        with urllib.request.urlopen(fleet.router.url + "/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        # the router's own families
+        assert "router_requests_total" in text
+        assert "router_retry_budget_balance" in text
+        # backend series federated under worker labels
+        assert re.search(
+            r'serving_requests_total\{[^}]*worker="\d"', text)
+        with urllib.request.urlopen(
+                fleet.router.url + "/metrics?format=json",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        names = {f["name"] for f in doc["metrics"]}
+        assert "router_requests_total" in names
+        assert "serving_requests_total" in names
+
+    def test_fleet_requests_ledger_federation(self, fleet):
+        fleet.client.predict("scale", fleet.x)
+        with urllib.request.urlopen(
+                fleet.router.url + "/debug/requests?limit=50",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["count"] >= 1
+        assert all("backend" in rec for rec in doc["records"])
+        with urllib.request.urlopen(
+                fleet.router.url + "/debug/incidents", timeout=10) as r:
+            inc = json.loads(r.read())
+        assert "incidents" in inc
+
+    def test_rolling_deploy_zero_failures(self, fleet):
+        """The drain acceptance: a rolling deploy across the fleet
+        under steady load completes with zero failed or dropped
+        in-flight requests, and every backend serves the new version
+        afterwards."""
+        stop = threading.Event()
+        failures, served = [], []
+        lock = threading.Lock()
+
+        def load():
+            c = ServingClient(fleet.router.url)  # NO client retries:
+            while not stop.is_set():             # the router alone
+                try:                             # must absorb it all
+                    out = c.predict("scale", fleet.x)
+                    with lock:
+                        served.append(out["outputs"][0][0])
+                except Exception as e:  # noqa: BLE001 - test collects
+                    with lock:
+                        failures.append(e)
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=load) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        try:
+            def deploy(name, url):
+                idx = int(name[1:])
+                fleet.registries[idx].deploy(
+                    "scale", {"scale": float(idx + 1) + 10.0},
+                    version="v2")
+
+            report = fleet.router.rolling_deploy(
+                deploy, drain_timeout_s=10.0, readmit_timeout_s=10.0)
+        finally:
+            time.sleep(0.1)
+            stop.set()
+            for t in threads:
+                t.join()
+        assert failures == []
+        assert len(report) == 3
+        assert all(s["drained"] and s["routable"] for s in report)
+        # the whole fleet serves the new versions now
+        post = {fleet.client.predict("scale", fleet.x)["outputs"][0][0]
+                for _ in range(12)}
+        assert post <= {11.0, 12.0, 13.0}
+        # old-version responses were fine DURING the roll; failed ones
+        # were not
+        assert served and all(
+            v in (1.0, 2.0, 3.0, 11.0, 12.0, 13.0) for v in served)
+
+
+class TestRouterFailover:
+    """Runs AFTER TestFleetIntegration (file order): these tests arm
+    small one-shot ``router.backend_down`` plans on the process-global
+    injector, and any still-running prober would consume the firings
+    before the request path saw them — the class-scoped fleet fixture
+    (live prober) must already be torn down, and the routers built
+    here park their own probing."""
+
+    def _router(self, backend_servers, **kw):
+        servers, _ = backend_servers
+        return FleetRouter(
+            [(f"b{i}", s.url) for i, s in enumerate(servers)],
+            policy=RouterPolicy(probe_interval_s=30.0, **kw)).start()
+
+    def test_retry_elsewhere_on_connect_failure(self, backend_servers):
+        router = self._router(backend_servers)
+        inj = FaultInjector()
+        inj.plan("router.backend_down", at=1, times=1, arg=-1.0)
+        set_fault_injector(inj)
+        try:
+            c = ServingClient(router.url)   # NO client retries: the
+            x = np.zeros((2, 4), np.float32)  # router alone absorbs
+            out = c.predict("scale", x)
+            assert out["outputs"][0][0] in _FLEET_SCALES
+            assert router.budget.spent_total == 1
+            # exactly one consumed firing: the failover retry skips an
+            # exhausted plan instead of counting another trigger
+            assert inj.triggers("router.backend_down") == 1
+        finally:
+            set_fault_injector(None)
+            router.stop()
+
+    def test_timeout_neither_ejects_nor_fails_over(self, backend_servers):
+        """A slow backend is not a dead one: a request timeout passes
+        through as the typed retryable failure WITHOUT burning a
+        failover (the request may still be executing) and WITHOUT
+        feeding the ejection streak (three slow requests must not
+        eject a healthy backend and cascade its load)."""
+        router = self._router(backend_servers, request_timeout_s=0.2)
+        inj = FaultInjector()
+        inj.plan("serving.latency", at=1, times=10, arg=0.6)
+        set_fault_injector(inj)
+        try:
+            c = ServingClient(router.url)
+            with pytest.raises(ConnectionFailedError) as ei:
+                c.predict("scale", np.zeros((1, 4), np.float32))
+            assert "timeout" in str(ei.value)
+            assert router.budget.spent_total == 0
+            assert all(b.consecutive_failures == 0
+                       for b in router.backends)
+            assert all(b.routable for b in router.backends)
+        finally:
+            set_fault_injector(None)
+            router.stop()
+
+    def test_rolling_deploy_aborts_on_failed_drain(self, backend_servers):
+        """A drain that times out with requests still in flight must
+        NOT deploy over them — the walk re-admits and stops."""
+        router = self._router(backend_servers)
+        deployed = []
+        b0 = router.backend("b0")
+        b0.begin()  # a stuck in-flight request the drain cannot clear
+        try:
+            report = router.rolling_deploy(
+                lambda name, url: deployed.append(name),
+                drain_timeout_s=0.1)
+        finally:
+            b0.end()
+            router.stop()
+        assert deployed == []             # deploy_fn never ran
+        assert len(report) == 1
+        assert not report[0]["drained"]
+        assert "deploy skipped" in report[0]["error"]
+        assert report[0]["routable"]      # re-admitted untouched
+
+    def test_retry_budget_exhaustion_passes_failure_through(
+            self, backend_servers):
+        # a zero-ratio, zero-balance budget cannot fund any failover
+        router = self._router(backend_servers,
+                              retry_budget_ratio=0.0,
+                              retry_budget_initial=0.0)
+        inj = FaultInjector()
+        inj.plan("router.backend_down", at=1, times=10**6, arg=-1.0)
+        set_fault_injector(inj)
+        try:
+            c = ServingClient(router.url)
+            with pytest.raises(ConnectionFailedError):
+                c.predict("scale", np.zeros((2, 4), np.float32))
+            assert router.budget.exhausted_total >= 1
+        finally:
+            set_fault_injector(None)
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# stream proxy (stub backends: the router is payload-agnostic transport)
+
+
+class _StreamStub(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    tokens = (1, 2, 3)
+    die_after = None        # int → abort the socket after N tokens
+
+    def log_message(self, *a):  # noqa: N802 - stdlib API
+        pass
+
+    def do_GET(self):  # noqa: N802 - stdlib API
+        body = b'{"ready": true}'
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _chunk(self, line: bytes):
+        self.wfile.write(b"%X\r\n" % len(line) + line + b"\r\n")
+        self.wfile.flush()
+
+    def do_POST(self):  # noqa: N802 - stdlib API
+        n = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(n)) if n else {}
+        if payload.get("stream", True) is False:
+            body = json.dumps({"tokens": list(self.tokens),
+                               "n_tokens": len(self.tokens),
+                               "finish_reason": "length"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        for i, t in enumerate(self.tokens):
+            if self.die_after is not None and i >= self.die_after:
+                self.wfile.flush()
+                self.connection.shutdown(socket.SHUT_RDWR)
+                self.close_connection = True
+                return
+            self._chunk(json.dumps({"token": t}).encode() + b"\n")
+        self._chunk(json.dumps({"done": True}).encode() + b"\n")
+        self.wfile.write(b"0\r\n\r\n")
+
+
+@pytest.fixture()
+def stream_stub():
+    _StreamStub.die_after = None
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StreamStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestStreamProxy:
+    def test_clean_stream_relays_verbatim(self, stream_stub):
+        with FleetRouter([("s", stream_stub)],
+                         policy=RouterPolicy(
+                             probe_interval_s=30.0)) as router:
+            c = ServingClient(router.url, timeout=10)
+            assert list(c.generate("gpt", [1, 2])) == [1, 2, 3]
+
+    def test_failover_before_first_token(self, stream_stub):
+        dead = f"http://127.0.0.1:{_free_port()}"
+        with FleetRouter([("dead", dead), ("live", stream_stub)],
+                         policy=RouterPolicy(
+                             probe_interval_s=30.0)) as router:
+            # affinity pins nothing here; retry may be needed — run a
+            # few to make sure the dead backend is hit at least once
+            c = ServingClient(router.url, timeout=10)
+            for _ in range(4):
+                assert list(c.generate("gpt", [1])) == [1, 2, 3]
+            assert router.metrics.retries_total._data  # failed over
+
+    def test_midstream_death_is_typed_terminal(self, stream_stub):
+        _StreamStub.die_after = 2
+        with FleetRouter([("s", stream_stub)],
+                         policy=RouterPolicy(
+                             probe_interval_s=30.0)) as router:
+            c = ServingClient(router.url, timeout=10)
+            got = []
+            with pytest.raises(ConnectionFailedError):
+                for t in c.generate("gpt", [1]):
+                    got.append(t)
+            assert got == [1, 2]          # relayed tokens stand
+
+    def test_direct_client_midstream_death_is_typed(self, stream_stub):
+        """The satellite covers the DIRECT path too: with no router in
+        front, the stdlib chunked reader swallows the IncompleteRead,
+        so a silent clean-looking EOF without a terminal done/error
+        event must still raise the typed retryable error."""
+        _StreamStub.die_after = 2
+        c = ServingClient(stream_stub, timeout=5)
+        got = []
+        with pytest.raises(ConnectionFailedError):
+            for t in c.generate("gpt", [1]):
+                got.append(t)
+        assert got == [1, 2]
+
+    def test_nonstream_generate_routes_like_predict(self, stream_stub):
+        with FleetRouter([("s", stream_stub)],
+                         policy=RouterPolicy(
+                             probe_interval_s=30.0)) as router:
+            c = ServingClient(router.url, timeout=10)
+            out = c.generate_tokens("gpt", [1], max_new_tokens=3)
+            assert out["tokens"] == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# client transport-error typing (satellite)
+
+
+class TestClientTransportErrors:
+    def test_connection_refused_is_typed_retryable(self):
+        c = ServingClient(f"http://127.0.0.1:{_free_port()}")
+        with pytest.raises(ConnectionFailedError) as ei:
+            c.predict("scale", [[0.0] * 4])
+        assert ei.value.retryable
+
+    def test_reset_then_retry_succeeds(self):
+        """First connection is aborted before any response (reset);
+        the client's retry loop must treat it as retryable and the
+        second attempt lands."""
+        body = json.dumps({"model": "scale", "version": "v1",
+                           "outputs": [[1.0]]}).encode()
+        response = (b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    + b"Content-Length: %d\r\n\r\n" % len(body) + body)
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        port = srv.getsockname()[1]
+        state = {"n": 0}
+
+        def serve():
+            while state["n"] < 2:
+                conn, _ = srv.accept()
+                state["n"] += 1
+                if state["n"] == 1:
+                    # abort: RST instead of a response
+                    conn.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_LINGER,
+                                    b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                    conn.close()
+                    continue
+                conn.recv(65536)
+                conn.sendall(response)
+                conn.close()
+
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        try:
+            c = ServingClient(f"http://127.0.0.1:{port}", max_retries=2,
+                              backoff_base_s=0.01, retry_seed=0)
+            out = c.predict("scale", [[0.0] * 4])
+            assert out["outputs"] == [[1.0]]
+        finally:
+            srv.close()
+            th.join(timeout=5)
+
+    def test_incomplete_read_is_typed(self):
+        """A response truncated mid-body (Content-Length larger than
+        what arrives before the close) raises the typed retryable
+        error, not a raw http.client.IncompleteRead."""
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(2)
+        port = srv.getsockname()[1]
+
+        def serve():
+            conn, _ = srv.accept()
+            conn.recv(65536)
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: application/json\r\n"
+                         b"Content-Length: 1000\r\n\r\n{\"par")
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            conn.close()
+
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        try:
+            c = ServingClient(f"http://127.0.0.1:{port}")
+            with pytest.raises(ConnectionFailedError):
+                c.predict("scale", [[0.0] * 4])
+        finally:
+            srv.close()
+            th.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: 3 subprocess backends, SIGKILL mid-load
+
+
+_BACKEND_SCRIPT = textwrap.dedent("""
+    import sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+                                            spec)
+    port, scale = int(sys.argv[1]), float(sys.argv[2])
+
+    def fwd(v, x):
+        return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+    reg = ModelRegistry()
+    reg.register("scale", fwd, {"scale": scale}, input_spec=spec((4,)),
+                 version=sys.argv[3], mode="batched", max_batch_size=8)
+    srv = ModelServer(reg, port=port, sentinel=False)
+    srv.start(warm=True)
+    print("READY", srv.port, flush=True)
+    while True:
+        time.sleep(3600)
+""")
+
+
+def _spawn_backend(port, scale, version="v1"):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _BACKEND_SCRIPT, str(port), str(scale),
+         version],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    return proc
+
+
+def _await_ready(proc, timeout_s=60.0):
+    line = ""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("READY"):
+            return True
+        if proc.poll() is not None:
+            return False
+    return False
+
+
+@pytest.fixture(scope="class")
+def chaos_fleet():
+    """3 REAL subprocess backends (SIGKILL-able) behind one router."""
+    ports = [_free_port() for _ in range(3)]
+    procs = [_spawn_backend(p, float(i + 1))
+             for i, p in enumerate(ports)]
+    ok = all(_await_ready(p) for p in procs)
+    if not ok:
+        for p in procs:
+            p.kill()
+        pytest.skip("subprocess backends failed to start")
+    policy = RouterPolicy(probe_interval_s=0.25, probe_timeout_s=0.5,
+                          reprobe_after_s=0.5)
+    router = FleetRouter(
+        [(f"b{i}", f"http://127.0.0.1:{p}")
+         for i, p in enumerate(ports)], policy=policy).start()
+    ns = type("ChaosFleet", (), {})()
+    ns.ports = ports
+    ns.procs = procs
+    ns.router = router
+    yield ns
+    router.stop()
+    for p in ns.procs:
+        if p.poll() is None:
+            p.kill()
+    for p in ns.procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _chaos_load(url, *, threads, per_thread, pause_s, barrier=None):
+    """Closed-loop load; returns (served_values, failures)."""
+    served, failures = [], []
+    lock = threading.Lock()
+
+    def run(tid):
+        c = ServingClient(url, max_retries=3, backoff_base_s=0.02,
+                          retry_seed=tid)
+        x = np.zeros((1, 4), np.float32)
+        if barrier is not None:
+            barrier.wait()
+        for _ in range(per_thread):
+            try:
+                out = c.predict("scale", x, deadline_ms=30000)
+                with lock:
+                    served.append(out["outputs"][0][0])
+            except Exception as e:  # noqa: BLE001 - chaos collects all
+                with lock:
+                    failures.append(e)
+            time.sleep(pause_s)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(threads)]
+    for t in ts:
+        t.start()
+    return ts, served, failures
+
+
+class TestFleetChaos:
+    def test_sigkill_mid_load_is_invisible_then_readmits(
+            self, chaos_fleet):
+        """THE acceptance: under steady load, SIGKILL one backend →
+        zero client-visible failures for retryable traffic, the dead
+        backend ejected < 2 s, re-admitted after restart."""
+        router = chaos_fleet.router
+        barrier = threading.Barrier(5)
+        ts, served, failures = _chaos_load(
+            router.url, threads=4, per_thread=30, pause_s=0.01,
+            barrier=barrier)
+        barrier.wait()
+        time.sleep(0.25)                  # load is flowing
+        victim = chaos_fleet.procs[1]
+        victim.send_signal(signal.SIGKILL)
+        t_kill = time.monotonic()
+        victim.wait(timeout=10)
+        assert _wait(lambda: not router.backend("b1").routable,
+                     timeout_s=4.0, interval_s=0.01)
+        eject_s = time.monotonic() - t_kill
+        for t in ts:
+            t.join()
+        # zero client-visible failures: router failover + typed client
+        # retries absorbed the SIGKILL completely
+        assert failures == [], [repr(f) for f in failures[:3]]
+        assert len(served) == 4 * 30
+        assert eject_s < 2.0, f"ejection took {eject_s:.2f}s"
+        # restart on the same port: the prober must re-admit it
+        chaos_fleet.procs[1] = _spawn_backend(
+            chaos_fleet.ports[1], 2.0, version="v2")
+        assert _await_ready(chaos_fleet.procs[1])
+        assert _wait(lambda: router.backend("b1").routable,
+                     timeout_s=10.0)
+        # and traffic reaches it again
+        c = ServingClient(router.url, max_retries=2)
+        x = np.zeros((1, 4), np.float32)
+        seen = {c.predict("scale", x)["outputs"][0][0]
+                for _ in range(18)}
+        assert 2.0 in seen
+
+    def test_fleet_debug_reflects_restart_history(self, chaos_fleet):
+        d = _fleet_debug(chaos_fleet.router.url)
+        b1 = next(b for b in d["backends"] if b["name"] == "b1")
+        assert b1["routable"] and b1["circuit"] == "closed"
+        m = chaos_fleet.router.metrics
+        assert m.ejections_total._data and m.readmissions_total._data
+
+
+@pytest.mark.slow
+class TestFleetChaosHeavy:
+    def test_10x_load_sigkill_and_rolling_restart(self):
+        """Heavy variant: 10x the offered load of the tier-1 chaos
+        test, one SIGKILL mid-stream, then a rolling kill+restart over
+        every backend — still zero client-visible failures."""
+        ports = [_free_port() for _ in range(3)]
+        procs = [_spawn_backend(p, float(i + 1))
+                 for i, p in enumerate(ports)]
+        assert all(_await_ready(p) for p in procs)
+        policy = RouterPolicy(probe_interval_s=0.25,
+                              reprobe_after_s=0.5)
+        router = FleetRouter(
+            [(f"b{i}", f"http://127.0.0.1:{p}")
+             for i, p in enumerate(ports)], policy=policy).start()
+        try:
+            barrier = threading.Barrier(17)
+            ts, served, failures = _chaos_load(
+                router.url, threads=16, per_thread=75, pause_s=0.005,
+                barrier=barrier)
+            barrier.wait()
+            time.sleep(0.5)
+            procs[0].send_signal(signal.SIGKILL)
+            procs[0].wait(timeout=10)
+            time.sleep(1.5)
+            procs[0] = _spawn_backend(ports[0], 1.0, version="v2")
+            assert _await_ready(procs[0])
+            for t in ts:
+                t.join()
+            assert failures == [], [repr(f) for f in failures[:3]]
+            assert len(served) == 16 * 75
+        finally:
+            router.stop()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()

@@ -46,7 +46,8 @@ class TestValidation:
             "train-data-pipeline", "cluster-worker-liveness",
             "cluster-degraded-mode", "anomaly-firing",
             "brownout-engaged", "generation-availability",
-            "generation-ttft-p99"}
+            "generation-ttft-p99", "router-availability",
+            "router-retry-budget-exhausted"}
 
     def test_default_serving_rules_match_example_vocabulary(self):
         known = slo.known_metric_names()
@@ -133,7 +134,7 @@ class TestCheckCLI:
              "--check", EXAMPLE_RULES],
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr
-        assert "ok: 11 rule(s) valid" in out.stdout
+        assert "ok: 13 rule(s) valid" in out.stdout
 
     def test_bad_rules_exit_nonzero(self, tmp_path):
         bad = tmp_path / "bad.json"
