@@ -539,6 +539,63 @@ class CheckpointMetrics:
             "Corrupt checkpoints moved to quarantine/.", namespace=ns)
 
 
+class WarmstartMetrics:
+    """Cold-start robustness instruments: the persistent compile cache's
+    integrity layer (runtime/compilecache.py) and the traffic-derived
+    warmup manifests (serving/warmstart.py). Process-global — a compile
+    cache is shared by every server/trainer in the process."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        self.cache_active = r.gauge(
+            "compile_cache_active",
+            "1 while a verified persistent compile cache directory is "
+            "armed on jax (0 = cold compiles every process start).")
+        self.cache_entries = r.gauge(
+            "compile_cache_entries",
+            "Artifacts currently recorded in the compile-cache "
+            "integrity manifest.")
+        self.cache_bytes = r.gauge(
+            "compile_cache_bytes",
+            "Total bytes of manifest-recorded compile-cache artifacts.")
+        self.cache_quarantined_total = r.counter(
+            "compile_cache_quarantined_total",
+            "Cache artifacts quarantined instead of being handed to "
+            "jax (corrupt = digest mismatch, truncated = size "
+            "mismatch, version_skew = written by a different jax).",
+            ("reason",))
+        self.cache_op_seconds = r.histogram(
+            "compile_cache_op_seconds",
+            "Compile-cache integrity operation latency (verify = "
+            "manifest walk + digests, seal = manifest rewrite).",
+            ("op",))
+        self.warmup_shapes_total = r.counter(
+            "warmup_shapes_total",
+            "Shapes AOT-compiled during warmup, by serving plane and "
+            "shape source (manifest = the traffic-derived warmup "
+            "manifest chose it, full = the closed bucket vocabulary).",
+            ("plane", "source"))
+        self.warmup_seconds = r.histogram(
+            "warmup_seconds",
+            "Per-shape warmup latency (compile + first dispatch).",
+            ("plane",))
+        self.manifest_entries = r.gauge(
+            "warmup_manifest_entries",
+            "Distinct (plane, model, shape) entries in the live warmup "
+            "manifest.")
+        self.manifest_writes_total = r.counter(
+            "warmup_manifest_writes_total",
+            "Atomic rewrites of the warmup-manifest file.")
+        self.recompiles_after_warm_total = r.counter(
+            "warmup_recompiles_after_warm_total",
+            "Compiles observed AFTER a plane declared itself warm — "
+            "the exact stall warmup exists to kill; the sentinel's "
+            "recompile_after_warmup detector and the recompile-after-"
+            "warmup burn-rate rule both gate this staying at zero.",
+            ("plane",))
+
+
 def get_training_metrics() -> TrainingMetrics:
     return _bundle("training", TrainingMetrics)
 
@@ -549,3 +606,15 @@ def get_resilience_metrics() -> ResilienceMetrics:
 
 def get_checkpoint_metrics() -> CheckpointMetrics:
     return _bundle("checkpoint", CheckpointMetrics)
+
+
+def get_warmstart_metrics() -> WarmstartMetrics:
+    return _bundle("warmstart", WarmstartMetrics)
+
+
+def warmstart_metrics_or_none() -> Optional[WarmstartMetrics]:
+    """The warmstart bundle gated on the kill switch — the ONE guard
+    every producer (compile cache, registry, generation engine, warmup
+    manifest) shares, so the telemetry-off contract lives here and not
+    in four drifting copies."""
+    return get_warmstart_metrics() if _ENABLED else None

@@ -576,6 +576,15 @@ def default_detectors(*, fire_after: int = 3, clear_after: int = 3,
             description="Sustained XLA recompiles (>= 0.5/s): bucket "
                         "misses are compiling on the hot path.", **k),
         Detector(
+            "recompile_after_warmup",
+            CounterRateProbe("warmup_recompiles_after_warm_total"),
+            mode="ceiling", threshold=0.05,
+            description="Serving planes that declared themselves warm "
+                        "are compiling under traffic — the warmup "
+                        "manifest no longer covers the live shape mix "
+                        "(or warmup was skipped). The invariant is "
+                        "zero; any sustained rate pages.", **k),
+        Detector(
             "serving_queue_buildup",
             GaugeProbe("serving_queue_depth"),
             mode="baseline", threshold=8.0, min_increase=1.0, min_abs=8.0,

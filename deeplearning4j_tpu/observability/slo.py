@@ -194,6 +194,10 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     _metrics.TrainingMetrics(reg)
     _metrics.ResilienceMetrics(reg)
     _metrics.CheckpointMetrics(reg)
+    # the cold-start plane (compile_cache_* / warmup_* families —
+    # runtime/compilecache.py + serving/warmstart.py): the
+    # recompile-after-warmup burn-rate rule validates offline
+    _metrics.WarmstartMetrics(reg)
     SLOMetrics(reg)
     from deeplearning4j_tpu.observability.federation import ClusterMetrics
     from deeplearning4j_tpu.observability.reqlog import ReqLogMetrics

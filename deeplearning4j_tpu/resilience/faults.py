@@ -58,6 +58,17 @@ Named injection points wired through the codebase:
                             path before the backend send (slow-backend /
                             congested-link chaos; drives retry-budget and
                             p99 tests)
+``compile.cache_corrupt``   flips bytes in one persistent-compile-cache
+                            artifact on disk BEFORE the integrity walk
+                            (runtime/compilecache.py ``activate``) — the
+                            manifest check must quarantine it and the
+                            process must degrade to a fresh compile,
+                            never load a poisoned executable
+``compile.cache_stall``     sleeps ``arg`` seconds inside compile-cache
+                            activation (a hung cache filesystem): warmup
+                            — and therefore ``/readyz`` — must stay
+                            not-ready for the duration instead of
+                            declaring a cold process warm
 ==========================  =====================================================
 
 Plans are deterministic: ``at=N`` fires on the N-th trigger of the point
@@ -100,6 +111,8 @@ POINT_TRAIN_WORKER_KILL = "train.worker_kill"
 POINT_SUPERVISOR_SLOT_DEAD = "supervisor.slot_dead"
 POINT_ROUTER_BACKEND_DOWN = "router.backend_down"
 POINT_ROUTER_BACKEND_LATENCY = "router.backend_latency"
+POINT_COMPILE_CACHE_CORRUPT = "compile.cache_corrupt"
+POINT_COMPILE_CACHE_STALL = "compile.cache_stall"
 
 KNOWN_POINTS = (
     POINT_DATA_READ,
@@ -115,6 +128,8 @@ KNOWN_POINTS = (
     POINT_SUPERVISOR_SLOT_DEAD,
     POINT_ROUTER_BACKEND_DOWN,
     POINT_ROUTER_BACKEND_LATENCY,
+    POINT_COMPILE_CACHE_CORRUPT,
+    POINT_COMPILE_CACHE_STALL,
 )
 
 

@@ -112,6 +112,14 @@ ENV_GENERATION = "DL4J_TPU_GENERATION"
 ENV_SLOT_ID = "DL4J_TPU_SLOT_ID"
 ENV_BASELINE_NUM_WORKERS = "DL4J_TPU_BASELINE_NUM_WORKERS"
 ENV_SHRINK_POLICY = "DL4J_TPU_SHRINK_POLICY"
+# cold-start robustness: armed for every generation when the supervisor
+# is given a compile cache dir / warmup manifest, so a relaunch or a
+# re-expanded cohort restores compiled artifacts + the traffic-derived
+# shape mix instead of recompiling from scratch. Literals duplicated
+# from runtime/compilecache.py + serving/warmstart.py — this module
+# must stay importable without jax.
+ENV_COMPILE_CACHE_DIR = "DL4J_TPU_COMPILE_CACHE_DIR"
+ENV_WARMUP_MANIFEST = "DL4J_TPU_WARMUP_MANIFEST"
 
 # the rotation-index file serde/checkpoint.py maintains — watched (never
 # parsed) for the expansion checkpoint boundary, so the supervisor needs
@@ -248,6 +256,8 @@ class ElasticSupervisor:
         slot_healthy: Optional[Callable[[int], bool]] = None,
         slot_ports: Optional[Callable[[int], Sequence[int]]] = None,
         max_topology_changes: int = 16,
+        compile_cache_dir: Optional[str | Path] = None,
+        warmup_manifest: Optional[str | Path] = None,
     ):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -291,6 +301,17 @@ class ElasticSupervisor:
         self.slot_healthy = slot_healthy
         self.slot_ports = slot_ports
         self.max_topology_changes = max_topology_changes
+        # cold-start robustness: a workdir-relative default when True is
+        # passed, any path used verbatim. Each generation's env carries
+        # both, so relaunches AND re-expansions take traffic warm.
+        if compile_cache_dir is True:
+            compile_cache_dir = self.workdir / "compile_cache"
+        if warmup_manifest is True:
+            warmup_manifest = self.workdir / "warmup_manifest.json"
+        self.compile_cache_dir = (Path(compile_cache_dir)
+                                  if compile_cache_dir is not None else None)
+        self.warmup_manifest = (Path(warmup_manifest)
+                                if warmup_manifest is not None else None)
         self.dead_slots: Set[int] = set()
         self.shrinks = 0
         self.expands = 0
@@ -722,6 +743,11 @@ class ElasticSupervisor:
                 env[ENV_SHRINK_POLICY] = str(self.shrink_policy)
             env[ENV_HEARTBEAT_DIR] = str(hb)
             env[ENV_HEARTBEAT_INTERVAL] = str(self.heartbeat_interval_s)
+            if self.compile_cache_dir is not None:
+                self.compile_cache_dir.mkdir(parents=True, exist_ok=True)
+                env[ENV_COMPILE_CACHE_DIR] = str(self.compile_cache_dir)
+            if self.warmup_manifest is not None:
+                env[ENV_WARMUP_MANIFEST] = str(self.warmup_manifest)
             log_path = self.worker_log(wid)
             log = open(log_path, "w")
             try:

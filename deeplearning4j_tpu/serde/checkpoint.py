@@ -82,11 +82,19 @@ def _snapshot_tree(tree: Any):
     return arrays, key_paths, key_impls
 
 
-def _atomic_write_text(path: Path, text: str):
-    """tmp-sibling + os.replace: readers never observe a partial file."""
+def atomic_write_text(path: Path, text: str):
+    """tmp-sibling + os.replace: readers never observe a partial file.
+
+    Shared by every integrity manifest in the repo (checkpoint
+    manifests here, the compile-cache manifest in
+    runtime/compilecache.py, the warmup manifest in
+    serving/warmstart.py) — one crash-consistency idiom, not three."""
     tmp = path.with_name(path.name + ".tmp")
     tmp.write_text(text)
     os.replace(tmp, path)
+
+
+_atomic_write_text = atomic_write_text
 
 
 def _array_sha256(a: np.ndarray) -> str:
@@ -98,12 +106,16 @@ def _array_sha256(a: np.ndarray) -> str:
     return h.hexdigest()
 
 
-def _file_sha256(path: Path) -> str:
+def file_sha256(path: Path) -> str:
+    """Streaming whole-file SHA-256 (the manifest digest primitive)."""
     h = hashlib.sha256()
     with open(path, "rb") as f:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+_file_sha256 = file_sha256
 
 
 def _fault_injector():

@@ -8,6 +8,13 @@ REST serving story, grown into a first-class subsystem).
   overload sheds with structured backpressure errors, never blocks.
 - warmup: pre-compiles the power-of-two batch buckets ParallelInference
   pads to, so no live request eats a first-compile spike.
+- warmstart: cold-start robustness — a bounded, atomically-rewritten
+  warmup manifest records the LIVE (model, bucket) traffic mix, so a
+  restarted process AOT-compiles exactly the shapes that matter before
+  /readyz flips (progress reported as {warmed, total, retry_after_ms}
+  on the 503 body); pairs with the integrity-verified persistent
+  compile cache (runtime/compilecache.py) that turns those compiles
+  into disk reads.
 - metrics: the serving instrument bundle on the shared telemetry core
   (observability/metrics.py; this module re-exports the instruments) —
   Prometheus text format with a JSON twin, and /metrics exposes the
@@ -99,6 +106,11 @@ from deeplearning4j_tpu.serving.router import (
     RouterPolicy,
 )
 from deeplearning4j_tpu.serving.server import ModelServer
+from deeplearning4j_tpu.serving.warmstart import (
+    WarmupManifest,
+    WarmupProgress,
+    resolve_warmup_manifest,
+)
 from deeplearning4j_tpu.serving.warmup import (
     bucket_sizes,
     spec,
@@ -144,9 +156,12 @@ __all__ = [
     "SlotPreemptedError",
     "TenantQuotas",
     "TenantQuotaError",
+    "WarmupManifest",
+    "WarmupProgress",
     "WorkerCrashedError",
     "bucket_sizes",
     "error_from_code",
+    "resolve_warmup_manifest",
     "spec",
     "token_brownout_rung",
     "warmup_inference",

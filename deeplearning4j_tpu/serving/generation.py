@@ -102,6 +102,14 @@ def _bucket(sizes: List[int], n: int) -> int:
     return sizes[-1]
 
 
+def _warmstart_metrics():
+    from deeplearning4j_tpu.observability.metrics import (
+        warmstart_metrics_or_none,
+    )
+
+    return warmstart_metrics_or_none()
+
+
 class GenerationStream:
     """One generation request: the client-side stream handle AND the
     scheduler's per-sequence record. Single consumer: ``tokens()`` /
@@ -340,6 +348,7 @@ class GenerationEngine:
         self._thread: Optional[threading.Thread] = None
         self._metrics = None
         self._overload = None
+        self._manifest = None
         if metrics is not None:
             self.attach_metrics(metrics)
 
@@ -372,6 +381,24 @@ class GenerationEngine:
         clamps the live slot count, its tenant buckets and brownout
         batch-shed flag gate :meth:`submit`."""
         self._overload = manager
+
+    def attach_manifest(self, manifest):
+        """Wire a warmup manifest (serving/warmstart.py): every
+        dispatched prefill bucket and (slot, kv) decode pair feeds the
+        live traffic mix a restarted process warms against."""
+        self._manifest = manifest
+
+    def _note_traffic(self, kind: str, *args):
+        wm = self._manifest
+        if wm is None:
+            return
+        try:
+            if kind == "prefill":
+                wm.note_prefill(self.name, args[0])
+            else:
+                wm.note_decode(self.name, args[0], args[1])
+        except Exception:  # noqa: BLE001 — recording traffic never
+            pass           # fails the scheduler
 
     # -- compiled programs ---------------------------------------------------
 
@@ -432,12 +459,16 @@ class GenerationEngine:
     def _note_compile(self, kind: str, key: str):
         self.compiles_total += 1
         if self.warmed:
-            # bucket sets are closed and warmed in full, so this should
-            # never fire — when it does, it is the exact regression the
-            # recompile-storm detector pages on
+            # bucket sets are closed and (absent a manifest restriction)
+            # warmed in full, so this should never fire — when it does,
+            # it is the exact regression the recompile-storm detector
+            # and the recompile-after-warmup burn rule page on
             self.compiles_after_warm += 1
             record_event("generation.compile", model=self.name, kind=kind,
                          key=key, after_warm=True)
+            wm = _warmstart_metrics()
+            if wm is not None:
+                wm.recompiles_after_warm_total.inc(plane="generation")
 
     def _get_prefill_fn(self, p_bucket: int):
         fn = self._prefill_fns.get(p_bucket)
@@ -455,20 +486,68 @@ class GenerationEngine:
 
     # -- warmup --------------------------------------------------------------
 
-    def warm(self) -> dict:
-        """Compile every prefill bucket and every (slot-bucket,
-        kv-bucket) decode step against the scratch slot, before any
-        traffic — the generation twin of the predict plane's
-        power-of-two batch warmup. Returns {kind: {bucket: seconds}}."""
+    def manifest_warm_plan(self, manifest=None) -> Tuple[
+            List[int], List[Tuple[int, int]]]:
+        """The (prompt buckets, decode pairs) a warm pass should
+        compile: the manifest's observed shapes when it has data for
+        this model, the full closed vocabulary otherwise. Observed
+        shapes outside the vocabulary (a config change shrank the
+        buckets) are dropped; an empty intersection falls back to
+        full — a stale manifest must never yield a ZERO-shape warmup
+        that declares a cold engine ready."""
+        p_list = list(self.prompt_buckets)
+        pairs = [(b, kv) for b in self.slot_buckets
+                 for kv in self.kv_buckets]
+        if manifest is None:
+            manifest = self._manifest
+        if manifest is not None:
+            obs_p = manifest.prefill_buckets(self.name)
+            if obs_p:
+                keep = [p for p in p_list if p in set(obs_p)]
+                if keep:
+                    p_list = keep
+            obs_d = manifest.decode_pairs(self.name)
+            if obs_d:
+                keep = [pr for pr in pairs if pr in set(obs_d)]
+                if keep:
+                    pairs = keep
+        return p_list, pairs
+
+    def warm(self, *, prompt_buckets: Optional[List[int]] = None,
+             decode_pairs: Optional[List[Tuple[int, int]]] = None,
+             progress=None, source: str = "full") -> dict:
+        """Compile prefill buckets and (slot-bucket, kv-bucket) decode
+        steps against the scratch slot, before any traffic — the
+        generation twin of the predict plane's batch warmup. Defaults
+        to the FULL closed vocabulary; pass ``prompt_buckets`` /
+        ``decode_pairs`` (e.g. from :meth:`manifest_warm_plan`) to warm
+        exactly the live traffic mix. ``progress`` is an optional
+        ``(key, seconds)`` per-shape callback (the /readyz progress
+        body). Returns {kind: {bucket: seconds}}."""
         if self.running:
             # the scheduler thread owns the slabs; warm() reassigning
             # them under a live decode loop would race (and on donating
             # backends hand an already-consumed buffer to one side)
             raise RuntimeError(
                 "warm() must run before start() (or after stop())")
+        if prompt_buckets is None:
+            prompt_buckets = list(self.prompt_buckets)
+        if decode_pairs is None:
+            decode_pairs = [(b, kv) for b in self.slot_buckets
+                            for kv in self.kv_buckets]
+        wm = _warmstart_metrics()
+
+        def note(key, seconds):
+            if wm is not None:
+                wm.warmup_shapes_total.inc(plane="generation",
+                                           source=source)
+                wm.warmup_seconds.observe(seconds, plane="generation")
+            if progress is not None:
+                progress(key, seconds)
+
         stats: Dict[str, Dict[str, float]] = {"prefill": {}, "decode": {}}
         t_all = time.monotonic()
-        for p in self.prompt_buckets:
+        for p in prompt_buckets:
             t0 = time.monotonic()
             fn = self._get_prefill_fn(p)
             ks, vs, tok = fn(self._params, self._kslabs, self._vslabs,
@@ -479,20 +558,21 @@ class GenerationEngine:
             self._kslabs, self._vslabs = ks, vs
             np.asarray(tok)
             stats["prefill"][str(p)] = round(time.monotonic() - t0, 4)
-        for b in self.slot_buckets:
-            for kv in self.kv_buckets:
-                t0 = time.monotonic()
-                fn = self._get_decode_fn(b, kv)
-                ks, vs, tok = fn(
-                    self._params, self._kslabs, self._vslabs,
-                    self._base_key, np.int32(0),
-                    np.full(b, self._scratch, np.int32),
-                    np.zeros(b, np.int32), np.zeros(b, np.int32),
-                    np.zeros(b, np.float32))
-                self._kslabs, self._vslabs = ks, vs
-                np.asarray(tok)
-                stats["decode"][f"{b}x{kv}"] = round(
-                    time.monotonic() - t0, 4)
+            note(str(p), stats["prefill"][str(p)])
+        for b, kv in decode_pairs:
+            t0 = time.monotonic()
+            fn = self._get_decode_fn(b, kv)
+            ks, vs, tok = fn(
+                self._params, self._kslabs, self._vslabs,
+                self._base_key, np.int32(0),
+                np.full(b, self._scratch, np.int32),
+                np.zeros(b, np.int32), np.zeros(b, np.int32),
+                np.zeros(b, np.float32))
+            self._kslabs, self._vslabs = ks, vs
+            np.asarray(tok)
+            stats["decode"][f"{b}x{kv}"] = round(
+                time.monotonic() - t0, 4)
+            note(f"{b}x{kv}", stats["decode"][f"{b}x{kv}"])
         self.warmed = True
         record_event("generation.warmup", model=self.name,
                      programs=self.compiles_total,
@@ -833,6 +913,7 @@ class GenerationEngine:
     def _prefill(self, req: GenerationStream):
         t0v = req.prompt_len
         p = _bucket(self.prompt_buckets, t0v)
+        self._note_traffic("prefill", p)
         fn = self._get_prefill_fn(p)
         prompt = np.zeros(p, np.int32)
         prompt[:t0v] = req.prompt
@@ -889,6 +970,7 @@ class GenerationEngine:
         b = _bucket(self.slot_buckets, len(active))
         kv = _bucket(self.kv_buckets,
                      min(max(r.pos for r in active) + 1, self.max_len))
+        self._note_traffic("decode", b, kv)
         slot_idx = np.full(b, self._scratch, np.int32)
         ids = np.zeros(b, np.int32)
         pos = np.zeros(b, np.int32)

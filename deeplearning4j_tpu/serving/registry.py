@@ -41,7 +41,11 @@ from deeplearning4j_tpu.serving.errors import (
     NotReadyError,
     ServingError,
 )
-from deeplearning4j_tpu.serving.warmup import bucket_sizes, warmup_inference
+from deeplearning4j_tpu.serving.warmup import (
+    bucket_sizes,
+    warm_all_replicas,
+    warmup_inference,
+)
 
 
 class _Active:
@@ -71,10 +75,17 @@ class ModelEntry:
         self.devices = devices
         # registered-but-dormant cheaper variables the brownout ladder
         # hot-swaps in at its deepest rung (set_fallback / the
-        # registry's engage_fallback / disengage_fallback)
+        # registry's engage_fallback / disengage_fallback). With
+        # prewarm (the default) the fallback's replica set is built and
+        # bucket-warmed at registration, so engaging it under overload
+        # is a pointer swap — ZERO compiles exactly when the process
+        # can least afford a recompile storm.
         self.fallback_variables: Any = None
         self.fallback_version: Optional[str] = None
         self.fallback_engaged = False
+        self._fallback_pi = None          # prewarmed dormant replica set
+        self._fallback_warmed_sizes: List[int] = []
+        self._fallback_lock = threading.Lock()
         self._lock = threading.Lock()
         # Serializes deploy/rollback (history mutation + swap) so
         # concurrent deploys can't leave the active version out of sync
@@ -83,6 +94,10 @@ class ModelEntry:
         self._active: Optional[_Active] = None
         self.history: List[Tuple[str, Any]] = []  # (version, variables)
         self.warmed = False
+        # the buckets the last warm() actually compiled: traffic landing
+        # outside this set after warm is a recompile-after-warmup — the
+        # regression warmup_recompiles_after_warm_total machine-checks
+        self.warmed_buckets: set = set()
         # static cost analyses are a compile each — cache per (version,
         # rows) so /debug/costs polling never recompiles
         self._cost_cache: Dict[Tuple[str, int], dict] = {}
@@ -113,15 +128,92 @@ class ModelEntry:
         if active is not None:
             active.pi.set_batch_wait(seconds)
 
-    def set_fallback(self, variables: Any, version: Optional[str] = None):
+    def set_fallback(self, variables: Any, version: Optional[str] = None,
+                     *, prewarm: bool = True):
         """Register dormant cheaper variables (a distilled/quantized
-        twin) the brownout ladder deploys at its deepest rung via the
-        normal warmed hot-swap path; ``disengage`` rolls back."""
+        twin) the brownout ladder deploys at its deepest rung;
+        ``disengage`` rolls back.
+
+        ``prewarm`` (default): build + bucket-warm the fallback's
+        replica set NOW — paying the compiles at registration, when the
+        process is healthy — so ``engage_fallback`` under overload is a
+        pointer swap with zero compiles instead of the recompile storm
+        brownout exists to avoid. The prewarmed set idles (worker
+        threads parked on an empty queue) until engaged; disengaging
+        re-prewarms in the background for the next brownout cycle.
+        ``prewarm=False`` keeps the historical lazy behavior (the
+        compiles happen inside ``engage_fallback``'s warmed deploy)."""
         self.fallback_variables = variables
         self.fallback_version = version
+        if prewarm:
+            self._prewarm_fallback()
 
-    def warm(self) -> Dict[int, float]:
-        """Pre-compile every batch bucket on the active replica set.
+    def _manifest_warm_sizes(self) -> List[int]:
+        """Manifest-restricted buckets when traffic data exists, the
+        full vocabulary otherwise — a deploy (or fallback prewarm) must
+        be warm for the shapes traffic is actually hitting."""
+        manifest = getattr(self._registry, "_warm_manifest", None)
+        all_sizes = bucket_sizes(self.max_batch_size, self.mode)
+        if manifest is not None:
+            observed = manifest.predict_buckets(self.name)
+            if observed:
+                sizes = [s for s in all_sizes if s in set(observed)]
+                if sizes:
+                    return sizes
+        return all_sizes
+
+    def _dead(self) -> bool:
+        with self._lock:
+            return self._active is None and bool(self.history)
+
+    def _prewarm_fallback(self):
+        """Build + warm a dormant replica set from the registered
+        fallback variables; a failure records a flight event and
+        leaves the lazy engage path as the fallback's fallback.
+
+        The compiles run OUTSIDE ``_fallback_lock`` — a background
+        re-prewarm must never make ``entry.shutdown()`` (a drain
+        deadline) or the next ``engage_fallback`` (an overloaded
+        process) wait out minutes of warmup. Install is a short
+        critical section that re-checks liveness, so a prewarm racing
+        shutdown discards its own set instead of leaking it."""
+        with self._fallback_lock:
+            if self.fallback_variables is None or self._fallback_pi \
+                    is not None or self._dead():
+                return
+            variables = self.fallback_variables
+        pi = self._build_pi(variables)
+        sizes = self._manifest_warm_sizes()
+        try:
+            # full (bucket x replica) coverage: the engage-under-
+            # overload contract is ZERO compiles, so queue-routed
+            # warmup (one device per bucket) is not enough here
+            warm_all_replicas(pi, self.input_spec, sizes)
+        except BaseException:
+            pi.shutdown()
+            _record_flight("serving.fallback_prewarm_failed",
+                           model=self.name)
+            raise
+        with self._fallback_lock:
+            if self._dead() or self._fallback_pi is not None:
+                # the entry shut down (or a concurrent prewarm won)
+                # while this set compiled: discard, don't park worker
+                # threads + replicas on a dead/duplicated slot
+                pi.shutdown()
+                return
+            self._fallback_pi = pi
+            # what THIS set actually compiled — engage must stamp these,
+            # not whatever the manifest says by then (buckets observed
+            # in between were never warmed on the fallback replicas)
+            self._fallback_warmed_sizes = list(sizes)
+        _record_flight("serving.fallback_prewarm", model=self.name,
+                       version=self.fallback_version or "")
+
+    def warm(self, sizes: Optional[Sequence[int]] = None,
+             progress=None, source: str = "full") -> Dict[int, float]:
+        """Pre-compile batch buckets on the active replica set —
+        ``sizes`` (e.g. a warmup manifest's observed buckets) or the
+        full vocabulary.
 
         Expects no concurrent traffic on this entry (the standard paths —
         ``ModelServer.start(warm=True)`` before serving begins, and
@@ -132,10 +224,21 @@ class ModelEntry:
             active = self._active
         if active is None:
             raise NotReadyError(f"model '{self.name}' is shut down")
-        stats = warmup_inference(
-            active.pi, self.input_spec,
-            bucket_sizes(self.max_batch_size, self.mode))
+        if sizes is None:
+            sizes = bucket_sizes(self.max_batch_size, self.mode)
+        wm = _warmstart_metrics()
+
+        def note(rows, seconds, _cb=progress):
+            if wm is not None:
+                wm.warmup_shapes_total.inc(plane="predict", source=source)
+                wm.warmup_seconds.observe(seconds, plane="predict")
+            if _cb is not None:
+                _cb(rows, seconds)
+
+        stats = warmup_inference(active.pi, self.input_spec, sizes,
+                                 progress=note)
         self.warmed = True
+        self.warmed_buckets = set(sizes)
         self._registry._record_ready(self.name, True)
         return stats
 
@@ -305,6 +408,10 @@ class ModelEntry:
             active, self._active = self._active, None
         if active is not None:
             active.pi.shutdown()
+        with self._fallback_lock:
+            fb, self._fallback_pi = self._fallback_pi, None
+        if fb is not None:
+            fb.shutdown()
 
 
 class ModelRegistry:
@@ -313,6 +420,7 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._metrics = metrics
         self._admission = None
+        self._warm_manifest = None
 
     def attach_metrics(self, metrics):
         """Wire a ServingMetrics bundle (occupancy/device-latency hooks
@@ -324,10 +432,17 @@ class ModelRegistry:
         feed its Retry-After overshoot EWMA."""
         self._admission = admission
 
+    def attach_manifest(self, manifest):
+        """Wire a :class:`~deeplearning4j_tpu.serving.warmstart.
+        WarmupManifest`: every dispatched batch's bucket feeds the live
+        traffic mix the next restart warms against."""
+        self._warm_manifest = manifest
+
     # -- metrics hooks (called from ParallelInference workers) -------------
 
     def _record_batch(self, name: str, n_requests: int, rows: int,
-                      bucket: int, seconds: float):
+                      bucket: int, seconds: float, *,
+                      record_manifest: bool = True):
         m = self._metrics
         if m is not None:
             m.batch_occupancy.observe(rows / max(bucket, 1), model=name)
@@ -335,6 +450,31 @@ class ModelRegistry:
         ac = self._admission
         if ac is not None and hasattr(ac, "observe_service_time"):
             ac.observe_service_time(seconds)
+        entry = self._entries.get(name)
+        wm = self._warm_manifest
+        if wm is not None and record_manifest \
+                and (entry is None or entry.warmed):
+            # LIVE traffic only: warmup's own zero-batches flow through
+            # this hook too (entry not yet warmed) and recording them
+            # would teach the manifest the full vocabulary, defeating
+            # the restrict-to-traffic restart
+            try:
+                wm.note_batch(name, bucket)
+            except Exception:  # noqa: BLE001 — recording traffic never
+                pass           # fails serving
+        # recompile-after-warm detection: a dispatched bucket outside
+        # the warmed set compiled on the hot path (counted once — the
+        # program exists afterwards). The entry lookup is a dict get;
+        # the set test is O(1).
+        if entry is not None and entry.warmed \
+                and entry.warmed_buckets \
+                and bucket not in entry.warmed_buckets:
+            entry.warmed_buckets.add(bucket)
+            wsm = _warmstart_metrics()
+            if wsm is not None:
+                wsm.recompiles_after_warm_total.inc(plane="predict")
+            _record_flight("serving.recompile_after_warm", model=name,
+                           bucket=bucket)
 
     def _record_expired(self, name: str, n: int):
         m = self._metrics
@@ -461,16 +601,34 @@ class ModelRegistry:
     # -- brownout fallback versions ----------------------------------------
 
     def engage_fallback(self, name: str) -> Optional[str]:
-        """Deploy the entry's registered fallback variables through the
-        normal warmed hot-swap (the old version keeps serving while the
-        cheaper one pre-compiles). Returns the deployed version, or
-        None when no fallback is registered / it is already engaged."""
+        """Swap the entry's registered fallback in. With a prewarmed
+        fallback set (the ``set_fallback`` default) this is a pointer
+        swap — ZERO compiles, the property the regression test pins;
+        otherwise it falls back to the normal warmed hot-swap (the old
+        version keeps serving while the cheaper one pre-compiles).
+        Returns the deployed version, or None when no fallback is
+        registered / it is already engaged."""
         entry = self.get(name)
         if entry.fallback_variables is None or entry.fallback_engaged:
             return None
         fb_version = entry.fallback_version or f"{entry.version}-fallback"
-        version = self.deploy(name, entry.fallback_variables,
-                              version=fb_version)
+        with entry._fallback_lock:
+            pi, entry._fallback_pi = entry._fallback_pi, None
+            warmed_sizes = entry._fallback_warmed_sizes
+        if pi is not None:
+            with entry._deploy_lock:
+                self._swap_prewarmed(entry, pi, fb_version, warmed_sizes)
+                entry.history.append((fb_version,
+                                      entry.fallback_variables))
+                if len(entry.history) > 2:
+                    old_version, _ = entry.history[-3]
+                    entry.history[-3] = (old_version, None)
+            version = fb_version
+            _record_flight("serving.deploy", model=name, version=version,
+                           warm=True, prewarmed=True)
+        else:
+            version = self.deploy(name, entry.fallback_variables,
+                                  version=fb_version)
         entry.fallback_engaged = True
         _record_flight("serving.fallback", model=name, version=version,
                        engaged=True)
@@ -478,8 +636,10 @@ class ModelRegistry:
 
     def disengage_fallback(self, name: str) -> Optional[str]:
         """Roll back from the engaged fallback to the version that was
-        serving before the brownout. Returns the restored version, or
-        None when no fallback is engaged."""
+        serving before the brownout, then re-prewarm the fallback in
+        the background for the next brownout cycle (cheap under an
+        active persistent compile cache). Returns the restored version,
+        or None when no fallback is engaged."""
         entry = self.get(name)
         if not entry.fallback_engaged:
             return None
@@ -487,23 +647,67 @@ class ModelRegistry:
         entry.fallback_engaged = False
         _record_flight("serving.fallback", model=name, version=version,
                        engaged=False)
+        threading.Thread(target=self._reprewarm, args=(entry,),
+                         daemon=True,
+                         name=f"fallback-prewarm-{name}").start()
         return version
+
+    @staticmethod
+    def _reprewarm(entry: ModelEntry):
+        try:
+            entry._prewarm_fallback()
+        except Exception:  # noqa: BLE001 — flight event already recorded;
+            pass           # the lazy engage path remains
+
+    def _swap_prewarmed(self, entry: ModelEntry, pi, version: str,
+                        warmed_sizes: Sequence[int]):
+        """Activate an already-warmed replica set (the prewarmed
+        fallback): the pointer swap of ``_swap`` without the build or
+        the compiles. ``warmed_sizes`` is what the set ACTUALLY
+        compiled at prewarm time — stamping the manifest's current view
+        instead would blind the recompile-after-warm check for buckets
+        observed since. Caller holds the deploy lock and appends
+        history."""
+        with entry._lock:
+            old, entry._active = entry._active, _Active(pi, version)
+            entry.warmed = True
+            entry.warmed_buckets = set(warmed_sizes)
+        self._record_ready(entry.name, True)
+        if old is not None:
+            old.pi.shutdown()
 
     def _swap(self, entry: ModelEntry, variables, version: str, warm: bool):
         new_pi = entry._build_pi(variables)
+        sizes = entry._manifest_warm_sizes()
         if warm:
+            # warm batches from the not-yet-active set report through
+            # the same on_batch hook as live traffic: pre-extend the
+            # warmed set so they never count as recompiles-after-warm,
+            # and mute manifest recording on the new set for the warm
+            # window — the OLD version is warmed, so the live-traffic
+            # gate alone would record these zero-batches and teach the
+            # manifest the full vocabulary
+            added = set(sizes) - entry.warmed_buckets
+            entry.warmed_buckets |= added
+            new_pi._on_batch = functools.partial(
+                self._record_batch, entry.name, record_manifest=False)
             try:
-                warmup_inference(new_pi, entry.input_spec,
-                                 bucket_sizes(entry.max_batch_size,
-                                              entry.mode))
+                warmup_inference(new_pi, entry.input_spec, sizes)
             except BaseException:
-                # failed deploy: the old version keeps serving; don't leak
-                # the half-built replica set's worker threads
+                # failed deploy: the old version keeps serving — don't
+                # leak the half-built replica set's worker threads, and
+                # roll the warmed-set pre-extension back or the old
+                # version's recompile-after-warm check goes blind for
+                # buckets it never compiled
+                entry.warmed_buckets -= added
                 new_pi.shutdown()
                 raise
+            new_pi._on_batch = functools.partial(
+                self._record_batch, entry.name)
         with entry._lock:
             old, entry._active = entry._active, _Active(new_pi, version)
             entry.warmed = warm
+            entry.warmed_buckets = set(sizes) if warm else set()
         self._record_ready(entry.name, warm)
         if old is not None:
             old.pi.shutdown()  # FIFO drain: queued requests still served
@@ -531,6 +735,16 @@ class ModelRegistry:
     def shutdown_all(self):
         for entry in self.entries():
             entry.shutdown()
+
+
+def _warmstart_metrics():
+    """Warmstart bundle, or None when telemetry is off — the
+    recompile-after-warm counter and warmup histograms."""
+    from deeplearning4j_tpu.observability.metrics import (
+        warmstart_metrics_or_none,
+    )
+
+    return warmstart_metrics_or_none()
 
 
 def _record_flight(kind: str, **data):

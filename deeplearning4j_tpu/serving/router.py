@@ -439,6 +439,10 @@ class Backend:
         self.requests_total = 0
         self.last_probe_ok: Optional[bool] = None
         self.last_probe_t: Optional[float] = None
+        # the backend's last-reported warmup progress ({warmed, total,
+        # retry_after_ms} from a 503 /readyz body) — a restarting
+        # backend compiling its manifest is ALIVE, not opaquely down
+        self.warming: Optional[dict] = None
         self._clock = clock
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
@@ -459,8 +463,13 @@ class Backend:
 
     @property
     def routable(self) -> bool:
+        # warming is-not-None: the last probe answered 503-with-warmup-
+        # progress. Routing there would shed every request retryably and
+        # burn the fleet retry budget exactly during the window warmup
+        # exists to protect — hold traffic until a ready probe clears it
         return (self.admin_state == ADMIN_ACTIVE
-                and self.circuit.state == STATE_CLOSED)
+                and self.circuit.state == STATE_CLOSED
+                and self.warming is None)
 
     def begin(self) -> None:
         with self._lock:
@@ -565,6 +574,7 @@ class Backend:
             "consecutive_failures": fails,
             "requests_total": requests,
             "window": {"n": n, "failure_rate": round(rate, 4)},
+            "warming": self.warming,
             "last_probe_ok": self.last_probe_ok,
             "last_probe_age_s": (
                 round(self._clock() - self.last_probe_t, 3)
@@ -1517,10 +1527,13 @@ class FleetRouter:
 
     # -- health probing -------------------------------------------------------
 
-    def _probe_once(self, backend: Backend) -> bool:
+    def _probe_once(self, backend: Backend) -> Tuple[str, Optional[dict]]:
         """One GET of the probe path on a FRESH connection (probes
         verify reachability; a pooled socket would hide a dead
-        process behind kernel buffers)."""
+        process behind kernel buffers). Returns ``(verdict,
+        warming)``: ``"ready"`` | ``"warming"`` (a 503 whose body
+        carries the /readyz warmup-progress fields — the backend is
+        alive and compiling its manifest) | ``"down"``."""
         self._maybe_inject_down(backend)
         conn = http.client.HTTPConnection(
             backend.host, backend.port,
@@ -1528,16 +1541,29 @@ class FleetRouter:
         try:
             conn.request("GET", self.policy.probe_path)
             resp = conn.getresponse()
-            resp.read()
-            return resp.status == 200
+            raw = resp.read()
+            if resp.status == 200:
+                return "ready", None
+            if resp.status == 503:
+                try:
+                    body = json.loads(raw)
+                except Exception:  # noqa: BLE001 — non-JSON 503 body
+                    return "down", None
+                if isinstance(body, dict) and body.get("total") \
+                        and body.get("warmed") is not None \
+                        and not body.get("draining", False):
+                    return "warming", {
+                        k: body.get(k)
+                        for k in ("warmed", "total", "retry_after_ms")}
+            return "down", None
         finally:
             conn.close()
 
-    def _safe_probe(self, backend: Backend) -> bool:
+    def _safe_probe(self, backend: Backend) -> Tuple[str, Optional[dict]]:
         try:
             return self._probe_once(backend)
         except Exception:  # noqa: BLE001 — any failure is "down"
-            return False
+            return "down", None
 
     def probe_all(self) -> None:
         """One probing pass over the fleet (the prober thread's body;
@@ -1558,12 +1584,25 @@ class FleetRouter:
                         self._io_pool.submit(self._safe_probe, b))
                        for b, token in targets]
             for b, token, fut in futures:
-                ok = fut.result()
+                verdict, warming = fut.result()
+                ok = verdict == "ready"
                 b.last_probe_ok = ok
                 b.last_probe_t = self._clock()
+                b.warming = warming
                 self.metrics.probes_total.inc(
                     backend=b.name, ok="true" if ok else "false")
-                b.note_result(ok, token)
+                if verdict == "warming":
+                    # alive-but-compiling is probe-NEUTRAL: it must not
+                    # re-open a half-open circuit (that backoff would
+                    # stretch re-admission past the warmup it is
+                    # waiting on) and must not count as healthy either —
+                    # re-admission waits for genuine warmth
+                    record_event("router.backend_warming", backend=b.name,
+                                 **{k: v for k, v in warming.items()
+                                    if k != "retry_after_ms"})
+                    b.note_neutral(token)
+                else:
+                    b.note_result(ok, token)
         self._update_routable_gauge()
 
     def _probe_loop(self):

@@ -19,7 +19,15 @@ what the repo already ships. Endpoints:
 - ``GET /models``   — registry contents (name, version, history, warmed).
 - ``GET /healthz``  — process liveness, always 200 while serving.
 - ``GET /readyz``   — 200 only after every registered model's warmup
-  completed AND the server is not draining; 503 otherwise.
+  completed AND the server is not draining; 503 otherwise. While a
+  warmup pass is in flight the 503 body carries progress —
+  ``{warmed: k, total: n, retry_after_ms}`` plus a ``Retry-After``
+  header — so the fleet router's prober treats a warming backend as
+  alive-but-compiling (probe-neutral) and retrying clients back off by
+  the estimate instead of a blind schedule. ``start(warm_async=True)``
+  binds the port immediately and warms in the background (the
+  restart-under-load shape); predicts against a still-cold model shed
+  with a retryable 503 instead of sneaking a compile into the warmup.
 - ``GET /metrics``  — Prometheus text format; ``?format=json`` for the
   JSON twin. Renders this server's serving bundle UNION the process-
   global default registry (observability/metrics.py), so the train /
@@ -141,6 +149,8 @@ from deeplearning4j_tpu.parallel.inference import (
     WorkerCrashError,
 )
 from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_injector
+from deeplearning4j_tpu.runtime import compilecache as _compilecache
+from deeplearning4j_tpu.serving import warmstart as _warmstart
 from deeplearning4j_tpu.serving.admission import AdmissionController
 from deeplearning4j_tpu.serving.circuit import (
     STATE_NUM,
@@ -212,8 +222,34 @@ class ModelServer:
         sentinel_interval_s: float = 10.0,
         incident_dir: Optional[str] = None,
         incident_profile_ms: float = 250.0,
+        warmup_manifest=None,
+        compile_cache=None,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
+        # Cold-start robustness (serving/warmstart.py + runtime/
+        # compilecache.py): the warmup manifest records the live
+        # (model, bucket) traffic mix and start() AOT-compiles exactly
+        # those shapes before /readyz flips; the persistent compile
+        # cache (integrity-verified, quarantining) makes each of those
+        # compiles a disk read on restart. Both default from env
+        # (DL4J_TPU_WARMUP_MANIFEST / DL4J_TPU_COMPILE_CACHE_DIR; the
+        # elastic supervisor arms them per generation); pass False to
+        # disable explicitly, a path or instance to configure directly.
+        self.warm_manifest = _warmstart.resolve_warmup_manifest(
+            warmup_manifest)
+        if self.warm_manifest is not None:
+            self.registry.attach_manifest(self.warm_manifest)
+        self._compile_cache_disabled = compile_cache is False
+        if compile_cache is False:
+            self.compile_cache = None
+        elif isinstance(compile_cache, _compilecache.CompileCache):
+            self.compile_cache = compile_cache
+        elif compile_cache is not None:
+            self.compile_cache = _compilecache.CompileCache(compile_cache)
+        else:
+            self.compile_cache = None  # start() falls back to env
+        self._warm_progress = _warmstart.WarmupProgress()
+        self._warm_thread: Optional[threading.Thread] = None
         if metrics is not None:
             self.metrics = metrics
         elif getattr(self.registry, "_metrics", None) is not None:
@@ -358,7 +394,8 @@ class ModelServer:
                     self._send(200, {"status": "ok"})
                 elif path == "/readyz":
                     body = server.readiness()
-                    self._send(200 if body["ready"] else 503, body)
+                    self._send(200 if body["ready"] else 503, body,
+                               retry_after_ms=body.get("retry_after_ms"))
                 elif path == "/models":
                     self._send(200, {"models": server.registry.describe()})
                 elif path == "/metrics":
@@ -554,6 +591,11 @@ class ModelServer:
         body = {"ready": ready, "draining": self._draining, "models": models}
         if gens:
             body["generators"] = gens
+        if self._warm_progress.active and not ready:
+            # warmup in flight: report progress so the router prober and
+            # retrying clients compose with it ({warmed: k, total: n,
+            # retry_after_ms}; the /readyz 503 also carries Retry-After)
+            body.update(self._warm_progress.snapshot())
         return body
 
     @property
@@ -674,6 +716,18 @@ class ModelServer:
                 if self._draining or not self._started:
                     raise NotReadyError("server is draining" if self._draining
                                         else "server not started")
+                if not entry.warmed and self._warm_progress.active:
+                    # warmup in flight (HTTP answers during it so /readyz
+                    # can report progress): traffic must not reach the
+                    # replica set — a live request coalescing with a
+                    # warmup batch would skip buckets, and the request
+                    # itself would eat a compile
+                    snap = self._warm_progress.snapshot()
+                    raise NotReadyError(
+                        f"model '{name}' is warming up "
+                        f"({snap['warmed']}/{snap['total']} shapes "
+                        "compiled)",
+                        retry_after_ms=snap["retry_after_ms"])
                 if not isinstance(payload, dict) or "inputs" not in payload:
                     raise BadRequestError('body must be {"inputs": ...}')
                 # circuit breaker: a version failing at/above the policy
@@ -841,6 +895,8 @@ class ModelServer:
             raise ValueError(f"generator '{name}' already registered")
         engine.name = name
         engine.attach_metrics(self.metrics)
+        if self.warm_manifest is not None:
+            engine.attach_manifest(self.warm_manifest)
         self.generators[name] = engine
         if self.overload is not None:
             engine.attach_overload(self.overload)
@@ -935,6 +991,13 @@ class ModelServer:
                     raise NotReadyError("server is draining"
                                         if self._draining
                                         else "server not started")
+                if not engine.warmed and self._warm_progress.active:
+                    snap = self._warm_progress.snapshot()
+                    raise NotReadyError(
+                        f"generator '{name}' is warming up "
+                        f"({snap['warmed']}/{snap['total']} shapes "
+                        "compiled)",
+                        retry_after_ms=snap["retry_after_ms"])
                 if not isinstance(payload, dict) or "prompt" not in payload:
                     raise BadRequestError(
                         'body must be {"prompt": [ids...]}')
@@ -1259,22 +1322,144 @@ class ModelServer:
                     eng.start()
         return out
 
-    def start(self, *, warm: bool = True) -> "ModelServer":
+    def _warm_plan(self):
+        """What a start-time warmup will compile: ``[(kind, target,
+        shapes)]`` + the total shape count. Manifest-observed shapes
+        when the warmup manifest has data for a model, the full closed
+        vocabulary otherwise. Computed synchronously (no compiles) so
+        the /readyz progress body knows its denominator before the
+        first compile starts."""
+        from deeplearning4j_tpu.serving.warmup import bucket_sizes
+
+        manifest = self.warm_manifest
+        plan, total = [], 0
+        for e in self.registry.entries():
+            if e.warmed:
+                continue
+            sizes = e._manifest_warm_sizes()
+            # label by what actually happened, not by whether the
+            # manifest had rows: a stale manifest whose buckets all
+            # fell out of the vocabulary warmed the FULL set
+            full = bucket_sizes(e.max_batch_size, e.mode)
+            source = "manifest" if sizes != full else "full"
+            plan.append(("entry", e, sizes, source))
+            total += len(sizes)
+        for eng in self.generators.values():
+            if eng.warmed:
+                continue
+            p_list, pairs = eng.manifest_warm_plan(manifest)
+            n_full = len(eng.prompt_buckets) + \
+                len(eng.slot_buckets) * len(eng.kv_buckets)
+            source = ("manifest" if len(p_list) + len(pairs) < n_full
+                      else "full")
+            plan.append(("engine", eng, (p_list, pairs), source))
+            total += len(p_list) + len(pairs)
+        return plan, total
+
+    def _run_warm_plan(self, plan, *, raise_errors: bool):
+        """Execute a warm plan, feeding per-shape progress; on success
+        start the engines, seal the compile cache, and flush the
+        manifest — the moment /readyz flips, the next restart's warm
+        assets are already on disk."""
+        t0 = time.monotonic()
+        note = lambda _key, seconds: self._warm_progress.note(seconds)  # noqa: E731
+        try:
+            for kind, target, shapes, source in plan:
+                if self._draining:
+                    return
+                if kind == "entry":
+                    target.warm(sizes=shapes, progress=note,
+                                source=source)
+                else:
+                    target.warm(prompt_buckets=shapes[0],
+                                decode_pairs=shapes[1],
+                                progress=note, source=source)
+        except BaseException as e:
+            record_event("serving.warmup_error", error=str(e)[:200])
+            if raise_errors:
+                raise
+            return  # async warm racing stop(): readyz stays 503
+        finally:
+            self._warm_progress.finish()
+        for eng in self.generators.values():
+            if eng.warmed and not eng.running and self._started \
+                    and not self._draining:
+                eng.start()
+        if self.compile_cache is not None:
+            try:
+                self.compile_cache.seal()
+            except Exception:  # noqa: BLE001 — an unsealed cache only
+                pass           # costs the NEXT restart its head start
+        if self.warm_manifest is not None:
+            self.warm_manifest.save()
+        record_event("serving.warmup_complete",
+                     shapes=self._warm_progress.snapshot()["warmed"],
+                     seconds=round(time.monotonic() - t0, 3))
+
+    def start(self, *, warm: bool = True,
+              warm_async: bool = False) -> "ModelServer":
+        """Serve. ``warm`` pre-compiles every registered model/engine
+        (manifest-restricted when a warmup manifest has traffic data)
+        before ``/readyz`` flips; ``warm_async=True`` returns
+        immediately and warms on a background thread — HTTP answers
+        throughout, ``/readyz`` 503s with ``{warmed, total,
+        retry_after_ms}`` progress, and predicts shed retryably until
+        their model is warm (the restart-under-load shape: the process
+        binds its port at once, the router re-admits only on genuine
+        warmth)."""
         if self._started:
             return self
+        if self.compile_cache is None:
+            if not self._compile_cache_disabled:
+                # fall back to the env-armed process cache (the
+                # supervisor sets DL4J_TPU_COMPILE_CACHE_DIR for worker
+                # generations); compile_cache=False opted out
+                # explicitly and stays out
+                self.compile_cache = \
+                    _compilecache.maybe_enable_compile_cache()
+        elif not self.compile_cache.active:
+            self.compile_cache.activate()
+            _compilecache.set_compile_cache(self.compile_cache)
         if warm:
-            self.warm_all()
+            # plan + progress BEFORE the HTTP thread exists: the
+            # warming shed guard keys on _warm_progress.active, and a
+            # request slipping in ahead of begin() would dispatch into
+            # the replica queue and coalesce with a warmup batch
+            plan, total = self._warm_plan()
+            self._warm_progress.begin(total)
         self._serve_thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="model-server")
         self._serve_thread.start()
         self._started = True
-        # only warmed engines get their scheduler: an unwarmed engine's
-        # later warm_all() must never race a live scheduler over the
-        # donated slabs (requests submitted meanwhile wait in its queue)
-        for eng in self.generators.values():
-            if eng.warmed:
-                eng.start()
+        if warm:
+            if warm_async:
+                self._warm_thread = threading.Thread(
+                    target=self._run_warm_plan, args=(plan,),
+                    kwargs={"raise_errors": False}, daemon=True,
+                    name="server-warmup")
+                self._warm_thread.start()
+            else:
+                try:
+                    self._run_warm_plan(plan, raise_errors=True)
+                except BaseException:
+                    # failed sync start leaves NO running state (the
+                    # historical contract: warm ran before anything
+                    # started) — a retried start() must re-enter the
+                    # warm path, not bounce off the _started guard
+                    # into an unwarmed, engine-less server
+                    self._httpd.shutdown()
+                    self._serve_thread.join(timeout=10)
+                    self._started = False
+                    raise
+        else:
+            # only warmed engines get their scheduler: an unwarmed
+            # engine's later warm_all() must never race a live scheduler
+            # over the donated slabs (requests submitted meanwhile wait
+            # in its queue)
+            for eng in self.generators.values():
+                if eng.warmed:
+                    eng.start()
         self.slo_engine.start()
         if self.overload is not None:
             self.overload.start()
@@ -1336,6 +1521,15 @@ class ModelServer:
         for eng in self.generators.values():
             eng.stop()
         self.registry.shutdown_all()
+        # an async warm pass races stop(): the replica-set shutdown
+        # above fails its next warm batch, so the short join below is a
+        # compile's tail, not a full warmup
+        if self._warm_thread is not None and self._warm_thread.is_alive():
+            self._warm_thread.join(timeout=10)
+        if self.warm_manifest is not None:
+            # final flush: the traffic mix this run observed survives
+            # the process — that is the whole point of the manifest
+            self.warm_manifest.save()
         return drained
 
     def __enter__(self) -> "ModelServer":

@@ -58,16 +58,51 @@ def zeros_batch(input_spec: Any, rows: int):
 
 
 def warmup_inference(pi, input_spec: Any,
-                     sizes: Optional[Sequence[int]] = None
-                     ) -> Dict[int, float]:
+                     sizes: Optional[Sequence[int]] = None, *,
+                     progress: Optional[Any] = None) -> Dict[int, float]:
     """Push one zero-batch per bucket through ``pi``; returns
     {rows: seconds}. Sequential on purpose: concurrent warmup requests
-    would coalesce into one batch and skip buckets."""
+    would coalesce into one batch and skip buckets. ``progress`` is an
+    optional ``(rows, seconds)`` callback fired after each bucket —
+    the ``/readyz`` warmup-progress body reads it."""
     if sizes is None:
         sizes = bucket_sizes(pi._max_batch, pi._mode)
     stats: Dict[int, float] = {}
     for rows in sizes:
         t0 = time.monotonic()
         pi.output(zeros_batch(input_spec, rows))
+        stats[rows] = time.monotonic() - t0
+        if progress is not None:
+            progress(rows, stats[rows])
+    return stats
+
+
+def warm_all_replicas(pi, input_spec: Any,
+                      sizes: Optional[Sequence[int]] = None
+                      ) -> Dict[int, float]:
+    """Warm every bucket on EVERY replica by dispatching directly to
+    each device, bypassing the request queue.
+
+    ``warmup_inference`` pushes one batch per bucket through the queue,
+    so on a multi-device replica set each bucket compiles only on
+    whichever worker grabbed it — jit caches per (shape, device), and
+    live traffic landing on a different replica still pays a first-hit
+    compile. That is tolerable for start-time warmup (traffic spreads
+    fast) but NOT for the brownout fallback prewarm, whose whole
+    contract is that engaging under overload compiles nothing; this is
+    the deterministic full-coverage variant it uses."""
+    import jax.numpy as jnp
+
+    if sizes is None:
+        sizes = bucket_sizes(pi._max_batch, pi._mode)
+    stats: Dict[int, float] = {}
+    for rows in sizes:
+        batch = jax.tree_util.tree_map(jnp.asarray,
+                                       zeros_batch(input_spec, rows))
+        t0 = time.monotonic()
+        for device, replica in zip(pi._devices, pi._replicas):
+            out = pi._fn(replica, jax.device_put(batch, device))
+            jax.tree_util.tree_map(
+                lambda a: a.block_until_ready(), out)
         stats[rows] = time.monotonic() - t0
     return stats

@@ -671,6 +671,11 @@ class Trainer:
         steps_per_epoch: Optional[int] = None,
     ) -> TrainState:
         listeners = listeners or []
+        # persistent compile cache (DL4J_TPU_COMPILE_CACHE_DIR): a
+        # supervisor-relaunched or re-expanded worker restores its step
+        # programs from disk instead of recompiling — activation is
+        # idempotent and a no-op when the env is unset
+        _maybe_enable_compile_cache()
         # opt-in starvation remediation (DL4J_TPU_AUTO_PREFETCH=1): the
         # data_starved detector below names the read-dominated step; this
         # is its minimal fix — reads move to a background prefetch thread
@@ -906,6 +911,7 @@ def _record_batch_transfer(batch):
 
 from deeplearning4j_tpu.data.dataset import as_batch_dict as _as_batch_dict  # noqa: E402
 from deeplearning4j_tpu.data.iterators import maybe_auto_prefetch as _maybe_auto_prefetch  # noqa: E402
+from deeplearning4j_tpu.runtime.compilecache import maybe_enable_compile_cache as _maybe_enable_compile_cache  # noqa: E402
 from deeplearning4j_tpu.observability.incidents import (  # noqa: E402
     enter_training as _incidents_enter_training,
     exit_training as _incidents_exit_training,

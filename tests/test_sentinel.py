@@ -527,6 +527,7 @@ class TestDetectorStateMachine:
         assert names == {
             "train_step_time_regression", "serving_p99_regression",
             "generation_ttft_regression", "recompile_storm",
+            "recompile_after_warmup",
             "serving_queue_buildup", "train_data_starvation",
             "live_array_bytes_leak", "hbm_bytes_leak"}
         # every probed family is in the validation vocabulary
