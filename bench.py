@@ -1636,10 +1636,14 @@ def bench_observability(peak, *, steps=64, batch_size=128, hidden=512,
         span_us = (time.perf_counter() - t0) / span_n * 1e6
 
         reg = MetricsRegistry()
+        # analysis: allow(unregistered-metric) — throwaway families on a
+        # private registry pricing render_text; never scraped, never
+        # referenced by an SLO rule
         c = reg.counter("bench_series_total", "render-latency probe",
                         ("idx",))
         for i in range(series):
             c.inc(idx=str(i))
+        # analysis: allow(unregistered-metric) — same render-latency probe
         h = reg.histogram("bench_latency_seconds", "render-latency probe")
         for i in range(256):
             h.observe(i * 1e-4)

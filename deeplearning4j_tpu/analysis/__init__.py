@@ -1,0 +1,94 @@
+"""Static-analysis + runtime-sanitizer plane.
+
+``python -m deeplearning4j_tpu.analysis --check`` runs every static
+pass over the package (plus ``bench.py``) and the GUIDE.md knob-table
+drift check, exiting nonzero on any unsuppressed finding — wired into
+tier-1, so the defect classes reviews used to hand-catch (ABBA lock
+cycles, blocking work under locks, jit-traced host effects, vocabulary
+drift) fail the build instead. See ``docs/GUIDE.md`` § "Static
+analysis & sanitizers" for rules and the allowlist syntax, and
+``analysis/lockcheck.py`` for the runtime half.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import List, Optional, Sequence
+
+from deeplearning4j_tpu.analysis import knobs as knobs  # noqa: F401
+from deeplearning4j_tpu.analysis.core import (
+    Finding, filter_findings, iter_sources, package_root, repo_root)
+from deeplearning4j_tpu.analysis.lockpasses import run_lock_passes
+from deeplearning4j_tpu.analysis.tracedpass import run_traced_pass
+from deeplearning4j_tpu.analysis.vocabpass import run_vocab_pass
+
+
+@dataclasses.dataclass
+class CheckResult:
+    findings: List[Finding]      # active (unsuppressed), sorted
+    allowlisted: int
+    n_files: int
+    duration_s: float
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines.append(
+            f"analysis: {len(self.findings)} finding(s), "
+            f"{self.allowlisted} allowlisted, {self.n_files} file(s), "
+            f"{self.duration_s * 1000:.0f} ms")
+        return "\n".join(lines)
+
+
+def default_roots() -> List[str]:
+    roots = [package_root()]
+    bench = os.path.join(repo_root(), "bench.py")
+    if os.path.isfile(bench):
+        roots.append(bench)
+    return roots
+
+
+def default_guide() -> Optional[str]:
+    guide = os.path.join(repo_root(), "docs", "GUIDE.md")
+    return guide if os.path.isfile(guide) else None
+
+
+def run_check(roots: Optional[Sequence[str]] = None,
+              guide: Optional[str] = None,
+              check_unused_knobs: Optional[bool] = None) -> CheckResult:
+    """Run every static pass. ``roots=None`` scans the installed
+    package + repo ``bench.py`` and checks GUIDE.md drift; explicit
+    roots (fixture tests) skip the tree-global checks unless asked."""
+    t0 = time.monotonic()
+    whole_tree = roots is None
+    if roots is None:
+        roots = default_roots()
+        if guide is None:
+            guide = default_guide()
+    if check_unused_knobs is None:
+        check_unused_knobs = whole_tree
+    sources = iter_sources(list(roots))
+    findings: List[Finding] = []
+    for sf in sources:
+        findings.extend(sf.comment_findings)
+    lock_findings, _graph = run_lock_passes(sources)
+    findings.extend(lock_findings)
+    findings.extend(run_traced_pass(sources))
+    findings.extend(run_vocab_pass(sources,
+                                   check_unused_knobs=check_unused_knobs))
+    by_rel = {sf.rel: sf for sf in sources}
+    active, suppressed = filter_findings(findings, by_rel)
+    if guide:
+        for err in knobs.check_guide(guide):
+            active.append(Finding("knob-table-drift",
+                                  os.path.relpath(guide, repo_root())
+                                  if guide.startswith(repo_root())
+                                  else guide, 1, err))
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    return CheckResult(active, suppressed, len(sources),
+                       time.monotonic() - t0)

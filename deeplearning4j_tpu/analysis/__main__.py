@@ -1,0 +1,74 @@
+"""CLI: ``python -m deeplearning4j_tpu.analysis --check``.
+
+Mirrors the ``slo --check`` idiom: offline, deterministic, nonzero
+exit on any problem, fast enough to sit in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from deeplearning4j_tpu.analysis import (
+    default_guide, knobs, run_check)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="Concurrency & invariant static analysis "
+                    "(lock-order cycles, blocking-under-lock, "
+                    "jit-traced hazards, vocabulary drift)")
+    ap.add_argument("--check", action="store_true",
+                    help="run every pass; nonzero exit on any "
+                         "unsuppressed finding")
+    ap.add_argument("--root", action="append", default=None,
+                    metavar="PATH",
+                    help="scan PATH (file or directory) instead of the "
+                         "installed package + bench.py; repeatable")
+    ap.add_argument("--guide", default=None, metavar="GUIDE_MD",
+                    help="GUIDE.md to drift-check the knob table "
+                         "against (default: the repo's docs/GUIDE.md "
+                         "when scanning the default roots)")
+    ap.add_argument("--no-guide", action="store_true",
+                    help="skip the knob-table drift check")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--write-knob-table", action="store_true",
+                    help="regenerate the GUIDE.md knob table from "
+                         "analysis/knobs.py and exit")
+    args = ap.parse_args(argv)
+
+    if args.write_knob_table:
+        guide = args.guide or default_guide()
+        if guide is None:
+            print("error: no GUIDE.md found; pass --guide",
+                  file=sys.stderr)
+            return 2
+        changed = knobs.write_guide_table(guide)
+        print(f"{guide}: {'updated' if changed else 'already in sync'}")
+        return 0
+
+    if not args.check:
+        ap.print_help()
+        return 2
+
+    guide = None if args.no_guide else args.guide
+    if args.root is None and guide is None and not args.no_guide:
+        guide = default_guide()
+    res = run_check(roots=args.root, guide=guide)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in res.findings],
+            "allowlisted": res.allowlisted,
+            "files": res.n_files,
+            "duration_s": round(res.duration_s, 3),
+        }, indent=2))
+    else:
+        print(res.render())
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,261 @@
+"""Shared machinery for the static-analysis plane.
+
+One parse per file per process: every pass receives the same cached
+``SourceFile`` objects (AST + allowlist comments + declared lock
+edges), so ``--check`` over the whole package stays well inside its
+tier-1 time budget no matter how many passes run.
+
+Allowlist syntax (a finding on line N is suppressed by a comment on
+line N or N-1):
+
+    # analysis: allow(blocking-under-lock) — scrape is bounded, <1 ms
+
+The reason text after the dash is MANDATORY — an allow without a
+written reason is itself a finding (``allow-missing-reason``). Declared
+lock edges teach the lock-order graph about orderings the AST cannot
+see (callback indirection):
+
+    # analysis: lock-edge(CircuitBreaker._lock -> Backend._lock) — why
+
+Stdlib only; importing this module must never import jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# every rule a pass can emit (the CLI validates allow(...) names
+# against this so a typo'd allow is caught instead of silently dead)
+RULES = frozenset({
+    "lock-order-cycle",
+    "blocking-under-lock",
+    "traced-hazard",
+    "unregistered-metric",
+    "unregistered-event-kind",
+    "unregistered-knob",
+    "unused-knob",
+    "knob-table-drift",
+    "allow-missing-reason",
+    "unknown-allow-rule",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str        # repo-relative when possible (stable across hosts)
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+# matches the allow-comment syntax shown in the module docstring;
+# accepts em/en dash or ASCII "-"/"--" as the reason separator
+_ALLOW_RE = re.compile(
+    r"#\s*analysis:\s*allow\(([a-z\-, ]+)\)\s*(?:(?:—|–|--|-)\s*(\S.*))?$")
+_EDGE_RE = re.compile(
+    r"#\s*analysis:\s*lock-edge\(\s*([\w.]+)\s*->\s*([\w.]+)\s*\)"
+    r"\s*(?:(?:—|–|--|-)\s*(\S.*))?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeclaredEdge:
+    src: str
+    dst: str
+    line: int
+    reason: str
+
+
+class SourceFile:
+    """One parsed source file: AST + comment-derived side tables."""
+
+    def __init__(self, path: str, text: str, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.modname = os.path.splitext(os.path.basename(path))[0]
+        # line -> (set of allowed rules, reason or "")
+        self.allow: Dict[int, Tuple[frozenset, str]] = {}
+        self.declared_edges: List[DeclaredEdge] = []
+        self.comment_findings: List[Finding] = []
+        self._lines: Optional[List[str]] = None   # lazy splitlines cache
+        self._scan_comments()
+
+    def _scan_comments(self):
+        # fast path: tokenizing every file costs as much as parsing it,
+        # and only files carrying a directive need the comment table
+        if "analysis:" not in self.text:
+            return
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except tokenize.TokenizeError:  # pragma: no cover - ast parsed OK
+            comments = []
+        for line, comment in comments:
+            m = _ALLOW_RE.search(comment)
+            if m:
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+                reason = (m.group(2) or "").strip()
+                if not reason:
+                    self.comment_findings.append(Finding(
+                        "allow-missing-reason", self.rel, line,
+                        "allow(...) without a written reason — every "
+                        "suppression must say why"))
+                unknown = rules - RULES
+                if unknown:
+                    self.comment_findings.append(Finding(
+                        "unknown-allow-rule", self.rel, line,
+                        f"allow names unknown rule(s) "
+                        f"{sorted(unknown)} — known: {sorted(RULES)}"))
+                self.allow[line] = (rules, reason)
+            m = _EDGE_RE.search(comment)
+            if m:
+                self.declared_edges.append(DeclaredEdge(
+                    m.group(1), m.group(2), line,
+                    (m.group(3) or "").strip()))
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """Is ``rule`` suppressed at ``line``? An allow directive covers
+        the line it sits on and the statement directly below its
+        comment block (the directive may be any line of a multi-line
+        comment)."""
+        if not self.allow:      # the common case: no directives at all
+            return False
+        entry = self.allow.get(line)
+        if entry and rule in entry[0]:
+            return True
+        lines = self._lines
+        if lines is None:
+            lines = self._lines = self.text.splitlines()
+        ln = line - 1
+        while ln >= 1 and ln > line - 8 and \
+                lines[ln - 1].lstrip().startswith("#"):
+            entry = self.allow.get(ln)
+            if entry and rule in entry[0]:
+                return True
+            ln -= 1
+        return False
+
+    def docstring_nodes(self) -> set:
+        """ids of Constant nodes that are docstrings (skipped by literal
+        scans — prose, not code)."""
+        out = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                body = getattr(node, "body", [])
+                if body and isinstance(body[0], ast.Expr) and \
+                        isinstance(body[0].value, ast.Constant) and \
+                        isinstance(body[0].value.value, str):
+                    out.add(id(body[0].value))
+        return out
+
+
+# -- per-process parse cache --------------------------------------------------
+
+_CACHE: Dict[str, Tuple[float, SourceFile]] = {}
+
+
+def package_root() -> str:
+    """The installed ``deeplearning4j_tpu`` package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def _rel(path: str) -> str:
+    root = repo_root()
+    ap = os.path.abspath(path)
+    return os.path.relpath(ap, root) if ap.startswith(root) else ap
+
+
+def load_source(path: str) -> SourceFile:
+    ap = os.path.abspath(path)
+    try:
+        mtime = os.path.getmtime(ap)
+    except OSError:
+        mtime = 0.0
+    hit = _CACHE.get(ap)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    with open(ap, encoding="utf-8") as fh:
+        text = fh.read()
+    sf = SourceFile(ap, text, _rel(ap))
+    _CACHE[ap] = (mtime, sf)
+    return sf
+
+
+def iter_sources(roots: Sequence[str]) -> List[SourceFile]:
+    """Every ``.py`` under each root (a root may also be one file),
+    parsed once per process. Deterministic order (sorted paths)."""
+    paths: List[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            paths.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            paths.extend(os.path.join(dirpath, f)
+                         for f in sorted(filenames) if f.endswith(".py"))
+    out = []
+    seen = set()
+    for p in paths:
+        ap = os.path.abspath(p)
+        if ap in seen:
+            continue
+        seen.add(ap)
+        out.append(load_source(ap))
+    return out
+
+
+# -- small AST helpers shared by the passes -----------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def string_constants(node: ast.AST) -> List[str]:
+    """Every string Constant inside ``node`` (handles the
+    ``"a" if cond else "b"`` first-arg idiom)."""
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def filter_findings(findings: Iterable[Finding],
+                    sources: Dict[str, SourceFile]
+                    ) -> Tuple[List[Finding], int]:
+    """Partition into (active, n_allowlisted) using each file's
+    allow comments."""
+    active: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        sf = sources.get(f.path)
+        if sf is not None and sf.allowed(f.rule, f.line):
+            suppressed += 1
+        else:
+            active.append(f)
+    return active, suppressed

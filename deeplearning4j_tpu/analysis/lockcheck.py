@@ -1,0 +1,344 @@
+"""Runtime lock-order sanitizer: instrumented locks, armed by env.
+
+The static lock-order pass sees what the AST shows; callback
+indirection, duck-typed attributes, and cross-instance interleavings it
+cannot. This is the ThreadSanitizer-style other half: an opt-in
+instrumented ``Lock`` factory that, while armed, records each thread's
+acquisition stack, maintains the observed lock-order graph, and
+reports
+
+- **lock-order-inversion** — thread acquires B while holding A after
+  some thread has acquired A while holding B (the PR 13 ABBA shape),
+  reported ONCE per lock pair with *both* acquisition stacks;
+- **lock-long-hold** — a hold exceeding ``DL4J_TPU_LOCKCHECK_HOLD_S``
+  (default 1.0 s; the static pass classifies *what* blocked, this
+  catches that it *did*), reported with the acquisition stack.
+
+Arming: ``DL4J_TPU_SANITIZERS=lockorder`` (comma-separated list, so
+future sanitizers compose). Unarmed, ``make_lock()`` returns a plain
+``threading.Lock`` — zero overhead, which is why production call sites
+adopt the factory unconditionally. Lock identity is the NAME given to
+the factory (``"Backend._lock"``), aggregated across instances; the
+order graph is name-level, matching the static pass, so same-name
+sibling locks never define an order. Instrumented locks compose with
+``threading.Condition`` (the stdlib fallback protocol: ``wait()``
+releases and reacquires through our ``acquire``/``release``, keeping
+the held-set truthful across waits).
+
+Each violation increments ``sanitizer_violations_total{rule=...}`` and
+records a ``sanitizer.violation`` flight event; chaos acceptance tests
+arm the sanitizer and assert ``violations() == []``, so every merged
+PR re-proves the fleet's lock discipline under real concurrency.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+ENV_SANITIZERS = "DL4J_TPU_SANITIZERS"
+ENV_HOLD_S = "DL4J_TPU_LOCKCHECK_HOLD_S"
+DEFAULT_HOLD_S = 1.0
+MAX_VIOLATIONS = 100          # bounded: a pathological loop must not OOM
+_STACK_LIMIT = 24
+
+
+def armed() -> bool:
+    """Is the lockorder sanitizer armed (read per lock CREATION, so a
+    test can arm/disarm around object construction)?"""
+    return "lockorder" in [
+        s.strip() for s in os.environ.get(ENV_SANITIZERS, "").split(",")]
+
+
+def hold_threshold_s() -> float:
+    try:
+        return float(os.environ.get(ENV_HOLD_S, str(DEFAULT_HOLD_S)))
+    except ValueError:
+        return DEFAULT_HOLD_S
+
+
+# -- global sanitizer state ---------------------------------------------------
+
+_state = threading.Lock()     # guards the order graph + violation list
+# (held_name, acquired_name) -> first witness
+#   {"thread", "held_stack", "acquire_stack"}
+_order: Dict[Tuple[str, str], dict] = {}
+_reported: set = set()        # frozenset({a, b}) pairs already reported
+_violations: List[dict] = []
+_tls = threading.local()
+
+
+def _held() -> List[dict]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _metrics():
+    from deeplearning4j_tpu.observability.metrics import (
+        get_sanitizer_metrics)
+    return get_sanitizer_metrics()
+
+
+_THIS_FILE = __file__.rstrip("co")     # .pyc -> .py, belt and braces
+
+
+def _stack() -> str:
+    # drop the trailing sanitizer-internal frames (_stack,
+    # _note_acquire, then acquire or __enter__/_acquire_restore —
+    # the count differs by entry path): the report ends at the
+    # caller's acquire site
+    frames = traceback.format_stack(limit=_STACK_LIMIT)
+    while frames and _THIS_FILE in frames[-1]:
+        frames.pop()
+    return "".join(frames)
+
+
+def _emit(violation: dict):
+    try:
+        _metrics().violations_total.inc(rule=violation["rule"])
+    except Exception:  # noqa: BLE001 — telemetry never wedges a lock
+        pass
+    try:
+        from deeplearning4j_tpu.observability.flightrecorder import (
+            record_event,
+        )
+        record_event("sanitizer.violation",
+                     rule=violation["rule"],
+                     locks=violation["locks"],
+                     thread=violation["thread"])
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def violations() -> List[dict]:
+    """Snapshot of every violation since the last ``reset()``."""
+    with _state:
+        return [dict(v) for v in _violations]
+
+
+def reset():
+    """Drop the order graph, reported pairs, and violations (tests)."""
+    with _state:
+        _order.clear()
+        _reported.clear()
+        _violations.clear()
+
+
+def _record_violation(v: dict):
+    with _state:
+        if len(_violations) < MAX_VIOLATIONS:
+            _violations.append(v)
+    _emit(v)
+
+
+def _note_acquire(name: str, t_now: float) -> dict:
+    """Update the graph for this thread acquiring ``name``; returns the
+    held-entry to push. Violation emission happens outside ``_state``."""
+    stack = _stack()
+    held = _held()
+    tname = threading.current_thread().name
+    inversions = []
+    with _state:
+        for h in held:
+            if h["name"] == name:
+                continue
+            fwd = (h["name"], name)
+            rev = (name, h["name"])
+            pair = frozenset(fwd)
+            if rev in _order and pair not in _reported:
+                _reported.add(pair)
+                first = _order[rev]
+                inversions.append({
+                    "rule": "lock-order-inversion",
+                    "locks": [h["name"], name],
+                    "thread": tname,
+                    "detail": (
+                        f"acquiring {name!r} while holding "
+                        f"{h['name']!r}, but thread "
+                        f"{first['thread']!r} previously acquired "
+                        f"{h['name']!r} while holding {name!r}"),
+                    "stacks": {
+                        f"this thread ({tname}) holding "
+                        f"{h['name']}": h["stack"],
+                        f"this thread ({tname}) acquiring "
+                        f"{name}": stack,
+                        f"first thread ({first['thread']}) holding "
+                        f"{name}": first["held_stack"],
+                        f"first thread ({first['thread']}) acquiring "
+                        f"{h['name']}": first["acquire_stack"],
+                    },
+                })
+            if fwd not in _order:
+                _order[fwd] = {"thread": tname, "held_stack": h["stack"],
+                               "acquire_stack": stack}
+    for v in inversions:
+        _record_violation(v)
+    return {"name": name, "t0": t_now, "stack": stack}
+
+
+def _note_release(name: str, lock_id: int):
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i].get("lock_id") == lock_id:
+            entry = held.pop(i)
+            dur = time.monotonic() - entry["t0"]
+            try:
+                _metrics().lock_hold_seconds.observe(dur)
+            except Exception:  # noqa: BLE001
+                pass
+            if dur > hold_threshold_s():
+                _record_violation({
+                    "rule": "lock-long-hold",
+                    "locks": [name],
+                    "thread": threading.current_thread().name,
+                    "detail": f"{name!r} held {dur:.3f}s (threshold "
+                              f"{hold_threshold_s():.3f}s)",
+                    "stacks": {"acquire": entry["stack"]},
+                })
+            return
+    # released by a different thread than the acquirer (legal for a
+    # plain Lock): nothing to time, the acquirer's entry expires with
+    # its thread
+
+
+class _SanitizedLock:
+    """threading.Lock wrapper that feeds the order graph. Exposes only
+    acquire/release/locked/__enter__/__exit__ — Condition's fallback
+    protocol then routes wait()'s release/reacquire through us."""
+
+    def __init__(self, name: str, raw_factory=threading.Lock):
+        self.name = name
+        self._raw = raw_factory()
+        try:
+            m = _metrics()
+            m.locks_tracked.inc()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            try:
+                _metrics().lock_acquisitions_total.inc()
+            except Exception:  # noqa: BLE001
+                pass
+            entry = _note_acquire(self.name, time.monotonic())
+            entry["lock_id"] = id(self)
+            _held().append(entry)
+        return ok
+
+    def release(self):
+        _note_release(self.name, id(self))
+        self._raw.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<SanitizedLock {self.name!r} {self._raw!r}>"
+
+
+class _SanitizedRLock(_SanitizedLock):
+    """Reentrant variant: only the outermost acquire/release feed the
+    graph (inner recursion defines no inter-lock order)."""
+
+    def __init__(self, name: str):
+        super().__init__(name, raw_factory=threading.RLock)
+        self._depth_tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._depth_tls, "n", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            n = self._depth() + 1
+            self._depth_tls.n = n
+            if n == 1:
+                try:
+                    _metrics().lock_acquisitions_total.inc()
+                except Exception:  # noqa: BLE001
+                    pass
+                entry = _note_acquire(self.name, time.monotonic())
+                entry["lock_id"] = id(self)
+                _held().append(entry)
+        return ok
+
+    def release(self):
+        n = self._depth()
+        if n == 1:
+            _note_release(self.name, id(self))
+        self._depth_tls.n = max(0, n - 1)
+        self._raw.release()
+
+    # -- Condition protocol ---------------------------------------------------
+    # Condition probes ownership via lock._is_owned when present; its
+    # fallback (acquire(0)) succeeds REENTRANTLY on an owned RLock and
+    # misreads it as un-owned — notify()/wait() would raise. Delegate,
+    # and keep the held-set/depth truthful across wait()'s full
+    # recursion-count release/reacquire.
+
+    def _is_owned(self):
+        return self._raw._is_owned()
+
+    def _release_save(self):
+        n = self._depth()
+        if n:
+            _note_release(self.name, id(self))
+        self._depth_tls.n = 0
+        return (self._raw._release_save(), n)
+
+    def _acquire_restore(self, state):
+        raw_state, n = state
+        self._raw._acquire_restore(raw_state)
+        self._depth_tls.n = n
+        if n:
+            entry = _note_acquire(self.name, time.monotonic())
+            entry["lock_id"] = id(self)
+            _held().append(entry)
+
+
+def make_lock(name: str):
+    """An instrumented Lock when the lockorder sanitizer is armed, a
+    plain ``threading.Lock`` otherwise. ``name`` should match the
+    static pass's node naming: ``"ClassName._attr"``."""
+    return _SanitizedLock(name) if armed() else threading.Lock()
+
+
+def make_rlock(name: str):
+    return _SanitizedRLock(name) if armed() else threading.RLock()
+
+
+def order_graph() -> Dict[Tuple[str, str], str]:
+    """Observed (held -> acquired) edges with the first witness thread
+    (debug/introspection)."""
+    with _state:
+        return {edge: w["thread"] for edge, w in _order.items()}
+
+
+def render_report(vs: Optional[List[dict]] = None) -> str:
+    """Human-readable multi-stack report (what chaos tests print on
+    failure)."""
+    vs = violations() if vs is None else vs
+    if not vs:
+        return "lockcheck: no violations"
+    out = []
+    for i, v in enumerate(vs):
+        out.append(f"[{i}] {v['rule']}: {v['detail']}")
+        for title, stack in v.get("stacks", {}).items():
+            out.append(f"  --- {title} ---")
+            out.extend("  " + ln for ln in stack.rstrip().splitlines())
+    return "\n".join(out)

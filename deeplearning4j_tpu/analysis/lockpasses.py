@@ -1,0 +1,492 @@
+"""Lock-order graph + blocking-under-lock AST passes.
+
+Two defect classes that reviews have hand-caught repeatedly:
+
+- **ABBA deadlocks** (the PR 13 shape: ``Backend._lock`` vs the circuit
+  lock). Pass 1 extracts every ``with <lock>:`` acquisition, resolves
+  one level of intra-package calls (``self.method()``, ``self.attr.
+  method()`` where ``self.attr = KnownClass(...)``, bare module
+  functions), builds the inter-lock edge graph, and reports every cycle
+  with a file:line witness per edge. Orderings the AST cannot see
+  (callback indirection, e.g. a breaker's ``on_transition`` hook taking
+  a backend lock) are *declared*::
+
+      # analysis: lock-edge(CircuitBreaker._lock -> Backend._lock) — why
+
+  so reintroducing the reverse order anywhere becomes a static cycle.
+
+- **Blocking work under a held lock** (the PR 8/14 shape: incident
+  bundle I/O and fallback-prewarm compiles inside engine/entry locks).
+  Pass 2 flags sleeps, subprocess/network/file I/O, and jit/compile
+  entry points lexically inside a held-lock region.
+
+Lock identity is *name-level* (``ClassName._attr`` / ``module._NAME``),
+aggregated across instances: two instances of one class locked in
+opposite orders are invisible here (no order exists between same-name
+locks) — that shape is the runtime sanitizer's job
+(``analysis/lockcheck.py``). A ``with`` target is lock-ish when its
+final name segment contains ``lock`` (case-insensitive); project style
+(enforced by review) names every mutex ``*lock*``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, SourceFile, call_name, dotted_name)
+
+# -- blocking-call classification ---------------------------------------------
+
+# full dotted-name matches
+_BLOCKING_EXACT = {
+    "time.sleep": "sleeps",
+    "os.system": "runs a shell",
+    "os.popen": "runs a shell",
+    "os.replace": "does file I/O",
+    "os.rename": "does file I/O",
+    "os.makedirs": "does file I/O",
+    "os.remove": "does file I/O",
+    "os.unlink": "does file I/O",
+    "subprocess.run": "spawns a process",
+    "subprocess.call": "spawns a process",
+    "subprocess.check_call": "spawns a process",
+    "subprocess.check_output": "spawns a process",
+    "subprocess.Popen": "spawns a process",
+    "urllib.request.urlopen": "does network I/O",
+    "urlopen": "does network I/O",
+    "socket.create_connection": "does network I/O",
+    "shutil.rmtree": "does file I/O",
+    "shutil.copy": "does file I/O",
+    "shutil.copy2": "does file I/O",
+    "shutil.copytree": "does file I/O",
+    "shutil.move": "does file I/O",
+    "json.dump": "does file I/O",
+    "pickle.dump": "does file I/O",
+    "np.save": "does file I/O",
+    "np.savez": "does file I/O",
+    "numpy.save": "does file I/O",
+    "open": "does file I/O",
+    "jax.jit": "enters jit",
+    "jax.pjit": "enters jit",
+    "pjit": "enters jit",
+    "jax.block_until_ready": "blocks on the device",
+}
+
+# final-attribute matches (base unresolvable or irrelevant)
+_BLOCKING_SUFFIX = {
+    "urlopen": "does network I/O",
+    "create_connection": "does network I/O",
+    "getresponse": "does network I/O",
+    "write_text": "does file I/O",
+    "write_bytes": "does file I/O",
+    "read_text": "does file I/O",
+    "read_bytes": "does file I/O",
+    "block_until_ready": "blocks on the device",
+    "aot_compile": "compiles",
+}
+
+# ``.compile()`` is an XLA AOT compile unless the base is the stdlib
+# regex module
+_RE_BASES = {"re", "sre_compile", "regex"}
+
+
+def _blocking_kind(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(display name, verb) when ``call`` is a known blocking call."""
+    name = call_name(call)
+    if name is not None and name in _BLOCKING_EXACT:
+        return name, _BLOCKING_EXACT[name]
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        if attr in _BLOCKING_SUFFIX:
+            return (name or f"*.{attr}"), _BLOCKING_SUFFIX[attr]
+        if attr == "compile":
+            base = dotted_name(func.value)
+            if base is None or base.split(".")[0] not in _RE_BASES:
+                return (name or "*.compile"), "compiles"
+    return None
+
+
+def _is_lockish(name: str) -> bool:
+    return "lock" in name.split(".")[-1].lower()
+
+
+# -- per-function extraction --------------------------------------------------
+
+@dataclasses.dataclass
+class FuncInfo:
+    qual: str
+    sf: SourceFile
+    # (lock name, line) for every direct ``with <lock>:``
+    acquires: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+    # lexical nesting (outer, inner, line-of-inner-with)
+    edges: List[Tuple[str, str, int]] = dataclasses.field(default_factory=list)
+    # (held locks at the call site, callee expr, line)
+    calls_under: List[Tuple[Tuple[str, ...], str, int]] = \
+        dataclasses.field(default_factory=list)
+    # blocking-under-lock witnesses (lock, call display, verb, line)
+    blocking: List[Tuple[str, str, str, int]] = \
+        dataclasses.field(default_factory=list)
+
+
+class _FuncWalker(ast.NodeVisitor):
+    def __init__(self, info: FuncInfo, lock_namer):
+        self.info = info
+        self._name_lock = lock_namer
+        self._held: List[str] = []
+        # parallel to _held: True when the region's ``with`` line
+        # carries an allow(blocking-under-lock) comment — a block-level
+        # suppression covering every blocking call inside
+        self._suppress: List[bool] = []
+
+    def visit_With(self, node):  # noqa: N802 - ast visitor API
+        self._with(node)
+
+    def visit_AsyncWith(self, node):  # noqa: N802
+        self._with(node)
+
+    def _with(self, node):
+        entered = 0
+        suppressed = self.info.sf.allowed("blocking-under-lock",
+                                          node.lineno)
+        for item in node.items:
+            lock = self._name_lock(item.context_expr)
+            if lock is not None:
+                self.info.acquires.append((lock, node.lineno))
+                for held in self._held:
+                    if held != lock:
+                        self.info.edges.append((held, lock, node.lineno))
+                self._held.append(lock)
+                self._suppress.append(suppressed)
+                entered += 1
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        if entered:
+            del self._held[-entered:]
+            del self._suppress[-entered:]
+
+    def visit_Call(self, node):  # noqa: N802
+        # calls are recorded even with nothing held: the transitive
+        # closure must follow a lock-free intermediate hop (f holds L,
+        # calls g; g holds nothing but calls h which locks M — the
+        # L -> M edge only exists if g's calls are on record)
+        held = tuple(self._held)
+        callee = dotted_name(node.func)
+        if callee is None and isinstance(node.func, ast.Attribute):
+            callee = f"?.{node.func.attr}"
+        if callee is not None:
+            self.info.calls_under.append((held, callee, node.lineno))
+        if held:
+            hit = _blocking_kind(node)
+            if hit is not None and not any(self._suppress):
+                display, verb = hit
+                self.info.blocking.append(
+                    (held[-1], display, verb, node.lineno))
+        self.generic_visit(node)
+
+    # a nested def/lambda body does not execute under the enclosing
+    # lock — it runs whenever it is *called*; analyzed separately
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        pass
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+
+# -- module/class extraction --------------------------------------------------
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    sf: SourceFile
+    methods: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    # self.<attr> = <KnownClass>(...)  ->  attr: class name
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    sf: SourceFile
+    functions: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+
+
+def _lock_namer(sf: SourceFile, cls: Optional[str]):
+    """Normalize a lock expression to a graph node name."""
+    def name(expr: ast.AST) -> Optional[str]:
+        dn = dotted_name(expr)
+        if dn is None or not _is_lockish(dn):
+            return None
+        if dn.startswith("self."):
+            owner = cls or sf.modname
+            return f"{owner}.{dn[len('self.'):]}"
+        if "." not in dn:
+            return f"{sf.modname}.{dn}"
+        return dn
+    return name
+
+
+def _walk_functions(body, sf: SourceFile, cls: Optional[str],
+                    out: Dict[str, FuncInfo], prefix: str = ""):
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = (f"{cls}.{prefix}{node.name}" if cls
+                    else f"{sf.modname}.{prefix}{node.name}")
+            info = FuncInfo(qual, sf)
+            _FuncWalker(info, _lock_namer(sf, cls)).generic_visit(node)
+            out[f"{prefix}{node.name}"] = info
+            # nested defs get their own entries (thread targets, hooks)
+            _walk_functions(node.body, sf, cls, out,
+                            prefix=f"{prefix}{node.name}.")
+        elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For,
+                               ast.While)):
+            # every nested block can define functions: else/elif chains
+            # (orelse), except handlers, finally — the import-fallback
+            # `except ImportError: def fast_impl(): ...` idiom included
+            for block in (getattr(node, "body", []),
+                          getattr(node, "orelse", []),
+                          getattr(node, "finalbody", [])):
+                _walk_functions(block, sf, cls, out, prefix)
+            for handler in getattr(node, "handlers", []):
+                _walk_functions(handler.body, sf, cls, out, prefix)
+
+
+def extract_module(sf: SourceFile) -> ModuleInfo:
+    mod = ModuleInfo(sf)
+    _walk_functions(sf.tree.body, sf, None, mod.functions)
+    for node in sf.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = ClassInfo(node.name, sf)
+        _walk_functions(node.body, sf, node.name, ci.methods)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.value, ast.Call):
+                target = dotted_name(sub.targets[0])
+                ctor = call_name(sub.value)
+                if target and ctor and target.startswith("self.") and \
+                        "." not in target[len("self."):]:
+                    ci.attr_types[target[len("self."):]] = \
+                        ctor.split(".")[-1]
+        mod.classes[node.name] = ci
+    return mod
+
+
+# -- the whole-tree graph -----------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Witness:
+    path: str
+    line: int
+    desc: str
+
+
+class LockGraph:
+    def __init__(self):
+        self.edges: Dict[Tuple[str, str], List[Witness]] = {}
+
+    def add(self, src: str, dst: str, w: Witness):
+        if src == dst:
+            return
+        self.edges.setdefault((src, dst), []).append(w)
+
+    def cycles(self) -> List[List[Tuple[str, str]]]:
+        """Strongly connected components with >= 2 nodes, each returned
+        as its member edge list (deterministic order)."""
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        stack: List[str] = []
+        on: Set[str] = set()
+        sccs: List[Set[str]] = []
+        counter = [0]
+
+        def strongconnect(v):
+            # iterative Tarjan (the tree is shallow, but recursion
+            # limits are not a property we want to depend on)
+            work = [(v, iter(sorted(adj[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(adj[w]))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = set()
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        scc.add(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        out = []
+        for scc in sccs:
+            member_edges = sorted(
+                (a, b) for (a, b) in self.edges
+                if a in scc and b in scc)
+            out.append(member_edges)
+        out.sort()
+        return out
+
+
+def _all_closures(funcs: Dict[str, FuncInfo], resolve
+                  ) -> Dict[str, Set[Tuple[str, str, int]]]:
+    """(lock, path, line) every function may acquire, transitively.
+    Computed as a global iterative fixed point — a DFS-with-memo
+    freezes partial results on call cycles (mutual recursion would
+    permanently lose the locks of whichever function was entered
+    second, an order-dependent false negative in the cycle graph)."""
+    clos: Dict[str, Set[Tuple[str, str, int]]] = {
+        q: {(lock, info.sf.rel, line) for lock, line in info.acquires}
+        for q, info in funcs.items()}
+    callees: Dict[str, List[str]] = {
+        q: [t for _held, callee, _line in info.calls_under
+            for t in resolve(info, callee)]
+        for q, info in funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, targets in callees.items():
+            acc = clos[q]
+            before = len(acc)
+            for t in targets:
+                tset = clos.get(t)
+                if tset:
+                    acc |= tset
+            if len(acc) != before:
+                changed = True
+    return clos
+
+
+def run_lock_passes(sources: Sequence[SourceFile]
+                    ) -> Tuple[List[Finding], LockGraph]:
+    """Returns (findings, graph). Findings cover both the lock-order
+    cycles and every blocking-under-lock witness."""
+    modules = [extract_module(sf) for sf in sources]
+
+    # global resolution tables
+    funcs: Dict[str, FuncInfo] = {}          # qual -> info
+    class_of: Dict[str, ClassInfo] = {}      # class name -> info
+    for mod in modules:
+        for name, fi in mod.functions.items():
+            funcs[fi.qual] = fi
+        for cname, ci in mod.classes.items():
+            class_of.setdefault(cname, ci)
+            for mname, fi in ci.methods.items():
+                funcs[fi.qual] = fi
+
+    def resolve(info: FuncInfo, callee: str) -> List[str]:
+        """Map a callee expression to known function quals."""
+        parts = callee.split(".")
+        cls = info.qual.split(".")[0] if "." in info.qual else None
+        ci = class_of.get(cls) if cls else None
+        if parts[0] == "self" and ci is not None:
+            if len(parts) == 2 and parts[1] in ci.methods:
+                return [ci.methods[parts[1]].qual]
+            if len(parts) == 3:
+                tcls = ci.attr_types.get(parts[1])
+                tci = class_of.get(tcls) if tcls else None
+                if tci is not None and parts[2] in tci.methods:
+                    return [tci.methods[parts[2]].qual]
+            return []
+        if len(parts) == 1:
+            fi = funcs.get(f"{info.sf.modname}.{parts[0]}")
+            return [fi.qual] if fi is not None else []
+        return []
+
+    graph = LockGraph()
+    findings: List[Finding] = []
+    closures = _all_closures(funcs, resolve)
+
+    for mod in modules:
+        sf = mod.sf
+        for edge in sf.declared_edges:
+            graph.add(edge.src, edge.dst,
+                      Witness(sf.rel, edge.line,
+                              f"declared: {edge.reason or 'no reason'}"))
+        infos = list(mod.functions.values())
+        for ci in mod.classes.values():
+            infos.extend(ci.methods.values())
+        for info in infos:
+            for a, b, line in info.edges:
+                graph.add(a, b, Witness(sf.rel, line,
+                                        f"nested with in {info.qual}"))
+            for held, callee, line in info.calls_under:
+                if not held:
+                    continue
+                for target in resolve(info, callee):
+                    for lock, tpath, tline in closures.get(target, ()):
+                        for h in held:
+                            graph.add(h, lock, Witness(
+                                sf.rel, line,
+                                f"{info.qual} calls {callee}() which "
+                                f"acquires {lock} "
+                                f"({tpath}:{tline})"))
+            for lock, display, verb, line in info.blocking:
+                findings.append(Finding(
+                    "blocking-under-lock", sf.rel, line,
+                    f"{display}() {verb} while holding {lock} "
+                    f"(in {info.qual})"))
+
+    by_rel = {sf.rel: sf for sf in sources}
+    for cycle_edges in graph.cycles():
+        nodes = sorted({n for e in cycle_edges for n in e})
+        lines = []
+        anchor = None
+        suppressed = False
+        for (a, b) in cycle_edges:
+            ws = sorted(graph.edges[(a, b)],
+                        key=lambda w: (w.path, w.line))
+            w = ws[0]
+            if anchor is None:
+                anchor = w
+            lines.append(f"{a} -> {b} [{w.path}:{w.line} {w.desc}]")
+            # an allow comment on ANY witness edge of the cycle accepts
+            # the whole ordering (you annotate the edge you vouch for)
+            for cand in ws:
+                sf = by_rel.get(cand.path)
+                if sf is not None and sf.allowed("lock-order-cycle",
+                                                 cand.line):
+                    suppressed = True
+        if suppressed:
+            continue
+        findings.append(Finding(
+            "lock-order-cycle", anchor.path, anchor.line,
+            "potential ABBA deadlock: lock-order cycle over "
+            f"{{{', '.join(nodes)}}}: " + "; ".join(lines)))
+    return findings, graph

@@ -1,0 +1,155 @@
+"""Traced-hazard lint: host-side effects inside jit-traced functions.
+
+The bench jit-sleep trap: a ``time.sleep`` (or clock read, or host RNG
+draw) inside a function handed to ``jax.jit`` executes ONCE at trace
+time and is silently compiled away — the replica "sleeps" during
+tracing and never again, quietly voiding whatever the sleep was
+simulating. Same class: ``time.time()`` baked to a constant,
+``random``/``np.random`` draws frozen into the graph.
+
+The pass finds functions that are traced —
+
+- decorated with ``jit``/``jax.jit``/``pjit``/``pmap``/``vmap``/
+  ``grad``/``value_and_grad``/``shard_map`` (bare or wrapped in
+  ``partial(...)``),
+- or passed by name to one of those transforms anywhere in the module
+  (``jax.jit(step)``, ``jax.jit(self._step)``), including lambdas
+  passed inline —
+
+and flags host-effect calls lexically inside them. Callback escapes
+(``jax.pure_callback`` / ``jax.debug.callback`` / ``io_callback``
+arguments) run on the host by design and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, SourceFile, call_name, dotted_name)
+
+# NOTE: jax.checkpoint/remat is deliberately absent — this repo's
+# serde plane uses bare ``checkpoint``-named helpers and the collision
+# cost outweighs the (jit-subsumed) coverage
+_TRANSFORMS = {"jit", "pjit", "pmap", "vmap", "grad", "value_and_grad",
+               "shard_map"}
+_CALLBACKS = {"pure_callback", "debug.callback", "callback", "io_callback"}
+
+_HAZARD_EXACT = {
+    "time.sleep": "sleeps once at trace time, never in the compiled fn",
+    "time.time": "bakes the trace-time clock into the graph",
+    "time.monotonic": "bakes the trace-time clock into the graph",
+    "time.perf_counter": "bakes the trace-time clock into the graph",
+    "datetime.now": "bakes the trace-time clock into the graph",
+    "datetime.datetime.now": "bakes the trace-time clock into the graph",
+}
+_HAZARD_PREFIXES = {
+    "random.": "draws host randomness once at trace time",
+    "np.random.": "draws host randomness once at trace time",
+    "numpy.random.": "draws host randomness once at trace time",
+}
+
+
+def _transform_name(expr: ast.AST) -> bool:
+    """Is ``expr`` (a decorator or a called function) a jax transform,
+    possibly ``partial(...)``-wrapped?"""
+    if isinstance(expr, ast.Call):
+        name = call_name(expr)
+        if name is not None and name.split(".")[-1] == "partial" and \
+                expr.args:
+            return _transform_name(expr.args[0])
+        # e.g. a decorator like @jax.jit(static_argnums=...) — a call
+        # OF the transform itself
+        return name is not None and name.split(".")[-1] in _TRANSFORMS
+    dn = dotted_name(expr)
+    return dn is not None and dn.split(".")[-1] in _TRANSFORMS
+
+
+def _is_callback_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name is None:
+        return False
+    tail = name.split(".")
+    return tail[-1] in {"pure_callback", "io_callback"} or \
+        (len(tail) >= 2 and tail[-2] == "debug" and tail[-1] == "callback")
+
+
+class _HazardWalker(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, qual: str,
+                 findings: List[Finding]):
+        self.sf = sf
+        self.qual = qual
+        self.findings = findings
+
+    def visit_Call(self, node):  # noqa: N802 - ast visitor API
+        if _is_callback_call(node):
+            # host-callback escape: only the callback FN (args[0]) runs
+            # on the host — the operand args are still evaluated at
+            # trace time, so hazards there are real
+            for arg in node.args[1:]:
+                self.visit(arg)
+            for kw in node.keywords:
+                self.visit(kw.value)
+            return
+        name = call_name(node)
+        if name is not None:
+            why = _HAZARD_EXACT.get(name)
+            if why is None:
+                for prefix, pwhy in _HAZARD_PREFIXES.items():
+                    if name.startswith(prefix):
+                        why = pwhy
+                        break
+            if why is not None:
+                self.findings.append(Finding(
+                    "traced-hazard", self.sf.rel, node.lineno,
+                    f"{name}() inside jit-traced {self.qual}: {why}"))
+        self.generic_visit(node)
+
+
+def _collect_traced(sf: SourceFile) -> Dict[str, ast.AST]:
+    """name -> function node for every function that is traced, plus
+    inline lambdas (keyed by synthetic names)."""
+    defs: Dict[str, ast.AST] = {}
+    classes_methods: Dict[str, ast.AST] = {}   # "_step" -> node
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+            classes_methods.setdefault(node.name, node)
+
+    traced: Dict[str, ast.AST] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_transform_name(d) for d in node.decorator_list):
+                traced[node.name] = node
+        elif isinstance(node, ast.Call) and _transform_name(node.func) \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Lambda):
+                traced[f"<lambda:{arg.lineno}>"] = arg
+            else:
+                dn = dotted_name(arg)
+                if dn is None:
+                    continue
+                leaf = dn.split(".")[-1]
+                target = defs.get(leaf) or classes_methods.get(leaf)
+                if target is not None:
+                    traced.setdefault(leaf, target)
+    return traced
+
+
+def run_traced_pass(sources: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for sf in sources:
+        for name, node in sorted(_collect_traced(sf).items()):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            walker = _HazardWalker(sf, name, findings)
+            if isinstance(node, ast.Lambda):
+                walker.visit(node.body)
+            else:
+                for stmt in node.body:
+                    walker.visit(stmt)
+    return findings

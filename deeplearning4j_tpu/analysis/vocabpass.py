@@ -1,0 +1,184 @@
+"""Vocabulary-consistency pass: metrics, flight-event kinds, env knobs.
+
+Three string vocabularies must stay closed under growth:
+
+- every metric family constructed anywhere in the package
+  (``reg.counter("name", ...)`` / ``gauge`` / ``histogram`` with a
+  literal name) must be in ``slo.known_metric_names()`` — otherwise
+  ``slo --check`` can never validate a rule over it;
+- every flight-event ``kind`` literal recorded (via ``record_event``,
+  the lazy ``_flight``/``_record_flight`` wrappers, or a recorder's
+  ``.record``) must be declared in ``observability/vocab.py``;
+- every ``DL4J_TPU_*`` env knob mentioned in code must be registered in
+  ``analysis/knobs.py`` (which also renders the GUIDE.md table), and
+  every registered knob must still be mentioned somewhere — both
+  directions of drift fail ``--check``.
+
+String literals inside docstrings are ignored (prose); comments never
+reach the AST.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set
+
+from deeplearning4j_tpu.analysis import knobs as _knobs
+from deeplearning4j_tpu.analysis.core import (
+    Finding, SourceFile, call_name, string_constants)
+
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+_FLIGHT_FUNCS = {"record_event", "_flight", "_record_flight"}
+_KNOB_RE = re.compile(r"^DL4J_TPU_[A-Z0-9_]+$")
+
+
+def _known_metric_names() -> Set[str]:
+    # imported lazily: slo instantiates every metrics bundle, which is
+    # exactly the vocabulary a constructed family must belong to
+    from deeplearning4j_tpu.observability.slo import known_metric_names
+    return set(known_metric_names())
+
+
+def _known_event_kinds() -> Set[str]:
+    from deeplearning4j_tpu.observability.vocab import EVENT_KINDS
+    return set(EVENT_KINDS)
+
+
+def _str_env(sf: SourceFile) -> Dict[int, Dict[str, str]]:
+    """Per-scope map of simple ``name = "literal"`` assignments, keyed
+    by scope node id (module + each function) — resolves the
+    ``namespace=ns`` idiom in metric bundles."""
+    envs: Dict[int, Dict[str, str]] = {}
+
+    def collect(scope_id: int, body):
+        env = envs.setdefault(scope_id, {})
+        for node in body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                env[node.targets[0].id] = node.value.value
+
+    collect(id(sf.tree), sf.tree.body)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            collect(id(node), node.body)
+    return envs
+
+
+def _scope_of(sf: SourceFile) -> Dict[int, int]:
+    """node id -> enclosing scope node id (function else module)."""
+    out: Dict[int, int] = {}
+
+    def walk(node, scope_id):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[id(child)] = scope_id
+                walk(child, id(child))
+            else:
+                out[id(child)] = scope_id
+                walk(child, scope_id)
+
+    walk(sf.tree, id(sf.tree))
+    return out
+
+
+def _metric_full_name(node: ast.Call, env: Dict[str, str]
+                      ) -> Optional[str]:
+    """The registered family name for a ``.counter("x", ...,
+    namespace=ns)`` call, or None when unresolvable."""
+    first = node.args[0] if node.args else None
+    if not (isinstance(first, ast.Constant) and
+            isinstance(first.value, str)):
+        return None
+    ns = None
+    for kw in node.keywords:
+        if kw.arg == "namespace":
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str):
+                ns = kw.value.value
+            elif isinstance(kw.value, ast.Name):
+                ns = env.get(kw.value.id)
+                if ns is None:
+                    return None          # unresolvable namespace
+            elif isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is None:
+                ns = None
+            else:
+                return None
+    return f"{ns}_{first.value}" if ns else first.value
+
+
+def run_vocab_pass(sources: Sequence[SourceFile],
+                   check_unused_knobs: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    metric_vocab = _known_metric_names()
+    kind_vocab = _known_event_kinds()
+    knob_vocab = _knobs.known_knob_names()
+    knobs_seen: Set[str] = set()
+    knobs_rel: Optional[str] = None
+
+    for sf in sources:
+        is_registry = sf.rel.endswith("analysis/knobs.py")
+        if is_registry:
+            knobs_rel = sf.rel
+        doc_ids = sf.docstring_nodes()
+        envs = scope = None    # built lazily: most files build no metrics
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                leaf = name.split(".")[-1] if name else None
+                first = node.args[0] if node.args else None
+                # metric families
+                if leaf in _METRIC_CTORS and isinstance(first, ast.Constant) \
+                        and isinstance(first.value, str) and "." in (name or ""):
+                    if envs is None:
+                        envs = _str_env(sf)
+                        scope = _scope_of(sf)
+                    env = envs.get(scope.get(id(node), id(sf.tree)), {})
+                    full = _metric_full_name(node, env)
+                    if full is not None and full not in metric_vocab:
+                        findings.append(Finding(
+                            "unregistered-metric", sf.rel, node.lineno,
+                            f"metric family {full!r} is not in "
+                            "slo.known_metric_names() — register its "
+                            "bundle there or slo --check can never "
+                            "validate a rule over it"))
+                # flight-event kinds
+                is_flight = (leaf in _FLIGHT_FUNCS or
+                             (isinstance(node.func, ast.Attribute) and
+                              node.func.attr == "record"))
+                if is_flight and first is not None:
+                    kinds = [s for s in string_constants(first)
+                             if s and " " not in s and "." in s]
+                    for kind in kinds:
+                        if kind not in kind_vocab:
+                            findings.append(Finding(
+                                "unregistered-event-kind", sf.rel,
+                                node.lineno,
+                                f"flight-event kind {kind!r} is not "
+                                "declared in observability/vocab.py"))
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    id(node) not in doc_ids and \
+                    _KNOB_RE.match(node.value):
+                # the registry's own entries don't count as usage —
+                # a knob only mentioned in knobs.py is dead
+                if not is_registry:
+                    knobs_seen.add(node.value)
+                if node.value not in knob_vocab:
+                    findings.append(Finding(
+                        "unregistered-knob", sf.rel, node.lineno,
+                        f"env knob {node.value!r} is not registered in "
+                        "analysis/knobs.py (the GUIDE.md table renders "
+                        "from that registry)"))
+
+    if check_unused_knobs:
+        for name in sorted(knob_vocab - knobs_seen):
+            findings.append(Finding(
+                "unused-knob", knobs_rel or "deeplearning4j_tpu/analysis"
+                                            "/knobs.py", 1,
+                f"registered knob {name!r} is never mentioned in the "
+                "scanned tree — delete it or wire it up"))
+    return findings

@@ -290,6 +290,10 @@ class TelemetryExporter:
             return None
         doc = json.dumps(self.snapshot(), default=str)
         try:
+            # analysis: allow(blocking-under-lock) — the publish lock
+            # exists to serialize exactly this atomic rewrite (two
+            # publishers would race on the shared .tmp name); payload is
+            # pre-serialized above and no other lock ever nests with it
             with self._publish_lock:
                 path.parent.mkdir(parents=True, exist_ok=True)
                 tmp = path.with_suffix(".tmp")

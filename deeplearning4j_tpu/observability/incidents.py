@@ -301,20 +301,39 @@ class IncidentManager:
                 results[name] = box["result"]
         bundle_dir = self.dir / iid
         with self._lock:
-            man = self._manifests.get(iid)
-            if man is None or not bundle_dir.is_dir():
+            if self._manifests.get(iid) is None or not bundle_dir.is_dir():
                 return  # pruned while capturing
-            try:
-                tmp = bundle_dir / ".profile.json.tmp"
-                tmp.write_text(json.dumps(
-                    {"captured_at": time.time(), "captures": results},
-                    default=str))
-                os.replace(tmp, bundle_dir / "profile.json")
-                man["profile"] = "done"
-                man.setdefault("artifacts", []).append("profile.json")
-                self._write_manifest(bundle_dir, man)
-            except OSError:
-                man["profile"] = "failed"
+        # the profile payload can be large (flame captures, host-sampler
+        # dumps) — serialize and write it OUTSIDE the incident lock; only
+        # this capture thread writes this bundle's profile.json
+        try:
+            tmp = bundle_dir / ".profile.json.tmp"
+            tmp.write_text(json.dumps(
+                {"captured_at": time.time(), "captures": results},
+                default=str))
+            os.replace(tmp, bundle_dir / "profile.json")
+            wrote = True
+        except OSError:
+            wrote = False
+        with self._lock:
+            man = self._manifests.get(iid)
+            if man is not None:
+                if wrote:
+                    man["profile"] = "done"
+                    if "profile.json" not in man.setdefault(
+                            "artifacts", []):
+                        man["artifacts"].append("profile.json")
+                    # small rewrite; artifact list + status flip must
+                    # stay atomic with retention's locked prune walk
+                    self._write_manifest(bundle_dir, man)
+                else:
+                    man["profile"] = "failed"
+        if man is None:
+            # pruned while writing: our write may have raced retention's
+            # rmtree and resurrected a dir holding only profile.json —
+            # such a dir has no incident.json, is invisible to the
+            # adoption scan, and would leak forever. Reclaim it.
+            shutil.rmtree(bundle_dir, ignore_errors=True)
 
     # -- close / retention ---------------------------------------------------
 
@@ -333,6 +352,9 @@ class IncidentManager:
             bundle_dir = self.dir / incident_id
             try:
                 if resolution is not None:
+                    # analysis: allow(blocking-under-lock) — bounded
+                    # caller-provided dict (~1 KB); the artifact list and
+                    # the closed flip must stay atomic with the write
                     (bundle_dir / "resolution.json").write_text(
                         json.dumps(resolution, indent=2, default=str))
                     if "resolution.json" not in man.get("artifacts", []):

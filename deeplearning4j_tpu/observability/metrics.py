@@ -596,6 +596,36 @@ class WarmstartMetrics:
             ("plane",))
 
 
+class SanitizerMetrics:
+    """Runtime concurrency-sanitizer instruments (analysis/lockcheck.py).
+    All-zero in a healthy process; the sanitizer-violation burn-rate
+    rule pages when the lockorder sanitizer sees an inversion or a
+    long hold in a canary/chaos environment."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        ns = "sanitizer"
+        self.violations_total = r.counter(
+            "violations_total",
+            "Concurrency-invariant violations detected at runtime "
+            "(rule = lock-order-inversion | lock-long-hold).",
+            ("rule",), namespace=ns)
+        self.lock_acquisitions_total = r.counter(
+            "lock_acquisitions_total",
+            "Acquisitions observed by instrumented locks while the "
+            "lockorder sanitizer is armed (DL4J_TPU_SANITIZERS).",
+            namespace=ns)
+        self.locks_tracked = r.gauge(
+            "locks_tracked",
+            "Instrumented lock objects created while armed.",
+            namespace=ns)
+        self.lock_hold_seconds = r.histogram(
+            "lock_hold_seconds",
+            "Observed lock hold durations (instrumented locks only).",
+            namespace=ns)
+
+
 def get_training_metrics() -> TrainingMetrics:
     return _bundle("training", TrainingMetrics)
 
@@ -610,6 +640,10 @@ def get_checkpoint_metrics() -> CheckpointMetrics:
 
 def get_warmstart_metrics() -> WarmstartMetrics:
     return _bundle("warmstart", WarmstartMetrics)
+
+
+def get_sanitizer_metrics() -> SanitizerMetrics:
+    return _bundle("sanitizer", SanitizerMetrics)
 
 
 def warmstart_metrics_or_none() -> Optional[WarmstartMetrics]:

@@ -198,6 +198,9 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     # runtime/compilecache.py + serving/warmstart.py): the
     # recompile-after-warmup burn-rate rule validates offline
     _metrics.WarmstartMetrics(reg)
+    # the runtime concurrency-sanitizer families (analysis/lockcheck.py):
+    # the sanitizer-violation burn-rate rule validates offline
+    _metrics.SanitizerMetrics(reg)
     SLOMetrics(reg)
     from deeplearning4j_tpu.observability.federation import ClusterMetrics
     from deeplearning4j_tpu.observability.reqlog import ReqLogMetrics

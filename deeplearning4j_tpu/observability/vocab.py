@@ -1,0 +1,133 @@
+"""Declared vocabularies for cross-plane string identifiers.
+
+The flight recorder, the SLO evaluator, and the fleet debug endpoints
+all key on *strings*: a flight event's ``kind``, a metric family name, a
+``DL4J_TPU_*`` env knob. Strings drift — PR review history shows the
+same defect class recurring (an event kind spelled two ways, a metric
+family the rule file can't validate, knobs documented in GUIDE.md long
+after the code grew them). This module is the single declaration point
+for the flight-event ``kind`` vocabulary; ``analysis/vocabpass.py``
+statically checks that every literal kind recorded anywhere in the
+package appears here, so adding an event without declaring it is a
+tier-1 failure, not a doc chore.
+
+Grouped by producing plane. Keep the groups sorted; the analysis check
+does not care, but reviewers diff this file.
+"""
+
+from __future__ import annotations
+
+# serving data plane (server.py / registry.py / warmup.py)
+SERVING_KINDS = frozenset({
+    "serving.admission_cap",
+    "serving.brownout",
+    "serving.circuit",
+    "serving.deploy",
+    "serving.drain",
+    "serving.error",
+    "serving.fallback",
+    "serving.fallback_error",
+    "serving.fallback_prewarm",
+    "serving.fallback_prewarm_failed",
+    "serving.recompile_after_warm",
+    "serving.rollback",
+    "serving.shed",
+    "serving.start",
+    "serving.stop",
+    "serving.warmup_complete",
+    "serving.warmup_error",
+    "serving.worker_crash",
+})
+
+# generative serving engine (generation.py)
+GENERATION_KINDS = frozenset({
+    "generation.compile",
+    "generation.error",
+    "generation.join",
+    "generation.leave",
+    "generation.preempt",
+    "generation.request",
+    "generation.shed",
+    "generation.warmup",
+})
+
+# fleet router tier (router.py)
+ROUTER_KINDS = frozenset({
+    "router.backend",
+    "router.backend_warming",
+    "router.deploy",
+    "router.drain",
+    "router.readmit",
+    "router.retry",
+    "router.retry_budget_exhausted",
+    "router.shed",
+    "router.start",
+    "router.stop",
+})
+
+# training + data pipeline (trainer.py / iterators.py)
+TRAIN_KINDS = frozenset({
+    "data.auto_prefetch",
+    "data.starved",
+    "train.data_recovered",
+    "train.data_starvation",
+    "train.epoch",
+    "train.step",
+})
+
+# resilience: recovery hooks, elastic supervisor, fault injection
+RESILIENCE_KINDS = frozenset({
+    "checkpoint.quarantined",
+    "checkpoint.verify_failed",
+    "collective.timeout",
+    "fault.injected",
+    "resilience.checkpoint_skip",
+    "resilience.lr_cut",
+    "resilience.rollback",
+    "resilience.skip_batch",
+    "supervisor.cluster_dossier",
+    "supervisor.complete",
+    "supervisor.expand",
+    "supervisor.expand_ready",
+    "supervisor.gave_up",
+    "supervisor.launch",
+    "supervisor.probe",
+    "supervisor.restart",
+    "supervisor.shrink",
+    "supervisor.shrink_denied",
+    "supervisor.slot_marked_dead",
+    "supervisor.worker_exit",
+    "supervisor.worker_hang",
+})
+
+# cold-start plane (runtime/compilecache.py + serving/warmstart.py)
+COMPILE_KINDS = frozenset({
+    "compile_cache.activate",
+    "compile_cache.quarantined",
+    "compile_cache.sealed",
+})
+
+# observability plane's own events (sentinel, SLO, profiling, recorder)
+OBSERVABILITY_KINDS = frozenset({
+    "anomaly.transition",
+    "debug.profile",
+    "incident.close",
+    "incident.open",
+    "metrics.snapshot",
+    "slo.transition",
+})
+
+# concurrency/invariant sanitizers (analysis/lockcheck.py)
+SANITIZER_KINDS = frozenset({
+    "sanitizer.violation",
+})
+
+EVENT_KINDS = frozenset().union(
+    SERVING_KINDS, GENERATION_KINDS, ROUTER_KINDS, TRAIN_KINDS,
+    RESILIENCE_KINDS, COMPILE_KINDS, OBSERVABILITY_KINDS,
+    SANITIZER_KINDS)
+
+
+def known_event_kinds() -> frozenset:
+    """The full declared flight-event ``kind`` vocabulary."""
+    return EVENT_KINDS

@@ -93,6 +93,7 @@ from typing import (
     Union,
 )
 
+from deeplearning4j_tpu.analysis.lockcheck import make_lock
 from deeplearning4j_tpu.resilience.cluster import (
     ENV_CRASH_DIR,
     ENV_HEARTBEAT_DIR,
@@ -317,7 +318,7 @@ class ElasticSupervisor:
         self.expands = 0
         self._fail_streak: Dict[int, int] = {}
         self._marked_dead: Set[int] = set()
-        self._marked_lock = threading.Lock()
+        self._marked_lock = make_lock("ElasticSupervisor._marked_lock")
         self._gen_slots: List[int] = list(range(num_workers))
         self._launch_time = 0.0
         self._probe_thread: Optional[threading.Thread] = None
@@ -332,7 +333,7 @@ class ElasticSupervisor:
         # recheck + ready-set, and the shared backoff generator) across
         # the run thread and any number of probe threads.
         self._probe_epoch = 0
-        self._probe_lock = threading.Lock()
+        self._probe_lock = make_lock("ElasticSupervisor._probe_lock")
         # ONE backoff schedule for the supervisor's lifetime: a slot
         # that flaps (probes healthy, crash-loops on expansion,
         # re-shrinks) keeps escalating toward probe_max_interval_s

@@ -311,6 +311,9 @@ def _finalize_checkpoint(root: Path, name: str, step: int, tag: str,
     ckpt_dir = root / name
     if config_json is not None:
         _atomic_write_text(ckpt_dir / "config.json", config_json)
+    # analysis: allow(blocking-under-lock) — the index lock exists to
+    # serialize exactly this read-modify-write + rotation-delete (see
+    # docstring); it is a leaf lock, nothing nests inside it
     with _index_lock:
         idx_path = root / _INDEX
         index = json.loads(idx_path.read_text()) if idx_path.exists() else {"checkpoints": []}

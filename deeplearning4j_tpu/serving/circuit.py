@@ -36,10 +36,11 @@ serving layer's hook for ``serving_circuit_state`` gauges and
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from collections import deque
 from typing import Callable, Optional, Tuple
+
+from deeplearning4j_tpu.analysis.lockcheck import make_lock
 
 STATE_CLOSED = "closed"
 STATE_OPEN = "open"
@@ -95,7 +96,7 @@ class CircuitBreaker:
         self.policy = (policy or CircuitPolicy()).validate()
         self._clock = clock
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = make_lock("CircuitBreaker._lock")
         self._state = STATE_CLOSED
         self._outcomes: deque = deque()  # (t, ok) decided outcomes
         self._failures = 0               # running count of not-ok entries
@@ -233,6 +234,13 @@ class CircuitBreaker:
             self._failures = 0
         if self._on_transition is not None and frm != to:
             try:
+                # the hook runs UNDER this breaker's lock: the router's
+                # hook closes the backend's connection pool (backend
+                # lock), so circuit-before-backend is the fleet's one
+                # legal order — declared so the static pass turns any
+                # backend-then-circuit acquisition into an ABBA cycle
+                # finding (the PR 13 deadlock shape, now unrevivable).
+                # analysis: lock-edge(CircuitBreaker._lock -> Backend._lock) — on_transition calls Backend.close_pool
                 self._on_transition(frm, to)
             except Exception:  # noqa: BLE001 — hooks never wedge the breaker
                 pass

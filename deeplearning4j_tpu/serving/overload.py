@@ -67,6 +67,7 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from deeplearning4j_tpu.analysis.lockcheck import make_lock
 from deeplearning4j_tpu.observability import metrics as _obs_metrics
 from deeplearning4j_tpu.observability.flightrecorder import record_event
 from deeplearning4j_tpu.observability.sentinel import (
@@ -216,7 +217,7 @@ class TenantQuotas:
         self.burst = float(burst)
         self.max_tenants = int(max_tenants)
         self._buckets: "OrderedDict[str, _Bucket]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("TenantQuotas._lock")
 
     def __len__(self) -> int:
         with self._lock:
@@ -282,7 +283,7 @@ class BrownoutLadder:
         self._level = 0
         self._on_transition = on_transition
         self._listeners: List[Callable] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("BrownoutLadder._lock")
 
     def insert_rung(self, rung: BrownoutRung,
                     before: Optional[str] = None) -> bool:
@@ -408,7 +409,7 @@ class OverloadManager:
         self.shed_batch = False          # set by the shed-batch rung
         self._shed_count = 0             # admission sheds (all reasons)
         # tick state
-        self._lock = threading.Lock()
+        self._lock = make_lock("OverloadManager._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._over_streak = 0

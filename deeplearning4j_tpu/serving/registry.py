@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.analysis.lockcheck import make_lock
 from deeplearning4j_tpu.parallel.inference import (
     InferenceShutdown,
     ParallelInference,
@@ -85,12 +86,12 @@ class ModelEntry:
         self.fallback_engaged = False
         self._fallback_pi = None          # prewarmed dormant replica set
         self._fallback_warmed_sizes: List[int] = []
-        self._fallback_lock = threading.Lock()
-        self._lock = threading.Lock()
+        self._fallback_lock = make_lock("ModelEntry._fallback_lock")
+        self._lock = make_lock("ModelEntry._lock")
         # Serializes deploy/rollback (history mutation + swap) so
         # concurrent deploys can't leave the active version out of sync
         # with history[-1]. Never held while _lock is already held.
-        self._deploy_lock = threading.Lock()
+        self._deploy_lock = make_lock("ModelEntry._deploy_lock")
         self._active: Optional[_Active] = None
         self.history: List[Tuple[str, Any]] = []  # (version, variables)
         self.warmed = False
@@ -417,7 +418,7 @@ class ModelEntry:
 class ModelRegistry:
     def __init__(self, *, metrics=None):
         self._entries: Dict[str, ModelEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("ModelRegistry._lock")
         self._metrics = metrics
         self._admission = None
         self._warm_manifest = None

@@ -93,6 +93,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import urlsplit
 
+from deeplearning4j_tpu.analysis.lockcheck import make_lock
 from deeplearning4j_tpu.observability.federation import (
     federate_instruments,
 )
@@ -343,7 +344,7 @@ class RetryBudget:
         self._balance = min(float(initial), self.cap)
         self._spent = 0
         self._exhausted = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("RetryBudget._lock")
 
     def deposit(self) -> None:
         with self._lock:
@@ -444,7 +445,7 @@ class Backend:
         # backend compiling its manifest is ALIVE, not opaquely down
         self.warming: Optional[dict] = None
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("Backend._lock")
         self._idle = threading.Condition(self._lock)
         # pooled keep-alive connections to this backend (forward path)
         self._pool: List[http.client.HTTPConnection] = []
@@ -638,7 +639,7 @@ class FleetRouter:
         self.metrics.retry_budget_balance.set(self.budget.balance)
         self.metrics.backends.set(len(self._backends))
         # fleet priority-shed state (None fleet_max_in_flight disables)
-        self._fleet_lock = threading.Lock()
+        self._fleet_lock = make_lock("FleetRouter._fleet_lock")
         self._class_in_flight = {p: 0 for p in PRIORITIES}
         self._rr = 0  # least-loaded tie-break cursor
         self._started = False
@@ -882,8 +883,14 @@ class FleetRouter:
             owner = self.ring.owner(affinity, eligible)
             if owner is not None:
                 return next(b for b in candidates if b.name == owner)
-        low = min(b.in_flight for b in candidates)
-        lows = [b for b in candidates if b.in_flight == low]
+        # snapshot in_flight ONCE per backend: reading it again in the
+        # filter would race concurrent begin()/end() — a backend that
+        # moved between the min and the filter can empty `lows` (seen
+        # as a ZeroDivisionError 500 under the lockorder sanitizer's
+        # widened timing)
+        loads = [(b.in_flight, b) for b in candidates]
+        low = min(l for l, _ in loads)
+        lows = [b for l, b in loads if l == low]
         self._rr += 1  # benign race: any tie-break is a valid one
         return lows[self._rr % len(lows)]
 

@@ -32,3 +32,77 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+# -- session thread-leak guard ------------------------------------------------
+#
+# Exporter/prober/evaluator shutdown bugs historically leaked non-daemon
+# threads that kept CI processes alive past the last test. The guard
+# snapshots live threads at session start and fails the run if the
+# session ends with extra non-daemon threads still alive (after a grace
+# window for in-flight joins). Named allowlist for infrastructure that
+# legitimately outlives the session.
+
+import sys  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+from fnmatch import fnmatch  # noqa: E402
+
+# thread-name patterns allowed to survive the session: executor pools
+# are reclaimed by their atexit join, and pytest plugins may keep a
+# watcher around
+_THREAD_ALLOWLIST = (
+    "ThreadPoolExecutor-*",
+    "pytest-watcher*",
+)
+
+
+def _leaked_threads(initial):
+    # `initial` holds the thread OBJECTS (not idents — CPython recycles
+    # idents, so a leaked thread could inherit a session-start ident
+    # and escape; the snapshot set keeps the objects alive, identity
+    # can't be reused)
+    cur = threading.current_thread()
+    return [
+        th for th in threading.enumerate()
+        if th.is_alive() and not th.daemon and th is not cur
+        and th not in initial
+        and not any(fnmatch(th.name, pat) for pat in _THREAD_ALLOWLIST)
+    ]
+
+
+def pytest_sessionstart(session):
+    session._initial_threads = set(threading.enumerate())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    initial = getattr(session, "_initial_threads", None)
+    if initial is None:
+        return
+    deadline = time.monotonic() + 3.0
+    leaked = _leaked_threads(initial)
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaked = _leaked_threads(initial)
+    if not leaked:
+        return
+    frames = sys._current_frames()
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    lines = ["", "=== thread-leak guard: non-daemon thread(s) leaked by "
+                 "the test session ==="]
+    import traceback
+    for th in leaked:
+        lines.append(f"  {th.name!r} (ident {th.ident})")
+        frame = frames.get(th.ident)
+        if frame is not None:
+            lines.extend("    " + ln for ln in
+                         "".join(traceback.format_stack(frame, limit=8))
+                         .rstrip().splitlines())
+    lines.append("fix the owning component's shutdown (or extend "
+                 "tests/conftest.py _THREAD_ALLOWLIST with a reason)")
+    text = "\n".join(lines)
+    if tr is not None:
+        tr.write_line(text, red=True)
+    else:  # pragma: no cover - terminal plugin disabled
+        print(text, file=sys.stderr)
+    session.exitstatus = 1

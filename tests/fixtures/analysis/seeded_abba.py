@@ -1,0 +1,39 @@
+"""Seeded ABBA deadlock: Engine takes its own lock then calls into its
+breaker (which takes the breaker lock); Breaker's transition path takes
+the breaker lock then calls back into an Engine method that takes the
+engine lock. The analyzer must report one lock-order cycle with
+witnesses on both edges."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.breaker = Breaker()
+
+    def note_result(self, ok):
+        with self._lock:
+            # engine -> breaker: the forward half (the bug)
+            self.breaker.record(ok)
+
+    def close_pool(self):
+        with self._lock:
+            self.pool = []
+
+
+class Breaker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # static type witness so the one-level resolver sees the
+        # callback half (real code would declare a lock-edge instead)
+        self.engine = Engine()
+
+    def record(self, ok):
+        with self._lock:
+            self.state = ok
+
+    def transition(self):
+        with self._lock:
+            # breaker -> engine: the callback half of the ABBA
+            self.engine.close_pool()

@@ -1,0 +1,31 @@
+"""Every seeded violation in this file carries a reasoned allow
+comment: the analyzer must report ZERO active findings here, with the
+suppressed count surfaced as `allowlisted`."""
+
+import os
+import threading
+import time
+
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+
+
+class QuietPlane:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def pause_all(self):
+        # analysis: allow(blocking-under-lock) — seeded fixture: the
+        # sleep is the whole point of the boundary pause
+        with self._lock:
+            time.sleep(0.01)
+
+    def inline_form(self):
+        with self._lock:
+            # analysis: allow(blocking-under-lock) — seeded fixture
+            time.sleep(0.01)
+
+    def note(self):
+        # analysis: allow(unregistered-event-kind) — seeded fixture
+        record_event("quiet.widget_event", detail="suppressed")
+        # analysis: allow(unregistered-knob) — seeded fixture
+        return os.environ.get("DL4J_TPU_QUIET_BOGUS_KNOB")

@@ -1,0 +1,52 @@
+"""Seeded traced-hazards: host effects inside jit-traced functions (the
+bench jit-sleep trap — the sleep runs once at trace time and is
+compiled away). Decorated, passed-by-name, partial-wrapped, and lambda
+forms must all be caught; the pure_callback escape must not."""
+
+import random
+import time
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_step(x):
+    time.sleep(0.01)                 # seeded: traced sleep
+    return x * 2
+
+
+def named_step(x):
+    t = time.time()                  # seeded: trace-time clock
+    return x + t
+
+
+compiled_named = jax.jit(named_step)
+
+
+@partial(jax.jit, static_argnums=0)
+def partial_decorated(n, x):
+    noise = np.random.normal(size=n)   # seeded: host RNG frozen
+    return x + noise
+
+
+compiled_lambda = jax.jit(lambda x: x * random.random())  # seeded: RNG
+
+
+@jax.jit
+def callback_escape_is_fine(x):
+    jax.pure_callback(lambda v: time.sleep(0.0), None, x)
+    return x
+
+
+@jax.jit
+def callback_operand_is_traced(x):
+    # only the callback FN escapes to the host — this operand is
+    # evaluated at trace time and the clock value baked into the graph
+    return jax.pure_callback(lambda v: v, x, x + time.time())  # seeded
+
+
+def untraced_helper(x):
+    time.sleep(0.01)                 # NOT traced: no finding here
+    return x

@@ -1,0 +1,46 @@
+"""Seeded blocking-under-lock: sleeps, network/file I/O, subprocess
+spawn, and a jit entry all lexically inside a held-lock region. The
+analyzer must flag every one (PR 8/14 shape: incident-bundle I/O and
+fallback-prewarm compiles held under engine/entry locks)."""
+
+import json
+import subprocess
+import threading
+import time
+import urllib.request
+
+import jax
+
+
+class Bundler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.doc = {}
+
+    def capture(self):
+        with self._lock:
+            time.sleep(0.5)                      # seeded: sleeps
+
+    def publish(self, url):
+        with self._lock:
+            urllib.request.urlopen(url)          # seeded: network I/O
+
+    def persist(self, path):
+        with self._lock:
+            with open(path, "w") as fh:          # seeded: file I/O
+                json.dump(self.doc, fh)          # seeded: file I/O
+
+    def spawn(self):
+        with self._lock:
+            subprocess.run(["true"])             # seeded: process spawn
+
+    def prewarm(self, fn):
+        with self._lock:
+            return jax.jit(fn)                   # seeded: enters jit
+
+    def off_lock_is_fine(self):
+        time.sleep(0.0)
+        doc = None
+        with self._lock:
+            doc = dict(self.doc)
+        return doc

@@ -1,0 +1,22 @@
+"""Seeded vocabulary drift: a metric family missing from
+slo.known_metric_names(), a flight-event kind undeclared in
+observability/vocab.py, and a DL4J_TPU_* env knob unregistered in
+analysis/knobs.py. One finding each."""
+
+import os
+
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+
+class BogusPlane:
+    def __init__(self):
+        reg = MetricsRegistry()
+        ns = "bogus"
+        self.total = reg.counter(
+            "unregistered_widget_total", "seeded drift", namespace=ns)
+
+    def note(self):
+        self.total.inc()
+        record_event("bogus.widget_event", detail="seeded drift")
+        return os.environ.get("DL4J_TPU_UNREGISTERED_BOGUS_KNOB")

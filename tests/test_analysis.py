@@ -1,0 +1,487 @@
+"""Analysis-plane tests (deeplearning4j_tpu/analysis/): the static
+passes fire on seeded-violation fixtures and exit nonzero through the
+CLI, reasoned allow comments suppress, the env-knob registry and the
+GUIDE.md table agree, the whole tree is clean inside the tier-1 time
+budget, and the runtime lock-order sanitizer detects a deliberate
+inversion with both acquisition stacks while staying silent unarmed."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    default_guide,
+    knobs,
+    lockcheck,
+    run_check,
+)
+from deeplearning4j_tpu.analysis.__main__ import main as analysis_main
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+)
+from deeplearning4j_tpu.observability.metrics import get_sanitizer_metrics
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+
+
+def _fix(name):
+    return os.path.join(FIXDIR, name)
+
+
+def _rules(res):
+    return [f.rule for f in res.findings]
+
+
+# -- static passes on seeded-violation fixtures -------------------------------
+
+
+def test_abba_fixture_reports_cycle_with_both_witnesses():
+    res = run_check(roots=[_fix("seeded_abba.py")])
+    cycles = [f for f in res.findings if f.rule == "lock-order-cycle"]
+    assert len(cycles) == 1, res.render()
+    msg = cycles[0].message
+    assert "Engine._lock" in msg and "Breaker._lock" in msg
+    # a file:line witness per edge, both directions of the ABBA
+    assert msg.count("seeded_abba.py:") >= 2
+    assert "Engine._lock -> Breaker._lock" in msg
+    assert "Breaker._lock -> Engine._lock" in msg
+
+
+def test_sleep_under_lock_fixture_flags_every_blocking_class():
+    res = run_check(roots=[_fix("seeded_sleep_under_lock.py")])
+    blocking = [f for f in res.findings
+                if f.rule == "blocking-under-lock"]
+    msgs = "\n".join(f.message for f in blocking)
+    for call in ("time.sleep", "urllib.request.urlopen", "open",
+                 "json.dump", "subprocess.run", "jax.jit"):
+        assert f"{call}()" in msgs, (call, res.render())
+    # the sleep OUTSIDE the lock region must not be flagged
+    assert all("off_lock_is_fine" not in f.message for f in blocking)
+
+
+def test_jit_traced_hazard_fixture():
+    res = run_check(roots=[_fix("seeded_jit_sleep.py")])
+    hazards = [f for f in res.findings if f.rule == "traced-hazard"]
+    msgs = "\n".join(f.message for f in hazards)
+    assert "time.sleep() inside jit-traced decorated_step" in msgs
+    assert "time.time() inside jit-traced named_step" in msgs
+    assert "np.random.normal() inside jit-traced partial_decorated" \
+        in msgs
+    assert "random.random()" in msgs          # the inline lambda
+    # a hazard in a callback OPERAND is trace-time-evaluated: flagged
+    assert "time.time() inside jit-traced callback_operand_is_traced" \
+        in msgs
+    # host-callback escape and plain helpers are not traced hazards
+    assert "callback_escape_is_fine" not in msgs
+    assert "untraced_helper" not in msgs
+    assert len(hazards) == 5, res.render()
+
+
+def test_vocabulary_fixture_fires_all_three_rules():
+    res = run_check(roots=[_fix("seeded_vocab.py")])
+    rules = _rules(res)
+    assert rules.count("unregistered-metric") == 1, res.render()
+    assert rules.count("unregistered-event-kind") == 1
+    assert rules.count("unregistered-knob") == 1
+    by_rule = {f.rule: f.message for f in res.findings}
+    # namespace=ns resolved through the local string assignment
+    assert "bogus_unregistered_widget_total" in \
+        by_rule["unregistered-metric"]
+    assert "bogus.widget_event" in by_rule["unregistered-event-kind"]
+    assert "DL4J_TPU_UNREGISTERED_BOGUS_KNOB" in \
+        by_rule["unregistered-knob"]
+
+
+def test_allowlist_comments_suppress_with_reason():
+    res = run_check(roots=[_fix("seeded_allowlisted.py")])
+    assert res.findings == [], res.render()
+    # the post-filter suppressions are counted (the block-level
+    # blocking-under-lock suppression short-circuits in the walker and
+    # deliberately does not count)
+    assert res.allowlisted >= 3
+
+
+def test_allow_without_reason_is_itself_a_finding(tmp_path):
+    p = tmp_path / "bad_allow.py"
+    p.write_text(
+        "import threading, time\n"
+        "_lock = threading.Lock()\n"
+        "def f():\n"
+        "    # analysis: allow(blocking-under-lock)\n"
+        "    with _lock:\n"
+        "        time.sleep(1)\n")
+    res = run_check(roots=[str(p)])
+    assert "allow-missing-reason" in _rules(res), res.render()
+
+
+def test_allow_with_unknown_rule_name_is_flagged(tmp_path):
+    p = tmp_path / "typo_allow.py"
+    p.write_text(
+        "# analysis: allow(blocking-under-lok) — typo'd rule name\n"
+        "X = 1\n")
+    res = run_check(roots=[str(p)])
+    assert "unknown-allow-rule" in _rules(res), res.render()
+
+
+def test_declared_lock_edge_completes_a_static_cycle(tmp_path):
+    """A lock-edge(...) declaration (callback indirection the AST can't
+    see) plus the reverse order in code = a reported cycle."""
+    p = tmp_path / "declared_edge.py"
+    p.write_text(
+        "import threading\n"
+        "# analysis: lock-edge(Hook._lock -> Owner._lock) — hook "
+        "calls back\n"
+        "class Hook:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def fire(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "class Owner:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.hook = Hook()\n"
+        "    def run(self):\n"
+        "        with self._lock:\n"
+        "            self.hook.fire()\n")
+    res = run_check(roots=[str(p)])
+    cycles = [f for f in res.findings if f.rule == "lock-order-cycle"]
+    assert len(cycles) == 1, res.render()
+    assert "declared" in cycles[0].message
+
+
+def test_cycle_through_lock_free_intermediate_call(tmp_path):
+    """The closure follows a hop that itself holds nothing: f holds L
+    and calls g; g (lock-free) calls h which takes M — the L -> M edge
+    must exist, so the reverse order elsewhere is a cycle."""
+    p = tmp_path / "hop.py"
+    p.write_text(
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._other_lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            self.g()\n"
+        "    def g(self):\n"
+        "        self.h()\n"
+        "    def h(self):\n"
+        "        with self._other_lock:\n"
+        "            pass\n"
+        "    def rev(self):\n"
+        "        with self._other_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n")
+    res = run_check(roots=[str(p)])
+    cycles = [f for f in res.findings if f.rule == "lock-order-cycle"]
+    assert len(cycles) == 1, res.render()
+    assert "A._lock" in cycles[0].message
+    assert "A._other_lock" in cycles[0].message
+
+
+def test_closure_survives_mutually_recursive_calls(tmp_path):
+    """h <-> g mutual recursion must not freeze a partial closure: f
+    holds K and calls h (which reaches g's G acquisition through the
+    cycle) — the K -> G edge must exist regardless of the order the
+    methods are defined or visited in."""
+    p = tmp_path / "mutual.py"
+    p.write_text(
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._k_lock = threading.Lock()\n"
+        "        self._g_lock = threading.Lock()\n"
+        "    def h(self):\n"
+        "        self.g()\n"
+        "    def g(self):\n"
+        "        with self._g_lock:\n"
+        "            self.h()\n"
+        "    def f(self):\n"
+        "        with self._k_lock:\n"
+        "            self.h()\n"
+        "    def rev(self):\n"
+        "        with self._g_lock:\n"
+        "            with self._k_lock:\n"
+        "                pass\n")
+    res = run_check(roots=[str(p)])
+    cycles = [f for f in res.findings if f.rule == "lock-order-cycle"]
+    assert len(cycles) == 1, res.render()
+    assert "A._k_lock" in cycles[0].message
+    assert "A._g_lock" in cycles[0].message
+
+
+def test_def_inside_except_handler_is_scanned(tmp_path):
+    """The import-fallback idiom (`except ImportError: def ...`) and
+    else-branch defs are not blind spots."""
+    p = tmp_path / "fallback.py"
+    p.write_text(
+        "import threading, time\n"
+        "_lock = threading.Lock()\n"
+        "try:\n"
+        "    from fastmod import impl\n"
+        "except ImportError:\n"
+        "    def impl():\n"
+        "        with _lock:\n"
+        "            time.sleep(1)\n"
+        "if True:\n"
+        "    pass\n"
+        "else:\n"
+        "    def alt():\n"
+        "        with _lock:\n"
+        "            time.sleep(2)\n")
+    res = run_check(roots=[str(p)])
+    blocking = [f for f in res.findings
+                if f.rule == "blocking-under-lock"]
+    msgs = "\n".join(f.message for f in blocking)
+    assert "fallback.impl" in msgs, res.render()
+    assert "fallback.alt" in msgs, res.render()
+
+
+# -- CLI behavior -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fixture", [
+    "seeded_abba.py", "seeded_sleep_under_lock.py",
+    "seeded_jit_sleep.py", "seeded_vocab.py"])
+def test_cli_exits_nonzero_on_each_seeded_fixture(fixture, capsys):
+    rc = analysis_main(["--check", "--root", _fix(fixture)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert fixture in out
+
+
+def test_cli_exits_zero_on_clean_root(tmp_path, capsys):
+    (tmp_path / "clean.py").write_text("X = 1\n")
+    rc = analysis_main(["--check", "--root", str(tmp_path)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_json_output_is_machine_readable(capsys):
+    rc = analysis_main(
+        ["--check", "--root", _fix("seeded_abba.py"), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["findings"] and doc["files"] == 1
+    assert doc["findings"][0]["rule"] == "lock-order-cycle"
+
+
+def test_whole_tree_check_is_green_and_fast():
+    """THE tier-1 gate: `python -m deeplearning4j_tpu.analysis --check`
+    over the real package (+ bench.py + GUIDE.md drift) exits 0 inside
+    the 5 s budget (ASTs parsed once per run)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis",
+         "--check", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == []
+    assert doc["duration_s"] < 5.0, doc
+
+
+# -- env-knob registry + GUIDE.md drift ---------------------------------------
+
+
+def test_knob_registry_is_well_formed():
+    reg = knobs.registry()          # raises on duplicate names
+    table = knobs.render_guide_table()
+    for name in reg:
+        assert f"`{name}`" in table
+
+
+def test_guide_knob_table_is_in_sync():
+    guide = default_guide()
+    assert guide is not None
+    assert knobs.check_guide(guide) == []
+
+
+def test_guide_drift_is_detected_and_regenerable(tmp_path):
+    guide = tmp_path / "GUIDE.md"
+    shutil.copy(default_guide(), guide)
+    text = guide.read_text()
+    drifted = text.replace("| `DL4J_TPU_DEBUG` |", "| `DL4J_TPU_DBG` |")
+    assert drifted != text
+    guide.write_text(drifted)
+    errs = knobs.check_guide(str(guide))
+    assert errs and "DL4J_TPU_DEBUG" in errs[0]
+    # --write-knob-table regenerates it byte-for-byte
+    assert knobs.write_guide_table(str(guide)) is True
+    assert knobs.check_guide(str(guide)) == []
+
+
+def test_guide_without_markers_is_a_drift_error(tmp_path):
+    guide = tmp_path / "GUIDE.md"
+    guide.write_text("# no table here\n")
+    errs = knobs.check_guide(str(guide))
+    assert errs and "markers not found" in errs[0]
+    with pytest.raises(ValueError):
+        knobs.write_guide_table(str(guide))
+
+
+# -- runtime lock-order sanitizer ---------------------------------------------
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv(lockcheck.ENV_SANITIZERS, "lockorder")
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+def test_unarmed_factory_returns_plain_locks(monkeypatch):
+    monkeypatch.delenv(lockcheck.ENV_SANITIZERS, raising=False)
+    lk = lockcheck.make_lock("T.plain")
+    assert not isinstance(lk, lockcheck._SanitizedLock)
+    assert type(lk) is type(threading.Lock())
+
+
+def _run_in_thread(fn, name):
+    th = threading.Thread(target=fn, name=name)
+    th.start()
+    th.join(10.0)
+    assert not th.is_alive()
+
+
+def test_deliberate_inversion_detected_with_both_stacks(armed):
+    a = lockcheck.make_lock("Inv.A")
+    b = lockcheck.make_lock("Inv.B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    _run_in_thread(forward, "lockcheck-forward")
+    assert lockcheck.violations() == []
+    _run_in_thread(inverted, "lockcheck-inverted")
+    vs = lockcheck.violations()
+    assert len(vs) == 1, lockcheck.render_report(vs)
+    v = vs[0]
+    assert v["rule"] == "lock-order-inversion"
+    assert sorted(v["locks"]) == ["Inv.A", "Inv.B"]
+    assert v["thread"] == "lockcheck-inverted"
+    # the report carries all four stacks: both threads, hold + acquire
+    assert len(v["stacks"]) == 4
+    assert all("in forward" in s or "in inverted" in s
+               for s in v["stacks"].values())
+    report = lockcheck.render_report()
+    assert "Inv.A" in report and "lock-order-inversion" in report
+    # one report per lock pair: repeating the inversion stays at 1
+    _run_in_thread(inverted, "lockcheck-again")
+    assert len(lockcheck.violations()) == 1
+
+
+def test_long_hold_with_blocking_call_detected(armed, monkeypatch):
+    monkeypatch.setenv(lockcheck.ENV_HOLD_S, "0.05")
+    lk = lockcheck.make_lock("Hold.L")
+    with lk:
+        time.sleep(0.12)
+    vs = lockcheck.violations()
+    assert [v["rule"] for v in vs] == ["lock-long-hold"], \
+        lockcheck.render_report(vs)
+    assert "Hold.L" in vs[0]["detail"]
+
+
+def test_rlock_reentrancy_defines_no_order(armed):
+    r = lockcheck.make_rlock("Re.R")
+    other = lockcheck.make_lock("Re.other")
+    with r:
+        with r:                # inner recursion: no self-edge
+            with other:
+                pass
+    with other:                # would invert IF recursion made edges
+        pass
+    with r:
+        pass
+    assert lockcheck.violations() == [], lockcheck.render_report()
+    assert ("Re.R", "Re.other") in lockcheck.order_graph()
+
+
+def test_condition_composes_with_sanitized_lock(armed):
+    lk = lockcheck.make_lock("Cond.L")
+    cond = threading.Condition(lk)
+    with cond:
+        cond.wait(timeout=0.01)    # releases + reacquires through us
+    with lk:
+        pass
+    assert lockcheck.violations() == [], lockcheck.render_report()
+
+
+def test_condition_composes_with_sanitized_rlock(armed):
+    """Condition over make_rlock: notify()/wait() must work (the
+    wrapper delegates _is_owned — Condition's fallback ownership probe
+    acquires reentrantly on an owned RLock and misreads it as
+    un-owned), and the held-set stays truthful across wait()'s full
+    recursion-count release/reacquire."""
+    r = lockcheck.make_rlock("CondR.R")
+    cond = threading.Condition(r)
+    with cond:
+        cond.notify()              # RuntimeError without _is_owned
+        cond.wait(timeout=0.01)
+    with r:
+        with r:
+            pass
+    assert lockcheck.violations() == [], lockcheck.render_report()
+
+
+def test_violation_emits_metric_and_flight_event(armed):
+    metric = get_sanitizer_metrics().violations_total
+    before = metric.value(rule="lock-order-inversion")
+    a = lockcheck.make_lock("Emit.A")
+    b = lockcheck.make_lock("Emit.B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    _run_in_thread(forward, "emit-forward")
+    _run_in_thread(inverted, "emit-inverted")
+    assert metric.value(rule="lock-order-inversion") == before + 1
+    events = get_flight_recorder().events(
+        kinds=("sanitizer.violation",))
+    assert events, "no sanitizer.violation flight event recorded"
+    data = events[-1]["data"]
+    assert data["rule"] == "lock-order-inversion"
+    assert sorted(data["locks"]) == ["Emit.A", "Emit.B"]
+
+
+# -- session thread-leak guard (tests/conftest.py) ----------------------------
+
+
+def test_thread_leak_guard_sees_nondaemon_leak_and_honors_allowlist():
+    import conftest
+    stop = threading.Event()
+    leaky = threading.Thread(target=stop.wait, name="leaky-probe")
+    pooled = threading.Thread(target=stop.wait,
+                              name="ThreadPoolExecutor-99_0")
+    leaky.start()
+    pooled.start()
+    try:
+        leaked = conftest._leaked_threads(set())
+        names = [th.name for th in leaked]
+        assert "leaky-probe" in names
+        assert "ThreadPoolExecutor-99_0" not in names  # allowlisted
+    finally:
+        stop.set()
+        leaky.join(5.0)
+        pooled.join(5.0)

@@ -19,6 +19,7 @@ import jax
 import numpy as np
 import pytest
 
+from deeplearning4j_tpu.analysis import lockcheck
 from deeplearning4j_tpu.parallel.inference import (
     InferenceDeadlineExpired,
     ParallelInference,
@@ -583,13 +584,25 @@ def _mixed_phase(server, n_rounds, outcomes, overload_ticks=0):
         server.overload.tick()
 
 
-def test_chaos_overload_brownout_full_roundtrip():
+def test_chaos_overload_brownout_full_roundtrip(monkeypatch):
     """The acceptance loop at tier-1 scale: serving.overload armed
     against a two-tenant, three-priority mix -> AIMD shrinks, the
     ladder walks all the way down (batch shed, fallback serving), no
     critical request is ever shed, and after the fault clears the
     ladder re-escalates to level 0 with the original version serving
-    (metrics prove the round trip)."""
+    (metrics prove the round trip).
+
+    Runs with the lockorder sanitizer armed: every lock built through
+    the overload/admission/registry planes is instrumented, and the
+    test asserts the whole brownout round trip produced zero
+    order-inversion / long-hold violations — the chaos path re-proves
+    the serving plane's lock discipline on every run."""
+    monkeypatch.setenv("DL4J_TPU_SANITIZERS", "lockorder")
+    # a generous long-hold threshold: a >1 s GIL/scheduler stall while
+    # a lock is held would otherwise fail the zero-violation assert
+    # with no real defect on a loaded CI machine
+    monkeypatch.setenv("DL4J_TPU_LOCKCHECK_HOLD_S", "30")
+    lockcheck.reset()
     server, registry = _overload_server(_chaos_policy())
     registry.get("scale").set_fallback({"scale": 9.0})
     outcomes = []
@@ -654,6 +667,8 @@ def test_chaos_overload_brownout_full_roundtrip():
     crit = [(s, b) for p, s, b in outcomes if p == "critical"]
     assert crit and all(s == 200 for s, _ in crit), \
         [b for s, b in crit if s != 200][:3]
+    # and the armed lockorder sanitizer saw a clean run
+    assert lockcheck.violations() == [], lockcheck.render_report()
 
 
 @pytest.mark.slow

@@ -32,6 +32,7 @@ import numpy as np
 import pytest
 import urllib.request
 
+from deeplearning4j_tpu.analysis import lockcheck
 from deeplearning4j_tpu.resilience.faults import (
     FaultInjector,
     set_fault_injector,
@@ -853,34 +854,53 @@ def _await_ready(proc, timeout_s=60.0):
 
 @pytest.fixture(scope="class")
 def chaos_fleet():
-    """3 REAL subprocess backends (SIGKILL-able) behind one router."""
-    ports = [_free_port() for _ in range(3)]
-    procs = [_spawn_backend(p, float(i + 1))
-             for i, p in enumerate(ports)]
-    ok = all(_await_ready(p) for p in procs)
-    if not ok:
-        for p in procs:
-            p.kill()
-        pytest.skip("subprocess backends failed to start")
-    policy = RouterPolicy(probe_interval_s=0.25, probe_timeout_s=0.5,
-                          reprobe_after_s=0.5)
-    router = FleetRouter(
-        [(f"b{i}", f"http://127.0.0.1:{p}")
-         for i, p in enumerate(ports)], policy=policy).start()
-    ns = type("ChaosFleet", (), {})()
-    ns.ports = ports
-    ns.procs = procs
-    ns.router = router
-    yield ns
-    router.stop()
-    for p in ns.procs:
-        if p.poll() is None:
-            p.kill()
-    for p in ns.procs:
+    """3 REAL subprocess backends (SIGKILL-able) behind one router.
+
+    The router (and through it every Backend/CircuitBreaker/RetryBudget
+    lock) is constructed with the lockorder sanitizer ARMED: the SIGKILL
+    chaos path exercises the circuit->backend callback ordering that
+    deadlocked in the PR 13 ABBA, so every run re-proves the fix —
+    the test asserts zero sanitizer violations after the storm."""
+    # MonkeyPatch.context: the armed env is restored on EVERY exit from
+    # this block — teardown, skip, or an exception anywhere in setup —
+    # so a failed fixture can't leak instrumented locks into the rest
+    # of the session
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("DL4J_TPU_SANITIZERS", "lockorder")
+        # generous long-hold threshold: a >1 s scheduler stall under a
+        # held lock is not a defect on a loaded CI machine
+        mp.setenv("DL4J_TPU_LOCKCHECK_HOLD_S", "30")
+        lockcheck.reset()
+        ports = [_free_port() for _ in range(3)]
+        procs = [_spawn_backend(p, float(i + 1))
+                 for i, p in enumerate(ports)]
         try:
-            p.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            pass
+            ok = all(_await_ready(p) for p in procs)
+            if not ok:
+                pytest.skip("subprocess backends failed to start")
+            policy = RouterPolicy(probe_interval_s=0.25,
+                                  probe_timeout_s=0.5,
+                                  reprobe_after_s=0.5)
+            router = FleetRouter(
+                [(f"b{i}", f"http://127.0.0.1:{p}")
+                 for i, p in enumerate(ports)], policy=policy).start()
+            try:
+                ns = type("ChaosFleet", (), {})()
+                ns.ports = ports
+                ns.procs = procs
+                ns.router = router
+                yield ns
+            finally:
+                router.stop()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
 
 
 def _chaos_load(url, *, threads, per_thread, pause_s, barrier=None):
@@ -949,6 +969,11 @@ class TestFleetChaos:
         seen = {c.predict("scale", x)["outputs"][0][0]
                 for _ in range(18)}
         assert 2.0 in seen
+        # the armed lockorder sanitizer watched the whole storm —
+        # SIGKILL, ejection (circuit trip -> close_pool under the
+        # breaker lock), drain waits, re-admission — and saw no
+        # order inversion or long hold
+        assert lockcheck.violations() == [], lockcheck.render_report()
 
     def test_fleet_debug_reflects_restart_history(self, chaos_fleet):
         d = _fleet_debug(chaos_fleet.router.url)
