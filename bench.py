@@ -2622,6 +2622,210 @@ def bench_reqtrace(peak, *, requests=10, rounds=8, num_slots=2,
         _tr.set_tail_sampler(prev_sampler)
 
 
+def bench_cache(peak, *, n_threads=4, requests_per_thread=60,
+                pool_size=24, zipf_a=1.5, dim=256, hidden=1024,
+                depth=16, repeat_burst=20,
+                prefix_requests=6, gen_hidden=128, gen_layers=3,
+                gen_heads=4, gen_vocab=512, gen_max_len=96,
+                gen_max_new=8):
+    """Request & prefix caching benchmark (serving/cache.py +
+    serving/prefixkv.py): what the caching tier buys on a realistic
+    repeat-heavy mix. Three legs:
+
+    1. **Goodput uplift** — N closed-loop clients draw payloads from a
+       bounded pool with Zipf(a) popularity (a few payloads dominate —
+       the retry/poll/shared-prompt shape) through real loopback HTTP
+       against a deliberately compute-heavy MLP. The same mix runs once
+       with `X-Cache-Bypass` on every request (cache-off baseline) and
+       once against the armed response cache; gated on
+       **goodput_on / goodput_off >= 2x**.
+    2. **No-slot proof** — a burst of exact repeats against the warm
+       cache must leave the device-batch counter EXACTLY flat: a cache
+       hit is answered before admission takes a batch slot.
+    3. **Prefix TTFT** — a GenerationEngine with prefix-KV reuse armed
+       serves prompts sharing a long common prefix; client-measured
+       TTFT on prefix hits (graft + suffix-feed) must beat cold
+       prefills of the same total length.
+
+    ``peak`` (chip FLOPs) is unused: end-to-end caching economics.
+    """
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.gpt import Gpt, GptConfig
+    from deeplearning4j_tpu.serving import (
+        GenerationEngine,
+        ModelRegistry,
+        ModelServer,
+        ServingClient,
+        spec,
+    )
+
+    # --- leg 1+2: exact-match response cache over HTTP -----------------
+    rng = np.random.default_rng(0)
+    w0 = jnp.asarray(rng.normal(0, 0.05, (dim, hidden)), jnp.float32)
+    wh = jnp.asarray(rng.normal(0, 0.05, (hidden, hidden)), jnp.float32)
+    wo = jnp.asarray(rng.normal(0, 0.05, (hidden, 8)), jnp.float32)
+
+    def forward(v, x):
+        h = jnp.tanh(x @ v["w0"])
+        for _ in range(depth):
+            h = jnp.tanh(h @ v["wh"])
+        return h @ v["wo"]
+
+    registry = ModelRegistry()
+    registry.register("zipf", forward, {"w0": w0, "wh": wh, "wo": wo},
+                      input_spec=spec((dim,)), version="v1",
+                      mode="batched", max_batch_size=8)
+    server = ModelServer(registry, port=0, sentinel=False, cache=True)
+    server.start(warm=True)
+    try:
+        pool = [rng.normal(size=(1, dim)).astype(np.float32)
+                for _ in range(pool_size)]
+        p = 1.0 / np.arange(1, pool_size + 1) ** zipf_a
+        p /= p.sum()
+        lock = threading.Lock()
+
+        def window(bypass):
+            latencies, broken = [], []
+            barrier = threading.Barrier(n_threads + 1)
+
+            def run(tid):
+                draw = np.random.default_rng(100 + tid)
+                client = ServingClient(server.url)
+                picks = draw.choice(pool_size, size=requests_per_thread,
+                                    p=p)
+                barrier.wait()
+                for k in picks:
+                    t0 = time.monotonic()
+                    try:
+                        client.predict("zipf", pool[int(k)],
+                                       cache_bypass=bypass,
+                                       deadline_ms=30000)
+                        with lock:
+                            latencies.append(time.monotonic() - t0)
+                    except Exception as e:  # noqa: BLE001 - any = bug
+                        with lock:
+                            broken.append(repr(e))
+
+            threads = [threading.Thread(target=run, args=(t,))
+                       for t in range(n_threads)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t_start = time.monotonic()
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t_start
+            return len(latencies) / wall, broken
+
+        goodput_off, broken_off = window(bypass=True)
+        goodput_on, broken_on = window(bypass=False)
+        uplift = goodput_on / max(goodput_off, 1e-9)
+        cstate = server.response_cache.describe()
+
+        # leg 2: a pure-repeat burst must not touch the device at all
+        client = ServingClient(server.url)
+        client.predict("zipf", pool[0])  # ensure the entry is resident
+        dev_before = server.metrics.device_latency.summary(
+            model="zipf")["count"]
+        hits_before = server.response_cache.describe()["hits"]
+        for _ in range(repeat_burst):
+            client.predict("zipf", pool[0])
+        dev_after = server.metrics.device_latency.summary(
+            model="zipf")["count"]
+        hits_after = server.response_cache.describe()["hits"]
+        burst_hits = hits_after - hits_before
+        burst_batches = dev_after - dev_before
+    finally:
+        server.stop()
+
+    # --- leg 3: prefix-KV reuse TTFT ----------------------------------
+    model = Gpt(GptConfig(
+        vocab_size=gen_vocab, hidden=gen_hidden, num_layers=gen_layers,
+        num_heads=gen_heads, intermediate=gen_hidden * 4,
+        max_position=gen_max_len, dropout=0.0, attention_dropout=0.0))
+    engine = GenerationEngine(
+        model, model.init(seed=0), name="gpt", num_slots=2,
+        max_len=gen_max_len, max_new_tokens=gen_max_new,
+        idle_wait_s=0.002, temperature=0.0, prefix_cache=True,
+        max_waiting=4 * prefix_requests)
+    gserver = ModelServer(port=0, sentinel=False,
+                          generators={"gpt": engine})
+    gserver.start(warm=True)
+    try:
+        gclient = ServingClient(gserver.url)
+        gdraw = np.random.default_rng(7)
+        # prompts are one prompt-bucket plus one suffix token: a prefix
+        # hit grafts the bucket-sized slab and feeds ONE token; a cold
+        # prefill pads the whole prompt into the next bucket up
+        pbucket = max(b for b in engine.prompt_buckets
+                      if b + 1 < gen_max_len)
+        plen = pbucket + 1
+
+        def ttft(prompt):
+            t0 = time.monotonic()
+            for _tok in gclient.generate("gpt", prompt,
+                                         temperature=0.0):
+                return time.monotonic() - t0
+            return time.monotonic() - t0
+
+        # cold leg: every prompt has a DISTINCT prefix — no reuse ever
+        cold = [ttft(gdraw.integers(0, gen_vocab - 1, size=plen))
+                for _ in range(prefix_requests)]
+        # hit leg: shared prefix, varied suffix token; the first request
+        # publishes the slab and is excluded from the hit stats
+        base = gdraw.integers(0, gen_vocab - 1, size=plen)
+        ttft(base)
+        hits = []
+        for i in range(prefix_requests):
+            pr = base.copy()
+            pr[-1] = (int(pr[-1]) + 1 + i) % gen_vocab
+            hits.append(ttft(pr))
+        pstate = engine.prefix_cache.describe()
+        ttft_cold_ms = float(np.median(cold) * 1e3)
+        ttft_hit_ms = float(np.median(hits) * 1e3)
+        ttft_ratio = ttft_hit_ms / max(ttft_cold_ms, 1e-9)
+    finally:
+        gserver.stop()
+
+    info = {
+        "offered_per_window": n_threads * requests_per_thread,
+        "pool_size": pool_size, "zipf_a": zipf_a,
+        "broken": len(broken_off) + len(broken_on),
+        "goodput_off_rps": round(goodput_off, 1),
+        "goodput_on_rps": round(goodput_on, 1),
+        "goodput_uplift": round(uplift, 2),
+        "cache_hits": cstate["hits"], "cache_misses": cstate["misses"],
+        "burst_hits": burst_hits,
+        "burst_device_batches": burst_batches,
+        "prefix_hits": pstate["hits"],
+        "prefix_len": pbucket,
+        "ttft_cold_ms": round(ttft_cold_ms, 2),
+        "ttft_prefix_hit_ms": round(ttft_hit_ms, 2),
+        "ttft_ratio": round(ttft_ratio, 3),
+        "compiles_after_warm": engine.compiles_after_warm,
+        # integrity gates: >= 2x goodput on the Zipf mix, exact hits
+        # consume ZERO batch slots, prefix hits measurably cut TTFT
+        # with zero recompiles after warmup
+        "gate_uplift_ok": bool(uplift >= 2.0),
+        "gate_no_slot_ok": bool(burst_batches == 0
+                                and burst_hits == repeat_burst),
+        "gate_ttft_ok": bool(ttft_ratio < 0.9 and pstate["hits"]
+                             >= prefix_requests),
+        "converged": bool(
+            uplift >= 2.0 and not broken_off and not broken_on
+            and burst_batches == 0 and burst_hits == repeat_burst
+            and ttft_ratio < 0.9 and pstate["hits"] >= prefix_requests
+            and engine.compiles_after_warm == 0),
+        "unit": "x goodput uplift, Zipf mix vs cache-off",
+    }
+    info["value"] = round(uplift, 2)
+    return info
+
+
 _CONFIGS = {
     "bert": bench_bert,
     # Batch-size knee probe (no baseline row): how much of the remaining
@@ -2693,6 +2897,11 @@ _CONFIGS = {
     # trace.TailSampler): the always-on per-request observability
     # plane's cost on the serving hot path, gated < 2% of step time.
     "reqtrace": bench_reqtrace,
+    # Request & prefix caching tier (serving/cache + serving/prefixkv):
+    # goodput uplift on a Zipf repeat mix vs cache-off (gated >= 2x),
+    # exact hits proven to consume zero batch slots, and prefix-KV
+    # TTFT reduction vs cold prefill at equal prompt length.
+    "cache": bench_cache,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -2758,6 +2967,15 @@ _CPU_INTEGRITY = {
     # reqtrace reports "converged" = the always-on ledger + tail-staging
     # plane costs the serving window < 2%
     "reqtrace": dict(requests=6, rounds=6, max_new_tokens=8, max_len=32),
+    # cache reports "converged" = >= 2x goodput on the Zipf mix vs
+    # bypass, a pure-repeat burst consumed zero device batches, and
+    # prefix hits beat cold prefills on TTFT with zero recompiles
+    # (same gates as the perf leg at a smaller offered load)
+    "cache": dict(n_threads=3, requests_per_thread=25, pool_size=10,
+                  dim=128, hidden=1024, depth=16, repeat_burst=10,
+                  prefix_requests=4, gen_hidden=64, gen_layers=2,
+                  gen_heads=2, gen_vocab=128, gen_max_len=80,
+                  gen_max_new=4),
 }
 
 
@@ -2833,7 +3051,7 @@ def main():
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
                             "serving,overload,generation,resilience,"
                             "observability,robustness,federation,elastic,"
-                            "sentinel,reqtrace,warmstart",
+                            "sentinel,reqtrace,warmstart,cache",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

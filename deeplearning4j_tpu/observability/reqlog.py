@@ -18,6 +18,11 @@ One record carries the whole story of one request:
 - placement: decode slot / batch rows + bucket (stamped post-hoc by the
   ParallelInference worker for predict, by the scheduler for
   generation);
+- caching: a ``cache`` field (``hit`` / ``stale`` / ``miss`` /
+  ``bypass`` / ``prefix_hit``) annotated by the response cache and the
+  prefix-KV store, plus ``prefix_len`` on prefix hits — so
+  ``/debug/requests`` answers "was request X served from cache, and
+  how much prefill did it skip?";
 - outcome: ``ok`` / ``error`` / ``shed`` / ``preempted`` / ``deadline``
   / ``cancelled`` / ``rejected``, HTTP status, finish reason, deadline
   slack (negative = the deadline was missed);

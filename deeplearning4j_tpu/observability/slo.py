@@ -205,10 +205,15 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     from deeplearning4j_tpu.observability.federation import ClusterMetrics
     from deeplearning4j_tpu.observability.reqlog import ReqLogMetrics
     from deeplearning4j_tpu.observability.sentinel import SentinelMetrics
+    from deeplearning4j_tpu.serving.cache import CacheMetrics
     from deeplearning4j_tpu.serving.metrics import ServingMetrics
     from deeplearning4j_tpu.serving.router import RouterMetrics
 
     ServingMetrics(reg)
+    # the caching-tier cache_* / cache_prefix_* families
+    # (serving/cache.py + serving/prefixkv.py): the cache hit-rate and
+    # stale-serve burn-rate rules validate offline
+    CacheMetrics(reg)
     # the fleet-router router_* families (serving/router.py): the
     # router-availability / retry-budget burn-rate rules validate
     # offline like every other plane's

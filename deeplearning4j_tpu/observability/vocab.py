@@ -122,10 +122,22 @@ SANITIZER_KINDS = frozenset({
     "sanitizer.violation",
 })
 
+# request & prefix caching tier (serving/cache.py, serving/prefixkv.py,
+# the router's fleet-level lookup)
+CACHE_KINDS = frozenset({
+    "cache.hit",
+    "cache.invalidate",
+    "cache.prefix_evict",
+    "cache.prefix_insert",
+    "cache.pressure",
+    "cache.purge",
+    "cache.stale_serve",
+})
+
 EVENT_KINDS = frozenset().union(
     SERVING_KINDS, GENERATION_KINDS, ROUTER_KINDS, TRAIN_KINDS,
     RESILIENCE_KINDS, COMPILE_KINDS, OBSERVABILITY_KINDS,
-    SANITIZER_KINDS)
+    SANITIZER_KINDS, CACHE_KINDS)
 
 
 def known_event_kinds() -> frozenset:

@@ -46,6 +46,16 @@ REST serving story, grown into a first-class subsystem).
   in-flight limit (p99-vs-rolling-baseline, sentinel machinery), and a
   brownout degradation ladder (shrink batch wait → shed batch class →
   hot-swap fallback versions) with hysteresis.
+- cache + prefixkv: the request & prefix caching tier — an exact-match
+  response cache consulted at admission *before* a batch slot is taken
+  (content-hash key over model/version/epoch/payload, bounded LRU +
+  TTL + byte budget, strict per-tenant isolation, invalidated by
+  registry swap epochs on hot-swap/rollback, stale-serve during
+  brownout), prefix-KV reuse in the generation engine (common prompt
+  prefixes pinned as shared immutable KV slabs with refcounting; a hit
+  grafts the slab and feeds only the suffix, cutting prefill FLOPs and
+  TTFT), and a router-level cache so a fleet-wide repeat is answered
+  at the router without touching a backend. GET /debug/cache.
 - router: the fleet tier — FleetRouter in front of N ModelServers:
   health-gated routing (active /readyz probes + passive consecutive-
   failure ejection through the circuit state machine, half-open
@@ -59,6 +69,13 @@ REST serving story, grown into a first-class subsystem).
 from deeplearning4j_tpu.serving.admission import (
     AdmissionController,
     AdmissionTicket,
+)
+from deeplearning4j_tpu.serving.cache import (
+    CacheHit,
+    CacheMetrics,
+    ResponseCache,
+    resolve_response_cache,
+    response_cache_key,
 )
 from deeplearning4j_tpu.serving.circuit import CircuitBreaker, CircuitPolicy
 from deeplearning4j_tpu.serving.client import ServingClient
@@ -97,6 +114,10 @@ from deeplearning4j_tpu.serving.overload import (
     OverloadPolicy,
     TenantQuotas,
 )
+from deeplearning4j_tpu.serving.prefixkv import (
+    PrefixKVStore,
+    resolve_prefix_store,
+)
 from deeplearning4j_tpu.serving.registry import ModelEntry, ModelRegistry
 from deeplearning4j_tpu.serving.router import (
     FleetRouter,
@@ -124,6 +145,8 @@ __all__ = [
     "BadRequestError",
     "BrownoutLadder",
     "BrownoutRung",
+    "CacheHit",
+    "CacheMetrics",
     "CircuitBreaker",
     "CircuitOpenError",
     "CircuitPolicy",
@@ -146,7 +169,9 @@ __all__ = [
     "OverloadManager",
     "OverloadPolicy",
     "PRIORITIES",
+    "PrefixKVStore",
     "QueueFullError",
+    "ResponseCache",
     "RetryBudget",
     "RouterMetrics",
     "RouterPolicy",
@@ -161,7 +186,10 @@ __all__ = [
     "WorkerCrashedError",
     "bucket_sizes",
     "error_from_code",
+    "resolve_prefix_store",
+    "resolve_response_cache",
     "resolve_warmup_manifest",
+    "response_cache_key",
     "spec",
     "token_brownout_rung",
     "warmup_inference",

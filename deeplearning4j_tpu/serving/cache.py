@@ -1,0 +1,476 @@
+"""Request caching tier: the exact-match response cache.
+
+At consumer scale most serving traffic is redundant — identical
+classify/embed requests hitting the same model version over and over —
+yet every request still takes an admission slot and a batch seat. This
+module makes "work we already did" a first-class serving primitive
+(the spirit of the cuDNN primitive catalog: the reusable unit IS the
+product): a bounded LRU + TTL cache of full predict responses,
+consulted *before* the circuit breaker and admission controller take a
+batch slot, so a hit costs the overloaded data plane nothing.
+
+Design points:
+
+- **Key** (:func:`response_cache_key`): sha256 over the canonical JSON
+  of (model, version, registry epoch, request payload minus
+  ``deadline_ms``). The epoch — bumped by the registry on every
+  hot-swap/rollback pointer swap — makes entries from a replaced
+  version structurally unreachable even before the invalidation
+  listener reclaims them.
+- **Tenant isolation**: every entry is stored under a composite
+  ``(tenant, key)`` — a lookup for tenant B can never return tenant
+  A's entry, whatever the payload, because B's probe key is a
+  different dict key. The anonymous namespace (no ``X-Tenant``) is its
+  own tenant, isolated from all named ones.
+- **Brownout interaction**: ``set_stale_serve(True)`` (the
+  ``cache_pressure`` brownout rung) lets expired-but-present entries
+  keep serving while the ladder is engaged — a degraded answer beats a
+  shed — counted as ``outcome="stale"`` so the stale-serve burn-rate
+  rule sees exactly how much staleness the brownout bought;
+  ``pressure_evict`` drops the LRU half so the cache's host memory
+  participates in pressure shedding.
+- **Shared tier**: the fleet router runs the same class with
+  ``plane="router"`` — a fleet-wide hit is answered without touching a
+  backend, and the ``cache_*`` families federate per plane.
+
+Everything is stdlib + the repo's own telemetry spine; locks go
+through :func:`~deeplearning4j_tpu.analysis.lockcheck.make_lock` so
+the lockorder sanitizer sees this tier like every other.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.analysis.lockcheck import make_lock
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+ENV_CACHE = "DL4J_TPU_CACHE"
+ENV_CACHE_CAPACITY = "DL4J_TPU_CACHE_CAPACITY"
+ENV_CACHE_TTL_S = "DL4J_TPU_CACHE_TTL_S"
+ENV_CACHE_MAX_BYTES = "DL4J_TPU_CACHE_MAX_BYTES"
+
+DEFAULT_CAPACITY = 1024
+DEFAULT_TTL_S = 60.0
+DEFAULT_MAX_BYTES = 64 << 20
+
+
+class CacheMetrics:
+    """The cache tier's instrument bundle. ``plane`` distinguishes the
+    server-side response cache from the router's fleet-level one when
+    both land in a federated scrape; the prefix-KV families label by
+    model (engine route names — a bounded, operator-chosen set)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        r = self.registry
+        self.requests_total = r.counter(
+            "cache_requests_total",
+            "Response-cache lookups by outcome: hit (fresh entry "
+            "served), miss, stale (expired entry served under the "
+            "cache_pressure brownout rung — the stale-serve burn-rate "
+            "rule's bad events), bypass (client sent X-Cache-Bypass).",
+            ("plane", "outcome"))
+        self.insertions_total = r.counter(
+            "cache_insertions_total",
+            "Responses written into the cache (200s on a consulted "
+            "key).", ("plane",))
+        self.evictions_total = r.counter(
+            "cache_evictions_total",
+            "Entries dropped, by reason: lru (capacity/byte bound), "
+            "ttl (expired on lookup), invalidate (registry epoch bump "
+            "on hot-swap/rollback), pressure (brownout rung), purge "
+            "(administrative clear).", ("plane", "reason"))
+        self.invalidations_total = r.counter(
+            "cache_invalidations_total",
+            "Invalidation passes (not entries — evictions_total counts "
+            "those), by reason.", ("plane", "reason"))
+        self.entries = r.gauge(
+            "cache_entries", "Entries currently cached.", ("plane",))
+        self.size_bytes = r.gauge(
+            "cache_bytes", "Approximate bytes of cached response "
+            "bodies.", ("plane",))
+        # prefix-KV reuse (serving/prefixkv.py + generation.py)
+        self.prefix_requests_total = r.counter(
+            "cache_prefix_requests_total",
+            "Prefix-KV lookups at generation prefill, by outcome "
+            "(hit = a shared prefix slab was grafted instead of a "
+            "full prefill).", ("model", "outcome"))
+        self.prefix_insertions_total = r.counter(
+            "cache_prefix_insertions_total",
+            "Prefix KV slabs captured from completed prefills.",
+            ("model",))
+        self.prefix_evictions_total = r.counter(
+            "cache_prefix_evictions_total",
+            "Prefix slabs dropped, by reason (lru = byte bound; "
+            "pinned entries are never evicted).", ("model", "reason"))
+        self.prefix_entries = r.gauge(
+            "cache_prefix_entries",
+            "Prefix KV slabs currently held.", ("model",))
+        self.prefix_bytes = r.gauge(
+            "cache_prefix_bytes",
+            "Bytes of shared prefix KV slabs.", ("model",))
+        self.prefix_tokens_reused_total = r.counter(
+            "cache_prefix_tokens_reused_total",
+            "Prompt tokens whose prefill was skipped by grafting a "
+            "shared prefix slab (the prefill-FLOP savings signal).",
+            ("model",))
+
+
+def response_cache_key(model: str, version: str, epoch: int,
+                       payload) -> Optional[str]:
+    """The exact-match key: sha256 of the canonical JSON of
+    (model, version, epoch, payload minus ``deadline_ms``).
+
+    ``deadline_ms`` is excluded — it parameterizes the client's wait,
+    not the computation. Returns None when the payload defeats
+    canonical serialization (the caller treats that as a bypass: an
+    uncacheable request must not 500)."""
+    if isinstance(payload, dict):
+        payload = {k: v for k, v in payload.items() if k != "deadline_ms"}
+    try:
+        doc = json.dumps([model, version, epoch, payload],
+                         sort_keys=True, separators=(",", ":"),
+                         default=_canon_default)
+    except (TypeError, ValueError):
+        return None
+    return hashlib.sha256(doc.encode()).hexdigest()
+
+
+def _canon_default(obj):
+    """Canonical fallback for direct (non-HTTP) callers passing numpy
+    scalars/arrays in the payload: anything exposing ``tolist`` is
+    serialized by value, everything else is uncacheable."""
+    tolist = getattr(obj, "tolist", None)
+    if callable(tolist):
+        return tolist()
+    raise TypeError(f"uncacheable payload element {type(obj).__name__}")
+
+
+class CacheHit:
+    """One successful lookup: the stored value plus enough context for
+    the caller's response decoration (``stale`` drives the
+    ``cache_stale`` body marker and the ledger outcome)."""
+
+    __slots__ = ("value", "model", "version", "stale", "age_s")
+
+    def __init__(self, value, model, version, stale, age_s):
+        self.value = value
+        self.model = model
+        self.version = version
+        self.stale = stale
+        self.age_s = age_s
+
+
+class _Entry:
+    __slots__ = ("value", "model", "version", "nbytes", "expires_at",
+                 "created_at")
+
+    def __init__(self, value, model, version, nbytes, expires_at,
+                 created_at):
+        self.value = value
+        self.model = model
+        self.version = version
+        self.nbytes = nbytes
+        self.expires_at = expires_at
+        self.created_at = created_at
+
+
+class ResponseCache:
+    """Bounded LRU + TTL exact-match response cache with strict
+    per-tenant isolation.
+
+    Entries are keyed ``(tenant, key)`` in one ordered map — global
+    LRU across tenants (one tenant's burst ages everyone's cold tail,
+    like any shared cache tier) while lookups remain structurally
+    tenant-scoped. Values are opaque to the cache (the server stores
+    response dicts, the router raw backend bytes); ``nbytes`` is the
+    serialized size either way and both ``capacity`` and ``max_bytes``
+    bound the cache."""
+
+    def __init__(self, *, capacity: int = DEFAULT_CAPACITY,
+                 ttl_s: float = DEFAULT_TTL_S,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 metrics: Optional[CacheMetrics] = None,
+                 plane: str = "serving",
+                 clock: Callable[[], float] = time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
+        if max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self.capacity = int(capacity)
+        self.ttl_s = float(ttl_s)
+        self.max_bytes = int(max_bytes)
+        self.plane = plane
+        self._metrics = metrics
+        self._clock = clock
+        self._lock = make_lock("ResponseCache._lock")
+        self._entries: "OrderedDict" = OrderedDict()
+        self._bytes = 0
+        self._stale_ok = False
+        # lifetime counters for describe() — the metrics bundle may be
+        # absent (router tests build bare caches), the debug endpoint
+        # must still answer
+        self._hits = 0
+        self._misses = 0
+        self._stale_serves = 0
+        self._bypasses = 0
+        self._insertions = 0
+        self._evictions = 0
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach_metrics(self, metrics: CacheMetrics) -> None:
+        """Adopt an instrument bundle after construction (the server
+        attaches its registry-backed bundle to a user-supplied
+        instance, mirroring ``ModelRegistry.attach_metrics``)."""
+        self._metrics = metrics
+
+    def set_stale_serve(self, flag: bool) -> None:
+        """Arm/disarm serving expired entries (the ``cache_pressure``
+        brownout rung toggles this)."""
+        self._stale_ok = bool(flag)
+
+    @property
+    def stale_serve(self) -> bool:
+        return self._stale_ok
+
+    @staticmethod
+    def _tenant_key(tenant: Optional[str]) -> str:
+        return tenant if tenant else ""
+
+    # -- data path ------------------------------------------------------------
+
+    def get(self, tenant: Optional[str], key: Optional[str],
+            ) -> Optional[CacheHit]:
+        """Look one key up in ``tenant``'s namespace. Fresh entries hit;
+        expired entries hit as ``stale`` only while stale-serve is
+        armed (brownout), otherwise they evict as ``ttl`` and miss."""
+        if key is None:
+            return None
+        now = self._clock()
+        hit = None
+        outcome = "miss"
+        with self._lock:
+            e = self._entries.get((self._tenant_key(tenant), key))
+            if e is not None:
+                if now < e.expires_at:
+                    self._entries.move_to_end(
+                        (self._tenant_key(tenant), key))
+                    outcome = "hit"
+                    self._hits += 1
+                    hit = CacheHit(e.value, e.model, e.version, False,
+                                   now - e.created_at)
+                elif self._stale_ok:
+                    outcome = "stale"
+                    self._stale_serves += 1
+                    hit = CacheHit(e.value, e.model, e.version, True,
+                                   now - e.created_at)
+                else:
+                    self._drop_locked((self._tenant_key(tenant), key))
+                    self._count_eviction_locked("ttl", 1)
+            if hit is None and outcome == "miss":
+                self._misses += 1
+            self._report_locked()
+        m = self._metrics
+        if m is not None:
+            m.requests_total.inc(plane=self.plane, outcome=outcome)
+        if outcome == "stale":
+            record_event("cache.stale_serve", plane=self.plane,
+                         model=hit.model, age_s=round(hit.age_s, 3))
+        return hit
+
+    def put(self, tenant: Optional[str], key: Optional[str], value, *,
+            model: str, version: str,
+            nbytes: Optional[int] = None) -> bool:
+        """Insert one response. ``nbytes`` defaults to the serialized
+        size (``len`` for bytes, canonical-JSON length for dicts); a
+        value larger than the whole byte bound is refused rather than
+        evicting everything else."""
+        if key is None:
+            return False
+        if nbytes is None:
+            if isinstance(value, (bytes, bytearray)):
+                nbytes = len(value)
+            else:
+                try:
+                    nbytes = len(json.dumps(value, default=_canon_default))
+                except (TypeError, ValueError):
+                    return False
+        if nbytes > self.max_bytes:
+            return False
+        now = self._clock()
+        entry = _Entry(value, model, version, int(nbytes),
+                       now + self.ttl_s, now)
+        evicted = 0
+        with self._lock:
+            full_key = (self._tenant_key(tenant), key)
+            old = self._entries.pop(full_key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[full_key] = entry
+            self._bytes += entry.nbytes
+            self._insertions += 1
+            while (len(self._entries) > self.capacity
+                   or self._bytes > self.max_bytes):
+                self._drop_locked(next(iter(self._entries)))
+                evicted += 1
+            if evicted:
+                self._count_eviction_locked("lru", evicted)
+            self._report_locked()
+        m = self._metrics
+        if m is not None:
+            m.insertions_total.inc(plane=self.plane)
+        return True
+
+    def note_bypass(self) -> None:
+        """Count one client-requested bypass (``X-Cache-Bypass``)."""
+        with self._lock:
+            self._bypasses += 1
+        m = self._metrics
+        if m is not None:
+            m.requests_total.inc(plane=self.plane, outcome="bypass")
+
+    # -- invalidation ---------------------------------------------------------
+
+    def invalidate_model(self, model: str, *,
+                         reason: str = "invalidate") -> int:
+        """Drop every entry for ``model`` across all tenants — the
+        registry's hot-swap/rollback listener. Returns entries
+        dropped. (The epoch in the key already makes them unreachable;
+        this reclaims the memory and keeps the gauges honest.)"""
+        with self._lock:
+            doomed = [k for k, e in self._entries.items()
+                      if e.model == model]
+            for k in doomed:
+                self._drop_locked(k)
+            self._count_eviction_locked("invalidate", len(doomed))
+            self._report_locked()
+        m = self._metrics
+        if m is not None:
+            m.invalidations_total.inc(plane=self.plane, reason=reason)
+        record_event("cache.invalidate", plane=self.plane, model=model,
+                     reason=reason, entries=len(doomed))
+        return len(doomed)
+
+    def purge(self, *, reason: str = "purge") -> int:
+        """Drop everything (fleet rolling deploy, backend readmit)."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            self._count_eviction_locked("purge", n)
+            self._report_locked()
+        m = self._metrics
+        if m is not None:
+            m.invalidations_total.inc(plane=self.plane, reason=reason)
+        record_event("cache.purge", plane=self.plane, reason=reason,
+                     entries=n)
+        return n
+
+    def pressure_evict(self, fraction: float = 0.5) -> int:
+        """Drop the LRU ``fraction`` of entries — the cache's host
+        memory participates in brownout pressure shedding."""
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+        with self._lock:
+            n = int(len(self._entries) * fraction)
+            for _ in range(n):
+                self._drop_locked(next(iter(self._entries)))
+            self._count_eviction_locked("pressure", n)
+            self._report_locked()
+        if n:
+            record_event("cache.pressure", plane=self.plane, evicted=n)
+        return n
+
+    # -- internals (caller holds the lock) ------------------------------------
+
+    def _drop_locked(self, full_key) -> None:
+        e = self._entries.pop(full_key, None)
+        if e is not None:
+            self._bytes -= e.nbytes
+
+    def _count_eviction_locked(self, reason: str, n: int) -> None:
+        if n <= 0:
+            return
+        self._evictions += n
+        m = self._metrics
+        if m is not None:
+            m.evictions_total.inc(n, plane=self.plane, reason=reason)
+
+    def _report_locked(self) -> None:
+        m = self._metrics
+        if m is not None:
+            m.entries.set(len(self._entries), plane=self.plane)
+            m.size_bytes.set(self._bytes, plane=self.plane)
+
+    # -- introspection --------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def describe(self) -> dict:
+        """The ``/debug/cache`` document."""
+        with self._lock:
+            tenants = len({tk for tk, _ in self._entries})
+            return {
+                "plane": self.plane,
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "capacity": self.capacity,
+                "max_bytes": self.max_bytes,
+                "ttl_s": self.ttl_s,
+                "tenants": tenants,
+                "stale_serve": self._stale_ok,
+                "hits": self._hits,
+                "misses": self._misses,
+                "stale_serves": self._stale_serves,
+                "bypasses": self._bypasses,
+                "insertions": self._insertions,
+                "evictions": self._evictions,
+            }
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def resolve_response_cache(arg, *, metrics: Optional[CacheMetrics] = None,
+                           plane: str = "serving",
+                           ) -> Optional[ResponseCache]:
+    """The server's cache-construction policy (mirrors
+    ``warmstart.resolve_warmup_manifest``): ``False`` disables
+    explicitly, an instance passes through (adopting ``metrics`` when
+    it has none), ``True`` builds a default, and ``None`` defers to the
+    ``DL4J_TPU_CACHE`` env knob (sized by ``DL4J_TPU_CACHE_CAPACITY`` /
+    ``DL4J_TPU_CACHE_TTL_S`` / ``DL4J_TPU_CACHE_MAX_BYTES``)."""
+    if arg is False:
+        return None
+    if isinstance(arg, ResponseCache):
+        if arg._metrics is None and metrics is not None:
+            arg.attach_metrics(metrics)
+        return arg
+    if arg is None and not _env_flag(ENV_CACHE):
+        return None
+    if arg is not None and arg is not True:
+        raise TypeError(
+            "cache must be None, a bool, or a ResponseCache, got "
+            f"{type(arg).__name__}")
+    capacity = int(os.environ.get(ENV_CACHE_CAPACITY, DEFAULT_CAPACITY))
+    ttl_s = float(os.environ.get(ENV_CACHE_TTL_S, DEFAULT_TTL_S))
+    max_bytes = int(os.environ.get(ENV_CACHE_MAX_BYTES,
+                                   DEFAULT_MAX_BYTES))
+    return ResponseCache(capacity=capacity, ttl_s=ttl_s,
+                         max_bytes=max_bytes, metrics=metrics,
+                         plane=plane)

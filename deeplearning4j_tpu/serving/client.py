@@ -182,7 +182,8 @@ class ServingClient:
                 deadline_ms: Optional[float] = None,
                 correlation_id: Optional[str] = None,
                 priority: Optional[str] = None,
-                tenant: Optional[str] = None) -> dict:
+                tenant: Optional[str] = None,
+                cache_bypass: bool = False) -> dict:
         """POST a predict; returns the full response dict
         ({"model", "version", "outputs"}). Typed ServingError on failure.
 
@@ -196,7 +197,12 @@ class ServingClient:
         ``X-Correlation-ID``/``X-Span-ID`` headers, so the client span
         recorded here and the server-side request/admission/batch/
         dispatch spans form one tree (``observability/trace.py``).
-        Retries reuse the same ID — one logical request, one trace."""
+        Retries reuse the same ID — one logical request, one trace.
+
+        ``cache_bypass=True`` sends ``X-Cache-Bypass``: every caching
+        tier on the path (router and server response caches) skips
+        both lookup and fill — the request is guaranteed to reach the
+        model."""
         payload = {"inputs": _jsonable(inputs)}
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
@@ -204,6 +210,8 @@ class ServingClient:
         with _trace.span("client.request", trace_id=cid,
                          model=model) as s:
             headers = self._headers(cid, priority, tenant)
+            if cache_bypass:
+                headers["X-Cache-Bypass"] = "1"
             if s is not None:
                 headers["X-Span-ID"] = s.span_id
             return self._request(f"/v1/models/{model}:predict", payload,

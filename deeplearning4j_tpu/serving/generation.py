@@ -88,6 +88,7 @@ from deeplearning4j_tpu.serving.errors import (
     TenantQuotaError,
 )
 from deeplearning4j_tpu.serving.overload import PRIORITIES, BrownoutRung
+from deeplearning4j_tpu.serving.prefixkv import resolve_prefix_store
 from deeplearning4j_tpu.serving.warmup import bucket_sizes
 
 _PRIO_RANK = {p: i for i, p in enumerate(PRIORITIES)}  # critical first
@@ -282,7 +283,7 @@ class GenerationEngine:
                  max_waiting: int = 64, min_kv_bucket: int = 8,
                  min_prompt_bucket: int = 8, idle_wait_s: float = 0.05,
                  temperature: float = 1.0, seed: int = 0,
-                 decode_span_every: int = 8,
+                 decode_span_every: int = 8, prefix_cache=None,
                  metrics=None, clock: Callable[[], float] = time.monotonic):
         cfg = model.config
         self._model = model
@@ -331,6 +332,15 @@ class GenerationEngine:
         self._base_key = jax.random.key(seed)
         self._prefill_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[Tuple[int, int], Any] = {}
+        # Prefix-KV reuse (serving/prefixkv.py): after a normal prefill
+        # the slot's KV columns for the longest bucket-aligned prefix
+        # are published as a shared immutable slab; a later request
+        # with the same prefix grafts it (one compiled scatter per
+        # prompt bucket, warmed in warm()) and feeds only its suffix
+        # through the already-warmed single-row decode programs. None
+        # defers to DL4J_TPU_PREFIX_CACHE; default OFF.
+        self.prefix_cache = resolve_prefix_store(prefix_cache, model=name)
+        self._graft_fns: Dict[int, Any] = {}
         self.warmed = False
         self.compiles_total = 0
         self.compiles_after_warm = 0
@@ -456,6 +466,33 @@ class GenerationEngine:
 
         return jax.jit(run, donate_argnums=self._donate())
 
+    def _build_graft(self, P: int):
+        # scatter a shared prefix slab (per-layer (heads, P, head_dim)
+        # host arrays) into one slot's first P KV columns — the whole
+        # prefill replaced by one copy when the prefix is cached
+        nl = self._model.config.num_layers
+
+        def run(kslabs, vslabs, pks, pvs, slot):
+            ks, vs = [], []
+            for i in range(nl):
+                ks.append(jax.lax.dynamic_update_slice(
+                    kslabs[i], pks[i][None].astype(kslabs[i].dtype),
+                    (slot, 0, 0, 0)))
+                vs.append(jax.lax.dynamic_update_slice(
+                    vslabs[i], pvs[i][None].astype(vslabs[i].dtype),
+                    (slot, 0, 0, 0)))
+            return tuple(ks), tuple(vs)
+
+        donate = () if jax.default_backend() == "cpu" else (0, 1)
+        return jax.jit(run, donate_argnums=donate)
+
+    def _get_graft_fn(self, P: int):
+        fn = self._graft_fns.get(P)
+        if fn is None:
+            fn = self._graft_fns[P] = self._build_graft(P)
+            self._note_compile("graft", str(P))
+        return fn
+
     def _note_compile(self, kind: str, key: str):
         self.compiles_total += 1
         if self.warmed:
@@ -573,6 +610,25 @@ class GenerationEngine:
             stats["decode"][f"{b}x{kv}"] = round(
                 time.monotonic() - t0, 4)
             note(f"{b}x{kv}", stats["decode"][f"{b}x{kv}"])
+        if self.prefix_cache is not None:
+            # the graft scatter is a compiled program per prompt bucket:
+            # warm them all, or the first prefix hit after readiness is
+            # a recompile-after-warmup
+            stats["graft"] = {}
+            hd = self._kslabs[0].shape[-1]
+            heads = self._kslabs[0].shape[1]
+            dtype = self._kslabs[0].dtype
+            for p in prompt_buckets:
+                t0 = time.monotonic()
+                gfn = self._get_graft_fn(p)
+                zero = tuple(np.zeros((heads, p, hd), dtype)
+                             for _ in self._kslabs)
+                ks, vs = gfn(self._kslabs, self._vslabs, zero, zero,
+                             np.int32(self._scratch))
+                self._kslabs, self._vslabs = ks, vs
+                jax.block_until_ready(self._kslabs[0])
+                stats["graft"][str(p)] = round(time.monotonic() - t0, 4)
+                note(f"graft:{p}", stats["graft"][str(p)])
         self.warmed = True
         record_event("generation.warmup", model=self.name,
                      programs=self.compiles_total,
@@ -912,6 +968,19 @@ class GenerationEngine:
 
     def _prefill(self, req: GenerationStream):
         t0v = req.prompt_len
+        pc = self.prefix_cache
+        if pc is not None:
+            entry = pc.acquire(self.version, req.prompt,
+                               self.prompt_buckets)
+            if entry is not None:
+                try:
+                    self._prefill_from_prefix(req, entry)
+                finally:
+                    pc.release(entry)
+                return
+            led = _reqlog.get_request_ledger()
+            if led is not None:
+                led.annotate(req.cid, cache="miss")
         p = _bucket(self.prompt_buckets, t0v)
         self._note_traffic("prefill", p)
         fn = self._get_prefill_fn(p)
@@ -926,6 +995,8 @@ class GenerationEngine:
         self._kslabs, self._vslabs = ks, vs
         tok = int(np.asarray(tok))
         tp1 = _trace.now()
+        if pc is not None:
+            self._publish_prefix(req, t0v)
         with self._cv:
             # same cancel-race guard as the decode path: a client that
             # disconnected while the prefill ran gets no phantom TTFT
@@ -961,6 +1032,105 @@ class GenerationEngine:
                      priority=req.priority, correlation_id=req.cid)
         req._push_token(tok)
         self._maybe_finish(req, tok)
+
+    def _prefill_from_prefix(self, req: GenerationStream, entry):
+        """Prefix-hit prefill: graft the shared slab into the slot's
+        first P KV columns, then force-feed the suffix tokens through
+        the warmed single-row decode programs — each feed of
+        ``prompt[j]`` at position ``j`` writes KV column ``j`` exactly
+        as prefill would (the written column depends only on the input
+        token and position); the last feed's sample IS the first
+        generated token. Prefill FLOPs scale with the suffix, not the
+        prompt."""
+        t0v = req.prompt_len
+        P = entry.length
+        tp0 = _trace.now()
+        gfn = self._get_graft_fn(P)
+        pks = tuple(k for k, _ in entry.kvs)
+        pvs = tuple(v for _, v in entry.kvs)
+        ks, vs = gfn(self._kslabs, self._vslabs, pks, pvs,
+                     np.int32(req.slot))
+        self._kslabs, self._vslabs = ks, vs
+        b = _bucket(self.slot_buckets, 1)
+        tok = None
+        for j in range(P, t0v):
+            kv = _bucket(self.kv_buckets, min(j + 1, self.max_len))
+            self._note_traffic("decode", b, kv)
+            fn = self._get_decode_fn(b, kv)
+            self._rng_step += 1
+            slot_idx = np.full(b, self._scratch, np.int32)
+            slot_idx[0] = req.slot
+            ids = np.zeros(b, np.int32)
+            ids[0] = req.prompt[j]
+            pos = np.zeros(b, np.int32)
+            pos[0] = j
+            temps = np.zeros(b, np.float32)
+            temps[0] = req.temperature
+            ks, vs, toks = fn(self._params, self._kslabs, self._vslabs,
+                              self._base_key, np.int32(self._rng_step),
+                              slot_idx, ids, pos, temps)
+            self._kslabs, self._vslabs = ks, vs
+            tok = toks
+        tok = int(np.asarray(tok)[0])
+        tp1 = _trace.now()
+        with self._cv:
+            if req.state != _ACTIVE:
+                return
+            req.pos = t0v
+            req.last_tok = tok
+            req.generated = 1
+            req.t_first = self._clock()
+            req.prefill_s = round(tp1 - tp0, 6)
+        ttft = req.t_first - req.t_submit
+        m = self._metrics
+        if m is not None:
+            m.generation_ttft.observe(ttft, model=self.name,
+                                      exemplar_trace_id=req.cid)
+            m.generation_tokens_total.inc(model=self.name)
+        led = _reqlog.get_request_ledger()
+        if led is not None:
+            led.annotate(req.cid, cache="prefix_hit", prefix_len=P)
+        if req.traced:
+            _trace.record_span(
+                "generation.prefill", trace_id=req.cid,
+                parent_id=req.root_span, start=tp0, end=tp1,
+                slot=req.slot, prompt_len=t0v, cache="prefix_hit",
+                prefix_len=P)
+            if led is not None:
+                led.annotate(req.cid, slot=req.slot,
+                             queue_wait_s=round(max(0.0, ttft
+                                                    - (tp1 - tp0)), 6),
+                             ttft_s=round(ttft, 6),
+                             prefill_s=req.prefill_s)
+        record_event("generation.join", model=self.name, req=req.id,
+                     slot=req.slot, step=self.steps, prompt_len=t0v,
+                     prefix_len=P, priority=req.priority,
+                     correlation_id=req.cid)
+        req._push_token(tok)
+        self._maybe_finish(req, tok)
+
+    def _publish_prefix(self, req: GenerationStream, t0v: int):
+        """After a normal prefill: snapshot the slot's KV columns for
+        the longest bucket-aligned prefix and publish them as a shared
+        slab (host copies — immutable by construction, the slot row
+        keeps decoding over its own copy)."""
+        pc = self.prefix_cache
+        # strictly shorter than the prompt: acquire() needs at least one
+        # suffix token to feed, so a slab of the full prompt length
+        # could only ever serve LONGER prompts — the shorter bucket
+        # serves identical repeats too
+        cands = [p for p in self.prompt_buckets
+                 if p < t0v and p >= pc.min_tokens]
+        if not cands:
+            return
+        P = max(cands)
+        prefix = np.asarray(req.prompt[:P], dtype=np.int64)
+        if pc.has(self.version, prefix):
+            return
+        kvs = [(np.asarray(self._kslabs[i][req.slot, :, :P, :]),
+                np.asarray(self._vslabs[i][req.slot, :, :P, :]))
+               for i in range(len(self._kslabs))]
+        pc.insert(self.version, prefix, kvs)
 
     def _decode_once(self):
         with self._cv:
@@ -1168,6 +1338,9 @@ class GenerationEngine:
                 "compiled_programs": self.compiles_total,
                 "compiles_after_warm": self.compiles_after_warm,
                 "stream_ewma_s": self._stream_ewma_s,
+                "prefix_cache": (self.prefix_cache.describe()
+                                 if self.prefix_cache is not None
+                                 else None),
             }
 
 

@@ -5,6 +5,11 @@ The Counter/Gauge/Histogram implementation was promoted to
 existing ``serving.metrics`` import keeps working, and keeps the
 serving-specific :class:`ServingMetrics` instrument bundle.
 
+The request & prefix caching tier's instrument bundle lives in
+``serving/cache.py`` (:class:`~deeplearning4j_tpu.serving.cache.
+CacheMetrics`, re-exported here) and registers on the same registry as
+this bundle when the server enables a cache.
+
 ``ServingMetrics`` still defaults to its OWN registry — a process can
 run several ``ModelServer``s (tests do) and each must count its own
 traffic — but the server's ``/metrics`` endpoint renders this bundle
@@ -15,6 +20,7 @@ runtime collectors registered globally.
 
 from __future__ import annotations
 
+from deeplearning4j_tpu.serving.cache import CacheMetrics  # noqa: F401
 from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS,
     OCCUPANCY_BUCKETS,
@@ -30,6 +36,7 @@ from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
     "OCCUPANCY_BUCKETS",
+    "CacheMetrics",
     "Counter",
     "Gauge",
     "Histogram",

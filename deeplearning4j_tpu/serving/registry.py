@@ -94,6 +94,12 @@ class ModelEntry:
         self._deploy_lock = make_lock("ModelEntry._deploy_lock")
         self._active: Optional[_Active] = None
         self.history: List[Tuple[str, Any]] = []  # (version, variables)
+        # monotone swap counter: bumps on every activation (deploy,
+        # rollback, fallback engage/disengage). Response-cache keys
+        # include it, so entries cached against a superseded set of
+        # weights can never be served — even if the version string is
+        # reused by a later deploy.
+        self.epoch = 0
         self.warmed = False
         # the buckets the last warm() actually compiled: traffic landing
         # outside this set after warm is a recompile-after-warmup — the
@@ -422,6 +428,10 @@ class ModelRegistry:
         self._metrics = metrics
         self._admission = None
         self._warm_manifest = None
+        # called as fn(name, version, epoch, reason) after every swap —
+        # the response-cache tier subscribes to drop entries for weights
+        # that just stopped serving
+        self._invalidation_listeners: List[Callable[..., None]] = []
 
     def attach_metrics(self, metrics):
         """Wire a ServingMetrics bundle (occupancy/device-latency hooks
@@ -438,6 +448,22 @@ class ModelRegistry:
         WarmupManifest`: every dispatched batch's bucket feeds the live
         traffic mix the next restart warms against."""
         self._warm_manifest = manifest
+
+    def add_invalidation_listener(self, fn: Callable[..., None]):
+        """Subscribe ``fn(name, version, epoch, reason)`` to activation
+        swaps (deploy / rollback / fallback engage). Listeners fire
+        AFTER the new replica set is live, outside entry locks; a
+        raising listener is swallowed — cache invalidation must never
+        fail a deploy."""
+        self._invalidation_listeners.append(fn)
+
+    def _notify_invalidation(self, name: str, version: str, epoch: int,
+                             reason: str):
+        for fn in list(self._invalidation_listeners):
+            try:
+                fn(name, version, epoch, reason)
+            except Exception:  # noqa: BLE001 — see add_invalidation_listener
+                pass
 
     # -- metrics hooks (called from ParallelInference workers) -------------
 
@@ -673,7 +699,11 @@ class ModelRegistry:
             old, entry._active = entry._active, _Active(pi, version)
             entry.warmed = True
             entry.warmed_buckets = set(warmed_sizes)
+            entry.epoch += 1
+            epoch = entry.epoch
         self._record_ready(entry.name, True)
+        self._notify_invalidation(entry.name, version, epoch,
+                                  "hot_swap")
         if old is not None:
             old.pi.shutdown()
 
@@ -709,7 +739,11 @@ class ModelRegistry:
             old, entry._active = entry._active, _Active(new_pi, version)
             entry.warmed = warm
             entry.warmed_buckets = set(sizes) if warm else set()
+            entry.epoch += 1
+            epoch = entry.epoch
         self._record_ready(entry.name, warm)
+        self._notify_invalidation(entry.name, version, epoch,
+                                  "hot_swap")
         if old is not None:
             old.pi.shutdown()  # FIFO drain: queued requests still served
 

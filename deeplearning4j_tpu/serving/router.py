@@ -112,6 +112,11 @@ from deeplearning4j_tpu.resilience.faults import (
     POINT_ROUTER_BACKEND_LATENCY,
     get_fault_injector as _fault_injector,
 )
+from deeplearning4j_tpu.serving.cache import (
+    CacheMetrics,
+    ResponseCache,
+    response_cache_key,
+)
 from deeplearning4j_tpu.serving.circuit import (
     STATE_CLOSED,
     STATE_NUM,
@@ -133,6 +138,7 @@ from deeplearning4j_tpu.serving.overload import (
 )
 
 _MODEL_ROUTE_RE = re.compile(r"^/v1/models/[\w.\-]+:(predict|generate)$")
+_PREDICT_PATH_RE = re.compile(r"^/v1/models/([\w.\-]+):predict$")
 
 # admin states (the drain plane; health is the circuit's)
 ADMIN_ACTIVE = "active"
@@ -188,6 +194,15 @@ class RouterPolicy:
     class_fractions: Dict[str, float] = dataclasses.field(
         default_factory=lambda: dict(DEFAULT_CLASS_FRACTIONS))
     drain_timeout_s: float = 30.0
+    # fleet-level exact-match response cache (serving/cache.py): a hit
+    # is answered at the router without touching any backend. 0
+    # disables (the default — the router must not lie about the model
+    # path unless the operator opts in). Entries are tenant-scoped and
+    # purged on rolling_deploy/readmit, since the router cannot see
+    # backend registry epochs.
+    cache_capacity: int = 0
+    cache_ttl_s: float = 30.0
+    cache_max_bytes: int = 32 << 20
 
     def validate(self) -> "RouterPolicy":
         for name in ("probe_interval_s", "probe_timeout_s",
@@ -231,6 +246,16 @@ class RouterPolicy:
             if not 0.0 < frac <= 1.0:
                 raise ValueError(f"class_fractions[{cls!r}] must be in "
                                  f"(0, 1], got {frac}")
+        if self.cache_capacity < 0:
+            raise ValueError(
+                f"cache_capacity must be >= 0, got {self.cache_capacity}")
+        if self.cache_capacity > 0:
+            if self.cache_ttl_s <= 0:
+                raise ValueError(
+                    f"cache_ttl_s must be > 0, got {self.cache_ttl_s}")
+            if self.cache_max_bytes < 1:
+                raise ValueError("cache_max_bytes must be >= 1, got "
+                                 f"{self.cache_max_bytes}")
         return self
 
     def circuit_policy(self) -> CircuitPolicy:
@@ -256,8 +281,9 @@ class RouterMetrics:
             "router_requests_total",
             "Requests routed, by the last backend ATTEMPTED and final "
             "HTTP status code (backend=\"\" only when the router "
-            "refused without attempting one: router sheds, bad "
-            "priority, no routable backend).", ("backend", "code"))
+            "answered without attempting one: router sheds, bad "
+            "priority, no routable backend, or a router-cache hit).",
+            ("backend", "code"))
         self.request_latency = r.histogram(
             "router_request_latency_seconds",
             "End-to-end router latency (request parse to final "
@@ -638,6 +664,17 @@ class FleetRouter:
                                   self.policy.retry_budget_cap)
         self.metrics.retry_budget_balance.set(self.budget.balance)
         self.metrics.backends.set(len(self._backends))
+        # fleet-level response cache (policy.cache_capacity > 0 arms
+        # it): hits answered here never reach a backend — federated
+        # cache_* series ride this router's registry
+        self.cache: Optional[ResponseCache] = None
+        if self.policy.cache_capacity > 0:
+            self.cache = ResponseCache(
+                capacity=self.policy.cache_capacity,
+                ttl_s=self.policy.cache_ttl_s,
+                max_bytes=self.policy.cache_max_bytes,
+                metrics=CacheMetrics(self.metrics.registry),
+                plane="router", clock=clock)
         # fleet priority-shed state (None fleet_max_in_flight disables)
         self._fleet_lock = make_lock("FleetRouter._fleet_lock")
         self._class_in_flight = {p: 0 for p in PRIORITIES}
@@ -863,6 +900,8 @@ class FleetRouter:
                 "readmit_probes": self.policy.readmit_probes,
                 "retry_budget_ratio": self.policy.retry_budget_ratio,
             },
+            "cache": (self.cache.describe()
+                      if self.cache is not None else None),
         }
 
     # -- selection ------------------------------------------------------------
@@ -947,7 +986,8 @@ class FleetRouter:
     def _forward_headers(headers, cid: str) -> dict:
         out = {"Content-Type": "application/json",
                "X-Correlation-ID": cid}
-        for name in ("X-Priority", "X-Tenant", "X-Span-ID"):
+        for name in ("X-Priority", "X-Tenant", "X-Span-ID",
+                     "X-Cache-Bypass"):
             v = headers.get(name)
             if v:
                 out[name] = v
@@ -1096,6 +1136,39 @@ class FleetRouter:
                                             code=str(e.http_status))
             return (e.http_status, json.dumps(e.to_json()).encode(),
                     e.retry_after_ms)
+        # Fleet cache consult — BEFORE the fleet admission gate: a hit
+        # is answered here without a backend round-trip OR a fleet
+        # in-flight slot. Keys are tenant-scoped (X-Tenant) over the
+        # canonical payload; the router can't see backend registry
+        # epochs, so rolling_deploy/readmit purge instead.
+        ckey = cache_tenant = cache_model = None
+        cache = self.cache
+        if cache is not None:
+            pm = _PREDICT_PATH_RE.match(path)
+            if pm is not None:
+                if headers.get("X-Cache-Bypass"):
+                    cache.note_bypass()
+                else:
+                    try:
+                        payload = json.loads(body) if body else {}
+                    except ValueError:
+                        payload = None
+                    if isinstance(payload, dict):
+                        cache_model = pm.group(1)
+                        cache_tenant = headers.get("X-Tenant")
+                        ckey = response_cache_key(cache_model, "", 0,
+                                                  payload)
+                if ckey is not None:
+                    hit = cache.get(cache_tenant, ckey)
+                    if hit is not None:
+                        record_event("cache.hit", plane="router",
+                                     model=cache_model,
+                                     stale=hit.stale)
+                        self.metrics.requests_total.inc(backend="",
+                                                        code="200")
+                        self.metrics.request_latency.observe(
+                            self._clock() - t0, backend="")
+                        return 200, hit.value, None
         admitted, retry_after_ms = self._fleet_admit(prio)
         if not admitted:
             self.metrics.shed_total.inc(priority=prio,
@@ -1107,10 +1180,14 @@ class FleetRouter:
                                  retry_after_ms=retry_after_ms)
             return 429, json.dumps(err.to_json()).encode(), retry_after_ms
         try:
-            return self._route_admitted(path, body, headers, prio,
-                                        affinity, timeout, t0)
+            result = self._route_admitted(path, body, headers, prio,
+                                          affinity, timeout, t0)
         finally:
             self._fleet_release(prio)
+        if ckey is not None and result[0] == 200:
+            cache.put(cache_tenant, ckey, result[1],
+                      model=cache_model, version="")
+        return result
 
     def _route_admitted(self, path, body, headers, prio, affinity,
                         timeout, t0):
@@ -1443,6 +1520,11 @@ class FleetRouter:
         b.close_pool()  # the old process's sockets are dead weight
         self.metrics.backend_draining.set(0, backend=name)
         self._update_routable_gauge()
+        if self.cache is not None:
+            # the backend may come back serving different weights —
+            # the router can't see its registry epoch, so the whole
+            # fleet cache drops (a deploy is rare; refill is cheap)
+            self.cache.purge(reason="readmit")
         record_event("router.readmit", backend=name)
 
     def wait_routable(self, name: str, timeout_s: float = 10.0) -> bool:
@@ -1464,6 +1546,10 @@ class FleetRouter:
         beats finishing the roll), when a deploy step raises, or when
         a backend never comes back — one bad step must not drain the
         rest of the fleet. Returns the per-backend report."""
+        if self.cache is not None:
+            # every cached answer predates the new version: drop them
+            # all up front rather than serving v_old bodies mid-roll
+            self.cache.purge(reason="deploy")
         report = []
         for b in list(self._backends):
             step = {"backend": b.name}

@@ -152,6 +152,13 @@ from deeplearning4j_tpu.resilience.faults import get_fault_injector as _fault_in
 from deeplearning4j_tpu.runtime import compilecache as _compilecache
 from deeplearning4j_tpu.serving import warmstart as _warmstart
 from deeplearning4j_tpu.serving.admission import AdmissionController
+from deeplearning4j_tpu.serving.cache import (
+    ENV_CACHE,
+    CacheMetrics,
+    _env_flag,
+    resolve_response_cache,
+    response_cache_key,
+)
 from deeplearning4j_tpu.serving.circuit import (
     STATE_NUM,
     CircuitBreaker,
@@ -199,6 +206,18 @@ _SHED_REASONS = {
 _MAX_TENANT_LEN = 128
 
 
+class _CachedResponse(Exception):
+    """Internal short-circuit: raised inside handle_predict's try block
+    when the response cache answers, caught before the ServingError
+    clause so the cached body rides the normal metrics/ledger tail
+    without touching admission, the breaker, or a batch slot."""
+
+    def __init__(self, body: dict, stale: bool):
+        super().__init__("cached")
+        self.body = body
+        self.stale = stale
+
+
 class ModelServer:
     def __init__(
         self,
@@ -224,6 +243,7 @@ class ModelServer:
         incident_profile_ms: float = 250.0,
         warmup_manifest=None,
         compile_cache=None,
+        cache=None,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
         # Cold-start robustness (serving/warmstart.py + runtime/
@@ -270,6 +290,25 @@ class ModelServer:
         # overshoot EWMA (satellite of the overload work: the shed hint
         # scales with how buried the server actually is)
         self.registry.attach_admission(self.admission)
+        # Exact-match response cache (serving/cache.py): consulted in
+        # handle_predict BEFORE admission, so a hit never takes a batch
+        # slot. Tenant-scoped (X-Tenant), keyed on (model, version,
+        # registry epoch, canonical payload); the registry invalidation
+        # listener drops a model's entries the moment a hot-swap /
+        # rollback activates different weights. None defers to the
+        # DL4J_TPU_CACHE env knob; default OFF — identical-payload
+        # traffic is the common case in tests and benches, and serving
+        # it from memory there would be lying about the model path.
+        self.cache_metrics: Optional[CacheMetrics] = None
+        if (cache is not None and cache is not False) \
+                or (cache is None and _env_flag(ENV_CACHE)):
+            self.cache_metrics = CacheMetrics(self.metrics.registry)
+        self.response_cache = resolve_response_cache(
+            cache, metrics=self.cache_metrics, plane="serving")
+        if self.response_cache is not None:
+            self.registry.add_invalidation_listener(
+                lambda name, version, epoch, reason:
+                self.response_cache.invalidate_model(name, reason=reason))
         # Overload management (overload.py): priority-class admission +
         # tenant quotas are enforced inside the AdmissionController once
         # the manager attaches; the manager's tick adapts the in-flight
@@ -474,6 +513,19 @@ class ModelServer:
                             "tracer ring").to_json())
                     else:
                         self._send(200, body)
+                elif path == "/debug/cache":
+                    if server.response_cache is None \
+                            and not any(
+                                getattr(e, "prefix_cache", None) is not None
+                                for e in server.generators.values()):
+                        self._send(404, ServingError(
+                            "caching is disabled (pass cache=True / a "
+                            "ResponseCache, or set DL4J_TPU_CACHE=1; "
+                            "prefix reuse via prefix_cache= on the "
+                            "generation engine or DL4J_TPU_PREFIX_CACHE=1"
+                            ").").to_json())
+                    else:
+                        self._send(200, server.render_cache())
                 elif path == "/debug/incidents":
                     self._send(200, server.render_incidents())
                 elif path.startswith("/debug/incidents/"):
@@ -533,7 +585,9 @@ class ModelServer:
                     m.group(1), payload, correlation_id=cid,
                     parent_span_id=self.headers.get("X-Span-ID"),
                     priority=self.headers.get("X-Priority"),
-                    tenant=self.headers.get("X-Tenant"))
+                    tenant=self.headers.get("X-Tenant"),
+                    cache_bypass=bool(
+                        self.headers.get("X-Cache-Bypass")))
                 self._send(status, body, correlation_id=cid)
 
             def _do_generate(self, name: str, payload, cid: str):
@@ -670,7 +724,8 @@ class ModelServer:
     def handle_predict(self, name: str, payload, *,
                        correlation_id: Optional[str] = None,
                        parent_span_id: Optional[str] = None,
-                       priority=None, tenant=None) -> Tuple[int, dict]:
+                       priority=None, tenant=None,
+                       cache_bypass: bool = False) -> Tuple[int, dict]:
         t0 = time.monotonic()
         # Unknown model names are client-controlled: labeling metrics with
         # them would grow a permanent label set per scanned/typo'd URL.
@@ -730,6 +785,40 @@ class ModelServer:
                         retry_after_ms=snap["retry_after_ms"])
                 if not isinstance(payload, dict) or "inputs" not in payload:
                     raise BadRequestError('body must be {"inputs": ...}')
+                # Response-cache consult — BEFORE the breaker and BEFORE
+                # admission: a hit must not consume a batch slot, count
+                # against the AIMD in-flight limit, or burn a breaker
+                # probe. Key includes the entry's swap epoch, so entries
+                # minted against superseded weights miss structurally
+                # even before the invalidation listener prunes them.
+                ckey = None
+                rc = self.response_cache
+                if rc is not None:
+                    if cache_bypass:
+                        rc.note_bypass()
+                        if led is not None:
+                            led.annotate(cid, cache="bypass")
+                        if req_span is not None:
+                            req_span.attrs["cache"] = "bypass"
+                    else:
+                        ckey = response_cache_key(
+                            name, entry.version, entry.epoch, payload)
+                        if ckey is None:
+                            # unserializable payload: uncacheable, and
+                            # counted as such rather than a fake miss
+                            rc.note_bypass()
+                            if led is not None:
+                                led.annotate(cid, cache="bypass")
+                            if req_span is not None:
+                                req_span.attrs["cache"] = "bypass"
+                        else:
+                            hit = rc.get(tenant, ckey)
+                            if hit is not None:
+                                raise _CachedResponse(hit.value, hit.stale)
+                            if led is not None:
+                                led.annotate(cid, cache="miss")
+                            if req_span is not None:
+                                req_span.attrs["cache"] = "miss"
                 # circuit breaker: a version failing at/above the policy
                 # rate sheds instantly with 503 + Retry-After instead of
                 # paying the failure path per request
@@ -799,6 +888,21 @@ class ModelServer:
                     lambda a: np.asarray(a).tolist(), out)
                 status, body = 200, {"model": name, "version": version,
                                      "outputs": outputs}
+                if rc is not None and ckey is not None:
+                    rc.put(tenant, ckey, body, model=name, version=version)
+            except _CachedResponse as e:
+                status = 200
+                body = dict(e.body)
+                body["cached"] = True
+                if e.stale:
+                    # brownout stale-serve: past-TTL entry returned
+                    # while the ladder's cache_pressure rung is engaged
+                    body["cache_stale"] = True
+                outcome = "stale" if e.stale else "hit"
+                if led is not None:
+                    led.annotate(cid, cache=outcome)
+                if req_span is not None:
+                    req_span.attrs["cache"] = outcome
             except ServingError as e:
                 status, body = e.http_status, e.to_json()
                 if isinstance(e, ModelNotFoundError):
@@ -897,6 +1001,14 @@ class ModelServer:
         engine.attach_metrics(self.metrics)
         if self.warm_manifest is not None:
             engine.attach_manifest(self.warm_manifest)
+        pstore = getattr(engine, "prefix_cache", None)
+        if pstore is not None:
+            # prefix-store hit/byte series join this server's scrape
+            if self.cache_metrics is None:
+                self.cache_metrics = CacheMetrics(self.metrics.registry)
+            if pstore._metrics is None:
+                pstore.attach_metrics(self.cache_metrics)
+            pstore.model = name
         self.generators[name] = engine
         if self.overload is not None:
             engine.attach_overload(self.overload)
@@ -1091,6 +1203,11 @@ class ModelServer:
     def _default_brownout_rungs(self):
         """The default degradation ladder, shallowest first:
 
+        0. ``cache_pressure`` (only when the response cache is on) —
+           allow expired entries to be served stale and shed half the
+           cache's memory footprint: under overload a slightly-stale
+           answer that skips a batch slot beats a shed, and the cache
+           is the cheapest RAM to give back.
         1. ``shrink_batch_wait`` — zero every entry's batch coalesce
            wait: latency headroom beats occupancy once overloaded.
         2. ``shed_batch_class`` — reject all ``batch``-priority
@@ -1107,7 +1224,21 @@ class ModelServer:
         def shed_off():
             self.overload.shed_batch = False
 
-        return [
+        rungs = []
+        if self.response_cache is not None:
+            rc = self.response_cache
+
+            def cache_pressure_on():
+                rc.set_stale_serve(True)
+                rc.pressure_evict()
+
+            def cache_pressure_off():
+                rc.set_stale_serve(False)
+
+            rungs.append(BrownoutRung("cache_pressure",
+                                      cache_pressure_on,
+                                      cache_pressure_off))
+        rungs += [
             BrownoutRung("shrink_batch_wait",
                          self._brownout_shrink_batch_wait,
                          self._brownout_restore_batch_wait),
@@ -1116,6 +1247,7 @@ class ModelServer:
                          self._brownout_engage_fallbacks,
                          self._brownout_disengage_fallbacks),
         ]
+        return rungs
 
     def _brownout_shrink_batch_wait(self):
         for e in self.registry.entries():
@@ -1186,6 +1318,18 @@ class ModelServer:
                 out.append({"model": e.name, "available": False,
                             "reason": str(exc)[:200]})
         return {"models": out}
+
+    def render_cache(self) -> dict:
+        """GET /debug/cache: response-cache occupancy/hit counters plus
+        every generation engine's prefix-store view."""
+        rc = self.response_cache
+        prefixes = {}
+        for gname, eng in self.generators.items():
+            ps = getattr(eng, "prefix_cache", None)
+            if ps is not None:
+                prefixes[gname] = ps.describe()
+        return {"response_cache": rc.describe() if rc is not None else None,
+                "prefix_stores": prefixes}
 
     def render_requests(self, *, outcome=None, tenant=None, model=None,
                         plane=None, min_latency_ms=None,

@@ -79,7 +79,10 @@ def build_server(port: int = 0, quick: bool = False):
                     "mask": spec((MAX_LEN,), np.float32)},
         version="v1", mode="batched", max_batch_size=4)
 
-    server = ModelServer(registry, port=port)
+    # cache=True arms the exact-match response cache: identical
+    # repeats are answered before a batch slot is taken, invalidated
+    # automatically on hot-swap/rollback
+    server = ModelServer(registry, port=port, cache=True)
     return server, registry, tok, lenet_model
 
 
@@ -147,6 +150,15 @@ def main(quick: bool = False):
     assert registry.rollback("lenet") == "v1"
     assert client.predict("lenet", x1)["version"] == "v1"
     print("hot-swap v1 -> v2 -> rollback v1: versions observed correctly")
+
+    # -- exact-match response cache: a repeat costs no batch slot ----------
+    xc = rng.normal(size=(1, 784)).astype(np.float32)
+    first = client.predict("lenet", xc)
+    again = client.predict("lenet", xc)
+    assert again.get("cached") is True
+    assert again["outputs"] == first["outputs"]
+    print("repeat request served from the response cache "
+          f"(hits={server.response_cache.describe()['hits']})")
 
     metrics = client.metrics_text()
     for series in ("serving_requests_total", "serving_request_latency_seconds",

@@ -1,0 +1,236 @@
+"""Response-cache unit tests (serving/cache.py): content-hash keys,
+LRU/TTL/byte-bound eviction, stale-serve (the brownout interaction),
+pressure eviction, model invalidation — and THE tenant-isolation
+negatives: a cross-tenant lookup can never hit, structurally (the
+tenant is part of the cache key), proven under concurrent eviction
+churn with the lockorder sanitizer armed.
+
+Budget discipline: pure logic with injected clocks — no jax, no HTTP,
+no sleeps; the concurrency test is a short bounded churn.
+"""
+
+import threading
+
+import pytest
+
+from deeplearning4j_tpu.analysis import lockcheck
+from deeplearning4j_tpu.serving.cache import (
+    CacheMetrics,
+    ResponseCache,
+    resolve_response_cache,
+    response_cache_key,
+)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _cache(**kw):
+    clock = _Clock()
+    kw.setdefault("capacity", 8)
+    kw.setdefault("ttl_s", 60.0)
+    kw.setdefault("max_bytes", 1 << 20)
+    return ResponseCache(clock=clock, **kw), clock
+
+
+# ---------------------------------------------------------------------------
+# key construction
+
+
+class TestResponseCacheKey:
+    def test_deterministic_and_content_sensitive(self):
+        p = {"inputs": [[1.0, 2.0]], "b": 1}
+        assert (response_cache_key("m", "v1", 0, p)
+                == response_cache_key("m", "v1", 0,
+                                      {"b": 1, "inputs": [[1.0, 2.0]]}))
+        base = response_cache_key("m", "v1", 0, p)
+        assert response_cache_key("m2", "v1", 0, p) != base
+        assert response_cache_key("m", "v2", 0, p) != base
+        assert response_cache_key("m", "v1", 1, p) != base
+        assert response_cache_key("m", "v1", 0, {"inputs": [[1.0]]}) != base
+
+    def test_deadline_excluded_from_key(self):
+        # the SAME question asked with a different per-request deadline
+        # is still the same question
+        a = response_cache_key("m", "v1", 0, {"inputs": [1], "deadline_ms": 5})
+        b = response_cache_key("m", "v1", 0, {"inputs": [1],
+                                              "deadline_ms": 900})
+        c = response_cache_key("m", "v1", 0, {"inputs": [1]})
+        assert a == b == c
+
+    def test_unserializable_payload_returns_none(self):
+        assert response_cache_key("m", "v1", 0, {"x": object()}) is None
+
+
+# ---------------------------------------------------------------------------
+# hit / miss / eviction mechanics
+
+
+class TestResponseCache:
+    def test_miss_then_hit_roundtrip(self):
+        c, _ = _cache()
+        assert c.get("t", "k1") is None
+        assert c.put("t", "k1", {"outputs": [1]}, model="m", version="v1")
+        hit = c.get("t", "k1")
+        assert hit is not None and hit.value == {"outputs": [1]}
+        assert not hit.stale and hit.model == "m" and hit.version == "v1"
+        d = c.describe()
+        assert d["hits"] == 1 and d["misses"] == 1 and d["entries"] == 1
+
+    def test_none_key_never_stores_or_hits(self):
+        c, _ = _cache()
+        assert not c.put("t", None, {"x": 1}, model="m", version="v")
+        assert c.get("t", None) is None
+        assert c.describe()["entries"] == 0
+
+    def test_lru_eviction_at_capacity(self):
+        c, _ = _cache(capacity=3)
+        for i in range(3):
+            c.put("t", f"k{i}", {"i": i}, model="m", version="v")
+        c.get("t", "k0")  # refresh k0: k1 becomes the LRU victim
+        c.put("t", "k3", {"i": 3}, model="m", version="v")
+        assert c.get("t", "k0") is not None
+        assert c.get("t", "k1") is None
+        assert c.describe()["evictions"] == 1
+
+    def test_ttl_expiry_is_a_strict_miss(self):
+        c, clock = _cache(ttl_s=10.0)
+        c.put("t", "k", {"x": 1}, model="m", version="v")
+        clock.t += 11.0
+        assert c.get("t", "k") is None
+        # the expired entry was dropped, not left behind
+        assert c.describe()["entries"] == 0
+
+    def test_stale_serve_only_while_armed(self):
+        c, clock = _cache(ttl_s=10.0)
+        c.put("t", "k", {"x": 1}, model="m", version="v")
+        clock.t += 11.0
+        c.set_stale_serve(True)
+        hit = c.get("t", "k")
+        assert hit is not None and hit.stale and hit.age_s > 10.0
+        assert c.describe()["stale_serves"] == 1
+        c.set_stale_serve(False)
+        assert c.get("t", "k") is None  # strict TTL is back
+
+    def test_byte_bound_evicts_and_oversize_refused(self):
+        c, _ = _cache(max_bytes=64)
+        assert not c.put("t", "big", {"x": "a" * 200}, model="m",
+                         version="v")
+        c.put("t", "a", {"x": "a" * 20}, model="m", version="v")
+        c.put("t", "b", {"x": "b" * 20}, model="m", version="v")
+        c.put("t", "c", {"x": "c" * 20}, model="m", version="v")
+        d = c.describe()
+        assert d["bytes"] <= 64 and d["evictions"] >= 1
+        assert c.get("t", "c") is not None  # newest survives
+
+    def test_invalidate_model_is_model_scoped(self):
+        c, _ = _cache()
+        c.put("t", "k1", {"x": 1}, model="m1", version="v")
+        c.put("t", "k2", {"x": 2}, model="m2", version="v")
+        assert c.invalidate_model("m1", reason="hot_swap") == 1
+        assert c.get("t", "k1") is None
+        assert c.get("t", "k2") is not None
+
+    def test_purge_and_pressure_evict(self):
+        c, _ = _cache()
+        for i in range(6):
+            c.put("t", f"k{i}", {"i": i}, model="m", version="v")
+        dropped = c.pressure_evict(fraction=0.5)
+        assert dropped == 3 and c.describe()["entries"] == 3
+        assert c.purge() == 3
+        assert len(c) == 0
+
+    def test_bypass_counted(self):
+        m = CacheMetrics()
+        c, _ = _cache(metrics=m)
+        c.note_bypass()
+        assert c.describe()["bypasses"] == 1
+        assert m.requests_total.value(plane="serving",
+                                      outcome="bypass") == 1
+
+    def test_resolver_contract(self):
+        assert resolve_response_cache(False) is None
+        c, _ = _cache()
+        assert resolve_response_cache(c) is c
+        built = resolve_response_cache(True)
+        assert isinstance(built, ResponseCache)
+        with pytest.raises(TypeError):
+            resolve_response_cache(42)
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation: the negatives the tier is not allowed to lose
+
+
+class TestTenantIsolation:
+    def test_cross_tenant_lookup_never_hits(self):
+        c, _ = _cache()
+        c.put("alice", "k", {"secret": "alice"}, model="m", version="v")
+        assert c.get("bob", "k") is None
+        assert c.get(None, "k") is None  # anonymous is its own namespace
+        hit = c.get("alice", "k")
+        assert hit is not None and hit.value["secret"] == "alice"
+
+    def test_anonymous_and_named_are_distinct(self):
+        c, _ = _cache()
+        c.put(None, "k", {"who": "anon"}, model="m", version="v")
+        c.put("t", "k", {"who": "t"}, model="m", version="v")
+        assert c.get(None, "k").value["who"] == "anon"
+        assert c.get("t", "k").value["who"] == "t"
+        assert c.describe()["tenants"] == 2
+
+    def test_isolation_survives_invalidation(self):
+        c, _ = _cache()
+        c.put("alice", "k", {"who": "alice"}, model="m", version="v")
+        c.put("bob", "k", {"who": "bob"}, model="m", version="v")
+        c.invalidate_model("m", reason="hot_swap")
+        # both gone — and refills land back in their own namespaces
+        assert c.get("alice", "k") is None and c.get("bob", "k") is None
+        c.put("alice", "k", {"who": "alice2"}, model="m", version="v")
+        assert c.get("bob", "k") is None
+
+    def test_isolation_under_concurrent_eviction_sanitized(self,
+                                                           monkeypatch):
+        """Cross-tenant isolation while eviction churns concurrently,
+        with the lockorder sanitizer armed: every tenant's reader may
+        only ever see its OWN values, through capacity evictions racing
+        gets/puts from 4 threads — and the run produces zero lock
+        violations."""
+        monkeypatch.setenv("DL4J_TPU_SANITIZERS", "lockorder")
+        monkeypatch.setenv("DL4J_TPU_LOCKCHECK_HOLD_S", "30")
+        lockcheck.reset()
+        # constructed AFTER arming so its lock is instrumented; tiny
+        # capacity forces eviction on nearly every put
+        cache = ResponseCache(capacity=4, ttl_s=60.0, max_bytes=1 << 20)
+        stop = threading.Event()
+        leaks = []
+
+        def churn(tenant):
+            i = 0
+            while not stop.is_set():
+                key = f"k{i % 8}"
+                cache.put(tenant, key, {"owner": tenant, "i": i},
+                          model="m", version="v")
+                hit = cache.get(tenant, key)
+                if hit is not None and hit.value["owner"] != tenant:
+                    leaks.append((tenant, hit.value))
+                if i % 7 == 0:
+                    cache.invalidate_model("m", reason="hot_swap")
+                i += 1
+
+        threads = [threading.Thread(target=churn, args=(t,))
+                   for t in ("alice", "bob", "carol", "dave")]
+        for t in threads:
+            t.start()
+        threads[0].join(0.4)  # bounded churn window
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads)
+        assert leaks == []
+        assert lockcheck.violations() == [], lockcheck.render_report()

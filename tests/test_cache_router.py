@@ -1,0 +1,127 @@
+"""Fleet-level cache tests (serving/router.py + serving/cache.py): a
+router-plane ``ResponseCache`` answers fleet-wide repeats WITHOUT
+contacting any backend, tenant-partitioned exactly like the server
+tier, bypass forwarded end to end, and purged whenever a backend
+re-admits or a rolling deploy walks the fleet (a swap may have changed
+what any key means).
+
+Budget discipline: ONE in-process backend behind one module-scoped
+router; every test uses its own distinct payloads so cache state never
+couples tests.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (
+    FleetRouter,
+    ModelRegistry,
+    ModelServer,
+    RouterPolicy,
+    ServingClient,
+    spec,
+)
+
+import jax
+import jax.numpy as jnp
+
+
+def _scale_forward(v, x):
+    return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+
+@pytest.fixture(scope="module")
+def cached_fleet():
+    registry = ModelRegistry()
+    registry.register("scale", _scale_forward, {"scale": 1.0},
+                      input_spec=spec((4,)), version="v1",
+                      mode="batched", max_batch_size=8,
+                      devices=jax.devices()[:1])
+    backend = ModelServer(registry, port=0, sentinel=False)
+    backend.start(warm=True)
+    policy = RouterPolicy(probe_interval_s=0.1, probe_timeout_s=0.5,
+                          reprobe_after_s=0.3, cache_capacity=64,
+                          cache_ttl_s=30.0)
+    router = FleetRouter([("b0", backend.url)], policy=policy).start()
+    ns = type("Fleet", (), {})()
+    ns.backend = backend
+    ns.router = router
+    ns.client = ServingClient(router.url)
+    yield ns
+    router.stop()
+    backend.stop(drain=False)
+
+
+def _x(seed):
+    return np.random.default_rng(seed).normal(size=(1, 4)).astype(
+        np.float32)
+
+
+def _backend_batches(ns):
+    return ns.backend.metrics.device_latency.summary(
+        model="scale")["count"]
+
+
+class TestRouterCache:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            RouterPolicy(cache_capacity=-1).validate()
+        with pytest.raises(ValueError):
+            RouterPolicy(cache_capacity=8, cache_ttl_s=0).validate()
+        assert FleetRouter([("b0", "http://127.0.0.1:1")]).cache is None
+
+    def test_fleet_hit_never_touches_a_backend(self, cached_fleet):
+        ns = cached_fleet
+        x = _x(1)
+        out1 = ns.client.predict("scale", x)
+        before = _backend_batches(ns)
+        hits_before = ns.router.cache.describe()["hits"]
+        for _ in range(5):
+            out = ns.client.predict("scale", x)
+            assert out["outputs"] == out1["outputs"]
+        # 5 answers, zero new backend batches: the router tier absorbed
+        # the repeats entirely
+        assert _backend_batches(ns) == before
+        assert ns.router.cache.describe()["hits"] == hits_before + 5
+        d = ns.router.describe()
+        assert d["cache"]["entries"] >= 1
+
+    def test_tenant_partitioned_at_the_router(self, cached_fleet):
+        ns = cached_fleet
+        x = _x(2)
+        ns.client.predict("scale", x, tenant="a")
+        ns.client.predict("scale", x, tenant="a")  # a's repeat hits
+        before = _backend_batches(ns)
+        # the SAME payload from tenant b must go to the backend
+        ns.client.predict("scale", x, tenant="b")
+        assert _backend_batches(ns) == before + 1
+
+    def test_bypass_forwarded_end_to_end(self, cached_fleet):
+        ns = cached_fleet
+        x = _x(3)
+        ns.client.predict("scale", x)
+        before = _backend_batches(ns)
+        byp_before = ns.router.cache.describe()["bypasses"]
+        # bypass skips the router cache AND the backend cache path
+        ns.client.predict("scale", x, cache_bypass=True)
+        assert _backend_batches(ns) == before + 1
+        assert ns.router.cache.describe()["bypasses"] == byp_before + 1
+
+    def test_readmit_purges_the_router_cache(self, cached_fleet):
+        ns = cached_fleet
+        ns.client.predict("scale", _x(4))
+        assert ns.router.cache.describe()["entries"] >= 1
+        ns.router.readmit("b0")
+        assert ns.router.cache.describe()["entries"] == 0
+
+    def test_rolling_deploy_purges_the_router_cache(self, cached_fleet):
+        ns = cached_fleet
+        x = _x(5)
+        ns.client.predict("scale", x)
+        ns.client.predict("scale", x)
+        assert ns.router.cache.describe()["entries"] >= 1
+        ns.router.rolling_deploy(lambda name: None)
+        assert ns.router.cache.describe()["entries"] == 0
+        # and the fleet still serves afterwards
+        out = ns.client.predict("scale", x)
+        assert out["outputs"][0][0] == 1.0

@@ -1,0 +1,281 @@
+"""Server-integrated cache tests: the exact-match response cache on the
+predict plane (consulted BEFORE a batch slot is taken, tenant-scoped,
+epoch-invalidated on hot-swap, bypass header, stale-serve under the
+``cache_pressure`` brownout rung, ``/debug/cache``) and prefix-KV reuse
+on the generation plane (graft + suffix-feed greedy parity with a cold
+prefill, ledger ``prefix_hit`` annotation, zero recompiles after warm).
+
+Budget discipline: one module-scoped cached ModelServer drives most
+predict tests through ``handle_predict`` (no HTTP except the /debug
+routes); the hot-swap test runs against the SAME server and later tests
+must not assume version v1; one short-TTL function server covers
+stale-serve; one module-scoped prefix-armed GenerationEngine covers the
+generation plane — that class is ``@pytest.mark.slow`` (the engine warm
+dominates its cost; the store's correctness invariants stay in tier-1
+via test_prefixkv.py, and greedy parity is also gated by ``bench.py
+cache``).
+"""
+
+import json
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.gpt import gpt_tiny
+from deeplearning4j_tpu.observability import reqlog as _rl
+from deeplearning4j_tpu.serving import (
+    GenerationEngine,
+    ModelRegistry,
+    ModelServer,
+    ResponseCache,
+    spec,
+)
+
+
+def _scale_forward(v, x):
+    return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+
+def _mk_cached_server(cache=True, scale=1.0, ttl_s=None):
+    registry = ModelRegistry()
+    registry.register("scale", _scale_forward, {"scale": scale},
+                      input_spec=spec((4,)), version="v1",
+                      mode="batched", max_batch_size=8,
+                      devices=jax.devices()[:1])
+    if ttl_s is not None:
+        cache = ResponseCache(capacity=64, ttl_s=ttl_s,
+                              max_bytes=1 << 20)
+    server = ModelServer(registry, port=0, sentinel=False, cache=cache)
+    server.start(warm=True)
+    return server, registry
+
+
+@pytest.fixture(scope="module")
+def cache_server():
+    """One cached server for the whole module. The hot-swap test
+    deploys v2 with scale=5 — tests that run after it must not assume
+    v1/scale=1, and every test uses its own distinct payloads."""
+    server, registry = _mk_cached_server()
+    yield server, registry
+    server.stop(drain=False)
+
+
+def _payload(seed, rows=1):
+    rng = np.random.default_rng(seed)
+    return {"inputs": rng.normal(size=(rows, 4)).round(4).tolist()}
+
+
+def _ledger_cache(cid):
+    rec = _rl.get_request_ledger(create=True).get(cid)
+    return None if rec is None else rec.get("cache")
+
+
+class TestResponseCacheServer:
+    def test_miss_then_hit_with_ledger_fields(self, cache_server):
+        server, _ = cache_server
+        payload = _payload(1)
+        s1, b1 = server.handle_predict("scale", dict(payload),
+                                       correlation_id="cache-miss-1")
+        s2, b2 = server.handle_predict("scale", dict(payload),
+                                       correlation_id="cache-hit-1")
+        assert s1 == s2 == 200
+        assert "cached" not in b1 and b2.get("cached") is True
+        assert b2["outputs"] == b1["outputs"]
+        assert _ledger_cache("cache-miss-1") == "miss"
+        assert _ledger_cache("cache-hit-1") == "hit"
+
+    def test_hit_consumes_no_batch_slot(self, cache_server):
+        server, _ = cache_server
+        payload = _payload(2)
+        server.handle_predict("scale", dict(payload))  # fill
+        before = server.metrics.device_latency.summary(
+            model="scale")["count"]
+        hits_before = server.response_cache.describe()["hits"]
+        for _ in range(5):
+            s, b = server.handle_predict("scale", dict(payload))
+            assert s == 200 and b.get("cached") is True
+        after = server.metrics.device_latency.summary(
+            model="scale")["count"]
+        # the proof the tier exists for: 5 answers, ZERO device batches
+        assert after == before
+        assert server.response_cache.describe()["hits"] == hits_before + 5
+
+    def test_bypass_header_skips_lookup_and_fill(self, cache_server):
+        server, _ = cache_server
+        payload = _payload(3)
+        for cid in ("cache-byp-1", "cache-byp-2"):
+            s, b = server.handle_predict("scale", dict(payload),
+                                         correlation_id=cid,
+                                         cache_bypass=True)
+            assert s == 200 and "cached" not in b
+            assert _ledger_cache(cid) == "bypass"
+        # bypass didn't fill either: a plain request still misses
+        s, b = server.handle_predict("scale", dict(payload),
+                                     correlation_id="cache-byp-3")
+        assert s == 200 and "cached" not in b
+
+    def test_cross_tenant_lookup_never_hits(self, cache_server):
+        server, _ = cache_server
+        payload = _payload(4)
+        s, b = server.handle_predict("scale", dict(payload), tenant="a")
+        assert s == 200 and "cached" not in b
+        s, b = server.handle_predict("scale", dict(payload), tenant="a")
+        assert b.get("cached") is True  # a's repeat hits
+        # the SAME payload from tenant b (and anonymous) must miss
+        s, b2 = server.handle_predict("scale", dict(payload), tenant="b")
+        assert s == 200 and "cached" not in b2
+        s, b3 = server.handle_predict("scale", dict(payload))
+        assert s == 200 and "cached" not in b3
+
+    def test_debug_cache_renders_over_http(self, cache_server):
+        server, _ = cache_server
+        with urllib.request.urlopen(server.url + "/debug/cache",
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["response_cache"]["plane"] == "serving"
+        assert doc["response_cache"]["entries"] >= 1
+
+    def test_cache_pressure_rung_wires_stale_serve(self, cache_server):
+        server, _ = cache_server
+        rungs = server._default_brownout_rungs()
+        assert rungs[0].name == "cache_pressure"
+        rc = server.response_cache
+        for i in range(4):
+            rc.put("rung", f"k{i}", {"i": i}, model="m", version="v")
+        entries_before = rc.describe()["entries"]
+        rungs[0].engage()
+        assert rc.stale_serve
+        assert rc.describe()["entries"] <= entries_before // 2 + 1
+        rungs[0].disengage()
+        assert not rc.stale_serve
+
+    # -- hot-swap invalidation: everything below runs post-deploy ----------
+
+    def test_hot_swap_invalidates_and_epoch_keys(self, cache_server):
+        server, registry = cache_server
+        payload = _payload(5)
+        s, b = server.handle_predict("scale", dict(payload))
+        s, b = server.handle_predict("scale", dict(payload))
+        assert b.get("cached") is True and b["version"] == "v1"
+        entry = registry.get("scale")
+        epoch_before = entry.epoch
+        inval_before = server.response_cache.describe()["evictions"]
+        registry.deploy("scale", {"scale": 5.0}, version="v2")
+        assert entry.epoch == epoch_before + 1
+        # the swap dropped the model's entries AND the epoch in the key
+        # makes any stale survivor unreachable: fresh compute, v2 answer
+        s, b = server.handle_predict("scale", dict(payload))
+        assert s == 200 and "cached" not in b
+        assert b["version"] == "v2"
+        assert np.asarray(b["outputs"])[0][0] == 5.0
+        assert server.response_cache.describe()["evictions"] > inval_before
+
+    def test_cross_tenant_still_isolated_after_hot_swap(self,
+                                                        cache_server):
+        server, _ = cache_server
+        payload = _payload(6)
+        server.handle_predict("scale", dict(payload), tenant="a")
+        s, b = server.handle_predict("scale", dict(payload), tenant="a")
+        assert b.get("cached") is True
+        s, b = server.handle_predict("scale", dict(payload), tenant="b")
+        assert s == 200 and "cached" not in b
+
+
+class TestStaleServeAndDisabled:
+    def test_stale_serve_end_to_end(self):
+        server, _ = _mk_cached_server(ttl_s=0.15)
+        try:
+            payload = _payload(7)
+            s, b1 = server.handle_predict("scale", dict(payload))
+            time.sleep(0.25)  # past TTL
+            # strict TTL: the expired entry misses (and evicts)
+            s, b = server.handle_predict("scale", dict(payload))
+            assert "cached" not in b
+            # re-fill, expire again, then engage brownout rung 0:
+            # the expired entry now serves, marked stale
+            server.handle_predict("scale", dict(payload))
+            time.sleep(0.25)
+            server._default_brownout_rungs()[0].engage()
+            s, b = server.handle_predict(
+                "scale", dict(payload), correlation_id="cache-stale-1")
+            assert s == 200 and b.get("cached") is True
+            assert b.get("cache_stale") is True
+            assert b["outputs"] == b1["outputs"]
+            assert _ledger_cache("cache-stale-1") == "stale"
+        finally:
+            server.stop(drain=False)
+
+    def test_debug_cache_404_when_disabled(self):
+        server = ModelServer(ModelRegistry(), port=0, sentinel=False)
+        server.start(warm=False)
+        try:
+            assert server.response_cache is None
+            with pytest.raises(urllib.request.HTTPError) as ei:
+                urllib.request.urlopen(server.url + "/debug/cache",
+                                       timeout=10)
+            assert ei.value.code == 404
+        finally:
+            server.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# prefix-KV reuse on the generation plane
+
+
+@pytest.fixture(scope="module")
+def prefix_engine():
+    model = gpt_tiny()
+    engine = GenerationEngine(
+        model, model.init(seed=0), name="gpt", num_slots=2, max_len=48,
+        max_new_tokens=8, min_kv_bucket=8, min_prompt_bucket=8,
+        idle_wait_s=0.005, temperature=0.0, max_waiting=16, seed=0,
+        prefix_cache=True)
+    engine.warm()
+    prev = _rl.get_request_ledger()
+    _rl.set_request_ledger(_rl.RequestLedger(256))
+    engine.start()
+    yield engine
+    engine.stop()
+    _rl.set_request_ledger(prev)
+
+
+@pytest.mark.slow
+class TestPrefixReuse:
+    def test_prefix_hit_greedy_parity_and_ledger(self, prefix_engine):
+        engine = prefix_engine
+        # 33 tokens: the cold prefill publishes the 32-token bucket
+        # prefix (strictly shorter — a suffix token must remain)
+        prompt = (np.arange(1, 34, dtype=np.int32) * 3) % 128
+        r1 = engine.submit(prompt, correlation_id="pfx-cold").result(
+            timeout=60)
+        assert engine.prefix_cache.describe()["entries"] >= 1
+        hits_before = engine.prefix_cache.describe()["hits"]
+        r2 = engine.submit(prompt, correlation_id="pfx-hit").result(
+            timeout=60)
+        # greedy decode from the grafted slab is BIT-identical to the
+        # cold prefill: the KV column for position j depends only on
+        # the token and position
+        assert r2["tokens"] == r1["tokens"]
+        assert engine.prefix_cache.describe()["hits"] == hits_before + 1
+        rec = _rl.get_request_ledger(create=True).get("pfx-hit")
+        assert rec["cache"] == "prefix_hit"
+        assert rec["prefix_len"] == 32
+        assert _rl.get_request_ledger().get("pfx-cold")["cache"] == "miss"
+
+    def test_distinct_prefix_misses_and_no_recompiles(self,
+                                                      prefix_engine):
+        engine = prefix_engine
+        other = (np.arange(1, 34, dtype=np.int32) * 5 + 7) % 128
+        misses_before = engine.prefix_cache.describe()["misses"]
+        engine.submit(other, correlation_id="pfx-other").result(
+            timeout=60)
+        assert engine.prefix_cache.describe()["misses"] \
+            == misses_before + 1
+        # the whole prefix path (graft + suffix-feed) was warmed at
+        # deploy: nothing recompiled
+        assert engine.compiles_after_warm == 0
+        assert engine.describe()["prefix_cache"]["entries"] >= 2

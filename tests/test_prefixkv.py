@@ -1,0 +1,154 @@
+"""Prefix-KV store unit tests (serving/prefixkv.py): digest + exact
+token verification (no hash-collision serves), longest-candidate
+selection, refcount pinning vs LRU eviction, version scoping (hot-swap
+can't leak old-weights KV), idempotent insertion, purge semantics.
+
+Budget discipline: tiny numpy slabs, no jax, no engine — the engine
+integration (graft + suffix-feed greedy parity) lives in
+test_cache_server.py against a real GenerationEngine.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving.prefixkv import (
+    PrefixKVStore,
+    resolve_prefix_store,
+)
+
+
+def _kvs(p, heads=2, head_dim=4, layers=2, fill=1.0):
+    return [(np.full((heads, p, head_dim), fill, np.float32),
+             np.full((heads, p, head_dim), -fill, np.float32))
+            for _ in range(layers)]
+
+
+def _store(**kw):
+    kw.setdefault("max_bytes", 1 << 20)
+    kw.setdefault("min_tokens", 4)
+    kw.setdefault("model", "gpt")
+    return PrefixKVStore(**kw)
+
+
+BUCKETS = (4, 8, 16)
+
+
+class TestAcquireInsert:
+    def test_insert_then_acquire_pins_and_verifies(self):
+        s = _store()
+        tokens = np.arange(8)
+        assert s.insert("v1", tokens, _kvs(8))
+        prompt = np.concatenate([tokens, [99]])  # 9 tokens: 8 + suffix
+        e = s.acquire("v1", prompt, BUCKETS)
+        assert e is not None and e.length == 8 and e.refs == 1
+        np.testing.assert_array_equal(e.tokens, tokens)
+        assert e.kvs[0][0].shape == (2, 8, 4)
+        s.release(e)
+        assert e.refs == 0
+        d = s.describe()
+        assert d["hits"] == 1 and d["entries"] == 1
+
+    def test_strict_prefix_required(self):
+        # a stored prefix EQUAL to the whole prompt can't serve: the
+        # suffix-feed needs at least one input token to produce the
+        # first sample's logits
+        s = _store()
+        tokens = np.arange(8)
+        s.insert("v1", tokens, _kvs(8))
+        assert s.acquire("v1", tokens, BUCKETS) is None
+        assert s.describe()["misses"] == 1
+
+    def test_longest_candidate_wins(self):
+        s = _store()
+        t16 = np.arange(16)
+        s.insert("v1", t16[:4], _kvs(4))
+        s.insert("v1", t16[:8], _kvs(8))
+        e = s.acquire("v1", np.concatenate([t16[:8], [7]]), BUCKETS)
+        assert e is not None and e.length == 8
+        s.release(e)
+
+    def test_token_mismatch_never_serves(self):
+        # same length, same version, different tokens: digest differs;
+        # and even a forged digest match is re-verified token-by-token
+        s = _store()
+        s.insert("v1", np.arange(8), _kvs(8))
+        other = np.concatenate([np.arange(7), [42], [3]])
+        assert s.acquire("v1", other, BUCKETS) is None
+
+    def test_version_scoped(self):
+        # a hot-swap changes the version: old-weights KV must not serve
+        s = _store()
+        tokens = np.arange(8)
+        s.insert("v1", tokens, _kvs(8))
+        prompt = np.concatenate([tokens, [1]])
+        assert s.acquire("v2", prompt, BUCKETS) is None
+        assert s.acquire("v1", prompt, BUCKETS) is not None
+
+    def test_min_tokens_floor(self):
+        s = _store(min_tokens=8)
+        assert not s.insert("v1", np.arange(4), _kvs(4))
+        s.insert("v1", np.arange(8), _kvs(8))
+        # a 4-candidate below the floor is skipped even though 4 < size
+        e = s.acquire("v1", np.arange(9), (4, 8))
+        assert e is not None and e.length == 8
+        s.release(e)
+
+    def test_insert_idempotent(self):
+        s = _store()
+        tokens = np.arange(8)
+        assert s.insert("v1", tokens, _kvs(8, fill=1.0))
+        assert not s.insert("v1", tokens, _kvs(8, fill=2.0))
+        e = s.acquire("v1", np.arange(9), BUCKETS)
+        assert float(e.kvs[0][0][0, 0, 0]) == 1.0  # first copy kept
+        s.release(e)
+        assert s.describe()["entries"] == 1
+
+
+class TestEvictionPinning:
+    def test_pinned_entries_never_evict(self):
+        one = _kvs(8)
+        slab_bytes = sum(k.nbytes + v.nbytes for k, v in one)
+        s = _store(max_bytes=slab_bytes * 2)
+        a = np.arange(8)
+        s.insert("v1", a, _kvs(8))
+        e = s.acquire("v1", np.concatenate([a, [1]]), BUCKETS)
+        assert e is not None  # pinned
+        # two more inserts push past the bound: only UNPINNED evict
+        s.insert("v1", np.arange(100, 108), _kvs(8))
+        s.insert("v1", np.arange(200, 208), _kvs(8))
+        assert s.has("v1", a)  # the pinned slab survived
+        assert s.describe()["evictions"] >= 1
+        s.release(e)
+
+    def test_oversize_slab_refused(self):
+        s = _store(max_bytes=64)
+        assert not s.insert("v1", np.arange(8), _kvs(8))
+        assert s.describe()["entries"] == 0
+
+    def test_purge_skips_pinned(self):
+        s = _store()
+        a, b = np.arange(8), np.arange(50, 58)
+        s.insert("v1", a, _kvs(8))
+        s.insert("v1", b, _kvs(8))
+        e = s.acquire("v1", np.concatenate([a, [1]]), BUCKETS)
+        assert s.purge() == 1  # only the unpinned slab dropped
+        assert s.has("v1", a) and not s.has("v1", b)
+        s.release(e)
+        assert s.purge() == 1
+
+
+class TestResolver:
+    def test_resolver_contract(self):
+        assert resolve_prefix_store(False, model="m") is None
+        s = _store()
+        assert resolve_prefix_store(s, model="m") is s
+        built = resolve_prefix_store(True, model="m")
+        assert isinstance(built, PrefixKVStore) and built.model == "m"
+        with pytest.raises(TypeError):
+            resolve_prefix_store(42, model="m")
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            PrefixKVStore(max_bytes=0)
+        with pytest.raises(ValueError):
+            PrefixKVStore(min_tokens=0)
