@@ -2826,6 +2826,171 @@ def bench_cache(peak, *, n_threads=4, requests_per_thread=60,
     return info
 
 
+def bench_replay(peak, *, backends=3, rows=None, clients=6,
+                 kill_at_s=0.2, speed_drill=10.0,
+                 availability_slo=0.95, mttr_budget_s=8.0,
+                 p99_budget_s=5.0, ready_timeout_s=180.0):
+    """Ledger-driven traffic replay + scripted game-day
+    (resilience/replay.py + gameday.py): the bundled reference trace
+    (``resilience/reference_trace.json`` — 60 predict rows over ~6 s
+    of Poisson arrivals, mixed critical/normal/batch priorities over
+    three tenants; regenerate via ``synthesize_trace`` with seed 2026)
+    replayed open-loop against a ``backends``-backend router fleet.
+    Two legs:
+
+    1. **Clean 1x replay** — arrival-faithful baseline: goodput,
+       availability (gated exactly 1.0 — nothing is degraded), client
+       p99, and open-loop send-lag fidelity.
+    2. **10x game-day drill** — the same trace compressed 10x while
+       one scripted act SIGKILLs a backend mid-replay; judged by the
+       drill's own gates from the client-side ledger, cross-checked
+       against the router's counters: zero critical-class failures,
+       availability >= ``availability_slo``, kill->first-success MTTR
+       <= ``mttr_budget_s``, client p99 <= ``p99_budget_s``, and the
+       reconciliation row (fleet served >= client successes).
+
+    Backends are subprocesses: a SIGKILL must take out a real process
+    — an in-process backend cannot die under the router the way a
+    host does. ``rows`` slices the trace's first N rows (CPU-integrity
+    sizing). ``peak`` is unused: the metrics are resilience economics.
+    """
+    import textwrap
+
+    from deeplearning4j_tpu.resilience import gameday as gd
+    from deeplearning4j_tpu.resilience import replay as rp
+    from deeplearning4j_tpu.serving import FleetRouter, RouterPolicy
+
+    trace = rp.load_trace(os.path.join(
+        os.path.dirname(rp.__file__), "reference_trace.json"))
+    if rows is not None:
+        sliced = trace["rows"][:int(rows)]
+        trace = rp.validate_trace(dict(
+            trace, rows=sliced, count=len(sliced),
+            duration_s=sliced[-1]["arrival_offset_s"]))
+
+    script = textwrap.dedent("""
+        import sys, time
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.serving import (ModelRegistry,
+                                                ModelServer, spec)
+
+        def fwd(v, x):
+            return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+        reg = ModelRegistry()
+        reg.register("scale", fwd, {"scale": 1.0}, input_spec=spec((4,)),
+                     mode="batched", max_batch_size=8)
+        srv = ModelServer(reg, port=int(sys.argv[1]), sentinel=False)
+        srv.start(warm=True)
+        print("READY", srv.port, flush=True)
+        while True:
+            time.sleep(3600)
+    """)
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FAULTS", None)
+    ports = [free_port() for _ in range(backends)]
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(p)],
+                              stdout=subprocess.PIPE, text=True, env=env)
+             for p in ports]
+
+    def await_ready(proc):
+        deadline = time.monotonic() + ready_timeout_s
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                return False
+            if line.startswith("READY"):
+                return True
+        return False
+
+    router = None
+    try:
+        if not all(await_ready(p) for p in procs):
+            raise RuntimeError("replay bench backend failed to start")
+        policy = RouterPolicy(probe_interval_s=0.25, probe_timeout_s=0.5,
+                              reprobe_after_s=0.5)
+        router = FleetRouter(
+            [(f"b{i}", f"http://127.0.0.1:{p}")
+             for i, p in enumerate(ports)], policy=policy).start()
+
+        # -- leg A: clean arrival-faithful replay at 1x --------------------
+        clean = rp.ReplayDriver(router.url, trace, speed=1.0,
+                                clients=clients).run()
+        clean.pop("results")
+
+        # -- leg B: 10x drill with one scripted SIGKILL --------------------
+        victim = procs[1]
+
+        def kill_victim():
+            victim.kill()
+            victim.wait(timeout=10)
+
+        drill = gd.GameDay.from_script(
+            {"name": "bench-replay-sigkill",
+             "speed": speed_drill, "clients": clients,
+             "acts": [{"at_s": kill_at_s, "kind": "kill",
+                       "name": "kill-b1", "hook": "kill-b1"}],
+             "gates": [
+                 {"kind": "critical_failures", "max_count": 0},
+                 {"kind": "availability", "min_ratio": availability_slo},
+                 {"kind": "mttr", "max_s": mttr_budget_s},
+                 {"kind": "p99", "max_s": p99_budget_s}]},
+            base_url=router.url, trace=trace,
+            hooks={"kill-b1": kill_victim},
+            scrape_urls=[router.url], incident_urls=[router.url])
+        report = drill.run()
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            p.kill()
+            p.wait(timeout=10)
+
+    gates = {v["gate"]: v for v in report["gates"]}
+    mttr_s = gates["mttr"]["value"]
+    rep = report["replay"]
+    recon = report["reconciliation"]
+    info = {
+        "trace_rows": trace["count"],
+        "trace_duration_s": trace["duration_s"],
+        "backends": backends,
+        "clean_goodput_rps": clean["goodput_rps"],
+        "clean_availability": clean["availability"],
+        "clean_p99_s": clean["latency_p99_s"],
+        "clean_max_send_lag_s": clean["max_send_lag_s"],
+        "drill_speed": speed_drill,
+        "drill_goodput_rps": rep["goodput_rps"],
+        "drill_availability": rep["availability"],
+        "drill_p99_s": rep["latency_p99_s"],
+        "drill_retries": rep["retries"],
+        "mttr_s": mttr_s,
+        "drill_verdict": report["verdict"],
+        "reconciliation_consistent": recon["consistent"],
+        # integrity gates: the undisturbed 1x leg loses NOTHING, and
+        # the SIGKILL drill passes every scripted gate with the
+        # client-side ledger reconciling against the router's counters
+        "gate_clean_ok": bool(clean["availability"] == 1.0),
+        "gate_drill_ok": bool(report["verdict"] == "pass"),
+        "converged": bool(clean["availability"] == 1.0
+                          and report["verdict"] == "pass"
+                          and recon["consistent"]),
+        "unit": "s kill->first-success MTTR, 10x replay + SIGKILL",
+    }
+    info["value"] = (round(mttr_s, 3) if isinstance(mttr_s, (int, float))
+                     else None)
+    return info
+
+
 _CONFIGS = {
     "bert": bench_bert,
     # Batch-size knee probe (no baseline row): how much of the remaining
@@ -2902,6 +3067,13 @@ _CONFIGS = {
     # exact hits proven to consume zero batch slots, and prefix-KV
     # TTFT reduction vs cold prefill at equal prompt length.
     "cache": bench_cache,
+    # Ledger-driven traffic replay + scripted game-day (resilience/
+    # replay + gameday): the bundled reference trace at 1x (clean
+    # baseline) and 10x (drill) against a 3-backend subprocess router
+    # fleet with one scripted SIGKILL act; goodput, availability,
+    # kill->recovery MTTR and p99, judged by the drill's own gates
+    # plus the ledger/fleet-counter reconciliation row.
+    "replay": bench_replay,
 }
 
 # Shrunken shapes for the CPU config-integrity fallback: prove every bench
@@ -2976,6 +3148,12 @@ _CPU_INTEGRITY = {
                   prefix_requests=4, gen_hidden=64, gen_layers=2,
                   gen_heads=2, gen_vocab=128, gen_max_len=80,
                   gen_max_new=4),
+    # replay reports "converged" = clean 1x leg availability exactly
+    # 1.0 AND the 10x SIGKILL drill passes all scripted gates (zero
+    # critical failures, availability >= SLO, MTTR and p99 in budget)
+    # with the client ledger reconciling against the router counters
+    # (first 24 trace rows, same invariants as the perf leg)
+    "replay": dict(rows=24, clients=4),
 }
 
 

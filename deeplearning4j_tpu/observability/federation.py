@@ -68,6 +68,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import parse_qs
 
 from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability import reqlog as _reqlog
 from deeplearning4j_tpu.observability import trace as _trace
 from deeplearning4j_tpu.observability.flightrecorder import (
     get_flight_recorder,
@@ -1196,6 +1197,22 @@ class ClusterAggregator:
         return {"workers": sorted(snaps), "count": len(rows),
                 "requests": rows}
 
+    def cluster_trace_export(self, *, plane: Optional[str] = None,
+                             model: Optional[str] = None) -> dict:
+        """The fleet-wide replayable trace: every worker's recent
+        ledger records merged and reduced to payload-scrubbed trace
+        rows, ordered by absolute arrival wall-time across workers
+        (``GET /cluster/debug/requests?format=trace``). A trace
+        recorded from N workers replays against one target as the
+        cohort's combined offered load."""
+        with self._lock:
+            snaps = dict(self._snapshots)
+        records: List[dict] = []
+        for _wid, snap in sorted(snaps.items()):
+            records.extend(snap.get("requests", []))
+        return _reqlog.trace_from_records(records, plane=plane,
+                                          model=model)
+
     def cluster_request(self, cid: str) -> Optional[dict]:
         """Find one request by correlation id on whichever worker
         served it: the ledger record from that worker's snapshot plus
@@ -1424,6 +1441,11 @@ class ClusterTelemetryServer:
                     except ValueError:
                         self._send(400, {"error": "min_latency_ms and "
                                                   "limit must be numbers"})
+                        return
+                    if q.get("format", [None])[0] == "trace":
+                        self._send(200, agg.cluster_trace_export(
+                            plane=q.get("plane", [None])[0],
+                            model=q.get("model", [None])[0]))
                         return
                     self._send(200, agg.cluster_requests(
                         outcome=q.get("outcome", [None])[0],

@@ -55,8 +55,9 @@ Stdlib only; safe to import from any layer.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 from deeplearning4j_tpu.observability import metrics as _metrics
 from deeplearning4j_tpu.observability import trace as _trace
@@ -173,9 +174,15 @@ class RequestLedger:
                         prev[k] = v
                 rec, evicted, open_now = prev, None, self._open
             else:
+                # t_start is the wall-anchored monotonic clock (interval
+                # math); t_wall is the true wall clock of arrival — trace
+                # export needs an absolute arrival time that survives
+                # cross-process merge (federated export sorts workers'
+                # records by it)
                 rec = {"cid": cid, "plane": plane, "model": model,
                        "priority": priority, "tenant": tenant,
                        "state": "open", "t_start": _trace.now(),
+                       "t_wall": time.time(),
                        "t_end": None, "latency_s": None, "outcome": None,
                        "status": None, "admission": None,
                        "trace_retained": None}
@@ -313,12 +320,89 @@ class RequestLedger:
             snap = list(self._ring)[-max(1, int(limit)):]
         return [dict(r) for r in reversed(snap)]
 
+    def export_trace(self, *, window_s: Optional[float] = None,
+                     plane: Optional[str] = None,
+                     model: Optional[str] = None,
+                     limit: Optional[int] = None) -> dict:
+        """Turn a ledger window into a replayable, payload-scrubbed
+        trace (``GET /debug/requests?format=trace``) — see
+        :func:`trace_from_records` for the row schema. ``window_s``
+        keeps only requests that arrived within the trailing window;
+        ``limit`` keeps the newest N arrivals."""
+        with self._lock:
+            snap = [dict(r) for r in self._ring]
+        if window_s is not None:
+            cutoff = time.time() - float(window_s)
+            snap = [r for r in snap
+                    if (r.get("t_wall") or r.get("t_start", 0.0)) >= cutoff]
+        if limit is not None:
+            snap = snap[-max(1, int(limit)):]
+        return trace_from_records(snap, plane=plane, model=model)
+
     def describe(self) -> dict:
         with self._lock:
             return {"capacity": self.capacity, "records": len(self._ring),
                     "open": self._open,
                     "staged": (self.sampler.staged_count()
                                if self.sampler is not None else 0)}
+
+
+# -- trace export -------------------------------------------------------------
+
+# the ONLY keys a trace row may carry: identity + timing + shape, never
+# payload bytes. ``payload_shape`` is a shape descriptor (list of ints
+# for a single array, {name: shape} for dict features, [prompt_len] for
+# generation); replay synthesizes inputs from it.
+TRACE_ROW_FIELDS = ("plane", "model", "arrival_offset_s", "priority",
+                    "tenant", "payload_shape", "deadline_s", "stream",
+                    "max_new_tokens")
+
+TRACE_VERSION = 1
+
+
+def trace_from_records(records: Iterable[dict], *,
+                       plane: Optional[str] = None,
+                       model: Optional[str] = None) -> dict:
+    """Build a replayable trace from ledger records (this process's
+    ring, or a cross-worker merge from federation snapshots). Rows are
+    sorted by absolute arrival wall-time and reduced to
+    :data:`TRACE_ROW_FIELDS` — payload bytes never leave the ledger;
+    the replay driver synthesizes inputs from ``payload_shape``.
+    Arrival offsets are relative to the first kept arrival, so a trace
+    is position-independent and can be replayed any time, anywhere."""
+    kept = []
+    for rec in records:
+        if plane is not None and rec.get("plane") != plane:
+            continue
+        if model is not None and rec.get("model") != model:
+            continue
+        t = rec.get("t_wall")
+        if t is None:
+            t = rec.get("t_start")
+        if t is None:
+            continue
+        kept.append((float(t), rec))
+    kept.sort(key=lambda pair: pair[0])
+    t0 = kept[0][0] if kept else None
+    rows: List[dict] = []
+    for t, rec in kept:
+        shape = rec.get("payload_shape")
+        if shape is None and rec.get("prompt_len") is not None:
+            shape = [int(rec["prompt_len"])]
+        row = {"plane": rec.get("plane"), "model": rec.get("model"),
+               "arrival_offset_s": round(t - t0, 6),
+               "priority": rec.get("priority"),
+               "tenant": rec.get("tenant"),
+               "payload_shape": shape,
+               "deadline_s": rec.get("deadline_s"),
+               "stream": bool(rec.get("stream", False))}
+        if rec.get("max_new_tokens") is not None:
+            row["max_new_tokens"] = int(rec["max_new_tokens"])
+        rows.append(row)
+    return {"version": TRACE_VERSION, "kind": "dl4j_tpu_trace",
+            "t0_wall": t0, "count": len(rows),
+            "duration_s": (round(kept[-1][0] - t0, 6) if kept else 0.0),
+            "rows": rows}
 
 
 # -- process-global ledger ----------------------------------------------------
@@ -438,8 +522,11 @@ def postmortem(window_s: float = 180.0, limit: int = 8) -> dict:
 
 __all__ = [
     "OUTCOMES",
+    "TRACE_ROW_FIELDS",
+    "TRACE_VERSION",
     "ReqLogMetrics",
     "RequestLedger",
+    "trace_from_records",
     "get_reqlog_metrics",
     "get_request_ledger",
     "ledger_enabled",

@@ -226,6 +226,14 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     SentinelMetrics(reg)
     # the request-ledger + tail-trace-retention families (reqlog.py)
     ReqLogMetrics(reg)
+    # the traffic-replay + game-day drill families (resilience/replay.py
+    # + resilience/gameday.py): the gameday-gate-breach burn-rate rule
+    # validates offline
+    from deeplearning4j_tpu.resilience.gameday import GameDayMetrics
+    from deeplearning4j_tpu.resilience.replay import ReplayMetrics
+
+    ReplayMetrics(reg)
+    GameDayMetrics(reg)
     names.update(i.name for i in reg.instruments())
     return names
 

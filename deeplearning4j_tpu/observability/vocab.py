@@ -134,10 +134,22 @@ CACHE_KINDS = frozenset({
     "cache.stale_serve",
 })
 
+# traffic replay + scripted game-days (resilience/replay.py,
+# resilience/gameday.py)
+REPLAY_KINDS = frozenset({
+    "gameday.act",
+    "gameday.complete",
+    "gameday.gate",
+    "gameday.report",
+    "gameday.start",
+    "replay.complete",
+    "replay.start",
+})
+
 EVENT_KINDS = frozenset().union(
     SERVING_KINDS, GENERATION_KINDS, ROUTER_KINDS, TRAIN_KINDS,
     RESILIENCE_KINDS, COMPILE_KINDS, OBSERVABILITY_KINDS,
-    SANITIZER_KINDS, CACHE_KINDS)
+    SANITIZER_KINDS, CACHE_KINDS, REPLAY_KINDS)
 
 
 def known_event_kinds() -> frozenset:

@@ -1,0 +1,540 @@
+"""Scripted game-days: replay + timed fault acts + gated verdicts.
+
+A **game-day** is a rehearsed outage: replay recorded traffic
+(resilience/replay.py) at speed S against a live ``ModelServer`` or
+``FleetRouter`` while a script of timed **acts** injures the fleet —
+fault-matrix entries (``serving.latency``, ``serving.error``,
+``checkpoint.corrupt``, ``collective.stall``, … via the deterministic
+injector in resilience/faults.py), backend SIGKILL (any callable
+hook — a subprocess ``proc.kill()``, the supervisor's slot murder),
+router-target drain/readmit — and then judges the run against
+declarative **gates**:
+
+- ``critical_failures`` — zero critical-class client-visible failures
+  (the non-negotiable one: a drill that hurts critical traffic fails
+  whatever else went right);
+- ``availability`` — client-observed ok-ratio ≥ the SLO;
+- ``mttr`` — kill→first-subsequent-success within budget;
+- ``p99`` — client-observed tail latency within budget;
+- ``recompiles`` — zero ``warmup_recompiles_after_warm_total`` growth
+  in the fleet scrape (a drill must not thaw the compile caches).
+
+Gates are evaluated from the replay driver's OWN client-side ledger —
+what users saw, not what the fleet claims — and then cross-checked
+against the fleet's federated metrics scrape (``reconciliation`` in
+the report: the fleet must have served at least every success the
+clients observed; a mismatch means telemetry is lying). Acts may be
+plain dicts (the JSON script grammar, see :meth:`GameDay.from_script`)
+or built programmatically; non-serializable acts (SIGKILL) bind
+through named **hooks**.
+
+Every run emits a ``gameday.*`` flight trail (start / act / gate /
+report / complete), ``gameday_*`` metric families, and a post-run
+report artifact: per-act verdicts, gate table, worst requests of the
+run, incident bundles the fleet opened while the drill ran, and the
+client-vs-fleet reconciliation. ``DL4J_TPU_GAMEDAY_REPORT_DIR`` (or
+``report_dir=``) makes the runner write the artifact to disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import replay as _replay
+
+ENV_GAMEDAY_REPORT_DIR = "DL4J_TPU_GAMEDAY_REPORT_DIR"
+
+ACT_KINDS = ("fault", "clear_faults", "kill", "drain", "readmit", "call")
+GATE_KINDS = ("critical_failures", "availability", "mttr", "p99",
+              "recompiles")
+
+# counter families the fleet scrape sums for reconciliation + the
+# recompile gate (whichever exist on the target; a router federates
+# its backends' serving_* under the same names)
+_SCRAPE_FAMILIES = ("serving_requests_total", "router_requests_total",
+                    "generation_requests_total",
+                    "warmup_recompiles_after_warm_total")
+
+
+class GameDayMetrics:
+    """Game-day exposition families (process default registry)."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        r = registry if registry is not None else _metrics.default_registry()
+        self.registry = r
+        self.runs_total = r.counter(
+            "gameday_runs_total",
+            "Game-day drills completed, by verdict (pass | fail).",
+            ("verdict",))
+        self.acts_total = r.counter(
+            "gameday_acts_total",
+            "Scripted acts fired across all drills, by kind (fault | "
+            "clear_faults | kill | drain | readmit | call).", ("kind",))
+        self.gates_total = r.counter(
+            "gameday_gates_total",
+            "Gate evaluations across all drills, by result (pass | "
+            "breach) — the gameday-gate-breach burn rule's "
+            "numerator/denominator pair.", ("result",))
+
+
+_gameday_metrics: Optional[GameDayMetrics] = None
+_gm_lock = threading.Lock()
+
+
+def get_gameday_metrics() -> GameDayMetrics:
+    global _gameday_metrics
+    if _gameday_metrics is None:
+        with _gm_lock:
+            if _gameday_metrics is None:
+                _gameday_metrics = GameDayMetrics()
+    return _gameday_metrics
+
+
+def _drop_gameday_metrics():
+    global _gameday_metrics
+    _gameday_metrics = None
+
+
+_metrics.register_reset_hook(_drop_gameday_metrics)
+
+
+def _gameday_metrics_or_none() -> Optional[GameDayMetrics]:
+    try:
+        if not _metrics.enabled():
+            return None
+        return get_gameday_metrics()
+    except Exception:  # noqa: BLE001 — metrics never fail the drill
+        return None
+
+
+# -- acts ---------------------------------------------------------------------
+
+
+class Act:
+    """One timed step of the script. ``at_s`` is the offset from run
+    start (in REPLAY time — already speed-scaled, like everything the
+    clients see). Kinds:
+
+    - ``fault``: install ``spec`` (the ``DL4J_TPU_FAULTS`` grammar,
+      e.g. ``"serving.latency@1x40:0.05"``) on the process fault
+      injector — injures in-process targets; subprocess backends arm
+      theirs via their own environment at spawn;
+    - ``clear_faults``: swap in a fresh empty injector;
+    - ``kill`` / ``call``: invoke ``fn`` (a subprocess ``.kill()``,
+      the supervisor's slot murder, any chaos callable); ``kill`` is
+      the act MTTR gates anchor to by default;
+    - ``drain`` / ``readmit``: ``POST /admin/<kind>/<backend>`` on
+      ``admin_url`` (default: the run's target URL — the router).
+    """
+
+    def __init__(self, at_s: float, kind: str, *,
+                 name: Optional[str] = None, spec: Optional[str] = None,
+                 fn: Optional[Callable[[], object]] = None,
+                 backend: Optional[str] = None,
+                 admin_url: Optional[str] = None):
+        if kind not in ACT_KINDS:
+            raise ValueError(f"unknown act kind {kind!r} "
+                             f"(one of {ACT_KINDS})")
+        if kind == "fault" and not spec:
+            raise ValueError("fault act needs spec=")
+        if kind in ("kill", "call") and fn is None:
+            raise ValueError(f"{kind} act needs fn= (or a hook name in "
+                             "the script form)")
+        if kind in ("drain", "readmit") and not backend:
+            raise ValueError(f"{kind} act needs backend=")
+        self.at_s = float(at_s)
+        self.kind = kind
+        self.name = name or f"{kind}@{self.at_s:g}s"
+        self.spec = spec
+        self.fn = fn
+        self.backend = backend
+        self.admin_url = admin_url
+        self.t_fired: Optional[float] = None  # monotonic, stamped on fire
+        self.error: Optional[str] = None
+
+    def fire(self, default_admin_url: str) -> None:
+        try:
+            if self.kind == "fault":
+                inj = _faults.get_fault_injector()
+                for kw in _faults.parse_fault_spec(self.spec):
+                    inj.plan(**kw)
+            elif self.kind == "clear_faults":
+                _faults.set_fault_injector(_faults.FaultInjector())
+            elif self.kind in ("kill", "call"):
+                self.fn()
+            else:  # drain / readmit
+                url = (self.admin_url or default_admin_url).rstrip("/")
+                req = urllib.request.Request(
+                    f"{url}/admin/{self.kind}/{self.backend}", data=b"")
+                with urllib.request.urlopen(req, timeout=10.0) as r:
+                    r.read()
+        except Exception as e:  # noqa: BLE001 — the drill reports it
+            self.error = f"{type(e).__name__}: {e}"[:200]
+        self.t_fired = time.monotonic()
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": self.kind, "at_s": self.at_s,
+                "spec": self.spec, "backend": self.backend,
+                "fired": self.t_fired is not None, "error": self.error}
+
+
+class Gate:
+    """One pass/fail criterion. ``scope`` is ``"run"`` (the whole
+    client ledger) or an act name (results sent at/after that act
+    fired — "did the fleet stay healthy from the kill onward").
+    Thresholds: ``max_count`` (critical_failures), ``min_ratio``
+    (availability), ``max_s`` (mttr / p99), ``max_count``
+    (recompiles); ``act`` names the anchor act for ``mttr`` (default:
+    the first ``kill`` act)."""
+
+    def __init__(self, kind: str, *, name: Optional[str] = None,
+                 scope: str = "run", act: Optional[str] = None,
+                 max_count: int = 0, min_ratio: float = 0.99,
+                 max_s: float = 5.0):
+        if kind not in GATE_KINDS:
+            raise ValueError(f"unknown gate kind {kind!r} "
+                             f"(one of {GATE_KINDS})")
+        self.kind = kind
+        self.scope = scope
+        self.act = act
+        self.name = name or (kind if scope == "run"
+                             else f"{kind}:{scope}")
+        self.max_count = int(max_count)
+        self.min_ratio = float(min_ratio)
+        self.max_s = float(max_s)
+
+    def evaluate(self, results: Sequence[dict],
+                 acts: Sequence[Act], fleet: dict) -> dict:
+        window = results
+        if self.scope != "run":
+            anchor = _act_named(acts, self.scope)
+            if anchor is None or anchor.t_fired is None:
+                return self._verdict(False, None,
+                                     f"scope act {self.scope!r} never "
+                                     "fired")
+            window = [r for r in results if r["t_send"] >= anchor.t_fired]
+        if self.kind == "critical_failures":
+            bad = [r for r in window if r.get("priority") == "critical"
+                   and r["outcome"] != "ok"]
+            return self._verdict(len(bad) <= self.max_count, len(bad),
+                                 f"<= {self.max_count}")
+        if self.kind == "availability":
+            if not window:
+                return self._verdict(False, None, "no requests in scope")
+            ok = sum(1 for r in window if r["outcome"] == "ok")
+            ratio = ok / len(window)
+            return self._verdict(ratio >= self.min_ratio, round(ratio, 6),
+                                 f">= {self.min_ratio}")
+        if self.kind == "p99":
+            p99 = _replay.summarize(window)["latency_p99_s"]
+            if p99 is None:
+                return self._verdict(False, None, "no successes in scope")
+            return self._verdict(p99 <= self.max_s, p99,
+                                 f"<= {self.max_s}s")
+        if self.kind == "mttr":
+            anchor = (_act_named(acts, self.act) if self.act
+                      else _first_kill(acts))
+            if anchor is None or anchor.t_fired is None:
+                return self._verdict(False, None,
+                                     "no fired kill act to anchor MTTR")
+            mttr = _replay.first_success_after(results, anchor.t_fired)
+            if mttr is None:
+                return self._verdict(False, None,
+                                     "no success after the kill")
+            return self._verdict(mttr <= self.max_s, round(mttr, 3),
+                                 f"<= {self.max_s}s")
+        # recompiles: judged from the fleet scrape, not the client view
+        n = fleet.get("warmup_recompiles_after_warm_total")
+        if n is None:
+            # zero-sample families drop out of federated scrapes, so a
+            # healthy scrape that shows traffic but no recompile family
+            # means the counter never incremented; only a scrape that
+            # saw nothing at all is unjudgeable
+            if not fleet.get("_scrape_errors") and any(
+                    not k.startswith("_") for k in fleet):
+                n = 0.0
+            else:
+                return self._verdict(False, None,
+                                     "fleet scrape unavailable")
+        return self._verdict(n <= self.max_count, n,
+                             f"<= {self.max_count}")
+
+    def _verdict(self, passed: bool, value, budget: str) -> dict:
+        return {"gate": self.name, "kind": self.kind, "scope": self.scope,
+                "passed": bool(passed), "value": value, "budget": budget}
+
+
+def _act_named(acts: Sequence[Act], name: str) -> Optional[Act]:
+    for a in acts:
+        if a.name == name:
+            return a
+    return None
+
+
+def _first_kill(acts: Sequence[Act]) -> Optional[Act]:
+    for a in acts:
+        if a.kind == "kill":
+            return a
+    return None
+
+
+# -- fleet scrape -------------------------------------------------------------
+
+
+def scrape_fleet_counters(urls: Sequence[str],
+                          families: Sequence[str] = _SCRAPE_FAMILIES
+                          ) -> dict:
+    """Sum the named counter families across ``/metrics?format=json``
+    scrapes of each URL (a router URL federates its whole fleet in one
+    scrape). Unreachable targets are recorded, not raised — a drill
+    that killed its last backend must still produce a report."""
+    totals: Dict[str, float] = {}
+    errors: List[str] = []
+    for url in urls:
+        try:
+            req = urllib.request.Request(
+                url.rstrip("/") + "/metrics?format=json")
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                doc = json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            errors.append(f"{url}: {type(e).__name__}: {e}"[:200])
+            continue
+        for fam in doc.get("metrics", []):
+            if fam.get("name") in families \
+                    and fam.get("type") == "counter":
+                totals[fam["name"]] = totals.get(fam["name"], 0.0) + sum(
+                    s.get("value", 0.0) for s in fam.get("samples", []))
+    out = dict(totals)
+    out["_scrape_errors"] = errors
+    return out
+
+
+def fetch_incident_index(urls: Sequence[str]) -> List[dict]:
+    """Merge ``/debug/incidents`` indexes (a router URL already
+    federates its backends'); unreachable targets are skipped."""
+    merged: List[dict] = []
+    for url in urls:
+        try:
+            req = urllib.request.Request(
+                url.rstrip("/") + "/debug/incidents")
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                doc = json.loads(r.read())
+        except Exception:  # noqa: BLE001 — a dead target has no bundles
+            continue
+        merged.extend(doc.get("incidents", []))
+    return merged
+
+
+# -- the runner ---------------------------------------------------------------
+
+
+class GameDay:
+    """One scripted drill: replay ``trace`` against ``base_url`` at
+    ``speed`` while firing ``acts`` at their offsets, then judge
+    ``gates`` and emit the report artifact."""
+
+    def __init__(self, base_url: str, trace: dict, *,
+                 acts: Sequence = (), gates: Sequence = (),
+                 name: str = "gameday",
+                 speed: Optional[float] = None,
+                 clients: Optional[int] = None,
+                 max_retries: int = 3, timeout_s: float = 30.0,
+                 token_read_delay_s: float = 0.0,
+                 fallback_shape=None,
+                 report_dir: Optional[str] = None,
+                 scrape_urls: Optional[Sequence[str]] = None,
+                 incident_urls: Optional[Sequence[str]] = None):
+        self.base_url = base_url.rstrip("/")
+        self.trace = trace
+        self.name = name
+        self.acts = [self._coerce_act(a) for a in acts]
+        self.acts.sort(key=lambda a: a.at_s)
+        self.gates = [self._coerce_gate(g) for g in gates]
+        self.driver = _replay.ReplayDriver(
+            base_url, trace, speed=speed, clients=clients,
+            max_retries=max_retries, timeout_s=timeout_s,
+            token_read_delay_s=token_read_delay_s,
+            fallback_shape=fallback_shape)
+        if report_dir is None:
+            report_dir = os.environ.get(ENV_GAMEDAY_REPORT_DIR) or None
+        self.report_dir = report_dir
+        self.scrape_urls = list(scrape_urls or [self.base_url])
+        self.incident_urls = list(incident_urls or [self.base_url])
+        self.report: Optional[dict] = None
+
+    @classmethod
+    def from_script(cls, script: dict, *, base_url: str, trace: dict,
+                    hooks: Optional[Dict[str, Callable]] = None,
+                    **overrides) -> "GameDay":
+        """Build a drill from the declarative JSON grammar::
+
+            {"name": "evacuate-b2",
+             "speed": 10, "clients": 8,
+             "acts": [
+               {"at_s": 1.0, "kind": "fault",
+                "spec": "serving.latency@1x40:0.05"},
+               {"at_s": 2.5, "kind": "kill", "hook": "kill-b2"},
+               {"at_s": 4.0, "kind": "drain", "backend": "b1"}],
+             "gates": [
+               {"kind": "critical_failures", "max_count": 0},
+               {"kind": "availability", "min_ratio": 0.95},
+               {"kind": "mttr", "max_s": 5.0}]}
+
+        ``hooks`` binds the non-serializable acts: an act with
+        ``"hook": "kill-b2"`` fires ``hooks["kill-b2"]()``."""
+        hooks = hooks or {}
+        acts = []
+        for a in script.get("acts", []):
+            a = dict(a)
+            hook = a.pop("hook", None)
+            if hook is not None:
+                if hook not in hooks:
+                    raise ValueError(f"script act references unbound "
+                                     f"hook {hook!r}")
+                a["fn"] = hooks[hook]
+            acts.append(a)
+        kwargs = {"name": script.get("name", "gameday"),
+                  "speed": script.get("speed"),
+                  "clients": script.get("clients"),
+                  "acts": acts, "gates": script.get("gates", [])}
+        kwargs.update(overrides)
+        return cls(base_url, trace, **kwargs)
+
+    @staticmethod
+    def _coerce_act(a) -> Act:
+        if isinstance(a, Act):
+            return a
+        a = dict(a)
+        return Act(a.pop("at_s"), a.pop("kind"), **a)
+
+    @staticmethod
+    def _coerce_gate(g) -> Gate:
+        if isinstance(g, Gate):
+            return g
+        g = dict(g)
+        return Gate(g.pop("kind"), **g)
+
+    def run(self) -> dict:
+        """Execute the drill; returns (and stores) the report dict."""
+        record_event("gameday.start", name=self.name,
+                     target=self.base_url, acts=len(self.acts),
+                     gates=len(self.gates),
+                     rows=len(self.trace["rows"]),
+                     speed=self.driver.speed)
+        t_wall0 = time.time()
+        self.driver.start()
+        t0 = self.driver.t_run0
+        m = _gameday_metrics_or_none()
+        for act in self.acts:
+            wait = t0 + act.at_s - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            act.fire(self.base_url)
+            record_event("gameday.act", name=self.name, act=act.name,
+                         kind=act.kind, error=act.error)
+            if m is not None:
+                m.acts_total.inc(kind=act.kind)
+        summary = self.driver.join()
+        results = summary.pop("results")
+        fleet = scrape_fleet_counters(self.scrape_urls)
+        verdicts = []
+        for gate in self.gates:
+            v = gate.evaluate(results, self.acts, fleet)
+            verdicts.append(v)
+            record_event("gameday.gate", name=self.name,
+                         gate=v["gate"], passed=v["passed"],
+                         value=v["value"])
+            if m is not None:
+                m.gates_total.inc(
+                    result="pass" if v["passed"] else "breach")
+        passed = all(v["passed"] for v in verdicts)
+        verdict = "pass" if passed else "fail"
+        incidents = fetch_incident_index(self.incident_urls)
+        # worst requests of the run: bad outcomes first, then slowest
+        worst = sorted(
+            results,
+            key=lambda r: (r["outcome"] != "ok", r["latency_s"]),
+            reverse=True)[:8]
+        client_ok = summary["ok"]
+        # two fleet views of "requests served": the backends' own
+        # counters, and — at a router target — the router's forward
+        # counter. Take the larger: a SIGKILLed backend's counters die
+        # with it, but the router survives and saw every forward, so a
+        # drill that kills a backend still reconciles
+        backend_served = sum(
+            fleet.get(n, 0.0) for n in ("serving_requests_total",
+                                        "generation_requests_total"))
+        fleet_served = max(backend_served,
+                           fleet.get("router_requests_total", 0.0))
+        report = {
+            "name": self.name,
+            "verdict": verdict,
+            "target": self.base_url,
+            "started_at": t_wall0,
+            "trace": {"rows": len(self.trace["rows"]),
+                      "duration_s": self.trace.get("duration_s")},
+            "replay": summary,
+            "acts": [a.describe() for a in self.acts],
+            "gates": verdicts,
+            "worst_requests": worst,
+            "incidents": incidents,
+            "reconciliation": {
+                # the fleet must account for at least every success a
+                # client observed (retries make fleet >= client); a
+                # shortfall means the telemetry plane dropped traffic
+                "client_ok": client_ok,
+                "client_requests": summary["requests"],
+                "fleet_served_total": fleet_served,
+                "fleet_counters": fleet,
+                "consistent": fleet_served >= client_ok,
+            },
+        }
+        self.report = report
+        if m is not None:
+            m.runs_total.inc(verdict=verdict)
+        path = self._write_report(report, t_wall0)
+        record_event("gameday.report", name=self.name, verdict=verdict,
+                     path=path,
+                     breaches=sum(1 for v in verdicts
+                                  if not v["passed"]))
+        record_event("gameday.complete", name=self.name, verdict=verdict,
+                     requests=summary["requests"],
+                     availability=summary["availability"])
+        return report
+
+    def _write_report(self, report: dict, t_wall0: float
+                      ) -> Optional[str]:
+        if not self.report_dir:
+            return None
+        try:
+            os.makedirs(self.report_dir, exist_ok=True)
+            path = os.path.join(
+                self.report_dir,
+                f"{self.name}-{int(t_wall0)}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1, default=str)
+            return path
+        except Exception:  # noqa: BLE001 — artifact IO never fails a run
+            return None
+
+
+__all__ = [
+    "ACT_KINDS",
+    "ENV_GAMEDAY_REPORT_DIR",
+    "GATE_KINDS",
+    "Act",
+    "GameDay",
+    "GameDayMetrics",
+    "Gate",
+    "fetch_incident_index",
+    "get_gameday_metrics",
+    "scrape_fleet_counters",
+]

@@ -1,0 +1,698 @@
+"""Traffic replay: recorded ledger traces → open-loop load generation.
+
+The request ledger (observability/reqlog.py) records what production
+traffic actually looked like; this module turns that recording into a
+repeatable experiment. ``RequestLedger.export_trace()`` (and ``GET
+/debug/requests?format=trace`` / the fleet-wide aggregator variant)
+produces a **trace**: payload-scrubbed rows of ``{plane, model,
+arrival_offset_s, priority, tenant, payload_shape, deadline_s,
+stream}`` — shapes only, never bytes. :class:`ReplayDriver` replays a
+trace against a live ``ModelServer`` or ``FleetRouter`` URL:
+
+- **open loop**: a dispatcher thread releases each request at its
+  recorded arrival time divided by ``speed`` (1x–20x), regardless of
+  whether earlier requests finished — offered load is faithful to the
+  recording, so an overloaded target queues/sheds exactly as the real
+  fleet would (a closed-loop generator would politely back off and
+  hide the overload);
+- **both planes**: predict rows synthesize zero inputs from
+  ``payload_shape``; generation rows synthesize a prompt of
+  ``payload_shape[0]`` tokens and replay through the recorded wire
+  mode — streamed rows drain the chunked ndjson token stream
+  (``token_read_delay_s`` makes the driver a deliberately SLOW client
+  to exercise server-side stream backpressure), non-streamed rows
+  collect;
+- **client-side ledger**: every replayed request lands one result row
+  (outcome, status, latency, send lag, attempts) — the game-day gates
+  (resilience/gameday.py) are judged from THIS ledger and then
+  cross-checked against the fleet's own federated metrics.
+
+Scenario synthesizers warp a trace without touching the target:
+:func:`warp_zipf_tenants` (skewed multi-tenant contention),
+:func:`warp_diurnal` (sinusoidal rate ramp), :func:`warp_flash_crowd`
+(compressed burst window), :func:`warp_duplicate_burst` (repeat
+identical requests — the cache tier's hit path under replay). All are
+deterministic under a fixed seed. :func:`synthesize_trace` builds a
+trace from a spec when no ledger recording exists.
+
+Knobs: ``DL4J_TPU_REPLAY_SPEED`` (default speed multiplier when the
+driver isn't given one) and ``DL4J_TPU_REPLAY_CLIENTS`` (default
+client-thread count). Metrics: ``replay_requests_total{plane,
+outcome}``, ``replay_retries_total``, ``replay_send_lag_seconds``,
+``replay_latency_seconds{plane}``, ``replay_in_flight``,
+``replay_runs_total``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import queue
+import random
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability import reqlog as _reqlog
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.serving.client import ServingClient
+from deeplearning4j_tpu.serving.errors import (
+    ConnectionFailedError,
+    DeadlineExceededError,
+    NotReadyError,
+    QueueFullError,
+    ServingError,
+    TenantQuotaError,
+)
+
+ENV_REPLAY_SPEED = "DL4J_TPU_REPLAY_SPEED"
+ENV_REPLAY_CLIENTS = "DL4J_TPU_REPLAY_CLIENTS"
+
+MAX_SPEED = 20.0
+
+# the client-side outcome vocabulary: what the driver's ledger records
+# per replayed request (a bounded metric label set, like reqlog's)
+CLIENT_OUTCOMES = ("ok", "shed", "unavailable", "deadline", "rejected",
+                   "error")
+
+
+class ReplayMetrics:
+    """The replay driver's exposition families (process default
+    registry, ReqLogMetrics pattern)."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        r = registry if registry is not None else _metrics.default_registry()
+        self.registry = r
+        self.requests_total = r.counter(
+            "replay_requests_total",
+            "Trace rows replayed, by plane and client-side outcome "
+            "(ok | shed | unavailable | deadline | rejected | error).",
+            ("plane", "outcome"))
+        self.retries_total = r.counter(
+            "replay_retries_total",
+            "Client-side retry attempts spent across all replayed "
+            "requests (beyond each request's first attempt).")
+        self.send_lag_seconds = r.histogram(
+            "replay_send_lag_seconds",
+            "How late each request left the driver relative to its "
+            "ideal (speed-scaled) arrival time — open-loop fidelity; "
+            "a saturated driver shows here, not as hidden backoff.")
+        self.latency_seconds = r.histogram(
+            "replay_latency_seconds",
+            "Client-observed end-to-end latency of replayed requests "
+            "(retries included), by plane.", ("plane",))
+        self.in_flight = r.gauge(
+            "replay_in_flight",
+            "Replayed requests currently in flight in the driver.")
+        self.runs_total = r.counter(
+            "replay_runs_total",
+            "Replay driver runs completed.")
+
+
+_replay_metrics: Optional[ReplayMetrics] = None
+_rm_lock = threading.Lock()
+
+
+def get_replay_metrics() -> ReplayMetrics:
+    global _replay_metrics
+    if _replay_metrics is None:
+        with _rm_lock:
+            if _replay_metrics is None:
+                _replay_metrics = ReplayMetrics()
+    return _replay_metrics
+
+
+def _drop_replay_metrics():
+    global _replay_metrics
+    _replay_metrics = None
+
+
+_metrics.register_reset_hook(_drop_replay_metrics)
+
+
+# -- trace plumbing -----------------------------------------------------------
+
+
+def validate_trace(trace: dict) -> dict:
+    """Structural check for a trace document (version, row fields);
+    returns the trace for chaining, raises ValueError on junk."""
+    if not isinstance(trace, dict) or trace.get("kind") != "dl4j_tpu_trace":
+        raise ValueError("not a dl4j_tpu_trace document")
+    if trace.get("version") != _reqlog.TRACE_VERSION:
+        raise ValueError(
+            f"unsupported trace version {trace.get('version')!r} "
+            f"(this build replays version {_reqlog.TRACE_VERSION})")
+    rows = trace.get("rows")
+    if not isinstance(rows, list):
+        raise ValueError("trace has no rows list")
+    last = -1.0
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            raise ValueError(f"row {i} is not an object")
+        off = row.get("arrival_offset_s")
+        if not isinstance(off, (int, float)) or off < 0:
+            raise ValueError(f"row {i} has bad arrival_offset_s {off!r}")
+        if off < last:
+            raise ValueError(f"row {i} arrives before row {i - 1} "
+                             "(rows must be arrival-ordered)")
+        last = off
+        if row.get("plane") not in ("predict", "generation"):
+            raise ValueError(f"row {i} has unknown plane "
+                             f"{row.get('plane')!r}")
+        if not row.get("model"):
+            raise ValueError(f"row {i} has no model")
+    return trace
+
+
+def load_trace(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return validate_trace(json.load(f))
+
+
+def save_trace(trace: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(trace, f, indent=1)
+
+
+def _rebuild(trace: dict, rows: List[dict]) -> dict:
+    rows = sorted(rows, key=lambda r: r["arrival_offset_s"])
+    out = dict(trace)
+    out["rows"] = rows
+    out["count"] = len(rows)
+    out["duration_s"] = (round(rows[-1]["arrival_offset_s"], 6)
+                         if rows else 0.0)
+    return out
+
+
+def synthesize_trace(spec: dict) -> dict:
+    """Build a trace from a workload spec when no ledger recording
+    exists. Deterministic under ``spec["seed"]``.
+
+    Spec keys: ``n`` (row count), ``rate_rps`` (Poisson arrival rate),
+    ``models`` (list of ``{name, plane, weight?, payload_shape?,
+    prompt_len?, max_new_tokens?, stream?, deadline_s?}``),
+    ``priorities`` (``{class: weight}``, default all-normal),
+    ``tenants`` (tenant-name list, uniform pick; use
+    :func:`warp_zipf_tenants` for skew), ``seed``."""
+    rng = random.Random(spec.get("seed", 0))
+    n = int(spec.get("n", 64))
+    rate = float(spec.get("rate_rps", 8.0))
+    models = spec.get("models") or [
+        {"name": "model", "plane": "predict", "payload_shape": [1, 4]}]
+    weights = [float(m.get("weight", 1.0)) for m in models]
+    prios = spec.get("priorities") or {"normal": 1.0}
+    prio_names = sorted(prios)
+    prio_weights = [float(prios[p]) for p in prio_names]
+    tenants = spec.get("tenants") or [None]
+    rows: List[dict] = []
+    t = 0.0
+    for _ in range(n):
+        m = rng.choices(models, weights=weights)[0]
+        plane = m.get("plane", "predict")
+        if plane == "generation":
+            shape = [int(m.get("prompt_len", 8))]
+        else:
+            shape = m.get("payload_shape") or [1, 4]
+        row = {"plane": plane, "model": m["name"],
+               "arrival_offset_s": round(t, 6),
+               "priority": rng.choices(prio_names,
+                                       weights=prio_weights)[0],
+               "tenant": rng.choice(tenants),
+               "payload_shape": shape,
+               "deadline_s": m.get("deadline_s",
+                                   spec.get("deadline_s")),
+               "stream": bool(m.get("stream", False))}
+        if plane == "generation":
+            row["max_new_tokens"] = int(m.get("max_new_tokens", 4))
+        rows.append(row)
+        t += rng.expovariate(rate)
+    trace = {"version": _reqlog.TRACE_VERSION, "kind": "dl4j_tpu_trace",
+             "t0_wall": None, "count": 0, "duration_s": 0.0, "rows": []}
+    return validate_trace(_rebuild(trace, rows))
+
+
+# -- scenario warps (pure; deterministic under a fixed seed) ------------------
+
+
+def warp_zipf_tenants(trace: dict, *, n_tenants: int = 8, s: float = 1.2,
+                      seed: int = 0) -> dict:
+    """Reassign every row's tenant by a Zipf(s) draw over
+    ``tenant-0..tenant-{n-1}`` — the skewed multi-tenant contention
+    scenario (one hot tenant burning the quota ladder while the tail
+    starves)."""
+    if n_tenants < 1:
+        raise ValueError("n_tenants must be >= 1")
+    rng = random.Random(seed)
+    weights = [1.0 / (k ** s) for k in range(1, n_tenants + 1)]
+    names = [f"tenant-{k}" for k in range(n_tenants)]
+    rows = []
+    for row in trace["rows"]:
+        r = dict(row)
+        r["tenant"] = rng.choices(names, weights=weights)[0]
+        rows.append(r)
+    return _rebuild(trace, rows)
+
+
+def warp_diurnal(trace: dict, *, period_s: Optional[float] = None,
+                 depth: float = 0.5) -> dict:
+    """Re-time arrivals through a sinusoidal rate profile: the
+    instantaneous rate swings between ``(1 - depth)`` and
+    ``(1 + depth)`` of the original across one period (default: the
+    trace duration) — the diurnal ramp scenario, compressed to replay
+    length. Deterministic (no randomness: gaps are rescaled by the
+    local rate)."""
+    if not 0.0 <= depth < 1.0:
+        raise ValueError("depth must be in [0, 1)")
+    rows = [dict(r) for r in trace["rows"]]
+    if len(rows) < 2:
+        return _rebuild(trace, rows)
+    period = float(period_s or max(trace.get("duration_s") or 0.0, 1e-6))
+    t_new = rows[0]["arrival_offset_s"]
+    prev = rows[0]["arrival_offset_s"]
+    rows[0]["arrival_offset_s"] = round(t_new, 6)
+    for row in rows[1:]:
+        gap = row["arrival_offset_s"] - prev
+        prev = row["arrival_offset_s"]
+        # rate high → gaps shrink; rate low → gaps stretch
+        rate = 1.0 + depth * math.sin(2.0 * math.pi * prev / period)
+        t_new += gap / max(rate, 1e-6)
+        row["arrival_offset_s"] = round(t_new, 6)
+    return _rebuild(trace, rows)
+
+
+def warp_flash_crowd(trace: dict, *, at_frac: float = 0.5,
+                     width_frac: float = 0.2,
+                     magnitude: float = 5.0) -> dict:
+    """Compress the arrival gaps inside a window (centered at
+    ``at_frac`` of the trace, ``width_frac`` wide) by ``magnitude`` —
+    the flash-crowd scenario: the same requests, arriving in a spike.
+    Deterministic."""
+    if magnitude <= 0:
+        raise ValueError("magnitude must be > 0")
+    dur = max(trace.get("duration_s") or 0.0, 1e-6)
+    lo = (at_frac - width_frac / 2.0) * dur
+    hi = (at_frac + width_frac / 2.0) * dur
+    rows = [dict(r) for r in trace["rows"]]
+    if len(rows) < 2:
+        return _rebuild(trace, rows)
+    t_new = rows[0]["arrival_offset_s"]
+    prev = rows[0]["arrival_offset_s"]
+    rows[0]["arrival_offset_s"] = round(t_new, 6)
+    for row in rows[1:]:
+        gap = row["arrival_offset_s"] - prev
+        prev = row["arrival_offset_s"]
+        if lo <= prev <= hi:
+            gap /= magnitude
+        t_new += gap
+        row["arrival_offset_s"] = round(t_new, 6)
+    return _rebuild(trace, rows)
+
+
+def warp_duplicate_burst(trace: dict, *, frac: float = 0.25,
+                         copies: int = 2, lag_s: float = 0.05,
+                         seed: int = 0) -> dict:
+    """Append ``copies`` duplicates of a random ``frac`` of rows,
+    each arriving ``lag_s`` after its original — identical model/
+    tenant/shape, so the response-cache tier sees a hit-heavy replay
+    (duplicates of cacheable predicts should be absorbed without
+    touching a batch slot)."""
+    if not 0.0 <= frac <= 1.0:
+        raise ValueError("frac must be in [0, 1]")
+    rng = random.Random(seed)
+    rows = [dict(r) for r in trace["rows"]]
+    extra: List[dict] = []
+    for row in rows:
+        if rng.random() < frac:
+            for c in range(1, copies + 1):
+                dup = dict(row)
+                dup["arrival_offset_s"] = round(
+                    row["arrival_offset_s"] + lag_s * c, 6)
+                extra.append(dup)
+    return _rebuild(trace, rows + extra)
+
+
+# -- outcome classification ---------------------------------------------------
+
+
+def _classify(err: ServingError) -> str:
+    if isinstance(err, (QueueFullError, TenantQuotaError)):
+        return "shed"
+    if isinstance(err, (NotReadyError, ConnectionFailedError)):
+        return "unavailable"
+    if isinstance(err, DeadlineExceededError):
+        return "deadline"
+    status = getattr(err, "http_status", 500)
+    if status in (400, 404):
+        return "rejected"
+    return "error"
+
+
+def summarize(results: Sequence[dict], *,
+              slo_availability: float = 0.99) -> dict:
+    """Gate-ready rollup of a driver's client-side ledger: counts by
+    outcome, goodput, availability (ok / total), latency percentiles,
+    open-loop send-lag fidelity, and the critical-class failures list
+    (``priority == "critical"`` rows whose outcome isn't ok — the
+    zero-tolerance gate input)."""
+    total = len(results)
+    by_outcome: dict = {}
+    for r in results:
+        by_outcome[r["outcome"]] = by_outcome.get(r["outcome"], 0) + 1
+    ok = by_outcome.get("ok", 0)
+    lat = sorted(r["latency_s"] for r in results if r["outcome"] == "ok")
+
+    def pct(p: float) -> Optional[float]:
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1,
+                             int(math.ceil(p * len(lat))) - 1)], 6)
+
+    t0 = min((r["t_send"] for r in results), default=0.0)
+    t1 = max((r["t_done"] for r in results), default=0.0)
+    dur = max(t1 - t0, 1e-9)
+    critical = [r for r in results
+                if r.get("priority") == "critical"
+                and r["outcome"] != "ok"]
+    return {
+        "requests": total,
+        "by_outcome": by_outcome,
+        "ok": ok,
+        "availability": round(ok / total, 6) if total else None,
+        "meets_slo": (ok / total >= slo_availability) if total else None,
+        "goodput_rps": round(ok / dur, 3) if total else 0.0,
+        "duration_s": round(dur, 3) if total else 0.0,
+        "latency_p50_s": pct(0.50),
+        "latency_p99_s": pct(0.99),
+        "max_send_lag_s": round(max((r["send_lag_s"] for r in results),
+                                    default=0.0), 6),
+        "retries": sum(r.get("attempts", 1) - 1 for r in results),
+        "critical_failures": critical,
+    }
+
+
+def first_success_after(results: Sequence[dict],
+                        t: float) -> Optional[float]:
+    """Seconds from ``t`` (monotonic, ``time.monotonic()`` domain) to
+    the first client-observed success completing after it — the MTTR
+    measurement a kill act's gate uses. None when nothing succeeded
+    after ``t``."""
+    times = [r["t_done"] for r in results
+             if r["outcome"] == "ok" and r["t_done"] >= t]
+    if not times:
+        return None
+    return min(times) - t
+
+
+# -- the driver ---------------------------------------------------------------
+
+
+def _synth_inputs(shape, fallback):
+    """Zero inputs matching a trace row's payload_shape descriptor
+    (list shape or {name: shape} dict); payload bytes were scrubbed at
+    export, so zeros stand in — the compiled shapes, bucketing, and
+    batching behave identically."""
+    if shape is None:
+        shape = fallback
+    if shape is None:
+        raise ValueError("row has no payload_shape and the driver has "
+                         "no fallback_shape")
+
+    def zeros(s):
+        out = 0.0
+        for dim in reversed([int(d) for d in s]):
+            out = [out] * dim
+        return out
+
+    if isinstance(shape, dict):
+        return {k: zeros(v) for k, v in shape.items()}
+    return zeros(shape)
+
+
+class ReplayDriver:
+    """Open-loop, arrival-time-faithful replay of one trace against a
+    ``ModelServer`` or ``FleetRouter`` base URL.
+
+    A dispatcher thread releases rows at ``arrival_offset_s / speed``;
+    ``clients`` worker threads execute them (an unbounded handoff
+    queue keeps the dispatcher from ever blocking on a slow target —
+    lateness is *measured* as ``send_lag_s``, never silently
+    introduced). Results land in ``self.results``; :meth:`run` returns
+    ``summarize(self.results)`` with the rows attached."""
+
+    def __init__(self, base_url: str, trace: dict, *,
+                 speed: Optional[float] = None,
+                 clients: Optional[int] = None,
+                 max_retries: int = 3,
+                 timeout_s: float = 30.0,
+                 token_read_delay_s: float = 0.0,
+                 fallback_shape=None,
+                 retry_seed: int = 0,
+                 on_result: Optional[Callable[[dict], None]] = None):
+        validate_trace(trace)
+        self.base_url = base_url.rstrip("/")
+        self.trace = trace
+        if speed is None:
+            speed = float(os.environ.get(ENV_REPLAY_SPEED) or 1.0)
+        if not 0.0 < speed <= MAX_SPEED:
+            raise ValueError(
+                f"speed must be in (0, {MAX_SPEED:g}], got {speed}")
+        self.speed = float(speed)
+        if clients is None:
+            clients = int(os.environ.get(ENV_REPLAY_CLIENTS) or 4)
+        if clients < 1:
+            raise ValueError(f"clients must be >= 1, got {clients}")
+        self.clients = int(clients)
+        self.max_retries = int(max_retries)
+        self.timeout_s = float(timeout_s)
+        self.token_read_delay_s = float(token_read_delay_s)
+        self.fallback_shape = fallback_shape
+        self.retry_seed = int(retry_seed)
+        self.on_result = on_result
+        self.results: List[dict] = []
+        self._results_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._queue: "queue.Queue" = queue.Queue()
+        self.t_run0: Optional[float] = None  # monotonic start of replay
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplayDriver":
+        """Launch dispatcher + workers without blocking (the game-day
+        runner fires acts while this replays); :meth:`join` collects."""
+        if self._threads:
+            raise RuntimeError("driver already started")
+        self.t_run0 = time.monotonic()
+        record_event("replay.start", target=self.base_url,
+                     rows=len(self.trace["rows"]), speed=self.speed,
+                     clients=self.clients)
+        for i in range(self.clients):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 name=f"replay-client-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        disp = threading.Thread(target=self._dispatch,
+                                name="replay-dispatch", daemon=True)
+        disp.start()
+        self._threads.append(disp)
+        return self
+
+    def abort(self) -> None:
+        """Stop dispatching further rows (in-flight requests finish);
+        the game-day runner calls this when a gate hard-fails."""
+        self._stop.set()
+
+    def join(self, timeout_s: Optional[float] = None) -> dict:
+        """Wait for the replay to finish and return the summary."""
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        for t in self._threads:
+            left = None
+            if deadline is not None:
+                left = max(0.0, deadline - time.monotonic())
+            t.join(left)
+        self._threads = []
+        with self._results_lock:
+            results = sorted(self.results, key=lambda r: r["idx"])
+        summary = summarize(results)
+        summary["speed"] = self.speed
+        summary["clients"] = self.clients
+        summary["target"] = self.base_url
+        summary["results"] = results
+        m = _replay_metrics_or_none()
+        if m is not None:
+            m.runs_total.inc()
+        record_event("replay.complete", target=self.base_url,
+                     requests=summary["requests"], ok=summary["ok"],
+                     availability=summary["availability"],
+                     p99_s=summary["latency_p99_s"])
+        return summary
+
+    def run(self) -> dict:
+        """Blocking replay: start + join."""
+        self.start()
+        return self.join()
+
+    # -- internals ---------------------------------------------------------
+
+    def _dispatch(self):
+        t0 = self.t_run0
+        for idx, row in enumerate(self.trace["rows"]):
+            if self._stop.is_set():
+                break
+            ideal = t0 + row["arrival_offset_s"] / self.speed
+            while True:
+                lead = ideal - time.monotonic()
+                if lead <= 0:
+                    break
+                if self._stop.wait(min(lead, 0.05)):
+                    break
+            if self._stop.is_set():
+                break
+            self._queue.put((idx, row, ideal))
+        for _ in range(self.clients):
+            self._queue.put(None)
+
+    def _worker(self, worker_idx: int):
+        client = ServingClient(
+            self.base_url, timeout=self.timeout_s,
+            max_retries=self.max_retries,
+            retry_seed=self.retry_seed * 1009 + worker_idx)
+        m = _replay_metrics_or_none()
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            idx, row, ideal = item
+            if m is not None:
+                m.in_flight.inc()
+            try:
+                res = self._execute(client, idx, row, ideal)
+            finally:
+                if m is not None:
+                    m.in_flight.dec()
+            if m is not None:
+                m.requests_total.inc(plane=row["plane"],
+                                     outcome=res["outcome"])
+                m.send_lag_seconds.observe(res["send_lag_s"])
+                if res["outcome"] == "ok":
+                    m.latency_seconds.observe(res["latency_s"],
+                                              plane=row["plane"])
+                if res.get("attempts", 1) > 1:
+                    m.retries_total.inc(res["attempts"] - 1)
+            with self._results_lock:
+                self.results.append(res)
+            if self.on_result is not None:
+                try:
+                    self.on_result(res)
+                except Exception:  # noqa: BLE001 — observer never kills
+                    pass
+
+    def _execute(self, client: ServingClient, idx: int, row: dict,
+                 ideal: float) -> dict:
+        t_send = time.monotonic()
+        cid = f"replay-{idx}"
+        deadline_ms = (float(row["deadline_s"]) * 1000.0
+                       if row.get("deadline_s") else None)
+        outcome, status, tokens, attempts, error = "ok", 200, 0, 1, None
+        try:
+            if row["plane"] == "generation":
+                attempts, tokens = self._do_generate(client, row, cid,
+                                                     deadline_ms)
+            else:
+                inputs = _synth_inputs(row.get("payload_shape"),
+                                       self.fallback_shape)
+                client.predict(row["model"], inputs,
+                               deadline_ms=deadline_ms,
+                               correlation_id=cid,
+                               priority=row.get("priority"),
+                               tenant=row.get("tenant"))
+        except ServingError as e:
+            outcome = _classify(e)
+            status = getattr(e, "http_status", 500)
+            error = f"{type(e).__name__}: {e}"[:200]
+        except Exception as e:  # noqa: BLE001 — one row, not the run
+            outcome, status = "error", 500
+            error = f"{type(e).__name__}: {e}"[:200]
+        t_done = time.monotonic()
+        return {"idx": idx, "cid": cid, "plane": row["plane"],
+                "model": row["model"], "priority": row.get("priority"),
+                "tenant": row.get("tenant"), "outcome": outcome,
+                "status": status, "latency_s": round(t_done - t_send, 6),
+                "t_send": t_send, "t_done": t_done,
+                "send_lag_s": round(max(0.0, t_send - ideal), 6),
+                "tokens": tokens, "attempts": attempts, "error": error}
+
+    def _do_generate(self, client: ServingClient, row: dict, cid: str,
+                     deadline_ms):
+        shape = row.get("payload_shape") or [8]
+        prompt_len = max(1, int(shape[0]) if shape else 8)
+        prompt = [1] * prompt_len
+        mnt = row.get("max_new_tokens")
+        if not row.get("stream"):
+            res = client.generate_tokens(
+                row["model"], prompt, max_new_tokens=mnt,
+                deadline_ms=deadline_ms, correlation_id=cid,
+                priority=row.get("priority"), tenant=row.get("tenant"))
+            return 1, len(res.get("tokens", []))
+        # streaming: the client's retry policy cannot apply to a
+        # generator (tokens cannot be un-yielded), so the driver
+        # retries WHOLE streams on retryable sheds/preemptions —
+        # discarded tokens are fine, replay measures the serving path
+        attempts = 0
+        delay = 0.05
+        while True:
+            attempts += 1
+            tokens = 0
+            try:
+                for _tok in client.generate(
+                        row["model"], prompt, max_new_tokens=mnt,
+                        deadline_ms=deadline_ms, correlation_id=cid,
+                        priority=row.get("priority"),
+                        tenant=row.get("tenant")):
+                    tokens += 1
+                    if self.token_read_delay_s > 0:
+                        # the deliberately slow client: server-side
+                        # stream backpressure is part of the replay
+                        time.sleep(self.token_read_delay_s)
+                return attempts, tokens
+            except ServingError as e:
+                if not getattr(e, "retryable", False) \
+                        or attempts > self.max_retries:
+                    raise
+                ra = getattr(e, "retry_after_ms", None)
+                wait = max(delay, float(ra) / 1000.0 if ra else 0.0)
+                time.sleep(min(wait, 2.0))
+                delay = min(delay * 2.0, 2.0)
+
+
+def _replay_metrics_or_none() -> Optional[ReplayMetrics]:
+    try:
+        if not _metrics.enabled():
+            return None
+        return get_replay_metrics()
+    except Exception:  # noqa: BLE001 — metrics never fail the driver
+        return None
+
+
+__all__ = [
+    "CLIENT_OUTCOMES",
+    "ENV_REPLAY_CLIENTS",
+    "ENV_REPLAY_SPEED",
+    "MAX_SPEED",
+    "ReplayDriver",
+    "ReplayMetrics",
+    "first_success_after",
+    "get_replay_metrics",
+    "load_trace",
+    "save_trace",
+    "summarize",
+    "synthesize_trace",
+    "validate_trace",
+    "warp_diurnal",
+    "warp_duplicate_burst",
+    "warp_flash_crowd",
+    "warp_zipf_tenants",
+]

@@ -757,7 +757,9 @@ class GenerationEngine:
             rec = led.begin(
                 cid, plane="generation", model=self.name,
                 priority=priority, tenant=tenant,
-                prompt_len=req.prompt_len, admission="admitted",
+                prompt_len=req.prompt_len,
+                max_new_tokens=int(max_new_tokens),
+                admission="admitted",
                 req=req.id) if led is not None else None
             req.traced = rec is not None
             # priority-ordered insert, FIFO within a class

@@ -206,6 +206,19 @@ _SHED_REASONS = {
 _MAX_TENANT_LEN = 128
 
 
+def _payload_shape(features):
+    """Shape descriptor for the ledger/trace-export plane: a list of
+    ints for a single array, ``{name: shape}`` for dict features, None
+    when the pytree is anything fancier — shapes only, never values."""
+    try:
+        if isinstance(features, dict):
+            return {str(k): list(np.asarray(v).shape)
+                    for k, v in features.items()}
+        return list(np.asarray(features).shape)
+    except Exception:  # noqa: BLE001 — telemetry never fails serving
+        return None
+
+
 class _CachedResponse(Exception):
     """Internal short-circuit: raised inside handle_predict's try block
     when the response cache answers, caught before the ServingError
@@ -493,10 +506,22 @@ class ModelServer:
                         min_latency_ms = (float(q["min_latency_ms"][0])
                                           if "min_latency_ms" in q else None)
                         limit = int(q.get("limit", ["100"])[0])
+                        window_s = (float(q["window_s"][0])
+                                    if "window_s" in q else None)
                     except ValueError:
                         self._send(400, BadRequestError(
-                            "min_latency_ms and limit must be "
-                            "numbers").to_json())
+                            "min_latency_ms, window_s and limit must "
+                            "be numbers").to_json())
+                        return
+                    if q.get("format", [None])[0] == "trace":
+                        # payload-scrubbed replayable trace of the
+                        # ledger window (resilience/replay.py consumes
+                        # this directly)
+                        self._send(200, server.render_trace(
+                            plane=q.get("plane", [None])[0],
+                            model=q.get("model", [None])[0],
+                            window_s=window_s,
+                            limit=(limit if "limit" in q else None)))
                         return
                     self._send(200, server.render_requests(
                         outcome=q.get("outcome", [None])[0],
@@ -847,6 +872,11 @@ class ModelServer:
                 deadline = time.monotonic() + timeout
                 try:
                     features = entry.parse_inputs(payload["inputs"])
+                    if led is not None:
+                        # shape, never bytes: this is what export_trace
+                        # ships and what replay synthesizes inputs from
+                        led.annotate(cid,
+                                     payload_shape=_payload_shape(features))
                     tctx = ((cid, req_span.span_id)
                             if req_span is not None else None)
                     try:
@@ -1144,8 +1174,11 @@ class ModelServer:
                     # BEFORE submit: the scheduler may finish (preempt,
                     # fail) the stream the instant it exists, and the
                     # deadline must already be on the record for the
-                    # finish path's deadline-slack computation
-                    self.reqlog.annotate(cid, deadline_s=timeout)
+                    # finish path's deadline-slack computation. The
+                    # stream flag rides along so export_trace replays
+                    # this request through the same wire mode.
+                    self.reqlog.annotate(cid, deadline_s=timeout,
+                                         stream=bool(stream_mode))
                 handle = engine.submit(
                     payload["prompt"], max_new_tokens=mnt,
                     temperature=temp, eos_id=eos, priority=prio,
@@ -1350,6 +1383,16 @@ class ModelServer:
         """One request by correlation id: ledger record + retained span
         tree (Chrome-format included); None when unknown."""
         return _reqlog.request_detail(cid)
+
+    def render_trace(self, *, plane=None, model=None, window_s=None,
+                     limit=None) -> dict:
+        """The ledger window as a replayable payload-scrubbed trace
+        (``GET /debug/requests?format=trace``)."""
+        ledger = self.reqlog
+        if ledger is None:
+            return _reqlog.trace_from_records([])
+        return ledger.export_trace(plane=plane, model=model,
+                                   window_s=window_s, limit=limit)
 
     def render_incidents(self) -> dict:
         """The incident-bundle index + current detector verdicts (the

@@ -34,6 +34,46 @@ def rng():
     return np.random.default_rng(0)
 
 
+# -- shared mixed predict+generation server ------------------------------------
+#
+# ONE tiny-GPT engine + one batched predict model behind one ModelServer,
+# compiled once per module and shared by every test in that module. The
+# replay/game-day modules both ride this instead of each compiling their
+# own fleet (the PR 6/7 budget pattern, hoisted to conftest so the
+# fixture exists exactly once).
+
+
+@pytest.fixture(scope="module")
+def mixed_server():
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.gpt import gpt_tiny
+    from deeplearning4j_tpu.serving import (
+        GenerationEngine,
+        ModelRegistry,
+        ModelServer,
+        spec,
+    )
+
+    def fwd(v, x):
+        return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+    reg = ModelRegistry()
+    reg.register("scale", fwd, {"scale": 2.0}, input_spec=spec((4,)),
+                 mode="batched", max_batch_size=8,
+                 devices=jax.devices()[:1])
+    model = gpt_tiny()
+    eng = GenerationEngine(
+        model, model.init(seed=0), name="gpt", num_slots=2, max_len=32,
+        max_new_tokens=24, min_kv_bucket=8, min_prompt_bucket=8,
+        idle_wait_s=0.002, temperature=0.0, max_waiting=16, seed=0)
+    srv = ModelServer(reg, port=0, sentinel=False,
+                      generators={"gpt": eng})
+    srv.start(warm=True)
+    yield srv
+    srv.stop()
+
+
 # -- session thread-leak guard ------------------------------------------------
 #
 # Exporter/prober/evaluator shutdown bugs historically leaked non-daemon

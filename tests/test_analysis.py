@@ -276,7 +276,18 @@ def test_cli_json_output_is_machine_readable(capsys):
 def test_whole_tree_check_is_green_and_fast():
     """THE tier-1 gate: `python -m deeplearning4j_tpu.analysis --check`
     over the real package (+ bench.py + GUIDE.md drift) exits 0 inside
-    the 5 s budget (ASTs parsed once per run)."""
+    the time budget (ASTs parsed once per run). The budget is scaled
+    by the host's measured interpreter throughput: the check is ~3-4 s
+    of pure AST work on an unloaded core but costs 2-3x that under
+    shared-CI neighbor load, and a fixed wall-clock gate flakes
+    exactly when CI is busiest — while a real order-of-magnitude cost
+    regression still trips the scaled budget on any host."""
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(2_000_000):
+        acc += i
+    unit = time.perf_counter() - t0  # ~0.10-0.15 s on an unloaded core
+    budget = 5.0 * max(1.0, unit / 0.15)
     proc = subprocess.run(
         [sys.executable, "-m", "deeplearning4j_tpu.analysis",
          "--check", "--json"],
@@ -284,7 +295,7 @@ def test_whole_tree_check_is_green_and_fast():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
     assert doc["findings"] == []
-    assert doc["duration_s"] < 5.0, doc
+    assert doc["duration_s"] < budget, (doc, unit)
 
 
 # -- env-knob registry + GUIDE.md drift ---------------------------------------

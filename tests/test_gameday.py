@@ -1,0 +1,475 @@
+"""Scripted game-days (PR 17): the fast single-server drill matrix
+(fault act + gate table + report artifact + client-vs-fleet
+reconciliation + metrics/flight trail, failing gates, the JSON script
+grammar with hook binding) and THE slow acceptance: a ledger-recorded
+mixed predict+generate trace replayed at 10x against a 3-subprocess-
+backend router fleet while one backend is SIGKILLed and
+``serving.latency`` fires on a survivor — zero critical-class failures,
+every gate green, and the report artifact carries the survivor's
+incident bundle, the per-act verdicts, and a consistent client-vs-fleet
+reconciliation.
+
+Budget discipline: the fast drills ride the shared ``mixed_server``
+conftest fixture (tier-1 proxies for the drill semantics); only the
+slow class pays for subprocess backends.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+)
+from deeplearning4j_tpu.resilience import faults as ft
+from deeplearning4j_tpu.resilience import gameday as gd
+from deeplearning4j_tpu.resilience import replay as rp
+from deeplearning4j_tpu.serving import (
+    FleetRouter,
+    RouterPolicy,
+    ServingClient,
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Fault acts install on the PROCESS injector; never leak an armed
+    plan into the rest of the suite."""
+    yield
+    ft.set_fault_injector(ft.FaultInjector())
+
+
+def _predict_trace(n, *, rate=40.0, critical_every=4):
+    rows = []
+    t = 0.0
+    for i in range(n):
+        rows.append({
+            "plane": "predict", "model": "scale",
+            "arrival_offset_s": round(t, 6),
+            "priority": "critical" if i % critical_every == 0
+            else "normal",
+            "tenant": f"gdt-{i % 2}", "payload_shape": [1, 4],
+            "deadline_s": 20.0, "stream": False})
+        t += 1.0 / rate
+    return rp.validate_trace({
+        "version": 1, "kind": "dl4j_tpu_trace", "t0_wall": None,
+        "count": n, "duration_s": rows[-1]["arrival_offset_s"],
+        "rows": rows})
+
+
+# ---------------------------------------------------------------------------
+# fast drills against the shared in-process mixed server
+
+
+class TestGameDayFast:
+    def test_drill_with_fault_act_reports_and_reconciles(
+            self, mixed_server, tmp_path):
+        """A passing drill: mixed predict+generate replay at 10x, a
+        timed ``serving.latency`` fault act, full gate table green,
+        report artifact on disk, fleet counters reconciling with the
+        client ledger, and the ``gameday.*`` flight trail."""
+        url = f"http://127.0.0.1:{mixed_server.port}"
+        trace = rp.synthesize_trace({
+            "n": 14, "rate_rps": 30.0, "seed": 5,
+            "models": [
+                {"name": "scale", "plane": "predict",
+                 "payload_shape": [1, 4], "weight": 3.0,
+                 "deadline_s": 20.0},
+                {"name": "gpt", "plane": "generation", "prompt_len": 4,
+                 "max_new_tokens": 3, "deadline_s": 20.0}],
+            "priorities": {"critical": 1, "normal": 3},
+            "tenants": ["gd-a", "gd-b"]})
+        m = gd.get_gameday_metrics()
+        runs_before = m.runs_total.value(verdict="pass")
+        drill = gd.GameDay(
+            url, trace, name="fast-drill", speed=10.0, clients=4,
+            report_dir=str(tmp_path),
+            acts=[gd.Act(0.05, "fault",
+                         spec="serving.latency@1x3:0.02",
+                         name="latency-burst")],
+            gates=[gd.Gate("critical_failures"),
+                   gd.Gate("availability", min_ratio=0.9),
+                   gd.Gate("p99", max_s=10.0),
+                   gd.Gate("recompiles", max_count=0)])
+        report = drill.run()
+        assert report["verdict"] == "pass", report["gates"]
+        assert all(v["passed"] for v in report["gates"])
+        assert report["acts"] == [
+            {"name": "latency-burst", "kind": "fault", "at_s": 0.05,
+             "spec": "serving.latency@1x3:0.02", "backend": None,
+             "fired": True, "error": None}]
+        rec = report["reconciliation"]
+        assert rec["consistent"] is True
+        assert rec["client_requests"] == 14
+        assert rec["fleet_served_total"] >= rec["client_ok"]
+        assert "serving_requests_total" in rec["fleet_counters"]
+        # artifact on disk, loadable, same verdict
+        files = list(tmp_path.glob("fast-drill-*.json"))
+        assert len(files) == 1
+        on_disk = json.loads(files[0].read_text())
+        assert on_disk["verdict"] == "pass"
+        assert len(on_disk["gates"]) == 4
+        assert m.runs_total.value(verdict="pass") == runs_before + 1
+        kinds = {e["kind"] for e in get_flight_recorder().events(
+            kinds=("gameday.start", "gameday.act", "gameday.gate",
+                   "gameday.report", "gameday.complete"),
+            max_events=100)}
+        assert kinds == {"gameday.start", "gameday.act", "gameday.gate",
+                         "gameday.report", "gameday.complete"}
+
+    def test_breached_gate_fails_the_drill_and_counts(self,
+                                                      mixed_server):
+        url = f"http://127.0.0.1:{mixed_server.port}"
+        m = gd.get_gameday_metrics()
+        breach_before = m.gates_total.value(result="breach")
+        fail_before = m.runs_total.value(verdict="fail")
+        drill = gd.GameDay(
+            url, _predict_trace(4), name="doomed", speed=10.0,
+            clients=2,
+            gates=[gd.Gate("p99", max_s=0.0),  # unmeetable
+                   gd.Gate("availability", min_ratio=0.5)])
+        report = drill.run()
+        assert report["verdict"] == "fail"
+        by_gate = {v["gate"]: v for v in report["gates"]}
+        assert by_gate["p99"]["passed"] is False
+        assert by_gate["availability"]["passed"] is True
+        assert m.gates_total.value(result="breach") == breach_before + 1
+        assert m.runs_total.value(verdict="fail") == fail_before + 1
+        # worst requests are ranked and bounded
+        assert report["worst_requests"]
+        assert len(report["worst_requests"]) <= 8
+
+    def test_from_script_binds_hooks_and_runs_kill_gates(
+            self, mixed_server):
+        """The declarative JSON grammar: a kill act bound through a
+        named hook, an MTTR gate anchored to it, and a scoped
+        availability gate judged from the kill onward."""
+        url = f"http://127.0.0.1:{mixed_server.port}"
+        fired = []
+        script = {
+            "name": "scripted",
+            "speed": 10, "clients": 3,
+            "acts": [{"at_s": 0.0, "kind": "kill",
+                      "hook": "kill-victim", "name": "kill-victim"}],
+            "gates": [{"kind": "mttr", "max_s": 10.0},
+                      {"kind": "availability", "scope": "kill-victim",
+                       "min_ratio": 0.9,
+                       "name": "availability-after-kill"},
+                      {"kind": "critical_failures"}]}
+        drill = gd.GameDay.from_script(
+            script, base_url=url, trace=_predict_trace(20, rate=10.0),
+            hooks={"kill-victim": lambda: fired.append(True)})
+        report = drill.run()
+        assert fired == [True]
+        assert report["verdict"] == "pass", report["gates"]
+        by_gate = {v["gate"]: v for v in report["gates"]}
+        assert by_gate["mttr"]["value"] is not None
+        assert by_gate["availability-after-kill"]["scope"] == \
+            "kill-victim"
+
+    def test_from_script_rejects_unbound_hook(self, mixed_server):
+        with pytest.raises(ValueError, match="unbound hook"):
+            gd.GameDay.from_script(
+                {"acts": [{"at_s": 0.0, "kind": "kill",
+                           "hook": "nope"}]},
+                base_url="http://127.0.0.1:1", trace=_predict_trace(1))
+
+    def test_act_errors_are_reported_not_raised(self, mixed_server):
+        """A hook that blows up marks ITS act and the drill keeps
+        running — a half-executed script still yields a report."""
+        url = f"http://127.0.0.1:{mixed_server.port}"
+
+        def boom():
+            raise RuntimeError("chaos tooling fell over")
+
+        drill = gd.GameDay(
+            url, _predict_trace(4), name="act-err", speed=10.0,
+            clients=2,
+            acts=[gd.Act(0.0, "call", fn=boom, name="boom")],
+            gates=[gd.Gate("availability", min_ratio=0.9)])
+        report = drill.run()
+        (act,) = report["acts"]
+        assert act["fired"] is True
+        assert "chaos tooling fell over" in act["error"]
+        assert report["verdict"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# THE slow acceptance: recorded trace at 10x vs a subprocess router
+# fleet, one backend SIGKILLed, serving.latency firing on a survivor
+
+
+_GD_BACKEND_SCRIPT = textwrap.dedent("""
+    import sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.gpt import gpt_tiny
+    from deeplearning4j_tpu.observability import sentinel as sn
+    from deeplearning4j_tpu.serving import (GenerationEngine,
+                                            ModelRegistry, ModelServer,
+                                            spec)
+    port, scale, incident_dir = (int(sys.argv[1]), float(sys.argv[2]),
+                                 sys.argv[3])
+
+    def fwd(v, x):
+        return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+    reg = ModelRegistry()
+    reg.register("scale", fwd, {"scale": scale}, input_spec=spec((4,)),
+                 mode="batched", max_batch_size=8)
+    model = gpt_tiny()
+    eng = GenerationEngine(
+        model, model.init(seed=0), name="gpt", num_slots=2, max_len=32,
+        max_new_tokens=24, min_kv_bucket=8, min_prompt_bucket=8,
+        idle_wait_s=0.002, temperature=0.0, max_waiting=16, seed=0)
+    if incident_dir != "-":
+        # a tight absolute p99 ceiling: the injected serving.latency
+        # (0.06 s) trips it within two sentinel ticks and opens an
+        # incident bundle the router then federates
+        det = sn.Detector(
+            "p99", sn.HistogramQuantileProbe(
+                "serving_request_latency_seconds", q=0.99, min_count=1),
+            mode="ceiling", threshold=0.04, fire_after=2,
+            clear_after=10000)
+        kw = dict(sentinel=True, sentinel_detectors=[det],
+                  sentinel_interval_s=0.15, incident_dir=incident_dir)
+    else:
+        kw = dict(sentinel=False)
+    srv = ModelServer(reg, port=port, generators={"gpt": eng}, **kw)
+    srv.start(warm=True)
+    print("READY", srv.port, flush=True)
+    while True:
+        time.sleep(3600)
+""")
+
+
+def _spawn_gd_backend(port, scale, *, incident_dir=None, faults=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FAULTS", None)
+    if faults:
+        env["DL4J_TPU_FAULTS"] = faults
+    return subprocess.Popen(
+        [sys.executable, "-c", _GD_BACKEND_SCRIPT, str(port),
+         str(scale), incident_dir or "-"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def _await_ready(proc, timeout_s=180.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("READY"):
+            return True
+        if proc.poll() is not None:
+            return False
+    return False
+
+
+@pytest.fixture(scope="class")
+def gameday_fleet(tmp_path_factory):
+    """3 REAL subprocess mixed predict+generation backends behind one
+    router: b1 is the SIGKILL victim; b2 the survivor with
+    ``serving.latency`` armed via its environment AND a sentinel whose
+    p99 ceiling detector opens the incident bundle the drill report
+    must carry."""
+    incident_dir = str(tmp_path_factory.mktemp("gd-incidents"))
+    ports = [_free_port() for _ in range(3)]
+    procs = [
+        _spawn_gd_backend(ports[0], 1.0),
+        _spawn_gd_backend(ports[1], 2.0),
+        _spawn_gd_backend(ports[2], 3.0, incident_dir=incident_dir,
+                          faults="serving.latency@1x300:0.06"),
+    ]
+    try:
+        if not all(_await_ready(p) for p in procs):
+            pytest.skip("subprocess backends failed to start")
+        policy = RouterPolicy(probe_interval_s=0.25,
+                              probe_timeout_s=0.5,
+                              reprobe_after_s=0.5)
+        router = FleetRouter(
+            [(f"b{i}", f"http://127.0.0.1:{p}")
+             for i, p in enumerate(ports)], policy=policy).start()
+        try:
+            ns = type("GameDayFleet", (), {})()
+            ns.ports = ports
+            ns.procs = procs
+            ns.router = router
+            ns.incident_dir = incident_dir
+            yield ns
+        finally:
+            router.stop()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+def _record_mixed_trace(server, *, n=30, gap_s=0.2):
+    """Drive REAL mixed traffic through the shared in-process server so
+    its ledger records it, then export the trace over HTTP — the drill
+    replays a recording, not a synthetic guess. Critical rows stay on
+    the retryable wire modes (predict / collected generate)."""
+    url = f"http://127.0.0.1:{server.port}"
+    c = ServingClient(url, max_retries=2)
+    x = [[0.0, 0.0, 0.0, 0.0]]
+    for i in range(n):
+        prio = "critical" if i % 4 == 0 else "normal"
+        tenant = f"gd-acc-{i % 3}"
+        if i % 5 == 3:
+            c.generate_tokens("gpt", [1, 2, 3, 4], max_new_tokens=3,
+                              priority=prio, tenant=tenant,
+                              deadline_ms=20000)
+        elif i % 10 == 6:
+            list(c.generate("gpt", [1, 2, 3], max_new_tokens=3,
+                            priority="normal", tenant=tenant,
+                            deadline_ms=20000))
+        else:
+            c.predict("scale", x, priority=prio, tenant=tenant,
+                      deadline_ms=20000)
+        time.sleep(gap_s)
+    doc = _get(f"{url}/debug/requests?format=trace")
+    rows = [r for r in doc["rows"]
+            if (r["tenant"] or "").startswith("gd-acc-")]
+    assert len(rows) == n
+    base = rows[0]["arrival_offset_s"]
+    for r in rows:
+        r["arrival_offset_s"] = round(r["arrival_offset_s"] - base, 6)
+    return rp.validate_trace({
+        "version": 1, "kind": "dl4j_tpu_trace", "t0_wall": None,
+        "count": n, "duration_s": rows[-1]["arrival_offset_s"],
+        "rows": rows})
+
+
+@pytest.mark.slow
+class TestGameDayAcceptance:
+    def test_recorded_trace_10x_sigkill_and_latency_all_gates_green(
+            self, gameday_fleet, mixed_server, tmp_path):
+        """THE acceptance. A trace recorded from real mixed traffic is
+        replayed at 10x against the router fleet; mid-replay the script
+        SIGKILLs b1 while b2's environment-armed ``serving.latency``
+        degrades it enough to trip its sentinel. Zero critical-class
+        client-visible failures, availability / MTTR / p99 / recompile
+        gates all green, the report artifact carries the survivor's
+        incident bundle and per-act verdicts, and the client-side
+        counts reconcile against the federated fleet scrape."""
+        trace = _record_mixed_trace(mixed_server, n=30, gap_s=0.2)
+        router = gameday_fleet.router
+        victim = gameday_fleet.procs[1]
+
+        def kill_victim():
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(timeout=10)
+
+        def await_incident():
+            """Hold the drill open until the survivor's sentinel fires,
+            sustaining probe traffic AT the degraded survivor so its
+            delta-based p99 probe sees elevated samples on consecutive
+            ticks (the quantile probe judges per-tick deltas; a replay
+            tail too sparse to land a request every tick would leave it
+            unjudgeable, not healthy)."""
+            pump = ServingClient(
+                f"http://127.0.0.1:{gameday_fleet.ports[2]}",
+                max_retries=1)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if _get(router.url + "/debug/incidents")["incidents"]:
+                    return
+                try:
+                    pump.predict("scale", [[0.0, 0.0, 0.0, 0.0]],
+                                 deadline_ms=5000)
+                except Exception:  # noqa: BLE001 — pump only
+                    time.sleep(0.1)
+
+        script = {
+            "name": "evacuate-b1",
+            "speed": 10, "clients": 6,
+            "acts": [
+                {"at_s": 0.25, "kind": "kill", "hook": "kill-victim",
+                 "name": "kill-victim"},
+                {"at_s": 0.4, "kind": "fault",
+                 "spec": "router.backend_latency@1x20:0.01",
+                 "name": "router-latency"},
+                {"at_s": 1.0, "kind": "call", "hook": "await-incident",
+                 "name": "await-incident"},
+            ],
+            "gates": [
+                {"kind": "critical_failures", "max_count": 0},
+                {"kind": "availability", "min_ratio": 0.97},
+                {"kind": "mttr", "max_s": 8.0},
+                {"kind": "p99", "max_s": 10.0},
+                {"kind": "recompiles", "max_count": 0},
+                {"kind": "availability", "scope": "kill-victim",
+                 "min_ratio": 0.97, "name": "availability-after-kill"},
+            ]}
+        drill = gd.GameDay.from_script(
+            script, base_url=router.url, trace=trace,
+            hooks={"kill-victim": kill_victim,
+                   "await-incident": await_incident},
+            report_dir=str(tmp_path), token_read_delay_s=0.01)
+        report = drill.run()
+
+        # every gate green, zero critical-class client failures
+        assert report["verdict"] == "pass", report["gates"]
+        by_gate = {v["gate"]: v for v in report["gates"]}
+        assert by_gate["critical_failures"]["value"] == 0
+        assert by_gate["availability"]["value"] >= 0.97
+        assert by_gate["mttr"]["value"] <= 8.0
+        assert by_gate["recompiles"]["value"] == 0
+        assert report["replay"]["requests"] == 30
+        assert report["replay"]["by_outcome"].get("shed", 0) == 0
+
+        # per-act verdicts: everything fired, nothing errored
+        acts = {a["name"]: a for a in report["acts"]}
+        assert set(acts) == {"kill-victim", "router-latency",
+                             "await-incident"}
+        assert all(a["fired"] and a["error"] is None
+                   for a in acts.values())
+
+        # the survivor's sentinel opened an incident under the injected
+        # latency and the router federated it into the report
+        assert report["incidents"], "no incident bundle in the report"
+
+        # client counts reconcile against the federated fleet scrape
+        rec = report["reconciliation"]
+        assert rec["consistent"] is True, rec
+        assert rec["client_ok"] == 30
+        assert rec["fleet_served_total"] >= rec["client_ok"]
+
+        # the artifact on disk tells the same story
+        files = list(tmp_path.glob("evacuate-b1-*.json"))
+        assert len(files) == 1
+        on_disk = json.loads(files[0].read_text())
+        assert on_disk["verdict"] == "pass"
+        assert on_disk["incidents"]
+
+        # and the victim really is dead and ejected
+        assert victim.poll() is not None
+        assert not router.backend("b1").routable

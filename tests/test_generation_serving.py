@@ -259,16 +259,24 @@ class TestHTTPStreaming:
             compiles_before = collector.jit_compiles_total.value()
             steps_before = engine.steps
             # mixed prefix lengths across different prompt buckets
-            # (longest + 20 new tokens still fits max_len=48)
+            # (longest + 20 new tokens still fits max_len=48). The
+            # first stream decodes 40 tokens so the staggered joiners
+            # land inside its decode window even on a fast host — a
+            # fixed stagger against a uniform 20-token decode let a
+            # quick machine finish each stream before the next client
+            # arrived, serializing the batch and failing the overlap
+            # assertion below.
             prompts = [[5, 9, 2], [1] * 9, [2] * 17, [3] * 27]
+            want = {0: 40, 1: 20, 2: 20, 3: 20}
             results = {}
             lock = threading.Lock()
 
             def run(i):
-                time.sleep(0.01 * i)  # staggered arrivals
+                time.sleep(0.005 * i)  # staggered arrivals
                 client = ServingClient(server.url)
                 toks = list(client.generate(
-                    "gpt", prompts[i], max_new_tokens=20, temperature=0.7))
+                    "gpt", prompts[i], max_new_tokens=want[i],
+                    temperature=0.7))
                 with lock:
                     results[i] = toks
 
@@ -280,7 +288,7 @@ class TestHTTPStreaming:
                 t.join(timeout=60)
                 assert not t.is_alive(), "streaming client hung"
             assert sorted(results) == [0, 1, 2, 3]
-            assert all(len(v) == 20 for v in results.values()), {
+            assert all(len(v) == want[k] for k, v in results.items()), {
                 k: len(v) for k, v in results.items()}
             # join/leave mid-decode: some request joined the batch at a
             # later decode step than another's join and before its leave

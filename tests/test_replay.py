@@ -1,0 +1,463 @@
+"""Ledger-driven traffic replay (PR 17): trace export/scrub from the
+request ledger, the trace document grammar, deterministic scenario
+warps, gate math on synthetic client ledgers, and the live round-trip —
+record mixed predict+generate traffic, export it over ``GET
+/debug/requests?format=trace``, replay it, and land the same
+plane/priority/tenant mix back on the server — plus open-loop arrival
+fidelity at 1x.
+
+Budget discipline: every HTTP test rides the shared ``mixed_server``
+conftest fixture (one tiny-GPT engine + one predict model compiled per
+module); everything else is pure math with no server at all.
+"""
+
+import json
+import time
+import urllib.request
+from collections import Counter
+
+import pytest
+
+from deeplearning4j_tpu.observability import reqlog as rl
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+)
+from deeplearning4j_tpu.resilience import gameday as gd
+from deeplearning4j_tpu.resilience import replay as rp
+from deeplearning4j_tpu.serving import ServingClient
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def _trace_of(rows):
+    """Wrap explicit rows into a valid trace document."""
+    return rp.validate_trace({
+        "version": rl.TRACE_VERSION, "kind": "dl4j_tpu_trace",
+        "t0_wall": None, "count": len(rows),
+        "duration_s": rows[-1]["arrival_offset_s"] if rows else 0.0,
+        "rows": rows})
+
+
+def _row(off, *, plane="predict", model="scale", priority="normal",
+         tenant=None, shape=(1, 4), **extra):
+    r = {"plane": plane, "model": model, "arrival_offset_s": off,
+         "priority": priority, "tenant": tenant,
+         "payload_shape": list(shape), "deadline_s": 30.0,
+         "stream": False}
+    r.update(extra)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# trace document grammar
+
+
+class TestTraceDocument:
+    def test_synthesize_is_deterministic_and_valid(self):
+        spec = {"n": 40, "rate_rps": 50.0, "seed": 7,
+                "models": [
+                    {"name": "scale", "plane": "predict",
+                     "payload_shape": [1, 4], "weight": 3.0},
+                    {"name": "gpt", "plane": "generation",
+                     "prompt_len": 6, "max_new_tokens": 4,
+                     "stream": True}],
+                "priorities": {"critical": 1, "normal": 4},
+                "tenants": ["a", "b"]}
+        t1 = rp.synthesize_trace(spec)
+        t2 = rp.synthesize_trace(spec)
+        assert t1["rows"] == t2["rows"]
+        assert t1["count"] == 40
+        planes = {r["plane"] for r in t1["rows"]}
+        assert planes == {"predict", "generation"}
+        for r in t1["rows"]:
+            if r["plane"] == "generation":
+                assert r["payload_shape"] == [6]
+                assert r["max_new_tokens"] == 4
+                assert r["stream"] is True
+
+    def test_different_seed_different_trace(self):
+        spec = {"n": 20, "rate_rps": 50.0, "tenants": ["a", "b", "c"]}
+        t1 = rp.synthesize_trace(dict(spec, seed=1))
+        t2 = rp.synthesize_trace(dict(spec, seed=2))
+        assert t1["rows"] != t2["rows"]
+
+    @pytest.mark.parametrize("mutate, msg", [
+        (lambda t: t.update(kind="nope"), "not a dl4j_tpu_trace"),
+        (lambda t: t.update(version=99), "unsupported trace version"),
+        (lambda t: t.update(rows=None), "no rows list"),
+        (lambda t: t["rows"].__setitem__(
+            0, dict(t["rows"][0], arrival_offset_s=-1.0)),
+         "bad arrival_offset_s"),
+        (lambda t: t["rows"].__setitem__(
+            0, dict(t["rows"][0], arrival_offset_s=9.0)),
+         "arrives before"),
+        (lambda t: t["rows"].__setitem__(
+            1, dict(t["rows"][1], plane="training")), "unknown plane"),
+        (lambda t: t["rows"].__setitem__(
+            1, dict(t["rows"][1], model="")), "no model"),
+    ])
+    def test_validate_rejects_junk(self, mutate, msg):
+        trace = _trace_of([_row(0.0), _row(0.5)])
+        doc = json.loads(json.dumps(trace))  # deep copy
+        mutate(doc)
+        with pytest.raises(ValueError, match=msg):
+            rp.validate_trace(doc)
+
+    def test_save_load_round_trip(self, tmp_path):
+        trace = rp.synthesize_trace({"n": 8, "seed": 3})
+        path = str(tmp_path / "t.json")
+        rp.save_trace(trace, path)
+        assert rp.load_trace(path) == trace
+
+
+# ---------------------------------------------------------------------------
+# scenario warps: deterministic under a fixed seed
+
+
+class TestWarps:
+    def _base(self):
+        return rp.synthesize_trace(
+            {"n": 60, "rate_rps": 30.0, "seed": 11,
+             "tenants": ["t0", "t1"]})
+
+    def test_zipf_tenants_deterministic_and_skewed(self):
+        base = self._base()
+        w1 = rp.warp_zipf_tenants(base, n_tenants=6, s=1.5, seed=4)
+        w2 = rp.warp_zipf_tenants(base, n_tenants=6, s=1.5, seed=4)
+        assert w1["rows"] == w2["rows"]
+        assert rp.warp_zipf_tenants(base, n_tenants=6, s=1.5,
+                                    seed=5)["rows"] != w1["rows"]
+        counts = Counter(r["tenant"] for r in w1["rows"])
+        assert set(counts) <= {f"tenant-{k}" for k in range(6)}
+        # Zipf head dominates the tail
+        assert counts["tenant-0"] == max(counts.values())
+
+    def test_diurnal_preserves_count_and_order(self):
+        base = self._base()
+        w = rp.warp_diurnal(base, depth=0.8)
+        assert w["rows"] == rp.warp_diurnal(base, depth=0.8)["rows"]
+        assert w["count"] == base["count"]
+        offs = [r["arrival_offset_s"] for r in w["rows"]]
+        assert offs == sorted(offs)
+        # the re-timing actually moved arrivals
+        assert offs != [r["arrival_offset_s"] for r in base["rows"]]
+
+    def test_flash_crowd_compresses_the_window(self):
+        base = self._base()
+        w = rp.warp_flash_crowd(base, at_frac=0.5, width_frac=0.4,
+                                magnitude=10.0)
+        assert w["count"] == base["count"]
+        assert w["duration_s"] < base["duration_s"]
+        offs = [r["arrival_offset_s"] for r in w["rows"]]
+        assert offs == sorted(offs)
+
+    def test_duplicate_burst_appends_identical_rows(self):
+        base = self._base()
+        w = rp.warp_duplicate_burst(base, frac=0.5, copies=2,
+                                    lag_s=0.01, seed=9)
+        assert w["rows"] == rp.warp_duplicate_burst(
+            base, frac=0.5, copies=2, lag_s=0.01, seed=9)["rows"]
+        assert w["count"] > base["count"]
+        # every added row is a byte-identical twin of an original
+        # except its arrival time
+        originals = {json.dumps({k: v for k, v in r.items()
+                                 if k != "arrival_offset_s"},
+                                sort_keys=True)
+                     for r in base["rows"]}
+        for r in w["rows"]:
+            key = json.dumps({k: v for k, v in r.items()
+                              if k != "arrival_offset_s"},
+                             sort_keys=True)
+            assert key in originals
+
+    @pytest.mark.parametrize("fn, kw", [
+        (rp.warp_zipf_tenants, {"n_tenants": 0}),
+        (rp.warp_diurnal, {"depth": 1.5}),
+        (rp.warp_flash_crowd, {"magnitude": 0.0}),
+        (rp.warp_duplicate_burst, {"frac": 2.0}),
+    ])
+    def test_warp_parameter_validation(self, fn, kw):
+        with pytest.raises(ValueError):
+            fn(self._base(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# ledger-level export: scrub, windowing, generation shape derivation
+
+
+class TestLedgerExport:
+    def _ledger_with_traffic(self):
+        led = rl.RequestLedger(capacity=64)
+        led.begin("p1", plane="predict", model="scale",
+                  priority="critical", tenant="t0",
+                  inputs=[[1.0, 2.0, 3.0, 4.0]])  # payload NEVER exported
+        led.annotate("p1", payload_shape=[1, 4], deadline_s=5.0,
+                     stream=False)
+        led.finish("p1", outcome="ok", status=200)
+        led.begin("g1", plane="generation", model="gpt",
+                  priority="normal", tenant="t1", prompt_len=6,
+                  max_new_tokens=4, prompt=[1, 2, 3, 4, 5, 6])
+        led.annotate("g1", deadline_s=10.0, stream=True)
+        led.finish("g1", outcome="ok", status=200)
+        return led
+
+    def test_rows_are_scrubbed_to_the_declared_fields(self):
+        trace = self._ledger_with_traffic().export_trace()
+        assert trace["kind"] == "dl4j_tpu_trace"
+        assert trace["version"] == rl.TRACE_VERSION
+        assert trace["count"] == 2
+        for row in trace["rows"]:
+            assert set(row) <= set(rl.TRACE_ROW_FIELDS)
+            blob = json.dumps(row)
+            assert "prompt" not in blob and "inputs" not in blob
+
+    def test_generation_rows_derive_shape_from_prompt_len(self):
+        trace = self._ledger_with_traffic().export_trace(
+            plane="generation")
+        assert trace["count"] == 1
+        (row,) = trace["rows"]
+        assert row["payload_shape"] == [6]
+        assert row["max_new_tokens"] == 4
+        assert row["stream"] is True
+        assert row["tenant"] == "t1"
+
+    def test_records_carry_absolute_wall_arrival(self):
+        led = self._ledger_with_traffic()
+        rec = led.get("p1")
+        assert abs(rec["t_wall"] - time.time()) < 60.0
+        # and the exported document anchors to it
+        trace = led.export_trace()
+        assert abs(trace["t0_wall"] - rec["t_wall"]) < 60.0
+
+    def test_window_and_limit_filters(self):
+        led = self._ledger_with_traffic()
+        assert led.export_trace(window_s=0.0)["count"] == 0
+        assert led.export_trace(limit=1)["count"] == 1
+        # limit keeps the NEWEST arrival
+        assert led.export_trace(limit=1)["rows"][0]["model"] == "gpt"
+        assert led.export_trace(model="scale")["count"] == 1
+
+    def test_offsets_rebase_to_the_first_kept_arrival(self):
+        trace = self._ledger_with_traffic().export_trace(
+            plane="generation")
+        assert trace["rows"][0]["arrival_offset_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# gate math on synthetic client ledgers (no server)
+
+
+def _res(idx, *, outcome="ok", priority="normal", t_send=0.0,
+         latency=0.01):
+    return {"idx": idx, "cid": f"r-{idx}", "plane": "predict",
+            "model": "m", "priority": priority, "tenant": None,
+            "outcome": outcome, "status": 200 if outcome == "ok" else 503,
+            "latency_s": latency, "t_send": t_send,
+            "t_done": t_send + latency, "send_lag_s": 0.0,
+            "tokens": 0, "attempts": 1, "error": None}
+
+
+class TestGateMath:
+    def test_summarize_counts_and_percentiles(self):
+        results = [_res(i, latency=0.01 * (i + 1)) for i in range(100)]
+        results[3] = _res(3, outcome="shed", priority="critical")
+        s = rp.summarize(results)
+        assert s["requests"] == 100
+        assert s["ok"] == 99
+        assert s["availability"] == 0.99
+        assert s["by_outcome"] == {"ok": 99, "shed": 1}
+        # 99 sorted ok-latencies; ceil-index: p50 -> 50th, p99 -> 99th
+        lats = sorted(r["latency_s"] for r in results
+                      if r["outcome"] == "ok")
+        assert s["latency_p50_s"] == round(lats[49], 6)
+        assert s["latency_p99_s"] == round(lats[98], 6)
+        assert [r["idx"] for r in s["critical_failures"]] == [3]
+
+    def test_first_success_after(self):
+        results = [_res(0, t_send=0.0), _res(1, outcome="error",
+                                             t_send=5.0),
+                   _res(2, t_send=7.0, latency=0.5)]
+        assert rp.first_success_after(results, 1.0) == pytest.approx(6.5)
+        assert rp.first_success_after(results, 8.0) is None
+
+    def test_gate_critical_failures_and_availability(self):
+        results = [_res(i) for i in range(10)]
+        acts, fleet = [], {}
+        g = gd.Gate("critical_failures")
+        assert g.evaluate(results, acts, fleet)["passed"] is True
+        results[0] = _res(0, outcome="shed", priority="critical")
+        v = g.evaluate(results, acts, fleet)
+        assert v["passed"] is False and v["value"] == 1
+        v = gd.Gate("availability", min_ratio=0.95).evaluate(
+            results, acts, fleet)
+        assert v["passed"] is False and v["value"] == 0.9
+
+    def test_gate_scope_filters_from_the_act_onward(self):
+        # the pre-kill shed is outside a kill-scoped gate's window
+        results = [_res(0, outcome="shed", t_send=1.0),
+                   _res(1, t_send=3.0), _res(2, t_send=4.0)]
+        act = gd.Act(2.0, "kill", name="kill-b1", fn=lambda: None)
+        act.t_fired = 2.0
+        g = gd.Gate("availability", scope="kill-b1", min_ratio=1.0)
+        assert g.evaluate(results, [act], {})["passed"] is True
+        g_run = gd.Gate("availability", min_ratio=1.0)
+        assert g_run.evaluate(results, [act], {})["passed"] is False
+
+    def test_gate_mttr_anchors_to_the_kill_act(self):
+        act = gd.Act(0.0, "kill", name="k", fn=lambda: None)
+        act.t_fired = 10.0
+        results = [_res(0, t_send=12.0, latency=0.5)]
+        v = gd.Gate("mttr", max_s=5.0).evaluate(results, [act], {})
+        assert v["passed"] is True and v["value"] == pytest.approx(2.5)
+        v = gd.Gate("mttr", max_s=1.0).evaluate(results, [act], {})
+        assert v["passed"] is False
+        # no kill act at all → the gate fails loudly, not silently
+        v = gd.Gate("mttr").evaluate(results, [], {})
+        assert v["passed"] is False
+
+    def test_gate_recompiles_reads_the_fleet_scrape(self):
+        g = gd.Gate("recompiles", max_count=0)
+        ok = {"warmup_recompiles_after_warm_total": 0.0}
+        bad = {"warmup_recompiles_after_warm_total": 2.0}
+        assert g.evaluate([], [], ok)["passed"] is True
+        assert g.evaluate([], [], bad)["passed"] is False
+        assert g.evaluate([], [], {})["passed"] is False
+
+    def test_act_and_gate_validation(self):
+        with pytest.raises(ValueError, match="unknown act kind"):
+            gd.Act(0.0, "meteor")
+        with pytest.raises(ValueError, match="needs spec"):
+            gd.Act(0.0, "fault")
+        with pytest.raises(ValueError, match="needs fn"):
+            gd.Act(0.0, "kill")
+        with pytest.raises(ValueError, match="needs backend"):
+            gd.Act(0.0, "drain")
+        with pytest.raises(ValueError, match="unknown gate kind"):
+            gd.Gate("vibes")
+
+    def test_driver_parameter_validation(self):
+        trace = _trace_of([_row(0.0)])
+        with pytest.raises(ValueError, match="speed"):
+            rp.ReplayDriver("http://x", trace, speed=0.0)
+        with pytest.raises(ValueError, match="speed"):
+            rp.ReplayDriver("http://x", trace, speed=rp.MAX_SPEED + 1)
+        with pytest.raises(ValueError, match="clients"):
+            rp.ReplayDriver("http://x", trace, clients=0)
+
+    def test_synth_inputs_shapes(self):
+        flat = rp._synth_inputs([2, 3], None)
+        assert flat == [[0.0] * 3] * 2
+        named = rp._synth_inputs({"x": [1, 2]}, None)
+        assert named == {"x": [[0.0, 0.0]]}
+        with pytest.raises(ValueError, match="no payload_shape"):
+            rp._synth_inputs(None, None)
+
+
+# ---------------------------------------------------------------------------
+# live round-trip: record -> export over HTTP -> replay -> same mix
+
+
+class TestRoundTrip:
+    def test_record_export_replay_same_mix(self, mixed_server):
+        """Satellite acceptance: traffic recorded by the ledger, exported
+        as a trace, and replayed lands the SAME plane/priority/tenant
+        mix back on the server — the trace is a faithful, scrubbed
+        recording, not a lossy sketch."""
+        url = f"http://127.0.0.1:{mixed_server.port}"
+        c = ServingClient(url, max_retries=2)
+        x = [[0.0, 0.0, 0.0, 0.0]]
+        sent = []
+        for prio, tenant in (("critical", "rt-a"), ("normal", "rt-a"),
+                             ("normal", "rt-b")):
+            c.predict("scale", x, priority=prio, tenant=tenant,
+                      deadline_ms=15000)
+            sent.append(("predict", prio, tenant))
+        out = c.generate_tokens("gpt", [1, 2, 3, 4], max_new_tokens=3,
+                                priority="normal", tenant="rt-a",
+                                deadline_ms=20000)
+        assert out["tokens"]
+        sent.append(("generation", "normal", "rt-a"))
+        tokens = list(c.generate("gpt", [1, 2, 3], max_new_tokens=3,
+                                 priority="critical", tenant="rt-b",
+                                 deadline_ms=20000))
+        assert tokens
+        sent.append(("generation", "critical", "rt-b"))
+
+        status, doc = _get(f"{url}/debug/requests?format=trace")
+        assert status == 200
+        rows = [r for r in doc["rows"] if r["tenant"] in ("rt-a", "rt-b")]
+        assert len(rows) == 5
+        base = rows[0]["arrival_offset_s"]
+        for r in rows:  # rebase: replay immediately, not after the
+            r["arrival_offset_s"] = round(        # module's whole history
+                r["arrival_offset_s"] - base, 6)
+        trace = _trace_of(rows)
+        # generation rows survived with wire mode + token budget intact
+        gen = [r for r in rows if r["plane"] == "generation"]
+        assert {r["stream"] for r in gen} == {False, True}
+        assert all(r["max_new_tokens"] == 3 for r in gen)
+        assert all(r["payload_shape"] in ([4], [3]) for r in gen)
+
+        summary = rp.ReplayDriver(url, trace, speed=10.0,
+                                  clients=3).run()
+        assert summary["ok"] == 5, summary["by_outcome"]
+        replayed = Counter((r["plane"], r["priority"], r["tenant"])
+                           for r in summary["results"])
+        assert replayed == Counter(sent)
+        # the streamed row streamed again (tokens drained client-side)
+        streamed = [r for r in summary["results"]
+                    if r["plane"] == "generation" and r["tokens"]]
+        assert streamed
+
+    def test_replay_emits_flight_trail_and_metrics(self, mixed_server):
+        url = f"http://127.0.0.1:{mixed_server.port}"
+        trace = _trace_of([_row(0.0, tenant="fm-a"),
+                           _row(0.05, tenant="fm-a")])
+        m = rp.get_replay_metrics()
+        before = m.requests_total.value(plane="predict", outcome="ok")
+        runs_before = m.runs_total.value()
+        rp.ReplayDriver(url, trace, speed=10.0, clients=2).run()
+        assert m.requests_total.value(
+            plane="predict", outcome="ok") == before + 2
+        assert m.runs_total.value() == runs_before + 1
+        kinds = [e["kind"] for e in get_flight_recorder().events(
+            kinds=("replay.start", "replay.complete"), max_events=50)]
+        assert "replay.start" in kinds and "replay.complete" in kinds
+
+
+# ---------------------------------------------------------------------------
+# open-loop arrival fidelity at 1x
+
+
+class TestArrivalFidelity:
+    def test_dispatch_tracks_recorded_offsets_at_1x(self, mixed_server):
+        """Open-loop: each request leaves the driver at its recorded
+        offset (tolerance covers scheduler jitter, not drift), and the
+        measured send lag is reported rather than hidden."""
+        url = f"http://127.0.0.1:{mixed_server.port}"
+        offsets = [0.0, 0.3, 0.6, 0.9]
+        trace = _trace_of([_row(o, tenant="af") for o in offsets])
+        drv = rp.ReplayDriver(url, trace, speed=1.0, clients=4)
+        summary = drv.run()
+        assert summary["ok"] == 4
+        t0 = drv.t_run0
+        for r, off in zip(summary["results"], offsets):
+            assert r["t_send"] - t0 == pytest.approx(off, abs=0.25)
+            assert r["send_lag_s"] < 0.25
+        # and the run took about as long as the recording
+        assert 0.85 <= summary["results"][-1]["t_send"] - t0 <= 1.6
+
+    def test_speed_compresses_wall_time(self, mixed_server):
+        url = f"http://127.0.0.1:{mixed_server.port}"
+        offsets = [0.0, 0.4, 0.8, 1.2, 1.6, 2.0]
+        trace = _trace_of([_row(o, tenant="sp") for o in offsets])
+        t0 = time.monotonic()
+        summary = rp.ReplayDriver(url, trace, speed=10.0,
+                                  clients=3).run()
+        wall = time.monotonic() - t0
+        assert summary["ok"] == 6
+        # 2.0 s of recording at 10x ≈ 0.2 s of dispatching
+        assert wall < 1.5

@@ -48,7 +48,8 @@ class TestValidation:
             "brownout-engaged", "generation-availability",
             "generation-ttft-p99", "router-availability",
             "router-retry-budget-exhausted", "recompile-after-warmup",
-            "sanitizer-violation", "cache-hit-rate", "cache-stale-serve"}
+            "sanitizer-violation", "cache-hit-rate", "cache-stale-serve",
+            "gameday-gate-breach"}
 
     def test_default_serving_rules_match_example_vocabulary(self):
         known = slo.known_metric_names()
@@ -135,7 +136,7 @@ class TestCheckCLI:
              "--check", EXAMPLE_RULES],
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr
-        assert "ok: 17 rule(s) valid" in out.stdout
+        assert "ok: 18 rule(s) valid" in out.stdout
 
     def test_bad_rules_exit_nonzero(self, tmp_path):
         bad = tmp_path / "bad.json"

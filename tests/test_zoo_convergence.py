@@ -49,6 +49,13 @@ class TestSequentialZoo:
         _overfit(lenet(updater=Adam(1e-3)),
                  _image_batch((28, 28, 1), 10))
 
+    # Tier-1 budget relief (the PR 6/7 pattern, paying for the PR 17
+    # replay/game-day suite): the 96x96 40-step alexnet overfit is
+    # ~40 s of plain stacked-conv training; the architecture stays
+    # wired in tier-1 via the forward-shape row (test_zoo.py::
+    # test_sequential_zoo_forward_shapes[alexnet...]) and the
+    # identical conv/pool overfit path runs every tier-1 in simplecnn.
+    @pytest.mark.slow
     def test_alexnet(self):
         from deeplearning4j_tpu.models.zoo import alexnet
 
@@ -61,7 +68,7 @@ class TestSequentialZoo:
     # convergence run (~19 s of plain stacked-conv overfitting); its
     # architecture stays wired in tier-1 via the forward-shape row
     # (test_zoo.py::test_sequential_zoo_forward_shapes[vgg16...]) and
-    # the identical conv/pool overfit path runs in alexnet/simplecnn.
+    # the identical conv/pool overfit path runs in simplecnn.
     @pytest.mark.slow
     def test_vgg16(self):
         from deeplearning4j_tpu.models.zoo import vgg16
@@ -110,7 +117,7 @@ class TestGraphZoo:
     # test_graph_zoo_forward_shapes[resnet50...]) AND a real training
     # proxy (test_zoo.py::test_resnet50_trains_tiny — 3 steps at 16x16
     # prove the residual graph trains end-to-end); the skip-connection
-    # overfit discipline continues via inception_resnet_v1/unet.
+    # overfit discipline continues via inception_resnet_v1.
     @pytest.mark.slow
     def test_resnet50(self):
         from deeplearning4j_tpu.models.zoo import resnet50
@@ -119,6 +126,13 @@ class TestGraphZoo:
                           updater=Adam(1e-3)),
                  _image_batch((64, 64, 3), 10), steps=50)
 
+    # Tier-1 budget relief (the PR 6/7 pattern, paying for the PR 17
+    # replay/game-day suite): ~22 s of 96x96 fire-module overfitting;
+    # the graph stays wired in tier-1 via the forward-shape row
+    # (test_zoo.py::test_graph_zoo_forward_shapes[squeezenet...]) and
+    # the graph-zoo overfit discipline continues every tier-1 run via
+    # inception_resnet_v1.
+    @pytest.mark.slow
     def test_squeezenet(self):
         from deeplearning4j_tpu.models.zoo import squeezenet
 
@@ -160,6 +174,14 @@ class TestGraphZoo:
                         dropout=0.0, updater=Adam(1e-3)),
                  _image_batch((64, 64, 3), 10), steps=60)
 
+    # Tier-1 budget relief (the PR 6/7 pattern, paying for the PR 17
+    # replay/game-day suite): the 60-step segmentation overfit is
+    # ~60 s — the 2nd-slowest test left in tier-1; the encoder/decoder
+    # graph stays wired via the forward-shape row (test_zoo.py::
+    # test_graph_zoo_forward_shapes[unet...]) and the skip-connection
+    # overfit discipline continues every tier-1 via
+    # inception_resnet_v1.
+    @pytest.mark.slow
     def test_unet(self):
         from deeplearning4j_tpu.models.zoo import unet
 
