@@ -2622,6 +2622,173 @@ def bench_reqtrace(peak, *, requests=10, rounds=8, num_slots=2,
         _tr.set_tail_sampler(prev_sampler)
 
 
+def bench_timeseries(peak, *, requests=10, rounds=8, num_slots=2,
+                     max_new_tokens=16, max_len=48, hidden=64,
+                     num_layers=2, num_heads=2, vocab=128, prompt_len=5):
+    """Historical telemetry tier benchmark (observability/timeseries +
+    usage): what the armed mini-TSDB + usage-metering plane costs the
+    serving hot path. Two priced components, gated together **< 2%**
+    of serving step time:
+
+    - the **usage sink**: one attribution call at every ledger finish
+      (tenant/model account update) — armed-vs-disarmed serving-window
+      A/B with adjacent-pair drift cancellation and GC off, the same
+      protocol every other sub-1% host gate here uses (the sampler is
+      killed via ``set_sampling_enabled(False)`` on both legs so its
+      wakeups cannot alias the windows);
+    - the **sampler scrape**: one full ``sample()`` pass (registry JSON
+      walk into the tiered rings + the usage/capacity roll-up
+      collectors, all due every pass) over the LIVE post-serving
+      state, amortized at the finest-tier 1 s cadence — the same
+      amortization the sentinel gate uses for its detector tick.
+
+    The request ledger stays enabled on both A/B legs: its own cost is
+    ``reqtrace``'s gate; this one prices the telemetry tier ON TOP of
+    the always-on ledger. Absolute costs (per-record attribution and
+    one scrape, both in µs) are reported so deployments can budget the
+    cadence.
+
+    ``peak`` (chip FLOPs) is unused: host-side overhead metrics.
+    """
+    import gc
+    from statistics import median as _median
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models.gpt import Gpt, GptConfig
+    from deeplearning4j_tpu.observability import reqlog as _rl
+    from deeplearning4j_tpu.observability import timeseries as _ts
+    from deeplearning4j_tpu.observability import usage as _us
+    from deeplearning4j_tpu.serving import GenerationEngine
+
+    model = Gpt(GptConfig(
+        vocab_size=vocab, hidden=hidden, num_layers=num_layers,
+        num_heads=num_heads, intermediate=hidden * 4,
+        max_position=max_len, dropout=0.0, attention_dropout=0.0))
+    variables = model.init(seed=0)
+    engine = GenerationEngine(
+        model, variables, name="timeseries", num_slots=num_slots,
+        max_len=max_len, max_new_tokens=max_new_tokens,
+        idle_wait_s=0.001, temperature=0.0,
+        max_waiting=4 * requests)
+    engine.warm()
+    # a fresh ledger (enabled both ways — its cost is reqtrace's gate,
+    # not this one's) and a fresh store/meter pair wired exactly like
+    # ModelServer wires them: sink at ledger finish, usage + capacity
+    # collectors on the store, sampler at the finest-tier cadence
+    prev_ledger = _rl.get_request_ledger()
+    prev_sink = _rl.get_usage_sink()
+    _rl.set_request_ledger(_rl.RequestLedger(2048))
+    _rl.set_ledger_enabled(True)
+    meter = _us.UsageMeter(max_accounts=64)
+    store = _ts.TimeSeriesStore(interval_s=1.0, max_series=256)
+    store.add_collector(meter.collect, every_s=1.0)
+    evaluator = _us.CapacityEvaluator(store)
+    store.add_collector(evaluator.collect, every_s=1.0)
+    # sampler killed during the A/B legs: a 1 Hz scrape aliasing a
+    # ~10 ms window would read as thousands of % — its true cost is
+    # priced below, amortized at the cadence it actually runs at
+    _ts.set_sampling_enabled(False)
+    engine.start()
+    try:
+        prompt = np.arange(1, prompt_len + 1, dtype=np.int32) % vocab
+
+        def window():
+            t0 = time.perf_counter()
+            handles = [engine.submit(prompt,
+                                     max_new_tokens=max_new_tokens)
+                       for _ in range(requests)]
+            for h in handles:
+                h.result(timeout=60)
+            return time.perf_counter() - t0
+
+        _rl.set_usage_sink(meter.on_record)
+        window()  # scheduler + cache warm, and seeds the first accounts
+        rounds += rounds % 2
+        round_diffs, bare_s = [], []
+        gc.collect()
+        gc.disable()
+        try:
+            for i in range(rounds):
+                if i % 2 == 0:
+                    _rl.set_usage_sink(None)
+                    bm = window()
+                    _rl.set_usage_sink(meter.on_record)
+                    am = window()
+                else:
+                    _rl.set_usage_sink(meter.on_record)
+                    am = window()
+                    _rl.set_usage_sink(None)
+                    bm = window()
+                bare_s.append(bm)
+                round_diffs.append((am - bm) / bm * 100.0)
+        finally:
+            gc.enable()
+            _rl.set_usage_sink(meter.on_record)
+        pair_diffs = [(round_diffs[k] + round_diffs[k + 1]) / 2.0
+                      for k in range(0, len(round_diffs), 2)]
+        sink_pct = max(0.0, _median(pair_diffs))
+
+        # absolute per-record attribution cost
+        n_micro = 2000
+        rec = {"model": "timeseries", "tenant": "bench",
+               "plane": "generation", "outcome": "ok",
+               "tokens": max_new_tokens, "prompt_len": prompt_len}
+        t0 = time.perf_counter()
+        for _ in range(n_micro):
+            meter.on_record(rec)
+        record_us = (time.perf_counter() - t0) / n_micro * 1e6
+
+        # full sampler scrape over the live post-serving registry state
+        # (all collectors due every pass via synthetic advancing clocks),
+        # amortized at the finest-tier cadence
+        _ts.set_sampling_enabled(True)
+        anchor = time.time()
+        ingested = store.sample(now=anchor)  # warm lazy bundles / caches
+        t0 = time.perf_counter()
+        n_scrapes = 50
+        for k in range(n_scrapes):
+            store.sample(now=anchor + (k + 1) * store.interval_s)
+        sample_us = (time.perf_counter() - t0) / n_scrapes * 1e6
+        scrape_pct = sample_us / (store.interval_s * 1e6) * 100.0
+
+        total_pct = sink_pct + scrape_pct
+        desc = store.describe()
+        usage = meter.describe()
+        info = {
+            "rounds": rounds,
+            "requests_per_window": requests,
+            "bare_window_ms": round(_median(bare_s) * 1e3, 2),
+            "sink_overhead_pct": round(sink_pct, 3),
+            "record_us": round(record_us, 2),
+            "sample_us": round(sample_us, 1),
+            "scrape_pct_at_cadence": round(scrape_pct, 4),
+            "samples_per_scrape": ingested,
+            "tsdb_series": desc["series"],
+            "tsdb_points": desc["points"],
+            "usage_accounts": len(usage["tenants"]),
+            "armed_overhead_pct": round(total_pct, 3),
+            # integrity gate: the armed mini-TSDB + usage plane (sink
+            # on the finish path + scrape at the 1 s cadence) costs the
+            # serving step < 2%
+            "gate_overhead_ok": bool(total_pct < 2.0),
+            "converged": bool(total_pct < 2.0
+                              and desc["series"] > 0
+                              and desc["points"] > 0
+                              and len(usage["tenants"]) > 0),
+            "unit": "% serving-window overhead, armed mini-TSDB "
+                    "sampler + usage metering",
+        }
+        info["value"] = round(total_pct, 3)
+        return info
+    finally:
+        engine.stop()
+        store.stop()
+        _ts.set_sampling_enabled(True)
+        _rl.set_usage_sink(prev_sink)
+        _rl.set_request_ledger(prev_ledger)
+
+
 def bench_cache(peak, *, n_threads=4, requests_per_thread=60,
                 pool_size=24, zipf_a=1.5, dim=256, hidden=1024,
                 depth=16, repeat_burst=20,
@@ -3062,6 +3229,10 @@ _CONFIGS = {
     # trace.TailSampler): the always-on per-request observability
     # plane's cost on the serving hot path, gated < 2% of step time.
     "reqtrace": bench_reqtrace,
+    # Historical telemetry tier (observability/timeseries + usage): the
+    # armed mini-TSDB sampler + usage-metering plane's cost on the
+    # serving hot path, gated < 2% of step time.
+    "timeseries": bench_timeseries,
     # Request & prefix caching tier (serving/cache + serving/prefixkv):
     # goodput uplift on a Zipf repeat mix vs cache-off (gated >= 2x),
     # exact hits proven to consume zero batch slots, and prefix-KV
@@ -3139,6 +3310,11 @@ _CPU_INTEGRITY = {
     # reqtrace reports "converged" = the always-on ledger + tail-staging
     # plane costs the serving window < 2%
     "reqtrace": dict(requests=6, rounds=6, max_new_tokens=8, max_len=32),
+    # timeseries reports "converged" = the armed mini-TSDB sampler +
+    # usage metering plane costs the serving window < 2% AND the store
+    # actually accumulated series/points and tenant accounts
+    "timeseries": dict(requests=6, rounds=6, max_new_tokens=8,
+                       max_len=32),
     # cache reports "converged" = >= 2x goodput on the Zipf mix vs
     # bypass, a pure-repeat burst consumed zero device batches, and
     # prefix hits beat cold prefills on TTFT with zero recompiles
@@ -3229,7 +3405,8 @@ def main():
                     default="bert,resnet50,resnet50_b128,lstm,lenet,gpt,"
                             "serving,overload,generation,resilience,"
                             "observability,robustness,federation,elastic,"
-                            "sentinel,reqtrace,warmstart,cache",
+                            "sentinel,reqtrace,timeseries,warmstart,"
+                            "cache",
                     help="comma-separated subset of %s" % list(_CONFIGS))
     ap.add_argument("--kernels", action="store_true",
                     help="run the on-chip Pallas-vs-XLA kernel A/B instead")

@@ -186,6 +186,9 @@ def build_snapshot(*, extra_registries: Sequence = (),
         "spans": [s.to_json() for s in _trace.get_tracer().spans()],
         "incidents": _incident_index(),
         "requests": _request_index(),
+        "timeseries": _timeseries_index(),
+        "usage": _usage_index(),
+        "capacity": _capacity_index(),
     }
 
 
@@ -213,6 +216,53 @@ def _request_index() -> List[dict]:
         return request_index()
     except Exception:  # noqa: BLE001 — telemetry never fails the worker
         return []
+
+
+def _timeseries_index() -> Optional[dict]:
+    """This worker's TSDB snapshot (timeseries.py), or None — never
+    creates a store as a side effect, never raises. History federates
+    as one atomic document; the aggregator rebuilds a queryable store
+    per worker from it."""
+    try:
+        from deeplearning4j_tpu.observability.timeseries import (
+            timeseries_index,
+        )
+
+        return timeseries_index()
+    except Exception:  # noqa: BLE001 — telemetry never fails the worker
+        return None
+
+
+def _usage_index() -> Optional[dict]:
+    """This worker's usage-accounting document (usage.py), or None —
+    never creates a meter as a side effect, never raises."""
+    try:
+        from deeplearning4j_tpu.observability.reqlog import (
+            get_request_ledger,
+        )
+        from deeplearning4j_tpu.observability.usage import usage_index
+
+        return usage_index(ledger=get_request_ledger())
+    except Exception:  # noqa: BLE001 — telemetry never fails the worker
+        return None
+
+
+#: Last capacity report published by this process's evaluator (every
+#: CapacityEvaluator.evaluate() pass stores its report here) — the
+#: federation snapshot reads it without holding a server reference.
+_LAST_CAPACITY_REPORT: Optional[dict] = None
+
+
+def publish_capacity_report(report: Optional[dict]) -> None:
+    global _LAST_CAPACITY_REPORT
+    _LAST_CAPACITY_REPORT = report
+
+
+def _capacity_index() -> Optional[dict]:
+    """This worker's latest published capacity report, or None. Reads
+    the cached report only — a federation scrape must not force an
+    evaluation pass."""
+    return _LAST_CAPACITY_REPORT
 
 
 class TelemetryExporter:
@@ -715,6 +765,11 @@ def _sanitize_snapshot(snap: dict) -> dict:
     snap["requests"] = (
         [d for d in requests if isinstance(d, dict) and d.get("cid")]
         if isinstance(requests, list) else [])
+    # historical-telemetry documents are optional and self-describing:
+    # anything that is not a dict degrades to absent (None)
+    for key in ("timeseries", "usage", "capacity"):
+        if not isinstance(snap.get(key), dict):
+            snap[key] = None
     return snap
 
 
@@ -787,6 +842,10 @@ class ClusterAggregator:
         self._live: Dict[int, bool] = {}
         self._federated_insts: List[_metrics._Instrument] = []
         self._last_poll: Optional[float] = None
+        # per-worker TSDB stores rebuilt from snapshot documents,
+        # cached by (worker, snapshot time) — a re-poll with an
+        # unchanged snapshot (dead worker) reuses the rebuilt store
+        self._ts_cache: Dict[tuple, object] = {}
         self.metrics.workers_expected.set(num_workers)
 
     # -- reconfiguration (a new generation moves the port base) --------------
@@ -1213,6 +1272,178 @@ class ClusterAggregator:
         return _reqlog.trace_from_records(records, plane=plane,
                                           model=model)
 
+    def _timeseries_stores(self) -> Dict[int, tuple]:
+        """Queryable per-worker TSDB stores rebuilt from last-known
+        snapshot documents: {worker: (store, generation, anchor_time)}.
+        Built from last-known snapshots, so a dead worker's history
+        stays queryable (anchored at its final snapshot time)."""
+        from deeplearning4j_tpu.observability.timeseries import (
+            store_from_snapshot,
+        )
+
+        with self._lock:
+            snaps = dict(self._snapshots)
+        stores: Dict[int, tuple] = {}
+        for wid, snap in sorted(snaps.items()):
+            doc = snap.get("timeseries")
+            if not isinstance(doc, dict):
+                continue
+            anchor = doc.get("time") or snap.get("time")
+            key = (wid, anchor)
+            store = self._ts_cache.get(key)
+            if store is None:
+                store = store_from_snapshot(doc)
+                # one cached store per worker: drop the stale build
+                self._ts_cache = {k: v for k, v in self._ts_cache.items()
+                                  if k[0] != wid}
+                if store is not None:
+                    self._ts_cache[key] = store
+            if store is not None:
+                stores[wid] = (store, snap.get("generation", 1), anchor)
+        return stores
+
+    def cluster_timeseries(self, family: Optional[str] = None, *,
+                           op: str = "range", window_s: float = 600.0,
+                           step_s: Optional[float] = None,
+                           q: Optional[float] = None,
+                           labels: Optional[Dict[str, str]] = None) -> dict:
+        """The fleet history query (``GET /cluster/debug/timeseries``):
+        every worker's store answers over its own trailing window
+        (anchored at that worker's last snapshot time, so a dead
+        worker's final history still answers), series stamped with
+        worker/generation labels. Without ``family``: the merged
+        catalog. ``rate`` aggregates to the fleet-wide sum; ``max`` to
+        the fleet max; quantiles stay per-worker (cross-worker
+        quantiles cannot be merged from values — read the per-worker
+        documents)."""
+        stores = self._timeseries_stores()
+        if family is None:
+            fams: Dict[str, List[int]] = {}
+            for wid, (store, _gen, _anchor) in stores.items():
+                for name in store.families():
+                    fams.setdefault(name, []).append(wid)
+            return {"workers": sorted(stores),
+                    "families": {n: sorted(w)
+                                 for n, w in sorted(fams.items())}}
+        out: dict = {"family": family, "op": op,
+                     "window_s": float(window_s),
+                     "workers": sorted(stores), "series": []}
+        agg = None
+        for wid, (store, gen, anchor) in stores.items():
+            try:
+                if op == "rate":
+                    doc = store.rate(family, window_s=window_s,
+                                     step_s=step_s, labels=labels,
+                                     now=anchor)
+                    agg = (agg or 0.0) + doc.get("rate", 0.0)
+                elif op == "quantile":
+                    doc = store.quantile_over_time(
+                        family, float(q if q is not None else 0.99),
+                        window_s=window_s, labels=labels, now=anchor)
+                    out["series"].append({
+                        "labels": {"worker": str(wid),
+                                   "generation": str(gen)},
+                        "value": doc.get("value"),
+                        "count": doc.get("count")})
+                    continue
+                elif op == "max":
+                    doc = store.max_over_time(family, window_s=window_s,
+                                              labels=labels, now=anchor)
+                    v = doc.get("value")
+                    if v is not None:
+                        agg = v if agg is None else max(agg, v)
+                else:
+                    doc = store.range(family, window_s=window_s,
+                                      step_s=step_s, labels=labels,
+                                      now=anchor)
+            except Exception:  # noqa: BLE001 — a version-skewed worker's
+                continue       # store must not fail the fleet query
+            for series in doc.get("series", []):
+                lbls = dict(series.get("labels") or {})
+                lbls["worker"] = str(wid)
+                lbls["generation"] = str(gen)
+                out["series"].append(dict(series, labels=lbls))
+        if op == "rate":
+            out["rate"] = agg or 0.0
+        elif op == "max":
+            out["value"] = agg
+        return out
+
+    def cluster_usage(self) -> dict:
+        """The fleet usage ledger (``GET /cluster/debug/usage``):
+        every worker's accounts worker/generation-stamped, plus fleet
+        roll-ups per (tenant, model) and overall. Built from last-known
+        snapshots — a dead worker's final attribution is retained."""
+        with self._lock:
+            snaps = dict(self._snapshots)
+        rows: List[dict] = []
+        fleet: Dict[tuple, dict] = {}
+        totals = {"requests": 0, "errors": 0, "tokens_in": 0,
+                  "tokens_out": 0}
+        for wid, snap in sorted(snaps.items()):
+            doc = snap.get("usage")
+            if not isinstance(doc, dict):
+                continue
+            gen = snap.get("generation", 1)
+            for acct in doc.get("tenants", []):
+                if not isinstance(acct, dict):
+                    continue
+                rows.append(dict(acct, worker=wid, generation=gen))
+                key = (acct.get("tenant"), acct.get("model"))
+                agg = fleet.setdefault(key, {
+                    "tenant": key[0], "model": key[1], "requests": 0,
+                    "errors": 0, "tokens_in": 0, "tokens_out": 0})
+                for k in totals:
+                    try:
+                        v = int(acct.get(k) or 0)
+                    except (TypeError, ValueError):
+                        v = 0
+                    agg[k] += v
+                    totals[k] += v
+        return {"workers": sorted(snaps), "accounts": rows,
+                "fleet": sorted(fleet.values(),
+                                key=lambda a: (-a["requests"],
+                                               str(a["tenant"]))),
+                "totals": totals}
+
+    def cluster_capacity(self) -> dict:
+        """The fleet capacity view (``GET /cluster/debug/capacity``):
+        per-worker headroom reports plus per-model fleet aggregates
+        (rates and peaks sum across workers serving the same model;
+        fleet headroom = 1 - sum(rate)/sum(peak)) and the worst
+        verdict. The autoscaler's fleet-level input contract."""
+        with self._lock:
+            snaps = dict(self._snapshots)
+        rank = {"ok": 0, "warn": 1, "exhausted": 2}
+        workers: List[dict] = []
+        fleet: Dict[str, dict] = {}
+        worst = "ok"
+        for wid, snap in sorted(snaps.items()):
+            doc = snap.get("capacity")
+            if not isinstance(doc, dict):
+                continue
+            workers.append(dict(doc, worker=wid,
+                                generation=snap.get("generation", 1)))
+            for model, row in (doc.get("models") or {}).items():
+                if not isinstance(row, dict):
+                    continue
+                agg = fleet.setdefault(model, {
+                    "rate_rps": 0.0, "peak_rps": 0.0, "workers": 0})
+                try:
+                    agg["rate_rps"] += float(row.get("rate_rps") or 0.0)
+                    agg["peak_rps"] += float(row.get("peak_rps") or 0.0)
+                except (TypeError, ValueError):
+                    pass
+                agg["workers"] += 1
+                v = row.get("verdict")
+                if rank.get(v, 0) > rank[worst]:
+                    worst = v
+        for model, agg in fleet.items():
+            peak = agg["peak_rps"]
+            agg["headroom"] = (round(1.0 - agg["rate_rps"] / peak, 4)
+                               if peak > 0 else 1.0)
+        return {"workers": workers, "models": fleet, "verdict": worst}
+
     def cluster_request(self, cid: str) -> Optional[dict]:
         """Find one request by correlation id on whichever worker
         served it: the ledger record from that worker's snapshot plus
@@ -1460,6 +1691,32 @@ class ClusterTelemetryServer:
                                                   "on any worker"})
                     else:
                         self._send(200, body)
+                elif path == "/cluster/debug/timeseries":
+                    q = parse_qs(query)
+                    try:
+                        window_s = (float(q["window"][0])
+                                    if "window" in q else 600.0)
+                        step_s = (float(q["step"][0])
+                                  if "step" in q else None)
+                        quant = float(q["q"][0]) if "q" in q else None
+                    except ValueError:
+                        self._send(400, {"error": "window, step and q "
+                                                  "must be numbers"})
+                        return
+                    labels = {k[len("label."):]: v[0]
+                              for k, v in q.items()
+                              if k.startswith("label.")}
+                    if "model" in q:
+                        labels["model"] = q["model"][0]
+                    self._send(200, agg.cluster_timeseries(
+                        q.get("family", [None])[0],
+                        op=q.get("op", ["range"])[0],
+                        window_s=window_s, step_s=step_s, q=quant,
+                        labels=labels or None))
+                elif path == "/cluster/debug/usage":
+                    self._send(200, agg.cluster_usage())
+                elif path == "/cluster/debug/capacity":
+                    self._send(200, agg.cluster_capacity())
                 elif path == "/cluster/debug/health":
                     if server.engine is None:
                         self._send(404, {"error": "no cluster health "

@@ -57,7 +57,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 from deeplearning4j_tpu.observability import metrics as _metrics
 from deeplearning4j_tpu.observability import trace as _trace
@@ -264,6 +264,12 @@ class RequestLedger:
                     m.trace_retained_spans_total.inc(n_spans)
                 else:
                     m.trace_dropped_total.inc()
+        sink = _USAGE_SINK
+        if sink is not None:
+            try:
+                sink(dict(rec))
+            except Exception:  # noqa: BLE001 — metering never fails serving
+                pass
         return rec
 
     def record(self, cid: str, *, plane: str, model: str, outcome: str,
@@ -410,6 +416,21 @@ def trace_from_records(records: Iterable[dict], *,
 _LEDGER: Optional[RequestLedger] = None
 _ledger_lock = threading.Lock()
 _ENABLED = True
+_USAGE_SINK: Optional[Callable[[dict], None]] = None
+
+
+def set_usage_sink(fn: Optional[Callable[[dict], None]]) -> None:
+    """Install ``fn(sealed_record)`` to receive every finished ledger
+    record (the usage meter's feed — both serving planes finish through
+    the ledger, so metering sees predict and generation uniformly).
+    One sink per process; None uninstalls. The sink runs outside the
+    ledger lock and its exceptions are swallowed."""
+    global _USAGE_SINK
+    _USAGE_SINK = fn
+
+
+def get_usage_sink() -> Optional[Callable[[dict], None]]:
+    return _USAGE_SINK
 
 
 def set_ledger_enabled(flag: bool) -> None:

@@ -234,6 +234,19 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
 
     ReplayMetrics(reg)
     GameDayMetrics(reg)
+    # the historical-telemetry tier (observability/timeseries.py +
+    # observability/usage.py): tsdb_* sampler health, usage_* account
+    # bookkeeping, and the capacity_* tick pair the
+    # capacity-headroom-exhausted burn-rate rule consumes
+    from deeplearning4j_tpu.observability.timeseries import TsdbMetrics
+    from deeplearning4j_tpu.observability.usage import (
+        CapacityMetrics,
+        UsageMetrics,
+    )
+
+    TsdbMetrics(reg)
+    UsageMetrics(reg)
+    CapacityMetrics(reg)
     names.update(i.name for i in reg.instruments())
     return names
 
@@ -543,7 +556,7 @@ class HealthEngine:
                  interval_s: float = 10.0, time_scale: float = 1.0,
                  clock: Optional[Callable[[], float]] = None,
                  snapshot_every_s: float = 30.0,
-                 max_samples: int = 4096):
+                 max_samples: int = 4096, store=None):
         if time_scale <= 0:
             raise ValueError(f"time_scale must be > 0, got {time_scale}")
         if interval_s <= 0:
@@ -558,10 +571,20 @@ class HealthEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_snapshot: Optional[float] = None
+        # With a TimeSeriesStore armed, each rule's cumulative
+        # (t, bad, total) window lives in a store-owned deque
+        # (store.slo_series) instead of a parallel private one: same
+        # object type, same maxlen, identical evaluator semantics — but
+        # the history rides the store's snapshot/restore, so burn-rate
+        # windows survive a warm restart.
+        self._store = store
         self._runtimes = {
             r.name: _RuleRuntime(
                 rule=r,
-                samples=deque(maxlen=self._retention(r, max_samples)))
+                samples=(store.slo_series(r.name,
+                                          self._retention(r, max_samples))
+                         if store is not None else
+                         deque(maxlen=self._retention(r, max_samples))))
             for r in self.rules
         }
 

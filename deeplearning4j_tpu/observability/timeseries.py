@@ -1,0 +1,806 @@
+"""Bounded in-process multi-resolution time-series store (mini-TSDB).
+
+Every other observability surface answers "what is true *now*":
+``/metrics`` is one scrape, burn rates are windowed deltas over private
+deques, the flight ring evicts. This module gives the telemetry spine a
+**time axis**: a background sampler snapshots selected metric families
+from the live registries into fixed-size rings at tiered resolutions
+(1 s x 10 min, 10 s x 2 h, 60 s x 24 h by default), so "what did queue
+depth do over the last hour" and "what is the request rate trend" are
+answerable in-process, with no external TSDB.
+
+Design points:
+
+- **Bounded everywhere**: rings are fixed-capacity per tier, the series
+  count is capped (``DL4J_TPU_TSDB_MAX_SERIES``; overflow series are
+  dropped and counted, never grown), and a point is a small list — the
+  store's memory is a static function of its configuration.
+- **Multi-resolution downsampling**: every sample lands in the finest
+  tier; a coarser tier keeps one point per ``step_s`` bucket (the last
+  value wins — correct for cumulative counters — with the bucket max
+  retained for gauges, so ``max_over_time`` does not lose spikes).
+- **Counters stay cumulative** at rest; :meth:`TimeSeriesStore.rate`
+  converts to per-second rates at query time with counter-reset
+  detection (a restart's drop-to-zero reads as ``delta = new_value``,
+  not a huge negative rate).
+- **Histograms** keep (count, sum, cumulative bucket counts) per point,
+  so :meth:`TimeSeriesStore.quantile_over_time` answers "p99 over the
+  last 10 minutes" from bucket deltas — the same math the SLO engine
+  runs, but over history.
+- **Snapshot/restore is atomic**: :meth:`snapshot` is one JSON document
+  built under the lock; :meth:`restore` builds fresh state and swaps it
+  in, so history survives the warm-restart path alongside the warmup
+  manifest and compile cache.
+- **Collectors** let non-registry sources (the usage meter's per-tenant
+  accounts, the capacity evaluator's headroom gauges) roll up into the
+  same store on the sampler cadence via :meth:`ingest`.
+- The SLO engine's burn-rate windows deduplicate onto this store:
+  :meth:`slo_series` hands the engine a store-owned cumulative ring
+  (same deque semantics as its historical private one, included in
+  snapshot/restore) instead of each rule keeping parallel history.
+
+Served at ``GET /debug/timeseries?family=&window=&step=`` on
+ModelServer and federated at ``GET /cluster/debug/timeseries`` (worker
+series merged under worker/generation labels). Stdlib only; safe to
+import from any layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+
+ENV_TSDB_TIERS = "DL4J_TPU_TSDB_TIERS"
+ENV_TSDB_MAX_SERIES = "DL4J_TPU_TSDB_MAX_SERIES"
+ENV_TSDB_INTERVAL_S = "DL4J_TPU_TSDB_INTERVAL_S"
+
+SNAPSHOT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Tier:
+    """One retention tier: a ring of ``capacity`` points at ``step_s``
+    resolution (coverage = ``step_s * capacity`` seconds)."""
+
+    step_s: float
+    capacity: int
+
+    @property
+    def coverage_s(self) -> float:
+        return self.step_s * self.capacity
+
+    def to_json(self) -> dict:
+        return {"step_s": self.step_s, "capacity": self.capacity}
+
+
+# 1 s x 10 min / 10 s x 2 h / 60 s x 24 h — ~2.8k points per series.
+DEFAULT_TIERS: Tuple[Tier, ...] = (
+    Tier(1.0, 600), Tier(10.0, 720), Tier(60.0, 1440))
+
+
+def resolve_tiers(spec: Optional[str] = None) -> Tuple[Tier, ...]:
+    """Parse a ``"1x600,10x720,60x1440"`` tier spec (the
+    ``DL4J_TPU_TSDB_TIERS`` knob format); malformed specs fall back to
+    the defaults — a bad env var must not kill the process."""
+    if spec is None:
+        spec = os.environ.get(ENV_TSDB_TIERS) or ""
+    spec = spec.strip()
+    if not spec:
+        return DEFAULT_TIERS
+    try:
+        tiers = []
+        for part in spec.split(","):
+            step, _, cap = part.strip().partition("x")
+            tier = Tier(float(step), int(cap))
+            if tier.step_s <= 0 or tier.capacity < 1:
+                raise ValueError(part)
+            tiers.append(tier)
+        tiers.sort(key=lambda t: t.step_s)
+        return tuple(tiers) if tiers else DEFAULT_TIERS
+    except (ValueError, TypeError):
+        return DEFAULT_TIERS
+
+
+class TsdbMetrics:
+    """The store's own exposition (on the process default registry):
+    the sampler is observable like every other background plane."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        r = registry if registry is not None else _metrics.default_registry()
+        self.registry = r
+        ns = "tsdb"
+        self.samples_total = r.counter(
+            "samples_total", "Sampler passes completed (registry scrape "
+            "+ collector roll-up into the ring tiers).", namespace=ns)
+        self.sample_errors_total = r.counter(
+            "sample_errors_total", "Sampler passes (or individual "
+            "collectors) that raised and were swallowed — history "
+            "capture never fails the process.", namespace=ns)
+        self.series = r.gauge(
+            "series", "Live series (family x label-set) currently held "
+            "in the ring tiers.", namespace=ns)
+        self.points = r.gauge(
+            "points", "Points currently retained across all series and "
+            "tiers (the store's memory bound in sample units).",
+            namespace=ns)
+        self.series_dropped_total = r.counter(
+            "series_dropped_total", "New series rejected by the "
+            "max-series cardinality bound (existing series keep "
+            "sampling; the overflow is counted, never grown).",
+            namespace=ns)
+        self.restores_total = r.counter(
+            "restores_total", "Snapshot restores applied (the "
+            "warm-restart path carrying history across a process "
+            "swap).", namespace=ns)
+
+
+_tsdb_metrics: Optional[TsdbMetrics] = None
+_tm_lock = threading.Lock()
+
+
+def get_tsdb_metrics() -> TsdbMetrics:
+    global _tsdb_metrics
+    if _tsdb_metrics is None:
+        with _tm_lock:
+            if _tsdb_metrics is None:
+                _tsdb_metrics = TsdbMetrics()
+    return _tsdb_metrics
+
+
+def _drop_tsdb_metrics():
+    global _tsdb_metrics
+    _tsdb_metrics = None
+
+
+_metrics.register_reset_hook(_drop_tsdb_metrics)
+
+
+def _tsdb_metrics_or_none() -> Optional[TsdbMetrics]:
+    try:
+        if not _metrics.enabled():
+            return None
+        return get_tsdb_metrics()
+    except Exception:  # noqa: BLE001 — metrics never fail the store
+        return None
+
+
+# -- sampling kill switch (the bench overhead gate prices against it) ---------
+
+_SAMPLING_ENABLED = True
+
+
+def set_sampling_enabled(flag: bool) -> None:
+    """Kill switch for the sampler/ingest hot path (``bench.py
+    timeseries`` prices the plane against this)."""
+    global _SAMPLING_ENABLED
+    _SAMPLING_ENABLED = bool(flag)
+
+
+def sampling_enabled() -> bool:
+    return _SAMPLING_ENABLED
+
+
+# -- series storage -----------------------------------------------------------
+
+
+def _parse_bound(key: str) -> float:
+    return float("inf") if key == "+Inf" else float(key)
+
+
+class _Series:
+    """One (family, label-set) series: a ring per tier.
+
+    Scalar points are ``[t, value, vmax]`` (``vmax`` = max raw sample
+    folded into the point's bucket); histogram points are
+    ``[t, count, sum, [cum_0, ..., cum_n]]`` with the bucket bounds
+    held once at series level. Lists, not tuples: points serialize to
+    the snapshot document as-is.
+    """
+
+    __slots__ = ("kind", "bounds", "rings")
+
+    def __init__(self, kind: str, tiers: Sequence[Tier],
+                 bounds: Optional[List[float]] = None):
+        self.kind = kind
+        self.bounds = bounds            # histogram bucket bounds, sorted
+        self.rings: List[deque] = [deque(maxlen=t.capacity) for t in tiers]
+
+    def add_scalar(self, t: float, value: float, tiers: Sequence[Tier]):
+        for ring, tier in zip(self.rings, tiers):
+            if not ring or t >= ring[-1][0] + tier.step_s:
+                ring.append([t, value, value])
+            else:
+                last = ring[-1]
+                last[1] = value
+                last[2] = max(last[2], value)
+
+    def add_hist(self, t: float, count: float, total: float,
+                 cum: List[float], tiers: Sequence[Tier]):
+        for ring, tier in zip(self.rings, tiers):
+            if not ring or t >= ring[-1][0] + tier.step_s:
+                ring.append([t, count, total, cum])
+            else:
+                last = ring[-1]
+                last[1], last[2], last[3] = count, total, cum
+
+    def n_points(self) -> int:
+        return sum(len(r) for r in self.rings)
+
+
+def _labels_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
+
+def _labels_match(key: Tuple[Tuple[str, str], ...],
+                  want: Optional[Dict[str, str]]) -> bool:
+    if not want:
+        return True
+    have = dict(key)
+    return all(have.get(str(k)) == str(v) for k, v in want.items())
+
+
+class TimeSeriesStore:
+    """The in-process mini-TSDB: sampler + ring tiers + query API.
+
+    ``registries``: the metric registries the sampler scrapes (None =
+    the live process default registry, resolved per pass so registry
+    resets in tests are honored). ``families``: an allow-list of family
+    names to retain (None = everything exposed, up to ``max_series``).
+    ``clock`` is wall time by default — snapshots cross process
+    restarts, so points are wall-anchored.
+    """
+
+    def __init__(self, registries: Optional[Sequence] = None, *,
+                 tiers: Optional[Sequence[Tier]] = None,
+                 interval_s: Optional[float] = None,
+                 families: Optional[Sequence[str]] = None,
+                 max_series: Optional[int] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.tiers: Tuple[Tier, ...] = (tuple(tiers) if tiers is not None
+                                        else resolve_tiers())
+        if interval_s is None:
+            try:
+                interval_s = float(
+                    os.environ.get(ENV_TSDB_INTERVAL_S) or
+                    self.tiers[0].step_s)
+            except ValueError:
+                interval_s = self.tiers[0].step_s
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        if max_series is None:
+            try:
+                max_series = int(
+                    os.environ.get(ENV_TSDB_MAX_SERIES) or 512)
+            except ValueError:
+                max_series = 512
+        if max_series < 1:
+            raise ValueError(f"max_series must be >= 1, got {max_series}")
+        self.interval_s = float(interval_s)
+        self.max_series = int(max_series)
+        self.families_filter = frozenset(families) if families else None
+        self._registries = list(registries) if registries is not None else None
+        self._clock = clock if clock is not None else time.time
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                           _Series] = {}
+        self._slo_series: Dict[str, deque] = {}
+        self._collectors: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_sample: Optional[float] = None
+        self._samples = 0
+
+    # -- wiring ---------------------------------------------------------------
+
+    def _resolve_registries(self):
+        if self._registries is not None:
+            return self._registries
+        return [_metrics.default_registry()]
+
+    def add_collector(self, fn: Callable[[float], Sequence[tuple]], *,
+                      every_s: Optional[float] = None) -> None:
+        """Register ``fn(now) -> [(family, labels, kind, value), ...]``
+        to roll external cumulative series (usage accounts, capacity
+        gauges) into the store. Runs on the sampler cadence, throttled
+        to ``every_s`` when given; a raising collector is counted and
+        skipped, never fatal."""
+        self._collectors.append(
+            {"fn": fn, "every_s": every_s, "last": None})
+
+    def slo_series(self, name: str, maxlen: int) -> deque:
+        """The SLO engine's cumulative ``(t, bad, total)`` ring for one
+        rule, owned by the store (and therefore snapshot/restored with
+        it). Same deque semantics the engine historically kept
+        privately — handing it out here is the dedup, not a behavior
+        change. Re-requesting with a different ``maxlen`` re-caps while
+        preserving the retained tail."""
+        with self._lock:
+            d = self._slo_series.get(name)
+            if d is None or d.maxlen != maxlen:
+                d = deque(list(d or ()), maxlen=max(1, int(maxlen)))
+                self._slo_series[name] = d
+            return d
+
+    # -- sampling -------------------------------------------------------------
+
+    def sample(self, now: Optional[float] = None) -> int:
+        """One sampler pass: scrape the registries' JSON document into
+        the ring tiers, then run due collectors. Returns the number of
+        series touched. Never raises."""
+        if not _SAMPLING_ENABLED:
+            return 0
+        t = self._clock() if now is None else now
+        tm = _tsdb_metrics_or_none()
+        touched = 0
+        try:
+            doc = _metrics.render_json_multi(self._resolve_registries())
+            with self._lock:
+                for fam in doc.get("metrics", []):
+                    name = fam.get("name")
+                    if self.families_filter is not None \
+                            and name not in self.families_filter:
+                        continue
+                    kind = fam.get("type")
+                    for s in fam.get("samples", []):
+                        if self._ingest_locked(name, s.get("labels") or {},
+                                               kind, s, t):
+                            touched += 1
+                self._last_sample = t
+                self._samples += 1
+        except Exception:  # noqa: BLE001 — history capture never fails
+            if tm is not None:
+                tm.sample_errors_total.inc()
+            return touched
+        for col in self._collectors:
+            if col["every_s"] is not None and col["last"] is not None \
+                    and t - col["last"] < col["every_s"]:
+                continue
+            col["last"] = t
+            try:
+                points = col["fn"](t) or ()
+                with self._lock:
+                    for family, labels, kind, value in points:
+                        self._ingest_locked(
+                            family, labels or {}, kind,
+                            {"value": float(value)}, t)
+            except Exception:  # noqa: BLE001 — a bad collector is skipped
+                if tm is not None:
+                    tm.sample_errors_total.inc()
+        if tm is not None:
+            tm.samples_total.inc()
+            with self._lock:
+                tm.series.set(len(self._series))
+                tm.points.set(sum(s.n_points()
+                                  for s in self._series.values()))
+        return touched
+
+    def ingest(self, family: str, labels: Dict[str, str], kind: str,
+               value, now: Optional[float] = None) -> None:
+        """Write one external point (``kind`` of ``counter`` / ``gauge``
+        expects a float ``value``) — the collector path, callable
+        directly in tests."""
+        if not _SAMPLING_ENABLED:
+            return
+        t = self._clock() if now is None else now
+        with self._lock:
+            self._ingest_locked(family, labels or {}, kind,
+                                {"value": float(value)}, t)
+
+    def _ingest_locked(self, family: str, labels: Dict[str, str],
+                       kind: str, sample: dict, t: float) -> bool:
+        key = (family, _labels_key(labels))
+        series = self._series.get(key)
+        if series is None:
+            if len(self._series) >= self.max_series:
+                tm = _tsdb_metrics_or_none()
+                if tm is not None:
+                    tm.series_dropped_total.inc()
+                return False
+            bounds = None
+            if kind == "histogram":
+                bounds = sorted(_parse_bound(k)
+                                for k in sample.get("buckets", {}))
+            series = _Series(kind or "gauge", self.tiers, bounds)
+            self._series[key] = series
+        if kind == "histogram":
+            buckets = sample.get("buckets", {})
+            bounds = sorted(_parse_bound(k) for k in buckets)
+            if series.bounds != bounds:
+                # bucket layout changed (re-registered family): restart
+                # the series rather than mixing incomparable points
+                series.bounds = bounds
+                for ring in series.rings:
+                    ring.clear()
+            cum = [float(buckets[("+Inf" if b == float("inf")
+                                  else _metrics._fmt(b))])
+                   for b in bounds]
+            series.add_hist(t, float(sample.get("count", 0.0)),
+                            float(sample.get("sum", 0.0)), cum, self.tiers)
+        else:
+            series.add_scalar(t, float(sample.get("value", 0.0)),
+                              self.tiers)
+        return True
+
+    # -- background thread ----------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "TimeSeriesStore":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tsdb-sampler")
+        self._thread.start()
+        record_event("tsdb.start", interval_s=self.interval_s,
+                     tiers=[t.to_json() for t in self.tiers])
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.sample()
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None
+        record_event("tsdb.stop", samples=self._samples)
+
+    # -- query API ------------------------------------------------------------
+
+    def _tier_index(self, window_s: float,
+                    step_s: Optional[float] = None) -> int:
+        """The finest tier that both covers ``window_s`` and (when
+        given) has step >= the requested ``step_s``; falls back to the
+        coarsest tier when nothing covers the window."""
+        for i, tier in enumerate(self.tiers):
+            if step_s is not None and tier.step_s < step_s * (1 - 1e-9):
+                continue
+            if tier.coverage_s >= window_s:
+                return i
+        return len(self.tiers) - 1
+
+    def _select(self, family: str, labels: Optional[Dict[str, str]]):
+        return [(dict(key[1]), s) for key, s in self._series.items()
+                if key[0] == family and _labels_match(key[1], labels)]
+
+    def range(self, family: str, *, window_s: float,
+              step_s: Optional[float] = None,
+              labels: Optional[Dict[str, str]] = None,
+              now: Optional[float] = None) -> dict:
+        """Raw points per matching series over the trailing window, at
+        the tier resolution chosen for (window, step)."""
+        t = self._clock() if now is None else now
+        idx = self._tier_index(window_s, step_s)
+        cutoff = t - float(window_s)
+        out = []
+        kind = None
+        with self._lock:
+            for lbls, series in self._select(family, labels):
+                kind = kind or series.kind
+                ring = series.rings[idx]
+                if series.kind == "histogram":
+                    pts = [[p[0], p[1]] for p in ring if p[0] >= cutoff]
+                else:
+                    pts = [[p[0], p[1]] for p in ring if p[0] >= cutoff]
+                out.append({"labels": lbls, "points": pts})
+        return {"family": family, "kind": kind,
+                "window_s": float(window_s),
+                "step_s": self.tiers[idx].step_s, "series": out}
+
+    def rate(self, family: str, *, window_s: float,
+             step_s: Optional[float] = None,
+             labels: Optional[Dict[str, str]] = None,
+             now: Optional[float] = None) -> dict:
+        """Counter -> per-second rate series with reset detection: a
+        drop in the cumulative value reads as a restart, contributing
+        ``new_value`` (the counter restarted from zero), never a
+        negative rate. Histogram series rate over their observation
+        counts. The top-level ``rate`` sums the per-series window
+        rates — offered load for a family like
+        ``serving_requests_total``."""
+        t = self._clock() if now is None else now
+        idx = self._tier_index(window_s, step_s)
+        cutoff = t - float(window_s)
+        out = []
+        total_rate = 0.0
+        with self._lock:
+            for lbls, series in self._select(family, labels):
+                ring = series.rings[idx]
+                pts = [p for p in ring if p[0] >= cutoff]
+                rate_pts = []
+                win_delta = 0.0
+                for prev, cur in zip(pts, pts[1:]):
+                    dv = cur[1] - prev[1]
+                    if dv < 0:            # counter reset
+                        dv = cur[1]
+                    dt = cur[0] - prev[0]
+                    if dt > 0:
+                        rate_pts.append([cur[0], dv / dt])
+                    win_delta += max(0.0, dv)
+                span = pts[-1][0] - pts[0][0] if len(pts) >= 2 else 0.0
+                series_rate = win_delta / span if span > 0 else 0.0
+                total_rate += series_rate
+                out.append({"labels": lbls, "points": rate_pts,
+                            "rate": series_rate})
+        return {"family": family, "window_s": float(window_s),
+                "step_s": self.tiers[idx].step_s, "rate": total_rate,
+                "series": out}
+
+    def quantile_over_time(self, family: str, q: float, *,
+                           window_s: float,
+                           labels: Optional[Dict[str, str]] = None,
+                           now: Optional[float] = None) -> dict:
+        """The q-quantile of a histogram family's observations that
+        landed inside the trailing window, from cumulative-bucket
+        deltas with linear interpolation inside the chosen bucket (the
+        Prometheus ``histogram_quantile`` recipe, over history). A
+        counter reset inside the window degrades to the latest absolute
+        counts."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        t = self._clock() if now is None else now
+        idx = self._tier_index(window_s)
+        cutoff = t - float(window_s)
+        agg: Optional[List[float]] = None
+        bounds: Optional[List[float]] = None
+        count = 0.0
+        with self._lock:
+            for _lbls, series in self._select(family, labels):
+                if series.kind != "histogram" or series.bounds is None:
+                    continue
+                ring = series.rings[idx]
+                pts = [p for p in ring if p[0] >= cutoff]
+                if not pts:
+                    continue
+                first, last = pts[0], pts[-1]
+                dc = [c1 - c0 for c0, c1 in zip(first[3], last[3])]
+                if any(d < -1e-9 for d in dc):
+                    dc = list(last[3])     # reset inside the window
+                if bounds is None:
+                    bounds = list(series.bounds)
+                    agg = [0.0] * len(bounds)
+                if list(series.bounds) != bounds:
+                    continue               # incomparable bucket layout
+                for i, d in enumerate(dc):
+                    agg[i] += max(0.0, d)
+                count += max(0.0, last[1] - first[1])
+        if not agg or agg[-1] <= 0:
+            return {"family": family, "q": q, "window_s": float(window_s),
+                    "count": 0.0, "value": None}
+        total = agg[-1]
+        target = q * total
+        value = None
+        for i, cum in enumerate(agg):
+            if cum >= target:
+                if math.isinf(bounds[i]):
+                    # observations beyond the largest finite bound:
+                    # report that bound (the honest floor)
+                    value = bounds[i - 1] if i > 0 else 0.0
+                    break
+                lo = bounds[i - 1] if i > 0 else 0.0
+                prev = agg[i - 1] if i > 0 else 0.0
+                width = cum - prev
+                frac = (target - prev) / width if width > 0 else 1.0
+                value = lo + frac * (bounds[i] - lo)
+                break
+        return {"family": family, "q": q, "window_s": float(window_s),
+                "count": count, "value": value}
+
+    def max_over_time(self, family: str, *, window_s: float,
+                      labels: Optional[Dict[str, str]] = None,
+                      now: Optional[float] = None) -> dict:
+        """The max raw sample folded into any point of the window
+        (downsampling keeps per-bucket maxima, so a coarser tier does
+        not lose gauge spikes)."""
+        t = self._clock() if now is None else now
+        idx = self._tier_index(window_s)
+        cutoff = t - float(window_s)
+        best = None
+        per_series = []
+        with self._lock:
+            for lbls, series in self._select(family, labels):
+                if series.kind == "histogram":
+                    continue
+                ring = series.rings[idx]
+                vals = [p[2] for p in ring if p[0] >= cutoff]
+                if not vals:
+                    continue
+                m = max(vals)
+                per_series.append({"labels": lbls, "max": m})
+                best = m if best is None else max(best, m)
+        return {"family": family, "window_s": float(window_s),
+                "value": best, "series": per_series}
+
+    def families(self) -> List[str]:
+        with self._lock:
+            return sorted({key[0] for key in self._series})
+
+    def describe(self) -> dict:
+        with self._lock:
+            n_points = sum(s.n_points() for s in self._series.values())
+            return {
+                "tiers": [t.to_json() for t in self.tiers],
+                "interval_s": self.interval_s,
+                "max_series": self.max_series,
+                "series": len(self._series),
+                "points": n_points,
+                "samples": self._samples,
+                "last_sample": self._last_sample,
+                "running": self.running,
+                "families": sorted({key[0] for key in self._series}),
+            }
+
+    # -- snapshot / restore ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """One atomic JSON document of every ring (and the SLO engine's
+        store-owned windows) — what the telemetry exporter snapshot and
+        the warm-restart path carry."""
+        with self._lock:
+            series = []
+            for (family, lkey), s in self._series.items():
+                series.append({
+                    "family": family,
+                    "labels": dict(lkey),
+                    "kind": s.kind,
+                    "bounds": (["+Inf" if math.isinf(b) else b
+                                for b in s.bounds]
+                               if s.bounds is not None else None),
+                    "rings": [[list(p) for p in ring]
+                              for ring in s.rings],
+                })
+            return {
+                "version": SNAPSHOT_VERSION,
+                "time": self._clock(),
+                "tiers": [t.to_json() for t in self.tiers],
+                "samples": self._samples,
+                "series": series,
+                "slo": {name: [list(p) for p in d]
+                        for name, d in self._slo_series.items()},
+            }
+
+    def restore(self, doc: dict) -> bool:
+        """Atomically replace the store's state from a snapshot
+        document (tier layouts must match point-for-point restore; a
+        mismatched snapshot re-buckets through the normal downsampling
+        path). Returns False on an unusable document — restore is
+        best-effort, never fatal."""
+        try:
+            if not isinstance(doc, dict) or \
+                    int(doc.get("version", -1)) != SNAPSHOT_VERSION:
+                return False
+            same_tiers = [Tier(float(t["step_s"]), int(t["capacity"]))
+                          for t in doc.get("tiers", [])] == list(self.tiers)
+            new_series: Dict = {}
+            for sd in doc.get("series", []):
+                family = sd["family"]
+                lkey = _labels_key(sd.get("labels") or {})
+                kind = sd.get("kind") or "gauge"
+                bounds = sd.get("bounds")
+                if bounds is not None:
+                    bounds = sorted(_parse_bound(str(b)) for b in bounds)
+                series = _Series(kind, self.tiers, bounds)
+                rings = sd.get("rings") or []
+                if same_tiers:
+                    for ring, pts in zip(series.rings, rings):
+                        for p in pts:
+                            ring.append(list(p))
+                else:
+                    # replay the finest preserved ring through the
+                    # store's own downsampling
+                    for pts in rings[:1]:
+                        for p in pts:
+                            if kind == "histogram":
+                                series.add_hist(p[0], p[1], p[2],
+                                                list(p[3]), self.tiers)
+                            else:
+                                series.add_scalar(p[0], p[1], self.tiers)
+                if len(new_series) < self.max_series:
+                    new_series[(family, lkey)] = series
+            new_slo = {}
+            for name, pts in (doc.get("slo") or {}).items():
+                old = self._slo_series.get(name)
+                maxlen = old.maxlen if old is not None else max(
+                    16, len(pts))
+                d = deque(maxlen=maxlen)
+                for p in pts:
+                    d.append(tuple(p))
+                new_slo[name] = d
+            with self._lock:
+                self._series = new_series
+                # re-cap restored SLO windows onto any deques already
+                # handed to a live engine: the engine keeps its object,
+                # so refill in place rather than swapping the dict
+                for name, d in new_slo.items():
+                    live = self._slo_series.get(name)
+                    if live is not None:
+                        live.clear()
+                        live.extend(d)
+                    else:
+                        self._slo_series[name] = d
+        except Exception:  # noqa: BLE001 — a bad snapshot restores nothing
+            return False
+        tm = _tsdb_metrics_or_none()
+        if tm is not None:
+            tm.restores_total.inc()
+        record_event("tsdb.restore", series=len(self._series))
+        return True
+
+
+# -- process-global store (federation snapshot + zero-config consumers) -------
+
+_STORE: Optional[TimeSeriesStore] = None
+_store_lock = threading.Lock()
+
+
+def set_timeseries_store(store: Optional[TimeSeriesStore]) -> None:
+    """Publish a store as the process default (ModelServer does on
+    start) so the federation snapshot and zero-config consumers can
+    read history without plumbing."""
+    global _STORE
+    with _store_lock:
+        _STORE = store
+
+
+def get_timeseries_store() -> Optional[TimeSeriesStore]:
+    return _STORE
+
+
+def timeseries_index() -> Optional[dict]:
+    """This process's store snapshot, or None — what the federation
+    snapshot embeds (never creates a store as a side effect, never
+    raises)."""
+    store = get_timeseries_store()
+    if store is None:
+        return None
+    try:
+        return store.snapshot()
+    except Exception:  # noqa: BLE001 — telemetry never fails the caller
+        return None
+
+
+def store_from_snapshot(doc: dict) -> Optional[TimeSeriesStore]:
+    """Rebuild a queryable store from a snapshot document (the
+    aggregator answers fleet history queries against these). None when
+    the document is unusable."""
+    try:
+        tiers = tuple(Tier(float(t["step_s"]), int(t["capacity"]))
+                      for t in doc.get("tiers", [])) or None
+    except (TypeError, ValueError, KeyError):
+        tiers = None
+    store = TimeSeriesStore(registries=[], tiers=tiers,
+                            interval_s=1.0, max_series=4096)
+    return store if store.restore(doc) else None
+
+
+__all__ = [
+    "DEFAULT_TIERS",
+    "ENV_TSDB_INTERVAL_S",
+    "ENV_TSDB_MAX_SERIES",
+    "ENV_TSDB_TIERS",
+    "SNAPSHOT_VERSION",
+    "Tier",
+    "TimeSeriesStore",
+    "TsdbMetrics",
+    "get_timeseries_store",
+    "get_tsdb_metrics",
+    "resolve_tiers",
+    "sampling_enabled",
+    "set_sampling_enabled",
+    "set_timeseries_store",
+    "store_from_snapshot",
+    "timeseries_index",
+]

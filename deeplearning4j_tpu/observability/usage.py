@@ -1,0 +1,614 @@
+"""Per-tenant / per-model usage metering and capacity headroom.
+
+The request ledger answers "what happened to request X"; ``/metrics``
+answers "how is the process doing". Neither answers the accounting
+question — *who* consumed the fleet, in which currency (requests,
+tokens, device-batch-seconds, FLOPs) — or the planning question — how
+close is each backend to its measured peak. This module adds both:
+
+- :class:`UsageMeter`: bounded-cardinality accounts keyed
+  (tenant, model), fed from the request ledger's finish path (both
+  serving planes flow through it, so predict and generation meter
+  uniformly) and from the model registry's ``on_batch`` hook
+  (device-batch-seconds and estimated FLOPs = static ``cost_analysis``
+  x batches). The FLOPs-per-batch cache is keyed by the entry's
+  **active version**, so a hot-swap or rollback re-resolves the cost
+  model instead of billing the old version's FLOPs. Accounts roll up
+  into the time-series store as synthetic cumulative families
+  (``usage_*_total``) on the sampler cadence, and
+  :meth:`UsageMeter.describe` reconciles metered request counts against
+  the ledger's window.
+- :class:`CapacityEvaluator`: per-model offered load (rate over the
+  store) vs the measured running peak -> occupancy, headroom, trend
+  and an ``ok`` / ``warn`` / ``exhausted`` verdict per model and for
+  the backend — the input contract for the autoscaler (ROADMAP item
+  5). Verdict flips are flight-recorded; the exhausted condition also
+  ticks a counter pair that the ``capacity-headroom-exhausted``
+  burn-rate rule consumes.
+
+Served at ``GET /debug/usage`` / ``GET /debug/capacity`` and federated
+at ``/cluster/debug/{usage,capacity}``. Stdlib only; every hook
+swallows its own failures — metering never fails serving.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.observability import metrics as _metrics
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+
+ENV_USAGE_MAX_ACCOUNTS = "DL4J_TPU_USAGE_MAX_ACCOUNTS"
+ENV_USAGE_ROLLUP_S = "DL4J_TPU_USAGE_ROLLUP_S"
+
+#: Overflow bucket: once the account table is full, new tenants fold
+#: into this pseudo-tenant per model instead of growing the table.
+OVERFLOW_TENANT = "__other__"
+
+#: Tenant label used when a request carried no tenant annotation.
+ANON_TENANT = "-"
+
+
+class UsageMetrics:
+    """The meter's own exposition (default registry)."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        r = registry if registry is not None else _metrics.default_registry()
+        self.registry = r
+        ns = "usage"
+        self.accounts = r.gauge(
+            "accounts", "Live (tenant, model) usage accounts (bounded "
+            "by DL4J_TPU_USAGE_MAX_ACCOUNTS; overflow folds into the "
+            "__other__ tenant).", namespace=ns)
+        self.overflow_total = r.counter(
+            "overflow_total", "Records folded into the __other__ "
+            "overflow tenant because the account table was full.",
+            namespace=ns)
+        self.errors_total = r.counter(
+            "errors_total", "Metering hook invocations that raised and "
+            "were swallowed — usage accounting never fails serving.",
+            namespace=ns)
+
+
+class CapacityMetrics:
+    """The capacity evaluator's exposition. The tick pair feeds the
+    ``capacity-headroom-exhausted`` burn-rate rule (bad/total)."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        r = registry if registry is not None else _metrics.default_registry()
+        self.registry = r
+        ns = "capacity"
+        self.ticks_total = r.counter(
+            "ticks_total", "Capacity evaluation passes (the burn-rate "
+            "rule's total stream).", namespace=ns)
+        self.exhausted_ticks_total = r.counter(
+            "exhausted_ticks_total", "Evaluation passes during which at "
+            "least one model's headroom verdict was 'exhausted' (the "
+            "burn-rate rule's bad stream).", namespace=ns)
+        self.headroom = r.gauge(
+            "headroom", "Current headroom fraction per model: 1 - "
+            "offered_rate / measured_peak_rate (1.0 = idle, 0.0 = at "
+            "measured peak).", labelnames=("model",), namespace=ns)
+        self.peak_rps = r.gauge(
+            "peak_rps", "Measured peak request rate per model — the "
+            "running max of observed window rates (re-seeded from "
+            "TSDB history after a warm restart).",
+            labelnames=("model",), namespace=ns)
+
+
+_usage_metrics: Optional[UsageMetrics] = None
+_capacity_metrics: Optional[CapacityMetrics] = None
+_um_lock = threading.Lock()
+
+
+def get_usage_metrics() -> UsageMetrics:
+    global _usage_metrics
+    if _usage_metrics is None:
+        with _um_lock:
+            if _usage_metrics is None:
+                _usage_metrics = UsageMetrics()
+    return _usage_metrics
+
+
+def get_capacity_metrics() -> CapacityMetrics:
+    global _capacity_metrics
+    if _capacity_metrics is None:
+        with _um_lock:
+            if _capacity_metrics is None:
+                _capacity_metrics = CapacityMetrics()
+    return _capacity_metrics
+
+
+def _drop_usage_metrics():
+    global _usage_metrics, _capacity_metrics
+    _usage_metrics = None
+    _capacity_metrics = None
+
+
+_metrics.register_reset_hook(_drop_usage_metrics)
+
+
+def _usage_metrics_or_none() -> Optional[UsageMetrics]:
+    try:
+        if not _metrics.enabled():
+            return None
+        return get_usage_metrics()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _capacity_metrics_or_none() -> Optional[CapacityMetrics]:
+    try:
+        if not _metrics.enabled():
+            return None
+        return get_capacity_metrics()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _new_tenant_account() -> dict:
+    return {"requests": 0, "errors": 0, "tokens_in": 0, "tokens_out": 0,
+            "planes": {}}
+
+
+def _new_model_account() -> dict:
+    return {"batches": 0, "batched_requests": 0, "batch_seconds": 0.0,
+            "est_flops": 0.0, "flops_unresolved_batches": 0}
+
+
+class UsageMeter:
+    """Cumulative usage accounts on both serving planes.
+
+    Feed it with :meth:`on_record` (install via
+    ``reqlog.set_usage_sink``) and :meth:`on_batch` (install via
+    ``ModelRegistry.add_batch_listener``); point :meth:`collect` at a
+    :class:`~deeplearning4j_tpu.observability.timeseries.TimeSeriesStore`
+    collector slot to get history. All hooks swallow their own
+    exceptions and count them.
+    """
+
+    def __init__(self, *, max_accounts: Optional[int] = None,
+                 cost_resolver: Optional[Callable] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        if max_accounts is None:
+            try:
+                max_accounts = int(
+                    os.environ.get(ENV_USAGE_MAX_ACCOUNTS) or 256)
+            except ValueError:
+                max_accounts = 256
+        if max_accounts < 1:
+            raise ValueError(
+                f"max_accounts must be >= 1, got {max_accounts}")
+        self.max_accounts = int(max_accounts)
+        self._lock = threading.Lock()
+        self._tenants: Dict[Tuple[str, str], dict] = {}
+        self._models: Dict[str, dict] = {}
+        # FLOPs-per-batch keyed by the entry's ACTIVE version: a
+        # hot-swap/rollback changes the version, so the next batch
+        # re-resolves cost_analysis instead of billing the old
+        # version's cost model (the /debug/costs drift fix).
+        self._cost_cache: Dict[Tuple[str, str, int], Optional[float]] = {}
+        self._cost_resolver = cost_resolver
+        self._clock = clock if clock is not None else time.time
+        self._overflow = 0
+        self._overflow_seen: set = set()
+        self._started = self._clock()
+
+    def set_cost_resolver(self, fn: Optional[Callable]) -> None:
+        """``fn(model_name) -> ModelEntry | None`` — how the meter
+        finds the active entry (and therefore the active version) when
+        pricing a batch. ModelServer installs its registry's ``get``."""
+        self._cost_resolver = fn
+
+    # -- write path -----------------------------------------------------------
+
+    def on_record(self, rec: dict) -> None:
+        """Ledger finish sink: attribute one sealed request record to
+        its (tenant, model) account. Never raises."""
+        try:
+            model = str(rec.get("model") or "?")
+            tenant = str(rec.get("tenant") or ANON_TENANT)
+            plane = str(rec.get("plane") or "?")
+            outcome = str(rec.get("outcome") or "?")
+            tokens_out = rec.get("tokens")
+            tokens_in = rec.get("prompt_len")
+            with self._lock:
+                acct = self._account_locked(tenant, model)
+                acct["requests"] += 1
+                if outcome not in ("ok", "completed"):
+                    acct["errors"] += 1
+                planes = acct["planes"]
+                planes[plane] = planes.get(plane, 0) + 1
+                if tokens_in is not None:
+                    acct["tokens_in"] += int(tokens_in)
+                if tokens_out is not None:
+                    acct["tokens_out"] += int(tokens_out)
+        except Exception:  # noqa: BLE001 — metering never fails serving
+            m = _usage_metrics_or_none()
+            if m is not None:
+                m.errors_total.inc()
+
+    def _account_locked(self, tenant: str, model: str) -> dict:
+        key = (tenant, model)
+        acct = self._tenants.get(key)
+        if acct is not None:
+            return acct
+        if len(self._tenants) >= self.max_accounts \
+                and tenant != OVERFLOW_TENANT:
+            # table full: fold into the per-model overflow tenant (its
+            # accounts are bounded by the registry's model count)
+            self._overflow += 1
+            m = _usage_metrics_or_none()
+            if m is not None:
+                m.overflow_total.inc()
+            if model not in self._overflow_seen:
+                self._overflow_seen.add(model)
+                record_event("usage.overflow", model=model,
+                             max_accounts=self.max_accounts)
+            return self._account_locked(OVERFLOW_TENANT, model)
+        acct = self._tenants[key] = _new_tenant_account()
+        m = _usage_metrics_or_none()
+        if m is not None:
+            m.accounts.set(len(self._tenants))
+        return acct
+
+    def on_batch(self, name: str, n_requests: int, rows: int,
+                 bucket: int, seconds: float) -> None:
+        """Registry batch listener: device-batch-seconds and estimated
+        FLOPs (static cost x 1 batch) per model. Never raises."""
+        try:
+            flops = self._flops_for(name, int(bucket or rows or 1))
+            with self._lock:
+                acct = self._models.get(name)
+                if acct is None:
+                    acct = self._models[name] = _new_model_account()
+                acct["batches"] += 1
+                acct["batched_requests"] += int(n_requests)
+                acct["batch_seconds"] += float(seconds)
+                if flops is not None:
+                    acct["est_flops"] += float(flops)
+                else:
+                    acct["flops_unresolved_batches"] += 1
+        except Exception:  # noqa: BLE001 — metering never fails serving
+            m = _usage_metrics_or_none()
+            if m is not None:
+                m.errors_total.inc()
+
+    def _flops_for(self, name: str, rows: int) -> Optional[float]:
+        resolver = self._cost_resolver
+        if resolver is None:
+            return None
+        try:
+            entry = resolver(name)
+            if entry is None:
+                return None
+            version = str(entry.version)
+            key = (name, version, rows)
+            if key in self._cost_cache:
+                return self._cost_cache[key]
+            ca = entry.cost_analysis(rows=rows)
+            flops = (float(ca["flops"])
+                     if ca.get("available") and ca.get("flops") else None)
+            if len(self._cost_cache) > 256:     # bounded: versions churn
+                self._cost_cache.clear()
+            self._cost_cache[key] = flops
+            return flops
+        except Exception:  # noqa: BLE001 — cost pricing is best-effort
+            return None
+
+    # -- read path ------------------------------------------------------------
+
+    def collect(self, now: float) -> List[tuple]:
+        """TSDB collector: the accounts as synthetic cumulative
+        families — ``(family, labels, kind, value)`` tuples for
+        :meth:`TimeSeriesStore.ingest`."""
+        out: List[tuple] = []
+        with self._lock:
+            for (tenant, model), acct in self._tenants.items():
+                base = {"tenant": tenant, "model": model}
+                out.append(("usage_tenant_requests_total", base,
+                            "counter", acct["requests"]))
+                out.append(("usage_tenant_tokens_total",
+                            dict(base, direction="in"), "counter",
+                            acct["tokens_in"]))
+                out.append(("usage_tenant_tokens_total",
+                            dict(base, direction="out"), "counter",
+                            acct["tokens_out"]))
+            for model, acct in self._models.items():
+                lbl = {"model": model}
+                out.append(("usage_model_batches_total", lbl, "counter",
+                            acct["batches"]))
+                out.append(("usage_model_batch_seconds_total", lbl,
+                            "counter", acct["batch_seconds"]))
+                out.append(("usage_model_est_flops_total", lbl,
+                            "counter", acct["est_flops"]))
+        return out
+
+    def describe(self, *, ledger=None) -> dict:
+        """The ``/debug/usage`` document. With a ledger, each account
+        carries a reconciliation block: the ledger's retained-window
+        count for the same (tenant, model) and whether the cumulative
+        meter covers it (it must — both are fed from the same finish
+        path; a shortfall means lost attribution)."""
+        ledger_counts: Dict[Tuple[str, str], int] = {}
+        if ledger is not None:
+            try:
+                for rec in ledger.recent(limit=4096):
+                    if rec.get("state") != "done":
+                        continue
+                    key = (str(rec.get("tenant") or ANON_TENANT),
+                           str(rec.get("model") or "?"))
+                    ledger_counts[key] = ledger_counts.get(key, 0) + 1
+            except Exception:  # noqa: BLE001 — reconciliation is advisory
+                ledger_counts = {}
+        with self._lock:
+            tenants = []
+            totals = {"requests": 0, "errors": 0, "tokens_in": 0,
+                      "tokens_out": 0}
+            for (tenant, model), acct in sorted(self._tenants.items()):
+                row = {"tenant": tenant, "model": model, **{
+                    k: v for k, v in acct.items() if k != "planes"},
+                    "planes": dict(acct["planes"])}
+                for k in totals:
+                    totals[k] += acct[k]
+                if ledger_counts or ledger is not None:
+                    # overflow accounts aggregate many real tenants;
+                    # their ledger twin is under the real tenant names,
+                    # so reconciliation only applies to direct accounts
+                    lw = ledger_counts.get((tenant, model))
+                    if tenant != OVERFLOW_TENANT and lw is not None:
+                        row["reconciliation"] = {
+                            "ledger_window": lw,
+                            "metered": acct["requests"],
+                            "covered": acct["requests"] >= lw,
+                        }
+                tenants.append(row)
+            models = {m: dict(a) for m, a in sorted(self._models.items())}
+            return {
+                "since": self._started,
+                "max_accounts": self.max_accounts,
+                "accounts": len(self._tenants),
+                "overflow_folds": self._overflow,
+                "tenants": tenants,
+                "models": models,
+                "totals": totals,
+            }
+
+
+class CapacityEvaluator:
+    """Headroom verdicts per model and backend, from TSDB history.
+
+    ``evaluate()`` reads offered load per model off the store (request
+    counters on both planes), tracks the measured running peak, and
+    derives occupancy / headroom / trend / verdict. Thresholds are on
+    headroom: below ``warn_headroom`` -> ``warn``; below
+    ``exhausted_headroom`` -> ``exhausted``. The report is the
+    autoscaler's input contract: a scale-out candidate is a backend
+    whose verdict is warn/exhausted with a rising trend; scale-to-zero
+    wants ``ok`` with rate ~0 over the long window.
+    """
+
+    RATE_FAMILIES = ("serving_requests_total", "generation_requests_total")
+
+    def __init__(self, store, *, resolver: Optional[Callable] = None,
+                 warn_headroom: float = 0.30,
+                 exhausted_headroom: float = 0.10,
+                 window_s: float = 60.0, trend_window_s: float = 600.0,
+                 clock: Optional[Callable[[], float]] = None):
+        if not 0.0 <= exhausted_headroom <= warn_headroom <= 1.0:
+            raise ValueError(
+                "need 0 <= exhausted_headroom <= warn_headroom <= 1, "
+                f"got {exhausted_headroom}/{warn_headroom}")
+        self.store = store
+        self.warn_headroom = float(warn_headroom)
+        self.exhausted_headroom = float(exhausted_headroom)
+        self.window_s = float(window_s)
+        self.trend_window_s = float(trend_window_s)
+        self._resolver = resolver
+        self._clock = clock if clock is not None else time.time
+        self._lock = threading.Lock()
+        self._peak: Dict[str, float] = {}
+        self._verdicts: Dict[str, str] = {}
+        self._footprints: Dict[Tuple[str, str], dict] = {}
+        self.last: Optional[dict] = None
+
+    def set_resolver(self, fn: Optional[Callable]) -> None:
+        """``fn(model) -> ModelEntry | None`` for footprint data."""
+        self._resolver = fn
+
+    def _rates(self, now: float, window_s: float) -> Dict[str, float]:
+        rates: Dict[str, float] = {}
+        for family in self.RATE_FAMILIES:
+            doc = self.store.rate(family, window_s=window_s, now=now)
+            for series in doc.get("series", []):
+                model = series.get("labels", {}).get("model", "?")
+                rates[model] = rates.get(model, 0.0) + series.get(
+                    "rate", 0.0)
+        return rates
+
+    def _seed_peak(self, model: str, now: float) -> float:
+        """After a warm restart the running peak restarts at 0 but the
+        restored TSDB still holds the ``capacity_peak_rps`` gauge
+        history — re-seed from it so one restart doesn't erase the
+        measured peak."""
+        try:
+            doc = self.store.max_over_time(
+                "capacity_peak_rps", window_s=self.store.tiers[-1].coverage_s,
+                labels={"model": model}, now=now)
+            return float(doc.get("value") or 0.0)
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    def _footprint(self, model: str) -> Optional[dict]:
+        resolver = self._resolver
+        if resolver is None:
+            return None
+        try:
+            entry = resolver(model)
+            if entry is None:
+                return None
+            version = str(entry.version)
+            key = (model, version)
+            cached = self._footprints.get(key)
+            if cached is not None:
+                return dict(cached)
+            ca = entry.cost_analysis()
+            fp = {"version": version,
+                  "rows": ca.get("rows"),
+                  "flops_per_batch": ca.get("flops"),
+                  "bytes_per_batch": ca.get("bytes_accessed"),
+                  "available": bool(ca.get("available"))}
+            if len(self._footprints) > 64:
+                self._footprints.clear()
+            self._footprints[key] = fp
+            return dict(fp)
+        except Exception:  # noqa: BLE001 — footprint is best-effort
+            return None
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """One pass: the ``/debug/capacity`` document (also cached on
+        ``self.last`` for the federation snapshot). Never raises."""
+        t = self._clock() if now is None else now
+        cm = _capacity_metrics_or_none()
+        try:
+            short = self._rates(t, self.window_s)
+            long = self._rates(t, self.trend_window_s)
+        except Exception:  # noqa: BLE001 — a store hiccup yields idle
+            short, long = {}, {}
+        models: Dict[str, dict] = {}
+        worst = "ok"
+        rank = {"ok": 0, "warn": 1, "exhausted": 2}
+        with self._lock:
+            for model in sorted(set(short) | set(self._peak)):
+                rate = short.get(model, 0.0)
+                peak = self._peak.get(model)
+                if peak is None:
+                    peak = self._seed_peak(model, t)
+                peak = max(peak, rate)
+                self._peak[model] = peak
+                occupancy = rate / peak if peak > 0 else 0.0
+                headroom = 1.0 - occupancy
+                if headroom < self.exhausted_headroom:
+                    verdict = "exhausted"
+                elif headroom < self.warn_headroom:
+                    verdict = "warn"
+                else:
+                    verdict = "ok"
+                lr = long.get(model, 0.0)
+                if rate > lr * 1.2 and rate - lr > 0.1:
+                    trend = "rising"
+                elif lr > rate * 1.2 and lr - rate > 0.1:
+                    trend = "falling"
+                else:
+                    trend = "flat"
+                prev = self._verdicts.get(model)
+                if prev != verdict:
+                    self._verdicts[model] = verdict
+                    record_event("capacity.verdict", model=model,
+                                 verdict=verdict, prev=prev,
+                                 headroom=round(headroom, 4),
+                                 rate_rps=round(rate, 4),
+                                 peak_rps=round(peak, 4))
+                row = {"rate_rps": rate, "peak_rps": peak,
+                       "occupancy": round(occupancy, 4),
+                       "headroom": round(headroom, 4),
+                       "verdict": verdict, "trend": trend}
+                fp = self._footprint(model)
+                if fp is not None:
+                    row["footprint"] = fp
+                models[model] = row
+                if rank[verdict] > rank[worst]:
+                    worst = verdict
+                if cm is not None:
+                    cm.headroom.set(headroom, model=model)
+                    cm.peak_rps.set(peak, model=model)
+        if cm is not None:
+            cm.ticks_total.inc()
+            if worst == "exhausted":
+                cm.exhausted_ticks_total.inc()
+        report = {
+            "time": t,
+            "window_s": self.window_s,
+            "trend_window_s": self.trend_window_s,
+            "thresholds": {"warn_headroom": self.warn_headroom,
+                           "exhausted_headroom": self.exhausted_headroom},
+            "models": models,
+            "verdict": worst,
+        }
+        self.last = report
+        try:
+            # lazy import: federation pulls usage only inside guarded
+            # index helpers, so this cannot cycle at import time
+            from deeplearning4j_tpu.observability.federation import (
+                publish_capacity_report,
+            )
+
+            publish_capacity_report(report)
+        except Exception:  # noqa: BLE001 — federation is optional here
+            pass
+        return report
+
+    def report(self) -> dict:
+        """Latest cached report (evaluating once if never run)."""
+        return self.last if self.last is not None else self.evaluate()
+
+    def collect(self, now: float) -> List[tuple]:
+        """TSDB collector slot: run an evaluation on the sampler
+        cadence (the headroom/peak gauges it sets are scraped into
+        history by the same sampler pass)."""
+        self.evaluate(now)
+        return []
+
+
+# -- process-global meter (federation snapshot + zero-config consumers) -------
+
+_METER: Optional[UsageMeter] = None
+_meter_lock = threading.Lock()
+
+
+def set_usage_meter(meter: Optional[UsageMeter]) -> None:
+    global _METER
+    with _meter_lock:
+        _METER = meter
+
+
+def get_usage_meter(create: bool = False) -> Optional[UsageMeter]:
+    global _METER
+    if _METER is None and create:
+        with _meter_lock:
+            if _METER is None:
+                _METER = UsageMeter()
+    return _METER
+
+
+def usage_index(*, ledger=None) -> Optional[dict]:
+    """This process's usage document, or None — what the federation
+    snapshot embeds (never creates a meter as a side effect, never
+    raises)."""
+    meter = get_usage_meter()
+    if meter is None:
+        return None
+    try:
+        return meter.describe(ledger=ledger)
+    except Exception:  # noqa: BLE001 — telemetry never fails the caller
+        return None
+
+
+__all__ = [
+    "ANON_TENANT",
+    "ENV_USAGE_MAX_ACCOUNTS",
+    "ENV_USAGE_ROLLUP_S",
+    "OVERFLOW_TENANT",
+    "CapacityEvaluator",
+    "CapacityMetrics",
+    "UsageMeter",
+    "UsageMetrics",
+    "get_capacity_metrics",
+    "get_usage_meter",
+    "get_usage_metrics",
+    "set_usage_meter",
+    "usage_index",
+]

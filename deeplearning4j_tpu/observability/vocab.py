@@ -146,10 +146,20 @@ REPLAY_KINDS = frozenset({
     "replay.start",
 })
 
+# historical telemetry tier (observability/timeseries.py,
+# observability/usage.py)
+TELEMETRY_KINDS = frozenset({
+    "capacity.verdict",
+    "tsdb.restore",
+    "tsdb.start",
+    "tsdb.stop",
+    "usage.overflow",
+})
+
 EVENT_KINDS = frozenset().union(
     SERVING_KINDS, GENERATION_KINDS, ROUTER_KINDS, TRAIN_KINDS,
     RESILIENCE_KINDS, COMPILE_KINDS, OBSERVABILITY_KINDS,
-    SANITIZER_KINDS, CACHE_KINDS, REPLAY_KINDS)
+    SANITIZER_KINDS, CACHE_KINDS, REPLAY_KINDS, TELEMETRY_KINDS)
 
 
 def known_event_kinds() -> frozenset:

@@ -432,6 +432,10 @@ class ModelRegistry:
         # the response-cache tier subscribes to drop entries for weights
         # that just stopped serving
         self._invalidation_listeners: List[Callable[..., None]] = []
+        # called as fn(name, n_requests, rows, bucket, seconds) for
+        # every dispatched device batch — the usage meter subscribes
+        # for device-batch-seconds / FLOPs attribution
+        self._batch_listeners: List[Callable[..., None]] = []
 
     def attach_metrics(self, metrics):
         """Wire a ServingMetrics bundle (occupancy/device-latency hooks
@@ -464,6 +468,13 @@ class ModelRegistry:
                 fn(name, version, epoch, reason)
             except Exception:  # noqa: BLE001 — see add_invalidation_listener
                 pass
+
+    def add_batch_listener(self, fn: Callable[..., None]):
+        """Subscribe ``fn(name, n_requests, rows, bucket, seconds)`` to
+        every dispatched device batch (warm batches included). Runs on
+        the worker's dispatch path, so listeners must be cheap; a
+        raising listener is swallowed — metering never fails serving."""
+        self._batch_listeners.append(fn)
 
     # -- metrics hooks (called from ParallelInference workers) -------------
 
@@ -502,6 +513,11 @@ class ModelRegistry:
                 wsm.recompiles_after_warm_total.inc(plane="predict")
             _record_flight("serving.recompile_after_warm", model=name,
                            bucket=bucket)
+        for fn in list(self._batch_listeners):
+            try:
+                fn(name, n_requests, rows, bucket, seconds)
+            except Exception:  # noqa: BLE001 — see add_batch_listener
+                pass
 
     def _record_expired(self, name: str, n: int):
         m = self._metrics

@@ -113,6 +113,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import re
 import threading
 import time
@@ -128,7 +129,9 @@ from deeplearning4j_tpu.observability import incidents as _incidents
 from deeplearning4j_tpu.observability import reqlog as _reqlog
 from deeplearning4j_tpu.observability import sentinel as _sentinel
 from deeplearning4j_tpu.observability import slo as _slo
+from deeplearning4j_tpu.observability import timeseries as _timeseries
 from deeplearning4j_tpu.observability import trace as _trace
+from deeplearning4j_tpu.observability import usage as _usage
 from deeplearning4j_tpu.observability.flightrecorder import (
     get_flight_recorder,
     record_event,
@@ -257,6 +260,8 @@ class ModelServer:
         warmup_manifest=None,
         compile_cache=None,
         cache=None,
+        timeseries=None,
+        usage=None,
     ):
         self.registry = registry if registry is not None else ModelRegistry()
         # Cold-start robustness (serving/warmstart.py + runtime/
@@ -353,9 +358,55 @@ class ModelServer:
         self.generators: dict = {}
         for gname, engine in (generators or {}).items():
             self.add_generator(gname, engine)
+        # Historical telemetry tier (observability/timeseries.py +
+        # usage.py): the mini-TSDB sampler snapshots this server's
+        # serving bundle UNION the process default registry into tiered
+        # rings (GET /debug/timeseries); the usage meter attributes
+        # requests/tokens (via the ledger finish sink) and device-batch-
+        # seconds/FLOPs (via the registry batch listener) per
+        # (tenant, model) and rolls up into the store (/debug/usage);
+        # the capacity evaluator derives per-model headroom verdicts
+        # from store rates vs the measured peak (/debug/capacity — the
+        # autoscaler's input contract). None = on (the default);
+        # False disables; an instance is adopted as-is.
+        self.timeseries: Optional[_timeseries.TimeSeriesStore] = None
+        if timeseries is not False:
+            if isinstance(timeseries, _timeseries.TimeSeriesStore):
+                self.timeseries = timeseries
+                if self.timeseries._registries is None:
+                    # an unbound store samples only the process default
+                    # registry — bind it to this server's serving
+                    # bundle too, or every serving_* family is invisible
+                    self.timeseries._registries = [
+                        self.metrics.registry, default_registry()]
+            else:
+                self.timeseries = _timeseries.TimeSeriesStore(
+                    registries=[self.metrics.registry, default_registry()])
+        self.usage: Optional[_usage.UsageMeter] = None
+        self.capacity: Optional[_usage.CapacityEvaluator] = None
+        if usage is not False:
+            self.usage = (usage if isinstance(usage, _usage.UsageMeter)
+                          else _usage.UsageMeter())
+            self.usage.set_cost_resolver(self._entry_or_none)
+            self.registry.add_batch_listener(self.usage.on_batch)
+        if self.timeseries is not None:
+            try:
+                rollup_s = float(
+                    os.environ.get(_usage.ENV_USAGE_ROLLUP_S) or 10.0)
+            except ValueError:
+                rollup_s = 10.0
+            if self.usage is not None:
+                self.timeseries.add_collector(self.usage.collect,
+                                              every_s=rollup_s)
+            self.capacity = _usage.CapacityEvaluator(
+                self.timeseries, resolver=self._entry_or_none)
+            self.timeseries.add_collector(self.capacity.collect,
+                                          every_s=rollup_s)
         # Diagnostics plane: the health engine evaluates this server's
         # serving bundle UNION the process default registry, so train /
         # resilience series in the same process count toward rules too.
+        # With the TSDB armed, the engine's burn-rate windows live in
+        # store-owned deques and survive warm restarts with it.
         if slo_engine is not None:
             self.slo_engine = slo_engine
         else:
@@ -363,7 +414,8 @@ class ModelServer:
                 slo_rules if slo_rules is not None
                 else _slo.default_serving_rules(),
                 registries=[self.metrics.registry, default_registry()],
-                interval_s=slo_interval_s, time_scale=slo_time_scale)
+                interval_s=slo_interval_s, time_scale=slo_time_scale,
+                store=self.timeseries)
         self.max_profile_ms = max_profile_ms
         self._profile_lock = threading.Lock()
         # when a capture holds the lock, the deadline it runs until —
@@ -551,6 +603,40 @@ class ModelServer:
                             ").").to_json())
                     else:
                         self._send(200, server.render_cache())
+                elif path == "/debug/timeseries":
+                    q = parse_qs(query)
+                    try:
+                        window_s = (float(q["window"][0])
+                                    if "window" in q else None)
+                        step_s = (float(q["step"][0])
+                                  if "step" in q else None)
+                        quant = float(q["q"][0]) if "q" in q else None
+                    except ValueError:
+                        self._send(400, BadRequestError(
+                            "window, step and q must be "
+                            "numbers").to_json())
+                        return
+                    labels = {k[len("label."):]: v[0]
+                              for k, v in q.items()
+                              if k.startswith("label.")}
+                    for shorthand in ("model", "tenant"):
+                        if shorthand in q:
+                            labels[shorthand] = q[shorthand][0]
+                    status, body = server.render_timeseries(
+                        family=q.get("family", [None])[0],
+                        window_s=window_s, step_s=step_s,
+                        op=q.get("op", ["range"])[0], q=quant,
+                        labels=labels or None)
+                    self._send(status, body)
+                elif path == "/debug/usage":
+                    status, body = server.render_usage()
+                    self._send(status, body)
+                elif path == "/debug/capacity":
+                    q = parse_qs(query)
+                    status, body = server.render_capacity(
+                        evaluate=q.get("evaluate", ["0"])[0]
+                        in ("1", "true"))
+                    self._send(status, body)
                 elif path == "/debug/incidents":
                     self._send(200, server.render_incidents())
                 elif path.startswith("/debug/incidents/"):
@@ -1352,6 +1438,68 @@ class ModelServer:
                             "reason": str(exc)[:200]})
         return {"models": out}
 
+    def _entry_or_none(self, name: str):
+        """Guarded registry lookup for the usage meter / capacity
+        evaluator cost resolvers (an unknown or shut-down model prices
+        as unresolved, never raises)."""
+        try:
+            return self.registry.get(name)
+        except Exception:  # noqa: BLE001 — pricing is best-effort
+            return None
+
+    def render_timeseries(self, *, family=None, window_s=None, step_s=None,
+                          op="range", q=None, labels=None) -> Tuple[int, dict]:
+        """GET /debug/timeseries: without ``family``, the store's
+        describe() (tiers, families, memory); with one, the requested
+        query (``op`` = range | rate | quantile | max; ``quantile``
+        needs ``q``)."""
+        store = self.timeseries
+        if store is None:
+            return 404, ServingError(
+                "historical telemetry is disabled "
+                "(pass timeseries=None/a TimeSeriesStore)").to_json()
+        if family is None:
+            return 200, store.describe()
+        window = float(window_s) if window_s is not None else 600.0
+        if op == "rate":
+            return 200, store.rate(family, window_s=window, step_s=step_s,
+                                   labels=labels)
+        if op == "quantile":
+            return 200, store.quantile_over_time(
+                family, float(q if q is not None else 0.99),
+                window_s=window, labels=labels)
+        if op == "max":
+            return 200, store.max_over_time(family, window_s=window,
+                                            labels=labels)
+        if op == "range":
+            return 200, store.range(family, window_s=window, step_s=step_s,
+                                    labels=labels)
+        return 400, BadRequestError(
+            f"op must be range|rate|quantile|max, got {op!r}").to_json()
+
+    def render_usage(self) -> Tuple[int, dict]:
+        """GET /debug/usage: per-(tenant, model) accounts on both
+        planes, per-model batch-seconds/FLOPs, reconciled against the
+        ledger window."""
+        if self.usage is None:
+            return 404, ServingError(
+                "usage metering is disabled "
+                "(pass usage=None/a UsageMeter)").to_json()
+        return 200, self.usage.describe(ledger=self.reqlog)
+
+    def render_capacity(self, *, evaluate: bool = False) -> Tuple[int, dict]:
+        """GET /debug/capacity: headroom verdict per model + backend
+        (the autoscaler input contract). The sampler keeps the cached
+        report fresh; ``evaluate=True`` (``?evaluate=1``) forces a
+        pass now."""
+        if self.capacity is None:
+            return 404, ServingError(
+                "capacity evaluation is disabled (it requires the "
+                "timeseries store)").to_json()
+        report = (self.capacity.evaluate() if evaluate
+                  else self.capacity.report())
+        return 200, report
+
     def render_cache(self) -> dict:
         """GET /debug/cache: response-cache occupancy/hit counters plus
         every generation engine's prefix-store view."""
@@ -1650,6 +1798,19 @@ class ModelServer:
         self.slo_engine.start()
         if self.overload is not None:
             self.overload.start()
+        if self.timeseries is not None:
+            self.timeseries.start()
+            if _timeseries.get_timeseries_store() is None:
+                # zero-config history: the federation snapshot and
+                # exporter read the process-default store
+                _timeseries.set_timeseries_store(self.timeseries)
+        if self.usage is not None:
+            # the ledger finish sink feeds the meter on both planes;
+            # one sink per process (mirrors the default-engine slot)
+            if _reqlog.get_usage_sink() is None:
+                _reqlog.set_usage_sink(self.usage.on_record)
+            if _usage.get_usage_meter() is None:
+                _usage.set_usage_meter(self.usage)
         if _slo.get_default_engine() is None:
             # zero-config visibility: UIServer's /health page renders the
             # process-default engine
@@ -1704,6 +1865,15 @@ class ModelServer:
                 "serving", self._incident_profile_hook)
         if _slo.get_default_engine() is self.slo_engine:
             _slo.set_default_engine(None)
+        if self.timeseries is not None:
+            self.timeseries.stop()
+            if _timeseries.get_timeseries_store() is self.timeseries:
+                _timeseries.set_timeseries_store(None)
+        if self.usage is not None:
+            if _reqlog.get_usage_sink() == self.usage.on_record:
+                _reqlog.set_usage_sink(None)
+            if _usage.get_usage_meter() is self.usage:
+                _usage.set_usage_meter(None)
         self._httpd.server_close()
         for eng in self.generators.values():
             eng.stop()

@@ -49,7 +49,7 @@ class TestValidation:
             "generation-ttft-p99", "router-availability",
             "router-retry-budget-exhausted", "recompile-after-warmup",
             "sanitizer-violation", "cache-hit-rate", "cache-stale-serve",
-            "gameday-gate-breach"}
+            "gameday-gate-breach", "capacity-headroom-exhausted"}
 
     def test_default_serving_rules_match_example_vocabulary(self):
         known = slo.known_metric_names()
@@ -136,7 +136,7 @@ class TestCheckCLI:
              "--check", EXAMPLE_RULES],
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr
-        assert "ok: 18 rule(s) valid" in out.stdout
+        assert "ok: 19 rule(s) valid" in out.stdout
 
     def test_bad_rules_exit_nonzero(self, tmp_path):
         bad = tmp_path / "bad.json"

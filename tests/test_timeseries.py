@@ -1,0 +1,550 @@
+"""Historical telemetry tier, part 1 (PR 18): the in-process
+mini-TSDB — multi-resolution ring tiers (append vs replace-last with
+per-bucket maxima), the query API (range / rate with counter-reset
+detection / quantile-over-time from bucket deltas / max-over-time),
+series-cardinality bounds, collector throttling, the kill switch, the
+atomic snapshot/restore that survives a warm restart (including the SLO
+engine's store-owned burn-rate windows), the store-armed HealthEngine
+regression against the private-deque engine, and the strict
+``/debug/timeseries`` JSON surface on a live ModelServer.
+
+Everything below the server class runs on injected clocks and direct
+``ingest``/``sample(now=)`` calls — no sleeps, no background threads.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import metrics as om
+from deeplearning4j_tpu.observability import slo
+from deeplearning4j_tpu.observability import timeseries as ts
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+# ---------------------------------------------------------------------------
+# tier resolution
+
+
+class TestTiers:
+    def test_defaults_cover_1s_10s_60s(self):
+        tiers = ts.resolve_tiers()
+        assert [t.step_s for t in tiers] == [1.0, 10.0, 60.0]
+        assert tiers[0].coverage_s == 600          # 10 min at 1 s
+        assert tiers[1].coverage_s == 7200         # 2 h at 10 s
+        assert tiers[2].coverage_s == 86400        # 24 h at 60 s
+
+    def test_env_spec_parsed(self, monkeypatch):
+        monkeypatch.setenv(ts.ENV_TSDB_TIERS, "2x5, 20x10")
+        tiers = ts.resolve_tiers()
+        assert [(t.step_s, t.capacity) for t in tiers] == [(2.0, 5),
+                                                           (20.0, 10)]
+
+    @pytest.mark.parametrize("spec", ["garbage", "0x10", "5x0", "1x2,bad"])
+    def test_malformed_spec_falls_back(self, monkeypatch, spec):
+        monkeypatch.setenv(ts.ENV_TSDB_TIERS, spec)
+        assert ts.resolve_tiers() == ts.DEFAULT_TIERS
+
+    def test_unsorted_spec_is_sorted_finest_first(self):
+        tiers = ts.resolve_tiers("10x5,1x600")
+        assert [t.step_s for t in tiers] == [1.0, 10.0]
+
+
+# ---------------------------------------------------------------------------
+# ring semantics (one series, injected timestamps)
+
+
+def _store(tiers=None, **kw):
+    kw.setdefault("registries", [])
+    kw.setdefault("interval_s", 1.0)
+    return ts.TimeSeriesStore(
+        tiers=tiers or (ts.Tier(1.0, 10), ts.Tier(10.0, 12)), **kw)
+
+
+class TestRings:
+    def test_same_bucket_replaces_last_and_keeps_vmax(self):
+        st = _store(tiers=(ts.Tier(10.0, 8),))
+        for t, v in ((0, 1.0), (3, 9.0), (6, 2.0)):
+            st.ingest("g", {}, "gauge", v, now=t)
+        doc = st.range("g", window_s=100, now=6)
+        # one 10 s bucket: latest value wins the point...
+        assert doc["series"][0]["points"] == [[0, 2.0]]
+        # ...but the folded max survives for max_over_time
+        assert st.max_over_time("g", window_s=100, now=6)["value"] == 9.0
+
+    def test_new_bucket_appends(self):
+        st = _store(tiers=(ts.Tier(10.0, 8),))
+        st.ingest("g", {}, "gauge", 1.0, now=0)
+        st.ingest("g", {}, "gauge", 2.0, now=10)
+        pts = st.range("g", window_s=100, now=10)["series"][0]["points"]
+        assert pts == [[0, 1.0], [10, 2.0]]
+
+    def test_ring_capacity_bounds_memory(self):
+        st = _store(tiers=(ts.Tier(1.0, 5),))
+        for t in range(50):
+            st.ingest("g", {}, "gauge", float(t), now=t)
+        pts = st.range("g", window_s=1000, now=49)["series"][0]["points"]
+        assert len(pts) == 5
+        assert pts[0] == [45, 45.0]               # oldest evicted
+
+    def test_coarse_tier_downsamples_fine_points(self):
+        st = _store()                              # 1sx10 + 10sx12
+        for t in range(0, 35):
+            st.ingest("c", {}, "counter", float(t), now=t)
+        # short window -> finest tier, per-second points
+        fine = st.range("c", window_s=5, now=34)
+        assert fine["step_s"] == 1.0
+        # long window -> 10 s tier, one point per bucket
+        coarse = st.range("c", window_s=120, now=34)
+        assert coarse["step_s"] == 10.0
+        assert [p[0] for p in coarse["series"][0]["points"]] == [0, 10,
+                                                                 20, 30]
+
+
+# ---------------------------------------------------------------------------
+# query math
+
+
+class TestQueries:
+    def test_counter_rate_exact(self):
+        st = _store(tiers=(ts.Tier(1.0, 60),))
+        for t in range(11):
+            st.ingest("c", {}, "counter", 5.0 * t, now=t)
+        doc = st.rate("c", window_s=10, now=10)
+        assert doc["rate"] == pytest.approx(5.0)
+
+    def test_counter_reset_never_negative(self):
+        st = _store(tiers=(ts.Tier(1.0, 60),))
+        # 0..40 then a restart from 0: the reset contributes the new
+        # value (30), never a negative delta
+        for t, v in enumerate((0, 10, 20, 30, 40, 30, 60, 90)):
+            st.ingest("c", {}, "counter", float(v), now=t)
+        doc = st.rate("c", window_s=7, now=7)
+        # deltas: 10,10,10,10,reset->30,30,30 over 7 s
+        assert doc["rate"] == pytest.approx((40 + 30 + 60) / 7.0)
+        assert all(p[1] >= 0 for p in doc["series"][0]["points"])
+
+    def test_rate_sums_across_label_sets(self):
+        st = _store(tiers=(ts.Tier(1.0, 60),))
+        for t in range(6):
+            st.ingest("c", {"model": "a"}, "counter", 2.0 * t, now=t)
+            st.ingest("c", {"model": "b"}, "counter", 3.0 * t, now=t)
+        assert st.rate("c", window_s=5, now=5)["rate"] == pytest.approx(5.0)
+        only_a = st.rate("c", window_s=5, labels={"model": "a"}, now=5)
+        assert only_a["rate"] == pytest.approx(2.0)
+
+    def test_quantile_over_time_interpolates(self):
+        reg = om.MetricsRegistry()
+        h = reg.histogram("lat_seconds", "", buckets=(0.1, 0.5, 1.0))
+        st = ts.TimeSeriesStore(registries=[reg],
+                                tiers=(ts.Tier(1.0, 60),), interval_s=1.0)
+        h.observe(0.05)    # the family must exist to baseline at t=0
+        st.sample(now=0)                           # baseline counts
+        for _ in range(10):
+            h.observe(0.3)                         # all inside (0.1, 0.5]
+        st.sample(now=5)
+        doc = st.quantile_over_time("lat_seconds", 0.5, window_s=10, now=5)
+        assert doc["count"] == 10
+        # linear interpolation inside the (0.1, 0.5] bucket at q=0.5
+        assert doc["value"] == pytest.approx(0.3)
+        # beyond-the-largest-finite-bound reports the honest floor
+        for _ in range(100):
+            h.observe(5.0)
+        st.sample(now=6)
+        top = st.quantile_over_time("lat_seconds", 0.99, window_s=10, now=6)
+        assert top["value"] == pytest.approx(1.0)
+
+    def test_quantile_empty_window_is_none(self):
+        st = _store()
+        doc = st.quantile_over_time("lat", 0.99, window_s=10, now=0)
+        assert doc["value"] is None and doc["count"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# sampler: scrape, bounds, collectors, kill switch
+
+
+class TestSampler:
+    def test_sample_scrapes_counters_gauges_histograms(self):
+        reg = om.MetricsRegistry()
+        c = reg.counter("req_total", "", ("model",))
+        g = reg.gauge("depth", "")
+        h = reg.histogram("lat", "", buckets=(0.1, 1.0))
+        st = ts.TimeSeriesStore(registries=[reg],
+                                tiers=(ts.Tier(1.0, 10),), interval_s=1.0)
+        c.inc(3, model="m")
+        g.set(7.0)
+        h.observe(0.05)
+        n = st.sample(now=100)
+        assert n == 3
+        assert sorted(st.families()) == ["depth", "lat", "req_total"]
+        pts = st.range("req_total", window_s=10,
+                       now=100)["series"][0]["points"]
+        assert pts == [[100, 3.0]]
+
+    def test_max_series_bound_drops_and_counts(self):
+        ts.get_tsdb_metrics()  # arm the bundle
+        dropped0 = ts.get_tsdb_metrics().series_dropped_total.value()
+        st = _store(max_series=2)
+        st.ingest("a", {}, "gauge", 1.0, now=0)
+        st.ingest("b", {}, "gauge", 1.0, now=0)
+        st.ingest("c", {}, "gauge", 1.0, now=0)     # over the bound
+        assert st.describe()["series"] == 2
+        assert "c" not in st.families()
+        assert ts.get_tsdb_metrics().series_dropped_total.value() \
+            == dropped0 + 1
+
+    def test_families_filter_allowlist(self):
+        reg = om.MetricsRegistry()
+        reg.counter("keep_total", "").inc()
+        reg.counter("drop_total", "").inc()
+        st = ts.TimeSeriesStore(registries=[reg], families=["keep_total"],
+                                tiers=(ts.Tier(1.0, 10),), interval_s=1.0)
+        st.sample(now=0)
+        assert st.families() == ["keep_total"]
+
+    def test_kill_switch_stops_ingestion(self):
+        st = _store()
+        try:
+            ts.set_sampling_enabled(False)
+            assert st.sample(now=0) == 0
+            st.ingest("g", {}, "gauge", 1.0, now=0)
+            assert st.describe()["points"] == 0
+        finally:
+            ts.set_sampling_enabled(True)
+        assert ts.sampling_enabled()
+
+    def test_collector_throttled_by_every_s(self):
+        st = _store()
+        calls = []
+
+        def col(now):
+            calls.append(now)
+            return [("ext", {}, "counter", float(len(calls)))]
+
+        st.add_collector(col, every_s=10.0)
+        for t in (0, 3, 6, 9):
+            st.sample(now=t)
+        assert calls == [0]                        # throttled
+        st.sample(now=10)
+        assert calls == [0, 10]
+        assert "ext" in st.families()
+
+    def test_raising_collector_is_skipped_not_fatal(self):
+        st = _store()
+
+        def bad(now):
+            raise RuntimeError("boom")
+
+        st.add_collector(bad)
+        st.add_collector(lambda now: [("ok", {}, "gauge", 1.0)])
+        st.sample(now=0)                           # must not raise
+        assert "ok" in st.families()
+
+    def test_background_thread_samples_and_stops(self):
+        reg = om.MetricsRegistry()
+        reg.counter("bg_total", "").inc()
+        st = ts.TimeSeriesStore(registries=[reg],
+                                tiers=(ts.Tier(1.0, 600),),
+                                interval_s=0.01)
+        st.start()
+        try:
+            deadline = threading.Event()
+            for _ in range(200):
+                if st.describe()["samples"] >= 2:
+                    break
+                deadline.wait(0.01)
+            assert st.describe()["samples"] >= 2
+        finally:
+            st.stop()
+        assert not st.running
+        after = st.describe()["samples"]
+        threading.Event().wait(0.05)
+        assert st.describe()["samples"] == after   # really stopped
+
+
+# ---------------------------------------------------------------------------
+# snapshot / restore (the warm-restart contract)
+
+
+class TestSnapshotRestore:
+    def _seeded(self):
+        st = _store()
+        for t in range(0, 30):
+            st.ingest("c", {"model": "m"}, "counter", 2.0 * t, now=t)
+        return st
+
+    def test_round_trip_same_tiers_point_for_point(self):
+        st = self._seeded()
+        snap = st.snapshot()
+        st2 = _store()
+        assert st2.restore(json.loads(json.dumps(snap)))
+        assert st2.snapshot()["series"] == st.snapshot()["series"]
+        assert st2.rate("c", window_s=20, now=29)["rate"] == \
+            st.rate("c", window_s=20, now=29)["rate"]
+
+    def test_restore_into_different_tiers_replays_finest_ring(self):
+        st = self._seeded()
+        st2 = _store(tiers=(ts.Tier(5.0, 100),))
+        assert st2.restore(st.snapshot())
+        pts = st2.range("c", window_s=100, now=29)["series"][0]["points"]
+        # the finest preserved ring held the 10 newest 1 s points
+        # (20..29); rebucketed at 5 s they fold to two points
+        assert [p[0] for p in pts] == [20, 25]
+
+    def test_store_from_snapshot_is_queryable(self):
+        st = self._seeded()
+        rebuilt = ts.store_from_snapshot(st.snapshot())
+        assert rebuilt is not None
+        assert rebuilt.rate("c", window_s=20, now=29)["rate"] == \
+            pytest.approx(2.0)
+
+    @pytest.mark.parametrize("doc", [None, {}, {"version": 999},
+                                     {"version": 1, "series": "nope"}])
+    def test_bad_documents_restore_nothing(self, doc):
+        st = self._seeded()
+        before = st.describe()["points"]
+        assert st.restore(doc) is False
+        assert st.describe()["points"] == before
+
+    def test_slo_windows_survive_and_refill_live_deques(self):
+        st = _store()
+        d = st.slo_series("avail", maxlen=16)
+        d.append((0.0, 1.0, 10.0))
+        d.append((1.0, 2.0, 20.0))
+        snap = st.snapshot()
+        st2 = _store()
+        live = st2.slo_series("avail", maxlen=16)   # engine holds this
+        assert st2.restore(snap)
+        assert list(live) == [(0.0, 1.0, 10.0), (1.0, 2.0, 20.0)]
+        assert st2.slo_series("avail", maxlen=16) is live
+
+    def test_slo_series_recap_preserves_tail(self):
+        st = _store()
+        d = st.slo_series("r", maxlen=4)
+        for i in range(6):
+            d.append((float(i), 0.0, 1.0))
+        d2 = st.slo_series("r", maxlen=2)
+        assert list(d2) == [(4.0, 0.0, 1.0), (5.0, 0.0, 1.0)]
+
+
+# ---------------------------------------------------------------------------
+# heavy leg: a full simulated day at the default tiers (the fast tests
+# above cover the same ring/downsample/query math on toy tiers)
+
+
+@pytest.mark.slow
+class TestFullDayRetention:
+    def test_24h_of_1s_samples_bounded_and_queryable(self):
+        st = ts.TimeSeriesStore(registries=[], interval_s=1.0)
+        day = 86400
+        for t in range(0, day + 1, 1):
+            st.ingest("c", {"model": "m"}, "counter", 3.0 * t, now=t)
+        desc = st.describe()
+        # memory bound: at most sum of tier capacities, never the raw
+        # 86401 samples
+        assert desc["points"] <= sum(t.capacity for t in st.tiers)
+        # every tier answers the steady rate (downsampling skews at
+        # most one bucket's worth of samples at the window edge)
+        for window in (300, 3600, 86400):
+            assert st.rate("c", window_s=window,
+                           now=day)["rate"] == pytest.approx(3.0, rel=0.01)
+        # the snapshot round-trips the whole day
+        rebuilt = ts.store_from_snapshot(st.snapshot())
+        assert rebuilt.rate("c", window_s=86400,
+                            now=day)["rate"] == pytest.approx(3.0, rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: store-armed HealthEngine is tick-identical
+
+
+class TestHealthEngineStore:
+    def test_store_armed_engine_matches_private_deques(self):
+        rule = slo.SLORule(
+            name="avail", kind="availability", objective=0.9,
+            total=slo.Selector("serving_requests_total"),
+            bad=slo.Selector("serving_requests_total",
+                             match=(("code", "429|5.."),)),
+            windows=(slo.BurnWindow(10.0, 40.0, 2.0),),
+            for_s=2.0, resolve_hold_s=2.0)
+        sm1, sm2 = ServingMetrics(), ServingMetrics()
+        clock = [0.0]
+        store = _store()
+        plain = slo.HealthEngine([rule], registries=[sm1.registry],
+                                 interval_s=1.0, clock=lambda: clock[0],
+                                 snapshot_every_s=0)
+        armed = slo.HealthEngine([rule], registries=[sm2.registry],
+                                 interval_s=1.0, clock=lambda: clock[0],
+                                 snapshot_every_s=0, store=store)
+        for t in range(20):
+            clock[0] = float(t)
+            for sm in (sm1, sm2):
+                sm.requests_total.inc(9, model="m", code="200")
+                if 5 <= t < 9:
+                    sm.requests_total.inc(6, model="m", code="503")
+            h1, h2 = plain.tick(), armed.tick()
+            h1.pop("time", None), h2.pop("time", None)
+            assert h1 == h2
+        # the armed engine's window rides the store: it snapshots out
+        assert "avail" in store.snapshot()["slo"]
+
+    def test_armed_engine_burn_history_survives_restore(self):
+        rule = slo.SLORule(
+            name="avail", kind="availability", objective=0.9,
+            total=slo.Selector("serving_requests_total"),
+            bad=slo.Selector("serving_requests_total",
+                             match=(("code", "5.."),)),
+            windows=(slo.BurnWindow(10.0, 40.0, 2.0),),
+            for_s=0.0, resolve_hold_s=2.0)
+        sm = ServingMetrics()
+        clock = [0.0]
+        store = _store()
+        eng = slo.HealthEngine([rule], registries=[sm.registry],
+                               interval_s=1.0, clock=lambda: clock[0],
+                               snapshot_every_s=0, store=store)
+        eng.tick()
+        clock[0] = 1.0
+        sm.requests_total.inc(80, model="m", code="200")
+        sm.requests_total.inc(20, model="m", code="500")
+        burn = eng.tick()["rules"][0]["windows"][0]["short"]
+        assert burn == pytest.approx(2.0)
+        snap = store.snapshot()
+        # "warm restart": a fresh store restores the document, a fresh
+        # engine adopts it and reads the SAME burn on its next tick
+        store2 = _store()
+        sm2 = ServingMetrics()
+        eng2 = slo.HealthEngine([rule], registries=[sm2.registry],
+                                interval_s=1.0, clock=lambda: clock[0],
+                                snapshot_every_s=0, store=store2)
+        store2.restore(snap)
+        sm2.requests_total.inc(80, model="m", code="200")
+        sm2.requests_total.inc(20, model="m", code="500")
+        h = eng2.tick()
+        assert h["rules"][0]["windows"][0]["short"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# process-global store slot
+
+
+class TestGlobals:
+    def test_set_get_and_index(self):
+        prev = ts.get_timeseries_store()
+        try:
+            st = _store()
+            st.ingest("g", {}, "gauge", 1.0, now=0)
+            ts.set_timeseries_store(st)
+            assert ts.get_timeseries_store() is st
+            idx = ts.timeseries_index()
+            assert idx["version"] == ts.SNAPSHOT_VERSION
+            assert len(idx["series"]) == 1
+            ts.set_timeseries_store(None)
+            assert ts.timeseries_index() is None
+        finally:
+            ts.set_timeseries_store(prev)
+
+
+# ---------------------------------------------------------------------------
+# /debug/timeseries on a live ModelServer (one tiny batched model,
+# compiled once for the module)
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer, spec
+
+    def fwd(v, x):
+        return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+    reg = ModelRegistry()
+    reg.register("scale", fwd, {"scale": 2.0}, input_spec=spec((4,)),
+                 mode="batched", max_batch_size=8,
+                 devices=jax.devices()[:1])
+    srv = ModelServer(reg, port=0, sentinel=False)
+    srv.start(warm=True)
+    yield srv
+    srv.stop()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _predict(server, n=1, tenant=None):
+    body = json.dumps({"inputs": [[0.0] * 4]}).encode()
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers["X-Tenant"] = tenant
+    for _ in range(n):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/models/scale:predict",
+            data=body, headers=headers)
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+
+
+class TestServerEndpoint:
+    def test_describe_without_family(self, server):
+        status, doc = _get(
+            f"http://127.0.0.1:{server.port}/debug/timeseries")
+        assert status == 200
+        assert [t["step_s"] for t in doc["tiers"]] == [1.0, 10.0, 60.0]
+        assert doc["running"] is True
+
+    def test_rate_query_over_served_traffic(self, server):
+        _predict(server, n=5)
+        # deterministic: drive the armed sampler directly rather than
+        # waiting out its 1 s cadence
+        now = server.timeseries._clock()
+        server.timeseries.sample(now=now - 2)
+        _predict(server, n=5)
+        server.timeseries.sample(now=now)
+        status, doc = _get(
+            f"http://127.0.0.1:{server.port}/debug/timeseries"
+            f"?family=serving_requests_total&op=rate&window=60"
+            f"&label.model=scale")
+        assert status == 200
+        assert doc["rate"] > 0
+        assert all(s["labels"].get("model") == "scale"
+                   for s in doc["series"])
+
+    def test_quantile_query(self, server):
+        _predict(server, n=3)
+        now = server.timeseries._clock()
+        server.timeseries.sample(now=now)
+        status, doc = _get(
+            f"http://127.0.0.1:{server.port}/debug/timeseries"
+            f"?family=serving_request_latency_seconds&op=quantile"
+            f"&q=0.99&window=600")
+        assert status == 200
+        assert doc["q"] == 0.99
+
+    def test_bad_params_are_400(self, server):
+        base = f"http://127.0.0.1:{server.port}/debug/timeseries"
+        status, _ = _get(base + "?family=x&window=abc")
+        assert status == 400
+        status, _ = _get(base + "?family=x&op=bogus")
+        assert status == 400
+
+    def test_server_snapshot_carries_store_and_usage(self, server):
+        from deeplearning4j_tpu.observability.federation import (
+            build_snapshot,
+        )
+
+        _predict(server, n=2, tenant="acme")
+        server.timeseries.sample(now=server.timeseries._clock())
+        snap = build_snapshot()
+        assert snap["timeseries"]["version"] == ts.SNAPSHOT_VERSION
+        assert any(a["tenant"] == "acme"
+                   for a in snap["usage"]["tenants"])

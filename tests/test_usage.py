@@ -1,0 +1,523 @@
+"""Historical telemetry tier, part 2 (PR 18): per-tenant x per-model
+usage metering and the capacity/headroom report — ledger-sink
+attribution on both planes, the bounded account table with overflow
+folding, the version-keyed FLOPs cache (the /debug/costs drift fix:
+hot-swap/rollback re-resolves cost analysis), ledger reconciliation,
+capacity verdict transitions as offered load approaches the measured
+peak, peak re-seeding from restored TSDB history, and the federated
+fleet views (usage sums, capacity worst-verdict, per-worker timeseries
+anchored at last-known snapshots so a dead worker's history answers).
+
+Unit legs run on injected clocks and hand-built records; the live
+two-tenant server leg drives real HTTP traffic through one tiny
+batched model.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import pytest
+
+from deeplearning4j_tpu.observability import timeseries as ts
+from deeplearning4j_tpu.observability import usage as us
+from deeplearning4j_tpu.observability import federation as fed
+
+# ---------------------------------------------------------------------------
+# attribution (the ledger finish sink)
+
+
+def _rec(**kw):
+    base = {"model": "m", "tenant": "acme", "plane": "serving",
+            "outcome": "ok", "tokens": 7, "prompt_len": 3}
+    base.update(kw)
+    return base
+
+
+class TestAttribution:
+    def test_requests_tokens_planes_accumulate(self):
+        meter = us.UsageMeter(max_accounts=8)
+        meter.on_record(_rec())
+        meter.on_record(_rec(plane="generation", tokens=5, prompt_len=2))
+        meter.on_record(_rec(tenant="globex", outcome="failed"))
+        doc = meter.describe()
+        assert doc["accounts"] == 2
+        acme = next(a for a in doc["tenants"] if a["tenant"] == "acme")
+        assert acme["requests"] == 2 and acme["errors"] == 0
+        assert acme["tokens_in"] == 5 and acme["tokens_out"] == 12
+        assert acme["planes"] == {"serving": 1, "generation": 1}
+        globex = next(a for a in doc["tenants"] if a["tenant"] == "globex")
+        assert globex["errors"] == 1
+        assert doc["totals"]["requests"] == 3
+
+    def test_anonymous_tenant_defaults(self):
+        meter = us.UsageMeter(max_accounts=8)
+        meter.on_record({"model": "m", "outcome": "ok"})
+        assert meter.describe()["tenants"][0]["tenant"] == us.ANON_TENANT
+
+    def test_completed_counts_as_ok(self):
+        meter = us.UsageMeter(max_accounts=8)
+        meter.on_record(_rec(outcome="completed"))
+        assert meter.describe()["tenants"][0]["errors"] == 0
+
+    def test_sink_never_raises_on_garbage(self):
+        meter = us.UsageMeter(max_accounts=8)
+        meter.on_record({"tokens": "not-a-number"})   # swallowed
+        meter.on_record(None if False else {})        # minimal record
+        assert meter.describe()["totals"]["requests"] >= 1
+
+    def test_overflow_folds_to_bounded_other_tenant(self):
+        meter = us.UsageMeter(max_accounts=2)
+        for i in range(5):
+            meter.on_record(_rec(tenant=f"t{i}"))
+        doc = meter.describe()
+        assert doc["accounts"] <= 3  # 2 direct + the overflow tenant
+        other = next(a for a in doc["tenants"]
+                     if a["tenant"] == us.OVERFLOW_TENANT)
+        assert other["requests"] == 3
+        assert doc["overflow_folds"] == 3
+        # no attribution lost to the bound
+        assert doc["totals"]["requests"] == 5
+
+    def test_collect_emits_cumulative_families(self):
+        meter = us.UsageMeter(max_accounts=8)
+        meter.on_record(_rec())
+        meter.on_batch("m", 4, 8, 8, 0.25)
+        fams = {f for f, _lbls, _k, _v in meter.collect(now=0.0)}
+        assert fams == {"usage_tenant_requests_total",
+                        "usage_tenant_tokens_total",
+                        "usage_model_batches_total",
+                        "usage_model_batch_seconds_total",
+                        "usage_model_est_flops_total"}
+        st = ts.TimeSeriesStore(registries=[],
+                                tiers=(ts.Tier(1.0, 10),), interval_s=1.0)
+        st.add_collector(meter.collect)
+        st.sample(now=0)
+        doc = st.range("usage_tenant_requests_total", window_s=10, now=0)
+        assert doc["series"][0]["labels"] == {"tenant": "acme",
+                                              "model": "m"}
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: version-keyed FLOPs cache (the /debug/costs drift fix)
+
+
+class _FakeEntry:
+    def __init__(self, version, flops):
+        self._version = version
+        self._flops = flops
+        self.calls = 0
+
+    @property
+    def version(self):
+        return self._version
+
+    def cost_analysis(self, rows=None):
+        self.calls += 1
+        return {"available": True, "flops": self._flops * (rows or 1),
+                "bytes_accessed": 10.0, "rows": rows}
+
+
+class TestCostCache:
+    def test_flops_cached_per_version_and_rows(self):
+        entry = _FakeEntry("v1", 100.0)
+        meter = us.UsageMeter(max_accounts=8, cost_resolver=lambda n: entry)
+        meter.on_batch("m", 4, 8, 8, 0.1)
+        meter.on_batch("m", 4, 8, 8, 0.1)
+        assert entry.calls == 1                    # second batch cached
+        assert meter.describe()["models"]["m"]["est_flops"] == 1600.0
+
+    def test_hot_swap_re_resolves_cost(self):
+        entry = _FakeEntry("v1", 100.0)
+        meter = us.UsageMeter(max_accounts=8, cost_resolver=lambda n: entry)
+        meter.on_batch("m", 1, 8, 8, 0.1)          # v1: 800
+        entry._version, entry._flops = "v2", 300.0  # hot-swap
+        meter.on_batch("m", 1, 8, 8, 0.1)          # v2: 2400, NOT 800
+        assert entry.calls == 2
+        assert meter.describe()["models"]["m"]["est_flops"] == 3200.0
+
+    def test_unavailable_cost_counts_unresolved(self):
+        meter = us.UsageMeter(max_accounts=8, cost_resolver=lambda n: None)
+        meter.on_batch("m", 1, 8, 8, 0.1)
+        row = meter.describe()["models"]["m"]
+        assert row["est_flops"] == 0.0
+        assert row["flops_unresolved_batches"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ledger reconciliation
+
+
+class _FakeLedger:
+    def __init__(self, recs):
+        self._recs = recs
+
+    def recent(self, limit=100):
+        return self._recs[:limit]
+
+
+class TestReconciliation:
+    def test_covered_when_meter_matches_ledger_window(self):
+        meter = us.UsageMeter(max_accounts=8)
+        for _ in range(5):
+            meter.on_record(_rec())
+        ledger = _FakeLedger(
+            [dict(_rec(), state="done")] * 5 +
+            [dict(_rec(), state="active")])       # in-flight not counted
+        doc = meter.describe(ledger=ledger)
+        rec = doc["tenants"][0]["reconciliation"]
+        assert rec == {"ledger_window": 5, "metered": 5, "covered": True}
+
+    def test_shortfall_reads_uncovered(self):
+        meter = us.UsageMeter(max_accounts=8)
+        meter.on_record(_rec())
+        ledger = _FakeLedger([dict(_rec(), state="done")] * 3)
+        rec = meter.describe(ledger=ledger)["tenants"][0]["reconciliation"]
+        assert rec["covered"] is False
+
+
+# ---------------------------------------------------------------------------
+# capacity / headroom verdicts
+
+
+def _seeded_store(rates, *, step=1.0, n=120):
+    """A store holding serving_requests_total counters whose windowed
+    rate is exactly ``rates[model]`` req/s at t = n."""
+    st = ts.TimeSeriesStore(registries=[],
+                            tiers=(ts.Tier(step, 2 * n),), interval_s=step)
+    for t in range(n + 1):
+        for model, r in rates.items():
+            st.ingest("serving_requests_total", {"model": model},
+                      "counter", r * t, now=float(t))
+    return st
+
+
+class TestCapacity:
+    def test_verdict_flips_as_load_approaches_peak(self):
+        clock = [120.0]
+        st = _seeded_store({"m": 10.0})
+        ev = us.CapacityEvaluator(st, window_s=60, trend_window_s=100,
+                                  clock=lambda: clock[0])
+        rep = ev.evaluate()
+        row = rep["models"]["m"]
+        # first sighting: rate IS the measured peak -> occupancy 1
+        assert row["rate_rps"] == pytest.approx(10.0)
+        assert row["peak_rps"] == pytest.approx(10.0)
+        assert row["verdict"] == "exhausted"
+        # load falls to 50% of peak: headroom recovers, verdict ok
+        for t in range(121, 241):
+            st.ingest("serving_requests_total", {"model": "m"}, "counter",
+                      10.0 * 120 + 5.0 * (t - 120), now=float(t))
+        clock[0] = 240.0
+        rep = ev.evaluate()
+        row = rep["models"]["m"]
+        assert row["rate_rps"] == pytest.approx(5.0)
+        assert row["peak_rps"] == pytest.approx(10.0)  # peak retained
+        assert row["verdict"] == "ok"
+        assert rep["verdict"] == "ok"
+
+    def test_warn_band_between_thresholds(self):
+        clock = [120.0]
+        st = _seeded_store({"m": 10.0})
+        ev = us.CapacityEvaluator(st, window_s=60, trend_window_s=100,
+                                  clock=lambda: clock[0])
+        ev.evaluate()
+        # 80% of peak: headroom 0.2 inside [0.10, 0.30) -> warn
+        for t in range(121, 241):
+            st.ingest("serving_requests_total", {"model": "m"}, "counter",
+                      10.0 * 120 + 8.0 * (t - 120), now=float(t))
+        clock[0] = 240.0
+        assert ev.evaluate()["models"]["m"]["verdict"] == "warn"
+
+    def test_peak_reseeded_from_restored_history(self):
+        # a warm restart: the fresh evaluator has no running peak, but
+        # the restored store still holds the capacity_peak_rps gauge
+        st = ts.TimeSeriesStore(registries=[],
+                                tiers=(ts.Tier(1.0, 600),), interval_s=1.0)
+        st.ingest("capacity_peak_rps", {"model": "m"}, "gauge", 40.0,
+                  now=50.0)
+        for t in range(40, 101):
+            st.ingest("serving_requests_total", {"model": "m"}, "counter",
+                      4.0 * t, now=float(t))
+        ev = us.CapacityEvaluator(st, window_s=60, trend_window_s=100,
+                                  clock=lambda: 100.0)
+        row = ev.evaluate()["models"]["m"]
+        assert row["peak_rps"] == pytest.approx(40.0)  # not 4.0
+        assert row["verdict"] == "ok"
+
+    def test_trend_rising_and_falling(self):
+        clock = [120.0]
+        st = _seeded_store({"m": 2.0}, n=120)
+        ev = us.CapacityEvaluator(st, window_s=10, trend_window_s=120,
+                                  clock=lambda: clock[0])
+        # last 10 s spike at 10 req/s against a 2 req/s long window
+        for t in range(121, 131):
+            st.ingest("serving_requests_total", {"model": "m"}, "counter",
+                      2.0 * 120 + 10.0 * (t - 120), now=float(t))
+        clock[0] = 130.0
+        assert ev.evaluate()["models"]["m"]["trend"] == "rising"
+
+    def test_report_caches_and_collect_never_returns_points(self):
+        st = _seeded_store({"m": 1.0})
+        ev = us.CapacityEvaluator(st, clock=lambda: 120.0)
+        assert ev.collect(120.0) == []
+        assert ev.report() is ev.last
+        assert "m" in ev.report()["models"]
+
+
+# ---------------------------------------------------------------------------
+# heavy leg: high-cardinality attribution under the account bound (the
+# fast overflow test above covers the same fold at toy sizes)
+
+
+@pytest.mark.slow
+class TestHighCardinality:
+    def test_10k_records_500_tenants_conserved_under_bound(self):
+        meter = us.UsageMeter(max_accounts=256)
+        for i in range(10_000):
+            meter.on_record(_rec(tenant=f"t{i % 500}"))
+        doc = meter.describe()
+        # the table never exceeds its bound (+1 for the overflow fold)
+        assert doc["accounts"] <= 257
+        # and not one request lost attribution
+        assert doc["totals"]["requests"] == 10_000
+        other = next(a for a in doc["tenants"]
+                     if a["tenant"] == us.OVERFLOW_TENANT)
+        assert other["requests"] == doc["overflow_folds"] > 0
+
+
+# ---------------------------------------------------------------------------
+# federation: fleet usage / capacity / timeseries from worker snapshots
+
+
+def _worker_snapshot(wid, *, t=None, gen=1, usage=None, capacity=None,
+                     timeseries=None):
+    return {
+        "worker": wid, "num_workers": 2, "generation": gen,
+        "pid": 1000 + wid, "time": time.time() if t is None else t,
+        "metrics": {"metrics": []},
+        "flight": {"capacity": 16, "dropped_total": 0, "count": 0,
+                   "events": []},
+        "spans": [],
+        "usage": usage, "capacity": capacity, "timeseries": timeseries,
+    }
+
+
+def _usage_doc(tenant, requests):
+    return {"tenants": [{"tenant": tenant, "model": "m",
+                         "requests": requests, "errors": 0,
+                         "tokens_in": 2 * requests,
+                         "tokens_out": 3 * requests}],
+            "totals": {"requests": requests}}
+
+
+def _capacity_doc(rate, peak, verdict):
+    return {"verdict": verdict,
+            "models": {"m": {"rate_rps": rate, "peak_rps": peak,
+                             "verdict": verdict}}}
+
+
+def _ts_doc(rate, *, t0=1000.0, n=60):
+    st = ts.TimeSeriesStore(registries=[], tiers=(ts.Tier(1.0, 600),),
+                            interval_s=1.0,
+                            clock=lambda: t0 + n)
+    for t in range(n + 1):
+        st.ingest("serving_requests_total", {"model": "m"}, "counter",
+                  rate * t, now=t0 + t)
+    return st.snapshot()
+
+
+class TestFederation:
+    def setup_method(self):
+        self._aggs = []
+
+    def teardown_method(self):
+        for agg in self._aggs:
+            agg.close()
+
+    def _agg(self, tmp_path, snaps):
+        for wid, snap in snaps.items():
+            (Path(tmp_path) / f"worker_{wid}.json").write_text(
+                json.dumps(snap))
+        agg = fed.ClusterAggregator(num_workers=len(snaps),
+                                    sink_dir=tmp_path)
+        self._aggs.append(agg)
+        agg.poll()
+        return agg
+
+    def test_cluster_usage_sums_and_stamps(self, tmp_path):
+        agg = self._agg(tmp_path, {
+            0: _worker_snapshot(0, usage=_usage_doc("acme", 12)),
+            1: _worker_snapshot(1, gen=2, usage=_usage_doc("acme", 8))})
+        doc = agg.cluster_usage()
+        assert doc["totals"]["requests"] == 20
+        assert {(r["worker"], r["generation"])
+                for r in doc["accounts"]} == {(0, 1), (1, 2)}
+        fleet = doc["fleet"][0]
+        assert fleet["tenant"] == "acme" and fleet["requests"] == 20
+
+    def test_dead_worker_last_known_usage_retained(self, tmp_path):
+        # worker 1's snapshot is an hour old -> it reads down, but its
+        # final attribution still answers the fleet query
+        agg = self._agg(tmp_path, {
+            0: _worker_snapshot(0, usage=_usage_doc("acme", 12)),
+            1: _worker_snapshot(1, t=time.time() - 3600,
+                                usage=_usage_doc("globex", 5))})
+        agg.liveness_window_s = 1.0
+        table = agg.poll()
+        assert table["up"] == 1
+        doc = agg.cluster_usage()
+        assert doc["totals"]["requests"] == 17
+        assert any(r["tenant"] == "globex" for r in doc["accounts"])
+
+    def test_cluster_capacity_worst_verdict_and_fleet_headroom(
+            self, tmp_path):
+        agg = self._agg(tmp_path, {
+            0: _worker_snapshot(0, capacity=_capacity_doc(9.0, 10.0,
+                                                          "exhausted")),
+            1: _worker_snapshot(1, capacity=_capacity_doc(2.0, 10.0,
+                                                          "ok"))})
+        doc = agg.cluster_capacity()
+        assert doc["verdict"] == "exhausted"
+        m = doc["models"]["m"]
+        assert m["rate_rps"] == pytest.approx(11.0)
+        assert m["peak_rps"] == pytest.approx(20.0)
+        assert m["headroom"] == pytest.approx(1 - 11.0 / 20.0)
+        assert m["workers"] == 2
+
+    def test_cluster_timeseries_rate_sums_anchored_per_worker(
+            self, tmp_path):
+        agg = self._agg(tmp_path, {
+            0: _worker_snapshot(0, timeseries=_ts_doc(4.0)),
+            1: _worker_snapshot(1, gen=3, timeseries=_ts_doc(8.0))})
+        catalog = agg.cluster_timeseries()
+        assert catalog["families"]["serving_requests_total"] == [0, 1]
+        doc = agg.cluster_timeseries("serving_requests_total", op="rate",
+                                     window_s=60)
+        # fleet rate = sum over workers, each anchored at its own
+        # snapshot time (the stores' points live at t0=1000, far from
+        # wall time — only per-worker anchoring can see them)
+        assert doc["rate"] == pytest.approx(12.0)
+        assert {(s["labels"]["worker"], s["labels"]["generation"])
+                for s in doc["series"]} == {("0", "1"), ("1", "3")}
+
+    def test_cluster_timeseries_max_and_missing_docs_skipped(
+            self, tmp_path):
+        agg = self._agg(tmp_path, {
+            0: _worker_snapshot(0, timeseries=_ts_doc(4.0)),
+            1: _worker_snapshot(1)})                # no timeseries doc
+        doc = agg.cluster_timeseries("serving_requests_total", op="max",
+                                     window_s=60)
+        assert doc["workers"] == [0]
+        assert doc["value"] == pytest.approx(4.0 * 60)
+
+    def test_sanitize_coerces_malformed_nested_docs(self, tmp_path):
+        snap = _worker_snapshot(0, usage="garbage", capacity=[1, 2],
+                                timeseries=3.5)
+        agg = self._agg(tmp_path, {0: snap})
+        assert agg.cluster_usage()["accounts"] == []
+        assert agg.cluster_capacity()["workers"] == []
+        assert agg.cluster_timeseries()["workers"] == []
+
+
+# ---------------------------------------------------------------------------
+# live two-tenant server leg (one tiny batched model, module-compiled)
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.observability import reqlog as rl
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer, spec
+
+    def fwd(v, x):
+        return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+    # a fresh ledger: reconciliation compares this server's cumulative
+    # meter against the ledger window, so records retained from earlier
+    # modules' servers would read as a (false) attribution shortfall
+    prev_ledger = rl.get_request_ledger()
+    rl.set_request_ledger(rl.RequestLedger(2048))
+    reg = ModelRegistry()
+    reg.register("scale", fwd, {"scale": 2.0}, input_spec=spec((4,)),
+                 mode="batched", max_batch_size=8,
+                 devices=jax.devices()[:1])
+    srv = ModelServer(reg, port=0, sentinel=False)
+    srv.start(warm=True)
+    yield srv
+    srv.stop()
+    rl.set_request_ledger(prev_ledger)
+
+
+def _predict(server, n, tenant):
+    body = json.dumps({"inputs": [[0.0] * 4]}).encode()
+    for _ in range(n):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/models/scale:predict",
+            data=body, headers={"Content-Type": "application/json",
+                                "X-Tenant": tenant})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestServerEndToEnd:
+    def test_two_tenants_metered_and_reconciled(self, server):
+        _predict(server, 6, "acme")
+        _predict(server, 4, "globex")
+        status, doc = _get(
+            f"http://127.0.0.1:{server.port}/debug/usage")
+        assert status == 200
+        by_tenant = {a["tenant"]: a for a in doc["tenants"]}
+        assert by_tenant["acme"]["requests"] >= 6
+        assert by_tenant["globex"]["requests"] >= 4
+        for name in ("acme", "globex"):
+            rec = by_tenant[name].get("reconciliation")
+            assert rec is not None and rec["covered"] is True
+        # the batch listener priced device batches for the model
+        assert doc["models"]["scale"]["batches"] >= 1
+        assert doc["models"]["scale"]["batch_seconds"] > 0
+
+    def test_capacity_endpoint_reports_verdict(self, server):
+        _predict(server, 3, "acme")
+        now = server.timeseries._clock()
+        server.timeseries.sample(now=now - 30)
+        _predict(server, 3, "acme")
+        server.timeseries.sample(now=now)
+        status, doc = _get(
+            f"http://127.0.0.1:{server.port}/debug/capacity?evaluate=1")
+        assert status == 200
+        assert doc["verdict"] in ("ok", "warn", "exhausted")
+        assert "scale" in doc["models"]
+        row = doc["models"]["scale"]
+        assert row["rate_rps"] > 0
+        assert row["footprint"]["available"] in (True, False)
+
+    def test_usage_rolls_up_into_tsdb(self, server):
+        _predict(server, 2, "acme")
+        st = server.timeseries
+        now = st._clock()
+        # collectors are throttled to the rollup cadence; force two due
+        # passes so the synthetic usage families land in the rings
+        for col in st._collectors:
+            col["last"] = None
+        st.sample(now=now - 15)
+        for col in st._collectors:
+            col["last"] = None
+        st.sample(now=now)
+        fams = st.families()
+        assert "usage_tenant_requests_total" in fams
+        doc = st.range("usage_tenant_requests_total", window_s=60,
+                       labels={"tenant": "acme"}, now=now)
+        assert doc["series"] and doc["series"][0]["points"]
