@@ -113,6 +113,7 @@ from deeplearning4j_tpu.observability.sentinel import (
     Sentinel,
     SentinelMetrics,
     default_detectors,
+    default_fleet_detectors,
     get_sentinel_metrics,
 )
 from deeplearning4j_tpu.observability.slo import (
@@ -122,6 +123,7 @@ from deeplearning4j_tpu.observability.slo import (
     Selector,
     SLOMetrics,
     SLORule,
+    default_fleet_rules,
     default_serving_rules,
     get_default_engine,
     get_slo_metrics,
@@ -144,6 +146,7 @@ from deeplearning4j_tpu.observability.trace import (
     set_tail_sampler,
     set_tracing_enabled,
     span,
+    stitch_named_lanes,
     to_chrome_trace,
     tracing_enabled,
     write_chrome_trace,
@@ -190,6 +193,8 @@ __all__ = [
     "current_span",
     "default_cluster_rules",
     "default_detectors",
+    "default_fleet_detectors",
+    "default_fleet_rules",
     "default_registry",
     "default_serving_rules",
     "enabled",
@@ -243,6 +248,7 @@ __all__ = [
     "set_tracing_enabled",
     "unregister_profile_hook",
     "span",
+    "stitch_named_lanes",
     "to_chrome_trace",
     "tracing_enabled",
     "validate_rules_doc",

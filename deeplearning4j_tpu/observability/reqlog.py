@@ -134,11 +134,16 @@ class RequestLedger:
     until evicted)."""
 
     def __init__(self, capacity: int = 2048, *,
-                 sampler: Optional[_trace.TailSampler] = None):
+                 sampler: Optional[_trace.TailSampler] = None,
+                 tracer: Optional[_trace.Tracer] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.sampler = sampler
+        # where this ledger's retained spans land; None = the process
+        # ring. A router running in the same process as its backends
+        # (tests, benches) needs its OWN ring or their spans interleave.
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._ring: deque = deque()
         self._index: Dict[str, dict] = {}
@@ -251,7 +256,8 @@ class RequestLedger:
         reason, n_spans = (None, 0)
         if self.sampler is not None:
             reason, n_spans = self.sampler.finish(
-                cid, outcome=outcome, latency_s=latency)
+                cid, outcome=outcome, latency_s=latency,
+                tracer=self.tracer)
             with self._lock:
                 rec["trace_retained"] = reason
         m = _reqlog_metrics_or_none()
@@ -280,6 +286,21 @@ class RequestLedger:
         if self.begin(cid, plane=plane, model=model, **fields) is None:
             return None
         return self.finish(cid, outcome=outcome, status=status)
+
+    def amend(self, cid: str, **fields) -> Optional[dict]:
+        """Merge fields into a record regardless of state — post-hoc
+        enrichment computed AFTER completion (a stitch-time critical
+        path needs the backend's half, fetched on demand). Unlike
+        ``annotate`` this never gates on openness; it must not be used
+        from the request path."""
+        if not _ENABLED:
+            return None
+        with self._lock:
+            rec = self._index.get(cid)
+            if rec is None:
+                return None
+            rec.update(fields)
+            return dict(rec)
 
     # -- read surface --------------------------------------------------------
 

@@ -625,6 +625,42 @@ def default_detectors(*, fire_after: int = 3, clear_after: int = 3,
     ]
 
 
+def default_fleet_detectors(*, fire_after: int = 3, clear_after: int = 3,
+                            min_history: int = 8) -> List[Detector]:
+    """The router's detector set over its own instrument bundle: fleet
+    p99 regression at the front door (queueing + retries + network
+    included — the client's view, not one backend's), ejection storms
+    (backends churning in and out of the routing table), and a
+    sustained retry-budget exhaustion rate (failovers being refused —
+    the fleet is one backend loss away from hard errors)."""
+    k = dict(fire_after=fire_after, clear_after=clear_after,
+             min_history=min_history)
+    return [
+        Detector(
+            "fleet_p99_regression",
+            HistogramQuantileProbe("router_request_latency_seconds",
+                                   q=0.99, min_count=8),
+            mode="baseline", threshold=8.0, min_increase=0.5,
+            description="Router-vantage request p99 (bucket-resolved) "
+                        "rose far above its rolling baseline — the "
+                        "fleet as the client sees it.", **k),
+        Detector(
+            "fleet_ejection_storm",
+            CounterRateProbe("router_ejections_total"),
+            mode="ceiling", threshold=0.2,
+            description="Sustained backend ejections (>= 0.2/s): the "
+                        "routing table is churning, capacity is "
+                        "flapping.", **k),
+        Detector(
+            "fleet_retry_budget_exhaustion",
+            CounterRateProbe("router_retry_budget_exhausted_total"),
+            mode="ceiling", threshold=0.1,
+            description="Failovers being refused for lack of retry "
+                        "budget (>= 0.1/s sustained): failures are "
+                        "outrunning the budget's deposit rate.", **k),
+    ]
+
+
 # -- sentinel metric family ---------------------------------------------------
 
 

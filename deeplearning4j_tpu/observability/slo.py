@@ -431,6 +431,43 @@ def default_serving_rules() -> List[SLORule]:
     ]
 
 
+def default_fleet_rules() -> List[SLORule]:
+    """The rules a ``FleetRouter`` evaluates over its own registry plus
+    the federated scrape when none are supplied: fleet availability
+    (requests the router refused outright — sheds the backends never
+    saw), fleet p99 at the router vantage (queueing + retries + network
+    included), retry-budget burn, and ejection churn. All four are
+    mirrored by ``observability/example_rules.json``."""
+    return [
+        SLORule(
+            name="fleet-availability", kind="availability",
+            objective=0.999,
+            total=Selector("router_requests_total"),
+            bad=Selector("router_shed_total"),
+            windows=DEFAULT_WINDOWS, for_s=120.0, resolve_hold_s=300.0),
+        SLORule(
+            name="fleet-latency-p99", kind="latency",
+            objective=0.99, threshold_s=0.5,
+            histogram=Selector("router_request_latency_seconds"),
+            windows=DEFAULT_WINDOWS, for_s=120.0, resolve_hold_s=300.0),
+        SLORule(
+            name="fleet-retry-budget-burn", kind="availability",
+            objective=0.99,
+            total=Selector("router_requests_total"),
+            bad=Selector("router_retry_budget_exhausted_total"),
+            windows=(BurnWindow(300.0, 3600.0, 10.0),
+                     BurnWindow(1800.0, 21600.0, 4.0)),
+            for_s=60.0, resolve_hold_s=300.0),
+        SLORule(
+            name="fleet-ejection-churn", kind="availability",
+            objective=0.99,
+            total=Selector("router_probes_total"),
+            bad=Selector("router_ejections_total"),
+            windows=(BurnWindow(300.0, 3600.0, 10.0),),
+            for_s=60.0, resolve_hold_s=300.0),
+    ]
+
+
 # -- slo metric family --------------------------------------------------------
 
 

@@ -643,6 +643,34 @@ class TimeSeriesStore:
                 "families": sorted({key[0] for key in self._series}),
             }
 
+    def debug_query(self, *, family=None, window_s=None, step_s=None,
+                    op: str = "range", q=None,
+                    labels: Optional[Dict[str, str]] = None) -> dict:
+        """One ``/debug/timeseries`` query against the store — the
+        shared dispatch behind the backend's AND the router's endpoint
+        (one grammar at every vantage: no ``family`` → ``describe()``;
+        ``op`` = range | rate | quantile | max, ``quantile`` reads
+        ``q``). Raises ValueError on an unknown op — the HTTP layer
+        owns the status code, the store owns the grammar."""
+        if family is None:
+            return self.describe()
+        window = float(window_s) if window_s is not None else 600.0
+        if op == "rate":
+            return self.rate(family, window_s=window, step_s=step_s,
+                             labels=labels)
+        if op == "quantile":
+            return self.quantile_over_time(
+                family, float(q if q is not None else 0.99),
+                window_s=window, labels=labels)
+        if op == "max":
+            return self.max_over_time(family, window_s=window,
+                                      labels=labels)
+        if op == "range":
+            return self.range(family, window_s=window, step_s=step_s,
+                              labels=labels)
+        raise ValueError(
+            f"op must be range|rate|quantile|max, got {op!r}")
+
     # -- snapshot / restore ---------------------------------------------------
 
     def snapshot(self) -> dict:

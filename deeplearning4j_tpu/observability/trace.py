@@ -55,7 +55,7 @@ import time
 import uuid
 from collections import OrderedDict, deque
 from contextlib import contextmanager
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 # Wall-clock anchor + monotonic progression: timestamps are comparable
 # across threads and meaningful as dates, but never go backwards the way
@@ -521,6 +521,29 @@ def from_chrome_trace(trace: dict) -> List[Span]:
             thread=tid_names.get((ev.get("pid"), ev.get("tid"))),
             attrs=dict(args.get("attrs", {}))))
     return spans
+
+
+def stitch_named_lanes(lanes: Sequence[Tuple[str, Iterable[Span]]],
+                       *, attr: str = "tier") -> dict:
+    """One Perfetto document from several span sets, one pid lane per
+    entry in order (client=0, router=1, backend=2 for a cross-tier
+    request stitch). Each span is stamped ``attrs[attr] = lane name``
+    so :func:`from_chrome_trace` round-trips the grouping, not just the
+    spans — the federation layer's pid-lane idiom with named tiers
+    instead of worker ids."""
+    events: List[dict] = []
+    for pid, (name, spans) in enumerate(lanes):
+        stamped = []
+        for s in spans:
+            attrs = dict(s.attrs)
+            attrs[attr] = name
+            stamped.append(Span(
+                s.name, trace_id=s.trace_id, span_id=s.span_id,
+                parent_id=s.parent_id, start=s.start, end=s.end,
+                thread=s.thread, attrs=attrs))
+        events.extend(to_chrome_trace(
+            stamped, pid=pid, process_name=name)["traceEvents"])
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def write_chrome_trace(path: str, spans: Iterable[Span]) -> int:

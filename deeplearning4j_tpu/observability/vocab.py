@@ -63,6 +63,7 @@ ROUTER_KINDS = frozenset({
     "router.shed",
     "router.start",
     "router.stop",
+    "router.stream_broken",
 })
 
 # training + data pipeline (trainer.py / iterators.py)

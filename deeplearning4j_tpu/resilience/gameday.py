@@ -54,7 +54,7 @@ ENV_GAMEDAY_REPORT_DIR = "DL4J_TPU_GAMEDAY_REPORT_DIR"
 
 ACT_KINDS = ("fault", "clear_faults", "kill", "drain", "readmit", "call")
 GATE_KINDS = ("critical_failures", "availability", "mttr", "p99",
-              "recompiles")
+              "recompiles", "fleet_health")
 
 # counter families the fleet scrape sums for reconciliation + the
 # recompile gate (whichever exist on the target; a router federates
@@ -193,7 +193,10 @@ class Gate:
     Thresholds: ``max_count`` (critical_failures), ``min_ratio``
     (availability), ``max_s`` (mttr / p99), ``max_count``
     (recompiles); ``act`` names the anchor act for ``mttr`` (default:
-    the first ``kill`` act)."""
+    the first ``kill`` act). ``fleet_health`` polls the router's
+    ``/debug/health`` after the drill and breaches on any FIRING fleet
+    SLO rule — the server-side cross-check of what the client-ledger
+    gates measured from the outside."""
 
     def __init__(self, kind: str, *, name: Optional[str] = None,
                  scope: str = "run", act: Optional[str] = None,
@@ -212,7 +215,21 @@ class Gate:
         self.max_s = float(max_s)
 
     def evaluate(self, results: Sequence[dict],
-                 acts: Sequence[Act], fleet: dict) -> dict:
+                 acts: Sequence[Act], fleet: dict,
+                 health: Optional[dict] = None) -> dict:
+        if self.kind == "fleet_health":
+            # judged from the router's own SLO federation, not the
+            # client ledger: the two views must agree for a pass
+            if health is None or not isinstance(health.get("rules"),
+                                                list):
+                return self._verdict(False, None,
+                                     "fleet health endpoint "
+                                     "unavailable")
+            firing = sorted(r.get("name", "?")
+                            for r in health["rules"]
+                            if r.get("state") == "firing")
+            return self._verdict(not firing, firing or 0,
+                                 "no firing fleet rules")
         window = results
         if self.scope != "run":
             anchor = _act_named(acts, self.scope)
@@ -315,6 +332,19 @@ def scrape_fleet_counters(urls: Sequence[str],
     out = dict(totals)
     out["_scrape_errors"] = errors
     return out
+
+
+def fetch_fleet_health(url: str) -> Optional[dict]:
+    """One ``GET /debug/health`` against the drill target (a router
+    answers at fleet scope). None when unreachable — the fleet_health
+    gate turns that into a breach, not a crash."""
+    try:
+        req = urllib.request.Request(url.rstrip("/") + "/debug/health")
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            doc = json.loads(r.read())
+        return doc if isinstance(doc, dict) else None
+    except Exception:  # noqa: BLE001 — report, don't crash
+        return None
 
 
 def fetch_incident_index(urls: Sequence[str]) -> List[dict]:
@@ -445,9 +475,12 @@ class GameDay:
         summary = self.driver.join()
         results = summary.pop("results")
         fleet = scrape_fleet_counters(self.scrape_urls)
+        health = (fetch_fleet_health(self.base_url)
+                  if any(g.kind == "fleet_health" for g in self.gates)
+                  else None)
         verdicts = []
         for gate in self.gates:
-            v = gate.evaluate(results, self.acts, fleet)
+            v = gate.evaluate(results, self.acts, fleet, health)
             verdicts.append(v)
             record_event("gameday.gate", name=self.name,
                          gate=v["gate"], passed=v["passed"],
@@ -486,6 +519,11 @@ class GameDay:
             "gates": verdicts,
             "worst_requests": worst,
             "incidents": incidents,
+            "fleet_health": (None if health is None else {
+                "status": health.get("status"),
+                "rules": [{"name": r.get("name"),
+                           "state": r.get("state")}
+                          for r in health.get("rules", [])]}),
             "reconciliation": {
                 # the fleet must account for at least every success a
                 # client observed (retries make fleet >= client); a
@@ -534,6 +572,7 @@ __all__ = [
     "GameDay",
     "GameDayMetrics",
     "Gate",
+    "fetch_fleet_health",
     "fetch_incident_index",
     "get_gameday_metrics",
     "scrape_fleet_counters",

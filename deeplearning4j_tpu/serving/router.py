@@ -85,19 +85,23 @@ import hashlib
 import http.client
 import json
 import math
+import os
 import re
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
-from urllib.parse import urlsplit
+from urllib.parse import parse_qs, urlsplit
 
 from deeplearning4j_tpu.analysis.lockcheck import make_lock
 from deeplearning4j_tpu.observability.federation import (
     federate_instruments,
 )
 from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.observability.incidents import (
+    get_incident_manager,
+)
 from deeplearning4j_tpu.observability.metrics import (
     CONTENT_TYPE_OPENMETRICS,
     CONTENT_TYPE_TEXT,
@@ -106,7 +110,18 @@ from deeplearning4j_tpu.observability.metrics import (
     render_text_multi,
     wants_openmetrics,
 )
+from deeplearning4j_tpu.observability import reqlog as _reqlog
+from deeplearning4j_tpu.observability.sentinel import (
+    Sentinel,
+    default_fleet_detectors,
+)
+from deeplearning4j_tpu.observability.slo import (
+    HealthEngine,
+    default_fleet_rules,
+)
+from deeplearning4j_tpu.observability.timeseries import TimeSeriesStore
 from deeplearning4j_tpu.observability import trace as _trace
+from deeplearning4j_tpu.observability.usage import CapacityEvaluator
 from deeplearning4j_tpu.resilience.faults import (
     POINT_ROUTER_BACKEND_DOWN,
     POINT_ROUTER_BACKEND_LATENCY,
@@ -137,12 +152,51 @@ from deeplearning4j_tpu.serving.overload import (
     validate_priority,
 )
 
-_MODEL_ROUTE_RE = re.compile(r"^/v1/models/[\w.\-]+:(predict|generate)$")
+_MODEL_ROUTE_RE = re.compile(r"^/v1/models/([\w.\-]+):(predict|generate)$")
 _PREDICT_PATH_RE = re.compile(r"^/v1/models/([\w.\-]+):predict$")
 
 # admin states (the drain plane; health is the circuit's)
 ADMIN_ACTIVE = "active"
 ADMIN_DRAINING = "draining"
+
+# router observability knobs (analysis/knobs.py registers these)
+ENV_ROUTER_OBSERVABILITY = "DL4J_TPU_ROUTER_OBSERVABILITY"
+ENV_ROUTER_REQLOG_CAPACITY = "DL4J_TPU_ROUTER_REQLOG_CAPACITY"
+ENV_ROUTER_TRACE_CAPACITY = "DL4J_TPU_ROUTER_TRACE_CAPACITY"
+ENV_ROUTER_OBS_INTERVAL_S = "DL4J_TPU_ROUTER_OBS_INTERVAL_S"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def _path_plane_model(path: str) -> Tuple[str, str]:
+    """(ledger plane, model name) from a model route path. Router
+    records carry the SAME plane vocabulary as the backends' — predict
+    | generation — so fleet trace exports replay through the standard
+    ``ReplayDriver`` and plane filters compose across tiers."""
+    m = _MODEL_ROUTE_RE.match(path)
+    if m is None:
+        return "predict", "?"
+    return ("generation" if m.group(2) == "generate" else "predict",
+            m.group(1))
 
 
 def _retry_after_secs(ms) -> str:
@@ -350,6 +404,15 @@ class RouterMetrics:
             "Backend metric families dropped from the federated "
             "/metrics view because their type/labels/buckets disagreed "
             "with the family's first-seen shape.", ("name",))
+        self.request_phase = r.histogram(
+            "router_request_phase_seconds",
+            "Critical-path phase attribution per routed request: "
+            "router_overhead (pick + admission + serialization), "
+            "backend (final attempt leg: network + backend service "
+            "time), retry (wall time burned on failed legs before the "
+            "final one). Phases sum to the request's wall latency; the "
+            "stitch endpoint refines 'backend' into network/queue-wait/"
+            "compute when the backend's trace is retained.", ("phase",))
 
 
 class RetryBudget:
@@ -619,6 +682,202 @@ class _FederatedView:
         return self._instruments
 
 
+class _LiveFederatedRegistry:
+    """Duck-typed registry whose ``instruments()`` runs a FRESH
+    federation pass (cached ``max_staleness_s`` so one health tick +
+    TSDB sample + sentinel tick on the same cadence share a single
+    backend fan-out instead of tripling it). This is what the router's
+    HealthEngine / TimeSeriesStore / Sentinel read — fleet rules and
+    detectors see live backend series, not a snapshot from __init__."""
+
+    def __init__(self, router: "FleetRouter", max_staleness_s: float = 1.0):
+        self._router = router
+        self._staleness = float(max_staleness_s)
+        self._lock = threading.Lock()
+        self._cached = None
+        self._fetched_at: Optional[float] = None
+
+    def instruments(self):
+        now = time.monotonic()
+        with self._lock:
+            if self._cached is not None and self._fetched_at is not None \
+                    and now - self._fetched_at < self._staleness:
+                return self._cached
+        insts = self._router._federated_instruments()
+        with self._lock:
+            self._cached = insts
+            self._fetched_at = time.monotonic()
+        return insts
+
+
+class _FleetSentinel(Sentinel):
+    """Sentinel whose incident bundles carry FLEET state: the verdict
+    is enriched with the router's ``describe()`` doc (per-backend
+    health, circuit states, retry-budget balance, drain flags) so a
+    fleet-p99-regression bundle shows which backend was ejected when
+    the incident opened — the context a backend-local bundle can't."""
+
+    def __init__(self, router: "FleetRouter", detectors, **kw):
+        super().__init__(detectors, **kw)
+        self._router = router
+
+    def _open_incident(self, name, verdict):
+        try:
+            verdict = dict(verdict, fleet=self._router.describe())
+        except Exception:  # noqa: BLE001 — enrichment must never
+            pass           # block the incident itself
+        super()._open_incident(name, verdict)
+
+
+class _RequestObs:
+    """Per-request observability context at the router: one ledger
+    record plus the ``router.request``/``router.pick``/
+    ``router.attempt``/``router.proxy`` span set. Spans are buffered
+    and flushed in one pass at completion — the hot path pays dict
+    appends, not per-leg sampler traffic. Every method is a no-op when
+    the plane is disabled (``set_ledger_enabled(False)``, the bench
+    A/B lever, or ``DL4J_TPU_ROUTER_OBSERVABILITY=0``)."""
+
+    __slots__ = ("router", "cid", "plane", "model", "enabled", "root_id",
+                 "client_span", "t0", "attempts", "spans", "proxy_s")
+
+    def __init__(self, router: "FleetRouter", cid: str, path: str,
+                 headers: dict, deadline_ms=None, payload=None):
+        self.router = router
+        self.cid = cid
+        self.enabled = router._obs_enabled()
+        if not self.enabled:
+            return
+        self.plane, self.model = _path_plane_model(path)
+        self.root_id = _trace.new_id()
+        self.client_span = headers.get("X-Span-ID") or None
+        self.t0 = _trace.now()
+        self.attempts: List[dict] = []
+        self.spans: List[_trace.Span] = []
+        self.proxy_s = 0.0
+        fields: dict = {}
+        if deadline_ms is not None:
+            fields["deadline_s"] = float(deadline_ms) / 1000.0
+        if isinstance(payload, dict):
+            # the replay-trace row fields (shape, never bytes): what
+            # /debug/requests?format=trace at the ROUTER vantage ships
+            if self.plane == "generation":
+                fields["stream"] = bool(payload.get("stream", True))
+                mnt = payload.get("max_new_tokens")
+                if isinstance(mnt, (int, float)):
+                    fields["max_new_tokens"] = int(mnt)
+            else:
+                shape = _payload_shape_of(payload.get("inputs"))
+                if shape is not None:
+                    fields["payload_shape"] = shape
+        router.reqlog.begin(cid, plane=self.plane, model=self.model,
+                            tenant=headers.get("X-Tenant") or None,
+                            **fields)
+
+    def annotate(self, **fields) -> None:
+        if self.enabled:
+            self.router.reqlog.annotate(self.cid, **fields)
+
+    def span(self, name: str, start: float, end: float, *,
+             span_id: Optional[str] = None,
+             parent_id: Optional[str] = None, **attrs) -> Optional[str]:
+        if not self.enabled:
+            return None
+        sid = span_id or _trace.new_id()
+        self.spans.append(_trace.Span(
+            name, trace_id=self.cid, span_id=sid,
+            parent_id=parent_id if parent_id is not None else self.root_id,
+            start=start, end=end,
+            thread=threading.current_thread().name, attrs=attrs))
+        return sid
+
+    def attempt_begin(self) -> Tuple[Optional[str], float]:
+        """Mint the attempt leg's span id BEFORE the forward so it can
+        ride ``X-Span-ID`` — the backend's ``serving.request`` root
+        then parents to this leg and the stitched tree is one tree."""
+        if not self.enabled:
+            return None, 0.0
+        return _trace.new_id(), _trace.now()
+
+    def attempt_end(self, span_id: Optional[str], t_start: float,
+                    backend: str, outcome: str,
+                    status: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        t_end = _trace.now()
+        leg = {"backend": backend, "outcome": outcome,
+               "latency_s": round(max(0.0, t_end - t_start), 6)}
+        if status is not None:
+            leg["status"] = status
+        self.attempts.append(leg)
+        self.span("router.attempt", t_start, t_end, span_id=span_id,
+                  backend=backend, outcome=outcome,
+                  **({"status": status} if status is not None else {}))
+
+    def shed(self, reason: str, *, status: int, outcome: str = "shed",
+             priority: Optional[str] = None) -> None:
+        """Close the record for a request the router refused without
+        contacting any backend — the offered load backends never saw."""
+        self.finish(outcome=outcome, status=status,
+                    admission=f"shed:{reason}", priority=priority)
+
+    def finish(self, *, outcome: str, status: int, backend: str = "",
+               priority: Optional[str] = None, **fields) -> None:
+        if not self.enabled:
+            return
+        t_end = _trace.now()
+        total = max(0.0, t_end - self.t0)
+        backend_s = (self.attempts[-1]["latency_s"]
+                     if self.attempts else 0.0) + self.proxy_s
+        retry_s = sum(a["latency_s"] for a in self.attempts[:-1])
+        phases = {
+            "router_overhead": round(
+                max(0.0, total - backend_s - retry_s), 6),
+            "backend": round(backend_s, 6),
+            "retry": round(retry_s, 6),
+        }
+        m = self.router.metrics
+        m.request_phase.observe(phases["router_overhead"],
+                                phase="router_overhead")
+        if backend_s > 0:
+            m.request_phase.observe(backend_s, phase="backend")
+        if retry_s > 0:
+            m.request_phase.observe(retry_s, phase="retry")
+        rl = self.router.reqlog
+        rl.annotate(self.cid, critical_path=phases,
+                    attempts=list(self.attempts),
+                    retries=max(0, len(self.attempts) - 1),
+                    failover=len(self.attempts) > 1,
+                    backend=backend,
+                    **({"priority": priority} if priority else {}),
+                    **fields)
+        self.span("router.request", self.t0, t_end,
+                  span_id=self.root_id, parent_id=self.client_span,
+                  model=self.model, backend=backend, status=status,
+                  outcome=outcome,
+                  retries=max(0, len(self.attempts) - 1))
+        # spans offer into the router's OWN sampler before the ledger's
+        # retention decision runs (finish pops the staging buffer); a
+        # span the stager has no room for still lands in the ring
+        sampler, tracer = self.router._sampler, self.router.tracer
+        for s in self.spans:
+            if not sampler.offer(s):
+                tracer.record(s)
+        rl.finish(self.cid, outcome=outcome, status=status)
+        self.enabled = False  # exactly one finish per record
+
+
+def _payload_shape_of(inputs) -> Optional[List[int]]:
+    """Best-effort [rows, cols] of a predict payload's ``inputs`` —
+    what replay synthesizes request bodies from. Never deep-validates
+    (the backend 400s junk; the router only labels it)."""
+    if not isinstance(inputs, list) or not inputs:
+        return None
+    if isinstance(inputs[0], list):
+        return [len(inputs), len(inputs[0])]
+    return [len(inputs)]
+
+
 # internal marker: the forward path's transport-level failure.
 # ``timeout=True`` means the backend was reachable but slow — it must
 # NOT feed the consecutive-failure ejection streak (three slow requests
@@ -643,6 +902,7 @@ class FleetRouter:
                  port: int = 0,
                  policy: Optional[RouterPolicy] = None,
                  metrics: Optional[RouterMetrics] = None,
+                 observability: Optional[bool] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.policy = (policy or RouterPolicy()).validate()
         self.metrics = metrics if metrics is not None else RouterMetrics()
@@ -675,6 +935,38 @@ class FleetRouter:
                 max_bytes=self.policy.cache_max_bytes,
                 metrics=CacheMetrics(self.metrics.registry),
                 plane="router", clock=clock)
+        # -- fleet observability spine (ROADMAP item 7) -------------------
+        # Router-OWNED ledger + span ring (never the process globals:
+        # an in-process fleet's backends write those, and the router's
+        # records must not interleave with theirs). The HealthEngine /
+        # TimeSeriesStore / Sentinel read the router registry UNION a
+        # live federated view, so one curl at the router answers "is
+        # the FLEET meeting its SLO". Construction is threadless —
+        # background cadences arm in start(), unwind in stop().
+        self._observability = (observability if observability is not None
+                               else _env_flag(ENV_ROUTER_OBSERVABILITY,
+                                              True))
+        obs_interval = _env_float(ENV_ROUTER_OBS_INTERVAL_S, 10.0)
+        self.tracer = _trace.Tracer(
+            capacity=_env_int(ENV_ROUTER_TRACE_CAPACITY, 4096))
+        self._sampler = _trace.TailSampler()
+        self.reqlog = _reqlog.RequestLedger(
+            _env_int(ENV_ROUTER_REQLOG_CAPACITY, 2048),
+            sampler=self._sampler, tracer=self.tracer)
+        self._fed_view = _LiveFederatedRegistry(self)
+        self.timeseries = TimeSeriesStore(
+            registries=[self.metrics.registry, self._fed_view])
+        self.capacity = CapacityEvaluator(self.timeseries)
+        self.timeseries.add_collector(self.capacity.collect,
+                                      every_s=obs_interval)
+        self.slo_engine = HealthEngine(
+            default_fleet_rules(),
+            registries=[self.metrics.registry, self._fed_view],
+            interval_s=obs_interval, store=self.timeseries)
+        self.sentinel = _FleetSentinel(
+            self, default_fleet_detectors(),
+            registries=[self.metrics.registry, self._fed_view],
+            interval_s=obs_interval)
         # fleet priority-shed state (None fleet_max_in_flight disables)
         self._fleet_lock = make_lock("FleetRouter._fleet_lock")
         self._class_in_flight = {p: 0 for p in PRIORITIES}
@@ -738,7 +1030,49 @@ class FleetRouter:
                 elif path == "/debug/fleet":
                     self._send(200, router.describe())
                 elif path == "/debug/requests":
-                    self._send(200, router.render_fleet_requests(query))
+                    status, body = router.render_fleet_requests(query)
+                    self._send(status, body)
+                elif path.startswith("/debug/requests/"):
+                    cid = path[len("/debug/requests/"):]
+                    status, body = router.render_stitched_request(cid)
+                    self._send(status, body)
+                elif path == "/debug/health":
+                    if "format=text" in query:
+                        self._send(
+                            200, router.render_health_text().encode(),
+                            content_type="text/plain")
+                    else:
+                        self._send(200, router.render_health())
+                elif path == "/debug/timeseries":
+                    q = parse_qs(query)
+                    try:
+                        window_s = (float(q["window"][0])
+                                    if "window" in q else None)
+                        step_s = (float(q["step"][0])
+                                  if "step" in q else None)
+                        quant = float(q["q"][0]) if "q" in q else None
+                    except ValueError:
+                        self._send(400, BadRequestError(
+                            "window, step and q must be "
+                            "numbers").to_json())
+                        return
+                    labels = {k[len("label."):]: v[0]
+                              for k, v in q.items()
+                              if k.startswith("label.")}
+                    for shorthand in ("model", "tenant"):
+                        if shorthand in q:
+                            labels[shorthand] = q[shorthand][0]
+                    status, body = router.render_timeseries(
+                        family=q.get("family", [None])[0],
+                        window_s=window_s, step_s=step_s,
+                        op=q.get("op", ["range"])[0], q=quant,
+                        labels=labels or None)
+                    self._send(status, body)
+                elif path == "/debug/capacity":
+                    q = parse_qs(query)
+                    self._send(200, router.render_capacity(
+                        evaluate=q.get("evaluate", ["0"])[0]
+                        in ("1", "true")))
                 elif path == "/debug/incidents":
                     self._send(200, router.render_fleet_incidents())
                 elif path == "/models":
@@ -772,13 +1106,14 @@ class FleetRouter:
                     payload = {}  # the backend will 400 the junk
                 deadline_ms = router._deadline_from(payload)
                 try:
-                    if m.group(1) == "generate" \
+                    if m.group(2) == "generate" \
                             and bool(payload.get("stream", True)):
                         self._stream_started = False
                         try:
                             router.route_stream(self, path, body,
                                                 headers, cid,
-                                                deadline_ms=deadline_ms)
+                                                deadline_ms=deadline_ms,
+                                                payload=payload)
                         except Exception as e:  # noqa: BLE001
                             if self._stream_started:
                                 # a 200 chunked response is already in
@@ -798,7 +1133,8 @@ class FleetRouter:
                         priority=self.headers.get("X-Priority"),
                         affinity=self.headers.get(
                             router.policy.affinity_header),
-                        deadline_ms=deadline_ms)
+                        deadline_ms=deadline_ms, cid=cid,
+                        payload=payload)
                 except Exception as e:  # noqa: BLE001 — surface, never
                     # crash the connection: a router bug must come back
                     # as a structured 500, not a reset the client then
@@ -877,6 +1213,12 @@ class FleetRouter:
         routable = [b.name for b in self._backends if b.routable]
         return {"ready": bool(routable), "routable": routable,
                 "backends": len(self._backends)}
+
+    def _obs_enabled(self) -> bool:
+        # the module-global ledger switch is the whole-plane bench A/B
+        # lever: set_ledger_enabled(False) silences the router's
+        # ledger AND its span plane in one move, same as the backends'
+        return self._observability and _reqlog.ledger_enabled()
 
     def describe(self) -> dict:
         """The ``/debug/fleet`` document."""
@@ -1122,18 +1464,23 @@ class FleetRouter:
 
     def route_request(self, path: str, body: bytes, headers: dict, *,
                       priority=None, affinity: Optional[str] = None,
-                      deadline_ms: Optional[float] = None
+                      deadline_ms: Optional[float] = None,
+                      cid: Optional[str] = None, payload=None
                       ) -> Tuple[int, bytes, Optional[float]]:
         """Route one non-streaming request; returns ``(status,
         raw_body, retry_after_ms)`` — the raw backend body passes
         through verbatim on both success and final failure."""
         t0 = self._clock()
+        obs = _RequestObs(self, cid or _trace.new_id(), path, headers,
+                          deadline_ms=deadline_ms, payload=payload)
         timeout = self._request_timeout(deadline_ms)
         try:
             prio = self._validate_priority(priority)
         except ServingError as e:
             self.metrics.requests_total.inc(backend="",
                                             code=str(e.http_status))
+            obs.shed("bad_priority", status=e.http_status,
+                     outcome="error")
             return (e.http_status, json.dumps(e.to_json()).encode(),
                     e.retry_after_ms)
         # Fleet cache consult — BEFORE the fleet admission gate: a hit
@@ -1168,6 +1515,9 @@ class FleetRouter:
                                                         code="200")
                         self.metrics.request_latency.observe(
                             self._clock() - t0, backend="")
+                        obs.finish(outcome="ok", status=200,
+                                   priority=prio, cache="hit",
+                                   admission="cache_hit")
                         return 200, hit.value, None
         admitted, retry_after_ms = self._fleet_admit(prio)
         if not admitted:
@@ -1176,12 +1526,13 @@ class FleetRouter:
             self.metrics.requests_total.inc(backend="", code="429")
             record_event("router.shed", priority=prio,
                          reason="fleet_overload")
+            obs.shed("fleet_overload", status=429, priority=prio)
             err = QueueFullError("fleet over capacity (router shed)",
                                  retry_after_ms=retry_after_ms)
             return 429, json.dumps(err.to_json()).encode(), retry_after_ms
         try:
             result = self._route_admitted(path, body, headers, prio,
-                                          affinity, timeout, t0)
+                                          affinity, timeout, t0, obs)
         finally:
             self._fleet_release(prio)
         if ckey is not None and result[0] == 200:
@@ -1190,24 +1541,40 @@ class FleetRouter:
         return result
 
     def _route_admitted(self, path, body, headers, prio, affinity,
-                        timeout, t0):
+                        timeout, t0, obs):
         self.budget.deposit()
         self.metrics.retry_budget_balance.set(self.budget.balance)
         tried: List[str] = []
         final: Optional[Tuple[int, bytes, Optional[float]]] = None
         backend_name = ""
+        budget_exhausted = False
         for attempt in (0, 1):
+            tp = _trace.now()
             b = self._pick(exclude=tried, affinity=affinity)
+            if obs.enabled:
+                obs.span("router.pick", tp, _trace.now(),
+                         attempt=attempt,
+                         picked=b.name if b is not None else "",
+                         excluded=len(tried))
             if b is None:
                 break
             tried.append(b.name)
             backend_name = b.name
+            sid, ts = obs.attempt_begin()
+            # the attempt span id rides X-Span-ID so the backend's
+            # serving.request root parents to THIS leg — one stitched
+            # tree per correlation id across tiers
+            h = headers if sid is None else {**headers,
+                                             "X-Span-ID": sid}
             try:
                 status, raw, resp_headers = self._attempt(
-                    b, path, body, headers, timeout)
+                    b, path, body, h, timeout)
                 conn_fail = False
             except _ConnectFailure as e:
                 conn_fail, status, raw = True, 503, b""
+                obs.attempt_end(sid, ts, b.name,
+                                "timeout" if e.timeout
+                                else "connect_fail")
                 err = ConnectionFailedError(
                     f"backend {b.name} unreachable: {e}",
                     retry_after_ms=250.0)
@@ -1218,6 +1585,12 @@ class FleetRouter:
                     # pass the typed retryable failure to the client
                     break
             if not conn_fail:
+                obs.attempt_end(
+                    sid, ts, b.name,
+                    "ok" if status < 400
+                    else ("retryable" if self._retryable_response(status)
+                          else "error"),
+                    status=status)
                 # the Retry-After probe JSON-parses the body — only
                 # error responses can carry one, and re-parsing every
                 # 200's outputs would be the hot path's biggest cost
@@ -1236,6 +1609,7 @@ class FleetRouter:
                 self.metrics.retry_budget_exhausted_total.inc()
                 record_event("router.retry_budget_exhausted",
                              backend=b.name)
+                budget_exhausted = True
                 break
             reason = "connect" if conn_fail else "status"
             self.metrics.retries_total.inc(reason=reason)
@@ -1244,16 +1618,26 @@ class FleetRouter:
         if final is None:
             self.metrics.shed_total.inc(priority=prio,
                                         reason="no_backend")
+            record_event("router.shed", priority=prio,
+                         reason="no_backend")
             err = NotReadyError("no routable backend",
                                 retry_after_ms=1000.0 *
                                 self.policy.probe_interval_s * 2)
             final = (503, json.dumps(err.to_json()).encode(),
                      err.retry_after_ms)
             backend_name = ""
+            obs.shed("no_backend", status=503, priority=prio)
         self.metrics.requests_total.inc(backend=backend_name,
                                         code=str(final[0]))
         self.metrics.request_latency.observe(self._clock() - t0,
                                              backend=backend_name)
+        status = final[0]
+        obs.finish(outcome=("ok" if status < 400
+                            else "shed" if status == 429 else "error"),
+                   status=status, backend=backend_name, priority=prio,
+                   retry_budget=round(self.budget.balance, 3),
+                   **({"retry_budget_exhausted": True}
+                      if budget_exhausted else {}))
         return final
 
     @staticmethod
@@ -1277,19 +1661,24 @@ class FleetRouter:
 
     def route_stream(self, handler, path: str, body: bytes,
                      headers: dict, cid: str, *,
-                     deadline_ms: Optional[float] = None) -> None:
+                     deadline_ms: Optional[float] = None,
+                     payload=None) -> None:
         """Proxy one streaming generate. Failover happens only while
         picking a backend and opening its response — BEFORE the first
         token; once the backend stream is open its chunks relay
         verbatim, and a mid-stream transport failure becomes the
         terminal typed error line (tokens already relayed stand)."""
         t0 = self._clock()
+        obs = _RequestObs(self, cid, path, headers,
+                          deadline_ms=deadline_ms, payload=payload)
         try:
             prio = self._validate_priority(
                 handler.headers.get("X-Priority"))
         except ServingError as e:
             self.metrics.requests_total.inc(backend="",
                                             code=str(e.http_status))
+            obs.shed("bad_priority", status=e.http_status,
+                     outcome="error")
             handler._send(e.http_status, e.to_json())
             return
         admitted, retry_after_ms = self._fleet_admit(prio)
@@ -1297,17 +1686,21 @@ class FleetRouter:
             self.metrics.shed_total.inc(priority=prio,
                                         reason="fleet_overload")
             self.metrics.requests_total.inc(backend="", code="429")
+            record_event("router.shed", priority=prio,
+                         reason="fleet_overload")
+            obs.shed("fleet_overload", status=429, priority=prio)
             handler._send(429, QueueFullError(
                 "fleet over capacity (router shed)",
                 retry_after_ms=retry_after_ms).to_json())
             return
         try:
             self._stream_admitted(handler, path, body, headers, cid,
-                                  prio, t0, deadline_ms)
+                                  prio, t0, deadline_ms, obs)
         finally:
             self._fleet_release(prio)
 
-    def _open_stream(self, path, body, headers, affinity, timeout):
+    def _open_stream(self, path, body, headers, affinity, timeout,
+                     obs):
         """The failover loop for streams: returns ``(backend, conn,
         resp, None)`` with the backend response OPEN (status 200), or
         ``(None, None, None, (status, raw_body, via))`` where ``via``
@@ -1318,7 +1711,13 @@ class FleetRouter:
         tried: List[str] = []
         final_err: Optional[Tuple[int, bytes, str]] = None
         for attempt in (0, 1):
+            tp = _trace.now()
             b = self._pick(exclude=tried, affinity=affinity)
+            if obs.enabled:
+                obs.span("router.pick", tp, _trace.now(),
+                         attempt=attempt,
+                         picked=b.name if b is not None else "",
+                         excluded=len(tried))
             if b is None:
                 break
             tried.append(b.name)
@@ -1328,15 +1727,21 @@ class FleetRouter:
             b.begin()
             self.metrics.backend_in_flight.set(b.in_flight,
                                                backend=b.name)
+            sid, ts = obs.attempt_begin()
+            h = headers if sid is None else {**headers,
+                                             "X-Span-ID": sid}
             conn = None
             try:
                 self._maybe_inject_down(b)
                 conn = http.client.HTTPConnection(
                     b.host, b.port, timeout=timeout)
-                conn.request("POST", path, body=body, headers=headers)
+                conn.request("POST", path, body=body, headers=h)
                 resp = conn.getresponse()
                 if resp.status == 200:
                     b.note_result(True, token)
+                    # the leg's latency is time-to-open; the relay
+                    # itself is the router.proxy span's business
+                    obs.attempt_end(sid, ts, b.name, "ok", status=200)
                     return b, conn, resp, None
                 raw = resp.read()
                 if resp.status == 503:
@@ -1344,6 +1749,10 @@ class FleetRouter:
                 else:
                     b.note_result(True, token)
                 self._close_stream(b, conn)
+                obs.attempt_end(
+                    sid, ts, b.name,
+                    "retryable" if self._retryable_response(resp.status)
+                    else "error", status=resp.status)
                 final_err = (resp.status, raw, b.name)
                 if not self._retryable_response(resp.status):
                     break
@@ -1356,6 +1765,9 @@ class FleetRouter:
                 else:
                     b.note_result(False, token)
                 self._close_stream(b, conn)
+                obs.attempt_end(sid, ts, b.name,
+                                "timeout" if is_timeout
+                                else "connect_fail")
                 err = ConnectionFailedError(
                     f"backend {b.name} unreachable: {e}",
                     retry_after_ms=250.0)
@@ -1368,9 +1780,13 @@ class FleetRouter:
                 break
             if not self.budget.try_spend():
                 self.metrics.retry_budget_exhausted_total.inc()
+                record_event("router.retry_budget_exhausted",
+                             backend=b.name)
                 break
             self.metrics.retries_total.inc(reason="stream_open")
             self.metrics.retry_budget_balance.set(self.budget.balance)
+            record_event("router.retry", backend=b.name,
+                         reason="stream_open")
         if final_err is None:
             err = NotReadyError("no routable backend")
             final_err = (503, json.dumps(err.to_json()).encode(), "")
@@ -1400,15 +1816,25 @@ class FleetRouter:
                                            backend=backend.name)
 
     def _stream_admitted(self, handler, path, body, headers, cid,
-                         prio, t0, deadline_ms=None):
+                         prio, t0, deadline_ms=None, obs=None):
         timeout = self._request_timeout(deadline_ms)
         affinity = handler.headers.get(self.policy.affinity_header)
+        if obs is None:
+            obs = _RequestObs(self, cid, path, headers,
+                              deadline_ms=deadline_ms)
         backend, conn, resp, err = self._open_stream(
-            path, body, headers, affinity, timeout)
+            path, body, headers, affinity, timeout, obs)
         if backend is None:
             status, raw, via = err
             self.metrics.requests_total.inc(backend=via,
                                             code=str(status))
+            if via == "" and status == 503:
+                obs.shed("no_backend", status=503, priority=prio)
+            else:
+                obs.finish(outcome=("shed" if status == 429
+                                    else "error"),
+                           status=status, backend=via, priority=prio,
+                           retry_budget=round(self.budget.balance, 3))
             # the backend's Retry-After hint must survive the raw-bytes
             # passthrough (the auto-derivation in _send is dict-only)
             ra = self._retry_after_from(raw, {})
@@ -1416,6 +1842,7 @@ class FleetRouter:
                      if ra is not None else None)
             handler._send(status, raw, extra_headers=extra)
             return
+        t_open = _trace.now()
         # backend stream open: from here on we are committed — send the
         # client headers and relay chunk lines verbatim. NOTE the
         # stdlib chunked reader SWALLOWS IncompleteRead on the
@@ -1424,6 +1851,7 @@ class FleetRouter:
         # event, not by the transport — anything else synthesizes the
         # typed mid-stream error line.
         status = 200
+        client_gone = broken = False
         try:
             handler._stream_started = True  # past this point a second
             handler.send_response(200)      # response would corrupt
@@ -1494,6 +1922,23 @@ class FleetRouter:
                                             code=str(status))
             self.metrics.request_latency.observe(
                 self._clock() - t0, backend=backend.name)
+            if obs.enabled:
+                t_done = _trace.now()
+                obs.proxy_s = max(0.0, t_done - t_open)
+                obs.span("router.proxy", t_open, t_done,
+                         backend=backend.name, broken=broken,
+                         client_gone=client_gone)
+                if broken:
+                    record_event("router.stream_broken",
+                                 backend=backend.name, cid=cid)
+                obs.finish(outcome="error" if broken else "ok",
+                           status=status, backend=backend.name,
+                           priority=prio,
+                           retry_budget=round(self.budget.balance, 3),
+                           **({"stream_broken": True} if broken
+                              else {}),
+                           **({"client_gone": True} if client_gone
+                              else {}))
 
     # -- drain / rolling deploy ----------------------------------------------
 
@@ -1761,13 +2206,48 @@ class FleetRouter:
         view = _FederatedView(self._federated_instruments())
         return render_json_multi([self.metrics.registry, view])
 
-    def render_fleet_requests(self, query: str = "") -> dict:
-        """``/debug/requests`` federated: every backend's ledger list
-        view merged newest-first, each record tagged with its backend."""
-        q = ("?" + query) if query else ""
+    def render_fleet_requests(self, query: str = ""
+                              ) -> Tuple[int, dict]:
+        """``/debug/requests`` at the router: the router's OWN ledger
+        records (``tier: "router"`` — one lifecycle record per offered
+        request, sheds included) merged newest-first with every
+        backend's list view (``tier: "backend"``). ``format=trace``
+        exports the ROUTER ledger alone: the backends never saw the
+        shed fraction, so the router vantage is the only replayable
+        picture of true offered load — and merging backend docs would
+        double-count every forwarded request."""
+        q = parse_qs(query)
+        try:
+            min_latency_ms = (float(q["min_latency_ms"][0])
+                              if "min_latency_ms" in q else None)
+            limit = int(q.get("limit", ["100"])[0])
+            window_s = (float(q["window_s"][0])
+                        if "window_s" in q else None)
+        except ValueError:
+            return 400, BadRequestError(
+                "min_latency_ms, window_s and limit must "
+                "be numbers").to_json()
+        if q.get("format", [None])[0] == "trace":
+            return 200, self.reqlog.export_trace(
+                plane=q.get("plane", [None])[0],
+                model=q.get("model", [None])[0],
+                window_s=window_s,
+                limit=(limit if "limit" in q else None))
         merged: List[dict] = []
+        for rec in self.reqlog.query(
+                outcome=q.get("outcome", [None])[0],
+                tenant=q.get("tenant", [None])[0],
+                model=q.get("model", [None])[0],
+                plane=q.get("plane", [None])[0],
+                min_latency_s=(min_latency_ms / 1000.0
+                               if min_latency_ms is not None else None),
+                limit=limit):
+            rec = dict(rec)
+            rec["tier"] = "router"
+            merged.append(rec)
         per_backend = {}
-        docs = self._fetch_all("/debug/requests" + q)
+        fq = ("?" + query) if query else ""
+        docs = self._fetch_all("/debug/requests" + fq)
         for b in self._backends:
             doc = docs.get(b.name)
             if doc is None:
@@ -1778,14 +2258,149 @@ class FleetRouter:
             for rec in records:
                 rec = dict(rec)
                 rec["backend"] = b.name
+                rec["tier"] = "backend"
                 merged.append(rec)
         merged.sort(key=lambda r: r.get("t_start", 0.0), reverse=True)
-        return {"count": len(merged), "backends": per_backend,
-                "records": merged}
+        return 200, {"ledger": self.reqlog.describe(),
+                     "count": len(merged), "backends": per_backend,
+                     "records": merged}
+
+    def render_stitched_request(self, cid: str) -> Tuple[int, dict]:
+        """``/debug/requests/<cid>``: ONE Perfetto document for a
+        routed request — client / router / backend pid lanes stitched
+        from the router's retained span tree plus the serving
+        backend's, fetched on demand by the same correlation id. The
+        refined critical path (network vs backend queue-wait vs
+        compute, carved out of the coarse finish-time attribution) is
+        amended onto the router's ledger record so a later list query
+        shows it without re-stitching."""
+        rec = self.reqlog.get(cid)
+        router_spans = self.tracer.spans(trace_id=cid)
+        if rec is None and not router_spans:
+            return 404, ServingError(
+                f"no request {cid!r} in the router ledger or "
+                "tracer ring").to_json()
+        # -- the backend's half, by the same cid ------------------------
+        backend_name = (rec or {}).get("backend") or ""
+        bdoc = None
+        if backend_name:
+            for b in self._backends:
+                if b.name == backend_name:
+                    bdoc = self._fetch_backend_json(
+                        b, f"/debug/requests/{cid}")
+                    break
+        backend_spans: List[_trace.Span] = []
+        backend_rec = None
+        if bdoc is not None:
+            backend_rec = bdoc.get("record")
+            for sj in (bdoc.get("trace") or {}).get("spans") or []:
+                try:
+                    backend_spans.append(_trace.Span.from_json(sj))
+                except Exception:  # noqa: BLE001 — a malformed span
+                    continue       # must not sink the stitch
+        backend_trace = "ok" if backend_spans else "unavailable"
+        # -- lanes ------------------------------------------------------
+        root = next((s for s in router_spans
+                     if s.name == "router.request"), None)
+        t_start = (rec or {}).get("t_start")
+        t_end = (rec or {}).get("t_end")
+        if t_start is None and root is not None:
+            t_start, t_end = root.start, root.end
+        client_lane: List[_trace.Span] = []
+        if t_start is not None and t_end is not None:
+            # the client's own tracer is out of reach — synthesize its
+            # lane from the record envelope so the stitched doc always
+            # shows who waited, even for clients that sent no X-Span-ID
+            client_lane.append(_trace.Span(
+                "client.request", trace_id=cid,
+                span_id=(root.parent_id if root is not None
+                         and root.parent_id else f"client-{cid}"),
+                start=t_start, end=t_end,
+                attrs={"synthesized": True}))
+        lanes = [("client", client_lane), ("router", router_spans)]
+        if backend_spans:
+            lanes.append((f"backend-{backend_name}", backend_spans))
+        stitched = _trace.stitch_named_lanes(lanes)
+        # -- critical path refinement -----------------------------------
+        phases = dict((rec or {}).get("critical_path") or {})
+        refined = None
+        if rec is not None and backend_spans:
+            serving = next((s for s in backend_spans
+                            if s.name == "serving.request"), None)
+            if serving is not None:
+                legs = rec.get("attempts") or []
+                leg_s = legs[-1]["latency_s"] if legs else 0.0
+                queue_wait = None
+                if isinstance(backend_rec, dict):
+                    queue_wait = backend_rec.get("queue_wait_s")
+                if queue_wait is None:
+                    queue_wait = sum(
+                        s.duration for s in backend_spans
+                        if s.name == "serving.admission")
+                served = serving.duration
+                refined = {
+                    "router_overhead": phases.get("router_overhead",
+                                                  0.0),
+                    "retry": phases.get("retry", 0.0),
+                    "network": round(max(0.0, leg_s - served), 6),
+                    "backend_queue_wait": round(
+                        min(queue_wait, served), 6),
+                    "backend_compute": round(
+                        max(0.0, served - min(queue_wait, served)), 6),
+                }
+                self.reqlog.amend(cid, critical_path_refined=refined,
+                                  backend_trace=backend_trace)
+                rec = self.reqlog.get(cid) or rec
+        if rec is not None and not backend_spans:
+            self.reqlog.amend(cid, backend_trace=backend_trace)
+            rec = self.reqlog.get(cid) or rec
+        return 200, {
+            "record": rec,
+            "backend": backend_name or None,
+            "backend_trace": backend_trace,
+            "backend_record": backend_rec,
+            "critical_path": refined if refined is not None else phases,
+            "router_spans": len(router_spans),
+            "backend_spans": len(backend_spans),
+            "stitched": stitched,
+        }
+
+    def render_health(self) -> dict:
+        """``/debug/health`` at FLEET scope: a fresh HealthEngine tick
+        over the router registry union the live federated view — one
+        curl answers "is the fleet meeting its SLO"."""
+        return self.slo_engine.tick()
+
+    def render_health_text(self) -> str:
+        self.slo_engine.tick()
+        return self.slo_engine.render_text()
+
+    def render_timeseries(self, *, family=None, window_s=None,
+                          step_s=None, op="range", q=None,
+                          labels=None) -> Tuple[int, dict]:
+        """``/debug/timeseries`` at fleet scope — same grammar as the
+        backend endpoint, answered from the router's own store (which
+        samples the federated scrape, so backend families appear under
+        their ``worker`` labels)."""
+        try:
+            return 200, self.timeseries.debug_query(
+                family=family, window_s=window_s, step_s=step_s,
+                op=op, q=q, labels=labels)
+        except ValueError as e:
+            return 400, BadRequestError(str(e)).to_json()
+
+    def render_capacity(self, *, evaluate: bool = False) -> dict:
+        """``/debug/capacity`` at fleet scope: per-model FLEET offered
+        load vs summed peaks (federated worker-labeled series sum into
+        one per-model rate) — the autoscaler input."""
+        return (self.capacity.evaluate() if evaluate
+                else self.capacity.report())
 
     def render_fleet_incidents(self) -> dict:
         """``/debug/incidents`` federated: bundle indexes merged with a
-        ``backend`` tag (fetch one bundle from its backend directly)."""
+        ``backend`` tag (fetch one bundle from its backend directly),
+        plus the router sentinel's live verdicts and its own fleet
+        incident index."""
         merged: List[dict] = []
         docs = self._fetch_all("/debug/incidents")
         for b in self._backends:
@@ -1796,7 +2411,11 @@ class FleetRouter:
                 inc = dict(inc)
                 inc["backend"] = b.name
                 merged.append(inc)
-        return {"incidents": merged}
+        out: dict = {"incidents": merged,
+                     "sentinel": self.sentinel.verdicts()}
+        if self.sentinel.incidents is not None:
+            out["router_incidents"] = self.sentinel.incidents.index()
+        return out
 
     def proxy_models(self) -> Tuple[int, dict]:
         """``GET /models`` answered by the first reachable backend (a
@@ -1823,6 +2442,15 @@ class FleetRouter:
             target=self._probe_loop, daemon=True,
             name="fleet-router-prober")
         self._probe_thread.start()
+        if self._observability:
+            # incidents attach lazily HERE, not in __init__: a router
+            # constructed for a unit test must not create bundle dirs
+            if self.sentinel.incidents is None:
+                self.sentinel.incidents = get_incident_manager(
+                    create=True)
+            self.timeseries.start()
+            self.slo_engine.start()
+            self.sentinel.start()
         self._started = True
         self._update_routable_gauge()
         record_event("router.start", port=self.port,
@@ -1835,6 +2463,10 @@ class FleetRouter:
             if self._probe_thread is not None:
                 self._probe_thread.join(timeout=5)
                 self._probe_thread = None
+            if self._observability:
+                self.sentinel.stop()
+                self.slo_engine.stop()
+                self.timeseries.stop()
             self._httpd.shutdown()
             if self._serve_thread is not None:
                 self._serve_thread.join(timeout=10)

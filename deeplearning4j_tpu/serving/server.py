@@ -1458,24 +1458,12 @@ class ModelServer:
             return 404, ServingError(
                 "historical telemetry is disabled "
                 "(pass timeseries=None/a TimeSeriesStore)").to_json()
-        if family is None:
-            return 200, store.describe()
-        window = float(window_s) if window_s is not None else 600.0
-        if op == "rate":
-            return 200, store.rate(family, window_s=window, step_s=step_s,
-                                   labels=labels)
-        if op == "quantile":
-            return 200, store.quantile_over_time(
-                family, float(q if q is not None else 0.99),
-                window_s=window, labels=labels)
-        if op == "max":
-            return 200, store.max_over_time(family, window_s=window,
-                                            labels=labels)
-        if op == "range":
-            return 200, store.range(family, window_s=window, step_s=step_s,
-                                    labels=labels)
-        return 400, BadRequestError(
-            f"op must be range|rate|quantile|max, got {op!r}").to_json()
+        try:
+            return 200, store.debug_query(family=family, window_s=window_s,
+                                          step_s=step_s, op=op, q=q,
+                                          labels=labels)
+        except ValueError as e:
+            return 400, BadRequestError(str(e)).to_json()
 
     def render_usage(self) -> Tuple[int, dict]:
         """GET /debug/usage: per-(tenant, model) accounts on both

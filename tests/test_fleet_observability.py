@@ -1,0 +1,456 @@
+"""Fleet-level observability tests (serving/router.py observability
+tier + observability/*): every routed request leaves ONE ledger record
+at the router (chosen backend, every retry leg, failover point,
+critical-path phases); ``/debug/requests/<cid>`` stitches the router's
+retained span tree with the serving backend's into ONE Perfetto
+document (client / router / backend pid lanes) that round-trips
+losslessly; shed requests the backends never saw still appear in the
+ledger AND its replayable trace export; and one curl at the router
+answers fleet health / timeseries / capacity.
+
+Budget discipline: ONE module-scoped 3-backend in-process fleet is
+shared by every test here; the fixture arms deterministic span
+retention (``sample_every=1``) so stitching never depends on winning
+the 1-in-128 baseline sample. The "backend stopped" fast variant
+builds a 1-backend fleet of its own; only the SIGKILL subprocess
+variant is ``@pytest.mark.slow``.
+"""
+
+import contextlib
+import json
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import reqlog as _rl
+from deeplearning4j_tpu.observability import trace as _tr
+from deeplearning4j_tpu.resilience.faults import (
+    FaultInjector,
+    set_fault_injector,
+)
+from deeplearning4j_tpu.serving import (
+    FleetRouter,
+    ModelRegistry,
+    ModelServer,
+    RouterPolicy,
+    ServingClient,
+    spec,
+)
+
+_FLEET_SCALES = {1.0, 2.0, 3.0}
+
+_FLEET_RULE_NAMES = {"fleet-availability", "fleet-latency-p99",
+                     "fleet-retry-budget-burn", "fleet-ejection-churn"}
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _scale_forward(v, x):
+    return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+
+def _mk_backend_server(scale):
+    registry = ModelRegistry()
+    registry.register("scale", _scale_forward, {"scale": scale},
+                      input_spec=spec((4,)), version="v1",
+                      mode="batched", max_batch_size=8,
+                      devices=jax.devices()[:1])
+    server = ModelServer(registry, port=0, sentinel=False)
+    server.start(warm=True)
+    return server
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _raw_predict(url, *, headers=None, rows=1):
+    body = json.dumps({"inputs": [[0.0] * 4] * rows}).encode()
+    req = urllib.request.Request(
+        url + "/v1/models/scale:predict", data=body,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def obs_fleet():
+    """3 in-process backends behind one observability-ON router.
+
+    Probing parked (30 s): the failover test arms one-shot
+    ``router.backend_down`` plans on the process-global injector and a
+    live prober would consume the firings before the request path saw
+    them (same discipline as TestRouterFailover in test_router.py).
+    Both the backends' process-global tail sampler and the router's
+    own get a ``sample_every=1`` retention policy: every request's
+    span tree is kept, so the stitch assertions are deterministic.
+    """
+    sampler = _tr.get_tail_sampler(create=True)
+    prev_policy = sampler.policy
+    prev_enabled = _rl.ledger_enabled()
+    sampler.policy = _tr.RetentionPolicy(sample_every=1)
+    _rl.set_ledger_enabled(True)
+    servers = []
+    try:
+        for i in range(3):
+            servers.append(_mk_backend_server(float(i + 1)))
+        router = FleetRouter(
+            [(f"b{i}", s.url) for i, s in enumerate(servers)],
+            policy=RouterPolicy(probe_interval_s=30.0),
+            observability=True).start()
+        router._sampler.policy = _tr.RetentionPolicy(sample_every=1)
+        try:
+            ns = type("ObsFleet", (), {})()
+            ns.router = router
+            ns.servers = servers
+            ns.x = np.zeros((1, 4), np.float32)
+            yield ns
+        finally:
+            router.stop()
+    finally:
+        for s in servers:
+            with contextlib.suppress(Exception):
+                s.stop(drain=False)
+        sampler.policy = prev_policy
+        _rl.set_ledger_enabled(prev_enabled)
+
+
+# ---------------------------------------------------------------------------
+# the router request ledger
+
+
+class TestRouterLedger:
+    def test_one_record_per_request_with_coarse_critical_path(
+            self, obs_fleet):
+        cid = "fobs-basic-1"
+        out = _raw_predict(obs_fleet.router.url,
+                           headers={"X-Correlation-ID": cid,
+                                    "X-Tenant": "acme"})
+        assert out["outputs"][0][0] in _FLEET_SCALES
+        rec = obs_fleet.router.reqlog.get(cid)
+        assert rec is not None
+        assert rec["plane"] == "predict"
+        assert rec["model"] == "scale"
+        assert rec["outcome"] == "ok" and rec["status"] == 200
+        assert rec["tenant"] == "acme"
+        assert rec["backend"] in {"b0", "b1", "b2"}
+        assert rec["failover"] is False and rec["retries"] == 0
+        [leg] = rec["attempts"]
+        assert leg["backend"] == rec["backend"]
+        assert leg["outcome"] == "ok" and leg["status"] == 200
+        # retry-budget state rides every record
+        assert isinstance(rec["retry_budget"], float)
+        # coarse finish-time attribution sums to the wall latency
+        cp = rec["critical_path"]
+        assert set(cp) == {"router_overhead", "backend", "retry"}
+        assert abs(sum(cp.values()) - rec["latency_s"]) < 0.05
+        assert cp["backend"] > 0
+
+    def test_debug_requests_merges_router_and_backend_tiers(
+            self, obs_fleet):
+        url = obs_fleet.router.url
+        _raw_predict(url)
+        doc = _get_json(url + "/debug/requests?limit=200")
+        assert doc["count"] >= 2
+        tiers = {r["tier"] for r in doc["records"]}
+        assert tiers == {"router", "backend"}
+        assert all(r["backend"] in {"b0", "b1", "b2"}
+                   for r in doc["records"] if r["tier"] == "backend")
+        # newest-first across tiers
+        starts = [r.get("t_start", 0.0) for r in doc["records"]]
+        assert starts == sorted(starts, reverse=True)
+        # the per-request phase histogram observed at finish is
+        # scrapeable at the router
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'router_request_phase_seconds' in text
+        assert 'phase="router_overhead"' in text
+
+    def test_shed_requests_land_in_ledger_and_trace_export(
+            self, obs_fleet):
+        """The router-shed blind spot: a request refused AT the router
+        (no backend ever saw it) still gets a ledger record and rides
+        the replayable trace export as offered load."""
+        url = obs_fleet.router.url
+        cid = "fobs-shed-1"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _raw_predict(url, headers={"X-Correlation-ID": cid,
+                                       "X-Priority": "bogus"}, rows=3)
+        rec = obs_fleet.router.reqlog.get(cid)
+        assert rec is not None
+        assert rec["admission"] == "shed:bad_priority"
+        assert rec["outcome"] == "error"
+        assert rec["status"] == ei.value.code
+        assert rec["backend"] == "" and rec["attempts"] == []
+        # the export carries the shed row (payload_shape [3, 4] tags it)
+        doc = _get_json(url + "/debug/requests?format=trace")
+        assert doc["kind"] == "dl4j_tpu_trace"
+        assert any(row["payload_shape"] == [3, 4] for row in doc["rows"])
+
+    def test_unknown_cid_is_404(self, obs_fleet):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(obs_fleet.router.url + "/debug/requests/nope-404")
+        assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# cross-tier trace stitching
+
+
+class TestCrossTierStitching:
+    def test_failover_stitch_round_trips_losslessly(self, obs_fleet):
+        """THE stitching acceptance: 3 backends under load, one
+        retry-elsewhere failover; ``/debug/requests/<cid>`` returns ONE
+        Perfetto doc whose client/router/backend pid lanes round-trip
+        losslessly, with the failed attempt leg visible and the refined
+        critical-path phases summing to the measured wall latency."""
+        router = obs_fleet.router
+        # background load across the fleet: the stitch must come off a
+        # busy router, not an idle one
+        def load(tid):
+            c = ServingClient(router.url, max_retries=2, retry_seed=tid)
+            for _ in range(8):
+                c.predict("scale", obs_fleet.x, deadline_ms=30000)
+
+        threads = [threading.Thread(target=load, args=(t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        inj = FaultInjector()
+        inj.plan("router.backend_down", at=1, times=1, arg=-1.0)
+        set_fault_injector(inj)
+        cid = "fobs-stitch-1"
+        try:
+            out = _raw_predict(router.url,
+                               headers={"X-Correlation-ID": cid}, rows=2)
+        finally:
+            set_fault_injector(None)
+        assert out["outputs"][0][0] in _FLEET_SCALES
+
+        doc = _get_json(router.url + f"/debug/requests/{cid}")
+        rec = doc["record"]
+        # the failover is on the record: two legs, first one failed,
+        # retried elsewhere
+        assert rec["failover"] is True and rec["retries"] == 1
+        first, second = rec["attempts"]
+        assert first["outcome"] in ("connect_fail", "timeout")
+        assert second["outcome"] == "ok"
+        assert first["backend"] != second["backend"]
+        assert rec["backend"] == second["backend"]
+
+        # both halves retained: 2x pick + 2x attempt + request = 5
+        assert doc["backend_trace"] == "ok"
+        assert doc["router_spans"] >= 5
+        assert doc["backend_spans"] >= 1
+
+        # ONE Perfetto document, three pid lanes, lossless round trip
+        stitched = doc["stitched"]
+        spans = _tr.from_chrome_trace(stitched)
+        assert len(spans) == (doc["router_spans"] + doc["backend_spans"]
+                              + 1)  # + the synthesized client span
+        tiers = {s.attrs["tier"] for s in spans}
+        assert tiers == {"client", "router", f"backend-{rec['backend']}"}
+        pids = {ev["pid"] for ev in stitched["traceEvents"]
+                if ev.get("ph") == "X"}
+        assert pids == {0, 1, 2}
+        # every router-retained span survives the doc, ids intact
+        router_ids = {s.span_id
+                      for s in router.tracer.spans(trace_id=cid)}
+        assert router_ids <= {s.span_id for s in spans}
+        # the failed attempt leg is visible IN the stitched doc
+        attempts = [s for s in spans if s.name == "router.attempt"]
+        assert len(attempts) == 2
+        assert {s.attrs["outcome"] for s in attempts} == {
+            first["outcome"], "ok"}
+        # the backend's serving.request parents to the router's
+        # winning attempt leg (X-Span-ID rewrite): one tree, not two
+        serving = next(s for s in spans if s.name == "serving.request")
+        winning = next(s for s in attempts if s.attrs["outcome"] == "ok")
+        assert serving.parent_id == winning.span_id
+
+        # refined critical path: phases sum to the wall latency
+        cp = doc["critical_path"]
+        assert set(cp) == {"router_overhead", "retry", "network",
+                           "backend_queue_wait", "backend_compute"}
+        assert cp["retry"] > 0          # the failed leg cost something
+        assert abs(sum(cp.values()) - rec["latency_s"]) < 0.05
+        # ... and is amended onto the ledger record for later listings
+        amended = router.reqlog.get(cid)
+        assert amended["critical_path_refined"] == cp
+        assert amended["backend_trace"] == "ok"
+
+    def test_backend_stopped_renders_unavailable(self):
+        """Fast in-process variant of the SIGKILL acceptance: the
+        serving backend is gone by stitch time — the router's half
+        still renders, marked ``backend_trace: unavailable``."""
+        server = _mk_backend_server(1.0)
+        router = FleetRouter(
+            [("b0", server.url)],
+            policy=RouterPolicy(probe_interval_s=30.0),
+            observability=True).start()
+        router._sampler.policy = _tr.RetentionPolicy(sample_every=1)
+        stopped = False
+        try:
+            cid = "fobs-dead-1"
+            _raw_predict(router.url,
+                         headers={"X-Correlation-ID": cid})
+            server.stop(drain=False)
+            stopped = True
+            doc = _get_json(router.url + f"/debug/requests/{cid}")
+            assert doc["backend_trace"] == "unavailable"
+            assert doc["backend_spans"] == 0
+            assert doc["record"]["outcome"] == "ok"
+            assert router.reqlog.get(cid)["backend_trace"] == \
+                "unavailable"
+            # client + router lanes only
+            pids = {ev["pid"] for ev in doc["stitched"]["traceEvents"]
+                    if ev.get("ph") == "X"}
+            assert pids == {0, 1}
+        finally:
+            router.stop()
+            if not stopped:
+                server.stop(drain=False)
+
+
+_BACKEND_SCRIPT = textwrap.dedent("""
+    import sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+                                            spec)
+
+    port = int(sys.argv[1])
+
+    def fwd(v, x):
+        return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+    reg = ModelRegistry()
+    reg.register("scale", fwd, {"scale": 1.0}, input_spec=spec((4,)),
+                 version="v1", mode="batched", max_batch_size=8)
+    srv = ModelServer(reg, port=port, sentinel=False)
+    srv.start(warm=True)
+    print("READY", srv.port, flush=True)
+    while True:
+        time.sleep(3600)
+""")
+
+
+@pytest.mark.slow
+def test_sigkill_backend_stitch_renders_unavailable():
+    """The full acceptance variant: a REAL subprocess backend serves
+    the request, then dies by SIGKILL — the stitch endpoint still
+    renders the router's half with ``backend_trace: unavailable``."""
+    import os
+
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _BACKEND_SCRIPT, str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    router = None
+    try:
+        deadline = time.monotonic() + 60.0
+        ready = False
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("READY"):
+                ready = True
+                break
+            if proc.poll() is not None:
+                break
+        if not ready:
+            pytest.skip("subprocess backend failed to start")
+        router = FleetRouter(
+            [("b0", f"http://127.0.0.1:{port}")],
+            policy=RouterPolicy(probe_interval_s=30.0),
+            observability=True).start()
+        router._sampler.policy = _tr.RetentionPolicy(sample_every=1)
+        cid = "fobs-kill-1"
+        out = _raw_predict(router.url,
+                           headers={"X-Correlation-ID": cid})
+        assert out["outputs"][0][0] == 1.0
+        proc.kill()
+        proc.wait(timeout=10)
+        doc = _get_json(router.url + f"/debug/requests/{cid}")
+        assert doc["backend_trace"] == "unavailable"
+        assert doc["record"]["outcome"] == "ok"
+        assert doc["backend_spans"] == 0
+    finally:
+        if router is not None:
+            router.stop()
+        if proc.poll() is None:
+            proc.kill()
+        with contextlib.suppress(subprocess.TimeoutExpired):
+            proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# fleet SLO federation + history
+
+
+class TestFleetHealth:
+    def test_one_curl_answers_fleet_slo(self, obs_fleet):
+        _raw_predict(obs_fleet.router.url)
+        doc = _get_json(obs_fleet.router.url + "/debug/health")
+        assert isinstance(doc["status"], str)
+        assert {r["name"] for r in doc["rules"]} == _FLEET_RULE_NAMES
+        assert all(r["state"] in ("ok", "pending", "firing", "resolved")
+                   for r in doc["rules"])
+
+    def test_health_text_rendering(self, obs_fleet):
+        with urllib.request.urlopen(
+                obs_fleet.router.url + "/debug/health?format=text",
+                timeout=10) as r:
+            text = r.read().decode()
+        assert "fleet-availability" in text
+
+    def test_fleet_timeseries_and_capacity(self, obs_fleet):
+        url = obs_fleet.router.url
+        doc = _get_json(url + "/debug/timeseries")
+        assert doc["running"] is True
+        # the store samples the router registry UNION the live
+        # federated view, so backend families are in its tier list
+        q = _get_json(url + "/debug/timeseries?family="
+                            "router_requests_total&op=rate&window_s=60")
+        assert isinstance(q, dict)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(url + "/debug/timeseries?family=x&op=bogus")
+        assert ei.value.code == 400
+        cap = _get_json(url + "/debug/capacity?evaluate=1")
+        assert "verdict" in cap and "models" in cap
+
+    def test_fleet_incidents_carry_sentinel_verdicts(self, obs_fleet):
+        doc = _get_json(obs_fleet.router.url + "/debug/incidents")
+        assert "incidents" in doc
+        names = {d["detector"]
+                 for d in doc["sentinel"]["detectors"]}
+        # the shipped fleet detector set is armed on the router
+        assert names == {"fleet_p99_regression", "fleet_ejection_storm",
+                         "fleet_retry_budget_exhaustion"}

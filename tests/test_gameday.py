@@ -215,6 +215,63 @@ class TestGameDayFast:
         assert report["verdict"] == "pass"
 
 
+class TestFleetHealthGate:
+    """The ``fleet_health`` gate (PR 19): judged from the target's own
+    SLO federation — the server-side cross-check of the client-ledger
+    gates. Pure-logic units plus one live-drill leg on the shared
+    mixed server."""
+
+    def test_passes_when_no_rule_fires(self):
+        g = gd.Gate("fleet_health")
+        health = {"status": "ok", "rules": [
+            {"name": "fleet-availability", "state": "ok"},
+            {"name": "fleet-latency-p99", "state": "pending"}]}
+        v = g.evaluate([], [], {}, health)
+        assert v["passed"] is True
+        assert v["value"] == 0
+        assert v["kind"] == "fleet_health"
+
+    def test_breaches_on_any_firing_rule_and_names_them(self):
+        g = gd.Gate("fleet_health")
+        health = {"status": "firing", "rules": [
+            {"name": "fleet-ejection-churn", "state": "firing"},
+            {"name": "fleet-availability", "state": "firing"},
+            {"name": "fleet-latency-p99", "state": "ok"}]}
+        v = g.evaluate([], [], {}, health)
+        assert v["passed"] is False
+        assert v["value"] == ["fleet-availability",
+                              "fleet-ejection-churn"]
+
+    def test_unreachable_health_is_a_breach_not_a_crash(self):
+        g = gd.Gate("fleet_health")
+        assert g.evaluate([], [], {}, None)["passed"] is False
+        # a malformed doc (no rules list) is just as unusable
+        assert g.evaluate([], [], {},
+                          {"status": "ok"})["passed"] is False
+
+    def test_from_script_and_live_drill_carry_fleet_health(
+            self, mixed_server):
+        """A drill scripted with a fleet_health gate polls the
+        target's ``/debug/health`` and the report carries the rule
+        states it judged."""
+        url = f"http://127.0.0.1:{mixed_server.port}"
+        drill = gd.GameDay.from_script(
+            {"name": "fleet-health-drill", "speed": 10, "clients": 2,
+             "gates": [{"kind": "fleet_health"},
+                       {"kind": "availability", "min_ratio": 0.5}]},
+            base_url=url, trace=_predict_trace(4))
+        report = drill.run()
+        assert report["fleet_health"] is not None
+        assert all(set(r) == {"name", "state"}
+                   for r in report["fleet_health"]["rules"])
+        by_gate = {v["gate"]: v for v in report["gates"]}
+        assert by_gate["fleet_health"]["passed"] is True
+
+    def test_fetch_fleet_health_none_on_unreachable(self):
+        assert gd.fetch_fleet_health(
+            f"http://127.0.0.1:{_free_port()}") is None
+
+
 # ---------------------------------------------------------------------------
 # THE slow acceptance: recorded trace at 10x vs a subprocess router
 # fleet, one backend SIGKILLed, serving.latency firing on a survivor

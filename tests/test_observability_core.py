@@ -347,6 +347,32 @@ class TestSpans:
         trace = json.loads(open(path).read())
         assert any(ev.get("ph") == "X" for ev in trace["traceEvents"])
 
+    def test_stitch_named_lanes_pids_and_round_trip(self, fresh):
+        """The cross-tier stitch primitive (PR 19): one Perfetto doc,
+        one pid lane per named span set in order, each span stamped
+        with its lane name so the grouping round-trips too."""
+        cid = self._tree()
+        router_spans = tr.get_tracer().spans(cid)
+        client = tr.Span("client.request", trace_id=cid,
+                         span_id=tr.new_id(), start=0.9, end=2.1)
+        doc = tr.stitch_named_lanes(
+            [("client", [client]), ("router", router_spans),
+             ("backend-b0", [])])
+        x_events = [ev for ev in doc["traceEvents"]
+                    if ev.get("ph") == "X"]
+        assert {ev["pid"] for ev in x_events} == {0, 1}  # b0 lane empty
+        lane_names = {ev["args"]["name"] for ev in doc["traceEvents"]
+                      if ev.get("ph") == "M"
+                      and ev.get("name") == "process_name"}
+        assert {"client", "router", "backend-b0"} <= lane_names
+        back = tr.from_chrome_trace(doc)
+        assert len(back) == len(router_spans) + 1
+        tiers = {s.attrs["tier"] for s in back}
+        assert tiers == {"client", "router"}
+        # identity survives: every original span id is in the doc
+        assert {s.span_id for s in router_spans} <= \
+            {s.span_id for s in back}
+
     def test_correlation_id_links_client_to_dispatch(self, fresh):
         from deeplearning4j_tpu.serving import (
             ModelRegistry,

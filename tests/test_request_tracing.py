@@ -310,6 +310,38 @@ class TestRequestLedger:
         finally:
             rl.set_ledger_enabled(True)
 
+    def test_amend_enriches_closed_records_post_hoc(self):
+        """``amend`` is the stitch-time enrichment path (PR 19): it
+        merges into a record regardless of state — unlike ``annotate``,
+        which gates on openness."""
+        led = self._ledger()
+        cid = tr.new_id()
+        led.begin(cid, plane="predict", model="m")
+        led.finish(cid, outcome="ok", status=200)
+        assert led.annotate(cid, nope=1) is None  # closed: annotate no-op
+        out = led.amend(cid, critical_path_refined={"network": 0.01},
+                        backend_trace="ok")
+        assert out["critical_path_refined"] == {"network": 0.01}
+        assert led.get(cid)["backend_trace"] == "ok"
+        assert led.amend("unknown-cid", x=1) is None
+
+    def test_private_tracer_receives_retained_spans(self):
+        """A ledger built with ``tracer=`` (the router's private ring)
+        promotes kept span trees there, NOT into the process ring."""
+        ring = tr.Tracer(capacity=64)
+        sampler = tr.TailSampler(policy=tr.RetentionPolicy(
+            sample_every=1))
+        led = rl.RequestLedger(8, sampler=sampler, tracer=ring)
+        cid = tr.new_id()
+        led.begin(cid, plane="predict", model="m")
+        sampler.offer(tr.Span("router.request", trace_id=cid,
+                              span_id=tr.new_id(), start=0.0, end=0.01))
+        rec = led.finish(cid, outcome="ok", status=200)
+        assert rec["trace_retained"] is not None
+        assert [s.name for s in ring.spans(trace_id=cid)] == \
+            ["router.request"]
+        assert tr.get_tracer().spans(trace_id=cid) == []
+
 
 # ---------------------------------------------------------------------------
 # the /debug/requests JSON surface (strict grammar) + predict-plane records

@@ -130,6 +130,10 @@ class TestA3C:
 
 
 class TestTD3:
+    # Tier-1 keeps test_twin_critics_and_targets_update (the same
+    # update machinery exercised over real train steps); the 8000-step
+    # swing-up convergence run rides the slow tier.
+    @pytest.mark.slow
     def test_learns_pendulum_swingup(self):
         from deeplearning4j_tpu.rl import TD3, Pendulum, TD3Config
 

@@ -49,7 +49,9 @@ class TestValidation:
             "generation-ttft-p99", "router-availability",
             "router-retry-budget-exhausted", "recompile-after-warmup",
             "sanitizer-violation", "cache-hit-rate", "cache-stale-serve",
-            "gameday-gate-breach", "capacity-headroom-exhausted"}
+            "gameday-gate-breach", "capacity-headroom-exhausted",
+            "fleet-availability", "fleet-latency-p99",
+            "fleet-retry-budget-burn", "fleet-ejection-churn"}
 
     def test_default_serving_rules_match_example_vocabulary(self):
         known = slo.known_metric_names()
@@ -136,7 +138,7 @@ class TestCheckCLI:
              "--check", EXAMPLE_RULES],
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr
-        assert "ok: 19 rule(s) valid" in out.stdout
+        assert "ok: 23 rule(s) valid" in out.stdout
 
     def test_bad_rules_exit_nonzero(self, tmp_path):
         bad = tmp_path / "bad.json"

@@ -76,6 +76,10 @@ def test_text_generation_lstm_shapes():
     assert y.shape == (3, 5, 11)
 
 
+# Tier-1 keeps the resnet50 forward-shape row above plus the Keras
+# oracle parity leg (test_keras_applications::test_resnet50); the
+# 8-step convergence run rides the slow tier.
+@pytest.mark.slow
 def test_resnet50_trains_tiny():
     """Loss decreases over a few steps on a fixed small batch."""
     model = zoo.get_model("resnet50", num_classes=4, input_shape=(16, 16, 3),
