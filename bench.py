@@ -3158,6 +3158,231 @@ def bench_replay(peak, *, backends=3, rows=None, clients=6,
     return info
 
 
+def bench_autoscale(peak, *, rows=72, rate_rps=6.0, magnitude=6.0,
+                    service_ms=150.0, clients=6,
+                    capacity_budget_s=60.0, respawn_budget_s=60.0,
+                    quiesce_timeout_s=90.0):
+    """Fleet autoscaling under a flash crowd (serving/autoscaler.py +
+    resilience/backendpool.py): a synthetic Poisson trace warped by
+    ``warp_flash_crowd`` (the middle half's arrival gaps compressed
+    ``magnitude``x) replayed against a ONE-backend subprocess fleet
+    with the autoscaler armed. Three gates:
+
+    1. **time-to-capacity** — the spike trips the overload hysteresis;
+       scale-out decision -> the spawned backend's first ready probe
+       (real process start + jax import + warmup + probe admission)
+       <= ``capacity_budget_s``.
+    2. **scale-to-zero** — traffic stops; sustained idle drains and
+       retires EVERY backend (floor 0).
+    3. **page-in respawn** — one cold request against the empty fleet
+       parks at the router, pages a backend in, and is served by the
+       respawn <= ``respawn_budget_s`` round-trip.
+
+    Per-request service time is pinned at ``service_ms`` via the
+    ``serving.latency`` injection point in the backend subprocesses,
+    so one backend's capacity — and therefore the spike's overload —
+    is deterministic. ``peak`` is unused: the metrics are control-loop
+    economics.
+    """
+    import textwrap
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.resilience import replay as rp
+    from deeplearning4j_tpu.resilience.backendpool import (
+        ProcessBackendLauncher,
+    )
+    from deeplearning4j_tpu.serving import (
+        FleetRouter,
+        RouterPolicy,
+        ServingClient,
+    )
+    from deeplearning4j_tpu.serving.autoscaler import (
+        Autoscaler,
+        AutoscalerPolicy,
+    )
+
+    at_frac, width_frac = 0.5, 0.5
+    base = rp.synthesize_trace({
+        "n": int(rows), "rate_rps": float(rate_rps), "seed": 2026,
+        "models": [{"name": "scale", "plane": "predict",
+                    "payload_shape": [1, 4], "deadline_s": 30.0}]})
+    trace = rp.warp_flash_crowd(base, at_frac=at_frac,
+                                width_frac=width_frac,
+                                magnitude=float(magnitude))
+    # spike onset in the WARPED timeline: warping keeps row order, so
+    # the first row whose PRE-warp arrival falls inside the window
+    # marks where the compressed burst lands after the warp
+    lo = (at_frac - width_frac / 2.0) * base["duration_s"]
+    spike_lo_s = next(
+        (w["arrival_offset_s"]
+         for b, w in zip(base["rows"], trace["rows"])
+         if b["arrival_offset_s"] >= lo), 0.0)
+
+    script = textwrap.dedent("""
+        import sys, time
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.serving import (ModelRegistry,
+                                                ModelServer, spec)
+
+        def fwd(v, x):
+            return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+        reg = ModelRegistry()
+        reg.register("scale", fwd, {"scale": 1.0}, input_spec=spec((4,)),
+                     mode="batched", max_batch_size=8)
+        srv = ModelServer(reg, port=int(sys.argv[1]), sentinel=False)
+        srv.start(warm=True)
+        while True:
+            time.sleep(3600)
+    """)
+
+    def argv(name, port):
+        return [sys.executable, "-c", script, str(port)]
+
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        DL4J_TPU_FAULTS=("serving.latency%%1x1000000:%g"
+                         % (float(service_ms) / 1000.0)))
+    launcher = ProcessBackendLauncher(argv, env=env, grace_s=5.0)
+    policy = RouterPolicy(probe_interval_s=0.25, probe_timeout_s=0.5,
+                          reprobe_after_s=0.5, park_timeout_s=60.0)
+    # empty-seeded + add_backend: the seed takes traffic only after a
+    # genuine ready probe (the subprocess imports jax before binding)
+    router = FleetRouter([], policy=policy).start()
+    a = a2 = None
+    try:
+        router.add_backend("b0", launcher.spawn("b0"))
+        a = Autoscaler(
+            router, launcher,
+            policy=AutoscalerPolicy(
+                min_backends=1, max_backends=3, tick_interval_s=0.2,
+                fire_after=2, clear_after=2, idle_fire_after=999999,
+                cooldown_s=2.0, occupancy_high=1.0,
+                backend_slot_target=4, dead_fire_after=3,
+                spawn_grace_s=120.0)).attach()
+        a._spawned_t["b0"] = a._clock()
+        a._slot_of["b0"] = "b0"
+        if not router.wait_routable("b0", timeout_s=180.0):
+            raise RuntimeError("autoscale bench seed backend never ready")
+        a.start()
+
+        # -- leg A: flash crowd -> scale-out -> time-to-capacity -----------
+        t_capacity = [None]
+        stop_watch = threading.Event()
+
+        def _watch():
+            while not stop_watch.is_set():
+                if sum(1 for b in router.backends if b.routable) >= 2:
+                    t_capacity[0] = time.monotonic()
+                    return
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=_watch, daemon=True)
+        watcher.start()
+        t_replay0 = time.monotonic()
+        rep = rp.ReplayDriver(router.url, trace, speed=1.0,
+                              clients=clients).run()
+        rep.pop("results", None)
+        scale_outs = [e for e in a.ledger()
+                      if e["action"] == "scale_out" and e["executed"]]
+        if scale_outs:
+            watcher.join(timeout=capacity_budget_s)
+        stop_watch.set()
+        watcher.join(timeout=5.0)
+        time_to_capacity_s = (
+            t_capacity[0] - scale_outs[0]["mono"]
+            if scale_outs and t_capacity[0] is not None else None)
+        spike_to_capacity_s = (
+            t_capacity[0] - (t_replay0 + spike_lo_s)
+            if t_capacity[0] is not None else None)
+        a.stop()
+
+        # every live backend genuinely serving before the retire wave:
+        # draining a still-warming spawn would measure its warmup, not
+        # the scale-in plane
+        deadline = time.monotonic() + quiesce_timeout_s
+        while time.monotonic() < deadline:
+            if router.backends and all(b.routable
+                                       for b in router.backends):
+                break
+            time.sleep(0.1)
+        fleet_peak = len(router.backends)
+
+        # -- legs B+C: idle -> scale-to-zero -> page-in respawn ------------
+        a2 = Autoscaler(
+            router, launcher,
+            policy=AutoscalerPolicy(
+                min_backends=0, max_backends=3, tick_interval_s=0.2,
+                fire_after=2, clear_after=2, idle_fire_after=2,
+                cooldown_s=0.4, dead_fire_after=3,
+                spawn_grace_s=120.0, scale_to_zero=True),
+            metrics=a.metrics).attach()
+        a2.start()
+        deadline = time.monotonic() + quiesce_timeout_s
+        while time.monotonic() < deadline and router.backends:
+            time.sleep(0.1)
+        scaled_to_zero = not router.backends
+        respawn_s = page_in_value_ok = None
+        if scaled_to_zero:
+            c = ServingClient(router.url, max_retries=2)
+            x = np.zeros((1, 4), np.float32)
+            t0 = time.monotonic()
+            out = c.predict("scale", x, deadline_ms=90000)
+            respawn_s = time.monotonic() - t0
+            page_in_value_ok = bool(out["outputs"][0][0] == 1.0)
+        page_ins = [e for e in a2.ledger()
+                    if e["action"] == "page_in" and e["executed"]]
+    finally:
+        for ctl in (a, a2):
+            if ctl is not None:
+                ctl.stop()
+        router.stop()
+        launcher.stop_all()
+
+    gate_capacity = (time_to_capacity_s is not None
+                     and time_to_capacity_s <= capacity_budget_s)
+    gate_respawn = (respawn_s is not None
+                    and respawn_s <= respawn_budget_s)
+    info = {
+        "trace_rows": trace["count"],
+        "trace_duration_s": trace["duration_s"],
+        "spike_magnitude": magnitude,
+        "service_ms": service_ms,
+        "availability": rep["availability"],
+        "goodput_rps": rep["goodput_rps"],
+        "p99_s": rep["latency_p99_s"],
+        "scale_out_decisions": len(scale_outs),
+        "fleet_peak": fleet_peak,
+        "time_to_capacity_s": (round(time_to_capacity_s, 3)
+                               if time_to_capacity_s is not None
+                               else None),
+        "spike_to_capacity_s": (round(spike_to_capacity_s, 3)
+                                if spike_to_capacity_s is not None
+                                else None),
+        "scaled_to_zero": scaled_to_zero,
+        "page_in_executions": len(page_ins),
+        "respawn_s": (round(respawn_s, 3)
+                      if respawn_s is not None else None),
+        "page_in_value_ok": page_in_value_ok,
+        # integrity gates: the spike provably grew the fleet within
+        # budget, idle provably drained it to zero, and one cold
+        # request provably paged capacity back in within budget
+        "gate_capacity_ok": bool(gate_capacity),
+        "gate_respawn_ok": bool(gate_respawn),
+        "converged": bool(gate_capacity and gate_respawn
+                          and scaled_to_zero
+                          and page_in_value_ok
+                          and rep["availability"] >= 0.95),
+        "unit": "s scale-out decision -> new capacity routable",
+    }
+    info["value"] = info["time_to_capacity_s"]
+    return info
+
+
 def bench_fleetobs(peak, *, backends=2, overhead_rounds=6,
                    overhead_requests=30, window_requests=40, ab_rounds=6):
     """Fleet-observability benchmark (serving/router.py request ledger +
@@ -3482,6 +3707,13 @@ _CONFIGS = {
     # kill->recovery MTTR and p99, judged by the drill's own gates
     # plus the ledger/fleet-counter reconciliation row.
     "replay": bench_replay,
+    # Fleet autoscaling (serving/autoscaler.py + resilience/
+    # backendpool.py): a flash-crowd-warped trace against a 1-backend
+    # subprocess fleet with the autoscaler armed — time from the
+    # scale-out decision to new capacity routable (gated), idle
+    # drain-and-retire to zero, and the page-in respawn round trip for
+    # one cold request against the empty fleet (gated).
+    "autoscale": bench_autoscale,
     # Fleet observability tier (serving/router.py request ledger +
     # span plane + cross-tier stitching): router-added p99 with the
     # plane armed (< 1 ms, jitter-floored) and the always-on router
@@ -3574,6 +3806,12 @@ _CPU_INTEGRITY = {
     # with the client ledger reconciling against the router counters
     # (first 24 trace rows, same invariants as the perf leg)
     "replay": dict(rows=24, clients=4),
+    # autoscale reports "converged" = the flash crowd scaled the fleet
+    # out within the capacity budget, sustained idle retired every
+    # backend (scale-to-zero), and one cold request paged capacity
+    # back in within the respawn budget with availability >= 95%
+    # (same invariants as the perf leg over a shorter trace)
+    "autoscale": dict(rows=36, rate_rps=6.0, clients=4),
     # fleetobs reports "converged" = router-added p99 < 1 ms with the
     # observability plane armed AND the router ledger+span tier costs
     # the serving window < 2% AND the stitch/health endpoints answer

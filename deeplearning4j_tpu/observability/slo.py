@@ -247,6 +247,12 @@ def known_metric_names(extra: Sequence[str] = ()) -> set:
     TsdbMetrics(reg)
     UsageMetrics(reg)
     CapacityMetrics(reg)
+    # the fleet autoscaler's autoscaler_* families
+    # (serving/autoscaler.py): the autoscaler-flapping and
+    # fleet-underprovisioned burn-rate rules validate offline
+    from deeplearning4j_tpu.serving.autoscaler import AutoscalerMetrics
+
+    AutoscalerMetrics(reg)
     names.update(i.name for i in reg.instruments())
     return names
 

@@ -54,9 +54,12 @@ GENERATION_KINDS = frozenset({
 # fleet router tier (router.py)
 ROUTER_KINDS = frozenset({
     "router.backend",
+    "router.backend_added",
+    "router.backend_removed",
     "router.backend_warming",
     "router.deploy",
     "router.drain",
+    "router.park",
     "router.readmit",
     "router.retry",
     "router.retry_budget_exhausted",
@@ -64,6 +67,17 @@ ROUTER_KINDS = frozenset({
     "router.start",
     "router.stop",
     "router.stream_broken",
+})
+
+# fleet autoscaler / self-healing control loop (serving/autoscaler.py)
+AUTOSCALER_KINDS = frozenset({
+    "autoscaler.gave_up",
+    "autoscaler.page_in",
+    "autoscaler.replace",
+    "autoscaler.scale_in",
+    "autoscaler.scale_out",
+    "autoscaler.start",
+    "autoscaler.stop",
 })
 
 # training + data pipeline (trainer.py / iterators.py)
@@ -160,7 +174,8 @@ TELEMETRY_KINDS = frozenset({
 EVENT_KINDS = frozenset().union(
     SERVING_KINDS, GENERATION_KINDS, ROUTER_KINDS, TRAIN_KINDS,
     RESILIENCE_KINDS, COMPILE_KINDS, OBSERVABILITY_KINDS,
-    SANITIZER_KINDS, CACHE_KINDS, REPLAY_KINDS, TELEMETRY_KINDS)
+    SANITIZER_KINDS, CACHE_KINDS, REPLAY_KINDS, TELEMETRY_KINDS,
+    AUTOSCALER_KINDS)
 
 
 def known_event_kinds() -> frozenset:

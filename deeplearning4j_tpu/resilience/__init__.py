@@ -15,8 +15,20 @@ Checkpoint integrity itself (SHA-256 manifests, atomic writes,
 ``verify_checkpoint`` / ``latest_verified_checkpoint`` / quarantine)
 lives in ``serde/checkpoint.py`` — this package is the policy layer on
 top of it. Stdlib + numpy + jax only.
+
+``backendpool`` adds the fleet autoscaler's lifecycle plane: the
+pluggable :class:`BackendLauncher` contract (subprocess and in-process
+implementations) plus :class:`FailStreak`, the supervisor's dead-slot
+streak discipline at fleet scope.
 """
 
+from deeplearning4j_tpu.resilience.backendpool import (
+    BackendLauncher,
+    CallableBackendLauncher,
+    FailStreak,
+    ProcessBackendLauncher,
+    free_port,
+)
 from deeplearning4j_tpu.resilience.cluster import (
     CollectiveTimeout,
     CollectiveWatchdog,
@@ -61,6 +73,11 @@ from deeplearning4j_tpu.resilience.supervisor import (
 )
 
 __all__ = [
+    "BackendLauncher",
+    "CallableBackendLauncher",
+    "FailStreak",
+    "ProcessBackendLauncher",
+    "free_port",
     "FaultInjector",
     "FaultPlan",
     "InjectedFault",

@@ -1,0 +1,273 @@
+"""Backend process lifecycle for the fleet autoscaler.
+
+The autoscaler (serving/autoscaler.py) decides *when* the fleet needs
+another backend or one fewer; this module owns *how* one starts and
+stops. The split mirrors the elastic supervisor's slot/process
+separation: policy upstairs, ``Popen`` downstairs — and keeps the
+autoscaler testable against an in-process launcher while production
+drives real OS processes.
+
+- :class:`BackendLauncher` — the pluggable contract: ``spawn(name) ->
+  url``, ``retire(name)`` (graceful: SIGTERM → grace → SIGKILL),
+  ``alive(name)``. The router's probe plane owns *admission* (a spawned
+  backend is not routable until ``/readyz`` goes green), so ``spawn``
+  returns as soon as the process exists.
+- :class:`ProcessBackendLauncher` — subprocess backends on free local
+  ports. Spawned environments inherit the fleet's warmup manifest
+  (``DL4J_TPU_WARMUP_MANIFEST``) so a scale-out pre-warms the shapes
+  the fleet is actually serving before traffic lands (ROADMAP item 8).
+- :class:`CallableBackendLauncher` — in-process backends (anything
+  with ``.url`` and ``.stop()``, e.g. a ModelServer) for fast tier-1
+  tests and dry drills.
+- :class:`FailStreak` — the supervisor's dead-slot streak discipline
+  at fleet scope: a replacement that dies younger than
+  ``immediate_exit_s`` counts toward the slot's streak;
+  ``dead_slot_threshold`` consecutive immediate deaths mark the slot
+  permanently dead so the autoscaler stops feeding it processes.
+
+Stdlib only; no flight events here — the autoscaler narrates decisions,
+this layer just reports what happened.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.analysis.lockcheck import make_lock
+
+
+def free_port() -> int:
+    """One OS-allocated free TCP port (the spawn-time port picker)."""
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class BackendLauncher:
+    """The pluggable lifecycle contract the autoscaler drives.
+
+    Implementations own name → process bookkeeping; ``retire`` and
+    ``alive`` on an unknown name are no-ops (the autoscaler also
+    manages seed backends it never spawned)."""
+
+    def spawn(self, name: str) -> str:
+        """Start a backend and return its URL. Must not block on
+        warmup — the router's probe plane gates admission."""
+        raise NotImplementedError
+
+    def retire(self, name: str) -> None:
+        """Stop the named backend: graceful first, forceful after the
+        grace deadline. Unknown names are ignored."""
+        raise NotImplementedError
+
+    def alive(self, name: str) -> bool:
+        """True while the named backend's process/thread still runs.
+        Unknown names are False."""
+        return False
+
+    def describe(self) -> dict:
+        return {"kind": type(self).__name__}
+
+    def stop_all(self) -> None:
+        """Teardown helper: retire everything this launcher spawned."""
+
+
+class ProcessBackendLauncher(BackendLauncher):
+    """Subprocess backends: ``argv_for(name, port)`` builds the command
+    line; the child inherits this process's environment plus ``env``
+    plus the fleet's warmup manifest path when one is armed.
+
+    ``retire`` is SIGTERM → ``grace_s`` → SIGKILL: a healthy backend
+    drains and exits on SIGTERM (install_sigterm_teardown); a wedged
+    one must not stall the control loop past the grace window."""
+
+    def __init__(self, argv_for: Callable[[str, int], List[str]], *,
+                 env: Optional[dict] = None, grace_s: float = 5.0,
+                 manifest=None, host: str = "127.0.0.1"):
+        self._argv_for = argv_for
+        self._extra_env = dict(env or {})
+        self.grace_s = float(grace_s)
+        self._host = host
+        self._manifest = manifest
+        self._lock = make_lock("ProcessBackendLauncher._lock")
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._spawned_at: Dict[str, float] = {}
+
+    def _child_env(self) -> dict:
+        env = dict(os.environ)
+        env.update(self._extra_env)
+        if self._manifest is not None:
+            # late import: serving.warmstart pulls the serving plane in,
+            # and resilience must stay importable without it
+            from deeplearning4j_tpu.serving.warmstart import (
+                ENV_WARMUP_MANIFEST, resolve_warmup_manifest)
+            m = resolve_warmup_manifest(self._manifest)
+            if m is not None and m.path is not None:
+                m.save()  # the child reads disk, not our memory
+                env[ENV_WARMUP_MANIFEST] = str(m.path)
+        return env
+
+    def spawn(self, name: str) -> str:
+        port = free_port()
+        proc = subprocess.Popen(
+            self._argv_for(name, port), env=self._child_env(),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        with self._lock:
+            self._procs[name] = proc
+            self._spawned_at[name] = time.monotonic()
+        return f"http://{self._host}:{port}"
+
+    def retire(self, name: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(name, None)
+            self._spawned_at.pop(name, None)
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=self.grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+    def alive(self, name: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(name)
+        return proc is not None and proc.poll() is None
+
+    def age_s(self, name: str) -> Optional[float]:
+        """Seconds since spawn (None for unknown names) — the
+        immediate-exit classifier's input."""
+        with self._lock:
+            t = self._spawned_at.get(name)
+        return None if t is None else time.monotonic() - t
+
+    def describe(self) -> dict:
+        with self._lock:
+            names = sorted(self._procs)
+        return {"kind": "process", "grace_s": self.grace_s,
+                "backends": names,
+                "alive": [n for n in names if self.alive(n)]}
+
+    def stop_all(self) -> None:
+        with self._lock:
+            names = list(self._procs)
+        for n in names:
+            self.retire(n)
+
+
+class CallableBackendLauncher(BackendLauncher):
+    """In-process backends for tests: ``factory(name)`` returns any
+    object with a ``.url`` attribute and a ``.stop()`` method (a
+    started ModelServer fits). ``retire`` calls ``.stop()`` — there is
+    no process to SIGKILL, so grace semantics collapse to one call."""
+
+    def __init__(self, factory: Callable[[str], object]):
+        self._factory = factory
+        self._lock = make_lock("CallableBackendLauncher._lock")
+        self._servers: Dict[str, object] = {}
+
+    def spawn(self, name: str) -> str:
+        server = self._factory(name)
+        with self._lock:
+            self._servers[name] = server
+        return server.url
+
+    def retire(self, name: str) -> None:
+        with self._lock:
+            server = self._servers.pop(name, None)
+        if server is not None:
+            server.stop()
+
+    def alive(self, name: str) -> bool:
+        with self._lock:
+            server = self._servers.get(name)
+        if server is None:
+            return False
+        # a server that exposes liveness reports it; others count as
+        # alive while registered (tests drop them via retire)
+        probe = getattr(server, "alive", None)
+        if callable(probe):
+            try:
+                return bool(probe())
+            except Exception:  # noqa: BLE001 — a dead server is False
+                return False
+        return True
+
+    def server(self, name: str):
+        with self._lock:
+            return self._servers.get(name)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"kind": "callable", "backends": sorted(self._servers)}
+
+    def stop_all(self) -> None:
+        with self._lock:
+            names = list(self._servers)
+        for n in names:
+            self.retire(n)
+
+
+class FailStreak:
+    """Per-slot immediate-exit streaks (supervisor discipline, fleet
+    scope). A *slot* is the stable lineage key replacements share
+    (``b2`` → ``b2-r1`` → ``b2-r2`` all charge slot ``b2``): the thing
+    that is permanently broken is the workload/config, not any one
+    process name."""
+
+    def __init__(self, *, immediate_exit_s: float = 5.0,
+                 dead_slot_threshold: int = 3):
+        if dead_slot_threshold < 1:
+            raise ValueError("dead_slot_threshold must be >= 1, got "
+                             f"{dead_slot_threshold}")
+        self.immediate_exit_s = float(immediate_exit_s)
+        self.dead_slot_threshold = int(dead_slot_threshold)
+        self._streak: Dict[str, int] = {}
+        self._dead: set = set()
+
+    def note_exit(self, slot: str, lifetime_s: Optional[float]) -> bool:
+        """Fold one death into the slot's streak; returns True when
+        this death marks the slot permanently dead. A lifetime older
+        than ``immediate_exit_s`` (or unknown — a seed backend the
+        launcher never spawned) proves the slot CAN run and resets the
+        streak to 1, exactly like the supervisor's restart ladder."""
+        if slot in self._dead:
+            return False
+        if lifetime_s is not None and lifetime_s <= self.immediate_exit_s:
+            self._streak[slot] = self._streak.get(slot, 0) + 1
+        else:
+            self._streak[slot] = 1
+        if self._streak[slot] >= self.dead_slot_threshold:
+            self._dead.add(slot)
+            return True
+        return False
+
+    def note_healthy(self, slot: str) -> None:
+        """A replacement that reached routable clears the streak."""
+        self._streak.pop(slot, None)
+
+    def is_dead(self, slot: str) -> bool:
+        return slot in self._dead
+
+    def describe(self) -> dict:
+        return {"immediate_exit_s": self.immediate_exit_s,
+                "dead_slot_threshold": self.dead_slot_threshold,
+                "streaks": dict(self._streak),
+                "dead_slots": sorted(self._dead)}
+
+
+__all__ = [
+    "BackendLauncher",
+    "CallableBackendLauncher",
+    "FailStreak",
+    "ProcessBackendLauncher",
+    "free_port",
+]

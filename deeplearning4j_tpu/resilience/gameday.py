@@ -52,9 +52,10 @@ from deeplearning4j_tpu.resilience import replay as _replay
 
 ENV_GAMEDAY_REPORT_DIR = "DL4J_TPU_GAMEDAY_REPORT_DIR"
 
-ACT_KINDS = ("fault", "clear_faults", "kill", "drain", "readmit", "call")
+ACT_KINDS = ("fault", "clear_faults", "kill", "drain", "readmit", "call",
+             "spawn_pressure")
 GATE_KINDS = ("critical_failures", "availability", "mttr", "p99",
-              "recompiles", "fleet_health")
+              "recompiles", "fleet_health", "autoscaler")
 
 # counter families the fleet scrape sums for reconciliation + the
 # recompile gate (whichever exist on the target; a router federates
@@ -132,14 +133,19 @@ class Act:
       the supervisor's slot murder, any chaos callable); ``kill`` is
       the act MTTR gates anchor to by default;
     - ``drain`` / ``readmit``: ``POST /admin/<kind>/<backend>`` on
-      ``admin_url`` (default: the run's target URL — the router).
+      ``admin_url`` (default: the run's target URL — the router);
+    - ``spawn_pressure``: ``POST /admin/autoscaler/pressure`` — inject
+      ``duration_s`` of synthetic overload into the router's attached
+      autoscaler, so a drill can assert the fleet scales out under
+      pressure and back in after it clears (the ``autoscaler`` gate).
     """
 
     def __init__(self, at_s: float, kind: str, *,
                  name: Optional[str] = None, spec: Optional[str] = None,
                  fn: Optional[Callable[[], object]] = None,
                  backend: Optional[str] = None,
-                 admin_url: Optional[str] = None):
+                 admin_url: Optional[str] = None,
+                 duration_s: Optional[float] = None):
         if kind not in ACT_KINDS:
             raise ValueError(f"unknown act kind {kind!r} "
                              f"(one of {ACT_KINDS})")
@@ -150,6 +156,11 @@ class Act:
                              "the script form)")
         if kind in ("drain", "readmit") and not backend:
             raise ValueError(f"{kind} act needs backend=")
+        if kind == "spawn_pressure":
+            duration_s = 10.0 if duration_s is None else float(duration_s)
+            if duration_s <= 0:
+                raise ValueError("spawn_pressure act needs duration_s "
+                                 f"> 0, got {duration_s}")
         self.at_s = float(at_s)
         self.kind = kind
         self.name = name or f"{kind}@{self.at_s:g}s"
@@ -157,6 +168,7 @@ class Act:
         self.fn = fn
         self.backend = backend
         self.admin_url = admin_url
+        self.duration_s = duration_s
         self.t_fired: Optional[float] = None  # monotonic, stamped on fire
         self.error: Optional[str] = None
 
@@ -170,6 +182,13 @@ class Act:
                 _faults.set_fault_injector(_faults.FaultInjector())
             elif self.kind in ("kill", "call"):
                 self.fn()
+            elif self.kind == "spawn_pressure":
+                url = (self.admin_url or default_admin_url).rstrip("/")
+                req = urllib.request.Request(
+                    f"{url}/admin/autoscaler/pressure"
+                    f"?duration_s={self.duration_s:g}", data=b"")
+                with urllib.request.urlopen(req, timeout=10.0) as r:
+                    r.read()
             else:  # drain / readmit
                 url = (self.admin_url or default_admin_url).rstrip("/")
                 req = urllib.request.Request(
@@ -181,9 +200,12 @@ class Act:
         self.t_fired = time.monotonic()
 
     def describe(self) -> dict:
-        return {"name": self.name, "kind": self.kind, "at_s": self.at_s,
-                "spec": self.spec, "backend": self.backend,
-                "fired": self.t_fired is not None, "error": self.error}
+        out = {"name": self.name, "kind": self.kind, "at_s": self.at_s,
+               "spec": self.spec, "backend": self.backend,
+               "fired": self.t_fired is not None, "error": self.error}
+        if self.duration_s is not None:
+            out["duration_s"] = self.duration_s
+        return out
 
 
 class Gate:
@@ -196,12 +218,16 @@ class Gate:
     the first ``kill`` act). ``fleet_health`` polls the router's
     ``/debug/health`` after the drill and breaches on any FIRING fleet
     SLO rule — the server-side cross-check of what the client-ledger
-    gates measured from the outside."""
+    gates measured from the outside. ``autoscaler`` judges the decision
+    ledger from ``/debug/autoscaler``: the fleet must have scaled out
+    within ``max_s`` of the anchor ``spawn_pressure`` act firing, and
+    (unless ``require_scale_in=False``) scaled back in after the
+    pressure window cleared."""
 
     def __init__(self, kind: str, *, name: Optional[str] = None,
                  scope: str = "run", act: Optional[str] = None,
                  max_count: int = 0, min_ratio: float = 0.99,
-                 max_s: float = 5.0):
+                 max_s: float = 5.0, require_scale_in: bool = True):
         if kind not in GATE_KINDS:
             raise ValueError(f"unknown gate kind {kind!r} "
                              f"(one of {GATE_KINDS})")
@@ -213,10 +239,14 @@ class Gate:
         self.max_count = int(max_count)
         self.min_ratio = float(min_ratio)
         self.max_s = float(max_s)
+        self.require_scale_in = bool(require_scale_in)
 
     def evaluate(self, results: Sequence[dict],
                  acts: Sequence[Act], fleet: dict,
-                 health: Optional[dict] = None) -> dict:
+                 health: Optional[dict] = None,
+                 autoscaler: Optional[dict] = None) -> dict:
+        if self.kind == "autoscaler":
+            return self._evaluate_autoscaler(acts, autoscaler)
         if self.kind == "fleet_health":
             # judged from the router's own SLO federation, not the
             # client ledger: the two views must agree for a pass
@@ -284,6 +314,41 @@ class Gate:
         return self._verdict(n <= self.max_count, n,
                              f"<= {self.max_count}")
 
+    def _evaluate_autoscaler(self, acts: Sequence[Act],
+                             autoscaler: Optional[dict]) -> dict:
+        """Judged from the autoscaler's own decision ledger (fetched
+        via ``/debug/autoscaler`` — router and drill share one
+        process-local monotonic clock, so act ``t_fired`` stamps and
+        ledger ``mono`` stamps are directly comparable)."""
+        if autoscaler is None or not isinstance(
+                autoscaler.get("ledger"), list):
+            return self._verdict(False, None,
+                                 "autoscaler ledger unavailable")
+        anchor = (_act_named(acts, self.act) if self.act
+                  else _first_of(acts, "spawn_pressure"))
+        if anchor is None or anchor.t_fired is None:
+            return self._verdict(False, None,
+                                 "no fired spawn_pressure act to "
+                                 "anchor the autoscaler gate")
+        ledger = autoscaler["ledger"]
+        outs = [e["mono"] - anchor.t_fired for e in ledger
+                if e.get("action") in ("scale_out", "page_in")
+                and isinstance(e.get("mono"), (int, float))
+                and e["mono"] >= anchor.t_fired]
+        out_after_s = round(min(outs), 3) if outs else None
+        out_ok = out_after_s is not None and out_after_s <= self.max_s
+        pressure_end = anchor.t_fired + (anchor.duration_s or 0.0)
+        scaled_in = any(e.get("action") == "scale_in"
+                        and isinstance(e.get("mono"), (int, float))
+                        and e["mono"] >= pressure_end for e in ledger)
+        in_ok = scaled_in if self.require_scale_in else True
+        budget = f"scale_out <= {self.max_s}s" + (
+            " and scale_in after pressure clears"
+            if self.require_scale_in else "")
+        return self._verdict(out_ok and in_ok,
+                             {"scale_out_after_s": out_after_s,
+                              "scaled_in": scaled_in}, budget)
+
     def _verdict(self, passed: bool, value, budget: str) -> dict:
         return {"gate": self.name, "kind": self.kind, "scope": self.scope,
                 "passed": bool(passed), "value": value, "budget": budget}
@@ -297,8 +362,12 @@ def _act_named(acts: Sequence[Act], name: str) -> Optional[Act]:
 
 
 def _first_kill(acts: Sequence[Act]) -> Optional[Act]:
+    return _first_of(acts, "kill")
+
+
+def _first_of(acts: Sequence[Act], kind: str) -> Optional[Act]:
     for a in acts:
-        if a.kind == "kill":
+        if a.kind == kind:
             return a
     return None
 
@@ -340,6 +409,21 @@ def fetch_fleet_health(url: str) -> Optional[dict]:
     gate turns that into a breach, not a crash."""
     try:
         req = urllib.request.Request(url.rstrip("/") + "/debug/health")
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            doc = json.loads(r.read())
+        return doc if isinstance(doc, dict) else None
+    except Exception:  # noqa: BLE001 — report, don't crash
+        return None
+
+
+def fetch_autoscaler(url: str) -> Optional[dict]:
+    """One ``GET /debug/autoscaler`` against the drill target — the
+    decision ledger the ``autoscaler`` gate judges and the report
+    attaches. None when unreachable or no autoscaler is attached; the
+    gate turns that into a breach, not a crash."""
+    try:
+        req = urllib.request.Request(
+            url.rstrip("/") + "/debug/autoscaler")
         with urllib.request.urlopen(req, timeout=10.0) as r:
             doc = json.loads(r.read())
         return doc if isinstance(doc, dict) else None
@@ -478,9 +562,15 @@ class GameDay:
         health = (fetch_fleet_health(self.base_url)
                   if any(g.kind == "fleet_health" for g in self.gates)
                   else None)
+        autoscaler_doc = (
+            fetch_autoscaler(self.base_url)
+            if any(g.kind == "autoscaler" for g in self.gates)
+            or any(a.kind == "spawn_pressure" for a in self.acts)
+            else None)
         verdicts = []
         for gate in self.gates:
-            v = gate.evaluate(results, self.acts, fleet, health)
+            v = gate.evaluate(results, self.acts, fleet, health,
+                              autoscaler=autoscaler_doc)
             verdicts.append(v)
             record_event("gameday.gate", name=self.name,
                          gate=v["gate"], passed=v["passed"],
@@ -519,6 +609,13 @@ class GameDay:
             "gates": verdicts,
             "worst_requests": worst,
             "incidents": incidents,
+            # the autoscaler's decision ledger rides in the artifact so
+            # a scale-out that passed (or breached) is auditable later
+            "autoscaler": (None if autoscaler_doc is None else {
+                "mode": autoscaler_doc.get("mode"),
+                "desired": autoscaler_doc.get("desired"),
+                "live": autoscaler_doc.get("live"),
+                "ledger": autoscaler_doc.get("ledger")}),
             "fleet_health": (None if health is None else {
                 "status": health.get("status"),
                 "rules": [{"name": r.get("name"),
@@ -572,6 +669,7 @@ __all__ = [
     "GameDay",
     "GameDayMetrics",
     "Gate",
+    "fetch_autoscaler",
     "fetch_fleet_health",
     "fetch_incident_index",
     "get_gameday_metrics",

@@ -64,11 +64,27 @@ REST serving story, grown into a first-class subsystem).
   budget, rolling drain for deploys, router-level priority shed, and
   fleet-federated /metrics, /debug/requests, /debug/incidents,
   /debug/fleet.
+- autoscaler: the fleet control loop — reads the router's federated
+  signals (shed rate, occupancy, capacity headroom verdicts, per-
+  backend liveness) through hysteresis + cooldown state machines and
+  drives backend lifecycle via a pluggable BackendLauncher
+  (resilience/backendpool.py): scale-out on sustained overload,
+  automatic replacement of dead backends under the supervisor's
+  dead-slot streak discipline, drain-and-retire on sustained idle, and
+  scale-to-zero with page-in-on-first-request (the router parks the
+  request under the retry budget while a backend respawns). Every
+  decision lands in an auditable ledger on GET /debug/autoscaler;
+  dry-run mode records without executing.
 """
 
 from deeplearning4j_tpu.serving.admission import (
     AdmissionController,
     AdmissionTicket,
+)
+from deeplearning4j_tpu.serving.autoscaler import (
+    Autoscaler,
+    AutoscalerMetrics,
+    AutoscalerPolicy,
 )
 from deeplearning4j_tpu.serving.cache import (
     CacheHit,
@@ -142,6 +158,9 @@ from deeplearning4j_tpu.serving.warmup import (
 __all__ = [
     "AdmissionController",
     "AdmissionTicket",
+    "Autoscaler",
+    "AutoscalerMetrics",
+    "AutoscalerPolicy",
     "BadRequestError",
     "BrownoutLadder",
     "BrownoutRung",

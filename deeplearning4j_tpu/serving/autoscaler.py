@@ -1,0 +1,705 @@
+"""Signal-driven fleet autoscaler: the control loop over the router.
+
+ROADMAP item 5 names the gap exactly: the router (ejection, shed,
+occupancy), the capacity evaluator (headroom verdicts), the warm-start
+plane (cheap respawn, probe-safe admission) and the supervisor (dead
+classification) are "all the parts of an autoscaler that nobody has
+connected". This module connects them:
+
+- **signals** — one :meth:`Autoscaler.signals` snapshot per tick reads
+  the router's own instruments: fleet in-flight + per-backend
+  occupancy, the ``router_shed_total`` rate, circuit/warming states,
+  the launcher's liveness view, and the capacity evaluator's last
+  headroom verdict;
+- **hysteresis + cooldown** — decisions go through the sentinel's
+  ``fire_after``/``clear_after`` streak machine (one jittery tick can
+  NEVER scale — ``fire_after >= 2`` is enforced exactly like
+  sentinel.Detector) plus a per-direction cooldown, so flapping
+  signals cannot thrash the fleet;
+- **actions** — scale-out on sustained overload, drain-and-retire on
+  sustained idle (optionally to ZERO backends), automatic replacement
+  of permanently-dead backends (the supervisor's dead-slot streak
+  discipline, fleet scope: replacements that die younger than
+  ``immediate_exit_s`` burn the slot's streak and the autoscaler gives
+  up after ``dead_slot_threshold``), and page-in-on-first-request for
+  scaled-to-zero models (the router parks the request under the retry
+  budget; the hook wakes this loop immediately);
+- **audit** — every decision is one row of a bounded ledger served on
+  ``GET /debug/autoscaler``, one ``autoscaler.*`` flight event, and
+  one ``autoscaler_decisions_total`` increment; a **dry-run** mode
+  records identical decisions without executing them (the rehearsal
+  lever: point it at production signals, read the ledger, then arm).
+
+Execution rides :class:`~deeplearning4j_tpu.resilience.backendpool.
+BackendLauncher` (processes in production, in-process servers in
+tests); admission safety is the router's existing probe plane — a
+spawned backend is not routable until ``/readyz`` goes green, and its
+warmup progress is probe-neutral, so scaling out can never route into
+a cold process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.analysis.lockcheck import make_lock
+from deeplearning4j_tpu.observability.flightrecorder import record_event
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.resilience.backendpool import (
+    BackendLauncher,
+    FailStreak,
+)
+from deeplearning4j_tpu.serving.circuit import STATE_OPEN
+
+ENV_AUTOSCALER_MIN = "DL4J_TPU_AUTOSCALER_MIN_BACKENDS"
+ENV_AUTOSCALER_MAX = "DL4J_TPU_AUTOSCALER_MAX_BACKENDS"
+ENV_AUTOSCALER_TICK_S = "DL4J_TPU_AUTOSCALER_TICK_S"
+ENV_AUTOSCALER_FIRE_AFTER = "DL4J_TPU_AUTOSCALER_FIRE_AFTER"
+ENV_AUTOSCALER_CLEAR_AFTER = "DL4J_TPU_AUTOSCALER_CLEAR_AFTER"
+ENV_AUTOSCALER_IDLE_FIRE_AFTER = "DL4J_TPU_AUTOSCALER_IDLE_FIRE_AFTER"
+ENV_AUTOSCALER_COOLDOWN_S = "DL4J_TPU_AUTOSCALER_COOLDOWN_S"
+ENV_AUTOSCALER_SHED_RATE = "DL4J_TPU_AUTOSCALER_SHED_RATE"
+ENV_AUTOSCALER_SCALE_TO_ZERO = "DL4J_TPU_AUTOSCALER_SCALE_TO_ZERO"
+ENV_AUTOSCALER_DRY_RUN = "DL4J_TPU_AUTOSCALER_DRY_RUN"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class AutoscalerPolicy:
+    """Decision thresholds + hysteresis/cooldown discipline.
+
+    Overload (any of): shed rate above ``shed_rate_threshold``, mean
+    routable-backend occupancy at/above ``occupancy_high`` (occupancy
+    = in-flight per routable backend / ``backend_slot_target``), the
+    capacity evaluator's fleet verdict ``"exhausted"``, or injected
+    drill pressure. Idle: zero in-flight, zero sheds, occupancy at or
+    under ``occupancy_low``. ``fire_after`` consecutive overloaded
+    ticks scale out; ``idle_fire_after`` consecutive idle ticks scale
+    in (to ``min_backends``, or to zero when ``scale_to_zero``);
+    ``cooldown_s`` separates successive scale actions per direction.
+    ``dead_fire_after`` consecutive ejected-and-not-warming ticks (or
+    launcher-reported process death) classify a backend permanently
+    dead and replace it — unless its slot burned
+    ``dead_slot_threshold`` immediate exits (lifetime under
+    ``immediate_exit_s``), when the autoscaler gives up on the slot
+    exactly like the supervisor marks a dead slot."""
+
+    min_backends: int = 1
+    max_backends: int = 4
+    tick_interval_s: float = 1.0
+    fire_after: int = 3
+    clear_after: int = 2
+    idle_fire_after: int = 5
+    cooldown_s: float = 10.0
+    shed_rate_threshold: float = 0.5
+    occupancy_high: float = 0.8
+    occupancy_low: float = 0.1
+    backend_slot_target: int = 4
+    dead_fire_after: int = 2
+    immediate_exit_s: float = 5.0
+    dead_slot_threshold: int = 3
+    # ejection amnesty for backends WE just spawned: a subprocess still
+    # importing/binding fails probes and ejects exactly like a corpse,
+    # and replacing it mid-startup would churn forever. Inside the
+    # grace window only the launcher's liveness verdict (the process
+    # provably exited) classifies a spawned backend dead.
+    spawn_grace_s: float = 30.0
+    scale_to_zero: bool = False
+    drain_timeout_s: float = 5.0
+    dry_run: bool = False
+    ledger_capacity: int = 256
+    flap_window_s: float = 60.0
+
+    def validate(self) -> "AutoscalerPolicy":
+        if self.fire_after < 2:
+            raise ValueError(
+                "fire_after must be >= 2 (hysteresis: one jittery tick "
+                f"must never scale the fleet), got {self.fire_after}")
+        if self.clear_after < 1:
+            raise ValueError("clear_after must be >= 1, got "
+                             f"{self.clear_after}")
+        if self.idle_fire_after < 2:
+            raise ValueError("idle_fire_after must be >= 2, got "
+                             f"{self.idle_fire_after}")
+        if self.dead_fire_after < 1:
+            raise ValueError("dead_fire_after must be >= 1, got "
+                             f"{self.dead_fire_after}")
+        if self.min_backends < 0:
+            raise ValueError("min_backends must be >= 0, got "
+                             f"{self.min_backends}")
+        if self.max_backends < max(1, self.min_backends):
+            raise ValueError(
+                f"max_backends ({self.max_backends}) must be >= "
+                f"max(1, min_backends={self.min_backends})")
+        if self.cooldown_s < 0 or self.tick_interval_s <= 0:
+            raise ValueError("cooldown_s must be >= 0 and "
+                             "tick_interval_s > 0")
+        if self.ledger_capacity < 1:
+            raise ValueError("ledger_capacity must be >= 1, got "
+                             f"{self.ledger_capacity}")
+        return self
+
+    @classmethod
+    def from_env(cls, **overrides) -> "AutoscalerPolicy":
+        """Knob-driven construction (the ``DL4J_TPU_AUTOSCALER_*``
+        family); explicit ``overrides`` win over the environment."""
+        kw = dict(
+            min_backends=_env_int(ENV_AUTOSCALER_MIN, cls.min_backends),
+            max_backends=_env_int(ENV_AUTOSCALER_MAX, cls.max_backends),
+            tick_interval_s=_env_float(ENV_AUTOSCALER_TICK_S,
+                                       cls.tick_interval_s),
+            fire_after=_env_int(ENV_AUTOSCALER_FIRE_AFTER,
+                                cls.fire_after),
+            clear_after=_env_int(ENV_AUTOSCALER_CLEAR_AFTER,
+                                 cls.clear_after),
+            idle_fire_after=_env_int(ENV_AUTOSCALER_IDLE_FIRE_AFTER,
+                                     cls.idle_fire_after),
+            cooldown_s=_env_float(ENV_AUTOSCALER_COOLDOWN_S,
+                                  cls.cooldown_s),
+            shed_rate_threshold=_env_float(ENV_AUTOSCALER_SHED_RATE,
+                                           cls.shed_rate_threshold),
+            scale_to_zero=_env_flag(ENV_AUTOSCALER_SCALE_TO_ZERO,
+                                    cls.scale_to_zero),
+            dry_run=_env_flag(ENV_AUTOSCALER_DRY_RUN, cls.dry_run),
+        )
+        kw.update(overrides)
+        return cls(**kw).validate()
+
+
+class AutoscalerMetrics:
+    """The autoscaler instrument bundle. Lives on the ROUTER's registry
+    in production (one scrape answers fleet + control loop; the SLO
+    engine's burn rules read the same registry), on a fresh one in
+    unit contexts."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        r = self.registry
+        self.ticks_total = r.counter(
+            "autoscaler_ticks_total",
+            "Control-loop ticks evaluated (the fleet-underprovisioned "
+            "burn rule's denominator).")
+        self.overload_ticks_total = r.counter(
+            "autoscaler_overload_ticks_total",
+            "Ticks whose signals judged the fleet overloaded (shed "
+            "rate / occupancy / capacity verdict / drill pressure) — "
+            "the fleet-underprovisioned burn rule's bad events.")
+        self.decisions_total = r.counter(
+            "autoscaler_decisions_total",
+            "Scale decisions recorded to the ledger, by action "
+            "(scale_out | scale_in | replace | page_in | give_up); "
+            "dry-run decisions count — the ledger is the audit unit.",
+            ("action",))
+        self.flaps_total = r.counter(
+            "autoscaler_flaps_total",
+            "Scale decisions that REVERSED the previous scale "
+            "direction inside flap_window_s (the autoscaler-flapping "
+            "burn rule's bad events; denominator: decisions_total).")
+        self.executions_total = r.counter(
+            "autoscaler_executions_total",
+            "Decision executions attempted (live mode only), by "
+            "action and outcome.", ("action", "ok"))
+        self.backends_desired = r.gauge(
+            "autoscaler_backends_desired",
+            "The control loop's current target backend count.")
+        self.backends_live = r.gauge(
+            "autoscaler_backends_live",
+            "Backends in the routing table at the last tick.")
+        self.spawn_to_routable_seconds = r.histogram(
+            "autoscaler_spawn_to_routable_seconds",
+            "Spawn-to-routable latency per launched backend (warmup + "
+            "probe admission) — the replacement-MTTR evidence the "
+            "autoscale bench gates.")
+
+
+class _Hysteresis:
+    """fire_after/clear_after streak machine (sentinel idiom, minus
+    the baseline: the autoscaler's thresholds are explicit policy)."""
+
+    def __init__(self, fire_after: int, clear_after: int):
+        self.fire_after = int(fire_after)
+        self.clear_after = int(clear_after)
+        self.firing = False
+        self._hot = 0
+        self._cool = 0
+
+    def update(self, anomalous: bool) -> bool:
+        """Advance one tick; returns True exactly when this tick
+        TRANSITIONED the machine into firing."""
+        if anomalous:
+            self._cool = 0
+            self._hot += 1
+            if not self.firing and self._hot >= self.fire_after:
+                self.firing = True
+                return True
+        else:
+            self._hot = 0
+            if self.firing:
+                self._cool += 1
+                if self._cool >= self.clear_after:
+                    self.firing = False
+                    self._cool = 0
+        return False
+
+    def describe(self) -> dict:
+        return {"firing": self.firing, "hot": self._hot,
+                "cool": self._cool, "fire_after": self.fire_after,
+                "clear_after": self.clear_after}
+
+
+_ACTION_EVENT = {
+    "give_up": "autoscaler.gave_up",
+    "page_in": "autoscaler.page_in",
+    "replace": "autoscaler.replace",
+    "scale_in": "autoscaler.scale_in",
+    "scale_out": "autoscaler.scale_out",
+}
+
+
+class Autoscaler:
+    """The control loop: reads router signals, drives the launcher.
+
+    ``attach()`` wires it to the router (``/debug/autoscaler``, the
+    parked-request page-in hook, defensive stop on ``router.stop()``);
+    ``start()``/``stop()`` run the tick thread; ``tick()`` is public
+    and deterministic for tests — pass ``signals=`` to bypass
+    collection entirely (the dry-run-equivalence proof feeds two
+    instances the same sequence)."""
+
+    def __init__(self, router, launcher: BackendLauncher, *,
+                 policy: Optional[AutoscalerPolicy] = None,
+                 metrics: Optional[AutoscalerMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.router = router
+        self.launcher = launcher
+        self.policy = (policy or AutoscalerPolicy.from_env()).validate()
+        self.metrics = (metrics if metrics is not None
+                        else AutoscalerMetrics(router.metrics.registry))
+        self._clock = clock
+        self._lock = make_lock("Autoscaler._lock")
+        self._overload = _Hysteresis(self.policy.fire_after,
+                                     self.policy.clear_after)
+        self._idle = _Hysteresis(self.policy.idle_fire_after, 1)
+        self._streaks = FailStreak(
+            immediate_exit_s=self.policy.immediate_exit_s,
+            dead_slot_threshold=self.policy.dead_slot_threshold)
+        self._ledger: deque = deque(maxlen=self.policy.ledger_capacity)
+        self._seq = 0
+        self._dead_ticks: Dict[str, int] = {}
+        self._slot_of: Dict[str, str] = {}
+        self._replaced: Dict[str, int] = {}  # slot -> replacement count
+        self._spawned_t: Dict[str, float] = {}
+        self._pending: Dict[str, float] = {}  # spawned, not yet routable
+        self._spawn_seq = 0
+        self._last_scale_t = {"out": float("-inf"), "in": float("-inf")}
+        self._last_scale: Optional[tuple] = None  # (direction, mono)
+        self._last_shed: Optional[float] = None
+        self._last_shed_t: Optional[float] = None
+        self._last_signals: dict = {}
+        self._desired = len(router.backends)
+        self._pressure_until = 0.0
+        self._page_in_models: set = set()
+        self._wake = threading.Event()
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(self) -> "Autoscaler":
+        self.router.autoscaler = self
+        self.router.set_page_in_hook(self.note_page_in)
+        return self
+
+    def note_page_in(self, model: str) -> None:
+        """The router's parked-request hook: a request arrived with no
+        routable backend. Cheap and lock-tight — it runs on request
+        threads; the tick thread wakes immediately to respawn."""
+        with self._lock:
+            self._page_in_models.add(model or "")
+        self._wake.set()
+
+    def inject_pressure(self, duration_s: float) -> None:
+        """Drill lever (game-day ``spawn_pressure`` act): treat every
+        tick inside the window as overloaded, whatever the real
+        signals say. Clears itself — no un-inject call to forget."""
+        self._pressure_until = self._clock() + max(0.0, float(duration_s))
+        self._wake.set()
+
+    # -- signals --------------------------------------------------------------
+
+    def signals(self) -> dict:
+        """One snapshot of everything the decision pipeline reads."""
+        now = self._clock()
+        backends = self.router.backends
+        routable = [b for b in backends if b.routable]
+        in_flight = sum(b.in_flight for b in backends)
+        shed = sum(s["value"] for s in
+                   self.router.metrics.shed_total.to_json()["samples"])
+        if self._last_shed is None or now <= (self._last_shed_t or now):
+            shed_rate = 0.0
+        else:
+            shed_rate = max(0.0, (shed - self._last_shed)
+                            / (now - self._last_shed_t))
+        self._last_shed, self._last_shed_t = shed, now
+        occupancy = (in_flight / len(routable)
+                     / max(1, self.policy.backend_slot_target)
+                     if routable else 0.0)
+        verdict = None
+        cap = getattr(self.router, "capacity", None)
+        if cap is not None and isinstance(getattr(cap, "last", None),
+                                          dict):
+            verdict = cap.last.get("verdict")
+        dead: List[str] = []
+        for b in backends:
+            spawned = self._spawned_t.get(b.name)
+            if spawned is not None and not self.launcher.alive(b.name):
+                # launcher-owned process died — authoritative, even
+                # inside the grace window (SIGKILL between probes)
+                dead.append(b.name)
+            elif b.circuit.state == STATE_OPEN and b.warming is None \
+                    and (spawned is None
+                         or now - spawned >= self.policy.spawn_grace_s):
+                dead.append(b.name)
+        return {
+            "live": len(backends),
+            "routable": len(routable),
+            "warming": sum(1 for b in backends if b.warming is not None),
+            "in_flight": in_flight,
+            "shed_rate": round(shed_rate, 4),
+            "occupancy": round(occupancy, 4),
+            "capacity_verdict": verdict,
+            "dead": dead,
+            "pressure": now < self._pressure_until,
+        }
+
+    # -- the decision pipeline ------------------------------------------------
+
+    def tick(self, signals: Optional[dict] = None) -> List[dict]:
+        """One control-loop pass; returns the decisions it recorded."""
+        p = self.policy
+        now = self._clock()
+        sig = dict(signals) if signals is not None else self.signals()
+        self._last_signals = sig
+        self.metrics.ticks_total.inc()
+        self.metrics.backends_live.set(sig.get("live", 0))
+        self._watch_pending(now)
+        overloaded = bool(
+            sig.get("pressure")
+            or sig.get("shed_rate", 0.0) > p.shed_rate_threshold
+            or sig.get("occupancy", 0.0) >= p.occupancy_high
+            or sig.get("capacity_verdict") == "exhausted")
+        if overloaded:
+            self.metrics.overload_ticks_total.inc()
+        idle = (not overloaded
+                and sig.get("in_flight", 0) == 0
+                and sig.get("shed_rate", 0.0) == 0.0
+                and sig.get("occupancy", 0.0) <= p.occupancy_low)
+        decisions: List[dict] = []
+
+        # 1) replacement — BEFORE scaling: a dead backend both distorts
+        # the occupancy signal and holds a fleet slot scale-out needs
+        dead_now = set(sig.get("dead", ()))
+        for name in list(self._dead_ticks):
+            if name not in dead_now:
+                del self._dead_ticks[name]
+        for name in dead_now:
+            self._dead_ticks[name] = self._dead_ticks.get(name, 0) + 1
+            if self._dead_ticks[name] < p.dead_fire_after:
+                continue
+            del self._dead_ticks[name]
+            decisions.append(self._replace(name, now, sig))
+
+        # 2) page-in: a parked request is WAITING — no hysteresis, the
+        # router's park deadline is the budget this must beat
+        with self._lock:
+            paged = sorted(self._page_in_models)
+            self._page_in_models.clear()
+        if (paged or sig.get("page_in")) and sig.get("routable", 0) == 0 \
+                and not self._pending and sig.get("warming", 0) == 0 \
+                and sig.get("live", 0) < p.max_backends:
+            decisions.append(self._decide(
+                "page_in", "first request for a scaled-to-zero model",
+                now, sig, detail={"models": paged},
+                execute=lambda: self._spawn_one(now)))
+
+        # 3) scale-out on sustained overload
+        self._overload.update(overloaded)
+        if self._overload.firing \
+                and now - self._last_scale_t["out"] >= p.cooldown_s \
+                and sig.get("live", 0) < p.max_backends:
+            self._last_scale_t["out"] = now
+            reason = ("drill pressure" if sig.get("pressure") else
+                      "sustained overload (shed_rate="
+                      f"{sig.get('shed_rate')}, occupancy="
+                      f"{sig.get('occupancy')}, capacity="
+                      f"{sig.get('capacity_verdict')})")
+            decisions.append(self._decide(
+                "scale_out", reason, now, sig,
+                execute=lambda: self._spawn_one(now)))
+
+        # 4) scale-in on sustained idle (never while overload fires)
+        self._idle.update(idle)
+        floor = 0 if p.scale_to_zero else p.min_backends
+        if self._idle.firing and not self._overload.firing \
+                and now - self._last_scale_t["in"] >= p.cooldown_s \
+                and sig.get("live", 0) > floor:
+            self._last_scale_t["in"] = now
+            victim = self._pick_victim()
+            decisions.append(self._decide(
+                "scale_in",
+                f"sustained idle ({self._idle.fire_after}+ ticks)",
+                now, sig, detail={"backend": victim},
+                execute=lambda: self._retire_one(victim)))
+        self.metrics.backends_desired.set(self._desired)
+        return decisions
+
+    # -- decision plumbing ----------------------------------------------------
+
+    def _decide(self, action: str, reason: str, now: float, sig: dict,
+                *, detail: Optional[dict] = None,
+                execute: Optional[Callable[[], dict]] = None) -> dict:
+        p = self.policy
+        mode = "dry_run" if p.dry_run else "live"
+        if action in ("scale_out", "page_in"):
+            self._desired = min(p.max_backends, self._desired + 1)
+        elif action == "scale_in":
+            self._desired = max(0, self._desired - 1)
+        # flap detection: a scale decision that reverses the previous
+        # one inside the window is the burn rule's bad event
+        direction = {"scale_out": "out", "page_in": "out",
+                     "scale_in": "in"}.get(action)
+        if direction is not None:
+            if self._last_scale is not None \
+                    and self._last_scale[0] != direction \
+                    and now - self._last_scale[1] <= p.flap_window_s:
+                self.metrics.flaps_total.inc()
+            self._last_scale = (direction, now)
+        self._seq += 1
+        entry = {
+            "seq": self._seq, "t": time.time(),
+            "mono": round(now, 4), "action": action, "reason": reason,
+            "mode": mode, "executed": False, "error": None,
+            "signals": {k: sig.get(k) for k in
+                        ("live", "routable", "in_flight", "shed_rate",
+                         "occupancy", "capacity_verdict", "pressure")},
+        }
+        if detail:
+            entry.update(detail)
+        self.metrics.decisions_total.inc(action=action)
+        if execute is not None and not p.dry_run:
+            try:
+                out = execute() or {}
+                entry.update(out)
+                entry["executed"] = True
+                self.metrics.executions_total.inc(action=action,
+                                                  ok="true")
+            except Exception as e:  # noqa: BLE001 — a failed execution
+                # is a ledger row + a metric, never a dead control loop
+                entry["error"] = f"{type(e).__name__}: {e}"[:200]
+                self.metrics.executions_total.inc(action=action,
+                                                  ok="false")
+        record_event(_ACTION_EVENT[action], reason=reason, mode=mode,
+                     executed=entry["executed"], error=entry["error"],
+                     backend=entry.get("backend"))
+        with self._lock:
+            self._ledger.append(entry)
+        return entry
+
+    def _replace(self, name: str, now: float, sig: dict) -> dict:
+        slot = self._slot_of.get(name, name)
+        lifetime = (now - self._spawned_t[name]
+                    if name in self._spawned_t else None)
+        if self._streaks.is_dead(slot) \
+                or self._streaks.note_exit(slot, lifetime):
+            # the slot burned its streak: retire the corpse, stop
+            # feeding it processes — exactly supervisor.slot_marked_dead
+            return self._decide(
+                "give_up",
+                f"slot {slot} dead after "
+                f"{self.policy.dead_slot_threshold} immediate exits",
+                now, sig, detail={"backend": name, "slot": slot},
+                execute=lambda: self._remove_only(name))
+        self._replaced[slot] = self._replaced.get(slot, 0) + 1
+        rname = f"{slot}-r{self._replaced[slot]}"
+        return self._decide(
+            "replace",
+            f"backend {name} classified permanently dead "
+            f"({self.policy.dead_fire_after}+ dead ticks)",
+            now, sig, detail={"backend": name, "slot": slot,
+                              "replacement": rname},
+            execute=lambda: self._replace_exec(name, slot, rname, now))
+
+    # -- executors (live mode only) -------------------------------------------
+
+    def _spawn_one(self, now: float) -> dict:
+        self._spawn_seq += 1
+        name = f"as{self._spawn_seq}"
+        url = self.launcher.spawn(name)
+        self.router.add_backend(name, url)
+        self._slot_of[name] = name
+        self._spawned_t[name] = self._clock()
+        self._pending[name] = self._clock()
+        return {"backend": name, "url": url}
+
+    def _retire_one(self, victim: Optional[str]) -> dict:
+        if victim is None:
+            raise RuntimeError("no retirable backend")
+        self.router.drain(victim, timeout_s=self.policy.drain_timeout_s)
+        self.router.remove_backend(victim)
+        self.launcher.retire(victim)
+        self._pending.pop(victim, None)
+        return {"backend": victim}
+
+    def _remove_only(self, name: str) -> dict:
+        self.router.remove_backend(name)
+        self.launcher.retire(name)
+        self._pending.pop(name, None)
+        return {"backend": name}
+
+    def _replace_exec(self, name: str, slot: str, rname: str,
+                      now: float) -> dict:
+        # no drain: the backend is DEAD — waiting on its in-flight
+        # would stall replacement on requests that can only time out
+        self.router.remove_backend(name)
+        self.launcher.retire(name)
+        self._pending.pop(name, None)
+        url = self.launcher.spawn(rname)
+        self.router.add_backend(rname, url)
+        self._slot_of[rname] = slot
+        self._spawned_t[rname] = self._clock()
+        self._pending[rname] = self._clock()
+        return {"url": url}
+
+    def _pick_victim(self) -> Optional[str]:
+        """Least-loaded routable backend, autoscaler-spawned first —
+        retiring a seed backend is legal but spawned ones are ours."""
+        candidates = [b for b in self.router.backends if b.routable]
+        if not candidates:
+            return None
+        candidates.sort(key=lambda b: (b.name not in self._spawned_t,
+                                       b.in_flight))
+        return candidates[0].name
+
+    def _watch_pending(self, now: float) -> None:
+        """Stamp spawn-to-routable for backends we launched; a spawn
+        that reached routable proves its slot healthy again."""
+        for name, t0 in list(self._pending.items()):
+            try:
+                b = self.router.backend(name)
+            except KeyError:
+                self._pending.pop(name, None)
+                continue
+            if b.routable:
+                self._pending.pop(name, None)
+                self.metrics.spawn_to_routable_seconds.observe(
+                    max(0.0, now - t0))
+                self._streaks.note_healthy(self._slot_of.get(name, name))
+
+    # -- surface ----------------------------------------------------------------
+
+    def ledger(self) -> List[dict]:
+        with self._lock:
+            return list(self._ledger)
+
+    def describe(self) -> dict:
+        """The ``GET /debug/autoscaler`` document."""
+        now = self._clock()
+        with self._lock:
+            ledger = list(self._ledger)
+            paged = sorted(self._page_in_models)
+        return {
+            "mode": "dry_run" if self.policy.dry_run else "live",
+            "running": self._started,
+            "desired": self._desired,
+            "live": len(self.router.backends),
+            "policy": dataclasses.asdict(self.policy),
+            "hysteresis": {"overload": self._overload.describe(),
+                           "idle": self._idle.describe()},
+            "signals": self._last_signals,
+            "pending_warm": sorted(self._pending),
+            "page_in_pending": paged,
+            "pressure_remaining_s": round(
+                max(0.0, self._pressure_until - now), 3),
+            "slots": self._streaks.describe(),
+            "launcher": self.launcher.describe(),
+            "ledger": ledger,
+        }
+
+    # -- lifecycle ----------------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._started:
+            return self
+        self._stop_event.clear()
+        self._wake.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fleet-autoscaler")
+        self._thread.start()
+        self._started = True
+        record_event("autoscaler.start",
+                     mode="dry_run" if self.policy.dry_run else "live",
+                     min=self.policy.min_backends,
+                     max=self.policy.max_backends)
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop_event.is_set():
+            self._wake.wait(timeout=self.policy.tick_interval_s)
+            self._wake.clear()
+            if self._stop_event.is_set():
+                break
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self._stop_event.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        record_event("autoscaler.stop", decisions=self._seq)
+
+    def __enter__(self) -> "Autoscaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerMetrics",
+    "AutoscalerPolicy",
+]

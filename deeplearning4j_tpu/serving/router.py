@@ -257,6 +257,13 @@ class RouterPolicy:
     cache_capacity: int = 0
     cache_ttl_s: float = 30.0
     cache_max_bytes: int = 32 << 20
+    # scale-to-zero page-in (serving/autoscaler.py): when > 0, a
+    # request that finds NO routable backend parks at the router for
+    # up to this long — funded by one fleet retry-budget token — while
+    # the page-in hook respawns a backend, instead of 503ing
+    # immediately. 0 disables (the default: parking only makes sense
+    # when something answers the page-in).
+    park_timeout_s: float = 0.0
 
     def validate(self) -> "RouterPolicy":
         for name in ("probe_interval_s", "probe_timeout_s",
@@ -310,6 +317,9 @@ class RouterPolicy:
             if self.cache_max_bytes < 1:
                 raise ValueError("cache_max_bytes must be >= 1, got "
                                  f"{self.cache_max_bytes}")
+        if self.park_timeout_s < 0:
+            raise ValueError(
+                f"park_timeout_s must be >= 0, got {self.park_timeout_s}")
         return self
 
     def circuit_policy(self) -> CircuitPolicy:
@@ -404,6 +414,13 @@ class RouterMetrics:
             "Backend metric families dropped from the federated "
             "/metrics view because their type/labels/buckets disagreed "
             "with the family's first-seen shape.", ("name",))
+        self.parked_total = r.counter(
+            "router_parked_total",
+            "Requests parked at the router because NO backend was "
+            "routable (the scale-to-zero page-in path), by outcome "
+            "(resumed = a backend became routable inside the park "
+            "window, timeout = none did, budget = the fleet retry "
+            "budget would not fund the park).", ("outcome",))
         self.request_phase = r.histogram(
             "router_request_phase_seconds",
             "Critical-path phase attribution per routed request: "
@@ -913,8 +930,9 @@ class FleetRouter:
                          else (f"b{i}", spec))
             self._backends.append(self._make_backend(str(name),
                                                      str(url), i))
-        if not self._backends:
-            raise ValueError("at least one backend is required")
+        # an EMPTY seed list is legal: an autoscaler-managed fleet
+        # starts with zero backends and admits its spawns through
+        # add_backend (probe-gated), or pages in from scale-to-zero
         names = [b.name for b in self._backends]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate backend names: {names}")
@@ -967,6 +985,17 @@ class FleetRouter:
             self, default_fleet_detectors(),
             registries=[self.metrics.registry, self._fed_view],
             interval_s=obs_interval)
+        # fleet autoscaler attachment (serving/autoscaler.py): the
+        # control loop registers itself here; /debug/autoscaler and
+        # the admin pressure lever answer 404 until it does. The
+        # page-in hook fires from the parked-request path when NO
+        # backend is routable — the autoscaler's respawn signal.
+        self.autoscaler = None
+        self._page_in_hook: Optional[Callable[[str], None]] = None
+        # topology lock: add/remove_backend swap self._backends
+        # copy-on-write (readers grab the list reference lock-free)
+        # and rebuild the hash ring under it
+        self._topology_lock = make_lock("FleetRouter._topology_lock")
         # fleet priority-shed state (None fleet_max_in_flight disables)
         self._fleet_lock = make_lock("FleetRouter._fleet_lock")
         self._class_in_flight = {p: 0 for p in PRIORITIES}
@@ -1075,6 +1104,12 @@ class FleetRouter:
                         in ("1", "true")))
                 elif path == "/debug/incidents":
                     self._send(200, router.render_fleet_incidents())
+                elif path == "/debug/autoscaler":
+                    if router.autoscaler is None:
+                        self._send(404, ServingError(
+                            "no autoscaler attached").to_json())
+                    else:
+                        self._send(200, router.autoscaler.describe())
                 elif path == "/models":
                     status, body = router.proxy_models()
                     self._send(status, body)
@@ -1187,6 +1222,64 @@ class FleetRouter:
     def _update_routable_gauge(self):
         self.metrics.routable_backends.set(
             sum(1 for b in self._backends if b.routable))
+
+    # -- runtime topology (the autoscaler's spawn/retire hooks) ----------------
+
+    def add_backend(self, name: str, url: str) -> Backend:
+        """Grow the routing table at runtime (autoscaler scale-out /
+        dead replacement). The new backend starts un-probed: it takes
+        traffic only once the probe plane sees a ready ``/readyz`` —
+        warm-start admission safety is exactly the deploy path's."""
+        with self._topology_lock:
+            if any(b.name == name for b in self._backends):
+                raise ValueError(f"duplicate backend name {name!r}")
+            index = (max(b.index for b in self._backends) + 1
+                     if self._backends else 0)
+            b = self._make_backend(str(name), str(url), index)
+            # a freshly spawned process is still binding its port: an
+            # unprobed backend must not be routable, or the first
+            # requests race the bind and burn the retry budget.
+            # Mark it warming until the first ready probe clears it.
+            b.warming = {"warmed": 0, "total": None}
+            # copy-on-write: readers iterate the OLD list reference
+            # without taking this lock
+            self._backends = self._backends + [b]
+            self.ring = HashRing([x.name for x in self._backends],
+                                 self.policy.hash_replicas)
+        self.metrics.backends.set(len(self._backends))
+        self._update_routable_gauge()
+        record_event("router.backend_added", backend=name, url=url)
+        return b
+
+    def remove_backend(self, name: str) -> None:
+        """Shrink the routing table at runtime (autoscaler retire /
+        dead replacement). The caller drains first when the backend is
+        healthy; a DEAD backend is removed as-is. Removing the last
+        backend is legal — that is scale-to-zero, and the parked-
+        request path pages the model back in."""
+        with self._topology_lock:
+            b = self.backend(name)  # KeyError for unknown names
+            self._backends = [x for x in self._backends
+                              if x.name != name]
+            self.ring = HashRing([x.name for x in self._backends],
+                                 self.policy.hash_replicas)
+        b.close_pool()
+        self.metrics.backends.set(len(self._backends))
+        # drop the departed backend's per-backend gauges (the
+        # federation layer's prune idiom) — a removed backend must not
+        # scrape as permanently unhealthy forever
+        self.metrics.backend_health.remove(backend=name)
+        self.metrics.backend_draining.remove(backend=name)
+        self.metrics.backend_in_flight.remove(backend=name)
+        self._update_routable_gauge()
+        record_event("router.backend_removed", backend=name)
+
+    def set_page_in_hook(self,
+                         hook: Optional[Callable[[str], None]]) -> None:
+        """Arm (or clear) the parked-request page-in callback: called
+        with the model name when a request finds no routable backend
+        and ``policy.park_timeout_s`` parks it."""
+        self._page_in_hook = hook
 
     # -- surface --------------------------------------------------------------
 
@@ -1548,73 +1641,87 @@ class FleetRouter:
         final: Optional[Tuple[int, bytes, Optional[float]]] = None
         backend_name = ""
         budget_exhausted = False
-        for attempt in (0, 1):
-            tp = _trace.now()
-            b = self._pick(exclude=tried, affinity=affinity)
-            if obs.enabled:
-                obs.span("router.pick", tp, _trace.now(),
-                         attempt=attempt,
-                         picked=b.name if b is not None else "",
-                         excluded=len(tried))
-            if b is None:
-                break
-            tried.append(b.name)
-            backend_name = b.name
-            sid, ts = obs.attempt_begin()
-            # the attempt span id rides X-Span-ID so the backend's
-            # serving.request root parents to THIS leg — one stitched
-            # tree per correlation id across tiers
-            h = headers if sid is None else {**headers,
-                                             "X-Span-ID": sid}
-            try:
-                status, raw, resp_headers = self._attempt(
-                    b, path, body, h, timeout)
-                conn_fail = False
-            except _ConnectFailure as e:
-                conn_fail, status, raw = True, 503, b""
-                obs.attempt_end(sid, ts, b.name,
-                                "timeout" if e.timeout
-                                else "connect_fail")
-                err = ConnectionFailedError(
-                    f"backend {b.name} unreachable: {e}",
-                    retry_after_ms=250.0)
-                final = (503, json.dumps(err.to_json()).encode(), 250.0)
-                if e.timeout:
-                    # the request may still be running on that
-                    # backend: failing over would double its cost —
-                    # pass the typed retryable failure to the client
+        # round 1 runs only after a successful park: a request that
+        # found NO routable backend waited (under the retry budget)
+        # for the page-in plane to respawn one, then retries fresh
+        for park_round in (0, 1):
+            for attempt in (0, 1):
+                tp = _trace.now()
+                b = self._pick(exclude=tried, affinity=affinity)
+                if obs.enabled:
+                    obs.span("router.pick", tp, _trace.now(),
+                             attempt=attempt,
+                             picked=b.name if b is not None else "",
+                             excluded=len(tried))
+                if b is None:
                     break
-            if not conn_fail:
-                obs.attempt_end(
-                    sid, ts, b.name,
-                    "ok" if status < 400
-                    else ("retryable" if self._retryable_response(status)
-                          else "error"),
-                    status=status)
-                # the Retry-After probe JSON-parses the body — only
-                # error responses can carry one, and re-parsing every
-                # 200's outputs would be the hot path's biggest cost
-                ra = (self._retry_after_from(raw, resp_headers)
-                      if status >= 400 else None)
-                final = (status, raw, ra)
-                if not self._retryable_response(status):
+                tried.append(b.name)
+                backend_name = b.name
+                sid, ts = obs.attempt_begin()
+                # the attempt span id rides X-Span-ID so the backend's
+                # serving.request root parents to THIS leg — one stitched
+                # tree per correlation id across tiers
+                h = headers if sid is None else {**headers,
+                                                 "X-Span-ID": sid}
+                try:
+                    status, raw, resp_headers = self._attempt(
+                        b, path, body, h, timeout)
+                    conn_fail = False
+                except _ConnectFailure as e:
+                    conn_fail, status, raw = True, 503, b""
+                    obs.attempt_end(sid, ts, b.name,
+                                    "timeout" if e.timeout
+                                    else "connect_fail")
+                    err = ConnectionFailedError(
+                        f"backend {b.name} unreachable: {e}",
+                        retry_after_ms=250.0)
+                    final = (503, json.dumps(err.to_json()).encode(),
+                             250.0)
+                    if e.timeout:
+                        # the request may still be running on that
+                        # backend: failing over would double its cost —
+                        # pass the typed retryable failure to the client
+                        break
+                if not conn_fail:
+                    obs.attempt_end(
+                        sid, ts, b.name,
+                        "ok" if status < 400
+                        else ("retryable"
+                              if self._retryable_response(status)
+                              else "error"),
+                        status=status)
+                    # the Retry-After probe JSON-parses the body — only
+                    # error responses can carry one, and re-parsing every
+                    # 200's outputs would be the hot path's biggest cost
+                    ra = (self._retry_after_from(raw, resp_headers)
+                          if status >= 400 else None)
+                    final = (status, raw, ra)
+                    if not self._retryable_response(status):
+                        break
+                # retryable: failover once if another backend exists and
+                # the fleet budget funds it
+                if attempt == 1:
                     break
-            # retryable: failover once if another backend exists and
-            # the fleet budget funds it
-            if attempt == 1:
+                if not self._routable(exclude=tried):
+                    break
+                if not self.budget.try_spend():
+                    self.metrics.retry_budget_exhausted_total.inc()
+                    record_event("router.retry_budget_exhausted",
+                                 backend=b.name)
+                    budget_exhausted = True
+                    break
+                reason = "connect" if conn_fail else "status"
+                self.metrics.retries_total.inc(reason=reason)
+                self.metrics.retry_budget_balance.set(self.budget.balance)
+                record_event("router.retry", backend=b.name,
+                             reason=reason)
+            if final is not None or park_round == 1:
                 break
-            if not self._routable(exclude=tried):
+            # final is None ⇔ zero routable backends at first pick
+            # (every attempted leg records a typed 503 before breaking)
+            if not self._park_for_backend(path, prio, timeout, t0, obs):
                 break
-            if not self.budget.try_spend():
-                self.metrics.retry_budget_exhausted_total.inc()
-                record_event("router.retry_budget_exhausted",
-                             backend=b.name)
-                budget_exhausted = True
-                break
-            reason = "connect" if conn_fail else "status"
-            self.metrics.retries_total.inc(reason=reason)
-            self.metrics.retry_budget_balance.set(self.budget.balance)
-            record_event("router.retry", backend=b.name, reason=reason)
+            tried = []
         if final is None:
             self.metrics.shed_total.inc(priority=prio,
                                         reason="no_backend")
@@ -1639,6 +1746,48 @@ class FleetRouter:
                    **({"retry_budget_exhausted": True}
                       if budget_exhausted else {}))
         return final
+
+    def _park_for_backend(self, path, prio, timeout, t0, obs) -> bool:
+        """Hold a request that found NO routable backend while the
+        page-in plane respawns one (scale-to-zero's first-request
+        path). Parking is funded by one fleet retry-budget token — an
+        unfunded park sheds exactly like before — and bounded by both
+        ``park_timeout_s`` and the request's own deadline. Returns
+        True when a backend became routable inside the window."""
+        park_s = self.policy.park_timeout_s
+        if park_s <= 0:
+            return False
+        if not self.budget.try_spend():
+            self.metrics.retry_budget_exhausted_total.inc()
+            self.metrics.parked_total.inc(outcome="budget")
+            record_event("router.retry_budget_exhausted", backend="")
+            return False
+        self.metrics.retry_budget_balance.set(self.budget.balance)
+        _, model = _path_plane_model(path)
+        hook = self._page_in_hook
+        if hook is not None:
+            try:
+                hook(model)
+            except Exception:  # noqa: BLE001 — the hook must never
+                pass           # fail the request it is trying to save
+        tp = _trace.now()
+        t_park = self._clock()
+        deadline = min(t_park + park_s, t0 + timeout)
+        served = False
+        while self._clock() < deadline:
+            if self._routable():
+                served = True
+                break
+            time.sleep(0.01)
+        outcome = "resumed" if served else "timeout"
+        wait_s = self._clock() - t_park
+        self.metrics.parked_total.inc(outcome=outcome)
+        record_event("router.park", model=model, priority=prio,
+                     outcome=outcome, wait_s=round(wait_s, 3))
+        if obs.enabled:
+            obs.span("router.park", tp, _trace.now(), model=model,
+                     outcome=outcome)
+        return served
 
     @staticmethod
     def _retry_after_from(raw: bytes, resp_headers: dict
@@ -1983,14 +2132,46 @@ class FleetRouter:
 
     def rolling_deploy(self, deploy_fn: Callable[[str, str], None], *,
                        drain_timeout_s: Optional[float] = None,
-                       readmit_timeout_s: float = 30.0) -> List[dict]:
+                       readmit_timeout_s: float = 30.0,
+                       manifest=None) -> List[dict]:
         """Walk the fleet one backend at a time: drain → ``deploy_fn(
         name, url)`` → readmit → wait routable. Aborts the walk when a
         drain times out with requests still in flight (deploying over
         them would fail them — the zero-dropped-requests contract
         beats finishing the roll), when a deploy step raises, or when
         a backend never comes back — one bad step must not drain the
-        rest of the fleet. Returns the per-backend report."""
+        rest of the fleet. Returns the per-backend report.
+
+        ``manifest`` (a WarmupManifest or its path) ships the fleet's
+        live warmup manifest through the roll: it is saved up front
+        and exported as ``DL4J_TPU_WARMUP_MANIFEST`` for the walk's
+        duration, so processes ``deploy_fn`` restarts AOT-compile the
+        next version's shapes before the router re-admits them."""
+        manifest_env = None
+        if manifest is not None:
+            from deeplearning4j_tpu.serving.warmstart import (
+                ENV_WARMUP_MANIFEST, resolve_warmup_manifest)
+            m = resolve_warmup_manifest(manifest)
+            if m is not None and m.path is not None:
+                m.save()  # the restarted processes read disk
+                manifest_env = (ENV_WARMUP_MANIFEST,
+                                os.environ.get(ENV_WARMUP_MANIFEST))
+                os.environ[ENV_WARMUP_MANIFEST] = str(m.path)
+        try:
+            return self._rolling_deploy(
+                deploy_fn, drain_timeout_s=drain_timeout_s,
+                readmit_timeout_s=readmit_timeout_s)
+        finally:
+            if manifest_env is not None:
+                name, prev = manifest_env
+                if prev is None:
+                    os.environ.pop(name, None)
+                else:
+                    os.environ[name] = prev
+
+    def _rolling_deploy(self, deploy_fn: Callable[[str, str], None], *,
+                        drain_timeout_s: Optional[float] = None,
+                        readmit_timeout_s: float = 30.0) -> List[dict]:
         if self.cache is not None:
             # every cached answer predates the new version: drop them
             # all up front rather than serving v_old bodies mid-roll
@@ -2035,6 +2216,23 @@ class FleetRouter:
     # -- admin HTTP -----------------------------------------------------------
 
     def handle_admin(self, path: str, query: str) -> Tuple[int, dict]:
+        if path == "/admin/autoscaler/pressure":
+            # game-day spawn_pressure lever: forward synthetic overload
+            # to the attached control loop for duration_s
+            if self.autoscaler is None:
+                return 404, ServingError(
+                    "no autoscaler attached").to_json()
+            duration = 10.0
+            qm = re.search(r"duration_s=([0-9.]+)", query or "")
+            if qm:
+                try:
+                    duration = float(qm.group(1))
+                except ValueError:
+                    return 400, BadRequestError(
+                        "duration_s must be a number, got "
+                        f"{qm.group(1)!r}").to_json()
+            self.autoscaler.inject_pressure(duration)
+            return 200, {"pressure_s": duration}
         m = re.match(r"^/admin/(drain|readmit)/([\w.\-]+)$", path)
         if m is None:
             return 404, ServingError(f"no route {path}").to_json()
@@ -2126,7 +2324,15 @@ class FleetRouter:
                 ok = verdict == "ready"
                 b.last_probe_ok = ok
                 b.last_probe_t = self._clock()
-                b.warming = warming
+                if verdict == "down" and b.warming is not None:
+                    # a spawn that has never probed ready keeps its
+                    # warming hold: clearing the stamp on a conn-refused
+                    # probe would route traffic into the unbound port
+                    # the stamp exists to protect (the circuit needs
+                    # eject_consecutive_failures more probes to open)
+                    pass
+                else:
+                    b.warming = warming
                 self.metrics.probes_total.inc(
                     backend=b.name, ok="true" if ok else "false")
                 if verdict == "warming":
@@ -2458,6 +2664,14 @@ class FleetRouter:
         return self
 
     def stop(self) -> None:
+        # defensive: an attached control loop must not outlive the
+        # router it reads (its stop() is idempotent — the owner
+        # stopping it first is the normal path)
+        if self.autoscaler is not None:
+            try:
+                self.autoscaler.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
         if self._started:
             self._stop_probing.set()
             if self._probe_thread is not None:

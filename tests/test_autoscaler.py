@@ -1,0 +1,1006 @@
+"""Fleet autoscaler tests (serving/autoscaler.py +
+resilience/backendpool.py + the router's topology/park plane).
+
+Coverage map:
+
+- pure units: the fire_after/clear_after hysteresis machine, policy
+  validation + env construction, the FailStreak dead-slot discipline,
+  launcher contracts (manifest shipping through ProcessBackendLauncher
+  child envs);
+- deterministic decision-pipeline tests: ``tick(signals=...)`` feeds
+  the control loop synthetic signal sequences — the single-tick-spike
+  proof (one jittery tick NEVER scales), scale-out under sustained
+  overload + cooldown, scale-in floors, dead-backend replacement and
+  the give-up path, page-in, flap accounting, and the dry-run ==
+  live decision-equivalence proof;
+- in-process integration: runtime add/remove on a live FleetRouter
+  (probe-gated admission of a new backend), the parked-request path
+  (timeout → typed 503; resumed → 200), the /debug/autoscaler and
+  /admin/autoscaler/pressure endpoints, the scale-to-zero round trip
+  (idle retire → park → page-in → served by the respawned backend),
+  fast in-process self-healing (a dead spawned backend is replaced
+  and the replacement serves), the rolling-deploy manifest ride-along,
+  and a spawn_pressure game-day drill judged by the autoscaler gate;
+- THE chaos acceptance (@slow): SIGKILL a subprocess backend under
+  load → the autoscaler classifies it dead and launches a replacement
+  that warms, passes /readyz, and is re-admitted — zero client-visible
+  critical failures, lockorder sanitizer armed throughout.
+
+Budget discipline: units use injected clocks/signals (no HTTP, no
+jax); integration classes share class-scoped in-process ModelServers;
+only the @slow chaos class pays for subprocesses.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import lockcheck
+from deeplearning4j_tpu.resilience import gameday as gd
+from deeplearning4j_tpu.resilience import replay as rp
+from deeplearning4j_tpu.resilience.backendpool import (
+    BackendLauncher,
+    CallableBackendLauncher,
+    FailStreak,
+    ProcessBackendLauncher,
+    free_port,
+)
+from deeplearning4j_tpu.serving import (
+    FleetRouter,
+    ModelRegistry,
+    ModelServer,
+    RouterPolicy,
+    ServingClient,
+    WarmupManifest,
+    spec,
+)
+from deeplearning4j_tpu.serving.autoscaler import (
+    Autoscaler,
+    AutoscalerMetrics,
+    AutoscalerPolicy,
+    _Hysteresis,
+)
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _scale_forward(v, x):
+    return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+
+def _mk_server(scale, *, version="v1"):
+    registry = ModelRegistry()
+    registry.register("scale", _scale_forward, {"scale": scale},
+                      input_spec=spec((4,)), version=version,
+                      mode="batched", max_batch_size=8,
+                      devices=jax.devices()[:1])
+    server = ModelServer(registry, port=0, sentinel=False)
+    server.start(warm=True)
+    return server
+
+
+class _ServerHandle:
+    """CallableBackendLauncher factory product with an honest
+    ``alive()`` (a plain ModelServer counts as alive while registered,
+    which hides in-process 'deaths' from the launcher)."""
+
+    def __init__(self, server):
+        self.server = server
+        self._alive = True
+
+    @property
+    def url(self):
+        return self.server.url
+
+    def alive(self):
+        return self._alive
+
+    def kill(self):
+        """In-process SIGKILL analogue: stop serving AND report dead."""
+        self._alive = False
+        self.server.stop(drain=False)
+
+    def stop(self):
+        self._alive = False
+        self.server.stop(drain=False)
+
+
+def _wait(cond, timeout_s, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return cond()
+
+
+def _sig(**kw):
+    base = dict(live=1, routable=1, warming=0, in_flight=0,
+                shed_rate=0.0, occupancy=0.0, capacity_verdict="ok",
+                dead=[], pressure=False)
+    base.update(kw)
+    return base
+
+
+_OVERLOAD = dict(shed_rate=5.0, occupancy=1.0)
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _FakeBackend:
+    def __init__(self, name, *, routable=True):
+        self.name = name
+        self.in_flight = 0
+        self.routable = routable
+        self.warming = None
+
+
+class _FakeRouter:
+    def __init__(self, names=("b0",), *, new_routable=True):
+        self.backends = [_FakeBackend(n) for n in names]
+        self.new_routable = new_routable
+        self.drained = []
+        self.autoscaler = None
+        self.page_in_hook = None
+
+    def add_backend(self, name, url):
+        b = _FakeBackend(name, routable=self.new_routable)
+        self.backends.append(b)
+        return b
+
+    def remove_backend(self, name):
+        self.backend(name)
+        self.backends = [b for b in self.backends if b.name != name]
+
+    def drain(self, name, timeout_s=None):
+        self.drained.append(name)
+        return True
+
+    def backend(self, name):
+        for b in self.backends:
+            if b.name == name:
+                return b
+        raise KeyError(name)
+
+    def set_page_in_hook(self, hook):
+        self.page_in_hook = hook
+
+
+class _StubLauncher(BackendLauncher):
+    def __init__(self):
+        self.spawned = []
+        self.retired = []
+        self._alive = {}
+
+    def spawn(self, name):
+        self.spawned.append(name)
+        self._alive[name] = True
+        return f"http://127.0.0.1:9/{name}"
+
+    def retire(self, name):
+        self.retired.append(name)
+        self._alive.pop(name, None)
+
+    def alive(self, name):
+        return self._alive.get(name, False)
+
+
+def _unit_policy(**kw):
+    base = dict(min_backends=1, max_backends=3, fire_after=2,
+                clear_after=1, idle_fire_after=2, cooldown_s=5.0,
+                dead_fire_after=1, tick_interval_s=0.05)
+    base.update(kw)
+    return AutoscalerPolicy(**base).validate()
+
+
+def _mk_unit(policy, *, names=("b0",), new_routable=True):
+    router = _FakeRouter(names, new_routable=new_routable)
+    launcher = _StubLauncher()
+    clock = _Clock()
+    a = Autoscaler(router, launcher, policy=policy,
+                   metrics=AutoscalerMetrics(), clock=clock)
+    return a, router, launcher, clock
+
+
+# ---------------------------------------------------------------------------
+# units: hysteresis / policy / fail streaks / launchers
+
+
+class TestHysteresis:
+    def test_fires_only_after_streak_and_transition_once(self):
+        h = _Hysteresis(3, 2)
+        assert h.update(True) is False
+        assert h.update(True) is False
+        assert h.update(True) is True       # the transition tick
+        assert h.firing
+        assert h.update(True) is False      # already firing: no re-fire
+        assert h.update(False) is False     # cool 1 of 2
+        assert h.firing
+        h.update(False)                     # cool 2 of 2 -> clears
+        assert not h.firing
+
+    def test_calm_tick_resets_the_hot_streak(self):
+        h = _Hysteresis(2, 1)
+        h.update(True)
+        h.update(False)                     # streak broken
+        assert h.update(True) is False      # back to 1 of 2
+        assert h.update(True) is True
+
+
+class TestAutoscalerPolicy:
+    def test_single_tick_fire_rejected(self):
+        with pytest.raises(ValueError, match="fire_after"):
+            AutoscalerPolicy(fire_after=1).validate()
+        with pytest.raises(ValueError, match="idle_fire_after"):
+            AutoscalerPolicy(idle_fire_after=1).validate()
+
+    def test_bounds_rejected(self):
+        with pytest.raises(ValueError, match="max_backends"):
+            AutoscalerPolicy(min_backends=5, max_backends=3).validate()
+        with pytest.raises(ValueError, match="ledger_capacity"):
+            AutoscalerPolicy(ledger_capacity=0).validate()
+
+    def test_from_env_reads_knobs_and_overrides_win(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_AUTOSCALER_MAX_BACKENDS", "7")
+        monkeypatch.setenv("DL4J_TPU_AUTOSCALER_FIRE_AFTER", "4")
+        monkeypatch.setenv("DL4J_TPU_AUTOSCALER_SCALE_TO_ZERO", "1")
+        monkeypatch.setenv("DL4J_TPU_AUTOSCALER_DRY_RUN", "true")
+        monkeypatch.setenv("DL4J_TPU_AUTOSCALER_COOLDOWN_S", "2.5")
+        p = AutoscalerPolicy.from_env(min_backends=0)
+        assert p.max_backends == 7 and p.fire_after == 4
+        assert p.scale_to_zero and p.dry_run
+        assert p.cooldown_s == 2.5 and p.min_backends == 0
+
+
+class TestFailStreak:
+    def test_immediate_exits_burn_the_slot(self):
+        fs = FailStreak(immediate_exit_s=5.0, dead_slot_threshold=3)
+        assert fs.note_exit("b2", 1.0) is False
+        assert fs.note_exit("b2", 0.5) is False
+        assert fs.note_exit("b2", 2.0) is True       # third strike
+        assert fs.is_dead("b2")
+        assert fs.note_exit("b2", 0.1) is False      # already dead
+
+    def test_long_life_or_unknown_resets(self):
+        fs = FailStreak(immediate_exit_s=5.0, dead_slot_threshold=3)
+        fs.note_exit("s", 0.5)
+        fs.note_exit("s", 0.5)
+        assert fs.note_exit("s", 100.0) is False     # proved it CAN run
+        assert fs.describe()["streaks"]["s"] == 1
+        assert fs.note_exit("s", None) is False      # seed backend
+        assert fs.describe()["streaks"]["s"] == 1
+
+    def test_routable_replacement_clears(self):
+        fs = FailStreak(dead_slot_threshold=2)
+        fs.note_exit("s", 0.5)
+        fs.note_healthy("s")
+        assert fs.note_exit("s", 0.5) is False
+        assert not fs.is_dead("s")
+
+
+class TestLaunchers:
+    def test_callable_launcher_lifecycle(self):
+        stopped = []
+
+        class _Srv:
+            def __init__(self, name):
+                self.url = f"http://x/{name}"
+
+            def stop(self):
+                stopped.append(1)
+
+        lau = CallableBackendLauncher(lambda name: _Srv(name))
+        url = lau.spawn("a")
+        assert url == "http://x/a" and lau.alive("a")
+        assert not lau.alive("nope")
+        assert lau.describe()["backends"] == ["a"]
+        lau.retire("a")
+        assert stopped == [1] and not lau.alive("a")
+        lau.retire("a")                              # idempotent
+        assert stopped == [1]
+
+    def test_process_launcher_child_env_ships_manifest(self, tmp_path):
+        m = WarmupManifest(tmp_path / "warm.json")
+        m.note_batch("scale", 8)
+        lau = ProcessBackendLauncher(lambda n, p: ["true"], manifest=m,
+                                     env={"EXTRA_FLAG": "on"})
+        env = lau._child_env()
+        assert env["DL4J_TPU_WARMUP_MANIFEST"] == str(tmp_path /
+                                                      "warm.json")
+        assert env["EXTRA_FLAG"] == "on"
+        # the manifest hit disk: the child reads it at startup
+        assert (tmp_path / "warm.json").exists()
+        # without a manifest the launcher adds nothing
+        lau2 = ProcessBackendLauncher(lambda n, p: ["true"])
+        assert (lau2._child_env().get("DL4J_TPU_WARMUP_MANIFEST")
+                == os.environ.get("DL4J_TPU_WARMUP_MANIFEST"))
+
+    def test_free_port_is_bindable(self):
+        import socket
+        p = free_port()
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", p))
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# the decision pipeline, deterministically (injected signals + clock)
+
+
+class TestTickDecisions:
+    def test_single_tick_spike_never_scales(self):
+        """THE hysteresis acceptance: one jittery overloaded tick (or
+        several, separated by calm ticks) produces NO scale decision."""
+        a, _, launcher, clock = _mk_unit(_unit_policy(fire_after=3))
+        for _ in range(4):
+            assert a.tick(_sig(**_OVERLOAD)) == []
+            assert a.tick(_sig()) == []              # calm resets
+            clock.advance(1.0)
+        assert launcher.spawned == [] and a.ledger() == []
+
+    def test_sustained_overload_scales_out_under_cooldown(self):
+        a, router, launcher, clock = _mk_unit(_unit_policy())
+        assert a.tick(_sig(**_OVERLOAD)) == []
+        d = a.tick(_sig(**_OVERLOAD))
+        assert [e["action"] for e in d] == ["scale_out"]
+        assert d[0]["executed"] and launcher.spawned == ["as1"]
+        assert any(b.name == "as1" for b in router.backends)
+        # still firing, but inside the cooldown window: no new decision
+        clock.advance(1.0)
+        assert a.tick(_sig(live=2, **_OVERLOAD)) == []
+        # past cooldown + still overloaded -> scales again, to max
+        clock.advance(10.0)
+        d = a.tick(_sig(live=2, **_OVERLOAD))
+        assert [e["action"] for e in d] == ["scale_out"]
+        # at the ceiling nothing more happens
+        clock.advance(10.0)
+        assert a.tick(_sig(live=3, **_OVERLOAD)) == []
+        assert a.metrics.overload_ticks_total.value() >= 4
+
+    def test_capacity_verdict_alone_is_an_overload_signal(self):
+        a, _, launcher, _ = _mk_unit(_unit_policy())
+        a.tick(_sig(capacity_verdict="exhausted"))
+        d = a.tick(_sig(capacity_verdict="exhausted"))
+        assert [e["action"] for e in d] == ["scale_out"]
+        assert launcher.spawned == ["as1"]
+
+    def test_idle_scales_in_but_respects_the_floor(self):
+        a, router, launcher, clock = _mk_unit(
+            _unit_policy(cooldown_s=0.0), names=("b0", "b1"))
+        a.tick(_sig(live=2))
+        d = a.tick(_sig(live=2))                     # idle streak = 2
+        assert [e["action"] for e in d] == ["scale_in"]
+        assert router.drained and launcher.retired
+        # at the floor (min_backends=1): idle forever, no decision
+        for _ in range(5):
+            clock.advance(1.0)
+            assert a.tick(_sig(live=1)) == []
+
+    def test_scale_to_zero_retires_the_last_backend(self):
+        a, router, _, _ = _mk_unit(
+            _unit_policy(cooldown_s=0.0, scale_to_zero=True))
+        a.tick(_sig())
+        d = a.tick(_sig())
+        assert [e["action"] for e in d] == ["scale_in"]
+        assert router.backends == [] and a.describe()["desired"] == 0
+
+    def test_dead_backend_replaced_with_slot_lineage(self):
+        a, router, launcher, clock = _mk_unit(
+            _unit_policy(dead_fire_after=2))
+        assert a.tick(_sig(dead=["b0"])) == []       # streak 1 of 2
+        d = a.tick(_sig(dead=["b0"]))
+        assert [e["action"] for e in d] == ["replace"]
+        assert d[0]["replacement"] == "b0-r1"
+        assert launcher.spawned == ["b0-r1"]
+        assert launcher.retired == ["b0"]
+        assert not any(b.name == "b0" for b in router.backends)
+        # a tick where the backend is healthy again resets the streak
+        a2, _, l2, _ = _mk_unit(_unit_policy(dead_fire_after=2))
+        a2.tick(_sig(dead=["b0"]))
+        a2.tick(_sig())                              # recovered
+        a2.tick(_sig(dead=["b0"]))
+        assert l2.spawned == []
+
+    def test_replacement_churn_gives_up_on_the_slot(self):
+        """Supervisor discipline at fleet scope: replacements that die
+        younger than immediate_exit_s burn the slot's streak; after
+        dead_slot_threshold the autoscaler stops feeding it."""
+        a, router, launcher, clock = _mk_unit(
+            _unit_policy(dead_fire_after=1, dead_slot_threshold=3,
+                         immediate_exit_s=5.0),
+            new_routable=False)                      # stays pending
+        actions = []
+        for name in ("b0", "b0-r1", "b0-r2"):
+            clock.advance(1.0)                       # young lifetimes
+            actions += [e["action"]
+                        for e in a.tick(_sig(dead=[name]))]
+        assert actions == ["replace", "replace", "give_up"]
+        assert launcher.spawned == ["b0-r1", "b0-r2"]
+        assert a.describe()["slots"]["dead_slots"] == ["b0"]
+        assert router.backends == []                 # corpse removed
+
+    def test_page_in_fires_without_hysteresis(self):
+        a, router, launcher, _ = _mk_unit(
+            _unit_policy(min_backends=0, scale_to_zero=True), names=(),
+            new_routable=False)              # spawn stays pending/warm
+        a.note_page_in("scale")
+        d = a.tick(_sig(live=0, routable=0))
+        assert [e["action"] for e in d] == ["page_in"]
+        assert d[0]["models"] == ["scale"]
+        assert launcher.spawned == ["as1"]
+        # the still-warming spawn suppresses duplicate page-ins
+        a.note_page_in("scale")
+        assert a.tick(_sig(live=1, routable=0, in_flight=1)) == []
+        assert launcher.spawned == ["as1"]
+
+    def test_flap_reversal_is_counted(self):
+        a, _, _, clock = _mk_unit(
+            _unit_policy(cooldown_s=0.0, flap_window_s=60.0))
+        a.tick(_sig(**_OVERLOAD))
+        a.tick(_sig(**_OVERLOAD))                    # scale_out
+        assert a.metrics.flaps_total.value() == 0
+        clock.advance(1.0)
+        a.tick(_sig(live=2))
+        a.tick(_sig(live=2))                         # scale_in: reversal
+        assert a.metrics.flaps_total.value() == 1
+        assert a.metrics.decisions_total.value(action="scale_out") == 1
+        assert a.metrics.decisions_total.value(action="scale_in") == 1
+
+    def test_dry_run_records_identical_decisions_to_live(self):
+        """THE dry-run acceptance: on the same replayed signal trace,
+        dry-run and live mode record the identical decision sequence —
+        dry-run just never executes."""
+        trace = ([_sig(**_OVERLOAD)] * 2       # -> scale_out on tick 2
+                 + [_sig(**_OVERLOAD)]         # cooldown blocks a repeat
+                 + [_sig(in_flight=1)]         # clears overload, not idle
+                 + [_sig(live=2)] * 2          # -> scale_in on tick 6
+                 + [_sig(live=2)]              # cooldown blocks a repeat
+                 + [_sig(in_flight=1, dead=["b0"])] * 2)  # -> replace
+        runs = {}
+        for mode, dry in (("live", False), ("dry", True)):
+            a, router, launcher, clock = _mk_unit(
+                _unit_policy(cooldown_s=100.0, dead_fire_after=2,
+                             dry_run=dry))
+            for s in trace:
+                a.tick(dict(s))
+                clock.advance(1.0)
+            runs[mode] = (a, launcher)
+        live, live_lau = runs["live"]
+        dry, dry_lau = runs["dry"]
+        assert [(e["action"], e["reason"]) for e in dry.ledger()] == \
+            [(e["action"], e["reason"]) for e in live.ledger()]
+        assert [e["action"] for e in live.ledger()] == [
+            "scale_out", "scale_in", "replace"]
+        # dry-run never touched the launcher; live did
+        assert all(e["mode"] == "dry_run" and not e["executed"]
+                   for e in dry.ledger())
+        assert all(e["mode"] == "live" and e["executed"]
+                   for e in live.ledger())
+        assert dry_lau.spawned == [] and live_lau.spawned != []
+        # decisions metric counts BOTH modes (the ledger is the audit)
+        assert (dry.metrics.decisions_total.value(action="scale_out")
+                == live.metrics.decisions_total.value(
+                    action="scale_out") == 1)
+
+    def test_describe_is_the_debug_document(self):
+        a, _, _, _ = _mk_unit(_unit_policy(dry_run=True))
+        a.tick(_sig(**_OVERLOAD))
+        a.tick(_sig(**_OVERLOAD))
+        doc = a.describe()
+        assert doc["mode"] == "dry_run" and doc["running"] is False
+        assert doc["hysteresis"]["overload"]["firing"]
+        assert doc["policy"]["fire_after"] == 2
+        assert doc["ledger"][0]["action"] == "scale_out"
+        assert doc["signals"]["occupancy"] == 1.0
+        json.dumps(doc)                              # wire-serializable
+
+
+# ---------------------------------------------------------------------------
+# in-process integration: runtime topology + park + endpoints
+
+
+@pytest.fixture(scope="class")
+def topo():
+    """One live router over server A; server B joins/leaves at runtime."""
+    a, b = _mk_server(1.0), _mk_server(2.0)
+    policy = RouterPolicy(probe_interval_s=0.1, probe_timeout_s=0.5,
+                          reprobe_after_s=0.3, park_timeout_s=5.0,
+                          deadline_headroom_s=0.2)
+    router = FleetRouter([("b0", a.url)], policy=policy).start()
+    ns = type("Topo", (), {})()
+    ns.a, ns.b, ns.router = a, b, router
+    ns.client = ServingClient(router.url, max_retries=2)
+    ns.x = np.zeros((1, 4), np.float32)
+    yield ns
+    router.stop()
+    a.stop(drain=False)
+    b.stop(drain=False)
+
+
+class TestRouterTopology:
+    def test_add_backend_is_probe_gated_then_serves(self, topo):
+        b = topo.router.add_backend("b1", topo.b.url)
+        assert not b.routable                # un-probed: not routable
+        assert topo.router.wait_routable("b1", timeout_s=5.0)
+        seen = {topo.client.predict("scale", topo.x)["outputs"][0][0]
+                for _ in range(16)}
+        assert seen == {1.0, 2.0}            # ring rebuilt, traffic spreads
+
+    def test_duplicate_and_unknown_names_are_typed(self, topo):
+        with pytest.raises(ValueError, match="duplicate"):
+            topo.router.add_backend("b0", topo.b.url)
+        with pytest.raises(KeyError):
+            topo.router.remove_backend("ghost")
+
+    def test_remove_backend_prunes_gauges_and_traffic(self, topo):
+        topo.router.remove_backend("b1")
+        assert [b.name for b in topo.router.backends] == ["b0"]
+        seen = {topo.client.predict("scale", topo.x)["outputs"][0][0]
+                for _ in range(8)}
+        assert seen == {1.0}
+        m = topo.router.metrics
+        assert not any(s["labels"].get("backend") == "b1"
+                       for s in m.backend_health.to_json()["samples"])
+
+    def test_park_times_out_to_typed_503(self, topo):
+        """Zero routable backends + no page-in plane: the request parks
+        for park_timeout_s (bounded by its deadline), then sheds."""
+        topo.router.remove_backend("b0")
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                body = json.dumps({"inputs": [[0.0] * 4],
+                                   "deadline_ms": 800}).encode()
+                req = urllib.request.Request(
+                    topo.router.url + "/v1/models/scale:predict",
+                    data=body,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=10)
+            waited = time.monotonic() - t0
+            assert ei.value.code == 503
+            # parked to the request deadline (0.8s + 0.2s headroom),
+            # not the full 5s park window
+            assert 0.5 <= waited < 4.0
+            m = topo.router.metrics
+            assert m.parked_total.value(outcome="timeout") >= 1
+        finally:
+            topo.router.add_backend("b0", topo.a.url)
+            assert topo.router.wait_routable("b0", timeout_s=5.0)
+
+    def test_park_resumes_when_a_backend_pages_in(self, topo):
+        topo.router.remove_backend("b0")
+        paged = []
+
+        def hook(model):
+            paged.append(model)
+            topo.router.add_backend("b0", topo.a.url)
+
+        topo.router.set_page_in_hook(hook)
+        try:
+            out = topo.client.predict("scale", topo.x)
+            assert out["outputs"][0][0] == 1.0
+            assert paged == ["scale"]
+            m = topo.router.metrics
+            assert m.parked_total.value(outcome="resumed") >= 1
+        finally:
+            topo.router.set_page_in_hook(None)
+            if not topo.router.backends:
+                topo.router.add_backend("b0", topo.a.url)
+            topo.router.wait_routable("b0", timeout_s=5.0)
+
+    def test_debug_and_pressure_endpoints(self, topo):
+        url = topo.router.url
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/debug/autoscaler",
+                                   timeout=5)
+        assert ei.value.code == 404          # nothing attached yet
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/admin/autoscaler/pressure", data=b""),
+                timeout=5)
+        assert ei.value.code == 404
+        a = Autoscaler(topo.router,
+                       CallableBackendLauncher(lambda n: None),
+                       policy=_unit_policy(dry_run=True)).attach()
+        assert topo.router.autoscaler is a
+        with urllib.request.urlopen(url + "/debug/autoscaler",
+                                    timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["mode"] == "dry_run" and doc["ledger"] == []
+        with urllib.request.urlopen(urllib.request.Request(
+                url + "/admin/autoscaler/pressure?duration_s=3.5",
+                data=b""), timeout=5) as r:
+            assert json.loads(r.read()) == {"pressure_s": 3.5}
+        assert a.describe()["pressure_remaining_s"] > 0
+        # bad duration is a typed 400, not a crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/admin/autoscaler/pressure?duration_s=1.2.3",
+                data=b""), timeout=5)
+        assert ei.value.code == 400
+        topo.router.autoscaler = None
+        topo.router.set_page_in_hook(None)
+
+    def test_rolling_deploy_ships_the_manifest(self, topo, tmp_path):
+        """ROADMAP item 8 residual: the fleet's live warmup manifest is
+        exported for deploy_fn's restarts and restored afterwards."""
+        m = WarmupManifest(tmp_path / "roll.json")
+        m.note_batch("scale", 8)
+        seen = []
+
+        def deploy_fn(name, url):
+            seen.append((name,
+                         os.environ.get("DL4J_TPU_WARMUP_MANIFEST")))
+
+        before = os.environ.get("DL4J_TPU_WARMUP_MANIFEST")
+        report = topo.router.rolling_deploy(deploy_fn, manifest=m)
+        assert [s["backend"] for s in report] == ["b0"]
+        assert report[0]["routable"]
+        assert seen == [("b0", str(tmp_path / "roll.json"))]
+        assert (tmp_path / "roll.json").exists()
+        assert os.environ.get("DL4J_TPU_WARMUP_MANIFEST") == before
+
+
+# ---------------------------------------------------------------------------
+# in-process integration: the control loop end to end
+
+
+@pytest.fixture()
+def loop_fleet():
+    """A launcher-owned seed backend behind a router, ready for an
+    autoscaler; the factory respawns real in-process ModelServers."""
+    launcher = CallableBackendLauncher(
+        lambda name: _ServerHandle(_mk_server(1.0)))
+    seed_url = launcher.spawn("m0")
+    policy = RouterPolicy(probe_interval_s=0.1, probe_timeout_s=0.5,
+                          reprobe_after_s=0.3, park_timeout_s=20.0)
+    router = FleetRouter([("m0", seed_url)], policy=policy).start()
+    ns = type("LoopFleet", (), {})()
+    ns.launcher, ns.router = launcher, router
+    ns.client = ServingClient(router.url, max_retries=3)
+    ns.x = np.zeros((1, 4), np.float32)
+    ns.autoscaler = None
+    yield ns
+    if ns.autoscaler is not None:
+        ns.autoscaler.stop()
+    router.stop()
+    launcher.stop_all()
+
+
+class TestScaleToZeroRoundTrip:
+    def test_idle_retire_then_first_request_pages_back_in(
+            self, loop_fleet):
+        """THE scale-to-zero acceptance: the idle model is retired to
+        zero backends; the first subsequent request parks under the
+        retry budget and is served by the respawned warm backend."""
+        router, launcher = loop_fleet.router, loop_fleet.launcher
+        assert router.wait_routable("m0", timeout_s=10.0)
+        a = Autoscaler(
+            router, launcher,
+            policy=AutoscalerPolicy(
+                min_backends=0, max_backends=2, fire_after=2,
+                clear_after=1, idle_fire_after=2, cooldown_s=0.2,
+                tick_interval_s=0.05, scale_to_zero=True,
+                spawn_grace_s=60.0)).attach()
+        loop_fleet.autoscaler = a
+        # the loop must mark m0's spawn time so retire is launcher-aware
+        a._spawned_t["m0"] = a._clock()
+        a._slot_of["m0"] = "m0"
+        a.start()
+        # idle ticks drain-and-retire the fleet to ZERO backends
+        assert _wait(lambda: len(router.backends) == 0, timeout_s=10.0)
+        assert _wait(lambda: any(e["action"] == "scale_in"
+                                 for e in a.ledger()), timeout_s=5.0)
+        assert not launcher.alive("m0")
+        # first request: parks -> page-in hook -> respawn -> served
+        t0 = time.monotonic()
+        out = loop_fleet.client.predict("scale", loop_fleet.x,
+                                        deadline_ms=30000)
+        respawn_s = time.monotonic() - t0
+        assert out["outputs"][0][0] == 1.0
+        ledger = a.ledger()
+        assert any(e["action"] == "page_in" and e["executed"]
+                   for e in ledger)
+        m = router.metrics
+        assert m.parked_total.value(outcome="resumed") >= 1
+        # generous CPU bound; the bench gates the real number
+        assert respawn_s < 25.0, f"respawn took {respawn_s:.1f}s"
+        # the NEXT tick's _watch_pending stamps spawn-to-routable
+        assert _wait(
+            lambda: a.metrics.spawn_to_routable_seconds.to_json()
+            ["samples"], timeout_s=5.0)      # MTTR evidence recorded
+
+
+class TestSelfHealingFast:
+    def test_dead_spawned_backend_is_replaced_and_serves(
+            self, loop_fleet):
+        """Fast in-process proxy for the @slow SIGKILL acceptance: the
+        launcher reports the spawned backend dead; the autoscaler
+        replaces it with slot lineage and the replacement serves."""
+        router, launcher = loop_fleet.router, loop_fleet.launcher
+        assert router.wait_routable("m0", timeout_s=10.0)
+        a = Autoscaler(
+            router, launcher,
+            policy=AutoscalerPolicy(
+                min_backends=1, max_backends=3, fire_after=2,
+                clear_after=1, idle_fire_after=999999,
+                cooldown_s=60.0, dead_fire_after=2,
+                tick_interval_s=0.05, spawn_grace_s=60.0)).attach()
+        loop_fleet.autoscaler = a
+        a._spawned_t["m0"] = a._clock()
+        a._slot_of["m0"] = "m0"
+        a.start()
+        # in-process SIGKILL: stops serving AND the launcher sees it
+        launcher.server("m0").kill()
+        assert _wait(lambda: any(e["action"] == "replace"
+                                 for e in a.ledger()), timeout_s=10.0)
+        entry = next(e for e in a.ledger() if e["action"] == "replace")
+        assert entry["backend"] == "m0"
+        assert entry["replacement"] == "m0-r1" and entry["executed"]
+        assert router.wait_routable("m0-r1", timeout_s=15.0)
+        out = loop_fleet.client.predict("scale", loop_fleet.x)
+        assert out["outputs"][0][0] == 1.0
+        assert not any(b.name == "m0" for b in router.backends)
+
+
+# ---------------------------------------------------------------------------
+# game-day: the spawn_pressure act + autoscaler gate
+
+
+class TestGameDayAutoscalerGate:
+    def test_act_validation_and_defaults(self):
+        act = gd.Act(0.5, "spawn_pressure")
+        assert act.duration_s == 10.0
+        assert gd.Act(0.5, "spawn_pressure",
+                      duration_s=3).duration_s == 3.0
+        with pytest.raises(ValueError, match="duration_s"):
+            gd.Act(0.5, "spawn_pressure", duration_s=0)
+        d = act.describe()
+        assert d["kind"] == "spawn_pressure" and d["duration_s"] == 10.0
+
+    def test_gate_judges_the_ledger(self):
+        act = gd.Act(0.0, "spawn_pressure", name="p", duration_s=2.0)
+        act.t_fired = 100.0
+        ledger = [{"action": "scale_out", "mono": 100.6},
+                  {"action": "scale_in", "mono": 103.1}]
+        v = gd.Gate("autoscaler", max_s=1.0).evaluate(
+            [], [act], {}, autoscaler={"ledger": ledger})
+        assert v["passed"]
+        assert v["value"] == {"scale_out_after_s": 0.6,
+                              "scaled_in": True}
+        # slow scale-out breaches
+        slow = [{"action": "scale_out", "mono": 102.5},
+                {"action": "scale_in", "mono": 103.0}]
+        v = gd.Gate("autoscaler", max_s=1.0).evaluate(
+            [], [act], {}, autoscaler={"ledger": slow})
+        assert not v["passed"]
+        # no scale-in after the window breaches unless waived
+        out_only = [{"action": "scale_out", "mono": 100.2}]
+        v = gd.Gate("autoscaler", max_s=1.0).evaluate(
+            [], [act], {}, autoscaler={"ledger": out_only})
+        assert not v["passed"]
+        v = gd.Gate("autoscaler", max_s=1.0,
+                    require_scale_in=False).evaluate(
+            [], [act], {}, autoscaler={"ledger": out_only})
+        assert v["passed"]
+
+    def test_gate_breaches_on_missing_ledger_or_anchor(self):
+        v = gd.Gate("autoscaler").evaluate([], [], {}, autoscaler=None)
+        assert not v["passed"] and "unavailable" in v["budget"]
+        act = gd.Act(0.0, "spawn_pressure")      # never fired
+        v = gd.Gate("autoscaler").evaluate(
+            [], [act], {}, autoscaler={"ledger": []})
+        assert not v["passed"]
+
+    def test_spawn_pressure_drill_scales_out_then_back_in(
+            self, loop_fleet, tmp_path):
+        """The drill: a spawn_pressure act injects synthetic overload
+        through the admin endpoint; the gate asserts scale-out within
+        the bound from the autoscaler's own ledger (attached to the
+        report artifact); after the act clears, the fleet scales back
+        in."""
+        router, launcher = loop_fleet.router, loop_fleet.launcher
+        assert router.wait_routable("m0", timeout_s=10.0)
+        a = Autoscaler(
+            router, launcher,
+            policy=AutoscalerPolicy(
+                min_backends=1, max_backends=2, fire_after=2,
+                clear_after=1, idle_fire_after=3, cooldown_s=0.2,
+                tick_interval_s=0.05, spawn_grace_s=60.0)).attach()
+        loop_fleet.autoscaler = a
+        a.start()
+        rows = [{"plane": "predict", "model": "scale",
+                 "arrival_offset_s": round(i * 0.05, 3),
+                 "priority": "normal", "tenant": "gd",
+                 "payload_shape": [1, 4], "deadline_s": 20.0,
+                 "stream": False} for i in range(12)]
+        trace = rp.validate_trace({
+            "version": 1, "kind": "dl4j_tpu_trace", "t0_wall": None,
+            "count": len(rows),
+            "duration_s": rows[-1]["arrival_offset_s"], "rows": rows})
+        drill = gd.GameDay(
+            router.url, trace, name="spawn-pressure-drill",
+            speed=1.0, clients=3, report_dir=str(tmp_path),
+            acts=[gd.Act(0.05, "spawn_pressure", name="pressure",
+                         duration_s=0.5)],
+            gates=[gd.Gate("autoscaler", max_s=20.0,
+                           require_scale_in=False),
+                   gd.Gate("critical_failures")])
+        report = drill.run()
+        by_gate = {v["gate"]: v for v in report["gates"]}
+        assert by_gate["autoscaler"]["passed"], report["gates"]
+        assert by_gate["autoscaler"]["value"]["scale_out_after_s"] \
+            is not None
+        # the decision ledger rides the report artifact
+        assert report["autoscaler"]["ledger"]
+        assert any(e["action"] == "scale_out"
+                   for e in report["autoscaler"]["ledger"])
+        files = list(tmp_path.glob("spawn-pressure-drill-*.json"))
+        assert files and json.loads(
+            files[0].read_text())["autoscaler"]["ledger"]
+        # after the act clears and traffic stops: scaled back in
+        assert _wait(lambda: any(e["action"] == "scale_in"
+                                 for e in a.ledger()), timeout_s=15.0)
+        assert _wait(lambda: len(router.backends) == 1, timeout_s=10.0)
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance (@slow): SIGKILL under load -> automatic
+# replacement that warms, passes /readyz, and is re-admitted
+
+
+_POOL_BACKEND_SCRIPT = textwrap.dedent("""
+    import sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+                                            spec)
+    port, scale = int(sys.argv[1]), float(sys.argv[2])
+
+    def fwd(v, x):
+        return jnp.zeros((x.shape[0], 1), jnp.float32) + v["scale"]
+
+    reg = ModelRegistry()
+    reg.register("scale", fwd, {"scale": scale}, input_spec=spec((4,)),
+                 version="v1", mode="batched", max_batch_size=8)
+    srv = ModelServer(reg, port=port, sentinel=False)
+    srv.start(warm=True)
+    while True:
+        time.sleep(3600)
+""")
+
+
+def _pool_argv(name, port):
+    # scale derives from the SLOT ("b1-r1" -> "b1" -> 2.0), so a
+    # replacement provably answers for its dead predecessor's share
+    slot = name.split("-")[0]
+    scale = 1.0 + float(int(slot.lstrip("b")))
+    return [sys.executable, "-c", _POOL_BACKEND_SCRIPT, str(port),
+            str(scale)]
+
+
+@pytest.mark.slow
+class TestChaosSelfHealing:
+    def test_sigkill_under_load_spawns_warm_replacement(self):
+        """SIGKILL a subprocess backend mid-load: the autoscaler
+        classifies it dead via the launcher, launches a replacement
+        that warms and passes /readyz, and the router re-admits it —
+        zero client-visible critical failures; lockorder sanitizer
+        armed across router + autoscaler + launcher the whole time."""
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setenv("DL4J_TPU_SANITIZERS", "lockorder")
+            mp.setenv("DL4J_TPU_LOCKCHECK_HOLD_S", "30")
+            lockcheck.reset()
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            launcher = ProcessBackendLauncher(_pool_argv, env=env,
+                                              grace_s=5.0)
+            policy = RouterPolicy(probe_interval_s=0.25,
+                                  probe_timeout_s=0.5,
+                                  reprobe_after_s=0.5)
+            # seed through add_backend, not the constructor: the
+            # warming stamp holds traffic until a probe sees a real
+            # ready /readyz, so wait_routable below means "the
+            # subprocess is genuinely serving" — constructor seeds are
+            # optimistically routable while the child still imports
+            router = FleetRouter([], policy=policy).start()
+            urls = [(n, launcher.spawn(n)) for n in ("b0", "b1")]
+            for n, u in urls:
+                router.add_backend(n, u)
+            a = Autoscaler(
+                router, launcher,
+                policy=AutoscalerPolicy(
+                    min_backends=2, max_backends=4, fire_after=3,
+                    clear_after=2, idle_fire_after=999999,
+                    cooldown_s=60.0, dead_fire_after=2,
+                    tick_interval_s=0.25, spawn_grace_s=120.0)).attach()
+            for n, _ in urls:
+                a._spawned_t[n] = a._clock()
+                a._slot_of[n] = n
+            try:
+                for n, _ in urls:
+                    assert router.wait_routable(n, timeout_s=90.0), \
+                        f"{n} never became routable"
+                a.start()
+                served, failures = [], []
+                lock = threading.Lock()
+                stop = threading.Event()
+
+                def client_loop(tid):
+                    c = ServingClient(router.url, max_retries=3,
+                                      backoff_base_s=0.02,
+                                      retry_seed=tid)
+                    x = np.zeros((1, 4), np.float32)
+                    while not stop.is_set():
+                        try:
+                            out = c.predict("scale", x,
+                                            deadline_ms=30000)
+                            with lock:
+                                served.append(out["outputs"][0][0])
+                        except Exception as e:  # noqa: BLE001
+                            with lock:
+                                failures.append(e)
+                        time.sleep(0.02)
+
+                ts = [threading.Thread(target=client_loop, args=(i,))
+                      for i in range(4)]
+                for t in ts:
+                    t.start()
+                time.sleep(1.0)                  # load is flowing
+                victim = launcher._procs["b1"]
+                t_kill = time.monotonic()
+                victim.send_signal(signal.SIGKILL)
+                victim.wait(timeout=10)
+                # the loop replaces the corpse with slot lineage
+                assert _wait(
+                    lambda: any(e["action"] == "replace"
+                                and e.get("backend") == "b1"
+                                for e in a.ledger()),
+                    timeout_s=20.0), a.ledger()
+                # the replacement warms and is re-admitted
+                assert router.wait_routable("b1-r1", timeout_s=90.0)
+                mttr_s = time.monotonic() - t_kill
+                stop.set()
+                for t in ts:
+                    t.join(timeout=30)
+                assert failures == [], [repr(f) for f in failures[:3]]
+                assert len(served) > 50
+                # the replacement actually serves slot b1's model
+                c = ServingClient(router.url, max_retries=2)
+                x = np.zeros((1, 4), np.float32)
+                seen = {c.predict("scale", x)["outputs"][0][0]
+                        for _ in range(16)}
+                assert len(seen) == 2, seen
+                assert mttr_s < 120.0, f"MTTR {mttr_s:.1f}s"
+                hist = a.metrics.spawn_to_routable_seconds.to_json()
+                assert hist["samples"]
+                assert lockcheck.violations() == [], \
+                    lockcheck.render_report()
+            finally:
+                a.stop()
+                router.stop()
+                launcher.stop_all()

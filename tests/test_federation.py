@@ -716,6 +716,11 @@ _SUPERVISED_WORKER = textwrap.dedent("""
 """)
 
 
+# Tier-1 budget relief (the PR 6/7 pattern, paying for the PR 20
+# autoscaler suite): the federated scrape + stitched-trace path stays
+# wired every tier-1 run via the two-process gloo leg; the full
+# supervisor kill-dossier drill rides tier-2.
+@pytest.mark.slow
 def test_supervisor_live_cluster_scrape_and_worker_kill_dossier(tmp_path):
     """THE cohort-view acceptance: a live 2-process cohort under a
     telemetry-enabled supervisor serves per-worker-labeled series at

@@ -51,7 +51,8 @@ class TestValidation:
             "sanitizer-violation", "cache-hit-rate", "cache-stale-serve",
             "gameday-gate-breach", "capacity-headroom-exhausted",
             "fleet-availability", "fleet-latency-p99",
-            "fleet-retry-budget-burn", "fleet-ejection-churn"}
+            "fleet-retry-budget-burn", "fleet-ejection-churn",
+            "autoscaler-flapping", "fleet-underprovisioned"}
 
     def test_default_serving_rules_match_example_vocabulary(self):
         known = slo.known_metric_names()
@@ -138,7 +139,7 @@ class TestCheckCLI:
              "--check", EXAMPLE_RULES],
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr
-        assert "ok: 23 rule(s) valid" in out.stdout
+        assert "ok: 25 rule(s) valid" in out.stdout
 
     def test_bad_rules_exit_nonzero(self, tmp_path):
         bad = tmp_path / "bad.json"

@@ -659,6 +659,12 @@ def _backend_metric(port, family):
 
 
 class TestWarmRestartChaos:
+    # Tier-1 budget relief (the PR 6/7 pattern, paying for the PR 20
+    # autoscaler suite): subprocess chaos rides tier-2; the corrupt-
+    # cache restart leg below keeps the degrade-clean path fast, and
+    # the warm-count discipline runs every tier-1 via
+    # TestGenerationManifestWarm.
+    @pytest.mark.slow
     def test_sigkill_restart_with_warm_cache_takes_traffic_warm(
             self, tmp_path):
         """THE acceptance: 2 backends under router load, one SIGKILLed,

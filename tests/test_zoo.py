@@ -30,6 +30,11 @@ def _one_hot(rng, n, k):
         ("nasnet", dict(num_classes=5, input_shape=(32, 32, 3),
                         penultimate_filters=48, cells_per_stack=1,
                         dropout=0.0), (2, 32, 32, 3), 5),
+        # tier-1 proxy for the slow-marked inception_resnet_v1
+        # convergence run: the residual-inception graph stays wired
+        ("inception_resnet_v1", dict(num_classes=5, width=8, blocks_a=1,
+                                     blocks_b=1, input_shape=(64, 64, 3),
+                                     dropout=0.0), (2, 64, 64, 3), 5),
     ],
 )
 def test_graph_zoo_forward_shapes(name, kw, in_shape, n_out):
